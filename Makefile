@@ -1,0 +1,24 @@
+# Convenience wrapper (reference has a Makefile driving go build/test;
+# here CMake+Ninja drive the C++ build and pytest drives the test tiers).
+
+BUILD_DIR ?= build
+
+.PHONY: all build test unit-test check bench clean
+
+all: build
+
+build:
+	cmake -S . -B $(BUILD_DIR) -G Ninja -DCMAKE_BUILD_TYPE=Release
+	ninja -C $(BUILD_DIR)
+
+unit-test: build
+	./$(BUILD_DIR)/tfd_unit_tests
+
+test: build
+	python -m pytest tests/ -x -q
+
+bench: build
+	python bench.py
+
+clean:
+	rm -rf $(BUILD_DIR)

@@ -1,0 +1,168 @@
+// tpu-feature-discovery: emit google.com/tpu.* node labels for NFD.
+//
+// Daemon structure mirrors the reference CLI
+// (cmd/gpu-feature-discovery/main.go): main → start (config load + signal
+// watcher + restart loop, main.go:117-153) → run (label/output/sleep loop
+// with oneshot and SIGHUP-reload, main.go:156-218), with the output file
+// removed on clean exit (main.go:220-240) so stale labels never outlive the
+// pod.
+#include <signal.h>
+
+#include <chrono>
+#include <cstdio>
+#include <vector>
+
+#include "tfd/config/config.h"
+#include "tfd/gce/metadata.h"
+#include "tfd/info/version.h"
+#include "tfd/lm/labeler.h"
+#include "tfd/lm/labels.h"
+#include "tfd/lm/machine_type.h"
+#include "tfd/lm/timestamp.h"
+#include "tfd/lm/tpu_labeler.h"
+#include "tfd/platform/detect.h"
+#include "tfd/resource/factory.h"
+#include "tfd/util/file.h"
+#include "tfd/util/logging.h"
+
+namespace tfd {
+namespace {
+
+enum class RunOutcome { kExit, kRestart, kError };
+
+// Builds the machine-type metadata getter when a metadata server is
+// plausibly reachable (GCE VM or explicit test endpoint).
+lm::MachineTypeGetter MakeMachineTypeGetter(const config::Config& config) {
+  const std::string& endpoint = config.flags.metadata_endpoint;
+  if (endpoint.empty() && !platform::OnGce() &&
+      std::getenv("GCE_METADATA_HOST") == nullptr) {
+    return nullptr;
+  }
+  auto client = std::make_shared<gce::MetadataClient>(endpoint);
+  return [client]() { return client->MachineType(); };
+}
+
+// One labeling pass: build backend + labelers, merge, write.
+Status LabelOnce(const config::Config& config, lm::Labeler& timestamp,
+                 lm::Labeler& machine_type) {
+  auto t0 = std::chrono::steady_clock::now();
+
+  Result<resource::ManagerPtr> manager = resource::NewManager(config);
+  if (!manager.ok()) {
+    return Status::Error("unable to create resource manager: " +
+                         manager.error());
+  }
+  Result<lm::LabelerPtr> tpu = lm::NewTpuLabeler(*manager, config);
+  if (!tpu.ok()) return tpu.status();
+
+  lm::Labels merged;
+  for (lm::Labeler* labeler :
+       std::vector<lm::Labeler*>{&timestamp, &machine_type, tpu->get()}) {
+    Result<lm::Labels> labels = labeler->GetLabels();
+    if (!labels.ok()) return labels.status();
+    for (auto& [k, v] : *labels) merged[k] = v;
+  }
+
+  if (merged.size() <= 1) {
+    TFD_LOG_WARNING << "only " << merged.size()
+                    << " label(s) generated; is this a TPU node?";
+  }
+
+  Status out = lm::OutputToFile(merged, config.flags.output_file);
+  if (!out.ok()) return out;
+
+  auto ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+                std::chrono::steady_clock::now() - t0)
+                .count();
+  TFD_LOG_INFO << "wrote " << merged.size() << " labels"
+               << (config.flags.output_file.empty()
+                       ? ""
+                       : " to " + config.flags.output_file)
+               << " in " << ms << "ms";
+  return Status::Ok();
+}
+
+RunOutcome Run(const config::Config& config, const sigset_t& sigmask) {
+  lm::LabelerPtr timestamp = lm::NewTimestampLabeler(config);
+  lm::LabelerPtr machine_type = lm::NewMachineTypeLabeler(
+      config.flags.machine_type_file, MakeMachineTypeGetter(config));
+
+  bool cleanup_output = !config.flags.oneshot &&
+                        !config.flags.output_file.empty();
+  while (true) {
+    Status s = LabelOnce(config, *timestamp, *machine_type);
+    if (!s.ok()) {
+      TFD_LOG_ERROR << s.message();
+      return RunOutcome::kError;
+    }
+    if (config.flags.oneshot) return RunOutcome::kExit;
+
+    // Sleep, interruptibly: SIGHUP → reload config and restart the loop;
+    // SIGINT/SIGTERM/SIGQUIT → clean exit (reference main.go:198-217).
+    timespec deadline{};
+    deadline.tv_sec = config.flags.sleep_interval_s;
+    int sig = sigtimedwait(&sigmask, nullptr, &deadline);
+    if (sig < 0) continue;  // EAGAIN: interval elapsed → relabel
+    if (sig == SIGHUP) {
+      TFD_LOG_INFO << "received SIGHUP; reloading configuration";
+      if (cleanup_output) {
+        Status rm = RemoveFileIfExists(config.flags.output_file);
+        if (!rm.ok()) TFD_LOG_WARNING << rm.message();
+      }
+      return RunOutcome::kRestart;
+    }
+    TFD_LOG_INFO << "received signal " << sig << "; exiting";
+    if (cleanup_output) {
+      Status rm = RemoveFileIfExists(config.flags.output_file);
+      if (!rm.ok()) TFD_LOG_WARNING << rm.message();
+    }
+    return RunOutcome::kExit;
+  }
+}
+
+int Main(int argc, char** argv) {
+  // Block the handled signals so sigtimedwait can collect them.
+  sigset_t sigmask;
+  sigemptyset(&sigmask);
+  sigaddset(&sigmask, SIGHUP);
+  sigaddset(&sigmask, SIGINT);
+  sigaddset(&sigmask, SIGTERM);
+  sigaddset(&sigmask, SIGQUIT);
+  sigprocmask(SIG_BLOCK, &sigmask, nullptr);
+
+  // start() loop: reload config and re-run on SIGHUP
+  // (reference main.go:125-153).
+  while (true) {
+    Result<config::LoadResult> loaded = config::Load(argc, argv);
+    if (!loaded.ok()) {
+      TFD_LOG_ERROR << loaded.error();
+      fprintf(stderr, "%s", config::UsageText().c_str());
+      return 1;
+    }
+    if (loaded->help_requested) {
+      printf("%s", config::UsageText().c_str());
+      return 0;
+    }
+    if (loaded->version_requested) {
+      printf("tpu-feature-discovery %s\n", info::VersionString().c_str());
+      return 0;
+    }
+    TFD_LOG_INFO << "tpu-feature-discovery " << info::VersionString();
+    TFD_LOG_INFO << "running with config: " << config::ToJson(loaded->config);
+
+    switch (Run(loaded->config, sigmask)) {
+      case RunOutcome::kExit:
+        TFD_LOG_INFO << "exiting";
+        return 0;
+      case RunOutcome::kRestart:
+        continue;
+      case RunOutcome::kError:
+        return 1;
+    }
+  }
+}
+
+}  // namespace
+}  // namespace tfd
+
+int main(int argc, char** argv) { return tfd::Main(argc, argv); }

@@ -1,0 +1,479 @@
+#include "tfd/config/config.h"
+
+#include <cstdlib>
+#include <functional>
+#include <sstream>
+
+#include "tfd/config/yamllite.h"
+#include "tfd/util/file.h"
+#include "tfd/util/logging.h"
+#include "tfd/util/strings.h"
+
+namespace tfd {
+namespace config {
+
+namespace {
+
+// One registered flag: CLI name, env aliases (first match wins), YAML key
+// under `flags:`, and a setter. `seen_cli` tracks precedence.
+struct FlagDef {
+  std::string name;               // CLI: --name
+  std::vector<std::string> envs;  // e.g. {"TFD_ONESHOT"}
+  std::string yaml_key;           // camelCase key under flags:
+  std::string usage;
+  bool is_bool = false;
+  std::function<Status(const std::string&)> set;
+};
+
+Status SetBool(bool* dst, const std::string& v) {
+  std::string s = ToLower(TrimSpace(v));
+  if (s == "true" || s == "1" || s == "yes") {
+    *dst = true;
+    return Status::Ok();
+  }
+  if (s == "false" || s == "0" || s == "no") {
+    *dst = false;
+    return Status::Ok();
+  }
+  return Status::Error("invalid boolean value '" + v + "'");
+}
+
+Status SetString(std::string* dst, const std::string& v) {
+  *dst = v;
+  return Status::Ok();
+}
+
+Status SetDuration(int* dst, const std::string& v) {
+  Result<int> r = ParseDurationSeconds(v);
+  if (!r.ok()) return r.status();
+  *dst = *r;
+  return Status::Ok();
+}
+
+std::vector<FlagDef> MakeFlagDefs(Flags* f) {
+  using std::placeholders::_1;
+  std::vector<FlagDef> defs;
+  defs.push_back({"slice-strategy",
+                  {"TFD_SLICE_STRATEGY", "SLICE_STRATEGY"},
+                  "sliceStrategy",
+                  "strategy for exposing TPU slice shapes: [none | single | mixed]",
+                  false,
+                  [f](const std::string& v) {
+                    return SetString(&f->slice_strategy, v);
+                  }});
+  defs.push_back({"fail-on-init-error",
+                  {"TFD_FAIL_ON_INIT_ERROR", "FAIL_ON_INIT_ERROR"},
+                  "failOnInitError",
+                  "fail if an error is encountered during initialization, "
+                  "otherwise degrade to a no-TPU label set",
+                  true,
+                  [f](const std::string& v) {
+                    return SetBool(&f->fail_on_init_error, v);
+                  }});
+  defs.push_back({"oneshot",
+                  {"TFD_ONESHOT"},
+                  "oneshot",
+                  "label once and exit",
+                  true,
+                  [f](const std::string& v) { return SetBool(&f->oneshot, v); }});
+  defs.push_back({"no-timestamp",
+                  {"TFD_NO_TIMESTAMP"},
+                  "noTimestamp",
+                  "do not add the timestamp label",
+                  true,
+                  [f](const std::string& v) {
+                    return SetBool(&f->no_timestamp, v);
+                  }});
+  defs.push_back({"sleep-interval",
+                  {"TFD_SLEEP_INTERVAL"},
+                  "sleepInterval",
+                  "time to sleep between labeling passes (e.g. 60s, 1m)",
+                  false,
+                  [f](const std::string& v) {
+                    return SetDuration(&f->sleep_interval_s, v);
+                  }});
+  defs.push_back({"output-file",
+                  {"TFD_OUTPUT_FILE"},
+                  "outputFile",
+                  "path of the NFD feature file ('' = stdout)",
+                  false,
+                  [f](const std::string& v) {
+                    return SetString(&f->output_file, v);
+                  }});
+  defs.push_back({"machine-type-file",
+                  {"TFD_MACHINE_TYPE_FILE"},
+                  "machineTypeFile",
+                  "file containing the DMI product name fallback",
+                  false,
+                  [f](const std::string& v) {
+                    return SetString(&f->machine_type_file, v);
+                  }});
+  defs.push_back({"config-file",
+                  {"TFD_CONFIG_FILE", "CONFIG_FILE"},
+                  "",
+                  "YAML config file (CLI and env take precedence)",
+                  false,
+                  [f](const std::string& v) {
+                    return SetString(&f->config_file, v);
+                  }});
+  defs.push_back({"use-node-feature-api",
+                  {"TFD_USE_NODE_FEATURE_API"},
+                  "useNodeFeatureAPI",
+                  "publish labels via the NFD NodeFeature API instead of the "
+                  "feature file",
+                  true,
+                  [f](const std::string& v) {
+                    return SetBool(&f->use_node_feature_api, v);
+                  }});
+  defs.push_back({"backend",
+                  {"TFD_BACKEND"},
+                  "backend",
+                  "device backend: [auto | pjrt | metadata | mock | null]",
+                  false,
+                  [f](const std::string& v) { return SetString(&f->backend, v); }});
+  defs.push_back({"libtpu-path",
+                  {"TFD_LIBTPU_PATH", "TPU_LIBRARY_PATH"},
+                  "libtpuPath",
+                  "explicit path to libtpu.so (default: search standard "
+                  "locations)",
+                  false,
+                  [f](const std::string& v) {
+                    return SetString(&f->libtpu_path, v);
+                  }});
+  defs.push_back({"metadata-endpoint",
+                  {"TFD_METADATA_ENDPOINT", "GCE_METADATA_HOST"},
+                  "metadataEndpoint",
+                  "GCE metadata server override (host[:port], for tests)",
+                  false,
+                  [f](const std::string& v) {
+                    return SetString(&f->metadata_endpoint, v);
+                  }});
+  defs.push_back({"mock-topology-file",
+                  {"TFD_MOCK_TOPOLOGY_FILE"},
+                  "mockTopologyFile",
+                  "fixture file for the mock backend (testing only)",
+                  false,
+                  [f](const std::string& v) {
+                    return SetString(&f->mock_topology_file, v);
+                  }});
+  defs.push_back({"device-health",
+                  {"TFD_DEVICE_HEALTH"},
+                  "deviceHealth",
+                  "on-chip health probe labels: [off | basic]",
+                  false,
+                  [f](const std::string& v) {
+                    return SetString(&f->device_health, v);
+                  }});
+  return defs;
+}
+
+Status ApplyYaml(const yamllite::Node& root, const std::vector<FlagDef>& defs,
+                 const std::vector<bool>& set_already, Config* config) {
+  yamllite::NodePtr version = root.Get("version");
+  if (version) {
+    Result<std::string> v = version->AsString();
+    if (!v.ok()) return v.status();
+    if (*v != kConfigVersion) {
+      return Status::Error("unsupported config version '" + *v +
+                           "' (want " + kConfigVersion + ")");
+    }
+  }
+
+  yamllite::NodePtr flags = root.Get("flags");
+  if (flags) {
+    for (size_t i = 0; i < defs.size(); i++) {
+      if (set_already[i] || defs[i].yaml_key.empty()) continue;
+      yamllite::NodePtr n = flags->Get(defs[i].yaml_key);
+      if (!n || n->IsNull()) continue;
+      Result<std::string> v = n->AsString();
+      if (!v.ok()) {
+        return Status::Error("config flags." + defs[i].yaml_key + ": " +
+                             v.error());
+      }
+      Status s = defs[i].set(*v);
+      if (!s.ok()) {
+        return Status::Error("config flags." + defs[i].yaml_key + ": " +
+                             s.message());
+      }
+    }
+  }
+
+  yamllite::NodePtr sharing = root.Get("sharing");
+  if (sharing) {
+    yamllite::NodePtr ts = sharing->Get("timeSlicing");
+    yamllite::NodePtr resources = ts ? ts->Get("resources") : nullptr;
+    if (resources && resources->kind == yamllite::Node::Kind::kList) {
+      for (const yamllite::NodePtr& item : resources->list_items) {
+        SharedResource r;
+        yamllite::NodePtr name = item->Get("name");
+        yamllite::NodePtr rename = item->Get("rename");
+        yamllite::NodePtr replicas = item->Get("replicas");
+        if (name) {
+          Result<std::string> v = name->AsString();
+          if (!v.ok()) return v.status();
+          r.name = *v;
+        } else {
+          r.name = kTpuResourceName;
+        }
+        if (rename) {
+          Result<std::string> v = rename->AsString();
+          if (!v.ok()) return v.status();
+          r.rename = *v;
+        }
+        if (replicas) {
+          Result<long long> v = replicas->AsInt();
+          if (!v.ok()) return v.status();
+          if (*v < 1) {
+            return Status::Error("sharing.timeSlicing replicas must be >= 1");
+          }
+          r.replicas = static_cast<int>(*v);
+        }
+        config->sharing.time_slicing.push_back(std::move(r));
+      }
+    }
+  }
+  return Status::Ok();
+}
+
+}  // namespace
+
+std::optional<SharedResource> Sharing::Match(
+    const std::string& resource) const {
+  for (const SharedResource& r : time_slicing) {
+    if (r.name == resource && r.replicas > 0) return r;
+  }
+  return std::nullopt;
+}
+
+Result<int> ParseDurationSeconds(const std::string& text) {
+  std::string s = TrimSpace(text);
+  if (s.empty()) return Result<int>::Error("empty duration");
+  // Bare integer = seconds.
+  bool all_digits = true;
+  for (char c : s) {
+    if (!isdigit(static_cast<unsigned char>(c))) all_digits = false;
+  }
+  if (all_digits) {
+    try {
+      return std::stoi(s);
+    } catch (...) {
+      return Result<int>::Error("invalid duration '" + text + "'");
+    }
+  }
+  long long total = 0;
+  size_t i = 0;
+  while (i < s.size()) {
+    size_t j = i;
+    while (j < s.size() && isdigit(static_cast<unsigned char>(s[j]))) j++;
+    if (j == i || j == s.size()) {
+      return Result<int>::Error("invalid duration '" + text + "'");
+    }
+    long long value;
+    try {
+      value = std::stoll(s.substr(i, j - i));
+    } catch (...) {
+      return Result<int>::Error("invalid duration '" + text + "'");
+    }
+    char unit = s[j];
+    switch (unit) {
+      case 'h':
+        total += value * 3600;
+        break;
+      case 'm':
+        // "ms" would be milliseconds; round sub-second components to 0.
+        if (j + 1 < s.size() && s[j + 1] == 's') {
+          total += value / 1000;
+          j++;
+        } else {
+          total += value * 60;
+        }
+        break;
+      case 's':
+        total += value;
+        break;
+      default:
+        return Result<int>::Error("invalid duration unit in '" + text + "'");
+    }
+    i = j + 1;
+  }
+  if (total > 86400 * 365) {
+    return Result<int>::Error("duration too large: '" + text + "'");
+  }
+  return static_cast<int>(total);
+}
+
+Result<LoadResult> Load(int argc, char** argv) {
+  LoadResult out;
+  Flags* f = &out.config.flags;
+  std::vector<FlagDef> defs = MakeFlagDefs(f);
+  std::vector<bool> set_by_cli_or_env(defs.size(), false);
+
+  // Pass 1: CLI. Accept --name=value, --name value, and bare --name for
+  // booleans. Also -o as an alias of --output-file (reference main.go:72).
+  std::vector<std::pair<size_t, std::string>> cli_sets;
+  for (int i = 1; i < argc; i++) {
+    std::string arg = argv[i];
+    if (arg == "-h" || arg == "--help" || arg == "help") {
+      out.help_requested = true;
+      return out;
+    }
+    if (arg == "--version" || arg == "-v") {
+      out.version_requested = true;
+      return out;
+    }
+    std::string name;
+    std::string value;
+    bool has_value = false;
+    if (HasPrefix(arg, "--")) {
+      name = arg.substr(2);
+    } else if (arg == "-o" || arg == "--output") {
+      name = "output-file";
+    } else {
+      return Result<LoadResult>::Error("unrecognized argument '" + arg + "'");
+    }
+    size_t eq = name.find('=');
+    if (eq != std::string::npos) {
+      value = name.substr(eq + 1);
+      name = name.substr(0, eq);
+      has_value = true;
+    }
+    if (name == "output") name = "output-file";
+    size_t idx = defs.size();
+    for (size_t d = 0; d < defs.size(); d++) {
+      if (defs[d].name == name) idx = d;
+    }
+    if (idx == defs.size()) {
+      return Result<LoadResult>::Error("unknown flag '--" + name + "'");
+    }
+    if (!has_value) {
+      if (defs[idx].is_bool) {
+        // Bare boolean flag means true; use --name=false to disable.
+        value = "true";
+      } else {
+        if (i + 1 >= argc) {
+          return Result<LoadResult>::Error("flag '--" + name +
+                                           "' needs a value");
+        }
+        value = argv[++i];
+      }
+    }
+    cli_sets.emplace_back(idx, value);
+  }
+  for (const auto& [idx, value] : cli_sets) {
+    Status s = defs[idx].set(value);
+    if (!s.ok()) {
+      return Result<LoadResult>::Error("flag '--" + defs[idx].name +
+                                       "': " + s.message());
+    }
+    set_by_cli_or_env[idx] = true;
+  }
+
+  // Pass 2: environment (only for flags not set on the CLI).
+  for (size_t d = 0; d < defs.size(); d++) {
+    if (set_by_cli_or_env[d]) continue;
+    for (const std::string& env : defs[d].envs) {
+      const char* v = std::getenv(env.c_str());
+      if (v == nullptr) continue;
+      Status s = defs[d].set(v);
+      if (!s.ok()) {
+        return Result<LoadResult>::Error("env " + env + ": " + s.message());
+      }
+      set_by_cli_or_env[d] = true;
+      break;
+    }
+  }
+
+  // Pass 3: config file fills whatever is still default.
+  if (!f->config_file.empty()) {
+    Result<std::string> text = ReadFile(f->config_file);
+    if (!text.ok()) {
+      return Result<LoadResult>::Error("unable to read config file: " +
+                                       text.error());
+    }
+    Result<yamllite::NodePtr> root = yamllite::Parse(*text);
+    if (!root.ok()) {
+      return Result<LoadResult>::Error("unable to parse config file: " +
+                                       root.error());
+    }
+    Status s = ApplyYaml(**root, defs, set_by_cli_or_env, &out.config);
+    if (!s.ok()) return Result<LoadResult>::Error(s.message());
+  }
+
+  // Validation.
+  const std::string& strat = f->slice_strategy;
+  if (strat != kSliceStrategyNone && strat != kSliceStrategySingle &&
+      strat != kSliceStrategyMixed) {
+    return Result<LoadResult>::Error("invalid slice-strategy '" + strat +
+                                     "' (want none|single|mixed)");
+  }
+  const std::string& backend = f->backend;
+  if (backend != "auto" && backend != "pjrt" && backend != "metadata" &&
+      backend != "mock" && backend != "null") {
+    return Result<LoadResult>::Error(
+        "invalid backend '" + backend +
+        "' (want auto|pjrt|metadata|mock|null)");
+  }
+  if (f->device_health != "off" && f->device_health != "basic") {
+    return Result<LoadResult>::Error("invalid device-health '" +
+                                     f->device_health + "' (want off|basic)");
+  }
+  if (f->sleep_interval_s < 1) {
+    return Result<LoadResult>::Error("sleep-interval must be >= 1s");
+  }
+  return out;
+}
+
+std::string ToJson(const Config& config) {
+  const Flags& f = config.flags;
+  std::ostringstream out;
+  auto jstr = [](const std::string& s) {
+    std::string r = "\"";
+    for (char c : s) {
+      if (c == '"' || c == '\\') r.push_back('\\');
+      r.push_back(c);
+    }
+    return r + "\"";
+  };
+  out << "{\"version\":" << jstr(config.version) << ",\"flags\":{"
+      << "\"sliceStrategy\":" << jstr(f.slice_strategy)
+      << ",\"failOnInitError\":" << (f.fail_on_init_error ? "true" : "false")
+      << ",\"oneshot\":" << (f.oneshot ? "true" : "false")
+      << ",\"noTimestamp\":" << (f.no_timestamp ? "true" : "false")
+      << ",\"sleepInterval\":\"" << f.sleep_interval_s << "s\""
+      << ",\"outputFile\":" << jstr(f.output_file)
+      << ",\"machineTypeFile\":" << jstr(f.machine_type_file)
+      << ",\"useNodeFeatureAPI\":"
+      << (f.use_node_feature_api ? "true" : "false")
+      << ",\"backend\":" << jstr(f.backend)
+      << ",\"deviceHealth\":" << jstr(f.device_health) << "},\"sharing\":[";
+  for (size_t i = 0; i < config.sharing.time_slicing.size(); i++) {
+    const SharedResource& r = config.sharing.time_slicing[i];
+    if (i) out << ",";
+    out << "{\"name\":" << jstr(r.name) << ",\"rename\":" << jstr(r.rename)
+        << ",\"replicas\":" << r.replicas << "}";
+  }
+  out << "]}";
+  return out.str();
+}
+
+std::string UsageText() {
+  std::ostringstream out;
+  out << "tpu-feature-discovery: generate node labels for Google TPU devices\n"
+      << "\nUsage: tpu-feature-discovery [flags]\n\nFlags:\n";
+  Flags tmp;
+  for (const FlagDef& d : MakeFlagDefs(&tmp)) {
+    out << "  --" << d.name;
+    if (!d.is_bool) out << " <value>";
+    out << "\n        " << d.usage;
+    if (!d.envs.empty()) {
+      out << " [env: " << JoinStrings(d.envs, ", ") << "]";
+    }
+    out << "\n";
+  }
+  out << "  --help\n        show this help\n"
+      << "  --version\n        print version and exit\n";
+  return out.str();
+}
+
+}  // namespace config
+}  // namespace tfd

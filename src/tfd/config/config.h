@@ -1,0 +1,96 @@
+// Versioned configuration with CLI > env > config-file precedence.
+//
+// Reference parity: the vendored spec config
+// (k8s-device-plugin/api/config/v1/config.go:33-57 — Config{Version, Flags,
+// Resources, Sharing}, precedence CLI > env > file) and the urfave/cli flag
+// table in cmd/gpu-feature-discovery/main.go:36-92. This build owns its
+// config types (SURVEY.md §7 step 1) instead of vendoring a device-plugin
+// spec, and swaps the GPU knobs for TPU ones: MIG strategy → slice strategy,
+// NVML paths → libtpu path + GCE metadata endpoint.
+#pragma once
+
+#include <optional>
+#include <string>
+#include <vector>
+
+#include "tfd/util/status.h"
+
+namespace tfd {
+namespace config {
+
+inline constexpr char kConfigVersion[] = "v1";
+
+// Slice strategies — the TPU analogue of MIG strategies
+// (reference internal/lm/mig-strategy.go:29-33).
+inline constexpr char kSliceStrategyNone[] = "none";
+inline constexpr char kSliceStrategySingle[] = "single";
+inline constexpr char kSliceStrategyMixed[] = "mixed";
+
+// Label namespace. The reference hardcodes "nvidia.com"; the TPU build
+// labels under "google.com" (BASELINE.json north star).
+inline constexpr char kDefaultResourcePrefix[] = "google.com";
+inline constexpr char kTpuResourceName[] = "google.com/tpu";
+
+// Sharing config — the analogue of Sharing.TimeSlicing
+// (k8s-device-plugin replicas.go:29-45): advertise each TPU chip as N
+// schedulable replicas, optionally under a renamed resource.
+struct SharedResource {
+  std::string name;     // e.g. "google.com/tpu"
+  std::string rename;   // optional renamed resource, e.g. "tpu-shared"
+  int replicas = 0;
+};
+
+struct Sharing {
+  std::vector<SharedResource> time_slicing;
+  // Returns (replicas, rename) for `resource`, or nullopt if not shared.
+  std::optional<SharedResource> Match(const std::string& resource) const;
+};
+
+struct Flags {
+  std::string slice_strategy = kSliceStrategyNone;
+  bool fail_on_init_error = true;
+  bool oneshot = false;
+  bool no_timestamp = false;
+  int sleep_interval_s = 60;
+  std::string output_file =
+      "/etc/kubernetes/node-feature-discovery/features.d/tfd";
+  std::string machine_type_file = "/sys/class/dmi/id/product_name";
+  bool use_node_feature_api = false;
+  std::string config_file;
+
+  // TPU-specific knobs (no reference analogue; replaces NVML/CUDA paths):
+  std::string backend = "auto";  // auto|pjrt|metadata|mock|null
+  std::string libtpu_path;       // override libtpu.so location
+  std::string metadata_endpoint; // override http://metadata.google.internal
+  std::string mock_topology_file; // mock backend fixture (tests)
+  std::string device_health = "off";  // off|basic — run on-chip health probe
+};
+
+struct Config {
+  std::string version = kConfigVersion;
+  Flags flags;
+  Sharing sharing;
+};
+
+// Loads config: parse argv; then env vars (TFD_* with legacy aliases); then
+// the optional YAML config file; CLI wins over env wins over file.
+// On "--help", prints usage and returns a config with `help_requested`.
+struct LoadResult {
+  Config config;
+  bool help_requested = false;
+  bool version_requested = false;
+};
+
+Result<LoadResult> Load(int argc, char** argv);
+
+// Parses a duration like "60s", "1m30s", "2h", or a bare integer (seconds).
+Result<int> ParseDurationSeconds(const std::string& text);
+
+// Serializes the effective config as a JSON echo line (reference
+// main.go:135-139 logs the running config as JSON at startup).
+std::string ToJson(const Config& config);
+
+std::string UsageText();
+
+}  // namespace config
+}  // namespace tfd

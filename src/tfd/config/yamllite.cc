@@ -1,0 +1,277 @@
+#include "tfd/config/yamllite.h"
+
+#include "tfd/util/strings.h"
+
+namespace tfd {
+namespace yamllite {
+
+namespace {
+
+struct Line {
+  int indent = 0;
+  std::string text;  // content after indentation
+  int number = 0;    // 1-based source line for errors
+};
+
+// Strips a trailing comment that is outside quotes.
+std::string StripComment(const std::string& s) {
+  bool in_single = false;
+  bool in_double = false;
+  for (size_t i = 0; i < s.size(); i++) {
+    char c = s[i];
+    if (c == '\'' && !in_double) in_single = !in_single;
+    if (c == '"' && !in_single) in_double = !in_double;
+    if (c == '#' && !in_single && !in_double &&
+        (i == 0 || s[i - 1] == ' ' || s[i - 1] == '\t')) {
+      return s.substr(0, i);
+    }
+  }
+  return s;
+}
+
+Result<std::vector<Line>> Lex(const std::string& text) {
+  std::vector<Line> lines;
+  int number = 0;
+  for (const std::string& raw : SplitString(text, '\n')) {
+    number++;
+    std::string no_comment = StripComment(raw);
+    std::string trimmed = TrimSpace(no_comment);
+    if (trimmed.empty()) continue;
+    if (trimmed == "---") continue;  // document marker
+    int indent = 0;
+    for (char c : no_comment) {
+      if (c == ' ') {
+        indent++;
+      } else if (c == '\t') {
+        return Result<std::vector<Line>>::Error(
+            "yaml: tabs are not allowed for indentation (line " +
+            std::to_string(number) + ")");
+      } else {
+        break;
+      }
+    }
+    lines.push_back(Line{indent, trimmed, number});
+  }
+  return lines;
+}
+
+NodePtr MakeScalar(std::string s, bool quoted) {
+  auto n = std::make_shared<Node>();
+  n->kind = Node::Kind::kScalar;
+  n->scalar = std::move(s);
+  n->quoted = quoted;
+  return n;
+}
+
+// Parses a scalar token, unquoting if needed.
+Result<NodePtr> ParseScalar(const std::string& tok, int line) {
+  std::string t = TrimSpace(tok);
+  if (t.size() >= 2 &&
+      ((t.front() == '"' && t.back() == '"') ||
+       (t.front() == '\'' && t.back() == '\''))) {
+    std::string inner = t.substr(1, t.size() - 2);
+    if (t.front() == '"') {
+      inner = ReplaceAll(inner, "\\\"", "\"");
+      inner = ReplaceAll(inner, "\\\\", "\\");
+    } else {
+      inner = ReplaceAll(inner, "''", "'");
+    }
+    return MakeScalar(inner, /*quoted=*/true);
+  }
+  if (t.find_first_of("{}[]") != std::string::npos) {
+    return Result<NodePtr>::Error(
+        "yaml: flow collections are not supported (line " +
+        std::to_string(line) + ")");
+  }
+  return MakeScalar(t, /*quoted=*/false);
+}
+
+// Splits "key: value" / "key:" at the first ':' followed by space or EOL.
+// Returns false if the line is not a mapping entry.
+bool SplitKey(const std::string& s, std::string* key, std::string* rest) {
+  bool in_single = false;
+  bool in_double = false;
+  for (size_t i = 0; i < s.size(); i++) {
+    char c = s[i];
+    if (c == '\'' && !in_double) in_single = !in_single;
+    if (c == '"' && !in_single) in_double = !in_double;
+    if (c == ':' && !in_single && !in_double &&
+        (i + 1 == s.size() || s[i + 1] == ' ')) {
+      *key = TrimSpace(s.substr(0, i));
+      *rest = (i + 1 < s.size()) ? TrimSpace(s.substr(i + 1)) : "";
+      return true;
+    }
+  }
+  return false;
+}
+
+class Parser {
+ public:
+  explicit Parser(std::vector<Line> lines) : lines_(std::move(lines)) {}
+
+  Result<NodePtr> ParseDocument() {
+    if (lines_.empty()) {
+      auto n = std::make_shared<Node>();
+      n->kind = Node::Kind::kMap;
+      return n;
+    }
+    Result<NodePtr> r = ParseBlock(lines_[0].indent);
+    if (!r.ok()) return r;
+    if (pos_ < lines_.size()) {
+      return Result<NodePtr>::Error("yaml: unexpected content at line " +
+                                    std::to_string(lines_[pos_].number));
+    }
+    return r;
+  }
+
+ private:
+  Result<NodePtr> ParseBlock(int indent) {
+    if (pos_ >= lines_.size()) {
+      auto n = std::make_shared<Node>();
+      n->kind = Node::Kind::kMap;
+      return n;
+    }
+    if (HasPrefix(lines_[pos_].text, "- ") || lines_[pos_].text == "-") {
+      return ParseList(indent);
+    }
+    return ParseMap(indent);
+  }
+
+  Result<NodePtr> ParseMap(int indent) {
+    auto node = std::make_shared<Node>();
+    node->kind = Node::Kind::kMap;
+    while (pos_ < lines_.size() && lines_[pos_].indent == indent &&
+           !HasPrefix(lines_[pos_].text, "- ") && lines_[pos_].text != "-") {
+      const Line& line = lines_[pos_];
+      std::string key, rest;
+      if (!SplitKey(line.text, &key, &rest)) {
+        return Result<NodePtr>::Error("yaml: expected 'key: value' at line " +
+                                      std::to_string(line.number));
+      }
+      pos_++;
+      NodePtr value;
+      if (!rest.empty()) {
+        Result<NodePtr> v = ParseScalar(rest, line.number);
+        if (!v.ok()) return v;
+        value = *v;
+      } else if (pos_ < lines_.size() && lines_[pos_].indent > indent) {
+        Result<NodePtr> v = ParseBlock(lines_[pos_].indent);
+        if (!v.ok()) return v;
+        value = *v;
+      } else if (pos_ < lines_.size() && lines_[pos_].indent == indent &&
+                 (HasPrefix(lines_[pos_].text, "- ") ||
+                  lines_[pos_].text == "-")) {
+        // k8s style: a sequence may sit at the same indent as its key.
+        Result<NodePtr> v = ParseList(indent);
+        if (!v.ok()) return v;
+        value = *v;
+      } else {
+        value = MakeScalar("", /*quoted=*/false);  // null
+      }
+      node->map_items.emplace_back(key, value);
+    }
+    if (pos_ < lines_.size() && lines_[pos_].indent > indent) {
+      return Result<NodePtr>::Error("yaml: bad indentation at line " +
+                                    std::to_string(lines_[pos_].number));
+    }
+    return node;
+  }
+
+  Result<NodePtr> ParseList(int indent) {
+    auto node = std::make_shared<Node>();
+    node->kind = Node::Kind::kList;
+    while (pos_ < lines_.size() && lines_[pos_].indent == indent &&
+           (HasPrefix(lines_[pos_].text, "- ") || lines_[pos_].text == "-")) {
+      Line line = lines_[pos_];
+      std::string item =
+          line.text == "-" ? "" : TrimSpace(line.text.substr(2));
+      std::string key, rest;
+      if (!item.empty() && SplitKey(item, &key, &rest)) {
+        // "- key: value": the item is a map whose first entry is on this
+        // line; following lines indented past the dash belong to it.
+        int item_indent = indent + 2;
+        lines_[pos_] = Line{item_indent, item, line.number};
+        Result<NodePtr> v = ParseMap(item_indent);
+        if (!v.ok()) return v;
+        node->list_items.push_back(*v);
+      } else if (!item.empty()) {
+        pos_++;
+        Result<NodePtr> v = ParseScalar(item, line.number);
+        if (!v.ok()) return v;
+        node->list_items.push_back(*v);
+      } else {
+        pos_++;
+        if (pos_ < lines_.size() && lines_[pos_].indent > indent) {
+          Result<NodePtr> v = ParseBlock(lines_[pos_].indent);
+          if (!v.ok()) return v;
+          node->list_items.push_back(*v);
+        } else {
+          node->list_items.push_back(MakeScalar("", false));
+        }
+      }
+    }
+    return node;
+  }
+
+  std::vector<Line> lines_;
+  size_t pos_ = 0;
+};
+
+}  // namespace
+
+NodePtr Node::Get(const std::string& key) const {
+  if (kind != Kind::kMap) return nullptr;
+  for (const auto& [k, v] : map_items) {
+    if (k == key) return v;
+  }
+  return nullptr;
+}
+
+Result<std::string> Node::AsString() const {
+  if (kind != Kind::kScalar) {
+    return Result<std::string>::Error("yaml: node is not a scalar");
+  }
+  return scalar;
+}
+
+Result<long long> Node::AsInt() const {
+  if (kind != Kind::kScalar || quoted) {
+    return Result<long long>::Error("yaml: node is not an integer");
+  }
+  try {
+    size_t used = 0;
+    long long v = std::stoll(scalar, &used);
+    if (used != scalar.size()) {
+      return Result<long long>::Error("yaml: invalid integer '" + scalar +
+                                      "'");
+    }
+    return v;
+  } catch (...) {
+    return Result<long long>::Error("yaml: invalid integer '" + scalar + "'");
+  }
+}
+
+Result<bool> Node::AsBool() const {
+  if (kind != Kind::kScalar || quoted) {
+    return Result<bool>::Error("yaml: node is not a boolean");
+  }
+  std::string v = ToLower(scalar);
+  if (v == "true" || v == "yes" || v == "on") return true;
+  if (v == "false" || v == "no" || v == "off") return false;
+  return Result<bool>::Error("yaml: invalid boolean '" + scalar + "'");
+}
+
+bool Node::IsNull() const {
+  return kind == Kind::kScalar && !quoted &&
+         (scalar.empty() || scalar == "null" || scalar == "~");
+}
+
+Result<NodePtr> Parse(const std::string& text) {
+  Result<std::vector<Line>> lines = Lex(text);
+  if (!lines.ok()) return Result<NodePtr>::Error(lines.error());
+  Parser p(std::move(*lines));
+  return p.ParseDocument();
+}
+
+}  // namespace yamllite
+}  // namespace tfd

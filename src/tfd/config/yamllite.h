@@ -1,0 +1,53 @@
+// yamllite: a minimal YAML-subset parser for tpu-feature-discovery config
+// files.
+//
+// The reference parses its config with sigs.k8s.io/yaml (vendored,
+// k8s-device-plugin/api/config/v1/config.go:60-99). This build owns its
+// config format instead of vendoring a foreign plugin's spec, and only needs
+// the YAML subset that k8s-style configs actually use:
+//   - nested mappings by 2-space indentation
+//   - block sequences of scalars or mappings ("- item" / "- key: value")
+//   - scalars: strings (plain or quoted), integers, booleans, null
+//   - '#' comments and blank lines
+// Anchors, aliases, multi-line scalars, and flow collections are not
+// supported and produce a parse error.
+#pragma once
+
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "tfd/util/status.h"
+
+namespace tfd {
+namespace yamllite {
+
+struct Node;
+using NodePtr = std::shared_ptr<Node>;
+
+struct Node {
+  enum class Kind { kScalar, kMap, kList };
+  Kind kind = Kind::kScalar;
+
+  std::string scalar;                       // kScalar (unquoted form)
+  bool quoted = false;                      // scalar was quoted in the source
+  std::vector<std::pair<std::string, NodePtr>> map_items;  // kMap, in order
+  std::vector<NodePtr> list_items;          // kList
+
+  // Map lookup; nullptr if missing or not a map.
+  NodePtr Get(const std::string& key) const;
+
+  // Scalar conversions. Conversion errors are reported via Result.
+  Result<std::string> AsString() const;
+  Result<long long> AsInt() const;
+  Result<bool> AsBool() const;
+  bool IsNull() const;
+};
+
+// Parses a yamllite document. An empty/comment-only document parses to an
+// empty map.
+Result<NodePtr> Parse(const std::string& text);
+
+}  // namespace yamllite
+}  // namespace tfd

@@ -1,0 +1,220 @@
+#include "tfd/gce/metadata.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <poll.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdlib>
+
+#include "tfd/util/strings.h"
+
+namespace tfd {
+namespace gce {
+
+namespace {
+
+constexpr char kDefaultEndpoint[] = "metadata.google.internal";
+
+struct FdCloser {
+  int fd;
+  ~FdCloser() {
+    if (fd >= 0) close(fd);
+  }
+};
+
+// One blocking HTTP/1.1 GET. The timeout applies per socket operation
+// (connect/send/recv), not to the whole request. Returns the raw response.
+Result<std::string> HttpGet(const std::string& host, int port,
+                            const std::string& path, int timeout_ms) {
+  addrinfo hints{};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  std::string port_str = std::to_string(port);
+  int rc = getaddrinfo(host.c_str(), port_str.c_str(), &hints, &res);
+  if (rc != 0) {
+    return Result<std::string>::Error("resolve " + host + ": " +
+                                      gai_strerror(rc));
+  }
+  int fd = -1;
+  for (addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+    fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    timeval tv{};
+    tv.tv_sec = timeout_ms / 1000;
+    tv.tv_usec = (timeout_ms % 1000) * 1000;
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  if (fd < 0) {
+    return Result<std::string>::Error("connect to " + host + ":" + port_str +
+                                      " failed: " + strerror(errno));
+  }
+  FdCloser closer{fd};
+
+  std::string request = "GET " + path +
+                        " HTTP/1.1\r\nHost: " + host +
+                        "\r\nMetadata-Flavor: Google\r\n"
+                        "Connection: close\r\n\r\n";
+  size_t off = 0;
+  while (off < request.size()) {
+    ssize_t n = send(fd, request.data() + off, request.size() - off, 0);
+    if (n <= 0) {
+      return Result<std::string>::Error("send failed: " +
+                                        std::string(strerror(errno)));
+    }
+    off += static_cast<size_t>(n);
+  }
+
+  std::string response;
+  char buf[4096];
+  while (true) {
+    ssize_t n = recv(fd, buf, sizeof(buf), 0);
+    if (n < 0) {
+      return Result<std::string>::Error("recv failed: " +
+                                        std::string(strerror(errno)));
+    }
+    if (n == 0) break;
+    response.append(buf, static_cast<size_t>(n));
+    if (response.size() > 4 * 1024 * 1024) {
+      return Result<std::string>::Error("metadata response too large");
+    }
+  }
+  return response;
+}
+
+// Minimal HTTP response parse: status line + headers + body. Handles
+// chunked transfer-encoding (the GCE server uses Content-Length, but a fake
+// test server may not).
+Result<std::string> ParseHttpResponse(const std::string& raw, int* status) {
+  size_t header_end = raw.find("\r\n\r\n");
+  if (header_end == std::string::npos) {
+    return Result<std::string>::Error("malformed HTTP response");
+  }
+  std::string headers = raw.substr(0, header_end);
+  std::string body = raw.substr(header_end + 4);
+  size_t sp = headers.find(' ');
+  if (sp == std::string::npos) {
+    return Result<std::string>::Error("malformed HTTP status line");
+  }
+  *status = atoi(headers.c_str() + sp + 1);
+  if (ToLower(headers).find("transfer-encoding: chunked") !=
+      std::string::npos) {
+    std::string decoded;
+    size_t pos = 0;
+    while (pos < body.size()) {
+      size_t eol = body.find("\r\n", pos);
+      if (eol == std::string::npos) break;
+      long chunk = strtol(body.substr(pos, eol - pos).c_str(), nullptr, 16);
+      if (chunk <= 0) break;
+      decoded += body.substr(eol + 2, static_cast<size_t>(chunk));
+      pos = eol + 2 + static_cast<size_t>(chunk) + 2;
+    }
+    body = decoded;
+  }
+  return body;
+}
+
+}  // namespace
+
+MetadataClient::MetadataClient(std::string endpoint, int timeout_ms)
+    : endpoint_(std::move(endpoint)), timeout_ms_(timeout_ms) {
+  if (endpoint_.empty()) {
+    if (const char* env = std::getenv("GCE_METADATA_HOST")) endpoint_ = env;
+  }
+  if (endpoint_.empty()) endpoint_ = kDefaultEndpoint;
+}
+
+Result<std::string> MetadataClient::Get(const std::string& path) const {
+  std::string host = endpoint_;
+  int port = 80;
+  size_t colon = host.rfind(':');
+  if (colon != std::string::npos && host.find(']') == std::string::npos) {
+    port = atoi(host.c_str() + colon + 1);
+    host = host.substr(0, colon);
+  }
+  Result<std::string> raw =
+      HttpGet(host, port, "/computeMetadata/v1/" + path, timeout_ms_);
+  if (!raw.ok()) return raw;
+  int status = 0;
+  Result<std::string> body = ParseHttpResponse(*raw, &status);
+  if (!body.ok()) return body;
+  if (status == 404) {
+    return Result<std::string>::Error("metadata key not found: " + path);
+  }
+  if (status != 200) {
+    return Result<std::string>::Error("metadata GET " + path + ": HTTP " +
+                                      std::to_string(status));
+  }
+  return body;
+}
+
+bool MetadataClient::Available() const {
+  // instance/id exists on every GCE VM.
+  return Get("instance/id").ok();
+}
+
+Result<std::string> MetadataClient::MachineType() const {
+  Result<std::string> full = Get("instance/machine-type");
+  if (!full.ok()) return full;
+  std::vector<std::string> parts = SplitString(TrimSpace(*full), '/');
+  return parts.back();
+}
+
+Result<std::string> MetadataClient::AcceleratorType() const {
+  Result<std::string> t = Get("instance/attributes/accelerator-type");
+  if (t.ok()) return TrimSpace(*t);
+  // Fall back to the tpu-env bag.
+  Result<std::map<std::string, std::string>> env = TpuEnv();
+  if (env.ok()) {
+    auto it = env->find("ACCELERATOR_TYPE");
+    if (it != env->end()) return it->second;
+  }
+  return t;
+}
+
+Result<std::map<std::string, std::string>> MetadataClient::TpuEnv() const {
+  Result<std::string> raw = Get("instance/attributes/tpu-env");
+  if (!raw.ok()) {
+    return Result<std::map<std::string, std::string>>::Error(raw.error());
+  }
+  return ParseTpuEnv(*raw);
+}
+
+Result<std::string> MetadataClient::InstanceId() const {
+  Result<std::string> id = Get("instance/id");
+  if (!id.ok()) return id;
+  return TrimSpace(*id);
+}
+
+Result<bool> MetadataClient::Preemptible() const {
+  Result<std::string> v = Get("instance/scheduling/preemptible");
+  if (!v.ok()) return Result<bool>::Error(v.error());
+  return ToLower(TrimSpace(*v)) == "true";
+}
+
+std::map<std::string, std::string> ParseTpuEnv(const std::string& text) {
+  // Format: one "KEY: 'value'" per line (value quoting optional).
+  std::map<std::string, std::string> out;
+  for (const std::string& line : SplitString(text, '\n')) {
+    size_t colon = line.find(':');
+    if (colon == std::string::npos) continue;
+    std::string key = TrimSpace(line.substr(0, colon));
+    std::string value = TrimSpace(line.substr(colon + 1));
+    if (value.size() >= 2 && value.front() == '\'' && value.back() == '\'') {
+      value = value.substr(1, value.size() - 2);
+    }
+    if (!key.empty()) out[key] = value;
+  }
+  return out;
+}
+
+}  // namespace gce
+}  // namespace tfd

@@ -1,0 +1,60 @@
+// GCE instance-metadata client.
+//
+// The structural analogue of the reference's sysfs/PCI-config probing
+// (internal/vgpu/pciutil.go) and DMI reads: on TPU VMs the interesting
+// hardware identity (accelerator-type, topology, worker id, multi-slice
+// membership) lives in the metadata server, not in PCI config space.
+//
+// Plain HTTP/1.1 over a blocking socket — metadata.google.internal
+// (169.254.254.169.254...) is link-local; no TLS involved, so no external
+// HTTP library is needed. The endpoint is overridable (--metadata-endpoint /
+// GCE_METADATA_HOST) so tests can run a fake server — the hermetic-harness
+// improvement SURVEY.md §4 calls for.
+#pragma once
+
+#include <map>
+#include <string>
+
+#include "tfd/util/status.h"
+
+namespace tfd {
+namespace gce {
+
+class MetadataClient {
+ public:
+  // `endpoint`: "host[:port]". Empty → $GCE_METADATA_HOST or
+  // metadata.google.internal. Timeouts are per-request, in milliseconds.
+  explicit MetadataClient(std::string endpoint = "", int timeout_ms = 1500);
+
+  // GET /computeMetadata/v1/<path> with Metadata-Flavor: Google.
+  // `path` example: "instance/attributes/accelerator-type".
+  Result<std::string> Get(const std::string& path) const;
+
+  // True if the metadata server answers at all (cheap liveness probe).
+  bool Available() const;
+
+  // Convenience wrappers over well-known keys (empty string if absent):
+  Result<std::string> MachineType() const;    // leaf of instance/machine-type
+  // TPU accelerator type, e.g. "v5litepod-16". Checks
+  // instance/attributes/accelerator-type (TPU VMs).
+  Result<std::string> AcceleratorType() const;
+  // The "tpu-env" attribute: a newline-separated KEY: 'value' bag with
+  // ACCELERATOR_TYPE, TOPOLOGY, WORKER_ID, HOST_BOUNDS, ... present on TPU
+  // VMs. Parsed into a map.
+  Result<std::map<std::string, std::string>> TpuEnv() const;
+  Result<std::string> InstanceId() const;
+  Result<bool> Preemptible() const;
+
+  const std::string& endpoint() const { return endpoint_; }
+
+ private:
+  std::string endpoint_;
+  int timeout_ms_;
+};
+
+// Parses the tpu-env attribute format: lines of KEY: 'value' (value quoting
+// optional). Exposed for unit tests.
+std::map<std::string, std::string> ParseTpuEnv(const std::string& text);
+
+}  // namespace gce
+}  // namespace tfd

@@ -1,0 +1,25 @@
+// Build version info (reference internal/info/version.go:22-43, injected
+// via -X ldflags; here via -D compile definitions from CMake).
+#pragma once
+
+#include <string>
+
+namespace tfd {
+namespace info {
+
+#ifndef TFD_VERSION
+#define TFD_VERSION "v0.1.0-dev"
+#endif
+#ifndef TFD_GIT_COMMIT
+#define TFD_GIT_COMMIT "unknown"
+#endif
+
+inline std::string Version() { return TFD_VERSION; }
+inline std::string GitCommit() { return TFD_GIT_COMMIT; }
+
+inline std::string VersionString() {
+  return Version() + " (commit " + GitCommit() + ")";
+}
+
+}  // namespace info
+}  // namespace tfd

@@ -1,0 +1,76 @@
+// Labeler core: the composable pipeline every feature source plugs into.
+//
+// Reference parity: internal/lm/labeler.go:28-30 (Labeler interface),
+// internal/lm/labels.go:41-47 (Labels map that is itself a Labeler),
+// internal/lm/list.go:25-46 (Merge combinator, later labelers win),
+// internal/lm/empty.go:20 (null object).
+//
+// TPU-first difference: `Labels` is a std::map (sorted by key), which makes
+// every sink deterministic byte-for-byte — a north-star requirement
+// (BASELINE.md) that the reference's Go map iteration order cannot give.
+#pragma once
+
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "tfd/util/status.h"
+
+namespace tfd {
+namespace lm {
+
+// Sorted key → value label set. Sorted order IS the output order.
+using Labels = std::map<std::string, std::string>;
+
+class Labeler {
+ public:
+  virtual ~Labeler() = default;
+  virtual Result<Labels> GetLabels() = 0;
+};
+
+using LabelerPtr = std::unique_ptr<Labeler>;
+
+// A fixed label set as a Labeler (reference: Labels.Labels()).
+class StaticLabeler : public Labeler {
+ public:
+  explicit StaticLabeler(Labels labels) : labels_(std::move(labels)) {}
+  Result<Labels> GetLabels() override { return labels_; }
+
+ private:
+  Labels labels_;
+};
+
+// Labeler that always returns no labels (reference: empty.go).
+class EmptyLabeler : public Labeler {
+ public:
+  Result<Labels> GetLabels() override { return Labels{}; }
+};
+
+inline LabelerPtr Empty() { return std::make_unique<EmptyLabeler>(); }
+
+// Merge: runs each labeler in order and merges the maps; on key conflict the
+// later labeler wins (reference list.go:33-46). Any child error aborts.
+class MergedLabeler : public Labeler {
+ public:
+  explicit MergedLabeler(std::vector<LabelerPtr> children)
+      : children_(std::move(children)) {}
+
+  Result<Labels> GetLabels() override {
+    Labels merged;
+    for (auto& child : children_) {
+      Result<Labels> r = child->GetLabels();
+      if (!r.ok()) return r;
+      for (auto& [k, v] : *r) merged[k] = v;  // later wins
+    }
+    return merged;
+  }
+
+ private:
+  std::vector<LabelerPtr> children_;
+};
+
+LabelerPtr Merge(std::vector<LabelerPtr> children);
+
+}  // namespace lm
+}  // namespace tfd

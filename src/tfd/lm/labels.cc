@@ -1,0 +1,30 @@
+#include "tfd/lm/labels.h"
+
+#include <iostream>
+#include <sstream>
+
+#include "tfd/util/file.h"
+
+namespace tfd {
+namespace lm {
+
+std::string FormatLabels(const Labels& labels) {
+  std::ostringstream out;
+  for (const auto& [k, v] : labels) {
+    out << k << "=" << v << "\n";
+  }
+  return out.str();
+}
+
+Status OutputToFile(const Labels& labels, const std::string& path) {
+  std::string body = FormatLabels(labels);
+  if (path.empty()) {
+    std::cout << body;
+    std::cout.flush();
+    return Status::Ok();
+  }
+  return WriteFileAtomically(path, body);
+}
+
+}  // namespace lm
+}  // namespace tfd

@@ -1,0 +1,25 @@
+// Label output sinks.
+//
+// Reference parity: internal/lm/labels.go:49-138 — Output() dispatches to
+// (a) stdout when no path is configured, (b) atomic file write for the NFD
+// `local` source, or (c) a NodeFeature custom resource when the NodeFeature
+// API is enabled (labels.go:141-184, implemented in tfd/k8s).
+#pragma once
+
+#include <string>
+
+#include "tfd/lm/labeler.h"
+#include "tfd/util/status.h"
+
+namespace tfd {
+namespace lm {
+
+// Serializes labels as sorted "key=value\n" lines.
+std::string FormatLabels(const Labels& labels);
+
+// Writes labels to `path` atomically, or to stdout if `path` is empty
+// (reference labels.go:62-65).
+Status OutputToFile(const Labels& labels, const std::string& path);
+
+}  // namespace lm
+}  // namespace tfd

@@ -1,0 +1,59 @@
+#include "tfd/lm/machine_type.h"
+
+#include "tfd/lm/schema.h"
+#include "tfd/util/file.h"
+#include "tfd/util/logging.h"
+#include "tfd/util/strings.h"
+
+namespace tfd {
+namespace lm {
+
+namespace {
+
+class MachineTypeLabeler : public Labeler {
+ public:
+  MachineTypeLabeler(std::string file, MachineTypeGetter getter)
+      : file_(std::move(file)), getter_(std::move(getter)) {}
+
+  Result<Labels> GetLabels() override {
+    std::string machine_type = "unknown";
+    bool found = false;
+    if (getter_) {
+      Result<std::string> m = getter_();
+      if (m.ok() && !TrimSpace(*m).empty()) {
+        machine_type = TrimSpace(*m);
+        found = true;
+      }
+    }
+    if (!found && !file_.empty()) {
+      Result<std::string> contents = ReadFile(file_);
+      if (contents.ok() && !TrimSpace(*contents).empty()) {
+        machine_type = TrimSpace(*contents);
+        found = true;
+      }
+    }
+    if (!found) {
+      TFD_LOG_WARNING << "could not determine machine type (metadata "
+                         "unavailable, file '"
+                      << file_ << "' unreadable); defaulting to 'unknown'";
+    }
+    Labels labels;
+    labels[kMachineLabel] = SanitizeLabelValue(machine_type);
+    return labels;
+  }
+
+ private:
+  std::string file_;
+  MachineTypeGetter getter_;
+};
+
+}  // namespace
+
+LabelerPtr NewMachineTypeLabeler(const std::string& machine_type_file,
+                                 MachineTypeGetter metadata_getter) {
+  return std::make_unique<MachineTypeLabeler>(machine_type_file,
+                                              std::move(metadata_getter));
+}
+
+}  // namespace lm
+}  // namespace tfd

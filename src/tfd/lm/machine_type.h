@@ -1,0 +1,27 @@
+// Machine-type labeler.
+//
+// Reference parity: internal/lm/machine-type.go:31-52 — read the DMI product
+// name, spaces→dashes, degrade to "unknown" with a warning on error.
+//
+// TPU-first difference: on GCE/TPU-VMs the DMI product name is just "Google
+// Compute Engine"; the useful machine type (e.g. "ct5lp-hightpu-4t") comes
+// from the metadata server. The labeler therefore takes an optional
+// metadata getter which wins over the DMI file when it succeeds.
+#pragma once
+
+#include <functional>
+#include <string>
+
+#include "tfd/lm/labeler.h"
+
+namespace tfd {
+namespace lm {
+
+using MachineTypeGetter = std::function<Result<std::string>()>;
+
+// `metadata_getter` may be null (no metadata server / tests).
+LabelerPtr NewMachineTypeLabeler(const std::string& machine_type_file,
+                                 MachineTypeGetter metadata_getter);
+
+}  // namespace lm
+}  // namespace tfd

@@ -1,0 +1,11 @@
+#include "tfd/lm/labeler.h"
+
+namespace tfd {
+namespace lm {
+
+LabelerPtr Merge(std::vector<LabelerPtr> children) {
+  return std::make_unique<MergedLabeler>(std::move(children));
+}
+
+}  // namespace lm
+}  // namespace tfd

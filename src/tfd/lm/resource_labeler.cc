@@ -1,0 +1,118 @@
+#include "tfd/lm/resource_labeler.h"
+
+#include "tfd/util/strings.h"
+
+namespace tfd {
+namespace lm {
+
+namespace {
+
+// Collects homogeneous device attributes; TPU hosts are homogeneous by
+// construction, but we validate instead of assuming (the reference warns on
+// >1 model per node, mig-strategy.go:125-152).
+struct DeviceSummary {
+  std::string product;
+  std::string family;
+  int generation = 0;
+  int cores = 0;
+  long long memory_mib = 0;
+  int count = 0;
+};
+
+Result<DeviceSummary> Summarize(
+    const std::vector<resource::DevicePtr>& devices) {
+  DeviceSummary s;
+  for (const resource::DevicePtr& d : devices) {
+    Result<std::string> product = d->GetProduct();
+    if (!product.ok()) return Result<DeviceSummary>::Error(product.error());
+    Result<long long> memory = d->GetTotalMemoryMiB();
+    if (!memory.ok()) return Result<DeviceSummary>::Error(memory.error());
+    Result<int> cores = d->GetCoreCount();
+    if (!cores.ok()) return Result<DeviceSummary>::Error(cores.error());
+    Result<int> generation = d->GetGeneration();
+    if (!generation.ok()) {
+      return Result<DeviceSummary>::Error(generation.error());
+    }
+    if (s.count == 0) {
+      s.product = *product;
+      s.memory_mib = *memory;
+      s.cores = *cores;
+      s.generation = *generation;
+    } else if (s.product != *product) {
+      return Result<DeviceSummary>::Error(
+          "heterogeneous TPU products on one host: '" + s.product +
+          "' and '" + *product + "'");
+    }
+    s.count++;
+  }
+  // family = product minus the "tpu-" prefix (tpu-v5e → v5e).
+  s.family = HasPrefix(s.product, "tpu-") ? s.product.substr(4) : s.product;
+  return s;
+}
+
+Labels BuildLabels(const std::string& resource_name,
+                   const DeviceSummary& s,
+                   const config::Sharing& sharing,
+                   const std::string& product_suffix) {
+  // Sharing semantics mirror resource.go:182-226: replicas multiplies the
+  // advertised count; the product gets "-SHARED" unless the resource is
+  // renamed (a renamed resource is already distinguishable).
+  int replicas = s.count;
+  std::string product = s.product;
+  if (!product_suffix.empty()) product += "-SLICE-" + product_suffix;
+  std::optional<config::SharedResource> shared =
+      sharing.Match(resource_name);
+  if (shared.has_value()) {
+    replicas = s.count * shared->replicas;
+    if (shared->rename.empty()) {
+      product += "-SHARED";
+    }
+  }
+
+  Labels labels;
+  const std::string p = resource_name + ".";
+  labels[p + "product"] = SanitizeLabelValue(product);
+  labels[p + "count"] = std::to_string(s.count);
+  labels[p + "replicas"] = std::to_string(replicas);
+  labels[p + "memory"] = std::to_string(s.memory_mib);
+  labels[p + "family"] = s.family;
+  labels[p + "generation"] = std::to_string(s.generation);
+  labels[p + "cores"] = std::to_string(s.cores);
+  return labels;
+}
+
+Result<LabelerPtr> Build(const std::string& resource_name,
+                         const std::string& shape,
+                         const std::vector<resource::DevicePtr>& devices,
+                         const config::Sharing& sharing) {
+  if (devices.empty()) return LabelerPtr(Empty());
+  Result<DeviceSummary> summary = Summarize(devices);
+  if (!summary.ok()) return Result<LabelerPtr>::Error(summary.error());
+  return LabelerPtr(std::make_unique<StaticLabeler>(
+      BuildLabels(resource_name, *summary, sharing, shape)));
+}
+
+}  // namespace
+
+Result<LabelerPtr> NewTpuResourceLabeler(
+    const std::string& resource_name,
+    const std::vector<resource::DevicePtr>& devices,
+    const config::Sharing& sharing) {
+  return Build(resource_name, "", devices, sharing);
+}
+
+Result<LabelerPtr> NewTpuResourceLabelerWithoutSharing(
+    const std::string& resource_name,
+    const std::vector<resource::DevicePtr>& devices) {
+  return Build(resource_name, "", devices, config::Sharing{});
+}
+
+Result<LabelerPtr> NewShapeResourceLabeler(
+    const std::string& resource_name, const std::string& shape,
+    const std::vector<resource::DevicePtr>& devices,
+    const config::Sharing& sharing) {
+  return Build(resource_name, shape, devices, sharing);
+}
+
+}  // namespace lm
+}  // namespace tfd

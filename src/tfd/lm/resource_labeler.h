@@ -1,0 +1,49 @@
+// Per-resource label generation.
+//
+// Reference parity: internal/lm/resource.go — resourceLabeler produces
+// <resource>.product/count/replicas/memory/... labels, applying time-slicing
+// sharing (replicas multiplier + "-SHARED" product suffix unless renamed,
+// resource.go:182-226). The TPU version generates, for a resource name like
+// "google.com/tpu" or "google.com/tpu-4x4":
+//   <resource>.product   e.g. tpu-v5e  (with -SHARED suffix when shared)
+//   <resource>.count     chips attached to this host
+//   <resource>.replicas  schedulable replicas (count × sharing replicas)
+//   <resource>.memory    per-chip HBM MiB
+//   <resource>.family    v2|v3|v4|v5e|v5p|v6e
+//   <resource>.generation 2..6       (compute-capability analogue)
+//   <resource>.cores     TensorCores per chip
+#pragma once
+
+#include <string>
+#include <vector>
+
+#include "tfd/config/config.h"
+#include "tfd/lm/labeler.h"
+#include "tfd/resource/types.h"
+
+namespace tfd {
+namespace lm {
+
+// Labels for the primary TPU resource with sharing applied
+// (reference NewGPUResourceLabeler, resource.go:36-73).
+Result<LabelerPtr> NewTpuResourceLabeler(
+    const std::string& resource_name,
+    const std::vector<resource::DevicePtr>& devices,
+    const config::Sharing& sharing);
+
+// Same, with sharing disabled (reference
+// NewGPUResourceLabelerWithoutSharing, resource.go:30-33).
+Result<LabelerPtr> NewTpuResourceLabelerWithoutSharing(
+    const std::string& resource_name,
+    const std::vector<resource::DevicePtr>& devices);
+
+// Product override used by shape-qualified resources in the mixed strategy
+// (reference NewMIGResourceLabeler builds "MODEL-MIG-<profile>" products,
+// resource.go:76-111): product becomes "<product>-SLICE-<shape>".
+Result<LabelerPtr> NewShapeResourceLabeler(
+    const std::string& resource_name, const std::string& shape,
+    const std::vector<resource::DevicePtr>& devices,
+    const config::Sharing& sharing);
+
+}  // namespace lm
+}  // namespace tfd

@@ -1,0 +1,59 @@
+// The google.com/tpu.* label schema.
+//
+// Reference schema (tests/expected-output*.txt): nvidia.com/gfd.timestamp,
+// cuda.driver.*, cuda.runtime.*, gpu.machine/count/replicas/product/memory/
+// family/compute.*, mig.capable, mig.strategy, mig-<profile>.*.
+//
+// TPU mapping (BASELINE.json north star):
+//   gfd.timestamp        → google.com/tfd.timestamp
+//   cuda.driver.*        → google.com/libtpu.version.{major,minor,patch}
+//   cuda.runtime.*       → google.com/tpu.runtime.{major,minor}  (PJRT C API)
+//   gpu.machine          → google.com/tpu.machine (GCE machine type, DMI fallback)
+//   gpu.count/replicas/product/memory → google.com/tpu.{count,replicas,product,memory}
+//   gpu.family           → google.com/tpu.family        (v2..v6e)
+//   gpu.compute.major/minor → google.com/tpu.generation (2..6)
+//   mig.capable          → google.com/tpu.slice.capable
+//   mig.strategy         → google.com/tpu.slice.strategy
+//   mig-<profile>.*      → google.com/tpu-<shape>.*     (mixed strategy)
+// TPU-only additions: tpu.cores, tpu.backend, tpu.topology, tpu.ici.wrap,
+// tpu.slice.{shape,hosts,chips-per-host,worker-id}, tpu.accelerator-type,
+// tpu-vm.*, tpu.multislice.*.
+#pragma once
+
+namespace tfd {
+namespace lm {
+
+inline constexpr char kPrefix[] = "google.com/";
+
+// Core.
+inline constexpr char kTimestampLabel[] = "google.com/tfd.timestamp";
+inline constexpr char kMachineLabel[] = "google.com/tpu.machine";
+inline constexpr char kBackendLabel[] = "google.com/tpu.backend";
+
+// Versions.
+inline constexpr char kLibtpuMajor[] = "google.com/libtpu.version.major";
+inline constexpr char kLibtpuMinor[] = "google.com/libtpu.version.minor";
+inline constexpr char kLibtpuPatch[] = "google.com/libtpu.version.patch";
+inline constexpr char kRuntimeMajor[] = "google.com/tpu.runtime.major";
+inline constexpr char kRuntimeMinor[] = "google.com/tpu.runtime.minor";
+
+// Slice strategy.
+inline constexpr char kSliceCapable[] = "google.com/tpu.slice.capable";
+inline constexpr char kSliceStrategy[] = "google.com/tpu.slice.strategy";
+
+// Topology (emitted when known).
+inline constexpr char kAcceleratorType[] = "google.com/tpu.accelerator-type";
+inline constexpr char kTopologyLabel[] = "google.com/tpu.topology";
+inline constexpr char kIciWrap[] = "google.com/tpu.ici.wrap";
+inline constexpr char kSliceShape[] = "google.com/tpu.slice.shape";
+inline constexpr char kSliceHosts[] = "google.com/tpu.slice.hosts";
+inline constexpr char kSliceChipsPerHost[] =
+    "google.com/tpu.slice.chips-per-host";
+inline constexpr char kSliceWorkerId[] = "google.com/tpu.slice.worker-id";
+
+// The value used when a slice strategy's validation fails — the analogue of
+// the reference's "MIG-INVALID" product (mig-strategy.go:243-262).
+inline constexpr char kSliceInvalid[] = "SLICE-INVALID";
+
+}  // namespace lm
+}  // namespace tfd

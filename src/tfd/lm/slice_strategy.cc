@@ -1,0 +1,166 @@
+#include "tfd/lm/slice_strategy.h"
+
+#include "tfd/lm/resource_labeler.h"
+#include "tfd/lm/schema.h"
+#include "tfd/slice/shape.h"
+#include "tfd/slice/topology.h"
+#include "tfd/util/logging.h"
+#include "tfd/util/strings.h"
+
+namespace tfd {
+namespace lm {
+
+namespace {
+
+// Resolves the slice topology into a validated shape. The TPU analogue of
+// the reference's `single` validation chain (mig-strategy.go:181-241):
+//   - topology (or accelerator type) must be known,
+//   - the shape must parse under the slice-shape grammar,
+//   - shape chips must equal chips-per-host × hosts when both are known.
+// Any failure returns an error → the caller degrades to SLICE-INVALID.
+Result<slice::Shape> ResolveValidatedShape(
+    const resource::TopologyInfo& topo, int local_chips) {
+  std::string topology = topo.topology;
+  std::optional<slice::AcceleratorType> accel;
+  if (!topo.accelerator_type.empty()) {
+    Result<slice::AcceleratorType> a =
+        slice::ParseAcceleratorType(topo.accelerator_type);
+    if (!a.ok()) return Result<slice::Shape>::Error(a.error());
+    accel = *a;
+  }
+  if (topology.empty()) {
+    if (!accel.has_value()) {
+      return Result<slice::Shape>::Error(
+          "slice topology unknown: neither topology nor accelerator-type "
+          "available");
+    }
+    Result<slice::Shape> dflt =
+        slice::DefaultTopology(accel->spec, accel->num_chips);
+    if (!dflt.ok()) return dflt;
+    topology = dflt->ToString();
+  }
+  Result<slice::Shape> shape = slice::ParseShape(topology);
+  if (!shape.ok()) return shape;
+
+  int shape_chips = shape->NumChips();
+  if (accel.has_value() && accel->num_chips != shape_chips) {
+    return Result<slice::Shape>::Error(
+        "topology " + shape->ToString() + " has " +
+        std::to_string(shape_chips) + " chips but accelerator type " +
+        accel->raw + " has " + std::to_string(accel->num_chips));
+  }
+  int hosts = topo.num_hosts > 0 ? topo.num_hosts : 1;
+  int chips_per_host =
+      topo.chips_per_host > 0 ? topo.chips_per_host : local_chips;
+  if (chips_per_host > 0 && hosts > 0 &&
+      chips_per_host * hosts != shape_chips) {
+    return Result<slice::Shape>::Error(
+        "topology " + shape->ToString() + " (" +
+        std::to_string(shape_chips) + " chips) does not match " +
+        std::to_string(hosts) + " hosts x " +
+        std::to_string(chips_per_host) + " chips/host");
+  }
+  return shape;
+}
+
+// Slice placement labels shared by single and mixed
+// (hosts / chips-per-host / worker-id / shape).
+Labels SliceLabels(const resource::TopologyInfo& topo,
+                   const slice::Shape& shape, int local_chips) {
+  Labels labels;
+  labels[kSliceShape] = shape.ToString();
+  labels[kSliceHosts] =
+      std::to_string(topo.num_hosts > 0 ? topo.num_hosts : 1);
+  labels[kSliceChipsPerHost] = std::to_string(
+      topo.chips_per_host > 0 ? topo.chips_per_host : local_chips);
+  if (topo.worker_id >= 0) {
+    labels[kSliceWorkerId] = std::to_string(topo.worker_id);
+  }
+  return labels;
+}
+
+// SLICE-INVALID degradation (reference newInvalidMigStrategyLabeler,
+// mig-strategy.go:243-262): explicit zeroed labels instead of failure.
+LabelerPtr InvalidSliceLabeler(const std::string& resource_name,
+                               const std::string& reason) {
+  TFD_LOG_WARNING << "invalid slice configuration: " << reason
+                  << "; emitting " << kSliceInvalid << " labels";
+  Labels labels;
+  const std::string p = resource_name + ".";
+  labels[p + "product"] = kSliceInvalid;
+  labels[p + "count"] = "0";
+  labels[p + "replicas"] = "0";
+  labels[p + "memory"] = "0";
+  labels[kSliceShape] = kSliceInvalid;
+  return std::make_unique<StaticLabeler>(std::move(labels));
+}
+
+}  // namespace
+
+Result<LabelerPtr> NewSliceStrategyLabeler(resource::Manager& manager,
+                                           const config::Config& config) {
+  Result<std::vector<resource::DevicePtr>> devices = manager.GetDevices();
+  if (!devices.ok()) {
+    return Result<LabelerPtr>::Error("error getting TPU devices: " +
+                                     devices.error());
+  }
+  if (devices->empty()) return LabelerPtr(Empty());
+  int local_chips = static_cast<int>(devices->size());
+
+  const std::string& strategy = config.flags.slice_strategy;
+  const std::string tpu_resource = config::kTpuResourceName;
+
+  // Whole-chip labels, always present (reference fullGPULabeler,
+  // mig-strategy.go:56-63).
+  Result<LabelerPtr> full =
+      NewTpuResourceLabeler(tpu_resource, *devices, config.sharing);
+  if (!full.ok()) return full;
+
+  if (strategy == config::kSliceStrategyNone) {
+    return full;
+  }
+
+  // Strategy label (reference strategy.go:20-28).
+  Labels strategy_labels;
+  strategy_labels[kSliceStrategy] = strategy;
+
+  Result<resource::TopologyInfo> topo = manager.GetTopology();
+  std::vector<LabelerPtr> parts;
+  parts.push_back(std::move(*full));
+  parts.push_back(
+      std::make_unique<StaticLabeler>(std::move(strategy_labels)));
+
+  if (!topo.ok()) {
+    parts.push_back(InvalidSliceLabeler(tpu_resource, topo.error()));
+    return Merge(std::move(parts));
+  }
+
+  Result<slice::Shape> shape = ResolveValidatedShape(*topo, local_chips);
+  if (!shape.ok()) {
+    parts.push_back(InvalidSliceLabeler(tpu_resource, shape.error()));
+    return Merge(std::move(parts));
+  }
+
+  if (strategy == config::kSliceStrategySingle) {
+    // Overload the primary resource with slice labels
+    // (reference newMigStrategySingleLabeler, mig-strategy.go:181-241).
+    parts.push_back(std::make_unique<StaticLabeler>(
+        SliceLabels(*topo, *shape, local_chips)));
+    return Merge(std::move(parts));
+  }
+
+  // mixed: shape-qualified resource (reference newMigStrategyMixedLabeler,
+  // mig-strategy.go:264-295, resource name "nvidia.com/mig-<profile>").
+  std::string shape_resource =
+      std::string(config::kTpuResourceName) + "-" + shape->ToString();
+  Result<LabelerPtr> shaped = NewShapeResourceLabeler(
+      shape_resource, shape->ToString(), *devices, config.sharing);
+  if (!shaped.ok()) return shaped;
+  parts.push_back(std::move(*shaped));
+  parts.push_back(std::make_unique<StaticLabeler>(
+      SliceLabels(*topo, *shape, local_chips)));
+  return Merge(std::move(parts));
+}
+
+}  // namespace lm
+}  // namespace tfd

@@ -1,0 +1,38 @@
+// Slice-shape strategies: the TPU generalization of MIG strategies.
+//
+// Reference parity: internal/lm/mig-strategy.go — strategy dispatch
+// none/single/mixed (mig-strategy.go:84-110), `single` homogeneity
+// validation with INVALID-label degradation (mig-strategy.go:181-262),
+// `mixed` per-profile resources (mig-strategy.go:264-295), and the
+// mig.strategy label (strategy.go:20-28).
+//
+// TPU semantics:
+//   none   — whole-chip labels only (google.com/tpu.*), no slice labels.
+//   single — the node's slice must be homogeneous and consistent: a known
+//            topology whose chip count equals chips-per-host × hosts and
+//            whose shape parses for the family. The primary resource is
+//            overloaded with slice labels (tpu.slice.shape/hosts/
+//            chips-per-host/worker-id). Inconsistent topology degrades to
+//            SLICE-INVALID labels with count/replicas = 0 rather than
+//            failing, exactly like MIG-INVALID.
+//   mixed  — the slice's labels move to a shape-qualified resource name
+//            ("google.com/tpu-4x4.*") so schedulers can target shapes as
+//            distinct resources; whole-chip labels remain for MIG-enabled-
+//            device parity (reference keeps full-GPU labels alongside).
+#pragma once
+
+#include "tfd/config/config.h"
+#include "tfd/lm/labeler.h"
+#include "tfd/resource/types.h"
+
+namespace tfd {
+namespace lm {
+
+// Builds the strategy-dispatched resource labeler for the node
+// (reference NewResourceLabeler, mig-strategy.go:45-82). Returns an empty
+// labeler when the manager exposes no devices.
+Result<LabelerPtr> NewSliceStrategyLabeler(resource::Manager& manager,
+                                           const config::Config& config);
+
+}  // namespace lm
+}  // namespace tfd

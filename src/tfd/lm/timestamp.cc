@@ -1,0 +1,18 @@
+#include "tfd/lm/timestamp.h"
+
+#include <ctime>
+
+#include "tfd/lm/schema.h"
+
+namespace tfd {
+namespace lm {
+
+LabelerPtr NewTimestampLabeler(const config::Config& config) {
+  if (config.flags.no_timestamp) return Empty();
+  Labels labels;
+  labels[kTimestampLabel] = std::to_string(std::time(nullptr));
+  return std::make_unique<StaticLabeler>(std::move(labels));
+}
+
+}  // namespace lm
+}  // namespace tfd

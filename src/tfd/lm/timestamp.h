@@ -1,0 +1,17 @@
+// Timestamp labeler (reference internal/lm/timestamp.go:29-37):
+// google.com/tfd.timestamp=<unix-seconds>, disabled by --no-timestamp.
+// Like the reference, the value is fixed at construction so the label stays
+// constant across sleep-loop rewrites until a config reload
+// (main_test.go:266-267 asserts exactly this).
+#pragma once
+
+#include "tfd/config/config.h"
+#include "tfd/lm/labeler.h"
+
+namespace tfd {
+namespace lm {
+
+LabelerPtr NewTimestampLabeler(const config::Config& config);
+
+}  // namespace lm
+}  // namespace tfd

@@ -1,0 +1,129 @@
+#include "tfd/lm/tpu_labeler.h"
+
+#include "tfd/lm/schema.h"
+#include "tfd/lm/slice_strategy.h"
+#include "tfd/util/logging.h"
+#include "tfd/util/strings.h"
+
+namespace tfd {
+namespace lm {
+
+namespace {
+
+// Splits a dotted version string into up to `max_parts` numeric components.
+std::vector<std::string> VersionParts(const std::string& version,
+                                      size_t max_parts) {
+  std::vector<std::string> parts = SplitString(TrimSpace(version), '.');
+  if (parts.size() > max_parts) parts.resize(max_parts);
+  return parts;
+}
+
+// Version labeler (reference newVersionLabeler, nvml.go:75-106: driver
+// X.Y[.Z] → cuda.driver.major/minor/rev, CUDA runtime → major/minor).
+// Here: libtpu version → libtpu.version.{major,minor,patch}; PJRT C-API
+// version → tpu.runtime.{major,minor}.
+LabelerPtr NewVersionLabeler(resource::Manager& manager) {
+  Labels labels;
+  Result<std::string> libtpu = manager.GetLibtpuVersion();
+  if (libtpu.ok()) {
+    std::vector<std::string> parts = VersionParts(*libtpu, 3);
+    const char* keys[3] = {kLibtpuMajor, kLibtpuMinor, kLibtpuPatch};
+    for (size_t i = 0; i < parts.size(); i++) labels[keys[i]] = parts[i];
+  } else {
+    TFD_LOG_WARNING << "unable to determine libtpu version: "
+                    << libtpu.error();
+  }
+  Result<std::string> runtime = manager.GetRuntimeVersion();
+  if (runtime.ok()) {
+    std::vector<std::string> parts = VersionParts(*runtime, 2);
+    const char* keys[2] = {kRuntimeMajor, kRuntimeMinor};
+    for (size_t i = 0; i < parts.size(); i++) labels[keys[i]] = parts[i];
+  } else {
+    TFD_LOG_WARNING << "unable to determine PJRT runtime version: "
+                    << runtime.error();
+  }
+  return std::make_unique<StaticLabeler>(std::move(labels));
+}
+
+// Slice-capability labeler (reference newMigCapabilityLabeler,
+// nvml.go:110-137): true when the node's chips are part of an addressable
+// slice fabric — i.e. the backend knows the slice topology or accelerator
+// type. False for chips visible without any topology identity.
+LabelerPtr NewSliceCapabilityLabeler(resource::Manager& manager) {
+  Labels labels;
+  Result<resource::TopologyInfo> topo = manager.GetTopology();
+  bool capable = topo.ok() && (!topo->accelerator_type.empty() ||
+                               !topo->topology.empty());
+  labels[kSliceCapable] = capable ? "true" : "false";
+  return std::make_unique<StaticLabeler>(std::move(labels));
+}
+
+// Topology labels shared by every strategy (emitted whenever known):
+// accelerator-type, topology, ICI wrap.
+LabelerPtr NewTopologyLabeler(resource::Manager& manager) {
+  Result<resource::TopologyInfo> topo = manager.GetTopology();
+  if (!topo.ok()) return Empty();
+  Labels labels;
+  if (!topo->accelerator_type.empty()) {
+    labels[kAcceleratorType] = SanitizeLabelValue(topo->accelerator_type);
+  }
+  if (!topo->topology.empty()) {
+    labels[kTopologyLabel] = SanitizeLabelValue(topo->topology);
+  }
+  if (!topo->accelerator_type.empty() || !topo->topology.empty()) {
+    labels[kIciWrap] = topo->has_wraparound ? "true" : "false";
+  }
+  return std::make_unique<StaticLabeler>(std::move(labels));
+}
+
+}  // namespace
+
+Result<LabelerPtr> NewTpuLabeler(const resource::ManagerPtr& manager,
+                                 const config::Config& config) {
+  Status init = manager->Init();
+  if (!init.ok()) {
+    return Result<LabelerPtr>::Error("failed to initialize " +
+                                     manager->Name() +
+                                     " backend: " + init.message());
+  }
+
+  Result<std::vector<resource::DevicePtr>> devices = manager->GetDevices();
+  if (!devices.ok()) {
+    manager->Shutdown();
+    return Result<LabelerPtr>::Error("error getting TPU devices: " +
+                                     devices.error());
+  }
+  if (devices->empty()) {
+    // No TPUs: contribute nothing (reference nvml.go:40-42); machine-type
+    // and timestamp labels are handled at the run() level.
+    manager->Shutdown();
+    return LabelerPtr(Empty());
+  }
+
+  std::vector<LabelerPtr> parts;
+  {
+    Labels backend;
+    backend[kBackendLabel] = manager->Name();
+    parts.push_back(std::make_unique<StaticLabeler>(std::move(backend)));
+  }
+  parts.push_back(NewVersionLabeler(*manager));
+  parts.push_back(NewSliceCapabilityLabeler(*manager));
+  parts.push_back(NewTopologyLabeler(*manager));
+  Result<LabelerPtr> strategy = NewSliceStrategyLabeler(*manager, config);
+  if (!strategy.ok()) {
+    manager->Shutdown();
+    return strategy;
+  }
+  parts.push_back(std::move(*strategy));
+  manager->Shutdown();
+
+  // Everything above is eagerly-computed static data; collapse it now so
+  // later GetLabels() calls cannot touch the (shut-down) manager.
+  LabelerPtr merged = Merge(std::move(parts));
+  Result<Labels> labels = merged->GetLabels();
+  if (!labels.ok()) return Result<LabelerPtr>::Error(labels.error());
+  return LabelerPtr(std::make_unique<StaticLabeler>(std::move(*labels)));
+}
+
+}  // namespace lm
+}  // namespace tfd

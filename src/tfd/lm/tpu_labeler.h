@@ -1,0 +1,20 @@
+// The TPU composite labeler — the NVML-labeler analogue.
+//
+// Reference parity: internal/lm/nvml.go:29-72 (NewNVMLLabeler): Init the
+// manager, short-circuit to empty on 0 devices, then merge version +
+// mig-capability + resource labelers, and Shutdown. All labels are computed
+// eagerly here (as the reference does) so the returned labeler is pure data.
+#pragma once
+
+#include "tfd/config/config.h"
+#include "tfd/lm/labeler.h"
+#include "tfd/resource/types.h"
+
+namespace tfd {
+namespace lm {
+
+Result<LabelerPtr> NewTpuLabeler(const resource::ManagerPtr& manager,
+                                 const config::Config& config);
+
+}  // namespace lm
+}  // namespace tfd

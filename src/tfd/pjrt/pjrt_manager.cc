@@ -1,0 +1,52 @@
+// PJRT (libtpu) backend — native binding over the PJRT C API via dlopen.
+//
+// Replaces the reference's NVML backend (internal/resource/nvml-lib.go,
+// nvml-device.go) and its cgo dlopen binding (internal/cuda/api.go:23-55):
+// the binary links with zero TPU dependencies and resolves libtpu.so at
+// runtime, degrading gracefully when absent.
+//
+// NOTE: placeholder implementation — the full PJRT C-API binding lands in
+// tfd/pjrt/pjrt_binding.{h,cc}. Init() currently reports unimplemented so
+// the fallback decorator and factory paths are exercised end-to-end.
+#include "tfd/resource/factory.h"
+
+namespace tfd {
+namespace resource {
+
+namespace {
+
+class PjrtManagerStub : public Manager {
+ public:
+  explicit PjrtManagerStub(std::string libtpu_path)
+      : libtpu_path_(std::move(libtpu_path)) {}
+
+  Status Init() override {
+    return Status::Error("PJRT backend not yet implemented");
+  }
+  void Shutdown() override {}
+  Result<std::vector<DevicePtr>> GetDevices() override {
+    return Result<std::vector<DevicePtr>>::Error("PJRT backend not initialized");
+  }
+  Result<std::string> GetLibtpuVersion() override {
+    return Result<std::string>::Error("PJRT backend not initialized");
+  }
+  Result<std::string> GetRuntimeVersion() override {
+    return Result<std::string>::Error("PJRT backend not initialized");
+  }
+  Result<TopologyInfo> GetTopology() override {
+    return Result<TopologyInfo>::Error("PJRT backend not initialized");
+  }
+  std::string Name() const override { return "pjrt"; }
+
+ private:
+  std::string libtpu_path_;
+};
+
+}  // namespace
+
+ManagerPtr NewPjrtManager(const std::string& libtpu_path) {
+  return std::make_shared<PjrtManagerStub>(libtpu_path);
+}
+
+}  // namespace resource
+}  // namespace tfd

@@ -1,0 +1,73 @@
+#include "tfd/platform/detect.h"
+
+#include <dlfcn.h>
+
+#include <cstdlib>
+#include <filesystem>
+
+#include "tfd/util/file.h"
+#include "tfd/util/strings.h"
+
+namespace tfd {
+namespace platform {
+
+namespace fs = std::filesystem;
+
+std::vector<std::string> LibtpuSearchPaths(const std::string& override_path) {
+  std::vector<std::string> paths;
+  if (!override_path.empty()) {
+    paths.push_back(override_path);
+    return paths;
+  }
+  if (const char* env = std::getenv("TPU_LIBRARY_PATH")) {
+    if (*env) paths.push_back(env);
+  }
+  // Standard TPU-VM locations, then the bare soname for ld.so search.
+  paths.push_back("/usr/lib/libtpu/libtpu.so");
+  paths.push_back("/usr/local/lib/libtpu/libtpu.so");
+  paths.push_back("/lib/libtpu.so");
+  paths.push_back("libtpu.so");
+  return paths;
+}
+
+bool HasLibtpu(const std::string& override_path, std::string* resolved_path) {
+  for (const std::string& path : LibtpuSearchPaths(override_path)) {
+    // RTLD_LAZY keeps the probe cheap; the PJRT backend re-opens for real
+    // (same pattern as the reference's dlopen probe, info/info.go:53-62).
+    void* handle = dlopen(path.c_str(), RTLD_LAZY | RTLD_LOCAL);
+    if (handle != nullptr) {
+      if (resolved_path != nullptr) *resolved_path = path;
+      dlclose(handle);
+      return true;
+    }
+  }
+  return false;
+}
+
+bool HasAccelDevice() {
+  std::error_code ec;
+  for (int i = 0; i < 8; i++) {
+    if (FileExists("/dev/accel" + std::to_string(i))) return true;
+  }
+  // VFIO-based TPU attachment (newer TPU VMs). A bound IOMMU group alone
+  // is not evidence of a TPU — any passthrough host has those — so only
+  // trust it on a GCE VM, where VFIO groups mean accelerators.
+  fs::path vfio("/dev/vfio");
+  if (fs::is_directory(vfio, ec) && OnGce()) {
+    for (const auto& entry : fs::directory_iterator(vfio, ec)) {
+      std::string name = entry.path().filename().string();
+      if (name != "vfio") return true;  // a bound IOMMU group node
+    }
+  }
+  return false;
+}
+
+bool OnGce(const std::string& dmi_product_file) {
+  Result<std::string> product = ReadFile(dmi_product_file);
+  if (!product.ok()) return false;
+  std::string p = ToLower(TrimSpace(*product));
+  return p.find("google") != std::string::npos;
+}
+
+}  // namespace platform
+}  // namespace tfd

@@ -1,0 +1,34 @@
+// Platform detection: which TPU stack (if any) is present on this node.
+//
+// Reference parity: go-nvlib info.Interface (vendor info/info.go:53-88 —
+// HasNvml via dlopen probe, IsTegraSystem via sysfs files) feeding the
+// backend factory (internal/resource/factory.go:41-73). The TPU probes:
+//   - HasLibtpu:      can dlopen libtpu.so (searching standard locations)
+//   - HasAccelDevice: /dev/accel* or /dev/vfio/* TPU device nodes exist
+//   - OnGce:          DMI product name is "Google Compute Engine" (or the
+//                     metadata server answers)
+#pragma once
+
+#include <string>
+#include <vector>
+
+namespace tfd {
+namespace platform {
+
+// Candidate libtpu.so paths, in search order. `override_path` (from
+// --libtpu-path / TPU_LIBRARY_PATH) wins when non-empty.
+std::vector<std::string> LibtpuSearchPaths(const std::string& override_path);
+
+// True if libtpu.so can be dlopen'd; fills `resolved_path` with the path
+// that loaded. Never keeps the library loaded (probe only).
+bool HasLibtpu(const std::string& override_path, std::string* resolved_path);
+
+// True if TPU device nodes exist (/dev/accel0... or /dev/vfio entries).
+bool HasAccelDevice();
+
+// True if this machine looks like a GCE VM (DMI product name).
+bool OnGce(const std::string& dmi_product_file =
+               "/sys/class/dmi/id/product_name");
+
+}  // namespace platform
+}  // namespace tfd

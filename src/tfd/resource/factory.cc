@@ -1,0 +1,60 @@
+#include "tfd/resource/factory.h"
+
+#include "tfd/platform/detect.h"
+#include "tfd/util/logging.h"
+
+namespace tfd {
+namespace resource {
+
+namespace {
+
+Result<ManagerPtr> SelectManager(const config::Config& config) {
+  const config::Flags& f = config.flags;
+  if (f.backend == "null") return NewNullManager();
+  if (f.backend == "mock") return NewMockManager(f.mock_topology_file);
+  if (f.backend == "pjrt") return NewPjrtManager(f.libtpu_path);
+  if (f.backend == "metadata") return NewMetadataManager(f.metadata_endpoint);
+
+  // auto (reference getManager, factory.go:41-73). Unlike the reference's
+  // single-winner probe, auto builds a *fallback chain*: a TPU VM whose
+  // chips are already held by a training job makes PJRT client creation
+  // fail, but the metadata backend can still label the node fully — so
+  // PJRT falls back to metadata (on GCE) before giving up.
+  std::string libtpu_path;
+  bool has_libtpu = platform::HasLibtpu(f.libtpu_path, &libtpu_path);
+  bool has_accel = platform::HasAccelDevice();
+  bool on_gce = platform::OnGce();
+  std::vector<ManagerPtr> chain;
+  if (has_libtpu || has_accel) {
+    TFD_LOG_INFO << "detected TPU stack (libtpu="
+                 << (has_libtpu ? libtpu_path : "no")
+                 << ", accel-devices=" << (has_accel ? "yes" : "no")
+                 << "); trying the PJRT backend first";
+    chain.push_back(NewPjrtManager(f.libtpu_path));
+  }
+  if (on_gce || !f.metadata_endpoint.empty()) {
+    chain.push_back(NewMetadataManager(f.metadata_endpoint));
+  }
+  if (chain.empty()) {
+    TFD_LOG_INFO << "no TPU stack detected; using the null backend";
+    return NewNullManager();
+  }
+  if (chain.size() == 1) return chain[0];
+  return NewFallbackChain(std::move(chain));
+}
+
+}  // namespace
+
+Result<ManagerPtr> NewManager(const config::Config& config) {
+  Result<ManagerPtr> manager = SelectManager(config);
+  if (!manager.ok()) return manager;
+  // WithConfig (reference factory.go:32-38): without fail-on-init-error,
+  // degrade to null on Init failure instead of crash-looping.
+  if (!config.flags.fail_on_init_error) {
+    return ManagerPtr(NewFallbackToNullOnInitError(*manager));
+  }
+  return manager;
+}
+
+}  // namespace resource
+}  // namespace tfd

@@ -1,0 +1,28 @@
+// Backend factory: picks the hardware backend for this node.
+//
+// Reference parity: internal/resource/factory.go:26-73 — NVML present →
+// NVML manager; Tegra → CUDA manager; neither → Null manager; wrapped in
+// the fallback-to-null decorator unless fail-on-init-error. The TPU
+// selection order: libtpu or TPU device nodes → PJRT backend; GCE VM with
+// a TPU accelerator-type in metadata → metadata backend (the degraded
+// CUDA-backend analogue: chip facts from the family table, no device
+// handles); neither → Null.
+#pragma once
+
+#include "tfd/config/config.h"
+#include "tfd/resource/types.h"
+
+namespace tfd {
+namespace resource {
+
+Result<ManagerPtr> NewManager(const config::Config& config);
+
+// The PJRT (libtpu) backend — implemented in pjrt_manager.cc.
+ManagerPtr NewPjrtManager(const std::string& libtpu_path);
+
+// The metadata backend — chip inventory derived from the GCE metadata
+// accelerator-type, for nodes where libtpu is absent or busy.
+ManagerPtr NewMetadataManager(const std::string& metadata_endpoint);
+
+}  // namespace resource
+}  // namespace tfd

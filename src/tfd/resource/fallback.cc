@@ -1,0 +1,106 @@
+#include "tfd/resource/types.h"
+#include "tfd/util/logging.h"
+
+namespace tfd {
+namespace resource {
+
+namespace {
+
+// On Init() failure the wrapped backend is replaced by the null manager so a
+// non-TPU (or broken-driver) node still gets its machine-type-only labels
+// instead of a crash loop (reference fallback.go:37-44; BASELINE config 1).
+class FallbackManager : public Manager {
+ public:
+  explicit FallbackManager(ManagerPtr wrapped)
+      : active_(std::move(wrapped)) {}
+
+  Status Init() override {
+    Status s = active_->Init();
+    if (!s.ok()) {
+      TFD_LOG_WARNING << "failed to initialize " << active_->Name()
+                      << " backend: " << s.message()
+                      << "; falling back to the null backend";
+      active_ = NewNullManager();
+    }
+    return Status::Ok();
+  }
+
+  void Shutdown() override { active_->Shutdown(); }
+
+  Result<std::vector<DevicePtr>> GetDevices() override {
+    return active_->GetDevices();
+  }
+  Result<std::string> GetLibtpuVersion() override {
+    return active_->GetLibtpuVersion();
+  }
+  Result<std::string> GetRuntimeVersion() override {
+    return active_->GetRuntimeVersion();
+  }
+  Result<TopologyInfo> GetTopology() override {
+    return active_->GetTopology();
+  }
+  std::string Name() const override { return active_->Name(); }
+
+ private:
+  ManagerPtr active_;
+};
+
+// Tries candidates in order until one Init()s (used by --backend=auto).
+class FallbackChainManager : public Manager {
+ public:
+  explicit FallbackChainManager(std::vector<ManagerPtr> candidates)
+      : candidates_(std::move(candidates)), active_(NewNullManager()) {}
+
+  Status Init() override {
+    std::string errors;
+    for (ManagerPtr& candidate : candidates_) {
+      Status s = candidate->Init();
+      if (s.ok()) {
+        active_ = candidate;
+        return Status::Ok();
+      }
+      TFD_LOG_WARNING << "backend " << candidate->Name()
+                      << " failed to initialize: " << s.message()
+                      << (candidate == candidates_.back()
+                              ? ""
+                              : "; trying the next backend");
+      if (!errors.empty()) errors += "; ";
+      errors += candidate->Name() + ": " + s.message();
+    }
+    return Status::Error("all backends failed to initialize (" + errors +
+                         ")");
+  }
+
+  void Shutdown() override { active_->Shutdown(); }
+
+  Result<std::vector<DevicePtr>> GetDevices() override {
+    return active_->GetDevices();
+  }
+  Result<std::string> GetLibtpuVersion() override {
+    return active_->GetLibtpuVersion();
+  }
+  Result<std::string> GetRuntimeVersion() override {
+    return active_->GetRuntimeVersion();
+  }
+  Result<TopologyInfo> GetTopology() override {
+    return active_->GetTopology();
+  }
+  std::string Name() const override { return active_->Name(); }
+
+ private:
+  std::vector<ManagerPtr> candidates_;
+  ManagerPtr active_;
+};
+
+}  // namespace
+
+ManagerPtr NewFallbackToNullOnInitError(ManagerPtr wrapped) {
+  return std::make_shared<FallbackManager>(std::move(wrapped));
+}
+
+ManagerPtr NewFallbackChain(std::vector<ManagerPtr> candidates) {
+  return std::make_shared<FallbackChainManager>(std::move(candidates));
+}
+
+}  // namespace resource
+}  // namespace tfd

@@ -1,0 +1,153 @@
+// Metadata backend: chip inventory derived from GCE instance metadata.
+//
+// The structural analogue of the reference's CUDA backend
+// (internal/resource/cuda-lib.go, cuda-device.go): the degraded path used
+// when the primary native library is unavailable. On a TPU VM whose chips
+// are held by another process (libtpu is single-tenant!) or whose libtpu is
+// missing, the accelerator identity is still fully determined by the
+// metadata server: accelerator-type + tpu-env give the chip count, family,
+// topology, and worker index. Versions are unknown here, exactly as the
+// CUDA backend reports "unknown.unknown.unknown" (cuda-lib.go:68-70).
+#include "tfd/gce/metadata.h"
+#include "tfd/resource/factory.h"
+#include "tfd/slice/topology.h"
+#include "tfd/util/logging.h"
+#include "tfd/util/strings.h"
+
+namespace tfd {
+namespace resource {
+
+namespace {
+
+class MetadataDevice : public Device {
+ public:
+  explicit MetadataDevice(slice::FamilySpec spec) : spec_(std::move(spec)) {}
+
+  Result<std::string> GetKind() override {
+    return "TPU " + spec_.family;  // synthesized; no PJRT handle here
+  }
+  Result<std::string> GetProduct() override { return spec_.product; }
+  Result<long long> GetTotalMemoryMiB() override { return spec_.hbm_mib; }
+  Result<int> GetCoreCount() override { return spec_.cores_per_chip; }
+  Result<int> GetGeneration() override { return spec_.generation; }
+
+ private:
+  slice::FamilySpec spec_;
+};
+
+// Product of a comma-separated bounds string like "2,2,1" (tpu-env
+// CHIPS_PER_HOST_BOUNDS / HOST_BOUNDS). 0 on parse failure.
+int BoundsProduct(const std::string& bounds) {
+  int product = 1;
+  for (const std::string& part : SplitString(TrimSpace(bounds), ',')) {
+    if (part.empty()) return 0;
+    try {
+      int v = std::stoi(part);
+      if (v < 1) return 0;
+      product *= v;
+    } catch (...) {
+      return 0;
+    }
+  }
+  return product;
+}
+
+class MetadataManager : public Manager {
+ public:
+  explicit MetadataManager(const std::string& endpoint)
+      : client_(endpoint) {}
+
+  Status Init() override {
+    Result<std::string> accel_type = client_.AcceleratorType();
+    if (!accel_type.ok() || accel_type->empty()) {
+      return Status::Error(
+          "no TPU accelerator-type in instance metadata (endpoint " +
+          client_.endpoint() + ")");
+    }
+    Result<slice::AcceleratorType> parsed =
+        slice::ParseAcceleratorType(*accel_type);
+    if (!parsed.ok()) return Status::Error(parsed.error());
+    accel_ = *parsed;
+
+    topology_.accelerator_type = accel_.raw;
+    topology_.num_hosts = 1;
+    int local_chips = std::min(accel_.num_chips,
+                               accel_.spec.max_chips_per_host);
+
+    Result<std::map<std::string, std::string>> env = client_.TpuEnv();
+    if (env.ok()) {
+      auto get = [&](const char* key) -> std::string {
+        auto it = env->find(key);
+        return it == env->end() ? "" : it->second;
+      };
+      if (int v = BoundsProduct(get("CHIPS_PER_HOST_BOUNDS"))) {
+        local_chips = v;
+      }
+      if (int v = BoundsProduct(get("HOST_BOUNDS"))) topology_.num_hosts = v;
+      std::string topology = get("TOPOLOGY");
+      if (!topology.empty()) {
+        topology_.topology = ToLower(topology);
+      }
+      std::string worker = get("WORKER_ID");
+      if (!worker.empty()) {
+        try {
+          topology_.worker_id = std::stoi(worker);
+        } catch (...) {
+        }
+      }
+    } else if (accel_.num_chips > accel_.spec.max_chips_per_host) {
+      // Multi-host slice without tpu-env: derive the host count.
+      topology_.num_hosts =
+          (accel_.num_chips + local_chips - 1) / local_chips;
+    }
+    topology_.chips_per_host = local_chips;
+
+    if (topology_.topology.empty()) {
+      Result<slice::Shape> shape =
+          slice::DefaultTopology(accel_.spec, accel_.num_chips);
+      if (shape.ok()) topology_.topology = shape->ToString();
+    }
+    // ICI wraparound: 3D-torus families wrap once the slice reaches a full
+    // cube (v4/v5p >= 64 chips); 2D families are reported unwrapped.
+    topology_.has_wraparound =
+        accel_.spec.topology_dims == 3 &&
+        accel_.spec.wrap_min_chips > 0 &&
+        accel_.num_chips >= accel_.spec.wrap_min_chips;
+
+    for (int i = 0; i < local_chips; i++) {
+      devices_.push_back(std::make_shared<MetadataDevice>(accel_.spec));
+    }
+    return Status::Ok();
+  }
+
+  void Shutdown() override {}
+
+  Result<std::vector<DevicePtr>> GetDevices() override { return devices_; }
+
+  Result<std::string> GetLibtpuVersion() override {
+    return Result<std::string>::Error(
+        "libtpu version unavailable from the metadata backend");
+  }
+  Result<std::string> GetRuntimeVersion() override {
+    return Result<std::string>::Error(
+        "runtime version unavailable from the metadata backend");
+  }
+  Result<TopologyInfo> GetTopology() override { return topology_; }
+
+  std::string Name() const override { return "metadata"; }
+
+ private:
+  gce::MetadataClient client_;
+  slice::AcceleratorType accel_;
+  TopologyInfo topology_;
+  std::vector<DevicePtr> devices_;
+};
+
+}  // namespace
+
+ManagerPtr NewMetadataManager(const std::string& metadata_endpoint) {
+  return std::make_shared<MetadataManager>(metadata_endpoint);
+}
+
+}  // namespace resource
+}  // namespace tfd

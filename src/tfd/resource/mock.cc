@@ -1,0 +1,185 @@
+// Mock backend: a Manager configured entirely from a yamllite fixture file.
+//
+// The reference generates moq mocks (internal/resource/manager_mock.go,
+// device_mock.go) and wraps them in fixture builders
+// (testing/resource-testing.go:31-134: NewFullGPU, NewMigDevice, ...,
+// WithErrorOnInit). Those only work in-process from Go tests. This build
+// makes the mock a real backend selectable with --backend=mock
+// --mock-topology-file=..., so golden tests exercise the *shipped binary*
+// end-to-end with no hardware — the hermetic-harness improvement SURVEY.md
+// §4 calls for.
+//
+// Fixture format (see tests/fixtures/*.yaml):
+//   libtpuVersion: 0.0.34
+//   runtimeVersion: "0.68"
+//   acceleratorType: v5litepod-16   # optional
+//   topology: 4x4                   # optional (default from type)
+//   chipsPerHost: 4                 # optional
+//   numHosts: 4                     # optional
+//   workerId: 0                     # optional
+//   wraparound: false               # optional
+//   initError: "boom"               # optional: Init() fails
+//   chips:
+//   - kind: TPU v5 lite
+//     count: 4                      # expands to N identical chips
+//     memoryMiB: 16384              # optional; default from family table
+#include "tfd/config/yamllite.h"
+#include "tfd/resource/types.h"
+#include "tfd/slice/topology.h"
+#include "tfd/util/file.h"
+
+namespace tfd {
+namespace resource {
+
+namespace {
+
+class MockDevice : public Device {
+ public:
+  MockDevice(std::string kind, slice::FamilySpec spec, long long memory_mib)
+      : kind_(std::move(kind)), spec_(std::move(spec)),
+        memory_mib_(memory_mib) {}
+
+  Result<std::string> GetKind() override { return kind_; }
+  Result<std::string> GetProduct() override { return spec_.product; }
+  Result<long long> GetTotalMemoryMiB() override { return memory_mib_; }
+  Result<int> GetCoreCount() override { return spec_.cores_per_chip; }
+  Result<int> GetGeneration() override { return spec_.generation; }
+
+ private:
+  std::string kind_;
+  slice::FamilySpec spec_;
+  long long memory_mib_;
+};
+
+class MockManager : public Manager {
+ public:
+  Status Init() override {
+    if (!init_error_.empty()) return Status::Error(init_error_);
+    return Status::Ok();
+  }
+  void Shutdown() override {}
+
+  Result<std::vector<DevicePtr>> GetDevices() override { return devices_; }
+
+  Result<std::string> GetLibtpuVersion() override {
+    if (libtpu_version_.empty()) {
+      return Result<std::string>::Error("mock: no libtpu version configured");
+    }
+    return libtpu_version_;
+  }
+
+  Result<std::string> GetRuntimeVersion() override {
+    if (runtime_version_.empty()) {
+      return Result<std::string>::Error(
+          "mock: no runtime version configured");
+    }
+    return runtime_version_;
+  }
+
+  Result<TopologyInfo> GetTopology() override { return topology_; }
+
+  std::string Name() const override { return "mock"; }
+
+  std::string init_error_;
+  std::string libtpu_version_;
+  std::string runtime_version_;
+  TopologyInfo topology_;
+  std::vector<DevicePtr> devices_;
+};
+
+Result<std::string> GetString(const yamllite::Node& root,
+                              const std::string& key,
+                              const std::string& dflt) {
+  yamllite::NodePtr n = root.Get(key);
+  if (!n || n->IsNull()) return dflt;
+  return n->AsString();
+}
+
+Result<long long> GetInt(const yamllite::Node& root, const std::string& key,
+                         long long dflt) {
+  yamllite::NodePtr n = root.Get(key);
+  if (!n || n->IsNull()) return dflt;
+  return n->AsInt();
+}
+
+}  // namespace
+
+Result<ManagerPtr> NewMockManager(const std::string& fixture_path) {
+  if (fixture_path.empty()) {
+    return Result<ManagerPtr>::Error(
+        "mock backend requires --mock-topology-file");
+  }
+  Result<std::string> text = ReadFile(fixture_path);
+  if (!text.ok()) return Result<ManagerPtr>::Error(text.error());
+  Result<yamllite::NodePtr> parsed = yamllite::Parse(*text);
+  if (!parsed.ok()) {
+    return Result<ManagerPtr>::Error("mock fixture " + fixture_path + ": " +
+                                     parsed.error());
+  }
+  const yamllite::Node& root = **parsed;
+
+  auto mgr = std::make_shared<MockManager>();
+
+#define TFD_MOCK_STR(field, key, dflt)                              \
+  {                                                                 \
+    Result<std::string> v = GetString(root, key, dflt);             \
+    if (!v.ok()) return Result<ManagerPtr>::Error(v.error());       \
+    field = *v;                                                     \
+  }
+#define TFD_MOCK_INT(field, key, dflt)                              \
+  {                                                                 \
+    Result<long long> v = GetInt(root, key, dflt);                  \
+    if (!v.ok()) return Result<ManagerPtr>::Error(v.error());       \
+    field = static_cast<int>(*v);                                   \
+  }
+
+  TFD_MOCK_STR(mgr->init_error_, "initError", "");
+  TFD_MOCK_STR(mgr->libtpu_version_, "libtpuVersion", "");
+  TFD_MOCK_STR(mgr->runtime_version_, "runtimeVersion", "");
+  TFD_MOCK_STR(mgr->topology_.accelerator_type, "acceleratorType", "");
+  TFD_MOCK_STR(mgr->topology_.topology, "topology", "");
+  TFD_MOCK_INT(mgr->topology_.chips_per_host, "chipsPerHost", 0);
+  TFD_MOCK_INT(mgr->topology_.num_hosts, "numHosts", 0);
+  TFD_MOCK_INT(mgr->topology_.worker_id, "workerId", -1);
+#undef TFD_MOCK_STR
+#undef TFD_MOCK_INT
+  {
+    yamllite::NodePtr n = root.Get("wraparound");
+    if (n && !n->IsNull()) {
+      Result<bool> v = n->AsBool();
+      if (!v.ok()) return Result<ManagerPtr>::Error(v.error());
+      mgr->topology_.has_wraparound = *v;
+    }
+  }
+
+  yamllite::NodePtr chips = root.Get("chips");
+  if (chips && chips->kind == yamllite::Node::Kind::kList) {
+    for (const yamllite::NodePtr& item : chips->list_items) {
+      Result<std::string> kind = GetString(*item, "kind", "");
+      if (!kind.ok()) return Result<ManagerPtr>::Error(kind.error());
+      if (kind->empty()) {
+        return Result<ManagerPtr>::Error(
+            "mock fixture: every chips[] entry needs a 'kind'");
+      }
+      Result<slice::FamilySpec> spec = slice::FamilyFromDeviceKind(*kind);
+      if (!spec.ok()) return Result<ManagerPtr>::Error(spec.error());
+      Result<long long> memory = GetInt(*item, "memoryMiB", spec->hbm_mib);
+      if (!memory.ok()) return Result<ManagerPtr>::Error(memory.error());
+      Result<long long> count = GetInt(*item, "count", 1);
+      if (!count.ok()) return Result<ManagerPtr>::Error(count.error());
+      for (long long i = 0; i < *count; i++) {
+        mgr->devices_.push_back(
+            std::make_shared<MockDevice>(*kind, *spec, *memory));
+      }
+    }
+  }
+
+  if (mgr->topology_.chips_per_host == 0) {
+    mgr->topology_.chips_per_host =
+        static_cast<int>(mgr->devices_.size());
+  }
+  return ManagerPtr(mgr);
+}
+
+}  // namespace resource
+}  // namespace tfd

@@ -1,0 +1,40 @@
+#include "tfd/resource/types.h"
+
+namespace tfd {
+namespace resource {
+
+namespace {
+
+class NullManager : public Manager {
+ public:
+  Status Init() override { return Status::Ok(); }
+  void Shutdown() override {}
+
+  Result<std::vector<DevicePtr>> GetDevices() override {
+    return std::vector<DevicePtr>{};
+  }
+
+  Result<std::string> GetLibtpuVersion() override {
+    return Result<std::string>::Error(
+        "cannot get libtpu version from the null manager");
+  }
+
+  Result<std::string> GetRuntimeVersion() override {
+    return Result<std::string>::Error(
+        "cannot get runtime version from the null manager");
+  }
+
+  Result<TopologyInfo> GetTopology() override {
+    return Result<TopologyInfo>::Error(
+        "cannot get topology from the null manager");
+  }
+
+  std::string Name() const override { return "null"; }
+};
+
+}  // namespace
+
+ManagerPtr NewNullManager() { return std::make_shared<NullManager>(); }
+
+}  // namespace resource
+}  // namespace tfd

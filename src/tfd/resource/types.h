@@ -1,0 +1,104 @@
+// Resource abstraction: the device layer every labeler sits on.
+//
+// Reference parity: internal/resource/types.go:22-42 defines
+// Manager{Init,Shutdown,GetDevices,GetDriverVersion,GetCudaDriverVersion}
+// and Device{IsMigEnabled,...,GetCudaComputeCapability}. The TPU interfaces
+// are re-sized for TPU hardware: chips instead of GPUs, HBM MiB, TPU
+// generation instead of CUDA compute capability, and a first-class
+// TopologyInfo (slice shape / hosts / worker id) — which NVML hands out
+// per-device but TPU stacks expose per-slice. MIG-isms (parent handles,
+// GPU-instance slices) are deliberately dropped; their role is played by the
+// slice-shape strategies in tfd/lm/slice_strategy.
+#pragma once
+
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "tfd/util/status.h"
+
+namespace tfd {
+namespace resource {
+
+// Per-slice topology, as known to this host.
+struct TopologyInfo {
+  std::string accelerator_type;  // e.g. "v5litepod-16" ("" if unknown)
+  std::string topology;          // e.g. "4x4" / "2x2x2" ("" if unknown)
+  int chips_per_host = 0;        // chips attached to this host
+  int num_hosts = 0;             // hosts in the slice (1 for single-host)
+  int worker_id = -1;            // this host's index in the slice (-1 unknown)
+  bool has_wraparound = false;   // ICI torus wrap links present
+};
+
+// One TPU chip attached to this host.
+class Device {
+ public:
+  virtual ~Device() = default;
+
+  // Raw device kind as reported by the backend (e.g. "TPU v5 lite").
+  virtual Result<std::string> GetKind() = 0;
+  // Normalized product name for labels (e.g. "tpu-v5e").
+  virtual Result<std::string> GetProduct() = 0;
+  // HBM capacity in MiB.
+  virtual Result<long long> GetTotalMemoryMiB() = 0;
+  // TensorCores on this chip.
+  virtual Result<int> GetCoreCount() = 0;
+  // TPU generation (e.g. 5 for v5e/v5p) — the compute-capability analogue
+  // (reference device.GetCudaComputeCapability, types.go:40).
+  virtual Result<int> GetGeneration() = 0;
+};
+
+using DevicePtr = std::shared_ptr<Device>;
+
+// A hardware backend. Init() is where the native library boundary is
+// crossed (reference nvml-lib.go:82-88); everything else must be callable
+// only between Init and Shutdown.
+class Manager {
+ public:
+  virtual ~Manager() = default;
+
+  virtual Status Init() = 0;
+  virtual void Shutdown() = 0;
+
+  virtual Result<std::vector<DevicePtr>> GetDevices() = 0;
+
+  // libtpu library version (driver-version analogue,
+  // reference Manager.GetDriverVersion types.go:27).
+  virtual Result<std::string> GetLibtpuVersion() = 0;
+  // PJRT C-API version "major.minor" (CUDA-driver-version analogue,
+  // reference Manager.GetCudaDriverVersion types.go:28).
+  virtual Result<std::string> GetRuntimeVersion() = 0;
+
+  // Slice topology as known to this backend. May be empty (single host,
+  // unknown shape) — labelers degrade gracefully.
+  virtual Result<TopologyInfo> GetTopology() = 0;
+
+  // Short backend name for logs and the tpu.backend label
+  // (e.g. "pjrt", "metadata", "mock", "null").
+  virtual std::string Name() const = 0;
+};
+
+using ManagerPtr = std::shared_ptr<Manager>;
+
+// Null manager: no devices; version queries error
+// (reference internal/resource/null.go:30-57).
+ManagerPtr NewNullManager();
+
+// Decorator: if Init() fails, log a warning and degrade to the null manager
+// (reference internal/resource/fallback.go:29-64).
+ManagerPtr NewFallbackToNullOnInitError(ManagerPtr wrapped);
+
+// Decorator: tries each backend's Init() in order, settling on the first
+// that succeeds; Init() fails only if every candidate fails. Used by
+// --backend=auto so a busy-chip PJRT failure falls back to the metadata
+// backend (no reference analogue — GFD picks a single winner up front).
+ManagerPtr NewFallbackChain(std::vector<ManagerPtr> candidates);
+
+// Mock manager configured from a yamllite fixture file — the moq-mock +
+// fixture-builder analogue (reference internal/resource/manager_mock.go and
+// testing/resource-testing.go:31-134), driven by data instead of codegen so
+// integration tests can exercise the real binary hermetically.
+Result<ManagerPtr> NewMockManager(const std::string& fixture_path);
+
+}  // namespace resource
+}  // namespace tfd

@@ -1,0 +1,56 @@
+#include "tfd/slice/shape.h"
+
+#include <cctype>
+
+#include "tfd/util/strings.h"
+
+namespace tfd {
+namespace slice {
+
+int Shape::NumChips() const {
+  int n = 1;
+  for (int d : dims) n *= d;
+  return n;
+}
+
+std::string Shape::ToString() const {
+  std::vector<std::string> parts;
+  parts.reserve(dims.size());
+  for (int d : dims) parts.push_back(std::to_string(d));
+  return JoinStrings(parts, "x");
+}
+
+Result<Shape> ParseShape(const std::string& text) {
+  std::string s = TrimSpace(text);
+  std::vector<std::string> parts = SplitString(s, 'x');
+  if (parts.size() < 2 || parts.size() > 3) {
+    return Result<Shape>::Error("invalid slice shape '" + text +
+                                "': want 2 or 3 'x'-separated dimensions");
+  }
+  Shape shape;
+  for (const std::string& p : parts) {
+    if (p.empty()) {
+      return Result<Shape>::Error("invalid slice shape '" + text + "'");
+    }
+    for (char c : p) {
+      if (!std::isdigit(static_cast<unsigned char>(c))) {
+        return Result<Shape>::Error("invalid slice shape '" + text + "'");
+      }
+    }
+    int v;
+    try {
+      v = std::stoi(p);
+    } catch (...) {
+      return Result<Shape>::Error("invalid slice shape '" + text + "'");
+    }
+    if (v < 1) {
+      return Result<Shape>::Error("invalid slice shape '" + text +
+                                  "': dimensions must be >= 1");
+    }
+    shape.dims.push_back(v);
+  }
+  return shape;
+}
+
+}  // namespace slice
+}  // namespace tfd

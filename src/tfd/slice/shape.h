@@ -1,0 +1,35 @@
+// TPU slice-shape grammar: "AxB" (2D torus: v2/v3/v5e/v6e) and "AxBxC"
+// (3D torus: v4/v5p).
+//
+// This is the structural analogue of the reference's MIG profile grammar
+// "<C>c.<G>g.<GB>gb[+me]" (go-nvlib device/mig_profile.go:36-120): a small,
+// strict parser/formatter that the single/mixed slice strategies and the
+// topology labelers share.
+#pragma once
+
+#include <string>
+#include <vector>
+
+#include "tfd/util/status.h"
+
+namespace tfd {
+namespace slice {
+
+struct Shape {
+  std::vector<int> dims;  // 2 or 3 dimensions, each >= 1
+
+  int NumChips() const;
+  // Canonical form, e.g. "2x2x1". Dimensions keep their given order: shape
+  // is a physical layout, not a bag of factors.
+  std::string ToString() const;
+
+  bool operator==(const Shape& other) const { return dims == other.dims; }
+  bool operator!=(const Shape& other) const { return !(*this == other); }
+};
+
+// Parses "4x4" / "2x2x2". Errors on anything else (dims < 1, not 2-3 axes,
+// junk characters).
+Result<Shape> ParseShape(const std::string& text);
+
+}  // namespace slice
+}  // namespace tfd

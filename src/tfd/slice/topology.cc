@@ -1,0 +1,173 @@
+#include "tfd/slice/topology.h"
+
+#include <array>
+#include <cmath>
+
+#include "tfd/util/strings.h"
+
+namespace tfd {
+namespace slice {
+
+namespace {
+
+// Per-chip HBM, cores, host fan-out and topology rules per TPU generation.
+// Sources: Google Cloud TPU system-architecture docs (public); chips-per-host
+// and count-unit conventions match GCE accelerator-type naming ("v2-8" = 8
+// TensorCores = 4 chips; "v5litepod-8" = 8 chips).
+const std::array<FamilySpec, 6>& Families() {
+  static const std::array<FamilySpec, 6> kFamilies = {{
+      // family, product, gen, hbm_mib, cores, max_chips/host, dims,
+      // counts_cores, wrap_min_chips
+      {"v2", "tpu-v2", 2, 16384, 2, 4, 2, true, 0},
+      {"v3", "tpu-v3", 3, 32768, 2, 4, 2, true, 0},
+      {"v4", "tpu-v4", 4, 32768, 2, 4, 3, true, 64},
+      {"v5e", "tpu-v5e", 5, 16384, 1, 8, 2, false, 0},
+      {"v5p", "tpu-v5p", 5, 97280, 2, 4, 3, true, 64},
+      {"v6e", "tpu-v6e", 6, 32768, 1, 8, 2, false, 0},
+  }};
+  return kFamilies;
+}
+
+}  // namespace
+
+Result<FamilySpec> LookupFamily(const std::string& name) {
+  std::string n = ToLower(TrimSpace(name));
+  if (n == "v5litepod" || n == "v5lite" || n == "v5litepod-slice") n = "v5e";
+  if (n == "v6litepod" || n == "v6lite") n = "v6e";
+  for (const FamilySpec& f : Families()) {
+    if (f.family == n) return f;
+  }
+  return Result<FamilySpec>::Error("unknown TPU family '" + name + "'");
+}
+
+Result<FamilySpec> FamilyFromDeviceKind(const std::string& kind) {
+  std::string k = ToLower(kind);
+  // PJRT device kinds: "TPU v2" ... "TPU v4", "TPU v5 lite" / "TPU v5lite",
+  // "TPU v5" / "TPU v5p", "TPU v6 lite" / "TPU v6e".
+  auto contains = [&k](const std::string& needle) {
+    return k.find(needle) != std::string::npos;
+  };
+  if (contains("v6e") || (contains("v6") && contains("lite"))) {
+    return LookupFamily("v6e");
+  }
+  if (contains("v5e") || (contains("v5") && contains("lite"))) {
+    return LookupFamily("v5e");
+  }
+  if (contains("v5p") || contains("v5")) return LookupFamily("v5p");
+  if (contains("v4")) return LookupFamily("v4");
+  if (contains("v3")) return LookupFamily("v3");
+  if (contains("v2")) return LookupFamily("v2");
+  return Result<FamilySpec>::Error("unrecognized TPU device kind '" + kind +
+                                   "'");
+}
+
+Result<AcceleratorType> ParseAcceleratorType(const std::string& text) {
+  std::string s = ToLower(TrimSpace(text));
+  size_t dash = s.rfind('-');
+  if (dash == std::string::npos || dash == 0 || dash + 1 >= s.size()) {
+    return Result<AcceleratorType>::Error("invalid accelerator type '" +
+                                          text + "'");
+  }
+  std::string family_part = s.substr(0, dash);
+  std::string count_part = s.substr(dash + 1);
+  for (char c : count_part) {
+    if (!isdigit(static_cast<unsigned char>(c))) {
+      return Result<AcceleratorType>::Error("invalid accelerator type '" +
+                                            text + "'");
+    }
+  }
+  Result<FamilySpec> family = LookupFamily(family_part);
+  if (!family.ok()) {
+    return Result<AcceleratorType>::Error("invalid accelerator type '" +
+                                          text + "': " + family.error());
+  }
+  int count;
+  try {
+    count = std::stoi(count_part);
+  } catch (...) {
+    return Result<AcceleratorType>::Error("invalid accelerator type '" +
+                                          text + "'");
+  }
+  if (count < 1) {
+    return Result<AcceleratorType>::Error("invalid accelerator type '" +
+                                          text + "'");
+  }
+  AcceleratorType out;
+  out.raw = TrimSpace(text);
+  out.spec = *family;
+  if (family->type_counts_cores) {
+    if (count % family->cores_per_chip != 0) {
+      return Result<AcceleratorType>::Error(
+          "invalid accelerator type '" + text + "': core count " +
+          std::to_string(count) + " is not a multiple of cores-per-chip " +
+          std::to_string(family->cores_per_chip));
+    }
+    out.num_cores = count;
+    out.num_chips = count / family->cores_per_chip;
+  } else {
+    out.num_chips = count;
+    out.num_cores = count * family->cores_per_chip;
+  }
+  return out;
+}
+
+Result<Shape> DefaultTopology(const FamilySpec& family, int num_chips) {
+  if (num_chips < 1) {
+    return Result<Shape>::Error("invalid chip count " +
+                                std::to_string(num_chips));
+  }
+  if (family.topology_dims == 2) {
+    // 2D: prefer the squarest AxB with A*B == num_chips and A <= B, matching
+    // published shapes (v5e: 1 chip 1x1, 4 → 2x2, 8 → 2x4, 16 → 4x4,
+    // 32 → 4x8, 64 → 8x8, 128 → 8x16, 256 → 16x16).
+    for (int a = static_cast<int>(std::sqrt(static_cast<double>(num_chips)));
+         a >= 1; a--) {
+      if (num_chips % a == 0) {
+        return Shape{{a, num_chips / a}};
+      }
+    }
+  }
+  if (family.topology_dims == 3) {
+    // 3D: Google's published shapes are the most-balanced A<=B<=C
+    // factorization (4 chips → 2x2x1, 8 → 2x2x2, 16 → 2x2x4, 32 → 2x4x4,
+    // 64 → 4x4x4, 128 → 4x4x8, 256 → 4x8x8), written ascending with any
+    // 1-dims moved to the end ("2x2x1", not "1x2x2").
+    Shape best;
+    bool found = false;
+    int best_spread = 0;
+    for (int a = 1; a * a * a <= num_chips; a++) {
+      if (num_chips % a != 0) continue;
+      int rem = num_chips / a;
+      for (int b = a; b * b <= rem; b++) {
+        if (rem % b != 0) continue;
+        int c = rem / b;
+        int spread = c - a;  // most-balanced = smallest spread
+        if (!found || spread < best_spread) {
+          found = true;
+          best_spread = spread;
+          best = Shape{{a, b, c}};
+        }
+      }
+    }
+    if (found) {
+      // Canonical published order: ascending, 1s last.
+      std::vector<int> dims;
+      int ones = 0;
+      for (int d : best.dims) {
+        if (d == 1) {
+          ones++;
+        } else {
+          dims.push_back(d);
+        }
+      }
+      for (int i = 0; i < ones; i++) dims.push_back(1);
+      return Shape{dims.empty() ? std::vector<int>{1, 1, 1} : dims};
+    }
+  }
+  return Result<Shape>::Error("no standard topology for " +
+                              std::to_string(num_chips) + " chips of " +
+                              family.family);
+}
+
+}  // namespace slice
+}  // namespace tfd

@@ -1,0 +1,57 @@
+// TPU accelerator-family knowledge: the table that replaces the reference's
+// compute-capability→arch-family map (internal/lm/resource.go:261-284) and
+// go-nvlib's MIG profile tables. Everything the labelers need to reason
+// about an accelerator type ("v5litepod-16") or a PJRT device kind
+// ("TPU v5 lite") without hardware calls lives here.
+#pragma once
+
+#include <string>
+
+#include "tfd/slice/shape.h"
+#include "tfd/util/status.h"
+
+namespace tfd {
+namespace slice {
+
+struct FamilySpec {
+  std::string family;       // label value: v2, v3, v4, v5e, v5p, v6e
+  std::string product;      // label value: tpu-v2, ..., tpu-v6e
+  int generation = 0;       // 2..6
+  long long hbm_mib = 0;    // per-chip HBM (MiB)
+  int cores_per_chip = 0;   // TensorCores per chip
+  int max_chips_per_host = 0;
+  int topology_dims = 0;    // 2 = 2D torus, 3 = 3D torus
+  // Accelerator-type counts chips (v5e/v6e) or TensorCores (v2/v3/v4/v5p):
+  // "v4-8" is 8 cores = 4 chips; "v5litepod-8" is 8 chips.
+  bool type_counts_cores = false;
+  // Minimum chips for a 3D slice to have torus wraparound links
+  // (v4/v5p: a full 4x4x4 cube, i.e. one "pod cube", wraps).
+  int wrap_min_chips = 0;
+};
+
+// Parsed "v5litepod-16" / "v4-8" / "v2-8".
+struct AcceleratorType {
+  std::string raw;       // original string
+  FamilySpec spec;
+  int num_chips = 0;     // whole-slice chips (derived)
+  int num_cores = 0;     // whole-slice TensorCores (derived)
+};
+
+// Family lookup by short name ("v5e") or its accelerator-type prefix
+// ("v5litepod"). Unknown families error.
+Result<FamilySpec> LookupFamily(const std::string& name);
+
+// Maps a PJRT device kind string (e.g. "TPU v5 lite", "TPU v4") to a family.
+Result<FamilySpec> FamilyFromDeviceKind(const std::string& kind);
+
+// Parses a GCE accelerator-type string like "v2-8", "v4-16", "v5litepod-4",
+// "v5p-128", "v6e-8".
+Result<AcceleratorType> ParseAcceleratorType(const std::string& text);
+
+// Default slice topology for `num_chips` chips of `family`, matching the
+// shapes Google publishes for each slice size (e.g. v5litepod-16 → 4x4,
+// v4-16 → 2x2x2). Errors when the chip count has no standard shape.
+Result<Shape> DefaultTopology(const FamilySpec& family, int num_chips);
+
+}  // namespace slice
+}  // namespace tfd

@@ -1,0 +1,476 @@
+// Assert-based unit tests for the tfd core, run as one binary by the pytest
+// tier-1 harness (tests/test_unit_cpp.py). Covers the pure-logic layers the
+// reference covers with table-driven Go tests (internal/lm/*_test.go,
+// internal/resource/*_test.go): yamllite, the slice-shape grammar, the
+// family table, config precedence, label generation per strategy, sharing,
+// and the fallback decorator.
+#include <unistd.h>
+
+#include <cassert>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <iostream>
+
+#include "tfd/config/config.h"
+#include "tfd/config/yamllite.h"
+#include "tfd/gce/metadata.h"
+#include "tfd/lm/labels.h"
+#include "tfd/lm/schema.h"
+#include "tfd/lm/slice_strategy.h"
+#include "tfd/lm/tpu_labeler.h"
+#include "tfd/resource/types.h"
+#include "tfd/slice/shape.h"
+#include "tfd/slice/topology.h"
+#include "tfd/util/file.h"
+#include "tfd/util/strings.h"
+
+namespace tfd {
+namespace {
+
+int g_failures = 0;
+int g_checks = 0;
+
+#define CHECK_TRUE(cond)                                              \
+  do {                                                                \
+    g_checks++;                                                       \
+    if (!(cond)) {                                                    \
+      g_failures++;                                                   \
+      std::cerr << "FAIL " << __FILE__ << ":" << __LINE__ << ": "     \
+                << #cond << std::endl;                                \
+    }                                                                 \
+  } while (0)
+
+#define CHECK_EQ(a, b)                                                 \
+  do {                                                                 \
+    g_checks++;                                                        \
+    auto va = (a);                                                     \
+    auto vb = (b);                                                     \
+    if (!(va == vb)) {                                                 \
+      g_failures++;                                                    \
+      std::cerr << "FAIL " << __FILE__ << ":" << __LINE__ << ": "      \
+                << #a << " == " << #b << " (got '" << va << "' vs '"   \
+                << vb << "')" << std::endl;                            \
+    }                                                                  \
+  } while (0)
+
+std::string WriteTemp(const std::string& contents) {
+  static int counter = 0;
+  std::string path = "/tmp/tfd-unit-" + std::to_string(getpid()) + "-" +
+                     std::to_string(counter++) + ".yaml";
+  std::ofstream out(path);
+  out << contents;
+  return path;
+}
+
+void TestStrings() {
+  CHECK_EQ(TrimSpace("  a b \n"), "a b");
+  CHECK_EQ(JoinStrings({"a", "b"}, "x"), "axb");
+  CHECK_EQ(SanitizeLabelValue("Google Compute Engine"),
+           "Google-Compute-Engine");
+  CHECK_EQ(SanitizeLabelValue("ct5lp-hightpu-4t"), "ct5lp-hightpu-4t");
+  CHECK_EQ(ReplaceAll("a.b.c", ".", "-"), "a-b-c");
+}
+
+void TestYamlLite() {
+  auto doc = yamllite::Parse(R"(
+version: v1
+flags:
+  oneshot: true
+  sleepInterval: 60s   # comment
+  outputFile: "/tmp/x y"
+sharing:
+  timeSlicing:
+    resources:
+    - name: google.com/tpu
+      replicas: 2
+    - name: other
+      rename: tpu-shared
+      replicas: 4
+)");
+  CHECK_TRUE(doc.ok());
+  if (!doc.ok()) {
+    std::cerr << "yaml parse error: " << doc.error() << std::endl;
+    return;
+  }
+  const yamllite::Node& root = **doc;
+  CHECK_EQ(root.Get("version")->AsString().value(), "v1");
+  CHECK_EQ(root.Get("flags")->Get("oneshot")->AsBool().value(), true);
+  CHECK_EQ(root.Get("flags")->Get("sleepInterval")->AsString().value(),
+           "60s");
+  CHECK_EQ(root.Get("flags")->Get("outputFile")->AsString().value(),
+           "/tmp/x y");
+  auto resources =
+      root.Get("sharing")->Get("timeSlicing")->Get("resources");
+  CHECK_TRUE(resources != nullptr);
+  CHECK_EQ(static_cast<int>(resources->list_items.size()), 2);
+  CHECK_EQ(resources->list_items[0]->Get("name")->AsString().value(),
+           "google.com/tpu");
+  CHECK_EQ(resources->list_items[1]->Get("replicas")->AsInt().value(), 4);
+
+  // Errors.
+  CHECK_TRUE(!yamllite::Parse("a: {flow: no}").ok());
+  CHECK_TRUE(!yamllite::Parse("\tb: 1").ok());
+}
+
+void TestShapeGrammar() {
+  auto s = slice::ParseShape("2x2x1");
+  CHECK_TRUE(s.ok());
+  CHECK_EQ(s->NumChips(), 4);
+  CHECK_EQ(s->ToString(), "2x2x1");
+  CHECK_EQ(slice::ParseShape("4x4")->NumChips(), 16);
+  CHECK_TRUE(!slice::ParseShape("4").ok());
+  CHECK_TRUE(!slice::ParseShape("1x2x3x4").ok());
+  CHECK_TRUE(!slice::ParseShape("0x2").ok());
+  CHECK_TRUE(!slice::ParseShape("2xax1").ok());
+}
+
+void TestFamilyTable() {
+  auto v5e = slice::LookupFamily("v5e");
+  CHECK_TRUE(v5e.ok());
+  CHECK_EQ(v5e->product, "tpu-v5e");
+  CHECK_EQ(v5e->hbm_mib, 16384LL);
+  CHECK_TRUE(slice::LookupFamily("v9").ok() == false);
+
+  auto from_kind = slice::FamilyFromDeviceKind("TPU v5 lite");
+  CHECK_TRUE(from_kind.ok());
+  CHECK_EQ(from_kind->family, "v5e");
+  CHECK_EQ(slice::FamilyFromDeviceKind("TPU v4")->family, "v4");
+  CHECK_EQ(slice::FamilyFromDeviceKind("TPU v5p")->family, "v5p");
+  CHECK_EQ(slice::FamilyFromDeviceKind("TPU v5")->family, "v5p");
+
+  // Accelerator types: v2/v3/v4/v5p count TensorCores, v5e/v6e count chips.
+  auto v2 = slice::ParseAcceleratorType("v2-8");
+  CHECK_TRUE(v2.ok());
+  CHECK_EQ(v2->num_chips, 4);
+  CHECK_EQ(v2->num_cores, 8);
+  auto v5lite = slice::ParseAcceleratorType("v5litepod-16");
+  CHECK_TRUE(v5lite.ok());
+  CHECK_EQ(v5lite->num_chips, 16);
+  CHECK_EQ(v5lite->spec.family, "v5e");
+  auto v5p = slice::ParseAcceleratorType("v5p-128");
+  CHECK_TRUE(v5p.ok());
+  CHECK_EQ(v5p->num_chips, 64);
+  CHECK_TRUE(!slice::ParseAcceleratorType("v2-7").ok());
+  CHECK_TRUE(!slice::ParseAcceleratorType("x100-8").ok());
+
+  // Default topologies: must match Google's published shapes, including the
+  // ascending-with-1s-last convention ("2x2x1", not "1x2x2").
+  CHECK_EQ(slice::DefaultTopology(*slice::LookupFamily("v5e"), 16)
+               ->ToString(),
+           "4x4");
+  CHECK_EQ(slice::DefaultTopology(*slice::LookupFamily("v5e"), 8)
+               ->ToString(),
+           "2x4");
+  CHECK_EQ(slice::DefaultTopology(*slice::LookupFamily("v5e"), 1)
+               ->ToString(),
+           "1x1");
+  const slice::FamilySpec v4spec = *slice::LookupFamily("v4");
+  CHECK_EQ(slice::DefaultTopology(v4spec, 4)->ToString(), "2x2x1");
+  CHECK_EQ(slice::DefaultTopology(v4spec, 8)->ToString(), "2x2x2");
+  CHECK_EQ(slice::DefaultTopology(v4spec, 16)->ToString(), "2x2x4");
+  CHECK_EQ(slice::DefaultTopology(v4spec, 32)->ToString(), "2x4x4");
+  CHECK_EQ(slice::DefaultTopology(v4spec, 64)->ToString(), "4x4x4");
+  CHECK_EQ(slice::DefaultTopology(v4spec, 128)->ToString(), "4x4x8");
+  CHECK_EQ(slice::DefaultTopology(v4spec, 256)->ToString(), "4x8x8");
+  CHECK_EQ(slice::DefaultTopology(*slice::LookupFamily("v5p"), 64)
+               ->ToString(),
+           "4x4x4");
+}
+
+void TestDuration() {
+  CHECK_EQ(config::ParseDurationSeconds("60s").value(), 60);
+  CHECK_EQ(config::ParseDurationSeconds("1m30s").value(), 90);
+  CHECK_EQ(config::ParseDurationSeconds("2h").value(), 7200);
+  CHECK_EQ(config::ParseDurationSeconds("45").value(), 45);
+  CHECK_TRUE(!config::ParseDurationSeconds("abc").ok());
+}
+
+void TestConfigPrecedence() {
+  std::string config_path = WriteTemp(R"(
+version: v1
+flags:
+  oneshot: true
+  sliceStrategy: mixed
+  sleepInterval: 10s
+)");
+  // CLI wins over file; file fills the rest.
+  setenv("TFD_SLEEP_INTERVAL", "30s", 1);  // env wins over file
+  std::vector<std::string> args = {"tfd", "--slice-strategy=single",
+                                   "--config-file", config_path};
+  std::vector<char*> argv;
+  for (auto& a : args) argv.push_back(a.data());
+  auto loaded = config::Load(static_cast<int>(argv.size()), argv.data());
+  unsetenv("TFD_SLEEP_INTERVAL");
+  CHECK_TRUE(loaded.ok());
+  if (loaded.ok()) {
+    CHECK_EQ(loaded->config.flags.slice_strategy, "single");  // CLI
+    CHECK_EQ(loaded->config.flags.sleep_interval_s, 30);      // env
+    CHECK_EQ(loaded->config.flags.oneshot, true);             // file
+  }
+  remove(config_path.c_str());
+
+  // Invalid strategy rejected.
+  std::vector<std::string> bad = {"tfd", "--slice-strategy=bogus"};
+  std::vector<char*> badv;
+  for (auto& a : bad) badv.push_back(a.data());
+  CHECK_TRUE(!config::Load(static_cast<int>(badv.size()), badv.data()).ok());
+}
+
+config::Config MockedConfig(const std::string& fixture,
+                            const std::string& strategy) {
+  config::Config c;
+  c.flags.backend = "mock";
+  c.flags.mock_topology_file = WriteTemp(fixture);
+  c.flags.slice_strategy = strategy;
+  return c;
+}
+
+const char kV5e4Fixture[] = R"(
+libtpuVersion: 0.0.34
+runtimeVersion: "0.68"
+acceleratorType: v5litepod-4
+topology: 2x2
+chipsPerHost: 4
+numHosts: 1
+workerId: 0
+chips:
+- kind: TPU v5 lite
+  count: 4
+)";
+
+void TestResourceLabelsNone() {
+  config::Config c = MockedConfig(kV5e4Fixture, "none");
+  auto manager = resource::NewMockManager(c.flags.mock_topology_file);
+  CHECK_TRUE(manager.ok());
+  auto labeler = lm::NewTpuLabeler(*manager, c);
+  CHECK_TRUE(labeler.ok());
+  auto labels = (*labeler)->GetLabels();
+  CHECK_TRUE(labels.ok());
+  const lm::Labels& l = *labels;
+  CHECK_EQ(l.at("google.com/tpu.count"), "4");
+  CHECK_EQ(l.at("google.com/tpu.replicas"), "4");
+  CHECK_EQ(l.at("google.com/tpu.product"), "tpu-v5e");
+  CHECK_EQ(l.at("google.com/tpu.memory"), "16384");
+  CHECK_EQ(l.at("google.com/tpu.family"), "v5e");
+  CHECK_EQ(l.at("google.com/tpu.generation"), "5");
+  CHECK_EQ(l.at("google.com/tpu.cores"), "1");
+  CHECK_EQ(l.at("google.com/libtpu.version.major"), "0");
+  CHECK_EQ(l.at("google.com/libtpu.version.patch"), "34");
+  CHECK_EQ(l.at("google.com/tpu.runtime.major"), "0");
+  CHECK_EQ(l.at("google.com/tpu.runtime.minor"), "68");
+  CHECK_EQ(l.at("google.com/tpu.slice.capable"), "true");
+  CHECK_EQ(l.at("google.com/tpu.backend"), "mock");
+  CHECK_EQ(l.at("google.com/tpu.accelerator-type"), "v5litepod-4");
+  CHECK_EQ(l.at("google.com/tpu.topology"), "2x2");
+  // Strategy none: no slice strategy/shape labels.
+  CHECK_TRUE(l.find("google.com/tpu.slice.strategy") == l.end());
+  CHECK_TRUE(l.find("google.com/tpu.slice.shape") == l.end());
+  remove(c.flags.mock_topology_file.c_str());
+}
+
+void TestResourceLabelsSingle() {
+  config::Config c = MockedConfig(kV5e4Fixture, "single");
+  auto manager = resource::NewMockManager(c.flags.mock_topology_file);
+  CHECK_TRUE(manager.ok());
+  auto labeler = lm::NewTpuLabeler(*manager, c);
+  CHECK_TRUE(labeler.ok());
+  auto labels = (*labeler)->GetLabels();
+  CHECK_TRUE(labels.ok());
+  const lm::Labels& l = *labels;
+  CHECK_EQ(l.at("google.com/tpu.slice.strategy"), "single");
+  CHECK_EQ(l.at("google.com/tpu.slice.shape"), "2x2");
+  CHECK_EQ(l.at("google.com/tpu.slice.hosts"), "1");
+  CHECK_EQ(l.at("google.com/tpu.slice.chips-per-host"), "4");
+  CHECK_EQ(l.at("google.com/tpu.slice.worker-id"), "0");
+  CHECK_EQ(l.at("google.com/tpu.count"), "4");
+  remove(c.flags.mock_topology_file.c_str());
+}
+
+void TestResourceLabelsMixed() {
+  config::Config c = MockedConfig(kV5e4Fixture, "mixed");
+  auto manager = resource::NewMockManager(c.flags.mock_topology_file);
+  CHECK_TRUE(manager.ok());
+  auto labeler = lm::NewTpuLabeler(*manager, c);
+  CHECK_TRUE(labeler.ok());
+  auto labels = (*labeler)->GetLabels();
+  CHECK_TRUE(labels.ok());
+  const lm::Labels& l = *labels;
+  CHECK_EQ(l.at("google.com/tpu.slice.strategy"), "mixed");
+  CHECK_EQ(l.at("google.com/tpu-2x2.count"), "4");
+  CHECK_EQ(l.at("google.com/tpu-2x2.product"), "tpu-v5e-SLICE-2x2");
+  CHECK_EQ(l.at("google.com/tpu-2x2.memory"), "16384");
+  CHECK_EQ(l.at("google.com/tpu.count"), "4");  // whole-chip labels remain
+  remove(c.flags.mock_topology_file.c_str());
+}
+
+void TestInvalidSliceDegradation() {
+  // Topology says 4x4 (16 chips) but accelerator type is 4 chips → the
+  // single strategy must degrade to SLICE-INVALID, not fail (reference
+  // mig-strategy.go:243-262 analogue).
+  const char* fixture = R"(
+acceleratorType: v5litepod-4
+topology: 4x4
+chipsPerHost: 4
+numHosts: 1
+chips:
+- kind: TPU v5 lite
+  count: 4
+)";
+  config::Config c = MockedConfig(fixture, "single");
+  auto manager = resource::NewMockManager(c.flags.mock_topology_file);
+  CHECK_TRUE(manager.ok());
+  auto labeler = lm::NewTpuLabeler(*manager, c);
+  CHECK_TRUE(labeler.ok());
+  auto labels = (*labeler)->GetLabels();
+  CHECK_TRUE(labels.ok());
+  const lm::Labels& l = *labels;
+  CHECK_EQ(l.at("google.com/tpu.product"), "SLICE-INVALID");
+  CHECK_EQ(l.at("google.com/tpu.count"), "0");
+  CHECK_EQ(l.at("google.com/tpu.replicas"), "0");
+  CHECK_EQ(l.at("google.com/tpu.slice.shape"), "SLICE-INVALID");
+  remove(c.flags.mock_topology_file.c_str());
+}
+
+void TestSharing() {
+  config::Config c = MockedConfig(kV5e4Fixture, "none");
+  config::SharedResource shared;
+  shared.name = "google.com/tpu";
+  shared.replicas = 2;
+  c.sharing.time_slicing.push_back(shared);
+  auto manager = resource::NewMockManager(c.flags.mock_topology_file);
+  CHECK_TRUE(manager.ok());
+  auto labeler = lm::NewTpuLabeler(*manager, c);
+  CHECK_TRUE(labeler.ok());
+  auto labels = (*labeler)->GetLabels();
+  CHECK_TRUE(labels.ok());
+  CHECK_EQ(labels->at("google.com/tpu.replicas"), "8");
+  CHECK_EQ(labels->at("google.com/tpu.product"), "tpu-v5e-SHARED");
+
+  // Renamed resources do not get the -SHARED suffix (resource.go:182-226).
+  c.sharing.time_slicing[0].rename = "tpu-shared";
+  auto manager2 = resource::NewMockManager(c.flags.mock_topology_file);
+  auto labeler2 = lm::NewTpuLabeler(*manager2, c);
+  CHECK_TRUE(labeler2.ok());
+  auto labels2 = (*labeler2)->GetLabels();
+  CHECK_EQ(labels2->at("google.com/tpu.product"), "tpu-v5e");
+  CHECK_EQ(labels2->at("google.com/tpu.replicas"), "8");
+  remove(c.flags.mock_topology_file.c_str());
+}
+
+void TestFallbackDecorator() {
+  const char* fixture = R"(
+initError: simulated init failure
+chips:
+- kind: TPU v5 lite
+  count: 4
+)";
+  std::string path = WriteTemp(fixture);
+  auto inner = resource::NewMockManager(path);
+  CHECK_TRUE(inner.ok());
+  // Raw manager fails Init.
+  CHECK_TRUE(!(*inner)->Init().ok());
+  // Decorated manager degrades to null: Init OK, zero devices.
+  auto wrapped = resource::NewFallbackToNullOnInitError(*inner);
+  CHECK_TRUE(wrapped->Init().ok());
+  auto devices = wrapped->GetDevices();
+  CHECK_TRUE(devices.ok());
+  CHECK_EQ(static_cast<int>(devices->size()), 0);
+  CHECK_EQ(wrapped->Name(), "null");
+  remove(path.c_str());
+}
+
+void TestFallbackChain() {
+  std::string bad = WriteTemp(
+      "initError: chips busy\nchips:\n- kind: TPU v5 lite\n  count: 4\n");
+  std::string good = WriteTemp(kV5e4Fixture);
+  auto first = resource::NewMockManager(bad);
+  auto second = resource::NewMockManager(good);
+  CHECK_TRUE(first.ok());
+  CHECK_TRUE(second.ok());
+  auto chain = resource::NewFallbackChain({*first, *second});
+  CHECK_TRUE(chain->Init().ok());
+  auto devices = chain->GetDevices();
+  CHECK_TRUE(devices.ok());
+  CHECK_EQ(static_cast<int>(devices->size()), 4);
+
+  // All candidates failing → Init fails.
+  auto first2 = resource::NewMockManager(bad);
+  auto chain2 = resource::NewFallbackChain({*first2});
+  CHECK_TRUE(!chain2->Init().ok());
+  remove(bad.c_str());
+  remove(good.c_str());
+}
+
+void TestBoolParsing() {
+  // Empty env values must not silently mean true (TFD_ONESHOT= in a
+  // manifest is an operator mistake, not an opt-in).
+  setenv("TFD_ONESHOT", "", 1);
+  std::vector<std::string> args = {"tfd"};
+  std::vector<char*> argv;
+  for (auto& a : args) argv.push_back(a.data());
+  auto loaded = config::Load(static_cast<int>(argv.size()), argv.data());
+  unsetenv("TFD_ONESHOT");
+  CHECK_TRUE(!loaded.ok());
+}
+
+void TestTpuEnvParse() {
+  auto env = gce::ParseTpuEnv(
+      "ACCELERATOR_TYPE: 'v5p-128'\n"
+      "CHIPS_PER_HOST_BOUNDS: '2,2,1'\n"
+      "HOST_BOUNDS: '4,4,1'\n"
+      "WORKER_ID: '3'\n"
+      "ZONE: us-east5-a\n");
+  CHECK_EQ(env["ACCELERATOR_TYPE"], "v5p-128");
+  CHECK_EQ(env["CHIPS_PER_HOST_BOUNDS"], "2,2,1");
+  CHECK_EQ(env["WORKER_ID"], "3");
+  CHECK_EQ(env["ZONE"], "us-east5-a");
+}
+
+void TestLabelFormatting() {
+  lm::Labels labels;
+  labels["b"] = "2";
+  labels["a"] = "1";
+  CHECK_EQ(lm::FormatLabels(labels), "a=1\nb=2\n");  // sorted, deterministic
+}
+
+void TestAtomicWrite() {
+  std::string dir = "/tmp/tfd-unit-atomic-" + std::to_string(getpid());
+  std::string path = dir + "/labels";
+  CHECK_TRUE(WriteFileAtomically(path, "x=1\n").ok());
+  auto contents = ReadFile(path);
+  CHECK_TRUE(contents.ok());
+  CHECK_EQ(*contents, "x=1\n");
+  CHECK_TRUE(WriteFileAtomically(path, "x=2\n").ok());
+  CHECK_EQ(*ReadFile(path), "x=2\n");
+  std::string cmd = "rm -rf " + dir;
+  CHECK_TRUE(system(cmd.c_str()) == 0);
+}
+
+}  // namespace
+}  // namespace tfd
+
+int main() {
+  tfd::TestStrings();
+  tfd::TestYamlLite();
+  tfd::TestShapeGrammar();
+  tfd::TestFamilyTable();
+  tfd::TestDuration();
+  tfd::TestConfigPrecedence();
+  tfd::TestResourceLabelsNone();
+  tfd::TestResourceLabelsSingle();
+  tfd::TestResourceLabelsMixed();
+  tfd::TestInvalidSliceDegradation();
+  tfd::TestSharing();
+  tfd::TestFallbackDecorator();
+  tfd::TestFallbackChain();
+  tfd::TestBoolParsing();
+  tfd::TestTpuEnvParse();
+  tfd::TestLabelFormatting();
+  tfd::TestAtomicWrite();
+
+  std::cerr << tfd::g_checks << " checks, " << tfd::g_failures << " failures"
+            << std::endl;
+  return tfd::g_failures == 0 ? 0 : 1;
+}

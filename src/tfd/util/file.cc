@@ -1,0 +1,94 @@
+#include "tfd/util/file.h"
+
+#include <errno.h>
+#include <fcntl.h>
+#include <string.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <filesystem>
+#include <fstream>
+#include <sstream>
+
+namespace tfd {
+
+namespace fs = std::filesystem;
+
+Result<std::string> ReadFile(const std::string& path) {
+  std::ifstream in(path, std::ios::binary);
+  if (!in) {
+    return Result<std::string>::Error("unable to open " + path + ": " +
+                                      strerror(errno));
+  }
+  std::ostringstream buf;
+  buf << in.rdbuf();
+  return buf.str();
+}
+
+Status WriteFileAtomically(const std::string& path,
+                           const std::string& contents) {
+  fs::path dest(path);
+  fs::path dir = dest.parent_path();
+  if (dir.empty()) dir = ".";
+  fs::path tmpdir = dir / "tfd-tmp";
+
+  std::error_code ec;
+  fs::create_directories(tmpdir, ec);
+  if (ec) {
+    return Status::Error("unable to create scratch dir " + tmpdir.string() +
+                         ": " + ec.message());
+  }
+
+  std::string tmpl = (tmpdir / (dest.filename().string() + ".XXXXXX")).string();
+  // mkstemp needs a mutable buffer.
+  std::string tmppath = tmpl;
+  int fd = mkstemp(tmppath.data());
+  if (fd < 0) {
+    return Status::Error("unable to create temp file " + tmpl + ": " +
+                         strerror(errno));
+  }
+
+  size_t off = 0;
+  while (off < contents.size()) {
+    ssize_t n = write(fd, contents.data() + off, contents.size() - off);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      close(fd);
+      unlink(tmppath.c_str());
+      return Status::Error("write to " + tmppath + " failed: " +
+                           strerror(errno));
+    }
+    off += static_cast<size_t>(n);
+  }
+  // NFD reads the file as other pods do: make it world-readable like the
+  // reference's os.WriteFile(0644)-equivalent behavior.
+  fchmod(fd, 0644);
+  if (fsync(fd) != 0) {
+    close(fd);
+    unlink(tmppath.c_str());
+    return Status::Error("fsync " + tmppath + " failed: " + strerror(errno));
+  }
+  close(fd);
+
+  if (rename(tmppath.c_str(), path.c_str()) != 0) {
+    unlink(tmppath.c_str());
+    return Status::Error("rename " + tmppath + " -> " + path + " failed: " +
+                         strerror(errno));
+  }
+  return Status::Ok();
+}
+
+Status RemoveFileIfExists(const std::string& path) {
+  if (unlink(path.c_str()) != 0 && errno != ENOENT) {
+    return Status::Error("unable to remove " + path + ": " + strerror(errno));
+  }
+  return Status::Ok();
+}
+
+bool FileExists(const std::string& path) {
+  struct stat st;
+  return stat(path.c_str(), &st) == 0;
+}
+
+}  // namespace tfd

@@ -1,0 +1,30 @@
+// Filesystem helpers: atomic label-file writes and small reads.
+//
+// Mirrors the reference's atomic sink behavior (internal/lm/labels.go:92-138):
+// the label file is written into a scratch dir next to the destination and
+// moved into place with rename(2) so the NFD worker never observes a torn
+// file. Scratch dir name: "tfd-tmp" (reference uses "gfd-tmp").
+#pragma once
+
+#include <string>
+
+#include "tfd/util/status.h"
+
+namespace tfd {
+
+// Reads an entire file. Error if missing/unreadable.
+Result<std::string> ReadFile(const std::string& path);
+
+// Writes `contents` to `path` atomically: write to
+// <dir>/tfd-tmp/<base>.XXXXXX, fsync, then rename over `path`.
+// Creates parent directories of the scratch dir as needed.
+Status WriteFileAtomically(const std::string& path,
+                           const std::string& contents);
+
+// Removes a file if it exists (used for clean-exit label removal,
+// reference cmd/gpu-feature-discovery/main.go:220-240).
+Status RemoveFileIfExists(const std::string& path);
+
+bool FileExists(const std::string& path);
+
+}  // namespace tfd

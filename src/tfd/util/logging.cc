@@ -1,0 +1,31 @@
+#include "tfd/util/logging.h"
+
+#include <ctime>
+
+namespace tfd {
+namespace log {
+
+LogLine::~LogLine() {
+  char prefix = 'I';
+  switch (sev_) {
+    case Severity::kInfo:
+      prefix = 'I';
+      break;
+    case Severity::kWarning:
+      prefix = 'W';
+      break;
+    case Severity::kError:
+      prefix = 'E';
+      break;
+  }
+  std::time_t now = std::time(nullptr);
+  std::tm tm_buf{};
+  gmtime_r(&now, &tm_buf);
+  char ts[32];
+  std::strftime(ts, sizeof(ts), "%m%d %H:%M:%S", &tm_buf);
+  std::cerr << prefix << ts << " tpu-feature-discovery: " << stream_.str()
+            << std::endl;
+}
+
+}  // namespace log
+}  // namespace tfd

@@ -1,0 +1,39 @@
+// klog-style leveled logging to stderr.
+//
+// The reference logs through k8s.io/klog/v2 (cmd/gpu-feature-discovery/
+// main.go:20). We keep the same minimal surface: Info / Warning / Error with
+// printf-free streaming, timestamps, and a severity prefix that matches what
+// cluster operators grep for.
+#pragma once
+
+#include <iostream>
+#include <sstream>
+#include <string>
+
+namespace tfd {
+namespace log {
+
+enum class Severity { kInfo, kWarning, kError };
+
+class LogLine {
+ public:
+  explicit LogLine(Severity sev) : sev_(sev) {}
+  ~LogLine();
+
+  template <typename T>
+  LogLine& operator<<(const T& v) {
+    stream_ << v;
+    return *this;
+  }
+
+ private:
+  Severity sev_;
+  std::ostringstream stream_;
+};
+
+}  // namespace log
+}  // namespace tfd
+
+#define TFD_LOG_INFO ::tfd::log::LogLine(::tfd::log::Severity::kInfo)
+#define TFD_LOG_WARNING ::tfd::log::LogLine(::tfd::log::Severity::kWarning)
+#define TFD_LOG_ERROR ::tfd::log::LogLine(::tfd::log::Severity::kError)

@@ -1,0 +1,63 @@
+// Minimal Status / Result types for tpu-feature-discovery.
+//
+// The reference (gpu-feature-discovery) threads Go `error` values through
+// every layer (e.g. internal/lm/labeler.go:28-30 returns (Labels, error)).
+// The idiomatic C++ equivalent used throughout this codebase is a small
+// Status + Result<T> pair: no exceptions on the hot path, explicit
+// propagation, and cheap to inspect.
+#pragma once
+
+#include <optional>
+#include <string>
+#include <utility>
+
+namespace tfd {
+
+class Status {
+ public:
+  Status() = default;  // OK
+  static Status Ok() { return Status(); }
+  static Status Error(std::string msg) {
+    Status s;
+    s.msg_ = std::move(msg);
+    s.ok_ = false;
+    return s;
+  }
+
+  bool ok() const { return ok_; }
+  const std::string& message() const { return msg_; }
+
+ private:
+  bool ok_ = true;
+  std::string msg_;
+};
+
+// Result<T>: either a value or an error message. Like absl::StatusOr but
+// dependency-free.
+template <typename T>
+class Result {
+ public:
+  Result(T value) : value_(std::move(value)) {}  // NOLINT(google-explicit-constructor)
+  Result(Status status) : status_(std::move(status)) {}  // NOLINT
+
+  static Result<T> Error(std::string msg) {
+    return Result<T>(Status::Error(std::move(msg)));
+  }
+
+  bool ok() const { return value_.has_value(); }
+  const Status& status() const { return status_; }
+  const std::string& error() const { return status_.message(); }
+
+  T& value() { return *value_; }
+  const T& value() const { return *value_; }
+  T& operator*() { return *value_; }
+  const T& operator*() const { return *value_; }
+  T* operator->() { return &*value_; }
+  const T* operator->() const { return &*value_; }
+
+ private:
+  std::optional<T> value_;
+  Status status_ = Status::Error("uninitialized result");
+};
+
+}  // namespace tfd

@@ -1,0 +1,23 @@
+// Small string helpers shared across labelers and config parsing.
+#pragma once
+
+#include <string>
+#include <vector>
+
+namespace tfd {
+
+std::string TrimSpace(const std::string& s);
+std::vector<std::string> SplitString(const std::string& s, char sep);
+std::string JoinStrings(const std::vector<std::string>& parts,
+                        const std::string& sep);
+std::string ToLower(std::string s);
+bool HasPrefix(const std::string& s, const std::string& prefix);
+bool HasSuffix(const std::string& s, const std::string& suffix);
+// Replaces every occurrence of `from` with `to`.
+std::string ReplaceAll(std::string s, const std::string& from,
+                       const std::string& to);
+// Sanitizes a value for use in a k8s label value: [A-Za-z0-9._-] only,
+// spaces become dashes (reference: machine-type.go:38 replaces " "→"-").
+std::string SanitizeLabelValue(const std::string& s);
+
+}  // namespace tfd

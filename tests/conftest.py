@@ -1,0 +1,96 @@
+"""Shared pytest harness for tpu-feature-discovery.
+
+Tier map (SURVEY.md section 4):
+  tier 1 - C++ unit tests (build/tfd_unit_tests, run via test_unit_cpp.py)
+  tier 2 - process-level tests: run the real binary with the mock backend and
+           validate output against golden regex files (the checkResult
+           analogue, reference cmd/gpu-feature-discovery/main_test.go:403-435)
+  tier 3 - hermetic integration: fake GCE metadata server + metadata backend
+  (tier 4, real-cluster e2e, lives in deployments/ and is not run here)
+
+JAX-based tests (tpufd package) run on a virtual 8-device CPU mesh.
+"""
+
+import os
+import re
+import subprocess
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+BUILD_DIR = REPO / "build"
+BINARY = BUILD_DIR / "tpu-feature-discovery"
+UNIT_TESTS = BUILD_DIR / "tfd_unit_tests"
+FIXTURES = Path(__file__).resolve().parent / "fixtures"
+GOLDEN = Path(__file__).resolve().parent / "golden"
+
+# Virtual 8-device CPU mesh for sharding tests (the driver dry-runs
+# multi-chip separately via __graft_entry__.dryrun_multichip).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS",
+    (os.environ.get("XLA_FLAGS", "") +
+     " --xla_force_host_platform_device_count=8").strip(),
+)
+
+
+def _build():
+    subprocess.run(
+        ["cmake", "-S", str(REPO), "-B", str(BUILD_DIR), "-G", "Ninja"],
+        check=True, capture_output=True)
+    subprocess.run(["ninja", "-C", str(BUILD_DIR)], check=True,
+                   capture_output=True)
+
+
+@pytest.fixture(scope="session")
+def tfd_binary():
+    if not BINARY.exists() or not UNIT_TESTS.exists():
+        _build()
+    return BINARY
+
+
+@pytest.fixture(scope="session")
+def unit_test_binary():
+    if not UNIT_TESTS.exists():
+        _build()
+    return UNIT_TESTS
+
+
+def run_tfd(binary, args, env=None, timeout=60):
+    """Runs the binary; returns (exit_code, stdout, stderr)."""
+    full_env = dict(os.environ)
+    # Isolate from any real GCE metadata reachable from CI.
+    full_env.setdefault("GCE_METADATA_HOST", "invalid.localdomain:1")
+    if env:
+        full_env.update(env)
+    proc = subprocess.run(
+        [str(binary)] + args, capture_output=True, text=True,
+        timeout=timeout, env=full_env)
+    return proc.returncode, proc.stdout, proc.stderr
+
+
+def check_golden(output: str, golden_file: Path):
+    """Every output line must match one of the golden regexes, and every
+    golden regex must match at least one line (reference checkResult is
+    line→regex only; we additionally require full coverage so missing labels
+    fail)."""
+    regexes = [
+        line for line in golden_file.read_text().splitlines()
+        if line.strip() and not line.startswith("#")
+    ]
+    compiled = [re.compile("^" + r + "$") for r in regexes]
+    lines = [l for l in output.splitlines() if l.strip()]
+    unmatched_lines = [
+        l for l in lines if not any(c.match(l) for c in compiled)
+    ]
+    unmatched_regexes = [
+        r for r, c in zip(regexes, compiled)
+        if not any(c.match(l) for l in lines)
+    ]
+    assert not unmatched_lines, (
+        f"output lines not matched by any golden regex in "
+        f"{golden_file.name}: {unmatched_lines}")
+    assert not unmatched_regexes, (
+        f"golden regexes with no matching output line in "
+        f"{golden_file.name}: {unmatched_regexes}")

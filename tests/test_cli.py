@@ -1,0 +1,222 @@
+"""Tier 2: process-level tests of the real binary with the mock backend.
+
+Mirrors the reference's in-process run() tests
+(cmd/gpu-feature-discovery/main_test.go): oneshot against golden regex
+files, no-timestamp, the sleep-loop rewrite behavior (file mtime advances,
+timestamp label constant, main_test.go:184-271), the init-error x
+fail-on-init-error matrix (main_test.go:273-380), and output-file cleanup.
+"""
+
+import os
+import signal
+import subprocess
+import time
+from pathlib import Path
+
+import pytest
+
+from conftest import FIXTURES, GOLDEN, check_golden, run_tfd
+
+
+def oneshot_args(extra):
+    return ["--oneshot", "--output-file="] + extra
+
+
+def test_cpu_only_node(tfd_binary):
+    """BASELINE config 1: no TPU stack -> machine-type labels only, exit 0."""
+    code, out, _ = run_tfd(tfd_binary, oneshot_args(
+        ["--fail-on-init-error=false", "--backend=null",
+         "--machine-type-file=/dev/null"]))
+    assert code == 0
+    check_golden(out, GOLDEN / "expected-output-tpu-cpu.txt")
+
+
+def test_v2_8_none(tfd_binary):
+    """BASELINE config 2: v2-8, whole-chip labels."""
+    code, out, _ = run_tfd(tfd_binary, oneshot_args(
+        ["--backend=mock",
+         f"--mock-topology-file={FIXTURES / 'v2-8.yaml'}",
+         "--machine-type-file=/dev/null"]))
+    assert code == 0
+    check_golden(out, GOLDEN / "expected-output-tpu-v2-8.txt")
+
+
+def test_v5e_4_single(tfd_binary):
+    """BASELINE config 3: v5e-4, slice-strategy=single."""
+    code, out, _ = run_tfd(tfd_binary, oneshot_args(
+        ["--backend=mock", "--slice-strategy=single",
+         f"--mock-topology-file={FIXTURES / 'v5e-4.yaml'}",
+         "--machine-type-file=/dev/null"]))
+    assert code == 0
+    check_golden(out, GOLDEN / "expected-output-tpu-v5e-4-single.txt")
+
+
+def test_v5p_128_mixed(tfd_binary):
+    """BASELINE config 4: v5p-128 host, slice-strategy=mixed."""
+    code, out, _ = run_tfd(tfd_binary, oneshot_args(
+        ["--backend=mock", "--slice-strategy=mixed",
+         f"--mock-topology-file={FIXTURES / 'v5p-128-worker3.yaml'}",
+         "--machine-type-file=/dev/null"]))
+    assert code == 0
+    check_golden(out, GOLDEN / "expected-output-tpu-v5p-128-mixed.txt")
+
+
+def test_no_timestamp(tfd_binary):
+    code, out, _ = run_tfd(tfd_binary, oneshot_args(
+        ["--no-timestamp", "--backend=mock",
+         f"--mock-topology-file={FIXTURES / 'v2-8.yaml'}",
+         "--machine-type-file=/dev/null"]))
+    assert code == 0
+    assert "tfd.timestamp" not in out
+    assert "google.com/tpu.count=4" in out
+
+
+def test_machine_type_from_file(tfd_binary, tmp_path):
+    mt = tmp_path / "machine-type"
+    mt.write_text("Google Compute Engine\n")
+    code, out, _ = run_tfd(tfd_binary, oneshot_args(
+        ["--backend=null", f"--machine-type-file={mt}"]))
+    assert code == 0
+    assert "google.com/tpu.machine=Google-Compute-Engine" in out
+
+
+def test_env_var_config(tfd_binary):
+    """Flags also come from TFD_* env vars (precedence CLI > env)."""
+    code, out, _ = run_tfd(
+        tfd_binary, ["--oneshot", "--output-file="],
+        env={
+            "TFD_BACKEND": "mock",
+            "TFD_MOCK_TOPOLOGY_FILE": str(FIXTURES / "v5e-4.yaml"),
+            "TFD_SLICE_STRATEGY": "single",
+            "TFD_MACHINE_TYPE_FILE": "/dev/null",
+        })
+    assert code == 0
+    assert "google.com/tpu.slice.strategy=single" in out
+
+
+def test_config_file(tfd_binary, tmp_path):
+    cfg = tmp_path / "config.yaml"
+    cfg.write_text(
+        "version: v1\n"
+        "flags:\n"
+        "  oneshot: true\n"
+        "  outputFile: \"\"\n"
+        "  backend: mock\n"
+        f"  mockTopologyFile: {FIXTURES / 'v5e-4.yaml'}\n"
+        "  machineTypeFile: /dev/null\n"
+        "sharing:\n"
+        "  timeSlicing:\n"
+        "    resources:\n"
+        "    - name: google.com/tpu\n"
+        "      replicas: 4\n")
+    code, out, _ = run_tfd(tfd_binary, [f"--config-file={cfg}"])
+    assert code == 0
+    assert "google.com/tpu.replicas=16" in out
+    assert "google.com/tpu.product=tpu-v5e-SHARED" in out
+
+
+@pytest.mark.parametrize("fail_on_init,expect_code,expect_labels", [
+    ("true", 1, False),   # init error surfaces as failure
+    ("false", 0, True),   # degrades to machine-type-only labels
+])
+def test_init_error_matrix(tfd_binary, fail_on_init, expect_code,
+                           expect_labels):
+    code, out, err = run_tfd(tfd_binary, oneshot_args(
+        [f"--fail-on-init-error={fail_on_init}", "--backend=mock",
+         f"--mock-topology-file={FIXTURES / 'init-error.yaml'}",
+         "--machine-type-file=/dev/null"]))
+    assert code == expect_code, err
+    if expect_labels:
+        assert "google.com/tpu.machine=" in out
+        assert "google.com/tpu.count" not in out
+
+
+def test_sleep_loop_rewrites_and_cleanup(tfd_binary, tmp_path):
+    """Sleep-loop: the output file is rewritten every interval with its
+    mtime advancing but the timestamp label constant; SIGTERM removes the
+    file (reference main_test.go:184-271 and main.go:220-240)."""
+    out_file = tmp_path / "tfd"
+    env = dict(os.environ)
+    env.setdefault("GCE_METADATA_HOST", "invalid.localdomain:1")
+    proc = subprocess.Popen(
+        [str(tfd_binary), "--sleep-interval=1s", "--backend=mock",
+         f"--mock-topology-file={FIXTURES / 'v2-8.yaml'}",
+         "--machine-type-file=/dev/null",
+         f"--output-file={out_file}"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    try:
+        deadline = time.time() + 10
+        while not out_file.exists() and time.time() < deadline:
+            time.sleep(0.05)
+        assert out_file.exists(), "label file never appeared"
+        first = out_file.read_text()
+        first_mtime = out_file.stat().st_mtime_ns
+
+        # Wait for at least one rewrite.
+        deadline = time.time() + 10
+        while (out_file.stat().st_mtime_ns == first_mtime
+               and time.time() < deadline):
+            time.sleep(0.1)
+        assert out_file.stat().st_mtime_ns > first_mtime, "no rewrite seen"
+        second = out_file.read_text()
+        assert first == second  # content (incl. timestamp label) stable
+
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=10)
+        assert proc.returncode == 0
+        assert not out_file.exists(), "output file not cleaned up on exit"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
+def test_sighup_reload(tfd_binary, tmp_path):
+    """SIGHUP reloads config and restarts labeling with a fresh timestamp
+    (reference main.go:150-152,207-211)."""
+    out_file = tmp_path / "tfd"
+    env = dict(os.environ)
+    env.setdefault("GCE_METADATA_HOST", "invalid.localdomain:1")
+    proc = subprocess.Popen(
+        [str(tfd_binary), "--sleep-interval=60s", "--backend=mock",
+         f"--mock-topology-file={FIXTURES / 'v2-8.yaml'}",
+         "--machine-type-file=/dev/null",
+         f"--output-file={out_file}"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    try:
+        deadline = time.time() + 10
+        while not out_file.exists() and time.time() < deadline:
+            time.sleep(0.05)
+        assert out_file.exists()
+        proc.send_signal(signal.SIGHUP)
+        # After reload the file must reappear (remove+rewrite).
+        time.sleep(1.0)
+        deadline = time.time() + 10
+        while not out_file.exists() and time.time() < deadline:
+            time.sleep(0.05)
+        assert out_file.exists(), "label file not rewritten after SIGHUP"
+        assert proc.poll() is None, "daemon exited on SIGHUP"
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=10)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
+def test_version_flag(tfd_binary):
+    code, out, _ = run_tfd(tfd_binary, ["--version"])
+    assert code == 0
+    assert "tpu-feature-discovery" in out
+
+
+def test_help_flag(tfd_binary):
+    code, out, _ = run_tfd(tfd_binary, ["--help"])
+    assert code == 0
+    assert "--slice-strategy" in out
+
+
+def test_unknown_flag_rejected(tfd_binary):
+    code, _, err = run_tfd(tfd_binary, ["--bogus-flag"])
+    assert code == 1
+    assert "unknown flag" in err

@@ -1,0 +1,10 @@
+"""Tier 1: runs the C++ unit-test binary (src/tfd/tests/unit_tests.cc)."""
+
+import subprocess
+
+
+def test_cpp_unit_suite(unit_test_binary):
+    proc = subprocess.run([str(unit_test_binary)], capture_output=True,
+                          text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "0 failures" in proc.stderr

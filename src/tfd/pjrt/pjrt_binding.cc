@@ -1,0 +1,80 @@
+#include "tfd/pjrt/pjrt_binding.h"
+
+#include <dlfcn.h>
+
+#include "tfd/platform/detect.h"
+#include "tfd/util/logging.h"
+
+namespace tfd {
+namespace pjrt {
+
+Result<std::shared_ptr<PjrtLibrary>> PjrtLibrary::Load(
+    const std::string& override_path) {
+  void* handle = nullptr;
+  std::string loaded_path;
+  std::string attempts;
+  for (const std::string& path : platform::LibtpuSearchPaths(override_path)) {
+    // RTLD_NOW surfaces missing-symbol problems at load time; RTLD_LOCAL
+    // keeps libtpu's symbols out of the global namespace (mirrors the
+    // reference's dlopen flags choice, internal/cuda/api.go:33-43).
+    handle = dlopen(path.c_str(), RTLD_NOW | RTLD_LOCAL);
+    if (handle != nullptr) {
+      loaded_path = path;
+      break;
+    }
+    if (!attempts.empty()) attempts += "; ";
+    attempts += path + ": " + dlerror();
+  }
+  if (handle == nullptr) {
+    return Result<std::shared_ptr<PjrtLibrary>>::Error(
+        "unable to load libtpu.so (" + attempts + ")");
+  }
+
+  using GetPjrtApiFn = const PJRT_Api* (*)();
+  auto get_api =
+      reinterpret_cast<GetPjrtApiFn>(dlsym(handle, "GetPjrtApi"));
+  if (get_api == nullptr) {
+    dlclose(handle);
+    return Result<std::shared_ptr<PjrtLibrary>>::Error(
+        loaded_path + " does not export GetPjrtApi: " + dlerror());
+  }
+  const PJRT_Api* api = get_api();
+  if (api == nullptr) {
+    dlclose(handle);
+    return Result<std::shared_ptr<PjrtLibrary>>::Error(
+        loaded_path + ": GetPjrtApi() returned null");
+  }
+  // The calls this binding makes end at PJRT_Device_MemoryStats; an older
+  // plugin with a smaller struct would hand us garbage function pointers.
+  if (api->struct_size < PJRT_STRUCT_SIZE(PJRT_Api, PJRT_Device_MemoryStats)) {
+    dlclose(handle);
+    return Result<std::shared_ptr<PjrtLibrary>>::Error(
+        loaded_path + ": PJRT_Api struct too small (" +
+        std::to_string(api->struct_size) + "); plugin too old");
+  }
+  TFD_LOG_INFO << "loaded " << loaded_path << " (PJRT C API v"
+               << api->pjrt_api_version.major_version << "."
+               << api->pjrt_api_version.minor_version << ")";
+  return std::shared_ptr<PjrtLibrary>(
+      new PjrtLibrary(handle, api, loaded_path));
+}
+
+PjrtLibrary::~PjrtLibrary() {
+  if (handle_ != nullptr) dlclose(handle_);
+}
+
+Status PjrtLibrary::ToStatus(PJRT_Error* error,
+                             const std::string& context) const {
+  if (error == nullptr) return Status::Ok();
+  auto msg_args = TFD_PJRT_ARGS(PJRT_Error_Message_Args);
+  msg_args.error = error;
+  api_->PJRT_Error_Message(&msg_args);
+  std::string message(msg_args.message, msg_args.message_size);
+  auto destroy_args = TFD_PJRT_ARGS(PJRT_Error_Destroy_Args);
+  destroy_args.error = error;
+  api_->PJRT_Error_Destroy(&destroy_args);
+  return Status::Error(context + ": " + message);
+}
+
+}  // namespace pjrt
+}  // namespace tfd

@@ -1,0 +1,62 @@
+// PJRT C-API loader: dlopen(libtpu.so) + GetPjrtApi(), with RAII and
+// error-to-Status plumbing.
+//
+// This is the TPU replacement for the reference's cgo dlopen bindings
+// (internal/cuda/api.go:23-55 dlopens libcuda.so.1 and checks symbols;
+// vendored go-nvml does the same for libnvidia-ml.so.1). Same contract:
+// the shipped binary has ZERO link-time TPU dependencies — libtpu.so is
+// resolved at runtime and its absence is a graceful condition, not an error.
+#pragma once
+
+#include <memory>
+#include <string>
+
+#include "tfd/util/status.h"
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+namespace tfd {
+namespace pjrt {
+
+// Initializes a PJRT arg struct: zero + struct_size (the C API's calling
+// convention for forward/backward compatibility).
+template <typename T>
+T MakeArgs(size_t size) {
+  T args = {};
+  args.struct_size = size;
+  return args;
+}
+
+// Always size args with the header's <type>_STRUCT_SIZE trait (the full
+// struct through its last field) — plugins validate struct_size against
+// their own build and reject short structs.
+#define TFD_PJRT_ARGS(type) ::tfd::pjrt::MakeArgs<type>(type##_STRUCT_SIZE)
+
+class PjrtLibrary {
+ public:
+  // Dlopens libtpu.so (searching tfd::platform::LibtpuSearchPaths) and
+  // resolves GetPjrtApi. Fails cleanly when the library or symbol is absent
+  // or the reported struct_size is too small for the calls we make.
+  static Result<std::shared_ptr<PjrtLibrary>> Load(
+      const std::string& override_path);
+
+  ~PjrtLibrary();
+  PjrtLibrary(const PjrtLibrary&) = delete;
+  PjrtLibrary& operator=(const PjrtLibrary&) = delete;
+
+  const PJRT_Api* api() const { return api_; }
+  const std::string& path() const { return path_; }
+
+  // Converts a PJRT_Error (may be null) into a Status, destroying the error.
+  Status ToStatus(PJRT_Error* error, const std::string& context) const;
+
+ private:
+  PjrtLibrary(void* handle, const PJRT_Api* api, std::string path)
+      : handle_(handle), api_(api), path_(std::move(path)) {}
+
+  void* handle_;
+  const PJRT_Api* api_;
+  std::string path_;
+};
+
+}  // namespace pjrt
+}  // namespace tfd

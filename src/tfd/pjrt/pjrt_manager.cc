@@ -1,51 +1,398 @@
-// PJRT (libtpu) backend — native binding over the PJRT C API via dlopen.
+// PJRT (libtpu) backend — the primary hardware backend.
 //
-// Replaces the reference's NVML backend (internal/resource/nvml-lib.go,
-// nvml-device.go) and its cgo dlopen binding (internal/cuda/api.go:23-55):
-// the binary links with zero TPU dependencies and resolves libtpu.so at
-// runtime, degrading gracefully when absent.
+// Replaces the reference's NVML backend (internal/resource/nvml-lib.go:30-97,
+// nvml-device.go:26-88) with the TPU-native equivalent: a PJRT client over a
+// dlopen'd libtpu.so. Mapping:
+//   nvmlInit / nvmlShutdown        → PJRT_Plugin_Initialize + Client_Create /
+//                                    Client_Destroy
+//   DeviceGetCount / handles       → PJRT_Client_AddressableDevices
+//   device name                    → PJRT_DeviceDescription_Kind
+//   memory info                    → PJRT_Device_MemoryStats bytes_limit
+//                                    (family-table fallback when unset)
+//   driver version                 → libtpu version (platform version /
+//                                    plugin attributes)
+//   CUDA driver version            → PJRT C API version (major.minor)
+//   per-device attributes          → PJRT_DeviceDescription_Attributes
+//                                    ("coords", "core_on_chip", ...)
 //
-// NOTE: placeholder implementation — the full PJRT C-API binding lands in
-// tfd/pjrt/pjrt_binding.{h,cc}. Init() currently reports unimplemented so
-// the fallback decorator and factory paths are exercised end-to-end.
+// TPU specifics the NVML model doesn't have:
+//   - PJRT devices are TensorCores on v2/v3 (2 per chip) but chips on
+//     v4/v5e/v5p/v6e (megacore / single-core). Chips are identified by the
+//     unique "coords" attribute; per-chip HBM is the sum of its core
+//     devices' bytes_limit.
+//   - PJRT_Client_Devices lists the *whole slice* (all hosts), which gives
+//     the slice topology (max coord + 1 per axis) and host count (max
+//     process_index + 1) with no extra metadata source.
+#include <algorithm>
+#include <map>
+#include <set>
+
+#include "tfd/pjrt/pjrt_binding.h"
 #include "tfd/resource/factory.h"
+#include "tfd/slice/topology.h"
+#include "tfd/util/logging.h"
+#include "tfd/util/strings.h"
 
 namespace tfd {
 namespace resource {
 
 namespace {
 
-class PjrtManagerStub : public Manager {
+// An eagerly-materialized chip (safe to use after Shutdown).
+class PjrtChip : public Device {
  public:
-  explicit PjrtManagerStub(std::string libtpu_path)
+  PjrtChip(std::string kind, slice::FamilySpec spec, long long memory_mib)
+      : kind_(std::move(kind)), spec_(std::move(spec)),
+        memory_mib_(memory_mib) {}
+
+  Result<std::string> GetKind() override { return kind_; }
+  Result<std::string> GetProduct() override { return spec_.product; }
+  Result<long long> GetTotalMemoryMiB() override { return memory_mib_; }
+  Result<int> GetCoreCount() override { return spec_.cores_per_chip; }
+  Result<int> GetGeneration() override { return spec_.generation; }
+
+ private:
+  std::string kind_;
+  slice::FamilySpec spec_;
+  long long memory_mib_;
+};
+
+// Extracts the first dotted numeric token ("0.0.34", "2.17") from a version
+// blob like "libtpu v0.0.34\nBuilt on ...".
+std::string ExtractDottedVersion(const std::string& text) {
+  for (size_t i = 0; i < text.size(); i++) {
+    if (!isdigit(static_cast<unsigned char>(text[i]))) continue;
+    if (i > 0 && (isalnum(static_cast<unsigned char>(text[i - 1])) ||
+                  text[i - 1] == '.')) {
+      continue;  // inside a word like "v5e" or "sha256"
+    }
+    size_t j = i;
+    int dots = 0;
+    while (j < text.size() &&
+           (isdigit(static_cast<unsigned char>(text[j])) || text[j] == '.')) {
+      if (text[j] == '.') dots++;
+      j++;
+    }
+    if (dots >= 1 && text[j - 1] != '.') return text.substr(i, j - i);
+    i = j;
+  }
+  return "";
+}
+
+class PjrtManager : public Manager {
+ public:
+  explicit PjrtManager(std::string libtpu_path)
       : libtpu_path_(std::move(libtpu_path)) {}
 
+  ~PjrtManager() override { Shutdown(); }
+
   Status Init() override {
-    return Status::Error("PJRT backend not yet implemented");
+    Result<std::shared_ptr<pjrt::PjrtLibrary>> lib =
+        pjrt::PjrtLibrary::Load(libtpu_path_);
+    if (!lib.ok()) return lib.status();
+    lib_ = *lib;
+    const PJRT_Api* api = lib_->api();
+
+    if (api->PJRT_Plugin_Initialize != nullptr) {
+      auto args =
+          TFD_PJRT_ARGS(PJRT_Plugin_Initialize_Args);
+      Status s = lib_->ToStatus(api->PJRT_Plugin_Initialize(&args),
+                                "PJRT_Plugin_Initialize");
+      if (!s.ok()) {
+        lib_.reset();
+        return s;
+      }
+    }
+
+    auto create = TFD_PJRT_ARGS(PJRT_Client_Create_Args);
+    Status s = lib_->ToStatus(api->PJRT_Client_Create(&create),
+                              "PJRT_Client_Create");
+    if (!s.ok()) {
+      lib_.reset();
+      return s;
+    }
+    client_ = create.client;
+
+    // Materialize everything eagerly while the client is alive (the
+    // reference computes all labels between Init and Shutdown too).
+    s = Snapshot();
+    if (!s.ok()) {
+      Shutdown();
+      return s;
+    }
+    return Status::Ok();
   }
-  void Shutdown() override {}
+
+  void Shutdown() override {
+    if (client_ != nullptr && lib_ != nullptr) {
+      auto args = TFD_PJRT_ARGS(PJRT_Client_Destroy_Args);
+      args.client = client_;
+      Status s = lib_->ToStatus(lib_->api()->PJRT_Client_Destroy(&args),
+                                "PJRT_Client_Destroy");
+      if (!s.ok()) TFD_LOG_WARNING << s.message();
+    }
+    client_ = nullptr;
+    lib_.reset();
+  }
+
   Result<std::vector<DevicePtr>> GetDevices() override {
-    return Result<std::vector<DevicePtr>>::Error("PJRT backend not initialized");
+    if (!snapshot_valid_) {
+      return Result<std::vector<DevicePtr>>::Error(
+          "PJRT backend not initialized");
+    }
+    return devices_;
   }
+
   Result<std::string> GetLibtpuVersion() override {
-    return Result<std::string>::Error("PJRT backend not initialized");
+    if (libtpu_version_.empty()) {
+      return Result<std::string>::Error(
+          "libtpu version not reported by the PJRT plugin");
+    }
+    return libtpu_version_;
   }
+
   Result<std::string> GetRuntimeVersion() override {
-    return Result<std::string>::Error("PJRT backend not initialized");
+    if (!snapshot_valid_) {
+      return Result<std::string>::Error("PJRT backend not initialized");
+    }
+    return runtime_version_;
   }
+
   Result<TopologyInfo> GetTopology() override {
-    return Result<TopologyInfo>::Error("PJRT backend not initialized");
+    if (!snapshot_valid_) {
+      return Result<TopologyInfo>::Error("PJRT backend not initialized");
+    }
+    return topology_;
   }
+
   std::string Name() const override { return "pjrt"; }
 
  private:
+  struct DeviceDesc {
+    std::string kind;
+    int process_index = 0;
+    std::vector<long long> coords;
+    bool addressable = false;
+    long long bytes_limit = 0;
+  };
+
+  // Reads one device's description (+memory stats if addressable).
+  Result<DeviceDesc> Describe(PJRT_Device* device, bool addressable) {
+    const PJRT_Api* api = lib_->api();
+    DeviceDesc out;
+    out.addressable = addressable;
+
+    auto get_desc = TFD_PJRT_ARGS(PJRT_Device_GetDescription_Args);
+    get_desc.device = device;
+    Status s = lib_->ToStatus(api->PJRT_Device_GetDescription(&get_desc),
+                              "PJRT_Device_GetDescription");
+    if (!s.ok()) return Result<DeviceDesc>::Error(s.message());
+    PJRT_DeviceDescription* desc = get_desc.device_description;
+
+    auto kind = TFD_PJRT_ARGS(PJRT_DeviceDescription_Kind_Args);
+    kind.device_description = desc;
+    s = lib_->ToStatus(api->PJRT_DeviceDescription_Kind(&kind),
+                       "PJRT_DeviceDescription_Kind");
+    if (!s.ok()) return Result<DeviceDesc>::Error(s.message());
+    out.kind = std::string(kind.device_kind, kind.device_kind_size);
+
+    auto proc = TFD_PJRT_ARGS(PJRT_DeviceDescription_ProcessIndex_Args);
+    proc.device_description = desc;
+    s = lib_->ToStatus(api->PJRT_DeviceDescription_ProcessIndex(&proc),
+                       "PJRT_DeviceDescription_ProcessIndex");
+    if (!s.ok()) return Result<DeviceDesc>::Error(s.message());
+    out.process_index = proc.process_index;
+
+    auto attrs = TFD_PJRT_ARGS(PJRT_DeviceDescription_Attributes_Args);
+    attrs.device_description = desc;
+    s = lib_->ToStatus(api->PJRT_DeviceDescription_Attributes(&attrs),
+                       "PJRT_DeviceDescription_Attributes");
+    if (!s.ok()) return Result<DeviceDesc>::Error(s.message());
+    for (size_t i = 0; i < attrs.num_attributes; i++) {
+      const PJRT_NamedValue& nv = attrs.attributes[i];
+      std::string name(nv.name, nv.name_size);
+      if (name == "coords" && nv.type == PJRT_NamedValue_kInt64List) {
+        out.coords.assign(nv.int64_array_value,
+                          nv.int64_array_value + nv.value_size);
+      }
+    }
+
+    if (addressable && api->PJRT_Device_MemoryStats != nullptr) {
+      auto stats = TFD_PJRT_ARGS(PJRT_Device_MemoryStats_Args);
+      stats.device = device;
+      // Memory stats are diagnostic and optionally implemented; ignore
+      // failure and fall back to the family table.
+      PJRT_Error* err = api->PJRT_Device_MemoryStats(&stats);
+      if (err == nullptr && stats.bytes_limit_is_set) {
+        out.bytes_limit = stats.bytes_limit;
+      } else if (err != nullptr) {
+        (void)lib_->ToStatus(err, "PJRT_Device_MemoryStats");
+      }
+    }
+    return out;
+  }
+
+  Status Snapshot() {
+    const PJRT_Api* api = lib_->api();
+
+    runtime_version_ =
+        std::to_string(api->pjrt_api_version.major_version) + "." +
+        std::to_string(api->pjrt_api_version.minor_version);
+
+    // libtpu version: scan the platform-version blob, then plugin
+    // attributes, for a dotted numeric (driver-version-probe analogue,
+    // reference nvml-lib.go:39-51).
+    auto pv = TFD_PJRT_ARGS(PJRT_Client_PlatformVersion_Args);
+    pv.client = client_;
+    if (lib_->ToStatus(api->PJRT_Client_PlatformVersion(&pv),
+                       "PJRT_Client_PlatformVersion")
+            .ok()) {
+      libtpu_version_ = ExtractDottedVersion(
+          std::string(pv.platform_version, pv.platform_version_size));
+    }
+    if (libtpu_version_.empty() && api->PJRT_Plugin_Attributes != nullptr) {
+      auto pa = TFD_PJRT_ARGS(PJRT_Plugin_Attributes_Args);
+      if (lib_->ToStatus(api->PJRT_Plugin_Attributes(&pa),
+                         "PJRT_Plugin_Attributes")
+              .ok()) {
+        for (size_t i = 0; i < pa.num_attributes; i++) {
+          const PJRT_NamedValue& nv = pa.attributes[i];
+          std::string name(nv.name, nv.name_size);
+          if (nv.type == PJRT_NamedValue_kString &&
+              name.find("version") != std::string::npos) {
+            std::string v = ExtractDottedVersion(
+                std::string(nv.string_value, nv.value_size));
+            if (!v.empty()) {
+              libtpu_version_ = v;
+              break;
+            }
+          }
+        }
+      }
+    }
+
+    auto local = TFD_PJRT_ARGS(PJRT_Client_AddressableDevices_Args);
+    local.client = client_;
+    Status s = lib_->ToStatus(api->PJRT_Client_AddressableDevices(&local),
+                              "PJRT_Client_AddressableDevices");
+    if (!s.ok()) return s;
+
+    auto global = TFD_PJRT_ARGS(PJRT_Client_Devices_Args);
+    global.client = client_;
+    s = lib_->ToStatus(api->PJRT_Client_Devices(&global),
+                       "PJRT_Client_Devices");
+    if (!s.ok()) return s;
+
+    std::set<PJRT_Device*> local_set(
+        local.addressable_devices,
+        local.addressable_devices + local.num_addressable_devices);
+
+    // Group addressable core-devices into chips by coords; track global
+    // topology bounds and host count.
+    std::map<std::vector<long long>, std::vector<DeviceDesc>> local_chips;
+    std::set<std::vector<long long>> global_chips;
+    std::vector<long long> bounds;
+    int max_process = 0;
+    std::string kind;
+    int device_ordinal = 0;
+    for (size_t i = 0; i < global.num_devices; i++) {
+      PJRT_Device* dev = global.devices[i];
+      Result<DeviceDesc> desc =
+          Describe(dev, local_set.count(dev) > 0);
+      if (!desc.ok()) return Status::Error(desc.error());
+      if (kind.empty()) kind = desc->kind;
+      max_process = std::max(max_process, desc->process_index);
+      std::vector<long long> coords = desc->coords;
+      if (coords.empty()) {
+        // No coords attribute (non-TPU or simulator): one chip per device.
+        coords = {device_ordinal};
+      }
+      device_ordinal++;
+      for (size_t d = 0; d < coords.size(); d++) {
+        if (bounds.size() <= d) bounds.resize(d + 1, 0);
+        bounds[d] = std::max(bounds[d], coords[d] + 1);
+      }
+      global_chips.insert(coords);
+      if (desc->addressable) local_chips[coords].push_back(*desc);
+    }
+    if (local_chips.empty()) {
+      return Status::Error("PJRT client reports no addressable TPU devices");
+    }
+
+    Result<slice::FamilySpec> family = slice::FamilyFromDeviceKind(kind);
+    if (!family.ok()) {
+      TFD_LOG_WARNING << family.error()
+                      << "; falling back to generic attributes";
+    }
+
+    for (const auto& [coords, cores] : local_chips) {
+      long long chip_bytes = 0;
+      for (const DeviceDesc& core : cores) chip_bytes += core.bytes_limit;
+      long long memory_mib = chip_bytes > 0
+                                 ? chip_bytes / (1024 * 1024)
+                                 : (family.ok() ? family->hbm_mib : 0);
+      slice::FamilySpec spec =
+          family.ok() ? *family
+                      : slice::FamilySpec{"unknown", "tpu-unknown", 0,
+                                          memory_mib, 1, 0, 0, false, 0};
+      devices_.push_back(
+          std::make_shared<PjrtChip>(kind, spec, memory_mib));
+    }
+
+    topology_.chips_per_host = static_cast<int>(local_chips.size());
+    topology_.num_hosts = max_process + 1;
+    auto proc = TFD_PJRT_ARGS(PJRT_Client_ProcessIndex_Args);
+    proc.client = client_;
+    if (lib_->ToStatus(api->PJRT_Client_ProcessIndex(&proc),
+                       "PJRT_Client_ProcessIndex")
+            .ok()) {
+      topology_.worker_id = proc.process_index;
+    }
+    // Topology string from coord bounds. TPU coords are (x, y, z); 2D
+    // families (v2/v3/v5e/v6e) publish AxB with the z axis dropped when 1.
+    if (!bounds.empty() && !global_chips.empty()) {
+      std::vector<long long> dims = bounds;
+      if (family.ok() && family->topology_dims == 2 && dims.size() == 3 &&
+          dims[2] == 1) {
+        dims.pop_back();
+      }
+      long long shape_chips = 1;
+      std::vector<std::string> parts;
+      for (long long d : dims) {
+        shape_chips *= d;
+        parts.push_back(std::to_string(d));
+      }
+      // Only trust the bounds when the chips fill the box (a dense torus);
+      // sparse coords would fabricate a too-large topology.
+      if (shape_chips == static_cast<long long>(global_chips.size()) &&
+          dims.size() >= 2) {
+        topology_.topology = JoinStrings(parts, "x");
+      }
+    }
+    topology_.has_wraparound =
+        family.ok() && family->topology_dims == 3 &&
+        family->wrap_min_chips > 0 &&
+        static_cast<int>(global_chips.size()) >= family->wrap_min_chips;
+
+    snapshot_valid_ = true;
+    return Status::Ok();
+  }
+
   std::string libtpu_path_;
+  std::shared_ptr<pjrt::PjrtLibrary> lib_;
+  PJRT_Client* client_ = nullptr;
+
+  bool snapshot_valid_ = false;
+  std::vector<DevicePtr> devices_;
+  std::string libtpu_version_;
+  std::string runtime_version_;
+  TopologyInfo topology_;
 };
 
 }  // namespace
 
 ManagerPtr NewPjrtManager(const std::string& libtpu_path) {
-  return std::make_shared<PjrtManagerStub>(libtpu_path);
+  return std::make_shared<PjrtManager>(libtpu_path);
 }
 
 }  // namespace resource

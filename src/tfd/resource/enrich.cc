@@ -1,0 +1,82 @@
+// Topology-enrichment decorator: fills the gaps PJRT cannot see from GCE
+// instance metadata.
+//
+// PJRT knows the physical slice (chips, coords, hosts) but not the GCE
+// accelerator-type string ("v5p-128") or the scheduler-facing worker id;
+// the metadata server knows those but not live device state. The decorator
+// composes them: inner (PJRT) wins, metadata fills blanks. No reference
+// analogue — NVML alone answers everything for GPUs; on TPU VMs identity is
+// split across libtpu and the metadata server (SURVEY.md §7 "hard part b").
+#include "tfd/gce/metadata.h"
+#include "tfd/resource/factory.h"
+#include "tfd/util/strings.h"
+
+namespace tfd {
+namespace resource {
+
+namespace {
+
+class EnrichedManager : public Manager {
+ public:
+  EnrichedManager(ManagerPtr inner, const std::string& endpoint)
+      : inner_(std::move(inner)), client_(endpoint) {}
+
+  Status Init() override { return inner_->Init(); }
+  void Shutdown() override { inner_->Shutdown(); }
+  Result<std::vector<DevicePtr>> GetDevices() override {
+    return inner_->GetDevices();
+  }
+  Result<std::string> GetLibtpuVersion() override {
+    return inner_->GetLibtpuVersion();
+  }
+  Result<std::string> GetRuntimeVersion() override {
+    return inner_->GetRuntimeVersion();
+  }
+  std::string Name() const override { return inner_->Name(); }
+
+  Result<TopologyInfo> GetTopology() override {
+    Result<TopologyInfo> topo = inner_->GetTopology();
+    if (!topo.ok()) return topo;
+    if (!enriched_) {
+      if (topo->accelerator_type.empty()) {
+        Result<std::string> at = client_.AcceleratorType();
+        if (at.ok()) accelerator_type_ = TrimSpace(*at);
+      }
+      if (topo->worker_id < 0) {
+        Result<std::map<std::string, std::string>> env = client_.TpuEnv();
+        if (env.ok()) {
+          auto it = env->find("WORKER_ID");
+          if (it != env->end()) {
+            try {
+              worker_id_ = std::stoi(it->second);
+            } catch (...) {
+            }
+          }
+        }
+      }
+      enriched_ = true;
+    }
+    if (topo->accelerator_type.empty()) {
+      topo->accelerator_type = accelerator_type_;
+    }
+    if (topo->worker_id < 0) topo->worker_id = worker_id_;
+    return topo;
+  }
+
+ private:
+  ManagerPtr inner_;
+  gce::MetadataClient client_;
+  bool enriched_ = false;
+  std::string accelerator_type_;
+  int worker_id_ = -1;
+};
+
+}  // namespace
+
+ManagerPtr NewMetadataEnrichedManager(ManagerPtr inner,
+                                      const std::string& endpoint) {
+  return std::make_shared<EnrichedManager>(std::move(inner), endpoint);
+}
+
+}  // namespace resource
+}  // namespace tfd

@@ -30,7 +30,11 @@ Result<ManagerPtr> SelectManager(const config::Config& config) {
                  << (has_libtpu ? libtpu_path : "no")
                  << ", accel-devices=" << (has_accel ? "yes" : "no")
                  << "); trying the PJRT backend first";
-    chain.push_back(NewPjrtManager(f.libtpu_path));
+    ManagerPtr pjrt = NewPjrtManager(f.libtpu_path);
+    if (on_gce || !f.metadata_endpoint.empty()) {
+      pjrt = NewMetadataEnrichedManager(pjrt, f.metadata_endpoint);
+    }
+    chain.push_back(std::move(pjrt));
   }
   if (on_gce || !f.metadata_endpoint.empty()) {
     chain.push_back(NewMetadataManager(f.metadata_endpoint));

@@ -24,5 +24,10 @@ ManagerPtr NewPjrtManager(const std::string& libtpu_path);
 // accelerator-type, for nodes where libtpu is absent or busy.
 ManagerPtr NewMetadataManager(const std::string& metadata_endpoint);
 
+// Decorator filling topology gaps (accelerator-type, worker id) from GCE
+// metadata; used around the PJRT backend on GCE — see enrich.cc.
+ManagerPtr NewMetadataEnrichedManager(ManagerPtr inner,
+                                      const std::string& endpoint);
+
 }  // namespace resource
 }  // namespace tfd

@@ -1,0 +1,279 @@
+// A fake PJRT plugin (.so) for hermetic tests of the PJRT backend.
+//
+// This is the "fake libtpu" harness SURVEY.md §4 identifies as the gap in
+// the reference's test strategy (GFD's hardware-free coverage stops at Go
+// interface mocks; real-binary tests need a cloud GPU). Built as
+// libtfd_fake_pjrt.so, passed to the daemon via --libtpu-path, it exercises
+// the REAL dlopen + GetPjrtApi + PJRT-call path end-to-end with a
+// configurable slice topology.
+//
+// Configuration via environment variables (read at client-create time):
+//   TFD_FAKE_PJRT_KIND       device kind        (default "TPU v5 lite")
+//   TFD_FAKE_PJRT_BOUNDS     global chip grid   (default "2,2,1")
+//   TFD_FAKE_PJRT_HOSTS      number of hosts    (default 1)
+//   TFD_FAKE_PJRT_PROC       this process index (default 0)
+//   TFD_FAKE_PJRT_CORES      devices per chip   (default 1; 2 = v2/v3 style)
+//   TFD_FAKE_PJRT_HBM_GIB    per-DEVICE HBM GiB (default 16; 0 = stats unset)
+//   TFD_FAKE_PJRT_VERSION    platform version   (default "fake 9.9.9")
+//   TFD_FAKE_PJRT_FAIL       if set, client creation fails with its value
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+namespace {
+
+struct FakeError {
+  std::string message;
+};
+
+struct FakeDevice {
+  std::string kind;
+  int process_index = 0;
+  std::vector<int64_t> coords;
+  int64_t bytes_limit = 0;
+  // Attributes must outlive calls; stored here.
+  std::vector<PJRT_NamedValue> attributes;
+};
+
+struct FakeClient {
+  std::string platform_version;
+  int process_index = 0;
+  std::vector<FakeDevice> devices;         // global
+  std::vector<PJRT_Device*> device_ptrs;   // same order
+  std::vector<PJRT_Device*> addressable;
+};
+
+FakeClient* g_client = nullptr;  // one client at a time, like libtpu
+
+int EnvInt(const char* name, int dflt) {
+  const char* v = std::getenv(name);
+  if (v == nullptr || *v == '\0') return dflt;
+  return atoi(v);
+}
+
+std::string EnvStr(const char* name, const char* dflt) {
+  const char* v = std::getenv(name);
+  return (v == nullptr || *v == '\0') ? dflt : v;
+}
+
+PJRT_Error* MakeError(const std::string& message) {
+  return reinterpret_cast<PJRT_Error*>(new FakeError{message});
+}
+
+// --- Error ---
+void ErrorDestroy(PJRT_Error_Destroy_Args* args) {
+  delete reinterpret_cast<FakeError*>(args->error);
+}
+
+void ErrorMessage(PJRT_Error_Message_Args* args) {
+  const FakeError* err = reinterpret_cast<const FakeError*>(args->error);
+  args->message = err->message.c_str();
+  args->message_size = err->message.size();
+}
+
+PJRT_Error* ErrorGetCode(PJRT_Error_GetCode_Args* args) {
+  args->code = PJRT_Error_Code_INTERNAL;
+  return nullptr;
+}
+
+// --- Plugin ---
+PJRT_Error* PluginInitialize(PJRT_Plugin_Initialize_Args*) { return nullptr; }
+
+PJRT_Error* PluginAttributes(PJRT_Plugin_Attributes_Args* args) {
+  args->attributes = nullptr;
+  args->num_attributes = 0;
+  return nullptr;
+}
+
+// --- Client ---
+PJRT_Error* ClientCreate(PJRT_Client_Create_Args* args) {
+  std::string fail = EnvStr("TFD_FAKE_PJRT_FAIL", "");
+  if (!fail.empty()) return MakeError(fail);
+
+  auto* client = new FakeClient();
+  client->platform_version = EnvStr("TFD_FAKE_PJRT_VERSION", "fake 9.9.9");
+  client->process_index = EnvInt("TFD_FAKE_PJRT_PROC", 0);
+  std::string kind = EnvStr("TFD_FAKE_PJRT_KIND", "TPU v5 lite");
+  int hosts = EnvInt("TFD_FAKE_PJRT_HOSTS", 1);
+  int cores = EnvInt("TFD_FAKE_PJRT_CORES", 1);
+  int64_t hbm_gib = EnvInt("TFD_FAKE_PJRT_HBM_GIB", 16);
+
+  // Parse bounds "X,Y,Z".
+  std::vector<int> bounds;
+  {
+    std::string b = EnvStr("TFD_FAKE_PJRT_BOUNDS", "2,2,1");
+    size_t pos = 0;
+    while (pos <= b.size()) {
+      size_t comma = b.find(',', pos);
+      if (comma == std::string::npos) comma = b.size();
+      bounds.push_back(atoi(b.substr(pos, comma - pos).c_str()));
+      pos = comma + 1;
+    }
+    while (bounds.size() < 3) bounds.push_back(1);
+  }
+  int total_chips = bounds[0] * bounds[1] * bounds[2];
+  int chips_per_host = total_chips / (hosts > 0 ? hosts : 1);
+
+  int chip_index = 0;
+  for (int z = 0; z < bounds[2]; z++) {
+    for (int y = 0; y < bounds[1]; y++) {
+      for (int x = 0; x < bounds[0]; x++) {
+        int process = chips_per_host > 0 ? chip_index / chips_per_host : 0;
+        for (int core = 0; core < cores; core++) {
+          FakeDevice dev;
+          dev.kind = kind;
+          dev.process_index = process;
+          dev.coords = {x, y, z};
+          dev.bytes_limit = hbm_gib * (1LL << 30);
+          client->devices.push_back(std::move(dev));
+        }
+        chip_index++;
+      }
+    }
+  }
+  // Stable pointers now that the vector is final.
+  for (FakeDevice& dev : client->devices) {
+    // The "coords" attribute, as the TPU plugin reports it.
+    PJRT_NamedValue coords = {};
+    coords.struct_size = PJRT_NamedValue_STRUCT_SIZE;
+    static const char kCoords[] = "coords";
+    coords.name = kCoords;
+    coords.name_size = sizeof(kCoords) - 1;
+    coords.type = PJRT_NamedValue_kInt64List;
+    coords.int64_array_value = dev.coords.data();
+    coords.value_size = dev.coords.size();
+    dev.attributes.push_back(coords);
+
+    auto* ptr = reinterpret_cast<PJRT_Device*>(&dev);
+    client->device_ptrs.push_back(ptr);
+    if (dev.process_index == client->process_index) {
+      client->addressable.push_back(ptr);
+    }
+  }
+
+  g_client = client;
+  args->client = reinterpret_cast<PJRT_Client*>(client);
+  return nullptr;
+}
+
+PJRT_Error* ClientDestroy(PJRT_Client_Destroy_Args* args) {
+  delete reinterpret_cast<FakeClient*>(args->client);
+  g_client = nullptr;
+  return nullptr;
+}
+
+PJRT_Error* ClientPlatformName(PJRT_Client_PlatformName_Args* args) {
+  static const char kName[] = "tpu";
+  args->platform_name = kName;
+  args->platform_name_size = sizeof(kName) - 1;
+  return nullptr;
+}
+
+PJRT_Error* ClientProcessIndex(PJRT_Client_ProcessIndex_Args* args) {
+  args->process_index =
+      reinterpret_cast<FakeClient*>(args->client)->process_index;
+  return nullptr;
+}
+
+PJRT_Error* ClientPlatformVersion(PJRT_Client_PlatformVersion_Args* args) {
+  FakeClient* client = reinterpret_cast<FakeClient*>(args->client);
+  args->platform_version = client->platform_version.c_str();
+  args->platform_version_size = client->platform_version.size();
+  return nullptr;
+}
+
+PJRT_Error* ClientDevices(PJRT_Client_Devices_Args* args) {
+  FakeClient* client = reinterpret_cast<FakeClient*>(args->client);
+  args->devices = client->device_ptrs.data();
+  args->num_devices = client->device_ptrs.size();
+  return nullptr;
+}
+
+PJRT_Error* ClientAddressableDevices(
+    PJRT_Client_AddressableDevices_Args* args) {
+  FakeClient* client = reinterpret_cast<FakeClient*>(args->client);
+  args->addressable_devices = client->addressable.data();
+  args->num_addressable_devices = client->addressable.size();
+  return nullptr;
+}
+
+// --- Device / DeviceDescription (the same object plays both roles) ---
+PJRT_Error* DeviceGetDescription(PJRT_Device_GetDescription_Args* args) {
+  args->device_description =
+      reinterpret_cast<PJRT_DeviceDescription*>(args->device);
+  return nullptr;
+}
+
+PJRT_Error* DeviceDescriptionId(PJRT_DeviceDescription_Id_Args* args) {
+  args->id = 0;
+  return nullptr;
+}
+
+PJRT_Error* DeviceDescriptionProcessIndex(
+    PJRT_DeviceDescription_ProcessIndex_Args* args) {
+  args->process_index =
+      reinterpret_cast<FakeDevice*>(args->device_description)->process_index;
+  return nullptr;
+}
+
+PJRT_Error* DeviceDescriptionAttributes(
+    PJRT_DeviceDescription_Attributes_Args* args) {
+  FakeDevice* dev = reinterpret_cast<FakeDevice*>(args->device_description);
+  args->attributes = dev->attributes.data();
+  args->num_attributes = dev->attributes.size();
+  return nullptr;
+}
+
+PJRT_Error* DeviceDescriptionKind(PJRT_DeviceDescription_Kind_Args* args) {
+  FakeDevice* dev = reinterpret_cast<FakeDevice*>(args->device_description);
+  args->device_kind = dev->kind.c_str();
+  args->device_kind_size = dev->kind.size();
+  return nullptr;
+}
+
+PJRT_Error* DeviceMemoryStats(PJRT_Device_MemoryStats_Args* args) {
+  FakeDevice* dev = reinterpret_cast<FakeDevice*>(args->device);
+  args->bytes_in_use = 0;
+  if (dev->bytes_limit > 0) {
+    args->bytes_limit = dev->bytes_limit;
+    args->bytes_limit_is_set = true;
+  }
+  return nullptr;
+}
+
+PJRT_Api MakeApi() {
+  PJRT_Api api = {};
+  api.struct_size = PJRT_Api_STRUCT_SIZE;
+  api.pjrt_api_version.struct_size = PJRT_Api_Version_STRUCT_SIZE;
+  api.pjrt_api_version.major_version = PJRT_API_MAJOR;
+  api.pjrt_api_version.minor_version = PJRT_API_MINOR;
+
+  api.PJRT_Error_Destroy = ErrorDestroy;
+  api.PJRT_Error_Message = ErrorMessage;
+  api.PJRT_Error_GetCode = ErrorGetCode;
+  api.PJRT_Plugin_Initialize = PluginInitialize;
+  api.PJRT_Plugin_Attributes = PluginAttributes;
+  api.PJRT_Client_Create = ClientCreate;
+  api.PJRT_Client_Destroy = ClientDestroy;
+  api.PJRT_Client_PlatformName = ClientPlatformName;
+  api.PJRT_Client_ProcessIndex = ClientProcessIndex;
+  api.PJRT_Client_PlatformVersion = ClientPlatformVersion;
+  api.PJRT_Client_Devices = ClientDevices;
+  api.PJRT_Client_AddressableDevices = ClientAddressableDevices;
+  api.PJRT_Device_GetDescription = DeviceGetDescription;
+  api.PJRT_DeviceDescription_Id = DeviceDescriptionId;
+  api.PJRT_DeviceDescription_ProcessIndex = DeviceDescriptionProcessIndex;
+  api.PJRT_DeviceDescription_Attributes = DeviceDescriptionAttributes;
+  api.PJRT_DeviceDescription_Kind = DeviceDescriptionKind;
+  api.PJRT_Device_MemoryStats = DeviceMemoryStats;
+  return api;
+}
+
+PJRT_Api g_api = MakeApi();
+
+}  // namespace
+
+extern "C" const PJRT_Api* GetPjrtApi() { return &g_api; }

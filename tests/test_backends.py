@@ -1,0 +1,164 @@
+"""Tier 3: hermetic integration tests of the real hardware-probing paths.
+
+- The PJRT backend is driven through the fake PJRT plugin
+  (build/libtfd_fake_pjrt.so) so the actual dlopen + GetPjrtApi +
+  PJRT-call code executes — the fake-libtpu harness SURVEY.md section 4
+  calls for.
+- The metadata backend is driven against the fake GCE metadata server
+  (tpufd.fakes.metadata_server), replacing the reference's cloud-node
+  integration tier (tests/integration-tests.py) with a hermetic one.
+"""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+from conftest import BUILD_DIR, REPO, run_tfd
+
+sys.path.insert(0, str(REPO))
+
+from tpufd.fakes.metadata_server import (  # noqa: E402
+    FakeMetadataServer, cpu_vm, tpu_vm)
+
+FAKE_PJRT = BUILD_DIR / "libtfd_fake_pjrt.so"
+
+
+def labels_of(out):
+    return dict(line.split("=", 1) for line in out.splitlines() if line)
+
+
+def pjrt_args(extra=None, machine="/dev/null"):
+    return (["--oneshot", "--output-file=", "--backend=pjrt",
+             f"--libtpu-path={FAKE_PJRT}",
+             f"--machine-type-file={machine}"] + (extra or []))
+
+
+class TestPjrtBackend:
+    def test_v5e_single_host(self, tfd_binary):
+        code, out, err = run_tfd(tfd_binary, pjrt_args(), env={
+            "TFD_FAKE_PJRT_KIND": "TPU v5 lite",
+            "TFD_FAKE_PJRT_BOUNDS": "2,2,1",
+        })
+        assert code == 0, err
+        labels = labels_of(out)
+        assert labels["google.com/tpu.count"] == "4"
+        assert labels["google.com/tpu.product"] == "tpu-v5e"
+        assert labels["google.com/tpu.memory"] == "16384"
+        assert labels["google.com/tpu.topology"] == "2x2"
+        assert labels["google.com/tpu.backend"] == "pjrt"
+        assert labels["google.com/libtpu.version.major"] == "9"
+        # PJRT C API version from the header the fake was built with.
+        assert "google.com/tpu.runtime.major" in labels
+
+    def test_v5p_multi_host_worker(self, tfd_binary):
+        """v5p-128-shaped slice seen from worker 3 (BASELINE config 4 via
+        the real PJRT code path)."""
+        code, out, err = run_tfd(
+            tfd_binary, pjrt_args(["--slice-strategy=mixed"]), env={
+                "TFD_FAKE_PJRT_KIND": "TPU v5p",
+                "TFD_FAKE_PJRT_BOUNDS": "4,4,4",
+                "TFD_FAKE_PJRT_HOSTS": "16",
+                "TFD_FAKE_PJRT_PROC": "3",
+                "TFD_FAKE_PJRT_HBM_GIB": "95",
+            })
+        assert code == 0, err
+        labels = labels_of(out)
+        assert labels["google.com/tpu.count"] == "4"
+        assert labels["google.com/tpu.slice.hosts"] == "16"
+        assert labels["google.com/tpu.slice.worker-id"] == "3"
+        assert labels["google.com/tpu.topology"] == "4x4x4"
+        assert labels["google.com/tpu.ici.wrap"] == "true"
+        assert labels["google.com/tpu.memory"] == "97280"
+        assert labels["google.com/tpu-4x4x4.product"] == "tpu-v5p-SLICE-4x4x4"
+
+    def test_v2_cores_grouped_into_chips(self, tfd_binary):
+        """v2-style: 2 PJRT core-devices per chip; count must be chips and
+        memory the per-chip sum."""
+        code, out, err = run_tfd(tfd_binary, pjrt_args(), env={
+            "TFD_FAKE_PJRT_KIND": "TPU v2",
+            "TFD_FAKE_PJRT_BOUNDS": "2,2,1",
+            "TFD_FAKE_PJRT_CORES": "2",
+            "TFD_FAKE_PJRT_HBM_GIB": "8",
+        })
+        assert code == 0, err
+        labels = labels_of(out)
+        assert labels["google.com/tpu.count"] == "4"
+        assert labels["google.com/tpu.memory"] == "16384"
+        assert labels["google.com/tpu.cores"] == "2"
+
+    def test_client_create_failure_falls_back(self, tfd_binary):
+        """PJRT init failure + fail-on-init-error=false -> machine-type
+        labels only (the busy-chip / broken-driver path)."""
+        code, out, err = run_tfd(
+            tfd_binary, pjrt_args(["--fail-on-init-error=false"]),
+            env={"TFD_FAKE_PJRT_FAIL": "chips are busy"})
+        assert code == 0, err
+        labels = labels_of(out)
+        assert "google.com/tpu.count" not in labels
+        assert "google.com/tpu.machine" in labels
+
+    def test_client_create_failure_fails_when_strict(self, tfd_binary):
+        code, _, err = run_tfd(tfd_binary, pjrt_args(),
+                               env={"TFD_FAKE_PJRT_FAIL": "chips are busy"})
+        assert code == 1
+        assert "chips are busy" in err
+
+
+class TestMetadataBackend:
+    def test_v5p_128_from_metadata(self, tfd_binary):
+        """BASELINE config 4 via metadata only (no libtpu on the node)."""
+        with FakeMetadataServer(tpu_vm(
+                accelerator_type="v5p-128", topology="4x4x4",
+                chips_per_host_bounds="2,2,1", host_bounds="2,2,4",
+                worker_id=3, machine_type="ct5p-hightpu-4t")) as server:
+            code, out, err = run_tfd(tfd_binary, [
+                "--oneshot", "--output-file=", "--backend=metadata",
+                f"--metadata-endpoint={server.endpoint}",
+                "--slice-strategy=single",
+                "--machine-type-file=/dev/null",
+            ], env={"GCE_METADATA_HOST": server.endpoint})
+            assert code == 0, err
+            labels = labels_of(out)
+            assert labels["google.com/tpu.machine"] == "ct5p-hightpu-4t"
+            assert labels["google.com/tpu.accelerator-type"] == "v5p-128"
+            assert labels["google.com/tpu.count"] == "4"
+            assert labels["google.com/tpu.slice.hosts"] == "16"
+            assert labels["google.com/tpu.slice.worker-id"] == "3"
+            assert labels["google.com/tpu.slice.shape"] == "4x4x4"
+            assert labels["google.com/tpu.ici.wrap"] == "true"
+            assert labels["google.com/tpu.backend"] == "metadata"
+            # Versions are unknown to the metadata backend.
+            assert "google.com/libtpu.version.major" not in labels
+
+    def test_v2_8_defaults_without_tpu_env(self, tfd_binary):
+        """accelerator-type alone (no tpu-env bag): counts and default
+        topology must still come out right."""
+        data = tpu_vm(accelerator_type="v2-8", machine_type="n1-standard-96")
+        del data["instance/attributes/tpu-env"]
+        with FakeMetadataServer(data) as server:
+            code, out, err = run_tfd(tfd_binary, [
+                "--oneshot", "--output-file=", "--backend=metadata",
+                f"--metadata-endpoint={server.endpoint}",
+                "--machine-type-file=/dev/null",
+            ], env={"GCE_METADATA_HOST": server.endpoint})
+            assert code == 0, err
+            labels = labels_of(out)
+            assert labels["google.com/tpu.count"] == "4"   # 8 cores = 4 chips
+            assert labels["google.com/tpu.product"] == "tpu-v2"
+            assert labels["google.com/tpu.topology"] == "2x2"
+
+    def test_cpu_vm_degrades(self, tfd_binary):
+        """GCE VM without TPUs: metadata backend finds no accelerator-type
+        -> with fail-on-init-error=false, machine-type only."""
+        with FakeMetadataServer(cpu_vm()) as server:
+            code, out, err = run_tfd(tfd_binary, [
+                "--oneshot", "--output-file=", "--backend=metadata",
+                f"--metadata-endpoint={server.endpoint}",
+                "--fail-on-init-error=false",
+                "--machine-type-file=/dev/null",
+            ], env={"GCE_METADATA_HOST": server.endpoint})
+            assert code == 0, err
+            labels = labels_of(out)
+            assert labels["google.com/tpu.machine"] == "n2-standard-8"
+            assert "google.com/tpu.count" not in labels

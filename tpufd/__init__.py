@@ -1,0 +1,13 @@
+"""tpufd: the Python companion to tpu-feature-discovery.
+
+Contents:
+  - tpufd.health:   jittable on-chip health/performance probes (JAX)
+  - tpufd.mesh:     slice-shape -> jax.sharding.Mesh helpers
+  - tpufd.fakes:    hermetic test doubles (GCE metadata server)
+
+The C++ daemon is the product; this package provides the JAX-powered device
+health checks it can invoke (--device-health=basic), the mesh utilities for
+validating slice topologies, and the fakes used by the test tiers.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,115 @@
+"""A fake GCE instance-metadata server for hermetic TPU-VM tests.
+
+The reference's integration tier needs a real cloud GPU node
+(tests/integration-tests.py + Terraform); SURVEY.md section 4 flags the
+missing hermetic multi-host harness as the thing to improve. This fake
+serves the exact metadata keys the daemon's metadata backend and machine-
+type labeler read, so BASELINE configs 2-5 run as plain pytest.
+
+Usage:
+    with FakeMetadataServer(tpu_vm(accelerator_type="v5p-128",
+                                   worker_id=3)) as server:
+        run_binary(["--backend=metadata",
+                    f"--metadata-endpoint=127.0.0.1:{server.port}"])
+"""
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def tpu_vm(accelerator_type="v5litepod-4", topology=None, worker_id=0,
+           chips_per_host_bounds=None, host_bounds=None,
+           machine_type="ct5lp-hightpu-4t", preemptible=False,
+           instance_id="1234567890", extra_attributes=None):
+    """Builds the metadata key->value dict for a TPU VM.
+
+    Keys mirror real TPU-VM metadata: instance/machine-type,
+    instance/attributes/accelerator-type, and the tpu-env bag with
+    ACCELERATOR_TYPE / TOPOLOGY / CHIPS_PER_HOST_BOUNDS / HOST_BOUNDS /
+    WORKER_ID entries (values single-quoted, as the real agent writes them).
+    """
+    tpu_env_lines = [f"ACCELERATOR_TYPE: '{accelerator_type}'"]
+    if topology:
+        tpu_env_lines.append(f"TOPOLOGY: '{topology}'")
+    if chips_per_host_bounds:
+        tpu_env_lines.append(
+            f"CHIPS_PER_HOST_BOUNDS: '{chips_per_host_bounds}'")
+    if host_bounds:
+        tpu_env_lines.append(f"HOST_BOUNDS: '{host_bounds}'")
+    tpu_env_lines.append(f"WORKER_ID: '{worker_id}'")
+    data = {
+        "instance/id": instance_id,
+        "instance/machine-type":
+            f"projects/12345/machineTypes/{machine_type}",
+        "instance/scheduling/preemptible":
+            "TRUE" if preemptible else "FALSE",
+        "instance/attributes/accelerator-type": accelerator_type,
+        "instance/attributes/tpu-env": "\n".join(tpu_env_lines) + "\n",
+        "instance/attributes/agent-worker-number": str(worker_id),
+    }
+    if extra_attributes:
+        for key, value in extra_attributes.items():
+            data[f"instance/attributes/{key}"] = value
+    return data
+
+
+def cpu_vm(machine_type="n2-standard-8"):
+    """Metadata for a plain (non-TPU) GCE VM."""
+    return {
+        "instance/id": "987654321",
+        "instance/machine-type":
+            f"projects/12345/machineTypes/{machine_type}",
+        "instance/scheduling/preemptible": "FALSE",
+    }
+
+
+class _Handler(BaseHTTPRequestHandler):
+    data = {}
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        if self.headers.get("Metadata-Flavor") != "Google":
+            self.send_response(403)
+            self.end_headers()
+            return
+        prefix = "/computeMetadata/v1/"
+        if not self.path.startswith(prefix):
+            self.send_response(404)
+            self.end_headers()
+            return
+        key = self.path[len(prefix):]
+        if key in self.data:
+            body = self.data[key].encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.send_header("Metadata-Flavor", "Google")
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self.send_response(404)
+            self.end_headers()
+
+    def log_message(self, *args):  # silence request logging in tests
+        pass
+
+
+class FakeMetadataServer:
+    def __init__(self, data, port=0):
+        handler = type("Handler", (_Handler,), {"data": dict(data)})
+        self._server = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5)
+        return False
+
+    @property
+    def endpoint(self):
+        return f"127.0.0.1:{self.port}"

@@ -20,6 +20,7 @@
 #include "tfd/lm/machine_type.h"
 #include "tfd/lm/timestamp.h"
 #include "tfd/lm/tpu_labeler.h"
+#include "tfd/lm/tpuvm_labeler.h"
 #include "tfd/platform/detect.h"
 #include "tfd/resource/factory.h"
 #include "tfd/util/file.h"
@@ -30,21 +31,24 @@ namespace {
 
 enum class RunOutcome { kExit, kRestart, kError };
 
-// Builds the machine-type metadata getter when a metadata server is
-// plausibly reachable (GCE VM or explicit test endpoint).
+// True when a metadata server is plausibly reachable (GCE VM or explicit
+// test endpoint) — gates the metadata-touching labelers so bare-metal nodes
+// never pay connection timeouts.
+bool MetadataPlausible(const config::Config& config) {
+  return !config.flags.metadata_endpoint.empty() || platform::OnGce() ||
+         std::getenv("GCE_METADATA_HOST") != nullptr;
+}
+
 lm::MachineTypeGetter MakeMachineTypeGetter(const config::Config& config) {
-  const std::string& endpoint = config.flags.metadata_endpoint;
-  if (endpoint.empty() && !platform::OnGce() &&
-      std::getenv("GCE_METADATA_HOST") == nullptr) {
-    return nullptr;
-  }
-  auto client = std::make_shared<gce::MetadataClient>(endpoint);
+  if (!MetadataPlausible(config)) return nullptr;
+  auto client =
+      std::make_shared<gce::MetadataClient>(config.flags.metadata_endpoint);
   return [client]() { return client->MachineType(); };
 }
 
 // One labeling pass: build backend + labelers, merge, write.
 Status LabelOnce(const config::Config& config, lm::Labeler& timestamp,
-                 lm::Labeler& machine_type) {
+                 lm::Labeler& machine_type, lm::Labeler& tpu_vm) {
   auto t0 = std::chrono::steady_clock::now();
 
   Result<resource::ManagerPtr> manager = resource::NewManager(config);
@@ -55,9 +59,11 @@ Status LabelOnce(const config::Config& config, lm::Labeler& timestamp,
   Result<lm::LabelerPtr> tpu = lm::NewTpuLabeler(*manager, config);
   if (!tpu.ok()) return tpu.status();
 
+  // Merge order mirrors lm.NewLabelers (labeler.go:33-45): device labels
+  // first, then the VM/virtualization labeler; later labelers win.
   lm::Labels merged;
-  for (lm::Labeler* labeler :
-       std::vector<lm::Labeler*>{&timestamp, &machine_type, tpu->get()}) {
+  for (lm::Labeler* labeler : std::vector<lm::Labeler*>{
+           &timestamp, &machine_type, tpu->get(), &tpu_vm}) {
     Result<lm::Labels> labels = labeler->GetLabels();
     if (!labels.ok()) return labels.status();
     for (auto& [k, v] : *labels) merged[k] = v;
@@ -86,11 +92,14 @@ RunOutcome Run(const config::Config& config, const sigset_t& sigmask) {
   lm::LabelerPtr timestamp = lm::NewTimestampLabeler(config);
   lm::LabelerPtr machine_type = lm::NewMachineTypeLabeler(
       config.flags.machine_type_file, MakeMachineTypeGetter(config));
+  lm::LabelerPtr tpu_vm = MetadataPlausible(config)
+                              ? lm::NewTpuVmLabeler(config)
+                              : lm::Empty();
 
   bool cleanup_output = !config.flags.oneshot &&
                         !config.flags.output_file.empty();
   while (true) {
-    Status s = LabelOnce(config, *timestamp, *machine_type);
+    Status s = LabelOnce(config, *timestamp, *machine_type, *tpu_vm);
     if (!s.ok()) {
       TFD_LOG_ERROR << s.message();
       return RunOutcome::kError;

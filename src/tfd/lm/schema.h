@@ -51,6 +51,18 @@ inline constexpr char kSliceChipsPerHost[] =
     "google.com/tpu.slice.chips-per-host";
 inline constexpr char kSliceWorkerId[] = "google.com/tpu.slice.worker-id";
 
+// TPU-VM detection (vGPU-path analogue) and multi-slice identity.
+inline constexpr char kTpuVmPresent[] = "google.com/tpu-vm.present";
+inline constexpr char kTpuVmPreemptible[] = "google.com/tpu-vm.preemptible";
+inline constexpr char kTpuVmSpot[] = "google.com/tpu-vm.spot";
+inline constexpr char kTpuVmZone[] = "google.com/tpu-vm.zone";
+inline constexpr char kMultislicePresent[] =
+    "google.com/tpu.multislice.present";
+inline constexpr char kMultisliceSliceId[] =
+    "google.com/tpu.multislice.slice-id";
+inline constexpr char kMultisliceNumSlices[] =
+    "google.com/tpu.multislice.num-slices";
+
 // The value used when a slice strategy's validation fails — the analogue of
 // the reference's "MIG-INVALID" product (mig-strategy.go:243-262).
 inline constexpr char kSliceInvalid[] = "SLICE-INVALID";

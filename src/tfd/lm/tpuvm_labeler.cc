@@ -1,0 +1,87 @@
+#include "tfd/lm/tpuvm_labeler.h"
+
+#include <cstdlib>
+
+#include "tfd/gce/metadata.h"
+#include "tfd/lm/schema.h"
+#include "tfd/util/strings.h"
+
+namespace tfd {
+namespace lm {
+
+namespace {
+
+class TpuVmLabeler : public Labeler {
+ public:
+  explicit TpuVmLabeler(std::string endpoint)
+      : client_(std::move(endpoint)) {}
+
+  Result<Labels> GetLabels() override {
+    Labels labels;
+    if (!client_.Available()) return labels;  // not on GCE: contribute none
+
+    Result<std::string> accel = client_.AcceleratorType();
+    bool is_tpu_vm = accel.ok() && !accel->empty();
+    labels[kTpuVmPresent] = is_tpu_vm ? "true" : "false";
+    if (!is_tpu_vm) return labels;
+
+    Result<bool> preemptible = client_.Preemptible();
+    if (preemptible.ok()) {
+      labels[kTpuVmPreemptible] = *preemptible ? "true" : "false";
+    }
+    Result<std::string> model =
+        client_.Get("instance/scheduling/provisioning-model");
+    if (model.ok()) {
+      labels[kTpuVmSpot] =
+          ToLower(TrimSpace(*model)) == "spot" ? "true" : "false";
+    }
+    Result<std::string> zone = client_.Get("instance/zone");
+    if (zone.ok()) {
+      std::vector<std::string> parts = SplitString(TrimSpace(*zone), '/');
+      labels[kTpuVmZone] = SanitizeLabelValue(parts.back());
+    }
+
+    // Multi-slice coordinates: prefer the tpu-env bag, fall back to the
+    // process environment (GKE injects MEGASCALE_* into multislice pods).
+    std::string slice_id;
+    std::string num_slices;
+    Result<std::map<std::string, std::string>> env = client_.TpuEnv();
+    if (env.ok()) {
+      auto get = [&](const char* key) -> std::string {
+        auto it = env->find(key);
+        return it == env->end() ? "" : it->second;
+      };
+      slice_id = get("MEGASCALE_SLICE_ID");
+      num_slices = get("MEGASCALE_NUM_SLICES");
+    }
+    if (slice_id.empty()) {
+      if (const char* v = std::getenv("MEGASCALE_SLICE_ID")) slice_id = v;
+    }
+    if (num_slices.empty()) {
+      if (const char* v = std::getenv("MEGASCALE_NUM_SLICES")) {
+        num_slices = v;
+      }
+    }
+    bool multislice = !slice_id.empty() || !num_slices.empty();
+    labels[kMultislicePresent] = multislice ? "true" : "false";
+    if (!slice_id.empty()) {
+      labels[kMultisliceSliceId] = SanitizeLabelValue(slice_id);
+    }
+    if (!num_slices.empty()) {
+      labels[kMultisliceNumSlices] = SanitizeLabelValue(num_slices);
+    }
+    return labels;
+  }
+
+ private:
+  gce::MetadataClient client_;
+};
+
+}  // namespace
+
+LabelerPtr NewTpuVmLabeler(const config::Config& config) {
+  return std::make_unique<TpuVmLabeler>(config.flags.metadata_endpoint);
+}
+
+}  // namespace lm
+}  // namespace tfd

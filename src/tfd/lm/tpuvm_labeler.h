@@ -1,0 +1,30 @@
+// TPU-VM / multi-slice labeler — the vGPU-path analogue.
+//
+// Reference parity: internal/lm/vgpu.go:32-55 + internal/vgpu (PCI
+// vendor-capability sniffing for hypervisor-hosted GPUs → vgpu.present /
+// host-driver-version / host-driver-branch). On TPU the "am I virtualized,
+// and what does the host say about me" facts live in GCE instance metadata,
+// not PCI config space:
+//   google.com/tpu-vm.present      = GCE VM with a TPU accelerator-type
+//   google.com/tpu-vm.preemptible  = instance/scheduling/preemptible
+//   google.com/tpu-vm.spot         = provisioning-model == SPOT
+//   google.com/tpu-vm.zone         = instance zone (leaf)
+// Multi-slice (DCN-connected slices, BASELINE config 5) identity comes from
+// the MEGASCALE coordinates (tpu-env bag or process env):
+//   google.com/tpu.multislice.present     = true|false
+//   google.com/tpu.multislice.slice-id    = this slice's index
+//   google.com/tpu.multislice.num-slices  = slices in the job
+// Non-GCE nodes and unreachable metadata contribute no labels (empty), the
+// same graceful degradation as the reference's vGPU probe on bare metal.
+#pragma once
+
+#include "tfd/config/config.h"
+#include "tfd/lm/labeler.h"
+
+namespace tfd {
+namespace lm {
+
+LabelerPtr NewTpuVmLabeler(const config::Config& config);
+
+}  // namespace lm
+}  // namespace tfd

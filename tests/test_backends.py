@@ -14,7 +14,7 @@ from pathlib import Path
 
 import pytest
 
-from conftest import BUILD_DIR, REPO, run_tfd
+from conftest import BUILD_DIR, GOLDEN, REPO, check_golden, run_tfd
 
 sys.path.insert(0, str(REPO))
 
@@ -147,6 +147,49 @@ class TestMetadataBackend:
             assert labels["google.com/tpu.count"] == "4"   # 8 cores = 4 chips
             assert labels["google.com/tpu.product"] == "tpu-v2"
             assert labels["google.com/tpu.topology"] == "2x2"
+
+    def test_multislice_preemptible(self, tfd_binary):
+        """BASELINE config 5: one host of slice 1 of a 2x v5e-64 multislice
+        job on preemptible TPU VMs — TPU-VM detection + multislice labels."""
+        with FakeMetadataServer(tpu_vm(
+                accelerator_type="v5litepod-64", topology="8x8",
+                chips_per_host_bounds="2,2,1", host_bounds="4,4,1",
+                worker_id=7, preemptible=True, spot=False,
+                zone="us-west4-a", megascale_slice_id=1,
+                megascale_num_slices=2)) as server:
+            code, out, err = run_tfd(tfd_binary, [
+                "--oneshot", "--output-file=", "--backend=metadata",
+                f"--metadata-endpoint={server.endpoint}",
+                "--slice-strategy=single",
+                "--machine-type-file=/dev/null",
+            ], env={"GCE_METADATA_HOST": server.endpoint})
+            assert code == 0, err
+            labels = labels_of(out)
+            assert labels["google.com/tpu-vm.present"] == "true"
+            assert labels["google.com/tpu-vm.preemptible"] == "true"
+            assert labels["google.com/tpu-vm.spot"] == "false"
+            assert labels["google.com/tpu-vm.zone"] == "us-west4-a"
+            assert labels["google.com/tpu.multislice.present"] == "true"
+            assert labels["google.com/tpu.multislice.slice-id"] == "1"
+            assert labels["google.com/tpu.multislice.num-slices"] == "2"
+            assert labels["google.com/tpu.count"] == "4"
+            assert labels["google.com/tpu.slice.hosts"] == "16"
+            assert labels["google.com/tpu.slice.shape"] == "8x8"
+            check_golden(out, GOLDEN / "expected-output-tpu-multislice.txt")
+
+    def test_cpu_vm_without_tpu_marks_absent(self, tfd_binary):
+        """A plain GCE VM gets tpu-vm.present=false (the labeler answers
+        even when the device backend finds nothing)."""
+        with FakeMetadataServer(cpu_vm()) as server:
+            code, out, err = run_tfd(tfd_binary, [
+                "--oneshot", "--output-file=", "--backend=null",
+                f"--metadata-endpoint={server.endpoint}",
+                "--machine-type-file=/dev/null",
+            ], env={"GCE_METADATA_HOST": server.endpoint})
+            assert code == 0, err
+            labels = labels_of(out)
+            assert labels["google.com/tpu-vm.present"] == "false"
+            assert "google.com/tpu-vm.preemptible" not in labels
 
     def test_cpu_vm_degrades(self, tfd_binary):
         """GCE VM without TPUs: metadata backend finds no accelerator-type

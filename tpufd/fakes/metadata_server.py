@@ -20,7 +20,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 def tpu_vm(accelerator_type="v5litepod-4", topology=None, worker_id=0,
            chips_per_host_bounds=None, host_bounds=None,
            machine_type="ct5lp-hightpu-4t", preemptible=False,
-           instance_id="1234567890", extra_attributes=None):
+           spot=False, zone="us-central2-b", megascale_slice_id=None,
+           megascale_num_slices=None, instance_id="1234567890",
+           extra_attributes=None):
     """Builds the metadata key->value dict for a TPU VM.
 
     Keys mirror real TPU-VM metadata: instance/machine-type,
@@ -37,12 +39,20 @@ def tpu_vm(accelerator_type="v5litepod-4", topology=None, worker_id=0,
     if host_bounds:
         tpu_env_lines.append(f"HOST_BOUNDS: '{host_bounds}'")
     tpu_env_lines.append(f"WORKER_ID: '{worker_id}'")
+    if megascale_slice_id is not None:
+        tpu_env_lines.append(f"MEGASCALE_SLICE_ID: '{megascale_slice_id}'")
+    if megascale_num_slices is not None:
+        tpu_env_lines.append(
+            f"MEGASCALE_NUM_SLICES: '{megascale_num_slices}'")
     data = {
         "instance/id": instance_id,
         "instance/machine-type":
             f"projects/12345/machineTypes/{machine_type}",
+        "instance/zone": f"projects/12345/zones/{zone}",
         "instance/scheduling/preemptible":
             "TRUE" if preemptible else "FALSE",
+        "instance/scheduling/provisioning-model":
+            "SPOT" if spot else "STANDARD",
         "instance/attributes/accelerator-type": accelerator_type,
         "instance/attributes/tpu-env": "\n".join(tpu_env_lines) + "\n",
         "instance/attributes/agent-worker-number": str(worker_id),

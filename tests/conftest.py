@@ -26,13 +26,26 @@ FIXTURES = Path(__file__).resolve().parent / "fixtures"
 GOLDEN = Path(__file__).resolve().parent / "golden"
 
 # Virtual 8-device CPU mesh for sharding tests (the driver dry-runs
-# multi-chip separately via __graft_entry__.dryrun_multichip).
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault(
-    "XLA_FLAGS",
-    (os.environ.get("XLA_FLAGS", "") +
-     " --xla_force_host_platform_device_count=8").strip(),
-)
+# multi-chip separately via __graft_entry__.dryrun_multichip). The
+# environment may pin JAX_PLATFORMS to a hardware plugin that overrides the
+# env var, so tests that import jax must ALSO call
+# jax.config.update("jax_platforms", "cpu") before first device use — the
+# `cpu_jax` fixture below does both.
+os.environ["JAX_PLATFORMS"] = "cpu"
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") +
+        " --xla_force_host_platform_device_count=8").strip()
+
+
+@pytest.fixture(scope="session")
+def cpu_jax():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    assert len(jax.devices()) == 8, (
+        f"expected 8 virtual CPU devices, got {jax.devices()}")
+    return jax
 
 
 def _build():

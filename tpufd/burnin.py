@@ -1,0 +1,116 @@
+"""Slice burn-in: a sharded training step used to validate a slice end-to-end.
+
+A node labeler can report that chips enumerate; a *slice* is only known-good
+once a representative sharded program has compiled and stepped across it —
+MXU (matmuls), HBM (activations), and ICI (gradient/activation collectives)
+all exercised. This module provides that program: a small MLP-block model
+with data-parallel batch and tensor-parallel hidden dimension over a
+('data', 'model') mesh, the canonical TPU sharding recipe (shardings
+annotated, XLA inserts the psum/all-gather collectives).
+
+Used by __graft_entry__.dryrun_multichip (the driver's multi-chip
+compile-check) and available to operators as a slice acceptance test.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def model_dims(d_model=256, d_ff=1024):
+    return {"d_model": d_model, "d_ff": d_ff}
+
+
+def init_params(key, d_model=256, d_ff=1024, dtype=jnp.bfloat16):
+    """Two-layer MLP block with layernorm scale: the minimal shape that
+    exercises both a column-parallel and a row-parallel matmul."""
+    k1, k2 = jax.random.split(key)
+    scale1 = 1.0 / (d_model ** 0.5)
+    scale2 = 1.0 / (d_ff ** 0.5)
+    return {
+        "w_in": (jax.random.normal(k1, (d_model, d_ff)) * scale1).astype(dtype),
+        "w_out": (jax.random.normal(k2, (d_ff, d_model)) * scale2).astype(dtype),
+        "gamma": jnp.ones((d_model,), dtype=dtype),
+    }
+
+
+def forward(params, x):
+    """Forward pass: layernorm -> col-parallel matmul -> gelu ->
+    row-parallel matmul -> residual. x: [batch, seq, d_model]."""
+    h = x * params["gamma"]
+    h = jax.nn.gelu(h @ params["w_in"])     # [b, s, d_ff]   (tp: d_ff sharded)
+    out = h @ params["w_out"]                # [b, s, d_model] (psum over tp)
+    return x + out
+
+
+def loss_fn(params, x, y):
+    pred = forward(params, x)
+    return jnp.mean((pred.astype(jnp.float32) - y.astype(jnp.float32)) ** 2)
+
+
+def param_shardings(mesh):
+    """Tensor-parallel placement: w_in column-sharded, w_out row-sharded
+    over the 'model' axis; small params replicated."""
+    return {
+        "w_in": NamedSharding(mesh, P(None, "model")),
+        "w_out": NamedSharding(mesh, P("model", None)),
+        "gamma": NamedSharding(mesh, P()),
+    }
+
+
+def batch_sharding(mesh):
+    """Data-parallel batch + sequence-parallel activations: batch over
+    'data', sequence over 'model' (re-gathered by XLA where the
+    tensor-parallel matmuls need it)."""
+    return NamedSharding(mesh, P("data", "model", None))
+
+
+def make_train_step(mesh, learning_rate=1e-3):
+    """Returns the jitted FULL training step (fwd + bwd + SGD update) with
+    explicit input/output shardings over `mesh`."""
+    p_shard = param_shardings(mesh)
+    x_shard = batch_sharding(mesh)
+
+    @functools.partial(
+        jax.jit,
+        in_shardings=(p_shard, x_shard, x_shard),
+        out_shardings=(p_shard, NamedSharding(mesh, P())),
+        donate_argnums=(0,),
+    )
+    def train_step(params, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        new_params = jax.tree_util.tree_map(
+            lambda p, g: (p.astype(jnp.float32) -
+                          learning_rate * g.astype(jnp.float32)).astype(p.dtype),
+            params, grads)
+        return new_params, loss
+
+    return train_step
+
+
+def run_burnin(mesh, batch=None, seq=None, d_model=256, d_ff=1024, steps=2):
+    """Compiles and runs the sharded train step on `mesh`. Shapes default to
+    small multiples of the mesh axes. Returns the final loss (float)."""
+    data_n = mesh.shape["data"]
+    model_n = mesh.shape["model"]
+    if batch is None:
+        batch = 4 * data_n
+    if seq is None:
+        seq = 8 * model_n
+    key = jax.random.PRNGKey(0)
+    params = init_params(key, d_model=d_model, d_ff=d_ff)
+    params = jax.device_put(params, param_shardings(mesh))
+    x = jax.device_put(
+        jax.random.normal(key, (batch, seq, d_model)).astype(jnp.bfloat16),
+        batch_sharding(mesh))
+    y = jax.device_put(
+        jnp.zeros((batch, seq, d_model), dtype=jnp.bfloat16),
+        batch_sharding(mesh))
+
+    step = make_train_step(mesh)
+    loss = None
+    for _ in range(steps):
+        params, loss = step(params, x, y)
+    return float(loss)

@@ -1,0 +1,110 @@
+"""On-chip TPU health / performance probes (jittable).
+
+The daemon's --device-health=basic mode and `bench.py` use these to turn
+*measured* silicon behavior into labels — a capability the reference does
+not have (GFD trusts NVML metadata; it never exercises the GPU). A node
+whose chip enumerates but delivers 10% of expected matmul throughput is
+exactly the node a scheduler should avoid; these probes catch that.
+
+Design notes (TPU-first):
+  - The matmul probe is one fused jit of a lax.fori_loop over bf16 matmuls
+    sized for the MXU (128-multiple dims), so the measurement is MXU
+    throughput, not dispatch overhead.
+  - The HBM probe streams a large bf16 buffer (scale + add) so the copy is
+    bandwidth-bound.
+  - The collective probe psums across a mesh axis, measuring ICI.
+  - All probes block_until_ready and time the *second* call (first call
+    pays XLA compilation).
+"""
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def _time_call(fn, *args):
+    """Compile (first call), then time the second. Returns seconds."""
+    fn(*args).block_until_ready()
+    start = time.perf_counter()
+    fn(*args).block_until_ready()
+    return time.perf_counter() - start
+
+
+@functools.partial(jax.jit, static_argnames=("size", "iters"))
+def _matmul_chain(x, size, iters):
+    def body(_, acc):
+        return jnp.tanh(acc @ acc) * 0.5 + acc * 0.5
+    return jax.lax.fori_loop(0, iters, body, x)
+
+
+def matmul_tflops(device=None, size=4096, iters=8):
+    """Measured bf16 matmul TFLOP/s on one chip."""
+    device = device or jax.devices()[0]
+    x = jax.device_put(
+        jnp.ones((size, size), dtype=jnp.bfloat16) * 0.001, device)
+    seconds = _time_call(lambda v: _matmul_chain(v, size, iters), x)
+    flops = 2.0 * size * size * size * iters
+    return flops / seconds / 1e12
+
+
+@functools.partial(jax.jit, static_argnames=("iters",))
+def _stream(x, iters):
+    def body(_, acc):
+        return acc * 1.0000001 + 0.5
+    return jax.lax.fori_loop(0, iters, body, x)
+
+
+def hbm_gbps(device=None, mib=512, iters=16):
+    """Measured HBM streaming bandwidth (GB/s, read+write) on one chip."""
+    device = device or jax.devices()[0]
+    n = mib * 1024 * 1024 // 2  # bf16 elements
+    x = jax.device_put(jnp.zeros((n,), dtype=jnp.bfloat16), device)
+    seconds = _time_call(lambda v: _stream(v, iters), x)
+    bytes_moved = 2.0 * n * 2 * iters  # read + write per iter
+    return bytes_moved / seconds / 1e9
+
+
+def allreduce_gbps(mesh, mib=64, iters=8):
+    """Measured all-reduce bus bandwidth (GB/s) over the mesh's first axis
+    (ICI when the mesh spans one slice)."""
+    axis = mesh.axis_names[0]
+    n_dev = mesh.devices.size
+    n = mib * 1024 * 1024 // 2
+
+    sharding = NamedSharding(mesh, P(axis))
+    x = jax.device_put(jnp.ones((n_dev, n // n_dev), dtype=jnp.bfloat16),
+                       sharding)
+
+    @jax.jit
+    def reduce_loop(v):
+        def body(_, acc):
+            summed = jnp.sum(acc, axis=0, keepdims=True)
+            return acc + summed * 1e-6  # keep values bounded
+        return jax.lax.fori_loop(0, iters, body, v)
+
+    seconds = _time_call(reduce_loop, x)
+    # Ring all-reduce moves 2*(k-1)/k of the buffer per step.
+    bytes_moved = 2.0 * n * 2 * (n_dev - 1) / n_dev * iters
+    return bytes_moved / seconds / 1e9
+
+
+def health_labels(prefix="google.com/tpu.health."):
+    """Runs the single-chip probes and returns a label dict, e.g.
+    {"google.com/tpu.health.matmul-tflops": "123", ...}. Values are
+    integers (label values must be stable-ish strings). Probe sizes are
+    TPU-scale on TPU and small elsewhere (CI hosts)."""
+    on_tpu = jax.devices()[0].platform == "tpu"
+    size = 4096 if on_tpu else 512
+    mib = 512 if on_tpu else 32
+    labels = {}
+    try:
+        labels[prefix + "matmul-tflops"] = str(
+            int(matmul_tflops(size=size)))
+        labels[prefix + "hbm-gbps"] = str(int(hbm_gbps(mib=mib)))
+        labels[prefix + "ok"] = "true"
+    except Exception:  # noqa: BLE001 — any device failure marks unhealthy
+        labels[prefix + "ok"] = "false"
+    return labels

@@ -1,0 +1,83 @@
+"""Slice-shape -> jax.sharding.Mesh helpers.
+
+The C++ daemon's slice-shape grammar (src/tfd/slice/shape.cc) has a Python
+twin here so JAX jobs can turn the node labels the daemon publishes
+(google.com/tpu.topology=4x4, tpu.slice.shape) directly into device meshes.
+"""
+
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def parse_shape(text):
+    """Parses "4x4" / "2x2x1" into a tuple of ints (the C++ grammar's twin,
+    src/tfd/slice/shape.cc ParseShape)."""
+    parts = str(text).strip().split("x")
+    if len(parts) < 2 or len(parts) > 3:
+        raise ValueError(f"invalid slice shape {text!r}: want 2 or 3 dims")
+    dims = []
+    for part in parts:
+        if not part.isdigit():
+            raise ValueError(f"invalid slice shape {text!r}")
+        value = int(part)
+        if value < 1:
+            raise ValueError(f"invalid slice shape {text!r}: dims must be >= 1")
+        dims.append(value)
+    return tuple(dims)
+
+
+def num_chips(shape_text):
+    return math.prod(parse_shape(shape_text))
+
+
+def balanced_2d(n):
+    """The squarest (a, b) with a*b == n and a <= b — same rule the daemon
+    uses for default 2D topologies (src/tfd/slice/topology.cc)."""
+    a = int(math.isqrt(n))
+    while a > 1 and n % a:
+        a -= 1
+    return (a, n // a)
+
+
+def data_model_mesh(devices=None, model_parallelism=None):
+    """A ('data', 'model') mesh over the given (default: all) devices.
+
+    `model_parallelism` defaults to the largest power-of-2 divisor of the
+    device count capped at 8 — a sensible tensor-parallel group size that
+    stays inside one ICI domain on current TPU hosts.
+    """
+    devices = list(jax.devices() if devices is None else devices)
+    n = len(devices)
+    if model_parallelism is None:
+        model_parallelism = 1
+        while (model_parallelism < 8 and n % (model_parallelism * 2) == 0):
+            model_parallelism *= 2
+    if n % model_parallelism:
+        raise ValueError(
+            f"{n} devices not divisible by model_parallelism="
+            f"{model_parallelism}")
+    grid = np.array(devices).reshape(n // model_parallelism,
+                                     model_parallelism)
+    return Mesh(grid, ("data", "model"))
+
+
+def topology_mesh(topology_text, devices=None, axis_names=None):
+    """A mesh shaped like the physical slice topology label
+    (e.g. "4x4" -> 4x4 mesh with axes ('x', 'y')).
+
+    Laying the mesh out in topology order keeps neighboring mesh coordinates
+    on neighboring chips, so collectives ride single-hop ICI links.
+    """
+    dims = parse_shape(topology_text)
+    devices = list(jax.devices() if devices is None else devices)
+    if len(devices) != math.prod(dims):
+        raise ValueError(
+            f"topology {topology_text} needs {math.prod(dims)} devices, "
+            f"have {len(devices)}")
+    if axis_names is None:
+        axis_names = ("x", "y", "z")[:len(dims)]
+    grid = np.array(devices).reshape(dims)
+    return Mesh(grid, tuple(axis_names))

@@ -15,6 +15,7 @@
 #include "tfd/config/config.h"
 #include "tfd/gce/metadata.h"
 #include "tfd/info/version.h"
+#include "tfd/k8s/client.h"
 #include "tfd/lm/labeler.h"
 #include "tfd/lm/labels.h"
 #include "tfd/lm/machine_type.h"
@@ -74,7 +75,16 @@ Status LabelOnce(const config::Config& config, lm::Labeler& timestamp,
                     << " label(s) generated; is this a TPU node?";
   }
 
-  Status out = lm::OutputToFile(merged, config.flags.output_file);
+  // Output dispatch (reference labels.go:49-56): NodeFeature CR when the
+  // NodeFeature API is enabled, else the feature file / stdout.
+  Status out;
+  if (config.flags.use_node_feature_api) {
+    Result<k8s::ClusterConfig> cluster = k8s::LoadInClusterConfig();
+    if (!cluster.ok()) return cluster.status();
+    out = k8s::UpdateNodeFeature(*cluster, merged);
+  } else {
+    out = lm::OutputToFile(merged, config.flags.output_file);
+  }
   if (!out.ok()) return out;
 
   auto ms = std::chrono::duration_cast<std::chrono::milliseconds>(

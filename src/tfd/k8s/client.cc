@@ -1,0 +1,191 @@
+#include "tfd/k8s/client.h"
+
+#include <cstdlib>
+
+#include "tfd/util/file.h"
+#include "tfd/util/http.h"
+#include "tfd/util/jsonlite.h"
+#include "tfd/util/logging.h"
+#include "tfd/util/strings.h"
+
+namespace tfd {
+namespace k8s {
+
+namespace {
+
+constexpr char kDefaultSaDir[] =
+    "/var/run/secrets/kubernetes.io/serviceaccount";
+constexpr char kNfdGroup[] = "nfd.k8s-sigs.io";
+constexpr char kNfdVersion[] = "v1alpha1";
+
+std::string SaDir() {
+  if (const char* dir = std::getenv("TFD_SERVICEACCOUNT_DIR")) return dir;
+  return kDefaultSaDir;
+}
+
+std::string CrName(const std::string& node) {
+  // Reference: "nvidia-features-for-<node>" (labels.go:38).
+  return "tfd-features-for-" + node;
+}
+
+std::string CrUrl(const ClusterConfig& config, bool named) {
+  std::string url = config.apiserver_url + "/apis/" + kNfdGroup + "/" +
+                    kNfdVersion + "/namespaces/" + config.namespace_ +
+                    "/nodefeatures";
+  if (named) url += "/" + CrName(config.node_name);
+  return url;
+}
+
+http::RequestOptions BaseOptions(const ClusterConfig& config) {
+  http::RequestOptions options;
+  options.ca_file = config.ca_file;
+  if (!config.token.empty()) {
+    options.headers["Authorization"] = "Bearer " + config.token;
+  }
+  options.headers["Accept"] = "application/json";
+  return options;
+}
+
+// The CR body. spec.labels values become node labels via the NFD master;
+// the nfd node-name label tells NFD which node this CR describes.
+std::string CrBody(const ClusterConfig& config, const lm::Labels& labels,
+                   const std::string& resource_version) {
+  std::map<std::string, std::string> spec_labels(labels.begin(),
+                                                 labels.end());
+  std::string body =
+      std::string("{\"apiVersion\":\"") + kNfdGroup + "/" + kNfdVersion +
+      "\",\"kind\":\"NodeFeature\"," + "\"metadata\":{\"name\":" +
+      jsonlite::Quote(CrName(config.node_name)) +
+      ",\"namespace\":" + jsonlite::Quote(config.namespace_) +
+      ",\"labels\":{\"nfd.node.kubernetes.io/node-name\":" +
+      jsonlite::Quote(config.node_name) + "}";
+  if (!resource_version.empty()) {
+    body += ",\"resourceVersion\":" + jsonlite::Quote(resource_version);
+  }
+  body += "},\"spec\":{\"labels\":" +
+          jsonlite::SerializeStringMap(spec_labels) + "}}";
+  return body;
+}
+
+}  // namespace
+
+Result<ClusterConfig> LoadInClusterConfig() {
+  ClusterConfig config;
+
+  const char* node = std::getenv("NODE_NAME");
+  if (node == nullptr || *node == '\0') {
+    return Result<ClusterConfig>::Error(
+        "NODE_NAME environment variable not set (required for the "
+        "NodeFeature API sink)");
+  }
+  config.node_name = node;
+
+  if (const char* url = std::getenv("TFD_APISERVER_URL")) {
+    config.apiserver_url = url;
+  } else {
+    const char* host = std::getenv("KUBERNETES_SERVICE_HOST");
+    const char* port = std::getenv("KUBERNETES_SERVICE_PORT");
+    if (host == nullptr || *host == '\0') {
+      return Result<ClusterConfig>::Error(
+          "not running in a cluster (KUBERNETES_SERVICE_HOST unset) and "
+          "TFD_APISERVER_URL not provided");
+    }
+    config.apiserver_url = std::string("https://") + host + ":" +
+                           (port != nullptr && *port ? port : "443");
+  }
+
+  std::string sa_dir = SaDir();
+  Result<std::string> token = ReadFile(sa_dir + "/token");
+  if (token.ok()) config.token = TrimSpace(*token);
+  if (FileExists(sa_dir + "/ca.crt")) config.ca_file = sa_dir + "/ca.crt";
+
+  // Namespace precedence: KUBERNETES_NAMESPACE > serviceaccount file >
+  // "default" (reference k8s-client.go:39-51).
+  if (const char* ns_env = std::getenv("KUBERNETES_NAMESPACE")) {
+    config.namespace_ = ns_env;
+  } else {
+    Result<std::string> ns_file = ReadFile(sa_dir + "/namespace");
+    config.namespace_ = ns_file.ok() ? TrimSpace(*ns_file) : "default";
+  }
+  if (config.namespace_.empty()) config.namespace_ = "default";
+  return config;
+}
+
+Status UpdateNodeFeature(const ClusterConfig& config,
+                         const lm::Labels& labels) {
+  http::RequestOptions options = BaseOptions(config);
+
+  // Get → create-if-missing → update-if-changed (labels.go:152-183).
+  Result<http::Response> existing =
+      http::Request("GET", CrUrl(config, true), "", options);
+  if (!existing.ok()) {
+    return Status::Error("getting NodeFeature CR: " + existing.error());
+  }
+
+  if (existing->status == 404) {
+    http::RequestOptions post = options;
+    post.headers["Content-Type"] = "application/json";
+    Result<http::Response> created = http::Request(
+        "POST", CrUrl(config, false), CrBody(config, labels, ""), post);
+    if (!created.ok()) {
+      return Status::Error("creating NodeFeature CR: " + created.error());
+    }
+    if (created->status != 201 && created->status != 200) {
+      return Status::Error("creating NodeFeature CR: HTTP " +
+                           std::to_string(created->status) + ": " +
+                           created->body.substr(0, 512));
+    }
+    TFD_LOG_INFO << "created NodeFeature CR " << CrName(config.node_name);
+    return Status::Ok();
+  }
+  if (existing->status != 200) {
+    return Status::Error("getting NodeFeature CR: HTTP " +
+                         std::to_string(existing->status) + ": " +
+                         existing->body.substr(0, 512));
+  }
+
+  Result<jsonlite::ValuePtr> parsed = jsonlite::Parse(existing->body);
+  if (!parsed.ok()) {
+    return Status::Error("parsing NodeFeature CR: " + parsed.error());
+  }
+
+  // Semantic-equality check to skip no-op updates (labels.go:170-176).
+  jsonlite::ValuePtr current = (*parsed)->GetPath("spec.labels");
+  if (current && current->kind == jsonlite::Value::Kind::kObject &&
+      current->object_items.size() == labels.size()) {
+    bool equal = true;
+    for (const auto& [k, v] : current->object_items) {
+      auto it = labels.find(k);
+      if (it == labels.end() ||
+          v->kind != jsonlite::Value::Kind::kString ||
+          v->string_value != it->second) {
+        equal = false;
+        break;
+      }
+    }
+    if (equal) return Status::Ok();
+  }
+
+  jsonlite::ValuePtr rv = (*parsed)->GetPath("metadata.resourceVersion");
+  std::string resource_version =
+      rv && rv->kind == jsonlite::Value::Kind::kString ? rv->string_value
+                                                       : "";
+  http::RequestOptions put = options;
+  put.headers["Content-Type"] = "application/json";
+  Result<http::Response> updated =
+      http::Request("PUT", CrUrl(config, true),
+                    CrBody(config, labels, resource_version), put);
+  if (!updated.ok()) {
+    return Status::Error("updating NodeFeature CR: " + updated.error());
+  }
+  if (updated->status != 200) {
+    return Status::Error("updating NodeFeature CR: HTTP " +
+                         std::to_string(updated->status) + ": " +
+                         updated->body.substr(0, 512));
+  }
+  TFD_LOG_INFO << "updated NodeFeature CR " << CrName(config.node_name);
+  return Status::Ok();
+}
+
+}  // namespace k8s
+}  // namespace tfd

@@ -1,0 +1,41 @@
+// Kubernetes in-cluster client + NodeFeature CR sink.
+//
+// Reference parity: internal/kubernetes/k8s-client.go (NODE_NAME env,
+// namespace from the serviceaccount file or KUBERNETES_NAMESPACE, NFD
+// clientset from in-cluster config) and internal/lm/labels.go:141-184
+// (UpdateNodeFeatureObject: get → create-if-missing → update-if-changed on
+// the NodeFeature CR named after the node). No client-go here: the CR is
+// plain JSON over the API server's REST endpoints via tfd::http.
+//
+// Test hooks: TFD_APISERVER_URL overrides the in-cluster URL (http:// or
+// https://), TFD_SERVICEACCOUNT_DIR overrides
+// /var/run/secrets/kubernetes.io/serviceaccount.
+#pragma once
+
+#include <string>
+
+#include "tfd/lm/labeler.h"
+#include "tfd/util/status.h"
+
+namespace tfd {
+namespace k8s {
+
+struct ClusterConfig {
+  std::string apiserver_url;  // e.g. https://10.0.0.1:443
+  std::string token;          // bearer token ("" = no auth header)
+  std::string ca_file;        // PEM path ("" = system roots)
+  std::string namespace_;     // CR namespace
+  std::string node_name;      // from NODE_NAME
+};
+
+// Loads in-cluster config (reference k8s-client.go:30-66). Errors when
+// NODE_NAME or the API server location is missing.
+Result<ClusterConfig> LoadInClusterConfig();
+
+// Creates or updates the NodeFeature CR "tfd-features-for-<node>" carrying
+// `labels` (reference labels.go:141-184; CR name pattern labels.go:38).
+Status UpdateNodeFeature(const ClusterConfig& config,
+                         const lm::Labels& labels);
+
+}  // namespace k8s
+}  // namespace tfd

@@ -1,0 +1,382 @@
+#include "tfd/util/http.h"
+
+#include <dlfcn.h>
+#include <netdb.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <memory>
+#include <mutex>
+#include <type_traits>
+
+#include "tfd/util/strings.h"
+
+namespace tfd {
+namespace http {
+
+namespace {
+
+// ---- OpenSSL via dlopen: hand-declared prototypes for the 3.x ABI ----
+// Constants from the stable OpenSSL public API.
+constexpr int kSslVerifyPeer = 0x01;
+constexpr long kSslCtrlSetTlsExtHostname = 55;
+constexpr int kTlsExtNametypeHostName = 0;
+constexpr int kSslErrorZeroReturn = 6;
+
+struct OpenSsl {
+  void* ssl_handle = nullptr;
+  void* crypto_handle = nullptr;
+
+  // libssl
+  const void* (*TLS_client_method)() = nullptr;
+  void* (*SSL_CTX_new)(const void*) = nullptr;
+  void (*SSL_CTX_free)(void*) = nullptr;
+  int (*SSL_CTX_load_verify_locations)(void*, const char*, const char*) =
+      nullptr;
+  int (*SSL_CTX_set_default_verify_paths)(void*) = nullptr;
+  void (*SSL_CTX_set_verify)(void*, int, void*) = nullptr;
+  void* (*SSL_new)(void*) = nullptr;
+  void (*SSL_free)(void*) = nullptr;
+  int (*SSL_set_fd)(void*, int) = nullptr;
+  int (*SSL_set1_host)(void*, const char*) = nullptr;
+  long (*SSL_ctrl)(void*, int, long, void*) = nullptr;
+  int (*SSL_connect)(void*) = nullptr;
+  int (*SSL_read)(void*, void*, int) = nullptr;
+  int (*SSL_write)(void*, const void*, int) = nullptr;
+  int (*SSL_shutdown)(void*) = nullptr;
+  int (*SSL_get_error)(const void*, int) = nullptr;
+
+  // libcrypto
+  unsigned long (*ERR_get_error)() = nullptr;
+  void (*ERR_error_string_n)(unsigned long, char*, size_t) = nullptr;
+
+  bool ok = false;
+  std::string error;
+};
+
+const OpenSsl& GetOpenSsl() {
+  static OpenSsl ssl = [] {
+    OpenSsl s;
+    s.crypto_handle = dlopen("libcrypto.so.3", RTLD_NOW | RTLD_GLOBAL);
+    s.ssl_handle = dlopen("libssl.so.3", RTLD_NOW | RTLD_LOCAL);
+    if (s.ssl_handle == nullptr || s.crypto_handle == nullptr) {
+      s.error = "OpenSSL 3 not available: ";
+      s.error += dlerror() ? dlerror() : "dlopen failed";
+      return s;
+    }
+    bool all = true;
+    auto load = [&](auto& fn, const char* name, void* handle) {
+      fn = reinterpret_cast<std::remove_reference_t<decltype(fn)>>(
+          dlsym(handle, name));
+      if (fn == nullptr) {
+        all = false;
+        s.error = std::string("missing OpenSSL symbol ") + name;
+      }
+    };
+    load(s.TLS_client_method, "TLS_client_method", s.ssl_handle);
+    load(s.SSL_CTX_new, "SSL_CTX_new", s.ssl_handle);
+    load(s.SSL_CTX_free, "SSL_CTX_free", s.ssl_handle);
+    load(s.SSL_CTX_load_verify_locations, "SSL_CTX_load_verify_locations",
+         s.ssl_handle);
+    load(s.SSL_CTX_set_default_verify_paths,
+         "SSL_CTX_set_default_verify_paths", s.ssl_handle);
+    load(s.SSL_CTX_set_verify, "SSL_CTX_set_verify", s.ssl_handle);
+    load(s.SSL_new, "SSL_new", s.ssl_handle);
+    load(s.SSL_free, "SSL_free", s.ssl_handle);
+    load(s.SSL_set_fd, "SSL_set_fd", s.ssl_handle);
+    load(s.SSL_set1_host, "SSL_set1_host", s.ssl_handle);
+    load(s.SSL_ctrl, "SSL_ctrl", s.ssl_handle);
+    load(s.SSL_connect, "SSL_connect", s.ssl_handle);
+    load(s.SSL_read, "SSL_read", s.ssl_handle);
+    load(s.SSL_write, "SSL_write", s.ssl_handle);
+    load(s.SSL_shutdown, "SSL_shutdown", s.ssl_handle);
+    load(s.SSL_get_error, "SSL_get_error", s.ssl_handle);
+    load(s.ERR_get_error, "ERR_get_error", s.crypto_handle);
+    load(s.ERR_error_string_n, "ERR_error_string_n", s.crypto_handle);
+    s.ok = all;
+    return s;
+  }();
+  return ssl;
+}
+
+std::string SslErrorString() {
+  const OpenSsl& ssl = GetOpenSsl();
+  if (!ssl.ok) return "openssl unavailable";
+  unsigned long code = ssl.ERR_get_error();
+  if (code == 0) return "unknown TLS error";
+  char buf[256];
+  ssl.ERR_error_string_n(code, buf, sizeof(buf));
+  return buf;
+}
+
+struct Url {
+  bool tls = false;
+  std::string host;
+  int port = 80;
+  std::string path = "/";
+};
+
+Result<Url> ParseUrl(const std::string& url) {
+  Url out;
+  std::string rest;
+  if (HasPrefix(url, "https://")) {
+    out.tls = true;
+    out.port = 443;
+    rest = url.substr(8);
+  } else if (HasPrefix(url, "http://")) {
+    rest = url.substr(7);
+  } else {
+    return Result<Url>::Error("unsupported URL scheme in " + url);
+  }
+  size_t slash = rest.find('/');
+  std::string hostport = slash == std::string::npos ? rest
+                                                    : rest.substr(0, slash);
+  out.path = slash == std::string::npos ? "/" : rest.substr(slash);
+  size_t colon = hostport.rfind(':');
+  if (colon != std::string::npos && hostport.find(']') == std::string::npos) {
+    out.port = atoi(hostport.c_str() + colon + 1);
+    out.host = hostport.substr(0, colon);
+  } else {
+    out.host = hostport;
+  }
+  if (out.host.empty()) return Result<Url>::Error("empty host in " + url);
+  return out;
+}
+
+Result<int> Connect(const Url& url, int timeout_ms) {
+  addrinfo hints{};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  std::string port = std::to_string(url.port);
+  int rc = getaddrinfo(url.host.c_str(), port.c_str(), &hints, &res);
+  if (rc != 0) {
+    return Result<int>::Error("resolve " + url.host + ": " +
+                              gai_strerror(rc));
+  }
+  int fd = -1;
+  for (addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+    fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    timeval tv{};
+    tv.tv_sec = timeout_ms / 1000;
+    tv.tv_usec = (timeout_ms % 1000) * 1000;
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  if (fd < 0) {
+    return Result<int>::Error("connect to " + url.host + ":" + port +
+                              " failed: " + strerror(errno));
+  }
+  return fd;
+}
+
+// Transport abstraction over plain fd / TLS.
+class Transport {
+ public:
+  virtual ~Transport() = default;
+  virtual Result<int> Write(const char* data, int len) = 0;
+  virtual Result<int> Read(char* data, int len) = 0;  // 0 = EOF
+};
+
+class PlainTransport : public Transport {
+ public:
+  explicit PlainTransport(int fd) : fd_(fd) {}
+  ~PlainTransport() override { close(fd_); }
+
+  Result<int> Write(const char* data, int len) override {
+    ssize_t n = send(fd_, data, len, 0);
+    if (n < 0) return Result<int>::Error(strerror(errno));
+    return static_cast<int>(n);
+  }
+  Result<int> Read(char* data, int len) override {
+    ssize_t n = recv(fd_, data, len, 0);
+    if (n < 0) return Result<int>::Error(strerror(errno));
+    return static_cast<int>(n);
+  }
+
+ private:
+  int fd_;
+};
+
+class TlsTransport : public Transport {
+ public:
+  static Result<std::unique_ptr<Transport>> Create(
+      int fd, const Url& url, const RequestOptions& options) {
+    const OpenSsl& ssl = GetOpenSsl();
+    if (!ssl.ok) {
+      close(fd);
+      return Result<std::unique_ptr<Transport>>::Error(
+          "https requested but " +
+          (ssl.error.empty() ? "OpenSSL unavailable" : ssl.error));
+    }
+    void* ctx = ssl.SSL_CTX_new(ssl.TLS_client_method());
+    if (ctx == nullptr) {
+      close(fd);
+      return Result<std::unique_ptr<Transport>>::Error("SSL_CTX_new: " +
+                                                       SslErrorString());
+    }
+    if (!options.insecure) {
+      int ok = options.ca_file.empty()
+                   ? ssl.SSL_CTX_set_default_verify_paths(ctx)
+                   : ssl.SSL_CTX_load_verify_locations(
+                         ctx, options.ca_file.c_str(), nullptr);
+      if (ok != 1) {
+        std::string err = SslErrorString();
+        ssl.SSL_CTX_free(ctx);
+        close(fd);
+        return Result<std::unique_ptr<Transport>>::Error(
+            "loading CA certificates (" + options.ca_file + "): " + err);
+      }
+      ssl.SSL_CTX_set_verify(ctx, kSslVerifyPeer, nullptr);
+    }
+    void* s = ssl.SSL_new(ctx);
+    if (s == nullptr) {
+      ssl.SSL_CTX_free(ctx);
+      close(fd);
+      return Result<std::unique_ptr<Transport>>::Error("SSL_new: " +
+                                                       SslErrorString());
+    }
+    ssl.SSL_set_fd(s, fd);
+    // SNI + hostname verification.
+    ssl.SSL_ctrl(s, kSslCtrlSetTlsExtHostname, kTlsExtNametypeHostName,
+                 const_cast<char*>(url.host.c_str()));
+    if (!options.insecure) ssl.SSL_set1_host(s, url.host.c_str());
+    if (ssl.SSL_connect(s) != 1) {
+      std::string err = SslErrorString();
+      ssl.SSL_free(s);
+      ssl.SSL_CTX_free(ctx);
+      close(fd);
+      return Result<std::unique_ptr<Transport>>::Error(
+          "TLS handshake with " + url.host + " failed: " + err);
+    }
+    return std::unique_ptr<Transport>(new TlsTransport(ctx, s, fd));
+  }
+
+  ~TlsTransport() override {
+    const OpenSsl& ssl = GetOpenSsl();
+    ssl.SSL_shutdown(ssl_);
+    ssl.SSL_free(ssl_);
+    ssl.SSL_CTX_free(ctx_);
+    close(fd_);
+  }
+
+  Result<int> Write(const char* data, int len) override {
+    const OpenSsl& ssl = GetOpenSsl();
+    int n = ssl.SSL_write(ssl_, data, len);
+    if (n <= 0) return Result<int>::Error("SSL_write: " + SslErrorString());
+    return n;
+  }
+
+  Result<int> Read(char* data, int len) override {
+    const OpenSsl& ssl = GetOpenSsl();
+    int n = ssl.SSL_read(ssl_, data, len);
+    if (n <= 0) {
+      int err = ssl.SSL_get_error(ssl_, n);
+      if (err == kSslErrorZeroReturn) return 0;  // clean close
+      // A peer that closes without close_notify after a complete response
+      // is tolerated by every HTTP client; treat as EOF.
+      return 0;
+    }
+    return n;
+  }
+
+ private:
+  TlsTransport(void* ctx, void* ssl, int fd)
+      : ctx_(ctx), ssl_(ssl), fd_(fd) {}
+  void* ctx_;
+  void* ssl_;
+  int fd_;
+};
+
+Result<Response> ParseResponse(const std::string& raw) {
+  size_t header_end = raw.find("\r\n\r\n");
+  if (header_end == std::string::npos) {
+    return Result<Response>::Error("malformed HTTP response");
+  }
+  std::string headers = raw.substr(0, header_end);
+  std::string body = raw.substr(header_end + 4);
+  size_t sp = headers.find(' ');
+  if (sp == std::string::npos) {
+    return Result<Response>::Error("malformed HTTP status line");
+  }
+  Response out;
+  out.status = atoi(headers.c_str() + sp + 1);
+  if (ToLower(headers).find("transfer-encoding: chunked") !=
+      std::string::npos) {
+    std::string decoded;
+    size_t pos = 0;
+    while (pos < body.size()) {
+      size_t eol = body.find("\r\n", pos);
+      if (eol == std::string::npos) break;
+      long chunk = strtol(body.substr(pos, eol - pos).c_str(), nullptr, 16);
+      if (chunk <= 0) break;
+      decoded += body.substr(eol + 2, static_cast<size_t>(chunk));
+      pos = eol + 2 + static_cast<size_t>(chunk) + 2;
+    }
+    body = decoded;
+  }
+  out.body = std::move(body);
+  return out;
+}
+
+}  // namespace
+
+Result<Response> Request(const std::string& method, const std::string& url,
+                         const std::string& body,
+                         const RequestOptions& options) {
+  Result<Url> parsed = ParseUrl(url);
+  if (!parsed.ok()) return Result<Response>::Error(parsed.error());
+
+  Result<int> fd = Connect(*parsed, options.timeout_ms);
+  if (!fd.ok()) return Result<Response>::Error(fd.error());
+
+  std::unique_ptr<Transport> transport;
+  if (parsed->tls) {
+    Result<std::unique_ptr<Transport>> tls =
+        TlsTransport::Create(*fd, *parsed, options);
+    if (!tls.ok()) return Result<Response>::Error(tls.error());
+    transport = std::move(*tls);
+  } else {
+    transport = std::make_unique<PlainTransport>(*fd);
+  }
+
+  std::string request = method + " " + parsed->path + " HTTP/1.1\r\n" +
+                        "Host: " + parsed->host + "\r\n";
+  for (const auto& [k, v] : options.headers) {
+    request += k + ": " + v + "\r\n";
+  }
+  if (!body.empty() || method == "POST" || method == "PUT") {
+    request += "Content-Length: " + std::to_string(body.size()) + "\r\n";
+  }
+  request += "Connection: close\r\n\r\n" + body;
+
+  size_t off = 0;
+  while (off < request.size()) {
+    Result<int> n = transport->Write(request.data() + off,
+                                     static_cast<int>(request.size() - off));
+    if (!n.ok()) return Result<Response>::Error("send failed: " + n.error());
+    off += static_cast<size_t>(*n);
+  }
+
+  std::string raw;
+  char buf[8192];
+  while (true) {
+    Result<int> n = transport->Read(buf, sizeof(buf));
+    if (!n.ok()) return Result<Response>::Error("recv failed: " + n.error());
+    if (*n == 0) break;
+    raw.append(buf, static_cast<size_t>(*n));
+    if (raw.size() > 16 * 1024 * 1024) {
+      return Result<Response>::Error("HTTP response too large");
+    }
+  }
+  return ParseResponse(raw);
+}
+
+}  // namespace http
+}  // namespace tfd

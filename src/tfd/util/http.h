@@ -1,0 +1,38 @@
+// Minimal HTTP/1.1 client with optional TLS for the Kubernetes API.
+//
+// The reference gets HTTPS for free from client-go; this build keeps its
+// zero-link-dependency rule instead: TLS comes from dlopen'd
+// libssl.so.3/libcrypto.so.3 with hand-declared prototypes — the same
+// runtime-resolution pattern as the libtpu binding (and the reference's
+// dlopen of libnvidia-ml, internal/cuda/api.go:23-55). On hosts without
+// OpenSSL, https:// requests fail cleanly and http:// still works.
+#pragma once
+
+#include <map>
+#include <string>
+
+#include "tfd/util/status.h"
+
+namespace tfd {
+namespace http {
+
+struct Response {
+  int status = 0;
+  std::string body;
+};
+
+struct RequestOptions {
+  std::map<std::string, std::string> headers;
+  std::string ca_file;      // PEM bundle for server verification (https)
+  bool insecure = false;    // skip server verification (tests only)
+  int timeout_ms = 5000;    // per socket operation
+};
+
+// `url`: http://host[:port]/path or https://host[:port]/path.
+// `method`: GET/POST/PUT/DELETE; `body` sent for POST/PUT.
+Result<Response> Request(const std::string& method, const std::string& url,
+                         const std::string& body,
+                         const RequestOptions& options);
+
+}  // namespace http
+}  // namespace tfd

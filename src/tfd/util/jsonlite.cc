@@ -1,0 +1,273 @@
+#include "tfd/util/jsonlite.h"
+
+#include <cctype>
+#include <cstdio>
+#include <sstream>
+
+namespace tfd {
+namespace jsonlite {
+
+namespace {
+
+class Parser {
+ public:
+  explicit Parser(const std::string& text) : text_(text) {}
+
+  Result<ValuePtr> Parse() {
+    SkipWs();
+    Result<ValuePtr> v = ParseValue(0);
+    if (!v.ok()) return v;
+    SkipWs();
+    if (pos_ != text_.size()) {
+      return Result<ValuePtr>::Error("json: trailing data at offset " +
+                                     std::to_string(pos_));
+    }
+    return v;
+  }
+
+ private:
+  Result<ValuePtr> Fail(const std::string& msg) {
+    return Result<ValuePtr>::Error("json: " + msg + " at offset " +
+                                   std::to_string(pos_));
+  }
+
+  void SkipWs() {
+    while (pos_ < text_.size() &&
+           std::isspace(static_cast<unsigned char>(text_[pos_]))) {
+      pos_++;
+    }
+  }
+
+  bool Consume(char c) {
+    if (pos_ < text_.size() && text_[pos_] == c) {
+      pos_++;
+      return true;
+    }
+    return false;
+  }
+
+  Result<ValuePtr> ParseValue(int depth) {
+    if (depth > 64) return Fail("nesting too deep");
+    if (pos_ >= text_.size()) return Fail("unexpected end");
+    char c = text_[pos_];
+    if (c == '{') return ParseObject(depth);
+    if (c == '[') return ParseArray(depth);
+    if (c == '"') return ParseString();
+    if (c == 't' || c == 'f') return ParseBool();
+    if (c == 'n') return ParseNull();
+    return ParseNumber();
+  }
+
+  Result<ValuePtr> ParseObject(int depth) {
+    auto v = std::make_shared<Value>();
+    v->kind = Value::Kind::kObject;
+    pos_++;  // '{'
+    SkipWs();
+    if (Consume('}')) return v;
+    while (true) {
+      SkipWs();
+      Result<ValuePtr> key = ParseString();
+      if (!key.ok()) return key;
+      SkipWs();
+      if (!Consume(':')) return Fail("expected ':'");
+      SkipWs();
+      Result<ValuePtr> val = ParseValue(depth + 1);
+      if (!val.ok()) return val;
+      v->object_items.emplace_back((*key)->string_value, *val);
+      SkipWs();
+      if (Consume(',')) continue;
+      if (Consume('}')) return v;
+      return Fail("expected ',' or '}'");
+    }
+  }
+
+  Result<ValuePtr> ParseArray(int depth) {
+    auto v = std::make_shared<Value>();
+    v->kind = Value::Kind::kArray;
+    pos_++;  // '['
+    SkipWs();
+    if (Consume(']')) return v;
+    while (true) {
+      SkipWs();
+      Result<ValuePtr> item = ParseValue(depth + 1);
+      if (!item.ok()) return item;
+      v->array_items.push_back(*item);
+      SkipWs();
+      if (Consume(',')) continue;
+      if (Consume(']')) return v;
+      return Fail("expected ',' or ']'");
+    }
+  }
+
+  Result<ValuePtr> ParseString() {
+    if (!Consume('"')) return Fail("expected string");
+    auto v = std::make_shared<Value>();
+    v->kind = Value::Kind::kString;
+    std::string& out = v->string_value;
+    while (pos_ < text_.size()) {
+      char c = text_[pos_++];
+      if (c == '"') return v;
+      if (c == '\\') {
+        if (pos_ >= text_.size()) return Fail("bad escape");
+        char e = text_[pos_++];
+        switch (e) {
+          case '"': out.push_back('"'); break;
+          case '\\': out.push_back('\\'); break;
+          case '/': out.push_back('/'); break;
+          case 'b': out.push_back('\b'); break;
+          case 'f': out.push_back('\f'); break;
+          case 'n': out.push_back('\n'); break;
+          case 'r': out.push_back('\r'); break;
+          case 't': out.push_back('\t'); break;
+          case 'u': {
+            if (pos_ + 4 > text_.size()) return Fail("bad \\u escape");
+            unsigned int code = 0;
+            for (int i = 0; i < 4; i++) {
+              char h = text_[pos_++];
+              code <<= 4;
+              if (h >= '0' && h <= '9') code |= h - '0';
+              else if (h >= 'a' && h <= 'f') code |= h - 'a' + 10;
+              else if (h >= 'A' && h <= 'F') code |= h - 'A' + 10;
+              else return Fail("bad \\u escape");
+            }
+            // UTF-8 encode (BMP only; surrogate pairs pass through as-is).
+            if (code < 0x80) {
+              out.push_back(static_cast<char>(code));
+            } else if (code < 0x800) {
+              out.push_back(static_cast<char>(0xC0 | (code >> 6)));
+              out.push_back(static_cast<char>(0x80 | (code & 0x3F)));
+            } else {
+              out.push_back(static_cast<char>(0xE0 | (code >> 12)));
+              out.push_back(static_cast<char>(0x80 | ((code >> 6) & 0x3F)));
+              out.push_back(static_cast<char>(0x80 | (code & 0x3F)));
+            }
+            break;
+          }
+          default:
+            return Fail("bad escape");
+        }
+      } else {
+        out.push_back(c);
+      }
+    }
+    return Fail("unterminated string");
+  }
+
+  Result<ValuePtr> ParseBool() {
+    auto v = std::make_shared<Value>();
+    v->kind = Value::Kind::kBool;
+    if (text_.compare(pos_, 4, "true") == 0) {
+      v->bool_value = true;
+      pos_ += 4;
+      return v;
+    }
+    if (text_.compare(pos_, 5, "false") == 0) {
+      v->bool_value = false;
+      pos_ += 5;
+      return v;
+    }
+    return Fail("bad literal");
+  }
+
+  Result<ValuePtr> ParseNull() {
+    if (text_.compare(pos_, 4, "null") == 0) {
+      pos_ += 4;
+      auto v = std::make_shared<Value>();
+      return v;
+    }
+    return Fail("bad literal");
+  }
+
+  Result<ValuePtr> ParseNumber() {
+    size_t start = pos_;
+    while (pos_ < text_.size() &&
+           (std::isdigit(static_cast<unsigned char>(text_[pos_])) ||
+            text_[pos_] == '-' || text_[pos_] == '+' || text_[pos_] == '.' ||
+            text_[pos_] == 'e' || text_[pos_] == 'E')) {
+      pos_++;
+    }
+    if (pos_ == start) return Fail("unexpected character");
+    auto v = std::make_shared<Value>();
+    v->kind = Value::Kind::kNumber;
+    try {
+      v->number_value = std::stod(text_.substr(start, pos_ - start));
+    } catch (...) {
+      return Fail("bad number");
+    }
+    return v;
+  }
+
+  const std::string& text_;
+  size_t pos_ = 0;
+};
+
+}  // namespace
+
+ValuePtr Value::Get(const std::string& key) const {
+  if (kind != Kind::kObject) return nullptr;
+  for (const auto& [k, v] : object_items) {
+    if (k == key) return v;
+  }
+  return nullptr;
+}
+
+ValuePtr Value::GetPath(const std::string& dotted) const {
+  const Value* cur = this;
+  ValuePtr found;
+  size_t pos = 0;
+  while (pos <= dotted.size()) {
+    size_t dot = dotted.find('.', pos);
+    if (dot == std::string::npos) dot = dotted.size();
+    found = cur->Get(dotted.substr(pos, dot - pos));
+    if (!found) return nullptr;
+    cur = found.get();
+    pos = dot + 1;
+    if (dot == dotted.size()) break;
+  }
+  return found;
+}
+
+Result<ValuePtr> Parse(const std::string& text) {
+  Parser p(text);
+  return p.Parse();
+}
+
+std::string Quote(const std::string& s) {
+  std::string out = "\"";
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\b': out += "\\b"; break;
+      case '\f': out += "\\f"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out += buf;
+        } else {
+          out.push_back(static_cast<char>(c));
+        }
+    }
+  }
+  return out + "\"";
+}
+
+std::string SerializeStringMap(const std::map<std::string, std::string>& m) {
+  std::ostringstream out;
+  out << "{";
+  bool first = true;
+  for (const auto& [k, v] : m) {
+    if (!first) out << ",";
+    first = false;
+    out << Quote(k) << ":" << Quote(v);
+  }
+  out << "}";
+  return out.str();
+}
+
+}  // namespace jsonlite
+}  // namespace tfd

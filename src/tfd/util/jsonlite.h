@@ -1,0 +1,47 @@
+// jsonlite: a minimal JSON parser/serializer for the k8s client.
+//
+// The reference leans on client-go + apimachinery for NodeFeature CR
+// marshalling (internal/lm/labels.go:141-184); this build talks to the API
+// server directly over HTTP, so it needs just enough JSON: parse a CR to
+// read metadata.resourceVersion and spec.labels, and serialize string maps.
+#pragma once
+
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "tfd/util/status.h"
+
+namespace tfd {
+namespace jsonlite {
+
+struct Value;
+using ValuePtr = std::shared_ptr<Value>;
+
+struct Value {
+  enum class Kind { kNull, kBool, kNumber, kString, kArray, kObject };
+  Kind kind = Kind::kNull;
+
+  bool bool_value = false;
+  double number_value = 0;
+  std::string string_value;
+  std::vector<ValuePtr> array_items;
+  std::vector<std::pair<std::string, ValuePtr>> object_items;  // in order
+
+  // Object lookup; nullptr if absent or not an object.
+  ValuePtr Get(const std::string& key) const;
+  // Dotted-path lookup: Get("metadata.resourceVersion").
+  ValuePtr GetPath(const std::string& dotted) const;
+};
+
+Result<ValuePtr> Parse(const std::string& text);
+
+// Escapes and quotes a JSON string.
+std::string Quote(const std::string& s);
+
+// Serializes a string map as a JSON object with sorted keys (deterministic).
+std::string SerializeStringMap(const std::map<std::string, std::string>& m);
+
+}  // namespace jsonlite
+}  // namespace tfd

@@ -1,0 +1,139 @@
+"""Tests for the NodeFeature CR sink (--use-node-feature-api) against the
+fake API server — plain HTTP and TLS (dlopen'd OpenSSL client path)."""
+
+import subprocess
+import sys
+
+import pytest
+
+from conftest import FIXTURES, REPO, run_tfd
+
+sys.path.insert(0, str(REPO))
+
+from tpufd.fakes.apiserver import FakeApiServer  # noqa: E402
+
+
+def nf_args():
+    return [
+        "--oneshot", "--use-node-feature-api", "--backend=mock",
+        f"--mock-topology-file={FIXTURES / 'v5e-4.yaml'}",
+        "--slice-strategy=single", "--machine-type-file=/dev/null",
+    ]
+
+
+def sa_dir(tmp_path, token=None):
+    d = tmp_path / "sa"
+    d.mkdir()
+    (d / "namespace").write_text("node-feature-discovery\n")
+    if token:
+        (d / "token").write_text(token + "\n")
+    return d
+
+
+def test_create_then_noop_then_update(tfd_binary, tmp_path):
+    with FakeApiServer(token="sekrit") as server:
+        env = {
+            "NODE_NAME": "tpu-node-1",
+            "TFD_APISERVER_URL": server.url,
+            "TFD_SERVICEACCOUNT_DIR": str(sa_dir(tmp_path, "sekrit")),
+        }
+        code, _, err = run_tfd(tfd_binary, nf_args(), env=env)
+        assert code == 0, err
+        key = ("node-feature-discovery", "tfd-features-for-tpu-node-1")
+        obj = server.store[key]
+        assert obj["metadata"]["resourceVersion"] == "1"
+        labels = obj["spec"]["labels"]
+        assert labels["google.com/tpu.count"] == "4"
+        assert labels["google.com/tpu.slice.shape"] == "2x2"
+        assert (obj["metadata"]["labels"]
+                ["nfd.node.kubernetes.io/node-name"] == "tpu-node-1")
+
+        # Second run with identical labels except the timestamp: an update.
+        # (Timestamps have 1s resolution; wait so it actually differs.)
+        import time
+        time.sleep(1.1)
+        code, _, err = run_tfd(tfd_binary, nf_args(), env=env)
+        assert code == 0, err
+        assert server.store[key]["metadata"]["resourceVersion"] == "2"
+
+        # Without the timestamp the label set is stable -> no-op (the
+        # semantic-equality check; resourceVersion must NOT bump).
+        code, _, err = run_tfd(tfd_binary, nf_args() + ["--no-timestamp"],
+                               env=env)
+        assert code == 0, err
+        rv = server.store[key]["metadata"]["resourceVersion"]
+        code, _, err = run_tfd(tfd_binary, nf_args() + ["--no-timestamp"],
+                               env=env)
+        assert code == 0, err
+        assert server.store[key]["metadata"]["resourceVersion"] == rv
+
+
+def test_auth_failure(tfd_binary, tmp_path):
+    with FakeApiServer(token="sekrit") as server:
+        code, _, err = run_tfd(tfd_binary, nf_args(), env={
+            "NODE_NAME": "tpu-node-1",
+            "TFD_APISERVER_URL": server.url,
+            "TFD_SERVICEACCOUNT_DIR": str(sa_dir(tmp_path, "wrong")),
+        })
+        assert code == 1
+        assert "401" in err
+
+
+def test_missing_node_name(tfd_binary, tmp_path):
+    with FakeApiServer() as server:
+        code, _, err = run_tfd(tfd_binary, nf_args(), env={
+            "TFD_APISERVER_URL": server.url,
+            "TFD_SERVICEACCOUNT_DIR": str(sa_dir(tmp_path)),
+            "NODE_NAME": "",
+        })
+        assert code == 1
+        assert "NODE_NAME" in err
+
+
+@pytest.fixture(scope="module")
+def tls_cert(tmp_path_factory):
+    d = tmp_path_factory.mktemp("tls")
+    cert = d / "server.crt"
+    key = d / "server.key"
+    subprocess.run([
+        "openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+        "-keyout", str(key), "-out", str(cert), "-days", "2",
+        "-subj", "/CN=127.0.0.1",
+        "-addext", "subjectAltName=IP:127.0.0.1",
+    ], check=True, capture_output=True)
+    return cert, key
+
+
+def test_tls_with_ca_verification(tfd_binary, tmp_path, tls_cert):
+    """The https path: dlopen'd OpenSSL, CA pinning via the serviceaccount
+    ca.crt, SNI + hostname verification."""
+    cert, key = tls_cert
+    with FakeApiServer(token="sekrit", certfile=str(cert),
+                       keyfile=str(key)) as server:
+        d = sa_dir(tmp_path, "sekrit")
+        (d / "ca.crt").write_text(cert.read_text())
+        env = {
+            "NODE_NAME": "tpu-node-tls",
+            "TFD_APISERVER_URL": server.url,  # https://...
+            "TFD_SERVICEACCOUNT_DIR": str(d),
+        }
+        code, _, err = run_tfd(tfd_binary, nf_args(), env=env)
+        assert code == 0, err
+        key_ = ("node-feature-discovery", "tfd-features-for-tpu-node-tls")
+        assert server.store[key_]["spec"]["labels"][
+            "google.com/tpu.count"] == "4"
+
+
+def test_tls_rejects_untrusted_cert(tfd_binary, tmp_path, tls_cert):
+    """Without the CA in the trust store the handshake must fail (no
+    silent insecure fallback)."""
+    cert, key = tls_cert
+    with FakeApiServer(certfile=str(cert), keyfile=str(key)) as server:
+        d = sa_dir(tmp_path, "sekrit")  # no ca.crt -> system roots
+        code, _, err = run_tfd(tfd_binary, nf_args(), env={
+            "NODE_NAME": "tpu-node-tls",
+            "TFD_APISERVER_URL": server.url,
+            "TFD_SERVICEACCOUNT_DIR": str(d),
+        })
+        assert code == 1
+        assert "TLS" in err or "certificate" in err.lower()

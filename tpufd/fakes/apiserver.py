@@ -1,0 +1,128 @@
+"""A fake Kubernetes API server for the NodeFeature CR sink tests.
+
+Implements just the NFD CR surface the daemon talks to:
+  GET    /apis/nfd.k8s-sigs.io/v1alpha1/namespaces/{ns}/nodefeatures/{name}
+  POST   /apis/nfd.k8s-sigs.io/v1alpha1/namespaces/{ns}/nodefeatures
+  PUT    /apis/nfd.k8s-sigs.io/v1alpha1/namespaces/{ns}/nodefeatures/{name}
+with in-memory storage, resourceVersion bumping, and optional bearer-token
+enforcement. Supports plain HTTP and TLS (pass certfile/keyfile).
+"""
+
+import json
+import ssl
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+PREFIX = "/apis/nfd.k8s-sigs.io/v1alpha1/namespaces/"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    store = None  # type: dict
+    token = None
+    lock = None
+
+    def _check_auth(self):
+        if self.token is None:
+            return True
+        return self.headers.get("Authorization") == f"Bearer {self.token}"
+
+    def _reply(self, code, obj=None):
+        body = json.dumps(obj).encode() if obj is not None else b"{}"
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _parse(self):
+        if not self.path.startswith(PREFIX):
+            return None, None
+        rest = self.path[len(PREFIX):]
+        parts = rest.split("/")
+        if len(parts) >= 2 and parts[1] == "nodefeatures":
+            name = parts[2] if len(parts) > 2 else None
+            return parts[0], name
+        return None, None
+
+    def do_GET(self):  # noqa: N802
+        if not self._check_auth():
+            return self._reply(401, {"message": "unauthorized"})
+        ns, name = self._parse()
+        if ns is None or name is None:
+            return self._reply(404, {"message": "not found"})
+        with self.lock:
+            obj = self.store.get((ns, name))
+        if obj is None:
+            return self._reply(404, {"message": "not found"})
+        return self._reply(200, obj)
+
+    def do_POST(self):  # noqa: N802
+        if not self._check_auth():
+            return self._reply(401, {"message": "unauthorized"})
+        ns, name = self._parse()
+        if ns is None or name is not None:
+            return self._reply(404, {"message": "not found"})
+        length = int(self.headers.get("Content-Length", "0"))
+        obj = json.loads(self.rfile.read(length))
+        obj_name = obj.get("metadata", {}).get("name")
+        with self.lock:
+            if (ns, obj_name) in self.store:
+                return self._reply(409, {"message": "already exists"})
+            obj.setdefault("metadata", {})["resourceVersion"] = "1"
+            self.store[(ns, obj_name)] = obj
+        return self._reply(201, obj)
+
+    def do_PUT(self):  # noqa: N802
+        if not self._check_auth():
+            return self._reply(401, {"message": "unauthorized"})
+        ns, name = self._parse()
+        if ns is None or name is None:
+            return self._reply(404, {"message": "not found"})
+        length = int(self.headers.get("Content-Length", "0"))
+        obj = json.loads(self.rfile.read(length))
+        with self.lock:
+            existing = self.store.get((ns, name))
+            if existing is None:
+                return self._reply(404, {"message": "not found"})
+            current_rv = existing["metadata"]["resourceVersion"]
+            sent_rv = obj.get("metadata", {}).get("resourceVersion")
+            if sent_rv != current_rv:
+                return self._reply(409, {"message": "conflict"})
+            obj["metadata"]["resourceVersion"] = str(int(current_rv) + 1)
+            self.store[(ns, name)] = obj
+        return self._reply(200, obj)
+
+    def log_message(self, *args):
+        pass
+
+
+class FakeApiServer:
+    def __init__(self, token=None, certfile=None, keyfile=None, port=0):
+        handler = type("Handler", (_Handler,), {
+            "store": {}, "token": token, "lock": threading.Lock()})
+        self.store = handler.store
+        self._server = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self.tls = certfile is not None
+        if self.tls:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(certfile, keyfile)
+            self._server.socket = ctx.wrap_socket(
+                self._server.socket, server_side=True)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5)
+        return False
+
+    @property
+    def url(self):
+        scheme = "https" if self.tls else "http"
+        return f"{scheme}://127.0.0.1:{self.port}"

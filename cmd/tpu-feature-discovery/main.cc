@@ -82,6 +82,13 @@ Status LabelOnce(const config::Config& config, lm::Labeler& timestamp,
     Result<k8s::ClusterConfig> cluster = k8s::LoadInClusterConfig();
     if (!cluster.ok()) return cluster.status();
     out = k8s::UpdateNodeFeature(*cluster, merged);
+    if (!out.ok() && !config.flags.oneshot) {
+      // Apiserver hiccups (rolling restarts, timeouts, exhausted conflict
+      // retries) are transient; keep the daemon alive and retry at the
+      // next interval instead of crash-looping the pod.
+      TFD_LOG_ERROR << out.message() << " (will retry next interval)";
+      return Status::Ok();  // skips the success log below
+    }
   } else {
     out = lm::OutputToFile(merged, config.flags.output_file);
   }

@@ -1,14 +1,8 @@
 #include "tfd/gce/metadata.h"
 
-#include <arpa/inet.h>
-#include <netdb.h>
-#include <poll.h>
-#include <string.h>
-#include <sys/socket.h>
-#include <unistd.h>
-
 #include <cstdlib>
 
+#include "tfd/util/http.h"
 #include "tfd/util/strings.h"
 
 namespace tfd {
@@ -17,110 +11,6 @@ namespace gce {
 namespace {
 
 constexpr char kDefaultEndpoint[] = "metadata.google.internal";
-
-struct FdCloser {
-  int fd;
-  ~FdCloser() {
-    if (fd >= 0) close(fd);
-  }
-};
-
-// One blocking HTTP/1.1 GET. The timeout applies per socket operation
-// (connect/send/recv), not to the whole request. Returns the raw response.
-Result<std::string> HttpGet(const std::string& host, int port,
-                            const std::string& path, int timeout_ms) {
-  addrinfo hints{};
-  hints.ai_family = AF_UNSPEC;
-  hints.ai_socktype = SOCK_STREAM;
-  addrinfo* res = nullptr;
-  std::string port_str = std::to_string(port);
-  int rc = getaddrinfo(host.c_str(), port_str.c_str(), &hints, &res);
-  if (rc != 0) {
-    return Result<std::string>::Error("resolve " + host + ": " +
-                                      gai_strerror(rc));
-  }
-  int fd = -1;
-  for (addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
-    fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
-    if (fd < 0) continue;
-    timeval tv{};
-    tv.tv_sec = timeout_ms / 1000;
-    tv.tv_usec = (timeout_ms % 1000) * 1000;
-    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
-    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
-    if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
-    close(fd);
-    fd = -1;
-  }
-  freeaddrinfo(res);
-  if (fd < 0) {
-    return Result<std::string>::Error("connect to " + host + ":" + port_str +
-                                      " failed: " + strerror(errno));
-  }
-  FdCloser closer{fd};
-
-  std::string request = "GET " + path +
-                        " HTTP/1.1\r\nHost: " + host +
-                        "\r\nMetadata-Flavor: Google\r\n"
-                        "Connection: close\r\n\r\n";
-  size_t off = 0;
-  while (off < request.size()) {
-    ssize_t n = send(fd, request.data() + off, request.size() - off, 0);
-    if (n <= 0) {
-      return Result<std::string>::Error("send failed: " +
-                                        std::string(strerror(errno)));
-    }
-    off += static_cast<size_t>(n);
-  }
-
-  std::string response;
-  char buf[4096];
-  while (true) {
-    ssize_t n = recv(fd, buf, sizeof(buf), 0);
-    if (n < 0) {
-      return Result<std::string>::Error("recv failed: " +
-                                        std::string(strerror(errno)));
-    }
-    if (n == 0) break;
-    response.append(buf, static_cast<size_t>(n));
-    if (response.size() > 4 * 1024 * 1024) {
-      return Result<std::string>::Error("metadata response too large");
-    }
-  }
-  return response;
-}
-
-// Minimal HTTP response parse: status line + headers + body. Handles
-// chunked transfer-encoding (the GCE server uses Content-Length, but a fake
-// test server may not).
-Result<std::string> ParseHttpResponse(const std::string& raw, int* status) {
-  size_t header_end = raw.find("\r\n\r\n");
-  if (header_end == std::string::npos) {
-    return Result<std::string>::Error("malformed HTTP response");
-  }
-  std::string headers = raw.substr(0, header_end);
-  std::string body = raw.substr(header_end + 4);
-  size_t sp = headers.find(' ');
-  if (sp == std::string::npos) {
-    return Result<std::string>::Error("malformed HTTP status line");
-  }
-  *status = atoi(headers.c_str() + sp + 1);
-  if (ToLower(headers).find("transfer-encoding: chunked") !=
-      std::string::npos) {
-    std::string decoded;
-    size_t pos = 0;
-    while (pos < body.size()) {
-      size_t eol = body.find("\r\n", pos);
-      if (eol == std::string::npos) break;
-      long chunk = strtol(body.substr(pos, eol - pos).c_str(), nullptr, 16);
-      if (chunk <= 0) break;
-      decoded += body.substr(eol + 2, static_cast<size_t>(chunk));
-      pos = eol + 2 + static_cast<size_t>(chunk) + 2;
-    }
-    body = decoded;
-  }
-  return body;
-}
 
 }  // namespace
 
@@ -133,27 +23,21 @@ MetadataClient::MetadataClient(std::string endpoint, int timeout_ms)
 }
 
 Result<std::string> MetadataClient::Get(const std::string& path) const {
-  std::string host = endpoint_;
-  int port = 80;
-  size_t colon = host.rfind(':');
-  if (colon != std::string::npos && host.find(']') == std::string::npos) {
-    port = atoi(host.c_str() + colon + 1);
-    host = host.substr(0, colon);
-  }
-  Result<std::string> raw =
-      HttpGet(host, port, "/computeMetadata/v1/" + path, timeout_ms_);
-  if (!raw.ok()) return raw;
-  int status = 0;
-  Result<std::string> body = ParseHttpResponse(*raw, &status);
-  if (!body.ok()) return body;
-  if (status == 404) {
+  http::RequestOptions options;
+  options.timeout_ms = timeout_ms_;
+  options.headers["Metadata-Flavor"] = "Google";
+  Result<http::Response> resp = http::Request(
+      "GET", "http://" + endpoint_ + "/computeMetadata/v1/" + path, "",
+      options);
+  if (!resp.ok()) return Result<std::string>::Error(resp.error());
+  if (resp->status == 404) {
     return Result<std::string>::Error("metadata key not found: " + path);
   }
-  if (status != 200) {
+  if (resp->status != 200) {
     return Result<std::string>::Error("metadata GET " + path + ": HTTP " +
-                                      std::to_string(status));
+                                      std::to_string(resp->status));
   }
-  return body;
+  return resp->body;
 }
 
 bool MetadataClient::Available() const {

@@ -46,25 +46,17 @@ http::RequestOptions BaseOptions(const ClusterConfig& config) {
   return options;
 }
 
-// The CR body. spec.labels values become node labels via the NFD master;
-// the nfd node-name label tells NFD which node this CR describes.
-std::string CrBody(const ClusterConfig& config, const lm::Labels& labels,
-                   const std::string& resource_version) {
-  std::map<std::string, std::string> spec_labels(labels.begin(),
-                                                 labels.end());
-  std::string body =
-      std::string("{\"apiVersion\":\"") + kNfdGroup + "/" + kNfdVersion +
-      "\",\"kind\":\"NodeFeature\"," + "\"metadata\":{\"name\":" +
-      jsonlite::Quote(CrName(config.node_name)) +
-      ",\"namespace\":" + jsonlite::Quote(config.namespace_) +
-      ",\"labels\":{\"nfd.node.kubernetes.io/node-name\":" +
-      jsonlite::Quote(config.node_name) + "}";
-  if (!resource_version.empty()) {
-    body += ",\"resourceVersion\":" + jsonlite::Quote(resource_version);
-  }
-  body += "},\"spec\":{\"labels\":" +
-          jsonlite::SerializeStringMap(spec_labels) + "}}";
-  return body;
+// The create body. spec.labels values become node labels via the NFD
+// master; the nfd node-name label tells NFD which node this CR describes.
+// (Updates serialize the mutated fetched CR instead.)
+std::string CrBody(const ClusterConfig& config, const lm::Labels& labels) {
+  return std::string("{\"apiVersion\":\"") + kNfdGroup + "/" + kNfdVersion +
+         "\",\"kind\":\"NodeFeature\"," + "\"metadata\":{\"name\":" +
+         jsonlite::Quote(CrName(config.node_name)) +
+         ",\"namespace\":" + jsonlite::Quote(config.namespace_) +
+         ",\"labels\":{\"nfd.node.kubernetes.io/node-name\":" +
+         jsonlite::Quote(config.node_name) + "}},\"spec\":{\"labels\":" +
+         jsonlite::SerializeStringMap(labels) + "}}";
 }
 
 }  // namespace
@@ -114,77 +106,114 @@ Result<ClusterConfig> LoadInClusterConfig() {
 Status UpdateNodeFeature(const ClusterConfig& config,
                          const lm::Labels& labels) {
   http::RequestOptions options = BaseOptions(config);
+  http::RequestOptions write = options;
+  write.headers["Content-Type"] = "application/json";
 
   // Get → create-if-missing → update-if-changed (labels.go:152-183).
-  Result<http::Response> existing =
-      http::Request("GET", CrUrl(config, true), "", options);
-  if (!existing.ok()) {
-    return Status::Error("getting NodeFeature CR: " + existing.error());
-  }
+  // Writes race other controllers (NFD master, a restarted twin): a 409
+  // conflict re-GETs and retries rather than failing the pass.
+  constexpr int kMaxAttempts = 3;
+  std::string last_error;
+  for (int attempt = 0; attempt < kMaxAttempts; attempt++) {
+    Result<http::Response> existing =
+        http::Request("GET", CrUrl(config, true), "", options);
+    if (!existing.ok()) {
+      return Status::Error("getting NodeFeature CR: " + existing.error());
+    }
 
-  if (existing->status == 404) {
-    http::RequestOptions post = options;
-    post.headers["Content-Type"] = "application/json";
-    Result<http::Response> created = http::Request(
-        "POST", CrUrl(config, false), CrBody(config, labels, ""), post);
-    if (!created.ok()) {
-      return Status::Error("creating NodeFeature CR: " + created.error());
+    if (existing->status == 404) {
+      Result<http::Response> created = http::Request(
+          "POST", CrUrl(config, false), CrBody(config, labels), write);
+      if (!created.ok()) {
+        return Status::Error("creating NodeFeature CR: " + created.error());
+      }
+      if (created->status == 409) {  // lost a create race; re-GET
+        last_error = "create conflict";
+        continue;
+      }
+      if (created->status != 201 && created->status != 200) {
+        return Status::Error("creating NodeFeature CR: HTTP " +
+                             std::to_string(created->status) + ": " +
+                             created->body.substr(0, 512));
+      }
+      TFD_LOG_INFO << "created NodeFeature CR " << CrName(config.node_name);
+      return Status::Ok();
     }
-    if (created->status != 201 && created->status != 200) {
-      return Status::Error("creating NodeFeature CR: HTTP " +
-                           std::to_string(created->status) + ": " +
-                           created->body.substr(0, 512));
+    if (existing->status != 200) {
+      return Status::Error("getting NodeFeature CR: HTTP " +
+                           std::to_string(existing->status) + ": " +
+                           existing->body.substr(0, 512));
     }
-    TFD_LOG_INFO << "created NodeFeature CR " << CrName(config.node_name);
+
+    Result<jsonlite::ValuePtr> parsed = jsonlite::Parse(existing->body);
+    if (!parsed.ok()) {
+      return Status::Error("parsing NodeFeature CR: " + parsed.error());
+    }
+    jsonlite::Value& cr = **parsed;
+
+    // Semantic-equality check to skip no-op updates (labels.go:170-176).
+    jsonlite::ValuePtr current = cr.GetPath("spec.labels");
+    if (current && current->kind == jsonlite::Value::Kind::kObject &&
+        current->object_items.size() == labels.size()) {
+      bool equal = true;
+      for (const auto& [k, v] : current->object_items) {
+        auto it = labels.find(k);
+        if (it == labels.end() ||
+            v->kind != jsonlite::Value::Kind::kString ||
+            v->string_value != it->second) {
+          equal = false;
+          break;
+        }
+      }
+      if (equal) return Status::Ok();
+    }
+
+    // Mutate the fetched object (as the reference does via client-go,
+    // labels.go:165-183) so metadata other controllers own — annotations,
+    // ownerReferences, finalizers, foreign labels — survives the PUT.
+    jsonlite::ValuePtr metadata = cr.Get("metadata");
+    if (!metadata) {
+      metadata = std::make_shared<jsonlite::Value>();
+      metadata->kind = jsonlite::Value::Kind::kObject;
+      cr.Set("metadata", metadata);
+    }
+    jsonlite::ValuePtr meta_labels = metadata->Get("labels");
+    if (!meta_labels || meta_labels->kind != jsonlite::Value::Kind::kObject) {
+      meta_labels = std::make_shared<jsonlite::Value>();
+      meta_labels->kind = jsonlite::Value::Kind::kObject;
+      metadata->Set("labels", meta_labels);
+    }
+    meta_labels->Set("nfd.node.kubernetes.io/node-name",
+                     jsonlite::MakeString(config.node_name));
+    jsonlite::ValuePtr spec = cr.Get("spec");
+    if (!spec || spec->kind != jsonlite::Value::Kind::kObject) {
+      spec = std::make_shared<jsonlite::Value>();
+      spec->kind = jsonlite::Value::Kind::kObject;
+      cr.Set("spec", spec);
+    }
+    spec->Set("labels", jsonlite::FromStringMap(labels));
+
+    Result<http::Response> updated = http::Request(
+        "PUT", CrUrl(config, true), jsonlite::Serialize(cr), write);
+    if (!updated.ok()) {
+      return Status::Error("updating NodeFeature CR: " + updated.error());
+    }
+    if (updated->status == 409) {  // stale resourceVersion; re-GET
+      last_error = "update conflict: " + updated->body.substr(0, 256);
+      TFD_LOG_WARNING << "NodeFeature CR update conflict; retrying";
+      continue;
+    }
+    if (updated->status != 200) {
+      return Status::Error("updating NodeFeature CR: HTTP " +
+                           std::to_string(updated->status) + ": " +
+                           updated->body.substr(0, 512));
+    }
+    TFD_LOG_INFO << "updated NodeFeature CR " << CrName(config.node_name);
     return Status::Ok();
   }
-  if (existing->status != 200) {
-    return Status::Error("getting NodeFeature CR: HTTP " +
-                         std::to_string(existing->status) + ": " +
-                         existing->body.substr(0, 512));
-  }
-
-  Result<jsonlite::ValuePtr> parsed = jsonlite::Parse(existing->body);
-  if (!parsed.ok()) {
-    return Status::Error("parsing NodeFeature CR: " + parsed.error());
-  }
-
-  // Semantic-equality check to skip no-op updates (labels.go:170-176).
-  jsonlite::ValuePtr current = (*parsed)->GetPath("spec.labels");
-  if (current && current->kind == jsonlite::Value::Kind::kObject &&
-      current->object_items.size() == labels.size()) {
-    bool equal = true;
-    for (const auto& [k, v] : current->object_items) {
-      auto it = labels.find(k);
-      if (it == labels.end() ||
-          v->kind != jsonlite::Value::Kind::kString ||
-          v->string_value != it->second) {
-        equal = false;
-        break;
-      }
-    }
-    if (equal) return Status::Ok();
-  }
-
-  jsonlite::ValuePtr rv = (*parsed)->GetPath("metadata.resourceVersion");
-  std::string resource_version =
-      rv && rv->kind == jsonlite::Value::Kind::kString ? rv->string_value
-                                                       : "";
-  http::RequestOptions put = options;
-  put.headers["Content-Type"] = "application/json";
-  Result<http::Response> updated =
-      http::Request("PUT", CrUrl(config, true),
-                    CrBody(config, labels, resource_version), put);
-  if (!updated.ok()) {
-    return Status::Error("updating NodeFeature CR: " + updated.error());
-  }
-  if (updated->status != 200) {
-    return Status::Error("updating NodeFeature CR: HTTP " +
-                         std::to_string(updated->status) + ": " +
-                         updated->body.substr(0, 512));
-  }
-  TFD_LOG_INFO << "updated NodeFeature CR " << CrName(config.node_name);
-  return Status::Ok();
+  return Status::Error("updating NodeFeature CR: " +
+                       std::to_string(kMaxAttempts) +
+                       " attempts exhausted (" + last_error + ")");
 }
 
 }  // namespace k8s

@@ -1,7 +1,10 @@
 #include "tfd/util/http.h"
 
+#include <arpa/inet.h>
 #include <dlfcn.h>
+#include <errno.h>
 #include <netdb.h>
+#include <signal.h>
 #include <string.h>
 #include <sys/socket.h>
 #include <unistd.h>
@@ -23,6 +26,15 @@ constexpr int kSslVerifyPeer = 0x01;
 constexpr long kSslCtrlSetTlsExtHostname = 55;
 constexpr int kTlsExtNametypeHostName = 0;
 constexpr int kSslErrorZeroReturn = 6;
+constexpr int kSslErrorSyscall = 5;
+// On a blocking socket BIO these only surface when SO_RCVTIMEO/SO_SNDTIMEO
+// fires (the BIO maps EAGAIN to its retry flag), i.e. a timeout.
+constexpr int kSslErrorWantRead = 2;
+constexpr int kSslErrorWantWrite = 3;
+// Report a peer that closes without close_notify as SSL_ERROR_ZERO_RETURN
+// instead of a protocol error (servers commonly skip close_notify with
+// Connection: close).
+constexpr uint64_t kSslOpIgnoreUnexpectedEof = 1ULL << 7;
 
 struct OpenSsl {
   void* ssl_handle = nullptr;
@@ -40,6 +52,8 @@ struct OpenSsl {
   void (*SSL_free)(void*) = nullptr;
   int (*SSL_set_fd)(void*, int) = nullptr;
   int (*SSL_set1_host)(void*, const char*) = nullptr;
+  void* (*SSL_get0_param)(void*) = nullptr;
+  uint64_t (*SSL_CTX_set_options)(void*, uint64_t) = nullptr;
   long (*SSL_ctrl)(void*, int, long, void*) = nullptr;
   int (*SSL_connect)(void*) = nullptr;
   int (*SSL_read)(void*, void*, int) = nullptr;
@@ -50,6 +64,7 @@ struct OpenSsl {
   // libcrypto
   unsigned long (*ERR_get_error)() = nullptr;
   void (*ERR_error_string_n)(unsigned long, char*, size_t) = nullptr;
+  int (*X509_VERIFY_PARAM_set1_ip_asc)(void*, const char*) = nullptr;
 
   bool ok = false;
   std::string error;
@@ -86,6 +101,8 @@ const OpenSsl& GetOpenSsl() {
     load(s.SSL_free, "SSL_free", s.ssl_handle);
     load(s.SSL_set_fd, "SSL_set_fd", s.ssl_handle);
     load(s.SSL_set1_host, "SSL_set1_host", s.ssl_handle);
+    load(s.SSL_get0_param, "SSL_get0_param", s.ssl_handle);
+    load(s.SSL_CTX_set_options, "SSL_CTX_set_options", s.ssl_handle);
     load(s.SSL_ctrl, "SSL_ctrl", s.ssl_handle);
     load(s.SSL_connect, "SSL_connect", s.ssl_handle);
     load(s.SSL_read, "SSL_read", s.ssl_handle);
@@ -94,6 +111,8 @@ const OpenSsl& GetOpenSsl() {
     load(s.SSL_get_error, "SSL_get_error", s.ssl_handle);
     load(s.ERR_get_error, "ERR_get_error", s.crypto_handle);
     load(s.ERR_error_string_n, "ERR_error_string_n", s.crypto_handle);
+    load(s.X509_VERIFY_PARAM_set1_ip_asc, "X509_VERIFY_PARAM_set1_ip_asc",
+         s.crypto_handle);
     s.ok = all;
     return s;
   }();
@@ -133,15 +152,33 @@ Result<Url> ParseUrl(const std::string& url) {
   std::string hostport = slash == std::string::npos ? rest
                                                     : rest.substr(0, slash);
   out.path = slash == std::string::npos ? "/" : rest.substr(slash);
-  size_t colon = hostport.rfind(':');
-  if (colon != std::string::npos && hostport.find(']') == std::string::npos) {
-    out.port = atoi(hostport.c_str() + colon + 1);
-    out.host = hostport.substr(0, colon);
+  if (!hostport.empty() && hostport[0] == '[') {
+    // Bracketed IPv6 literal: [fd00::1] or [fd00::1]:6443.
+    size_t close = hostport.find(']');
+    if (close == std::string::npos) {
+      return Result<Url>::Error("unterminated IPv6 literal in " + url);
+    }
+    out.host = hostport.substr(1, close - 1);
+    if (close + 1 < hostport.size() && hostport[close + 1] == ':') {
+      out.port = atoi(hostport.c_str() + close + 2);
+    }
   } else {
-    out.host = hostport;
+    size_t colon = hostport.rfind(':');
+    if (colon != std::string::npos) {
+      out.port = atoi(hostport.c_str() + colon + 1);
+      out.host = hostport.substr(0, colon);
+    } else {
+      out.host = hostport;
+    }
   }
   if (out.host.empty()) return Result<Url>::Error("empty host in " + url);
   return out;
+}
+
+bool IsIpLiteral(const std::string& host) {
+  unsigned char buf[sizeof(in6_addr)];
+  return inet_pton(AF_INET, host.c_str(), buf) == 1 ||
+         inet_pton(AF_INET6, host.c_str(), buf) == 1;
 }
 
 Result<int> Connect(const Url& url, int timeout_ms) {
@@ -190,7 +227,7 @@ class PlainTransport : public Transport {
   ~PlainTransport() override { close(fd_); }
 
   Result<int> Write(const char* data, int len) override {
-    ssize_t n = send(fd_, data, len, 0);
+    ssize_t n = send(fd_, data, len, MSG_NOSIGNAL);
     if (n < 0) return Result<int>::Error(strerror(errno));
     return static_cast<int>(n);
   }
@@ -235,6 +272,7 @@ class TlsTransport : public Transport {
       }
       ssl.SSL_CTX_set_verify(ctx, kSslVerifyPeer, nullptr);
     }
+    ssl.SSL_CTX_set_options(ctx, kSslOpIgnoreUnexpectedEof);
     void* s = ssl.SSL_new(ctx);
     if (s == nullptr) {
       ssl.SSL_CTX_free(ctx);
@@ -243,10 +281,28 @@ class TlsTransport : public Transport {
                                                        SslErrorString());
     }
     ssl.SSL_set_fd(s, fd);
-    // SNI + hostname verification.
-    ssl.SSL_ctrl(s, kSslCtrlSetTlsExtHostname, kTlsExtNametypeHostName,
-                 const_cast<char*>(url.host.c_str()));
-    if (!options.insecure) ssl.SSL_set1_host(s, url.host.c_str());
+    // SNI (DNS names only — RFC 6066 forbids IP literals) + peer
+    // verification. X509_check_host only consults DNS SANs, so IP literals
+    // (the in-cluster KUBERNETES_SERVICE_HOST case, matched by the
+    // apiserver cert's IP SANs) must go through the IP verify param.
+    if (!IsIpLiteral(url.host)) {
+      ssl.SSL_ctrl(s, kSslCtrlSetTlsExtHostname, kTlsExtNametypeHostName,
+                   const_cast<char*>(url.host.c_str()));
+    }
+    if (!options.insecure) {
+      int ok = IsIpLiteral(url.host)
+                   ? ssl.X509_VERIFY_PARAM_set1_ip_asc(ssl.SSL_get0_param(s),
+                                                       url.host.c_str())
+                   : ssl.SSL_set1_host(s, url.host.c_str());
+      if (ok != 1) {
+        std::string err = SslErrorString();
+        ssl.SSL_free(s);
+        ssl.SSL_CTX_free(ctx);
+        close(fd);
+        return Result<std::unique_ptr<Transport>>::Error(
+            "setting expected peer identity " + url.host + ": " + err);
+      }
+    }
     if (ssl.SSL_connect(s) != 1) {
       std::string err = SslErrorString();
       ssl.SSL_free(s);
@@ -268,20 +324,40 @@ class TlsTransport : public Transport {
 
   Result<int> Write(const char* data, int len) override {
     const OpenSsl& ssl = GetOpenSsl();
+    errno = 0;
     int n = ssl.SSL_write(ssl_, data, len);
-    if (n <= 0) return Result<int>::Error("SSL_write: " + SslErrorString());
+    if (n <= 0) {
+      int err = ssl.SSL_get_error(ssl_, n);
+      if (err == kSslErrorWantRead || err == kSslErrorWantWrite) {
+        return Result<int>::Error("TLS write timed out");
+      }
+      if (err == kSslErrorSyscall && errno != 0) {
+        return Result<int>::Error(std::string("TLS write: ") +
+                                  strerror(errno));
+      }
+      return Result<int>::Error("SSL_write: " + SslErrorString());
+    }
     return n;
   }
 
   Result<int> Read(char* data, int len) override {
     const OpenSsl& ssl = GetOpenSsl();
+    errno = 0;
     int n = ssl.SSL_read(ssl_, data, len);
     if (n <= 0) {
       int err = ssl.SSL_get_error(ssl_, n);
-      if (err == kSslErrorZeroReturn) return 0;  // clean close
-      // A peer that closes without close_notify after a complete response
-      // is tolerated by every HTTP client; treat as EOF.
-      return 0;
+      // Covers both close_notify and (via SSL_OP_IGNORE_UNEXPECTED_EOF)
+      // peers that drop the connection without one.
+      if (err == kSslErrorZeroReturn) return 0;
+      if (err == kSslErrorWantRead || err == kSslErrorWantWrite) {
+        return Result<int>::Error("TLS read timed out");
+      }
+      if (err == kSslErrorSyscall) {
+        if (errno == 0) return 0;  // EOF surfaced as a 0-byte read
+        return Result<int>::Error(std::string("TLS read: ") +
+                                  strerror(errno));
+      }
+      return Result<int>::Error("SSL_read: " + SslErrorString());
     }
     return n;
   }
@@ -330,6 +406,12 @@ Result<Response> ParseResponse(const std::string& raw) {
 Result<Response> Request(const std::string& method, const std::string& url,
                          const std::string& body,
                          const RequestOptions& options) {
+  // SSL_write's underlying write(2) cannot carry MSG_NOSIGNAL, so a peer
+  // reset mid-write would raise SIGPIPE and kill the daemon; surface it as
+  // an EPIPE error instead.
+  static std::once_flag sigpipe_once;
+  std::call_once(sigpipe_once, [] { signal(SIGPIPE, SIG_IGN); });
+
   Result<Url> parsed = ParseUrl(url);
   if (!parsed.ok()) return Result<Response>::Error(parsed.error());
 

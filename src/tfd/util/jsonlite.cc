@@ -227,6 +227,17 @@ ValuePtr Value::GetPath(const std::string& dotted) const {
   return found;
 }
 
+void Value::Set(const std::string& key, ValuePtr value) {
+  kind = Kind::kObject;
+  for (auto& [k, v] : object_items) {
+    if (k == key) {
+      v = std::move(value);
+      return;
+    }
+  }
+  object_items.emplace_back(key, std::move(value));
+}
+
 Result<ValuePtr> Parse(const std::string& text) {
   Parser p(text);
   return p.Parse();
@@ -267,6 +278,63 @@ std::string SerializeStringMap(const std::map<std::string, std::string>& m) {
   }
   out << "}";
   return out.str();
+}
+
+std::string Serialize(const Value& v) {
+  switch (v.kind) {
+    case Value::Kind::kNull:
+      return "null";
+    case Value::Kind::kBool:
+      return v.bool_value ? "true" : "false";
+    case Value::Kind::kNumber: {
+      // Integral values (the common k8s case: generation, ports) must not
+      // grow a ".0"; others keep full double precision.
+      double d = v.number_value;
+      if (d == static_cast<double>(static_cast<long long>(d))) {
+        return std::to_string(static_cast<long long>(d));
+      }
+      char buf[32];
+      snprintf(buf, sizeof(buf), "%.17g", d);
+      return buf;
+    }
+    case Value::Kind::kString:
+      return Quote(v.string_value);
+    case Value::Kind::kArray: {
+      std::string out = "[";
+      for (size_t i = 0; i < v.array_items.size(); i++) {
+        if (i) out += ",";
+        out += Serialize(*v.array_items[i]);
+      }
+      return out + "]";
+    }
+    case Value::Kind::kObject: {
+      std::string out = "{";
+      bool first = true;
+      for (const auto& [k, item] : v.object_items) {
+        if (!first) out += ",";
+        first = false;
+        out += Quote(k) + ":" + Serialize(*item);
+      }
+      return out + "}";
+    }
+  }
+  return "null";
+}
+
+ValuePtr MakeString(const std::string& s) {
+  auto v = std::make_shared<Value>();
+  v->kind = Value::Kind::kString;
+  v->string_value = s;
+  return v;
+}
+
+ValuePtr FromStringMap(const std::map<std::string, std::string>& m) {
+  auto v = std::make_shared<Value>();
+  v->kind = Value::Kind::kObject;
+  for (const auto& [k, val] : m) {
+    v->object_items.emplace_back(k, MakeString(val));
+  }
+  return v;
 }
 
 }  // namespace jsonlite

@@ -33,6 +33,8 @@ struct Value {
   ValuePtr Get(const std::string& key) const;
   // Dotted-path lookup: Get("metadata.resourceVersion").
   ValuePtr GetPath(const std::string& dotted) const;
+  // Object insert-or-replace (keeps existing key order; appends new keys).
+  void Set(const std::string& key, ValuePtr value);
 };
 
 Result<ValuePtr> Parse(const std::string& text);
@@ -42,6 +44,12 @@ std::string Quote(const std::string& s);
 
 // Serializes a string map as a JSON object with sorted keys (deterministic).
 std::string SerializeStringMap(const std::map<std::string, std::string>& m);
+
+// Serializes any parsed value back to JSON (object key order preserved).
+std::string Serialize(const Value& v);
+
+ValuePtr MakeString(const std::string& s);
+ValuePtr FromStringMap(const std::map<std::string, std::string>& m);
 
 }  // namespace jsonlite
 }  // namespace tfd

@@ -81,11 +81,13 @@ Status LabelOnce(const config::Config& config, lm::Labeler& timestamp,
   if (config.flags.use_node_feature_api) {
     Result<k8s::ClusterConfig> cluster = k8s::LoadInClusterConfig();
     if (!cluster.ok()) return cluster.status();
-    out = k8s::UpdateNodeFeature(*cluster, merged);
-    if (!out.ok() && !config.flags.oneshot) {
+    bool transient = false;
+    out = k8s::UpdateNodeFeature(*cluster, merged, &transient);
+    if (!out.ok() && transient && !config.flags.oneshot) {
       // Apiserver hiccups (rolling restarts, timeouts, exhausted conflict
-      // retries) are transient; keep the daemon alive and retry at the
-      // next interval instead of crash-looping the pod.
+      // retries): keep the daemon alive and retry at the next interval.
+      // Permanent failures (missing RBAC, bad schema) still exit so the
+      // pod crash-loops visibly.
       TFD_LOG_ERROR << out.message() << " (will retry next interval)";
       return Status::Ok();  // skips the success log below
     }

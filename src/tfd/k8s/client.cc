@@ -104,7 +104,20 @@ Result<ClusterConfig> LoadInClusterConfig() {
 }
 
 Status UpdateNodeFeature(const ClusterConfig& config,
-                         const lm::Labels& labels) {
+                         const lm::Labels& labels, bool* transient) {
+  // Pessimistic default: failures below that return without passing
+  // through Fail() (none today) would read as permanent.
+  if (transient != nullptr) *transient = false;
+  auto Fail = [transient](bool is_transient, const std::string& message) {
+    if (transient != nullptr) *transient = is_transient;
+    return Status::Error(message);
+  };
+  // Retrying helps against server hiccups (429, 5xx) and transport
+  // failures, not against auth/schema rejections.
+  auto StatusTransient = [](int http_status) {
+    return http_status == 429 || http_status >= 500;
+  };
+
   http::RequestOptions options = BaseOptions(config);
   http::RequestOptions write = options;
   write.headers["Content-Type"] = "application/json";
@@ -118,36 +131,38 @@ Status UpdateNodeFeature(const ClusterConfig& config,
     Result<http::Response> existing =
         http::Request("GET", CrUrl(config, true), "", options);
     if (!existing.ok()) {
-      return Status::Error("getting NodeFeature CR: " + existing.error());
+      return Fail(true, "getting NodeFeature CR: " + existing.error());
     }
 
     if (existing->status == 404) {
       Result<http::Response> created = http::Request(
           "POST", CrUrl(config, false), CrBody(config, labels), write);
       if (!created.ok()) {
-        return Status::Error("creating NodeFeature CR: " + created.error());
+        return Fail(true, "creating NodeFeature CR: " + created.error());
       }
       if (created->status == 409) {  // lost a create race; re-GET
         last_error = "create conflict";
         continue;
       }
       if (created->status != 201 && created->status != 200) {
-        return Status::Error("creating NodeFeature CR: HTTP " +
-                             std::to_string(created->status) + ": " +
-                             created->body.substr(0, 512));
+        return Fail(StatusTransient(created->status),
+                    "creating NodeFeature CR: HTTP " +
+                        std::to_string(created->status) + ": " +
+                        created->body.substr(0, 512));
       }
       TFD_LOG_INFO << "created NodeFeature CR " << CrName(config.node_name);
       return Status::Ok();
     }
     if (existing->status != 200) {
-      return Status::Error("getting NodeFeature CR: HTTP " +
-                           std::to_string(existing->status) + ": " +
-                           existing->body.substr(0, 512));
+      return Fail(StatusTransient(existing->status),
+                  "getting NodeFeature CR: HTTP " +
+                      std::to_string(existing->status) + ": " +
+                      existing->body.substr(0, 512));
     }
 
     Result<jsonlite::ValuePtr> parsed = jsonlite::Parse(existing->body);
     if (!parsed.ok()) {
-      return Status::Error("parsing NodeFeature CR: " + parsed.error());
+      return Fail(false, "parsing NodeFeature CR: " + parsed.error());
     }
     jsonlite::Value& cr = **parsed;
 
@@ -196,7 +211,7 @@ Status UpdateNodeFeature(const ClusterConfig& config,
     Result<http::Response> updated = http::Request(
         "PUT", CrUrl(config, true), jsonlite::Serialize(cr), write);
     if (!updated.ok()) {
-      return Status::Error("updating NodeFeature CR: " + updated.error());
+      return Fail(true, "updating NodeFeature CR: " + updated.error());
     }
     if (updated->status == 409) {  // stale resourceVersion; re-GET
       last_error = "update conflict: " + updated->body.substr(0, 256);
@@ -204,16 +219,17 @@ Status UpdateNodeFeature(const ClusterConfig& config,
       continue;
     }
     if (updated->status != 200) {
-      return Status::Error("updating NodeFeature CR: HTTP " +
-                           std::to_string(updated->status) + ": " +
-                           updated->body.substr(0, 512));
+      return Fail(StatusTransient(updated->status),
+                  "updating NodeFeature CR: HTTP " +
+                      std::to_string(updated->status) + ": " +
+                      updated->body.substr(0, 512));
     }
     TFD_LOG_INFO << "updated NodeFeature CR " << CrName(config.node_name);
     return Status::Ok();
   }
-  return Status::Error("updating NodeFeature CR: " +
-                       std::to_string(kMaxAttempts) +
-                       " attempts exhausted (" + last_error + ")");
+  return Fail(true, "updating NodeFeature CR: " +
+                        std::to_string(kMaxAttempts) +
+                        " attempts exhausted (" + last_error + ")");
 }
 
 }  // namespace k8s

@@ -34,8 +34,13 @@ Result<ClusterConfig> LoadInClusterConfig();
 
 // Creates or updates the NodeFeature CR "tfd-features-for-<node>" carrying
 // `labels` (reference labels.go:141-184; CR name pattern labels.go:38).
+// On failure, `*transient` (if non-null) reports whether retrying later
+// can plausibly succeed without operator action: transport errors,
+// conflict-retry exhaustion, 429 and 5xx are transient; auth/schema
+// failures (other 4xx) and malformed responses are not.
 Status UpdateNodeFeature(const ClusterConfig& config,
-                         const lm::Labels& labels);
+                         const lm::Labels& labels,
+                         bool* transient = nullptr);
 
 }  // namespace k8s
 }  // namespace tfd

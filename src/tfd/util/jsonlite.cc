@@ -288,9 +288,11 @@ std::string Serialize(const Value& v) {
       return v.bool_value ? "true" : "false";
     case Value::Kind::kNumber: {
       // Integral values (the common k8s case: generation, ports) must not
-      // grow a ".0"; others keep full double precision.
+      // grow a ".0"; others keep full double precision. The cast is only
+      // defined inside long long range, so gate it (9.2e18 < 2^63).
       double d = v.number_value;
-      if (d == static_cast<double>(static_cast<long long>(d))) {
+      if (d >= -9.2e18 && d <= 9.2e18 &&
+          d == static_cast<double>(static_cast<long long>(d))) {
         return std::to_string(static_cast<long long>(d));
       }
       char buf[32];

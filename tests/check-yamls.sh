@@ -1,0 +1,36 @@
+#!/bin/sh
+# Assert deployment YAML image tags and chart versions match the release
+# version (reference tests/check-yamls.sh).
+
+if [ "$#" -lt 1 ]; then
+  echo "Usage: $0 VERSION (e.g. v0.1.0)" && exit 1
+fi
+
+VERSION=$1
+DIR=$(dirname "$0")/..
+YAML_FILES="
+$DIR/deployments/static/tpu-feature-discovery-daemonset.yaml
+$DIR/deployments/static/tpu-feature-discovery-daemonset-with-slice-single.yaml
+$DIR/deployments/static/tpu-feature-discovery-daemonset-with-slice-mixed.yaml
+$DIR/deployments/static/tpu-feature-discovery-job.yaml.template
+"
+
+ret=0
+
+for file in ${YAML_FILES}; do
+  if ! grep -qw "tpu-feature-discovery:${VERSION}" "${file}"; then
+    echo "image tag in ${file} does not match ${VERSION}"
+    ret=1
+  fi
+done
+
+BARE=${VERSION#v}
+CHART="$DIR/deployments/helm/tpu-feature-discovery/Chart.yaml"
+for field in version appVersion; do
+  if ! grep -q "^${field}: \"${BARE}\"" "${CHART}"; then
+    echo "${field} in ${CHART} does not match ${BARE}"
+    ret=1
+  fi
+done
+
+exit $ret

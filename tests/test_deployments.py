@@ -1,0 +1,135 @@
+"""Deployment-artifact tests (tier 2.5) + hermetic tier 3/4 drivers.
+
+The reference validates deployments only via check-yamls.sh and cloud CI;
+here the YAML is parsed and cross-checked against the binary's actual
+flag/env surface, and the integration/e2e drivers (reference
+tests/integration-tests.py, e2e-tests.py — hermetic in this build) run
+in-process against the fakes.
+"""
+
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+import yaml
+
+from conftest import REPO, run_tfd
+
+DEPLOY = REPO / "deployments"
+STATIC = DEPLOY / "static"
+HELM = DEPLOY / "helm" / "tpu-feature-discovery"
+
+STATIC_YAMLS = [
+    STATIC / "tpu-feature-discovery-daemonset.yaml",
+    STATIC / "tpu-feature-discovery-daemonset-with-slice-single.yaml",
+    STATIC / "tpu-feature-discovery-daemonset-with-slice-mixed.yaml",
+]
+
+
+def binary_version(binary):
+    out = subprocess.run([str(binary), "--version"], capture_output=True,
+                         text=True, check=True).stdout
+    match = re.search(r"v\d+\.\d+\.\d+", out)
+    assert match, f"no version in {out!r}"
+    return match.group(0)
+
+
+class TestStaticYamls:
+    @pytest.mark.parametrize("path", STATIC_YAMLS,
+                             ids=lambda p: p.name)
+    def test_daemonset_shape(self, path):
+        docs = list(yaml.safe_load_all(path.read_text()))
+        assert len(docs) == 1
+        ds = docs[0]
+        assert ds["kind"] == "DaemonSet"
+        spec = ds["spec"]["template"]["spec"]
+        container = spec["containers"][0]
+        # No privileged mode (unlike the reference, which needed it for
+        # PCI config-space reads).
+        assert container["securityContext"].get("privileged") is not True
+        mounts = {m["name"]: m for m in container["volumeMounts"]}
+        assert mounts["host-sys"]["readOnly"] is True
+        assert (mounts["output-dir"]["mountPath"]
+                == "/etc/kubernetes/node-feature-discovery/features.d")
+        # TPU node-pool scheduling.
+        terms = spec["affinity"]["nodeAffinity"][
+            "requiredDuringSchedulingIgnoredDuringExecution"][
+            "nodeSelectorTerms"]
+        keys = {e["key"] for t in terms for e in t["matchExpressions"]}
+        assert "cloud.google.com/gke-tpu-accelerator" in keys
+        assert "google.com/tpu.present" in keys
+        assert any(t["key"] == "google.com/tpu"
+                   for t in spec["tolerations"])
+
+    def test_job_template(self):
+        text = (STATIC / "tpu-feature-discovery-job.yaml.template"
+                ).read_text()
+        job = yaml.safe_load(text.replace("NODE_NAME", "placeholder-node"))
+        assert job["kind"] == "Job"
+        spec = job["spec"]["template"]["spec"]
+        assert spec["nodeName"] == "placeholder-node"
+        assert "--oneshot" in spec["containers"][0]["args"]
+        assert spec["restartPolicy"] == "Never"
+
+    def test_strategy_env_matches_filename(self):
+        for path, want in [
+            (STATIC_YAMLS[0], "none"),
+            (STATIC_YAMLS[1], "single"),
+            (STATIC_YAMLS[2], "mixed"),
+        ]:
+            ds = yaml.safe_load(path.read_text())
+            env = {e["name"]: e.get("value") for e in
+                   ds["spec"]["template"]["spec"]["containers"][0]["env"]}
+            assert env["TFD_SLICE_STRATEGY"] == want, path.name
+
+
+class TestHelmChart:
+    def test_chart_versions_consistent(self):
+        chart = yaml.safe_load((HELM / "Chart.yaml").read_text())
+        assert chart["version"] == chart["appVersion"]
+
+    def test_values_parse_and_cover_flags(self):
+        values = yaml.safe_load((HELM / "values.yaml").read_text())
+        assert values["sliceStrategy"] in ("none", "single", "mixed")
+        assert values["backend"] in ("auto", "pjrt", "metadata", "null")
+        assert values["securityContext"]["capabilities"]["drop"] == ["ALL"]
+        assert values["nfd"]["master"]["config"]["extraLabelNs"] == [
+            "google.com"]
+
+    def test_template_env_vars_exist_in_binary(self, tfd_binary):
+        """Every TFD_* env the daemonset template wires must be a real env
+        alias of a CLI flag (catches template/flag drift)."""
+        help_text = subprocess.run(
+            [str(tfd_binary), "--help"], capture_output=True,
+            text=True).stdout
+        known = set(re.findall(r"TFD_[A-Z_]+", help_text))
+        template = (HELM / "templates" / "daemonset.yml").read_text()
+        wired = set(re.findall(r"TFD_[A-Z_]+", template))
+        missing = wired - known
+        assert not missing, f"template wires unknown env vars: {missing}"
+
+    def test_check_yamls_script(self, tfd_binary):
+        version = binary_version(tfd_binary)
+        # The dev build carries a -dev suffix; the YAML tag is the release
+        # version.
+        release = version.split("-")[0]
+        proc = subprocess.run(
+            ["sh", str(REPO / "tests" / "check-yamls.sh"), release],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+class TestTier34Drivers:
+    def test_integration_driver(self, tfd_binary):
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tests" / "integration-tests.py"),
+             str(tfd_binary)], capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_e2e_driver(self, tfd_binary):
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tests" / "e2e-tests.py"),
+             str(tfd_binary)], capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stdout + proc.stderr

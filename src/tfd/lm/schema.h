@@ -63,6 +63,13 @@ inline constexpr char kMultisliceSliceId[] =
 inline constexpr char kMultisliceNumSlices[] =
     "google.com/tpu.multislice.num-slices";
 
+// Device health (--device-health=basic): init + enumeration succeeded and
+// its latency. Deep measured probes (matmul/HBM/ICI) are tpufd.health's
+// job under the same google.com/tpu.health. prefix.
+inline constexpr char kHealthOk[] = "google.com/tpu.health.ok";
+inline constexpr char kHealthDevices[] = "google.com/tpu.health.devices";
+inline constexpr char kHealthProbeMs[] = "google.com/tpu.health.probe-ms";
+
 // The value used when a slice strategy's validation fails — the analogue of
 // the reference's "MIG-INVALID" product (mig-strategy.go:243-262).
 inline constexpr char kSliceInvalid[] = "SLICE-INVALID";

@@ -1,5 +1,7 @@
 #include "tfd/lm/tpu_labeler.h"
 
+#include <chrono>
+
 #include "tfd/lm/schema.h"
 #include "tfd/lm/slice_strategy.h"
 #include "tfd/util/logging.h"
@@ -80,6 +82,7 @@ LabelerPtr NewTopologyLabeler(resource::Manager& manager) {
 
 Result<LabelerPtr> NewTpuLabeler(const resource::ManagerPtr& manager,
                                  const config::Config& config) {
+  auto probe_start = std::chrono::steady_clock::now();
   Status init = manager->Init();
   if (!init.ok()) {
     return Result<LabelerPtr>::Error("failed to initialize " +
@@ -109,6 +112,26 @@ Result<LabelerPtr> NewTpuLabeler(const resource::ManagerPtr& manager,
   parts.push_back(NewVersionLabeler(*manager));
   parts.push_back(NewSliceCapabilityLabeler(*manager));
   parts.push_back(NewTopologyLabeler(*manager));
+  if (config.flags.device_health == "basic" &&
+      manager->Name() != "metadata") {
+    // Basic health: the backend initialized and every chip enumerated, and
+    // how long that took — a sick TPU stack shows up first as slow or
+    // failing init (hence the fail path never reaches here; absence of
+    // health labels on a TPU node means the probe never completed).
+    // Restricted to device-touching backends: the metadata backend labels
+    // from the control plane without touching silicon, so it must not
+    // vouch for chip health — including when auto fell back to it because
+    // PJRT init failed. Measured on-silicon probes (matmul/HBM/ICI
+    // throughput) live in tpufd.health and feed bench.py.
+    auto ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+                  std::chrono::steady_clock::now() - probe_start)
+                  .count();
+    Labels health;
+    health[kHealthOk] = "true";
+    health[kHealthDevices] = std::to_string(devices->size());
+    health[kHealthProbeMs] = std::to_string(ms);
+    parts.push_back(std::make_unique<StaticLabeler>(std::move(health)));
+  }
   Result<LabelerPtr> strategy = NewSliceStrategyLabeler(*manager, config);
   if (!strategy.ok()) {
     manager->Shutdown();

@@ -428,8 +428,13 @@ Result<Response> Request(const std::string& method, const std::string& url,
     transport = std::make_unique<PlainTransport>(*fd);
   }
 
+  // RFC 7230: IPv6 literals in the Host header must be bracketed
+  // (ParseUrl strips the brackets from the URL authority).
+  std::string host_header = parsed->host.find(':') != std::string::npos
+                                ? "[" + parsed->host + "]"
+                                : parsed->host;
   std::string request = method + " " + parsed->path + " HTTP/1.1\r\n" +
-                        "Host: " + parsed->host + "\r\n";
+                        "Host: " + host_header + "\r\n";
   for (const auto& [k, v] : options.headers) {
     request += k + ": " + v + "\r\n";
   }

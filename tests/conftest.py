@@ -12,7 +12,6 @@ JAX-based tests (tpufd package) run on a virtual 8-device CPU mesh.
 """
 
 import os
-import re
 import subprocess
 from pathlib import Path
 
@@ -87,23 +86,16 @@ def check_golden(output: str, golden_file: Path):
     """Every output line must match one of the golden regexes, and every
     golden regex must match at least one line (reference checkResult is
     line→regex only; we additionally require full coverage so missing labels
-    fail)."""
-    regexes = [
-        line for line in golden_file.read_text().splitlines()
-        if line.strip() and not line.startswith("#")
-    ]
-    compiled = [re.compile("^" + r + "$") for r in regexes]
+    fail). Shared matcher: tests/golden_match.py."""
+    from golden_match import load_golden, match_lines
+
     lines = [l for l in output.splitlines() if l.strip()]
-    unmatched_lines = [
-        l for l in lines if not any(c.match(l) for c in compiled)
-    ]
-    unmatched_regexes = [
-        r for r, c in zip(regexes, compiled)
-        if not any(c.match(l) for l in lines)
-    ]
+    unmatched_lines, unmatched_regexes = match_lines(
+        load_golden(golden_file), lines)
     assert not unmatched_lines, (
         f"output lines not matched by any golden regex in "
         f"{golden_file.name}: {unmatched_lines}")
     assert not unmatched_regexes, (
         f"golden regexes with no matching output line in "
-        f"{golden_file.name}: {unmatched_regexes}")
+        f"{golden_file.name}: "
+        f"{[r.pattern for r in unmatched_regexes]}")

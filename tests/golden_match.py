@@ -1,0 +1,33 @@
+"""Golden-regex matching shared by the pytest tiers and the standalone
+integration/e2e drivers (one implementation of the reference's checkResult
+semantics, main_test.go:403-435, extended to require full coverage in both
+directions)."""
+
+import re
+from pathlib import Path
+
+
+def load_golden(golden_file: Path):
+    """Reads a golden file into compiled full-line regexes, skipping blank
+    lines and # comments."""
+    return [
+        re.compile(line.strip())
+        for line in Path(golden_file).read_text().splitlines()
+        if line.strip() and not line.startswith("#")
+    ]
+
+
+def match_lines(regexes, lines):
+    """Consumes each line against at most one regex (1:1). Returns
+    (unmatched_lines, unmatched_regexes); both empty means a full
+    bidirectional match."""
+    remaining_regexes = list(regexes)
+    remaining_lines = []
+    for line in lines:
+        for regex in remaining_regexes:
+            if regex.fullmatch(line):
+                remaining_regexes.remove(regex)
+                break
+        else:
+            remaining_lines.append(line)
+    return remaining_lines, remaining_regexes

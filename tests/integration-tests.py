@@ -13,7 +13,6 @@ Usage: integration-tests.py BINARY [GOLDEN]
 """
 
 import os
-import re
 import signal
 import subprocess
 import sys
@@ -21,27 +20,22 @@ import tempfile
 import time
 from pathlib import Path
 
-sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
-
-from tpufd.fakes.metadata_server import FakeMetadataServer, tpu_vm  # noqa: E402
-
 TESTS = Path(__file__).resolve().parent
+sys.path.insert(0, str(TESTS.parent))
+sys.path.insert(0, str(TESTS))
+
+from golden_match import load_golden, match_lines  # noqa: E402
+from tpufd.fakes.metadata_server import FakeMetadataServer, tpu_vm  # noqa: E402
 
 
 def check_labels(expected_regexes, labels):
-    regexes = list(expected_regexes)
-    lines = list(labels)
-    for label in labels:
-        for regex in regexes:
-            if regex.fullmatch(label):
-                regexes.remove(regex)
-                lines.remove(label)
-                break
-    for label in lines:
+    unmatched_lines, unmatched_regexes = match_lines(expected_regexes,
+                                                     labels)
+    for label in unmatched_lines:
         print(f"Unexpected label: {label}")
-    for regex in regexes:
+    for regex in unmatched_regexes:
         print(f"Missing label matching regex: {regex.pattern}")
-    return not regexes and not lines
+    return not unmatched_regexes and not unmatched_lines
 
 
 def main():
@@ -51,12 +45,7 @@ def main():
     binary = sys.argv[1]
     golden = Path(sys.argv[2]) if len(sys.argv) == 3 else (
         TESTS / "golden" / "expected-output-tpu-integration.txt")
-
-    expected = [
-        re.compile(line.strip())
-        for line in golden.read_text().splitlines()
-        if line.strip() and not line.startswith("#")
-    ]
+    expected = load_golden(golden)
 
     print("Running integration tests for tpu-feature-discovery")
     with FakeMetadataServer(tpu_vm()) as server, \

@@ -205,3 +205,18 @@ class TestMetadataBackend:
             labels = labels_of(out)
             assert labels["google.com/tpu.machine"] == "n2-standard-8"
             assert "google.com/tpu.count" not in labels
+
+    def test_metadata_backend_never_vouches_health(self, tfd_binary):
+        """--device-health=basic must stay silent on the metadata backend:
+        labeling from the control plane proves nothing about silicon (and
+        auto may have fallen back here precisely because PJRT init
+        failed)."""
+        with FakeMetadataServer(tpu_vm()) as server:
+            code, out, err = run_tfd(tfd_binary, [
+                "--oneshot", "--output-file=", "--backend=metadata",
+                f"--metadata-endpoint={server.endpoint}",
+                "--device-health=basic", "--machine-type-file=/dev/null",
+            ], env={"GCE_METADATA_HOST": server.endpoint})
+            assert code == 0, err
+            assert "tpu.health" not in out
+            assert labels_of(out)["google.com/tpu.count"] == "4"

@@ -220,3 +220,23 @@ def test_unknown_flag_rejected(tfd_binary):
     code, _, err = run_tfd(tfd_binary, ["--bogus-flag"])
     assert code == 1
     assert "unknown flag" in err
+
+
+def test_device_health_basic(tfd_binary):
+    """--device-health=basic adds probe labels on a TPU node and nothing on
+    a no-TPU node (absence of health labels = probe never completed)."""
+    code, out, _ = run_tfd(tfd_binary, oneshot_args(
+        ["--backend=mock",
+         f"--mock-topology-file={FIXTURES / 'v2-8.yaml'}",
+         "--machine-type-file=/dev/null", "--device-health=basic"]))
+    assert code == 0
+    labels = dict(line.split("=", 1) for line in out.splitlines() if line)
+    assert labels["google.com/tpu.health.ok"] == "true"
+    assert labels["google.com/tpu.health.devices"] == "4"
+    assert int(labels["google.com/tpu.health.probe-ms"]) >= 0
+
+    code, out, _ = run_tfd(tfd_binary, oneshot_args(
+        ["--backend=null", "--fail-on-init-error=false",
+         "--machine-type-file=/dev/null", "--device-health=basic"]))
+    assert code == 0
+    assert "tpu.health" not in out

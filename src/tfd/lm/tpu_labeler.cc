@@ -112,17 +112,16 @@ Result<LabelerPtr> NewTpuLabeler(const resource::ManagerPtr& manager,
   parts.push_back(NewVersionLabeler(*manager));
   parts.push_back(NewSliceCapabilityLabeler(*manager));
   parts.push_back(NewTopologyLabeler(*manager));
-  if (config.flags.device_health == "basic" &&
-      manager->Name() != "metadata") {
+  if (config.flags.device_health == "basic" && manager->TouchesDevices()) {
     // Basic health: the backend initialized and every chip enumerated, and
     // how long that took — a sick TPU stack shows up first as slow or
     // failing init (hence the fail path never reaches here; absence of
     // health labels on a TPU node means the probe never completed).
-    // Restricted to device-touching backends: the metadata backend labels
-    // from the control plane without touching silicon, so it must not
-    // vouch for chip health — including when auto fell back to it because
-    // PJRT init failed. Measured on-silicon probes (matmul/HBM/ICI
-    // throughput) live in tpufd.health and feed bench.py.
+    // Restricted to device-touching backends: a control-plane backend
+    // (metadata) must not vouch for chip health — including when auto
+    // fell back to it because PJRT init failed. Measured on-silicon
+    // probes (matmul/HBM/ICI throughput) live in tpufd.health and feed
+    // bench.py.
     auto ms = std::chrono::duration_cast<std::chrono::milliseconds>(
                   std::chrono::steady_clock::now() - probe_start)
                   .count();

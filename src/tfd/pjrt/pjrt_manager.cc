@@ -166,6 +166,7 @@ class PjrtManager : public Manager {
   }
 
   std::string Name() const override { return "pjrt"; }
+  bool TouchesDevices() const override { return true; }
 
  private:
   struct DeviceDesc {

@@ -33,6 +33,7 @@ class EnrichedManager : public Manager {
     return inner_->GetRuntimeVersion();
   }
   std::string Name() const override { return inner_->Name(); }
+  bool TouchesDevices() const override { return inner_->TouchesDevices(); }
 
   Result<TopologyInfo> GetTopology() override {
     Result<TopologyInfo> topo = inner_->GetTopology();

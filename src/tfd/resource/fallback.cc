@@ -40,6 +40,7 @@ class FallbackManager : public Manager {
     return active_->GetTopology();
   }
   std::string Name() const override { return active_->Name(); }
+  bool TouchesDevices() const override { return active_->TouchesDevices(); }
 
  private:
   ManagerPtr active_;
@@ -86,6 +87,7 @@ class FallbackChainManager : public Manager {
     return active_->GetTopology();
   }
   std::string Name() const override { return active_->Name(); }
+  bool TouchesDevices() const override { return active_->TouchesDevices(); }
 
  private:
   std::vector<ManagerPtr> candidates_;

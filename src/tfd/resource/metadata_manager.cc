@@ -135,6 +135,7 @@ class MetadataManager : public Manager {
   Result<TopologyInfo> GetTopology() override { return topology_; }
 
   std::string Name() const override { return "metadata"; }
+  bool TouchesDevices() const override { return false; }
 
  private:
   gce::MetadataClient client_;

@@ -79,6 +79,7 @@ class MockManager : public Manager {
   Result<TopologyInfo> GetTopology() override { return topology_; }
 
   std::string Name() const override { return "mock"; }
+  bool TouchesDevices() const override { return true; }
 
   std::string init_error_;
   std::string libtpu_version_;

@@ -30,6 +30,7 @@ class NullManager : public Manager {
   }
 
   std::string Name() const override { return "null"; }
+  bool TouchesDevices() const override { return false; }
 };
 
 }  // namespace

@@ -76,6 +76,11 @@ class Manager {
   // Short backend name for logs and the tpu.backend label
   // (e.g. "pjrt", "metadata", "mock", "null").
   virtual std::string Name() const = 0;
+
+  // Whether this backend exercises the device stack itself (dlopen'd
+  // libtpu, device nodes) rather than describing it from the control
+  // plane. Only device-touching backends may vouch for device health.
+  virtual bool TouchesDevices() const = 0;
 };
 
 using ManagerPtr = std::shared_ptr<Manager>;

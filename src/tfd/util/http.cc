@@ -428,11 +428,14 @@ Result<Response> Request(const std::string& method, const std::string& url,
     transport = std::make_unique<PlainTransport>(*fd);
   }
 
-  // RFC 7230: IPv6 literals in the Host header must be bracketed
-  // (ParseUrl strips the brackets from the URL authority).
+  // RFC 7230 §5.4: Host mirrors the URI authority — IPv6 literals
+  // re-bracketed (ParseUrl strips them), non-default ports included.
   std::string host_header = parsed->host.find(':') != std::string::npos
                                 ? "[" + parsed->host + "]"
                                 : parsed->host;
+  if (parsed->port != (parsed->tls ? 443 : 80)) {
+    host_header += ":" + std::to_string(parsed->port);
+  }
   std::string request = method + " " + parsed->path + " HTTP/1.1\r\n" +
                         "Host: " + host_header + "\r\n";
   for (const auto& [k, v] : options.headers) {

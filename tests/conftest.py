@@ -99,3 +99,8 @@ def check_golden(output: str, golden_file: Path):
         f"golden regexes with no matching output line in "
         f"{golden_file.name}: "
         f"{[r.pattern for r in unmatched_regexes]}")
+
+
+def labels_of(output: str):
+    """Parses `key=value` label lines into a dict."""
+    return dict(line.split("=", 1) for line in output.splitlines() if line)

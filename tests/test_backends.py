@@ -14,7 +14,7 @@ from pathlib import Path
 
 import pytest
 
-from conftest import BUILD_DIR, GOLDEN, REPO, check_golden, run_tfd
+from conftest import BUILD_DIR, GOLDEN, REPO, check_golden, run_tfd, labels_of
 
 sys.path.insert(0, str(REPO))
 
@@ -22,10 +22,6 @@ from tpufd.fakes.metadata_server import (  # noqa: E402
     FakeMetadataServer, cpu_vm, tpu_vm)
 
 FAKE_PJRT = BUILD_DIR / "libtfd_fake_pjrt.so"
-
-
-def labels_of(out):
-    return dict(line.split("=", 1) for line in out.splitlines() if line)
 
 
 def pjrt_args(extra=None, machine="/dev/null"):

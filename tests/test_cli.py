@@ -15,7 +15,7 @@ from pathlib import Path
 
 import pytest
 
-from conftest import FIXTURES, GOLDEN, check_golden, run_tfd
+from conftest import FIXTURES, GOLDEN, check_golden, labels_of, run_tfd
 
 
 def oneshot_args(extra):
@@ -230,7 +230,7 @@ def test_device_health_basic(tfd_binary):
          f"--mock-topology-file={FIXTURES / 'v2-8.yaml'}",
          "--machine-type-file=/dev/null", "--device-health=basic"]))
     assert code == 0
-    labels = dict(line.split("=", 1) for line in out.splitlines() if line)
+    labels = labels_of(out)
     assert labels["google.com/tpu.health.ok"] == "true"
     assert labels["google.com/tpu.health.devices"] == "4"
     assert int(labels["google.com/tpu.health.probe-ms"]) >= 0

@@ -67,9 +67,11 @@ class TestV5p128EveryHost:
         ordering leaks) once the timestamp label is disabled."""
         args = v5p_args(FIXTURES / "v5p-128-worker3.yaml",
                         ["--no-timestamp"])
-        _, first, _ = run_tfd(tfd_binary, args)
-        _, second, _ = run_tfd(tfd_binary, args)
-        assert first == second
+        code1, first, err1 = run_tfd(tfd_binary, args)
+        code2, second, err2 = run_tfd(tfd_binary, args)
+        assert code1 == 0, err1
+        assert code2 == 0, err2
+        assert first and first == second
         # And the output is sorted, so any future map-iteration leak fails
         # loudly rather than flaking.
         lines = [l for l in first.splitlines() if l]

@@ -13,11 +13,13 @@ Design notes (TPU-first):
   - The HBM probe streams a large bf16 buffer (scale + add) so the copy is
     bandwidth-bound.
   - The collective probe psums across a mesh axis, measuring ICI.
-  - All probes block_until_ready and time the *second* call (first call
-    pays XLA compilation).
+  - Timing is differential — t(2N iters) − t(N iters), salted inputs,
+    median of pairs, auto-calibrated loop length — so XLA compilation,
+    dispatch overhead, host round-trips on tunneled devices, and
+    result-memoizing relays all cancel out of the throughput number.
 """
 
-import functools
+import itertools
 import time
 
 import jax
@@ -25,19 +27,88 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 
-def _time_call(fn, *args):
-    """Compile (first call), then time the second. Returns seconds."""
-    fn(*args).block_until_ready()
-    start = time.perf_counter()
-    fn(*args).block_until_ready()
-    return time.perf_counter() - start
+def _fetch_scalar(result):
+    """Forces completion by reading ONE element back to the host — robust
+    where block_until_ready acks early (remote-relay PJRT plugins). Reads
+    from an addressable shard so multi-host sharded results work, and
+    slices on-device so only a scalar crosses the wire (np.asarray here
+    would download the whole buffer)."""
+    shards = getattr(result, "addressable_shards", None)
+    target = shards[0].data if shards else result
+    return float(target.ravel()[0])
 
 
-@functools.partial(jax.jit, static_argnames=("size", "iters"))
-def _matmul_chain(x, size, iters):
+_salt_counter = itertools.count(1)
+
+
+def _salt():
+    """A fresh scalar per invocation, sized to be exactly representable in
+    bf16 next to O(1) data (0.125 steps — a raw tiny epsilon would round
+    away and leave inputs bit-identical). Defeats result memoization
+    between host and device (remote-relay PJRT plugins cache deterministic
+    executions)."""
+    return (next(_salt_counter) % 13 + 1) * 0.125
+
+
+def _time_iters(fn, iters, settle_s=0.5):
+    """Seconds attributable to `iters` loop iterations alone.
+
+    `fn(n, salt)` must run `n` loop iterations — n arrives as a TRACED
+    int32, so ONE executable serves every calibration length — and fold
+    `salt` into its input. Times runs at n and 2n and returns the
+    difference, so fixed per-call overhead — dispatch, host round-trips
+    on tunneled devices — cancels instead of polluting the throughput
+    number.
+
+    Raises RuntimeError when the difference is not measurable (jitter or
+    caching swamped it); callers must treat that as probe failure, not as
+    infinite throughput.
+    """
+    warmed = False
+
+    def run(n):
+        nonlocal warmed
+        if not warmed:  # the one XLA compile never pollutes a timing
+            _fetch_scalar(fn(jnp.int32(n), jnp.bfloat16(_salt())))
+            warmed = True
+        start = time.perf_counter()
+        _fetch_scalar(fn(jnp.int32(n), jnp.bfloat16(_salt())))
+        return time.perf_counter() - start
+
+    # Calibrate on the DIFFERENTIAL, not single-run wall time: on tunneled
+    # devices one call's latency alone can exceed any threshold while the
+    # compute difference is still lost in jitter — and a single pair can be
+    # faked by that jitter, so every step judges the median of 3 pairs.
+    # Grow the loop until median(t(2n) - t(n)) is comfortably measurable.
+    n = iters
+    while True:
+        diffs = sorted(run(2 * n) - run(n) for _ in range(3))
+        if diffs[1] >= settle_s or n >= iters * 1024:
+            break
+        n *= 4
+    seconds_for_n = diffs[1]  # median rides out jitter
+    if seconds_for_n < settle_s / 2:
+        # Hitting the calibration cap with the diff still below the floor
+        # means device time never grew with the loop length (memoized
+        # replies or jitter-dominated timing) — a tiny positive diff here
+        # would report an absurd throughput as healthy.
+        raise RuntimeError(
+            f"unmeasurable device time (median diff {seconds_for_n:.2g}s "
+            f"at {n} iterations); not reporting a throughput")
+    return seconds_for_n * iters / n  # normalize back to `iters`
+
+
+def _settle_s(device):
+    """TPU measurements must out-shout tunnel round-trips (~0.1 s); local
+    CPU/test runs keep probes fast."""
+    return 0.15 if device.platform == "tpu" else 0.02
+
+
+@jax.jit
+def _matmul_chain(x, n):
     def body(_, acc):
         return jnp.tanh(acc @ acc) * 0.5 + acc * 0.5
-    return jax.lax.fori_loop(0, iters, body, x)
+    return jax.lax.fori_loop(0, n, body, x)
 
 
 def matmul_tflops(device=None, size=4096, iters=8):
@@ -45,16 +116,18 @@ def matmul_tflops(device=None, size=4096, iters=8):
     device = device or jax.devices()[0]
     x = jax.device_put(
         jnp.ones((size, size), dtype=jnp.bfloat16) * 0.001, device)
-    seconds = _time_call(lambda v: _matmul_chain(v, size, iters), x)
+    seconds = _time_iters(
+        lambda n, salt: _matmul_chain(x * salt, n),
+        iters, settle_s=_settle_s(device))
     flops = 2.0 * size * size * size * iters
     return flops / seconds / 1e12
 
 
-@functools.partial(jax.jit, static_argnames=("iters",))
-def _stream(x, iters):
+@jax.jit
+def _stream(x, n):
     def body(_, acc):
         return acc * 1.0000001 + 0.5
-    return jax.lax.fori_loop(0, iters, body, x)
+    return jax.lax.fori_loop(0, n, body, x)
 
 
 def hbm_gbps(device=None, mib=512, iters=16):
@@ -62,7 +135,9 @@ def hbm_gbps(device=None, mib=512, iters=16):
     device = device or jax.devices()[0]
     n = mib * 1024 * 1024 // 2  # bf16 elements
     x = jax.device_put(jnp.zeros((n,), dtype=jnp.bfloat16), device)
-    seconds = _time_call(lambda v: _stream(v, iters), x)
+    seconds = _time_iters(
+        lambda k, salt: _stream(x + salt, k), iters,
+        settle_s=_settle_s(device))
     bytes_moved = 2.0 * n * 2 * iters  # read + write per iter
     return bytes_moved / seconds / 1e9
 
@@ -79,13 +154,15 @@ def allreduce_gbps(mesh, mib=64, iters=8):
                        sharding)
 
     @jax.jit
-    def reduce_loop(v):
+    def reduce_loop(v, k):
         def body(_, acc):
             summed = jnp.sum(acc, axis=0, keepdims=True)
             return acc + summed * 1e-6  # keep values bounded
-        return jax.lax.fori_loop(0, iters, body, v)
+        return jax.lax.fori_loop(0, k, body, v)
 
-    seconds = _time_call(reduce_loop, x)
+    seconds = _time_iters(
+        lambda k, salt: reduce_loop(x * salt, k), iters,
+        settle_s=_settle_s(mesh.devices.flat[0]))
     # Ring all-reduce moves 2*(k-1)/k of the buffer per step.
     bytes_moved = 2.0 * n * 2 * (n_dev - 1) / n_dev * iters
     return bytes_moved / seconds / 1e9

@@ -240,3 +240,23 @@ def test_device_health_basic(tfd_binary):
          "--machine-type-file=/dev/null", "--device-health=basic"]))
     assert code == 0
     assert "tpu.health" not in out
+
+
+def test_v6e_8_single(tfd_binary):
+    """Trillium (v6e) single host, slice-strategy=single."""
+    code, out, _ = run_tfd(tfd_binary, oneshot_args(
+        ["--backend=mock",
+         f"--mock-topology-file={FIXTURES / 'v6e-8.yaml'}",
+         "--slice-strategy=single", "--machine-type-file=/dev/null"]))
+    assert code == 0
+    check_golden(out, GOLDEN / "expected-output-tpu-v6e-8-single.txt")
+
+
+def test_v4_16_mixed(tfd_binary):
+    """v4 two-host cube with wraparound, slice-strategy=mixed."""
+    code, out, _ = run_tfd(tfd_binary, oneshot_args(
+        ["--backend=mock",
+         f"--mock-topology-file={FIXTURES / 'v4-16.yaml'}",
+         "--slice-strategy=mixed", "--machine-type-file=/dev/null"]))
+    assert code == 0
+    check_golden(out, GOLDEN / "expected-output-tpu-v4-16-mixed.txt")

@@ -83,3 +83,23 @@ def test_health_probes_cpu(cpu_jax):
     assert gbps > 0
     labels = health.health_labels()
     assert labels["google.com/tpu.health.ok"] == "true"
+
+
+def test_cli_burnin(cpu_jax, capsys):
+    """python -m tpufd burnin runs the sharded step over all devices."""
+    from tpufd.__main__ import main
+
+    assert main(["burnin", "--steps", "1"]) == 0
+    out = capsys.readouterr().out
+    assert "mesh: data=" in out and "final loss" in out
+
+
+def test_cli_health(cpu_jax, capsys):
+    """python -m tpufd health prints feature-file-format label lines."""
+    from tpufd.__main__ import main
+
+    code = main(["health"])
+    out = capsys.readouterr().out
+    assert code == 0, out
+    labels = dict(line.split("=", 1) for line in out.splitlines())
+    assert labels["google.com/tpu.health.ok"] == "true"

@@ -1,0 +1,69 @@
+"""Operator CLI for the Python-side TPU tooling.
+
+  python -m tpufd health   — run the on-chip probes, print label lines
+                             (key=value, the NFD feature-file format, so
+                             output can be appended to a features.d file)
+  python -m tpufd burnin   — compile + run the sharded burn-in training
+                             step over all visible devices (slice
+                             acceptance test)
+
+The C++ daemon labels what a node *has*; these commands measure what it
+*does* — the slice-acceptance half of the framework.
+"""
+
+import argparse
+import math
+import sys
+
+
+def cmd_health(args):
+    from tpufd import health
+
+    labels = health.health_labels(prefix=args.prefix)
+    for key in sorted(labels):
+        print(f"{key}={labels[key]}")
+    return 0 if labels.get(args.prefix + "ok") == "true" else 1
+
+
+def cmd_burnin(args):
+    import jax
+
+    from tpufd import burnin, mesh as mesh_lib
+
+    devices = jax.devices()
+    mesh = mesh_lib.data_model_mesh(
+        devices, model_parallelism=args.model_parallelism)
+    print(f"devices: {len(devices)} x {devices[0].device_kind}")
+    print(f"mesh: data={mesh.shape['data']} model={mesh.shape['model']}")
+    loss = burnin.run_burnin(mesh, steps=args.steps)
+    ok = math.isfinite(loss)
+    print(f"final loss after {args.steps} steps: {loss:.6f} "
+          f"({'ok' if ok else 'NOT FINITE'})")
+    return 0 if ok else 1
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="python -m tpufd")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    health = sub.add_parser("health", help="on-chip health probe labels")
+    health.add_argument("--prefix", default="google.com/tpu.health.")
+    health.set_defaults(fn=cmd_health)
+
+    def positive_int(text):
+        value = int(text)
+        if value < 1:
+            raise argparse.ArgumentTypeError("must be >= 1")
+        return value
+
+    burnin = sub.add_parser("burnin", help="sharded slice burn-in step")
+    burnin.add_argument("--steps", type=positive_int, default=2)
+    burnin.add_argument("--model-parallelism", type=int, default=None)
+    burnin.set_defaults(fn=cmd_burnin)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -74,6 +74,39 @@ def test_graft_entry(cpu_jax):
     graft.dryrun_multichip(4)
 
 
+def test_graft_dryrun_hermetic_subprocess():
+    """Regression for the round-1 driver failure: dryrun_multichip must pass
+    in a FRESH interpreter whose environment does not pre-select the CPU
+    platform (the driver's environment — possibly with a sitecustomize that
+    pre-imports jax pinned to a tunneled hardware plugin). No cpu_jax
+    fixture here, deliberately: the in-process tests structurally cannot
+    catch a hermeticity bug because the fixture pre-switches the platform."""
+    import os
+    import subprocess
+
+    env = dict(os.environ)
+    # Undo the conftest's own CPU pinning so the subprocess sees what the
+    # driver would: whatever platform the ambient site (sitecustomize)
+    # installs, or the default.
+    env.pop("JAX_PLATFORMS", None)
+    flags = env.get("XLA_FLAGS", "")
+    flags = " ".join(f for f in flags.split()
+                     if "--xla_force_host_platform_device_count" not in f)
+    if flags:
+        env["XLA_FLAGS"] = flags
+    else:
+        env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(8); "
+         "print('DRYRUN_OK')"],
+        cwd=str(REPO), env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, (
+        f"dryrun_multichip(8) failed in driver-like env:\n"
+        f"stdout: {proc.stdout}\nstderr: {proc.stderr}")
+    assert "DRYRUN_OK" in proc.stdout
+
+
 def test_health_probes_cpu(cpu_jax):
     """The probes must run (tiny sizes) on whatever backend is present."""
     from tpufd import health

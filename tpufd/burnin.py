@@ -99,15 +99,29 @@ def run_burnin(mesh, batch=None, seq=None, d_model=256, d_ff=1024, steps=2):
         batch = 4 * data_n
     if seq is None:
         seq = 8 * model_n
-    key = jax.random.PRNGKey(0)
-    params = init_params(key, d_model=d_model, d_ff=d_ff)
+    # Create inputs under the mesh's own platform: without the pin, the
+    # unsharded init computations would dispatch to the process-default
+    # device, which on a host with an ambient hardware plugin may be a
+    # flaky tunneled TPU even when `mesh` is a virtual CPU mesh — the
+    # burn-in must only ever touch the devices it was handed. On a
+    # multi-host mesh, pin to a LOCALLY-ADDRESSABLE mesh device (device 0
+    # belongs to worker 0's process; dispatching to it from another worker
+    # would raise). Locality is judged against the mesh devices' OWN
+    # client — jax.process_index() would initialize the process-default
+    # backend, which may be a different (broken) platform than the mesh's.
+    local_process = mesh.devices.flat[0].client.process_index()
+    local_dev = next(
+        (d for d in mesh.devices.flat if d.process_index == local_process),
+        mesh.devices.flat[0])
+    with jax.default_device(local_dev):
+        key = jax.random.PRNGKey(0)
+        params = init_params(key, d_model=d_model, d_ff=d_ff)
+        x_host = jax.random.normal(
+            key, (batch, seq, d_model)).astype(jnp.bfloat16)
+        y_host = jnp.zeros((batch, seq, d_model), dtype=jnp.bfloat16)
     params = jax.device_put(params, param_shardings(mesh))
-    x = jax.device_put(
-        jax.random.normal(key, (batch, seq, d_model)).astype(jnp.bfloat16),
-        batch_sharding(mesh))
-    y = jax.device_put(
-        jnp.zeros((batch, seq, d_model), dtype=jnp.bfloat16),
-        batch_sharding(mesh))
+    x = jax.device_put(x_host, batch_sharding(mesh))
+    y = jax.device_put(y_host, batch_sharding(mesh))
 
     step = make_train_step(mesh)
     loss = None

@@ -149,6 +149,12 @@ RunOutcome Run(const config::Config& config, const sigset_t& sigmask) {
 }
 
 int Main(int argc, char** argv) {
+  // Ignore SIGPIPE process-wide, explicitly at startup: the HTTP client
+  // needs it (SSL_write cannot carry MSG_NOSIGNAL) and would otherwise
+  // install it lazily from inside a utility — the daemon owns its signal
+  // dispositions in one place (see util/http.h for the library contract).
+  signal(SIGPIPE, SIG_IGN);
+
   // Block the handled signals so sigtimedwait can collect them.
   sigset_t sigmask;
   sigemptyset(&sigmask);

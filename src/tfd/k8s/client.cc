@@ -167,9 +167,19 @@ Status UpdateNodeFeature(const ClusterConfig& config,
     jsonlite::Value& cr = **parsed;
 
     // Semantic-equality check to skip no-op updates (labels.go:170-176).
+    // The reference DeepEquals the whole mutated object, so the skip must
+    // also require the node-name metadata label to already be correct —
+    // a CR missing it could never be attributed to this node by the NFD
+    // master, and skipping here would leave it broken forever.
     jsonlite::ValuePtr current = cr.GetPath("spec.labels");
+    jsonlite::ValuePtr current_meta = cr.GetPath("metadata.labels");
+    jsonlite::ValuePtr node_name_label =
+        current_meta ? current_meta->Get("nfd.node.kubernetes.io/node-name")
+                     : nullptr;
     if (current && current->kind == jsonlite::Value::Kind::kObject &&
-        current->object_items.size() == labels.size()) {
+        current->object_items.size() == labels.size() && node_name_label &&
+        node_name_label->kind == jsonlite::Value::Kind::kString &&
+        node_name_label->string_value == config.node_name) {
       bool equal = true;
       for (const auto& [k, v] : current->object_items) {
         auto it = labels.find(k);

@@ -12,6 +12,7 @@
 #include <cstring>
 #include <fstream>
 #include <iostream>
+#include <limits>
 
 #include "tfd/config/config.h"
 #include "tfd/config/yamllite.h"
@@ -24,6 +25,8 @@
 #include "tfd/slice/shape.h"
 #include "tfd/slice/topology.h"
 #include "tfd/util/file.h"
+#include "tfd/util/http.h"
+#include "tfd/util/jsonlite.h"
 #include "tfd/util/strings.h"
 
 namespace tfd {
@@ -448,6 +451,54 @@ void TestAtomicWrite() {
   CHECK_TRUE(system(cmd.c_str()) == 0);
 }
 
+void TestUrlParsing() {
+  auto url = http::ParseUrl("https://10.0.0.1:6443/api");
+  CHECK_TRUE(url.ok());
+  CHECK_EQ(url->host, "10.0.0.1");
+  CHECK_TRUE(url->port == 6443 && url->tls);
+  CHECK_EQ(url->path, "/api");
+
+  url = http::ParseUrl("http://example.com");
+  CHECK_TRUE(url.ok());
+  CHECK_EQ(url->host, "example.com");
+  CHECK_TRUE(url->port == 80 && !url->tls);
+  CHECK_EQ(url->path, "/");
+
+  // Bracketed IPv6, with and without a port.
+  url = http::ParseUrl("https://[fd00::1]:6443/apis");
+  CHECK_TRUE(url.ok());
+  CHECK_EQ(url->host, "fd00::1");
+  CHECK_TRUE(url->port == 6443);
+  url = http::ParseUrl("https://[fd00::1]/apis");
+  CHECK_TRUE(url.ok());
+  CHECK_EQ(url->host, "fd00::1");
+  CHECK_TRUE(url->port == 443);
+
+  // Unbracketed IPv6 literal: the whole hostport is the host (splitting
+  // at the last colon would yield host "fd00:" port 1).
+  url = http::ParseUrl("https://fd00::1");
+  CHECK_TRUE(url.ok());
+  CHECK_EQ(url->host, "fd00::1");
+  CHECK_TRUE(url->port == 443);
+
+  CHECK_TRUE(!http::ParseUrl("ftp://x").ok());
+  CHECK_TRUE(!http::ParseUrl("https://[fd00::1/x").ok());
+  CHECK_TRUE(!http::ParseUrl("https:///x").ok());
+}
+
+void TestJsonNonFiniteSerialization() {
+  // JSON has no nan/inf tokens; Serialize must degrade to null rather
+  // than emit an invalid document on the CR write path.
+  auto value = std::make_shared<jsonlite::Value>();
+  value->kind = jsonlite::Value::Kind::kNumber;
+  value->number_value = std::numeric_limits<double>::quiet_NaN();
+  CHECK_EQ(jsonlite::Serialize(*value), "null");
+  value->number_value = std::numeric_limits<double>::infinity();
+  CHECK_EQ(jsonlite::Serialize(*value), "null");
+  value->number_value = 42.0;
+  CHECK_EQ(jsonlite::Serialize(*value), "42");
+}
+
 }  // namespace
 }  // namespace tfd
 
@@ -469,6 +520,8 @@ int main() {
   tfd::TestTpuEnvParse();
   tfd::TestLabelFormatting();
   tfd::TestAtomicWrite();
+  tfd::TestUrlParsing();
+  tfd::TestJsonNonFiniteSerialization();
 
   std::cerr << tfd::g_checks << " checks, " << tfd::g_failures << " failures"
             << std::endl;

@@ -129,12 +129,7 @@ std::string SslErrorString() {
   return buf;
 }
 
-struct Url {
-  bool tls = false;
-  std::string host;
-  int port = 80;
-  std::string path = "/";
-};
+}  // namespace
 
 Result<Url> ParseUrl(const std::string& url) {
   Url out;
@@ -164,7 +159,11 @@ Result<Url> ParseUrl(const std::string& url) {
     }
   } else {
     size_t colon = hostport.rfind(':');
-    if (colon != std::string::npos) {
+    if (colon != std::string::npos &&
+        hostport.find(':') == colon) {
+      // Exactly one colon: host:port. More than one means an unbracketed
+      // IPv6 literal (e.g. https://fd00::1) — treat the whole string as
+      // the host; there is no way to carry a port without brackets.
       out.port = atoi(hostport.c_str() + colon + 1);
       out.host = hostport.substr(0, colon);
     } else {
@@ -174,6 +173,8 @@ Result<Url> ParseUrl(const std::string& url) {
   if (out.host.empty()) return Result<Url>::Error("empty host in " + url);
   return out;
 }
+
+namespace {
 
 bool IsIpLiteral(const std::string& host) {
   unsigned char buf[sizeof(in6_addr)];

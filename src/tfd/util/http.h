@@ -21,6 +21,18 @@ struct Response {
   std::string body;
 };
 
+// Parsed form of http[s]://host[:port]/path. Unbracketed IPv6 literals
+// (e.g. https://fd00::1) are accepted as a bare host at the scheme's
+// default port; a non-default port requires brackets ([fd00::1]:6443).
+struct Url {
+  bool tls = false;
+  std::string host;
+  int port = 80;
+  std::string path = "/";
+};
+
+Result<Url> ParseUrl(const std::string& url);
+
 struct RequestOptions {
   std::map<std::string, std::string> headers;
   std::string ca_file;      // PEM bundle for server verification (https)
@@ -30,6 +42,13 @@ struct RequestOptions {
 
 // `url`: http://host[:port]/path or https://host[:port]/path.
 // `method`: GET/POST/PUT/DELETE; `body` sent for POST/PUT.
+//
+// PROCESS-WIDE SIDE EFFECT: the first call installs signal(SIGPIPE,
+// SIG_IGN) for the whole process (SSL_write cannot carry MSG_NOSIGNAL, so
+// a peer reset mid-write would otherwise kill the process). Writes to any
+// closed pipe thereafter return EPIPE instead of terminating; a component
+// that needs its own SIGPIPE handler must install it after the first
+// Request. The daemon also sets this up explicitly at startup (main.cc).
 Result<Response> Request(const std::string& method, const std::string& url,
                          const std::string& body,
                          const RequestOptions& options);

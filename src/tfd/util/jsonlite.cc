@@ -1,6 +1,7 @@
 #include "tfd/util/jsonlite.h"
 
 #include <cctype>
+#include <cmath>
 #include <cstdio>
 #include <sstream>
 
@@ -291,6 +292,10 @@ std::string Serialize(const Value& v) {
       // grow a ".0"; others keep full double precision. The cast is only
       // defined inside long long range, so gate it (9.2e18 < 2^63).
       double d = v.number_value;
+      // JSON has no token for non-finite numbers; "%.17g" would emit
+      // nan/inf and corrupt the PUT body on the CR write path. null is
+      // the closest valid degradation.
+      if (!std::isfinite(d)) return "null";
       if (d >= -9.2e18 && d <= 9.2e18 &&
           d == static_cast<double>(static_cast<long long>(d))) {
         return std::to_string(static_cast<long long>(d));

@@ -18,16 +18,19 @@ def load_golden(golden_file: Path):
 
 
 def match_lines(regexes, lines):
-    """Consumes each line against at most one regex (1:1). Returns
-    (unmatched_lines, unmatched_regexes); both empty means a full
-    bidirectional match."""
-    remaining_regexes = list(regexes)
-    remaining_lines = []
-    for line in lines:
-        for regex in remaining_regexes:
-            if regex.fullmatch(line):
-                remaining_regexes.remove(regex)
-                break
-        else:
-            remaining_lines.append(line)
-    return remaining_lines, remaining_regexes
+    """Coverage semantics, order-independent (a line may satisfy several
+    regexes and vice versa — label output is a map, so duplicate lines
+    cannot occur): every line must match SOME regex, and every regex must
+    match SOME line. Greedy 1:1 consumption would be order-dependent: a
+    line matching an earlier broad pattern could consume a regex a later
+    line needed, producing spurious mismatches. Returns (unmatched_lines,
+    unmatched_regexes); both empty means a full bidirectional match."""
+    unmatched_lines = [
+        line for line in lines
+        if not any(regex.fullmatch(line) for regex in regexes)
+    ]
+    unmatched_regexes = [
+        regex for regex in regexes
+        if not any(regex.fullmatch(line) for line in lines)
+    ]
+    return unmatched_lines, unmatched_regexes

@@ -68,6 +68,39 @@ def test_create_then_noop_then_update(tfd_binary, tmp_path):
         assert server.store[key]["metadata"]["resourceVersion"] == rv
 
 
+def test_repairs_missing_node_name_label(tfd_binary, tmp_path):
+    """A pre-existing CR whose spec.labels already match but whose
+    nfd.node.kubernetes.io/node-name metadata label is missing must be
+    repaired, not skipped — without that label the NFD master cannot
+    attribute the CR to the node. (The no-op short-circuit must include
+    metadata in its equality check, like the reference's DeepEqual.)"""
+    with FakeApiServer(token="sekrit") as server:
+        env = {
+            "NODE_NAME": "tpu-node-1",
+            "TFD_APISERVER_URL": server.url,
+            "TFD_SERVICEACCOUNT_DIR": str(sa_dir(tmp_path, "sekrit")),
+        }
+        args = nf_args() + ["--no-timestamp"]
+        code, _, err = run_tfd(tfd_binary, args, env=env)
+        assert code == 0, err
+        key = ("node-feature-discovery", "tfd-features-for-tpu-node-1")
+        assert server.store[key]["metadata"]["resourceVersion"] == "1"
+
+        # Sabotage: drop the node-name label (e.g. created by an older
+        # version or mangled by another controller). spec.labels still
+        # match exactly, so a spec-only equality check would skip.
+        del server.store[key]["metadata"]["labels"][
+            "nfd.node.kubernetes.io/node-name"]
+
+        code, _, err = run_tfd(tfd_binary, args, env=env)
+        assert code == 0, err
+        obj = server.store[key]
+        assert obj["metadata"]["resourceVersion"] == "2", (
+            "update skipped despite missing node-name metadata label")
+        assert (obj["metadata"]["labels"]
+                ["nfd.node.kubernetes.io/node-name"] == "tpu-node-1")
+
+
 def test_auth_failure(tfd_binary, tmp_path):
     with FakeApiServer(token="sekrit") as server:
         code, _, err = run_tfd(tfd_binary, nf_args(), env={

@@ -159,10 +159,37 @@ std::vector<FlagDef> MakeFlagDefs(Flags* f) {
   defs.push_back({"device-health",
                   {"TFD_DEVICE_HEALTH"},
                   "deviceHealth",
-                  "on-chip health probe labels: [off | basic]",
+                  "on-chip health probe labels: [off | basic | full] (full "
+                  "runs --health-exec and merges its measured labels)",
                   false,
                   [f](const std::string& v) {
                     return SetString(&f->device_health, v);
+                  }});
+  defs.push_back({"health-exec",
+                  {"TFD_HEALTH_EXEC"},
+                  "healthExec",
+                  "command run by --device-health=full; prints "
+                  "google.com/tpu.health.* key=value lines to stdout",
+                  false,
+                  [f](const std::string& v) {
+                    return SetString(&f->health_exec, v);
+                  }});
+  defs.push_back({"health-exec-timeout",
+                  {"TFD_HEALTH_EXEC_TIMEOUT"},
+                  "healthExecTimeout",
+                  "deadline for the health exec (e.g. 120s)",
+                  false,
+                  [f](const std::string& v) {
+                    return SetDuration(&f->health_exec_timeout_s, v);
+                  }});
+  defs.push_back({"health-exec-interval",
+                  {"TFD_HEALTH_EXEC_INTERVAL"},
+                  "healthExecInterval",
+                  "how often the measured probe re-runs (e.g. 1h); between "
+                  "runs the cached labels are republished",
+                  false,
+                  [f](const std::string& v) {
+                    return SetDuration(&f->health_exec_interval_s, v);
                   }});
   return defs;
 }
@@ -413,9 +440,17 @@ Result<LoadResult> Load(int argc, char** argv) {
         "invalid backend '" + backend +
         "' (want auto|pjrt|metadata|mock|null)");
   }
-  if (f->device_health != "off" && f->device_health != "basic") {
+  if (f->device_health != "off" && f->device_health != "basic" &&
+      f->device_health != "full") {
     return Result<LoadResult>::Error("invalid device-health '" +
-                                     f->device_health + "' (want off|basic)");
+                                     f->device_health +
+                                     "' (want off|basic|full)");
+  }
+  if (f->health_exec_timeout_s < 1) {
+    return Result<LoadResult>::Error("health-exec-timeout must be >= 1s");
+  }
+  if (f->health_exec_interval_s < 1) {
+    return Result<LoadResult>::Error("health-exec-interval must be >= 1s");
   }
   if (f->sleep_interval_s < 1) {
     return Result<LoadResult>::Error("sleep-interval must be >= 1s");
@@ -445,7 +480,11 @@ std::string ToJson(const Config& config) {
       << ",\"useNodeFeatureAPI\":"
       << (f.use_node_feature_api ? "true" : "false")
       << ",\"backend\":" << jstr(f.backend)
-      << ",\"deviceHealth\":" << jstr(f.device_health) << "},\"sharing\":[";
+      << ",\"deviceHealth\":" << jstr(f.device_health)
+      << ",\"healthExec\":" << jstr(f.health_exec)
+      << ",\"healthExecTimeout\":\"" << f.health_exec_timeout_s << "s\""
+      << ",\"healthExecInterval\":\"" << f.health_exec_interval_s << "s\""
+      << "},\"sharing\":[";
   for (size_t i = 0; i < config.sharing.time_slicing.size(); i++) {
     const SharedResource& r = config.sharing.time_slicing[i];
     if (i) out << ",";

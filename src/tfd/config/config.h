@@ -63,7 +63,18 @@ struct Flags {
   std::string libtpu_path;       // override libtpu.so location
   std::string metadata_endpoint; // override http://metadata.google.internal
   std::string mock_topology_file; // mock backend fixture (tests)
-  std::string device_health = "off";  // off|basic — run on-chip health probe
+  // off|basic|full. basic: init+enumeration+latency labels. full: basic
+  // plus measured silicon throughput labels (matmul TFLOPs, HBM GB/s,
+  // ICI all-reduce GB/s) merged from the output of `health_exec`.
+  std::string device_health = "off";
+  // Command for --device-health=full; must print google.com/tpu.health.*
+  // key=value lines (the NFD feature-file format) to stdout and exit 0.
+  std::string health_exec = "python3 -m tpufd health";
+  int health_exec_timeout_s = 120;
+  // Measured throughput doesn't change minute to minute: the exec result
+  // is cached and re-measured only this often, so the probe never runs
+  // once per sleep-interval.
+  int health_exec_interval_s = 3600;
 };
 
 struct Config {

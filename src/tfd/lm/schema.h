@@ -63,9 +63,11 @@ inline constexpr char kMultisliceSliceId[] =
 inline constexpr char kMultisliceNumSlices[] =
     "google.com/tpu.multislice.num-slices";
 
-// Device health (--device-health=basic): init + enumeration succeeded and
-// its latency. Deep measured probes (matmul/HBM/ICI) are tpufd.health's
-// job under the same google.com/tpu.health. prefix.
+// Device health. --device-health=basic: init + enumeration succeeded and
+// its latency. --device-health=full additionally merges measured silicon
+// labels (matmul-tflops, hbm-gbps, allreduce-gbps, ...) produced by the
+// health exec (tpufd.health) under the same prefix.
+inline constexpr char kHealthPrefix[] = "google.com/tpu.health.";
 inline constexpr char kHealthOk[] = "google.com/tpu.health.ok";
 inline constexpr char kHealthDevices[] = "google.com/tpu.health.devices";
 inline constexpr char kHealthProbeMs[] = "google.com/tpu.health.probe-ms";

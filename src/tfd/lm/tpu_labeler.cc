@@ -1,11 +1,14 @@
 #include "tfd/lm/tpu_labeler.h"
 
+#include <cctype>
 #include <chrono>
+#include <cstring>
 
 #include "tfd/lm/schema.h"
 #include "tfd/lm/slice_strategy.h"
 #include "tfd/util/logging.h"
 #include "tfd/util/strings.h"
+#include "tfd/util/subprocess.h"
 
 namespace tfd {
 namespace lm {
@@ -78,6 +81,89 @@ LabelerPtr NewTopologyLabeler(resource::Manager& manager) {
   return std::make_unique<StaticLabeler>(std::move(labels));
 }
 
+// A label key's name part (after the prefix) must be a valid Kubernetes
+// label name: alphanumeric ends, [-._a-zA-Z0-9] middle, <= 63 chars. A bad
+// key from a buggy probe must never reach the apiserver — an invalid label
+// name fails the whole NodeFeature update.
+bool ValidLabelKeySuffix(const std::string& s) {
+  if (s.empty() || s.size() > 63) return false;
+  auto alnum = [](char c) { return isalnum(static_cast<unsigned char>(c)); };
+  if (!alnum(s.front()) || !alnum(s.back())) return false;
+  for (char c : s) {
+    if (!alnum(c) && c != '-' && c != '_' && c != '.') return false;
+  }
+  return true;
+}
+
+// Runs the --health-exec command and returns the google.com/tpu.health.*
+// labels parsed from its key=value stdout lines. Keys outside the health
+// prefix or with invalid names are dropped with a warning (the probe must
+// not be able to overwrite, say, the product label, nor crash-loop the
+// daemon with an apiserver-rejected key); on any failure the ok label is
+// forced to "false".
+Labels RunHealthExec(const config::Config& config) {
+  Labels out;
+  Result<std::string> text = RunCommandCapture(
+      config.flags.health_exec, config.flags.health_exec_timeout_s);
+  if (!text.ok()) {
+    TFD_LOG_WARNING << "health exec failed: " << text.error();
+    out[kHealthOk] = "false";
+    return out;
+  }
+  for (const std::string& line : SplitString(*text, '\n')) {
+    std::string trimmed = TrimSpace(line);
+    if (trimmed.empty()) continue;
+    size_t eq = trimmed.find('=');
+    if (eq == std::string::npos || eq == 0) {
+      TFD_LOG_WARNING << "health exec: ignoring malformed line: " << trimmed;
+      continue;
+    }
+    std::string key = trimmed.substr(0, eq);
+    std::string value = trimmed.substr(eq + 1);
+    if (key.rfind(kHealthPrefix, 0) != 0) {
+      TFD_LOG_WARNING << "health exec: ignoring label outside "
+                      << kHealthPrefix << ": " << key;
+      continue;
+    }
+    if (!ValidLabelKeySuffix(key.substr(strlen(kHealthPrefix)))) {
+      TFD_LOG_WARNING << "health exec: ignoring invalid label key: " << key;
+      continue;
+    }
+    out[key] = SanitizeLabelValue(value);
+  }
+  if (out.empty()) {
+    TFD_LOG_WARNING << "health exec produced no health labels";
+    out[kHealthOk] = "false";
+  }
+  return out;
+}
+
+// Merges the (expensive) measured-probe labels, re-running the exec only
+// when the cached result is older than --health-exec-interval. The probe
+// benchmarks the silicon — rerunning a matmul/HBM/all-reduce sweep every
+// 60s sleep-interval would steal TPU cycles from co-located jobs and
+// stall label refresh; measured throughput does not change minute to
+// minute. The daemon is single-threaded, so plain statics suffice.
+void MergeHealthExecLabels(const config::Config& config, Labels* health) {
+  static Labels cached;
+  static std::string cached_exec;
+  static std::chrono::steady_clock::time_point cached_at;
+  static bool have_cache = false;
+
+  auto now = std::chrono::steady_clock::now();
+  bool stale =
+      !have_cache || cached_exec != config.flags.health_exec ||
+      now - cached_at >=
+          std::chrono::seconds(config.flags.health_exec_interval_s);
+  if (stale) {
+    cached = RunHealthExec(config);
+    cached_exec = config.flags.health_exec;
+    cached_at = now;
+    have_cache = true;
+  }
+  for (const auto& [k, v] : cached) (*health)[k] = v;
+}
+
 }  // namespace
 
 Result<LabelerPtr> NewTpuLabeler(const resource::ManagerPtr& manager,
@@ -112,24 +198,24 @@ Result<LabelerPtr> NewTpuLabeler(const resource::ManagerPtr& manager,
   parts.push_back(NewVersionLabeler(*manager));
   parts.push_back(NewSliceCapabilityLabeler(*manager));
   parts.push_back(NewTopologyLabeler(*manager));
-  if (config.flags.device_health == "basic" && manager->TouchesDevices()) {
+  const std::string& health_mode = config.flags.device_health;
+  bool health_on = (health_mode == "basic" || health_mode == "full") &&
+                   manager->TouchesDevices();
+  Labels health;
+  if (health_on) {
     // Basic health: the backend initialized and every chip enumerated, and
     // how long that took — a sick TPU stack shows up first as slow or
     // failing init (hence the fail path never reaches here; absence of
     // health labels on a TPU node means the probe never completed).
     // Restricted to device-touching backends: a control-plane backend
     // (metadata) must not vouch for chip health — including when auto
-    // fell back to it because PJRT init failed. Measured on-silicon
-    // probes (matmul/HBM/ICI throughput) live in tpufd.health and feed
-    // bench.py.
+    // fell back to it because PJRT init failed.
     auto ms = std::chrono::duration_cast<std::chrono::milliseconds>(
                   std::chrono::steady_clock::now() - probe_start)
                   .count();
-    Labels health;
     health[kHealthOk] = "true";
     health[kHealthDevices] = std::to_string(devices->size());
     health[kHealthProbeMs] = std::to_string(ms);
-    parts.push_back(std::make_unique<StaticLabeler>(std::move(health)));
   }
   Result<LabelerPtr> strategy = NewSliceStrategyLabeler(*manager, config);
   if (!strategy.ok()) {
@@ -138,6 +224,22 @@ Result<LabelerPtr> NewTpuLabeler(const resource::ManagerPtr& manager,
   }
   parts.push_back(std::move(*strategy));
   manager->Shutdown();
+
+  if (health_on && health_mode == "full") {
+    // Full health: run the measured-silicon probe (default:
+    // `python3 -m tpufd health` — matmul TFLOPs, HBM GB/s, ICI
+    // all-reduce GB/s) and merge its labels. The probe self-reports
+    // google.com/tpu.health.ok; a failed or timed-out probe downgrades
+    // ok to false rather than silently keeping basic's true — a node
+    // that enumerates but cannot run a matmul is exactly the node a
+    // scheduler must avoid. Runs strictly AFTER manager->Shutdown():
+    // TPU access is exclusive, so the probe could never acquire the
+    // chips while the daemon's own PJRT client holds them.
+    MergeHealthExecLabels(config, &health);
+  }
+  if (health_on) {
+    parts.push_back(std::make_unique<StaticLabeler>(std::move(health)));
+  }
 
   // Everything above is eagerly-computed static data; collapse it now so
   // later GetLabels() calls cannot touch the (shut-down) manager.

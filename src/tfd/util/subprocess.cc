@@ -1,0 +1,176 @@
+#include "tfd/util/subprocess.h"
+
+#include <poll.h>
+#include <signal.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <chrono>
+#include <cstring>
+
+namespace tfd {
+
+namespace {
+
+// Reaps `pid` and formats its exit disposition. Blocking waitpid is safe
+// here: callers only reach this after SIGKILLing the process group or
+// after WaitUntil saw the child exit.
+int WaitExitCode(pid_t pid, std::string* how) {
+  int wstatus = 0;
+  while (waitpid(pid, &wstatus, 0) < 0 && errno == EINTR) {
+  }
+  if (WIFEXITED(wstatus)) {
+    *how = "exit code " + std::to_string(WEXITSTATUS(wstatus));
+    return WEXITSTATUS(wstatus);
+  }
+  if (WIFSIGNALED(wstatus)) {
+    *how = std::string("signal ") + strsignal(WTERMSIG(wstatus));
+    return 128 + WTERMSIG(wstatus);
+  }
+  *how = "unknown wait status";
+  return -1;
+}
+
+// Polls (WNOHANG) until the child exits or `deadline` passes. On exit,
+// reaps the child, formats `how`, and returns its code via `code`;
+// returns false (without reaping) on deadline. EOF on the pipe does NOT
+// imply exit — a probe can close stdout and keep running — so even the
+// post-EOF wait must be bounded or the "hard deadline" contract breaks.
+bool WaitUntil(pid_t pid, std::chrono::steady_clock::time_point deadline,
+               int* code, std::string* how) {
+  while (true) {
+    int wstatus = 0;
+    pid_t rc = waitpid(pid, &wstatus, WNOHANG);
+    if (rc == pid) {
+      if (WIFEXITED(wstatus)) {
+        *how = "exit code " + std::to_string(WEXITSTATUS(wstatus));
+        *code = WEXITSTATUS(wstatus);
+      } else if (WIFSIGNALED(wstatus)) {
+        *how = std::string("signal ") + strsignal(WTERMSIG(wstatus));
+        *code = 128 + WTERMSIG(wstatus);
+      } else {
+        *how = "unknown wait status";
+        *code = -1;
+      }
+      return true;
+    }
+    if (rc < 0 && errno != EINTR) {
+      *how = std::string("waitpid: ") + strerror(errno);
+      *code = -1;
+      return true;
+    }
+    if (std::chrono::steady_clock::now() >= deadline) return false;
+    usleep(20 * 1000);
+  }
+}
+
+}  // namespace
+
+Result<std::string> RunCommandCapture(const std::string& command,
+                                      int timeout_s) {
+  int fds[2];
+  if (pipe(fds) != 0) {
+    return Result<std::string>::Error(std::string("pipe: ") +
+                                      strerror(errno));
+  }
+
+  pid_t pid = fork();
+  if (pid < 0) {
+    close(fds[0]);
+    close(fds[1]);
+    return Result<std::string>::Error(std::string("fork: ") +
+                                      strerror(errno));
+  }
+  if (pid == 0) {
+    // Child. Own process group so a timeout kill reaps the whole probe
+    // pipeline (sh + python), not just the shell.
+    setpgid(0, 0);
+    // The daemon blocks its handled signals for sigtimedwait; the probe
+    // must not inherit that mask or it becomes unkillable by SIGTERM.
+    sigset_t none;
+    sigemptyset(&none);
+    sigprocmask(SIG_SETMASK, &none, nullptr);
+    dup2(fds[1], STDOUT_FILENO);
+    close(fds[0]);
+    close(fds[1]);
+    execl("/bin/sh", "sh", "-c", command.c_str(), (char*)nullptr);
+    _exit(127);
+  }
+
+  close(fds[1]);
+  std::string output;
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::seconds(timeout_s);
+  bool timed_out = false;
+  bool overflowed = false;
+  char buf[4096];
+  while (true) {
+    auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+                    deadline - std::chrono::steady_clock::now())
+                    .count();
+    if (left <= 0) {
+      timed_out = true;
+      break;
+    }
+    pollfd pfd{fds[0], POLLIN, 0};
+    int rc = poll(&pfd, 1, static_cast<int>(left));
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      timed_out = true;  // treat poll failure like a hang: kill and report
+      break;
+    }
+    if (rc == 0) {
+      timed_out = true;
+      break;
+    }
+    ssize_t n = read(fds[0], buf, sizeof(buf));
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      break;  // read error: fall through to reap with what we have
+    }
+    if (n == 0) break;  // EOF: child closed stdout (it may still run)
+    output.append(buf, static_cast<size_t>(n));
+    if (output.size() > 1 << 20) {  // runaway output guard (1 MiB)
+      overflowed = true;
+      break;
+    }
+  }
+  close(fds[0]);
+
+  auto KillAndReap = [pid] {
+    kill(-pid, SIGKILL);  // the child's whole process group
+    std::string how;
+    WaitExitCode(pid, &how);
+  };
+  if (timed_out) {
+    KillAndReap();
+    return Result<std::string>::Error(
+        "command timed out after " + std::to_string(timeout_s) + "s: " +
+        command);
+  }
+  if (overflowed) {
+    KillAndReap();
+    return Result<std::string>::Error(
+        "command produced more than 1 MiB of output (killed): " + command);
+  }
+
+  // EOF reached: wait for exit, still bounded by the deadline — a child
+  // that closed stdout but keeps running must not hang the daemon.
+  std::string how;
+  int code = 0;
+  if (!WaitUntil(pid, deadline, &code, &how)) {
+    KillAndReap();
+    return Result<std::string>::Error(
+        "command timed out after " + std::to_string(timeout_s) +
+        "s (stdout closed, process still running): " + command);
+  }
+  if (code != 0) {
+    return Result<std::string>::Error(
+        "command failed (" + how + "): " + command + ": " +
+        output.substr(0, 512));
+  }
+  return output;
+}
+
+}  // namespace tfd

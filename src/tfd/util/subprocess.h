@@ -1,0 +1,24 @@
+// Run a child command with captured stdout and a hard deadline.
+//
+// Used by --device-health=full to run the measured on-chip probe command
+// (default: `python -m tpufd health`). The reference has no analogue — GFD
+// never executes anything — but the pattern matches its dlopen boundary
+// philosophy: the daemon stays a small static C++ binary and reaches the
+// JAX/PJRT world through a narrow, failure-isolated seam.
+#pragma once
+
+#include <string>
+
+#include "tfd/util/status.h"
+
+namespace tfd {
+
+// Runs `command` via /bin/sh -c, capturing stdout (stderr passes through to
+// the daemon's stderr so probe logs land in the pod log). Enforces
+// `timeout_s`: on expiry the child's process group is killed and an error
+// returned. Non-zero exit is an error carrying the exit code and the first
+// captured bytes.
+Result<std::string> RunCommandCapture(const std::string& command,
+                                      int timeout_s);
+
+}  // namespace tfd

@@ -242,6 +242,128 @@ def test_device_health_basic(tfd_binary):
     assert "tpu.health" not in out
 
 
+def health_exec_args(command, extra=None):
+    return oneshot_args(
+        ["--backend=mock", f"--mock-topology-file={FIXTURES / 'v2-8.yaml'}",
+         "--machine-type-file=/dev/null", "--device-health=full",
+         f"--health-exec={command}"] + (extra or []))
+
+
+def test_device_health_full_merges_probe_labels(tfd_binary):
+    """--device-health=full execs the health command and merges its
+    google.com/tpu.health.* lines; keys outside the health prefix must be
+    dropped (the probe must not be able to overwrite e.g. the product
+    label)."""
+    cmd = ("printf 'google.com/tpu.health.matmul-tflops=123\\n"
+           "google.com/tpu.health.hbm-gbps=456\\n"
+           "google.com/tpu.health.ok=true\\n"
+           "google.com/tpu.product=EVIL\\n'")
+    code, out, _ = run_tfd(tfd_binary, health_exec_args(cmd))
+    assert code == 0
+    labels = labels_of(out)
+    assert labels["google.com/tpu.health.matmul-tflops"] == "123"
+    assert labels["google.com/tpu.health.hbm-gbps"] == "456"
+    assert labels["google.com/tpu.health.ok"] == "true"
+    assert labels["google.com/tpu.health.devices"] == "4"  # basic included
+    assert labels["google.com/tpu.product"] != "EVIL"
+
+
+def test_device_health_full_probe_failure_downgrades_ok(tfd_binary):
+    """A failing probe must downgrade health.ok to false — a node that
+    enumerates but cannot run the probe is not known-good."""
+    code, out, _ = run_tfd(tfd_binary, health_exec_args("exit 3"))
+    assert code == 0
+    labels = labels_of(out)
+    assert labels["google.com/tpu.health.ok"] == "false"
+    assert "google.com/tpu.health.matmul-tflops" not in labels
+
+
+def test_device_health_full_timeout(tfd_binary):
+    """A hung probe is killed at the deadline and reads as unhealthy."""
+    start = time.monotonic()
+    code, out, _ = run_tfd(tfd_binary, health_exec_args(
+        "sleep 30", extra=["--health-exec-timeout=1s"]))
+    assert code == 0
+    assert time.monotonic() - start < 15
+    assert labels_of(out)["google.com/tpu.health.ok"] == "false"
+
+
+def test_device_health_full_stdout_close_hang(tfd_binary):
+    """A probe that closes stdout but keeps running must still hit the
+    deadline (EOF does not mean the child exited)."""
+    start = time.monotonic()
+    code, out, _ = run_tfd(tfd_binary, health_exec_args(
+        "exec 1>&-; sleep 30", extra=["--health-exec-timeout=1s"]))
+    assert code == 0
+    assert time.monotonic() - start < 15
+    assert labels_of(out)["google.com/tpu.health.ok"] == "false"
+
+
+def test_device_health_full_invalid_keys_dropped(tfd_binary):
+    """Invalid label keys from a buggy probe must never reach the output —
+    the apiserver would reject the whole NodeFeature update."""
+    cmd = ("printf 'google.com/tpu.health.bad key!=1\\n"
+           "google.com/tpu.health.good=2\\n'")
+    code, out, _ = run_tfd(tfd_binary, health_exec_args(cmd))
+    assert code == 0
+    labels = labels_of(out)
+    assert labels["google.com/tpu.health.good"] == "2"
+    assert not any("bad key" in k for k in labels)
+
+
+def test_device_health_full_probe_cached_across_passes(tfd_binary, tmp_path):
+    """The measured probe is expensive (it benchmarks the silicon): in
+    daemon mode it must run once per --health-exec-interval, not once per
+    sleep-interval."""
+    counter = tmp_path / "count"
+    out_file = tmp_path / "tfd"
+    proc = subprocess.Popen(
+        [str(tfd_binary), "--sleep-interval=1s",
+         f"--output-file={out_file}", "--backend=mock",
+         f"--mock-topology-file={FIXTURES / 'v2-8.yaml'}",
+         "--machine-type-file=/dev/null", "--device-health=full",
+         f"--health-exec=echo run >> {counter}; "
+         "printf 'google.com/tpu.health.ok=true\\n'"],
+        env={**os.environ, "GCE_METADATA_HOST": "invalid.localdomain:1"},
+        stderr=subprocess.DEVNULL)
+    try:
+        time.sleep(3.5)  # ~3 labeling passes
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=10)
+    assert counter.read_text().count("run") == 1, (
+        "probe must be cached across passes within health-exec-interval")
+
+
+def test_device_health_full_real_probe_feature_file(tfd_binary, tmp_path):
+    """Integration: the daemon runs the REAL `python -m tpufd health` (on
+    the virtual CPU mesh) and the measured labels land in the NFD feature
+    file — the full capability end-to-end, no TPU required."""
+    out_file = tmp_path / "tfd"
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": str(Path(__file__).resolve().parent.parent),
+    }
+    proc = subprocess.run(
+        [str(tfd_binary), "--oneshot", f"--output-file={out_file}",
+         "--backend=mock", f"--mock-topology-file={FIXTURES / 'v2-8.yaml'}",
+         "--machine-type-file=/dev/null", "--device-health=full",
+         "--health-exec=python3 -m tpufd health"],
+        env={**os.environ, **env,
+             "GCE_METADATA_HOST": "invalid.localdomain:1"},
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr
+    labels = labels_of(out_file.read_text())
+    assert labels["google.com/tpu.health.ok"] == "true"
+    # A CPU host measures well under 1 TFLOP/s, so the integer label can
+    # legitimately be 0 — presence proves the probe ran; on TPU bench.py
+    # asserts real magnitudes.
+    assert int(labels["google.com/tpu.health.matmul-tflops"]) >= 0
+    assert int(labels["google.com/tpu.health.hbm-gbps"]) > 0
+    # 8 virtual CPU devices -> the ICI all-reduce probe must have run.
+    assert int(labels["google.com/tpu.health.allreduce-gbps"]) > 0
+
+
 def test_v6e_8_single(tfd_binary):
     """Trillium (v6e) single host, slice-strategy=single."""
     code, out, _ = run_tfd(tfd_binary, oneshot_args(

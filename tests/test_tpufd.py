@@ -116,6 +116,21 @@ def test_health_probes_cpu(cpu_jax):
     assert gbps > 0
     labels = health.health_labels()
     assert labels["google.com/tpu.health.ok"] == "true"
+    # 8 visible devices -> the ICI all-reduce probe must contribute.
+    assert int(labels["google.com/tpu.health.allreduce-gbps"]) > 0
+
+
+def test_allreduce_probe_multidevice(cpu_jax):
+    """allreduce_gbps measures a real cross-device reduction over a
+    multi-device mesh (ICI on TPU; here the 8-device CPU mesh)."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from tpufd import health
+
+    mesh = Mesh(np.array(cpu_jax.devices()), ("all",))
+    gbps = health.allreduce_gbps(mesh, mib=4, iters=2)
+    assert gbps > 0
 
 
 def test_cli_burnin(cpu_jax, capsys):

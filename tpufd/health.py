@@ -169,11 +169,21 @@ def allreduce_gbps(mesh, mib=64, iters=8):
 
 
 def health_labels(prefix="google.com/tpu.health."):
-    """Runs the single-chip probes and returns a label dict, e.g.
+    """Runs the measured-silicon probes and returns a label dict, e.g.
     {"google.com/tpu.health.matmul-tflops": "123", ...}. Values are
     integers (label values must be stable-ish strings). Probe sizes are
-    TPU-scale on TPU and small elsewhere (CI hosts)."""
-    on_tpu = jax.devices()[0].platform == "tpu"
+    TPU-scale on TPU and small elsewhere (CI hosts). With more than one
+    visible device the ICI all-reduce probe runs over a one-axis mesh of
+    all of them; single-chip nodes skip it (there is no ICI to measure).
+    This is the --device-health=full payload: the daemon execs
+    `python -m tpufd health` and merges these lines into the feature file.
+    """
+    from jax.sharding import Mesh
+
+    import numpy as np
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform == "tpu"
     size = 4096 if on_tpu else 512
     mib = 512 if on_tpu else 32
     labels = {}
@@ -181,6 +191,10 @@ def health_labels(prefix="google.com/tpu.health."):
         labels[prefix + "matmul-tflops"] = str(
             int(matmul_tflops(size=size)))
         labels[prefix + "hbm-gbps"] = str(int(hbm_gbps(mib=mib)))
+        if len(devices) > 1:
+            mesh = Mesh(np.array(devices), ("all",))
+            labels[prefix + "allreduce-gbps"] = str(int(
+                allreduce_gbps(mesh, mib=64 if on_tpu else 8)))
         labels[prefix + "ok"] = "true"
     except Exception:  # noqa: BLE001 — any device failure marks unhealthy
         labels[prefix + "ok"] = "false"

@@ -12,11 +12,20 @@ therefore 1000ms / p50ms — higher is better, 1.0 = parity with that bound.
 Method: run the shipped binary end-to-end (process spawn -> backend init ->
 label generation -> atomic file write) against the hermetic mock backend
 with the v5p-128 multi-host fixture (the most label-heavy config), 40 runs,
-report the median. On a machine with a real TPU or GCE metadata the same
-binary exercises those paths instead when TFD_BENCH_BACKEND is set.
+report the median. Set TFD_BENCH_BACKEND=pjrt|metadata|auto to point the
+same end-to-end run at a real backend instead of mock (the mock fixture
+and slice strategy flags are dropped; init then costs whatever the real
+stack costs).
+
+When a TPU is visible to jax, the measured-silicon probes (tpufd.health,
+the --device-health=full payload) also run once and their results ride
+along in the same JSON line as tpu_matmul_tflops / tpu_hbm_gbps — the
+throughput numbers the reference cannot produce at all (GFD never
+exercises the GPU).
 """
 
 import json
+import os
 import statistics
 import subprocess
 import sys
@@ -29,7 +38,7 @@ BUILD = REPO / "build"
 BINARY = BUILD / "tpu-feature-discovery"
 
 BASELINE_MS = 1000.0  # reference main_test.go rewrite-within-1s bound
-RUNS = 40
+RUNS = int(os.environ.get("TFD_BENCH_RUNS", "40"))
 
 
 def ensure_built():
@@ -42,16 +51,17 @@ def ensure_built():
                    capture_output=True)
 
 
-def one_run(out_file):
-    args = [
-        str(BINARY), "--oneshot",
-        "--backend=mock",
-        f"--mock-topology-file={REPO / 'tests/fixtures/v5p-128-worker3.yaml'}",
-        "--slice-strategy=mixed",
-        "--machine-type-file=/dev/null",
-        f"--output-file={out_file}",
-    ]
-    env = {"PATH": "/usr/bin:/bin", "GCE_METADATA_HOST": "invalid.localdomain:1"}
+def one_run(out_file, backend):
+    args = [str(BINARY), "--oneshot", f"--backend={backend}",
+            "--machine-type-file=/dev/null", f"--output-file={out_file}"]
+    env = {"PATH": "/usr/bin:/bin"}
+    if backend == "mock":
+        args += [
+            "--mock-topology-file="
+            f"{REPO / 'tests/fixtures/v5p-128-worker3.yaml'}",
+            "--slice-strategy=mixed",
+        ]
+        env["GCE_METADATA_HOST"] = "invalid.localdomain:1"
     start = time.perf_counter()
     proc = subprocess.run(args, env=env, capture_output=True)
     elapsed_ms = (time.perf_counter() - start) * 1000.0
@@ -61,19 +71,52 @@ def one_run(out_file):
     return elapsed_ms
 
 
+def tpu_probe_numbers():
+    """Measured bf16 matmul TFLOP/s and HBM GB/s on the local chip, when
+    one is visible to jax; {} otherwise (or when
+    TFD_BENCH_SKIP_TPU_PROBE is set — tests). Differential timing in
+    tpufd.health already rides out relay/tunnel quirks."""
+    if os.environ.get("TFD_BENCH_SKIP_TPU_PROBE"):
+        return {}
+    try:
+        sys.path.insert(0, str(REPO))
+        import jax
+
+        if jax.devices()[0].platform != "tpu":
+            return {}
+        from tpufd import health
+
+        # Median of 3 independent probe runs: a single differential pair
+        # can still catch tunnel jitter and report above chip peak.
+        return {
+            "tpu_matmul_tflops": round(statistics.median(
+                health.matmul_tflops() for _ in range(3)), 1),
+            "tpu_hbm_gbps": round(statistics.median(
+                health.hbm_gbps() for _ in range(3)), 1),
+        }
+    except Exception as e:  # noqa: BLE001 — bench must not die on probe
+        sys.stderr.write(f"tpu probe skipped: {e}\n")
+        return {}
+
+
 def main():
     ensure_built()
+    backend = os.environ.get("TFD_BENCH_BACKEND", "mock")
     with tempfile.TemporaryDirectory() as tmp:
         out_file = str(Path(tmp) / "tfd")
-        one_run(out_file)  # warm page cache
-        samples = [one_run(out_file) for _ in range(RUNS)]
+        one_run(out_file, backend)  # warm page cache
+        samples = [one_run(out_file, backend) for _ in range(RUNS)]
     p50 = statistics.median(samples)
-    print(json.dumps({
+    record = {
         "metric": "oneshot_label_p50_ms",
         "value": round(p50, 3),
         "unit": "ms",
         "vs_baseline": round(BASELINE_MS / p50, 2),
-    }))
+    }
+    if backend != "mock":
+        record["backend"] = backend
+    record.update(tpu_probe_numbers())
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -1,8 +1,8 @@
 #include "tfd/lm/tpu_labeler.h"
 
+#include <algorithm>
 #include <cctype>
 #include <chrono>
-#include <cstring>
 
 #include "tfd/lm/schema.h"
 #include "tfd/lm/slice_strategy.h"
@@ -81,12 +81,15 @@ LabelerPtr NewTopologyLabeler(resource::Manager& manager) {
   return std::make_unique<StaticLabeler>(std::move(labels));
 }
 
-// A label key's name part (after the prefix) must be a valid Kubernetes
-// label name: alphanumeric ends, [-._a-zA-Z0-9] middle, <= 63 chars. A bad
-// key from a buggy probe must never reach the apiserver — an invalid label
-// name fails the whole NodeFeature update.
+// A label key's name part (after the "google.com/" domain) must be a valid
+// Kubernetes label name: alphanumeric ends, [-._a-zA-Z0-9] middle, <= 63
+// chars TOTAL — and the name already starts with the fixed "tpu.health."
+// (11 chars), so the probe's suffix gets at most 52. A bad key from a
+// buggy probe must never reach the apiserver — an invalid label name
+// fails the whole NodeFeature update.
 bool ValidLabelKeySuffix(const std::string& s) {
-  if (s.empty() || s.size() > 63) return false;
+  constexpr size_t kMax = 63 - (sizeof("tpu.health.") - 1);
+  if (s.empty() || s.size() > kMax) return false;
   auto alnum = [](char c) { return isalnum(static_cast<unsigned char>(c)); };
   if (!alnum(s.front()) || !alnum(s.back())) return false;
   for (char c : s) {
@@ -120,16 +123,18 @@ Labels RunHealthExec(const config::Config& config) {
     }
     std::string key = trimmed.substr(0, eq);
     std::string value = trimmed.substr(eq + 1);
-    if (key.rfind(kHealthPrefix, 0) != 0) {
+    if (!HasPrefix(key, kHealthPrefix)) {
       TFD_LOG_WARNING << "health exec: ignoring label outside "
                       << kHealthPrefix << ": " << key;
       continue;
     }
-    if (!ValidLabelKeySuffix(key.substr(strlen(kHealthPrefix)))) {
+    if (!ValidLabelKeySuffix(key.substr(sizeof(kHealthPrefix) - 1))) {
       TFD_LOG_WARNING << "health exec: ignoring invalid label key: " << key;
       continue;
     }
-    out[key] = SanitizeLabelValue(value);
+    // Label values are capped at 63 chars by the apiserver; truncating
+    // beats failing the whole update.
+    out[key] = SanitizeLabelValue(value).substr(0, 63);
   }
   if (out.empty()) {
     TFD_LOG_WARNING << "health exec produced no health labels";
@@ -150,11 +155,21 @@ void MergeHealthExecLabels(const config::Config& config, Labels* health) {
   static std::chrono::steady_clock::time_point cached_at;
   static bool have_cache = false;
 
+  // A failed probe retries much sooner than a good one re-measures:
+  // transient causes (a training job briefly holding the exclusive chips,
+  // a probe OOM) should not mark a healthy node unhealthy for a whole
+  // --health-exec-interval.
+  int interval_s = config.flags.health_exec_interval_s;
+  if (have_cache) {
+    auto it = cached.find(kHealthOk);
+    if (it != cached.end() && it->second == "false") {
+      interval_s = std::min(interval_s, 300);
+    }
+  }
+
   auto now = std::chrono::steady_clock::now();
-  bool stale =
-      !have_cache || cached_exec != config.flags.health_exec ||
-      now - cached_at >=
-          std::chrono::seconds(config.flags.health_exec_interval_s);
+  bool stale = !have_cache || cached_exec != config.flags.health_exec ||
+               now - cached_at >= std::chrono::seconds(interval_s);
   if (stale) {
     cached = RunHealthExec(config);
     cached_exec = config.flags.health_exec;

@@ -13,13 +13,8 @@ namespace tfd {
 
 namespace {
 
-// Reaps `pid` and formats its exit disposition. Blocking waitpid is safe
-// here: callers only reach this after SIGKILLing the process group or
-// after WaitUntil saw the child exit.
-int WaitExitCode(pid_t pid, std::string* how) {
-  int wstatus = 0;
-  while (waitpid(pid, &wstatus, 0) < 0 && errno == EINTR) {
-  }
+// Formats a waitpid status as (exit code, human-readable disposition).
+int FormatWaitStatus(int wstatus, std::string* how) {
   if (WIFEXITED(wstatus)) {
     *how = "exit code " + std::to_string(WEXITSTATUS(wstatus));
     return WEXITSTATUS(wstatus);
@@ -30,6 +25,16 @@ int WaitExitCode(pid_t pid, std::string* how) {
   }
   *how = "unknown wait status";
   return -1;
+}
+
+// Reaps `pid` (blocking) and formats its exit disposition. Safe only
+// after SIGKILLing the process group or after WaitUntil saw the child
+// exit.
+int WaitExitCode(pid_t pid, std::string* how) {
+  int wstatus = 0;
+  while (waitpid(pid, &wstatus, 0) < 0 && errno == EINTR) {
+  }
+  return FormatWaitStatus(wstatus, how);
 }
 
 // Polls (WNOHANG) until the child exits or `deadline` passes. On exit,
@@ -43,16 +48,7 @@ bool WaitUntil(pid_t pid, std::chrono::steady_clock::time_point deadline,
     int wstatus = 0;
     pid_t rc = waitpid(pid, &wstatus, WNOHANG);
     if (rc == pid) {
-      if (WIFEXITED(wstatus)) {
-        *how = "exit code " + std::to_string(WEXITSTATUS(wstatus));
-        *code = WEXITSTATUS(wstatus);
-      } else if (WIFSIGNALED(wstatus)) {
-        *how = std::string("signal ") + strsignal(WTERMSIG(wstatus));
-        *code = 128 + WTERMSIG(wstatus);
-      } else {
-        *how = "unknown wait status";
-        *code = -1;
-      }
+      *code = FormatWaitStatus(wstatus, how);
       return true;
     }
     if (rc < 0 && errno != EINTR) {
@@ -64,6 +60,57 @@ bool WaitUntil(pid_t pid, std::chrono::steady_clock::time_point deadline,
     usleep(20 * 1000);
   }
 }
+
+// The daemon blocks SIGTERM/SIGINT/SIGQUIT for sigtimedwait (main.cc), so
+// a termination request arriving during a long probe would stay pending
+// until the probe finishes — up to health-exec-timeout, past Kubernetes'
+// default 30s grace period, after which the kubelet SIGKILLs the daemon
+// and ORPHANS the probe (its own process group) holding the exclusive
+// TPU. While a probe runs we therefore unblock those signals with a
+// handler that kills the probe group and re-delivers the signal with
+// default (terminating) disposition. The daemon is single-threaded, so a
+// file-scope pgid is safe; every call in the handler is
+// async-signal-safe.
+volatile sig_atomic_t g_probe_pgid = 0;
+
+extern "C" void ProbeFatalSignalForwarder(int sig) {
+  pid_t pgid = g_probe_pgid;
+  if (pgid > 0) {
+    if (kill(-pgid, SIGKILL) != 0) kill(pgid, SIGKILL);
+  }
+  signal(sig, SIG_DFL);
+  raise(sig);  // pending; delivered (unblocked) when the handler returns
+}
+
+class ScopedProbeSignals {
+ public:
+  explicit ScopedProbeSignals(pid_t pid) {
+    g_probe_pgid = pid;
+    struct sigaction sa{};
+    sa.sa_handler = ProbeFatalSignalForwarder;
+    sigemptyset(&sa.sa_mask);
+    for (size_t i = 0; i < kNumSignals; i++) {
+      sigaction(kSignals[i], &sa, &saved_actions_[i]);
+    }
+    sigset_t unblock;
+    sigemptyset(&unblock);
+    for (size_t i = 0; i < kNumSignals; i++) sigaddset(&unblock, kSignals[i]);
+    sigprocmask(SIG_UNBLOCK, &unblock, &saved_mask_);
+  }
+  ~ScopedProbeSignals() {
+    sigprocmask(SIG_SETMASK, &saved_mask_, nullptr);
+    for (size_t i = 0; i < kNumSignals; i++) {
+      sigaction(kSignals[i], &saved_actions_[i], nullptr);
+    }
+    g_probe_pgid = 0;
+  }
+
+ private:
+  static constexpr int kSignals[] = {SIGTERM, SIGINT, SIGQUIT};
+  static constexpr size_t kNumSignals = 3;
+  struct sigaction saved_actions_[kNumSignals];
+  sigset_t saved_mask_;
+};
 
 }  // namespace
 
@@ -86,6 +133,9 @@ Result<std::string> RunCommandCapture(const std::string& command,
     // Child. Own process group so a timeout kill reaps the whole probe
     // pipeline (sh + python), not just the shell.
     setpgid(0, 0);
+    // (The parent also calls setpgid(pid, pid): whichever runs first
+    // wins, closing the race where a timeout fires before the child was
+    // ever scheduled and kill(-pid) would hit a nonexistent group.)
     // The daemon blocks its handled signals for sigtimedwait; the probe
     // must not inherit that mask or it becomes unkillable by SIGTERM.
     sigset_t none;
@@ -99,6 +149,9 @@ Result<std::string> RunCommandCapture(const std::string& command,
   }
 
   close(fds[1]);
+  setpgid(pid, pid);  // see child comment; EACCES after exec is fine —
+                      // the child already did it itself
+  ScopedProbeSignals signal_guard(pid);
   std::string output;
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::seconds(timeout_s);
@@ -139,7 +192,9 @@ Result<std::string> RunCommandCapture(const std::string& command,
   close(fds[0]);
 
   auto KillAndReap = [pid] {
-    kill(-pid, SIGKILL);  // the child's whole process group
+    // Group kill first (sh + python); direct kill as a belt-and-braces
+    // fallback should the group somehow not exist.
+    if (kill(-pid, SIGKILL) != 0) kill(pid, SIGKILL);
     std::string how;
     WaitExitCode(pid, &how);
   };

@@ -18,6 +18,15 @@ namespace tfd {
 // `timeout_s`: on expiry the child's process group is killed and an error
 // returned. Non-zero exit is an error carrying the exit code and the first
 // captured bytes.
+//
+// Signal behavior: while the child runs, SIGTERM/SIGINT/SIGQUIT are
+// UNBLOCKED (the daemon otherwise blocks them for sigtimedwait) with a
+// handler that kills the child's process group and then terminates the
+// process with the signal's default disposition. A pod deletion during a
+// long probe therefore takes the daemon down promptly (within the k8s
+// grace period) without orphaning a probe that holds the exclusive TPU —
+// at the cost of skipping the daemon's output-file cleanup, the same
+// outcome a kubelet SIGKILL would have produced after the grace period.
 Result<std::string> RunCommandCapture(const std::string& command,
                                       int timeout_s);
 

@@ -311,6 +311,31 @@ def test_device_health_full_invalid_keys_dropped(tfd_binary):
     assert not any("bad key" in k for k in labels)
 
 
+def test_device_health_full_sigterm_during_probe(tfd_binary, tmp_path):
+    """SIGTERM arriving while a long probe runs must take the daemon down
+    promptly (within the k8s grace period), killing the probe's process
+    group — not wait out the probe deadline with the signal blocked."""
+    out_file = tmp_path / "tfd"
+    proc = subprocess.Popen(
+        [str(tfd_binary), "--sleep-interval=60s",
+         f"--output-file={out_file}", "--backend=mock",
+         f"--mock-topology-file={FIXTURES / 'v2-8.yaml'}",
+         "--machine-type-file=/dev/null", "--device-health=full",
+         "--health-exec=sleep 120", "--health-exec-timeout=100s"],
+        env={**os.environ, "GCE_METADATA_HOST": "invalid.localdomain:1"},
+        stderr=subprocess.DEVNULL)
+    try:
+        time.sleep(1.0)  # let it reach the probe
+        proc.send_signal(signal.SIGTERM)
+        start = time.monotonic()
+        proc.wait(timeout=15)
+        assert time.monotonic() - start < 10
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
 def test_device_health_full_probe_cached_across_passes(tfd_binary, tmp_path):
     """The measured probe is expensive (it benchmarks the silicon): in
     daemon mode it must run once per --health-exec-interval, not once per

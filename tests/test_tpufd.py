@@ -133,6 +133,30 @@ def test_allreduce_probe_multidevice(cpu_jax):
     assert gbps > 0
 
 
+def test_bench_json_contract():
+    """bench.py must print exactly one JSON line with the driver's schema;
+    TFD_BENCH_RUNS trims it for test speed and JAX_PLATFORMS=cpu skips the
+    TPU-only probe fields."""
+    import json
+    import os
+    import subprocess
+
+    env = {**os.environ, "TFD_BENCH_RUNS": "3",
+           "TFD_BENCH_SKIP_TPU_PROBE": "1"}
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")], env=env, cwd=str(REPO),
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, proc.stdout
+    record = json.loads(lines[0])
+    assert record["metric"] == "oneshot_label_p50_ms"
+    assert record["unit"] == "ms"
+    assert record["value"] > 0
+    assert record["vs_baseline"] > 0
+    assert "tpu_matmul_tflops" not in record  # probe explicitly skipped
+
+
 def test_cli_burnin(cpu_jax, capsys):
     """python -m tpufd burnin runs the sharded step over all devices."""
     from tpufd.__main__ import main

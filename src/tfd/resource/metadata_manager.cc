@@ -102,6 +102,40 @@ class MetadataManager : public Manager {
     }
     topology_.chips_per_host = local_chips;
 
+    // Worker-id fallbacks when tpu-env lacks WORKER_ID (seen on nodes
+    // where the TPU runtime agent rewrote tpu-env, and on GKE): the
+    // agent-worker-number attribute, then the "-w-<N>" hostname suffix
+    // GCE gives every multi-host TPU-VM worker. Without this the
+    // byte-for-byte v5p-128 golden (slice.worker-id) could not match on
+    // the metadata-only path — the exact fallback used when a training
+    // job holds the chips and PJRT init fails.
+    if (topology_.worker_id < 0) {
+      Result<std::string> agent_number =
+          client_.Get("instance/attributes/agent-worker-number");
+      if (agent_number.ok()) {
+        try {
+          topology_.worker_id = std::stoi(TrimSpace(*agent_number));
+        } catch (...) {
+        }
+      }
+    }
+    if (topology_.worker_id < 0) {
+      Result<std::string> hostname = client_.Get("instance/hostname");
+      if (hostname.ok()) {
+        // First DNS label of e.g. "t1v-n-abc123-w-3.us-central2-b...".
+        std::string label = TrimSpace(*hostname);
+        size_t dot = label.find('.');
+        if (dot != std::string::npos) label = label.substr(0, dot);
+        size_t w = label.rfind("-w-");
+        if (w != std::string::npos) {
+          try {
+            topology_.worker_id = std::stoi(label.substr(w + 3));
+          } catch (...) {
+          }
+        }
+      }
+    }
+
     if (topology_.topology.empty()) {
       Result<slice::Shape> shape =
           slice::DefaultTopology(accel_.spec, accel_.num_chips);

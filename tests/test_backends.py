@@ -127,6 +127,65 @@ class TestMetadataBackend:
             # Versions are unknown to the metadata backend.
             assert "google.com/libtpu.version.major" not in labels
 
+    def test_v5p_128_worker_id_fallback_agent_number(self, tfd_binary):
+        """North-star case: tpu-env lacks WORKER_ID (some TPU runtime
+        agents rewrite it) on the metadata-only path — worker id must come
+        from instance/attributes/agent-worker-number, and the full
+        v5p-128 mixed label set must still golden-match."""
+        with FakeMetadataServer(tpu_vm(
+                accelerator_type="v5p-128", topology="4x4x4",
+                chips_per_host_bounds="2,2,1", host_bounds="2,2,4",
+                worker_id=3, machine_type="ct5p-hightpu-4t",
+                include_worker_id=False)) as server:
+            code, out, err = run_tfd(tfd_binary, [
+                "--oneshot", "--output-file=", "--backend=metadata",
+                f"--metadata-endpoint={server.endpoint}",
+                "--slice-strategy=mixed",
+                "--machine-type-file=/dev/null",
+            ], env={"GCE_METADATA_HOST": server.endpoint})
+            assert code == 0, err
+            assert labels_of(out)["google.com/tpu.slice.worker-id"] == "3"
+            check_golden(
+                out, GOLDEN / "expected-output-tpu-v5p-128-mixed-metadata.txt")
+
+    def test_worker_id_fallback_hostname(self, tfd_binary):
+        """No WORKER_ID and no agent-worker-number: the '-w-<N>' suffix of
+        the GCE TPU-VM hostname is the last resort."""
+        data = tpu_vm(
+            accelerator_type="v5p-128", topology="4x4x4",
+            chips_per_host_bounds="2,2,1", host_bounds="2,2,4",
+            worker_id=0, machine_type="ct5p-hightpu-4t",
+            include_worker_id=False,
+            hostname="t1v-n-abc123-w-7.us-central2-b.c.proj.internal")
+        del data["instance/attributes/agent-worker-number"]
+        with FakeMetadataServer(data) as server:
+            code, out, err = run_tfd(tfd_binary, [
+                "--oneshot", "--output-file=", "--backend=metadata",
+                f"--metadata-endpoint={server.endpoint}",
+                "--slice-strategy=single",
+                "--machine-type-file=/dev/null",
+            ], env={"GCE_METADATA_HOST": server.endpoint})
+            assert code == 0, err
+            assert labels_of(out)["google.com/tpu.slice.worker-id"] == "7"
+
+    def test_worker_id_unknown_label_omitted(self, tfd_binary):
+        """With no worker-id source at all, the label must be omitted (not
+        -1) — absence is the honest value."""
+        data = tpu_vm(
+            accelerator_type="v5p-128", topology="4x4x4",
+            chips_per_host_bounds="2,2,1", host_bounds="2,2,4",
+            include_worker_id=False)
+        del data["instance/attributes/agent-worker-number"]
+        with FakeMetadataServer(data) as server:
+            code, out, err = run_tfd(tfd_binary, [
+                "--oneshot", "--output-file=", "--backend=metadata",
+                f"--metadata-endpoint={server.endpoint}",
+                "--slice-strategy=single",
+                "--machine-type-file=/dev/null",
+            ], env={"GCE_METADATA_HOST": server.endpoint})
+            assert code == 0, err
+            assert "google.com/tpu.slice.worker-id" not in labels_of(out)
+
     def test_v2_8_defaults_without_tpu_env(self, tfd_binary):
         """accelerator-type alone (no tpu-env bag): counts and default
         topology must still come out right."""

@@ -22,7 +22,7 @@ def tpu_vm(accelerator_type="v5litepod-4", topology=None, worker_id=0,
            machine_type="ct5lp-hightpu-4t", preemptible=False,
            spot=False, zone="us-central2-b", megascale_slice_id=None,
            megascale_num_slices=None, instance_id="1234567890",
-           extra_attributes=None):
+           extra_attributes=None, include_worker_id=True, hostname=None):
     """Builds the metadata key->value dict for a TPU VM.
 
     Keys mirror real TPU-VM metadata: instance/machine-type,
@@ -38,7 +38,10 @@ def tpu_vm(accelerator_type="v5litepod-4", topology=None, worker_id=0,
             f"CHIPS_PER_HOST_BOUNDS: '{chips_per_host_bounds}'")
     if host_bounds:
         tpu_env_lines.append(f"HOST_BOUNDS: '{host_bounds}'")
-    tpu_env_lines.append(f"WORKER_ID: '{worker_id}'")
+    if include_worker_id:
+        # Some TPU runtime agents rewrite tpu-env without WORKER_ID; the
+        # daemon then falls back to agent-worker-number / the hostname.
+        tpu_env_lines.append(f"WORKER_ID: '{worker_id}'")
     if megascale_slice_id is not None:
         tpu_env_lines.append(f"MEGASCALE_SLICE_ID: '{megascale_slice_id}'")
     if megascale_num_slices is not None:
@@ -57,6 +60,8 @@ def tpu_vm(accelerator_type="v5litepod-4", topology=None, worker_id=0,
         "instance/attributes/tpu-env": "\n".join(tpu_env_lines) + "\n",
         "instance/attributes/agent-worker-number": str(worker_id),
     }
+    if hostname:
+        data["instance/hostname"] = hostname
     if extra_attributes:
         for key, value in extra_attributes.items():
             data[f"instance/attributes/{key}"] = value

@@ -54,14 +54,19 @@ def ensure_built():
 def one_run(out_file, backend):
     args = [str(BINARY), "--oneshot", f"--backend={backend}",
             "--machine-type-file=/dev/null", f"--output-file={out_file}"]
-    env = {"PATH": "/usr/bin:/bin"}
     if backend == "mock":
+        # Hermetic: a stripped env (plus metadata-host poisoning) so the
+        # mock run never touches a real GCE metadata server.
+        env = {"PATH": "/usr/bin:/bin",
+               "GCE_METADATA_HOST": "invalid.localdomain:1"}
         args += [
             "--mock-topology-file="
             f"{REPO / 'tests/fixtures/v5p-128-worker3.yaml'}",
             "--slice-strategy=mixed",
         ]
-        env["GCE_METADATA_HOST"] = "invalid.localdomain:1"
+    else:
+        # Real backends need the ambient env (libtpu/GCE vars, proxies).
+        env = dict(os.environ)
     start = time.perf_counter()
     proc = subprocess.run(args, env=env, capture_output=True)
     elapsed_ms = (time.perf_counter() - start) * 1000.0

@@ -38,7 +38,7 @@ class MachineTypeLabeler : public Labeler {
                       << file_ << "' unreadable); defaulting to 'unknown'";
     }
     Labels labels;
-    labels[kMachineLabel] = SanitizeLabelValue(machine_type);
+    labels[kMachineLabel] = StrictLabelValue(machine_type);
     return labels;
   }
 

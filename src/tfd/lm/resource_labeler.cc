@@ -71,7 +71,7 @@ Labels BuildLabels(const std::string& resource_name,
 
   Labels labels;
   const std::string p = resource_name + ".";
-  labels[p + "product"] = SanitizeLabelValue(product);
+  labels[p + "product"] = StrictLabelValue(product);
   labels[p + "count"] = std::to_string(s.count);
   labels[p + "replicas"] = std::to_string(replicas);
   labels[p + "memory"] = std::to_string(s.memory_mib);

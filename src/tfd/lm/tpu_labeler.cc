@@ -70,10 +70,10 @@ LabelerPtr NewTopologyLabeler(resource::Manager& manager) {
   if (!topo.ok()) return Empty();
   Labels labels;
   if (!topo->accelerator_type.empty()) {
-    labels[kAcceleratorType] = SanitizeLabelValue(topo->accelerator_type);
+    labels[kAcceleratorType] = StrictLabelValue(topo->accelerator_type);
   }
   if (!topo->topology.empty()) {
-    labels[kTopologyLabel] = SanitizeLabelValue(topo->topology);
+    labels[kTopologyLabel] = StrictLabelValue(topo->topology);
   }
   if (!topo->accelerator_type.empty() || !topo->topology.empty()) {
     labels[kIciWrap] = topo->has_wraparound ? "true" : "false";
@@ -132,9 +132,17 @@ Labels RunHealthExec(const config::Config& config) {
       TFD_LOG_WARNING << "health exec: ignoring invalid label key: " << key;
       continue;
     }
-    // Label values are capped at 63 chars by the apiserver; truncating
-    // beats failing the whole update.
-    out[key] = SanitizeLabelValue(value).substr(0, 63);
+    // Label values are capped at 63 chars by the apiserver, and must have
+    // alphanumeric ends — StrictLabelValue enforces both, because an
+    // invalid VALUE from a buggy probe would fail the whole NodeFeature
+    // update just like an invalid key. Truncating/trimming beats failing.
+    std::string strict = StrictLabelValue(value);
+    if (strict.empty() && !value.empty()) {
+      TFD_LOG_WARNING << "health exec: dropping label with no valid value: "
+                      << key << "=" << value;
+      continue;
+    }
+    out[key] = strict;
   }
   if (out.empty()) {
     TFD_LOG_WARNING << "health exec produced no health labels";

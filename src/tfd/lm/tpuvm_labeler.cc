@@ -38,7 +38,7 @@ class TpuVmLabeler : public Labeler {
     Result<std::string> zone = client_.Get("instance/zone");
     if (zone.ok()) {
       std::vector<std::string> parts = SplitString(TrimSpace(*zone), '/');
-      labels[kTpuVmZone] = SanitizeLabelValue(parts.back());
+      labels[kTpuVmZone] = StrictLabelValue(parts.back());
     }
 
     // Multi-slice coordinates: prefer the tpu-env bag, fall back to the
@@ -65,10 +65,10 @@ class TpuVmLabeler : public Labeler {
     bool multislice = !slice_id.empty() || !num_slices.empty();
     labels[kMultislicePresent] = multislice ? "true" : "false";
     if (!slice_id.empty()) {
-      labels[kMultisliceSliceId] = SanitizeLabelValue(slice_id);
+      labels[kMultisliceSliceId] = StrictLabelValue(slice_id);
     }
     if (!num_slices.empty()) {
-      labels[kMultisliceNumSlices] = SanitizeLabelValue(num_slices);
+      labels[kMultisliceNumSlices] = StrictLabelValue(num_slices);
     }
     return labels;
   }

@@ -47,11 +47,10 @@ class EnrichedManager : public Manager {
         Result<std::map<std::string, std::string>> env = client_.TpuEnv();
         if (env.ok()) {
           auto it = env->find("WORKER_ID");
-          if (it != env->end()) {
-            try {
-              worker_id_ = std::stoi(it->second);
-            } catch (...) {
-            }
+          int worker_id = 0;
+          if (it != env->end() &&
+              ParseNonNegInt(TrimSpace(it->second), &worker_id)) {
+            worker_id_ = worker_id;
           }
         }
       }

@@ -36,20 +36,19 @@ class MetadataDevice : public Device {
 };
 
 // Product of a comma-separated bounds string like "2,2,1" (tpu-env
-// CHIPS_PER_HOST_BOUNDS / HOST_BOUNDS). 0 on parse failure.
+// CHIPS_PER_HOST_BOUNDS / HOST_BOUNDS). 0 on parse failure; every part
+// must be all digits (ParseNonNegInt) so "2x,2" cannot half-parse.
 int BoundsProduct(const std::string& bounds) {
-  int product = 1;
+  long long product = 1;
   for (const std::string& part : SplitString(TrimSpace(bounds), ',')) {
-    if (part.empty()) return 0;
-    try {
-      int v = std::stoi(part);
-      if (v < 1) return 0;
-      product *= v;
-    } catch (...) {
-      return 0;
-    }
+    int v = 0;
+    if (!ParseNonNegInt(TrimSpace(part), &v) || v < 1) return 0;
+    product *= v;
+    // A bounds product is a host/chip count; anything past int range is
+    // garbage metadata (and would overflow the int return).
+    if (product > 2147483647LL) return 0;
   }
-  return product;
+  return static_cast<int>(product);
 }
 
 class MetadataManager : public Manager {
@@ -88,12 +87,10 @@ class MetadataManager : public Manager {
       if (!topology.empty()) {
         topology_.topology = ToLower(topology);
       }
-      std::string worker = get("WORKER_ID");
-      if (!worker.empty()) {
-        try {
-          topology_.worker_id = std::stoi(worker);
-        } catch (...) {
-        }
+      std::string worker = TrimSpace(get("WORKER_ID"));
+      int worker_id = 0;
+      if (ParseNonNegInt(worker, &worker_id)) {
+        topology_.worker_id = worker_id;
       }
     } else if (accel_.num_chips > accel_.spec.max_chips_per_host) {
       // Multi-host slice without tpu-env: derive the host count.
@@ -112,11 +109,10 @@ class MetadataManager : public Manager {
     if (topology_.worker_id < 0) {
       Result<std::string> agent_number =
           client_.Get("instance/attributes/agent-worker-number");
-      if (agent_number.ok()) {
-        try {
-          topology_.worker_id = std::stoi(TrimSpace(*agent_number));
-        } catch (...) {
-        }
+      int worker_id = 0;
+      if (agent_number.ok() &&
+          ParseNonNegInt(TrimSpace(*agent_number), &worker_id)) {
+        topology_.worker_id = worker_id;
       }
     }
     if (topology_.worker_id < 0) {
@@ -126,12 +122,13 @@ class MetadataManager : public Manager {
         std::string label = TrimSpace(*hostname);
         size_t dot = label.find('.');
         if (dot != std::string::npos) label = label.substr(0, dot);
+        // Strict all-digit suffix: a nonstandard hostname like
+        // "...-w-3x" must not silently yield worker id 3.
         size_t w = label.rfind("-w-");
-        if (w != std::string::npos) {
-          try {
-            topology_.worker_id = std::stoi(label.substr(w + 3));
-          } catch (...) {
-          }
+        int worker_id = 0;
+        if (w != std::string::npos &&
+            ParseNonNegInt(label.substr(w + 3), &worker_id)) {
+          topology_.worker_id = worker_id;
         }
       }
     }

@@ -74,6 +74,28 @@ void TestStrings() {
            "Google-Compute-Engine");
   CHECK_EQ(SanitizeLabelValue("ct5lp-hightpu-4t"), "ct5lp-hightpu-4t");
   CHECK_EQ(ReplaceAll("a.b.c", ".", "-"), "a-b-c");
+
+  // StrictLabelValue: apiserver-valid output even from hostile input —
+  // alphanumeric ends after sanitize+truncate (advisor r2, medium).
+  CHECK_EQ(StrictLabelValue("ok-value"), "ok-value");
+  CHECK_EQ(StrictLabelValue("-leading.and.trailing_"),
+           "leading.and.trailing");
+  CHECK_EQ(StrictLabelValue("---"), "");
+  CHECK_EQ(StrictLabelValue(""), "");
+  // 63-char cap applied before end-trim: 62 'a's then '-' then more text
+  // truncates at 63 ('a'*62 + '-') and trims to the 62 'a's.
+  CHECK_EQ(StrictLabelValue(std::string(62, 'a') + "-tail"),
+           std::string(62, 'a'));
+
+  int v = -1;
+  CHECK_TRUE(ParseNonNegInt("3", &v) && v == 3);
+  CHECK_TRUE(ParseNonNegInt("0", &v) && v == 0);
+  CHECK_TRUE(ParseNonNegInt("2147483647", &v) && v == 2147483647);
+  CHECK_TRUE(!ParseNonNegInt("3abc", &v));   // stoi would return 3
+  CHECK_TRUE(!ParseNonNegInt("-3", &v));
+  CHECK_TRUE(!ParseNonNegInt("", &v));
+  CHECK_TRUE(!ParseNonNegInt(" 3", &v));
+  CHECK_TRUE(!ParseNonNegInt("2147483648", &v));
 }
 
 void TestYamlLite() {

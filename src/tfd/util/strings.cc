@@ -82,4 +82,28 @@ std::string SanitizeLabelValue(const std::string& s) {
   return out;
 }
 
+std::string StrictLabelValue(const std::string& s) {
+  std::string out = SanitizeLabelValue(s).substr(0, 63);
+  size_t b = 0;
+  size_t e = out.size();
+  auto alnum = [&out](size_t i) {
+    return std::isalnum(static_cast<unsigned char>(out[i])) != 0;
+  };
+  while (b < e && !alnum(b)) b++;
+  while (e > b && !alnum(e - 1)) e--;
+  return out.substr(b, e - b);
+}
+
+bool ParseNonNegInt(const std::string& s, int* out) {
+  if (s.empty() || s.size() > 10) return false;
+  long long v = 0;
+  for (char c : s) {
+    if (!std::isdigit(static_cast<unsigned char>(c))) return false;
+    v = v * 10 + (c - '0');
+  }
+  if (v > 2147483647LL) return false;
+  *out = static_cast<int>(v);
+  return true;
+}
+
 }  // namespace tfd

@@ -19,5 +19,15 @@ std::string ReplaceAll(std::string s, const std::string& from,
 // Sanitizes a value for use in a k8s label value: [A-Za-z0-9._-] only,
 // spaces become dashes (reference: machine-type.go:38 replaces " "→"-").
 std::string SanitizeLabelValue(const std::string& s);
+// A guaranteed-valid k8s label value from arbitrary text: sanitize, cap at
+// the 63-char apiserver limit, then trim non-alphanumeric characters from
+// both ends — the value regex [A-Za-z0-9]([A-Za-z0-9_.-]*[A-Za-z0-9])?
+// rejects '-'/'_'/'.' ends that sanitize+truncate alone can produce. May
+// return "" (also valid); callers decide whether to keep an empty value.
+std::string StrictLabelValue(const std::string& s);
+// Strict non-negative integer parse: every character must be a digit
+// (std::stoi's partial parsing accepts trailing garbage like "3abc").
+// False on empty, non-digit, or out-of-int-range input.
+bool ParseNonNegInt(const std::string& s, int* out);
 
 }  // namespace tfd

@@ -23,8 +23,14 @@ def match_lines(regexes, lines):
     cannot occur): every line must match SOME regex, and every regex must
     match SOME line. Greedy 1:1 consumption would be order-dependent: a
     line matching an earlier broad pattern could consume a regex a later
-    line needed, producing spurious mismatches. Returns (unmatched_lines,
-    unmatched_regexes); both empty means a full bidirectional match."""
+    line needed, producing spurious mismatches. Coverage alone, though,
+    loses the old 1:1 matcher's implicit count check: with overlapping
+    patterns (e.g. a broad tpu.machine=.*), one missing expected line and
+    one unexpected extra line can each be absorbed by another pattern — so
+    a count mismatch is additionally reported. Golden files carry exactly
+    one regex per expected label line, making the counts comparable.
+    Returns (unmatched_lines, unmatched_regexes); both empty means a full
+    bidirectional match."""
     unmatched_lines = [
         line for line in lines
         if not any(regex.fullmatch(line) for regex in regexes)
@@ -33,4 +39,13 @@ def match_lines(regexes, lines):
         regex for regex in regexes
         if not any(regex.fullmatch(line) for line in lines)
     ]
+    if not unmatched_lines and not unmatched_regexes \
+            and len(lines) != len(regexes):
+        # Reported via unmatched_lines (plain strings, printed verbatim by
+        # every caller); unmatched_regexes entries must be compiled
+        # patterns, which would garble the message.
+        unmatched_lines.append(
+            f"count mismatch: {len(lines)} output lines vs "
+            f"{len(regexes)} golden regexes (an overlapping pattern "
+            "absorbed a missing or extra line)")
     return unmatched_lines, unmatched_regexes

@@ -311,6 +311,26 @@ def test_device_health_full_invalid_keys_dropped(tfd_binary):
     assert not any("bad key" in k for k in labels)
 
 
+def test_device_health_full_invalid_values_repaired(tfd_binary):
+    """Invalid label VALUES from a buggy probe are repaired (trimmed to
+    alphanumeric ends) or dropped — the apiserver's value regex
+    [A-Za-z0-9]([A-Za-z0-9_.-]*[A-Za-z0-9])? rejects '-'/'.'/'_' ends, and
+    one bad value would fail the whole NodeFeature update."""
+    cmd = ("printf 'google.com/tpu.health.trailing=1.5-\\n"
+           "google.com/tpu.health.leading=-x\\n"
+           "google.com/tpu.health.hopeless=---\\n"
+           "google.com/tpu.health.long=%s-end\\n"
+           "google.com/tpu.health.ok=true\\n' " + "a" * 62)
+    code, out, _ = run_tfd(tfd_binary, health_exec_args(cmd))
+    assert code == 0
+    labels = labels_of(out)
+    assert labels["google.com/tpu.health.trailing"] == "1.5"
+    assert labels["google.com/tpu.health.leading"] == "x"
+    assert "google.com/tpu.health.hopeless" not in labels  # nothing valid
+    assert labels["google.com/tpu.health.long"] == "a" * 62  # cap then trim
+    assert labels["google.com/tpu.health.ok"] == "true"
+
+
 def test_device_health_full_sigterm_during_probe(tfd_binary, tmp_path):
     """SIGTERM arriving while a long probe runs must take the daemon down
     promptly (within the k8s grace period), killing the probe's process

@@ -1,5 +1,8 @@
 #include "tfd/lm/resource_labeler.h"
 
+#include <map>
+
+#include "tfd/util/logging.h"
 #include "tfd/util/strings.h"
 
 namespace tfd {
@@ -21,7 +24,14 @@ struct DeviceSummary {
 
 Result<DeviceSummary> Summarize(
     const std::vector<resource::DevicePtr>& devices) {
-  DeviceSummary s;
+  // Heterogeneous products on one host should be impossible on real TPU
+  // hardware, but a buggy backend (or exotic future host) must degrade,
+  // not crash-loop the daemon: the reference WARNS on >1 model and labels
+  // anyway (mig-strategy.go:125-152, where per-model labelers merge and
+  // the shared label keys end up describing one model). Here the dominant
+  // product group wins deterministically (largest count, then
+  // lexicographically smallest product) and the anomaly is logged.
+  std::map<std::string, DeviceSummary> by_product;
   for (const resource::DevicePtr& d : devices) {
     Result<std::string> product = d->GetProduct();
     if (!product.ok()) return Result<DeviceSummary>::Error(product.error());
@@ -33,18 +43,32 @@ Result<DeviceSummary> Summarize(
     if (!generation.ok()) {
       return Result<DeviceSummary>::Error(generation.error());
     }
+    DeviceSummary& s = by_product[*product];
     if (s.count == 0) {
       s.product = *product;
       s.memory_mib = *memory;
       s.cores = *cores;
       s.generation = *generation;
-    } else if (s.product != *product) {
-      return Result<DeviceSummary>::Error(
-          "heterogeneous TPU products on one host: '" + s.product +
-          "' and '" + *product + "'");
     }
     s.count++;
   }
+  const DeviceSummary* best = nullptr;
+  for (const auto& [product, s] : by_product) {
+    if (best == nullptr || s.count > best->count) best = &s;
+  }
+  if (best == nullptr) {
+    return Result<DeviceSummary>::Error("no TPU devices to summarize");
+  }
+  if (by_product.size() > 1) {
+    std::string all;
+    for (const auto& [product, s] : by_product) {
+      if (!all.empty()) all += ", ";
+      all += product + " x" + std::to_string(s.count);
+    }
+    TFD_LOG_WARNING << "heterogeneous TPU products on one host (" << all
+                    << "); labeling only '" << best->product << "'";
+  }
+  DeviceSummary s = *best;
   // family = product minus the "tpu-" prefix (tpu-v5e → v5e).
   s.family = HasPrefix(s.product, "tpu-") ? s.product.substr(4) : s.product;
   return s;

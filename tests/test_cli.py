@@ -419,6 +419,19 @@ def test_v6e_8_single(tfd_binary):
     check_golden(out, GOLDEN / "expected-output-tpu-v6e-8-single.txt")
 
 
+def test_heterogeneous_devices_degrade(tfd_binary):
+    """Mixed chip products on one host must warn and label the dominant
+    product group — never exit nonzero (a crash loop is the worst failure
+    mode for a DaemonSet; the reference warns, mig-strategy.go:125-152)."""
+    code, out, err = run_tfd(tfd_binary, oneshot_args(
+        ["--backend=mock",
+         f"--mock-topology-file={FIXTURES / 'heterogeneous.yaml'}",
+         "--machine-type-file=/dev/null"]))
+    assert code == 0, err
+    assert "heterogeneous" in err  # warned
+    check_golden(out, GOLDEN / "expected-output-tpu-heterogeneous.txt")
+
+
 def test_v4_16_mixed(tfd_binary):
     """v4 two-host cube with wraparound, slice-strategy=mixed."""
     code, out, _ = run_tfd(tfd_binary, oneshot_args(

@@ -368,12 +368,16 @@ class PjrtManager : public Manager {
       if (shape_chips == static_cast<long long>(global_chips.size()) &&
           dims.size() >= 2) {
         topology_.topology = JoinStrings(parts, "x");
+        // Wrap from the actual shape (published cube/full-pod rule,
+        // slice::ComputeIciWrap) — never from a bare chip count.
+        if (family.ok()) {
+          slice::Shape shape;
+          for (long long d : dims) shape.dims.push_back(static_cast<int>(d));
+          topology_.has_wraparound =
+              slice::ComputeIciWrap(*family, shape).all;
+        }
       }
     }
-    topology_.has_wraparound =
-        family.ok() && family->topology_dims == 3 &&
-        family->wrap_min_chips > 0 &&
-        static_cast<int>(global_chips.size()) >= family->wrap_min_chips;
 
     snapshot_valid_ = true;
     return Status::Ok();

@@ -138,12 +138,17 @@ class MetadataManager : public Manager {
           slice::DefaultTopology(accel_.spec, accel_.num_chips);
       if (shape.ok()) topology_.topology = shape->ToString();
     }
-    // ICI wraparound: 3D-torus families wrap once the slice reaches a full
-    // cube (v4/v5p >= 64 chips); 2D families are reported unwrapped.
-    topology_.has_wraparound =
-        accel_.spec.topology_dims == 3 &&
-        accel_.spec.wrap_min_chips > 0 &&
-        accel_.num_chips >= accel_.spec.wrap_min_chips;
+    // ICI wraparound from the ACTUAL slice shape (tpu-env TOPOLOGY may be
+    // a custom non-default layout), per the published cube/full-pod rule
+    // (slice::ComputeIciWrap). Unknown shape → no wrap claimed.
+    topology_.has_wraparound = false;
+    if (!topology_.topology.empty()) {
+      Result<slice::Shape> shape = slice::ParseShape(topology_.topology);
+      if (shape.ok()) {
+        topology_.has_wraparound =
+            slice::ComputeIciWrap(accel_.spec, *shape).all;
+      }
+    }
 
     for (int i = 0; i < local_chips; i++) {
       devices_.push_back(std::make_shared<MetadataDevice>(accel_.spec));

@@ -17,13 +17,15 @@ namespace {
 const std::array<FamilySpec, 6>& Families() {
   static const std::array<FamilySpec, 6> kFamilies = {{
       // family, product, gen, hbm_mib, cores, max_chips/host, dims,
-      // counts_cores, wrap_min_chips
-      {"v2", "tpu-v2", 2, 16384, 2, 4, 2, true, 0},
-      {"v3", "tpu-v3", 3, 32768, 2, 4, 2, true, 0},
-      {"v4", "tpu-v4", 4, 32768, 2, 4, 3, true, 64},
-      {"v5e", "tpu-v5e", 5, 16384, 1, 8, 2, false, 0},
-      {"v5p", "tpu-v5p", 5, 97280, 2, 4, 3, true, 64},
-      {"v6e", "tpu-v6e", 6, 32768, 1, 8, 2, false, 0},
+      // counts_cores, full_pod_chips (2D pods: v2-512 = 16x16 chips,
+      // v3-2048 = 32x32, v5e/v6e pods = 16x16; 3D families use the
+      // multiple-of-4 cube rule instead — see ComputeIciWrap)
+      {"v2", "tpu-v2", 2, 16384, 2, 4, 2, true, 256},
+      {"v3", "tpu-v3", 3, 32768, 2, 4, 2, true, 1024},
+      {"v4", "tpu-v4", 4, 32768, 2, 4, 3, true, 0},
+      {"v5e", "tpu-v5e", 5, 16384, 1, 8, 2, false, 256},
+      {"v5p", "tpu-v5p", 5, 97280, 2, 4, 3, true, 0},
+      {"v6e", "tpu-v6e", 6, 32768, 1, 8, 2, false, 256},
   }};
   return kFamilies;
 }
@@ -167,6 +169,31 @@ Result<Shape> DefaultTopology(const FamilySpec& family, int num_chips) {
   return Result<Shape>::Error("no standard topology for " +
                               std::to_string(num_chips) + " chips of " +
                               family.family);
+}
+
+IciWrap ComputeIciWrap(const FamilySpec& family, const Shape& shape) {
+  IciWrap out;
+  out.axes.assign(shape.dims.size(), false);
+  if (family.topology_dims == 3 && shape.dims.size() == 3) {
+    // OCS cube rule: torus (incl. twisted torus) iff every dimension is a
+    // multiple of 4 — the slice is then a union of full 4x4x4 cubes and
+    // the optical switches close the ring on each axis.
+    bool cubes = true;
+    for (int d : shape.dims) {
+      if (d < 4 || d % 4 != 0) cubes = false;
+    }
+    if (cubes) out.axes.assign(3, true);
+  } else if (family.topology_dims == 2 && shape.dims.size() == 2 &&
+             family.full_pod_chips > 0 &&
+             shape.NumChips() == family.full_pod_chips) {
+    out.axes.assign(2, true);
+  }
+  out.all = !out.axes.empty();
+  for (bool axis : out.axes) {
+    out.all = out.all && axis;
+    out.any = out.any || axis;
+  }
+  return out;
 }
 
 }  // namespace slice

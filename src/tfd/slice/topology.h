@@ -24,9 +24,10 @@ struct FamilySpec {
   // Accelerator-type counts chips (v5e/v6e) or TensorCores (v2/v3/v4/v5p):
   // "v4-8" is 8 cores = 4 chips; "v5litepod-8" is 8 chips.
   bool type_counts_cores = false;
-  // Minimum chips for a 3D slice to have torus wraparound links
-  // (v4/v5p: a full 4x4x4 cube, i.e. one "pod cube", wraps).
-  int wrap_min_chips = 0;
+  // Chips in a full pod of this family (2D families wrap only as a full
+  // pod; 0 for 3D families, whose wrap rule is per-shape — see
+  // ComputeIciWrap).
+  int full_pod_chips = 0;
 };
 
 // Parsed "v5litepod-16" / "v4-8" / "v2-8".
@@ -52,6 +53,23 @@ Result<AcceleratorType> ParseAcceleratorType(const std::string& text);
 // shapes Google publishes for each slice size (e.g. v5litepod-16 → 4x4,
 // v4-16 → 2x2x2). Errors when the chip count has no standard shape.
 Result<Shape> DefaultTopology(const FamilySpec& family, int num_chips);
+
+// ICI wraparound links for a slice of `family` laid out as `shape`.
+//
+// Rule (Cloud TPU v4/v5p system-architecture docs): 3D families are built
+// from 4x4x4 cubes joined by optical circuit switches; the OCS closes the
+// torus only when EVERY dimension is a multiple of 4 (shapes like 4x4x8
+// become twisted tori — still wrapped), so a 2x2x2 v4-16 or a 2x8x8 custom
+// topology is a mesh with no wrap on any axis. 2D families wrap only as a
+// full pod (v2: 16x16 chips, v3: 32x32, v5e/v6e: 16x16); every sub-pod 2D
+// slice is a mesh. This replaces the earlier ">= 64 chips" heuristic,
+// which mislabeled non-multiple-of-4 custom topologies.
+struct IciWrap {
+  std::vector<bool> axes;  // aligned with shape.dims; true = axis wraps
+  bool all = false;        // every axis wraps (the tpu.ici.wrap label)
+  bool any = false;
+};
+IciWrap ComputeIciWrap(const FamilySpec& family, const Shape& shape);
 
 }  // namespace slice
 }  // namespace tfd

@@ -204,6 +204,66 @@ void TestFamilyTable() {
            "4x4x4");
 }
 
+void TestIciWrap() {
+  // Table-driven over Google-published v4/v5p slice shapes (Cloud TPU
+  // system-architecture docs: torus links — incl. twisted tori — exist
+  // only when every dimension is a multiple of 4; everything else is a
+  // mesh). The old ">= 64 chips" heuristic would wrongly wrap custom
+  // shapes like 2x8x8.
+  const slice::FamilySpec v4 = *slice::LookupFamily("v4");
+  const slice::FamilySpec v5p = *slice::LookupFamily("v5p");
+  struct Case {
+    const slice::FamilySpec& family;
+    const char* shape;
+    bool wrap;
+  };
+  const Case cases[] = {
+      {v4, "2x2x1", false},    // v4-8
+      {v4, "2x2x2", false},    // v4-16: mesh, not a torus
+      {v4, "2x2x4", false},    // v4-32
+      {v4, "2x4x4", false},    // v4-64
+      {v4, "4x4x4", true},     // v4-128: one full cube
+      {v4, "4x4x8", true},     // v4-256: twisted torus — still wrapped
+      {v4, "4x8x8", true},     // v4-512
+      {v4, "8x8x8", true},     // v4-1024
+      {v4, "8x8x12", true},    // v4-1536
+      {v4, "8x8x16", true},    // v4-2048
+      {v4, "8x16x16", true},   // v4-4096
+      {v4, "2x8x8", false},    // 128 chips but a 2-dim: mesh (old
+                               // heuristic said true)
+      {v5p, "2x2x1", false},   // v5p-8
+      {v5p, "4x4x4", true},    // v5p-128
+      {v5p, "4x4x8", true},    // v5p-256
+      {v5p, "4x4x12", true},   // v5p-384
+      {v5p, "4x8x8", true},    // v5p-512
+      {v5p, "2x2x16", false},  // 64 chips, custom column: mesh
+  };
+  for (const Case& c : cases) {
+    Result<slice::Shape> shape = slice::ParseShape(c.shape);
+    CHECK_TRUE(shape.ok());
+    slice::IciWrap wrap = slice::ComputeIciWrap(c.family, *shape);
+    if (wrap.all != c.wrap) {
+      g_failures++;
+      std::cerr << "ICI wrap mismatch for " << c.family.family << " "
+                << c.shape << ": got " << wrap.all << ", want " << c.wrap
+                << "\n";
+    }
+    g_checks++;
+    CHECK_EQ(wrap.all, wrap.any);  // uniform per-axis under the cube rule
+  }
+  // 2D families: only the full pod is a torus.
+  const slice::FamilySpec v5e = *slice::LookupFamily("v5e");
+  CHECK_TRUE(!slice::ComputeIciWrap(v5e, *slice::ParseShape("4x4")).all);
+  CHECK_TRUE(!slice::ComputeIciWrap(v5e, *slice::ParseShape("8x16")).all);
+  CHECK_TRUE(slice::ComputeIciWrap(v5e, *slice::ParseShape("16x16")).all);
+  const slice::FamilySpec v2 = *slice::LookupFamily("v2");
+  CHECK_TRUE(!slice::ComputeIciWrap(v2, *slice::ParseShape("4x4")).all);
+  CHECK_TRUE(slice::ComputeIciWrap(v2, *slice::ParseShape("16x16")).all);
+  const slice::FamilySpec v3 = *slice::LookupFamily("v3");
+  CHECK_TRUE(slice::ComputeIciWrap(v3, *slice::ParseShape("32x32")).all);
+  CHECK_TRUE(!slice::ComputeIciWrap(v3, *slice::ParseShape("16x16")).all);
+}
+
 void TestDuration() {
   CHECK_EQ(config::ParseDurationSeconds("60s").value(), 60);
   CHECK_EQ(config::ParseDurationSeconds("1m30s").value(), 90);
@@ -529,6 +589,7 @@ int main() {
   tfd::TestYamlLite();
   tfd::TestShapeGrammar();
   tfd::TestFamilyTable();
+  tfd::TestIciWrap();
   tfd::TestDuration();
   tfd::TestConfigPrecedence();
   tfd::TestResourceLabelsNone();

@@ -433,7 +433,7 @@ def test_heterogeneous_devices_degrade(tfd_binary):
 
 
 def test_v4_16_mixed(tfd_binary):
-    """v4 two-host cube with wraparound, slice-strategy=mixed."""
+    """v4 two-host 2x2x2 cube (mesh, no wrap), slice-strategy=mixed."""
     code, out, _ = run_tfd(tfd_binary, oneshot_args(
         ["--backend=mock",
          f"--mock-topology-file={FIXTURES / 'v4-16.yaml'}",

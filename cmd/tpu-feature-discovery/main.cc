@@ -32,12 +32,8 @@ namespace {
 
 enum class RunOutcome { kExit, kRestart, kError };
 
-// True when a metadata server is plausibly reachable (GCE VM or explicit
-// test endpoint) — gates the metadata-touching labelers so bare-metal nodes
-// never pay connection timeouts.
 bool MetadataPlausible(const config::Config& config) {
-  return !config.flags.metadata_endpoint.empty() || platform::OnGce() ||
-         std::getenv("GCE_METADATA_HOST") != nullptr;
+  return platform::MetadataPlausible(config.flags.metadata_endpoint);
 }
 
 lm::MachineTypeGetter MakeMachineTypeGetter(const config::Config& config) {

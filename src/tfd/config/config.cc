@@ -140,6 +140,24 @@ std::vector<FlagDef> MakeFlagDefs(Flags* f) {
                   [f](const std::string& v) {
                     return SetString(&f->libtpu_path, v);
                   }});
+  defs.push_back({"pjrt-init-timeout",
+                  {"TFD_PJRT_INIT_TIMEOUT"},
+                  "pjrtInitTimeout",
+                  "deadline for PJRT backend init, run in a killable child "
+                  "(e.g. 30s; 0 = no watchdog, init in-process)",
+                  false,
+                  [f](const std::string& v) {
+                    return SetDuration(&f->pjrt_init_timeout_s, v);
+                  }});
+  defs.push_back({"pjrt-multihost",
+                  {"TFD_PJRT_MULTIHOST"},
+                  "pjrtMultihost",
+                  "allow whole-slice PJRT client creation on multi-host "
+                  "slices instead of pinning init to this host",
+                  true,
+                  [f](const std::string& v) {
+                    return SetBool(&f->pjrt_multihost, v);
+                  }});
   defs.push_back({"metadata-endpoint",
                   {"TFD_METADATA_ENDPOINT", "GCE_METADATA_HOST"},
                   "metadataEndpoint",
@@ -446,6 +464,9 @@ Result<LoadResult> Load(int argc, char** argv) {
                                      f->device_health +
                                      "' (want off|basic|full)");
   }
+  if (f->pjrt_init_timeout_s < 0) {
+    return Result<LoadResult>::Error("pjrt-init-timeout must be >= 0s");
+  }
   if (f->health_exec_timeout_s < 1) {
     return Result<LoadResult>::Error("health-exec-timeout must be >= 1s");
   }
@@ -480,6 +501,8 @@ std::string ToJson(const Config& config) {
       << ",\"useNodeFeatureAPI\":"
       << (f.use_node_feature_api ? "true" : "false")
       << ",\"backend\":" << jstr(f.backend)
+      << ",\"pjrtInitTimeout\":\"" << f.pjrt_init_timeout_s << "s\""
+      << ",\"pjrtMultihost\":" << (f.pjrt_multihost ? "true" : "false")
       << ",\"deviceHealth\":" << jstr(f.device_health)
       << ",\"healthExec\":" << jstr(f.health_exec)
       << ",\"healthExecTimeout\":\"" << f.health_exec_timeout_s << "s\""

@@ -61,6 +61,18 @@ struct Flags {
   // TPU-specific knobs (no reference analogue; replaces NVML/CUDA paths):
   std::string backend = "auto";  // auto|pjrt|metadata|mock|null
   std::string libtpu_path;       // override libtpu.so location
+  // Hard deadline on PJRT backend init (dlopen + PJRT_Client_Create runs
+  // in a killable child process). libtpu's client creation can BLOCK, not
+  // fail, on a multi-host slice (slice-wide rendezvous); the deadline
+  // turns a wedged init into a clean fallback to the metadata backend.
+  // 0 disables the watchdog (init runs in-process, for debugging).
+  int pjrt_init_timeout_s = 30;
+  // Opt into whole-slice PJRT client creation on multi-host slices (every
+  // worker's daemon must reach init within pjrt-init-timeout together —
+  // true under a DaemonSet covering the slice). Default: client creation
+  // is pinned to this host (TPU_HOST_BOUNDS=1,1,1) and slice-wide
+  // topology comes from the metadata server instead.
+  bool pjrt_multihost = false;
   std::string metadata_endpoint; // override http://metadata.google.internal
   std::string mock_topology_file; // mock backend fixture (tests)
   // off|basic|full. basic: init+enumeration+latency labels. full: basic

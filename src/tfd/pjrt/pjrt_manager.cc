@@ -396,7 +396,7 @@ class PjrtManager : public Manager {
 
 }  // namespace
 
-ManagerPtr NewPjrtManager(const std::string& libtpu_path) {
+ManagerPtr NewPjrtInProcessManager(const std::string& libtpu_path) {
   return std::make_shared<PjrtManager>(libtpu_path);
 }
 

@@ -1,0 +1,445 @@
+// PJRT init watchdog: the deadline + multi-host fence around the raw
+// in-process PJRT backend (pjrt_manager.cc).
+//
+// Why it exists: the reference's NVML init is local and fast, so its
+// factory can call it inline (internal/resource/factory.go:32-38) and rely
+// on the fallback decorator catching *errors*. libtpu is different:
+// PJRT_Client_Create on one worker of a multi-host slice performs a
+// slice-wide rendezvous (it probes TPU_WORKER_HOSTNAMES) and can BLOCK
+// indefinitely when the peers aren't also initializing — a failure mode
+// the error-based fallback chain never sees. The watchdog restores the
+// reference's "init either works or degrades" contract on TPU terms:
+//
+//   1. Init runs in a forked child (RunForkedCapture) under
+//      flags.pjrt_init_timeout_s. A wedged libtpu is SIGKILLed (which
+//      also releases the TPU chip lock — libtpu is single-tenant) and
+//      Init returns an error, so --backend=auto falls back to the
+//      metadata backend and label refresh never stalls.
+//   2. Multi-host contract: by default client creation is PINNED to this
+//      host. When a multi-host slice is detected (tpu-env HOST_BOUNDS /
+//      accelerator-type chip count / TPU_WORKER_HOSTNAMES), the child
+//      sets TPU_HOST_BOUNDS=1,1,1 (+ the newer TPU_PROCESS_BOUNDS
+//      spelling) and clears the rendezvous triggers, so libtpu brings up
+//      only the local chips — the daemon is per-node and must not gate
+//      its labels on every peer running simultaneously. Slice-wide
+//      topology (shape, hosts, worker id, wrap) is then overlaid from
+//      the metadata backend, which knows it authoritatively.
+//      --pjrt-multihost opts into whole-slice creation (sound under a
+//      DaemonSet where every worker initializes together), still bounded
+//      by the deadline.
+//
+// The child serializes the snapshot as one JSON document over the pipe;
+// versions and device facts always come from PJRT (real silicon), only
+// topology may be overlaid.
+#include <stdlib.h>
+#include <unistd.h>
+
+#include <cstring>
+
+#include "tfd/gce/metadata.h"
+#include "tfd/platform/detect.h"
+#include "tfd/resource/factory.h"
+#include "tfd/slice/topology.h"
+#include "tfd/util/jsonlite.h"
+#include "tfd/util/logging.h"
+#include "tfd/util/strings.h"
+#include "tfd/util/subprocess.h"
+
+namespace tfd {
+namespace resource {
+
+namespace {
+
+using jsonlite::Value;
+using jsonlite::ValuePtr;
+
+ValuePtr MakeNum(double v) {
+  auto p = std::make_shared<Value>();
+  p->kind = Value::Kind::kNumber;
+  p->number_value = v;
+  return p;
+}
+
+ValuePtr MakeBool(bool v) {
+  auto p = std::make_shared<Value>();
+  p->kind = Value::Kind::kBool;
+  p->bool_value = v;
+  return p;
+}
+
+ValuePtr MakeObject() {
+  auto p = std::make_shared<Value>();
+  p->kind = Value::Kind::kObject;
+  return p;
+}
+
+// A chip rebuilt from the probe child's snapshot.
+class SnapshotChip : public Device {
+ public:
+  SnapshotChip(std::string kind, std::string product, long long memory_mib,
+               int cores, int generation)
+      : kind_(std::move(kind)), product_(std::move(product)),
+        memory_mib_(memory_mib), cores_(cores), generation_(generation) {}
+
+  Result<std::string> GetKind() override { return kind_; }
+  Result<std::string> GetProduct() override { return product_; }
+  Result<long long> GetTotalMemoryMiB() override { return memory_mib_; }
+  Result<int> GetCoreCount() override { return cores_; }
+  Result<int> GetGeneration() override { return generation_; }
+
+ private:
+  std::string kind_;
+  std::string product_;
+  long long memory_mib_;
+  int cores_;
+  int generation_;
+};
+
+// Env spellings libtpu reads at client-create time. Both generations are
+// set/cleared: TPU_HOST_BOUNDS/TPU_CHIPS_PER_HOST_BOUNDS (v2/v3-era) and
+// TPU_PROCESS_BOUNDS/TPU_CHIPS_PER_PROCESS_BOUNDS (current).
+constexpr const char* kHostBoundsEnvs[] = {"TPU_HOST_BOUNDS",
+                                           "TPU_PROCESS_BOUNDS"};
+constexpr const char* kChipsBoundsEnvs[] = {"TPU_CHIPS_PER_HOST_BOUNDS",
+                                            "TPU_CHIPS_PER_PROCESS_BOUNDS"};
+// Rendezvous triggers: with these set, libtpu attempts slice-wide (or
+// multi-slice) coordination during client creation.
+constexpr const char* kRendezvousEnvs[] = {
+    "TPU_WORKER_HOSTNAMES", "TPU_WORKER_ID",      "CLOUD_TPU_TASK_ID",
+    "TPU_PROCESS_ADDRESSES", "TPU_PROCESS_PORT",
+    "MEGASCALE_COORDINATOR_ADDRESS", "MEGASCALE_NUM_SLICES",
+    "MEGASCALE_SLICE_ID", "MEGASCALE_PORT"};
+
+// What the parent decided before forking the probe.
+struct PinPlan {
+  bool pin = false;             // pin client creation to this host
+  std::string chips_bounds;     // tpu-env CHIPS_PER_HOST_BOUNDS ("" unknown)
+  bool metadata_plausible = false;
+};
+
+PinPlan PlanHostPinning(const config::Flags& flags) {
+  PinPlan plan;
+  if (flags.pjrt_multihost) return plan;  // operator chose whole-slice init
+
+  // Env evidence: the TPU runtime agent exports the slice's worker list.
+  const char* hostnames = getenv("TPU_WORKER_HOSTNAMES");
+  if (hostnames != nullptr &&
+      std::strchr(hostnames, ',') != nullptr) {
+    plan.pin = true;
+  }
+
+  plan.metadata_plausible =
+      platform::MetadataPlausible(flags.metadata_endpoint);
+  if (!plan.metadata_plausible) return plan;
+
+  // Metadata evidence: HOST_BOUNDS product > 1, or an accelerator-type
+  // whose chip count exceeds one host.
+  gce::MetadataClient client(flags.metadata_endpoint);
+  Result<std::map<std::string, std::string>> env = client.TpuEnv();
+  if (env.ok()) {
+    auto it = env->find("CHIPS_PER_HOST_BOUNDS");
+    if (it != env->end()) plan.chips_bounds = TrimSpace(it->second);
+    it = env->find("HOST_BOUNDS");
+    if (it != env->end()) {
+      int hosts = 1;
+      long long product = 1;
+      for (const std::string& part :
+           SplitString(TrimSpace(it->second), ',')) {
+        if (!ParseNonNegInt(TrimSpace(part), &hosts) || hosts < 1) {
+          product = 0;
+          break;
+        }
+        product *= hosts;
+      }
+      if (product > 1) plan.pin = true;
+    }
+  }
+  if (!plan.pin) {
+    Result<std::string> accel = client.AcceleratorType();
+    if (accel.ok() && !accel->empty()) {
+      Result<slice::AcceleratorType> parsed =
+          slice::ParseAcceleratorType(*accel);
+      if (parsed.ok() &&
+          parsed->num_chips > parsed->spec.max_chips_per_host) {
+        plan.pin = true;
+      }
+    }
+  }
+  return plan;
+}
+
+// ---- child side ----------------------------------------------------------
+
+void WriteAll(int fd, const std::string& data) {
+  size_t off = 0;
+  while (off < data.size()) {
+    ssize_t n = write(fd, data.data() + off, data.size() - off);
+    if (n <= 0) return;  // parent vanished; nothing useful to do
+    off += static_cast<size_t>(n);
+  }
+}
+
+// Runs the real in-process PJRT backend and streams its snapshot out as
+// JSON. Runs post-fork: _exits, never returns to the daemon loop.
+int ProbeChild(int fd, const std::string& libtpu_path, const PinPlan& plan) {
+  if (plan.pin) {
+    // Pin client creation to this host. Overwrites ambient values on
+    // purpose: the runtime agent's slice-wide env is exactly what must
+    // not leak into a per-node probe.
+    for (const char* env : kHostBoundsEnvs) setenv(env, "1,1,1", 1);
+    // Standard multi-host TPU hosts carry 4 chips in a 2x2x1 block
+    // (v2/v3/v4/v5p/v5e-multihost/v6e alike); tpu-env overrides when the
+    // platform says otherwise.
+    std::string chips =
+        plan.chips_bounds.empty() ? "2,2,1" : plan.chips_bounds;
+    for (const char* env : kChipsBoundsEnvs) setenv(env, chips.c_str(), 1);
+    for (const char* env : kRendezvousEnvs) unsetenv(env);
+  }
+
+  ManagerPtr inner = NewPjrtInProcessManager(libtpu_path);
+  Status s = inner->Init();
+  ValuePtr doc = MakeObject();
+  if (!s.ok()) {
+    doc->Set("error", jsonlite::MakeString(s.message()));
+    WriteAll(fd, jsonlite::Serialize(*doc));
+    return 1;
+  }
+
+  Result<std::vector<DevicePtr>> devices = inner->GetDevices();
+  if (!devices.ok()) {
+    doc->Set("error", jsonlite::MakeString(devices.error()));
+    WriteAll(fd, jsonlite::Serialize(*doc));
+    return 1;
+  }
+  auto device_array = std::make_shared<Value>();
+  device_array->kind = Value::Kind::kArray;
+  for (const DevicePtr& device : *devices) {
+    ValuePtr d = MakeObject();
+    Result<std::string> kind = device->GetKind();
+    Result<std::string> product = device->GetProduct();
+    Result<long long> memory = device->GetTotalMemoryMiB();
+    Result<int> cores = device->GetCoreCount();
+    Result<int> generation = device->GetGeneration();
+    d->Set("kind", jsonlite::MakeString(kind.ok() ? *kind : ""));
+    d->Set("product", jsonlite::MakeString(product.ok() ? *product : ""));
+    d->Set("memory_mib", MakeNum(memory.ok() ? double(*memory) : 0));
+    d->Set("cores", MakeNum(cores.ok() ? *cores : 0));
+    d->Set("generation", MakeNum(generation.ok() ? *generation : 0));
+    device_array->array_items.push_back(d);
+  }
+  doc->Set("devices", device_array);
+
+  Result<std::string> libtpu_version = inner->GetLibtpuVersion();
+  if (libtpu_version.ok()) {
+    doc->Set("libtpu_version", jsonlite::MakeString(*libtpu_version));
+  }
+  Result<std::string> runtime_version = inner->GetRuntimeVersion();
+  if (runtime_version.ok()) {
+    doc->Set("runtime_version", jsonlite::MakeString(*runtime_version));
+  }
+  Result<TopologyInfo> topo = inner->GetTopology();
+  if (topo.ok()) {
+    ValuePtr t = MakeObject();
+    t->Set("accelerator_type", jsonlite::MakeString(topo->accelerator_type));
+    t->Set("topology", jsonlite::MakeString(topo->topology));
+    t->Set("chips_per_host", MakeNum(topo->chips_per_host));
+    t->Set("num_hosts", MakeNum(topo->num_hosts));
+    t->Set("worker_id", MakeNum(topo->worker_id));
+    t->Set("wrap", MakeBool(topo->has_wraparound));
+    doc->Set("topology", t);
+  }
+  inner->Shutdown();
+  WriteAll(fd, jsonlite::Serialize(*doc));
+  return 0;
+}
+
+// ---- parent side ---------------------------------------------------------
+
+class PjrtWatchdogManager : public Manager {
+ public:
+  explicit PjrtWatchdogManager(const config::Config& config)
+      : flags_(config.flags) {}
+
+  Status Init() override {
+    // Escape hatches: no deadline configured → plain in-process init.
+    if (flags_.pjrt_init_timeout_s <= 0 ||
+        getenv("TFD_PJRT_INPROC") != nullptr) {
+      inproc_ = NewPjrtInProcessManager(flags_.libtpu_path);
+      return inproc_->Init();
+    }
+
+    PinPlan plan = PlanHostPinning(flags_);
+    if (plan.pin) {
+      TFD_LOG_INFO << "multi-host slice detected; pinning PJRT client "
+                      "creation to this host (chips bounds "
+                   << (plan.chips_bounds.empty() ? "2,2,1"
+                                                 : plan.chips_bounds)
+                   << "); slice topology will come from metadata";
+    }
+
+    std::string libtpu_path = flags_.libtpu_path;
+    int exit_code = 0;
+    Result<std::string> out = RunForkedCapture(
+        [&libtpu_path, &plan](int fd) {
+          return ProbeChild(fd, libtpu_path, plan);
+        },
+        flags_.pjrt_init_timeout_s, "PJRT init probe", &exit_code);
+    if (!out.ok()) {
+      // Deadline expiry lands here: the child was SIGKILLed.
+      return Status::Error("PJRT init did not complete: " + out.error());
+    }
+
+    Result<ValuePtr> doc = jsonlite::Parse(*out);
+    if (!doc.ok()) {
+      return Status::Error("PJRT probe emitted unparseable output (exit " +
+                           std::to_string(exit_code) + "): " + doc.error());
+    }
+    ValuePtr error = (*doc)->Get("error");
+    if (error != nullptr) return Status::Error(error->string_value);
+    if (exit_code != 0) {
+      return Status::Error("PJRT probe exited " + std::to_string(exit_code));
+    }
+
+    ValuePtr devices = (*doc)->Get("devices");
+    if (devices == nullptr || devices->kind != Value::Kind::kArray ||
+        devices->array_items.empty()) {
+      return Status::Error("PJRT probe reported no devices");
+    }
+    for (const ValuePtr& d : devices->array_items) {
+      auto str = [&d](const char* key) {
+        ValuePtr v = d->Get(key);
+        return v != nullptr ? v->string_value : std::string();
+      };
+      auto num = [&d](const char* key) -> long long {
+        ValuePtr v = d->Get(key);
+        return v != nullptr ? static_cast<long long>(v->number_value) : 0;
+      };
+      devices_.push_back(std::make_shared<SnapshotChip>(
+          str("kind"), str("product"), num("memory_mib"),
+          static_cast<int>(num("cores")),
+          static_cast<int>(num("generation"))));
+    }
+    if (ValuePtr v = (*doc)->Get("libtpu_version")) {
+      libtpu_version_ = v->string_value;
+    }
+    if (ValuePtr v = (*doc)->Get("runtime_version")) {
+      runtime_version_ = v->string_value;
+    }
+    if (ValuePtr t = (*doc)->Get("topology")) {
+      auto get = [&t](const char* key) { return t->Get(key); };
+      if (ValuePtr v = get("accelerator_type")) {
+        topology_.accelerator_type = v->string_value;
+      }
+      if (ValuePtr v = get("topology")) topology_.topology = v->string_value;
+      if (ValuePtr v = get("chips_per_host")) {
+        topology_.chips_per_host = static_cast<int>(v->number_value);
+      }
+      if (ValuePtr v = get("num_hosts")) {
+        topology_.num_hosts = static_cast<int>(v->number_value);
+      }
+      if (ValuePtr v = get("worker_id")) {
+        topology_.worker_id = static_cast<int>(v->number_value);
+      }
+      if (ValuePtr v = get("wrap")) topology_.has_wraparound = v->bool_value;
+    }
+
+    if (plan.pin) OverlaySliceTopology(plan);
+    initialized_ = true;
+    return Status::Ok();
+  }
+
+  void Shutdown() override {
+    if (inproc_ != nullptr) inproc_->Shutdown();
+  }
+
+  Result<std::vector<DevicePtr>> GetDevices() override {
+    if (inproc_ != nullptr) return inproc_->GetDevices();
+    if (!initialized_) {
+      return Result<std::vector<DevicePtr>>::Error(
+          "PJRT backend not initialized");
+    }
+    return devices_;
+  }
+
+  Result<std::string> GetLibtpuVersion() override {
+    if (inproc_ != nullptr) return inproc_->GetLibtpuVersion();
+    if (libtpu_version_.empty()) {
+      return Result<std::string>::Error(
+          "libtpu version not reported by the PJRT plugin");
+    }
+    return libtpu_version_;
+  }
+
+  Result<std::string> GetRuntimeVersion() override {
+    if (inproc_ != nullptr) return inproc_->GetRuntimeVersion();
+    if (!initialized_) {
+      return Result<std::string>::Error("PJRT backend not initialized");
+    }
+    return runtime_version_;
+  }
+
+  Result<TopologyInfo> GetTopology() override {
+    if (inproc_ != nullptr) return inproc_->GetTopology();
+    if (!initialized_) {
+      return Result<TopologyInfo>::Error("PJRT backend not initialized");
+    }
+    return topology_;
+  }
+
+  std::string Name() const override { return "pjrt"; }
+  bool TouchesDevices() const override { return true; }
+
+ private:
+  // After a pinned (host-local) client creation, the PJRT view of the
+  // slice is just this host: process_index 0, num_hosts 1, a host-sized
+  // "topology". Those slice-wide fields are authoritative in the metadata
+  // server — reuse the metadata backend wholesale (it owns the worker-id
+  // fallback ladder: tpu-env → agent-worker-number → hostname). Device
+  // facts (kind/memory/versions) stay PJRT's; chips_per_host stays the
+  // actually-enumerated local chip count.
+  void OverlaySliceTopology(const PinPlan& plan) {
+    // Whatever happens below, a pinned snapshot must not claim the pinned
+    // artifacts as slice truth.
+    topology_.num_hosts = 0;
+    topology_.worker_id = -1;
+    topology_.topology.clear();
+    topology_.has_wraparound = false;
+
+    if (!plan.metadata_plausible) return;
+    // This re-fetches tpu-env/accelerator-type that PlanHostPinning just
+    // read — deliberately: reusing the metadata backend buys its whole
+    // worker-id fallback ladder, and the duplicate GETs are two small
+    // requests to a link-local server once per sleep-interval.
+    ManagerPtr metadata = NewMetadataManager(flags_.metadata_endpoint);
+    Status s = metadata->Init();
+    if (!s.ok()) {
+      TFD_LOG_WARNING << "pinned PJRT init succeeded but slice topology "
+                         "lookup failed: "
+                      << s.message();
+      return;
+    }
+    Result<TopologyInfo> meta_topo = metadata->GetTopology();
+    if (!meta_topo.ok()) return;
+    int chips_per_host = topology_.chips_per_host;  // PJRT's local truth
+    topology_ = *meta_topo;
+    topology_.chips_per_host = chips_per_host;
+  }
+
+  config::Flags flags_;
+  ManagerPtr inproc_;  // set only on the no-watchdog escape hatch
+
+  bool initialized_ = false;
+  std::vector<DevicePtr> devices_;
+  std::string libtpu_version_;
+  std::string runtime_version_;
+  TopologyInfo topology_;
+};
+
+}  // namespace
+
+ManagerPtr NewPjrtManager(const config::Config& config) {
+  return std::make_shared<PjrtWatchdogManager>(config);
+}
+
+}  // namespace resource
+}  // namespace tfd

@@ -69,5 +69,10 @@ bool OnGce(const std::string& dmi_product_file) {
   return p.find("google") != std::string::npos;
 }
 
+bool MetadataPlausible(const std::string& endpoint) {
+  return !endpoint.empty() || std::getenv("GCE_METADATA_HOST") != nullptr ||
+         OnGce();
+}
+
 }  // namespace platform
 }  // namespace tfd

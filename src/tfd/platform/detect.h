@@ -30,5 +30,12 @@ bool HasAccelDevice();
 bool OnGce(const std::string& dmi_product_file =
                "/sys/class/dmi/id/product_name");
 
+// True when a metadata server is plausibly reachable: an explicit
+// endpoint (--metadata-endpoint), a GCE_METADATA_HOST override, or a GCE
+// VM. Gates every metadata-touching path (labelers in main.cc, the PJRT
+// watchdog's pinning plan) so bare-metal nodes never pay connection
+// timeouts.
+bool MetadataPlausible(const std::string& endpoint);
+
 }  // namespace platform
 }  // namespace tfd

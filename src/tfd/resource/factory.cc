@@ -12,7 +12,7 @@ Result<ManagerPtr> SelectManager(const config::Config& config) {
   const config::Flags& f = config.flags;
   if (f.backend == "null") return NewNullManager();
   if (f.backend == "mock") return NewMockManager(f.mock_topology_file);
-  if (f.backend == "pjrt") return NewPjrtManager(f.libtpu_path);
+  if (f.backend == "pjrt") return NewPjrtManager(config);
   if (f.backend == "metadata") return NewMetadataManager(f.metadata_endpoint);
 
   // auto (reference getManager, factory.go:41-73). Unlike the reference's
@@ -30,7 +30,7 @@ Result<ManagerPtr> SelectManager(const config::Config& config) {
                  << (has_libtpu ? libtpu_path : "no")
                  << ", accel-devices=" << (has_accel ? "yes" : "no")
                  << "); trying the PJRT backend first";
-    ManagerPtr pjrt = NewPjrtManager(f.libtpu_path);
+    ManagerPtr pjrt = NewPjrtManager(config);
     if (on_gce || !f.metadata_endpoint.empty()) {
       pjrt = NewMetadataEnrichedManager(pjrt, f.metadata_endpoint);
     }

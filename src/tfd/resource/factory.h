@@ -17,8 +17,19 @@ namespace resource {
 
 Result<ManagerPtr> NewManager(const config::Config& config);
 
-// The PJRT (libtpu) backend — implemented in pjrt_manager.cc.
-ManagerPtr NewPjrtManager(const std::string& libtpu_path);
+// The PJRT (libtpu) backend. A watchdog manager (pjrt_watchdog.cc): init
+// runs in a forked child under flags.pjrt_init_timeout_s so a blocking
+// PJRT_Client_Create (multi-host rendezvous, wedged driver) degrades into
+// a clean Init error instead of hanging the daemon. On detected
+// multi-host slices (unless flags.pjrt_multihost) the child pins client
+// creation to this host and slice-wide topology is overlaid from GCE
+// metadata.
+ManagerPtr NewPjrtManager(const config::Config& config);
+
+// The raw in-process PJRT backend (pjrt_manager.cc): dlopen + client
+// create on the calling thread, no deadline. Runs inside the watchdog's
+// probe child; selectable directly via pjrt-init-timeout=0.
+ManagerPtr NewPjrtInProcessManager(const std::string& libtpu_path);
 
 // The metadata backend — chip inventory derived from the GCE metadata
 // accelerator-type, for nodes where libtpu is absent or busy.

@@ -16,6 +16,22 @@
 //   TFD_FAKE_PJRT_HBM_GIB    per-DEVICE HBM GiB (default 16; 0 = stats unset)
 //   TFD_FAKE_PJRT_VERSION    platform version   (default "fake 9.9.9")
 //   TFD_FAKE_PJRT_FAIL       if set, client creation fails with its value
+//   TFD_FAKE_PJRT_HANG       if set, client creation blocks forever — the
+//                            wedged-driver case the init watchdog fences
+//   TFD_FAKE_PJRT_MULTIHOST_HANG  if set, client creation blocks UNLESS
+//                            host-pinning env is present (see below) —
+//                            models real libtpu's slice-wide rendezvous
+//                            waiting for peers that never arrive
+//
+// Host-pinning emulation (mirrors real libtpu semantics): when
+// TPU_HOST_BOUNDS or TPU_PROCESS_BOUNDS is "1,1,1", the client creates
+// single-host — process_index 0, one host, and the chip grid taken from
+// TPU_CHIPS_PER_HOST_BOUNDS / TPU_CHIPS_PER_PROCESS_BOUNDS instead of
+// TFD_FAKE_PJRT_BOUNDS. This lets tests drive the watchdog's multi-host
+// contract end-to-end: a 4x4x4/16-host fake that would hang on a
+// whole-slice create comes up pinned with just the local 2x2x1 chips.
+#include <unistd.h>
+
 #include <cstdlib>
 #include <cstring>
 #include <string>
@@ -93,18 +109,34 @@ PJRT_Error* ClientCreate(PJRT_Client_Create_Args* args) {
   std::string fail = EnvStr("TFD_FAKE_PJRT_FAIL", "");
   if (!fail.empty()) return MakeError(fail);
 
+  // Real libtpu honors single-host pinning via the bounds env.
+  bool pinned = EnvStr("TPU_HOST_BOUNDS", "") == "1,1,1" ||
+                EnvStr("TPU_PROCESS_BOUNDS", "") == "1,1,1";
+
+  // Hang modes: unconditional (wedged driver), or rendezvous-shaped
+  // (blocks only when asked to bring up the whole slice). SIGKILL from
+  // the watchdog is the only way out, exactly like the real thing.
+  bool hang = !EnvStr("TFD_FAKE_PJRT_HANG", "").empty() ||
+              (!EnvStr("TFD_FAKE_PJRT_MULTIHOST_HANG", "").empty() &&
+               !pinned);
+  while (hang) sleep(3600);
+
   auto* client = new FakeClient();
   client->platform_version = EnvStr("TFD_FAKE_PJRT_VERSION", "fake 9.9.9");
-  client->process_index = EnvInt("TFD_FAKE_PJRT_PROC", 0);
+  client->process_index = pinned ? 0 : EnvInt("TFD_FAKE_PJRT_PROC", 0);
   std::string kind = EnvStr("TFD_FAKE_PJRT_KIND", "TPU v5 lite");
-  int hosts = EnvInt("TFD_FAKE_PJRT_HOSTS", 1);
+  int hosts = pinned ? 1 : EnvInt("TFD_FAKE_PJRT_HOSTS", 1);
   int cores = EnvInt("TFD_FAKE_PJRT_CORES", 1);
   int64_t hbm_gib = EnvInt("TFD_FAKE_PJRT_HBM_GIB", 16);
 
-  // Parse bounds "X,Y,Z".
+  // Parse bounds "X,Y,Z". Pinned: the chip grid is this host's block.
   std::vector<int> bounds;
   {
     std::string b = EnvStr("TFD_FAKE_PJRT_BOUNDS", "2,2,1");
+    if (pinned) {
+      b = EnvStr("TPU_CHIPS_PER_HOST_BOUNDS", "");
+      if (b.empty()) b = EnvStr("TPU_CHIPS_PER_PROCESS_BOUNDS", "2,2,1");
+    }
     size_t pos = 0;
     while (pos <= b.size()) {
       size_t comma = b.find(',', pos);

@@ -28,6 +28,7 @@
 #include "tfd/util/http.h"
 #include "tfd/util/jsonlite.h"
 #include "tfd/util/strings.h"
+#include "tfd/util/subprocess.h"
 
 namespace tfd {
 namespace {
@@ -581,6 +582,44 @@ void TestJsonNonFiniteSerialization() {
   CHECK_EQ(jsonlite::Serialize(*value), "42");
 }
 
+void TestForkedCapture() {
+  // Normal path: output + exit code transported, no error mapping.
+  int code = -1;
+  Result<std::string> out = RunForkedCapture(
+      [](int fd) {
+        const char msg[] = "{\"ok\":true}";
+        (void)!write(fd, msg, sizeof(msg) - 1);
+        return 3;
+      },
+      5, "test child", &code);
+  CHECK_TRUE(out.ok());
+  CHECK_EQ(*out, "{\"ok\":true}");
+  CHECK_EQ(code, 3);
+
+  // Hang path: the PJRT-init-shaped failure — child blocks without ever
+  // writing; the deadline must kill it and surface an error.
+  code = -1;
+  out = RunForkedCapture(
+      [](int) {
+        while (true) sleep(3600);
+        return 0;
+      },
+      1, "hanging child", &code);
+  CHECK_TRUE(!out.ok());
+  CHECK_TRUE(out.error().find("timed out") != std::string::npos);
+
+  // Close-then-hang: EOF on the pipe must not bypass the deadline.
+  out = RunForkedCapture(
+      [](int fd) {
+        close(fd);
+        while (true) sleep(3600);
+        return 0;
+      },
+      1, "eof-then-hang child", &code);
+  CHECK_TRUE(!out.ok());
+  CHECK_TRUE(out.error().find("timed out") != std::string::npos);
+}
+
 }  // namespace
 }  // namespace tfd
 
@@ -605,6 +644,7 @@ int main() {
   tfd::TestAtomicWrite();
   tfd::TestUrlParsing();
   tfd::TestJsonNonFiniteSerialization();
+  tfd::TestForkedCapture();
 
   std::cerr << tfd::g_checks << " checks, " << tfd::g_failures << " failures"
             << std::endl;

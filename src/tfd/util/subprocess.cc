@@ -112,6 +112,92 @@ class ScopedProbeSignals {
   sigset_t saved_mask_;
 };
 
+// Parent side of a captured child: reads `read_fd` until EOF, overflow, or
+// the deadline; kills the child's process group on timeout/overflow; reaps.
+// Returns the captured bytes and the child's exit code via `exit_code`
+// (untouched on error). Closes `read_fd`.
+Result<std::string> CaptureChild(pid_t pid, int read_fd, int timeout_s,
+                                 const std::string& what, int* exit_code) {
+  setpgid(pid, pid);  // see child comment in RunCommandCapture; EACCES
+                      // after exec is fine — the child already did it itself
+  ScopedProbeSignals signal_guard(pid);
+  std::string output;
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::seconds(timeout_s);
+  bool timed_out = false;
+  bool overflowed = false;
+  char buf[4096];
+  while (true) {
+    auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+                    deadline - std::chrono::steady_clock::now())
+                    .count();
+    if (left <= 0) {
+      timed_out = true;
+      break;
+    }
+    pollfd pfd{read_fd, POLLIN, 0};
+    int rc = poll(&pfd, 1, static_cast<int>(left));
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      timed_out = true;  // treat poll failure like a hang: kill and report
+      break;
+    }
+    if (rc == 0) {
+      timed_out = true;
+      break;
+    }
+    ssize_t n = read(read_fd, buf, sizeof(buf));
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      break;  // read error: fall through to reap with what we have
+    }
+    if (n == 0) break;  // EOF: child closed stdout (it may still run)
+    output.append(buf, static_cast<size_t>(n));
+    if (output.size() > 1 << 20) {  // runaway output guard (1 MiB)
+      overflowed = true;
+      break;
+    }
+  }
+  close(read_fd);
+
+  auto KillAndReap = [pid] {
+    // Group kill first (sh + python); direct kill as a belt-and-braces
+    // fallback should the group somehow not exist.
+    if (kill(-pid, SIGKILL) != 0) kill(pid, SIGKILL);
+    std::string how;
+    WaitExitCode(pid, &how);
+  };
+  if (timed_out) {
+    KillAndReap();
+    return Result<std::string>::Error(
+        "command timed out after " + std::to_string(timeout_s) + "s: " +
+        what);
+  }
+  if (overflowed) {
+    KillAndReap();
+    return Result<std::string>::Error(
+        "command produced more than 1 MiB of output (killed): " + what);
+  }
+
+  // EOF reached: wait for exit, still bounded by the deadline — a child
+  // that closed stdout but keeps running must not hang the daemon.
+  std::string how;
+  int code = 0;
+  if (!WaitUntil(pid, deadline, &code, &how)) {
+    KillAndReap();
+    return Result<std::string>::Error(
+        "command timed out after " + std::to_string(timeout_s) +
+        "s (stdout closed, process still running): " + what);
+  }
+  if (code != 0 && exit_code == nullptr) {
+    return Result<std::string>::Error(
+        "command failed (" + how + "): " + what + ": " +
+        output.substr(0, 512));
+  }
+  if (exit_code != nullptr) *exit_code = code;
+  return output;
+}
+
 }  // namespace
 
 Result<std::string> RunCommandCapture(const std::string& command,
@@ -149,83 +235,43 @@ Result<std::string> RunCommandCapture(const std::string& command,
   }
 
   close(fds[1]);
-  setpgid(pid, pid);  // see child comment; EACCES after exec is fine —
-                      // the child already did it itself
-  ScopedProbeSignals signal_guard(pid);
-  std::string output;
-  auto deadline = std::chrono::steady_clock::now() +
-                  std::chrono::seconds(timeout_s);
-  bool timed_out = false;
-  bool overflowed = false;
-  char buf[4096];
-  while (true) {
-    auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
-                    deadline - std::chrono::steady_clock::now())
-                    .count();
-    if (left <= 0) {
-      timed_out = true;
-      break;
-    }
-    pollfd pfd{fds[0], POLLIN, 0};
-    int rc = poll(&pfd, 1, static_cast<int>(left));
-    if (rc < 0) {
-      if (errno == EINTR) continue;
-      timed_out = true;  // treat poll failure like a hang: kill and report
-      break;
-    }
-    if (rc == 0) {
-      timed_out = true;
-      break;
-    }
-    ssize_t n = read(fds[0], buf, sizeof(buf));
-    if (n < 0) {
-      if (errno == EINTR) continue;
-      break;  // read error: fall through to reap with what we have
-    }
-    if (n == 0) break;  // EOF: child closed stdout (it may still run)
-    output.append(buf, static_cast<size_t>(n));
-    if (output.size() > 1 << 20) {  // runaway output guard (1 MiB)
-      overflowed = true;
-      break;
-    }
-  }
-  close(fds[0]);
+  // nullptr exit_code: non-zero exit is mapped to an error.
+  return CaptureChild(pid, fds[0], timeout_s, command, nullptr);
+}
 
-  auto KillAndReap = [pid] {
-    // Group kill first (sh + python); direct kill as a belt-and-braces
-    // fallback should the group somehow not exist.
-    if (kill(-pid, SIGKILL) != 0) kill(pid, SIGKILL);
-    std::string how;
-    WaitExitCode(pid, &how);
-  };
-  if (timed_out) {
-    KillAndReap();
-    return Result<std::string>::Error(
-        "command timed out after " + std::to_string(timeout_s) + "s: " +
-        command);
+Result<std::string> RunForkedCapture(const std::function<int(int fd)>& child_fn,
+                                     int timeout_s, const std::string& what,
+                                     int* exit_code) {
+  int fds[2];
+  if (pipe(fds) != 0) {
+    return Result<std::string>::Error(std::string("pipe: ") +
+                                      strerror(errno));
   }
-  if (overflowed) {
-    KillAndReap();
-    return Result<std::string>::Error(
-        "command produced more than 1 MiB of output (killed): " + command);
+  pid_t pid = fork();
+  if (pid < 0) {
+    close(fds[0]);
+    close(fds[1]);
+    return Result<std::string>::Error(std::string("fork: ") +
+                                      strerror(errno));
   }
-
-  // EOF reached: wait for exit, still bounded by the deadline — a child
-  // that closed stdout but keeps running must not hang the daemon.
-  std::string how;
+  if (pid == 0) {
+    // Child: same group/signal discipline as the exec'd variant. No exec —
+    // the point is to run parent code (a dlopen'd library's init) in a
+    // killable address space.
+    setpgid(0, 0);
+    sigset_t none;
+    sigemptyset(&none);
+    sigprocmask(SIG_SETMASK, &none, nullptr);
+    close(fds[0]);
+    _exit(child_fn(fds[1]));
+  }
+  close(fds[1]);
   int code = 0;
-  if (!WaitUntil(pid, deadline, &code, &how)) {
-    KillAndReap();
-    return Result<std::string>::Error(
-        "command timed out after " + std::to_string(timeout_s) +
-        "s (stdout closed, process still running): " + command);
-  }
-  if (code != 0) {
-    return Result<std::string>::Error(
-        "command failed (" + how + "): " + command + ": " +
-        output.substr(0, 512));
-  }
-  return output;
+  Result<std::string> out =
+      CaptureChild(pid, fds[0], timeout_s, what, &code);
+  if (!out.ok()) return out;
+  if (exit_code != nullptr) *exit_code = code;
+  return out;
 }
 
 }  // namespace tfd

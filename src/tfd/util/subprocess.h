@@ -7,6 +7,7 @@
 // JAX/PJRT world through a narrow, failure-isolated seam.
 #pragma once
 
+#include <functional>
 #include <string>
 
 #include "tfd/util/status.h"
@@ -29,5 +30,22 @@ namespace tfd {
 // outcome a kubelet SIGKILL would have produced after the grace period.
 Result<std::string> RunCommandCapture(const std::string& command,
                                       int timeout_s);
+
+// Runs `child_fn` in a forked child of this process (own process group,
+// cleared signal mask — no exec), capturing everything it writes to the
+// fd it is handed, under the same hard deadline and signal contract as
+// RunCommandCapture. The child's return value becomes its exit code
+// (delivered via `exit_code`); the child never returns into the parent's
+// control flow (_exit). Used to fence dlopen'd native-library init
+// (PJRT_Client_Create can BLOCK on a slice-wide rendezvous, not fail —
+// an in-process call would wedge the daemon forever).
+//
+// Unlike RunCommandCapture, a non-zero exit is NOT mapped to an error:
+// the caller owns the payload protocol (the PJRT probe writes a JSON
+// error document and exits 1). Errors are reserved for fork/pipe
+// failures, deadline expiry, and output overflow.
+Result<std::string> RunForkedCapture(const std::function<int(int fd)>& child_fn,
+                                     int timeout_s, const std::string& what,
+                                     int* exit_code);
 
 }  // namespace tfd

@@ -277,6 +277,130 @@ class TestMetadataBackend:
             assert labels_of(out)["google.com/tpu.count"] == "4"
 
 
+class TestPjrtInitWatchdog:
+    """The PJRT init deadline + multi-host contract (pjrt_watchdog.cc).
+
+    Real libtpu's PJRT_Client_Create can BLOCK (slice-wide rendezvous)
+    rather than fail; the daemon must bound it and degrade to the
+    metadata backend. The fake plugin's hang modes model both the wedged
+    driver (TFD_FAKE_PJRT_HANG) and the rendezvous
+    (TFD_FAKE_PJRT_MULTIHOST_HANG: blocks unless host-pinning env is
+    present)."""
+
+    def test_hung_client_create_degrades_to_metadata(self, tfd_binary):
+        """A wedged PJRT init must not stall labeling: within the
+        deadline the auto chain falls back to the metadata backend."""
+        import time
+        with FakeMetadataServer(tpu_vm(
+                accelerator_type="v5litepod-4", topology="2x2",
+                machine_type="ct5lp-hightpu-4t")) as server:
+            t0 = time.monotonic()
+            code, out, err = run_tfd(tfd_binary, [
+                "--oneshot", "--output-file=", "--backend=auto",
+                f"--libtpu-path={FAKE_PJRT}",
+                "--pjrt-init-timeout=2",
+                f"--metadata-endpoint={server.endpoint}",
+                "--machine-type-file=/dev/null",
+            ], env={"TFD_FAKE_PJRT_HANG": "1",
+                    "GCE_METADATA_HOST": server.endpoint})
+            elapsed = time.monotonic() - t0
+            assert code == 0, err
+            labels = labels_of(out)
+            assert labels["google.com/tpu.backend"] == "metadata"
+            assert labels["google.com/tpu.count"] == "4"
+            assert "timed out" in err
+            assert elapsed < 20, f"fallback took {elapsed:.1f}s"
+
+    def test_hung_client_create_fails_when_strict(self, tfd_binary):
+        code, _, err = run_tfd(tfd_binary, pjrt_args(
+            ["--pjrt-init-timeout=1"]), env={"TFD_FAKE_PJRT_HANG": "1"})
+        assert code == 1
+        assert "PJRT init did not complete" in err
+
+    def test_multihost_slice_pins_to_single_host(self, tfd_binary):
+        """BASELINE config 4 (v5p-128, worker 3) with a rendezvous-shaped
+        libtpu: client creation must be pinned to this host (no hang),
+        device facts come from PJRT, and slice-wide topology is overlaid
+        from metadata."""
+        with FakeMetadataServer(tpu_vm(
+                accelerator_type="v5p-128", topology="4x4x4",
+                chips_per_host_bounds="2,2,1", host_bounds="2,2,4",
+                worker_id=3, machine_type="ct5p-hightpu-4t")) as server:
+            code, out, err = run_tfd(tfd_binary, [
+                "--oneshot", "--output-file=", "--backend=pjrt",
+                f"--libtpu-path={FAKE_PJRT}",
+                "--pjrt-init-timeout=10", "--slice-strategy=single",
+                f"--metadata-endpoint={server.endpoint}",
+                "--machine-type-file=/dev/null",
+            ], env={
+                "TFD_FAKE_PJRT_MULTIHOST_HANG": "1",
+                "TFD_FAKE_PJRT_KIND": "TPU v5p",
+                "TFD_FAKE_PJRT_BOUNDS": "4,4,4",
+                "TFD_FAKE_PJRT_HOSTS": "16",
+                "TFD_FAKE_PJRT_PROC": "3",
+                "TFD_FAKE_PJRT_HBM_GIB": "95",
+                "GCE_METADATA_HOST": server.endpoint,
+            })
+            assert code == 0, err
+            labels = labels_of(out)
+            # Device facts from PJRT (the pinned local client).
+            assert labels["google.com/tpu.backend"] == "pjrt"
+            assert labels["google.com/tpu.count"] == "4"
+            assert labels["google.com/tpu.memory"] == "97280"
+            assert labels["google.com/libtpu.version.major"] == "9"
+            # Slice-wide topology from the metadata overlay.
+            assert labels["google.com/tpu.accelerator-type"] == "v5p-128"
+            assert labels["google.com/tpu.slice.hosts"] == "16"
+            assert labels["google.com/tpu.slice.worker-id"] == "3"
+            assert labels["google.com/tpu.topology"] == "4x4x4"
+            assert labels["google.com/tpu.ici.wrap"] == "true"
+
+    def test_multihost_optin_attempts_whole_slice(self, tfd_binary):
+        """--pjrt-multihost skips pinning: the rendezvous-shaped fake then
+        hangs (peers never arrive), the watchdog kills it, and auto falls
+        back to metadata — documenting that the opt-in requires every
+        worker to initialize together."""
+        with FakeMetadataServer(tpu_vm(
+                accelerator_type="v5p-128", topology="4x4x4",
+                chips_per_host_bounds="2,2,1", host_bounds="2,2,4",
+                worker_id=3, machine_type="ct5p-hightpu-4t")) as server:
+            code, out, err = run_tfd(tfd_binary, [
+                "--oneshot", "--output-file=", "--backend=auto",
+                f"--libtpu-path={FAKE_PJRT}",
+                "--slice-strategy=single",
+                "--pjrt-init-timeout=2", "--pjrt-multihost",
+                f"--metadata-endpoint={server.endpoint}",
+                "--machine-type-file=/dev/null",
+            ], env={
+                "TFD_FAKE_PJRT_MULTIHOST_HANG": "1",
+                "TFD_FAKE_PJRT_KIND": "TPU v5p",
+                "TFD_FAKE_PJRT_BOUNDS": "4,4,4",
+                "TFD_FAKE_PJRT_HOSTS": "16",
+                "GCE_METADATA_HOST": server.endpoint,
+            })
+            assert code == 0, err
+            labels = labels_of(out)
+            assert labels["google.com/tpu.backend"] == "metadata"
+            assert labels["google.com/tpu.slice.worker-id"] == "3"
+
+    def test_single_host_no_pinning_no_metadata_needed(self, tfd_binary):
+        """A single-host slice must initialize whole (no pinning env), so
+        the full topology still comes from PJRT itself even with the
+        watchdog in the path and no metadata server at all."""
+        code, out, err = run_tfd(tfd_binary, pjrt_args(
+            ["--pjrt-init-timeout=10"]), env={
+                "TFD_FAKE_PJRT_KIND": "TPU v6e",
+                "TFD_FAKE_PJRT_BOUNDS": "2,4,1",
+                "TFD_FAKE_PJRT_HBM_GIB": "32",
+            })
+        assert code == 0, err
+        labels = labels_of(out)
+        assert labels["google.com/tpu.count"] == "8"
+        assert labels["google.com/tpu.product"] == "tpu-v6e"
+        assert labels["google.com/tpu.topology"] == "2x4"
+        assert labels["google.com/tpu.backend"] == "pjrt"
+
+
 def _real_libtpu_path():
     try:
         import libtpu  # noqa: PLC0415 — optional, probed at test time

@@ -155,6 +155,13 @@ def test_bench_json_contract():
     assert record["value"] > 0
     assert record["vs_baseline"] > 0
     assert "tpu_matmul_tflops" not in record  # probe explicitly skipped
+    # Per-backend p50s: mock + the two hermetically-drivable real code
+    # paths must carry numbers; pjrt_real may honestly be null (no chip).
+    p50s = record["p50_ms"]
+    assert p50s["mock"] == record["value"]
+    assert p50s["metadata"] > 0
+    assert p50s["pjrt"] > 0
+    assert "pjrt_real" in p50s
 
 
 def test_cli_burnin(cpu_jax, capsys):

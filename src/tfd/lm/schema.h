@@ -56,6 +56,15 @@ inline constexpr char kTpuVmPresent[] = "google.com/tpu-vm.present";
 inline constexpr char kTpuVmPreemptible[] = "google.com/tpu-vm.preemptible";
 inline constexpr char kTpuVmSpot[] = "google.com/tpu-vm.spot";
 inline constexpr char kTpuVmZone[] = "google.com/tpu-vm.zone";
+// TPU runtime/agent versions from the control plane (tpu-env) — the
+// vgpu.host-driver-version / host-driver-branch analogue (reference
+// internal/lm/vgpu.go:51-52, sourced hypervisor-side in
+// internal/vgpu/vgpu.go:108-153): version labels that survive on a node
+// whose chips are busy (no PJRT client, so no libtpu.version.* labels).
+inline constexpr char kTpuVmRuntimeVersion[] =
+    "google.com/tpu-vm.runtime-version";
+inline constexpr char kTpuVmAgentVersion[] =
+    "google.com/tpu-vm.agent-version";
 inline constexpr char kMultislicePresent[] =
     "google.com/tpu.multislice.present";
 inline constexpr char kMultisliceSliceId[] =

@@ -53,6 +53,31 @@ class TpuVmLabeler : public Labeler {
       };
       slice_id = get("MEGASCALE_SLICE_ID");
       num_slices = get("MEGASCALE_NUM_SLICES");
+
+      // Runtime/agent versions (the vgpu.host-driver-version/branch
+      // analogue, reference internal/lm/vgpu.go:51-52): control-plane
+      // version facts that survive when the chips are held by a training
+      // job and the PJRT-side libtpu.version.* labels are unavailable.
+      // Absent-not-empty: StrictLabelValue can trim a garbage value
+      // ("---") to "", and an empty-valued version label would read as
+      // "version known to be empty" rather than "unknown".
+      std::string runtime_version =
+          StrictLabelValue(TrimSpace(get("RUNTIME_VERSION")));
+      if (!runtime_version.empty()) {
+        labels[kTpuVmRuntimeVersion] = runtime_version;
+      }
+      // AGENT_BOOTSTRAP_IMAGE is an image ref ("gcr.io/.../agent:TAG");
+      // the tag is the agent version. A ':' before the last '/' is a
+      // registry port, not a tag.
+      std::string agent_image = TrimSpace(get("AGENT_BOOTSTRAP_IMAGE"));
+      size_t colon = agent_image.rfind(':');
+      size_t slash = agent_image.rfind('/');
+      if (colon != std::string::npos &&
+          (slash == std::string::npos || colon > slash) &&
+          colon + 1 < agent_image.size()) {
+        std::string tag = StrictLabelValue(agent_image.substr(colon + 1));
+        if (!tag.empty()) labels[kTpuVmAgentVersion] = tag;
+      }
     }
     if (slice_id.empty()) {
       if (const char* v = std::getenv("MEGASCALE_SLICE_ID")) slice_id = v;

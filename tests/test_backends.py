@@ -124,8 +124,31 @@ class TestMetadataBackend:
             assert labels["google.com/tpu.slice.shape"] == "4x4x4"
             assert labels["google.com/tpu.ici.wrap"] == "true"
             assert labels["google.com/tpu.backend"] == "metadata"
-            # Versions are unknown to the metadata backend.
+            # libtpu versions are unknown to the metadata backend, but the
+            # control-plane runtime/agent versions survive (the
+            # vgpu.host-driver-version analogue on a chips-busy node).
             assert "google.com/libtpu.version.major" not in labels
+            assert (labels["google.com/tpu-vm.runtime-version"]
+                    == "tpu-ubuntu2204-base")
+            assert labels["google.com/tpu-vm.agent-version"] == "cl_20240321"
+
+    def test_runtime_version_labels_omitted_when_absent(self, tfd_binary):
+        """tpu-env without RUNTIME_VERSION/AGENT_BOOTSTRAP_IMAGE (older
+        agents): the version labels must be absent, not empty. An image
+        ref without a tag must also not produce an agent-version label."""
+        with FakeMetadataServer(tpu_vm(
+                runtime_version=None,
+                agent_bootstrap_image="gcr.io:5000/img/agent")) as server:
+            code, out, err = run_tfd(tfd_binary, [
+                "--oneshot", "--output-file=", "--backend=metadata",
+                f"--metadata-endpoint={server.endpoint}",
+                "--machine-type-file=/dev/null",
+            ], env={"GCE_METADATA_HOST": server.endpoint})
+            assert code == 0, err
+            labels = labels_of(out)
+            assert "google.com/tpu-vm.runtime-version" not in labels
+            # ":5000" is a registry port, not a tag.
+            assert "google.com/tpu-vm.agent-version" not in labels
 
     def test_v5p_128_worker_id_fallback_agent_number(self, tfd_binary):
         """North-star case: tpu-env lacks WORKER_ID (some TPU runtime

@@ -22,7 +22,10 @@ def tpu_vm(accelerator_type="v5litepod-4", topology=None, worker_id=0,
            machine_type="ct5lp-hightpu-4t", preemptible=False,
            spot=False, zone="us-central2-b", megascale_slice_id=None,
            megascale_num_slices=None, instance_id="1234567890",
-           extra_attributes=None, include_worker_id=True, hostname=None):
+           extra_attributes=None, include_worker_id=True, hostname=None,
+           runtime_version="tpu-ubuntu2204-base",
+           agent_bootstrap_image=(
+               "gcr.io/cloud-tpu-v2-images/grpc_tpu_worker:cl_20240321")):
     """Builds the metadata key->value dict for a TPU VM.
 
     Keys mirror real TPU-VM metadata: instance/machine-type,
@@ -31,6 +34,11 @@ def tpu_vm(accelerator_type="v5litepod-4", topology=None, worker_id=0,
     WORKER_ID entries (values single-quoted, as the real agent writes them).
     """
     tpu_env_lines = [f"ACCELERATOR_TYPE: '{accelerator_type}'"]
+    if runtime_version:
+        tpu_env_lines.append(f"RUNTIME_VERSION: '{runtime_version}'")
+    if agent_bootstrap_image:
+        tpu_env_lines.append(
+            f"AGENT_BOOTSTRAP_IMAGE: '{agent_bootstrap_image}'")
     if topology:
         tpu_env_lines.append(f"TOPOLOGY: '{topology}'")
     if chips_per_host_bounds:

@@ -1,9 +1,18 @@
 # Convenience wrapper (reference has a Makefile driving go build/test;
 # here CMake+Ninja drive the C++ build and pytest drives the test tiers).
+# Release targets mirror the reference's versions.mk/Makefile flow: one
+# pinned VERSION, image + chart artifacts derived from it (RELEASE.md).
 
 BUILD_DIR ?= build
+VERSION := $(shell cat VERSION)
+BARE_VERSION := $(VERSION:v%=%)
+IMAGE ?= tpu-feature-discovery
+# Helm repo URL baked into docs/index.yaml (gh-pages style, reference
+# docs/index.yaml) — override for a fork.
+HELM_REPO_URL ?= https://example.com/tpu-feature-discovery/charts
 
-.PHONY: all build test unit-test check bench clean
+.PHONY: all build test unit-test check bench clean \
+        set-version check-release image helm-package
 
 all: build
 
@@ -21,4 +30,32 @@ bench: build
 	python bench.py
 
 clean:
-	rm -rf $(BUILD_DIR)
+	rm -rf $(BUILD_DIR) dist
+
+# --- release flow (see RELEASE.md) ---------------------------------------
+
+# One-line version bump: rewrites every versioned artifact.
+#   make set-version NEW_VERSION=v0.2.0
+set-version:
+	sh scripts/set-version.sh $(NEW_VERSION)
+
+# Asserts no artifact drifted from the pinned VERSION.
+check-release:
+	sh tests/check-yamls.sh $(VERSION)
+
+# Container image at the release tag (multi-arch in CI via buildx).
+image:
+	docker build -f deployments/container/Dockerfile \
+	  --build-arg VERSION=$(VERSION) -t $(IMAGE):$(VERSION) .
+
+# Helm chart package + repo index (the reference's gh-pages
+# docs/index.yaml flow). Requires helm; writes dist/*.tgz and refreshes
+# docs/index.yaml so pushing docs/ publishes the repo.
+helm-package:
+	mkdir -p dist
+	helm package deployments/helm/tpu-feature-discovery -d dist \
+	  --version $(BARE_VERSION) --app-version $(BARE_VERSION)
+	helm repo index dist --url $(HELM_REPO_URL) \
+	  $(shell [ -f docs/index.yaml ] && echo --merge docs/index.yaml)
+	mkdir -p docs
+	cp dist/index.yaml docs/index.yaml

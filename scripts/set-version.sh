@@ -1,0 +1,42 @@
+#!/bin/sh
+# One-line version bump: rewrites EVERY versioned artifact from the new
+# value so nothing can drift (the versions.mk role in the reference,
+# versions.mk:17-22, where a single VERSION feeds the Makefile, CI, and
+# image tags). Artifacts touched:
+#   VERSION                                  (the pinned source)
+#   deployments/static/*.yaml(.template)     (image tags)
+#   deployments/helm/.../Chart.yaml          (version + appVersion)
+#   .github/workflows/ci.yml                 (container build arg)
+# tests/check-yamls.sh verifies the result; test_deployments.py runs both
+# against a scratch copy so the bump flow itself is under test.
+#
+# Usage: set-version.sh vX.Y.Z [ROOT]
+set -e
+
+NEW=$1
+ROOT=${2:-$(dirname "$0")/..}
+case "$NEW" in
+  v[0-9]*) ;;
+  *) echo "Usage: $0 vX.Y.Z [ROOT]" >&2; exit 1 ;;
+esac
+BARE=${NEW#v}
+
+echo "$NEW" > "$ROOT/VERSION"
+
+for f in "$ROOT"/deployments/static/*.yaml \
+         "$ROOT"/deployments/static/*.yaml.template; do
+  [ -f "$f" ] || continue
+  sed -i "s|tpu-feature-discovery:v[0-9][0-9a-zA-Z.+-]*|tpu-feature-discovery:${NEW}|g" "$f"
+done
+
+# Top-level version/appVersion only: the NFD subchart pin under
+# dependencies: is indented and must not be touched.
+CHART="$ROOT/deployments/helm/tpu-feature-discovery/Chart.yaml"
+sed -i "s|^version: \".*\"|version: \"${BARE}\"|; s|^appVersion: \".*\"|appVersion: \"${BARE}\"|" "$CHART"
+
+CI="$ROOT/.github/workflows/ci.yml"
+if [ -f "$CI" ]; then
+  sed -i "s|--build-arg VERSION=v[0-9][0-9a-zA-Z.+-]*|--build-arg VERSION=${NEW}|g" "$CI"
+fi
+
+echo "version set to ${NEW}"

@@ -68,8 +68,11 @@ class TpuVmLabeler : public Labeler {
       }
       // AGENT_BOOTSTRAP_IMAGE is an image ref ("gcr.io/.../agent:TAG");
       // the tag is the agent version. A ':' before the last '/' is a
-      // registry port, not a tag.
+      // registry port, not a tag; an OCI digest suffix ("@sha256:...")
+      // is not a version — drop it (keeping any tag before it).
       std::string agent_image = TrimSpace(get("AGENT_BOOTSTRAP_IMAGE"));
+      size_t at = agent_image.find('@');
+      if (at != std::string::npos) agent_image = agent_image.substr(0, at);
       size_t colon = agent_image.rfind(':');
       size_t slash = agent_image.rfind('/');
       if (colon != std::string::npos &&

@@ -1,13 +1,19 @@
 #!/bin/sh
 # Assert deployment YAML image tags and chart versions match the release
-# version (reference tests/check-yamls.sh).
+# version (reference tests/check-yamls.sh). With no argument, the pinned
+# VERSION file is the expected version — so `sh tests/check-yamls.sh`
+# proves no artifact drifted from the single source.
 
-if [ "$#" -lt 1 ]; then
-  echo "Usage: $0 VERSION (e.g. v0.1.0)" && exit 1
+DIR=$(dirname "$0")/..
+VERSION=${1:-$(cat "$DIR/VERSION")}
+if [ -z "$VERSION" ]; then
+  echo "Usage: $0 [VERSION]  (default: the VERSION file)" && exit 1
 fi
 
-VERSION=$1
-DIR=$(dirname "$0")/..
+if [ "$(cat "$DIR/VERSION")" != "$VERSION" ]; then
+  echo "VERSION file ($(cat "$DIR/VERSION")) does not match ${VERSION}"
+  exit 1
+fi
 YAML_FILES="
 $DIR/deployments/static/tpu-feature-discovery-daemonset.yaml
 $DIR/deployments/static/tpu-feature-discovery-daemonset-with-slice-single.yaml

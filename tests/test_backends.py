@@ -150,6 +150,25 @@ class TestMetadataBackend:
             # ":5000" is a registry port, not a tag.
             assert "google.com/tpu-vm.agent-version" not in labels
 
+    def test_agent_version_from_digest_pinned_image(self, tfd_binary):
+        """A digest-pinned ref keeps the tag before '@' as the version; a
+        pure-digest ref yields no version label (a sha256 is not one)."""
+        cases = [
+            ("gcr.io/img/agent:cl_777@sha256:" + "a" * 64, "cl_777"),
+            ("gcr.io/img/agent@sha256:" + "a" * 64, None),
+        ]
+        for image, want in cases:
+            with FakeMetadataServer(
+                    tpu_vm(agent_bootstrap_image=image)) as server:
+                code, out, err = run_tfd(tfd_binary, [
+                    "--oneshot", "--output-file=", "--backend=metadata",
+                    f"--metadata-endpoint={server.endpoint}",
+                    "--machine-type-file=/dev/null",
+                ], env={"GCE_METADATA_HOST": server.endpoint})
+                assert code == 0, err
+                got = labels_of(out).get("google.com/tpu-vm.agent-version")
+                assert got == want, (image, got)
+
     def test_v5p_128_worker_id_fallback_agent_number(self, tfd_binary):
         """North-star case: tpu-env lacks WORKER_ID (some TPU runtime
         agents rewrite it) on the metadata-only path — worker id must come

@@ -121,6 +121,65 @@ class TestHelmChart:
         assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
+class TestReleaseMachinery:
+    """The VERSION file is the single pinned source (RELEASE.md; the
+    reference's versions.mk:17-22 role): every artifact must agree with
+    it, and the one-line bump flow must rewrite them all."""
+
+    def test_version_pinned_single_source(self, tfd_binary):
+        version = (REPO / "VERSION").read_text().strip()
+        assert re.fullmatch(r"v\d+\.\d+\.\d+", version), version
+        # Binary (CMake reads VERSION at configure; dev suffix allowed).
+        assert binary_version(tfd_binary).split("-")[0] == version
+        # Chart version + appVersion.
+        chart = yaml.safe_load((HELM / "Chart.yaml").read_text())
+        assert chart["version"] == version[1:]
+        assert chart["appVersion"] == version[1:]
+        # Static YAML image tags + everything else: the checker with no
+        # argument validates against the VERSION file itself.
+        proc = subprocess.run(
+            ["sh", str(REPO / "tests" / "check-yamls.sh")],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        # CI builds the container at the pinned version.
+        ci = (REPO / ".github" / "workflows" / "ci.yml").read_text()
+        assert f"--build-arg VERSION={version}" in ci
+
+    def test_set_version_bump_rewrites_every_artifact(self, tmp_path):
+        """scripts/set-version.sh against a scratch copy: one command must
+        move every artifact to the new version and keep the NFD subchart
+        pin untouched; the checker must then pass at the new version."""
+        import shutil
+
+        for rel in ("VERSION", "deployments", "tests/check-yamls.sh",
+                    ".github/workflows/ci.yml"):
+            src = REPO / rel
+            dst = tmp_path / rel
+            dst.parent.mkdir(parents=True, exist_ok=True)
+            if src.is_dir():
+                shutil.copytree(src, dst)
+            else:
+                shutil.copy(src, dst)
+        proc = subprocess.run(
+            ["sh", str(REPO / "scripts" / "set-version.sh"), "v9.9.9",
+             str(tmp_path)], capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert (tmp_path / "VERSION").read_text().strip() == "v9.9.9"
+        chart = yaml.safe_load(
+            (tmp_path / "deployments/helm/tpu-feature-discovery/"
+             "Chart.yaml").read_text())
+        assert chart["version"] == "9.9.9"
+        assert chart["appVersion"] == "9.9.9"
+        # The NFD subchart dependency pin must not be rewritten.
+        assert chart["dependencies"][0]["version"] != "9.9.9"
+        proc = subprocess.run(
+            ["sh", str(tmp_path / "tests" / "check-yamls.sh"), "v9.9.9"],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        # The real repo is untouched.
+        assert (REPO / "VERSION").read_text().strip() != "v9.9.9"
+
+
 class TestTier34Drivers:
     def test_integration_driver(self, tfd_binary):
         proc = subprocess.run(

@@ -8,6 +8,8 @@
 // metadata server: accelerator-type + tpu-env give the chip count, family,
 // topology, and worker index. Versions are unknown here, exactly as the
 // CUDA backend reports "unknown.unknown.unknown" (cuda-lib.go:68-70).
+#include <cstdlib>
+
 #include "tfd/gce/metadata.h"
 #include "tfd/resource/factory.h"
 #include "tfd/slice/topology.h"
@@ -59,9 +61,16 @@ class MetadataManager : public Manager {
   Status Init() override {
     Result<std::string> accel_type = client_.AcceleratorType();
     if (!accel_type.ok() || accel_type->empty()) {
+      // GKE TPU node pools (BASELINE config 5's substrate) don't carry
+      // the Cloud-TPU-VM attributes (accelerator-type / tpu-env); their
+      // TPU identity is in the ct* machine type and the kube-labels
+      // attribute instead. Try that surface before giving up.
+      Status gke = GkeInit();
+      if (gke.ok()) return gke;
       return Status::Error(
-          "no TPU accelerator-type in instance metadata (endpoint " +
-          client_.endpoint() + ")");
+          "no TPU accelerator-type in instance metadata and no GKE TPU "
+          "machine type (endpoint " + client_.endpoint() + "): " +
+          gke.message());
     }
     Result<slice::AcceleratorType> parsed =
         slice::ParseAcceleratorType(*accel_type);
@@ -99,13 +108,39 @@ class MetadataManager : public Manager {
     }
     topology_.chips_per_host = local_chips;
 
-    // Worker-id fallbacks when tpu-env lacks WORKER_ID (seen on nodes
-    // where the TPU runtime agent rewrote tpu-env, and on GKE): the
-    // agent-worker-number attribute, then the "-w-<N>" hostname suffix
-    // GCE gives every multi-host TPU-VM worker. Without this the
-    // byte-for-byte v5p-128 golden (slice.worker-id) could not match on
-    // the metadata-only path — the exact fallback used when a training
-    // job holds the chips and PJRT init fails.
+    FillWorkerIdFallbacks();
+
+    if (topology_.topology.empty()) {
+      Result<slice::Shape> shape =
+          slice::DefaultTopology(accel_.spec, accel_.num_chips);
+      if (shape.ok()) topology_.topology = shape->ToString();
+    }
+    // ICI wraparound from the ACTUAL slice shape (tpu-env TOPOLOGY may be
+    // a custom non-default layout), per the published cube/full-pod rule
+    // (slice::ComputeIciWrap). Unknown shape → no wrap claimed.
+    topology_.has_wraparound = false;
+    if (!topology_.topology.empty()) {
+      Result<slice::Shape> shape = slice::ParseShape(topology_.topology);
+      if (shape.ok()) {
+        topology_.has_wraparound =
+            slice::ComputeIciWrap(accel_.spec, *shape).all;
+      }
+    }
+
+    for (int i = 0; i < local_chips; i++) {
+      devices_.push_back(std::make_shared<MetadataDevice>(accel_.spec));
+    }
+    return Status::Ok();
+  }
+
+  // Worker-id fallback ladder, shared by the Cloud-TPU-VM and GKE paths:
+  // the agent-worker-number attribute (seen on nodes where the TPU
+  // runtime agent rewrote tpu-env, and on GKE), then the "-w-<N>"
+  // hostname suffix GCE gives every multi-host TPU-VM worker. Without
+  // this the byte-for-byte v5p-128 golden (slice.worker-id) could not
+  // match on the metadata-only path — the exact fallback used when a
+  // training job holds the chips and PJRT init fails.
+  void FillWorkerIdFallbacks() {
     if (topology_.worker_id < 0) {
       Result<std::string> agent_number =
           client_.Get("instance/attributes/agent-worker-number");
@@ -132,27 +167,90 @@ class MetadataManager : public Manager {
         }
       }
     }
+  }
 
-    if (topology_.topology.empty()) {
-      Result<slice::Shape> shape =
-          slice::DefaultTopology(accel_.spec, accel_.num_chips);
-      if (shape.ok()) topology_.topology = shape->ToString();
+  // The GKE lookup ladder (GKE docs "TPUs in GKE"; no Cloud-TPU-VM
+  // attributes exist on these nodes):
+  //   chips + family   <- the ct* machine type (ct5lp-hightpu-4t = v5e,
+  //                       4 chips on this host)
+  //   slice topology   <- cloud.google.com/gke-tpu-topology node label,
+  //                       surfaced through the kube-labels attribute
+  //   family crosscheck<- cloud.google.com/gke-tpu-accelerator label
+  //   worker id        <- TPU_WORKER_ID env (the GKE TPU webhook injects
+  //                       it into TPU-requesting pods; present only when
+  //                       the operator wires it through)
+  // The GCE accelerator-type string ("v5litepod-16") does not exist on
+  // GKE, so the tpu.accelerator-type label is honestly absent here.
+  Status GkeInit() {
+    Result<std::string> machine_type = client_.MachineType();
+    if (!machine_type.ok()) {
+      return Status::Error("no machine type: " + machine_type.error());
     }
-    // ICI wraparound from the ACTUAL slice shape (tpu-env TOPOLOGY may be
-    // a custom non-default layout), per the published cube/full-pod rule
-    // (slice::ComputeIciWrap). Unknown shape → no wrap claimed.
-    topology_.has_wraparound = false;
-    if (!topology_.topology.empty()) {
-      Result<slice::Shape> shape = slice::ParseShape(topology_.topology);
-      if (shape.ok()) {
-        topology_.has_wraparound =
-            slice::ComputeIciWrap(accel_.spec, *shape).all;
+    Result<slice::GkeMachineType> parsed =
+        slice::ParseGkeMachineType(*machine_type);
+    if (!parsed.ok()) return Status::Error(parsed.error());
+    slice::FamilySpec spec = parsed->spec;
+    int local_chips = parsed->chips_per_host;
+
+    std::map<std::string, std::string> kube_labels;
+    Result<std::string> raw = client_.Get("instance/attributes/kube-labels");
+    if (raw.ok()) {
+      // kube-labels is "k1=v1,k2=v2,..." (the node labels configured on
+      // the node pool).
+      for (const std::string& pair : SplitString(TrimSpace(*raw), ',')) {
+        size_t eq = pair.find('=');
+        if (eq == std::string::npos) continue;
+        kube_labels[TrimSpace(pair.substr(0, eq))] =
+            TrimSpace(pair.substr(eq + 1));
+      }
+    }
+    auto label = [&kube_labels](const char* key) -> std::string {
+      auto it = kube_labels.find(key);
+      return it == kube_labels.end() ? "" : it->second;
+    };
+    std::string accel = label("cloud.google.com/gke-tpu-accelerator");
+    if (!accel.empty()) {
+      Result<slice::FamilySpec> from_label =
+          slice::FamilyFromGkeAccelerator(accel);
+      if (from_label.ok() && from_label->family != spec.family) {
+        TFD_LOG_WARNING << "gke-tpu-accelerator label (" << accel
+                        << ") disagrees with machine type ("
+                        << *machine_type << "); trusting the machine type";
       }
     }
 
-    for (int i = 0; i < local_chips; i++) {
-      devices_.push_back(std::make_shared<MetadataDevice>(accel_.spec));
+    topology_.chips_per_host = local_chips;
+    topology_.num_hosts = 1;
+    std::string topo = label("cloud.google.com/gke-tpu-topology");
+    if (!topo.empty()) {
+      Result<slice::Shape> shape = slice::ParseShape(ToLower(topo));
+      if (shape.ok()) {
+        topology_.topology = shape->ToString();
+        int slice_chips = shape->NumChips();
+        if (local_chips > 0 && slice_chips >= local_chips) {
+          topology_.num_hosts = slice_chips / local_chips;
+        }
+        topology_.has_wraparound = slice::ComputeIciWrap(spec, *shape).all;
+      }
     }
+    const char* worker = std::getenv("TPU_WORKER_ID");
+    int worker_id = 0;
+    if (worker != nullptr && ParseNonNegInt(TrimSpace(worker), &worker_id)) {
+      topology_.worker_id = worker_id;
+    }
+    // Same metadata-side ladder as the Cloud-TPU-VM path: the TPU
+    // runtime agent publishes agent-worker-number on GKE nodes too.
+    FillWorkerIdFallbacks();
+
+    for (int i = 0; i < local_chips; i++) {
+      devices_.push_back(std::make_shared<MetadataDevice>(spec));
+    }
+    TFD_LOG_INFO << "GKE TPU node: " << *machine_type << " ("
+                 << spec.product << " x" << local_chips
+                 << (topology_.topology.empty()
+                         ? std::string(", slice topology unknown")
+                         : ", slice " + topology_.topology)
+                 << ")";
     return Status::Ok();
   }
 

@@ -113,6 +113,63 @@ Result<AcceleratorType> ParseAcceleratorType(const std::string& text) {
   return out;
 }
 
+Result<GkeMachineType> ParseGkeMachineType(const std::string& machine_type) {
+  // "ct<code>-<tier>-<N>t": ct5lp-hightpu-4t, ct6e-standard-8t, ...
+  // (GKE docs "TPUs in GKE", machine-type table). The family code sits
+  // between "ct" and the first '-'; the trailing "<N>t" is the number of
+  // TPU chips attached to the host.
+  std::string s = ToLower(TrimSpace(machine_type));
+  if (!HasPrefix(s, "ct")) {
+    return Result<GkeMachineType>::Error(
+        "not a GKE TPU machine type: '" + machine_type + "'");
+  }
+  size_t dash = s.find('-');
+  size_t last_dash = s.rfind('-');
+  if (dash == std::string::npos || last_dash == dash ||
+      s.back() != 't' || last_dash + 2 > s.size() - 1) {
+    return Result<GkeMachineType>::Error(
+        "unrecognized GKE TPU machine type '" + machine_type + "'");
+  }
+  std::string code = s.substr(2, dash - 2);
+  std::string family;
+  if (code == "4p") family = "v4";
+  else if (code == "5lp" || code == "5l") family = "v5e";
+  else if (code == "5p") family = "v5p";
+  else if (code == "6e") family = "v6e";
+  else {
+    return Result<GkeMachineType>::Error(
+        "unrecognized GKE TPU machine family code '" + code + "' in '" +
+        machine_type + "'");
+  }
+  int chips = 0;
+  if (!ParseNonNegInt(s.substr(last_dash + 1, s.size() - last_dash - 2),
+                      &chips) ||
+      chips < 1) {
+    return Result<GkeMachineType>::Error(
+        "unrecognized chip count in GKE TPU machine type '" + machine_type +
+        "'");
+  }
+  Result<FamilySpec> spec = LookupFamily(family);
+  if (!spec.ok()) return Result<GkeMachineType>::Error(spec.error());
+  GkeMachineType out;
+  out.spec = *spec;
+  out.chips_per_host = chips;
+  return out;
+}
+
+Result<FamilySpec> FamilyFromGkeAccelerator(const std::string& value) {
+  // cloud.google.com/gke-tpu-accelerator node-label values (GKE docs).
+  std::string v = ToLower(TrimSpace(value));
+  if (v == "tpu-v4-podslice") return LookupFamily("v4");
+  if (v == "tpu-v5-lite-podslice" || v == "tpu-v5-lite-device") {
+    return LookupFamily("v5e");
+  }
+  if (v == "tpu-v5p-slice") return LookupFamily("v5p");
+  if (v == "tpu-v6e-slice") return LookupFamily("v6e");
+  return Result<FamilySpec>::Error(
+      "unrecognized gke-tpu-accelerator value '" + value + "'");
+}
+
 Result<Shape> DefaultTopology(const FamilySpec& family, int num_chips) {
   if (num_chips < 1) {
     return Result<Shape>::Error("invalid chip count " +

@@ -49,6 +49,23 @@ Result<FamilySpec> FamilyFromDeviceKind(const std::string& kind);
 // "v5p-128", "v6e-8".
 Result<AcceleratorType> ParseAcceleratorType(const std::string& text);
 
+// GKE TPU node pools don't carry the Cloud-TPU-VM metadata attributes
+// (accelerator-type / tpu-env); their TPU identity lives in the published
+// GKE surface instead (GKE docs "TPUs in GKE" machine-type and node-label
+// tables):
+//   - machine type: ct4p-hightpu-4t, ct5lp-hightpu-{1,4,8}t,
+//     ct5l-hightpu-{1,4,8}t, ct5p-hightpu-4t, ct6e-standard-{1,4,8}t —
+//     family code + local chip count ("-4t" = 4 TPU chips on the host)
+//   - node label cloud.google.com/gke-tpu-accelerator: tpu-v4-podslice,
+//     tpu-v5-lite-podslice, tpu-v5-lite-device, tpu-v5p-slice,
+//     tpu-v6e-slice
+struct GkeMachineType {
+  FamilySpec spec;
+  int chips_per_host = 0;
+};
+Result<GkeMachineType> ParseGkeMachineType(const std::string& machine_type);
+Result<FamilySpec> FamilyFromGkeAccelerator(const std::string& value);
+
 // Default slice topology for `num_chips` chips of `family`, matching the
 // shapes Google publishes for each slice size (e.g. v5litepod-16 → 4x4,
 // v4-16 → 2x2x2). Errors when the chip count has no standard shape.

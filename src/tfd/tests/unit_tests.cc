@@ -582,6 +582,42 @@ void TestJsonNonFiniteSerialization() {
   CHECK_EQ(jsonlite::Serialize(*value), "42");
 }
 
+void TestGkeIdentity() {
+  // The published GKE machine-type table (GKE docs "TPUs in GKE").
+  struct Case {
+    const char* machine;
+    const char* family;
+    int chips;
+  };
+  const Case cases[] = {
+      {"ct4p-hightpu-4t", "v4", 4},    {"ct5lp-hightpu-1t", "v5e", 1},
+      {"ct5lp-hightpu-4t", "v5e", 4},  {"ct5lp-hightpu-8t", "v5e", 8},
+      {"ct5l-hightpu-8t", "v5e", 8},   {"ct5p-hightpu-4t", "v5p", 4},
+      {"ct6e-standard-1t", "v6e", 1},  {"ct6e-standard-4t", "v6e", 4},
+      {"ct6e-standard-8t", "v6e", 8},
+  };
+  for (const Case& c : cases) {
+    Result<slice::GkeMachineType> parsed =
+        slice::ParseGkeMachineType(c.machine);
+    CHECK_TRUE(parsed.ok());
+    CHECK_EQ(parsed->spec.family, c.family);
+    CHECK_EQ(parsed->chips_per_host, c.chips);
+  }
+  CHECK_TRUE(!slice::ParseGkeMachineType("n2-standard-8").ok());
+  CHECK_TRUE(!slice::ParseGkeMachineType("ct9z-hightpu-4t").ok());
+  CHECK_TRUE(!slice::ParseGkeMachineType("ct5lp-hightpu-4x").ok());
+  CHECK_TRUE(!slice::ParseGkeMachineType("ct5lp").ok());
+
+  CHECK_EQ(slice::FamilyFromGkeAccelerator("tpu-v4-podslice")->family, "v4");
+  CHECK_EQ(slice::FamilyFromGkeAccelerator("tpu-v5-lite-podslice")->family,
+           "v5e");
+  CHECK_EQ(slice::FamilyFromGkeAccelerator("tpu-v5-lite-device")->family,
+           "v5e");
+  CHECK_EQ(slice::FamilyFromGkeAccelerator("tpu-v5p-slice")->family, "v5p");
+  CHECK_EQ(slice::FamilyFromGkeAccelerator("tpu-v6e-slice")->family, "v6e");
+  CHECK_TRUE(!slice::FamilyFromGkeAccelerator("nvidia-tesla-t4").ok());
+}
+
 void TestForkedCapture() {
   // Normal path: output + exit code transported, no error mapping.
   int code = -1;
@@ -644,6 +680,7 @@ int main() {
   tfd::TestAtomicWrite();
   tfd::TestUrlParsing();
   tfd::TestJsonNonFiniteSerialization();
+  tfd::TestGkeIdentity();
   tfd::TestForkedCapture();
 
   std::cerr << tfd::g_checks << " checks, " << tfd::g_failures << " failures"

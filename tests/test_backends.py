@@ -19,7 +19,7 @@ from conftest import BUILD_DIR, GOLDEN, REPO, check_golden, run_tfd, labels_of
 sys.path.insert(0, str(REPO))
 
 from tpufd.fakes.metadata_server import (  # noqa: E402
-    FakeMetadataServer, cpu_vm, tpu_vm)
+    FakeMetadataServer, cpu_vm, gke_tpu_node, tpu_vm)
 
 FAKE_PJRT = BUILD_DIR / "libtfd_fake_pjrt.so"
 
@@ -317,6 +317,85 @@ class TestMetadataBackend:
             assert code == 0, err
             assert "tpu.health" not in out
             assert labels_of(out)["google.com/tpu.count"] == "4"
+
+
+class TestGkeMetadata:
+    """GKE TPU node pools (metadata_manager.cc GkeInit): no Cloud-TPU-VM
+    attributes exist there — identity comes from the ct* machine type and
+    the kube-labels attribute (README 'GKE nodes' section)."""
+
+    def _run(self, tfd_binary, server, extra=(), env=None):
+        e = {"GCE_METADATA_HOST": server.endpoint}
+        e.update(env or {})
+        return run_tfd(tfd_binary, [
+            "--oneshot", "--output-file=", "--backend=metadata",
+            f"--metadata-endpoint={server.endpoint}",
+            "--machine-type-file=/dev/null", *extra], env=e)
+
+    def test_v5e_multihost_pool(self, tfd_binary):
+        """ct5lp-hightpu-4t node of a 4x4 (16-chip, 4-host) v5e slice."""
+        with FakeMetadataServer(gke_tpu_node()) as server:
+            code, out, err = self._run(tfd_binary, server,
+                                       ["--slice-strategy=single"])
+            assert code == 0, err
+            labels = labels_of(out)
+            assert labels["google.com/tpu.machine"] == "ct5lp-hightpu-4t"
+            assert labels["google.com/tpu.count"] == "4"
+            assert labels["google.com/tpu.product"] == "tpu-v5e"
+            assert labels["google.com/tpu.topology"] == "4x4"
+            assert labels["google.com/tpu.slice.hosts"] == "4"
+            assert labels["google.com/tpu.ici.wrap"] == "false"
+            assert labels["google.com/tpu.backend"] == "metadata"
+            # No accelerator-type string exists on GKE; absence is honest.
+            assert "google.com/tpu.accelerator-type" not in labels
+            # Not a Cloud TPU VM.
+            assert labels["google.com/tpu-vm.present"] == "false"
+
+    def test_v5p_single_host_pool(self, tfd_binary):
+        with FakeMetadataServer(gke_tpu_node(
+                machine_type="ct5p-hightpu-4t",
+                gke_accelerator="tpu-v5p-slice",
+                gke_topology="2x2x1")) as server:
+            code, out, err = self._run(tfd_binary, server)
+            assert code == 0, err
+            labels = labels_of(out)
+            assert labels["google.com/tpu.count"] == "4"
+            assert labels["google.com/tpu.product"] == "tpu-v5p"
+            assert labels["google.com/tpu.memory"] == "97280"
+            assert labels["google.com/tpu.topology"] == "2x2x1"
+
+    def test_worker_id_from_injected_env(self, tfd_binary):
+        """The GKE TPU webhook injects TPU_WORKER_ID into TPU pods; when
+        the operator wires it through, the worker-id label appears."""
+        with FakeMetadataServer(gke_tpu_node()) as server:
+            code, out, err = self._run(
+                tfd_binary, server, ["--slice-strategy=single"],
+                env={"TPU_WORKER_ID": "2"})
+            assert code == 0, err
+            assert labels_of(out)["google.com/tpu.slice.worker-id"] == "2"
+
+    def test_missing_tpu_labels_still_counts_chips(self, tfd_binary):
+        """A pool without the gke-tpu-* labels: chips/family still come
+        from the machine type; topology labels are absent, not wrong."""
+        with FakeMetadataServer(gke_tpu_node(
+                machine_type="ct6e-standard-8t", gke_accelerator=None,
+                gke_topology=None)) as server:
+            code, out, err = self._run(tfd_binary, server)
+            assert code == 0, err
+            labels = labels_of(out)
+            assert labels["google.com/tpu.count"] == "8"
+            assert labels["google.com/tpu.product"] == "tpu-v6e"
+            assert "google.com/tpu.topology" not in labels
+
+    def test_non_tpu_gke_node_degrades(self, tfd_binary):
+        """A CPU node pool (n2-standard) must not grow TPU labels."""
+        with FakeMetadataServer(gke_tpu_node(
+                machine_type="n2-standard-8", gke_accelerator=None,
+                gke_topology=None)) as server:
+            code, out, err = self._run(tfd_binary, server,
+                                       ["--fail-on-init-error=false"])
+            assert code == 0, err
+            assert "google.com/tpu.count" not in labels_of(out)
 
 
 class TestPjrtInitWatchdog:

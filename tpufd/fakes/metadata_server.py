@@ -76,6 +76,42 @@ def tpu_vm(accelerator_type="v5litepod-4", topology=None, worker_id=0,
     return data
 
 
+def gke_tpu_node(machine_type="ct5lp-hightpu-4t",
+                 gke_accelerator="tpu-v5-lite-podslice",
+                 gke_topology="4x4", cluster_name="tpu-cluster",
+                 zone="us-west4-a", extra_kube_labels=None):
+    """Metadata for a GKE TPU node-pool node.
+
+    GKE TPU nodes do NOT carry the Cloud-TPU-VM attributes
+    (accelerator-type / tpu-env); their TPU identity is the ct* machine
+    type plus the node labels the node pool was created with
+    (cloud.google.com/gke-tpu-accelerator, gke-tpu-topology), which GCE
+    surfaces through the kube-labels instance attribute. GKE-specific
+    attributes like kube-env and cluster-name are present instead.
+    """
+    labels = {
+        "cloud.google.com/gke-nodepool": "tpu-pool",
+    }
+    if gke_accelerator:
+        labels["cloud.google.com/gke-tpu-accelerator"] = gke_accelerator
+    if gke_topology:
+        labels["cloud.google.com/gke-tpu-topology"] = gke_topology
+    if extra_kube_labels:
+        labels.update(extra_kube_labels)
+    return {
+        "instance/id": "5555555555",
+        "instance/machine-type":
+            f"projects/12345/machineTypes/{machine_type}",
+        "instance/zone": f"projects/12345/zones/{zone}",
+        "instance/scheduling/preemptible": "FALSE",
+        "instance/scheduling/provisioning-model": "STANDARD",
+        "instance/attributes/cluster-name": cluster_name,
+        "instance/attributes/kube-env": "AUTOSCALER_ENV_VARS: ...\n",
+        "instance/attributes/kube-labels":
+            ",".join(f"{k}={v}" for k, v in sorted(labels.items())),
+    }
+
+
 def cpu_vm(machine_type="n2-standard-8"):
     """Metadata for a plain (non-TPU) GCE VM."""
     return {

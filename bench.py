@@ -193,12 +193,22 @@ def tpu_probe_numbers():
 
         # Median of 3 independent probe runs: a single differential pair
         # can still catch tunnel jitter and report above chip peak.
-        return {
-            "tpu_matmul_tflops": round(statistics.median(
-                health.matmul_tflops() for _ in range(3)), 1),
-            "tpu_hbm_gbps": round(statistics.median(
-                health.hbm_gbps() for _ in range(3)), 1),
-        }
+        tflops = round(statistics.median(
+            health.matmul_tflops() for _ in range(3)), 1)
+        gbps = round(statistics.median(
+            health.hbm_gbps() for _ in range(3)), 1)
+        out = {"tpu_matmul_tflops": tflops, "tpu_hbm_gbps": gbps}
+        # Context against the published per-family peaks (a scale+add
+        # stream normally reads 75-90% of rated HBM; see tpufd/health.py).
+        family = health.family_of(jax.devices()[0])
+        matmul_pct = health.pct_of_rated(
+            tflops, family, health.RATED_MATMUL_TFLOPS)
+        hbm_pct = health.pct_of_rated(gbps, family, health.RATED_HBM_GBPS)
+        if matmul_pct is not None:
+            out["tpu_matmul_pct_of_rated"] = matmul_pct
+        if hbm_pct is not None:
+            out["tpu_hbm_pct_of_rated"] = hbm_pct
+        return out
     except Exception as e:  # noqa: BLE001 — bench must not die on probe
         sys.stderr.write(f"tpu probe skipped: {e}\n")
         return {}

@@ -118,6 +118,38 @@ def test_health_probes_cpu(cpu_jax):
     assert labels["google.com/tpu.health.ok"] == "true"
     # 8 visible devices -> the ICI all-reduce probe must contribute.
     assert int(labels["google.com/tpu.health.allreduce-gbps"]) > 0
+    # CPU devices have no rated-peak context; no pct/degraded labels.
+    assert "google.com/tpu.health.hbm-gbps-rated" not in labels
+
+
+def test_rated_peak_tables():
+    """The rated-peak tables (the documented expected-range context for
+    measured throughput) must cover every TPU family the C++ family table
+    knows, and the family mapping must agree with
+    slice::FamilyFromDeviceKind."""
+    from tpufd import health
+
+    families = {"v2", "v3", "v4", "v5e", "v5p", "v6e"}
+    assert set(health.RATED_HBM_GBPS) == families
+    assert set(health.RATED_MATMUL_TFLOPS) == families
+    assert all(v > 0 for v in health.RATED_HBM_GBPS.values())
+
+    class FakeDev:
+        def __init__(self, kind):
+            self.device_kind = kind
+
+    cases = {
+        "TPU v2": "v2", "TPU v3": "v3", "TPU v4": "v4",
+        "TPU v5 lite": "v5e", "TPU v5e": "v5e", "TPU v5": "v5p",
+        "TPU v5p": "v5p", "TPU v6 lite": "v6e", "TPU v6e": "v6e",
+    }
+    for kind, want in cases.items():
+        assert health.family_of(FakeDev(kind)) == want, kind
+    assert health.family_of(FakeDev("cpu")) is None
+
+    # The degradation threshold sits well below normal stream efficiency
+    # (75-90% of rated) so healthy chips can never be flagged.
+    assert health.DEGRADED_PCT <= 60
 
 
 def test_allreduce_probe_multidevice(cpu_jax):

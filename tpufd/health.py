@@ -26,6 +26,57 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+# Rated per-chip peaks from Google's published Cloud TPU
+# system-architecture tables (bf16 TFLOP/s; HBM GB/s). Context for the
+# measured numbers: a STREAM-style scale+add loop typically lands at
+# 75-90% of rated HBM bandwidth on healthy silicon (the rated figure is
+# the theoretical pin rate), while the MXU matmul probe reaches ~95%+ of
+# rated TFLOP/s. The health labeler therefore publishes the rated figure
+# and the measured percentage next to each measurement, and only flags
+# degradation below DEGRADED_PCT — so an operator never misreads a
+# normal 80%-of-rated stream as a sick chip.
+RATED_HBM_GBPS = {
+    "v2": 700.0, "v3": 900.0, "v4": 1228.0,
+    "v5e": 819.0, "v5p": 2765.0, "v6e": 1640.0,
+}
+RATED_MATMUL_TFLOPS = {
+    "v2": 46.0, "v3": 123.0, "v4": 275.0,
+    "v5e": 197.0, "v5p": 459.0, "v6e": 918.0,
+}
+# Below this share of rated throughput the chip is flagged degraded.
+# Wide on purpose: it must never fire on the normal 75-90% stream
+# efficiency, only on genuinely sick silicon (thermal throttling, a bad
+# HBM stack, a chip running at a fraction of clock).
+DEGRADED_PCT = 50
+
+
+def pct_of_rated(measured, family, rated_table):
+    """Measured throughput as a percentage of the family's rated peak;
+    None when the family (or its rating) is unknown. The single home of
+    the rated-context math — the daemon's health labels and bench.py both
+    use it, so their percentages can never diverge."""
+    rated = rated_table.get(family) if family else None
+    if not rated:
+        return None
+    return round(100.0 * measured / rated, 1)
+
+
+def family_of(device):
+    """TPU family short name from a jax device kind ("TPU v5 lite" ->
+    "v5e"); None for non-TPU / unknown kinds. Python twin of
+    slice::FamilyFromDeviceKind (src/tfd/slice/topology.cc)."""
+    kind = getattr(device, "device_kind", "").lower()
+    if "tpu" not in kind:
+        return None
+    if "v6" in kind:
+        return "v6e"
+    if "v5" in kind:
+        return "v5e" if ("lite" in kind or "v5e" in kind) else "v5p"
+    for fam in ("v4", "v3", "v2"):
+        if fam in kind:
+            return fam
+    return None
+
 
 def _fetch_scalar(result):
     """Forces completion by reading ONE element back to the host — robust
@@ -186,11 +237,24 @@ def health_labels(prefix="google.com/tpu.health."):
     on_tpu = devices[0].platform == "tpu"
     size = 4096 if on_tpu else 512
     mib = 512 if on_tpu else 32
+    family = family_of(devices[0])
     labels = {}
+
+    def with_rated(measured, rated_table, name):
+        """Publishes measured + rated + pct-of-rated (+ degraded flag),
+        so 80%-of-rated never reads as sickness without context."""
+        labels[prefix + name] = str(int(measured))
+        pct = pct_of_rated(measured, family, rated_table)
+        if pct is not None:
+            labels[prefix + name + "-rated"] = str(int(rated_table[family]))
+            labels[prefix + name + "-pct-of-rated"] = str(int(round(pct)))
+            if pct < DEGRADED_PCT:
+                labels[prefix + name + "-degraded"] = "true"
+
     try:
-        labels[prefix + "matmul-tflops"] = str(
-            int(matmul_tflops(size=size)))
-        labels[prefix + "hbm-gbps"] = str(int(hbm_gbps(mib=mib)))
+        with_rated(matmul_tflops(size=size), RATED_MATMUL_TFLOPS,
+                   "matmul-tflops")
+        with_rated(hbm_gbps(mib=mib), RATED_HBM_GBPS, "hbm-gbps")
         if len(devices) > 1:
             mesh = Mesh(np.array(devices), ("all",))
             labels[prefix + "allreduce-gbps"] = str(int(

@@ -198,7 +198,7 @@ def tpu_probe_numbers():
         gbps = round(statistics.median(
             health.hbm_gbps() for _ in range(3)), 1)
         out = {"tpu_matmul_tflops": tflops, "tpu_hbm_gbps": gbps}
-        # Context against the published per-family peaks (a scale+add
+        # Context against the published per-family peaks (the sign-flip
         # stream normally reads 75-90% of rated HBM; see tpufd/health.py).
         family = health.family_of(jax.devices()[0])
         matmul_pct = health.pct_of_rated(

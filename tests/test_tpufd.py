@@ -146,6 +146,11 @@ def test_rated_peak_tables():
     for kind, want in cases.items():
         assert health.family_of(FakeDev(kind)) == want, kind
     assert health.family_of(FakeDev("cpu")) is None
+    # Unknown kinds yield None (no rated context), exactly as the C++
+    # twin errors — a bare "TPU v6" or future family must not borrow
+    # another family's peaks and be falsely flagged degraded.
+    assert health.family_of(FakeDev("TPU v6")) is None
+    assert health.family_of(FakeDev("TPU v7")) is None
 
     # The degradation threshold sits well below normal stream efficiency
     # (75-90% of rated) so healthy chips can never be flagged.

@@ -366,13 +366,15 @@ class TestGkeMetadata:
 
     def test_worker_id_from_injected_env(self, tfd_binary):
         """The GKE TPU webhook injects TPU_WORKER_ID into TPU pods; when
-        the operator wires it through, the worker-id label appears."""
+        the operator wires it through, the worker-id label appears — and
+        the full GKE label set golden-matches."""
         with FakeMetadataServer(gke_tpu_node()) as server:
             code, out, err = self._run(
                 tfd_binary, server, ["--slice-strategy=single"],
-                env={"TPU_WORKER_ID": "2"})
+                env={"TPU_WORKER_ID": "1"})
             assert code == 0, err
-            assert labels_of(out)["google.com/tpu.slice.worker-id"] == "2"
+            assert labels_of(out)["google.com/tpu.slice.worker-id"] == "1"
+            check_golden(out, GOLDEN / "expected-output-tpu-gke-v5e.txt")
 
     def test_missing_tpu_labels_still_counts_chips(self, tfd_binary):
         """A pool without the gke-tpu-* labels: chips/family still come

@@ -13,11 +13,15 @@ JAX-based tests (tpufd package) run on a virtual 8-device CPU mesh.
 
 import os
 import subprocess
+import sys
 from pathlib import Path
 
 import pytest
 
 REPO = Path(__file__).resolve().parent.parent
+# Make the tpufd package (fakes, health, mesh) importable from every test
+# module — the single home of this path patch.
+sys.path.insert(0, str(REPO))
 BUILD_DIR = REPO / "build"
 BINARY = BUILD_DIR / "tpu-feature-discovery"
 UNIT_TESTS = BUILD_DIR / "tfd_unit_tests"

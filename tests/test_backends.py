@@ -9,17 +9,14 @@
   integration tier (tests/integration-tests.py) with a hermetic one.
 """
 
-import sys
 from pathlib import Path
 
 import pytest
 
-from conftest import BUILD_DIR, GOLDEN, REPO, check_golden, run_tfd, labels_of
+from conftest import BUILD_DIR, GOLDEN, check_golden, run_tfd, labels_of
 
-sys.path.insert(0, str(REPO))
-
-from tpufd.fakes.metadata_server import (  # noqa: E402
-    FakeMetadataServer, cpu_vm, gke_tpu_node, tpu_vm)
+from tpufd.fakes.metadata_server import (
+    FakeMetadataServer, cpu_vm, gke_tpu_node, tpu_vm, v5p_128_worker3)
 
 FAKE_PJRT = BUILD_DIR / "libtfd_fake_pjrt.so"
 
@@ -104,10 +101,7 @@ class TestPjrtBackend:
 class TestMetadataBackend:
     def test_v5p_128_from_metadata(self, tfd_binary):
         """BASELINE config 4 via metadata only (no libtpu on the node)."""
-        with FakeMetadataServer(tpu_vm(
-                accelerator_type="v5p-128", topology="4x4x4",
-                chips_per_host_bounds="2,2,1", host_bounds="2,2,4",
-                worker_id=3, machine_type="ct5p-hightpu-4t")) as server:
+        with FakeMetadataServer(v5p_128_worker3()) as server:
             code, out, err = run_tfd(tfd_binary, [
                 "--oneshot", "--output-file=", "--backend=metadata",
                 f"--metadata-endpoint={server.endpoint}",
@@ -174,11 +168,7 @@ class TestMetadataBackend:
         agents rewrite it) on the metadata-only path — worker id must come
         from instance/attributes/agent-worker-number, and the full
         v5p-128 mixed label set must still golden-match."""
-        with FakeMetadataServer(tpu_vm(
-                accelerator_type="v5p-128", topology="4x4x4",
-                chips_per_host_bounds="2,2,1", host_bounds="2,2,4",
-                worker_id=3, machine_type="ct5p-hightpu-4t",
-                include_worker_id=False)) as server:
+        with FakeMetadataServer(v5p_128_worker3(include_worker_id=False)) as server:
             code, out, err = run_tfd(tfd_binary, [
                 "--oneshot", "--output-file=", "--backend=metadata",
                 f"--metadata-endpoint={server.endpoint}",
@@ -193,11 +183,8 @@ class TestMetadataBackend:
     def test_worker_id_fallback_hostname(self, tfd_binary):
         """No WORKER_ID and no agent-worker-number: the '-w-<N>' suffix of
         the GCE TPU-VM hostname is the last resort."""
-        data = tpu_vm(
-            accelerator_type="v5p-128", topology="4x4x4",
-            chips_per_host_bounds="2,2,1", host_bounds="2,2,4",
-            worker_id=0, machine_type="ct5p-hightpu-4t",
-            include_worker_id=False,
+        data = v5p_128_worker3(
+            worker_id=0, include_worker_id=False,
             hostname="t1v-n-abc123-w-7.us-central2-b.c.proj.internal")
         del data["instance/attributes/agent-worker-number"]
         with FakeMetadataServer(data) as server:
@@ -213,10 +200,7 @@ class TestMetadataBackend:
     def test_worker_id_unknown_label_omitted(self, tfd_binary):
         """With no worker-id source at all, the label must be omitted (not
         -1) — absence is the honest value."""
-        data = tpu_vm(
-            accelerator_type="v5p-128", topology="4x4x4",
-            chips_per_host_bounds="2,2,1", host_bounds="2,2,4",
-            include_worker_id=False)
+        data = v5p_128_worker3(include_worker_id=False)
         del data["instance/attributes/agent-worker-number"]
         with FakeMetadataServer(data) as server:
             code, out, err = run_tfd(tfd_binary, [
@@ -445,10 +429,7 @@ class TestPjrtInitWatchdog:
         libtpu: client creation must be pinned to this host (no hang),
         device facts come from PJRT, and slice-wide topology is overlaid
         from metadata."""
-        with FakeMetadataServer(tpu_vm(
-                accelerator_type="v5p-128", topology="4x4x4",
-                chips_per_host_bounds="2,2,1", host_bounds="2,2,4",
-                worker_id=3, machine_type="ct5p-hightpu-4t")) as server:
+        with FakeMetadataServer(v5p_128_worker3()) as server:
             code, out, err = run_tfd(tfd_binary, [
                 "--oneshot", "--output-file=", "--backend=pjrt",
                 f"--libtpu-path={FAKE_PJRT}",
@@ -483,10 +464,7 @@ class TestPjrtInitWatchdog:
         hangs (peers never arrive), the watchdog kills it, and auto falls
         back to metadata — documenting that the opt-in requires every
         worker to initialize together."""
-        with FakeMetadataServer(tpu_vm(
-                accelerator_type="v5p-128", topology="4x4x4",
-                chips_per_host_bounds="2,2,1", host_bounds="2,2,4",
-                worker_id=3, machine_type="ct5p-hightpu-4t")) as server:
+        with FakeMetadataServer(v5p_128_worker3()) as server:
             code, out, err = run_tfd(tfd_binary, [
                 "--oneshot", "--output-file=", "--backend=auto",
                 f"--libtpu-path={FAKE_PJRT}",

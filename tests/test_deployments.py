@@ -109,6 +109,9 @@ class TestHelmChart:
         wired = set(re.findall(r"TFD_[A-Z_]+", template))
         missing = wired - known
         assert not missing, f"template wires unknown env vars: {missing}"
+        # And the chart must expose the robustness knobs (an operator has
+        # no other way to set them on a helm deployment).
+        assert {"TFD_PJRT_INIT_TIMEOUT", "TFD_PJRT_MULTIHOST"} <= wired
 
     def test_check_yamls_script(self, tfd_binary):
         version = binary_version(tfd_binary)

@@ -2,15 +2,12 @@
 fake API server — plain HTTP and TLS (dlopen'd OpenSSL client path)."""
 
 import subprocess
-import sys
 
 import pytest
 
-from conftest import FIXTURES, REPO, run_tfd
+from conftest import FIXTURES, run_tfd
 
-sys.path.insert(0, str(REPO))
-
-from tpufd.fakes.apiserver import FakeApiServer  # noqa: E402
+from tpufd.fakes.apiserver import FakeApiServer
 
 
 def nf_args():

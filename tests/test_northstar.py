@@ -1,13 +1,15 @@
 """North-star acceptance checks (BASELINE.md): `--oneshot` on every host
 of a v5p-128 slice reproduces the golden labels byte-for-byte, with zero
-NVML symbols linked into the binary."""
+NVML symbols linked into the binary — and keeps doing so when libtpu is
+wedged (the chips-busy worst case a real training job creates)."""
 
 import re
 import subprocess
 
 import pytest
 
-from conftest import BINARY, FIXTURES, GOLDEN, check_golden, labels_of, run_tfd
+from conftest import (BINARY, BUILD_DIR, FIXTURES, GOLDEN,
+                      check_golden, labels_of, run_tfd)
 
 V5P_FIXTURE = (FIXTURES / "v5p-128-worker3.yaml").read_text()
 
@@ -61,6 +63,30 @@ class TestV5p128EveryHost:
         assert labels["google.com/tpu.slice.shape"] == "4x4x4"
         # The golden regex file accepts any worker id; full check:
         check_golden(out, GOLDEN / "expected-output-tpu-v5p-128-mixed.txt")
+
+    def test_wedged_libtpu_still_golden(self, tfd_binary):
+        """The production worst case on config 4: a training job holds the
+        chips AND libtpu blocks in client creation (slice rendezvous).
+        --backend=auto must still reproduce the full v5p-128 metadata
+        golden byte set within the init deadline — the watchdog kills the
+        wedged probe and the chain falls back to the metadata backend."""
+        from tpufd.fakes.metadata_server import (FakeMetadataServer,
+                                                  v5p_128_worker3)
+
+        with FakeMetadataServer(
+                v5p_128_worker3(include_worker_id=False)) as server:
+            code, out, err = run_tfd(tfd_binary, [
+                "--oneshot", "--output-file=", "--backend=auto",
+                f"--libtpu-path={BUILD_DIR / 'libtfd_fake_pjrt.so'}",
+                "--pjrt-init-timeout=2", "--slice-strategy=mixed",
+                f"--metadata-endpoint={server.endpoint}",
+                "--machine-type-file=/dev/null",
+            ], env={"TFD_FAKE_PJRT_HANG": "1",
+                    "GCE_METADATA_HOST": server.endpoint})
+            assert code == 0, err
+            assert labels_of(out)["google.com/tpu.slice.worker-id"] == "3"
+            check_golden(
+                out, GOLDEN / "expected-output-tpu-v5p-128-mixed-metadata.txt")
 
     def test_byte_for_byte_deterministic(self, tfd_binary, tmp_path):
         """Two runs must produce identical bytes (sorted labels, no map

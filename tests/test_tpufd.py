@@ -9,8 +9,6 @@ import pytest
 
 from conftest import REPO
 
-sys.path.insert(0, str(REPO))
-
 
 def test_parse_shape(cpu_jax):
     from tpufd import mesh

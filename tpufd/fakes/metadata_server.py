@@ -76,6 +76,18 @@ def tpu_vm(accelerator_type="v5litepod-4", topology=None, worker_id=0,
     return data
 
 
+def v5p_128_worker3(**overrides):
+    """The canonical BASELINE config-4 host: worker 3 of a v5p-128 slice
+    (4x4x4, 16 hosts), as several tests and goldens pin it. Keyword
+    overrides replace individual fields."""
+    spec = dict(
+        accelerator_type="v5p-128", topology="4x4x4",
+        chips_per_host_bounds="2,2,1", host_bounds="2,2,4",
+        worker_id=3, machine_type="ct5p-hightpu-4t")
+    spec.update(overrides)
+    return tpu_vm(**spec)
+
+
 def gke_tpu_node(machine_type="ct5lp-hightpu-4t",
                  gke_accelerator="tpu-v5-lite-podslice",
                  gke_topology="4x4", cluster_name="tpu-cluster",

@@ -158,6 +158,16 @@ std::vector<FlagDef> MakeFlagDefs(Flags* f) {
                   [f](const std::string& v) {
                     return SetBool(&f->pjrt_multihost, v);
                   }});
+  defs.push_back({"pjrt-refresh-interval",
+                  {"TFD_PJRT_REFRESH_INTERVAL"},
+                  "pjrtRefreshInterval",
+                  "how long a successful PJRT probe snapshot is reused "
+                  "before the (exclusive) chips are touched again "
+                  "(e.g. 1h; 0 = probe every pass)",
+                  false,
+                  [f](const std::string& v) {
+                    return SetDuration(&f->pjrt_refresh_interval_s, v);
+                  }});
   defs.push_back({"metadata-endpoint",
                   {"TFD_METADATA_ENDPOINT", "GCE_METADATA_HOST"},
                   "metadataEndpoint",
@@ -467,6 +477,9 @@ Result<LoadResult> Load(int argc, char** argv) {
   if (f->pjrt_init_timeout_s < 0) {
     return Result<LoadResult>::Error("pjrt-init-timeout must be >= 0s");
   }
+  if (f->pjrt_refresh_interval_s < 0) {
+    return Result<LoadResult>::Error("pjrt-refresh-interval must be >= 0s");
+  }
   if (f->health_exec_timeout_s < 1) {
     return Result<LoadResult>::Error("health-exec-timeout must be >= 1s");
   }
@@ -503,6 +516,7 @@ std::string ToJson(const Config& config) {
       << ",\"backend\":" << jstr(f.backend)
       << ",\"pjrtInitTimeout\":\"" << f.pjrt_init_timeout_s << "s\""
       << ",\"pjrtMultihost\":" << (f.pjrt_multihost ? "true" : "false")
+      << ",\"pjrtRefreshInterval\":\"" << f.pjrt_refresh_interval_s << "s\""
       << ",\"deviceHealth\":" << jstr(f.device_health)
       << ",\"healthExec\":" << jstr(f.health_exec)
       << ",\"healthExecTimeout\":\"" << f.health_exec_timeout_s << "s\""

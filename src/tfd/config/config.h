@@ -73,6 +73,12 @@ struct Flags {
   // is pinned to this host (TPU_HOST_BOUNDS=1,1,1) and slice-wide
   // topology comes from the metadata server instead.
   bool pjrt_multihost = false;
+  // TPU access is EXCLUSIVE (unlike NVML): every PJRT probe briefly holds
+  // the chips, racing any training job that is just initializing. Chip
+  // identity is static, so a successful probe snapshot is reused for this
+  // long before the chips are touched again (0 = probe every pass, the
+  // reference's NVML re-init-per-pass behavior).
+  int pjrt_refresh_interval_s = 3600;
   std::string metadata_endpoint; // override http://metadata.google.internal
   std::string mock_topology_file; // mock backend fixture (tests)
   // off|basic|full. basic: init+enumeration+latency labels. full: basic

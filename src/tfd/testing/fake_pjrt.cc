@@ -18,6 +18,9 @@
 //   TFD_FAKE_PJRT_FAIL       if set, client creation fails with its value
 //   TFD_FAKE_PJRT_HANG       if set, client creation blocks forever — the
 //                            wedged-driver case the init watchdog fences
+//   TFD_FAKE_PJRT_COUNT_FILE if set, one line is appended per client
+//                            creation — lets tests count how often the
+//                            daemon actually grabs the (exclusive) chips
 //   TFD_FAKE_PJRT_MULTIHOST_HANG  if set, client creation blocks UNLESS
 //                            host-pinning env is present (see below) —
 //                            models real libtpu's slice-wide rendezvous
@@ -32,6 +35,7 @@
 // whole-slice create comes up pinned with just the local 2x2x1 chips.
 #include <unistd.h>
 
+#include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <string>
@@ -106,6 +110,14 @@ PJRT_Error* PluginAttributes(PJRT_Plugin_Attributes_Args* args) {
 
 // --- Client ---
 PJRT_Error* ClientCreate(PJRT_Client_Create_Args* args) {
+  std::string count_file = EnvStr("TFD_FAKE_PJRT_COUNT_FILE", "");
+  if (!count_file.empty()) {
+    if (FILE* f = fopen(count_file.c_str(), "a")) {
+      fputs("create\n", f);
+      fclose(f);
+    }
+  }
+
   std::string fail = EnvStr("TFD_FAKE_PJRT_FAIL", "");
   if (!fail.empty()) return MakeError(fail);
 

@@ -9,6 +9,7 @@
   integration tier (tests/integration-tests.py) with a hermetic one.
 """
 
+import os
 from pathlib import Path
 
 import pytest
@@ -483,6 +484,68 @@ class TestPjrtInitWatchdog:
             labels = labels_of(out)
             assert labels["google.com/tpu.backend"] == "metadata"
             assert labels["google.com/tpu.slice.worker-id"] == "3"
+
+    @staticmethod
+    def _run_daemon_passes(tfd_binary, tmp_path, extra, env_extra,
+                           min_passes=3, deadline_s=60):
+        """Runs the daemon until it has completed >= min_passes labeling
+        passes (observed via the per-pass 'wrote N labels' stderr line —
+        polling, never a fixed sleep, so slow CI can't flake it), then
+        returns the number of PJRT client creations the fake counted."""
+        import subprocess
+        import time
+
+        tmp_path.mkdir(exist_ok=True)
+        count_file = tmp_path / "creates"
+        stderr_file = tmp_path / "stderr"
+        env = dict(os.environ,
+                   GCE_METADATA_HOST="invalid.localdomain:1",
+                   TFD_FAKE_PJRT_COUNT_FILE=str(count_file))
+        env.update(env_extra)
+        with open(stderr_file, "w") as stderr:
+            proc = subprocess.Popen(
+                [str(tfd_binary), "--sleep-interval=1s", "--output-file=",
+                 "--backend=pjrt", f"--libtpu-path={FAKE_PJRT}",
+                 "--machine-type-file=/dev/null", *extra],
+                env=env, stdout=subprocess.DEVNULL, stderr=stderr)
+            deadline = time.monotonic() + deadline_s
+            while time.monotonic() < deadline:
+                # Every pass ends in a "wrote N labels" line (failing
+                # backends degrade to null and still write).
+                if stderr_file.read_text().count("wrote ") >= min_passes:
+                    break
+                time.sleep(0.2)
+            else:
+                proc.terminate()
+                proc.wait(timeout=30)
+                raise AssertionError(
+                    f"daemon completed fewer than {min_passes} passes in "
+                    f"{deadline_s}s:\n{stderr_file.read_text()[-2000:]}")
+            proc.terminate()
+            proc.wait(timeout=30)
+        return len(count_file.read_text().splitlines())
+
+    def test_snapshot_cached_across_passes(self, tfd_binary, tmp_path):
+        """TPU access is exclusive: the daemon must NOT grab the chips on
+        every sleep-interval. With the default refresh interval the fake
+        plugin sees exactly one client creation across several passes;
+        with --pjrt-refresh-interval=0 it sees one per pass (the
+        reference's NVML re-init-per-pass behavior)."""
+        cached = self._run_daemon_passes(
+            tfd_binary, tmp_path / "cached", [], {})
+        assert cached == 1, f"expected 1 chip grab with caching, got {cached}"
+        fresh = self._run_daemon_passes(
+            tfd_binary, tmp_path / "fresh",
+            ["--pjrt-refresh-interval=0"], {})
+        assert fresh >= 3, f"expected a grab per pass, got {fresh}"
+
+    def test_failures_never_cached(self, tfd_binary, tmp_path):
+        """A busy-chip node must keep retrying every pass so it recovers
+        promptly when the training job releases the chips."""
+        creates = self._run_daemon_passes(
+            tfd_binary, tmp_path / "busy", ["--fail-on-init-error=false"],
+            {"TFD_FAKE_PJRT_FAIL": "chips are busy"})
+        assert creates >= 3, f"expected a retry per pass, got {creates}"
 
     def test_single_host_no_pinning_no_metadata_needed(self, tfd_binary):
         """A single-host slice must initialize whole (no pinning env), so

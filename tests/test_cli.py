@@ -419,6 +419,17 @@ def test_v6e_8_single(tfd_binary):
     check_golden(out, GOLDEN / "expected-output-tpu-v6e-8-single.txt")
 
 
+def test_v3_32_single(tfd_binary):
+    """v3-32 multi-host (the donut-era family): 4 hosts x 4 chips, 4x4
+    sub-pod mesh — completes per-family golden coverage (v2..v6e)."""
+    code, out, _ = run_tfd(tfd_binary, oneshot_args(
+        ["--backend=mock",
+         f"--mock-topology-file={FIXTURES / 'v3-32.yaml'}",
+         "--slice-strategy=single", "--machine-type-file=/dev/null"]))
+    assert code == 0
+    check_golden(out, GOLDEN / "expected-output-tpu-v3-32-single.txt")
+
+
 def test_heterogeneous_devices_degrade(tfd_binary):
     """Mixed chip products on one host must warn and label the dominant
     product group — never exit nonzero (a crash loop is the worst failure

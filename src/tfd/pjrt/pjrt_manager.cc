@@ -22,7 +22,11 @@
 //     devices' bytes_limit.
 //   - PJRT_Client_Devices lists the *whole slice* (all hosts), which gives
 //     the slice topology (max coord + 1 per axis) and host count (max
-//     process_index + 1) with no extra metadata source.
+//     process_index + 1) with no extra metadata source. On multi-host
+//     slices whole-slice creation rendezvouses with every peer, so the
+//     production path runs this manager inside the watchdog's pinned
+//     probe child (pjrt_watchdog.cc) where the view is host-local and
+//     slice topology comes from metadata instead.
 #include <algorithm>
 #include <map>
 #include <set>

@@ -52,13 +52,8 @@ Result<DeviceSummary> Summarize(
     }
     s.count++;
   }
-  const DeviceSummary* best = nullptr;
-  for (const auto& [product, s] : by_product) {
-    if (best == nullptr || s.count > best->count) best = &s;
-  }
-  if (best == nullptr) {
-    return Result<DeviceSummary>::Error("no TPU devices to summarize");
-  }
+  Result<std::string> dominant = DominantProduct(devices);
+  if (!dominant.ok()) return Result<DeviceSummary>::Error(dominant.error());
   if (by_product.size() > 1) {
     std::string all;
     for (const auto& [product, s] : by_product) {
@@ -66,9 +61,9 @@ Result<DeviceSummary> Summarize(
       all += product + " x" + std::to_string(s.count);
     }
     TFD_LOG_WARNING << "heterogeneous TPU products on one host (" << all
-                    << "); labeling only '" << best->product << "'";
+                    << "); labeling only '" << *dominant << "'";
   }
-  DeviceSummary s = *best;
+  DeviceSummary s = by_product[*dominant];
   // family = product minus the "tpu-" prefix (tpu-v5e → v5e).
   s.family = HasPrefix(s.product, "tpu-") ? s.product.substr(4) : s.product;
   return s;
@@ -117,6 +112,29 @@ Result<LabelerPtr> Build(const std::string& resource_name,
 }
 
 }  // namespace
+
+Result<std::string> DominantProduct(
+    const std::vector<resource::DevicePtr>& devices) {
+  std::map<std::string, int> counts;
+  for (const resource::DevicePtr& device : devices) {
+    Result<std::string> product = device->GetProduct();
+    if (!product.ok()) return product;
+    counts[*product]++;
+  }
+  const std::string* dominant = nullptr;
+  int best = 0;
+  // Ascending map order + strict > = lexicographically smallest tie-break.
+  for (const auto& [product, n] : counts) {
+    if (dominant == nullptr || n > best) {
+      dominant = &product;
+      best = n;
+    }
+  }
+  if (dominant == nullptr) {
+    return Result<std::string>::Error("no TPU devices to summarize");
+  }
+  return *dominant;
+}
 
 Result<LabelerPtr> NewTpuResourceLabeler(
     const std::string& resource_name,

@@ -24,6 +24,14 @@
 namespace tfd {
 namespace lm {
 
+// The dominant product among `devices` (largest count, then
+// lexicographically smallest) — the ONE selection rule for everything
+// keyed on "the node's product": the heterogeneous warn-and-label
+// degradation here, and the ici.links label in tpu_labeler.cc. Errors
+// when a device cannot report its product, or on an empty list.
+Result<std::string> DominantProduct(
+    const std::vector<resource::DevicePtr>& devices);
+
 // Labels for the primary TPU resource with sharing applied
 // (reference NewGPUResourceLabeler, resource.go:36-73).
 Result<LabelerPtr> NewTpuResourceLabeler(

@@ -45,6 +45,10 @@ inline constexpr char kSliceStrategy[] = "google.com/tpu.slice.strategy";
 inline constexpr char kAcceleratorType[] = "google.com/tpu.accelerator-type";
 inline constexpr char kTopologyLabel[] = "google.com/tpu.topology";
 inline constexpr char kIciWrap[] = "google.com/tpu.ici.wrap";
+// Per-chip ICI link count — a hardware attribute of the family's fabric
+// (2D torus: 4 links, 3D torus: 6), the last of SURVEY §5's
+// MIG-attribute analogues (HBM GiB / TensorCores / ICI links).
+inline constexpr char kIciLinks[] = "google.com/tpu.ici.links";
 inline constexpr char kSliceShape[] = "google.com/tpu.slice.shape";
 inline constexpr char kSliceHosts[] = "google.com/tpu.slice.hosts";
 inline constexpr char kSliceChipsPerHost[] =

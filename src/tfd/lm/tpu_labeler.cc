@@ -3,9 +3,12 @@
 #include <algorithm>
 #include <cctype>
 #include <chrono>
+#include <map>
 
 #include "tfd/lm/schema.h"
+#include "tfd/lm/resource_labeler.h"
 #include "tfd/lm/slice_strategy.h"
+#include "tfd/slice/topology.h"
 #include "tfd/util/logging.h"
 #include "tfd/util/strings.h"
 #include "tfd/util/subprocess.h"
@@ -78,6 +81,27 @@ LabelerPtr NewTopologyLabeler(resource::Manager& manager) {
   if (!topo->accelerator_type.empty() || !topo->topology.empty()) {
     labels[kIciWrap] = topo->has_wraparound ? "true" : "false";
   }
+  return std::make_unique<StaticLabeler>(std::move(labels));
+}
+
+// ICI link-count labeler: per-chip links are a hardware constant of the
+// family's fabric (2D torus: 4 links, 3D: 6) — the last MIG-attribute
+// analogue from SURVEY §5 (next to HBM capacity and TensorCores). Derived
+// from the device product, so it survives on topology-less backends too.
+LabelerPtr NewIciLinksLabeler(
+    const std::vector<resource::DevicePtr>& devices) {
+  // DominantProduct is the resource labeler's selection rule, so on a
+  // heterogeneous host this label always matches the product the node is
+  // labeled as.
+  Result<std::string> dominant = DominantProduct(devices);
+  if (!dominant.ok()) return Empty();
+  std::string family_name = HasPrefix(*dominant, "tpu-")
+                                ? dominant->substr(4)
+                                : *dominant;
+  Result<slice::FamilySpec> family = slice::LookupFamily(family_name);
+  if (!family.ok() || family->topology_dims == 0) return Empty();
+  Labels labels;
+  labels[kIciLinks] = family->topology_dims == 3 ? "6" : "4";
   return std::make_unique<StaticLabeler>(std::move(labels));
 }
 
@@ -221,6 +245,7 @@ Result<LabelerPtr> NewTpuLabeler(const resource::ManagerPtr& manager,
   parts.push_back(NewVersionLabeler(*manager));
   parts.push_back(NewSliceCapabilityLabeler(*manager));
   parts.push_back(NewTopologyLabeler(*manager));
+  parts.push_back(NewIciLinksLabeler(*devices));
   const std::string& health_mode = config.flags.device_health;
   bool health_on = (health_mode == "basic" || health_mode == "full") &&
                    manager->TouchesDevices();

@@ -15,10 +15,12 @@ set -e
 
 NEW=$1
 ROOT=${2:-$(dirname "$0")/..}
-case "$NEW" in
-  v[0-9]*) ;;
-  *) echo "Usage: $0 vX.Y.Z [ROOT]" >&2; exit 1 ;;
-esac
+# Strict vX.Y.Z: a glob like v[0-9]* would happily write "v1garbage" into
+# VERSION, Chart.yaml and every image tag.
+if ! expr "$NEW" : 'v[0-9][0-9]*\.[0-9][0-9]*\.[0-9][0-9]*$' >/dev/null; then
+  echo "Usage: $0 vX.Y.Z [ROOT] (got '$NEW')" >&2
+  exit 1
+fi
 BARE=${NEW#v}
 
 echo "$NEW" > "$ROOT/VERSION"

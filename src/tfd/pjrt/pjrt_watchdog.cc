@@ -115,8 +115,33 @@ constexpr const char* kRendezvousEnvs[] = {
 struct PinPlan {
   bool pin = false;             // pin client creation to this host
   std::string chips_bounds;     // tpu-env CHIPS_PER_HOST_BOUNDS ("" unknown)
+  std::string family_chips_bounds;  // family-table fallback ("" unknown)
+  int host_count = 0;           // slice hosts, if any evidence said (0 = no)
   bool metadata_plausible = false;
 };
+
+// Chips-per-host bounds ("x,y,z") for a host of `family` carrying `chips`
+// chips, from the family table's published host layouts (DefaultTopology):
+// 4-chip hosts → "2,2,1", v5e/v6e 8-chip hosts → "2,4,1". Used only when
+// tpu-env lacks CHIPS_PER_HOST_BOUNDS — normally the platform supplies it.
+std::string FamilyChipsBounds(const slice::FamilySpec& family, int chips) {
+  Result<slice::Shape> shape = slice::DefaultTopology(family, chips);
+  if (!shape.ok()) return "";
+  std::vector<int> dims = shape->dims;
+  while (dims.size() < 3) dims.push_back(1);
+  if (dims.size() > 3) return "";
+  return std::to_string(dims[0]) + "," + std::to_string(dims[1]) + "," +
+         std::to_string(dims[2]);
+}
+
+// The effective bounds the probe child will pin with.
+std::string EffectiveChipsBounds(const PinPlan& plan) {
+  if (!plan.chips_bounds.empty()) return plan.chips_bounds;
+  if (!plan.family_chips_bounds.empty()) return plan.family_chips_bounds;
+  // Last resort: 4 chips in a 2x2 block, the layout shared by every
+  // multi-host family's standard hosts (v2/v3/v4/v5p, multi-host v5e).
+  return "2,2,1";
+}
 
 PinPlan PlanHostPinning(const config::Flags& flags) {
   PinPlan plan;
@@ -127,6 +152,8 @@ PinPlan PlanHostPinning(const config::Flags& flags) {
   if (hostnames != nullptr &&
       std::strchr(hostnames, ',') != nullptr) {
     plan.pin = true;
+    plan.host_count =
+        static_cast<int>(SplitString(hostnames, ',').size());
   }
 
   plan.metadata_plausible =
@@ -152,17 +179,55 @@ PinPlan PlanHostPinning(const config::Flags& flags) {
         }
         product *= hosts;
       }
-      if (product > 1) plan.pin = true;
+      if (product > 1) {
+        plan.pin = true;
+        plan.host_count = static_cast<int>(product);
+      }
     }
   }
-  if (!plan.pin) {
+  if (!plan.pin || plan.chips_bounds.empty()) {
+    // Fetched even when HOST_BOUNDS already decided the pin: when tpu-env
+    // lacks CHIPS_PER_HOST_BOUNDS the family table supplies the fallback
+    // layout, so a pinned probe on a non-4-chip host (e.g. a v6e 8-chip
+    // host, 2x4) doesn't under-enumerate local chips. Chips-per-host is
+    // slice chips over the slice's host count when evidence gave one —
+    // max_chips_per_host alone would be wrong for multi-host v5e/v6e,
+    // whose published multi-host pools use 4-chip hosts even though the
+    // single-host machine shapes go up to 8.
     Result<std::string> accel = client.AcceleratorType();
     if (accel.ok() && !accel->empty()) {
       Result<slice::AcceleratorType> parsed =
           slice::ParseAcceleratorType(*accel);
-      if (parsed.ok() &&
-          parsed->num_chips > parsed->spec.max_chips_per_host) {
-        plan.pin = true;
+      if (parsed.ok()) {
+        if (parsed->num_chips > parsed->spec.max_chips_per_host) {
+          plan.pin = true;
+        }
+        int chips_per_host = 0;
+        if (plan.host_count > 0 &&
+            parsed->num_chips % plan.host_count == 0) {
+          chips_per_host = parsed->num_chips / plan.host_count;
+        } else if (parsed->num_chips <= parsed->spec.max_chips_per_host) {
+          chips_per_host = parsed->num_chips;  // single-host slice
+        }
+        if (chips_per_host > 0 &&
+            chips_per_host <= parsed->spec.max_chips_per_host) {
+          plan.family_chips_bounds =
+              FamilyChipsBounds(parsed->spec, chips_per_host);
+        }
+      }
+    }
+    if (plan.family_chips_bounds.empty()) {
+      // GKE rung: GKE node pools carry no accelerator-type attribute
+      // (topology.h), but the ct* machine type states the local chip
+      // count directly — ct6e-standard-8t is an 8-chip (2x4) host.
+      Result<std::string> machine_type = client.MachineType();
+      if (machine_type.ok()) {
+        Result<slice::GkeMachineType> gke =
+            slice::ParseGkeMachineType(*machine_type);
+        if (gke.ok()) {
+          plan.family_chips_bounds =
+              FamilyChipsBounds(gke->spec, gke->chips_per_host);
+        }
       }
     }
   }
@@ -188,11 +253,9 @@ int ProbeChild(int fd, const std::string& libtpu_path, const PinPlan& plan) {
     // purpose: the runtime agent's slice-wide env is exactly what must
     // not leak into a per-node probe.
     for (const char* env : kHostBoundsEnvs) setenv(env, "1,1,1", 1);
-    // Standard multi-host TPU hosts carry 4 chips in a 2x2x1 block
-    // (v2/v3/v4/v5p/v5e-multihost/v6e alike); tpu-env overrides when the
-    // platform says otherwise.
-    std::string chips =
-        plan.chips_bounds.empty() ? "2,2,1" : plan.chips_bounds;
+    // tpu-env CHIPS_PER_HOST_BOUNDS wins; else the family table's host
+    // layout for the accelerator type; else the generic 2x2x1 4-chip host.
+    std::string chips = EffectiveChipsBounds(plan);
     for (const char* env : kChipsBoundsEnvs) setenv(env, chips.c_str(), 1);
     for (const char* env : kRendezvousEnvs) unsetenv(env);
   }
@@ -338,8 +401,7 @@ class PjrtWatchdogManager : public Manager {
     if (plan.pin) {
       TFD_LOG_INFO << "multi-host slice detected; pinning PJRT client "
                       "creation to this host (chips bounds "
-                   << (plan.chips_bounds.empty() ? "2,2,1"
-                                                 : plan.chips_bounds)
+                   << EffectiveChipsBounds(plan)
                    << "); slice topology will come from metadata";
     }
 
@@ -409,9 +471,16 @@ class PjrtWatchdogManager : public Manager {
       if (ValuePtr v = get("wrap")) topology_.has_wraparound = v->bool_value;
     }
 
-    if (plan.pin) OverlaySliceTopology(plan);
+    // A pinned snapshot whose metadata overlay failed must NOT be cached:
+    // the snapshot is served degraded (no slice.* topology) and caching it
+    // would freeze that degradation for pjrt_refresh_interval even after a
+    // transient metadata hiccup clears — violating the cache's own
+    // "failures are never cached" contract. The device facts are still
+    // good for THIS pass; the next pass re-probes and re-overlays.
+    bool overlay_ok = true;
+    if (plan.pin) overlay_ok = OverlaySliceTopology(plan);
     initialized_ = true;
-    if (cacheable) {
+    if (cacheable && overlay_ok) {
       g_snapshot_cache = {true, cache_key,
                           std::chrono::steady_clock::now(), devices_,
                           libtpu_version_, runtime_version_, topology_};
@@ -430,6 +499,9 @@ class PjrtWatchdogManager : public Manager {
   }
 
   Result<std::string> GetLibtpuVersion() override {
+    if (!initialized_) {
+      return Result<std::string>::Error("PJRT backend not initialized");
+    }
     if (libtpu_version_.empty()) {
       return Result<std::string>::Error(
           "libtpu version not reported by the PJRT plugin");
@@ -461,8 +533,12 @@ class PjrtWatchdogManager : public Manager {
   // server — reuse the metadata backend wholesale (it owns the worker-id
   // fallback ladder: tpu-env → agent-worker-number → hostname). Device
   // facts (kind/memory/versions) stay PJRT's; chips_per_host stays the
-  // actually-enumerated local chip count.
-  void OverlaySliceTopology(const PinPlan& plan) {
+  // actually-enumerated local chip count. Returns false only on a
+  // TRANSIENT failure — metadata was plausible but errored — telling the
+  // caller not to cache the degraded snapshot. A node with no metadata
+  // server at all returns true: there is no recovery to wait for, and
+  // re-probing the exclusive chips every pass would be pure contention.
+  bool OverlaySliceTopology(const PinPlan& plan) {
     // Whatever happens below, a pinned snapshot must not claim the pinned
     // artifacts as slice truth.
     topology_.num_hosts = 0;
@@ -470,7 +546,7 @@ class PjrtWatchdogManager : public Manager {
     topology_.topology.clear();
     topology_.has_wraparound = false;
 
-    if (!plan.metadata_plausible) return;
+    if (!plan.metadata_plausible) return true;
     // This re-fetches tpu-env/accelerator-type that PlanHostPinning just
     // read — deliberately: reusing the metadata backend buys its whole
     // worker-id fallback ladder, and the duplicate GETs are two small
@@ -481,13 +557,14 @@ class PjrtWatchdogManager : public Manager {
       TFD_LOG_WARNING << "pinned PJRT init succeeded but slice topology "
                          "lookup failed: "
                       << s.message();
-      return;
+      return false;
     }
     Result<TopologyInfo> meta_topo = metadata->GetTopology();
-    if (!meta_topo.ok()) return;
+    if (!meta_topo.ok()) return false;
     int chips_per_host = topology_.chips_per_host;  // PJRT's local truth
     topology_ = *meta_topo;
     topology_.chips_per_host = chips_per_host;
+    return true;
   }
 
   config::Flags flags_;

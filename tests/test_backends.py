@@ -459,6 +459,100 @@ class TestPjrtInitWatchdog:
             assert labels["google.com/tpu.topology"] == "4x4x4"
             assert labels["google.com/tpu.ici.wrap"] == "true"
 
+    def test_pin_bounds_from_family_table_v6e(self, tfd_binary):
+        """A multi-host pool whose tpu-env lacks CHIPS_PER_HOST_BOUNDS must
+        pin with the FAMILY's host layout, not a generic 2x2x1: v6e hosts
+        carry up to 8 chips in a 2x4 block, and pinning at 2,2,1 would
+        under-enumerate half the local chips (pjrt_watchdog.cc
+        FamilyChipsBounds)."""
+        fixture = tpu_vm(
+            accelerator_type="v6e-16", topology="4x4",
+            host_bounds="1,2", chips_per_host_bounds=None,
+            worker_id=1, machine_type="ct6e-standard-8t")
+        with FakeMetadataServer(fixture) as server:
+            code, out, err = run_tfd(tfd_binary, [
+                "--oneshot", "--output-file=", "--backend=pjrt",
+                f"--libtpu-path={FAKE_PJRT}",
+                "--pjrt-init-timeout=10", "--slice-strategy=single",
+                f"--metadata-endpoint={server.endpoint}",
+                "--machine-type-file=/dev/null",
+            ], env={
+                "TFD_FAKE_PJRT_MULTIHOST_HANG": "1",
+                "TFD_FAKE_PJRT_KIND": "TPU v6e",
+                "TFD_FAKE_PJRT_HBM_GIB": "32",
+                "GCE_METADATA_HOST": server.endpoint,
+            })
+            assert code == 0, err
+            labels = labels_of(out)
+            # All 8 local chips enumerated under the pinned 2,4,1 bounds.
+            assert labels["google.com/tpu.count"] == "8"
+            assert labels["google.com/tpu.product"] == "tpu-v6e"
+            # Slice-wide topology still overlaid from metadata.
+            assert labels["google.com/tpu.topology"] == "4x4"
+            assert labels["google.com/tpu.slice.hosts"] == "2"
+            assert labels["google.com/tpu.slice.worker-id"] == "1"
+
+    def test_pin_bounds_from_gke_machine_type(self, tfd_binary):
+        """GKE nodes carry no accelerator-type attribute, so the family
+        fallback must come from the ct* machine type: a pinned probe on a
+        ct6e-standard-8t (8-chip, 2x4) host must not under-enumerate at
+        the generic 2,2,1."""
+        fixture = gke_tpu_node(
+            machine_type="ct6e-standard-8t",
+            gke_accelerator="tpu-v6e-slice", gke_topology="4x4")
+        with FakeMetadataServer(fixture) as server:
+            code, out, err = run_tfd(tfd_binary, [
+                "--oneshot", "--output-file=", "--backend=pjrt",
+                f"--libtpu-path={FAKE_PJRT}",
+                "--pjrt-init-timeout=10", "--slice-strategy=single",
+                f"--metadata-endpoint={server.endpoint}",
+                "--machine-type-file=/dev/null",
+            ], env={
+                # GKE's device plugin injects the worker env into
+                # TPU-requesting pods; the hostnames list is the pin
+                # trigger here (no tpu-env HOST_BOUNDS on GKE).
+                "TPU_WORKER_HOSTNAMES": "host-0,host-1",
+                "TPU_WORKER_ID": "1",
+                "TFD_FAKE_PJRT_MULTIHOST_HANG": "1",
+                "TFD_FAKE_PJRT_KIND": "TPU v6e",
+                "GCE_METADATA_HOST": server.endpoint,
+            })
+            assert code == 0, err
+            labels = labels_of(out)
+            assert labels["google.com/tpu.count"] == "8"
+            assert labels["google.com/tpu.topology"] == "4x4"
+            assert labels["google.com/tpu.slice.hosts"] == "2"
+            assert labels["google.com/tpu.slice.worker-id"] == "1"
+
+    def test_pin_bounds_multihost_v5e_keeps_4_chip_hosts(self, tfd_binary):
+        """The family fallback must NOT assume max_chips_per_host on
+        multi-host slices: published multi-host v5e pools use 4-chip
+        hosts (ct5lp-hightpu-4t) even though single-host v5e machines go
+        to 8 chips. With HOST_BOUNDS evidence (4 hosts, 16 chips) and no
+        CHIPS_PER_HOST_BOUNDS, the pin must be 2,2,1 — not 2,4,1."""
+        fixture = tpu_vm(
+            accelerator_type="v5litepod-16", topology="4x4",
+            host_bounds="1,4", chips_per_host_bounds=None,
+            worker_id=2, machine_type="ct5lp-hightpu-4t")
+        with FakeMetadataServer(fixture) as server:
+            code, out, err = run_tfd(tfd_binary, [
+                "--oneshot", "--output-file=", "--backend=pjrt",
+                f"--libtpu-path={FAKE_PJRT}",
+                "--pjrt-init-timeout=10", "--slice-strategy=single",
+                f"--metadata-endpoint={server.endpoint}",
+                "--machine-type-file=/dev/null",
+            ], env={
+                "TFD_FAKE_PJRT_MULTIHOST_HANG": "1",
+                "TFD_FAKE_PJRT_KIND": "TPU v5 lite",
+                "GCE_METADATA_HOST": server.endpoint,
+            })
+            assert code == 0, err
+            labels = labels_of(out)
+            assert labels["google.com/tpu.count"] == "4"
+            assert labels["google.com/tpu.slice.hosts"] == "4"
+            assert labels["google.com/tpu.slice.worker-id"] == "2"
+            assert labels["google.com/tpu.topology"] == "4x4"
+
     def test_multihost_optin_attempts_whole_slice(self, tfd_binary):
         """--pjrt-multihost skips pinning: the rendezvous-shaped fake then
         hangs (peers never arrive), the watchdog kills it, and auto falls
@@ -501,6 +595,7 @@ class TestPjrtInitWatchdog:
                    GCE_METADATA_HOST="invalid.localdomain:1",
                    TFD_FAKE_PJRT_COUNT_FILE=str(count_file))
         env.update(env_extra)
+        env = {k: v for k, v in env.items() if v is not None}
         with open(stderr_file, "w") as stderr:
             proc = subprocess.Popen(
                 [str(tfd_binary), "--sleep-interval=1s", "--output-file=",
@@ -545,6 +640,55 @@ class TestPjrtInitWatchdog:
             tfd_binary, tmp_path / "busy", ["--fail-on-init-error=false"],
             {"TFD_FAKE_PJRT_FAIL": "chips are busy"})
         assert creates >= 3, f"expected a retry per pass, got {creates}"
+
+    def test_pinned_overlay_failure_not_cached(self, tfd_binary, tmp_path):
+        """A pinned probe whose metadata topology overlay FAILS is served
+        degraded (device facts, no slice.*) and must not be cached: a
+        transient metadata hiccup would otherwise freeze the degradation
+        for the whole refresh interval — the same contract as
+        'failures are never cached'. Each pass must re-probe."""
+        with FakeMetadataServer(cpu_vm()) as server:
+            # TPU_WORKER_HOSTNAMES pins; the cpu_vm fixture makes the
+            # metadata backend's overlay Init fail while the server stays
+            # reachable (MetadataPlausible = true).
+            creates = self._run_daemon_passes(
+                tfd_binary, tmp_path / "overlay",
+                [f"--metadata-endpoint={server.endpoint}"],
+                {"TPU_WORKER_HOSTNAMES": "host-0,host-1",
+                 "GCE_METADATA_HOST": server.endpoint})
+            assert creates >= 3, (
+                f"degraded pinned snapshot was cached: {creates} creates")
+
+    @pytest.mark.skipif(
+        os.path.exists("/sys/class/dmi/id/product_name") and "google" in
+        open("/sys/class/dmi/id/product_name").read().lower(),
+        reason="on a real GCE VM OnGce() makes 'no metadata server at all' "
+               "unforceable from the environment")
+    def test_pinned_no_metadata_still_cached(self, tfd_binary, tmp_path):
+        """A pinned node with NO metadata server at all (non-GCE, nothing
+        configured) is PERMANENTLY degraded — there is no recovery to
+        poll for, so the snapshot must still be cached rather than
+        re-grabbing the exclusive chips every pass."""
+        creates = self._run_daemon_passes(
+            tfd_binary, tmp_path / "no-meta", [],
+            {"TPU_WORKER_HOSTNAMES": "host-0,host-1",
+             "GCE_METADATA_HOST": None})
+        assert creates == 1, (
+            f"permanently-degraded pin must cache: {creates} creates")
+
+    def test_pinned_overlay_success_still_cached(self, tfd_binary, tmp_path):
+        """The overlay-failure rule must not disable caching on the pinned
+        HAPPY path: with metadata answering, one probe serves all passes."""
+        with FakeMetadataServer(v5p_128_worker3()) as server:
+            creates = self._run_daemon_passes(
+                tfd_binary, tmp_path / "pinned-ok",
+                [f"--metadata-endpoint={server.endpoint}"],
+                {"TPU_WORKER_HOSTNAMES": "host-0,host-1",
+                 "TFD_FAKE_PJRT_KIND": "TPU v5p",
+                 "GCE_METADATA_HOST": server.endpoint})
+            assert creates == 1, (
+                f"expected 1 chip grab on the pinned happy path, "
+                f"got {creates}")
 
     def test_single_host_no_pinning_no_metadata_needed(self, tfd_binary):
         """A single-host slice must initialize whole (no pinning env), so

@@ -182,6 +182,18 @@ class TestReleaseMachinery:
         # The real repo is untouched.
         assert (REPO / "VERSION").read_text().strip() != "v9.9.9"
 
+    def test_set_version_rejects_malformed(self, tmp_path):
+        """Malformed versions must be rejected up front — a loose glob
+        would write 'v1garbage' into VERSION, Chart.yaml and every image
+        tag before any checker runs."""
+        (tmp_path / "VERSION").write_text("v0.0.0\n")
+        for bad in ("v1garbage", "v0.2", "1.2.3", "v1.2.3-rc", "v", ""):
+            proc = subprocess.run(
+                ["sh", str(REPO / "scripts" / "set-version.sh"), bad,
+                 str(tmp_path)], capture_output=True, text=True)
+            assert proc.returncode != 0, f"accepted malformed '{bad}'"
+        assert (tmp_path / "VERSION").read_text().strip() == "v0.0.0"
+
 
 class TestTier34Drivers:
     def test_integration_driver(self, tfd_binary):

@@ -327,6 +327,16 @@ int ProbeChild(int fd, const std::string& libtpu_path, const PinPlan& plan) {
 // flags.pjrt_refresh_interval_s removes ~59 of 60 chip grabs at the
 // default intervals. Failures are never cached (a busy-chip node must
 // keep retrying so it recovers promptly when the job ends).
+//
+// Pinned snapshots cache the CHIP facts but not the slice topology:
+// topology comes from the metadata overlay, which is two GETs to a
+// link-local server — cheap enough to re-run on every pass. That keeps
+// the slice.* labels live (a transient metadata hiccup on the first pass
+// recovers on the next, never frozen for the refresh interval) without
+// ever re-grabbing the exclusive chips. `topology` holds the last
+// successfully overlaid slice view as a fallback when a LATER overlay
+// fails; `pinned_topology` holds the pre-overlay (host-local, cleared)
+// view the re-overlay starts from.
 struct CachedSnapshot {
   bool valid = false;
   std::string key;  // libtpu path + contract flags; mismatch = miss
@@ -335,8 +345,14 @@ struct CachedSnapshot {
   std::string libtpu_version;
   std::string runtime_version;
   TopologyInfo topology;
+  bool pinned = false;
+  TopologyInfo pinned_topology;  // pre-overlay view (pinned only)
 };
 CachedSnapshot g_snapshot_cache;
+// The cache-hit path retries the overlay every pass; on a node where it
+// fails persistently that would mean warnings every sleep-interval
+// forever. Warn on the ok→failed edge only, re-arming on recovery.
+bool g_overlay_failure_warned = false;
 
 class PjrtWatchdogManager : public Manager {
  public:
@@ -362,6 +378,27 @@ class PjrtWatchdogManager : public Manager {
       libtpu_version_ = g_snapshot_cache.libtpu_version;
       runtime_version_ = g_snapshot_cache.runtime_version;
       topology_ = g_snapshot_cache.topology;
+      // Pinned snapshots re-run the cheap metadata overlay every pass so
+      // the slice.* labels stay live (and a transiently-failed first
+      // overlay recovers promptly) without re-grabbing the chips.
+      if (g_snapshot_cache.pinned &&
+          platform::MetadataPlausible(flags_.metadata_endpoint)) {
+        topology_ = g_snapshot_cache.pinned_topology;
+        std::string overlay_error;
+        if (OverlayFromMetadata(&overlay_error)) {
+          g_snapshot_cache.topology = topology_;  // freshen last-good
+          g_overlay_failure_warned = false;
+        } else {
+          if (!g_overlay_failure_warned) {
+            TFD_LOG_WARNING << "slice topology overlay failed ("
+                            << overlay_error
+                            << "); serving the last known slice view "
+                               "(warning once until it recovers)";
+            g_overlay_failure_warned = true;
+          }
+          topology_ = g_snapshot_cache.topology;
+        }
+      }
       initialized_ = true;
       return Status::Ok();
     }
@@ -471,19 +508,36 @@ class PjrtWatchdogManager : public Manager {
       if (ValuePtr v = get("wrap")) topology_.has_wraparound = v->bool_value;
     }
 
-    // A pinned snapshot whose metadata overlay failed must NOT be cached:
-    // the snapshot is served degraded (no slice.* topology) and caching it
-    // would freeze that degradation for pjrt_refresh_interval even after a
-    // transient metadata hiccup clears — violating the cache's own
-    // "failures are never cached" contract. The device facts are still
-    // good for THIS pass; the next pass re-probes and re-overlays.
-    bool overlay_ok = true;
-    if (plan.pin) overlay_ok = OverlaySliceTopology(plan);
+    TopologyInfo pinned_view;
+    if (plan.pin) {
+      // Whatever the overlay yields, a pinned snapshot must not claim the
+      // pinned artifacts (process_index 0, num_hosts 1, host-sized
+      // "topology") as slice truth.
+      ClearPinnedTopology();
+      pinned_view = topology_;
+      std::string overlay_error;
+      if (plan.metadata_plausible && !OverlayFromMetadata(&overlay_error)) {
+        TFD_LOG_WARNING << "pinned PJRT init succeeded but the slice "
+                           "topology overlay failed ("
+                        << overlay_error
+                        << "); slice labels are degraded until metadata "
+                           "answers";
+      }
+    }
     initialized_ = true;
-    if (cacheable && overlay_ok) {
-      g_snapshot_cache = {true, cache_key,
-                          std::chrono::steady_clock::now(), devices_,
-                          libtpu_version_, runtime_version_, topology_};
+    // The overlaid topology is cached only as the last-good fallback —
+    // cache hits on pinned snapshots re-run the overlay each pass, so a
+    // failed overlay here is never frozen for the refresh interval.
+    if (cacheable) {
+      g_snapshot_cache = {true,
+                          cache_key,
+                          std::chrono::steady_clock::now(),
+                          devices_,
+                          libtpu_version_,
+                          runtime_version_,
+                          topology_,
+                          plan.pin,
+                          pinned_view};
     }
     return Status::Ok();
   }
@@ -527,40 +581,37 @@ class PjrtWatchdogManager : public Manager {
   bool TouchesDevices() const override { return true; }
 
  private:
-  // After a pinned (host-local) client creation, the PJRT view of the
-  // slice is just this host: process_index 0, num_hosts 1, a host-sized
-  // "topology". Those slice-wide fields are authoritative in the metadata
-  // server — reuse the metadata backend wholesale (it owns the worker-id
-  // fallback ladder: tpu-env → agent-worker-number → hostname). Device
-  // facts (kind/memory/versions) stay PJRT's; chips_per_host stays the
-  // actually-enumerated local chip count. Returns false only on a
-  // TRANSIENT failure — metadata was plausible but errored — telling the
-  // caller not to cache the degraded snapshot. A node with no metadata
-  // server at all returns true: there is no recovery to wait for, and
-  // re-probing the exclusive chips every pass would be pure contention.
-  bool OverlaySliceTopology(const PinPlan& plan) {
-    // Whatever happens below, a pinned snapshot must not claim the pinned
-    // artifacts as slice truth.
+  // A pinned (host-local) client creation leaves PJRT seeing just this
+  // host: process_index 0, num_hosts 1, a host-sized "topology". Those
+  // must never be served as slice truth.
+  void ClearPinnedTopology() {
     topology_.num_hosts = 0;
     topology_.worker_id = -1;
     topology_.topology.clear();
     topology_.has_wraparound = false;
+  }
 
-    if (!plan.metadata_plausible) return true;
-    // This re-fetches tpu-env/accelerator-type that PlanHostPinning just
-    // read — deliberately: reusing the metadata backend buys its whole
-    // worker-id fallback ladder, and the duplicate GETs are two small
-    // requests to a link-local server once per sleep-interval.
+  // Overlays the slice-wide topology (shape, hosts, worker id, wrap) from
+  // the metadata backend, which knows it authoritatively — reused
+  // wholesale because it owns the worker-id fallback ladder (tpu-env →
+  // agent-worker-number → hostname). Device facts (kind/memory/versions)
+  // stay PJRT's; chips_per_host stays the actually-enumerated local chip
+  // count. The repeat GETs are two small requests to a link-local server
+  // once per sleep-interval. Returns false when metadata errored, with
+  // the reason in *error; the caller decides what degraded view to serve
+  // and how loudly to say so.
+  bool OverlayFromMetadata(std::string* error) {
     ManagerPtr metadata = NewMetadataManager(flags_.metadata_endpoint);
     Status s = metadata->Init();
     if (!s.ok()) {
-      TFD_LOG_WARNING << "pinned PJRT init succeeded but slice topology "
-                         "lookup failed: "
-                      << s.message();
+      *error = s.message();
       return false;
     }
     Result<TopologyInfo> meta_topo = metadata->GetTopology();
-    if (!meta_topo.ok()) return false;
+    if (!meta_topo.ok()) {
+      *error = meta_topo.error();
+      return false;
+    }
     int chips_per_host = topology_.chips_per_host;  // PJRT's local truth
     topology_ = *meta_topo;
     topology_.chips_per_host = chips_per_host;

@@ -9,6 +9,7 @@
   integration tier (tests/integration-tests.py) with a hermetic one.
 """
 
+import contextlib
 import os
 
 import pytest
@@ -579,14 +580,13 @@ class TestPjrtInitWatchdog:
             assert labels["google.com/tpu.slice.worker-id"] == "3"
 
     @staticmethod
-    def _run_daemon_passes(tfd_binary, tmp_path, extra, env_extra,
-                           min_passes=3, deadline_s=60):
-        """Runs the daemon until it has completed >= min_passes labeling
-        passes (observed via the per-pass 'wrote N labels' stderr line —
-        polling, never a fixed sleep, so slow CI can't flake it), then
-        returns the number of PJRT client creations the fake counted."""
+    @contextlib.contextmanager
+    def _daemon(tfd_binary, tmp_path, extra, env_extra, output_file=""):
+        """Runs the daemon (1s passes, fake PJRT with a client-creation
+        count file) for a with-block, terminating it on exit. Yields
+        (count_file, stderr_file). An env_extra value of None DELETES
+        that variable from the inherited environment."""
         import subprocess
-        import time
 
         tmp_path.mkdir(exist_ok=True)
         count_file = tmp_path / "creates"
@@ -598,10 +598,28 @@ class TestPjrtInitWatchdog:
         env = {k: v for k, v in env.items() if v is not None}
         with open(stderr_file, "w") as stderr:
             proc = subprocess.Popen(
-                [str(tfd_binary), "--sleep-interval=1s", "--output-file=",
+                [str(tfd_binary), "--sleep-interval=1s",
+                 f"--output-file={output_file}",
                  "--backend=pjrt", f"--libtpu-path={FAKE_PJRT}",
                  "--machine-type-file=/dev/null", *extra],
                 env=env, stdout=subprocess.DEVNULL, stderr=stderr)
+            try:
+                yield count_file, stderr_file
+            finally:
+                proc.terminate()
+                proc.wait(timeout=30)
+
+    @classmethod
+    def _run_daemon_passes(cls, tfd_binary, tmp_path, extra, env_extra,
+                           min_passes=3, deadline_s=60):
+        """Runs the daemon until it has completed >= min_passes labeling
+        passes (observed via the per-pass 'wrote N labels' stderr line —
+        polling, never a fixed sleep, so slow CI can't flake it), then
+        returns the number of PJRT client creations the fake counted."""
+        import time
+
+        with cls._daemon(tfd_binary, tmp_path, extra,
+                         env_extra) as (count_file, stderr_file):
             deadline = time.monotonic() + deadline_s
             while time.monotonic() < deadline:
                 # Every pass ends in a "wrote N labels" line (failing
@@ -610,13 +628,9 @@ class TestPjrtInitWatchdog:
                     break
                 time.sleep(0.2)
             else:
-                proc.terminate()
-                proc.wait(timeout=30)
                 raise AssertionError(
                     f"daemon completed fewer than {min_passes} passes in "
                     f"{deadline_s}s:\n{stderr_file.read_text()[-2000:]}")
-            proc.terminate()
-            proc.wait(timeout=30)
         return len(count_file.read_text().splitlines())
 
     def test_snapshot_cached_across_passes(self, tfd_binary, tmp_path):
@@ -641,23 +655,61 @@ class TestPjrtInitWatchdog:
             {"TFD_FAKE_PJRT_FAIL": "chips are busy"})
         assert creates >= 3, f"expected a retry per pass, got {creates}"
 
-    def test_pinned_overlay_failure_not_cached(self, tfd_binary, tmp_path):
-        """A pinned probe whose metadata topology overlay FAILS is served
-        degraded (device facts, no slice.*) and must not be cached: a
-        transient metadata hiccup would otherwise freeze the degradation
-        for the whole refresh interval — the same contract as
-        'failures are never cached'. Each pass must re-probe."""
-        with FakeMetadataServer(cpu_vm()) as server:
-            # TPU_WORKER_HOSTNAMES pins; the cpu_vm fixture makes the
-            # metadata backend's overlay Init fail while the server stays
-            # reachable (MetadataPlausible = true).
-            creates = self._run_daemon_passes(
-                tfd_binary, tmp_path / "overlay",
-                [f"--metadata-endpoint={server.endpoint}"],
+    def test_pinned_overlay_failure_recovers_without_reprobe(
+            self, tfd_binary, tmp_path):
+        """A pinned snapshot caches the CHIP facts but re-runs the cheap
+        metadata overlay every pass: a metadata hiccup on the first pass
+        must not freeze the slice.* labels degraded for the refresh
+        interval (the r3 advisor finding), and recovering must not cost
+        extra exclusive-chip grabs (one client creation total)."""
+        import time
+
+        out_file = tmp_path / "labels"
+        # cpu_vm: the server answers but the overlay's metadata Init
+        # fails (no TPU identity) — the transient-degradation shape.
+        with FakeMetadataServer(cpu_vm()) as server, self._daemon(
+                tfd_binary, tmp_path,
+                [f"--metadata-endpoint={server.endpoint}",
+                 "--slice-strategy=single"],
                 {"TPU_WORKER_HOSTNAMES": "host-0,host-1",
-                 "GCE_METADATA_HOST": server.endpoint})
-            assert creates >= 3, (
-                f"degraded pinned snapshot was cached: {creates} creates")
+                 "GCE_METADATA_HOST": server.endpoint,
+                 "TFD_FAKE_PJRT_KIND": "TPU v5p"},
+                output_file=out_file) as (count_file, stderr_file):
+
+            def wait_for(pred, what, deadline_s=60):
+                deadline = time.monotonic() + deadline_s
+                text = ""
+                while time.monotonic() < deadline:
+                    try:
+                        text = out_file.read_text()
+                    except OSError:
+                        text = ""
+                    if pred(text):
+                        return text
+                    time.sleep(0.2)
+                raise AssertionError(
+                    f"never observed {what}; last output:\n{text}\n"
+                    f"stderr:\n{stderr_file.read_text()[-2000:]}")
+
+            # Degraded pass: topology unknown + strategy=single emits
+            # the SLICE-INVALID degradation.
+            degraded = wait_for(
+                lambda t: "google.com/tpu.slice.shape=SLICE-INVALID" in t,
+                "a degraded (SLICE-INVALID) labeling pass")
+            assert "slice.worker-id" not in degraded
+            assert "google.com/tpu.topology" not in degraded
+            # Metadata recovers; the next overlay must heal the slice
+            # labels WITHOUT a new chip grab.
+            server.set_data(v5p_128_worker3())
+            recovered = wait_for(
+                lambda t: "google.com/tpu.slice.worker-id=3" in t,
+                "slice labels after metadata recovery")
+            assert "google.com/tpu.topology=4x4x4" in recovered
+            assert "google.com/tpu.count=4" in recovered
+            assert "SLICE-INVALID" not in recovered
+        creates = len(count_file.read_text().splitlines())
+        assert creates == 1, (
+            f"recovery must not re-grab the chips: {creates} creates")
 
     @pytest.mark.skipif(
         os.path.exists("/sys/class/dmi/id/product_name") and "google" in

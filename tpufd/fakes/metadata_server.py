@@ -165,11 +165,17 @@ class _Handler(BaseHTTPRequestHandler):
 
 class FakeMetadataServer:
     def __init__(self, data, port=0):
-        handler = type("Handler", (_Handler,), {"data": dict(data)})
-        self._server = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self._handler = type("Handler", (_Handler,), {"data": dict(data)})
+        self._server = ThreadingHTTPServer(("127.0.0.1", port),
+                                           self._handler)
         self.port = self._server.server_address[1]
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True)
+
+    def set_data(self, data):
+        """Swaps the served metadata live — for tests that model a
+        metadata server recovering (or changing) mid-daemon-run."""
+        self._handler.data = dict(data)
 
     def __enter__(self):
         self._thread.start()

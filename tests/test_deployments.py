@@ -182,6 +182,23 @@ class TestReleaseMachinery:
         # The real repo is untouched.
         assert (REPO / "VERSION").read_text().strip() != "v9.9.9"
 
+    def test_license_present_everywhere(self):
+        """A deployable artifact (image + chart + release flow) needs its
+        license stated at every surface a consumer sees: the repo root,
+        the chart metadata, the image labels, and the contributor docs."""
+        license_text = (REPO / "LICENSE").read_text()
+        assert "Apache License" in license_text
+        assert "Version 2.0" in license_text
+        contributing = (REPO / "CONTRIBUTING.md").read_text()
+        assert "Signed-off-by" in contributing
+        chart = yaml.safe_load((HELM / "Chart.yaml").read_text())
+        assert chart["annotations"]["artifacthub.io/license"] == "Apache-2.0"
+        dockerfile = (DEPLOY / "container" / "Dockerfile").read_text()
+        assert 'org.opencontainers.image.licenses="Apache-2.0"' in dockerfile
+        assert "LICENSE" in dockerfile  # the text ships inside the image
+        readme = (REPO / "README.md").read_text()
+        assert "LICENSE" in readme and "CONTRIBUTING.md" in readme
+
     def test_set_version_rejects_malformed(self, tmp_path):
         """Malformed versions must be rejected up front — a loose glob
         would write 'v1garbage' into VERSION, Chart.yaml and every image

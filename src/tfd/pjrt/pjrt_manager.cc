@@ -378,7 +378,7 @@ class PjrtManager : public Manager {
           slice::Shape shape;
           for (long long d : dims) shape.dims.push_back(static_cast<int>(d));
           topology_.has_wraparound =
-              slice::ComputeIciWrap(*family, shape).all;
+              slice::ComputeIciWrap(*family, shape);
         }
       }
     }

@@ -123,7 +123,7 @@ class MetadataManager : public Manager {
       Result<slice::Shape> shape = slice::ParseShape(topology_.topology);
       if (shape.ok()) {
         topology_.has_wraparound =
-            slice::ComputeIciWrap(accel_.spec, *shape).all;
+            slice::ComputeIciWrap(accel_.spec, *shape);
       }
     }
 
@@ -230,7 +230,7 @@ class MetadataManager : public Manager {
         if (local_chips > 0 && slice_chips >= local_chips) {
           topology_.num_hosts = slice_chips / local_chips;
         }
-        topology_.has_wraparound = slice::ComputeIciWrap(spec, *shape).all;
+        topology_.has_wraparound = slice::ComputeIciWrap(spec, *shape);
       }
     }
     const char* worker = std::getenv("TPU_WORKER_ID");
@@ -238,8 +238,13 @@ class MetadataManager : public Manager {
     if (worker != nullptr && ParseNonNegInt(TrimSpace(worker), &worker_id)) {
       topology_.worker_id = worker_id;
     }
-    // Same metadata-side ladder as the Cloud-TPU-VM path: the TPU
-    // runtime agent publishes agent-worker-number on GKE nodes too.
+    // Same metadata-side ladder as the Cloud-TPU-VM path. The
+    // authoritative GKE rung is TPU_WORKER_ID above (the GKE TPU webhook
+    // injects it into TPU-requesting pods — GKE "TPUs in GKE" docs); the
+    // agent-worker-number attribute and "-w-<N>" hostname suffix are
+    // Cloud-TPU-VM conventions that are UNVERIFIED on GKE nodes — kept
+    // because they are only consulted when TPU_WORKER_ID is absent, and
+    // a node that does carry them is better labeled than not.
     FillWorkerIdFallbacks();
 
     for (int i = 0; i < local_chips; i++) {

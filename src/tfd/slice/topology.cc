@@ -228,29 +228,20 @@ Result<Shape> DefaultTopology(const FamilySpec& family, int num_chips) {
                               family.family);
 }
 
-IciWrap ComputeIciWrap(const FamilySpec& family, const Shape& shape) {
-  IciWrap out;
-  out.axes.assign(shape.dims.size(), false);
+bool ComputeIciWrap(const FamilySpec& family, const Shape& shape) {
   if (family.topology_dims == 3 && shape.dims.size() == 3) {
     // OCS cube rule: torus (incl. twisted torus) iff every dimension is a
     // multiple of 4 — the slice is then a union of full 4x4x4 cubes and
     // the optical switches close the ring on each axis.
-    bool cubes = true;
     for (int d : shape.dims) {
-      if (d < 4 || d % 4 != 0) cubes = false;
+      if (d < 4 || d % 4 != 0) return false;
     }
-    if (cubes) out.axes.assign(3, true);
-  } else if (family.topology_dims == 2 && shape.dims.size() == 2 &&
-             family.full_pod_chips > 0 &&
-             shape.NumChips() == family.full_pod_chips) {
-    out.axes.assign(2, true);
+    return true;
   }
-  out.all = !out.axes.empty();
-  for (bool axis : out.axes) {
-    out.all = out.all && axis;
-    out.any = out.any || axis;
-  }
-  return out;
+  // 2D families: only the full pod closes the torus (both axes at once).
+  return family.topology_dims == 2 && shape.dims.size() == 2 &&
+         family.full_pod_chips > 0 &&
+         shape.NumChips() == family.full_pod_chips;
 }
 
 }  // namespace slice

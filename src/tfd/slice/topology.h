@@ -71,7 +71,8 @@ Result<FamilySpec> FamilyFromGkeAccelerator(const std::string& value);
 // v4-16 → 2x2x2). Errors when the chip count has no standard shape.
 Result<Shape> DefaultTopology(const FamilySpec& family, int num_chips);
 
-// ICI wraparound links for a slice of `family` laid out as `shape`.
+// ICI wraparound links for a slice of `family` laid out as `shape`
+// (the tpu.ici.wrap label).
 //
 // Rule (Cloud TPU v4/v5p system-architecture docs): 3D families are built
 // from 4x4x4 cubes joined by optical circuit switches; the OCS closes the
@@ -81,12 +82,14 @@ Result<Shape> DefaultTopology(const FamilySpec& family, int num_chips);
 // full pod (v2: 16x16 chips, v3: 32x32, v5e/v6e: 16x16); every sub-pod 2D
 // slice is a mesh. This replaces the earlier ">= 64 chips" heuristic,
 // which mislabeled non-multiple-of-4 custom topologies.
-struct IciWrap {
-  std::vector<bool> axes;  // aligned with shape.dims; true = axis wraps
-  bool all = false;        // every axis wraps (the tpu.ici.wrap label)
-  bool any = false;
-};
-IciWrap ComputeIciWrap(const FamilySpec& family, const Shape& shape);
+//
+// A single bool, deliberately not per-axis: under both published rules
+// wrap is all-or-nothing — the OCS closes every axis of a cube-aligned 3D
+// slice simultaneously, and a full 2D pod wraps both axes — so no
+// published shape has divergent per-axis wrap and a per-axis vector would
+// be dead generality (an earlier revision carried one; nothing could ever
+// observe axes differing).
+bool ComputeIciWrap(const FamilySpec& family, const Shape& shape);
 
 }  // namespace slice
 }  // namespace tfd

@@ -242,27 +242,26 @@ void TestIciWrap() {
   for (const Case& c : cases) {
     Result<slice::Shape> shape = slice::ParseShape(c.shape);
     CHECK_TRUE(shape.ok());
-    slice::IciWrap wrap = slice::ComputeIciWrap(c.family, *shape);
-    if (wrap.all != c.wrap) {
+    bool wrap = slice::ComputeIciWrap(c.family, *shape);
+    if (wrap != c.wrap) {
       g_failures++;
       std::cerr << "ICI wrap mismatch for " << c.family.family << " "
-                << c.shape << ": got " << wrap.all << ", want " << c.wrap
+                << c.shape << ": got " << wrap << ", want " << c.wrap
                 << "\n";
     }
     g_checks++;
-    CHECK_EQ(wrap.all, wrap.any);  // uniform per-axis under the cube rule
   }
   // 2D families: only the full pod is a torus.
   const slice::FamilySpec v5e = *slice::LookupFamily("v5e");
-  CHECK_TRUE(!slice::ComputeIciWrap(v5e, *slice::ParseShape("4x4")).all);
-  CHECK_TRUE(!slice::ComputeIciWrap(v5e, *slice::ParseShape("8x16")).all);
-  CHECK_TRUE(slice::ComputeIciWrap(v5e, *slice::ParseShape("16x16")).all);
+  CHECK_TRUE(!slice::ComputeIciWrap(v5e, *slice::ParseShape("4x4")));
+  CHECK_TRUE(!slice::ComputeIciWrap(v5e, *slice::ParseShape("8x16")));
+  CHECK_TRUE(slice::ComputeIciWrap(v5e, *slice::ParseShape("16x16")));
   const slice::FamilySpec v2 = *slice::LookupFamily("v2");
-  CHECK_TRUE(!slice::ComputeIciWrap(v2, *slice::ParseShape("4x4")).all);
-  CHECK_TRUE(slice::ComputeIciWrap(v2, *slice::ParseShape("16x16")).all);
+  CHECK_TRUE(!slice::ComputeIciWrap(v2, *slice::ParseShape("4x4")));
+  CHECK_TRUE(slice::ComputeIciWrap(v2, *slice::ParseShape("16x16")));
   const slice::FamilySpec v3 = *slice::LookupFamily("v3");
-  CHECK_TRUE(slice::ComputeIciWrap(v3, *slice::ParseShape("32x32")).all);
-  CHECK_TRUE(!slice::ComputeIciWrap(v3, *slice::ParseShape("16x16")).all);
+  CHECK_TRUE(slice::ComputeIciWrap(v3, *slice::ParseShape("32x32")));
+  CHECK_TRUE(!slice::ComputeIciWrap(v3, *slice::ParseShape("16x16")));
 }
 
 void TestDuration() {

@@ -361,6 +361,51 @@ class TestGkeMetadata:
             assert labels_of(out)["google.com/tpu.slice.worker-id"] == "1"
             check_golden(out, GOLDEN / "expected-output-tpu-gke-v5e.txt")
 
+    def test_v5p_multihost_pool_worker_id_ladder(self, tfd_binary):
+        """GKE multi-host, golden-proven (VERDICT r3 item 4): a
+        ct5p-hightpu-4t node of a 4x4x4 (64-chip, 16-host) pool, with the
+        worker id supplied through EACH rung of the ladder in turn —
+        TPU_WORKER_ID env (the verified GKE mechanism: the GKE TPU
+        webhook injects it into TPU-requesting pods), then the
+        agent-worker-number attribute, then the -w-<N> hostname (both
+        Cloud-TPU-VM conventions, unverified on GKE but honored when
+        present). Every rung must produce the same byte-shape label set
+        (golden) with its own worker id."""
+        rungs = [
+            # (fixture overrides, env, expected worker id)
+            ({}, {"TPU_WORKER_ID": "7"}, "7"),
+            ({"agent_worker_number": 11}, {}, "11"),
+            ({"hostname": "t5p-node-w-15.us-east5-a.c.proj.internal"},
+             {}, "15"),
+        ]
+        for overrides, env, want in rungs:
+            fixture = gke_tpu_node(machine_type="ct5p-hightpu-4t",
+                                   gke_accelerator="tpu-v5p-slice",
+                                   gke_topology="4x4x4", **overrides)
+            with FakeMetadataServer(fixture) as server:
+                code, out, err = self._run(
+                    tfd_binary, server, ["--slice-strategy=single"],
+                    env=env)
+                assert code == 0, err
+                labels = labels_of(out)
+                assert labels["google.com/tpu.slice.worker-id"] == want, (
+                    f"rung {overrides or 'TPU_WORKER_ID'}")
+                assert labels["google.com/tpu.slice.hosts"] == "16"
+                check_golden(
+                    out,
+                    GOLDEN / "expected-output-tpu-gke-v5p-multihost.txt")
+        # Env beats the attribute when both rungs are present.
+        fixture = gke_tpu_node(machine_type="ct5p-hightpu-4t",
+                               gke_accelerator="tpu-v5p-slice",
+                               gke_topology="4x4x4",
+                               agent_worker_number=11)
+        with FakeMetadataServer(fixture) as server:
+            code, out, err = self._run(
+                tfd_binary, server, ["--slice-strategy=single"],
+                env={"TPU_WORKER_ID": "7"})
+            assert code == 0, err
+            assert labels_of(out)["google.com/tpu.slice.worker-id"] == "7"
+
     def test_missing_tpu_labels_still_counts_chips(self, tfd_binary):
         """A pool without the gke-tpu-* labels: chips/family still come
         from the machine type; topology labels are absent, not wrong."""

@@ -91,7 +91,8 @@ def v5p_128_worker3(**overrides):
 def gke_tpu_node(machine_type="ct5lp-hightpu-4t",
                  gke_accelerator="tpu-v5-lite-podslice",
                  gke_topology="4x4", cluster_name="tpu-cluster",
-                 zone="us-west4-a", extra_kube_labels=None):
+                 zone="us-west4-a", extra_kube_labels=None,
+                 agent_worker_number=None, hostname=None):
     """Metadata for a GKE TPU node-pool node.
 
     GKE TPU nodes do NOT carry the Cloud-TPU-VM attributes
@@ -110,7 +111,7 @@ def gke_tpu_node(machine_type="ct5lp-hightpu-4t",
         labels["cloud.google.com/gke-tpu-topology"] = gke_topology
     if extra_kube_labels:
         labels.update(extra_kube_labels)
-    return {
+    data = {
         "instance/id": "5555555555",
         "instance/machine-type":
             f"projects/12345/machineTypes/{machine_type}",
@@ -122,6 +123,12 @@ def gke_tpu_node(machine_type="ct5lp-hightpu-4t",
         "instance/attributes/kube-labels":
             ",".join(f"{k}={v}" for k, v in sorted(labels.items())),
     }
+    if agent_worker_number is not None:
+        data["instance/attributes/agent-worker-number"] = str(
+            agent_worker_number)
+    if hostname:
+        data["instance/hostname"] = hostname
+    return data
 
 
 def cpu_vm(machine_type="n2-standard-8"):

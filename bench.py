@@ -21,10 +21,18 @@ backend that can run here:
               real dlopen + GetPjrtApi + client-create + device
               enumeration path AND the init watchdog's fork/JSON-pipe
               overhead (pjrt_watchdog.cc).
+  - auto      : the chips-busy PRODUCTION path — --backend=auto with
+              PJRT init failing and the metadata fallback serving the
+              labels; what a degraded node pays per pass.
+  - auto_deadline : worst case — a wedged libtpu burning the full
+              --pjrt-init-timeout (1s in the bench; 30s production
+              default) before the fallback; deadline-inclusive by
+              construction.
   - pjrt_real : against the real libtpu when one is attachable; null when
               client creation fails (e.g. chips held by a training job —
               on such nodes the shipped daemon would serve from the
-              metadata fallback, which the metadata p50 above prices).
+              metadata fallback, which the auto p50 above prices
+              end-to-end).
 All p50s ride in ONE JSON line; the headline value stays comparable
 across rounds (override which backend is the headline with
 TFD_BENCH_BACKEND=pjrt|metadata|auto).
@@ -116,16 +124,21 @@ def mock_kwargs():
     }
 
 
+def config4_server():
+    """The canonical BASELINE config-4 fixture (v5p-128 worker 3) behind
+    the fake GCE metadata server — shared by every bench that measures
+    the metadata-serving paths so they all price the same config."""
+    sys.path.insert(0, str(REPO))
+    from tpufd.fakes.metadata_server import (FakeMetadataServer,
+                                             v5p_128_worker3)
+
+    return FakeMetadataServer(v5p_128_worker3())
+
+
 def metadata_p50(out_file):
     """p50 against the fake GCE metadata server (BASELINE config 4 data):
     the path a chips-busy node serves labels from."""
-    sys.path.insert(0, str(REPO))
-    from tpufd.fakes.metadata_server import FakeMetadataServer, tpu_vm
-
-    with FakeMetadataServer(tpu_vm(
-            accelerator_type="v5p-128", topology="4x4x4",
-            chips_per_host_bounds="2,2,1", host_bounds="2,2,4",
-            worker_id=3, machine_type="ct5p-hightpu-4t")) as server:
+    with config4_server() as server:
         env = dict(HERMETIC_ENV, GCE_METADATA_HOST=server.endpoint)
         return p50_of(
             SIDE_RUNS, out_file, "metadata",
@@ -145,6 +158,34 @@ def pjrt_fake_p50(out_file):
         SIDE_RUNS, out_file, "pjrt",
         extra_args=[f"--libtpu-path={FAKE_PJRT}"],
         env=env, check_backend="pjrt")
+
+
+def auto_p50(out_file, hang=False):
+    """p50 of the chips-busy PRODUCTION path: --backend=auto with PJRT
+    init failing (a training job holds the exclusive chips) and the
+    metadata fallback serving the labels — the end-to-end latency a
+    degraded node actually pays per pass, the number an SRE sizing
+    --sleep-interval needs. hang=True prices the worst case instead: a
+    WEDGED (not failing) libtpu that burns the full --pjrt-init-timeout
+    deadline (1s here; production default 30s) before the fallback, so
+    its p50 is deadline-inclusive by design — read it as "deadline + the
+    auto p50", not as overhead."""
+    with config4_server() as server:
+        env = dict(HERMETIC_ENV, GCE_METADATA_HOST=server.endpoint)
+        runs = SIDE_RUNS
+        if hang:
+            env["TFD_FAKE_PJRT_HANG"] = "1"
+            # Every sample burns the full deadline; keep wall time sane.
+            runs = max(3, SIDE_RUNS // 3)
+        else:
+            env["TFD_FAKE_PJRT_FAIL"] = "chips busy (held by training job)"
+        return p50_of(
+            runs, out_file, "auto",
+            extra_args=[f"--libtpu-path={FAKE_PJRT}",
+                        f"--metadata-endpoint={server.endpoint}",
+                        "--slice-strategy=mixed",
+                        "--pjrt-init-timeout=1"],
+            env=env, check_backend="metadata")
 
 
 def real_libtpu_path():
@@ -229,6 +270,9 @@ def main():
             p50s[headline] = p50
         for name, fn in (("metadata", metadata_p50),
                          ("pjrt", pjrt_fake_p50),
+                         ("auto", auto_p50),
+                         ("auto_deadline",
+                          lambda f: auto_p50(f, hang=True)),
                          ("pjrt_real", pjrt_real_p50)):
             if name in p50s:
                 continue

@@ -164,6 +164,17 @@ PinPlan PlanHostPinning(const config::Flags& flags) {
   // whose chip count exceeds one host.
   gce::MetadataClient client(flags.metadata_endpoint);
   Result<std::map<std::string, std::string>> env = client.TpuEnv();
+  // A TRANSPORT-level failure (no HTTP response at all — connect/resolve
+  // failed) means every further rung would stack its own connect timeout
+  // onto the probe for nothing — bail. Any HTTP response, including 404
+  // "metadata key not found" (the GKE shape: no tpu-env, server answers)
+  // and transient 5xx ("metadata GET ...: HTTP 503"), proves the server
+  // is answering, so the remaining rungs stay worth trying.
+  if (!env.ok() &&
+      env.error().find("metadata key not found") == std::string::npos &&
+      env.error().find("HTTP") == std::string::npos) {
+    return plan;
+  }
   if (env.ok()) {
     auto it = env->find("CHIPS_PER_HOST_BOUNDS");
     if (it != env->end()) plan.chips_bounds = TrimSpace(it->second);
@@ -516,12 +527,20 @@ class PjrtWatchdogManager : public Manager {
       ClearPinnedTopology();
       pinned_view = topology_;
       std::string overlay_error;
-      if (plan.metadata_plausible && !OverlayFromMetadata(&overlay_error)) {
-        TFD_LOG_WARNING << "pinned PJRT init succeeded but the slice "
-                           "topology overlay failed ("
-                        << overlay_error
-                        << "); slice labels are degraded until metadata "
-                           "answers";
+      if (plan.metadata_plausible) {
+        // Keep the warn-on-edge state in sync with the cache-hit path:
+        // a failure here opens (or continues) the same episode its
+        // per-pass retries belong to.
+        if (OverlayFromMetadata(&overlay_error)) {
+          g_overlay_failure_warned = false;
+        } else {
+          TFD_LOG_WARNING << "pinned PJRT init succeeded but the slice "
+                             "topology overlay failed ("
+                          << overlay_error
+                          << "); slice labels are degraded until "
+                             "metadata answers";
+          g_overlay_failure_warned = true;
+        }
       }
     }
     initialized_ = true;

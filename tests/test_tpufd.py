@@ -197,6 +197,11 @@ def test_bench_json_contract():
     assert p50s["metadata"] > 0
     assert p50s["pjrt"] > 0
     assert "pjrt_real" in p50s
+    # The chips-busy production path (auto: PJRT fails, metadata serves)
+    # and its worst case (auto_deadline: wedged libtpu burns the 1s bench
+    # deadline before the fallback — deadline-inclusive by construction).
+    assert p50s["auto"] > 0
+    assert p50s["auto_deadline"] > 1000
 
 
 def test_cli_burnin(cpu_jax, capsys):

@@ -1,0 +1,75 @@
+#!/bin/sh
+# Tier-4 on real silicon: deploy the FULL stack (helm chart + bundled NFD
+# subchart) on a real GKE cluster with a TPU node pool, wait for the
+# daemon's labels to land on the nodes through the NodeFeature transport,
+# and verify them — the role of the reference's tests/ci-run-e2e.sh +
+# e2e-tests.py (deploy NFD + daemonset, watch for the timestamp label),
+# pointed at GKE.
+#
+# Needs: KUBECONFIG at a cluster with a TPU node pool
+# (tests/gke-ci/provision.sh), helm, and IMAGE pushed somewhere the
+# cluster can pull. Cannot run in the hermetic CI environment;
+# tests/test_deployments.py::TestGkeHarness keeps its references in sync
+# so it does not rot between real runs.
+#
+# Usage: tests/ci-run-e2e-gke.sh IMAGE_NAME VERSION
+#   TFD_GOLDEN=<file>  optional golden for a byte-shape match when the
+#                      cluster's config is pinned (default: required-set
+#                      check, tests/gke-check-labels.py).
+#   TFD_KEEP=1         leave the release installed for debugging.
+set -eu
+
+[ "$#" -eq 2 ] || { echo "Usage: $0 IMAGE_NAME VERSION" >&2; exit 1; }
+IMAGE_NAME=$1
+VERSION=$2
+TESTS=$(CDPATH= cd -- "$(dirname -- "$0")" && pwd)
+CHART="$TESTS/../deployments/helm/tpu-feature-discovery"
+RELEASE=tfd-e2e
+TIMEOUT_S=${TFD_E2E_TIMEOUT:-300}
+
+helm dependency update "$CHART"
+helm upgrade --install "$RELEASE" "$CHART" \
+  --set image.repository="$IMAGE_NAME" \
+  --set image.tag="$VERSION" \
+  --wait --timeout "${TIMEOUT_S}s"
+if [ -z "${TFD_KEEP:-}" ]; then
+  trap 'helm uninstall "$RELEASE"' EXIT
+fi
+
+# Fail fast when the pool never provisioned: zero TPU nodes is
+# unrecoverable from the first iteration — don't burn the poll timeout.
+TPU_NODES=$(kubectl get nodes -l cloud.google.com/gke-tpu-accelerator \
+  -o name)
+[ -n "$TPU_NODES" ] || {
+  echo "no TPU nodes matched cloud.google.com/gke-tpu-accelerator" >&2
+  exit 1
+}
+
+# The reference's liveness signal: the timestamp label appearing on the
+# node proves daemon -> features.d/NodeFeature -> NFD master -> node
+# labels end-to-end. Poll every TPU node for it.
+echo "Waiting up to ${TIMEOUT_S}s for google.com/tfd.timestamp on TPU nodes"
+DEADLINE=$(( $(date +%s) + TIMEOUT_S ))
+while :; do
+  MISSING=$(kubectl get nodes -l cloud.google.com/gke-tpu-accelerator \
+    -o json | python3 -c '
+import json, sys
+nodes = json.load(sys.stdin)["items"]
+if not nodes:
+    print("no-tpu-nodes")
+for n in nodes:
+    if "google.com/tfd.timestamp" not in n["metadata"]["labels"]:
+        print(n["metadata"]["name"])
+')
+  [ -z "$MISSING" ] && break
+  if [ "$(date +%s)" -ge "$DEADLINE" ]; then
+    echo "Nodes still missing the timestamp label: $MISSING" >&2
+    kubectl get pods -l app.kubernetes.io/name=tpu-feature-discovery \
+      -o wide >&2 || true
+    exit 1
+  fi
+  sleep 5
+done
+
+python3 "$TESTS/gke-check-labels.py" --nodes ${TFD_GOLDEN:+--golden "$TFD_GOLDEN"}
+echo "E2E run passed"

@@ -1,0 +1,46 @@
+#!/bin/sh
+# Tier-3 on real silicon: run the CONTAINER one-shot on a real GKE TPU
+# node and verify the labels it emits — the role of the reference's
+# tests/ci-run-integration.sh (which pip-installs and drives
+# integration-tests.py on a terraform-provisioned GPU node), spoken in
+# kubectl because the target substrate is a GKE node pool
+# (tests/gke-ci/provision.sh).
+#
+# Needs: KUBECONFIG at a cluster with a TPU node pool, and IMAGE pushed
+# somewhere the cluster can pull. Cannot run in the hermetic CI
+# environment; tests/test_deployments.py::TestGkeHarness keeps its
+# references in sync so it does not rot between real runs.
+#
+# Usage: tests/ci-run-integration-gke.sh IMAGE[:TAG] [NODE]
+#   NODE defaults to the first node carrying the GKE TPU label.
+set -eu
+
+[ "$#" -ge 1 ] || { echo "Usage: $0 IMAGE[:TAG] [NODE]" >&2; exit 1; }
+IMAGE=$1
+TESTS=$(CDPATH= cd -- "$(dirname -- "$0")" && pwd)
+
+NODE=${2:-$(kubectl get nodes \
+  -l cloud.google.com/gke-tpu-accelerator \
+  -o jsonpath='{.items[0].metadata.name}')}
+[ -n "$NODE" ] || { echo "no GKE TPU node found" >&2; exit 1; }
+echo "Running one-shot labeling Job on node $NODE with $IMAGE"
+
+kubectl delete job tpu-feature-discovery --ignore-not-found
+# The rendering (image + node + stdout-labels arg) lives in render-job.sh
+# so the hermetic harness test exercises the exact same substitution.
+"$TESTS/gke-ci/render-job.sh" "$NODE" "$IMAGE" | kubectl apply -f -
+
+trap 'kubectl delete job tpu-feature-discovery --ignore-not-found' EXIT
+kubectl wait --for=condition=complete --timeout=300s \
+  job/tpu-feature-discovery
+
+# Pick the SUCCEEDED pod explicitly: a transiently-failed retry pod sits
+# beside it under the same job selector, and `kubectl logs job/...` may
+# pick either.
+POD=$(kubectl get pods -l job-name=tpu-feature-discovery \
+  --field-selector=status.phase=Succeeded \
+  -o jsonpath='{.items[0].metadata.name}')
+[ -n "$POD" ] || { echo "no succeeded pod for the job" >&2; exit 1; }
+kubectl logs "$POD" \
+  | python3 "$TESTS/gke-check-labels.py" --stdin ${TFD_GOLDEN:+--golden "$TFD_GOLDEN"}
+echo "Integration run on $NODE passed"

@@ -1,0 +1,120 @@
+#!/usr/bin/env python3
+"""Real-cluster label verifier, shared by ci-run-integration-gke.sh
+(label lines on stdin, from the one-shot Job's logs) and
+ci-run-e2e-gke.sh (node labels through the live apiserver, proving the
+whole NFD transport) — the check_labels role of the reference's
+tests/e2e-tests.py, pointed at real GKE instead of a fake.
+
+Unlike the hermetic tiers, a real cluster's exact shape isn't known in
+advance, so the default check is the REQUIRED core set every healthy TPU
+node must carry; pass --golden for a byte-shape regex match (both
+directions, same golden grammar as tests/golden/) when the cluster's
+config is pinned.
+
+Usage:
+  gke-check-labels.py --stdin [--golden FILE]
+  gke-check-labels.py --nodes [--selector LABEL] [--golden FILE]
+"""
+
+import argparse
+import json
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+TESTS = Path(__file__).resolve().parent
+sys.path.insert(0, str(TESTS))
+
+from golden_match import load_golden, match_lines  # noqa: E402
+
+# What every healthy labeled TPU node carries regardless of family,
+# slice shape, or strategy (lm/schema.h; README label table).
+REQUIRED = [
+    r"google\.com\/tfd\.timestamp=[0-9]{10}",
+    r"google\.com\/tpu\.machine=ct.+",
+    r"google\.com\/tpu\.count=[1-9][0-9]*",
+    r"google\.com\/tpu\.product=tpu-v.+",
+    r"google\.com\/tpu\.family=v.+",
+    r"google\.com\/tpu\.generation=[2-9]",
+    r"google\.com\/tpu\.slice\.capable=(true|false)",
+    r"google\.com\/tpu\.backend=(pjrt|metadata)",
+]
+TPU_NODE_SELECTOR = "cloud.google.com/gke-tpu-accelerator"
+
+
+def check(labels, golden_regexes):
+    """labels: list of 'key=value' lines. Returns True when they satisfy
+    the required set (and the golden exactly, when given)."""
+    ok = True
+    if golden_regexes is not None:
+        unmatched_lines, unmatched_regexes = match_lines(
+            golden_regexes, labels)
+        for label in unmatched_lines:
+            print(f"Unexpected label: {label}")
+            ok = False
+        for regex in unmatched_regexes:
+            print(f"Missing label matching: {regex.pattern}")
+            ok = False
+        return ok
+    for pattern in REQUIRED:
+        regex = re.compile(pattern)
+        if not any(regex.fullmatch(label) for label in labels):
+            print(f"Missing required label matching: {pattern}")
+            ok = False
+    return ok
+
+
+def node_label_lines(selector):
+    """TPU nodes' google.com/* labels via kubectl, as 'key=value' lines
+    per node: {node_name: [lines]}."""
+    out = subprocess.run(
+        ["kubectl", "get", "nodes", "-l", selector, "-o", "json"],
+        check=True, capture_output=True, text=True).stdout
+    nodes = json.loads(out)["items"]
+    return {
+        node["metadata"]["name"]: sorted(
+            f"{key}={value}"
+            for key, value in node["metadata"]["labels"].items()
+            if key.startswith("google.com/"))
+        for node in nodes
+    }
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    source = parser.add_mutually_exclusive_group(required=True)
+    source.add_argument("--stdin", action="store_true",
+                        help="read key=value label lines from stdin")
+    source.add_argument("--nodes", action="store_true",
+                        help="read node labels via kubectl")
+    parser.add_argument("--selector", default=TPU_NODE_SELECTOR,
+                        help="node selector for --nodes")
+    parser.add_argument("--golden", type=Path,
+                        help="golden regex file for a byte-shape match")
+    args = parser.parse_args()
+    golden = load_golden(args.golden) if args.golden else None
+
+    if args.stdin:
+        # Job logs interleave the daemon's stderr klog lines with the
+        # stdout labels; keep only label-shaped lines (<domain>/<name>=v).
+        label_shape = re.compile(r"^[A-Za-z0-9.-]+/[A-Za-z0-9._-]+=\S*$")
+        labels = sorted(line.strip() for line in sys.stdin
+                        if label_shape.match(line.strip()))
+        print(f"Checking {len(labels)} labels from stdin")
+        return 0 if check(labels, golden) else 1
+
+    per_node = node_label_lines(args.selector)
+    if not per_node:
+        print(f"No nodes matched selector {args.selector}")
+        return 1
+    failed = 0
+    for name, labels in per_node.items():
+        print(f"Checking {len(labels)} labels on node {name}")
+        if not check(labels, golden):
+            failed += 1
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

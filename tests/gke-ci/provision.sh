@@ -1,0 +1,68 @@
+#!/bin/sh
+# Provision (or tear down) the small real-cluster CI substrate for
+# tpu-feature-discovery: a GKE cluster with one CPU default pool plus one
+# single-host TPU node pool. The role of the reference's aws-kube-ci
+# terraform submodule + terraform.tfvars, spoken in gcloud because the
+# target platform is GKE (reference: tests/terraform.tfvars pins
+# instance_type; here TFD_GKE_MACHINE_TYPE pins the ct* machine type).
+#
+# Cannot run in the hermetic CI environment — it needs a GCP project with
+# TPU quota. tests/test_deployments.py::TestGkeHarness keeps its flag and
+# file references in sync so the script does not rot between real runs.
+#
+# Usage:
+#   tests/gke-ci/provision.sh up
+#   tests/gke-ci/provision.sh down
+#
+# Environment (defaults chosen for the cheapest real TPU signal):
+#   TFD_GKE_PROJECT       GCP project id            (required)
+#   TFD_GKE_CLUSTER       cluster name              (default tfd-ci)
+#   TFD_GKE_ZONE          zone with v5e capacity    (default us-west4-a)
+#   TFD_GKE_MACHINE_TYPE  TPU machine type          (default ct5lp-hightpu-1t)
+#   TFD_GKE_TPU_TOPOLOGY  slice topology            (default 1x1)
+#   TFD_GKE_NUM_NODES     TPU pool size             (default 1; multi-host
+#                         pools take the slice's host count)
+set -eu
+
+CLUSTER=${TFD_GKE_CLUSTER:-tfd-ci}
+ZONE=${TFD_GKE_ZONE:-us-west4-a}
+MACHINE_TYPE=${TFD_GKE_MACHINE_TYPE:-ct5lp-hightpu-1t}
+TPU_TOPOLOGY=${TFD_GKE_TPU_TOPOLOGY:-1x1}
+NUM_NODES=${TFD_GKE_NUM_NODES:-1}
+
+usage() {
+  echo "Usage: $0 up|down (see header for TFD_GKE_* env)" >&2
+  exit 1
+}
+
+[ "$#" -eq 1 ] || usage
+: "${TFD_GKE_PROJECT:?set TFD_GKE_PROJECT to the GCP project id}"
+
+case "$1" in
+  up)
+    # Small CPU default pool: runs NFD master + kube-system.
+    gcloud container clusters create "$CLUSTER" \
+      --project "$TFD_GKE_PROJECT" --zone "$ZONE" \
+      --num-nodes 1 --machine-type e2-standard-4
+    # The TPU pool. GKE attaches the cloud.google.com/gke-tpu-accelerator
+    # and gke-tpu-topology node labels itself — exactly the surface the
+    # daemon's GKE metadata ladder reads (src/tfd/resource/
+    # metadata_manager.cc GkeInit); nothing to label by hand.
+    gcloud container node-pools create tfd-tpu-pool \
+      --project "$TFD_GKE_PROJECT" --cluster "$CLUSTER" --zone "$ZONE" \
+      --machine-type "$MACHINE_TYPE" \
+      --tpu-topology "$TPU_TOPOLOGY" \
+      --num-nodes "$NUM_NODES"
+    gcloud container clusters get-credentials "$CLUSTER" \
+      --project "$TFD_GKE_PROJECT" --zone "$ZONE"
+    echo "Cluster ready; run tests/ci-run-integration-gke.sh and" \
+         "tests/ci-run-e2e-gke.sh against it."
+    ;;
+  down)
+    gcloud container clusters delete "$CLUSTER" --quiet \
+      --project "$TFD_GKE_PROJECT" --zone "$ZONE"
+    ;;
+  *)
+    usage
+    ;;
+esac

@@ -1,0 +1,25 @@
+#!/bin/sh
+# Renders the one-shot labeling Job for a given NODE and IMAGE from
+# deployments/static/tpu-feature-discovery-job.yaml.template, with the
+# labels additionally routed to stdout (--output-file=) so the driver can
+# verify them from the pod logs. Single source of the substitution:
+# ci-run-integration-gke.sh pipes this to kubectl apply, and
+# tests/test_deployments.py::TestGkeHarness renders with dummy values and
+# asserts the result is valid YAML carrying them — so the patterns here
+# can never silently diverge from the template.
+#
+# Usage: render-job.sh NODE IMAGE[:TAG]
+set -eu
+
+[ "$#" -eq 2 ] || { echo "Usage: $0 NODE IMAGE[:TAG]" >&2; exit 1; }
+NODE=$1
+IMAGE=$2
+HERE=$(CDPATH= cd -- "$(dirname -- "$0")" && pwd)
+TEMPLATE="$HERE/../../deployments/static/tpu-feature-discovery-job.yaml.template"
+
+# awk appends the extra arg line portably (a \n inside a sed replacement
+# is GNU-only; BSD sed would emit a literal 'n').
+sed -e "s|NODE_NAME|$NODE|" \
+    -e "s|image: tpu-feature-discovery:v[0-9][0-9a-zA-Z.+-]*|image: $IMAGE|" \
+    "$TEMPLATE" \
+  | awk '{print} /- "--oneshot"/ {print "            - \"--output-file=\""}'

@@ -212,6 +212,153 @@ class TestReleaseMachinery:
         assert (tmp_path / "VERSION").read_text().strip() == "v0.0.0"
 
 
+class TestGkeHarness:
+    """The real-cluster GKE scripts (tests/gke-ci/provision.sh,
+    ci-run-integration-gke.sh, ci-run-e2e-gke.sh) cannot execute here —
+    they need a GCP project with TPU quota. This keeps them from rotting
+    between real runs: syntax, referenced files, the sed patterns they
+    rewrite, the helm values they set, and the label checker they share
+    (driven against the real binary's output)."""
+
+    SCRIPTS = [
+        REPO / "tests" / "gke-ci" / "provision.sh",
+        REPO / "tests" / "gke-ci" / "render-job.sh",
+        REPO / "tests" / "ci-run-integration-gke.sh",
+        REPO / "tests" / "ci-run-e2e-gke.sh",
+    ]
+
+    @pytest.mark.parametrize("script", SCRIPTS, ids=lambda p: p.name)
+    def test_script_parses_and_is_executable(self, script):
+        assert script.exists(), script
+        assert script.stat().st_mode & 0o111, f"{script} not executable"
+        proc = subprocess.run(["sh", "-n", str(script)],
+                              capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+
+    def test_referenced_files_exist(self):
+        """Every repo path a script names must exist — a renamed yaml or
+        checker would otherwise only fail on a real (expensive) run. The
+        assertions use the full relative path (a bare file name like
+        'tpu-feature-discovery' appears all over the scripts and would
+        make the check vacuous)."""
+        refs = {
+            "gke-ci/render-job.sh": [
+                "deployments/static/"
+                "tpu-feature-discovery-job.yaml.template",
+            ],
+            "ci-run-integration-gke.sh": [
+                "gke-ci/render-job.sh",
+                "gke-check-labels.py",
+            ],
+            "ci-run-e2e-gke.sh": [
+                "deployments/helm/tpu-feature-discovery",
+                "gke-check-labels.py",
+            ],
+        }
+        for script, needed in refs.items():
+            text = (REPO / "tests" / script).read_text()
+            for ref in needed:
+                assert ref in text, f"{script} lost its {ref} reference"
+                target = (REPO / ref if ref.startswith("deployments")
+                          else REPO / "tests" / ref)
+                assert target.exists(), f"{script} references {ref}"
+
+    def test_render_job_substitutes_node_image_and_args(self):
+        """render-job.sh is the single source of the Job substitution:
+        rendering with dummy values must yield valid YAML carrying the
+        node, the image, and the stdout-labels arg — so neither the
+        template nor the script's patterns can silently diverge."""
+        proc = subprocess.run(
+            ["sh", str(REPO / "tests" / "gke-ci" / "render-job.sh"),
+             "test-node-1", "gcr.io/proj/tpu-feature-discovery:v9.9.9"],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+        job = yaml.safe_load(proc.stdout)
+        spec = job["spec"]["template"]["spec"]
+        assert spec["nodeName"] == "test-node-1"
+        container = spec["containers"][0]
+        assert (container["image"]
+                == "gcr.io/proj/tpu-feature-discovery:v9.9.9")
+        assert container["args"] == ["--oneshot", "--output-file="]
+
+    def test_e2e_helm_values_exist(self):
+        """--set image.repository/tag must name real chart values."""
+        values = yaml.safe_load((HELM / "values.yaml").read_text())
+        assert "repository" in values["image"]
+        assert "tag" in values["image"]
+        script = (REPO / "tests" / "ci-run-e2e-gke.sh").read_text()
+        assert "image.repository" in script
+        assert "image.tag" in script
+        # The liveness label the script polls is the one the daemon emits.
+        assert "google.com/tfd.timestamp" in script
+
+    def test_provision_machine_types_parse(self, tfd_binary):
+        """Machine types the provisioning script defaults to must parse
+        through the daemon's own GKE ladder — provisioning a pool the
+        daemon then can't identify would be a wasted real run. Proven by
+        driving the binary with each ct* type as the node's machine
+        type (GkeInit path, no kube-labels needed for family+chips)."""
+        from tpufd.fakes.metadata_server import (FakeMetadataServer,
+                                                 gke_tpu_node)
+
+        script = (REPO / "tests" / "gke-ci" / "provision.sh").read_text()
+        machine_types = set(re.findall(r"ct[0-9a-z]+-[a-z]+-[0-9]+t",
+                                       script))
+        assert machine_types, "provision.sh names no ct* machine type"
+        for machine_type in machine_types:
+            fixture = gke_tpu_node(machine_type=machine_type,
+                                   gke_accelerator=None, gke_topology=None)
+            with FakeMetadataServer(fixture) as server:
+                code, out, err = run_tfd(tfd_binary, [
+                    "--oneshot", "--output-file=", "--backend=metadata",
+                    f"--metadata-endpoint={server.endpoint}",
+                    "--machine-type-file=/dev/null",
+                ], env={"GCE_METADATA_HOST": server.endpoint})
+                assert code == 0, f"{machine_type}: {err}"
+                labels = dict(line.split("=", 1)
+                              for line in out.splitlines() if "=" in line)
+                assert int(labels["google.com/tpu.count"]) >= 1, \
+                    machine_type
+
+    def test_label_checker_against_real_binary_output(self, tfd_binary):
+        """gke-check-labels.py --stdin must accept the actual binary's
+        output for a GKE fixture (klog interleaving included) in both
+        required-set and golden modes, and reject an incomplete set."""
+        from tpufd.fakes.metadata_server import (FakeMetadataServer,
+                                                 gke_tpu_node)
+
+        fixture = gke_tpu_node(machine_type="ct5p-hightpu-4t",
+                               gke_accelerator="tpu-v5p-slice",
+                               gke_topology="4x4x4")
+        checker = REPO / "tests" / "gke-check-labels.py"
+        with FakeMetadataServer(fixture) as server:
+            proc = run_tfd(tfd_binary, [
+                "--oneshot", "--output-file=", "--backend=metadata",
+                f"--metadata-endpoint={server.endpoint}",
+                "--slice-strategy=single",
+                "--machine-type-file=/dev/null",
+            ], env={"GCE_METADATA_HOST": server.endpoint,
+                    "TPU_WORKER_ID": "7"})
+        code, out, err = proc
+        assert code == 0, err
+        combined = err + out  # job logs interleave stderr and stdout
+        ok = subprocess.run(
+            [sys.executable, str(checker), "--stdin"],
+            input=combined, capture_output=True, text=True)
+        assert ok.returncode == 0, ok.stdout + ok.stderr
+        golden = subprocess.run(
+            [sys.executable, str(checker), "--stdin", "--golden",
+             str(REPO / "tests" / "golden" /
+                 "expected-output-tpu-gke-v5p-multihost.txt")],
+            input=combined, capture_output=True, text=True)
+        assert golden.returncode == 0, golden.stdout + golden.stderr
+        bad = subprocess.run(
+            [sys.executable, str(checker), "--stdin"],
+            input="google.com/tfd.timestamp=1234567890\n",
+            capture_output=True, text=True)
+        assert bad.returncode == 1, "checker accepted an incomplete set"
+
+
 class TestTier34Drivers:
     def test_integration_driver(self, tfd_binary):
         proc = subprocess.run(

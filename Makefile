@@ -9,7 +9,9 @@ BARE_VERSION := $(VERSION:v%=%)
 IMAGE ?= tpu-feature-discovery
 # Helm repo URL baked into docs/index.yaml (gh-pages style, reference
 # docs/index.yaml) — override for a fork.
-HELM_REPO_URL ?= https://example.com/tpu-feature-discovery/charts
+# The gh-pages-style URL docs/ is served from (CI overrides with the
+# actual repository owner's pages URL on release).
+HELM_REPO_URL ?= https://distsys-graft.github.io/tpu-feature-discovery/charts
 
 .PHONY: all build test unit-test check bench clean \
         set-version check-release image helm-package
@@ -49,13 +51,22 @@ image:
 	  --build-arg VERSION=$(VERSION) -t $(IMAGE):$(VERSION) .
 
 # Helm chart package + repo index (the reference's gh-pages
-# docs/index.yaml flow). Requires helm; writes dist/*.tgz and refreshes
-# docs/index.yaml so pushing docs/ publishes the repo.
+# docs/index.yaml flow). Writes dist/*.tgz and refreshes docs/index.yaml
+# so pushing docs/ publishes the repo. Uses helm when present (CI's
+# release job pins one); otherwise the spec-conformant python fallback
+# (scripts/helm_package.py) produces the same two artifacts, so the flow
+# runs end-to-end in helm-less environments too.
 helm-package:
-	mkdir -p dist
-	helm package deployments/helm/tpu-feature-discovery -d dist \
-	  --version $(BARE_VERSION) --app-version $(BARE_VERSION)
-	helm repo index dist --url $(HELM_REPO_URL) \
-	  $(shell [ -f docs/index.yaml ] && echo --merge docs/index.yaml)
-	mkdir -p docs
+	mkdir -p dist docs
+	if command -v helm >/dev/null 2>&1; then \
+	  helm package deployments/helm/tpu-feature-discovery -d dist \
+	    --version $(BARE_VERSION) --app-version $(BARE_VERSION) && \
+	  helm repo index dist --url $(HELM_REPO_URL) \
+	    $(shell [ -f docs/index.yaml ] && echo --merge docs/index.yaml); \
+	else \
+	  python3 scripts/helm_package.py \
+	    --chart deployments/helm/tpu-feature-discovery \
+	    --version $(BARE_VERSION) --dist dist --url $(HELM_REPO_URL) \
+	    $(shell [ -f docs/index.yaml ] && echo --merge docs/index.yaml); \
+	fi
 	cp dist/index.yaml docs/index.yaml

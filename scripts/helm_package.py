@@ -1,0 +1,124 @@
+#!/usr/bin/env python3
+"""Helm-less chart packaging + repo index for `make helm-package`.
+
+The release flow (RELEASE.md step 5) produces dist/<name>-<ver>.tgz and
+docs/index.yaml — the gh-pages-style chart repo surface the reference
+serves from its docs/ directory. CI's tag-triggered release job uses real
+helm (pinned via azure/setup-helm); this fallback produces the same two
+artifacts in environments without a helm binary so the flow itself stays
+runnable end-to-end everywhere:
+
+  - the .tgz is the documented chart archive layout (a gzipped tar whose
+    top-level directory is the chart name),
+  - index.yaml follows the helm repo index schema (apiVersion v1,
+    entries.<name>[] carrying the Chart.yaml fields plus created/digest/
+    urls, digest = sha256 of the .tgz), merging any existing index so
+    prior releases stay listed.
+
+Chart dependencies (the NFD subchart) are NOT vendored into the archive —
+same as the committed chart; `helm dependency update` fetches them at
+install time (deployments/helm/tpu-feature-discovery/Chart.yaml note).
+
+Usage: helm_package.py --chart DIR --version X.Y.Z --dist DIR --url URL
+                       [--merge INDEX]
+"""
+
+import argparse
+import datetime
+import hashlib
+import io
+import sys
+import tarfile
+from pathlib import Path
+
+import yaml
+
+
+def load_chart(chart_dir, version):
+    chart = yaml.safe_load((chart_dir / "Chart.yaml").read_text())
+    chart["version"] = version
+    chart["appVersion"] = version
+    return chart
+
+
+def package(chart_dir, chart, dist):
+    """Writes dist/<name>-<version>.tgz with the chart-name top dir."""
+    name = chart["name"]
+    out = dist / f"{name}-{chart['version']}.tgz"
+    buf = io.BytesIO()
+    # Rewrite Chart.yaml inside the archive with the release version so
+    # the package is self-consistent even mid-bump.
+    chart_yaml = yaml.safe_dump(chart, sort_keys=False).encode()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+        for path in sorted(chart_dir.rglob("*")):
+            if path.is_dir():
+                continue
+            rel = path.relative_to(chart_dir)
+            # Vendored dependency archives (charts/) are not packaged —
+            # tested on the CHART-relative path, not the absolute one
+            # (an ancestor directory named 'charts' must not exclude
+            # the whole chart).
+            if "charts" in rel.parts[:-1]:
+                continue
+            arcname = f"{name}/{rel}"
+            if rel == Path("Chart.yaml"):
+                info = tarfile.TarInfo(arcname)
+                info.size = len(chart_yaml)
+                tar.addfile(info, io.BytesIO(chart_yaml))
+            else:
+                tar.add(path, arcname=arcname)
+    out.write_bytes(buf.getvalue())
+    return out
+
+
+def index_entry(chart, tgz, url):
+    digest = hashlib.sha256(tgz.read_bytes()).hexdigest()
+    created = datetime.datetime.now(datetime.timezone.utc).isoformat()
+    entry = dict(chart)
+    entry.update({
+        "created": created,
+        "digest": digest,
+        "urls": [f"{url.rstrip('/')}/{tgz.name}"],
+    })
+    return entry
+
+
+def write_index(entry, name, dist, merge):
+    index = {"apiVersion": "v1", "entries": {}}
+    if merge and merge.exists():
+        index = yaml.safe_load(merge.read_text()) or index
+        index.setdefault("entries", {})
+    versions = [e for e in index["entries"].get(name, [])
+                if e.get("version") != entry["version"]]
+    versions.insert(0, entry)
+    index["entries"][name] = versions
+    index["generated"] = entry["created"]
+    out = dist / "index.yaml"
+    out.write_text(yaml.safe_dump(index, sort_keys=False))
+    return out
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--chart", type=Path, required=True)
+    parser.add_argument("--version", required=True,
+                        help="bare X.Y.Z (no leading v)")
+    parser.add_argument("--dist", type=Path, required=True)
+    parser.add_argument("--url", required=True,
+                        help="base URL the repo will be served from")
+    parser.add_argument("--merge", type=Path,
+                        help="existing index.yaml to keep prior releases")
+    args = parser.parse_args()
+
+    args.dist.mkdir(parents=True, exist_ok=True)
+    chart = load_chart(args.chart, args.version)
+    tgz = package(args.chart, chart, args.dist)
+    entry = index_entry(chart, tgz, args.url)
+    index = write_index(entry, chart["name"], args.dist, args.merge)
+    print(f"packaged {tgz} (sha256 {entry['digest'][:12]}…), "
+          f"index {index}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,13 +28,16 @@ RELEASE=tfd-e2e
 TIMEOUT_S=${TFD_E2E_TIMEOUT:-300}
 
 helm dependency update "$CHART"
+# Registered BEFORE the install: a failed/timed-out --wait must not leave
+# the partial release on the shared cluster (uninstall of a never-
+# installed release is harmless).
+if [ -z "${TFD_KEEP:-}" ]; then
+  trap 'helm uninstall "$RELEASE" 2>/dev/null || true' EXIT
+fi
 helm upgrade --install "$RELEASE" "$CHART" \
   --set image.repository="$IMAGE_NAME" \
   --set image.tag="$VERSION" \
   --wait --timeout "${TIMEOUT_S}s"
-if [ -z "${TFD_KEEP:-}" ]; then
-  trap 'helm uninstall "$RELEASE"' EXIT
-fi
 
 # Fail fast when the pool never provisioned: zero TPU nodes is
 # unrecoverable from the first iteration — don't burn the poll timeout.

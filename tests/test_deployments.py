@@ -199,6 +199,68 @@ class TestReleaseMachinery:
         readme = (REPO / "README.md").read_text()
         assert "LICENSE" in readme and "CONTRIBUTING.md" in readme
 
+    def test_helm_package_fallback_artifacts(self, tmp_path):
+        """scripts/helm_package.py — the helm-less half of
+        `make helm-package` — must produce the documented chart-repo
+        surface: a .tgz whose top-level dir is the chart name and whose
+        inner Chart.yaml carries the release version, plus an index.yaml
+        whose digest matches the archive; --merge keeps prior releases."""
+        import hashlib
+        import tarfile
+
+        def run(version, merge=None):
+            args = [sys.executable,
+                    str(REPO / "scripts" / "helm_package.py"),
+                    "--chart", str(HELM), "--version", version,
+                    "--dist", str(tmp_path),
+                    "--url", "https://charts.example/repo"]
+            if merge:
+                args += ["--merge", str(merge)]
+            proc = subprocess.run(args, capture_output=True, text=True)
+            assert proc.returncode == 0, proc.stdout + proc.stderr
+
+        run("9.9.9")
+        tgz = tmp_path / "tpu-feature-discovery-9.9.9.tgz"
+        with tarfile.open(tgz) as tar:
+            names = tar.getnames()
+            assert all(n.startswith("tpu-feature-discovery/")
+                       for n in names), names
+            chart = yaml.safe_load(
+                tar.extractfile("tpu-feature-discovery/Chart.yaml").read())
+            assert chart["version"] == "9.9.9"
+            assert chart["appVersion"] == "9.9.9"
+        index = yaml.safe_load((tmp_path / "index.yaml").read_text())
+        assert index["apiVersion"] == "v1"
+        entry = index["entries"]["tpu-feature-discovery"][0]
+        assert entry["digest"] == hashlib.sha256(
+            tgz.read_bytes()).hexdigest()
+        assert entry["urls"] == [
+            "https://charts.example/repo/tpu-feature-discovery-9.9.9.tgz"]
+        # A later release merged over the same index keeps both versions.
+        run("9.9.10", merge=tmp_path / "index.yaml")
+        merged = yaml.safe_load((tmp_path / "index.yaml").read_text())
+        versions = {e["version"] for e in
+                    merged["entries"]["tpu-feature-discovery"]}
+        assert versions == {"9.9.9", "9.9.10"}
+
+    def test_repo_index_published(self):
+        """The release flow has been run for real at least once:
+        docs/index.yaml (the served chart-repo index) exists, parses,
+        and carries well-formed entries. Deliberately does NOT require
+        the CURRENT VERSION to be listed — RELEASE.md runs `make test`
+        (step 2) before `make helm-package` (step 5), so mid-release the
+        index legitimately still lists only prior versions."""
+        index = yaml.safe_load((REPO / "docs" / "index.yaml").read_text())
+        assert index["apiVersion"] == "v1"
+        entries = index["entries"]["tpu-feature-discovery"]
+        assert entries, "index carries no releases"
+        for entry in entries:
+            assert re.fullmatch(r"[0-9a-f]{64}", entry["digest"])
+            assert entry["urls"][0].endswith(
+                f"tpu-feature-discovery-{entry['version']}.tgz")
+            assert "example.com" not in entry["urls"][0], \
+                "index published with the placeholder repo URL"
+
     def test_set_version_rejects_malformed(self, tmp_path):
         """Malformed versions must be rejected up front — a loose glob
         would write 'v1garbage' into VERSION, Chart.yaml and every image

@@ -69,4 +69,8 @@ helm-package:
 	    --version $(BARE_VERSION) --dist dist --url $(HELM_REPO_URL) \
 	    $(shell [ -f docs/index.yaml ] && echo --merge docs/index.yaml); \
 	fi
+	# docs/ is the SERVED repo root (gh-pages): the index AND the chart
+	# archives live there, so the urls the index records actually resolve.
+	mkdir -p docs/charts
+	cp dist/*.tgz docs/charts/
 	cp dist/index.yaml docs/index.yaml

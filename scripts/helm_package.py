@@ -87,7 +87,10 @@ def write_index(entry, name, dist, merge):
     index = {"apiVersion": "v1", "entries": {}}
     if merge and merge.exists():
         index = yaml.safe_load(merge.read_text()) or index
-        index.setdefault("entries", {})
+        # An empty `entries:` key parses as None — setdefault won't
+        # replace it.
+        if not index.get("entries"):
+            index["entries"] = {}
     versions = [e for e in index["entries"].get(name, [])
                 if e.get("version") != entry["version"]]
     versions.insert(0, entry)

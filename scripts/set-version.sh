@@ -28,7 +28,8 @@ echo "$NEW" > "$ROOT/VERSION"
 for f in "$ROOT"/deployments/static/*.yaml \
          "$ROOT"/deployments/static/*.yaml.template; do
   [ -f "$f" ] || continue
-  sed -i "s|tpu-feature-discovery:v[0-9][0-9a-zA-Z.+-]*|tpu-feature-discovery:${NEW}|g" "$f"
+  sed -i "s|tpu-feature-discovery:v[0-9][0-9a-zA-Z.+-]*|tpu-feature-discovery:${NEW}|g; \
+          s|app.kubernetes.io/version: [0-9][0-9a-zA-Z.+-]*|app.kubernetes.io/version: ${BARE}|g" "$f"
 done
 
 # Top-level version/appVersion only: the NFD subchart pin under

@@ -23,20 +23,41 @@ $DIR/deployments/static/tpu-feature-discovery-job.yaml.template
 
 ret=0
 
+BARE=${VERSION#v}
+# The version strings go into grep REGEXES below; escape their dots so a
+# mangled value like 0x2y0 cannot satisfy the gate.
+ESC_VERSION=$(printf '%s' "$VERSION" | sed 's/\./\\./g')
+ESC_BARE=$(printf '%s' "$BARE" | sed 's/\./\\./g')
+
 for file in ${YAML_FILES}; do
-  if ! grep -qw "tpu-feature-discovery:${VERSION}" "${file}"; then
+  if ! grep -qw "tpu-feature-discovery:${ESC_VERSION}" "${file}"; then
     echo "image tag in ${file} does not match ${VERSION}"
     ret=1
   fi
+  # The app.kubernetes.io/version labels must track the release too:
+  # every occurrence must equal BARE exactly.
+  if grep "app.kubernetes.io/version" "${file}" \
+       | grep -vq "app\.kubernetes\.io/version: ${ESC_BARE}$"; then
+    echo "app.kubernetes.io/version in ${file} does not match ${BARE}"
+    ret=1
+  fi
 done
-
-BARE=${VERSION#v}
 CHART="$DIR/deployments/helm/tpu-feature-discovery/Chart.yaml"
 for field in version appVersion; do
-  if ! grep -q "^${field}: \"${BARE}\"" "${CHART}"; then
+  if ! grep -q "^${field}: \"${ESC_BARE}\"" "${CHART}"; then
     echo "${field} in ${CHART} does not match ${BARE}"
     ret=1
   fi
 done
+
+# The CI container job's hand-written build arg (the tag-triggered
+# release job reads the VERSION file directly and needs no check) —
+# RELEASE.md's plumbing map promises this file is enforced here.
+CI="$DIR/.github/workflows/ci.yml"
+if [ -f "$CI" ] && \
+   ! grep -q -- "--build-arg VERSION=${ESC_VERSION}\b" "$CI"; then
+  echo "container build arg in ${CI} does not match ${VERSION}"
+  ret=1
+fi
 
 exit $ret

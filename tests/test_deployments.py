@@ -175,6 +175,12 @@ class TestReleaseMachinery:
         assert chart["appVersion"] == "9.9.9"
         # The NFD subchart dependency pin must not be rewritten.
         assert chart["dependencies"][0]["version"] != "9.9.9"
+        # app.kubernetes.io/version labels track the release too (they
+        # drifted silently through the v0.2.0 bump before this check).
+        ds = (tmp_path / "deployments/static/"
+              "tpu-feature-discovery-daemonset.yaml").read_text()
+        assert "app.kubernetes.io/version: 9.9.9" in ds
+        assert "app.kubernetes.io/version: 0." not in ds
         proc = subprocess.run(
             ["sh", str(tmp_path / "tests" / "check-yamls.sh"), "v9.9.9"],
             capture_output=True, text=True)
@@ -242,6 +248,11 @@ class TestReleaseMachinery:
         versions = {e["version"] for e in
                     merged["entries"]["tpu-feature-discovery"]}
         assert versions == {"9.9.9", "9.9.10"}
+        # Merging over an index whose `entries:` is empty (parses as
+        # None) must not crash.
+        empty = tmp_path / "empty-index.yaml"
+        empty.write_text("apiVersion: v1\nentries:\n")
+        run("9.9.11", merge=empty)
 
     def test_repo_index_published(self):
         """The release flow has been run for real at least once:
@@ -260,6 +271,11 @@ class TestReleaseMachinery:
                 f"tpu-feature-discovery-{entry['version']}.tgz")
             assert "example.com" not in entry["urls"][0], \
                 "index published with the placeholder repo URL"
+            # The archive each URL names is actually served from docs/
+            # (docs/ is the repo root; URLs end .../charts/<file>).
+            archive = (REPO / "docs" / "charts" /
+                       entry["urls"][0].rsplit("/", 1)[1])
+            assert archive.exists(), f"index names unserved {archive}"
 
     def test_set_version_rejects_malformed(self, tmp_path):
         """Malformed versions must be rejected up front — a loose glob

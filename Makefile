@@ -28,6 +28,17 @@ unit-test: build
 test: build
 	python -m pytest tests/ -x -q
 
+# Static checks with no external linter deps (the reference's `make
+# check` role: gofmt/vet/lint there; sh/py syntax + version pins here).
+# Dockerfile.devel carries the heavier optional linters.
+check:
+	@for f in scripts/*.sh tests/*.sh tests/gke-ci/*.sh; do \
+	  sh -n "$$f" || exit 1; \
+	done; echo "shell scripts parse"
+	@python3 -m compileall -q bench.py scripts/helm_package.py \
+	  tpufd tests && echo "python compiles"
+	@sh tests/check-yamls.sh && echo "version pins consistent"
+
 bench: build
 	python bench.py
 
@@ -72,5 +83,5 @@ helm-package:
 	# docs/ is the SERVED repo root (gh-pages): the index AND the chart
 	# archives live there, so the urls the index records actually resolve.
 	mkdir -p docs/charts
-	cp dist/*.tgz docs/charts/
+	cp dist/tpu-feature-discovery-$(BARE_VERSION).tgz docs/charts/
 	cp dist/index.yaml docs/index.yaml

@@ -35,8 +35,12 @@ for file in ${YAML_FILES}; do
     ret=1
   fi
   # The app.kubernetes.io/version labels must track the release too:
-  # every occurrence must equal BARE exactly.
-  if grep "app.kubernetes.io/version" "${file}" \
+  # the labels must be PRESENT (deleting them would also pass a
+  # matches-only check) and every occurrence must equal BARE exactly.
+  if ! grep -q "app.kubernetes.io/version" "${file}"; then
+    echo "app.kubernetes.io/version labels missing from ${file}"
+    ret=1
+  elif grep "app.kubernetes.io/version" "${file}" \
        | grep -vq "app\.kubernetes\.io/version: ${ESC_BARE}$"; then
     echo "app.kubernetes.io/version in ${file} does not match ${BARE}"
     ret=1
@@ -53,9 +57,11 @@ done
 # The CI container job's hand-written build arg (the tag-triggered
 # release job reads the VERSION file directly and needs no check) —
 # RELEASE.md's plumbing map promises this file is enforced here.
+# ERE so the boundary alternation is POSIX-portable (\b is GNU-only).
 CI="$DIR/.github/workflows/ci.yml"
 if [ -f "$CI" ] && \
-   ! grep -q -- "--build-arg VERSION=${ESC_VERSION}\b" "$CI"; then
+   ! grep -qE -- \
+     "--build-arg VERSION=${ESC_VERSION}([^0-9a-zA-Z.+-]|$)" "$CI"; then
   echo "container build arg in ${CI} does not match ${VERSION}"
   ret=1
 fi

@@ -398,28 +398,139 @@ class TestGkeHarness:
                 assert int(labels["google.com/tpu.count"]) >= 1, \
                     machine_type
 
+    _gke_labels_cache = None
+
+    @classmethod
+    def _real_gke_labels(cls, tfd_binary):
+        """Runs the binary once per test session against the GKE
+        multi-host fixture (cached — three tests consume it) and returns
+        (combined pod-log-style text, node label dict copy)."""
+        if cls._gke_labels_cache is None:
+            from tpufd.fakes.metadata_server import (FakeMetadataServer,
+                                                     gke_tpu_node)
+
+            fixture = gke_tpu_node(machine_type="ct5p-hightpu-4t",
+                                   gke_accelerator="tpu-v5p-slice",
+                                   gke_topology="4x4x4")
+            with FakeMetadataServer(fixture) as server:
+                code, out, err = run_tfd(tfd_binary, [
+                    "--oneshot", "--output-file=", "--backend=metadata",
+                    f"--metadata-endpoint={server.endpoint}",
+                    "--slice-strategy=single",
+                    "--machine-type-file=/dev/null",
+                ], env={"GCE_METADATA_HOST": server.endpoint,
+                        "TPU_WORKER_ID": "7"})
+            assert code == 0, err
+            labels = dict(line.split("=", 1)
+                          for line in out.splitlines() if "=" in line)
+            cls._gke_labels_cache = (err + out, labels)
+        combined, labels = cls._gke_labels_cache
+        return combined, dict(labels)
+
+    @staticmethod
+    def _stub_cloud_clis(tmp_path, node_json_path, pod_logs_path):
+        """Writes stub kubectl/helm onto a bin dir: enough surface for
+        the harness scripts to run END-TO-END hermetically. Every
+        invocation is appended to <bin>/calls.log; `kubectl apply -f -`
+        captures its stdin to <bin>/applied.yaml."""
+        bin_dir = tmp_path / "bin"
+        bin_dir.mkdir(exist_ok=True)
+        (bin_dir / "kubectl").write_text(f"""#!/bin/sh
+echo "kubectl $*" >> "{bin_dir}/calls.log"
+case "$1 $2" in
+  "get nodes")
+    case "$*" in
+      *"-o name"*) echo "node/gke-tpu-node-1" ;;
+      *jsonpath*)  printf "gke-tpu-node-1" ;;
+      *"-o json"*) cat "{node_json_path}" ;;
+    esac ;;
+  "get pods")
+    case "$*" in
+      *jsonpath*) printf "tpu-feature-discovery-abc12" ;;
+      *)          echo "NAME READY" ;;
+    esac ;;
+  "apply -f")  cat > "{bin_dir}/applied.yaml"; echo "job created" ;;
+  "delete job") echo "deleted" ;;
+  "wait --for=condition=complete"*) echo "condition met" ;;
+  "logs "*)    cat "{pod_logs_path}" ;;
+esac
+exit 0
+""")
+        (bin_dir / "helm").write_text(f"""#!/bin/sh
+echo "helm $*" >> "{bin_dir}/calls.log"
+exit 0
+""")
+        for stub in ("kubectl", "helm"):
+            (bin_dir / stub).chmod(0o755)
+        return bin_dir
+
+    def test_integration_script_runs_against_stub_cluster(
+            self, tfd_binary, tmp_path):
+        """EXECUTES ci-run-integration-gke.sh end-to-end against stub
+        kubectl: node discovery, job render+apply (the applied yaml must
+        carry the image and node), wait, succeeded-pod selection, and
+        the label check against the REAL binary's output as pod logs."""
+        import os
+
+        logs, _ = self._real_gke_labels(tfd_binary)
+        (tmp_path / "pod.log").write_text(logs)
+        (tmp_path / "nodes.json").write_text("{}")  # unused by tier 3
+        bin_dir = self._stub_cloud_clis(
+            tmp_path, tmp_path / "nodes.json", tmp_path / "pod.log")
+        proc = subprocess.run(
+            ["sh", str(REPO / "tests" / "ci-run-integration-gke.sh"),
+             "gcr.io/proj/tpu-feature-discovery:v9.9.9"],
+            env=dict(os.environ, PATH=f"{bin_dir}:{os.environ['PATH']}"),
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "Integration run on gke-tpu-node-1 passed" in proc.stdout
+        applied = yaml.safe_load((bin_dir / "applied.yaml").read_text())
+        spec = applied["spec"]["template"]["spec"]
+        assert spec["nodeName"] == "gke-tpu-node-1"
+        assert (spec["containers"][0]["image"]
+                == "gcr.io/proj/tpu-feature-discovery:v9.9.9")
+        calls = (bin_dir / "calls.log").read_text()
+        assert "wait --for=condition=complete" in calls
+        assert "--field-selector=status.phase=Succeeded" in calls
+
+    def test_e2e_script_runs_against_stub_cluster(self, tfd_binary,
+                                                  tmp_path):
+        """EXECUTES ci-run-e2e-gke.sh end-to-end against stub helm +
+        kubectl: dependency update, install with the image values,
+        timestamp-label wait satisfied by REAL binary labels on the stub
+        node, node-label verification, and the uninstall trap."""
+        import json
+        import os
+
+        _, labels = self._real_gke_labels(tfd_binary)
+        labels["cloud.google.com/gke-tpu-accelerator"] = "tpu-v5p-slice"
+        node_json = {"items": [
+            {"metadata": {"name": "gke-tpu-node-1", "labels": labels}}]}
+        (tmp_path / "nodes.json").write_text(json.dumps(node_json))
+        (tmp_path / "pod.log").write_text("")  # unused by tier 4
+        bin_dir = self._stub_cloud_clis(
+            tmp_path, tmp_path / "nodes.json", tmp_path / "pod.log")
+        proc = subprocess.run(
+            ["sh", str(REPO / "tests" / "ci-run-e2e-gke.sh"),
+             "gcr.io/proj/tpu-feature-discovery", "v9.9.9"],
+            env=dict(os.environ, PATH=f"{bin_dir}:{os.environ['PATH']}"),
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "E2E run passed" in proc.stdout
+        calls = (bin_dir / "calls.log").read_text()
+        assert "helm dependency update" in calls
+        assert ("--set image.repository=gcr.io/proj/tpu-feature-discovery"
+                in calls)
+        assert "--set image.tag=v9.9.9" in calls
+        # The cleanup trap ran on success too.
+        assert "helm uninstall tfd-e2e" in calls
+
     def test_label_checker_against_real_binary_output(self, tfd_binary):
         """gke-check-labels.py --stdin must accept the actual binary's
         output for a GKE fixture (klog interleaving included) in both
         required-set and golden modes, and reject an incomplete set."""
-        from tpufd.fakes.metadata_server import (FakeMetadataServer,
-                                                 gke_tpu_node)
-
-        fixture = gke_tpu_node(machine_type="ct5p-hightpu-4t",
-                               gke_accelerator="tpu-v5p-slice",
-                               gke_topology="4x4x4")
         checker = REPO / "tests" / "gke-check-labels.py"
-        with FakeMetadataServer(fixture) as server:
-            proc = run_tfd(tfd_binary, [
-                "--oneshot", "--output-file=", "--backend=metadata",
-                f"--metadata-endpoint={server.endpoint}",
-                "--slice-strategy=single",
-                "--machine-type-file=/dev/null",
-            ], env={"GCE_METADATA_HOST": server.endpoint,
-                    "TPU_WORKER_ID": "7"})
-        code, out, err = proc
-        assert code == 0, err
-        combined = err + out  # job logs interleave stderr and stdout
+        combined, _ = self._real_gke_labels(tfd_binary)
         ok = subprocess.run(
             [sys.executable, str(checker), "--stdin"],
             input=combined, capture_output=True, text=True)

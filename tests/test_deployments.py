@@ -7,6 +7,8 @@ tests/integration-tests.py, e2e-tests.py — hermetic in this build) run
 in-process against the fakes.
 """
 
+import json
+import os
 import re
 import subprocess
 import sys
@@ -290,13 +292,40 @@ class TestReleaseMachinery:
         assert (tmp_path / "VERSION").read_text().strip() == "v0.0.0"
 
 
+class TestLabelDocs:
+    def test_every_schema_label_documented_in_readme(self):
+        """Every label key the daemon can emit (lm/schema.h) must appear
+        in README's label tables — an undocumented label is invisible to
+        the operators selecting on it. Multi-line declarations are
+        folded before extraction; grouped keys like
+        tpu.runtime.{major,minor} are matched by their common prefix."""
+        schema = (REPO / "src" / "tfd" / "lm" / "schema.h").read_text()
+        keys = re.findall(
+            r'inline constexpr char k\w+\[\]\s*=\s*"(google\.com/[^"]+)"',
+            schema.replace("\n    ", " "))
+        assert len(keys) >= 25, "schema extraction regressed"
+        readme = (REPO / "README.md").read_text()
+        undocumented = [
+            key for key in keys
+            if key not in readme
+            # Grouped README rows only — `prefix.{major,minor}` syntax.
+            # A bare-prefix fallback would be vacuous: every tpu.* key's
+            # prefix is a substring of some existing row.
+            and key.rsplit(".", 1)[0] + ".{" not in readme
+        ]
+        assert not undocumented, f"labels missing from README: " \
+                                 f"{undocumented}"
+
+
 class TestGkeHarness:
     """The real-cluster GKE scripts (tests/gke-ci/provision.sh,
-    ci-run-integration-gke.sh, ci-run-e2e-gke.sh) cannot execute here —
-    they need a GCP project with TPU quota. This keeps them from rotting
-    between real runs: syntax, referenced files, the sed patterns they
-    rewrite, the helm values they set, and the label checker they share
-    (driven against the real binary's output)."""
+    ci-run-integration-gke.sh, ci-run-e2e-gke.sh) need a GCP project
+    with TPU quota for a REAL run; this class keeps them working
+    between such runs. Beyond syntax/reference/pattern checks, both
+    driver scripts are EXECUTED end-to-end — success and failure
+    paths — against stub kubectl/helm binaries, with the real daemon's
+    output standing in for pod logs and node labels, so only the
+    cluster itself is faked."""
 
     SCRIPTS = [
         REPO / "tests" / "gke-ci" / "provision.sh",
@@ -439,6 +468,14 @@ class TestGkeHarness:
 echo "kubectl $*" >> "{bin_dir}/calls.log"
 case "$1 $2" in
   "get nodes")
+    # STUB_NO_TPU_NODES models a pool that never provisioned: empty
+    # name/jsonpath output, empty items JSON. jsonpath is matched first
+    # ("-o json" would also glob-match "-o jsonpath=...").
+    [ -n "$STUB_NO_TPU_NODES" ] && {{ \
+      case "$*" in \
+        *jsonpath*) ;; \
+        *"-o json"*) echo '{{"items": []}}' ;; \
+      esac; exit 0; }}
     case "$*" in
       *"-o name"*) echo "node/gke-tpu-node-1" ;;
       *jsonpath*)  printf "gke-tpu-node-1" ;;
@@ -446,7 +483,10 @@ case "$1 $2" in
     esac ;;
   "get pods")
     case "$*" in
-      *jsonpath*) printf "tpu-feature-discovery-abc12" ;;
+      *jsonpath*)
+        # STUB_NO_SUCCEEDED_POD models only-failed retry pods.
+        [ -n "$STUB_NO_SUCCEEDED_POD" ] || \
+          printf "tpu-feature-discovery-abc12" ;;
       *)          echo "NAME READY" ;;
     esac ;;
   "apply -f")  cat > "{bin_dir}/applied.yaml"; echo "job created" ;;
@@ -470,8 +510,6 @@ exit 0
         kubectl: node discovery, job render+apply (the applied yaml must
         carry the image and node), wait, succeeded-pod selection, and
         the label check against the REAL binary's output as pod logs."""
-        import os
-
         logs, _ = self._real_gke_labels(tfd_binary)
         (tmp_path / "pod.log").write_text(logs)
         (tmp_path / "nodes.json").write_text("{}")  # unused by tier 3
@@ -499,9 +537,6 @@ exit 0
         kubectl: dependency update, install with the image values,
         timestamp-label wait satisfied by REAL binary labels on the stub
         node, node-label verification, and the uninstall trap."""
-        import json
-        import os
-
         _, labels = self._real_gke_labels(tfd_binary)
         labels["cloud.google.com/gke-tpu-accelerator"] = "tpu-v5p-slice"
         node_json = {"items": [
@@ -524,6 +559,43 @@ exit 0
         assert "--set image.tag=v9.9.9" in calls
         # The cleanup trap ran on success too.
         assert "helm uninstall tfd-e2e" in calls
+
+    def test_scripts_fail_fast_on_degraded_cluster(self, tfd_binary,
+                                                   tmp_path):
+        """Failure paths execute too: the e2e driver must exit 1
+        immediately (not after the 300s poll) when no TPU nodes exist,
+        and the integration driver when no pod succeeded — an expensive
+        real run must not end with a confusing downstream error."""
+        logs, _ = self._real_gke_labels(tfd_binary)
+        (tmp_path / "pod.log").write_text(logs)
+        (tmp_path / "nodes.json").write_text('{"items": []}')
+        bin_dir = self._stub_cloud_clis(
+            tmp_path, tmp_path / "nodes.json", tmp_path / "pod.log")
+        env = dict(os.environ, PATH=f"{bin_dir}:{os.environ['PATH']}")
+
+        no_nodes = subprocess.run(
+            ["sh", str(REPO / "tests" / "ci-run-e2e-gke.sh"),
+             "img", "v9.9.9"],
+            env=dict(env, STUB_NO_TPU_NODES="1"),
+            capture_output=True, text=True, timeout=60)
+        assert no_nodes.returncode == 1
+        assert "no TPU nodes matched" in no_nodes.stderr
+
+        no_node = subprocess.run(
+            ["sh", str(REPO / "tests" / "ci-run-integration-gke.sh"),
+             "img"],
+            env=dict(env, STUB_NO_TPU_NODES="1"),
+            capture_output=True, text=True, timeout=60)
+        assert no_node.returncode == 1
+        assert "no GKE TPU node found" in no_node.stderr
+
+        no_pod = subprocess.run(
+            ["sh", str(REPO / "tests" / "ci-run-integration-gke.sh"),
+             "img"],
+            env=dict(env, STUB_NO_SUCCEEDED_POD="1"),
+            capture_output=True, text=True, timeout=60)
+        assert no_pod.returncode == 1
+        assert "no succeeded pod" in no_pod.stderr
 
     def test_label_checker_against_real_binary_output(self, tfd_binary):
         """gke-check-labels.py --stdin must accept the actual binary's

@@ -264,6 +264,49 @@ void TestIciWrap() {
   CHECK_TRUE(!slice::ComputeIciWrap(v3, *slice::ParseShape("16x16")));
 }
 
+void TestParserRobustness() {
+  // Hostile-input sweep over every hand-rolled parser: all of them sit
+  // on untrusted surfaces (metadata attributes an agent rewrites, config
+  // files, the probe child's pipe), so malformed input must come back as
+  // an error Result — never a crash, hang, or UB. The CI sanitizer job
+  // runs this same sweep under ASan/UBSan, which is where lifetime or
+  // overflow bugs in the parsers would actually surface.
+  const std::vector<std::string> corpus = {
+      "", " ", "\n", std::string("\0x", 2), "{", "}", "[", "]",
+      "{\"a\":", "[1,",
+      "{\"a\" 1}", "\"unterminated", "nul", "tru", "-", "1e",
+      "0x10", "{\"a\":1}}", "\xff\xfe", "\"\\u12\"", "\"\\q\"",
+      ": : :", "- - -", "a\n  b: c\n x", "key: [unclosed",
+      "4x", "x4", "4x4x4x4", "0x4", "-1x4", "4xx4", "99999999999x2",
+      "1h2", "5", "-5s", "h", "99999999999999999999s",
+      "v5litepod-", "-8", "v99-8", "v5p-3", "v5litepod-0",
+      "ct-hightpu-4t", "ct5lp-hightpu-t", "ct5lp-hightpu-99999999999t",
+  };
+  for (const std::string& text : corpus) {
+    // Each parser either errors or yields a well-defined value; the
+    // CHECKs only count the calls — the sanitizer asserts the rest.
+    (void)jsonlite::Parse(text);
+    (void)yamllite::Parse(text);
+    (void)slice::ParseShape(text);
+    (void)config::ParseDurationSeconds(text);
+    (void)slice::ParseAcceleratorType(text);
+    (void)slice::ParseGkeMachineType(text);
+    (void)gce::ParseTpuEnv(text);
+    int v = 0;
+    (void)ParseNonNegInt(text, &v);
+    g_checks++;
+  }
+  // The deep-nesting guard: a 4 KiB bracket bomb must error via the
+  // depth cap (jsonlite.cc:51), not recurse to a stack overflow.
+  CHECK_TRUE(!jsonlite::Parse(std::string(4096, '[')).ok());
+  // And specific malformed inputs really are rejected, not silently
+  // coerced.
+  CHECK_TRUE(!slice::ParseShape("4xx4").ok());
+  CHECK_TRUE(!slice::ParseAcceleratorType("v5p-3").ok());
+  CHECK_TRUE(!slice::ParseGkeMachineType("ct5lp-hightpu-t").ok());
+  CHECK_TRUE(!config::ParseDurationSeconds("-5s").ok());
+}
+
 void TestDuration() {
   CHECK_EQ(config::ParseDurationSeconds("60s").value(), 60);
   CHECK_EQ(config::ParseDurationSeconds("1m30s").value(), 90);
@@ -664,6 +707,7 @@ int main() {
   tfd::TestShapeGrammar();
   tfd::TestFamilyTable();
   tfd::TestIciWrap();
+  tfd::TestParserRobustness();
   tfd::TestDuration();
   tfd::TestConfigPrecedence();
   tfd::TestResourceLabelsNone();

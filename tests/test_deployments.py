@@ -305,14 +305,25 @@ class TestLabelDocs:
             schema.replace("\n    ", " "))
         assert len(keys) >= 25, "schema extraction regressed"
         readme = (REPO / "README.md").read_text()
-        undocumented = [
-            key for key in keys
-            if key not in readme
-            # Grouped README rows only — `prefix.{major,minor}` syntax.
-            # A bare-prefix fallback would be vacuous: every tpu.* key's
-            # prefix is a substring of some existing row.
-            and key.rsplit(".", 1)[0] + ".{" not in readme
-        ]
+        # Grouped README rows — `prefix.{major,minor}` syntax: a key is
+        # documented when its LEAF appears inside its prefix's braces (a
+        # prefix-only check would pass a new key added to an existing
+        # group without updating the row).
+        grouped = {}
+        for prefix, leaves in re.findall(
+                r"`?([a-z.\-/]+)\.\{([^}]+)\}", readme):
+            # A prefix may appear in several rows (tpu.health.{ok,...}
+            # and tpu.health.{matmul-tflops,...}): union, don't clobber.
+            grouped.setdefault(prefix, set()).update(
+                re.split(r"[,:]", leaves))
+
+        def documented(key):
+            if key in readme:
+                return True
+            prefix, leaf = key.rsplit(".", 1)
+            return leaf in grouped.get(prefix, set())
+
+        undocumented = [key for key in keys if not documented(key)]
         assert not undocumented, f"labels missing from README: " \
                                  f"{undocumented}"
 

@@ -315,7 +315,7 @@ class TestLabelDocs:
             # A prefix may appear in several rows (tpu.health.{ok,...}
             # and tpu.health.{matmul-tflops,...}): union, don't clobber.
             grouped.setdefault(prefix, set()).update(
-                re.split(r"[,:]", leaves))
+                leaf.strip() for leaf in re.split(r"[,:]", leaves))
 
         def documented(key):
             if key in readme:

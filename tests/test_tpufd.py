@@ -190,6 +190,7 @@ def test_bench_json_contract():
     assert record["value"] > 0
     assert record["vs_baseline"] > 0
     assert "tpu_matmul_tflops" not in record  # probe explicitly skipped
+    assert "daemon_health_ok" not in record  # daemon probe skipped too
     # Per-backend p50s: mock + the two hermetically-drivable real code
     # paths must carry numbers; pjrt_real may honestly be null (no chip).
     p50s = record["p50_ms"]

@@ -77,7 +77,7 @@ def run_tfd(binary, args, env=None, timeout=60):
     """Runs the binary; returns (exit_code, stdout, stderr)."""
     full_env = dict(os.environ)
     # Isolate from any real GCE metadata reachable from CI.
-    full_env.setdefault("GCE_METADATA_HOST", "invalid.localdomain:1")
+    full_env.setdefault("GCE_METADATA_HOST", "127.0.0.1:1")
     if env:
         full_env.update(env)
     proc = subprocess.run(

@@ -637,7 +637,7 @@ class TestPjrtInitWatchdog:
         count_file = tmp_path / "creates"
         stderr_file = tmp_path / "stderr"
         env = dict(os.environ,
-                   GCE_METADATA_HOST="invalid.localdomain:1",
+                   GCE_METADATA_HOST="127.0.0.1:1",
                    TFD_FAKE_PJRT_COUNT_FILE=str(count_file))
         env.update(env_extra)
         env = {k: v for k, v in env.items() if v is not None}

@@ -137,7 +137,7 @@ def test_sleep_loop_rewrites_and_cleanup(tfd_binary, tmp_path):
     file (reference main_test.go:184-271 and main.go:220-240)."""
     out_file = tmp_path / "tfd"
     env = dict(os.environ)
-    env.setdefault("GCE_METADATA_HOST", "invalid.localdomain:1")
+    env.setdefault("GCE_METADATA_HOST", "127.0.0.1:1")
     proc = subprocess.Popen(
         [str(tfd_binary), "--sleep-interval=1s", "--backend=mock",
          f"--mock-topology-file={FIXTURES / 'v2-8.yaml'}",
@@ -176,7 +176,7 @@ def test_sighup_reload(tfd_binary, tmp_path):
     (reference main.go:150-152,207-211)."""
     out_file = tmp_path / "tfd"
     env = dict(os.environ)
-    env.setdefault("GCE_METADATA_HOST", "invalid.localdomain:1")
+    env.setdefault("GCE_METADATA_HOST", "127.0.0.1:1")
     proc = subprocess.Popen(
         [str(tfd_binary), "--sleep-interval=60s", "--backend=mock",
          f"--mock-topology-file={FIXTURES / 'v2-8.yaml'}",
@@ -342,7 +342,7 @@ def test_device_health_full_sigterm_during_probe(tfd_binary, tmp_path):
          f"--mock-topology-file={FIXTURES / 'v2-8.yaml'}",
          "--machine-type-file=/dev/null", "--device-health=full",
          "--health-exec=sleep 120", "--health-exec-timeout=100s"],
-        env={**os.environ, "GCE_METADATA_HOST": "invalid.localdomain:1"},
+        env={**os.environ, "GCE_METADATA_HOST": "127.0.0.1:1"},
         stderr=subprocess.DEVNULL)
     try:
         time.sleep(1.0)  # let it reach the probe
@@ -369,7 +369,7 @@ def test_device_health_full_probe_cached_across_passes(tfd_binary, tmp_path):
          "--machine-type-file=/dev/null", "--device-health=full",
          f"--health-exec=echo run >> {counter}; "
          "printf 'google.com/tpu.health.ok=true\\n'"],
-        env={**os.environ, "GCE_METADATA_HOST": "invalid.localdomain:1"},
+        env={**os.environ, "GCE_METADATA_HOST": "127.0.0.1:1"},
         stderr=subprocess.DEVNULL)
     try:
         time.sleep(3.5)  # ~3 labeling passes
@@ -395,7 +395,7 @@ def test_device_health_full_real_probe_feature_file(tfd_binary, tmp_path):
          "--machine-type-file=/dev/null", "--device-health=full",
          "--health-exec=python3 -m tpufd health"],
         env={**os.environ, **env,
-             "GCE_METADATA_HOST": "invalid.localdomain:1"},
+             "GCE_METADATA_HOST": "127.0.0.1:1"},
         capture_output=True, text=True, timeout=300)
     assert proc.returncode == 0, proc.stderr
     labels = labels_of(out_file.read_text())

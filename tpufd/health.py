@@ -32,10 +32,12 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 # rated HBM bandwidth on healthy silicon (the rated figure is the
 # theoretical pin rate), while the MXU matmul probe reaches ~95%+ of
 # rated TFLOP/s. Independent same-chip sessions agree: the sign-flip
-# stream measured 649.1 GB/s (79.3% of rated) and 658.5 GB/s (80.4%) on
-# a real v5e in two separate sessions, with matmul at 193.3 and 191.5
-# TFLOP/s (98.1%, 97.2%) — the band is stream efficiency, not noise,
-# and kernel-body variants land inside it too (see _stream below). The
+# stream measured 649.1 GB/s (79.3% of rated), 658.5 GB/s (80.4%), and
+# — via the shipped daemon's --device-health=full exec path — 705 GB/s
+# (86.1%) on a real v5e across three separate sessions, with matmul at
+# 193.3/191.5/193.0 TFLOP/s (97-98%) — the band is stream efficiency,
+# not noise, and kernel-body variants land inside it too (see _stream
+# below). The
 # health labeler therefore publishes the rated figure
 # and the measured percentage next to each measurement, and only flags
 # degradation below DEGRADED_PCT — so an operator never misreads a

@@ -26,17 +26,28 @@ Result<std::string> MetadataClient::Get(const std::string& path) const {
   http::RequestOptions options;
   options.timeout_ms = timeout_ms_;
   options.headers["Metadata-Flavor"] = "Google";
+  bool server_reached = false;
+  options.server_reached = &server_reached;
   Result<http::Response> resp = http::Request(
       "GET", "http://" + endpoint_ + "/computeMetadata/v1/" + path, "",
       options);
-  if (!resp.ok()) return Result<std::string>::Error(resp.error());
+  if (!resp.ok()) {
+    // A garbage-speaking or close-without-a-byte endpoint still proves
+    // something is listening; only resolve/connect failure is transport.
+    last_error_kind_ =
+        server_reached ? ErrorKind::kHttpStatus : ErrorKind::kTransport;
+    return Result<std::string>::Error(resp.error());
+  }
   if (resp->status == 404) {
+    last_error_kind_ = ErrorKind::kNotFound;
     return Result<std::string>::Error("metadata key not found: " + path);
   }
   if (resp->status != 200) {
+    last_error_kind_ = ErrorKind::kHttpStatus;
     return Result<std::string>::Error("metadata GET " + path + ": HTTP " +
                                       std::to_string(resp->status));
   }
+  last_error_kind_ = ErrorKind::kNone;
   return resp->body;
 }
 

@@ -22,6 +22,20 @@ namespace gce {
 
 class MetadataClient {
  public:
+  // Classifies the most recent Get() failure. Callers that stack multiple
+  // metadata rungs (the watchdog's pin planner) branch on this instead of
+  // matching error-message substrings: a kTransport failure (nothing
+  // answered at all) means every further request would pay its own connect
+  // timeout for nothing, while kNotFound/kHttpStatus (and a garbage- or
+  // oversized-answer kHttpStatus) prove the server is reachable.
+  enum class ErrorKind {
+    kNone,        // last Get succeeded
+    kTransport,   // resolve/connect failed: nothing listening at all
+    kHttpStatus,  // endpoint reached but answered badly (non-200/404
+                  // status, garbage, or closed without a byte)
+    kNotFound,    // HTTP 404: server up, key absent (the GKE shape)
+  };
+
   // `endpoint`: "host[:port]". Empty → $GCE_METADATA_HOST or
   // metadata.google.internal. Timeouts are per-request, in milliseconds.
   explicit MetadataClient(std::string endpoint = "", int timeout_ms = 1500);
@@ -29,6 +43,11 @@ class MetadataClient {
   // GET /computeMetadata/v1/<path> with Metadata-Flavor: Google.
   // `path` example: "instance/attributes/accelerator-type".
   Result<std::string> Get(const std::string& path) const;
+
+  // Kind of the most recent Get() outcome (including Gets made internally
+  // by the convenience wrappers; wrappers that fall back across several
+  // keys report the LAST request's kind).
+  ErrorKind last_error_kind() const { return last_error_kind_; }
 
   // True if the metadata server answers at all (cheap liveness probe).
   bool Available() const;
@@ -50,6 +69,9 @@ class MetadataClient {
  private:
   std::string endpoint_;
   int timeout_ms_;
+  // Mutable: Get() is logically const (no client state changes) but records
+  // its outcome for the caller; the client is used single-threaded.
+  mutable ErrorKind last_error_kind_ = ErrorKind::kNone;
 };
 
 // Parses the tpu-env attribute format: lines of KEY: 'value' (value quoting

@@ -148,12 +148,19 @@ PinPlan PlanHostPinning(const config::Flags& flags) {
   if (flags.pjrt_multihost) return plan;  // operator chose whole-slice init
 
   // Env evidence: the TPU runtime agent exports the slice's worker list.
+  // Empty fields (a trailing comma, accidental double commas) are not
+  // hosts: counting them would fail the chips%hosts divisibility check
+  // below and demote the pin to the generic bounds.
   const char* hostnames = getenv("TPU_WORKER_HOSTNAMES");
-  if (hostnames != nullptr &&
-      std::strchr(hostnames, ',') != nullptr) {
-    plan.pin = true;
-    plan.host_count =
-        static_cast<int>(SplitString(hostnames, ',').size());
+  if (hostnames != nullptr) {
+    int hosts = 0;
+    for (const std::string& part : SplitString(hostnames, ',')) {
+      if (!TrimSpace(part).empty()) hosts++;
+    }
+    if (hosts > 1) {
+      plan.pin = true;
+      plan.host_count = hosts;
+    }
   }
 
   plan.metadata_plausible =
@@ -167,12 +174,12 @@ PinPlan PlanHostPinning(const config::Flags& flags) {
   // A TRANSPORT-level failure (no HTTP response at all — connect/resolve
   // failed) means every further rung would stack its own connect timeout
   // onto the probe for nothing — bail. Any HTTP response, including 404
-  // "metadata key not found" (the GKE shape: no tpu-env, server answers)
-  // and transient 5xx ("metadata GET ...: HTTP 503"), proves the server
-  // is answering, so the remaining rungs stay worth trying.
-  if (!env.ok() &&
-      env.error().find("metadata key not found") == std::string::npos &&
-      env.error().find("HTTP") == std::string::npos) {
+  // (the GKE shape: no tpu-env, server answers), transient 5xx, and even
+  // a garbage-speaking endpoint, proves the server is answering, so the
+  // remaining rungs stay worth trying. The classification is the client's
+  // structured signal, not error-message matching.
+  if (!env.ok() && client.last_error_kind() ==
+                       gce::MetadataClient::ErrorKind::kTransport) {
     return plan;
   }
   if (env.ok()) {

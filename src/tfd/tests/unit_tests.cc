@@ -4,6 +4,10 @@
 // internal/resource/*_test.go): yamllite, the slice-shape grammar, the
 // family table, config precedence, label generation per strategy, sharing,
 // and the fallback decorator.
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <sys/wait.h>
 #include <unistd.h>
 
 #include <cassert>
@@ -698,6 +702,97 @@ void TestForkedCapture() {
   CHECK_TRUE(out.error().find("timed out") != std::string::npos);
 }
 
+// Serves exactly one TCP connection with a canned byte payload from a
+// forked child; returns the bound port. Waits for the child in the caller
+// via waitpid (pid out-param).
+int ServeOnce(const std::string& payload, pid_t* pid) {
+  // NOTE: no side effects inside assert() — the suite builds with NDEBUG.
+  int listener = socket(AF_INET, SOCK_STREAM, 0);
+  if (listener < 0) return -1;
+  int one = 1;
+  setsockopt(listener, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr = {};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = 0;
+  socklen_t len = sizeof(addr);
+  if (bind(listener, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      listen(listener, 1) != 0 ||
+      getsockname(listener, reinterpret_cast<sockaddr*>(&addr), &len) != 0) {
+    close(listener);
+    return -1;
+  }
+  *pid = fork();
+  if (*pid < 0) {
+    close(listener);
+    return -1;
+  }
+  if (*pid == 0) {
+    int conn = accept(listener, nullptr, nullptr);
+    if (conn >= 0) {
+      char buf[4096];
+      (void)!read(conn, buf, sizeof(buf));  // drain the request headers
+      (void)!write(conn, payload.data(), payload.size());
+      close(conn);
+    }
+    _exit(0);
+  }
+  close(listener);
+  return ntohs(addr.sin_port);
+}
+
+void TestMetadataErrorKinds() {
+  using ErrorKind = gce::MetadataClient::ErrorKind;
+  auto get_kind = [](const std::string& payload) {
+    pid_t pid = -1;
+    int port = ServeOnce(payload, &pid);
+    CHECK_TRUE(port > 0);
+    gce::MetadataClient client("127.0.0.1:" + std::to_string(port), 2000);
+    Result<std::string> r = client.Get("instance/attributes/tpu-env");
+    CHECK_TRUE(!r.ok());
+    int status = 0;
+    waitpid(pid, &status, 0);
+    return client.last_error_kind();
+  };
+
+  // Transport: nothing listens on the hermetic poison port.
+  gce::MetadataClient down("127.0.0.1:1", 500);
+  CHECK_TRUE(!down.Get("instance/id").ok());
+  CHECK_TRUE(down.last_error_kind() == ErrorKind::kTransport);
+
+  // 404: server up, key absent (the GKE shape).
+  CHECK_TRUE(get_kind("HTTP/1.1 404 Not Found\r\nContent-Length: 0"
+                      "\r\nConnection: close\r\n\r\n") ==
+             ErrorKind::kNotFound);
+
+  // Transient 5xx: server answering; rungs stay worth trying.
+  CHECK_TRUE(get_kind("HTTP/1.1 503 Unavailable\r\nContent-Length: 0"
+                      "\r\nConnection: close\r\n\r\n") ==
+             ErrorKind::kHttpStatus);
+
+  // A garbage-speaking endpoint answered — NOT a transport failure (the
+  // pin planner must keep trying its remaining rungs). Before the
+  // structured signal this was misclassified by substring matching.
+  CHECK_TRUE(get_kind("not http at all") == ErrorKind::kHttpStatus);
+
+  // Accept-then-close without a byte: something IS listening (a proxy
+  // starting up), so remaining rungs fail fast and stay worth trying.
+  CHECK_TRUE(get_kind("") == ErrorKind::kHttpStatus);
+
+  // Success resets the kind.
+  pid_t pid = -1;
+  int port = ServeOnce(
+      "HTTP/1.1 200 OK\r\nContent-Length: 2\r\nConnection: close\r\n\r\nok",
+      &pid);
+  gce::MetadataClient ok_client("127.0.0.1:" + std::to_string(port), 2000);
+  Result<std::string> r = ok_client.Get("instance/id");
+  CHECK_TRUE(r.ok());
+  CHECK_EQ(*r, "ok");
+  CHECK_TRUE(ok_client.last_error_kind() == ErrorKind::kNone);
+  int status = 0;
+  waitpid(pid, &status, 0);
+}
+
 }  // namespace
 }  // namespace tfd
 
@@ -725,6 +820,7 @@ int main() {
   tfd::TestJsonNonFiniteSerialization();
   tfd::TestGkeIdentity();
   tfd::TestForkedCapture();
+  tfd::TestMetadataErrorKinds();
 
   std::cerr << tfd::g_checks << " checks, " << tfd::g_failures << " failures"
             << std::endl;

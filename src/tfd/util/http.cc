@@ -407,6 +407,7 @@ Result<Response> ParseResponse(const std::string& raw) {
 Result<Response> Request(const std::string& method, const std::string& url,
                          const std::string& body,
                          const RequestOptions& options) {
+  if (options.server_reached != nullptr) *options.server_reached = false;
   // SSL_write's underlying write(2) cannot carry MSG_NOSIGNAL, so a peer
   // reset mid-write would raise SIGPIPE and kill the daemon; surface it as
   // an EPIPE error instead.
@@ -418,6 +419,10 @@ Result<Response> Request(const std::string& method, const std::string& url,
 
   Result<int> fd = Connect(*parsed, options.timeout_ms);
   if (!fd.ok()) return Result<Response>::Error(fd.error());
+  // The accepted connection proves a live endpoint; everything after this
+  // point (TLS handshake, garbage, close-without-a-byte) is the server
+  // answering badly, not the transport failing.
+  if (options.server_reached != nullptr) *options.server_reached = true;
 
   std::unique_ptr<Transport> transport;
   if (parsed->tls) {

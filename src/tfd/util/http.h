@@ -38,6 +38,13 @@ struct RequestOptions {
   std::string ca_file;      // PEM bundle for server verification (https)
   bool insecure = false;    // skip server verification (tests only)
   int timeout_ms = 5000;    // per socket operation
+  // When set, *server_reached is written on every outcome: true once the
+  // TCP connection is established — something is listening, even if it
+  // then speaks garbage, closes without a byte, fails the TLS handshake,
+  // or returns an error status. False only for resolve/connect/send-setup
+  // failures. Lets callers distinguish "endpoint is down" from "endpoint
+  // answered badly" without parsing error strings.
+  bool* server_reached = nullptr;
 };
 
 // `url`: http://host[:port]/path or https://host[:port]/path.

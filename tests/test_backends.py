@@ -599,6 +599,37 @@ class TestPjrtInitWatchdog:
             assert labels["google.com/tpu.slice.worker-id"] == "2"
             assert labels["google.com/tpu.topology"] == "4x4"
 
+    def test_hostnames_trailing_comma_not_counted_as_host(self, tfd_binary):
+        """TPU_WORKER_HOSTNAMES with a trailing comma must count 4 hosts,
+        not 5: a phantom host fails the chips%hosts divisibility check and
+        demotes a v6e-32 pin from 2,4,1 (8 chips) to the generic 2,2,1,
+        under-enumerating half the local chips."""
+        fixture = tpu_vm(
+            accelerator_type="v6e-32", topology="4x8",
+            host_bounds=None, chips_per_host_bounds=None,
+            machine_type="n2-standard-8")  # non-ct*: no GKE rung rescue
+        with FakeMetadataServer(fixture) as server:
+            code, out, err = run_tfd(tfd_binary, [
+                "--oneshot", "--output-file=", "--backend=pjrt",
+                f"--libtpu-path={FAKE_PJRT}",
+                "--pjrt-init-timeout=10",
+                f"--metadata-endpoint={server.endpoint}",
+                "--machine-type-file=/dev/null",
+            ], env={
+                "TPU_WORKER_HOSTNAMES": "host-0,host-1,host-2,host-3,",
+                "TFD_FAKE_PJRT_MULTIHOST_HANG": "1",
+                "TFD_FAKE_PJRT_KIND": "TPU v6e",
+                "TFD_FAKE_PJRT_HBM_GIB": "32",
+                "GCE_METADATA_HOST": server.endpoint,
+            })
+            assert code == 0, err
+            labels = labels_of(out)
+            # 8 local chips under the 2,4,1 family pin — the phantom host
+            # would have demoted this to 4. (slice.hosts is absent here:
+            # the fixture carries no HOST_BOUNDS for the overlay.)
+            assert labels["google.com/tpu.count"] == "8"
+            assert labels["google.com/tpu.topology"] == "4x8"
+
     def test_multihost_optin_attempts_whole_slice(self, tfd_binary):
         """--pjrt-multihost skips pinning: the rendezvous-shaped fake then
         hangs (peers never arrive), the watchdog kills it, and auto falls

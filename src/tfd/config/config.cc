@@ -222,6 +222,38 @@ std::vector<FlagDef> MakeFlagDefs(Flags* f) {
   return defs;
 }
 
+// Validates the shape of a sharing `devices` replica-selector: the
+// reference union (replicas.go:45-60) admits the string "all", a count,
+// or a list of device refs (indices or UUID-like strings). Anything else
+// is a config error even though a valid selector is ultimately ignored —
+// rejecting malformed config loudly beats deploying it.
+Status ValidateDevicesSelector(const yamllite::NodePtr& devices) {
+  if (devices->kind == yamllite::Node::Kind::kScalar) {
+    // Count form: the reference union only admits a positive count.
+    if (Result<long long> n = devices->AsInt(); n.ok()) {
+      if (*n >= 1) return Status::Ok();
+      return Status::Error("device count must be >= 1");
+    }
+    std::string s = *devices->AsString();  // AsString never fails on kScalar
+    if (ToLower(TrimSpace(s)) == "all") return Status::Ok();
+    return Status::Error("expected \"all\", a count, or a list of device "
+                         "refs; got scalar '" + s + "'");
+  }
+  if (devices->kind == yamllite::Node::Kind::kList) {
+    if (devices->list_items.empty()) {
+      return Status::Error("device-ref list must not be empty");
+    }
+    for (const yamllite::NodePtr& item : devices->list_items) {
+      if (item->kind != yamllite::Node::Kind::kScalar) {
+        return Status::Error("device refs must be scalars");
+      }
+    }
+    return Status::Ok();
+  }
+  return Status::Error("expected \"all\", a count, or a list of device "
+                       "refs; got a mapping");
+}
+
 Status ApplyYaml(const yamllite::Node& root, const std::vector<FlagDef>& defs,
                  const std::vector<bool>& set_already, Config* config) {
   yamllite::NodePtr version = root.Get("version");
@@ -274,6 +306,30 @@ Status ApplyYaml(const yamllite::Node& root, const std::vector<FlagDef>& defs,
           Result<std::string> v = rename->AsString();
           if (!v.ok()) return v.status();
           r.rename = *v;
+        }
+        // The reference schema lets sharing target a device subset
+        // (vendor/.../config/v1/replicas.go:39-60 — a union of "all", a
+        // count, or a list of device refs). TPU chips are fungible within
+        // a host (no MIG-style partitions to address), so a subset
+        // selector is not honored here; following the reference's own
+        // posture for unsupported sharing knobs (strip-with-warning,
+        // cmd/gpu-feature-discovery/main.go:244-278), a well-formed
+        // `devices` key is validated, warned about, and ignored rather
+        // than silently accepted.
+        yamllite::NodePtr devices = item->Get("devices");
+        // An explicit-null `devices:` is unset, matching the flags loop
+        // above and the reference's yaml unmarshal semantics.
+        if (devices && !devices->IsNull()) {
+          Status s = ValidateDevicesSelector(devices);
+          if (!s.ok()) {
+            return Status::Error("sharing.timeSlicing devices: " +
+                                 s.message());
+          }
+          TFD_LOG_WARNING
+              << "sharing.timeSlicing resource '" << r.name
+              << "' sets 'devices'; per-device replication selectors are "
+                 "not supported on TPU (chips are fungible within a host) "
+              << "-- ignoring the selector and replicating all chips";
         }
         if (replicas) {
           Result<long long> v = replicas->AsInt();

@@ -491,6 +491,55 @@ void TestSharing() {
   remove(c.flags.mock_topology_file.c_str());
 }
 
+void TestSharingDevicesSelector() {
+  // The reference's devices union (replicas.go:45-60): "all", a count, or
+  // a device-ref list. All three load (validated, warned, ignored);
+  // malformed selectors are config errors.
+  auto load_with = [](const std::string& devices_yaml) {
+    std::string path = WriteTemp(
+        "version: v1\nsharing:\n  timeSlicing:\n    resources:\n"
+        "    - name: google.com/tpu\n" + devices_yaml +
+        "      replicas: 2\n");
+    std::vector<std::string> args = {"tfd", "--config-file", path};
+    std::vector<char*> argv;
+    for (auto& a : args) argv.push_back(a.data());
+    auto loaded = config::Load(static_cast<int>(argv.size()), argv.data());
+    remove(path.c_str());
+    return loaded;
+  };
+
+  auto all = load_with("      devices: all\n");
+  CHECK_TRUE(all.ok());
+  if (all.ok()) {
+    CHECK_EQ(static_cast<int>(all->config.sharing.time_slicing.size()), 1);
+    CHECK_EQ(all->config.sharing.time_slicing[0].replicas, 2);
+  }
+
+  auto count = load_with("      devices: 2\n");
+  CHECK_TRUE(count.ok());
+
+  auto list = load_with(
+      "      devices:\n      - 0\n      - TPU-ab12cd\n");
+  CHECK_TRUE(list.ok());
+  if (list.ok()) {
+    CHECK_EQ(list->config.sharing.time_slicing[0].replicas, 2);
+  }
+
+  auto bad_scalar = load_with("      devices: some\n");
+  CHECK_TRUE(!bad_scalar.ok());
+  // The reference union only admits a POSITIVE count.
+  CHECK_TRUE(!load_with("      devices: 0\n").ok());
+  CHECK_TRUE(!load_with("      devices: -3\n").ok());
+  // Explicit-null is unset (sigs.k8s.io/yaml unmarshal semantics).
+  CHECK_TRUE(load_with("      devices:\n").ok());
+  auto bad_map = load_with("      devices:\n        nested: map\n");
+  CHECK_TRUE(!bad_map.ok());
+  if (!bad_map.ok()) {
+    CHECK_TRUE(bad_map.status().message().find("devices") !=
+               std::string::npos);
+  }
+}
+
 void TestFallbackDecorator() {
   const char* fixture = R"(
 initError: simulated init failure
@@ -810,6 +859,7 @@ int main() {
   tfd::TestResourceLabelsMixed();
   tfd::TestInvalidSliceDegradation();
   tfd::TestSharing();
+  tfd::TestSharingDevicesSelector();
   tfd::TestFallbackDecorator();
   tfd::TestFallbackChain();
   tfd::TestBoolParsing();

@@ -115,6 +115,51 @@ def test_config_file(tfd_binary, tmp_path):
     assert "google.com/tpu.product=tpu-v5e-SHARED" in out
 
 
+def test_config_sharing_devices_selector_stripped(tfd_binary, tmp_path):
+    """A `devices` replica-selector (reference replicas.go:39-60) is
+    parsed and validated but not honored on TPU: the daemon warns and
+    replicates all chips — the reference's strip-with-warning posture for
+    unsupported sharing knobs (main.go:244-278), never silent acceptance."""
+    cfg = tmp_path / "config.yaml"
+    cfg.write_text(
+        "version: v1\n"
+        "flags:\n"
+        "  oneshot: true\n"
+        "  outputFile: \"\"\n"
+        "  backend: mock\n"
+        f"  mockTopologyFile: {FIXTURES / 'v5e-4.yaml'}\n"
+        "  machineTypeFile: /dev/null\n"
+        "sharing:\n"
+        "  timeSlicing:\n"
+        "    resources:\n"
+        "    - name: google.com/tpu\n"
+        "      devices:\n"
+        "      - 0\n"
+        "      - 1\n"
+        "      replicas: 4\n")
+    code, out, err = run_tfd(tfd_binary, [f"--config-file={cfg}"])
+    assert code == 0, err
+    # Selector ignored: all 4 chips replicated, not just devices 0-1.
+    assert "google.com/tpu.replicas=16" in out
+    assert "not supported on TPU" in err
+
+    # The "all" form is the supported semantic spelled explicitly — same
+    # labels, still warned (the key itself is unsupported).
+    cfg.write_text(cfg.read_text().replace(
+        "      devices:\n      - 0\n      - 1\n", "      devices: all\n"))
+    code, out, err = run_tfd(tfd_binary, [f"--config-file={cfg}"])
+    assert code == 0, err
+    assert "google.com/tpu.replicas=16" in out
+    assert "not supported on TPU" in err
+
+    # Malformed selector: loud config error, not silent acceptance.
+    cfg.write_text(cfg.read_text().replace(
+        "      devices: all\n", "      devices: frobnicate\n"))
+    code, _, err = run_tfd(tfd_binary, [f"--config-file={cfg}"])
+    assert code == 1
+    assert "devices" in err
+
+
 @pytest.mark.parametrize("fail_on_init,expect_code,expect_labels", [
     ("true", 1, False),   # init error surfaces as failure
     ("false", 0, True),   # degrades to machine-type-only labels

@@ -43,6 +43,26 @@ Status SetString(std::string* dst, const std::string& v) {
   return Status::Ok();
 }
 
+// Appends ';'-separated "key=value" client options (the separator keeps a
+// whole option list expressible through one env var / YAML scalar; PJRT
+// option values in the wild don't contain semicolons). Validation here is
+// shape-only — typing happens where the NamedValues are built
+// (pjrt_manager.cc), so the error surfaces at the backend that uses them.
+Status AppendClientOptions(std::vector<std::string>* dst,
+                           const std::string& v) {
+  for (const std::string& part : SplitString(v, ';')) {
+    std::string opt = TrimSpace(part);
+    if (opt.empty()) continue;
+    size_t eq = opt.find('=');
+    if (eq == 0 || eq == std::string::npos) {
+      return Status::Error("client option '" + opt +
+                           "' is not of the form key=value");
+    }
+    dst->push_back(opt);
+  }
+  return Status::Ok();
+}
+
 Status SetDuration(int* dst, const std::string& v) {
   Result<int> r = ParseDurationSeconds(v);
   if (!r.ok()) return r.status();
@@ -139,6 +159,18 @@ std::vector<FlagDef> MakeFlagDefs(Flags* f) {
                   false,
                   [f](const std::string& v) {
                     return SetString(&f->libtpu_path, v);
+                  }});
+  defs.push_back({"pjrt-client-option",
+                  {"TFD_PJRT_CLIENT_OPTIONS"},
+                  "pjrtClientOptions",
+                  "PJRT_Client_Create NamedValue option as key=value "
+                  "(repeatable; ';'-separated lists accepted). Needed for "
+                  "PJRT proxy plugins that take session/routing options; "
+                  "values are typed by inference or an int:/bool:/float:/"
+                  "str: prefix",
+                  false,
+                  [f](const std::string& v) {
+                    return AppendClientOptions(&f->pjrt_client_options, v);
                   }});
   defs.push_back({"pjrt-init-timeout",
                   {"TFD_PJRT_INIT_TIMEOUT"},
@@ -569,8 +601,16 @@ std::string ToJson(const Config& config) {
       << ",\"machineTypeFile\":" << jstr(f.machine_type_file)
       << ",\"useNodeFeatureAPI\":"
       << (f.use_node_feature_api ? "true" : "false")
-      << ",\"backend\":" << jstr(f.backend)
-      << ",\"pjrtInitTimeout\":\"" << f.pjrt_init_timeout_s << "s\""
+      << ",\"backend\":" << jstr(f.backend);
+  if (!f.pjrt_client_options.empty()) {
+    out << ",\"pjrtClientOptions\":[";
+    for (size_t i = 0; i < f.pjrt_client_options.size(); i++) {
+      if (i) out << ",";
+      out << jstr(f.pjrt_client_options[i]);
+    }
+    out << "]";
+  }
+  out << ",\"pjrtInitTimeout\":\"" << f.pjrt_init_timeout_s << "s\""
       << ",\"pjrtMultihost\":" << (f.pjrt_multihost ? "true" : "false")
       << ",\"pjrtRefreshInterval\":\"" << f.pjrt_refresh_interval_s << "s\""
       << ",\"deviceHealth\":" << jstr(f.device_health)

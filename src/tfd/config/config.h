@@ -61,6 +61,14 @@ struct Flags {
   // TPU-specific knobs (no reference analogue; replaces NVML/CUDA paths):
   std::string backend = "auto";  // auto|pjrt|metadata|mock|null
   std::string libtpu_path;       // override libtpu.so location
+  // PJRT_Client_Create NamedValue create-options, as "key=value" strings.
+  // Stock libtpu needs none, but alternative PJRT plugins (proxies/relays
+  // that tunnel a remote TPU) can require session/routing options the
+  // daemon cannot guess. Value typing: all-digits → int64, true/false →
+  // bool, parseable float → float, else string; an explicit
+  // int:/bool:/float:/str: value prefix overrides the inference
+  // (e.g. remote_compile=int:1, tag=str:123).
+  std::vector<std::string> pjrt_client_options;
   // Hard deadline on PJRT backend init (dlopen + PJRT_Client_Create runs
   // in a killable child process). libtpu's client creation can BLOCK, not
   // fail, on a multi-host slice (slice-wide rendezvous); the deadline

@@ -2,11 +2,199 @@
 
 #include <dlfcn.h>
 
+#include <cctype>
+#include <cerrno>
+#include <cstdlib>
+
 #include "tfd/platform/detect.h"
 #include "tfd/util/logging.h"
+#include "tfd/util/strings.h"
 
 namespace tfd {
 namespace pjrt {
+
+namespace {
+
+// Full-string numeric parses (strtoll/strtod accept partial prefixes and
+// leading whitespace; option values must parse exactly).
+bool ParseFullInt64(const std::string& s, long long* out) {
+  if (s.empty() || isspace(static_cast<unsigned char>(s[0]))) return false;
+  errno = 0;
+  char* end = nullptr;
+  long long v = strtoll(s.c_str(), &end, 10);
+  if (errno != 0 || end != s.c_str() + s.size()) return false;
+  *out = v;
+  return true;
+}
+
+bool ParseFullFloat(const std::string& s, float* out) {
+  if (s.empty() || isspace(static_cast<unsigned char>(s[0]))) return false;
+  errno = 0;
+  char* end = nullptr;
+  float v = strtof(s.c_str(), &end);
+  if (errno != 0 || end != s.c_str() + s.size()) return false;
+  *out = v;
+  return true;
+}
+
+// Shape gates for type INFERENCE (explicit prefixes accept anything their
+// strtoll/strtof parse does): only plain decimals infer numeric, so
+// "nan"/"inf"/"0x10" stay strings instead of becoming surprise floats.
+bool IsPlainInt(const std::string& s) {
+  size_t i = s.size() > 0 && s[0] == '-' ? 1 : 0;
+  if (i >= s.size()) return false;
+  for (; i < s.size(); i++) {
+    if (!isdigit(static_cast<unsigned char>(s[i]))) return false;
+  }
+  return true;
+}
+
+bool IsPlainDecimal(const std::string& s) {
+  size_t i = s.size() > 0 && s[0] == '-' ? 1 : 0;
+  int digits = 0;
+  int dots = 0;
+  for (; i < s.size(); i++) {
+    if (s[i] == '.') {
+      dots++;
+    } else if (isdigit(static_cast<unsigned char>(s[i]))) {
+      digits++;
+    } else {
+      return false;
+    }
+  }
+  return digits > 0 && dots == 1;
+}
+
+}  // namespace
+
+Result<ClientOption> ParseClientOption(const std::string& key_eq_value) {
+  size_t eq = key_eq_value.find('=');
+  if (eq == 0 || eq == std::string::npos) {
+    return Result<ClientOption>::Error("client option '" + key_eq_value +
+                                       "' is not of the form key=value");
+  }
+  ClientOption opt;
+  opt.key = key_eq_value.substr(0, eq);
+  std::string value = key_eq_value.substr(eq + 1);
+
+  // Explicit type prefix wins (lets "tag=str:123" stay a string and
+  // "level=int:0" force the integer even if a plugin update changes the
+  // inference rules).
+  auto forced = [&value](const char* prefix) {
+    if (!HasPrefix(value, prefix)) return false;
+    value = value.substr(std::string(prefix).size());
+    return true;
+  };
+  if (forced("str:")) {
+    opt.type = ClientOption::Type::kString;
+    opt.string_value = value;
+    return opt;
+  }
+  if (forced("int:")) {
+    if (!ParseFullInt64(value, &opt.int64_value)) {
+      return Result<ClientOption>::Error("client option '" + opt.key +
+                                         "': '" + value +
+                                         "' is not an integer");
+    }
+    opt.type = ClientOption::Type::kInt64;
+    return opt;
+  }
+  if (forced("bool:")) {
+    if (value != "true" && value != "false") {
+      return Result<ClientOption>::Error("client option '" + opt.key +
+                                         "': '" + value +
+                                         "' is not true/false");
+    }
+    opt.type = ClientOption::Type::kBool;
+    opt.bool_value = value == "true";
+    return opt;
+  }
+  if (forced("float:")) {
+    if (!ParseFullFloat(value, &opt.float_value)) {
+      return Result<ClientOption>::Error("client option '" + opt.key +
+                                         "': '" + value +
+                                         "' is not a float");
+    }
+    opt.type = ClientOption::Type::kFloat;
+    return opt;
+  }
+
+  // Inference: plain integer → int64, true/false → bool, plain decimal →
+  // float, everything else a string. An integer-SHAPED value that
+  // overflows int64 is an error, not a silent float (a wrong-typed
+  // NamedValue would surface as a confusing plugin-side rejection);
+  // "nan"/"inf"/hex stay strings — force them with float: if meant.
+  if (IsPlainInt(value)) {
+    if (!ParseFullInt64(value, &opt.int64_value)) {
+      return Result<ClientOption>::Error(
+          "client option '" + opt.key + "': integer '" + value +
+          "' out of int64 range (use float: or str: if intended)");
+    }
+    opt.type = ClientOption::Type::kInt64;
+    return opt;
+  }
+  if (value == "true" || value == "false") {
+    opt.type = ClientOption::Type::kBool;
+    opt.bool_value = value == "true";
+    return opt;
+  }
+  if (IsPlainDecimal(value) && ParseFullFloat(value, &opt.float_value)) {
+    opt.type = ClientOption::Type::kFloat;
+    return opt;
+  }
+  opt.type = ClientOption::Type::kString;
+  opt.string_value = value;
+  return opt;
+}
+
+Result<std::vector<ClientOption>> ParseClientOptions(
+    const std::vector<std::string>& options) {
+  std::vector<ClientOption> out;
+  out.reserve(options.size());
+  for (const std::string& raw : options) {
+    Result<ClientOption> opt = ParseClientOption(raw);
+    if (!opt.ok()) return Result<std::vector<ClientOption>>::Error(
+        opt.error());
+    out.push_back(std::move(*opt));
+  }
+  return out;
+}
+
+std::vector<PJRT_NamedValue> ToNamedValues(
+    const std::vector<ClientOption>& options) {
+  std::vector<PJRT_NamedValue> out;
+  out.reserve(options.size());
+  for (const ClientOption& opt : options) {
+    PJRT_NamedValue nv = {};
+    nv.struct_size = PJRT_NamedValue_STRUCT_SIZE;
+    nv.name = opt.key.c_str();
+    nv.name_size = opt.key.size();
+    switch (opt.type) {
+      case ClientOption::Type::kString:
+        nv.type = PJRT_NamedValue_kString;
+        nv.string_value = opt.string_value.c_str();
+        nv.value_size = opt.string_value.size();
+        break;
+      case ClientOption::Type::kInt64:
+        nv.type = PJRT_NamedValue_kInt64;
+        nv.int64_value = opt.int64_value;
+        nv.value_size = 1;
+        break;
+      case ClientOption::Type::kBool:
+        nv.type = PJRT_NamedValue_kBool;
+        nv.bool_value = opt.bool_value;
+        nv.value_size = 1;
+        break;
+      case ClientOption::Type::kFloat:
+        nv.type = PJRT_NamedValue_kFloat;
+        nv.float_value = opt.float_value;
+        nv.value_size = 1;
+        break;
+    }
+    out.push_back(nv);
+  }
+  return out;
+}
 
 Result<std::shared_ptr<PjrtLibrary>> PjrtLibrary::Load(
     const std::string& override_path) {

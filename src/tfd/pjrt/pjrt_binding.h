@@ -10,12 +10,41 @@
 
 #include <memory>
 #include <string>
+#include <vector>
 
 #include "tfd/util/status.h"
 #include "xla/pjrt/c/pjrt_c_api.h"
 
 namespace tfd {
 namespace pjrt {
+
+// A typed PJRT_Client_Create create-option parsed from the config's
+// "key=value" form. Stock libtpu needs none; PJRT proxy plugins (relays
+// that tunnel a remote TPU and need session/routing parameters) reject
+// client creation without theirs, so the daemon forwards operator-supplied
+// options verbatim. Typing is inferred from the value (integer → int64,
+// true/false → bool, decimal → float, else string) with an explicit
+// int:/bool:/float:/str: prefix override for ambiguous cases.
+struct ClientOption {
+  enum class Type { kString, kInt64, kBool, kFloat };
+  std::string key;
+  Type type = Type::kString;
+  std::string string_value;
+  long long int64_value = 0;
+  bool bool_value = false;
+  float float_value = 0;
+};
+
+Result<ClientOption> ParseClientOption(const std::string& key_eq_value);
+
+// Convenience: parses each "key=value"; first malformed option fails.
+Result<std::vector<ClientOption>> ParseClientOptions(
+    const std::vector<std::string>& options);
+
+// Builds the PJRT_NamedValue array for PJRT_Client_Create. The returned
+// values point into `options`, which must outlive any use of them.
+std::vector<PJRT_NamedValue> ToNamedValues(
+    const std::vector<ClientOption>& options);
 
 // Initializes a PJRT arg struct: zero + struct_size (the C API's calling
 // convention for forward/backward compatibility).

@@ -85,8 +85,10 @@ std::string ExtractDottedVersion(const std::string& text) {
 
 class PjrtManager : public Manager {
  public:
-  explicit PjrtManager(std::string libtpu_path)
-      : libtpu_path_(std::move(libtpu_path)) {}
+  PjrtManager(std::string libtpu_path,
+              std::vector<std::string> client_options)
+      : libtpu_path_(std::move(libtpu_path)),
+        client_options_(std::move(client_options)) {}
 
   ~PjrtManager() override { Shutdown(); }
 
@@ -108,7 +110,21 @@ class PjrtManager : public Manager {
       }
     }
 
+    // Operator-supplied NamedValue create-options (PJRT proxy plugins
+    // require session/routing parameters; stock libtpu takes none).
+    Result<std::vector<pjrt::ClientOption>> parsed =
+        pjrt::ParseClientOptions(client_options_);
+    if (!parsed.ok()) {
+      lib_.reset();
+      return Status::Error(parsed.error());
+    }
+    std::vector<PJRT_NamedValue> named = pjrt::ToNamedValues(*parsed);
+
     auto create = TFD_PJRT_ARGS(PJRT_Client_Create_Args);
+    if (!named.empty()) {
+      create.create_options = named.data();
+      create.num_options = named.size();
+    }
     Status s = lib_->ToStatus(api->PJRT_Client_Create(&create),
                               "PJRT_Client_Create");
     if (!s.ok()) {
@@ -388,6 +404,7 @@ class PjrtManager : public Manager {
   }
 
   std::string libtpu_path_;
+  std::vector<std::string> client_options_;
   std::shared_ptr<pjrt::PjrtLibrary> lib_;
   PJRT_Client* client_ = nullptr;
 
@@ -400,8 +417,10 @@ class PjrtManager : public Manager {
 
 }  // namespace
 
-ManagerPtr NewPjrtInProcessManager(const std::string& libtpu_path) {
-  return std::make_shared<PjrtManager>(libtpu_path);
+ManagerPtr NewPjrtInProcessManager(
+    const std::string& libtpu_path,
+    const std::vector<std::string>& client_options) {
+  return std::make_shared<PjrtManager>(libtpu_path, client_options);
 }
 
 }  // namespace resource

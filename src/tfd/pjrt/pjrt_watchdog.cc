@@ -265,7 +265,7 @@ void WriteAll(int fd, const std::string& data) {
 
 // Runs the real in-process PJRT backend and streams its snapshot out as
 // JSON. Runs post-fork: _exits, never returns to the daemon loop.
-int ProbeChild(int fd, const std::string& libtpu_path, const PinPlan& plan) {
+int ProbeChild(int fd, const config::Flags& flags, const PinPlan& plan) {
   if (plan.pin) {
     // Pin client creation to this host. Overwrites ambient values on
     // purpose: the runtime agent's slice-wide env is exactly what must
@@ -278,7 +278,8 @@ int ProbeChild(int fd, const std::string& libtpu_path, const PinPlan& plan) {
     for (const char* env : kRendezvousEnvs) unsetenv(env);
   }
 
-  ManagerPtr inner = NewPjrtInProcessManager(libtpu_path);
+  ManagerPtr inner = NewPjrtInProcessManager(flags.libtpu_path,
+                                             flags.pjrt_client_options);
   Status s = inner->Init();
   ValuePtr doc = MakeObject();
   if (!s.ok()) {
@@ -385,7 +386,8 @@ class PjrtWatchdogManager : public Manager {
     // refresh interval after the stack wedges. Operators enabling health
     // labels are explicitly choosing per-pass chip probes.
     const std::string cache_key =
-        flags_.libtpu_path + "|" + (flags_.pjrt_multihost ? "m" : "p");
+        flags_.libtpu_path + "|" + (flags_.pjrt_multihost ? "m" : "p") +
+        "|" + JoinStrings(flags_.pjrt_client_options, ";");
     const bool cacheable = flags_.pjrt_refresh_interval_s > 0 &&
                            flags_.device_health == "off";
     if (cacheable && g_snapshot_cache.valid &&
@@ -427,7 +429,8 @@ class PjrtWatchdogManager : public Manager {
     // the same cache as the forked path.
     if (flags_.pjrt_init_timeout_s <= 0 ||
         getenv("TFD_PJRT_INPROC") != nullptr) {
-      ManagerPtr inproc = NewPjrtInProcessManager(flags_.libtpu_path);
+      ManagerPtr inproc = NewPjrtInProcessManager(
+          flags_.libtpu_path, flags_.pjrt_client_options);
       Status s = inproc->Init();
       if (!s.ok()) return s;
       Result<std::vector<DevicePtr>> devices = inproc->GetDevices();
@@ -445,9 +448,15 @@ class PjrtWatchdogManager : public Manager {
       inproc->Shutdown();
       initialized_ = true;
       if (cacheable) {
-        g_snapshot_cache = {true, cache_key,
-                            std::chrono::steady_clock::now(), devices_,
-                            libtpu_version_, runtime_version_, topology_};
+        g_snapshot_cache = {true,
+                            cache_key,
+                            std::chrono::steady_clock::now(),
+                            devices_,
+                            libtpu_version_,
+                            runtime_version_,
+                            topology_,
+                            /*pinned=*/false,
+                            /*pinned_topology=*/{}};
       }
       return Status::Ok();
     }
@@ -460,11 +469,11 @@ class PjrtWatchdogManager : public Manager {
                    << "); slice topology will come from metadata";
     }
 
-    std::string libtpu_path = flags_.libtpu_path;
+    const config::Flags& flags = flags_;
     int exit_code = 0;
     Result<std::string> out = RunForkedCapture(
-        [&libtpu_path, &plan](int fd) {
-          return ProbeChild(fd, libtpu_path, plan);
+        [&flags, &plan](int fd) {
+          return ProbeChild(fd, flags, plan);
         },
         flags_.pjrt_init_timeout_s, "PJRT init probe", &exit_code);
     if (!out.ok()) {

@@ -29,7 +29,9 @@ ManagerPtr NewPjrtManager(const config::Config& config);
 // The raw in-process PJRT backend (pjrt_manager.cc): dlopen + client
 // create on the calling thread, no deadline. Runs inside the watchdog's
 // probe child; selectable directly via pjrt-init-timeout=0.
-ManagerPtr NewPjrtInProcessManager(const std::string& libtpu_path);
+ManagerPtr NewPjrtInProcessManager(
+    const std::string& libtpu_path,
+    const std::vector<std::string>& client_options = {});
 
 // The metadata backend — chip inventory derived from the GCE metadata
 // accelerator-type, for nodes where libtpu is absent or busy.

@@ -121,6 +121,56 @@ PJRT_Error* ClientCreate(PJRT_Client_Create_Args* args) {
   std::string fail = EnvStr("TFD_FAKE_PJRT_FAIL", "");
   if (!fail.empty()) return MakeError(fail);
 
+  // Proxy-plugin shape: reject creation unless the required NamedValue
+  // create-options are present with the right type and value. Spec is a
+  // comma-separated list of name:type[:value] with type one of
+  // s|i|b|f — e.g. "session_id:s,rank:i:4294967295,remote_compile:i:1".
+  // This is how the suite proves the daemon's --pjrt-client-option
+  // encoding end-to-end through a real dlopen'd plugin boundary.
+  std::string required = EnvStr("TFD_FAKE_PJRT_REQUIRE_OPTIONS", "");
+  if (!required.empty()) {
+    size_t start = 0;
+    while (start <= required.size()) {
+      size_t comma = required.find(',', start);
+      if (comma == std::string::npos) comma = required.size();
+      std::string spec = required.substr(start, comma - start);
+      start = comma + 1;
+      if (spec.empty()) continue;
+      size_t c1 = spec.find(':');
+      std::string want_name = spec.substr(0, c1);
+      std::string rest = c1 == std::string::npos ? "" : spec.substr(c1 + 1);
+      size_t c2 = rest.find(':');
+      std::string want_type = rest.substr(0, c2);
+      std::string want_value =
+          c2 == std::string::npos ? "" : rest.substr(c2 + 1);
+      bool found = false;
+      for (size_t i = 0; i < args->num_options; i++) {
+        const PJRT_NamedValue& nv = args->create_options[i];
+        if (std::string(nv.name, nv.name_size) != want_name) continue;
+        if (want_type == "s" && nv.type == PJRT_NamedValue_kString) {
+          found = want_value.empty() ||
+                  std::string(nv.string_value, nv.value_size) == want_value;
+        } else if (want_type == "i" && nv.type == PJRT_NamedValue_kInt64) {
+          found = want_value.empty() ||
+                  std::to_string(nv.int64_value) == want_value;
+        } else if (want_type == "b" && nv.type == PJRT_NamedValue_kBool) {
+          found = want_value.empty() ||
+                  (nv.bool_value ? "true" : "false") == want_value;
+        } else if (want_type == "f" && nv.type == PJRT_NamedValue_kFloat) {
+          // Numeric compare: a prefix match on to_string would let a
+          // shifted value (0.55 vs required 0.5) slip through.
+          found = want_value.empty() ||
+                  strtof(want_value.c_str(), nullptr) == nv.float_value;
+        }
+        if (found) break;
+      }
+      if (!found) {
+        return MakeError("missing required NamedValue create-option: " +
+                         spec);
+      }
+    }
+  }
+
   // Real libtpu honors single-host pinning via the bounds env.
   bool pinned = EnvStr("TPU_HOST_BOUNDS", "") == "1,1,1" ||
                 EnvStr("TPU_PROCESS_BOUNDS", "") == "1,1,1";

@@ -25,6 +25,7 @@
 #include "tfd/lm/schema.h"
 #include "tfd/lm/slice_strategy.h"
 #include "tfd/lm/tpu_labeler.h"
+#include "tfd/pjrt/pjrt_binding.h"
 #include "tfd/resource/types.h"
 #include "tfd/slice/shape.h"
 #include "tfd/slice/topology.h"
@@ -491,6 +492,73 @@ void TestSharing() {
   remove(c.flags.mock_topology_file.c_str());
 }
 
+void TestClientOptionParsing() {
+  using pjrt::ClientOption;
+  // Inference: integer / bool / float / string.
+  auto r = pjrt::ParseClientOption("rank=4294967295");
+  CHECK_TRUE(r.ok() && r->type == ClientOption::Type::kInt64);
+  CHECK_EQ(r->int64_value, 4294967295LL);
+  r = pjrt::ParseClientOption("negative=-3");
+  CHECK_TRUE(r.ok() && r->type == ClientOption::Type::kInt64 &&
+             r->int64_value == -3);
+  r = pjrt::ParseClientOption("flag=true");
+  CHECK_TRUE(r.ok() && r->type == ClientOption::Type::kBool &&
+             r->bool_value);
+  r = pjrt::ParseClientOption("ratio=0.5");
+  CHECK_TRUE(r.ok() && r->type == ClientOption::Type::kFloat);
+  r = pjrt::ParseClientOption("topology=v5e:1x1x1");
+  CHECK_TRUE(r.ok() && r->type == ClientOption::Type::kString);
+  CHECK_EQ(r->string_value, "v5e:1x1x1");
+  // Values may contain '=' (only the first splits).
+  r = pjrt::ParseClientOption("kv=a=b");
+  CHECK_TRUE(r.ok() && r->string_value == "a=b");
+
+  // Explicit prefixes override inference.
+  r = pjrt::ParseClientOption("tag=str:123");
+  CHECK_TRUE(r.ok() && r->type == ClientOption::Type::kString &&
+             r->string_value == "123");
+  r = pjrt::ParseClientOption("level=int:7");
+  CHECK_TRUE(r.ok() && r->type == ClientOption::Type::kInt64 &&
+             r->int64_value == 7);
+  r = pjrt::ParseClientOption("b=bool:false");
+  CHECK_TRUE(r.ok() && r->type == ClientOption::Type::kBool &&
+             !r->bool_value);
+  r = pjrt::ParseClientOption("f=float:2");
+  CHECK_TRUE(r.ok() && r->type == ClientOption::Type::kFloat);
+
+  // Inference edge cases: only plain decimal shapes infer numeric —
+  // nan/inf/hex stay strings; integer-shaped overflow is a loud error.
+  r = pjrt::ParseClientOption("tag=nan");
+  CHECK_TRUE(r.ok() && r->type == ClientOption::Type::kString);
+  r = pjrt::ParseClientOption("tag=inf");
+  CHECK_TRUE(r.ok() && r->type == ClientOption::Type::kString);
+  r = pjrt::ParseClientOption("tag=0x10");
+  CHECK_TRUE(r.ok() && r->type == ClientOption::Type::kString);
+  r = pjrt::ParseClientOption("tag=1e9");
+  CHECK_TRUE(r.ok() && r->type == ClientOption::Type::kString);
+  CHECK_TRUE(!pjrt::ParseClientOption("x=18446744073709551615").ok());
+
+  // Malformed.
+  CHECK_TRUE(!pjrt::ParseClientOption("novalue").ok());
+  CHECK_TRUE(!pjrt::ParseClientOption("=v").ok());
+  CHECK_TRUE(!pjrt::ParseClientOption("x=int:abc").ok());
+  CHECK_TRUE(!pjrt::ParseClientOption("x=bool:2").ok());
+  CHECK_TRUE(!pjrt::ParseClientOption("x=float:nope").ok());
+
+  // NamedValue views carry types and sizes per the C-API convention.
+  auto parsed = pjrt::ParseClientOptions(
+      {"session_id=abc", "rank=1", "on=true", "r=0.5"});
+  CHECK_TRUE(parsed.ok());
+  auto nvs = pjrt::ToNamedValues(*parsed);
+  CHECK_EQ(static_cast<int>(nvs.size()), 4);
+  CHECK_TRUE(nvs[0].type == PJRT_NamedValue_kString &&
+             nvs[0].value_size == 3);
+  CHECK_TRUE(nvs[1].type == PJRT_NamedValue_kInt64 &&
+             nvs[1].value_size == 1);
+  CHECK_TRUE(nvs[2].type == PJRT_NamedValue_kBool && nvs[2].bool_value);
+  CHECK_TRUE(nvs[3].type == PJRT_NamedValue_kFloat);
+}
+
 void TestSharingDevicesSelector() {
   // The reference's devices union (replicas.go:45-60): "all", a count, or
   // a device-ref list. All three load (validated, warned, ignored);
@@ -859,6 +927,7 @@ int main() {
   tfd::TestResourceLabelsMixed();
   tfd::TestInvalidSliceDegradation();
   tfd::TestSharing();
+  tfd::TestClientOptionParsing();
   tfd::TestSharingDevicesSelector();
   tfd::TestFallbackDecorator();
   tfd::TestFallbackChain();

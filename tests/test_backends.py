@@ -836,6 +836,135 @@ class TestPjrtInitWatchdog:
         assert labels["google.com/tpu.backend"] == "pjrt"
 
 
+class TestPjrtClientOptions:
+    """--pjrt-client-option forwards NamedValue create-options through the
+    real dlopen'd plugin boundary — the contract PJRT proxy/relay plugins
+    (tunneled-TPU environments) need to create a client at all."""
+
+    REQUIRE = ("session_id:s,rank:i:4294967295,remote_compile:i:1,"
+               "topology:s:v5e:1x1x1,on:b:true")
+
+    def test_options_reach_the_plugin_typed(self, tfd_binary):
+        code, out, err = run_tfd(tfd_binary, pjrt_args([
+            "--pjrt-client-option",
+            "session_id=tfd-test;rank=4294967295;remote_compile=1",
+            "--pjrt-client-option", "topology=v5e:1x1x1",
+            "--pjrt-client-option", "on=true",
+        ]), env={
+            "TFD_FAKE_PJRT_REQUIRE_OPTIONS": self.REQUIRE,
+            "TFD_FAKE_PJRT_KIND": "TPU v5 lite",
+            "TFD_FAKE_PJRT_BOUNDS": "2,2,1",
+        })
+        assert code == 0, err
+        labels = labels_of(out)
+        assert labels["google.com/tpu.backend"] == "pjrt"
+        assert labels["google.com/tpu.count"] == "4"
+
+    def test_missing_option_fails_like_a_proxy_plugin(self, tfd_binary):
+        """Without the options the proxy-shaped plugin rejects client
+        creation, and the daemon surfaces the plugin's own reason."""
+        code, _, err = run_tfd(tfd_binary, pjrt_args(),
+                               env={"TFD_FAKE_PJRT_REQUIRE_OPTIONS":
+                                    self.REQUIRE})
+        assert code == 1
+        assert "missing required NamedValue create-option" in err
+
+    def test_wrong_type_rejected_by_plugin(self, tfd_binary):
+        """A string-forced value must NOT satisfy an int-typed requirement:
+        proves the typed encoding, not just key presence."""
+        code, _, err = run_tfd(tfd_binary, pjrt_args([
+            "--pjrt-client-option",
+            "session_id=x;rank=str:4294967295;remote_compile=1",
+            "--pjrt-client-option", "topology=v5e:1x1x1",
+            "--pjrt-client-option", "on=true",
+        ]), env={"TFD_FAKE_PJRT_REQUIRE_OPTIONS": self.REQUIRE})
+        assert code == 1
+        assert "rank" in err
+
+    def test_malformed_option_is_a_config_error(self, tfd_binary):
+        code, _, err = run_tfd(tfd_binary, pjrt_args(
+            ["--pjrt-client-option", "nonsense"]))
+        assert code == 1
+        assert "key=value" in err
+
+    def test_options_via_env_and_config_file(self, tfd_binary, tmp_path):
+        """TFD_PJRT_CLIENT_OPTIONS env and the pjrtClientOptions config
+        scalar both feed the same plumbing (CLI > env > file)."""
+        code, out, err = run_tfd(tfd_binary, pjrt_args([
+            "--pjrt-client-option", "topology=v5e:1x1x1",
+            "--pjrt-client-option", "on=true",
+        ]), env={
+            "TFD_PJRT_CLIENT_OPTIONS":
+                "session_id=via-env;rank=4294967295;remote_compile=1;"
+                "topology=v5e:1x1x1;on=true",
+            "TFD_FAKE_PJRT_REQUIRE_OPTIONS": self.REQUIRE,
+            "TFD_FAKE_PJRT_KIND": "TPU v5 lite",
+            "TFD_FAKE_PJRT_BOUNDS": "2,2,1",
+        })
+        # CLI options given → env ignored → requirement unmet (no
+        # session_id among the CLI options).
+        assert code == 1, err
+
+        cfg = tmp_path / "cfg.yaml"
+        cfg.write_text(
+            "version: v1\n"
+            "flags:\n"
+            "  oneshot: true\n"
+            "  outputFile: \"\"\n"
+            "  backend: pjrt\n"
+            "  machineTypeFile: /dev/null\n"
+            "  pjrtClientOptions: \"session_id=via-file;rank=4294967295;"
+            "remote_compile=1;topology=v5e:1x1x1;on=true\"\n")
+        # libtpu path on the CLI (the ambient TPU_LIBRARY_PATH alias of a
+        # relay environment would outrank a file-level libtpuPath); the
+        # client options still come from the file.
+        code, out, err = run_tfd(
+            tfd_binary,
+            [f"--config-file={cfg}", f"--libtpu-path={FAKE_PJRT}"], env={
+                "TFD_FAKE_PJRT_REQUIRE_OPTIONS": self.REQUIRE,
+                "TFD_FAKE_PJRT_KIND": "TPU v5 lite",
+                "TFD_FAKE_PJRT_BOUNDS": "2,2,1",
+            })
+        assert code == 0, err
+        assert labels_of(out)["google.com/tpu.backend"] == "pjrt"
+
+
+def _relay_pjrt_plugin():
+    path = os.environ.get("PJRT_LIBRARY_PATH")
+    return path if path and os.path.exists(path) else None
+
+
+@pytest.mark.skipif(_relay_pjrt_plugin() is None,
+                    reason="no relay PJRT plugin exported on this host")
+class TestRelayPjrtPlugin:
+    def test_daemon_labels_real_silicon_via_relay(self, tfd_binary):
+        """The shipped C++ PJRT path against the ambient relay PJRT plugin
+        (the .so the environment's jax platform loads): dlopen →
+        GetPjrtApi → PJRT_Client_Create with the relay's session options →
+        enumerate REAL chips → labels. The end-to-end proof the fake
+        plugin cannot give."""
+        import uuid
+        gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+        rc = ("1" if os.environ.get("PALLAS_AXON_REMOTE_COMPILE") == "1"
+              else "0")
+        code, out, err = run_tfd(tfd_binary, [
+            "--oneshot", "--output-file=", "--backend=pjrt",
+            f"--libtpu-path={_relay_pjrt_plugin()}",
+            "--machine-type-file=/dev/null",
+            "--pjrt-client-option",
+            f"remote_compile={rc};local_only=0;priority=0;n_slices=1;"
+            "rank=4294967295",
+            "--pjrt-client-option", f"topology={gen}:1x1x1",
+            "--pjrt-client-option", f"session_id=tfd-test-{uuid.uuid4()}",
+        ], env=dict(os.environ, GCE_METADATA_HOST="127.0.0.1:1"),
+            timeout=180)
+        assert code == 0, err
+        labels = labels_of(out)
+        assert labels["google.com/tpu.backend"] == "pjrt"
+        assert int(labels["google.com/tpu.count"]) >= 1
+        assert labels["google.com/tpu.family"] != ""
+
+
 def _real_libtpu_path():
     try:
         import libtpu  # noqa: PLC0415 — optional, probed at test time

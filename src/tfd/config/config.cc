@@ -200,6 +200,17 @@ std::vector<FlagDef> MakeFlagDefs(Flags* f) {
                   [f](const std::string& v) {
                     return SetDuration(&f->pjrt_refresh_interval_s, v);
                   }});
+  defs.push_back({"pjrt-retry-backoff",
+                  {"TFD_PJRT_RETRY_BACKOFF"},
+                  "pjrtRetryBackoff",
+                  "after a failed PJRT init, skip re-probing for this long "
+                  "(doubling per consecutive failure, capped at 15m) and "
+                  "serve the memoized error instantly (e.g. 60s; 0 = "
+                  "retry every pass)",
+                  false,
+                  [f](const std::string& v) {
+                    return SetDuration(&f->pjrt_retry_backoff_s, v);
+                  }});
   defs.push_back({"metadata-endpoint",
                   {"TFD_METADATA_ENDPOINT", "GCE_METADATA_HOST"},
                   "metadataEndpoint",
@@ -568,6 +579,9 @@ Result<LoadResult> Load(int argc, char** argv) {
   if (f->pjrt_refresh_interval_s < 0) {
     return Result<LoadResult>::Error("pjrt-refresh-interval must be >= 0s");
   }
+  if (f->pjrt_retry_backoff_s < 0) {
+    return Result<LoadResult>::Error("pjrt-retry-backoff must be >= 0s");
+  }
   if (f->health_exec_timeout_s < 1) {
     return Result<LoadResult>::Error("health-exec-timeout must be >= 1s");
   }
@@ -613,6 +627,7 @@ std::string ToJson(const Config& config) {
   out << ",\"pjrtInitTimeout\":\"" << f.pjrt_init_timeout_s << "s\""
       << ",\"pjrtMultihost\":" << (f.pjrt_multihost ? "true" : "false")
       << ",\"pjrtRefreshInterval\":\"" << f.pjrt_refresh_interval_s << "s\""
+      << ",\"pjrtRetryBackoff\":\"" << f.pjrt_retry_backoff_s << "s\""
       << ",\"deviceHealth\":" << jstr(f.device_health)
       << ",\"healthExec\":" << jstr(f.health_exec)
       << ",\"healthExecTimeout\":\"" << f.health_exec_timeout_s << "s\""

@@ -87,6 +87,16 @@ struct Flags {
   // long before the chips are touched again (0 = probe every pass, the
   // reference's NVML re-init-per-pass behavior).
   int pjrt_refresh_interval_s = 3600;
+  // FAILED PJRT inits are memoized too: without this, a node whose chips
+  // are held by a training job (or whose libtpu is wedged) would burn the
+  // full pjrt-init-timeout on EVERY pass — with the 30s default and 60s
+  // sleep-interval, half its wall-clock. After a failure the daemon skips
+  // re-probing for this long, serving the memoized error instantly (auto
+  // falls straight to the metadata labels); the window doubles per
+  // consecutive failure up to 15m, so recovery after the job releases the
+  // chips is bounded by the current window. 0 = retry every pass (the
+  // reference's NVML-era behavior, factory.go:32-38).
+  int pjrt_retry_backoff_s = 60;
   std::string metadata_endpoint; // override http://metadata.google.internal
   std::string mock_topology_file; // mock backend fixture (tests)
   // off|basic|full. basic: init+enumeration+latency labels. full: basic

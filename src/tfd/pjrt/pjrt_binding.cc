@@ -4,6 +4,7 @@
 
 #include <cctype>
 #include <cerrno>
+#include <cmath>
 #include <cstdlib>
 
 #include "tfd/platform/detect.h"
@@ -29,10 +30,13 @@ bool ParseFullInt64(const std::string& s, long long* out) {
 
 bool ParseFullFloat(const std::string& s, float* out) {
   if (s.empty() || isspace(static_cast<unsigned char>(s[0]))) return false;
-  errno = 0;
   char* end = nullptr;
   float v = strtof(s.c_str(), &end);
-  if (errno != 0 || end != s.c_str() + s.size()) return false;
+  // No errno check: glibc sets ERANGE for representable subnormals (an
+  // explicit float:1e-43 must not be rejected). Full consumption is the
+  // contract; range handling is the caller's (inference errors on an
+  // overflow-to-inf, the explicit prefix takes the parse as intended).
+  if (end != s.c_str() + s.size()) return false;
   *out = v;
   return true;
 }
@@ -139,6 +143,11 @@ Result<ClientOption> ParseClientOption(const std::string& key_eq_value) {
     return opt;
   }
   if (IsPlainDecimal(value) && ParseFullFloat(value, &opt.float_value)) {
+    if (std::isinf(opt.float_value)) {
+      return Result<ClientOption>::Error(
+          "client option '" + opt.key + "': decimal '" + value +
+          "' overflows float (use str: if a string was intended)");
+    }
     opt.type = ClientOption::Type::kFloat;
     return opt;
   }

@@ -344,8 +344,9 @@ int ProbeChild(int fd, const config::Flags& flags, const PinPlan& plan) {
 // on every sleep-interval races any training job that is just
 // initializing. Chip identity is static — reusing the snapshot for
 // flags.pjrt_refresh_interval_s removes ~59 of 60 chip grabs at the
-// default intervals. Failures are never cached (a busy-chip node must
-// keep retrying so it recovers promptly when the job ends).
+// default intervals. Failures are memoized separately with exponential
+// backoff (FailureMemo below) so a busy/wedged node neither burns the
+// init deadline per pass nor loses prompt recovery.
 //
 // Pinned snapshots cache the CHIP facts but not the slice topology:
 // topology comes from the metadata overlay, which is two GETs to a
@@ -373,21 +374,88 @@ CachedSnapshot g_snapshot_cache;
 // forever. Warn on the ok→failed edge only, re-arming on recovery.
 bool g_overlay_failure_warned = false;
 
+// FAILED inits are memoized with exponential backoff (the success-side
+// snapshot cache's counterpart). Without it, a node whose chips are held
+// by a training job — or whose libtpu is wedged — pays the full
+// pjrt-init-timeout on EVERY pass: with the 30s default and a 60s
+// sleep-interval that is half the node's wall-clock, and every retry
+// races the job's own initialization for the exclusive chips. While the
+// memo is fresh, Init returns the remembered error instantly and the
+// auto chain serves metadata labels at full speed; each consecutive
+// failure doubles the window (capped at 15m), and expiry retries
+// promptly — a freed chip is re-labeled pjrt within one window.
+struct FailureMemo {
+  bool valid = false;
+  std::string key;  // same identity as the snapshot cache
+  std::string error;
+  std::chrono::steady_clock::time_point last_attempt;
+  int window_s = 0;
+  int consecutive = 0;
+};
+FailureMemo g_failure_memo;
+constexpr int kMaxBackoffS = 15 * 60;
+
 class PjrtWatchdogManager : public Manager {
  public:
   explicit PjrtWatchdogManager(const config::Config& config)
       : flags_(config.flags) {}
 
   Status Init() override {
+    const std::string cache_key =
+        flags_.libtpu_path + "|" + (flags_.pjrt_multihost ? "m" : "p") +
+        "|" + JoinStrings(flags_.pjrt_client_options, ";");
+
+    // Failure memo (mirrors the snapshot cache's device-health bypass:
+    // operators enabling health labels chose per-pass truth). A fresh
+    // memo fails instantly so the fallback chain serves metadata without
+    // burning the init deadline; expiry falls through to a live retry.
+    const bool memoizable = flags_.pjrt_retry_backoff_s > 0 &&
+                            flags_.device_health == "off";
+    if (memoizable && g_failure_memo.valid &&
+        g_failure_memo.key == cache_key) {
+      auto elapsed = std::chrono::steady_clock::now() -
+                     g_failure_memo.last_attempt;
+      if (elapsed < std::chrono::seconds(g_failure_memo.window_s)) {
+        return Status::Error(
+            g_failure_memo.error + " (memoized failure " +
+            std::to_string(g_failure_memo.consecutive) + "; retrying in <=" +
+            std::to_string(g_failure_memo.window_s) + "s)");
+      }
+    }
+
+    Status s = InitProbe(cache_key);
+    if (!memoizable) return s;
+    if (s.ok()) {
+      g_failure_memo = {};
+    } else {
+      if (g_failure_memo.valid && g_failure_memo.key == cache_key) {
+        g_failure_memo.consecutive++;
+        g_failure_memo.window_s =
+            std::min(kMaxBackoffS, g_failure_memo.window_s * 2);
+      } else {
+        g_failure_memo = {};
+        g_failure_memo.consecutive = 1;
+        // The cap applies to the FIRST window too: an operator value
+        // above 15m would otherwise start high and then SHRINK at the
+        // min() when doubled — backoff inverted.
+        g_failure_memo.window_s =
+            std::min(kMaxBackoffS, flags_.pjrt_retry_backoff_s);
+      }
+      g_failure_memo.valid = true;
+      g_failure_memo.key = cache_key;
+      g_failure_memo.error = s.message();
+      g_failure_memo.last_attempt = std::chrono::steady_clock::now();
+    }
+    return s;
+  }
+
+  Status InitProbe(const std::string& cache_key) {
     // Snapshot cache — applies to the watchdog AND in-process paths.
     // Bypassed when device-health is enabled: those labels vouch that the
     // stack was probed THIS pass (tpu_labeler times Init for probe-ms);
     // serving them from a cache would keep health.ok=true for up to the
     // refresh interval after the stack wedges. Operators enabling health
     // labels are explicitly choosing per-pass chip probes.
-    const std::string cache_key =
-        flags_.libtpu_path + "|" + (flags_.pjrt_multihost ? "m" : "p") +
-        "|" + JoinStrings(flags_.pjrt_client_options, ";");
     const bool cacheable = flags_.pjrt_refresh_interval_s > 0 &&
                            flags_.device_health == "off";
     if (cacheable && g_snapshot_cache.valid &&

@@ -121,6 +121,14 @@ PJRT_Error* ClientCreate(PJRT_Client_Create_Args* args) {
   std::string fail = EnvStr("TFD_FAKE_PJRT_FAIL", "");
   if (!fail.empty()) return MakeError(fail);
 
+  // File-gated failure: fails while the file exists. Lets a test model a
+  // training job that holds the chips and then RELEASES them mid-run
+  // (env is fixed at daemon start; a file isn't).
+  std::string fail_file = EnvStr("TFD_FAKE_PJRT_FAIL_IF_FILE", "");
+  if (!fail_file.empty() && access(fail_file.c_str(), F_OK) == 0) {
+    return MakeError("chips busy (held while " + fail_file + " exists)");
+  }
+
   // Proxy-plugin shape: reject creation unless the required NamedValue
   // create-options are present with the right type and value. Spec is a
   // comma-separated list of name:type[:value] with type one of

@@ -537,6 +537,12 @@ void TestClientOptionParsing() {
   r = pjrt::ParseClientOption("tag=1e9");
   CHECK_TRUE(r.ok() && r->type == ClientOption::Type::kString);
   CHECK_TRUE(!pjrt::ParseClientOption("x=18446744073709551615").ok());
+  // Decimal-shaped float overflow errors loudly; explicit float: takes
+  // subnormals (glibc ERANGE must not reject a representable value).
+  CHECK_TRUE(!pjrt::ParseClientOption(
+      "x=" + std::string(40, '9') + ".0").ok());
+  r = pjrt::ParseClientOption("x=float:1e-43");
+  CHECK_TRUE(r.ok() && r->type == ClientOption::Type::kFloat);
 
   // Malformed.
   CHECK_TRUE(!pjrt::ParseClientOption("novalue").ok());

@@ -723,13 +723,66 @@ class TestPjrtInitWatchdog:
             ["--pjrt-refresh-interval=0"], {})
         assert fresh >= 3, f"expected a grab per pass, got {fresh}"
 
-    def test_failures_never_cached(self, tfd_binary, tmp_path):
-        """A busy-chip node must keep retrying every pass so it recovers
-        promptly when the training job releases the chips."""
+    def test_failure_memo_skips_reprobes(self, tfd_binary, tmp_path):
+        """A busy-chip node must NOT burn the init deadline on every pass:
+        with the default retry backoff the failure is memoized and later
+        passes fail instantly (1 probe across >=3 passes); the memoized
+        error stays visible in the logs. --pjrt-retry-backoff=0 restores
+        the probe-every-pass contract."""
+        tmp = tmp_path / "busy"
         creates = self._run_daemon_passes(
-            tfd_binary, tmp_path / "busy", ["--fail-on-init-error=false"],
+            tfd_binary, tmp, ["--fail-on-init-error=false"],
             {"TFD_FAKE_PJRT_FAIL": "chips are busy"})
-        assert creates >= 3, f"expected a retry per pass, got {creates}"
+        assert creates == 1, f"expected 1 probe with the memo, got {creates}"
+        assert "memoized failure" in (tmp / "stderr").read_text()
+        eager = self._run_daemon_passes(
+            tfd_binary, tmp_path / "busy-eager",
+            ["--fail-on-init-error=false", "--pjrt-retry-backoff=0"],
+            {"TFD_FAKE_PJRT_FAIL": "chips are busy"})
+        assert eager >= 3, f"expected a retry per pass, got {eager}"
+
+    def test_failure_memo_recovers_when_chips_freed(self, tfd_binary,
+                                                    tmp_path):
+        """Prompt recovery: a training job holds the chips (file-gated
+        failure), the daemon memoizes; once the job releases them the next
+        expired-memo retry succeeds and the node is labeled pjrt within
+        one backoff window."""
+        import time
+        tmp = tmp_path / "recover"
+        tmp.mkdir()
+        gate = tmp / "job-holds-chips"
+        gate.touch()
+        out_file = tmp / "labels"
+        with self._daemon(
+                tfd_binary, tmp,
+                ["--fail-on-init-error=false", "--pjrt-retry-backoff=1s"],
+                {"TFD_FAKE_PJRT_FAIL_IF_FILE": str(gate),
+                 "TFD_FAKE_PJRT_KIND": "TPU v5 lite",
+                 "TFD_FAKE_PJRT_BOUNDS": "2,2,1"},
+                output_file=out_file) as (count_file, stderr_file):
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if stderr_file.read_text().count("wrote ") >= 2:
+                    break
+                time.sleep(0.2)
+            # Degraded while held: no TPU labels.
+            assert "google.com/tpu.backend=pjrt" not in (
+                out_file.read_text() if out_file.exists() else "")
+            gate.unlink()  # the job releases the chips
+            t_freed = time.monotonic()
+            while time.monotonic() < deadline:
+                text = out_file.read_text() if out_file.exists() else ""
+                if "google.com/tpu.backend=pjrt" in text:
+                    break
+                time.sleep(0.2)
+            else:
+                raise AssertionError(
+                    "chips freed but never re-labeled pjrt; stderr:\n" +
+                    stderr_file.read_text()[-2000:])
+            # Within one backoff window (1s) + one pass (1s) + slack.
+            assert time.monotonic() - t_freed < 10
+            labels = labels_of(out_file.read_text())
+            assert labels["google.com/tpu.count"] == "4"
 
     def test_pinned_overlay_failure_recovers_without_reprobe(
             self, tfd_binary, tmp_path):
@@ -929,12 +982,10 @@ class TestPjrtClientOptions:
         assert labels_of(out)["google.com/tpu.backend"] == "pjrt"
 
 
-def _relay_pjrt_plugin():
-    path = os.environ.get("PJRT_LIBRARY_PATH")
-    return path if path and os.path.exists(path) else None
+from tpufd.relay import relay_pjrt_plugin
 
 
-@pytest.mark.skipif(_relay_pjrt_plugin() is None,
+@pytest.mark.skipif(relay_pjrt_plugin() is None,
                     reason="no relay PJRT plugin exported on this host")
 class TestRelayPjrtPlugin:
     def test_daemon_labels_real_silicon_via_relay(self, tfd_binary):
@@ -942,20 +993,14 @@ class TestRelayPjrtPlugin:
         (the .so the environment's jax platform loads): dlopen →
         GetPjrtApi → PJRT_Client_Create with the relay's session options →
         enumerate REAL chips → labels. The end-to-end proof the fake
-        plugin cannot give."""
-        import uuid
-        gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
-        rc = ("1" if os.environ.get("PALLAS_AXON_REMOTE_COMPILE") == "1"
-              else "0")
+        plugin cannot give. Discovery + options come from tpufd.relay —
+        the same helper bench.py's pjrt_real uses, so test and bench
+        exercise one configuration."""
+        so, options = relay_pjrt_plugin()
         code, out, err = run_tfd(tfd_binary, [
             "--oneshot", "--output-file=", "--backend=pjrt",
-            f"--libtpu-path={_relay_pjrt_plugin()}",
-            "--machine-type-file=/dev/null",
-            "--pjrt-client-option",
-            f"remote_compile={rc};local_only=0;priority=0;n_slices=1;"
-            "rank=4294967295",
-            "--pjrt-client-option", f"topology={gen}:1x1x1",
-            "--pjrt-client-option", f"session_id=tfd-test-{uuid.uuid4()}",
+            f"--libtpu-path={so}", "--pjrt-init-timeout=120s",
+            "--machine-type-file=/dev/null", *options,
         ], env=dict(os.environ, GCE_METADATA_HOST="127.0.0.1:1"),
             timeout=180)
         assert code == 0, err

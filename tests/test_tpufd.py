@@ -200,9 +200,13 @@ def test_bench_json_contract():
     assert "pjrt_real" in p50s
     # The chips-busy production path (auto: PJRT fails, metadata serves)
     # and its worst case (auto_deadline: wedged libtpu burns the 1s bench
-    # deadline before the fallback — deadline-inclusive by construction).
+    # deadline on the FIRST pass — deadline-inclusive by construction).
     assert p50s["auto"] > 0
     assert p50s["auto_deadline"] > 1000
+    # Steady state rides the failure memo: passes >=2 must NOT pay the
+    # deadline again — within ~2x the metadata p50 plus scheduler noise.
+    assert p50s["auto_deadline_steady"] < 1000
+    assert p50s["auto_deadline_steady"] <= 2 * p50s["metadata"] + 50
 
 
 def test_cli_burnin(cpu_jax, capsys):

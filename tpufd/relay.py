@@ -1,0 +1,43 @@
+"""Discovery of an ambient relay PJRT plugin and its daemon options.
+
+Tunneled-TPU environments route the chip through a relay PJRT plugin
+instead of a directly-attached libtpu (stock libtpu then fails client
+creation outright). The relay's boot hook exports PJRT_LIBRARY_PATH for
+exactly this discovery purpose; its client requires the session/routing
+NamedValue create-options that the environment's jax registration would
+pass — the daemon forwards the same ones via --pjrt-client-option.
+
+Single home for the discovery + option construction: bench.py's
+pjrt_real measurement and the gated end-to-end test
+(tests/test_backends.py TestRelayPjrtPlugin) must exercise the SAME
+configuration, so neither carries its own copy. Stdlib-only on purpose.
+"""
+
+import os
+import uuid
+from pathlib import Path
+
+
+def relay_pjrt_plugin():
+    """(plugin_so_path, [--pjrt-client-option, value, ...]) for the
+    ambient relay PJRT plugin, or None when the environment has none.
+
+    Options mirror the relay bootstrap contract (remote-compile pool
+    mode; rank sentinel = monoclient); the session id is fresh per call
+    because it keys the relay's session lock.
+    """
+    so = os.environ.get("PJRT_LIBRARY_PATH") or os.environ.get(
+        "AXON_SO_PATH")
+    if not so or not Path(so).exists():
+        return None
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    remote_compile = (
+        "1" if os.environ.get("PALLAS_AXON_REMOTE_COMPILE") == "1" else "0")
+    options = [
+        "--pjrt-client-option",
+        f"remote_compile={remote_compile};local_only=0;priority=0;"
+        "n_slices=1;rank=4294967295",
+        "--pjrt-client-option", f"topology={gen}:1x1x1",
+        "--pjrt-client-option", f"session_id=tfd-relay-{uuid.uuid4()}",
+    ]
+    return so, options

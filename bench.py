@@ -236,6 +236,14 @@ def auto_deadline_p50s(out_file):
                     m = re.search(rb"wrote \d+ labels.* in (\d+)ms", line)
                     if m:
                         pass_ms.append(int(m.group(1)))
+            # Self-validate the path like one_run's check_backend — read
+            # BEFORE terminate (the daemon removes its file on SIGTERM):
+            # the passes must have come from the metadata fallback behind
+            # a wedged PJRT, or the numbers measure the wrong thing.
+            labels = Path(out_file).read_text()
+            if "google.com/tpu.backend=metadata\n" not in labels:
+                raise RuntimeError(
+                    "daemon passes did not come from the metadata fallback")
         finally:
             proc.terminate()
             proc.wait(timeout=30)
@@ -321,6 +329,27 @@ def tpu_probe_numbers():
         gbps = round(statistics.median(
             health.hbm_gbps() for _ in range(3)), 1)
         out = {"tpu_matmul_tflops": tflops, "tpu_hbm_gbps": gbps}
+        # ICI all-reduce: measured over a one-axis mesh of all local
+        # chips when there are >1; recorded as an EXPLICIT null with the
+        # reason on single-chip hosts, so the never-measured-on-silicon
+        # gap stays visible in every bench record instead of silent
+        # (the probe itself is CPU-mesh tested; tests/test_tpufd.py).
+        devices = jax.devices()
+        out["tpu_allreduce_gbps"] = None
+        if len(devices) > 1:
+            # Own try: an ICI probe failure must not discard the matmul/
+            # HBM numbers already measured — it becomes the skip reason.
+            try:
+                from jax.sharding import Mesh
+                import numpy as np
+                mesh = Mesh(np.array(devices), ("all",))
+                out["tpu_allreduce_gbps"] = round(statistics.median(
+                    health.allreduce_gbps(mesh) for _ in range(3)), 1)
+            except Exception as e:  # noqa: BLE001
+                out["tpu_allreduce_skip_reason"] = f"probe failed: {e}"
+        else:
+            out["tpu_allreduce_skip_reason"] = (
+                f"{len(devices)} chip visible: no ICI to measure")
         # Context against the published per-family peaks (the sign-flip
         # stream normally reads 75-90% of rated HBM; see tpufd/health.py).
         family = health.family_of(jax.devices()[0])

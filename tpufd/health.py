@@ -19,6 +19,7 @@ Design notes (TPU-first):
     result-memoizing relays all cancel out of the throughput number.
 """
 
+import functools
 import itertools
 import time
 
@@ -35,9 +36,11 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 # stream measured 649.1 GB/s (79.3% of rated), 658.5 GB/s (80.4%), and
 # — via the shipped daemon's --device-health=full exec path — 705 GB/s
 # (86.1%) on a real v5e across three separate sessions, with matmul at
-# 193.3/191.5/193.0 TFLOP/s (97-98%) — the band is stream efficiency,
-# not noise, and kernel-body variants land inside it too (see _stream
-# below). The
+# 193.3/191.5/193.0 TFLOP/s (97-98%); a fourth session's controlled
+# donation A/B added 661.9 plain / 689.5 donated GB/s (80.8%/84.2%
+# medians over six paired trials, donation adopted) — the band is
+# stream efficiency, not noise, and kernel-body variants land inside it
+# too (see _stream below). The
 # health labeler therefore publishes the rated figure
 # and the measured percentage next to each measurement, and only flags
 # degradation below DEGRADED_PCT — so an operator never misreads a
@@ -181,7 +184,7 @@ def matmul_tflops(device=None, size=4096, iters=8):
     return flops / seconds / 1e12
 
 
-@jax.jit
+@functools.partial(jax.jit, donate_argnums=0)
 def _stream(x, n):
     # Sign-flip is the cheapest per-element transform the compiler cannot
     # fold away across traced-loop iterations, so the loop is as close to
@@ -190,9 +193,16 @@ def _stream(x, n):
     # body within noise of each other (both bandwidth-bound at ~650-710
     # GB/s = 79-87% of the 819 rated, drifting with ambient conditions),
     # while copy-shaped bodies (roll/reverse/concat: 160-373 GB/s) and
-    # larger working sets (>=1 GiB: -7%) are strictly worse. The gap to
-    # rated pin rate is stream efficiency, not probe overhead — which is
-    # why the labels publish rated context instead of chasing 100%.
+    # larger working sets (>=1 GiB: -7%) are strictly worse. A fourth
+    # same-chip session A/B'd buffer donation (donate_argnums=0, adopted
+    # here: the loop result reuses the input allocation): donated median
+    # 689.5 GB/s (84.2%) vs plain 661.9 (80.8%) over six paired trials —
+    # a real but small lift that stays inside the 79-87% band, confirming
+    # the gap to rated pin rate is stream efficiency, not allocation or
+    # probe overhead — which is why the labels publish rated context
+    # instead of chasing 100%. Python-level donated dispatch loops were
+    # also tried and rejected: per-call timing through a relay/tunnel is
+    # unreliable (and a donated bare copy aliases away to zero traffic).
     def body(_, acc):
         return -acc
     return jax.lax.fori_loop(0, n, body, x)

@@ -71,6 +71,7 @@ helm-package:
 	mkdir -p dist docs
 	if command -v helm >/dev/null 2>&1; then \
 	  helm package deployments/helm/tpu-feature-discovery -d dist \
+	    --dependency-update \
 	    --version $(BARE_VERSION) --app-version $(BARE_VERSION) && \
 	  helm repo index dist --url $(HELM_REPO_URL) \
 	    $(shell [ -f docs/index.yaml ] && echo --merge docs/index.yaml); \

@@ -15,12 +15,19 @@ runnable end-to-end everywhere:
     urls, digest = sha256 of the .tgz), merging any existing index so
     prior releases stay listed.
 
-Chart dependencies (the NFD subchart) are NOT vendored into the archive —
-same as the committed chart; `helm dependency update` fetches them at
-install time (deployments/helm/tpu-feature-discovery/Chart.yaml note).
+Chart dependencies: helm refuses to install an archive whose Chart.yaml
+declares dependencies that are not vendored in charts/, and a packaged
+.tgz cannot be `helm dependency update`d after the fact — so a dep-less
+archive of this chart is NOT installable as published. The real-helm
+path vendors them via `helm package --dependency-update`; this fallback
+cannot fetch, so it vendors whatever charts/ (+ Chart.lock) already
+holds — run `helm dependency update <chart>` first on a networked
+machine — and it WARNS loudly when declared dependencies are missing
+from the archive. --require-deps turns that warning into an error
+(exit 1) for release pipelines.
 
 Usage: helm_package.py --chart DIR --version X.Y.Z --dist DIR --url URL
-                       [--merge INDEX]
+                       [--merge INDEX] [--require-deps]
 """
 
 import argparse
@@ -54,12 +61,6 @@ def package(chart_dir, chart, dist):
             if path.is_dir():
                 continue
             rel = path.relative_to(chart_dir)
-            # Vendored dependency archives (charts/) are not packaged —
-            # tested on the CHART-relative path, not the absolute one
-            # (an ancestor directory named 'charts' must not exclude
-            # the whole chart).
-            if "charts" in rel.parts[:-1]:
-                continue
             arcname = f"{name}/{rel}"
             if rel == Path("Chart.yaml"):
                 info = tarfile.TarInfo(arcname)
@@ -101,6 +102,20 @@ def write_index(entry, name, dist, merge):
     return out
 
 
+def missing_dependencies(chart_dir, chart):
+    """Declared dependencies with no vendored archive or directory under
+    charts/ — the set helm's install-time dependency check would fail on."""
+    missing = []
+    charts_dir = chart_dir / "charts"
+    for dep in chart.get("dependencies") or []:
+        dep_name = dep.get("name", "")
+        vendored = (list(charts_dir.glob(f"{dep_name}-*.tgz")) +
+                    [p for p in [charts_dir / dep_name] if p.is_dir()])
+        if not vendored:
+            missing.append(dep_name)
+    return missing
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--chart", type=Path, required=True)
@@ -111,10 +126,25 @@ def main():
                         help="base URL the repo will be served from")
     parser.add_argument("--merge", type=Path,
                         help="existing index.yaml to keep prior releases")
+    parser.add_argument("--require-deps", action="store_true",
+                        help="error (exit 1) instead of warning when "
+                             "declared dependencies are not vendored")
     args = parser.parse_args()
 
     args.dist.mkdir(parents=True, exist_ok=True)
     chart = load_chart(args.chart, args.version)
+    missing = missing_dependencies(args.chart, chart)
+    if missing:
+        sys.stderr.write(
+            "WARNING: declared dependencies not vendored in charts/: "
+            f"{', '.join(missing)}. helm will REFUSE to install the "
+            "packaged archive ('found in Chart.yaml, but missing in "
+            "charts/ directory'); run `helm dependency update "
+            f"{args.chart}` on a networked machine first, or use the "
+            "real-helm release path (`helm package --dependency-update`)."
+            "\n")
+        if args.require_deps:
+            return 1
     tgz = package(args.chart, chart, args.dist)
     entry = index_entry(chart, tgz, args.url)
     index = write_index(entry, chart["name"], args.dist, args.merge)

@@ -10,6 +10,7 @@ in-process against the fakes.
 import json
 import os
 import re
+import shutil
 import subprocess
 import sys
 from pathlib import Path
@@ -154,8 +155,6 @@ class TestReleaseMachinery:
         """scripts/set-version.sh against a scratch copy: one command must
         move every artifact to the new version and keep the NFD subchart
         pin untouched; the checker must then pass at the new version."""
-        import shutil
-
         for rel in ("VERSION", "deployments", "tests/check-yamls.sh",
                     ".github/workflows/ci.yml"):
             src = REPO / rel
@@ -255,6 +254,81 @@ class TestReleaseMachinery:
         empty = tmp_path / "empty-index.yaml"
         empty.write_text("apiVersion: v1\nentries:\n")
         run("9.9.11", merge=empty)
+
+    def test_helm_package_vendors_dependencies(self, tmp_path):
+        """The packaged archive must be installable as published: helm
+        refuses archives whose Chart.yaml declares dependencies missing
+        from charts/ (and a .tgz cannot be dependency-updated after the
+        fact). With charts/ populated (what `helm dependency update`
+        leaves behind) the packager vendors it plus Chart.lock; with it
+        missing the packager warns loudly, and --require-deps makes that
+        an error for release pipelines."""
+        import tarfile
+
+        # Copies are SCRUBBED of charts//Chart.lock first: a real-helm
+        # `make helm-package` run legitimately deposits both into the
+        # source chart (gitignored), and this test must not depend on
+        # whether that has happened.
+        def clean_copy(dst):
+            shutil.copytree(HELM, dst,
+                            ignore=shutil.ignore_patterns(
+                                "charts", "Chart.lock"))
+            return dst
+
+        chart_src = clean_copy(tmp_path / "chart")
+        (chart_src / "charts").mkdir()
+        (chart_src / "charts" / "node-feature-discovery-0.15.4.tgz"
+         ).write_bytes(b"stub-subchart-archive")
+        (chart_src / "Chart.lock").write_text(
+            "dependencies:\n- name: node-feature-discovery\n"
+            "  version: 0.15.4\n")
+
+        def run(chart_dir, *extra):
+            return subprocess.run(
+                [sys.executable, str(REPO / "scripts" / "helm_package.py"),
+                 "--chart", str(chart_dir), "--version", "9.9.9",
+                 "--dist", str(tmp_path / "dist"),
+                 "--url", "https://charts.example/repo", *extra],
+                capture_output=True, text=True)
+
+        proc = run(chart_src)
+        assert proc.returncode == 0, proc.stderr
+        assert "WARNING" not in proc.stderr
+        with tarfile.open(
+                tmp_path / "dist" / "tpu-feature-discovery-9.9.9.tgz") as tar:
+            names = tar.getnames()
+        assert ("tpu-feature-discovery/charts/"
+                "node-feature-discovery-0.15.4.tgz") in names
+        assert "tpu-feature-discovery/Chart.lock" in names
+
+        # A chart with no vendored charts/: warn, still pack.
+        bare = clean_copy(tmp_path / "chart-bare")
+        proc = run(bare)
+        assert proc.returncode == 0, proc.stderr
+        assert "missing in charts/ directory" in proc.stderr
+        assert "node-feature-discovery" in proc.stderr
+        # Release pipelines can refuse to publish the broken artifact.
+        proc = run(bare, "--require-deps")
+        assert proc.returncode == 1
+
+    @pytest.mark.skipif(
+        shutil.which("helm") is None
+        or not os.environ.get("TFD_HELM_NETWORK_TESTS"),
+        reason="needs a helm binary AND network (set "
+               "TFD_HELM_NETWORK_TESTS=1); the hermetic tier must not "
+               "fetch the NFD subchart from the internet")
+    def test_helm_lint_packaged_chart(self, tmp_path):
+        """Real helm + network (opt-in; the CI release job lints via its
+        own workflow step): dependency-update then lint the chart —
+        validates the subchart wiring end-to-end."""
+        chart = tmp_path / "chart"
+        shutil.copytree(HELM, chart)
+        proc = subprocess.run(["helm", "dependency", "update", str(chart)],
+                              capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        proc = subprocess.run(["helm", "lint", str(chart)],
+                              capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
 
     def test_repo_index_published(self):
         """The release flow has been run for real at least once:

@@ -13,7 +13,7 @@ IMAGE ?= tpu-feature-discovery
 # actual repository owner's pages URL on release).
 HELM_REPO_URL ?= https://distsys-graft.github.io/tpu-feature-discovery/charts
 
-.PHONY: all build test unit-test check bench clean \
+.PHONY: all build test unit-test check bench clean coverage \
         set-version check-release image helm-package
 
 all: build
@@ -42,8 +42,31 @@ check:
 bench: build
 	python bench.py
 
+# Line coverage over the C++ core (reference Makefile computes
+# per-package coverage and excludes generated code; here a gcov
+# build + scripts/coverage_report.py do the same with no gcovr/lcov
+# dependency). The FULL pytest tiers run against the instrumented
+# binary (TFD_BUILD_DIR), so process-level/golden/e2e paths count, not
+# just the unit suite. Python-side coverage runs too when coverage.py
+# is importable (CI installs it; the floor for it is enforced there).
+COVERAGE_MIN ?= 75
+PY_COVERAGE_MIN ?= 55
+coverage:
+	cmake -S . -B build-cov -G Ninja -DCMAKE_BUILD_TYPE=Debug \
+	  -DTFD_COVERAGE=ON
+	ninja -C build-cov
+	if python3 -c 'import coverage' 2>/dev/null; then \
+	  TFD_BUILD_DIR=build-cov python3 -m coverage run \
+	    --source=tpufd -m pytest tests/ -x -q && \
+	  python3 -m coverage report --fail-under=$(PY_COVERAGE_MIN); \
+	else \
+	  TFD_BUILD_DIR=build-cov python3 -m pytest tests/ -x -q; \
+	fi
+	python3 scripts/coverage_report.py --build build-cov \
+	  --min $(COVERAGE_MIN) --out build-cov/coverage.txt
+
 clean:
-	rm -rf $(BUILD_DIR) dist
+	rm -rf $(BUILD_DIR) build-cov dist
 
 # --- release flow (see RELEASE.md) ---------------------------------------
 

@@ -1,0 +1,132 @@
+#!/usr/bin/env python3
+"""Dependency-free C++ line-coverage report over a gcov-instrumented
+build (`make coverage`).
+
+The reference computes per-package coverage in CI and excludes generated
+code (its Makefile coverage target filters generated mocks); this is the
+C++ equivalent built on bare `gcov --json-format --stdout` so it needs
+neither gcovr nor lcov: aggregate the per-line execution counts from
+every .gcda left behind by the instrumented test run, report per-file
+and total line coverage for first-party sources, and enforce a floor.
+
+Exclusions mirror the reference's generated-code filter: test code
+(src/tfd/tests/), test fakes (src/tfd/testing/), and the pinned
+third-party header are not product code and do not count.
+
+Usage: coverage_report.py --build build-cov [--min PCT] [--out FILE]
+"""
+
+import argparse
+import gzip
+import json
+import subprocess
+import sys
+from collections import defaultdict
+from pathlib import Path
+
+EXCLUDE_PARTS = ("src/tfd/tests/", "src/tfd/testing/", "third_party/")
+INCLUDE_PARTS = ("src/", "cmd/")
+
+
+def gcov_json(gcda, build_dir):
+    """Runs gcov in JSON mode for one .gcda; returns parsed docs.
+
+    gcov is pointed at the sibling .o (CMake names both
+    <source>.cc.{o,gcda,gcno}): given the object file it locates its
+    notes + data files itself, which the .gcda path alone does not."""
+    obj = gcda.with_suffix("")  # foo.cc.gcda -> foo.cc
+    obj = obj.parent / (obj.name + ".o")
+    # Path relative to the gcov cwd (the build dir): gcov resolves the
+    # sibling .gcno/.gcda against the path as given.
+    obj = obj.resolve().relative_to(build_dir.resolve())
+    proc = subprocess.run(
+        ["gcov", "--json-format", "--stdout", str(obj)],
+        capture_output=True, cwd=str(build_dir))
+    if proc.returncode != 0:
+        sys.stderr.write(f"gcov failed on {gcda}: "
+                         f"{proc.stderr.decode()[:200]}\n")
+        return []
+    # Some gcov builds gzip even the --stdout stream: detect the magic on
+    # the WHOLE buffer before any line splitting (a gzip stream contains
+    # newline bytes, so splitting first would truncate it).
+    raw = proc.stdout
+    if raw[:2] == b"\x1f\x8b":
+        try:
+            raw = gzip.decompress(raw)
+        except OSError:
+            sys.stderr.write(f"undecompressable gcov output for {gcda}\n")
+            return []
+    docs = []
+    for line in raw.splitlines():
+        if not line.strip():
+            continue
+        try:
+            docs.append(json.loads(line))  # one JSON doc per input
+        except json.JSONDecodeError:
+            continue
+    return docs
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--build", type=Path, required=True)
+    parser.add_argument("--min", type=float, default=0.0,
+                        help="fail (exit 1) below this total line %%")
+    parser.add_argument("--out", type=Path,
+                        help="also write the report to this file")
+    args = parser.parse_args()
+
+    repo = Path(__file__).resolve().parent.parent
+    gcdas = sorted(args.build.rglob("*.gcda"))
+    if not gcdas:
+        sys.stderr.write(f"no .gcda under {args.build} — build with "
+                         "-DTFD_COVERAGE=ON and run the tests first\n")
+        return 2
+
+    # line number -> max count across all runs/translation units.
+    per_file = defaultdict(dict)
+    for gcda in gcdas:
+        for doc in gcov_json(gcda, args.build):
+            for f in doc.get("files", []):
+                name = f.get("file", "")
+                path = (args.build / name).resolve() \
+                    if not Path(name).is_absolute() else Path(name)
+                try:
+                    rel = path.resolve().relative_to(repo).as_posix()
+                except ValueError:
+                    continue  # system headers
+                if not rel.startswith(INCLUDE_PARTS):
+                    continue
+                if any(part in rel for part in EXCLUDE_PARTS):
+                    continue
+                lines = per_file[rel]
+                for ln in f.get("lines", []):
+                    n = ln.get("line_number")
+                    lines[n] = max(lines.get(n, 0), ln.get("count", 0))
+
+    rows = []
+    total_lines = total_covered = 0
+    for rel in sorted(per_file):
+        lines = per_file[rel]
+        covered = sum(1 for c in lines.values() if c > 0)
+        total_lines += len(lines)
+        total_covered += covered
+        pct = 100.0 * covered / len(lines) if lines else 0.0
+        rows.append(f"{rel:60s} {covered:5d}/{len(lines):5d} {pct:6.1f}%")
+    total_pct = 100.0 * total_covered / total_lines if total_lines else 0.0
+    rows.append(f"{'TOTAL':60s} {total_covered:5d}/{total_lines:5d} "
+                f"{total_pct:6.1f}%")
+    report = "\n".join(rows) + "\n"
+    sys.stdout.write(report)
+    if args.out:
+        args.out.write_text(report)
+
+    if total_pct < args.min:
+        sys.stderr.write(f"FAIL: total line coverage {total_pct:.1f}% "
+                         f"is below the floor {args.min:.1f}%\n")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -104,15 +104,25 @@ def write_index(entry, name, dist, merge):
 
 def missing_dependencies(chart_dir, chart):
     """Declared dependencies with no vendored archive or directory under
-    charts/ — the set helm's install-time dependency check would fail on."""
+    charts/ — the set helm's install-time dependency check would fail on.
+
+    Pinned exact versions must match the vendored archive name
+    (helm vendors `<name>-<version>.tgz`), so a stale archive left from
+    an earlier `helm dependency update` is reported instead of silently
+    published; semver RANGES can't be checked by filename and fall back
+    to a name-only match."""
     missing = []
     charts_dir = chart_dir / "charts"
     for dep in chart.get("dependencies") or []:
         dep_name = dep.get("name", "")
-        vendored = (list(charts_dir.glob(f"{dep_name}-*.tgz")) +
-                    [p for p in [charts_dir / dep_name] if p.is_dir()])
-        if not vendored:
-            missing.append(dep_name)
+        version = str(dep.get("version", "") or "")
+        exact = version and not any(c in version for c in "*^~><=| ")
+        if exact:
+            archives = list(charts_dir.glob(f"{dep_name}-{version}.tgz"))
+        else:
+            archives = list(charts_dir.glob(f"{dep_name}-*.tgz"))
+        if not archives and not (charts_dir / dep_name).is_dir():
+            missing.append(f"{dep_name}-{version}" if exact else dep_name)
     return missing
 
 

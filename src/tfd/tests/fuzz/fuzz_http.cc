@@ -1,0 +1,19 @@
+// libFuzzer target for the HTTP-facing parsers: the raw response parser
+// (status line, headers, chunked decoding — fed by whatever a metadata
+// server or apiserver sends back), URL parsing, and the tpu-env
+// attribute-bag grammar that rides on metadata responses. See
+// fuzz_yamllite.cc for the engine/driver arrangement.
+#include <cstddef>
+#include <cstdint>
+#include <string>
+
+#include "tfd/gce/metadata.h"
+#include "tfd/util/http.h"
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
+  std::string text(reinterpret_cast<const char*>(data), size);
+  (void)tfd::http::ParseResponse(text);
+  (void)tfd::http::ParseUrl(text);
+  (void)tfd::gce::ParseTpuEnv(text);
+  return 0;
+}

@@ -1,0 +1,22 @@
+// libFuzzer target for the hand-rolled JSON parser — the watchdog
+// probe-pipe surface (the parent parses whatever the killed-or-crashed
+// probe child managed to write) and the k8s apiserver response surface.
+// See fuzz_yamllite.cc for the engine/driver arrangement.
+#include <cstddef>
+#include <cstdint>
+#include <string>
+
+#include "tfd/util/jsonlite.h"
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
+  std::string text(reinterpret_cast<const char*>(data), size);
+  auto doc = tfd::jsonlite::Parse(text);
+  if (doc.ok()) {
+    // Anything that parsed must round-trip through the serializer (the
+    // NodeFeature CR writer) and survive the lookups the watchdog does.
+    (void)tfd::jsonlite::Serialize(**doc);
+    (void)(*doc)->Get("devices");
+    (void)(*doc)->GetPath("metadata.resourceVersion");
+  }
+  return 0;
+}

@@ -1,0 +1,34 @@
+// libFuzzer target for the hand-rolled YAML subset parser — the
+// config-file attack surface (an operator-supplied file reaches
+// yamllite::Parse before any validation). Built with clang's
+// -fsanitize=fuzzer in the sanitizer CI job; under gcc the standalone
+// driver (standalone_driver.cc) replays the seed corpus + deterministic
+// mutations, so `ninja fuzzers` works everywhere.
+//
+// Reference anchor: GFD's config surface is fuzzed implicitly through
+// sigs.k8s.io/yaml's own fuzzers; a hand-rolled parser must bring its own.
+#include <cstddef>
+#include <cstdint>
+#include <string>
+
+#include "tfd/config/yamllite.h"
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
+  std::string text(reinterpret_cast<const char*>(data), size);
+  auto doc = tfd::yamllite::Parse(text);
+  if (doc.ok()) {
+    // Walk the tree the way config.cc does: lookups + scalar coercions
+    // must be safe on anything that parsed.
+    const tfd::yamllite::Node& root = **doc;
+    for (const auto& [key, child] : root.map_items) {
+      (void)child->AsString();
+      (void)child->AsInt();
+      (void)child->AsBool();
+      (void)child->IsNull();
+      for (const auto& item : child->list_items) {
+        (void)item->AsString();
+      }
+    }
+  }
+  return 0;
+}

@@ -371,6 +371,8 @@ class TlsTransport : public Transport {
   int fd_;
 };
 
+}  // namespace
+
 Result<Response> ParseResponse(const std::string& raw) {
   size_t header_end = raw.find("\r\n\r\n");
   if (header_end == std::string::npos) {
@@ -401,8 +403,6 @@ Result<Response> ParseResponse(const std::string& raw) {
   out.body = std::move(body);
   return out;
 }
-
-}  // namespace
 
 Result<Response> Request(const std::string& method, const std::string& url,
                          const std::string& body,

@@ -60,5 +60,10 @@ Result<Response> Request(const std::string& method, const std::string& url,
                          const std::string& body,
                          const RequestOptions& options);
 
+// Parses a raw HTTP/1.1 response (status line + headers + body, with
+// chunked transfer-encoding decoding). Exposed for the fuzzers and
+// hostile-input tests — production callers go through Request.
+Result<Response> ParseResponse(const std::string& raw);
+
 }  // namespace http
 }  // namespace tfd

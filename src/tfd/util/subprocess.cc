@@ -9,6 +9,10 @@
 #include <chrono>
 #include <cstring>
 
+// gcov's counter dump, present only in --coverage builds (weak → null
+// elsewhere). RunForkedCapture's child calls it before _exit.
+extern "C" void __gcov_dump(void) __attribute__((weak));
+
 namespace tfd {
 
 namespace {
@@ -263,7 +267,13 @@ Result<std::string> RunForkedCapture(const std::function<int(int fd)>& child_fn,
     sigemptyset(&none);
     sigprocmask(SIG_SETMASK, &none, nullptr);
     close(fds[0]);
-    _exit(child_fn(fds[1]));
+    int code = child_fn(fds[1]);
+    // _exit skips atexit handlers by design (no double-flush of parent
+    // state), which also skips gcov's counter dump — flush explicitly in
+    // instrumented builds so probe-child code counts (weak: resolves to
+    // null outside -DTFD_COVERAGE builds).
+    if (__gcov_dump != nullptr) __gcov_dump();
+    _exit(code);
   }
   close(fds[1]);
   int code = 0;

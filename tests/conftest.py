@@ -22,7 +22,12 @@ REPO = Path(__file__).resolve().parent.parent
 # Make the tpufd package (fakes, health, mesh) importable from every test
 # module — the single home of this path patch.
 sys.path.insert(0, str(REPO))
-BUILD_DIR = REPO / "build"
+# TFD_BUILD_DIR lets `make coverage` point every tier at the
+# gcov-instrumented build, so process-level/golden/e2e paths count
+# toward coverage, not just the unit suite.
+BUILD_DIR = Path(os.environ.get("TFD_BUILD_DIR", REPO / "build"))
+if not BUILD_DIR.is_absolute():
+    BUILD_DIR = REPO / BUILD_DIR
 BINARY = BUILD_DIR / "tpu-feature-discovery"
 UNIT_TESTS = BUILD_DIR / "tfd_unit_tests"
 FIXTURES = Path(__file__).resolve().parent / "fixtures"

@@ -301,6 +301,16 @@ class TestReleaseMachinery:
                 "node-feature-discovery-0.15.4.tgz") in names
         assert "tpu-feature-discovery/Chart.lock" in names
 
+        # A STALE vendored version (pin bumped, charts/ not refreshed)
+        # must warn too — helm vendors exact <name>-<version>.tgz names.
+        stale = clean_copy(tmp_path / "chart-stale")
+        (stale / "charts").mkdir()
+        (stale / "charts" / "node-feature-discovery-0.15.3.tgz"
+         ).write_bytes(b"old-subchart-archive")
+        proc = run(stale)
+        assert proc.returncode == 0, proc.stderr
+        assert "node-feature-discovery-0.15.4" in proc.stderr
+
         # A chart with no vendored charts/: warn, still pack.
         bare = clean_copy(tmp_path / "chart-bare")
         proc = run(bare)

@@ -34,6 +34,7 @@ import argparse
 import datetime
 import hashlib
 import io
+import re
 import sys
 import tarfile
 from pathlib import Path
@@ -116,7 +117,10 @@ def missing_dependencies(chart_dir, chart):
     for dep in chart.get("dependencies") or []:
         dep_name = dep.get("name", "")
         version = str(dep.get("version", "") or "")
-        exact = version and not any(c in version for c in "*^~><=| ")
+        # A range can be spelled with operators OR x/X wildcard segments
+        # ("1.x"); only true pins map to a <name>-<version>.tgz filename.
+        exact = (version and not any(c in version for c in "*^~><=| ")
+                 and not re.search(r"(^|\.)[xX](\.|$)", version))
         if exact:
             archives = list(charts_dir.glob(f"{dep_name}-{version}.tgz"))
         else:

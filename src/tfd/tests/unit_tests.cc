@@ -26,6 +26,7 @@
 #include "tfd/lm/slice_strategy.h"
 #include "tfd/lm/tpu_labeler.h"
 #include "tfd/pjrt/pjrt_binding.h"
+#include "tfd/platform/detect.h"
 #include "tfd/resource/types.h"
 #include "tfd/slice/shape.h"
 #include "tfd/slice/topology.h"
@@ -614,6 +615,48 @@ void TestSharingDevicesSelector() {
   }
 }
 
+void TestNullManager() {
+  // The end state of every degradation path: zero devices, loud errors
+  // on identity getters, and it never touches hardware.
+  auto null = resource::NewNullManager();
+  CHECK_TRUE(null->Init().ok());
+  CHECK_EQ(null->Name(), "null");
+  CHECK_TRUE(!null->TouchesDevices());
+  auto devices = null->GetDevices();
+  CHECK_TRUE(devices.ok() && devices->empty());
+  CHECK_TRUE(!null->GetLibtpuVersion().ok());
+  CHECK_TRUE(!null->GetRuntimeVersion().ok());
+  CHECK_TRUE(!null->GetTopology().ok());
+  null->Shutdown();
+}
+
+void TestPlatformDetect() {
+  // OnGce: driven through the DMI-file parameter, not the live host.
+  std::string gce = WriteTemp("Google Compute Engine\n");
+  CHECK_TRUE(platform::OnGce(gce));
+  std::string metal = WriteTemp("Some Vendor Board\n");
+  CHECK_TRUE(!platform::OnGce(metal));
+  CHECK_TRUE(!platform::OnGce("/nonexistent/dmi"));
+  remove(gce.c_str());
+  remove(metal.c_str());
+
+  // Search order: an override path always wins and comes first.
+  auto paths = platform::LibtpuSearchPaths("/custom/libtpu.so");
+  CHECK_TRUE(!paths.empty());
+  CHECK_EQ(paths[0], "/custom/libtpu.so");
+  CHECK_EQ(static_cast<int>(paths.size()), 1);
+  CHECK_TRUE(platform::LibtpuSearchPaths("").size() >= 1);
+
+  // HasLibtpu with an unloadable override: false, resolved path
+  // untouched (callers log it only on success).
+  std::string resolved = "unchanged";
+  CHECK_TRUE(!platform::HasLibtpu("/nonexistent/libtpu.so", &resolved));
+  CHECK_EQ(resolved, "unchanged");
+
+  // MetadataPlausible: an explicit endpoint is always plausible.
+  CHECK_TRUE(platform::MetadataPlausible("127.0.0.1:1"));
+}
+
 void TestFallbackDecorator() {
   const char* fixture = R"(
 initError: simulated init failure
@@ -935,6 +978,8 @@ int main() {
   tfd::TestSharing();
   tfd::TestClientOptionParsing();
   tfd::TestSharingDevicesSelector();
+  tfd::TestNullManager();
+  tfd::TestPlatformDetect();
   tfd::TestFallbackDecorator();
   tfd::TestFallbackChain();
   tfd::TestBoolParsing();

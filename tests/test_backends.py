@@ -599,6 +599,38 @@ class TestPjrtInitWatchdog:
             assert labels["google.com/tpu.slice.worker-id"] == "2"
             assert labels["google.com/tpu.topology"] == "4x4"
 
+    def test_pin_bounds_v4_multihost(self, tfd_binary):
+        """v4 multi-host slice (v4-32 = 16 chips, 4 hosts of 2x2x1): the
+        pin must enumerate the 4 local chips and overlay the 2x2x4 slice
+        topology from metadata — v4 is the remaining cube-topology family
+        the pin path had no golden-shaped case for."""
+        fixture = tpu_vm(
+            accelerator_type="v4-32", topology="2x2x4",
+            host_bounds="1,1,4", chips_per_host_bounds=None,
+            worker_id=2, machine_type="ct4p-hightpu-4t")
+        with FakeMetadataServer(fixture) as server:
+            code, out, err = run_tfd(tfd_binary, [
+                "--oneshot", "--output-file=", "--backend=pjrt",
+                f"--libtpu-path={FAKE_PJRT}",
+                "--pjrt-init-timeout=10", "--slice-strategy=single",
+                f"--metadata-endpoint={server.endpoint}",
+                "--machine-type-file=/dev/null",
+            ], env={
+                "TFD_FAKE_PJRT_MULTIHOST_HANG": "1",
+                "TFD_FAKE_PJRT_KIND": "TPU v4",
+                "TFD_FAKE_PJRT_HBM_GIB": "32",
+                "GCE_METADATA_HOST": server.endpoint,
+            })
+            assert code == 0, err
+            labels = labels_of(out)
+            assert labels["google.com/tpu.count"] == "4"
+            assert labels["google.com/tpu.product"] == "tpu-v4"
+            assert labels["google.com/tpu.topology"] == "2x2x4"
+            assert labels["google.com/tpu.slice.hosts"] == "4"
+            assert labels["google.com/tpu.slice.worker-id"] == "2"
+            # 2x2x4 is not a wrapped cube (all dims %4 required).
+            assert labels["google.com/tpu.ici.wrap"] == "false"
+
     def test_hostnames_trailing_comma_not_counted_as_host(self, tfd_binary):
         """TPU_WORKER_HOSTNAMES with a trailing comma must count 4 hosts,
         not 5: a phantom host fails the chips%hosts divisibility check and
@@ -887,6 +919,71 @@ class TestPjrtInitWatchdog:
         assert labels["google.com/tpu.product"] == "tpu-v6e"
         assert labels["google.com/tpu.topology"] == "2x4"
         assert labels["google.com/tpu.backend"] == "pjrt"
+
+
+class TestMetadataEnrichment:
+    """The auto chain's enrichment decorator (resource/enrich.cc): PJRT
+    answers everything it can see, and GCE metadata fills ONLY the
+    blanks PJRT cannot know — the accelerator-type string and (when PJRT
+    has no process view) the scheduler-facing worker id. No reference
+    analogue: NVML alone answers everything for GPUs; TPU identity is
+    split across libtpu and the metadata server."""
+
+    def test_auto_enriches_accelerator_type_from_metadata(self,
+                                                          tfd_binary):
+        with FakeMetadataServer(tpu_vm(
+                accelerator_type="v5litepod-4", topology="2x2",
+                machine_type="ct5lp-hightpu-4t")) as server:
+            code, out, err = run_tfd(tfd_binary, [
+                "--oneshot", "--output-file=", "--backend=auto",
+                f"--libtpu-path={FAKE_PJRT}",
+                f"--metadata-endpoint={server.endpoint}",
+                "--machine-type-file=/dev/null",
+            ], env={
+                "TFD_FAKE_PJRT_KIND": "TPU v5 lite",
+                "TFD_FAKE_PJRT_BOUNDS": "2,2,1",
+                "GCE_METADATA_HOST": server.endpoint,
+            })
+            assert code == 0, err
+            labels = labels_of(out)
+            # Device facts + topology from the live PJRT client...
+            assert labels["google.com/tpu.backend"] == "pjrt"
+            assert labels["google.com/tpu.count"] == "4"
+            assert labels["google.com/tpu.topology"] == "2x2"
+            # ...accelerator-type enriched from metadata (PJRT has no
+            # GCE identity string).
+            assert labels["google.com/tpu.accelerator-type"] == \
+                "v5litepod-4"
+
+    def test_auto_pjrt_facts_win_over_metadata(self, tfd_binary):
+        """Enrichment must never override what PJRT measured: a
+        single-host metadata bag with a different topology claim fills
+        only the accelerator-type blank; the enumerated topology stands.
+        (A MULTI-host metadata claim is a different, also-correct path —
+        the watchdog pins and overlays slice topology from metadata;
+        covered by TestPjrtInitWatchdog.)"""
+        with FakeMetadataServer(tpu_vm(
+                accelerator_type="v5litepod-8", topology="2x4",
+                machine_type="ct5lp-hightpu-8t")) as server:
+            code, out, err = run_tfd(tfd_binary, [
+                "--oneshot", "--output-file=", "--backend=auto",
+                f"--libtpu-path={FAKE_PJRT}",
+                f"--metadata-endpoint={server.endpoint}",
+                "--machine-type-file=/dev/null",
+            ], env={
+                "TFD_FAKE_PJRT_KIND": "TPU v5 lite",
+                "TFD_FAKE_PJRT_BOUNDS": "2,2,1",
+                "GCE_METADATA_HOST": server.endpoint,
+            })
+            assert code == 0, err
+            labels = labels_of(out)
+            # PJRT enumerated a 2x2 host (single-host: no pin, no
+            # overlay); metadata's 2x4 claim fills only the
+            # accelerator-type blank, not the live topology.
+            assert labels["google.com/tpu.topology"] == "2x2"
+            assert labels["google.com/tpu.count"] == "4"
+            assert labels["google.com/tpu.accelerator-type"] == \
+                "v5litepod-8"
 
 
 class TestPjrtClientOptions:

@@ -744,6 +744,16 @@ void TestAtomicWrite() {
   CHECK_EQ(*ReadFile(path), "x=2\n");
   std::string cmd = "rm -rf " + dir;
   CHECK_TRUE(system(cmd.c_str()) == 0);
+
+  // Error paths stay errors, not silent no-ops: an unwritable target
+  // directory (scratch-dir creation fails under a plain file) and a
+  // missing read target.
+  std::string file_as_dir = WriteTemp("not a directory");
+  Status s = WriteFileAtomically(file_as_dir + "/labels", "x=1\n");
+  CHECK_TRUE(!s.ok());
+  CHECK_TRUE(s.message().find("scratch dir") != std::string::npos);
+  remove(file_as_dir.c_str());
+  CHECK_TRUE(!ReadFile("/nonexistent/tfd-labels").ok());
 }
 
 void TestUrlParsing() {

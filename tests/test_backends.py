@@ -599,6 +599,23 @@ class TestPjrtInitWatchdog:
             assert labels["google.com/tpu.slice.worker-id"] == "2"
             assert labels["google.com/tpu.topology"] == "4x4"
 
+    def test_inprocess_escape_hatch_no_watchdog(self, tfd_binary):
+        """--pjrt-init-timeout=0 disables the watchdog: init runs
+        in-process (debugging escape hatch, config.h) and still produces
+        the full label set, feeding the same snapshot cache."""
+        code, out, err = run_tfd(tfd_binary, pjrt_args(
+            ["--pjrt-init-timeout=0"]), env={
+                "TFD_FAKE_PJRT_KIND": "TPU v5 lite",
+                "TFD_FAKE_PJRT_BOUNDS": "2,2,1",
+            })
+        assert code == 0, err
+        labels = labels_of(out)
+        assert labels["google.com/tpu.backend"] == "pjrt"
+        assert labels["google.com/tpu.count"] == "4"
+        assert labels["google.com/tpu.topology"] == "2x2"
+        # No probe child in this mode: the log must not mention one.
+        assert "PJRT init probe" not in err
+
     def test_pin_bounds_v4_multihost(self, tfd_binary):
         """v4 multi-host slice (v4-32 = 16 chips, 4 hosts of 2x2x1): the
         pin must enumerate the 4 local chips and overlay the 2x2x4 slice
@@ -1105,6 +1122,48 @@ class TestRelayPjrtPlugin:
         assert labels["google.com/tpu.backend"] == "pjrt"
         assert int(labels["google.com/tpu.count"]) >= 1
         assert labels["google.com/tpu.family"] != ""
+
+    def test_daemon_snapshot_cache_on_real_silicon(self, tfd_binary,
+                                                   tmp_path):
+        """Sleep-loop daemon against the relay: the exclusive chip is
+        claimed ONCE (one plugin load / probe) and later passes serve
+        the snapshot cache — the TPU-exclusivity contract, proven on
+        real silicon rather than the fake."""
+        import subprocess
+        import time
+        so, options = relay_pjrt_plugin()
+        out_file = tmp_path / "labels"
+        stderr_file = tmp_path / "stderr"
+        env = dict(os.environ, GCE_METADATA_HOST="127.0.0.1:1")
+        with open(stderr_file, "w") as stderr:
+            proc = subprocess.Popen([
+                str(tfd_binary), "--sleep-interval=1s",
+                f"--output-file={out_file}", "--backend=pjrt",
+                f"--libtpu-path={so}", "--pjrt-init-timeout=120s",
+                "--machine-type-file=/dev/null", *options,
+            ], env=env, stdout=subprocess.DEVNULL, stderr=stderr)
+            try:
+                deadline = time.monotonic() + 150
+                while time.monotonic() < deadline:
+                    if stderr_file.read_text().count("wrote ") >= 3:
+                        break
+                    time.sleep(0.3)
+                text = stderr_file.read_text()
+                assert text.count("wrote ") >= 3, text[-2000:]
+                labels = labels_of(out_file.read_text())
+            finally:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    # A daemon wedged inside the relay's client-create
+                    # must not outlive the test holding the exclusive
+                    # chip.
+                    proc.kill()
+                    proc.wait(timeout=10)
+        assert labels["google.com/tpu.backend"] == "pjrt"
+        # One "loaded <plugin>" line = one probe = one chip claim.
+        assert text.count(f"loaded {so}") == 1, text[-2000:]
 
 
 def _real_libtpu_path():

@@ -537,6 +537,10 @@ class TestPjrtInitWatchdog:
             assert labels["google.com/tpu.topology"] == "4x4"
             assert labels["google.com/tpu.slice.hosts"] == "2"
             assert labels["google.com/tpu.slice.worker-id"] == "1"
+            # Full both-direction golden: any label added to or dropped
+            # from the pin path is a loud regression.
+            check_golden(out, GOLDEN /
+                         "expected-output-tpu-pjrt-v6e-multihost-pinned.txt")
 
     def test_pin_bounds_from_gke_machine_type(self, tfd_binary):
         """GKE nodes carry no accelerator-type attribute, so the family

@@ -322,12 +322,10 @@ def tpu_probe_numbers():
             return {}
         from tpufd import health
 
-        # Median of 3 independent probe runs: a single differential pair
-        # can still catch tunnel jitter and report above chip peak.
-        tflops = round(statistics.median(
-            health.matmul_tflops() for _ in range(3)), 1)
-        gbps = round(statistics.median(
-            health.hbm_gbps() for _ in range(3)), 1)
+        # health.median_probe is the shared median-of-3 policy (same one
+        # the daemon's published labels use).
+        tflops = round(health.median_probe(health.matmul_tflops), 1)
+        gbps = round(health.median_probe(health.hbm_gbps), 1)
         out = {"tpu_matmul_tflops": tflops, "tpu_hbm_gbps": gbps}
         # ICI all-reduce: measured over a one-axis mesh of all local
         # chips when there are >1; recorded as an EXPLICIT null with the
@@ -343,8 +341,8 @@ def tpu_probe_numbers():
                 from jax.sharding import Mesh
                 import numpy as np
                 mesh = Mesh(np.array(devices), ("all",))
-                out["tpu_allreduce_gbps"] = round(statistics.median(
-                    health.allreduce_gbps(mesh) for _ in range(3)), 1)
+                out["tpu_allreduce_gbps"] = round(health.median_probe(
+                    lambda: health.allreduce_gbps(mesh)), 1)
             except Exception as e:  # noqa: BLE001
                 out["tpu_allreduce_skip_reason"] = f"probe failed: {e}"
         else:

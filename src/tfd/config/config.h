@@ -106,7 +106,9 @@ struct Flags {
   // Command for --device-health=full; must print google.com/tpu.health.*
   // key=value lines (the NFD feature-file format) to stdout and exit 0.
   std::string health_exec = "python3 -m tpufd health";
-  int health_exec_timeout_s = 120;
+  // Sized for the full probe (jax init + median-of-3 matmul and HBM
+  // runs ≈ 70s on a tunneled v5e) with headroom for slower transports.
+  int health_exec_timeout_s = 240;
   // Measured throughput doesn't change minute to minute: the exec result
   // is cached and re-measured only this often, so the probe never runs
   // once per sleep-interval.

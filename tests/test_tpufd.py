@@ -115,7 +115,7 @@ def test_health_probes_cpu(cpu_jax):
     labels = health.health_labels()
     assert labels["google.com/tpu.health.ok"] == "true"
     # 8 visible devices -> the ICI all-reduce probe must contribute.
-    assert int(labels["google.com/tpu.health.allreduce-gbps"]) > 0
+    assert float(labels["google.com/tpu.health.allreduce-gbps"]) > 0
     # CPU devices have no rated-peak context; no pct/degraded labels.
     assert "google.com/tpu.health.hbm-gbps-rated" not in labels
 

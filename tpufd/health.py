@@ -262,7 +262,8 @@ def median_probe(fn, runs=3):
 def health_labels(prefix="google.com/tpu.health."):
     """Runs the measured-silicon probes and returns a label dict, e.g.
     {"google.com/tpu.health.matmul-tflops": "123", ...}. Values are
-    integers (label values must be stable-ish strings). Probe sizes are
+    whole numbers at TPU scale; below 10 they carry two significant
+    digits (see fmt below) — parse with float(). Probe sizes are
     TPU-scale on TPU and small elsewhere (CI hosts). With more than one
     visible device the ICI all-reduce probe runs over a one-axis mesh of
     all of them; single-chip nodes skip it (there is no ICI to measure).
@@ -280,10 +281,19 @@ def health_labels(prefix="google.com/tpu.health."):
     family = family_of(devices[0])
     labels = {}
 
+    def fmt(v):
+        """Throughput as a label value: whole numbers at TPU scale, two
+        significant digits below 10 — a small-but-real measurement on a
+        loaded CPU/CI host (observed: 0.4 GB/s all-reduce with every
+        core busy) must never publish as "0", which reads as probe
+        failure. k8s label values permit [A-Za-z0-9._-], so "0.43" and
+        even a pathological "4.3e-05" are valid."""
+        return str(int(v)) if v >= 10 else f"{v:.2g}"
+
     def with_rated(measured, rated_table, name):
         """Publishes measured + rated + pct-of-rated (+ degraded flag),
         so 80%-of-rated never reads as sickness without context."""
-        labels[prefix + name] = str(int(measured))
+        labels[prefix + name] = fmt(measured)
         pct = pct_of_rated(measured, family, rated_table)
         if pct is not None:
             labels[prefix + name + "-rated"] = str(int(rated_table[family]))
@@ -298,8 +308,8 @@ def health_labels(prefix="google.com/tpu.health."):
                    RATED_HBM_GBPS, "hbm-gbps")
         if len(devices) > 1:
             mesh = Mesh(np.array(devices), ("all",))
-            labels[prefix + "allreduce-gbps"] = str(int(
-                allreduce_gbps(mesh, mib=64 if on_tpu else 8)))
+            labels[prefix + "allreduce-gbps"] = fmt(median_probe(
+                lambda: allreduce_gbps(mesh, mib=64 if on_tpu else 8)))
         labels[prefix + "ok"] = "true"
     except Exception:  # noqa: BLE001 — any device failure marks unhealthy
         labels[prefix + "ok"] = "false"

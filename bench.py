@@ -327,6 +327,16 @@ def tpu_probe_numbers():
         tflops = round(health.median_probe(health.matmul_tflops), 1)
         gbps = round(health.median_probe(health.hbm_gbps), 1)
         out = {"tpu_matmul_tflops": tflops, "tpu_hbm_gbps": gbps}
+        # DMA-engine bandwidth (pallas HBM→HBM copy) next to the VPU
+        # stream: the two agreeing inside the 74-87%-of-rated band is the
+        # mechanism-independence proof; sharp disagreement = a sick path.
+        # Own try: a Mosaic/pallas failure (e.g. a relay plugin without
+        # custom-call support) must not discard the numbers above.
+        try:
+            out["tpu_dma_copy_gbps"] = round(
+                health.median_probe(health.dma_copy_gbps), 1)
+        except Exception as e:  # noqa: BLE001
+            out["tpu_dma_copy_skip_reason"] = f"probe failed: {e}"
         # ICI all-reduce: measured over a one-axis mesh of all local
         # chips when there are >1; recorded as an EXPLICIT null with the
         # reason on single-chip hosts, so the never-measured-on-silicon

@@ -19,7 +19,8 @@ import sys
 def cmd_health(args):
     from tpufd import health
 
-    labels = health.health_labels(prefix=args.prefix)
+    labels = health.health_labels(prefix=args.prefix,
+                                  extended=args.extended)
     for key in sorted(labels):
         print(f"{key}={labels[key]}")
     return 0 if labels.get(args.prefix + "ok") == "true" else 1
@@ -48,6 +49,10 @@ def main(argv=None):
 
     health = sub.add_parser("health", help="on-chip health probe labels")
     health.add_argument("--prefix", default="google.com/tpu.health.")
+    health.add_argument(
+        "--extended", action="store_true",
+        help="add the pallas DMA-copy probe (dma-copy-gbps): slower, "
+             "distinguishes a sick VPU/DMA path from sick HBM")
     health.set_defaults(fn=cmd_health)
 
     def positive_int(text):

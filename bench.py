@@ -268,42 +268,53 @@ def real_libtpu_path():
 PJRT_REAL_SOURCE = {"value": None}  # which candidate produced pjrt_real
 
 
+def relay_daemon_flags():
+    """Daemon flags for labeling real silicon through the ambient relay
+    PJRT plugin (tunneled-TPU environments), or None when none is
+    exported. The ONE home of the relay discovery + init-timeout policy:
+    pjrt_real_p50 and soak_record must not diverge on it. A cold relay
+    claim can take tens of seconds before the steady ~100ms state, hence
+    the generous init watchdog deadline."""
+    sys.path.insert(0, str(REPO))
+    from tpufd.relay import relay_pjrt_plugin
+
+    relay = relay_pjrt_plugin()
+    if relay is None:
+        return None
+    so, options = relay
+    return [f"--libtpu-path={so}", "--pjrt-init-timeout=120s", *options]
+
+
 def pjrt_real_p50(out_file):
     """p50 of the shipped pjrt backend labeling REAL silicon: first the
     directly-attached libtpu, then the ambient relay PJRT plugin. None
     when no candidate can create a client (e.g. chips held by a training
     job) — each candidate's exact failure goes to stderr so a null is
     always explained in the bench tail."""
-    sys.path.insert(0, str(REPO))
-    from tpufd.relay import relay_pjrt_plugin
-
     candidates = []
     libtpu = real_libtpu_path()
     if libtpu is not None:
-        candidates.append(("libtpu", libtpu, []))
-    relay = relay_pjrt_plugin()
-    if relay is not None:
-        candidates.append(("relay-plugin", relay[0], relay[1]))
+        candidates.append(("libtpu", [f"--libtpu-path={libtpu}",
+                                      "--pjrt-init-timeout=120s"]))
+    relay_flags = relay_daemon_flags()
+    if relay_flags is not None:
+        candidates.append(("relay-plugin", relay_flags))
     if not candidates:
         sys.stderr.write(
             "pjrt_real skipped: no libtpu.so importable and no relay "
             "PJRT plugin exported (PJRT_LIBRARY_PATH unset)\n")
         return None
-    for name, so, options in candidates:
+    for name, flags in candidates:
         try:
-            # A cold relay claim can take tens of seconds before the
-            # steady ~100ms state; don't let the init watchdog kill the
-            # warm-up sample (the cold cost lands on p50_of's warm run,
-            # not in the reported median).
-            p50 = p50_of(
-                SIDE_RUNS, out_file, "pjrt",
-                extra_args=[f"--libtpu-path={so}",
-                            "--pjrt-init-timeout=120s", *options],
-                check_backend="pjrt")
+            # The cold init cost lands on p50_of's warm run, not in the
+            # reported median.
+            p50 = p50_of(SIDE_RUNS, out_file, "pjrt",
+                         extra_args=flags, check_backend="pjrt")
             PJRT_REAL_SOURCE["value"] = name
             return p50
         except (RuntimeError, SystemExit) as e:
-            sys.stderr.write(f"pjrt_real via {name} ({so}) failed: {e}\n")
+            sys.stderr.write(
+                f"pjrt_real via {name} ({flags[0]}) failed: {e}\n")
     return None
 
 
@@ -433,6 +444,47 @@ def daemon_silicon_numbers(out_file):
         return {}
 
 
+def soak_record():
+    """Daemon steady-state proof via scripts/soak.py: N passes at 1s
+    cadence with memory/fd/label-stability/clean-exit checks. Prefers the
+    real-silicon path (relay PJRT plugin — first pass inits the chip,
+    steady state rides the snapshot cache); falls back to the mock
+    fixture so the record exists on chipless CI hosts too. Keys are
+    prefixed soak_; soak_ok=false stays in the record rather than
+    disappearing — a flaky steady state must be visible."""
+    duration = float(os.environ.get("TFD_BENCH_SOAK_S", "15"))
+    extra, backend = None, None
+    if not os.environ.get("TFD_BENCH_SKIP_TPU_PROBE"):
+        try:
+            relay_flags = relay_daemon_flags()
+            if relay_flags is not None:
+                extra = ["--backend=pjrt", *relay_flags]
+                backend = "pjrt-relay"
+        except Exception as e:  # noqa: BLE001
+            sys.stderr.write(f"soak relay discovery failed: {e}\n")
+    if extra is None:
+        extra = ["--backend=mock",
+                 f"--mock-topology-file={REPO}/tests/fixtures/v5e-4.yaml"]
+        backend = "mock"
+    cmd = [sys.executable, str(REPO / "scripts" / "soak.py"),
+           "--binary", str(BINARY), "--duration", str(duration),
+           *(f"--extra-arg={a}" for a in extra)]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=duration + 180)
+        report = json.loads(proc.stdout.strip().splitlines()[-1])
+    except Exception as e:  # noqa: BLE001 — bench must not die on soak
+        return {"soak_ok": False, "soak_backend": backend,
+                "soak_error": f"harness failed: {e}"}
+    out = {"soak_ok": report.pop("ok", False), "soak_backend": backend}
+    for key in ("passes", "rss_drift_kb", "fd_start", "fd_end",
+                "labels_stable", "rewrite_interval_p50_s", "clean_exit",
+                "error"):
+        if key in report:
+            out[f"soak_{key}"] = report[key]
+    return out
+
+
 def main():
     ensure_built()
     headline = os.environ.get("TFD_BENCH_BACKEND", "mock")
@@ -485,6 +537,8 @@ def main():
     # starve the daemon's exec'd probe.
     with tempfile.TemporaryDirectory() as tmp:
         record.update(daemon_silicon_numbers(str(Path(tmp) / "tfd")))
+    # Soak before tpu_probe_numbers for the same exclusive-chip reason.
+    record.update(soak_record())
     record.update(tpu_probe_numbers())
     print(json.dumps(record))
 

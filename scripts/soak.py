@@ -1,0 +1,192 @@
+#!/usr/bin/env python3
+"""Soak-test the daemon: run it in daemon mode for a while and prove the
+steady state is actually steady — memory flat, fds flat, labels stable,
+rewrites on cadence, clean shutdown.
+
+The unit/CLI tiers prove each pass is CORRECT; CI's sanitizer job proves
+a pass doesn't corrupt memory. Neither catches the classic daemon
+failure modes: a slow per-pass heap or fd leak, label churn between
+passes, or a rewrite cadence that drifts. This harness runs the shipped
+binary long enough for those to show (reference analogue: GFD's e2e tier
+watches the daemon relabel on cadence, tests/e2e-tests.py — but nothing
+in the reference watches its memory; this goes further).
+
+Usage:
+  python3 scripts/soak.py --binary build/tpu-feature-discovery \
+      --duration 30 [--interval 1] [--extra-arg=--backend=mock ...]
+
+Prints ONE JSON line, e.g.:
+  {"ok": true, "passes": 29, "rss_start_kb": 3180, "rss_end_kb": 3180,
+   "rss_drift_kb": 0, "fd_start": 6, "fd_end": 6, "labels_stable": true,
+   "rewrite_interval_p50_s": 1.0, "clean_exit": true}
+
+Exit code 0 iff ok. "ok" means: >=3 passes observed, RSS drift under
+--max-rss-drift-kb (default 1024), fd count unchanged, labels (minus the
+timestamp) identical across every pass, SIGTERM led to exit 0 and the
+output file was removed.
+"""
+
+import argparse
+import hashlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+
+def rss_kb(pid):
+    """Resident set size in KiB from /proc (Linux; the daemon's target)."""
+    with open(f"/proc/{pid}/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1])
+    raise RuntimeError("no VmRSS in /proc status")
+
+
+def fd_count(pid):
+    return len(os.listdir(f"/proc/{pid}/fd"))
+
+
+def stable_digest(label_text):
+    """Digest of the label set minus the timestamp line — the one label
+    that legitimately changes every pass."""
+    lines = [l for l in label_text.splitlines()
+             if not l.startswith("google.com/tfd.timestamp=")]
+    return hashlib.sha256("\n".join(lines).encode()).hexdigest()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--binary", default="build/tpu-feature-discovery")
+    ap.add_argument("--duration", type=float, default=30.0,
+                    help="seconds to soak")
+    ap.add_argument("--interval", type=int, default=1,
+                    help="daemon --sleep-interval in seconds")
+    ap.add_argument("--max-rss-drift-kb", type=int, default=1024,
+                    help="fail if RSS grows more than this over the soak")
+    ap.add_argument("--settle-passes", type=int, default=3,
+                    help="passes to let allocators warm up before the RSS "
+                         "baseline is taken (first passes legitimately "
+                         "grow the heap: stdio buffers, metadata caches)")
+    ap.add_argument("--extra-arg", action="append", default=[],
+                    help="extra daemon flag (repeatable)")
+    ap.add_argument("--init-grace", type=float, default=180.0,
+                    help="seconds allowed for the FIRST pass (backend "
+                         "init: a cold PJRT chip claim can take tens of "
+                         "seconds); the soak clock starts at the first "
+                         "observed rewrite, not at spawn")
+    args = ap.parse_args(argv)
+
+    out = {"ok": False}
+    with tempfile.TemporaryDirectory() as d:
+        label_file = os.path.join(d, "tfd")
+        stderr_path = os.path.join(d, "stderr")
+        cmd = [args.binary, f"--sleep-interval={args.interval}s",
+               f"--output-file={label_file}",
+               "--machine-type-file=/dev/null", *args.extra_arg]
+        env = {**os.environ}
+        env.setdefault("GCE_METADATA_HOST", "127.0.0.1:1")
+
+        def stderr_tail():
+            try:
+                with open(stderr_path, "rb") as f:
+                    f.seek(0, os.SEEK_END)
+                    f.seek(max(0, f.tell() - 500))
+                    return f.read().decode(errors="replace")
+            except OSError:
+                return ""
+
+        # stderr goes to a file, not a pipe: a chatty daemon on a long
+        # soak would fill a 64KB pipe nobody drains and block mid-pass —
+        # reading as a false cadence stall.
+        stderr_file = open(stderr_path, "wb")
+        proc = subprocess.Popen(cmd, env=env,
+                                stdout=subprocess.DEVNULL,
+                                stderr=stderr_file)
+        stderr_file.close()
+        try:
+            digests, mtimes = set(), []
+            baseline_rss = baseline_fd = None
+            # The soak duration is steady-state time: the clock starts at
+            # the FIRST observed rewrite. Spawn-to-first-pass gets its own
+            # budget (--init-grace) so slow chip init neither eats the
+            # soak nor lets a never-writing daemon hang the harness.
+            deadline = time.monotonic() + args.init_grace
+            while time.monotonic() < deadline:
+                if proc.poll() is not None:
+                    break
+                try:
+                    st = os.stat(label_file)
+                except FileNotFoundError:  # first pass not done yet
+                    time.sleep(0.05)
+                    continue
+                if not mtimes or st.st_mtime != mtimes[-1]:
+                    if not mtimes:
+                        deadline = time.monotonic() + args.duration
+                    mtimes.append(st.st_mtime)
+                    digests.add(stable_digest(
+                        open(label_file).read()))
+                    if len(mtimes) == args.settle_passes:
+                        try:
+                            baseline_rss = rss_kb(proc.pid)
+                            baseline_fd = fd_count(proc.pid)
+                        except (OSError, RuntimeError):
+                            break  # died mid-sample; poll() below reports
+                time.sleep(0.05)
+
+            if proc.poll() is not None:
+                out["error"] = (f"daemon died mid-soak rc={proc.returncode}: "
+                                f"{stderr_tail()}")
+                print(json.dumps(out))
+                return 1
+            if not mtimes:
+                out["error"] = (f"no first pass within --init-grace="
+                                f"{args.init_grace}s: {stderr_tail()}")
+                print(json.dumps(out))
+                return 1
+
+            try:
+                end_rss, end_fd = rss_kb(proc.pid), fd_count(proc.pid)
+            except (OSError, RuntimeError):  # died between poll and read
+                out["error"] = ("daemon died during final sampling: "
+                                + stderr_tail())
+                print(json.dumps(out))
+                return 1
+            proc.send_signal(signal.SIGTERM)
+            try:
+                clean = proc.wait(timeout=30) == 0
+            except subprocess.TimeoutExpired:
+                clean = False  # won't shut down IS the finding
+            gaps = sorted(b - a for a, b in zip(mtimes, mtimes[1:]))
+
+            out.update({
+                "passes": len(mtimes),
+                "rss_start_kb": baseline_rss, "rss_end_kb": end_rss,
+                "rss_drift_kb": (None if baseline_rss is None
+                                 else end_rss - baseline_rss),
+                "fd_start": baseline_fd, "fd_end": end_fd,
+                "labels_stable": len(digests) == 1,
+                "rewrite_interval_p50_s": (
+                    round(gaps[len(gaps) // 2], 2) if gaps else None),
+                "clean_exit": clean,
+                "file_removed": not os.path.exists(label_file),
+            })
+            out["ok"] = bool(
+                len(mtimes) >= max(3, args.settle_passes)
+                and baseline_rss is not None
+                and out["rss_drift_kb"] <= args.max_rss_drift_kb
+                and end_fd == baseline_fd
+                and out["labels_stable"] and clean and out["file_removed"])
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+    print(json.dumps(out))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

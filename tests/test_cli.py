@@ -496,3 +496,76 @@ def test_v4_16_mixed(tfd_binary):
          "--slice-strategy=mixed", "--machine-type-file=/dev/null"]))
     assert code == 0
     check_golden(out, GOLDEN / "expected-output-tpu-v4-16-mixed.txt")
+
+
+class TestSoakHarness:
+    """scripts/soak.py — the daemon steady-state prover bench.py records.
+    A short real soak here (mock backend) plus hermetic checks of the
+    harness's own failure detection, so a soak_ok=true in a bench record
+    is backed by a harness that demonstrably can say false."""
+
+    SOAK = Path(__file__).resolve().parent.parent / "scripts" / "soak.py"
+
+    def run_soak(self, args):
+        import json as json_mod
+        import sys as sys_mod
+        proc = subprocess.run(
+            [sys_mod.executable, str(self.SOAK), *args],
+            capture_output=True, text=True, timeout=120)
+        lines = [l for l in proc.stdout.splitlines() if l.strip()]
+        return proc.returncode, json_mod.loads(lines[-1])
+
+    def test_short_soak_is_steady(self, tfd_binary):
+        rc, report = self.run_soak(
+            ["--binary", str(tfd_binary), "--duration", "7",
+             "--extra-arg=--backend=mock",
+             f"--extra-arg=--mock-topology-file={FIXTURES / 'v2-8.yaml'}"])
+        assert rc == 0 and report["ok"] is True, report
+        assert report["passes"] >= 4
+        assert report["rss_drift_kb"] <= 1024
+        assert report["fd_start"] == report["fd_end"]
+        assert report["labels_stable"] is True
+        assert report["clean_exit"] is True and report["file_removed"]
+
+    def test_detects_label_churn_and_dirty_exit(self, tmp_path):
+        """A 'daemon' whose labels churn every pass and which neither
+        removes its file nor exits 0 on SIGTERM must fail the soak —
+        proving the harness's checks bite, not just pass."""
+        fake = tmp_path / "churny"
+        fake.write_text(
+            "#!/bin/bash\n"
+            "trap 'exit 3' TERM\n"  # dirty exit, file left behind
+            "out=''\n"
+            "for a in \"$@\"; do case $a in --output-file=*)"
+            " out=${a#*=};; esac; done\n"
+            "i=0\n"
+            "while true; do echo \"google.com/tpu.x=$i\" > \"$out\";"
+            " i=$((i+1)); sleep 1; done\n")
+        fake.chmod(0o755)
+        rc, report = self.run_soak(
+            ["--binary", str(fake), "--duration", "6"])
+        assert rc == 1 and report["ok"] is False
+        assert report["labels_stable"] is False
+        assert report["clean_exit"] is False
+        assert report["file_removed"] is False
+
+    def test_dead_daemon_is_an_error(self, tmp_path):
+        fake = tmp_path / "dies"
+        fake.write_text("#!/bin/bash\nexit 7\n")
+        fake.chmod(0o755)
+        rc, report = self.run_soak(
+            ["--binary", str(fake), "--duration", "4"])
+        assert rc == 1 and report["ok"] is False
+        assert "died" in report.get("error", "")
+
+    def test_never_writing_daemon_hits_init_grace(self, tmp_path):
+        """A daemon that stays alive but never produces a first pass must
+        fail at --init-grace, not hang the harness or eat the soak."""
+        fake = tmp_path / "mute"
+        fake.write_text("#!/bin/bash\ntrap 'exit 0' TERM\n"
+                        "while true; do sleep 1; done\n")
+        fake.chmod(0o755)
+        rc, report = self.run_soak(
+            ["--binary", str(fake), "--duration", "30", "--init-grace", "3"])
+        assert rc == 1 and report["ok"] is False
+        assert "init-grace" in report.get("error", "")

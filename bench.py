@@ -366,6 +366,25 @@ def tpu_probe_numbers():
                     lambda: health.allreduce_gbps(mesh)), 1)
             except Exception as e:  # noqa: BLE001
                 out["tpu_allreduce_skip_reason"] = f"probe failed: {e}"
+            # Per-axis ICI sweep when the chips expose a coord grid.
+            # Per-axis keys and per-axis failure reasons: an axis-y
+            # failure must neither masquerade as an allreduce failure
+            # nor silently drop the key.
+            try:
+                pmesh = health.physical_mesh(devices)
+                axes = (pmesh.axis_names
+                        if pmesh.axis_names != ("all",) else ())
+            except Exception as e:  # noqa: BLE001
+                out["tpu_ici_sweep_skip_reason"] = f"mesh failed: {e}"
+                axes = ()
+            for ax in axes:
+                try:
+                    out[f"tpu_ici_{ax}_gbps"] = round(
+                        health.median_probe(
+                            lambda ax=ax: health.ici_axis_gbps(
+                                pmesh, ax)), 1)
+                except Exception as e:  # noqa: BLE001
+                    out[f"tpu_ici_{ax}_skip_reason"] = f"probe failed: {e}"
         else:
             out["tpu_allreduce_skip_reason"] = (
                 f"{len(devices)} chip visible: no ICI to measure")
@@ -466,12 +485,18 @@ def soak_record():
         extra = ["--backend=mock",
                  f"--mock-topology-file={REPO}/tests/fixtures/v5e-4.yaml"]
         backend = "mock"
+    # The harness's own worst-case budget: init-grace (cold PJRT claim)
+    # + the soak itself + the 30s SIGTERM wait, plus slack — the outer
+    # timeout must never kill a soak that is within its documented
+    # budget (that would read as a steady-state failure).
+    init_grace = 180.0
     cmd = [sys.executable, str(REPO / "scripts" / "soak.py"),
            "--binary", str(BINARY), "--duration", str(duration),
+           "--init-grace", str(init_grace),
            *(f"--extra-arg={a}" for a in extra)]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
-                              timeout=duration + 180)
+                              timeout=init_grace + duration + 60)
         report = json.loads(proc.stdout.strip().splitlines()[-1])
     except Exception as e:  # noqa: BLE001 — bench must not die on soak
         return {"soak_ok": False, "soak_backend": backend,

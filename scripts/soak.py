@@ -102,11 +102,15 @@ def main(argv=None):
         # stderr goes to a file, not a pipe: a chatty daemon on a long
         # soak would fill a 64KB pipe nobody drains and block mid-pass —
         # reading as a false cadence stall.
-        stderr_file = open(stderr_path, "wb")
-        proc = subprocess.Popen(cmd, env=env,
-                                stdout=subprocess.DEVNULL,
-                                stderr=stderr_file)
-        stderr_file.close()
+        with open(stderr_path, "wb") as stderr_file:
+            try:
+                proc = subprocess.Popen(cmd, env=env,
+                                        stdout=subprocess.DEVNULL,
+                                        stderr=stderr_file)
+            except OSError as e:  # missing/unexecutable binary
+                out["error"] = f"cannot launch {cmd[0]}: {e}"
+                print(json.dumps(out))
+                return 1
         try:
             digests, mtimes = set(), []
             baseline_rss = baseline_fd = None

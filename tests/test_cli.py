@@ -558,6 +558,14 @@ class TestSoakHarness:
         assert rc == 1 and report["ok"] is False
         assert "died" in report.get("error", "")
 
+    def test_missing_binary_is_a_json_error(self, tmp_path):
+        """Even an unlaunchable binary keeps the one-JSON-line contract
+        (bench must get a parseable report, not a traceback)."""
+        rc, report = self.run_soak(
+            ["--binary", str(tmp_path / "nonexistent"), "--duration", "2"])
+        assert rc == 1 and report["ok"] is False
+        assert "cannot launch" in report.get("error", "")
+
     def test_never_writing_daemon_hits_init_grace(self, tmp_path):
         """A daemon that stays alive but never produces a first pass must
         fail at --init-grace, not hang the harness or eat the soak."""

@@ -168,6 +168,100 @@ def test_extended_probe_failure_degrades_gracefully(cpu_jax, monkeypatch):
     assert float(labels["google.com/tpu.health.allreduce-gbps"]) > 0
 
 
+class FakeCoordDev:
+    def __init__(self, coords):
+        self.coords = coords
+
+
+def test_coords_grid_arrangement():
+    """_coords_grid: dense boxes become (grid, axis-names) with size-1
+    axes dropped; anything else (no coords, duplicate coords as on
+    2-core-per-chip v2/v3, sparse reservations) is a loud None."""
+    from tpufd import health
+
+    # 2x2x1 dense box -> ("x","y"), z dropped.
+    devs = [FakeCoordDev((x, y, 0)) for x in range(2) for y in range(2)]
+    grid, names = health._coords_grid(devs)
+    assert names == ("x", "y") and grid.shape == (2, 2)
+    assert grid[1, 0] is devs[2]  # coord (1,0,0) landed at [1,0]
+
+    # Offset box (coords needn't start at 0): normalized.
+    devs = [FakeCoordDev((x, 5, 3)) for x in range(4)]
+    grid, names = health._coords_grid(devs)
+    assert names == ("x",) and grid.shape == (4,)
+
+    # All-size-1: keeps one axis rather than a 0-d grid.
+    grid, names = health._coords_grid([FakeCoordDev((0, 0, 0))])
+    assert names == ("x",) and grid.shape == (1,)
+
+    # Duplicate coords (two cores, one chip) -> None.
+    devs = [FakeCoordDev((0, 0, 0)), FakeCoordDev((0, 0, 0))]
+    assert health._coords_grid(devs) == (None, None)
+
+    # Sparse (3 devices in a 2x2 bounding box) -> None.
+    devs = [FakeCoordDev((0, 0, 0)), FakeCoordDev((1, 1, 0)),
+            FakeCoordDev((0, 1, 0))]
+    assert health._coords_grid(devs) == (None, None)
+
+    # No coords at all (CPU) -> None.
+    assert health._coords_grid([object(), object()]) == (None, None)
+
+
+def test_ici_axis_sweep_cpu(cpu_jax):
+    """ici_axis_gbps measures a real ppermute ring per axis of a 2-axis
+    mesh — and the ring actually permutes (a full cycle is the identity,
+    a single step is not)."""
+    import functools
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from tpufd import health
+
+    mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("x", "y"))
+    for axis in ("x", "y"):
+        assert health.ici_axis_gbps(mesh, axis, mib=4, iters=2) > 0
+
+    # Functional check of the ring primitive itself.
+    n = mesh.shape["x"]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    @jax.jit
+    @functools.partial(jax.shard_map, mesh=mesh, in_specs=(P("x"), P()),
+                       out_specs=P("x"), check_vma=False)
+    def shift(v, k):
+        return lax.fori_loop(
+            0, k, lambda _, acc: lax.ppermute(acc, "x", perm), v)
+
+    x = jnp.arange(8 * 128, dtype=jnp.bfloat16).reshape(8, 128)
+    assert bool(jnp.all(shift(x, jnp.int32(n)) == x))
+    assert bool(jnp.any(shift(x, jnp.int32(1)) != x))
+
+
+def test_ici_sweep_labels_cpu(cpu_jax, monkeypatch):
+    """When the devices expose a coordinate grid, health_labels adds one
+    ici-<axis>-gbps label per axis; CPU devices don't, so the physical
+    mesh is substituted. Off the grid (the default CPU path) no sweep
+    labels appear."""
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+
+    from tpufd import health
+
+    labels = health.health_labels()
+    assert not any("ici-" in k for k in labels)
+
+    pmesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("x", "y"))
+    monkeypatch.setattr(health, "physical_mesh", lambda devices: pmesh)
+    labels = health.health_labels()
+    assert float(labels["google.com/tpu.health.ici-x-gbps"]) > 0
+    assert float(labels["google.com/tpu.health.ici-y-gbps"]) > 0
+
+
 def test_rated_peak_tables():
     """The rated-peak tables (the documented expected-range context for
     measured throughput) must cover every TPU family the C++ family table

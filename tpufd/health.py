@@ -323,6 +323,81 @@ def allreduce_gbps(mesh, mib=64, iters=8):
     return bytes_moved / seconds / 1e9
 
 
+def _coords_grid(devices):
+    """Arranges devices into a dense coordinate grid: (ndarray, axis
+    names) with size-1 axes dropped, or (None, None) when the devices
+    don't form one — coords missing (CPU, some relay plugins), duplicated
+    (v2/v3 expose two cores per chip at the same coord), or sparse (a
+    non-contiguous reservation). Pure arrangement logic, split from
+    physical_mesh so it is testable without constructible jax devices."""
+    import numpy as np
+
+    coords = [getattr(d, "coords", None) for d in devices]
+    if (any(c is None for c in coords)
+            or len({tuple(c) for c in coords}) != len(devices)):
+        return None, None
+    dims = len(coords[0])
+    lo = [min(c[i] for c in coords) for i in range(dims)]
+    shape = [max(c[i] for c in coords) - lo[i] + 1 for i in range(dims)]
+    if int(np.prod(shape)) != len(devices):
+        return None, None  # sparse box: no well-defined ring per axis
+    grid = np.empty(shape, dtype=object)
+    for d, c in zip(devices, coords):
+        grid[tuple(ci - li for ci, li in zip(c, lo))] = d
+    keep = [i for i, s in enumerate(shape) if s > 1] or [0]
+    return (grid.reshape([shape[i] for i in keep]),
+            tuple("xyz"[i] if i < 3 else f"d{i}" for i in keep))
+
+
+def physical_mesh(devices):
+    """Mesh over the physical ICI topology (axes named x/y/z from device
+    coords), or a flat ("all",) mesh when the devices don't form a dense
+    coordinate grid. The flat fallback keeps every caller working on CPU
+    test meshes and relay plugins that hide coords."""
+    import numpy as np
+
+    from jax.sharding import Mesh
+
+    grid, names = _coords_grid(devices)
+    if grid is None:
+        return Mesh(np.array(devices), ("all",))
+    return Mesh(grid, names)
+
+
+def ici_axis_gbps(mesh, axis, mib=64, iters=8):
+    """Measured per-device send throughput (GB/s) around ONE mesh axis:
+    a lax.ppermute ring shifting each device's shard to its +1 neighbor,
+    so the traffic rides exactly that axis's ICI links. Run per axis
+    (the sweep), this localizes a weak link to an axis — the all-axis
+    allreduce probe can only say "somewhere". ppermute is also the
+    right primitive for the job: unlike psum it cannot be served by a
+    tree that skips links, and it is the building block the ring
+    collectives themselves ride."""
+    from jax import lax, shard_map
+
+    n_axis = mesh.shape[axis]
+    cols = 1024
+    rows = max(mib * 1024 * 1024 // 2 // cols // n_axis, 1) * n_axis
+    perm = [(i, (i + 1) % n_axis) for i in range(n_axis)]
+
+    @jax.jit
+    @functools.partial(shard_map, mesh=mesh, in_specs=(P(axis), P()),
+                       out_specs=P(axis), check_vma=False)
+    def shift(v, k):
+        def body(_, acc):
+            return lax.ppermute(acc, axis_name=axis, perm=perm)
+        return lax.fori_loop(0, k, body, v)
+
+    x = jax.device_put(
+        jnp.zeros((rows, cols), dtype=jnp.bfloat16),
+        NamedSharding(mesh, P(axis)))
+    seconds = _time_iters(
+        lambda k, salt: shift(x * salt, k), iters,
+        settle_s=_settle_s(mesh.devices.flat[0]))
+    bytes_sent_per_device = rows * cols * 2 / n_axis
+    return bytes_sent_per_device * iters / seconds / 1e9
+
+
 def median_probe(fn, runs=3):
     """Median of `runs` independent probe executions — the ONE home of
     this policy for both the daemon's published labels (health_labels)
@@ -402,6 +477,24 @@ def health_labels(prefix="google.com/tpu.health.", extended=False):
             mesh = Mesh(np.array(devices), ("all",))
             labels[prefix + "allreduce-gbps"] = fmt(median_probe(
                 lambda: allreduce_gbps(mesh, mib=64 if on_tpu else 8)))
+            # Per-axis ICI sweep: only when the devices expose a real
+            # coordinate grid (multi-chip TPU hosts) — a ppermute ring
+            # per physical axis localizes a weak link to an axis. Each
+            # axis gets its own try: the sweep is a localization
+            # diagnostic, and one axis failing to MEASURE (tunnel
+            # jitter, a plugin without ppermute) must neither flip
+            # ok=false on a node whose core probes measured healthy nor
+            # hide the other axes' numbers.
+            pmesh = physical_mesh(devices)
+            if pmesh.axis_names != ("all",):
+                for ax in pmesh.axis_names:
+                    try:
+                        labels[prefix + f"ici-{ax}-gbps"] = fmt(
+                            median_probe(lambda ax=ax: ici_axis_gbps(
+                                pmesh, ax, mib=64 if on_tpu else 4)))
+                    except Exception as e:  # noqa: BLE001
+                        sys.stderr.write(
+                            f"ici sweep axis {ax} skipped: {e}\n")
         labels[prefix + "ok"] = "true"
     except Exception:  # noqa: BLE001 — any device failure marks unhealthy
         labels[prefix + "ok"] = "false"

@@ -357,13 +357,60 @@ def test_bench_json_contract():
     assert record["soak_labels_stable"] is True
 
 
+def test_ring_attention_matches_full(cpu_jax):
+    """Context-parallel ring attention must be numerically exact against
+    full attention — the streaming-softmax accumulation and the ppermute
+    rotation together reconstruct softmax(QK^T/√d)V, block order
+    notwithstanding."""
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+
+    from tpufd import burnin
+
+    mesh = Mesh(np.array(jax.devices()), ("context",))
+    err = burnin.run_ring_attention_burnin(mesh, heads=2, seq=32, d_head=16)
+    assert err <= 1e-4
+
+    # Also directly over a 2-axis mesh's first axis (the shape dryrun and
+    # multi-axis slices use).
+    mesh2 = Mesh(np.array(jax.devices()).reshape(4, 2), ("context", "other"))
+    err = burnin.run_ring_attention_burnin(mesh2, axis="context", seq=16)
+    assert err <= 1e-4
+
+
+def test_ring_attention_detects_divergence(cpu_jax, monkeypatch):
+    """A corrupted exchange must FAIL the burn-in: substitute a reference
+    that disagrees and the acceptance check raises."""
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+
+    import pytest as _pytest
+
+    from tpufd import burnin
+
+    mesh = Mesh(np.array(jax.devices()), ("context",))
+    real_full = burnin.full_attention
+    monkeypatch.setattr(burnin, "full_attention",
+                        lambda q, k, v: real_full(q, k, v) + 1.0)
+    with _pytest.raises(RuntimeError, match="diverged"):
+        burnin.run_ring_attention_burnin(mesh, seq=16)
+
+
 def test_cli_burnin(cpu_jax, capsys):
-    """python -m tpufd burnin runs the sharded step over all devices."""
+    """python -m tpufd burnin runs the sharded step over all devices,
+    then the ring-attention long-context acceptance."""
     from tpufd.__main__ import main
 
     assert main(["burnin", "--steps", "1"]) == 0
     out = capsys.readouterr().out
     assert "mesh: data=" in out and "final loss" in out
+    assert "ring attention over context=8" in out
+
+    assert main(["burnin", "--steps", "1", "--skip-ring"]) == 0
+    out = capsys.readouterr().out
+    assert "ring attention" not in out
 
 
 def test_cli_health(cpu_jax, capsys):

@@ -40,6 +40,23 @@ def cmd_burnin(args):
     ok = math.isfinite(loss)
     print(f"final loss after {args.steps} steps: {loss:.6f} "
           f"({'ok' if ok else 'NOT FINITE'})")
+    if ok and not args.skip_ring and len(devices) > 1:
+        # Long-context acceptance: context-parallel ring attention over
+        # ALL devices, checked for equality against full attention — a
+        # corrupting ICI link fails here even when the MLP loss looks
+        # plausible.
+        from jax.sharding import Mesh
+
+        import numpy as np
+
+        ring_mesh = Mesh(np.array(devices), ("context",))
+        try:
+            err = burnin.run_ring_attention_burnin(ring_mesh)
+            print(f"ring attention over context={len(devices)}: "
+                  f"max abs err {err:.2e} vs full attention (ok)")
+        except RuntimeError as e:
+            print(f"ring attention FAILED: {e}")
+            ok = False
     return 0 if ok else 1
 
 
@@ -64,6 +81,10 @@ def main(argv=None):
     burnin = sub.add_parser("burnin", help="sharded slice burn-in step")
     burnin.add_argument("--steps", type=positive_int, default=2)
     burnin.add_argument("--model-parallelism", type=int, default=None)
+    burnin.add_argument(
+        "--skip-ring", action="store_true",
+        help="skip the context-parallel ring-attention acceptance check "
+             "(runs by default on multi-device hosts)")
     burnin.set_defaults(fn=cmd_burnin)
 
     args = parser.parse_args(argv)

@@ -6,7 +6,13 @@ MXU (matmuls), HBM (activations), and ICI (gradient/activation collectives)
 all exercised. This module provides that program: a small MLP-block model
 with data-parallel batch and tensor-parallel hidden dimension over a
 ('data', 'model') mesh, the canonical TPU sharding recipe (shardings
-annotated, XLA inserts the psum/all-gather collectives).
+annotated, XLA inserts the psum/all-gather collectives) — plus
+context-parallel ring attention (ring_attention below): sequence-sharded
+q/k/v with kv blocks rotating around the mesh axis via ppermute under a
+flash-style streaming softmax, the long-context acceptance program. The
+MLP step proves the slice trains; the ring proves it can stream a long
+context, and its result is checked for EQUALITY against full attention,
+so a corrupting ICI link fails the burn-in rather than skewing a loss.
 
 Used by __graft_entry__.dryrun_multichip (the driver's multi-chip
 compile-check) and available to operators as a slice acceptance test.
@@ -90,6 +96,120 @@ def make_train_step(mesh, learning_rate=1e-3):
     return train_step
 
 
+def _local_mesh_device(mesh):
+    """A locally-addressable device of `mesh` to pin unsharded input
+    creation to. Without the pin, init computations would dispatch to the
+    process-default device, which on a host with an ambient hardware
+    plugin may be a flaky tunneled TPU even when `mesh` is a virtual CPU
+    mesh — burn-ins must only ever touch the devices they were handed.
+    On a multi-host mesh, pick a device this process owns; locality is
+    judged against the mesh devices' OWN client — jax.process_index()
+    would initialize the process-default backend, which may be a
+    different (broken) platform than the mesh's."""
+    local_process = mesh.devices.flat[0].client.process_index()
+    return next(
+        (d for d in mesh.devices.flat if d.process_index == local_process),
+        mesh.devices.flat[0])
+
+
+def ring_attention(q, k, v, mesh, axis):
+    """Context-parallel attention via a ppermute ring: each device holds
+    one sequence block of q/k/v; kv blocks rotate around `axis` while a
+    flash-style streaming softmax (running max + denominator) accumulates
+    exact attention — numerically identical to full softmax(QK^T/√d)V,
+    with activation memory O(seq/n_devices) per chip. This is the
+    canonical TPU long-context recipe (blockwise ring attention riding
+    ICI neighbor links), and as a burn-in it exercises the one traffic
+    pattern the MLP step does not: sustained same-axis neighbor exchange
+    overlapped with MXU work.
+
+    q, k, v: [heads, seq, d_head] sharded over seq on `axis`.
+    Bidirectional (no causal mask): keeps the full-attention reference
+    comparison exact over every block pair.
+    """
+    from jax import lax, shard_map
+
+    n_axis = mesh.shape[axis]
+    perm = [(i, (i + 1) % n_axis) for i in range(n_axis)]
+    spec = P(None, axis, None)
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    def ring(q_blk, k_blk, v_blk):
+        scale = 1.0 / (q_blk.shape[-1] ** 0.5)
+        q32 = q_blk.astype(jnp.float32) * scale
+
+        def body(_, carry):
+            k_cur, v_cur, m, l, o = carry
+            s = jnp.einsum("hqd,hkd->hqk", q32,
+                           k_cur.astype(jnp.float32))
+            m_new = jnp.maximum(m, s.max(axis=-1))
+            p = jnp.exp(s - m_new[..., None])
+            alpha = jnp.exp(m - m_new)
+            l_new = l * alpha + p.sum(axis=-1)
+            o_new = o * alpha[..., None] + jnp.einsum(
+                "hqk,hkd->hqd", p, v_cur.astype(jnp.float32))
+            k_next = lax.ppermute(k_cur, axis, perm)
+            v_next = lax.ppermute(v_cur, axis, perm)
+            return k_next, v_next, m_new, l_new, o_new
+
+        heads, sq, d = q_blk.shape
+        init = (k_blk, v_blk,
+                jnp.full((heads, sq), -jnp.inf, dtype=jnp.float32),
+                jnp.zeros((heads, sq), dtype=jnp.float32),
+                jnp.zeros((heads, sq, d), dtype=jnp.float32))
+        *_, m, l, o = lax.fori_loop(0, n_axis, body, init)
+        return (o / l[..., None]).astype(q_blk.dtype)
+
+    return jax.jit(ring)(q, k, v)
+
+
+def full_attention(q, k, v):
+    """Unsharded reference: softmax(QK^T/√d)V in f32 — the ground truth
+    ring_attention must reproduce."""
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("hqd,hkd->hqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("hqk,hkd->hqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def run_ring_attention_burnin(mesh, axis=None, heads=2, seq=None, d_head=64,
+                              dtype=jnp.float32):
+    """Compiles and runs context-parallel ring attention over `mesh` and
+    checks it against full attention — a slice is only long-context-ready
+    once this passes. Returns the max absolute error (float); raises if
+    the ring result diverges from the reference beyond the dtype's
+    tolerance."""
+    import numpy as np
+
+    axis = axis or mesh.axis_names[0]
+    n_axis = mesh.shape[axis]
+    if seq is None:
+        seq = 8 * n_axis
+    with jax.default_device(_local_mesh_device(mesh)):
+        ks = jax.random.split(jax.random.PRNGKey(7), 3)
+        q_host = jax.random.normal(ks[0], (heads, seq, d_head), dtype=dtype)
+        k_host = jax.random.normal(ks[1], (heads, seq, d_head), dtype=dtype)
+        v_host = jax.random.normal(ks[2], (heads, seq, d_head), dtype=dtype)
+        want = full_attention(q_host, k_host, v_host)
+    sharding = NamedSharding(mesh, P(None, axis, None))
+    q = jax.device_put(q_host, sharding)
+    k = jax.device_put(k_host, sharding)
+    v = jax.device_put(v_host, sharding)
+    got = ring_attention(q, k, v, mesh, axis)
+    err = float(jnp.max(jnp.abs(
+        np.asarray(got).astype(jnp.float32) -
+        np.asarray(want).astype(jnp.float32))))
+    tol = 1e-4 if dtype == jnp.float32 else 2e-2
+    if not err <= tol:
+        raise RuntimeError(
+            f"ring attention diverged from full attention: max abs err "
+            f"{err} > {tol} — the {axis}-axis exchange is corrupting data")
+    return err
+
+
 def run_burnin(mesh, batch=None, seq=None, d_model=256, d_ff=1024, steps=2):
     """Compiles and runs the sharded train step on `mesh`. Shapes default to
     small multiples of the mesh axes. Returns the final loss (float)."""
@@ -99,21 +219,7 @@ def run_burnin(mesh, batch=None, seq=None, d_model=256, d_ff=1024, steps=2):
         batch = 4 * data_n
     if seq is None:
         seq = 8 * model_n
-    # Create inputs under the mesh's own platform: without the pin, the
-    # unsharded init computations would dispatch to the process-default
-    # device, which on a host with an ambient hardware plugin may be a
-    # flaky tunneled TPU even when `mesh` is a virtual CPU mesh — the
-    # burn-in must only ever touch the devices it was handed. On a
-    # multi-host mesh, pin to a LOCALLY-ADDRESSABLE mesh device (device 0
-    # belongs to worker 0's process; dispatching to it from another worker
-    # would raise). Locality is judged against the mesh devices' OWN
-    # client — jax.process_index() would initialize the process-default
-    # backend, which may be a different (broken) platform than the mesh's.
-    local_process = mesh.devices.flat[0].client.process_index()
-    local_dev = next(
-        (d for d in mesh.devices.flat if d.process_index == local_process),
-        mesh.devices.flat[0])
-    with jax.default_device(local_dev):
+    with jax.default_device(_local_mesh_device(mesh)):
         key = jax.random.PRNGKey(0)
         params = init_params(key, d_model=d_model, d_ff=d_ff)
         x_host = jax.random.normal(

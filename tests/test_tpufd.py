@@ -372,11 +372,44 @@ def test_ring_attention_matches_full(cpu_jax):
     err = burnin.run_ring_attention_burnin(mesh, heads=2, seq=32, d_head=16)
     assert err <= 1e-4
 
+    # Causal: masked by GLOBAL position across rotating blocks — the
+    # production decoder pattern, and the harder accumulation (skipped
+    # future blocks, -inf guard on the streaming max).
+    err = burnin.run_ring_attention_burnin(
+        mesh, heads=2, seq=32, d_head=16, causal=True)
+    assert err <= 1e-4
+
     # Also directly over a 2-axis mesh's first axis (the shape dryrun and
     # multi-axis slices use).
     mesh2 = Mesh(np.array(jax.devices()).reshape(4, 2), ("context", "other"))
     err = burnin.run_ring_attention_burnin(mesh2, axis="context", seq=16)
     assert err <= 1e-4
+
+
+def test_causal_ring_attention_actually_masks(cpu_jax):
+    """The causal result must differ from the bidirectional one (the mask
+    is live), and both must match their own reference — so the two
+    acceptance modes can't silently collapse into one."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from tpufd import burnin
+
+    mesh = Mesh(np.array(jax.devices()), ("context",))
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    shape = (1, 16, 8)
+    q = jax.random.normal(ks[0], shape, dtype=jnp.float32)
+    k = jax.random.normal(ks[1], shape, dtype=jnp.float32)
+    v = jax.random.normal(ks[2], shape, dtype=jnp.float32)
+    sharding = NamedSharding(mesh, P(None, "context", None))
+    qs, ks_, vs = (jax.device_put(t, sharding) for t in (q, k, v))
+    bi = burnin.ring_attention(qs, ks_, vs, mesh, "context")
+    ca = burnin.ring_attention(qs, ks_, vs, mesh, "context", causal=True)
+    assert bool(jnp.any(jnp.abs(bi - ca) > 1e-3))
+    # First token attends only to itself under the mask: row 0 == v[0].
+    assert float(jnp.max(jnp.abs(ca[0, 0] - v[0, 0]))) <= 1e-5
 
 
 def test_ring_attention_detects_divergence(cpu_jax, monkeypatch):
@@ -392,8 +425,9 @@ def test_ring_attention_detects_divergence(cpu_jax, monkeypatch):
 
     mesh = Mesh(np.array(jax.devices()), ("context",))
     real_full = burnin.full_attention
-    monkeypatch.setattr(burnin, "full_attention",
-                        lambda q, k, v: real_full(q, k, v) + 1.0)
+    monkeypatch.setattr(
+        burnin, "full_attention",
+        lambda q, k, v, **kw: real_full(q, k, v, **kw) + 1.0)
     with _pytest.raises(RuntimeError, match="diverged"):
         burnin.run_ring_attention_burnin(mesh, seq=16)
 
@@ -406,7 +440,8 @@ def test_cli_burnin(cpu_jax, capsys):
     assert main(["burnin", "--steps", "1"]) == 0
     out = capsys.readouterr().out
     assert "mesh: data=" in out and "final loss" in out
-    assert "ring attention over context=8" in out
+    assert "bidirectional ring attention over context=8" in out
+    assert "causal ring attention over context=8" in out
 
     assert main(["burnin", "--steps", "1", "--skip-ring"]) == 0
     out = capsys.readouterr().out

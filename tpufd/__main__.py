@@ -50,13 +50,17 @@ def cmd_burnin(args):
         import numpy as np
 
         ring_mesh = Mesh(np.array(devices), ("context",))
-        try:
-            err = burnin.run_ring_attention_burnin(ring_mesh)
-            print(f"ring attention over context={len(devices)}: "
-                  f"max abs err {err:.2e} vs full attention (ok)")
-        except RuntimeError as e:
-            print(f"ring attention FAILED: {e}")
-            ok = False
+        for causal in (False, True):
+            mode = "causal" if causal else "bidirectional"
+            try:
+                err = burnin.run_ring_attention_burnin(
+                    ring_mesh, causal=causal)
+                print(f"{mode} ring attention over "
+                      f"context={len(devices)}: max abs err {err:.2e} "
+                      f"vs full attention (ok)")
+            except RuntimeError as e:
+                print(f"{mode} ring attention FAILED: {e}")
+                ok = False
     return 0 if ok else 1
 
 

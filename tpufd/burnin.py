@@ -112,7 +112,7 @@ def _local_mesh_device(mesh):
         mesh.devices.flat[0])
 
 
-def ring_attention(q, k, v, mesh, axis):
+def ring_attention(q, k, v, mesh, axis, causal=False):
     """Context-parallel attention via a ppermute ring: each device holds
     one sequence block of q/k/v; kv blocks rotate around `axis` while a
     flash-style streaming softmax (running max + denominator) accumulates
@@ -124,8 +124,11 @@ def ring_attention(q, k, v, mesh, axis):
     overlapped with MXU work.
 
     q, k, v: [heads, seq, d_head] sharded over seq on `axis`.
-    Bidirectional (no causal mask): keeps the full-attention reference
-    comparison exact over every block pair.
+    causal=True masks by GLOBAL position (device block index × block
+    length + offset), the production long-context decoder pattern; the
+    rotation starts on each device's own block, so every query row
+    attends at least to its own diagonal and the streaming max never
+    propagates a fully-masked -inf row.
     """
     from jax import lax, shard_map
 
@@ -138,11 +141,23 @@ def ring_attention(q, k, v, mesh, axis):
     def ring(q_blk, k_blk, v_blk):
         scale = 1.0 / (q_blk.shape[-1] ** 0.5)
         q32 = q_blk.astype(jnp.float32) * scale
+        heads, sq, d = q_blk.shape
+        sk = k_blk.shape[1]
+        me = lax.axis_index(axis)
+        q_pos = me * sq + jnp.arange(sq)
 
-        def body(_, carry):
+        def body(t, carry):
             k_cur, v_cur, m, l, o = carry
             s = jnp.einsum("hqd,hkd->hqk", q32,
                            k_cur.astype(jnp.float32))
+            if causal:
+                # At step t this device holds the block that started on
+                # device (me - t) mod n — its global positions decide
+                # the mask, not the local step index.
+                src = (me - t) % n_axis
+                kv_pos = src * sk + jnp.arange(sk)
+                s = jnp.where(kv_pos[None, None, :] <= q_pos[None, :, None],
+                              s, -jnp.inf)
             m_new = jnp.maximum(m, s.max(axis=-1))
             p = jnp.exp(s - m_new[..., None])
             alpha = jnp.exp(m - m_new)
@@ -153,7 +168,6 @@ def ring_attention(q, k, v, mesh, axis):
             v_next = lax.ppermute(v_cur, axis, perm)
             return k_next, v_next, m_new, l_new, o_new
 
-        heads, sq, d = q_blk.shape
         init = (k_blk, v_blk,
                 jnp.full((heads, sq), -jnp.inf, dtype=jnp.float32),
                 jnp.zeros((heads, sq), dtype=jnp.float32),
@@ -164,19 +178,24 @@ def ring_attention(q, k, v, mesh, axis):
     return jax.jit(ring)(q, k, v)
 
 
-def full_attention(q, k, v):
+def full_attention(q, k, v, causal=False):
     """Unsharded reference: softmax(QK^T/√d)V in f32 — the ground truth
     ring_attention must reproduce."""
     scale = 1.0 / (q.shape[-1] ** 0.5)
     s = jnp.einsum("hqd,hkd->hqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
+    if causal:
+        seq = q.shape[1]
+        pos = jnp.arange(seq)
+        s = jnp.where(pos[None, None, :] <= pos[None, :, None],
+                      s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("hqk,hkd->hqd", p,
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
 def run_ring_attention_burnin(mesh, axis=None, heads=2, seq=None, d_head=64,
-                              dtype=jnp.float32):
+                              dtype=jnp.float32, causal=False):
     """Compiles and runs context-parallel ring attention over `mesh` and
     checks it against full attention — a slice is only long-context-ready
     once this passes. Returns the max absolute error (float); raises if
@@ -193,20 +212,22 @@ def run_ring_attention_burnin(mesh, axis=None, heads=2, seq=None, d_head=64,
         q_host = jax.random.normal(ks[0], (heads, seq, d_head), dtype=dtype)
         k_host = jax.random.normal(ks[1], (heads, seq, d_head), dtype=dtype)
         v_host = jax.random.normal(ks[2], (heads, seq, d_head), dtype=dtype)
-        want = full_attention(q_host, k_host, v_host)
+        want = full_attention(q_host, k_host, v_host, causal=causal)
     sharding = NamedSharding(mesh, P(None, axis, None))
     q = jax.device_put(q_host, sharding)
     k = jax.device_put(k_host, sharding)
     v = jax.device_put(v_host, sharding)
-    got = ring_attention(q, k, v, mesh, axis)
+    got = ring_attention(q, k, v, mesh, axis, causal=causal)
     err = float(jnp.max(jnp.abs(
         np.asarray(got).astype(jnp.float32) -
         np.asarray(want).astype(jnp.float32))))
     tol = 1e-4 if dtype == jnp.float32 else 2e-2
     if not err <= tol:
+        mode = "causal" if causal else "bidirectional"
         raise RuntimeError(
-            f"ring attention diverged from full attention: max abs err "
-            f"{err} > {tol} — the {axis}-axis exchange is corrupting data")
+            f"{mode} ring attention diverged from full attention: max abs "
+            f"err {err} > {tol} — the {axis}-axis exchange is corrupting "
+            f"data")
     return err
 
 

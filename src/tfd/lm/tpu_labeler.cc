@@ -128,10 +128,23 @@ bool ValidLabelKeySuffix(const std::string& s) {
 // not be able to overwrite, say, the product label, nor crash-loop the
 // daemon with an apiserver-rejected key); on any failure the ok label is
 // forced to "false".
-Labels RunHealthExec(const config::Config& config) {
+Labels RunHealthExec(const config::Config& config, int chip_count) {
   Labels out;
-  Result<std::string> text = RunCommandCapture(
-      config.flags.health_exec, config.flags.health_exec_timeout_s);
+  // The daemon's enumerated chip count rides into the probe's
+  // environment so the PROBE's published label set can carry the
+  // enumeration cross-check (jax initializing fewer devices than the
+  // daemon's backend enumerated — see tpufd/health.py
+  // devices-consistent). Scoped to the child shell via an export
+  // prefix: RunCommandCapture runs `sh -c`, so this sets the variable
+  // for the whole probe command (pipelines included) without ever
+  // mutating the daemon's own environment.
+  std::string command = config.flags.health_exec;
+  if (chip_count >= 0) {
+    command = "export TFD_CHIP_COUNT=" + std::to_string(chip_count) +
+              "; " + command;
+  }
+  Result<std::string> text =
+      RunCommandCapture(command, config.flags.health_exec_timeout_s);
   if (!text.ok()) {
     TFD_LOG_WARNING << "health exec failed: " << text.error();
     out[kHealthOk] = "false";
@@ -181,7 +194,8 @@ Labels RunHealthExec(const config::Config& config) {
 // 60s sleep-interval would steal TPU cycles from co-located jobs and
 // stall label refresh; measured throughput does not change minute to
 // minute. The daemon is single-threaded, so plain statics suffice.
-void MergeHealthExecLabels(const config::Config& config, Labels* health) {
+void MergeHealthExecLabels(const config::Config& config, Labels* health,
+                           int chip_count) {
   static Labels cached;
   static std::string cached_exec;
   static std::chrono::steady_clock::time_point cached_at;
@@ -203,7 +217,7 @@ void MergeHealthExecLabels(const config::Config& config, Labels* health) {
   bool stale = !have_cache || cached_exec != config.flags.health_exec ||
                now - cached_at >= std::chrono::seconds(interval_s);
   if (stale) {
-    cached = RunHealthExec(config);
+    cached = RunHealthExec(config, chip_count);
     cached_exec = config.flags.health_exec;
     cached_at = now;
     have_cache = true;
@@ -283,7 +297,8 @@ Result<LabelerPtr> NewTpuLabeler(const resource::ManagerPtr& manager,
     // scheduler must avoid. Runs strictly AFTER manager->Shutdown():
     // TPU access is exclusive, so the probe could never acquire the
     // chips while the daemon's own PJRT client holds them.
-    MergeHealthExecLabels(config, &health);
+    MergeHealthExecLabels(config, &health,
+                          static_cast<int>(devices->size()));
   }
   if (health_on) {
     parts.push_back(std::make_unique<StaticLabeler>(std::move(health)));

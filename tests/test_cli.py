@@ -452,6 +452,35 @@ def test_device_health_full_real_probe_feature_file(tfd_binary, tmp_path):
     assert float(labels["google.com/tpu.health.hbm-gbps"]) > 0
     # 8 virtual CPU devices -> the ICI all-reduce probe must have run.
     assert float(labels["google.com/tpu.health.allreduce-gbps"]) > 0
+    # The mock enumerated 4 chips but jax sees 8 CPU devices: the
+    # enumeration cross-check (TFD_CHIP_COUNT exported by the daemon)
+    # must flag the mismatch WITHOUT downgrading ok.
+    assert labels["google.com/tpu.health.devices-consistent"] == "false"
+    assert labels["google.com/tpu.health.devices-jax"] == "8"
+
+
+def test_device_health_chip_count_consistent(tfd_binary, tmp_path):
+    """With an 8-chip fixture matching the 8-device CPU mesh, the
+    enumeration cross-check reports consistent and no devices-jax."""
+    out_file = tmp_path / "tfd"
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": str(Path(__file__).resolve().parent.parent),
+    }
+    proc = subprocess.run(
+        [str(tfd_binary), "--oneshot", f"--output-file={out_file}",
+         "--backend=mock",
+         f"--mock-topology-file={FIXTURES / 'v6e-8.yaml'}",
+         "--machine-type-file=/dev/null", "--device-health=full",
+         "--health-exec=python3 -m tpufd health"],
+        env={**os.environ, **env,
+             "GCE_METADATA_HOST": "127.0.0.1:1"},
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr
+    labels = labels_of(out_file.read_text())
+    assert labels["google.com/tpu.health.ok"] == "true"
+    assert labels["google.com/tpu.health.devices-consistent"] == "true"
+    assert "google.com/tpu.health.devices-jax" not in labels
 
 
 def test_v6e_8_single(tfd_binary):

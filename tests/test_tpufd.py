@@ -120,6 +120,30 @@ def test_health_probes_cpu(cpu_jax):
     assert "google.com/tpu.health.hbm-gbps-rated" not in labels
     # The DMA probe is opt-in: absent by default.
     assert "google.com/tpu.health.dma-copy-gbps" not in labels
+    # No TFD_CHIP_COUNT in the environment -> no cross-check labels.
+    assert "google.com/tpu.health.devices-consistent" not in labels
+
+
+def test_chip_count_cross_check(cpu_jax, monkeypatch):
+    """TFD_CHIP_COUNT (exported by the daemon around the health exec)
+    drives the enumeration cross-check: match -> consistent only;
+    mismatch -> false + the jax count; garbage -> no labels."""
+    from tpufd import health
+
+    monkeypatch.setenv("TFD_CHIP_COUNT", "8")
+    labels = health.health_labels()
+    assert labels["google.com/tpu.health.devices-consistent"] == "true"
+    assert "google.com/tpu.health.devices-jax" not in labels
+
+    monkeypatch.setenv("TFD_CHIP_COUNT", "4")
+    labels = health.health_labels()
+    assert labels["google.com/tpu.health.devices-consistent"] == "false"
+    assert labels["google.com/tpu.health.devices-jax"] == "8"
+    assert labels["google.com/tpu.health.ok"] == "true"  # not downgraded
+
+    monkeypatch.setenv("TFD_CHIP_COUNT", "bogus")
+    labels = health.health_labels()
+    assert "google.com/tpu.health.devices-consistent" not in labels
 
 
 def test_dma_copy_probe_cpu(cpu_jax):

@@ -21,6 +21,7 @@ Design notes (TPU-first):
 
 import functools
 import itertools
+import os
 import statistics
 import sys
 import time
@@ -509,4 +510,19 @@ def health_labels(prefix="google.com/tpu.health.", extended=False):
         labels[prefix + "ok"] = "true"
     except Exception:  # noqa: BLE001 — any device failure marks unhealthy
         labels[prefix + "ok"] = "false"
+    # Enumeration cross-check: the daemon exports ITS chip count
+    # (TFD_CHIP_COUNT) when exec'ing this probe; libtpu enumerating N
+    # chips while jax initializes M is a node-health signal neither
+    # process can produce alone (a half-dead chip often enumerates but
+    # fails client init). A mismatch labels loudly but does NOT flip
+    # ok=false: the chips jax DID see measured healthy, and the
+    # scheduler-facing signal belongs in its own label.
+    count_env = os.environ.get("TFD_CHIP_COUNT", "")
+    if count_env.isdigit():
+        daemon_count = int(count_env)
+        consistent = len(devices) == daemon_count
+        labels[prefix + "devices-consistent"] = (
+            "true" if consistent else "false")
+        if not consistent:
+            labels[prefix + "devices-jax"] = str(len(devices))
     return labels

@@ -28,7 +28,10 @@ echo "$NEW" > "$ROOT/VERSION"
 for f in "$ROOT"/deployments/static/*.yaml \
          "$ROOT"/deployments/static/*.yaml.template; do
   [ -f "$f" ] || continue
-  sed -i "s|tpu-feature-discovery:v[0-9][0-9a-zA-Z.+-]*|tpu-feature-discovery:${NEW}|g; \
+  # The image-variant suffix (-full: probe runtime) is part of WHICH
+  # image, not which version — preserve it across bumps. Versions are
+  # strictly vX.Y.Z (gate above), so the version class needs no '-'.
+  sed -i "s|tpu-feature-discovery:v[0-9][0-9a-zA-Z.+]*\(-full\)\{0,1\}|tpu-feature-discovery:${NEW}\1|g; \
           s|app.kubernetes.io/version: [0-9][0-9a-zA-Z.+-]*|app.kubernetes.io/version: ${BARE}|g" "$f"
 done
 

@@ -198,6 +198,7 @@ void MergeHealthExecLabels(const config::Config& config, Labels* health,
                            int chip_count) {
   static Labels cached;
   static std::string cached_exec;
+  static int cached_chip_count = -1;
   static std::chrono::steady_clock::time_point cached_at;
   static bool have_cache = false;
 
@@ -214,11 +215,17 @@ void MergeHealthExecLabels(const config::Config& config, Labels* health,
   }
 
   auto now = std::chrono::steady_clock::now();
+  // chip_count is part of the staleness key: a chip dropping from (or
+  // returning to) enumeration must re-run the probe immediately, or the
+  // node would republish a stale devices-consistent verdict next to a
+  // contradictory tpu.health.devices for up to a full interval.
   bool stale = !have_cache || cached_exec != config.flags.health_exec ||
+               cached_chip_count != chip_count ||
                now - cached_at >= std::chrono::seconds(interval_s);
   if (stale) {
     cached = RunHealthExec(config, chip_count);
     cached_exec = config.flags.health_exec;
+    cached_chip_count = chip_count;
     cached_at = now;
     have_cache = true;
   }

@@ -425,6 +425,49 @@ def test_device_health_full_probe_cached_across_passes(tfd_binary, tmp_path):
         "probe must be cached across passes within health-exec-interval")
 
 
+def test_device_health_probe_rerun_on_chip_count_change(tfd_binary,
+                                                        tmp_path):
+    """A chip dropping from (or returning to) enumeration must re-run the
+    cached probe immediately — a stale devices-consistent verdict next to
+    a contradictory tpu.health.devices is worse than the probe cost."""
+    import shutil
+
+    topo = tmp_path / "topo.yaml"
+    shutil.copy(FIXTURES / "v2-8.yaml", topo)  # 4 chips
+    counter = tmp_path / "count"
+    out_file = tmp_path / "tfd"
+    proc = subprocess.Popen(
+        [str(tfd_binary), "--sleep-interval=1s",
+         f"--output-file={out_file}", "--backend=mock",
+         f"--mock-topology-file={topo}",
+         "--machine-type-file=/dev/null", "--device-health=full",
+         f"--health-exec=echo $TFD_CHIP_COUNT >> {counter}; "
+         "printf 'google.com/tpu.health.ok=true\\n'"],
+        env={**os.environ, "GCE_METADATA_HOST": "127.0.0.1:1"},
+        stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline and not counter.exists():
+            time.sleep(0.1)
+        assert counter.exists(), "first probe never ran"
+        # Same count -> cached (no growth across a couple of passes).
+        time.sleep(2.5)
+        first = counter.read_text().splitlines()
+        assert first == ["4"], first
+        # Enumeration changes (8-chip fixture): next pass must re-probe
+        # with the new count.
+        shutil.copy(FIXTURES / "v6e-8.yaml", topo)
+        deadline = time.time() + 10
+        while time.time() < deadline and \
+                counter.read_text().splitlines() == ["4"]:
+            time.sleep(0.1)
+        assert counter.read_text().splitlines() == ["4", "8"], \
+            counter.read_text()
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=10)
+
+
 def test_device_health_full_real_probe_feature_file(tfd_binary, tmp_path):
     """Integration: the daemon runs the REAL `python -m tpufd health` (on
     the virtual CPU mesh) and the measured labels land in the NFD feature

@@ -76,6 +76,23 @@ class TestStaticYamls:
         assert "--oneshot" in spec["containers"][0]["args"]
         assert spec["restartPolicy"] == "Never"
 
+    def test_burnin_job_template(self):
+        """The slice burn-in Job: -full image (it needs python3+jax+
+        tpufd), exclusive TPU chip request (a burn-in that doesn't own
+        the chips measures nothing), substitutable node/chip-count."""
+        text = (STATIC / "tpu-slice-burnin-job.yaml.template").read_text()
+        job = yaml.safe_load(text.replace("NODE_NAME", "placeholder-node")
+                             .replace("TPU_LIMIT", "4"))
+        assert job["kind"] == "Job"
+        spec = job["spec"]["template"]["spec"]
+        assert spec["nodeName"] == "placeholder-node"
+        container = spec["containers"][0]
+        assert container["image"].endswith("-full")
+        assert container["command"][-2:] == ["tpufd", "burnin"]
+        assert container["resources"]["limits"]["google.com/tpu"] == 4
+        assert spec["restartPolicy"] == "Never"
+        assert job["spec"]["backoffLimit"] == 0  # a bad node must FAIL
+
     def test_strategy_env_matches_filename(self):
         for path, want in [
             (STATIC_YAMLS[0], "none"),
@@ -182,6 +199,11 @@ class TestReleaseMachinery:
               "tpu-feature-discovery-daemonset.yaml").read_text()
         assert "app.kubernetes.io/version: 9.9.9" in ds
         assert "app.kubernetes.io/version: 0." not in ds
+        # The burn-in job's -full image-variant suffix survives the bump
+        # (the version rewrite once ate it).
+        burnin = (tmp_path / "deployments/static/"
+                  "tpu-slice-burnin-job.yaml.template").read_text()
+        assert "tpu-feature-discovery:v9.9.9-full" in burnin
         proc = subprocess.run(
             ["sh", str(tmp_path / "tests" / "check-yamls.sh"), "v9.9.9"],
             capture_output=True, text=True)

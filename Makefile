@@ -80,9 +80,15 @@ check-release:
 	sh tests/check-yamls.sh $(VERSION)
 
 # Container image at the release tag (multi-arch in CI via buildx).
+# The -full variant (probe runtime: python3 + jax + tpufd) is what
+# --device-health=full, the burn-in Job, and `helm test` reference as
+# <image>:<version>-full — it ships alongside the slim image on every
+# release.
 image:
 	docker build -f deployments/container/Dockerfile \
 	  --build-arg VERSION=$(VERSION) -t $(IMAGE):$(VERSION) .
+	docker build -f deployments/container/Dockerfile --target full \
+	  --build-arg VERSION=$(VERSION) -t $(IMAGE):$(VERSION)-full .
 
 # Helm chart package + repo index (the reference's gh-pages
 # docs/index.yaml flow). Writes dist/*.tgz and refreshes docs/index.yaml

@@ -118,6 +118,28 @@ class TestHelmChart:
         assert values["nfd"]["master"]["config"]["extraLabelNs"] == [
             "google.com"]
 
+    def test_burnin_test_hook(self):
+        """`helm test` must run the slice burn-in: hook annotation, -full
+        image variant, an exclusive TPU chip request wired to values, and
+        the values file must document/enable it."""
+        text = (HELM / "templates" / "tests" / "burnin-test.yaml"
+                ).read_text()
+        assert "helm.sh/hook: test" in text
+        assert 'fullimage" . }}-full' in text
+        assert "google.com/tpu: {{ .Values.tests.tpuLimit }}" in text
+        assert "restartPolicy: Never" in text
+        assert "helm.sh/hook-delete-policy: before-hook-creation" in text
+        assert ".Values.imagePullSecrets" in text
+        assert ".Values.podSecurityContext" in text
+        values = yaml.safe_load((HELM / "values.yaml").read_text())
+        assert values["tests"]["enabled"] is True
+        assert values["tests"]["tpuLimit"] >= 1
+        # Every surface that references <image>:<version>-full depends on
+        # the release flow actually producing that tag.
+        assert "--target full" in (REPO / "Makefile").read_text()
+        ci = (REPO / ".github" / "workflows" / "ci.yml").read_text()
+        assert "-full" in ci and "--target full" in ci
+
     def test_template_env_vars_exist_in_binary(self, tfd_binary):
         """Every TFD_* env the daemonset template wires must be a real env
         alias of a CLI flag (catches template/flag drift)."""

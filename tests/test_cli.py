@@ -425,6 +425,19 @@ def test_device_health_full_probe_cached_across_passes(tfd_binary, tmp_path):
         "probe must be cached across passes within health-exec-interval")
 
 
+def test_device_health_exec_runaway_output_killed(tfd_binary):
+    """A probe that floods stdout (>1 MiB) is killed and reported as a
+    failed probe (ok=false) — it must not balloon daemon memory or hang
+    the pass (subprocess.cc runaway guard)."""
+    code, out, err = run_tfd(tfd_binary, health_exec_args(
+        "yes google.com/tpu.health.flood=1"))
+    assert code == 0, err  # daemon survives
+    assert "more than 1 MiB" in err
+    labels = labels_of(out)
+    assert labels["google.com/tpu.health.ok"] == "false"
+    assert "google.com/tpu.health.flood" not in labels
+
+
 def test_device_health_probe_rerun_on_chip_count_change(tfd_binary,
                                                         tmp_path):
     """A chip dropping from (or returning to) enumeration must re-run the

@@ -154,6 +154,25 @@ def test_tls_with_ca_verification(tfd_binary, tmp_path, tls_cert):
             "google.com/tpu.count"] == "4"
 
 
+def test_tls_garbage_ca_file_is_a_clean_error(tfd_binary, tmp_path,
+                                              tls_cert):
+    """A corrupt serviceaccount ca.crt must fail with the CA-load error
+    (naming the file), not crash and not silently skip verification."""
+    cert, key = tls_cert
+    with FakeApiServer(token="sekrit", certfile=str(cert),
+                       keyfile=str(key)) as server:
+        d = sa_dir(tmp_path, "sekrit")
+        (d / "ca.crt").write_text("this is not a PEM certificate\n")
+        code, _, err = run_tfd(tfd_binary, nf_args(), env={
+            "NODE_NAME": "tpu-node-tls",
+            "TFD_APISERVER_URL": server.url,
+            "TFD_SERVICEACCOUNT_DIR": str(d),
+        })
+        assert code == 1
+        assert "loading CA certificates" in err
+        assert "ca.crt" in err
+
+
 def test_tls_rejects_untrusted_cert(tfd_binary, tmp_path, tls_cert):
     """Without the CA in the trust store the handshake must fail (no
     silent insecure fallback)."""

@@ -11,9 +11,17 @@ binary long enough for those to show (reference analogue: GFD's e2e tier
 watches the daemon relabel on cadence, tests/e2e-tests.py — but nothing
 in the reference watches its memory; this goes further).
 
+Both output sinks soak: `--sink=file` (default) watches the NFD feature
+file; `--sink=cr` launches the hermetic fake apiserver
+(tpufd.fakes.apiserver) and counts passes from the CR request stream
+(steady-state passes are deliberate no-op GETs — identical labels skip
+the PUT, so resourceVersion never advances), giving the HTTP client
+path the same steady-state scrutiny as the file path.
+
 Usage:
   python3 scripts/soak.py --binary build/tpu-feature-discovery \
-      --duration 30 [--interval 1] [--extra-arg=--backend=mock ...]
+      --duration 30 [--interval 1] [--sink=file|cr] \
+      [--extra-arg=--backend=mock ...]
 
 Prints ONE JSON line, e.g.:
   {"ok": true, "passes": 29, "rss_start_kb": 3180, "rss_end_kb": 3180,
@@ -22,8 +30,9 @@ Prints ONE JSON line, e.g.:
 
 Exit code 0 iff ok. "ok" means: >=3 passes observed, RSS drift under
 --max-rss-drift-kb (default 1024), fd count unchanged, labels (minus the
-timestamp) identical across every pass, SIGTERM led to exit 0 and the
-output file was removed.
+timestamp) identical across every pass, SIGTERM led to exit 0, and the
+sink was left in its contracted end state (file removed; the CR persists
+by design — NFD owns its lifecycle).
 """
 
 import argparse
@@ -58,6 +67,90 @@ def stable_digest(label_text):
     return hashlib.sha256("\n".join(lines).encode()).hexdigest()
 
 
+class FileSink:
+    """Watches the NFD feature file the daemon rewrites each pass."""
+
+    def __init__(self, tmpdir):
+        self.path = os.path.join(tmpdir, "tfd")
+
+    def daemon_args(self):
+        return [f"--output-file={self.path}"]
+
+    def daemon_env(self):
+        return {}
+
+    def observe(self):
+        """(generation, digest) of the current label set; None before the
+        first pass. Generation is the file mtime — it advances on every
+        rewrite even when the bytes are identical."""
+        try:
+            st = os.stat(self.path)
+        except FileNotFoundError:
+            return None
+        with open(self.path) as f:
+            return st.st_mtime, stable_digest(f.read())
+
+    def end_state_ok(self):
+        return not os.path.exists(self.path)  # SIGTERM removes the file
+
+    def close(self):
+        pass
+
+
+class CrSink:
+    """Watches a NodeFeature CR on the hermetic fake apiserver — the
+    same steady-state checks, through the real HTTP client path."""
+
+    NODE = "soak-node"
+
+    def __init__(self, tmpdir):
+        sys.path.insert(0, os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        from tpufd.fakes.apiserver import FakeApiServer
+
+        self.server = FakeApiServer(token="soak-token").__enter__()
+        sa = os.path.join(tmpdir, "sa")
+        os.mkdir(sa)
+        with open(os.path.join(sa, "namespace"), "w") as f:
+            f.write("node-feature-discovery\n")
+        with open(os.path.join(sa, "token"), "w") as f:
+            f.write("soak-token\n")
+        self._env = {
+            "NODE_NAME": self.NODE,
+            "TFD_APISERVER_URL": self.server.url,
+            "TFD_SERVICEACCOUNT_DIR": sa,
+        }
+        self.key = ("node-feature-discovery", f"tfd-features-for-{self.NODE}")
+
+    def daemon_args(self):
+        return ["--use-node-feature-api", "--output-file="]
+
+    def daemon_env(self):
+        return self._env
+
+    def observe(self):
+        obj = self.server.store.get(self.key)
+        if obj is None:
+            return None
+        labels = obj.get("spec", {}).get("labels", {})
+        text = "\n".join(f"{k}={v}" for k, v in sorted(labels.items()))
+        # Generation = count of CR requests, not resourceVersion: in
+        # daemon mode the timestamp label is constant, so every
+        # steady-state pass is a no-op (GET, compare, skip the PUT) and
+        # rv never advances — but each pass still talks to the server.
+        gen = sum(1 for _, path in list(self.server.requests)
+                  if self.NODE in path)
+        return gen, stable_digest(text)
+
+    def end_state_ok(self):
+        # The CR persists across daemon restarts by design (NFD owns its
+        # lifecycle; the reference leaves its CR too).
+        return self.server.store.get(self.key) is not None
+
+    def close(self):
+        self.server.__exit__(None, None, None)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--binary", default="build/tpu-feature-discovery")
@@ -65,6 +158,11 @@ def main(argv=None):
                     help="seconds to soak")
     ap.add_argument("--interval", type=int, default=1,
                     help="daemon --sleep-interval in seconds")
+    ap.add_argument("--sink", choices=["file", "cr"], default="file",
+                    help="file: watch the NFD feature file; cr: fake "
+                         "apiserver + NodeFeature CR (passes counted "
+                         "from the request stream — steady-state passes "
+                         "are no-op GETs that never bump resourceVersion)")
     ap.add_argument("--max-rss-drift-kb", type=int, default=1024,
                     help="fail if RSS grows more than this over the soak")
     ap.add_argument("--settle-passes", type=int, default=3,
@@ -80,14 +178,14 @@ def main(argv=None):
                          "observed rewrite, not at spawn")
     args = ap.parse_args(argv)
 
-    out = {"ok": False}
+    out = {"ok": False, "sink": args.sink}
     with tempfile.TemporaryDirectory() as d:
-        label_file = os.path.join(d, "tfd")
+        sink = (CrSink if args.sink == "cr" else FileSink)(d)
         stderr_path = os.path.join(d, "stderr")
         cmd = [args.binary, f"--sleep-interval={args.interval}s",
-               f"--output-file={label_file}",
+               *sink.daemon_args(),
                "--machine-type-file=/dev/null", *args.extra_arg]
-        env = {**os.environ}
+        env = {**os.environ, **sink.daemon_env()}
         env.setdefault("GCE_METADATA_HOST", "127.0.0.1:1")
 
         def stderr_tail():
@@ -108,11 +206,13 @@ def main(argv=None):
                                         stdout=subprocess.DEVNULL,
                                         stderr=stderr_file)
             except OSError as e:  # missing/unexecutable binary
+                sink.close()
                 out["error"] = f"cannot launch {cmd[0]}: {e}"
                 print(json.dumps(out))
                 return 1
         try:
-            digests, mtimes = set(), []
+            digests = set()
+            gens, seen_at = [], []
             baseline_rss = baseline_fd = None
             # The soak duration is steady-state time: the clock starts at
             # the FIRST observed rewrite. Spawn-to-first-pass gets its own
@@ -122,18 +222,18 @@ def main(argv=None):
             while time.monotonic() < deadline:
                 if proc.poll() is not None:
                     break
-                try:
-                    st = os.stat(label_file)
-                except FileNotFoundError:  # first pass not done yet
+                observed = sink.observe()
+                if observed is None:  # first pass not done yet
                     time.sleep(0.05)
                     continue
-                if not mtimes or st.st_mtime != mtimes[-1]:
-                    if not mtimes:
+                gen, digest = observed
+                if not gens or gen != gens[-1]:
+                    if not gens:
                         deadline = time.monotonic() + args.duration
-                    mtimes.append(st.st_mtime)
-                    digests.add(stable_digest(
-                        open(label_file).read()))
-                    if len(mtimes) == args.settle_passes:
+                    gens.append(gen)
+                    seen_at.append(time.monotonic())
+                    digests.add(digest)
+                    if len(gens) == args.settle_passes:
                         try:
                             baseline_rss = rss_kb(proc.pid)
                             baseline_fd = fd_count(proc.pid)
@@ -146,7 +246,7 @@ def main(argv=None):
                                 f"{stderr_tail()}")
                 print(json.dumps(out))
                 return 1
-            if not mtimes:
+            if not gens:
                 out["error"] = (f"no first pass within --init-grace="
                                 f"{args.init_grace}s: {stderr_tail()}")
                 print(json.dumps(out))
@@ -164,10 +264,10 @@ def main(argv=None):
                 clean = proc.wait(timeout=30) == 0
             except subprocess.TimeoutExpired:
                 clean = False  # won't shut down IS the finding
-            gaps = sorted(b - a for a, b in zip(mtimes, mtimes[1:]))
+            gaps = sorted(b - a for a, b in zip(seen_at, seen_at[1:]))
 
             out.update({
-                "passes": len(mtimes),
+                "passes": len(gens),
                 "rss_start_kb": baseline_rss, "rss_end_kb": end_rss,
                 "rss_drift_kb": (None if baseline_rss is None
                                  else end_rss - baseline_rss),
@@ -176,18 +276,20 @@ def main(argv=None):
                 "rewrite_interval_p50_s": (
                     round(gaps[len(gaps) // 2], 2) if gaps else None),
                 "clean_exit": clean,
-                "file_removed": not os.path.exists(label_file),
+                "end_state_ok": sink.end_state_ok(),
             })
             out["ok"] = bool(
-                len(mtimes) >= max(3, args.settle_passes)
+                len(gens) >= max(3, args.settle_passes)
                 and baseline_rss is not None
                 and out["rss_drift_kb"] <= args.max_rss_drift_kb
                 and end_fd == baseline_fd
-                and out["labels_stable"] and clean and out["file_removed"])
+                and out["labels_stable"] and clean
+                and out["end_state_ok"])
         finally:
             if proc.poll() is None:
                 proc.kill()
                 proc.wait()
+            sink.close()
     print(json.dumps(out))
     return 0 if out["ok"] else 1
 

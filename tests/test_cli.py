@@ -610,7 +610,24 @@ class TestSoakHarness:
         assert report["rss_drift_kb"] <= 1024
         assert report["fd_start"] == report["fd_end"]
         assert report["labels_stable"] is True
-        assert report["clean_exit"] is True and report["file_removed"]
+        assert report["clean_exit"] is True and report["end_state_ok"]
+
+    def test_cr_sink_soak_is_steady(self, tfd_binary):
+        """--sink=cr: the same steady-state checks through the real
+        NodeFeature HTTP client path against the fake apiserver — each
+        pass is a server-observed request (steady state is a no-op GET;
+        identical labels skip the PUT), labels stay stable, and the CR
+        persists after SIGTERM (NFD owns its lifecycle)."""
+        rc, report = self.run_soak(
+            ["--binary", str(tfd_binary), "--duration", "7", "--sink", "cr",
+             "--extra-arg=--backend=mock",
+             f"--extra-arg=--mock-topology-file={FIXTURES / 'v5e-4.yaml'}",
+             "--extra-arg=--slice-strategy=single"])
+        assert rc == 0 and report["ok"] is True, report
+        assert report["sink"] == "cr"
+        assert report["passes"] >= 4
+        assert report["labels_stable"] is True
+        assert report["clean_exit"] is True and report["end_state_ok"]
 
     def test_detects_label_churn_and_dirty_exit(self, tmp_path):
         """A 'daemon' whose labels churn every pass and which neither
@@ -632,7 +649,7 @@ class TestSoakHarness:
         assert rc == 1 and report["ok"] is False
         assert report["labels_stable"] is False
         assert report["clean_exit"] is False
-        assert report["file_removed"] is False
+        assert report["end_state_ok"] is False  # file left behind
 
     def test_dead_daemon_is_an_error(self, tmp_path):
         fake = tmp_path / "dies"

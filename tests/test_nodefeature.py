@@ -154,6 +154,37 @@ def test_tls_with_ca_verification(tfd_binary, tmp_path, tls_cert):
             "google.com/tpu.count"] == "4"
 
 
+def test_fake_apiserver_error_replies_do_not_deadlock():
+    """The fake server's request log is taken under the same lock as the
+    store; error replies issued while the store lock is held (POST 409,
+    PUT 404/409) must still answer — a non-reentrant lock here once hung
+    every conflict-retry test forever instead of returning 409."""
+    import json
+    import urllib.request
+    import urllib.error
+
+    from tpufd.fakes.apiserver import FakeApiServer
+
+    body = json.dumps({"metadata": {"name": "dup"},
+                       "spec": {"labels": {}}}).encode()
+    with FakeApiServer() as server:
+        base = (f"{server.url}/apis/nfd.k8s-sigs.io/v1alpha1/"
+                f"namespaces/ns/nodefeatures")
+        req = urllib.request.Request(base, data=body, method="POST")
+        assert urllib.request.urlopen(req, timeout=5).status == 201
+        try:
+            urllib.request.urlopen(
+                urllib.request.Request(base, data=body, method="POST"),
+                timeout=5)
+            assert False, "duplicate create must 409"
+        except urllib.error.HTTPError as e:
+            assert e.code == 409
+        # And the server still answers afterwards (lock released).
+        assert ("POST", base[len(server.url):]) in server.requests
+        ok = urllib.request.urlopen(base + "/dup", timeout=5)
+        assert ok.status == 200
+
+
 def test_tls_garbage_ca_file_is_a_clean_error(tfd_binary, tmp_path,
                                               tls_cert):
     """A corrupt serviceaccount ca.crt must fail with the CA-load error

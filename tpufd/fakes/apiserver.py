@@ -20,6 +20,7 @@ class _Handler(BaseHTTPRequestHandler):
     store = None  # type: dict
     token = None
     lock = None
+    requests = None  # type: list  # (method, path) per handled request
 
     def _check_auth(self):
         if self.token is None:
@@ -27,6 +28,11 @@ class _Handler(BaseHTTPRequestHandler):
         return self.headers.get("Authorization") == f"Bearer {self.token}"
 
     def _reply(self, code, obj=None):
+        # Request log BEFORE the response: a no-op daemon pass (GET,
+        # compare, skip the PUT) is otherwise invisible server-side, and
+        # the soak harness counts passes by watching this stream.
+        with self.lock:
+            self.requests.append((self.command, self.path))
         body = json.dumps(obj).encode() if obj is not None else b"{}"
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
@@ -98,9 +104,14 @@ class _Handler(BaseHTTPRequestHandler):
 
 class FakeApiServer:
     def __init__(self, token=None, certfile=None, keyfile=None, port=0):
+        # RLock: _reply logs the request under the lock, and the POST/PUT
+        # error branches call _reply while already holding it for the
+        # store — a plain Lock would deadlock every 409/404 reply.
         handler = type("Handler", (_Handler,), {
-            "store": {}, "token": token, "lock": threading.Lock()})
+            "store": {}, "token": token, "lock": threading.RLock(),
+            "requests": []})
         self.store = handler.store
+        self.requests = handler.requests
         self._server = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.tls = certfile is not None
         if self.tls:

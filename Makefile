@@ -49,7 +49,7 @@ bench: build
 # binary (TFD_BUILD_DIR), so process-level/golden/e2e paths count, not
 # just the unit suite. Python-side coverage runs too when coverage.py
 # is importable (CI installs it; the floor for it is enforced there).
-COVERAGE_MIN ?= 80
+COVERAGE_MIN ?= 85
 PY_COVERAGE_MIN ?= 55
 coverage:
 	cmake -S . -B build-cov -G Ninja -DCMAKE_BUILD_TYPE=Debug \

@@ -95,7 +95,11 @@ image:
 # so pushing docs/ publishes the repo. Uses helm when present (CI's
 # release job pins one); otherwise the spec-conformant python fallback
 # (scripts/helm_package.py) produces the same two artifacts, so the flow
-# runs end-to-end in helm-less environments too.
+# runs end-to-end in helm-less environments too. The fallback REQUIRES
+# vendored dependencies by default — a dep-less archive is uninstallable
+# (helm refuses it at install time), and a warning alone once let one
+# ship. HELM_ALLOW_DEPLESS=1 opts out for egress-less dev machines; the
+# disclosure obligation in docs/README.md travels with that choice.
 helm-package:
 	mkdir -p dist docs
 	if command -v helm >/dev/null 2>&1; then \
@@ -108,6 +112,7 @@ helm-package:
 	  python3 scripts/helm_package.py \
 	    --chart deployments/helm/tpu-feature-discovery \
 	    --version $(BARE_VERSION) --dist dist --url $(HELM_REPO_URL) \
+	    $(if $(HELM_ALLOW_DEPLESS),,--require-deps) \
 	    $(shell [ -f docs/index.yaml ] && echo --merge docs/index.yaml); \
 	fi
 	# docs/ is the SERVED repo root (gh-pages): the index AND the chart

@@ -201,8 +201,6 @@ def run_ring_attention_burnin(mesh, axis=None, heads=2, seq=None, d_head=64,
     once this passes. Returns the max absolute error (float); raises if
     the ring result diverges from the reference beyond the dtype's
     tolerance."""
-    import numpy as np
-
     axis = axis or mesh.axis_names[0]
     n_axis = mesh.shape[axis]
     if seq is None:
@@ -218,9 +216,12 @@ def run_ring_attention_burnin(mesh, axis=None, heads=2, seq=None, d_head=64,
     k = jax.device_put(k_host, sharding)
     v = jax.device_put(v_host, sharding)
     got = ring_attention(q, k, v, mesh, axis, causal=causal)
-    err = float(jnp.max(jnp.abs(
-        np.asarray(got).astype(jnp.float32) -
-        np.asarray(want).astype(jnp.float32))))
+    # Reduce ON DEVICE and fetch only the replicated scalar: np.asarray
+    # on the sharded result would raise on a multi-host mesh (it spans
+    # non-addressable devices) and spuriously fail a healthy slice.
+    want_sharded = jax.device_put(want, sharding)
+    err = float(jax.jit(lambda a, b: jnp.max(jnp.abs(
+        a.astype(jnp.float32) - b.astype(jnp.float32))))(got, want_sharded))
     tol = 1e-4 if dtype == jnp.float32 else 2e-2
     if not err <= tol:
         mode = "causal" if causal else "bidirectional"

@@ -365,20 +365,15 @@ def physical_mesh(devices):
     return Mesh(grid, names)
 
 
-def ici_axis_gbps(mesh, axis, mib=64, iters=8):
-    """Measured per-device send throughput (GB/s) around ONE mesh axis:
-    a lax.ppermute ring shifting each device's shard to its +1 neighbor,
-    so the traffic rides exactly that axis's ICI links. Run per axis
-    (the sweep), this localizes a weak link to an axis — the all-axis
-    allreduce probe can only say "somewhere". ppermute is also the
-    right primitive for the job: unlike psum it cannot be served by a
-    tree that skips links, and it is the building block the ring
-    collectives themselves ride."""
+@functools.lru_cache(maxsize=None)
+def _ici_shift_fn(mesh, axis):
+    """Jitted ppermute ring over one mesh axis, cached per (mesh, axis)
+    — jax.Mesh is hashable, and median_probe calls the probe 3x per
+    axis, so a fresh closure each call would recompile every time
+    (seconds per compile on TPU, worse through a relay)."""
     from jax import lax, shard_map
 
     n_axis = mesh.shape[axis]
-    cols = 1024
-    rows = max(mib * 1024 * 1024 // 2 // cols // n_axis, 1) * n_axis
     perm = [(i, (i + 1) % n_axis) for i in range(n_axis)]
 
     @jax.jit
@@ -388,6 +383,22 @@ def ici_axis_gbps(mesh, axis, mib=64, iters=8):
         def body(_, acc):
             return lax.ppermute(acc, axis_name=axis, perm=perm)
         return lax.fori_loop(0, k, body, v)
+    return shift
+
+
+def ici_axis_gbps(mesh, axis, mib=64, iters=8):
+    """Measured per-device send throughput (GB/s) around ONE mesh axis:
+    a lax.ppermute ring shifting each device's shard to its +1 neighbor,
+    so the traffic rides exactly that axis's ICI links. Run per axis
+    (the sweep), this localizes a weak link to an axis — the all-axis
+    allreduce probe can only say "somewhere". ppermute is also the
+    right primitive for the job: unlike psum it cannot be served by a
+    tree that skips links, and it is the building block the ring
+    collectives themselves ride."""
+    n_axis = mesh.shape[axis]
+    cols = 1024
+    rows = max(mib * 1024 * 1024 // 2 // cols // n_axis, 1) * n_axis
+    shift = _ici_shift_fn(mesh, axis)
 
     # ones, not zeros: the salt folds in multiplicatively, and 0 * salt
     # would leave every timed input bit-identical — a memoizing relay

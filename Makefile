@@ -35,7 +35,7 @@ check:
 	@for f in scripts/*.sh tests/*.sh tests/gke-ci/*.sh; do \
 	  sh -n "$$f" || exit 1; \
 	done; echo "shell scripts parse"
-	@python3 -m compileall -q bench.py scripts/helm_package.py \
+	@python3 -m compileall -q bench.py scripts \
 	  tpufd tests && echo "python compiles"
 	@sh tests/check-yamls.sh && echo "version pins consistent"
 

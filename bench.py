@@ -138,7 +138,8 @@ def config4_server():
     """The canonical BASELINE config-4 fixture (v5p-128 worker 3) behind
     the fake GCE metadata server — shared by every bench that measures
     the metadata-serving paths so they all price the same config."""
-    sys.path.insert(0, str(REPO))
+    if str(REPO) not in sys.path:  # repeated callers must not
+        sys.path.insert(0, str(REPO))  # stack duplicate entries
     from tpufd.fakes.metadata_server import (FakeMetadataServer,
                                              v5p_128_worker3)
 
@@ -275,7 +276,8 @@ def relay_daemon_flags():
     pjrt_real_p50 and soak_record must not diverge on it. A cold relay
     claim can take tens of seconds before the steady ~100ms state, hence
     the generous init watchdog deadline."""
-    sys.path.insert(0, str(REPO))
+    if str(REPO) not in sys.path:  # repeated callers must not
+        sys.path.insert(0, str(REPO))  # stack duplicate entries
     from tpufd.relay import relay_pjrt_plugin
 
     relay = relay_pjrt_plugin()
@@ -326,7 +328,8 @@ def tpu_probe_numbers():
     if os.environ.get("TFD_BENCH_SKIP_TPU_PROBE"):
         return {}
     try:
-        sys.path.insert(0, str(REPO))
+        if str(REPO) not in sys.path:  # repeated callers must not
+            sys.path.insert(0, str(REPO))  # stack duplicate entries
         import jax
 
         if jax.devices()[0].platform != "tpu":

@@ -10,6 +10,7 @@
 
 #include <chrono>
 #include <cstdio>
+#include <memory>
 #include <vector>
 
 #include "tfd/config/config.h"
@@ -22,6 +23,8 @@
 #include "tfd/lm/timestamp.h"
 #include "tfd/lm/tpu_labeler.h"
 #include "tfd/lm/tpuvm_labeler.h"
+#include "tfd/obs/metrics.h"
+#include "tfd/obs/server.h"
 #include "tfd/platform/detect.h"
 #include "tfd/resource/factory.h"
 #include "tfd/util/file.h"
@@ -31,6 +34,57 @@ namespace tfd {
 namespace {
 
 enum class RunOutcome { kExit, kRestart, kError };
+
+// ---- observability plumbing (obs/) ---------------------------------------
+// All instruments live in obs::Default() so counters stay monotone across
+// SIGHUP reloads; the introspection server (re)binds per config load.
+
+double WallClockSeconds() {
+  return std::chrono::duration<double>(
+             std::chrono::system_clock::now().time_since_epoch())
+      .count();
+}
+
+double SecondsSince(std::chrono::steady_clock::time_point t0) {
+  return std::chrono::duration<double>(std::chrono::steady_clock::now() - t0)
+      .count();
+}
+
+// One rewrite attempt settled: counters, freshness gauge, /readyz state.
+// `ok` means labels actually landed in the sink — a transient NodeFeature
+// failure that keeps the daemon alive still records as a failure here, so
+// /readyz and tfd_rewrite_failures_total see what the log sees.
+void RecordRewriteOutcome(bool ok, size_t labels_emitted, double seconds,
+                          obs::IntrospectionServer* server) {
+  obs::Registry& reg = obs::Default();
+  reg.GetCounter("tfd_rewrites_total",
+                 "Label rewrite passes attempted.")->Inc();
+  reg.GetHistogram("tfd_rewrite_duration_seconds",
+                   "End-to-end duration of one label rewrite pass.",
+                   obs::DurationBuckets())->Observe(seconds);
+  if (ok) {
+    reg.GetGauge("tfd_labels_emitted",
+                 "Labels written by the last successful rewrite.")
+        ->Set(static_cast<double>(labels_emitted));
+    reg.GetGauge("tfd_last_rewrite_timestamp_seconds",
+                 "Unix time of the last successful label rewrite.")
+        ->Set(WallClockSeconds());
+  } else {
+    reg.GetCounter("tfd_rewrite_failures_total",
+                   "Label rewrite passes that failed (including transient "
+                   "NodeFeature errors the daemon survives).")->Inc();
+  }
+  if (server != nullptr) server->RecordRewrite(ok);
+}
+
+void ObserveStageDuration(const char* metric, const char* help,
+                          const char* label_key, const std::string& label,
+                          double seconds) {
+  obs::Default()
+      .GetHistogram(metric, help, obs::DurationBuckets(),
+                    {{label_key, label}})
+      ->Observe(seconds);
+}
 
 bool MetadataPlausible(const config::Config& config) {
   return platform::MetadataPlausible(config.flags.metadata_endpoint);
@@ -43,25 +97,42 @@ lm::MachineTypeGetter MakeMachineTypeGetter(const config::Config& config) {
   return [client]() { return client->MachineType(); };
 }
 
-// One labeling pass: build backend + labelers, merge, write.
-Status LabelOnce(const config::Config& config, lm::Labeler& timestamp,
-                 lm::Labeler& machine_type, lm::Labeler& tpu_vm) {
+// One labeling pass: build backend + labelers, merge, write. `*wrote_ok`
+// reports whether labels actually landed in the sink — false on every
+// error path, including the transient NodeFeature one that returns Ok to
+// keep the daemon alive.
+Status LabelOnceInner(const config::Config& config, lm::Labeler& timestamp,
+                      lm::Labeler& machine_type, lm::Labeler& tpu_vm,
+                      size_t* labels_emitted, bool* wrote_ok) {
   auto t0 = std::chrono::steady_clock::now();
 
+  auto backend_t0 = std::chrono::steady_clock::now();
   Result<resource::ManagerPtr> manager = resource::NewManager(config);
   if (!manager.ok()) {
     return Status::Error("unable to create resource manager: " +
                          manager.error());
   }
+  ObserveStageDuration("tfd_backend_duration_seconds",
+                       "Resource-backend construction + init duration, per "
+                       "backend actually used.",
+                       "backend", (*manager)->Name(),
+                       SecondsSince(backend_t0));
   Result<lm::LabelerPtr> tpu = lm::NewTpuLabeler(*manager, config);
   if (!tpu.ok()) return tpu.status();
 
   // Merge order mirrors lm.NewLabelers (labeler.go:33-45): device labels
   // first, then the VM/virtualization labeler; later labelers win.
+  constexpr const char* kLabelerNames[] = {"timestamp", "machine-type",
+                                           "tpu", "tpu-vm"};
   lm::Labels merged;
+  size_t i = 0;
   for (lm::Labeler* labeler : std::vector<lm::Labeler*>{
            &timestamp, &machine_type, tpu->get(), &tpu_vm}) {
+    auto labeler_t0 = std::chrono::steady_clock::now();
     Result<lm::Labels> labels = labeler->GetLabels();
+    ObserveStageDuration("tfd_labeler_duration_seconds",
+                         "GetLabels duration per labeler.", "labeler",
+                         kLabelerNames[i++], SecondsSince(labeler_t0));
     if (!labels.ok()) return labels.status();
     for (auto& [k, v] : *labels) merged[k] = v;
   }
@@ -92,6 +163,8 @@ Status LabelOnce(const config::Config& config, lm::Labeler& timestamp,
   }
   if (!out.ok()) return out;
 
+  *labels_emitted = merged.size();
+  *wrote_ok = true;
   auto ms = std::chrono::duration_cast<std::chrono::milliseconds>(
                 std::chrono::steady_clock::now() - t0)
                 .count();
@@ -103,7 +176,20 @@ Status LabelOnce(const config::Config& config, lm::Labeler& timestamp,
   return Status::Ok();
 }
 
-RunOutcome Run(const config::Config& config, const sigset_t& sigmask) {
+Status LabelOnce(const config::Config& config, lm::Labeler& timestamp,
+                 lm::Labeler& machine_type, lm::Labeler& tpu_vm,
+                 obs::IntrospectionServer* server) {
+  auto t0 = std::chrono::steady_clock::now();
+  size_t labels_emitted = 0;
+  bool wrote_ok = false;
+  Status s = LabelOnceInner(config, timestamp, machine_type, tpu_vm,
+                            &labels_emitted, &wrote_ok);
+  RecordRewriteOutcome(wrote_ok, labels_emitted, SecondsSince(t0), server);
+  return s;
+}
+
+RunOutcome Run(const config::Config& config, const sigset_t& sigmask,
+               obs::IntrospectionServer* server) {
   lm::LabelerPtr timestamp = lm::NewTimestampLabeler(config);
   lm::LabelerPtr machine_type = lm::NewMachineTypeLabeler(
       config.flags.machine_type_file, MakeMachineTypeGetter(config));
@@ -114,7 +200,7 @@ RunOutcome Run(const config::Config& config, const sigset_t& sigmask) {
   bool cleanup_output = !config.flags.oneshot &&
                         !config.flags.output_file.empty();
   while (true) {
-    Status s = LabelOnce(config, *timestamp, *machine_type, *tpu_vm);
+    Status s = LabelOnce(config, *timestamp, *machine_type, *tpu_vm, server);
     if (!s.ok()) {
       TFD_LOG_ERROR << s.message();
       return RunOutcome::kError;
@@ -162,6 +248,7 @@ int Main(int argc, char** argv) {
 
   // start() loop: reload config and re-run on SIGHUP
   // (reference main.go:125-153).
+  int config_generation = 0;
   while (true) {
     Result<config::LoadResult> loaded = config::Load(argc, argv);
     if (!loaded.ok()) {
@@ -180,7 +267,49 @@ int Main(int argc, char** argv) {
     TFD_LOG_INFO << "tpu-feature-discovery " << info::VersionString();
     TFD_LOG_INFO << "running with config: " << config::ToJson(loaded->config);
 
-    switch (Run(loaded->config, sigmask)) {
+    config_generation++;
+    obs::Default()
+        .GetGauge("tfd_config_generation",
+                  "Config loads this process has performed (bumps on "
+                  "SIGHUP reload).")
+        ->Set(config_generation);
+    obs::Default()
+        .GetGauge("tfd_build_info",
+                  "Always 1; version and commit ride as labels.",
+                  {{"version", info::VersionString()}})
+        ->Set(1);
+
+    // Introspection server: daemon mode only (a oneshot pass has no
+    // lifecycle to probe, and binding would collide with a daemon already
+    // on the node). Recreated per config load so a SIGHUP that changes
+    // --introspection-addr rebinds; a bind failure is fatal — a DaemonSet
+    // with liveness probes must crash visibly, not run unprobeable.
+    std::unique_ptr<obs::IntrospectionServer> server;
+    const config::Flags& flags = loaded->config.flags;
+    if (!flags.oneshot && !flags.introspection_addr.empty()) {
+      obs::ServerOptions options;
+      options.addr = flags.introspection_addr;
+      // Freshness window: 2x the rewrite cadence — plus the health-exec
+      // budget when --device-health=full, whose hourly re-measure
+      // legitimately blocks a pass for up to health_exec_timeout_s; a
+      // healthy node must not flap NotReady once an hour.
+      options.stale_after_s =
+          2 * flags.sleep_interval_s +
+          (flags.device_health == "full" ? flags.health_exec_timeout_s : 0);
+      Result<std::unique_ptr<obs::IntrospectionServer>> started =
+          obs::IntrospectionServer::Start(options, &obs::Default());
+      if (!started.ok()) {
+        TFD_LOG_ERROR << "introspection server: " << started.error();
+        return 1;
+      }
+      server = std::move(*started);
+      TFD_LOG_INFO << "introspection server serving /healthz /readyz "
+                      "/metrics on "
+                   << flags.introspection_addr << " (port "
+                   << server->port() << ")";
+    }
+
+    switch (Run(loaded->config, sigmask, server.get())) {
       case RunOutcome::kExit:
         TFD_LOG_INFO << "exiting";
         return 0;

@@ -1,0 +1,126 @@
+#!/usr/bin/env python3
+"""CI metrics-lint: boot the daemon (null backend), scrape /metrics, and
+validate the exposition with BOTH checkers — the C++ one shipped in the
+unit-test binary (`tfd_unit_tests --validate-exposition`, the same
+function the fuzz target uses as its oracle) and the Python twin
+(tpufd.metrics.validate_exposition, the one soak's scrape parsing rides
+on). Also asserts the contract metrics the deployment docs promise are
+actually present, so a renamed series fails CI before it breaks
+someone's dashboard.
+
+Usage:
+  python3 scripts/metrics_lint.py [--binary build/tpu-feature-discovery]
+      [--unit-tests build/tfd_unit_tests]
+
+Exit 0 on a valid, complete scrape; nonzero with the reason otherwise.
+"""
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+from tpufd import metrics  # noqa: E402
+from tpufd.fakes import free_loopback_port  # noqa: E402
+
+# The scrape surface the docs/README promise operators. Histograms are
+# checked via their _count series.
+REQUIRED = [
+    "tfd_rewrites_total",
+    "tfd_rewrite_duration_seconds_count",
+    "tfd_labeler_duration_seconds_count",
+    "tfd_backend_duration_seconds_count",
+    "tfd_labels_emitted",
+    "tfd_last_rewrite_timestamp_seconds",
+    "tfd_config_generation",
+    "tfd_build_info",
+]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--binary", default="build/tpu-feature-discovery")
+    ap.add_argument("--unit-tests", default="build/tfd_unit_tests")
+    ap.add_argument("--timeout", type=float, default=30.0)
+    args = ap.parse_args(argv)
+
+    port = free_loopback_port()
+
+    proc = subprocess.Popen(
+        [args.binary, "--sleep-interval=1s", "--backend=null",
+         "--fail-on-init-error=false", "--machine-type-file=/dev/null",
+         "--output-file=/dev/null",
+         f"--introspection-addr=127.0.0.1:{port}"],
+        env={**os.environ, "GCE_METADATA_HOST": "127.0.0.1:1"},
+        stderr=subprocess.PIPE)
+    text = None
+    try:
+        deadline = time.monotonic() + args.timeout
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                print(f"daemon exited rc={proc.returncode}: "
+                      f"{proc.stderr.read().decode()[-500:]}",
+                      file=sys.stderr)
+                return 1
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics", timeout=2) as r:
+                    candidate = r.read().decode()
+            except OSError:
+                time.sleep(0.1)
+                continue
+            # Wait for the first pass so the rewrite metrics exist.
+            if metrics.sample_value(candidate, "tfd_rewrites_total"):
+                text = candidate
+                break
+            time.sleep(0.1)
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+    if text is None:
+        print("never scraped a post-first-pass /metrics", file=sys.stderr)
+        return 1
+
+    # Checker 1: Python twin (raises on violation).
+    metrics.validate_exposition(text)
+
+    # Checker 2: the C++ checker from the unit-test binary.
+    with tempfile.NamedTemporaryFile("w", suffix=".prom",
+                                     delete=False) as f:
+        f.write(text)
+        path = f.name
+    try:
+        cpp = subprocess.run(
+            [args.unit_tests, "--validate-exposition", path],
+            capture_output=True, text=True, timeout=30)
+        if cpp.returncode != 0:
+            print(f"C++ checker rejected the scrape: {cpp.stderr}",
+                  file=sys.stderr)
+            return 1
+    finally:
+        os.unlink(path)
+
+    missing = [name for name in REQUIRED
+               if metrics.sample_value(text, name) is None]
+    if missing:
+        print(f"contract metrics missing from /metrics: {missing}",
+              file=sys.stderr)
+        return 1
+    print(f"metrics lint OK: {len(text.splitlines())} lines, "
+          f"both checkers passed, {len(REQUIRED)} contract series present")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,12 +11,21 @@ binary long enough for those to show (reference analogue: GFD's e2e tier
 watches the daemon relabel on cadence, tests/e2e-tests.py — but nothing
 in the reference watches its memory; this goes further).
 
+Pass counting comes from the daemon's OWN introspection server: the
+harness starts it on a loopback port and scrapes `tfd_rewrites_total`
+from /metrics — the counter increments exactly once per attempted pass,
+so the soak measures what the daemon says it did, not what the harness
+managed to infer from mtimes or request streams. (Binaries without the
+introspection server — the hermetic harness-failure fakes — fall back to
+sink-observed generations; `gen_source` records which path counted.)
+/readyz must also report ready at the end of a healthy soak.
+
 Both output sinks soak: `--sink=file` (default) watches the NFD feature
 file; `--sink=cr` launches the hermetic fake apiserver
-(tpufd.fakes.apiserver) and counts passes from the CR request stream
-(steady-state passes are deliberate no-op GETs — identical labels skip
-the PUT, so resourceVersion never advances), giving the HTTP client
-path the same steady-state scrutiny as the file path.
+(tpufd.fakes.apiserver). The CR request stream is demoted to a
+cross-check: the server-side count of per-pass GETs (steady-state passes
+are deliberate no-op GETs — identical labels skip the PUT, so
+resourceVersion never advances) must agree with the scraped counter.
 
 Usage:
   python3 scripts/soak.py --binary build/tpu-feature-discovery \
@@ -26,13 +35,17 @@ Usage:
 Prints ONE JSON line, e.g.:
   {"ok": true, "passes": 29, "rss_start_kb": 3180, "rss_end_kb": 3180,
    "rss_drift_kb": 0, "fd_start": 6, "fd_end": 6, "labels_stable": true,
-   "rewrite_interval_p50_s": 1.0, "clean_exit": true}
+   "rewrite_interval_p50_s": 1.0, "cadence_ok": true, "readyz_ok": true,
+   "gen_source": "metrics", "clean_exit": true}
 
-Exit code 0 iff ok. "ok" means: >=3 passes observed, RSS drift under
---max-rss-drift-kb (default 1024), fd count unchanged, labels (minus the
-timestamp) identical across every pass, SIGTERM led to exit 0, and the
-sink was left in its contracted end state (file removed; the CR persists
-by design — NFD owns its lifecycle).
+Exit code 0 iff ok. "ok" means: >=3 passes observed, rewrites on cadence
+(passes >= half of duration/interval AND the p50 rewrite interval within
+3x --interval), RSS drift under --max-rss-drift-kb (default 1024), fd
+count unchanged, labels (minus the timestamp) identical across every
+pass, /readyz ready at soak end (when scraping), the CR GET cross-check
+consistent (cr sink + scraping), SIGTERM led to exit 0, and the sink was
+left in its contracted end state (file removed; the CR persists by
+design — NFD owns its lifecycle).
 """
 
 import argparse
@@ -44,6 +57,45 @@ import subprocess
 import sys
 import tempfile
 import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+from tpufd import metrics as tpufd_metrics  # noqa: E402
+from tpufd.fakes import free_loopback_port  # noqa: E402
+
+
+class MetricsScraper:
+    """Scrapes the daemon's introspection server (the /metrics and
+    /readyz the deployment probes hit), parsing with the shared
+    tpufd.metrics exposition parser."""
+
+    def __init__(self, port):
+        self.base = f"http://127.0.0.1:{port}"
+
+    def _get(self, path):
+        try:
+            with urllib.request.urlopen(self.base + path, timeout=2) as r:
+                return r.status, r.read().decode()
+        except urllib.error.HTTPError as e:  # 503 from /readyz
+            return e.code, ""
+        except (OSError, ValueError):
+            return None, ""
+
+    def generation(self):
+        """Value of tfd_rewrites_total, or None while unreachable."""
+        status, text = self._get("/metrics")
+        if status != 200:
+            return None
+        try:
+            return tpufd_metrics.sample_value(text, "tfd_rewrites_total")
+        except ValueError:
+            return None
+
+    def readyz(self):
+        return self._get("/readyz")[0]
 
 
 def rss_kb(pid):
@@ -104,8 +156,6 @@ class CrSink:
     NODE = "soak-node"
 
     def __init__(self, tmpdir):
-        sys.path.insert(0, os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__))))
         from tpufd.fakes.apiserver import FakeApiServer
 
         self.server = FakeApiServer(token="soak-token").__enter__()
@@ -134,12 +184,15 @@ class CrSink:
             return None
         labels = obj.get("spec", {}).get("labels", {})
         text = "\n".join(f"{k}={v}" for k, v in sorted(labels.items()))
-        # Generation = count of CR requests, not resourceVersion: in
-        # daemon mode the timestamp label is constant, so every
-        # steady-state pass is a no-op (GET, compare, skip the PUT) and
-        # rv never advances — but each pass still talks to the server.
-        gen = sum(1 for _, path in list(self.server.requests)
-                  if self.NODE in path)
+        # Generation = count of CR GETs, not resourceVersion: in daemon
+        # mode the timestamp label is constant, so every steady-state
+        # pass is a no-op (GET, compare, skip the PUT) and rv never
+        # advances — but each pass still does exactly one read. Counting
+        # GETs only keeps a GET+PUT label-change pass from registering as
+        # two generations (advisor r5). This stream is the cross-check
+        # against the daemon's scraped tfd_rewrites_total.
+        gen = sum(1 for method, path in list(self.server.requests)
+                  if method == "GET" and self.NODE in path)
         return gen, stable_digest(text)
 
     def end_state_ok(self):
@@ -182,9 +235,17 @@ def main(argv=None):
     with tempfile.TemporaryDirectory() as d:
         sink = (CrSink if args.sink == "cr" else FileSink)(d)
         stderr_path = os.path.join(d, "stderr")
+        # Pass counting scrapes the daemon's own introspection server
+        # unless the caller pinned an address via --extra-arg.
+        extra = list(args.extra_arg)
+        scraper = None
+        if not any(a.startswith("--introspection-addr") for a in extra):
+            port = free_loopback_port()
+            extra.append(f"--introspection-addr=127.0.0.1:{port}")
+            scraper = MetricsScraper(port)
         cmd = [args.binary, f"--sleep-interval={args.interval}s",
                *sink.daemon_args(),
-               "--machine-type-file=/dev/null", *args.extra_arg]
+               "--machine-type-file=/dev/null", *extra]
         env = {**os.environ, **sink.daemon_env()}
         env.setdefault("GCE_METADATA_HOST", "127.0.0.1:1")
 
@@ -214,6 +275,7 @@ def main(argv=None):
             digests = set()
             gens, seen_at = [], []
             baseline_rss = baseline_fd = None
+            gen_source = None  # "metrics" once the scrape works, else sink
             # The soak duration is steady-state time: the clock starts at
             # the FIRST observed rewrite. Spawn-to-first-pass gets its own
             # budget (--init-grace) so slow chip init neither eats the
@@ -222,17 +284,43 @@ def main(argv=None):
             while time.monotonic() < deadline:
                 if proc.poll() is not None:
                     break
-                observed = sink.observe()
-                if observed is None:  # first pass not done yet
-                    time.sleep(0.05)
-                    continue
-                gen, digest = observed
+                # Generations come from the daemon's own rewrite counter;
+                # the sink is still read every new generation for the
+                # label digest. The source latches on first evidence:
+                # a successful scrape wins (the real daemon's server is
+                # up before its first pass completes); a sink generation
+                # appearing while the scrape still fails means a binary
+                # without the introspection server (the harness-failure
+                # fakes) and latches the legacy sink path.
+                if gen_source is None:
+                    if scraper is not None and \
+                            scraper.generation() is not None:
+                        gen_source = "metrics"
+                    elif sink.observe() is not None:
+                        gen_source = "sink"
+                    else:
+                        time.sleep(0.05)
+                        continue
+                if gen_source == "metrics":
+                    gen = scraper.generation()
+                    if gen is None or gen < 1:  # no pass yet (or hiccup)
+                        time.sleep(0.05)
+                        continue
+                    observed = sink.observe()
+                    digest = observed[1] if observed else None
+                else:
+                    observed = sink.observe()
+                    if observed is None:  # first pass not done yet
+                        time.sleep(0.05)
+                        continue
+                    gen, digest = observed
                 if not gens or gen != gens[-1]:
                     if not gens:
                         deadline = time.monotonic() + args.duration
                     gens.append(gen)
                     seen_at.append(time.monotonic())
-                    digests.add(digest)
+                    if digest is not None:
+                        digests.add(digest)
                     if len(gens) == args.settle_passes:
                         try:
                             baseline_rss = rss_kb(proc.pid)
@@ -259,27 +347,55 @@ def main(argv=None):
                                 + stderr_tail())
                 print(json.dumps(out))
                 return 1
+            # Readiness at soak end: a healthy steady state must also
+            # LOOK healthy to the deployment's readiness probe.
+            readyz_ok = None
+            if gen_source == "metrics":
+                readyz_ok = scraper.readyz() == 200
+            # CR cross-check (cr sink + scraping): one GET per pass
+            # server-side must agree with the daemon's own counter,
+            # within an edge pass either way.
+            crosscheck_ok = None
+            if args.sink == "cr" and gen_source == "metrics":
+                observed = sink.observe()
+                cr_gets = observed[0] if observed else 0
+                out["cr_gets"] = cr_gets
+                crosscheck_ok = abs(cr_gets - len(gens)) <= 2
             proc.send_signal(signal.SIGTERM)
             try:
                 clean = proc.wait(timeout=30) == 0
             except subprocess.TimeoutExpired:
                 clean = False  # won't shut down IS the finding
             gaps = sorted(b - a for a, b in zip(seen_at, seen_at[1:]))
+            p50 = round(gaps[len(gaps) // 2], 2) if gaps else None
+            # Cadence is part of ok (advisor r5): a daemon that settles
+            # then stalls for the rest of the soak must not report
+            # steady. Both halves: enough passes for the wall time, and
+            # a p50 rewrite interval in the right ballpark.
+            cadence_ok = (
+                len(gens) >= max(3, int(0.5 * args.duration / args.interval))
+                and (p50 is None or p50 <= 3 * args.interval))
 
             out.update({
                 "passes": len(gens),
+                "gen_source": gen_source,
                 "rss_start_kb": baseline_rss, "rss_end_kb": end_rss,
                 "rss_drift_kb": (None if baseline_rss is None
                                  else end_rss - baseline_rss),
                 "fd_start": baseline_fd, "fd_end": end_fd,
                 "labels_stable": len(digests) == 1,
-                "rewrite_interval_p50_s": (
-                    round(gaps[len(gaps) // 2], 2) if gaps else None),
+                "rewrite_interval_p50_s": p50,
+                "cadence_ok": cadence_ok,
+                "readyz_ok": readyz_ok,
+                "crosscheck_ok": crosscheck_ok,
                 "clean_exit": clean,
                 "end_state_ok": sink.end_state_ok(),
             })
             out["ok"] = bool(
                 len(gens) >= max(3, args.settle_passes)
+                and cadence_ok
+                and readyz_ok is not False
+                and crosscheck_ok is not False
                 and baseline_rss is not None
                 and out["rss_drift_kb"] <= args.max_rss_drift_kb
                 and end_fd == baseline_fd

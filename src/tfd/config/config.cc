@@ -5,6 +5,7 @@
 #include <sstream>
 
 #include "tfd/config/yamllite.h"
+#include "tfd/obs/server.h"
 #include "tfd/util/file.h"
 #include "tfd/util/logging.h"
 #include "tfd/util/strings.h"
@@ -261,6 +262,16 @@ std::vector<FlagDef> MakeFlagDefs(Flags* f) {
                   false,
                   [f](const std::string& v) {
                     return SetDuration(&f->health_exec_interval_s, v);
+                  }});
+  defs.push_back({"introspection-addr",
+                  {"TFD_INTROSPECTION_ADDR"},
+                  "introspectionAddr",
+                  "listen address for the introspection HTTP server "
+                  "(/healthz, /readyz, Prometheus /metrics), e.g. :8081 or "
+                  "127.0.0.1:8081; '' disables (oneshot runs never bind)",
+                  false,
+                  [f](const std::string& v) {
+                    return SetString(&f->introspection_addr, v);
                   }});
   return defs;
 }
@@ -591,6 +602,10 @@ Result<LoadResult> Load(int argc, char** argv) {
   if (f->sleep_interval_s < 1) {
     return Result<LoadResult>::Error("sleep-interval must be >= 1s");
   }
+  if (!f->introspection_addr.empty()) {
+    Result<obs::ListenAddr> addr = obs::ParseListenAddr(f->introspection_addr);
+    if (!addr.ok()) return Result<LoadResult>::Error(addr.error());
+  }
   return out;
 }
 
@@ -632,6 +647,7 @@ std::string ToJson(const Config& config) {
       << ",\"healthExec\":" << jstr(f.health_exec)
       << ",\"healthExecTimeout\":\"" << f.health_exec_timeout_s << "s\""
       << ",\"healthExecInterval\":\"" << f.health_exec_interval_s << "s\""
+      << ",\"introspectionAddr\":" << jstr(f.introspection_addr)
       << "},\"sharing\":[";
   for (size_t i = 0; i < config.sharing.time_slicing.size(); i++) {
     const SharedResource& r = config.sharing.time_slicing[i];

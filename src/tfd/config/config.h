@@ -113,6 +113,12 @@ struct Flags {
   // is cached and re-measured only this often, so the probe never runs
   // once per sleep-interval.
   int health_exec_interval_s = 3600;
+  // Introspection HTTP server (obs/server.h): /healthz, /readyz and
+  // Prometheus /metrics. "host:port"; empty host binds all interfaces,
+  // empty string disables. Oneshot runs never bind (there is no
+  // lifecycle to introspect, and a bound port would collide with a
+  // daemon already running on the node).
+  std::string introspection_addr = ":8081";
 };
 
 struct Config {

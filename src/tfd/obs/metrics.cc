@@ -1,0 +1,598 @@
+#include "tfd/obs/metrics.h"
+
+#include <algorithm>
+#include <cctype>
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <limits>
+#include <map>
+#include <sstream>
+
+namespace tfd {
+namespace obs {
+
+namespace {
+
+enum MetricType { kCounter = 0, kGauge = 1, kHistogram = 2 };
+
+const char* TypeName(int type) {
+  switch (type) {
+    case kCounter: return "counter";
+    case kGauge: return "gauge";
+    default: return "histogram";
+  }
+}
+
+// Prometheus metric-name grammar: [a-zA-Z_:][a-zA-Z0-9_:]*. Sanitizing at
+// registration (instead of rejecting) keeps the exposition valid for any
+// input — hostile names from the fuzzer included — at the cost of
+// possibly merging two degenerate names; real call sites use literals.
+std::string SanitizeMetricName(const std::string& name) {
+  std::string out;
+  out.reserve(name.size());
+  for (char c : name) {
+    bool ok = std::isalnum(static_cast<unsigned char>(c)) || c == '_' ||
+              c == ':';
+    out.push_back(ok ? c : '_');
+  }
+  if (out.empty()) out = "_";
+  if (std::isdigit(static_cast<unsigned char>(out[0]))) out.insert(0, "_");
+  return out;
+}
+
+// Label names additionally exclude ':' (reserved for recording rules).
+std::string SanitizeLabelName(const std::string& name) {
+  std::string out = SanitizeMetricName(name);
+  std::replace(out.begin(), out.end(), ':', '_');
+  return out;
+}
+
+// Escaping for label VALUES: \ " and newline (text format 0.0.4).
+std::string EscapeLabelValue(const std::string& v) {
+  std::string out;
+  out.reserve(v.size());
+  for (char c : v) {
+    if (c == '\\') out += "\\\\";
+    else if (c == '"') out += "\\\"";
+    else if (c == '\n') out += "\\n";
+    else out.push_back(c);
+  }
+  return out;
+}
+
+// Escaping for HELP text: only \ and newline (quotes are legal there).
+std::string EscapeHelp(const std::string& v) {
+  std::string out;
+  out.reserve(v.size());
+  for (char c : v) {
+    if (c == '\\') out += "\\\\";
+    else if (c == '\n') out += "\\n";
+    else out.push_back(c);
+  }
+  return out;
+}
+
+std::string FormatValue(double v) {
+  if (std::isnan(v)) return "NaN";
+  if (std::isinf(v)) return v > 0 ? "+Inf" : "-Inf";
+  // %.17g round-trips every double; trim the noise for the common exact
+  // cases (counters, millisecond-scale durations) via shortest-exact.
+  char buf[64];
+  for (int prec = 6; prec <= 17; prec++) {
+    snprintf(buf, sizeof(buf), "%.*g", prec, v);
+    if (std::strtod(buf, nullptr) == v) break;
+  }
+  return buf;
+}
+
+Labels SanitizeLabels(const Labels& labels) {
+  Labels out;
+  out.reserve(labels.size());
+  for (const auto& [k, v] : labels) out.emplace_back(SanitizeLabelName(k), v);
+  return out;
+}
+
+std::string RenderLabels(const Labels& labels, const char* extra_key,
+                         const std::string& extra_value) {
+  if (labels.empty() && extra_key == nullptr) return "";
+  std::string out = "{";
+  bool first = true;
+  for (const auto& [k, v] : labels) {
+    if (!first) out += ",";
+    first = false;
+    out += k + "=\"" + EscapeLabelValue(v) + "\"";
+  }
+  if (extra_key != nullptr) {
+    if (!first) out += ",";
+    out += std::string(extra_key) + "=\"" + extra_value + "\"";
+  }
+  out += "}";
+  return out;
+}
+
+}  // namespace
+
+void Counter::Inc(double v) {
+  if (!(v > 0)) return;  // counters only go up; NaN/negative dropped
+  double cur = value_.load(std::memory_order_relaxed);
+  while (!value_.compare_exchange_weak(cur, cur + v,
+                                       std::memory_order_relaxed)) {
+  }
+}
+
+Histogram::Histogram(std::vector<double> upper_bounds) {
+  std::sort(upper_bounds.begin(), upper_bounds.end());
+  for (double b : upper_bounds) {
+    if (!std::isfinite(b)) continue;  // +Inf is implicit, NaN is nonsense
+    if (!upper_bounds_.empty() && upper_bounds_.back() == b) continue;
+    upper_bounds_.push_back(b);
+  }
+  counts_.reserve(upper_bounds_.size());
+  for (size_t i = 0; i < upper_bounds_.size(); i++) {
+    counts_.push_back(std::make_unique<std::atomic<unsigned long long>>(0));
+  }
+}
+
+void Histogram::Observe(double v) {
+  if (std::isnan(v)) return;
+  size_t i = std::lower_bound(upper_bounds_.begin(), upper_bounds_.end(), v) -
+             upper_bounds_.begin();
+  if (i < counts_.size()) {
+    counts_[i]->fetch_add(1, std::memory_order_relaxed);
+  } else {
+    overflow_.fetch_add(1, std::memory_order_relaxed);
+  }
+  double cur = sum_.load(std::memory_order_relaxed);
+  while (!sum_.compare_exchange_weak(cur, cur + v,
+                                     std::memory_order_relaxed)) {
+  }
+  count_.fetch_add(1, std::memory_order_relaxed);
+}
+
+unsigned long long Histogram::CumulativeCount(size_t i) const {
+  unsigned long long total = 0;
+  for (size_t j = 0; j <= i && j < counts_.size(); j++) {
+    total += counts_[j]->load(std::memory_order_relaxed);
+  }
+  return total;
+}
+
+Histogram::Snapshot Histogram::TakeSnapshot() const {
+  Snapshot snap;
+  snap.cumulative.reserve(counts_.size());
+  unsigned long long running = 0;
+  for (const auto& count : counts_) {
+    running += count->load(std::memory_order_relaxed);
+    snap.cumulative.push_back(running);
+  }
+  snap.total = running + overflow_.load(std::memory_order_relaxed);
+  snap.sum = sum_.load(std::memory_order_relaxed);
+  return snap;
+}
+
+std::vector<double> DurationBuckets() {
+  return {0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+          0.5,    1,     2.5,    5,     10,   30,    60,   120, 300};
+}
+
+struct Registry::Child {
+  Labels labels;
+  std::unique_ptr<Counter> counter;
+  std::unique_ptr<Gauge> gauge;
+  std::unique_ptr<Histogram> histogram;
+};
+
+struct Registry::Family {
+  std::string name;
+  std::string help;
+  int type = kCounter;
+  std::vector<std::unique_ptr<Child>> children;
+};
+
+Registry::Registry() = default;
+Registry::~Registry() = default;
+
+Registry::Child* Registry::GetChild(const std::string& raw_name,
+                                    const std::string& help, int type,
+                                    const Labels& raw_labels,
+                                    const std::vector<double>* upper_bounds) {
+  std::string name = SanitizeMetricName(raw_name);
+  Labels labels = SanitizeLabels(raw_labels);
+  // Dedupe (last wins) and, on histograms, free the reserved `le` label —
+  // a caller-supplied `le` would collide with the generated bucket label.
+  Labels deduped;
+  for (auto& [k, v] : labels) {
+    std::string key = (type == kHistogram && k == "le") ? "exported_le" : k;
+    bool replaced = false;
+    for (auto& [dk, dv] : deduped) {
+      if (dk == key) {
+        dv = v;
+        replaced = true;
+        break;
+      }
+    }
+    if (!replaced) deduped.emplace_back(key, v);
+  }
+  labels = std::move(deduped);
+
+  // Sample-name collision guard: a family must not emit sample lines
+  // that collide with another family's — neither a plain metric named
+  // like an existing histogram's generated h_bucket/_sum/_count series,
+  // nor a new histogram whose generated names hit an existing family.
+  // Such output is ambiguous to every consumer, so the newcomer is
+  // renamed (trailing '_') until its names are free. The loop re-runs
+  // the exact-name lookup after each rename, so repeat registrations of
+  // a renamed metric land on the SAME family, not a fresh one.
+  auto series_names = [](const std::string& n, int t) {
+    std::vector<std::string> names = {n};
+    if (t == kHistogram) {
+      names.push_back(n + "_bucket");
+      names.push_back(n + "_sum");
+      names.push_back(n + "_count");
+    }
+    return names;
+  };
+  Family* family = nullptr;
+  while (true) {
+    for (auto& f : families_) {
+      if (f->name == name) {
+        family = f.get();
+        break;
+      }
+    }
+    if (family != nullptr) break;  // exact reuse (type checked below)
+    bool collides = false;
+    for (const auto& f : families_) {
+      for (const std::string& theirs : series_names(f->name, f->type)) {
+        for (const std::string& ours : series_names(name, type)) {
+          if (ours == theirs) collides = true;
+        }
+      }
+    }
+    if (!collides) break;
+    name += "_";
+  }
+  if (family == nullptr) {
+    families_.push_back(std::make_unique<Family>());
+    family = families_.back().get();
+    family->name = name;
+    family->help = help;
+    family->type = type;
+  }
+  if (family->type != type) return nullptr;  // caller hands out an orphan
+
+  for (auto& child : family->children) {
+    if (child->labels == labels) return child.get();
+  }
+  family->children.push_back(std::make_unique<Child>());
+  Child* child = family->children.back().get();
+  child->labels = std::move(labels);
+  switch (type) {
+    case kCounter:
+      child->counter = std::make_unique<Counter>();
+      break;
+    case kGauge:
+      child->gauge = std::make_unique<Gauge>();
+      break;
+    default:
+      child->histogram = std::make_unique<Histogram>(
+          upper_bounds != nullptr ? *upper_bounds : DurationBuckets());
+      break;
+  }
+  return child;
+}
+
+Counter* Registry::GetCounter(const std::string& name, const std::string& help,
+                              const Labels& labels) {
+  std::lock_guard<std::mutex> lock(mu_);
+  Child* child = GetChild(name, help, kCounter, labels, nullptr);
+  if (child != nullptr) return child->counter.get();
+  orphan_counters_.push_back(std::make_unique<Counter>());
+  return orphan_counters_.back().get();
+}
+
+Gauge* Registry::GetGauge(const std::string& name, const std::string& help,
+                          const Labels& labels) {
+  std::lock_guard<std::mutex> lock(mu_);
+  Child* child = GetChild(name, help, kGauge, labels, nullptr);
+  if (child != nullptr) return child->gauge.get();
+  orphan_gauges_.push_back(std::make_unique<Gauge>());
+  return orphan_gauges_.back().get();
+}
+
+Histogram* Registry::GetHistogram(const std::string& name,
+                                  const std::string& help,
+                                  std::vector<double> upper_bounds,
+                                  const Labels& labels) {
+  std::lock_guard<std::mutex> lock(mu_);
+  Child* child = GetChild(name, help, kHistogram, labels, &upper_bounds);
+  if (child != nullptr) return child->histogram.get();
+  orphan_histograms_.push_back(
+      std::make_unique<Histogram>(std::move(upper_bounds)));
+  return orphan_histograms_.back().get();
+}
+
+std::string Registry::Exposition() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  std::string out;
+  for (const auto& f : families_) {
+    out += "# HELP " + f->name + " " + EscapeHelp(f->help) + "\n";
+    out += "# TYPE " + f->name + " " + TypeName(f->type) + "\n";
+    for (const auto& child : f->children) {
+      if (f->type == kCounter) {
+        out += f->name + RenderLabels(child->labels, nullptr, "") + " " +
+               FormatValue(child->counter->Value()) + "\n";
+      } else if (f->type == kGauge) {
+        out += f->name + RenderLabels(child->labels, nullptr, "") + " " +
+               FormatValue(child->gauge->Value()) + "\n";
+      } else {
+        const Histogram& h = *child->histogram;
+        const Histogram::Snapshot snap = h.TakeSnapshot();
+        for (size_t i = 0; i < h.upper_bounds().size(); i++) {
+          out += f->name + "_bucket" +
+                 RenderLabels(child->labels, "le",
+                              FormatValue(h.upper_bounds()[i])) +
+                 " " + std::to_string(snap.cumulative[i]) + "\n";
+        }
+        out += f->name + "_bucket" +
+               RenderLabels(child->labels, "le", "+Inf") + " " +
+               std::to_string(snap.total) + "\n";
+        out += f->name + "_sum" + RenderLabels(child->labels, nullptr, "") +
+               " " + FormatValue(snap.sum) + "\n";
+        out += f->name + "_count" + RenderLabels(child->labels, nullptr, "") +
+               " " + std::to_string(snap.total) + "\n";
+      }
+    }
+  }
+  return out;
+}
+
+Registry& Default() {
+  // Meyers singleton (destroyed at exit, LeakSanitizer-clean): safe
+  // because the daemon stops the introspection server — the only other
+  // thread touching the registry — before Main returns.
+  static Registry registry;
+  return registry;
+}
+
+// ---- exposition validation ----------------------------------------------
+
+namespace {
+
+bool ValidMetricName(const std::string& s) {
+  if (s.empty()) return false;
+  if (std::isdigit(static_cast<unsigned char>(s[0]))) return false;
+  for (char c : s) {
+    if (!(std::isalnum(static_cast<unsigned char>(c)) || c == '_' ||
+          c == ':')) {
+      return false;
+    }
+  }
+  return true;
+}
+
+// Parses `metric_name{labels} value` into its parts. Returns false (with
+// *error set) on any grammar violation.
+struct Sample {
+  std::string name;
+  std::map<std::string, std::string> labels;
+  double value = 0;
+};
+
+bool ParseSample(const std::string& line, Sample* out, std::string* error) {
+  size_t i = 0;
+  while (i < line.size() &&
+         (std::isalnum(static_cast<unsigned char>(line[i])) ||
+          line[i] == '_' || line[i] == ':')) {
+    i++;
+  }
+  out->name = line.substr(0, i);
+  if (!ValidMetricName(out->name)) {
+    *error = "invalid metric name in sample: " + line;
+    return false;
+  }
+  if (i < line.size() && line[i] == '{') {
+    i++;
+    while (i < line.size() && line[i] != '}') {
+      size_t key_start = i;
+      while (i < line.size() && line[i] != '=') i++;
+      std::string key = line.substr(key_start, i - key_start);
+      if (!ValidMetricName(key) || key.find(':') != std::string::npos) {
+        *error = "invalid label name '" + key + "' in: " + line;
+        return false;
+      }
+      if (i + 1 >= line.size() || line[i + 1] != '"') {
+        *error = "label value not quoted in: " + line;
+        return false;
+      }
+      i += 2;
+      std::string value;
+      while (i < line.size() && line[i] != '"') {
+        if (line[i] == '\\') {
+          if (i + 1 >= line.size()) {
+            *error = "dangling escape in: " + line;
+            return false;
+          }
+          char esc = line[i + 1];
+          if (esc != '\\' && esc != '"' && esc != 'n') {
+            *error = "invalid escape \\" + std::string(1, esc) +
+                     " in: " + line;
+            return false;
+          }
+          value.push_back(esc == 'n' ? '\n' : esc);
+          i += 2;
+        } else {
+          value.push_back(line[i++]);
+        }
+      }
+      if (i >= line.size()) {
+        *error = "unterminated label value in: " + line;
+        return false;
+      }
+      i++;  // closing quote
+      if (out->labels.count(key) != 0) {
+        *error = "duplicate label '" + key + "' in: " + line;
+        return false;
+      }
+      out->labels[key] = value;
+      if (i < line.size() && line[i] == ',') i++;
+    }
+    if (i >= line.size()) {
+      *error = "unterminated label set in: " + line;
+      return false;
+    }
+    i++;  // closing brace
+  }
+  if (i >= line.size() || line[i] != ' ') {
+    *error = "missing value separator in: " + line;
+    return false;
+  }
+  std::string value_text = line.substr(i + 1);
+  if (value_text.empty() || value_text.find(' ') != std::string::npos) {
+    // A trailing timestamp is legal Prometheus but this build never emits
+    // one; flagging it keeps the validator strict about OUR output.
+    *error = "malformed value field in: " + line;
+    return false;
+  }
+  if (value_text == "+Inf") {
+    out->value = std::numeric_limits<double>::infinity();
+  } else if (value_text == "-Inf") {
+    out->value = -std::numeric_limits<double>::infinity();
+  } else if (value_text == "NaN") {
+    out->value = std::numeric_limits<double>::quiet_NaN();
+  } else {
+    char* end = nullptr;
+    out->value = std::strtod(value_text.c_str(), &end);
+    if (end == value_text.c_str() || *end != '\0') {
+      *error = "unparseable value '" + value_text + "' in: " + line;
+      return false;
+    }
+  }
+  return true;
+}
+
+// The family a sample belongs to: an exactly-named family wins (a
+// counter that happens to be called h_bucket is its own family), else a
+// histogram series suffix attributes to its base. The registry prevents
+// the ambiguous case (an h_bucket family next to a histogram h) at
+// registration, so exact-first is unambiguous for registry output.
+std::string BaseFamily(const std::string& name,
+                       const std::map<std::string, std::string>& types) {
+  if (types.count(name) != 0) return name;
+  for (const char* suffix : {"_bucket", "_sum", "_count"}) {
+    size_t n = std::string(suffix).size();
+    if (name.size() > n && name.compare(name.size() - n, n, suffix) == 0) {
+      std::string base = name.substr(0, name.size() - n);
+      auto it = types.find(base);
+      if (it != types.end() && it->second == "histogram") return base;
+    }
+  }
+  return name;
+}
+
+}  // namespace
+
+Status ValidateExposition(const std::string& text) {
+  if (!text.empty() && text.back() != '\n') {
+    return Status::Error("exposition must end with a newline");
+  }
+  std::map<std::string, std::string> types;  // family -> type
+  // (family, serialized labels minus le) -> last cumulative bucket value,
+  // for monotonicity; and the +Inf tracking for the _count cross-check.
+  std::map<std::string, double> last_bucket;
+  std::map<std::string, double> last_le;
+  std::map<std::string, double> inf_bucket;
+  std::map<std::string, double> counts;
+
+  std::istringstream in(text);
+  std::string line;
+  while (std::getline(in, line)) {
+    if (line.empty()) continue;
+    if (line[0] == '#') {
+      std::istringstream header(line);
+      std::string hash, kind, name;
+      header >> hash >> kind >> name;
+      if (kind != "HELP" && kind != "TYPE") continue;  // comment
+      if (!ValidMetricName(name)) {
+        return Status::Error("invalid family name in: " + line);
+      }
+      if (kind == "TYPE") {
+        std::string type;
+        header >> type;
+        if (type != "counter" && type != "gauge" && type != "histogram" &&
+            type != "summary" && type != "untyped") {
+          return Status::Error("invalid type in: " + line);
+        }
+        if (types.count(name) != 0) {
+          return Status::Error("duplicate TYPE for " + name);
+        }
+        types[name] = type;
+      }
+      continue;
+    }
+    Sample sample;
+    std::string error;
+    if (!ParseSample(line, &sample, &error)) return Status::Error(error);
+    std::string family = BaseFamily(sample.name, types);
+    auto type_it = types.find(family);
+    if (type_it == types.end()) {
+      return Status::Error("sample for undeclared family: " + line);
+    }
+    if (type_it->second == "counter" &&
+        !(sample.value >= 0 || std::isnan(sample.value))) {
+      return Status::Error("negative counter: " + line);
+    }
+    if (type_it->second == "histogram" &&
+        sample.name == family + "_bucket") {
+      auto le_it = sample.labels.find("le");
+      if (le_it == sample.labels.end()) {
+        return Status::Error("histogram bucket without le: " + line);
+      }
+      double le;
+      if (le_it->second == "+Inf") {
+        le = std::numeric_limits<double>::infinity();
+      } else {
+        char* end = nullptr;
+        le = std::strtod(le_it->second.c_str(), &end);
+        if (end == le_it->second.c_str() || *end != '\0') {
+          return Status::Error("unparseable le in: " + line);
+        }
+      }
+      std::string series = family + "|";
+      for (const auto& [k, v] : sample.labels) {
+        if (k != "le") series += k + "=" + v + ";";
+      }
+      auto last = last_bucket.find(series);
+      if (last != last_bucket.end()) {
+        if (le <= last_le[series]) {
+          return Status::Error("bucket le not increasing: " + line);
+        }
+        if (sample.value < last->second) {
+          return Status::Error("bucket counts not cumulative: " + line);
+        }
+      }
+      last_bucket[series] = sample.value;
+      last_le[series] = le;
+      if (std::isinf(le)) inf_bucket[series] = sample.value;
+    }
+    if (type_it->second == "histogram" && sample.name == family + "_count") {
+      std::string series = family + "|";
+      for (const auto& [k, v] : sample.labels) series += k + "=" + v + ";";
+      counts[series] = sample.value;
+    }
+  }
+  for (const auto& [series, count] : counts) {
+    auto it = inf_bucket.find(series);
+    if (it == inf_bucket.end()) {
+      return Status::Error("histogram series without +Inf bucket: " + series);
+    }
+    if (it->second != count) {
+      return Status::Error("+Inf bucket != _count for: " + series);
+    }
+  }
+  return Status::Ok();
+}
+
+}  // namespace obs
+}  // namespace tfd

@@ -1,0 +1,149 @@
+// In-process metrics registry + Prometheus text exposition, no deps.
+//
+// The reference daemon is opaque at runtime: operators pair it with a
+// separate dcgm-exporter for telemetry and infer liveness from pod logs.
+// This build makes the daemon itself scrapeable (ROADMAP north star:
+// per-node label-rewrite health for large fleets). The registry is sized
+// for a single-writer daemon: the main loop (and the PJRT watchdog, which
+// runs on the main thread) update instruments; the introspection server
+// thread (obs/server.h) renders Exposition() concurrently — all values
+// are atomics, so a scrape never blocks a labeling pass.
+//
+// Exposition follows the Prometheus text format (version 0.0.4): one
+// `# HELP`/`# TYPE` block per family, label values escaped (\\, \", \n),
+// histograms rendered as cumulative `_bucket{le=...}` series ending in
+// `+Inf` plus `_sum`/`_count`. Families and children render in
+// registration order, so output is deterministic — the same property the
+// label file has (sorted labels), and what the golden-style tests and
+// the CI metrics-lint rely on.
+#pragma once
+
+#include <atomic>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "tfd/util/status.h"
+
+namespace tfd {
+namespace obs {
+
+// Label set for one child of a metric family, in render order.
+using Labels = std::vector<std::pair<std::string, std::string>>;
+
+class Counter {
+ public:
+  void Inc(double v = 1.0);
+  double Value() const { return value_.load(std::memory_order_relaxed); }
+
+ private:
+  std::atomic<double> value_{0.0};
+};
+
+class Gauge {
+ public:
+  void Set(double v) { value_.store(v, std::memory_order_relaxed); }
+  double Value() const { return value_.load(std::memory_order_relaxed); }
+
+ private:
+  std::atomic<double> value_{0.0};
+};
+
+class Histogram {
+ public:
+  explicit Histogram(std::vector<double> upper_bounds);
+  // NaN observations are dropped (they would poison _sum forever and
+  // cannot be bucketed); +/-inf land in the +Inf bucket like any other
+  // out-of-range value.
+  void Observe(double v);
+
+  const std::vector<double>& upper_bounds() const { return upper_bounds_; }
+  // One coherent read of the whole histogram: cumulative counts per
+  // finite bucket plus the grand total (the +Inf bucket AND _count —
+  // derived from the same per-bucket snapshot, never from the separate
+  // count_ atomic, so a concurrent Observe can never yield exposition
+  // where +Inf != _count or buckets regress). Exposition() and the
+  // tests both read through this.
+  struct Snapshot {
+    std::vector<unsigned long long> cumulative;  // per finite bucket
+    unsigned long long total = 0;                // +Inf bucket == _count
+    double sum = 0;
+  };
+  Snapshot TakeSnapshot() const;
+  unsigned long long CumulativeCount(size_t i) const;
+  unsigned long long TotalCount() const {
+    return count_.load(std::memory_order_relaxed);
+  }
+  double Sum() const { return sum_.load(std::memory_order_relaxed); }
+
+ private:
+  std::vector<double> upper_bounds_;  // sorted, deduped, finite
+  std::vector<std::unique_ptr<std::atomic<unsigned long long>>> counts_;
+  std::atomic<unsigned long long> overflow_{0};  // > last bound (+Inf)
+  std::atomic<double> sum_{0.0};
+  std::atomic<unsigned long long> count_{0};
+};
+
+// Buckets sized for label-pass work: sub-millisecond file rewrites up to
+// multi-minute health execs (--health-exec-timeout default 240s).
+std::vector<double> DurationBuckets();
+
+// A family registry. Get* registers on first use and returns the same
+// instrument for the same (name, labels) thereafter, so call sites need
+// no setup phase — the daemon's hot loop just calls
+// Default().GetCounter("tfd_rewrites_total", ...)->Inc().
+//
+// Names are sanitized to the Prometheus grammar at registration
+// ([a-zA-Z_:][a-zA-Z0-9_:]* for metrics, no ':' for label names), so
+// Exposition() output is valid by construction regardless of input —
+// the property fuzz_metrics.cc leans on. A name registered as one type
+// and requested as another returns a detached instrument (never
+// rendered) instead of crashing or corrupting the family.
+class Registry {
+ public:
+  Registry();
+  ~Registry();  // out-of-line: Family/Child are incomplete here
+
+  Counter* GetCounter(const std::string& name, const std::string& help,
+                      const Labels& labels = {});
+  Gauge* GetGauge(const std::string& name, const std::string& help,
+                  const Labels& labels = {});
+  Histogram* GetHistogram(const std::string& name, const std::string& help,
+                          std::vector<double> upper_bounds,
+                          const Labels& labels = {});
+
+  // Renders every family in registration order.
+  std::string Exposition() const;
+
+ private:
+  struct Child;
+  struct Family;
+  Child* GetChild(const std::string& name, const std::string& help, int type,
+                  const Labels& labels,
+                  const std::vector<double>* upper_bounds);
+
+  mutable std::mutex mu_;
+  std::vector<std::unique_ptr<Family>> families_;
+  // Type-mismatch orphans: alive for the process, never rendered.
+  std::vector<std::unique_ptr<Counter>> orphan_counters_;
+  std::vector<std::unique_ptr<Gauge>> orphan_gauges_;
+  std::vector<std::unique_ptr<Histogram>> orphan_histograms_;
+};
+
+// The process-wide registry the daemon's instruments live in. Counters
+// survive SIGHUP config reloads (the introspection server restarts; the
+// registry does not), keeping scraped series monotone across reloads.
+Registry& Default();
+
+// Validates Prometheus text exposition: HELP/TYPE lines well-formed, every
+// sample matches the line grammar with a parseable value, samples only for
+// families with a declared TYPE, histogram buckets cumulative-monotone with
+// a +Inf bucket matching _count. Used by the unit tests, fuzz_metrics.cc
+// (as the oracle over Registry output), and the CI metrics-lint step (via
+// `tfd_unit_tests --validate-exposition <file>`).
+Status ValidateExposition(const std::string& text);
+
+}  // namespace obs
+}  // namespace tfd

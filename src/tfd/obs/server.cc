@@ -1,0 +1,340 @@
+#include "tfd/obs/server.h"
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <poll.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include "tfd/util/logging.h"
+#include "tfd/util/strings.h"
+
+namespace tfd {
+namespace obs {
+
+namespace {
+
+// Small fixed limits: the traffic model is kubelet probes + one scraper.
+constexpr int kMaxConns = 16;
+constexpr size_t kMaxRequestBytes = 8192;
+constexpr int kConnDeadlineS = 10;
+constexpr int kPollTickMs = 1000;
+
+std::string HttpResponse(int status, const std::string& reason,
+                         const std::string& content_type,
+                         const std::string& body,
+                         const std::string& extra_header = "") {
+  std::string out = "HTTP/1.1 " + std::to_string(status) + " " + reason +
+                    "\r\n";
+  out += "Content-Type: " + content_type + "\r\n";
+  out += "Content-Length: " + std::to_string(body.size()) + "\r\n";
+  if (!extra_header.empty()) out += extra_header + "\r\n";
+  out += "Connection: close\r\n\r\n";
+  out += body;
+  return out;
+}
+
+void SetNonBlockingCloexec(int fd) {
+  fcntl(fd, F_SETFL, fcntl(fd, F_GETFL, 0) | O_NONBLOCK);
+  fcntl(fd, F_SETFD, fcntl(fd, F_GETFD, 0) | FD_CLOEXEC);
+}
+
+}  // namespace
+
+Result<ListenAddr> ParseListenAddr(const std::string& text) {
+  ListenAddr out;
+  size_t colon = text.rfind(':');
+  if (colon == std::string::npos) {
+    return Result<ListenAddr>::Error(
+        "introspection address '" + text +
+        "' must be host:port (e.g. :8081 or 127.0.0.1:8081)");
+  }
+  out.host = text.substr(0, colon);
+  std::string port = text.substr(colon + 1);
+  int value = -1;
+  if (!ParseNonNegInt(port, &value) || value > 65535) {
+    return Result<ListenAddr>::Error("invalid introspection port '" + port +
+                                     "'");
+  }
+  out.port = value;
+  if (!out.host.empty()) {
+    in_addr addr{};
+    if (inet_pton(AF_INET, out.host.c_str(), &addr) != 1) {
+      return Result<ListenAddr>::Error(
+          "introspection host '" + out.host +
+          "' must be an IPv4 literal or empty (all interfaces)");
+    }
+  }
+  return out;
+}
+
+struct IntrospectionServer::Conn {
+  int fd = -1;
+  std::string in;
+  std::string out;
+  size_t out_off = 0;
+  std::chrono::steady_clock::time_point opened;
+  bool responding = false;
+};
+
+class IntrospectionServer::Impl {
+ public:
+  std::thread thread;
+  std::atomic<bool> stopping{false};
+
+  // /readyz state, written by the daemon thread via RecordRewrite.
+  std::mutex mu;
+  bool ever_succeeded = false;
+  bool last_ok = false;
+  std::chrono::steady_clock::time_point last_success;
+
+  std::vector<Conn> conns;
+};
+
+Result<std::unique_ptr<IntrospectionServer>> IntrospectionServer::Start(
+    const ServerOptions& options, Registry* registry) {
+  using R = Result<std::unique_ptr<IntrospectionServer>>;
+  Result<ListenAddr> addr = ParseListenAddr(options.addr);
+  if (!addr.ok()) return R::Error(addr.error());
+
+  int fd = socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0);
+  if (fd < 0) return R::Error(std::string("socket: ") + strerror(errno));
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+
+  sockaddr_in sa{};
+  sa.sin_family = AF_INET;
+  sa.sin_port = htons(static_cast<uint16_t>(addr->port));
+  if (addr->host.empty()) {
+    sa.sin_addr.s_addr = htonl(INADDR_ANY);
+  } else {
+    inet_pton(AF_INET, addr->host.c_str(), &sa.sin_addr);
+  }
+  if (bind(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) != 0) {
+    std::string err = strerror(errno);
+    close(fd);
+    return R::Error("bind " + options.addr + ": " + err);
+  }
+  if (listen(fd, 16) != 0) {
+    std::string err = strerror(errno);
+    close(fd);
+    return R::Error("listen " + options.addr + ": " + err);
+  }
+  SetNonBlockingCloexec(fd);
+
+  sockaddr_in bound{};
+  socklen_t bound_len = sizeof(bound);
+  getsockname(fd, reinterpret_cast<sockaddr*>(&bound), &bound_len);
+
+  auto server = std::unique_ptr<IntrospectionServer>(new IntrospectionServer());
+  server->registry_ = registry;
+  server->stale_after_s_ = options.stale_after_s;
+  server->listen_fd_ = fd;
+  server->port_ = ntohs(bound.sin_port);
+  if (pipe(server->wake_fds_) != 0) {
+    close(fd);
+    return R::Error(std::string("pipe: ") + strerror(errno));
+  }
+  SetNonBlockingCloexec(server->wake_fds_[0]);
+  SetNonBlockingCloexec(server->wake_fds_[1]);
+  server->impl_ = std::make_unique<Impl>();
+  IntrospectionServer* raw = server.get();
+  server->impl_->thread = std::thread([raw] { raw->Loop(); });
+  return server;
+}
+
+IntrospectionServer::~IntrospectionServer() { Stop(); }
+
+void IntrospectionServer::Stop() {
+  if (impl_ == nullptr) return;
+  if (!impl_->stopping.exchange(true)) {
+    // Wake the poll loop; a full pipe still wakes it (POLLIN is already
+    // pending), so the write result is irrelevant.
+    ssize_t ignored = write(wake_fds_[1], "x", 1);
+    (void)ignored;
+  }
+  if (impl_->thread.joinable()) impl_->thread.join();
+  for (Conn& conn : impl_->conns) {
+    if (conn.fd >= 0) close(conn.fd);
+  }
+  impl_->conns.clear();
+  if (listen_fd_ >= 0) close(listen_fd_);
+  listen_fd_ = -1;
+  for (int& fd : wake_fds_) {
+    if (fd >= 0) close(fd);
+    fd = -1;
+  }
+}
+
+void IntrospectionServer::RecordRewrite(bool ok) {
+  std::lock_guard<std::mutex> lock(impl_->mu);
+  impl_->last_ok = ok;
+  if (ok) {
+    impl_->ever_succeeded = true;
+    impl_->last_success = std::chrono::steady_clock::now();
+  }
+}
+
+void IntrospectionServer::HandleRequest(Conn* conn) {
+  conn->responding = true;
+  size_t line_end = conn->in.find("\r\n");
+  if (line_end == std::string::npos) line_end = conn->in.find('\n');
+  std::string request_line = conn->in.substr(0, line_end);
+  size_t sp1 = request_line.find(' ');
+  size_t sp2 = request_line.rfind(' ');
+  if (sp1 == std::string::npos || sp2 <= sp1) {
+    conn->out = HttpResponse(400, "Bad Request", "text/plain",
+                             "malformed request line\n");
+    return;
+  }
+  std::string method = request_line.substr(0, sp1);
+  std::string path = request_line.substr(sp1 + 1, sp2 - sp1 - 1);
+  size_t query = path.find('?');
+  if (query != std::string::npos) path = path.substr(0, query);
+
+  if (method != "GET") {
+    conn->out = HttpResponse(405, "Method Not Allowed", "text/plain",
+                             "only GET is served\n", "Allow: GET");
+    return;
+  }
+  if (path == "/healthz") {
+    conn->out = HttpResponse(200, "OK", "text/plain", "ok\n");
+  } else if (path == "/readyz") {
+    bool ready;
+    std::string why;
+    {
+      std::lock_guard<std::mutex> lock(impl_->mu);
+      if (!impl_->ever_succeeded) {
+        ready = false;
+        why = "no label rewrite has succeeded yet\n";
+      } else if (!impl_->last_ok) {
+        ready = false;
+        why = "last label rewrite failed\n";
+      } else {
+        auto age = std::chrono::steady_clock::now() - impl_->last_success;
+        ready = age <= std::chrono::seconds(stale_after_s_);
+        if (!ready) {
+          why = "last successful rewrite is older than " +
+                std::to_string(stale_after_s_) + "s\n";
+        }
+      }
+    }
+    conn->out = ready
+                    ? HttpResponse(200, "OK", "text/plain", "ready\n")
+                    : HttpResponse(503, "Service Unavailable", "text/plain",
+                                   why);
+  } else if (path == "/metrics") {
+    conn->out = HttpResponse(
+        200, "OK", "text/plain; version=0.0.4; charset=utf-8",
+        registry_->Exposition());
+  } else {
+    conn->out = HttpResponse(404, "Not Found", "text/plain",
+                             "serves /healthz, /readyz, /metrics\n");
+  }
+}
+
+void IntrospectionServer::Loop() {
+  std::vector<Conn>& conns = impl_->conns;
+  while (!impl_->stopping.load()) {
+    std::vector<pollfd> fds;
+    fds.push_back({wake_fds_[0], POLLIN, 0});
+    // Stop accepting while at the connection budget; pending peers wait
+    // in the listen backlog.
+    const bool accepting = conns.size() < kMaxConns;
+    if (accepting) {
+      fds.push_back({listen_fd_, POLLIN, 0});
+    }
+    for (Conn& conn : conns) {
+      fds.push_back({conn.fd,
+                     static_cast<short>(conn.responding ? POLLOUT : POLLIN),
+                     0});
+    }
+    int rc = poll(fds.data(), fds.size(), kPollTickMs);
+    if (impl_->stopping.load()) return;
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      TFD_LOG_WARNING << "introspection poll failed: " << strerror(errno)
+                      << "; server exiting";
+      return;
+    }
+
+    size_t idx = 1;
+    if (accepting) {
+      if (fds[idx].revents & POLLIN) {
+        while (true) {
+          int client = accept(listen_fd_, nullptr, nullptr);
+          if (client < 0) break;
+          SetNonBlockingCloexec(client);
+          Conn conn;
+          conn.fd = client;
+          conn.opened = std::chrono::steady_clock::now();
+          conns.push_back(std::move(conn));
+          if (conns.size() >= kMaxConns) break;
+        }
+      }
+      idx++;
+    }
+
+    auto now = std::chrono::steady_clock::now();
+    // fds[idx..] map 1:1 onto the conns present at poll time; conns
+    // accepted above have no pollfd yet and are skipped this round.
+    size_t polled = fds.size() - idx;
+    for (size_t c = 0; c < polled; c++, idx++) {
+      Conn& conn = conns[c];
+      bool drop = false;
+      if (fds[idx].revents & (POLLERR | POLLHUP | POLLNVAL)) {
+        drop = true;
+      } else if (!conn.responding && (fds[idx].revents & POLLIN)) {
+        char buf[2048];
+        ssize_t n = read(conn.fd, buf, sizeof(buf));
+        if (n <= 0) {
+          if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+            // spurious wakeup
+          } else {
+            drop = true;  // peer closed before a full request
+          }
+        } else {
+          conn.in.append(buf, static_cast<size_t>(n));
+          if (conn.in.size() > kMaxRequestBytes) {
+            conn.out = HttpResponse(431, "Request Header Fields Too Large",
+                                    "text/plain", "request too large\n");
+            conn.responding = true;
+          } else if (conn.in.find("\r\n\r\n") != std::string::npos ||
+                     conn.in.find("\n\n") != std::string::npos) {
+            HandleRequest(&conn);
+          }
+        }
+      } else if (conn.responding && (fds[idx].revents & POLLOUT)) {
+        ssize_t n = send(conn.fd, conn.out.data() + conn.out_off,
+                         conn.out.size() - conn.out_off, MSG_NOSIGNAL);
+        if (n < 0) {
+          if (errno != EAGAIN && errno != EWOULDBLOCK) drop = true;
+        } else {
+          conn.out_off += static_cast<size_t>(n);
+          if (conn.out_off >= conn.out.size()) drop = true;  // done
+        }
+      }
+      if (!drop &&
+          now - conn.opened > std::chrono::seconds(kConnDeadlineS)) {
+        drop = true;  // slowloris / dead peer
+      }
+      conn.fd = drop ? (close(conn.fd), -1) : conn.fd;
+    }
+    conns.erase(std::remove_if(conns.begin(), conns.end(),
+                               [](const Conn& c) { return c.fd < 0; }),
+                conns.end());
+  }
+}
+
+}  // namespace obs
+}  // namespace tfd

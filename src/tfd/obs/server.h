@@ -1,0 +1,83 @@
+// In-daemon introspection HTTP server: /healthz, /readyz, /metrics.
+//
+// A minimal single-threaded GET-only HTTP/1.1 server: one background
+// thread runs a poll(2) loop over the listen socket and a small fixed
+// budget of connections (the idiom mirror of util/http.cc's client —
+// hand-rolled, zero link deps). Kubelet probes and a Prometheus scrape
+// are its whole traffic model: tiny requests, tiny responses, loopback
+// or pod-network peers.
+//
+// Lifecycle is SIGHUP-safe by construction: the daemon creates the
+// server after each config load and destroys it (Stop joins the thread
+// and closes the socket) before reloading, so an addr change via SIGHUP
+// rebinds cleanly (SO_REUSEADDR covers the TIME_WAIT window). The
+// registry it renders lives in obs::Default() and survives reloads, so
+// scraped counters stay monotone across SIGHUP.
+//
+// Readiness contract (/readyz): 200 iff the LAST label rewrite succeeded
+// AND its success is fresher than `stale_after_s` (the daemon wires
+// 2 x sleep-interval, widened by the health-exec budget when
+// --device-health=full legitimately blocks a pass); everything else —
+// never rewrote, last rewrite failed, rewrites stale — is 503, so a
+// wedged or erroring daemon drops out of service without dying.
+#pragma once
+
+#include <memory>
+#include <string>
+
+#include "tfd/obs/metrics.h"
+#include "tfd/util/status.h"
+
+namespace tfd {
+namespace obs {
+
+// Parses a listen address "host:port" (empty host = all interfaces, e.g.
+// ":8081"; host must be an IPv4 literal otherwise). Port 0 binds an
+// ephemeral port (tests). Exposed for config validation and unit tests.
+struct ListenAddr {
+  std::string host;  // "" = INADDR_ANY
+  int port = 0;
+};
+Result<ListenAddr> ParseListenAddr(const std::string& text);
+
+struct ServerOptions {
+  std::string addr;        // "host:port" per ParseListenAddr
+  int stale_after_s = 120; // /readyz freshness window
+};
+
+class IntrospectionServer {
+ public:
+  ~IntrospectionServer();
+
+  // Binds, listens, and starts the serving thread. The registry must
+  // outlive the server (the daemon passes obs::Default()).
+  static Result<std::unique_ptr<IntrospectionServer>> Start(
+      const ServerOptions& options, Registry* registry);
+
+  // Joins the serving thread and closes every socket. Idempotent.
+  void Stop();
+
+  // The bound port (resolves :0 for tests).
+  int port() const { return port_; }
+
+  // Called by the daemon loop after every rewrite attempt; drives /readyz.
+  void RecordRewrite(bool ok);
+
+ private:
+  IntrospectionServer() = default;
+  void Loop();
+  struct Conn;
+  // Serves one fully-read request, filling the conn's output buffer.
+  void HandleRequest(Conn* conn);
+
+  Registry* registry_ = nullptr;
+  int stale_after_s_ = 120;
+  int listen_fd_ = -1;
+  int wake_fds_[2] = {-1, -1};  // self-pipe: Stop() wakes the poll loop
+  int port_ = 0;
+  class Impl;
+  std::unique_ptr<Impl> impl_;
+};
+
+}  // namespace obs
+}  // namespace tfd

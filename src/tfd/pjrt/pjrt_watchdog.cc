@@ -38,6 +38,7 @@
 #include <cstring>
 
 #include "tfd/gce/metadata.h"
+#include "tfd/obs/metrics.h"
 #include "tfd/platform/detect.h"
 #include "tfd/resource/factory.h"
 #include "tfd/slice/topology.h"
@@ -546,6 +547,14 @@ class PjrtWatchdogManager : public Manager {
         flags_.pjrt_init_timeout_s, "PJRT init probe", &exit_code);
     if (!out.ok()) {
       // Deadline expiry lands here: the child was SIGKILLed.
+      // Deadline SIGKILLs, fork/pipe failures, and output overflow all
+      // land here; trips are the fleet signal a wedged libtpu leaves
+      // behind (the fallback chain hides it from the labels themselves).
+      obs::Default()
+          .GetCounter("tfd_pjrt_watchdog_trips_total",
+                      "PJRT init probes that did not complete (deadline "
+                      "SIGKILL or probe I/O failure).")
+          ->Inc();
       return Status::Error("PJRT init did not complete: " + out.error());
     }
 

@@ -1,0 +1,83 @@
+// libFuzzer target for the metrics registry + exposition path
+// (src/tfd/obs/metrics.cc). The input is interpreted as a little metric
+// program — one instrument op per line, `kind;name;label-key;label-val;
+// value` — driven against a fresh Registry; the oracle is the registry's
+// own contract: whatever hostile names/labels/values went in, Exposition()
+// must render VALID Prometheus text (ValidateExposition, the same checker
+// the unit tests and the CI metrics-lint step run). See fuzz_yamllite.cc
+// for the engine/driver arrangement.
+#include <cstddef>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+#include <vector>
+
+#include "tfd/obs/metrics.h"
+
+namespace {
+
+std::vector<std::string> SplitLine(const std::string& line) {
+  std::vector<std::string> fields;
+  size_t start = 0;
+  while (fields.size() < 4) {
+    size_t semi = line.find(';', start);
+    if (semi == std::string::npos) break;
+    fields.push_back(line.substr(start, semi - start));
+    start = semi + 1;
+  }
+  fields.push_back(line.substr(start));
+  return fields;
+}
+
+}  // namespace
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
+  std::string text(reinterpret_cast<const char*>(data), size);
+  tfd::obs::Registry registry;
+
+  size_t pos = 0;
+  int ops = 0;
+  while (pos < text.size() && ops < 256) {
+    size_t eol = text.find('\n', pos);
+    if (eol == std::string::npos) eol = text.size();
+    std::string line = text.substr(pos, eol - pos);
+    pos = eol + 1;
+    if (line.empty()) continue;
+    ops++;
+
+    std::vector<std::string> f = SplitLine(line);
+    char kind = f[0].empty() ? 'c' : f[0][0];
+    std::string name = f.size() > 1 ? f[1] : "m";
+    tfd::obs::Labels labels;
+    if (f.size() > 3 && !f[2].empty()) labels.push_back({f[2], f[3]});
+    double value = f.size() > 4 ? std::strtod(f[4].c_str(), nullptr) : 1.0;
+
+    switch (kind) {
+      case 'g':
+        registry.GetGauge(name, "fuzzed gauge " + name, labels)->Set(value);
+        break;
+      case 'h': {
+        // Bucket bounds derived from the value keep the shape diverse
+        // (including degenerate negative/duplicate bounds).
+        std::vector<double> bounds = {value, value * 2, 1.0, 1.0, -value};
+        registry.GetHistogram(name, "fuzzed histogram " + name, bounds,
+                              labels)->Observe(value);
+        break;
+      }
+      default:
+        registry.GetCounter(name, "fuzzed counter " + name, labels)
+            ->Inc(value);
+        break;
+    }
+  }
+
+  std::string exposition = registry.Exposition();
+  tfd::Status valid = tfd::obs::ValidateExposition(exposition);
+  if (!valid.ok()) {
+    fprintf(stderr, "registry rendered invalid exposition: %s\n---\n%s---\n",
+            valid.message().c_str(), exposition.c_str());
+    abort();
+  }
+  return 0;
+}

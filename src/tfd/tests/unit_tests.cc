@@ -25,6 +25,8 @@
 #include "tfd/lm/schema.h"
 #include "tfd/lm/slice_strategy.h"
 #include "tfd/lm/tpu_labeler.h"
+#include "tfd/obs/metrics.h"
+#include "tfd/obs/server.h"
 #include "tfd/pjrt/pjrt_binding.h"
 #include "tfd/platform/detect.h"
 #include "tfd/resource/types.h"
@@ -969,10 +971,276 @@ void TestMetadataErrorKinds() {
   waitpid(pid, &status, 0);
 }
 
+// ---- obs: metrics registry + exposition + introspection server ----------
+
+void TestMetricsRegistry() {
+  obs::Registry reg;
+  obs::Counter* c = reg.GetCounter("tfd_test_total", "help text");
+  c->Inc();
+  c->Inc(2.5);
+  CHECK_EQ(c->Value(), 3.5);
+  // Same (name, labels) -> same instrument.
+  CHECK_TRUE(reg.GetCounter("tfd_test_total", "help text") == c);
+  // Counters never go down, and NaN increments are dropped.
+  c->Inc(-5);
+  c->Inc(std::numeric_limits<double>::quiet_NaN());
+  CHECK_EQ(c->Value(), 3.5);
+
+  obs::Gauge* g = reg.GetGauge("tfd_test_gauge", "a gauge");
+  g->Set(42);
+  g->Set(-1.5);
+  CHECK_EQ(g->Value(), -1.5);
+
+  // Type mismatch on a registered name: a detached instrument, never a
+  // crash or a corrupted family.
+  obs::Gauge* orphan = reg.GetGauge("tfd_test_total", "not a counter");
+  orphan->Set(99);
+  CHECK_EQ(c->Value(), 3.5);
+
+  std::string text = reg.Exposition();
+  CHECK_TRUE(text.find("# HELP tfd_test_total help text\n") !=
+             std::string::npos);
+  CHECK_TRUE(text.find("# TYPE tfd_test_total counter\n") !=
+             std::string::npos);
+  CHECK_TRUE(text.find("tfd_test_total 3.5\n") != std::string::npos);
+  CHECK_TRUE(text.find("99") == std::string::npos);  // orphan not rendered
+  CHECK_TRUE(obs::ValidateExposition(text).ok());
+
+  // Two children of one family render under ONE HELP/TYPE block.
+  reg.GetCounter("tfd_multi", "multi", {{"k", "a"}})->Inc();
+  reg.GetCounter("tfd_multi", "multi", {{"k", "b"}})->Inc();
+  text = reg.Exposition();
+  size_t first = text.find("# TYPE tfd_multi counter");
+  CHECK_TRUE(first != std::string::npos);
+  CHECK_TRUE(text.find("# TYPE tfd_multi counter", first + 1) ==
+             std::string::npos);
+  CHECK_TRUE(text.find("tfd_multi{k=\"a\"} 1\n") != std::string::npos);
+  CHECK_TRUE(text.find("tfd_multi{k=\"b\"} 1\n") != std::string::npos);
+  CHECK_TRUE(obs::ValidateExposition(text).ok());
+}
+
+void TestMetricsEscaping() {
+  obs::Registry reg;
+  reg.GetGauge("tfd_escape", "help with \\ backslash\nand newline",
+               {{"path", "a\\b \"quoted\"\nnext"}})
+      ->Set(1);
+  std::string text = reg.Exposition();
+  CHECK_TRUE(text.find("help with \\\\ backslash\\nand newline") !=
+             std::string::npos);
+  CHECK_TRUE(text.find("{path=\"a\\\\b \\\"quoted\\\"\\nnext\"}") !=
+             std::string::npos);
+  CHECK_TRUE(obs::ValidateExposition(text).ok());
+
+  // Hostile names sanitize into the Prometheus grammar instead of
+  // producing an unscrapeable page.
+  reg.GetCounter("9bad name!", "x", {{"bad key", "v"}})->Inc();
+  CHECK_TRUE(obs::ValidateExposition(reg.Exposition()).ok());
+  CHECK_TRUE(reg.Exposition().find("_9bad_name_") != std::string::npos);
+}
+
+void TestMetricsHistogram() {
+  obs::Registry reg;
+  obs::Histogram* h = reg.GetHistogram("tfd_lat_seconds", "latency",
+                                       {0.01, 0.1, 1.0}, {{"op", "x"}});
+  h->Observe(0.005);
+  h->Observe(0.05);
+  h->Observe(0.5);
+  h->Observe(5.0);            // above the last bound -> +Inf only
+  h->Observe(0.1);            // exactly on a bound counts into it
+  h->Observe(std::numeric_limits<double>::quiet_NaN());  // dropped
+  CHECK_EQ(h->TotalCount(), 5ULL);
+  CHECK_EQ(h->CumulativeCount(0), 1ULL);
+  CHECK_EQ(h->CumulativeCount(1), 3ULL);
+  CHECK_EQ(h->CumulativeCount(2), 4ULL);
+
+  std::string text = reg.Exposition();
+  CHECK_TRUE(text.find("# TYPE tfd_lat_seconds histogram\n") !=
+             std::string::npos);
+  CHECK_TRUE(text.find(
+                 "tfd_lat_seconds_bucket{op=\"x\",le=\"0.01\"} 1\n") !=
+             std::string::npos);
+  CHECK_TRUE(text.find("tfd_lat_seconds_bucket{op=\"x\",le=\"+Inf\"} 5\n") !=
+             std::string::npos);
+  CHECK_TRUE(text.find("tfd_lat_seconds_count{op=\"x\"} 5\n") !=
+             std::string::npos);
+  CHECK_TRUE(obs::ValidateExposition(text).ok());
+
+  // A caller-supplied `le` label cannot collide with the generated one.
+  reg.GetHistogram("tfd_le_clash", "x", {1.0}, {{"le", "evil"}})
+      ->Observe(0.5);
+  CHECK_TRUE(obs::ValidateExposition(reg.Exposition()).ok());
+  CHECK_TRUE(reg.Exposition().find("exported_le=\"evil\"") !=
+             std::string::npos);
+
+  // Unsorted/duplicate/non-finite bounds are repaired at construction.
+  obs::Histogram* odd = reg.GetHistogram(
+      "tfd_odd", "x",
+      {5.0, 1.0, 1.0, std::numeric_limits<double>::infinity()});
+  odd->Observe(3.0);
+  CHECK_EQ(odd->upper_bounds().size(), 2ULL);
+  CHECK_TRUE(obs::ValidateExposition(reg.Exposition()).ok());
+
+  // Sample-name collisions are renamed away at registration: a counter
+  // named like the histogram's generated _bucket series would emit
+  // ambiguous lines, so it registers under a trailing-underscore name —
+  // and repeat registration lands on the SAME instrument.
+  obs::Counter* clash = reg.GetCounter("tfd_lat_seconds_bucket", "clash");
+  clash->Inc();
+  CHECK_TRUE(reg.GetCounter("tfd_lat_seconds_bucket", "clash") == clash);
+  std::string collided = reg.Exposition();
+  CHECK_TRUE(collided.find("# TYPE tfd_lat_seconds_bucket_ counter") !=
+             std::string::npos);
+  CHECK_TRUE(obs::ValidateExposition(collided).ok());
+  // And the reverse: a new histogram whose generated names would hit an
+  // existing plain family gets renamed too.
+  reg.GetCounter("tfd_plain_sum", "plain")->Inc();
+  reg.GetHistogram("tfd_plain", "h", {1.0})->Observe(0.5);
+  CHECK_TRUE(obs::ValidateExposition(reg.Exposition()).ok());
+  CHECK_TRUE(reg.Exposition().find("tfd_plain__bucket") !=
+             std::string::npos);
+}
+
+void TestValidateExposition() {
+  // The checker must bite: hand-made invalid documents are rejected.
+  CHECK_TRUE(!obs::ValidateExposition("no trailing newline").ok());
+  CHECK_TRUE(!obs::ValidateExposition("orphan_sample 1\n").ok());
+  CHECK_TRUE(
+      !obs::ValidateExposition("# TYPE m counter\nm{x=\"a\",x=\"b\"} 1\n")
+           .ok());
+  CHECK_TRUE(!obs::ValidateExposition("# TYPE m counter\nm -1\n").ok());
+  CHECK_TRUE(!obs::ValidateExposition("# TYPE m counter\nm notanum\n").ok());
+  CHECK_TRUE(!obs::ValidateExposition("# TYPE m bogus\nm 1\n").ok());
+  CHECK_TRUE(
+      !obs::ValidateExposition("# TYPE m counter\n# TYPE m counter\nm 1\n")
+           .ok());
+  // Histogram invariants: monotone buckets, +Inf present and == _count.
+  CHECK_TRUE(!obs::ValidateExposition(
+                  "# TYPE h histogram\n"
+                  "h_bucket{le=\"1\"} 5\nh_bucket{le=\"2\"} 3\n"
+                  "h_bucket{le=\"+Inf\"} 5\nh_sum 1\nh_count 5\n")
+                  .ok());
+  CHECK_TRUE(!obs::ValidateExposition(
+                  "# TYPE h histogram\n"
+                  "h_bucket{le=\"1\"} 1\nh_sum 1\nh_count 1\n")
+                  .ok());
+  CHECK_TRUE(!obs::ValidateExposition(
+                  "# TYPE h histogram\n"
+                  "h_bucket{le=\"1\"} 1\nh_bucket{le=\"+Inf\"} 2\n"
+                  "h_sum 1\nh_count 3\n")
+                  .ok());
+  // And a well-formed document passes.
+  CHECK_TRUE(obs::ValidateExposition(
+                 "# HELP h some text\n# TYPE h histogram\n"
+                 "h_bucket{le=\"1\"} 1\nh_bucket{le=\"+Inf\"} 2\n"
+                 "h_sum 1.5\nh_count 2\n"
+                 "# TYPE c counter\nc{a=\"b\"} 0\n")
+                 .ok());
+  // Exact-named families win over histogram suffix attribution: a
+  // standalone counter that happens to be called x_bucket needs no le.
+  CHECK_TRUE(obs::ValidateExposition(
+                 "# TYPE x_bucket counter\nx_bucket 3\n")
+                 .ok());
+}
+
+void TestListenAddrParse() {
+  Result<obs::ListenAddr> a = obs::ParseListenAddr(":8081");
+  CHECK_TRUE(a.ok());
+  CHECK_EQ(a->host, "");
+  CHECK_EQ(a->port, 8081);
+  a = obs::ParseListenAddr("127.0.0.1:9");
+  CHECK_TRUE(a.ok());
+  CHECK_EQ(a->host, "127.0.0.1");
+  CHECK_EQ(a->port, 9);
+  a = obs::ParseListenAddr("127.0.0.1:0");  // ephemeral (tests)
+  CHECK_TRUE(a.ok());
+  CHECK_TRUE(!obs::ParseListenAddr("").ok());
+  CHECK_TRUE(!obs::ParseListenAddr("8081").ok());
+  CHECK_TRUE(!obs::ParseListenAddr(":huh").ok());
+  CHECK_TRUE(!obs::ParseListenAddr(":70000").ok());
+  CHECK_TRUE(!obs::ParseListenAddr("metadata.google.internal:1").ok());
+}
+
+void TestIntrospectionServer() {
+  obs::Registry reg;
+  reg.GetCounter("tfd_e2e_total", "served over http")->Inc(7);
+
+  obs::ServerOptions options;
+  options.addr = "127.0.0.1:0";
+  options.stale_after_s = 1;
+  Result<std::unique_ptr<obs::IntrospectionServer>> server =
+      obs::IntrospectionServer::Start(options, &reg);
+  CHECK_TRUE(server.ok());
+  std::string base =
+      "http://127.0.0.1:" + std::to_string((*server)->port());
+  http::RequestOptions ropt;
+  ropt.timeout_ms = 3000;
+
+  Result<http::Response> r = http::Request("GET", base + "/healthz", "", ropt);
+  CHECK_TRUE(r.ok());
+  CHECK_EQ(r->status, 200);
+  CHECK_EQ(r->body, "ok\n");
+
+  // Not ready before the first successful rewrite; ready after; not
+  // ready again once the last success is older than stale_after_s.
+  r = http::Request("GET", base + "/readyz", "", ropt);
+  CHECK_TRUE(r.ok());
+  CHECK_EQ(r->status, 503);
+  (*server)->RecordRewrite(true);
+  r = http::Request("GET", base + "/readyz", "", ropt);
+  CHECK_TRUE(r.ok());
+  CHECK_EQ(r->status, 200);
+  (*server)->RecordRewrite(false);  // last rewrite failed -> 503 instantly
+  r = http::Request("GET", base + "/readyz", "", ropt);
+  CHECK_TRUE(r.ok());
+  CHECK_EQ(r->status, 503);
+  (*server)->RecordRewrite(true);
+  usleep(1300 * 1000);  // stale_after_s = 1
+  r = http::Request("GET", base + "/readyz", "", ropt);
+  CHECK_TRUE(r.ok());
+  CHECK_EQ(r->status, 503);
+
+  r = http::Request("GET", base + "/metrics", "", ropt);
+  CHECK_TRUE(r.ok());
+  CHECK_EQ(r->status, 200);
+  CHECK_TRUE(r->body.find("tfd_e2e_total 7\n") != std::string::npos);
+  CHECK_TRUE(obs::ValidateExposition(r->body).ok());
+
+  r = http::Request("GET", base + "/nope", "", ropt);
+  CHECK_TRUE(r.ok());
+  CHECK_EQ(r->status, 404);
+  r = http::Request("POST", base + "/metrics", "x", ropt);
+  CHECK_TRUE(r.ok());
+  CHECK_EQ(r->status, 405);
+
+  (*server)->Stop();
+  // Stopped server: connection refused, and Stop is idempotent.
+  r = http::Request("GET", base + "/healthz", "", ropt);
+  CHECK_TRUE(!r.ok());
+  (*server)->Stop();
+}
+
 }  // namespace
 }  // namespace tfd
 
-int main() {
+int main(int argc, char** argv) {
+  // Exposition-checker mode for CI's metrics-lint step: validate a scraped
+  // /metrics document with the same checker the unit tests assert with.
+  if (argc == 3 && std::string(argv[1]) == "--validate-exposition") {
+    std::ifstream in(argv[2]);
+    if (!in) {
+      std::cerr << "cannot open " << argv[2] << std::endl;
+      return 2;
+    }
+    std::string text((std::istreambuf_iterator<char>(in)),
+                     std::istreambuf_iterator<char>());
+    tfd::Status s = tfd::obs::ValidateExposition(text);
+    if (!s.ok()) {
+      std::cerr << "invalid exposition: " << s.message() << std::endl;
+      return 1;
+    }
+    std::cerr << "exposition OK (" << text.size() << " bytes)" << std::endl;
+    return 0;
+  }
   tfd::TestStrings();
   tfd::TestYamlLite();
   tfd::TestShapeGrammar();
@@ -1001,6 +1269,12 @@ int main() {
   tfd::TestGkeIdentity();
   tfd::TestForkedCapture();
   tfd::TestMetadataErrorKinds();
+  tfd::TestMetricsRegistry();
+  tfd::TestMetricsEscaping();
+  tfd::TestMetricsHistogram();
+  tfd::TestValidateExposition();
+  tfd::TestListenAddrParse();
+  tfd::TestIntrospectionServer();
 
   std::cerr << tfd::g_checks << " checks, " << tfd::g_failures << " failures"
             << std::endl;

@@ -12,6 +12,7 @@ JAX-based tests (tpufd package) run on a virtual 8-device CPU mesh.
 """
 
 import os
+import shutil
 import subprocess
 import sys
 from pathlib import Path
@@ -56,7 +57,59 @@ def cpu_jax():
     return jax
 
 
+def _gxx_build():
+    """Plain-g++ fallback for environments without cmake/ninja: compiles
+    the tfd_core source list straight out of CMakeLists.txt and links the
+    same artifacts the CMake build produces (daemon, unit tests, fake
+    PJRT plugin, standalone-driver fuzzers)."""
+    import re
+    import shutil
+
+    obj_dir = BUILD_DIR / "obj"
+    obj_dir.mkdir(parents=True, exist_ok=True)
+    version = (REPO / "VERSION").read_text().strip()
+    commit = subprocess.run(
+        ["git", "rev-parse", "--short", "HEAD"], cwd=REPO,
+        capture_output=True, text=True).stdout.strip() or "unknown"
+    common = ["g++", "-std=c++17", "-O1", f"-I{REPO}/src",
+              f"-I{REPO}/third_party"]
+    defines = [f"-DTFD_VERSION=\"{version}\"",
+               f"-DTFD_GIT_COMMIT=\"{commit}\""]
+    cmake_text = (REPO / "CMakeLists.txt").read_text()
+    core_sources = re.findall(r"^\s+(src/tfd/\S+\.cc)$", cmake_text,
+                              re.MULTILINE)
+    core_sources = [s for s in core_sources
+                    if "tests/" not in s and "testing/" not in s]
+    objects = []
+    for src in core_sources:
+        obj = obj_dir / (src.replace("/", "_") + ".o")
+        objects.append(str(obj))
+        subprocess.run([*common, *defines, "-c", str(REPO / src),
+                        "-o", str(obj)], check=True, capture_output=True)
+    link = ["-ldl", "-lpthread"]
+    subprocess.run([*common, *defines,
+                    str(REPO / "cmd/tpu-feature-discovery/main.cc"),
+                    *objects, "-o", str(BINARY), *link],
+                   check=True, capture_output=True)
+    subprocess.run([*common, str(REPO / "src/tfd/tests/unit_tests.cc"),
+                    *objects, "-o", str(UNIT_TESTS), *link],
+                   check=True, capture_output=True)
+    subprocess.run([*common, "-shared", "-fPIC",
+                    str(REPO / "src/tfd/testing/fake_pjrt.cc"),
+                    "-o", str(BUILD_DIR / "libtfd_fake_pjrt.so")],
+                   check=True, capture_output=True)
+    driver = REPO / "src/tfd/tests/fuzz/standalone_driver.cc"
+    for target in sorted(set(re.findall(r"\bfuzz_[a-z]+\b", cmake_text))):
+        subprocess.run(
+            [*common, str(REPO / f"src/tfd/tests/fuzz/{target}.cc"),
+             str(driver), *objects, "-o", str(BUILD_DIR / target), *link],
+            check=True, capture_output=True)
+
+
 def _build():
+    if shutil.which("cmake") is None or shutil.which("ninja") is None:
+        _gxx_build()
+        return
     subprocess.run(
         ["cmake", "-S", str(REPO), "-B", str(BUILD_DIR), "-G", "Ninja"],
         check=True, capture_output=True)

@@ -486,6 +486,7 @@ def test_device_health_full_real_probe_feature_file(tfd_binary, tmp_path):
     the virtual CPU mesh) and the measured labels land in the NFD feature
     file — the full capability end-to-end, no TPU required."""
     out_file = tmp_path / "tfd"
+    metrics_out = tmp_path / "probe.prom"
     env = {
         "JAX_PLATFORMS": "cpu",
         "PYTHONPATH": str(Path(__file__).resolve().parent.parent),
@@ -494,13 +495,27 @@ def test_device_health_full_real_probe_feature_file(tfd_binary, tmp_path):
         [str(tfd_binary), "--oneshot", f"--output-file={out_file}",
          "--backend=mock", f"--mock-topology-file={FIXTURES / 'v2-8.yaml'}",
          "--machine-type-file=/dev/null", "--device-health=full",
-         "--health-exec=python3 -m tpufd health"],
+         f"--health-exec=python3 -m tpufd health "
+         f"--metrics-out {metrics_out}"],
         env={**os.environ, **env,
              "GCE_METADATA_HOST": "127.0.0.1:1"},
         capture_output=True, text=True, timeout=300)
     assert proc.returncode == 0, proc.stderr
     labels = labels_of(out_file.read_text())
     assert labels["google.com/tpu.health.ok"] == "true"
+    # --metrics-out rode along: valid exposition carrying the per-probe
+    # timing telemetry for the probes that just published labels.
+    from tpufd import metrics as tpufd_metrics
+
+    probe_text = metrics_out.read_text()
+    tpufd_metrics.validate_exposition(probe_text)
+    assert tpufd_metrics.sample_value(
+        probe_text, "tpufd_probe_duration_seconds_count",
+        labels={"probe": "matmul-tflops"}) >= 1
+    assert tpufd_metrics.sample_value(
+        probe_text, "tpufd_probe_duration_seconds_count",
+        labels={"probe": "hbm-gbps"}) >= 1
+    assert tpufd_metrics.sample_value(probe_text, "tpufd_health_ok") == 1
     # A loaded CPU host can measure arbitrarily low, but sub-10 values
     # publish with two significant digits, so a real measurement is
     # always a positive float; on TPU bench.py asserts real magnitudes.

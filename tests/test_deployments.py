@@ -65,6 +65,17 @@ class TestStaticYamls:
         assert "google.com/tpu.present" in keys
         assert any(t["key"] == "google.com/tpu"
                    for t in spec["tolerations"])
+        # Introspection server wiring: named containerPort + kubelet
+        # probes against the daemon's own /healthz//readyz, matching the
+        # TFD_INTROSPECTION_ADDR env.
+        env = {e["name"]: e.get("value") for e in container["env"]}
+        port = int(env["TFD_INTROSPECTION_ADDR"].rsplit(":", 1)[1])
+        ports = {p["name"]: p for p in container["ports"]}
+        assert ports["introspection"]["containerPort"] == port
+        assert (container["livenessProbe"]["httpGet"]
+                == {"path": "/healthz", "port": "introspection"})
+        assert (container["readinessProbe"]["httpGet"]
+                == {"path": "/readyz", "port": "introspection"})
 
     def test_job_template(self):
         text = (STATIC / "tpu-feature-discovery-job.yaml.template"
@@ -117,6 +128,20 @@ class TestHelmChart:
         assert values["securityContext"]["capabilities"]["drop"] == ["ALL"]
         assert values["nfd"]["master"]["config"]["extraLabelNs"] == [
             "google.com"]
+        assert values["introspection"]["enabled"] is True
+        assert 1 <= values["introspection"]["port"] <= 65535
+
+    def test_helm_daemonset_wires_introspection(self):
+        """The chart must wire the introspection addr env, a named
+        containerPort, and both kubelet probes, all gated on
+        .Values.introspection.enabled."""
+        template = (HELM / "templates" / "daemonset.yml").read_text()
+        assert "TFD_INTROSPECTION_ADDR" in template
+        assert ".Values.introspection.enabled" in template
+        assert ".Values.introspection.port" in template
+        assert "livenessProbe" in template and "/healthz" in template
+        assert "readinessProbe" in template and "/readyz" in template
+        assert "name: introspection" in template
 
     def test_burnin_test_hook(self):
         """`helm test` must run the slice burn-in: hook annotation, -full

@@ -1,0 +1,333 @@
+"""Tier 2: the in-daemon introspection server (src/tfd/obs/) against the
+real binary — /metrics exposition validity and content, /healthz,
+/readyz lifecycle (including the flip to 503 when rewrites start
+failing), flag gating, and the soak harness's scrape path."""
+
+import os
+import signal
+import socket
+import subprocess
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from conftest import FIXTURES, run_tfd
+from tpufd import metrics
+from tpufd.fakes import free_loopback_port as free_port
+
+SOAK = Path(__file__).resolve().parent.parent / "scripts" / "soak.py"
+
+
+def http_get(port, path, timeout=2):
+    """(status, body); (None, "") while the server is unreachable —
+    polling callers ride through startup and SIGHUP-rebind windows."""
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+    except (OSError, urllib.error.URLError):
+        return None, ""
+
+
+def wait_for(predicate, timeout=15, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def daemon_argv(binary, port, out_file, extra=()):
+    return [str(binary), "--sleep-interval=1s", "--backend=mock",
+            f"--mock-topology-file={FIXTURES / 'v2-8.yaml'}",
+            "--machine-type-file=/dev/null",
+            f"--output-file={out_file}",
+            f"--introspection-addr=127.0.0.1:{port}", *extra]
+
+
+@pytest.fixture
+def daemon(tfd_binary, tmp_path):
+    """A running daemon (mock backend, 1s interval) with the
+    introspection server on an ephemeral loopback port."""
+    port = free_port()
+    out_file = tmp_path / "tfd"
+    proc = subprocess.Popen(
+        daemon_argv(tfd_binary, port, out_file),
+        env={**os.environ, "GCE_METADATA_HOST": "127.0.0.1:1"},
+        stderr=subprocess.PIPE)
+    try:
+        assert wait_for(lambda: out_file.exists()), "first pass never ran"
+        yield port
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=10)
+
+
+class TestEndpoints:
+    def test_healthz(self, daemon):
+        status, body = http_get(daemon, "/healthz")
+        assert status == 200 and body == "ok\n"
+
+    def test_readyz_ready_after_first_pass(self, daemon):
+        assert wait_for(lambda: http_get(daemon, "/readyz")[0] == 200)
+
+    def test_metrics_valid_and_complete(self, daemon):
+        # Let a couple of passes land so counters/histograms have data.
+        assert wait_for(lambda: (metrics.sample_value(
+            http_get(daemon, "/metrics")[1], "tfd_rewrites_total")
+            or 0) >= 2)
+        status, text = http_get(daemon, "/metrics")
+        assert status == 200
+        metrics.validate_exposition(text)  # raises on any format violation
+        assert metrics.sample_value(text, "tfd_rewrites_total") >= 2
+        assert metrics.sample_value(text, "tfd_rewrite_failures_total") in (
+            None, 0)
+        assert metrics.sample_value(text, "tfd_labels_emitted") > 0
+        now = time.time()
+        ts = metrics.sample_value(text, "tfd_last_rewrite_timestamp_seconds")
+        assert now - 120 < ts <= now + 5
+        assert metrics.sample_value(text, "tfd_config_generation") == 1
+        # Per-labeler histogram: every labeler in the merge pipeline.
+        for labeler in ("timestamp", "machine-type", "tpu", "tpu-vm"):
+            assert metrics.sample_value(
+                text, "tfd_labeler_duration_seconds_count",
+                labels={"labeler": labeler}) >= 2, labeler
+        # Per-backend histogram names the backend actually used.
+        assert metrics.sample_value(
+            text, "tfd_backend_duration_seconds_count",
+            labels={"backend": "mock"}) >= 2
+
+    def test_unknown_path_and_method(self, daemon):
+        assert http_get(daemon, "/nope")[0] == 404
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{daemon}/metrics", data=b"x", method="POST")
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req, timeout=2)
+        assert err.value.code == 405
+
+
+def test_readyz_flips_on_rewrite_failures(tfd_binary, tmp_path):
+    """The readiness contract end to end: a daemon publishing NodeFeature
+    CRs goes ready after its first successful rewrite, then flips /readyz
+    to 503 once an injected apiserver outage makes rewrites fail (the
+    daemon itself stays alive — 5xx is transient — and /healthz stays
+    200), and recovers to 200 when the outage ends."""
+    from tpufd.fakes.apiserver import FakeApiServer
+
+    port = free_port()
+    sa = tmp_path / "sa"
+    sa.mkdir()
+    (sa / "namespace").write_text("node-feature-discovery\n")
+    (sa / "token").write_text("introspect-token\n")
+    with FakeApiServer(token="introspect-token") as server:
+        proc = subprocess.Popen(
+            [str(tfd_binary), "--sleep-interval=1s", "--backend=mock",
+             f"--mock-topology-file={FIXTURES / 'v2-8.yaml'}",
+             "--machine-type-file=/dev/null", "--use-node-feature-api",
+             "--output-file=",
+             f"--introspection-addr=127.0.0.1:{port}"],
+            env={**os.environ, "GCE_METADATA_HOST": "127.0.0.1:1",
+                 "NODE_NAME": "introspect-node",
+                 "TFD_APISERVER_URL": server.url,
+                 "TFD_SERVICEACCOUNT_DIR": str(sa)},
+            stderr=subprocess.DEVNULL)
+        try:
+            assert wait_for(lambda: http_get(port, "/readyz")[0] == 200), \
+                "never became ready"
+            server.set_failing(500)
+            assert wait_for(lambda: http_get(port, "/readyz")[0] == 503), \
+                "readyz did not flip on failing rewrites"
+            assert proc.poll() is None  # transient: daemon stays alive
+            assert http_get(port, "/healthz")[0] == 200
+            text = http_get(port, "/metrics")[1]
+            assert metrics.sample_value(
+                text, "tfd_rewrite_failures_total") >= 1
+            server.set_failing(0)
+            assert wait_for(lambda: http_get(port, "/readyz")[0] == 200), \
+                "readyz did not recover"
+        finally:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+                proc.wait(timeout=10)
+
+
+def test_readyz_flips_on_stale_rewrites(tfd_binary, tmp_path):
+    """The staleness half of the readiness contract: a daemon whose pass
+    WEDGES (no failure, no success — the libtpu-hang shape) must drop out
+    of /readyz once the last success is older than 2x the sleep interval,
+    while /healthz keeps answering 200 from the server thread. The wedge:
+    the mock topology file is swapped for a writer-less FIFO, so the next
+    pass blocks forever inside the backend's file open."""
+    import shutil
+
+    port = free_port()
+    topo = tmp_path / "topo.yaml"
+    shutil.copy(FIXTURES / "v2-8.yaml", topo)
+    out_file = tmp_path / "tfd"
+    proc = subprocess.Popen(
+        [str(tfd_binary), "--sleep-interval=1s", "--backend=mock",
+         f"--mock-topology-file={topo}", "--machine-type-file=/dev/null",
+         f"--output-file={out_file}",
+         f"--introspection-addr=127.0.0.1:{port}"],
+        env={**os.environ, "GCE_METADATA_HOST": "127.0.0.1:1"},
+        stderr=subprocess.DEVNULL)
+    try:
+        assert wait_for(lambda: http_get(port, "/readyz")[0] == 200)
+        topo.unlink()
+        os.mkfifo(topo)  # next pass blocks opening it; no writer ever
+        assert wait_for(lambda: http_get(port, "/readyz")[0] == 503,
+                        timeout=20), "readyz did not flip on staleness"
+        assert http_get(port, "/healthz")[0] == 200
+        assert proc.poll() is None  # wedged, not dead — that's the point
+    finally:
+        proc.kill()  # SIGTERM would pend behind the wedged pass
+        proc.wait(timeout=10)
+
+
+def test_sighup_rebinds_and_bumps_config_generation(tfd_binary, tmp_path):
+    port = free_port()
+    out_file = tmp_path / "tfd"
+    proc = subprocess.Popen(
+        daemon_argv(tfd_binary, port, out_file),
+        env={**os.environ, "GCE_METADATA_HOST": "127.0.0.1:1"},
+        stderr=subprocess.DEVNULL)
+    try:
+        assert wait_for(lambda: http_get(port, "/readyz")[0] == 200)
+        rewrites_before = metrics.sample_value(
+            http_get(port, "/metrics")[1], "tfd_rewrites_total")
+        proc.send_signal(signal.SIGHUP)
+        # The server comes back on the same addr and the registry
+        # survives the reload: generation bumps, counters keep counting.
+        assert wait_for(lambda: metrics.sample_value(
+            http_get(port, "/metrics")[1], "tfd_config_generation") == 2)
+        assert wait_for(lambda: metrics.sample_value(
+            http_get(port, "/metrics")[1],
+            "tfd_rewrites_total") > rewrites_before)
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=10)
+
+
+def test_pjrt_watchdog_trip_counter(tfd_binary, tmp_path):
+    """A wedged PJRT init (fake plugin in hang mode, SIGKILLed by the
+    watchdog at the deadline) must increment
+    tfd_pjrt_watchdog_trips_total — the fleet signal the fallback chain
+    otherwise hides (labels still get served, from the fallback)."""
+    from conftest import BUILD_DIR
+
+    fake = BUILD_DIR / "libtfd_fake_pjrt.so"
+    if not fake.exists():
+        pytest.skip("fake PJRT plugin not built")
+    port = free_port()
+    out_file = tmp_path / "tfd"
+    proc = subprocess.Popen(
+        [str(tfd_binary), "--sleep-interval=1s", "--backend=pjrt",
+         f"--libtpu-path={fake}", "--pjrt-init-timeout=1s",
+         "--fail-on-init-error=false", "--machine-type-file=/dev/null",
+         f"--output-file={out_file}",
+         f"--introspection-addr=127.0.0.1:{port}"],
+        env={**os.environ, "GCE_METADATA_HOST": "127.0.0.1:1",
+             "TFD_FAKE_PJRT_HANG": "1"},
+        stderr=subprocess.DEVNULL)
+    try:
+        assert wait_for(lambda: metrics.sample_value(
+            http_get(port, "/metrics")[1],
+            "tfd_pjrt_watchdog_trips_total") == 1, timeout=30)
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=15)
+
+
+def test_oneshot_never_binds(tfd_binary, tmp_path):
+    """Oneshot passes must not open the introspection port: the port is
+    pre-claimed here, so a oneshot that tried to bind would fail."""
+    port = free_port()
+    with socket.socket() as claimed:
+        claimed.bind(("127.0.0.1", port))
+        claimed.listen(1)
+        code, out, err = run_tfd(
+            tfd_binary,
+            ["--oneshot", "--output-file=", "--backend=mock",
+             f"--mock-topology-file={FIXTURES / 'v2-8.yaml'}",
+             "--machine-type-file=/dev/null",
+             f"--introspection-addr=127.0.0.1:{port}"])
+        assert code == 0, err
+        assert "google.com/tpu.count=4" in out
+
+
+def test_empty_addr_disables(tfd_binary, tmp_path):
+    """--introspection-addr= (empty) runs the daemon with no listener:
+    labeling works, and the startup log never announces a server."""
+    out_file = tmp_path / "tfd"
+    stderr_path = tmp_path / "stderr"
+    with open(stderr_path, "wb") as stderr_file:
+        proc = subprocess.Popen(
+            [str(tfd_binary), "--sleep-interval=1s", "--backend=mock",
+             f"--mock-topology-file={FIXTURES / 'v2-8.yaml'}",
+             "--machine-type-file=/dev/null", f"--output-file={out_file}",
+             "--introspection-addr="],
+            env={**os.environ, "GCE_METADATA_HOST": "127.0.0.1:1"},
+            stderr=stderr_file)
+    try:
+        assert wait_for(lambda: out_file.exists())
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=10)
+    assert proc.returncode == 0
+    assert "introspection server" not in stderr_path.read_text()
+
+
+def test_bind_failure_is_fatal_and_loud(tfd_binary, tmp_path):
+    """An unbindable introspection addr must crash the daemon visibly
+    (DaemonSet crash-loop), not leave it running unprobeable."""
+    port = free_port()
+    with socket.socket() as claimed:
+        claimed.bind(("127.0.0.1", port))
+        claimed.listen(1)
+        proc = subprocess.run(
+            [str(tfd_binary), "--sleep-interval=60s", "--backend=mock",
+             f"--mock-topology-file={FIXTURES / 'v2-8.yaml'}",
+             "--machine-type-file=/dev/null",
+             f"--output-file={tmp_path / 'tfd'}",
+             f"--introspection-addr=127.0.0.1:{port}"],
+            env={**os.environ, "GCE_METADATA_HOST": "127.0.0.1:1"},
+            capture_output=True, text=True, timeout=30)
+    assert proc.returncode == 1
+    assert "introspection server" in proc.stderr
+
+
+def test_invalid_addr_rejected_at_config(tfd_binary):
+    code, _, err = run_tfd(tfd_binary, ["--introspection-addr=8081"])
+    assert code == 1
+    assert "introspection" in err
+
+
+def test_soak_scrapes_daemon_metrics(tfd_binary):
+    """scripts/soak.py derives generations from the daemon's /metrics
+    (gen_source=metrics), checks /readyz at soak end, and — on the cr
+    sink — cross-checks the server-observed GET count against the
+    scraped counter."""
+    import json
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, str(SOAK), "--binary", str(tfd_binary),
+         "--duration", "6", "--sink", "cr",
+         "--extra-arg=--backend=mock",
+         f"--extra-arg=--mock-topology-file={FIXTURES / 'v2-8.yaml'}"],
+        capture_output=True, text=True, timeout=120)
+    report = json.loads(proc.stdout.splitlines()[-1])
+    assert proc.returncode == 0 and report["ok"] is True, report
+    assert report["gen_source"] == "metrics"
+    assert report["readyz_ok"] is True
+    assert report["cadence_ok"] is True
+    assert report["crosscheck_ok"] is True
+    assert abs(report["cr_gets"] - report["passes"]) <= 2

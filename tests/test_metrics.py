@@ -1,0 +1,220 @@
+"""Unit tests for tpufd.metrics — the Python twin of the C++ registry
+(src/tfd/obs/metrics.cc): render correctness, escaping, histogram
+invariants, the shared exposition parser/validator, and the atomic
+textfile writer. The C++ side is covered by tfd_unit_tests; these two
+suites assert the same format rules so the twins cannot drift."""
+
+import math
+
+import pytest
+
+from tpufd import metrics
+
+
+def test_counter_and_gauge_render():
+    reg = metrics.Registry()
+    c = reg.counter("tfd_x_total", "help text")
+    c.inc()
+    c.inc(2.5)
+    c.inc(-5)            # counters only go up
+    c.inc(float("nan"))  # dropped
+    reg.gauge("tfd_g", "a gauge").set(-1.5)
+    text = reg.render()
+    assert "# HELP tfd_x_total help text\n" in text
+    assert "# TYPE tfd_x_total counter\n" in text
+    assert "tfd_x_total 3.5\n" in text
+    assert "tfd_g -1.5\n" in text
+    metrics.validate_exposition(text)
+    # Same (name, labels) -> same instrument.
+    assert reg.counter("tfd_x_total", "help text") is c
+
+
+def test_one_help_type_block_per_family():
+    reg = metrics.Registry()
+    reg.counter("tfd_multi", "m", labels={"k": "a"}).inc()
+    reg.counter("tfd_multi", "m", labels={"k": "b"}).inc()
+    text = reg.render()
+    assert text.count("# TYPE tfd_multi counter") == 1
+    assert 'tfd_multi{k="a"} 1\n' in text
+    assert 'tfd_multi{k="b"} 1\n' in text
+    metrics.validate_exposition(text)
+
+
+def test_escaping_round_trips():
+    reg = metrics.Registry()
+    hostile = 'a\\b "quoted"\nnext'
+    reg.gauge("tfd_esc", "help with \\ and\nnewline",
+              labels={"path": hostile}).set(1)
+    text = reg.render()
+    assert "help with \\\\ and\\nnewline" in text
+    metrics.validate_exposition(text)
+    (name, labels, value), = metrics.parse_samples(text)
+    assert name == "tfd_esc"
+    assert labels["path"] == hostile  # unescape reverses escape
+    assert value == 1
+
+
+def test_hostile_names_sanitized():
+    reg = metrics.Registry()
+    reg.counter("9bad name!", "x", labels={"bad key": "v"}).inc()
+    text = reg.render()
+    assert "_9bad_name_" in text
+    metrics.validate_exposition(text)
+
+
+def test_backslash_before_n_round_trips():
+    """Regression: sequential-replace unescaping ate a literal backslash
+    followed by 'n'; the single-pass unescape must round-trip it."""
+    reg = metrics.Registry()
+    hostile = "a\\nb"  # backslash, then the letter n — NOT a newline
+    reg.gauge("tfd_bs", "x", labels={"p": hostile}).set(1)
+    text = reg.render()
+    metrics.validate_exposition(text)
+    (_, labels, _), = metrics.parse_samples(text)
+    assert labels["p"] == hostile
+
+
+def test_sample_name_collisions_renamed():
+    """Regression: a counter named like a histogram's generated _bucket
+    series (or a histogram colliding with an existing plain family) is
+    renamed at registration, keeping the exposition unambiguous; repeat
+    registrations land on the same instrument."""
+    reg = metrics.Registry()
+    reg.histogram("h", "hist", buckets=(1.0,)).observe(0.5)
+    c = reg.counter("h_bucket", "clash")
+    c.inc(3)
+    assert reg.counter("h_bucket", "clash") is c
+    text = reg.render()
+    metrics.validate_exposition(text)
+    assert "# TYPE h_bucket_ counter" in text
+    assert "h_bucket_ 3\n" in text
+    # Reverse direction: histogram generated names vs existing family.
+    reg.counter("g_sum", "plain").inc()
+    reg.histogram("g", "hist", buckets=(1.0,)).observe(0.5)
+    text = reg.render()
+    metrics.validate_exposition(text)
+    assert "g__bucket" in text
+
+
+def test_exact_family_wins_over_suffix():
+    metrics.validate_exposition(
+        "# TYPE x_bucket counter\nx_bucket 3\n")
+
+
+def test_histogram_buckets_cumulative_and_monotone():
+    reg = metrics.Registry()
+    h = reg.histogram("tfd_lat_seconds", "lat", labels={"op": "x"},
+                      buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.5, 5.0, 0.1):
+        h.observe(v)
+    h.observe(float("nan"))  # dropped
+    text = reg.render()
+    assert 'tfd_lat_seconds_bucket{op="x",le="0.01"} 1\n' in text
+    assert 'tfd_lat_seconds_bucket{op="x",le="0.1"} 3\n' in text
+    assert 'tfd_lat_seconds_bucket{op="x",le="1"} 4\n' in text
+    assert 'tfd_lat_seconds_bucket{op="x",le="+Inf"} 5\n' in text
+    assert 'tfd_lat_seconds_count{op="x"} 5\n' in text
+    metrics.validate_exposition(text)
+    # A caller-supplied `le` cannot collide with the generated label.
+    reg.histogram("tfd_le_clash", "x", labels={"le": "evil"},
+                  buckets=(1.0,)).observe(0.5)
+    assert 'exported_le="evil"' in reg.render()
+    metrics.validate_exposition(reg.render())
+
+
+def test_validator_bites():
+    for bad in (
+        "no trailing newline",
+        "orphan_sample 1\n",
+        "# TYPE m counter\nm -1\n",
+        "# TYPE m counter\nm notanum\n",
+        "# TYPE m bogus\nm 1\n",
+        "# TYPE m counter\n# TYPE m counter\nm 1\n",
+        '# TYPE m counter\nm{x="a",x="b"} 1\n',
+        # histogram: non-monotone, missing +Inf, +Inf != count
+        ('# TYPE h histogram\nh_bucket{le="1"} 5\nh_bucket{le="2"} 3\n'
+         'h_bucket{le="+Inf"} 5\nh_sum 1\nh_count 5\n'),
+        '# TYPE h histogram\nh_bucket{le="1"} 1\nh_sum 1\nh_count 1\n',
+        ('# TYPE h histogram\nh_bucket{le="1"} 1\n'
+         'h_bucket{le="+Inf"} 2\nh_sum 1\nh_count 3\n'),
+    ):
+        with pytest.raises(ValueError):
+            metrics.validate_exposition(bad)
+    metrics.validate_exposition(
+        "# HELP h text\n# TYPE h histogram\n"
+        'h_bucket{le="1"} 1\nh_bucket{le="+Inf"} 2\n'
+        "h_sum 1.5\nh_count 2\n")
+
+
+def test_sample_value_lookup():
+    text = ("# TYPE tfd_rewrites_total counter\n"
+            "tfd_rewrites_total 17\n"
+            "# TYPE tfd_d_seconds histogram\n"
+            'tfd_d_seconds_bucket{op="a",le="+Inf"} 3\n'
+            'tfd_d_seconds_sum{op="a"} 0.5\n'
+            'tfd_d_seconds_count{op="a"} 3\n')
+    assert metrics.sample_value(text, "tfd_rewrites_total") == 17
+    assert metrics.sample_value(
+        text, "tfd_d_seconds_count", labels={"op": "a"}) == 3
+    assert metrics.sample_value(text, "absent") is None
+    assert metrics.sample_value(
+        text, "tfd_d_seconds_count", labels={"op": "b"}) is None
+
+
+def test_special_values_render_and_parse():
+    reg = metrics.Registry()
+    reg.gauge("tfd_inf", "x").set(float("inf"))
+    text = reg.render()
+    assert "tfd_inf +Inf\n" in text
+    metrics.validate_exposition(text)
+    assert metrics.sample_value(text, "tfd_inf") == float("inf")
+    samples = {n: v for n, _, v in metrics.parse_samples(
+        "# TYPE n gauge\nn NaN\n")}
+    assert math.isnan(samples["n"])
+
+
+def test_write_textfile_atomic(tmp_path):
+    reg = metrics.Registry()
+    reg.counter("tfd_file_total", "x").inc(3)
+    path = tmp_path / "node.prom"
+    text = reg.write_textfile(str(path))
+    assert path.read_text() == text
+    assert "tfd_file_total 3\n" in text
+    metrics.validate_exposition(path.read_text())
+    # No tmp litter left behind.
+    assert list(tmp_path.iterdir()) == [path]
+
+
+def test_type_mismatch_returns_detached_instrument():
+    reg = metrics.Registry()
+    c = reg.counter("tfd_clash", "x")
+    c.inc(2)
+    g = reg.gauge("tfd_clash", "x")  # wrong type: detached, not a crash
+    g.set(99)
+    text = reg.render()
+    assert "tfd_clash 2\n" in text
+    assert "99" not in text
+    metrics.validate_exposition(text)
+
+
+def test_probe_timing_lands_in_default_registry():
+    """health.timed_probe is the seam every probe runs through; it must
+    record durations (and failures) under probe=<name> in the default
+    registry that --metrics-out serializes."""
+    from tpufd import health
+
+    assert health.timed_probe("unit-probe", lambda: 42) == 42
+    with pytest.raises(RuntimeError):
+        health.timed_probe("unit-probe", self_destruct)
+    text = metrics.default_registry().render()
+    metrics.validate_exposition(text)
+    assert metrics.sample_value(
+        text, "tpufd_probe_duration_seconds_count",
+        labels={"probe": "unit-probe"}) == 2
+    assert metrics.sample_value(
+        text, "tpufd_probe_failures_total",
+        labels={"probe": "unit-probe"}) == 1
+
+
+def self_destruct():
+    raise RuntimeError("probe blew up")

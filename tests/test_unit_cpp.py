@@ -16,7 +16,8 @@ def test_cpp_unit_suite(unit_test_binary):
     assert "0 failures" in proc.stderr
 
 
-@pytest.mark.parametrize("target", ["yamllite", "jsonlite", "http"])
+@pytest.mark.parametrize("target",
+                         ["yamllite", "jsonlite", "http", "metrics"])
 def test_fuzz_targets_smoke(unit_test_binary, target):
     """The fuzz targets (src/tfd/tests/fuzz/) must build and survive the
     seed corpus + a deterministic mutation sweep. Under gcc this runs the

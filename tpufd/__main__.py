@@ -16,6 +16,16 @@ import math
 import sys
 
 
+def _write_metrics(path):
+    """Writes the probe-timing telemetry collected this run as a
+    Prometheus textfile (atomic tmp+rename) — feed it to node-exporter's
+    textfile collector or inspect it directly."""
+    if path:
+        from tpufd import metrics
+
+        metrics.default_registry().write_textfile(path)
+
+
 def cmd_health(args):
     from tpufd import health
 
@@ -23,6 +33,7 @@ def cmd_health(args):
                                   extended=args.extended)
     for key in sorted(labels):
         print(f"{key}={labels[key]}")
+    _write_metrics(args.metrics_out)
     return 0 if labels.get(args.prefix + "ok") == "true" else 1
 
 
@@ -61,6 +72,7 @@ def cmd_burnin(args):
             except RuntimeError as e:
                 print(f"{mode} ring attention FAILED: {e}")
                 ok = False
+    _write_metrics(args.metrics_out)
     return 0 if ok else 1
 
 
@@ -74,6 +86,10 @@ def main(argv=None):
         "--extended", action="store_true",
         help="add the pallas DMA-copy probe (dma-copy-gbps): slower, "
              "distinguishes a sick VPU/DMA path from sick HBM")
+    health.add_argument(
+        "--metrics-out", default="",
+        help="also write probe-timing telemetry as a Prometheus textfile "
+             "(node-exporter textfile-collector format) to this path")
     health.set_defaults(fn=cmd_health)
 
     def positive_int(text):
@@ -89,6 +105,10 @@ def main(argv=None):
         "--skip-ring", action="store_true",
         help="skip the context-parallel ring-attention acceptance check "
              "(runs by default on multi-device hosts)")
+    burnin.add_argument(
+        "--metrics-out", default="",
+        help="also write step/ring timing telemetry as a Prometheus "
+             "textfile to this path")
     burnin.set_defaults(fn=cmd_burnin)
 
     args = parser.parse_args(argv)

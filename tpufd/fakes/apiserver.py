@@ -21,6 +21,11 @@ class _Handler(BaseHTTPRequestHandler):
     token = None
     lock = None
     requests = None  # type: list  # (method, path) per handled request
+    # When truthy, every CR request gets this HTTP status before touching
+    # the store — apiserver outage injection (5xx reads as transient to
+    # the daemon, which stays alive and flips /readyz once rewrites go
+    # stale; see FakeApiServer.set_failing).
+    failing = 0
 
     def _check_auth(self):
         if self.token is None:
@@ -51,6 +56,8 @@ class _Handler(BaseHTTPRequestHandler):
         return None, None
 
     def do_GET(self):  # noqa: N802
+        if self.failing:
+            return self._reply(self.failing, {"message": "injected outage"})
         if not self._check_auth():
             return self._reply(401, {"message": "unauthorized"})
         ns, name = self._parse()
@@ -63,6 +70,8 @@ class _Handler(BaseHTTPRequestHandler):
         return self._reply(200, obj)
 
     def do_POST(self):  # noqa: N802
+        if self.failing:
+            return self._reply(self.failing, {"message": "injected outage"})
         if not self._check_auth():
             return self._reply(401, {"message": "unauthorized"})
         ns, name = self._parse()
@@ -79,6 +88,8 @@ class _Handler(BaseHTTPRequestHandler):
         return self._reply(201, obj)
 
     def do_PUT(self):  # noqa: N802
+        if self.failing:
+            return self._reply(self.failing, {"message": "injected outage"})
         if not self._check_auth():
             return self._reply(401, {"message": "unauthorized"})
         ns, name = self._parse()
@@ -109,9 +120,10 @@ class FakeApiServer:
         # store — a plain Lock would deadlock every 409/404 reply.
         handler = type("Handler", (_Handler,), {
             "store": {}, "token": token, "lock": threading.RLock(),
-            "requests": []})
+            "requests": [], "failing": 0})
         self.store = handler.store
         self.requests = handler.requests
+        self._handler = handler
         self._server = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.tls = certfile is not None
         if self.tls:
@@ -132,6 +144,13 @@ class FakeApiServer:
         self._server.server_close()
         self._thread.join(timeout=5)
         return False
+
+    def set_failing(self, status=500):
+        """Starts (status truthy) or stops (0/None) an injected outage:
+        every subsequent CR request is answered with `status` and never
+        touches the store. 5xx/429 are what the daemon treats as
+        transient — it logs, stays alive, and retries next interval."""
+        self._handler.failing = status or 0
 
     @property
     def url(self):

@@ -425,6 +425,31 @@ def median_probe(fn, runs=3):
     return statistics.median(fn() for _ in range(runs))
 
 
+def timed_probe(name, fn):
+    """Runs `fn` and records its wall time (and failure, if it raises)
+    into the tpufd metrics registry under probe=`name` — the telemetry
+    half of every published health label, surfaced through
+    `python -m tpufd health --metrics-out`. Re-raises, so callers keep
+    their own failure policy."""
+    from tpufd import metrics
+
+    reg = metrics.default_registry()
+    start = time.perf_counter()
+    try:
+        return fn()
+    except Exception:
+        reg.counter("tpufd_probe_failures_total",
+                    "Health probes that raised, per probe.",
+                    labels={"probe": name}).inc()
+        raise
+    finally:
+        reg.histogram("tpufd_probe_duration_seconds",
+                      "Wall time of one health probe (median-of-N "
+                      "included), per probe.",
+                      labels={"probe": name}).observe(
+                          time.perf_counter() - start)
+
+
 def health_labels(prefix="google.com/tpu.health.", extended=False):
     """Runs the measured-silicon probes and returns a label dict, e.g.
     {"google.com/tpu.health.matmul-tflops": "123", ...}. Values are
@@ -472,10 +497,13 @@ def health_labels(prefix="google.com/tpu.health.", extended=False):
             if pct < DEGRADED_PCT:
                 labels[prefix + name + "-degraded"] = "true"
 
+    probe_t0 = time.perf_counter()
     try:
-        with_rated(median_probe(lambda: matmul_tflops(size=size)),
+        with_rated(timed_probe("matmul-tflops", lambda: median_probe(
+            lambda: matmul_tflops(size=size))),
                    RATED_MATMUL_TFLOPS, "matmul-tflops")
-        with_rated(median_probe(lambda: hbm_gbps(mib=mib)),
+        with_rated(timed_probe("hbm-gbps", lambda: median_probe(
+            lambda: hbm_gbps(mib=mib))),
                    RATED_HBM_GBPS, "hbm-gbps")
         if extended:
             # Own try: the DMA probe is an opt-in diagnostic, and a
@@ -485,15 +513,18 @@ def health_labels(prefix="google.com/tpu.health.", extended=False):
             # the core probes just measured healthy nor block the
             # allreduce probe below (bench.py isolates it the same way).
             try:
-                with_rated(median_probe(
-                    lambda: dma_copy_gbps(mib=mib // 2)),
-                    RATED_HBM_GBPS, "dma-copy-gbps")
+                with_rated(timed_probe("dma-copy-gbps",
+                                       lambda: median_probe(
+                                           lambda: dma_copy_gbps(
+                                               mib=mib // 2))),
+                           RATED_HBM_GBPS, "dma-copy-gbps")
             except Exception as e:  # noqa: BLE001
                 sys.stderr.write(f"dma-copy probe skipped: {e}\n")
         if len(devices) > 1:
             mesh = Mesh(np.array(devices), ("all",))
-            labels[prefix + "allreduce-gbps"] = fmt(median_probe(
-                lambda: allreduce_gbps(mesh, mib=64 if on_tpu else 8)))
+            labels[prefix + "allreduce-gbps"] = fmt(timed_probe(
+                "allreduce-gbps", lambda: median_probe(
+                    lambda: allreduce_gbps(mesh, mib=64 if on_tpu else 8))))
             # Per-axis ICI sweep: only when the devices expose a real
             # coordinate grid (multi-chip TPU hosts) — a ppermute ring
             # per physical axis localizes a weak link to an axis. Each
@@ -513,14 +544,27 @@ def health_labels(prefix="google.com/tpu.health.", extended=False):
                 for ax in pmesh.axis_names:
                     try:
                         labels[prefix + f"ici-{ax}-gbps"] = fmt(
-                            median_probe(lambda ax=ax: ici_axis_gbps(
-                                pmesh, ax, mib=64 if on_tpu else 4)))
+                            timed_probe(
+                                f"ici-{ax}-gbps",
+                                lambda ax=ax: median_probe(
+                                    lambda: ici_axis_gbps(
+                                        pmesh, ax,
+                                        mib=64 if on_tpu else 4))))
                     except Exception as e:  # noqa: BLE001
                         sys.stderr.write(
                             f"ici sweep axis {ax} skipped: {e}\n")
         labels[prefix + "ok"] = "true"
     except Exception:  # noqa: BLE001 — any device failure marks unhealthy
         labels[prefix + "ok"] = "false"
+    from tpufd import metrics as _metrics
+
+    reg = _metrics.default_registry()
+    reg.gauge("tpufd_health_duration_seconds",
+              "Wall time of the whole health_labels run.").set(
+                  time.perf_counter() - probe_t0)
+    reg.gauge("tpufd_health_ok",
+              "1 when the core probes measured healthy, else 0.").set(
+                  1 if labels.get(prefix + "ok") == "true" else 0)
     # Enumeration cross-check: the daemon exports ITS chip count
     # (TFD_CHIP_COUNT) when exec'ing this probe; libtpu enumerating N
     # chips while jax initializes M is a node-health signal neither

@@ -6,6 +6,17 @@
 // with oneshot and SIGHUP-reload, main.go:156-218), with the output file
 // removed on clean exit (main.go:220-240) so stale labels never outlive the
 // pod.
+//
+// Label rendering is decoupled from hardware probing by the probe
+// scheduler (src/tfd/sched/): a ProbeBroker owns one worker per probe
+// source (PJRT enumeration, GCE metadata, device-health exec) and the
+// rewrite loop renders from the latest SnapshotStore state through a
+// degradation ladder — full snapshot → cached snapshot (snapshot-age +
+// degraded labels) → metadata-only → minimal. The first rewrite on a
+// node with a wedged libtpu therefore completes in milliseconds instead
+// of burning the 30s init deadline, and a wedged probe can never stall
+// the rewrite cadence. --oneshot runs one synchronous probe round on
+// the main thread (no worker threads exist at all).
 #include <signal.h>
 
 #include <chrono>
@@ -20,6 +31,7 @@
 #include "tfd/lm/labeler.h"
 #include "tfd/lm/labels.h"
 #include "tfd/lm/machine_type.h"
+#include "tfd/lm/schema.h"
 #include "tfd/lm/timestamp.h"
 #include "tfd/lm/tpu_labeler.h"
 #include "tfd/lm/tpuvm_labeler.h"
@@ -27,6 +39,9 @@
 #include "tfd/obs/server.h"
 #include "tfd/platform/detect.h"
 #include "tfd/resource/factory.h"
+#include "tfd/sched/broker.h"
+#include "tfd/sched/snapshot.h"
+#include "tfd/sched/sources.h"
 #include "tfd/util/file.h"
 #include "tfd/util/logging.h"
 
@@ -35,6 +50,13 @@ namespace {
 
 enum class RunOutcome { kExit, kRestart, kError };
 
+// How long the FIRST rewrite waits for the initial probe round to
+// settle: long enough that a healthy backend (mock fixture read, cached
+// metadata, a warm PJRT plugin) yields full labels on the very first
+// pass, short enough that a wedged/slow probe cannot hold the first
+// labels past ~1s — the whole point of the scheduler.
+constexpr std::chrono::milliseconds kFirstPassSettleWait{500};
+
 // ---- observability plumbing (obs/) ---------------------------------------
 // All instruments live in obs::Default() so counters stay monotone across
 // SIGHUP reloads; the introspection server (re)binds per config load.
@@ -42,11 +64,6 @@ enum class RunOutcome { kExit, kRestart, kError };
 double WallClockSeconds() {
   return std::chrono::duration<double>(
              std::chrono::system_clock::now().time_since_epoch())
-      .count();
-}
-
-double SecondsSince(std::chrono::steady_clock::time_point t0) {
-  return std::chrono::duration<double>(std::chrono::steady_clock::now() - t0)
       .count();
 }
 
@@ -97,27 +114,125 @@ lm::MachineTypeGetter MakeMachineTypeGetter(const config::Config& config) {
   return [client]() { return client->MachineType(); };
 }
 
-// One labeling pass: build backend + labelers, merge, write. `*wrote_ok`
-// reports whether labels actually landed in the sink — false on every
-// error path, including the transient NodeFeature one that returns Ok to
-// keep the daemon alive.
+// ---- degradation ladder (sched/) -----------------------------------------
+
+// What this pass serves, decided from the snapshot store:
+//   level 0 — preferred device source, fresh.
+//   level 1 — a device source, stale-usable: cached facts, served with
+//             snapshot-age + degraded labels.
+//   level 2 — a fallback source, fresh (metadata-only on a node whose
+//             PJRT rung is down): plain labels, exactly what the old
+//             synchronous fallback chain emitted.
+//   level 3 — everything expired (serve the newest expired snapshot,
+//             degraded labels, /readyz not-ready) or nothing probed yet
+//             / every probe failed (minimal machine labels).
+struct ServeDecision {
+  resource::ManagerPtr manager;  // null → minimal labels
+  std::string source;
+  int level = 3;
+  double age_s = -1;
+  bool degraded_labels = false;
+  bool all_expired = false;
+  bool fatal = false;
+  std::string fatal_error;
+};
+
+ServeDecision Decide(const sched::SnapshotStore& store,
+                     const config::Flags& flags) {
+  ServeDecision decision;
+  std::vector<std::string> sources = store.DeviceSources();
+
+  auto serve = [&decision](const std::string& name,
+                           const sched::SourceView& view, int level,
+                           bool degraded, bool all_expired) {
+    decision.manager = view.last_ok->manager;
+    decision.source = name;
+    decision.level = level;
+    decision.age_s = view.age_s;
+    decision.degraded_labels = degraded;
+    decision.all_expired = all_expired;
+  };
+
+  // Rung 1: the first fresh source in preference order.
+  for (size_t i = 0; i < sources.size(); i++) {
+    sched::SourceView view = store.View(sources[i]);
+    if (view.tier == sched::Tier::kFresh) {
+      serve(sources[i], view, i == 0 ? 0 : 2, false, false);
+      return decision;
+    }
+  }
+  // Rung 2: cached (stale-usable) facts beat a missing source — served
+  // with the snapshot-age + degraded labels so schedulers see the truth.
+  for (size_t i = 0; i < sources.size(); i++) {
+    sched::SourceView view = store.View(sources[i]);
+    if (view.tier == sched::Tier::kStaleUsable) {
+      serve(sources[i], view, 1, true, false);
+      return decision;
+    }
+  }
+  // Rung 3: everything usable is gone; keep serving the newest expired
+  // snapshot (throwing away facts helps nobody) but report not-ready.
+  const std::string* newest = nullptr;
+  sched::SourceView newest_view;
+  for (const std::string& name : sources) {
+    sched::SourceView view = store.View(name);
+    if (!view.last_ok.has_value()) continue;
+    if (newest == nullptr || view.age_s < newest_view.age_s) {
+      newest = &name;
+      newest_view = view;
+    }
+  }
+  if (newest != nullptr) {
+    serve(*newest, newest_view, 3, true, true);
+    return decision;
+  }
+  // Rung 4: no source has EVER succeeded. A settled construction error
+  // is always fatal (the old "unable to create resource manager" exit);
+  // all-sources-settled-failed is fatal under --fail-on-init-error,
+  // else the node degrades to the minimal (machine-type/VM) label set.
+  bool all_settled_failed = !sources.empty();
+  std::string first_error;
+  for (const std::string& name : sources) {
+    sched::SourceView view = store.View(name);
+    if (view.fatal_error) {
+      decision.fatal = true;
+      decision.fatal_error = view.last_error;
+      return decision;
+    }
+    if (!view.settled || view.last_error.empty()) {
+      all_settled_failed = false;
+    } else if (first_error.empty()) {
+      first_error = view.last_error;
+    }
+  }
+  if (all_settled_failed && flags.fail_on_init_error) {
+    decision.fatal = true;
+    decision.fatal_error = first_error;
+    return decision;
+  }
+  decision.level = 3;
+  decision.all_expired = true;
+  return decision;
+}
+
+// One labeling pass: render labelers against the decided snapshot,
+// merge, write. `*wrote_ok` reports whether labels actually landed in
+// the sink — false on every error path, including the transient
+// NodeFeature one that returns Ok to keep the daemon alive.
 Status LabelOnceInner(const config::Config& config, lm::Labeler& timestamp,
                       lm::Labeler& machine_type, lm::Labeler& tpu_vm,
-                      size_t* labels_emitted, bool* wrote_ok) {
-  auto t0 = std::chrono::steady_clock::now();
-
-  auto backend_t0 = std::chrono::steady_clock::now();
-  Result<resource::ManagerPtr> manager = resource::NewManager(config);
-  if (!manager.ok()) {
-    return Status::Error("unable to create resource manager: " +
-                         manager.error());
+                      const sched::SnapshotStore& store,
+                      const ServeDecision& decision, size_t* labels_emitted,
+                      bool* wrote_ok) {
+  if (decision.fatal) {
+    return Status::Error(decision.fatal_error.empty()
+                             ? "no probe source could label this node"
+                             : decision.fatal_error);
   }
-  ObserveStageDuration("tfd_backend_duration_seconds",
-                       "Resource-backend construction + init duration, per "
-                       "backend actually used.",
-                       "backend", (*manager)->Name(),
-                       SecondsSince(backend_t0));
-  Result<lm::LabelerPtr> tpu = lm::NewTpuLabeler(*manager, config);
+  resource::ManagerPtr manager = decision.manager != nullptr
+                                     ? decision.manager
+                                     : resource::NewNullManager();
+  Result<lm::LabelerPtr> tpu = lm::NewTpuLabeler(manager, config);
   if (!tpu.ok()) return tpu.status();
 
   // Merge order mirrors lm.NewLabelers (labeler.go:33-45): device labels
@@ -132,9 +247,32 @@ Status LabelOnceInner(const config::Config& config, lm::Labeler& timestamp,
     Result<lm::Labels> labels = labeler->GetLabels();
     ObserveStageDuration("tfd_labeler_duration_seconds",
                          "GetLabels duration per labeler.", "labeler",
-                         kLabelerNames[i++], SecondsSince(labeler_t0));
+                         kLabelerNames[i++], obs::SecondsSince(labeler_t0));
     if (!labels.ok()) return labels.status();
     for (auto& [k, v] : *labels) merged[k] = v;
+  }
+
+  // Full-health exec labels ride in from the health worker's snapshot
+  // (the exec itself never runs on the rewrite path). Only merged while
+  // the SERVING backend touches devices — a metadata-only rung must not
+  // vouch for chip health — and only over a non-empty device label set.
+  if (config.flags.device_health == "full" && manager->TouchesDevices() &&
+      merged.count(lm::kBackendLabel) > 0) {
+    sched::SourceView health = store.View("health");
+    if (health.last_ok.has_value() &&
+        health.tier != sched::Tier::kExpired) {
+      for (const auto& [k, v] : health.last_ok->labels) merged[k] = v;
+    }
+  }
+
+  // Degradation markers: cached/expired snapshots say so, with their
+  // age, so a scheduler (or a human) can weigh the staleness. Fresh
+  // serves — including the metadata-only rung — stay byte-identical to
+  // the pre-scheduler label sets.
+  if (decision.degraded_labels && decision.manager != nullptr) {
+    merged[lm::kDegraded] = "true";
+    merged[lm::kSnapshotAge] =
+        std::to_string(static_cast<long long>(decision.age_s));
   }
 
   if (merged.size() <= 1) {
@@ -165,26 +303,56 @@ Status LabelOnceInner(const config::Config& config, lm::Labeler& timestamp,
 
   *labels_emitted = merged.size();
   *wrote_ok = true;
-  auto ms = std::chrono::duration_cast<std::chrono::milliseconds>(
-                std::chrono::steady_clock::now() - t0)
-                .count();
-  TFD_LOG_INFO << "wrote " << merged.size() << " labels"
-               << (config.flags.output_file.empty()
-                       ? ""
-                       : " to " + config.flags.output_file)
-               << " in " << ms << "ms";
   return Status::Ok();
 }
 
 Status LabelOnce(const config::Config& config, lm::Labeler& timestamp,
                  lm::Labeler& machine_type, lm::Labeler& tpu_vm,
+                 const sched::SnapshotStore& store,
                  obs::IntrospectionServer* server) {
   auto t0 = std::chrono::steady_clock::now();
+  ServeDecision decision = Decide(store, config.flags);
+
+  // Scheduler telemetry: the per-source snapshot ages and the ladder
+  // rung this pass served from.
+  obs::Registry& reg = obs::Default();
+  for (const std::string& name : store.Sources()) {
+    sched::SourceView view = store.View(name);
+    if (view.age_s >= 0) {
+      reg.GetGauge("tfd_snapshot_age_seconds",
+                   "Seconds since the source's last successful probe.",
+                   {{"source", name}})
+          ->Set(view.age_s);
+    }
+  }
+  reg.GetGauge("tfd_probe_degradation_level",
+               "Serving rung of the degradation ladder: 0 full, 1 cached "
+               "(stale device snapshot), 2 fallback source, 3 "
+               "expired/minimal.")
+      ->Set(decision.level);
+  if (server != nullptr) server->SetAllExpired(decision.all_expired);
+
   size_t labels_emitted = 0;
   bool wrote_ok = false;
-  Status s = LabelOnceInner(config, timestamp, machine_type, tpu_vm,
-                            &labels_emitted, &wrote_ok);
-  RecordRewriteOutcome(wrote_ok, labels_emitted, SecondsSince(t0), server);
+  Status s = LabelOnceInner(config, timestamp, machine_type, tpu_vm, store,
+                            decision, &labels_emitted, &wrote_ok);
+  double seconds = obs::SecondsSince(t0);
+  RecordRewriteOutcome(wrote_ok, labels_emitted, seconds, server);
+  if (wrote_ok) {
+    auto ms = static_cast<long long>(seconds * 1000);
+    TFD_LOG_INFO << "wrote " << labels_emitted << " labels"
+                 << (config.flags.output_file.empty()
+                         ? ""
+                         : " to " + config.flags.output_file)
+                 << " in " << ms << "ms"
+                 << (decision.level > 0
+                         ? " (degradation level " +
+                               std::to_string(decision.level) +
+                               (decision.source.empty()
+                                    ? ""
+                                    : ", serving " + decision.source) + ")"
+                         : "");
+  }
   return s;
 }
 
@@ -197,10 +365,28 @@ RunOutcome Run(const config::Config& config, const sigset_t& sigmask,
                               ? lm::NewTpuVmLabeler(config)
                               : lm::Empty();
 
+  // The probe scheduler: store + broker live for this config
+  // generation. Oneshot runs one synchronous round on this thread;
+  // daemon mode starts one worker per source and the loop below only
+  // ever reads snapshots.
+  auto store = std::make_shared<sched::SnapshotStore>();
+  sched::ProbeBroker broker(store, sched::BuildProbeSpecs(config, store));
+  if (config.flags.oneshot) {
+    broker.RunOneRound();
+  } else {
+    broker.Start();
+    // Give the initial probe round a short settle budget so a healthy
+    // node's first pass serves full labels; a wedged probe forfeits it
+    // and the first pass serves whatever has landed (metadata-only on
+    // the classic busy-chips cold start).
+    store->WaitAllSettled(kFirstPassSettleWait);
+  }
+
   bool cleanup_output = !config.flags.oneshot &&
                         !config.flags.output_file.empty();
   while (true) {
-    Status s = LabelOnce(config, *timestamp, *machine_type, *tpu_vm, server);
+    Status s = LabelOnce(config, *timestamp, *machine_type, *tpu_vm, *store,
+                         server);
     if (!s.ok()) {
       TFD_LOG_ERROR << s.message();
       return RunOutcome::kError;
@@ -215,6 +401,13 @@ RunOutcome Run(const config::Config& config, const sigset_t& sigmask,
     if (sig < 0) continue;  // EAGAIN: interval elapsed → relabel
     if (sig == SIGHUP) {
       TFD_LOG_INFO << "received SIGHUP; reloading configuration";
+      // Config regen invalidates every snapshot: the store dies with
+      // this scope, the broker is stopped (wedged workers detached),
+      // and the PJRT watchdog's process-global caches are dropped so
+      // nothing probed under the old config leaks into the new one.
+      broker.Stop();
+      store->InvalidateAll();
+      resource::InvalidatePjrtProbeCaches();
       if (cleanup_output) {
         Status rm = RemoveFileIfExists(config.flags.output_file);
         if (!rm.ok()) TFD_LOG_WARNING << rm.message();
@@ -222,6 +415,7 @@ RunOutcome Run(const config::Config& config, const sigset_t& sigmask,
       return RunOutcome::kRestart;
     }
     TFD_LOG_INFO << "received signal " << sig << "; exiting";
+    broker.Stop();
     if (cleanup_output) {
       Status rm = RemoveFileIfExists(config.flags.output_file);
       if (!rm.ok()) TFD_LOG_WARNING << rm.message();

@@ -41,6 +41,12 @@ REQUIRED = [
     "tfd_last_rewrite_timestamp_seconds",
     "tfd_config_generation",
     "tfd_build_info",
+    # Probe scheduler (sched/): per-source probe telemetry + the
+    # degradation-ladder serving rung.
+    "tfd_probe_attempts_total",
+    "tfd_probe_duration_seconds_count",
+    "tfd_snapshot_age_seconds",
+    "tfd_probe_degradation_level",
 ]
 
 
@@ -53,10 +59,15 @@ def main(argv=None):
 
     port = free_loopback_port()
 
+    # A real temp file, NOT /dev/null: the daemon removes its output
+    # file on clean exit (stale labels must not outlive the pod), and a
+    # root-run lint would otherwise delete the device node.
+    import tempfile
+    out_dir = tempfile.mkdtemp(prefix="tfd-metrics-lint-")
     proc = subprocess.Popen(
         [args.binary, "--sleep-interval=1s", "--backend=null",
          "--fail-on-init-error=false", "--machine-type-file=/dev/null",
-         "--output-file=/dev/null",
+         f"--output-file={os.path.join(out_dir, 'tfd')}",
          f"--introspection-addr=127.0.0.1:{port}"],
         env={**os.environ, "GCE_METADATA_HOST": "127.0.0.1:1"},
         stderr=subprocess.PIPE)

@@ -97,6 +97,36 @@ class MetricsScraper:
     def readyz(self):
         return self._get("/readyz")[0]
 
+    def counter(self, name):
+        """Value of a counter, or None. `name` may carry one label
+        selector: tfd_probe_attempts_total{source=health}."""
+        status, text = self._get("/metrics")
+        if status != 200:
+            return None
+        labels = None
+        if "{" in name:
+            name, _, selector = name.partition("{")
+            key, _, value = selector.rstrip("}").partition("=")
+            labels = {key: value.strip('"')}
+        try:
+            return tpufd_metrics.sample_value(text, name, labels=labels)
+        except ValueError:
+            return None
+
+    def by_source(self, name):
+        """{source: value} for every child of a source-labelled family."""
+        status, text = self._get("/metrics")
+        if status != 200:
+            return {}
+        out = {}
+        try:
+            for sample, labels, value in tpufd_metrics.parse_samples(text):
+                if sample == name and "source" in labels:
+                    out[labels["source"]] = value
+        except ValueError:
+            return {}
+        return out
+
 
 def rss_kb(pid):
     """Resident set size in KiB from /proc (Linux; the daemon's target)."""
@@ -108,14 +138,26 @@ def rss_kb(pid):
 
 
 def fd_count(pid):
-    return len(os.listdir(f"/proc/{pid}/fd"))
+    """Minimum of a few spaced samples: the probe workers legitimately
+    open short-lived fds (fixture reads, metadata sockets, watchdog
+    pipes) on their own threads, so a single sample can catch one
+    mid-probe and read as a leak. A real leak is monotone and survives
+    the min; transient probe fds do not."""
+    counts = []
+    for _ in range(3):
+        counts.append(len(os.listdir(f"/proc/{pid}/fd")))
+        time.sleep(0.05)
+    return min(counts)
 
 
 def stable_digest(label_text):
-    """Digest of the label set minus the timestamp line — the one label
-    that legitimately changes every pass."""
+    """Digest of the label set minus the labels that legitimately change
+    every pass: the timestamp, and — under --device-health — the basic
+    probe's latency measurement (probe-ms is a fresh wall-clock reading
+    per probe, not node identity)."""
     lines = [l for l in label_text.splitlines()
-             if not l.startswith("google.com/tfd.timestamp=")]
+             if not l.startswith("google.com/tfd.timestamp=")
+             and not l.startswith("google.com/tpu.health.probe-ms=")]
     return hashlib.sha256("\n".join(lines).encode()).hexdigest()
 
 
@@ -224,6 +266,12 @@ def main(argv=None):
                          "grow the heap: stdio buffers, metadata caches)")
     ap.add_argument("--extra-arg", action="append", default=[],
                     help="extra daemon flag (repeatable)")
+    ap.add_argument("--require-counter", action="append", default=[],
+                    metavar="NAME:MIN",
+                    help="fail unless the scraped counter NAME ends the "
+                         "soak >= MIN (repeatable) — e.g. "
+                         "tfd_pjrt_cache_refreshes_total:2 proves the "
+                         "soak crossed a snapshot-cache expiry boundary")
     ap.add_argument("--init-grace", type=float, default=180.0,
                     help="seconds allowed for the FIRST pass (backend "
                          "init: a cold PJRT chip claim can take tens of "
@@ -281,6 +329,7 @@ def main(argv=None):
             # budget (--init-grace) so slow chip init neither eats the
             # soak nor lets a never-writing daemon hang the harness.
             deadline = time.monotonic() + args.init_grace
+            scrape_grace_until = time.monotonic() + 5.0
             while time.monotonic() < deadline:
                 if proc.poll() is not None:
                     break
@@ -289,14 +338,20 @@ def main(argv=None):
                 # label digest. The source latches on first evidence:
                 # a successful scrape wins (the real daemon's server is
                 # up before its first pass completes); a sink generation
-                # appearing while the scrape still fails means a binary
-                # without the introspection server (the harness-failure
-                # fakes) and latches the legacy sink path.
+                # appearing while the scrape still fails past a short
+                # grace means a binary without the introspection server
+                # (the harness-failure fakes) and latches the legacy
+                # sink path. The grace matters under load: a slow first
+                # scrape racing an already-written sink must not demote
+                # a metrics-capable daemon (which would silently skip
+                # the counter/tier checks).
                 if gen_source is None:
                     if scraper is not None and \
                             scraper.generation() is not None:
                         gen_source = "metrics"
-                    elif sink.observe() is not None:
+                    elif sink.observe() is not None and (
+                            scraper is None or
+                            time.monotonic() >= scrape_grace_until):
                         gen_source = "sink"
                     else:
                         time.sleep(0.05)
@@ -352,6 +407,31 @@ def main(argv=None):
             readyz_ok = None
             if gen_source == "metrics":
                 readyz_ok = scraper.readyz() == 200
+            # Re-probe floors (--require-counter): the cache-expiry
+            # soak's proof that snapshot refreshes / health re-execs
+            # actually happened, from the daemon's own counters.
+            counters_ok = None
+            counters = {}
+            if args.require_counter and gen_source == "metrics":
+                counters_ok = True
+                for spec in args.require_counter:
+                    name, _, floor = spec.rpartition(":")
+                    value = scraper.counter(name)
+                    counters[name] = value
+                    if value is None or value < float(floor):
+                        counters_ok = False
+            # Per-source snapshot tiers at soak end, classified with the
+            # same policy vocabulary the daemon registers
+            # (tpufd.sched mirrors sched/sources.cc): every source of a
+            # healthy soak must end fresh.
+            snapshot_tiers = None
+            if gen_source == "metrics":
+                from tpufd import sched as sched_lib
+
+                ages = scraper.by_source("tfd_snapshot_age_seconds")
+                policy = sched_lib.device_policy(args.interval)
+                snapshot_tiers = {source: sched_lib.tier_of(age, policy)
+                                  for source, age in sorted(ages.items())}
             # CR cross-check (cr sink + scraping): one GET per pass
             # server-side must agree with the daemon's own counter,
             # within an edge pass either way.
@@ -388,6 +468,9 @@ def main(argv=None):
                 "cadence_ok": cadence_ok,
                 "readyz_ok": readyz_ok,
                 "crosscheck_ok": crosscheck_ok,
+                "counters": counters or None,
+                "counters_ok": counters_ok,
+                "snapshot_tiers": snapshot_tiers,
                 "clean_exit": clean,
                 "end_state_ok": sink.end_state_ok(),
             })
@@ -396,6 +479,7 @@ def main(argv=None):
                 and cadence_ok
                 and readyz_ok is not False
                 and crosscheck_ok is not False
+                and counters_ok is not False
                 and baseline_rss is not None
                 and out["rss_drift_kb"] <= args.max_rss_drift_kb
                 and end_fd == baseline_fd

@@ -263,6 +263,17 @@ std::vector<FlagDef> MakeFlagDefs(Flags* f) {
                   [f](const std::string& v) {
                     return SetDuration(&f->health_exec_interval_s, v);
                   }});
+  defs.push_back({"snapshot-usable-for",
+                  {"TFD_SNAPSHOT_USABLE_FOR"},
+                  "snapshotUsableFor",
+                  "how long a probe source's snapshot stays servable "
+                  "after its last successful probe before the "
+                  "degradation ladder drops it (e.g. 10m; 0 = auto: "
+                  "fresh window + 6 sleep-intervals)",
+                  false,
+                  [f](const std::string& v) {
+                    return SetDuration(&f->snapshot_usable_for_s, v);
+                  }});
   defs.push_back({"introspection-addr",
                   {"TFD_INTROSPECTION_ADDR"},
                   "introspectionAddr",
@@ -602,6 +613,9 @@ Result<LoadResult> Load(int argc, char** argv) {
   if (f->sleep_interval_s < 1) {
     return Result<LoadResult>::Error("sleep-interval must be >= 1s");
   }
+  if (f->snapshot_usable_for_s < 0) {
+    return Result<LoadResult>::Error("snapshot-usable-for must be >= 0s");
+  }
   if (!f->introspection_addr.empty()) {
     Result<obs::ListenAddr> addr = obs::ParseListenAddr(f->introspection_addr);
     if (!addr.ok()) return Result<LoadResult>::Error(addr.error());
@@ -647,6 +661,7 @@ std::string ToJson(const Config& config) {
       << ",\"healthExec\":" << jstr(f.health_exec)
       << ",\"healthExecTimeout\":\"" << f.health_exec_timeout_s << "s\""
       << ",\"healthExecInterval\":\"" << f.health_exec_interval_s << "s\""
+      << ",\"snapshotUsableFor\":\"" << f.snapshot_usable_for_s << "s\""
       << ",\"introspectionAddr\":" << jstr(f.introspection_addr)
       << "},\"sharing\":[";
   for (size_t i = 0; i < config.sharing.time_slicing.size(); i++) {

@@ -113,6 +113,14 @@ struct Flags {
   // is cached and re-measured only this often, so the probe never runs
   // once per sleep-interval.
   int health_exec_interval_s = 3600;
+  // Staleness-tier override for the probe scheduler's snapshot cache
+  // (sched/snapshot.h): how long after its last successful probe a
+  // source's snapshot stays SERVABLE (the stale-usable tier's outer
+  // edge — beyond it the degradation ladder falls to the next source
+  // and, with everything expired, /readyz reports not-ready). 0 = auto:
+  // the per-source fresh window (2x sleep-interval + the probe's
+  // deadline budget) plus 6 sleep-intervals.
+  int snapshot_usable_for_s = 0;
   // Introspection HTTP server (obs/server.h): /healthz, /readyz and
   // Prometheus /metrics. "host:port"; empty host binds all interfaces,
   // empty string disables. Oneshot runs never bind (there is no

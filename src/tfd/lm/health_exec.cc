@@ -1,0 +1,95 @@
+#include "tfd/lm/health_exec.h"
+
+#include <cctype>
+
+#include "tfd/lm/schema.h"
+#include "tfd/util/logging.h"
+#include "tfd/util/strings.h"
+#include "tfd/util/subprocess.h"
+
+namespace tfd {
+namespace lm {
+
+namespace {
+
+// A label key's name part (after the "google.com/" domain) must be a valid
+// Kubernetes label name: alphanumeric ends, [-._a-zA-Z0-9] middle, <= 63
+// chars TOTAL — and the name already starts with the fixed "tpu.health."
+// (11 chars), so the probe's suffix gets at most 52. A bad key from a
+// buggy probe must never reach the apiserver — an invalid label name
+// fails the whole NodeFeature update.
+bool ValidLabelKeySuffix(const std::string& s) {
+  constexpr size_t kMax = 63 - (sizeof("tpu.health.") - 1);
+  if (s.empty() || s.size() > kMax) return false;
+  auto alnum = [](char c) { return isalnum(static_cast<unsigned char>(c)); };
+  if (!alnum(s.front()) || !alnum(s.back())) return false;
+  for (char c : s) {
+    if (!alnum(c) && c != '-' && c != '_' && c != '.') return false;
+  }
+  return true;
+}
+
+}  // namespace
+
+Labels RunHealthExec(const config::Config& config, int chip_count) {
+  Labels out;
+  // The daemon's enumerated chip count rides into the probe's
+  // environment so the PROBE's published label set can carry the
+  // enumeration cross-check (jax initializing fewer devices than the
+  // daemon's backend enumerated — see tpufd/health.py
+  // devices-consistent). Scoped to the child shell via an export
+  // prefix: RunCommandCapture runs `sh -c`, so this sets the variable
+  // for the whole probe command (pipelines included) without ever
+  // mutating the daemon's own environment.
+  std::string command = config.flags.health_exec;
+  if (chip_count >= 0) {
+    command = "export TFD_CHIP_COUNT=" + std::to_string(chip_count) +
+              "; " + command;
+  }
+  Result<std::string> text =
+      RunCommandCapture(command, config.flags.health_exec_timeout_s);
+  if (!text.ok()) {
+    TFD_LOG_WARNING << "health exec failed: " << text.error();
+    out[kHealthOk] = "false";
+    return out;
+  }
+  for (const std::string& line : SplitString(*text, '\n')) {
+    std::string trimmed = TrimSpace(line);
+    if (trimmed.empty()) continue;
+    size_t eq = trimmed.find('=');
+    if (eq == std::string::npos || eq == 0) {
+      TFD_LOG_WARNING << "health exec: ignoring malformed line: " << trimmed;
+      continue;
+    }
+    std::string key = trimmed.substr(0, eq);
+    std::string value = trimmed.substr(eq + 1);
+    if (!HasPrefix(key, kHealthPrefix)) {
+      TFD_LOG_WARNING << "health exec: ignoring label outside "
+                      << kHealthPrefix << ": " << key;
+      continue;
+    }
+    if (!ValidLabelKeySuffix(key.substr(sizeof(kHealthPrefix) - 1))) {
+      TFD_LOG_WARNING << "health exec: ignoring invalid label key: " << key;
+      continue;
+    }
+    // Label values are capped at 63 chars by the apiserver, and must have
+    // alphanumeric ends — StrictLabelValue enforces both, because an
+    // invalid VALUE from a buggy probe would fail the whole NodeFeature
+    // update just like an invalid key. Truncating/trimming beats failing.
+    std::string strict = StrictLabelValue(value);
+    if (strict.empty() && !value.empty()) {
+      TFD_LOG_WARNING << "health exec: dropping label with no valid value: "
+                      << key << "=" << value;
+      continue;
+    }
+    out[key] = strict;
+  }
+  if (out.empty()) {
+    TFD_LOG_WARNING << "health exec produced no health labels";
+    out[kHealthOk] = "false";
+  }
+  return out;
+}
+
+}  // namespace lm
+}  // namespace tfd

@@ -1,0 +1,28 @@
+// The --device-health=full measured-silicon probe exec.
+//
+// Runs the --health-exec command (default `python3 -m tpufd health`)
+// and parses its google.com/tpu.health.* key=value stdout lines into
+// labels, dropping keys outside the health prefix or with invalid
+// names/values (a buggy probe must neither overwrite, say, the product
+// label nor crash-loop the daemon with an apiserver-rejected key). On
+// any failure the ok label is forced to "false".
+//
+// Lived inside the TPU labeler until the probe scheduler
+// (sched/sources.cc) took over its cadence: the exec can legitimately
+// run for minutes, so it belongs on the health worker, not the rewrite
+// path. The oneshot round still runs it synchronously.
+#pragma once
+
+#include "tfd/config/config.h"
+#include "tfd/lm/labeler.h"
+
+namespace tfd {
+namespace lm {
+
+// `chip_count` (>= 0) rides into the probe's environment as
+// TFD_CHIP_COUNT so its published labels can carry the enumeration
+// cross-check (tpufd/health.py devices-consistent).
+Labels RunHealthExec(const config::Config& config, int chip_count);
+
+}  // namespace lm
+}  // namespace tfd

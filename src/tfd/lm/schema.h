@@ -85,6 +85,16 @@ inline constexpr char kHealthOk[] = "google.com/tpu.health.ok";
 inline constexpr char kHealthDevices[] = "google.com/tpu.health.devices";
 inline constexpr char kHealthProbeMs[] = "google.com/tpu.health.probe-ms";
 
+// Degradation ladder (sched/): present only when the daemon is serving
+// CACHED device facts because the probe source missed its cadence
+// (chips held by a training job, wedged libtpu). Age is whole seconds
+// since the serving snapshot's probe succeeded. Never emitted on a
+// healthy node or by the metadata-only rung, so steady-state label sets
+// stay byte-identical to the pre-scheduler daemon.
+inline constexpr char kSnapshotAge[] =
+    "google.com/tpu.snapshot-age-seconds";
+inline constexpr char kDegraded[] = "google.com/tpu.degraded";
+
 // The value used when a slice strategy's validation fails — the analogue of
 // the reference's "MIG-INVALID" product (mig-strategy.go:243-262).
 inline constexpr char kSliceInvalid[] = "SLICE-INVALID";

@@ -1,7 +1,6 @@
 #include "tfd/lm/tpu_labeler.h"
 
 #include <algorithm>
-#include <cctype>
 #include <chrono>
 #include <map>
 
@@ -11,7 +10,6 @@
 #include "tfd/slice/topology.h"
 #include "tfd/util/logging.h"
 #include "tfd/util/strings.h"
-#include "tfd/util/subprocess.h"
 
 namespace tfd {
 namespace lm {
@@ -105,133 +103,6 @@ LabelerPtr NewIciLinksLabeler(
   return std::make_unique<StaticLabeler>(std::move(labels));
 }
 
-// A label key's name part (after the "google.com/" domain) must be a valid
-// Kubernetes label name: alphanumeric ends, [-._a-zA-Z0-9] middle, <= 63
-// chars TOTAL — and the name already starts with the fixed "tpu.health."
-// (11 chars), so the probe's suffix gets at most 52. A bad key from a
-// buggy probe must never reach the apiserver — an invalid label name
-// fails the whole NodeFeature update.
-bool ValidLabelKeySuffix(const std::string& s) {
-  constexpr size_t kMax = 63 - (sizeof("tpu.health.") - 1);
-  if (s.empty() || s.size() > kMax) return false;
-  auto alnum = [](char c) { return isalnum(static_cast<unsigned char>(c)); };
-  if (!alnum(s.front()) || !alnum(s.back())) return false;
-  for (char c : s) {
-    if (!alnum(c) && c != '-' && c != '_' && c != '.') return false;
-  }
-  return true;
-}
-
-// Runs the --health-exec command and returns the google.com/tpu.health.*
-// labels parsed from its key=value stdout lines. Keys outside the health
-// prefix or with invalid names are dropped with a warning (the probe must
-// not be able to overwrite, say, the product label, nor crash-loop the
-// daemon with an apiserver-rejected key); on any failure the ok label is
-// forced to "false".
-Labels RunHealthExec(const config::Config& config, int chip_count) {
-  Labels out;
-  // The daemon's enumerated chip count rides into the probe's
-  // environment so the PROBE's published label set can carry the
-  // enumeration cross-check (jax initializing fewer devices than the
-  // daemon's backend enumerated — see tpufd/health.py
-  // devices-consistent). Scoped to the child shell via an export
-  // prefix: RunCommandCapture runs `sh -c`, so this sets the variable
-  // for the whole probe command (pipelines included) without ever
-  // mutating the daemon's own environment.
-  std::string command = config.flags.health_exec;
-  if (chip_count >= 0) {
-    command = "export TFD_CHIP_COUNT=" + std::to_string(chip_count) +
-              "; " + command;
-  }
-  Result<std::string> text =
-      RunCommandCapture(command, config.flags.health_exec_timeout_s);
-  if (!text.ok()) {
-    TFD_LOG_WARNING << "health exec failed: " << text.error();
-    out[kHealthOk] = "false";
-    return out;
-  }
-  for (const std::string& line : SplitString(*text, '\n')) {
-    std::string trimmed = TrimSpace(line);
-    if (trimmed.empty()) continue;
-    size_t eq = trimmed.find('=');
-    if (eq == std::string::npos || eq == 0) {
-      TFD_LOG_WARNING << "health exec: ignoring malformed line: " << trimmed;
-      continue;
-    }
-    std::string key = trimmed.substr(0, eq);
-    std::string value = trimmed.substr(eq + 1);
-    if (!HasPrefix(key, kHealthPrefix)) {
-      TFD_LOG_WARNING << "health exec: ignoring label outside "
-                      << kHealthPrefix << ": " << key;
-      continue;
-    }
-    if (!ValidLabelKeySuffix(key.substr(sizeof(kHealthPrefix) - 1))) {
-      TFD_LOG_WARNING << "health exec: ignoring invalid label key: " << key;
-      continue;
-    }
-    // Label values are capped at 63 chars by the apiserver, and must have
-    // alphanumeric ends — StrictLabelValue enforces both, because an
-    // invalid VALUE from a buggy probe would fail the whole NodeFeature
-    // update just like an invalid key. Truncating/trimming beats failing.
-    std::string strict = StrictLabelValue(value);
-    if (strict.empty() && !value.empty()) {
-      TFD_LOG_WARNING << "health exec: dropping label with no valid value: "
-                      << key << "=" << value;
-      continue;
-    }
-    out[key] = strict;
-  }
-  if (out.empty()) {
-    TFD_LOG_WARNING << "health exec produced no health labels";
-    out[kHealthOk] = "false";
-  }
-  return out;
-}
-
-// Merges the (expensive) measured-probe labels, re-running the exec only
-// when the cached result is older than --health-exec-interval. The probe
-// benchmarks the silicon — rerunning a matmul/HBM/all-reduce sweep every
-// 60s sleep-interval would steal TPU cycles from co-located jobs and
-// stall label refresh; measured throughput does not change minute to
-// minute. The daemon is single-threaded, so plain statics suffice.
-void MergeHealthExecLabels(const config::Config& config, Labels* health,
-                           int chip_count) {
-  static Labels cached;
-  static std::string cached_exec;
-  static int cached_chip_count = -1;
-  static std::chrono::steady_clock::time_point cached_at;
-  static bool have_cache = false;
-
-  // A failed probe retries much sooner than a good one re-measures:
-  // transient causes (a training job briefly holding the exclusive chips,
-  // a probe OOM) should not mark a healthy node unhealthy for a whole
-  // --health-exec-interval.
-  int interval_s = config.flags.health_exec_interval_s;
-  if (have_cache) {
-    auto it = cached.find(kHealthOk);
-    if (it != cached.end() && it->second == "false") {
-      interval_s = std::min(interval_s, 300);
-    }
-  }
-
-  auto now = std::chrono::steady_clock::now();
-  // chip_count is part of the staleness key: a chip dropping from (or
-  // returning to) enumeration must re-run the probe immediately, or the
-  // node would republish a stale devices-consistent verdict next to a
-  // contradictory tpu.health.devices for up to a full interval.
-  bool stale = !have_cache || cached_exec != config.flags.health_exec ||
-               cached_chip_count != chip_count ||
-               now - cached_at >= std::chrono::seconds(interval_s);
-  if (stale) {
-    cached = RunHealthExec(config, chip_count);
-    cached_exec = config.flags.health_exec;
-    cached_chip_count = chip_count;
-    cached_at = now;
-    have_cache = true;
-  }
-  for (const auto& [k, v] : cached) (*health)[k] = v;
-}
-
 }  // namespace
 
 Result<LabelerPtr> NewTpuLabeler(const resource::ManagerPtr& manager,
@@ -282,6 +153,12 @@ Result<LabelerPtr> NewTpuLabeler(const resource::ManagerPtr& manager,
     auto ms = std::chrono::duration_cast<std::chrono::milliseconds>(
                   std::chrono::steady_clock::now() - probe_start)
                   .count();
+    // A pre-probed snapshot view (sched/sources.cc) answers every call
+    // above from captured data in microseconds; its ProbeSeconds() is
+    // the honest init+enumeration latency of the probe that produced it.
+    if (auto* timed = dynamic_cast<resource::ProbeTimed*>(manager.get())) {
+      ms = static_cast<long long>(timed->ProbeSeconds() * 1000);
+    }
     health[kHealthOk] = "true";
     health[kHealthDevices] = std::to_string(devices->size());
     health[kHealthProbeMs] = std::to_string(ms);
@@ -294,19 +171,11 @@ Result<LabelerPtr> NewTpuLabeler(const resource::ManagerPtr& manager,
   parts.push_back(std::move(*strategy));
   manager->Shutdown();
 
-  if (health_on && health_mode == "full") {
-    // Full health: run the measured-silicon probe (default:
-    // `python3 -m tpufd health` — matmul TFLOPs, HBM GB/s, ICI
-    // all-reduce GB/s) and merge its labels. The probe self-reports
-    // google.com/tpu.health.ok; a failed or timed-out probe downgrades
-    // ok to false rather than silently keeping basic's true — a node
-    // that enumerates but cannot run a matmul is exactly the node a
-    // scheduler must avoid. Runs strictly AFTER manager->Shutdown():
-    // TPU access is exclusive, so the probe could never acquire the
-    // chips while the daemon's own PJRT client holds them.
-    MergeHealthExecLabels(config, &health,
-                          static_cast<int>(devices->size()));
-  }
+  // Full-health exec labels (matmul TFLOPs, HBM GB/s, ...) are no
+  // longer merged here: the probe scheduler's health worker runs the
+  // exec on its own cadence (sched/sources.cc) and the daemon loop
+  // merges its snapshot over these basic labels — a multi-minute
+  // silicon probe must never ride the rewrite path.
   if (health_on) {
     parts.push_back(std::make_unique<StaticLabeler>(std::move(health)));
   }

@@ -19,6 +19,7 @@
 #pragma once
 
 #include <atomic>
+#include <chrono>
 #include <memory>
 #include <mutex>
 #include <string>
@@ -29,6 +30,14 @@
 
 namespace tfd {
 namespace obs {
+
+// Seconds elapsed since `t0` on the steady clock — the one timing
+// helper behind every duration histogram (rewrite passes, labelers,
+// backend probes, broker probes).
+inline double SecondsSince(std::chrono::steady_clock::time_point t0) {
+  return std::chrono::duration<double>(std::chrono::steady_clock::now() - t0)
+      .count();
+}
 
 // Label set for one child of a metric family, in render order.
 using Labels = std::vector<std::pair<std::string, std::string>>;

@@ -92,10 +92,12 @@ class IntrospectionServer::Impl {
   std::thread thread;
   std::atomic<bool> stopping{false};
 
-  // /readyz state, written by the daemon thread via RecordRewrite.
+  // /readyz state, written by the daemon thread via RecordRewrite /
+  // SetAllExpired.
   std::mutex mu;
   bool ever_succeeded = false;
   bool last_ok = false;
+  bool all_expired = false;
   std::chrono::steady_clock::time_point last_success;
 
   std::vector<Conn> conns;
@@ -185,6 +187,11 @@ void IntrospectionServer::RecordRewrite(bool ok) {
   }
 }
 
+void IntrospectionServer::SetAllExpired(bool all_expired) {
+  std::lock_guard<std::mutex> lock(impl_->mu);
+  impl_->all_expired = all_expired;
+}
+
 void IntrospectionServer::HandleRequest(Conn* conn) {
   conn->responding = true;
   size_t line_end = conn->in.find("\r\n");
@@ -220,6 +227,10 @@ void IntrospectionServer::HandleRequest(Conn* conn) {
       } else if (!impl_->last_ok) {
         ready = false;
         why = "last label rewrite failed\n";
+      } else if (impl_->all_expired) {
+        ready = false;
+        why = "every probe-source snapshot is expired; serving "
+              "best-effort labels only\n";
       } else {
         auto age = std::chrono::steady_clock::now() - impl_->last_success;
         ready = age <= std::chrono::seconds(stale_after_s_);

@@ -63,6 +63,13 @@ class IntrospectionServer {
   // Called by the daemon loop after every rewrite attempt; drives /readyz.
   void RecordRewrite(bool ok);
 
+  // Degradation-ladder input (sched/): when EVERY probe source's
+  // snapshot is expired the daemon still rewrites (best-effort labels)
+  // but must drop out of service — "degraded-but-serving is ready;
+  // expired-everything is not". Called per rewrite alongside
+  // RecordRewrite.
+  void SetAllExpired(bool all_expired);
+
  private:
   IntrospectionServer() = default;
   void Loop();

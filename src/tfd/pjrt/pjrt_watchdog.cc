@@ -36,6 +36,7 @@
 
 #include <chrono>
 #include <cstring>
+#include <mutex>
 
 #include "tfd/gce/metadata.h"
 #include "tfd/obs/metrics.h"
@@ -396,6 +397,18 @@ struct FailureMemo {
 FailureMemo g_failure_memo;
 constexpr int kMaxBackoffS = 15 * 60;
 
+// One mutex guards every process-global above, plus a generation token:
+// the probe broker runs Init on a worker thread, and a worker wedged
+// inside a probe can be DETACHED across a SIGHUP reload
+// (sched/broker.cc Stop), so its late write-backs would otherwise race
+// both the invalidation and the next config generation's worker. The
+// lock is held only around global reads/writes — never across a probe
+// or the metadata overlay — and any write-back whose generation token
+// is stale (a SIGHUP happened mid-probe) is dropped, so facts probed
+// under a dead configuration can never repopulate the cache.
+std::mutex g_probe_cache_mu;
+unsigned long long g_cache_generation = 0;
+
 class PjrtWatchdogManager : public Manager {
  public:
   explicit PjrtWatchdogManager(const config::Config& config)
@@ -412,45 +425,59 @@ class PjrtWatchdogManager : public Manager {
     // burning the init deadline; expiry falls through to a live retry.
     const bool memoizable = flags_.pjrt_retry_backoff_s > 0 &&
                             flags_.device_health == "off";
-    if (memoizable && g_failure_memo.valid &&
-        g_failure_memo.key == cache_key) {
-      auto elapsed = std::chrono::steady_clock::now() -
-                     g_failure_memo.last_attempt;
-      if (elapsed < std::chrono::seconds(g_failure_memo.window_s)) {
-        return Status::Error(
-            g_failure_memo.error + " (memoized failure " +
-            std::to_string(g_failure_memo.consecutive) + "; retrying in <=" +
-            std::to_string(g_failure_memo.window_s) + "s)");
+    unsigned long long generation;
+    {
+      std::lock_guard<std::mutex> lock(g_probe_cache_mu);
+      generation = g_cache_generation;
+      if (memoizable && g_failure_memo.valid &&
+          g_failure_memo.key == cache_key) {
+        auto elapsed = std::chrono::steady_clock::now() -
+                       g_failure_memo.last_attempt;
+        if (elapsed < std::chrono::seconds(g_failure_memo.window_s)) {
+          return Status::Error(
+              g_failure_memo.error + " (memoized failure " +
+              std::to_string(g_failure_memo.consecutive) +
+              "; retrying in <=" +
+              std::to_string(g_failure_memo.window_s) + "s)");
+        }
       }
     }
 
-    Status s = InitProbe(cache_key);
+    Status s = InitProbe(cache_key, generation);
     if (!memoizable) return s;
-    if (s.ok()) {
-      g_failure_memo = {};
-    } else {
-      if (g_failure_memo.valid && g_failure_memo.key == cache_key) {
-        g_failure_memo.consecutive++;
-        g_failure_memo.window_s =
-            std::min(kMaxBackoffS, g_failure_memo.window_s * 2);
-      } else {
+    {
+      std::lock_guard<std::mutex> lock(g_probe_cache_mu);
+      // A SIGHUP landed mid-probe: this result belongs to a dead
+      // configuration — serve it to our (equally dead) caller, but
+      // never write it back.
+      if (g_cache_generation != generation) return s;
+      if (s.ok()) {
         g_failure_memo = {};
-        g_failure_memo.consecutive = 1;
-        // The cap applies to the FIRST window too: an operator value
-        // above 15m would otherwise start high and then SHRINK at the
-        // min() when doubled — backoff inverted.
-        g_failure_memo.window_s =
-            std::min(kMaxBackoffS, flags_.pjrt_retry_backoff_s);
+      } else {
+        if (g_failure_memo.valid && g_failure_memo.key == cache_key) {
+          g_failure_memo.consecutive++;
+          g_failure_memo.window_s =
+              std::min(kMaxBackoffS, g_failure_memo.window_s * 2);
+        } else {
+          g_failure_memo = {};
+          g_failure_memo.consecutive = 1;
+          // The cap applies to the FIRST window too: an operator value
+          // above 15m would otherwise start high and then SHRINK at the
+          // min() when doubled — backoff inverted.
+          g_failure_memo.window_s =
+              std::min(kMaxBackoffS, flags_.pjrt_retry_backoff_s);
+        }
+        g_failure_memo.valid = true;
+        g_failure_memo.key = cache_key;
+        g_failure_memo.error = s.message();
+        g_failure_memo.last_attempt = std::chrono::steady_clock::now();
       }
-      g_failure_memo.valid = true;
-      g_failure_memo.key = cache_key;
-      g_failure_memo.error = s.message();
-      g_failure_memo.last_attempt = std::chrono::steady_clock::now();
     }
     return s;
   }
 
-  Status InitProbe(const std::string& cache_key) {
+  Status InitProbe(const std::string& cache_key,
+                   unsigned long long generation) {
     // Snapshot cache — applies to the watchdog AND in-process paths.
     // Bypassed when device-health is enabled: those labels vouch that the
     // stack was probed THIS pass (tpu_labeler times Init for probe-ms);
@@ -459,38 +486,64 @@ class PjrtWatchdogManager : public Manager {
     // labels are explicitly choosing per-pass chip probes.
     const bool cacheable = flags_.pjrt_refresh_interval_s > 0 &&
                            flags_.device_health == "off";
-    if (cacheable && g_snapshot_cache.valid &&
-        g_snapshot_cache.key == cache_key &&
-        std::chrono::steady_clock::now() - g_snapshot_cache.taken_at <
-            std::chrono::seconds(flags_.pjrt_refresh_interval_s)) {
-      devices_ = g_snapshot_cache.devices;
-      libtpu_version_ = g_snapshot_cache.libtpu_version;
-      runtime_version_ = g_snapshot_cache.runtime_version;
-      topology_ = g_snapshot_cache.topology;
+    CachedSnapshot cached;  // copy: the overlay below runs unlocked
+    {
+      std::lock_guard<std::mutex> lock(g_probe_cache_mu);
+      if (cacheable && g_snapshot_cache.valid &&
+          g_snapshot_cache.key == cache_key &&
+          std::chrono::steady_clock::now() - g_snapshot_cache.taken_at <
+              std::chrono::seconds(flags_.pjrt_refresh_interval_s)) {
+        cached = g_snapshot_cache;
+      }
+    }
+    if (cached.valid) {
+      devices_ = cached.devices;
+      libtpu_version_ = cached.libtpu_version;
+      runtime_version_ = cached.runtime_version;
+      topology_ = cached.topology;
       // Pinned snapshots re-run the cheap metadata overlay every pass so
       // the slice.* labels stay live (and a transiently-failed first
       // overlay recovers promptly) without re-grabbing the chips.
-      if (g_snapshot_cache.pinned &&
+      if (cached.pinned &&
           platform::MetadataPlausible(flags_.metadata_endpoint)) {
-        topology_ = g_snapshot_cache.pinned_topology;
+        topology_ = cached.pinned_topology;
         std::string overlay_error;
-        if (OverlayFromMetadata(&overlay_error)) {
-          g_snapshot_cache.topology = topology_;  // freshen last-good
-          g_overlay_failure_warned = false;
+        bool overlaid = OverlayFromMetadata(&overlay_error);
+        std::lock_guard<std::mutex> lock(g_probe_cache_mu);
+        // Freshen last-good / warn-on-edge only while the cache entry
+        // is still this generation's and ours.
+        bool still_ours = g_cache_generation == generation &&
+                          g_snapshot_cache.valid &&
+                          g_snapshot_cache.key == cache_key;
+        if (overlaid) {
+          if (still_ours) {
+            g_snapshot_cache.topology = topology_;  // freshen last-good
+            g_overlay_failure_warned = false;
+          }
         } else {
-          if (!g_overlay_failure_warned) {
+          if (still_ours && !g_overlay_failure_warned) {
             TFD_LOG_WARNING << "slice topology overlay failed ("
                             << overlay_error
                             << "); serving the last known slice view "
                                "(warning once until it recovers)";
             g_overlay_failure_warned = true;
           }
-          topology_ = g_snapshot_cache.topology;
+          topology_ = still_ours ? g_snapshot_cache.topology
+                                 : cached.topology;
         }
       }
       initialized_ = true;
       return Status::Ok();
     }
+
+    // Cache miss from here on: a REAL probe runs (and briefly holds the
+    // exclusive chips). The counter is the soak harness's re-probe
+    // signal — per-tick broker probes that hit the cache never bump it.
+    obs::Default()
+        .GetCounter("tfd_pjrt_cache_refreshes_total",
+                    "PJRT probes that actually ran (snapshot-cache "
+                    "misses); each briefly holds the exclusive chips.")
+        ->Inc();
 
     // Escape hatch: no deadline configured → plain in-process init. The
     // client is shut down (releasing the exclusive chips) as soon as the
@@ -517,15 +570,18 @@ class PjrtWatchdogManager : public Manager {
       inproc->Shutdown();
       initialized_ = true;
       if (cacheable) {
-        g_snapshot_cache = {true,
-                            cache_key,
-                            std::chrono::steady_clock::now(),
-                            devices_,
-                            libtpu_version_,
-                            runtime_version_,
-                            topology_,
-                            /*pinned=*/false,
-                            /*pinned_topology=*/{}};
+        std::lock_guard<std::mutex> lock(g_probe_cache_mu);
+        if (g_cache_generation == generation) {
+          g_snapshot_cache = {true,
+                              cache_key,
+                              std::chrono::steady_clock::now(),
+                              devices_,
+                              libtpu_version_,
+                              runtime_version_,
+                              topology_,
+                              /*pinned=*/false,
+                              /*pinned_topology=*/{}};
+        }
       }
       return Status::Ok();
     }
@@ -613,6 +669,8 @@ class PjrtWatchdogManager : public Manager {
     }
 
     TopologyInfo pinned_view;
+    bool overlay_warned_edge = false;
+    bool overlay_recovered = false;
     if (plan.pin) {
       // Whatever the overlay yields, a pinned snapshot must not claim the
       // pinned artifacts (process_index 0, num_hosts 1, host-sized
@@ -625,14 +683,14 @@ class PjrtWatchdogManager : public Manager {
         // a failure here opens (or continues) the same episode its
         // per-pass retries belong to.
         if (OverlayFromMetadata(&overlay_error)) {
-          g_overlay_failure_warned = false;
+          overlay_recovered = true;
         } else {
           TFD_LOG_WARNING << "pinned PJRT init succeeded but the slice "
                              "topology overlay failed ("
                           << overlay_error
                           << "); slice labels are degraded until "
                              "metadata answers";
-          g_overlay_failure_warned = true;
+          overlay_warned_edge = true;
         }
       }
     }
@@ -640,16 +698,23 @@ class PjrtWatchdogManager : public Manager {
     // The overlaid topology is cached only as the last-good fallback —
     // cache hits on pinned snapshots re-run the overlay each pass, so a
     // failed overlay here is never frozen for the refresh interval.
-    if (cacheable) {
-      g_snapshot_cache = {true,
-                          cache_key,
-                          std::chrono::steady_clock::now(),
-                          devices_,
-                          libtpu_version_,
-                          runtime_version_,
-                          topology_,
-                          plan.pin,
-                          pinned_view};
+    {
+      std::lock_guard<std::mutex> lock(g_probe_cache_mu);
+      if (g_cache_generation == generation) {
+        if (overlay_recovered) g_overlay_failure_warned = false;
+        if (overlay_warned_edge) g_overlay_failure_warned = true;
+        if (cacheable) {
+          g_snapshot_cache = {true,
+                              cache_key,
+                              std::chrono::steady_clock::now(),
+                              devices_,
+                              libtpu_version_,
+                              runtime_version_,
+                              topology_,
+                              plan.pin,
+                              pinned_view};
+        }
+      }
     }
     return Status::Ok();
   }
@@ -742,6 +807,19 @@ class PjrtWatchdogManager : public Manager {
 
 ManagerPtr NewPjrtManager(const config::Config& config) {
   return std::make_shared<PjrtWatchdogManager>(config);
+}
+
+void InvalidatePjrtProbeCaches() {
+  // SIGHUP config regen: snapshots probed under the previous
+  // configuration must not be served into the new one. The generation
+  // bump makes any in-flight probe's eventual write-back a no-op — a
+  // wedged worker the broker DETACHED can complete minutes later and
+  // must find its result unwanted.
+  std::lock_guard<std::mutex> lock(g_probe_cache_mu);
+  g_cache_generation++;
+  g_snapshot_cache = {};
+  g_failure_memo = {};
+  g_overlay_failure_warned = false;
 }
 
 }  // namespace resource

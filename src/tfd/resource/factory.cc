@@ -6,58 +6,77 @@
 namespace tfd {
 namespace resource {
 
-namespace {
-
-Result<ManagerPtr> SelectManager(const config::Config& config) {
+std::vector<BackendCandidate> BackendCandidates(
+    const config::Config& config) {
   const config::Flags& f = config.flags;
-  if (f.backend == "null") return NewNullManager();
-  if (f.backend == "mock") return NewMockManager(f.mock_topology_file);
-  if (f.backend == "pjrt") return NewPjrtManager(config);
-  if (f.backend == "metadata") return NewMetadataManager(f.metadata_endpoint);
+  std::vector<BackendCandidate> out;
+  if (f.backend == "null") {
+    out.push_back({"null", [] {
+                     return Result<ManagerPtr>(NewNullManager());
+                   }});
+    return out;
+  }
+  if (f.backend == "mock") {
+    std::string fixture = f.mock_topology_file;
+    out.push_back(
+        {"mock", [fixture] { return NewMockManager(fixture); }});
+    return out;
+  }
+  if (f.backend == "pjrt") {
+    config::Config captured = config;
+    out.push_back({"pjrt", [captured] {
+                     return Result<ManagerPtr>(NewPjrtManager(captured));
+                   }});
+    return out;
+  }
+  if (f.backend == "metadata") {
+    std::string endpoint = f.metadata_endpoint;
+    out.push_back({"metadata", [endpoint] {
+                     return Result<ManagerPtr>(
+                         NewMetadataManager(endpoint));
+                   }});
+    return out;
+  }
 
   // auto (reference getManager, factory.go:41-73). Unlike the reference's
-  // single-winner probe, auto builds a *fallback chain*: a TPU VM whose
+  // single-winner probe, auto yields a *candidate ladder*: a TPU VM whose
   // chips are already held by a training job makes PJRT client creation
   // fail, but the metadata backend can still label the node fully — so
-  // PJRT falls back to metadata (on GCE) before giving up.
+  // PJRT degrades to metadata (on GCE) before giving up.
   std::string libtpu_path;
   bool has_libtpu = platform::HasLibtpu(f.libtpu_path, &libtpu_path);
   bool has_accel = platform::HasAccelDevice();
   bool on_gce = platform::OnGce();
-  std::vector<ManagerPtr> chain;
   if (has_libtpu || has_accel) {
     TFD_LOG_INFO << "detected TPU stack (libtpu="
                  << (has_libtpu ? libtpu_path : "no")
                  << ", accel-devices=" << (has_accel ? "yes" : "no")
                  << "); trying the PJRT backend first";
-    ManagerPtr pjrt = NewPjrtManager(config);
-    if (on_gce || !f.metadata_endpoint.empty()) {
-      pjrt = NewMetadataEnrichedManager(pjrt, f.metadata_endpoint);
-    }
-    chain.push_back(std::move(pjrt));
+    config::Config captured = config;
+    bool enrich = on_gce || !f.metadata_endpoint.empty();
+    std::string endpoint = f.metadata_endpoint;
+    out.push_back({"pjrt", [captured, enrich, endpoint] {
+                     ManagerPtr pjrt = NewPjrtManager(captured);
+                     if (enrich) {
+                       pjrt = NewMetadataEnrichedManager(pjrt, endpoint);
+                     }
+                     return Result<ManagerPtr>(std::move(pjrt));
+                   }});
   }
   if (on_gce || !f.metadata_endpoint.empty()) {
-    chain.push_back(NewMetadataManager(f.metadata_endpoint));
+    std::string endpoint = f.metadata_endpoint;
+    out.push_back({"metadata", [endpoint] {
+                     return Result<ManagerPtr>(
+                         NewMetadataManager(endpoint));
+                   }});
   }
-  if (chain.empty()) {
+  if (out.empty()) {
     TFD_LOG_INFO << "no TPU stack detected; using the null backend";
-    return NewNullManager();
+    out.push_back({"null", [] {
+                     return Result<ManagerPtr>(NewNullManager());
+                   }});
   }
-  if (chain.size() == 1) return chain[0];
-  return NewFallbackChain(std::move(chain));
-}
-
-}  // namespace
-
-Result<ManagerPtr> NewManager(const config::Config& config) {
-  Result<ManagerPtr> manager = SelectManager(config);
-  if (!manager.ok()) return manager;
-  // WithConfig (reference factory.go:32-38): without fail-on-init-error,
-  // degrade to null on Init failure instead of crash-looping.
-  if (!config.flags.fail_on_init_error) {
-    return ManagerPtr(NewFallbackToNullOnInitError(*manager));
-  }
-  return manager;
+  return out;
 }
 
 }  // namespace resource

@@ -9,13 +9,39 @@
 // handles); neither → Null.
 #pragma once
 
+#include <functional>
+#include <string>
+#include <vector>
+
 #include "tfd/config/config.h"
 #include "tfd/resource/types.h"
 
 namespace tfd {
 namespace resource {
 
-Result<ManagerPtr> NewManager(const config::Config& config);
+// One backend the node could be labeled from. `make` builds a FRESH
+// manager per call (Init is one-shot per object); construction-shaped
+// errors (missing fixture, bad flags) surface through the Result. The
+// probe scheduler (sched/sources.cc) maps each candidate to a probe
+// source and the render ladder (cmd/) replaces the old synchronous
+// NewManager/fallback-chain entry point; the chain decorators below
+// remain as tested building blocks.
+struct BackendCandidate {
+  std::string name;  // pjrt | metadata | mock | null
+  std::function<Result<ManagerPtr>()> make;
+};
+
+// The ordered candidate list for this node (preferred first), mirroring
+// the old auto-selection: TPU stack -> pjrt (metadata-enriched on GCE),
+// GCE -> metadata, neither -> null. Explicit --backend values yield the
+// single matching candidate. Never empty. Platform detection (and its
+// log lines) runs here, once per call.
+std::vector<BackendCandidate> BackendCandidates(const config::Config& config);
+
+// Drops the PJRT watchdog's process-global snapshot cache and failure
+// memo (pjrt_watchdog.cc). Called on SIGHUP: a config regen must not
+// serve device facts probed under the previous configuration.
+void InvalidatePjrtProbeCaches();
 
 // The PJRT (libtpu) backend. A watchdog manager (pjrt_watchdog.cc): init
 // runs in a forked child under flags.pjrt_init_timeout_s so a blocking

@@ -85,6 +85,16 @@ class Manager {
 
 using ManagerPtr = std::shared_ptr<Manager>;
 
+// Optional mixin for managers that serve a pre-probed snapshot view
+// (sched/sources.cc): reports how long the probe that produced the
+// snapshot actually took, so health probe-ms reflects the real
+// init+enumeration latency rather than a no-op snapshot Init.
+class ProbeTimed {
+ public:
+  virtual ~ProbeTimed() = default;
+  virtual double ProbeSeconds() const = 0;
+};
+
 // Null manager: no devices; version queries error
 // (reference internal/resource/null.go:30-57).
 ManagerPtr NewNullManager();

@@ -1,0 +1,223 @@
+#include "tfd/sched/broker.h"
+
+#include <algorithm>
+#include <chrono>
+#include <condition_variable>
+#include <mutex>
+#include <random>
+#include <thread>
+
+#include "tfd/obs/metrics.h"
+#include "tfd/util/logging.h"
+
+namespace tfd {
+namespace sched {
+
+double BackoffWithJitter(int consecutive_failures, int initial_s, int max_s,
+                         double unit_random) {
+  if (initial_s < 1) initial_s = 1;
+  if (max_s < initial_s) max_s = initial_s;
+  int exponent = std::max(0, consecutive_failures - 1);
+  // 2^31 s is already beyond any cap; avoid shift overflow outright.
+  double base = exponent >= 31
+                    ? static_cast<double>(max_s)
+                    : std::min<double>(max_s,
+                                       static_cast<double>(initial_s) *
+                                           (1u << exponent));
+  double jitter = std::clamp(unit_random, 0.0, 1.0);
+  return base * (1.0 + 0.25 * jitter);
+}
+
+struct BrokerControl {
+  std::shared_ptr<SnapshotStore> store;
+  std::mutex mu;
+  std::condition_variable cv;
+  bool stop = false;
+  int workers_done = 0;
+  // Serializes device-touching probes (exclusive chips).
+  std::mutex device_mu;
+  std::vector<std::thread> threads;
+};
+
+namespace {
+
+// One probe invocation + its metrics + the store write. Shared by the
+// oneshot round and the daemon workers; a free function over the
+// control block because a detached (wedged) worker may outlive the
+// broker object itself. Returns whether the probe succeeded; on
+// success *success_interval_s (when non-null) receives the next-probe
+// cadence, resolved against spec.interval_for before the snapshot is
+// moved into the store.
+bool RunProbeOnce(BrokerControl& control, const ProbeSpec& spec,
+                  int* success_interval_s = nullptr) {
+  obs::Registry& reg = obs::Default();
+  reg.GetCounter("tfd_probe_attempts_total",
+                 "Probe invocations, per source (steady-state ticks "
+                 "included; cache hits inside a backend count as cheap "
+                 "successes).",
+                 {{"source", spec.name}})
+      ->Inc();
+  Snapshot snapshot;
+  bool fatal = false;
+  auto t0 = std::chrono::steady_clock::now();
+  Status s = Status::Ok();
+  {
+    std::unique_lock<std::mutex> device_lock(control.device_mu,
+                                             std::defer_lock);
+    if (spec.exclusive) device_lock.lock();
+    s = spec.probe(&snapshot, &fatal);
+  }
+  double seconds = obs::SecondsSince(t0);
+  reg.GetHistogram("tfd_probe_duration_seconds",
+                   "Wall time of one probe invocation, per source.",
+                   obs::DurationBuckets(), {{"source", spec.name}})
+      ->Observe(seconds);
+  if (s.ok()) {
+    snapshot.probe_seconds = seconds;
+    if (success_interval_s != nullptr) {
+      *success_interval_s = spec.interval_for ? spec.interval_for(snapshot)
+                                              : spec.interval_s;
+    }
+    control.store->PutOk(spec.name, std::move(snapshot));
+    return true;
+  }
+  reg.GetCounter("tfd_probe_failures_total",
+                 "Probe invocations that failed, per source.",
+                 {{"source", spec.name}})
+      ->Inc();
+  control.store->PutError(spec.name, s.message(), fatal);
+  TFD_LOG_WARNING << "probe " << spec.name << " failed: " << s.message();
+  return false;
+}
+
+void WorkerLoop(std::shared_ptr<BrokerControl> control, ProbeSpec spec) {
+  // Per-worker seed: jitter spreads a fleet without coordinating — two
+  // daemons that failed at the same instant still re-probe at
+  // different moments.
+  std::mt19937 rng(static_cast<unsigned>(
+      std::hash<std::thread::id>()(std::this_thread::get_id())));
+  std::uniform_real_distribution<double> unit(0.0, 1.0);
+  for (;;) {
+    {
+      std::unique_lock<std::mutex> lock(control->mu);
+      if (control->stop) break;
+    }
+    int success_interval_s = spec.interval_s;
+    bool ok = RunProbeOnce(*control, spec, &success_interval_s);
+    double sleep_s;
+    if (ok) {
+      sleep_s = success_interval_s;
+      control->store->SetBackoff(spec.name, 0);
+    } else if (spec.backoff_initial_s == spec.backoff_max_s) {
+      // Flat policy (the PJRT source): the tick cadence IS the retry
+      // contract — the backend's own failure memo provides the real
+      // backoff, and jitter would only drift re-probes out of step
+      // with the rewrite passes.
+      sleep_s = spec.backoff_initial_s;
+      control->store->SetBackoff(spec.name, sleep_s);
+    } else {
+      int consecutive = control->store->View(spec.name).consecutive_failures;
+      sleep_s = BackoffWithJitter(consecutive, spec.backoff_initial_s,
+                                  spec.backoff_max_s, unit(rng));
+      control->store->SetBackoff(spec.name, sleep_s);
+    }
+    obs::Default()
+        .GetGauge("tfd_probe_backoff_seconds",
+                  "Current failure-backoff window, per source (0: "
+                  "healthy).",
+                  {{"source", spec.name}})
+        ->Set(ok ? 0 : sleep_s);
+    // Sleep in <=1s slices so stop requests and rerun_early triggers
+    // (chip-count changes) interrupt a long cadence.
+    auto wake_at = std::chrono::steady_clock::now() +
+                   std::chrono::duration_cast<
+                       std::chrono::steady_clock::duration>(
+                       std::chrono::duration<double>(sleep_s));
+    bool stop_seen = false;
+    for (;;) {
+      std::unique_lock<std::mutex> lock(control->mu);
+      if (control->stop) {
+        stop_seen = true;
+        break;
+      }
+      auto now = std::chrono::steady_clock::now();
+      if (now >= wake_at) break;
+      auto slice = std::min<std::chrono::steady_clock::duration>(
+          wake_at - now, std::chrono::seconds(1));
+      control->cv.wait_for(lock, slice);
+      lock.unlock();
+      if (spec.rerun_early && spec.rerun_early()) break;
+    }
+    if (stop_seen) break;
+  }
+  {
+    std::lock_guard<std::mutex> lock(control->mu);
+    control->workers_done++;
+  }
+  control->cv.notify_all();
+}
+
+}  // namespace
+
+ProbeBroker::ProbeBroker(std::shared_ptr<SnapshotStore> store,
+                         std::vector<ProbeSpec> specs)
+    : control_(std::make_shared<BrokerControl>()), specs_(std::move(specs)) {
+  control_->store = std::move(store);
+}
+
+ProbeBroker::~ProbeBroker() { Stop(); }
+
+void ProbeBroker::Start() {
+  if (started_) return;
+  started_ = true;
+  for (const ProbeSpec& spec : specs_) {
+    control_->threads.emplace_back(WorkerLoop, control_, spec);
+  }
+}
+
+void ProbeBroker::Stop(int grace_ms) {
+  if (control_ == nullptr) return;
+  {
+    std::lock_guard<std::mutex> lock(control_->mu);
+    if (control_->stop && control_->threads.empty()) return;
+    control_->stop = true;
+  }
+  control_->cv.notify_all();
+  // Bounded join: a worker wedged inside a probe (FIFO open, hung
+  // dlopen) must not block a SIGHUP reload or clean exit forever.
+  {
+    std::unique_lock<std::mutex> lock(control_->mu);
+    control_->cv.wait_for(
+        lock, std::chrono::milliseconds(grace_ms), [this] {
+          return control_->workers_done ==
+                 static_cast<int>(control_->threads.size());
+        });
+  }
+  bool all_done;
+  {
+    std::lock_guard<std::mutex> lock(control_->mu);
+    all_done = control_->workers_done ==
+               static_cast<int>(control_->threads.size());
+  }
+  for (std::thread& thread : control_->threads) {
+    if (!thread.joinable()) continue;
+    if (all_done) {
+      thread.join();
+    } else {
+      thread.detach();
+    }
+  }
+  control_->threads.clear();
+}
+
+void ProbeBroker::RunOneRound() {
+  bool device_served = false;
+  for (const ProbeSpec& spec : specs_) {
+    if (spec.device_source && device_served) continue;  // chain early-exit
+    bool ok = RunProbeOnce(*control_, spec);
+    if (spec.device_source && ok) device_served = true;
+  }
+}
+
+}  // namespace sched
+}  // namespace tfd

@@ -1,0 +1,106 @@
+// Asynchronous probe broker: one worker per probe source.
+//
+// Each source (PJRT enumeration, GCE metadata, device-health exec, the
+// mock/null test backends) gets its own worker thread with its own
+// re-probe cadence, retry budget, and exponential backoff with jitter;
+// results land in the SnapshotStore (sched/snapshot.h) that the label
+// loop renders from. The decoupling is the point: a wedged libtpu (or a
+// FIFO-swapped fixture, or a 4-minute health exec) stalls ITS worker,
+// never the rewrite cadence.
+//
+// Two lifecycles:
+//   Start()/Stop()  — daemon mode. Workers are real threads; Stop()
+//                     signals them and joins with a bounded grace,
+//                     detaching any worker wedged inside a probe (the
+//                     worker holds only shared_ptr state, so detaching
+//                     is safe — its late writes land in a store the
+//                     next config load no longer reads).
+//   RunOneRound()   — --oneshot. Probes run synchronously on the
+//                     calling thread in registration order, stopping at
+//                     the first device source that succeeds (the old
+//                     fallback chain's early-exit), then label sources.
+//                     No threads are ever created.
+//
+// Backoff: after a failure the worker sleeps
+// BackoffWithJitter(consecutive_failures, initial, max, u) seconds —
+// initial * 2^(n-1) clamped to max, stretched by up to +25% jitter so a
+// fleet of daemons whose chips were grabbed by the same job does not
+// re-probe in lockstep. The PJRT source sets initial == max == the
+// sleep interval: its real backoff lives in the watchdog's failure memo
+// (pjrt_watchdog.cc), which makes per-tick re-probes instant, keeps the
+// memoized-failure log visible, and preserves the chip-grab guarantees
+// the backend tests pin down.
+#pragma once
+
+#include <functional>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "tfd/sched/snapshot.h"
+#include "tfd/util/status.h"
+
+namespace tfd {
+namespace sched {
+
+struct ProbeSpec {
+  std::string name;
+  // Fills `out` (manager or labels payload) on success. `fatal` set
+  // true marks a construction-shaped error (see SourceView::fatal_error).
+  std::function<Status(Snapshot* out, bool* fatal)> probe;
+  int interval_s = 60;         // re-probe cadence after success
+  int backoff_initial_s = 60;  // first failure backoff window
+  int backoff_max_s = 900;     // backoff cap
+  // Optional per-result cadence override, computed from the successful
+  // snapshot before it lands in the store (the health source re-measures
+  // a ran-but-unhealthy exec sooner than a healthy one).
+  std::function<int(const Snapshot&)> interval_for;
+  bool device_source = true;   // participates in the degradation ladder
+  // Device-touching probes (PJRT, health exec) serialize on a shared
+  // lock: TPU access is exclusive, and the health exec's own jax client
+  // must never race the watchdog child for the chips.
+  bool exclusive = false;
+  // Checked once per second while sleeping between probes; returning
+  // true re-probes immediately (the health source re-runs when the
+  // enumerated chip count changes).
+  std::function<bool()> rerun_early;
+};
+
+// Pure backoff rule, unit-tested for its bounds: with base =
+// min(max_s, initial_s * 2^(consecutive_failures-1)), returns
+// base * (1 + 0.25 * unit_random) — never below base, never above
+// 1.25 * base. unit_random must be in [0, 1).
+double BackoffWithJitter(int consecutive_failures, int initial_s, int max_s,
+                         double unit_random);
+
+// Shared worker state; lives at namespace scope so a detached (wedged)
+// worker can keep it alive after the broker object is gone.
+struct BrokerControl;
+
+class ProbeBroker {
+ public:
+  ProbeBroker(std::shared_ptr<SnapshotStore> store,
+              std::vector<ProbeSpec> specs);
+  ~ProbeBroker();  // Stop()
+
+  ProbeBroker(const ProbeBroker&) = delete;
+  ProbeBroker& operator=(const ProbeBroker&) = delete;
+
+  // Daemon mode: one worker thread per spec.
+  void Start();
+  // Signals workers, joins each for up to `grace_ms` total, detaches
+  // stragglers (wedged probes). Idempotent.
+  void Stop(int grace_ms = 2000);
+
+  // Oneshot mode: synchronous, in-order, early-exit after the first
+  // successful device source. Never spawns a thread.
+  void RunOneRound();
+
+ private:
+  std::shared_ptr<BrokerControl> control_;
+  std::vector<ProbeSpec> specs_;
+  bool started_ = false;
+};
+
+}  // namespace sched
+}  // namespace tfd

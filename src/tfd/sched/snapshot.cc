@@ -1,0 +1,153 @@
+#include "tfd/sched/snapshot.h"
+
+namespace tfd {
+namespace sched {
+
+const char* TierName(Tier tier) {
+  switch (tier) {
+    case Tier::kNone:
+      return "none";
+    case Tier::kFresh:
+      return "fresh";
+    case Tier::kStaleUsable:
+      return "stale-usable";
+    case Tier::kExpired:
+      return "expired";
+  }
+  return "none";
+}
+
+Tier TierForAge(double age_s, const TierPolicy& policy) {
+  if (age_s < 0) return Tier::kNone;
+  if (age_s <= policy.fresh_for_s) return Tier::kFresh;
+  if (age_s <= policy.usable_for_s) return Tier::kStaleUsable;
+  return Tier::kExpired;
+}
+
+void SnapshotStore::Register(const std::string& source,
+                             const TierPolicy& policy, bool device_source) {
+  std::lock_guard<std::mutex> lock(mu_);
+  if (states_.find(source) == states_.end()) order_.push_back(source);
+  State& state = states_[source];
+  state.policy = policy;
+  state.device_source = device_source;
+}
+
+void SnapshotStore::PutOk(const std::string& source, Snapshot snapshot) {
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = states_.find(source);
+    if (it == states_.end()) return;  // unregistered: dropped
+    snapshot.version = next_version_++;
+    if (snapshot.taken_at == std::chrono::steady_clock::time_point()) {
+      snapshot.taken_at = std::chrono::steady_clock::now();
+    }
+    it->second.last_ok = std::move(snapshot);
+    it->second.settled = true;
+    it->second.last_error.clear();
+    it->second.fatal_error = false;
+    it->second.consecutive_failures = 0;
+    it->second.backoff_s = 0;
+  }
+  settled_cv_.notify_all();
+}
+
+void SnapshotStore::PutError(const std::string& source,
+                             const std::string& error, bool fatal) {
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = states_.find(source);
+    if (it == states_.end()) return;
+    it->second.settled = true;
+    it->second.last_error = error;
+    it->second.fatal_error = fatal;
+    it->second.consecutive_failures++;
+  }
+  settled_cv_.notify_all();
+}
+
+void SnapshotStore::InvalidateAll() {
+  std::lock_guard<std::mutex> lock(mu_);
+  for (auto& [name, state] : states_) {
+    state.last_ok.reset();
+    state.settled = false;
+    state.last_error.clear();
+    state.fatal_error = false;
+    state.consecutive_failures = 0;
+    state.backoff_s = 0;
+  }
+}
+
+void SnapshotStore::SetBackoff(const std::string& source, double backoff_s) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = states_.find(source);
+  if (it != states_.end()) it->second.backoff_s = backoff_s;
+}
+
+SourceView SnapshotStore::View(const std::string& source) const {
+  std::lock_guard<std::mutex> lock(mu_);
+  SourceView view;
+  auto it = states_.find(source);
+  if (it == states_.end()) return view;
+  const State& state = it->second;
+  view.registered = true;
+  view.settled = state.settled;
+  view.device_source = state.device_source;
+  view.last_ok = state.last_ok;
+  view.last_error = state.last_error;
+  view.fatal_error = state.fatal_error;
+  view.consecutive_failures = state.consecutive_failures;
+  view.backoff_s = state.backoff_s;
+  if (state.last_ok.has_value()) {
+    view.age_s = std::chrono::duration<double>(
+                     std::chrono::steady_clock::now() -
+                     state.last_ok->taken_at)
+                     .count();
+  }
+  view.tier = TierForAge(view.age_s, state.policy);
+  return view;
+}
+
+std::vector<std::string> SnapshotStore::Sources() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return order_;
+}
+
+std::vector<std::string> SnapshotStore::DeviceSources() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  std::vector<std::string> out;
+  for (const std::string& name : order_) {
+    if (states_.at(name).device_source) out.push_back(name);
+  }
+  return out;
+}
+
+bool SnapshotStore::AllSettled() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  for (const auto& [name, state] : states_) {
+    if (!state.settled) return false;
+  }
+  return true;
+}
+
+bool SnapshotStore::WaitAllSettled(std::chrono::milliseconds timeout) const {
+  std::unique_lock<std::mutex> lock(mu_);
+  return settled_cv_.wait_for(lock, timeout, [this] {
+    for (const auto& [name, state] : states_) {
+      if (!state.settled) return false;
+    }
+    return true;
+  });
+}
+
+void SnapshotStore::AgeForTest(const std::string& source, double seconds) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = states_.find(source);
+  if (it == states_.end() || !it->second.last_ok.has_value()) return;
+  it->second.last_ok->taken_at -=
+      std::chrono::duration_cast<std::chrono::steady_clock::duration>(
+          std::chrono::duration<double>(seconds));
+}
+
+}  // namespace sched
+}  // namespace tfd

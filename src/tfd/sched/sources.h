@@ -1,0 +1,37 @@
+// Probe-source construction: maps the configured backends (and the
+// device-health exec) onto broker ProbeSpecs + store registrations.
+//
+// Device sources come from resource::BackendCandidates(config) — the
+// same ordered candidate list the old fallback chain used (pjrt before
+// metadata before null), so the degradation ladder walks exactly the
+// order --backend=auto used to try synchronously. Each probe constructs
+// a FRESH manager, Init()s it (the PJRT watchdog's snapshot cache and
+// failure memo make steady-state re-probes instant and chip-free), and
+// captures the result into an inert SnapshotManager the render loop can
+// use any number of times without re-touching hardware.
+//
+// The health source (--device-health=full only) runs the health exec on
+// its own cadence with the measured chip count from the newest
+// device-touching snapshot, re-running early when that count changes —
+// the same staleness rules the labeler's in-pass cache used, now off
+// the rewrite path.
+#pragma once
+
+#include <memory>
+#include <vector>
+
+#include "tfd/config/config.h"
+#include "tfd/sched/broker.h"
+#include "tfd/sched/snapshot.h"
+
+namespace tfd {
+namespace sched {
+
+// Registers every source (with its staleness policy) in `store` and
+// returns the matching broker specs. Call once per config load.
+std::vector<ProbeSpec> BuildProbeSpecs(
+    const config::Config& config,
+    const std::shared_ptr<SnapshotStore>& store);
+
+}  // namespace sched
+}  // namespace tfd

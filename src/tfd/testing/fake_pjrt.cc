@@ -25,6 +25,15 @@
 //                            host-pinning env is present (see below) —
 //                            models real libtpu's slice-wide rendezvous
 //                            waiting for peers that never arrive
+//   TFD_FAKE_PJRT_HANG_IF_FILE  client creation blocks forever WHILE the
+//                            named file exists — a wedge that starts (and
+//                            ends) mid-run, for degrade-then-recover
+//                            tests of the probe scheduler (env is fixed
+//                            at daemon start; a file isn't)
+//   TFD_FAKE_PJRT_INIT_DELAY_MS  sleep this long before creating the
+//                            client — a SLOW (but healthy) init, the
+//                            cold-node shape the async scheduler serves
+//                            metadata-only labels through
 //
 // Host-pinning emulation (mirrors real libtpu semantics): when
 // TPU_HOST_BOUNDS or TPU_PROCESS_BOUNDS is "1,1,1", the client creates
@@ -183,13 +192,25 @@ PJRT_Error* ClientCreate(PJRT_Client_Create_Args* args) {
   bool pinned = EnvStr("TPU_HOST_BOUNDS", "") == "1,1,1" ||
                 EnvStr("TPU_PROCESS_BOUNDS", "") == "1,1,1";
 
-  // Hang modes: unconditional (wedged driver), or rendezvous-shaped
-  // (blocks only when asked to bring up the whole slice). SIGKILL from
-  // the watchdog is the only way out, exactly like the real thing.
+  // Slow-init emulation: a healthy client that simply takes a while
+  // (cold libtpu, busy node). Applied before the hang checks so a
+  // delayed-then-wedged combination still wedges.
+  int delay_ms = EnvInt("TFD_FAKE_PJRT_INIT_DELAY_MS", 0);
+  if (delay_ms > 0) usleep(static_cast<useconds_t>(delay_ms) * 1000);
+
+  // Hang modes: unconditional (wedged driver), rendezvous-shaped
+  // (blocks only when asked to bring up the whole slice), or file-gated
+  // (wedged only while the file exists — re-checked each second so the
+  // wedge can lift mid-run). SIGKILL from the watchdog is the only way
+  // out of the first two, exactly like the real thing.
   bool hang = !EnvStr("TFD_FAKE_PJRT_HANG", "").empty() ||
               (!EnvStr("TFD_FAKE_PJRT_MULTIHOST_HANG", "").empty() &&
                !pinned);
   while (hang) sleep(3600);
+  std::string hang_file = EnvStr("TFD_FAKE_PJRT_HANG_IF_FILE", "");
+  if (!hang_file.empty()) {
+    while (access(hang_file.c_str(), F_OK) == 0) sleep(1);
+  }
 
   auto* client = new FakeClient();
   client->platform_version = EnvStr("TFD_FAKE_PJRT_VERSION", "fake 9.9.9");

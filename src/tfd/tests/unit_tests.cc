@@ -10,6 +10,7 @@
 #include <sys/wait.h>
 #include <unistd.h>
 
+#include <atomic>
 #include <cassert>
 #include <cstdio>
 #include <cstdlib>
@@ -29,7 +30,10 @@
 #include "tfd/obs/server.h"
 #include "tfd/pjrt/pjrt_binding.h"
 #include "tfd/platform/detect.h"
+#include "tfd/resource/factory.h"
 #include "tfd/resource/types.h"
+#include "tfd/sched/broker.h"
+#include "tfd/sched/snapshot.h"
 #include "tfd/slice/shape.h"
 #include "tfd/slice/topology.h"
 #include "tfd/util/file.h"
@@ -1219,6 +1223,278 @@ void TestIntrospectionServer() {
   (*server)->Stop();
 }
 
+void TestReadyzAllExpired() {
+  // "Degraded-but-serving is ready; expired-everything is not": with
+  // rewrites succeeding and fresh, SetAllExpired alone must flip
+  // /readyz, and clearing it must restore readiness.
+  obs::Registry reg;
+  obs::ServerOptions options;
+  options.addr = "127.0.0.1:0";
+  options.stale_after_s = 60;
+  Result<std::unique_ptr<obs::IntrospectionServer>> server =
+      obs::IntrospectionServer::Start(options, &reg);
+  CHECK_TRUE(server.ok());
+  std::string base =
+      "http://127.0.0.1:" + std::to_string((*server)->port());
+  http::RequestOptions ropt;
+  ropt.timeout_ms = 3000;
+
+  (*server)->RecordRewrite(true);
+  Result<http::Response> r =
+      http::Request("GET", base + "/readyz", "", ropt);
+  CHECK_TRUE(r.ok());
+  CHECK_EQ(r->status, 200);
+  (*server)->SetAllExpired(true);
+  r = http::Request("GET", base + "/readyz", "", ropt);
+  CHECK_TRUE(r.ok());
+  CHECK_EQ(r->status, 503);
+  CHECK_TRUE(r->body.find("expired") != std::string::npos);
+  CHECK_TRUE(http::Request("GET", base + "/healthz", "", ropt)->status ==
+             200);  // liveness unaffected
+  (*server)->SetAllExpired(false);
+  r = http::Request("GET", base + "/readyz", "", ropt);
+  CHECK_TRUE(r.ok());
+  CHECK_EQ(r->status, 200);
+  (*server)->Stop();
+}
+
+// ---- probe scheduler (sched/) --------------------------------------------
+
+void TestSnapshotTierTransitions() {
+  // Pure tier rule first.
+  sched::TierPolicy policy;
+  policy.fresh_for_s = 10;
+  policy.usable_for_s = 30;
+  CHECK_TRUE(sched::TierForAge(-1, policy) == sched::Tier::kNone);
+  CHECK_TRUE(sched::TierForAge(0, policy) == sched::Tier::kFresh);
+  CHECK_TRUE(sched::TierForAge(10, policy) == sched::Tier::kFresh);
+  CHECK_TRUE(sched::TierForAge(10.5, policy) == sched::Tier::kStaleUsable);
+  CHECK_TRUE(sched::TierForAge(30, policy) == sched::Tier::kStaleUsable);
+  CHECK_TRUE(sched::TierForAge(31, policy) == sched::Tier::kExpired);
+  CHECK_EQ(std::string(sched::TierName(sched::Tier::kStaleUsable)),
+           "stale-usable");
+
+  // Store transitions, driven through the test clock shift.
+  sched::SnapshotStore store;
+  store.Register("pjrt", policy, /*device_source=*/true);
+  store.Register("metadata", policy, /*device_source=*/true);
+  store.Register("health", policy, /*device_source=*/false);
+  CHECK_EQ(store.Sources().size(), size_t{3});
+  CHECK_EQ(store.DeviceSources().size(), size_t{2});
+  CHECK_TRUE(!store.AllSettled());
+
+  sched::SourceView view = store.View("pjrt");
+  CHECK_TRUE(view.registered && !view.settled);
+  CHECK_TRUE(view.tier == sched::Tier::kNone);
+
+  sched::Snapshot snapshot;
+  snapshot.manager = resource::NewNullManager();
+  store.PutOk("pjrt", snapshot);
+  view = store.View("pjrt");
+  CHECK_TRUE(view.settled && view.last_ok.has_value());
+  CHECK_TRUE(view.tier == sched::Tier::kFresh);
+  CHECK_TRUE(view.age_s >= 0 && view.age_s < 5);
+
+  store.AgeForTest("pjrt", 15);
+  CHECK_TRUE(store.View("pjrt").tier == sched::Tier::kStaleUsable);
+  store.AgeForTest("pjrt", 20);  // cumulative: 35s old
+  CHECK_TRUE(store.View("pjrt").tier == sched::Tier::kExpired);
+
+  // Failures settle a source and count up without clearing the last
+  // success; a new success resets the failure run.
+  store.PutError("metadata", "boom");
+  store.PutError("metadata", "boom again");
+  view = store.View("metadata");
+  CHECK_TRUE(view.settled && !view.last_ok.has_value());
+  CHECK_EQ(view.consecutive_failures, 2);
+  CHECK_EQ(view.last_error, "boom again");
+  CHECK_TRUE(!view.fatal_error);
+  store.PutError("metadata", "cannot even construct", /*fatal=*/true);
+  CHECK_TRUE(store.View("metadata").fatal_error);
+  store.PutOk("metadata", sched::Snapshot{});
+  view = store.View("metadata");
+  CHECK_EQ(view.consecutive_failures, 0);
+  CHECK_TRUE(!view.fatal_error && view.last_error.empty());
+
+  // Versions are store-global and monotone.
+  CHECK_TRUE(store.View("metadata").last_ok->version >
+             store.View("pjrt").last_ok->version);
+
+  store.PutOk("health", sched::Snapshot{});
+  CHECK_TRUE(store.AllSettled());
+  CHECK_TRUE(store.WaitAllSettled(std::chrono::milliseconds(1)));
+
+  // SIGHUP path: invalidation drops every result and settles nothing.
+  store.InvalidateAll();
+  CHECK_TRUE(!store.AllSettled());
+  CHECK_TRUE(store.View("pjrt").tier == sched::Tier::kNone);
+  CHECK_TRUE(!store.WaitAllSettled(std::chrono::milliseconds(1)));
+
+  // Unregistered sources are inert: no crash, nothing stored.
+  store.PutOk("bogus", sched::Snapshot{});
+  CHECK_TRUE(!store.View("bogus").registered);
+}
+
+void TestBackoffJitterBounds() {
+  // base = min(max, initial * 2^(n-1)); result in [base, 1.25 * base].
+  for (int n = 1; n <= 40; n++) {
+    for (double u : {0.0, 0.33, 0.999}) {
+      double d = sched::BackoffWithJitter(n, 2, 900, u);
+      double base = 2.0;
+      for (int i = 1; i < n && base < 900; i++) base *= 2;
+      if (base > 900) base = 900;
+      CHECK_TRUE(d >= base - 1e-9);
+      CHECK_TRUE(d <= 1.25 * base + 1e-9);
+    }
+  }
+  // Monotone in the failure count until the cap.
+  CHECK_TRUE(sched::BackoffWithJitter(2, 60, 900, 0) >
+             sched::BackoffWithJitter(1, 60, 900, 0));
+  CHECK_EQ(sched::BackoffWithJitter(1, 60, 900, 0.0), 60.0);
+  CHECK_EQ(sched::BackoffWithJitter(5, 60, 900, 0.0), 900.0);  // capped
+  // Degenerate inputs: clamped, never zero, never overflowing.
+  CHECK_TRUE(sched::BackoffWithJitter(1, 0, 0, 0.0) >= 1.0);
+  CHECK_TRUE(sched::BackoffWithJitter(1000000, 1, 900, 0.999) <=
+             1.25 * 900 + 1e-9);
+  CHECK_TRUE(sched::BackoffWithJitter(3, 60, 900, 2.0) <=
+             1.25 * 240 + 1e-9);  // out-of-range jitter clamped
+}
+
+void TestProbeBrokerOneRound() {
+  // Early-exit: once a device source succeeds, later device sources are
+  // not probed (the old fallback chain's semantics), but label sources
+  // still run.
+  auto store = std::make_shared<sched::SnapshotStore>();
+  sched::TierPolicy policy{10, 30};
+  store->Register("a", policy, true);
+  store->Register("b", policy, true);
+  store->Register("labels", policy, false);
+  int a_runs = 0, b_runs = 0, label_runs = 0;
+  std::vector<sched::ProbeSpec> specs(3);
+  specs[0].name = "a";
+  specs[0].device_source = true;
+  specs[0].probe = [&a_runs](sched::Snapshot*, bool*) {
+    a_runs++;
+    return Status::Error("a down");
+  };
+  specs[1].name = "b";
+  specs[1].device_source = true;
+  specs[1].probe = [&b_runs](sched::Snapshot* out, bool*) {
+    b_runs++;
+    out->manager = resource::NewNullManager();
+    return Status::Ok();
+  };
+  specs[2].name = "labels";
+  specs[2].device_source = false;
+  specs[2].probe = [&label_runs](sched::Snapshot* out, bool*) {
+    label_runs++;
+    out->labels["google.com/tpu.health.ok"] = "true";
+    return Status::Ok();
+  };
+  {
+    sched::ProbeBroker broker(store, specs);
+    broker.RunOneRound();
+  }
+  CHECK_EQ(a_runs, 1);
+  CHECK_EQ(b_runs, 1);
+  CHECK_EQ(label_runs, 1);
+  CHECK_TRUE(!store->View("a").last_ok.has_value());
+  CHECK_TRUE(store->View("b").last_ok.has_value());
+  CHECK_EQ(store->View("labels").last_ok->labels.size(), size_t{1});
+
+  // Second round on a fresh store with "a" healthy: "b" is skipped.
+  store->InvalidateAll();
+  specs[0].probe = [&a_runs](sched::Snapshot* out, bool*) {
+    a_runs++;
+    out->manager = resource::NewNullManager();
+    return Status::Ok();
+  };
+  {
+    sched::ProbeBroker broker(store, specs);
+    broker.RunOneRound();
+  }
+  CHECK_EQ(a_runs, 2);
+  CHECK_EQ(b_runs, 1);  // unchanged: early-exit
+  CHECK_TRUE(!store->View("b").settled);
+}
+
+void TestProbeBrokerWorkers() {
+  // Daemon mode: workers re-probe on their own cadence, failures set
+  // the backoff state, and Stop() joins healthy workers promptly.
+  auto store = std::make_shared<sched::SnapshotStore>();
+  sched::TierPolicy policy{10, 30};
+  store->Register("good", policy, true);
+  store->Register("bad", policy, true);
+  std::atomic<int> good_runs{0}, bad_runs{0};
+  std::vector<sched::ProbeSpec> specs(2);
+  specs[0].name = "good";
+  specs[0].interval_s = 0;  // re-probe immediately
+  specs[0].probe = [&good_runs](sched::Snapshot* out, bool*) {
+    good_runs++;
+    out->manager = resource::NewNullManager();
+    usleep(10 * 1000);
+    return Status::Ok();
+  };
+  specs[1].name = "bad";
+  specs[1].backoff_initial_s = 0;
+  specs[1].backoff_max_s = 1;
+  specs[1].probe = [&bad_runs](sched::Snapshot*, bool*) {
+    bad_runs++;
+    usleep(10 * 1000);
+    return Status::Error("still down");
+  };
+  auto t0 = std::chrono::steady_clock::now();
+  {
+    sched::ProbeBroker broker(store, specs);
+    broker.Start();
+    while (good_runs.load() < 3 &&
+           std::chrono::steady_clock::now() - t0 < std::chrono::seconds(10)) {
+      usleep(20 * 1000);
+    }
+    broker.Stop();
+  }
+  double elapsed = std::chrono::duration<double>(
+                       std::chrono::steady_clock::now() - t0)
+                       .count();
+  CHECK_TRUE(good_runs.load() >= 3);
+  CHECK_TRUE(bad_runs.load() >= 1);
+  CHECK_TRUE(elapsed < 10);  // Stop() did not hang on healthy workers
+  CHECK_TRUE(store->View("good").last_ok.has_value());
+  sched::SourceView bad = store->View("bad");
+  CHECK_TRUE(bad.settled && !bad.last_ok.has_value());
+  CHECK_TRUE(bad.consecutive_failures >= 1);
+}
+
+void TestBackendCandidatesList() {
+  config::Config config;
+  config.flags.backend = "null";
+  std::vector<resource::BackendCandidate> candidates =
+      resource::BackendCandidates(config);
+  CHECK_EQ(candidates.size(), size_t{1});
+  CHECK_EQ(candidates[0].name, "null");
+  Result<resource::ManagerPtr> made = candidates[0].make();
+  CHECK_TRUE(made.ok());
+  CHECK_EQ((*made)->Name(), "null");
+
+  // Construction-shaped errors surface through the Result, per probe.
+  config.flags.backend = "mock";
+  config.flags.mock_topology_file = "/nonexistent/fixture.yaml";
+  candidates = resource::BackendCandidates(config);
+  CHECK_EQ(candidates.size(), size_t{1});
+  CHECK_TRUE(!candidates[0].make().ok());
+
+  // Explicit backends yield exactly one candidate; `make` builds a
+  // FRESH manager each call (Init is one-shot per object).
+  config.flags.backend = "metadata";
+  candidates = resource::BackendCandidates(config);
+  CHECK_EQ(candidates.size(), size_t{1});
+  CHECK_EQ(candidates[0].name, "metadata");
+  Result<resource::ManagerPtr> first = candidates[0].make();
+  Result<resource::ManagerPtr> second = candidates[0].make();
+  CHECK_TRUE(first.ok() && second.ok());
+  CHECK_TRUE(first->get() != second->get());
+}
+
 }  // namespace
 }  // namespace tfd
 
@@ -1275,6 +1551,12 @@ int main(int argc, char** argv) {
   tfd::TestValidateExposition();
   tfd::TestListenAddrParse();
   tfd::TestIntrospectionServer();
+  tfd::TestReadyzAllExpired();
+  tfd::TestSnapshotTierTransitions();
+  tfd::TestBackoffJitterBounds();
+  tfd::TestProbeBrokerOneRound();
+  tfd::TestProbeBrokerWorkers();
+  tfd::TestBackendCandidatesList();
 
   std::cerr << tfd::g_checks << " checks, " << tfd::g_failures << " failures"
             << std::endl;

@@ -80,6 +80,17 @@ Status WriteFileAtomically(const std::string& path,
 }
 
 Status RemoveFileIfExists(const std::string& path) {
+  // Regular files only: the caller is cleaning up a feature file it
+  // wrote. An operator pointing --output-file at a device node or FIFO
+  // (e.g. /dev/null to discard labels) must not lose the node on clean
+  // exit — a root daemon deleting /dev/null takes the host's stdio
+  // sink with it.
+  struct stat st;
+  if (lstat(path.c_str(), &st) != 0) {
+    if (errno == ENOENT) return Status::Ok();
+    return Status::Error("unable to stat " + path + ": " + strerror(errno));
+  }
+  if (!S_ISREG(st.st_mode)) return Status::Ok();
   if (unlink(path.c_str()) != 0 && errno != ENOENT) {
     return Status::Error("unable to remove " + path + ": " + strerror(errno));
   }

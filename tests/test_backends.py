@@ -1170,6 +1170,103 @@ class TestRelayPjrtPlugin:
         assert text.count(f"loaded {so}") == 1, text[-2000:]
 
 
+@pytest.mark.skipif(relay_pjrt_plugin() is None,
+                    reason="no relay PJRT plugin exported on this host")
+class TestRelayContractCanary:
+    """Drift canary (VERDICT r5 weak #6): tpufd/relay.py hardcodes the
+    relay plugin's NamedValue contract (rank sentinel, topology shape,
+    remote-compile mode). If the environment's OWN jax registration of
+    the same plugin disagrees, the daemon's --pjrt-client-option set is
+    wrong, pjrt_real silently reverts to null, and the only real-silicon
+    proof of the C++ path disappears. This test derives the expected
+    options from the ambient registration — NOT from relay.py's
+    constants — and FAILS (never skips) on any disagreement while the
+    plugin is present."""
+
+    # Fresh-per-call / session-identity keys: excluded from comparison.
+    SESSION_KEYS = {"session_id", "session", "client_id"}
+
+    @staticmethod
+    def _normalize(value):
+        """Canonical string form matching the daemon's value-typing
+        inference (bools as 1/0, numbers as their int form)."""
+        if isinstance(value, bool):
+            return "1" if value else "0"
+        try:
+            return str(int(str(value)))
+        except (TypeError, ValueError):
+            return str(value)
+
+    @classmethod
+    def _ambient_registration_options(cls, so):
+        """The options dict the environment's jax plugin registration
+        carries for the relay .so, unwrapped from the registered backend
+        factory (functools.partial chains and closures). None when no
+        registration references the .so."""
+        import functools
+        import jax  # noqa: F401 — triggers plugin discovery/registration
+
+        from jax._src import xla_bridge
+
+        def unwrap(obj, depth=0):
+            """(library_path, options) pairs reachable from a factory."""
+            found = []
+            if depth > 6 or obj is None:
+                return found
+            if isinstance(obj, functools.partial):
+                kw = dict(obj.keywords or {})
+                if "library_path" in kw or "options" in kw:
+                    found.append((kw.get("library_path"),
+                                  kw.get("options")))
+                for arg in list(obj.args) + list(kw.values()):
+                    found.extend(unwrap(arg, depth + 1))
+                found.extend(unwrap(obj.func, depth + 1))
+            elif callable(obj):
+                closure = getattr(obj, "__closure__", None) or ()
+                for cell in closure:
+                    try:
+                        found.extend(unwrap(cell.cell_contents, depth + 1))
+                    except ValueError:
+                        continue
+            return found
+
+        factories = getattr(xla_bridge, "_backend_factories", {})
+        for registration in factories.values():
+            factory = getattr(registration, "factory", registration)
+            if isinstance(factory, tuple):
+                factory = factory[0]
+            for library_path, options in unwrap(factory):
+                if library_path == so and options is not None:
+                    if callable(options):
+                        options = options()
+                    return dict(options)
+        return None
+
+    def test_relay_options_match_ambient_registration(self):
+        so, args = relay_pjrt_plugin()
+        ambient = self._ambient_registration_options(so)
+        assert ambient is not None, (
+            f"relay plugin {so} is present but no jax backend "
+            "registration carrying create-options references it — the "
+            "ambient contract moved out from under tpufd/relay.py; "
+            "update relay.py (and this canary's introspection) against "
+            "the current bootstrap")
+        # relay.py's options, parsed back out of its CLI encoding.
+        ours = {}
+        for chunk in args[1::2]:
+            for option in chunk.split(";"):
+                key, _, value = option.partition("=")
+                ours[key] = value
+        ambient_cmp = {k: self._normalize(v) for k, v in ambient.items()
+                       if k not in self.SESSION_KEYS}
+        ours_cmp = {k: self._normalize(v) for k, v in ours.items()
+                    if k not in self.SESSION_KEYS}
+        assert ours_cmp == ambient_cmp, (
+            "tpufd/relay.py's hardcoded contract drifted from the "
+            f"environment's own registration for {so}:\n"
+            f"  relay.py : {ours_cmp}\n  ambient  : {ambient_cmp}")
+
+
 def _real_libtpu_path():
     try:
         import libtpu  # noqa: PLC0415 — optional, probed at test time

@@ -1,0 +1,332 @@
+"""Tier 2/3: the async probe scheduler (src/tfd/sched/) against the
+real binary — the degradation ladder end to end.
+
+The contract under test (ISSUE 2 acceptance): a node with a wedged or
+slow PJRT plugin gets its FIRST labels in well under the init deadline
+(metadata-only, degradation level 2), converges to full PJRT labels
+once the background probe lands, degrades to cached labels (snapshot-age
++ degraded markers) when the probe wedges mid-run — without ever missing
+a rewrite tick — and recovers. --oneshot stays fully synchronous.
+"""
+
+import os
+import signal
+import subprocess
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from conftest import BUILD_DIR, FIXTURES, run_tfd, labels_of
+from tpufd import metrics
+from tpufd.fakes import free_loopback_port as free_port
+from tpufd.fakes.metadata_server import FakeMetadataServer, tpu_vm
+
+FAKE_PJRT = BUILD_DIR / "libtfd_fake_pjrt.so"
+
+
+def http_get(port, path, timeout=2):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+    except (OSError, urllib.error.URLError):
+        return None, ""
+
+
+def wait_for(predicate, timeout=30, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def degradation_level(port):
+    text = http_get(port, "/metrics")[1]
+    if not text:
+        return None
+    return metrics.sample_value(text, "tfd_probe_degradation_level")
+
+
+def read_labels(out_file):
+    try:
+        return labels_of(out_file.read_text())
+    except (OSError, ValueError):
+        return {}
+
+
+class TestWedgedAndSlowPjrt:
+    """The acceptance scenario: auto backend, fake PJRT plugin wedged
+    (or slow), fake GCE metadata answering — the busy-node cold start."""
+
+    @staticmethod
+    def launch(tfd_binary, tmp_path, server, port, env_extra, extra=()):
+        out_file = tmp_path / "tfd"
+        env = {**os.environ,
+               "GCE_METADATA_HOST": server.endpoint,
+               **env_extra}
+        proc = subprocess.Popen(
+            [str(tfd_binary), "--sleep-interval=1s", "--backend=auto",
+             f"--libtpu-path={FAKE_PJRT}",
+             f"--metadata-endpoint={server.endpoint}",
+             "--pjrt-init-timeout=1s", "--pjrt-retry-backoff=1s",
+             "--machine-type-file=/dev/null",
+             f"--output-file={out_file}",
+             f"--introspection-addr=127.0.0.1:{port}", *extra],
+            env=env, stderr=subprocess.DEVNULL)
+        return proc, out_file
+
+    def test_wedged_plugin_first_rewrite_is_fast_and_metadata_only(
+            self, tfd_binary, tmp_path):
+        """Wedged libtpu (hang > deadline): the first rewrite must land
+        within ~1s (vs the 30s the synchronous design burned), serving
+        the metadata rung (level 2), then converge to full PJRT labels
+        once the wedge lifts and the background probe succeeds."""
+        gate = tmp_path / "wedged"
+        gate.touch()
+        port = free_port()
+        with FakeMetadataServer(tpu_vm(
+                accelerator_type="v5litepod-4", topology="2x2")) as server:
+            t0 = time.monotonic()
+            proc, out_file = self.launch(
+                tfd_binary, tmp_path, server, port,
+                {"TFD_FAKE_PJRT_HANG_IF_FILE": str(gate),
+                 "TFD_FAKE_PJRT_KIND": "TPU v5 lite",
+                 "TFD_FAKE_PJRT_BOUNDS": "2,2,1"})
+            try:
+                assert wait_for(lambda: out_file.exists(), timeout=10)
+                first_labels_s = time.monotonic() - t0
+                # The acceptance bound is < 1s of daemon time; the
+                # assertion allows process-spawn overhead on a loaded
+                # CI host but stays an order of magnitude under the 30s
+                # deadline the old design burned.
+                assert first_labels_s < 2.5, (
+                    f"first rewrite took {first_labels_s:.2f}s")
+                labels = read_labels(out_file)
+                assert labels["google.com/tpu.backend"] == "metadata"
+                assert labels["google.com/tpu.count"] == "4"
+                # No degraded markers: the metadata rung serves fresh.
+                assert "google.com/tpu.degraded" not in labels
+                assert wait_for(lambda: degradation_level(port) == 2)
+
+                gate.unlink()  # the wedge lifts; next probe succeeds
+                assert wait_for(
+                    lambda: read_labels(out_file).get(
+                        "google.com/tpu.backend") == "pjrt",
+                    timeout=30), "never converged to PJRT labels"
+                assert wait_for(lambda: degradation_level(port) == 0)
+                assert read_labels(out_file).get(
+                    "google.com/libtpu.version.major") == "9"
+            finally:
+                proc.kill()
+                proc.wait(timeout=10)
+
+    def test_slow_plugin_converges_in_background(self, tfd_binary,
+                                                 tmp_path):
+        """A SLOW (healthy) init — delay well past the first rewrite —
+        must not block it: metadata labels first, PJRT labels once the
+        background probe lands."""
+        port = free_port()
+        with FakeMetadataServer(tpu_vm(
+                accelerator_type="v5litepod-4", topology="2x2")) as server:
+            proc, out_file = self.launch(
+                tfd_binary, tmp_path, server, port,
+                {"TFD_FAKE_PJRT_INIT_DELAY_MS": "3000",
+                 "TFD_FAKE_PJRT_KIND": "TPU v5 lite",
+                 "TFD_FAKE_PJRT_BOUNDS": "2,2,1"},
+                extra=("--pjrt-init-timeout=30s",))
+            try:
+                assert wait_for(lambda: out_file.exists(), timeout=10)
+                assert read_labels(out_file)[
+                    "google.com/tpu.backend"] == "metadata"
+                assert wait_for(
+                    lambda: read_labels(out_file).get(
+                        "google.com/tpu.backend") == "pjrt",
+                    timeout=30)
+            finally:
+                proc.kill()
+                proc.wait(timeout=10)
+
+
+class TestDegradeRecover:
+    def test_wedge_mid_run_degrades_then_recovers_without_missed_ticks(
+            self, tfd_binary, tmp_path):
+        """Healthy daemon; the plugin wedges mid-run (file-gated hang)
+        with a short refresh interval, so re-probes start failing: the
+        labels degrade to the cached snapshot (degraded=true +
+        snapshot-age), the rewrite cadence never misses a tick, and
+        removing the wedge recovers the full label set."""
+        gate = tmp_path / "wedged"
+        port = free_port()
+        out_file = tmp_path / "tfd"
+        proc = subprocess.Popen(
+            [str(tfd_binary), "--sleep-interval=1s", "--backend=pjrt",
+             f"--libtpu-path={FAKE_PJRT}",
+             "--pjrt-init-timeout=1s", "--pjrt-retry-backoff=1s",
+             "--pjrt-refresh-interval=2s",
+             "--machine-type-file=/dev/null",
+             f"--output-file={out_file}",
+             f"--introspection-addr=127.0.0.1:{port}"],
+            env={**os.environ, "GCE_METADATA_HOST": "127.0.0.1:1",
+                 "TFD_FAKE_PJRT_HANG_IF_FILE": str(gate),
+                 "TFD_FAKE_PJRT_KIND": "TPU v5 lite",
+                 "TFD_FAKE_PJRT_BOUNDS": "2,2,1"},
+            stderr=subprocess.DEVNULL)
+        try:
+            assert wait_for(
+                lambda: read_labels(out_file).get(
+                    "google.com/tpu.backend") == "pjrt", timeout=15)
+            assert wait_for(lambda: degradation_level(port) == 0)
+            rewrites_before = metrics.sample_value(
+                http_get(port, "/metrics")[1], "tfd_rewrites_total")
+
+            gate.touch()  # wedge: re-probes now hang -> watchdog kills
+            t_wedge = time.monotonic()
+            assert wait_for(
+                lambda: read_labels(out_file).get(
+                    "google.com/tpu.degraded") == "true",
+                timeout=30), "labels never degraded"
+            labels = read_labels(out_file)
+            # Cached device facts keep serving, with their age.
+            assert labels["google.com/tpu.backend"] == "pjrt"
+            assert labels["google.com/tpu.count"] == "4"
+            assert float(labels["google.com/tpu.snapshot-age-seconds"]) >= 0
+            assert degradation_level(port) == 1
+
+            # No missed rewrite ticks while degraded: the counter kept
+            # ticking through the wedge. The bound is deliberately loose
+            # (a third of wall-clock): CI load stretches both the 1s
+            # sigtimedwait and this test's own scrape round-trips, and
+            # the property under test is "kept rewriting", not "kept
+            # exact cadence".
+            elapsed = time.monotonic() - t_wedge
+            rewrites_now = metrics.sample_value(
+                http_get(port, "/metrics")[1], "tfd_rewrites_total")
+            assert rewrites_now - rewrites_before >= max(1, elapsed / 3), (
+                f"{rewrites_now - rewrites_before} rewrites in "
+                f"{elapsed:.1f}s")
+
+            gate.unlink()  # recovery
+            assert wait_for(
+                lambda: "google.com/tpu.degraded" not in
+                read_labels(out_file), timeout=30), "never recovered"
+            assert wait_for(lambda: degradation_level(port) == 0)
+            assert read_labels(out_file)[
+                "google.com/tpu.count"] == "4"
+        finally:
+            proc.kill()
+            proc.wait(timeout=10)
+
+
+class TestOneshot:
+    def test_oneshot_is_fully_synchronous(self, tfd_binary):
+        """--oneshot runs the probe round on the calling thread: a slow
+        plugin DELAYS the run (no background serving), and the labels
+        are the full PJRT set — proof there is no async path (and so no
+        thread) behind a oneshot pass."""
+        t0 = time.monotonic()
+        code, out, err = run_tfd(
+            tfd_binary,
+            ["--oneshot", "--output-file=", "--backend=pjrt",
+             f"--libtpu-path={FAKE_PJRT}", "--machine-type-file=/dev/null"],
+            env={"TFD_FAKE_PJRT_INIT_DELAY_MS": "1500",
+                 "TFD_FAKE_PJRT_KIND": "TPU v5 lite",
+                 "TFD_FAKE_PJRT_BOUNDS": "2,2,1"})
+        elapsed = time.monotonic() - t0
+        assert code == 0, err
+        assert elapsed >= 1.4, "oneshot did not wait for the probe"
+        labels = labels_of(out)
+        assert labels["google.com/tpu.backend"] == "pjrt"
+        assert labels["google.com/tpu.count"] == "4"
+        assert "google.com/tpu.degraded" not in labels
+
+    def test_oneshot_wedged_plugin_still_bounded_by_deadline(
+            self, tfd_binary):
+        """Oneshot + wedged plugin: the watchdog deadline still bounds
+        the (synchronous) probe, and the fallback posture matches the
+        old chain's — degrade to the minimal label set with
+        --fail-on-init-error=false."""
+        code, out, err = run_tfd(
+            tfd_binary,
+            ["--oneshot", "--output-file=", "--backend=pjrt",
+             f"--libtpu-path={FAKE_PJRT}", "--pjrt-init-timeout=1s",
+             "--fail-on-init-error=false",
+             "--machine-type-file=/dev/null"],
+            env={"TFD_FAKE_PJRT_HANG": "1"})
+        assert code == 0, err
+        assert "google.com/tpu.count" not in out
+
+
+class TestSighupInvalidation:
+    def test_sighup_drops_snapshots_and_reprobes(self, tfd_binary,
+                                                 tmp_path):
+        """Config regen invalidates snapshots: after SIGHUP the daemon
+        must re-probe the chips (one extra client creation) instead of
+        serving facts probed under the previous configuration."""
+        count_file = tmp_path / "creates"
+        out_file = tmp_path / "tfd"
+        proc = subprocess.Popen(
+            [str(tfd_binary), "--sleep-interval=1s", "--backend=pjrt",
+             f"--libtpu-path={FAKE_PJRT}", "--machine-type-file=/dev/null",
+             f"--output-file={out_file}"],
+            env={**os.environ, "GCE_METADATA_HOST": "127.0.0.1:1",
+                 "TFD_FAKE_PJRT_COUNT_FILE": str(count_file),
+                 "TFD_FAKE_PJRT_KIND": "TPU v5 lite",
+                 "TFD_FAKE_PJRT_BOUNDS": "2,2,1"},
+            stderr=subprocess.DEVNULL)
+
+        def creates():
+            try:
+                return len(count_file.read_text().splitlines())
+            except OSError:
+                return 0
+
+        try:
+            assert wait_for(
+                lambda: out_file.exists() and creates() == 1, timeout=15)
+            time.sleep(2)  # a few cached passes: still one creation
+            assert creates() == 1
+            proc.send_signal(signal.SIGHUP)
+            assert wait_for(lambda: creates() == 2, timeout=15), (
+                "SIGHUP did not invalidate the probe snapshot")
+        finally:
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=10)
+
+
+class TestSoakAcrossExpiry:
+    def test_soak_crosses_snapshot_expiry_boundaries(self, tfd_binary):
+        """VERDICT weak #4: a soak whose --pjrt-refresh-interval is
+        shorter than the window must observe >= 2 REAL re-probes
+        (snapshot-cache refreshes, from the daemon's own counter) with
+        churn-free labels, flat RSS/fds, and every source ending
+        fresh."""
+        import json
+        import sys
+        from pathlib import Path
+
+        soak = Path(__file__).resolve().parent.parent / "scripts" / "soak.py"
+        proc = subprocess.run(
+            [sys.executable, str(soak), "--binary", str(tfd_binary),
+             "--duration", "8",
+             "--require-counter", "tfd_pjrt_cache_refreshes_total:2",
+             "--extra-arg=--backend=pjrt",
+             f"--extra-arg=--libtpu-path={FAKE_PJRT}",
+             "--extra-arg=--pjrt-refresh-interval=2s"],
+            env={**os.environ, "GCE_METADATA_HOST": "127.0.0.1:1",
+                 "TFD_FAKE_PJRT_KIND": "TPU v5 lite",
+                 "TFD_FAKE_PJRT_BOUNDS": "2,2,1"},
+            capture_output=True, text=True, timeout=120)
+        report = json.loads(proc.stdout.splitlines()[-1])
+        assert proc.returncode == 0 and report["ok"] is True, report
+        assert report["counters_ok"] is True, report
+        assert report["counters"]["tfd_pjrt_cache_refreshes_total"] >= 2
+        assert report["labels_stable"] is True
+        assert report["rss_drift_kb"] <= 1024
+        assert report["fd_start"] == report["fd_end"]
+        assert report["snapshot_tiers"].get("pjrt") == "fresh", report

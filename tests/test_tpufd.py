@@ -481,3 +481,133 @@ def test_cli_health(cpu_jax, capsys):
     assert code == 0, out
     labels = dict(line.split("=", 1) for line in out.splitlines())
     assert labels["google.com/tpu.health.ok"] == "true"
+
+
+# ---- tpufd.sched: the Python twin of src/tfd/sched/ ----------------------
+
+
+def test_sched_backoff_parity_bounds():
+    """Formula parity with the C++ BackoffWithJitter (unit-tested in
+    src/tfd/tests/unit_tests.cc TestBackoffJitterBounds): base =
+    min(max, initial * 2^(n-1)), result in [base, 1.25 * base]."""
+    from tpufd import sched
+
+    for n in range(1, 41):
+        for u in (0.0, 0.33, 0.999):
+            d = sched.backoff_with_jitter(n, 2, 900, u)
+            base = min(900.0, 2.0 * (1 << min(n - 1, 30)))
+            assert base - 1e-9 <= d <= 1.25 * base + 1e-9, (n, u, d)
+    assert sched.backoff_with_jitter(1, 60, 900, 0.0) == 60.0
+    assert sched.backoff_with_jitter(5, 60, 900, 0.0) == 900.0  # capped
+    assert sched.backoff_with_jitter(2, 60, 900, 0.0) > \
+        sched.backoff_with_jitter(1, 60, 900, 0.0)
+    # Degenerate inputs clamp exactly like the C++ side.
+    assert sched.backoff_with_jitter(1, 0, 0, 0.0) >= 1.0
+    assert sched.backoff_with_jitter(10**6, 1, 900, 0.999) <= \
+        1.25 * 900 + 1e-9
+    assert sched.backoff_with_jitter(3, 60, 900, 2.0) <= 1.25 * 240 + 1e-9
+
+
+def test_sched_tiers_match_daemon_policy():
+    """tier_of + device_policy mirror sched/sources.cc: fresh for
+    4 ticks + deadline, usable for 6 more (or the override)."""
+    from tpufd import sched
+
+    policy = sched.device_policy(sleep_interval_s=1)
+    assert policy.fresh_for_s == 4 and policy.usable_for_s == 10
+    assert sched.tier_of(None, policy) == sched.NONE
+    assert sched.tier_of(0, policy) == sched.FRESH
+    assert sched.tier_of(4, policy) == sched.FRESH
+    assert sched.tier_of(4.5, policy) == sched.STALE_USABLE
+    assert sched.tier_of(10, policy) == sched.STALE_USABLE
+    assert sched.tier_of(10.5, policy) == sched.EXPIRED
+    wide = sched.device_policy(60, deadline_s=30, usable_override_s=600)
+    assert wide.fresh_for_s == 270 and wide.usable_for_s == 600
+
+
+def test_sched_snapshot_store_views():
+    from tpufd import sched
+
+    store = sched.SnapshotStore()
+    store.register("pjrt", sched.TierPolicy(10, 30))
+    view = store.view("pjrt", now=100.0)
+    assert not view["settled"] and view["tier"] == sched.NONE
+
+    store.put_ok("pjrt", {"chips": 4}, now=100.0)
+    view = store.view("pjrt", now=105.0)
+    assert view["settled"] and view["tier"] == sched.FRESH
+    assert view["age_s"] == 5.0 and view["value"] == {"chips": 4}
+    assert store.view("pjrt", now=120.0)["tier"] == sched.STALE_USABLE
+    assert store.view("pjrt", now=131.0)["tier"] == sched.EXPIRED
+
+    store.put_error("pjrt", "boom")
+    store.put_error("pjrt", "boom again")
+    view = store.view("pjrt", now=131.0)
+    assert view["consecutive_failures"] == 2
+    assert view["error"] == "boom again"
+    assert view["value"] == {"chips": 4}  # last success survives
+    store.put_ok("pjrt", {"chips": 4}, now=131.0)
+    assert store.view("pjrt", now=131.0)["consecutive_failures"] == 0
+
+
+def test_sched_probe_scheduler_retries_with_backoff():
+    """A transiently-raising probe retries within its budget (sleeping
+    the jittered backoff), records per-probe attempts, and re-raises
+    once the budget is spent."""
+    from tpufd import metrics, sched
+
+    registry = metrics.Registry()
+    sleeps = []
+    scheduler = sched.ProbeScheduler(
+        registry=registry, retry_budget=2, sleep=sleeps.append)
+
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("tunnel hiccup")
+        return 42.0
+
+    assert scheduler.run("matmul-tflops", flaky) == 42.0
+    assert calls["n"] == 3
+    assert len(sleeps) == 2 and all(s > 0 for s in sleeps)
+    text = registry.render()
+    assert metrics.sample_value(
+        text, "tpufd_probe_attempts_total",
+        labels={"probe": "matmul-tflops"}) == 3
+    assert metrics.sample_value(
+        text, "tpufd_probe_retries_total",
+        labels={"probe": "matmul-tflops"}) == 2
+
+    def always_down():
+        raise RuntimeError("chip held")
+
+    with pytest.raises(RuntimeError, match="chip held"):
+        scheduler.run("hbm-gbps", always_down)
+    # Budget of 2 retries -> exactly 3 attempts.
+    assert metrics.sample_value(
+        registry.render(), "tpufd_probe_attempts_total",
+        labels={"probe": "hbm-gbps"}) == 3
+
+
+def test_sched_health_labels_retry_transient_probe(cpu_jax, monkeypatch):
+    """health_labels routes its core probes through the scheduler: one
+    transient raise must not flip ok=false (TPUFD_PROBE_RETRIES covers
+    it), proving the wiring end to end on the CPU mesh."""
+    from tpufd import health
+
+    real = health.matmul_tflops
+    state = {"raised": False}
+
+    def flaky_matmul(*args, **kwargs):
+        if not state["raised"]:
+            state["raised"] = True
+            raise RuntimeError("transient")
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(health, "matmul_tflops", flaky_matmul)
+    labels = health.health_labels()
+    assert state["raised"], "fake transient never triggered"
+    assert labels["google.com/tpu.health.ok"] == "true"
+    assert "google.com/tpu.health.matmul-tflops" in labels

@@ -497,13 +497,24 @@ def health_labels(prefix="google.com/tpu.health.", extended=False):
             if pct < DEGRADED_PCT:
                 labels[prefix + name + "-degraded"] = "true"
 
+    # Core probes run through the probe scheduler (tpufd.sched, the
+    # Python twin of the daemon's sched/ broker): a transient raise —
+    # tunnel jitter, a briefly-held chip — retries with the shared
+    # jittered backoff instead of immediately flipping ok=false.
+    from tpufd import sched as sched_lib
+
+    scheduler = sched_lib.ProbeScheduler(
+        retry_budget=int(os.environ.get("TPUFD_PROBE_RETRIES", "1")))
+
     probe_t0 = time.perf_counter()
     try:
-        with_rated(timed_probe("matmul-tflops", lambda: median_probe(
-            lambda: matmul_tflops(size=size))),
+        with_rated(scheduler.run("matmul-tflops", lambda: timed_probe(
+            "matmul-tflops", lambda: median_probe(
+                lambda: matmul_tflops(size=size)))),
                    RATED_MATMUL_TFLOPS, "matmul-tflops")
-        with_rated(timed_probe("hbm-gbps", lambda: median_probe(
-            lambda: hbm_gbps(mib=mib))),
+        with_rated(scheduler.run("hbm-gbps", lambda: timed_probe(
+            "hbm-gbps", lambda: median_probe(
+                lambda: hbm_gbps(mib=mib)))),
                    RATED_HBM_GBPS, "hbm-gbps")
         if extended:
             # Own try: the DMA probe is an opt-in diagnostic, and a

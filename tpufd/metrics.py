@@ -184,8 +184,17 @@ class Registry:
                         f'{k}="{_escape_label_value(v)}"'
                         for k, v in items)
                     if kind == "histogram":
+                        # One coherent read: +Inf and _count derive from
+                        # the same per-bucket values just rendered (the
+                        # C++ TakeSnapshot rule) — reading child.count
+                        # here could observe an observe() between its
+                        # bucket increment and its count increment and
+                        # emit +Inf < a finite bucket, which
+                        # validate_exposition itself rejects.
+                        counts = list(child.counts)
+                        total = sum(counts) + child.overflow
                         cumulative = 0
-                        for bound, n in zip(child.bounds, child.counts):
+                        for bound, n in zip(child.bounds, counts):
                             cumulative += n
                             le = _format_value(bound)
                             sep = "," if labels else ""
@@ -194,11 +203,11 @@ class Registry:
                                 f"{cumulative}")
                         sep = "," if labels else ""
                         out.append(f'{name}_bucket{{{labels}{sep}le="+Inf"}} '
-                                   f"{child.count}")
+                                   f"{total}")
                         suffix = f"{{{labels}}}" if labels else ""
                         out.append(f"{name}_sum{suffix} "
                                    f"{_format_value(child.sum)}")
-                        out.append(f"{name}_count{suffix} {child.count}")
+                        out.append(f"{name}_count{suffix} {total}")
                     else:
                         suffix = f"{{{labels}}}" if labels else ""
                         out.append(f"{name}{suffix} "
@@ -260,6 +269,13 @@ def parse_samples(text):
         if label_text:
             consumed = 0
             for lm in _LABEL_RE.finditer(label_text):
+                # Matches must be CONTIGUOUS from the start: an end-only
+                # check would silently drop junk-prefixed or
+                # space-separated labels ('a="1" ,b="2"') instead of
+                # rejecting the line like the C++ checker does.
+                if lm.start() != consumed:
+                    raise ValueError(
+                        f"unparseable label set in: {line!r}")
                 key, value = lm.group(1), lm.group(2)
                 if key in labels:
                     raise ValueError(f"duplicate label {key!r} in: {line!r}")

@@ -18,9 +18,11 @@
 // the rewrite cadence. --oneshot runs one synchronous probe round on
 // the main thread (no worker threads exist at all).
 #include <signal.h>
+#include <string.h>
 
 #include <chrono>
 #include <cstdio>
+#include <cstdlib>
 #include <memory>
 #include <vector>
 
@@ -31,10 +33,12 @@
 #include "tfd/lm/labeler.h"
 #include "tfd/lm/labels.h"
 #include "tfd/lm/machine_type.h"
+#include "tfd/lm/merge.h"
 #include "tfd/lm/schema.h"
 #include "tfd/lm/timestamp.h"
 #include "tfd/lm/tpu_labeler.h"
 #include "tfd/lm/tpuvm_labeler.h"
+#include "tfd/obs/journal.h"
 #include "tfd/obs/metrics.h"
 #include "tfd/obs/server.h"
 #include "tfd/platform/detect.h"
@@ -43,6 +47,7 @@
 #include "tfd/sched/snapshot.h"
 #include "tfd/sched/sources.h"
 #include "tfd/util/file.h"
+#include "tfd/util/jsonlite.h"
 #include "tfd/util/logging.h"
 
 namespace tfd {
@@ -129,12 +134,23 @@ lm::MachineTypeGetter MakeMachineTypeGetter(const config::Config& config) {
 struct ServeDecision {
   resource::ManagerPtr manager;  // null → minimal labels
   std::string source;
+  std::string tier = "none";  // TierName of the serving snapshot
   int level = 3;
   double age_s = -1;
   bool degraded_labels = false;
   bool all_expired = false;
   bool fatal = false;
   std::string fatal_error;
+};
+
+// What the last rewrite published, kept across passes (and SIGHUP
+// reloads) so every subsequent pass can be explained as a DIFF with
+// per-key provenance — the flight recorder's label-change record and
+// the /debug/labels document both derive from it.
+struct LabelState {
+  lm::Labels labels;
+  lm::Provenance provenance;
+  int last_level = -1;  // degradation rung of the previous pass
 };
 
 ServeDecision Decide(const sched::SnapshotStore& store,
@@ -147,6 +163,7 @@ ServeDecision Decide(const sched::SnapshotStore& store,
                            bool degraded, bool all_expired) {
     decision.manager = view.last_ok->manager;
     decision.source = name;
+    decision.tier = sched::TierName(view.tier);
     decision.level = level;
     decision.age_s = view.age_s;
     decision.degraded_labels = degraded;
@@ -218,12 +235,17 @@ ServeDecision Decide(const sched::SnapshotStore& store,
 // One labeling pass: render labelers against the decided snapshot,
 // merge, write. `*wrote_ok` reports whether labels actually landed in
 // the sink — false on every error path, including the transient
-// NodeFeature one that returns Ok to keep the daemon alive.
-Status LabelOnceInner(const config::Config& config, lm::Labeler& timestamp,
-                      lm::Labeler& machine_type, lm::Labeler& tpu_vm,
-                      const sched::SnapshotStore& store,
-                      const ServeDecision& decision, size_t* labels_emitted,
-                      bool* wrote_ok) {
+// NodeFeature one that returns Ok to keep the daemon alive. The merged
+// set and its per-key provenance land in `*merged_out`/`*provenance_out`
+// (for the label diff + /debug/labels), per-labeler timings in
+// `*span_fields` (for the journal's rewrite span).
+Status LabelOnceInner(
+    const config::Config& config, lm::Labeler& timestamp,
+    lm::Labeler& machine_type, lm::Labeler& tpu_vm,
+    const sched::SnapshotStore& store, const ServeDecision& decision,
+    size_t* labels_emitted, bool* wrote_ok, lm::Labels* merged_out,
+    lm::Provenance* provenance_out,
+    std::vector<std::pair<std::string, std::string>>* span_fields) {
   if (decision.fatal) {
     return Status::Error(decision.fatal_error.empty()
                              ? "no probe source could label this node"
@@ -236,20 +258,42 @@ Status LabelOnceInner(const config::Config& config, lm::Labeler& timestamp,
   if (!tpu.ok()) return tpu.status();
 
   // Merge order mirrors lm.NewLabelers (labeler.go:33-45): device labels
-  // first, then the VM/virtualization labeler; later labelers win.
+  // first, then the VM/virtualization labeler; later labelers win — so
+  // provenance follows the same later-wins rule.
   constexpr const char* kLabelerNames[] = {"timestamp", "machine-type",
                                            "tpu", "tpu-vm"};
   lm::Labels merged;
+  lm::Provenance provenance;
   size_t i = 0;
   for (lm::Labeler* labeler : std::vector<lm::Labeler*>{
            &timestamp, &machine_type, tpu->get(), &tpu_vm}) {
+    const char* name = kLabelerNames[i++];
     auto labeler_t0 = std::chrono::steady_clock::now();
     Result<lm::Labels> labels = labeler->GetLabels();
+    double seconds = obs::SecondsSince(labeler_t0);
     ObserveStageDuration("tfd_labeler_duration_seconds",
                          "GetLabels duration per labeler.", "labeler",
-                         kLabelerNames[i++], obs::SecondsSince(labeler_t0));
+                         name, seconds);
+    span_fields->emplace_back(
+        std::string("labeler_") + name + "_ms",
+        std::to_string(static_cast<long long>(seconds * 1000)));
     if (!labels.ok()) return labels.status();
-    for (auto& [k, v] : *labels) merged[k] = v;
+    // The device labeler's facts come from the serving snapshot; the
+    // host-derived labelers answer from local state ("local"/fresh).
+    lm::LabelProvenance from;
+    from.labeler = name;
+    if (std::string(name) == "tpu") {
+      from.source = decision.source.empty() ? "none" : decision.source;
+      from.tier = decision.tier;
+      from.age_s = decision.age_s < 0 ? 0 : decision.age_s;
+    } else {
+      from.source = "local";
+      from.tier = "fresh";
+    }
+    for (auto& [k, v] : *labels) {
+      merged[k] = v;
+      provenance[k] = from;
+    }
   }
 
   // Full-health exec labels ride in from the health worker's snapshot
@@ -261,7 +305,15 @@ Status LabelOnceInner(const config::Config& config, lm::Labeler& timestamp,
     sched::SourceView health = store.View("health");
     if (health.last_ok.has_value() &&
         health.tier != sched::Tier::kExpired) {
-      for (const auto& [k, v] : health.last_ok->labels) merged[k] = v;
+      lm::LabelProvenance from;
+      from.labeler = "health-exec";
+      from.source = "health";
+      from.tier = sched::TierName(health.tier);
+      from.age_s = health.age_s < 0 ? 0 : health.age_s;
+      for (const auto& [k, v] : health.last_ok->labels) {
+        merged[k] = v;
+        provenance[k] = from;
+      }
     }
   }
 
@@ -273,6 +325,13 @@ Status LabelOnceInner(const config::Config& config, lm::Labeler& timestamp,
     merged[lm::kDegraded] = "true";
     merged[lm::kSnapshotAge] =
         std::to_string(static_cast<long long>(decision.age_s));
+    lm::LabelProvenance from;
+    from.labeler = "scheduler";
+    from.source = decision.source;
+    from.tier = decision.tier;
+    from.age_s = decision.age_s < 0 ? 0 : decision.age_s;
+    provenance[lm::kDegraded] = from;
+    provenance[lm::kSnapshotAge] = from;
   }
 
   if (merged.size() <= 1) {
@@ -303,14 +362,88 @@ Status LabelOnceInner(const config::Config& config, lm::Labeler& timestamp,
 
   *labels_emitted = merged.size();
   *wrote_ok = true;
+  *merged_out = std::move(merged);
+  *provenance_out = std::move(provenance);
   return Status::Ok();
+}
+
+// The /debug/labels document: the exact label set the sink received
+// plus per-key provenance — built from the same merged map, so
+// reconstructing "key=value\n" lines from it matches the emitted label
+// file byte-for-byte.
+std::string LabelsDebugJson(uint64_t generation, const lm::Labels& labels,
+                            const lm::Provenance& provenance) {
+  std::string out = "{\"generation\":" + std::to_string(generation) +
+                    ",\"labels\":{";
+  bool first = true;
+  for (const auto& [k, v] : labels) {
+    if (!first) out += ",";
+    first = false;
+    // Sanitized for strict-UTF-8 consumers; real label keys/values are
+    // ASCII, so the byte-for-byte agreement with the feature file holds
+    // (a node emitting non-UTF8 labels WOULD fail that comparison —
+    // which is a finding, not an encoding accident).
+    out += jsonlite::Quote(jsonlite::SanitizeUtf8(k)) + ":" +
+           jsonlite::Quote(jsonlite::SanitizeUtf8(v));
+  }
+  out += "},\"provenance\":{";
+  first = true;
+  for (const auto& [k, from] : provenance) {
+    if (labels.count(k) == 0) continue;
+    if (!first) out += ",";
+    first = false;
+    char age[32];
+    snprintf(age, sizeof(age), "%.1f", from.age_s);
+    out += jsonlite::Quote(jsonlite::SanitizeUtf8(k)) + ":{\"labeler\":" +
+           jsonlite::Quote(from.labeler) + ",\"source\":" +
+           jsonlite::Quote(from.source) + ",\"tier\":" +
+           jsonlite::Quote(from.tier) + ",\"age_seconds\":" + age + "}";
+  }
+  return out + "}}";
+}
+
+// Journals the per-key label diff (with the provenance of each changed
+// key) and counts changes per bounded key prefix; updates `state` to
+// the just-published set.
+void RecordLabelDiff(const lm::Labels& merged,
+                     const lm::Provenance& provenance, LabelState* state) {
+  std::vector<lm::LabelDiffEntry> diff =
+      lm::DiffLabels(state->labels, merged);
+  obs::Registry& reg = obs::Default();
+  for (const lm::LabelDiffEntry& entry : diff) {
+    reg.GetCounter("tfd_label_changes_total",
+                   "Label keys added/removed/changed by a rewrite, by "
+                   "bounded key prefix.",
+                   {{"key_prefix", lm::LabelKeyPrefix(entry.key)}})
+        ->Inc();
+    // Removed keys are attributed to whoever produced them last.
+    const lm::Provenance& lookup =
+        entry.op == lm::LabelDiffEntry::Op::kRemoved ? state->provenance
+                                                     : provenance;
+    lm::LabelProvenance from;
+    auto it = lookup.find(entry.key);
+    if (it != lookup.end()) from = it->second;
+    obs::DefaultJournal().Record(
+        "label-diff", from.source,
+        std::string(lm::DiffOpName(entry.op)) + " " + entry.key,
+        {{"key", entry.key},
+         {"op", lm::DiffOpName(entry.op)},
+         {"old", entry.old_value},
+         {"new", entry.new_value},
+         {"labeler", from.labeler},
+         {"source", from.source},
+         {"tier", from.tier}});
+  }
+  state->labels = merged;
+  state->provenance = provenance;
 }
 
 Status LabelOnce(const config::Config& config, lm::Labeler& timestamp,
                  lm::Labeler& machine_type, lm::Labeler& tpu_vm,
                  const sched::SnapshotStore& store,
-                 obs::IntrospectionServer* server) {
+                 obs::IntrospectionServer* server, LabelState* state) {
   auto t0 = std::chrono::steady_clock::now();
+  uint64_t generation = obs::DefaultJournal().BeginRewrite();
   ServeDecision decision = Decide(store, config.flags);
 
   // Scheduler telemetry: the per-source snapshot ages and the ladder
@@ -332,12 +465,58 @@ Status LabelOnce(const config::Config& config, lm::Labeler& timestamp,
       ->Set(decision.level);
   if (server != nullptr) server->SetAllExpired(decision.all_expired);
 
+  // Degradation-ladder transitions: the flight recorder's {from,to}
+  // record (and metric), including the first pass's none→<level>.
+  if (decision.level != state->last_level) {
+    std::string from = state->last_level < 0
+                           ? "none"
+                           : std::to_string(state->last_level);
+    std::string to = std::to_string(decision.level);
+    reg.GetCounter("tfd_degradation_transitions_total",
+                   "Degradation-ladder rung changes between rewrites.",
+                   {{"from", from}, {"to", to}})
+        ->Inc();
+    obs::DefaultJournal().Record(
+        "degradation", decision.source,
+        "degradation level " + from + " -> " + to +
+            (decision.source.empty() ? "" : " serving " + decision.source),
+        {{"from", from}, {"to", to}, {"source", decision.source},
+         {"tier", decision.tier}});
+    state->last_level = decision.level;
+  }
+
   size_t labels_emitted = 0;
   bool wrote_ok = false;
+  lm::Labels merged;
+  lm::Provenance provenance;
+  std::vector<std::pair<std::string, std::string>> span_fields;
   Status s = LabelOnceInner(config, timestamp, machine_type, tpu_vm, store,
-                            decision, &labels_emitted, &wrote_ok);
+                            decision, &labels_emitted, &wrote_ok, &merged,
+                            &provenance, &span_fields);
   double seconds = obs::SecondsSince(t0);
   RecordRewriteOutcome(wrote_ok, labels_emitted, seconds, server);
+  if (wrote_ok) {
+    RecordLabelDiff(merged, provenance, state);
+    if (server != nullptr) {
+      server->SetLabelsJson(LabelsDebugJson(generation, merged, provenance));
+    }
+  }
+  // The per-rewrite span: outcome + serving decision + labeler timings,
+  // correlated by generation with every probe/diff/sink event above.
+  span_fields.insert(
+      span_fields.begin(),
+      {{"ok", wrote_ok ? "true" : "false"},
+       {"duration_ms",
+        std::to_string(static_cast<long long>(seconds * 1000))},
+       {"level", std::to_string(decision.level)},
+       {"source", decision.source},
+       {"tier", decision.tier},
+       {"labels", std::to_string(labels_emitted)}});
+  obs::DefaultJournal().Record(
+      "rewrite", decision.source,
+      std::string(wrote_ok ? "rewrite succeeded" : "rewrite failed") +
+          " (level " + std::to_string(decision.level) + ")",
+      std::move(span_fields));
   if (wrote_ok) {
     auto ms = static_cast<long long>(seconds * 1000);
     TFD_LOG_INFO << "wrote " << labels_emitted << " labels"
@@ -356,8 +535,63 @@ Status LabelOnce(const config::Config& config, lm::Labeler& timestamp,
   return s;
 }
 
+// Per-source snapshot state for the SIGUSR1 dump (and nothing else):
+// the same view the degradation ladder decides from.
+std::string SnapshotsJson(const sched::SnapshotStore& store) {
+  std::string out = "{";
+  bool first = true;
+  for (const std::string& name : store.Sources()) {
+    sched::SourceView view = store.View(name);
+    if (!first) out += ",";
+    first = false;
+    char age[32];
+    snprintf(age, sizeof(age), "%.1f", view.age_s);
+    out += jsonlite::Quote(name) + ":{\"settled\":" +
+           (view.settled ? "true" : "false") + ",\"device_source\":" +
+           (view.device_source ? "true" : "false") + ",\"tier\":" +
+           jsonlite::Quote(sched::TierName(view.tier)) +
+           ",\"age_seconds\":" + age + ",\"consecutive_failures\":" +
+           std::to_string(view.consecutive_failures) + ",\"backoff_s\":" +
+           std::to_string(view.backoff_s) + ",\"last_error\":" +
+           jsonlite::Quote(jsonlite::SanitizeUtf8(view.last_error)) +
+           ",\"has_snapshot\":" +
+           (view.last_ok.has_value() ? "true" : "false") + "}";
+  }
+  return out + "}";
+}
+
+// SIGUSR1 post-mortem dump: journal + snapshots + labels/provenance,
+// written atomically so a `kubectl cp` mid-dump never reads a torn file.
+void WriteDebugDump(const config::Config& config,
+                    const sched::SnapshotStore& store,
+                    const LabelState& state) {
+  const std::string& path = config.flags.debug_dump_file;
+  obs::Journal& journal = obs::DefaultJournal();
+  // The dump records itself first, so the written journal shows when
+  // (and that) the operator pulled it.
+  journal.Record("dump", "", "SIGUSR1 debug dump requested",
+                 {{"path", path}});
+  std::string body =
+      "{\"dumped_at\":" +
+      std::to_string(static_cast<long long>(WallClockSeconds())) +
+      ",\"version\":" + jsonlite::Quote(info::VersionString()) +
+      ",\"labels\":" +
+      LabelsDebugJson(journal.generation(), state.labels,
+                      state.provenance) +
+      ",\"snapshots\":" + SnapshotsJson(store) +
+      ",\"journal\":" + journal.RenderJson() + "}\n";
+  Status s = WriteFileAtomically(path, body);
+  if (s.ok()) {
+    TFD_LOG_INFO << "wrote debug dump (journal + snapshots + label "
+                    "provenance) to "
+                 << path;
+  } else {
+    TFD_LOG_WARNING << "debug dump failed: " << s.message();
+  }
+}
+
 RunOutcome Run(const config::Config& config, const sigset_t& sigmask,
-               obs::IntrospectionServer* server) {
+               obs::IntrospectionServer* server, LabelState* state) {
   lm::LabelerPtr timestamp = lm::NewTimestampLabeler(config);
   lm::LabelerPtr machine_type = lm::NewMachineTypeLabeler(
       config.flags.machine_type_file, MakeMachineTypeGetter(config));
@@ -386,7 +620,7 @@ RunOutcome Run(const config::Config& config, const sigset_t& sigmask,
                         !config.flags.output_file.empty();
   while (true) {
     Status s = LabelOnce(config, *timestamp, *machine_type, *tpu_vm, *store,
-                         server);
+                         server, state);
     if (!s.ok()) {
       TFD_LOG_ERROR << s.message();
       return RunOutcome::kError;
@@ -394,13 +628,39 @@ RunOutcome Run(const config::Config& config, const sigset_t& sigmask,
     if (config.flags.oneshot) return RunOutcome::kExit;
 
     // Sleep, interruptibly: SIGHUP → reload config and restart the loop;
-    // SIGINT/SIGTERM/SIGQUIT → clean exit (reference main.go:198-217).
-    timespec deadline{};
-    deadline.tv_sec = config.flags.sleep_interval_s;
-    int sig = sigtimedwait(&sigmask, nullptr, &deadline);
-    if (sig < 0) continue;  // EAGAIN: interval elapsed → relabel
+    // SIGUSR1 → write the post-mortem dump and keep sleeping the
+    // remainder; SIGINT/SIGTERM/SIGQUIT → clean exit (reference
+    // main.go:198-217).
+    auto sleep_until = std::chrono::steady_clock::now() +
+                       std::chrono::seconds(config.flags.sleep_interval_s);
+    int sig = 0;
+    while (true) {
+      auto now = std::chrono::steady_clock::now();
+      if (now >= sleep_until) {
+        sig = 0;
+        break;
+      }
+      auto left = std::chrono::duration_cast<std::chrono::nanoseconds>(
+          sleep_until - now);
+      timespec deadline{};
+      deadline.tv_sec = left.count() / 1000000000LL;
+      deadline.tv_nsec = left.count() % 1000000000LL;
+      sig = sigtimedwait(&sigmask, nullptr, &deadline);
+      if (sig < 0) {  // EAGAIN: interval elapsed → relabel
+        sig = 0;
+        break;
+      }
+      if (sig == SIGUSR1) {
+        WriteDebugDump(config, *store, *state);
+        continue;  // an operator dump must not perturb the cadence
+      }
+      break;
+    }
+    if (sig == 0) continue;
     if (sig == SIGHUP) {
       TFD_LOG_INFO << "received SIGHUP; reloading configuration";
+      obs::DefaultJournal().Record("reload", "",
+                                   "SIGHUP: reloading configuration");
       // Config regen invalidates every snapshot: the store dies with
       // this scope, the broker is stopped (wedged workers detached),
       // and the PJRT watchdog's process-global caches are dropped so
@@ -415,6 +675,9 @@ RunOutcome Run(const config::Config& config, const sigset_t& sigmask,
       return RunOutcome::kRestart;
     }
     TFD_LOG_INFO << "received signal " << sig << "; exiting";
+    obs::DefaultJournal().Record(
+        "shutdown", "", "received signal " + std::to_string(sig),
+        {{"signal", std::to_string(sig)}});
     broker.Stop();
     if (cleanup_output) {
       Status rm = RemoveFileIfExists(config.flags.output_file);
@@ -438,10 +701,32 @@ int Main(int argc, char** argv) {
   sigaddset(&sigmask, SIGINT);
   sigaddset(&sigmask, SIGTERM);
   sigaddset(&sigmask, SIGQUIT);
+  sigaddset(&sigmask, SIGUSR1);  // post-mortem dump trigger
   sigprocmask(SIG_BLOCK, &sigmask, nullptr);
 
+  // Pre-scan the CLI/env log-format so even config::Load's own parse
+  // warnings come out in the requested format (a config FILE can still
+  // flip it, but only after it has been read — load-time lines then
+  // use the pre-scan result, and on later reloads the previous load's
+  // format, which the atomic preserves).
+  std::string early_format;
+  if (const char* env = std::getenv("TFD_LOG_FORMAT")) early_format = env;
+  for (int i = 1; i < argc; i++) {  // CLI beats env, as in config::Load
+    std::string arg = argv[i];
+    if (arg == "--log-format" && i + 1 < argc) {
+      early_format = argv[i + 1];
+    } else if (arg.rfind("--log-format=", 0) == 0) {
+      early_format = arg.substr(strlen("--log-format="));
+    }
+  }
+  if (early_format == "json") log::SetFormat(log::Format::kJson);
+  if (early_format == "klog") log::SetFormat(log::Format::kKlog);
+
   // start() loop: reload config and re-run on SIGHUP
-  // (reference main.go:125-153).
+  // (reference main.go:125-153). The label state lives ABOVE the loop:
+  // the flight recorder must explain the first post-reload rewrite as a
+  // diff against what the node actually carried.
+  LabelState label_state;
   int config_generation = 0;
   while (true) {
     Result<config::LoadResult> loaded = config::Load(argc, argv);
@@ -458,10 +743,19 @@ int Main(int argc, char** argv) {
       printf("tpu-feature-discovery %s\n", info::VersionString().c_str());
       return 0;
     }
+    log::SetFormat(loaded->config.flags.log_format == "json"
+                       ? log::Format::kJson
+                       : log::Format::kKlog);
+    obs::DefaultJournal().SetCapacity(
+        static_cast<size_t>(loaded->config.flags.journal_capacity));
     TFD_LOG_INFO << "tpu-feature-discovery " << info::VersionString();
     TFD_LOG_INFO << "running with config: " << config::ToJson(loaded->config);
 
     config_generation++;
+    obs::DefaultJournal().Record(
+        "config-load", "", "configuration loaded",
+        {{"config_generation", std::to_string(config_generation)},
+         {"log_format", loaded->config.flags.log_format}});
     obs::Default()
         .GetGauge("tfd_config_generation",
                   "Config loads this process has performed (bumps on "
@@ -483,6 +777,7 @@ int Main(int argc, char** argv) {
     if (!flags.oneshot && !flags.introspection_addr.empty()) {
       obs::ServerOptions options;
       options.addr = flags.introspection_addr;
+      options.journal = &obs::DefaultJournal();
       // Freshness window: 2x the rewrite cadence — plus the health-exec
       // budget when --device-health=full, whose hourly re-measure
       // legitimately blocks a pass for up to health_exec_timeout_s; a
@@ -497,13 +792,21 @@ int Main(int argc, char** argv) {
         return 1;
       }
       server = std::move(*started);
+      // A SIGHUP recreates the server but the label state survives the
+      // reload: seed /debug/labels so the reload window never claims
+      // "no rewrite has completed yet" on a node that IS labeled.
+      if (!label_state.labels.empty()) {
+        server->SetLabelsJson(LabelsDebugJson(
+            obs::DefaultJournal().generation(), label_state.labels,
+            label_state.provenance));
+      }
       TFD_LOG_INFO << "introspection server serving /healthz /readyz "
-                      "/metrics on "
+                      "/metrics /debug/journal /debug/labels on "
                    << flags.introspection_addr << " (port "
                    << server->port() << ")";
     }
 
-    switch (Run(loaded->config, sigmask, server.get())) {
+    switch (Run(loaded->config, sigmask, server.get(), &label_state)) {
       case RunOutcome::kExit:
         TFD_LOG_INFO << "exiting";
         return 0;

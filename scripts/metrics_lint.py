@@ -8,9 +8,17 @@ on). Also asserts the contract metrics the deployment docs promise are
 actually present, so a renamed series fails CI before it breaks
 someone's dashboard.
 
+Doc-drift gate: the metric families the booted binary actually registers
+(the `# TYPE` lines of the live scrape) are diffed against the README's
+Observability metric table, both directions — a scraped family missing
+from the table fails as UNDOCUMENTED; a table row the binary never
+registers fails as STALE (modulo CONDITIONAL: families only reachable
+under configs this hermetic boot can't exercise, each annotated with the
+path that emits it).
+
 Usage:
   python3 scripts/metrics_lint.py [--binary build/tpu-feature-discovery]
-      [--unit-tests build/tfd_unit_tests]
+      [--unit-tests build/tfd_unit_tests] [--readme README.md]
 
 Exit 0 on a valid, complete scrape; nonzero with the reason otherwise.
 """
@@ -47,13 +55,62 @@ REQUIRED = [
     "tfd_probe_duration_seconds_count",
     "tfd_snapshot_age_seconds",
     "tfd_probe_degradation_level",
+    # Flight recorder (obs/journal): event + eviction counters, label
+    # changes, and the ladder's {from,to} transition record (the first
+    # pass always journals none -> <level>).
+    "tfd_journal_events_total",
+    "tfd_journal_dropped_total",
+    "tfd_label_changes_total",
+    "tfd_degradation_transitions_total",
 ]
+
+# Families documented in the README that this boot (null backend, no
+# failures injected) legitimately never registers — each exists only on
+# the named path. Anything else documented-but-unscraped is STALE.
+CONDITIONAL = {
+    # PJRT paths: need --backend=pjrt and a (wedged) plugin.
+    "tfd_pjrt_watchdog_trips_total",
+    "tfd_pjrt_cache_refreshes_total",
+    # Failure paths: need an injected probe/rewrite failure.
+    "tfd_probe_failures_total",
+    "tfd_rewrite_failures_total",
+    # Registered by the broker's backoff bookkeeping only once a worker
+    # completes its first probe round — racy at scrape time.
+    "tfd_probe_backoff_seconds",
+}
+
+
+def readme_metric_names(readme_path):
+    """Metric names promised by the README's Observability table: rows
+    like `| \\`tfd_foo_total{source=}\\` | counter | ... |`."""
+    import re
+
+    names = set()
+    for line in open(readme_path):
+        m = re.match(r"\|\s*`(tfd_[a-zA-Z0-9_]+)", line)
+        if m:
+            names.add(m.group(1))
+    return names
+
+
+def scraped_family_names(text):
+    """Families the binary actually registered: the scrape's TYPE lines
+    (histograms appear under their base family name there)."""
+    names = set()
+    for line in text.splitlines():
+        parts = line.split()
+        if len(parts) == 4 and parts[0] == "#" and parts[1] == "TYPE":
+            names.add(parts[2])
+    return names
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--binary", default="build/tpu-feature-discovery")
     ap.add_argument("--unit-tests", default="build/tfd_unit_tests")
+    ap.add_argument("--readme", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "README.md"))
     ap.add_argument("--timeout", type=float, default=30.0)
     args = ap.parse_args(argv)
 
@@ -128,8 +185,26 @@ def main(argv=None):
         print(f"contract metrics missing from /metrics: {missing}",
               file=sys.stderr)
         return 1
+
+    # Doc-drift gate: registered families vs the README metric table.
+    documented = readme_metric_names(args.readme)
+    scraped = scraped_family_names(text)
+    undocumented = sorted(scraped - documented)
+    stale = sorted(documented - scraped - CONDITIONAL)
+    if undocumented:
+        print("metrics registered by the binary but missing from the "
+              f"README metric table: {undocumented}", file=sys.stderr)
+        return 1
+    if stale:
+        print("README metric table documents series the binary never "
+              f"registered (and not in CONDITIONAL): {stale}",
+              file=sys.stderr)
+        return 1
+
     print(f"metrics lint OK: {len(text.splitlines())} lines, "
-          f"both checkers passed, {len(REQUIRED)} contract series present")
+          f"both checkers passed, {len(REQUIRED)} contract series "
+          f"present, doc table in sync ({len(scraped)} scraped / "
+          f"{len(documented)} documented)")
     return 0
 
 

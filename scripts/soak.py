@@ -41,11 +41,20 @@ Prints ONE JSON line, e.g.:
 Exit code 0 iff ok. "ok" means: >=3 passes observed, rewrites on cadence
 (passes >= half of duration/interval AND the p50 rewrite interval within
 3x --interval), RSS drift under --max-rss-drift-kb (default 1024), fd
-count unchanged, labels (minus the timestamp) identical across every
+count not above the baseline, labels (minus the timestamp) identical across every
 pass, /readyz ready at soak end (when scraping), the CR GET cross-check
 consistent (cr sink + scraping), SIGTERM led to exit 0, and the sink was
 left in its contracted end state (file removed; the CR persists by
 design — NFD owns its lifecycle).
+
+--require-journal additionally enforces the flight-recorder
+explainability invariant (tpufd.journal): every observed label change
+has a matching /debug/journal label-diff event with provenance, every
+observed degradation level was journaled as a transition, /debug/labels
+agrees with the label file byte-for-byte, and the journal stays within
+its capacity. Under that flag label CHURN is allowed as long as every
+change is explained (an injected wedge SHOULD change labels);
+labels_stable becomes informational.
 """
 
 import argparse
@@ -63,6 +72,7 @@ import urllib.request
 sys.path.insert(0, os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
 
+from tpufd import journal as tpufd_journal  # noqa: E402
 from tpufd import metrics as tpufd_metrics  # noqa: E402
 from tpufd.fakes import free_loopback_port  # noqa: E402
 
@@ -96,6 +106,18 @@ class MetricsScraper:
 
     def readyz(self):
         return self._get("/readyz")[0]
+
+    def get_json(self, path):
+        """Parsed JSON document from a /debug endpoint, or None."""
+        import json
+
+        status, text = self._get(path)
+        if status != 200:
+            return None
+        try:
+            return json.loads(text)
+        except ValueError:
+            return None
 
     def counter(self, name):
         """Value of a counter, or None. `name` may carry one label
@@ -184,6 +206,15 @@ class FileSink:
         with open(self.path) as f:
             return st.st_mtime, stable_digest(f.read())
 
+    def labels(self):
+        """The current label dict, or None before the first pass."""
+        try:
+            with open(self.path) as f:
+                return dict(line.split("=", 1)
+                            for line in f.read().splitlines() if line)
+        except (OSError, ValueError):
+            return None
+
     def end_state_ok(self):
         return not os.path.exists(self.path)  # SIGTERM removes the file
 
@@ -237,6 +268,12 @@ class CrSink:
                   if method == "GET" and self.NODE in path)
         return gen, stable_digest(text)
 
+    def labels(self):
+        obj = self.server.store.get(self.key)
+        if obj is None:
+            return None
+        return dict(obj.get("spec", {}).get("labels", {}))
+
     def end_state_ok(self):
         # The CR persists across daemon restarts by design (NFD owns its
         # lifecycle; the reference leaves its CR too).
@@ -272,6 +309,17 @@ def main(argv=None):
                          "soak >= MIN (repeatable) — e.g. "
                          "tfd_pjrt_cache_refreshes_total:2 proves the "
                          "soak crossed a snapshot-cache expiry boundary")
+    ap.add_argument("--require-journal", action="store_true",
+                    help="enforce the flight-recorder explainability "
+                         "invariant: every observed label change has a "
+                         "matching journal label-diff event with "
+                         "provenance, every observed degradation level "
+                         "was journaled as a transition, /debug/labels "
+                         "agrees with the label file byte-for-byte, and "
+                         "the journal stays within its capacity. Label "
+                         "CHURN is allowed (and expected under injected "
+                         "wedges) as long as every change is explained — "
+                         "labels_stable becomes informational")
     ap.add_argument("--init-grace", type=float, default=180.0,
                     help="seconds allowed for the FIRST pass (backend "
                          "init: a cold PJRT chip claim can take tens of "
@@ -283,11 +331,20 @@ def main(argv=None):
     with tempfile.TemporaryDirectory() as d:
         sink = (CrSink if args.sink == "cr" else FileSink)(d)
         stderr_path = os.path.join(d, "stderr")
-        # Pass counting scrapes the daemon's own introspection server
-        # unless the caller pinned an address via --extra-arg.
+        # Pass counting scrapes the daemon's own introspection server;
+        # a caller-pinned address (--extra-arg=--introspection-addr=...)
+        # is scraped too when its port is parseable, so a harness that
+        # wants to watch the same daemon (e.g. to inject a wedge at a
+        # chosen ladder state) can share the port.
         extra = list(args.extra_arg)
         scraper = None
-        if not any(a.startswith("--introspection-addr") for a in extra):
+        pinned = [a for a in extra
+                  if a.startswith("--introspection-addr")]
+        if pinned:
+            pinned_port = pinned[-1].rpartition(":")[2]
+            if pinned_port.isdigit() and int(pinned_port) > 0:
+                scraper = MetricsScraper(int(pinned_port))
+        else:
             port = free_loopback_port()
             extra.append(f"--introspection-addr=127.0.0.1:{port}")
             scraper = MetricsScraper(port)
@@ -322,6 +379,12 @@ def main(argv=None):
         try:
             digests = set()
             gens, seen_at = [], []
+            # --require-journal bookkeeping: full label dicts + scraped
+            # degradation levels per observed pass, and the journal
+            # accumulated across scrapes (merged by seq, so a wrapped
+            # ring never loses what an earlier scrape saw).
+            label_history, level_history = [], []
+            journal_events, journal_problems = {}, []
             baseline_rss = baseline_fd = None
             gen_source = None  # "metrics" once the scrape works, else sink
             # The soak duration is steady-state time: the clock starts at
@@ -376,6 +439,26 @@ def main(argv=None):
                     seen_at.append(time.monotonic())
                     if digest is not None:
                         digests.add(digest)
+                    if args.require_journal and gen_source == "metrics":
+                        labels_now = sink.labels()
+                        if labels_now is not None and (
+                                not label_history or
+                                label_history[-1] != labels_now):
+                            label_history.append(labels_now)
+                        level = scraper.counter(
+                            "tfd_probe_degradation_level")
+                        if level is not None and (
+                                not level_history or
+                                level_history[-1] != level):
+                            level_history.append(level)
+                        doc = scraper.get_json("/debug/journal")
+                        if doc is not None:
+                            try:
+                                tpufd_journal.merge_events(
+                                    journal_events,
+                                    tpufd_journal.parse_journal(doc))
+                            except ValueError as e:
+                                journal_problems.append(str(e))
                     if len(gens) == args.settle_passes:
                         try:
                             baseline_rss = rss_kb(proc.pid)
@@ -441,6 +524,94 @@ def main(argv=None):
                 cr_gets = observed[0] if observed else 0
                 out["cr_gets"] = cr_gets
                 crosscheck_ok = abs(cr_gets - len(gens)) <= 2
+            # Flight-recorder invariant (--require-journal), checked
+            # while the daemon is still alive: every observed label
+            # change explained by a provenance-carrying label-diff
+            # event, every observed degradation level journaled as a
+            # transition target, /debug/labels byte-identical to the
+            # emitted label file, journal within capacity.
+            journal_ok = None
+            if args.require_journal and gen_source != "metrics":
+                # Requiring the invariant without a scrape path must fail
+                # loudly, not silently skip every check.
+                journal_ok = False
+                out["journal_problems"] = [
+                    "--require-journal needs the metrics scrape path "
+                    f"(gen_source={gen_source}); pin a scrapeable "
+                    "--introspection-addr or drop the pin"]
+            if args.require_journal and gen_source == "metrics":
+                # Labels BEFORE the journal: a rewrite landing between
+                # the two reads must be covered by the scraped events,
+                # which holds only when the label observation is the
+                # earlier one (the in-loop scrape uses the same order).
+                labels_now = sink.labels()
+                if labels_now is not None and (
+                        not label_history or
+                        label_history[-1] != labels_now):
+                    label_history.append(labels_now)
+                doc = scraper.get_json("/debug/journal")
+                if doc is not None:
+                    try:
+                        tpufd_journal.merge_events(
+                            journal_events,
+                            tpufd_journal.parse_journal(doc))
+                    except ValueError as e:
+                        journal_problems.append(str(e))
+                if not journal_events:
+                    journal_problems.append("no journal events scraped")
+                changes = []
+                for prev, cur in zip(label_history, label_history[1:]):
+                    changes.extend(tpufd_journal.label_changes(prev, cur))
+                _, cover_problems = tpufd_journal.diffs_cover_changes(
+                    journal_events, changes)
+                journal_problems.extend(cover_problems)
+                transitions = tpufd_journal.degradation_transitions(
+                    journal_events)
+                journaled_to = {t for _, t in transitions}
+                for level in sorted({str(int(lv)) for lv in level_history
+                                     if lv is not None}):
+                    if level not in journaled_to:
+                        journal_problems.append(
+                            f"observed degradation level {level} never "
+                            "journaled as a transition")
+                if args.sink == "file":
+                    # Byte-for-byte agreement, retried around the race
+                    # with an in-flight rewrite: only an observation
+                    # where the file did not change while /debug/labels
+                    # was fetched counts.
+                    agreed = False
+                    for _ in range(5):
+                        try:
+                            with open(sink.path) as f:
+                                before = f.read()
+                        except OSError:
+                            before = None
+                        debug_labels = scraper.get_json("/debug/labels")
+                        try:
+                            with open(sink.path) as f:
+                                after = f.read()
+                        except OSError:
+                            after = None
+                        if (before is not None and before == after
+                                and debug_labels is not None
+                                and tpufd_journal.labels_file_text(
+                                    debug_labels) == before):
+                            agreed = True
+                            break
+                        # Mismatch with a stable file still retries: the
+                        # daemon writes the file, THEN hands the endpoint
+                        # its document — a sample in that window sees the
+                        # endpoint one rewrite behind.
+                        time.sleep(0.2)
+                    if not agreed:
+                        journal_problems.append(
+                            "/debug/labels does not match the emitted "
+                            "label file byte-for-byte")
+                journal_ok = not journal_problems
+                out["journal_events"] = len(journal_events)
+                out["journal_label_changes"] = len(changes)
+                out["journal_degradations"] = transitions or None
+                out["journal_problems"] = journal_problems or None
             proc.send_signal(signal.SIGTERM)
             try:
                 clean = proc.wait(timeout=30) == 0
@@ -471,19 +642,30 @@ def main(argv=None):
                 "counters": counters or None,
                 "counters_ok": counters_ok,
                 "snapshot_tiers": snapshot_tiers,
+                "journal_ok": journal_ok,
                 "clean_exit": clean,
                 "end_state_ok": sink.end_state_ok(),
             })
+            # Under --require-journal, label churn is allowed as long as
+            # every change is journal-explained (an injected wedge SHOULD
+            # change labels); otherwise stability is required as before.
+            labels_accounted = out["labels_stable"] or (
+                args.require_journal and journal_ok is True)
             out["ok"] = bool(
                 len(gens) >= max(3, args.settle_passes)
                 and cadence_ok
                 and readyz_ok is not False
                 and crosscheck_ok is not False
                 and counters_ok is not False
+                and journal_ok is not False
                 and baseline_rss is not None
                 and out["rss_drift_kb"] <= args.max_rss_drift_kb
-                and end_fd == baseline_fd
-                and out["labels_stable"] and clean
+                # A leak is monotone GROWTH; ending below the baseline
+                # just means the baseline sample caught a transient
+                # probe-worker fd (min-of-3 narrows but cannot close
+                # that window).
+                and end_fd <= baseline_fd
+                and labels_accounted and clean
                 and out["end_state_ok"])
         finally:
             if proc.poll() is None:

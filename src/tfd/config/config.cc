@@ -278,11 +278,47 @@ std::vector<FlagDef> MakeFlagDefs(Flags* f) {
                   {"TFD_INTROSPECTION_ADDR"},
                   "introspectionAddr",
                   "listen address for the introspection HTTP server "
-                  "(/healthz, /readyz, Prometheus /metrics), e.g. :8081 or "
-                  "127.0.0.1:8081; '' disables (oneshot runs never bind)",
+                  "(/healthz, /readyz, Prometheus /metrics, /debug/journal, "
+                  "/debug/labels), e.g. :8081 or 127.0.0.1:8081; '' "
+                  "disables (oneshot runs never bind)",
                   false,
                   [f](const std::string& v) {
                     return SetString(&f->introspection_addr, v);
+                  }});
+  defs.push_back({"log-format",
+                  {"TFD_LOG_FORMAT"},
+                  "logFormat",
+                  "log line format: [klog | json]; json emits one JSON "
+                  "object per line (journal event schema, with the "
+                  "rewrite-generation correlation id)",
+                  false,
+                  [f](const std::string& v) {
+                    return SetString(&f->log_format, v);
+                  }});
+  defs.push_back({"journal-capacity",
+                  {"TFD_JOURNAL_CAPACITY"},
+                  "journalCapacity",
+                  "flight-recorder ring-buffer capacity (drop-oldest; "
+                  "drops counted in tfd_journal_dropped_total)",
+                  false,
+                  [f](const std::string& v) {
+                    int parsed = 0;
+                    if (!ParseNonNegInt(TrimSpace(v), &parsed) ||
+                        parsed < 1) {
+                      return Status::Error("journal-capacity must be a "
+                                           "positive integer");
+                    }
+                    f->journal_capacity = parsed;
+                    return Status::Ok();
+                  }});
+  defs.push_back({"debug-dump-file",
+                  {"TFD_DEBUG_DUMP_FILE"},
+                  "debugDumpFile",
+                  "path the SIGUSR1 post-mortem dump (journal + snapshots "
+                  "+ label provenance) is written to",
+                  false,
+                  [f](const std::string& v) {
+                    return SetString(&f->debug_dump_file, v);
                   }});
   return defs;
 }
@@ -620,6 +656,10 @@ Result<LoadResult> Load(int argc, char** argv) {
     Result<obs::ListenAddr> addr = obs::ParseListenAddr(f->introspection_addr);
     if (!addr.ok()) return Result<LoadResult>::Error(addr.error());
   }
+  if (f->log_format != "klog" && f->log_format != "json") {
+    return Result<LoadResult>::Error("invalid log-format '" +
+                                     f->log_format + "' (want klog|json)");
+  }
   return out;
 }
 
@@ -663,6 +703,9 @@ std::string ToJson(const Config& config) {
       << ",\"healthExecInterval\":\"" << f.health_exec_interval_s << "s\""
       << ",\"snapshotUsableFor\":\"" << f.snapshot_usable_for_s << "s\""
       << ",\"introspectionAddr\":" << jstr(f.introspection_addr)
+      << ",\"logFormat\":" << jstr(f.log_format)
+      << ",\"journalCapacity\":" << f.journal_capacity
+      << ",\"debugDumpFile\":" << jstr(f.debug_dump_file)
       << "},\"sharing\":[";
   for (size_t i = 0; i < config.sharing.time_slicing.size(); i++) {
     const SharedResource& r = config.sharing.time_slicing[i];

@@ -121,12 +121,24 @@ struct Flags {
   // the per-source fresh window (2x sleep-interval + the probe's
   // deadline budget) plus 6 sleep-intervals.
   int snapshot_usable_for_s = 0;
-  // Introspection HTTP server (obs/server.h): /healthz, /readyz and
-  // Prometheus /metrics. "host:port"; empty host binds all interfaces,
-  // empty string disables. Oneshot runs never bind (there is no
-  // lifecycle to introspect, and a bound port would collide with a
-  // daemon already running on the node).
+  // Introspection HTTP server (obs/server.h): /healthz, /readyz,
+  // Prometheus /metrics, and the flight-recorder debug endpoints
+  // /debug/journal + /debug/labels. "host:port"; empty host binds all
+  // interfaces, empty string disables. Oneshot runs never bind (there
+  // is no lifecycle to introspect, and a bound port would collide with
+  // a daemon already running on the node).
   std::string introspection_addr = ":8081";
+  // Log line format: "klog" (the classic I0601 12:00:00 prefix) or
+  // "json" (one JSON object per line, reusing the journal event schema
+  // with the rewrite-generation correlation id — see obs/journal.h).
+  std::string log_format = "klog";
+  // Flight-recorder ring size (obs/journal.h): fixed capacity,
+  // drop-oldest, drops counted in tfd_journal_dropped_total. Bounds the
+  // recorder's memory no matter how eventful the node is.
+  int journal_capacity = 512;
+  // SIGUSR1 post-mortem dump target: journal + per-source snapshot
+  // state + current labels/provenance, written atomically.
+  std::string debug_dump_file = "/tmp/tpu-feature-discovery-debug.json";
 };
 
 struct Config {

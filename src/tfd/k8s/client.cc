@@ -2,6 +2,7 @@
 
 #include <cstdlib>
 
+#include "tfd/obs/journal.h"
 #include "tfd/util/file.h"
 #include "tfd/util/http.h"
 #include "tfd/util/jsonlite.h"
@@ -108,8 +109,19 @@ Status UpdateNodeFeature(const ClusterConfig& config,
   // Pessimistic default: failures below that return without passing
   // through Fail() (none today) would read as permanent.
   if (transient != nullptr) *transient = false;
-  auto Fail = [transient](bool is_transient, const std::string& message) {
+  auto RecordSink = [](const std::string& message,
+                       const std::string& action, bool ok,
+                       const std::string& error = "") {
+    obs::DefaultJournal().Record("sink-write", "cr", message,
+                                 {{"action", action},
+                                  {"ok", ok ? "true" : "false"},
+                                  {"error", error}});
+  };
+  auto Fail = [transient, &RecordSink](bool is_transient,
+                                       const std::string& message) {
     if (transient != nullptr) *transient = is_transient;
+    RecordSink("NodeFeature CR write failed: " + message, "fail",
+               /*ok=*/false, message);
     return Status::Error(message);
   };
   // Retrying helps against server hiccups (429, 5xx) and transport
@@ -142,6 +154,8 @@ Status UpdateNodeFeature(const ClusterConfig& config,
       }
       if (created->status == 409) {  // lost a create race; re-GET
         last_error = "create conflict";
+        RecordSink("NodeFeature CR create conflict; retrying",
+                   "conflict-retry", /*ok=*/false, last_error);
         continue;
       }
       if (created->status != 201 && created->status != 200) {
@@ -151,6 +165,8 @@ Status UpdateNodeFeature(const ClusterConfig& config,
                         created->body.substr(0, 512));
       }
       TFD_LOG_INFO << "created NodeFeature CR " << CrName(config.node_name);
+      RecordSink("created NodeFeature CR " + CrName(config.node_name),
+                 "create", /*ok=*/true);
       return Status::Ok();
     }
     if (existing->status != 200) {
@@ -190,7 +206,11 @@ Status UpdateNodeFeature(const ClusterConfig& config,
           break;
         }
       }
-      if (equal) return Status::Ok();
+      if (equal) {
+        RecordSink("NodeFeature CR already current (no-op update skipped)",
+                "noop", /*ok=*/true);
+        return Status::Ok();
+      }
     }
 
     // Mutate the fetched object (as the reference does via client-go,
@@ -226,6 +246,8 @@ Status UpdateNodeFeature(const ClusterConfig& config,
     if (updated->status == 409) {  // stale resourceVersion; re-GET
       last_error = "update conflict: " + updated->body.substr(0, 256);
       TFD_LOG_WARNING << "NodeFeature CR update conflict; retrying";
+      RecordSink("NodeFeature CR update conflict; retrying",
+                 "conflict-retry", /*ok=*/false, last_error);
       continue;
     }
     if (updated->status != 200) {
@@ -235,6 +257,8 @@ Status UpdateNodeFeature(const ClusterConfig& config,
                       updated->body.substr(0, 512));
     }
     TFD_LOG_INFO << "updated NodeFeature CR " << CrName(config.node_name);
+    RecordSink("updated NodeFeature CR " + CrName(config.node_name),
+               "update", /*ok=*/true);
     return Status::Ok();
   }
   return Fail(true, "updating NodeFeature CR: " +

@@ -3,6 +3,7 @@
 #include <iostream>
 #include <sstream>
 
+#include "tfd/obs/journal.h"
 #include "tfd/util/file.h"
 
 namespace tfd {
@@ -21,9 +22,21 @@ Status OutputToFile(const Labels& labels, const std::string& path) {
   if (path.empty()) {
     std::cout << body;
     std::cout.flush();
+    obs::DefaultJournal().Record(
+        "sink-write", "stdout", "wrote labels to stdout",
+        {{"labels", std::to_string(labels.size())}, {"ok", "true"}});
     return Status::Ok();
   }
-  return WriteFileAtomically(path, body);
+  Status s = WriteFileAtomically(path, body);
+  obs::DefaultJournal().Record(
+      "sink-write", "file",
+      s.ok() ? "wrote labels to " + path
+             : "label file write failed: " + s.message(),
+      {{"labels", std::to_string(labels.size())},
+       {"path", path},
+       {"ok", s.ok() ? "true" : "false"},
+       {"error", s.ok() ? "" : s.message()}});
+  return s;
 }
 
 }  // namespace lm

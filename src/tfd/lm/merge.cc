@@ -1,3 +1,5 @@
+#include "tfd/lm/merge.h"
+
 #include "tfd/lm/labeler.h"
 
 namespace tfd {
@@ -5,6 +7,63 @@ namespace lm {
 
 LabelerPtr Merge(std::vector<LabelerPtr> children) {
   return std::make_unique<MergedLabeler>(std::move(children));
+}
+
+const char* DiffOpName(LabelDiffEntry::Op op) {
+  switch (op) {
+    case LabelDiffEntry::Op::kAdded:
+      return "added";
+    case LabelDiffEntry::Op::kRemoved:
+      return "removed";
+    case LabelDiffEntry::Op::kChanged:
+      return "changed";
+  }
+  return "added";
+}
+
+std::vector<LabelDiffEntry> DiffLabels(const Labels& previous,
+                                       const Labels& next) {
+  std::vector<LabelDiffEntry> out;
+  auto p = previous.begin();
+  auto n = next.begin();
+  while (p != previous.end() || n != next.end()) {
+    LabelDiffEntry entry;
+    if (n == next.end() ||
+        (p != previous.end() && p->first < n->first)) {
+      entry.op = LabelDiffEntry::Op::kRemoved;
+      entry.key = p->first;
+      entry.old_value = p->second;
+      ++p;
+    } else if (p == previous.end() || n->first < p->first) {
+      entry.op = LabelDiffEntry::Op::kAdded;
+      entry.key = n->first;
+      entry.new_value = n->second;
+      ++n;
+    } else {
+      if (p->second != n->second) {
+        entry.op = LabelDiffEntry::Op::kChanged;
+        entry.key = n->first;
+        entry.old_value = p->second;
+        entry.new_value = n->second;
+        ++p;
+        ++n;
+        out.push_back(std::move(entry));
+        continue;
+      }
+      ++p;
+      ++n;
+      continue;
+    }
+    out.push_back(std::move(entry));
+  }
+  return out;
+}
+
+std::string LabelKeyPrefix(const std::string& key) {
+  size_t slash = key.find('/');
+  size_t dot = key.find('.', slash == std::string::npos ? 0 : slash + 1);
+  if (dot == std::string::npos) return key;
+  return key.substr(0, dot);
 }
 
 }  // namespace lm

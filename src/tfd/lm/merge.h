@@ -1,0 +1,56 @@
+// Label-set diffing and per-key provenance.
+//
+// The merge pipeline (labeler.h) decides WHAT the label set is; this
+// header carries the explainability companions the flight recorder
+// (obs/journal.h) and /debug/labels need: which labeler/probe-source/
+// staleness-tier produced each key, and what changed between two
+// consecutive rewrites (added / removed / changed, with old→new values).
+// The daemon journals one "label-diff" event per changed key and counts
+// changes in tfd_label_changes_total{key_prefix}.
+#pragma once
+
+#include <map>
+#include <string>
+#include <vector>
+
+#include "tfd/lm/labeler.h"
+
+namespace tfd {
+namespace lm {
+
+// Where one label key came from, captured at merge time.
+struct LabelProvenance {
+  std::string labeler;  // "timestamp", "machine-type", "tpu", ...
+  std::string source;   // probe source ("pjrt", "metadata", "health",
+                        // "local" for host-derived labelers)
+  std::string tier;     // snapshot tier name serving it ("fresh", ...)
+  double age_s = 0;     // snapshot age at merge time (0 for local)
+};
+
+using Provenance = std::map<std::string, LabelProvenance>;
+
+struct LabelDiffEntry {
+  enum class Op { kAdded, kRemoved, kChanged };
+  Op op = Op::kAdded;
+  std::string key;
+  std::string old_value;  // empty for kAdded
+  std::string new_value;  // empty for kRemoved
+};
+
+const char* DiffOpName(LabelDiffEntry::Op op);
+
+// Key-ordered diff between two label sets (both std::map, so the walk
+// is a linear merge). Equal sets yield an empty diff.
+std::vector<LabelDiffEntry> DiffLabels(const Labels& previous,
+                                       const Labels& next);
+
+// The bounded-cardinality metric prefix for a label key: everything up
+// to (and excluding) the first '.' after the namespace slash —
+// "google.com/tpu.count" → "google.com/tpu",
+// "google.com/tfd.timestamp" → "google.com/tfd". Slash-less keys
+// truncate at their first '.' ("plain.key" → "plain"); keys with no
+// '.' after the slash (or at all) pass through whole.
+std::string LabelKeyPrefix(const std::string& key);
+
+}  // namespace lm
+}  // namespace tfd

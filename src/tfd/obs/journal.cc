@@ -1,0 +1,186 @@
+#include "tfd/obs/journal.h"
+
+#include <chrono>
+#include <cstdio>
+
+#include "tfd/obs/metrics.h"
+#include "tfd/util/jsonlite.h"
+#include "tfd/util/logging.h"
+
+namespace tfd {
+namespace obs {
+
+namespace {
+
+double WallNow() {
+  return std::chrono::duration<double>(
+             std::chrono::system_clock::now().time_since_epoch())
+      .count();
+}
+
+std::string FormatSeconds(double s) {
+  char buf[32];
+  snprintf(buf, sizeof(buf), "%.3f", s);
+  return buf;
+}
+
+}  // namespace
+
+std::string EventJson(const Event& event) {
+  std::string out = "{\"seq\":" + std::to_string(event.seq) +
+                    ",\"ts\":" + FormatSeconds(event.wall_time_s) +
+                    ",\"generation\":" + std::to_string(event.generation) +
+                    ",\"type\":" + jsonlite::Quote(event.type) +
+                    ",\"source\":" + jsonlite::Quote(event.source) +
+                    ",\"message\":" + jsonlite::Quote(event.message) +
+                    ",\"fields\":{";
+  bool first = true;
+  for (const auto& [k, v] : event.fields) {
+    if (!first) out += ",";
+    first = false;
+    out += jsonlite::Quote(k) + ":" + jsonlite::Quote(v);
+  }
+  return out + "}}";
+}
+
+Journal::Journal(size_t capacity, bool metrics)
+    : capacity_(capacity == 0 ? 1 : capacity), metrics_(metrics) {}
+
+void Journal::SetCapacity(size_t capacity) {
+  uint64_t dropped_now = 0;
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    capacity_ = capacity == 0 ? 1 : capacity;
+    while (events_.size() > capacity_) {
+      events_.pop_front();
+      dropped_++;
+      dropped_now++;
+    }
+  }
+  if (metrics_ && dropped_now > 0) {
+    Default()
+        .GetCounter("tfd_journal_dropped_total",
+                    "Journal events evicted by the bounded ring buffer "
+                    "(drop-oldest).")
+        ->Inc(static_cast<double>(dropped_now));
+  }
+}
+
+size_t Journal::capacity() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return capacity_;
+}
+
+void Journal::Record(
+    const std::string& type, const std::string& source,
+    const std::string& message,
+    std::vector<std::pair<std::string, std::string>> fields) {
+  Event event;
+  event.wall_time_s = WallNow();
+  // Sanitize at ingestion: payloads can carry arbitrary bytes (probe
+  // error strings from a wedged libtpu), but /debug/journal and the
+  // SIGUSR1 dump must stay decodable by strict UTF-8 consumers
+  // (Python json.load) — jsonlite::Quote escapes but does not validate.
+  event.type = jsonlite::SanitizeUtf8(type);
+  event.source = jsonlite::SanitizeUtf8(source);
+  event.message = jsonlite::SanitizeUtf8(message);
+  event.fields.reserve(fields.size());
+  for (auto& [k, v] : fields) {
+    event.fields.emplace_back(jsonlite::SanitizeUtf8(k),
+                              jsonlite::SanitizeUtf8(v));
+  }
+  bool dropped = false;
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    event.seq = next_seq_++;
+    event.generation = generation_;
+    if (events_.size() >= capacity_) {
+      events_.pop_front();
+      dropped_++;
+      dropped = true;
+    }
+    events_.push_back(std::move(event));
+  }
+  if (!metrics_) return;
+  Registry& reg = Default();
+  // The sanitized type also labels the counter — raw bytes must not
+  // reach the exposition through the metrics side door.
+  reg.GetCounter("tfd_journal_events_total",
+                 "Flight-recorder events appended to the journal, by "
+                 "event type.",
+                 {{"type", jsonlite::SanitizeUtf8(type)}})
+      ->Inc();
+  Counter* dropped_counter = reg.GetCounter(
+      "tfd_journal_dropped_total",
+      "Journal events evicted by the bounded ring buffer (drop-oldest).");
+  if (dropped) dropped_counter->Inc();
+}
+
+uint64_t Journal::BeginRewrite() {
+  uint64_t generation;
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    generation = ++generation_;
+  }
+  log::SetCurrentGeneration(generation);
+  return generation;
+}
+
+uint64_t Journal::generation() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return generation_;
+}
+
+std::vector<Event> Journal::Snapshot(size_t n,
+                                     const std::string& type) const {
+  std::lock_guard<std::mutex> lock(mu_);
+  std::vector<Event> out;
+  for (const Event& event : events_) {
+    if (!type.empty() && event.type != type) continue;
+    out.push_back(event);
+  }
+  if (n > 0 && out.size() > n) {
+    out.erase(out.begin(), out.end() - static_cast<ptrdiff_t>(n));
+  }
+  return out;
+}
+
+uint64_t Journal::dropped_total() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return dropped_;
+}
+
+uint64_t Journal::next_seq() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return next_seq_;
+}
+
+std::string Journal::RenderJson(size_t n, const std::string& type) const {
+  std::vector<Event> events = Snapshot(n, type);
+  uint64_t capacity;
+  uint64_t dropped;
+  uint64_t generation;
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    capacity = capacity_;
+    dropped = dropped_;
+    generation = generation_;
+  }
+  std::string out = "{\"capacity\":" + std::to_string(capacity) +
+                    ",\"dropped_total\":" + std::to_string(dropped) +
+                    ",\"generation\":" + std::to_string(generation) +
+                    ",\"events\":[";
+  for (size_t i = 0; i < events.size(); i++) {
+    if (i) out += ",";
+    out += EventJson(events[i]);
+  }
+  return out + "]}";
+}
+
+Journal& DefaultJournal() {
+  static Journal* journal = new Journal();
+  return *journal;
+}
+
+}  // namespace obs
+}  // namespace tfd

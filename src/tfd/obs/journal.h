@@ -1,0 +1,119 @@
+// In-daemon flight recorder: a bounded, lock-protected ring buffer of
+// structured events.
+//
+// The daemon's metrics (obs/metrics.h) say HOW MUCH happened; the labels
+// say WHAT the node looks like right now. Neither can answer the ops
+// question PR 2's degradation ladder made acute: WHY does this node carry
+// these labels — which probe source produced each key, at which staleness
+// tier, and when did it last change? The journal records the causal
+// chain: probe lifecycle (start/ok/fail/backoff per source), snapshot
+// tier transitions, degradation-ladder level changes, per-rewrite spans
+// (duration + per-labeler timings), sink writes (file and NodeFeature CR,
+// including conflict retries), SIGHUP reloads, SIGUSR1 dumps, and label
+// diffs (added/removed/changed keys with old→new values and the
+// labeler/source/tier that produced each).
+//
+// Bounded by construction: fixed capacity (--journal-capacity, default
+// 512), drop-oldest, with the drops counted in tfd_journal_dropped_total
+// — a wedged node that loops through probe failures for a week holds a
+// window of recent history at constant memory, never an unbounded log.
+// Every append also bumps tfd_journal_events_total{type}.
+//
+// Correlation: every label rewrite pass calls BeginRewrite(), and every
+// event recorded until the next pass carries that generation — so an
+// operator (or scripts/soak.py --require-journal) can join a label diff
+// to the rewrite span, probe results, and sink write that produced it.
+// The same generation rides in --log-format=json log lines
+// (log::SetCurrentGeneration), joining free-text logs to the journal.
+//
+// Exposed on the introspection server as /debug/journal?n=&type= (JSON)
+// and folded into the SIGUSR1 post-mortem dump. Like the metrics
+// registry, DefaultJournal() is process-global and survives SIGHUP
+// config reloads — the flight recorder must cover the reload itself.
+#pragma once
+
+#include <cstdint>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace tfd {
+namespace obs {
+
+// One recorded event. `fields` is a small ordered key→value payload
+// (label diffs carry key/op/old/new/provenance; rewrite spans carry
+// per-labeler timings; ...). All strings may contain arbitrary bytes —
+// the JSON renderers escape, and the fuzz target (fuzz_journal.cc)
+// pins that hostile payloads cannot break /debug/journal exposition.
+struct Event {
+  uint64_t seq = 0;        // journal-global, monotone, never reused
+  double wall_time_s = 0;  // unix time, sub-second resolution
+  uint64_t generation = 0; // rewrite-generation correlation id
+  std::string type;        // "probe-ok", "label-diff", "rewrite", ...
+  std::string source;      // probe source / sink / "" when not applicable
+  std::string message;     // one human-readable line
+  std::vector<std::pair<std::string, std::string>> fields;
+};
+
+// Renders one event as a JSON object (the schema --log-format=json log
+// lines reuse: ts/generation/type/message + the structured extras).
+std::string EventJson(const Event& event);
+
+class Journal {
+ public:
+  static constexpr size_t kDefaultCapacity = 512;
+
+  // `metrics` wires tfd_journal_events_total{type} /
+  // tfd_journal_dropped_total into obs::Default(); the fuzz target
+  // disables it so hostile event types cannot grow the registry.
+  explicit Journal(size_t capacity = kDefaultCapacity, bool metrics = true);
+
+  // Capacity is reconfigurable at a config load (--journal-capacity);
+  // shrinking drops oldest events (counted as drops).
+  void SetCapacity(size_t capacity);
+  size_t capacity() const;
+
+  // Appends an event, assigning seq / wall time / current generation.
+  // Thread-safe: probe workers, the render loop, and the sink layers all
+  // record concurrently.
+  void Record(const std::string& type, const std::string& source,
+              const std::string& message,
+              std::vector<std::pair<std::string, std::string>> fields = {});
+
+  // Starts a new rewrite generation (the correlation id) and mirrors it
+  // into log::SetCurrentGeneration for --log-format=json. Returns the
+  // new generation.
+  uint64_t BeginRewrite();
+  uint64_t generation() const;
+
+  // The newest `n` events (0 = all retained), oldest-first, optionally
+  // filtered by exact type. Copied under the lock — renderers never
+  // block an append for long.
+  std::vector<Event> Snapshot(size_t n = 0,
+                              const std::string& type = "") const;
+
+  uint64_t dropped_total() const;
+  uint64_t next_seq() const;
+
+  // {"capacity":..,"dropped_total":..,"generation":..,"events":[..]} —
+  // what /debug/journal serves and the SIGUSR1 dump embeds.
+  std::string RenderJson(size_t n = 0, const std::string& type = "") const;
+
+ private:
+  mutable std::mutex mu_;
+  size_t capacity_;
+  bool metrics_;
+  std::deque<Event> events_;
+  uint64_t next_seq_ = 1;
+  uint64_t dropped_ = 0;
+  uint64_t generation_ = 0;
+};
+
+// The process-wide journal (the analogue of obs::Default() for metrics):
+// survives SIGHUP reloads so the recorder covers the reload itself.
+Journal& DefaultJournal();
+
+}  // namespace obs
+}  // namespace tfd

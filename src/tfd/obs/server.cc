@@ -99,6 +99,7 @@ class IntrospectionServer::Impl {
   bool last_ok = false;
   bool all_expired = false;
   std::chrono::steady_clock::time_point last_success;
+  std::string labels_json;  // /debug/labels document (see SetLabelsJson)
 
   std::vector<Conn> conns;
 };
@@ -140,6 +141,7 @@ Result<std::unique_ptr<IntrospectionServer>> IntrospectionServer::Start(
 
   auto server = std::unique_ptr<IntrospectionServer>(new IntrospectionServer());
   server->registry_ = registry;
+  server->journal_ = options.journal;
   server->stale_after_s_ = options.stale_after_s;
   server->listen_fd_ = fd;
   server->port_ = ntohs(bound.sin_port);
@@ -192,6 +194,11 @@ void IntrospectionServer::SetAllExpired(bool all_expired) {
   impl_->all_expired = all_expired;
 }
 
+void IntrospectionServer::SetLabelsJson(std::string json) {
+  std::lock_guard<std::mutex> lock(impl_->mu);
+  impl_->labels_json = std::move(json);
+}
+
 void IntrospectionServer::HandleRequest(Conn* conn) {
   conn->responding = true;
   size_t line_end = conn->in.find("\r\n");
@@ -206,8 +213,12 @@ void IntrospectionServer::HandleRequest(Conn* conn) {
   }
   std::string method = request_line.substr(0, sp1);
   std::string path = request_line.substr(sp1 + 1, sp2 - sp1 - 1);
-  size_t query = path.find('?');
-  if (query != std::string::npos) path = path.substr(0, query);
+  std::string query;
+  size_t qmark = path.find('?');
+  if (qmark != std::string::npos) {
+    query = path.substr(qmark + 1);
+    path = path.substr(0, qmark);
+  }
 
   if (method != "GET") {
     conn->out = HttpResponse(405, "Method Not Allowed", "text/plain",
@@ -248,9 +259,43 @@ void IntrospectionServer::HandleRequest(Conn* conn) {
     conn->out = HttpResponse(
         200, "OK", "text/plain; version=0.0.4; charset=utf-8",
         registry_->Exposition());
+  } else if (path == "/debug/journal" && journal_ != nullptr) {
+    // ?n=<count> (0/absent = all retained) and ?type=<event type>
+    // filter the flight-recorder dump.
+    size_t n = 0;
+    std::string type;
+    for (const std::string& param : SplitString(query, '&')) {
+      size_t eq = param.find('=');
+      if (eq == std::string::npos) continue;
+      std::string key = param.substr(0, eq);
+      std::string value = param.substr(eq + 1);
+      if (key == "n") {
+        int parsed = 0;
+        if (ParseNonNegInt(value, &parsed)) n = static_cast<size_t>(parsed);
+      } else if (key == "type") {
+        type = value;
+      }
+    }
+    conn->out = HttpResponse(200, "OK", "application/json",
+                             journal_->RenderJson(n, type) + "\n");
+  } else if (path == "/debug/labels") {
+    std::string body;
+    {
+      std::lock_guard<std::mutex> lock(impl_->mu);
+      body = impl_->labels_json;
+    }
+    if (body.empty()) {
+      conn->out = HttpResponse(503, "Service Unavailable",
+                               "application/json",
+                               "{\"error\":\"no rewrite has completed "
+                               "yet\"}\n");
+    } else {
+      conn->out = HttpResponse(200, "OK", "application/json", body + "\n");
+    }
   } else {
     conn->out = HttpResponse(404, "Not Found", "text/plain",
-                             "serves /healthz, /readyz, /metrics\n");
+                             "serves /healthz, /readyz, /metrics, "
+                             "/debug/journal, /debug/labels\n");
   }
 }
 

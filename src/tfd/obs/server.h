@@ -1,4 +1,5 @@
-// In-daemon introspection HTTP server: /healthz, /readyz, /metrics.
+// In-daemon introspection HTTP server: /healthz, /readyz, /metrics,
+// /debug/journal, /debug/labels.
 //
 // A minimal single-threaded GET-only HTTP/1.1 server: one background
 // thread runs a poll(2) loop over the listen socket and a small fixed
@@ -25,6 +26,7 @@
 #include <memory>
 #include <string>
 
+#include "tfd/obs/journal.h"
 #include "tfd/obs/metrics.h"
 #include "tfd/util/status.h"
 
@@ -43,6 +45,9 @@ Result<ListenAddr> ParseListenAddr(const std::string& text);
 struct ServerOptions {
   std::string addr;        // "host:port" per ParseListenAddr
   int stale_after_s = 120; // /readyz freshness window
+  // Flight recorder behind /debug/journal?n=&type= (null hides the
+  // endpoint; the daemon passes obs::DefaultJournal()).
+  Journal* journal = nullptr;
 };
 
 class IntrospectionServer {
@@ -70,6 +75,12 @@ class IntrospectionServer {
   // RecordRewrite.
   void SetAllExpired(bool all_expired);
 
+  // Pre-rendered /debug/labels document (current labels + per-key
+  // provenance), handed over by the daemon loop after every successful
+  // rewrite — built from the SAME merged map the sink wrote, so the
+  // endpoint agrees with the emitted label file byte-for-byte.
+  void SetLabelsJson(std::string json);
+
  private:
   IntrospectionServer() = default;
   void Loop();
@@ -78,6 +89,7 @@ class IntrospectionServer {
   void HandleRequest(Conn* conn);
 
   Registry* registry_ = nullptr;
+  Journal* journal_ = nullptr;
   int stale_after_s_ = 120;
   int listen_fd_ = -1;
   int wake_fds_[2] = {-1, -1};  // self-pipe: Stop() wakes the poll loop

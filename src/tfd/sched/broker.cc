@@ -7,6 +7,7 @@
 #include <random>
 #include <thread>
 
+#include "tfd/obs/journal.h"
 #include "tfd/obs/metrics.h"
 #include "tfd/util/logging.h"
 
@@ -57,6 +58,8 @@ bool RunProbeOnce(BrokerControl& control, const ProbeSpec& spec,
                  "successes).",
                  {{"source", spec.name}})
       ->Inc();
+  obs::DefaultJournal().Record("probe-start", spec.name,
+                               "probe " + spec.name + " starting");
   Snapshot snapshot;
   bool fatal = false;
   auto t0 = std::chrono::steady_clock::now();
@@ -79,6 +82,9 @@ bool RunProbeOnce(BrokerControl& control, const ProbeSpec& spec,
                                               : spec.interval_s;
     }
     control.store->PutOk(spec.name, std::move(snapshot));
+    obs::DefaultJournal().Record(
+        "probe-ok", spec.name, "probe " + spec.name + " succeeded",
+        {{"duration_s", std::to_string(seconds)}});
     return true;
   }
   reg.GetCounter("tfd_probe_failures_total",
@@ -86,6 +92,11 @@ bool RunProbeOnce(BrokerControl& control, const ProbeSpec& spec,
                  {{"source", spec.name}})
       ->Inc();
   control.store->PutError(spec.name, s.message(), fatal);
+  obs::DefaultJournal().Record(
+      "probe-fail", spec.name, "probe " + spec.name + " failed",
+      {{"duration_s", std::to_string(seconds)},
+       {"error", s.message()},
+       {"fatal", fatal ? "true" : "false"}});
   TFD_LOG_WARNING << "probe " << spec.name << " failed: " << s.message();
   return false;
 }
@@ -120,6 +131,13 @@ void WorkerLoop(std::shared_ptr<BrokerControl> control, ProbeSpec spec) {
       sleep_s = BackoffWithJitter(consecutive, spec.backoff_initial_s,
                                   spec.backoff_max_s, unit(rng));
       control->store->SetBackoff(spec.name, sleep_s);
+      obs::DefaultJournal().Record(
+          "probe-backoff", spec.name,
+          "probe " + spec.name + " backing off " +
+              std::to_string(sleep_s) + "s after " +
+              std::to_string(consecutive) + " consecutive failure(s)",
+          {{"backoff_s", std::to_string(sleep_s)},
+           {"consecutive_failures", std::to_string(consecutive)}});
     }
     obs::Default()
         .GetGauge("tfd_probe_backoff_seconds",

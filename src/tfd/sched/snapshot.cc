@@ -1,5 +1,7 @@
 #include "tfd/sched/snapshot.h"
 
+#include "tfd/obs/journal.h"
+
 namespace tfd {
 namespace sched {
 
@@ -67,15 +69,21 @@ void SnapshotStore::PutError(const std::string& source,
 }
 
 void SnapshotStore::InvalidateAll() {
-  std::lock_guard<std::mutex> lock(mu_);
-  for (auto& [name, state] : states_) {
-    state.last_ok.reset();
-    state.settled = false;
-    state.last_error.clear();
-    state.fatal_error = false;
-    state.consecutive_failures = 0;
-    state.backoff_s = 0;
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    for (auto& [name, state] : states_) {
+      state.last_ok.reset();
+      state.settled = false;
+      state.last_error.clear();
+      state.fatal_error = false;
+      state.consecutive_failures = 0;
+      state.backoff_s = 0;
+      state.last_seen_tier = Tier::kNone;
+    }
   }
+  obs::DefaultJournal().Record(
+      "snapshots-invalidated", "",
+      "every probe-source snapshot invalidated (config regen)");
 }
 
 void SnapshotStore::SetBackoff(const std::string& source, double backoff_s) {
@@ -105,6 +113,19 @@ SourceView SnapshotStore::View(const std::string& source) const {
                      .count();
   }
   view.tier = TierForAge(view.age_s, state.policy);
+  // Tier is a function of age, so transitions become visible at read
+  // time; journal the first reader's observation of each change (the
+  // flight-recorder record the degradation ladder correlates with).
+  if (state.settled && view.tier != state.last_seen_tier) {
+    obs::DefaultJournal().Record(
+        "tier-change", source,
+        source + " snapshot tier " + TierName(state.last_seen_tier) +
+            " -> " + TierName(view.tier),
+        {{"from", TierName(state.last_seen_tier)},
+         {"to", TierName(view.tier)},
+         {"age_s", std::to_string(view.age_s)}});
+    state.last_seen_tier = view.tier;
+  }
   return view;
 }
 

@@ -128,6 +128,11 @@ class SnapshotStore {
     bool fatal_error = false;
     int consecutive_failures = 0;
     double backoff_s = 0;
+    // Last tier observed by a reader — tier is a function of age, so
+    // transitions surface at read time; View() journals the change
+    // (obs/journal.h "tier-change" events). Mutable: observation
+    // bookkeeping, not logical state.
+    mutable Tier last_seen_tier = Tier::kNone;
   };
 
   mutable std::mutex mu_;

@@ -1,0 +1,43 @@
+// libFuzzer target for the flight recorder's JSON exposition
+// (/debug/journal): hostile event payloads — huge label values, embedded
+// quotes/newlines, non-UTF8 bytes — must never produce output the JSON
+// grammar (our own jsonlite parser as the oracle) rejects, and the ring
+// buffer must stay bounded under any append pattern. See
+// fuzz_yamllite.cc for the engine/driver arrangement.
+#include <cstddef>
+#include <cstdint>
+#include <string>
+
+#include "tfd/obs/journal.h"
+#include "tfd/util/jsonlite.h"
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
+  std::string text(reinterpret_cast<const char*>(data), size);
+  // Metrics disabled: hostile event types must not grow the process
+  // registry across iterations (the journal itself is the target).
+  tfd::obs::Journal journal(/*capacity=*/8, /*metrics=*/false);
+  size_t third = text.size() / 3;
+  std::string type = text.substr(0, third);
+  std::string source = text.substr(third, third);
+  std::string rest = text.substr(2 * third);
+  journal.BeginRewrite();
+  journal.Record(type, source, rest, {{rest, text}, {"value", type}});
+  journal.Record("label-diff", source, text,
+                 {{"key", text}, {"old", rest}, {"new", type}});
+  for (int i = 0; i < 12; i++) journal.Record(type, source, rest);
+
+  // Whatever the payload, the rendered document must be valid JSON
+  // (this is exactly what /debug/journal serves), valid UTF-8 (strict
+  // consumers like Python json.load must decode it — SanitizeUtf8 is
+  // idempotent, so sanitizing an already-clean document is identity),
+  // the ring bounded, and the filtered render valid too.
+  std::string json = journal.RenderJson();
+  auto doc = tfd::jsonlite::Parse(json);
+  if (!doc.ok()) __builtin_trap();
+  if (tfd::jsonlite::SanitizeUtf8(json) != json) __builtin_trap();
+  if (journal.Snapshot().size() > journal.capacity()) __builtin_trap();
+  auto filtered = tfd::jsonlite::Parse(journal.RenderJson(2, type));
+  if (!filtered.ok()) __builtin_trap();
+  (void)tfd::obs::EventJson(journal.Snapshot(1).front());
+  return 0;
+}

@@ -23,9 +23,11 @@
 #include "tfd/config/yamllite.h"
 #include "tfd/gce/metadata.h"
 #include "tfd/lm/labels.h"
+#include "tfd/lm/merge.h"
 #include "tfd/lm/schema.h"
 #include "tfd/lm/slice_strategy.h"
 #include "tfd/lm/tpu_labeler.h"
+#include "tfd/obs/journal.h"
 #include "tfd/obs/metrics.h"
 #include "tfd/obs/server.h"
 #include "tfd/pjrt/pjrt_binding.h"
@@ -39,6 +41,7 @@
 #include "tfd/util/file.h"
 #include "tfd/util/http.h"
 #include "tfd/util/jsonlite.h"
+#include "tfd/util/logging.h"
 #include "tfd/util/strings.h"
 #include "tfd/util/subprocess.h"
 
@@ -1465,6 +1468,230 @@ void TestProbeBrokerWorkers() {
   CHECK_TRUE(bad.consecutive_failures >= 1);
 }
 
+void TestJournalCapacityDropOrdering() {
+  // Bounded ring: drop-oldest, monotone seq, stable ordering.
+  obs::Journal journal(3, /*metrics=*/false);
+  CHECK_EQ(journal.capacity(), size_t{3});
+  journal.Record("a", "s1", "first");
+  journal.Record("b", "s2", "second");
+  journal.Record("a", "s3", "third");
+  CHECK_EQ(journal.dropped_total(), uint64_t{0});
+  journal.Record("c", "s4", "fourth");  // evicts "first"
+  CHECK_EQ(journal.dropped_total(), uint64_t{1});
+
+  std::vector<obs::Event> events = journal.Snapshot();
+  CHECK_EQ(events.size(), size_t{3});
+  CHECK_EQ(events[0].message, "second");
+  CHECK_EQ(events[2].message, "fourth");
+  // seq is journal-global and monotone across drops.
+  CHECK_EQ(events[0].seq, uint64_t{2});
+  CHECK_EQ(events[1].seq, uint64_t{3});
+  CHECK_EQ(events[2].seq, uint64_t{4});
+
+  // Type filter + newest-n limit compose.
+  journal.Record("a", "s5", "fifth");
+  std::vector<obs::Event> only_a = journal.Snapshot(0, "a");
+  CHECK_EQ(only_a.size(), size_t{2});
+  CHECK_EQ(only_a.back().message, "fifth");
+  CHECK_EQ(journal.Snapshot(1, "a").size(), size_t{1});
+  CHECK_EQ(journal.Snapshot(1, "a")[0].message, "fifth");
+
+  // Shrinking capacity drops oldest and counts the drops.
+  journal.SetCapacity(1);
+  CHECK_EQ(journal.Snapshot().size(), size_t{1});
+  CHECK_TRUE(journal.dropped_total() >= 3);
+}
+
+void TestJournalGenerationCorrelation() {
+  obs::Journal journal(8, /*metrics=*/false);
+  journal.Record("pre", "", "before any rewrite");
+  uint64_t g1 = journal.BeginRewrite();
+  journal.Record("in1", "", "inside first rewrite");
+  uint64_t g2 = journal.BeginRewrite();
+  journal.Record("in2", "", "inside second rewrite");
+  CHECK_TRUE(g2 == g1 + 1);
+  std::vector<obs::Event> events = journal.Snapshot();
+  CHECK_EQ(events[0].generation, uint64_t{0});
+  CHECK_EQ(events[1].generation, g1);
+  CHECK_EQ(events[2].generation, g2);
+  // The correlation id is mirrored into the JSON log lines.
+  CHECK_EQ(log::CurrentGeneration(), g2);
+}
+
+void TestSanitizeUtf8() {
+  // Identity on valid UTF-8, including multi-byte and 4-byte planes.
+  CHECK_EQ(jsonlite::SanitizeUtf8("plain ascii"), "plain ascii");
+  CHECK_EQ(jsonlite::SanitizeUtf8("caf\xc3\xa9 \xe2\x82\xac "
+                                  "\xf0\x9f\x99\x82"),
+           "caf\xc3\xa9 \xe2\x82\xac \xf0\x9f\x99\x82");
+  // Ill-formed sequences become U+FFFD: stray continuation, stray
+  // lead, overlong, surrogate encoding, truncated tail.
+  const char* fffd = "\xef\xbf\xbd";
+  CHECK_EQ(jsonlite::SanitizeUtf8("a\x80z"), std::string("a") + fffd + "z");
+  CHECK_EQ(jsonlite::SanitizeUtf8("a\xffz"), std::string("a") + fffd + "z");
+  CHECK_EQ(jsonlite::SanitizeUtf8("\xc0\xaf"),
+           std::string(fffd) + fffd);  // overlong '/'
+  CHECK_EQ(jsonlite::SanitizeUtf8("\xed\xa0\x80"),
+           std::string(fffd) + fffd + fffd);  // UTF-8-encoded surrogate
+  CHECK_EQ(jsonlite::SanitizeUtf8("tail\xc3"),
+           std::string("tail") + fffd);  // truncated 2-byte seq
+  // Idempotent: sanitizing sanitized text is identity (the fuzz
+  // target's valid-UTF-8 oracle rides on this).
+  std::string once = jsonlite::SanitizeUtf8("x\xfe\xc3(\xf5y");
+  CHECK_EQ(jsonlite::SanitizeUtf8(once), once);
+}
+
+void TestJournalJsonHostileBytes() {
+  // /debug/journal exposition must stay valid JSON *and* valid UTF-8
+  // for ANY payload bytes (the fuzz target's oracle, pinned here
+  // deterministically) — strict consumers (Python json.load) must
+  // always decode what the endpoint serves.
+  obs::Journal journal(4, /*metrics=*/false);
+  std::string hostile = "quote\" slash\\ newline\n tab\t ctrl\x01 "
+                        "high\xff\xc3(";
+  journal.Record(hostile, hostile, hostile, {{hostile, hostile}});
+  std::string json = journal.RenderJson();
+  CHECK_EQ(jsonlite::SanitizeUtf8(json), json);  // already valid UTF-8
+  Result<jsonlite::ValuePtr> doc = jsonlite::Parse(json);
+  CHECK_TRUE(doc.ok());
+  if (doc.ok()) {
+    jsonlite::ValuePtr events = (*doc)->Get("events");
+    CHECK_TRUE(events != nullptr &&
+               events->kind == jsonlite::Value::Kind::kArray);
+    // Round-trip: sanitized at ingestion (invalid bytes -> U+FFFD),
+    // then preserved exactly.
+    jsonlite::ValuePtr message = events->array_items[0]->Get("message");
+    CHECK_TRUE(message != nullptr &&
+               message->string_value == jsonlite::SanitizeUtf8(hostile));
+  }
+
+  // The journal metrics register in the default registry (exposition
+  // stays valid — the registry sanitizes/escapes).
+  obs::DefaultJournal().Record("unit-test", "", "metrics registration");
+  CHECK_TRUE(obs::ValidateExposition(obs::Default().Exposition()).ok());
+}
+
+void TestLabelDiff() {
+  lm::Labels prev{{"a", "1"}, {"b", "2"}, {"c", "3"}};
+  lm::Labels next{{"b", "2"}, {"c", "9"}, {"d", "4"}};
+  std::vector<lm::LabelDiffEntry> diff = lm::DiffLabels(prev, next);
+  CHECK_EQ(diff.size(), size_t{3});
+  CHECK_EQ(diff[0].key, "a");
+  CHECK_EQ(std::string(lm::DiffOpName(diff[0].op)), "removed");
+  CHECK_EQ(diff[0].old_value, "1");
+  CHECK_EQ(diff[1].key, "c");
+  CHECK_EQ(std::string(lm::DiffOpName(diff[1].op)), "changed");
+  CHECK_EQ(diff[1].old_value, "3");
+  CHECK_EQ(diff[1].new_value, "9");
+  CHECK_EQ(diff[2].key, "d");
+  CHECK_EQ(std::string(lm::DiffOpName(diff[2].op)), "added");
+  CHECK_EQ(diff[2].new_value, "4");
+
+  CHECK_TRUE(lm::DiffLabels(prev, prev).empty());
+  CHECK_EQ(lm::DiffLabels({}, next).size(), next.size());
+  CHECK_EQ(lm::DiffLabels(prev, {}).size(), prev.size());
+}
+
+void TestLabelKeyPrefix() {
+  CHECK_EQ(lm::LabelKeyPrefix("google.com/tpu.count"), "google.com/tpu");
+  CHECK_EQ(lm::LabelKeyPrefix("google.com/tfd.timestamp"),
+           "google.com/tfd");
+  CHECK_EQ(lm::LabelKeyPrefix("google.com/tpu.health.ok"),
+           "google.com/tpu");
+  CHECK_EQ(lm::LabelKeyPrefix("google.com/tpu-vm.present"),
+           "google.com/tpu-vm");
+  CHECK_EQ(lm::LabelKeyPrefix("noslash"), "noslash");
+  CHECK_EQ(lm::LabelKeyPrefix("plain.key"), "plain");
+  CHECK_EQ(lm::LabelKeyPrefix("google.com/nodot"), "google.com/nodot");
+}
+
+void TestLogFormatLine() {
+  // klog: byte-compatible with the pre-journal format.
+  std::string klog = log::FormatLine(log::Severity::kWarning, "hello",
+                                     log::Format::kKlog,
+                                     1700000000123LL, 7);
+  CHECK_TRUE(klog.size() > 2 && klog[0] == 'W');
+  CHECK_TRUE(klog.find(" tpu-feature-discovery: hello") !=
+             std::string::npos);
+
+  // json: one valid JSON object carrying ts / generation / severity /
+  // message (the journal event schema's shared keys).
+  std::string json = log::FormatLine(log::Severity::kError,
+                                     "msg with \"quotes\"\nand newline",
+                                     log::Format::kJson,
+                                     1700000000123LL, 42);
+  Result<jsonlite::ValuePtr> doc = jsonlite::Parse(json);
+  CHECK_TRUE(doc.ok());
+  if (doc.ok()) {
+    CHECK_EQ((*doc)->Get("severity")->string_value, "error");
+    CHECK_EQ((*doc)->Get("type")->string_value, "log");
+    CHECK_EQ((*doc)->Get("generation")->number_value, 42.0);
+    CHECK_EQ((*doc)->Get("message")->string_value,
+             "msg with \"quotes\"\nand newline");
+    CHECK_TRUE((*doc)->Get("ts")->number_value > 1.6e9);
+  }
+}
+
+void TestDebugEndpoints() {
+  // /debug/journal (filtering) and /debug/labels (handed-over document)
+  // over the real server socket.
+  obs::Registry reg;
+  obs::Journal journal(16, /*metrics=*/false);
+  journal.Record("label-diff", "mock", "added x");
+  journal.Record("probe-ok", "mock", "probe ok");
+  journal.Record("label-diff", "mock", "changed y");
+
+  obs::ServerOptions options;
+  options.addr = "127.0.0.1:0";
+  options.journal = &journal;
+  Result<std::unique_ptr<obs::IntrospectionServer>> server =
+      obs::IntrospectionServer::Start(options, &reg);
+  CHECK_TRUE(server.ok());
+  std::string base =
+      "http://127.0.0.1:" + std::to_string((*server)->port());
+  http::RequestOptions ropt;
+  ropt.timeout_ms = 3000;
+
+  Result<http::Response> r =
+      http::Request("GET", base + "/debug/journal", "", ropt);
+  CHECK_TRUE(r.ok());
+  CHECK_EQ(r->status, 200);
+  Result<jsonlite::ValuePtr> doc = jsonlite::Parse(
+      r->body.substr(0, r->body.find_last_not_of('\n') + 1));
+  CHECK_TRUE(doc.ok());
+  if (doc.ok()) {
+    CHECK_EQ((*doc)->Get("events")->array_items.size(), size_t{3});
+  }
+
+  r = http::Request("GET", base + "/debug/journal?type=label-diff&n=1",
+                    "", ropt);
+  CHECK_TRUE(r.ok());
+  doc = jsonlite::Parse(r->body.substr(0, r->body.size() - 1));
+  CHECK_TRUE(doc.ok());
+  if (doc.ok()) {
+    jsonlite::ValuePtr events = (*doc)->Get("events");
+    CHECK_EQ(events->array_items.size(), size_t{1});
+    CHECK_EQ(events->array_items[0]->Get("message")->string_value,
+             "changed y");
+  }
+
+  // /debug/labels: 503 before the first handover, then the document.
+  r = http::Request("GET", base + "/debug/labels", "", ropt);
+  CHECK_TRUE(r.ok());
+  CHECK_EQ(r->status, 503);
+  (*server)->SetLabelsJson("{\"generation\":1,\"labels\":{\"k\":\"v\"},"
+                           "\"provenance\":{}}");
+  r = http::Request("GET", base + "/debug/labels", "", ropt);
+  CHECK_TRUE(r.ok());
+  CHECK_EQ(r->status, 200);
+  doc = jsonlite::Parse(r->body.substr(0, r->body.size() - 1));
+  CHECK_TRUE(doc.ok());
+  if (doc.ok()) {
+    CHECK_EQ((*doc)->GetPath("labels.k")->string_value, "v");
+  }
+  (*server)->Stop();
+}
+
 void TestBackendCandidatesList() {
   config::Config config;
   config.flags.backend = "null";
@@ -1557,6 +1784,14 @@ int main(int argc, char** argv) {
   tfd::TestProbeBrokerOneRound();
   tfd::TestProbeBrokerWorkers();
   tfd::TestBackendCandidatesList();
+  tfd::TestJournalCapacityDropOrdering();
+  tfd::TestJournalGenerationCorrelation();
+  tfd::TestSanitizeUtf8();
+  tfd::TestJournalJsonHostileBytes();
+  tfd::TestLabelDiff();
+  tfd::TestLabelKeyPrefix();
+  tfd::TestLogFormatLine();
+  tfd::TestDebugEndpoints();
 
   std::cerr << tfd::g_checks << " checks, " << tfd::g_failures << " failures"
             << std::endl;

@@ -268,6 +268,46 @@ std::string Quote(const std::string& s) {
   return out + "\"";
 }
 
+std::string SanitizeUtf8(const std::string& s) {
+  // Strict well-formedness per RFC 3629: the lead byte constrains the
+  // first continuation byte's range (rejecting overlongs, surrogate
+  // code points, and > U+10FFFF), later continuations are 80-BF.
+  std::string out;
+  out.reserve(s.size());
+  size_t i = 0;
+  auto cont = [&](size_t off, unsigned char lo, unsigned char hi) {
+    if (i + off >= s.size()) return false;
+    unsigned char c = static_cast<unsigned char>(s[i + off]);
+    return c >= lo && c <= hi;
+  };
+  while (i < s.size()) {
+    unsigned char c = static_cast<unsigned char>(s[i]);
+    size_t len = 0;
+    if (c <= 0x7F) {
+      len = 1;
+    } else if (c >= 0xC2 && c <= 0xDF && cont(1, 0x80, 0xBF)) {
+      len = 2;
+    } else if ((c == 0xE0 && cont(1, 0xA0, 0xBF)) ||
+               (c >= 0xE1 && c <= 0xEC && cont(1, 0x80, 0xBF)) ||
+               (c == 0xED && cont(1, 0x80, 0x9F)) ||
+               (c >= 0xEE && c <= 0xEF && cont(1, 0x80, 0xBF))) {
+      if (cont(2, 0x80, 0xBF)) len = 3;
+    } else if ((c == 0xF0 && cont(1, 0x90, 0xBF)) ||
+               (c >= 0xF1 && c <= 0xF3 && cont(1, 0x80, 0xBF)) ||
+               (c == 0xF4 && cont(1, 0x80, 0x8F))) {
+      if (cont(2, 0x80, 0xBF) && cont(3, 0x80, 0xBF)) len = 4;
+    }
+    if (len == 0) {
+      out += "\xEF\xBF\xBD";  // U+FFFD REPLACEMENT CHARACTER
+      i++;
+    } else {
+      out.append(s, i, len);
+      i += len;
+    }
+  }
+  return out;
+}
+
 std::string SerializeStringMap(const std::map<std::string, std::string>& m) {
   std::ostringstream out;
   out << "{";

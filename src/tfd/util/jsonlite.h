@@ -39,8 +39,18 @@ struct Value {
 
 Result<ValuePtr> Parse(const std::string& text);
 
-// Escapes and quotes a JSON string.
+// Escapes and quotes a JSON string. Bytes >= 0x80 pass through
+// unchanged, so the result is only as UTF-8-valid as the input — run
+// hostile bytes through SanitizeUtf8 first when the document must be
+// decodable by strict consumers (Python json.load).
 std::string Quote(const std::string& s);
+
+// Replaces every ill-formed UTF-8 sequence (stray continuation bytes,
+// overlongs, surrogate encodings, truncated sequences) with U+FFFD.
+// Identity on valid UTF-8; idempotent. The journal and the JSON log
+// format pass all externally-sourced text through this so /debug/*
+// responses and log lines always decode.
+std::string SanitizeUtf8(const std::string& s);
 
 // Serializes a string map as a JSON object with sorted keys (deterministic).
 std::string SerializeStringMap(const std::map<std::string, std::string>& m);

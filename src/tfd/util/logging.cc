@@ -1,30 +1,102 @@
 #include "tfd/util/logging.h"
 
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstdio>
 #include <ctime>
+
+#include "tfd/util/jsonlite.h"
 
 namespace tfd {
 namespace log {
 
-LogLine::~LogLine() {
-  char prefix = 'I';
-  switch (sev_) {
+namespace {
+
+std::atomic<Format> g_format{Format::kKlog};
+std::atomic<uint64_t> g_generation{0};
+
+const char* SeverityName(Severity sev) {
+  switch (sev) {
     case Severity::kInfo:
-      prefix = 'I';
-      break;
+      return "info";
     case Severity::kWarning:
-      prefix = 'W';
-      break;
+      return "warning";
     case Severity::kError:
-      prefix = 'E';
-      break;
+      return "error";
   }
-  std::time_t now = std::time(nullptr);
+  return "info";
+}
+
+char SeverityPrefix(Severity sev) {
+  switch (sev) {
+    case Severity::kInfo:
+      return 'I';
+    case Severity::kWarning:
+      return 'W';
+    case Severity::kError:
+      return 'E';
+  }
+  return 'I';
+}
+
+}  // namespace
+
+void SetFormat(Format format) {
+  g_format.store(format, std::memory_order_relaxed);
+}
+
+Format GetFormat() { return g_format.load(std::memory_order_relaxed); }
+
+void SetCurrentGeneration(uint64_t generation) {
+  g_generation.store(generation, std::memory_order_relaxed);
+}
+
+uint64_t CurrentGeneration() {
+  return g_generation.load(std::memory_order_relaxed);
+}
+
+std::string FormatLine(Severity severity, const std::string& body,
+                       Format format, int64_t wall_ms,
+                       uint64_t generation) {
+  if (format == Format::kJson) {
+    // One JSON object per line, reusing the journal event schema
+    // (ts / generation / type / message) so `jq` pipelines treat log
+    // lines and /debug/journal events uniformly.
+    char ts[32];
+    snprintf(ts, sizeof(ts), "%lld.%03lld",
+             static_cast<long long>(wall_ms / 1000),
+             static_cast<long long>(wall_ms % 1000));
+    return std::string("{\"ts\":") + ts +
+           ",\"generation\":" + std::to_string(generation) +
+           ",\"type\":\"log\",\"severity\":\"" + SeverityName(severity) +
+           "\",\"message\":" +
+           jsonlite::Quote(jsonlite::SanitizeUtf8(body)) + "}";
+  }
+  std::time_t now = static_cast<std::time_t>(wall_ms / 1000);
   std::tm tm_buf{};
   gmtime_r(&now, &tm_buf);
   char ts[32];
   std::strftime(ts, sizeof(ts), "%m%d %H:%M:%S", &tm_buf);
-  std::cerr << prefix << ts << " tpu-feature-discovery: " << stream_.str()
-            << std::endl;
+  return SeverityPrefix(severity) + std::string(ts) +
+         " tpu-feature-discovery: " + body;
+}
+
+LogLine::~LogLine() {
+  int64_t wall_ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+                        std::chrono::system_clock::now().time_since_epoch())
+                        .count();
+  std::string line = FormatLine(sev_, stream_.str(), GetFormat(), wall_ms,
+                                CurrentGeneration());
+  line.push_back('\n');
+  // One write(2) for the whole line: concurrent threads (broker workers,
+  // the introspection server) must not interleave mid-line. POSIX makes
+  // a single small write to the same fd atomic enough for line logs; a
+  // short write (signal-less here, but possible on weird fds) just
+  // truncates this one line rather than corrupting the stream.
+  ssize_t ignored = write(2, line.data(), line.size());
+  (void)ignored;
 }
 
 }  // namespace log

@@ -4,9 +4,19 @@
 // main.go:20). We keep the same minimal surface: Info / Warning / Error with
 // printf-free streaming, timestamps, and a severity prefix that matches what
 // cluster operators grep for.
+//
+// Emission contract: the destructor formats the WHOLE line (prefix,
+// timestamp, body, newline) into one buffer and emits it with a single
+// write(2) to fd 2 — the daemon's broker/server threads log concurrently,
+// and per-`<<` streaming to std::cerr could tear lines mid-byte-run. That
+// single-write seam is also where --log-format=json plugs in: SetFormat
+// switches every line to one JSON object (reusing the journal event
+// schema: ts / generation / type / message, plus severity), with the
+// rewrite-generation correlation id provided via SetCurrentGeneration
+// (the journal calls it from BeginRewrite).
 #pragma once
 
-#include <iostream>
+#include <cstdint>
 #include <sstream>
 #include <string>
 
@@ -14,6 +24,23 @@ namespace tfd {
 namespace log {
 
 enum class Severity { kInfo, kWarning, kError };
+
+enum class Format { kKlog, kJson };
+
+// Process-wide output format (default klog). Set once per config load.
+void SetFormat(Format format);
+Format GetFormat();
+
+// Rewrite-generation correlation id carried by JSON log lines; the
+// journal's BeginRewrite keeps it current.
+void SetCurrentGeneration(uint64_t generation);
+uint64_t CurrentGeneration();
+
+// Formats one line (without trailing newline) the way the destructor
+// emits it — exposed for tests.
+std::string FormatLine(Severity severity, const std::string& body,
+                       Format format, int64_t wall_ms,
+                       uint64_t generation);
 
 class LogLine {
  public:

@@ -80,12 +80,22 @@ def _gxx_build():
                               re.MULTILINE)
     core_sources = [s for s in core_sources
                     if "tests/" not in s and "testing/" not in s]
+    # Compile in parallel (the tier-1 time budget pays for every serial
+    # second here); each job is independent, the links below are not.
+    from concurrent.futures import ThreadPoolExecutor
+
     objects = []
-    for src in core_sources:
-        obj = obj_dir / (src.replace("/", "_") + ".o")
-        objects.append(str(obj))
-        subprocess.run([*common, *defines, "-c", str(REPO / src),
-                        "-o", str(obj)], check=True, capture_output=True)
+    jobs = []
+    with ThreadPoolExecutor(max_workers=os.cpu_count() or 2) as pool:
+        for src in core_sources:
+            obj = obj_dir / (src.replace("/", "_") + ".o")
+            objects.append(str(obj))
+            jobs.append(pool.submit(
+                subprocess.run, [*common, *defines, "-c", str(REPO / src),
+                                 "-o", str(obj)],
+                check=True, capture_output=True))
+        for job in jobs:
+            job.result()  # re-raises the first compile failure
     link = ["-ldl", "-lpthread"]
     subprocess.run([*common, *defines,
                     str(REPO / "cmd/tpu-feature-discovery/main.cc"),
@@ -166,3 +176,44 @@ def check_golden(output: str, golden_file: Path):
 def labels_of(output: str):
     """Parses `key=value` label lines into a dict."""
     return dict(line.split("=", 1) for line in output.splitlines() if line)
+
+
+# ---- introspection-server test helpers (shared by test_introspection,
+# test_sched, test_journal — one home, so the daemon-driving idiom and
+# its timeouts cannot drift between files) ----------------------------------
+
+def http_get(port, path, timeout=2):
+    """(status, body); (None, "") while the server is unreachable —
+    polling callers ride through startup and SIGHUP-rebind windows."""
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+    except (OSError, urllib.error.URLError):
+        return None, ""
+
+
+def wait_for(predicate, timeout=30, interval=0.05):
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def daemon_argv(binary, port, out_file, extra=()):
+    """Standard daemon-under-test invocation: mock backend, 1s cadence,
+    introspection pinned to a loopback port."""
+    return [str(binary), "--sleep-interval=1s", "--backend=mock",
+            f"--mock-topology-file={FIXTURES / 'v2-8.yaml'}",
+            "--machine-type-file=/dev/null",
+            f"--output-file={out_file}",
+            f"--introspection-addr=127.0.0.1:{port}", *extra]

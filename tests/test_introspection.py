@@ -14,41 +14,11 @@ from pathlib import Path
 
 import pytest
 
-from conftest import FIXTURES, run_tfd
+from conftest import FIXTURES, daemon_argv, http_get, run_tfd, wait_for
 from tpufd import metrics
 from tpufd.fakes import free_loopback_port as free_port
 
 SOAK = Path(__file__).resolve().parent.parent / "scripts" / "soak.py"
-
-
-def http_get(port, path, timeout=2):
-    """(status, body); (None, "") while the server is unreachable —
-    polling callers ride through startup and SIGHUP-rebind windows."""
-    try:
-        with urllib.request.urlopen(
-                f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
-            return r.status, r.read().decode()
-    except urllib.error.HTTPError as e:
-        return e.code, e.read().decode()
-    except (OSError, urllib.error.URLError):
-        return None, ""
-
-
-def wait_for(predicate, timeout=15, interval=0.05):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if predicate():
-            return True
-        time.sleep(interval)
-    return False
-
-
-def daemon_argv(binary, port, out_file, extra=()):
-    return [str(binary), "--sleep-interval=1s", "--backend=mock",
-            f"--mock-topology-file={FIXTURES / 'v2-8.yaml'}",
-            "--machine-type-file=/dev/null",
-            f"--output-file={out_file}",
-            f"--introspection-addr=127.0.0.1:{port}", *extra]
 
 
 @pytest.fixture
@@ -308,6 +278,86 @@ def test_invalid_addr_rejected_at_config(tfd_binary):
     code, _, err = run_tfd(tfd_binary, ["--introspection-addr=8081"])
     assert code == 1
     assert "introspection" in err
+
+
+def test_concurrent_scrapes_survive_sighup_and_rewrites(tfd_binary,
+                                                        tmp_path):
+    """Satellite (ISSUE 3): the introspection server under concurrency —
+    /metrics, /debug/journal, and /debug/labels hammered from parallel
+    threads while rewrites land every second and a SIGHUP rebinds the
+    server mid-scrape. Every 200 body must be complete and parseable
+    (no torn responses); connection errors during the rebind window are
+    the only acceptable failures; and the daemon's fd count returns to
+    its pre-storm baseline (no leaked conns)."""
+    import json
+    import threading
+
+    from tpufd import journal as journal_lib
+
+    port = free_port()
+    out_file = tmp_path / "tfd"
+    proc = subprocess.Popen(
+        daemon_argv(tfd_binary, port, out_file),
+        env={**os.environ, "GCE_METADATA_HOST": "127.0.0.1:1"},
+        stderr=subprocess.DEVNULL)
+
+    def fd_count():
+        counts = []
+        for _ in range(3):
+            counts.append(len(os.listdir(f"/proc/{proc.pid}/fd")))
+            time.sleep(0.05)
+        return min(counts)
+
+    failures = []
+    responses = {"metrics": 0, "journal": 0, "labels": 0}
+    stop = threading.Event()
+
+    def hammer(path, key, check):
+        while not stop.is_set():
+            status, body = http_get(port, path, timeout=3)
+            if status is None:
+                continue  # rebind window / conn budget: retry
+            if status == 503 and key == "labels":
+                continue  # rebound server, first rewrite not in yet
+            if status != 200:
+                failures.append((key, status))
+                continue
+            try:
+                check(body)
+            except Exception as e:  # torn/invalid body IS the failure
+                failures.append((key, repr(e), body[-200:]))
+            responses[key] += 1
+
+    checks = [
+        ("/metrics", "metrics", metrics.validate_exposition),
+        ("/debug/journal", "journal",
+         lambda body: journal_lib.parse_journal(body)),
+        ("/debug/labels", "labels",
+         lambda body: json.loads(body)["labels"]),
+    ]
+    try:
+        assert wait_for(lambda: http_get(port, "/readyz")[0] == 200)
+        baseline_fd = fd_count()
+        threads = [threading.Thread(target=hammer, args=args)
+                   for args in checks for _ in range(2)]
+        for t in threads:
+            t.start()
+        time.sleep(1.5)
+        proc.send_signal(signal.SIGHUP)  # rebind mid-storm
+        time.sleep(2.5)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert not failures, failures[:5]
+        assert all(count > 5 for count in responses.values()), responses
+        # Back to ready on the rebound server, fds back to baseline.
+        assert wait_for(lambda: http_get(port, "/readyz")[0] == 200)
+        assert wait_for(lambda: fd_count() <= baseline_fd, timeout=15), \
+            f"fd leak: {fd_count()} > baseline {baseline_fd}"
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=10)
 
 
 def test_soak_scrapes_daemon_metrics(tfd_binary):

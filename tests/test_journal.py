@@ -1,0 +1,360 @@
+"""Tier 2: the flight recorder (src/tfd/obs/journal) against the real
+binary — /debug/journal content and filtering, /debug/labels provenance
+agreeing with the emitted label file byte-for-byte, the SIGUSR1
+post-mortem dump, --log-format=json, the bounded ring, and the soak
+harness's --require-journal explainability invariant under an injected
+probe wedge (the ISSUE 3 acceptance scenario)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from conftest import BUILD_DIR, daemon_argv, http_get, wait_for
+from tpufd import journal as journal_lib
+from tpufd import metrics
+from tpufd.fakes import free_loopback_port as free_port
+
+SOAK = Path(__file__).resolve().parent.parent / "scripts" / "soak.py"
+FAKE_PJRT = BUILD_DIR / "libtfd_fake_pjrt.so"
+
+
+def journal_doc(port, query=""):
+    status, text = http_get(port, f"/debug/journal{query}")
+    if status != 200:
+        return None
+    return journal_lib.parse_journal(text)
+
+
+@pytest.fixture
+def daemon(tfd_binary, tmp_path):
+    port = free_port()
+    out_file = tmp_path / "tfd"
+    proc = subprocess.Popen(
+        daemon_argv(tfd_binary, port, out_file),
+        env={**os.environ, "GCE_METADATA_HOST": "127.0.0.1:1"},
+        stderr=subprocess.DEVNULL)
+    try:
+        assert wait_for(lambda: out_file.exists()), "first pass never ran"
+        yield port, out_file, proc
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=10)
+
+
+class TestDebugJournal:
+    def test_journal_records_the_causal_chain(self, daemon):
+        """One healthy pass leaves the full explainability chain in the
+        journal: probe lifecycle, rewrite span with labeler timings,
+        sink write, degradation's first none->0 transition, and one
+        label-diff per initially-added key — all correlated by
+        generation."""
+        port, out_file, _ = daemon
+        assert wait_for(lambda: (journal_doc(port) or
+                                 {"generation": 0})["generation"] >= 2)
+        doc = journal_doc(port)
+        types = {e["type"] for e in doc["events"]}
+        for expected in ("probe-start", "probe-ok", "rewrite",
+                         "sink-write", "degradation", "label-diff",
+                         "tier-change", "config-load"):
+            assert expected in types, (expected, sorted(types))
+
+        rewrites = journal_lib.events_of_type(doc["events"], "rewrite")
+        span = rewrites[-1]["fields"]
+        assert span["ok"] == "true"
+        assert span["level"] == "0"
+        assert span["source"] == "mock"
+        assert "duration_ms" in span and "labeler_tpu_ms" in span
+
+        degradations = journal_lib.degradation_transitions(doc["events"])
+        assert ("none", "0") in degradations
+
+        # The initial label set arrived as one label-diff per key, each
+        # carrying provenance, matching the emitted file's key set.
+        diffs = journal_lib.events_of_type(doc["events"], "label-diff")
+        diff_keys = {e["fields"]["key"] for e in diffs}
+        file_keys = {line.split("=", 1)[0]
+                     for line in out_file.read_text().splitlines() if line}
+        assert file_keys <= diff_keys
+        ok, problems = journal_lib.diffs_cover_changes(doc["events"], [])
+        assert ok, problems
+
+        # Events carry monotone seqs and rewrite generations.
+        seqs = [e["seq"] for e in doc["events"]]
+        assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+        assert any(e["generation"] >= 1 for e in doc["events"])
+
+    def test_filters_and_limits(self, daemon):
+        port, _, _ = daemon
+        assert wait_for(lambda: journal_doc(port) is not None)
+        only = journal_doc(port, "?type=probe-ok")
+        assert only is not None and only["events"]
+        assert {e["type"] for e in only["events"]} == {"probe-ok"}
+        limited = journal_doc(port, "?n=2")
+        assert len(limited["events"]) == 2
+        # n picks the NEWEST events.
+        full = journal_doc(port)
+        assert limited["events"][-1]["seq"] >= full["events"][-3]["seq"]
+
+    def test_journal_metrics_exported(self, daemon):
+        port, _, _ = daemon
+        assert wait_for(lambda: metrics.sample_value(
+            http_get(port, "/metrics")[1], "tfd_rewrites_total"))
+        text = http_get(port, "/metrics")[1]
+        assert metrics.sample_value(
+            text, "tfd_journal_events_total",
+            labels={"type": "rewrite"}) >= 1
+        assert metrics.sample_value(text, "tfd_journal_dropped_total") == 0
+        assert metrics.sample_value(
+            text, "tfd_label_changes_total",
+            labels={"key_prefix": "google.com/tpu"}) >= 1
+        assert metrics.sample_value(
+            text, "tfd_degradation_transitions_total",
+            labels={"from": "none", "to": "0"}) == 1
+
+
+class TestDebugLabels:
+    def test_matches_label_file_byte_for_byte_with_provenance(
+            self, daemon):
+        port, out_file, _ = daemon
+        assert wait_for(
+            lambda: http_get(port, "/debug/labels")[0] == 200)
+        # Retry around an in-flight rewrite: an observation only counts
+        # when the file did not change while the endpoint was fetched.
+        for _ in range(5):
+            before = out_file.read_text()
+            status, text = http_get(port, "/debug/labels")
+            after = out_file.read_text()
+            if status == 200 and before == after:
+                break
+            time.sleep(0.3)
+        doc = json.loads(text)
+        assert journal_lib.labels_file_text(doc) == before
+        assert doc["generation"] >= 1
+        prov = doc["provenance"]
+        assert set(prov) == set(doc["labels"])
+        assert prov["google.com/tpu.count"] == {
+            "labeler": "tpu", "source": "mock", "tier": "fresh",
+            "age_seconds": pytest.approx(0, abs=10)}
+        assert prov["google.com/tfd.timestamp"]["source"] == "local"
+
+
+class TestSigusr1Dump:
+    def test_dump_writes_journal_snapshots_and_provenance(
+            self, tfd_binary, tmp_path):
+        port = free_port()
+        out_file = tmp_path / "tfd"
+        dump_file = tmp_path / "dump.json"
+        proc = subprocess.Popen(
+            daemon_argv(tfd_binary, port, out_file,
+                        extra=(f"--debug-dump-file={dump_file}",)),
+            env={**os.environ, "GCE_METADATA_HOST": "127.0.0.1:1"},
+            stderr=subprocess.DEVNULL)
+        try:
+            assert wait_for(lambda: out_file.exists())
+            rewrites_before = metrics.sample_value(
+                http_get(port, "/metrics")[1], "tfd_rewrites_total")
+            proc.send_signal(signal.SIGUSR1)
+            assert wait_for(lambda: dump_file.exists()), "no dump"
+            doc = json.loads(dump_file.read_text())
+            assert set(doc) == {"dumped_at", "version", "labels",
+                                "snapshots", "journal"}
+            journal = journal_lib.parse_journal(doc["journal"])
+            # The dump records itself.
+            assert journal_lib.events_of_type(journal["events"], "dump")
+            assert doc["snapshots"]["mock"]["tier"] == "fresh"
+            assert doc["snapshots"]["mock"]["settled"] is True
+            assert doc["labels"]["labels"]["google.com/tpu.count"] == "4"
+            assert doc["labels"]["provenance"]["google.com/tpu.count"][
+                "source"] == "mock"
+            # The dump did not force an extra rewrite: the daemon keeps
+            # sleeping the remainder of its interval.
+            time.sleep(0.3)
+            rewrites_now = metrics.sample_value(
+                http_get(port, "/metrics")[1], "tfd_rewrites_total")
+            assert rewrites_now - rewrites_before <= 1
+        finally:
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=10)
+
+
+class TestJsonLogFormat:
+    def test_every_line_is_one_json_object(self, tfd_binary, tmp_path):
+        port = free_port()
+        out_file = tmp_path / "tfd"
+        stderr_path = tmp_path / "stderr"
+        with open(stderr_path, "wb") as stderr_file:
+            proc = subprocess.Popen(
+                daemon_argv(tfd_binary, port, out_file,
+                            extra=("--log-format=json",)),
+                env={**os.environ, "GCE_METADATA_HOST": "127.0.0.1:1"},
+                stderr=stderr_file)
+        try:
+            assert wait_for(lambda: out_file.exists())
+            time.sleep(1.2)  # a couple of in-pass log lines
+        finally:
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=10)
+        lines = stderr_path.read_text().splitlines()
+        assert lines, "daemon logged nothing"
+        parsed = [json.loads(line) for line in lines]  # raises on tearing
+        for obj in parsed:
+            assert obj["type"] == "log"
+            assert obj["severity"] in ("info", "warning", "error")
+            assert isinstance(obj["message"], str)
+            assert obj["ts"] > 1.6e9
+        # The correlation id appears once rewrites run ("wrote N labels"
+        # lands inside a pass, generation >= 1).
+        wrote = [obj for obj in parsed
+                 if obj["message"].startswith("wrote ")]
+        assert wrote and all(obj["generation"] >= 1 for obj in wrote)
+
+    def test_invalid_format_rejected(self, tfd_binary):
+        from conftest import run_tfd
+
+        code, _, err = run_tfd(tfd_binary, ["--log-format=xml"])
+        assert code == 1
+        assert "log-format" in err
+
+
+class TestBoundedRing:
+    def test_capacity_and_drop_counter(self, tfd_binary, tmp_path):
+        """A tiny --journal-capacity shows the drop-oldest bound from
+        the outside: the served window never exceeds the capacity while
+        tfd_journal_dropped_total keeps counting."""
+        port = free_port()
+        out_file = tmp_path / "tfd"
+        proc = subprocess.Popen(
+            daemon_argv(tfd_binary, port, out_file,
+                        extra=("--journal-capacity=8",)),
+            env={**os.environ, "GCE_METADATA_HOST": "127.0.0.1:1"},
+            stderr=subprocess.DEVNULL)
+        try:
+            assert wait_for(lambda: out_file.exists())
+            assert wait_for(lambda: (metrics.sample_value(
+                http_get(port, "/metrics")[1],
+                "tfd_journal_dropped_total") or 0) > 0, timeout=15)
+            doc = journal_doc(port)
+            assert doc["capacity"] == 8
+            assert len(doc["events"]) <= 8  # parse_journal asserts too
+            assert doc["dropped_total"] > 0
+        finally:
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=10)
+
+
+class TestTwinHelpers:
+    """tpufd.journal pure helpers (the soak rides on these)."""
+
+    def test_label_changes_and_cover(self):
+        changes = journal_lib.label_changes(
+            {"a": "1", "b": "2"}, {"b": "3", "c": "4"})
+        assert changes == [("a", "1", None), ("b", "2", "3"),
+                           ("c", None, "4")]
+        events = [
+            {"seq": i + 1, "ts": 0, "generation": 1, "type": "label-diff",
+             "source": "mock", "message": "",
+             "fields": {"key": key, "labeler": "tpu", "source": "mock",
+                        "tier": "fresh"}}
+            for i, key in enumerate(("a", "b", "c"))]
+        ok, problems = journal_lib.diffs_cover_changes(events, changes)
+        assert ok, problems
+        ok, problems = journal_lib.diffs_cover_changes(
+            events[:2], changes)
+        assert not ok and "c" in problems[0]
+        # Provenance-less diffs are a problem even with coverage.
+        events[0]["fields"]["tier"] = ""
+        ok, problems = journal_lib.diffs_cover_changes(events, changes)
+        assert not ok
+
+    def test_parse_rejects_overfull_ring(self):
+        doc = {"capacity": 1, "dropped_total": 0, "generation": 1,
+               "events": [
+                   {"seq": 1, "ts": 0, "generation": 1, "type": "a",
+                    "fields": {}},
+                   {"seq": 2, "ts": 0, "generation": 1, "type": "a",
+                    "fields": {}}]}
+        with pytest.raises(ValueError):
+            journal_lib.parse_journal(doc)
+
+    def test_dump_text_smoke(self):
+        doc = {"capacity": 4, "dropped_total": 0, "generation": 2,
+               "events": [
+                   {"seq": 1, "ts": 1700000000.5, "generation": 1,
+                    "type": "probe-ok", "source": "pjrt",
+                    "message": "probe pjrt succeeded",
+                    "fields": {"duration_s": "0.1"}}]}
+        text = journal_lib.dump_text(journal_lib.parse_journal(doc))
+        assert "probe-ok" in text and "pjrt" in text
+        assert "duration_s" in text
+
+
+class TestRequireJournalAcceptance:
+    def test_soak_with_injected_wedge_explains_every_change(
+            self, tfd_binary, tmp_path):
+        """The ISSUE 3 acceptance: soak --require-journal under an
+        injected probe wedge (fake_pjrt HANG_IF_FILE). The wedge
+        degrades labels (degraded=true + snapshot-age churn), recovery
+        restores them — and the soak passes BECAUSE every change pairs
+        with a journal diff event carrying provenance, every ladder
+        level was journaled with {from,to}, /debug/labels matches the
+        label file byte-for-byte, and RSS stays flat (bounded ring)."""
+        if not FAKE_PJRT.exists():
+            pytest.skip("fake PJRT plugin not built")
+        gate = tmp_path / "wedge"
+        port = free_port()
+        proc = subprocess.Popen(
+            [sys.executable, str(SOAK), "--binary", str(tfd_binary),
+             "--duration", "22", "--require-journal",
+             "--extra-arg=--backend=pjrt",
+             f"--extra-arg=--libtpu-path={FAKE_PJRT}",
+             "--extra-arg=--pjrt-init-timeout=1s",
+             "--extra-arg=--pjrt-retry-backoff=1s",
+             "--extra-arg=--pjrt-refresh-interval=2s",
+             f"--extra-arg=--introspection-addr=127.0.0.1:{port}"],
+            env={**os.environ, "GCE_METADATA_HOST": "127.0.0.1:1",
+                 "TFD_FAKE_PJRT_HANG_IF_FILE": str(gate),
+                 "TFD_FAKE_PJRT_KIND": "TPU v5 lite",
+                 "TFD_FAKE_PJRT_BOUNDS": "2,2,1"},
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        try:
+            def level():
+                return metrics.sample_value(
+                    http_get(port, "/metrics")[1] or "",
+                    "tfd_probe_degradation_level")
+
+            # Healthy start, then wedge until the ladder actually
+            # degrades (cached snapshot ages out of fresh), then lift
+            # the wedge and let it recover — all within the soak.
+            assert wait_for(lambda: level() == 0, timeout=30)
+            time.sleep(1)
+            gate.touch()
+            assert wait_for(lambda: level() == 1, timeout=15), \
+                "ladder never degraded under the wedge"
+            gate.unlink()
+            assert wait_for(lambda: level() == 0, timeout=15), \
+                "ladder never recovered"
+            out, err = proc.communicate(timeout=90)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate()
+        report = json.loads(out.splitlines()[-1])
+        assert proc.returncode == 0 and report["ok"] is True, report
+        assert report["journal_ok"] is True, report
+        # The wedge DID change labels (degraded markers came and went) —
+        # explained, not stable.
+        assert report["journal_label_changes"] >= 4, report
+        assert report["labels_stable"] is False, report
+        transitions = report["journal_degradations"]
+        assert ["0", "1"] in transitions and ["1", "0"] in transitions, \
+            report
+        # Bounded recorder: flat RSS across the eventful soak.
+        assert report["rss_drift_kb"] <= 1024, report
+        assert report["fd_end"] <= report["fd_start"], report

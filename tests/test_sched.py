@@ -13,37 +13,16 @@ import os
 import signal
 import subprocess
 import time
-import urllib.error
-import urllib.request
 
 import pytest
 
-from conftest import BUILD_DIR, FIXTURES, run_tfd, labels_of
+from conftest import (BUILD_DIR, FIXTURES, http_get, labels_of, run_tfd,
+                      wait_for)
 from tpufd import metrics
 from tpufd.fakes import free_loopback_port as free_port
 from tpufd.fakes.metadata_server import FakeMetadataServer, tpu_vm
 
 FAKE_PJRT = BUILD_DIR / "libtfd_fake_pjrt.so"
-
-
-def http_get(port, path, timeout=2):
-    try:
-        with urllib.request.urlopen(
-                f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
-            return r.status, r.read().decode()
-    except urllib.error.HTTPError as e:
-        return e.code, e.read().decode()
-    except (OSError, urllib.error.URLError):
-        return None, ""
-
-
-def wait_for(predicate, timeout=30, interval=0.05):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if predicate():
-            return True
-        time.sleep(interval)
-    return False
 
 
 def degradation_level(port):

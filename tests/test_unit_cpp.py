@@ -17,7 +17,8 @@ def test_cpp_unit_suite(unit_test_binary):
 
 
 @pytest.mark.parametrize("target",
-                         ["yamllite", "jsonlite", "http", "metrics"])
+                         ["yamllite", "jsonlite", "http", "metrics",
+                          "journal"])
 def test_fuzz_targets_smoke(unit_test_binary, target):
     """The fuzz targets (src/tfd/tests/fuzz/) must build and survive the
     seed corpus + a deterministic mutation sweep. Under gcc this runs the

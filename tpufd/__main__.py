@@ -6,9 +6,13 @@
   python -m tpufd burnin   — compile + run the sharded burn-in training
                              step over all visible devices (slice
                              acceptance test)
+  python -m tpufd journal  — fetch a daemon's /debug/journal (or read a
+                             SIGUSR1 dump file) and pretty-print the
+                             flight recorder
 
 The C++ daemon labels what a node *has*; these commands measure what it
-*does* — the slice-acceptance half of the framework.
+*does* — the slice-acceptance half of the framework — and read back WHY
+it is labeled the way it is (the flight-recorder half).
 """
 
 import argparse
@@ -76,6 +80,30 @@ def cmd_burnin(args):
     return 0 if ok else 1
 
 
+def cmd_journal(args):
+    import json
+    import urllib.request
+
+    from tpufd import journal as journal_lib
+
+    if args.file:
+        doc = json.load(open(args.file))
+        # A SIGUSR1 dump embeds the journal next to snapshots/labels.
+        if "journal" in doc:
+            doc = doc["journal"]
+    else:
+        url = (f"{args.url.rstrip('/')}/debug/journal"
+               f"?n={args.n}&type={args.type}")
+        with urllib.request.urlopen(url, timeout=5) as r:
+            doc = json.load(r)
+    doc = journal_lib.parse_journal(doc)
+    if args.raw:
+        print(json.dumps(doc, indent=2))
+    else:
+        print(journal_lib.dump_text(doc))
+    return 0
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(prog="python -m tpufd")
     sub = parser.add_subparsers(dest="command", required=True)
@@ -110,6 +138,23 @@ def main(argv=None):
         help="also write step/ring timing telemetry as a Prometheus "
              "textfile to this path")
     burnin.set_defaults(fn=cmd_burnin)
+
+    journal = sub.add_parser(
+        "journal", help="pretty-print a daemon's flight recorder")
+    journal.add_argument(
+        "--url", default="http://127.0.0.1:8081",
+        help="daemon introspection base URL (serves /debug/journal)")
+    journal.add_argument(
+        "--file", default="",
+        help="read a SIGUSR1 dump (or raw /debug/journal JSON) from a "
+             "file instead of fetching")
+    journal.add_argument("--n", type=int, default=0,
+                         help="newest N events (0 = all retained)")
+    journal.add_argument("--type", default="",
+                         help="filter by event type (e.g. label-diff)")
+    journal.add_argument("--raw", action="store_true",
+                         help="print the JSON instead of pretty text")
+    journal.set_defaults(fn=cmd_journal)
 
     args = parser.parse_args(argv)
     return args.fn(args)

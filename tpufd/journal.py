@@ -1,0 +1,132 @@
+"""Parse / dump / assert helpers for the daemon's flight recorder.
+
+The Python twin of ``src/tfd/obs/journal.h``: the daemon records probe
+lifecycle, snapshot tier transitions, degradation-ladder changes,
+per-rewrite spans, sink writes, reloads, and per-key label diffs into a
+bounded ring buffer, served as JSON on ``/debug/journal?n=&type=``
+(current labels + per-key provenance on ``/debug/labels``). This module
+gives the harnesses one vocabulary over that surface:
+
+  - :func:`parse_journal` / :func:`merge_events` — parse a dump and
+    accumulate events across scrapes (dedupe by the monotone ``seq``,
+    so a wrapped ring never loses what an earlier scrape saw);
+  - :func:`label_changes` / :func:`diffs_cover_changes` — the
+    explainability invariant ``scripts/soak.py --require-journal``
+    enforces: every observed label change has a matching ``label-diff``
+    event carrying provenance;
+  - :func:`degradation_transitions` — the ladder's journaled
+    ``{from,to}`` record, checked against scraped level changes;
+  - :func:`labels_file_text` — canonical ``key=value`` rendering of a
+    ``/debug/labels`` document, for the byte-for-byte comparison with
+    the emitted feature file;
+  - :func:`dump_text` — the ``python -m tpufd journal`` pretty-printer.
+"""
+
+import datetime
+import json
+
+# Fields every label-diff event must carry for the diff to count as
+# EXPLAINED (the provenance half of the invariant).
+PROVENANCE_FIELDS = ("labeler", "source", "tier")
+
+
+def parse_journal(text):
+    """Parses a /debug/journal (or SIGUSR1-dump ``journal``) document;
+    raises ValueError when the schema is off."""
+    doc = json.loads(text) if isinstance(text, (str, bytes)) else text
+    for key in ("capacity", "dropped_total", "generation", "events"):
+        if key not in doc:
+            raise ValueError(f"journal document missing {key!r}")
+    if len(doc["events"]) > doc["capacity"]:
+        raise ValueError("journal holds more events than its capacity "
+                         f"({len(doc['events'])} > {doc['capacity']}) — "
+                         "the ring is not bounded")
+    for event in doc["events"]:
+        for key in ("seq", "ts", "generation", "type", "fields"):
+            if key not in event:
+                raise ValueError(f"journal event missing {key!r}: {event}")
+    return doc
+
+
+def merge_events(accumulated, doc):
+    """Folds a parsed journal document into ``accumulated`` ({seq:
+    event}), deduplicating by seq — scraping periodically and merging
+    keeps a complete record even after the ring wraps."""
+    for event in doc["events"]:
+        accumulated[event["seq"]] = event
+    return accumulated
+
+
+def events_of_type(events, event_type):
+    """Events (a seq→event dict or an event list) of one type, seq
+    order."""
+    if isinstance(events, dict):
+        events = [events[seq] for seq in sorted(events)]
+    return [e for e in events if e["type"] == event_type]
+
+
+def label_changes(previous, current):
+    """[(key, old, new)] between two label dicts (old/None = added,
+    new/None = removed) — the observer-side mirror of lm::DiffLabels."""
+    out = []
+    for key in sorted(set(previous) | set(current)):
+        old, new = previous.get(key), current.get(key)
+        if old != new:
+            out.append((key, old, new))
+    return out
+
+
+def diffs_cover_changes(events, observed_changes):
+    """The explainability invariant: every observed (key, old, new)
+    change has a label-diff event for that key, and every label-diff
+    event carries full provenance. Returns (ok, problems)."""
+    problems = []
+    diffs = events_of_type(events, "label-diff")
+    keys_with_diffs = {e["fields"].get("key") for e in diffs}
+    for key, old, new in observed_changes:
+        if key not in keys_with_diffs:
+            problems.append(f"change {key}: {old!r} -> {new!r} has no "
+                            "label-diff event")
+    for event in diffs:
+        missing = [f for f in PROVENANCE_FIELDS
+                   if not event["fields"].get(f)]
+        if missing:
+            problems.append(f"label-diff for {event['fields'].get('key')} "
+                            f"lacks provenance fields {missing}")
+    return not problems, problems
+
+
+def degradation_transitions(events):
+    """[(from, to)] from the journal's degradation events, seq order."""
+    return [(e["fields"].get("from"), e["fields"].get("to"))
+            for e in events_of_type(events, "degradation")]
+
+
+def labels_file_text(debug_labels):
+    """Renders a /debug/labels document exactly as lm::FormatLabels
+    writes the feature file (sorted ``key=value`` lines) — the two must
+    agree byte-for-byte."""
+    doc = (json.loads(debug_labels)
+           if isinstance(debug_labels, (str, bytes)) else debug_labels)
+    labels = doc.get("labels", {})
+    return "".join(f"{k}={labels[k]}\n" for k in sorted(labels))
+
+
+def dump_text(doc):
+    """Human-readable rendering of a parsed journal document (oldest
+    first), one line per event plus its structured fields."""
+    lines = [f"journal: {len(doc['events'])} events, capacity "
+             f"{doc['capacity']}, dropped {doc['dropped_total']}, "
+             f"generation {doc['generation']}"]
+    for event in doc["events"]:
+        stamp = datetime.datetime.fromtimestamp(
+            event["ts"], tz=datetime.timezone.utc).strftime("%H:%M:%S.%f")
+        source = f" [{event['source']}]" if event.get("source") else ""
+        lines.append(f"  #{event['seq']} {stamp} g{event['generation']} "
+                     f"{event['type']}{source}: "
+                     f"{event.get('message', '')}")
+        extras = {k: v for k, v in event["fields"].items() if v != ""}
+        if extras:
+            lines.append("      " + " ".join(
+                f"{k}={v!r}" for k, v in sorted(extras.items())))
+    return "\n".join(lines)

@@ -24,11 +24,14 @@
 #include <cstdio>
 #include <cstdlib>
 #include <memory>
+#include <optional>
 #include <vector>
 
 #include "tfd/config/config.h"
+#include "tfd/fault/fault.h"
 #include "tfd/gce/metadata.h"
 #include "tfd/info/version.h"
+#include "tfd/k8s/breaker.h"
 #include "tfd/k8s/client.h"
 #include "tfd/lm/labeler.h"
 #include "tfd/lm/labels.h"
@@ -46,6 +49,7 @@
 #include "tfd/sched/broker.h"
 #include "tfd/sched/snapshot.h"
 #include "tfd/sched/sources.h"
+#include "tfd/sched/state.h"
 #include "tfd/util/file.h"
 #include "tfd/util/jsonlite.h"
 #include "tfd/util/logging.h"
@@ -151,6 +155,15 @@ struct LabelState {
   lm::Labels labels;
   lm::Provenance provenance;
   int last_level = -1;  // degradation rung of the previous pass
+  // Warm-restart cache (sched/state.h): the restored persisted state,
+  // served as a rung between "fallback source" and "minimal" — any pass
+  // where NO snapshot can serve (probes wedged/failing after a restart)
+  // re-serves these cached facts instead of downgrading to minimal,
+  // until a real snapshot serves or the usable window closes.
+  std::optional<sched::PersistedState> restored;
+  double restored_loaded_at_wall = 0;  // when LoadState accepted it
+  double restored_until_wall = 0;      // when its usable window closes
+  double restored_downtime_s = 0;      // crash-to-restart gap at load
 };
 
 ServeDecision Decide(const sched::SnapshotStore& store,
@@ -232,6 +245,71 @@ ServeDecision Decide(const sched::SnapshotStore& store,
   return decision;
 }
 
+// Sink dispatch (reference labels.go:49-56) with the hardening layers:
+// the NodeFeature CR path goes through the circuit breaker (an open
+// circuit skips the write instantly instead of burning the retry
+// budget against a dead apiserver) and carries the per-request deadline
+// budget; BOTH sinks classify failures, and transient ones in daemon
+// mode are survived (log + retry next interval) rather than exiting —
+// a full disk or an apiserver rollout must not crash-loop the labeler.
+// `*wrote_ok` reports whether labels actually landed.
+Status DispatchSink(const config::Config& config, const lm::Labels& labels,
+                    k8s::CircuitBreaker* breaker, bool* wrote_ok) {
+  Status out;
+  bool transient = false;
+  if (config.flags.use_node_feature_api) {
+    // Breaker first: an open circuit skips before ANY per-pass work —
+    // no serviceaccount file reads, no config build — so the skip is
+    // genuinely instant.
+    if (breaker != nullptr && !breaker->Allow()) {
+      obs::DefaultJournal().Record(
+          "sink-write", "cr",
+          "NodeFeature CR write skipped: circuit breaker open",
+          {{"action", "breaker-skip"}, {"ok", "false"},
+           {"error", "circuit breaker open"}});
+      TFD_LOG_ERROR << "NodeFeature sink circuit breaker open; skipping "
+                       "write (will retry after cooldown)";
+      return Status::Ok();  // recorded as a failed rewrite by the caller
+    }
+    Result<k8s::ClusterConfig> cluster = k8s::LoadInClusterConfig();
+    if (!cluster.ok()) {
+      // Allow() may have admitted the half-open probe: this failure
+      // must reach the breaker before the error propagates (and fails
+      // the pass), or the probe slot would leak and every future write
+      // would be skipped forever.
+      if (breaker != nullptr) breaker->RecordTransientFailure();
+      return cluster.status();
+    }
+    cluster->request_deadline_ms =
+        config.flags.sink_request_deadline_s * 1000;
+    out = k8s::UpdateNodeFeature(*cluster, labels, &transient);
+    if (breaker != nullptr) {
+      if (out.ok()) {
+        breaker->RecordSuccess();
+      } else if (transient) {
+        breaker->RecordTransientFailure();
+      } else {
+        // Must be reported too: a permanent failure during a half-open
+        // probe would otherwise leave the probe slot occupied forever.
+        breaker->RecordPermanentFailure();
+      }
+    }
+  } else {
+    out = lm::OutputToFile(labels, config.flags.output_file, &transient);
+  }
+  if (!out.ok() && transient && !config.flags.oneshot) {
+    // Apiserver hiccups, full disks, exhausted conflict retries: keep
+    // the daemon alive and retry at the next interval. Permanent
+    // failures (missing RBAC, bad schema, read-only mount) still exit
+    // so the pod crash-loops visibly.
+    TFD_LOG_ERROR << out.message() << " (will retry next interval)";
+    return Status::Ok();
+  }
+  if (!out.ok()) return out;
+  *wrote_ok = true;
+  return Status::Ok();
+}
+
 // One labeling pass: render labelers against the decided snapshot,
 // merge, write. `*wrote_ok` reports whether labels actually landed in
 // the sink — false on every error path, including the transient
@@ -243,8 +321,8 @@ Status LabelOnceInner(
     const config::Config& config, lm::Labeler& timestamp,
     lm::Labeler& machine_type, lm::Labeler& tpu_vm,
     const sched::SnapshotStore& store, const ServeDecision& decision,
-    size_t* labels_emitted, bool* wrote_ok, lm::Labels* merged_out,
-    lm::Provenance* provenance_out,
+    k8s::CircuitBreaker* breaker, size_t* labels_emitted, bool* wrote_ok,
+    lm::Labels* merged_out, lm::Provenance* provenance_out,
     std::vector<std::pair<std::string, std::string>>* span_fields) {
   if (decision.fatal) {
     return Status::Error(decision.fatal_error.empty()
@@ -339,29 +417,13 @@ Status LabelOnceInner(
                     << " label(s) generated; is this a TPU node?";
   }
 
-  // Output dispatch (reference labels.go:49-56): NodeFeature CR when the
-  // NodeFeature API is enabled, else the feature file / stdout.
-  Status out;
-  if (config.flags.use_node_feature_api) {
-    Result<k8s::ClusterConfig> cluster = k8s::LoadInClusterConfig();
-    if (!cluster.ok()) return cluster.status();
-    bool transient = false;
-    out = k8s::UpdateNodeFeature(*cluster, merged, &transient);
-    if (!out.ok() && transient && !config.flags.oneshot) {
-      // Apiserver hiccups (rolling restarts, timeouts, exhausted conflict
-      // retries): keep the daemon alive and retry at the next interval.
-      // Permanent failures (missing RBAC, bad schema) still exit so the
-      // pod crash-loops visibly.
-      TFD_LOG_ERROR << out.message() << " (will retry next interval)";
-      return Status::Ok();  // skips the success log below
-    }
-  } else {
-    out = lm::OutputToFile(merged, config.flags.output_file);
-  }
+  // Output dispatch: NodeFeature CR (behind the circuit breaker) when
+  // the NodeFeature API is enabled, else the feature file / stdout.
+  Status out = DispatchSink(config, merged, breaker, wrote_ok);
   if (!out.ok()) return out;
+  if (!*wrote_ok) return Status::Ok();  // survived transient sink failure
 
   *labels_emitted = merged.size();
-  *wrote_ok = true;
   *merged_out = std::move(merged);
   *provenance_out = std::move(provenance);
   return Status::Ok();
@@ -438,10 +500,64 @@ void RecordLabelDiff(const lm::Labels& merged,
   state->provenance = provenance;
 }
 
+// Degradation-ladder bookkeeping shared by normal and restored passes:
+// the serving-rung gauge plus — on a rung change — the {from,to}
+// transition counter, the journal record, and the last_level update.
+void RecordLadderLevel(int level, const std::string& source,
+                       const std::string& tier, const std::string& via,
+                       LabelState* state) {
+  obs::Registry& reg = obs::Default();
+  reg.GetGauge("tfd_probe_degradation_level",
+               "Serving rung of the degradation ladder: 0 full, 1 cached "
+               "(stale device snapshot), 2 fallback source, 3 "
+               "expired/minimal.")
+      ->Set(level);
+  if (state->last_level == level) return;
+  std::string from =
+      state->last_level < 0 ? "none" : std::to_string(state->last_level);
+  std::string to = std::to_string(level);
+  reg.GetCounter("tfd_degradation_transitions_total",
+                 "Degradation-ladder rung changes between rewrites.",
+                 {{"from", from}, {"to", to}})
+      ->Inc();
+  obs::DefaultJournal().Record(
+      "degradation", source, "degradation level " + from + " -> " + to + via,
+      {{"from", from}, {"to", to}, {"source", source}, {"tier", tier}});
+  state->last_level = level;
+}
+
+// Persists what this pass just published so a crashed-and-restarted
+// daemon can warm-serve it (sched/state.h). A failed save is a warning,
+// never a failed rewrite: the labels DID land in the sink.
+void SaveStateAfterRewrite(const config::Config& config,
+                           const ServeDecision& decision,
+                           const lm::Labels& labels,
+                           const lm::Provenance& provenance) {
+  sched::PersistedState state;
+  state.node = sched::NodeIdentity();
+  state.saved_at = WallClockSeconds();
+  state.source = decision.source;
+  state.tier = decision.tier;
+  state.level = decision.level;
+  state.age_s = decision.age_s < 0 ? 0 : decision.age_s;
+  state.labels = labels;
+  state.provenance = provenance;
+  Status s = sched::SaveState(config.flags.state_file, state);
+  if (!s.ok()) {
+    TFD_LOG_WARNING << "state save failed (warm restart unavailable): "
+                    << s.message();
+    obs::DefaultJournal().Record("state-save-failed", decision.source,
+                                 "state save failed: " + s.message(),
+                                 {{"path", config.flags.state_file},
+                                  {"error", s.message()}});
+  }
+}
+
 Status LabelOnce(const config::Config& config, lm::Labeler& timestamp,
                  lm::Labeler& machine_type, lm::Labeler& tpu_vm,
                  const sched::SnapshotStore& store,
-                 obs::IntrospectionServer* server, LabelState* state) {
+                 obs::IntrospectionServer* server,
+                 k8s::CircuitBreaker* breaker, LabelState* state) {
   auto t0 = std::chrono::steady_clock::now();
   uint64_t generation = obs::DefaultJournal().BeginRewrite();
   ServeDecision decision = Decide(store, config.flags);
@@ -458,32 +574,13 @@ Status LabelOnce(const config::Config& config, lm::Labeler& timestamp,
           ->Set(view.age_s);
     }
   }
-  reg.GetGauge("tfd_probe_degradation_level",
-               "Serving rung of the degradation ladder: 0 full, 1 cached "
-               "(stale device snapshot), 2 fallback source, 3 "
-               "expired/minimal.")
-      ->Set(decision.level);
   if (server != nullptr) server->SetAllExpired(decision.all_expired);
 
   // Degradation-ladder transitions: the flight recorder's {from,to}
   // record (and metric), including the first pass's none→<level>.
-  if (decision.level != state->last_level) {
-    std::string from = state->last_level < 0
-                           ? "none"
-                           : std::to_string(state->last_level);
-    std::string to = std::to_string(decision.level);
-    reg.GetCounter("tfd_degradation_transitions_total",
-                   "Degradation-ladder rung changes between rewrites.",
-                   {{"from", from}, {"to", to}})
-        ->Inc();
-    obs::DefaultJournal().Record(
-        "degradation", decision.source,
-        "degradation level " + from + " -> " + to +
-            (decision.source.empty() ? "" : " serving " + decision.source),
-        {{"from", from}, {"to", to}, {"source", decision.source},
-         {"tier", decision.tier}});
-    state->last_level = decision.level;
-  }
+  RecordLadderLevel(
+      decision.level, decision.source, decision.tier,
+      decision.source.empty() ? "" : " serving " + decision.source, state);
 
   size_t labels_emitted = 0;
   bool wrote_ok = false;
@@ -491,14 +588,29 @@ Status LabelOnce(const config::Config& config, lm::Labeler& timestamp,
   lm::Provenance provenance;
   std::vector<std::pair<std::string, std::string>> span_fields;
   Status s = LabelOnceInner(config, timestamp, machine_type, tpu_vm, store,
-                            decision, &labels_emitted, &wrote_ok, &merged,
-                            &provenance, &span_fields);
+                            decision, breaker, &labels_emitted, &wrote_ok,
+                            &merged, &provenance, &span_fields);
   double seconds = obs::SecondsSince(t0);
   RecordRewriteOutcome(wrote_ok, labels_emitted, seconds, server);
   if (wrote_ok) {
     RecordLabelDiff(merged, provenance, state);
     if (server != nullptr) {
       server->SetLabelsJson(LabelsDebugJson(generation, merged, provenance));
+    }
+    // Persist only passes that served REAL device facts: a minimal
+    // (never-probed) pass carries nothing worth warm-restoring, and
+    // saving it (age -1 clamped to 0) would let a restart republish a
+    // not-ready minimal label set as a "cached" ready rung.
+    if (!config.flags.oneshot && !config.flags.state_file.empty() &&
+        decision.manager != nullptr) {
+      SaveStateAfterRewrite(config, decision, merged, provenance);
+    }
+    // Real facts now serve: the restored warm-restart cache is obsolete.
+    if (decision.manager != nullptr && state->restored.has_value()) {
+      obs::DefaultJournal().Record(
+          "state-superseded", decision.source,
+          "live snapshot now serving; restored state dropped");
+      state->restored.reset();
     }
   }
   // The per-rewrite span: outcome + serving decision + labeler timings,
@@ -590,8 +702,94 @@ void WriteDebugDump(const config::Config& config,
   }
 }
 
+// Serves the restored persisted state as one full rewrite pass:
+// cached-tier labels with the TRUE snapshot age (`age_s`, persisted age
+// + downtime so far). Used twice: as the warm-restart FIRST pass (in
+// milliseconds, before any probe has run — event "warm-restart"), and
+// as the restored rung on later passes while probes are still wedged
+// and nothing else can serve (event "restored-serve") — without it the
+// pass after the warm one would DOWNGRADE a restarted wedged node to
+// minimal labels, throwing the restored facts away. Returns the sink
+// status: Ok for written or survived-transient, an error only for
+// PERMANENT sink failures (misconfiguration that must crash-loop
+// visibly — the Run loop fails the pass like a normal one; the
+// warm-restart call at startup tolerates it, since the first normal
+// pass will surface it again).
+Status ServeRestored(const config::Config& config,
+                     const sched::PersistedState& restored, double age_s,
+                     double downtime_s, const char* event_type,
+                     obs::IntrospectionServer* server,
+                     k8s::CircuitBreaker* breaker, LabelState* state) {
+  auto t0 = std::chrono::steady_clock::now();
+  uint64_t generation = obs::DefaultJournal().BeginRewrite();
+  lm::Labels labels = restored.labels;
+  lm::Provenance provenance;
+  // Everything served from disk is cached by definition: per-key
+  // provenance keeps the saved labeler/source but reports the
+  // stale-usable tier and the downtime-corrected age.
+  double key_age_bump = age_s - restored.age_s;  // time since the load
+  for (const auto& [key, saved_from] : restored.provenance) {
+    lm::LabelProvenance from = saved_from;
+    from.tier = "stale-usable";
+    from.age_s += downtime_s + key_age_bump;
+    provenance[key] = from;
+  }
+  labels[lm::kDegraded] = "true";
+  labels[lm::kSnapshotAge] = std::to_string(static_cast<long long>(age_s));
+  lm::LabelProvenance marker;
+  marker.labeler = "warm-restart";
+  marker.source = restored.source;
+  marker.tier = "stale-usable";
+  marker.age_s = age_s;
+  provenance[lm::kDegraded] = marker;
+  provenance[lm::kSnapshotAge] = marker;
+
+  bool wrote_ok = false;
+  Status s = DispatchSink(config, labels, breaker, &wrote_ok);
+  double seconds = obs::SecondsSince(t0);
+  RecordRewriteOutcome(wrote_ok, labels.size(), seconds, server);
+
+  // Ladder bookkeeping: a restored pass serves the cached rung
+  // (level 1), with the same transition record a normal pass makes.
+  if (server != nullptr) server->SetAllExpired(false);
+  RecordLadderLevel(1, restored.source, "stale-usable",
+                    " serving restored state", state);
+  if (wrote_ok) {
+    RecordLabelDiff(labels, provenance, state);
+    if (server != nullptr) {
+      server->SetLabelsJson(LabelsDebugJson(generation, labels, provenance));
+    }
+  }
+  auto ms = static_cast<long long>(seconds * 1000);
+  obs::DefaultJournal().Record(
+      event_type, restored.source,
+      std::string(wrote_ok ? "served" : "failed to serve") + " " +
+          std::to_string(labels.size()) +
+          " restored labels (snapshot age " +
+          std::to_string(static_cast<long long>(age_s)) + "s, down " +
+          std::to_string(static_cast<long long>(downtime_s)) + "s)",
+      {{"ok", wrote_ok ? "true" : "false"},
+       {"duration_ms", std::to_string(ms)},
+       {"labels", std::to_string(labels.size())},
+       {"source", restored.source},
+       {"saved_tier", restored.tier},
+       {"restored_age_s", std::to_string(static_cast<long long>(age_s))},
+       {"downtime_s", std::to_string(static_cast<long long>(downtime_s))}});
+  if (wrote_ok) {
+    TFD_LOG_INFO << event_type << ": served " << labels.size()
+                 << " restored labels in " << ms << "ms (snapshot age "
+                 << static_cast<long long>(age_s) << "s, down "
+                 << static_cast<long long>(downtime_s)
+                 << "s); probes run cold in the background";
+  } else if (!s.ok()) {
+    TFD_LOG_WARNING << event_type << " pass failed: " << s.message();
+  }
+  return s;
+}
+
 RunOutcome Run(const config::Config& config, const sigset_t& sigmask,
-               obs::IntrospectionServer* server, LabelState* state) {
+               obs::IntrospectionServer* server,
+               k8s::CircuitBreaker* breaker, LabelState* state) {
   lm::LabelerPtr timestamp = lm::NewTimestampLabeler(config);
   lm::LabelerPtr machine_type = lm::NewMachineTypeLabeler(
       config.flags.machine_type_file, MakeMachineTypeGetter(config));
@@ -619,8 +817,39 @@ RunOutcome Run(const config::Config& config, const sigset_t& sigmask,
   bool cleanup_output = !config.flags.oneshot &&
                         !config.flags.output_file.empty();
   while (true) {
-    Status s = LabelOnce(config, *timestamp, *machine_type, *tpu_vm, *store,
-                         server, state);
+    // The restored rung: while probes are still wedged/failing after a
+    // warm restart and NO snapshot can serve, keep re-serving the
+    // restored cached facts (with their growing age) instead of
+    // downgrading to minimal — until a real snapshot serves or the
+    // restored window closes.
+    Status s;
+    bool served_restored = false;
+    if (!config.flags.oneshot && state->restored.has_value()) {
+      double now_wall = WallClockSeconds();
+      if (now_wall >= state->restored_until_wall) {
+        obs::DefaultJournal().Record(
+            "state-expired", state->restored->source,
+            "restored state aged out of the usable window; dropping it");
+        state->restored.reset();
+      } else {
+        ServeDecision decision = Decide(*store, config.flags);
+        if (!decision.fatal && decision.manager == nullptr) {
+          double age_s = state->restored->age_s +
+                         (now_wall - state->restored_loaded_at_wall);
+          // A permanent sink error (EACCES, RBAC) fails this pass like
+          // any other — the restored rung must not keep a misconfigured
+          // pod alive-and-warning for the whole restored window.
+          s = ServeRestored(config, *state->restored, age_s,
+                            state->restored_downtime_s, "restored-serve",
+                            server, breaker, state);
+          served_restored = true;
+        }
+      }
+    }
+    if (!served_restored) {
+      s = LabelOnce(config, *timestamp, *machine_type, *tpu_vm, *store,
+                    server, breaker, state);
+    }
     if (!s.ok()) {
       TFD_LOG_ERROR << s.message();
       return RunOutcome::kError;
@@ -723,44 +952,92 @@ int Main(int argc, char** argv) {
   if (early_format == "klog") log::SetFormat(log::Format::kKlog);
 
   // start() loop: reload config and re-run on SIGHUP
-  // (reference main.go:125-153). The label state lives ABOVE the loop:
-  // the flight recorder must explain the first post-reload rewrite as a
-  // diff against what the node actually carried.
+  // (reference main.go:125-153). The label state, the sink circuit
+  // breaker, and the warm-restart marker live ABOVE the loop: the
+  // flight recorder must explain the first post-reload rewrite as a
+  // diff against what the node actually carried, the breaker's view of
+  // the apiserver's health is not changed by our config, and a restored
+  // state is served exactly once per process.
   LabelState label_state;
+  k8s::CircuitBreaker sink_breaker;
+  bool warm_restart_done = false;
+  config::LoadResult last_good;
+  std::string armed_fault_spec;
   int config_generation = 0;
   while (true) {
-    Result<config::LoadResult> loaded = config::Load(argc, argv);
-    if (!loaded.ok()) {
-      TFD_LOG_ERROR << loaded.error();
-      fprintf(stderr, "%s", config::UsageText().c_str());
-      return 1;
+    Result<config::LoadResult> loaded_result = config::Load(argc, argv);
+    config::LoadResult loaded;
+    if (!loaded_result.ok()) {
+      if (config_generation == 0) {
+        TFD_LOG_ERROR << loaded_result.error();
+        fprintf(stderr, "%s", config::UsageText().c_str());
+        return 1;
+      }
+      // A RELOAD that fails (config file replaced with garbage, env
+      // mutated under us, injected config.load fault) must not kill a
+      // daemon that was serving fine: keep the previous configuration
+      // running and say so loudly.
+      TFD_LOG_ERROR << "config reload failed: " << loaded_result.error()
+                    << "; keeping the previous configuration";
+      obs::DefaultJournal().Record(
+          "config-load-failed", "",
+          "config reload failed; previous configuration kept",
+          {{"error", loaded_result.error()}});
+      loaded = last_good;
+    } else {
+      loaded = *loaded_result;
     }
-    if (loaded->help_requested) {
+    if (loaded.help_requested) {
       printf("%s", config::UsageText().c_str());
       return 0;
     }
-    if (loaded->version_requested) {
+    if (loaded.version_requested) {
       printf("tpu-feature-discovery %s\n", info::VersionString().c_str());
       return 0;
     }
-    log::SetFormat(loaded->config.flags.log_format == "json"
+    last_good = loaded;
+    log::SetFormat(loaded.config.flags.log_format == "json"
                        ? log::Format::kJson
                        : log::Format::kKlog);
     obs::DefaultJournal().SetCapacity(
-        static_cast<size_t>(loaded->config.flags.journal_capacity));
+        static_cast<size_t>(loaded.config.flags.journal_capacity));
+    // Fault injection arms on first load and re-arms only when the
+    // SPEC changes; a reload with the same spec keeps the live rule
+    // state (consumed counts, RNG position) — else a count=1
+    // config.load drill would reset itself on the very reload it
+    // failed and fire forever. config::Load validated the grammar.
+    if (config_generation == 0 ||
+        loaded.config.flags.fault_spec != armed_fault_spec) {
+      if (Status armed = fault::Arm(loaded.config.flags.fault_spec);
+          !armed.ok()) {
+        TFD_LOG_ERROR << "fault-spec: " << armed.message();
+        return 1;
+      }
+      armed_fault_spec = loaded.config.flags.fault_spec;
+    }
+    sink_breaker.Configure(
+        {loaded.config.flags.sink_breaker_failures,
+         static_cast<double>(loaded.config.flags.sink_breaker_cooldown_s)});
     TFD_LOG_INFO << "tpu-feature-discovery " << info::VersionString();
-    TFD_LOG_INFO << "running with config: " << config::ToJson(loaded->config);
+    TFD_LOG_INFO << "running with config: " << config::ToJson(loaded.config);
 
-    config_generation++;
-    obs::DefaultJournal().Record(
-        "config-load", "", "configuration loaded",
-        {{"config_generation", std::to_string(config_generation)},
-         {"log_format", loaded->config.flags.log_format}});
-    obs::Default()
-        .GetGauge("tfd_config_generation",
-                  "Config loads this process has performed (bumps on "
-                  "SIGHUP reload).")
-        ->Set(config_generation);
+    // Generation bookkeeping only for loads that APPLIED: a failed
+    // reload already journaled config-load-failed, and bumping the
+    // generation (or claiming "configuration loaded") for a config
+    // that never took effect would lie to anyone watching
+    // tfd_config_generation to confirm a rollout.
+    if (loaded_result.ok()) {
+      config_generation++;
+      obs::DefaultJournal().Record(
+          "config-load", "", "configuration loaded",
+          {{"config_generation", std::to_string(config_generation)},
+           {"log_format", loaded.config.flags.log_format}});
+      obs::Default()
+          .GetGauge("tfd_config_generation",
+                    "Config loads this process has performed (bumps on "
+                    "SIGHUP reload).")
+          ->Set(config_generation);
+    }
     obs::Default()
         .GetGauge("tfd_build_info",
                   "Always 1; version and commit ride as labels.",
@@ -773,7 +1050,7 @@ int Main(int argc, char** argv) {
     // --introspection-addr rebinds; a bind failure is fatal — a DaemonSet
     // with liveness probes must crash visibly, not run unprobeable.
     std::unique_ptr<obs::IntrospectionServer> server;
-    const config::Flags& flags = loaded->config.flags;
+    const config::Flags& flags = loaded.config.flags;
     if (!flags.oneshot && !flags.introspection_addr.empty()) {
       obs::ServerOptions options;
       options.addr = flags.introspection_addr;
@@ -806,7 +1083,57 @@ int Main(int argc, char** argv) {
                    << server->port() << ")";
     }
 
-    switch (Run(loaded->config, sigmask, server.get(), &label_state)) {
+    // Crash-safe warm restart, once per process: a valid persisted
+    // state (checksummed, this node's, within the usable window) is
+    // served immediately — cached-tier labels with true snapshot ages —
+    // while the probe round below starts from zero. Every rejection is
+    // journaled and counted; a missing file is just a first boot.
+    if (!warm_restart_done && !flags.oneshot && !flags.state_file.empty()) {
+      warm_restart_done = true;
+      double max_age_s = flags.snapshot_usable_for_s > 0
+                             ? flags.snapshot_usable_for_s
+                             : 10.0 * flags.sleep_interval_s;
+      Result<sched::PersistedState> restored = sched::LoadState(
+          flags.state_file, sched::NodeIdentity(), max_age_s,
+          WallClockSeconds());
+      if (restored.ok()) {
+        double now_wall = WallClockSeconds();
+        double downtime_s = now_wall - restored->saved_at;
+        if (downtime_s < 0) downtime_s = 0;
+        obs::Default()
+            .GetCounter("tfd_state_restores_total",
+                        "Warm-restart state-file loads, by outcome.",
+                        {{"outcome", "restored"}})
+            ->Inc();
+        // Keep the restored facts around as a serving rung: later
+        // passes re-serve them while probes are still wedged, until a
+        // real snapshot lands or the usable window closes.
+        label_state.restored = *restored;
+        label_state.restored_loaded_at_wall = now_wall;
+        label_state.restored_until_wall =
+            now_wall + (max_age_s - restored->age_s);
+        label_state.restored_downtime_s = downtime_s;
+        ServeRestored(loaded.config, *restored, restored->age_s,
+                      downtime_s, "warm-restart", server.get(),
+                      &sink_breaker, &label_state);
+      } else if (FileExists(flags.state_file)) {
+        obs::Default()
+            .GetCounter("tfd_state_restores_total",
+                        "Warm-restart state-file loads, by outcome.",
+                        {{"outcome", "rejected"}})
+            ->Inc();
+        obs::DefaultJournal().Record(
+            "state-rejected", "",
+            "state file rejected; starting cold: " + restored.error(),
+            {{"path", flags.state_file}, {"error", restored.error()}});
+        TFD_LOG_WARNING << "state file " << flags.state_file
+                        << " rejected (" << restored.error()
+                        << "); starting cold";
+      }
+    }
+
+    switch (Run(loaded.config, sigmask, server.get(), &sink_breaker,
+                &label_state)) {
       case RunOutcome::kExit:
         TFD_LOG_INFO << "exiting";
         return 0;

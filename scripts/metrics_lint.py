@@ -77,6 +77,14 @@ CONDITIONAL = {
     # Registered by the broker's backoff bookkeeping only once a worker
     # completes its first probe round — racy at scrape time.
     "tfd_probe_backoff_seconds",
+    # Robustness layer (ISSUE 4): each family exists only on its path.
+    # Warm restart: needs --state-file (and a restore attempt).
+    "tfd_state_restores_total",
+    # CR sink circuit breaker: needs --use-node-feature-api.
+    "tfd_sink_breaker_state",
+    "tfd_sink_breaker_transitions_total",
+    # Fault injection: needs an armed --fault-spec (test runs only).
+    "tfd_faults_injected_total",
 }
 
 

@@ -5,6 +5,7 @@
 #include <sstream>
 
 #include "tfd/config/yamllite.h"
+#include "tfd/fault/fault.h"
 #include "tfd/obs/server.h"
 #include "tfd/util/file.h"
 #include "tfd/util/logging.h"
@@ -319,6 +320,66 @@ std::vector<FlagDef> MakeFlagDefs(Flags* f) {
                   false,
                   [f](const std::string& v) {
                     return SetString(&f->debug_dump_file, v);
+                  }});
+  defs.push_back({"state-file",
+                  {"TFD_STATE_FILE"},
+                  "stateFile",
+                  "crash-safe warm restart: persist the published labels "
+                  "+ provenance here after every rewrite (checksummed, "
+                  "node-gated) and serve them as an immediate cached-tier "
+                  "first pass on boot; '' disables. Use pod-lifetime "
+                  "storage (emptyDir), never hostPath",
+                  false,
+                  [f](const std::string& v) {
+                    return SetString(&f->state_file, v);
+                  }});
+  defs.push_back({"sink-breaker-failures",
+                  {"TFD_SINK_BREAKER_FAILURES"},
+                  "sinkBreakerFailures",
+                  "consecutive transient NodeFeature CR write failures "
+                  "before the sink circuit breaker opens (writes then "
+                  "skip instantly until a half-open probe succeeds)",
+                  false,
+                  [f](const std::string& v) {
+                    int parsed = 0;
+                    if (!ParseNonNegInt(TrimSpace(v), &parsed) ||
+                        parsed < 1) {
+                      return Status::Error("sink-breaker-failures must be "
+                                           "a positive integer");
+                    }
+                    f->sink_breaker_failures = parsed;
+                    return Status::Ok();
+                  }});
+  defs.push_back({"sink-breaker-cooldown",
+                  {"TFD_SINK_BREAKER_COOLDOWN"},
+                  "sinkBreakerCooldown",
+                  "how long the open sink breaker waits before letting "
+                  "one half-open probe write through (e.g. 30s)",
+                  false,
+                  [f](const std::string& v) {
+                    return SetDuration(&f->sink_breaker_cooldown_s, v);
+                  }});
+  defs.push_back({"sink-request-deadline",
+                  {"TFD_SINK_REQUEST_DEADLINE"},
+                  "sinkRequestDeadline",
+                  "total wall-clock budget for one apiserver HTTP request "
+                  "(bounds the sum of socket-op stalls so a dribbling "
+                  "apiserver cannot stretch a sink write past the rewrite "
+                  "cadence; e.g. 10s, 0 = no budget)",
+                  false,
+                  [f](const std::string& v) {
+                    return SetDuration(&f->sink_request_deadline_s, v);
+                  }});
+  defs.push_back({"fault-spec",
+                  {"TFD_FAULT_SPEC"},
+                  "faultSpec",
+                  "TEST-ONLY fault injection spec, e.g. "
+                  "'sink.file:errno=ENOSPC:rate=0.3,k8s.put:http=500:"
+                  "count=3' (see README failure-modes runbook); an armed "
+                  "daemon fails on purpose — never set in production",
+                  false,
+                  [f](const std::string& v) {
+                    return SetString(&f->fault_spec, v);
                   }});
   return defs;
 }
@@ -660,6 +721,29 @@ Result<LoadResult> Load(int argc, char** argv) {
     return Result<LoadResult>::Error("invalid log-format '" +
                                      f->log_format + "' (want klog|json)");
   }
+  if (f->sink_breaker_cooldown_s < 1) {
+    return Result<LoadResult>::Error("sink-breaker-cooldown must be >= 1s");
+  }
+  if (f->sink_request_deadline_s < 0) {
+    return Result<LoadResult>::Error("sink-request-deadline must be >= 0s");
+  }
+  if (!f->fault_spec.empty()) {
+    Status s = fault::Validate(f->fault_spec);
+    if (!s.ok()) {
+      return Result<LoadResult>::Error("fault-spec: " + s.message());
+    }
+  }
+  // Injection point for reload hardening: with "config.load" armed, the
+  // next (SIGHUP) reload fails here — the daemon must survive it by
+  // keeping the previous config running. A hang has already slept
+  // inside Check (the delay IS the fault) and the load then proceeds.
+  if (fault::Action injected = fault::Check("config.load")) {
+    if (injected.kind == fault::Action::Kind::kFail ||
+        injected.kind == fault::Action::Kind::kErrno) {
+      return Result<LoadResult>::Error("config load failed: " +
+                                       injected.message);
+    }
+  }
   return out;
 }
 
@@ -706,6 +790,11 @@ std::string ToJson(const Config& config) {
       << ",\"logFormat\":" << jstr(f.log_format)
       << ",\"journalCapacity\":" << f.journal_capacity
       << ",\"debugDumpFile\":" << jstr(f.debug_dump_file)
+      << ",\"stateFile\":" << jstr(f.state_file)
+      << ",\"sinkBreakerFailures\":" << f.sink_breaker_failures
+      << ",\"sinkBreakerCooldown\":\"" << f.sink_breaker_cooldown_s << "s\""
+      << ",\"sinkRequestDeadline\":\"" << f.sink_request_deadline_s << "s\""
+      << ",\"faultSpec\":" << jstr(f.fault_spec)
       << "},\"sharing\":[";
   for (size_t i = 0; i < config.sharing.time_slicing.size(); i++) {
     const SharedResource& r = config.sharing.time_slicing[i];

@@ -139,6 +139,31 @@ struct Flags {
   // SIGUSR1 post-mortem dump target: journal + per-source snapshot
   // state + current labels/provenance, written atomically.
   std::string debug_dump_file = "/tmp/tpu-feature-discovery-debug.json";
+  // Crash-safe warm restart (sched/state.h): after every successful
+  // rewrite the published labels + provenance + serving decision are
+  // persisted here (checksummed, schema- and node-gated); on boot a
+  // valid, unexpired state file is served as an immediate cached-tier
+  // first pass (degraded + true snapshot-age labels) while the probe
+  // round runs. Empty disables. Point it at pod-lifetime storage
+  // (emptyDir) — hostPath would replay labels across pod identities.
+  std::string state_file;
+  // NodeFeature CR sink circuit breaker (k8s/breaker.h): consecutive
+  // TRANSIENT write failures before the circuit opens and writes are
+  // skipped instantly (still recorded as failed rewrites)...
+  int sink_breaker_failures = 3;
+  // ...and how long the circuit stays open before one half-open probe
+  // write is let through.
+  int sink_breaker_cooldown_s = 30;
+  // Total wall-clock budget for ONE apiserver HTTP request (connect +
+  // TLS + send + receive). The per-socket-op timeout bounds each stall;
+  // this bounds their sum, so a dribbling apiserver cannot stretch a
+  // sink write past the rewrite cadence. 0 disables.
+  int sink_request_deadline_s = 10;
+  // Fault injection (fault/fault.h): named-point spec, e.g.
+  // "sink.file:errno=ENOSPC:rate=0.3,k8s.put:http=500:count=3".
+  // TEST-ONLY — an armed daemon fails on purpose; empty (default)
+  // keeps every injection point a single relaxed atomic load.
+  std::string fault_spec;
 };
 
 struct Config {

@@ -1,0 +1,347 @@
+#include "tfd/fault/fault.h"
+
+#include <errno.h>
+#include <string.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdlib>
+#include <mutex>
+#include <random>
+#include <thread>
+#include <vector>
+
+#include "tfd/obs/journal.h"
+#include "tfd/obs/metrics.h"
+#include "tfd/util/logging.h"
+#include "tfd/util/strings.h"
+
+namespace tfd {
+namespace fault {
+
+namespace {
+
+struct Rule {
+  std::string point;
+  Action action;        // template; message filled per injection
+  double rate = 1.0;    // probability per check
+  long long count_left = -1;  // -1: unlimited
+};
+
+struct Registry {
+  std::mutex mu;
+  std::vector<Rule> rules;
+  // Seeded (default seed 1, `seed=` overrides): the rate draws — the
+  // only nondeterminism — replay identically for a given spec, which is
+  // what makes a chaos schedule a SCHEDULE rather than noise.
+  std::mt19937 rng{1};
+  std::uniform_real_distribution<double> unit{0.0, 1.0};
+};
+
+Registry& GetRegistry() {
+  static Registry* registry = new Registry();
+  return *registry;
+}
+
+// The errno names a fault spec may use — the ones the hardened error
+// branches classify on. Anything else must be given numerically.
+int ErrnoByName(const std::string& name) {
+  struct Entry {
+    const char* name;
+    int value;
+  };
+  static constexpr Entry kNames[] = {
+      {"ENOSPC", ENOSPC},       {"EIO", EIO},
+      {"EPIPE", EPIPE},         {"ECONNRESET", ECONNRESET},
+      {"ETIMEDOUT", ETIMEDOUT}, {"ECONNREFUSED", ECONNREFUSED},
+      {"EACCES", EACCES},       {"EDQUOT", EDQUOT},
+      {"EXDEV", EXDEV},         {"EROFS", EROFS},
+  };
+  for (const Entry& entry : kNames) {
+    if (name == entry.name) return entry.value;
+  }
+  return 0;
+}
+
+// "<n>ms" / "<n>s" / bare integer seconds → milliseconds.
+Result<int> ParseMs(const std::string& text) {
+  std::string s = TrimSpace(text);
+  int scale = 1000;
+  if (s.size() > 2 && s.compare(s.size() - 2, 2, "ms") == 0) {
+    scale = 1;
+    s = s.substr(0, s.size() - 2);
+  } else if (s.size() > 1 && s.back() == 's') {
+    s = s.substr(0, s.size() - 1);
+  }
+  int value = 0;
+  if (!ParseNonNegInt(s, &value)) {
+    return Result<int>::Error("invalid duration '" + text + "'");
+  }
+  if (value > 600000 / scale) {
+    return Result<int>::Error("hang duration '" + text +
+                              "' exceeds the 10m injection cap");
+  }
+  return value * scale;
+}
+
+// One spec entry: point:action[:modifier...]. `*seed_out` picks up a
+// seed= modifier (registry-wide, last one wins).
+Result<Rule> ParseEntry(const std::string& entry, unsigned* seed_out) {
+  std::vector<std::string> parts = SplitString(entry, ':');
+  if (parts.size() < 2) {
+    return Result<Rule>::Error("fault entry '" + entry +
+                               "' is not point:action[:modifiers]");
+  }
+  Rule rule;
+  rule.point = TrimSpace(parts[0]);
+  if (rule.point.empty()) {
+    return Result<Rule>::Error("fault entry '" + entry +
+                               "' has an empty point name");
+  }
+  for (size_t i = 1; i < parts.size(); i++) {
+    std::string part = TrimSpace(parts[i]);
+    std::string key = part;
+    std::string value;
+    size_t eq = part.find('=');
+    if (eq != std::string::npos) {
+      key = part.substr(0, eq);
+      value = part.substr(eq + 1);
+    }
+    auto set_kind = [&rule, &entry](Action::Kind kind) {
+      if (rule.action.kind != Action::Kind::kNone) {
+        return Status::Error("fault entry '" + entry +
+                             "' has more than one action");
+      }
+      rule.action.kind = kind;
+      return Status::Ok();
+    };
+    Status s = Status::Ok();
+    if (key == "fail") {
+      s = set_kind(Action::Kind::kFail);
+      rule.action.message = value.empty() ? "injected fault" : value;
+    } else if (key == "errno") {
+      s = set_kind(Action::Kind::kErrno);
+      if (s.ok()) {
+        int parsed = ErrnoByName(value);
+        if (parsed == 0 && !ParseNonNegInt(value, &parsed)) parsed = 0;
+        if (parsed <= 0) {
+          return Result<Rule>::Error("fault entry '" + entry +
+                                     "': unknown errno '" + value + "'");
+        }
+        rule.action.errno_value = parsed;
+      }
+    } else if (key == "http") {
+      s = set_kind(Action::Kind::kHttp);
+      int status_code = 0;
+      if (s.ok() && (!ParseNonNegInt(value, &status_code) ||
+                     status_code < 100 || status_code > 599)) {
+        return Result<Rule>::Error("fault entry '" + entry +
+                                   "': invalid http status '" + value + "'");
+      }
+      rule.action.http_status = status_code;
+    } else if (key == "hang") {
+      s = set_kind(Action::Kind::kHang);
+      if (s.ok()) {
+        Result<int> ms = ParseMs(value);
+        if (!ms.ok()) {
+          return Result<Rule>::Error("fault entry '" + entry + "': " +
+                                     ms.error());
+        }
+        rule.action.hang_ms = *ms;
+      }
+    } else if (key == "crash") {
+      s = set_kind(Action::Kind::kCrash);
+    } else if (key == "torn") {
+      s = set_kind(Action::Kind::kTorn);
+    } else if (key == "rate") {
+      char* end = nullptr;
+      rule.rate = strtod(value.c_str(), &end);
+      // The negated >=/<= form also rejects NaN (all its comparisons
+      // are false), which would otherwise arm as an always-fire rule.
+      if (end == value.c_str() || *end != '\0' ||
+          !(rule.rate >= 0 && rule.rate <= 1)) {
+        return Result<Rule>::Error("fault entry '" + entry +
+                                   "': rate must be in [0,1], got '" +
+                                   value + "'");
+      }
+    } else if (key == "count") {
+      int parsed = 0;
+      if (!ParseNonNegInt(value, &parsed) || parsed < 1) {
+        return Result<Rule>::Error("fault entry '" + entry +
+                                   "': count must be a positive integer");
+      }
+      rule.count_left = parsed;
+    } else if (key == "seed") {
+      int parsed = 0;
+      if (!ParseNonNegInt(value, &parsed)) {
+        return Result<Rule>::Error("fault entry '" + entry +
+                                   "': seed must be a non-negative integer");
+      }
+      *seed_out = static_cast<unsigned>(parsed);
+    } else {
+      return Result<Rule>::Error("fault entry '" + entry +
+                                 "': unknown parameter '" + key + "'");
+    }
+    if (!s.ok()) return Result<Rule>::Error(s.message());
+  }
+  if (rule.action.kind == Action::Kind::kNone) {
+    return Result<Rule>::Error("fault entry '" + entry +
+                               "' has no action (fail/errno/http/hang/"
+                               "crash/torn)");
+  }
+  // Point/action compatibility: fail/errno/hang/crash are generic
+  // (every site handles them, or CheckSlow does centrally), but http
+  // only means something to the k8s verb points and torn only to the
+  // state writer. Rejecting the rest here keeps a grammar-valid spec
+  // from arming rules that would be counted and journaled as
+  // "injected" while the call site ignores them — a chaos drill must
+  // never pass on no-op injections.
+  if (rule.action.kind == Action::Kind::kHttp &&
+      rule.point != "k8s.get" && rule.point != "k8s.put" &&
+      rule.point != "k8s.post") {
+    return Result<Rule>::Error(
+        "fault entry '" + entry +
+        "': http= is only meaningful at k8s.get/k8s.put/k8s.post");
+  }
+  if (rule.action.kind == Action::Kind::kTorn &&
+      rule.point != "state.write") {
+    return Result<Rule>::Error("fault entry '" + entry +
+                               "': torn is only meaningful at state.write");
+  }
+  return rule;
+}
+
+Result<std::vector<Rule>> ParseSpec(const std::string& spec,
+                                    unsigned* seed_out) {
+  std::vector<Rule> rules;
+  for (const std::string& entry : SplitString(spec, ',')) {
+    if (TrimSpace(entry).empty()) continue;
+    Result<Rule> rule = ParseEntry(TrimSpace(entry), seed_out);
+    if (!rule.ok()) return Result<std::vector<Rule>>::Error(rule.error());
+    rules.push_back(std::move(*rule));
+  }
+  return rules;
+}
+
+std::string DescribeAction(const Action& action) {
+  switch (action.kind) {
+    case Action::Kind::kFail:
+      return "fail";
+    case Action::Kind::kErrno:
+      return std::string("errno=") + strerror(action.errno_value);
+    case Action::Kind::kHttp:
+      return "http=" + std::to_string(action.http_status);
+    case Action::Kind::kHang:
+      return "hang=" + std::to_string(action.hang_ms) + "ms";
+    case Action::Kind::kCrash:
+      return "crash";
+    case Action::Kind::kTorn:
+      return "torn";
+    case Action::Kind::kNone:
+      break;
+  }
+  return "none";
+}
+
+}  // namespace
+
+namespace internal {
+
+std::atomic<bool> g_armed{false};
+
+Action CheckSlow(const char* point) {
+  Registry& registry = GetRegistry();
+  Action action;
+  {
+    std::lock_guard<std::mutex> lock(registry.mu);
+    for (Rule& rule : registry.rules) {
+      if (rule.point != point || rule.count_left == 0) continue;
+      if (rule.rate < 1.0 && registry.unit(registry.rng) >= rule.rate) {
+        // One draw per armed check of a probabilistic rule — the draw
+        // sequence (and thus the schedule) is a pure function of seed
+        // and check order.
+        return Action{};
+      }
+      if (rule.count_left > 0) rule.count_left--;
+      action = rule.action;
+      break;
+    }
+  }
+  if (!action) return action;
+  std::string custom = action.message;  // fail=<msg>, if the spec set one
+  action.message = "injected " + DescribeAction(action) + " at " + point;
+  if (action.kind == Action::Kind::kFail && !custom.empty() &&
+      custom != "injected fault") {
+    action.message += ": " + custom;
+  }
+  obs::Default()
+      .GetCounter("tfd_faults_injected_total",
+                  "Faults injected by the armed --fault-spec, per "
+                  "injection point.",
+                  {{"point", point}})
+      ->Inc();
+  if (action.kind == Action::Kind::kCrash) {
+    // The kill -9 analogue for warm-restart drills: no cleanup, no
+    // journal flush, no atexit — exactly what a SIGKILLed daemon leaves
+    // behind. One stderr line so the soak harness can attribute the
+    // death; _exit so nothing else runs.
+    TFD_LOG_ERROR << action.message << "; exiting immediately";
+    _exit(134);
+  }
+  obs::DefaultJournal().Record("fault-injected", point, action.message,
+                               {{"point", point},
+                                {"action", DescribeAction(action)}});
+  if (action.kind == Action::Kind::kHang) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(action.hang_ms));
+  }
+  return action;
+}
+
+}  // namespace internal
+
+Status Arm(const std::string& spec) {
+  unsigned seed = 1;
+  Result<std::vector<Rule>> rules = ParseSpec(spec, &seed);
+  if (!rules.ok()) return rules.status();
+  Registry& registry = GetRegistry();
+  bool armed;
+  {
+    std::lock_guard<std::mutex> lock(registry.mu);
+    registry.rules = std::move(*rules);
+    registry.rng.seed(seed);
+    armed = !registry.rules.empty();
+  }
+  internal::g_armed.store(armed, std::memory_order_relaxed);
+  if (armed) {
+    TFD_LOG_WARNING << "fault injection ARMED (" << spec
+                    << ") - this daemon is lying on purpose; never deploy "
+                       "with a fault spec";
+    obs::DefaultJournal().Record("fault-armed", "",
+                                 "fault injection armed: " + spec,
+                                 {{"spec", spec}});
+  }
+  return Status::Ok();
+}
+
+void Disarm() {
+  Registry& registry = GetRegistry();
+  {
+    std::lock_guard<std::mutex> lock(registry.mu);
+    registry.rules.clear();
+  }
+  internal::g_armed.store(false, std::memory_order_relaxed);
+}
+
+bool Armed() {
+  return internal::g_armed.load(std::memory_order_relaxed);
+}
+
+Status Validate(const std::string& spec) {
+  unsigned seed = 1;
+  Result<std::vector<Rule>> rules = ParseSpec(spec, &seed);
+  if (!rules.ok()) return rules.status();
+  return Status::Ok();
+}
+
+}  // namespace fault
+}  // namespace tfd

@@ -1,0 +1,81 @@
+// Runtime-gated fault injection: named points, armed by a spec string.
+//
+// The error branches in the sink, the k8s transport, the probe broker,
+// and the state writer are exercised in production by faults nobody can
+// schedule — ENOSPC, an apiserver 500-storm, a wedged connect, a torn
+// file after power loss. This registry lets tests (and an operator on a
+// scratch node) INJECT those faults deterministically: the daemon is
+// started with `--fault-spec` / `TFD_FAULT_SPEC`, e.g.
+//
+//   sink.file:errno=ENOSPC:rate=0.3:seed=42   # 30% of label writes fail
+//   k8s.put:http=500:count=3                  # first 3 CR PUTs answer 500
+//   k8s.connect:hang=2s                       # every connect stalls 2s
+//   probe.pjrt:crash                          # the next probe kills -9 us
+//   state.write:torn                          # state file lands half-written
+//   config.load:fail                          # the next SIGHUP reload errors
+//
+// Entries are comma-separated; each is `point:action[:modifier...]`.
+// Actions: `fail[=msg]` (generic error), `errno=<NAME|int>` (error
+// carrying that errno's strerror), `http=<status>` (fabricated HTTP
+// response), `hang=<duration>` (sleep, then proceed — the delay IS the
+// fault), `crash` (immediate _exit(134), the kill -9 analogue), `torn`
+// (the write lands truncated and unchecksummed). Modifiers:
+// `rate=<0..1>` (probability per check, default 1), `count=<n>` (max
+// injections, default unlimited), `seed=<n>` (reseeds the registry RNG —
+// rate draws are deterministic per seed, so a chaos schedule replays).
+// Multiple entries may target one point; each check consumes from the
+// first non-exhausted entry in spec order, so `k8s.put:http=429:count=1,
+// k8s.put:http=500:count=1` yields a 429 then a 500.
+//
+// Inert by default: with nothing armed, every Check is one relaxed
+// atomic load and an immediate return — no lock, no allocation, no
+// measurable cost on the rewrite path (the bench.py oneshot p50
+// contract). Armed injections are journaled ("fault-injected") and
+// counted (tfd_faults_injected_total{point}) so a chaos soak can prove
+// which faults actually fired.
+#pragma once
+
+#include <atomic>
+#include <string>
+
+#include "tfd/util/status.h"
+
+namespace tfd {
+namespace fault {
+
+struct Action {
+  enum class Kind { kNone, kFail, kErrno, kHttp, kHang, kCrash, kTorn };
+  Kind kind = Kind::kNone;
+  int errno_value = 0;   // kErrno
+  int http_status = 0;   // kHttp
+  int hang_ms = 0;       // kHang (Check has already slept this long)
+  std::string message;   // human-readable injection description
+  explicit operator bool() const { return kind != Kind::kNone; }
+};
+
+// Parses and installs `spec`, replacing any armed rules. An empty spec
+// disarms. Invalid specs leave the previous rules in place.
+Status Arm(const std::string& spec);
+void Disarm();
+bool Armed();
+
+// Parse-only validation (config::Load rejects bad specs at startup
+// instead of arming garbage mid-flight).
+Status Validate(const std::string& spec);
+
+namespace internal {
+extern std::atomic<bool> g_armed;
+Action CheckSlow(const char* point);
+}  // namespace internal
+
+// The per-site probe. Returns the action to inject at `point`, or a
+// kNone action (falsy) when disarmed / no rule matches / rate says no.
+// kHang actions have already slept before returning; kCrash never
+// returns. The disarmed fast path is a single relaxed atomic load.
+inline Action Check(const char* point) {
+  if (!internal::g_armed.load(std::memory_order_relaxed)) return Action{};
+  return internal::CheckSlow(point);
+}
+
+}  // namespace fault
+}  // namespace tfd

@@ -1,0 +1,151 @@
+#include "tfd/k8s/breaker.h"
+
+#include "tfd/obs/journal.h"
+#include "tfd/obs/metrics.h"
+#include "tfd/util/logging.h"
+
+namespace tfd {
+namespace k8s {
+
+namespace {
+
+double StateGaugeValue(CircuitBreaker::State state) {
+  switch (state) {
+    case CircuitBreaker::State::kClosed:
+      return 0;
+    case CircuitBreaker::State::kHalfOpen:
+      return 1;
+    case CircuitBreaker::State::kOpen:
+      return 2;
+  }
+  return 0;
+}
+
+obs::Gauge* StateGauge() {
+  return obs::Default().GetGauge(
+      "tfd_sink_breaker_state",
+      "NodeFeature CR sink circuit breaker: 0 closed, 1 half-open, "
+      "2 open (writes skipped).");
+}
+
+}  // namespace
+
+const char* CircuitBreaker::StateName(State state) {
+  switch (state) {
+    case State::kClosed:
+      return "closed";
+    case State::kHalfOpen:
+      return "half-open";
+    case State::kOpen:
+      return "open";
+  }
+  return "closed";
+}
+
+CircuitBreaker::CircuitBreaker(Options options) : options_(options) {
+  if (options_.open_after_failures < 1) options_.open_after_failures = 1;
+  if (options_.cooldown_s < 0) options_.cooldown_s = 0;
+}
+
+void CircuitBreaker::Configure(Options options) {
+  std::lock_guard<std::mutex> lock(mu_);
+  options_ = options;
+  if (options_.open_after_failures < 1) options_.open_after_failures = 1;
+  if (options_.cooldown_s < 0) options_.cooldown_s = 0;
+}
+
+void CircuitBreaker::TransitionLocked(State to, const std::string& reason) {
+  if (state_ == to) return;
+  const char* from = StateName(state_);
+  state_ = to;
+  StateGauge()->Set(StateGaugeValue(to));
+  obs::Default()
+      .GetCounter("tfd_sink_breaker_transitions_total",
+                  "Sink circuit-breaker state transitions.",
+                  {{"from", from}, {"to", StateName(to)}})
+      ->Inc();
+  obs::DefaultJournal().Record(
+      "breaker-transition", "cr",
+      std::string("sink breaker ") + from + " -> " + StateName(to) +
+          (reason.empty() ? "" : ": " + reason),
+      {{"from", from}, {"to", StateName(to)}, {"reason", reason}});
+  TFD_LOG_WARNING << "NodeFeature sink circuit breaker " << from << " -> "
+                  << StateName(to)
+                  << (reason.empty() ? "" : " (" + reason + ")");
+}
+
+bool CircuitBreaker::Allow() {
+  std::lock_guard<std::mutex> lock(mu_);
+  StateGauge()->Set(StateGaugeValue(state_));  // registered even if quiet
+  switch (state_) {
+    case State::kClosed:
+      return true;
+    case State::kHalfOpen:
+      // One probe at a time; the rewrite loop is single-threaded so
+      // this only matters to tests, but the invariant is cheap.
+      if (half_open_probe_in_flight_) return false;
+      half_open_probe_in_flight_ = true;
+      return true;
+    case State::kOpen:
+      if (std::chrono::steady_clock::now() < open_until_) return false;
+      TransitionLocked(State::kHalfOpen, "cooldown elapsed; probing");
+      half_open_probe_in_flight_ = true;
+      return true;
+  }
+  return true;
+}
+
+void CircuitBreaker::RecordSuccess() {
+  std::lock_guard<std::mutex> lock(mu_);
+  consecutive_failures_ = 0;
+  half_open_probe_in_flight_ = false;
+  TransitionLocked(State::kClosed, "write succeeded");
+}
+
+void CircuitBreaker::RecordPermanentFailure() {
+  std::lock_guard<std::mutex> lock(mu_);
+  consecutive_failures_ = 0;
+  half_open_probe_in_flight_ = false;
+  TransitionLocked(State::kClosed,
+                   "permanent failure (endpoint answered; not an outage)");
+}
+
+void CircuitBreaker::RecordTransientFailure() {
+  std::lock_guard<std::mutex> lock(mu_);
+  consecutive_failures_++;
+  half_open_probe_in_flight_ = false;
+  if (state_ == State::kHalfOpen ||
+      (state_ == State::kClosed &&
+       consecutive_failures_ >= options_.open_after_failures)) {
+    open_until_ = std::chrono::steady_clock::now() +
+                  std::chrono::duration_cast<
+                      std::chrono::steady_clock::duration>(
+                      std::chrono::duration<double>(options_.cooldown_s));
+    TransitionLocked(
+        State::kOpen,
+        std::to_string(consecutive_failures_) +
+            " consecutive transient failure(s); cooling down " +
+            std::to_string(static_cast<long long>(options_.cooldown_s)) +
+            "s");
+  }
+}
+
+CircuitBreaker::State CircuitBreaker::state() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return state_;
+}
+
+int CircuitBreaker::consecutive_failures() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return consecutive_failures_;
+}
+
+void CircuitBreaker::AgeForTest(double seconds) {
+  std::lock_guard<std::mutex> lock(mu_);
+  open_until_ -= std::chrono::duration_cast<
+      std::chrono::steady_clock::duration>(
+      std::chrono::duration<double>(seconds));
+}
+
+}  // namespace k8s
+}  // namespace tfd

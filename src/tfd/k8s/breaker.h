@@ -1,0 +1,88 @@
+// Circuit breaker for the NodeFeature CR sink.
+//
+// A flapping apiserver used to cost every rewrite pass the full
+// GET/PUT retry budget: with the CR sink's 3 attempts and per-request
+// timeouts, a dead endpoint could stretch a pass far past the rewrite
+// cadence — the daemon stayed alive (transient failures are survived)
+// but its label freshness, /readyz honesty, and state-file save cadence
+// all degraded with it. The breaker bounds that cost: after
+// `open_after_failures` CONSECUTIVE transient failures the circuit
+// opens and every write is skipped instantly (still recorded as a
+// failed rewrite, so /readyz and tfd_rewrite_failures_total keep
+// telling the truth); after `cooldown_s` one half-open probe write is
+// let through — success closes the circuit, failure re-opens it for
+// another cooldown.
+//
+// Permanent failures (RBAC, schema) never trip it: those exit the
+// daemon visibly, which is the correct crash-loop. State is exported as
+// tfd_sink_breaker_state (0 closed, 1 half-open, 2 open), transitions
+// as tfd_sink_breaker_transitions_total{from,to} and journal
+// "breaker-transition" events.
+//
+// Thread model: only the rewrite loop talks to the sink, but Allow()/
+// Record*() are mutex-guarded anyway — the cost is nothing next to an
+// HTTP round trip, and it keeps the class safe for tests that poke it
+// from helper threads.
+#pragma once
+
+#include <chrono>
+#include <mutex>
+#include <string>
+
+namespace tfd {
+namespace k8s {
+
+class CircuitBreaker {
+ public:
+  enum class State { kClosed, kHalfOpen, kOpen };
+
+  struct Options {
+    int open_after_failures = 3;
+    double cooldown_s = 30;
+  };
+
+  CircuitBreaker() : CircuitBreaker(Options{3, 30}) {}
+  explicit CircuitBreaker(Options options);
+
+  // Reconfigures thresholds (SIGHUP reload) without resetting the
+  // failure streak or the circuit — the apiserver's health did not
+  // change because our config did.
+  void Configure(Options options);
+
+  // True if a write may proceed. An open circuit past its cooldown
+  // transitions to half-open here and admits exactly ONE probe write;
+  // further calls stay blocked until that probe's outcome is recorded.
+  bool Allow();
+
+  void RecordSuccess();
+  void RecordTransientFailure();
+  // Permanent failures (RBAC, schema) mean the endpoint ANSWERED — the
+  // breaker is the wrong tool, so the circuit closes and the streak
+  // resets. Critically this also releases a half-open probe slot; the
+  // daemon usually exits on permanent errors, but the restored-serve
+  // path survives them, and an unreleased probe slot would wedge
+  // Allow() at false forever.
+  void RecordPermanentFailure();
+
+  State state() const;
+  int consecutive_failures() const;
+
+  static const char* StateName(State state);
+
+  // Test hook: shifts the open-until deadline into the past so cooldown
+  // expiry is testable without real sleeps.
+  void AgeForTest(double seconds);
+
+ private:
+  void TransitionLocked(State to, const std::string& reason);
+
+  mutable std::mutex mu_;
+  Options options_;
+  State state_ = State::kClosed;
+  int consecutive_failures_ = 0;
+  bool half_open_probe_in_flight_ = false;
+  std::chrono::steady_clock::time_point open_until_{};
+};
+
+}  // namespace k8s
+}  // namespace tfd

@@ -1,7 +1,10 @@
 #include "tfd/k8s/client.h"
 
+#include <string.h>
+
 #include <cstdlib>
 
+#include "tfd/fault/fault.h"
 #include "tfd/obs/journal.h"
 #include "tfd/util/file.h"
 #include "tfd/util/http.h"
@@ -44,7 +47,51 @@ http::RequestOptions BaseOptions(const ClusterConfig& config) {
     options.headers["Authorization"] = "Bearer " + config.token;
   }
   options.headers["Accept"] = "application/json";
+  options.deadline_ms = config.request_deadline_ms;
   return options;
+}
+
+// One apiserver request, with its fault-injection points. "k8s.connect"
+// fires for every method (transport-level faults: a hang has already
+// slept inside Check — the delay is the fault — while errno/fail become
+// the transport error the caller's transient classification sees);
+// `method_point` (k8s.get / k8s.put / k8s.post) fires per verb, with
+// `http=` fabricating a response of that status without touching the
+// network. Disarmed cost: two relaxed atomic loads.
+Result<http::Response> SinkRequest(const char* method_point,
+                                   const std::string& method,
+                                   const std::string& url,
+                                   const std::string& body,
+                                   const http::RequestOptions& options) {
+  if (fault::Action injected = fault::Check("k8s.connect")) {
+    if (injected.kind == fault::Action::Kind::kErrno) {
+      return Result<http::Response>::Error(
+          std::string("connect: ") + strerror(injected.errno_value) +
+          " (injected)");
+    }
+    if (injected.kind == fault::Action::Kind::kFail) {
+      return Result<http::Response>::Error(injected.message);
+    }
+  }
+  if (fault::Action injected = fault::Check(method_point)) {
+    switch (injected.kind) {
+      case fault::Action::Kind::kHttp: {
+        http::Response response;
+        response.status = injected.http_status;
+        response.body = "{}";
+        return response;
+      }
+      case fault::Action::Kind::kErrno:
+        return Result<http::Response>::Error(
+            std::string("recv failed: ") + strerror(injected.errno_value) +
+            " (injected)");
+      case fault::Action::Kind::kFail:
+        return Result<http::Response>::Error(injected.message);
+      default:
+        break;  // hang already slept; torn/crash not meaningful here
+    }
+  }
+  return http::Request(method, url, body, options);
 }
 
 // The create body. spec.labels values become node labels via the NFD
@@ -141,14 +188,15 @@ Status UpdateNodeFeature(const ClusterConfig& config,
   std::string last_error;
   for (int attempt = 0; attempt < kMaxAttempts; attempt++) {
     Result<http::Response> existing =
-        http::Request("GET", CrUrl(config, true), "", options);
+        SinkRequest("k8s.get", "GET", CrUrl(config, true), "", options);
     if (!existing.ok()) {
       return Fail(true, "getting NodeFeature CR: " + existing.error());
     }
 
     if (existing->status == 404) {
-      Result<http::Response> created = http::Request(
-          "POST", CrUrl(config, false), CrBody(config, labels), write);
+      Result<http::Response> created = SinkRequest(
+          "k8s.post", "POST", CrUrl(config, false), CrBody(config, labels),
+          write);
       if (!created.ok()) {
         return Fail(true, "creating NodeFeature CR: " + created.error());
       }
@@ -238,8 +286,9 @@ Status UpdateNodeFeature(const ClusterConfig& config,
     }
     spec->Set("labels", jsonlite::FromStringMap(labels));
 
-    Result<http::Response> updated = http::Request(
-        "PUT", CrUrl(config, true), jsonlite::Serialize(cr), write);
+    Result<http::Response> updated = SinkRequest(
+        "k8s.put", "PUT", CrUrl(config, true), jsonlite::Serialize(cr),
+        write);
     if (!updated.ok()) {
       return Fail(true, "updating NodeFeature CR: " + updated.error());
     }

@@ -26,6 +26,11 @@ struct ClusterConfig {
   std::string ca_file;        // PEM path ("" = system roots)
   std::string namespace_;     // CR namespace
   std::string node_name;      // from NODE_NAME
+  // Wall-clock budget per apiserver HTTP request (http::RequestOptions
+  // deadline_ms): bounds a dribbling/hanging apiserver's hold on a sink
+  // write. 0 = per-op timeouts only. The daemon wires
+  // --sink-request-deadline here.
+  int request_deadline_ms = 0;
 };
 
 // Loads in-cluster config (reference k8s-client.go:30-66). Errors when

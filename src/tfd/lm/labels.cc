@@ -1,8 +1,12 @@
 #include "tfd/lm/labels.h"
 
+#include <errno.h>
+#include <string.h>
+
 #include <iostream>
 #include <sstream>
 
+#include "tfd/fault/fault.h"
 #include "tfd/obs/journal.h"
 #include "tfd/util/file.h"
 
@@ -17,7 +21,21 @@ std::string FormatLabels(const Labels& labels) {
   return out.str();
 }
 
-Status OutputToFile(const Labels& labels, const std::string& path) {
+namespace {
+
+// Filesystem errors worth retrying next interval: conditions that
+// drain on their own. Permission/mount-shape errors are configuration
+// and should crash-loop visibly instead.
+bool TransientFsErrno(int err) {
+  return err == ENOSPC || err == EDQUOT || err == EIO || err == EINTR ||
+         err == EAGAIN || err == ENOMEM;
+}
+
+}  // namespace
+
+Status OutputToFile(const Labels& labels, const std::string& path,
+                    bool* transient) {
+  if (transient != nullptr) *transient = false;
   std::string body = FormatLabels(labels);
   if (path.empty()) {
     std::cout << body;
@@ -27,7 +45,30 @@ Status OutputToFile(const Labels& labels, const std::string& path) {
         {{"labels", std::to_string(labels.size())}, {"ok", "true"}});
     return Status::Ok();
   }
-  Status s = WriteFileAtomically(path, body);
+  Status s;
+  int write_errno = 0;
+  // Fault point "sink.file": a hang has already slept (the delay is the
+  // fault); errno/fail become the write error the daemon's transient
+  // handling — and the chaos soak's never-torn invariant — must absorb.
+  // The injected failure SKIPS the real write entirely: the previous
+  // label file stays in place untouched, exactly like a full disk.
+  if (fault::Action injected = fault::Check("sink.file")) {
+    if (injected.kind == fault::Action::Kind::kErrno) {
+      write_errno = injected.errno_value;
+      s = Status::Error("write to " + path + " failed: " +
+                        strerror(injected.errno_value) + " (injected)");
+    } else if (injected.kind == fault::Action::Kind::kFail) {
+      s = Status::Error("write to " + path + " failed: " +
+                        injected.message);
+    } else {
+      s = WriteFileAtomically(path, body, &write_errno);
+    }
+  } else {
+    s = WriteFileAtomically(path, body, &write_errno);
+  }
+  if (!s.ok() && transient != nullptr) {
+    *transient = TransientFsErrno(write_errno);
+  }
   obs::DefaultJournal().Record(
       "sink-write", "file",
       s.ok() ? "wrote labels to " + path

@@ -19,7 +19,13 @@ std::string FormatLabels(const Labels& labels);
 
 // Writes labels to `path` atomically, or to stdout if `path` is empty
 // (reference labels.go:62-65).
-Status OutputToFile(const Labels& labels, const std::string& path);
+// On failure, `*transient` (if non-null) mirrors the CR sink's
+// contract: true when retrying next interval can plausibly succeed
+// without operator action (ENOSPC, EDQUOT, EIO — conditions that
+// drain), false for misconfiguration (EACCES, EROFS, EXDEV) where a
+// visible crash-loop beats silent retrying.
+Status OutputToFile(const Labels& labels, const std::string& path,
+                    bool* transient = nullptr);
 
 }  // namespace lm
 }  // namespace tfd

@@ -7,6 +7,7 @@
 #include <random>
 #include <thread>
 
+#include "tfd/fault/fault.h"
 #include "tfd/obs/journal.h"
 #include "tfd/obs/metrics.h"
 #include "tfd/util/logging.h"
@@ -64,7 +65,16 @@ bool RunProbeOnce(BrokerControl& control, const ProbeSpec& spec,
   bool fatal = false;
   auto t0 = std::chrono::steady_clock::now();
   Status s = Status::Ok();
-  {
+  // Fault point "probe.<source>": fail/errno become a probe failure
+  // (exercising the backoff + degradation ladder), a hang has already
+  // slept inside Check (stalling THIS worker, never the rewrite loop —
+  // which is the decoupling the scheduler exists to prove), and crash
+  // never returns (the warm-restart drill).
+  fault::Action injected = fault::Check(spec.fault_point.c_str());
+  if (injected.kind == fault::Action::Kind::kFail ||
+      injected.kind == fault::Action::Kind::kErrno) {
+    s = Status::Error(injected.message);
+  } else {
     std::unique_lock<std::mutex> device_lock(control.device_mu,
                                              std::defer_lock);
     if (spec.exclusive) device_lock.lock();
@@ -181,6 +191,9 @@ ProbeBroker::ProbeBroker(std::shared_ptr<SnapshotStore> store,
                          std::vector<ProbeSpec> specs)
     : control_(std::make_shared<BrokerControl>()), specs_(std::move(specs)) {
   control_->store = std::move(store);
+  for (ProbeSpec& spec : specs_) {
+    spec.fault_point = "probe." + spec.name;
+  }
 }
 
 ProbeBroker::~ProbeBroker() { Stop(); }

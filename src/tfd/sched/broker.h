@@ -45,6 +45,10 @@ namespace sched {
 
 struct ProbeSpec {
   std::string name;
+  // "probe.<name>", precomputed by the ProbeBroker constructor so the
+  // disarmed fault check on the probe path stays a single relaxed
+  // atomic load (no per-attempt string build).
+  std::string fault_point;
   // Fills `out` (manager or labels payload) on success. `fatal` set
   // true marks a construction-shaped error (see SourceView::fatal_error).
   std::function<Status(Snapshot* out, bool* fatal)> probe;

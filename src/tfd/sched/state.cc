@@ -1,0 +1,238 @@
+#include "tfd/sched/state.h"
+
+#include <string.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstdlib>
+
+#include "tfd/fault/fault.h"
+#include "tfd/util/file.h"
+#include "tfd/util/jsonlite.h"
+
+namespace tfd {
+namespace sched {
+
+namespace {
+
+constexpr char kMagic[] = "TFDSTATE1";
+
+// FNV-1a 64: tiny, deterministic, and plenty to catch torn writes and
+// bit rot — this is an integrity check against accidents, not an
+// authenticity check against attackers (the state file lives on the
+// pod's own emptyDir).
+uint64_t Fnv1a(const std::string& data) {
+  uint64_t hash = 1469598103934665603ULL;
+  for (unsigned char c : data) {
+    hash ^= c;
+    hash *= 1099511628211ULL;
+  }
+  return hash;
+}
+
+std::string HexU64(uint64_t v) {
+  char buf[17];
+  snprintf(buf, sizeof(buf), "%016llx", static_cast<unsigned long long>(v));
+  return buf;
+}
+
+std::string NumberJson(double v) {
+  char buf[32];
+  snprintf(buf, sizeof(buf), "%.3f", v);
+  return buf;
+}
+
+}  // namespace
+
+std::string NodeIdentity() {
+  if (const char* node = std::getenv("NODE_NAME")) {
+    if (*node != '\0') return node;
+  }
+  char host[256] = {0};
+  if (gethostname(host, sizeof(host) - 1) == 0 && host[0] != '\0') {
+    return host;
+  }
+  return "unknown";
+}
+
+std::string SerializeState(const PersistedState& state) {
+  std::string payload = "{\"schema\":" + std::to_string(state.schema) +
+                        ",\"node\":" + jsonlite::Quote(state.node) +
+                        ",\"saved_at\":" + NumberJson(state.saved_at) +
+                        ",\"source\":" + jsonlite::Quote(state.source) +
+                        ",\"tier\":" + jsonlite::Quote(state.tier) +
+                        ",\"level\":" + std::to_string(state.level) +
+                        ",\"age_s\":" + NumberJson(state.age_s) +
+                        ",\"labels\":" +
+                        jsonlite::SerializeStringMap(state.labels) +
+                        ",\"provenance\":{";
+  bool first = true;
+  for (const auto& [key, from] : state.provenance) {
+    if (!first) payload += ",";
+    first = false;
+    payload += jsonlite::Quote(key) + ":{\"labeler\":" +
+               jsonlite::Quote(from.labeler) + ",\"source\":" +
+               jsonlite::Quote(from.source) + ",\"tier\":" +
+               jsonlite::Quote(from.tier) + ",\"age_s\":" +
+               NumberJson(from.age_s) + "}";
+  }
+  payload += "}}";
+  return std::string(kMagic) + " " + HexU64(Fnv1a(payload)) + " " +
+         std::to_string(payload.size()) + "\n" + payload;
+}
+
+Result<PersistedState> ParseState(const std::string& contents) {
+  using R = Result<PersistedState>;
+  size_t newline = contents.find('\n');
+  if (newline == std::string::npos) {
+    return R::Error("state file torn or corrupt (no header line)");
+  }
+  std::string header = contents.substr(0, newline);
+  std::string payload = contents.substr(newline + 1);
+  char checksum_hex[32] = {0};
+  unsigned long long length = 0;
+  char magic[16] = {0};
+  if (sscanf(header.c_str(), "%15s %31s %llu", magic, checksum_hex,
+             &length) != 3 ||
+      std::string(magic) != kMagic) {
+    return R::Error("state file has an unrecognized header (not " +
+                    std::string(kMagic) + ")");
+  }
+  if (payload.size() != length) {
+    return R::Error("state file torn or corrupt (payload " +
+                    std::to_string(payload.size()) + " bytes, header says " +
+                    std::to_string(length) + ")");
+  }
+  if (HexU64(Fnv1a(payload)) != checksum_hex) {
+    return R::Error("state file torn or corrupt (checksum mismatch)");
+  }
+  Result<jsonlite::ValuePtr> parsed = jsonlite::Parse(payload);
+  if (!parsed.ok()) {
+    return R::Error("state payload unparseable: " + parsed.error());
+  }
+  const jsonlite::Value& root = **parsed;
+  jsonlite::ValuePtr schema = root.Get("schema");
+  if (!schema || schema->kind != jsonlite::Value::Kind::kNumber) {
+    return R::Error("state payload missing schema");
+  }
+  if (static_cast<int>(schema->number_value) != kStateSchema) {
+    return R::Error("state schema " +
+                    std::to_string(static_cast<int>(schema->number_value)) +
+                    " unsupported (want " + std::to_string(kStateSchema) +
+                    ")");
+  }
+  PersistedState state;
+  auto get_string = [&root](const char* key, std::string* out) {
+    jsonlite::ValuePtr v = root.Get(key);
+    if (v && v->kind == jsonlite::Value::Kind::kString) {
+      *out = v->string_value;
+    }
+  };
+  auto get_number = [&root](const char* key, double* out) {
+    jsonlite::ValuePtr v = root.Get(key);
+    if (v && v->kind == jsonlite::Value::Kind::kNumber) {
+      *out = v->number_value;
+    }
+  };
+  get_string("node", &state.node);
+  get_string("source", &state.source);
+  get_string("tier", &state.tier);
+  get_number("saved_at", &state.saved_at);
+  get_number("age_s", &state.age_s);
+  double level = 0;
+  get_number("level", &level);
+  state.level = static_cast<int>(level);
+  jsonlite::ValuePtr labels = root.Get("labels");
+  if (!labels || labels->kind != jsonlite::Value::Kind::kObject) {
+    return R::Error("state payload missing labels");
+  }
+  for (const auto& [key, value] : labels->object_items) {
+    if (value->kind != jsonlite::Value::Kind::kString) {
+      return R::Error("state label '" + key + "' is not a string");
+    }
+    state.labels[key] = value->string_value;
+  }
+  if (state.labels.empty()) {
+    return R::Error("state payload carries no labels");
+  }
+  jsonlite::ValuePtr provenance = root.Get("provenance");
+  if (provenance && provenance->kind == jsonlite::Value::Kind::kObject) {
+    for (const auto& [key, value] : provenance->object_items) {
+      if (value->kind != jsonlite::Value::Kind::kObject) continue;
+      lm::LabelProvenance from;
+      jsonlite::ValuePtr field = value->Get("labeler");
+      if (field && field->kind == jsonlite::Value::Kind::kString) {
+        from.labeler = field->string_value;
+      }
+      field = value->Get("source");
+      if (field && field->kind == jsonlite::Value::Kind::kString) {
+        from.source = field->string_value;
+      }
+      field = value->Get("tier");
+      if (field && field->kind == jsonlite::Value::Kind::kString) {
+        from.tier = field->string_value;
+      }
+      field = value->Get("age_s");
+      if (field && field->kind == jsonlite::Value::Kind::kNumber) {
+        from.age_s = field->number_value;
+      }
+      state.provenance[key] = from;
+    }
+  }
+  return state;
+}
+
+Status SaveState(const std::string& path, const PersistedState& state) {
+  std::string framed = SerializeState(state);
+  if (fault::Action injected = fault::Check("state.write")) {
+    if (injected.kind == fault::Action::Kind::kTorn) {
+      // Mid-write power loss: a non-atomic partial write lands at the
+      // destination — precisely what the checksum gate must catch on
+      // the next boot. Deliberately bypasses the atomic writer.
+      FILE* f = fopen(path.c_str(), "w");
+      if (f != nullptr) {
+        fwrite(framed.data(), 1, framed.size() / 2, f);
+        fclose(f);
+      }
+      return Status::Ok();  // the daemon believes the save worked
+    }
+    if (injected.kind == fault::Action::Kind::kErrno) {
+      return Status::Error("state save failed: " + path + ": " +
+                           strerror(injected.errno_value) + " (injected)");
+    }
+    if (injected.kind == fault::Action::Kind::kFail) {
+      return Status::Error("state save failed: " + injected.message);
+    }
+  }
+  return WriteFileAtomically(path, framed);
+}
+
+Result<PersistedState> LoadState(const std::string& path,
+                                 const std::string& expect_node,
+                                 double max_age_s, double now_wall) {
+  using R = Result<PersistedState>;
+  Result<std::string> contents = ReadFile(path);
+  if (!contents.ok()) return R::Error(contents.error());
+  Result<PersistedState> state = ParseState(*contents);
+  if (!state.ok()) return state;
+  if (!expect_node.empty() && state->node != expect_node) {
+    return R::Error("state file is from node '" + state->node +
+                    "', this is '" + expect_node +
+                    "' (refusing foreign labels)");
+  }
+  double downtime_s = now_wall - state->saved_at;
+  if (downtime_s < 0) downtime_s = 0;  // clock stepped back across boot
+  double restored_age_s = state->age_s + downtime_s;
+  if (restored_age_s > max_age_s) {
+    return R::Error("state snapshot age " +
+                    std::to_string(static_cast<long long>(restored_age_s)) +
+                    "s exceeds the usable window (" +
+                    std::to_string(static_cast<long long>(max_age_s)) +
+                    "s); facts expired while down");
+  }
+  state->age_s = restored_age_s;
+  return state;
+}
+
+}  // namespace sched
+}  // namespace tfd

@@ -1,0 +1,72 @@
+// Crash-safe warm restart: the persisted label state.
+//
+// A restarted daemon used to forget everything: the first pass after a
+// crash (or an OOM-kill, or a node-agent restart) re-ran the full probe
+// gauntlet, so a node whose PJRT init takes 30s served NO device labels
+// for that long — and a crash-looping labeler turned into a scheduling
+// outage. The fix: after every successful rewrite the daemon persists
+// what it published (labels + per-key provenance + the serving
+// decision) to `--state-file`; on boot it loads that file and serves a
+// cached-tier warm pass in milliseconds — the persisted labels, marked
+// degraded with the TRUE snapshot age (persisted age + downtime) — while
+// the probe brokers start from zero in the background.
+//
+// The file must be trustworthy after any crash, so it is:
+//   - written through WriteFileAtomically (rename-into-place, dir fsync);
+//   - framed with a magic + FNV-1a checksum header ("TFDSTATE1 <hex>
+//     <len>") so a torn or bit-rotted payload is detected, not parsed;
+//   - schema-gated (payload "schema" must match kStateSchema);
+//   - node-gated (payload "node" must match this node's identity — a
+//     hostPath-style volume reattached to a different node must not
+//     replay a foreign node's labels);
+//   - age-gated (persisted age + downtime past the usable window means
+//     the facts expired while we were dead; serve a cold start instead).
+// Every rejection reason is distinct, journaled by the caller, and
+// counted in tfd_state_restores_total{outcome}.
+#pragma once
+
+#include <string>
+
+#include "tfd/lm/merge.h"
+#include "tfd/util/status.h"
+
+namespace tfd {
+namespace sched {
+
+inline constexpr int kStateSchema = 1;
+
+struct PersistedState {
+  int schema = kStateSchema;
+  std::string node;       // NODE_NAME env, else hostname
+  double saved_at = 0;    // unix wall time of the save
+  std::string source;     // serving probe source at save time
+  std::string tier;       // its staleness tier
+  int level = 0;          // degradation-ladder rung served
+  double age_s = 0;       // serving snapshot age at save time
+  lm::Labels labels;
+  lm::Provenance provenance;
+};
+
+// This node's identity for the foreign-node gate.
+std::string NodeIdentity();
+
+// Serializes to the framed on-disk format (header line + JSON payload).
+std::string SerializeState(const PersistedState& state);
+
+// Parses the framed format, verifying magic, checksum, and schema.
+// Errors name the specific gate that failed ("torn or corrupt", ...).
+Result<PersistedState> ParseState(const std::string& contents);
+
+// Atomic save (fault point "state.write": `torn` lands a truncated,
+// unverifiable file — exactly what mid-write power loss leaves).
+Status SaveState(const std::string& path, const PersistedState& state);
+
+// Load + every gate: parse/checksum/schema via ParseState, then node
+// identity and age. `now_wall` is unix time; the restored age
+// (state.age_s + downtime) must be <= max_age_s.
+Result<PersistedState> LoadState(const std::string& path,
+                                 const std::string& expect_node,
+                                 double max_age_s, double now_wall);
+
+}  // namespace sched
+}  // namespace tfd

@@ -18,10 +18,14 @@
 #include <fstream>
 #include <iostream>
 #include <limits>
+#include <thread>
 
 #include "tfd/config/config.h"
 #include "tfd/config/yamllite.h"
+#include "tfd/fault/fault.h"
 #include "tfd/gce/metadata.h"
+#include "tfd/k8s/breaker.h"
+#include "tfd/k8s/client.h"
 #include "tfd/lm/labels.h"
 #include "tfd/lm/merge.h"
 #include "tfd/lm/schema.h"
@@ -36,6 +40,7 @@
 #include "tfd/resource/types.h"
 #include "tfd/sched/broker.h"
 #include "tfd/sched/snapshot.h"
+#include "tfd/sched/state.h"
 #include "tfd/slice/shape.h"
 #include "tfd/slice/topology.h"
 #include "tfd/util/file.h"
@@ -1722,6 +1727,371 @@ void TestBackendCandidatesList() {
   CHECK_TRUE(first->get() != second->get());
 }
 
+// ---- fault injection / robustness (ISSUE 4) ------------------------------
+
+void TestFaultSpecParse() {
+  // The grammar the README documents, end to end.
+  CHECK_TRUE(fault::Validate("").ok());
+  CHECK_TRUE(fault::Validate("sink.file:errno=ENOSPC:rate=0.3,"
+                             "k8s.put:http=500:count=3,"
+                             "k8s.connect:hang=2s,"
+                             "probe.pjrt:crash,"
+                             "state.write:torn,"
+                             "config.load:fail:seed=7")
+                 .ok());
+  CHECK_TRUE(fault::Validate("sink.file:errno=ENOSPC:hang=10ms").ok() ==
+             false);  // two actions
+  CHECK_TRUE(!fault::Validate("sink.file").ok());             // no action
+  CHECK_TRUE(!fault::Validate("sink.file:rate=0.5").ok());    // no action
+  CHECK_TRUE(!fault::Validate("sink.file:errno=EWHAT").ok());
+  CHECK_TRUE(!fault::Validate("sink.file:fail:rate=1.5").ok());
+  CHECK_TRUE(!fault::Validate("sink.file:fail:rate=nan").ok());
+  CHECK_TRUE(!fault::Validate("sink.file:http=999").ok());
+  CHECK_TRUE(!fault::Validate("sink.file:fail:count=0").ok());
+  CHECK_TRUE(!fault::Validate("sink.file:fail:bogus=1").ok());
+  CHECK_TRUE(!fault::Validate(":fail").ok());                 // empty point
+
+  // Disarmed: every check is falsy (and costs one atomic load).
+  fault::Disarm();
+  CHECK_TRUE(!fault::Armed());
+  CHECK_TRUE(!fault::Check("sink.file"));
+
+  // count consumes per-injection; other points never match.
+  CHECK_TRUE(fault::Arm("x.y:fail=boom:count=2").ok());
+  CHECK_TRUE(fault::Armed());
+  CHECK_TRUE(!fault::Check("x.z"));
+  fault::Action first = fault::Check("x.y");
+  CHECK_TRUE(first.kind == fault::Action::Kind::kFail);
+  CHECK_TRUE(first.message.find("x.y") != std::string::npos);
+  // The custom fail=<msg> text survives into the injected message.
+  CHECK_TRUE(first.message.find("boom") != std::string::npos);
+  CHECK_TRUE(fault::Check("x.y"));
+  CHECK_TRUE(!fault::Check("x.y"));  // exhausted
+
+  // Spec-order sequencing on one point: 429 then 500, then nothing.
+  CHECK_TRUE(
+      fault::Arm("k8s.get:http=429:count=1,k8s.get:http=500:count=1").ok());
+  CHECK_EQ(fault::Check("k8s.get").http_status, 429);
+  CHECK_EQ(fault::Check("k8s.get").http_status, 500);
+  CHECK_TRUE(!fault::Check("k8s.get"));
+
+  // Point/action compatibility: actions a site would ignore must not
+  // arm (they would be counted as injected while doing nothing).
+  CHECK_TRUE(!fault::Validate("sink.file:http=500").ok());
+  CHECK_TRUE(!fault::Validate("probe.pjrt:http=500").ok());
+  CHECK_TRUE(!fault::Validate("sink.file:torn").ok());
+  CHECK_TRUE(fault::Validate("state.write:torn").ok());
+  CHECK_TRUE(fault::Validate("k8s.put:http=500").ok());
+
+  // rate=0 never fires; a seeded rate replays the same fire pattern.
+  CHECK_TRUE(fault::Arm("r.s:fail:rate=0").ok());
+  for (int i = 0; i < 20; i++) CHECK_TRUE(!fault::Check("r.s"));
+  auto draw_pattern = [] {
+    std::string pattern;
+    for (int i = 0; i < 32; i++) {
+      pattern += fault::Check("r.s") ? '1' : '0';
+    }
+    return pattern;
+  };
+  CHECK_TRUE(fault::Arm("r.s:fail:rate=0.5:seed=11").ok());
+  std::string run1 = draw_pattern();
+  CHECK_TRUE(fault::Arm("r.s:fail:rate=0.5:seed=11").ok());
+  std::string run2 = draw_pattern();
+  CHECK_EQ(run1, run2);
+  CHECK_TRUE(run1.find('1') != std::string::npos);
+  CHECK_TRUE(run1.find('0') != std::string::npos);
+
+  // hang sleeps inside Check (the delay IS the fault).
+  CHECK_TRUE(fault::Arm("h.i:hang=20ms").ok());
+  auto t0 = std::chrono::steady_clock::now();
+  CHECK_TRUE(fault::Check("h.i").kind == fault::Action::Kind::kHang);
+  CHECK_TRUE(std::chrono::steady_clock::now() - t0 >=
+             std::chrono::milliseconds(18));
+  fault::Disarm();
+  CHECK_TRUE(!fault::Check("h.i"));
+}
+
+void TestFaultSinkFile() {
+  std::string dir = "/tmp/tfd-unit-fault-" + std::to_string(getpid());
+  std::string path = dir + "/labels";
+  lm::Labels labels{{"google.com/tpu.count", "4"}};
+
+  // Injected ENOSPC: the write fails AND is classified transient — the
+  // daemon must survive it — and the real file is never touched (a full
+  // disk leaves the previous labels in place).
+  CHECK_TRUE(fault::Arm("sink.file:errno=ENOSPC:count=1").ok());
+  bool transient = false;
+  Status s = lm::OutputToFile(labels, path, &transient);
+  CHECK_TRUE(!s.ok());
+  CHECK_TRUE(s.message().find("injected") != std::string::npos);
+  CHECK_TRUE(transient);
+  CHECK_TRUE(!FileExists(path));
+  // Fault exhausted: the next write lands.
+  CHECK_TRUE(lm::OutputToFile(labels, path, &transient).ok());
+  CHECK_EQ(*ReadFile(path), "google.com/tpu.count=4\n");
+
+  // EACCES is configuration, not weather: permanent.
+  CHECK_TRUE(fault::Arm("sink.file:errno=EACCES:count=1").ok());
+  transient = true;
+  CHECK_TRUE(!lm::OutputToFile(labels, path, &transient).ok());
+  CHECK_TRUE(!transient);
+  fault::Disarm();
+  std::string cmd = "rm -rf " + dir;
+  CHECK_TRUE(system(cmd.c_str()) == 0);
+}
+
+void TestCircuitBreaker() {
+  k8s::CircuitBreaker breaker(k8s::CircuitBreaker::Options{3, 30});
+  CHECK_TRUE(breaker.state() == k8s::CircuitBreaker::State::kClosed);
+  CHECK_TRUE(breaker.Allow());
+
+  // Two failures: still closed (under the threshold).
+  breaker.RecordTransientFailure();
+  breaker.RecordTransientFailure();
+  CHECK_TRUE(breaker.state() == k8s::CircuitBreaker::State::kClosed);
+  CHECK_TRUE(breaker.Allow());
+  // Third consecutive: open; writes skip.
+  breaker.RecordTransientFailure();
+  CHECK_TRUE(breaker.state() == k8s::CircuitBreaker::State::kOpen);
+  CHECK_TRUE(!breaker.Allow());
+  CHECK_EQ(breaker.consecutive_failures(), 3);
+
+  // Cooldown elapses: exactly ONE half-open probe is admitted.
+  breaker.AgeForTest(31);
+  CHECK_TRUE(breaker.Allow());
+  CHECK_TRUE(breaker.state() == k8s::CircuitBreaker::State::kHalfOpen);
+  CHECK_TRUE(!breaker.Allow());  // probe in flight
+  // Probe fails: straight back to open, cooldown restarted.
+  breaker.RecordTransientFailure();
+  CHECK_TRUE(breaker.state() == k8s::CircuitBreaker::State::kOpen);
+  CHECK_TRUE(!breaker.Allow());
+  // Probe succeeds after the next cooldown: closed, streak reset.
+  breaker.AgeForTest(31);
+  CHECK_TRUE(breaker.Allow());
+  breaker.RecordSuccess();
+  CHECK_TRUE(breaker.state() == k8s::CircuitBreaker::State::kClosed);
+  CHECK_EQ(breaker.consecutive_failures(), 0);
+  CHECK_TRUE(breaker.Allow());
+
+  // A success mid-streak resets the consecutive count: 2 failures,
+  // success, 2 failures never opens a threshold-3 breaker.
+  breaker.RecordTransientFailure();
+  breaker.RecordTransientFailure();
+  breaker.RecordSuccess();
+  breaker.RecordTransientFailure();
+  breaker.RecordTransientFailure();
+  CHECK_TRUE(breaker.state() == k8s::CircuitBreaker::State::kClosed);
+
+  // A PERMANENT failure during the half-open probe must release the
+  // probe slot (else Allow() wedges at false forever) and close the
+  // circuit: the endpoint answered, so the breaker does not apply.
+  breaker.RecordTransientFailure();
+  CHECK_TRUE(breaker.state() == k8s::CircuitBreaker::State::kOpen);
+  breaker.AgeForTest(31);
+  CHECK_TRUE(breaker.Allow());  // half-open probe admitted
+  breaker.RecordPermanentFailure();
+  CHECK_TRUE(breaker.state() == k8s::CircuitBreaker::State::kClosed);
+  CHECK_TRUE(breaker.Allow());
+  CHECK_EQ(breaker.consecutive_failures(), 0);
+}
+
+void TestStateRoundTrip() {
+  sched::PersistedState state;
+  state.node = "unit-node";
+  state.saved_at = 1000.0;
+  state.source = "pjrt";
+  state.tier = "fresh";
+  state.level = 0;
+  state.age_s = 12.5;
+  state.labels = {{"google.com/tpu.count", "4"},
+                  {"google.com/tpu.backend", "pjrt"}};
+  lm::LabelProvenance from;
+  from.labeler = "tpu";
+  from.source = "pjrt";
+  from.tier = "fresh";
+  from.age_s = 12.5;
+  state.provenance["google.com/tpu.count"] = from;
+
+  std::string framed = sched::SerializeState(state);
+  CHECK_TRUE(framed.rfind("TFDSTATE1 ", 0) == 0);
+  Result<sched::PersistedState> parsed = sched::ParseState(framed);
+  CHECK_TRUE(parsed.ok());
+  CHECK_EQ(parsed->node, "unit-node");
+  CHECK_EQ(parsed->source, "pjrt");
+  CHECK_EQ(parsed->labels.at("google.com/tpu.count"), "4");
+  CHECK_EQ(parsed->provenance.at("google.com/tpu.count").labeler, "tpu");
+  CHECK_TRUE(parsed->age_s == 12.5);
+
+  // Torn mid-write: payload shorter than the header promises.
+  std::string torn = framed.substr(0, framed.size() / 2);
+  Result<sched::PersistedState> bad = sched::ParseState(torn);
+  CHECK_TRUE(!bad.ok());
+  CHECK_TRUE(bad.error().find("torn or corrupt") != std::string::npos);
+  // Bit rot: same length, one flipped byte → checksum mismatch.
+  std::string rotten = framed;
+  rotten[framed.size() - 3] = rotten[framed.size() - 3] == 'x' ? 'y' : 'x';
+  bad = sched::ParseState(rotten);
+  CHECK_TRUE(!bad.ok());
+  CHECK_TRUE(bad.error().find("checksum") != std::string::npos);
+  // Not a state file at all.
+  CHECK_TRUE(!sched::ParseState("{}").ok());
+  CHECK_TRUE(!sched::ParseState("").ok());
+
+  // Save/Load through a real file, with every gate.
+  std::string dir = "/tmp/tfd-unit-state-" + std::to_string(getpid());
+  std::string path = dir + "/state";
+  CHECK_TRUE(sched::SaveState(path, state).ok());
+  // Happy path: age grows by the downtime (saved_at 1000, now 1060).
+  Result<sched::PersistedState> loaded =
+      sched::LoadState(path, "unit-node", 600, 1060.0);
+  CHECK_TRUE(loaded.ok());
+  CHECK_TRUE(loaded->age_s > 72.0 && loaded->age_s < 73.0);  // 12.5 + 60
+  // Foreign node: rejected by identity, not served.
+  bad = sched::LoadState(path, "other-node", 600, 1060.0);
+  CHECK_TRUE(!bad.ok());
+  CHECK_TRUE(bad.error().find("foreign") != std::string::npos);
+  // Stale: the facts expired while the daemon was down.
+  bad = sched::LoadState(path, "unit-node", 600, 1000.0 + 3600);
+  CHECK_TRUE(!bad.ok());
+  CHECK_TRUE(bad.error().find("expired") != std::string::npos);
+  // The injected torn write is exactly what the checksum gate catches.
+  CHECK_TRUE(fault::Arm("state.write:torn:count=1").ok());
+  CHECK_TRUE(sched::SaveState(path, state).ok());  // "succeeds"
+  fault::Disarm();
+  bad = sched::LoadState(path, "unit-node", 600, 1060.0);
+  CHECK_TRUE(!bad.ok());
+  CHECK_TRUE(bad.error().find("torn or corrupt") != std::string::npos);
+  std::string cmd = "rm -rf " + dir;
+  CHECK_TRUE(system(cmd.c_str()) == 0);
+}
+
+void TestRenameErrorDeviceIds() {
+  // rename(2) over an existing DIRECTORY fails (EISDIR): the error must
+  // carry both device ids — the one-line diagnosis for the cross-device
+  // hostPath misconfig (EXDEV shows the ids differing).
+  std::string dir = "/tmp/tfd-unit-rename-" + std::to_string(getpid());
+  std::string blocked = dir + "/blocked";
+  std::string cmd = "mkdir -p " + blocked;
+  CHECK_TRUE(system(cmd.c_str()) == 0);
+  int write_errno = 0;
+  Status s = WriteFileAtomically(blocked, "x=1\n", &write_errno);
+  CHECK_TRUE(!s.ok());
+  CHECK_EQ(write_errno, EISDIR);
+  CHECK_TRUE(s.message().find("src dev=") != std::string::npos);
+  CHECK_TRUE(s.message().find("dst dev=") != std::string::npos);
+  cmd = "rm -rf " + dir;
+  CHECK_TRUE(system(cmd.c_str()) == 0);
+}
+
+void TestHttpDeadlineBudget() {
+  // A dribbling server: one byte per 50ms, forever. Per-op socket
+  // timeouts never fire — only the whole-request deadline can end this.
+  int listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+  CHECK_TRUE(listen_fd >= 0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = 0;
+  CHECK_TRUE(bind(listen_fd, reinterpret_cast<sockaddr*>(&addr),
+                  sizeof(addr)) == 0);
+  CHECK_TRUE(listen(listen_fd, 1) == 0);
+  socklen_t len = sizeof(addr);
+  CHECK_TRUE(getsockname(listen_fd, reinterpret_cast<sockaddr*>(&addr),
+                         &len) == 0);
+  int port = ntohs(addr.sin_port);
+  std::thread server([listen_fd] {
+    int conn = accept(listen_fd, nullptr, nullptr);
+    if (conn < 0) return;
+    char buf[1024];
+    (void)recv(conn, buf, sizeof(buf), 0);  // swallow the request
+    const char* dribble = "HTTP/1.1 200 OK\r\nContent-Length: 10000\r\n\r\n";
+    for (const char* p = dribble; ; p++) {
+      char c = *p ? *p : 'x';  // headers, then filler forever
+      if (send(conn, &c, 1, MSG_NOSIGNAL) <= 0) break;
+      if (!*p) p--;  // stick on filler
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    }
+    close(conn);
+  });
+
+  http::RequestOptions options;
+  options.timeout_ms = 5000;   // per-op: never fires against a dribble
+  options.deadline_ms = 400;   // whole-request: must end it
+  auto t0 = std::chrono::steady_clock::now();
+  Result<http::Response> response = http::Request(
+      "GET", "http://127.0.0.1:" + std::to_string(port) + "/", "", options);
+  double elapsed_s = obs::SecondsSince(t0);
+  CHECK_TRUE(!response.ok());
+  CHECK_TRUE(response.error().find("deadline exceeded") !=
+             std::string::npos);
+  CHECK_TRUE(elapsed_s < 3.0);  // ended by the budget, not the dribble
+  close(listen_fd);
+  server.join();
+}
+
+void TestK8sFaultClassification() {
+  // Table-driven transient/permanent classification of the CR sink
+  // under injected transport and HTTP faults — the contract the daemon's
+  // survive-vs-exit choice and the breaker's trip decision ride on.
+  // TFD_APISERVER_URL points at a closed port so any request a fault
+  // does NOT intercept fails as a real transport error (also transient).
+  setenv("NODE_NAME", "unit-node", 1);
+  setenv("TFD_APISERVER_URL", "http://127.0.0.1:1", 1);
+  setenv("TFD_SERVICEACCOUNT_DIR", "/nonexistent-tfd-unit", 1);
+  Result<k8s::ClusterConfig> cluster = k8s::LoadInClusterConfig();
+  CHECK_TRUE(cluster.ok());
+  lm::Labels labels{{"google.com/tpu.count", "4"}};
+
+  struct Case {
+    const char* spec;        // injected fault schedule
+    bool expect_transient;   // retry (true) vs. give-up (false)
+    const char* expect_in_error;
+  };
+  const Case kCases[] = {
+      // Apiserver 5xx/429 storms: retry.
+      {"k8s.get:http=500", true, "HTTP 500"},
+      {"k8s.get:http=503", true, "HTTP 503"},
+      {"k8s.get:http=429", true, "HTTP 429"},
+      // Auth/permission rejections: give up (crash-loop visibly).
+      {"k8s.get:http=403", false, "HTTP 403"},
+      // Transport faults: connect timeout and mid-body reset — retry.
+      {"k8s.connect:errno=ETIMEDOUT", true, "Connection timed out"},
+      {"k8s.get:errno=ECONNRESET", true, "Connection reset"},
+      // A 429-then-500-then-503 sequence: each call classifies alike.
+      {"k8s.get:http=429:count=1,k8s.get:http=500:count=1,"
+       "k8s.get:http=503:count=1",
+       true, "HTTP 429"},
+      // Create-race conflicts forever: retries exhaust, still transient.
+      {"k8s.get:http=404:count=3,k8s.post:http=409:count=3", true,
+       "attempts exhausted"},
+  };
+  k8s::CircuitBreaker breaker(k8s::CircuitBreaker::Options{3, 60});
+  int transient_seen = 0;
+  for (const Case& c : kCases) {
+    CHECK_TRUE(fault::Arm(c.spec).ok());
+    bool transient = !c.expect_transient;  // must be overwritten
+    Status s = k8s::UpdateNodeFeature(*cluster, labels, &transient);
+    CHECK_TRUE(!s.ok());
+    CHECK_TRUE(transient == c.expect_transient);
+    CHECK_TRUE(s.message().find(c.expect_in_error) != std::string::npos);
+    // The classification drives the breaker: transient failures trip
+    // it, permanent ones never do.
+    if (transient) {
+      breaker.RecordTransientFailure();
+      transient_seen++;
+    }
+  }
+  // 3+ consecutive transients: breaker-open — the third outcome the
+  // table distinguishes (skip instantly, probe after cooldown).
+  CHECK_TRUE(transient_seen >= 3);
+  CHECK_TRUE(breaker.state() == k8s::CircuitBreaker::State::kOpen);
+  CHECK_TRUE(!breaker.Allow());
+  fault::Disarm();
+  unsetenv("NODE_NAME");
+  unsetenv("TFD_APISERVER_URL");
+  unsetenv("TFD_SERVICEACCOUNT_DIR");
+}
+
 }  // namespace
 }  // namespace tfd
 
@@ -1792,6 +2162,13 @@ int main(int argc, char** argv) {
   tfd::TestLabelKeyPrefix();
   tfd::TestLogFormatLine();
   tfd::TestDebugEndpoints();
+  tfd::TestFaultSpecParse();
+  tfd::TestFaultSinkFile();
+  tfd::TestCircuitBreaker();
+  tfd::TestStateRoundTrip();
+  tfd::TestRenameErrorDeviceIds();
+  tfd::TestHttpDeadlineBudget();
+  tfd::TestK8sFaultClassification();
 
   std::cerr << tfd::g_checks << " checks, " << tfd::g_failures << " failures"
             << std::endl;

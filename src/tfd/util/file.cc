@@ -26,8 +26,29 @@ Result<std::string> ReadFile(const std::string& path) {
   return buf.str();
 }
 
+namespace {
+
+// Device id of a path (or its parent dir when the path itself is
+// absent), for the cross-device rename diagnostic. -1: unknown.
+long long DeviceOf(const fs::path& path) {
+  struct stat st;
+  if (stat(path.c_str(), &st) == 0) return static_cast<long long>(st.st_dev);
+  fs::path dir = path.parent_path();
+  if (!dir.empty() && stat(dir.c_str(), &st) == 0) {
+    return static_cast<long long>(st.st_dev);
+  }
+  return -1;
+}
+
+}  // namespace
+
 Status WriteFileAtomically(const std::string& path,
-                           const std::string& contents) {
+                           const std::string& contents, int* errno_out) {
+  if (errno_out != nullptr) *errno_out = 0;
+  auto fail = [errno_out](int saved_errno, const std::string& message) {
+    if (errno_out != nullptr) *errno_out = saved_errno;
+    return Status::Error(message);
+  };
   fs::path dest(path);
   fs::path dir = dest.parent_path();
   if (dir.empty()) dir = ".";
@@ -36,8 +57,8 @@ Status WriteFileAtomically(const std::string& path,
   std::error_code ec;
   fs::create_directories(tmpdir, ec);
   if (ec) {
-    return Status::Error("unable to create scratch dir " + tmpdir.string() +
-                         ": " + ec.message());
+    return fail(ec.value(), "unable to create scratch dir " +
+                                tmpdir.string() + ": " + ec.message());
   }
 
   std::string tmpl = (tmpdir / (dest.filename().string() + ".XXXXXX")).string();
@@ -45,8 +66,8 @@ Status WriteFileAtomically(const std::string& path,
   std::string tmppath = tmpl;
   int fd = mkstemp(tmppath.data());
   if (fd < 0) {
-    return Status::Error("unable to create temp file " + tmpl + ": " +
-                         strerror(errno));
+    return fail(errno, "unable to create temp file " + tmpl + ": " +
+                           strerror(errno));
   }
 
   size_t off = 0;
@@ -54,10 +75,11 @@ Status WriteFileAtomically(const std::string& path,
     ssize_t n = write(fd, contents.data() + off, contents.size() - off);
     if (n < 0) {
       if (errno == EINTR) continue;
+      int saved = errno;
       close(fd);
       unlink(tmppath.c_str());
-      return Status::Error("write to " + tmppath + " failed: " +
-                           strerror(errno));
+      return fail(saved, "write to " + tmppath + " failed: " +
+                             strerror(saved));
     }
     off += static_cast<size_t>(n);
   }
@@ -65,16 +87,35 @@ Status WriteFileAtomically(const std::string& path,
   // reference's os.WriteFile(0644)-equivalent behavior.
   fchmod(fd, 0644);
   if (fsync(fd) != 0) {
+    int saved = errno;
     close(fd);
     unlink(tmppath.c_str());
-    return Status::Error("fsync " + tmppath + " failed: " + strerror(errno));
+    return fail(saved, "fsync " + tmppath + " failed: " + strerror(saved));
   }
   close(fd);
 
   if (rename(tmppath.c_str(), path.c_str()) != 0) {
+    int saved = errno;
     unlink(tmppath.c_str());
-    return Status::Error("rename " + tmppath + " -> " + path + " failed: " +
-                         strerror(errno));
+    // Both sides' device ids: EXDEV here is the classic hostPath
+    // misconfig (scratch dir and destination on different mounts), and
+    // the ids make that diagnosis one log line instead of a shell
+    // session on the node.
+    return fail(saved, "rename " + tmppath + " -> " + path + " failed: " +
+                           strerror(saved) + " (src dev=" +
+                           std::to_string(DeviceOf(tmppath)) + ", dst dev=" +
+                           std::to_string(DeviceOf(dest)) + ")");
+  }
+
+  // Durability of the rename itself: fsync the destination directory,
+  // or a power cut can roll back to the old directory entry after the
+  // daemon reported success. Directories that cannot be opened/fsynced
+  // (exotic filesystems return EINVAL) degrade to the pre-fsync
+  // behavior rather than failing a write that DID land.
+  int dirfd = open(dir.c_str(), O_RDONLY | O_DIRECTORY);
+  if (dirfd >= 0) {
+    fsync(dirfd);
+    close(dirfd);
   }
   return Status::Ok();
 }

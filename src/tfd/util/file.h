@@ -16,10 +16,17 @@ namespace tfd {
 Result<std::string> ReadFile(const std::string& path);
 
 // Writes `contents` to `path` atomically: write to
-// <dir>/tfd-tmp/<base>.XXXXXX, fsync, then rename over `path`.
+// <dir>/tfd-tmp/<base>.XXXXXX, fsync, rename over `path`, then fsync
+// the destination DIRECTORY — without the directory fsync the rename
+// itself can be lost on power failure and a reader later sees the old
+// (or no) file where the daemon believes it published labels.
 // Creates parent directories of the scratch dir as needed.
+// On failure `*errno_out` (when non-null) carries the failing
+// syscall's errno (0 for non-errno failures), so callers can classify
+// transient (ENOSPC, EIO) vs. misconfiguration (EACCES, EXDEV).
 Status WriteFileAtomically(const std::string& path,
-                           const std::string& contents);
+                           const std::string& contents,
+                           int* errno_out = nullptr);
 
 // Removes a file if it exists (used for clean-exit label removal,
 // reference cmd/gpu-feature-discovery/main.go:220-240).

@@ -9,6 +9,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <chrono>
 #include <memory>
 #include <mutex>
 #include <type_traits>
@@ -417,7 +418,21 @@ Result<Response> Request(const std::string& method, const std::string& url,
   Result<Url> parsed = ParseUrl(url);
   if (!parsed.ok()) return Result<Response>::Error(parsed.error());
 
-  Result<int> fd = Connect(*parsed, options.timeout_ms);
+  // Deadline budget: per-op socket timeouts bound each stall, the
+  // deadline bounds their sum. Ops are admitted while budget remains,
+  // so the worst-case overshoot is one timeout_ms.
+  auto t0 = std::chrono::steady_clock::now();
+  auto over_deadline = [&options, t0] {
+    if (options.deadline_ms <= 0) return false;
+    return std::chrono::steady_clock::now() - t0 >=
+           std::chrono::milliseconds(options.deadline_ms);
+  };
+  int connect_timeout_ms = options.timeout_ms;
+  if (options.deadline_ms > 0 && options.deadline_ms < connect_timeout_ms) {
+    connect_timeout_ms = options.deadline_ms;
+  }
+
+  Result<int> fd = Connect(*parsed, connect_timeout_ms);
   if (!fd.ok()) return Result<Response>::Error(fd.error());
   // The accepted connection proves a live endpoint; everything after this
   // point (TLS handshake, garbage, close-without-a-byte) is the server
@@ -426,6 +441,32 @@ Result<Response> Request(const std::string& method, const std::string& url,
 
   std::unique_ptr<Transport> transport;
   if (parsed->tls) {
+    // Re-tighten the per-op socket timeouts to the REMAINING budget
+    // before the handshake: SSL_connect's internal reads/writes are
+    // each bounded by these, so the handshake cannot take a full
+    // timeout_ms per op on top of an almost-spent deadline. (Each
+    // handshake op is still only per-op bounded — a deliberately
+    // dribbling peer can stretch the handshake itself; the budget
+    // check resumes the moment the handshake returns.)
+    if (options.deadline_ms > 0) {
+      auto spent = std::chrono::duration_cast<std::chrono::milliseconds>(
+                       std::chrono::steady_clock::now() - t0)
+                       .count();
+      long remaining = options.deadline_ms - static_cast<long>(spent);
+      if (remaining <= 0) {
+        close(*fd);
+        return Result<Response>::Error(
+            "request deadline exceeded after " +
+            std::to_string(options.deadline_ms) + "ms (connecting)");
+      }
+      if (remaining < connect_timeout_ms) {
+        timeval tv{};
+        tv.tv_sec = remaining / 1000;
+        tv.tv_usec = (remaining % 1000) * 1000;
+        setsockopt(*fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+        setsockopt(*fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+      }
+    }
     Result<std::unique_ptr<Transport>> tls =
         TlsTransport::Create(*fd, *parsed, options);
     if (!tls.ok()) return Result<Response>::Error(tls.error());
@@ -454,6 +495,11 @@ Result<Response> Request(const std::string& method, const std::string& url,
 
   size_t off = 0;
   while (off < request.size()) {
+    if (over_deadline()) {
+      return Result<Response>::Error(
+          "request deadline exceeded after " +
+          std::to_string(options.deadline_ms) + "ms (sending)");
+    }
     Result<int> n = transport->Write(request.data() + off,
                                      static_cast<int>(request.size() - off));
     if (!n.ok()) return Result<Response>::Error("send failed: " + n.error());
@@ -463,6 +509,11 @@ Result<Response> Request(const std::string& method, const std::string& url,
   std::string raw;
   char buf[8192];
   while (true) {
+    if (over_deadline()) {
+      return Result<Response>::Error(
+          "request deadline exceeded after " +
+          std::to_string(options.deadline_ms) + "ms (receiving)");
+    }
     Result<int> n = transport->Read(buf, sizeof(buf));
     if (!n.ok()) return Result<Response>::Error("recv failed: " + n.error());
     if (*n == 0) break;

@@ -38,6 +38,15 @@ struct RequestOptions {
   std::string ca_file;      // PEM bundle for server verification (https)
   bool insecure = false;    // skip server verification (tests only)
   int timeout_ms = 5000;    // per socket operation
+  // Total wall-clock budget for the WHOLE request (resolve + connect +
+  // TLS + send + receive). timeout_ms bounds each socket stall; this
+  // bounds their sum, so a peer dribbling one byte per timeout window
+  // cannot stretch the body transfer indefinitely. Checked between
+  // operations — worst-case overshoot is one timeout_ms. The TLS
+  // handshake runs with its per-op timeouts tightened to the remaining
+  // budget but is not interruptible mid-op, so a hostile peer can
+  // still dribble the handshake itself past the budget. 0 disables.
+  int deadline_ms = 0;
   // When set, *server_reached is written on every outcome: true once the
   // TCP connection is established — something is listening, even if it
   // then speaks garbage, closes without a byte, fails the TLS handshake,

@@ -1,0 +1,285 @@
+"""Tier 2/3: the robustness layer (ISSUE 4) against the real binary —
+fault injection, crash-safe warm restart, and the sink circuit breaker.
+
+The contracts under test:
+  - a kill -9'd daemon restarted with --state-file serves CACHED-TIER
+    labels (the device source's label set, degraded + true snapshot
+    age) on its first rewrite, in <100ms of pass time, journaled end to
+    end — and KEEPS serving them while probes are still wedged;
+  - corrupt / torn / foreign-node state files are rejected (journaled,
+    counted), never parsed into labels;
+  - a flapping apiserver trips the CR sink's circuit breaker open
+    (writes skip instantly, cadence holds) and a recovered apiserver
+    closes it again, with every transition journaled and gauged;
+  - --fault-spec grammar errors are a startup error, not a silent arm;
+  - a SIGHUP reload that fails (injected config.load fault) keeps the
+    previous configuration running instead of killing the daemon.
+"""
+
+import json
+import os
+import signal
+import subprocess
+
+from conftest import FIXTURES, http_get, labels_of, wait_for
+from tpufd import journal as tpufd_journal
+from tpufd.fakes import free_loopback_port as free_port
+from tpufd.fakes.apiserver import FakeApiServer
+
+
+def journal_events(port):
+    status, body = http_get(port, "/debug/journal")
+    if status != 200:
+        return []
+    try:
+        return tpufd_journal.parse_journal(json.loads(body))["events"]
+    except (ValueError, KeyError):
+        return []
+
+
+def events_of(port, event_type):
+    return tpufd_journal.events_of_type(journal_events(port), event_type)
+
+
+def read_labels(out_file):
+    try:
+        return labels_of(out_file.read_text())
+    except (OSError, ValueError):
+        return {}
+
+
+def state_argv(binary, port, out_file, state_file, extra=()):
+    return [str(binary), "--sleep-interval=1s", "--backend=mock",
+            f"--mock-topology-file={FIXTURES / 'v2-8.yaml'}",
+            "--machine-type-file=/dev/null",
+            f"--output-file={out_file}",
+            f"--state-file={state_file}",
+            f"--introspection-addr=127.0.0.1:{port}", *extra]
+
+
+def launch(argv, env_extra=None):
+    env = {**os.environ, "GCE_METADATA_HOST": "127.0.0.1:1",
+           **(env_extra or {})}
+    return subprocess.Popen(argv, env=env, stderr=subprocess.DEVNULL)
+
+
+class TestWarmRestart:
+    def test_kill9_restart_serves_cached_tier_in_under_100ms(
+            self, tfd_binary, tmp_path):
+        """The ISSUE 4 acceptance: kill -9 mid-soak, restart, and the
+        FIRST rewrite serves cached-tier (not metadata-only/minimal)
+        labels in <100ms with the true snapshot age — journaled end to
+        end. The restart wedges the probe for 10s so only the restored
+        state can be serving."""
+        out_file = tmp_path / "tfd"
+        state_file = tmp_path / "state"
+        port = free_port()
+        argv = state_argv(tfd_binary, port, out_file, state_file)
+        proc = launch(argv)
+        try:
+            assert wait_for(lambda: state_file.exists(), timeout=15)
+            baseline = read_labels(out_file)
+            assert baseline["google.com/tpu.backend"] == "mock"
+        finally:
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=10)
+        assert out_file.exists(), "SIGKILL must not remove the label file"
+
+        # Restart with the device probe wedged well past the test: every
+        # label below can only have come from the persisted state.
+        proc = launch(argv + ["--fault-spec=probe.mock:hang=10s"])
+        try:
+            assert wait_for(lambda: events_of(port, "warm-restart"),
+                            timeout=10)
+            warm = events_of(port, "warm-restart")[0]["fields"]
+            assert warm["ok"] == "true"
+            assert int(warm["duration_ms"]) < 100, (
+                f"warm pass took {warm['duration_ms']}ms")
+            assert int(warm["labels"]) >= len(baseline)
+            assert warm["source"] == "mock"
+
+            labels = read_labels(out_file)
+            # Cached-tier: the device source's label set, not the
+            # metadata-only or minimal rung...
+            assert labels["google.com/tpu.backend"] == "mock"
+            assert labels["google.com/tpu.count"] == "4"
+            # ...honestly marked stale, with a true (small) age.
+            assert labels["google.com/tpu.degraded"] == "true"
+            assert int(labels["google.com/tpu.snapshot-age-seconds"]) < 120
+
+            # Journaled end to end: the label diff of the warm pass
+            # carries warm-restart provenance for the degraded marker.
+            diffs = events_of(port, "label-diff")
+            marker = [e for e in diffs
+                      if e["fields"].get("key") == "google.com/tpu.degraded"]
+            assert marker and marker[0]["fields"]["labeler"] == (
+                "warm-restart")
+
+            # While the probe stays wedged, later passes keep re-serving
+            # the restored facts (the restored rung) instead of
+            # downgrading to minimal labels.
+            assert wait_for(lambda: events_of(port, "restored-serve"),
+                            timeout=10)
+            assert read_labels(out_file)["google.com/tpu.backend"] == "mock"
+        finally:
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=10)
+
+    def test_restart_converges_and_supersedes_restored_state(
+            self, tfd_binary, tmp_path):
+        """Once the real probe lands, the restored rung is dropped
+        (journaled) and the degraded markers disappear."""
+        out_file = tmp_path / "tfd"
+        state_file = tmp_path / "state"
+        port = free_port()
+        argv = state_argv(tfd_binary, port, out_file, state_file)
+        proc = launch(argv)
+        try:
+            assert wait_for(lambda: state_file.exists(), timeout=15)
+        finally:
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=10)
+        proc = launch(argv + ["--fault-spec=probe.mock:hang=2s:count=1"])
+        try:
+            assert wait_for(lambda: events_of(port, "warm-restart"),
+                            timeout=10)
+            assert wait_for(lambda: events_of(port, "state-superseded"),
+                            timeout=15)
+            assert wait_for(
+                lambda: "google.com/tpu.degraded" not in
+                read_labels(out_file) and read_labels(out_file).get(
+                    "google.com/tpu.backend") == "mock",
+                timeout=10)
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+    def test_foreign_node_state_is_rejected(self, tfd_binary, tmp_path):
+        """A state file written under one node identity must never be
+        served under another (the reattached-volume hazard)."""
+        out_file = tmp_path / "tfd"
+        state_file = tmp_path / "state"
+        port = free_port()
+        argv = state_argv(tfd_binary, port, out_file, state_file)
+        proc = launch(argv, {"NODE_NAME": "node-a"})
+        try:
+            assert wait_for(lambda: state_file.exists(), timeout=15)
+        finally:
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=10)
+        proc = launch(argv, {"NODE_NAME": "node-b"})
+        try:
+            assert wait_for(lambda: events_of(port, "state-rejected"),
+                            timeout=10)
+            rejected = events_of(port, "state-rejected")[0]["fields"]
+            assert "foreign" in rejected["error"]
+            assert not events_of(port, "warm-restart")
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+
+class TestSinkBreaker:
+    def test_apiserver_outage_opens_breaker_and_recovery_closes_it(
+            self, tfd_binary, tmp_path):
+        """A REAL fake-apiserver 500 outage (no fault injection): the
+        breaker opens after the configured failures — writes skip, the
+        cadence holds — and closes again once the outage ends, with
+        transitions journaled and the gauge tracking the state."""
+        port = free_port()
+        sa = tmp_path / "sa"
+        sa.mkdir()
+        (sa / "namespace").write_text("node-feature-discovery\n")
+        (sa / "token").write_text("breaker-token\n")
+
+        def gauge():
+            status, body = http_get(port, "/metrics")
+            if status != 200:
+                return None
+            from tpufd import metrics
+            try:
+                return metrics.sample_value(body, "tfd_sink_breaker_state")
+            except ValueError:
+                return None
+
+        def rewrites():
+            status, body = http_get(port, "/metrics")
+            if status != 200:
+                return 0
+            from tpufd import metrics
+            try:
+                return metrics.sample_value(body, "tfd_rewrites_total")
+            except ValueError:
+                return 0
+
+        with FakeApiServer(token="breaker-token") as server:
+            proc = launch(
+                [str(tfd_binary), "--sleep-interval=1s", "--backend=mock",
+                 f"--mock-topology-file={FIXTURES / 'v2-8.yaml'}",
+                 "--machine-type-file=/dev/null", "--use-node-feature-api",
+                 "--output-file=", "--sink-breaker-failures=2",
+                 "--sink-breaker-cooldown=2s",
+                 f"--introspection-addr=127.0.0.1:{port}"],
+                {"NODE_NAME": "breaker-node",
+                 "TFD_APISERVER_URL": server.url,
+                 "TFD_SERVICEACCOUNT_DIR": str(sa)})
+            try:
+                assert wait_for(lambda: rewrites() >= 2, timeout=15)
+                assert gauge() == 0
+
+                server.set_failing(500)
+                assert wait_for(lambda: gauge() == 2, timeout=15), (
+                    "breaker never opened under the 500 outage")
+                # Cadence holds while open: skips are instant.
+                before = rewrites()
+                assert wait_for(lambda: rewrites() >= before + 2,
+                                timeout=10)
+
+                server.set_failing(0)
+                assert wait_for(lambda: gauge() == 0, timeout=20), (
+                    "breaker never closed after the outage ended")
+                assert wait_for(
+                    lambda: http_get(port, "/readyz")[0] == 200,
+                    timeout=10)
+                transitions = tpufd_journal.breaker_transitions(
+                    journal_events(port))
+                assert ("closed", "open") in transitions
+                assert ("half-open", "closed") in transitions
+            finally:
+                proc.terminate()
+                proc.wait(timeout=10)
+
+
+class TestFaultSpec:
+    def test_bad_fault_spec_is_a_startup_error(self, tfd_binary):
+        proc = subprocess.run(
+            [str(tfd_binary), "--oneshot", "--fault-spec=sink.file"],
+            capture_output=True, text=True, timeout=30)
+        assert proc.returncode != 0
+        assert "fault" in proc.stderr.lower()
+
+    def test_reload_failure_keeps_previous_config(self, tfd_binary,
+                                                  tmp_path):
+        """An injected config.load fault makes the SIGHUP reload fail:
+        the daemon must keep the previous configuration running (and
+        say so in the journal), not exit."""
+        out_file = tmp_path / "tfd"
+        port = free_port()
+        proc = launch(state_argv(tfd_binary, port, out_file,
+                                 tmp_path / "state",
+                                 ["--fault-spec=config.load:fail:count=1"]))
+        try:
+            assert wait_for(lambda: out_file.exists(), timeout=15)
+            proc.send_signal(signal.SIGHUP)
+            assert wait_for(lambda: events_of(port, "config-load-failed"),
+                            timeout=15)
+            assert proc.poll() is None, "reload failure killed the daemon"
+            # Still labeling on the previous config.
+            mtime = out_file.stat().st_mtime
+            assert wait_for(
+                lambda: out_file.exists() and
+                out_file.stat().st_mtime > mtime, timeout=10), (
+                "no rewrite after the failed reload")
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)

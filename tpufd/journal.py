@@ -102,6 +102,21 @@ def degradation_transitions(events):
             for e in events_of_type(events, "degradation")]
 
 
+def fault_injections(events):
+    """[(point, action)] from the journal's fault-injected events, seq
+    order — the chaos soak's proof of which armed faults actually
+    fired."""
+    return [(e["fields"].get("point"), e["fields"].get("action"))
+            for e in events_of_type(events, "fault-injected")]
+
+
+def breaker_transitions(events):
+    """[(from, to)] from the sink circuit breaker's transition events,
+    seq order (closed -> open -> half-open -> ...)."""
+    return [(e["fields"].get("from"), e["fields"].get("to"))
+            for e in events_of_type(events, "breaker-transition")]
+
+
 def labels_file_text(debug_labels):
     """Renders a /debug/labels document exactly as lm::FormatLabels
     writes the feature file (sorted ``key=value`` lines) — the two must

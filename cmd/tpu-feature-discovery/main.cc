@@ -30,9 +30,11 @@
 #include "tfd/config/config.h"
 #include "tfd/fault/fault.h"
 #include "tfd/gce/metadata.h"
+#include "tfd/healthsm/healthsm.h"
 #include "tfd/info/version.h"
 #include "tfd/k8s/breaker.h"
 #include "tfd/k8s/client.h"
+#include "tfd/lm/governor.h"
 #include "tfd/lm/labeler.h"
 #include "tfd/lm/labels.h"
 #include "tfd/lm/machine_type.h"
@@ -53,6 +55,8 @@
 #include "tfd/util/file.h"
 #include "tfd/util/jsonlite.h"
 #include "tfd/util/logging.h"
+#include "tfd/util/strings.h"
+#include "tfd/util/time.h"
 
 namespace tfd {
 namespace {
@@ -70,11 +74,6 @@ constexpr std::chrono::milliseconds kFirstPassSettleWait{500};
 // All instruments live in obs::Default() so counters stay monotone across
 // SIGHUP reloads; the introspection server (re)binds per config load.
 
-double WallClockSeconds() {
-  return std::chrono::duration<double>(
-             std::chrono::system_clock::now().time_since_epoch())
-      .count();
-}
 
 // One rewrite attempt settled: counters, freshness gauge, /readyz state.
 // `ok` means labels actually landed in the sink — a transient NodeFeature
@@ -155,6 +154,12 @@ struct LabelState {
   lm::Labels labels;
   lm::Provenance provenance;
   int last_level = -1;  // degradation rung of the previous pass
+  // Rung of the last pass whose labels actually LANDED in the sink.
+  // The governor's level-improved bypass compares against this, not
+  // last_level: a transient sink failure on the improving pass must not
+  // burn the bypass — the retry is still publishing the improvement
+  // (the same reason its hold-down timers commit only on publish).
+  int last_published_level = -1;
   // Warm-restart cache (sched/state.h): the restored persisted state,
   // served as a rung between "fallback source" and "minimal" — any pass
   // where NO snapshot can serve (probes wedged/failing after a restart)
@@ -310,6 +315,103 @@ Status DispatchSink(const config::Config& config, const lm::Labels& labels,
   return Status::Ok();
 }
 
+// Quarantine hold + anti-flap governance, applied to the merged set
+// right before the sink (healthsm/ + lm/governor.h):
+//   1. every key owned by a quarantined source/chip holds its last
+//      PUBLISHED value (or stays absent) — quarantined facts are
+//      untrusted until recovery is earned — and the set is annotated
+//      google.com/tpu.health.quarantined=true;
+//   2. the governor's per-key hold-down + churn budget suppress any
+//      remaining non-monotone flips, reported in `*suppressed` (the
+//      caller's published-level bookkeeping needs to know whether the
+//      pass landed verbatim, and journals/counts them only once the
+//      sink write lands — like the governor's own deferred commit, a
+//      transiently failing sink must not re-record the same flip on
+//      every retry pass).
+void HoldQuarantinedAndGovern(const LabelState& prev, bool level_improved,
+                              lm::LabelGovernor* governor,
+                              lm::Labels* merged, lm::Provenance* provenance,
+                              std::vector<lm::SuppressedFlip>* suppressed) {
+  healthsm::HealthTracker& tracker = healthsm::Default();
+  double now = WallClockSeconds();
+  std::vector<std::string> quarantined = tracker.QuarantinedKeys(now);
+  for (const std::string& q : quarantined) {
+    // Chip keys ("health/chip-<i>") own the matching device label
+    // lines; source keys own every label whose provenance names them.
+    std::string chip_prefix;
+    constexpr char kChipKeyPrefix[] = "health/chip-";
+    if (q.rfind(kChipKeyPrefix, 0) == 0) {
+      chip_prefix = std::string(lm::kHealthDevicePrefix) +
+                    q.substr(sizeof(kChipKeyPrefix) - 1) + "-";
+    }
+    auto owned = [&](const std::string& key, const lm::Provenance& prov) {
+      if (!chip_prefix.empty()) return key.rfind(chip_prefix, 0) == 0;
+      auto it = prov.find(key);
+      return it != prov.end() && it->second.source == q;
+    };
+    std::vector<std::string> keys;
+    for (const auto& [key, value] : *merged) {
+      (void)value;
+      if (owned(key, *provenance)) keys.push_back(key);
+    }
+    for (const auto& [key, value] : prev.labels) {
+      (void)value;
+      if (merged->count(key) == 0 && owned(key, prev.provenance)) {
+        keys.push_back(key);
+      }
+    }
+    for (const std::string& key : keys) {
+      auto it = prev.labels.find(key);
+      if (it != prev.labels.end()) {
+        (*merged)[key] = it->second;
+        auto from = prev.provenance.find(key);
+        if (from != prev.provenance.end()) {
+          (*provenance)[key] = from->second;
+        }
+      } else {
+        merged->erase(key);
+        provenance->erase(key);
+      }
+    }
+  }
+  if (!quarantined.empty()) {
+    (*merged)[lm::kHealthQuarantined] = "true";
+    lm::LabelProvenance marker;
+    marker.labeler = "healthsm";
+    marker.source = JoinStrings(quarantined, ",");
+    marker.tier = "quarantined";
+    (*provenance)[lm::kHealthQuarantined] = marker;
+  }
+
+  governor->Apply(prev.labels, prev.provenance, level_improved, now, merged,
+                  provenance, suppressed);
+}
+
+// The observability half of a suppressed flip, recorded only after the
+// pass's sink write landed (see HoldQuarantinedAndGovern).
+void RecordSuppressedFlips(
+    const std::vector<lm::SuppressedFlip>& suppressed) {
+  obs::Registry& reg = obs::Default();
+  for (const lm::SuppressedFlip& flip : suppressed) {
+    reg.GetCounter("tfd_label_flaps_suppressed_total",
+                   "Label flips suppressed by the anti-flap governor "
+                   "(hold-down / churn budget), by bounded key prefix.",
+                   {{"key_prefix", lm::LabelKeyPrefix(flip.key)}})
+        ->Inc();
+    obs::DefaultJournal().Record(
+        "flap-suppressed", flip.provenance.source,
+        "suppressed " + flip.op + " " + flip.key + " (" + flip.reason + ")",
+        {{"key", flip.key},
+         {"op", flip.op},
+         {"old", flip.old_value},
+         {"new", flip.new_value},
+         {"reason", flip.reason},
+         {"labeler", flip.provenance.labeler},
+         {"source", flip.provenance.source},
+         {"tier", flip.provenance.tier}});
+  }
+}
+
 // One labeling pass: render labelers against the decided snapshot,
 // merge, write. `*wrote_ok` reports whether labels actually landed in
 // the sink — false on every error path, including the transient
@@ -321,7 +423,9 @@ Status LabelOnceInner(
     const config::Config& config, lm::Labeler& timestamp,
     lm::Labeler& machine_type, lm::Labeler& tpu_vm,
     const sched::SnapshotStore& store, const ServeDecision& decision,
-    k8s::CircuitBreaker* breaker, size_t* labels_emitted, bool* wrote_ok,
+    k8s::CircuitBreaker* breaker, const LabelState& prev,
+    bool level_improved, lm::LabelGovernor* governor,
+    size_t* labels_emitted, bool* wrote_ok, size_t* suppressed_flips,
     lm::Labels* merged_out, lm::Provenance* provenance_out,
     std::vector<std::pair<std::string, std::string>>* span_fields) {
   if (decision.fatal) {
@@ -412,6 +516,13 @@ Status LabelOnceInner(
     provenance[lm::kSnapshotAge] = from;
   }
 
+  // Anti-flap layer: quarantined sources hold last-good facts, and the
+  // governor debounces whatever still wants to flip.
+  std::vector<lm::SuppressedFlip> suppressed;
+  HoldQuarantinedAndGovern(prev, level_improved, governor, &merged,
+                           &provenance, &suppressed);
+  *suppressed_flips = suppressed.size();
+
   if (merged.size() <= 1) {
     TFD_LOG_WARNING << "only " << merged.size()
                     << " label(s) generated; is this a TPU node?";
@@ -422,6 +533,8 @@ Status LabelOnceInner(
   Status out = DispatchSink(config, merged, breaker, wrote_ok);
   if (!out.ok()) return out;
   if (!*wrote_ok) return Status::Ok();  // survived transient sink failure
+  governor->CommitPublished();
+  RecordSuppressedFlips(suppressed);
 
   *labels_emitted = merged.size();
   *merged_out = std::move(merged);
@@ -542,6 +655,10 @@ void SaveStateAfterRewrite(const config::Config& config,
   state.age_s = decision.age_s < 0 ? 0 : decision.age_s;
   state.labels = labels;
   state.provenance = provenance;
+  // Quarantine state rides along: a kill -9 must not launder a
+  // flapping source back to trusted.
+  state.healthsm_json =
+      healthsm::Default().SerializeJson(WallClockSeconds());
   Status s = sched::SaveState(config.flags.state_file, state);
   if (!s.ok()) {
     TFD_LOG_WARNING << "state save failed (warm restart unavailable): "
@@ -557,10 +674,18 @@ Status LabelOnce(const config::Config& config, lm::Labeler& timestamp,
                  lm::Labeler& machine_type, lm::Labeler& tpu_vm,
                  const sched::SnapshotStore& store,
                  obs::IntrospectionServer* server,
-                 k8s::CircuitBreaker* breaker, LabelState* state) {
+                 k8s::CircuitBreaker* breaker,
+                 lm::LabelGovernor* governor, LabelState* state) {
   auto t0 = std::chrono::steady_clock::now();
   uint64_t generation = obs::DefaultJournal().BeginRewrite();
   ServeDecision decision = Decide(store, config.flags);
+  // A pass whose serving rung IMPROVED (metadata -> pjrt convergence,
+  // restored -> live) carries monotone-informative changes the
+  // governor must not damp. Compared against the last PUBLISHED rung:
+  // if the improving pass's sink write fails transiently, every retry
+  // until one lands is still the same improvement.
+  bool level_improved = state->last_published_level < 0 ||
+                        decision.level < state->last_published_level;
 
   // Scheduler telemetry: the per-source snapshot ages and the ladder
   // rung this pass served from.
@@ -584,15 +709,27 @@ Status LabelOnce(const config::Config& config, lm::Labeler& timestamp,
 
   size_t labels_emitted = 0;
   bool wrote_ok = false;
+  size_t suppressed_flips = 0;
   lm::Labels merged;
   lm::Provenance provenance;
   std::vector<std::pair<std::string, std::string>> span_fields;
   Status s = LabelOnceInner(config, timestamp, machine_type, tpu_vm, store,
-                            decision, breaker, &labels_emitted, &wrote_ok,
-                            &merged, &provenance, &span_fields);
+                            decision, breaker, *state, level_improved,
+                            governor, &labels_emitted, &wrote_ok,
+                            &suppressed_flips, &merged, &provenance,
+                            &span_fields);
   double seconds = obs::SecondsSince(t0);
   RecordRewriteOutcome(wrote_ok, labels_emitted, seconds, server);
   if (wrote_ok) {
+    // The published-level bookkeeping may only advance when this pass
+    // landed verbatim: if the governor suppressed flips, the sink still
+    // shows (some of) the previous rung's facts, and recording the new
+    // rung anyway would let the next pass claim a bogus "improvement"
+    // and bypass the hold-down — re-opening the churn this layer exists
+    // to stop.
+    if (suppressed_flips == 0) {
+      state->last_published_level = decision.level;
+    }
     RecordLabelDiff(merged, provenance, state);
     if (server != nullptr) {
       server->SetLabelsJson(LabelsDebugJson(generation, merged, provenance));
@@ -719,7 +856,8 @@ Status ServeRestored(const config::Config& config,
                      const sched::PersistedState& restored, double age_s,
                      double downtime_s, const char* event_type,
                      obs::IntrospectionServer* server,
-                     k8s::CircuitBreaker* breaker, LabelState* state) {
+                     k8s::CircuitBreaker* breaker,
+                     lm::LabelGovernor* governor, LabelState* state) {
   auto t0 = std::chrono::steady_clock::now();
   uint64_t generation = obs::DefaultJournal().BeginRewrite();
   lm::Labels labels = restored.labels;
@@ -755,6 +893,10 @@ Status ServeRestored(const config::Config& config,
   RecordLadderLevel(1, restored.source, "stale-usable",
                     " serving restored state", state);
   if (wrote_ok) {
+    state->last_published_level = 1;
+    // The governor never saw this publish (it bypasses the merge):
+    // seed its history so the restored keys carry hold-down timers.
+    governor->NotePublished(labels, WallClockSeconds());
     RecordLabelDiff(labels, provenance, state);
     if (server != nullptr) {
       server->SetLabelsJson(LabelsDebugJson(generation, labels, provenance));
@@ -789,7 +931,8 @@ Status ServeRestored(const config::Config& config,
 
 RunOutcome Run(const config::Config& config, const sigset_t& sigmask,
                obs::IntrospectionServer* server,
-               k8s::CircuitBreaker* breaker, LabelState* state) {
+               k8s::CircuitBreaker* breaker,
+               lm::LabelGovernor* governor, LabelState* state) {
   lm::LabelerPtr timestamp = lm::NewTimestampLabeler(config);
   lm::LabelerPtr machine_type = lm::NewMachineTypeLabeler(
       config.flags.machine_type_file, MakeMachineTypeGetter(config));
@@ -841,14 +984,14 @@ RunOutcome Run(const config::Config& config, const sigset_t& sigmask,
           // pod alive-and-warning for the whole restored window.
           s = ServeRestored(config, *state->restored, age_s,
                             state->restored_downtime_s, "restored-serve",
-                            server, breaker, state);
+                            server, breaker, governor, state);
           served_restored = true;
         }
       }
     }
     if (!served_restored) {
       s = LabelOnce(config, *timestamp, *machine_type, *tpu_vm, *store,
-                    server, breaker, state);
+                    server, breaker, governor, state);
     }
     if (!s.ok()) {
       TFD_LOG_ERROR << s.message();
@@ -916,6 +1059,29 @@ RunOutcome Run(const config::Config& config, const sigset_t& sigmask,
   }
 }
 
+// Restores persisted healthsm state so quarantines survive a crash;
+// `origin` distinguishes the warm-restart payload from the stale-file
+// one in the journal line (e.g. " from stale state file"). A failed
+// restore starts from healthy, loudly.
+void RestoreHealthState(const std::string& json, double now_wall,
+                        const std::string& origin) {
+  if (json.empty()) return;
+  Status restore = healthsm::Default().RestoreJson(json, now_wall);
+  if (!restore.ok()) {
+    TFD_LOG_WARNING << "health state restore failed (starting from "
+                       "healthy): "
+                    << restore.message();
+    return;
+  }
+  std::vector<std::string> quarantined =
+      healthsm::Default().QuarantinedKeys(now_wall);
+  obs::DefaultJournal().Record(
+      "health-restored", "",
+      "health state restored" + origin + ": " +
+          std::to_string(quarantined.size()) + " key(s) still quarantined",
+      {{"quarantined", JoinStrings(quarantined, ",")}});
+}
+
 int Main(int argc, char** argv) {
   // Ignore SIGPIPE process-wide, explicitly at startup: the HTTP client
   // needs it (SSL_write cannot carry MSG_NOSIGNAL) and would otherwise
@@ -960,6 +1126,9 @@ int Main(int argc, char** argv) {
   // state is served exactly once per process.
   LabelState label_state;
   k8s::CircuitBreaker sink_breaker;
+  // The anti-flap governor's hold-down history also survives reloads:
+  // a SIGHUP must not grant every key a free flip.
+  lm::LabelGovernor label_governor;
   bool warm_restart_done = false;
   config::LoadResult last_good;
   std::string armed_fault_spec;
@@ -1018,6 +1187,25 @@ int Main(int argc, char** argv) {
     sink_breaker.Configure(
         {loaded.config.flags.sink_breaker_failures,
          static_cast<double>(loaded.config.flags.sink_breaker_cooldown_s)});
+    // Anti-flap thresholds (healthsm/ + lm/governor): reconfigured per
+    // load, state preserved — the silicon's health did not change
+    // because our config did.
+    {
+      healthsm::Policy health_policy;
+      health_policy.flap_window_s =
+          loaded.config.flags.health_flap_window_s;
+      health_policy.flap_threshold =
+          loaded.config.flags.health_flap_threshold;
+      health_policy.quarantine_cooldown_s =
+          loaded.config.flags.quarantine_cooldown_s;
+      healthsm::Default().Configure(health_policy);
+      lm::GovernorPolicy governor_policy;
+      governor_policy.hold_down_s =
+          loaded.config.flags.health_flap_window_s;
+      governor_policy.churn_budget =
+          loaded.config.flags.health_flap_threshold;
+      label_governor.Configure(governor_policy);
+    }
     TFD_LOG_INFO << "tpu-feature-discovery " << info::VersionString();
     TFD_LOG_INFO << "running with config: " << config::ToJson(loaded.config);
 
@@ -1093,9 +1281,10 @@ int Main(int argc, char** argv) {
       double max_age_s = flags.snapshot_usable_for_s > 0
                              ? flags.snapshot_usable_for_s
                              : 10.0 * flags.sleep_interval_s;
+      std::string stale_healthsm_json;
       Result<sched::PersistedState> restored = sched::LoadState(
           flags.state_file, sched::NodeIdentity(), max_age_s,
-          WallClockSeconds());
+          WallClockSeconds(), &stale_healthsm_json);
       if (restored.ok()) {
         double now_wall = WallClockSeconds();
         double downtime_s = now_wall - restored->saved_at;
@@ -1113,9 +1302,13 @@ int Main(int argc, char** argv) {
         label_state.restored_until_wall =
             now_wall + (max_age_s - restored->age_s);
         label_state.restored_downtime_s = downtime_s;
+        // Quarantine state first: the warm pass must already hold a
+        // flapping source's keys and keep its annotation — a crash
+        // must not launder it back to trusted.
+        RestoreHealthState(restored->healthsm_json, now_wall, "");
         ServeRestored(loaded.config, *restored, restored->age_s,
                       downtime_s, "warm-restart", server.get(),
-                      &sink_breaker, &label_state);
+                      &sink_breaker, &label_governor, &label_state);
       } else if (FileExists(flags.state_file)) {
         obs::Default()
             .GetCounter("tfd_state_restores_total",
@@ -1129,11 +1322,17 @@ int Main(int argc, char** argv) {
         TFD_LOG_WARNING << "state file " << flags.state_file
                         << " rejected (" << restored.error()
                         << "); starting cold";
+        // The label payload expired, but an active quarantine has its
+        // own clock and must still hold — a crash loop longer than the
+        // snapshot window must not launder a flapping chip back to
+        // trusted.
+        RestoreHealthState(stale_healthsm_json, WallClockSeconds(),
+                           " from stale state file");
       }
     }
 
     switch (Run(loaded.config, sigmask, server.get(), &sink_breaker,
-                &label_state)) {
+                &label_governor, &label_state)) {
       case RunOutcome::kExit:
         TFD_LOG_INFO << "exiting";
         return 0;

@@ -64,6 +64,14 @@ REQUIRED = [
     "tfd_degradation_transitions_total",
 ]
 
+# The health state machine gauges every observed source on every probe
+# (healthsm/), so even this hermetic null-backend boot registers it —
+# but the gauge carries a source label, so presence is asserted via the
+# null source's child.
+REQUIRED_LABELED = [
+    ("tfd_health_state", {"source": "null"}),
+]
+
 # Families documented in the README that this boot (null backend, no
 # failures injected) legitimately never registers — each exists only on
 # the named path. Anything else documented-but-unscraped is STALE.
@@ -85,6 +93,12 @@ CONDITIONAL = {
     "tfd_sink_breaker_transitions_total",
     # Fault injection: needs an armed --fault-spec (test runs only).
     "tfd_faults_injected_total",
+    # Anti-flap layer (ISSUE 5): transitions/quarantines/suppressions
+    # fire only when something actually flaps; a healthy hermetic boot
+    # never does. (tfd_health_state itself is REQUIRED_LABELED above.)
+    "tfd_health_transitions_total",
+    "tfd_quarantines_total",
+    "tfd_label_flaps_suppressed_total",
 }
 
 
@@ -189,6 +203,8 @@ def main(argv=None):
 
     missing = [name for name in REQUIRED
                if metrics.sample_value(text, name) is None]
+    missing += [f"{name}{labels}" for name, labels in REQUIRED_LABELED
+                if metrics.sample_value(text, name, labels=labels) is None]
     if missing:
         print(f"contract metrics missing from /metrics: {missing}",
               file=sys.stderr)
@@ -210,7 +226,8 @@ def main(argv=None):
         return 1
 
     print(f"metrics lint OK: {len(text.splitlines())} lines, "
-          f"both checkers passed, {len(REQUIRED)} contract series "
+          f"both checkers passed, "
+          f"{len(REQUIRED) + len(REQUIRED_LABELED)} contract series "
           f"present, doc table in sync ({len(scraped)} scraped / "
           f"{len(documented)} documented)")
     return 0

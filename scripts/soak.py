@@ -291,10 +291,15 @@ class CrSink:
 # injected faults are journaled, the sink breaker opens AND recovers
 # with its transitions visible, a kill -9 restart warm-serves the
 # persisted state, a torn state file is rejected (not parsed), and
-# RSS/fds stay flat. Three phases:
+# RSS/fds stay flat. Four phases:
 #   1. file sink + injected ENOSPC burst, then kill -9 + warm restart;
 #   2. torn state file -> checksum rejection -> clean cold start;
-#   3. CR sink + connect-hang + 500-storm -> breaker open -> recovery.
+#   3. CR sink + connect-hang + 500-storm -> breaker open -> recovery;
+#   4. flap drill (fake_pjrt FLAP_EVERY_N=1): a source whose facts flip
+#      every probe must quarantine (tfd_health_state=3) with label
+#      churn governed (<=2 changes, suppressions journaled + counted,
+#      transitions legal per the tpufd.healthsm twin) and the
+#      quarantine restored across a kill -9 warm restart.
 # The schedule is deterministic per --chaos-seed (rate draws inside the
 # daemon are seeded; counts bound every burst), so CI replays it.
 
@@ -622,6 +627,141 @@ def run_chaos(args):
                 daemon.proc.wait()
             sink.close()
         out["phases"]["3"] = phase
+
+        # ---- phase 4: flap drill — governor + quarantine + restart ----
+        phase = {"name": "flap-governor"}
+        fake_pjrt = os.path.join(os.path.dirname(
+            os.path.abspath(args.binary)), "libtfd_fake_pjrt.so")
+        if not os.path.exists(fake_pjrt):
+            phase["skipped"] = f"no fake PJRT plugin at {fake_pjrt}"
+            out["phases"]["4"] = phase
+            return finish()
+        from tpufd import healthsm as healthsm_lib
+
+        label4 = os.path.join(d, "tfd4")
+        state4 = os.path.join(d, "state4")
+        port4 = free_loopback_port()
+        stderr4 = os.path.join(d, "stderr4")
+        env4 = {**env,
+                "TFD_FAKE_PJRT_FLAP_EVERY_N": "1",
+                "TFD_FAKE_PJRT_COUNT_FILE": os.path.join(d, "creates4"),
+                "TFD_FAKE_PJRT_KIND": "TPU v5 lite",
+                "TFD_FAKE_PJRT_BOUNDS": "2,2,1"}
+        argv4 = [f"--sleep-interval={interval}s", "--backend=pjrt",
+                 f"--libtpu-path={fake_pjrt}",
+                 "--pjrt-refresh-interval=0", "--pjrt-retry-backoff=0",
+                 "--pjrt-init-timeout=10s",
+                 "--machine-type-file=/dev/null",
+                 "--snapshot-usable-for=120s",
+                 f"--output-file={label4}", f"--state-file={state4}",
+                 f"--health-flap-window={10 * interval}s",
+                 "--health-flap-threshold=5",
+                 f"--quarantine-cooldown={3 * interval}s",
+                 f"--introspection-addr=127.0.0.1:{port4}"]
+        daemon = ChaosDaemon(args.binary, argv4, env4, stderr4, port4)
+
+        def governed_labels():
+            try:
+                with open(label4) as f:
+                    labels = dict(line.split("=", 1)
+                                  for line in f.read().splitlines() if line)
+            except (OSError, ValueError):
+                return None
+            labels.pop("google.com/tfd.timestamp", None)
+            labels.pop("google.com/tpu.health.probe-ms", None)
+            return labels
+
+        def health_state():
+            status, text = daemon.scraper._get("/metrics")
+            if status != 200:
+                return None
+            try:
+                return tpufd_metrics.sample_value(
+                    text, "tfd_health_state", labels={"source": "pjrt"})
+            except ValueError:
+                return None
+
+        try:
+            if not daemon.wait_first_pass():
+                problems.append("phase4: no first pass: " +
+                                daemon.stderr_tail())
+            observed = []
+            deadline = time.monotonic() + max(30.0, 25 * interval)
+            quarantined = False
+            while time.monotonic() < deadline:
+                if daemon.proc.poll() is not None:
+                    problems.append("phase4: daemon died: " +
+                                    daemon.stderr_tail())
+                    break
+                labels = governed_labels()
+                if labels and (not observed or observed[-1] != labels):
+                    observed.append(labels)
+                if health_state() == 3 and labels and labels.get(
+                        "google.com/tpu.health.quarantined") == "true":
+                    quarantined = True
+                    # A few more passes to prove the held set is steady.
+                    time.sleep(4 * interval)
+                    labels = governed_labels()
+                    if labels and observed[-1] != labels:
+                        observed.append(labels)
+                    break
+                time.sleep(0.1)
+            phase["label_changes"] = len(observed) - 1
+            phase["quarantined"] = quarantined
+            if not quarantined:
+                problems.append("phase4: flapping source never quarantined")
+            if len(observed) - 1 > 2:
+                problems.append(
+                    f"phase4: {len(observed) - 1} label changes under the "
+                    "flap (governor budget is 2)")
+            # Suppressions: probes and rewrites interleave freely, so the
+            # quarantine can engage before any flipped snapshot reaches a
+            # rewrite — zero suppressions then just means the hold did
+            # all the damping. The journal and the counter must agree.
+            events = daemon.journal_events()
+            suppressions = healthsm_lib.flap_suppressions(events)
+            phase["suppressions"] = len(suppressions)
+            suppressed_total = daemon.scraper.counter(
+                "tfd_label_flaps_suppressed_total"
+                "{key_prefix=google.com/tpu}")
+            if suppressions and not suppressed_total:
+                problems.append("phase4: flap-suppressed journaled but "
+                                "tfd_label_flaps_suppressed_total never "
+                                "incremented")
+            if suppressed_total and not suppressions:
+                problems.append("phase4: tfd_label_flaps_suppressed_total "
+                                "incremented without journaled "
+                                "flap-suppressed events")
+            illegal = healthsm_lib.illegal_transitions(events)
+            if illegal:
+                problems.append(f"phase4: illegal health transitions "
+                                f"journaled: {illegal}")
+
+            # kill -9: the quarantine must ride the state file back.
+            daemon.kill9()
+            daemon = ChaosDaemon(
+                args.binary, argv4 + ["--fault-spec=probe.pjrt:hang=60s"],
+                env4, stderr4, port4)
+            restored = False
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if tpufd_journal.events_of_type(daemon.journal_events(),
+                                                "health-restored") and \
+                        health_state() == 3:
+                    restored = True
+                    break
+                time.sleep(0.2)
+            phase["quarantine_restored"] = restored
+            if quarantined and not restored:
+                problems.append("phase4: quarantine did not survive the "
+                                "kill -9 warm restart")
+            if not daemon.terminate():
+                problems.append("phase4: SIGTERM exit was not clean")
+        finally:
+            if daemon.proc.poll() is None:
+                daemon.proc.kill()
+                daemon.proc.wait()
+        out["phases"]["4"] = phase
 
     return finish()
 

@@ -264,6 +264,46 @@ std::vector<FlagDef> MakeFlagDefs(Flags* f) {
                   [f](const std::string& v) {
                     return SetDuration(&f->health_exec_interval_s, v);
                   }});
+  defs.push_back({"health-flap-window",
+                  {"TFD_HEALTH_FLAP_WINDOW"},
+                  "healthFlapWindow",
+                  "anti-flap sliding window AND the label governor's "
+                  "per-key hold-down period: a google.com/tpu.* key "
+                  "that changed may not change again within it unless "
+                  "the change is monotone-informative (e.g. 5m)",
+                  false,
+                  [f](const std::string& v) {
+                    return SetDuration(&f->health_flap_window_s, v);
+                  }});
+  defs.push_back({"health-flap-threshold",
+                  {"TFD_HEALTH_FLAP_THRESHOLD"},
+                  "healthFlapThreshold",
+                  "health state-machine transitions (or content changes "
+                  "between successful probes) inside the window that "
+                  "mark a source/chip flapping and quarantine it; also "
+                  "the governor's per-window churn budget",
+                  false,
+                  [f](const std::string& v) {
+                    int parsed = 0;
+                    if (!ParseNonNegInt(TrimSpace(v), &parsed) ||
+                        parsed < 2) {
+                      return Status::Error("health-flap-threshold must be "
+                                           "an integer >= 2");
+                    }
+                    f->health_flap_threshold = parsed;
+                    return Status::Ok();
+                  }});
+  defs.push_back({"quarantine-cooldown",
+                  {"TFD_QUARANTINE_COOLDOWN"},
+                  "quarantineCooldown",
+                  "how long a quarantined source/chip holds its "
+                  "last-good labels before recovery may begin (3 "
+                  "consecutive clean probes then close it); also its "
+                  "slow re-probe cadence (e.g. 10m)",
+                  false,
+                  [f](const std::string& v) {
+                    return SetDuration(&f->quarantine_cooldown_s, v);
+                  }});
   defs.push_back({"snapshot-usable-for",
                   {"TFD_SNAPSHOT_USABLE_FOR"},
                   "snapshotUsableFor",
@@ -713,6 +753,12 @@ Result<LoadResult> Load(int argc, char** argv) {
   if (f->snapshot_usable_for_s < 0) {
     return Result<LoadResult>::Error("snapshot-usable-for must be >= 0s");
   }
+  if (f->health_flap_window_s < 1) {
+    return Result<LoadResult>::Error("health-flap-window must be >= 1s");
+  }
+  if (f->quarantine_cooldown_s < 1) {
+    return Result<LoadResult>::Error("quarantine-cooldown must be >= 1s");
+  }
   if (!f->introspection_addr.empty()) {
     Result<obs::ListenAddr> addr = obs::ParseListenAddr(f->introspection_addr);
     if (!addr.ok()) return Result<LoadResult>::Error(addr.error());
@@ -785,6 +831,9 @@ std::string ToJson(const Config& config) {
       << ",\"healthExec\":" << jstr(f.health_exec)
       << ",\"healthExecTimeout\":\"" << f.health_exec_timeout_s << "s\""
       << ",\"healthExecInterval\":\"" << f.health_exec_interval_s << "s\""
+      << ",\"healthFlapWindow\":\"" << f.health_flap_window_s << "s\""
+      << ",\"healthFlapThreshold\":" << f.health_flap_threshold
+      << ",\"quarantineCooldown\":\"" << f.quarantine_cooldown_s << "s\""
       << ",\"snapshotUsableFor\":\"" << f.snapshot_usable_for_s << "s\""
       << ",\"introspectionAddr\":" << jstr(f.introspection_addr)
       << ",\"logFormat\":" << jstr(f.log_format)

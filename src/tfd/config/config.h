@@ -113,6 +113,23 @@ struct Flags {
   // is cached and re-measured only this often, so the probe never runs
   // once per sleep-interval.
   int health_exec_interval_s = 3600;
+  // Anti-flap layer (healthsm/ + lm/governor): the sliding window for
+  // flap counting AND the label governor's per-key hold-down period —
+  // once a google.com/tpu.* key changes, it may not change again for
+  // this long unless the change is monotone-informative (first
+  // appearance, tier upgrade). Suppressed flips are journaled
+  // ("flap-suppressed") and counted.
+  int health_flap_window_s = 300;
+  // State-machine transitions (or content changes between successful
+  // probes) inside the window that mark a source/chip FLAPPING and
+  // quarantine it: labels hold their last-good values (annotated
+  // google.com/tpu.health.quarantined=true) until recovery is earned.
+  // Also the governor's per-window churn budget.
+  int health_flap_threshold = 6;
+  // How long a quarantined source/chip is held before recovery may
+  // begin (then 3 consecutive clean probes walk it back to healthy);
+  // also the slow re-probe cadence the broker drops it to.
+  int quarantine_cooldown_s = 600;
   // Staleness-tier override for the probe scheduler's snapshot cache
   // (sched/snapshot.h): how long after its last successful probe a
   // source's snapshot stays SERVABLE (the stale-usable tier's outer

@@ -1,0 +1,428 @@
+#include "tfd/healthsm/healthsm.h"
+
+#include <algorithm>
+
+#include "tfd/fault/fault.h"
+#include "tfd/obs/journal.h"
+#include "tfd/obs/metrics.h"
+#include "tfd/util/jsonlite.h"
+#include "tfd/util/logging.h"
+#include "tfd/util/strings.h"
+
+namespace tfd {
+namespace healthsm {
+
+namespace {
+
+constexpr const char* kStateNames[] = {"healthy", "suspect", "unhealthy",
+                                       "quarantined", "recovering"};
+
+State StateFromName(const std::string& name, bool* ok) {
+  *ok = true;
+  for (int i = 0; i < 5; i++) {
+    if (name == kStateNames[i]) return static_cast<State>(i);
+  }
+  *ok = false;
+  return State::kHealthy;
+}
+
+obs::Gauge* StateGauge(const std::string& key) {
+  return obs::Default().GetGauge(
+      "tfd_health_state",
+      "Debounced health state per probe source / chip: 0 healthy, "
+      "1 suspect, 2 unhealthy, 3 quarantined (labels held at "
+      "last-good), 4 recovering.",
+      {{"source", key}});
+}
+
+}  // namespace
+
+const char* StateName(State state) {
+  return kStateNames[static_cast<int>(state)];
+}
+
+int StateGaugeValue(State state) { return static_cast<int>(state); }
+
+std::string ChipKey(const std::string& chip_id) {
+  return "health/chip-" + chip_id;
+}
+
+HealthTracker::HealthTracker(Policy policy) { Configure(policy); }
+
+void HealthTracker::Configure(Policy policy) {
+  std::lock_guard<std::mutex> lock(mu_);
+  if (policy.flap_window_s < 1) policy.flap_window_s = 1;
+  if (policy.flap_threshold < 2) policy.flap_threshold = 2;
+  if (policy.quarantine_cooldown_s < 1) policy.quarantine_cooldown_s = 1;
+  if (policy.unhealthy_after < 1) policy.unhealthy_after = 1;
+  if (policy.recover_after < 1) policy.recover_after = 1;
+  policy_ = policy;
+}
+
+Policy HealthTracker::policy() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return policy_;
+}
+
+void HealthTracker::PruneWindowLocked(Entry* entry, double now_s) const {
+  while (!entry->flap_times.empty() &&
+         entry->flap_times.front() < now_s - policy_.flap_window_s) {
+    entry->flap_times.pop_front();
+  }
+}
+
+void HealthTracker::NoteFlapLocked(const std::string& key, Entry* entry,
+                                   double now_s) {
+  entry->flap_times.push_back(now_s);
+  PruneWindowLocked(entry, now_s);
+  if (entry->state == State::kQuarantined) return;  // already held
+  if (static_cast<int>(entry->flap_times.size()) < policy_.flap_threshold) {
+    return;
+  }
+  entry->quarantine_until = now_s + policy_.quarantine_cooldown_s;
+  entry->consecutive_clean = 0;
+  const size_t flap_count = entry->flap_times.size();
+  // The window's events are CONSUMED by the quarantine they caused:
+  // otherwise, with a cooldown shorter than the window, the
+  // quarantined->recovering exit transition would land in the
+  // still-populated window and instantly re-quarantine — recovery
+  // could never begin until the whole window drained. Re-quarantining
+  // after recovery requires fresh evidence.
+  entry->flap_times.clear();
+  obs::Default()
+      .GetCounter("tfd_quarantines_total",
+                  "Keys quarantined by the health state machine "
+                  "(flapping past --health-flap-threshold inside "
+                  "--health-flap-window).",
+                  {{"source", key}})
+      ->Inc();
+  TransitionLocked(key, entry, State::kQuarantined,
+                   std::to_string(flap_count) + " transitions in " +
+                       std::to_string(policy_.flap_window_s) +
+                       "s; holding last-good labels for " +
+                       std::to_string(policy_.quarantine_cooldown_s) + "s",
+                   now_s);
+}
+
+void HealthTracker::TransitionLocked(const std::string& key, Entry* entry,
+                                     State to, const std::string& reason,
+                                     double now_s) {
+  if (entry->state == to) return;
+  const State from_state = entry->state;
+  const char* from = StateName(from_state);
+  entry->state = to;
+  StateGauge(key)->Set(StateGaugeValue(to));
+  obs::Default()
+      .GetCounter("tfd_health_transitions_total",
+                  "Health state-machine transitions.",
+                  {{"from", from}, {"to", StateName(to)}})
+      ->Inc();
+  obs::DefaultJournal().Record(
+      "health-transition", key,
+      "health " + key + " " + from + " -> " + StateName(to) +
+          (reason.empty() ? "" : ": " + reason),
+      {{"key", key},
+       {"from", from},
+       {"to", StateName(to)},
+       {"reason", reason}});
+  TFD_LOG_WARNING << "health " << key << " " << from << " -> "
+                  << StateName(to) << (reason.empty() ? "" : " (" + reason +
+                                       ")");
+  // The transition itself is a flap event — except entering quarantine
+  // (must not feed its own detector) and the earned-recovery edges
+  // (quarantine exit, recovery completion): those only happen after
+  // the cooldown plus consecutive clean probes, and counting them
+  // refills the window they just drained — at the minimum
+  // --health-flap-threshold=2 the quarantined -> recovering -> healthy
+  // pair alone would re-quarantine a perfectly clean key forever.
+  const bool earned_recovery =
+      from_state == State::kQuarantined ||
+      (from_state == State::kRecovering && to == State::kHealthy);
+  if (to != State::kQuarantined && !earned_recovery) {
+    NoteFlapLocked(key, entry, now_s);
+  }
+}
+
+State HealthTracker::Observe(const std::string& key, bool ok,
+                             uint64_t fingerprint, double now_s,
+                             double interval_s) {
+  // Drill hook: an armed `healthsm.transition` fail/errno turns this
+  // observation into a failure, driving transitions on demand.
+  if (fault::Action injected = fault::Check("healthsm.transition")) {
+    if (injected.kind == fault::Action::Kind::kFail ||
+        injected.kind == fault::Action::Kind::kErrno) {
+      ok = false;
+    }
+  }
+  std::lock_guard<std::mutex> lock(mu_);
+  Entry& entry = entries_[key];
+  entry.last_observed = now_s;
+  if (interval_s > 0) entry.observe_interval_s = interval_s;
+  PruneWindowLocked(&entry, now_s);
+
+  // Classify: failure / unstable (success whose content fingerprint
+  // moved) / clean.
+  bool unstable = false;
+  if (ok && fingerprint != 0) {
+    unstable = entry.has_fingerprint && fingerprint != entry.last_fingerprint;
+    entry.last_fingerprint = fingerprint;
+    entry.has_fingerprint = true;
+  }
+  bool clean = ok && !unstable;
+
+  if (clean) {
+    entry.consecutive_failures = 0;
+    entry.consecutive_clean++;
+    switch (entry.state) {
+      case State::kHealthy:
+        break;
+      case State::kSuspect:
+        TransitionLocked(key, &entry, State::kHealthy, "probe clean", now_s);
+        break;
+      case State::kUnhealthy:
+        entry.consecutive_clean = 1;
+        entry.from_quarantine = false;
+        TransitionLocked(key, &entry, State::kRecovering, "probe clean",
+                         now_s);
+        break;
+      case State::kRecovering:
+        if (entry.consecutive_clean >= policy_.recover_after) {
+          entry.from_quarantine = false;
+          entry.quarantine_until = 0;
+          TransitionLocked(key, &entry, State::kHealthy,
+                           std::to_string(entry.consecutive_clean) +
+                               " consecutive clean probes",
+                           now_s);
+        }
+        break;
+      case State::kQuarantined:
+        // Recovery must be earned AFTER the cooldown; clean probes
+        // during it do not count toward the streak. Past it, the first
+        // clean probe starts recovering, and the streak continues there
+        // until recover_after consecutive cleans close it healthy.
+        if (now_s < entry.quarantine_until) {
+          entry.consecutive_clean = 0;
+        } else {
+          entry.from_quarantine = true;
+          TransitionLocked(key, &entry, State::kRecovering,
+                           "cooldown elapsed; probe clean", now_s);
+        }
+        break;
+    }
+  } else {
+    const char* why = ok ? "content changed between successful probes"
+                         : "probe failed";
+    entry.consecutive_clean = 0;
+    entry.consecutive_failures++;
+    switch (entry.state) {
+      case State::kHealthy:
+        entry.consecutive_failures = 1;
+        TransitionLocked(key, &entry, State::kSuspect, why, now_s);
+        break;
+      case State::kSuspect:
+        if (entry.consecutive_failures >= policy_.unhealthy_after) {
+          TransitionLocked(key, &entry, State::kUnhealthy, why, now_s);
+        } else if (unstable) {
+          NoteFlapLocked(key, &entry, now_s);
+        }
+        break;
+      case State::kUnhealthy:
+        // Staying unhealthy on failures is NOT a flap; repeated
+        // instability is.
+        if (unstable) NoteFlapLocked(key, &entry, now_s);
+        break;
+      case State::kRecovering:
+        if (entry.from_quarantine) {
+          // The documented contract: a failure or content flip midway
+          // through an EARNED recovery re-arms the cooldown — the key
+          // goes straight back to quarantined (hold + annotation
+          // return) instead of dropping to unhealthy, where a fresh
+          // threshold of flap evidence would be needed to re-quarantine
+          // a source that plainly never stopped flapping.
+          entry.quarantine_until = now_s + policy_.quarantine_cooldown_s;
+          obs::Default()
+              .GetCounter("tfd_quarantines_total",
+                          "Keys quarantined by the health state machine "
+                          "(flapping past --health-flap-threshold inside "
+                          "--health-flap-window).",
+                          {{"source", key}})
+              ->Inc();
+          TransitionLocked(key, &entry, State::kQuarantined,
+                           std::string(why) + " during earned recovery; "
+                                              "cooldown re-armed",
+                           now_s);
+        } else {
+          TransitionLocked(key, &entry, State::kUnhealthy, why, now_s);
+        }
+        break;
+      case State::kQuarantined:
+        // Still misbehaving: re-arm the cooldown.
+        entry.quarantine_until = now_s + policy_.quarantine_cooldown_s;
+        break;
+    }
+  }
+  StateGauge(key)->Set(StateGaugeValue(entry.state));
+  return entry.state;
+}
+
+State HealthTracker::StateOf(const std::string& key, double now_s) const {
+  (void)now_s;
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = entries_.find(key);
+  return it == entries_.end() ? State::kHealthy : it->second.state;
+}
+
+bool HealthTracker::Quarantined(const std::string& key, double now_s) const {
+  return StateOf(key, now_s) == State::kQuarantined;
+}
+
+std::vector<std::string> HealthTracker::QuarantinedKeys(double now_s) {
+  std::lock_guard<std::mutex> lock(mu_);
+  std::vector<std::string> out;
+  for (auto& [key, entry] : entries_) {
+    if (entry.state != State::kQuarantined) continue;
+    // Ghost release (see header): the key vanished from the probe
+    // stream, so it can never earn recovery — stop holding its labels.
+    // The unobserved threshold is max(cooldown, 2x the key's own
+    // observation cadence) PLUS a flap window: a quarantined source
+    // still probed at the slow cooldown cadence — or a chip line fed
+    // only once per hourly health-exec run — must never trip it
+    // between ticks.
+    const double unobserved_for =
+        std::max<double>(policy_.quarantine_cooldown_s,
+                         2.0 * entry.observe_interval_s) +
+        policy_.flap_window_s;
+    if (now_s >= entry.quarantine_until &&
+        now_s - entry.last_observed >= unobserved_for) {
+      TransitionLocked(key, &entry, State::kRecovering,
+                       "cooldown elapsed and key no longer observed; "
+                       "releasing hold",
+                       now_s);
+      continue;
+    }
+    out.push_back(key);
+  }
+  return out;
+}
+
+std::string HealthTracker::SerializeJson(double now_s) const {
+  (void)now_s;
+  std::lock_guard<std::mutex> lock(mu_);
+  std::string out = "{\"keys\":{";
+  bool first = true;
+  for (const auto& [key, entry] : entries_) {
+    if (!first) out += ",";
+    first = false;
+    char until[32];
+    snprintf(until, sizeof(until), "%.3f", entry.quarantine_until);
+    out += jsonlite::Quote(key) + ":{\"state\":" +
+           jsonlite::Quote(StateName(entry.state)) + ",\"fails\":" +
+           std::to_string(entry.consecutive_failures) + ",\"clean\":" +
+           std::to_string(entry.consecutive_clean) + ",\"fp\":\"" +
+           HexU64(entry.last_fingerprint) + "\",\"has_fp\":" +
+           (entry.has_fingerprint ? "true" : "false") + ",\"fromq\":" +
+           (entry.from_quarantine ? "true" : "false") + ",\"iv\":" +
+           std::to_string(entry.observe_interval_s) + ",\"until\":" +
+           until + ",\"flaps\":[";
+    bool first_flap = true;
+    for (double t : entry.flap_times) {
+      if (!first_flap) out += ",";
+      first_flap = false;
+      char buf[32];
+      snprintf(buf, sizeof(buf), "%.3f", t);
+      out += buf;
+    }
+    out += "]}";
+  }
+  return out + "}}";
+}
+
+Status HealthTracker::RestoreJson(const std::string& json, double now_s) {
+  if (json.empty()) return Status::Ok();
+  Result<jsonlite::ValuePtr> parsed = jsonlite::Parse(json);
+  if (!parsed.ok()) {
+    return Status::Error("health state unparseable: " + parsed.error());
+  }
+  jsonlite::ValuePtr keys = (*parsed)->Get("keys");
+  if (!keys || keys->kind != jsonlite::Value::Kind::kObject) {
+    return Status::Error("health state missing keys object");
+  }
+  std::map<std::string, Entry> restored;
+  for (const auto& [key, value] : keys->object_items) {
+    if (value->kind != jsonlite::Value::Kind::kObject) {
+      return Status::Error("health state entry '" + key +
+                           "' is not an object");
+    }
+    Entry entry;
+    jsonlite::ValuePtr state = value->Get("state");
+    if (!state || state->kind != jsonlite::Value::Kind::kString) {
+      return Status::Error("health state entry '" + key + "' has no state");
+    }
+    bool known = false;
+    entry.state = StateFromName(state->string_value, &known);
+    if (!known) {
+      return Status::Error("health state entry '" + key +
+                           "' names unknown state '" + state->string_value +
+                           "'");
+    }
+    auto number = [&value](const char* name, double dflt) {
+      jsonlite::ValuePtr v = value->Get(name);
+      return (v && v->kind == jsonlite::Value::Kind::kNumber)
+                 ? v->number_value
+                 : dflt;
+    };
+    entry.consecutive_failures = static_cast<int>(number("fails", 0));
+    entry.consecutive_clean = static_cast<int>(number("clean", 0));
+    entry.quarantine_until = number("until", 0);
+    // Restored cadence keeps the ghost-release threshold honest before
+    // the first post-restart observation re-declares it: a slow source
+    // must not be released as a ghost just because the daemon rebooted.
+    entry.observe_interval_s = number("iv", 0);
+    // A restored entry gets a fresh observation stamp (not the
+    // pre-crash one): the key earns a full flap window to reappear in
+    // the probe stream before the ghost release may fire.
+    entry.last_observed = now_s;
+    jsonlite::ValuePtr fp = value->Get("fp");
+    if (fp && fp->kind == jsonlite::Value::Kind::kString) {
+      entry.last_fingerprint =
+          strtoull(fp->string_value.c_str(), nullptr, 16);
+    }
+    jsonlite::ValuePtr has_fp = value->Get("has_fp");
+    entry.has_fingerprint = has_fp &&
+                            has_fp->kind == jsonlite::Value::Kind::kBool &&
+                            has_fp->bool_value;
+    jsonlite::ValuePtr fromq = value->Get("fromq");
+    entry.from_quarantine = fromq &&
+                            fromq->kind == jsonlite::Value::Kind::kBool &&
+                            fromq->bool_value;
+    jsonlite::ValuePtr flaps = value->Get("flaps");
+    if (flaps && flaps->kind == jsonlite::Value::Kind::kArray) {
+      for (const jsonlite::ValuePtr& t : flaps->array_items) {
+        if (t->kind == jsonlite::Value::Kind::kNumber) {
+          entry.flap_times.push_back(t->number_value);
+        }
+      }
+    }
+    restored[key] = std::move(entry);
+  }
+  std::lock_guard<std::mutex> lock(mu_);
+  entries_ = std::move(restored);
+  for (auto& [key, entry] : entries_) {
+    PruneWindowLocked(&entry, now_s);
+    StateGauge(key)->Set(StateGaugeValue(entry.state));
+  }
+  return Status::Ok();
+}
+
+void HealthTracker::Reset() {
+  std::lock_guard<std::mutex> lock(mu_);
+  entries_.clear();
+}
+
+HealthTracker& Default() {
+  static HealthTracker* tracker = new HealthTracker();
+  return *tracker;
+}
+
+}  // namespace healthsm
+}  // namespace tfd

@@ -1,0 +1,174 @@
+// Debounced per-source health state machine with flap detection and
+// chip quarantine.
+//
+// PR 2's degradation ladder keeps a wedged probe from stalling the
+// rewrite cadence, but every probe RESULT still flowed straight into
+// labels: a TPU whose health exec alternates ok/fail — a flaky ICI
+// link, a thermal throttle, a neighbor briefly holding the exclusive
+// chips — flipped `google.com/tpu.health.*` (and the degradation
+// markers) on every rewrite, thrashing any scheduler that selects on
+// them. The reference's steady-state contract treats label churn as an
+// outage of its own; this tracker puts a debounced state machine in
+// front of every health-bearing fact:
+//
+//   healthy -> suspect -> unhealthy -> quarantined -> recovering
+//
+// One entry per KEY: a probe source ("pjrt", "metadata", "health") fed
+// by the broker after every probe, or a chip ("health/chip-<i>") fed
+// from the health exec's per-device label lines. Observations are
+// classified three ways:
+//   - failure   — the probe errored (or an armed `healthsm.transition`
+//                 fault forced one);
+//   - unstable  — the probe SUCCEEDED but its content fingerprint
+//                 changed since the last success (a source whose facts
+//                 alternate — 4 chips, then 2, then 4 — is flapping
+//                 even though every probe "works");
+//   - clean     — success with stable content.
+//
+// Flap detection: every state transition (except the earned-recovery
+// edges — quarantine exit and recovery completion, which are
+// hysteresis doing its job) AND every unstable observation lands in a
+// per-key sliding window (`--health-flap-window` seconds). `--health-flap-threshold` events inside the window mark the
+// key flapping and quarantine it for `--quarantine-cooldown`: the label
+// pipeline holds the key's facts at their last-good values (annotated
+// `google.com/tpu.health.quarantined=true`), and the broker drops the
+// source to the slow quarantine-cooldown re-probe cadence. Recovery is
+// deliberately earned: after the cooldown elapses, K consecutive clean
+// probes (K = recover_after, default 3) walk quarantined -> recovering
+// -> healthy; any failure or unstable observation mid-recovery re-arms
+// the cooldown.
+//
+// Every transition is journaled ("health-transition") and counted
+// (tfd_health_transitions_total{from,to}); the per-key state is gauged
+// (tfd_health_state{source}: 0 healthy, 1 suspect, 2 unhealthy,
+// 3 quarantined, 4 recovering) and quarantine entries counted
+// (tfd_quarantines_total{source}). SIGHUP reloads Reconfigure() the
+// thresholds without resetting state — the silicon's health did not
+// change because our config did — and the whole tracker serializes
+// into the warm-restart state file (sched/state.h), so a quarantine
+// survives kill -9: a crash must not launder a flapping chip back to
+// trusted.
+//
+// Time is caller-supplied unix wall seconds (WallClockSeconds() in the
+// daemon, synthetic values in tests — no sleeps needed to cross a
+// window), which is also what lets deadlines round-trip through the
+// state file.
+#pragma once
+
+#include <cstdint>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "tfd/util/status.h"
+
+namespace tfd {
+namespace healthsm {
+
+enum class State { kHealthy, kSuspect, kUnhealthy, kQuarantined, kRecovering };
+
+const char* StateName(State state);
+// The tfd_health_state gauge encoding (0..4, order above).
+int StateGaugeValue(State state);
+
+struct Policy {
+  // Sliding window for flap counting (--health-flap-window).
+  int flap_window_s = 300;
+  // Transitions/unstable observations inside the window that mark the
+  // key flapping and quarantine it (--health-flap-threshold).
+  int flap_threshold = 6;
+  // How long a quarantined key stays held before recovery may begin;
+  // also the slow re-probe cadence the broker drops the source to
+  // (--quarantine-cooldown).
+  int quarantine_cooldown_s = 600;
+  // Consecutive failures that harden suspect into unhealthy.
+  int unhealthy_after = 2;
+  // Consecutive clean probes that close recovering back to healthy
+  // (and, after the cooldown, walk quarantined out).
+  int recover_after = 3;
+};
+
+// Key under which a health-exec per-device line is tracked
+// ("health/chip-<id>").
+std::string ChipKey(const std::string& chip_id);
+
+class HealthTracker {
+ public:
+  explicit HealthTracker(Policy policy = Policy());
+
+  // SIGHUP reload: thresholds change, per-key state survives (like the
+  // sink breaker's Configure).
+  void Configure(Policy policy);
+  Policy policy() const;
+
+  // Feeds one observation for `key`. `ok` is the probe verdict;
+  // `fingerprint` hashes the successful result's content (0 = no
+  // fingerprint: only ok/fail is classified); `interval_s` is the
+  // cadence the caller will observe this key at next (0 = unknown),
+  // which scales the ghost-release threshold so a slow source (the
+  // hourly health exec and its chip lines) is never mistaken for a
+  // vanished one. Returns the post-observation state. Fault point
+  // "healthsm.transition": an armed fail/errno action forces this
+  // observation to a failure.
+  State Observe(const std::string& key, bool ok, uint64_t fingerprint,
+                double now_s, double interval_s = 0);
+
+  State StateOf(const std::string& key, double now_s) const;
+  bool Quarantined(const std::string& key, double now_s) const;
+  // Keys currently quarantined, in key order. Also releases ghost
+  // quarantines: a quarantined key that stopped being observed (chip
+  // replaced/renumbered, exec's device list shrank) can never earn the
+  // clean-probe recovery, so once the cooldown has elapsed AND no
+  // observation has arrived for max(cooldown, 2x the key's own
+  // observation cadence) plus a flap window (a still-probed key never
+  // goes quiet that long — the 2x covers one missed tick of even the
+  // hourly health exec), the key transitions to recovering and its
+  // hold ends — otherwise a dead chip's label and the quarantined=true
+  // annotation would be pinned forever.
+  std::vector<std::string> QuarantinedKeys(double now_s);
+
+  // Warm-restart round trip (rides inside sched::PersistedState).
+  // Serialization is a JSON object; Restore tolerates an empty string
+  // (nothing persisted) and errors on garbage without touching state.
+  std::string SerializeJson(double now_s) const;
+  Status RestoreJson(const std::string& json, double now_s);
+
+  // Test hook: drops every entry (a fresh tracker without rebuilding
+  // the process-global one).
+  void Reset();
+
+ private:
+  struct Entry {
+    State state = State::kHealthy;
+    int consecutive_failures = 0;
+    int consecutive_clean = 0;
+    uint64_t last_fingerprint = 0;
+    bool has_fingerprint = false;
+    double quarantine_until = 0;     // wall time; meaningful when quarantined
+    // The current recovering spell exits a quarantine: a failure or
+    // content flip mid-recovery re-arms the cooldown (straight back to
+    // quarantined) instead of falling to unhealthy.
+    bool from_quarantine = false;
+    double last_observed = 0;        // wall time of the latest Observe()
+    double observe_interval_s = 0;   // caller-declared cadence (0 unknown)
+    std::deque<double> flap_times;   // transition/unstable wall times
+  };
+
+  void TransitionLocked(const std::string& key, Entry* entry, State to,
+                        const std::string& reason, double now_s);
+  void NoteFlapLocked(const std::string& key, Entry* entry, double now_s);
+  void PruneWindowLocked(Entry* entry, double now_s) const;
+
+  mutable std::mutex mu_;
+  Policy policy_;
+  std::map<std::string, Entry> entries_;
+};
+
+// The process-wide tracker (the analogue of obs::Default()): survives
+// SIGHUP reloads, shared by the broker workers and the rewrite loop.
+HealthTracker& Default();
+
+}  // namespace healthsm
+}  // namespace tfd

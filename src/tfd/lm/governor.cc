@@ -1,0 +1,196 @@
+#include "tfd/lm/governor.h"
+
+#include <algorithm>
+
+#include "tfd/lm/schema.h"
+#include "tfd/util/strings.h"
+
+namespace tfd {
+namespace lm {
+
+bool GovernedKey(const std::string& key) {
+  if (!HasPrefix(key, "google.com/tpu")) return false;
+  // Measurement keys move every pass by design; damping them would
+  // only hide the measurement. snapshot-age is handled as kDegraded's
+  // paired marker, never on its own timer. The quarantine annotation is
+  // healthsm's already-debounced verdict (threshold flaps to appear,
+  // cooldown + clean streak to clear) — governing it can only suppress
+  // the one label that explains why everything else is held.
+  if (key == kHealthProbeMs) return false;
+  if (key == kSnapshotAge) return false;
+  if (key == kHealthQuarantined) return false;
+  return true;
+}
+
+bool DowngradeMarkerKey(const std::string& key) {
+  return key == kDegraded || key == kSnapshotAge;
+}
+
+LabelGovernor::LabelGovernor(GovernorPolicy policy) { Configure(policy); }
+
+void LabelGovernor::Configure(GovernorPolicy policy) {
+  if (policy.hold_down_s < 1) policy.hold_down_s = 1;
+  if (policy.churn_budget < 1) policy.churn_budget = 1;
+  policy_ = policy;
+}
+
+GovernorPolicy LabelGovernor::policy() const { return policy_; }
+
+void LabelGovernor::NotePublished(const Labels& labels, double now_s) {
+  for (const auto& [key, value] : labels) {
+    (void)value;
+    if (!GovernedKey(key)) continue;
+    last_change_.emplace(key, now_s);  // only newly seen keys
+  }
+}
+
+void LabelGovernor::Apply(const Labels& previous,
+                          const Provenance& prev_provenance,
+                          bool level_improved, double now_s,
+                          Labels* candidate, Provenance* provenance,
+                          std::vector<SuppressedFlip>* suppressed) {
+  pending_change_.clear();  // uncommitted pass: its changes never landed
+  pending_budget_spend_ = 0;
+  pending_now_ = now_s;
+  while (!window_changes_.empty() &&
+         window_changes_.front() < now_s - policy_.hold_down_s) {
+    window_changes_.pop_front();
+  }
+
+  // A pass that converges AWAY from a published SLICE-INVALID sentinel
+  // (the slice labeler's explicit degradation values: the topology
+  // overlay had no answer yet) is an overlay recovery — the value-level
+  // analogue of a tier upgrade, carrying NEW information the governor
+  // must not damp. The reverse direction gets no such pass: flipping
+  // INTO the sentinel is a governed change, so a flapping overlay holds
+  // at its last valid facts and this hatch never re-arms.
+  bool invalid_recovery = false;
+  for (const auto& [key, value] : previous) {
+    if (!GovernedKey(key) || value != kSliceInvalid) continue;
+    auto cand = candidate->find(key);
+    if (cand == candidate->end() || cand->second != kSliceInvalid) {
+      invalid_recovery = true;
+      break;
+    }
+  }
+  if (invalid_recovery) level_improved = true;
+
+  // The union of governed keys across both sets, walked in key order so
+  // suppressions journal deterministically.
+  std::vector<std::string> keys;
+  for (const auto& [key, value] : previous) {
+    (void)value;
+    if (GovernedKey(key)) keys.push_back(key);
+  }
+  for (const auto& [key, value] : *candidate) {
+    (void)value;
+    if (GovernedKey(key) && previous.count(key) == 0) keys.push_back(key);
+  }
+  std::sort(keys.begin(), keys.end());
+
+  bool degraded_suppressed = false;
+  for (const std::string& key : keys) {
+    auto prev_it = previous.find(key);
+    auto cand_it = candidate->find(key);
+    bool prev_has = prev_it != previous.end();
+    bool cand_has = cand_it != candidate->end();
+    if (prev_has && cand_has && prev_it->second == cand_it->second) {
+      continue;  // unchanged
+    }
+    if (!prev_has && !cand_has) continue;
+
+    bool first_appearance =
+        !prev_has && last_change_.find(key) == last_change_.end();
+    bool marker_upgrade = !cand_has && DowngradeMarkerKey(key);
+    if (first_appearance || marker_upgrade || level_improved) {
+      pending_change_[key] = now_s;
+      continue;
+    }
+
+    std::string reason;
+    auto seen = last_change_.find(key);
+    double last = seen == last_change_.end() ? now_s - 2 * policy_.hold_down_s
+                                             : seen->second;
+    if (now_s - last < policy_.hold_down_s) {
+      reason = "hold-down";
+    } else if (static_cast<int>(window_changes_.size()) +
+                   pending_budget_spend_ >=
+               policy_.churn_budget) {
+      reason = "churn-budget";
+    }
+    if (reason.empty()) {
+      pending_change_[key] = now_s;
+      pending_budget_spend_++;
+      continue;
+    }
+
+    // Suppress: hold the previously published value (or absence).
+    SuppressedFlip flip;
+    flip.key = key;
+    flip.op = !prev_has ? "added" : (!cand_has ? "removed" : "changed");
+    flip.old_value = prev_has ? prev_it->second : "";
+    flip.new_value = cand_has ? cand_it->second : "";
+    flip.reason = reason;
+    if (cand_has) {
+      auto from = provenance->find(key);
+      if (from != provenance->end()) flip.provenance = from->second;
+    } else {
+      // A suppressed removal has no candidate entry to cite; the
+      // provenance that explains the journal event is the previously
+      // published value's — the one the hold keeps serving.
+      auto from = prev_provenance.find(key);
+      if (from != prev_provenance.end()) flip.provenance = from->second;
+    }
+    if (prev_has) {
+      (*candidate)[key] = prev_it->second;
+      auto from = prev_provenance.find(key);
+      if (from != prev_provenance.end()) {
+        (*provenance)[key] = from->second;
+      }
+    } else {
+      candidate->erase(key);
+      provenance->erase(key);
+    }
+    if (key == kDegraded) degraded_suppressed = true;
+    suppressed->push_back(std::move(flip));
+  }
+
+  // tpu.snapshot-age-seconds rides with tpu.degraded: when the marker's
+  // flip was suppressed, the age must mirror the held state too —
+  // publishing an age without its marker (or vice versa) would be a
+  // torn pair.
+  if (degraded_suppressed) {
+    auto prev_it = previous.find(kSnapshotAge);
+    if (prev_it != previous.end()) {
+      (*candidate)[kSnapshotAge] = prev_it->second;
+      auto from = prev_provenance.find(kSnapshotAge);
+      if (from != prev_provenance.end()) {
+        (*provenance)[kSnapshotAge] = from->second;
+      }
+    } else {
+      candidate->erase(kSnapshotAge);
+      provenance->erase(kSnapshotAge);
+    }
+  }
+}
+
+void LabelGovernor::CommitPublished() {
+  for (const auto& [key, when] : pending_change_) {
+    last_change_[key] = when;
+  }
+  for (int i = 0; i < pending_budget_spend_; i++) {
+    window_changes_.push_back(pending_now_);
+  }
+  pending_change_.clear();
+  pending_budget_spend_ = 0;
+}
+
+void LabelGovernor::Reset() {
+  last_change_.clear();
+  window_changes_.clear();
+  pending_change_.clear();
+  pending_budget_spend_ = 0;
+}
+
+}  // namespace lm
+}  // namespace tfd

@@ -1,0 +1,116 @@
+// Label hold-down governor: anti-flap debouncing in front of the merge.
+//
+// The degradation ladder and the health state machine decide WHAT the
+// daemon believes; this governor decides how fast a belief may reach a
+// LABEL. Schedulers select on `google.com/tpu.*` keys, and a key that
+// flips every rewrite — a flapping health exec, a source whose facts
+// alternate — thrashes them worse than a stale value would. So every
+// governed key carries a hold-down timer: once it changes, it may not
+// change again for `hold_down_s`, and a bounded churn budget caps how
+// many governed keys may change inside one window at all. Suppressed
+// flips hold the previously published value, are journaled
+// ("flap-suppressed", full provenance of the value that WOULD have
+// been published) and counted (tfd_label_flaps_suppressed_total
+// {key_prefix}).
+//
+// Monotone-informative changes bypass the governor — suppressing them
+// would withhold NEW information rather than damp noise:
+//   - first appearance: a key this process has never published;
+//   - tier upgrades: a pass whose degradation-ladder rung IMPROVED
+//     (metadata -> pjrt convergence, restored -> live) may change
+//     anything, removing a downgrade marker (tpu.degraded,
+//     tpu.snapshot-age-seconds) is always allowed, and so is a pass
+//     converging AWAY from a published
+//     SLICE-INVALID sentinel (the slice overlay recovered — flipping
+//     INTO the sentinel stays governed, so this cannot oscillate);
+//   - measurement keys (tpu.health.probe-ms) and the
+//     tpu.health.quarantined annotation (healthsm's already-debounced
+//     verdict) are exempt outright, and tpu.snapshot-age-seconds
+//     mirrors tpu.degraded's outcome rather than burning its own
+//     timer (the pair is set and cleared together).
+//
+// Only `google.com/tpu*` keys are governed: the timestamp label
+// (google.com/tfd.*) is cadence proof, not node identity.
+#pragma once
+
+#include <deque>
+#include <map>
+#include <string>
+#include <vector>
+
+#include "tfd/lm/merge.h"
+
+namespace tfd {
+namespace lm {
+
+struct GovernorPolicy {
+  // Minimum seconds between changes of one governed key
+  // (--health-flap-window: the hold-down period IS the flap window).
+  int hold_down_s = 300;
+  // Governed (non-monotone) key changes allowed inside one hold-down
+  // window across ALL keys (derived from --health-flap-threshold).
+  int churn_budget = 6;
+};
+
+struct SuppressedFlip {
+  std::string key;
+  std::string op;         // "added" | "removed" | "changed"
+  std::string old_value;  // what stays published
+  std::string new_value;  // what was suppressed
+  std::string reason;     // "hold-down" | "churn-budget"
+  LabelProvenance provenance;  // of the suppressed candidate value
+};
+
+class LabelGovernor {
+ public:
+  explicit LabelGovernor(GovernorPolicy policy = GovernorPolicy());
+
+  // SIGHUP reload: thresholds change, hold-down history survives.
+  void Configure(GovernorPolicy policy);
+  GovernorPolicy policy() const;
+
+  // Governs `candidate` (the merged label set about to be published)
+  // against `previous` (the last published set): suppressed keys are
+  // reverted in place to their previous value/absence (provenance
+  // restored from `prev_provenance`), and each suppression is reported
+  // in `suppressed`. `level_improved` marks a pass whose serving rung
+  // improved — its changes are monotone-informative and pass through.
+  // Allowed changes are recorded as PENDING; the caller must
+  // CommitPublished() once the set actually lands in the sink, so a
+  // transient sink failure never burns a key's hold-down timer (the
+  // retry would then suppress the very change it meant to publish).
+  // A new Apply() discards any uncommitted pending changes.
+  void Apply(const Labels& previous, const Provenance& prev_provenance,
+             bool level_improved, double now_s, Labels* candidate,
+             Provenance* provenance,
+             std::vector<SuppressedFlip>* suppressed);
+  void CommitPublished();
+
+  // Seeds the history from a set published OUTSIDE Apply (the
+  // warm-restart passes write to the sink directly): newly seen keys
+  // start their hold-down at `now_s`.
+  void NotePublished(const Labels& labels, double now_s);
+
+  void Reset();
+
+ private:
+  GovernorPolicy policy_;
+  std::map<std::string, double> last_change_;  // governed key -> wall time
+  std::deque<double> window_changes_;          // budget bookkeeping
+  std::map<std::string, double> pending_change_;
+  int pending_budget_spend_ = 0;
+  double pending_now_ = 0;
+};
+
+// True for keys the governor debounces (google.com/tpu*, minus the
+// exempt measurement keys).
+bool GovernedKey(const std::string& key);
+
+// True for the downgrade-marker keys whose REMOVAL is always a tier
+// upgrade (tpu.degraded, tpu.snapshot-age-seconds).
+// tpu.health.quarantined is not one: it is exempt from governing
+// outright (GovernedKey returns false for it).
+bool DowngradeMarkerKey(const std::string& key);
+
+}  // namespace lm
+}  // namespace tfd

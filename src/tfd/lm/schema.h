@@ -84,6 +84,16 @@ inline constexpr char kHealthPrefix[] = "google.com/tpu.health.";
 inline constexpr char kHealthOk[] = "google.com/tpu.health.ok";
 inline constexpr char kHealthDevices[] = "google.com/tpu.health.devices";
 inline constexpr char kHealthProbeMs[] = "google.com/tpu.health.probe-ms";
+// Anti-flap layer (healthsm/): present while ANY health-state-machine
+// key is quarantined — the flapping source's labels are held at their
+// last-good values until it earns recovery.
+inline constexpr char kHealthQuarantined[] =
+    "google.com/tpu.health.quarantined";
+// Per-chip health lines from the health exec
+// ("google.com/tpu.health.device-<i>-ok=true|false"): each chip gets
+// its own debounced state machine entry (healthsm::ChipKey).
+inline constexpr char kHealthDevicePrefix[] =
+    "google.com/tpu.health.device-";
 
 // Degradation ladder (sched/): present only when the daemon is serving
 // CACHED device facts because the probe source missed its cadence

@@ -8,9 +8,13 @@
 #include <thread>
 
 #include "tfd/fault/fault.h"
+#include "tfd/healthsm/healthsm.h"
+#include "tfd/lm/schema.h"
 #include "tfd/obs/journal.h"
 #include "tfd/obs/metrics.h"
 #include "tfd/util/logging.h"
+#include "tfd/util/strings.h"
+#include "tfd/util/time.h"
 
 namespace tfd {
 namespace sched {
@@ -42,6 +46,38 @@ struct BrokerControl {
 };
 
 namespace {
+
+// Feeds the health state machine (healthsm/) with this probe round's
+// verdict: the per-source observation (with the snapshot's content
+// fingerprint, so a source whose facts alternate registers as
+// flapping), plus one per-chip observation for every health-exec
+// device line ("google.com/tpu.health.device-<i>-ok"), so a single
+// flaky chip quarantines alone instead of tainting the whole source.
+void ObserveProbeHealth(const ProbeSpec& spec, bool ok,
+                        const Snapshot& snapshot, int interval_s) {
+  healthsm::HealthTracker& tracker = healthsm::Default();
+  double now = WallClockSeconds();
+  uint64_t fingerprint = ok ? SnapshotFingerprint(snapshot) : 0;
+  // The cadence rides along so the tracker's ghost release can tell a
+  // slowly-probed key (hourly health exec, chip lines fed once per exec
+  // run) from one that vanished from the probe stream.
+  tracker.Observe(spec.name, ok, fingerprint, now, interval_s);
+  if (!ok) return;
+  constexpr size_t kPrefixLen = sizeof(lm::kHealthDevicePrefix) - 1;
+  for (const auto& [key, value] : snapshot.labels) {
+    if (!HasPrefix(key, lm::kHealthDevicePrefix)) continue;
+    std::string suffix = key.substr(kPrefixLen);  // "<i>-ok"
+    constexpr char kOkSuffix[] = "-ok";
+    if (suffix.size() <= sizeof(kOkSuffix) - 1 ||
+        suffix.compare(suffix.size() - (sizeof(kOkSuffix) - 1),
+                       sizeof(kOkSuffix) - 1, kOkSuffix) != 0) {
+      continue;
+    }
+    std::string chip = suffix.substr(0, suffix.size() - 3);
+    tracker.Observe(healthsm::ChipKey(chip), value == "true", 0, now,
+                    interval_s);
+  }
+}
 
 // One probe invocation + its metrics + the store write. Shared by the
 // oneshot round and the daemon workers; a free function over the
@@ -87,10 +123,12 @@ bool RunProbeOnce(BrokerControl& control, const ProbeSpec& spec,
       ->Observe(seconds);
   if (s.ok()) {
     snapshot.probe_seconds = seconds;
+    int next_interval_s =
+        spec.interval_for ? spec.interval_for(snapshot) : spec.interval_s;
     if (success_interval_s != nullptr) {
-      *success_interval_s = spec.interval_for ? spec.interval_for(snapshot)
-                                              : spec.interval_s;
+      *success_interval_s = next_interval_s;
     }
+    ObserveProbeHealth(spec, true, snapshot, next_interval_s);
     control.store->PutOk(spec.name, std::move(snapshot));
     obs::DefaultJournal().Record(
         "probe-ok", spec.name, "probe " + spec.name + " succeeded",
@@ -101,6 +139,13 @@ bool RunProbeOnce(BrokerControl& control, const ProbeSpec& spec,
                  "Probe invocations that failed, per source.",
                  {{"source", spec.name}})
       ->Inc();
+  // Declare the worst-case failure cadence, not the nominal interval:
+  // after a failure the worker sleeps a backoff of up to backoff_max_s,
+  // and the tracker's ghost release keys off this declared cadence — a
+  // still-probed, still-failing quarantined source must not be released
+  // as "no longer observed" mid-backoff.
+  ObserveProbeHealth(spec, false, snapshot,
+                     std::max(spec.interval_s, spec.backoff_max_s));
   control.store->PutError(spec.name, s.message(), fatal);
   obs::DefaultJournal().Record(
       "probe-fail", spec.name, "probe " + spec.name + " failed",
@@ -149,12 +194,22 @@ void WorkerLoop(std::shared_ptr<BrokerControl> control, ProbeSpec spec) {
           {{"backoff_s", std::to_string(sleep_s)},
            {"consecutive_failures", std::to_string(consecutive)}});
     }
+    // Quarantine clamp (healthsm/): a flapping source re-probes at the
+    // slow quarantine-cooldown cadence instead of its normal one —
+    // hammering a source already proven unstable only feeds the flap
+    // detector, and its labels are held at last-good anyway.
+    bool quarantined =
+        healthsm::Default().Quarantined(spec.name, WallClockSeconds());
+    if (quarantined) {
+      int cooldown_s = healthsm::Default().policy().quarantine_cooldown_s;
+      if (sleep_s < cooldown_s) sleep_s = cooldown_s;
+    }
     obs::Default()
         .GetGauge("tfd_probe_backoff_seconds",
                   "Current failure-backoff window, per source (0: "
                   "healthy).",
                   {{"source", spec.name}})
-        ->Set(ok ? 0 : sleep_s);
+        ->Set(ok && !quarantined ? 0 : sleep_s);
     // Sleep in <=1s slices so stop requests and rerun_early triggers
     // (chip-count changes) interrupt a long cadence.
     auto wake_at = std::chrono::steady_clock::now() +
@@ -174,7 +229,10 @@ void WorkerLoop(std::shared_ptr<BrokerControl> control, ProbeSpec spec) {
           wake_at - now, std::chrono::seconds(1));
       control->cv.wait_for(lock, slice);
       lock.unlock();
-      if (spec.rerun_early && spec.rerun_early()) break;
+      // A quarantined source must not short-circuit its slow cadence:
+      // rerun_early (chip-count changes) is exactly the kind of signal
+      // a flapping source emits every pass.
+      if (spec.rerun_early && !quarantined && spec.rerun_early()) break;
     }
     if (stop_seen) break;
   }

@@ -1,9 +1,35 @@
 #include "tfd/sched/snapshot.h"
 
+#include "tfd/lm/schema.h"
 #include "tfd/obs/journal.h"
+#include "tfd/util/strings.h"
 
 namespace tfd {
 namespace sched {
+
+namespace {
+
+// Labels whose content feeds the flap fingerprint. Measured values
+// under google.com/tpu.health.* (matmul-tflops, hbm-gbps, probe-ms,
+// ...) legitimately move between re-measures — hashing them would mark
+// a healthy health exec "unstable" on every run and walk its state
+// machine entry to unhealthy on perfectly good silicon. Per-chip
+// device-<i>-ok lines are excluded too: each has its own healthsm chip
+// entry (broker ObserveProbeHealth), and hashing them here as well
+// would let a single flapping chip drag the whole source into
+// quarantine. Only the source-level STRUCTURAL facts participate: the
+// aggregate verdicts (ok, devices-consistent, *-degraded) and the chip
+// count. Every label outside the health prefix is a hardware/identity
+// fact and counts.
+bool FingerprintedLabel(const std::string& key) {
+  if (!HasPrefix(key, lm::kHealthPrefix)) return true;
+  if (HasPrefix(key, lm::kHealthDevicePrefix)) return false;
+  const std::string fact = key.substr(sizeof(lm::kHealthPrefix) - 1);
+  return fact == "ok" || fact == "devices" || fact == "devices-consistent" ||
+         HasSuffix(fact, "-ok") || HasSuffix(fact, "-degraded");
+}
+
+}  // namespace
 
 const char* TierName(Tier tier) {
   switch (tier) {
@@ -24,6 +50,50 @@ Tier TierForAge(double age_s, const TierPolicy& policy) {
   if (age_s <= policy.fresh_for_s) return Tier::kFresh;
   if (age_s <= policy.usable_for_s) return Tier::kStaleUsable;
   return Tier::kExpired;
+}
+
+uint64_t SnapshotFingerprint(const Snapshot& snapshot) {
+  uint64_t hash = 1469598103934665603ULL;  // FNV-1a 64
+  auto mix = [&hash](const std::string& s) {
+    for (unsigned char c : s) {
+      hash ^= c;
+      hash *= 1099511628211ULL;
+    }
+    hash ^= 0x1f;  // field separator
+    hash *= 1099511628211ULL;
+  };
+  for (const auto& [key, value] : snapshot.labels) {
+    if (!FingerprintedLabel(key)) continue;
+    mix(key);
+    mix(value);
+  }
+  if (snapshot.manager != nullptr) {
+    // SnapshotManager answers from captured data — these reads never
+    // touch hardware.
+    Result<std::vector<resource::DevicePtr>> devices =
+        snapshot.manager->GetDevices();
+    if (devices.ok()) {
+      mix("devices=" + std::to_string(devices->size()));
+      for (const resource::DevicePtr& device : *devices) {
+        if (device == nullptr) continue;
+        Result<std::string> kind = device->GetKind();
+        if (kind.ok()) mix(*kind);
+      }
+    } else {
+      mix("devices-error=" + devices.error());
+    }
+    Result<std::string> libtpu = snapshot.manager->GetLibtpuVersion();
+    if (libtpu.ok()) mix("libtpu=" + *libtpu);
+    Result<std::string> runtime = snapshot.manager->GetRuntimeVersion();
+    if (runtime.ok()) mix("runtime=" + *runtime);
+    Result<resource::TopologyInfo> topology =
+        snapshot.manager->GetTopology();
+    if (topology.ok()) {
+      mix("topology=" + topology->accelerator_type + "/" +
+          topology->topology);
+    }
+  }
+  return hash == 0 ? 1 : hash;
 }
 
 void SnapshotStore::Register(const std::string& source,

@@ -58,6 +58,20 @@ struct TierPolicy {
 // Pure tier rule, unit-testable without a store or a clock.
 Tier TierForAge(double age_s, const TierPolicy& policy);
 
+struct Snapshot;
+
+// Content fingerprint of one successful probe result (FNV-1a over the
+// label payload and, for device sources, the captured device facts).
+// The health state machine (healthsm/) compares consecutive successful
+// probes' fingerprints: a source whose facts alternate — 4 chips, then
+// 2, then 4 — is flapping even though every probe "succeeds". Measured
+// google.com/tpu.health.* values (probe-ms, matmul-tflops, ...) are
+// excluded — they legitimately move between re-measures; only the
+// structural verdicts (ok / device-<i>-ok / devices-consistent /
+// *-degraded / chip count) participate. Never 0 (0 means "no
+// fingerprint" to the tracker).
+uint64_t SnapshotFingerprint(const Snapshot& snapshot);
+
 // One successful probe result. Device sources carry an initialized,
 // inert manager view (sched/sources.cc SnapshotManager: every call
 // answers from captured data, Init/Shutdown are no-ops); label sources

@@ -9,6 +9,7 @@
 #include "tfd/fault/fault.h"
 #include "tfd/util/file.h"
 #include "tfd/util/jsonlite.h"
+#include "tfd/util/strings.h"
 
 namespace tfd {
 namespace sched {
@@ -28,12 +29,6 @@ uint64_t Fnv1a(const std::string& data) {
     hash *= 1099511628211ULL;
   }
   return hash;
-}
-
-std::string HexU64(uint64_t v) {
-  char buf[17];
-  snprintf(buf, sizeof(buf), "%016llx", static_cast<unsigned long long>(v));
-  return buf;
 }
 
 std::string NumberJson(double v) {
@@ -76,7 +71,13 @@ std::string SerializeState(const PersistedState& state) {
                jsonlite::Quote(from.tier) + ",\"age_s\":" +
                NumberJson(from.age_s) + "}";
   }
-  payload += "}}";
+  payload += "}";
+  // Health state machine state rides along (quarantine must survive
+  // kill -9). Embedded as a raw JSON object; absent/empty means none.
+  if (!state.healthsm_json.empty()) {
+    payload += ",\"healthsm\":" + state.healthsm_json;
+  }
+  payload += "}";
   return std::string(kMagic) + " " + HexU64(Fnv1a(payload)) + " " +
          std::to_string(payload.size()) + "\n" + payload;
 }
@@ -179,6 +180,10 @@ Result<PersistedState> ParseState(const std::string& contents) {
       state.provenance[key] = from;
     }
   }
+  jsonlite::ValuePtr healthsm = root.Get("healthsm");
+  if (healthsm && healthsm->kind == jsonlite::Value::Kind::kObject) {
+    state.healthsm_json = jsonlite::Serialize(*healthsm);
+  }
   return state;
 }
 
@@ -209,7 +214,8 @@ Status SaveState(const std::string& path, const PersistedState& state) {
 
 Result<PersistedState> LoadState(const std::string& path,
                                  const std::string& expect_node,
-                                 double max_age_s, double now_wall) {
+                                 double max_age_s, double now_wall,
+                                 std::string* stale_healthsm_json) {
   using R = Result<PersistedState>;
   Result<std::string> contents = ReadFile(path);
   if (!contents.ok()) return R::Error(contents.error());
@@ -224,6 +230,9 @@ Result<PersistedState> LoadState(const std::string& path,
   if (downtime_s < 0) downtime_s = 0;  // clock stepped back across boot
   double restored_age_s = state->age_s + downtime_s;
   if (restored_age_s > max_age_s) {
+    if (stale_healthsm_json != nullptr) {
+      *stale_healthsm_json = state->healthsm_json;
+    }
     return R::Error("state snapshot age " +
                     std::to_string(static_cast<long long>(restored_age_s)) +
                     "s exceeds the usable window (" +

@@ -45,6 +45,11 @@ struct PersistedState {
   double age_s = 0;       // serving snapshot age at save time
   lm::Labels labels;
   lm::Provenance provenance;
+  // Serialized health state machine (healthsm::HealthTracker
+  // SerializeJson): a chip quarantine must survive kill -9 — a crash
+  // must not launder a flapping source back to trusted. Empty when
+  // nothing was tracked (or the file predates the field).
+  std::string healthsm_json;
 };
 
 // This node's identity for the foreign-node gate.
@@ -64,9 +69,20 @@ Status SaveState(const std::string& path, const PersistedState& state);
 // Load + every gate: parse/checksum/schema via ParseState, then node
 // identity and age. `now_wall` is unix time; the restored age
 // (state.age_s + downtime) must be <= max_age_s.
+//
+// `stale_healthsm_json` (optional): when the ONLY failed gate is
+// staleness — the state is authentic, checksummed, and from this node,
+// just older than the label payload's usable window — it receives the
+// persisted healthsm state. Quarantine has its own clock
+// (quarantine_until is absolute wall time), so an active quarantine
+// must survive even a long crash loop: expiring it with the labels
+// would launder a flapping chip back to trusted. Untouched on success
+// and on every other rejection (corrupt/foreign state is never
+// trusted).
 Result<PersistedState> LoadState(const std::string& path,
                                  const std::string& expect_node,
-                                 double max_age_s, double now_wall);
+                                 double max_age_s, double now_wall,
+                                 std::string* stale_healthsm_json = nullptr);
 
 }  // namespace sched
 }  // namespace tfd

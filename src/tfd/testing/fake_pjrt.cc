@@ -34,6 +34,17 @@
 //                            client — a SLOW (but healthy) init, the
 //                            cold-node shape the async scheduler serves
 //                            metadata-only labels through
+//   TFD_FAKE_PJRT_FLAP_EVERY_N  alternate the visible topology every N
+//                            client creations: blocks of N healthy
+//                            creations (full BOUNDS grid) alternate
+//                            with blocks of N degraded ones (x-bound
+//                            halved — the flaky-ICI-link shape where a
+//                            probe SUCCEEDS but sees fewer chips).
+//                            N=1 flaps every creation. The creation
+//                            index is derived from COUNT_FILE when set
+//                            (the watchdog loads this plugin in a fresh
+//                            child per probe, so an in-process counter
+//                            would reset every time).
 //
 // Host-pinning emulation (mirrors real libtpu semantics): when
 // TPU_HOST_BOUNDS or TPU_PROCESS_BOUNDS is "1,1,1", the client creates
@@ -119,11 +130,24 @@ PJRT_Error* PluginAttributes(PJRT_Plugin_Attributes_Args* args) {
 
 // --- Client ---
 PJRT_Error* ClientCreate(PJRT_Client_Create_Args* args) {
+  static int g_creations = 0;  // per-process fallback for the flap index
+  g_creations++;
+  int creation_index = g_creations;
   std::string count_file = EnvStr("TFD_FAKE_PJRT_COUNT_FILE", "");
   if (!count_file.empty()) {
     if (FILE* f = fopen(count_file.c_str(), "a")) {
       fputs("create\n", f);
       fclose(f);
+    }
+    // Cross-process creation index: the line just appended is ours.
+    if (FILE* f = fopen(count_file.c_str(), "r")) {
+      int lines = 0;
+      int c;
+      while ((c = fgetc(f)) != EOF) {
+        if (c == '\n') lines++;
+      }
+      fclose(f);
+      if (lines > 0) creation_index = lines;
     }
   }
 
@@ -236,6 +260,14 @@ PJRT_Error* ClientCreate(PJRT_Client_Create_Args* args) {
       pos = comma + 1;
     }
     while (bounds.size() < 3) bounds.push_back(1);
+  }
+  // Flap emulation: alternate blocks of N creations between the full
+  // grid and a halved one — every probe SUCCEEDS, but the facts flip,
+  // which is exactly the content-flapping the health state machine's
+  // fingerprint comparison must catch.
+  int flap_every = EnvInt("TFD_FAKE_PJRT_FLAP_EVERY_N", 0);
+  if (flap_every > 0 && ((creation_index - 1) / flap_every) % 2 == 1) {
+    bounds[0] = bounds[0] > 1 ? bounds[0] / 2 : 1;
   }
   int total_chips = bounds[0] * bounds[1] * bounds[2];
   int chips_per_host = total_chips / (hosts > 0 ? hosts : 1);

@@ -24,8 +24,10 @@
 #include "tfd/config/yamllite.h"
 #include "tfd/fault/fault.h"
 #include "tfd/gce/metadata.h"
+#include "tfd/healthsm/healthsm.h"
 #include "tfd/k8s/breaker.h"
 #include "tfd/k8s/client.h"
+#include "tfd/lm/governor.h"
 #include "tfd/lm/labels.h"
 #include "tfd/lm/merge.h"
 #include "tfd/lm/schema.h"
@@ -1895,6 +1897,607 @@ void TestCircuitBreaker() {
   CHECK_EQ(breaker.consecutive_failures(), 0);
 }
 
+// ---- health state machine (healthsm/) ------------------------------------
+
+void TestSnapshotFingerprintIgnoresMeasurements() {
+  // Measured health values (probe-ms, throughput numbers) move between
+  // re-measures on perfectly healthy silicon; the flap fingerprint must
+  // only see the structural verdicts, or every health re-measure reads
+  // as content instability.
+  sched::Snapshot a;
+  a.labels = {{"google.com/tpu.health.ok", "true"},
+              {"google.com/tpu.health.devices", "4"},
+              {"google.com/tpu.health.device-0-ok", "true"},
+              {"google.com/tpu.health.probe-ms", "812"},
+              {"google.com/tpu.health.matmul-tflops", "918"}};
+  sched::Snapshot b = a;
+  b.labels["google.com/tpu.health.probe-ms"] = "977";
+  b.labels["google.com/tpu.health.matmul-tflops"] = "912";
+  CHECK_EQ(SnapshotFingerprint(a), SnapshotFingerprint(b));
+
+  // A source-level structural change (aggregate verdict, chip count,
+  // any non-health fact) DOES move it...
+  sched::Snapshot c = a;
+  c.labels["google.com/tpu.health.ok"] = "false";
+  CHECK_TRUE(SnapshotFingerprint(c) != SnapshotFingerprint(a));
+  sched::Snapshot d = a;
+  d.labels["google.com/tpu.count"] = "2";
+  CHECK_TRUE(SnapshotFingerprint(d) != SnapshotFingerprint(a));
+
+  // ...but a per-chip device line does NOT: each chip has its own
+  // healthsm entry, and hashing its verdict into the source
+  // fingerprint too would let one flapping chip quarantine the whole
+  // source instead of quarantining alone.
+  sched::Snapshot e = a;
+  e.labels["google.com/tpu.health.device-0-ok"] = "false";
+  CHECK_EQ(SnapshotFingerprint(e), SnapshotFingerprint(a));
+}
+
+void TestHealthStateMachineTransitions() {
+  healthsm::Policy policy;
+  policy.flap_window_s = 60;
+  policy.flap_threshold = 100;  // flap detection out of the way here
+  policy.unhealthy_after = 2;
+  policy.recover_after = 3;
+  policy.quarantine_cooldown_s = 30;
+  healthsm::HealthTracker tracker(policy);
+  double t = 1000;
+  using S = healthsm::State;
+
+  // Unknown keys are healthy; a clean observation keeps them there.
+  CHECK_TRUE(tracker.StateOf("pjrt", t) == S::kHealthy);
+  CHECK_TRUE(tracker.Observe("pjrt", true, 7, t) == S::kHealthy);
+
+  // healthy -> suspect on the first failure; clean -> straight back.
+  CHECK_TRUE(tracker.Observe("pjrt", false, 0, t += 1) == S::kSuspect);
+  CHECK_TRUE(tracker.Observe("pjrt", true, 7, t += 1) == S::kHealthy);
+
+  // suspect hardens into unhealthy after unhealthy_after failures.
+  CHECK_TRUE(tracker.Observe("pjrt", false, 0, t += 1) == S::kSuspect);
+  CHECK_TRUE(tracker.Observe("pjrt", false, 0, t += 1) == S::kUnhealthy);
+  // Further failures stay unhealthy.
+  CHECK_TRUE(tracker.Observe("pjrt", false, 0, t += 1) == S::kUnhealthy);
+
+  // unhealthy -> recovering on the first clean probe; recover_after
+  // consecutive cleans close it healthy — and a failure mid-recovery
+  // falls back to unhealthy.
+  CHECK_TRUE(tracker.Observe("pjrt", true, 7, t += 1) == S::kRecovering);
+  CHECK_TRUE(tracker.Observe("pjrt", false, 0, t += 1) == S::kUnhealthy);
+  CHECK_TRUE(tracker.Observe("pjrt", true, 7, t += 1) == S::kRecovering);
+  CHECK_TRUE(tracker.Observe("pjrt", true, 7, t += 1) == S::kRecovering);
+  CHECK_TRUE(tracker.Observe("pjrt", true, 7, t += 1) == S::kHealthy);
+
+  // A successful probe whose CONTENT moved is suspect, not clean: the
+  // fingerprint comparison is what catches a source whose facts
+  // alternate while every probe "works".
+  CHECK_TRUE(tracker.Observe("pjrt", true, 8, t += 1) == S::kSuspect);
+  CHECK_TRUE(tracker.Observe("pjrt", true, 8, t += 1) == S::kHealthy);
+}
+
+void TestHealthStateMachineDebounceBoundaries() {
+  healthsm::Policy policy;
+  policy.flap_window_s = 60;
+  policy.flap_threshold = 100;
+  policy.unhealthy_after = 3;
+  policy.recover_after = 2;
+  healthsm::HealthTracker tracker(policy);
+  double t = 0;
+  using S = healthsm::State;
+
+  // Exactly unhealthy_after-1 failures stay suspect; the Nth hardens.
+  CHECK_TRUE(tracker.Observe("m", false, 0, t += 1) == S::kSuspect);
+  CHECK_TRUE(tracker.Observe("m", false, 0, t += 1) == S::kSuspect);
+  CHECK_TRUE(tracker.Observe("m", false, 0, t += 1) == S::kUnhealthy);
+  // Exactly recover_after cleans close recovery — not one sooner.
+  CHECK_TRUE(tracker.Observe("m", true, 1, t += 1) == S::kRecovering);
+  CHECK_TRUE(tracker.Observe("m", true, 1, t += 1) == S::kHealthy);
+}
+
+void TestHealthStateMachineFlapQuarantine() {
+  healthsm::Policy policy;
+  policy.flap_window_s = 10;
+  policy.flap_threshold = 3;
+  policy.quarantine_cooldown_s = 30;
+  policy.recover_after = 2;
+  healthsm::HealthTracker tracker(policy);
+  double t = 1000;
+  using S = healthsm::State;
+
+  // ok/fail alternation: each flip is a transition; the third inside
+  // the window quarantines.
+  tracker.Observe("h", true, 5, t += 1);
+  tracker.Observe("h", false, 0, t += 1);     // -> suspect (flap 1)
+  tracker.Observe("h", true, 5, t += 1);      // -> healthy (flap 2)
+  CHECK_TRUE(tracker.Observe("h", false, 0, t += 1) == S::kQuarantined);
+  CHECK_TRUE(tracker.Quarantined("h", t));
+  CHECK_EQ(tracker.QuarantinedKeys(t).size(), static_cast<size_t>(1));
+
+  // During the cooldown even clean probes do not start recovery, and a
+  // failure re-arms it.
+  CHECK_TRUE(tracker.Observe("h", true, 5, t += 1) == S::kQuarantined);
+  CHECK_TRUE(tracker.Observe("h", false, 0, t += 1) == S::kQuarantined);
+  // Past the (re-armed) cooldown: clean -> recovering -> healthy after
+  // recover_after cleans.
+  t += 31;
+  CHECK_TRUE(tracker.Observe("h", true, 5, t) == S::kRecovering);
+  CHECK_TRUE(tracker.Observe("h", true, 5, t += 1) == S::kHealthy);
+}
+
+void TestHealthStateMachineContentFlapQuarantine() {
+  // Every probe SUCCEEDS but the fingerprint alternates — the
+  // FLAP_EVERY_N=1 shape. The window must fill from unstable
+  // observations alone.
+  healthsm::Policy policy;
+  policy.flap_window_s = 100;
+  policy.flap_threshold = 4;
+  policy.quarantine_cooldown_s = 50;
+  healthsm::HealthTracker tracker(policy);
+  double t = 0;
+  using S = healthsm::State;
+  uint64_t fps[2] = {11, 22};
+  S state = S::kHealthy;
+  int observations = 0;
+  for (int i = 0; i < 10 && state != S::kQuarantined; i++) {
+    state = tracker.Observe("pjrt", true, fps[i % 2], t += 1);
+    observations++;
+  }
+  CHECK_TRUE(state == S::kQuarantined);
+  CHECK_TRUE(observations <= 6);  // threshold 4 fills within ~5 flips
+
+  // Content still alternating at the slow cadence: stays quarantined
+  // (every unstable observation re-arms the cooldown).
+  t += 51;
+  CHECK_TRUE(tracker.Observe("pjrt", true, fps[1], t) == S::kQuarantined);
+  CHECK_TRUE(tracker.Quarantined("pjrt", t));
+}
+
+void TestHealthStateMachineWindowExpiry() {
+  healthsm::Policy policy;
+  policy.flap_window_s = 10;
+  policy.flap_threshold = 3;
+  healthsm::HealthTracker tracker(policy);
+  double t = 0;
+  using S = healthsm::State;
+  // Two flap events, then a long quiet gap: the window empties, so two
+  // MORE events later still do not quarantine.
+  tracker.Observe("s", true, 1, t += 1);
+  tracker.Observe("s", false, 0, t += 1);  // flap 1
+  tracker.Observe("s", true, 1, t += 1);   // flap 2
+  t += 60;                                 // window empties
+  tracker.Observe("s", false, 0, t += 1);  // flap 1 (fresh window)
+  CHECK_TRUE(tracker.Observe("s", true, 1, t += 1) == S::kHealthy);
+  CHECK_TRUE(!tracker.Quarantined("s", t));
+}
+
+void TestHealthStateMachineMinThresholdRecovery() {
+  // At the minimum flap threshold the earned-recovery transitions
+  // (quarantine exit, recovering -> healthy) must not count as flap
+  // evidence: the exit pair alone would refill the window and
+  // re-quarantine a perfectly clean key forever.
+  healthsm::Policy policy;
+  policy.flap_window_s = 100;
+  policy.flap_threshold = 2;
+  policy.quarantine_cooldown_s = 5;
+  policy.unhealthy_after = 2;
+  policy.recover_after = 3;
+  healthsm::HealthTracker tracker(policy);
+  double t = 1000;
+  using S = healthsm::State;
+  tracker.Observe("p", false, 0, t += 1);  // -> suspect (flap 1)
+  CHECK_TRUE(tracker.Observe("p", false, 0, t += 1) == S::kQuarantined);
+  t += 6;  // past the cooldown
+  CHECK_TRUE(tracker.Observe("p", true, 1, t += 1) == S::kRecovering);
+  tracker.Observe("p", true, 1, t += 1);
+  CHECK_TRUE(tracker.Observe("p", true, 1, t += 1) == S::kHealthy);
+  // Stays healthy: no livelock from the recovery's own transitions.
+  CHECK_TRUE(tracker.Observe("p", true, 1, t += 1) == S::kHealthy);
+  CHECK_TRUE(!tracker.Quarantined("p", t));
+}
+
+void TestHealthStateMachineGhostRelease() {
+  // A quarantined key that vanishes from the probe stream (chip
+  // replaced/renumbered) can never earn clean-probe recovery; once the
+  // cooldown elapses and a slow re-probe period plus a window passes
+  // unobserved, the hold ends instead of pinning the dead chip's label
+  // forever.
+  healthsm::Policy policy;
+  policy.flap_window_s = 10;
+  policy.flap_threshold = 3;
+  policy.quarantine_cooldown_s = 30;
+  healthsm::HealthTracker tracker(policy);
+  double t = 1000;
+  using S = healthsm::State;
+  tracker.Observe("health/chip-0", true, 0, t += 1);
+  tracker.Observe("health/chip-0", false, 0, t += 1);
+  tracker.Observe("health/chip-0", true, 0, t += 1);
+  tracker.Observe("health/chip-0", false, 0, t += 1);
+  CHECK_TRUE(tracker.Quarantined("health/chip-0", t));
+  // Cooldown not yet elapsed: still held even though unobserved.
+  CHECK_EQ(tracker.QuarantinedKeys(t + 20).size(), static_cast<size_t>(1));
+  // Past the cooldown (30) AND unobserved for cooldown+window (40):
+  // the hold releases as recovering.
+  CHECK_EQ(tracker.QuarantinedKeys(t + 45).size(), static_cast<size_t>(0));
+  CHECK_TRUE(tracker.StateOf("health/chip-0", t + 45) == S::kRecovering);
+  // A key still being observed keeps its quarantine through the same
+  // wall-clock span (failures re-arm the cooldown).
+  tracker.Observe("health/chip-1", true, 0, t += 1);
+  tracker.Observe("health/chip-1", false, 0, t += 1);
+  tracker.Observe("health/chip-1", true, 0, t += 1);
+  tracker.Observe("health/chip-1", false, 0, t += 1);
+  CHECK_TRUE(tracker.Quarantined("health/chip-1", t));
+  tracker.Observe("health/chip-1", false, 0, t + 20);  // re-arms cooldown
+  CHECK_EQ(tracker.QuarantinedKeys(t + 45).size(), static_cast<size_t>(1));
+}
+
+void TestHealthStateMachineReloadPreservesState() {
+  healthsm::Policy policy;
+  policy.flap_window_s = 10;
+  policy.flap_threshold = 3;
+  policy.quarantine_cooldown_s = 30;
+  healthsm::HealthTracker tracker(policy);
+  double t = 0;
+  tracker.Observe("q", true, 1, t += 1);
+  tracker.Observe("q", false, 0, t += 1);
+  tracker.Observe("q", true, 1, t += 1);
+  tracker.Observe("q", false, 0, t += 1);
+  CHECK_TRUE(tracker.Quarantined("q", t));
+  // A SIGHUP-style Configure changes thresholds but never resets state.
+  policy.flap_threshold = 50;
+  tracker.Configure(policy);
+  CHECK_TRUE(tracker.Quarantined("q", t));
+  CHECK_EQ(tracker.policy().flap_threshold, 50);
+}
+
+void TestHealthStateMachineSerializeRestore() {
+  healthsm::Policy policy;
+  policy.flap_window_s = 10;
+  policy.flap_threshold = 3;
+  policy.quarantine_cooldown_s = 300;
+  policy.recover_after = 2;
+  healthsm::HealthTracker tracker(policy);
+  double t = 5000;
+  tracker.Observe("pjrt", true, 42, t += 1);
+  tracker.Observe("pjrt", false, 0, t += 1);
+  tracker.Observe("pjrt", true, 42, t += 1);
+  tracker.Observe("pjrt", false, 0, t += 1);
+  CHECK_TRUE(tracker.Quarantined("pjrt", t));
+  tracker.Observe("health", false, 0, t += 1);  // a suspect rides along
+
+  std::string serialized = tracker.SerializeJson(t);
+  healthsm::HealthTracker restored(policy);
+  Status s = restored.RestoreJson(serialized, t + 1);
+  CHECK_TRUE(s.ok());
+  // The quarantine survives (the kill -9 contract) with its deadline:
+  // still quarantined now, recoverable past the cooldown.
+  CHECK_TRUE(restored.Quarantined("pjrt", t + 1));
+  CHECK_TRUE(restored.StateOf("health", t + 1) ==
+             healthsm::State::kSuspect);
+  using S = healthsm::State;
+  CHECK_TRUE(restored.Observe("pjrt", true, 42, t + 2) == S::kQuarantined);
+  CHECK_TRUE(restored.Observe("pjrt", true, 42, t + 400) == S::kRecovering);
+  CHECK_TRUE(restored.Observe("pjrt", true, 42, t + 401) == S::kHealthy);
+
+  // Garbage never half-applies: the tracker keeps its state.
+  healthsm::HealthTracker untouched(policy);
+  untouched.Observe("x", false, 0, 1);
+  CHECK_TRUE(!untouched.RestoreJson("{not json", 2).ok());
+  CHECK_TRUE(untouched.StateOf("x", 2) == S::kSuspect);
+  CHECK_TRUE(!untouched.RestoreJson("{\"keys\":{\"x\":{\"state\":"
+                                    "\"bogus\"}}}",
+                                    2)
+                  .ok());
+  CHECK_TRUE(untouched.StateOf("x", 2) == S::kSuspect);
+  // An empty string (nothing persisted) is fine and a no-op.
+  CHECK_TRUE(untouched.RestoreJson("", 2).ok());
+}
+
+void TestHealthStateMachineFaultPoint() {
+  // An armed healthsm.transition fault forces observations to
+  // failures — the drill hook for forcing transitions on demand.
+  healthsm::Policy policy;
+  policy.unhealthy_after = 1;
+  healthsm::HealthTracker tracker(policy);
+  CHECK_TRUE(fault::Arm("healthsm.transition:fail:count=1").ok());
+  CHECK_TRUE(tracker.Observe("drill", true, 1, 1) ==
+             healthsm::State::kSuspect);
+  // The count=1 rule is consumed: the next observation is clean.
+  CHECK_TRUE(tracker.Observe("drill", true, 1, 2) ==
+             healthsm::State::kHealthy);
+  fault::Disarm();
+}
+
+// ---- label governor (lm/governor) ----------------------------------------
+
+void TestLabelGovernorHoldDown() {
+  lm::GovernorPolicy policy;
+  policy.hold_down_s = 100;
+  policy.churn_budget = 10;
+  lm::LabelGovernor governor(policy);
+  lm::Provenance no_prov;
+  std::vector<lm::SuppressedFlip> suppressed;
+  double t = 1000;
+
+  // First appearance always passes (a first pass is all appearances).
+  lm::Labels previous;
+  lm::Labels candidate = {{"google.com/tpu.count", "4"},
+                          {"google.com/tpu.backend", "mock"}};
+  lm::Provenance prov;
+  governor.Apply(previous, no_prov, false, t, &candidate, &prov,
+                 &suppressed);
+  governor.CommitPublished();
+  CHECK_TRUE(suppressed.empty());
+  CHECK_EQ(candidate["google.com/tpu.count"], "4");
+
+  // A flip inside the hold-down window is suppressed: the published
+  // value holds, the flip is reported with its would-be value.
+  previous = candidate;
+  candidate["google.com/tpu.count"] = "2";
+  governor.Apply(previous, no_prov, false, t + 10, &candidate, &prov,
+                 &suppressed);
+  governor.CommitPublished();
+  CHECK_EQ(suppressed.size(), static_cast<size_t>(1));
+  CHECK_EQ(suppressed[0].key, "google.com/tpu.count");
+  CHECK_EQ(suppressed[0].op, "changed");
+  CHECK_EQ(suppressed[0].new_value, "2");
+  CHECK_EQ(suppressed[0].reason, "hold-down");
+  CHECK_EQ(candidate["google.com/tpu.count"], "4");
+
+  // Past the window the same change is allowed...
+  suppressed.clear();
+  candidate["google.com/tpu.count"] = "2";
+  governor.Apply(previous, no_prov, false, t + 200, &candidate, &prov,
+                 &suppressed);
+  governor.CommitPublished();
+  CHECK_TRUE(suppressed.empty());
+  CHECK_EQ(candidate["google.com/tpu.count"], "2");
+  // ...and starts a fresh hold-down of its own.
+  previous = candidate;
+  candidate["google.com/tpu.count"] = "4";
+  governor.Apply(previous, no_prov, false, t + 210, &candidate, &prov,
+                 &suppressed);
+  CHECK_EQ(suppressed.size(), static_cast<size_t>(1));
+  CHECK_EQ(candidate["google.com/tpu.count"], "2");
+}
+
+void TestLabelGovernorRemovalAndReadd() {
+  // Remove/add flapping is the classic churn shape: a key REMOVED
+  // within its hold-down holds its value; a key RE-ADDED after a
+  // governed removal is not a "first appearance".
+  lm::GovernorPolicy policy;
+  policy.hold_down_s = 100;
+  policy.churn_budget = 10;
+  lm::LabelGovernor governor(policy);
+  lm::Provenance no_prov;
+  std::vector<lm::SuppressedFlip> suppressed;
+  lm::Labels previous;
+  lm::Labels candidate = {{"google.com/tpu.health.ok", "true"}};
+  lm::Provenance prov;
+  governor.Apply(previous, no_prov, false, 0, &candidate, &prov,
+                 &suppressed);
+  governor.CommitPublished();
+  previous = candidate;
+
+  // Removal within hold-down: held — and the journaled flip cites the
+  // held (previously published) value's provenance, since a removal has
+  // no candidate entry of its own to cite.
+  lm::Provenance prev_prov;
+  prev_prov["google.com/tpu.health.ok"] = {"health", "health", "fresh", 1.0};
+  candidate.clear();
+  governor.Apply(previous, prev_prov, false, 10, &candidate, &prov,
+                 &suppressed);
+  governor.CommitPublished();
+  CHECK_EQ(suppressed.size(), static_cast<size_t>(1));
+  CHECK_EQ(suppressed[0].op, "removed");
+  CHECK_EQ(suppressed[0].provenance.labeler, "health");
+  CHECK_EQ(suppressed[0].provenance.tier, "fresh");
+  CHECK_EQ(candidate["google.com/tpu.health.ok"], "true");
+
+  // Removal after the window: allowed.
+  suppressed.clear();
+  candidate.clear();
+  governor.Apply(previous, no_prov, false, 150, &candidate, &prov,
+                 &suppressed);
+  governor.CommitPublished();
+  CHECK_TRUE(suppressed.empty());
+  CHECK_TRUE(candidate.count("google.com/tpu.health.ok") == 0);
+
+  // Re-add right after the allowed removal: the key is KNOWN (not a
+  // first appearance) and inside the new hold-down -> suppressed.
+  previous = candidate;
+  candidate["google.com/tpu.health.ok"] = "false";
+  suppressed.clear();
+  governor.Apply(previous, no_prov, false, 160, &candidate, &prov,
+                 &suppressed);
+  CHECK_EQ(suppressed.size(), static_cast<size_t>(1));
+  CHECK_EQ(suppressed[0].op, "added");
+  CHECK_TRUE(candidate.count("google.com/tpu.health.ok") == 0);
+}
+
+void TestLabelGovernorMonotoneExemptions() {
+  lm::GovernorPolicy policy;
+  policy.hold_down_s = 100;
+  policy.churn_budget = 10;
+  lm::LabelGovernor governor(policy);
+  lm::Provenance no_prov;
+  std::vector<lm::SuppressedFlip> suppressed;
+  lm::Provenance prov;
+
+  // Downgrade-marker REMOVAL (recovery) is always allowed, even just
+  // after the marker appeared.
+  lm::Labels previous;
+  lm::Labels candidate = {{"google.com/tpu.degraded", "true"},
+                          {"google.com/tpu.snapshot-age-seconds", "12"},
+                          {"google.com/tpu.count", "4"}};
+  governor.Apply(previous, no_prov, false, 0, &candidate, &prov,
+                 &suppressed);
+  governor.CommitPublished();
+  CHECK_TRUE(suppressed.empty());
+  previous = candidate;
+  candidate = {{"google.com/tpu.count", "4"}};
+  governor.Apply(previous, no_prov, false, 5, &candidate, &prov,
+                 &suppressed);
+  governor.CommitPublished();
+  CHECK_TRUE(suppressed.empty());
+  CHECK_TRUE(candidate.count("google.com/tpu.degraded") == 0);
+  CHECK_TRUE(candidate.count("google.com/tpu.snapshot-age-seconds") == 0);
+
+  // A level-improved pass may change anything (metadata -> pjrt
+  // convergence must not be damped).
+  previous = {{"google.com/tpu.backend", "metadata"}};
+  candidate = {{"google.com/tpu.backend", "pjrt"}};
+  lm::LabelGovernor fresh(policy);
+  fresh.NotePublished(previous, 0);
+  suppressed.clear();
+  fresh.Apply(previous, no_prov, true, 1, &candidate, &prov, &suppressed);
+  CHECK_TRUE(suppressed.empty());
+  CHECK_EQ(candidate["google.com/tpu.backend"], "pjrt");
+
+  // Measurement keys are exempt outright — and so is the quarantine
+  // annotation: healthsm's already-debounced verdict, whose re-add
+  // within its own removal's hold-down must never be suppressed (it is
+  // the one label explaining why everything else is held).
+  CHECK_TRUE(!lm::GovernedKey("google.com/tpu.health.probe-ms"));
+  CHECK_TRUE(!lm::GovernedKey("google.com/tpu.health.quarantined"));
+  CHECK_TRUE(!lm::GovernedKey("google.com/tfd.timestamp"));
+  CHECK_TRUE(lm::GovernedKey("google.com/tpu.count"));
+  CHECK_TRUE(lm::GovernedKey("google.com/tpu-vm.present"));
+
+  // snapshot-age mirrors tpu.degraded's outcome: a suppressed marker
+  // re-add drags the age back out too (no torn pair).
+  lm::LabelGovernor paired(policy);
+  previous = {};
+  candidate = {{"google.com/tpu.degraded", "true"},
+               {"google.com/tpu.snapshot-age-seconds", "3"}};
+  suppressed.clear();
+  paired.Apply(previous, no_prov, false, 0, &candidate, &prov, &suppressed);
+  paired.CommitPublished();
+  previous = candidate;
+  candidate = {};
+  paired.Apply(previous, no_prov, false, 1, &candidate, &prov, &suppressed);
+  paired.CommitPublished();  // marker removal: upgrade, allowed
+  previous = candidate;
+  candidate = {{"google.com/tpu.degraded", "true"},
+               {"google.com/tpu.snapshot-age-seconds", "9"}};
+  suppressed.clear();
+  paired.Apply(previous, no_prov, false, 2, &candidate, &prov, &suppressed);
+  CHECK_TRUE(!suppressed.empty());
+  CHECK_TRUE(candidate.count("google.com/tpu.degraded") == 0);
+  CHECK_TRUE(candidate.count("google.com/tpu.snapshot-age-seconds") == 0);
+}
+
+void TestLabelGovernorSliceInvalidRecovery() {
+  // A degraded first pass publishes the SLICE-INVALID sentinel (plus
+  // its zeroed companions); when the overlay recovers one pass later,
+  // the WHOLE converging set must land — suppressing it would pin the
+  // node at explicitly-invalid facts for a full hold-down window. The
+  // reverse flip (INTO the sentinel) stays governed, so the hatch
+  // cannot oscillate.
+  lm::GovernorPolicy policy;
+  policy.hold_down_s = 100;
+  policy.churn_budget = 3;  // tighter than the recovery's change count
+  lm::LabelGovernor governor(policy);
+  lm::Provenance no_prov, prov;
+  std::vector<lm::SuppressedFlip> suppressed;
+
+  lm::Labels previous;
+  lm::Labels candidate = {{"google.com/tpu.product", "SLICE-INVALID"},
+                          {"google.com/tpu.slice.shape", "SLICE-INVALID"},
+                          {"google.com/tpu.count", "0"},
+                          {"google.com/tpu.replicas", "0"},
+                          {"google.com/tpu.memory", "0"}};
+  governor.Apply(previous, no_prov, false, 0, &candidate, &prov,
+                 &suppressed);
+  governor.CommitPublished();
+  CHECK_TRUE(suppressed.empty());
+
+  // Overlay recovers at t=1 (inside hold-down, more changes than the
+  // budget): every key converges anyway.
+  previous = candidate;
+  candidate = {{"google.com/tpu.product", "tpu-v5p"},
+               {"google.com/tpu.slice.shape", "4x4x4"},
+               {"google.com/tpu.count", "4"},
+               {"google.com/tpu.replicas", "4"},
+               {"google.com/tpu.memory", "16384"}};
+  lm::Labels recovered = candidate;
+  suppressed.clear();
+  governor.Apply(previous, no_prov, false, 1, &candidate, &prov,
+                 &suppressed);
+  governor.CommitPublished();
+  CHECK_TRUE(suppressed.empty());
+  CHECK_TRUE(candidate == recovered);
+
+  // Flipping back INTO the sentinel is ordinary churn: suppressed, the
+  // valid facts stay published...
+  previous = candidate;
+  candidate = {{"google.com/tpu.product", "SLICE-INVALID"},
+               {"google.com/tpu.slice.shape", "SLICE-INVALID"},
+               {"google.com/tpu.count", "0"},
+               {"google.com/tpu.replicas", "0"},
+               {"google.com/tpu.memory", "0"}};
+  suppressed.clear();
+  governor.Apply(previous, no_prov, false, 2, &candidate, &prov,
+                 &suppressed);
+  governor.CommitPublished();
+  CHECK_EQ(suppressed.size(), 5u);
+  CHECK_TRUE(candidate == recovered);
+
+  // ...so a subsequent "recovery" pass sees no published sentinel and
+  // gets no free flip either (candidate == published already).
+  previous = candidate;
+  candidate = recovered;
+  suppressed.clear();
+  governor.Apply(previous, no_prov, false, 3, &candidate, &prov,
+                 &suppressed);
+  CHECK_TRUE(suppressed.empty());
+  CHECK_TRUE(candidate == recovered);
+}
+
+void TestLabelGovernorChurnBudgetAndCommit() {
+  lm::GovernorPolicy policy;
+  policy.hold_down_s = 100;
+  policy.churn_budget = 2;
+  lm::LabelGovernor governor(policy);
+  lm::Provenance no_prov;
+  std::vector<lm::SuppressedFlip> suppressed;
+  lm::Provenance prov;
+  lm::Labels previous = {{"google.com/tpu.a", "1"},
+                         {"google.com/tpu.b", "1"},
+                         {"google.com/tpu.c", "1"},
+                         {"google.com/tpu.d", "1"}};
+  lm::LabelGovernor seeded(policy);
+  seeded.NotePublished(previous, -200);  // hold-downs long expired
+  // Four keys want to change at once; the budget admits two.
+  lm::Labels candidate = {{"google.com/tpu.a", "2"},
+                          {"google.com/tpu.b", "2"},
+                          {"google.com/tpu.c", "2"},
+                          {"google.com/tpu.d", "2"}};
+  seeded.Apply(previous, no_prov, false, 0, &candidate, &prov, &suppressed);
+  seeded.CommitPublished();
+  CHECK_EQ(suppressed.size(), static_cast<size_t>(2));
+  CHECK_EQ(suppressed[0].reason, "churn-budget");
+  int changed = 0;
+  for (const auto& [key, value] : candidate) {
+    if (value == "2") changed++;
+  }
+  CHECK_EQ(changed, 2);
+
+  // Pending-change semantics: an Apply whose publish never lands (no
+  // CommitPublished) must not burn the hold-down timer — the retry of
+  // the SAME change passes.
+  lm::LabelGovernor uncommitted(policy);
+  lm::Labels prev2 = {{"google.com/tpu.x", "1"}};
+  uncommitted.NotePublished(prev2, -200);
+  lm::Labels cand2 = {{"google.com/tpu.x", "2"}};
+  suppressed.clear();
+  uncommitted.Apply(prev2, no_prov, false, 0, &cand2, &prov, &suppressed);
+  CHECK_TRUE(suppressed.empty());  // allowed; sink then "fails"
+  cand2 = {{"google.com/tpu.x", "2"}};
+  suppressed.clear();
+  uncommitted.Apply(prev2, no_prov, false, 1, &cand2, &prov, &suppressed);
+  CHECK_TRUE(suppressed.empty());  // not suppressed by its own ghost
+  CHECK_EQ(cand2["google.com/tpu.x"], "2");
+}
+
 void TestStateRoundTrip() {
   sched::PersistedState state;
   state.node = "unit-node";
@@ -1911,6 +2514,7 @@ void TestStateRoundTrip() {
   from.tier = "fresh";
   from.age_s = 12.5;
   state.provenance["google.com/tpu.count"] = from;
+  state.healthsm_json = "{\"keys\":{}}";
 
   std::string framed = sched::SerializeState(state);
   CHECK_TRUE(framed.rfind("TFDSTATE1 ", 0) == 0);
@@ -1946,14 +2550,21 @@ void TestStateRoundTrip() {
       sched::LoadState(path, "unit-node", 600, 1060.0);
   CHECK_TRUE(loaded.ok());
   CHECK_TRUE(loaded->age_s > 72.0 && loaded->age_s < 73.0);  // 12.5 + 60
-  // Foreign node: rejected by identity, not served.
-  bad = sched::LoadState(path, "other-node", 600, 1060.0);
+  // Foreign node: rejected by identity, not served — and the healthsm
+  // payload is NOT handed out (a foreign quarantine must not transfer).
+  std::string stale_health = "untouched";
+  bad = sched::LoadState(path, "other-node", 600, 1060.0, &stale_health);
   CHECK_TRUE(!bad.ok());
   CHECK_TRUE(bad.error().find("foreign") != std::string::npos);
-  // Stale: the facts expired while the daemon was down.
-  bad = sched::LoadState(path, "unit-node", 600, 1000.0 + 3600);
+  CHECK_EQ(stale_health, "untouched");
+  // Stale: the facts expired while the daemon was down — but the
+  // authentic healthsm payload survives the rejection (quarantine has
+  // its own clock; a long crash loop must not launder it).
+  bad = sched::LoadState(path, "unit-node", 600, 1000.0 + 3600,
+                         &stale_health);
   CHECK_TRUE(!bad.ok());
   CHECK_TRUE(bad.error().find("expired") != std::string::npos);
+  CHECK_EQ(stale_health, "{\"keys\":{}}");
   // The injected torn write is exactly what the checksum gate catches.
   CHECK_TRUE(fault::Arm("state.write:torn:count=1").ok());
   CHECK_TRUE(sched::SaveState(path, state).ok());  // "succeeds"
@@ -2165,6 +2776,22 @@ int main(int argc, char** argv) {
   tfd::TestFaultSpecParse();
   tfd::TestFaultSinkFile();
   tfd::TestCircuitBreaker();
+  tfd::TestSnapshotFingerprintIgnoresMeasurements();
+  tfd::TestHealthStateMachineTransitions();
+  tfd::TestHealthStateMachineDebounceBoundaries();
+  tfd::TestHealthStateMachineFlapQuarantine();
+  tfd::TestHealthStateMachineContentFlapQuarantine();
+  tfd::TestHealthStateMachineWindowExpiry();
+  tfd::TestHealthStateMachineMinThresholdRecovery();
+  tfd::TestHealthStateMachineGhostRelease();
+  tfd::TestHealthStateMachineReloadPreservesState();
+  tfd::TestHealthStateMachineSerializeRestore();
+  tfd::TestHealthStateMachineFaultPoint();
+  tfd::TestLabelGovernorHoldDown();
+  tfd::TestLabelGovernorRemovalAndReadd();
+  tfd::TestLabelGovernorMonotoneExemptions();
+  tfd::TestLabelGovernorSliceInvalidRecovery();
+  tfd::TestLabelGovernorChurnBudgetAndCommit();
   tfd::TestStateRoundTrip();
   tfd::TestRenameErrorDeviceIds();
   tfd::TestHttpDeadlineBudget();

@@ -2,6 +2,7 @@
 
 #include <algorithm>
 #include <cctype>
+#include <cstdio>
 #include <sstream>
 
 namespace tfd {
@@ -104,6 +105,12 @@ bool ParseNonNegInt(const std::string& s, int* out) {
   if (v > 2147483647LL) return false;
   *out = static_cast<int>(v);
   return true;
+}
+
+std::string HexU64(uint64_t v) {
+  char buf[17];
+  snprintf(buf, sizeof(buf), "%016llx", static_cast<unsigned long long>(v));
+  return buf;
 }
 
 }  // namespace tfd

@@ -1,6 +1,7 @@
 // Small string helpers shared across labelers and config parsing.
 #pragma once
 
+#include <cstdint>
 #include <string>
 #include <vector>
 
@@ -29,5 +30,8 @@ std::string StrictLabelValue(const std::string& s);
 // (std::stoi's partial parsing accepts trailing garbage like "3abc").
 // False on empty, non-digit, or out-of-int-range input.
 bool ParseNonNegInt(const std::string& s, int* out);
+// Fixed-width (16 digit) lowercase hex — the state-file checksum and
+// the healthsm fingerprint serialization share one format.
+std::string HexU64(uint64_t v);
 
 }  // namespace tfd

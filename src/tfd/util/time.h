@@ -1,0 +1,17 @@
+// Wall-clock helper shared by the daemon loop, the probe broker, and
+// logging: unix time as fractional seconds. Kept in one place so the
+// clock source can be adjusted (fault injection, clock stepping)
+// without hunting down hand-rolled copies.
+#pragma once
+
+#include <chrono>
+
+namespace tfd {
+
+inline double WallClockSeconds() {
+  return std::chrono::duration<double>(
+             std::chrono::system_clock::now().time_since_epoch())
+      .count();
+}
+
+}  // namespace tfd

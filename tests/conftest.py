@@ -127,16 +127,31 @@ def _build():
                    capture_output=True)
 
 
+def _binaries_stale():
+    """True when any C++ source/header (or CMakeLists.txt) is newer than
+    the built artifacts — an exists()-only check once let a whole tier-1
+    run silently validate a binary predating the edits under test."""
+    targets = [BINARY, UNIT_TESTS]
+    if any(not t.exists() for t in targets):
+        return True
+    built = min(t.stat().st_mtime for t in targets)
+    sources = [REPO / "CMakeLists.txt",
+               REPO / "cmd/tpu-feature-discovery/main.cc"]
+    for pattern in ("*.cc", "*.h"):
+        sources.extend((REPO / "src/tfd").rglob(pattern))
+    return any(s.stat().st_mtime > built for s in sources if s.exists())
+
+
 @pytest.fixture(scope="session")
 def tfd_binary():
-    if not BINARY.exists() or not UNIT_TESTS.exists():
+    if _binaries_stale():
         _build()
     return BINARY
 
 
 @pytest.fixture(scope="session")
 def unit_test_binary():
-    if not UNIT_TESTS.exists():
+    if _binaries_stale():
         _build()
     return UNIT_TESTS
 

@@ -1,0 +1,323 @@
+"""Tier 2/3: the anti-flap layer (ISSUE 5) against the real binary —
+health state machine, label hold-down governor, and chip quarantine.
+
+The contracts under test:
+  - a source flapping every pass (fake_pjrt FLAP_EVERY_N=1: every
+    successful probe sees a different topology) produces <=2
+    google.com/tpu.* label changes over a 30-pass soak — the governor
+    holds the published set at last-good while the state machine
+    quarantines the source (tfd_health_state == 3), every suppressed
+    flip journaled ("flap-suppressed", full provenance) and counted in
+    tfd_label_flaps_suppressed_total;
+  - a SIGHUP reload reconfigures thresholds without resetting the
+    quarantine;
+  - the quarantine survives a kill -9 warm restart (it rides in the
+    state file): the restarted daemon is quarantined BEFORE the flap
+    window could possibly refill;
+  - a single flapping chip line from the health exec
+    (google.com/tpu.health.device-<i>-ok) quarantines that CHIP, holds
+    its label at last-good, and annotates the set
+    google.com/tpu.health.quarantined=true;
+  - every journaled health-transition is an edge the machine can
+    legally make (checked against the tpufd.healthsm twin).
+"""
+
+import json
+import os
+import signal
+import subprocess
+
+from conftest import BUILD_DIR, http_get, labels_of, wait_for
+from tpufd import healthsm as healthsm_lib
+from tpufd import journal as tpufd_journal
+from tpufd import metrics
+from tpufd.fakes import free_loopback_port as free_port
+
+FAKE_PJRT = BUILD_DIR / "libtfd_fake_pjrt.so"
+
+# Keys that legitimately change every pass (the soak's stable_digest
+# exclusions): everything else under google.com/tpu* must hold.
+VOLATILE = ("google.com/tfd.timestamp", "google.com/tpu.health.probe-ms")
+
+
+def journal_events(port):
+    status, body = http_get(port, "/debug/journal")
+    if status != 200:
+        return []
+    try:
+        return tpufd_journal.parse_journal(json.loads(body))["events"]
+    except (ValueError, KeyError):
+        return []
+
+
+def scrape(port, name, labels=None):
+    status, text = http_get(port, "/metrics")
+    if status != 200:
+        return None
+    try:
+        return metrics.sample_value(text, name, labels=labels)
+    except ValueError:
+        return None
+
+
+def read_labels(out_file):
+    try:
+        return labels_of(out_file.read_text())
+    except (OSError, ValueError):
+        return {}
+
+
+def governed_view(labels):
+    return {k: v for k, v in labels.items() if k not in VOLATILE}
+
+
+def launch(argv, env_extra=None):
+    env = {**os.environ, "GCE_METADATA_HOST": "127.0.0.1:1",
+           **(env_extra or {})}
+    return subprocess.Popen(argv, env=env, stderr=subprocess.DEVNULL)
+
+
+def flap_argv(binary, port, out_file, state_file):
+    """Daemon against the flapping fake PJRT plugin: per-pass probes
+    (no snapshot cache, no failure memo), tight anti-flap thresholds so
+    quarantine engages within a handful of 1s passes."""
+    return [str(binary), "--sleep-interval=1s", "--backend=pjrt",
+            f"--libtpu-path={FAKE_PJRT}",
+            "--pjrt-refresh-interval=0", "--pjrt-retry-backoff=0",
+            "--pjrt-init-timeout=10s", "--machine-type-file=/dev/null",
+            "--snapshot-usable-for=60s",
+            f"--output-file={out_file}", f"--state-file={state_file}",
+            # Threshold 5 (not the minimum): quarantine lands ~5 probes
+            # in, so a few rewrites SEE flipped content first and the
+            # governor's suppressions are exercised, not just the
+            # post-quarantine hold.
+            "--health-flap-window=10s", "--health-flap-threshold=5",
+            "--quarantine-cooldown=5s",
+            f"--introspection-addr=127.0.0.1:{port}"]
+
+
+class TestFlapGovernorAndQuarantine:
+    def test_flap_every_pass_quarantines_and_holds_labels(
+            self, tfd_binary, tmp_path):
+        """The ISSUE 5 acceptance: FLAP_EVERY_N=1 alternates the visible
+        topology on every successful probe. Over a 30-pass soak the
+        published google.com/tpu.* set changes at most twice, every
+        suppression is journaled with provenance and counted, the
+        source is quarantined — and the quarantine survives both a
+        SIGHUP reload and a kill -9 warm restart."""
+        out_file = tmp_path / "tfd"
+        state_file = tmp_path / "state"
+        count_file = tmp_path / "creates"
+        port = free_port()
+        argv = flap_argv(tfd_binary, port, out_file, state_file)
+        env = {"TFD_FAKE_PJRT_FLAP_EVERY_N": "1",
+               "TFD_FAKE_PJRT_COUNT_FILE": str(count_file),
+               "TFD_FAKE_PJRT_KIND": "TPU v5 lite",
+               "TFD_FAKE_PJRT_BOUNDS": "2,2,1"}
+        proc = launch(argv, env)
+        observed = []  # distinct governed label sets, in order
+        try:
+            last_gen = 0
+            assert wait_for(lambda: (scrape(port, "tfd_rewrites_total")
+                                     or 0) >= 1, timeout=60)
+            deadline_passes = 30
+            while last_gen < deadline_passes:
+                assert proc.poll() is None, "daemon died mid-soak"
+                gen = scrape(port, "tfd_rewrites_total") or 0
+                if gen > last_gen:
+                    last_gen = gen
+                    labels = governed_view(read_labels(out_file))
+                    if labels and (not observed or observed[-1] != labels):
+                        observed.append(labels)
+                assert wait_for(
+                    lambda g=last_gen: (scrape(port, "tfd_rewrites_total")
+                                        or 0) > g or last_gen >=
+                    deadline_passes, timeout=30)
+
+            # <=2 label-set changes over the soak (first observation is
+            # not a change).
+            assert len(observed) - 1 <= 2, (
+                f"label set changed {len(observed) - 1} times: {observed}")
+            # The held set is the FIRST probe's facts (4 chips), never
+            # the flap side's.
+            assert observed[-1]["google.com/tpu.count"] == "4"
+            assert observed[-1]["google.com/tpu.backend"] == "pjrt"
+            # Quarantined, annotated, counted.
+            assert scrape(port, "tfd_health_state",
+                          labels={"source": "pjrt"}) == 3
+            assert read_labels(out_file)[
+                "google.com/tpu.health.quarantined"] == "true"
+            assert (scrape(port, "tfd_quarantines_total",
+                           labels={"source": "pjrt"}) or 0) >= 1
+
+            # Suppressions: probes and rewrites are independent threads,
+            # so the quarantine CAN engage before any flipped snapshot
+            # reaches a rewrite — then the hold (not the governor) did
+            # all the damping and zero suppressions is legitimate. The
+            # journal and the counter must agree either way, and every
+            # suppression that did happen carries full provenance. (The
+            # governor's suppression logic itself is pinned
+            # deterministically by the C++ unit suite.)
+            events = journal_events(port)
+            suppressions = healthsm_lib.flap_suppressions(events)
+            suppressed_total = scrape(
+                port, "tfd_label_flaps_suppressed_total",
+                labels={"key_prefix": "google.com/tpu"})
+            if suppressions:
+                assert (suppressed_total or 0) >= 1
+                for event in tpufd_journal.events_of_type(
+                        events, "flap-suppressed"):
+                    assert event["fields"]["key"]
+                    assert event["fields"]["reason"] in ("hold-down",
+                                                         "churn-budget")
+                    assert event["fields"]["labeler"]
+            else:
+                assert suppressed_total is None, (
+                    "counter incremented but no flap-suppressed journal "
+                    "events")
+            assert healthsm_lib.illegal_transitions(events) == [], (
+                healthsm_lib.health_transitions(events))
+
+            # SIGHUP: thresholds reload, quarantine survives.
+            proc.send_signal(signal.SIGHUP)
+            assert wait_for(
+                lambda: (scrape(port, "tfd_config_generation") or 0) >= 2,
+                timeout=30)
+            assert scrape(port, "tfd_health_state",
+                          labels={"source": "pjrt"}) == 3, (
+                "SIGHUP reset the quarantine")
+        finally:
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=10)
+
+        # kill -9 warm restart: the quarantine rides in the state file.
+        # The probe is wedged for the whole check, so the quarantined
+        # gauge can only have come from the restored state — the flap
+        # window never had a chance to refill.
+        proc = launch(argv + ["--fault-spec=probe.pjrt:hang=30s"], env)
+        try:
+            assert wait_for(
+                lambda: tpufd_journal.events_of_type(
+                    journal_events(port), "health-restored"), timeout=30)
+            restored = tpufd_journal.events_of_type(
+                journal_events(port), "health-restored")[0]
+            assert "pjrt" in restored["fields"]["quarantined"]
+            assert wait_for(
+                lambda: scrape(port, "tfd_health_state",
+                               labels={"source": "pjrt"}) == 3, timeout=10)
+            # The warm pass re-serves the held labels, annotation intact.
+            assert wait_for(
+                lambda: read_labels(out_file).get(
+                    "google.com/tpu.health.quarantined") == "true",
+                timeout=15)
+            assert read_labels(out_file)["google.com/tpu.count"] == "4"
+        finally:
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=10)
+
+
+class TestChipQuarantine:
+    def test_flapping_chip_line_quarantines_chip_and_holds_label(
+            self, tfd_binary, tmp_path):
+        """A health exec whose device-0 line alternates true/false:
+        chip 0 gets its own state machine entry, is quarantined, and
+        its label holds at last-good while the stable chip 1 line and
+        the rest of the set keep publishing normally."""
+        out_file = tmp_path / "tfd"
+        counter = tmp_path / "flap-counter"
+        port = free_port()
+        # Alternates device-0-ok true/false per run; device-1-ok is
+        # always true. The counter file makes the flap cross-process.
+        exec_script = tmp_path / "health-exec.sh"
+        exec_script.write_text(
+            "#!/bin/sh\n"
+            f"n=$(cat {counter} 2>/dev/null || echo 0)\n"
+            f"echo $((n+1)) > {counter}\n"
+            "echo google.com/tpu.health.ok=true\n"
+            "if [ $((n % 2)) -eq 0 ]; then\n"
+            "  echo google.com/tpu.health.device-0-ok=true\n"
+            "else\n"
+            "  echo google.com/tpu.health.device-0-ok=false\n"
+            "fi\n"
+            "echo google.com/tpu.health.device-1-ok=true\n")
+        exec_script.chmod(0o755)
+        argv = [str(tfd_binary), "--sleep-interval=1s", "--backend=pjrt",
+                f"--libtpu-path={FAKE_PJRT}",
+                "--machine-type-file=/dev/null",
+                f"--output-file={out_file}",
+                "--device-health=full",
+                f"--health-exec=sh {exec_script}",
+                "--health-exec-timeout=10s", "--health-exec-interval=1s",
+                "--health-flap-window=10s", "--health-flap-threshold=3",
+                "--quarantine-cooldown=5s",
+                f"--introspection-addr=127.0.0.1:{port}"]
+        proc = launch(argv, {"TFD_FAKE_PJRT_KIND": "TPU v5 lite",
+                             "TFD_FAKE_PJRT_BOUNDS": "2,2,1"})
+        try:
+            assert wait_for(
+                lambda: "google.com/tpu.health.device-0-ok" in
+                read_labels(out_file), timeout=60)
+            held = read_labels(out_file)["google.com/tpu.health.device-0-ok"]
+            # Chip 0 flaps its way into quarantine; chip 1 stays clean.
+            assert wait_for(
+                lambda: scrape(port, "tfd_health_state",
+                               labels={"source": "health/chip-0"}) == 3,
+                timeout=60), "chip 0 never quarantined"
+            assert scrape(port, "tfd_health_state",
+                          labels={"source": "health/chip-1"}) in (0, None)
+            # The annotation lands on the next rewrite after quarantine.
+            assert wait_for(
+                lambda: read_labels(out_file).get(
+                    "google.com/tpu.health.quarantined") == "true",
+                timeout=15)
+            labels = read_labels(out_file)
+            # The chip's label holds at what was last published — no
+            # further flips reach the file.
+            assert labels["google.com/tpu.health.device-0-ok"] == held
+            assert labels["google.com/tpu.health.device-1-ok"] == "true"
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+
+class TestTwinParity:
+    """tpufd.healthsm mirrors the C++ transition rules (the same edges
+    the unit suite pins)."""
+
+    def test_flap_quarantine_and_recovery(self):
+        sm = healthsm_lib.HealthStateMachine(healthsm_lib.Policy(
+            flap_window_s=10, flap_threshold=3, quarantine_cooldown_s=30,
+            recover_after=2))
+        t = 1000
+        assert sm.observe("h", True, 5, t) == healthsm_lib.HEALTHY
+        assert sm.observe("h", False, None, t + 1) == healthsm_lib.SUSPECT
+        assert sm.observe("h", True, 5, t + 2) == healthsm_lib.HEALTHY
+        assert sm.observe("h", False, None,
+                          t + 3) == healthsm_lib.QUARANTINED
+        # Clean during cooldown: held; failure re-arms; past cooldown:
+        # recovering then healthy.
+        assert sm.observe("h", True, 5, t + 4) == healthsm_lib.QUARANTINED
+        assert sm.observe("h", False, None,
+                          t + 5) == healthsm_lib.QUARANTINED
+        assert sm.observe("h", True, 5, t + 36) == healthsm_lib.RECOVERING
+        assert sm.observe("h", True, 5, t + 37) == healthsm_lib.HEALTHY
+        assert healthsm_lib.illegal_transitions([]) == []
+        for edge in zip([s for _, s, _ in sm.transitions],
+                        [d for _, _, d in sm.transitions]):
+            assert edge in healthsm_lib.LEGAL_TRANSITIONS
+
+    def test_content_flap_quarantines(self):
+        sm = healthsm_lib.HealthStateMachine(healthsm_lib.Policy(
+            flap_window_s=100, flap_threshold=4))
+        state = healthsm_lib.HEALTHY
+        for i in range(10):
+            state = sm.observe("pjrt", True, [11, 22][i % 2], i)
+            if state == healthsm_lib.QUARANTINED:
+                break
+        assert state == healthsm_lib.QUARANTINED
+
+    def test_gauge_encoding_matches(self):
+        assert healthsm_lib.STATE_GAUGE_VALUES == {
+            "healthy": 0, "suspect": 1, "unhealthy": 2,
+            "quarantined": 3, "recovering": 4}
+        assert healthsm_lib.state_name(3) == "quarantined"

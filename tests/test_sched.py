@@ -32,6 +32,23 @@ def degradation_level(port):
     return metrics.sample_value(text, "tfd_probe_degradation_level")
 
 
+def scrape_sample(port, name, timeout=15):
+    """sample_value from /metrics, retried until the sample appears — a
+    single unretried http_get (2s timeout, (None, "") on failure) flakes
+    under full-suite CI load."""
+    found = {}
+
+    def attempt():
+        value = metrics.sample_value(http_get(port, "/metrics")[1], name)
+        if value is None:
+            return False
+        found["value"] = value
+        return True
+
+    assert wait_for(attempt, timeout=timeout), f"no {name} sample scraped"
+    return found["value"]
+
+
 def read_labels(out_file):
     try:
         return labels_of(out_file.read_text())
@@ -161,8 +178,7 @@ class TestDegradeRecover:
                 lambda: read_labels(out_file).get(
                     "google.com/tpu.backend") == "pjrt", timeout=15)
             assert wait_for(lambda: degradation_level(port) == 0)
-            rewrites_before = metrics.sample_value(
-                http_get(port, "/metrics")[1], "tfd_rewrites_total")
+            rewrites_before = scrape_sample(port, "tfd_rewrites_total")
 
             gate.touch()  # wedge: re-probes now hang -> watchdog kills
             t_wedge = time.monotonic()
@@ -175,7 +191,7 @@ class TestDegradeRecover:
             assert labels["google.com/tpu.backend"] == "pjrt"
             assert labels["google.com/tpu.count"] == "4"
             assert float(labels["google.com/tpu.snapshot-age-seconds"]) >= 0
-            assert degradation_level(port) == 1
+            assert wait_for(lambda: degradation_level(port) == 1)
 
             # No missed rewrite ticks while degraded: the counter kept
             # ticking through the wedge. The bound is deliberately loose
@@ -184,8 +200,7 @@ class TestDegradeRecover:
             # the property under test is "kept rewriting", not "kept
             # exact cadence".
             elapsed = time.monotonic() - t_wedge
-            rewrites_now = metrics.sample_value(
-                http_get(port, "/metrics")[1], "tfd_rewrites_total")
+            rewrites_now = scrape_sample(port, "tfd_rewrites_total")
             assert rewrites_now - rewrites_before >= max(1, elapsed / 3), (
                 f"{rewrites_now - rewrites_before} rewrites in "
                 f"{elapsed:.1f}s")

@@ -206,8 +206,12 @@ def auto_deadline_p50s(out_file):
 
     passes_wanted = 6
     with config4_server() as server:
+        # TFD_FORCE_SLOW_PASS keeps this metric measuring what it always
+        # measured: the full render+merge+sink cost of a wedged-node
+        # pass. Without it passes >=2 are fingerprint-clean no-ops
+        # (steady_noop_p50_us prices those) and never log "wrote".
         env = dict(HERMETIC_ENV, GCE_METADATA_HOST=server.endpoint,
-                   TFD_FAKE_PJRT_HANG="1")
+                   TFD_FAKE_PJRT_HANG="1", TFD_FORCE_SLOW_PASS="1")
         args = [str(BINARY), "--sleep-interval=1s", "--backend=auto",
                 f"--libtpu-path={FAKE_PJRT}",
                 f"--metadata-endpoint={server.endpoint}",
@@ -480,6 +484,108 @@ def daemon_silicon_numbers(out_file):
         return {}
 
 
+def steady_pass_durations(out_file, force_slow, passes_wanted=12,
+                          deadline_s=60):
+    """Per-pass durations of one 1s-cadence mock daemon (the headline
+    v5p-128 mixed config), read from the daemon's own flight recorder:
+    fast passes journal `pass-shortcircuit` events with duration_us,
+    slow passes journal `rewrite` spans with duration_us. Returns
+    (noop_durations_us, slow_durations_us, fast_total, slow_total)."""
+    import urllib.request
+
+    if str(REPO) not in sys.path:  # repeated callers must not
+        sys.path.insert(0, str(REPO))  # stack duplicate entries
+    from tpufd.fakes import free_loopback_port
+
+    port = free_loopback_port()
+    env = dict(HERMETIC_ENV)
+    if force_slow:
+        env["TFD_FORCE_SLOW_PASS"] = "1"
+    args = [str(BINARY), "--sleep-interval=1s", "--backend=mock",
+            "--mock-topology-file="
+            f"{REPO / 'tests/fixtures/v5p-128-worker3.yaml'}",
+            "--slice-strategy=mixed", "--machine-type-file=/dev/null",
+            f"--output-file={out_file}",
+            # The journal ring must hold every pass's events.
+            "--journal-capacity=2048",
+            f"--introspection-addr=127.0.0.1:{port}"]
+
+    def get(path):
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=2) as r:
+                return r.read().decode()
+        except OSError:
+            return None
+
+    proc = subprocess.Popen(args, env=env, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"steady bench daemon died rc={proc.returncode}")
+            metrics_text = get("/metrics")
+            if metrics_text:
+                for line in metrics_text.splitlines():
+                    if line.startswith("tfd_rewrites_total "):
+                        if float(line.split()[1]) >= passes_wanted:
+                            deadline = 0  # collected enough
+                        break
+            if deadline:
+                time.sleep(0.25)
+        body = get("/debug/journal?n=4096")
+        if body is None:
+            raise RuntimeError("journal scrape failed")
+        events = json.loads(body)["events"]
+    finally:
+        proc.terminate()
+        proc.wait(timeout=30)
+    noop_us = [float(e["fields"]["duration_us"]) for e in events
+               if e["type"] == "pass-shortcircuit"]
+    slow_us = [float(e["fields"]["duration_us"]) for e in events
+               if e["type"] == "rewrite" and "duration_us" in e["fields"]]
+    return noop_us, slow_us, len(noop_us), len(slow_us)
+
+
+def steady_state_record():
+    """The ISSUE 7 hot-path metrics: `steady_noop_p50_us` — the p50 of a
+    fingerprint-clean pass (plan + skipped sink write; the steady state
+    every healthy node lives in), gated < 1000 us by CI — and
+    `steady_dirty_p50_ms` — the p50 of a TFD_FORCE_SLOW_PASS=1 full
+    render+merge+govern+sink pass (the pre-fast-path per-pass cost,
+    gated against regression >25% vs the committed reference)."""
+    out = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        try:
+            noop_us, _, fast_n, slow_n = steady_pass_durations(
+                str(Path(tmp) / "tfd"), force_slow=False)
+            if not noop_us:
+                raise RuntimeError("no pass-shortcircuit events journaled")
+            out["steady_noop_p50_us"] = round(statistics.median(noop_us), 1)
+            out["steady_fast_passes"] = fast_n
+            out["steady_slow_passes"] = slow_n
+        except Exception as e:  # noqa: BLE001 — bench must not die here
+            sys.stderr.write(f"steady noop bench skipped: {e}\n")
+            out["steady_noop_p50_us"] = None
+        try:
+            _, slow_us, _, _ = steady_pass_durations(
+                str(Path(tmp) / "tfd-slow"), force_slow=True,
+                passes_wanted=8)
+            if not slow_us:
+                raise RuntimeError("no rewrite spans journaled")
+            # First pass carries backend warm-up; steady full passes are
+            # the regression-gated number (events arrive in seq order).
+            steady = slow_us[1:] or slow_us
+            out["steady_dirty_p50_ms"] = round(
+                statistics.median(steady) / 1000.0, 3)
+        except Exception as e:  # noqa: BLE001
+            sys.stderr.write(f"steady dirty bench skipped: {e}\n")
+            out["steady_dirty_p50_ms"] = None
+    return out
+
+
 def soak_record():
     """Daemon steady-state proof via scripts/soak.py: N passes at 1s
     cadence with memory/fd/label-stability/clean-exit checks. Prefers the
@@ -625,6 +731,9 @@ def main():
         record["backend"] = headline
     if PJRT_REAL_SOURCE["value"] is not None:
         record["pjrt_real_source"] = PJRT_REAL_SOURCE["value"]
+    # Hot-path steady-state metrics (hermetic, mock backend): the no-op
+    # fast-pass p50 and the forced-slow full-pass p50.
+    record.update(steady_state_record())
     # Daemon-mediated silicon probe FIRST: tpu_probe_numbers leaves an
     # in-process jax client holding the exclusive chip, which would
     # starve the daemon's exec'd probe.

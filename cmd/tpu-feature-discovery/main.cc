@@ -20,6 +20,7 @@
 #include <signal.h>
 #include <string.h>
 
+#include <algorithm>
 #include <chrono>
 #include <cstdio>
 #include <cstdlib>
@@ -34,6 +35,7 @@
 #include "tfd/info/version.h"
 #include "tfd/k8s/breaker.h"
 #include "tfd/k8s/client.h"
+#include "tfd/lm/fragments.h"
 #include "tfd/lm/governor.h"
 #include "tfd/lm/labeler.h"
 #include "tfd/lm/labels.h"
@@ -171,6 +173,112 @@ struct LabelState {
   double restored_downtime_s = 0;      // crash-to-restart gap at load
 };
 
+// ---- pass planning (the hot path) ----------------------------------------
+// Every pass first decides how much work it owes. The planner digests
+// the pass's inputs — per-source snapshot fingerprints and tiers
+// (sched::SnapshotStore::Generations), the serve decision, the config
+// generation, the quarantine set — into a PassSignature and compares it
+// against the last published pass:
+//
+//   fast        — nothing moved: skip render+merge+govern outright and
+//                 re-emit the cached serialized bytes (file sink: skip
+//                 the write and touch the mtime; CR sink: no-op without
+//                 even a GET). Target: p50 < 1 ms.
+//   incremental — something moved: re-render through the per-source
+//                 fragment caches (lm/fragments.h) so only the dirty
+//                 source's labeler re-runs, then the full
+//                 govern/serialize/sink pipeline. Target: p50 < 10 ms.
+//   full        — TFD_FORCE_SLOW_PASS=1 (CI's slow-path soak and the
+//                 golden-equality harness): bypass every cache and
+//                 render from scratch.
+//
+// Correctness gates that force a slow pass regardless of fingerprints:
+// a pending governor suppression (the held flip becomes publishable on
+// a TIMER, with no snapshot movement to dirty the pass), any
+// quarantined source/chip (its release is also timer-driven), a
+// degraded serve (the snapshot-age label ticks every second), and a
+// sink write that has not landed yet (retry must go through the full
+// pipeline). An armed --fault-spec additionally disables the sink-skip
+// so injected sink faults keep firing (a chaos daemon that silently
+// stopped writing would dodge its own fault schedule).
+enum class PassMode { kFast, kIncremental, kFull };
+
+struct PassPlan {
+  PassMode mode = PassMode::kFull;
+  std::string reason;  // bounded: tfd_pass_slow_total{reason}
+  std::string detail;  // which source/generation/timer forced it
+  uint64_t signature = 0;
+  std::vector<sched::SourceGeneration> sources;
+  std::vector<std::string> quarantined;
+};
+
+// What the last published pass looked like, kept so the next pass can
+// short-circuit against it. Lives above the config-reload loop (like
+// LabelState) but is invalidated at every run entry: labeler instances
+// are rebuilt per load, so cached fragments/bytes must not outlive
+// them.
+struct PassCache {
+  bool valid = false;          // artifacts describe the last landed pass
+  bool retry_pending = false;  // last sink write did not land
+  // True while `published` is what the sink currently holds (cleared
+  // by reloads, restored-state serves, and failed writes).
+  bool sink_holds_published = false;
+  uint64_t signature = 0;
+  std::vector<sched::SourceGeneration> sources;
+  std::string scratch;    // serialize target, pre-sized and reused
+  std::string published;  // bytes last landed in the sink
+  size_t published_labels = 0;
+  double last_real_write_wall = 0;  // anti-entropy refresh bookkeeping
+  double saved_state_wall = 0;      // state-file save dedup
+  // When the host-derived labelers (machine-type, tpu-vm) last
+  // actually RAN. Their true values are static per VM, but their
+  // reads are live IO (metadata HTTP, DMI file) that can transiently
+  // degrade — e.g. machine-type=unknown during a metadata blip — and
+  // neither a fragment hit nor a fast pass would ever heal it. The
+  // planner forces a host-refresh render on the anti-entropy cadence.
+  double host_refresh_wall = 0;
+  lm::FragmentCache fragments;
+
+  void InvalidateForRun() {
+    valid = false;
+    retry_pending = false;  // a reload owes a fresh write, not a retry
+    sink_holds_published = false;
+    host_refresh_wall = 0;
+    fragments.Invalidate();
+  }
+};
+
+// CI / golden-equality hook: every pass renders from scratch, no
+// fragment reuse, no sink skip — the forced-slow daemon the
+// byte-for-byte equality net compares the fast-path daemon against.
+bool ForceSlowPassEnv() {
+  static const bool forced = [] {
+    const char* env = std::getenv("TFD_FORCE_SLOW_PASS");
+    return env != nullptr && *env != '\0' &&
+           std::string(env) != "0";
+  }();
+  return forced;
+}
+
+// Anti-entropy refresh cadence for skipped sink writes: even a
+// perfectly clean steady state re-writes the sink this often, so an
+// externally deleted NodeFeature CR (or a tampered label file the
+// size check missed) heals without waiting for a real change.
+double SinkRefreshSeconds(const config::Flags& flags) {
+  return std::max(60.0, 2.5 * flags.sleep_interval_s);
+}
+
+// State-file refresh cadence: the warm-restart loader rejects a state
+// file older than its usable window, so a steady state that skipped
+// every save would silently lose warm restart. A quarter of the window
+// keeps the file always restorable at a quarter of the write load.
+double StateRefreshSeconds(const config::Flags& flags) {
+  double max_age_s = flags.snapshot_usable_for_s > 0
+                         ? flags.snapshot_usable_for_s
+                         : 10.0 * flags.sleep_interval_s;
+  return max_age_s / 4.0;
+}
+
 ServeDecision Decide(const sched::SnapshotStore& store,
                      const config::Flags& flags) {
   ServeDecision decision;
@@ -250,6 +358,123 @@ ServeDecision Decide(const sched::SnapshotStore& store,
   return decision;
 }
 
+// Digests this pass's inputs and decides fast / incremental / full.
+// Must see the SAME decision the render would use; the caller computes
+// it once and passes it in.
+PassPlan PlanPass(const config::Config& config,
+                  const sched::SnapshotStore& store,
+                  const ServeDecision& decision, int config_generation,
+                  lm::LabelGovernor* governor, PassCache* cache,
+                  double now_wall) {
+  PassPlan plan;
+  plan.sources = store.Generations();
+  plan.quarantined = healthsm::Default().QuarantinedKeys(now_wall);
+  const bool health_on = config.flags.device_health != "off";
+
+  lm::PassSignature sig;
+  sig.MixU64(static_cast<uint64_t>(config_generation));
+  sig.Mix(decision.source);
+  sig.Mix(decision.tier);
+  sig.MixU64(static_cast<uint64_t>(decision.level));
+  sig.MixU64((decision.degraded_labels ? 1u : 0u) |
+             (decision.all_expired ? 2u : 0u) |
+             (decision.manager != nullptr ? 4u : 0u));
+  for (const sched::SourceGeneration& gen : plan.sources) {
+    sig.Mix(gen.source);
+    sig.MixU64(gen.content_fingerprint);
+    sig.MixU64(static_cast<uint64_t>(gen.tier));
+    sig.MixU64((gen.has_snapshot ? 1u : 0u) | (gen.failing ? 2u : 0u));
+    // probe-ms feeds the basic-health labels, so it only dirties the
+    // pass on configs that publish it — and only for the SERVING
+    // source, whose ProbeTimed view the tpu labeler reads.
+    if (health_on && gen.source == decision.source) {
+      sig.MixU64(static_cast<uint64_t>(gen.probe_ms));
+    }
+  }
+  for (const std::string& key : plan.quarantined) sig.Mix(key);
+  plan.signature = sig.Digest();
+
+  auto slow = [&plan](PassMode mode, const char* reason,
+                      std::string detail = "") {
+    plan.mode = mode;
+    plan.reason = reason;
+    plan.detail = std::move(detail);
+  };
+  if (ForceSlowPassEnv()) {
+    slow(PassMode::kFull, "forced", "TFD_FORCE_SLOW_PASS");
+    return plan;
+  }
+  // retry_pending before valid: every failed write clears `valid` too,
+  // so this order is what makes the sink-retry reason reachable.
+  if (cache->retry_pending) {
+    slow(PassMode::kIncremental, "sink-retry",
+         "previous sink write did not land");
+    return plan;
+  }
+  if (!cache->valid) {
+    slow(PassMode::kIncremental, "first-pass",
+         "no published pass to short-circuit against");
+    return plan;
+  }
+  if (!plan.quarantined.empty()) {
+    // A quarantined key's hold and its release are timer-driven: no
+    // snapshot generation moves when the cooldown expires, so every
+    // quarantined pass renders in full (the acceptance contract).
+    slow(PassMode::kIncremental, "quarantine",
+         JoinStrings(plan.quarantined, ","));
+    return plan;
+  }
+  if (governor->PendingSuppressions()) {
+    slow(PassMode::kIncremental, "governor-hold",
+         "suppressed flip awaiting hold-down/churn budget");
+    return plan;
+  }
+  if (decision.degraded_labels) {
+    slow(PassMode::kIncremental, "degraded-age",
+         "serving " + decision.source +
+             " degraded; snapshot-age label ticks");
+    return plan;
+  }
+  if (now_wall - cache->host_refresh_wall >=
+      SinkRefreshSeconds(config.flags)) {
+    // The host-derived labelers' reads are live IO; re-render them on
+    // the anti-entropy cadence so a transiently degraded read
+    // (machine-type=unknown during a metadata blip) heals instead of
+    // staying frozen in the fragment cache until the next reload.
+    slow(PassMode::kIncremental, "host-refresh",
+         "host-derived fragments due for re-render");
+    return plan;
+  }
+  if (plan.signature != cache->signature) {
+    // Name the first moved source for the journal; if none moved, the
+    // serve decision itself changed.
+    for (const sched::SourceGeneration& gen : plan.sources) {
+      const sched::SourceGeneration* last = nullptr;
+      for (const sched::SourceGeneration& cached : cache->sources) {
+        if (cached.source == gen.source) {
+          last = &cached;
+          break;
+        }
+      }
+      if (last == nullptr || last->content_fingerprint !=
+                                 gen.content_fingerprint ||
+          last->tier != gen.tier || last->failing != gen.failing ||
+          last->has_snapshot != gen.has_snapshot) {
+        slow(PassMode::kIncremental, "source-dirty",
+             "source " + gen.source + " generation " +
+                 std::to_string(gen.generation) + " moved");
+        return plan;
+      }
+    }
+    slow(PassMode::kIncremental, "decision-changed",
+         "serving decision moved to " + decision.source + "/" +
+             decision.tier + " level " + std::to_string(decision.level));
+    return plan;
+  }
+  plan.mode = PassMode::kFast;
+  return plan;
+}
+
 // Sink dispatch (reference labels.go:49-56) with the hardening layers:
 // the NodeFeature CR path goes through the circuit breaker (an open
 // circuit skips the write instantly instead of burning the retry
@@ -257,9 +482,13 @@ ServeDecision Decide(const sched::SnapshotStore& store,
 // budget; BOTH sinks classify failures, and transient ones in daemon
 // mode are survived (log + retry next interval) rather than exiting —
 // a full disk or an apiserver rollout must not crash-loop the labeler.
-// `*wrote_ok` reports whether labels actually landed.
+// `*wrote_ok` reports whether labels actually landed. `bytes` (when
+// non-null) is the caller's pre-serialized "key=value\n" body — the
+// pass pipeline serializes once into its reused buffer; the sink must
+// not re-serialize.
 Status DispatchSink(const config::Config& config, const lm::Labels& labels,
-                    k8s::CircuitBreaker* breaker, bool* wrote_ok) {
+                    const std::string* bytes, k8s::CircuitBreaker* breaker,
+                    bool* wrote_ok) {
   Status out;
   bool transient = false;
   if (config.flags.use_node_feature_api) {
@@ -299,6 +528,9 @@ Status DispatchSink(const config::Config& config, const lm::Labels& labels,
         breaker->RecordPermanentFailure();
       }
     }
+  } else if (bytes != nullptr) {
+    out = lm::OutputBytesToFile(*bytes, labels.size(),
+                                config.flags.output_file, &transient);
   } else {
     out = lm::OutputToFile(labels, config.flags.output_file, &transient);
   }
@@ -412,46 +644,83 @@ void RecordSuppressedFlips(
   }
 }
 
-// One labeling pass: render labelers against the decided snapshot,
-// merge, write. `*wrote_ok` reports whether labels actually landed in
-// the sink — false on every error path, including the transient
-// NodeFeature one that returns Ok to keep the daemon alive. The merged
-// set and its per-key provenance land in `*merged_out`/`*provenance_out`
-// (for the label diff + /debug/labels), per-labeler timings in
-// `*span_fields` (for the journal's rewrite span).
-Status LabelOnceInner(
-    const config::Config& config, lm::Labeler& timestamp,
-    lm::Labeler& machine_type, lm::Labeler& tpu_vm,
-    const sched::SnapshotStore& store, const ServeDecision& decision,
-    k8s::CircuitBreaker* breaker, const LabelState& prev,
-    bool level_improved, lm::LabelGovernor* governor,
-    size_t* labels_emitted, bool* wrote_ok, size_t* suppressed_flips,
-    lm::Labels* merged_out, lm::Provenance* provenance_out,
+// The sink-skip observability pair: counted per sink, journaled once.
+void RecordSinkSkip(const char* sink) {
+  obs::Default()
+      .GetCounter("tfd_sink_writes_skipped_total",
+                  "Sink writes skipped because the serialized label "
+                  "bytes already match what the sink holds (file sink: "
+                  "mtime still touched as the cadence proof; cr sink: "
+                  "skipped without a GET).",
+                  {{"sink", sink}})
+      ->Inc();
+  obs::DefaultJournal().Record(
+      "sink-write", sink, "write skipped: label bytes unchanged",
+      {{"ok", "true"}, {"action", "skipped-unchanged"}});
+}
+
+// Render stage: the four labelers — through the per-source fragment
+// caches unless `fragments` is null (forced-full pass) — plus the
+// health-exec overlay and the degradation markers. Only the DIRTY
+// source's labeler actually re-runs on an incremental pass; clean
+// fragments are reused byte-for-byte.
+Status RenderLabels(
+    const config::Config& config, int config_generation,
+    lm::Labeler& timestamp, lm::Labeler& machine_type,
+    lm::Labeler& tpu_vm, const sched::SnapshotStore& store,
+    const ServeDecision& decision, const PassPlan& plan,
+    bool refresh_host, lm::FragmentCache* fragments, lm::Labels* merged,
+    lm::Provenance* provenance,
     std::vector<std::pair<std::string, std::string>>* span_fields) {
-  if (decision.fatal) {
-    return Status::Error(decision.fatal_error.empty()
-                             ? "no probe source could label this node"
-                             : decision.fatal_error);
-  }
   resource::ManagerPtr manager = decision.manager != nullptr
                                      ? decision.manager
                                      : resource::NewNullManager();
-  Result<lm::LabelerPtr> tpu = lm::NewTpuLabeler(manager, config);
-  if (!tpu.ok()) return tpu.status();
+  // The device fragment's render key: everything its output depends on
+  // besides the config — the serving source's full-content fingerprint,
+  // its tier (unused by the labeler itself but cheap and safe), and
+  // probe-ms when a basic-health config publishes it.
+  uint64_t render_key = 0;
+  {
+    lm::PassSignature key;
+    key.Mix(decision.tier);
+    key.MixU64(decision.manager != nullptr);
+    for (const sched::SourceGeneration& gen : plan.sources) {
+      if (gen.source != decision.source) continue;
+      key.MixU64(gen.content_fingerprint);
+      if (config.flags.device_health != "off") {
+        key.MixU64(static_cast<uint64_t>(gen.probe_ms));
+      }
+    }
+    render_key = key.Digest();
+  }
 
   // Merge order mirrors lm.NewLabelers (labeler.go:33-45): device labels
   // first, then the VM/virtualization labeler; later labelers win — so
   // provenance follows the same later-wins rule.
   constexpr const char* kLabelerNames[] = {"timestamp", "machine-type",
                                            "tpu", "tpu-vm"};
-  lm::Labels merged;
-  lm::Provenance provenance;
-  size_t i = 0;
-  for (lm::Labeler* labeler : std::vector<lm::Labeler*>{
-           &timestamp, &machine_type, tpu->get(), &tpu_vm}) {
-    const char* name = kLabelerNames[i++];
+  lm::Labeler* host_labelers[] = {&timestamp, &machine_type, nullptr,
+                                  &tpu_vm};
+  for (size_t i = 0; i < 4; i++) {
+    const char* name = kLabelerNames[i];
     auto labeler_t0 = std::chrono::steady_clock::now();
-    Result<lm::Labels> labels = labeler->GetLabels();
+    Result<lm::Labels> labels = [&]() -> Result<lm::Labels> {
+      if (host_labelers[i] == nullptr) {  // the device (tpu) labeler
+        if (fragments != nullptr) {
+          return fragments->TpuFragment(manager, decision.source,
+                                        render_key, config_generation,
+                                        config);
+        }
+        Result<lm::LabelerPtr> tpu = lm::NewTpuLabeler(manager, config);
+        if (!tpu.ok()) return Result<lm::Labels>::Error(tpu.error());
+        return (*tpu)->GetLabels();
+      }
+      if (fragments != nullptr) {
+        return fragments->HostFragment(name, *host_labelers[i],
+                                       config_generation, refresh_host);
+      }
+      return host_labelers[i]->GetLabels();
+    }();
     double seconds = obs::SecondsSince(labeler_t0);
     ObserveStageDuration("tfd_labeler_duration_seconds",
                          "GetLabels duration per labeler.", "labeler",
@@ -473,8 +742,8 @@ Status LabelOnceInner(
       from.tier = "fresh";
     }
     for (auto& [k, v] : *labels) {
-      merged[k] = v;
-      provenance[k] = from;
+      (*merged)[k] = v;
+      (*provenance)[k] = from;
     }
   }
 
@@ -483,7 +752,7 @@ Status LabelOnceInner(
   // the SERVING backend touches devices — a metadata-only rung must not
   // vouch for chip health — and only over a non-empty device label set.
   if (config.flags.device_health == "full" && manager->TouchesDevices() &&
-      merged.count(lm::kBackendLabel) > 0) {
+      merged->count(lm::kBackendLabel) > 0) {
     sched::SourceView health = store.View("health");
     if (health.last_ok.has_value() &&
         health.tier != sched::Tier::kExpired) {
@@ -493,8 +762,8 @@ Status LabelOnceInner(
       from.tier = sched::TierName(health.tier);
       from.age_s = health.age_s < 0 ? 0 : health.age_s;
       for (const auto& [k, v] : health.last_ok->labels) {
-        merged[k] = v;
-        provenance[k] = from;
+        (*merged)[k] = v;
+        (*provenance)[k] = from;
       }
     }
   }
@@ -504,17 +773,55 @@ Status LabelOnceInner(
   // serves — including the metadata-only rung — stay byte-identical to
   // the pre-scheduler label sets.
   if (decision.degraded_labels && decision.manager != nullptr) {
-    merged[lm::kDegraded] = "true";
-    merged[lm::kSnapshotAge] =
+    (*merged)[lm::kDegraded] = "true";
+    (*merged)[lm::kSnapshotAge] =
         std::to_string(static_cast<long long>(decision.age_s));
     lm::LabelProvenance from;
     from.labeler = "scheduler";
     from.source = decision.source;
     from.tier = decision.tier;
     from.age_s = decision.age_s < 0 ? 0 : decision.age_s;
-    provenance[lm::kDegraded] = from;
-    provenance[lm::kSnapshotAge] = from;
+    (*provenance)[lm::kDegraded] = from;
+    (*provenance)[lm::kSnapshotAge] = from;
   }
+  return Status::Ok();
+}
+
+// One SLOW labeling pass: render (through the fragment caches unless
+// the plan is full), govern, serialize once into the cache's reused
+// buffer, and write — skipping the write when the bytes already match
+// what the sink holds. `*wrote_ok` reports whether labels actually
+// landed (or were proven already landed) — false on every error path,
+// including the transient NodeFeature one that returns Ok to keep the
+// daemon alive. The merged set and its per-key provenance land in
+// `*merged_out`/`*provenance_out` (for the label diff + /debug/labels),
+// per-labeler timings in `*span_fields` (for the journal's rewrite
+// span).
+Status LabelOnceInner(
+    const config::Config& config, int config_generation,
+    lm::Labeler& timestamp, lm::Labeler& machine_type,
+    lm::Labeler& tpu_vm, const sched::SnapshotStore& store,
+    const ServeDecision& decision, const PassPlan& plan,
+    bool refresh_host, PassCache* cache, k8s::CircuitBreaker* breaker,
+    const LabelState& prev, bool level_improved,
+    lm::LabelGovernor* governor, size_t* labels_emitted, bool* wrote_ok,
+    bool* write_skipped, size_t* suppressed_flips,
+    lm::Labels* merged_out, lm::Provenance* provenance_out,
+    std::vector<std::pair<std::string, std::string>>* span_fields) {
+  if (decision.fatal) {
+    return Status::Error(decision.fatal_error.empty()
+                             ? "no probe source could label this node"
+                             : decision.fatal_error);
+  }
+  lm::FragmentCache* fragments =
+      plan.mode == PassMode::kFull ? nullptr : &cache->fragments;
+  lm::Labels merged;
+  lm::Provenance provenance;
+  Status rendered = RenderLabels(config, config_generation, timestamp,
+                                 machine_type, tpu_vm, store, decision,
+                                 plan, refresh_host, fragments, &merged,
+                                 &provenance, span_fields);
+  if (!rendered.ok()) return rendered;
 
   // Anti-flap layer: quarantined sources hold last-good facts, and the
   // governor debounces whatever still wants to flip.
@@ -528,10 +835,44 @@ Status LabelOnceInner(
                     << " label(s) generated; is this a TPU node?";
   }
 
-  // Output dispatch: NodeFeature CR (behind the circuit breaker) when
-  // the NodeFeature API is enabled, else the feature file / stdout.
-  Status out = DispatchSink(config, merged, breaker, wrote_ok);
-  if (!out.ok()) return out;
+  // One-shot serialization into the reused pass buffer: the same bytes
+  // feed the byte-compare skip, the file sink, and the published-bytes
+  // cache the next fast pass re-emits.
+  lm::FormatLabelsInto(merged, &cache->scratch);
+
+  // Byte-compare sink skip: a slow pass whose output is byte-identical
+  // to what the sink holds (a governor hold re-rendering the same set,
+  // a re-probe that changed nothing observable) skips the write like a
+  // fast pass would. Never on oneshot (its one write IS the product),
+  // never on a forced-full pass, and never with a fault spec armed —
+  // a skipped write would dodge the injected sink faults the chaos
+  // schedule exists to fire.
+  const bool file_sink = !config.flags.use_node_feature_api &&
+                         !config.flags.output_file.empty();
+  const bool cr_sink = config.flags.use_node_feature_api;
+  *write_skipped = false;
+  if ((file_sink || cr_sink) && !config.flags.oneshot &&
+      plan.mode != PassMode::kFull && config.flags.fault_spec.empty() &&
+      cache->sink_holds_published && cache->scratch == cache->published &&
+      WallClockSeconds() - cache->last_real_write_wall <
+          SinkRefreshSeconds(config.flags)) {
+    Status touched =
+        file_sink ? lm::TouchLabelFile(config.flags.output_file,
+                                       cache->published.size())
+                  : Status::Ok();
+    if (touched.ok()) {
+      *write_skipped = true;
+      *wrote_ok = true;
+      RecordSinkSkip(file_sink ? "file" : "cr");
+    }
+  }
+  if (!*write_skipped) {
+    // Output dispatch: NodeFeature CR (behind the circuit breaker) when
+    // the NodeFeature API is enabled, else the feature file / stdout.
+    Status out =
+        DispatchSink(config, merged, &cache->scratch, breaker, wrote_ok);
+    if (!out.ok()) return out;
+  }
   if (!*wrote_ok) return Status::Ok();  // survived transient sink failure
   governor->CommitPublished();
   RecordSuppressedFlips(suppressed);
@@ -540,6 +881,91 @@ Status LabelOnceInner(
   *merged_out = std::move(merged);
   *provenance_out = std::move(provenance);
   return Status::Ok();
+}
+
+void SaveStateAfterRewrite(const config::Config& config,
+                           const ServeDecision& decision,
+                           const lm::Labels& labels,
+                           const lm::Provenance& provenance);
+
+// The no-op FAST pass: every planned input matched the last published
+// pass, so render+merge+govern are skipped outright and the cached
+// artifacts re-emitted — the file sink write is skipped (mtime touched
+// as the cadence proof) and the CR sink no-ops without a GET, unless
+// the anti-entropy refresh is due or a fault spec is armed. Sub-
+// millisecond by construction: the remaining work is the plan itself,
+// a stat+utimensat, and the bookkeeping below.
+Status FastPass(const config::Config& config, const ServeDecision& decision,
+                const PassPlan& plan, obs::IntrospectionServer* server,
+                k8s::CircuitBreaker* breaker, LabelState* state,
+                PassCache* cache,
+                std::chrono::steady_clock::time_point t0) {
+  const bool file_sink = !config.flags.use_node_feature_api &&
+                         !config.flags.output_file.empty();
+  const bool cr_sink = config.flags.use_node_feature_api;
+  double now_wall = WallClockSeconds();
+  bool due = now_wall - cache->last_real_write_wall >=
+                 SinkRefreshSeconds(config.flags) ||
+             !config.flags.fault_spec.empty();
+  bool wrote_ok = false;
+  bool skipped = false;
+  Status out;
+  if ((file_sink || cr_sink) && !due) {
+    Status touched =
+        file_sink ? lm::TouchLabelFile(config.flags.output_file,
+                                       cache->published.size())
+                  : Status::Ok();
+    if (touched.ok()) {
+      skipped = true;
+      wrote_ok = true;
+      RecordSinkSkip(file_sink ? "file" : "cr");
+    }
+  }
+  if (!skipped) {
+    // Refresh due, stdout sink, or the label file was tampered with:
+    // re-emit the cached bytes for real (still no render).
+    out = DispatchSink(config, state->labels, &cache->published, breaker,
+                       &wrote_ok);
+    if (wrote_ok) cache->last_real_write_wall = now_wall;
+  }
+  double seconds = obs::SecondsSince(t0);
+  RecordRewriteOutcome(wrote_ok, cache->published_labels, seconds, server);
+  if (!wrote_ok) {
+    cache->retry_pending = true;
+    cache->valid = false;
+    if (!skipped) cache->sink_holds_published = false;
+  } else if (!config.flags.state_file.empty() &&
+             decision.manager != nullptr &&
+             now_wall - cache->saved_state_wall >=
+                 StateRefreshSeconds(config.flags)) {
+    // Keep the warm-restart state file inside its usable window even
+    // when nothing changes — a steady state that never refreshed it
+    // would silently lose warm restart.
+    SaveStateAfterRewrite(config, decision, state->labels,
+                          state->provenance);
+    cache->saved_state_wall = now_wall;
+  }
+  auto us = static_cast<long long>(seconds * 1e6);
+  obs::Default()
+      .GetCounter("tfd_pass_fast_total",
+                  "Passes that short-circuited render+merge+govern "
+                  "because no source generation, serve decision, or "
+                  "pending timer moved since the last published pass.")
+      ->Inc();
+  obs::DefaultJournal().Record(
+      "pass-shortcircuit", decision.source,
+      "pass short-circuited: no source/decision/timer moved",
+      {{"ok", wrote_ok ? "true" : "false"},
+       {"duration_us", std::to_string(us)},
+       {"skipped_write", skipped ? "true" : "false"},
+       {"labels", std::to_string(cache->published_labels)},
+       {"level", std::to_string(decision.level)},
+       {"source", decision.source},
+       {"tier", decision.tier}});
+  TFD_LOG_INFO << "labels unchanged (" << cache->published_labels
+               << "); pass short-circuited in " << us << "us"
+               << (skipped ? " (sink write skipped)" : "");
+  return out;
 }
 
 // The /debug/labels document: the exact label set the sink received
@@ -670,12 +1096,13 @@ void SaveStateAfterRewrite(const config::Config& config,
   }
 }
 
-Status LabelOnce(const config::Config& config, lm::Labeler& timestamp,
-                 lm::Labeler& machine_type, lm::Labeler& tpu_vm,
-                 const sched::SnapshotStore& store,
+Status LabelOnce(const config::Config& config, int config_generation,
+                 lm::Labeler& timestamp, lm::Labeler& machine_type,
+                 lm::Labeler& tpu_vm, const sched::SnapshotStore& store,
                  obs::IntrospectionServer* server,
                  k8s::CircuitBreaker* breaker,
-                 lm::LabelGovernor* governor, LabelState* state) {
+                 lm::LabelGovernor* governor, LabelState* state,
+                 PassCache* cache) {
   auto t0 = std::chrono::steady_clock::now();
   uint64_t generation = obs::DefaultJournal().BeginRewrite();
   ServeDecision decision = Decide(store, config.flags);
@@ -707,19 +1134,64 @@ Status LabelOnce(const config::Config& config, lm::Labeler& timestamp,
       decision.level, decision.source, decision.tier,
       decision.source.empty() ? "" : " serving " + decision.source, state);
 
+  // The pass plan: fast (short-circuit), incremental (fragment-cached
+  // render), or full (forced from-scratch).
+  PassPlan plan = PlanPass(config, store, decision, config_generation,
+                           governor, cache, WallClockSeconds());
+  if (plan.mode == PassMode::kFast) {
+    return FastPass(config, decision, plan, server, breaker, state, cache,
+                    t0);
+  }
+  obs::Default()
+      .GetCounter("tfd_pass_slow_total",
+                  "Passes that rendered in full or incrementally, by the "
+                  "reason the no-op short-circuit was unavailable.",
+                  {{"reason", plan.reason}})
+      ->Inc();
+
   size_t labels_emitted = 0;
   bool wrote_ok = false;
+  bool write_skipped = false;
   size_t suppressed_flips = 0;
   lm::Labels merged;
   lm::Provenance provenance;
   std::vector<std::pair<std::string, std::string>> span_fields;
-  Status s = LabelOnceInner(config, timestamp, machine_type, tpu_vm, store,
-                            decision, breaker, *state, level_improved,
-                            governor, &labels_emitted, &wrote_ok,
-                            &suppressed_flips, &merged, &provenance,
-                            &span_fields);
+  // Any slow pass that is DUE re-renders the host-derived fragments
+  // (machine-type, tpu-vm) so a transiently degraded read heals on the
+  // anti-entropy cadence; forced-full passes render everything anyway.
+  bool refresh_host =
+      plan.mode == PassMode::kFull ||
+      WallClockSeconds() - cache->host_refresh_wall >=
+          SinkRefreshSeconds(config.flags);
+  Status s = LabelOnceInner(config, config_generation, timestamp,
+                            machine_type, tpu_vm, store, decision, plan,
+                            refresh_host, cache, breaker, *state,
+                            level_improved, governor, &labels_emitted,
+                            &wrote_ok, &write_skipped, &suppressed_flips,
+                            &merged, &provenance, &span_fields);
+  if (refresh_host && s.ok()) {
+    cache->host_refresh_wall = WallClockSeconds();
+  }
   double seconds = obs::SecondsSince(t0);
   RecordRewriteOutcome(wrote_ok, labels_emitted, seconds, server);
+  // Pass-cache bookkeeping: the artifacts describe this pass only when
+  // it landed; a failed write forces the next pass slow (sink-retry).
+  if (wrote_ok) {
+    cache->valid = true;
+    cache->retry_pending = false;
+    cache->signature = plan.signature;
+    cache->sources = std::move(plan.sources);
+    cache->published_labels = labels_emitted;
+    if (!write_skipped) {
+      std::swap(cache->published, cache->scratch);
+      cache->last_real_write_wall = WallClockSeconds();
+    }
+    cache->sink_holds_published = true;
+  } else {
+    cache->valid = false;
+    cache->retry_pending = true;
+    if (!write_skipped) cache->sink_holds_published = false;
+  }
   if (wrote_ok) {
     // The published-level bookkeeping may only advance when this pass
     // landed verbatim: if the governor suppressed flips, the sink still
@@ -741,6 +1213,7 @@ Status LabelOnce(const config::Config& config, lm::Labeler& timestamp,
     if (!config.flags.oneshot && !config.flags.state_file.empty() &&
         decision.manager != nullptr) {
       SaveStateAfterRewrite(config, decision, merged, provenance);
+      cache->saved_state_wall = WallClockSeconds();
     }
     // Real facts now serve: the restored warm-restart cache is obsolete.
     if (decision.manager != nullptr && state->restored.has_value()) {
@@ -757,6 +1230,12 @@ Status LabelOnce(const config::Config& config, lm::Labeler& timestamp,
       {{"ok", wrote_ok ? "true" : "false"},
        {"duration_ms",
         std::to_string(static_cast<long long>(seconds * 1000))},
+       {"duration_us",
+        std::to_string(static_cast<long long>(seconds * 1e6))},
+       {"plan", plan.mode == PassMode::kFull ? "full" : "incremental"},
+       {"slow_reason", plan.reason},
+       {"slow_detail", plan.detail},
+       {"write_skipped", write_skipped ? "true" : "false"},
        {"level", std::to_string(decision.level)},
        {"source", decision.source},
        {"tier", decision.tier},
@@ -883,7 +1362,7 @@ Status ServeRestored(const config::Config& config,
   provenance[lm::kSnapshotAge] = marker;
 
   bool wrote_ok = false;
-  Status s = DispatchSink(config, labels, breaker, &wrote_ok);
+  Status s = DispatchSink(config, labels, nullptr, breaker, &wrote_ok);
   double seconds = obs::SecondsSince(t0);
   RecordRewriteOutcome(wrote_ok, labels.size(), seconds, server);
 
@@ -929,10 +1408,15 @@ Status ServeRestored(const config::Config& config,
   return s;
 }
 
-RunOutcome Run(const config::Config& config, const sigset_t& sigmask,
-               obs::IntrospectionServer* server,
+RunOutcome Run(const config::Config& config, int config_generation,
+               const sigset_t& sigmask, obs::IntrospectionServer* server,
                k8s::CircuitBreaker* breaker,
-               lm::LabelGovernor* governor, LabelState* state) {
+               lm::LabelGovernor* governor, LabelState* state,
+               PassCache* cache) {
+  // Labeler instances (below) are rebuilt per run — a failed reload
+  // re-enters under the SAME config generation but with a fresh
+  // timestamp — so cached fragments and published bytes must die here.
+  cache->InvalidateForRun();
   lm::LabelerPtr timestamp = lm::NewTimestampLabeler(config);
   lm::LabelerPtr machine_type = lm::NewMachineTypeLabeler(
       config.flags.machine_type_file, MakeMachineTypeGetter(config));
@@ -986,12 +1470,17 @@ RunOutcome Run(const config::Config& config, const sigset_t& sigmask,
                             state->restored_downtime_s, "restored-serve",
                             server, breaker, governor, state);
           served_restored = true;
+          // The sink now holds the restored set, not the pass cache's
+          // published bytes: the next normal pass must render + write.
+          cache->valid = false;
+          cache->sink_holds_published = false;
         }
       }
     }
     if (!served_restored) {
-      s = LabelOnce(config, *timestamp, *machine_type, *tpu_vm, *store,
-                    server, breaker, governor, state);
+      s = LabelOnce(config, config_generation, *timestamp, *machine_type,
+                    *tpu_vm, *store, server, breaker, governor, state,
+                    cache);
     }
     if (!s.ok()) {
       TFD_LOG_ERROR << s.message();
@@ -1125,6 +1614,7 @@ int Main(int argc, char** argv) {
   // the apiserver's health is not changed by our config, and a restored
   // state is served exactly once per process.
   LabelState label_state;
+  PassCache pass_cache;
   k8s::CircuitBreaker sink_breaker;
   // The anti-flap governor's hold-down history also survives reloads:
   // a SIGHUP must not grant every key a free flip.
@@ -1331,8 +1821,9 @@ int Main(int argc, char** argv) {
       }
     }
 
-    switch (Run(loaded.config, sigmask, server.get(), &sink_breaker,
-                &label_governor, &label_state)) {
+    switch (Run(loaded.config, config_generation, sigmask, server.get(),
+                &sink_breaker, &label_governor, &label_state,
+                &pass_cache)) {
       case RunOutcome::kExit:
         TFD_LOG_INFO << "exiting";
         return 0;

@@ -1,0 +1,93 @@
+#!/usr/bin/env python3
+"""CI steady-state regression gate for the hot-path fast pass (ISSUE 7).
+
+Measures the two pass-latency metrics bench.py records —
+`steady_noop_p50_us` (a fingerprint-clean short-circuited pass) and
+`steady_dirty_p50_ms` (a TFD_FORCE_SLOW_PASS=1 full render pass) — on
+the hermetic mock backend, then fails if:
+
+  - the no-op p50 exceeds the ABSOLUTE budget (default 1000 us): the
+    whole point of the fast path is that steady state is nearly free,
+    so this is a hard ceiling, not a relative gate;
+  - the dirty (full-pass) p50 regressed more than --dirty-slack
+    (default 25%) against the committed reference record
+    (BENCH_r07.json by default) — new per-pass work must ride the
+    fast-path/fragment machinery, not tax every render.
+
+Exit 0 when both gates hold; nonzero with the reason otherwise.
+
+Usage:
+  python3 scripts/bench_gate.py [--reference BENCH_r07.json]
+      [--noop-budget-us 1000] [--dirty-slack 0.25]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+def reference_dirty_p50_ms(path):
+    """steady_dirty_p50_ms from a committed bench record (either the
+    bare record or the driver's {parsed: ...} wrapper)."""
+    with open(path) as f:
+        doc = json.load(f)
+    record = doc.get("parsed", doc)
+    return record.get("steady_dirty_p50_ms")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reference", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_r07.json"))
+    ap.add_argument("--noop-budget-us", type=float, default=1000.0)
+    ap.add_argument("--dirty-slack", type=float, default=0.25)
+    args = ap.parse_args(argv)
+
+    bench.ensure_built()
+    record = bench.steady_state_record()
+    print(json.dumps(record))
+
+    problems = []
+    noop = record.get("steady_noop_p50_us")
+    if noop is None:
+        problems.append("steady_noop_p50_us could not be measured")
+    elif noop > args.noop_budget_us:
+        problems.append(
+            f"no-op pass p50 {noop}us exceeds the {args.noop_budget_us}us "
+            "budget — the fast path is no longer fast")
+
+    dirty = record.get("steady_dirty_p50_ms")
+    if dirty is None:
+        problems.append("steady_dirty_p50_ms could not be measured")
+    else:
+        try:
+            ref = reference_dirty_p50_ms(args.reference)
+        except (OSError, ValueError) as e:
+            ref = None
+            problems.append(f"reference {args.reference} unreadable: {e}")
+        if ref is not None:
+            ceiling = ref * (1.0 + args.dirty_slack)
+            if dirty > ceiling:
+                problems.append(
+                    f"full-pass p50 {dirty}ms regressed past "
+                    f"{ceiling:.3f}ms (reference {ref}ms "
+                    f"+{int(args.dirty_slack * 100)}%)")
+
+    if problems:
+        for p in problems:
+            print(f"bench gate FAILED: {p}", file=sys.stderr)
+        return 1
+    print(f"bench gate OK: noop p50 {noop}us <= {args.noop_budget_us}us, "
+          f"dirty p50 {dirty}ms within slack")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

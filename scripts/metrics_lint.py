@@ -70,6 +70,10 @@ REQUIRED = [
 # null source's child.
 REQUIRED_LABELED = [
     ("tfd_health_state", {"source": "null"}),
+    # Pass planner (ISSUE 7): the very first pass is always slow with
+    # reason=first-pass (there is no published pass to short-circuit
+    # against), so even this one-pass hermetic boot registers it.
+    ("tfd_pass_slow_total", {"reason": "first-pass"}),
 ]
 
 # Families documented in the README that this boot (null backend, no
@@ -99,6 +103,12 @@ CONDITIONAL = {
     "tfd_health_transitions_total",
     "tfd_quarantines_total",
     "tfd_label_flaps_suppressed_total",
+    # Hot path (ISSUE 7): a fast pass / skipped write needs a SECOND
+    # pass after the first published one — racy at this boot's scrape
+    # time, which stops at the first pass. (tfd_pass_slow_total is
+    # REQUIRED_LABELED above: the first pass always registers it.)
+    "tfd_pass_fast_total",
+    "tfd_sink_writes_skipped_total",
 }
 
 

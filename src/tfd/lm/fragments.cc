@@ -1,0 +1,71 @@
+#include "tfd/lm/fragments.h"
+
+#include "tfd/lm/tpu_labeler.h"
+
+namespace tfd {
+namespace lm {
+
+void PassSignature::Mix(const std::string& field) {
+  for (unsigned char c : field) {
+    hash_ ^= c;
+    hash_ *= 1099511628211ULL;
+  }
+  hash_ ^= 0x1f;  // field separator: Mix("ab"),Mix("c") != Mix("a"),Mix("bc")
+  hash_ *= 1099511628211ULL;
+}
+
+void PassSignature::MixU64(uint64_t value) {
+  for (int i = 0; i < 8; i++) {
+    hash_ ^= (value >> (8 * i)) & 0xff;
+    hash_ *= 1099511628211ULL;
+  }
+}
+
+uint64_t PassSignature::Digest() const { return hash_ == 0 ? 1 : hash_; }
+
+Result<Labels> FragmentCache::TpuFragment(const resource::ManagerPtr& manager,
+                                          const std::string& source,
+                                          uint64_t render_key,
+                                          int config_generation,
+                                          const config::Config& config) {
+  if (tpu_.valid && tpu_.source == source && tpu_.key == render_key &&
+      tpu_.config_generation == config_generation) {
+    return tpu_.labels;
+  }
+  Result<LabelerPtr> labeler = NewTpuLabeler(manager, config);
+  if (!labeler.ok()) return Result<Labels>::Error(labeler.error());
+  Result<Labels> labels = (*labeler)->GetLabels();
+  if (!labels.ok()) return labels;
+  tpu_.valid = true;
+  tpu_.source = source;
+  tpu_.key = render_key;
+  tpu_.config_generation = config_generation;
+  tpu_.labels = *labels;
+  return labels;
+}
+
+Result<Labels> FragmentCache::HostFragment(const std::string& name,
+                                           Labeler& labeler,
+                                           int config_generation,
+                                           bool force_refresh) {
+  auto it = host_.find(name);
+  if (!force_refresh && it != host_.end() && it->second.valid &&
+      it->second.config_generation == config_generation) {
+    return it->second.labels;
+  }
+  Result<Labels> labels = labeler.GetLabels();
+  if (!labels.ok()) return labels;
+  Entry& entry = host_[name];
+  entry.valid = true;
+  entry.config_generation = config_generation;
+  entry.labels = *labels;
+  return labels;
+}
+
+void FragmentCache::Invalidate() {
+  tpu_ = Entry();
+  host_.clear();
+}
+
+}  // namespace lm
+}  // namespace tfd

@@ -1,0 +1,94 @@
+// Per-source label-fragment caches + the pass-signature hasher: the
+// incremental half of the hot-path refactor (cmd/ PassPlan).
+//
+// A steady-state pass used to rebuild the whole labeler pipeline —
+// NewTpuLabeler re-ran against the serving snapshot, the host labelers
+// re-answered, the merge re-allocated — even when no snapshot
+// generation had moved. The FragmentCache memoizes each labeler's
+// rendered fragment keyed by what it actually depends on:
+//   - the device (tpu) fragment: the serving source's full-content
+//     fingerprint (sched::FullSnapshotFingerprint, plus probe-ms when a
+//     basic-health config publishes it) and the config generation —
+//     identical re-probes reuse the fragment, so only the DIRTY
+//     source's labeler re-runs;
+//   - the host-derived fragments (timestamp, machine-type, tpu-vm):
+//     the config generation, plus a caller-driven force_refresh on the
+//     anti-entropy cadence — their FACTS are static per VM (and the
+//     timestamp label is stamped per load by contract, which is
+//     exactly what keeps it from defeating no-op detection), but the
+//     machine-type/tpu-vm READS are live IO whose transient failures
+//     must not stay frozen in the cache until the next reload.
+// The merge is then rebuilt from cached fragments; serialization
+// reuses one pre-sized buffer (lm::FormatLabelsInto).
+//
+// PassSignature is the order-sensitive FNV-1a accumulator the planner
+// digests a pass's inputs into (per-source fingerprints + tiers, the
+// serve decision, the config generation, the quarantine set): equal
+// digests mean the render would reproduce the published bytes, so the
+// pass can short-circuit.
+#pragma once
+
+#include <map>
+#include <string>
+
+#include "tfd/config/config.h"
+#include "tfd/lm/labeler.h"
+#include "tfd/resource/types.h"
+
+namespace tfd {
+namespace lm {
+
+class PassSignature {
+ public:
+  void Mix(const std::string& field);
+  void MixU64(uint64_t value);
+  // Never 0 (0 means "no signature" to the pass cache).
+  uint64_t Digest() const;
+
+ private:
+  uint64_t hash_ = 1469598103934665603ULL;  // FNV-1a 64 offset basis
+};
+
+class FragmentCache {
+ public:
+  // The device labeler's fragment for the serving snapshot,
+  // re-rendered only when (source, render_key, config_generation)
+  // moved. `render_key` must capture everything the fragment depends
+  // on besides the config: the serving source's content fingerprint,
+  // plus its probe-ms when the config publishes basic-health labels.
+  Result<Labels> TpuFragment(const resource::ManagerPtr& manager,
+                             const std::string& source, uint64_t render_key,
+                             int config_generation,
+                             const config::Config& config);
+
+  // A host-derived labeler's fragment (timestamp, machine-type,
+  // tpu-vm). The timestamp labeler is static per config load by
+  // contract; machine-type and tpu-vm carry per-VM-static FACTS read
+  // through live IO (metadata HTTP, DMI file) that can transiently
+  // degrade — so the caller passes `force_refresh` on its anti-entropy
+  // cadence (and on forced-full passes) to re-render and re-cache, and
+  // the fragment is otherwise reused within a config generation.
+  Result<Labels> HostFragment(const std::string& name, Labeler& labeler,
+                              int config_generation,
+                              bool force_refresh = false);
+
+  // Drops every fragment. Called at the top of each config-load run:
+  // labeler instances are rebuilt per load (a failed reload re-runs
+  // under the SAME generation but with a fresh timestamp), so cached
+  // fragments must not outlive the instances that rendered them.
+  void Invalidate();
+
+ private:
+  struct Entry {
+    bool valid = false;
+    std::string source;
+    uint64_t key = 0;
+    int config_generation = -1;
+    Labels labels;
+  };
+  Entry tpu_;
+  std::map<std::string, Entry> host_;
+};
+
+}  // namespace lm
+}  // namespace tfd

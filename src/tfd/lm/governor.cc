@@ -49,6 +49,7 @@ void LabelGovernor::Apply(const Labels& previous,
                           bool level_improved, double now_s,
                           Labels* candidate, Provenance* provenance,
                           std::vector<SuppressedFlip>* suppressed) {
+  const size_t suppressed_before = suppressed->size();
   pending_change_.clear();  // uncommitted pass: its changes never landed
   pending_budget_spend_ = 0;
   pending_now_ = now_s;
@@ -172,6 +173,11 @@ void LabelGovernor::Apply(const Labels& previous,
       provenance->erase(kSnapshotAge);
     }
   }
+  last_apply_suppressed_ = suppressed->size() - suppressed_before;
+}
+
+bool LabelGovernor::PendingSuppressions() const {
+  return last_apply_suppressed_ > 0;
 }
 
 void LabelGovernor::CommitPublished() {
@@ -190,6 +196,7 @@ void LabelGovernor::Reset() {
   window_changes_.clear();
   pending_change_.clear();
   pending_budget_spend_ = 0;
+  last_apply_suppressed_ = 0;
 }
 
 }  // namespace lm

@@ -86,6 +86,14 @@ class LabelGovernor {
              std::vector<SuppressedFlip>* suppressed);
   void CommitPublished();
 
+  // Timer introspection for the pass planner (cmd/ PassPlan): true
+  // while the most recent Apply() suppressed at least one flip. The
+  // suppressed candidate becomes publishable the moment its hold-down
+  // timer or the churn budget frees — with NO snapshot movement to
+  // dirty the pass — so no-op short-circuiting must stay off until a
+  // pass applies with zero suppressions. Cleared by Reset().
+  bool PendingSuppressions() const;
+
   // Seeds the history from a set published OUTSIDE Apply (the
   // warm-restart passes write to the sink directly): newly seen keys
   // start their hold-down at `now_s`.
@@ -100,6 +108,7 @@ class LabelGovernor {
   std::map<std::string, double> pending_change_;
   int pending_budget_spend_ = 0;
   double pending_now_ = 0;
+  size_t last_apply_suppressed_ = 0;
 };
 
 // True for keys the governor debounces (google.com/tpu*, minus the

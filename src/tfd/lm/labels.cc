@@ -1,10 +1,11 @@
 #include "tfd/lm/labels.h"
 
 #include <errno.h>
+#include <fcntl.h>
 #include <string.h>
+#include <sys/stat.h>
 
 #include <iostream>
-#include <sstream>
 
 #include "tfd/fault/fault.h"
 #include "tfd/obs/journal.h"
@@ -13,12 +14,23 @@
 namespace tfd {
 namespace lm {
 
-std::string FormatLabels(const Labels& labels) {
-  std::ostringstream out;
+void FormatLabelsInto(const Labels& labels, std::string* out) {
+  out->clear();
+  size_t need = 0;
+  for (const auto& [k, v] : labels) need += k.size() + v.size() + 2;
+  if (out->capacity() < need) out->reserve(need);
   for (const auto& [k, v] : labels) {
-    out << k << "=" << v << "\n";
+    out->append(k);
+    out->push_back('=');
+    out->append(v);
+    out->push_back('\n');
   }
-  return out.str();
+}
+
+std::string FormatLabels(const Labels& labels) {
+  std::string out;
+  FormatLabelsInto(labels, &out);
+  return out;
 }
 
 namespace {
@@ -35,14 +47,19 @@ bool TransientFsErrno(int err) {
 
 Status OutputToFile(const Labels& labels, const std::string& path,
                     bool* transient) {
+  return OutputBytesToFile(FormatLabels(labels), labels.size(), path,
+                           transient);
+}
+
+Status OutputBytesToFile(const std::string& body, size_t label_count,
+                         const std::string& path, bool* transient) {
   if (transient != nullptr) *transient = false;
-  std::string body = FormatLabels(labels);
   if (path.empty()) {
     std::cout << body;
     std::cout.flush();
     obs::DefaultJournal().Record(
         "sink-write", "stdout", "wrote labels to stdout",
-        {{"labels", std::to_string(labels.size())}, {"ok", "true"}});
+        {{"labels", std::to_string(label_count)}, {"ok", "true"}});
     return Status::Ok();
   }
   Status s;
@@ -73,11 +90,29 @@ Status OutputToFile(const Labels& labels, const std::string& path,
       "sink-write", "file",
       s.ok() ? "wrote labels to " + path
              : "label file write failed: " + s.message(),
-      {{"labels", std::to_string(labels.size())},
+      {{"labels", std::to_string(label_count)},
        {"path", path},
        {"ok", s.ok() ? "true" : "false"},
        {"error", s.ok() ? "" : s.message()}});
   return s;
+}
+
+Status TouchLabelFile(const std::string& path, size_t expected_size) {
+  struct stat st {};
+  if (stat(path.c_str(), &st) != 0) {
+    return Status::Error("label file " + path + " missing: " +
+                         strerror(errno));
+  }
+  if (!S_ISREG(st.st_mode) ||
+      static_cast<size_t>(st.st_size) != expected_size) {
+    return Status::Error("label file " + path +
+                         " no longer matches the published bytes");
+  }
+  if (utimensat(AT_FDCWD, path.c_str(), nullptr, 0) != 0) {
+    return Status::Error("touch of " + path + " failed: " +
+                         strerror(errno));
+  }
+  return Status::Ok();
 }
 
 }  // namespace lm

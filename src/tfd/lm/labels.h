@@ -17,6 +17,12 @@ namespace lm {
 // Serializes labels as sorted "key=value\n" lines.
 std::string FormatLabels(const Labels& labels);
 
+// One-shot serializer for the hot path: serializes into `*out`,
+// reusing its capacity — the daemon keeps one pre-sized buffer across
+// passes so a steady-state serialization allocates nothing after the
+// first pass.
+void FormatLabelsInto(const Labels& labels, std::string* out);
+
 // Writes labels to `path` atomically, or to stdout if `path` is empty
 // (reference labels.go:62-65).
 // On failure, `*transient` (if non-null) mirrors the CR sink's
@@ -26,6 +32,26 @@ std::string FormatLabels(const Labels& labels);
 // visible crash-loop beats silent retrying.
 Status OutputToFile(const Labels& labels, const std::string& path,
                     bool* transient = nullptr);
+
+// The pre-serialized variant OutputToFile wraps: same sinks, same
+// fault point, same journaling and transient classification, but the
+// caller owns serialization (the pass pipeline serializes once into
+// its reused buffer and hands the same bytes to the sink, the
+// byte-compare skip, and /debug/labels). `label_count` only feeds the
+// journal record.
+Status OutputBytesToFile(const std::string& body, size_t label_count,
+                         const std::string& path,
+                         bool* transient = nullptr);
+
+// Advances the label file's mtime WITHOUT rewriting it — the fast
+// path's sink-write skip. The mtime advance is the rewrite-cadence
+// proof the reference contract (and the soak harness) watches, at the
+// cost of one utimensat instead of a write+fsync+rename+fsync. Fails
+// (so the caller falls back to a real write) when the file is missing
+// or its size no longer matches `expected_size` — an externally
+// deleted/truncated label file must be healed by the next pass, not
+// skipped over.
+Status TouchLabelFile(const std::string& path, size_t expected_size);
 
 }  // namespace lm
 }  // namespace tfd

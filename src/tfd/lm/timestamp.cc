@@ -9,6 +9,17 @@ namespace lm {
 
 LabelerPtr NewTimestampLabeler(const config::Config& config) {
   if (config.flags.no_timestamp) return Empty();
+  // Stamped ONCE per config load (the labeler is constructed per run
+  // and answers statically), mirroring the reference's sleep-loop
+  // contract: the label file's mtime advances every interval but its
+  // CONTENT — including this timestamp — stays constant between
+  // reloads (gpu-feature-discovery main_test.go:184-271, asserted here
+  // by tests/test_cli.py). That contract is also what exempts
+  // google.com/tfd.timestamp from dirtiness on the no-op fast path: a
+  // per-PASS stamp would make every pass look changed, defeating the
+  // byte-compare sink skip (cmd/ PassPlan) outright. Liveness is
+  // proven by the mtime touch + tfd_last_rewrite_timestamp_seconds,
+  // not by churning this value.
   Labels labels;
   labels[kTimestampLabel] = std::to_string(std::time(nullptr));
   return std::make_unique<StaticLabeler>(std::move(labels));

@@ -1,6 +1,7 @@
 #include "tfd/lm/tpu_labeler.h"
 
 #include <algorithm>
+#include <atomic>
 #include <chrono>
 #include <map>
 
@@ -105,8 +106,15 @@ LabelerPtr NewIciLinksLabeler(
 
 }  // namespace
 
+namespace {
+std::atomic<long long> g_tpu_labeler_builds{0};
+}  // namespace
+
+long long TpuLabelerBuilds() { return g_tpu_labeler_builds.load(); }
+
 Result<LabelerPtr> NewTpuLabeler(const resource::ManagerPtr& manager,
                                  const config::Config& config) {
+  g_tpu_labeler_builds.fetch_add(1, std::memory_order_relaxed);
   auto probe_start = std::chrono::steady_clock::now();
   Status init = manager->Init();
   if (!init.ok()) {

@@ -16,5 +16,10 @@ namespace lm {
 Result<LabelerPtr> NewTpuLabeler(const resource::ManagerPtr& manager,
                                  const config::Config& config);
 
+// Process-wide count of NewTpuLabeler invocations (label-pipeline
+// builds). The fragment cache's tests assert a no-op pass loop builds
+// the pipeline exactly once instead of once per pass.
+long long TpuLabelerBuilds();
+
 }  // namespace lm
 }  // namespace tfd

@@ -52,7 +52,12 @@ Tier TierForAge(double age_s, const TierPolicy& policy) {
   return Tier::kExpired;
 }
 
-uint64_t SnapshotFingerprint(const Snapshot& snapshot) {
+namespace {
+
+// Shared FNV-1a core of the two fingerprints: `all_labels` selects the
+// full-content hash (no-op pass detection) over the structural-only one
+// (healthsm flap detection, FingerprintedLabel above).
+uint64_t FingerprintSnapshot(const Snapshot& snapshot, bool all_labels) {
   uint64_t hash = 1469598103934665603ULL;  // FNV-1a 64
   auto mix = [&hash](const std::string& s) {
     for (unsigned char c : s) {
@@ -63,7 +68,7 @@ uint64_t SnapshotFingerprint(const Snapshot& snapshot) {
     hash *= 1099511628211ULL;
   };
   for (const auto& [key, value] : snapshot.labels) {
-    if (!FingerprintedLabel(key)) continue;
+    if (!all_labels && !FingerprintedLabel(key)) continue;
     mix(key);
     mix(value);
   }
@@ -96,6 +101,16 @@ uint64_t SnapshotFingerprint(const Snapshot& snapshot) {
   return hash == 0 ? 1 : hash;
 }
 
+}  // namespace
+
+uint64_t SnapshotFingerprint(const Snapshot& snapshot) {
+  return FingerprintSnapshot(snapshot, /*all_labels=*/false);
+}
+
+uint64_t FullSnapshotFingerprint(const Snapshot& snapshot) {
+  return FingerprintSnapshot(snapshot, /*all_labels=*/true);
+}
+
 void SnapshotStore::Register(const std::string& source,
                              const TierPolicy& policy, bool device_source) {
   std::lock_guard<std::mutex> lock(mu_);
@@ -106,6 +121,9 @@ void SnapshotStore::Register(const std::string& source,
 }
 
 void SnapshotStore::PutOk(const std::string& source, Snapshot snapshot) {
+  // Memoized off the lock (and off the render path): probe workers pay
+  // for the hash so the per-pass planner never does.
+  uint64_t content_fingerprint = FullSnapshotFingerprint(snapshot);
   {
     std::lock_guard<std::mutex> lock(mu_);
     auto it = states_.find(source);
@@ -116,6 +134,8 @@ void SnapshotStore::PutOk(const std::string& source, Snapshot snapshot) {
     }
     it->second.last_ok = std::move(snapshot);
     it->second.settled = true;
+    it->second.generation++;
+    it->second.content_fingerprint = content_fingerprint;
     it->second.last_error.clear();
     it->second.fatal_error = false;
     it->second.consecutive_failures = 0;
@@ -131,6 +151,7 @@ void SnapshotStore::PutError(const std::string& source,
     auto it = states_.find(source);
     if (it == states_.end()) return;
     it->second.settled = true;
+    it->second.generation++;
     it->second.last_error = error;
     it->second.fatal_error = fatal;
     it->second.consecutive_failures++;
@@ -144,6 +165,8 @@ void SnapshotStore::InvalidateAll() {
     for (auto& [name, state] : states_) {
       state.last_ok.reset();
       state.settled = false;
+      state.generation++;
+      state.content_fingerprint = 0;
       state.last_error.clear();
       state.fatal_error = false;
       state.consecutive_failures = 0;
@@ -202,6 +225,35 @@ SourceView SnapshotStore::View(const std::string& source) const {
 std::vector<std::string> SnapshotStore::Sources() const {
   std::lock_guard<std::mutex> lock(mu_);
   return order_;
+}
+
+std::vector<SourceGeneration> SnapshotStore::Generations() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  std::vector<SourceGeneration> out;
+  out.reserve(order_.size());
+  auto now = std::chrono::steady_clock::now();
+  for (const std::string& name : order_) {
+    const State& state = states_.at(name);
+    SourceGeneration gen;
+    gen.source = name;
+    gen.generation = state.generation;
+    gen.content_fingerprint = state.content_fingerprint;
+    gen.has_snapshot = state.last_ok.has_value();
+    gen.failing = !state.last_error.empty();
+    double age_s = -1;
+    if (state.last_ok.has_value()) {
+      age_s = std::chrono::duration<double>(now - state.last_ok->taken_at)
+                  .count();
+      gen.probe_ms =
+          static_cast<long long>(state.last_ok->probe_seconds * 1000);
+    }
+    // Tier read WITHOUT the View() journaling: the planner's read must
+    // stay cheap, and Decide()'s Views this same pass record any
+    // transition for the flight recorder.
+    gen.tier = TierForAge(age_s, state.policy);
+    out.push_back(std::move(gen));
+  }
+  return out;
 }
 
 std::vector<std::string> SnapshotStore::DeviceSources() const {

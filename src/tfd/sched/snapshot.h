@@ -72,6 +72,37 @@ struct Snapshot;
 // fingerprint" to the tracker).
 uint64_t SnapshotFingerprint(const Snapshot& snapshot);
 
+// Full-content fingerprint for no-op pass detection (cmd/ PassPlan):
+// unlike SnapshotFingerprint it hashes EVERY label — including the
+// measured google.com/tpu.health.* values the flap fingerprint excludes
+// — because a moved measurement must dirty the pass, or the fast path
+// would keep re-serving a stale measurement the forced-slow daemon
+// would have republished. Device facts hash the same way. The probe's
+// own wall time (probe_seconds) is deliberately NOT hashed here; it is
+// exported per source as SourceGeneration::probe_ms so the planner can
+// fold it in only when a config actually publishes it (basic-health
+// probe-ms). Memoized by the store at PutOk time, so the render loop
+// never pays for the hash. Never 0.
+uint64_t FullSnapshotFingerprint(const Snapshot& snapshot);
+
+// Cheap per-source dirtiness digest for the pass planner. `generation`
+// bumps on every store write (PutOk / PutError / InvalidateAll) — the
+// "something landed" counter journaled when a pass is forced slow;
+// `content_fingerprint` is the memoized FullSnapshotFingerprint of
+// last_ok (0: none yet), which identical re-probes keep stable so a
+// healthy steady state plans clean; `tier` is the CURRENT age-derived
+// tier (a fresh→stale-usable lapse must dirty the pass even though no
+// probe landed).
+struct SourceGeneration {
+  std::string source;
+  uint64_t generation = 0;
+  uint64_t content_fingerprint = 0;
+  Tier tier = Tier::kNone;
+  bool has_snapshot = false;
+  bool failing = false;       // last probe errored
+  long long probe_ms = 0;     // last_ok probe latency, ms-rounded
+};
+
 // One successful probe result. Device sources carry an initialized,
 // inert manager view (sched/sources.cc SnapshotManager: every call
 // answers from captured data, Init/Shutdown are no-ops); label sources
@@ -121,6 +152,11 @@ class SnapshotStore {
   std::vector<std::string> Sources() const;        // registration order
   std::vector<std::string> DeviceSources() const;  // registration order
 
+  // The exported generation vector (registration order): one mutex
+  // acquisition, no journaling, no snapshot copies — the pass planner
+  // calls this every pass, including the sub-millisecond no-op ones.
+  std::vector<SourceGeneration> Generations() const;
+
   // True once every registered source has settled (has at least one
   // result). Waits at most `timeout`; used by the FIRST rewrite so a
   // fast probe round yields full labels immediately while a wedged
@@ -138,6 +174,10 @@ class SnapshotStore {
     bool device_source = false;
     bool settled = false;
     std::optional<Snapshot> last_ok;
+    // Dirtiness bookkeeping (Generations()): write counter + the
+    // memoized full-content fingerprint of last_ok.
+    uint64_t generation = 0;
+    uint64_t content_fingerprint = 0;
     std::string last_error;
     bool fatal_error = false;
     int consecutive_failures = 0;

@@ -5,8 +5,10 @@
 // family table, config precedence, label generation per strategy, sharing,
 // and the fallback decorator.
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netinet/in.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
 #include <sys/wait.h>
 #include <unistd.h>
 
@@ -27,6 +29,7 @@
 #include "tfd/healthsm/healthsm.h"
 #include "tfd/k8s/breaker.h"
 #include "tfd/k8s/client.h"
+#include "tfd/lm/fragments.h"
 #include "tfd/lm/governor.h"
 #include "tfd/lm/labels.h"
 #include "tfd/lm/merge.h"
@@ -1933,6 +1936,238 @@ void TestSnapshotFingerprintIgnoresMeasurements() {
   CHECK_EQ(SnapshotFingerprint(e), SnapshotFingerprint(a));
 }
 
+void TestFullSnapshotFingerprint() {
+  // The pass planner's fingerprint must see what the flap fingerprint
+  // deliberately ignores: a moved MEASUREMENT re-renders the pass (the
+  // forced-slow daemon would republish it), even though it is not flap
+  // evidence.
+  sched::Snapshot a;
+  a.labels = {{"google.com/tpu.health.ok", "true"},
+              {"google.com/tpu.health.probe-ms", "812"},
+              {"google.com/tpu.health.matmul-tflops", "918"}};
+  sched::Snapshot b = a;
+  b.labels["google.com/tpu.health.matmul-tflops"] = "912";
+  CHECK_EQ(SnapshotFingerprint(a), SnapshotFingerprint(b));  // flap: equal
+  CHECK_TRUE(sched::FullSnapshotFingerprint(a) !=
+             sched::FullSnapshotFingerprint(b));  // planner: dirty
+  sched::Snapshot c = a;
+  CHECK_EQ(sched::FullSnapshotFingerprint(a),
+           sched::FullSnapshotFingerprint(c));
+  CHECK_TRUE(sched::FullSnapshotFingerprint(a) != 0);
+}
+
+void TestSnapshotStoreGenerations() {
+  sched::SnapshotStore store;
+  sched::TierPolicy policy;
+  store.Register("pjrt", policy, /*device_source=*/true);
+  store.Register("metadata", policy, /*device_source=*/true);
+
+  std::vector<sched::SourceGeneration> gens = store.Generations();
+  CHECK_EQ(gens.size(), static_cast<size_t>(2));
+  CHECK_EQ(gens[0].source, "pjrt");  // registration order
+  CHECK_EQ(gens[0].generation, static_cast<uint64_t>(0));
+  CHECK_TRUE(!gens[0].has_snapshot);
+
+  sched::Snapshot snap;
+  snap.labels = {{"google.com/tpu.count", "4"}};
+  store.PutOk("pjrt", snap);
+  gens = store.Generations();
+  CHECK_EQ(gens[0].generation, static_cast<uint64_t>(1));
+  uint64_t first_fp = gens[0].content_fingerprint;
+  CHECK_TRUE(first_fp != 0);
+  CHECK_TRUE(gens[0].has_snapshot);
+  CHECK_TRUE(gens[0].tier == sched::Tier::kFresh);
+
+  // An identical re-probe bumps the generation but keeps the content
+  // fingerprint — the planner's "nothing actually moved" signal.
+  sched::Snapshot same;
+  same.labels = {{"google.com/tpu.count", "4"}};
+  store.PutOk("pjrt", same);
+  gens = store.Generations();
+  CHECK_EQ(gens[0].generation, static_cast<uint64_t>(2));
+  CHECK_EQ(gens[0].content_fingerprint, first_fp);
+
+  // Content movement moves the fingerprint.
+  sched::Snapshot changed;
+  changed.labels = {{"google.com/tpu.count", "2"}};
+  store.PutOk("pjrt", changed);
+  gens = store.Generations();
+  CHECK_TRUE(gens[0].content_fingerprint != first_fp);
+
+  // A failure flips `failing` (and bumps the generation) without
+  // touching the last-ok fingerprint.
+  uint64_t pre_fail_fp = gens[0].content_fingerprint;
+  store.PutError("pjrt", "chips busy");
+  gens = store.Generations();
+  CHECK_TRUE(gens[0].failing);
+  CHECK_EQ(gens[0].generation, static_cast<uint64_t>(4));
+  CHECK_EQ(gens[0].content_fingerprint, pre_fail_fp);
+
+  // Invalidation (config regen) zeroes the memo.
+  store.InvalidateAll();
+  gens = store.Generations();
+  CHECK_EQ(gens[0].content_fingerprint, static_cast<uint64_t>(0));
+  CHECK_TRUE(!gens[0].has_snapshot);
+}
+
+void TestPassSignature() {
+  lm::PassSignature a;
+  a.Mix("pjrt");
+  a.MixU64(42);
+  lm::PassSignature b;
+  b.Mix("pjrt");
+  b.MixU64(42);
+  CHECK_EQ(a.Digest(), b.Digest());
+  CHECK_TRUE(a.Digest() != 0);
+
+  lm::PassSignature c;  // field boundaries matter
+  c.Mix("pjr");
+  c.Mix("t");
+  c.MixU64(42);
+  CHECK_TRUE(c.Digest() != a.Digest());
+
+  lm::PassSignature d;  // order matters
+  d.MixU64(42);
+  d.Mix("pjrt");
+  CHECK_TRUE(d.Digest() != a.Digest());
+}
+
+void TestFormatLabelsInto() {
+  lm::Labels labels = {{"b", "2"}, {"a", "1"}, {"c", "x=y"}};
+  CHECK_EQ(lm::FormatLabels(labels), "a=1\nb=2\nc=x=y\n");
+  // The reused-buffer serializer produces identical bytes and keeps
+  // its capacity across passes — steady state allocates nothing.
+  std::string buffer;
+  lm::FormatLabelsInto(labels, &buffer);
+  CHECK_EQ(buffer, lm::FormatLabels(labels));
+  buffer.reserve(4096);
+  const size_t capacity = buffer.capacity();
+  lm::FormatLabelsInto(labels, &buffer);
+  CHECK_EQ(buffer, lm::FormatLabels(labels));
+  CHECK_EQ(buffer.capacity(), capacity);
+}
+
+void TestTouchLabelFile() {
+  std::string path = WriteTemp("a=1\n");
+  struct stat before {};
+  CHECK_TRUE(stat(path.c_str(), &before) == 0);
+  // Matching size: touched, mtime advances (the cadence proof the
+  // sleep-loop contract watches), bytes untouched.
+  struct timespec old_time {};
+  old_time.tv_sec = before.st_mtime - 100;
+  struct timespec times[2] = {old_time, old_time};
+  utimensat(AT_FDCWD, path.c_str(), times, 0);
+  CHECK_TRUE(lm::TouchLabelFile(path, 4).ok());
+  struct stat after {};
+  CHECK_TRUE(stat(path.c_str(), &after) == 0);
+  CHECK_TRUE(after.st_mtime > old_time.tv_sec);
+  // Size mismatch (external truncation/tamper) and a missing file both
+  // refuse, so the caller falls back to a real write.
+  CHECK_TRUE(!lm::TouchLabelFile(path, 5).ok());
+  unlink(path.c_str());
+  CHECK_TRUE(!lm::TouchLabelFile(path, 4).ok());
+}
+
+void TestFragmentCacheTpuBuildOnce() {
+  lm::FragmentCache cache;
+  config::Config config;
+  resource::ManagerPtr manager = resource::NewNullManager();
+  long long before = lm::TpuLabelerBuilds();
+  // A 10-pass no-op loop (same source, same render key, same config
+  // generation) constructs the labeler pipeline exactly ONCE — the
+  // per-(manager, config-generation) cache ISSUE 7 asks for.
+  for (int i = 0; i < 10; i++) {
+    Result<lm::Labels> labels =
+        cache.TpuFragment(manager, "mock", /*render_key=*/7,
+                          /*config_generation=*/1, config);
+    CHECK_TRUE(labels.ok());
+  }
+  CHECK_EQ(lm::TpuLabelerBuilds() - before, 1LL);
+  // A moved render key (dirty source) rebuilds once...
+  CHECK_TRUE(cache.TpuFragment(manager, "mock", 8, 1, config).ok());
+  CHECK_EQ(lm::TpuLabelerBuilds() - before, 2LL);
+  // ...and so does a config reload.
+  CHECK_TRUE(cache.TpuFragment(manager, "mock", 8, 2, config).ok());
+  CHECK_EQ(lm::TpuLabelerBuilds() - before, 3LL);
+  // Invalidate drops everything.
+  cache.Invalidate();
+  CHECK_TRUE(cache.TpuFragment(manager, "mock", 8, 2, config).ok());
+  CHECK_EQ(lm::TpuLabelerBuilds() - before, 4LL);
+}
+
+void TestFragmentCacheHostFragment() {
+  // A counting labeler: the host fragment must render once per config
+  // generation, not once per pass.
+  class CountingLabeler : public lm::Labeler {
+   public:
+    Result<lm::Labels> GetLabels() override {
+      calls++;
+      return lm::Labels{{"k", std::to_string(calls)}};
+    }
+    int calls = 0;
+  };
+  lm::FragmentCache cache;
+  CountingLabeler labeler;
+  for (int i = 0; i < 5; i++) {
+    Result<lm::Labels> labels = cache.HostFragment("count", labeler, 1);
+    CHECK_TRUE(labels.ok() && labels->at("k") == "1");
+  }
+  CHECK_EQ(labeler.calls, 1);
+  Result<lm::Labels> reloaded = cache.HostFragment("count", labeler, 2);
+  CHECK_TRUE(reloaded.ok() && reloaded->at("k") == "2");
+  CHECK_EQ(labeler.calls, 2);
+  // force_refresh (the anti-entropy host-refresh pass) re-renders AND
+  // re-caches — a transiently degraded read must not stay frozen for
+  // the config generation's lifetime.
+  Result<lm::Labels> forced = cache.HostFragment("count", labeler, 2,
+                                                 /*force_refresh=*/true);
+  CHECK_TRUE(forced.ok() && forced->at("k") == "3");
+  CHECK_EQ(labeler.calls, 3);
+  Result<lm::Labels> cached = cache.HostFragment("count", labeler, 2);
+  CHECK_TRUE(cached.ok() && cached->at("k") == "3");
+  CHECK_EQ(labeler.calls, 3);
+}
+
+void TestGovernorPendingSuppressions() {
+  // The pass planner's timer introspection: a suppressed flip keeps
+  // PendingSuppressions() true (forcing slow passes) until a pass
+  // applies clean — the held candidate becomes publishable on a TIMER,
+  // with no snapshot movement to dirty the pass.
+  lm::GovernorPolicy policy;
+  policy.hold_down_s = 100;
+  policy.churn_budget = 10;
+  lm::LabelGovernor governor(policy);
+  CHECK_TRUE(!governor.PendingSuppressions());
+
+  lm::Labels previous = {{"google.com/tpu.count", "4"}};
+  lm::Provenance prev_prov;
+  double now = 1000;
+  // Establish the published set (first appearance passes through).
+  lm::Labels candidate = previous;
+  lm::Provenance prov;
+  std::vector<lm::SuppressedFlip> suppressed;
+  governor.Apply({}, {}, false, now, &candidate, &prov, &suppressed);
+  governor.CommitPublished();
+  CHECK_TRUE(!governor.PendingSuppressions());
+
+  // A flip inside the hold-down is suppressed -> pending.
+  candidate = {{"google.com/tpu.count", "2"}};
+  suppressed.clear();
+  governor.Apply(previous, prev_prov, false, now + 10, &candidate, &prov,
+                 &suppressed);
+  CHECK_EQ(suppressed.size(), static_cast<size_t>(1));
+  CHECK_TRUE(governor.PendingSuppressions());
+
+  // After the hold-down expires the same flip applies clean -> cleared.
+  candidate = {{"google.com/tpu.count", "2"}};
+  suppressed.clear();
+  governor.Apply(previous, prev_prov, false, now + 200, &candidate, &prov,
+                 &suppressed);
+  CHECK_EQ(suppressed.size(), static_cast<size_t>(0));
+  CHECK_TRUE(!governor.PendingSuppressions());
+  CHECK_EQ(candidate.at("google.com/tpu.count"), "2");
+}
+
 void TestHealthStateMachineTransitions() {
   healthsm::Policy policy;
   policy.flap_window_s = 60;
@@ -2777,6 +3012,14 @@ int main(int argc, char** argv) {
   tfd::TestFaultSinkFile();
   tfd::TestCircuitBreaker();
   tfd::TestSnapshotFingerprintIgnoresMeasurements();
+  tfd::TestFullSnapshotFingerprint();
+  tfd::TestSnapshotStoreGenerations();
+  tfd::TestPassSignature();
+  tfd::TestFormatLabelsInto();
+  tfd::TestTouchLabelFile();
+  tfd::TestFragmentCacheTpuBuildOnce();
+  tfd::TestFragmentCacheHostFragment();
+  tfd::TestGovernorPendingSuppressions();
   tfd::TestHealthStateMachineTransitions();
   tfd::TestHealthStateMachineDebounceBoundaries();
   tfd::TestHealthStateMachineFlapQuarantine();

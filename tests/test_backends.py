@@ -22,6 +22,14 @@ from tpufd.fakes.metadata_server import (
 FAKE_PJRT = BUILD_DIR / "libtfd_fake_pjrt.so"
 
 
+def count_passes(stderr_text):
+    """Completed labeling passes observed in the daemon's stderr: slow
+    passes log 'wrote N labels', fingerprint-clean passes log
+    'pass short-circuited' — both end exactly one pass."""
+    return (stderr_text.count("wrote ") +
+            stderr_text.count("pass short-circuited"))
+
+
 def pjrt_args(extra=None, machine="/dev/null", libtpu=None):
     return (["--oneshot", "--output-file=", "--backend=pjrt",
              f"--libtpu-path={libtpu or FAKE_PJRT}",
@@ -753,7 +761,7 @@ class TestPjrtInitWatchdog:
             while time.monotonic() < deadline:
                 # Every pass ends in a "wrote N labels" line (failing
                 # backends degrade to null and still write).
-                if stderr_file.read_text().count("wrote ") >= min_passes:
+                if count_passes(stderr_file.read_text()) >= min_passes:
                     break
                 time.sleep(0.2)
             else:
@@ -815,7 +823,7 @@ class TestPjrtInitWatchdog:
                 output_file=out_file) as (count_file, stderr_file):
             deadline = time.monotonic() + 60
             while time.monotonic() < deadline:
-                if stderr_file.read_text().count("wrote ") >= 2:
+                if count_passes(stderr_file.read_text()) >= 2:
                     break
                 time.sleep(0.2)
             # Degraded while held: no TPU labels.
@@ -1149,11 +1157,11 @@ class TestRelayPjrtPlugin:
             try:
                 deadline = time.monotonic() + 150
                 while time.monotonic() < deadline:
-                    if stderr_file.read_text().count("wrote ") >= 3:
+                    if count_passes(stderr_file.read_text()) >= 3:
                         break
                     time.sleep(0.3)
                 text = stderr_file.read_text()
-                assert text.count("wrote ") >= 3, text[-2000:]
+                assert count_passes(text) >= 3, text[-2000:]
                 labels = labels_of(out_file.read_text())
             finally:
                 proc.terminate()

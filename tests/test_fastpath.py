@@ -1,0 +1,299 @@
+"""Tier 2/3: the fingerprint-gated no-op fast path (ISSUE 7) against
+the real binary.
+
+The contracts under test:
+  - a healthy 30-pass mock soak short-circuits >=27 passes
+    (tfd_pass_fast_total), with /debug/labels byte-equal to the label
+    file throughout and the file's mtime still advancing every pass
+    (the sleep-loop cadence proof survives the skipped writes);
+  - a mid-soak topology change dirties the source fingerprint and
+    forces exactly ONE slow pass (tfd_pass_slow_total{reason=
+    source-dirty}), after which the fast path resumes with the new
+    labels published;
+  - kill -9 invalidates the fragment caches: the first passes of the
+    restarted process are slow (warm restart + first live render)
+    before the fast path resumes;
+  - an externally deleted label file is healed by the next fast pass
+    (the touch fails, the cached bytes are re-written);
+  - a quarantined source always forces slow passes (the quarantine
+    release is timer-driven; no fingerprint moves when it expires);
+  - golden byte-for-byte equality: a TFD_FORCE_SLOW_PASS=1 daemon and
+    a fast-path daemon produce identical label files and /debug/labels
+    documents across the same scenario, topology change included.
+"""
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import time
+
+from conftest import BUILD_DIR, FIXTURES, http_get, labels_of, wait_for
+from tpufd import journal as tpufd_journal
+from tpufd import metrics
+from tpufd.fakes import free_loopback_port as free_port
+
+FAKE_PJRT = BUILD_DIR / "libtfd_fake_pjrt.so"
+
+
+def scrape(port, name, labels=None):
+    status, text = http_get(port, "/metrics")
+    if status != 200:
+        return None
+    try:
+        return metrics.sample_value(text, name, labels=labels)
+    except ValueError:
+        return None
+
+
+def journal_events(port):
+    status, body = http_get(port, "/debug/journal")
+    if status != 200:
+        return []
+    try:
+        return tpufd_journal.parse_journal(json.loads(body))["events"]
+    except (ValueError, KeyError):
+        return []
+
+
+def launch(argv, env_extra=None):
+    env = {**os.environ, "GCE_METADATA_HOST": "127.0.0.1:1",
+           **(env_extra or {})}
+    return subprocess.Popen(argv, env=env, stderr=subprocess.DEVNULL)
+
+
+def mock_argv(binary, port, out_file, fixture, extra=()):
+    return [str(binary), "--sleep-interval=1s", "--backend=mock",
+            f"--mock-topology-file={fixture}",
+            "--machine-type-file=/dev/null",
+            f"--output-file={out_file}",
+            # Tight hold-down so the one deliberate topology change
+            # lands instead of being governor-suppressed (which would
+            # correctly force slow passes until its timer expired —
+            # a different contract, tested by the governor suites).
+            "--health-flap-window=2s", "--health-flap-threshold=6",
+            f"--introspection-addr=127.0.0.1:{port}", *extra]
+
+
+def wait_passes(port, n, timeout=60):
+    assert wait_for(
+        lambda: (scrape(port, "tfd_rewrites_total") or 0) >= n,
+        timeout=timeout), f"never reached {n} passes"
+
+
+def debug_labels_agree(port, out_file):
+    """True when /debug/labels reconstructs the label file byte-for-byte
+    (retried around the write-then-update window, like soak.py)."""
+    for _ in range(5):
+        try:
+            before = out_file.read_text()
+        except OSError:
+            before = None
+        status, body = http_get(port, "/debug/labels")
+        try:
+            after = out_file.read_text()
+        except OSError:
+            after = None
+        if (before is not None and before == after and status == 200
+                and tpufd_journal.labels_file_text(json.loads(body))
+                == before):
+            return True
+        time.sleep(0.3)
+    return False
+
+
+class TestFastPathSoak:
+    def test_noop_soak_short_circuits_and_topology_change_is_one_slow_pass(
+            self, tfd_binary, tmp_path):
+        """The ISSUE 7 acceptance soak: 30 passes, >=27 fast, byte-equal
+        /debug/labels throughout, one mid-soak topology change = exactly
+        one slow source-dirty pass, and kill -9 invalidates the caches
+        (the restarted process's first passes are slow)."""
+        out_file = tmp_path / "tfd"
+        state_file = tmp_path / "state"
+        fixture = tmp_path / "topology.yaml"
+        shutil.copy(FIXTURES / "v2-8.yaml", fixture)
+        port = free_port()
+        argv = mock_argv(tfd_binary, port, out_file, fixture,
+                         extra=[f"--state-file={state_file}"])
+        proc = launch(argv)
+        try:
+            wait_passes(port, 2)
+            assert debug_labels_agree(port, out_file)
+            mtime_then = out_file.stat().st_mtime_ns
+            labels_before = labels_of(out_file.read_text())
+            assert labels_before["google.com/tpu.count"] == "4"
+
+            # Steady half: ride to ~pass 15, confirm the fast path is
+            # carrying the cadence and the mtime still advances (the
+            # skipped write touches it as the cadence proof).
+            wait_passes(port, 15)
+            fast_mid = scrape(port, "tfd_pass_fast_total") or 0
+            assert fast_mid >= 10, f"only {fast_mid} fast passes by 15"
+            assert out_file.stat().st_mtime_ns > mtime_then
+            assert (scrape(port, "tfd_sink_writes_skipped_total",
+                           labels={"sink": "file"}) or 0) >= 5
+            assert debug_labels_agree(port, out_file)
+
+            # Mid-soak topology change: the mock probe re-reads the
+            # fixture every tick, so the next probe moves the source's
+            # content fingerprint -> exactly one slow source-dirty pass.
+            fixture.write_text(
+                fixture.read_text().replace("count: 4", "count: 2")
+                .replace("chipsPerHost: 4", "chipsPerHost: 2"))
+            assert wait_for(
+                lambda: (labels_of(out_file.read_text())
+                         .get("google.com/tpu.count") == "2"),
+                timeout=20), "topology change never reached the labels"
+            wait_passes(port, 30, timeout=60)
+            fast_total = scrape(port, "tfd_pass_fast_total") or 0
+            passes = scrape(port, "tfd_rewrites_total") or 0
+            assert passes >= 30
+            assert fast_total >= passes - 3, (
+                f"{fast_total} fast of {passes} passes")
+            assert scrape(port, "tfd_pass_slow_total",
+                          labels={"reason": "source-dirty"}) == 1
+            assert debug_labels_agree(port, out_file)
+            shortcircuits = tpufd_journal.events_of_type(
+                journal_events(port), "pass-shortcircuit")
+            assert shortcircuits, "no pass-shortcircuit journal events"
+            assert all(e["fields"]["ok"] == "true" for e in shortcircuits)
+
+            # kill -9: a fresh process has no fragment caches — its
+            # first passes (warm restart + first live render) are slow,
+            # then the fast path resumes.
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=10)
+            port2 = free_port()
+            argv2 = mock_argv(tfd_binary, port2, out_file, fixture,
+                              extra=[f"--state-file={state_file}"])
+            proc = launch(argv2)
+            wait_passes(port2, 1, timeout=30)
+            assert (scrape(port2, "tfd_pass_fast_total") or 0) == 0, (
+                "restarted process short-circuited before any slow "
+                "render (caches cannot survive kill -9)")
+            warm = tpufd_journal.events_of_type(
+                journal_events(port2), "warm-restart")
+            assert warm, "state file was not warm-served after kill -9"
+            assert wait_for(
+                lambda: (scrape(port2, "tfd_pass_fast_total") or 0) >= 1,
+                timeout=30), "fast path never resumed after restart"
+        finally:
+            if proc.poll() is None:
+                proc.terminate()
+                proc.wait(timeout=10)
+
+    def test_deleted_label_file_heals_on_fast_pass(self, tfd_binary,
+                                                   tmp_path):
+        """An externally deleted label file fails the mtime-touch size
+        check, so the next (still fast) pass re-emits the cached bytes
+        for real instead of skipping over the hole."""
+        out_file = tmp_path / "tfd"
+        port = free_port()
+        proc = launch(mock_argv(tfd_binary, port, out_file,
+                                FIXTURES / "v2-8.yaml"))
+        try:
+            wait_passes(port, 3)
+            before = out_file.read_text()
+            out_file.unlink()
+            assert wait_for(out_file.exists, timeout=10), (
+                "deleted label file never healed")
+            assert out_file.read_text() == before
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+    def test_quarantined_source_always_forces_slow_passes(
+            self, tfd_binary, tmp_path):
+        """A quarantined source's hold (and its release) is timer-
+        driven, so while ANY key is quarantined every pass renders in
+        full — the acceptance criterion that governor/healthsm behavior
+        is unchanged by the fast path."""
+        out_file = tmp_path / "tfd"
+        port = free_port()
+        argv = [str(tfd_binary), "--sleep-interval=1s", "--backend=pjrt",
+                f"--libtpu-path={FAKE_PJRT}",
+                "--pjrt-refresh-interval=0", "--pjrt-retry-backoff=0",
+                "--pjrt-init-timeout=10s", "--machine-type-file=/dev/null",
+                "--snapshot-usable-for=60s",
+                f"--output-file={out_file}",
+                "--health-flap-window=10s", "--health-flap-threshold=3",
+                "--quarantine-cooldown=30s",
+                f"--introspection-addr=127.0.0.1:{port}"]
+        env = {"TFD_FAKE_PJRT_FLAP_EVERY_N": "1",
+               "TFD_FAKE_PJRT_COUNT_FILE": str(tmp_path / "creates"),
+               "TFD_FAKE_PJRT_KIND": "TPU v5 lite",
+               "TFD_FAKE_PJRT_BOUNDS": "2,2,1"}
+        proc = launch(argv, env)
+        try:
+            assert wait_for(
+                lambda: (scrape(port, "tfd_health_state",
+                                labels={"source": "pjrt"}) or 0) == 3,
+                timeout=60), "flapping source never quarantined"
+            slow_before = scrape(port, "tfd_pass_slow_total",
+                                 labels={"reason": "quarantine"}) or 0
+            fast_before = scrape(port, "tfd_pass_fast_total") or 0
+            passes_before = scrape(port, "tfd_rewrites_total") or 0
+            assert wait_for(
+                lambda: (scrape(port, "tfd_rewrites_total") or 0)
+                >= passes_before + 3, timeout=30)
+            assert (scrape(port, "tfd_pass_slow_total",
+                           labels={"reason": "quarantine"}) or 0) > \
+                slow_before
+            assert (scrape(port, "tfd_pass_fast_total")
+                    or 0) == fast_before, (
+                "a pass short-circuited while a source was quarantined")
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+
+class TestGoldenEquality:
+    def test_forced_slow_and_fast_path_outputs_are_byte_identical(
+            self, tfd_binary, tmp_path):
+        """The safety net: the same scenario — steady passes, then a
+        topology change — run under TFD_FORCE_SLOW_PASS=1 and under the
+        fast path must produce byte-identical label files and
+        /debug/labels documents (--no-timestamp pins the one per-load
+        nondeterminism)."""
+        outputs = {}
+        for mode, env in (("fast", {}),
+                          ("slow", {"TFD_FORCE_SLOW_PASS": "1"})):
+            out_file = tmp_path / f"tfd-{mode}"
+            fixture = tmp_path / f"topology-{mode}.yaml"
+            shutil.copy(FIXTURES / "v2-8.yaml", fixture)
+            port = free_port()
+            argv = mock_argv(tfd_binary, port, out_file, fixture,
+                             extra=["--no-timestamp"])
+            proc = launch(argv, env)
+            try:
+                wait_passes(port, 5)
+                mid = out_file.read_text()
+                fixture.write_text(
+                    fixture.read_text().replace("count: 4", "count: 2")
+                    .replace("chipsPerHost: 4", "chipsPerHost: 2"))
+                assert wait_for(
+                    lambda: (labels_of(out_file.read_text())
+                             .get("google.com/tpu.count") == "2"),
+                    timeout=20)
+                wait_passes(port, 10)
+                assert debug_labels_agree(port, out_file)
+                outputs[mode] = (mid, out_file.read_text())
+                if mode == "slow":
+                    # The forced-slow daemon must not have taken the
+                    # fast path at all.
+                    assert (scrape(port, "tfd_pass_fast_total")
+                            or 0) == 0
+                    assert (scrape(port, "tfd_pass_slow_total",
+                                   labels={"reason": "forced"})
+                            or 0) >= 5
+            finally:
+                proc.terminate()
+                proc.wait(timeout=10)
+        assert outputs["fast"][0] == outputs["slow"][0], (
+            "steady-state label bytes diverge between fast and "
+            "forced-slow daemons")
+        assert outputs["fast"][1] == outputs["slow"][1], (
+            "post-change label bytes diverge between fast and "
+            "forced-slow daemons")

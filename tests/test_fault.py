@@ -185,7 +185,11 @@ class TestSinkBreaker:
         """A REAL fake-apiserver 500 outage (no fault injection): the
         breaker opens after the configured failures — writes skip, the
         cadence holds — and closes again once the outage ends, with
-        transitions journaled and the gauge tracking the state."""
+        transitions journaled and the gauge tracking the state.
+        TFD_FORCE_SLOW_PASS pins every pass to a real CR write: the
+        fast path would skip the apiserver on fingerprint-clean passes
+        and the outage would only surface at the anti-entropy refresh —
+        this test is about the breaker itself."""
         port = free_port()
         sa = tmp_path / "sa"
         sa.mkdir()
@@ -221,6 +225,7 @@ class TestSinkBreaker:
                  "--sink-breaker-cooldown=2s",
                  f"--introspection-addr=127.0.0.1:{port}"],
                 {"NODE_NAME": "breaker-node",
+                 "TFD_FORCE_SLOW_PASS": "1",
                  "TFD_APISERVER_URL": server.url,
                  "TFD_SERVICEACCOUNT_DIR": str(sa)})
             try:

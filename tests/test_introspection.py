@@ -64,11 +64,13 @@ class TestEndpoints:
         ts = metrics.sample_value(text, "tfd_last_rewrite_timestamp_seconds")
         assert now - 120 < ts <= now + 5
         assert metrics.sample_value(text, "tfd_config_generation") == 1
-        # Per-labeler histogram: every labeler in the merge pipeline.
+        # Per-labeler histogram: every labeler in the merge pipeline ran
+        # at least once (steady-state passes short-circuit the labelers
+        # entirely, so the count does NOT track the pass count).
         for labeler in ("timestamp", "machine-type", "tpu", "tpu-vm"):
             assert metrics.sample_value(
                 text, "tfd_labeler_duration_seconds_count",
-                labels={"labeler": labeler}) >= 2, labeler
+                labels={"labeler": labeler}) >= 1, labeler
         # Per-backend histogram names the backend actually used.
         assert metrics.sample_value(
             text, "tfd_backend_duration_seconds_count",
@@ -88,7 +90,13 @@ def test_readyz_flips_on_rewrite_failures(tfd_binary, tmp_path):
     CRs goes ready after its first successful rewrite, then flips /readyz
     to 503 once an injected apiserver outage makes rewrites fail (the
     daemon itself stays alive — 5xx is transient — and /healthz stays
-    200), and recovers to 200 when the outage ends."""
+    200), and recovers to 200 when the outage ends.
+
+    TFD_FORCE_SLOW_PASS pins every pass to a real CR write: on the fast
+    path a fingerprint-clean pass skips the apiserver entirely, so an
+    outage only surfaces at the next dirty pass or anti-entropy refresh
+    (the documented fleet-scale tradeoff); this test is about the
+    write-failure path itself."""
     from tpufd.fakes.apiserver import FakeApiServer
 
     port = free_port()
@@ -105,6 +113,7 @@ def test_readyz_flips_on_rewrite_failures(tfd_binary, tmp_path):
              f"--introspection-addr=127.0.0.1:{port}"],
             env={**os.environ, "GCE_METADATA_HOST": "127.0.0.1:1",
                  "NODE_NAME": "introspect-node",
+                 "TFD_FORCE_SLOW_PASS": "1",
                  "TFD_APISERVER_URL": server.url,
                  "TFD_SERVICEACCOUNT_DIR": str(sa)},
             stderr=subprocess.DEVNULL)
@@ -380,4 +389,9 @@ def test_soak_scrapes_daemon_metrics(tfd_binary):
     assert report["readyz_ok"] is True
     assert report["cadence_ok"] is True
     assert report["crosscheck_ok"] is True
-    assert abs(report["cr_gets"] - report["passes"]) <= 2
+    # Steady-state passes short-circuit the CR sink WITHOUT a GET; the
+    # daemon's own skip counter accounts for the gap.
+    assert abs(report["cr_gets"] + report.get("cr_writes_skipped", 0)
+               - report["passes"]) <= 2
+    assert report["cr_gets"] < report["passes"], (
+        "no CR no-op passes were skipped — the fast path never engaged")

@@ -35,6 +35,7 @@
 #include "tfd/info/version.h"
 #include "tfd/k8s/breaker.h"
 #include "tfd/k8s/client.h"
+#include "tfd/k8s/desync.h"
 #include "tfd/lm/fragments.h"
 #include "tfd/lm/governor.h"
 #include "tfd/lm/labeler.h"
@@ -261,11 +262,21 @@ bool ForceSlowPassEnv() {
 }
 
 // Anti-entropy refresh cadence for skipped sink writes: even a
-// perfectly clean steady state re-writes the sink this often, so an
-// externally deleted NodeFeature CR (or a tampered label file the
-// size check missed) heals without waiting for a real change.
+// perfectly clean steady state re-writes the sink this often — a full
+// reconcile for the CR sink — so an externally deleted NodeFeature CR
+// (or a tampered label file the size check missed) heals without
+// waiting for a real change, and a dead sink is DISCOVERED within one
+// refresh period (the write doubles as the sink liveness probe).
+// The base period (--sink-refresh, auto max(60s, 2.5x interval)) is
+// stretched per node by the fleet desync hash so a rollout's refresh
+// clocks drift apart instead of herding the apiserver.
 double SinkRefreshSeconds(const config::Flags& flags) {
-  return std::max(60.0, 2.5 * flags.sleep_interval_s);
+  double base = flags.sink_refresh_s > 0
+                    ? flags.sink_refresh_s
+                    : std::max(60.0, 2.5 * flags.sleep_interval_s);
+  static const std::string node_key = k8s::desync::NodeKey();
+  return k8s::desync::RefreshPeriodS(base, node_key,
+                                     flags.cadence_jitter_pct);
 }
 
 // State-file refresh cadence: the warm-restart loader rejects a state
@@ -486,23 +497,36 @@ PassPlan PlanPass(const config::Config& config,
 // non-null) is the caller's pre-serialized "key=value\n" body — the
 // pass pipeline serializes once into its reused buffer; the sink must
 // not re-serialize.
+// `anti_entropy` marks the periodic refresh write: the CR sink forgets
+// its cached diff state first, so the write re-GETs and reconciles
+// against the server's ACTUAL content (healing external edits a blind
+// patch would miss) — and a failure is journaled/counted as a
+// discovered sink outage, since this write is the steady state's only
+// liveness probe of the sink.
 Status DispatchSink(const config::Config& config, const lm::Labels& labels,
                     const std::string* bytes, k8s::CircuitBreaker* breaker,
-                    bool* wrote_ok) {
+                    bool* wrote_ok, bool anti_entropy = false) {
   Status out;
   bool transient = false;
+  k8s::WriteOutcome wire;
   if (config.flags.use_node_feature_api) {
     // Breaker first: an open circuit skips before ANY per-pass work —
     // no serviceaccount file reads, no config build — so the skip is
-    // genuinely instant.
+    // genuinely instant. A server-directed deferral (Retry-After) is
+    // reported as what it is — an APF triage must not read "breaker
+    // open" off a circuit that never tripped.
     if (breaker != nullptr && !breaker->Allow()) {
+      const bool deferred = breaker->deferred();
+      const char* why = deferred ? "write deferred (server Retry-After)"
+                                 : "circuit breaker open";
       obs::DefaultJournal().Record(
           "sink-write", "cr",
-          "NodeFeature CR write skipped: circuit breaker open",
-          {{"action", "breaker-skip"}, {"ok", "false"},
-           {"error", "circuit breaker open"}});
-      TFD_LOG_ERROR << "NodeFeature sink circuit breaker open; skipping "
-                       "write (will retry after cooldown)";
+          std::string("NodeFeature CR write skipped: ") + why,
+          {{"action", deferred ? "defer-skip" : "breaker-skip"},
+           {"ok", "false"},
+           {"error", why}});
+      TFD_LOG_ERROR << "NodeFeature sink " << why
+                    << "; skipping write (will retry later)";
       return Status::Ok();  // recorded as a failed rewrite by the caller
     }
     Result<k8s::ClusterConfig> cluster = k8s::LoadInClusterConfig();
@@ -516,10 +540,26 @@ Status DispatchSink(const config::Config& config, const lm::Labels& labels,
     }
     cluster->request_deadline_ms =
         config.flags.sink_request_deadline_s * 1000;
-    out = k8s::UpdateNodeFeature(*cluster, labels, &transient);
+    cluster->use_patch = config.flags.sink_patch;
+    if (anti_entropy) k8s::DefaultSinkState().Invalidate();
+    out = k8s::UpdateNodeFeature(*cluster, labels, &transient, nullptr,
+                                 &wire);
     if (breaker != nullptr) {
       if (out.ok()) {
         breaker->RecordSuccess();
+      } else if (transient && wire.retry_after_s > 0) {
+        // Adaptive backoff: the server named its own recovery time
+        // (429/503 Retry-After, typically APF). A server handing out
+        // pacing is ALIVE — this must not feed the consecutive-failure
+        // streak, or a sustained-but-orderly throttle storm opens the
+        // breaker and turns 1s of pacing into a full cooldown outage.
+        // The deferral is stretched by the per-node desync hash so the
+        // whole throttled fleet doesn't re-arrive as one herd a window
+        // later.
+        breaker->Defer(
+            k8s::desync::SpreadRetryAfterS(wire.retry_after_s,
+                                           k8s::desync::NodeKey()),
+            wire.apf_rejected ? "APF Retry-After" : "Retry-After");
       } else if (transient) {
         breaker->RecordTransientFailure();
       } else {
@@ -533,6 +573,25 @@ Status DispatchSink(const config::Config& config, const lm::Labels& labels,
                                 config.flags.output_file, &transient);
   } else {
     out = lm::OutputToFile(labels, config.flags.output_file, &transient);
+  }
+  if (!out.ok() && anti_entropy && wire.retry_after_s <= 0) {
+    // The steady state's only probe of the sink just failed: without
+    // this record, a dead sink under a fingerprint-clean fleet is
+    // invisible until the next real label change. Outage detection is
+    // therefore bounded by the (jittered) refresh cadence. A rejection
+    // carrying Retry-After is excluded — that is a LIVE server pacing
+    // us (the deferral above already handled it), not an outage.
+    obs::Default()
+        .GetCounter("tfd_sink_outages_total",
+                    "Sink outages discovered by the anti-entropy "
+                    "refresh write (steady-state liveness probe).")
+        ->Inc();
+    obs::DefaultJournal().Record(
+        "sink-outage",
+        config.flags.use_node_feature_api ? "cr" : "file",
+        "anti-entropy refresh found the sink dead: " + out.message(),
+        {{"error", out.message()},
+         {"transient", transient ? "true" : "false"}});
   }
   if (!out.ok() && transient && !config.flags.oneshot) {
     // Apiserver hiccups, full disks, exhausted conflict retries: keep
@@ -869,8 +928,15 @@ Status LabelOnceInner(
   if (!*write_skipped) {
     // Output dispatch: NodeFeature CR (behind the circuit breaker) when
     // the NodeFeature API is enabled, else the feature file / stdout.
-    Status out =
-        DispatchSink(config, merged, &cache->scratch, breaker, wrote_ok);
+    // A write past the refresh window is the anti-entropy reconcile:
+    // the CR sink drops its cached diff state and verifies the server's
+    // actual content.
+    bool anti_entropy_due =
+        cache->last_real_write_wall > 0 &&
+        WallClockSeconds() - cache->last_real_write_wall >=
+            SinkRefreshSeconds(config.flags);
+    Status out = DispatchSink(config, merged, &cache->scratch, breaker,
+                              wrote_ok, anti_entropy_due);
     if (!out.ok()) return out;
   }
   if (!*wrote_ok) return Status::Ok();  // survived transient sink failure
@@ -904,9 +970,9 @@ Status FastPass(const config::Config& config, const ServeDecision& decision,
                          !config.flags.output_file.empty();
   const bool cr_sink = config.flags.use_node_feature_api;
   double now_wall = WallClockSeconds();
-  bool due = now_wall - cache->last_real_write_wall >=
-                 SinkRefreshSeconds(config.flags) ||
-             !config.flags.fault_spec.empty();
+  bool refresh_due = now_wall - cache->last_real_write_wall >=
+                     SinkRefreshSeconds(config.flags);
+  bool due = refresh_due || !config.flags.fault_spec.empty();
   bool wrote_ok = false;
   bool skipped = false;
   Status out;
@@ -923,9 +989,11 @@ Status FastPass(const config::Config& config, const ServeDecision& decision,
   }
   if (!skipped) {
     // Refresh due, stdout sink, or the label file was tampered with:
-    // re-emit the cached bytes for real (still no render).
+    // re-emit the cached bytes for real (still no render). The
+    // refresh-due write reconciles the CR sink in full and reports a
+    // dead sink (anti-entropy doubles as the liveness probe).
     out = DispatchSink(config, state->labels, &cache->published, breaker,
-                       &wrote_ok);
+                       &wrote_ok, refresh_due);
     if (wrote_ok) cache->last_real_write_wall = now_wall;
   }
   double seconds = obs::SecondsSince(t0);
@@ -1412,7 +1480,7 @@ RunOutcome Run(const config::Config& config, int config_generation,
                const sigset_t& sigmask, obs::IntrospectionServer* server,
                k8s::CircuitBreaker* breaker,
                lm::LabelGovernor* governor, LabelState* state,
-               PassCache* cache) {
+               PassCache* cache, uint64_t* tick) {
   // Labeler instances (below) are rebuilt per run — a failed reload
   // re-enters under the SAME config generation but with a fresh
   // timestamp — so cached fragments and published bytes must die here.
@@ -1443,6 +1511,17 @@ RunOutcome Run(const config::Config& config, int config_generation,
 
   bool cleanup_output = !config.flags.oneshot &&
                         !config.flags.output_file.empty();
+  // Fleet cadence desync (k8s/desync.h): a deterministic
+  // hash-of-nodename phase offset on the FIRST sleep of the PROCESS
+  // spreads a DaemonSet rollout's synchronized daemons across the
+  // whole interval (always up to one full interval when desync is on),
+  // and per-tick jitter — whose amplitude is --cadence-jitter-pct —
+  // keeps them from re-converging (0 = the old fixed cadence, no
+  // offset, no jitter).
+  // The tick counter lives above the reload loop (caller-owned): a
+  // SIGHUP must not re-apply the one-time phase offset and stretch the
+  // reloaded config's first pass by up to a whole extra interval.
+  const std::string desync_node = k8s::desync::NodeKey();
   while (true) {
     // The restored rung: while probes are still wedged/failing after a
     // warm restart and NO snapshot can serve, keep re-serving the
@@ -1492,8 +1571,18 @@ RunOutcome Run(const config::Config& config, int config_generation,
     // SIGUSR1 → write the post-mortem dump and keep sleeping the
     // remainder; SIGINT/SIGTERM/SIGQUIT → clean exit (reference
     // main.go:198-217).
-    auto sleep_until = std::chrono::steady_clock::now() +
-                       std::chrono::seconds(config.flags.sleep_interval_s);
+    double sleep_s = k8s::desync::JitteredIntervalS(
+        config.flags.sleep_interval_s, desync_node, *tick,
+        config.flags.cadence_jitter_pct);
+    if (*tick == 0) {
+      sleep_s += k8s::desync::PhaseOffsetS(config.flags.sleep_interval_s,
+                                           desync_node,
+                                           config.flags.cadence_jitter_pct);
+    }
+    (*tick)++;
+    auto sleep_until =
+        std::chrono::steady_clock::now() +
+        std::chrono::milliseconds(static_cast<long long>(sleep_s * 1000));
     int sig = 0;
     while (true) {
       auto now = std::chrono::steady_clock::now();
@@ -1615,6 +1704,9 @@ int Main(int argc, char** argv) {
   // state is served exactly once per process.
   LabelState label_state;
   PassCache pass_cache;
+  // Desync tick counter: the one-time rollout phase offset is per
+  // PROCESS, not per config load (see Run).
+  uint64_t desync_tick = 0;
   k8s::CircuitBreaker sink_breaker;
   // The anti-flap governor's hold-down history also survives reloads:
   // a SIGHUP must not grant every key a free flip.
@@ -1823,7 +1915,7 @@ int Main(int argc, char** argv) {
 
     switch (Run(loaded.config, config_generation, sigmask, server.get(),
                 &sink_breaker, &label_governor, &label_state,
-                &pass_cache)) {
+                &pass_cache, &desync_tick)) {
       case RunOutcome::kExit:
         TFD_LOG_INFO << "exiting";
         return 0;

@@ -16,9 +16,17 @@ the hermetic mock backend, then fails if:
 
 Exit 0 when both gates hold; nonzero with the reason otherwise.
 
+Fleet mode (ISSUE 8): `--fleet RECORD.json` gates a fleet-soak record
+(scripts/fleet_soak.py --json) instead of running the local bench —
+aggregate steady-state QPS reduction vs the GET+PUT baseline (absolute
+>= 5x), the worst 1-second burst bucket (<= 10% of the fleet), and the
+steady QPS / churn p99 regressions against the committed BENCH_r08.json.
+
 Usage:
   python3 scripts/bench_gate.py [--reference BENCH_r07.json]
       [--noop-budget-us 1000] [--dirty-slack 0.25]
+  python3 scripts/bench_gate.py --fleet fleet.json
+      [--fleet-reference BENCH_r08.json] [--fleet-slack 0.5]
 """
 
 import argparse
@@ -29,7 +37,60 @@ import sys
 sys.path.insert(0, os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
 
-import bench  # noqa: E402
+
+def fleet_gate(record_path, reference_path, slack):
+    """Gates a fleet-soak record: the two absolute acceptance bounds
+    plus regression vs the committed reference. Returns a problem list
+    (empty = pass)."""
+    with open(record_path) as f:
+        record = json.load(f)
+    problems = []
+
+    reduction = record.get("steady_qps_reduction")
+    if reduction is None:
+        problems.append("fleet record has no steady_qps_reduction")
+    elif reduction < 5.0:
+        problems.append(
+            f"steady-state QPS reduction {reduction}x vs the GET+PUT "
+            f"baseline is below the 5x floor")
+    # Absent phase data FAILS: a partially-run or older-format soak
+    # record must not sail through the herd/backoff gates on defaulted
+    # zeros.
+    nodes = record.get("nodes") or 1
+    steady = record.get("phases", {}).get("diff_steady")
+    if steady is None or "worst_bucket" not in steady:
+        problems.append("fleet record has no diff_steady worst_bucket")
+    elif steady["worst_bucket"] / nodes > 0.10:
+        problems.append(
+            f"worst steady 1-second bucket {steady['worst_bucket']} "
+            f"requests is over 10% of the {nodes}-node fleet (herd "
+            f"survives)")
+    if not record.get("golden_equal"):
+        problems.append("diff-sink CR content diverged from the "
+                        "full-update path (golden check)")
+    storm = record.get("phases", {}).get("storm")
+    if storm is None or "breaker_opens" not in storm:
+        problems.append("fleet record has no storm breaker_opens")
+    elif storm["breaker_opens"] > 0:
+        problems.append(f"storm opened {storm['breaker_opens']} "
+                        "breaker(s) — adaptive backoff regressed")
+
+    try:
+        with open(reference_path) as f:
+            ref = json.load(f)
+    except (OSError, ValueError) as e:
+        problems.append(f"fleet reference {reference_path} unreadable: {e}")
+        return problems
+    for key, label in (("steady_qps_diff", "steady-state sink QPS"),
+                       ("churn_p99_ms", "churn write p99")):
+        got, want = record.get(key), ref.get(key)
+        if got is None or want is None:
+            problems.append(f"{key} missing from record or reference")
+        elif want > 0 and got > want * (1.0 + slack):
+            problems.append(
+                f"{label} {got} regressed past {want * (1.0 + slack):.2f} "
+                f"(reference {want} +{int(slack * 100)}%)")
+    return problems
 
 
 def reference_dirty_p50_ms(path):
@@ -42,13 +103,33 @@ def reference_dirty_p50_ms(path):
 
 
 def main(argv=None):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     ap = argparse.ArgumentParser()
-    ap.add_argument("--reference", default=os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "BENCH_r07.json"))
+    ap.add_argument("--reference",
+                    default=os.path.join(repo, "BENCH_r07.json"))
     ap.add_argument("--noop-budget-us", type=float, default=1000.0)
     ap.add_argument("--dirty-slack", type=float, default=0.25)
+    ap.add_argument("--fleet", metavar="RECORD.json",
+                    help="gate this fleet-soak record instead of running "
+                         "the local steady-state bench")
+    ap.add_argument("--fleet-reference",
+                    default=os.path.join(repo, "BENCH_r08.json"))
+    # Wider than the local bench's slack: the fleet numbers ride a
+    # shared CI box through ~3000 real HTTP requests.
+    ap.add_argument("--fleet-slack", type=float, default=0.5)
     args = ap.parse_args(argv)
+
+    if args.fleet:
+        problems = fleet_gate(args.fleet, args.fleet_reference,
+                              args.fleet_slack)
+        if problems:
+            for p in problems:
+                print(f"fleet bench gate FAILED: {p}", file=sys.stderr)
+            return 1
+        print("fleet bench gate OK")
+        return 0
+
+    import bench
 
     bench.ensure_built()
     record = bench.steady_state_record()

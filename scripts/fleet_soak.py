@@ -1,0 +1,499 @@
+#!/usr/bin/env python3
+"""Cluster-in-a-box fleet soak: ~1000 simulated daemon sink loops vs one
+fake apiserver (ISSUE 8).
+
+What a 50k-node cluster does to one apiserver cannot be rehearsed with
+one daemon process, so this harness simulates the fleet: every node is a
+`tpufd.sink` twin of the daemon's sink behavior (the SAME desync math,
+diff-patch flow, anti-entropy refresh, breaker + Retry-After backoff the
+C++ runs — pinned by the parity tests), scheduled on a shared heap and
+executed against a real `tpufd.fakes.apiserver` instance over pooled
+keep-alive connections.
+
+Phases (all seeded, all measured):
+
+  baseline  — the reference GET+full-PUT-per-tick sink, synchronized
+              cadence (no desync): churn then steady. This is the load
+              profile the tentpole exists to remove.
+  diff      — the new sink: fingerprint no-op fast path (no request at
+              all when nothing changed), JSON-merge-patch diff writes,
+              hash-of-nodename phase offset + per-tick jitter, jittered
+              anti-entropy refresh: churn then steady.
+  storm     — apiserver capacity capped while the whole fleet owes a
+              write: proves the 429/Retry-After adaptive backoff drains
+              the herd without breaker flap.
+  golden    — one node driven through an identical label-change schedule
+              against two fresh servers, full-update vs diff sink; the
+              stored CRs must match byte-for-byte at every step.
+
+Request accounting buckets arrivals by the tick's SCHEDULED second (the
+quantity desync controls); per-request latency is measured on the wire.
+Worst-bucket share >10% of a phase's writes means the fleet still herds.
+
+Exit nonzero when an acceptance invariant fails; the regression numbers
+(steady QPS, p99) are gated separately by scripts/bench_gate.py against
+the committed BENCH_r08.json.
+
+Usage:
+  python3 scripts/fleet_soak.py [--nodes 1000] [--seed 8] [--json out]
+      [--quick]
+"""
+
+import argparse
+import collections
+import heapq
+import http.client
+import json
+import os
+import random
+import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+from tpufd.fakes.apiserver import FakeApiServer  # noqa: E402
+from tpufd import sink as sinklib  # noqa: E402
+
+NAMESPACE = "fleet"
+
+BASE_LABELS = {
+    "google.com/tfd.tpu-vm": "true",
+    "google.com/tpu.accelerator-type": "v5litepod-16",
+    "google.com/tpu.count": "4",
+    "google.com/tpu.machine": "ct5lp-hightpu-4t",
+    "google.com/tpu.product": "tpu-v5-lite-podslice",
+    "google.com/tpu.slice.shape": "4x4",
+    "google.com/tpu.topology": "4x4",
+    "google.com/tpu.vcpu": "112",
+}
+
+
+class Wire:
+    """Pooled keep-alive HTTP client: one connection per worker thread,
+    every request timed into `latencies_ms` and counted into the
+    scheduled-second bucket the caller names."""
+
+    def __init__(self, port):
+        self.port = port
+        self.local = threading.local()
+        self.lock = threading.Lock()
+        self.latencies_ms = []
+        self.buckets = collections.Counter()
+        self.by_verb = collections.Counter()
+        self.throttled = 0
+
+    def _conn(self):
+        conn = getattr(self.local, "conn", None)
+        if conn is None:
+            conn = http.client.HTTPConnection("127.0.0.1", self.port,
+                                              timeout=30)
+            self.local.conn = conn
+        return conn
+
+    def request_fn(self, scheduled_t):
+        """A tpufd.sink request callable attributing every request to
+        `scheduled_t`'s second bucket."""
+        def request(method, path, body, headers):
+            payload = None
+            if body is not None:
+                payload = json.dumps(body, separators=(",", ":"))
+            t0 = time.monotonic()
+            for attempt in (0, 1):  # one silent retry: stale keep-alive
+                conn = self._conn()
+                try:
+                    conn.request(method, path, payload, headers)
+                    resp = conn.getresponse()
+                    raw = resp.read()
+                    break
+                except (OSError, http.client.HTTPException):
+                    self.local.conn = None
+                    if attempt:
+                        raise
+            ms = (time.monotonic() - t0) * 1000.0
+            resp_headers = dict(resp.getheaders())
+            try:
+                resp_body = json.loads(raw) if raw else None
+            except ValueError:
+                resp_body = None
+            with self.lock:
+                self.latencies_ms.append(ms)
+                self.buckets[int(scheduled_t)] += 1
+                self.by_verb[method] += 1
+                if resp.status == 429:
+                    self.throttled += 1
+            return resp.status, resp_headers, resp_body
+        return request
+
+    def snapshot(self):
+        with self.lock:
+            return (list(self.latencies_ms), dict(self.buckets),
+                    dict(self.by_verb), self.throttled)
+
+    def reset(self):
+        with self.lock:
+            self.latencies_ms.clear()
+            self.buckets.clear()
+            self.by_verb.clear()
+            self.throttled = 0
+
+
+def percentile(values, pct):
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    idx = min(len(ordered) - 1, int(round(pct / 100.0 * (len(ordered) - 1))))
+    return ordered[idx]
+
+
+class Node:
+    def __init__(self, index, seed, mode, interval_s, refresh_s,
+                 jitter_pct):
+        self.name = f"sim-node-{index:04d}"
+        self.mode = mode
+        self.interval_s = interval_s
+        self.jitter_pct = jitter_pct if mode == "diff" else 0
+        self.rng = random.Random(seed * 1000003 + index)
+        # Serializes this node's ticks: on a loaded box the worker pool
+        # can backlog past one interval, and two in-flight ticks for
+        # the same node would race the DiffSink/Breaker state.
+        self.lock = threading.Lock()
+        self.labels = dict(BASE_LABELS)
+        self.labels["google.com/tfd.node"] = self.name
+        self.tick = 0
+        self.churn_serial = 0
+        self.last_write_t = None
+        self.retry_pending = False
+        if mode == "diff":
+            self.sink = sinklib.DiffSink(self.name, NAMESPACE)
+            self.refresh_s = sinklib.refresh_period_s(
+                refresh_s, self.name, jitter_pct)
+        else:
+            self.sink = sinklib.BaselineSink(self.name, NAMESPACE)
+            self.refresh_s = refresh_s
+        self.breaker = sinklib.Breaker(open_after=3, cooldown_s=30.0)
+
+    def first_due(self, start_t):
+        if self.mode == "diff":
+            return start_t + sinklib.phase_offset_s(
+                self.interval_s, self.name, self.jitter_pct)
+        return start_t  # baseline: the synchronized rollout herd
+
+    def next_due(self, due_t):
+        self.tick += 1
+        return due_t + sinklib.jittered_interval_s(
+            self.interval_s, self.name, self.tick, self.jitter_pct)
+
+    def maybe_churn(self, churn_prob):
+        if churn_prob > 0 and self.rng.random() < churn_prob:
+            self.churn_serial += 1
+            self.labels["google.com/tpu.health.probe-ms"] = str(
+                self.churn_serial)
+
+    def run_tick(self, request, now, churn_prob):
+        """One simulated pass: mirrors the daemon's plan (fast no-op vs
+        write) + sink flow. Returns True when a write was attempted."""
+        self.maybe_churn(churn_prob)
+        if self.mode == "baseline":
+            # The reference sink: GET + compare (+ full PUT) every tick.
+            out = self.sink.write(request, self.labels)
+            if out.ok:
+                self.last_write_t = now
+            return True
+        dirty = self.labels != self.sink.acked or not self.sink.known
+        refresh_due = (self.last_write_t is not None and
+                       now - self.last_write_t >= self.refresh_s)
+        if not (dirty or refresh_due or self.retry_pending):
+            return False  # fingerprint-clean fast pass: no request at all
+        if not self.breaker.allow(now):
+            self.retry_pending = True
+            return False
+        if refresh_due and not dirty:
+            self.sink.invalidate()  # anti-entropy: reconcile for real
+        out = self.sink.write(request, self.labels)
+        if out.ok:
+            self.breaker.record_success()
+            self.last_write_t = now
+            self.retry_pending = False
+        elif out.retry_after_s > 0:
+            # Server-directed pacing from a LIVE server: defer instead
+            # of feeding the breaker's failure streak (the daemon's
+            # DispatchSink makes the same call).
+            self.breaker.defer(
+                sinklib.spread_retry_after_s(out.retry_after_s,
+                                             self.name), now)
+            self.retry_pending = True
+        else:
+            if out.transient:
+                self.breaker.record_transient_failure(now)
+            self.retry_pending = True
+        return True
+
+
+def run_phase(wire, pool, nodes, duration_s, churn_prob, label):
+    """Drives every node's tick schedule for `duration_s`, returns the
+    phase record."""
+    wire.reset()
+    start = time.monotonic()
+    end = start + duration_s
+    heap = []
+    for node in nodes:
+        heapq.heappush(heap, (node.first_due(start), id(node), node))
+    pending = []
+
+    def execute(node, due):
+        with node.lock:
+            node.run_tick(wire.request_fn(due), due, churn_prob)
+
+    while heap:
+        due, _, node = heapq.heappop(heap)
+        if due >= end:
+            break
+        delay = due - time.monotonic()
+        if delay > 0:
+            time.sleep(min(delay, 0.05))
+            if time.monotonic() < due:
+                heapq.heappush(heap, (due, id(node), node))
+                continue
+        pending.append(pool.submit(execute, node, due))
+        nxt = node.next_due(due)
+        if nxt < end:
+            heapq.heappush(heap, (nxt, id(node), node))
+    for f in pending:
+        f.result()
+    elapsed = time.monotonic() - start
+    latencies, buckets, by_verb, throttled = wire.snapshot()
+    total = sum(by_verb.values())
+    worst = max(buckets.values()) if buckets else 0
+    record = {
+        "phase": label,
+        "duration_s": round(elapsed, 2),
+        "requests": total,
+        "by_verb": by_verb,
+        "qps": round(total / elapsed, 2) if elapsed else 0.0,
+        "throttled_429": throttled,
+        "worst_bucket": worst,
+        "worst_bucket_frac": round(worst / total, 4) if total else 0.0,
+        "p50_ms": round(percentile(latencies, 50), 2),
+        "p99_ms": round(percentile(latencies, 99), 2),
+    }
+    print(json.dumps(record), flush=True)
+    return record
+
+
+def golden_check(seed, steps=12):
+    """One node, one seeded label-change schedule, two fresh servers:
+    full-update sink vs diff sink (with periodic anti-entropy
+    invalidation). The stored CRs must agree at every step — the diff
+    sink must never publish content the reference flow would not."""
+    rng = random.Random(seed)
+    schedule = []
+    labels = dict(BASE_LABELS)
+    for step in range(steps):
+        action = rng.choice(["set", "set", "remove", "noop"])
+        if action == "set":
+            labels[f"google.com/tpu.g{rng.randrange(4)}"] = str(
+                rng.randrange(1000))
+        elif action == "remove":
+            for key in list(labels):
+                if key.startswith("google.com/tpu.g"):
+                    del labels[key]
+                    break
+        schedule.append(dict(labels))
+
+    def strip(obj):
+        meta = obj.get("metadata", {})
+        return {
+            "labels": meta.get("labels"),
+            "spec": obj.get("spec"),
+        }
+
+    with FakeApiServer() as full_server, FakeApiServer() as diff_server:
+        full_wire = Wire(full_server.port)
+        diff_wire = Wire(diff_server.port)
+        full = sinklib.BaselineSink("golden-node", NAMESPACE)
+        diff = sinklib.DiffSink("golden-node", NAMESPACE)
+        key = (NAMESPACE, "tfd-features-for-golden-node")
+        for step, step_labels in enumerate(schedule):
+            if step % 5 == 4:
+                diff.invalidate()  # the anti-entropy reconcile cadence
+            out_full = full.write(full_wire.request_fn(0), step_labels)
+            out_diff = diff.write(diff_wire.request_fn(0), step_labels)
+            if not (out_full.ok and out_diff.ok):
+                return False, f"step {step}: write failed"
+            a = strip(full_server.store[key])
+            b = strip(diff_server.store[key])
+            if a != b:
+                return False, (f"step {step}: stores diverged:\n"
+                               f"full: {json.dumps(a, sort_keys=True)}\n"
+                               f"diff: {json.dumps(b, sort_keys=True)}")
+    return True, ""
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=1000)
+    ap.add_argument("--seed", type=int, default=8)
+    ap.add_argument("--interval", type=float, default=5.0,
+                    help="simulated rewrite cadence (s)")
+    ap.add_argument("--refresh", type=float, default=30.0,
+                    help="anti-entropy base period (s)")
+    ap.add_argument("--jitter-pct", type=int, default=10)
+    ap.add_argument("--churn-secs", type=float, default=12.0)
+    ap.add_argument("--steady-secs", type=float, default=18.0)
+    ap.add_argument("--storm-secs", type=float, default=10.0)
+    ap.add_argument("--storm-capacity", type=int, default=0,
+                    help="apiserver requests/s during the storm "
+                         "(0 = fleet/10)")
+    ap.add_argument("--workers", type=int, default=64)
+    ap.add_argument("--json", help="write the soak record here")
+    ap.add_argument("--quick", action="store_true",
+                    help="40 nodes, short phases (test smoke)")
+    args = ap.parse_args(argv)
+
+    if args.quick:
+        args.nodes = min(args.nodes, 40)
+        args.churn_secs = min(args.churn_secs, 6.0)
+        args.steady_secs = min(args.steady_secs, 6.0)
+        args.storm_secs = min(args.storm_secs, 6.0)
+
+    record = {"nodes": args.nodes, "seed": args.seed,
+              "interval_s": args.interval, "refresh_s": args.refresh,
+              "jitter_pct": args.jitter_pct, "phases": {}}
+    problems = []
+
+    with FakeApiServer() as server:
+        wire = Wire(server.port)
+        pool = ThreadPoolExecutor(max_workers=args.workers)
+
+        def fleet(mode):
+            return [Node(i, args.seed, mode, args.interval, args.refresh,
+                         args.jitter_pct) for i in range(args.nodes)]
+
+        # Both modes get an unmeasured warm-up pass first (every node
+        # creates its CR): pods create once per lifetime, so the create
+        # burst is a rollout event, not part of the steady/churn load
+        # profile the phases below measure.
+        create_secs = args.interval + 1
+
+        # ---- baseline: the reference GET+PUT sink, synchronized.
+        nodes = fleet("baseline")
+        record["phases"]["baseline_create"] = run_phase(
+            wire, pool, nodes, create_secs, 0.0, "baseline_create")
+        record["phases"]["baseline_churn"] = run_phase(
+            wire, pool, nodes, args.churn_secs, 0.3, "baseline_churn")
+        record["phases"]["baseline_steady"] = run_phase(
+            wire, pool, nodes, args.steady_secs, 0.0, "baseline_steady")
+
+        # ---- diff sink + desync. Fresh store so create costs are
+        # comparable; same seed so churn draws are identical.
+        server.store.clear()
+        nodes = fleet("diff")
+        record["phases"]["diff_create"] = run_phase(
+            wire, pool, nodes, create_secs, 0.0, "diff_create")
+        record["phases"]["diff_churn"] = run_phase(
+            wire, pool, nodes, args.churn_secs, 0.3, "diff_churn")
+        record["phases"]["diff_steady"] = run_phase(
+            wire, pool, nodes, args.steady_secs, 0.0, "diff_steady")
+
+        # ---- 429 storm: cap the apiserver while the whole fleet owes
+        # a write (one synchronized churn burst), then measure drain.
+        capacity = args.storm_capacity or max(10, args.nodes // 10)
+        for node in nodes:
+            node.maybe_churn(1.0)  # everyone dirty at once
+        server.set_capacity(capacity)
+        storm = run_phase(wire, pool, nodes, args.storm_secs, 0.0, "storm")
+        server.set_capacity(0)
+        # Drain window: every deferred/pending node retries at its next
+        # (jittered) tick, so 1.5 intervals + margin covers the worst
+        # phase slot.
+        drain = run_phase(wire, pool, nodes,
+                          max(8.0, 1.5 * args.interval + 2), 0.0,
+                          "storm_drain")
+        record["phases"]["storm"] = storm
+        record["phases"]["storm_drain"] = drain
+        storm["breaker_opens"] = sum(n.breaker.opens() for n in nodes)
+        storm["undrained_nodes"] = sum(
+            1 for n in nodes if n.retry_pending)
+        pool.shutdown()
+
+    # ---- golden: diff-sink content == full-update content, always.
+    golden_ok, golden_detail = golden_check(args.seed)
+    record["golden_equal"] = golden_ok
+
+    # ---- headline numbers + acceptance invariants.
+    base_steady = record["phases"]["baseline_steady"]
+    diff_steady = record["phases"]["diff_steady"]
+    reduction = (base_steady["qps"] / diff_steady["qps"]
+                 if diff_steady["qps"] else float("inf"))
+    record["steady_qps_baseline"] = base_steady["qps"]
+    record["steady_qps_diff"] = diff_steady["qps"]
+    record["steady_qps_reduction"] = round(min(reduction, 9999.0), 2)
+    record["steady_p99_ms"] = diff_steady["p99_ms"]
+    record["churn_p99_ms"] = record["phases"]["diff_churn"]["p99_ms"]
+    record["churn_p99_baseline_ms"] = (
+        record["phases"]["baseline_churn"]["p99_ms"])
+    record["steady_worst_bucket_frac"] = diff_steady["worst_bucket_frac"]
+
+    if reduction < 5.0:
+        problems.append(
+            f"steady-state QPS only dropped {reduction:.1f}x vs the "
+            f"GET+PUT baseline (need >= 5x)")
+    # Thundering-herd bound: no 1-second bucket may see more than 10%
+    # of the FLEET's writes — the herd metric is how much of the
+    # cluster arrives together, so it scales with node count, not with
+    # how long a phase happened to run. (The synchronized baseline
+    # delivers the entire fleet into one bucket: frac 1.0.)
+    for phase in ("diff_churn", "diff_steady"):
+        worst = record["phases"][phase]["worst_bucket"]
+        fleet_frac = worst / args.nodes
+        record["phases"][phase]["worst_bucket_fleet_frac"] = round(
+            fleet_frac, 4)
+        # Gated only with a statistically meaningful sample — the
+        # --quick smoke's handful of writes can land anywhere.
+        if record["phases"][phase]["requests"] >= 50 and fleet_frac > 0.10:
+            problems.append(
+                f"{phase}: worst 1-second bucket got {worst} requests = "
+                f"{fleet_frac:.0%} of the fleet (desync failed, herd "
+                f"survives)")
+    record["steady_worst_bucket_fleet_frac"] = round(
+        record["phases"]["diff_steady"]["worst_bucket"] / args.nodes, 4)
+    record["baseline_worst_bucket_fleet_frac"] = round(
+        record["phases"]["baseline_steady"]["worst_bucket"] / args.nodes,
+        4)
+    if storm["throttled_429"] == 0:
+        problems.append("storm phase saw no 429s (storm did not happen)")
+    if storm["breaker_opens"] > 0:
+        problems.append(
+            f"storm opened {storm['breaker_opens']} breaker(s): the "
+            f"Retry-After backoff should drain the herd without flap")
+    if storm["undrained_nodes"] > 0:
+        problems.append(
+            f"{storm['undrained_nodes']} node(s) still owe a write "
+            f"after the drain window")
+    if not golden_ok:
+        problems.append(f"golden divergence: {golden_detail}")
+
+    print(json.dumps(record))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(record, f, indent=1)
+    if problems:
+        for p in problems:
+            print(f"fleet soak FAILED: {p}", file=sys.stderr)
+        return 1
+    print(
+        f"fleet soak OK: {args.nodes} nodes, steady "
+        f"{base_steady['qps']} -> {diff_steady['qps']} qps "
+        f"({reduction:.1f}x), worst steady bucket "
+        f"{record['steady_worst_bucket_fleet_frac']:.1%} of the fleet "
+        f"(baseline {record['baseline_worst_bucket_fleet_frac']:.0%}), "
+        f"p99 {diff_steady['p99_ms']}ms, storm drained without breaker "
+        f"flap, golden equal")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

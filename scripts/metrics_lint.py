@@ -109,6 +109,14 @@ CONDITIONAL = {
     # REQUIRED_LABELED above: the first pass always registers it.)
     "tfd_pass_fast_total",
     "tfd_sink_writes_skipped_total",
+    # Fleet-scale diff sink (ISSUE 8): the CR sink is config-gated
+    # (--use-node-feature-api), so its wire counters/histogram and the
+    # adaptive-backoff + anti-entropy outage records never register on
+    # this file-sink boot.
+    "tfd_sink_requests_total",
+    "tfd_sink_patch_bytes",
+    "tfd_sink_deferrals_total",
+    "tfd_sink_outages_total",
 }
 
 

@@ -257,16 +257,19 @@ class CrSink:
             return None
         labels = obj.get("spec", {}).get("labels", {})
         text = "\n".join(f"{k}={v}" for k, v in sorted(labels.items()))
-        # Generation = count of CR GETs, not resourceVersion: the
-        # timestamp label is constant per config load, so a steady-state
-        # pass never bumps rv — and since the fast path, a fingerprint-
-        # clean pass skips the CR sink WITHOUT even a GET, so this
-        # stream undercounts passes by the daemon's own
+        # Generation = count of CR GETs + PATCHes, not resourceVersion:
+        # the timestamp label is constant per config load, so a
+        # steady-state pass never bumps rv — and since the fast path, a
+        # fingerprint-clean pass skips the CR sink WITHOUT even a GET,
+        # so this stream undercounts passes by the daemon's own
         # tfd_sink_writes_skipped_total{sink=cr} (the crosscheck below
-        # adds the two). Counting GETs only keeps a GET+PUT label-change
-        # pass from registering as two generations (advisor r5).
+        # adds the two). A dirty pass under the diff sink is ONE
+        # zero-GET PATCH, so patches count as generations too; GETs
+        # cover the first pass and the anti-entropy reconciles. (An
+        # anti-entropy reconcile that also finds a diff is GET+PATCH in
+        # one pass — rare enough to live inside the crosscheck slack.)
         gen = sum(1 for method, path in list(self.server.requests)
-                  if method == "GET" and self.NODE in path)
+                  if method in ("GET", "PATCH") and self.NODE in path)
         return gen, stable_digest(text)
 
     def labels(self):
@@ -1013,12 +1016,14 @@ def main(argv=None):
                 snapshot_tiers = {source: sched_lib.tier_of(age, policy)
                                   for source, age in sorted(ages.items())}
             # CR cross-check (cr sink + scraping): every pass must be
-            # accounted for server-side as a GET — or explained by the
-            # daemon's own skip counter: a fingerprint-clean fast pass
-            # no-ops the CR sink WITHOUT a GET, which is the point of
-            # the sub-millisecond steady state (a 50k-node fleet must
-            # not hammer the apiserver with no-op reads). GETs + skips
-            # must agree with the pass count, within an edge pass.
+            # accounted for server-side as a GET (first pass,
+            # anti-entropy reconcile) or a zero-GET diff PATCH — or
+            # explained by the daemon's own skip counter: a
+            # fingerprint-clean fast pass no-ops the CR sink WITHOUT a
+            # request, which is the point of the sub-millisecond steady
+            # state (a 50k-node fleet must not hammer the apiserver
+            # with no-op reads). Requests + skips must agree with the
+            # pass count, within an edge pass.
             crosscheck_ok = None
             if args.sink == "cr" and gen_source == "metrics":
                 observed = sink.observe()

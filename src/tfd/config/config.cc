@@ -410,6 +410,49 @@ std::vector<FlagDef> MakeFlagDefs(Flags* f) {
                   [f](const std::string& v) {
                     return SetDuration(&f->sink_request_deadline_s, v);
                   }});
+  defs.push_back({"sink-patch",
+                  {"TFD_SINK_PATCH"},
+                  "sinkPatch",
+                  "write NodeFeature CR changes as a resourceVersion-"
+                  "preconditioned JSON merge patch of only the changed "
+                  "keys (zero GETs in steady state); false forces the "
+                  "full GET+PUT update path on every write",
+                  true,
+                  [f](const std::string& v) {
+                    return SetBool(&f->sink_patch, v);
+                  }});
+  defs.push_back({"cadence-jitter-pct",
+                  {"TFD_CADENCE_JITTER_PCT"},
+                  "cadenceJitterPct",
+                  "fleet desync: percent amplitude of the deterministic "
+                  "hash-of-nodename per-tick jitter and anti-entropy "
+                  "refresh spread; any value > 0 also enables the "
+                  "one-time full-interval rollout phase offset, so a "
+                  "DaemonSet rollout's daemons don't all hit the "
+                  "apiserver in the same second forever (0 disables, "
+                  "max 50)",
+                  false,
+                  [f](const std::string& v) {
+                    int parsed = 0;
+                    if (!ParseNonNegInt(TrimSpace(v), &parsed)) {
+                      return Status::Error("cadence-jitter-pct must be a "
+                                           "non-negative integer");
+                    }
+                    f->cadence_jitter_pct = parsed;
+                    return Status::Ok();
+                  }});
+  defs.push_back({"sink-refresh",
+                  {"TFD_SINK_REFRESH"},
+                  "sinkRefresh",
+                  "anti-entropy base period: a clean steady state still "
+                  "performs a real, fully-reconciling sink write this "
+                  "often (heals external CR deletes/edits; doubles as the "
+                  "sink liveness probe). e.g. 90s; 0 = auto "
+                  "(max(60s, 2.5x sleep-interval))",
+                  false,
+                  [f](const std::string& v) {
+                    return SetDuration(&f->sink_refresh_s, v);
+                  }});
   defs.push_back({"fault-spec",
                   {"TFD_FAULT_SPEC"},
                   "faultSpec",
@@ -773,6 +816,13 @@ Result<LoadResult> Load(int argc, char** argv) {
   if (f->sink_request_deadline_s < 0) {
     return Result<LoadResult>::Error("sink-request-deadline must be >= 0s");
   }
+  if (f->cadence_jitter_pct < 0 || f->cadence_jitter_pct > 50) {
+    return Result<LoadResult>::Error(
+        "cadence-jitter-pct must be between 0 and 50");
+  }
+  if (f->sink_refresh_s < 0) {
+    return Result<LoadResult>::Error("sink-refresh must be >= 0s");
+  }
   if (!f->fault_spec.empty()) {
     Status s = fault::Validate(f->fault_spec);
     if (!s.ok()) {
@@ -843,6 +893,9 @@ std::string ToJson(const Config& config) {
       << ",\"sinkBreakerFailures\":" << f.sink_breaker_failures
       << ",\"sinkBreakerCooldown\":\"" << f.sink_breaker_cooldown_s << "s\""
       << ",\"sinkRequestDeadline\":\"" << f.sink_request_deadline_s << "s\""
+      << ",\"sinkPatch\":" << (f.sink_patch ? "true" : "false")
+      << ",\"cadenceJitterPct\":" << f.cadence_jitter_pct
+      << ",\"sinkRefresh\":\"" << f.sink_refresh_s << "s\""
       << ",\"faultSpec\":" << jstr(f.fault_spec)
       << "},\"sharing\":[";
   for (size_t i = 0; i < config.sharing.time_slicing.size(); i++) {

@@ -176,6 +176,28 @@ struct Flags {
   // this bounds their sum, so a dribbling apiserver cannot stretch a
   // sink write past the rewrite cadence. 0 disables.
   int sink_request_deadline_s = 10;
+  // Diff sink (k8s/client.h): write NodeFeature CR changes as a JSON
+  // merge patch of only the changed/removed spec.labels keys,
+  // resourceVersion-preconditioned with a zero-GET steady path. Off
+  // forces the reference GET->mutate->PUT flow on every write (the
+  // client also falls back by itself when the server answers 415/405).
+  bool sink_patch = true;
+  // Fleet cadence desynchronization (k8s/desync.h): percent amplitude
+  // of the deterministic hash-of-nodename per-tick jitter and the
+  // anti-entropy refresh-period spread. Any value > 0 ALSO enables the
+  // one-time rollout phase offset, which is always up to a full
+  // interval (spreading the fleet across the whole interval is its
+  // point; it does not scale with the percentage). 0 disables all of
+  // it — every daemon then ticks and refreshes on the same clock,
+  // which at fleet scale delivers the whole cluster's sink load into
+  // the same one-second apiserver bucket.
+  int cadence_jitter_pct = 10;
+  // Anti-entropy base period: how often a clean steady state still
+  // performs a REAL sink write (full reconcile for the CR sink — heals
+  // external deletes/edits and doubles as the sink liveness probe).
+  // 0 = auto: max(60s, 2.5x sleep-interval). Per-node desync stretches
+  // the effective period by up to cadence-jitter-pct.
+  int sink_refresh_s = 0;
   // Fault injection (fault/fault.h): named-point spec, e.g.
   // "sink.file:errno=ENOSPC:rate=0.3,k8s.put:http=500:count=3".
   // TEST-ONLY — an armed daemon fails on purpose; empty (default)

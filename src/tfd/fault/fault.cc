@@ -198,10 +198,11 @@ Result<Rule> ParseEntry(const std::string& entry, unsigned* seed_out) {
   // never pass on no-op injections.
   if (rule.action.kind == Action::Kind::kHttp &&
       rule.point != "k8s.get" && rule.point != "k8s.put" &&
-      rule.point != "k8s.post") {
+      rule.point != "k8s.post" && rule.point != "k8s.patch") {
     return Result<Rule>::Error(
         "fault entry '" + entry +
-        "': http= is only meaningful at k8s.get/k8s.put/k8s.post");
+        "': http= is only meaningful at k8s.get/k8s.put/k8s.post/"
+        "k8s.patch");
   }
   if (rule.action.kind == Action::Kind::kTorn &&
       rule.point != "state.write") {

@@ -77,6 +77,9 @@ void CircuitBreaker::TransitionLocked(State to, const std::string& reason) {
 bool CircuitBreaker::Allow() {
   std::lock_guard<std::mutex> lock(mu_);
   StateGauge()->Set(StateGaugeValue(state_));  // registered even if quiet
+  // A server-directed pause outranks every state: the apiserver said
+  // when to come back, and probing earlier just feeds the 429 storm.
+  if (std::chrono::steady_clock::now() < defer_until_) return false;
   switch (state_) {
     case State::kClosed:
       return true;
@@ -130,6 +133,40 @@ void CircuitBreaker::RecordTransientFailure() {
   }
 }
 
+void CircuitBreaker::Defer(double seconds, const std::string& reason) {
+  if (seconds <= 0) return;
+  std::lock_guard<std::mutex> lock(mu_);
+  // A deferred write settles the in-flight half-open probe without a
+  // verdict: release the slot so the NEXT Allow() after the pause can
+  // probe again (a held slot would wedge Allow() at false forever).
+  half_open_probe_in_flight_ = false;
+  auto until = std::chrono::steady_clock::now() +
+               std::chrono::duration_cast<
+                   std::chrono::steady_clock::duration>(
+                   std::chrono::duration<double>(seconds));
+  if (until <= defer_until_) return;  // deadlines only extend
+  defer_until_ = until;
+  obs::Default()
+      .GetCounter("tfd_sink_deferrals_total",
+                  "Server-directed sink write pauses (429/503 "
+                  "Retry-After honored by the adaptive backoff).")
+      ->Inc();
+  obs::DefaultJournal().Record(
+      "breaker-defer", "cr",
+      "sink writes deferred " +
+          std::to_string(static_cast<long long>(seconds)) + "s: " + reason,
+      {{"seconds", std::to_string(static_cast<long long>(seconds))},
+       {"reason", reason}});
+  TFD_LOG_WARNING << "NodeFeature sink deferring writes "
+                  << static_cast<long long>(seconds) << "s (" << reason
+                  << ")";
+}
+
+bool CircuitBreaker::deferred() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return std::chrono::steady_clock::now() < defer_until_;
+}
+
 CircuitBreaker::State CircuitBreaker::state() const {
   std::lock_guard<std::mutex> lock(mu_);
   return state_;
@@ -142,9 +179,11 @@ int CircuitBreaker::consecutive_failures() const {
 
 void CircuitBreaker::AgeForTest(double seconds) {
   std::lock_guard<std::mutex> lock(mu_);
-  open_until_ -= std::chrono::duration_cast<
+  auto delta = std::chrono::duration_cast<
       std::chrono::steady_clock::duration>(
       std::chrono::duration<double>(seconds));
+  open_until_ -= delta;
+  defer_until_ -= delta;
 }
 
 }  // namespace k8s

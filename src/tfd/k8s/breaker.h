@@ -56,6 +56,14 @@ class CircuitBreaker {
 
   void RecordSuccess();
   void RecordTransientFailure();
+  // Server-directed pause (APF/429 Retry-After): Allow() returns false
+  // until `seconds` from now, in EVERY state — the server named its own
+  // recovery time, so even a closed circuit honors it instead of burning
+  // the consecutive-failure budget against a throttling apiserver. Does
+  // not change the breaker state machine; a longer existing deferral is
+  // kept (deadlines only extend). Journaled as "breaker-defer" and
+  // counted in tfd_sink_deferrals_total.
+  void Defer(double seconds, const std::string& reason);
   // Permanent failures (RBAC, schema) mean the endpoint ANSWERED — the
   // breaker is the wrong tool, so the circuit closes and the streak
   // resets. Critically this also releases a half-open probe slot; the
@@ -66,6 +74,8 @@ class CircuitBreaker {
 
   State state() const;
   int consecutive_failures() const;
+  // True while a Defer() deadline is pending (test/introspection hook).
+  bool deferred() const;
 
   static const char* StateName(State state);
 
@@ -82,6 +92,7 @@ class CircuitBreaker {
   int consecutive_failures_ = 0;
   bool half_open_probe_in_flight_ = false;
   std::chrono::steady_clock::time_point open_until_{};
+  std::chrono::steady_clock::time_point defer_until_{};
 };
 
 }  // namespace k8s

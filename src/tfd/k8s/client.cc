@@ -3,9 +3,11 @@
 #include <string.h>
 
 #include <cstdlib>
+#include <vector>
 
 #include "tfd/fault/fault.h"
 #include "tfd/obs/journal.h"
+#include "tfd/obs/metrics.h"
 #include "tfd/util/file.h"
 #include "tfd/util/http.h"
 #include "tfd/util/jsonlite.h"
@@ -21,6 +23,7 @@ constexpr char kDefaultSaDir[] =
     "/var/run/secrets/kubernetes.io/serviceaccount";
 constexpr char kNfdGroup[] = "nfd.k8s-sigs.io";
 constexpr char kNfdVersion[] = "v1alpha1";
+constexpr char kNodeNameLabel[] = "nfd.node.kubernetes.io/node-name";
 
 std::string SaDir() {
   if (const char* dir = std::getenv("TFD_SERVICEACCOUNT_DIR")) return dir;
@@ -55,9 +58,9 @@ http::RequestOptions BaseOptions(const ClusterConfig& config) {
 // fires for every method (transport-level faults: a hang has already
 // slept inside Check — the delay is the fault — while errno/fail become
 // the transport error the caller's transient classification sees);
-// `method_point` (k8s.get / k8s.put / k8s.post) fires per verb, with
-// `http=` fabricating a response of that status without touching the
-// network. Disarmed cost: two relaxed atomic loads.
+// `method_point` (k8s.get / k8s.put / k8s.post / k8s.patch) fires per
+// verb, with `http=` fabricating a response of that status without
+// touching the network. Disarmed cost: two relaxed atomic loads.
 Result<http::Response> SinkRequest(const char* method_point,
                                    const std::string& method,
                                    const std::string& url,
@@ -94,20 +97,181 @@ Result<http::Response> SinkRequest(const char* method_point,
   return http::Request(method, url, body, options);
 }
 
+// Bounded status_class for the per-request counter: 429 gets its own
+// bucket (it drives the adaptive backoff and is the number an APF
+// triage starts from).
+const char* StatusClassOf(int status) {
+  if (status == 429) return "429";
+  if (status >= 500) return "5xx";
+  if (status >= 400) return "4xx";
+  if (status >= 300) return "3xx";
+  if (status >= 200) return "2xx";
+  return "error";
+}
+
+std::vector<double> PatchByteBuckets() {
+  return {64, 256, 1024, 4096, 16384, 65536};
+}
+
+// SinkRequest plus the wire observability: every apiserver request is
+// counted by verb and status class, patch bodies sized, and 429/503
+// pacing hints (Retry-After, the APF attribution headers) captured into
+// `outcome` and journaled — the flight-recorder record an APF triage
+// reads first.
+Result<http::Response> CountedRequest(const char* method_point,
+                                      const std::string& method,
+                                      const std::string& url,
+                                      const std::string& body,
+                                      const http::RequestOptions& options,
+                                      WriteOutcome* outcome) {
+  Result<http::Response> response =
+      SinkRequest(method_point, method, url, body, options);
+  obs::Default()
+      .GetCounter("tfd_sink_requests_total",
+                  "Apiserver requests issued by the NodeFeature CR sink, "
+                  "by verb and status class (429 bucketed separately; "
+                  "'error' = transport failure).",
+                  {{"verb", method},
+                   {"status_class",
+                    response.ok() ? StatusClassOf(response->status)
+                                  : "error"}})
+      ->Inc();
+  if (method == "GET") outcome->gets++;
+  if (method == "POST") outcome->posts++;
+  if (method == "PUT") outcome->puts++;
+  if (method == "PATCH") {
+    outcome->patches++;
+    outcome->patch_bytes += body.size();
+    obs::Default()
+        .GetHistogram("tfd_sink_patch_bytes",
+                      "Size of JSON merge-patch bodies sent to the "
+                      "NodeFeature CR sink.",
+                      PatchByteBuckets())
+        ->Observe(static_cast<double>(body.size()));
+  }
+  if (response.ok() &&
+      (response->status == 429 || response->status == 503)) {
+    double retry_after = response->RetryAfterSeconds();
+    bool apf =
+        response->headers.count("x-kubernetes-pf-flowschema-uid") > 0 ||
+        response->headers.count("x-kubernetes-pf-prioritylevel-uid") > 0;
+    if (retry_after > outcome->retry_after_s) {
+      outcome->retry_after_s = retry_after;
+    }
+    outcome->apf_rejected = outcome->apf_rejected || apf;
+    obs::DefaultJournal().Record(
+        "sink-throttled", "cr",
+        "apiserver throttled " + method + " (HTTP " +
+            std::to_string(response->status) + ")" +
+            (retry_after > 0
+                 ? ", Retry-After " +
+                       std::to_string(static_cast<long long>(retry_after)) +
+                       "s"
+                 : "") +
+            (apf ? ", APF priority-level rejection" : ""),
+        {{"verb", method},
+         {"status", std::to_string(response->status)},
+         {"retry_after_s",
+          std::to_string(static_cast<long long>(retry_after))},
+         {"apf", apf ? "true" : "false"}});
+  }
+  return response;
+}
+
 // The create body. spec.labels values become node labels via the NFD
 // master; the nfd node-name label tells NFD which node this CR describes.
-// (Updates serialize the mutated fetched CR instead.)
+// (Updates patch or serialize the mutated fetched CR instead.)
 std::string CrBody(const ClusterConfig& config, const lm::Labels& labels) {
   return std::string("{\"apiVersion\":\"") + kNfdGroup + "/" + kNfdVersion +
          "\",\"kind\":\"NodeFeature\"," + "\"metadata\":{\"name\":" +
          jsonlite::Quote(CrName(config.node_name)) +
          ",\"namespace\":" + jsonlite::Quote(config.namespace_) +
-         ",\"labels\":{\"nfd.node.kubernetes.io/node-name\":" +
+         ",\"labels\":{\"" + kNodeNameLabel + "\":" +
          jsonlite::Quote(config.node_name) + "}},\"spec\":{\"labels\":" +
          jsonlite::SerializeStringMap(labels) + "}}";
 }
 
+// metadata.resourceVersion of a parsed CR ("" when absent).
+std::string ExtractResourceVersion(const jsonlite::Value& cr) {
+  jsonlite::ValuePtr rv = cr.GetPath("metadata.resourceVersion");
+  if (rv && rv->kind == jsonlite::Value::Kind::kString) {
+    return rv->string_value;
+  }
+  return "";
+}
+
+// spec.labels of a parsed CR as a string map (non-string values and a
+// missing/mistyped spec.labels read as absent keys — the diff then
+// rewrites them, which is the correct heal).
+lm::Labels ExtractSpecLabels(const jsonlite::Value& cr) {
+  lm::Labels out;
+  jsonlite::ValuePtr labels = cr.GetPath("spec.labels");
+  if (!labels || labels->kind != jsonlite::Value::Kind::kObject) return out;
+  for (const auto& [k, v] : labels->object_items) {
+    if (v->kind == jsonlite::Value::Kind::kString) {
+      out[k] = v->string_value;
+    }
+  }
+  return out;
+}
+
+// Whether the CR carries the node-name metadata label the NFD master
+// attributes it by. A CR missing it can never label the node, so the
+// no-op and diff paths must both treat it as dirty.
+bool NodeNameLabelOk(const jsonlite::Value& cr,
+                     const std::string& node_name) {
+  jsonlite::ValuePtr meta_labels = cr.GetPath("metadata.labels");
+  jsonlite::ValuePtr v =
+      meta_labels ? meta_labels->Get(kNodeNameLabel) : nullptr;
+  return v && v->kind == jsonlite::Value::Kind::kString &&
+         v->string_value == node_name;
+}
+
 }  // namespace
+
+std::string BuildMergePatch(const lm::Labels& acked,
+                            const lm::Labels& desired,
+                            const std::string& node_name,
+                            bool fix_node_name,
+                            const std::string& resource_version) {
+  std::string spec;
+  auto add = [&spec](const std::string& key, const std::string* value) {
+    if (!spec.empty()) spec += ",";
+    spec += jsonlite::Quote(key) + ":";
+    spec += value != nullptr ? jsonlite::Quote(*value) : "null";
+  };
+  for (const auto& [key, value] : desired) {
+    auto it = acked.find(key);
+    if (it == acked.end() || it->second != value) add(key, &value);
+  }
+  for (const auto& [key, value] : acked) {
+    (void)value;
+    if (desired.count(key) == 0) add(key, nullptr);  // merge-patch delete
+  }
+  if (spec.empty() && !fix_node_name) return "";
+
+  std::string meta;
+  if (!resource_version.empty()) {
+    // Optimistic-concurrency precondition: the apiserver answers 409
+    // when the CR moved past this version, instead of silently applying
+    // the patch over another writer's state.
+    meta += "\"resourceVersion\":" + jsonlite::Quote(resource_version);
+  }
+  if (fix_node_name) {
+    if (!meta.empty()) meta += ",";
+    meta += std::string("\"labels\":{\"") + kNodeNameLabel +
+            "\":" + jsonlite::Quote(node_name) + "}";
+  }
+  std::string out = "{";
+  if (!meta.empty()) out += "\"metadata\":{" + meta + "},";
+  out += "\"spec\":{\"labels\":{" + spec + "}}}";
+  return out;
+}
+
+SinkState& DefaultSinkState() {
+  static SinkState* state = new SinkState();
+  return *state;
+}
 
 Result<ClusterConfig> LoadInClusterConfig() {
   ClusterConfig config;
@@ -152,7 +316,11 @@ Result<ClusterConfig> LoadInClusterConfig() {
 }
 
 Status UpdateNodeFeature(const ClusterConfig& config,
-                         const lm::Labels& labels, bool* transient) {
+                         const lm::Labels& labels, bool* transient,
+                         SinkState* state, WriteOutcome* outcome) {
+  if (state == nullptr) state = &DefaultSinkState();
+  WriteOutcome local_outcome;
+  if (outcome == nullptr) outcome = &local_outcome;
   // Pessimistic default: failures below that return without passing
   // through Fail() (none today) would read as permanent.
   if (transient != nullptr) *transient = false;
@@ -176,27 +344,134 @@ Status UpdateNodeFeature(const ClusterConfig& config,
   auto StatusTransient = [](int http_status) {
     return http_status == 429 || http_status >= 500;
   };
+  // Learns the server's resourceVersion from a successful write's
+  // response body. A response the parse can't extract one from clears
+  // the cached version: the next patch goes out unconditioned (still
+  // correct merge-patch semantics, just without the 409 fence) and the
+  // next GET re-learns it.
+  auto LearnAck = [state, &labels](const std::string& body) {
+    state->known = true;
+    state->acked = labels;
+    state->resource_version.clear();
+    if (Result<jsonlite::ValuePtr> parsed = jsonlite::Parse(body);
+        parsed.ok()) {
+      state->resource_version = ExtractResourceVersion(**parsed);
+    }
+  };
 
   http::RequestOptions options = BaseOptions(config);
   http::RequestOptions write = options;
   write.headers["Content-Type"] = "application/json";
+  http::RequestOptions patch_write = options;
+  patch_write.headers["Content-Type"] = "application/merge-patch+json";
 
-  // Get → create-if-missing → update-if-changed (labels.go:152-183).
+  // Diff-patch first (zero GETs while the cached state holds), GET →
+  // create-if-missing → patch/update-if-changed otherwise (the
+  // reference flow, labels.go:152-183, upgraded to send a diff).
   // Writes race other controllers (NFD master, a restarted twin): a 409
   // conflict re-GETs and retries rather than failing the pass.
   constexpr int kMaxAttempts = 3;
   std::string last_error;
   for (int attempt = 0; attempt < kMaxAttempts; attempt++) {
-    Result<http::Response> existing =
-        SinkRequest("k8s.get", "GET", CrUrl(config, true), "", options);
+    // Recomputed per attempt: a 415 in THIS call flips the flag and the
+    // retry must already take the GET->PUT road.
+    const bool patching = config.use_patch && !state->patch_unsupported;
+    // Shared PATCH send + response handling for both the zero-GET and
+    // the freshly-fetched diff. Returns true when the write settled
+    // (result in *settled); false to retry the attempt loop.
+    Status settled;
+    bool done = false;
+    auto TryPatch = [&](const std::string& patch_body,
+                        bool zero_get) -> bool {
+      Result<http::Response> patched =
+          CountedRequest("k8s.patch", "PATCH", CrUrl(config, true),
+                         patch_body, patch_write, outcome);
+      if (!patched.ok()) {
+        settled = Fail(true, "patching NodeFeature CR: " + patched.error());
+        return true;
+      }
+      if (patched->status == 200) {
+        LearnAck(patched->body);
+        TFD_LOG_INFO << "patched NodeFeature CR " << CrName(config.node_name)
+                     << " (" << patch_body.size() << " bytes"
+                     << (zero_get ? ", no GET" : "") << ")";
+        RecordSink("patched NodeFeature CR " + CrName(config.node_name) +
+                       " (" + std::to_string(patch_body.size()) + " bytes)",
+                   "patch", /*ok=*/true);
+        settled = Status::Ok();
+        return true;
+      }
+      if (patched->status == 404) {
+        // The CR vanished under us (deleted externally): forget it and
+        // fall back to the create path on the next attempt.
+        state->Invalidate();
+        last_error = "CR missing on patch";
+        RecordSink("NodeFeature CR vanished under patch; re-creating",
+                   "patch-miss", /*ok=*/false, last_error);
+        return false;
+      }
+      if (patched->status == 409) {
+        // Stale resourceVersion: another writer moved the CR. Forget
+        // the cached state so the retry re-GETs the truth (ONE extra
+        // GET) and re-diffs against it.
+        state->Invalidate();
+        last_error = "patch conflict: " + patched->body.substr(0, 256);
+        TFD_LOG_WARNING << "NodeFeature CR patch conflict; re-reading";
+        RecordSink("NodeFeature CR patch conflict; retrying",
+                   "conflict-retry", /*ok=*/false, last_error);
+        return false;
+      }
+      if (patched->status == 415 || patched->status == 405) {
+        // Server doesn't speak merge-patch: remember that and fall back
+        // to the reference GET->mutate->PUT path for this process.
+        state->patch_unsupported = true;
+        last_error =
+            "merge-patch unsupported (HTTP " +
+            std::to_string(patched->status) + ")";
+        RecordSink("apiserver rejects merge-patch; falling back to full "
+                   "updates",
+                   "patch-unsupported", /*ok=*/false, last_error);
+        return false;
+      }
+      settled = Fail(StatusTransient(patched->status),
+                     "patching NodeFeature CR: HTTP " +
+                         std::to_string(patched->status) + ": " +
+                         patched->body.substr(0, 512));
+      return true;
+    };
+
+    // ---- Zero-GET diff path: the cached state says what the server
+    // holds, so a dirty pass is ONE PATCH of the changed keys. An
+    // EMPTY diff does not short-circuit locally: callers skip clean
+    // passes upstream (fingerprint fast path, byte-compare), so a
+    // write request whose diff is empty is a forced-slow/chaos/
+    // post-reload pass that owes a REAL server interaction — it falls
+    // through to the GET below (semantic-equality no-op), which is
+    // also what lets a dead apiserver fail the pass and feed the
+    // breaker instead of being invisibly "healed" by a local no-op.
+    if (state->known && patching) {
+      std::string patch =
+          BuildMergePatch(state->acked, labels, config.node_name,
+                          /*fix_node_name=*/false, state->resource_version);
+      if (!patch.empty()) {
+        done = TryPatch(patch, /*zero_get=*/true);
+        if (done) return settled;
+        continue;
+      }
+    }
+
+    // ---- GET path: no cached state (first write, anti-entropy
+    // reconcile, post-conflict), or patch unsupported/disabled.
+    Result<http::Response> existing = CountedRequest(
+        "k8s.get", "GET", CrUrl(config, true), "", options, outcome);
     if (!existing.ok()) {
       return Fail(true, "getting NodeFeature CR: " + existing.error());
     }
 
     if (existing->status == 404) {
-      Result<http::Response> created = SinkRequest(
+      Result<http::Response> created = CountedRequest(
           "k8s.post", "POST", CrUrl(config, false), CrBody(config, labels),
-          write);
+          write, outcome);
       if (!created.ok()) {
         return Fail(true, "creating NodeFeature CR: " + created.error());
       }
@@ -212,6 +487,7 @@ Status UpdateNodeFeature(const ClusterConfig& config,
                         std::to_string(created->status) + ": " +
                         created->body.substr(0, 512));
       }
+      LearnAck(created->body);
       TFD_LOG_INFO << "created NodeFeature CR " << CrName(config.node_name);
       RecordSink("created NodeFeature CR " + CrName(config.node_name),
                  "create", /*ok=*/true);
@@ -229,41 +505,60 @@ Status UpdateNodeFeature(const ClusterConfig& config,
       return Fail(false, "parsing NodeFeature CR: " + parsed.error());
     }
     jsonlite::Value& cr = **parsed;
+    std::string resource_version = ExtractResourceVersion(cr);
+    lm::Labels current = ExtractSpecLabels(cr);
+    bool node_name_ok = NodeNameLabelOk(cr, config.node_name);
 
     // Semantic-equality check to skip no-op updates (labels.go:170-176).
     // The reference DeepEquals the whole mutated object, so the skip must
     // also require the node-name metadata label to already be correct —
     // a CR missing it could never be attributed to this node by the NFD
-    // master, and skipping here would leave it broken forever.
-    jsonlite::ValuePtr current = cr.GetPath("spec.labels");
-    jsonlite::ValuePtr current_meta = cr.GetPath("metadata.labels");
-    jsonlite::ValuePtr node_name_label =
-        current_meta ? current_meta->Get("nfd.node.kubernetes.io/node-name")
-                     : nullptr;
-    if (current && current->kind == jsonlite::Value::Kind::kObject &&
-        current->object_items.size() == labels.size() && node_name_label &&
-        node_name_label->kind == jsonlite::Value::Kind::kString &&
-        node_name_label->string_value == config.node_name) {
-      bool equal = true;
-      for (const auto& [k, v] : current->object_items) {
-        auto it = labels.find(k);
-        if (it == labels.end() ||
-            v->kind != jsonlite::Value::Kind::kString ||
-            v->string_value != it->second) {
-          equal = false;
-          break;
-        }
-      }
-      if (equal) {
-        RecordSink("NodeFeature CR already current (no-op update skipped)",
-                "noop", /*ok=*/true);
-        return Status::Ok();
-      }
+    // master, and skipping here would leave it broken forever. Non-string
+    // spec.labels values read as absent from `current`, so a CR carrying
+    // one can never compare equal and gets rewritten.
+    jsonlite::ValuePtr raw_labels = cr.GetPath("spec.labels");
+    size_t raw_label_count =
+        raw_labels && raw_labels->kind == jsonlite::Value::Kind::kObject
+            ? raw_labels->object_items.size()
+            : 0;
+    if (node_name_ok && current == labels &&
+        raw_label_count == current.size()) {
+      state->known = true;
+      state->acked = current;
+      state->resource_version = resource_version;
+      RecordSink("NodeFeature CR already current (no-op update skipped)",
+                 "noop", /*ok=*/true);
+      return Status::Ok();
     }
 
+    if (patching) {
+      // Diff against the server's ACTUAL content — this is also what
+      // heals foreign edits during an anti-entropy reconcile.
+      std::string patch =
+          BuildMergePatch(current, labels, config.node_name,
+                          /*fix_node_name=*/!node_name_ok,
+                          resource_version);
+      if (!patch.empty()) {
+        done = TryPatch(patch, /*zero_get=*/false);
+        if (done) return settled;
+        continue;
+      }
+      // An EMPTY diff here means the no-op check failed for a reason
+      // the string-map diff cannot express — a foreign NON-STRING
+      // spec.labels value (raw_label_count mismatch). A merge patch
+      // built from the string view would leave it in place forever;
+      // the full-update path below replaces spec.labels wholesale,
+      // exactly like the reference — fall through to it.
+    }
+
+    // ---- Full-update fallback (use_patch off, server can't PATCH, or
+    // a non-string foreign spec.labels value only a wholesale replace
+    // can heal).
     // Mutate the fetched object (as the reference does via client-go,
     // labels.go:165-183) so metadata other controllers own — annotations,
     // ownerReferences, finalizers, foreign labels — survives the PUT.
+    // The fetched object carries its resourceVersion, so the PUT is
+    // precondition-checked the same way the patch is.
     jsonlite::ValuePtr metadata = cr.Get("metadata");
     if (!metadata) {
       metadata = std::make_shared<jsonlite::Value>();
@@ -276,7 +571,7 @@ Status UpdateNodeFeature(const ClusterConfig& config,
       meta_labels->kind = jsonlite::Value::Kind::kObject;
       metadata->Set("labels", meta_labels);
     }
-    meta_labels->Set("nfd.node.kubernetes.io/node-name",
+    meta_labels->Set(kNodeNameLabel,
                      jsonlite::MakeString(config.node_name));
     jsonlite::ValuePtr spec = cr.Get("spec");
     if (!spec || spec->kind != jsonlite::Value::Kind::kObject) {
@@ -286,9 +581,9 @@ Status UpdateNodeFeature(const ClusterConfig& config,
     }
     spec->Set("labels", jsonlite::FromStringMap(labels));
 
-    Result<http::Response> updated = SinkRequest(
+    Result<http::Response> updated = CountedRequest(
         "k8s.put", "PUT", CrUrl(config, true), jsonlite::Serialize(cr),
-        write);
+        write, outcome);
     if (!updated.ok()) {
       return Fail(true, "updating NodeFeature CR: " + updated.error());
     }
@@ -305,11 +600,16 @@ Status UpdateNodeFeature(const ClusterConfig& config,
                       std::to_string(updated->status) + ": " +
                       updated->body.substr(0, 512));
     }
+    LearnAck(updated->body);
     TFD_LOG_INFO << "updated NodeFeature CR " << CrName(config.node_name);
     RecordSink("updated NodeFeature CR " + CrName(config.node_name),
                "update", /*ok=*/true);
     return Status::Ok();
   }
+  // Conflict-retry exhaustion: every attempt lost its race. Transient by
+  // definition — the CR exists and other writers are active, so the next
+  // pass can win — and `last_error` carries the final conflict so the
+  // journal and the breaker see what was actually lost.
   return Fail(true, "updating NodeFeature CR: " +
                         std::to_string(kMaxAttempts) +
                         " attempts exhausted (" + last_error + ")");

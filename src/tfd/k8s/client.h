@@ -31,21 +31,95 @@ struct ClusterConfig {
   // write. 0 = per-op timeouts only. The daemon wires
   // --sink-request-deadline here.
   int request_deadline_ms = 0;
+  // Diff writes via JSON merge patch (--sink-patch). Off forces the
+  // reference GET->mutate->PUT path on every write.
+  bool use_patch = true;
 };
 
 // Loads in-cluster config (reference k8s-client.go:30-66). Errors when
 // NODE_NAME or the API server location is missing.
 Result<ClusterConfig> LoadInClusterConfig();
 
+// What the sink last acknowledged, carried across passes (the daemon
+// keeps one above the reload loop; tests pass their own). This is what
+// turns the fleet-hostile GET+full-PUT-per-write into a diff sink: with
+// `known`, a dirty pass sends ONE JSON-merge-patch of the changed keys,
+// preconditioned on `resource_version` — zero GETs unless the server
+// answers 409 (another writer moved the CR) or the caller invalidated
+// the state (anti-entropy reconcile).
+struct SinkState {
+  bool known = false;  // resource_version + acked describe the live CR
+  // The server rejected application/merge-patch+json (415/405): fall
+  // back to the reference GET->mutate->PUT path for the rest of this
+  // process (re-probed on restart — apiservers don't usually regress).
+  bool patch_unsupported = false;
+  std::string resource_version;  // last-known metadata.resourceVersion
+  lm::Labels acked;              // spec.labels the server last ack'd
+
+  // Forgets the CR (anti-entropy reconcile, reload): the next write
+  // re-GETs, diffs against the server's ACTUAL content — healing
+  // foreign edits a blind patch would never notice — and re-learns the
+  // resourceVersion. patch_unsupported is deliberately kept.
+  void Invalidate() {
+    known = false;
+    resource_version.clear();
+    acked.clear();
+  }
+};
+
+// Per-call wire observability: what went over the network and what the
+// server said about pacing. Counters only ever increase within a call.
+struct WriteOutcome {
+  int gets = 0;
+  int posts = 0;
+  int puts = 0;
+  int patches = 0;
+  size_t patch_bytes = 0;   // serialized merge-patch bodies
+  // Largest Retry-After the server attached to a 429/503 — the adaptive
+  // backoff's input (0 = server named no pause).
+  double retry_after_s = 0;
+  // An X-Kubernetes-PF-* header rode on a rejection: API Priority &
+  // Fairness throttled this flow, not a generic overload.
+  bool apf_rejected = false;
+};
+
 // Creates or updates the NodeFeature CR "tfd-features-for-<node>" carrying
 // `labels` (reference labels.go:141-184; CR name pattern labels.go:38).
+//
+// With a known `state` (and `use_patch`) the write is a JSON merge patch
+// of only the changed/removed spec.labels keys, resourceVersion-
+// preconditioned; 409 re-GETs and retries, 404 falls back to create,
+// 415/405 falls back to the full GET->mutate->PUT path. With no state
+// (first write, anti-entropy) it GETs once, no-ops on semantic equality,
+// and patches the diff against the server's actual content.
+//
 // On failure, `*transient` (if non-null) reports whether retrying later
 // can plausibly succeed without operator action: transport errors,
 // conflict-retry exhaustion, 429 and 5xx are transient; auth/schema
-// failures (other 4xx) and malformed responses are not.
+// failures (other 4xx) and malformed responses are not. `state` null
+// uses a process-wide default (DefaultSinkState); `outcome` null skips
+// the per-call reporting (metrics still fire).
 Status UpdateNodeFeature(const ClusterConfig& config,
                          const lm::Labels& labels,
-                         bool* transient = nullptr);
+                         bool* transient = nullptr,
+                         SinkState* state = nullptr,
+                         WriteOutcome* outcome = nullptr);
+
+// The daemon's sink state (rewrite-loop-only, like the other Default()
+// singletons). Tests that want isolation pass their own SinkState.
+SinkState& DefaultSinkState();
+
+// Builds the JSON merge patch that turns `acked` into `desired`:
+// changed/added keys verbatim, removed keys null, under spec.labels —
+// plus the nfd node-name metadata label when `fix_node_name` (the GET
+// path saw it missing/wrong) and the resourceVersion precondition when
+// `resource_version` is non-empty. Returns "" when there is nothing to
+// patch. Exposed for the unit tests and the Python twin's parity pins.
+std::string BuildMergePatch(const lm::Labels& acked,
+                            const lm::Labels& desired,
+                            const std::string& node_name,
+                            bool fix_node_name,
+                            const std::string& resource_version);
 
 }  // namespace k8s
 }  // namespace tfd

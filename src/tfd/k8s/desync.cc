@@ -1,0 +1,99 @@
+#include "tfd/k8s/desync.h"
+
+#include "tfd/sched/state.h"
+
+namespace tfd {
+namespace k8s {
+namespace desync {
+
+namespace {
+
+constexpr uint64_t kFnvOffset = 0xcbf29ce484222325ULL;
+constexpr uint64_t kFnvPrime = 0x100000001b3ULL;
+
+uint64_t Mix(uint64_t hash, const unsigned char* data, size_t len) {
+  for (size_t i = 0; i < len; i++) {
+    hash ^= data[i];
+    hash *= kFnvPrime;
+  }
+  return hash;
+}
+
+// Hash -> [0, 1). Raw FNV-1a has NO final avalanche: node names
+// differing only in the last digit move only a handful of output bits,
+// so mapping the raw hash to a unit puts "node-0001".."node-0009" in
+// nearly the same phase slot — exactly the herd this module exists to
+// break. The murmur3 fmix64 finalizer spreads every input bit across
+// the word; the unit then comes from the (exactly double-representable)
+// low 53 bits.
+constexpr uint64_t kMask53 = (1ULL << 53) - 1;
+constexpr double kTwo53 = 9007199254740992.0;  // 2^53
+
+uint64_t Fmix64(uint64_t h) {
+  h ^= h >> 33;
+  h *= 0xff51afd7ed558ccdULL;
+  h ^= h >> 33;
+  h *= 0xc4ceb9fe1a85ec53ULL;
+  h ^= h >> 33;
+  return h;
+}
+
+double Unit(uint64_t hash) {
+  return static_cast<double>(Fmix64(hash) & kMask53) / kTwo53;
+}
+
+}  // namespace
+
+uint64_t Fnv1a64(const std::string& data) {
+  return Mix(kFnvOffset,
+             reinterpret_cast<const unsigned char*>(data.data()),
+             data.size());
+}
+
+double HashUnit(const std::string& key) { return Unit(Fnv1a64(key)); }
+
+double JitterUnit(const std::string& node, uint64_t tick) {
+  unsigned char bytes[8];
+  for (int i = 0; i < 8; i++) {
+    bytes[i] = static_cast<unsigned char>((tick >> (8 * i)) & 0xff);
+  }
+  uint64_t h = Mix(Fnv1a64(node), bytes, sizeof(bytes));
+  return Unit(h) * 2.0 - 1.0;
+}
+
+double JitteredIntervalS(double base_s, const std::string& node,
+                         uint64_t tick, int jitter_pct) {
+  if (jitter_pct <= 0 || base_s <= 0) return base_s;
+  return base_s *
+         (1.0 + jitter_pct / 100.0 * JitterUnit(node, tick));
+}
+
+double PhaseOffsetS(double base_s, const std::string& node,
+                    int jitter_pct) {
+  if (jitter_pct <= 0 || base_s <= 0) return 0;
+  return HashUnit(node) * base_s;
+}
+
+double RefreshPeriodS(double base_s, const std::string& node,
+                      int jitter_pct) {
+  if (jitter_pct <= 0 || base_s <= 0) return base_s;
+  // Distinct hash key: a node's refresh spread must not correlate with
+  // its tick phase, or phase-0 nodes would also all refresh together.
+  double u = HashUnit(node + "/anti-entropy");
+  return base_s * (1.0 + jitter_pct / 100.0 * (2.0 * u - 1.0));
+}
+
+double SpreadRetryAfterS(double retry_after_s, const std::string& node) {
+  if (retry_after_s <= 0) return 0;
+  return retry_after_s * (1.0 + 0.5 * HashUnit(node + "/retry-after"));
+}
+
+std::string NodeKey() {
+  // One source of truth for node identity: the desync key must never
+  // drift from the identity the warm-restart state file is gated on.
+  return sched::NodeIdentity();
+}
+
+}  // namespace desync
+}  // namespace k8s
+}  // namespace tfd

@@ -1,0 +1,75 @@
+// Fleet-wide cadence desynchronization.
+//
+// A DaemonSet rollout starts every tfd daemon within seconds of each
+// other; with a fixed sleep interval and a fixed anti-entropy refresh
+// (max(60s, 2.5x interval)) the whole fleet then ticks — and refreshes —
+// in phase forever, so a 50k-node cluster delivers its entire write load
+// to the apiserver in the same one-second bucket. Every function here is
+// a pure, deterministic hash of the node name (plus a tick counter for
+// the per-tick jitter), so:
+//
+//   - the spread needs no coordination and survives restarts: a node
+//     always lands in the same phase slot;
+//   - the Python twin (tpufd/sink.py) reproduces the exact same numbers,
+//     which is what lets the cluster-in-a-box soak simulate a thousand
+//     daemons' schedules and the parity test pin C++ against Python.
+//
+// The math: u = FNV-1a64(node)/2^64 in [0,1).
+//   phase offset     = u * interval            (first sleep only)
+//   per-tick jitter  = interval * pct/100 * j  (j in [-1,1), per tick)
+//   refresh period   = base * (1 + pct/100 * (2u' - 1))  (u' from a
+//                      distinct key, so tick phase and refresh spread
+//                      are independent)
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+namespace tfd {
+namespace k8s {
+namespace desync {
+
+// FNV-1a 64-bit. Shared constants with the Python twin; do not change
+// without bumping both.
+uint64_t Fnv1a64(const std::string& data);
+
+// Hash mapped to [0, 1).
+double HashUnit(const std::string& key);
+
+// Deterministic per-(node, tick) value in [-1, 1): the node hash
+// re-mixed with the tick's 8 little-endian bytes through another FNV-1a
+// round, so consecutive ticks draw independent-looking jitter without
+// any RNG state to persist.
+double JitterUnit(const std::string& node, uint64_t tick);
+
+// One sleep interval for this node and tick: base * (1 + pct/100 * j).
+// pct <= 0 returns base unchanged (desync disabled).
+double JitteredIntervalS(double base_s, const std::string& node,
+                         uint64_t tick, int jitter_pct);
+
+// One-time phase offset in [0, base): added to the FIRST sleep so a
+// rollout's synchronized start spreads across the whole interval.
+// pct <= 0 returns 0.
+double PhaseOffsetS(double base_s, const std::string& node, int jitter_pct);
+
+// This node's anti-entropy refresh period: base stretched/shrunk by up
+// to pct percent, from a hash key distinct from the tick phase. The
+// spread compounds: two nodes whose refresh periods differ by even 1%
+// drift a full period apart within 100 cycles.
+double RefreshPeriodS(double base_s, const std::string& node,
+                      int jitter_pct);
+
+// Server-directed backoff with a deterministic per-node stretch in
+// [retry_after, retry_after * 1.5): a fleet-wide 429 storm whose every
+// victim honored the same Retry-After verbatim would re-arrive as the
+// same herd one window later.
+double SpreadRetryAfterS(double retry_after_s, const std::string& node);
+
+// The node key the daemon desyncs on: sched::NodeIdentity() (NODE_NAME,
+// else hostname, else "unknown") — shared, so the desync key can never
+// drift from the identity the warm-restart state file is gated on.
+std::string NodeKey();
+
+}  // namespace desync
+}  // namespace k8s
+}  // namespace tfd

@@ -29,6 +29,7 @@
 #include "tfd/healthsm/healthsm.h"
 #include "tfd/k8s/breaker.h"
 #include "tfd/k8s/client.h"
+#include "tfd/k8s/desync.h"
 #include "tfd/lm/fragments.h"
 #include "tfd/lm/governor.h"
 #include "tfd/lm/labels.h"
@@ -2938,6 +2939,516 @@ void TestK8sFaultClassification() {
   unsetenv("TFD_SERVICEACCOUNT_DIR");
 }
 
+// ---- fleet-scale diff sink (k8s/client.cc, k8s/desync.cc) ---------------
+
+// A scripted apiserver: accepts sequential connections (the client sends
+// Connection: close, one request per connection), records every
+// (method, path, body), and answers from a fixed response script. Full
+// control over status/headers/body is what the conflict and Retry-After
+// tests need and fault injection can't fabricate.
+class ScriptedApiServer {
+ public:
+  struct Exchange {
+    std::string method;
+    std::string path;
+    std::string body;
+  };
+  struct Reply {
+    int status = 200;
+    std::string body = "{}";
+    std::string extra_headers;  // raw "K: v\r\n" lines
+  };
+
+  explicit ScriptedApiServer(std::vector<Reply> script)
+      : script_(std::move(script)) {
+    listen_fd_ = socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    addr.sin_port = 0;
+    bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
+    listen(listen_fd_, 8);
+    socklen_t len = sizeof(addr);
+    getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+    port_ = ntohs(addr.sin_port);
+    thread_ = std::thread([this] { Serve(); });
+  }
+
+  ~ScriptedApiServer() {
+    shutdown(listen_fd_, SHUT_RDWR);
+    close(listen_fd_);
+    thread_.join();
+  }
+
+  int port() const { return port_; }
+  std::string url() const {
+    return "http://127.0.0.1:" + std::to_string(port_);
+  }
+  const std::vector<Exchange>& exchanges() const { return exchanges_; }
+  int CountVerb(const std::string& verb) const {
+    int n = 0;
+    for (const Exchange& e : exchanges_) {
+      if (e.method == verb) n++;
+    }
+    return n;
+  }
+
+ private:
+  void Serve() {
+    for (size_t i = 0; i < script_.size(); i++) {
+      int conn = accept(listen_fd_, nullptr, nullptr);
+      if (conn < 0) return;  // shut down mid-script
+      std::string raw;
+      char buf[4096];
+      size_t body_need = std::string::npos;
+      size_t header_end = std::string::npos;
+      while (true) {
+        if (header_end == std::string::npos) {
+          header_end = raw.find("\r\n\r\n");
+          if (header_end != std::string::npos) {
+            size_t cl = raw.find("Content-Length: ");
+            body_need = cl != std::string::npos && cl < header_end
+                            ? strtoul(raw.c_str() + cl + 16, nullptr, 10)
+                            : 0;
+          }
+        }
+        if (header_end != std::string::npos &&
+            raw.size() >= header_end + 4 + body_need) {
+          break;
+        }
+        ssize_t n = recv(conn, buf, sizeof(buf), 0);
+        if (n <= 0) break;
+        raw.append(buf, static_cast<size_t>(n));
+      }
+      Exchange ex;
+      size_t sp1 = raw.find(' ');
+      size_t sp2 = raw.find(' ', sp1 + 1);
+      if (sp1 != std::string::npos && sp2 != std::string::npos) {
+        ex.method = raw.substr(0, sp1);
+        ex.path = raw.substr(sp1 + 1, sp2 - sp1 - 1);
+      }
+      if (header_end != std::string::npos) {
+        ex.body = raw.substr(header_end + 4);
+      }
+      exchanges_.push_back(ex);
+      const Reply& reply = script_[i];
+      std::string out = "HTTP/1.1 " + std::to_string(reply.status) +
+                        " X\r\nContent-Length: " +
+                        std::to_string(reply.body.size()) + "\r\n" +
+                        reply.extra_headers + "Connection: close\r\n\r\n" +
+                        reply.body;
+      send(conn, out.data(), out.size(), MSG_NOSIGNAL);
+      close(conn);
+    }
+  }
+
+  std::vector<Reply> script_;
+  std::vector<Exchange> exchanges_;
+  int listen_fd_;
+  int port_;
+  std::thread thread_;
+};
+
+k8s::ClusterConfig ScriptedCluster(const ScriptedApiServer& server) {
+  k8s::ClusterConfig cluster;
+  cluster.apiserver_url = server.url();
+  cluster.namespace_ = "unit";
+  cluster.node_name = "unit-node";
+  return cluster;
+}
+
+void TestDesyncMath() {
+  // Cross-language golden pins: tests/test_fleet.py asserts the SAME
+  // numbers from the tpufd.sink twin. If either side drifts, the fleet
+  // soak stops simulating the schedule the daemon actually runs.
+  CHECK_TRUE(k8s::desync::Fnv1a64("tpu-node-1") == 0xd4ee320a7c9868f9ULL);
+  char buf[64];
+  snprintf(buf, sizeof(buf), "%.12f", k8s::desync::HashUnit("tpu-node-1"));
+  CHECK_EQ(std::string(buf), "0.153074774741");
+  snprintf(buf, sizeof(buf), "%.6f",
+           k8s::desync::PhaseOffsetS(60.0, "tpu-node-1", 10));
+  CHECK_EQ(std::string(buf), "9.184486");
+  snprintf(buf, sizeof(buf), "%.12f",
+           k8s::desync::JitterUnit("tpu-node-1", 3));
+  CHECK_EQ(std::string(buf), "0.939997208947");
+  snprintf(buf, sizeof(buf), "%.6f",
+           k8s::desync::JitteredIntervalS(60.0, "tpu-node-1", 3, 10));
+  CHECK_EQ(std::string(buf), "65.639983");
+  snprintf(buf, sizeof(buf), "%.6f",
+           k8s::desync::RefreshPeriodS(150.0, "tpu-node-1", 10));
+  CHECK_EQ(std::string(buf), "159.504576");
+  snprintf(buf, sizeof(buf), "%.6f",
+           k8s::desync::SpreadRetryAfterS(30.0, "tpu-node-1"));
+  CHECK_EQ(std::string(buf), "33.595262");
+
+  // Properties: jitter-pct 0 disables everything; bounds hold; similar
+  // node names spread (the raw-FNV high-bit clustering regression).
+  CHECK_EQ(k8s::desync::PhaseOffsetS(60.0, "tpu-node-1", 0), 0.0);
+  CHECK_EQ(k8s::desync::JitteredIntervalS(60.0, "tpu-node-1", 3, 0), 60.0);
+  CHECK_EQ(k8s::desync::RefreshPeriodS(150.0, "tpu-node-1", 0), 150.0);
+  int buckets[5] = {0, 0, 0, 0, 0};
+  for (int i = 0; i < 500; i++) {
+    char name[32];
+    snprintf(name, sizeof(name), "node-%04d", i);
+    double offset = k8s::desync::PhaseOffsetS(5.0, name, 10);
+    CHECK_TRUE(offset >= 0 && offset < 5.0);
+    buckets[static_cast<int>(offset)]++;
+    double interval = k8s::desync::JitteredIntervalS(60.0, name, i, 10);
+    CHECK_TRUE(interval >= 54.0 && interval <= 66.0);
+    double retry = k8s::desync::SpreadRetryAfterS(10.0, name);
+    CHECK_TRUE(retry >= 10.0 && retry < 15.0);
+  }
+  for (int b = 0; b < 5; b++) {
+    CHECK_TRUE(buckets[b] > 50);  // ~100 each when uniform
+  }
+}
+
+void TestBuildMergePatch() {
+  lm::Labels acked{{"a", "1"}, {"b", "2"}, {"z", "9"}};
+  lm::Labels desired{{"a", "1"}, {"b", "3"}, {"c", "4"}};
+  // Pinned against tpufd.sink.build_merge_patch (tests/test_fleet.py):
+  // changed/added keys sorted, then removals as nulls, rv precondition
+  // and node-name fix in metadata.
+  CHECK_EQ(k8s::BuildMergePatch(acked, desired, "tpu-node-1", true, "17"),
+           "{\"metadata\":{\"resourceVersion\":\"17\",\"labels\":"
+           "{\"nfd.node.kubernetes.io/node-name\":\"tpu-node-1\"}},"
+           "\"spec\":{\"labels\":{\"b\":\"3\",\"c\":\"4\",\"z\":null}}}");
+  CHECK_EQ(k8s::BuildMergePatch(acked, desired, "tpu-node-1", false, ""),
+           "{\"spec\":{\"labels\":{\"b\":\"3\",\"c\":\"4\","
+           "\"z\":null}}}");
+  // Nothing changed, nothing to fix: no patch at all.
+  CHECK_EQ(k8s::BuildMergePatch(acked, acked, "tpu-node-1", false, "17"),
+           "");
+  // Node-name repair alone still patches (empty spec diff).
+  CHECK_EQ(k8s::BuildMergePatch(acked, acked, "tpu-node-1", true, ""),
+           "{\"metadata\":{\"labels\":"
+           "{\"nfd.node.kubernetes.io/node-name\":\"tpu-node-1\"}},"
+           "\"spec\":{\"labels\":{}}}");
+}
+
+void TestSinkPatchFlow() {
+  // First write (state unknown): GET the CR, diff, PATCH. Second write
+  // (state cached): ONE PATCH, zero GETs. Third write (no change): a
+  // semantic-equality GET, no write — callers skip clean passes
+  // upstream, so a write call with nothing to diff owes a REAL server
+  // interaction (that GET is what surfaces a dead apiserver to the
+  // breaker on forced-slow/chaos passes).
+  ScriptedApiServer server({
+      {200,
+       "{\"metadata\":{\"name\":\"tfd-features-for-unit-node\","
+       "\"resourceVersion\":\"5\",\"labels\":{"
+       "\"nfd.node.kubernetes.io/node-name\":\"unit-node\"}},"
+       "\"spec\":{\"labels\":{\"google.com/tpu.count\":\"2\"}}}"},
+      {200, "{\"metadata\":{\"resourceVersion\":\"6\"}}"},
+      {200, "{\"metadata\":{\"resourceVersion\":\"7\"}}"},
+      {200,
+       "{\"metadata\":{\"name\":\"tfd-features-for-unit-node\","
+       "\"resourceVersion\":\"7\",\"labels\":{"
+       "\"nfd.node.kubernetes.io/node-name\":\"unit-node\"}},"
+       "\"spec\":{\"labels\":{\"google.com/tpu.count\":\"8\","
+       "\"google.com/tpu.topology\":\"2x2\"}}}"},
+  });
+  k8s::ClusterConfig cluster = ScriptedCluster(server);
+  k8s::SinkState state;
+  k8s::WriteOutcome outcome;
+  lm::Labels labels{{"google.com/tpu.count", "4"},
+                    {"google.com/tpu.topology", "2x2"}};
+  bool transient = true;
+  CHECK_TRUE(k8s::UpdateNodeFeature(cluster, labels, &transient, &state,
+                                    &outcome).ok());
+  CHECK_EQ(outcome.gets, 1);
+  CHECK_EQ(outcome.patches, 1);
+  CHECK_EQ(outcome.puts, 0);
+  CHECK_TRUE(state.known);
+  CHECK_EQ(state.resource_version, "6");
+
+  labels["google.com/tpu.count"] = "8";
+  k8s::WriteOutcome second;
+  CHECK_TRUE(k8s::UpdateNodeFeature(cluster, labels, &transient, &state,
+                                    &second).ok());
+  CHECK_EQ(second.gets, 0);  // zero-GET dirty write
+  CHECK_EQ(second.patches, 1);
+  CHECK_EQ(state.resource_version, "7");
+  CHECK_TRUE(second.patch_bytes > 0 && second.patch_bytes < 200);
+
+  k8s::WriteOutcome third;
+  CHECK_TRUE(k8s::UpdateNodeFeature(cluster, labels, &transient, &state,
+                                    &third).ok());
+  CHECK_EQ(third.gets, 1);  // semantic-equality probe, no write
+  CHECK_EQ(third.patches + third.puts + third.posts, 0);
+
+  // Wire truth: GET, PATCH, PATCH, GET — never a PUT; the first patch
+  // body is a DIFF with the rv precondition, not a full object.
+  CHECK_EQ(server.exchanges().size(), static_cast<size_t>(4));
+  CHECK_EQ(server.exchanges()[0].method, "GET");
+  CHECK_EQ(server.exchanges()[1].method, "PATCH");
+  CHECK_EQ(server.exchanges()[2].method, "PATCH");
+  CHECK_EQ(server.exchanges()[3].method, "GET");
+  const std::string& patch1 = server.exchanges()[1].body;
+  CHECK_TRUE(patch1.find("\"resourceVersion\":\"5\"") != std::string::npos);
+  CHECK_TRUE(patch1.find("\"google.com/tpu.count\":\"4\"") !=
+             std::string::npos);
+  CHECK_TRUE(patch1.find("apiVersion") == std::string::npos);
+  // The second patch carries ONLY the changed key.
+  const std::string& patch2 = server.exchanges()[2].body;
+  CHECK_TRUE(patch2.find("\"google.com/tpu.count\":\"8\"") !=
+             std::string::npos);
+  CHECK_TRUE(patch2.find("topology") == std::string::npos);
+}
+
+void TestSinkPatchConflictReGet() {
+  // The 409 contract (table-driven over the conflict position): a stale
+  // resourceVersion costs exactly ONE extra GET — PATCH(409) ->
+  // re-GET -> PATCH(200) — and never a full-object PUT.
+  struct Case {
+    const char* name;
+    bool start_known;  // conflict on the zero-GET patch vs the GET path
+  };
+  const Case kCases[] = {
+      {"zero-get patch conflicts", true},
+      {"fresh-get patch conflicts", false},
+  };
+  for (const Case& c : kCases) {
+    std::vector<ScriptedApiServer::Reply> script;
+    if (!c.start_known) {
+      script.push_back(
+          {200,
+           "{\"metadata\":{\"resourceVersion\":\"8\",\"labels\":{"
+           "\"nfd.node.kubernetes.io/node-name\":\"unit-node\"}},"
+           "\"spec\":{\"labels\":{\"k\":\"old\"}}}"});
+    }
+    script.push_back({409, "{\"message\":\"conflict\"}"});
+    script.push_back(
+        {200,
+         "{\"metadata\":{\"resourceVersion\":\"9\",\"labels\":{"
+         "\"nfd.node.kubernetes.io/node-name\":\"unit-node\"}},"
+         "\"spec\":{\"labels\":{\"k\":\"theirs\"}}}"});
+    script.push_back({200, "{\"metadata\":{\"resourceVersion\":\"10\"}}"});
+    ScriptedApiServer server(std::move(script));
+    k8s::ClusterConfig cluster = ScriptedCluster(server);
+    k8s::SinkState state;
+    if (c.start_known) {
+      state.known = true;
+      state.resource_version = "7";  // stale on purpose
+      state.acked = {{"k", "old"}};
+    }
+    k8s::WriteOutcome outcome;
+    bool transient = true;
+    lm::Labels labels{{"k", "new"}};
+    Status s = k8s::UpdateNodeFeature(cluster, labels, &transient, &state,
+                                      &outcome);
+    CHECK_TRUE(s.ok());
+    // Exactly one extra GET beyond what the path already owed.
+    CHECK_EQ(outcome.gets, c.start_known ? 1 : 2);
+    CHECK_EQ(outcome.patches, 2);
+    CHECK_EQ(server.CountVerb("PUT"), 0);
+    CHECK_EQ(state.resource_version, "10");
+    // The re-GET re-diffed against the server's moved content: the
+    // winning patch overwrites "theirs", preconditioned on ITS rv.
+    const std::string& final_patch = server.exchanges().back().body;
+    CHECK_TRUE(final_patch.find("\"resourceVersion\":\"9\"") !=
+               std::string::npos);
+    CHECK_TRUE(final_patch.find("\"k\":\"new\"") != std::string::npos);
+  }
+}
+
+void TestSinkPatchFallbacks() {
+  // 404 under a zero-GET patch: the CR was deleted externally — fall
+  // back to the create path (GET 404 -> POST), state re-learned.
+  {
+    ScriptedApiServer server({
+        {404, "{\"message\":\"gone\"}"},
+        {404, "{\"message\":\"gone\"}"},
+        {201, "{\"metadata\":{\"resourceVersion\":\"1\"}}"},
+    });
+    k8s::ClusterConfig cluster = ScriptedCluster(server);
+    k8s::SinkState state;
+    state.known = true;
+    state.resource_version = "44";
+    state.acked = {{"k", "old"}};
+    bool transient = true;
+    k8s::WriteOutcome outcome;
+    CHECK_TRUE(k8s::UpdateNodeFeature(cluster, {{"k", "new"}}, &transient,
+                                      &state, &outcome).ok());
+    CHECK_EQ(outcome.patches, 1);
+    CHECK_EQ(outcome.posts, 1);
+    CHECK_EQ(state.resource_version, "1");
+    // The create body is the FULL CR (it must carry the node-name
+    // metadata label the NFD master attributes by).
+    CHECK_TRUE(server.exchanges().back().body.find(
+                   "nfd.node.kubernetes.io/node-name") !=
+               std::string::npos);
+  }
+  // 415: the apiserver doesn't speak merge-patch — fall back to the
+  // reference GET->mutate->PUT, and REMEMBER it: the next write skips
+  // the doomed PATCH entirely.
+  {
+    ScriptedApiServer server({
+        {415, "{\"message\":\"no merge-patch\"}"},
+        {200,
+         "{\"metadata\":{\"resourceVersion\":\"3\",\"labels\":{"
+         "\"nfd.node.kubernetes.io/node-name\":\"unit-node\"}},"
+         "\"spec\":{\"labels\":{\"k\":\"old\"}},\"apiVersion\":\"x\"}"},
+        {200, "{\"metadata\":{\"resourceVersion\":\"4\"}}"},
+        {200,
+         "{\"metadata\":{\"resourceVersion\":\"4\",\"labels\":{"
+         "\"nfd.node.kubernetes.io/node-name\":\"unit-node\"}},"
+         "\"spec\":{\"labels\":{\"k\":\"new\"}},\"apiVersion\":\"x\"}"},
+        {200, "{\"metadata\":{\"resourceVersion\":\"5\"}}"},
+    });
+    k8s::ClusterConfig cluster = ScriptedCluster(server);
+    k8s::SinkState state;
+    state.known = true;
+    state.resource_version = "3";
+    state.acked = {{"k", "old"}};
+    bool transient = true;
+    k8s::WriteOutcome outcome;
+    CHECK_TRUE(k8s::UpdateNodeFeature(cluster, {{"k", "new"}}, &transient,
+                                      &state, &outcome).ok());
+    CHECK_TRUE(state.patch_unsupported);
+    CHECK_EQ(outcome.patches, 1);
+    CHECK_EQ(outcome.puts, 1);
+    // The PUT body is the mutated FETCHED object: foreign fields
+    // (apiVersion here) survive.
+    CHECK_TRUE(server.exchanges()[2].body.find("\"apiVersion\":\"x\"") !=
+               std::string::npos);
+    // Second write: straight GET -> PUT, no PATCH attempt.
+    k8s::WriteOutcome second;
+    CHECK_TRUE(k8s::UpdateNodeFeature(cluster, {{"k", "newer"}},
+                                      &transient, &state, &second).ok());
+    CHECK_EQ(second.patches, 0);
+    CHECK_EQ(second.gets, 1);
+    CHECK_EQ(second.puts, 1);
+  }
+  // A foreign NON-STRING spec.labels value: invisible to the string-map
+  // diff (empty patch) but it must still be healed — the write falls
+  // through to the wholesale-replace PUT, like the reference. A local
+  // "no diff" no-op here would leave the junk in the CR forever.
+  {
+    ScriptedApiServer server({
+        {200,
+         "{\"metadata\":{\"resourceVersion\":\"3\",\"labels\":{"
+         "\"nfd.node.kubernetes.io/node-name\":\"unit-node\"}},"
+         "\"spec\":{\"labels\":{\"k\":\"v\",\"junk\":123}}}"},
+        {200, "{\"metadata\":{\"resourceVersion\":\"4\"}}"},
+    });
+    k8s::ClusterConfig cluster = ScriptedCluster(server);
+    k8s::SinkState state;
+    bool transient = true;
+    k8s::WriteOutcome outcome;
+    CHECK_TRUE(k8s::UpdateNodeFeature(cluster, {{"k", "v"}}, &transient,
+                                      &state, &outcome).ok());
+    CHECK_EQ(outcome.patches, 0);
+    CHECK_EQ(outcome.puts, 1);
+    CHECK_TRUE(server.exchanges().back().body.find("junk") ==
+               std::string::npos);  // wholesale replace dropped it
+  }
+}
+
+void TestSinkConflictExhaustion() {
+  // kMaxAttempts 409s in a row: the write must settle as a TRANSIENT
+  // failure carrying the last conflict (journaled, breaker-visible) —
+  // not fall silently out of the retry loop. Both update flavors.
+  setenv("NODE_NAME", "unit-node", 1);
+  setenv("TFD_APISERVER_URL", "http://127.0.0.1:1", 1);
+  setenv("TFD_SERVICEACCOUNT_DIR", "/nonexistent-tfd-unit", 1);
+  Result<k8s::ClusterConfig> cluster = k8s::LoadInClusterConfig();
+  CHECK_TRUE(cluster.ok());
+  lm::Labels labels{{"google.com/tpu.count", "4"}};
+  struct Case {
+    const char* spec;
+    bool use_patch;
+    const char* expect_in_error;
+  };
+  const Case kCases[] = {
+      // PATCH conflicts forever (GET 200 fabricates an empty CR "{}").
+      {"k8s.get:http=200:count=3,k8s.patch:http=409:count=3", true,
+       "patch conflict"},
+      // The reference PUT path conflicts forever.
+      {"k8s.get:http=200:count=3,k8s.put:http=409:count=3", false,
+       "update conflict"},
+  };
+  for (const Case& c : kCases) {
+    CHECK_TRUE(fault::Arm(c.spec).ok());
+    k8s::ClusterConfig scoped = *cluster;
+    scoped.use_patch = c.use_patch;
+    k8s::SinkState state;
+    bool transient = false;  // must be overwritten to true
+    Status s =
+        k8s::UpdateNodeFeature(scoped, labels, &transient, &state);
+    CHECK_TRUE(!s.ok());
+    CHECK_TRUE(transient);
+    CHECK_TRUE(s.message().find("attempts exhausted") != std::string::npos);
+    CHECK_TRUE(s.message().find(c.expect_in_error) != std::string::npos);
+  }
+  fault::Disarm();
+  unsetenv("NODE_NAME");
+  unsetenv("TFD_APISERVER_URL");
+  unsetenv("TFD_SERVICEACCOUNT_DIR");
+}
+
+void TestSinkRetryAfterAndDefer() {
+  // A 429 with Retry-After + APF attribution headers: the outcome must
+  // surface both (DispatchSink feeds them to the breaker's deferral),
+  // and the deferral must gate Allow() in the CLOSED state without a
+  // state-machine transition.
+  ScriptedApiServer server({
+      {429, "{\"message\":\"slow down\"}",
+       "Retry-After: 7\r\n"
+       "X-Kubernetes-PF-FlowSchema-UID: fs-1\r\n"
+       "X-Kubernetes-PF-PriorityLevel-UID: pl-1\r\n"},
+  });
+  k8s::ClusterConfig cluster = ScriptedCluster(server);
+  k8s::SinkState state;
+  state.known = true;
+  state.resource_version = "2";
+  state.acked = {{"k", "old"}};
+  bool transient = false;
+  k8s::WriteOutcome outcome;
+  Status s = k8s::UpdateNodeFeature(cluster, {{"k", "new"}}, &transient,
+                                    &state, &outcome);
+  CHECK_TRUE(!s.ok());
+  CHECK_TRUE(transient);
+  CHECK_TRUE(outcome.retry_after_s == 7.0);
+  CHECK_TRUE(outcome.apf_rejected);
+
+  k8s::CircuitBreaker breaker(k8s::CircuitBreaker::Options{3, 30});
+  CHECK_TRUE(breaker.Allow());
+  breaker.Defer(7.0, "Retry-After");
+  CHECK_TRUE(!breaker.Allow());  // closed but deferred
+  CHECK_TRUE(breaker.deferred());
+  CHECK_TRUE(breaker.state() == k8s::CircuitBreaker::State::kClosed);
+  breaker.AgeForTest(8.0);
+  CHECK_TRUE(!breaker.deferred());
+  CHECK_TRUE(breaker.Allow());
+  // Deadlines only extend: a shorter later defer never shrinks one.
+  breaker.Defer(10.0, "x");
+  breaker.Defer(1.0, "y");
+  breaker.AgeForTest(5.0);
+  CHECK_TRUE(!breaker.Allow());
+}
+
+void TestHttpResponseHeaders() {
+  Result<http::Response> r = http::ParseResponse(
+      "HTTP/1.1 429 Too Many Requests\r\n"
+      "Content-Type: application/json\r\n"
+      "RETRY-AFTER:  12 \r\n"
+      "X-Kubernetes-PF-FlowSchema-UID: abc\r\n"
+      "\r\n"
+      "{}");
+  CHECK_TRUE(r.ok());
+  CHECK_EQ(r->status, 429);
+  CHECK_EQ(r->headers.at("retry-after"), "12");  // lowercased, trimmed
+  CHECK_EQ(r->headers.at("x-kubernetes-pf-flowschema-uid"), "abc");
+  CHECK_TRUE(r->RetryAfterSeconds() == 12.0);
+  // HTTP-date Retry-After is not parsed: reads as "no pause named".
+  Result<http::Response> date = http::ParseResponse(
+      "HTTP/1.1 503 X\r\nRetry-After: Tue, 04 Aug 2026 01:00:00 GMT\r\n"
+      "\r\n");
+  CHECK_TRUE(date.ok());
+  CHECK_TRUE(date->RetryAfterSeconds() == 0.0);
+}
+
 }  // namespace
 }  // namespace tfd
 
@@ -3039,6 +3550,14 @@ int main(int argc, char** argv) {
   tfd::TestRenameErrorDeviceIds();
   tfd::TestHttpDeadlineBudget();
   tfd::TestK8sFaultClassification();
+  tfd::TestDesyncMath();
+  tfd::TestBuildMergePatch();
+  tfd::TestSinkPatchFlow();
+  tfd::TestSinkPatchConflictReGet();
+  tfd::TestSinkPatchFallbacks();
+  tfd::TestSinkConflictExhaustion();
+  tfd::TestSinkRetryAfterAndDefer();
+  tfd::TestHttpResponseHeaders();
 
   std::cerr << tfd::g_checks << " checks, " << tfd::g_failures << " failures"
             << std::endl;

@@ -374,6 +374,15 @@ class TlsTransport : public Transport {
 
 }  // namespace
 
+double Response::RetryAfterSeconds() const {
+  auto it = headers.find("retry-after");
+  if (it == headers.end()) return 0;
+  char* end = nullptr;
+  double s = strtod(it->second.c_str(), &end);
+  if (end == it->second.c_str() || s < 0) return 0;  // HTTP-date or junk
+  return s;
+}
+
 Result<Response> ParseResponse(const std::string& raw) {
   size_t header_end = raw.find("\r\n\r\n");
   if (header_end == std::string::npos) {
@@ -387,6 +396,27 @@ Result<Response> ParseResponse(const std::string& raw) {
   }
   Response out;
   out.status = atoi(headers.c_str() + sp + 1);
+  // Header lines after the status line, keys lowercased. Obs-fold
+  // continuations (RFC 9112 §5.2, deprecated) are not reassembled — a
+  // folded Retry-After simply reads as absent.
+  size_t line_start = headers.find("\r\n");
+  while (line_start != std::string::npos && line_start < headers.size()) {
+    line_start += 2;
+    size_t line_end = headers.find("\r\n", line_start);
+    std::string line = headers.substr(
+        line_start, line_end == std::string::npos ? std::string::npos
+                                                  : line_end - line_start);
+    size_t colon = line.find(':');
+    if (colon != std::string::npos && colon > 0) {
+      std::string key = ToLower(line.substr(0, colon));
+      std::string value = line.substr(colon + 1);
+      size_t b = value.find_first_not_of(" \t");
+      size_t e = value.find_last_not_of(" \t\r");
+      out.headers[key] =
+          b == std::string::npos ? "" : value.substr(b, e - b + 1);
+    }
+    line_start = line_end;
+  }
   if (ToLower(headers).find("transfer-encoding: chunked") !=
       std::string::npos) {
     std::string decoded;

@@ -19,6 +19,16 @@ namespace http {
 struct Response {
   int status = 0;
   std::string body;
+  // Response headers, keys lowercased (HTTP header names are
+  // case-insensitive; RFC 9110 §5.1). Later duplicates win — fine for
+  // the singleton headers the daemon reads (Retry-After, the APF
+  // X-Kubernetes-PF-* attribution pair).
+  std::map<std::string, std::string> headers;
+
+  // Retry-After in seconds (the delta-seconds form; the HTTP-date form
+  // is not parsed). 0 when absent/unparseable — callers treat 0 as
+  // "server named no pause".
+  double RetryAfterSeconds() const;
 };
 
 // Parsed form of http[s]://host[:port]/path. Unbracketed IPv6 literals

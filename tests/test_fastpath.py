@@ -249,6 +249,74 @@ class TestFastPathSoak:
             proc.wait(timeout=10)
 
 
+class TestSinkOutageDetection:
+    def test_anti_entropy_discovers_dead_cr_sink(self, tfd_binary,
+                                                 tmp_path):
+        """The PR 6 documented nuance, closed: a steady-state fleet
+        skips the CR sink entirely, so a dead apiserver is invisible
+        until something dirties a pass — UNLESS the (jittered)
+        anti-entropy refresh doubles as the liveness probe. Kill the
+        fake apiserver mid-steady-state and the outage must surface as
+        a journaled `sink-outage` + tfd_sink_outages_total within the
+        refresh cadence; healing the server recovers the sink."""
+        from tpufd.fakes.apiserver import FakeApiServer
+
+        with FakeApiServer(token="soak-token") as server:
+            sa = tmp_path / "sa"
+            sa.mkdir()
+            (sa / "namespace").write_text("node-feature-discovery\n")
+            (sa / "token").write_text("soak-token\n")
+            port = free_port()
+            argv = [str(tfd_binary), "--sleep-interval=1s",
+                    "--backend=mock",
+                    f"--mock-topology-file={FIXTURES / 'v2-8.yaml'}",
+                    "--machine-type-file=/dev/null",
+                    "--use-node-feature-api", "--output-file=",
+                    "--sink-refresh=3s",
+                    f"--introspection-addr=127.0.0.1:{port}"]
+            env = {"NODE_NAME": "outage-node",
+                   "TFD_APISERVER_URL": server.url,
+                   "TFD_SERVICEACCOUNT_DIR": str(sa)}
+            proc = launch(argv, env)
+            try:
+                wait_passes(port, 3)
+                # Steady state reached: fast passes skip the CR sink.
+                assert wait_for(
+                    lambda: (scrape(port, "tfd_pass_fast_total") or 0) >= 2,
+                    timeout=30), "fast path never engaged on the CR sink"
+                failures_before = scrape(
+                    port, "tfd_rewrite_failures_total") or 0
+
+                server.set_failing(500)
+                # Detection is bounded by the anti-entropy cadence
+                # (3s here), not by the next label change.
+                assert wait_for(
+                    lambda: (scrape(port, "tfd_sink_outages_total")
+                             or 0) >= 1,
+                    timeout=20), ("anti-entropy never noticed the dead "
+                                  "sink")
+                outages = tpufd_journal.events_of_type(
+                    journal_events(port), "sink-outage")
+                assert outages, "no sink-outage journal event"
+                assert outages[0]["fields"]["transient"] == "true"
+                assert outages[0]["source"] == "cr"
+                assert (scrape(port, "tfd_rewrite_failures_total")
+                        or 0) > failures_before
+
+                server.set_failing(0)
+                rv_then = server.store[
+                    ("node-feature-discovery",
+                     "tfd-features-for-outage-node")][
+                    "metadata"]["resourceVersion"]
+                assert wait_for(
+                    lambda: http_get(port, "/readyz")[0] == 200,
+                    timeout=30), "sink never recovered after the heal"
+                assert rv_then is not None  # CR survived the outage
+            finally:
+                proc.terminate()
+                proc.wait(timeout=10)
+
+
 class TestGoldenEquality:
     def test_forced_slow_and_fast_path_outputs_are_byte_identical(
             self, tfd_binary, tmp_path):

@@ -1,0 +1,312 @@
+"""Fleet-scale diff sink (ISSUE 8): twin parity, fake-apiserver
+merge-patch semantics, the diff-sink flow over the wire, the golden
+content equivalence, and a small cluster-in-a-box smoke.
+
+The cross-language golden pins here mirror the C++ TestDesyncMath /
+TestBuildMergePatch checks in src/tfd/tests/unit_tests.cc — the SAME
+literal numbers appear in both files on purpose: the fleet soak
+simulates a thousand daemons with the Python twin, which is only valid
+while both sides compute identical schedules and patches.
+"""
+
+import json
+import sys
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "scripts"))
+
+import fleet_soak  # noqa: E402
+
+from tpufd import sink  # noqa: E402
+from tpufd.fakes.apiserver import FakeApiServer  # noqa: E402
+
+
+class TestDesyncParity:
+    def test_golden_pins_match_cpp(self):
+        # Pinned verbatim in unit_tests.cc TestDesyncMath.
+        assert sink.fnv1a64("tpu-node-1") == 0xD4EE320A7C9868F9
+        assert f"{sink.hash_unit('tpu-node-1'):.12f}" == "0.153074774741"
+        assert (f"{sink.phase_offset_s(60.0, 'tpu-node-1', 10):.6f}"
+                == "9.184486")
+        assert (f"{sink.jitter_unit('tpu-node-1', 3):.12f}"
+                == "0.939997208947")
+        assert (f"{sink.jittered_interval_s(60.0, 'tpu-node-1', 3, 10):.6f}"
+                == "65.639983")
+        assert (f"{sink.refresh_period_s(150.0, 'tpu-node-1', 10):.6f}"
+                == "159.504576")
+        assert (f"{sink.spread_retry_after_s(30.0, 'tpu-node-1'):.6f}"
+                == "33.595262")
+
+    def test_zero_jitter_disables_everything(self):
+        assert sink.phase_offset_s(60.0, "n", 0) == 0.0
+        assert sink.jittered_interval_s(60.0, "n", 3, 0) == 60.0
+        assert sink.refresh_period_s(150.0, "n", 0) == 150.0
+
+    def test_similar_node_names_spread(self):
+        """The raw-FNV high-bit clustering regression: numeric-suffix
+        node names (every real fleet) must spread across the interval."""
+        import collections
+        buckets = collections.Counter(
+            int(sink.phase_offset_s(5.0, f"node-{i:04d}", 10))
+            for i in range(500))
+        assert set(buckets) == {0, 1, 2, 3, 4}
+        assert all(count > 50 for count in buckets.values())
+
+    def test_merge_patch_pin_matches_cpp(self):
+        # Pinned verbatim in unit_tests.cc TestBuildMergePatch.
+        patch = sink.build_merge_patch(
+            {"a": "1", "b": "2", "z": "9"},
+            {"a": "1", "b": "3", "c": "4"},
+            "tpu-node-1", True, "17")
+        assert json.dumps(patch, separators=(",", ":")) == (
+            '{"metadata":{"resourceVersion":"17","labels":'
+            '{"nfd.node.kubernetes.io/node-name":"tpu-node-1"}},'
+            '"spec":{"labels":{"b":"3","c":"4","z":null}}}')
+        assert sink.build_merge_patch({"a": "1"}, {"a": "1"},
+                                      "n", False, "9") is None
+
+
+def api(server, method, path, body=None, content_type=None, rv=None):
+    url = f"{server.url}{path}"
+    data = None
+    if body is not None:
+        if rv is not None:
+            body = {**body, "metadata": {**body.get("metadata", {}),
+                                         "resourceVersion": rv}}
+        data = json.dumps(body).encode()
+    req = urllib.request.Request(url, data=data, method=method)
+    if content_type:
+        req.add_header("Content-Type", content_type)
+    try:
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return resp.status, dict(resp.headers), json.loads(
+                resp.read() or b"null")
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read() or b"null")
+
+
+BASE = "/apis/nfd.k8s-sigs.io/v1alpha1/namespaces/ns/nodefeatures"
+
+
+class TestFakeApiServerPatch:
+    def test_merge_patch_semantics(self):
+        with FakeApiServer() as server:
+            status, _, _ = api(server, "POST", BASE, {
+                "metadata": {"name": "cr1"},
+                "spec": {"labels": {"a": "1", "b": "2"}}})
+            assert status == 201
+            # Merge: change a, delete b, add c; rv precondition "1".
+            status, _, obj = api(
+                server, "PATCH", f"{BASE}/cr1",
+                {"metadata": {"resourceVersion": "1"},
+                 "spec": {"labels": {"a": "9", "b": None, "c": "3"}}},
+                content_type=sink.MERGE_PATCH_CONTENT_TYPE)
+            assert status == 200
+            assert obj["spec"]["labels"] == {"a": "9", "c": "3"}
+            assert obj["metadata"]["resourceVersion"] == "2"
+            # Stale rv precondition: 409, store untouched.
+            status, _, _ = api(
+                server, "PATCH", f"{BASE}/cr1",
+                {"metadata": {"resourceVersion": "1"},
+                 "spec": {"labels": {"a": "0"}}},
+                content_type=sink.MERGE_PATCH_CONTENT_TYPE)
+            assert status == 409
+            assert server.store[("ns", "cr1")]["spec"]["labels"][
+                "a"] == "9"
+            # No rv: unconditioned patch applies.
+            status, _, obj = api(
+                server, "PATCH", f"{BASE}/cr1",
+                {"spec": {"labels": {"a": "0"}}},
+                content_type=sink.MERGE_PATCH_CONTENT_TYPE)
+            assert status == 200
+            assert obj["metadata"]["resourceVersion"] == "3"
+
+    def test_content_type_and_support_gates(self):
+        with FakeApiServer() as server:
+            api(server, "POST", BASE, {"metadata": {"name": "cr1"},
+                                       "spec": {"labels": {}}})
+            status, _, _ = api(server, "PATCH", f"{BASE}/cr1",
+                               {"spec": {}},
+                               content_type="application/json")
+            assert status == 415
+            server.set_patch_supported(False)
+            status, _, _ = api(
+                server, "PATCH", f"{BASE}/cr1", {"spec": {}},
+                content_type=sink.MERGE_PATCH_CONTENT_TYPE)
+            assert status == 415
+            status, _, _ = api(server, "PATCH", f"{BASE}/missing",
+                               {"spec": {}},
+                               content_type=sink.MERGE_PATCH_CONTENT_TYPE)
+            # Support gate outranks existence, like a real apiserver
+            # rejecting the content type at the door.
+            assert status == 415
+
+    def test_429_storm_carries_retry_after_and_apf_headers(self):
+        with FakeApiServer() as server:
+            server.set_failing(429, retry_after=7, apf=True)
+            status, headers, _ = api(server, "GET", f"{BASE}/x")
+            assert status == 429
+            assert headers["Retry-After"] == "7"
+            assert "X-Kubernetes-PF-FlowSchema-UID" in headers
+            server.set_failing(0)
+            status, _, _ = api(server, "GET", f"{BASE}/x")
+            assert status == 404
+
+    def test_capacity_limit_throttles_overflow(self):
+        with FakeApiServer() as server:
+            server.set_capacity(3)
+            statuses = [api(server, "GET", f"{BASE}/x")[0]
+                        for _ in range(8)]
+            assert statuses.count(429) >= 4  # over-capacity slice
+            server.set_capacity(0)
+
+
+def wire_request(server):
+    def request(method, path, body, headers):
+        url = f"{server.url}{path}"
+        data = (json.dumps(body, separators=(",", ":")).encode()
+                if body is not None else None)
+        req = urllib.request.Request(url, data=data, method=method)
+        for key, value in headers.items():
+            req.add_header(key, value)
+        try:
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                return (resp.status, dict(resp.headers),
+                        json.loads(resp.read() or b"null"))
+        except urllib.error.HTTPError as e:
+            return e.code, dict(e.headers), json.loads(e.read() or b"null")
+    return request
+
+
+class TestDiffSinkFlow:
+    def test_create_then_zero_get_patch_then_noop(self):
+        with FakeApiServer() as server:
+            request = wire_request(server)
+            diff = sink.DiffSink("n1", "ns")
+            labels = {"google.com/tpu.count": "4"}
+            out = diff.write(request, labels)
+            assert out.ok and out.gets == 1 and out.posts == 1
+
+            labels["google.com/tpu.count"] = "8"
+            out = diff.write(request, labels)
+            assert out.ok and out.gets == 0 and out.patches == 1
+
+            # Clean write call: a semantic-equality GET, no write (the
+            # daemon's fast path skips clean passes before reaching the
+            # sink at all; an explicit write call must still probe the
+            # server so chaos/forced-slow passes keep outage visibility).
+            out = diff.write(request, labels)
+            assert out.ok
+            assert out.gets == 1
+            assert out.patches + out.puts + out.posts == 0
+
+            methods = [m for m, _ in server.requests]
+            assert methods == ["GET", "POST", "PATCH", "GET"]
+            stored = server.store[("ns", "tfd-features-for-n1")]
+            assert stored["spec"]["labels"][
+                "google.com/tpu.count"] == "8"
+            assert stored["metadata"]["labels"][
+                sink.NODE_NAME_LABEL] == "n1"
+
+    def test_conflict_costs_exactly_one_extra_get(self):
+        with FakeApiServer() as server:
+            request = wire_request(server)
+            diff = sink.DiffSink("n1", "ns")
+            assert diff.write(request, {"k": "1"}).ok
+            # A foreign writer moves the CR: our cached rv goes stale.
+            status, _, _ = api(
+                server, "PATCH",
+                "/apis/nfd.k8s-sigs.io/v1alpha1/namespaces/ns/"
+                "nodefeatures/tfd-features-for-n1",
+                {"spec": {"labels": {"foreign": "x"}}},
+                content_type=sink.MERGE_PATCH_CONTENT_TYPE)
+            assert status == 200
+            del server.requests[:]
+            out = diff.write(request, {"k": "2"})
+            assert out.ok
+            methods = [m for m, _ in server.requests]
+            assert methods == ["PATCH", "GET", "PATCH"]  # 409 -> re-GET
+            # The re-diff reconciled against the moved content: OUR key
+            # updated, and the foreign spec.labels key REMOVED — the
+            # daemon owns spec.labels wholesale, exactly like the
+            # reference full-update path (golden equivalence demands
+            # the diff sink converge to the same bytes).
+            stored = server.store[("ns", "tfd-features-for-n1")]
+            assert stored["spec"]["labels"] == {"k": "2"}
+
+    def test_foreign_non_string_value_healed_by_wholesale_put(self):
+        """C++ parity (unit-pinned there too): a foreign non-string
+        spec.labels value is invisible to the string-map diff but must
+        still dirty the write and be healed by the wholesale-replace
+        PUT, like the reference full-update path."""
+        with FakeApiServer() as server:
+            request = wire_request(server)
+            diff = sink.DiffSink("n1", "ns")
+            assert diff.write(request, {"k": "v"}).ok
+            key = ("ns", "tfd-features-for-n1")
+            server.store[key]["spec"]["labels"]["junk"] = 123
+            diff.invalidate()  # anti-entropy reconcile
+            out = diff.write(request, {"k": "v"})
+            assert out.ok and out.puts == 1 and out.patches == 0
+            assert server.store[key]["spec"]["labels"] == {"k": "v"}
+
+    def test_415_falls_back_to_get_put(self):
+        with FakeApiServer() as server:
+            request = wire_request(server)
+            diff = sink.DiffSink("n1", "ns")
+            assert diff.write(request, {"k": "1"}).ok
+            server.set_patch_supported(False)
+            out = diff.write(request, {"k": "2"})
+            assert out.ok and out.puts == 1
+            assert diff.patch_unsupported
+            out = diff.write(request, {"k": "3"})
+            assert out.ok and out.patches == 0 and out.puts == 1
+
+    def test_golden_content_equivalence(self):
+        ok, detail = fleet_soak.golden_check(seed=8)
+        assert ok, detail
+
+
+class TestClusterInABoxSmoke:
+    def test_small_fleet_soak_passes(self, tmp_path):
+        """A 12-node, short-phase cluster-in-a-box run end to end: all
+        phases execute, the storm drains without breaker flap, golden
+        holds. (CI runs the full 1000-node soak as its own step.)"""
+        out = tmp_path / "fleet.json"
+        rc = fleet_soak.main([
+            "--nodes", "12", "--seed", "8", "--interval", "2",
+            "--refresh", "8", "--churn-secs", "4", "--steady-secs", "4",
+            "--storm-secs", "4", "--storm-capacity", "2",
+            "--json", str(out)])
+        assert rc == 0
+        record = json.loads(out.read_text())
+        assert record["golden_equal"]
+        assert record["phases"]["storm"]["breaker_opens"] == 0
+        assert record["phases"]["storm"]["undrained_nodes"] == 0
+        # The baseline phases really did GET+PUT; the diff phases never
+        # PUT.
+        assert record["phases"]["baseline_churn"]["by_verb"].get("PUT")
+        for phase in ("diff_churn", "diff_steady"):
+            assert "PUT" not in record["phases"][phase]["by_verb"]
+
+
+class TestBreakerTwin:
+    def test_defer_and_open_close(self):
+        b = sink.Breaker(open_after=3, cooldown_s=30.0)
+        assert b.allow(0.0)
+        b.defer(7.0, 0.0)
+        assert not b.allow(5.0)  # deferred while closed
+        assert b.state == b.CLOSED
+        assert b.allow(7.5)
+        b.record_transient_failure(8.0)
+        b.record_transient_failure(9.0)
+        assert b.state == b.CLOSED
+        b.record_transient_failure(10.0)
+        assert b.state == b.OPEN
+        assert not b.allow(11.0)
+        assert b.allow(41.0)  # cooldown elapsed: half-open probe
+        b.record_success()
+        assert b.state == b.CLOSED
+        assert b.opens() == 1

@@ -98,6 +98,40 @@ def test_repairs_missing_node_name_label(tfd_binary, tmp_path):
                 ["nfd.node.kubernetes.io/node-name"] == "tpu-node-1")
 
 
+def test_sink_patch_flag_controls_write_verb(tfd_binary, tmp_path):
+    """--sink-patch (default true) sends label changes as a merge PATCH;
+    --sink-patch=false restores the reference GET+full-PUT flow. Both
+    must converge to the same stored CR content."""
+    with FakeApiServer(token="sekrit") as server:
+        env = {
+            "NODE_NAME": "tpu-node-1",
+            "TFD_APISERVER_URL": server.url,
+            "TFD_SERVICEACCOUNT_DIR": str(sa_dir(tmp_path, "sekrit")),
+        }
+        args = nf_args() + ["--no-timestamp"]
+        code, _, err = run_tfd(tfd_binary, args, env=env)
+        assert code == 0, err
+        key = ("node-feature-discovery", "tfd-features-for-tpu-node-1")
+
+        # Dirty the CR so the next runs have something to write.
+        server.store[key]["spec"]["labels"]["google.com/tpu.count"] = "99"
+        del server.requests[:]
+        code, _, err = run_tfd(tfd_binary, args, env=env)
+        assert code == 0, err
+        verbs = [m for m, _ in server.requests]
+        assert "PATCH" in verbs and "PUT" not in verbs
+        patched = dict(server.store[key]["spec"]["labels"])
+
+        server.store[key]["spec"]["labels"]["google.com/tpu.count"] = "99"
+        del server.requests[:]
+        code, _, err = run_tfd(tfd_binary, args + ["--sink-patch=false"],
+                               env=env)
+        assert code == 0, err
+        verbs = [m for m, _ in server.requests]
+        assert "PUT" in verbs and "PATCH" not in verbs
+        assert dict(server.store[key]["spec"]["labels"]) == patched
+
+
 def test_auth_failure(tfd_binary, tmp_path):
     with FakeApiServer(token="sekrit") as server:
         code, _, err = run_tfd(tfd_binary, nf_args(), env={

@@ -4,46 +4,125 @@ Implements just the NFD CR surface the daemon talks to:
   GET    /apis/nfd.k8s-sigs.io/v1alpha1/namespaces/{ns}/nodefeatures/{name}
   POST   /apis/nfd.k8s-sigs.io/v1alpha1/namespaces/{ns}/nodefeatures
   PUT    /apis/nfd.k8s-sigs.io/v1alpha1/namespaces/{ns}/nodefeatures/{name}
-with in-memory storage, resourceVersion bumping, and optional bearer-token
-enforcement. Supports plain HTTP and TLS (pass certfile/keyfile).
+  PATCH  /apis/nfd.k8s-sigs.io/v1alpha1/namespaces/{ns}/nodefeatures/{name}
+with in-memory storage, resourceVersion bumping, JSON-merge-patch
+(RFC 7386) semantics with the resourceVersion-precondition 409, optional
+bearer-token enforcement, 429/Retry-After throttling (a fixed capacity
+per second, or an injected storm), and optional TLS (certfile/keyfile).
+
+HTTP/1.1 with keep-alive: the cluster-in-a-box fleet soak drives ~1000
+simulated daemons through persistent connections; one thread per
+connection instead of one per request is what makes that feasible.
 """
 
+import copy
 import json
 import ssl
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 PREFIX = "/apis/nfd.k8s-sigs.io/v1alpha1/namespaces/"
+MERGE_PATCH = "application/merge-patch+json"
+
+
+def merge_patch(target, patch):
+    """RFC 7386 JSON merge patch, in place on `target` (a dict)."""
+    for key, value in patch.items():
+        if value is None:
+            target.pop(key, None)
+        elif isinstance(value, dict):
+            if not isinstance(target.get(key), dict):
+                target[key] = {}
+            merge_patch(target[key], value)
+        else:
+            target[key] = value
+    return target
 
 
 class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"  # keep-alive for the fleet soak
+
     store = None  # type: dict
     token = None
     lock = None
     requests = None  # type: list  # (method, path) per handled request
+    timeline = None  # type: list  # (monotonic_t, method, status)
     # When truthy, every CR request gets this HTTP status before touching
     # the store — apiserver outage injection (5xx reads as transient to
     # the daemon, which stays alive and flips /readyz once rewrites go
-    # stale; see FakeApiServer.set_failing).
+    # stale; see FakeApiServer.set_failing). failing_retry_after rides a
+    # Retry-After header on the injected status; failing_apf adds the
+    # API-Priority-and-Fairness attribution headers a real apiserver
+    # sends on a priority-level rejection.
     failing = 0
+    failing_retry_after = None
+    failing_apf = False
+    # Requests-per-second capacity: above it every CR request answers
+    # 429 + Retry-After until the next second's bucket (0 = unlimited).
+    capacity = 0
+    cap_bucket = None  # type: list  # [epoch_second, count]
+    # When False, PATCH answers 415 — an apiserver predating merge-patch
+    # support on this resource; the client must fall back to GET+PUT.
+    patch_supported = True
 
     def _check_auth(self):
         if self.token is None:
             return True
         return self.headers.get("Authorization") == f"Bearer {self.token}"
 
-    def _reply(self, code, obj=None):
+    def _reply(self, code, obj=None, headers=None):
         # Request log BEFORE the response: a no-op daemon pass (GET,
         # compare, skip the PUT) is otherwise invisible server-side, and
         # the soak harness counts passes by watching this stream.
         with self.lock:
             self.requests.append((self.command, self.path))
+            self.timeline.append((time.monotonic(), self.command, code))
         body = json.dumps(obj).encode() if obj is not None else b"{}"
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for key, value in (headers or {}).items():
+            self.send_header(key, value)
         self.end_headers()
         self.wfile.write(body)
+
+    def _apf_headers(self):
+        return {
+            "X-Kubernetes-PF-FlowSchema-UID": "fake-flow-schema",
+            "X-Kubernetes-PF-PriorityLevel-UID": "fake-priority-level",
+        }
+
+    def _gate(self):
+        """Outage / throttle gate shared by every verb. Returns True when
+        the request was already answered (injected failure or 429)."""
+        if self.failing:
+            headers = {}
+            if self.failing_retry_after is not None:
+                headers["Retry-After"] = str(self.failing_retry_after)
+            if self.failing_apf:
+                headers.update(self._apf_headers())
+            self._reply(self.failing, {"message": "injected outage"},
+                        headers=headers)
+            return True
+        if self.capacity:
+            now = time.monotonic()
+            with self.lock:
+                bucket = int(now)
+                if self.cap_bucket[0] != bucket:
+                    self.cap_bucket[0] = bucket
+                    self.cap_bucket[1] = 0
+                self.cap_bucket[1] += 1
+                over = self.cap_bucket[1] > self.capacity
+            if over:
+                self._reply(429, {"message": "too many requests"},
+                            headers={"Retry-After": "1",
+                                     **self._apf_headers()})
+                return True
+        if not self._check_auth():
+            self._reply(401, {"message": "unauthorized"})
+            return True
+        return False
 
     def _parse(self):
         if not self.path.startswith(PREFIX):
@@ -55,11 +134,19 @@ class _Handler(BaseHTTPRequestHandler):
             return parts[0], name
         return None, None
 
+    def _body(self):
+        """Consumes and parses the request body. Body-carrying verbs MUST
+        call this before any early reply (429 gate, 415, 409): with
+        HTTP/1.1 keep-alive an unread body stays in the socket and gets
+        parsed as the NEXT request line, answering every later request
+        on the connection with a bogus 501."""
+        length = int(self.headers.get("Content-Length", "0"))
+        raw = self.rfile.read(length)
+        return json.loads(raw) if raw else {}
+
     def do_GET(self):  # noqa: N802
-        if self.failing:
-            return self._reply(self.failing, {"message": "injected outage"})
-        if not self._check_auth():
-            return self._reply(401, {"message": "unauthorized"})
+        if self._gate():
+            return None
         ns, name = self._parse()
         if ns is None or name is None:
             return self._reply(404, {"message": "not found"})
@@ -70,15 +157,12 @@ class _Handler(BaseHTTPRequestHandler):
         return self._reply(200, obj)
 
     def do_POST(self):  # noqa: N802
-        if self.failing:
-            return self._reply(self.failing, {"message": "injected outage"})
-        if not self._check_auth():
-            return self._reply(401, {"message": "unauthorized"})
+        obj = self._body()  # consume before ANY reply (keep-alive framing)
+        if self._gate():
+            return None
         ns, name = self._parse()
         if ns is None or name is not None:
             return self._reply(404, {"message": "not found"})
-        length = int(self.headers.get("Content-Length", "0"))
-        obj = json.loads(self.rfile.read(length))
         obj_name = obj.get("metadata", {}).get("name")
         with self.lock:
             if (ns, obj_name) in self.store:
@@ -88,15 +172,12 @@ class _Handler(BaseHTTPRequestHandler):
         return self._reply(201, obj)
 
     def do_PUT(self):  # noqa: N802
-        if self.failing:
-            return self._reply(self.failing, {"message": "injected outage"})
-        if not self._check_auth():
-            return self._reply(401, {"message": "unauthorized"})
+        obj = self._body()  # consume before ANY reply (keep-alive framing)
+        if self._gate():
+            return None
         ns, name = self._parse()
         if ns is None or name is None:
             return self._reply(404, {"message": "not found"})
-        length = int(self.headers.get("Content-Length", "0"))
-        obj = json.loads(self.rfile.read(length))
         with self.lock:
             existing = self.store.get((ns, name))
             if existing is None:
@@ -107,6 +188,40 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._reply(409, {"message": "conflict"})
             obj["metadata"]["resourceVersion"] = str(int(current_rv) + 1)
             self.store[(ns, name)] = obj
+        return self._reply(200, obj)
+
+    def do_PATCH(self):  # noqa: N802
+        patch = self._body()  # consume before ANY reply (keep-alive framing)
+        if self._gate():
+            return None
+        ns, name = self._parse()
+        if ns is None or name is None:
+            return self._reply(404, {"message": "not found"})
+        content_type = (self.headers.get("Content-Type") or "").split(";")[0]
+        if not self.patch_supported or content_type.strip() != MERGE_PATCH:
+            return self._reply(
+                415, {"message": f"unsupported patch type {content_type}"})
+        with self.lock:
+            existing = self.store.get((ns, name))
+            if existing is None:
+                return self._reply(404, {"message": "not found"})
+            current_rv = existing["metadata"]["resourceVersion"]
+            # metadata.resourceVersion in a merge patch is an
+            # optimistic-concurrency PRECONDITION (as on a real
+            # apiserver), never content: check it, then strip it so the
+            # merge can't persist a stale version string.
+            patch = copy.deepcopy(patch)
+            sent_rv = (patch.get("metadata") or {}).pop(
+                "resourceVersion", None)
+            if sent_rv is not None and sent_rv != current_rv:
+                return self._reply(409, {"message": "conflict"})
+            if patch.get("metadata") == {}:
+                del patch["metadata"]
+            merge_patch(existing, patch)
+            existing["metadata"]["resourceVersion"] = str(
+                int(current_rv) + 1)
+            self.store[(ns, name)] = existing
+            obj = copy.deepcopy(existing)
         return self._reply(200, obj)
 
     def log_message(self, *args):
@@ -120,9 +235,12 @@ class FakeApiServer:
         # store — a plain Lock would deadlock every 409/404 reply.
         handler = type("Handler", (_Handler,), {
             "store": {}, "token": token, "lock": threading.RLock(),
-            "requests": [], "failing": 0})
+            "requests": [], "timeline": [], "failing": 0,
+            "failing_retry_after": None, "failing_apf": False,
+            "capacity": 0, "cap_bucket": [0, 0], "patch_supported": True})
         self.store = handler.store
         self.requests = handler.requests
+        self.timeline = handler.timeline
         self._handler = handler
         self._server = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.tls = certfile is not None
@@ -145,12 +263,28 @@ class FakeApiServer:
         self._thread.join(timeout=5)
         return False
 
-    def set_failing(self, status=500):
+    def set_failing(self, status=500, retry_after=None, apf=False):
         """Starts (status truthy) or stops (0/None) an injected outage:
         every subsequent CR request is answered with `status` and never
         touches the store. 5xx/429 are what the daemon treats as
-        transient — it logs, stays alive, and retries next interval."""
+        transient — it logs, stays alive, and retries next interval.
+        `retry_after` (seconds) rides a Retry-After header, `apf` adds
+        the X-Kubernetes-PF-* attribution headers — together they drive
+        the daemon's adaptive backoff."""
         self._handler.failing = status or 0
+        self._handler.failing_retry_after = retry_after
+        self._handler.failing_apf = apf
+
+    def set_capacity(self, per_second):
+        """Caps CR requests per wall-clock second; the overflow answers
+        429 + Retry-After: 1 with APF headers (0 = unlimited). The fleet
+        soak's 429-storm phase uses this to prove the herd drains."""
+        self._handler.capacity = per_second or 0
+
+    def set_patch_supported(self, supported):
+        """False: PATCH answers 415 — exercises the client's GET+PUT
+        fallback against an apiserver without merge-patch support."""
+        self._handler.patch_supported = bool(supported)
 
     @property
     def url(self):

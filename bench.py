@@ -408,6 +408,12 @@ def tpu_probe_numbers():
         hbm_pct = health.pct_of_rated(gbps, family, health.RATED_HBM_GBPS)
         if matmul_pct is not None:
             out["tpu_matmul_pct_of_rated"] = matmul_pct
+            # Always the fresh in-process numerator; the amortized
+            # characterization path records its own perf_pct_of_rated /
+            # perf_restored_pct_of_rated keys whose *_source fields say
+            # "inprocess-probe" vs "state-restored" (perf_record), so a
+            # BENCH record can always tell a cached characterization
+            # from a fresh measurement.
             out["pct_of_rated_source"] = "inprocess-probe"
         if hbm_pct is not None:
             out["tpu_hbm_pct_of_rated"] = hbm_pct
@@ -586,6 +592,140 @@ def steady_state_record():
     return out
 
 
+def perf_record():
+    """The ISSUE 9 amortization metrics, hermetic (mock backend + a
+    millisecond fake measurement exec):
+
+      perf_noop_p50_us            steady no-op pass p50 WITH the perf
+                                  source enabled (gated <= 1000us by
+                                  bench_gate --perf: characterization
+                                  must not tax the hot path);
+      perf_measure_rounds         measurement execs journaled across the
+                                  steady soak (the amortization
+                                  contract: exactly 1);
+      perf_restore_ms             warm-restart perf-section restore
+                                  latency after kill -9 (gated <= 15ms);
+      perf_restored_measure_rounds  measurements after the restart
+                                  (must be 0: the restored
+                                  characterization is trusted).
+
+    pct-of-rated provenance is recorded NEXT TO each value, so a BENCH
+    record can always tell a cached characterization from a fresh one:
+    `perf_pct_of_rated` carries perf_pct_of_rated_source=
+    "inprocess-probe" (the soak's own measurement round produced it),
+    while `perf_restored_pct_of_rated` carries "state-restored" (served
+    from the warm-restarted state file with zero re-measurement). The
+    headline tpu_*_pct_of_rated keys remain pinned to the real-TPU
+    in-process probe (tpu_probe_numbers) and never mix with these."""
+    import urllib.request
+
+    if str(REPO) not in sys.path:
+        sys.path.insert(0, str(REPO))
+    from tpufd.fakes import free_loopback_port
+
+    out = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        tmp_path = Path(tmp)
+        fixture = REPO / "tests/fixtures/v2-8.yaml"
+        count = tmp_path / "count"
+        values = tmp_path / "values.txt"
+        values.write_text("matmul-tflops=44\nhbm-gbps=630\nici-gbps=40\n")
+        script = tmp_path / "exec.sh"
+        script.write_text(f"echo run >> {count}\ncat {values}\n")
+        out_file = tmp_path / "tfd"
+
+        def argv(port):
+            return [str(BINARY), "--sleep-interval=1s", "--backend=mock",
+                    f"--mock-topology-file={fixture}",
+                    "--machine-type-file=/dev/null",
+                    f"--output-file={out_file}",
+                    f"--state-file={tmp_path / 'state'}",
+                    "--journal-capacity=2048",
+                    "--perf-characterize", f"--perf-exec=sh {script}",
+                    "--perf-duty-cycle-pct=50",
+                    f"--introspection-addr=127.0.0.1:{port}"]
+
+        def get(port, path):
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}{path}", timeout=2) as r:
+                    return r.read().decode()
+            except OSError:
+                return None
+
+        def events(port, kind):
+            body = get(port, f"/debug/journal?n=4096&type={kind}")
+            return json.loads(body)["events"] if body else []
+
+        def wait_rewrites(port, proc, n, deadline_s=60):
+            deadline = time.monotonic() + deadline_s
+            while time.monotonic() < deadline:
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"perf bench daemon died rc={proc.returncode}")
+                text = get(port, "/metrics")
+                if text:
+                    for line in text.splitlines():
+                        if line.startswith("tfd_rewrites_total "):
+                            if float(line.split()[1]) >= n:
+                                return
+                            break
+                time.sleep(0.25)
+            raise RuntimeError(f"never reached {n} rewrites")
+
+        def pct_label():
+            try:
+                labels = dict(
+                    line.split("=", 1)
+                    for line in out_file.read_text().splitlines() if line)
+                value = labels.get("google.com/tpu.perf.pct-of-rated")
+                return float(value) if value is not None else None
+            except OSError:
+                return None
+
+        port = free_loopback_port()
+        proc = subprocess.Popen(argv(port), env=dict(HERMETIC_ENV),
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+        try:
+            wait_rewrites(port, proc, 12)
+            noop_us = [float(e["fields"]["duration_us"])
+                       for e in events(port, "pass-shortcircuit")]
+            if not noop_us:
+                raise RuntimeError("no pass-shortcircuit events journaled")
+            out["perf_noop_p50_us"] = round(statistics.median(noop_us), 1)
+            out["perf_measure_rounds"] = len(events(port, "perf-measure"))
+            pct = pct_label()
+            if pct is not None:
+                out["perf_pct_of_rated"] = pct
+                out["perf_pct_of_rated_source"] = "inprocess-probe"
+            proc.send_signal(9)  # SIGKILL: the warm-restart drill
+            proc.wait(timeout=10)
+
+            port = free_loopback_port()
+            proc = subprocess.Popen(argv(port), env=dict(HERMETIC_ENV),
+                                    stdout=subprocess.DEVNULL,
+                                    stderr=subprocess.DEVNULL)
+            wait_rewrites(port, proc, 2, deadline_s=30)
+            restored = events(port, "perf-restored")
+            if not restored:
+                raise RuntimeError("perf characterization not restored "
+                                   "after kill -9")
+            out["perf_restore_ms"] = round(
+                float(restored[0]["fields"]["duration_us"]) / 1000.0, 3)
+            out["perf_restored_measure_rounds"] = len(
+                events(port, "perf-measure"))
+            pct = pct_label()
+            if pct is not None:
+                out["perf_restored_pct_of_rated"] = pct
+                out["perf_restored_pct_of_rated_source"] = "state-restored"
+        finally:
+            if proc.poll() is None:
+                proc.terminate()
+                proc.wait(timeout=30)
+    return out
+
+
 def soak_record():
     """Daemon steady-state proof via scripts/soak.py: N passes at 1s
     cadence with memory/fd/label-stability/clean-exit checks. Prefers the
@@ -734,6 +874,11 @@ def main():
     # Hot-path steady-state metrics (hermetic, mock backend): the no-op
     # fast-pass p50 and the forced-slow full-pass p50.
     record.update(steady_state_record())
+    # Amortized perf-characterization metrics (hermetic, mock backend).
+    try:
+        record.update(perf_record())
+    except Exception as e:  # noqa: BLE001 — bench must not die here
+        sys.stderr.write(f"perf bench skipped: {e}\n")
     # Daemon-mediated silicon probe FIRST: tpu_probe_numbers leaves an
     # in-process jax client holding the exclusive chip, which would
     # starve the daemon's exec'd probe.

@@ -49,6 +49,7 @@
 #include "tfd/obs/journal.h"
 #include "tfd/obs/metrics.h"
 #include "tfd/obs/server.h"
+#include "tfd/perf/perf.h"
 #include "tfd/platform/detect.h"
 #include "tfd/resource/factory.h"
 #include "tfd/sched/broker.h"
@@ -827,6 +828,27 @@ Status RenderLabels(
     }
   }
 
+  // Perf-characterization labels (perf/) ride in from the perf
+  // worker's snapshot the same way: measured-silicon claims are only
+  // merged while the SERVING backend actually touches devices — a
+  // metadata-only rung must not vouch for chip throughput.
+  if (config.flags.perf_characterize && manager->TouchesDevices() &&
+      merged->count(lm::kBackendLabel) > 0) {
+    sched::SourceView perf_view = store.View("perf");
+    if (perf_view.last_ok.has_value() &&
+        perf_view.tier != sched::Tier::kExpired) {
+      lm::LabelProvenance from;
+      from.labeler = "perf";
+      from.source = "perf";
+      from.tier = sched::TierName(perf_view.tier);
+      from.age_s = perf_view.age_s < 0 ? 0 : perf_view.age_s;
+      for (const auto& [k, v] : perf_view.last_ok->labels) {
+        (*merged)[k] = v;
+        (*provenance)[k] = from;
+      }
+    }
+  }
+
   // Degradation markers: cached/expired snapshots say so, with their
   // age, so a scheduler (or a human) can weigh the staleness. Fresh
   // serves — including the metadata-only rung — stay byte-identical to
@@ -1153,6 +1175,9 @@ void SaveStateAfterRewrite(const config::Config& config,
   // flapping source back to trusted.
   state.healthsm_json =
       healthsm::Default().SerializeJson(WallClockSeconds());
+  // So does the perf characterization (its own checksummed section):
+  // the amortization contract is that a restart re-measures NOTHING.
+  state.perf_json = perf::Default().SerializeJson();
   Status s = sched::SaveState(config.flags.state_file, state);
   if (!s.ok()) {
     TFD_LOG_WARNING << "state save failed (warm restart unavailable): "
@@ -1660,6 +1685,62 @@ void RestoreHealthState(const std::string& json, double now_wall,
       {{"quarantined", JoinStrings(quarantined, ",")}});
 }
 
+// Restores the persisted perf characterization (its own checksummed
+// schema section, validated independently of the label payload): a
+// valid section seeds perf::Default() so the perf source serves
+// tpu.perf.* labels with ZERO re-measurement; a torn/corrupt one is
+// rejected alone — the caller's label restore proceeds untouched — and
+// triggers exactly one fresh characterization. `origin` mirrors
+// RestoreHealthState's.
+void RestorePerfState(const std::string& json, const std::string& origin) {
+  if (json.empty()) return;  // pre-perf state file: nothing to restore
+  auto t0 = std::chrono::steady_clock::now();
+  Status restored = perf::Default().RestoreJson(json);
+  double us = obs::SecondsSince(t0) * 1e6;
+  if (!restored.ok()) {
+    obs::Default()
+        .GetCounter("tfd_perf_restores_total",
+                    "Perf-characterization state restores, by outcome.",
+                    {{"outcome", "rejected"}})
+        ->Inc();
+    obs::DefaultJournal().Record(
+        "perf-rejected", "perf",
+        "perf section rejected (one fresh characterization owed): " +
+            restored.message(),
+        {{"error", restored.message()}});
+    TFD_LOG_WARNING << "perf characterization section rejected ("
+                    << restored.message()
+                    << "); will characterize once from scratch";
+    return;
+  }
+  std::optional<perf::Characterization> c = perf::Default().Get();
+  obs::Default()
+      .GetCounter("tfd_perf_restores_total",
+                  "Perf-characterization state restores, by outcome.",
+                  {{"outcome", "restored"}})
+      ->Inc();
+  if (c.has_value()) {
+    // The gauge must reflect the class the node is actually publishing
+    // — which after the common zero-re-measurement boot comes from
+    // HERE, not from a measurement round (the next one is up to a
+    // whole recheck interval away).
+    obs::Default()
+        .GetGauge("tfd_perf_class",
+                  "Published performance class: 0 gold, 1 silver, "
+                  "2 degraded; -1 while no characterization is published.")
+        ->Set(c->class_rank);
+  }
+  obs::DefaultJournal().Record(
+      "perf-restored", "perf",
+      "perf characterization restored" + origin +
+          " with zero re-measurement (class " +
+          (c.has_value() ? perf::ClassName(c->class_rank) : "?") + ")",
+      {{"duration_us",
+        std::to_string(static_cast<long long>(us))},
+       {"fingerprint", c.has_value() ? c->fingerprint : ""},
+       {"class", c.has_value() ? perf::ClassName(c->class_rank) : ""}});
+}
+
 int Main(int argc, char** argv) {
   // Ignore SIGPIPE process-wide, explicitly at startup: the HTTP client
   // needs it (SSL_write cannot carry MSG_NOSIGNAL) and would otherwise
@@ -1864,9 +1945,10 @@ int Main(int argc, char** argv) {
                              ? flags.snapshot_usable_for_s
                              : 10.0 * flags.sleep_interval_s;
       std::string stale_healthsm_json;
+      std::string stale_perf_json;
       Result<sched::PersistedState> restored = sched::LoadState(
           flags.state_file, sched::NodeIdentity(), max_age_s,
-          WallClockSeconds(), &stale_healthsm_json);
+          WallClockSeconds(), &stale_healthsm_json, &stale_perf_json);
       if (restored.ok()) {
         double now_wall = WallClockSeconds();
         double downtime_s = now_wall - restored->saved_at;
@@ -1888,6 +1970,15 @@ int Main(int argc, char** argv) {
         // flapping source's keys and keep its annotation — a crash
         // must not launder it back to trusted.
         RestoreHealthState(restored->healthsm_json, now_wall, "");
+        // Only when the feature is ON: restoring a leftover perf
+        // section on a --perf-characterize=false daemon would journal
+        // perf-restored, set the class gauge, and re-persist the
+        // section forever — all while publishing no perf labels.
+        // Disabling the feature discards the characterization; turning
+        // it back on re-characterizes once.
+        if (flags.perf_characterize) {
+          RestorePerfState(restored->perf_json, "");
+        }
         ServeRestored(loaded.config, *restored, restored->age_s,
                       downtime_s, "warm-restart", server.get(),
                       &sink_breaker, &label_governor, &label_state);
@@ -1910,6 +2001,14 @@ int Main(int argc, char** argv) {
         // trusted.
         RestoreHealthState(stale_healthsm_json, WallClockSeconds(),
                            " from stale state file");
+        // The characterization outlives the label payload's age gate:
+        // its validity is the hardware fingerprint, not time — a crash
+        // loop longer than the snapshot window must not force a
+        // re-measurement of unchanged silicon. (Feature-gated like the
+        // warm path: a disabled daemon discards it.)
+        if (flags.perf_characterize) {
+          RestorePerfState(stale_perf_json, " from stale state file");
+        }
       }
     }
 

@@ -22,11 +22,21 @@ aggregate steady-state QPS reduction vs the GET+PUT baseline (absolute
 >= 5x), the worst 1-second burst bucket (<= 10% of the fleet), and the
 steady QPS / churn p99 regressions against the committed BENCH_r08.json.
 
+Perf mode (ISSUE 9): `--perf` runs bench.perf_record() — the hermetic
+amortized-characterization scenario — and gates (a) the steady-state
+no-op p50 WITH the perf source enabled (<= --noop-budget-us absolute:
+characterization must not tax the fast path), (b) warm-restart perf
+restore <= 15 ms with ZERO measurements journaled after the kill -9,
+(c) exactly one measurement round across the steady soak, and (d) the
+no-op p50 against the committed BENCH_r09.json reference (+ slack).
+
 Usage:
   python3 scripts/bench_gate.py [--reference BENCH_r07.json]
       [--noop-budget-us 1000] [--dirty-slack 0.25]
   python3 scripts/bench_gate.py --fleet fleet.json
       [--fleet-reference BENCH_r08.json] [--fleet-slack 0.5]
+  python3 scripts/bench_gate.py --perf
+      [--perf-reference BENCH_r09.json] [--perf-restore-budget-ms 15]
 """
 
 import argparse
@@ -93,6 +103,64 @@ def fleet_gate(record_path, reference_path, slack):
     return problems
 
 
+def perf_gate(record, reference_path, noop_budget_us, restore_budget_ms,
+              slack):
+    """Gates a bench.perf_record() result: the amortization acceptance
+    bounds plus regression vs the committed BENCH_r09.json. Returns a
+    problem list (empty = pass). Absent keys FAIL loudly — a
+    partially-run scenario must not sail through on defaults."""
+    problems = []
+    noop = record.get("perf_noop_p50_us")
+    if noop is None:
+        problems.append("perf_noop_p50_us could not be measured")
+    elif noop > noop_budget_us:
+        problems.append(
+            f"no-op pass p50 {noop}us with the perf source enabled "
+            f"exceeds the {noop_budget_us}us budget — characterization "
+            "is taxing the fast path")
+    rounds = record.get("perf_measure_rounds")
+    if rounds is None:
+        problems.append("perf_measure_rounds missing")
+    elif rounds != 1:
+        problems.append(
+            f"{rounds} measurement rounds across the steady soak "
+            "(amortization contract: exactly 1)")
+    restore = record.get("perf_restore_ms")
+    if restore is None:
+        problems.append("perf_restore_ms could not be measured")
+    elif restore > restore_budget_ms:
+        problems.append(
+            f"warm-restart perf restore {restore}ms exceeds the "
+            f"{restore_budget_ms}ms budget")
+    restored_rounds = record.get("perf_restored_measure_rounds")
+    if restored_rounds is None:
+        problems.append("perf_restored_measure_rounds missing")
+    elif restored_rounds != 0:
+        problems.append(
+            f"{restored_rounds} measurement(s) journaled after the "
+            "kill -9 restore (must be 0: the restored characterization "
+            "was not trusted)")
+    if record.get("perf_restored_pct_of_rated_source") != "state-restored":
+        problems.append(
+            "restored pct-of-rated provenance is not 'state-restored' "
+            "(cached vs fresh characterization indistinguishable)")
+    try:
+        with open(reference_path) as f:
+            doc = json.load(f)
+        ref = doc.get("parsed", doc).get("perf_noop_p50_us")
+    except (OSError, ValueError) as e:
+        problems.append(f"perf reference {reference_path} unreadable: {e}")
+        ref = None
+    if ref is not None and noop is not None:
+        ceiling = ref * (1.0 + slack)
+        if noop > ceiling:
+            problems.append(
+                f"perf-enabled no-op p50 {noop}us regressed past "
+                f"{ceiling:.1f}us (reference {ref}us "
+                f"+{int(slack * 100)}%)")
+    return problems
+
+
 def reference_dirty_p50_ms(path):
     """steady_dirty_p50_ms from a committed bench record (either the
     bare record or the driver's {parsed: ...} wrapper)."""
@@ -117,7 +185,37 @@ def main(argv=None):
     # Wider than the local bench's slack: the fleet numbers ride a
     # shared CI box through ~3000 real HTTP requests.
     ap.add_argument("--fleet-slack", type=float, default=0.5)
+    ap.add_argument("--perf", action="store_true",
+                    help="run and gate the amortized perf-"
+                         "characterization scenario (bench.perf_record)")
+    ap.add_argument("--perf-reference",
+                    default=os.path.join(repo, "BENCH_r09.json"))
+    ap.add_argument("--perf-restore-budget-ms", type=float, default=15.0)
+    # Wider than the dirty-pass slack: the gated number is a
+    # sub-millisecond p50 on a shared CI box, and the 1000us absolute
+    # budget is the load-bearing gate.
+    ap.add_argument("--perf-slack", type=float, default=1.0)
     args = ap.parse_args(argv)
+
+    if args.perf:
+        import bench
+
+        bench.ensure_built()
+        record = bench.perf_record()
+        print(json.dumps(record))
+        problems = perf_gate(record, args.perf_reference,
+                             args.noop_budget_us,
+                             args.perf_restore_budget_ms, args.perf_slack)
+        if problems:
+            for p in problems:
+                print(f"perf bench gate FAILED: {p}", file=sys.stderr)
+            return 1
+        print(f"perf bench gate OK: noop p50 "
+              f"{record.get('perf_noop_p50_us')}us <= "
+              f"{args.noop_budget_us}us with the perf source enabled, "
+              f"restore {record.get('perf_restore_ms')}ms <= "
+              f"{args.perf_restore_budget_ms}ms with zero re-measures")
+        return 0
 
     if args.fleet:
         problems = fleet_gate(args.fleet, args.fleet_reference,

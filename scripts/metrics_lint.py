@@ -117,6 +117,17 @@ CONDITIONAL = {
     "tfd_sink_patch_bytes",
     "tfd_sink_deferrals_total",
     "tfd_sink_outages_total",
+    # Perf characterization (ISSUE 9): config-gated behind
+    # --perf-characterize (off on this hermetic boot); restores/
+    # rejections additionally need a state file carrying a perf
+    # section, deferrals an exhausted duty budget, class changes a
+    # re-measure that moved the debounced class.
+    "tfd_perf_measures_total",
+    "tfd_perf_measure_duration_seconds",
+    "tfd_perf_class",
+    "tfd_perf_class_changes_total",
+    "tfd_perf_deferrals_total",
+    "tfd_perf_restores_total",
 }
 
 

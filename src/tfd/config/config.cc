@@ -264,6 +264,75 @@ std::vector<FlagDef> MakeFlagDefs(Flags* f) {
                   [f](const std::string& v) {
                     return SetDuration(&f->health_exec_interval_s, v);
                   }});
+  defs.push_back({"perf-characterize",
+                  {"TFD_PERF_CHARACTERIZE"},
+                  "perfCharacterize",
+                  "publish measured google.com/tpu.perf.* class labels "
+                  "(matmul-tflops/hbm-gbps/ici-gbps/pct-of-rated/"
+                  "class=gold|silver|degraded) from micro-benchmarks run "
+                  "ONCE per hardware fingerprint, persisted in "
+                  "--state-file and restored on boot with zero "
+                  "re-measurement",
+                  true,
+                  [f](const std::string& v) {
+                    return SetBool(&f->perf_characterize, v);
+                  }});
+  defs.push_back({"perf-exec",
+                  {"TFD_PERF_EXEC"},
+                  "perfExec",
+                  "characterization measurement command; prints "
+                  "matmul-tflops=/hbm-gbps=/ici-gbps= lines to stdout "
+                  "(runs device-exclusive)",
+                  false,
+                  [f](const std::string& v) {
+                    return SetString(&f->perf_exec, v);
+                  }});
+  defs.push_back({"perf-exec-timeout",
+                  {"TFD_PERF_EXEC_TIMEOUT"},
+                  "perfExecTimeout",
+                  "deadline for the perf measurement exec (e.g. 300s)",
+                  false,
+                  [f](const std::string& v) {
+                    return SetDuration(&f->perf_exec_timeout_s, v);
+                  }});
+  defs.push_back({"perf-recheck-interval",
+                  {"TFD_PERF_RECHECK_INTERVAL"},
+                  "perfRecheckInterval",
+                  "re-verification cadence for a VALID cached "
+                  "characterization (hours by design, e.g. 6h; a "
+                  "fingerprint change re-characterizes regardless)",
+                  false,
+                  [f](const std::string& v) {
+                    return SetDuration(&f->perf_recheck_interval_s, v);
+                  }});
+  defs.push_back({"perf-duty-cycle-pct",
+                  {"TFD_PERF_DUTY_CYCLE_PCT"},
+                  "perfDutyCyclePct",
+                  "duty-cycle bound on characterization: after a "
+                  "measurement of D seconds the next may not start for "
+                  "D*(100/pct - 1)s, so measurement never consumes more "
+                  "than pct% of wall-clock TPU time (1..100)",
+                  false,
+                  [f](const std::string& v) {
+                    int parsed = 0;
+                    if (!ParseNonNegInt(TrimSpace(v), &parsed)) {
+                      return Status::Error("perf-duty-cycle-pct must be "
+                                           "an integer 1..100");
+                    }
+                    f->perf_duty_cycle_pct = parsed;
+                    return Status::Ok();
+                  }});
+  defs.push_back({"rated-specs-file",
+                  {"TFD_RATED_SPECS_FILE"},
+                  "ratedSpecsFile",
+                  "override the baked-in per-family rated TFLOPS/GBps "
+                  "table with this rated_specs.json (same format as the "
+                  "checked-in tpufd/rated_specs.json); '' uses the baked "
+                  "copy",
+                  false,
+                  [f](const std::string& v) {
+                    return SetString(&f->rated_specs_file, v);
+                  }});
   defs.push_back({"health-flap-window",
                   {"TFD_HEALTH_FLAP_WINDOW"},
                   "healthFlapWindow",
@@ -793,6 +862,20 @@ Result<LoadResult> Load(int argc, char** argv) {
   if (f->sleep_interval_s < 1) {
     return Result<LoadResult>::Error("sleep-interval must be >= 1s");
   }
+  if (f->perf_exec_timeout_s < 1) {
+    return Result<LoadResult>::Error("perf-exec-timeout must be >= 1s");
+  }
+  if (f->perf_recheck_interval_s < 1) {
+    return Result<LoadResult>::Error("perf-recheck-interval must be >= 1s");
+  }
+  if (f->perf_duty_cycle_pct < 1 || f->perf_duty_cycle_pct > 100) {
+    return Result<LoadResult>::Error(
+        "perf-duty-cycle-pct must be between 1 and 100");
+  }
+  if (f->perf_characterize && f->perf_exec.empty()) {
+    return Result<LoadResult>::Error(
+        "perf-characterize needs a non-empty perf-exec");
+  }
   if (f->snapshot_usable_for_s < 0) {
     return Result<LoadResult>::Error("snapshot-usable-for must be >= 0s");
   }
@@ -881,6 +964,12 @@ std::string ToJson(const Config& config) {
       << ",\"healthExec\":" << jstr(f.health_exec)
       << ",\"healthExecTimeout\":\"" << f.health_exec_timeout_s << "s\""
       << ",\"healthExecInterval\":\"" << f.health_exec_interval_s << "s\""
+      << ",\"perfCharacterize\":" << (f.perf_characterize ? "true" : "false")
+      << ",\"perfExec\":" << jstr(f.perf_exec)
+      << ",\"perfExecTimeout\":\"" << f.perf_exec_timeout_s << "s\""
+      << ",\"perfRecheckInterval\":\"" << f.perf_recheck_interval_s << "s\""
+      << ",\"perfDutyCyclePct\":" << f.perf_duty_cycle_pct
+      << ",\"ratedSpecsFile\":" << jstr(f.rated_specs_file)
       << ",\"healthFlapWindow\":\"" << f.health_flap_window_s << "s\""
       << ",\"healthFlapThreshold\":" << f.health_flap_threshold
       << ",\"quarantineCooldown\":\"" << f.quarantine_cooldown_s << "s\""

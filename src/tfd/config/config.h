@@ -113,6 +113,34 @@ struct Flags {
   // is cached and re-measured only this often, so the probe never runs
   // once per sleep-interval.
   int health_exec_interval_s = 3600;
+  // Cached perf characterization (perf/): publish measured
+  // google.com/tpu.perf.* class labels (matmul-tflops, hbm-gbps,
+  // ici-gbps, pct-of-rated, class=gold|silver|degraded) from
+  // micro-benchmarks run ONCE per hardware-identity fingerprint
+  // (family/chips/topology/libtpu), persisted in --state-file and
+  // restored on boot with zero re-measurement.
+  bool perf_characterize = false;
+  // Command for the characterization measurement; must print
+  // "matmul-tflops=<n>" / "hbm-gbps=<n>" / "ici-gbps=<n>" lines to
+  // stdout and exit 0. Runs device-exclusive (broker serialization).
+  std::string perf_exec = "python3 -m tpufd perfmodel";
+  // Sized like the health exec: jax init + median-of-3 matmul/HBM/ICI
+  // probes on a tunneled v5e, with transport headroom.
+  int perf_exec_timeout_s = 300;
+  // Re-VERIFICATION cadence for a valid cached characterization
+  // (hours by design — measured throughput does not drift minute to
+  // minute; only a fingerprint change forces an early re-measure).
+  int perf_recheck_interval_s = 6 * 3600;
+  // Duty-cycle bound on characterization: after a measurement that
+  // took D seconds, the next may not start for D * (100/pct - 1)
+  // seconds, so characterization can never consume more than pct% of
+  // wall-clock TPU time regardless of recheck cadence or fingerprint
+  // churn (1..100).
+  int perf_duty_cycle_pct = 1;
+  // Optional override for the per-family rated-spec table (the
+  // checked-in tpufd/rated_specs.json format); empty uses the baked-in
+  // copy of the same table.
+  std::string rated_specs_file;
   // Anti-flap layer (healthsm/ + lm/governor): the sliding window for
   // flap counting AND the label governor's per-key hold-down period —
   // once a google.com/tpu.* key changes, it may not change again for

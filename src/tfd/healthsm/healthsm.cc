@@ -265,6 +265,67 @@ State HealthTracker::Observe(const std::string& key, bool ok,
   return entry.state;
 }
 
+int HealthTracker::ObserveClassRank(const std::string& key, int rank,
+                                    const std::string& fingerprint,
+                                    double now_s) {
+  std::lock_guard<std::mutex> lock(mu_);
+  Entry& entry = entries_[key];
+  if (entry.published_rank >= 0 && entry.rank_fingerprint != fingerprint) {
+    // The history describes different silicon (the rank state can
+    // outlive the perf cache — torn perf section, feature toggled off
+    // and on — across a hardware swap): void it rather than debounce
+    // the new chip's first verdict against the old chip's class.
+    entry.published_rank = -1;
+    entry.candidate_rank = -1;
+    entry.candidate_streak = 0;
+  }
+  entry.rank_fingerprint = fingerprint;
+  if (entry.published_rank < 0) {
+    // First characterization: publish immediately — there is no
+    // previous class to defend, and withholding the first verdict
+    // would leave the node classless for a whole debounce streak.
+    entry.published_rank = rank;
+    entry.candidate_rank = -1;
+    entry.candidate_streak = 0;
+    return rank;
+  }
+  if (rank == entry.published_rank) {
+    entry.candidate_rank = -1;  // agreement dissolves any streak
+    entry.candidate_streak = 0;
+    return entry.published_rank;
+  }
+  if (rank == entry.candidate_rank) {
+    entry.candidate_streak++;
+  } else {
+    entry.candidate_rank = rank;
+    entry.candidate_streak = 1;
+  }
+  const int needed = rank > entry.published_rank ? policy_.unhealthy_after
+                                                 : policy_.recover_after;
+  if (entry.candidate_streak < needed) return entry.published_rank;
+  entry.published_rank = rank;
+  entry.candidate_rank = -1;
+  entry.candidate_streak = 0;
+  // Deliberately NO NoteFlapLocked here: the published class is part
+  // of the source's content fingerprint (snapshot.cc keeps kPerfClass
+  // fingerprinted), so the broker's Observe() of the same probe round
+  // already registers the change as an unstable observation — one flap
+  // event per change. Noting it here too would double-count every
+  // legitimate, debounced class move and quarantine the source at
+  // HALF the configured threshold.
+  return rank;
+}
+
+void HealthTracker::ResetClassRank(const std::string& key) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = entries_.find(key);
+  if (it == entries_.end()) return;
+  it->second.published_rank = -1;
+  it->second.candidate_rank = -1;
+  it->second.candidate_streak = 0;
+  it->second.rank_fingerprint.clear();
+}
+
 State HealthTracker::StateOf(const std::string& key, double now_s) const {
   (void)now_s;
   std::lock_guard<std::mutex> lock(mu_);
@@ -322,7 +383,11 @@ std::string HealthTracker::SerializeJson(double now_s) const {
            HexU64(entry.last_fingerprint) + "\",\"has_fp\":" +
            (entry.has_fingerprint ? "true" : "false") + ",\"fromq\":" +
            (entry.from_quarantine ? "true" : "false") + ",\"iv\":" +
-           std::to_string(entry.observe_interval_s) + ",\"until\":" +
+           std::to_string(entry.observe_interval_s) + ",\"rank\":" +
+           std::to_string(entry.published_rank) + ",\"cand\":" +
+           std::to_string(entry.candidate_rank) + ",\"streak\":" +
+           std::to_string(entry.candidate_streak) + ",\"rfp\":" +
+           jsonlite::Quote(entry.rank_fingerprint) + ",\"until\":" +
            until + ",\"flaps\":[";
     bool first_flap = true;
     for (double t : entry.flap_times) {
@@ -374,6 +439,17 @@ Status HealthTracker::RestoreJson(const std::string& json, double now_s) {
     entry.consecutive_failures = static_cast<int>(number("fails", 0));
     entry.consecutive_clean = static_cast<int>(number("clean", 0));
     entry.quarantine_until = number("until", 0);
+    // Class-rank debounce state (perf class hook): a half-built
+    // demotion streak survives a crash instead of granting the chip a
+    // fresh debounce budget. Absent fields (pre-PR-9 payloads) default
+    // to "no rank tracked".
+    entry.published_rank = static_cast<int>(number("rank", -1));
+    entry.candidate_rank = static_cast<int>(number("cand", -1));
+    entry.candidate_streak = static_cast<int>(number("streak", 0));
+    jsonlite::ValuePtr rfp = value->Get("rfp");
+    if (rfp && rfp->kind == jsonlite::Value::Kind::kString) {
+      entry.rank_fingerprint = rfp->string_value;
+    }
     // Restored cadence keeps the ghost-release threshold honest before
     // the first post-restart observation re-declares it: a slow source
     // must not be released as a ghost just because the daemon rebooted.

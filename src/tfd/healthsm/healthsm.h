@@ -115,6 +115,38 @@ class HealthTracker {
   State Observe(const std::string& key, bool ok, uint64_t fingerprint,
                 double now_s, double interval_s = 0);
 
+  // Perf class-demotion hook: rank transitions routed through the
+  // ladder's debounce policy. `rank` is this measurement round's RAW
+  // class (perf::kRankGold..kRankDegraded, larger = worse); the return
+  // value is the rank the caller may PUBLISH. A demotion (rank above
+  // the published one) must repeat for `unhealthy_after` consecutive
+  // observations before it lands — one thermal blip never moves the
+  // class — and a promotion must repeat for `recover_after` (recovery
+  // is earned, mirroring the quarantine exit). Flap accounting for
+  // published changes rides the NORMAL content-fingerprint path (the
+  // class participates in the source's flap fingerprint, so the
+  // broker's Observe() of the same round registers one unstable
+  // observation per change — this method adds none of its own, or
+  // every change would double-count and quarantine at half the
+  // threshold); a class that churns past --health-flap-threshold
+  // therefore still quarantines the source. Rank state rides the same
+  // Entry as Observe()'s and serializes with it, so a half-built
+  // demotion streak survives kill -9 instead of resetting.
+  // `fingerprint` names the hardware identity the observation
+  // describes: rank history self-invalidates when it changes, because
+  // debouncing NEW silicon's first verdict against OLD silicon's
+  // published class (possible when the rank state outlives the perf
+  // cache — a torn perf section, a disabled-then-re-enabled feature —
+  // across a hardware swap) would pin a replaced chip's class on its
+  // healthy successor for recover_after slow rechecks.
+  int ObserveClassRank(const std::string& key, int rank,
+                       const std::string& fingerprint, double now_s);
+  // Forgets the key's rank history (hardware-identity fingerprint
+  // changed: the next rank observation describes DIFFERENT silicon and
+  // publishes immediately instead of debouncing against the old
+  // chip's class).
+  void ResetClassRank(const std::string& key);
+
   State StateOf(const std::string& key, double now_s) const;
   bool Quarantined(const std::string& key, double now_s) const;
   // Keys currently quarantined, in key order. Also releases ghost
@@ -154,6 +186,14 @@ class HealthTracker {
     double last_observed = 0;        // wall time of the latest Observe()
     double observe_interval_s = 0;   // caller-declared cadence (0 unknown)
     std::deque<double> flap_times;   // transition/unstable wall times
+    // Class-rank debounce (ObserveClassRank): the published rank, the
+    // candidate streak working toward replacing it (-1: none), and
+    // the hardware-identity fingerprint the history describes (a
+    // mismatch voids the history).
+    int published_rank = -1;
+    int candidate_rank = -1;
+    int candidate_streak = 0;
+    std::string rank_fingerprint;
   };
 
   void TransitionLocked(const std::string& key, Entry* entry, State to,

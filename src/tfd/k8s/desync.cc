@@ -1,6 +1,7 @@
 #include "tfd/k8s/desync.h"
 
 #include "tfd/sched/state.h"
+#include "tfd/util/strings.h"
 
 namespace tfd {
 namespace k8s {
@@ -45,6 +46,12 @@ double Unit(uint64_t hash) {
 }  // namespace
 
 uint64_t Fnv1a64(const std::string& data) {
+  // NOT tfd::Fnv1a64 (util/strings.h): this is textbook FNV-1a with
+  // the standard offset basis, pinned by the unit goldens and the
+  // tpufd/sink.py twin — while the util primitive keeps the state
+  // file's historical (truncated-offset) variant for on-disk
+  // compatibility. The two must not be unified without migrating both
+  // the fleet's persisted state files and the twin pins.
   return Mix(kFnvOffset,
              reinterpret_cast<const unsigned char*>(data.data()),
              data.size());

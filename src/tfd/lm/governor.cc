@@ -3,6 +3,7 @@
 #include <algorithm>
 
 #include "tfd/lm/schema.h"
+#include "tfd/perf/perf.h"
 #include "tfd/util/strings.h"
 
 namespace tfd {
@@ -19,6 +20,13 @@ bool GovernedKey(const std::string& key) {
   if (key == kHealthProbeMs) return false;
   if (key == kSnapshotAge) return false;
   if (key == kHealthQuarantined) return false;
+  // tpu.perf.* measurements re-publish only per (slow) re-measure and
+  // already carry the characterization pipeline's debounce; only the
+  // CLASS verdict is a scheduling-facing structural fact worth
+  // governing (same split as the snapshot flap fingerprint). Damping
+  // the numbers would publish a demoted class next to the healthy
+  // chip's held throughput — a torn pair.
+  if (HasPrefix(key, kPerfPrefix)) return key == kPerfClass;
   return true;
 }
 
@@ -103,7 +111,22 @@ void LabelGovernor::Apply(const Labels& previous,
     bool first_appearance =
         !prev_has && last_change_.find(key) == last_change_.end();
     bool marker_upgrade = !cand_has && DowngradeMarkerKey(key);
-    if (first_appearance || marker_upgrade || level_improved) {
+    // A perf-class DEMOTION (gold -> silver -> degraded) is
+    // monotone-informative in the conservative direction: the
+    // characterization pipeline already debounced it (hysteresis +
+    // healthsm rank streaks), and holding it back would keep routing
+    // latency-critical traffic to a chip proven slow. PROMOTIONS stay
+    // governed — flipping back up is where flap damage lives, and the
+    // debounce's recover_after streak plus this hold-down make the
+    // up-down cycle strictly slower than the down leg.
+    bool class_demotion = false;
+    if (key == kPerfClass && prev_has && cand_has) {
+      int was = perf::ClassRankFromName(prev_it->second);
+      int now_rank = perf::ClassRankFromName(cand_it->second);
+      class_demotion = was >= 0 && now_rank > was;
+    }
+    if (first_appearance || marker_upgrade || class_demotion ||
+        level_improved) {
       pending_change_[key] = now_s;
       continue;
     }

@@ -95,6 +95,20 @@ inline constexpr char kHealthQuarantined[] =
 inline constexpr char kHealthDevicePrefix[] =
     "google.com/tpu.health.device-";
 
+// Measured performance classes (perf/): published by the cached
+// perf-characterization source — micro-benchmark results amortized to
+// one measurement per hardware-identity fingerprint, persisted in the
+// warm-restart state file. `class` is gold|silver|degraded; schedulers
+// route latency-critical serving to class=gold nodes.
+inline constexpr char kPerfPrefix[] = "google.com/tpu.perf.";
+inline constexpr char kPerfMatmulTflops[] =
+    "google.com/tpu.perf.matmul-tflops";
+inline constexpr char kPerfHbmGbps[] = "google.com/tpu.perf.hbm-gbps";
+inline constexpr char kPerfIciGbps[] = "google.com/tpu.perf.ici-gbps";
+inline constexpr char kPerfPctOfRated[] =
+    "google.com/tpu.perf.pct-of-rated";
+inline constexpr char kPerfClass[] = "google.com/tpu.perf.class";
+
 // Degradation ladder (sched/): present only when the daemon is serving
 // CACHED device facts because the probe source missed its cadence
 // (chips held by a training job, wedged libtpu). Age is whole seconds

@@ -1,0 +1,345 @@
+#include "tfd/perf/perf.h"
+
+#include <cstdio>
+#include <cstdlib>
+
+#include "tfd/util/jsonlite.h"
+#include "tfd/util/logging.h"
+#include "tfd/util/strings.h"
+
+namespace tfd {
+namespace perf {
+
+namespace {
+
+// The checksummed canonical form: every field that carries meaning, in
+// a fixed order with a fixed float format, so parse→recompute→compare
+// is byte-stable regardless of how the JSON transport reformats.
+std::string CanonicalFields(const Characterization& c) {
+  return std::to_string(c.schema) + "|" + c.fingerprint + "|" + c.family +
+         "|" + Fixed3(c.measured_at) + "|" +
+         Fixed3(c.measure_seconds) + "|" + Fixed3(c.matmul_tflops) +
+         "|" + Fixed3(c.hbm_gbps) + "|" + Fixed3(c.ici_gbps) + "|" +
+         Fixed3(c.matmul_pct) + "|" + Fixed3(c.hbm_pct) + "|" +
+         std::to_string(c.class_rank);
+}
+
+}  // namespace
+
+const char* ClassName(int rank) {
+  switch (rank) {
+    case kRankGold:
+      return "gold";
+    case kRankSilver:
+      return "silver";
+    case kRankDegraded:
+      return "degraded";
+  }
+  return "silver";
+}
+
+int ClassRankFromName(const std::string& name) {
+  if (name == "gold") return kRankGold;
+  if (name == "silver") return kRankSilver;
+  if (name == "degraded") return kRankDegraded;
+  return -1;
+}
+
+const std::map<std::string, RatedSpec>& BakedRatedSpecs() {
+  // Must stay value-identical to tpufd/rated_specs.json (the checked-in
+  // source of truth; TestRatedSpecsParity pins this).
+  static const std::map<std::string, RatedSpec> specs = {
+      {"v2", {46.0, 700.0}},    {"v3", {123.0, 900.0}},
+      {"v4", {275.0, 1228.0}},  {"v5e", {197.0, 819.0}},
+      {"v5p", {459.0, 2765.0}}, {"v6e", {918.0, 1640.0}},
+  };
+  return specs;
+}
+
+Result<std::map<std::string, RatedSpec>> ParseRatedSpecs(
+    const std::string& json_text) {
+  using R = Result<std::map<std::string, RatedSpec>>;
+  Result<jsonlite::ValuePtr> parsed = jsonlite::Parse(json_text);
+  if (!parsed.ok()) {
+    return R::Error("rated specs unparseable: " + parsed.error());
+  }
+  jsonlite::ValuePtr families = (*parsed)->Get("families");
+  if (!families || families->kind != jsonlite::Value::Kind::kObject) {
+    return R::Error("rated specs missing 'families' object");
+  }
+  std::map<std::string, RatedSpec> out;
+  for (const auto& [family, value] : families->object_items) {
+    if (value->kind != jsonlite::Value::Kind::kObject) {
+      return R::Error("rated spec for '" + family + "' is not an object");
+    }
+    RatedSpec spec;
+    jsonlite::ValuePtr matmul = value->Get("matmul_tflops");
+    jsonlite::ValuePtr hbm = value->Get("hbm_gbps");
+    if (!matmul || matmul->kind != jsonlite::Value::Kind::kNumber ||
+        !hbm || hbm->kind != jsonlite::Value::Kind::kNumber) {
+      return R::Error("rated spec for '" + family +
+                      "' needs numeric matmul_tflops and hbm_gbps");
+    }
+    spec.matmul_tflops = matmul->number_value;
+    spec.hbm_gbps = hbm->number_value;
+    if (spec.matmul_tflops <= 0 || spec.hbm_gbps <= 0) {
+      return R::Error("rated spec for '" + family + "' must be positive");
+    }
+    out[family] = spec;
+  }
+  if (out.empty()) return R::Error("rated specs list no families");
+  return out;
+}
+
+double PctOfRated(double measured, double rated) {
+  if (rated <= 0 || measured < 0) return -1;
+  return 100.0 * measured / rated;
+}
+
+int ClassifyPct(double matmul_pct, double hbm_pct, int prev_rank) {
+  // Raw thresholds first; hysteresis below only defends the CURRENT
+  // class against boundary jitter.
+  auto raw = [](double matmul, double hbm) {
+    if (matmul >= 0 && matmul < kDegradedPct) return kRankDegraded;
+    if (hbm >= 0 && hbm < kDegradedPct) return kRankDegraded;
+    if (matmul >= kGoldMatmulPct && (hbm < 0 || hbm >= kGoldHbmPct)) {
+      return kRankGold;
+    }
+    return kRankSilver;
+  };
+  int rank = raw(matmul_pct, hbm_pct);
+  if (prev_rank < 0 || rank == prev_rank) return rank;
+  // Hysteresis: to LEAVE the previous class, the measurement must clear
+  // the crossed boundary by the margin — shifting the inputs toward the
+  // previous class by the margin must still produce the new class.
+  double toward = rank > prev_rank ? kHysteresisPct : -kHysteresisPct;
+  int confirmed = raw(matmul_pct < 0 ? matmul_pct : matmul_pct + toward,
+                      hbm_pct < 0 ? hbm_pct : hbm_pct + toward);
+  // A margin-shifted reading that no longer crosses in the same
+  // direction means the chip is sitting on the boundary: keep the
+  // previous class.
+  bool still_crosses =
+      rank > prev_rank ? confirmed > prev_rank : confirmed < prev_rank;
+  return still_crosses ? rank : prev_rank;
+}
+
+std::string Fingerprint(const std::string& family, int chip_count,
+                        const std::string& topology,
+                        const std::string& libtpu_version) {
+  return (family.empty() ? "unknown" : family) + "/" +
+         std::to_string(chip_count) + "/" +
+         (topology.empty() ? "-" : topology) + "/" +
+         (libtpu_version.empty() ? "-" : libtpu_version);
+}
+
+std::string SerializeCharacterization(const Characterization& c) {
+  return "{\"schema\":" + std::to_string(c.schema) +
+         ",\"sum\":\"" + HexU64(Fnv1a64(CanonicalFields(c))) + "\"" +
+         ",\"fingerprint\":" + jsonlite::Quote(c.fingerprint) +
+         ",\"family\":" + jsonlite::Quote(c.family) +
+         ",\"measured_at\":" + Fixed3(c.measured_at) +
+         ",\"measure_seconds\":" + Fixed3(c.measure_seconds) +
+         ",\"matmul_tflops\":" + Fixed3(c.matmul_tflops) +
+         ",\"hbm_gbps\":" + Fixed3(c.hbm_gbps) +
+         ",\"ici_gbps\":" + Fixed3(c.ici_gbps) +
+         ",\"matmul_pct\":" + Fixed3(c.matmul_pct) +
+         ",\"hbm_pct\":" + Fixed3(c.hbm_pct) +
+         ",\"class\":" + jsonlite::Quote(ClassName(c.class_rank)) + "}";
+}
+
+Result<Characterization> ParseCharacterization(const std::string& json) {
+  using R = Result<Characterization>;
+  Result<jsonlite::ValuePtr> parsed = jsonlite::Parse(json);
+  if (!parsed.ok()) {
+    return R::Error("perf section unparseable: " + parsed.error());
+  }
+  const jsonlite::Value& root = **parsed;
+  auto number = [&root](const char* key, double* out) {
+    jsonlite::ValuePtr v = root.Get(key);
+    if (!v || v->kind != jsonlite::Value::Kind::kNumber) return false;
+    *out = v->number_value;
+    return true;
+  };
+  auto text = [&root](const char* key, std::string* out) {
+    jsonlite::ValuePtr v = root.Get(key);
+    if (!v || v->kind != jsonlite::Value::Kind::kString) return false;
+    *out = v->string_value;
+    return true;
+  };
+  Characterization c;
+  double schema = 0;
+  if (!number("schema", &schema)) {
+    return R::Error("perf section missing schema");
+  }
+  if (static_cast<int>(schema) != kPerfSchema) {
+    return R::Error("perf schema " +
+                    std::to_string(static_cast<int>(schema)) +
+                    " unsupported (want " + std::to_string(kPerfSchema) +
+                    ")");
+  }
+  c.schema = static_cast<int>(schema);
+  std::string sum, cls;
+  if (!text("sum", &sum)) return R::Error("perf section missing checksum");
+  if (!text("fingerprint", &c.fingerprint) || c.fingerprint.empty()) {
+    return R::Error("perf section missing fingerprint");
+  }
+  text("family", &c.family);
+  number("measured_at", &c.measured_at);
+  number("measure_seconds", &c.measure_seconds);
+  number("matmul_tflops", &c.matmul_tflops);
+  number("hbm_gbps", &c.hbm_gbps);
+  number("ici_gbps", &c.ici_gbps);
+  number("matmul_pct", &c.matmul_pct);
+  number("hbm_pct", &c.hbm_pct);
+  if (!text("class", &cls)) return R::Error("perf section missing class");
+  c.class_rank = ClassRankFromName(cls);
+  if (c.class_rank < 0) {
+    return R::Error("perf section names unknown class '" + cls + "'");
+  }
+  if (HexU64(Fnv1a64(CanonicalFields(c))) != sum) {
+    return R::Error("perf section torn or corrupt (checksum mismatch)");
+  }
+  return c;
+}
+
+Result<std::map<std::string, double>> ParseExecOutput(
+    const std::string& text) {
+  using R = Result<std::map<std::string, double>>;
+  std::map<std::string, double> out;
+  for (const std::string& line : SplitString(text, '\n')) {
+    std::string trimmed = TrimSpace(line);
+    if (trimmed.empty()) continue;
+    size_t eq = trimmed.find('=');
+    if (eq == std::string::npos || eq == 0) {
+      TFD_LOG_WARNING << "perf exec: ignoring malformed line: " << trimmed;
+      continue;
+    }
+    std::string key = trimmed.substr(0, eq);
+    if (key != "matmul-tflops" && key != "hbm-gbps" && key != "ici-gbps") {
+      TFD_LOG_WARNING << "perf exec: ignoring unknown measurement: " << key;
+      continue;
+    }
+    char* end = nullptr;
+    std::string value = trimmed.substr(eq + 1);
+    double parsed = strtod(value.c_str(), &end);
+    if (end == value.c_str() || parsed < 0) {
+      TFD_LOG_WARNING << "perf exec: ignoring non-numeric value: "
+                      << trimmed;
+      continue;
+    }
+    out[key] = parsed;
+  }
+  if (out.count("matmul-tflops") == 0 && out.count("hbm-gbps") == 0) {
+    return R::Error("perf exec produced no recognized measurement "
+                    "(want matmul-tflops= / hbm-gbps= / ici-gbps= lines)");
+  }
+  return out;
+}
+
+std::map<std::string, std::string> BuildLabels(const Characterization& c) {
+  // Throughput label values mirror tpufd.health's fmt(): whole numbers
+  // at TPU scale, two significant digits below 10 (a small-but-real CI
+  // measurement must never read "0" = probe failure).
+  auto fmt = [](double v) -> std::string {
+    if (v >= 10) return std::to_string(static_cast<long long>(v));
+    char buf[32];
+    snprintf(buf, sizeof(buf), "%.2g", v);
+    return buf;
+  };
+  std::map<std::string, std::string> labels;
+  if (c.matmul_tflops >= 0) {
+    labels["google.com/tpu.perf.matmul-tflops"] = fmt(c.matmul_tflops);
+  }
+  if (c.hbm_gbps >= 0) {
+    labels["google.com/tpu.perf.hbm-gbps"] = fmt(c.hbm_gbps);
+  }
+  if (c.ici_gbps >= 0) {
+    labels["google.com/tpu.perf.ici-gbps"] = fmt(c.ici_gbps);
+  }
+  if (c.matmul_pct >= 0) {
+    labels["google.com/tpu.perf.pct-of-rated"] =
+        std::to_string(static_cast<long long>(c.matmul_pct + 0.5));
+  }
+  labels["google.com/tpu.perf.class"] = ClassName(c.class_rank);
+  return labels;
+}
+
+bool MeasureAllowed(double now, double last_end, double last_seconds,
+                    int duty_cycle_pct) {
+  if (last_end <= 0 || last_seconds <= 0) return true;  // first ever
+  if (duty_cycle_pct >= 100) return true;
+  if (duty_cycle_pct < 1) duty_cycle_pct = 1;
+  double required_gap =
+      last_seconds * (100.0 / duty_cycle_pct - 1.0);
+  return now - last_end >= required_gap;
+}
+
+std::optional<Characterization> Cache::Get() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return value_;
+}
+
+void Cache::Set(const Characterization& c) {
+  std::lock_guard<std::mutex> lock(mu_);
+  value_ = c;
+}
+
+void Cache::Invalidate() {
+  std::lock_guard<std::mutex> lock(mu_);
+  value_.reset();
+}
+
+void Cache::NoteMeasurement(double end_wall, double seconds) {
+  std::lock_guard<std::mutex> lock(mu_);
+  last_measure_end_ = end_wall;
+  last_measure_seconds_ = seconds;
+  last_deferral_key_.clear();  // a fresh attempt opens a fresh episode
+}
+
+bool Cache::AllowedNow(double now, int duty_cycle_pct) const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return MeasureAllowed(now, last_measure_end_, last_measure_seconds_,
+                        duty_cycle_pct);
+}
+
+bool Cache::NoteDeferral(const std::string& key) {
+  std::lock_guard<std::mutex> lock(mu_);
+  if (last_deferral_key_ == key) return false;
+  last_deferral_key_ = key;
+  return true;
+}
+
+std::string Cache::SerializeJson() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  if (!value_.has_value()) return "";
+  return SerializeCharacterization(*value_);
+}
+
+Status Cache::RestoreJson(const std::string& json) {
+  if (json.empty()) return Status::Ok();  // pre-PR-9 state file
+  Result<Characterization> parsed = ParseCharacterization(json);
+  if (!parsed.ok()) return Status::Error(parsed.error());
+  std::lock_guard<std::mutex> lock(mu_);
+  value_ = *parsed;
+  // The restored measurement's duty bookkeeping starts clean: the
+  // measurement happened a process lifetime ago, so the next REAL
+  // measurement (fingerprint change, recheck due) is not duty-blocked
+  // by it.
+  return Status::Ok();
+}
+
+void Cache::Reset() {
+  std::lock_guard<std::mutex> lock(mu_);
+  value_.reset();
+  last_measure_end_ = 0;
+  last_measure_seconds_ = 0;
+  last_deferral_key_.clear();
+}
+
+Cache& Default() {
+  static Cache* cache = new Cache();
+  return *cache;
+}
+
+}  // namespace perf
+}  // namespace tfd

@@ -1,0 +1,199 @@
+// Cached perf-characterization source: measured tpu.perf.* class labels
+// with amortized micro-benchmarks.
+//
+// Schedulers select on `tpu.product`, but what they actually want is
+// what this node can SUSTAIN: a chip that enumerates cleanly yet
+// delivers half its rated matmul throughput is exactly the node a
+// latency-critical serving workload must avoid. This subsystem extends
+// the burn-in/matmul probe discipline (tpufd/health.py, bench.py
+// pct_of_rated) into a first-class probe source that publishes
+//
+//   google.com/tpu.perf.matmul-tflops   measured bf16 MXU throughput
+//   google.com/tpu.perf.hbm-gbps        measured HBM stream bandwidth
+//   google.com/tpu.perf.ici-gbps        measured ICI all-reduce bw
+//   google.com/tpu.perf.pct-of-rated    matmul as % of the family peak
+//   google.com/tpu.perf.class           gold | silver | degraded
+//
+// The perf discipline is AMORTIZATION: measurement must cost ~zero in
+// steady state. Characterize once (the `--perf-exec` micro-benchmarks,
+// device-exclusive via the broker's serialization), persist the result
+// in the warm-restart state file (own schema section with its OWN
+// checksum, so a torn perf section is rejected without discarding the
+// label payload), and on every later boot restore it in milliseconds
+// with zero re-measurement. The cached characterization is invalidated
+// ONLY by a hardware-identity fingerprint change (family / chip count /
+// topology / libtpu version) — never by time alone; re-VERIFICATION
+// runs on the slow `--perf-recheck-interval` cadence, and every
+// measurement pass is additionally bounded by `--perf-duty-cycle-pct`:
+// after a measurement that took D seconds, the next one may not start
+// for D * (100/pct - 1) seconds, so characterization can never consume
+// more than pct% of wall-clock TPU time no matter how often something
+// asks for it.
+//
+// Classification (mirrored bit-for-bit by tpufd/perfmodel.py — the
+// parity tests pin the two against each other):
+//   gold      matmul >= 90% of rated AND hbm >= 70% of rated
+//             (healthy silicon: the MXU probe reaches ~95%+ of rated,
+//             the HBM stream 75-90% — see tpufd/health.py's measured
+//             band notes);
+//   degraded  matmul < 50% OR hbm < 50% (the DEGRADED_PCT floor:
+//             genuinely sick silicon, never normal stream efficiency);
+//   silver    everything between.
+// A 3-point hysteresis margin is applied against the PREVIOUS class so
+// a chip sitting on a boundary cannot flap, and the published class is
+// additionally debounced through the healthsm ladder
+// (HealthTracker::ObserveClassRank): a demotion needs
+// `unhealthy_after` consecutive measurements to agree, a promotion
+// `recover_after` — a thermally-throttling chip therefore DEMOTES its
+// class once instead of flapping it, and repeated published-class
+// churn feeds the source's flap window like any other instability.
+#pragma once
+
+#include <map>
+#include <mutex>
+#include <optional>
+#include <string>
+#include <vector>
+
+#include "tfd/util/status.h"
+
+namespace tfd {
+namespace perf {
+
+inline constexpr int kPerfSchema = 1;
+
+// Class ranks order by desirability; larger = worse. The governor's
+// demotion bypass and the healthsm debounce both compare ranks.
+inline constexpr int kRankGold = 0;
+inline constexpr int kRankSilver = 1;
+inline constexpr int kRankDegraded = 2;
+
+// Threshold constants, mirrored by tpufd/perfmodel.py (parity-pinned).
+inline constexpr double kGoldMatmulPct = 90.0;
+inline constexpr double kGoldHbmPct = 70.0;
+inline constexpr double kDegradedPct = 50.0;
+inline constexpr double kHysteresisPct = 3.0;
+
+const char* ClassName(int rank);               // "gold"|"silver"|"degraded"
+int ClassRankFromName(const std::string& name);  // -1 unknown
+
+// Per-family rated peaks (bf16 TFLOP/s, HBM GB/s) from Google's
+// published Cloud TPU system-architecture tables. The baked table must
+// match the checked-in tpufd/rated_specs.json byte-for-value — the
+// JSON is the single source of truth both language halves consume
+// (tpufd/health.py + tpufd/perfmodel.py load it directly; the C++
+// parity test pins this table against it), and `--rated-specs-file`
+// lets a deployment override the baked copy without a rebuild.
+struct RatedSpec {
+  double matmul_tflops = 0;
+  double hbm_gbps = 0;
+};
+
+const std::map<std::string, RatedSpec>& BakedRatedSpecs();
+
+// Parses a rated_specs.json document:
+//   {"families": {"v5e": {"matmul_tflops": 197.0, "hbm_gbps": 819.0}}}
+Result<std::map<std::string, RatedSpec>> ParseRatedSpecs(
+    const std::string& json_text);
+
+// measured / rated * 100, or -1 when the family (or its rating) is
+// unknown — the C++ twin of tpufd.health.pct_of_rated.
+double PctOfRated(double measured, double rated);
+
+// Raw classification from the measured percentages (-1 = unknown):
+// unknown matmul classifies silver (never vouch gold for an unmeasured
+// chip, never condemn it either); unknown hbm leaves only the matmul
+// gates. `prev_rank` (-1 = none) applies the hysteresis margin: a
+// boundary crossing must clear the threshold by kHysteresisPct in the
+// direction of CHANGE, so a chip sitting exactly on a threshold keeps
+// its class.
+int ClassifyPct(double matmul_pct, double hbm_pct, int prev_rank);
+
+// One completed characterization: the measured numbers, their derived
+// context, and the hardware-identity fingerprint they describe.
+struct Characterization {
+  int schema = kPerfSchema;
+  std::string fingerprint;  // family/chips/topology/libtpu
+  std::string family;       // "" when unknown (no rated context)
+  double measured_at = 0;   // unix wall time the measurement finished
+  double measure_seconds = 0;
+  double matmul_tflops = -1;  // -1: not measured
+  double hbm_gbps = -1;
+  double ici_gbps = -1;
+  double matmul_pct = -1;  // -1: no rated context
+  double hbm_pct = -1;
+  int class_rank = kRankSilver;  // the DEBOUNCED published class
+};
+
+// Hardware-identity fingerprint: the ONLY thing that invalidates a
+// cached characterization. Human-readable on purpose — it is journaled
+// as the re-characterization reason.
+std::string Fingerprint(const std::string& family, int chip_count,
+                        const std::string& topology,
+                        const std::string& libtpu_version);
+
+// Serialization for the state-file perf section: a JSON object whose
+// "sum" field is an FNV-1a checksum over the canonical field string,
+// so a torn/hand-edited perf section fails ITS OWN gate and is
+// rejected independently of the (outer-checksummed) label payload.
+std::string SerializeCharacterization(const Characterization& c);
+Result<Characterization> ParseCharacterization(const std::string& json);
+
+// Parses `--perf-exec` stdout: "matmul-tflops=..." / "hbm-gbps=..." /
+// "ici-gbps=..." lines (unknown keys ignored, loudly). Errors when no
+// recognized measurement is present.
+Result<std::map<std::string, double>> ParseExecOutput(
+    const std::string& text);
+
+// The five published labels for one characterization.
+std::map<std::string, std::string> BuildLabels(const Characterization& c);
+
+// Duty-cycle gate (pure, unit-tested): may a measurement start now?
+// After a measurement of `last_seconds` that ended at `last_end`, the
+// next may not start before last_end + last_seconds * (100/pct - 1);
+// a never-measured cache is always allowed.
+bool MeasureAllowed(double now, double last_end, double last_seconds,
+                    int duty_cycle_pct);
+
+// Process-wide characterization cache (the analogue of
+// healthsm::Default()): written by the perf probe worker, read by the
+// state saver on the rewrite thread, seeded by the warm-restart loader
+// before any probe runs. Survives SIGHUP (the silicon did not change
+// because our config did).
+class Cache {
+ public:
+  std::optional<Characterization> Get() const;
+  void Set(const Characterization& c);
+  void Invalidate();  // fingerprint changed: the cached numbers lie
+
+  // Duty-cycle bookkeeping, fed by the probe after every measurement.
+  void NoteMeasurement(double end_wall, double seconds);
+  bool AllowedNow(double now, int duty_cycle_pct) const;
+
+  // Deferral-episode dedup: true the FIRST time `key` (reason +
+  // fingerprint) is noted since the last measurement/restore — the
+  // probe retries an owed measurement on a short cadence, and a long
+  // duty gap must journal ONE perf-deferred episode, not one per
+  // retry tick (hours of 60s ticks would flush the flight recorder).
+  bool NoteDeferral(const std::string& key);
+
+  // State-file round trip. Restore tolerates an empty string (nothing
+  // persisted — a pre-PR-9 state file) and errors on garbage or a
+  // checksum mismatch WITHOUT touching the current state.
+  std::string SerializeJson() const;
+  Status RestoreJson(const std::string& json);
+
+  void Reset();  // test hook
+
+ private:
+  mutable std::mutex mu_;
+  std::optional<Characterization> value_;
+  double last_measure_end_ = 0;
+  double last_measure_seconds_ = 0;
+  std::string last_deferral_key_;  // NoteDeferral episode dedup
+};
+
+Cache& Default();
+
+}  // namespace perf
+}  // namespace tfd

@@ -2,11 +2,21 @@
 
 #include <algorithm>
 #include <chrono>
+#include <memory>
 
+#include "tfd/healthsm/healthsm.h"
 #include "tfd/lm/health_exec.h"
 #include "tfd/lm/schema.h"
+#include "tfd/obs/journal.h"
 #include "tfd/obs/metrics.h"
+#include "tfd/perf/perf.h"
 #include "tfd/resource/factory.h"
+#include "tfd/slice/topology.h"
+#include "tfd/util/file.h"
+#include "tfd/util/logging.h"
+#include "tfd/util/strings.h"
+#include "tfd/util/subprocess.h"
+#include "tfd/util/time.h"
 
 namespace tfd {
 namespace sched {
@@ -110,6 +120,280 @@ int TouchingChipCount(const SnapshotStore& store) {
     }
   }
   return -1;
+}
+
+// ---- cached perf characterization (perf/) --------------------------------
+
+// The hardware-identity fingerprint the cached characterization is
+// keyed by, read from the newest usable device-touching snapshot
+// (family from the first device's kind, chip count, topology string,
+// libtpu version). Empty when no device snapshot can answer yet.
+// `family_out` (optional) receives the family short name for the
+// rated-spec lookup.
+std::string CurrentPerfFingerprint(const SnapshotStore& store,
+                                   std::string* family_out = nullptr) {
+  for (const std::string& name : store.DeviceSources()) {
+    SourceView view = store.View(name);
+    if (!view.last_ok.has_value() || view.tier == Tier::kExpired) continue;
+    const resource::ManagerPtr& manager = view.last_ok->manager;
+    if (manager == nullptr || !manager->TouchesDevices()) continue;
+    Result<std::vector<resource::DevicePtr>> devices = manager->GetDevices();
+    if (!devices.ok() || devices->empty()) continue;
+    std::string family;
+    if ((*devices)[0] != nullptr) {
+      Result<std::string> kind = (*devices)[0]->GetKind();
+      if (kind.ok()) {
+        Result<slice::FamilySpec> spec = slice::FamilyFromDeviceKind(*kind);
+        if (spec.ok()) family = spec->family;
+      }
+    }
+    std::string topology;
+    Result<resource::TopologyInfo> topo = manager->GetTopology();
+    if (topo.ok()) {
+      topology = topo->topology.empty() ? topo->accelerator_type
+                                        : topo->topology;
+    }
+    std::string libtpu;
+    Result<std::string> lib = manager->GetLibtpuVersion();
+    if (lib.ok()) libtpu = *lib;
+    if (family_out != nullptr) *family_out = family;
+    return perf::Fingerprint(family, static_cast<int>(devices->size()),
+                             topology, libtpu);
+  }
+  return "";
+}
+
+// Quarantined chip ids ("health/chip-<i>" healthsm keys), exported to
+// the measurement exec as TFD_PERF_EXCLUDE_CHIPS so a chip the health
+// ladder already distrusts is EXCLUDED from the aggregate
+// characterization — its sickness belongs to its quarantine record,
+// not to the node's published class.
+std::string QuarantinedChipIds(double now_s) {
+  constexpr char kChipKeyPrefix[] = "health/chip-";
+  std::vector<std::string> ids;
+  for (const std::string& key : healthsm::Default().QuarantinedKeys(now_s)) {
+    if (key.rfind(kChipKeyPrefix, 0) == 0) {
+      ids.push_back(key.substr(sizeof(kChipKeyPrefix) - 1));
+    }
+  }
+  return JoinStrings(ids, ",");
+}
+
+// One perf probe tick: serve the cached characterization when its
+// fingerprint still matches the hardware and no recheck is due
+// (zero-measurement steady state), else measure — once — under the
+// duty-cycle budget. The probe runs on the broker's exclusive lock, so
+// a measurement can never race the PJRT watchdog or the health exec
+// for the chips.
+Status RunPerfProbe(const config::Config& config,
+                    const SnapshotStore& store,
+                    const std::map<std::string, perf::RatedSpec>& rated,
+                    Snapshot* out) {
+  const config::Flags& flags = config.flags;
+  perf::Cache& cache = perf::Default();
+  double now = WallClockSeconds();
+  std::optional<perf::Characterization> current = cache.Get();
+  std::string family;
+  std::string fingerprint = CurrentPerfFingerprint(store, &family);
+  if (fingerprint.empty()) {
+    if (current.has_value()) {
+      // Device workers haven't settled yet (warm-restart cold probes,
+      // wedged PJRT) but a cached characterization exists — it was
+      // node-gated by the state file, so serve it rather than dropping
+      // the perf labels for the settle window; the fingerprint gate
+      // re-judges it the moment a device snapshot lands (rerun_early).
+      out->labels = perf::BuildLabels(*current);
+      return Status::Ok();
+    }
+    return Status::Error(
+        "no device-touching backend snapshot to characterize against");
+  }
+
+  std::string reason;
+  if (current.has_value() && current->fingerprint != fingerprint) {
+    // The cached numbers describe hardware this node no longer has:
+    // drop them NOW, before the duty gate — a duty-deferred
+    // re-measurement must not keep republishing a different chip's
+    // class for the rest of the duty gap (the snapshot below is
+    // replaced by an empty label set on the deferral path for the
+    // same reason).
+    cache.Invalidate();
+    healthsm::Default().ResetClassRank("perf");
+    current.reset();
+    reason = "fingerprint-changed";
+    // No label is vouching for a class anymore: the gauge must say so
+    // (-1 = none published) instead of advertising the old hardware's
+    // class until the re-measure lands.
+    obs::Default()
+        .GetGauge("tfd_perf_class",
+                  "Published performance class: 0 gold, 1 silver, "
+                  "2 degraded; -1 while no characterization is published.")
+        ->Set(-1);
+  } else if (!current.has_value()) {
+    reason = "never-characterized";
+  } else if (now - current->measured_at >= flags.perf_recheck_interval_s) {
+    reason = "recheck-due";
+  }
+
+  if (reason.empty()) {
+    // Amortized steady state: republish the cached characterization.
+    // No device touched, no exec run, nothing journaled — the snapshot
+    // content is byte-stable so the pass planner stays clean too.
+    out->labels = perf::BuildLabels(*current);
+    return Status::Ok();
+  }
+
+  if (!cache.AllowedNow(now, flags.perf_duty_cycle_pct)) {
+    // Once per owed EPISODE, not per retry tick: a duty gap that
+    // outlasts the recheck interval would otherwise drip one event
+    // per short-cadence retry for hours and flush the journal ring.
+    if (cache.NoteDeferral(reason + "|" + fingerprint)) {
+      obs::Default()
+          .GetCounter("tfd_perf_deferrals_total",
+                      "Perf measurement episodes deferred by the "
+                      "--perf-duty-cycle-pct budget (one per owed "
+                      "episode, not per retry tick).")
+          ->Inc();
+      obs::DefaultJournal().Record(
+          "perf-deferred", "perf",
+          "characterization owed (" + reason +
+              ") but deferred: duty-cycle budget exhausted",
+          {{"reason", reason}, {"fingerprint", fingerprint}});
+    }
+    if (current.has_value()) {
+      // A recheck-due deferral still serves the (fingerprint-valid)
+      // cached facts.
+      out->labels = perf::BuildLabels(*current);
+      return Status::Ok();
+    }
+    // No valid characterization to serve: publish an EMPTY perf
+    // snapshot so the store stops serving whatever the previous
+    // (invalidated) one claimed — no labels beats a different chip's
+    // labels — and retry on the short owed cadence.
+    return Status::Ok();
+  }
+
+  std::string exclude = QuarantinedChipIds(now);
+  std::string command = flags.perf_exec;
+  {
+    // Env rides in via an export prefix like the health exec's chip
+    // count: RunCommandCapture runs `sh -c`, so this scopes to the
+    // child without mutating the daemon's environment.
+    std::string exports;
+    if (!exclude.empty()) {
+      exports += "export TFD_PERF_EXCLUDE_CHIPS=" + exclude + "; ";
+    }
+    if (!family.empty()) {
+      exports += "export TFD_PERF_FAMILY=" + family + "; ";
+    }
+    command = exports + command;
+  }
+  auto t0 = std::chrono::steady_clock::now();
+  Result<std::string> text =
+      RunCommandCapture(command, flags.perf_exec_timeout_s);
+  double seconds = obs::SecondsSince(t0);
+  // A failed exec consumed the chips too: it spends duty budget, so a
+  // crash-looping measurement command cannot grind the TPU.
+  cache.NoteMeasurement(WallClockSeconds(), seconds);
+  Result<std::map<std::string, double>> measured =
+      text.ok() ? perf::ParseExecOutput(*text)
+                : Result<std::map<std::string, double>>::Error(
+                      "perf exec failed: " + text.error());
+  if (!measured.ok()) {
+    if (reason == "fingerprint-changed") {
+      // The old characterization is already invalidated and its labels
+      // describe different hardware: publish the EMPTY set (replacing
+      // the stale snapshot) rather than erroring, which would leave
+      // the store serving the previous chip's class until expiry.
+      obs::DefaultJournal().Record(
+          "perf-measure-failed", "perf",
+          "re-characterization after fingerprint change failed; "
+          "dropping stale perf labels: " + measured.error(),
+          {{"reason", reason},
+           {"fingerprint", fingerprint},
+           {"error", measured.error()}});
+      return Status::Ok();
+    }
+    // recheck-due / never-characterized: the store's existing snapshot
+    // (if any) is still fingerprint-valid — fail the probe normally
+    // (backoff + probe-fail journal) and keep serving it.
+    return Status::Error(measured.error());
+  }
+
+  perf::Characterization c;
+  c.fingerprint = fingerprint;
+  c.family = family;
+  c.measured_at = WallClockSeconds();
+  c.measure_seconds = seconds;
+  auto value_of = [&measured](const char* key) {
+    auto it = measured->find(key);
+    return it == measured->end() ? -1.0 : it->second;
+  };
+  c.matmul_tflops = value_of("matmul-tflops");
+  c.hbm_gbps = value_of("hbm-gbps");
+  c.ici_gbps = value_of("ici-gbps");
+  auto spec = rated.find(family);
+  if (spec != rated.end()) {
+    c.matmul_pct = perf::PctOfRated(c.matmul_tflops,
+                                    spec->second.matmul_tflops);
+    c.hbm_pct = perf::PctOfRated(c.hbm_gbps, spec->second.hbm_gbps);
+  }
+  const int prev_rank =
+      current.has_value() ? current->class_rank : -1;
+  int raw_rank = perf::ClassifyPct(c.matmul_pct, c.hbm_pct, prev_rank);
+  // The health-ladder demotion debounce: one throttled measurement
+  // never moves the published class; `unhealthy_after` consecutive
+  // demotion verdicts do (and promotions need `recover_after`).
+  c.class_rank =
+      healthsm::Default().ObserveClassRank("perf", raw_rank, fingerprint, now);
+  cache.Set(c);
+
+  obs::Registry& reg = obs::Default();
+  reg.GetCounter("tfd_perf_measures_total",
+                 "Perf characterization measurement rounds actually run "
+                 "(the amortization contract: one per hardware "
+                 "fingerprint plus slow rechecks).")
+      ->Inc();
+  reg.GetHistogram("tfd_perf_measure_duration_seconds",
+                   "Wall time of one perf characterization exec.",
+                   obs::DurationBuckets())
+      ->Observe(seconds);
+  reg.GetGauge("tfd_perf_class",
+               "Published performance class: 0 gold, 1 silver, "
+               "2 degraded; -1 while no characterization is published.")
+      ->Set(c.class_rank);
+  auto fmt3 = [](double v) { return Fixed3(v); };
+  obs::DefaultJournal().Record(
+      "perf-measure", "perf",
+      "characterized " + fingerprint + " in " + fmt3(seconds) + "s (" +
+          reason + "): class " + perf::ClassName(c.class_rank),
+      {{"reason", reason},
+       {"fingerprint", fingerprint},
+       {"duration_s", fmt3(seconds)},
+       {"matmul_tflops", fmt3(c.matmul_tflops)},
+       {"hbm_gbps", fmt3(c.hbm_gbps)},
+       {"ici_gbps", fmt3(c.ici_gbps)},
+       {"pct_of_rated", fmt3(c.matmul_pct)},
+       {"raw_class", perf::ClassName(raw_rank)},
+       {"class", perf::ClassName(c.class_rank)},
+       {"excluded_chips", exclude}});
+  if (prev_rank >= 0 && c.class_rank != prev_rank) {
+    reg.GetCounter("tfd_perf_class_changes_total",
+                   "Published performance-class changes, by direction.",
+                   {{"direction",
+                     c.class_rank > prev_rank ? "demote" : "promote"}})
+        ->Inc();
+    obs::DefaultJournal().Record(
+        "perf-class-change", "perf",
+        std::string("performance class ") + perf::ClassName(prev_rank) +
+            " -> " + perf::ClassName(c.class_rank),
+        {{"from", perf::ClassName(prev_rank)},
+         {"to", perf::ClassName(c.class_rank)},
+         {"pct_of_rated", fmt3(c.matmul_pct)},
+         {"fingerprint", fingerprint}});
+  }
+  out->labels = perf::BuildLabels(c);
+  return Status::Ok();
 }
 
 }  // namespace
@@ -220,6 +504,92 @@ std::vector<ProbeSpec> BuildProbeSpecs(
     spec.rerun_early = [store_ref, last_chips] {
       int chips = TouchingChipCount(*store_ref);
       return chips >= 0 && chips != *last_chips;
+    };
+    specs.push_back(std::move(spec));
+  }
+
+  if (flags.perf_characterize) {
+    // The perf snapshot's freshness must span the whole recheck
+    // cadence (hours): between measurements the worker republishes the
+    // cached characterization, and a republish tick slipping under
+    // load must not flap the degraded markers.
+    TierPolicy policy;
+    policy.fresh_for_s = flags.perf_recheck_interval_s +
+                         flags.perf_exec_timeout_s + 4 * sleep_s;
+    policy.usable_for_s = policy.fresh_for_s + flags.perf_recheck_interval_s;
+    store->Register("perf", policy, /*device_source=*/false);
+
+    // Rated specs resolved once per config load: the baked table,
+    // overridden by --rated-specs-file when it parses. A broken
+    // override keeps the baked copy and says so — a perf source with
+    // no rated context still publishes the measured numbers, just no
+    // pct-of-rated, which would silently misclassify everything.
+    auto rated = std::make_shared<std::map<std::string, perf::RatedSpec>>(
+        perf::BakedRatedSpecs());
+    if (!flags.rated_specs_file.empty()) {
+      Result<std::string> text = ReadFile(flags.rated_specs_file);
+      Result<std::map<std::string, perf::RatedSpec>> parsed =
+          text.ok() ? perf::ParseRatedSpecs(*text)
+                    : Result<std::map<std::string, perf::RatedSpec>>::Error(
+                          text.error());
+      if (parsed.ok()) {
+        *rated = *parsed;
+      } else {
+        TFD_LOG_ERROR << "rated-specs-file " << flags.rated_specs_file
+                      << " unusable (" << parsed.error()
+                      << "); keeping the baked table";
+      }
+    }
+
+    config::Config config_copy = config;
+    std::shared_ptr<SnapshotStore> store_ref = store;
+    ProbeSpec spec;
+    spec.name = "perf";
+    spec.probe = [config_copy, store_ref, rated](Snapshot* out,
+                                                 bool* /*fatal*/) {
+      return RunPerfProbe(config_copy, *store_ref, *rated, out);
+    };
+    // The nominal cadence is the slow recheck interval; a tick that
+    // still OWES a measurement (duty-deferred, or waiting out the
+    // device-snapshot startup race) retries at a short cadence
+    // instead.
+    spec.interval_s = flags.perf_recheck_interval_s;
+    const int recheck_s = flags.perf_recheck_interval_s;
+    spec.interval_for = [recheck_s](const Snapshot& /*snapshot*/) {
+      std::optional<perf::Characterization> c = perf::Default().Get();
+      bool owed = !c.has_value() ||
+                  WallClockSeconds() - c->measured_at >= recheck_s;
+      return owed ? std::min(60, recheck_s) : recheck_s;
+    };
+    spec.backoff_initial_s = sleep_s;
+    spec.backoff_max_s = std::max(60, 8 * sleep_s);
+    spec.device_source = false;
+    spec.exclusive = true;  // micro-benchmarks need the chips
+    // Re-run the probe as soon as the hardware-identity fingerprint
+    // visible in the device snapshots stops matching the cached
+    // characterization (topology change, driver update, first device
+    // snapshot after a cold boot). A STALE cache fires immediately and
+    // duty-independently — the probe must at least invalidate it and
+    // stop the old hardware's labels from serving, even when the
+    // re-measurement itself is duty-deferred; once the cache is empty,
+    // further fires wait for the duty budget (the probe's own Ok
+    // return then owns the short retry cadence), so a flapping
+    // fingerprint cannot turn this 1s-cadence check into a measurement
+    // storm or a journal flood.
+    const int duty_pct = flags.perf_duty_cycle_pct;
+    spec.rerun_early = [store_ref, duty_pct] {
+      std::optional<perf::Characterization> c = perf::Default().Get();
+      std::string fingerprint = CurrentPerfFingerprint(*store_ref);
+      if (fingerprint.empty()) return false;
+      if (c.has_value()) return c->fingerprint != fingerprint;
+      // Empty cache: a measurement is owed, but a FAILING probe (a
+      // misconfigured exec, e.g. the slim image without python3) must
+      // ride the worker's exponential backoff — a fast-failing exec's
+      // duty gap is milliseconds, and breaking the backoff sleep every
+      // 1s slice would spawn it (and journal probe-fail) at ~1 Hz
+      // forever.
+      if (store_ref->View("perf").consecutive_failures > 0) return false;
+      return perf::Default().AllowedNow(WallClockSeconds(), duty_pct);
     };
     specs.push_back(std::move(spec));
   }

@@ -18,25 +18,6 @@ namespace {
 
 constexpr char kMagic[] = "TFDSTATE1";
 
-// FNV-1a 64: tiny, deterministic, and plenty to catch torn writes and
-// bit rot — this is an integrity check against accidents, not an
-// authenticity check against attackers (the state file lives on the
-// pod's own emptyDir).
-uint64_t Fnv1a(const std::string& data) {
-  uint64_t hash = 1469598103934665603ULL;
-  for (unsigned char c : data) {
-    hash ^= c;
-    hash *= 1099511628211ULL;
-  }
-  return hash;
-}
-
-std::string NumberJson(double v) {
-  char buf[32];
-  snprintf(buf, sizeof(buf), "%.3f", v);
-  return buf;
-}
-
 }  // namespace
 
 std::string NodeIdentity() {
@@ -53,11 +34,11 @@ std::string NodeIdentity() {
 std::string SerializeState(const PersistedState& state) {
   std::string payload = "{\"schema\":" + std::to_string(state.schema) +
                         ",\"node\":" + jsonlite::Quote(state.node) +
-                        ",\"saved_at\":" + NumberJson(state.saved_at) +
+                        ",\"saved_at\":" + Fixed3(state.saved_at) +
                         ",\"source\":" + jsonlite::Quote(state.source) +
                         ",\"tier\":" + jsonlite::Quote(state.tier) +
                         ",\"level\":" + std::to_string(state.level) +
-                        ",\"age_s\":" + NumberJson(state.age_s) +
+                        ",\"age_s\":" + Fixed3(state.age_s) +
                         ",\"labels\":" +
                         jsonlite::SerializeStringMap(state.labels) +
                         ",\"provenance\":{";
@@ -69,7 +50,7 @@ std::string SerializeState(const PersistedState& state) {
                jsonlite::Quote(from.labeler) + ",\"source\":" +
                jsonlite::Quote(from.source) + ",\"tier\":" +
                jsonlite::Quote(from.tier) + ",\"age_s\":" +
-               NumberJson(from.age_s) + "}";
+               Fixed3(from.age_s) + "}";
   }
   payload += "}";
   // Health state machine state rides along (quarantine must survive
@@ -77,8 +58,14 @@ std::string SerializeState(const PersistedState& state) {
   if (!state.healthsm_json.empty()) {
     payload += ",\"healthsm\":" + state.healthsm_json;
   }
+  // Perf characterization rides along as its OWN schema section: the
+  // object carries an inner checksum (perf::SerializeCharacterization)
+  // so its integrity is judged independently of this outer frame.
+  if (!state.perf_json.empty()) {
+    payload += ",\"perf\":" + state.perf_json;
+  }
   payload += "}";
-  return std::string(kMagic) + " " + HexU64(Fnv1a(payload)) + " " +
+  return std::string(kMagic) + " " + HexU64(Fnv1a64(payload)) + " " +
          std::to_string(payload.size()) + "\n" + payload;
 }
 
@@ -104,7 +91,7 @@ Result<PersistedState> ParseState(const std::string& contents) {
                     std::to_string(payload.size()) + " bytes, header says " +
                     std::to_string(length) + ")");
   }
-  if (HexU64(Fnv1a(payload)) != checksum_hex) {
+  if (HexU64(Fnv1a64(payload)) != checksum_hex) {
     return R::Error("state file torn or corrupt (checksum mismatch)");
   }
   Result<jsonlite::ValuePtr> parsed = jsonlite::Parse(payload);
@@ -184,6 +171,14 @@ Result<PersistedState> ParseState(const std::string& contents) {
   if (healthsm && healthsm->kind == jsonlite::Value::Kind::kObject) {
     state.healthsm_json = jsonlite::Serialize(*healthsm);
   }
+  // The perf section is carried opaquely, NOT validated here: its own
+  // checksum gate (perf::ParseCharacterization) decides its fate at
+  // restore time, so a corrupt perf section can be rejected without
+  // discarding the label payload this parse just accepted. A non-object
+  // value still rides through — the inner gate is the one that
+  // journals the rejection.
+  jsonlite::ValuePtr perf = root.Get("perf");
+  if (perf) state.perf_json = jsonlite::Serialize(*perf);
   return state;
 }
 
@@ -215,7 +210,8 @@ Status SaveState(const std::string& path, const PersistedState& state) {
 Result<PersistedState> LoadState(const std::string& path,
                                  const std::string& expect_node,
                                  double max_age_s, double now_wall,
-                                 std::string* stale_healthsm_json) {
+                                 std::string* stale_healthsm_json,
+                                 std::string* stale_perf_json) {
   using R = Result<PersistedState>;
   Result<std::string> contents = ReadFile(path);
   if (!contents.ok()) return R::Error(contents.error());
@@ -232,6 +228,9 @@ Result<PersistedState> LoadState(const std::string& path,
   if (restored_age_s > max_age_s) {
     if (stale_healthsm_json != nullptr) {
       *stale_healthsm_json = state->healthsm_json;
+    }
+    if (stale_perf_json != nullptr) {
+      *stale_perf_json = state->perf_json;
     }
     return R::Error("state snapshot age " +
                     std::to_string(static_cast<long long>(restored_age_s)) +

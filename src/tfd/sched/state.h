@@ -50,6 +50,15 @@ struct PersistedState {
   // must not launder a flapping source back to trusted. Empty when
   // nothing was tracked (or the file predates the field).
   std::string healthsm_json;
+  // Serialized perf characterization (perf::Cache SerializeJson): the
+  // amortized micro-benchmark result, carried OPAQUELY here — it has
+  // its own schema section with its OWN checksum, validated by
+  // perf::ParseCharacterization at restore time, so a torn/corrupt
+  // perf section is rejected independently WITHOUT discarding the
+  // label payload (and vice versa: a pre-PR-9 file without the field
+  // restores labels normally and triggers exactly one
+  // characterization). Empty when never characterized.
+  std::string perf_json;
 };
 
 // This node's identity for the foreign-node gate.
@@ -70,19 +79,23 @@ Status SaveState(const std::string& path, const PersistedState& state);
 // identity and age. `now_wall` is unix time; the restored age
 // (state.age_s + downtime) must be <= max_age_s.
 //
-// `stale_healthsm_json` (optional): when the ONLY failed gate is
-// staleness — the state is authentic, checksummed, and from this node,
-// just older than the label payload's usable window — it receives the
-// persisted healthsm state. Quarantine has its own clock
-// (quarantine_until is absolute wall time), so an active quarantine
-// must survive even a long crash loop: expiring it with the labels
-// would launder a flapping chip back to trusted. Untouched on success
-// and on every other rejection (corrupt/foreign state is never
-// trusted).
+// `stale_healthsm_json` / `stale_perf_json` (optional): when the ONLY
+// failed gate is staleness — the state is authentic, checksummed, and
+// from this node, just older than the label payload's usable window —
+// they receive the persisted healthsm and perf sections. Both have
+// their own validity rules instead of the label payload's age gate:
+// quarantine has its own clock (quarantine_until is absolute wall
+// time), and a characterization is invalidated only by a
+// hardware-identity fingerprint change — a crash loop longer than the
+// snapshot window must neither launder a flapping chip back to
+// trusted nor throw away a measurement the silicon still matches.
+// Untouched on success and on every other rejection (corrupt/foreign
+// state is never trusted).
 Result<PersistedState> LoadState(const std::string& path,
                                  const std::string& expect_node,
                                  double max_age_s, double now_wall,
-                                 std::string* stale_healthsm_json = nullptr);
+                                 std::string* stale_healthsm_json = nullptr,
+                                 std::string* stale_perf_json = nullptr);
 
 }  // namespace sched
 }  // namespace tfd

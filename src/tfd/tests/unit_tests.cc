@@ -40,6 +40,7 @@
 #include "tfd/obs/journal.h"
 #include "tfd/obs/metrics.h"
 #include "tfd/obs/server.h"
+#include "tfd/perf/perf.h"
 #include "tfd/pjrt/pjrt_binding.h"
 #include "tfd/platform/detect.h"
 #include "tfd/resource/factory.h"
@@ -3449,6 +3450,384 @@ void TestHttpResponseHeaders() {
   CHECK_TRUE(date->RetryAfterSeconds() == 0.0);
 }
 
+// ---- perf characterization (src/tfd/perf/) -------------------------------
+
+void TestPerfClassificationGrid() {
+  // The parity grid: tests/test_perf.py runs tpufd.perfmodel.classify
+  // over the SAME cases — any drift between the C++ and Python
+  // thresholds fails one of the two suites.
+  struct Case {
+    double matmul, hbm;
+    int prev;
+    int want;
+  };
+  const Case cases[] = {
+      {95, 80, -1, perf::kRankGold},
+      {95, 65, -1, perf::kRankSilver},   // hbm under the gold bar
+      {89, 80, -1, perf::kRankSilver},
+      {95, -1, -1, perf::kRankGold},     // unknown hbm: matmul gates
+      {-1, 80, -1, perf::kRankSilver},   // unknown matmul: never gold
+      {49, 80, -1, perf::kRankDegraded},
+      {95, 45, -1, perf::kRankDegraded},
+      // Hysteresis: leaving a class needs the margin cleared.
+      {89, 80, perf::kRankGold, perf::kRankGold},
+      {86, 80, perf::kRankGold, perf::kRankSilver},
+      {91, 80, perf::kRankSilver, perf::kRankSilver},
+      {94, 80, perf::kRankSilver, perf::kRankGold},
+      {49, 80, perf::kRankSilver, perf::kRankSilver},
+      {46, 80, perf::kRankSilver, perf::kRankDegraded},
+      {51, 80, perf::kRankDegraded, perf::kRankDegraded},
+      {54, 80, perf::kRankDegraded, perf::kRankSilver},
+      {95, 80, perf::kRankDegraded, perf::kRankGold},
+  };
+  for (const Case& c : cases) {
+    CHECK_EQ(perf::ClassifyPct(c.matmul, c.hbm, c.prev), c.want);
+  }
+  CHECK_EQ(std::string(perf::ClassName(perf::kRankGold)), "gold");
+  CHECK_EQ(perf::ClassRankFromName("degraded"), perf::kRankDegraded);
+  CHECK_EQ(perf::ClassRankFromName("platinum"), -1);
+  // pct-of-rated math mirrors tpufd.health.pct_of_rated.
+  CHECK_TRUE(perf::PctOfRated(98.5, 197.0) == 50.0);
+  CHECK_TRUE(perf::PctOfRated(100, 0) == -1);
+  CHECK_TRUE(perf::PctOfRated(-1, 197.0) == -1);
+}
+
+void TestPerfRatedSpecs() {
+  const std::map<std::string, perf::RatedSpec>& baked =
+      perf::BakedRatedSpecs();
+  CHECK_EQ(baked.size(), 6u);
+  CHECK_TRUE(baked.at("v5e").matmul_tflops == 197.0);
+  CHECK_TRUE(baked.at("v5p").hbm_gbps == 2765.0);
+
+  Result<std::map<std::string, perf::RatedSpec>> parsed =
+      perf::ParseRatedSpecs(
+          "{\"families\":{\"v5e\":{\"matmul_tflops\":197.0,"
+          "\"hbm_gbps\":819.0}}}");
+  CHECK_TRUE(parsed.ok());
+  CHECK_TRUE(parsed->at("v5e").hbm_gbps == 819.0);
+  CHECK_TRUE(!perf::ParseRatedSpecs("{}").ok());
+  CHECK_TRUE(!perf::ParseRatedSpecs("{\"families\":{}}").ok());
+  CHECK_TRUE(!perf::ParseRatedSpecs(
+                  "{\"families\":{\"v5e\":{\"matmul_tflops\":-1,"
+                  "\"hbm_gbps\":819}}}")
+                  .ok());
+
+  // Parity with the checked-in single source of truth: the baked table
+  // must match tpufd/rated_specs.json value for value (the tier-1 run
+  // executes from the repo root; a manual run from elsewhere skips).
+  for (const char* path :
+       {"tpufd/rated_specs.json", "../tpufd/rated_specs.json"}) {
+    if (!FileExists(path)) continue;
+    Result<std::string> text = ReadFile(path);
+    CHECK_TRUE(text.ok());
+    Result<std::map<std::string, perf::RatedSpec>> file_specs =
+        perf::ParseRatedSpecs(*text);
+    CHECK_TRUE(file_specs.ok());
+    CHECK_EQ(file_specs->size(), baked.size());
+    for (const auto& [family, spec] : *file_specs) {
+      CHECK_TRUE(baked.count(family) == 1);
+      CHECK_TRUE(baked.at(family).matmul_tflops == spec.matmul_tflops);
+      CHECK_TRUE(baked.at(family).hbm_gbps == spec.hbm_gbps);
+    }
+    break;
+  }
+}
+
+void TestPerfSerializeRoundTrip() {
+  perf::Characterization c;
+  c.fingerprint = "v5e/4/2x2/2.9.0";
+  c.family = "v5e";
+  c.measured_at = 1234.5;
+  c.measure_seconds = 61.25;
+  c.matmul_tflops = 193.25;
+  c.hbm_gbps = 650.5;
+  c.ici_gbps = 40.125;
+  c.matmul_pct = 98.1;
+  c.hbm_pct = 79.4;
+  c.class_rank = perf::kRankGold;
+  std::string json = perf::SerializeCharacterization(c);
+  Result<perf::Characterization> parsed = perf::ParseCharacterization(json);
+  CHECK_TRUE(parsed.ok());
+  CHECK_EQ(parsed->fingerprint, "v5e/4/2x2/2.9.0");
+  CHECK_EQ(parsed->family, "v5e");
+  CHECK_TRUE(parsed->matmul_tflops == 193.25);
+  CHECK_TRUE(parsed->ici_gbps == 40.125);
+  CHECK_EQ(parsed->class_rank, perf::kRankGold);
+
+  // A tampered field fails the perf section's OWN checksum: the gate
+  // that lets a corrupt perf payload be rejected independently of the
+  // label payload.
+  std::string tampered = json;
+  size_t pos = tampered.find("193.250");
+  CHECK_TRUE(pos != std::string::npos);
+  tampered.replace(pos, 7, "250.193");
+  Result<perf::Characterization> bad =
+      perf::ParseCharacterization(tampered);
+  CHECK_TRUE(!bad.ok());
+  CHECK_TRUE(bad.error().find("checksum") != std::string::npos);
+
+  CHECK_TRUE(!perf::ParseCharacterization("{").ok());
+  CHECK_TRUE(!perf::ParseCharacterization("{}").ok());
+  // Unknown class names and schemas are distinct, loud rejections.
+  std::string unknown_class = json;
+  pos = unknown_class.find("\"gold\"");
+  unknown_class.replace(pos, 6, "\"plat\"");
+  CHECK_TRUE(!perf::ParseCharacterization(unknown_class).ok());
+
+  // Cache round trip incl. the empty (pre-PR-9) payload.
+  perf::Cache cache;
+  CHECK_TRUE(cache.RestoreJson("").ok());
+  CHECK_TRUE(!cache.Get().has_value());
+  CHECK_TRUE(cache.RestoreJson(json).ok());
+  CHECK_TRUE(cache.Get().has_value());
+  CHECK_EQ(cache.Get()->class_rank, perf::kRankGold);
+  CHECK_EQ(cache.SerializeJson(), json);
+  // Garbage never clobbers a good cache.
+  CHECK_TRUE(!cache.RestoreJson("garbage").ok());
+  CHECK_TRUE(cache.Get().has_value());
+  cache.Invalidate();
+  CHECK_EQ(cache.SerializeJson(), "");
+}
+
+void TestPerfExecParse() {
+  Result<std::map<std::string, double>> parsed = perf::ParseExecOutput(
+      "matmul-tflops=193.2\nhbm-gbps=650\nici-gbps=40.5\n"
+      "bogus line\nunknown-key=7\n");
+  CHECK_TRUE(parsed.ok());
+  CHECK_TRUE(parsed->at("matmul-tflops") == 193.2);
+  CHECK_TRUE(parsed->at("hbm-gbps") == 650.0);
+  CHECK_TRUE(parsed->at("ici-gbps") == 40.5);
+  CHECK_EQ(parsed->size(), 3u);
+  CHECK_TRUE(!perf::ParseExecOutput("").ok());
+  CHECK_TRUE(!perf::ParseExecOutput("nothing useful\n").ok());
+  // ici alone is context, not a characterization.
+  CHECK_TRUE(!perf::ParseExecOutput("ici-gbps=40\n").ok());
+}
+
+void TestPerfDutyCycle() {
+  // First measurement is always allowed.
+  CHECK_TRUE(perf::MeasureAllowed(100, 0, 0, 1));
+  // 60s measurement at 1% duty: next start >= end + 60*(100-1) = +5940.
+  CHECK_TRUE(!perf::MeasureAllowed(1000 + 5939, 1000, 60, 1));
+  CHECK_TRUE(perf::MeasureAllowed(1000 + 5940, 1000, 60, 1));
+  // 50% duty: gap equals the measurement itself.
+  CHECK_TRUE(!perf::MeasureAllowed(1059, 1000, 60, 50));
+  CHECK_TRUE(perf::MeasureAllowed(1060, 1000, 60, 50));
+  // 100% duty disables the bound.
+  CHECK_TRUE(perf::MeasureAllowed(1000, 1000, 60, 100));
+  perf::Cache cache;
+  CHECK_TRUE(cache.AllowedNow(0, 1));
+  cache.NoteMeasurement(1000, 60);
+  CHECK_TRUE(!cache.AllowedNow(1001, 1));
+  CHECK_TRUE(cache.AllowedNow(7000, 1));
+}
+
+void TestPerfLabels() {
+  perf::Characterization c;
+  c.matmul_tflops = 193.2;
+  c.hbm_gbps = 650.4;
+  c.ici_gbps = 40.0;
+  c.matmul_pct = 98.07;
+  c.class_rank = perf::kRankGold;
+  std::map<std::string, std::string> labels = perf::BuildLabels(c);
+  CHECK_EQ(labels.at(lm::kPerfMatmulTflops), "193");
+  CHECK_EQ(labels.at(lm::kPerfHbmGbps), "650");
+  CHECK_EQ(labels.at(lm::kPerfIciGbps), "40");
+  CHECK_EQ(labels.at(lm::kPerfPctOfRated), "98");
+  CHECK_EQ(labels.at(lm::kPerfClass), "gold");
+  // Unmeasured fields stay absent rather than publishing zeros.
+  perf::Characterization sparse;
+  sparse.matmul_tflops = 0.43;  // small-but-real CI measurement
+  sparse.class_rank = perf::kRankSilver;
+  labels = perf::BuildLabels(sparse);
+  CHECK_EQ(labels.at(lm::kPerfMatmulTflops), "0.43");
+  CHECK_TRUE(labels.count(lm::kPerfHbmGbps) == 0);
+  CHECK_TRUE(labels.count(lm::kPerfPctOfRated) == 0);
+  CHECK_EQ(labels.at(lm::kPerfClass), "silver");
+  CHECK_EQ(labels.size(), 2u);
+}
+
+void TestPerfStateSectionIndependence() {
+  // The perf payload rides the state file as its OWN schema section: a
+  // pre-perf file restores labels normally with no perf payload, and a
+  // corrupt perf section is rejected alone — the label payload
+  // survives.
+  sched::PersistedState state;
+  state.node = "unit-node";
+  state.saved_at = 1000.0;
+  state.source = "mock";
+  state.tier = "fresh";
+  state.labels = {{"google.com/tpu.count", "4"}};
+
+  // Forward compat: no perf section at all (pre-PR-9 writer).
+  std::string framed = sched::SerializeState(state);
+  Result<sched::PersistedState> parsed = sched::ParseState(framed);
+  CHECK_TRUE(parsed.ok());
+  CHECK_EQ(parsed->perf_json, "");
+
+  perf::Characterization c;
+  c.fingerprint = "v2/4/2x2/-";
+  c.family = "v2";
+  c.measured_at = 900;
+  c.matmul_tflops = 44;
+  c.class_rank = perf::kRankGold;
+  state.perf_json = perf::SerializeCharacterization(c);
+  framed = sched::SerializeState(state);
+  parsed = sched::ParseState(framed);
+  CHECK_TRUE(parsed.ok());
+  CHECK_TRUE(!parsed->perf_json.empty());
+  CHECK_TRUE(perf::ParseCharacterization(parsed->perf_json).ok());
+
+  // Corrupt the perf section's CONTENT (outer frame recomputed, so the
+  // file-level checksum passes — the inner gate must catch it without
+  // failing the labels).
+  sched::PersistedState corrupt = state;
+  size_t pos = corrupt.perf_json.find("\"v2\"");
+  CHECK_TRUE(pos != std::string::npos);
+  corrupt.perf_json.replace(pos, 4, "\"v3\"");
+  framed = sched::SerializeState(corrupt);
+  parsed = sched::ParseState(framed);
+  CHECK_TRUE(parsed.ok());  // labels fine
+  CHECK_EQ(parsed->labels.at("google.com/tpu.count"), "4");
+  Result<perf::Characterization> inner =
+      perf::ParseCharacterization(parsed->perf_json);
+  CHECK_TRUE(!inner.ok());
+  CHECK_TRUE(inner.error().find("checksum") != std::string::npos);
+
+  // The stale-rejection path hands the perf section out like the
+  // healthsm one: a characterization's validity is its fingerprint,
+  // not the label payload's age.
+  std::string dir = "/tmp/tfd-unit-perf-state-" + std::to_string(getpid());
+  std::string path = dir + "/state";
+  CHECK_TRUE(sched::SaveState(path, state).ok());
+  std::string stale_health, stale_perf;
+  Result<sched::PersistedState> stale = sched::LoadState(
+      path, "unit-node", 600, 1000.0 + 3600, &stale_health, &stale_perf);
+  CHECK_TRUE(!stale.ok());
+  // The transport may reformat the JSON (jsonlite round trip); the
+  // canonical-field checksum must still validate and the payload must
+  // be semantically intact.
+  Result<perf::Characterization> stale_parsed =
+      perf::ParseCharacterization(stale_perf);
+  CHECK_TRUE(stale_parsed.ok());
+  CHECK_EQ(stale_parsed->fingerprint, "v2/4/2x2/-");
+  CHECK_TRUE(stale_parsed->matmul_tflops == 44.0);
+  // ...but a FOREIGN node's perf section is never handed out.
+  stale_perf = "untouched";
+  stale = sched::LoadState(path, "other-node", 600, 1000.0 + 3600,
+                           &stale_health, &stale_perf);
+  CHECK_TRUE(!stale.ok());
+  CHECK_EQ(stale_perf, "untouched");
+  std::string cmd = "rm -rf " + dir;
+  CHECK_TRUE(system(cmd.c_str()) == 0);
+}
+
+void TestGovernorPerfClassDemotion() {
+  lm::LabelGovernor governor(lm::GovernorPolicy{300, 6});
+  lm::Labels previous = {{lm::kPerfClass, "gold"},
+                         {"google.com/tpu.count", "4"}};
+  lm::Provenance prev_prov;
+  governor.NotePublished(previous, 1000.0);
+
+  // A demotion inside the hold-down window passes (conservative
+  // direction; the characterization pipeline already debounced it).
+  lm::Labels candidate = previous;
+  candidate[lm::kPerfClass] = "degraded";
+  lm::Provenance provenance;
+  std::vector<lm::SuppressedFlip> suppressed;
+  governor.Apply(previous, prev_prov, false, 1010.0, &candidate,
+                 &provenance, &suppressed);
+  CHECK_TRUE(suppressed.empty());
+  CHECK_EQ(candidate.at(lm::kPerfClass), "degraded");
+  governor.CommitPublished();
+
+  // The promotion straight back inside the hold-down is governed.
+  lm::Labels degraded_set = candidate;
+  lm::Labels promote = degraded_set;
+  promote[lm::kPerfClass] = "gold";
+  suppressed.clear();
+  governor.Apply(degraded_set, prev_prov, false, 1020.0, &promote,
+                 &provenance, &suppressed);
+  CHECK_EQ(suppressed.size(), 1u);
+  CHECK_EQ(candidate.at(lm::kPerfClass), "degraded");
+  CHECK_EQ(promote.at(lm::kPerfClass), "degraded");  // held
+  // Past the hold-down, the promotion lands.
+  promote[lm::kPerfClass] = "gold";
+  suppressed.clear();
+  governor.Apply(degraded_set, prev_prov, false, 1400.0, &promote,
+                 &provenance, &suppressed);
+  CHECK_TRUE(suppressed.empty());
+  CHECK_EQ(promote.at(lm::kPerfClass), "gold");
+}
+
+void TestHealthsmClassRankDebounce() {
+  healthsm::Policy policy;
+  policy.flap_window_s = 300;
+  policy.flap_threshold = 6;
+  policy.unhealthy_after = 2;
+  policy.recover_after = 3;
+  healthsm::HealthTracker tracker(policy);
+
+  const std::string fp = "v2/4/2x2/-";
+  // First characterization publishes immediately.
+  CHECK_EQ(tracker.ObserveClassRank("perf", perf::kRankGold, fp, 1000),
+           perf::kRankGold);
+  // One throttled round never demotes...
+  CHECK_EQ(tracker.ObserveClassRank("perf", perf::kRankDegraded, fp, 1010),
+           perf::kRankGold);
+  // ...agreement dissolves the streak...
+  CHECK_EQ(tracker.ObserveClassRank("perf", perf::kRankGold, fp, 1020),
+           perf::kRankGold);
+  CHECK_EQ(tracker.ObserveClassRank("perf", perf::kRankDegraded, fp, 1030),
+           perf::kRankGold);
+  // ...two consecutive demotion verdicts land it (unhealthy_after=2).
+  CHECK_EQ(tracker.ObserveClassRank("perf", perf::kRankDegraded, fp, 1040),
+           perf::kRankDegraded);
+  // Promotion is earned: recover_after=3 consecutive gold verdicts.
+  CHECK_EQ(tracker.ObserveClassRank("perf", perf::kRankGold, fp, 1050),
+           perf::kRankDegraded);
+  CHECK_EQ(tracker.ObserveClassRank("perf", perf::kRankGold, fp, 1060),
+           perf::kRankDegraded);
+  CHECK_EQ(tracker.ObserveClassRank("perf", perf::kRankGold, fp, 1070),
+           perf::kRankGold);
+  // A candidate switch mid-streak restarts the count.
+  CHECK_EQ(tracker.ObserveClassRank("perf", perf::kRankSilver, fp, 1080),
+           perf::kRankGold);
+  CHECK_EQ(tracker.ObserveClassRank("perf", perf::kRankDegraded, fp, 1090),
+           perf::kRankGold);
+  CHECK_EQ(tracker.ObserveClassRank("perf", perf::kRankDegraded, fp, 1100),
+           perf::kRankDegraded);
+
+  // The debounce state serializes with the tracker: a half-built
+  // streak survives kill -9.
+  healthsm::HealthTracker restored;
+  CHECK_EQ(restored.ObserveClassRank("perf", perf::kRankGold, fp, 1000),
+           perf::kRankGold);
+  CHECK_EQ(restored.ObserveClassRank("perf", perf::kRankDegraded, fp, 1010),
+           perf::kRankGold);  // streak of 1 pending
+  std::string json = restored.SerializeJson(1010);
+  healthsm::HealthTracker fresh;
+  CHECK_TRUE(fresh.RestoreJson(json, 1020).ok());
+  // One more demotion verdict completes the restored streak.
+  CHECK_EQ(fresh.ObserveClassRank("perf", perf::kRankDegraded, fp, 1030),
+           perf::kRankDegraded);
+  // ...but a DIFFERENT hardware fingerprint voids restored history:
+  // the replacement chip's first verdict publishes immediately instead
+  // of being debounced against the old chip's class (the rank state
+  // can outlive the perf cache across a swap).
+  healthsm::HealthTracker swapped;
+  CHECK_TRUE(swapped.RestoreJson(json, 1020).ok());
+  CHECK_EQ(swapped.ObserveClassRank("perf", perf::kRankGold,
+                                    "v2/4/2x2/new", 1030),
+           perf::kRankGold);
+
+  // Fingerprint change: ResetClassRank forgets history, the next
+  // verdict publishes immediately.
+  tracker.ResetClassRank("perf");
+  CHECK_EQ(tracker.ObserveClassRank("perf", perf::kRankSilver, fp, 1200),
+           perf::kRankSilver);
+}
+
 }  // namespace
 }  // namespace tfd
 
@@ -3558,6 +3937,15 @@ int main(int argc, char** argv) {
   tfd::TestSinkConflictExhaustion();
   tfd::TestSinkRetryAfterAndDefer();
   tfd::TestHttpResponseHeaders();
+  tfd::TestPerfClassificationGrid();
+  tfd::TestPerfRatedSpecs();
+  tfd::TestPerfSerializeRoundTrip();
+  tfd::TestPerfExecParse();
+  tfd::TestPerfDutyCycle();
+  tfd::TestPerfLabels();
+  tfd::TestPerfStateSectionIndependence();
+  tfd::TestGovernorPerfClassDemotion();
+  tfd::TestHealthsmClassRankDebounce();
 
   std::cerr << tfd::g_checks << " checks, " << tfd::g_failures << " failures"
             << std::endl;

@@ -113,4 +113,19 @@ std::string HexU64(uint64_t v) {
   return buf;
 }
 
+std::string Fixed3(double v) {
+  char buf[32];
+  snprintf(buf, sizeof(buf), "%.3f", v);
+  return buf;
+}
+
+uint64_t Fnv1a64(const std::string& data) {
+  uint64_t hash = 1469598103934665603ULL;
+  for (unsigned char c : data) {
+    hash ^= c;
+    hash *= 1099511628211ULL;
+  }
+  return hash;
+}
+
 }  // namespace tfd

@@ -33,5 +33,18 @@ bool ParseNonNegInt(const std::string& s, int* out);
 // Fixed-width (16 digit) lowercase hex — the state-file checksum and
 // the healthsm fingerprint serialization share one format.
 std::string HexU64(uint64_t v);
+// FNV-1a-shaped integrity checksum over the whole string — the shared
+// primitive behind the state-file framing and the perf-section
+// checksum. An accident detector, never an authenticity check. NOTE:
+// it keeps the state file's HISTORICAL offset basis (a truncated
+// digit of the textbook constant) for on-disk compatibility with
+// every persisted state in the fleet; k8s/desync.h's Fnv1a64 is the
+// textbook variant, pinned separately by its Python twin.
+uint64_t Fnv1a64(const std::string& data);
+// Fixed three-decimal float formatting ("%.3f") — the shared canonical
+// number format of the state-file payload and the perf-section
+// checksum: writer and reader must round-trip byte-identically, so
+// there is exactly one copy of the format.
+std::string Fixed3(double v);
 
 }  // namespace tfd

@@ -1,0 +1,511 @@
+"""Tier 2/3: the cached perf-characterization source (ISSUE 9) against
+the real binary.
+
+The amortization contract under test:
+  - a 30-pass soak with the perf source enabled runs the measurement
+    exec exactly ONCE (one `perf-measure` journal round), publishes the
+    five google.com/tpu.perf.* labels, and leaves the no-op fast path
+    carrying the cadence;
+  - kill -9 serves tpu.perf.* from the restored state file with ZERO
+    re-measurement (`perf-restored` journaled);
+  - a mock topology change moves the hardware-identity fingerprint and
+    triggers exactly one re-characterization;
+  - a simulated throttling chip demotes gold -> degraded through the
+    health-ladder debounce with <= 2 changes of the class label over a
+    30-pass soak;
+  - forward compat: a pre-PR-9 state file (no perf section) restores
+    labels/healthsm normally and triggers exactly one characterization;
+    a corrupt perf section is rejected independently (`perf-rejected`)
+    without discarding the label payload;
+  - an injected `probe.perf` hang stalls only the perf worker — every
+    other source keeps labeling on cadence;
+  - the classification model is parity-pinned against the C++ grid and
+    the checked-in rated_specs.json is the single rated-spec source.
+"""
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import time
+
+from conftest import FIXTURES, http_get, labels_of, wait_for
+from tpufd import journal as tpufd_journal
+from tpufd import metrics, perfmodel
+from tpufd.fakes import free_loopback_port as free_port
+
+PERF_KEYS = [
+    "google.com/tpu.perf.matmul-tflops",
+    "google.com/tpu.perf.hbm-gbps",
+    "google.com/tpu.perf.ici-gbps",
+    "google.com/tpu.perf.pct-of-rated",
+    "google.com/tpu.perf.class",
+]
+
+
+def scrape(port, name, labels=None):
+    status, text = http_get(port, "/metrics")
+    if status != 200:
+        return None
+    try:
+        return metrics.sample_value(text, name, labels=labels)
+    except ValueError:
+        return None
+
+
+def journal_events(port, kind=""):
+    status, body = http_get(port, f"/debug/journal?n=4096&type={kind}")
+    if status != 200:
+        return []
+    try:
+        return tpufd_journal.parse_journal(json.loads(body))["events"]
+    except (ValueError, KeyError):
+        return []
+
+
+def launch(argv, env_extra=None):
+    env = {**os.environ, "GCE_METADATA_HOST": "127.0.0.1:1",
+           **(env_extra or {})}
+    return subprocess.Popen(argv, env=env, stderr=subprocess.DEVNULL)
+
+
+def write_fake_exec(tmp_path, matmul=44.0, hbm=630.0, ici=40.0):
+    """A controllable measurement exec: counts invocations (the
+    amortization proof) and prints whatever values.txt currently holds,
+    so a test can simulate thermal throttling by rewriting the file."""
+    count = tmp_path / "measure_count"
+    values = tmp_path / "values.txt"
+    script = tmp_path / "perf_exec.sh"
+    set_fake_values(tmp_path, matmul=matmul, hbm=hbm, ici=ici)
+    script.write_text(f"echo run >> {count}\ncat {values}\n")
+    return script, count, values
+
+
+def set_fake_values(tmp_path, matmul, hbm, ici=40.0):
+    (tmp_path / "values.txt").write_text(
+        f"matmul-tflops={matmul}\nhbm-gbps={hbm}\nici-gbps={ici}\n")
+
+
+def measure_count(count_file):
+    try:
+        return len(count_file.read_text().splitlines())
+    except OSError:
+        return 0
+
+
+def file_labels(tmp_path):
+    """Labels currently in the emitted feature file ({} before the
+    first write lands)."""
+    try:
+        return labels_of((tmp_path / "tfd").read_text())
+    except OSError:
+        return {}
+
+
+def perf_argv(binary, port, tmp_path, fixture, script, extra=()):
+    return [str(binary), "--sleep-interval=1s", "--backend=mock",
+            f"--mock-topology-file={fixture}",
+            "--machine-type-file=/dev/null",
+            f"--output-file={tmp_path / 'tfd'}",
+            f"--state-file={tmp_path / 'state'}",
+            "--journal-capacity=2048",
+            "--perf-characterize", f"--perf-exec=sh {script}",
+            # Generous duty budget: the fake exec is milliseconds, and
+            # these drills deliberately re-characterize on demand.
+            "--perf-duty-cycle-pct=50",
+            # Tight hold-down so deliberate changes land (the governor's
+            # own contracts are pinned by its unit suites).
+            "--health-flap-window=2s", "--health-flap-threshold=6",
+            f"--introspection-addr=127.0.0.1:{port}", *extra]
+
+
+def wait_passes(port, n, timeout=60):
+    assert wait_for(
+        lambda: (scrape(port, "tfd_rewrites_total") or 0) >= n,
+        timeout=timeout), f"never reached {n} passes"
+
+
+def stop(proc):
+    if proc.poll() is None:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+class TestAmortizedCharacterization:
+    def test_soak_measures_once_and_kill9_restores_without_remeasure(
+            self, tfd_binary, tmp_path):
+        """The headline acceptance soak: 30 passes = ONE perf-measure
+        round, published labels parity-checked against the Python twin,
+        fast path intact; kill -9 then serves tpu.perf.* from the
+        restored state with zero re-measurement."""
+        fixture = tmp_path / "topology.yaml"
+        shutil.copy(FIXTURES / "v2-8.yaml", fixture)
+        script, count, _ = write_fake_exec(tmp_path)
+        port = free_port()
+        proc = launch(perf_argv(tfd_binary, port, tmp_path, fixture,
+                                script))
+        try:
+            assert wait_for(lambda: measure_count(count) >= 1, timeout=30)
+            assert wait_for(
+                lambda: "google.com/tpu.perf.class" in file_labels(
+                    tmp_path), timeout=20)
+            labels = file_labels(tmp_path)
+            # Parity oracle: the daemon's five labels must match the
+            # Python twin's rendering of the same measurements (v2
+            # rated specs from the shared rated_specs.json).
+            expected = perfmodel.expected_labels(
+                44.0, 630.0, 40.0, "v2",
+                perfmodel.classify(
+                    perfmodel.pct_of_rated(
+                        44.0, perfmodel.load_rated_specs()["v2"]
+                        ["matmul_tflops"]),
+                    perfmodel.pct_of_rated(
+                        630.0, perfmodel.load_rated_specs()["v2"]
+                        ["hbm_gbps"])))
+            for key, value in expected.items():
+                assert labels.get(key) == value, (key, value, labels)
+            assert labels["google.com/tpu.perf.class"] == "gold"
+
+            wait_passes(port, 30, timeout=90)
+            assert measure_count(count) == 1, (
+                "steady state re-measured: amortization broken")
+            measures = journal_events(port, "perf-measure")
+            assert len(measures) == 1
+            assert measures[0]["fields"]["reason"] == "never-characterized"
+            # The perf source must not tax the no-op fast path: the
+            # soak's passes still overwhelmingly short-circuit.
+            passes = scrape(port, "tfd_rewrites_total") or 0
+            fast = scrape(port, "tfd_pass_fast_total") or 0
+            assert fast >= passes - 6, f"{fast} fast of {passes}"
+            assert (scrape(port, "tfd_perf_measures_total") or 0) == 1
+
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=10)
+            port2 = free_port()
+            proc = launch(perf_argv(tfd_binary, port2, tmp_path, fixture,
+                                    script))
+            wait_passes(port2, 3, timeout=30)
+            restored = journal_events(port2, "perf-restored")
+            assert restored, "perf characterization was not restored"
+            assert restored[0]["fields"]["class"] == "gold"
+            # The restore is milliseconds, not a re-measurement.
+            assert float(restored[0]["fields"]["duration_us"]) < 15000
+            assert measure_count(count) == 1, (
+                "restart re-measured: the restored characterization "
+                "was not trusted")
+            assert not journal_events(port2, "perf-measure")
+            labels = file_labels(tmp_path)
+            for key in PERF_KEYS:
+                assert key in labels, f"{key} missing after warm restart"
+        finally:
+            stop(proc)
+
+    def test_topology_change_recharacterizes_exactly_once(
+            self, tfd_binary, tmp_path):
+        """A chip-count change moves the hardware-identity fingerprint:
+        the cached characterization is invalidated and exactly one
+        fresh measurement runs (reason=fingerprint-changed)."""
+        fixture = tmp_path / "topology.yaml"
+        shutil.copy(FIXTURES / "v2-8.yaml", fixture)
+        script, count, _ = write_fake_exec(tmp_path)
+        port = free_port()
+        proc = launch(perf_argv(tfd_binary, port, tmp_path, fixture,
+                                script))
+        try:
+            assert wait_for(lambda: measure_count(count) >= 1, timeout=30)
+            wait_passes(port, 5)
+            fixture.write_text(
+                fixture.read_text().replace("count: 4", "count: 2")
+                .replace("chipsPerHost: 4", "chipsPerHost: 2"))
+            assert wait_for(
+                lambda: file_labels(tmp_path)
+                .get("google.com/tpu.count") == "2", timeout=30)
+            assert wait_for(lambda: measure_count(count) == 2, timeout=30)
+            measures = journal_events(port, "perf-measure")
+            assert len(measures) == 2
+            assert measures[-1]["fields"]["reason"] == "fingerprint-changed"
+            assert "/2/" in measures[-1]["fields"]["fingerprint"]
+            # ...and exactly once: the fingerprint settles, so no storm.
+            wait_passes(port, (scrape(port, "tfd_rewrites_total") or 0) + 5)
+            assert measure_count(count) == 2
+        finally:
+            stop(proc)
+
+    def test_throttling_chip_demotes_class_with_bounded_churn(
+            self, tfd_binary, tmp_path):
+        """A thermally-throttling chip (measurements collapse to 43% of
+        rated) DEMOTES gold -> degraded through the health-ladder
+        debounce — two consecutive agreeing re-measures — instead of
+        flapping: <= 2 changes of the class label across the soak, with
+        the perf-class-change event journaled."""
+        fixture = tmp_path / "topology.yaml"
+        shutil.copy(FIXTURES / "v2-8.yaml", fixture)
+        script, count, _ = write_fake_exec(tmp_path)
+        port = free_port()
+        # Fast recheck so the drill's re-verification cadence fits the
+        # test budget; production defaults are hours.
+        proc = launch(perf_argv(tfd_binary, port, tmp_path, fixture,
+                                script,
+                                extra=["--perf-recheck-interval=1s",
+                                       "--perf-duty-cycle-pct=100"]))
+        try:
+            assert wait_for(
+                lambda: file_labels(tmp_path)
+                .get("google.com/tpu.perf.class") == "gold", timeout=30)
+            # Throttle: v2 rated 46 TFLOPS -> 20 measures 43% (degraded
+            # floor is 50%).
+            set_fake_values(tmp_path, matmul=20.0, hbm=630.0)
+            assert wait_for(
+                lambda: file_labels(tmp_path)
+                .get("google.com/tpu.perf.class") == "degraded",
+                timeout=45), "throttling chip never demoted"
+            # Debounce proof: more than one measurement agreed first.
+            assert measure_count(count) >= 3
+            changes = journal_events(port, "perf-class-change")
+            assert changes
+            assert changes[-1]["fields"]["from"] == "gold"
+            assert changes[-1]["fields"]["to"] == "degraded"
+
+            wait_passes(port, 30, timeout=90)
+            class_diffs = [
+                e for e in journal_events(port, "label-diff")
+                if e["fields"].get("key") == "google.com/tpu.perf.class"
+                and e["fields"].get("op") != "added"]
+            assert len(class_diffs) <= 2, (
+                f"class label churned {len(class_diffs)} times: "
+                f"{class_diffs}")
+            # Published class stays demoted (no flap back).
+            assert file_labels(tmp_path)[
+                "google.com/tpu.perf.class"] == "degraded"
+        finally:
+            stop(proc)
+
+
+class TestStateForwardCompat:
+    def test_pre_perf_state_restores_and_characterizes_once(
+            self, tfd_binary, tmp_path):
+        """A state file written WITHOUT the perf source (the pre-PR-9
+        layout) restores labels normally — and the perf source, seeing
+        no cached characterization, measures exactly once."""
+        fixture = tmp_path / "topology.yaml"
+        shutil.copy(FIXTURES / "v2-8.yaml", fixture)
+        script, count, _ = write_fake_exec(tmp_path)
+        port = free_port()
+        # Phase 1: no perf source; leaves a perf-less state file.
+        argv = [str(tfd_binary), "--sleep-interval=1s", "--backend=mock",
+                f"--mock-topology-file={fixture}",
+                "--machine-type-file=/dev/null",
+                f"--output-file={tmp_path / 'tfd'}",
+                f"--state-file={tmp_path / 'state'}",
+                f"--introspection-addr=127.0.0.1:{port}"]
+        proc = launch(argv)
+        try:
+            wait_passes(port, 2)
+        finally:
+            stop(proc)
+        assert (tmp_path / "state").exists()
+        assert measure_count(count) == 0
+
+        # Phase 2: perf enabled against the old file.
+        port2 = free_port()
+        proc = launch(perf_argv(tfd_binary, port2, tmp_path, fixture,
+                                script))
+        try:
+            wait_passes(port2, 2, timeout=30)
+            warm = journal_events(port2, "warm-restart")
+            assert warm, "label payload was not warm-restored"
+            assert not journal_events(port2, "perf-restored")
+            assert not journal_events(port2, "perf-rejected")
+            assert wait_for(lambda: measure_count(count) == 1, timeout=30)
+            assert wait_for(
+                lambda: "google.com/tpu.perf.class" in file_labels(
+                    tmp_path), timeout=20)
+            wait_passes(port2, 10, timeout=30)
+            assert measure_count(count) == 1
+        finally:
+            stop(proc)
+
+    def test_disabled_perf_source_discards_the_section(
+            self, tfd_binary, tmp_path):
+        """Turning --perf-characterize OFF discards a leftover perf
+        section: no perf-restored journal, no perf labels, no gauge
+        games — and re-enabling later re-characterizes once."""
+        fixture = tmp_path / "topology.yaml"
+        shutil.copy(FIXTURES / "v2-8.yaml", fixture)
+        script, count, _ = write_fake_exec(tmp_path)
+        port = free_port()
+        proc = launch(perf_argv(tfd_binary, port, tmp_path, fixture,
+                                script))
+        try:
+            assert wait_for(lambda: measure_count(count) >= 1, timeout=30)
+            wait_passes(port, 3)
+        finally:
+            stop(proc)
+
+        port2 = free_port()
+        argv = [str(tfd_binary), "--sleep-interval=1s", "--backend=mock",
+                f"--mock-topology-file={fixture}",
+                "--machine-type-file=/dev/null",
+                f"--output-file={tmp_path / 'tfd'}",
+                f"--state-file={tmp_path / 'state'}",
+                f"--introspection-addr=127.0.0.1:{port2}"]
+        proc = launch(argv)
+        try:
+            wait_passes(port2, 3, timeout=30)
+            assert journal_events(port2, "warm-restart")
+            assert not journal_events(port2, "perf-restored"), (
+                "a disabled perf source must not restore the section")
+            assert "google.com/tpu.perf.class" not in file_labels(tmp_path)
+            assert measure_count(count) == 1  # and never measures
+        finally:
+            stop(proc)
+        # The re-saved state file no longer carries the section (the
+        # healthsm payload may still track a source NAMED "perf" — only
+        # the top-level section matters), so re-enabling
+        # re-characterizes exactly once.
+        payload = (tmp_path / "state").read_text().split("\n", 1)[1]
+        assert "perf" not in json.loads(payload)
+
+    def test_corrupt_perf_section_rejected_without_discarding_labels(
+            self, tfd_binary, tmp_path):
+        """A perf section whose OWN checksum fails (torn write, buggy
+        writer) is rejected alone: the label payload still warm-serves,
+        `perf-rejected` is journaled, and exactly one fresh
+        characterization runs."""
+        fixture = tmp_path / "topology.yaml"
+        shutil.copy(FIXTURES / "v2-8.yaml", fixture)
+        script, count, _ = write_fake_exec(tmp_path)
+        port = free_port()
+        proc = launch(perf_argv(tfd_binary, port, tmp_path, fixture,
+                                script))
+        try:
+            assert wait_for(lambda: measure_count(count) >= 1, timeout=30)
+            wait_passes(port, 3)
+        finally:
+            stop(proc)
+
+        # Corrupt ONLY the perf section's content; re-frame the outer
+        # checksum so the file-level gate passes (mirrors state.cc's
+        # FNV-1a framing).
+        def fnv1a(data):
+            h = 1469598103934665603
+            for b in data:
+                h = ((h ^ b) * 1099511628211) % (1 << 64)
+            return h
+
+        state_file = tmp_path / "state"
+        raw = state_file.read_text()
+        header, payload = raw.split("\n", 1)
+        doc = json.loads(payload)
+        assert doc.get("perf", {}).get("class") == "gold"
+        doc["perf"]["class"] = "silver"  # inner sum now wrong
+        new_payload = json.dumps(doc)
+        encoded = new_payload.encode()
+        state_file.write_text(
+            f"TFDSTATE1 {fnv1a(encoded):016x} {len(encoded)}\n"
+            + new_payload)
+
+        port2 = free_port()
+        proc = launch(perf_argv(tfd_binary, port2, tmp_path, fixture,
+                                script))
+        try:
+            wait_passes(port2, 2, timeout=30)
+            assert journal_events(port2, "warm-restart"), (
+                "label payload must survive a corrupt perf section")
+            rejected = journal_events(port2, "perf-rejected")
+            assert rejected
+            assert "checksum" in rejected[0]["fields"]["error"]
+            assert not journal_events(port2, "perf-restored")
+            assert wait_for(lambda: measure_count(count) == 2, timeout=30)
+        finally:
+            stop(proc)
+
+
+class TestPerfChaos:
+    def test_perf_probe_hang_does_not_disturb_other_sources(
+            self, tfd_binary, tmp_path):
+        """An injected probe.perf hang (the chaos drill) stalls ONLY the
+        perf worker: the device source keeps labeling on cadence, the
+        pass pipeline keeps rewriting, and no perf labels are vouched
+        for."""
+        fixture = tmp_path / "topology.yaml"
+        shutil.copy(FIXTURES / "v2-8.yaml", fixture)
+        script, count, _ = write_fake_exec(tmp_path)
+        port = free_port()
+        proc = launch(perf_argv(
+            tfd_binary, port, tmp_path, fixture, script,
+            extra=["--fault-spec=probe.perf:hang=60s"]))
+        try:
+            wait_passes(port, 8, timeout=30)
+            labels = file_labels(tmp_path)
+            assert labels.get("google.com/tpu.count") == "4"
+            assert "google.com/tpu.perf.class" not in labels, (
+                "a hung perf probe must not publish perf labels")
+            assert measure_count(count) == 0
+            # The hang is visible where it should be: the perf worker.
+            starts = [e for e in journal_events(port, "probe-start")
+                      if e.get("source") == "perf"]
+            assert starts, "perf probe never started"
+        finally:
+            stop(proc)
+
+
+class TestModelParity:
+    def test_classification_grid_matches_cpp(self):
+        """The SAME grid as unit_tests.cc TestPerfClassificationGrid:
+        any threshold drift between perf.cc and perfmodel.py fails one
+        of the two suites."""
+        grid = [
+            (95, 80, None, "gold"),
+            (95, 65, None, "silver"),
+            (89, 80, None, "silver"),
+            (95, None, None, "gold"),
+            (None, 80, None, "silver"),
+            (49, 80, None, "degraded"),
+            (95, 45, None, "degraded"),
+            (89, 80, "gold", "gold"),
+            (86, 80, "gold", "silver"),
+            (91, 80, "silver", "silver"),
+            (94, 80, "silver", "gold"),
+            (49, 80, "silver", "silver"),
+            (46, 80, "silver", "degraded"),
+            (51, 80, "degraded", "degraded"),
+            (54, 80, "degraded", "silver"),
+            (95, 80, "degraded", "gold"),
+        ]
+        for matmul, hbm, prev, want in grid:
+            got = perfmodel.classify(matmul, hbm, prev=prev)
+            assert got == want, (matmul, hbm, prev, got, want)
+
+    def test_rated_specs_single_source_of_truth(self):
+        """health.py's module tables, perfmodel's loader, and the
+        checked-in JSON must agree — plus a hard-coded spot check so an
+        accidental edit of the JSON itself trips a test."""
+        from tpufd import health
+
+        specs = perfmodel.load_rated_specs()
+        assert set(specs) == {"v2", "v3", "v4", "v5e", "v5p", "v6e"}
+        for family, spec in specs.items():
+            assert health.RATED_MATMUL_TFLOPS[family] == \
+                spec["matmul_tflops"]
+            assert health.RATED_HBM_GBPS[family] == spec["hbm_gbps"]
+        assert specs["v5e"] == {"matmul_tflops": 197.0, "hbm_gbps": 819.0}
+        assert specs["v5p"] == {"matmul_tflops": 459.0,
+                                "hbm_gbps": 2765.0}
+
+    def test_quarantined_chips_excluded_from_aggregate(self):
+        """The measurement twin skips TFD_PERF_EXCLUDE_CHIPS ids and
+        falls back to all devices when exclusion would leave none."""
+        class Dev:
+            def __init__(self, i):
+                self.id = i
+
+        devices = [Dev(0), Dev(1), Dev(2)]
+        assert perfmodel.excluded_chip_ids({"TFD_PERF_EXCLUDE_CHIPS":
+                                            "0, 2"}) == {"0", "2"}
+        kept = perfmodel.measurement_devices(devices, {"0", "2"})
+        assert [d.id for d in kept] == [1]
+        assert perfmodel.measurement_devices(devices,
+                                             {"0", "1", "2"}) == devices
+        assert perfmodel.excluded_chip_ids({}) == set()

@@ -80,6 +80,13 @@ def cmd_burnin(args):
     return 0 if ok else 1
 
 
+def cmd_perfmodel(args):
+    del args
+    from tpufd import perfmodel
+
+    return perfmodel.main()
+
+
 def cmd_journal(args):
     import json
     import urllib.request
@@ -138,6 +145,16 @@ def main(argv=None):
         help="also write step/ring timing telemetry as a Prometheus "
              "textfile to this path")
     burnin.set_defaults(fn=cmd_burnin)
+
+    perfmodel = sub.add_parser(
+        "perfmodel",
+        help="perf-characterization measurement: run the matmul/HBM/ICI "
+             "micro-benchmarks and print bare matmul-tflops=/hbm-gbps=/"
+             "ici-gbps= lines (the daemon's --perf-exec payload; "
+             "classification stays daemon-side). Honors "
+             "TFD_PERF_EXCLUDE_CHIPS=<id,...> — quarantined chips are "
+             "excluded from the aggregate")
+    perfmodel.set_defaults(fn=cmd_perfmodel)
 
     journal = sub.add_parser(
         "journal", help="pretty-print a daemon's flight recorder")

@@ -51,14 +51,25 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 # carries a few percent of error either way, so a healthy chip's matmul
 # can legitimately read marginally ABOVE 100% of rated (observed:
 # 102.1%); only the DEGRADED_PCT floor is a health judgement.
-RATED_HBM_GBPS = {
-    "v2": 700.0, "v3": 900.0, "v4": 1228.0,
-    "v5e": 819.0, "v5p": 2765.0, "v6e": 1640.0,
-}
-RATED_MATMUL_TFLOPS = {
-    "v2": 46.0, "v3": 123.0, "v4": 275.0,
-    "v5e": 197.0, "v5p": 459.0, "v6e": 918.0,
-}
+def _load_rated_tables():
+    """Loads the per-family rated peaks from the checked-in
+    tpufd/rated_specs.json — the single source of truth shared with the
+    C++ perf source's baked table (src/tfd/perf/perf.cc, parity-pinned
+    by the tests) and tpufd/perfmodel.py. Returns (matmul, hbm) dicts
+    keyed by family short name."""
+    import json
+    from pathlib import Path
+
+    path = Path(__file__).resolve().parent / "rated_specs.json"
+    with open(path) as f:
+        families = json.load(f)["families"]
+    matmul = {fam: float(spec["matmul_tflops"])
+              for fam, spec in families.items()}
+    hbm = {fam: float(spec["hbm_gbps"]) for fam, spec in families.items()}
+    return matmul, hbm
+
+
+RATED_MATMUL_TFLOPS, RATED_HBM_GBPS = _load_rated_tables()
 # Below this share of rated throughput the chip is flagged degraded.
 # Wide on purpose: it must never fire on the normal 75-90% stream
 # efficiency, only on genuinely sick silicon (thermal throttling, a bad

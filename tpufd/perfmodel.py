@@ -1,0 +1,221 @@
+"""Python twin of the daemon's perf-characterization source (src/tfd/perf/).
+
+Two halves:
+
+  1. The MODEL — rated-spec math and class thresholds, mirrored
+     bit-for-bit from perf.cc so the C++ daemon and every Python
+     consumer (bench.py, soak assertions, operators reading labels)
+     classify identically. The parity tests (tests/test_perf.py and
+     the C++ TestPerfClassification grid) pin the two against each
+     other; edit thresholds HERE and THERE together.
+
+  2. The MEASUREMENT CLI — `python -m tpufd perfmodel` runs the
+     matmul/HBM/ICI micro-benchmarks (tpufd.health's differential
+     probes, median-of-3) and prints bare measurement lines
+
+         matmul-tflops=<float>
+         hbm-gbps=<float>
+         ici-gbps=<float>
+
+     which the daemon's `--perf-exec` consumes. Unlike
+     `python -m tpufd health` it does NOT print label lines: the
+     daemon owns classification (rated context, hysteresis, the
+     healthsm demotion debounce) so a stale twin can never publish a
+     class the C++ side would not.
+
+Quarantined chips are EXCLUDED from the aggregate: the daemon exports
+TFD_PERF_EXCLUDE_CHIPS=<id,id,...> (the healthsm-quarantined chip ids)
+and the measurement skips those devices — a chip the health ladder
+already distrusts must not drag the node's published class down; its
+sickness belongs to its quarantine record.
+"""
+
+import json
+import os
+import sys
+from pathlib import Path
+
+# Class names and ranks (larger = worse), mirroring perf.h.
+CLASS_GOLD = "gold"
+CLASS_SILVER = "silver"
+CLASS_DEGRADED = "degraded"
+_RANKS = {CLASS_GOLD: 0, CLASS_SILVER: 1, CLASS_DEGRADED: 2}
+_NAMES = {rank: name for name, rank in _RANKS.items()}
+
+# Thresholds, mirroring perf.h (kGoldMatmulPct / kGoldHbmPct /
+# kDegradedPct / kHysteresisPct). Context for the numbers: healthy
+# silicon reaches ~95%+ of rated matmul but only 75-90% of rated HBM
+# (stream efficiency vs theoretical pin rate — see tpufd/health.py's
+# measured band notes), so gold demands 90/70; the degraded floor is
+# health.DEGRADED_PCT, wide enough that normal stream efficiency can
+# never trip it.
+GOLD_MATMUL_PCT = 90.0
+GOLD_HBM_PCT = 70.0
+DEGRADED_PCT = 50.0
+HYSTERESIS_PCT = 3.0
+
+
+def class_rank(name):
+    """Rank of a class name (gold=0, silver=1, degraded=2); None for
+    unknown names."""
+    return _RANKS.get(name)
+
+
+def rank_name(rank):
+    return _NAMES.get(rank, CLASS_SILVER)
+
+
+def load_rated_specs(path=None):
+    """The checked-in per-family rated peaks (tpufd/rated_specs.json) as
+    {family: {"matmul_tflops": float, "hbm_gbps": float}} — the single
+    source of truth shared with the C++ baked table."""
+    if path is None:
+        path = Path(__file__).resolve().parent / "rated_specs.json"
+    with open(path) as f:
+        doc = json.load(f)
+    families = doc.get("families")
+    if not isinstance(families, dict) or not families:
+        raise ValueError(f"{path} has no 'families' object")
+    out = {}
+    for family, spec in families.items():
+        matmul = float(spec["matmul_tflops"])
+        hbm = float(spec["hbm_gbps"])
+        if matmul <= 0 or hbm <= 0:
+            raise ValueError(f"rated spec for {family} must be positive")
+        out[family] = {"matmul_tflops": matmul, "hbm_gbps": hbm}
+    return out
+
+
+def pct_of_rated(measured, rated):
+    """measured/rated*100, or None when unmeasured/unrated — the twin of
+    perf::PctOfRated (which uses -1 for the same sentinel)."""
+    if rated is None or rated <= 0 or measured is None or measured < 0:
+        return None
+    return 100.0 * measured / rated
+
+
+def _raw_class(matmul_pct, hbm_pct):
+    if matmul_pct is not None and matmul_pct < DEGRADED_PCT:
+        return _RANKS[CLASS_DEGRADED]
+    if hbm_pct is not None and hbm_pct < DEGRADED_PCT:
+        return _RANKS[CLASS_DEGRADED]
+    if (matmul_pct is not None and matmul_pct >= GOLD_MATMUL_PCT
+            and (hbm_pct is None or hbm_pct >= GOLD_HBM_PCT)):
+        return _RANKS[CLASS_GOLD]
+    return _RANKS[CLASS_SILVER]
+
+
+def classify(matmul_pct, hbm_pct, prev=None):
+    """Class name for the measured percentages (None = unknown),
+    mirroring perf::ClassifyPct including the hysteresis margin: to
+    LEAVE `prev`, the margin-shifted reading must still cross the
+    boundary in the same direction, so a chip sitting exactly on a
+    threshold keeps its class."""
+    rank = _raw_class(matmul_pct, hbm_pct)
+    prev_rank = _RANKS.get(prev) if prev else None
+    if prev_rank is None or rank == prev_rank:
+        return _NAMES[rank]
+    toward = HYSTERESIS_PCT if rank > prev_rank else -HYSTERESIS_PCT
+    confirmed = _raw_class(
+        None if matmul_pct is None else matmul_pct + toward,
+        None if hbm_pct is None else hbm_pct + toward)
+    still_crosses = (confirmed > prev_rank if rank > prev_rank
+                     else confirmed < prev_rank)
+    return _NAMES[rank] if still_crosses else _NAMES[prev_rank]
+
+
+def expected_labels(matmul_tflops, hbm_gbps, ici_gbps, family,
+                    class_name, specs=None,
+                    prefix="google.com/tpu.perf."):
+    """The five labels the daemon publishes for these measurements —
+    the parity oracle tests/test_perf.py compares the real daemon's
+    output against (value formatting mirrors perf::BuildLabels)."""
+    def fmt(v):
+        return str(int(v)) if v >= 10 else f"{v:.2g}"
+
+    specs = specs if specs is not None else load_rated_specs()
+    labels = {}
+    if matmul_tflops is not None and matmul_tflops >= 0:
+        labels[prefix + "matmul-tflops"] = fmt(matmul_tflops)
+    if hbm_gbps is not None and hbm_gbps >= 0:
+        labels[prefix + "hbm-gbps"] = fmt(hbm_gbps)
+    if ici_gbps is not None and ici_gbps >= 0:
+        labels[prefix + "ici-gbps"] = fmt(ici_gbps)
+    rated = specs.get(family, {}).get("matmul_tflops") if family else None
+    pct = pct_of_rated(matmul_tflops, rated)
+    if pct is not None:
+        labels[prefix + "pct-of-rated"] = str(int(pct + 0.5))
+    labels[prefix + "class"] = class_name
+    return labels
+
+
+def excluded_chip_ids(env=None):
+    """Chip ids named by TFD_PERF_EXCLUDE_CHIPS (the daemon's
+    healthsm-quarantined set), as a set of strings."""
+    env = os.environ if env is None else env
+    raw = env.get("TFD_PERF_EXCLUDE_CHIPS", "")
+    return {part.strip() for part in raw.split(",") if part.strip()}
+
+
+def measurement_devices(devices, excluded):
+    """The devices the aggregate characterization may use: every visible
+    device whose id is not quarantined. Falls back to ALL devices when
+    exclusion would leave none — an all-quarantined node still deserves
+    a measurement (its class will be degraded on merit)."""
+    kept = [d for d in devices if str(getattr(d, "id", "")) not in excluded]
+    return kept or list(devices)
+
+
+def measure(excluded=None):
+    """Runs the micro-benchmarks (median-of-3 differential probes from
+    tpufd.health) on the first non-excluded device — plus the ICI
+    all-reduce over all non-excluded devices when there are several —
+    and returns {"matmul-tflops": float, "hbm-gbps": float,
+    "ici-gbps": float|None}."""
+    import jax
+
+    from tpufd import health
+
+    devices = jax.devices()
+    excluded = excluded_chip_ids() if excluded is None else excluded
+    usable = measurement_devices(devices, excluded)
+    device = usable[0]
+    on_tpu = device.platform == "tpu"
+    size = 4096 if on_tpu else 512
+    mib = 512 if on_tpu else 32
+    out = {
+        "matmul-tflops": health.median_probe(
+            lambda: health.matmul_tflops(device=device, size=size)),
+        "hbm-gbps": health.median_probe(
+            lambda: health.hbm_gbps(device=device, mib=mib)),
+        "ici-gbps": None,
+    }
+    if len(usable) > 1:
+        from jax.sharding import Mesh
+
+        import numpy as np
+
+        mesh = Mesh(np.array(usable), ("all",))
+        try:
+            out["ici-gbps"] = health.median_probe(
+                lambda: health.allreduce_gbps(
+                    mesh, mib=64 if on_tpu else 8))
+        except Exception as e:  # noqa: BLE001 — ICI is optional context;
+            # a mesh the plugin cannot collective over must not fail the
+            # matmul/HBM characterization it is riding along with.
+            sys.stderr.write(f"ici probe skipped: {e}\n")
+    return out
+
+
+def main(argv=None):  # pragma: no cover - exercised via the daemon exec
+    del argv
+    measured = measure()
+    for key in ("matmul-tflops", "hbm-gbps", "ici-gbps"):
+        value = measured.get(key)
+        if value is not None:
+            print(f"{key}={value:.3f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -56,6 +56,7 @@
 #include "tfd/sched/snapshot.h"
 #include "tfd/sched/sources.h"
 #include "tfd/sched/state.h"
+#include "tfd/slice/coord.h"
 #include "tfd/util/file.h"
 #include "tfd/util/jsonlite.h"
 #include "tfd/util/logging.h"
@@ -849,6 +850,29 @@ Status RenderLabels(
     }
   }
 
+  // Slice-coherence labels (slice/coord.h) ride in from the slice
+  // worker's snapshot: labels built from the slice's ADOPTED verdict
+  // only — every member of the slice publishes identical bytes for
+  // these keys, and an orphaned member's empty snapshot removes them
+  // (self-demotion to single-host labels). Unlike health/perf these are
+  // cluster-coordination facts, not measured-silicon claims, so they
+  // merge on every rung that has them.
+  if (config.flags.slice_coordination) {
+    sched::SourceView slice_view = store.View("slice");
+    if (slice_view.registered && slice_view.last_ok.has_value() &&
+        slice_view.tier != sched::Tier::kExpired) {
+      lm::LabelProvenance from;
+      from.labeler = lm::kSliceCoordLabeler;
+      from.source = "slice";
+      from.tier = sched::TierName(slice_view.tier);
+      from.age_s = slice_view.age_s < 0 ? 0 : slice_view.age_s;
+      for (const auto& [k, v] : slice_view.last_ok->labels) {
+        (*merged)[k] = v;
+        (*provenance)[k] = from;
+      }
+    }
+  }
+
   // Degradation markers: cached/expired snapshots say so, with their
   // age, so a scheduler (or a human) can weigh the staleness. Fresh
   // serves — including the metadata-only rung — stay byte-identical to
@@ -1178,6 +1202,13 @@ void SaveStateAfterRewrite(const config::Config& config,
   // So does the perf characterization (its own checksummed section):
   // the amortization contract is that a restart re-measures NOTHING.
   state.perf_json = perf::Default().SerializeJson();
+  // And the slice coordination state: a kill -9'd slice leader must
+  // resume its still-valid lease on restart instead of flapping
+  // leadership, and a restarted member must keep serving the agreed
+  // slice labels through the probe settle window.
+  if (config.flags.slice_coordination) {
+    state.slice_json = slice::Default().SerializeJson(WallClockSeconds());
+  }
   Status s = sched::SaveState(config.flags.state_file, state);
   if (!s.ok()) {
     TFD_LOG_WARNING << "state save failed (warm restart unavailable): "
@@ -1433,6 +1464,24 @@ Status ServeRestored(const config::Config& config,
   auto t0 = std::chrono::steady_clock::now();
   uint64_t generation = obs::DefaultJournal().BeginRewrite();
   lm::Labels labels = restored.labels;
+  // Coordination-owned slice labels are NEVER replayed from disk: the
+  // slice contract is agreed-or-absent, and a restored payload is a
+  // snapshot of an agreement that may have moved while this daemon was
+  // dead (a member died, the slice degraded). The coordinator verifies
+  // against the live blackboard on its first tick (~one interval) and
+  // republishes the CURRENT agreement; until then the restarted member
+  // abstains — exactly like an orphan's self-demotion, and unlike
+  // per-host facts, whose staleness the snapshot-age markers already
+  // disclose. (Identified by the coord-owned kSliceId: the topology
+  // labeler's per-host slice.* facts — kSliceHosts included, a
+  // structural constant both producers agree on — stay.)
+  if (config.flags.slice_coordination &&
+      labels.count(lm::kSliceId) > 0) {
+    for (const char* key : {lm::kSliceId, lm::kSliceHealthyHosts,
+                            lm::kSliceDegraded, lm::kSliceClass}) {
+      labels.erase(key);
+    }
+  }
   lm::Provenance provenance;
   // Everything served from disk is cached by definition: per-key
   // provenance keeps the saved labeler/source but reports the
@@ -1741,6 +1790,28 @@ void RestorePerfState(const std::string& json, const std::string& origin) {
        {"class", c.has_value() ? perf::ClassName(c->class_rank) : ""}});
 }
 
+// Restores the persisted slice-coordination state (lease epoch, adopted
+// verdict, join status) so a kill -9'd slice leader resumes its
+// still-valid lease without a leadership flap and a restarted member
+// keeps the agreed slice labels through the probe settle window. The
+// payload names its slice id; Configure() (per config load) drops it if
+// the derived identity disagrees. `origin` mirrors RestoreHealthState's.
+void RestoreSliceState(const std::string& json, double now_wall,
+                       const std::string& origin) {
+  if (json.empty()) return;
+  Status restored = slice::Default().RestoreJson(json, now_wall);
+  if (!restored.ok()) {
+    TFD_LOG_WARNING << "slice coordination state restore failed "
+                       "(rejoining from scratch): "
+                    << restored.message();
+    return;
+  }
+  obs::DefaultJournal().Record(
+      "slice-restored", "slice",
+      "slice coordination state restored" + origin +
+          " (lease/verdict continue across the restart)");
+}
+
 int Main(int argc, char** argv) {
   // Ignore SIGPIPE process-wide, explicitly at startup: the HTTP client
   // needs it (SSL_write cannot carry MSG_NOSIGNAL) and would otherwise
@@ -1946,9 +2017,11 @@ int Main(int argc, char** argv) {
                              : 10.0 * flags.sleep_interval_s;
       std::string stale_healthsm_json;
       std::string stale_perf_json;
+      std::string stale_slice_json;
       Result<sched::PersistedState> restored = sched::LoadState(
           flags.state_file, sched::NodeIdentity(), max_age_s,
-          WallClockSeconds(), &stale_healthsm_json, &stale_perf_json);
+          WallClockSeconds(), &stale_healthsm_json, &stale_perf_json,
+          &stale_slice_json);
       if (restored.ok()) {
         double now_wall = WallClockSeconds();
         double downtime_s = now_wall - restored->saved_at;
@@ -1979,6 +2052,11 @@ int Main(int argc, char** argv) {
         if (flags.perf_characterize) {
           RestorePerfState(restored->perf_json, "");
         }
+        // Slice lease/verdict continuity (feature-gated like perf: a
+        // disabled daemon discards a leftover slice section).
+        if (flags.slice_coordination) {
+          RestoreSliceState(restored->slice_json, now_wall, "");
+        }
         ServeRestored(loaded.config, *restored, restored->age_s,
                       downtime_s, "warm-restart", server.get(),
                       &sink_breaker, &label_governor, &label_state);
@@ -2008,6 +2086,14 @@ int Main(int argc, char** argv) {
         // warm path: a disabled daemon discards it.)
         if (flags.perf_characterize) {
           RestorePerfState(stale_perf_json, " from stale state file");
+        }
+        // The slice lease's truth lives in the apiserver, not in this
+        // file's age: a crash loop longer than the snapshot window
+        // must not make a restarted leader forget an epoch it may
+        // still hold.
+        if (flags.slice_coordination) {
+          RestoreSliceState(stale_slice_json, WallClockSeconds(),
+                            " from stale state file");
         }
       }
     }

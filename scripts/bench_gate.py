@@ -30,6 +30,14 @@ restore <= 15 ms with ZERO measurements journaled after the kill -9,
 (c) exactly one measurement round across the steady soak, and (d) the
 no-op p50 against the committed BENCH_r09.json reference (+ slack).
 
+Slice mode (ISSUE 10): `--slice RECORD.json` gates a multi-host
+slice-coherence soak record (scripts/slice_soak.py --json) — ZERO
+interleaved-disagreement samples (no pass where two live hosts publish
+different tpu.slice.* claims), every chaos step converged with its
+disagreement window inside 2 probe intervals, the partition/failover/
+kill -9 invariants held, and the agreement-latency p50 within slack of
+the committed BENCH_r10.json.
+
 Usage:
   python3 scripts/bench_gate.py [--reference BENCH_r07.json]
       [--noop-budget-us 1000] [--dirty-slack 0.25]
@@ -37,6 +45,8 @@ Usage:
       [--fleet-reference BENCH_r08.json] [--fleet-slack 0.5]
   python3 scripts/bench_gate.py --perf
       [--perf-reference BENCH_r09.json] [--perf-restore-budget-ms 15]
+  python3 scripts/bench_gate.py --slice slice-soak.json
+      [--slice-reference BENCH_r10.json] [--slice-slack 0.5]
 """
 
 import argparse
@@ -161,6 +171,66 @@ def perf_gate(record, reference_path, noop_budget_us, restore_budget_ms,
     return problems
 
 
+def slice_gate(record_path, reference_path, slack):
+    """Gates a slice-soak record: the coherence acceptance bounds plus
+    agreement-latency regression vs the committed reference. Absent
+    keys FAIL loudly — a partially-run soak must not sail through on
+    defaults. Returns a problem list (empty = pass)."""
+    with open(record_path) as f:
+        record = json.load(f)
+    problems = []
+
+    interleaved = record.get("interleaved_disagreement_passes")
+    if interleaved is None:
+        problems.append("slice record has no "
+                        "interleaved_disagreement_passes")
+    elif interleaved != 0:
+        problems.append(
+            f"{interleaved} sample(s) showed two live hosts publishing "
+            "disagreeing tpu.slice.* labels (coherence regressed)")
+    steps = record.get("steps") or []
+    expected_steps = {"join", "kill-follower", "member-rejoin",
+                      "kill-leader", "leader-rejoin", "wedge-pjrt",
+                      "unwedge", "partition", "heal",
+                      "kill9-leader-resume"}
+    missing = expected_steps - {s.get("name") for s in steps}
+    if missing:
+        problems.append(f"slice record is missing chaos steps: "
+                        f"{sorted(missing)}")
+    interval_ms = (record.get("interval_s") or 1) * 1000
+    for invariant in ("orphan_self_demoted", "leader_failover_epoch_bump",
+                      "kill9_lease_resumed"):
+        if not record.get(invariant):
+            problems.append(f"slice record invariant {invariant} not set")
+    worst = record.get("max_disagreement_ms")
+    if worst is None:
+        problems.append("slice record has no max_disagreement_ms")
+    # (Per-step windows are enforced by the soak itself for the
+    # failure-relabeling steps; rejoin/boot windows legitimately span a
+    # settle window, so no absolute bound on the max here.)
+
+    p50 = record.get("slice_agreement_p50_ms")
+    if p50 is None:
+        problems.append("slice_agreement_p50_ms missing")
+    try:
+        with open(reference_path) as f:
+            ref = json.load(f).get("slice_agreement_p50_ms")
+    except (OSError, ValueError) as e:
+        problems.append(f"slice reference {reference_path} unreadable: {e}")
+        ref = None
+    if ref is not None and p50 is not None:
+        # Latencies are dominated by the configured protocol constants
+        # (agreement timeout, lease), so regression here means a new
+        # layer added passes/round-trips to convergence.
+        ceiling = ref * (1.0 + slack) + 2 * interval_ms
+        if p50 > ceiling:
+            problems.append(
+                f"agreement-latency p50 {p50}ms regressed past "
+                f"{ceiling:.0f}ms (reference {ref}ms +{int(slack * 100)}% "
+                f"+ 2 intervals)")
+    return problems
+
+
 def reference_dirty_p50_ms(path):
     """steady_dirty_p50_ms from a committed bench record (either the
     bare record or the driver's {parsed: ...} wrapper)."""
@@ -190,6 +260,13 @@ def main(argv=None):
                          "characterization scenario (bench.perf_record)")
     ap.add_argument("--perf-reference",
                     default=os.path.join(repo, "BENCH_r09.json"))
+    ap.add_argument("--slice", metavar="RECORD.json",
+                    help="gate this slice-coherence soak record "
+                         "(scripts/slice_soak.py --json)")
+    ap.add_argument("--slice-reference",
+                    default=os.path.join(repo, "BENCH_r10.json"))
+    # Latencies ride protocol constants + a shared CI box's scheduling.
+    ap.add_argument("--slice-slack", type=float, default=0.5)
     ap.add_argument("--perf-restore-budget-ms", type=float, default=15.0)
     # Wider than the dirty-pass slack: the gated number is a
     # sub-millisecond p50 on a shared CI box, and the 1000us absolute
@@ -225,6 +302,16 @@ def main(argv=None):
                 print(f"fleet bench gate FAILED: {p}", file=sys.stderr)
             return 1
         print("fleet bench gate OK")
+        return 0
+
+    if args.slice:
+        problems = slice_gate(args.slice, args.slice_reference,
+                              args.slice_slack)
+        if problems:
+            for p in problems:
+                print(f"slice bench gate FAILED: {p}", file=sys.stderr)
+            return 1
+        print("slice bench gate OK")
         return 0
 
     import bench

@@ -128,6 +128,15 @@ CONDITIONAL = {
     "tfd_perf_class_changes_total",
     "tfd_perf_deferrals_total",
     "tfd_perf_restores_total",
+    # Slice coherence (ISSUE 10): config-gated behind
+    # --slice-coordination (off on this hermetic boot; the state gauge
+    # additionally needs a derivable slice identity). Leader
+    # transitions / agreement latency / orphan counts fire only on
+    # live coordination events.
+    "tfd_slice_state",
+    "tfd_slice_leader_transitions_total",
+    "tfd_slice_agreement_latency_seconds",
+    "tfd_slice_orphaned_total",
 }
 
 

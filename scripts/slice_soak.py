@@ -1,0 +1,468 @@
+#!/usr/bin/env python3
+"""Multi-host slice-coherence chaos soak (ISSUE 10 acceptance).
+
+Boots N (default 4) REAL daemons as the member hosts of one fake slice —
+every process loads the fake PJRT plugin with the SAME global topology
+(TFD_FAKE_PJRT_BOUNDS) and its own host index (TFD_FAKE_PJRT_PROC), all
+coordinating through ONE fake apiserver (each host on its own listener
+so a single host can be network-partitioned) — then walks a seeded chaos
+schedule:
+
+  kill-follower     SIGKILL a non-leader member        -> survivors 3/4
+  restart           bring it back                      -> 4/4
+  kill-leader       SIGKILL the lease holder           -> failover + 3/4
+  restart           bring it back                      -> 4/4
+  wedge-pjrt        wedge one member's PJRT (hang file)-> 3/4 everywhere
+  unwedge           lift the wedge                     -> 4/4
+  partition         refuse one member's apiserver      -> member drops
+                                                          tpu.slice.*
+                                                          (self-demotes),
+                                                          peers 3/4
+  heal              restore the listener               -> rejoin, 4/4
+  kill9-leader      kill -9 the leader + instant       -> lease resumed
+                    restart (same state file)             from the state
+                                                          file: NO epoch
+                                                          bump, survivors'
+                                                          labels never
+                                                          move
+
+Invariants asserted at every step:
+  - all live hosts' tpu.slice.* labels are BYTE-IDENTICAL once the step
+    converges, and the disagreement window (first label movement ->
+    convergence) is at most 2 probe intervals;
+  - detection latency is bounded by the layer that owns it: the
+    agreement timeout for a dead member, the PJRT fresh window for a
+    wedged one, the lease duration for a partition;
+  - ZERO "interleaved disagreement" samples: outside a step's
+    convergence window, no sample may show two live hosts publishing
+    different slice labels;
+  - the partitioned member drops its slice labels entirely (never a
+    stale slice view) and journals slice-orphaned;
+  - the kill -9'd leader resumes its lease epoch from the state file.
+
+`--json FILE` writes the bench record bench_gate.py --slice gates
+against the committed BENCH_r10.json.
+
+Usage:
+  python3 scripts/slice_soak.py [--hosts 4] [--seed 10] [--json out.json]
+"""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from tpufd import slicecoord  # noqa: E402
+from tpufd.fakes import free_loopback_port  # noqa: E402
+from tpufd.fakes.apiserver import FakeApiServer  # noqa: E402
+
+BUILD = REPO / "build"
+BINARY = BUILD / "tpu-feature-discovery"
+FAKE_PJRT = BUILD / "libtfd_fake_pjrt.so"
+
+INTERVAL_S = 1
+AGREEMENT_S = 2
+LEASE_S = 3
+PJRT_TIMEOUT_S = 3
+SLICE_ID = "soak-slice"
+NS = "slice-soak"
+
+
+class SoakError(AssertionError):
+    pass
+
+
+def require(cond, message):
+    if not cond:
+        raise SoakError(message)
+
+
+class Member:
+    def __init__(self, tmp, index, url, hosts):
+        self.index = index
+        self.node = f"soak-host-{index}"
+        self.url = url
+        self.out_file = tmp / f"tfd-{index}"
+        self.state_file = tmp / f"state-{index}"
+        self.hang_file = tmp / f"hang-{index}"
+        self.port = free_loopback_port()
+        self.argv = [
+            str(BINARY), f"--sleep-interval={INTERVAL_S}s",
+            "--backend=pjrt", f"--libtpu-path={FAKE_PJRT}",
+            f"--pjrt-init-timeout={PJRT_TIMEOUT_S}s",
+            "--pjrt-refresh-interval=1s",
+            # Failed inits are memoized; the default 60s window would
+            # stretch un-wedge recovery far past the step budget.
+            "--pjrt-retry-backoff=1s",
+            "--machine-type-file=/dev/null",
+            f"--output-file={self.out_file}",
+            f"--state-file={self.state_file}",
+            f"--introspection-addr=127.0.0.1:{self.port}",
+            "--slice-coordination",
+            f"--slice-lease-duration={LEASE_S}s",
+            f"--slice-agreement-timeout={AGREEMENT_S}s",
+            # A request in flight when a partition starts can hang to
+            # the full deadline; keep it under the lease so ONE stalled
+            # tick can't push self-demotion past the step budget.
+            "--sink-request-deadline=2s",
+            "--cadence-jitter-pct=0", "--no-timestamp",
+        ]
+        self.env = {
+            **os.environ,
+            "GCE_METADATA_HOST": "127.0.0.1:1",
+            "NODE_NAME": self.node,
+            "TFD_APISERVER_URL": url,
+            "KUBERNETES_NAMESPACE": NS,
+            "TFD_SLICE_ID": SLICE_ID,
+            "TFD_SLICE_WORKER_ID": str(index),
+            "TFD_SLICE_HOSTS": "",  # set by the soak
+            "TFD_FAKE_PJRT_KIND": "TPU v5 lite",
+            "TFD_FAKE_PJRT_BOUNDS": "4,4,1",
+            "TFD_FAKE_PJRT_HOSTS": "",  # set by the soak
+            "TFD_FAKE_PJRT_PROC": str(index),
+            "TFD_FAKE_PJRT_HANG_IF_FILE": str(self.hang_file),
+        }
+        self.proc = None
+
+    def start(self):
+        self.proc = subprocess.Popen(self.argv, env=self.env,
+                                     stderr=subprocess.DEVNULL)
+
+    def kill(self, sig=signal.SIGKILL):
+        if self.proc is None:
+            return
+        self.proc.send_signal(sig)
+        self.proc.wait(timeout=10)
+        self.proc = None
+
+    def alive(self):
+        return self.proc is not None and self.proc.poll() is None
+
+    def slice_labels(self):
+        try:
+            labels = dict(line.split("=", 1) for line in
+                          self.out_file.read_text().splitlines() if line)
+        except (OSError, ValueError):
+            return None  # unreadable mid-write; sample again
+        return slicecoord.slice_labels_of(labels)
+
+    def journal_types(self):
+        import urllib.request
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{self.port}/debug/journal?n=512",
+                    timeout=2) as r:
+                doc = json.loads(r.read().decode())
+            return [e.get("type") for e in doc.get("events", [])]
+        except Exception:
+            return []
+
+
+def expected_labels(sanitized_id, hosts, healthy):
+    verdict = {"hosts": hosts, "healthy_hosts": healthy,
+               "degraded": healthy < hosts, "class": "", "members": []}
+    return slicecoord.build_slice_labels(sanitized_id, verdict)
+
+
+class Soak:
+    def __init__(self, hosts, seed):
+        import random
+        self.random = random.Random(seed)
+        self.hosts = hosts
+        self.sanitized_id = slicecoord.sanitize_slice_id(SLICE_ID)
+        self.steps = []
+        self.interleaved = 0
+        self.samples = 0
+
+    def sample_all(self, members):
+        """One coherence sample across the live members; returns
+        {index: labels} for members whose file is readable."""
+        out = {}
+        for m in members:
+            if not m.alive():
+                continue
+            labels = m.slice_labels()
+            if labels is not None:
+                out[m.index] = labels
+        return out
+
+    def watch_steady(self, members, duration, phase=""):
+        """Between steps: no sample may show two live hosts CLAIMING
+        different slice facts — the 'interleaved disagreement' the
+        acceptance forbids. A host with NO slice labels is abstaining
+        (the designed self-demoted/booting state: agreed-or-absent),
+        not disagreeing."""
+        deadline = time.monotonic() + duration
+        while time.monotonic() < deadline:
+            sets = [s for s in self.sample_all(members).values() if s]
+            self.samples += 1
+            if sets and any(s != sets[0] for s in sets[1:]):
+                self.interleaved += 1
+                if phase:
+                    print(f"    DISAGREE[{phase}]: {sets}")
+            time.sleep(0.1)
+
+    def converge(self, name, members, want, budget_s, extra_check=None,
+                 enforce_window=True):
+        """Waits for every live member's slice labels to equal `want`
+        (a dict, or a per-index dict-of-dicts), measuring convergence
+        latency and the disagreement window. `budget_s` bounds the
+        whole step. `enforce_window` applies the 2-probe-interval
+        disagreement bound — the FAILURE-relabeling acceptance; steps
+        that include a host booting (join, rejoins) measure but don't
+        enforce it, since a cold daemon's settle window is not a
+        coherence failure."""
+        t0 = time.monotonic()
+        first_change = None
+        baseline = self.sample_all(members)
+        while True:
+            now = time.monotonic()
+            sample = self.sample_all(members)
+            self.samples += 1
+            if first_change is None and sample != baseline:
+                first_change = now
+            def want_of(index):
+                if want and isinstance(next(iter(want.values()), None),
+                                       dict):
+                    return want.get(index, {})
+                return want
+            done = all(m.alive() is False or
+                       sample.get(m.index) == want_of(m.index)
+                       for m in members)
+            if done and (extra_check is None or extra_check()):
+                break
+            require(now - t0 < budget_s,
+                    f"step {name}: no convergence within {budget_s}s "
+                    f"(sample {sample}; extra_check="
+                    f"{extra_check() if extra_check else None})")
+            time.sleep(0.05)
+        t_converged = time.monotonic()
+        latency_ms = (t_converged - t0) * 1000
+        disagreement_ms = ((t_converged - first_change) * 1000
+                           if first_change is not None else 0.0)
+        if enforce_window:
+            require(disagreement_ms <= 2 * INTERVAL_S * 1000 + 500,
+                    f"step {name}: disagreement window "
+                    f"{disagreement_ms:.0f}ms exceeds 2 probe intervals")
+        self.steps.append({"name": name,
+                           "latency_ms": round(latency_ms, 1),
+                           "disagreement_ms": round(disagreement_ms, 1)})
+        print(f"  step {name}: converged in {latency_ms:.0f}ms "
+              f"(disagreement window {disagreement_ms:.0f}ms)")
+
+    def record(self):
+        latencies = sorted(s["latency_ms"] for s in self.steps)
+        p50 = latencies[len(latencies) // 2] if latencies else None
+        return {
+            "soak": "slice",
+            "hosts": self.hosts,
+            "interval_s": INTERVAL_S,
+            "agreement_timeout_s": AGREEMENT_S,
+            "lease_duration_s": LEASE_S,
+            "steps": self.steps,
+            "slice_agreement_p50_ms": p50,
+            "max_disagreement_ms": max(
+                (s["disagreement_ms"] for s in self.steps), default=0),
+            "interleaved_disagreement_passes": self.interleaved,
+            "coherence_samples": self.samples,
+        }
+
+
+def lease_of(server):
+    doc = server.store.get(
+        (NS, "tfd-slice-" + slicecoord.sanitize_slice_id(SLICE_ID)))
+    raw = (doc or {}).get("data", {}).get("lease")
+    return json.loads(raw) if raw else None
+
+
+def run_soak(hosts, seed, tmp):
+    soak = Soak(hosts, seed)
+    sid = soak.sanitized_id
+    with FakeApiServer() as server:
+        listeners = [server.add_listener() for _ in range(hosts)]
+        members = [Member(tmp, i, listeners[i].url, hosts)
+                   for i in range(hosts)]
+        for m in members:
+            m.env["TFD_SLICE_HOSTS"] = str(hosts)
+            m.env["TFD_FAKE_PJRT_HOSTS"] = str(hosts)
+        try:
+            print(f"slice soak: {hosts} hosts, seed {seed}")
+            for m in members:
+                m.start()
+            # Join: everyone healthy, byte-identical. Cold PJRT probes
+            # pay the settle window once.
+            soak.converge("join", members,
+                          expected_labels(sid, hosts, hosts),
+                          budget_s=30, enforce_window=False)
+            soak.watch_steady(members, 2, phase="w1")
+
+            lease = lease_of(server)
+            require(lease is not None, "no lease on the blackboard")
+            leader = next(m for m in members if m.node == lease["holder"])
+            follower = next(m for m in members if m is not leader)
+
+            # 1. Kill a follower: detection <= agreement timeout, then
+            # a 2-interval convergence window.
+            follower.kill(signal.SIGKILL)
+            soak.converge("kill-follower", members,
+                          expected_labels(sid, hosts, hosts - 1),
+                          budget_s=AGREEMENT_S + 4 * INTERVAL_S + 3)
+            soak.watch_steady(members, 2, phase="w2")
+            follower.start()
+            soak.converge("member-rejoin", members,
+                          expected_labels(sid, hosts, hosts),
+                          budget_s=20, enforce_window=False)
+            soak.watch_steady(members, 2, phase="w3")
+
+            # 2. Kill the leader: lease failover (epoch bump) + the
+            # same coherent degrade on every survivor. Re-resolve the
+            # holder first — leadership may have moved since the join.
+            lease = lease_of(server)
+            leader = next(m for m in members if m.node == lease["holder"])
+            epoch_before = lease["epoch"]
+            leader.kill(signal.SIGKILL)
+            soak.converge(
+                "kill-leader", members,
+                expected_labels(sid, hosts, hosts - 1),
+                budget_s=LEASE_S + AGREEMENT_S + 4 * INTERVAL_S + 3,
+                extra_check=lambda: (lease_of(server) or {}).get(
+                    "epoch", 0) > epoch_before)
+            require(lease_of(server)["holder"] != leader.node,
+                    "dead leader still holds the lease")
+            soak.watch_steady(members, 2, phase="w4")
+            leader.start()
+            soak.converge("leader-rejoin", members,
+                          expected_labels(sid, hosts, hosts),
+                          budget_s=20, enforce_window=False)
+            soak.watch_steady(members, 2, phase="w5")
+
+            # 3. Wedge one member's PJRT: its device snapshot ages out
+            # of fresh, its report turns unhealthy, the SLICE degrades
+            # — coherently, on all four LIVE hosts (the wedged one
+            # publishes the same agreed verdict).
+            wedged = next(m for m in members
+                          if m.node != lease_of(server)["holder"])
+            wedged.hang_file.touch()
+            fresh_window = 4 * INTERVAL_S + PJRT_TIMEOUT_S
+            soak.converge("wedge-pjrt", members,
+                          expected_labels(sid, hosts, hosts - 1),
+                          budget_s=fresh_window + AGREEMENT_S +
+                          4 * INTERVAL_S + 5)
+            soak.watch_steady(members, 2, phase="w6")
+            wedged.hang_file.unlink()
+            soak.converge("unwedge", members,
+                          expected_labels(sid, hosts, hosts),
+                          budget_s=fresh_window + 10,
+                          enforce_window=False)
+            soak.watch_steady(members, 2, phase="w7")
+
+            # 4. Partition one member from the apiserver: it must
+            # SELF-DEMOTE (drop tpu.slice.* entirely — never a stale
+            # slice view) while the peers degrade the slice.
+            lease = lease_of(server)
+            victim = next(m for m in members
+                          if m.node != lease["holder"])
+            listeners[victim.index].stop()
+            want = {m.index: (expected_labels(sid, hosts, hosts - 1)
+                              if m is not victim else {})
+                    for m in members}
+            soak.converge("partition", members, want,
+                          budget_s=LEASE_S + AGREEMENT_S +
+                          4 * INTERVAL_S + 3)
+            require("slice-orphaned" in victim.journal_types(),
+                    "partitioned member never journaled slice-orphaned")
+            soak.watch_steady([m for m in members if m is not victim], 2, phase="w8")
+            listeners[victim.index].start()
+            soak.converge("heal", members,
+                          expected_labels(sid, hosts, hosts),
+                          budget_s=LEASE_S + 15, enforce_window=False)
+            soak.watch_steady(members, 2, phase="w9")
+
+            # 5. kill -9 the leader and restart it IMMEDIATELY with the
+            # same state file: the lease must be resumed (no epoch
+            # bump) and the survivors' labels must never move.
+            lease = lease_of(server)
+            leader = next(m for m in members if m.node == lease["holder"])
+            survivors = [m for m in members if m is not leader]
+            before = {m.index: m.slice_labels() for m in survivors}
+            epoch_before = lease_of(server)["epoch"]
+            leader.kill(signal.SIGKILL)
+            leader.start()
+
+            def lease_resumed():
+                lease_now = lease_of(server)
+                return (lease_now and lease_now["holder"] == leader.node
+                        and lease_now["renewed_at"] > lease["renewed_at"])
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline and not lease_resumed():
+                sets = {m.index: m.slice_labels() for m in survivors}
+                require(sets == before,
+                        f"survivor labels moved during the leader's "
+                        f"kill -9 restart: {sets} != {before}")
+                time.sleep(0.1)
+            require(lease_resumed(), "kill -9'd leader did not resume")
+            require(lease_of(server)["epoch"] == epoch_before,
+                    "lease epoch bumped across kill -9 (leadership "
+                    "flapped instead of resuming from the state file)")
+            require("slice-restored" in leader.journal_types(),
+                    "restarted leader never journaled slice-restored")
+            soak.steps.append({"name": "kill9-leader-resume",
+                               "latency_ms": 0.0, "disagreement_ms": 0.0})
+            soak.watch_steady(members, 3, phase="w10")
+
+            require(soak.interleaved == 0,
+                    f"{soak.interleaved} steady-state sample(s) showed "
+                    f"two live hosts publishing disagreeing slice labels")
+            record = soak.record()
+            record["orphan_self_demoted"] = True
+            record["leader_failover_epoch_bump"] = True
+            record["kill9_lease_resumed"] = True
+            return record
+        finally:
+            for m in members:
+                if m.proc is not None:
+                    m.kill(signal.SIGTERM)
+            for listener in listeners:
+                listener.stop()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hosts", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=10)
+    ap.add_argument("--json", metavar="FILE",
+                    help="write the bench record here")
+    args = ap.parse_args(argv)
+
+    if not BINARY.exists() or not FAKE_PJRT.exists():
+        print("build/ artifacts missing; run the build first (the "
+              "pytest conftest or cmake+ninja)", file=sys.stderr)
+        return 2
+
+    import tempfile
+    with tempfile.TemporaryDirectory(prefix="slice-soak-") as tmp:
+        try:
+            record = run_soak(args.hosts, args.seed, Path(tmp))
+        except SoakError as e:
+            print(f"slice soak FAILED: {e}", file=sys.stderr)
+            return 1
+    print(json.dumps(record, indent=2))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(record, f)
+    print(f"slice soak OK: {len(record['steps'])} steps, agreement p50 "
+          f"{record['slice_agreement_p50_ms']}ms, "
+          f"{record['interleaved_disagreement_passes']} interleaved "
+          f"disagreements over {record['coherence_samples']} samples")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

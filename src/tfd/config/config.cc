@@ -522,6 +522,41 @@ std::vector<FlagDef> MakeFlagDefs(Flags* f) {
                   [f](const std::string& v) {
                     return SetDuration(&f->sink_refresh_s, v);
                   }});
+  defs.push_back({"slice-coordination",
+                  {"TFD_SLICE_COORDINATION"},
+                  "sliceCoordination",
+                  "multi-host slice coherence: agree with the slice's "
+                  "other hosts (lease-elected leader over a per-slice "
+                  "ConfigMap) before publishing google.com/tpu.slice."
+                  "{id,hosts,healthy-hosts,degraded} — every member "
+                  "publishes identical values or none (single-host "
+                  "fallback when no slice identity is derivable)",
+                  true,
+                  [f](const std::string& v) {
+                    return SetBool(&f->slice_coordination, v);
+                  }});
+  defs.push_back({"slice-lease-duration",
+                  {"TFD_SLICE_LEASE_DURATION"},
+                  "sliceLeaseDuration",
+                  "slice leadership lease: a lease this stale fails over "
+                  "to the next member, and a member that cannot reach "
+                  "the blackboard for this long self-demotes to "
+                  "single-host labels (e.g. 30s)",
+                  false,
+                  [f](const std::string& v) {
+                    return SetDuration(&f->slice_lease_duration_s, v);
+                  }});
+  defs.push_back({"slice-agreement-timeout",
+                  {"TFD_SLICE_AGREEMENT_TIMEOUT"},
+                  "sliceAgreementTimeout",
+                  "how old a member's report may be before the leader "
+                  "stops counting it healthy and the slice degrades "
+                  "(e.g. 2m; 0 = auto: 2x the coordination tick, which "
+                  "is min(sleep-interval, slice-lease-duration/3))",
+                  false,
+                  [f](const std::string& v) {
+                    return SetDuration(&f->slice_agreement_timeout_s, v);
+                  }});
   defs.push_back({"fault-spec",
                   {"TFD_FAULT_SPEC"},
                   "faultSpec",
@@ -906,6 +941,15 @@ Result<LoadResult> Load(int argc, char** argv) {
   if (f->sink_refresh_s < 0) {
     return Result<LoadResult>::Error("sink-refresh must be >= 0s");
   }
+  if (f->slice_lease_duration_s < 2) {
+    // The lease must outlive at least one renew round trip; 1s leases
+    // flap leadership on any scheduling hiccup.
+    return Result<LoadResult>::Error("slice-lease-duration must be >= 2s");
+  }
+  if (f->slice_agreement_timeout_s < 0) {
+    return Result<LoadResult>::Error(
+        "slice-agreement-timeout must be >= 0s (0 = auto)");
+  }
   if (!f->fault_spec.empty()) {
     Status s = fault::Validate(f->fault_spec);
     if (!s.ok()) {
@@ -985,6 +1029,11 @@ std::string ToJson(const Config& config) {
       << ",\"sinkPatch\":" << (f.sink_patch ? "true" : "false")
       << ",\"cadenceJitterPct\":" << f.cadence_jitter_pct
       << ",\"sinkRefresh\":\"" << f.sink_refresh_s << "s\""
+      << ",\"sliceCoordination\":"
+      << (f.slice_coordination ? "true" : "false")
+      << ",\"sliceLeaseDuration\":\"" << f.slice_lease_duration_s << "s\""
+      << ",\"sliceAgreementTimeout\":\"" << f.slice_agreement_timeout_s
+      << "s\""
       << ",\"faultSpec\":" << jstr(f.fault_spec)
       << "},\"sharing\":[";
   for (size_t i = 0; i < config.sharing.time_slicing.size(); i++) {

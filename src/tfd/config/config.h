@@ -226,6 +226,27 @@ struct Flags {
   // 0 = auto: max(60s, 2.5x sleep-interval). Per-node desync stretches
   // the effective period by up to cadence-jitter-pct.
   int sink_refresh_s = 0;
+  // Multi-host slice coherence (slice/coord.h): derive a deterministic
+  // slice identity from GCE/TPU-env metadata, elect a lease-based
+  // per-slice leader through the k8s client, agree on the slice's
+  // health across hosts, and publish IDENTICAL
+  // google.com/tpu.slice.{id,hosts,healthy-hosts,degraded} labels on
+  // every member. Off by default; single-host nodes (or hosts with no
+  // slice identity evidence) stay in single-host mode even when on.
+  // Daemon mode only (a oneshot run must not join a slice).
+  bool slice_coordination = false;
+  // Slice leadership lease duration. The coordination tick —
+  // report/renew/verdict cadence — is min(sleep-interval, a third of
+  // this), so the holder always renews well inside the lease no matter
+  // how slow the rewrite cadence is. A lease this stale fails over to
+  // the first member that claims it, and a member that cannot REACH
+  // the blackboard for this long self-demotes to single-host labels
+  // (journal slice-orphaned) rather than serve a stale slice view.
+  int slice_lease_duration_s = 30;
+  // How old a member's report may be before the leader stops counting
+  // it (the host is dead/wedged/partitioned and the slice degrades).
+  // 0 = auto: 2x the coordination tick.
+  int slice_agreement_timeout_s = 0;
   // Fault injection (fault/fault.h): named-point spec, e.g.
   // "sink.file:errno=ENOSPC:rate=0.3,k8s.put:http=500:count=3".
   // TEST-ONLY — an armed daemon fails on purpose; empty (default)

@@ -615,5 +615,134 @@ Status UpdateNodeFeature(const ClusterConfig& config,
                         " attempts exhausted (" + last_error + ")");
 }
 
+// ---- slice-coordination blackboard ----------------------------------------
+
+namespace {
+
+std::string CoordUrl(const ClusterConfig& config, const std::string& name) {
+  std::string url = config.apiserver_url + "/api/v1/namespaces/" +
+                    config.namespace_ + "/configmaps";
+  if (!name.empty()) url += "/" + name;
+  return url;
+}
+
+std::string ConfigMapBody(const ClusterConfig& config,
+                          const std::string& name,
+                          const std::map<std::string, std::string>& data) {
+  return "{\"apiVersion\":\"v1\",\"kind\":\"ConfigMap\",\"metadata\":"
+         "{\"name\":" +
+         jsonlite::Quote(name) +
+         ",\"namespace\":" + jsonlite::Quote(config.namespace_) +
+         "},\"data\":" + jsonlite::SerializeStringMap(data) + "}";
+}
+
+}  // namespace
+
+Result<CoordDocResult> GetCoordConfigMap(const ClusterConfig& config,
+                                         const std::string& name,
+                                         bool* server_alive,
+                                         WriteOutcome* outcome) {
+  WriteOutcome local_outcome;
+  if (outcome == nullptr) outcome = &local_outcome;
+  if (server_alive != nullptr) *server_alive = false;
+  http::RequestOptions options = BaseOptions(config);
+  Result<http::Response> got = CountedRequest(
+      "k8s.get", "GET", CoordUrl(config, name), "", options, outcome);
+  if (!got.ok()) {
+    return Result<CoordDocResult>::Error("getting slice ConfigMap: " +
+                                         got.error());
+  }
+  if (server_alive != nullptr) *server_alive = true;
+  CoordDocResult doc;
+  if (got->status == 404) return doc;  // found=false: first boot
+  if (got->status != 200) {
+    return Result<CoordDocResult>::Error(
+        "getting slice ConfigMap: HTTP " + std::to_string(got->status) +
+        ": " + got->body.substr(0, 256));
+  }
+  Result<jsonlite::ValuePtr> parsed = jsonlite::Parse(got->body);
+  if (!parsed.ok()) {
+    return Result<CoordDocResult>::Error("parsing slice ConfigMap: " +
+                                         parsed.error());
+  }
+  const jsonlite::Value& cm = **parsed;
+  doc.found = true;
+  doc.resource_version = ExtractResourceVersion(cm);
+  if (jsonlite::ValuePtr data = cm.Get("data");
+      data && data->kind == jsonlite::Value::Kind::kObject) {
+    for (const auto& [key, value] : data->object_items) {
+      if (value && value->kind == jsonlite::Value::Kind::kString) {
+        doc.data[key] = value->string_value;
+      }
+    }
+  }
+  return doc;
+}
+
+Status PatchCoordConfigMap(const ClusterConfig& config,
+                           const std::string& name,
+                           const std::map<std::string, std::string>& updates,
+                           const std::string& precondition_rv,
+                           bool create_if_missing, bool* conflict,
+                           bool* server_alive, WriteOutcome* outcome) {
+  WriteOutcome local_outcome;
+  if (outcome == nullptr) outcome = &local_outcome;
+  if (conflict != nullptr) *conflict = false;
+  if (server_alive != nullptr) *server_alive = false;
+
+  if (create_if_missing) {
+    // Bootstrap is a pure CREATE, never a patch: the caller just saw
+    // 404, but a rival bootstrapper may have created the doc in the
+    // meantime — an unconditioned merge would silently overwrite its
+    // freshly won lease and seed TWO leaders with the same epoch. POST
+    // makes the race explicit: exactly one 201, every loser a 409.
+    http::RequestOptions write = BaseOptions(config);
+    write.headers["Content-Type"] = "application/json";
+    Result<http::Response> created = CountedRequest(
+        "k8s.post", "POST", CoordUrl(config, ""),
+        ConfigMapBody(config, name, updates), write, outcome);
+    if (!created.ok()) {
+      return Status::Error("creating slice ConfigMap: " + created.error());
+    }
+    if (server_alive != nullptr) *server_alive = true;
+    if (created->status == 201 || created->status == 200) {
+      return Status::Ok();
+    }
+    if (created->status == 409) {  // lost the create race
+      if (conflict != nullptr) *conflict = true;
+      return Status::Error("slice ConfigMap create conflict");
+    }
+    return Status::Error("creating slice ConfigMap: HTTP " +
+                         std::to_string(created->status) + ": " +
+                         created->body.substr(0, 256));
+  }
+
+  http::RequestOptions patch_write = BaseOptions(config);
+  patch_write.headers["Content-Type"] = "application/merge-patch+json";
+  std::string body = "{";
+  if (!precondition_rv.empty()) {
+    body += "\"metadata\":{\"resourceVersion\":" +
+            jsonlite::Quote(precondition_rv) + "},";
+  }
+  body += "\"data\":" + jsonlite::SerializeStringMap(updates) + "}";
+
+  Result<http::Response> patched = CountedRequest(
+      "k8s.patch", "PATCH", CoordUrl(config, name), body, patch_write,
+      outcome);
+  if (!patched.ok()) {
+    return Status::Error("patching slice ConfigMap: " + patched.error());
+  }
+  if (server_alive != nullptr) *server_alive = true;
+  if (patched->status == 200 || patched->status == 201) return Status::Ok();
+  if (patched->status == 409) {
+    if (conflict != nullptr) *conflict = true;
+    return Status::Error("slice ConfigMap conflict: " +
+                         patched->body.substr(0, 128));
+  }
+  return Status::Error("patching slice ConfigMap: HTTP " +
+                       std::to_string(patched->status) + ": " +
+                       patched->body.substr(0, 256));
+}
+
 }  // namespace k8s
 }  // namespace tfd

@@ -109,6 +109,46 @@ Status UpdateNodeFeature(const ClusterConfig& config,
 // singletons). Tests that want isolation pass their own SinkState.
 SinkState& DefaultSinkState();
 
+// ---- slice-coordination blackboard (slice/coord.h) ------------------------
+// The slice coherence layer keeps one ConfigMap per slice
+// ("tfd-slice-<id>", core /api/v1 — no CRD needed) holding the lease,
+// the member reports, and the leader's verdict. These two calls are the
+// whole transport; they ride the SAME request machinery as the
+// NodeFeature sink (per-request deadline, tfd_sink_requests_total
+// counting, Retry-After/APF capture into `outcome`, and the
+// k8s.get/k8s.patch/k8s.post/k8s.connect fault points).
+
+struct CoordDocResult {
+  bool found = false;
+  std::string resource_version;
+  std::map<std::string, std::string> data;  // ConfigMap .data (strings)
+};
+
+// GET the coordination ConfigMap. `server_alive` (non-null) reports
+// whether ANY HTTP response arrived — a 429/5xx is an ALIVE server (the
+// caller's partition/orphan logic must not read pacing as a network
+// partition); a transport error is not.
+Result<CoordDocResult> GetCoordConfigMap(const ClusterConfig& config,
+                                         const std::string& name,
+                                         bool* server_alive,
+                                         WriteOutcome* outcome = nullptr);
+
+// JSON-merge-patches `updates` into the ConfigMap's .data (disjoint keys
+// merge independently — concurrent member-report writes never clobber
+// each other). `precondition_rv` non-empty rides as the
+// metadata.resourceVersion precondition; a stale one sets *conflict
+// (and errors). `create_if_missing` makes the call a PURE CREATE
+// (POST) instead: the caller just saw the doc absent, and a rival
+// bootstrapper racing the same gap must lose loudly (409 -> *conflict)
+// rather than have its freshly won lease silently merged over.
+Status PatchCoordConfigMap(const ClusterConfig& config,
+                           const std::string& name,
+                           const std::map<std::string, std::string>& updates,
+                           const std::string& precondition_rv,
+                           bool create_if_missing, bool* conflict,
+                           bool* server_alive,
+                           WriteOutcome* outcome = nullptr);
+
 // Builds the JSON merge patch that turns `acked` into `desired`:
 // changed/added keys verbatim, removed keys null, under spec.labels —
 // plus the nfd node-name metadata label when `fix_node_name` (the GET

@@ -27,6 +27,31 @@ bool GovernedKey(const std::string& key) {
   // the numbers would publish a demoted class next to the healthy
   // chip's held throughput — a torn pair.
   if (HasPrefix(key, kPerfPrefix)) return key == kPerfClass;
+  // Slice-coherence verdict keys (slice/coord.h) are exempt from the
+  // per-key hold-down: their contract is that every member of a slice
+  // publishes IDENTICAL values, and per-host hold-down timers — started
+  // at each host's own last change — would keep hosts disagreeing for
+  // up to a whole window after every verdict move. Anti-flap for these
+  // keys lives where the whole slice shares it — in the verdict
+  // protocol: the leader's verdict only moves when a member's report
+  // actually changes or ages out of the agreement window, and every
+  // input to a report is itself debounced (device snapshot tiers,
+  // healthsm quarantine, the perf class streaks). Verdict movement is
+  // correspondingly excluded from the slice source's flap fingerprint
+  // (sched/snapshot.cc FingerprintedLabel) — a coordinated transition
+  // every member adopts identically is not per-host instability.
+  // The slice CLASS is the exception: it is governed like tpu.perf.class
+  // (demotions bypass below, promotions ride the hold-down).
+  // tpu.slice.hosts is NOT key-exempt: the topology labeler publishes
+  // it too (with or without coordination), and waiving its hold-down
+  // would let a flapping topology probe flip it freely next to its
+  // still-governed siblings (slice.shape, slice.chips-per-host) — a
+  // torn set. Coordination-OWNED changes of it (the provenance names
+  // the slice-coord labeler) bypass in Apply() instead.
+  if (key == kSliceId || key == kSliceHealthyHosts ||
+      key == kSliceDegraded) {
+    return false;
+  }
   return true;
 }
 
@@ -111,6 +136,21 @@ void LabelGovernor::Apply(const Labels& previous,
     bool first_appearance =
         !prev_has && last_change_.find(key) == last_change_.end();
     bool marker_upgrade = !cand_has && DowngradeMarkerKey(key);
+    // tpu.slice.hosts has two producers. The topology labeler's copy
+    // is a per-host probe fact and stays governed like its siblings
+    // (slice.shape, slice.chips-per-host); the coordination verdict's
+    // copy carries the slice contract — identical-or-absent on every
+    // member — and is exempt like the other verdict keys (see
+    // GovernedKey). The provenance of the value IN PLAY (candidate's,
+    // or for a removal the previously published one's) names the
+    // producer this change belongs to.
+    bool coord_slice_hosts = false;
+    if (key == kSliceHosts) {
+      const Provenance& from = cand_has ? *provenance : prev_provenance;
+      auto it = from.find(key);
+      coord_slice_hosts =
+          it != from.end() && it->second.labeler == kSliceCoordLabeler;
+    }
     // A perf-class DEMOTION (gold -> silver -> degraded) is
     // monotone-informative in the conservative direction: the
     // characterization pipeline already debounced it (hysteresis +
@@ -119,14 +159,19 @@ void LabelGovernor::Apply(const Labels& previous,
     // governed — flipping back up is where flap damage lives, and the
     // debounce's recover_after streak plus this hold-down make the
     // up-down cycle strictly slower than the down leg.
+    // tpu.slice.class carries the same contract slice-wide (the verdict
+    // is the min of already-debounced member classes): a slice demotion
+    // must land on every member promptly, a promotion earns its way
+    // back through the hold-down.
     bool class_demotion = false;
-    if (key == kPerfClass && prev_has && cand_has) {
+    if ((key == kPerfClass || key == kSliceClass) && prev_has &&
+        cand_has) {
       int was = perf::ClassRankFromName(prev_it->second);
       int now_rank = perf::ClassRankFromName(cand_it->second);
       class_demotion = was >= 0 && now_rank > was;
     }
     if (first_appearance || marker_upgrade || class_demotion ||
-        level_improved) {
+        coord_slice_hosts || level_improved) {
       pending_change_[key] = now_s;
       continue;
     }

@@ -27,7 +27,15 @@
 //     tpu.health.quarantined annotation (healthsm's already-debounced
 //     verdict) are exempt outright, and tpu.snapshot-age-seconds
 //     mirrors tpu.degraded's outcome rather than burning its own
-//     timer (the pair is set and cleared together).
+//     timer (the pair is set and cleared together);
+//   - the slice-coherence verdict keys (tpu.slice.id/healthy-hosts/
+//     degraded) are exempt outright: their contract is byte-identical
+//     values on every member of a slice, and per-host hold-down
+//     timers would break it — anti-flap for them lives in the verdict
+//     protocol (slice/coord.h). tpu.slice.class is governed with the
+//     perf-class demotion bypass; tpu.slice.hosts is exempt only when
+//     the value in play carries the slice-coord labeler's provenance
+//     (the topology labeler's copy of the same key stays governed).
 //
 // Only `google.com/tpu*` keys are governed: the timestamp label
 // (google.com/tfd.*) is cadence proof, not node identity.

@@ -55,6 +55,23 @@ inline constexpr char kSliceChipsPerHost[] =
     "google.com/tpu.slice.chips-per-host";
 inline constexpr char kSliceWorkerId[] = "google.com/tpu.slice.worker-id";
 
+// Slice coherence (slice/coord.h, --slice-coordination): published from
+// the slice's AGREED verdict only — every member of a slice carries
+// byte-identical values for these keys, or none at all (a member that
+// loses the coordination blackboard self-demotes by dropping them).
+inline constexpr char kSliceId[] = "google.com/tpu.slice.id";
+inline constexpr char kSliceHealthyHosts[] =
+    "google.com/tpu.slice.healthy-hosts";
+inline constexpr char kSliceDegraded[] = "google.com/tpu.slice.degraded";
+// min (worst) of the member hosts' tpu.perf.class — a slice is as fast
+// as its slowest host.
+inline constexpr char kSliceClass[] = "google.com/tpu.slice.class";
+// The provenance labeler name for coordination-published labels — the
+// governor distinguishes the verdict's tpu.slice.hosts (exempt, slice
+// contract) from the topology labeler's (governed, per-host fact) by
+// it.
+inline constexpr char kSliceCoordLabeler[] = "slice-coord";
+
 // TPU-VM detection (vGPU-path analogue) and multi-slice identity.
 inline constexpr char kTpuVmPresent[] = "google.com/tpu-vm.present";
 inline constexpr char kTpuVmPreemptible[] = "google.com/tpu-vm.preemptible";

@@ -28,6 +28,20 @@ bool FingerprintedLabel(const std::string& key) {
   // healthy re-verification "unstable" and walk the perf source toward
   // quarantine for doing its job.
   if (HasPrefix(key, lm::kPerfPrefix)) return key == lm::kPerfClass;
+  // tpu.slice.* labels move exactly when the slice's AGREED state
+  // moves — member death, rejoin, an orphan self-demotion removing the
+  // whole set, a debounced class change. Those are coordinated
+  // transitions (already debounced member-side and leader-side), not
+  // per-host probe instability, and counting them here would let one
+  // chaotic-but-coherent hour (a member crash-looping, a partition
+  // healing) quarantine the slice source — a PER-HOST label freeze
+  // that breaks the cross-host agreement the coherence layer exists
+  // for. The slice source's flap protection is the verdict protocol
+  // itself: demotion needs a full agreement window of silence, orphan
+  // needs a full lease of unreachability. (These keys only ever appear
+  // in the slice source's snapshot; device-labeler topology labels are
+  // rendered later and never enter a Snapshot's label payload.)
+  if (HasPrefix(key, "google.com/tpu.slice.")) return false;
   if (!HasPrefix(key, lm::kHealthPrefix)) return true;
   if (HasPrefix(key, lm::kHealthDevicePrefix)) return false;
   const std::string fact = key.substr(sizeof(lm::kHealthPrefix) - 1);

@@ -4,13 +4,20 @@
 #include <chrono>
 #include <memory>
 
+#include "tfd/gce/metadata.h"
 #include "tfd/healthsm/healthsm.h"
+#include "tfd/k8s/breaker.h"
+#include "tfd/k8s/client.h"
+#include "tfd/k8s/desync.h"
 #include "tfd/lm/health_exec.h"
 #include "tfd/lm/schema.h"
 #include "tfd/obs/journal.h"
 #include "tfd/obs/metrics.h"
 #include "tfd/perf/perf.h"
+#include "tfd/platform/detect.h"
 #include "tfd/resource/factory.h"
+#include "tfd/sched/state.h"
+#include "tfd/slice/coord.h"
 #include "tfd/slice/topology.h"
 #include "tfd/util/file.h"
 #include "tfd/util/logging.h"
@@ -396,6 +403,175 @@ Status RunPerfProbe(const config::Config& config,
   return Status::Ok();
 }
 
+// ---- slice coherence (slice/coord.h) -------------------------------------
+
+// The coordinator's blackboard transport over the hardened k8s client.
+// Everything PRs 4/7 built for the sink is inherited: per-request
+// deadlines, the k8s.* fault points, request counting, and a circuit
+// breaker — its OWN instance (coordination traffic must not trip the
+// label sink's circuit, or vice versa) with the same thresholds, plus
+// the 429 Retry-After deferral with the fleet desync spread.
+class K8sCoordStore : public slice::DocStore {
+ public:
+  explicit K8sCoordStore(const config::Flags& flags)
+      : deadline_ms_(flags.sink_request_deadline_s * 1000) {
+    // Cooldown capped at the lease duration: the lease is the
+    // protocol's own time constant — a member that orphaned at one
+    // lease of silence must probe for the healed blackboard at the
+    // same cadence, not sit out the label sink's (longer) cooldown
+    // while its peers count it dead.
+    breaker_.Configure(
+        {flags.sink_breaker_failures,
+         static_cast<double>(std::min(flags.sink_breaker_cooldown_s,
+                                      flags.slice_lease_duration_s))});
+  }
+
+  Status Get(const std::string& name, slice::CoordDoc* doc,
+             bool* server_alive) override {
+    *server_alive = false;
+    Result<k8s::ClusterConfig> cluster = Admit(server_alive);
+    if (!cluster.ok()) return cluster.status();
+    k8s::WriteOutcome outcome;
+    Result<k8s::CoordDocResult> got =
+        k8s::GetCoordConfigMap(*cluster, name, server_alive, &outcome);
+    Settle(got.ok(), *server_alive, outcome);
+    if (!got.ok()) return got.status();
+    doc->found = got->found;
+    doc->resource_version = got->resource_version;
+    doc->data = got->data;
+    return Status::Ok();
+  }
+
+  Status Patch(const std::string& name,
+               const std::map<std::string, std::string>& updates,
+               const std::string& precondition_rv, bool create_if_missing,
+               bool* conflict, bool* server_alive) override {
+    *conflict = false;
+    *server_alive = false;
+    Result<k8s::ClusterConfig> cluster = Admit(server_alive);
+    if (!cluster.ok()) return cluster.status();
+    k8s::WriteOutcome outcome;
+    Status wrote = k8s::PatchCoordConfigMap(
+        *cluster, name, updates, precondition_rv, create_if_missing,
+        conflict, server_alive, &outcome);
+    // A precondition conflict is the protocol WORKING (a rival writer
+    // moved the doc), not a sink failure — it must not feed the
+    // breaker's failure streak.
+    Settle(wrote.ok() || *conflict, *server_alive, outcome);
+    return wrote;
+  }
+
+ private:
+  Result<k8s::ClusterConfig> Admit(bool* server_alive) {
+    if (!breaker_.Allow()) {
+      // A deferral is server-directed pacing: the apiserver is ALIVE,
+      // and the coordinator's partition/orphan logic must know that.
+      *server_alive = breaker_.deferred();
+      return Result<k8s::ClusterConfig>::Error(
+          breaker_.deferred() ? "slice blackboard write deferred "
+                                "(server Retry-After)"
+                              : "slice blackboard circuit breaker open");
+    }
+    Result<k8s::ClusterConfig> cluster = k8s::LoadInClusterConfig();
+    if (!cluster.ok()) {
+      breaker_.RecordTransientFailure();
+      return cluster;
+    }
+    cluster->request_deadline_ms = deadline_ms_;
+    return cluster;
+  }
+
+  void Settle(bool ok, bool server_alive,
+              const k8s::WriteOutcome& outcome) {
+    if (ok) {
+      breaker_.RecordSuccess();
+    } else if (outcome.retry_after_s > 0) {
+      breaker_.Defer(
+          k8s::desync::SpreadRetryAfterS(outcome.retry_after_s,
+                                         k8s::desync::NodeKey()),
+          outcome.apf_rejected ? "APF Retry-After" : "Retry-After");
+    } else {
+      (void)server_alive;
+      breaker_.RecordTransientFailure();
+    }
+  }
+
+  k8s::CircuitBreaker breaker_;
+  int deadline_ms_ = 0;
+};
+
+// This host's view for the member report: shape + freshness from the
+// serving-preference device snapshot, healthsm quarantine, the health
+// exec's verdict, and the debounced perf class. All already-debounced
+// inputs — the report never flaps faster than the layers beneath it.
+slice::MemberReport BuildLocalReport(const SnapshotStore& store,
+                                     const config::Flags& flags,
+                                     const slice::SliceIdentity& identity,
+                                     double now) {
+  slice::MemberReport report;
+  report.host = NodeIdentity();
+  report.worker_id = identity.worker_id;
+  report.reported_at = now;
+
+  bool device_fresh = false;
+  for (const std::string& name : store.DeviceSources()) {
+    SourceView view = store.View(name);
+    if (!view.last_ok.has_value() || view.tier == Tier::kExpired) continue;
+    const resource::ManagerPtr& manager = view.last_ok->manager;
+    if (manager == nullptr) continue;
+    int chips = 0;
+    if (Result<std::vector<resource::DevicePtr>> devices =
+            manager->GetDevices();
+        devices.ok()) {
+      chips = static_cast<int>(devices->size());
+    }
+    std::string topo;
+    if (Result<resource::TopologyInfo> t = manager->GetTopology();
+        t.ok()) {
+      topo = t->topology.empty() ? t->accelerator_type : t->topology;
+    }
+    report.shape = "chips=" + std::to_string(chips) +
+                   (topo.empty() ? "" : ";topo=" + topo);
+    device_fresh = view.tier == Tier::kFresh;
+    break;  // store order is serving preference
+  }
+  bool quarantined = !healthsm::Default().QuarantinedKeys(now).empty();
+  bool health_bad = false;
+  SourceView health = store.View("health");
+  if (health.registered && health.last_ok.has_value() &&
+      health.tier != Tier::kExpired) {
+    auto it = health.last_ok->labels.find(lm::kHealthOk);
+    health_bad =
+        it != health.last_ok->labels.end() && it->second == "false";
+  }
+  report.healthy = device_fresh && !quarantined && !health_bad;
+  if (flags.perf_characterize) {
+    if (std::optional<perf::Characterization> c = perf::Default().Get()) {
+      report.perf_class = perf::ClassName(c->class_rank);
+    }
+  }
+  return report;
+}
+
+// Slice identity from the live metadata server (when plausible) plus
+// the env overrides — resolved once per config load.
+slice::SliceIdentity ResolveSliceIdentity(const config::Flags& flags) {
+  std::map<std::string, std::string> tpu_env;
+  std::string accel;
+  if (platform::MetadataPlausible(flags.metadata_endpoint)) {
+    gce::MetadataClient client(flags.metadata_endpoint);
+    if (Result<std::map<std::string, std::string>> env = client.TpuEnv();
+        env.ok()) {
+      tpu_env = *env;
+    }
+    if (Result<std::string> a = client.AcceleratorType(); a.ok()) {
+      accel = *a;
+    }
+  }
+  return slice::DeriveSliceIdentity(tpu_env, accel,
+                                    slice::SliceEnvFromProcess());
+}
+
 }  // namespace
 
 std::vector<ProbeSpec> BuildProbeSpecs(
@@ -592,6 +768,101 @@ std::vector<ProbeSpec> BuildProbeSpecs(
       return perf::Default().AllowedNow(WallClockSeconds(), duty_pct);
     };
     specs.push_back(std::move(spec));
+  }
+
+  if (flags.slice_coordination && !flags.oneshot) {
+    // Multi-host slice coherence: the coordinator is configured every
+    // load (state survives a SIGHUP of the same slice) and the "slice"
+    // worker ticks it at the rewrite cadence. A host with no derivable
+    // slice identity stays single-host — Configure() sets the gauge
+    // and no source is registered, so nothing slice-scoped is ever
+    // published on a guess.
+    slice::SliceIdentity identity = ResolveSliceIdentity(flags);
+    // The coordination tick is the LEASE's cadence, not the rewrite's:
+    // the holder renews only inside Tick, so ticking slower than the
+    // lease (default 30s lease under the default 60s rewrite interval)
+    // would leave the lease expired between renewals and churn
+    // leadership/epochs every round. A third of the lease gives two
+    // missed renewals of margin before failover.
+    const int slice_tick_s =
+        std::min(sleep_s,
+                 std::max(1, flags.slice_lease_duration_s / 3));
+    slice::CoordPolicy coord_policy;
+    coord_policy.lease_duration_s = flags.slice_lease_duration_s;
+    coord_policy.agreement_timeout_s =
+        flags.slice_agreement_timeout_s > 0
+            ? flags.slice_agreement_timeout_s
+            : 2 * slice_tick_s;
+    slice::Default().Configure(identity, NodeIdentity(), coord_policy);
+    // Configure() may substitute the state file's restored identity
+    // when live derivation had NO name evidence (metadata server down
+    // at boot) — re-read the coordinator's answer.
+    identity = slice::Default().identity();
+    if (!identity.valid) {
+      TFD_LOG_INFO << "slice coordination enabled but no slice identity "
+                      "is derivable from metadata/env; staying in "
+                      "single-host mode";
+    } else {
+      TFD_LOG_INFO << "slice coordination: slice " << identity.slice_id
+                   << " worker " << identity.worker_id << "/"
+                   << identity.num_hosts << " (identity from "
+                   << identity.source << ")";
+      // The verdict republishes every tick; freshness mirrors the
+      // device sources' slack so one slipped tick never flaps the
+      // degradation markers.
+      TierPolicy policy;
+      policy.fresh_for_s = 4 * sleep_s + 10;
+      policy.usable_for_s = flags.snapshot_usable_for_s > 0
+                                ? flags.snapshot_usable_for_s
+                                : policy.fresh_for_s + 6 * sleep_s;
+      store->Register("slice", policy, /*device_source=*/false);
+
+      auto coord_store = std::make_shared<K8sCoordStore>(flags);
+      config::Flags flags_copy = flags;
+      std::shared_ptr<SnapshotStore> store_ref = store;
+      ProbeSpec spec;
+      spec.name = "slice";
+      spec.probe = [coord_store, store_ref, flags_copy,
+                    identity](Snapshot* out, bool* /*fatal*/) {
+        // Until the first device probe round settles, this host's view
+        // is UNKNOWN, not unhealthy — a freshly (re)started member
+        // must not report itself sick and degrade the whole slice for
+        // a boot second (a resumed leader would even WRITE that false
+        // verdict). Error out instead: no report, no labels, the
+        // blackboard's standing state carries until we can actually
+        // answer (~one worker round).
+        bool device_settled = false;
+        for (const std::string& name : store_ref->DeviceSources()) {
+          if (store_ref->View(name).settled) {
+            device_settled = true;
+            break;
+          }
+        }
+        if (!device_settled) {
+          return Status::Error(
+              "waiting for the first device probe round before "
+              "reporting to the slice");
+        }
+        double now = WallClockSeconds();
+        slice::MemberReport local =
+            BuildLocalReport(*store_ref, flags_copy, identity, now);
+        // Tick NEVER fails on transport: an orphaned member must
+        // publish an EMPTY slice snapshot (self-demotion to
+        // single-host labels), not let a stale one keep serving from
+        // the store until expiry.
+        slice::Coordinator::TickResult result =
+            slice::Default().Tick(coord_store.get(), local, now);
+        out->labels = result.labels;
+        return Status::Ok();
+      };
+      spec.interval_s = slice_tick_s;
+      spec.backoff_initial_s = slice_tick_s;
+      spec.backoff_max_s =
+          std::max(flags.slice_lease_duration_s, 8 * slice_tick_s);
+      spec.device_source = false;
+      spec.exclusive = false;  // pure HTTP; never touches the chips
+      specs.push_back(std::move(spec));
+    }
   }
 
   return specs;

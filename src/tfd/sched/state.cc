@@ -64,6 +64,11 @@ std::string SerializeState(const PersistedState& state) {
   if (!state.perf_json.empty()) {
     payload += ",\"perf\":" + state.perf_json;
   }
+  // Slice-coordination state rides along (a kill -9'd leader must
+  // resume its lease without a leadership flap). Opaque, like healthsm.
+  if (!state.slice_json.empty()) {
+    payload += ",\"slice\":" + state.slice_json;
+  }
   payload += "}";
   return std::string(kMagic) + " " + HexU64(Fnv1a64(payload)) + " " +
          std::to_string(payload.size()) + "\n" + payload;
@@ -179,6 +184,10 @@ Result<PersistedState> ParseState(const std::string& contents) {
   // journals the rejection.
   jsonlite::ValuePtr perf = root.Get("perf");
   if (perf) state.perf_json = jsonlite::Serialize(*perf);
+  jsonlite::ValuePtr slice = root.Get("slice");
+  if (slice && slice->kind == jsonlite::Value::Kind::kObject) {
+    state.slice_json = jsonlite::Serialize(*slice);
+  }
   return state;
 }
 
@@ -211,7 +220,8 @@ Result<PersistedState> LoadState(const std::string& path,
                                  const std::string& expect_node,
                                  double max_age_s, double now_wall,
                                  std::string* stale_healthsm_json,
-                                 std::string* stale_perf_json) {
+                                 std::string* stale_perf_json,
+                                 std::string* stale_slice_json) {
   using R = Result<PersistedState>;
   Result<std::string> contents = ReadFile(path);
   if (!contents.ok()) return R::Error(contents.error());
@@ -231,6 +241,9 @@ Result<PersistedState> LoadState(const std::string& path,
     }
     if (stale_perf_json != nullptr) {
       *stale_perf_json = state->perf_json;
+    }
+    if (stale_slice_json != nullptr) {
+      *stale_slice_json = state->slice_json;
     }
     return R::Error("state snapshot age " +
                     std::to_string(static_cast<long long>(restored_age_s)) +

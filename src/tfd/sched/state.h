@@ -59,6 +59,15 @@ struct PersistedState {
   // restores labels normally and triggers exactly one
   // characterization). Empty when never characterized.
   std::string perf_json;
+  // Serialized slice-coordination state (slice::Coordinator
+  // SerializeJson): the lease epoch, the adopted slice verdict, and the
+  // join status — a kill -9'd slice LEADER must resume its still-valid
+  // lease on restart instead of flapping leadership, and a restarted
+  // member keeps serving the agreed slice labels through the probe
+  // settle window. Carried opaquely like healthsm_json; a payload for a
+  // different slice id is dropped at Configure time. Empty when slice
+  // coordination is off or single-host.
+  std::string slice_json;
 };
 
 // This node's identity for the foreign-node gate.
@@ -91,11 +100,16 @@ Status SaveState(const std::string& path, const PersistedState& state);
 // trusted nor throw away a measurement the silicon still matches.
 // Untouched on success and on every other rejection (corrupt/foreign
 // state is never trusted).
+// `stale_slice_json` joins them for the same reason: the slice lease's
+// truth lives in the apiserver, not in this file's age — a crash loop
+// longer than the snapshot window must not make a restarted leader
+// forget an epoch it may still hold.
 Result<PersistedState> LoadState(const std::string& path,
                                  const std::string& expect_node,
                                  double max_age_s, double now_wall,
                                  std::string* stale_healthsm_json = nullptr,
-                                 std::string* stale_perf_json = nullptr);
+                                 std::string* stale_perf_json = nullptr,
+                                 std::string* stale_slice_json = nullptr);
 
 }  // namespace sched
 }  // namespace tfd

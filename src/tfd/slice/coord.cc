@@ -1,0 +1,845 @@
+#include "tfd/slice/coord.h"
+
+#include <algorithm>
+#include <cstdlib>
+
+#include "tfd/k8s/desync.h"
+#include "tfd/lm/schema.h"
+#include "tfd/obs/journal.h"
+#include "tfd/obs/metrics.h"
+#include "tfd/perf/perf.h"
+#include "tfd/slice/topology.h"
+#include "tfd/util/jsonlite.h"
+#include "tfd/util/logging.h"
+#include "tfd/util/strings.h"
+
+namespace tfd {
+namespace slice {
+
+namespace {
+
+// Product of a "X,Y,Z" bounds string; 0 on any unparsable part (matches
+// resource/metadata_manager.cc's reading of the same attributes).
+int BoundsProduct(const std::string& text) {
+  if (text.empty()) return 0;
+  int product = 1;
+  for (const std::string& part : SplitString(text, ',')) {
+    int v = 0;
+    if (!ParseNonNegInt(TrimSpace(part), &v) || v <= 0) return 0;
+    product *= v;
+  }
+  return product;
+}
+
+std::string MapGet(const std::map<std::string, std::string>& m,
+                   const char* key) {
+  auto it = m.find(key);
+  return it == m.end() ? "" : TrimSpace(it->second);
+}
+
+// perf-class name -> rank, via the single-homed perf.h names (gold=0 <
+// silver=1 < degraded=2; see perf::kRankGold..kRankDegraded). -1 =
+// unknown/absent, excluded from the slice-class merge.
+int RankOfClassName(const std::string& name) {
+  for (int rank = perf::kRankGold; rank <= perf::kRankDegraded; rank++) {
+    if (name == perf::ClassName(rank)) return rank;
+  }
+  return -1;
+}
+
+double NumberOr(const jsonlite::Value& obj, const char* key, double dflt) {
+  jsonlite::ValuePtr v = obj.Get(key);
+  if (v && v->kind == jsonlite::Value::Kind::kNumber) return v->number_value;
+  return dflt;
+}
+
+std::string StringOr(const jsonlite::Value& obj, const char* key) {
+  jsonlite::ValuePtr v = obj.Get(key);
+  if (v && v->kind == jsonlite::Value::Kind::kString) return v->string_value;
+  return "";
+}
+
+bool BoolOr(const jsonlite::Value& obj, const char* key, bool dflt) {
+  jsonlite::ValuePtr v = obj.Get(key);
+  if (v && v->kind == jsonlite::Value::Kind::kBool) return v->bool_value;
+  return dflt;
+}
+
+obs::Gauge* SliceStateGauge() {
+  return obs::Default().GetGauge(
+      "tfd_slice_state",
+      "Slice coordination state: 0 single-host, 1 pending (no verdict "
+      "adopted), 2 follower, 3 leader, 4 orphaned (blackboard "
+      "unreachable past a lease; slice labels self-demoted).");
+}
+
+}  // namespace
+
+// ---- identity ------------------------------------------------------------
+
+std::string SanitizeSliceId(const std::string& raw) {
+  std::string safe;
+  bool last_dash = true;  // also trims leading dashes
+  for (char c : ToLower(raw)) {
+    bool ok = (c >= 'a' && c <= 'z') || (c >= '0' && c <= '9');
+    if (ok) {
+      safe.push_back(c);
+      last_dash = false;
+    } else if (!last_dash) {
+      safe.push_back('-');
+      last_dash = true;
+    }
+  }
+  while (!safe.empty() && safe.back() == '-') safe.pop_back();
+  if (safe.size() > 32) safe.resize(32);
+  // The raw-name hash suffix keeps two names that sanitize identically
+  // ("tpu/a" vs "tpu:a") from sharing one blackboard. TEXTBOOK FNV-1a
+  // (k8s/desync.h), NOT util/strings.h's truncated-basis state-file
+  // variant: every member — and the Python twin (tpufd/slicecoord.py,
+  // which reuses the sink twin's pinned fnv1a64) — must derive the
+  // same id.
+  std::string hex = HexU64(k8s::desync::Fnv1a64(raw));
+  std::string suffix = hex.size() > 8 ? hex.substr(hex.size() - 8) : hex;
+  return safe.empty() ? suffix : safe + "-" + suffix;
+}
+
+std::string CoordDocName(const std::string& slice_id) {
+  return "tfd-slice-" + slice_id;
+}
+
+SliceIdentity DeriveSliceIdentity(
+    const std::map<std::string, std::string>& tpu_env,
+    const std::string& accelerator_type,
+    const std::map<std::string, std::string>& env) {
+  SliceIdentity id;
+
+  // Worker index.
+  std::string worker = MapGet(env, "TFD_SLICE_WORKER_ID");
+  if (worker.empty()) worker = MapGet(tpu_env, "WORKER_ID");
+  if (worker.empty()) worker = MapGet(env, "TPU_WORKER_ID");
+  int worker_id = -1;
+  if (!worker.empty() && !ParseNonNegInt(worker, &worker_id)) worker_id = -1;
+  id.worker_id = worker_id;
+
+  // Expected host count.
+  int hosts = 0;
+  std::string hosts_env = MapGet(env, "TFD_SLICE_HOSTS");
+  if (!hosts_env.empty()) ParseNonNegInt(hosts_env, &hosts);
+  if (hosts <= 0) hosts = BoundsProduct(MapGet(tpu_env, "HOST_BOUNDS"));
+  if (hosts <= 0) {
+    std::string accel = MapGet(tpu_env, "ACCELERATOR_TYPE");
+    if (accel.empty()) accel = TrimSpace(accelerator_type);
+    Result<AcceleratorType> parsed = ParseAcceleratorType(accel);
+    if (parsed.ok() && parsed->num_chips > 0) {
+      int per_host =
+          BoundsProduct(MapGet(tpu_env, "CHIPS_PER_HOST_BOUNDS"));
+      if (per_host <= 0) per_host = parsed->spec.max_chips_per_host;
+      if (per_host > 0) {
+        hosts = (parsed->num_chips + per_host - 1) / per_host;
+      }
+    }
+  }
+  id.num_hosts = hosts;
+
+  // Slice name: must be an identifier every member shares and no other
+  // slice does — never guessed from shape alone (two v5e-64 slices in
+  // one cluster would collide).
+  std::string name = MapGet(env, "TFD_SLICE_ID");
+  id.source = "env";
+  if (name.empty()) {
+    name = MapGet(tpu_env, "TPU_NAME");
+    if (name.empty()) name = MapGet(tpu_env, "NODE_ID");
+    id.source = "tpu-env";
+  }
+  if (name.empty()) {
+    // GKE's TPU webhook injects the slice's full worker-hostname list
+    // into every member — shared by exactly the slice's pods.
+    std::string hostnames = MapGet(env, "TPU_WORKER_HOSTNAMES");
+    if (!hostnames.empty()) {
+      // Textbook FNV (desync), twin-pinned — see SanitizeSliceId.
+      name = "gke-" + HexU64(k8s::desync::Fnv1a64(hostnames));
+      id.source = "gke-env";
+    }
+  }
+  if (name.empty()) {
+    id.source.clear();
+    return id;  // no shared identity evidence: single-host mode
+  }
+  // Multislice: each slice of the job coordinates separately.
+  std::string megascale = MapGet(tpu_env, "MEGASCALE_SLICE_ID");
+  if (megascale.empty()) megascale = MapGet(env, "MEGASCALE_SLICE_ID");
+  if (!megascale.empty()) name += "-s" + megascale;
+
+  id.raw_name = name;
+  id.slice_id = SanitizeSliceId(name);
+  id.valid = id.num_hosts >= 2 && id.worker_id >= 0 &&
+             id.worker_id < id.num_hosts;
+  return id;
+}
+
+std::map<std::string, std::string> SliceEnvFromProcess() {
+  std::map<std::string, std::string> env;
+  for (const char* key :
+       {"TFD_SLICE_ID", "TFD_SLICE_WORKER_ID", "TFD_SLICE_HOSTS",
+        "TPU_WORKER_ID", "TPU_WORKER_HOSTNAMES", "MEGASCALE_SLICE_ID"}) {
+    if (const char* v = std::getenv(key)) {
+      if (*v != '\0') env[key] = v;
+    }
+  }
+  return env;
+}
+
+// ---- blackboard documents ------------------------------------------------
+
+std::string SerializeReport(const MemberReport& report) {
+  return "{\"host\":" + jsonlite::Quote(report.host) +
+         ",\"worker\":" + std::to_string(report.worker_id) +
+         ",\"healthy\":" + (report.healthy ? "true" : "false") +
+         ",\"shape\":" + jsonlite::Quote(report.shape) +
+         ",\"class\":" + jsonlite::Quote(report.perf_class) +
+         ",\"at\":" + Fixed3(report.reported_at) + "}";
+}
+
+Result<MemberReport> ParseReport(const std::string& json) {
+  Result<jsonlite::ValuePtr> parsed = jsonlite::Parse(json);
+  if (!parsed.ok()) {
+    return Result<MemberReport>::Error("report: " + parsed.error());
+  }
+  const jsonlite::Value& obj = **parsed;
+  if (obj.kind != jsonlite::Value::Kind::kObject) {
+    return Result<MemberReport>::Error("report: not an object");
+  }
+  MemberReport report;
+  report.host = StringOr(obj, "host");
+  if (report.host.empty()) {
+    return Result<MemberReport>::Error("report: missing host");
+  }
+  report.worker_id = static_cast<int>(NumberOr(obj, "worker", -1));
+  report.healthy = BoolOr(obj, "healthy", false);
+  report.shape = StringOr(obj, "shape");
+  report.perf_class = StringOr(obj, "class");
+  report.reported_at = NumberOr(obj, "at", 0);
+  return report;
+}
+
+std::string SerializeLease(const Lease& lease) {
+  return "{\"holder\":" + jsonlite::Quote(lease.holder) +
+         ",\"epoch\":" + std::to_string(lease.epoch) +
+         ",\"renewed_at\":" + Fixed3(lease.renewed_at) +
+         ",\"duration_s\":" + std::to_string(lease.duration_s) + "}";
+}
+
+Result<Lease> ParseLease(const std::string& json) {
+  Result<jsonlite::ValuePtr> parsed = jsonlite::Parse(json);
+  if (!parsed.ok()) return Result<Lease>::Error("lease: " + parsed.error());
+  const jsonlite::Value& obj = **parsed;
+  if (obj.kind != jsonlite::Value::Kind::kObject) {
+    return Result<Lease>::Error("lease: not an object");
+  }
+  Lease lease;
+  lease.holder = StringOr(obj, "holder");
+  lease.epoch = static_cast<uint64_t>(NumberOr(obj, "epoch", 0));
+  lease.renewed_at = NumberOr(obj, "renewed_at", 0);
+  lease.duration_s = static_cast<int>(NumberOr(obj, "duration_s", 0));
+  return lease;
+}
+
+bool LeaseExpired(const Lease& lease, double now_s) {
+  if (lease.holder.empty() || lease.duration_s <= 0) return true;
+  return now_s - lease.renewed_at > lease.duration_s;
+}
+
+std::string SerializeVerdict(const SliceVerdict& verdict) {
+  std::string members;
+  for (const std::string& m : verdict.members) {
+    if (!members.empty()) members += ",";
+    members += jsonlite::Quote(m);
+  }
+  return "{\"seq\":" + std::to_string(verdict.seq) +
+         ",\"leader\":" + jsonlite::Quote(verdict.leader) +
+         ",\"computed_at\":" + Fixed3(verdict.computed_at) +
+         ",\"hosts\":" + std::to_string(verdict.hosts) +
+         ",\"healthy_hosts\":" + std::to_string(verdict.healthy_hosts) +
+         ",\"degraded\":" + (verdict.degraded ? "true" : "false") +
+         ",\"class\":" + jsonlite::Quote(verdict.perf_class) +
+         ",\"members\":[" + members + "]}";
+}
+
+Result<SliceVerdict> ParseVerdict(const std::string& json) {
+  Result<jsonlite::ValuePtr> parsed = jsonlite::Parse(json);
+  if (!parsed.ok()) {
+    return Result<SliceVerdict>::Error("verdict: " + parsed.error());
+  }
+  const jsonlite::Value& obj = **parsed;
+  if (obj.kind != jsonlite::Value::Kind::kObject) {
+    return Result<SliceVerdict>::Error("verdict: not an object");
+  }
+  SliceVerdict verdict;
+  verdict.seq = static_cast<uint64_t>(NumberOr(obj, "seq", 0));
+  verdict.leader = StringOr(obj, "leader");
+  verdict.computed_at = NumberOr(obj, "computed_at", 0);
+  verdict.hosts = static_cast<int>(NumberOr(obj, "hosts", 0));
+  verdict.healthy_hosts =
+      static_cast<int>(NumberOr(obj, "healthy_hosts", 0));
+  verdict.degraded = BoolOr(obj, "degraded", true);
+  verdict.perf_class = StringOr(obj, "class");
+  if (jsonlite::ValuePtr members = obj.Get("members");
+      members && members->kind == jsonlite::Value::Kind::kArray) {
+    for (const jsonlite::ValuePtr& m : members->array_items) {
+      if (m && m->kind == jsonlite::Value::Kind::kString) {
+        verdict.members.push_back(m->string_value);
+      }
+    }
+  }
+  if (verdict.hosts <= 0) {
+    return Result<SliceVerdict>::Error("verdict: missing hosts");
+  }
+  // The writer sorts, but a parsed doc is untrusted input — the
+  // membership check binary-searches this, and an unsorted list from a
+  // hand-edited/corrupt ConfigMap must not turn that into UB.
+  std::sort(verdict.members.begin(), verdict.members.end());
+  return verdict;
+}
+
+bool VerdictContentEquals(const SliceVerdict& a, const SliceVerdict& b) {
+  return a.hosts == b.hosts && a.healthy_hosts == b.healthy_hosts &&
+         a.degraded == b.degraded && a.perf_class == b.perf_class &&
+         a.members == b.members;
+}
+
+SliceVerdict MergeVerdict(const SliceIdentity& identity,
+                          const std::string& leader,
+                          const std::vector<MemberReport>& reports,
+                          const CoordPolicy& policy, double now_s) {
+  SliceVerdict verdict;
+  verdict.leader = leader;
+  verdict.hosts = identity.num_hosts;
+  int worst_rank = -1;
+  std::vector<std::string> seen;
+  for (const MemberReport& report : reports) {
+    // Present = heard from within the agreement window. A stale report
+    // is a member the slice cannot vouch for: it neither counts healthy
+    // nor appears in members — conservative by construction. Duplicate
+    // hosts (a report whose embedded host disagrees with its data key)
+    // count once, like the Python twin.
+    if (report.reported_at <= 0 ||
+        now_s - report.reported_at > policy.agreement_timeout_s) {
+      continue;
+    }
+    if (std::find(seen.begin(), seen.end(), report.host) != seen.end()) {
+      continue;
+    }
+    seen.push_back(report.host);
+    verdict.members.push_back(report.host);
+    if (report.healthy) verdict.healthy_hosts++;
+    int rank = RankOfClassName(report.perf_class);
+    if (rank > worst_rank) worst_rank = rank;
+  }
+  std::sort(verdict.members.begin(), verdict.members.end());
+  verdict.degraded = verdict.healthy_hosts < verdict.hosts;
+  // tpu.slice.class = the WORST present member class (a slice is as
+  // fast as its slowest host; closes the PR 8 "plug the perf class
+  // into slice coherence" nuance). No class claimed when no member
+  // measured one.
+  if (worst_rank >= 0) verdict.perf_class = perf::ClassName(worst_rank);
+  return verdict;
+}
+
+lm::Labels BuildSliceLabels(const SliceIdentity& identity,
+                            const SliceVerdict& verdict) {
+  lm::Labels labels;
+  labels[lm::kSliceId] = identity.slice_id;
+  labels[lm::kSliceHosts] = std::to_string(verdict.hosts);
+  labels[lm::kSliceHealthyHosts] = std::to_string(verdict.healthy_hosts);
+  labels[lm::kSliceDegraded] = verdict.degraded ? "true" : "false";
+  if (!verdict.perf_class.empty()) {
+    labels[lm::kSliceClass] = verdict.perf_class;
+  }
+  return labels;
+}
+
+// ---- the coordinator -----------------------------------------------------
+
+const char* CoordModeName(CoordMode mode) {
+  switch (mode) {
+    case CoordMode::kSingleHost: return "single-host";
+    case CoordMode::kPending: return "pending";
+    case CoordMode::kFollower: return "follower";
+    case CoordMode::kLeader: return "leader";
+    case CoordMode::kOrphaned: return "orphaned";
+  }
+  return "?";
+}
+
+void Coordinator::Configure(const SliceIdentity& identity,
+                            const std::string& self,
+                            const CoordPolicy& policy) {
+  std::lock_guard<std::mutex> lock(mu_);
+  SliceIdentity effective = identity;
+  // Live derivation can fail on a transient metadata blip at exactly
+  // the moment it matters most — a crashed leader restarting. When the
+  // live attempt produced NO name evidence at all (raw_name empty; a
+  // PRESENT-but-invalid name is a misconfiguration the operator must
+  // see) and the state file restored a complete identity for this
+  // node, resume it: losing coordination until the next SIGHUP would
+  // defeat the lease-resume the state file exists for.
+  if (!effective.valid && effective.raw_name.empty() &&
+      state_.identity.valid) {
+    effective = state_.identity;
+    TFD_LOG_WARNING << "slice identity not derivable from metadata/env; "
+                       "resuming restored identity for slice "
+                    << effective.slice_id << " (worker "
+                    << effective.worker_id << "/" << effective.num_hosts
+                    << ")";
+  }
+  // State (epoch, adopted verdict, join status) belongs to a SLICE, not
+  // a config generation: a SIGHUP reload of the same slice keeps it —
+  // the slice did not change because our config did — while a changed
+  // slice id (or a restored payload from a different slice) starts
+  // clean.
+  bool same_slice =
+      effective.valid && state_.identity.slice_id == effective.slice_id;
+  if (!same_slice) {
+    state_.epoch = 0;
+    state_.have_verdict = false;
+    state_.adopted = SliceVerdict();
+    state_.joined = false;
+    state_.pending_episode.clear();
+    state_.last_leader_seen.clear();
+    state_.last_contact_ok = 0;
+  }
+  state_.identity = effective;
+  state_.self = self;
+  state_.policy = policy;
+  state_.mode = effective.valid
+                    ? (state_.mode == CoordMode::kSingleHost
+                           ? CoordMode::kPending
+                           : state_.mode)
+                    : CoordMode::kSingleHost;
+  SliceStateGauge()->Set(static_cast<int>(state_.mode));
+}
+
+CoordMode Coordinator::mode() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return state_.mode;
+}
+
+SliceIdentity Coordinator::identity() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return state_.identity;
+}
+
+void Coordinator::SetMode(State* s, CoordMode mode, const std::string& why,
+                          double now_s) {
+  (void)now_s;
+  if (s->mode == mode) return;
+  s->mode = mode;
+  SliceStateGauge()->Set(static_cast<int>(mode));
+  if (!why.empty()) {
+    TFD_LOG_INFO << "slice " << s->identity.slice_id << ": now "
+                 << CoordModeName(mode) << " (" << why << ")";
+  }
+}
+
+void Coordinator::ObserveLeader(State* s, const std::string& holder,
+                                uint64_t epoch, double now_s) {
+  (void)now_s;
+  std::string seen = holder + "/" + std::to_string(epoch);
+  if (seen == s->last_leader_seen) return;
+  std::string from = s->last_leader_seen;
+  s->last_leader_seen = seen;
+  obs::Default()
+      .GetCounter("tfd_slice_leader_transitions_total",
+                  "Slice-lease holder/epoch changes observed by this "
+                  "member (acquisitions, failovers, step-downs).")
+      ->Inc();
+  obs::DefaultJournal().Record(
+      "leader-change", "slice",
+      "slice leader now " + holder + " (epoch " + std::to_string(epoch) +
+          ")" + (holder == s->self ? " [self]" : ""),
+      {{"slice", s->identity.slice_id},
+       {"from", from},
+       {"holder", holder},
+       {"epoch", std::to_string(epoch)},
+       {"self", holder == s->self ? "true" : "false"}});
+}
+
+void Coordinator::AdoptVerdict(State* s, const SliceVerdict& verdict,
+                               double now_s) {
+  bool changed = !s->have_verdict ||
+                 !VerdictContentEquals(verdict, s->adopted);
+  bool degraded_moved =
+      changed && (!s->have_verdict || verdict.degraded != s->adopted.degraded ||
+                  verdict.healthy_hosts != s->adopted.healthy_hosts);
+  bool was_degraded = s->have_verdict && s->adopted.degraded;
+  s->adopted = verdict;
+  s->have_verdict = true;
+  if (!changed) return;
+  double latency = now_s - verdict.computed_at;
+  if (latency < 0) latency = 0;
+  obs::Default()
+      .GetHistogram("tfd_slice_agreement_latency_seconds",
+                    "Verdict-to-adoption latency: how long after the "
+                    "leader computed a new slice verdict this member "
+                    "adopted (and published) it.",
+                    obs::DurationBuckets())
+      ->Observe(latency);
+  if (!s->joined) {
+    s->joined = true;
+    obs::DefaultJournal().Record(
+        "slice-join", "slice",
+        "joined slice " + s->identity.slice_id + " as worker " +
+            std::to_string(s->identity.worker_id) + " (" +
+            std::to_string(verdict.healthy_hosts) + "/" +
+            std::to_string(verdict.hosts) + " healthy)",
+        {{"slice", s->identity.slice_id},
+         {"worker", std::to_string(s->identity.worker_id)},
+         {"hosts", std::to_string(verdict.hosts)},
+         {"healthy_hosts", std::to_string(verdict.healthy_hosts)},
+         {"seq", std::to_string(verdict.seq)}});
+  }
+  if (degraded_moved && (verdict.degraded || was_degraded)) {
+    obs::DefaultJournal().Record(
+        "slice-degraded", "slice",
+        std::string("slice ") +
+            (verdict.degraded ? "degraded" : "recovered") + ": " +
+            std::to_string(verdict.healthy_hosts) + "/" +
+            std::to_string(verdict.hosts) + " hosts healthy",
+        {{"slice", s->identity.slice_id},
+         {"degraded", verdict.degraded ? "true" : "false"},
+         {"healthy_hosts", std::to_string(verdict.healthy_hosts)},
+         {"hosts", std::to_string(verdict.hosts)},
+         {"class", verdict.perf_class},
+         {"seq", std::to_string(verdict.seq)}});
+  }
+}
+
+Coordinator::TickResult Coordinator::HandleContactFailure(State* s,
+                                                          bool server_alive,
+                                                          double now_s) {
+  if (server_alive) {
+    // The apiserver ANSWERED (429 pacing, a 5xx blip): that is load or
+    // a rollout, not a partition — the transport's breaker/deferral
+    // already paces the retries. Keep serving the adopted agreement.
+    s->last_contact_ok = now_s;
+    return {s->mode, s->have_verdict
+                         ? BuildSliceLabels(s->identity, s->adopted)
+                         : lm::Labels{}};
+  }
+  if (now_s - s->last_contact_ok <= s->policy.lease_duration_s) {
+    // Grace window (one lease duration): a transient transport blip
+    // must not strip the slice labels.
+    return {s->mode, s->have_verdict
+                         ? BuildSliceLabels(s->identity, s->adopted)
+                         : lm::Labels{}};
+  }
+  // Partitioned past a lease duration: our view of the slice can no
+  // longer be verified, and the rest of the slice has already aged our
+  // report out of the agreement. Self-demote to single-host labels —
+  // publishing a stale slice view would be a lie a scheduler acts on —
+  // and re-join when the blackboard answers again.
+  if (s->mode != CoordMode::kOrphaned) {
+    obs::Default()
+        .GetCounter("tfd_slice_orphaned_total",
+                    "Times this member self-demoted to single-host "
+                    "labels after losing the slice blackboard for a "
+                    "full lease duration.")
+        ->Inc();
+    obs::DefaultJournal().Record(
+        "slice-orphaned", "slice",
+        "slice blackboard unreachable for " +
+            std::to_string(s->policy.lease_duration_s) +
+            "s; self-demoting to single-host labels",
+        {{"slice", s->identity.slice_id},
+         {"down_s",
+          std::to_string(static_cast<long long>(now_s -
+                                                s->last_contact_ok))},
+         {"was_mode", CoordModeName(s->mode)}});
+    SetMode(s, CoordMode::kOrphaned, "blackboard unreachable", now_s);
+    // The adopted verdict is dropped with the labels: on re-contact we
+    // re-adopt from the blackboard (and journal a fresh slice-join).
+    s->have_verdict = false;
+    s->adopted = SliceVerdict();
+    s->joined = false;
+  }
+  return {CoordMode::kOrphaned, lm::Labels{}};
+}
+
+Coordinator::TickResult Coordinator::Tick(DocStore* store,
+                                          const MemberReport& local,
+                                          double now_s) {
+  std::lock_guard<std::mutex> lock(mu_);
+  State* s = &state_;
+  if (!s->identity.valid) return {CoordMode::kSingleHost, lm::Labels{}};
+  if (s->last_contact_ok == 0) s->last_contact_ok = now_s;
+  const std::string name = CoordDocName(s->identity.slice_id);
+  const std::string report_key = std::string(kReportKeyPrefix) + s->self;
+
+  CoordDoc doc;
+  bool alive = false;
+  Status got = store->Get(name, &doc, &alive);
+  if (!got.ok()) return HandleContactFailure(s, alive, now_s);
+  s->last_contact_ok = now_s;
+
+  std::map<std::string, std::string> updates;
+  updates[report_key] = SerializeReport(local);
+
+  if (!doc.found) {
+    // Bootstrap: claim the lease and seed the verdict with the one
+    // report we have. A lost create race means another member is
+    // bootstrapping — follow them next tick.
+    Lease lease{s->self, s->epoch + 1, now_s, s->policy.lease_duration_s};
+    SliceVerdict verdict =
+        MergeVerdict(s->identity, s->self, {local}, s->policy, now_s);
+    verdict.seq = s->adopted.seq + 1;
+    verdict.computed_at = now_s;
+    updates[kLeaseKey] = SerializeLease(lease);
+    updates[kVerdictKey] = SerializeVerdict(verdict);
+    bool conflict = false;
+    bool alive2 = false;
+    Status created =
+        store->Patch(name, updates, "", true, &conflict, &alive2);
+    if (!created.ok()) {
+      if (conflict) {
+        return {s->mode, s->have_verdict
+                             ? BuildSliceLabels(s->identity, s->adopted)
+                             : lm::Labels{}};
+      }
+      return HandleContactFailure(s, alive2, now_s);
+    }
+    s->epoch = lease.epoch;
+    ObserveLeader(s, lease.holder, lease.epoch, now_s);
+    AdoptVerdict(s, verdict, now_s);
+    SetMode(s, CoordMode::kLeader, "bootstrapped the slice blackboard",
+            now_s);
+    return {s->mode, BuildSliceLabels(s->identity, s->adopted)};
+  }
+
+  Lease lease;
+  if (auto it = doc.data.find(kLeaseKey); it != doc.data.end()) {
+    if (Result<Lease> parsed = ParseLease(it->second); parsed.ok()) {
+      lease = *parsed;
+    }
+  }
+  SliceVerdict stored;
+  bool have_stored = false;
+  if (auto it = doc.data.find(kVerdictKey); it != doc.data.end()) {
+    if (Result<SliceVerdict> parsed = ParseVerdict(it->second);
+        parsed.ok()) {
+      stored = *parsed;
+      have_stored = true;
+    }
+  }
+  std::vector<MemberReport> reports;
+  for (const auto& [key, value] : doc.data) {
+    if (key.rfind(kReportKeyPrefix, 0) != 0) continue;
+    Result<MemberReport> parsed = ParseReport(value);
+    if (parsed.ok() && parsed->host != s->self) reports.push_back(*parsed);
+  }
+  reports.push_back(local);
+
+  const bool expired = LeaseExpired(lease, now_s);
+  const bool holder = !expired && lease.holder == s->self;
+
+  if (holder || expired) {
+    // Renew (holder) or run for the expired lease. Both are
+    // preconditioned on the fetched resourceVersion: two acquirers
+    // cannot both win, and a slow OLD leader races the live doc rather
+    // than its stale view — on conflict it re-reads and steps down if
+    // outbid (the epoch fence).
+    Lease next_lease{s->self, holder ? lease.epoch : lease.epoch + 1,
+                     now_s, s->policy.lease_duration_s};
+    SliceVerdict next =
+        MergeVerdict(s->identity, s->self, reports, s->policy, now_s);
+    bool content_changed =
+        !have_stored || !VerdictContentEquals(next, stored);
+    if (content_changed) {
+      next.seq = (have_stored ? stored.seq : s->adopted.seq) + 1;
+      next.computed_at = now_s;
+      updates[kVerdictKey] = SerializeVerdict(next);
+    }
+    updates[kLeaseKey] = SerializeLease(next_lease);
+    bool conflict = false;
+    bool alive2 = false;
+    Status wrote = store->Patch(name, updates, doc.resource_version,
+                                false, &conflict, &alive2);
+    if (wrote.ok()) {
+      s->epoch = next_lease.epoch;
+      ObserveLeader(s, next_lease.holder, next_lease.epoch, now_s);
+      AdoptVerdict(s, content_changed ? next : stored, now_s);
+      SetMode(s, CoordMode::kLeader,
+              holder ? "" : "acquired the expired lease", now_s);
+    } else if (conflict) {
+      // Another member moved the doc between our GET and PATCH — a
+      // rival acquirer, or just a report landing. Our report must
+      // still land (unconditioned merge of a key only we write); the
+      // lease question settles at the next tick against the fresh doc.
+      bool c2 = false;
+      bool a2 = false;
+      store->Patch(name, {{report_key, SerializeReport(local)}}, "",
+                   false, &c2, &a2);
+      ObserveLeader(s, lease.holder, lease.epoch, now_s);
+      if (have_stored) AdoptVerdict(s, stored, now_s);
+      if (!holder) {
+        SetMode(s,
+                s->have_verdict ? CoordMode::kFollower
+                                : CoordMode::kPending,
+                "lost the lease race", now_s);
+      }
+    } else {
+      return HandleContactFailure(s, alive2, now_s);
+    }
+  } else {
+    // Follower: our report is a key only we write, so the merge needs
+    // no precondition and cannot clobber a neighbor's.
+    bool conflict = false;
+    bool alive2 = false;
+    Status wrote =
+        store->Patch(name, updates, "", false, &conflict, &alive2);
+    if (!wrote.ok() && !conflict) {
+      return HandleContactFailure(s, alive2, now_s);
+    }
+    ObserveLeader(s, lease.holder, lease.epoch, now_s);
+    if (have_stored) AdoptVerdict(s, stored, now_s);
+    SetMode(s,
+            s->have_verdict ? CoordMode::kFollower : CoordMode::kPending,
+            "following " + lease.holder, now_s);
+  }
+
+  // Disagreement hold-down: the local view NEVER reaches labels
+  // directly. When it contradicts the adopted verdict — we know we are
+  // sick but the slice still claims full health, or we report healthy
+  // and are not yet counted — journal slice-pending once per
+  // (seq, claim) episode and keep publishing the agreement; the next
+  // verdict resolves it.
+  if (s->have_verdict) {
+    bool counted =
+        std::binary_search(s->adopted.members.begin(),
+                           s->adopted.members.end(), s->self);
+    std::string pending;
+    if (!local.healthy && !s->adopted.degraded) {
+      pending = "local-unhealthy-vs-healthy-verdict";
+    } else if (local.healthy && !counted) {
+      pending = "not-yet-counted";
+    }
+    if (!pending.empty()) {
+      std::string episode =
+          pending + ":" + std::to_string(s->adopted.seq);
+      if (episode != s->pending_episode) {
+        s->pending_episode = episode;
+        obs::DefaultJournal().Record(
+            "slice-pending", "slice",
+            "local view disagrees with the adopted verdict (" + pending +
+                "); holding the agreed labels until the next verdict",
+            {{"slice", s->identity.slice_id},
+             {"reason", pending},
+             {"seq", std::to_string(s->adopted.seq)},
+             {"local_healthy", local.healthy ? "true" : "false"}});
+      }
+    } else {
+      s->pending_episode.clear();
+    }
+  } else {
+    // No verdict adopted yet: publish nothing slice-scoped (pending).
+    std::string episode = "no-verdict";
+    if (episode != s->pending_episode) {
+      s->pending_episode = episode;
+      obs::DefaultJournal().Record(
+          "slice-pending", "slice",
+          "no slice verdict adopted yet; publishing no tpu.slice.* "
+          "labels",
+          {{"slice", s->identity.slice_id}, {"reason", "no-verdict"}});
+    }
+  }
+
+  return {s->mode, s->have_verdict
+                       ? BuildSliceLabels(s->identity, s->adopted)
+                       : lm::Labels{}};
+}
+
+std::string Coordinator::SerializeJson(double now_s) const {
+  std::lock_guard<std::mutex> lock(mu_);
+  const State& s = state_;
+  if (!s.identity.valid) return "";
+  return "{\"schema\":1,\"slice_id\":" +
+         jsonlite::Quote(s.identity.slice_id) +
+         ",\"raw_name\":" + jsonlite::Quote(s.identity.raw_name) +
+         ",\"worker\":" + std::to_string(s.identity.worker_id) +
+         ",\"hosts\":" + std::to_string(s.identity.num_hosts) +
+         ",\"id_source\":" + jsonlite::Quote(s.identity.source) +
+         ",\"self\":" + jsonlite::Quote(s.self) +
+         ",\"epoch\":" + std::to_string(s.epoch) +
+         ",\"joined\":" + (s.joined ? "true" : "false") +
+         ",\"leader_seen\":" + jsonlite::Quote(s.last_leader_seen) +
+         ",\"have_verdict\":" + (s.have_verdict ? "true" : "false") +
+         ",\"verdict\":" + SerializeVerdict(s.adopted) +
+         ",\"saved_at\":" + Fixed3(now_s) + "}";
+}
+
+Status Coordinator::RestoreJson(const std::string& json, double now_s) {
+  if (json.empty()) return Status::Ok();
+  Result<jsonlite::ValuePtr> parsed = jsonlite::Parse(json);
+  if (!parsed.ok()) {
+    return Status::Error("slice state: " + parsed.error());
+  }
+  const jsonlite::Value& obj = **parsed;
+  if (obj.kind != jsonlite::Value::Kind::kObject ||
+      static_cast<int>(NumberOr(obj, "schema", 0)) != 1) {
+    return Status::Error("slice state: unknown schema");
+  }
+  std::string slice_id = StringOr(obj, "slice_id");
+  if (slice_id.empty()) return Status::Error("slice state: no slice_id");
+
+  std::lock_guard<std::mutex> lock(mu_);
+  State* s = &state_;
+  // Stash under the restored identity: Configure() keeps this state
+  // only when the derived identity agrees (a state file from a
+  // different slice — node repurposed, volume reattached — must not
+  // seed leadership or verdicts here), and may RESUME the full
+  // restored identity when live derivation has no name evidence (a
+  // metadata blip during a restart).
+  s->identity.slice_id = slice_id;
+  s->identity.raw_name = StringOr(obj, "raw_name");
+  s->identity.worker_id = static_cast<int>(NumberOr(obj, "worker", -1));
+  s->identity.num_hosts = static_cast<int>(NumberOr(obj, "hosts", 0));
+  s->identity.source = StringOr(obj, "id_source");
+  s->identity.valid = s->identity.num_hosts >= 2 &&
+                      s->identity.worker_id >= 0 &&
+                      s->identity.worker_id < s->identity.num_hosts;
+  s->self = StringOr(obj, "self");
+  s->epoch = static_cast<uint64_t>(NumberOr(obj, "epoch", 0));
+  s->joined = BoolOr(obj, "joined", false);
+  s->last_leader_seen = StringOr(obj, "leader_seen");
+  s->have_verdict = BoolOr(obj, "have_verdict", false);
+  if (s->have_verdict) {
+    if (jsonlite::ValuePtr v = obj.Get("verdict")) {
+      Result<SliceVerdict> verdict = ParseVerdict(jsonlite::Serialize(*v));
+      if (verdict.ok()) {
+        s->adopted = *verdict;
+      } else {
+        s->have_verdict = false;
+      }
+    } else {
+      s->have_verdict = false;
+    }
+  }
+  // Restored = we WERE in the slice; mode settles at the first tick
+  // (the lease in the blackboard, not this file, says who leads now).
+  s->mode = CoordMode::kPending;
+  s->last_contact_ok = now_s;  // grace starts at restore, not at epoch 0
+  s->restored_at = now_s;
+  return Status::Ok();
+}
+
+void Coordinator::Reset() {
+  std::lock_guard<std::mutex> lock(mu_);
+  state_ = State();
+}
+
+Coordinator& Default() {
+  static Coordinator* coordinator = new Coordinator();
+  return *coordinator;
+}
+
+}  // namespace slice
+}  // namespace tfd

@@ -1,0 +1,295 @@
+// Multi-host slice coherence: slice identity, cross-host agreement, and
+// coherent failure relabeling (ROADMAP open item #2).
+//
+// A multi-host slice (v5p-128 = 16 hosts, a GKE multislice 2x v5e-64) is
+// the schedulable unit, but PRs 1-9 label each host from its OWN probes:
+// a per-host flap or a skewed probe publishes DISAGREEING slice-shape
+// labels across one slice, silently breaking slice-aware placement. This
+// module makes the slice agree before anything slice-scoped is published:
+//
+//   identity  — a deterministic slice id derived from GCE/TPU-env
+//               metadata (DeriveSliceIdentity): every member of a slice
+//               computes the SAME id with no communication, and a host
+//               with no slice evidence falls back to single-host mode
+//               (no coordination, no slice labels — never a guess).
+//   blackboard — one ConfigMap per slice ("tfd-slice-<id>") in the
+//               daemon's namespace holds a lease, one report per member,
+//               and the leader-computed verdict. All access goes through
+//               the hardened k8s client (breaker, per-request deadlines,
+//               429 Retry-After deferral, k8s.* fault points inherited).
+//   lease     — a per-slice leader elected by optimistic-concurrency
+//               lease acquisition (resourceVersion-preconditioned patch;
+//               the loser sees 409 and follows). The holder renews each
+//               tick; expiry = failover. Epochs make leadership changes
+//               observable and fence a slow old leader (it re-reads the
+//               doc before renewing and steps down when outbid).
+//   agreement — each member writes its local view (shape freshness,
+//               healthsm quarantine, health exec verdict, perf class)
+//               as report.<host>; the leader merges the reports into a
+//               SliceVerdict (healthy-hosts, degraded, worst perf
+//               class) and every member publishes labels built from the
+//               ADOPTED verdict only — a host's divergent local view is
+//               journaled ("slice-pending") but never interleaved into
+//               its labels.
+//   failure   — a dead/wedged member misses its report cadence and is
+//               dropped from healthy-hosts within the agreement window;
+//               leader death fails over via lease expiry WITHOUT a label
+//               flap (the verdict content survives in the doc; a new
+//               leader recomputing the same facts bumps seq but not
+//               bytes). A member that cannot reach the apiserver for a
+//               lease duration SELF-DEMOTES: it drops its tpu.slice.*
+//               labels (journal "slice-orphaned") rather than serving a
+//               stale slice view it can no longer verify.
+//
+// The Coordinator's lease/epoch/verdict state serializes into the warm-
+// restart state file (sched::PersistedState.slice_json, carried like
+// healthsm_json), so a kill -9'd leader resumes its still-valid lease on
+// restart instead of flapping leadership.
+//
+// Time is caller-supplied unix wall seconds, like healthsm — tests cross
+// lease windows with synthetic clocks, no sleeps.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "tfd/lm/labeler.h"
+#include "tfd/util/status.h"
+
+namespace tfd {
+namespace slice {
+
+// ---- identity ------------------------------------------------------------
+
+struct SliceIdentity {
+  bool valid = false;     // false => single-host mode, no coordination
+  std::string slice_id;   // sanitized, k8s-object-name-safe
+  std::string raw_name;   // the name source before sanitization
+  int worker_id = -1;     // this host's index within the slice
+  int num_hosts = 0;      // expected member count
+  std::string source;     // "env" | "tpu-env" | "gke-env"
+};
+
+// Pure derivation from the tpu-env attribute map, the accelerator-type
+// attribute, and a (process-)environment map — every input injectable so
+// the permutation tests need no metadata server. Precedence:
+//   name:   TFD_SLICE_ID env > tpu-env TPU_NAME/NODE_ID >
+//           TPU_WORKER_HOSTNAMES env (GKE webhook; hashed — the list is
+//           shared by exactly the slice's members)
+//   worker: TFD_SLICE_WORKER_ID env > tpu-env WORKER_ID >
+//           TPU_WORKER_ID env
+//   hosts:  TFD_SLICE_HOSTS env > tpu-env HOST_BOUNDS product >
+//           accelerator-type chips / chips-per-host (CHIPS_PER_HOST_BOUNDS
+//           product, else the family's max_chips_per_host)
+// MEGASCALE_SLICE_ID (tpu-env or env) suffixes the name so each slice of
+// a multislice job coordinates separately. Valid only with a name, a
+// worker id in [0, hosts), and hosts >= 2 — anything less is a
+// single-host node and coordination would be a guess.
+SliceIdentity DeriveSliceIdentity(
+    const std::map<std::string, std::string>& tpu_env,
+    const std::string& accelerator_type,
+    const std::map<std::string, std::string>& env);
+
+// Reads the real process environment into the map DeriveSliceIdentity
+// consumes (the keys it cares about only).
+std::map<std::string, std::string> SliceEnvFromProcess();
+
+// Lowercase [a-z0-9-] with runs collapsed, truncated, and suffixed with
+// 8 hex chars of FNV-1a over the RAW name so sanitization collisions
+// ("tpu/a" vs "tpu:a") cannot merge two slices' blackboards.
+std::string SanitizeSliceId(const std::string& raw);
+
+// The coordination ConfigMap name for a slice: "tfd-slice-<id>".
+std::string CoordDocName(const std::string& slice_id);
+
+// ---- blackboard documents ------------------------------------------------
+
+// ConfigMap data keys: "lease", "verdict", "report.<host>".
+inline constexpr char kLeaseKey[] = "lease";
+inline constexpr char kVerdictKey[] = "verdict";
+inline constexpr char kReportKeyPrefix[] = "report.";
+
+// One member's local view, written every slice tick.
+struct MemberReport {
+  std::string host;       // sched::NodeIdentity()
+  int worker_id = -1;
+  bool healthy = false;   // device snapshot fresh, no quarantine, exec ok
+  std::string shape;      // "accel=...;chips=N;topo=..." ("" = no device facts)
+  std::string perf_class; // debounced tpu.perf.class ("" = none)
+  double reported_at = 0; // reporter's wall clock
+};
+std::string SerializeReport(const MemberReport& report);
+Result<MemberReport> ParseReport(const std::string& json);
+
+struct Lease {
+  std::string holder;
+  uint64_t epoch = 0;
+  double renewed_at = 0;
+  int duration_s = 0;
+};
+std::string SerializeLease(const Lease& lease);
+Result<Lease> ParseLease(const std::string& json);
+bool LeaseExpired(const Lease& lease, double now_s);
+
+// The leader-computed slice verdict. Labels are built from these fields
+// by BuildSliceLabels on EVERY member, so the published bytes cannot
+// depend on who computed it; leader/seq/computed_at are bookkeeping and
+// deliberately never label content (failover with unchanged facts must
+// not move a byte).
+struct SliceVerdict {
+  uint64_t seq = 0;
+  std::string leader;
+  double computed_at = 0;
+  int hosts = 0;          // expected members (identity.num_hosts)
+  int healthy_hosts = 0;  // present + healthy reports
+  bool degraded = true;   // healthy_hosts < hosts
+  std::string perf_class; // WORST present member class ("" = none known)
+  std::vector<std::string> members;  // present member hosts, sorted
+};
+std::string SerializeVerdict(const SliceVerdict& verdict);
+Result<SliceVerdict> ParseVerdict(const std::string& json);
+// Label-relevant content equality (ignores seq/leader/computed_at).
+bool VerdictContentEquals(const SliceVerdict& a, const SliceVerdict& b);
+
+struct CoordPolicy {
+  int lease_duration_s = 30;    // --slice-lease-duration
+  int agreement_timeout_s = 120;  // --slice-agreement-timeout (resolved)
+};
+
+// Pure verdict merge: a report is PRESENT when it is younger than the
+// agreement timeout; healthy-hosts counts present healthy reporters; a
+// missing or stale member degrades the slice (conservative — the slice
+// cannot vouch for a host it has not heard from). The worst present
+// perf class becomes the slice class (tpu.slice.class = min of member
+// classes). seq/computed_at are NOT set here; the caller bumps seq only
+// when content changed vs the adopted verdict.
+SliceVerdict MergeVerdict(const SliceIdentity& identity,
+                          const std::string& leader,
+                          const std::vector<MemberReport>& reports,
+                          const CoordPolicy& policy, double now_s);
+
+// The published google.com/tpu.slice.{id,hosts,healthy-hosts,degraded}
+// (+ .class when known) labels for one verdict. Deterministic from the
+// verdict fields alone.
+lm::Labels BuildSliceLabels(const SliceIdentity& identity,
+                            const SliceVerdict& verdict);
+
+// ---- transport -----------------------------------------------------------
+
+struct CoordDoc {
+  bool found = false;
+  std::string resource_version;
+  std::map<std::string, std::string> data;
+};
+
+// The blackboard transport the Coordinator drives. The daemon's
+// implementation wraps the hardened k8s client (sched/sources.cc); unit
+// tests drive the lease machine against an in-memory store.
+// `server_alive` (when non-null) reports whether ANY HTTP response
+// arrived — a 429-paced apiserver is alive (the orphan decision must
+// not treat server-directed pacing as a partition), a transport error
+// is not.
+class DocStore {
+ public:
+  virtual ~DocStore() = default;
+  virtual Status Get(const std::string& name, CoordDoc* doc,
+                     bool* server_alive) = 0;
+  // JSON-merge-patches `updates` into the ConfigMap data (disjoint keys
+  // merge independently, so concurrent member-report writes never
+  // clobber each other). `precondition_rv` non-empty preconditions on
+  // resourceVersion ("" = unconditioned); a stale precondition sets
+  // *conflict and returns an error. `create_if_missing` is a PURE
+  // CREATE: it must fail with *conflict when the doc already exists
+  // (a rival bootstrapper won the race) — never merge into it.
+  virtual Status Patch(const std::string& name,
+                       const std::map<std::string, std::string>& updates,
+                       const std::string& precondition_rv,
+                       bool create_if_missing, bool* conflict,
+                       bool* server_alive) = 0;
+};
+
+// ---- the coordinator -----------------------------------------------------
+
+// tfd_slice_state gauge encoding.
+enum class CoordMode {
+  kSingleHost = 0,  // no valid slice identity: coordination off
+  kPending = 1,     // in a slice, no verdict adopted yet
+  kFollower = 2,    // serving an adopted verdict, someone else leads
+  kLeader = 3,      // serving an adopted verdict, this host leads
+  kOrphaned = 4,    // lost the blackboard past a lease duration:
+                    // slice labels dropped (single-host self-demotion)
+};
+const char* CoordModeName(CoordMode mode);
+
+class Coordinator {
+ public:
+  // Per config load (sources.cc): identity + policy. State survives a
+  // SIGHUP reload of the same slice (the slice did not change because
+  // our config did); a DIFFERENT slice id resets it.
+  void Configure(const SliceIdentity& identity, const std::string& self,
+                 const CoordPolicy& policy);
+
+  struct TickResult {
+    CoordMode mode = CoordMode::kSingleHost;
+    lm::Labels labels;  // empty = publish no slice labels
+  };
+  // One coordination tick: fetch the blackboard, write our report,
+  // renew/acquire the lease, compute (leader) or adopt (all) the
+  // verdict, and return the labels to publish. NEVER fails on transport
+  // errors — a partitioned member must keep returning Ok so its (empty,
+  // self-demoted) snapshot replaces the stale one in the store; within
+  // the grace window it returns the last adopted labels unchanged.
+  TickResult Tick(DocStore* store, const MemberReport& local, double now_s);
+
+  CoordMode mode() const;
+  SliceIdentity identity() const;
+
+  // Warm-restart round trip (rides sched::PersistedState.slice_json,
+  // like healthsm_json): lease epoch, adopted verdict, and join state —
+  // a kill -9'd leader must resume its still-valid lease without a
+  // leadership (or label) flap. Restore tolerates ""; garbage errors
+  // without touching state; a payload for a DIFFERENT slice id is
+  // dropped at the next Configure.
+  std::string SerializeJson(double now_s) const;
+  Status RestoreJson(const std::string& json, double now_s);
+
+  void Reset();
+
+ private:
+  struct State {
+    SliceIdentity identity;
+    std::string self;
+    CoordPolicy policy;
+    CoordMode mode = CoordMode::kSingleHost;
+    uint64_t epoch = 0;            // highest lease epoch seen/held
+    bool have_verdict = false;
+    SliceVerdict adopted;
+    bool joined = false;           // slice-join journaled
+    double last_contact_ok = 0;    // last successful blackboard fetch
+    double restored_at = 0;        // RestoreJson acceptance time
+    std::string pending_episode;   // slice-pending dedup key
+    std::string last_leader_seen;  // leader-change detection ("holder/epoch")
+  };
+
+  TickResult HandleContactFailure(State* s, bool server_alive,
+                                  double now_s);
+  void AdoptVerdict(State* s, const SliceVerdict& verdict, double now_s);
+  void SetMode(State* s, CoordMode mode, const std::string& why,
+               double now_s);
+  void ObserveLeader(State* s, const std::string& holder, uint64_t epoch,
+                     double now_s);
+
+  mutable std::mutex mu_;
+  State state_;
+};
+
+// The process-wide coordinator (the analogue of healthsm::Default()):
+// configured per load, ticked by the slice probe worker, serialized by
+// the rewrite thread's state saver, seeded by the warm-restart loader.
+Coordinator& Default();
+
+}  // namespace slice
+}  // namespace tfd

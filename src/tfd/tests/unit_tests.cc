@@ -48,6 +48,7 @@
 #include "tfd/sched/broker.h"
 #include "tfd/sched/snapshot.h"
 #include "tfd/sched/state.h"
+#include "tfd/slice/coord.h"
 #include "tfd/slice/shape.h"
 #include "tfd/slice/topology.h"
 #include "tfd/util/file.h"
@@ -3828,6 +3829,675 @@ void TestHealthsmClassRankDebounce() {
            perf::kRankSilver);
 }
 
+// ---- slice coherence (slice/coord.h) -------------------------------------
+
+void TestSliceIdentityDerivation() {
+  using Env = std::map<std::string, std::string>;
+
+  // Env override wins over everything.
+  {
+    slice::SliceIdentity id = slice::DeriveSliceIdentity(
+        {{"TPU_NAME", "metadata-name"}, {"WORKER_ID", "9"}}, "v5p-128",
+        {{"TFD_SLICE_ID", "my-slice"},
+         {"TFD_SLICE_WORKER_ID", "3"},
+         {"TFD_SLICE_HOSTS", "16"}});
+    CHECK_TRUE(id.valid);
+    CHECK_EQ(id.source, std::string("env"));
+    CHECK_EQ(id.worker_id, 3);
+    CHECK_EQ(id.num_hosts, 16);
+    CHECK_EQ(id.raw_name, std::string("my-slice"));
+  }
+  // tpu-env: TPU_NAME + WORKER_ID + HOST_BOUNDS product.
+  {
+    slice::SliceIdentity id = slice::DeriveSliceIdentity(
+        {{"TPU_NAME", "train-pod"},
+         {"WORKER_ID", "2"},
+         {"HOST_BOUNDS", "2,2,1"}},
+        "", Env{});
+    CHECK_TRUE(id.valid);
+    CHECK_EQ(id.source, std::string("tpu-env"));
+    CHECK_EQ(id.num_hosts, 4);
+    CHECK_EQ(id.worker_id, 2);
+  }
+  // Hosts derived from the accelerator type + family chips-per-host
+  // when HOST_BOUNDS is absent: v5p-128 = 64 chips / 4 per host = 16.
+  {
+    slice::SliceIdentity id = slice::DeriveSliceIdentity(
+        {{"TPU_NAME", "big"}, {"WORKER_ID", "0"}}, "v5p-128", Env{});
+    CHECK_TRUE(id.valid);
+    CHECK_EQ(id.num_hosts, 16);
+  }
+  // CHIPS_PER_HOST_BOUNDS overrides the family default: 16 chips at
+  // 2x2x1 per host = 4 hosts.
+  {
+    slice::SliceIdentity id = slice::DeriveSliceIdentity(
+        {{"ACCELERATOR_TYPE", "v5litepod-16"},
+         {"TPU_NAME", "lite"},
+         {"WORKER_ID", "1"},
+         {"CHIPS_PER_HOST_BOUNDS", "2,2,1"}},
+        "", Env{});
+    CHECK_TRUE(id.valid);
+    CHECK_EQ(id.num_hosts, 4);
+  }
+  // GKE: the webhook-injected worker-hostname list is the shared name.
+  {
+    slice::SliceIdentity a = slice::DeriveSliceIdentity(
+        Env{}, "v5litepod-16",
+        {{"TPU_WORKER_HOSTNAMES", "h0,h1,h2,h3"},
+         {"TPU_WORKER_ID", "1"},
+         {"TFD_SLICE_HOSTS", "4"}});
+    slice::SliceIdentity b = slice::DeriveSliceIdentity(
+        Env{}, "v5litepod-16",
+        {{"TPU_WORKER_HOSTNAMES", "h0,h1,h2,h3"},
+         {"TPU_WORKER_ID", "2"},
+         {"TFD_SLICE_HOSTS", "4"}});
+    CHECK_TRUE(a.valid && b.valid);
+    CHECK_EQ(a.slice_id, b.slice_id);  // same slice, every member
+    CHECK_EQ(a.source, std::string("gke-env"));
+    slice::SliceIdentity other = slice::DeriveSliceIdentity(
+        Env{}, "v5litepod-16",
+        {{"TPU_WORKER_HOSTNAMES", "g0,g1,g2,g3"},
+         {"TPU_WORKER_ID", "0"},
+         {"TFD_SLICE_HOSTS", "4"}});
+    CHECK_TRUE(other.slice_id != a.slice_id);  // different slice
+  }
+  // Multislice: MEGASCALE_SLICE_ID separates the job's slices.
+  {
+    slice::SliceIdentity s0 = slice::DeriveSliceIdentity(
+        {{"TPU_NAME", "ms"},
+         {"WORKER_ID", "0"},
+         {"HOST_BOUNDS", "2,1,1"},
+         {"MEGASCALE_SLICE_ID", "0"}},
+        "", Env{});
+    slice::SliceIdentity s1 = slice::DeriveSliceIdentity(
+        {{"TPU_NAME", "ms"},
+         {"WORKER_ID", "0"},
+         {"HOST_BOUNDS", "2,1,1"},
+         {"MEGASCALE_SLICE_ID", "1"}},
+        "", Env{});
+    CHECK_TRUE(s0.valid && s1.valid);
+    CHECK_TRUE(s0.slice_id != s1.slice_id);
+  }
+  // Missing metadata -> single-host fallback, never a guessed slice.
+  CHECK_TRUE(!slice::DeriveSliceIdentity(Env{}, "", Env{}).valid);
+  // Shape alone (no shared NAME) must not invent an identity: two
+  // distinct v5e-64 slices in one cluster would collide.
+  CHECK_TRUE(!slice::DeriveSliceIdentity(
+                  {{"ACCELERATOR_TYPE", "v5litepod-64"},
+                   {"WORKER_ID", "0"},
+                   {"HOST_BOUNDS", "4,2,1"}},
+                  "", Env{})
+                  .valid);
+  // A single-host slice needs no coordination.
+  CHECK_TRUE(!slice::DeriveSliceIdentity(
+                  {{"TPU_NAME", "tiny"}, {"WORKER_ID", "0"}},
+                  "v5litepod-4", Env{})
+                  .valid);
+  // Worker id out of range is evidence of broken metadata, not a slice.
+  CHECK_TRUE(!slice::DeriveSliceIdentity(
+                  {{"TPU_NAME", "t"},
+                   {"WORKER_ID", "7"},
+                   {"HOST_BOUNDS", "2,1,1"}},
+                  "", Env{})
+                  .valid);
+  // Sanitization: case, hostile characters, and collision resistance.
+  {
+    std::string a = slice::SanitizeSliceId("My/Pod:0");
+    for (char c : a) {
+      CHECK_TRUE((c >= 'a' && c <= 'z') || (c >= '0' && c <= '9') ||
+                 c == '-');
+    }
+    CHECK_TRUE(slice::SanitizeSliceId("tpu/a") !=
+               slice::SanitizeSliceId("tpu:a"));
+    CHECK_EQ(slice::SanitizeSliceId("x"), slice::SanitizeSliceId("x"));
+    // Cross-language pins (tpufd/slicecoord.py derives the SAME ids —
+    // the textbook-FNV suffix included; change one side, change both).
+    CHECK_EQ(slice::SanitizeSliceId("My/Pod:0"),
+             std::string("my-pod-0-ca4412d5"));
+    CHECK_EQ(slice::SanitizeSliceId("train-pod"),
+             std::string("train-pod-724677df"));
+  }
+}
+
+void TestSliceDocSerialization() {
+  slice::MemberReport report;
+  report.host = "host-3";
+  report.worker_id = 3;
+  report.healthy = true;
+  report.shape = "chips=4;topo=4x4";
+  report.perf_class = "gold";
+  report.reported_at = 1234.5;
+  Result<slice::MemberReport> parsed =
+      slice::ParseReport(slice::SerializeReport(report));
+  CHECK_TRUE(parsed.ok());
+  CHECK_EQ(parsed->host, report.host);
+  CHECK_EQ(parsed->worker_id, 3);
+  CHECK_TRUE(parsed->healthy);
+  CHECK_EQ(parsed->shape, report.shape);
+  CHECK_EQ(parsed->perf_class, std::string("gold"));
+  CHECK_TRUE(!slice::ParseReport("{}").ok());       // no host
+  CHECK_TRUE(!slice::ParseReport("garbage").ok());
+  CHECK_TRUE(!slice::ParseReport("[1,2]").ok());
+
+  slice::Lease lease{"host-0", 7, 1000.0, 30};
+  Result<slice::Lease> lease2 =
+      slice::ParseLease(slice::SerializeLease(lease));
+  CHECK_TRUE(lease2.ok());
+  CHECK_EQ(lease2->holder, std::string("host-0"));
+  CHECK_EQ(static_cast<int>(lease2->epoch), 7);
+  CHECK_TRUE(!slice::LeaseExpired(*lease2, 1030.0));
+  CHECK_TRUE(slice::LeaseExpired(*lease2, 1030.5));
+  CHECK_TRUE(slice::LeaseExpired(slice::Lease{}, 0));  // empty = expired
+
+  slice::SliceVerdict verdict;
+  verdict.seq = 9;
+  verdict.leader = "host-0";
+  verdict.computed_at = 2000;
+  verdict.hosts = 4;
+  verdict.healthy_hosts = 3;
+  verdict.degraded = true;
+  verdict.perf_class = "silver";
+  verdict.members = {"host-0", "host-1", "host-2"};
+  Result<slice::SliceVerdict> verdict2 =
+      slice::ParseVerdict(slice::SerializeVerdict(verdict));
+  CHECK_TRUE(verdict2.ok());
+  CHECK_TRUE(slice::VerdictContentEquals(verdict, *verdict2));
+  CHECK_EQ(static_cast<int>(verdict2->seq), 9);
+  CHECK_TRUE(!slice::ParseVerdict("{}").ok());  // no hosts
+}
+
+void TestSliceVerdictMerge() {
+  slice::SliceIdentity identity;
+  identity.valid = true;
+  identity.slice_id = "testslice";
+  identity.num_hosts = 4;
+  slice::CoordPolicy policy;
+  policy.lease_duration_s = 10;
+  policy.agreement_timeout_s = 5;
+
+  auto report = [](const std::string& host, bool healthy, double at,
+                   const std::string& cls = "") {
+    slice::MemberReport r;
+    r.host = host;
+    r.healthy = healthy;
+    r.reported_at = at;
+    r.perf_class = cls;
+    return r;
+  };
+
+  // This grid is the cross-language parity pin: tests/test_slice.py
+  // runs the SAME scenarios through tpufd/slicecoord.py and asserts
+  // the same expected values — change one side, change both.
+  // All healthy, all fresh.
+  {
+    slice::SliceVerdict v = slice::MergeVerdict(
+        identity, "a",
+        {report("a", true, 100, "gold"), report("b", true, 99, "gold"),
+         report("c", true, 98, "silver"), report("d", true, 100, "gold")},
+        policy, 100);
+    CHECK_EQ(v.healthy_hosts, 4);
+    CHECK_TRUE(!v.degraded);
+    CHECK_EQ(v.perf_class, std::string("silver"));  // worst wins
+    CHECK_EQ(static_cast<int>(v.members.size()), 4);
+  }
+  // A stale report is a host the slice cannot vouch for.
+  {
+    slice::SliceVerdict v = slice::MergeVerdict(
+        identity, "a",
+        {report("a", true, 100), report("b", true, 94),
+         report("c", true, 100), report("d", true, 100)},
+        policy, 100);
+    CHECK_EQ(v.healthy_hosts, 3);
+    CHECK_TRUE(v.degraded);
+    CHECK_EQ(static_cast<int>(v.members.size()), 3);
+    CHECK_EQ(v.perf_class, std::string(""));  // nobody measured
+  }
+  // A present-but-unhealthy member counts present, not healthy.
+  {
+    slice::SliceVerdict v = slice::MergeVerdict(
+        identity, "a",
+        {report("a", true, 100, "gold"), report("b", false, 100, "degraded"),
+         report("c", true, 100, "gold"), report("d", true, 100, "gold")},
+        policy, 100);
+    CHECK_EQ(v.healthy_hosts, 3);
+    CHECK_TRUE(v.degraded);
+    CHECK_EQ(static_cast<int>(v.members.size()), 4);
+    CHECK_EQ(v.perf_class, std::string("degraded"));
+  }
+  // A lone bootstrap report: 1/4 healthy, degraded.
+  {
+    slice::SliceVerdict v = slice::MergeVerdict(
+        identity, "a", {report("a", true, 100)}, policy, 100);
+    CHECK_EQ(v.healthy_hosts, 1);
+    CHECK_TRUE(v.degraded);
+  }
+  // Labels are pure functions of the verdict fields (never of who
+  // computed it): leader/seq must not move a byte.
+  {
+    slice::SliceVerdict v1 = slice::MergeVerdict(
+        identity, "a", {report("a", true, 100), report("b", true, 100)},
+        policy, 100);
+    slice::SliceVerdict v2 = v1;
+    v2.leader = "b";
+    v2.seq = 99;
+    v2.computed_at = 777;
+    lm::Labels l1 = slice::BuildSliceLabels(identity, v1);
+    lm::Labels l2 = slice::BuildSliceLabels(identity, v2);
+    CHECK_TRUE(l1 == l2);
+    CHECK_EQ(l1[lm::kSliceId], std::string("testslice"));
+    CHECK_EQ(l1[lm::kSliceHosts], std::string("4"));
+    CHECK_EQ(l1[lm::kSliceHealthyHosts], std::string("2"));
+    CHECK_EQ(l1[lm::kSliceDegraded], std::string("true"));
+    CHECK_EQ(l1.count(lm::kSliceClass), 0u);  // no class claimed
+  }
+}
+
+// In-memory DocStore with injectable partition, for the lease-edge
+// suite: real resourceVersion semantics (precondition 409s), merge
+// updates, create race detection.
+class MemoryDocStore : public slice::DocStore {
+ public:
+  bool fail_transport = false;
+  bool alive_on_fail = false;  // true = "server answered 429/5xx"
+
+  Status Get(const std::string& name, slice::CoordDoc* doc,
+             bool* alive) override {
+    if (fail_transport) {
+      *alive = alive_on_fail;
+      return Status::Error("injected transport failure");
+    }
+    *alive = true;
+    auto it = docs.find(name);
+    if (it == docs.end()) {
+      doc->found = false;
+      return Status::Ok();
+    }
+    doc->found = true;
+    doc->resource_version = std::to_string(it->second.rv);
+    doc->data = it->second.data;
+    return Status::Ok();
+  }
+
+  Status Patch(const std::string& name,
+               const std::map<std::string, std::string>& updates,
+               const std::string& precondition_rv, bool create_if_missing,
+               bool* conflict, bool* alive) override {
+    *conflict = false;
+    if (fail_transport) {
+      *alive = alive_on_fail;
+      return Status::Error("injected transport failure");
+    }
+    *alive = true;
+    auto it = docs.find(name);
+    if (create_if_missing) {
+      // Pure create: a doc that appeared since the caller's GET is a
+      // lost bootstrap race, never a merge target.
+      if (it != docs.end()) {
+        *conflict = true;
+        return Status::Error("create conflict");
+      }
+      Doc doc;
+      doc.rv = 1;
+      doc.data = updates;
+      docs[name] = doc;
+      return Status::Ok();
+    }
+    if (it == docs.end()) return Status::Error("missing");
+    if (!precondition_rv.empty() &&
+        precondition_rv != std::to_string(it->second.rv)) {
+      *conflict = true;
+      return Status::Error("conflict");
+    }
+    for (const auto& [key, value] : updates) it->second.data[key] = value;
+    it->second.rv++;
+    return Status::Ok();
+  }
+
+  struct Doc {
+    uint64_t rv = 0;
+    std::map<std::string, std::string> data;
+  };
+  std::map<std::string, Doc> docs;
+};
+
+slice::SliceIdentity TwoHostIdentity() {
+  slice::SliceIdentity identity;
+  identity.valid = true;
+  identity.slice_id = "unit-slice";
+  identity.raw_name = "unit-slice";
+  identity.num_hosts = 2;
+  identity.worker_id = 0;
+  return identity;
+}
+
+slice::MemberReport LocalReportFor(const std::string& host, bool healthy,
+                                   double at) {
+  slice::MemberReport r;
+  r.host = host;
+  r.healthy = healthy;
+  r.reported_at = at;
+  r.shape = "chips=4";
+  return r;
+}
+
+void TestSliceLeaseStateMachine() {
+  MemoryDocStore store;
+  slice::CoordPolicy policy;
+  policy.lease_duration_s = 10;
+  policy.agreement_timeout_s = 5;
+
+  slice::SliceIdentity id_a = TwoHostIdentity();
+  slice::SliceIdentity id_b = TwoHostIdentity();
+  id_b.worker_id = 1;
+  slice::Coordinator a;
+  slice::Coordinator b;
+  a.Configure(id_a, "host-a", policy);
+  b.Configure(id_b, "host-b", policy);
+
+  // Bootstrap: first tick creates the blackboard and takes the lease.
+  slice::Coordinator::TickResult ra =
+      a.Tick(&store, LocalReportFor("host-a", true, 100), 100);
+  CHECK_TRUE(ra.mode == slice::CoordMode::kLeader);
+  CHECK_EQ(ra.labels[lm::kSliceHealthyHosts], std::string("1"));
+  CHECK_EQ(ra.labels[lm::kSliceDegraded], std::string("true"));
+
+  // Second host joins as a follower; its local healthy view is NOT
+  // interleaved — it publishes the adopted (1/2) verdict verbatim.
+  slice::Coordinator::TickResult rb =
+      b.Tick(&store, LocalReportFor("host-b", true, 101), 101);
+  CHECK_TRUE(rb.mode == slice::CoordMode::kFollower);
+  CHECK_TRUE(rb.labels == ra.labels);
+
+  // The leader's next tick counts host-b; the follower adopts the new
+  // verdict: byte-identical on both.
+  ra = a.Tick(&store, LocalReportFor("host-a", true, 102), 102);
+  CHECK_EQ(ra.labels[lm::kSliceHealthyHosts], std::string("2"));
+  CHECK_EQ(ra.labels[lm::kSliceDegraded], std::string("false"));
+  rb = b.Tick(&store, LocalReportFor("host-b", true, 103), 103);
+  CHECK_TRUE(rb.labels == ra.labels);
+
+  // Leader death: host-a stops ticking; once the lease expires host-b
+  // acquires it (epoch bump) and the verdict drops the stale member.
+  rb = b.Tick(&store, LocalReportFor("host-b", true, 113), 113);
+  CHECK_TRUE(rb.mode == slice::CoordMode::kLeader);
+  CHECK_EQ(rb.labels[lm::kSliceHealthyHosts], std::string("1"));
+  CHECK_EQ(rb.labels[lm::kSliceDegraded], std::string("true"));
+  {
+    Result<slice::Lease> lease =
+        slice::ParseLease(store.docs[slice::CoordDocName("unit-slice")]
+                              .data[slice::kLeaseKey]);
+    CHECK_TRUE(lease.ok());
+    CHECK_EQ(lease->holder, std::string("host-b"));
+    CHECK_EQ(static_cast<int>(lease->epoch), 2);
+  }
+
+  // The old leader comes back: it sees the fresh lease, steps down to
+  // follower, and adopts the new verdict — no split brain, no flap.
+  ra = a.Tick(&store, LocalReportFor("host-a", true, 114), 114);
+  CHECK_TRUE(ra.mode == slice::CoordMode::kFollower);
+  // One more leader round counts host-a healthy again; both converge.
+  rb = b.Tick(&store, LocalReportFor("host-b", true, 115), 115);
+  CHECK_EQ(rb.labels[lm::kSliceHealthyHosts], std::string("2"));
+  ra = a.Tick(&store, LocalReportFor("host-a", true, 116), 116);
+  CHECK_TRUE(ra.labels == rb.labels);
+
+  // Acquisition race: expire the lease, then two fresh coordinators
+  // race — the rv precondition lets exactly one win.
+  {
+    MemoryDocStore race_store;
+    slice::Coordinator c1;
+    slice::Coordinator c2;
+    c1.Configure(id_a, "host-a", policy);
+    c2.Configure(id_b, "host-b", policy);
+    c1.Tick(&race_store, LocalReportFor("host-a", true, 200), 200);
+    c2.Tick(&race_store, LocalReportFor("host-b", true, 201), 201);
+    // Both see the lease expired at t=300; c2 ticks first and wins.
+    slice::Coordinator::TickResult r2 =
+        c2.Tick(&race_store, LocalReportFor("host-b", true, 300), 300);
+    CHECK_TRUE(r2.mode == slice::CoordMode::kLeader);
+    slice::Coordinator::TickResult r1 =
+        c1.Tick(&race_store, LocalReportFor("host-a", true, 300.5), 300.5);
+    CHECK_TRUE(r1.mode == slice::CoordMode::kFollower ||
+               r1.mode == slice::CoordMode::kLeader);
+    Result<slice::Lease> lease = slice::ParseLease(
+        race_store.docs[slice::CoordDocName("unit-slice")]
+            .data[slice::kLeaseKey]);
+    CHECK_TRUE(lease.ok());
+  }
+}
+
+void TestSliceOrphanAndRejoin() {
+  MemoryDocStore store;
+  slice::CoordPolicy policy;
+  policy.lease_duration_s = 10;
+  policy.agreement_timeout_s = 5;
+  slice::SliceIdentity id_b = TwoHostIdentity();
+  id_b.worker_id = 1;
+  slice::Coordinator a;
+  slice::Coordinator b;
+  a.Configure(TwoHostIdentity(), "host-a", policy);
+  b.Configure(id_b, "host-b", policy);
+  a.Tick(&store, LocalReportFor("host-a", true, 100), 100);
+  b.Tick(&store, LocalReportFor("host-b", true, 100), 100);
+  slice::Coordinator::TickResult rb =
+      b.Tick(&store, LocalReportFor("host-b", true, 101), 101);
+  CHECK_TRUE(!rb.labels.empty());
+
+  // Partition host-b: within the grace window it keeps serving the
+  // ADOPTED labels unchanged...
+  store.fail_transport = true;
+  rb = b.Tick(&store, LocalReportFor("host-b", true, 105), 105);
+  CHECK_TRUE(rb.mode != slice::CoordMode::kOrphaned);
+  CHECK_TRUE(!rb.labels.empty());
+  // ...but past a lease duration it SELF-DEMOTES: empty labels, never
+  // a stale slice view.
+  rb = b.Tick(&store, LocalReportFor("host-b", true, 120), 120);
+  CHECK_TRUE(rb.mode == slice::CoordMode::kOrphaned);
+  CHECK_TRUE(rb.labels.empty());
+
+  // A 429-paced apiserver is ALIVE: pacing never orphans.
+  {
+    MemoryDocStore paced;
+    slice::Coordinator c;
+    c.Configure(TwoHostIdentity(), "host-a", policy);
+    c.Tick(&paced, LocalReportFor("host-a", true, 100), 100);
+    paced.fail_transport = true;
+    paced.alive_on_fail = true;  // server answered (throttle), no route loss
+    slice::Coordinator::TickResult rc =
+        c.Tick(&paced, LocalReportFor("host-a", true, 200), 200);
+    CHECK_TRUE(rc.mode != slice::CoordMode::kOrphaned);
+    CHECK_TRUE(!rc.labels.empty());
+  }
+
+  // Heal the partition: host-b re-joins and re-adopts the agreement.
+  store.fail_transport = false;
+  rb = b.Tick(&store, LocalReportFor("host-b", true, 130), 130);
+  CHECK_TRUE(rb.mode != slice::CoordMode::kOrphaned);
+  CHECK_TRUE(!rb.labels.empty());
+}
+
+void TestSliceCoordSerializeRestore() {
+  MemoryDocStore store;
+  slice::CoordPolicy policy;
+  policy.lease_duration_s = 10;
+  policy.agreement_timeout_s = 5;
+  slice::Coordinator a;
+  a.Configure(TwoHostIdentity(), "host-a", policy);
+  a.Tick(&store, LocalReportFor("host-a", true, 100), 100);
+  CHECK_TRUE(a.mode() == slice::CoordMode::kLeader);
+  std::string json = a.SerializeJson(101);
+  CHECK_TRUE(!json.empty());
+
+  // kill -9 + restart: the restored coordinator resumes the SAME lease
+  // epoch on its first tick — holder is still host-a and the lease is
+  // still valid, so no epoch bump, no leadership flap.
+  slice::Coordinator a2;
+  CHECK_TRUE(a2.RestoreJson(json, 102).ok());
+  a2.Configure(TwoHostIdentity(), "host-a", policy);
+  slice::Coordinator::TickResult r =
+      a2.Tick(&store, LocalReportFor("host-a", true, 103), 103);
+  CHECK_TRUE(r.mode == slice::CoordMode::kLeader);
+  {
+    Result<slice::Lease> lease =
+        slice::ParseLease(store.docs[slice::CoordDocName("unit-slice")]
+                              .data[slice::kLeaseKey]);
+    CHECK_TRUE(lease.ok());
+    CHECK_EQ(static_cast<int>(lease->epoch), 1);  // resumed, not re-won
+  }
+
+  // Garbage is rejected without touching state.
+  slice::Coordinator c;
+  CHECK_TRUE(!c.RestoreJson("not json", 100).ok());
+  CHECK_TRUE(!c.RestoreJson("{\"schema\":9}", 100).ok());
+  CHECK_TRUE(c.RestoreJson("", 100).ok());  // nothing persisted: fine
+
+  // A restored payload for a DIFFERENT slice is dropped at Configure:
+  // leadership/verdict from a repurposed node must not leak in.
+  slice::Coordinator d;
+  CHECK_TRUE(d.RestoreJson(json, 102).ok());
+  slice::SliceIdentity other = TwoHostIdentity();
+  other.slice_id = "other-slice";
+  d.Configure(other, "host-a", policy);
+  MemoryDocStore fresh;
+  slice::Coordinator::TickResult rd =
+      d.Tick(&fresh, LocalReportFor("host-a", true, 103), 103);
+  Result<slice::Lease> lease =
+      slice::ParseLease(fresh.docs[slice::CoordDocName("other-slice")]
+                            .data[slice::kLeaseKey]);
+  CHECK_TRUE(lease.ok());
+  CHECK_EQ(static_cast<int>(lease->epoch), 1);  // started clean
+  CHECK_TRUE(rd.mode == slice::CoordMode::kLeader);
+
+  // The state-file carry: slice_json rides PersistedState opaquely and
+  // survives the frame round trip.
+  sched::PersistedState state;
+  state.node = "host-a";
+  state.saved_at = 1000;
+  state.labels["google.com/tpu.count"] = "4";
+  state.slice_json = json;
+  Result<sched::PersistedState> parsed =
+      sched::ParseState(sched::SerializeState(state));
+  CHECK_TRUE(parsed.ok());
+  CHECK_EQ(parsed->slice_json.empty(), false);
+  slice::Coordinator e;
+  CHECK_TRUE(e.RestoreJson(parsed->slice_json, 1001).ok());
+}
+
+void TestGovernorSliceKeys() {
+  // The verdict keys are exempt from per-key hold-down (cross-host
+  // coherence contract; anti-flap lives in the verdict protocol +
+  // healthsm on the slice source)...
+  CHECK_TRUE(!lm::GovernedKey(lm::kSliceId));
+  CHECK_TRUE(!lm::GovernedKey(lm::kSliceHealthyHosts));
+  CHECK_TRUE(!lm::GovernedKey(lm::kSliceDegraded));
+  // ...except the class, which is governed like tpu.perf.class.
+  CHECK_TRUE(lm::GovernedKey(lm::kSliceClass));
+  // tpu.slice.hosts stays key-governed (the topology labeler publishes
+  // it too; key-waiving it would tear it from its governed siblings) —
+  // but changes whose provenance names the slice-coord labeler carry
+  // the cross-host contract and bypass the hold-down.
+  CHECK_TRUE(lm::GovernedKey(lm::kSliceHosts));
+  {
+    lm::GovernorPolicy policy;
+    policy.hold_down_s = 300;
+    policy.churn_budget = 6;
+    lm::LabelGovernor governor(policy);
+    lm::Labels previous = {{lm::kSliceHosts, "4"}};
+    lm::LabelProvenance topo_prov;
+    topo_prov.labeler = "tpu";
+    lm::LabelProvenance coord_prov;
+    coord_prov.labeler = lm::kSliceCoordLabeler;
+    lm::Provenance prev_topo = {{lm::kSliceHosts, topo_prov}};
+    lm::Provenance prev_coord = {{lm::kSliceHosts, coord_prov}};
+    governor.NotePublished(previous, 1000);
+    // A TOPOLOGY-owned value change inside the hold-down is
+    // suppressed...
+    lm::Labels candidate = {{lm::kSliceHosts, "2"}};
+    lm::Provenance provenance = {{lm::kSliceHosts, topo_prov}};
+    std::vector<lm::SuppressedFlip> suppressed;
+    governor.Apply(previous, prev_topo, false, 1001, &candidate,
+                   &provenance, &suppressed);
+    CHECK_EQ(suppressed.size(), 1u);
+    CHECK_EQ(candidate[lm::kSliceHosts], std::string("4"));
+    // ...a coordination-owned REMOVAL (orphan self-demotion, judged by
+    // the previously published value's provenance) passes...
+    lm::Labels demoted;
+    lm::Provenance demoted_prov;
+    std::vector<lm::SuppressedFlip> suppressed2;
+    governor.Apply(previous, prev_coord, false, 1002, &demoted,
+                   &demoted_prov, &suppressed2);
+    CHECK_EQ(suppressed2.size(), 0u);
+    CHECK_EQ(demoted.count(lm::kSliceHosts), 0u);
+    // ...and so does a coordination-owned re-addition/change.
+    lm::Labels readded = {{lm::kSliceHosts, "4"}};
+    lm::Provenance readd_prov = {{lm::kSliceHosts, coord_prov}};
+    std::vector<lm::SuppressedFlip> suppressed3;
+    governor.Apply(previous, prev_coord, false, 1003, &readded,
+                   &readd_prov, &suppressed3);
+    lm::Labels changed = {{lm::kSliceHosts, "8"}};
+    lm::Provenance changed_prov = {{lm::kSliceHosts, coord_prov}};
+    std::vector<lm::SuppressedFlip> suppressed4;
+    governor.Apply(previous, prev_coord, false, 1004, &changed,
+                   &changed_prov, &suppressed4);
+    CHECK_EQ(suppressed4.size(), 0u);
+    CHECK_EQ(changed[lm::kSliceHosts], std::string("8"));
+  }
+
+  lm::GovernorPolicy policy;
+  policy.hold_down_s = 300;
+  policy.churn_budget = 6;
+  lm::LabelGovernor governor(policy);
+
+  lm::Labels previous = {{lm::kSliceClass, "gold"},
+                         {lm::kSliceDegraded, "false"},
+                         {lm::kSliceHealthyHosts, "4"}};
+  lm::Provenance prev_prov;
+  governor.NotePublished(previous, 1000);
+  // Burn the class key's hold-down with a recent change.
+  {
+    lm::Labels candidate = previous;
+    candidate[lm::kSliceClass] = "silver";
+    lm::Provenance provenance;
+    std::vector<lm::SuppressedFlip> suppressed;
+    governor.Apply(previous, prev_prov, false, 1001, &candidate,
+                   &provenance, &suppressed);
+    governor.CommitPublished();
+    CHECK_EQ(suppressed.size(), 0u);  // first flip passes (budget)
+    previous = candidate;
+  }
+  // A DEMOTION inside the hold-down window bypasses (conservative
+  // direction, already debounced at the members + leader)...
+  {
+    lm::Labels candidate = previous;
+    candidate[lm::kSliceClass] = "degraded";
+    candidate[lm::kSliceDegraded] = "true";
+    candidate[lm::kSliceHealthyHosts] = "3";
+    lm::Provenance provenance;
+    std::vector<lm::SuppressedFlip> suppressed;
+    governor.Apply(previous, prev_prov, false, 1002, &candidate,
+                   &provenance, &suppressed);
+    governor.CommitPublished();
+    CHECK_EQ(suppressed.size(), 0u);
+    CHECK_EQ(candidate[lm::kSliceClass], std::string("degraded"));
+    // The exempt verdict keys moved freely with it: coherent, all at
+    // once.
+    CHECK_EQ(candidate[lm::kSliceDegraded], std::string("true"));
+    CHECK_EQ(candidate[lm::kSliceHealthyHosts], std::string("3"));
+    previous = candidate;
+  }
+  // ...but a PROMOTION inside the window is governed (held down).
+  {
+    lm::Labels candidate = previous;
+    candidate[lm::kSliceClass] = "gold";
+    lm::Provenance provenance;
+    std::vector<lm::SuppressedFlip> suppressed;
+    governor.Apply(previous, prev_prov, false, 1003, &candidate,
+                   &provenance, &suppressed);
+    CHECK_EQ(suppressed.size(), 1u);
+    CHECK_EQ(candidate[lm::kSliceClass], std::string("degraded"));
+  }
+}
+
 }  // namespace
 }  // namespace tfd
 
@@ -3946,6 +4616,13 @@ int main(int argc, char** argv) {
   tfd::TestPerfStateSectionIndependence();
   tfd::TestGovernorPerfClassDemotion();
   tfd::TestHealthsmClassRankDebounce();
+  tfd::TestSliceIdentityDerivation();
+  tfd::TestSliceDocSerialization();
+  tfd::TestSliceVerdictMerge();
+  tfd::TestSliceLeaseStateMachine();
+  tfd::TestSliceOrphanAndRejoin();
+  tfd::TestSliceCoordSerializeRestore();
+  tfd::TestGovernorSliceKeys();
 
   std::cerr << tfd::g_checks << " checks, " << tfd::g_failures << " failures"
             << std::endl;

@@ -131,6 +131,33 @@ class TestHelmChart:
         assert values["introspection"]["enabled"] is True
         assert 1 <= values["introspection"]["port"] <= 65535
 
+    def test_slice_coordination_knobs_wired(self):
+        """The slice-coherence knobs (ISSUE 10): helm values ->
+        daemonset TFD_SLICE_* envs, configmaps RBAC gated on
+        sliceCoordination, and the 3 static daemonsets carrying the
+        envs at daemon defaults."""
+        values = yaml.safe_load((HELM / "values.yaml").read_text())
+        assert values["sliceCoordination"] is False
+        assert values["sliceLeaseDuration"] == "30s"
+        assert "sliceAgreementTimeout" in values
+        template = (HELM / "templates" / "daemonset.yml").read_text()
+        for env in ("TFD_SLICE_COORDINATION", "TFD_SLICE_LEASE_DURATION",
+                    "TFD_SLICE_AGREEMENT_TIMEOUT"):
+            assert env in template, env
+        # Coordination needs a serviceaccount even in file-sink mode.
+        assert ("or .Values.nfd.enableNodeFeatureApi "
+                ".Values.sliceCoordination" in template)
+        rbac = (HELM / "templates" / "rbac.yaml").read_text()
+        assert ".Values.sliceCoordination" in rbac
+        assert "configmaps" in rbac
+        for path in STATIC_YAMLS:
+            ds = yaml.safe_load(path.read_text())
+            env = {e["name"]: e.get("value") for e in
+                   ds["spec"]["template"]["spec"]["containers"][0]["env"]}
+            assert env["TFD_SLICE_COORDINATION"] == "false", path.name
+            assert env["TFD_SLICE_LEASE_DURATION"] == "30s", path.name
+            assert env["TFD_SLICE_AGREEMENT_TIMEOUT"] == "0", path.name
+
     def test_helm_daemonset_wires_introspection(self):
         """The chart must wire the introspection addr env, a named
         containerPort, and both kubelet probes, all gated on

@@ -1,0 +1,435 @@
+"""Tier 2/3: multi-host slice coherence (ISSUE 10) against real binaries.
+
+The contracts under test:
+  - N daemons sharing one fake apiserver agree: every member publishes
+    BYTE-IDENTICAL google.com/tpu.slice.* labels built from the leader's
+    verdict, never its own local view;
+  - killing a member (follower or leader) flips the survivors'
+    healthy-hosts/degraded coherently; leader death fails over by lease
+    expiry without a label flap (the survivor's slice labels change
+    exactly once);
+  - a member partitioned from the apiserver SELF-DEMOTES: it drops its
+    tpu.slice.* labels (slice-orphaned journaled) instead of serving a
+    stale slice view, and rejoins when the partition heals;
+  - a kill -9'd LEADER restarted with --state-file resumes its
+    still-valid lease (no epoch bump, no leadership flap);
+  - tpu.slice.class is the min (worst) of the members' debounced
+    tpu.perf.class (the PR 8 nuance closed);
+  - the slice identity derives deterministically from tpu-env metadata
+    (fake metadata server end to end);
+  - the pure merge/identity logic is parity-pinned against the
+    tpufd/slicecoord.py twin (the same grid the C++ unit suite pins).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import time
+
+from conftest import FIXTURES, http_get, labels_of, wait_for
+from tpufd import journal as tpufd_journal
+from tpufd import slicecoord
+from tpufd.fakes import free_loopback_port as free_port
+from tpufd.fakes.apiserver import FakeApiServer
+from tpufd.fakes.metadata_server import FakeMetadataServer, tpu_vm
+
+NS = "slice-test"
+
+
+def journal_events(port):
+    status, body = http_get(port, "/debug/journal")
+    if status != 200:
+        return []
+    try:
+        return tpufd_journal.parse_journal(json.loads(body))["events"]
+    except (ValueError, KeyError):
+        return []
+
+
+def events_of(port, event_type):
+    return tpufd_journal.events_of_type(journal_events(port), event_type)
+
+
+def slice_labels(out_file):
+    try:
+        return slicecoord.slice_labels_of(labels_of(out_file.read_text()))
+    except (OSError, ValueError):
+        return {}
+
+
+class Host:
+    """One daemon process in the fake slice."""
+
+    def __init__(self, binary, tmp_path, index, apiserver_url, hosts,
+                 slice_id="proc-slice", extra=(), env_extra=None):
+        self.binary = str(binary)
+        self.index = index
+        self.out_file = tmp_path / f"tfd-{index}"
+        self.state_file = tmp_path / f"state-{index}"
+        self.port = free_port()
+        self.node = f"host-{index}"
+        self.argv = [
+            self.binary, "--sleep-interval=1s", "--backend=mock",
+            f"--mock-topology-file={FIXTURES / 'v2-8.yaml'}",
+            "--machine-type-file=/dev/null",
+            f"--output-file={self.out_file}",
+            f"--state-file={self.state_file}",
+            f"--introspection-addr=127.0.0.1:{self.port}",
+            "--slice-coordination", "--slice-lease-duration=3s",
+            "--slice-agreement-timeout=2s", "--cadence-jitter-pct=0",
+            *extra,
+        ]
+        self.env = {
+            **os.environ,
+            "GCE_METADATA_HOST": "127.0.0.1:1",
+            "NODE_NAME": self.node,
+            "TFD_APISERVER_URL": apiserver_url,
+            "KUBERNETES_NAMESPACE": NS,
+            "TFD_SLICE_ID": slice_id,
+            "TFD_SLICE_WORKER_ID": str(index),
+            "TFD_SLICE_HOSTS": str(hosts),
+            **(env_extra or {}),
+        }
+        self.proc = None
+
+    def start(self):
+        self.proc = subprocess.Popen(self.argv, env=self.env,
+                                     stderr=subprocess.DEVNULL)
+        return self
+
+    def stop(self, sig=signal.SIGTERM):
+        if self.proc is None:
+            return
+        self.proc.send_signal(sig)
+        try:
+            self.proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait(timeout=10)
+        self.proc = None
+
+    def kill9(self):
+        self.proc.kill()
+        self.proc.wait(timeout=10)
+        self.proc = None
+
+    def labels(self):
+        return slice_labels(self.out_file)
+
+
+def lease_of(server, slice_id="proc-slice"):
+    doc = server.store.get((NS, "tfd-slice-" + slicecoord.sanitize_slice_id(
+        slice_id)))
+    if not doc:
+        return None
+    raw = (doc.get("data") or {}).get("lease")
+    return json.loads(raw) if raw else None
+
+
+def agreed(hosts, healthy, total, degraded):
+    """All live hosts byte-identical with the expected counts."""
+    sets = [h.labels() for h in hosts]
+    if any(not s for s in sets):
+        return False
+    if any(s != sets[0] for s in sets[1:]):
+        return False
+    return (sets[0][slicecoord.SLICE_HEALTHY_HOSTS] == str(healthy) and
+            sets[0][slicecoord.SLICE_HOSTS] == str(total) and
+            sets[0][slicecoord.SLICE_DEGRADED] ==
+            ("true" if degraded else "false"))
+
+
+class TestSliceCoherence:
+    def test_member_and_leader_death_relabel_coherently(
+            self, tfd_binary, tmp_path):
+        """Two hosts agree; killing the follower degrades the slice on
+        the survivor; killing the LEADER fails over without a label
+        flap (the survivor's slice labels change exactly once)."""
+        with FakeApiServer() as server:
+            hosts = [Host(tfd_binary, tmp_path, i, server.url, hosts=2)
+                     for i in range(2)]
+            try:
+                for h in hosts:
+                    h.start()
+                assert wait_for(lambda: agreed(hosts, 2, 2, False),
+                                timeout=20), \
+                    [h.labels() for h in hosts]
+
+                lease = lease_of(server)
+                assert lease and lease["holder"] in ("host-0", "host-1")
+                leader = next(h for h in hosts
+                              if h.node == lease["holder"])
+                follower = next(h for h in hosts if h is not leader)
+
+                # Follower death: the survivor (the leader) must flip to
+                # 1/2 degraded within the agreement window + 2 ticks.
+                follower.kill9()
+                assert wait_for(lambda: agreed([leader], 1, 2, True),
+                                timeout=10), leader.labels()
+
+                # Follower rebirth: back to 2/2, byte-identical again.
+                follower.start()
+                assert wait_for(lambda: agreed(hosts, 2, 2, False),
+                                timeout=20)
+
+                # Leader death: the follower must take the lease (epoch
+                # bump) and relabel — and its slice labels must change
+                # EXACTLY once (4->3 healthy would be a flap with any
+                # intermediate state).
+                epoch_before = lease_of(server)["epoch"]
+                observed = [follower.labels()]
+                expected = {
+                    **observed[0],
+                    slicecoord.SLICE_HEALTHY_HOSTS: "1",
+                    slicecoord.SLICE_DEGRADED: "true",
+                }
+                leader.kill9()
+                deadline = time.monotonic() + 12
+                while time.monotonic() < deadline:
+                    # Single read per iteration: sampling twice would
+                    # let a transition land between the flap check and
+                    # the convergence check.
+                    now = follower.labels()
+                    if now and now != observed[-1]:
+                        observed.append(now)
+                    if now == expected:
+                        break
+                    time.sleep(0.05)
+                assert observed[-1] == expected, observed
+                # Exactly one transition: [2/2 healthy, 1/2 degraded].
+                assert len(observed) == 2, observed
+                lease = lease_of(server)
+                assert lease["holder"] == follower.node
+                assert lease["epoch"] > epoch_before
+                assert events_of(follower.port, "leader-change")
+            finally:
+                for h in hosts:
+                    if h.proc is not None:
+                        h.stop()
+
+    def test_partitioned_member_self_demotes_and_rejoins(
+            self, tfd_binary, tmp_path):
+        """A member that loses the apiserver drops its tpu.slice.*
+        labels (never serves a stale slice view) and journals
+        slice-orphaned; the peers degrade the slice; healing the
+        partition rejoins everyone."""
+        with FakeApiServer() as server:
+            listener = server.add_listener()
+            a = Host(tfd_binary, tmp_path, 0, server.url, hosts=2)
+            b = Host(tfd_binary, tmp_path, 1, listener.url, hosts=2)
+            try:
+                a.start()
+                b.start()
+                assert wait_for(lambda: agreed([a, b], 2, 2, False),
+                                timeout=20)
+
+                listener.stop()  # partition host-1 only
+                # host-1 self-demotes: its slice labels VANISH within
+                # the lease duration + a couple of ticks...
+                assert wait_for(lambda: b.labels() == {}, timeout=12), \
+                    b.labels()
+                assert events_of(b.port, "slice-orphaned")
+                # ...while host-0 (still connected) degrades the slice.
+                assert wait_for(lambda: agreed([a], 1, 2, True),
+                                timeout=10)
+
+                listener.start()  # heal
+                assert wait_for(lambda: agreed([a, b], 2, 2, False),
+                                timeout=20)
+                assert events_of(b.port, "slice-join")
+            finally:
+                a.stop()
+                b.stop()
+                listener.stop()
+
+    def test_kill9_leader_resumes_lease_from_state_file(
+            self, tfd_binary, tmp_path):
+        """kill -9 the leader and restart it fast: the restored slice
+        state (sched state file slice section) resumes the still-valid
+        lease with NO epoch bump — leadership (and labels) never flap."""
+        with FakeApiServer() as server:
+            a = Host(tfd_binary, tmp_path, 0, server.url, hosts=2,
+                     extra=("--slice-lease-duration=10s",))
+            b = Host(tfd_binary, tmp_path, 1, server.url, hosts=2,
+                     extra=("--slice-lease-duration=10s",))
+            try:
+                a.start()
+                b.start()
+                assert wait_for(lambda: agreed([a, b], 2, 2, False),
+                                timeout=20)
+                lease = lease_of(server)
+                leader = a if lease["holder"] == a.node else b
+                epoch = lease["epoch"]
+
+                leader.kill9()
+                leader.start()
+                # The restarted leader must have RESTORED its slice
+                # state and renewed (not re-won) the lease.
+                assert wait_for(
+                    lambda: events_of(leader.port, "slice-restored"),
+                    timeout=15)
+                assert wait_for(
+                    lambda: (lease_of(server) or {}).get("holder") ==
+                    leader.node and
+                    lease_of(server)["renewed_at"] > lease["renewed_at"],
+                    timeout=15)
+                assert lease_of(server)["epoch"] == epoch, \
+                    "lease epoch bumped across kill -9 (leadership flap)"
+                assert wait_for(lambda: agreed([a, b], 2, 2, False),
+                                timeout=20)
+            finally:
+                a.stop()
+                b.stop()
+
+    def test_slice_class_is_min_of_member_perf_classes(
+            self, tfd_binary, tmp_path):
+        """The PR 8 nuance: tpu.slice.class = the WORST member
+        tpu.perf.class. host-0 measures gold silicon (v2 rated: 46
+        TFLOPs / 700 GBps), host-1 measures degraded; BOTH must publish
+        slice.class=degraded."""
+        gold = "printf 'matmul-tflops=45\\nhbm-gbps=650\\nici-gbps=9\\n'"
+        sick = "printf 'matmul-tflops=10\\nhbm-gbps=200\\nici-gbps=1\\n'"
+        with FakeApiServer() as server:
+            hosts = [
+                Host(tfd_binary, tmp_path, 0, server.url, hosts=2,
+                     extra=("--perf-characterize",
+                            f"--perf-exec={gold}")),
+                Host(tfd_binary, tmp_path, 1, server.url, hosts=2,
+                     extra=("--perf-characterize",
+                            f"--perf-exec={sick}")),
+            ]
+            try:
+                for h in hosts:
+                    h.start()
+
+                def class_agreed():
+                    sets = [h.labels() for h in hosts]
+                    return (all(s for s in sets) and
+                            sets[0] == sets[1] and
+                            sets[0].get(slicecoord.SLICE_CLASS) ==
+                            "degraded")
+
+                assert wait_for(class_agreed, timeout=25), \
+                    [h.labels() for h in hosts]
+            finally:
+                for h in hosts:
+                    h.stop()
+
+    def test_identity_from_tpu_env_metadata(self, tfd_binary, tmp_path):
+        """End to end through the fake metadata server: the slice id the
+        daemon derives from tpu-env (TPU_NAME + WORKER_ID + HOST_BOUNDS)
+        matches the twin's derivation, and a lone member of a 4-host
+        slice publishes 1/4 degraded."""
+        data = tpu_vm(accelerator_type="v5litepod-16", worker_id=1,
+                      host_bounds="2,2,1",
+                      chips_per_host_bounds="2,2,1", tpu_name="md-slice")
+        with FakeApiServer() as server, \
+                FakeMetadataServer(data) as metadata:
+            host = Host(tfd_binary, tmp_path, 0, server.url, hosts=4,
+                        extra=(
+                            f"--metadata-endpoint=127.0.0.1:"
+                            f"{metadata.port}",))
+            # No env overrides: identity must come from tpu-env.
+            for key in ("TFD_SLICE_ID", "TFD_SLICE_WORKER_ID",
+                        "TFD_SLICE_HOSTS"):
+                host.env.pop(key, None)
+            host.env["GCE_METADATA_HOST"] = f"127.0.0.1:{metadata.port}"
+            twin = slicecoord.derive_slice_identity(
+                {"TPU_NAME": "md-slice", "WORKER_ID": "1",
+                 "HOST_BOUNDS": "2,2,1"})
+            assert twin["valid"] and twin["num_hosts"] == 4
+            try:
+                host.start()
+                # The very first verdict may predate the device
+                # snapshot (0/4 for a tick); wait for the settled view.
+                assert wait_for(
+                    lambda: host.labels().get(slicecoord.SLICE_ID) ==
+                    twin["slice_id"] and
+                    host.labels().get(slicecoord.SLICE_HEALTHY_HOSTS) ==
+                    "1", timeout=20), host.labels()
+                labels = host.labels()
+                assert labels[slicecoord.SLICE_HOSTS] == "4"
+                assert labels[slicecoord.SLICE_DEGRADED] == "true"
+            finally:
+                host.stop()
+
+
+class TestTwinParity:
+    """The same grids the C++ unit suite pins (TestSliceVerdictMerge /
+    TestSliceIdentityDerivation) — change one side, change both."""
+
+    def test_verdict_merge_grid(self):
+        def report(host, healthy, at, cls=""):
+            return {"host": host, "healthy": healthy, "at": at,
+                    "class": cls}
+
+        v = slicecoord.merge_verdict(4, [
+            report("a", True, 100, "gold"), report("b", True, 99, "gold"),
+            report("c", True, 98, "silver"),
+            report("d", True, 100, "gold")], 5, 100)
+        assert (v["healthy_hosts"], v["degraded"], v["class"]) == \
+            (4, False, "silver")
+
+        v = slicecoord.merge_verdict(4, [
+            report("a", True, 100), report("b", True, 94),
+            report("c", True, 100), report("d", True, 100)], 5, 100)
+        assert (v["healthy_hosts"], v["degraded"],
+                len(v["members"]), v["class"]) == (3, True, 3, "")
+
+        v = slicecoord.merge_verdict(4, [
+            report("a", True, 100, "gold"),
+            report("b", False, 100, "degraded"),
+            report("c", True, 100, "gold"),
+            report("d", True, 100, "gold")], 5, 100)
+        assert (v["healthy_hosts"], v["degraded"],
+                len(v["members"]), v["class"]) == (3, True, 4, "degraded")
+
+        v = slicecoord.merge_verdict(4, [report("a", True, 100)], 5, 100)
+        assert (v["healthy_hosts"], v["degraded"]) == (1, True)
+
+        labels = slicecoord.build_slice_labels("testslice", v)
+        assert labels[slicecoord.SLICE_ID] == "testslice"
+        assert labels[slicecoord.SLICE_HEALTHY_HOSTS] == "1"
+        assert slicecoord.SLICE_CLASS not in labels
+
+    def test_identity_grid(self):
+        # The literals pinned on the C++ side (TestSliceIdentityDerivation).
+        assert slicecoord.sanitize_slice_id("My/Pod:0") == \
+            "my-pod-0-ca4412d5"
+        assert slicecoord.sanitize_slice_id("train-pod") == \
+            "train-pod-724677df"
+
+        ident = slicecoord.derive_slice_identity(
+            {"TPU_NAME": "train-pod", "WORKER_ID": "2",
+             "HOST_BOUNDS": "2,2,1"})
+        assert ident == {"valid": True,
+                         "slice_id": "train-pod-724677df",
+                         "raw_name": "train-pod", "worker_id": 2,
+                         "num_hosts": 4, "source": "tpu-env"}
+
+        # v5p-128 = 64 chips / 4 per host = 16 hosts (family fallback).
+        ident = slicecoord.derive_slice_identity(
+            {"TPU_NAME": "big", "WORKER_ID": "0"}, "v5p-128",
+            family_chips_per_host={"v5p": 4})
+        assert ident["valid"] and ident["num_hosts"] == 16
+
+        # No shared name -> single-host, never a guess.
+        assert not slicecoord.derive_slice_identity(
+            {"ACCELERATOR_TYPE": "v5litepod-64", "WORKER_ID": "0",
+             "HOST_BOUNDS": "4,2,1"})["valid"]
+        # Single host needs no coordination.
+        assert not slicecoord.derive_slice_identity(
+            {"TPU_NAME": "tiny", "WORKER_ID": "0"}, "v5litepod-4",
+            family_chips_per_host={"v5litepod": 8})["valid"]
+        # GKE hostname-list identity: shared across members, distinct
+        # across slices.
+        env_a = {"TPU_WORKER_HOSTNAMES": "h0,h1,h2,h3",
+                 "TPU_WORKER_ID": "1", "TFD_SLICE_HOSTS": "4"}
+        env_b = dict(env_a, TPU_WORKER_ID="2")
+        ida = slicecoord.derive_slice_identity({}, env=env_a)
+        idb = slicecoord.derive_slice_identity({}, env=env_b)
+        assert ida["valid"] and ida["slice_id"] == idb["slice_id"]
+        other = slicecoord.derive_slice_identity(
+            {}, env=dict(env_a, TPU_WORKER_HOSTNAMES="g0,g1,g2,g3"))
+        assert other["slice_id"] != ida["slice_id"]

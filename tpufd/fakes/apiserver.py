@@ -23,6 +23,11 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 PREFIX = "/apis/nfd.k8s-sigs.io/v1alpha1/namespaces/"
+# Core-API ConfigMaps: the slice-coherence layer keeps one per slice
+# ("tfd-slice-<id>") as its coordination blackboard (lease + member
+# reports + verdict). Same store, same resourceVersion/merge-patch
+# semantics — names never collide with the NodeFeature CRs.
+CORE_PREFIX = "/api/v1/namespaces/"
 MERGE_PATCH = "application/merge-patch+json"
 
 
@@ -125,13 +130,15 @@ class _Handler(BaseHTTPRequestHandler):
         return False
 
     def _parse(self):
-        if not self.path.startswith(PREFIX):
-            return None, None
-        rest = self.path[len(PREFIX):]
-        parts = rest.split("/")
-        if len(parts) >= 2 and parts[1] == "nodefeatures":
-            name = parts[2] if len(parts) > 2 else None
-            return parts[0], name
+        for prefix, resource in ((PREFIX, "nodefeatures"),
+                                 (CORE_PREFIX, "configmaps")):
+            if not self.path.startswith(prefix):
+                continue
+            rest = self.path[len(prefix):]
+            parts = rest.split("/")
+            if len(parts) >= 2 and parts[1] == resource:
+                name = parts[2] if len(parts) > 2 else None
+                return parts[0], name
         return None, None
 
     def _body(self):
@@ -286,7 +293,51 @@ class FakeApiServer:
         fallback against an apiserver without merge-patch support."""
         self._handler.patch_supported = bool(supported)
 
+    def add_listener(self, port=0):
+        """A second loopback listener sharing THIS server's store and
+        handler state. The multi-host slice soak gives each fake host
+        its own listener so a single host can be network-partitioned
+        (listener stopped → connection refused) while its peers keep
+        talking to the same blackboard."""
+        return _Listener(self._handler, port)
+
     @property
     def url(self):
         scheme = "https" if self.tls else "http"
         return f"{scheme}://127.0.0.1:{self.port}"
+
+
+class _Listener:
+    """One partitionable loopback port onto a FakeApiServer's store.
+    stop() refuses connections (the network-partition injection);
+    start() rebinds the SAME port (allow_reuse_address) to heal it."""
+
+    def __init__(self, handler, port=0):
+        self._handler = handler
+        self._server = None
+        self._thread = None
+        self.port = port
+        self.start()
+
+    def start(self):
+        if self._server is not None:
+            return
+        self._server = ThreadingHTTPServer(("127.0.0.1", self.port),
+                                           self._handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        if self._server is None:
+            return
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5)
+        self._server = None
+        self._thread = None
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.port}"

@@ -23,6 +23,7 @@ def tpu_vm(accelerator_type="v5litepod-4", topology=None, worker_id=0,
            spot=False, zone="us-central2-b", megascale_slice_id=None,
            megascale_num_slices=None, instance_id="1234567890",
            extra_attributes=None, include_worker_id=True, hostname=None,
+           tpu_name=None,
            runtime_version="tpu-ubuntu2204-base",
            agent_bootstrap_image=(
                "gcr.io/cloud-tpu-v2-images/grpc_tpu_worker:cl_20240321")):
@@ -34,6 +35,10 @@ def tpu_vm(accelerator_type="v5litepod-4", topology=None, worker_id=0,
     WORKER_ID entries (values single-quoted, as the real agent writes them).
     """
     tpu_env_lines = [f"ACCELERATOR_TYPE: '{accelerator_type}'"]
+    if tpu_name:
+        # The slice-coherence layer derives its deterministic slice id
+        # from this (every member of a slice shares the TPU name).
+        tpu_env_lines.append(f"TPU_NAME: '{tpu_name}'")
     if runtime_version:
         tpu_env_lines.append(f"RUNTIME_VERSION: '{runtime_version}'")
     if agent_bootstrap_image:

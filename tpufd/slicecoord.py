@@ -1,0 +1,179 @@
+"""Python twin of the slice-coherence pure logic (src/tfd/slice/coord.*).
+
+Mirrors, parity-pinned by tests/test_slice.py against the C++ unit
+grid (change one side, change both):
+  - derive_slice_identity: the deterministic slice-id derivation
+  - sanitize_slice_id:     the k8s-name-safe id (incl. the FNV suffix)
+  - lease_expired:         the lease freshness rule
+  - merge_verdict:         the leader's report merge
+  - build_slice_labels:    the published tpu.slice.* label set
+
+The soak (scripts/slice_soak.py) uses these to independently recompute
+what the daemons SHOULD agree on, and the journal/label helpers to
+assert they did.
+"""
+
+from .sink import fnv1a64
+
+PREFIX = "google.com/"
+SLICE_ID = PREFIX + "tpu.slice.id"
+SLICE_HOSTS = PREFIX + "tpu.slice.hosts"
+SLICE_HEALTHY_HOSTS = PREFIX + "tpu.slice.healthy-hosts"
+SLICE_DEGRADED = PREFIX + "tpu.slice.degraded"
+SLICE_CLASS = PREFIX + "tpu.slice.class"
+SLICE_KEYS = (SLICE_ID, SLICE_HOSTS, SLICE_HEALTHY_HOSTS, SLICE_DEGRADED,
+              SLICE_CLASS)
+
+# perf.h kRankGold..kRankDegraded order: larger = worse.
+CLASS_RANKS = {"gold": 0, "silver": 1, "degraded": 2}
+RANK_NAMES = {v: k for k, v in CLASS_RANKS.items()}
+
+
+def sanitize_slice_id(raw):
+    """C++ SanitizeSliceId: lowercase [a-z0-9-], runs collapsed, 32-char
+    cap, 8-hex FNV-1a suffix over the RAW name."""
+    safe = []
+    last_dash = True
+    for c in raw.lower():
+        if c.isascii() and (c.isdigit() or "a" <= c <= "z"):
+            safe.append(c)
+            last_dash = False
+        elif not last_dash:
+            safe.append("-")
+            last_dash = True
+    out = "".join(safe).rstrip("-")[:32]
+    # 016x matches C++ HexU64's zero-padding (the last-8 slice must
+    # agree even for small hashes).
+    suffix = format(fnv1a64(raw.encode()), "016x")[-8:]
+    return f"{out}-{suffix}" if out else suffix
+
+
+def _bounds_product(text):
+    if not text:
+        return 0
+    product = 1
+    for part in text.split(","):
+        part = part.strip()
+        if not part.isdigit() or int(part) <= 0:
+            return 0
+        product *= int(part)
+    return product
+
+
+def derive_slice_identity(tpu_env, accelerator_type="", env=None,
+                          family_chips_per_host=None):
+    """Returns a dict {valid, slice_id, raw_name, worker_id, num_hosts,
+    source}. `family_chips_per_host` maps accelerator-type prefix to
+    max chips per host for the family-table fallback (the C++ side uses
+    slice/topology.h); pass e.g. {"v5litepod": 8, "v5p": 4}."""
+    env = env or {}
+    tpu_env = tpu_env or {}
+
+    def get(m, key):
+        return (m.get(key) or "").strip()
+
+    worker = (get(env, "TFD_SLICE_WORKER_ID") or get(tpu_env, "WORKER_ID")
+              or get(env, "TPU_WORKER_ID"))
+    worker_id = int(worker) if worker.isdigit() else -1
+
+    hosts = 0
+    hosts_env = get(env, "TFD_SLICE_HOSTS")
+    if hosts_env.isdigit():
+        hosts = int(hosts_env)
+    if hosts <= 0:
+        hosts = _bounds_product(get(tpu_env, "HOST_BOUNDS"))
+    if hosts <= 0:
+        accel = get(tpu_env, "ACCELERATOR_TYPE") or accelerator_type.strip()
+        if accel and "-" in accel:
+            prefix, _, count = accel.rpartition("-")
+            if count.isdigit():
+                n = int(count)
+                # v2/v3/v4/v5p accelerator types count TensorCores
+                # (2 per chip); v5e/v6e count chips (topology.h
+                # type_counts_cores).
+                chips = n // 2 if prefix in ("v2", "v3", "v4",
+                                             "v5p") else n
+                per_host = _bounds_product(
+                    get(tpu_env, "CHIPS_PER_HOST_BOUNDS"))
+                if per_host <= 0 and family_chips_per_host:
+                    per_host = family_chips_per_host.get(prefix, 0)
+                if per_host > 0 and chips > 0:
+                    hosts = -(-chips // per_host)
+
+    name = get(env, "TFD_SLICE_ID")
+    source = "env"
+    if not name:
+        name = get(tpu_env, "TPU_NAME") or get(tpu_env, "NODE_ID")
+        source = "tpu-env"
+    if not name:
+        hostnames = get(env, "TPU_WORKER_HOSTNAMES")
+        if hostnames:
+            name = "gke-" + format(fnv1a64(hostnames.encode()), "016x")
+            source = "gke-env"
+    if not name:
+        return {"valid": False, "slice_id": "", "raw_name": "",
+                "worker_id": worker_id, "num_hosts": hosts, "source": ""}
+    megascale = (get(tpu_env, "MEGASCALE_SLICE_ID")
+                 or get(env, "MEGASCALE_SLICE_ID"))
+    if megascale:
+        name += "-s" + megascale
+    valid = hosts >= 2 and 0 <= worker_id < hosts
+    return {"valid": valid, "slice_id": sanitize_slice_id(name),
+            "raw_name": name, "worker_id": worker_id,
+            "num_hosts": hosts, "source": source}
+
+
+def lease_expired(lease, now):
+    """lease: {holder, epoch, renewed_at, duration_s}."""
+    if not lease or not lease.get("holder") or lease.get(
+            "duration_s", 0) <= 0:
+        return True
+    return now - lease.get("renewed_at", 0) > lease["duration_s"]
+
+
+def merge_verdict(num_hosts, reports, agreement_timeout_s, now):
+    """The leader's merge: reports = [{host, healthy, at, class?}].
+    Present = heard from within the agreement window; a stale/missing
+    member degrades the slice. Returns {hosts, healthy_hosts, degraded,
+    class, members}."""
+    members = set()
+    healthy = 0
+    worst = -1
+    for report in reports:
+        at = report.get("at", 0)
+        if at <= 0 or now - at > agreement_timeout_s:
+            continue
+        if report["host"] in members:
+            continue
+        members.add(report["host"])
+        if report.get("healthy"):
+            healthy += 1
+        rank = CLASS_RANKS.get(report.get("class") or "", -1)
+        worst = max(worst, rank)
+    return {
+        "hosts": num_hosts,
+        "healthy_hosts": healthy,
+        "degraded": healthy < num_hosts,
+        "class": RANK_NAMES.get(worst, ""),
+        "members": sorted(members),
+    }
+
+
+def build_slice_labels(slice_id, verdict):
+    """The published tpu.slice.* set for one verdict — deterministic
+    from the verdict fields alone (leader/seq never move a byte)."""
+    labels = {
+        SLICE_ID: slice_id,
+        SLICE_HOSTS: str(verdict["hosts"]),
+        SLICE_HEALTHY_HOSTS: str(verdict["healthy_hosts"]),
+        SLICE_DEGRADED: "true" if verdict["degraded"] else "false",
+    }
+    if verdict.get("class"):
+        labels[SLICE_CLASS] = verdict["class"]
+    return labels
+
+
+def slice_labels_of(labels):
+    """The tpu.slice.* subset of a parsed label dict (the soak's
+    byte-compare unit)."""
+    return {k: v for k, v in labels.items() if k in SLICE_KEYS}

@@ -51,6 +51,7 @@
 #include "tfd/obs/server.h"
 #include "tfd/perf/perf.h"
 #include "tfd/platform/detect.h"
+#include "tfd/plugin/plugin.h"
 #include "tfd/resource/factory.h"
 #include "tfd/sched/broker.h"
 #include "tfd/sched/snapshot.h"
@@ -753,6 +754,32 @@ Status RenderLabels(
       }
     }
     render_key = key.Digest();
+  }
+
+  // Probe-plugin labels merge FIRST — the LOWEST precedence — so no
+  // plugin can overwrite a first-party label no matter what prefix it
+  // declared: every labeler and first-party source below lands on top.
+  // (Namespace enforcement in plugin/plugin.cc already drops keys
+  // outside a plugin's declared prefix; this ordering is the backstop
+  // for a prefix that was legitimately declared but collides with a
+  // first-party key.) Plugins are arbitrary node probes — NIC checks,
+  // burn-ins — so, like the slice labels, they merge on every rung.
+  for (const std::string& source_name : store.Sources()) {
+    if (source_name.rfind(plugin::kSourcePrefix, 0) != 0) continue;
+    sched::SourceView plugin_view = store.View(source_name);
+    if (!plugin_view.last_ok.has_value() ||
+        plugin_view.tier == sched::Tier::kExpired) {
+      continue;
+    }
+    lm::LabelProvenance from;
+    from.labeler = plugin::kPluginLabeler;
+    from.source = source_name;
+    from.tier = sched::TierName(plugin_view.tier);
+    from.age_s = plugin_view.age_s < 0 ? 0 : plugin_view.age_s;
+    for (const auto& [k, v] : plugin_view.last_ok->labels) {
+      (*merged)[k] = v;
+      (*provenance)[k] = from;
+    }
   }
 
   // Merge order mirrors lm.NewLabelers (labeler.go:33-45): device labels

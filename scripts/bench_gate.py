@@ -38,6 +38,15 @@ disagreement window inside 2 probe intervals, the partition/failover/
 kill -9 invariants held, and the agreement-latency p50 within slack of
 the committed BENCH_r10.json.
 
+Plugin mode (ISSUE 11): `--plugin RECORD.json` gates a probe-plugin
+containment soak record (scripts/plugin_soak.py --json) — every
+misbehavior class (hang, crash-loop, garbage, label-spam, namespace
+escape, stdout flood) present, quarantined, journaled, and recovered,
+every other source's labels byte-stable at every sampled pass, the
+ported device-health plugin golden byte-equal to the compiled-in path,
+and the steady no-op p50 with two plugins registered under the
+absolute budget and within slack of the committed BENCH_r11.json.
+
 Usage:
   python3 scripts/bench_gate.py [--reference BENCH_r07.json]
       [--noop-budget-us 1000] [--dirty-slack 0.25]
@@ -47,6 +56,8 @@ Usage:
       [--perf-reference BENCH_r09.json] [--perf-restore-budget-ms 15]
   python3 scripts/bench_gate.py --slice slice-soak.json
       [--slice-reference BENCH_r10.json] [--slice-slack 0.5]
+  python3 scripts/bench_gate.py --plugin plugin-soak.json
+      [--plugin-reference BENCH_r11.json] [--plugin-slack 1.0]
 """
 
 import argparse
@@ -190,6 +201,7 @@ def slice_gate(record_path, reference_path, slack):
             "disagreeing tpu.slice.* labels (coherence regressed)")
     steps = record.get("steps") or []
     expected_steps = {"join", "kill-follower", "member-rejoin",
+                      "dwell-depart", "crash-loop-dwell",
                       "kill-leader", "leader-rejoin", "wedge-pjrt",
                       "unwedge", "partition", "heal",
                       "kill9-leader-resume"}
@@ -231,6 +243,58 @@ def slice_gate(record_path, reference_path, slack):
     return problems
 
 
+def plugin_gate(record_path, reference_path, noop_budget_us, slack):
+    """Gates a plugin-soak record (scripts/plugin_soak.py --json): the
+    containment invariants are ABSOLUTE (a misbehaving plugin that
+    perturbs a neighbor or escapes quarantine is a correctness bug, not
+    a regression), the steady no-op p50 with two plugins registered is
+    gated by the absolute budget plus regression vs the committed
+    reference. Absent keys FAIL loudly."""
+    with open(record_path) as f:
+        record = json.load(f)
+    problems = []
+
+    modes = record.get("modes") or []
+    missing = {"hang", "crash-loop", "garbage", "label-spam", "escape",
+               "flood"} - {m.get("mode") for m in modes}
+    if missing:
+        problems.append(
+            f"plugin record is missing misbehavior classes: "
+            f"{sorted(missing)}")
+    for invariant in ("ported_health_golden_equal", "all_quarantined",
+                      "all_journaled", "all_recovered",
+                      "others_byte_stable"):
+        if not record.get(invariant):
+            problems.append(f"plugin record invariant {invariant} not set "
+                            "(containment regressed or soak incomplete)")
+    if (record.get("containment_samples") or 0) < len(modes):
+        problems.append("plugin record sampled almost nothing — the "
+                        "byte-stability claim is vacuous")
+
+    noop = record.get("steady_noop_p50_us")
+    if noop is None:
+        problems.append("steady_noop_p50_us missing")
+    elif noop > noop_budget_us:
+        problems.append(
+            f"no-op pass p50 {noop}us with plugins registered exceeds "
+            f"the {noop_budget_us}us budget — plugins are taxing the "
+            "fast path")
+    try:
+        with open(reference_path) as f:
+            ref = json.load(f).get("steady_noop_p50_us")
+    except (OSError, ValueError) as e:
+        problems.append(f"plugin reference {reference_path} unreadable: "
+                        f"{e}")
+        ref = None
+    if ref is not None and noop is not None:
+        ceiling = ref * (1.0 + slack)
+        if noop > max(ceiling, noop_budget_us):
+            problems.append(
+                f"steady no-op p50 {noop}us regressed past {ceiling:.0f}us "
+                f"(reference {ref}us +{int(slack * 100)}%)")
+    return problems
+
+
 def reference_dirty_p50_ms(path):
     """steady_dirty_p50_ms from a committed bench record (either the
     bare record or the driver's {parsed: ...} wrapper)."""
@@ -267,6 +331,14 @@ def main(argv=None):
                     default=os.path.join(repo, "BENCH_r10.json"))
     # Latencies ride protocol constants + a shared CI box's scheduling.
     ap.add_argument("--slice-slack", type=float, default=0.5)
+    ap.add_argument("--plugin", metavar="RECORD.json",
+                    help="gate this probe-plugin containment soak record "
+                         "(scripts/plugin_soak.py --json)")
+    ap.add_argument("--plugin-reference",
+                    default=os.path.join(repo, "BENCH_r11.json"))
+    # The gated number is a sub-millisecond p50 on a shared CI box; the
+    # absolute budget is the load-bearing gate.
+    ap.add_argument("--plugin-slack", type=float, default=1.0)
     ap.add_argument("--perf-restore-budget-ms", type=float, default=15.0)
     # Wider than the dirty-pass slack: the gated number is a
     # sub-millisecond p50 on a shared CI box, and the 1000us absolute
@@ -312,6 +384,16 @@ def main(argv=None):
                 print(f"slice bench gate FAILED: {p}", file=sys.stderr)
             return 1
         print("slice bench gate OK")
+        return 0
+
+    if args.plugin:
+        problems = plugin_gate(args.plugin, args.plugin_reference,
+                               args.noop_budget_us, args.plugin_slack)
+        if problems:
+            for p in problems:
+                print(f"plugin bench gate FAILED: {p}", file=sys.stderr)
+            return 1
+        print("plugin bench gate OK")
         return 0
 
     import bench

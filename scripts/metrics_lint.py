@@ -137,6 +137,17 @@ CONDITIONAL = {
     "tfd_slice_leader_transitions_total",
     "tfd_slice_agreement_latency_seconds",
     "tfd_slice_orphaned_total",
+    # Rejoin hysteresis (ISSUE 11 satellite): fires only when a
+    # departed member rejoins a coordinated slice.
+    "tfd_slice_rejoin_dwells_total",
+    # Probe-plugin SDK (ISSUE 11): config-gated behind --plugin-dir
+    # (empty on this hermetic boot); failures/violations/kills
+    # additionally need a misbehaving plugin.
+    "tfd_plugin_state",
+    "tfd_plugin_rounds_total",
+    "tfd_plugin_failures_total",
+    "tfd_plugin_violations_total",
+    "tfd_plugin_kills_total",
 }
 
 

@@ -1,0 +1,425 @@
+#!/usr/bin/env python3
+"""Misbehaving-plugin containment drill (ISSUE 11 acceptance).
+
+Boots ONE real daemon (mock v2-8 backend, 1 s cadence) per phase and
+walks a probe plugin through every misbehavior class the SDK promises
+to contain:
+
+  hang        sleeps past its deadline    -> process-group killed
+  crash-loop  exits non-zero every round  -> backoff + flap evidence
+  garbage     emits non-JSON              -> round rejected whole
+  label-spam  emits > --plugin-label-budget labels -> rejected whole
+  escape      writes keys outside its declared prefix -> keys dropped
+  flood       writes ~10 MB to stdout     -> killed at the 1 MiB cap
+
+Invariants asserted per misbehavior phase:
+  - every OTHER source's labels are BYTE-IDENTICAL to a no-plugin
+    baseline at every sampled pass (containment: the offender never
+    perturbs a neighbor's labels);
+  - the offender ends QUARANTINED (tfd_plugin_state == 2) with the
+    evidence journaled (plugin-kill for hang/flood, plugin-violation
+    for garbage/spam/escape, probe-fail for the crash loop);
+  - after the plugin is FIXED, recovery is EARNED (cooldown + clean
+    rounds): its labels publish and the state returns to active.
+
+Plus the two contract proofs:
+  - the ported device-health plugin (deployments/plugins/device-health)
+    publishes byte-identical tpu.health.* labels to the compiled-in
+    --device-health=full path given the same underlying exec;
+  - the steady no-op pass p50 stays under 1 ms with two well-behaved
+    plugins registered (measured from the daemon's own journal).
+
+`--json FILE` writes the record bench_gate.py --plugin gates against
+the committed BENCH_r11.json.
+
+Usage:
+  python3 scripts/plugin_soak.py [--seed 11] [--json out.json]
+"""
+
+import argparse
+import json
+import os
+import signal
+import statistics
+import subprocess
+import sys
+import textwrap
+import time
+import urllib.request
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from tpufd import journal as tpufd_journal  # noqa: E402
+from tpufd import metrics  # noqa: E402
+from tpufd.fakes import free_loopback_port  # noqa: E402
+
+BINARY = Path(os.environ.get("TFD_BUILD_DIR", REPO / "build")) / \
+    "tpu-feature-discovery"
+FIXTURE = REPO / "tests" / "fixtures" / "v2-8.yaml"
+IN_TREE = REPO / "deployments" / "plugins"
+
+# Keys that legitimately move across runs/passes. The quarantine
+# annotation belongs to the OFFENDER's containment, not to a neighbor
+# source, so the byte-stability check excludes it and asserts it
+# separately.
+VOLATILE = ("google.com/tfd.timestamp", "google.com/tpu.health.probe-ms",
+            "google.com/tpu.health.quarantined")
+
+MODES = ("hang", "crash-loop", "garbage", "label-spam", "escape", "flood")
+
+
+def log(msg):
+    print(f"[plugin-soak] {msg}", flush=True)
+
+
+def http_get(port, path, timeout=2):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except OSError:
+        return None, ""
+
+
+def wait_for(predicate, timeout, interval=0.2, what=""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what or predicate}")
+
+
+class Daemon:
+    def __init__(self, out_dir, tag, extra_argv=(), env_extra=None):
+        self.port = free_loopback_port()
+        self.out_file = Path(out_dir) / f"labels-{tag}"
+        argv = [str(BINARY), "--sleep-interval=1s", "--backend=mock",
+                f"--mock-topology-file={FIXTURE}",
+                "--machine-type-file=/dev/null", "--no-timestamp",
+                "--journal-capacity=2048",
+                f"--output-file={self.out_file}",
+                f"--introspection-addr=127.0.0.1:{self.port}",
+                *extra_argv]
+        env = {**os.environ, "GCE_METADATA_HOST": "127.0.0.1:1",
+               **(env_extra or {})}
+        self.proc = subprocess.Popen(argv, env=env,
+                                     stderr=subprocess.DEVNULL)
+
+    def labels(self):
+        try:
+            return dict(line.split("=", 1)
+                        for line in self.out_file.read_text().splitlines()
+                        if line)
+        except (OSError, ValueError):
+            return {}
+
+    def journal(self):
+        status, body = http_get(self.port, "/debug/journal?n=2048")
+        if status != 200:
+            return []
+        try:
+            return tpufd_journal.parse_journal(json.loads(body))["events"]
+        except (ValueError, KeyError):
+            return []
+
+    def scrape(self, name, labels=None):
+        status, text = http_get(self.port, "/metrics")
+        if status != 200:
+            return None
+        try:
+            return metrics.sample_value(text, name, labels=labels)
+        except ValueError:
+            return None
+
+    def stop(self):
+        self.proc.send_signal(signal.SIGKILL)
+        self.proc.wait()
+
+
+def stable_view(labels):
+    """A label set with volatile + plugin-owned keys removed — the
+    byte-stability comparison unit."""
+    return {k: v for k, v in labels.items()
+            if k not in VOLATILE
+            and not k.startswith("google.com/tpu.plugin.")
+            and not k.startswith("google.com/tpu.health.")}
+
+
+def write_chaos_plugin(plugin_dir, mode_file, budget=32):
+    """One /bin/sh plugin whose behavior is switched at runtime through
+    `mode_file` — discovery happens once, misbehavior and the fix need
+    no SIGHUP."""
+    spam_keys = ",".join(
+        f'\\"google.com/tpu.plugin.chaos.k{i}\\": \\"{i}\\"'
+        for i in range(budget + 8))
+    path = plugin_dir / "chaos-probe"
+    path.write_text(textwrap.dedent(f"""\
+        #!/bin/sh
+        if [ "$TFD_PLUGIN_OP" = handshake ]; then
+          echo '{{"contract": "tfd.probe/v1", "name": "chaos",
+                 "label_prefix": "google.com/tpu.plugin.chaos."}}'
+          exit 0
+        fi
+        case "$(cat {mode_file})" in
+          hang)       sleep 60 ;;
+          crash-loop) exit 3 ;;
+          garbage)    echo 'XX{{{{ not json' ;;
+          label-spam) echo "{{\\"labels\\": {{{spam_keys}}}}}" ;;
+          escape)     echo '{{"labels": {{
+                        "google.com/tpu.plugin.chaos.ok": "true",
+                        "google.com/tpu.product": "spoofed",
+                        "google.com/tpu.perf.class": "gold"}}}}' ;;
+          flood)      head -c 10485760 /dev/zero | tr '\\0' 'x' ;;
+          *)          echo '{{"labels": {{
+                        "google.com/tpu.plugin.chaos.ok": "true"}}}}' ;;
+        esac
+        """))
+    path.chmod(0o755)
+    # Deadline stanza: the hang must die in seconds, not the 30s
+    # default — this is the operator-trusted knob the SDK documents.
+    (plugin_dir / "chaos-probe.conf").write_text("deadline = 2s\n")
+    return path
+
+
+def baseline_phase(work):
+    log("phase baseline: no plugins")
+    daemon = Daemon(work, "baseline")
+    try:
+        wait_for(lambda: "google.com/tpu.count" in daemon.labels(), 30,
+                 what="baseline labels")
+        time.sleep(2)
+        return stable_view(daemon.labels())
+    finally:
+        daemon.stop()
+
+
+def golden_phase(work, record):
+    """Device-health port golden: byte-identical exec labels vs the
+    compiled-in path, same underlying exec."""
+    log("phase golden: device-health port vs compiled-in")
+    fake_exec = Path(work) / "fake-health"
+    fake_exec.write_text(textwrap.dedent("""\
+        #!/bin/sh
+        echo "google.com/tpu.health.ok=true"
+        echo "google.com/tpu.health.devices=$TFD_CHIP_COUNT"
+        echo "google.com/tpu.health.device-0-ok=true"
+        echo "google.com/tpu.health.matmul-tflops=42.5"
+        """))
+    fake_exec.chmod(0o755)
+
+    def health_view(daemon):
+        return {k: v for k, v in daemon.labels().items()
+                if k.startswith("google.com/tpu.health.")
+                and k != "google.com/tpu.health.probe-ms"}
+
+    compiled = Daemon(work, "golden-compiled",
+                      ["--device-health=full",
+                       f"--health-exec={fake_exec}"])
+    try:
+        wait_for(lambda: "google.com/tpu.health.matmul-tflops"
+                 in compiled.labels(), 30, what="compiled-in health")
+        compiled_view = health_view(compiled)
+    finally:
+        compiled.stop()
+
+    plugin_dir = Path(work) / "plugins-golden"
+    plugin_dir.mkdir()
+    port_file = plugin_dir / "device-health"
+    port_file.write_text((IN_TREE / "device-health").read_text())
+    port_file.chmod(0o755)
+    ported = Daemon(work, "golden-ported",
+                    [f"--plugin-dir={plugin_dir}"],
+                    {"TFD_PLUGIN_HEALTH_EXEC": str(fake_exec)})
+    try:
+        wait_for(lambda: "google.com/tpu.health.matmul-tflops"
+                 in ported.labels(), 30, what="ported health")
+        ported_view = health_view(ported)
+    finally:
+        ported.stop()
+
+    record["ported_health_golden_equal"] = ported_view == compiled_view
+    assert ported_view == compiled_view, (
+        f"device-health port diverged: {ported_view} != {compiled_view}")
+    log(f"  golden OK ({len(ported_view)} exec labels byte-equal)")
+
+
+def steady_phase(work, record):
+    """Steady no-op p50 with TWO well-behaved plugins registered."""
+    log("phase steady: no-op p50 with two plugins")
+    plugin_dir = Path(work) / "plugins-steady"
+    plugin_dir.mkdir()
+    for name in ("device-health", "libtpu-caps"):
+        f = plugin_dir / name
+        f.write_text((IN_TREE / name).read_text())
+        f.chmod(0o755)
+    fake_exec = Path(work) / "fake-health"  # reuse the golden fake
+    daemon = Daemon(work, "steady", [f"--plugin-dir={plugin_dir}"],
+                    {"TFD_PLUGIN_HEALTH_EXEC": str(fake_exec),
+                     # Hint libtpu-caps down from its default 300s so
+                     # the steady window actually exercises per-tick
+                     # plugin rounds (the hint floor is the 1s sleep
+                     # interval).
+                     "TFD_PLUGIN_LIBTPU_INTERVAL": "1"})
+    try:
+        wait_for(lambda: "google.com/tpu.plugin.libtpu.jax"
+                 in daemon.labels()
+                 and "google.com/tpu.health.ok" in daemon.labels(),
+                 45, what="both plugins' labels")
+        time.sleep(3)  # let the first post-settle passes go clean
+
+        def noop_samples():
+            return [float(e["fields"]["duration_us"])
+                    for e in daemon.journal()
+                    if e["type"] == "pass-shortcircuit"]
+        before = len(noop_samples())
+        wait_for(lambda: len(noop_samples()) >= before + 12, 40,
+                 what="12 steady no-op passes")
+        samples = noop_samples()[before:]
+        record["steady_noop_p50_us"] = round(
+            statistics.median(samples), 1)
+        record["steady_noop_passes"] = len(samples)
+        rounds = daemon.scrape("tfd_plugin_rounds_total",
+                               {"plugin": "libtpu-caps"}) or 0
+        record["steady_plugin_rounds"] = int(rounds)
+        assert rounds >= 2, "plugins were not actually probing"
+        log(f"  steady no-op p50 {record['steady_noop_p50_us']}us over "
+            f"{len(samples)} passes, {int(rounds)} libtpu-caps rounds")
+    finally:
+        daemon.stop()
+
+
+def misbehavior_phase(work, mode, baseline, record):
+    log(f"phase misbehave: {mode}")
+    plugin_dir = Path(work) / f"plugins-{mode}"
+    plugin_dir.mkdir()
+    mode_file = Path(work) / f"mode-{mode}"
+    mode_file.write_text(mode)
+    write_chaos_plugin(plugin_dir, mode_file)
+
+    result = {"mode": mode, "samples": 0, "stable_samples": 0,
+              "quarantined": False, "journaled": False,
+              "recovered": False}
+    daemon = Daemon(work, f"chaos-{mode}",
+                    [f"--plugin-dir={plugin_dir}",
+                     "--health-flap-window=60s",
+                     "--health-flap-threshold=2",
+                     "--quarantine-cooldown=2s"])
+    try:
+        wait_for(lambda: "google.com/tpu.count" in daemon.labels(), 30,
+                 what=f"{mode}: first labels")
+
+        def sample_stable():
+            view = stable_view(daemon.labels())
+            result["samples"] += 1
+            if view == baseline:
+                result["stable_samples"] += 1
+            else:
+                raise AssertionError(
+                    f"{mode}: other sources' labels moved: "
+                    f"{set(view.items()) ^ set(baseline.items())}")
+
+        # Quarantine must land while every sampled pass keeps the other
+        # sources byte-identical to the no-plugin baseline.
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            sample_stable()
+            state = daemon.scrape("tfd_plugin_state", {"plugin": "chaos"})
+            if state == 2.0:
+                result["quarantined"] = True
+                break
+            time.sleep(1.0)
+        assert result["quarantined"], f"{mode}: never quarantined"
+
+        events = daemon.journal()
+        if mode in ("hang", "flood"):
+            kills = [e for e in events if e["type"] == "plugin-kill"]
+            reason = "deadline" if mode == "hang" else "output-flood"
+            result["journaled"] = any(
+                e["fields"].get("reason") == reason for e in kills)
+        elif mode == "crash-loop":
+            result["journaled"] = any(
+                e["type"] == "probe-fail"
+                and e.get("source") == "plugin.chaos" for e in events)
+        else:
+            kind = {"garbage": "garbage", "label-spam": "label-budget",
+                    "escape": "namespace"}[mode]
+            result["journaled"] = any(
+                e["type"] == "plugin-violation"
+                and kind in e["fields"].get("kinds", "")
+                for e in events)
+        assert result["journaled"], f"{mode}: containment not journaled"
+
+        # Containment held; now FIX the plugin and earn recovery
+        # (cooldown + clean rounds at the quarantine cadence).
+        mode_file.write_text("good")
+        wait_for(lambda: daemon.labels().get(
+            "google.com/tpu.plugin.chaos.ok") == "true", 60,
+            what=f"{mode}: recovery labels")
+        wait_for(lambda: daemon.scrape(
+            "tfd_plugin_state", {"plugin": "chaos"}) == 0.0, 20,
+            what=f"{mode}: recovery state")
+        result["recovered"] = True
+        sample_stable()
+        log(f"  {mode}: quarantined + journaled + recovered, "
+            f"{result['stable_samples']}/{result['samples']} stable "
+            "samples")
+    finally:
+        daemon.stop()
+    record["modes"].append(result)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seed", type=int, default=11,
+                    help="recorded for provenance; the drill is "
+                         "deterministic")
+    ap.add_argument("--json", metavar="FILE",
+                    help="write the bench record here")
+    ap.add_argument("--work-dir", default=None)
+    args = ap.parse_args(argv)
+
+    if not BINARY.exists():
+        log(f"daemon binary missing at {BINARY}; build first "
+            "(tests/conftest.py builds it)")
+        return 2
+
+    import tempfile
+    work = args.work_dir or tempfile.mkdtemp(prefix="tfd-plugin-soak-")
+    Path(work).mkdir(parents=True, exist_ok=True)
+    log(f"work dir {work}")
+
+    record = {"soak": "plugin", "seed": args.seed, "interval_s": 1,
+              "modes": []}
+    t0 = time.monotonic()
+    baseline = baseline_phase(work)
+    assert "google.com/tpu.count" in baseline
+    golden_phase(work, record)
+    steady_phase(work, record)
+    for mode in MODES:
+        misbehavior_phase(work, mode, baseline, record)
+
+    record["duration_s"] = round(time.monotonic() - t0, 1)
+    record["all_quarantined"] = all(m["quarantined"]
+                                    for m in record["modes"])
+    record["all_journaled"] = all(m["journaled"] for m in record["modes"])
+    record["all_recovered"] = all(m["recovered"] for m in record["modes"])
+    record["others_byte_stable"] = all(
+        m["stable_samples"] == m["samples"] for m in record["modes"])
+    record["containment_samples"] = sum(m["samples"]
+                                        for m in record["modes"])
+
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(record, f, indent=1)
+    log(f"plugin soak OK: {len(record['modes'])} misbehavior classes "
+        f"contained, steady no-op p50 {record['steady_noop_p50_us']}us, "
+        f"{record['containment_samples']} byte-stable samples, "
+        f"{record['duration_s']}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,6 +10,13 @@ schedule:
 
   kill-follower     SIGKILL a non-leader member        -> survivors 3/4
   restart           bring it back                      -> 4/4
+  crash-loop-dwell  kill/restart it twice inside the   -> healthy-hosts
+                    rejoin dwell (--slice-rejoin-dwell)   NEVER flaps up
+                                                          per restart;
+                                                          re-counted only
+                                                          after it stays
+                                                          up through the
+                                                          dwell
   kill-leader       SIGKILL the lease holder           -> failover + 3/4
   restart           bring it back                      -> 4/4
   wedge-pjrt        wedge one member's PJRT (hang file)-> 3/4 everywhere
@@ -112,6 +119,16 @@ class Member:
             # the full deadline; keep it under the lease so ONE stalled
             # tick can't push self-demotion past the step budget.
             "--sink-request-deadline=2s",
+            # Every boot's "waiting for the first device probe round"
+            # slice-probe error costs 2 healthsm transitions that the
+            # state file PERSISTS across restarts; the crash-loop-dwell
+            # drill boots the same member 4 times (8 transitions),
+            # which at the default threshold of 6 would quarantine its
+            # slice source for the default 600s cooldown and wedge the
+            # drill. 12 keeps the soak's restart budget under the bar
+            # without masking anything the soak asserts (no step ever
+            # legitimately quarantines here).
+            "--health-flap-threshold=12",
             "--cadence-jitter-pct=0", "--no-timestamp",
         ]
         self.env = {
@@ -132,8 +149,11 @@ class Member:
         self.proc = None
 
     def start(self):
+        # Stderr kept per host (appended across restarts): the chaos
+        # post-mortems need the coordinator's own account.
+        self.log = open(self.out_file.parent / f"log-{self.index}", "a")
         self.proc = subprocess.Popen(self.argv, env=self.env,
-                                     stderr=subprocess.DEVNULL)
+                                     stderr=self.log)
 
     def kill(self, sig=signal.SIGKILL):
         if self.proc is None:
@@ -320,6 +340,52 @@ def run_soak(hosts, seed, tmp):
                           expected_labels(sid, hosts, hosts),
                           budget_s=20, enforce_window=False)
             soak.watch_steady(members, 2, phase="w3")
+
+            # 1b. Crash-loop rejoin hysteresis (ISSUE 11 satellite,
+            # --slice-rejoin-dwell at its auto default = 2x the
+            # agreement timeout): a member restarting FASTER than the
+            # dwell must not flap healthy-hosts back up once per
+            # restart — the leader re-counts it only after it stays
+            # continuously present through the dwell.
+            follower.kill(signal.SIGKILL)
+            soak.converge("dwell-depart", members,
+                          expected_labels(sid, hosts, hosts - 1),
+                          budget_s=AGREEMENT_S + 4 * INTERVAL_S + 3)
+            follower.start()
+            # While the crash-looper is inside its dwell, no SURVIVOR
+            # may claim full health — this is the flap the hysteresis
+            # exists to prevent. (The restarting member itself is
+            # excluded: its on-disk label file legitimately holds the
+            # pre-kill bytes until its first warm-restart pass.)
+            survivors = [m for m in members if m is not follower]
+            flap_deadline = time.monotonic() + 3 * INTERVAL_S
+            while time.monotonic() < flap_deadline:
+                for index, labels in soak.sample_all(survivors).items():
+                    if labels:
+                        require(labels[slicecoord.SLICE_HEALTHY_HOSTS]
+                                != str(hosts),
+                                f"crash-looper re-counted healthy inside "
+                                f"its rejoin dwell (healthy-hosts "
+                                f"flapped; host {index} published "
+                                f"{labels})")
+                soak.samples += 1
+                time.sleep(0.1)
+            # Second crash inside the dwell, then a real recovery: the
+            # departure clock refreshes, so full health returns only
+            # after the member finally stays up through the dwell.
+            follower.kill(signal.SIGKILL)
+            follower.start()
+            soak.converge("crash-loop-dwell", members,
+                          expected_labels(sid, hosts, hosts),
+                          budget_s=2 * AGREEMENT_S + 25,
+                          enforce_window=False)
+            lease = lease_of(server)
+            dwell_leader = next(m for m in members
+                                if m.node == lease["holder"])
+            require("slice-rejoin-dwell" in dwell_leader.journal_types(),
+                    "leader never journaled slice-rejoin-dwell for the "
+                    "crash-looping member")
+            soak.watch_steady(members, 2, phase="w3b")
 
             # 2. Kill the leader: lease failover (epoch bump) + the
             # same coherent degrade on every survivor. Re-resolve the

@@ -557,6 +557,70 @@ std::vector<FlagDef> MakeFlagDefs(Flags* f) {
                   [f](const std::string& v) {
                     return SetDuration(&f->slice_agreement_timeout_s, v);
                   }});
+  defs.push_back({"slice-rejoin-dwell",
+                  {"TFD_SLICE_REJOIN_DWELL"},
+                  "sliceRejoinDwell",
+                  "leader-side rejoin hysteresis: how long a "
+                  "recently-departed slice member must stay "
+                  "continuously present before it is re-counted "
+                  "healthy, so a crash-looping host cannot flap "
+                  "tpu.slice.healthy-hosts once per restart (e.g. 4m; "
+                  "0 = auto: 2x the agreement timeout)",
+                  false,
+                  [f](const std::string& v) {
+                    return SetDuration(&f->slice_rejoin_dwell_s, v);
+                  }});
+  defs.push_back({"plugin-dir",
+                  {"TFD_PLUGIN_DIR"},
+                  "pluginDir",
+                  "probe-plugin directory: every executable here "
+                  "speaking the tfd.probe/v1 handshake becomes a "
+                  "probe source (\"plugin.<name>\") with first-party "
+                  "scheduling, deadlines, quarantine, and label "
+                  "namespace enforcement; optional \"<file>.conf\" "
+                  "stanzas set enabled/interval/deadline per plugin "
+                  "(empty disables)",
+                  false,
+                  [f](const std::string& v) {
+                    return SetString(&f->plugin_dir, v);
+                  }});
+  defs.push_back({"plugin-timeout",
+                  {"TFD_PLUGIN_TIMEOUT"},
+                  "pluginTimeout",
+                  "default and ceiling for one plugin probe round: at "
+                  "the deadline the plugin's whole process group is "
+                  "killed (a handshake hint may only lower it; a "
+                  "per-plugin conf stanza may set it freely), e.g. 30s",
+                  false,
+                  [f](const std::string& v) {
+                    return SetDuration(&f->plugin_timeout_s, v);
+                  }});
+  defs.push_back({"plugin-interval",
+                  {"TFD_PLUGIN_INTERVAL"},
+                  "pluginInterval",
+                  "default plugin re-probe cadence (a handshake hint "
+                  "may only slow a plugin down, never quicken it); "
+                  "0 = the sleep interval",
+                  false,
+                  [f](const std::string& v) {
+                    return SetDuration(&f->plugin_interval_s, v);
+                  }});
+  defs.push_back({"plugin-label-budget",
+                  {"TFD_PLUGIN_LABEL_BUDGET"},
+                  "pluginLabelBudget",
+                  "labels one plugin round may publish; a round "
+                  "carrying more is rejected whole (label-spam "
+                  "containment) and counts toward quarantine",
+                  false,
+                  [f](const std::string& v) {
+                    int parsed = 0;
+                    if (!ParseNonNegInt(TrimSpace(v), &parsed)) {
+                      return Status::Error("plugin-label-budget must be "
+                                           "a non-negative integer");
+                    }
+                    f->plugin_label_budget = parsed;
+                    return Status::Ok();
+                  }});
   defs.push_back({"fault-spec",
                   {"TFD_FAULT_SPEC"},
                   "faultSpec",
@@ -950,6 +1014,20 @@ Result<LoadResult> Load(int argc, char** argv) {
     return Result<LoadResult>::Error(
         "slice-agreement-timeout must be >= 0s (0 = auto)");
   }
+  if (f->slice_rejoin_dwell_s < 0) {
+    return Result<LoadResult>::Error(
+        "slice-rejoin-dwell must be >= 0s (0 = auto)");
+  }
+  if (f->plugin_timeout_s < 1) {
+    return Result<LoadResult>::Error("plugin-timeout must be >= 1s");
+  }
+  if (f->plugin_interval_s < 0) {
+    return Result<LoadResult>::Error(
+        "plugin-interval must be >= 0s (0 = sleep interval)");
+  }
+  if (f->plugin_label_budget < 1) {
+    return Result<LoadResult>::Error("plugin-label-budget must be >= 1");
+  }
   if (!f->fault_spec.empty()) {
     Status s = fault::Validate(f->fault_spec);
     if (!s.ok()) {
@@ -1034,6 +1112,11 @@ std::string ToJson(const Config& config) {
       << ",\"sliceLeaseDuration\":\"" << f.slice_lease_duration_s << "s\""
       << ",\"sliceAgreementTimeout\":\"" << f.slice_agreement_timeout_s
       << "s\""
+      << ",\"sliceRejoinDwell\":\"" << f.slice_rejoin_dwell_s << "s\""
+      << ",\"pluginDir\":" << jstr(f.plugin_dir)
+      << ",\"pluginTimeout\":\"" << f.plugin_timeout_s << "s\""
+      << ",\"pluginInterval\":\"" << f.plugin_interval_s << "s\""
+      << ",\"pluginLabelBudget\":" << f.plugin_label_budget
       << ",\"faultSpec\":" << jstr(f.fault_spec)
       << "},\"sharing\":[";
   for (size_t i = 0; i < config.sharing.time_slicing.size(); i++) {

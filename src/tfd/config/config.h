@@ -247,6 +247,33 @@ struct Flags {
   // it (the host is dead/wedged/partitioned and the slice degrades).
   // 0 = auto: 2x the coordination tick.
   int slice_agreement_timeout_s = 0;
+  // Leader-side rejoin hysteresis: how long the leader dwells before
+  // re-counting a RECENTLY-DEPARTED member as healthy again, so a
+  // crash-looping host cannot flap tpu.slice.healthy-hosts once per
+  // restart — it must stay continuously present for the dwell to be
+  // counted. 0 = auto: 2x the agreement timeout.
+  int slice_rejoin_dwell_s = 0;
+  // Probe-plugin SDK (plugin/plugin.h): directory scanned at config
+  // load for tfd.probe/v1 plugin executables; each accepted plugin
+  // becomes a ProbeBroker source "plugin.<name>" with the full
+  // first-party containment stack (deadline kill, crash-loop backoff,
+  // healthsm quarantine, output validation, namespace enforcement).
+  // Empty disables. Optional per-plugin "<file>.conf" stanzas override
+  // enabled/interval/deadline.
+  std::string plugin_dir;
+  // Default AND ceiling for one plugin round's wall clock: at the
+  // deadline the plugin's whole process group is SIGKILLed. A plugin's
+  // handshake hint may lower its own deadline, never raise it; a
+  // trusted per-plugin conf stanza may set it freely.
+  int plugin_timeout_s = 30;
+  // Default re-probe cadence for plugins whose handshake declares no
+  // (or a faster) interval hint — hints may only slow a plugin down.
+  // 0 = the sleep interval.
+  int plugin_interval_s = 0;
+  // Per-plugin labels-per-round budget: a round carrying more is
+  // rejected WHOLE (journal "plugin-violation", flap evidence toward
+  // quarantine) — label spam must not publish even its first N keys.
+  int plugin_label_budget = 32;
   // Fault injection (fault/fault.h): named-point spec, e.g.
   // "sink.file:errno=ENOSPC:rate=0.3,k8s.put:http=500:count=3".
   // TEST-ONLY — an armed daemon fails on purpose; empty (default)

@@ -316,6 +316,21 @@ int HealthTracker::ObserveClassRank(const std::string& key, int rank,
   return rank;
 }
 
+State HealthTracker::NoteFlapEvidence(const std::string& key,
+                                      const std::string& reason,
+                                      double now_s) {
+  std::lock_guard<std::mutex> lock(mu_);
+  Entry& entry = entries_[key];
+  PruneWindowLocked(&entry, now_s);
+  TFD_LOG_WARNING << "health " << key << ": misbehavior evidence ("
+                  << reason << "), "
+                  << (entry.flap_times.size() + 1) << "/"
+                  << policy_.flap_threshold << " in window";
+  NoteFlapLocked(key, &entry, now_s);
+  StateGauge(key)->Set(StateGaugeValue(entry.state));
+  return entry.state;
+}
+
 void HealthTracker::ResetClassRank(const std::string& key) {
   std::lock_guard<std::mutex> lock(mu_);
   auto it = entries_.find(key);

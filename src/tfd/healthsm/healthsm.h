@@ -147,6 +147,20 @@ class HealthTracker {
   // chip's class).
   void ResetClassRank(const std::string& key);
 
+  // Extra flap evidence from OUTSIDE the probe-verdict stream — the
+  // plugin supervisor's containment hook (plugin/plugin.cc). Observe()
+  // only notes flaps on state TRANSITIONS and content instability, so
+  // a plugin that fails the same way every round (crash loop, garbage
+  // output) parks in `unhealthy` and never reaches quarantine, and a
+  // plugin whose rounds SUCCEED minus dropped namespace violations
+  // looks perfectly clean. Each misbehaving round calls this once:
+  // --health-flap-threshold misbehaviors inside --health-flap-window
+  // quarantine the key exactly like transition-sourced evidence (same
+  // window, same counters, same journal). `reason` rides the log line.
+  // Returns the post-evidence state.
+  State NoteFlapEvidence(const std::string& key, const std::string& reason,
+                         double now_s);
+
   State StateOf(const std::string& key, double now_s) const;
   bool Quarantined(const std::string& key, double now_s) const;
   // Keys currently quarantined, in key order. Also releases ghost

@@ -126,6 +126,16 @@ inline constexpr char kPerfPctOfRated[] =
     "google.com/tpu.perf.pct-of-rated";
 inline constexpr char kPerfClass[] = "google.com/tpu.perf.class";
 
+// Probe plugins (plugin/plugin.h, --plugin-dir): the RECOMMENDED home
+// for out-of-tree plugin label namespaces — a plugin named "foo"
+// conventionally declares "google.com/tpu.plugin.foo." as its
+// label_prefix. Not enforced (the device-health port legitimately
+// declares the tpu.health. namespace); what IS enforced is that every
+// key a plugin publishes lives under its OWN declared prefix, that no
+// two plugins' prefixes overlap, and that plugin labels merge at the
+// lowest precedence so first-party labels always win.
+inline constexpr char kPluginNamespacePrefix[] = "google.com/tpu.plugin.";
+
 // Degradation ladder (sched/): present only when the daemon is serving
 // CACHED device facts because the probe source missed its cadence
 // (chips held by a training job, wedged libtpu). Age is whole seconds

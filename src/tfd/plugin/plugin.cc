@@ -1,0 +1,589 @@
+#include "tfd/plugin/plugin.h"
+
+#include <dirent.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cctype>
+#include <cerrno>
+#include <cstring>
+#include <fstream>
+
+#include "tfd/healthsm/healthsm.h"
+#include "tfd/lm/schema.h"
+#include "tfd/obs/journal.h"
+#include "tfd/obs/metrics.h"
+#include "tfd/util/jsonlite.h"
+#include "tfd/util/logging.h"
+#include "tfd/util/strings.h"
+#include "tfd/util/subprocess.h"
+#include "tfd/util/time.h"
+
+namespace tfd {
+namespace plugin {
+
+namespace {
+
+// A label key's name part (after "google.com/"): alphanumeric ends,
+// [-._a-zA-Z0-9] middle, <= 63 chars — the apiserver's label-name
+// rule. One invalid key from a plugin would fail the whole NodeFeature
+// update, so it can never pass through.
+bool ValidLabelName(const std::string& s) {
+  if (s.empty() || s.size() > 63) return false;
+  auto alnum = [](char c) { return isalnum(static_cast<unsigned char>(c)); };
+  if (!alnum(s.front()) || !alnum(s.back())) return false;
+  for (char c : s) {
+    if (!alnum(c) && c != '-' && c != '_' && c != '.') return false;
+  }
+  return true;
+}
+
+// Plugin names double as metric label values, source names, and journal
+// keys: lowercase alphanumeric + dashes, alnum ends, 1..32.
+bool ValidPluginName(const std::string& s) {
+  if (s.empty() || s.size() > 32) return false;
+  auto lower_alnum = [](char c) {
+    return (c >= 'a' && c <= 'z') || (c >= '0' && c <= '9');
+  };
+  if (!lower_alnum(s.front()) || !lower_alnum(s.back())) return false;
+  for (char c : s) {
+    if (!lower_alnum(c) && c != '-') return false;
+  }
+  return true;
+}
+
+// Declared prefix: under "google.com/", trailing '.', and — with the
+// trailing dot stripped and one suffix character appended — still a
+// valid label name, so every key under it CAN be valid.
+Status ValidateLabelPrefix(const std::string& prefix) {
+  if (!HasPrefix(prefix, lm::kPrefix)) {
+    return Status::Error("label_prefix must start with \"" +
+                         std::string(lm::kPrefix) + "\"");
+  }
+  std::string name = prefix.substr(sizeof(lm::kPrefix) - 1);
+  if (name.size() < 2 || name.back() != '.') {
+    return Status::Error(
+        "label_prefix must end with '.' and name a namespace "
+        "(e.g. google.com/tpu.plugin.myprobe.)");
+  }
+  // "x." + 1 suffix char must fit the 63-char name budget.
+  std::string shortest_key = name + "x";
+  if (!ValidLabelName(shortest_key)) {
+    return Status::Error("label_prefix is not a valid label-key prefix "
+                         "(chars or length)");
+  }
+  return Status::Ok();
+}
+
+double NumberOr(const jsonlite::Value& obj, const std::string& key,
+                double fallback) {
+  jsonlite::ValuePtr v = obj.Get(key);
+  if (v == nullptr || v->kind != jsonlite::Value::Kind::kNumber) {
+    return fallback;
+  }
+  return v->number_value;
+}
+
+std::string StringOr(const jsonlite::Value& obj, const std::string& key) {
+  jsonlite::ValuePtr v = obj.Get(key);
+  if (v == nullptr || v->kind != jsonlite::Value::Kind::kString) return "";
+  return v->string_value;
+}
+
+std::string Truncate(const std::string& s, size_t n) {
+  return s.size() <= n ? s : s.substr(0, n) + "...";
+}
+
+// Single-quote shell quoting for the exec'd plugin path (paths come
+// from a directory scan, not from config the operator typed).
+std::string ShellQuote(const std::string& s) {
+  std::string out = "'";
+  for (char c : s) {
+    if (c == '\'') {
+      out += "'\\''";
+    } else {
+      out += c;
+    }
+  }
+  out += "'";
+  return out;
+}
+
+obs::Gauge* PluginStateGauge(const std::string& name) {
+  return obs::Default().GetGauge(
+      "tfd_plugin_state",
+      "Probe-plugin supervisor state: 0 active, 1 failing (backoff), "
+      "2 quarantined (labels held at last-good), 3 rejected at "
+      "discovery.",
+      {{"plugin", name}});
+}
+
+void CountViolations(const std::string& name,
+                     const std::vector<Violation>& violations) {
+  for (const Violation& v : violations) {
+    obs::Default()
+        .GetCounter("tfd_plugin_violations_total",
+                    "Probe-plugin contract violations (dropped keys, "
+                    "rejected rounds), by plugin and kind.",
+                    {{"plugin", name}, {"kind", v.kind}})
+        ->Inc();
+  }
+}
+
+// One "plugin-violation" journal event per misbehaving round — the
+// violation list rides as a count plus the first few details, so a
+// 10k-key spammer cannot flood the ring with per-key events.
+void JournalViolations(const std::string& name,
+                       const std::vector<Violation>& violations,
+                       bool round_rejected) {
+  if (violations.empty()) return;
+  std::vector<std::string> kinds;
+  std::vector<std::string> samples;
+  for (const Violation& v : violations) {
+    if (std::find(kinds.begin(), kinds.end(), v.kind) == kinds.end()) {
+      kinds.push_back(v.kind);
+    }
+    if (samples.size() < 3) {
+      samples.push_back(v.kind + ":" +
+                        jsonlite::SanitizeUtf8(Truncate(v.detail, 80)));
+    }
+  }
+  obs::DefaultJournal().Record(
+      "plugin-violation", kSourcePrefix + name,
+      "plugin " + name + ": " + std::to_string(violations.size()) +
+          " contract violation(s) [" + JoinStrings(kinds, ",") + "]" +
+          (round_rejected ? "; round rejected"
+                          : "; offending keys dropped"),
+      {{"plugin", name},
+       {"violations", std::to_string(violations.size())},
+       {"kinds", JoinStrings(kinds, ",")},
+       {"sample", JoinStrings(samples, " ")},
+       {"round_rejected", round_rejected ? "true" : "false"}});
+}
+
+}  // namespace
+
+void SetPluginStateGauge(const std::string& name, PluginState state) {
+  PluginStateGauge(name)->Set(static_cast<int>(state));
+}
+
+Result<Handshake> ParseHandshake(const std::string& text) {
+  if (text.size() > kMaxHandshakeBytes) {
+    return Result<Handshake>::Error(
+        "handshake larger than " + std::to_string(kMaxHandshakeBytes) +
+        " bytes");
+  }
+  Result<jsonlite::ValuePtr> parsed =
+      jsonlite::Parse(jsonlite::SanitizeUtf8(TrimSpace(text)));
+  if (!parsed.ok()) {
+    return Result<Handshake>::Error("handshake is not valid JSON: " +
+                                    parsed.error());
+  }
+  const jsonlite::Value& obj = **parsed;
+  if (obj.kind != jsonlite::Value::Kind::kObject) {
+    return Result<Handshake>::Error("handshake is not a JSON object");
+  }
+  Handshake hs;
+  hs.contract = StringOr(obj, "contract");
+  if (hs.contract != kContractV1) {
+    // The forward-compat contract: a v2 plugin against a v1 daemon is
+    // rejected HERE, loudly, with both versions named — never
+    // half-registered to fail confusingly mid-round.
+    return Result<Handshake>::Error(
+        "unknown contract version '" + Truncate(hs.contract, 64) +
+        "' (this daemon speaks " + kContractV1 + ")");
+  }
+  hs.name = StringOr(obj, "name");
+  if (!ValidPluginName(hs.name)) {
+    return Result<Handshake>::Error(
+        "invalid plugin name '" + Truncate(hs.name, 64) +
+        "' (want [a-z0-9-], alnum ends, 1..32 chars)");
+  }
+  hs.label_prefix = StringOr(obj, "label_prefix");
+  if (Status s = ValidateLabelPrefix(hs.label_prefix); !s.ok()) {
+    return Result<Handshake>::Error(s.message());
+  }
+  double interval = NumberOr(obj, "interval_s", 0);
+  double deadline = NumberOr(obj, "deadline_s", 0);
+  if (interval < 0 || interval > 86400 || deadline < 0 ||
+      deadline > 86400) {
+    return Result<Handshake>::Error(
+        "interval_s/deadline_s hints must be in [0, 86400]");
+  }
+  hs.interval_s = static_cast<int>(interval);
+  hs.deadline_s = static_cast<int>(deadline);
+  return hs;
+}
+
+Status ParseRoundOutput(const std::string& text, const Handshake& handshake,
+                        int label_budget, RoundOutput* out) {
+  *out = RoundOutput();
+  if (text.size() > kMaxRoundOutputBytes) {
+    out->violations.push_back(
+        {"oversize", std::to_string(text.size()) + " bytes (cap " +
+                         std::to_string(kMaxRoundOutputBytes) + ")"});
+    return Status::Error("round output oversize");
+  }
+  Result<jsonlite::ValuePtr> parsed =
+      jsonlite::Parse(jsonlite::SanitizeUtf8(TrimSpace(text)));
+  if (!parsed.ok() ||
+      (*parsed)->kind != jsonlite::Value::Kind::kObject) {
+    out->violations.push_back(
+        {"garbage",
+         parsed.ok() ? "not a JSON object" : parsed.error()});
+    return Status::Error("round output is not the contract document");
+  }
+  const jsonlite::Value& obj = **parsed;
+  if (jsonlite::ValuePtr facts = obj.Get("facts");
+      facts != nullptr && facts->kind == jsonlite::Value::Kind::kObject) {
+    out->facts = static_cast<int>(facts->object_items.size());
+  }
+  jsonlite::ValuePtr labels = obj.Get("labels");
+  if (labels == nullptr) return Status::Ok();  // facts-only round
+  if (labels->kind != jsonlite::Value::Kind::kObject) {
+    out->violations.push_back({"schema", "\"labels\" is not an object"});
+    return Status::Error("round output is not the contract document");
+  }
+  // Budget check runs on the RAW count, before per-key validation: a
+  // spammer must not sneak under the budget by padding with keys the
+  // validator would drop anyway.
+  if (label_budget > 0 &&
+      static_cast<int>(labels->object_items.size()) > label_budget) {
+    out->violations.push_back(
+        {"label-budget",
+         std::to_string(labels->object_items.size()) + " labels (budget " +
+             std::to_string(label_budget) + ")"});
+    return Status::Error("round exceeded the label budget");
+  }
+  for (const auto& [key, value] : labels->object_items) {
+    if (value == nullptr ||
+        value->kind != jsonlite::Value::Kind::kString) {
+      out->violations.push_back({"schema", key});
+      continue;
+    }
+    // Namespace enforcement — the headline rule: a plugin may only
+    // write keys under its DECLARED prefix. Everything else (another
+    // plugin's namespace, tpu.perf.*, the product label...) is
+    // dropped and journaled, never merged.
+    if (!HasPrefix(key, handshake.label_prefix)) {
+      out->violations.push_back({"namespace", key});
+      continue;
+    }
+    if (!ValidLabelName(key.substr(sizeof(lm::kPrefix) - 1)) ||
+        key.size() == handshake.label_prefix.size()) {
+      out->violations.push_back({"invalid-key", key});
+      continue;
+    }
+    std::string strict = StrictLabelValue(value->string_value);
+    if (strict.empty() && !value->string_value.empty()) {
+      out->violations.push_back({"invalid-value", key});
+      continue;
+    }
+    out->labels[key] = strict;
+  }
+  return Status::Ok();
+}
+
+Result<PluginConf> ParsePluginConf(const std::string& text) {
+  PluginConf conf;
+  for (const std::string& raw : SplitString(text, '\n')) {
+    std::string line = TrimSpace(raw);
+    if (line.empty() || line[0] == '#') continue;
+    size_t eq = line.find('=');
+    if (eq == std::string::npos) {
+      return Result<PluginConf>::Error("not key=value: '" +
+                                       Truncate(line, 64) + "'");
+    }
+    std::string key = TrimSpace(line.substr(0, eq));
+    std::string value = TrimSpace(line.substr(eq + 1));
+    if (key == "enabled") {
+      std::string v = ToLower(value);
+      if (v == "true" || v == "1" || v == "yes") {
+        conf.enabled = true;
+      } else if (v == "false" || v == "0" || v == "no") {
+        conf.enabled = false;
+      } else {
+        return Result<PluginConf>::Error("enabled must be true/false");
+      }
+    } else if (key == "interval" || key == "deadline") {
+      Result<int> seconds = config::ParseDurationSeconds(value);
+      if (!seconds.ok() || *seconds < 0) {
+        return Result<PluginConf>::Error(key + ": not a duration: '" +
+                                         Truncate(value, 64) + "'");
+      }
+      (key == "interval" ? conf.interval_s : conf.deadline_s) = *seconds;
+    } else {
+      return Result<PluginConf>::Error("unknown key '" +
+                                       Truncate(key, 64) + "'");
+    }
+  }
+  return conf;
+}
+
+int EffectiveDeadlineS(const Handshake& handshake, const PluginConf& conf,
+                       int default_deadline_s) {
+  int base = conf.deadline_s > 0 ? conf.deadline_s : default_deadline_s;
+  if (base < 1) base = 1;
+  if (handshake.deadline_s > 0 && handshake.deadline_s < base) {
+    return handshake.deadline_s;
+  }
+  return base;
+}
+
+int EffectiveIntervalS(const Handshake& handshake, const PluginConf& conf,
+                       int default_interval_s) {
+  if (conf.interval_s > 0) {
+    // The operator's stanza is trusted and overrides OUTRIGHT — it may
+    // quicken a plugin below its own (untrusted) hint; only the
+    // hint-vs-default comparison is trust-capped.
+    return conf.interval_s;
+  }
+  int base = default_interval_s < 1 ? 1 : default_interval_s;
+  return std::max(handshake.interval_s, base);
+}
+
+std::vector<DiscoveredPlugin> DiscoverPlugins(const config::Flags& flags,
+                                              std::string* error) {
+  std::vector<DiscoveredPlugin> accepted;
+  if (error != nullptr) error->clear();
+  if (flags.plugin_dir.empty()) return accepted;
+
+  auto reject = [](const std::string& name, const std::string& path,
+                   const std::string& why) {
+    TFD_LOG_ERROR << "plugin " << path << " rejected: " << why;
+    SetPluginStateGauge(name, PluginState::kRejected);
+    obs::DefaultJournal().Record(
+        "plugin-rejected", kSourcePrefix + name,
+        "plugin " + path + " rejected at discovery: " + why,
+        {{"plugin", name}, {"path", path}, {"reason", why}});
+  };
+
+  DIR* dir = opendir(flags.plugin_dir.c_str());
+  if (dir == nullptr) {
+    std::string why = "plugin-dir " + flags.plugin_dir +
+                      " unreadable: " + strerror(errno);
+    TFD_LOG_ERROR << why;
+    if (error != nullptr) *error = why;
+    return accepted;
+  }
+  std::vector<std::string> names;
+  while (dirent* entry = readdir(dir)) {
+    std::string name = entry->d_name;
+    if (name.empty() || name[0] == '.') continue;
+    if (HasSuffix(name, ".conf")) continue;  // sidecar stanzas
+    names.push_back(name);
+  }
+  closedir(dir);
+  std::sort(names.begin(), names.end());
+
+  for (const std::string& file : names) {
+    std::string path = flags.plugin_dir + "/" + file;
+    struct stat st {};
+    if (stat(path.c_str(), &st) != 0 || !S_ISREG(st.st_mode)) continue;
+    if (access(path.c_str(), X_OK) != 0) {
+      TFD_LOG_INFO << "plugin dir entry " << path
+                   << " is not executable; skipping";
+      continue;
+    }
+
+    PluginConf conf;
+    {
+      std::ifstream in(path + ".conf");
+      if (in) {
+        std::string text((std::istreambuf_iterator<char>(in)),
+                         std::istreambuf_iterator<char>());
+        Result<PluginConf> parsed = ParsePluginConf(text);
+        if (!parsed.ok()) {
+          reject(file, path, "bad conf stanza: " + parsed.error());
+          continue;
+        }
+        conf = *parsed;
+      }
+    }
+    if (!conf.enabled) {
+      TFD_LOG_INFO << "plugin " << path << " disabled by its conf stanza";
+      continue;
+    }
+
+    // The handshake runs under its own short deadline: discovery is on
+    // the config-load path, and a plugin that hangs its handshake must
+    // not stall startup for the full probe budget.
+    int handshake_deadline_s =
+        std::min(10, std::max(1, flags.plugin_timeout_s));
+    std::string command = "export TFD_PLUGIN_OP=handshake; "
+                          "export TFD_PLUGIN_CONTRACT=" +
+                          std::string(kContractV1) + "; exec " +
+                          ShellQuote(path);
+    CaptureOutcome outcome;
+    Result<std::string> text =
+        RunCommandCapture(command, handshake_deadline_s, &outcome);
+    if (!text.ok()) {
+      reject(file, path,
+             outcome.timed_out ? "handshake timed out (killed)"
+                               : "handshake failed: " + text.error());
+      continue;
+    }
+    Result<Handshake> handshake = ParseHandshake(*text);
+    if (!handshake.ok()) {
+      reject(file, path, handshake.error());
+      continue;
+    }
+    bool collides = false;
+    for (const DiscoveredPlugin& other : accepted) {
+      // Collision rejections gauge/journal under the FILE name: the
+      // rejected plugin's claimed name belongs to the already-accepted
+      // plugin, whose tfd_plugin_state must stay active.
+      if (other.handshake.name == handshake->name) {
+        reject(file, path,
+               "duplicate plugin name '" + handshake->name +
+                   "' (already provided by " + other.path + ")");
+        collides = true;
+        break;
+      }
+      // No prefix-of relationship in either direction: two plugins
+      // must never share a key's ownership, or the namespace rule
+      // stops identifying the offender.
+      if (HasPrefix(other.handshake.label_prefix,
+                    handshake->label_prefix) ||
+          HasPrefix(handshake->label_prefix,
+                    other.handshake.label_prefix)) {
+        reject(file, path,
+               "label_prefix " + handshake->label_prefix +
+                   " overlaps " + other.handshake.label_prefix +
+                   " (plugin " + other.handshake.name + ")");
+        collides = true;
+        break;
+      }
+    }
+    if (collides) continue;
+
+    DiscoveredPlugin plugin;
+    plugin.path = path;
+    plugin.handshake = *handshake;
+    plugin.deadline_s =
+        EffectiveDeadlineS(*handshake, conf, flags.plugin_timeout_s);
+    plugin.interval_s = EffectiveIntervalS(
+        *handshake, conf,
+        flags.plugin_interval_s > 0 ? flags.plugin_interval_s
+                                    : flags.sleep_interval_s);
+    plugin.label_budget = flags.plugin_label_budget;
+    SetPluginStateGauge(handshake->name, PluginState::kActive);
+    obs::DefaultJournal().Record(
+        "plugin-discovered", kSourcePrefix + handshake->name,
+        "plugin " + handshake->name + " (" + path + "): prefix " +
+            handshake->label_prefix + ", interval " +
+            std::to_string(plugin.interval_s) + "s, deadline " +
+            std::to_string(plugin.deadline_s) + "s",
+        {{"plugin", handshake->name},
+         {"path", path},
+         {"label_prefix", handshake->label_prefix},
+         {"interval_s", std::to_string(plugin.interval_s)},
+         {"deadline_s", std::to_string(plugin.deadline_s)}});
+    TFD_LOG_INFO << "plugin " << handshake->name << " discovered at "
+                 << path << " (prefix " << handshake->label_prefix
+                 << ", interval " << plugin.interval_s << "s, deadline "
+                 << plugin.deadline_s << "s)";
+    accepted.push_back(std::move(plugin));
+  }
+  return accepted;
+}
+
+Status RunPluginRound(const DiscoveredPlugin& plugin, int chip_count,
+                      lm::Labels* out_labels) {
+  const std::string& name = plugin.handshake.name;
+  const std::string source = kSourcePrefix + name;
+  obs::Registry& reg = obs::Default();
+  healthsm::HealthTracker& tracker = healthsm::Default();
+  reg.GetCounter("tfd_plugin_rounds_total",
+                 "Probe-plugin rounds started, per plugin.",
+                 {{"plugin", name}})
+      ->Inc();
+
+  auto fail = [&](const std::string& message) {
+    reg.GetCounter("tfd_plugin_failures_total",
+                   "Probe-plugin rounds that failed (crash, kill, "
+                   "rejected output), per plugin.",
+                   {{"plugin", name}})
+        ->Inc();
+    // Failure rounds are flap evidence ON TOP of the healthsm state
+    // transitions the broker's Observe() will record: a crash LOOP
+    // fails identically every round, which moves the state machine
+    // only twice (healthy->suspect->unhealthy) — without this, a
+    // plugin could crash forever and never reach quarantine.
+    healthsm::State state =
+        tracker.NoteFlapEvidence(source, message, WallClockSeconds());
+    SetPluginStateGauge(name,
+                        state == healthsm::State::kQuarantined
+                            ? PluginState::kQuarantined
+                            : PluginState::kFailing);
+    return Status::Error(message);
+  };
+
+  std::string command =
+      "export TFD_PLUGIN_OP=probe; export TFD_PLUGIN_CONTRACT=" +
+      std::string(kContractV1) + "; export TFD_PLUGIN_NAME=" + name + "; ";
+  if (chip_count >= 0) {
+    // The daemon's enumerated chip count rides along like the health
+    // exec's (lm/health_exec.cc): a device-facing plugin can
+    // cross-check its own enumeration without touching the chips.
+    command += "export TFD_CHIP_COUNT=" + std::to_string(chip_count) + "; ";
+  }
+  command += "exec " + ShellQuote(plugin.path);
+
+  CaptureOutcome outcome;
+  Result<std::string> text =
+      RunCommandCapture(command, plugin.deadline_s, &outcome);
+  if (!text.ok()) {
+    if (outcome.timed_out || outcome.overflowed) {
+      // The containment headline: the plugin's whole process GROUP is
+      // already dead (subprocess.cc kills -pgid, so grandchildren died
+      // too); count and journal the kill distinctly from a crash.
+      const char* why = outcome.timed_out ? "deadline" : "output-flood";
+      reg.GetCounter("tfd_plugin_kills_total",
+                     "Probe-plugin process groups hard-killed by the "
+                     "supervisor, by reason (deadline, output-flood).",
+                     {{"plugin", name}, {"reason", why}})
+          ->Inc();
+      obs::DefaultJournal().Record(
+          "plugin-kill", source,
+          "plugin " + name + " killed (" + why + "): " + text.error(),
+          {{"plugin", name},
+           {"reason", why},
+           {"deadline_s", std::to_string(plugin.deadline_s)}});
+    }
+    return fail("plugin " + name + " round failed: " + text.error());
+  }
+
+  RoundOutput round;
+  Status parsed = ParseRoundOutput(*text, plugin.handshake,
+                                   plugin.label_budget, &round);
+  CountViolations(name, round.violations);
+  JournalViolations(name, round.violations, !parsed.ok());
+  if (!parsed.ok()) {
+    // Rejected whole (garbage / oversize / label budget): the round
+    // fails like a crash — the store keeps serving the last good
+    // snapshot through its tier window, and the evidence accrues.
+    return fail("plugin " + name + " round rejected: " + parsed.message());
+  }
+  if (!round.violations.empty()) {
+    // Dropped-key violations keep the round's VALID labels, but each
+    // violating round is unstable evidence: a plugin that escapes its
+    // namespace every round quarantines even though it also publishes
+    // perfectly good keys. (The quarantine the evidence may have just
+    // triggered is picked up by the gauge read below.)
+    tracker.NoteFlapEvidence(
+        source,
+        std::to_string(round.violations.size()) + " contract violation(s)",
+        WallClockSeconds());
+  }
+  SetPluginStateGauge(name,
+                      tracker.Quarantined(source, WallClockSeconds())
+                          ? PluginState::kQuarantined
+                          : PluginState::kActive);
+  *out_labels = std::move(round.labels);
+  return Status::Ok();
+}
+
+}  // namespace plugin
+}  // namespace tfd

@@ -1,0 +1,201 @@
+// Probe-plugin SDK: the versioned tfd.probe/v1 exec/JSON contract and
+// the supervisor that mounts each discovered plugin as a first-class
+// ProbeBroker source (ROADMAP open item #1).
+//
+// Every probe before this PR was compiled in: a site-specific burn-in,
+// a NIC/ICI link check, or a TPU-MLIR-style compiler-capability probe
+// could only ship by patching core. A plugin is any executable in
+// --plugin-dir speaking the contract:
+//
+//   handshake   — run once at discovery (config load) with
+//                 TFD_PLUGIN_OP=handshake; the plugin prints ONE JSON
+//                 doc on stdout and exits 0:
+//                   {"contract": "tfd.probe/v1",
+//                    "name": "libtpu-caps",
+//                    "label_prefix": "google.com/tpu.plugin.libtpu.",
+//                    "interval_s": 300, "deadline_s": 20}
+//                 `contract` must be EXACTLY kContractV1 — an unknown
+//                 version is rejected loudly at discovery (journal
+//                 "plugin-rejected"), never mid-round. `label_prefix`
+//                 is the plugin's declared namespace: every label it
+//                 will ever publish must live under it. interval /
+//                 deadline are HINTS (see EffectiveSchedule — a plugin
+//                 can make itself cheaper, never hotter).
+//   probe round — run per scheduled tick with TFD_PLUGIN_OP=probe
+//                 (plus TFD_PLUGIN_NAME, TFD_PLUGIN_CONTRACT, and
+//                 TFD_CHIP_COUNT when a device snapshot has settled);
+//                 prints ONE JSON doc of labels + optional free-form
+//                 facts:
+//                   {"labels": {"google.com/tpu.plugin.x.ok": "true"},
+//                    "facts": {"anything": "journaled as a count"}}
+//
+// The supervisor wraps each accepted plugin as a ProbeBroker source
+// named "plugin.<name>", so plugins inherit the whole first-party
+// stack for free: scheduling + deadlines + exponential backoff
+// (sched/broker), snapshots + staleness tiers (sched/snapshot), the
+// health state machine and quarantine (healthsm/), the flight recorder
+// (obs/journal), metrics, warm-restart label state (sched/state), and
+// the probe.plugin.<name> fault point.
+//
+// Containment is the point — an out-of-tree plugin is untrusted code
+// on the node's hot path:
+//   hang        — hard wall-clock kill of the plugin's whole PROCESS
+//                 GROUP at its deadline (util/subprocess.cc: setpgid +
+//                 kill(-pgid), so grandchildren die too); counted
+//                 tfd_plugin_kills_total, journaled "plugin-kill".
+//   flood       — stdout capture is killed at 1 MiB (subprocess.cc),
+//                 and anything past kMaxRoundOutputBytes is rejected
+//                 before parsing.
+//   crash loop  — non-zero exits ride the broker's exponential backoff
+//                 AND feed healthsm::NoteFlapEvidence, so
+//                 --health-flap-threshold bad rounds inside the window
+//                 quarantine the plugin (labels held at last-good, slow
+//                 cooldown cadence, recovery earned).
+//   garbage     — stdout is SanitizeUtf8'd, size-capped, and schema-
+//                 checked; an unparseable round fails like a crash.
+//   label spam  — a round publishing more than --plugin-label-budget
+//                 labels is rejected whole (a spammer must not get its
+//                 first N keys published either).
+//   namespace   — a key outside the declared label_prefix (or an
+//                 invalid k8s label key/value) is DROPPED, journaled
+//                 "plugin-violation", and counts as flap evidence; the
+//                 round's valid labels still publish.
+// On top of that, plugin labels merge at the LOWEST precedence in the
+// render (cmd/main.cc): every first-party labeler and source overwrites
+// them, so no declared prefix can clobber a first-party label.
+//
+// tpufd/plugin.py is the parity-pinned Python twin of the pure
+// contract logic (handshake parse, round validation, conf stanzas).
+#pragma once
+
+#include <string>
+#include <vector>
+
+#include "tfd/config/config.h"
+#include "tfd/lm/labeler.h"
+#include "tfd/util/status.h"
+
+namespace tfd {
+namespace plugin {
+
+inline constexpr char kContractV1[] = "tfd.probe/v1";
+// Probe-source name prefix: the broker/store/healthsm key for plugin
+// "foo" is "plugin.foo" (fault point "probe.plugin.foo").
+inline constexpr char kSourcePrefix[] = "plugin.";
+// Provenance labeler name for plugin-published labels.
+inline constexpr char kPluginLabeler[] = "plugin";
+
+// Output caps. The subprocess layer already SIGKILLs a flood at 1 MiB;
+// these bound what the validator will even look at.
+inline constexpr size_t kMaxHandshakeBytes = 16 * 1024;
+inline constexpr size_t kMaxRoundOutputBytes = 256 * 1024;
+
+// ---- contract documents (pure, twin-pinned) -------------------------------
+
+struct Handshake {
+  std::string contract;      // == kContractV1
+  std::string name;          // [a-z0-9-], 1..32, alnum ends
+  std::string label_prefix;  // "google.com/...", trailing '.', valid key chars
+  int interval_s = 0;        // hint; 0 = daemon default
+  int deadline_s = 0;        // hint; 0 = daemon default
+};
+
+// Parses + validates one handshake doc. Errors name the exact rule
+// broken (the discovery journal carries them verbatim); an unknown
+// contract version is its own loud error, distinct from parse garbage.
+Result<Handshake> ParseHandshake(const std::string& text);
+
+// One dropped-or-rejected piece of a probe round, by kind:
+//   "garbage"      — stdout did not parse as the contract document
+//   "oversize"     — stdout exceeded kMaxRoundOutputBytes
+//   "label-budget" — more labels than --plugin-label-budget (round
+//                    rejected whole)
+//   "namespace"    — a key outside the declared label_prefix
+//   "invalid-key"  — a key that is not a valid k8s label key
+//   "invalid-value"— a value with no valid k8s label value inside it
+//   "schema"       — a non-string label value / non-object labels
+struct Violation {
+  std::string kind;
+  std::string detail;  // offending key or parse error, truncated
+};
+
+struct RoundOutput {
+  lm::Labels labels;  // validated, namespace-enforced
+  int facts = 0;      // entry count of the free-form "facts" object
+  std::vector<Violation> violations;
+};
+
+// Validates one probe round's stdout against the handshake. Returns an
+// error — with *out->violations still populated — when the round is
+// rejected WHOLE (garbage / oversize / label-budget); per-key
+// violations drop the key and keep the round. `label_budget` <= 0
+// means unbudgeted.
+Status ParseRoundOutput(const std::string& text, const Handshake& handshake,
+                        int label_budget, RoundOutput* out);
+
+// Operator-side per-plugin stanza: an optional "<plugin-file>.conf"
+// next to the plugin, key=value lines (# comments):
+//   enabled = false        # skip this plugin at discovery
+//   interval = 5m          # override the scheduling interval
+//   deadline = 45s         # override the kill deadline
+struct PluginConf {
+  bool enabled = true;
+  int interval_s = 0;  // 0 = no override
+  int deadline_s = 0;  // 0 = no override
+};
+Result<PluginConf> ParsePluginConf(const std::string& text);
+
+// The trust rule for schedule hints, pure and twin-pinned. The
+// operator's conf (trusted) overrides outright — it may even quicken a
+// plugin below its own hint; the plugin's handshake hint (untrusted)
+// can only make the plugin CHEAPER vs the daemon default — a deadline
+// hint may lower the kill budget but never raise it, an interval hint
+// may slow the cadence but never quicken it.
+//   deadline = min(hint or base, base),  base = conf or --plugin-timeout
+//   interval = conf, else max(hint, --plugin-interval or sleep-interval)
+int EffectiveDeadlineS(const Handshake& handshake, const PluginConf& conf,
+                       int default_deadline_s);
+int EffectiveIntervalS(const Handshake& handshake, const PluginConf& conf,
+                       int default_interval_s);
+
+// ---- discovery + rounds (exec side) ---------------------------------------
+
+struct DiscoveredPlugin {
+  std::string path;
+  Handshake handshake;
+  int interval_s = 0;     // effective (EffectiveIntervalS)
+  int deadline_s = 0;     // effective (EffectiveDeadlineS)
+  int label_budget = 32;  // --plugin-label-budget at discovery time
+};
+
+// Scans --plugin-dir (sorted names; regular executable files, dotfiles
+// and *.conf skipped), runs each candidate's handshake under a short
+// deadline, and validates it. Accepted plugins are journaled
+// "plugin-discovered"; a plugin that fails the handshake — unknown
+// contract version included — is journaled "plugin-rejected" with the
+// reason, gauged tfd_plugin_state=3, logged at ERROR, and never
+// registered: rejection happens loudly at discovery, not mid-round.
+// Duplicate names and overlapping label prefixes reject the later
+// plugin (directory order is the tiebreak the operator controls).
+std::vector<DiscoveredPlugin> DiscoverPlugins(const config::Flags& flags,
+                                              std::string* error = nullptr);
+
+// One supervised probe round: exec under the deadline, classify kills,
+// validate output, enforce the namespace, feed healthsm evidence,
+// count + journal everything. `chip_count` (-1 = unknown) rides into
+// the round's environment as TFD_CHIP_COUNT. On success `out_labels`
+// holds the validated label set (possibly empty).
+Status RunPluginRound(const DiscoveredPlugin& plugin, int chip_count,
+                      lm::Labels* out_labels);
+
+// tfd_plugin_state gauge encoding.
+enum class PluginState {
+  kActive = 0,      // discovered, last round ok
+  kFailing = 1,     // last round failed (backoff)
+  kQuarantined = 2, // healthsm quarantine holds its labels
+  kRejected = 3,    // failed discovery; not registered
+};
+void SetPluginStateGauge(const std::string& name, PluginState state);
+
+}  // namespace plugin
+}  // namespace tfd

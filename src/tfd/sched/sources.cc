@@ -3,6 +3,7 @@
 #include <algorithm>
 #include <chrono>
 #include <memory>
+#include <thread>
 
 #include "tfd/gce/metadata.h"
 #include "tfd/healthsm/healthsm.h"
@@ -15,6 +16,7 @@
 #include "tfd/obs/metrics.h"
 #include "tfd/perf/perf.h"
 #include "tfd/platform/detect.h"
+#include "tfd/plugin/plugin.h"
 #include "tfd/resource/factory.h"
 #include "tfd/sched/state.h"
 #include "tfd/slice/coord.h"
@@ -770,6 +772,74 @@ std::vector<ProbeSpec> BuildProbeSpecs(
     specs.push_back(std::move(spec));
   }
 
+  if (!flags.plugin_dir.empty()) {
+    // Probe-plugin SDK (plugin/plugin.h): every accepted plugin mounts
+    // as its own label source "plugin.<name>", so it inherits the
+    // broker's scheduling/deadline/backoff, the store's staleness
+    // tiers, healthsm quarantine, the journal, warm-restart label
+    // state, and the probe.plugin.<name> fault point — exactly like a
+    // first-party source. Discovery (one handshake exec per candidate,
+    // each under a short kill deadline) happens here, once per config
+    // load, so a broken plugin is rejected loudly at startup/SIGHUP,
+    // never mid-round.
+    for (const plugin::DiscoveredPlugin& discovered :
+         plugin::DiscoverPlugins(flags)) {
+      TierPolicy policy;
+      // Freshness spans the plugin's own cadence plus its deadline and
+      // the usual 4 ticks of slack; a slow-interval plugin (hourly
+      // burn-in) keeps serving its last round between runs.
+      policy.fresh_for_s =
+          discovered.interval_s + discovered.deadline_s + 4 * sleep_s;
+      policy.usable_for_s = flags.snapshot_usable_for_s > 0
+                                ? flags.snapshot_usable_for_s
+                                : policy.fresh_for_s + 6 * sleep_s;
+      const std::string source_name =
+          plugin::kSourcePrefix + discovered.handshake.name;
+      store->Register(source_name, policy, /*device_source=*/false);
+
+      std::shared_ptr<SnapshotStore> store_ref = store;
+      ProbeSpec spec;
+      spec.name = source_name;
+      spec.probe = [discovered, store_ref](Snapshot* out,
+                                           bool* /*fatal*/) {
+        // Bounded wait for the FIRST device probe round before the
+        // first plugin round: TFD_CHIP_COUNT must carry the real
+        // enumeration, not a startup-race unknown — a plugin label
+        // derived from the unknown would publish a degenerate first
+        // value and the governor's hold-down would then pin it for a
+        // whole flap window. Settle normally lands in milliseconds;
+        // a wedged device worker stops blocking after 5s (the plugin
+        // then sees chip count -1 / no TFD_CHIP_COUNT, by contract).
+        for (int i = 0; i < 50; i++) {
+          bool device_settled = false;
+          for (const std::string& name : store_ref->DeviceSources()) {
+            if (store_ref->View(name).settled) {
+              device_settled = true;
+              break;
+            }
+          }
+          if (device_settled) break;
+          std::this_thread::sleep_for(std::chrono::milliseconds(100));
+        }
+        // The enumerated chip count rides into the round's env
+        // (TFD_CHIP_COUNT) like the health exec's, so a device-facing
+        // plugin can cross-check enumeration without the chips.
+        return plugin::RunPluginRound(discovered,
+                                      TouchingChipCount(*store_ref),
+                                      &out->labels);
+      };
+      spec.interval_s = discovered.interval_s;
+      // Crash-loop containment: failures ride the exponential backoff
+      // (the healthsm evidence the supervisor feeds per bad round
+      // owns the quarantine decision).
+      spec.backoff_initial_s = std::max(1, sleep_s);
+      spec.backoff_max_s = std::max(60, 8 * sleep_s);
+      spec.device_source = false;
+      spec.exclusive = false;  // plugins never get the device lock
+      specs.push_back(std::move(spec));
+    }
+  }
+
   if (flags.slice_coordination && !flags.oneshot) {
     // Multi-host slice coherence: the coordinator is configured every
     // load (state survives a SIGHUP of the same slice) and the "slice"
@@ -793,6 +863,15 @@ std::vector<ProbeSpec> BuildProbeSpecs(
         flags.slice_agreement_timeout_s > 0
             ? flags.slice_agreement_timeout_s
             : 2 * slice_tick_s;
+    // Rejoin hysteresis: default to 2x the agreement timeout — long
+    // enough that a member crash-looping at the detection cadence
+    // cannot flap healthy-hosts once per restart, short enough that a
+    // genuinely recovered host is re-counted within ~2 detection
+    // windows.
+    coord_policy.rejoin_dwell_s =
+        flags.slice_rejoin_dwell_s > 0
+            ? flags.slice_rejoin_dwell_s
+            : 2 * coord_policy.agreement_timeout_s;
     slice::Default().Configure(identity, NodeIdentity(), coord_policy);
     // Configure() may substitute the state file's restored identity
     // when live derivation had NO name evidence (metadata server down

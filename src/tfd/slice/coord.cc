@@ -310,7 +310,9 @@ bool VerdictContentEquals(const SliceVerdict& a, const SliceVerdict& b) {
 SliceVerdict MergeVerdict(const SliceIdentity& identity,
                           const std::string& leader,
                           const std::vector<MemberReport>& reports,
-                          const CoordPolicy& policy, double now_s) {
+                          const CoordPolicy& policy, double now_s,
+                          const std::map<std::string, double>* departed_at,
+                          std::vector<std::string>* dwelling) {
   SliceVerdict verdict;
   verdict.leader = leader;
   verdict.hosts = identity.num_hosts;
@@ -331,7 +333,22 @@ SliceVerdict MergeVerdict(const SliceIdentity& identity,
     }
     seen.push_back(report.host);
     verdict.members.push_back(report.host);
-    if (report.healthy) verdict.healthy_hosts++;
+    bool healthy = report.healthy;
+    if (healthy && policy.rejoin_dwell_s > 0 && departed_at != nullptr) {
+      // Rejoin hysteresis: a recently-departed member is present (it
+      // appears in members, its report/class count) but not yet
+      // HEALTHY — a crash-looper restarting once per lease would
+      // otherwise flap healthy-hosts on every restart. The departure
+      // map's entry is refreshed while the host is absent, so this
+      // measures continuous presence since its return.
+      auto it = departed_at->find(report.host);
+      if (it != departed_at->end() &&
+          now_s - it->second < policy.rejoin_dwell_s) {
+        healthy = false;
+        if (dwelling != nullptr) dwelling->push_back(report.host);
+      }
+    }
+    if (healthy) verdict.healthy_hosts++;
     int rank = RankOfClassName(report.perf_class);
     if (rank > worst_rank) worst_rank = rank;
   }
@@ -407,6 +424,8 @@ void Coordinator::Configure(const SliceIdentity& identity,
     state_.pending_episode.clear();
     state_.last_leader_seen.clear();
     state_.last_contact_ok = 0;
+    state_.departed_at.clear();
+    state_.last_dwelling.clear();
   }
   state_.identity = effective;
   state_.self = self;
@@ -649,8 +668,64 @@ Coordinator::TickResult Coordinator::Tick(DocStore* store,
     // outbid (the epoch fence).
     Lease next_lease{s->self, holder ? lease.epoch : lease.epoch + 1,
                      now_s, s->policy.lease_duration_s};
+    // Rejoin hysteresis bookkeeping (leader-side): refresh the
+    // departure time of every expected-or-tracked member that is
+    // absent/stale THIS round, so "now - departed_at" measures
+    // continuous presence since a member's return; a host that has
+    // served its dwell sheds the entry.
+    if (s->policy.rejoin_dwell_s > 0) {
+      std::vector<std::string> present;
+      for (const MemberReport& report : reports) {
+        if (report.reported_at > 0 &&
+            now_s - report.reported_at <= s->policy.agreement_timeout_s) {
+          present.push_back(report.host);
+        }
+      }
+      auto is_present = [&present](const std::string& host) {
+        return std::find(present.begin(), present.end(), host) !=
+               present.end();
+      };
+      if (s->have_verdict) {
+        for (const std::string& host : s->adopted.members) {
+          if (!is_present(host)) s->departed_at[host] = now_s;
+        }
+      }
+      for (auto it = s->departed_at.begin(); it != s->departed_at.end();) {
+        if (!is_present(it->first)) {
+          it->second = now_s;  // still absent: the dwell clock holds
+          ++it;
+        } else if (now_s - it->second >= s->policy.rejoin_dwell_s) {
+          it = s->departed_at.erase(it);  // dwell served: count it again
+        } else {
+          ++it;
+        }
+      }
+    }
+    std::vector<std::string> dwelling;
     SliceVerdict next =
-        MergeVerdict(s->identity, s->self, reports, s->policy, now_s);
+        MergeVerdict(s->identity, s->self, reports, s->policy, now_s,
+                     &s->departed_at, &dwelling);
+    for (const std::string& host : dwelling) {
+      if (std::find(s->last_dwelling.begin(), s->last_dwelling.end(),
+                    host) != s->last_dwelling.end()) {
+        continue;  // already journaled this dwell episode
+      }
+      obs::Default()
+          .GetCounter("tfd_slice_rejoin_dwells_total",
+                      "Rejoined slice members held un-healthy through "
+                      "the --slice-rejoin-dwell hysteresis window (one "
+                      "per rejoin episode).")
+          ->Inc();
+      obs::DefaultJournal().Record(
+          "slice-rejoin-dwell", "slice",
+          "member " + host + " rejoined; dwelling " +
+              std::to_string(s->policy.rejoin_dwell_s) +
+              "s before re-counting it healthy (crash-loop hysteresis)",
+          {{"slice", s->identity.slice_id},
+           {"host", host},
+           {"dwell_s", std::to_string(s->policy.rejoin_dwell_s)}});
+    }
+    s->last_dwelling = std::move(dwelling);
     bool content_changed =
         !have_stored || !VerdictContentEquals(next, stored);
     if (content_changed) {
@@ -773,6 +848,20 @@ std::string Coordinator::SerializeJson(double now_s) const {
          ",\"leader_seen\":" + jsonlite::Quote(s.last_leader_seen) +
          ",\"have_verdict\":" + (s.have_verdict ? "true" : "false") +
          ",\"verdict\":" + SerializeVerdict(s.adopted) +
+         ",\"departed\":" + [&s] {
+           // host -> absolute departure wall time: a restarted leader
+           // resumes a crash-looper's dwell instead of re-counting it
+           // on the first post-restore merge. departed_at is an
+           // ordered map, so the emission is already deterministic.
+           std::string out = "{";
+           bool first = true;
+           for (const auto& [host, at] : s.departed_at) {
+             if (!first) out += ",";
+             first = false;
+             out += jsonlite::Quote(host) + ":" + Fixed3(at);
+           }
+           return out + "}";
+         }() +
          ",\"saved_at\":" + Fixed3(now_s) + "}";
 }
 
@@ -821,6 +910,17 @@ Status Coordinator::RestoreJson(const std::string& json, double now_s) {
       }
     } else {
       s->have_verdict = false;
+    }
+  }
+  s->departed_at.clear();
+  if (jsonlite::ValuePtr departed = obj.Get("departed");
+      departed != nullptr &&
+      departed->kind == jsonlite::Value::Kind::kObject) {
+    for (const auto& [host, at] : departed->object_items) {
+      if (at != nullptr && at->kind == jsonlite::Value::Kind::kNumber &&
+          at->number_value > 0) {
+        s->departed_at[host] = at->number_value;
+      }
     }
   }
   // Restored = we WERE in the slice; mode settles at the first tick

@@ -157,6 +157,11 @@ bool VerdictContentEquals(const SliceVerdict& a, const SliceVerdict& b);
 struct CoordPolicy {
   int lease_duration_s = 30;    // --slice-lease-duration
   int agreement_timeout_s = 120;  // --slice-agreement-timeout (resolved)
+  // Leader-side rejoin hysteresis (--slice-rejoin-dwell, resolved):
+  // a recently-departed member must stay continuously present this
+  // long before it is re-counted healthy, so a crash-looping host
+  // cannot flap healthy-hosts once per restart. 0 disables.
+  int rejoin_dwell_s = 0;
 };
 
 // Pure verdict merge: a report is PRESENT when it is younger than the
@@ -166,10 +171,22 @@ struct CoordPolicy {
 // perf class becomes the slice class (tpu.slice.class = min of member
 // classes). seq/computed_at are NOT set here; the caller bumps seq only
 // when content changed vs the adopted verdict.
+//
+// Rejoin hysteresis: `departed_at` (optional) maps host -> the wall
+// time the leader last saw it ABSENT; a present healthy report whose
+// host departed less than policy.rejoin_dwell_s ago is counted as a
+// MEMBER but not healthy (and named in `dwelling`, when non-null) —
+// recovery is earned by staying present through the dwell, exactly the
+// healthsm discipline applied at the slice layer. The leader maintains
+// the map (Tick refreshes an absent member's entry every round, so the
+// dwell clock starts at its LAST absence, i.e. its reappearance).
 SliceVerdict MergeVerdict(const SliceIdentity& identity,
                           const std::string& leader,
                           const std::vector<MemberReport>& reports,
-                          const CoordPolicy& policy, double now_s);
+                          const CoordPolicy& policy, double now_s,
+                          const std::map<std::string, double>* departed_at =
+                              nullptr,
+                          std::vector<std::string>* dwelling = nullptr);
 
 // The published google.com/tpu.slice.{id,hosts,healthy-hosts,degraded}
 // (+ .class when known) labels for one verdict. Deterministic from the
@@ -272,6 +289,14 @@ class Coordinator {
     double restored_at = 0;        // RestoreJson acceptance time
     std::string pending_episode;   // slice-pending dedup key
     std::string last_leader_seen;  // leader-change detection ("holder/epoch")
+    // Rejoin hysteresis (leader-side): host -> wall time last seen
+    // absent. Refreshed every leader tick while the host is absent, so
+    // "now - departed_at" measures continuous presence since rejoin;
+    // erased once the dwell is served. Serialized (slice_json) so a
+    // kill -9'd leader cannot be tricked into instantly re-counting a
+    // crash-looper it was mid-dwell on.
+    std::map<std::string, double> departed_at;
+    std::vector<std::string> last_dwelling;  // rejoin-dwell journal dedup
   };
 
   TickResult HandleContactFailure(State* s, bool server_alive,

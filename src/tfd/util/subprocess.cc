@@ -119,9 +119,11 @@ class ScopedProbeSignals {
 // Parent side of a captured child: reads `read_fd` until EOF, overflow, or
 // the deadline; kills the child's process group on timeout/overflow; reaps.
 // Returns the captured bytes and the child's exit code via `exit_code`
-// (untouched on error). Closes `read_fd`.
+// (untouched on error). `outcome` (optional) records the exit forensics
+// on every path. Closes `read_fd`.
 Result<std::string> CaptureChild(pid_t pid, int read_fd, int timeout_s,
-                                 const std::string& what, int* exit_code) {
+                                 const std::string& what, int* exit_code,
+                                 CaptureOutcome* outcome = nullptr) {
   setpgid(pid, pid);  // see child comment in RunCommandCapture; EACCES
                       // after exec is fine — the child already did it itself
   ScopedProbeSignals signal_guard(pid);
@@ -173,12 +175,14 @@ Result<std::string> CaptureChild(pid_t pid, int read_fd, int timeout_s,
   };
   if (timed_out) {
     KillAndReap();
+    if (outcome != nullptr) outcome->timed_out = true;
     return Result<std::string>::Error(
         "command timed out after " + std::to_string(timeout_s) + "s: " +
         what);
   }
   if (overflowed) {
     KillAndReap();
+    if (outcome != nullptr) outcome->overflowed = true;
     return Result<std::string>::Error(
         "command produced more than 1 MiB of output (killed): " + what);
   }
@@ -189,9 +193,14 @@ Result<std::string> CaptureChild(pid_t pid, int read_fd, int timeout_s,
   int code = 0;
   if (!WaitUntil(pid, deadline, &code, &how)) {
     KillAndReap();
+    if (outcome != nullptr) outcome->timed_out = true;
     return Result<std::string>::Error(
         "command timed out after " + std::to_string(timeout_s) +
         "s (stdout closed, process still running): " + what);
+  }
+  if (outcome != nullptr) {
+    outcome->exit_code = code;
+    outcome->how = how;
   }
   if (code != 0 && exit_code == nullptr) {
     return Result<std::string>::Error(
@@ -205,7 +214,8 @@ Result<std::string> CaptureChild(pid_t pid, int read_fd, int timeout_s,
 }  // namespace
 
 Result<std::string> RunCommandCapture(const std::string& command,
-                                      int timeout_s) {
+                                      int timeout_s,
+                                      CaptureOutcome* outcome) {
   int fds[2];
   if (pipe(fds) != 0) {
     return Result<std::string>::Error(std::string("pipe: ") +
@@ -240,7 +250,7 @@ Result<std::string> RunCommandCapture(const std::string& command,
 
   close(fds[1]);
   // nullptr exit_code: non-zero exit is mapped to an error.
-  return CaptureChild(pid, fds[0], timeout_s, command, nullptr);
+  return CaptureChild(pid, fds[0], timeout_s, command, nullptr, outcome);
 }
 
 Result<std::string> RunForkedCapture(const std::function<int(int fd)>& child_fn,

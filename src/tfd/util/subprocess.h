@@ -14,11 +14,24 @@
 
 namespace tfd {
 
+// How a captured child ended — the containment layer's forensic record.
+// The plugin supervisor (plugin/plugin.cc) classifies a misbehaving
+// probe by it: a deadline kill and an output-flood kill are counted and
+// journaled differently from a plain non-zero exit, and all three
+// differently from a parse failure.
+struct CaptureOutcome {
+  bool timed_out = false;   // deadline hit; process group SIGKILLed
+  bool overflowed = false;  // stdout > 1 MiB; process group SIGKILLed
+  int exit_code = 0;        // valid when neither kill flag is set
+  std::string how;          // human exit disposition ("exit code 1", ...)
+};
+
 // Runs `command` via /bin/sh -c, capturing stdout (stderr passes through to
 // the daemon's stderr so probe logs land in the pod log). Enforces
 // `timeout_s`: on expiry the child's process group is killed and an error
 // returned. Non-zero exit is an error carrying the exit code and the first
-// captured bytes.
+// captured bytes. `outcome` (optional) receives the exit forensics on
+// every path, including the error ones.
 //
 // Signal behavior: while the child runs, SIGTERM/SIGINT/SIGQUIT are
 // UNBLOCKED (the daemon otherwise blocks them for sigtimedwait) with a
@@ -29,7 +42,8 @@ namespace tfd {
 // at the cost of skipping the daemon's output-file cleanup, the same
 // outcome a kubelet SIGKILL would have produced after the grace period.
 Result<std::string> RunCommandCapture(const std::string& command,
-                                      int timeout_s);
+                                      int timeout_s,
+                                      CaptureOutcome* outcome = nullptr);
 
 // Runs `child_fn` in a forked child of this process (own process group,
 // cleared signal mask — no exec), capturing everything it writes to the

@@ -158,6 +158,51 @@ class TestHelmChart:
             assert env["TFD_SLICE_LEASE_DURATION"] == "30s", path.name
             assert env["TFD_SLICE_AGREEMENT_TIMEOUT"] == "0", path.name
 
+    def test_slice_rejoin_dwell_wired(self):
+        """The rejoin-hysteresis knob (ISSUE 11 satellite): helm value
+        -> TFD_SLICE_REJOIN_DWELL, static daemonsets at the auto
+        default."""
+        values = yaml.safe_load((HELM / "values.yaml").read_text())
+        assert values["sliceRejoinDwell"] == "0"
+        template = (HELM / "templates" / "daemonset.yml").read_text()
+        assert "TFD_SLICE_REJOIN_DWELL" in template
+        for path in STATIC_YAMLS:
+            ds = yaml.safe_load(path.read_text())
+            env = {e["name"]: e.get("value") for e in
+                   ds["spec"]["template"]["spec"]["containers"][0]["env"]}
+            assert env["TFD_SLICE_REJOIN_DWELL"] == "0", path.name
+
+    def test_plugin_knobs_wired(self):
+        """The probe-plugin SDK knobs (ISSUE 11): helm values ->
+        TFD_PLUGIN_* envs (dir gated on pluginEnabled), the 3 static
+        daemonsets carrying them at daemon defaults, and the in-tree
+        plugins present and executable."""
+        values = yaml.safe_load((HELM / "values.yaml").read_text())
+        assert values["pluginEnabled"] is False
+        assert values["pluginDir"] == "/opt/tfd/plugins"
+        assert values["pluginTimeout"] == "30s"
+        assert values["pluginInterval"] == "0"
+        assert values["pluginLabelBudget"] == 32
+        template = (HELM / "templates" / "daemonset.yml").read_text()
+        assert ".Values.pluginEnabled" in template
+        for env in ("TFD_PLUGIN_DIR", "TFD_PLUGIN_TIMEOUT",
+                    "TFD_PLUGIN_INTERVAL", "TFD_PLUGIN_LABEL_BUDGET"):
+            assert env in template, env
+        for path in STATIC_YAMLS:
+            ds = yaml.safe_load(path.read_text())
+            env = {e["name"]: e.get("value") for e in
+                   ds["spec"]["template"]["spec"]["containers"][0]["env"]}
+            assert env["TFD_PLUGIN_DIR"] == "", path.name
+            assert env["TFD_PLUGIN_TIMEOUT"] == "30s", path.name
+            assert env["TFD_PLUGIN_INTERVAL"] == "0", path.name
+            assert env["TFD_PLUGIN_LABEL_BUDGET"] == "32", path.name
+        plugins_dir = HELM.parent.parent / "plugins"
+        for name in ("device-health", "libtpu-caps"):
+            plugin = plugins_dir / name
+            assert plugin.exists(), name
+            assert plugin.stat().st_mode & 0o111, f"{name} not executable"
+            assert plugin.read_text().startswith("#!/usr/bin/env python3")
+
     def test_helm_daemonset_wires_introspection(self):
         """The chart must wire the introspection addr env, a named
         containerPort, and both kubelet probes, all gated on

@@ -1,0 +1,482 @@
+"""Tier 2/3: the probe-plugin SDK (ISSUE 11) against the real binary.
+
+The contracts under test:
+  - a tfd.probe/v1 plugin dropped in --plugin-dir registers as a
+    broker source ("plugin.<name>"), publishes its labels with
+    labeler=plugin provenance, and receives TFD_CHIP_COUNT;
+  - an unknown contract version is rejected LOUDLY at discovery
+    (journal "plugin-rejected" naming both versions, tfd_plugin_state
+    == 3), never registered, and the daemon stays healthy — the
+    forward-compat satellite;
+  - the ported device-health plugin publishes byte-identical
+    tpu.health.* labels to the compiled-in --device-health=full path
+    given the same underlying exec (the golden pin);
+  - a misbehaving plugin (garbage output every round) is quarantined
+    by flap evidence while every other source's labels stay
+    byte-identical, and recovery is EARNED after the plugin is fixed;
+  - the pure contract logic is parity-pinned against the
+    tpufd/plugin.py twin (the same grid the C++ unit suite pins) —
+    change one side, change both.
+"""
+
+import json
+import os
+import stat
+import subprocess
+import textwrap
+
+from conftest import FIXTURES, http_get, labels_of, wait_for
+from tpufd import journal as tpufd_journal
+from tpufd import metrics
+from tpufd import plugin as plugin_lib
+from tpufd.fakes import free_loopback_port as free_port
+
+REPO = FIXTURES.parent.parent
+IN_TREE_PLUGINS = REPO / "deployments" / "plugins"
+
+# Keys that legitimately change across passes (same exclusions the
+# soaks use) plus the quarantine annotation healthsm owns.
+VOLATILE = ("google.com/tfd.timestamp", "google.com/tpu.health.probe-ms",
+            "google.com/tpu.health.quarantined")
+
+
+def write_plugin(directory, filename, body):
+    path = directory / filename
+    path.write_text(body)
+    path.chmod(path.stat().st_mode | stat.S_IXUSR | stat.S_IXGRP
+               | stat.S_IXOTH)
+    return path
+
+
+def simple_plugin(name, prefix, labels_expr):
+    """A /bin/sh tfd.probe/v1 plugin whose probe echoes `labels_expr`
+    (a JSON object literal; $-vars expand in the shell)."""
+    return textwrap.dedent(f"""\
+        #!/bin/sh
+        if [ "$TFD_PLUGIN_OP" = handshake ]; then
+          echo '{{"contract": "tfd.probe/v1", "name": "{name}",
+                 "label_prefix": "{prefix}"}}'
+          exit 0
+        fi
+        echo "{{\\"labels\\": {labels_expr}}}"
+        """)
+
+
+def launch(argv, env_extra=None):
+    env = {**os.environ, "GCE_METADATA_HOST": "127.0.0.1:1",
+           **(env_extra or {})}
+    return subprocess.Popen(argv, env=env, stderr=subprocess.DEVNULL)
+
+
+def daemon_argv(binary, port, out_file, plugin_dir=None, extra=()):
+    argv = [str(binary), "--sleep-interval=1s", "--backend=mock",
+            f"--mock-topology-file={FIXTURES / 'v2-8.yaml'}",
+            "--machine-type-file=/dev/null", "--no-timestamp",
+            f"--output-file={out_file}",
+            f"--introspection-addr=127.0.0.1:{port}"]
+    if plugin_dir is not None:
+        argv.append(f"--plugin-dir={plugin_dir}")
+    return argv + list(extra)
+
+
+def read_labels(out_file):
+    try:
+        return labels_of(out_file.read_text())
+    except (OSError, ValueError):
+        return {}
+
+
+def journal_events(port):
+    status, body = http_get(port, "/debug/journal")
+    if status != 200:
+        return []
+    try:
+        return tpufd_journal.parse_journal(json.loads(body))["events"]
+    except (ValueError, KeyError):
+        return []
+
+
+def scrape(port, name, labels=None):
+    status, text = http_get(port, "/metrics")
+    if status != 200:
+        return None
+    try:
+        return metrics.sample_value(text, name, labels=labels)
+    except ValueError:
+        return None
+
+
+class TestPluginPublish:
+    def test_plugin_labels_published_with_provenance(self, tfd_binary,
+                                                     tmp_path):
+        plugin_dir = tmp_path / "plugins"
+        plugin_dir.mkdir()
+        write_plugin(
+            plugin_dir, "chips-probe",
+            simple_plugin(
+                "chips", "google.com/tpu.plugin.chips.",
+                '{\\"google.com/tpu.plugin.chips.seen\\": '
+                '\\"$TFD_CHIP_COUNT\\"}'))
+        out_file = tmp_path / "labels"
+        port = free_port()
+        daemon = launch(daemon_argv(tfd_binary, port, out_file, plugin_dir))
+        try:
+            # TFD_CHIP_COUNT carried the mock backend's enumeration
+            # (v2-8 = 4 chips) into the plugin's environment. (An early
+            # round before the device worker settles may publish "",
+            # so wait for the settled value, not mere presence.)
+            assert wait_for(lambda: read_labels(out_file).get(
+                "google.com/tpu.plugin.chips.seen") == "4", timeout=30)
+            # Provenance names the plugin source, /debug/labels agrees
+            # with the emitted file.
+            status, body = http_get(port, "/debug/labels")
+            assert status == 200
+            doc = json.loads(body)
+            prov = doc["provenance"]["google.com/tpu.plugin.chips.seen"]
+            assert prov["labeler"] == "plugin"
+            assert prov["source"] == "plugin.chips"
+            # Discovery journaled the accepted plugin.
+            events = journal_events(port)
+            discovered = [e for e in events
+                          if e["type"] == "plugin-discovered"]
+            assert any(e["fields"].get("plugin") == "chips"
+                       for e in discovered)
+            assert scrape(port, "tfd_plugin_state",
+                          {"plugin": "chips"}) == 0.0
+            assert (scrape(port, "tfd_plugin_rounds_total",
+                           {"plugin": "chips"}) or 0) >= 1
+        finally:
+            daemon.kill()
+            daemon.wait()
+
+    def test_unknown_contract_rejected_loudly_at_discovery(
+            self, tfd_binary, tmp_path):
+        """Forward compat: a v2 plugin against this v1 daemon fails AT
+        DISCOVERY with both versions named — never mid-round."""
+        plugin_dir = tmp_path / "plugins"
+        plugin_dir.mkdir()
+        write_plugin(plugin_dir, "future-probe", textwrap.dedent("""\
+            #!/bin/sh
+            echo '{"contract": "tfd.probe/v2", "name": "future",
+                   "label_prefix": "google.com/tpu.plugin.future."}'
+            """))
+        out_file = tmp_path / "labels"
+        port = free_port()
+        daemon = launch(daemon_argv(tfd_binary, port, out_file, plugin_dir))
+        try:
+            assert wait_for(lambda: read_labels(out_file), timeout=30)
+
+            def rejected():
+                return [e for e in journal_events(port)
+                        if e["type"] == "plugin-rejected"]
+            assert wait_for(lambda: len(rejected()) > 0, timeout=10)
+            reason = rejected()[0]["fields"]["reason"]
+            assert "unknown contract version" in reason
+            assert "tfd.probe/v2" in reason
+            assert "tfd.probe/v1" in reason
+            # Never registered: no plugin labels, no probe rounds, the
+            # daemon healthy and labeling normally.
+            labels = read_labels(out_file)
+            assert not any(k.startswith("google.com/tpu.plugin.")
+                           for k in labels)
+            assert "google.com/tpu.count" in labels
+            # The rejected gauge keys by FILE name — the handshake's
+            # claimed name is untrusted before it validates.
+            assert scrape(port, "tfd_plugin_state",
+                          {"plugin": "future-probe"}) == 3.0
+        finally:
+            daemon.kill()
+            daemon.wait()
+
+
+class TestDeviceHealthPort:
+    def test_ported_plugin_golden_byte_equal(self, tfd_binary, tmp_path):
+        """The contract proof: the device-health plugin's published
+        tpu.health.* labels are byte-identical to the compiled-in
+        --device-health=full path, given the same underlying exec."""
+        fake_exec = tmp_path / "fake-health"
+        fake_exec.write_text(textwrap.dedent("""\
+            #!/bin/sh
+            echo "google.com/tpu.health.ok=true"
+            echo "google.com/tpu.health.devices=$TFD_CHIP_COUNT"
+            echo "google.com/tpu.health.device-0-ok=true"
+            echo "google.com/tpu.health.matmul-tflops=42.5"
+            echo "google.com/evil.outside=dropped-by-both"
+            """))
+        fake_exec.chmod(0o755)
+
+        def health_labels(argv_extra, plugin_dir=None, env=None):
+            out_file = tmp_path / f"labels-{len(argv_extra)}"
+            port = free_port()
+            daemon = launch(
+                daemon_argv(tfd_binary, port, out_file, plugin_dir,
+                            argv_extra), env)
+            try:
+                # Wait for an EXEC-only label: the compiled-in path
+                # publishes basic-health ok/devices from the tpu
+                # labeler immediately, before the exec overlay lands.
+                assert wait_for(
+                    lambda: "google.com/tpu.health.matmul-tflops"
+                    in read_labels(out_file), timeout=30)
+                # probe-ms is NOT exec output: it is the basic-health
+                # layer's own probe-latency measurement, emitted only
+                # by --device-health — the exec-label golden excludes
+                # it.
+                return {k: v for k, v in read_labels(out_file).items()
+                        if k.startswith("google.com/tpu.health.")
+                        and k != "google.com/tpu.health.probe-ms"}
+            finally:
+                daemon.kill()
+                daemon.wait()
+
+        compiled_in = health_labels(
+            ["--device-health=full", f"--health-exec={fake_exec}"])
+        plugin_dir = tmp_path / "plugins"
+        plugin_dir.mkdir()
+        port_source = (IN_TREE_PLUGINS / "device-health").read_text()
+        write_plugin(plugin_dir, "device-health", port_source)
+        ported = health_labels(
+            [], plugin_dir,
+            {"TFD_PLUGIN_HEALTH_EXEC": str(fake_exec)})
+
+        assert ported == compiled_in
+        # Both paths enforce the namespace: the escape line never
+        # published on either side.
+        assert "google.com/evil.outside" not in ported
+
+    def test_libtpu_caps_plugin_hermetic(self, tfd_binary, tmp_path):
+        """The genuinely new plugin: libtpu/jax versions + capability
+        bits, file stats and package metadata only."""
+        plugin_dir = tmp_path / "plugins"
+        plugin_dir.mkdir()
+        write_plugin(plugin_dir, "libtpu-caps",
+                     (IN_TREE_PLUGINS / "libtpu-caps").read_text())
+        out_file = tmp_path / "labels"
+        port = free_port()
+        daemon = launch(daemon_argv(tfd_binary, port, out_file, plugin_dir))
+        try:
+            prefix = "google.com/tpu.plugin.libtpu."
+            assert wait_for(lambda: prefix + "jax" in read_labels(out_file),
+                            timeout=30)
+            labels = read_labels(out_file)
+            assert labels[prefix + "present"] in ("true", "false")
+            assert labels[prefix + "shard-map"] in ("true", "false")
+            # jax is installed in the test environment; the value is a
+            # real version string, not "none".
+            assert labels[prefix + "jax"] != "none"
+        finally:
+            daemon.kill()
+            daemon.wait()
+
+
+class TestContainment:
+    def test_garbage_plugin_quarantined_others_stable(self, tfd_binary,
+                                                      tmp_path):
+        """A plugin emitting garbage every round is quarantined by flap
+        evidence; every OTHER source's labels stay byte-identical to a
+        no-plugin baseline; recovery is earned after the fix."""
+        out_file = tmp_path / "labels-baseline"
+        port = free_port()
+        daemon = launch(daemon_argv(tfd_binary, port, out_file))
+        try:
+            assert wait_for(
+                lambda: "google.com/tpu.count" in read_labels(out_file),
+                timeout=30)
+            baseline = {k: v for k, v in read_labels(out_file).items()
+                        if k not in VOLATILE}
+        finally:
+            daemon.kill()
+            daemon.wait()
+
+        plugin_dir = tmp_path / "plugins"
+        plugin_dir.mkdir()
+        mode_file = tmp_path / "mode"
+        mode_file.write_text("garbage")
+        write_plugin(plugin_dir, "chaos-probe", textwrap.dedent(f"""\
+            #!/bin/sh
+            if [ "$TFD_PLUGIN_OP" = handshake ]; then
+              echo '{{"contract": "tfd.probe/v1", "name": "chaos",
+                     "label_prefix": "google.com/tpu.plugin.chaos."}}'
+              exit 0
+            fi
+            if [ "$(cat {mode_file})" = garbage ]; then
+              echo 'XX not json {{{{'
+              exit 0
+            fi
+            echo '{{"labels": {{"google.com/tpu.plugin.chaos.ok": "true"}}}}'
+            """))
+        out_file = tmp_path / "labels-chaos"
+        port = free_port()
+        daemon = launch(daemon_argv(
+            tfd_binary, port, out_file, plugin_dir,
+            ["--health-flap-window=60s", "--health-flap-threshold=2",
+             "--quarantine-cooldown=2s"]))
+        try:
+            assert wait_for(
+                lambda: "google.com/tpu.count" in read_labels(out_file),
+                timeout=30)
+            # Quarantined within a few bad rounds (threshold 2).
+            assert wait_for(
+                lambda: scrape(port, "tfd_plugin_state",
+                               {"plugin": "chaos"}) == 2.0, timeout=30)
+            # Journaled as a contract violation with the kind named.
+            violations = plugin_lib.plugin_violations(journal_events(port))
+            assert any(p == "chaos" and "garbage" in kinds
+                       for p, kinds, _ in violations)
+            # Containment: every non-plugin label byte-identical to the
+            # no-plugin baseline.
+            others = {k: v for k, v in read_labels(out_file).items()
+                      if k not in VOLATILE
+                      and not k.startswith("google.com/tpu.plugin.")}
+            assert others == baseline
+            # Fix the plugin: recovery is EARNED (cooldown + clean
+            # rounds), after which its labels finally publish.
+            mode_file.write_text("good")
+            assert wait_for(
+                lambda: read_labels(out_file).get(
+                    "google.com/tpu.plugin.chaos.ok") == "true",
+                timeout=60)
+            # The gauge is set by the supervisor at round start, one
+            # round before the broker's post-round observation moves
+            # the state machine — wait a round for it to settle.
+            assert wait_for(
+                lambda: scrape(port, "tfd_plugin_state",
+                               {"plugin": "chaos"}) == 0.0, timeout=15)
+        finally:
+            daemon.kill()
+            daemon.wait()
+
+
+class TestTwinParity:
+    """The same grids the C++ unit suite pins (TestPluginHandshakeGrid /
+    TestPluginRoundValidationGrid / TestPluginConfAndSchedule) — change
+    one side, change both."""
+
+    def test_handshake_grid(self):
+        hs, err = plugin_lib.parse_handshake(json.dumps({
+            "contract": "tfd.probe/v1", "name": "libtpu-caps",
+            "label_prefix": "google.com/tpu.plugin.libtpu.",
+            "interval_s": 300, "deadline_s": 20}))
+        assert err is None
+        assert hs["name"] == "libtpu-caps"
+        assert hs["interval_s"] == 300 and hs["deadline_s"] == 20
+
+        hs, err = plugin_lib.parse_handshake(json.dumps({
+            "contract": "tfd.probe/v1", "name": "device-health",
+            "label_prefix": "google.com/tpu.health."}))
+        assert err is None and hs["interval_s"] == 0
+
+        _, err = plugin_lib.parse_handshake(json.dumps({
+            "contract": "tfd.probe/v2", "name": "future",
+            "label_prefix": "google.com/tpu.plugin.future."}))
+        assert err and "unknown contract version" in err
+        assert "tfd.probe/v2" in err and "tfd.probe/v1" in err
+
+        assert plugin_lib.parse_handshake("not json")[1]
+        assert plugin_lib.parse_handshake("[1,2]")[1]
+        for bad in ("", "Upper", "has_underscore", "-lead", "trail-",
+                    "waaaaaaaaaaaaaaaaaaaaaaaaaay-too-long-plugin-name"):
+            assert plugin_lib.parse_handshake(json.dumps({
+                "contract": "tfd.probe/v1", "name": bad,
+                "label_prefix": "google.com/tpu.plugin.x."}))[1]
+        for bad in ("", "nvidia.com/gpu.", "google.com/",
+                    "google.com/tpu.plugin.x", "google.com/bad prefix.",
+                    "google.com/-lead."):
+            assert plugin_lib.parse_handshake(json.dumps({
+                "contract": "tfd.probe/v1", "name": "x",
+                "label_prefix": bad}))[1]
+        assert plugin_lib.parse_handshake(json.dumps({
+            "contract": "tfd.probe/v1", "name": "x",
+            "label_prefix": "google.com/tpu.plugin.x.",
+            "interval_s": 86401}))[1]
+
+    def test_round_validation_grid(self):
+        hs = {"name": "x", "label_prefix": "google.com/tpu.plugin.x."}
+
+        labels, violations, ok = plugin_lib.parse_round_output(json.dumps({
+            "labels": {"google.com/tpu.plugin.x.ok": "true",
+                       "google.com/tpu.plugin.x.version": "1.2.3"},
+            "facts": {"free": "form", "n": "2"}}), hs, 32)
+        assert ok and not violations and len(labels) == 2
+
+        labels, violations, ok = plugin_lib.parse_round_output(
+            json.dumps({"facts": {"a": "b"}}), hs, 32)
+        assert ok and labels == {}
+
+        labels, violations, ok = plugin_lib.parse_round_output(
+            "}{ not json", hs, 32)
+        assert not ok and violations[0][0] == "garbage"
+
+        labels, violations, ok = plugin_lib.parse_round_output(
+            "x" * (plugin_lib.MAX_ROUND_OUTPUT_BYTES + 1), hs, 32)
+        assert not ok and violations[0][0] == "oversize"
+
+        # Budget gates the RAW count; rejected whole.
+        labels, violations, ok = plugin_lib.parse_round_output(json.dumps({
+            "labels": {"google.com/tpu.plugin.x.a": "1",
+                       "google.com/tpu.plugin.x.b": "2",
+                       "google.com/evil.escape": "3"}}), hs, 2)
+        assert not ok and violations[0][0] == "label-budget"
+        assert labels == {}
+
+        # Namespace escape drops offenders, keeps the valid keys.
+        labels, violations, ok = plugin_lib.parse_round_output(json.dumps({
+            "labels": {"google.com/tpu.plugin.x.good": "1",
+                       "google.com/tpu.perf.class": "gold",
+                       "google.com/tpu.plugin.other.key": "2"}}), hs, 32)
+        assert ok and list(labels) == ["google.com/tpu.plugin.x.good"]
+        assert sorted(kind for kind, _ in violations) == \
+            ["namespace", "namespace"]
+
+        # Key/value strictness, each its own kind; spaces dash-ified.
+        labels, violations, ok = plugin_lib.parse_round_output(json.dumps({
+            "labels": {"google.com/tpu.plugin.x.bad key": "1",
+                       "google.com/tpu.plugin.x.": "bare",
+                       "google.com/tpu.plugin.x.num": 7,
+                       "google.com/tpu.plugin.x.val": "@@@",
+                       "google.com/tpu.plugin.x.ok": "fine value"}}),
+            hs, 32)
+        assert ok and labels == {"google.com/tpu.plugin.x.ok":
+                                 "fine-value"}
+        assert len(violations) == 4
+
+    def test_conf_and_schedule_rules(self):
+        conf, err = plugin_lib.parse_plugin_conf(
+            "# operator stanza\nenabled = true\ninterval = 5m\n"
+            "deadline = 45s\n")
+        assert err is None
+        assert conf == {"enabled": True, "interval_s": 300,
+                        "deadline_s": 45}
+        assert plugin_lib.parse_plugin_conf("enabled=false\n")[0][
+            "enabled"] is False
+        assert plugin_lib.parse_plugin_conf("")[1] is None
+        assert plugin_lib.parse_plugin_conf("nonsense\n")[1]
+        assert plugin_lib.parse_plugin_conf("interval = soon\n")[1]
+        assert plugin_lib.parse_plugin_conf("color = red\n")[1]
+
+        no_conf = {"enabled": True, "interval_s": 0, "deadline_s": 0}
+        assert plugin_lib.effective_deadline_s(
+            {"deadline_s": 5}, no_conf, 30) == 5
+        assert plugin_lib.effective_deadline_s(
+            {"deadline_s": 120}, no_conf, 30) == 30
+        assert plugin_lib.effective_deadline_s(
+            {"deadline_s": 0}, no_conf, 30) == 30
+        conf120 = {"enabled": True, "interval_s": 0, "deadline_s": 120}
+        assert plugin_lib.effective_deadline_s(
+            {"deadline_s": 0}, conf120, 30) == 120
+        assert plugin_lib.effective_deadline_s(
+            {"deadline_s": 600}, conf120, 30) == 120
+        assert plugin_lib.effective_interval_s(
+            {"interval_s": 3600}, no_conf, 60) == 3600
+        assert plugin_lib.effective_interval_s(
+            {"interval_s": 1}, no_conf, 60) == 60
+        assert plugin_lib.effective_interval_s(
+            {"interval_s": 1},
+            {"enabled": True, "interval_s": 10, "deadline_s": 0},
+            60) == 10
+        # The trusted conf may quicken even below the plugin's own
+        # slow hint.
+        assert plugin_lib.effective_interval_s(
+            {"interval_s": 86400},
+            {"enabled": True, "interval_s": 300, "deadline_s": 0},
+            60) == 300

@@ -393,6 +393,32 @@ class TestTwinParity:
         assert labels[slicecoord.SLICE_HEALTHY_HOSTS] == "1"
         assert slicecoord.SLICE_CLASS not in labels
 
+        # Rejoin hysteresis (C++ TestSliceRejoinDwell parity): a host
+        # that departed 5s ago (< dwell 20) is present but NOT counted
+        # healthy; once the dwell is served it counts again; an
+        # unhealthy rejoiner is not double-counted; dwell 0 is a no-op.
+        departed = {"b": 95}
+        v = slicecoord.merge_verdict(
+            4, [report("a", True, 100), report("b", True, 100),
+                report("c", True, 100), report("d", True, 100)],
+            5, 100, departed_at=departed, rejoin_dwell_s=20)
+        assert (v["healthy_hosts"], v["degraded"], len(v["members"]),
+                v["dwelling"]) == (3, True, 4, ["b"])
+        v = slicecoord.merge_verdict(
+            4, [report("a", True, 116), report("b", True, 116),
+                report("c", True, 116), report("d", True, 116)],
+            5, 116, departed_at=departed, rejoin_dwell_s=20)
+        assert (v["healthy_hosts"], v["degraded"], v["dwelling"]) == \
+            (4, False, [])
+        v = slicecoord.merge_verdict(
+            4, [report("a", True, 100), report("b", False, 100)],
+            5, 100, departed_at=departed, rejoin_dwell_s=20)
+        assert (v["healthy_hosts"], v["dwelling"]) == (1, [])
+        v = slicecoord.merge_verdict(
+            4, [report("a", True, 100), report("b", True, 100)],
+            5, 100, departed_at=departed, rejoin_dwell_s=0)
+        assert v["healthy_hosts"] == 2
+
     def test_identity_grid(self):
         # The literals pinned on the C++ side (TestSliceIdentityDerivation).
         assert slicecoord.sanitize_slice_id("My/Pod:0") == \

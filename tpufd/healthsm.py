@@ -183,6 +183,18 @@ class HealthStateMachine:
                     now + self.policy.quarantine_cooldown_s)
         return entry.state
 
+    def note_flap_evidence(self, key, now):
+        """Mirror of healthsm::HealthTracker::NoteFlapEvidence — the
+        plugin supervisor's containment hook: one unit of flap evidence
+        from OUTSIDE the probe-verdict stream (a crash round, a
+        contract-violation round). flap_threshold of these inside the
+        window quarantine the key even though the state machine itself
+        would park in `unhealthy` on identical failures."""
+        entry = self._entries.setdefault(key, _Entry())
+        self._prune(entry, now)
+        self._note_flap(key, entry, now)
+        return entry.state
+
     def _prune(self, entry, now):
         cutoff = now - self.policy.flap_window_s
         entry.flap_times = [t for t in entry.flap_times if t >= cutoff]

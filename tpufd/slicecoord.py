@@ -131,14 +131,21 @@ def lease_expired(lease, now):
     return now - lease.get("renewed_at", 0) > lease["duration_s"]
 
 
-def merge_verdict(num_hosts, reports, agreement_timeout_s, now):
+def merge_verdict(num_hosts, reports, agreement_timeout_s, now,
+                  departed_at=None, rejoin_dwell_s=0):
     """The leader's merge: reports = [{host, healthy, at, class?}].
     Present = heard from within the agreement window; a stale/missing
-    member degrades the slice. Returns {hosts, healthy_hosts, degraded,
-    class, members}."""
+    member degrades the slice. Rejoin hysteresis (C++ MergeVerdict
+    parity): a present healthy host whose ``departed_at[host]`` is
+    younger than ``rejoin_dwell_s`` counts as a member but NOT healthy
+    — a crash-looper cannot flap healthy-hosts once per restart.
+    Returns {hosts, healthy_hosts, degraded, class, members,
+    dwelling}."""
+    departed_at = departed_at or {}
     members = set()
     healthy = 0
     worst = -1
+    dwelling = []
     for report in reports:
         at = report.get("at", 0)
         if at <= 0 or now - at > agreement_timeout_s:
@@ -146,7 +153,13 @@ def merge_verdict(num_hosts, reports, agreement_timeout_s, now):
         if report["host"] in members:
             continue
         members.add(report["host"])
-        if report.get("healthy"):
+        is_healthy = bool(report.get("healthy"))
+        if (is_healthy and rejoin_dwell_s > 0
+                and report["host"] in departed_at
+                and now - departed_at[report["host"]] < rejoin_dwell_s):
+            is_healthy = False
+            dwelling.append(report["host"])
+        if is_healthy:
             healthy += 1
         rank = CLASS_RANKS.get(report.get("class") or "", -1)
         worst = max(worst, rank)
@@ -156,6 +169,7 @@ def merge_verdict(num_hosts, reports, agreement_timeout_s, now):
         "degraded": healthy < num_hosts,
         "class": RANK_NAMES.get(worst, ""),
         "members": sorted(members),
+        "dwelling": sorted(dwelling),
     }
 
 

@@ -508,6 +508,9 @@ def steady_pass_durations(out_file, force_slow, passes_wanted=12,
     if force_slow:
         env["TFD_FORCE_SLOW_PASS"] = "1"
     args = [str(BINARY), "--sleep-interval=1s", "--backend=mock",
+            # Prices the per-interval pass pipeline (the machinery event
+            # mode still runs on every wakeup): legacy loop pinned.
+            "--event-driven=false",
             "--mock-topology-file="
             f"{REPO / 'tests/fixtures/v5p-128-worker3.yaml'}",
             "--slice-strategy=mixed", "--machine-type-file=/dev/null",
@@ -636,6 +639,7 @@ def perf_record():
 
         def argv(port):
             return [str(BINARY), "--sleep-interval=1s", "--backend=mock",
+                    "--event-driven=false",  # cadence-counted scenario
                     f"--mock-topology-file={fixture}",
                     "--machine-type-file=/dev/null",
                     f"--output-file={out_file}",

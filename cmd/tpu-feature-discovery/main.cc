@@ -21,6 +21,7 @@
 #include <string.h>
 
 #include <algorithm>
+#include <atomic>
 #include <chrono>
 #include <cstdio>
 #include <cstdlib>
@@ -36,6 +37,7 @@
 #include "tfd/k8s/breaker.h"
 #include "tfd/k8s/client.h"
 #include "tfd/k8s/desync.h"
+#include "tfd/k8s/watch.h"
 #include "tfd/lm/fragments.h"
 #include "tfd/lm/governor.h"
 #include "tfd/lm/labeler.h"
@@ -57,6 +59,7 @@
 #include "tfd/sched/snapshot.h"
 #include "tfd/sched/sources.h"
 #include "tfd/sched/state.h"
+#include "tfd/sched/wakeup.h"
 #include "tfd/slice/coord.h"
 #include "tfd/util/file.h"
 #include "tfd/util/jsonlite.h"
@@ -177,6 +180,28 @@ struct LabelState {
   double restored_downtime_s = 0;      // crash-to-restart gap at load
 };
 
+// What the sink currently holds, shared with the CR watcher thread so
+// it can tell a self-echo watch event (spec.labels == what we last
+// published) from foreign drift. The pass loop writes after every
+// landed pass; the watcher only reads.
+struct PublishedLabelsView {
+  std::mutex mu;
+  bool valid = false;
+  lm::Labels labels;
+
+  void Set(const lm::Labels& published) {
+    std::lock_guard<std::mutex> lock(mu);
+    labels = published;
+    valid = true;
+  }
+  bool Get(lm::Labels* out) {
+    std::lock_guard<std::mutex> lock(mu);
+    if (!valid) return false;
+    *out = labels;
+    return true;
+  }
+};
+
 // ---- pass planning (the hot path) ----------------------------------------
 // Every pass first decides how much work it owes. The planner digests
 // the pass's inputs — per-source snapshot fingerprints and tiers
@@ -264,6 +289,21 @@ bool ForceSlowPassEnv() {
   return forced;
 }
 
+// ---- event-driven core shared state ---------------------------------------
+// The CR watch's health, read by the anti-entropy cadence below and
+// written by the watcher thread (k8s/watch.h on_health).
+std::atomic<bool> g_watch_healthy{false};
+// Watch-delivered foreign CR drift pending a heal pass: detection wall
+// time (0 = none). The watcher thread sets it; the pass loop consumes
+// it (invalidates the sink state so the next pass re-asserts).
+std::atomic<double> g_watch_drift_at{0};
+
+// With a HEALTHY watch the anti-entropy refresh is redundant as a
+// drift/outage detector (the watch sees both in milliseconds), so it is
+// demoted to a low-frequency self-check — still a real reconciling
+// write, just no longer the latency-critical path.
+constexpr double kWatchSelfCheckFloorS = 600;
+
 // Anti-entropy refresh cadence for skipped sink writes: even a
 // perfectly clean steady state re-writes the sink this often — a full
 // reconcile for the CR sink — so an externally deleted NodeFeature CR
@@ -272,8 +312,28 @@ bool ForceSlowPassEnv() {
 // refresh period (the write doubles as the sink liveness probe).
 // The base period (--sink-refresh, auto max(60s, 2.5x interval)) is
 // stretched per node by the fleet desync hash so a rollout's refresh
-// clocks drift apart instead of herding the apiserver.
+// clocks drift apart instead of herding the apiserver. While the CR
+// WATCH is healthy, drift and outages surface in milliseconds from the
+// watch instead, and the refresh is demoted to a >= 10 min self-check.
 double SinkRefreshSeconds(const config::Flags& flags) {
+  double base = flags.sink_refresh_s > 0
+                    ? flags.sink_refresh_s
+                    : std::max(60.0, 2.5 * flags.sleep_interval_s);
+  if (flags.use_node_feature_api && flags.sink_watch &&
+      g_watch_healthy.load(std::memory_order_relaxed)) {
+    base = std::max(base, kWatchSelfCheckFloorS);
+  }
+  static const std::string node_key = k8s::desync::NodeKey();
+  return k8s::desync::RefreshPeriodS(base, node_key,
+                                     flags.cadence_jitter_pct);
+}
+
+// The HOST-refresh cadence (machine-type / tpu-vm fragment re-render)
+// deliberately does NOT take the watch demotion: the CR watch covers
+// drift of the CR, not of the metadata/DMI reads behind the host
+// fragments — a transient machine=unknown must still heal within the
+// ORIGINAL refresh window even while the watch is healthy.
+double HostRefreshSeconds(const config::Flags& flags) {
   double base = flags.sink_refresh_s > 0
                     ? flags.sink_refresh_s
                     : std::max(60.0, 2.5 * flags.sleep_interval_s);
@@ -450,7 +510,7 @@ PassPlan PlanPass(const config::Config& config,
     return plan;
   }
   if (now_wall - cache->host_refresh_wall >=
-      SinkRefreshSeconds(config.flags)) {
+      HostRefreshSeconds(config.flags)) {
     // The host-derived labelers' reads are live IO; re-render them on
     // the anti-entropy cadence so a transiently degraded read
     // (machine-type=unknown during a metadata blip) heals instead of
@@ -544,6 +604,7 @@ Status DispatchSink(const config::Config& config, const lm::Labels& labels,
     cluster->request_deadline_ms =
         config.flags.sink_request_deadline_s * 1000;
     cluster->use_patch = config.flags.sink_patch;
+    cluster->use_apply = config.flags.sink_apply;
     if (anti_entropy) k8s::DefaultSinkState().Invalidate();
     out = k8s::UpdateNodeFeature(*cluster, labels, &transient, nullptr,
                                  &wire);
@@ -1313,7 +1374,7 @@ Status LabelOnce(const config::Config& config, int config_generation,
   bool refresh_host =
       plan.mode == PassMode::kFull ||
       WallClockSeconds() - cache->host_refresh_wall >=
-          SinkRefreshSeconds(config.flags);
+          HostRefreshSeconds(config.flags);
   Status s = LabelOnceInner(config, config_generation, timestamp,
                             machine_type, tpu_vm, store, decision, plan,
                             refresh_host, cache, breaker, *state,
@@ -1469,6 +1530,141 @@ void WriteDebugDump(const config::Config& config,
   }
 }
 
+// ---- event-driven wait (sched/wakeup.h) -----------------------------------
+
+void CountWakeup(const char* reason) {
+  obs::Default()
+      .GetCounter("tfd_pass_wakeups_total",
+                  "Event-driven pass-loop wakeups, by source: probe-"
+                  "snapshot movement, watch-delivered CR drift, config-"
+                  "input inotify, a collected signal, or a deadline "
+                  "timer (anti-entropy refresh, state re-save, tier "
+                  "boundary, busy-state interval cadence).",
+                  {{"reason", reason}})
+      ->Inc();
+}
+
+// Whether a deadline wake actually owes a pass. Probe workers keep
+// probing between passes; every clean landing silently pushes the tier
+// boundary out, so a deadline computed at park time is often stale by
+// the time it fires. Re-checking here (instead of running a pass to
+// find out) is what keeps a quiet daemon at ZERO passes between events.
+bool DeadlineOwesPass(const config::Config& config,
+                      const sched::SnapshotStore& store,
+                      const PassCache& cache, double now_wall) {
+  const config::Flags& flags = config.flags;
+  if (now_wall - cache.last_real_write_wall >= SinkRefreshSeconds(flags)) {
+    return true;
+  }
+  if (now_wall - cache.host_refresh_wall >= HostRefreshSeconds(flags)) {
+    return true;
+  }
+  if (!flags.state_file.empty() &&
+      now_wall - cache.saved_state_wall >= StateRefreshSeconds(flags)) {
+    return true;
+  }
+  // An age-driven tier lapse dirties the pass signature with no probe
+  // write to announce it.
+  for (const sched::SourceGeneration& gen : store.Generations()) {
+    for (const sched::SourceGeneration& cached : cache.sources) {
+      if (cached.source == gen.source) {
+        if (cached.tier != gen.tier) return true;
+        break;
+      }
+    }
+  }
+  return false;
+}
+
+// Parks the event-driven loop until work is owed. Returns 0 to run a
+// pass, or the signal the caller must handle (SIGHUP includes a
+// config-input inotify change — same reload semantics). While any
+// interval-shaped contract is live (degraded snapshot-age ticking,
+// governor hold-downs, quarantine cooldowns, a pending sink retry, the
+// restored rung, forced-slow CI, an armed fault spec) the wait falls
+// back to the legacy jittered interval so those contracts tick exactly
+// as before; a QUIET daemon sleeps until the next real event or
+// deadline and runs nothing in between.
+int EventWait(const config::Config& config, const sched::SnapshotStore& store,
+              lm::LabelGovernor* governor, LabelState* state,
+              PassCache* cache, sched::WakeupMux* mux,
+              const std::string& desync_node, uint64_t* tick) {
+  using Reason = sched::WakeupMux::Reason;
+  while (true) {
+    double now_wall = WallClockSeconds();
+    ServeDecision decision = Decide(store, config.flags);
+    const bool busy =
+        ForceSlowPassEnv() || cache->retry_pending || !cache->valid ||
+        state->restored.has_value() || decision.degraded_labels ||
+        decision.all_expired || governor->PendingSuppressions() ||
+        !healthsm::Default().QuarantinedKeys(now_wall).empty() ||
+        !config.flags.fault_spec.empty();
+    double wait_s;
+    if (busy) {
+      wait_s = k8s::desync::JitteredIntervalS(
+          config.flags.sleep_interval_s, desync_node, *tick,
+          config.flags.cadence_jitter_pct);
+      (*tick)++;
+    } else {
+      wait_s = SinkRefreshSeconds(config.flags) -
+               (now_wall - cache->last_real_write_wall);
+      wait_s = std::min(wait_s,
+                        HostRefreshSeconds(config.flags) -
+                            (now_wall - cache->host_refresh_wall));
+      if (!config.flags.state_file.empty()) {
+        wait_s = std::min(wait_s,
+                          StateRefreshSeconds(config.flags) -
+                              (now_wall - cache->saved_state_wall));
+      }
+      double tier_in = store.SecondsUntilTierChange();
+      if (tier_in >= 0) wait_s = std::min(wait_s, tier_in);
+      wait_s = std::max(0.05, std::min(wait_s, 3600.0));
+    }
+    sched::WakeupMux::WakeResult wake = mux->Wait(wait_s);
+    if (wake.reasons & static_cast<uint32_t>(Reason::kSnapshot)) {
+      CountWakeup("snapshot");
+    }
+    if (wake.reasons & static_cast<uint32_t>(Reason::kWatchDrift)) {
+      CountWakeup("watch-drift");
+    }
+    if (wake.reasons & static_cast<uint32_t>(Reason::kInotify)) {
+      CountWakeup("inotify");
+    }
+    if (wake.reasons & static_cast<uint32_t>(Reason::kSignal)) {
+      CountWakeup("signal");
+    } else if (wake.reasons == static_cast<uint32_t>(Reason::kDeadline)) {
+      CountWakeup("deadline");
+    }
+    if (wake.reasons & static_cast<uint32_t>(Reason::kSignal)) {
+      if (wake.signal == SIGUSR1) {
+        WriteDebugDump(config, store, *state);
+        continue;  // an operator dump must not trigger a pass
+      }
+      return wake.signal;
+    }
+    if (wake.reasons & static_cast<uint32_t>(Reason::kInotify)) {
+      // A config-load-time byte input (config file, plugin dir) changed
+      // on disk: reload exactly as a SIGHUP would.
+      obs::DefaultJournal().Record(
+          "config-input-changed", "",
+          "config input changed on disk; reloading",
+          {{"paths", JoinStrings(wake.changed_paths, ",")}});
+      return SIGHUP;
+    }
+    if (wake.reasons & (static_cast<uint32_t>(Reason::kSnapshot) |
+                        static_cast<uint32_t>(Reason::kWatchDrift))) {
+      return 0;
+    }
+    // Deadline-only wake: run a pass only when a timed contract is
+    // actually due — probe landings between parks push the boundaries
+    // out silently. (A busy loop always owes its interval pass.)
+    if (busy ||
+        DeadlineOwesPass(config, store, *cache, WallClockSeconds())) {
+      return 0;
+    }
+  }
+}
+
 // Serves the restored persisted state as one full rewrite pass:
 // cached-tier labels with the TRUE snapshot age (`age_s`, persisted age
 // + downtime so far). Used twice: as the warm-restart FIRST pass (in
@@ -1581,7 +1777,8 @@ RunOutcome Run(const config::Config& config, int config_generation,
                const sigset_t& sigmask, obs::IntrospectionServer* server,
                k8s::CircuitBreaker* breaker,
                lm::LabelGovernor* governor, LabelState* state,
-               PassCache* cache, uint64_t* tick) {
+               PassCache* cache, uint64_t* tick, sched::WakeupMux* mux,
+               PublishedLabelsView* published) {
   // Labeler instances (below) are rebuilt per run — a failed reload
   // re-enters under the SAME config generation but with a fresh
   // timestamp — so cached fragments and published bytes must die here.
@@ -1610,6 +1807,69 @@ RunOutcome Run(const config::Config& config, int config_generation,
     store->WaitAllSettled(kFirstPassSettleWait);
   }
 
+  // Event-driven core (sched/wakeup.h): probe-snapshot movement wakes
+  // the loop, the config file and plugin dir are inotify-watched, and
+  // the fixed-interval sleep below is replaced with a deadline-computed
+  // park. The legacy loop remains behind --event-driven=false (and as
+  // the fallback when the mux could not initialize).
+  const bool event_mode = !config.flags.oneshot &&
+                          config.flags.event_driven && mux != nullptr &&
+                          mux->initialized();
+  if (event_mode) {
+    store->SetMovementCallback([mux] {
+      mux->Notify(sched::WakeupMux::Reason::kSnapshot);
+    });
+    if (!config.flags.config_file.empty()) {
+      mux->WatchPath(config.flags.config_file);
+    }
+    if (!config.flags.plugin_dir.empty()) {
+      mux->WatchPath(config.flags.plugin_dir);
+    }
+  }
+
+  // The NodeFeature CR watcher (k8s/watch.h): external drift and
+  // apiserver outages surface in milliseconds. Runs with or without the
+  // event mux — in legacy mode drift is consumed at the next tick.
+  g_watch_healthy.store(false);
+  std::unique_ptr<k8s::NodeFeatureWatcher> watcher;
+  if (!config.flags.oneshot && config.flags.use_node_feature_api &&
+      config.flags.sink_watch) {
+    Result<k8s::ClusterConfig> cluster = k8s::LoadInClusterConfig();
+    if (cluster.ok()) {
+      cluster->request_deadline_ms =
+          config.flags.sink_request_deadline_s * 1000;
+      k8s::WatcherOptions watch_options;
+      if (const char* env = std::getenv("TFD_WATCH_TIMEOUT_S")) {
+        // Test hook: short server-side rotations so watch drills don't
+        // wait minutes for a session boundary.
+        int t = atoi(env);
+        if (t > 0) {
+          watch_options.timeout_s = t;
+          watch_options.read_timeout_ms = (t + 30) * 1000;
+        }
+      }
+      watcher = std::make_unique<k8s::NodeFeatureWatcher>(
+          *cluster, watch_options,
+          [published](lm::Labels* out) { return published->Get(out); },
+          [mux, event_mode](const std::string& reason) {
+            (void)reason;
+            double expected = 0;
+            g_watch_drift_at.compare_exchange_strong(expected,
+                                                     WallClockSeconds());
+            if (event_mode && mux != nullptr) {
+              mux->Notify(sched::WakeupMux::Reason::kWatchDrift);
+            }
+          },
+          [](bool healthy) {
+            g_watch_healthy.store(healthy, std::memory_order_relaxed);
+          });
+      watcher->Start();
+    } else {
+      TFD_LOG_WARNING << "NodeFeature CR watch disabled: "
+                      << cluster.error();
+    }
+  }
+
   bool cleanup_output = !config.flags.oneshot &&
                         !config.flags.output_file.empty();
   // Fleet cadence desync (k8s/desync.h): a deterministic
@@ -1623,7 +1883,23 @@ RunOutcome Run(const config::Config& config, int config_generation,
   // SIGHUP must not re-apply the one-time phase offset and stretch the
   // reloaded config's first pass by up to a whole extra interval.
   const std::string desync_node = k8s::desync::NodeKey();
+  // A consumed-but-not-yet-healed drift (the heal pass's write may fail
+  // transiently): carried until a pass LANDS so the heal record isn't
+  // lost, while the global slot is already free to catch the NEXT drift.
+  double pending_drift_at = 0;
   while (true) {
+    // Watch-delivered foreign drift: someone moved/deleted the CR under
+    // us. CONSUME the slot (exchange, not load) so a second drift that
+    // lands while this heal pass runs can re-arm it — then forget the
+    // cached sink/pass state so THIS pass re-reads the server's truth
+    // and re-asserts the labels (under SSA, one apply).
+    const double drift_newly = g_watch_drift_at.exchange(0);
+    if (drift_newly > 0) {
+      if (pending_drift_at == 0) pending_drift_at = drift_newly;
+      k8s::DefaultSinkState().Invalidate();
+      cache->valid = false;
+      cache->sink_holds_published = false;
+    }
     // The restored rung: while probes are still wedged/failing after a
     // warm restart and NO snapshot can serve, keep re-serving the
     // restored cached facts (with their growing age) instead of
@@ -1666,46 +1942,66 @@ RunOutcome Run(const config::Config& config, int config_generation,
       TFD_LOG_ERROR << s.message();
       return RunOutcome::kError;
     }
+    // Keep the watcher's self-echo reference current, and close out a
+    // watch-drift heal once the re-asserting pass actually LANDED
+    // (cache->valid: the pass cache describes a landed pass again).
+    if (!state->labels.empty()) published->Set(state->labels);
+    if (pending_drift_at > 0 && (cache->valid || served_restored)) {
+      double heal_ms = (WallClockSeconds() - pending_drift_at) * 1000.0;
+      pending_drift_at = 0;
+      obs::DefaultJournal().Record(
+          "watch-drift-healed", "cr",
+          "external CR drift healed by re-assertion",
+          {{"heal_ms", std::to_string(static_cast<long long>(heal_ms))}});
+    }
     if (config.flags.oneshot) return RunOutcome::kExit;
 
-    // Sleep, interruptibly: SIGHUP → reload config and restart the loop;
-    // SIGUSR1 → write the post-mortem dump and keep sleeping the
-    // remainder; SIGINT/SIGTERM/SIGQUIT → clean exit (reference
-    // main.go:198-217).
-    double sleep_s = k8s::desync::JitteredIntervalS(
-        config.flags.sleep_interval_s, desync_node, *tick,
-        config.flags.cadence_jitter_pct);
-    if (*tick == 0) {
-      sleep_s += k8s::desync::PhaseOffsetS(config.flags.sleep_interval_s,
-                                           desync_node,
-                                           config.flags.cadence_jitter_pct);
-    }
-    (*tick)++;
-    auto sleep_until =
-        std::chrono::steady_clock::now() +
-        std::chrono::milliseconds(static_cast<long long>(sleep_s * 1000));
     int sig = 0;
-    while (true) {
-      auto now = std::chrono::steady_clock::now();
-      if (now >= sleep_until) {
-        sig = 0;
+    if (event_mode) {
+      // Event-driven park: zero passes until an event or a due
+      // deadline (sched/wakeup.h); signals (and config-input inotify,
+      // folded into SIGHUP) surface here.
+      sig = EventWait(config, *store, governor, state, cache, mux,
+                      desync_node, tick);
+    } else {
+      // Legacy fixed-interval sleep, interruptibly: SIGHUP → reload
+      // config and restart the loop; SIGUSR1 → write the post-mortem
+      // dump and keep sleeping the remainder; SIGINT/SIGTERM/SIGQUIT →
+      // clean exit (reference main.go:198-217).
+      double sleep_s = k8s::desync::JitteredIntervalS(
+          config.flags.sleep_interval_s, desync_node, *tick,
+          config.flags.cadence_jitter_pct);
+      if (*tick == 0) {
+        sleep_s += k8s::desync::PhaseOffsetS(
+            config.flags.sleep_interval_s, desync_node,
+            config.flags.cadence_jitter_pct);
+      }
+      (*tick)++;
+      auto sleep_until =
+          std::chrono::steady_clock::now() +
+          std::chrono::milliseconds(static_cast<long long>(sleep_s * 1000));
+      while (true) {
+        auto now = std::chrono::steady_clock::now();
+        if (now >= sleep_until) {
+          sig = 0;
+          break;
+        }
+        auto left = std::chrono::duration_cast<std::chrono::nanoseconds>(
+            sleep_until - now);
+        timespec deadline{};
+        deadline.tv_sec = left.count() / 1000000000LL;
+        deadline.tv_nsec = left.count() % 1000000000LL;
+        sig = sigtimedwait(&sigmask, nullptr, &deadline);
+        if (sig < 0) {  // EAGAIN: interval elapsed → relabel
+          sig = 0;
+          break;
+        }
+        if (sig == SIGUSR1) {
+          WriteDebugDump(config, *store, *state);
+          continue;  // an operator dump must not perturb the cadence
+        }
         break;
       }
-      auto left = std::chrono::duration_cast<std::chrono::nanoseconds>(
-          sleep_until - now);
-      timespec deadline{};
-      deadline.tv_sec = left.count() / 1000000000LL;
-      deadline.tv_nsec = left.count() % 1000000000LL;
-      sig = sigtimedwait(&sigmask, nullptr, &deadline);
-      if (sig < 0) {  // EAGAIN: interval elapsed → relabel
-        sig = 0;
-        break;
-      }
-      if (sig == SIGUSR1) {
-        WriteDebugDump(config, *store, *state);
-        continue;  // an operator dump must not perturb the cadence
-      }
-      break;
     }
     if (sig == 0) continue;
     if (sig == SIGHUP) {
@@ -1886,6 +2182,19 @@ int Main(int argc, char** argv) {
   // Desync tick counter: the one-time rollout phase offset is per
   // PROCESS, not per config load (see Run).
   uint64_t desync_tick = 0;
+  // Event-driven wakeup multiplexer: process-lifetime fds (eventfd +
+  // signalfd + inotify); Run() decides per config load whether to park
+  // on it or run the legacy interval loop. An init failure falls back
+  // to the legacy loop, loudly.
+  sched::WakeupMux wakeup_mux;
+  if (Status mux_init = wakeup_mux.Init(sigmask); !mux_init.ok()) {
+    TFD_LOG_WARNING << "wakeup multiplexer unavailable ("
+                    << mux_init.message()
+                    << "); falling back to the interval loop";
+  }
+  // What the sink last landed, shared with the CR watcher thread so it
+  // can tell self-echo watch events from foreign drift.
+  PublishedLabelsView published_view;
   k8s::CircuitBreaker sink_breaker;
   // The anti-flap governor's hold-down history also survives reloads:
   // a SIGHUP must not grant every key a free flip.
@@ -2127,7 +2436,8 @@ int Main(int argc, char** argv) {
 
     switch (Run(loaded.config, config_generation, sigmask, server.get(),
                 &sink_breaker, &label_governor, &label_state,
-                &pass_cache, &desync_tick)) {
+                &pass_cache, &desync_tick, &wakeup_mux,
+                &published_view)) {
       case RunOutcome::kExit:
         TFD_LOG_INFO << "exiting";
         return 0;

@@ -47,6 +47,14 @@ ported device-health plugin golden byte-equal to the compiled-in path,
 and the steady no-op p50 with two plugins registered under the
 absolute budget and within slack of the committed BENCH_r11.json.
 
+Watch mode (ISSUE 12): `--watch RECORD.json` gates an event-driven
+watch-soak record (scripts/fleet_soak.py --watch --json) — ZERO rewrite
+passes fleet-wide across the quiet window, external-drift heal p99
+<= 2s (absolute), the mass-watch-drop reconnect storm drained through
+Retry-After pacing with zero breaker opens and no re-herding retry
+wave, and the heal/convergence latencies within slack of the committed
+BENCH_r12.json.
+
 Usage:
   python3 scripts/bench_gate.py [--reference BENCH_r07.json]
       [--noop-budget-us 1000] [--dirty-slack 0.25]
@@ -295,6 +303,75 @@ def plugin_gate(record_path, reference_path, noop_budget_us, slack):
     return problems
 
 
+def watch_gate(record_path, reference_path, slack):
+    """Gates an event-driven watch-soak record (scripts/fleet_soak.py
+    --watch --json): the zero-quiet-pass assertion and the reconnect-
+    storm invariants are ABSOLUTE (a quiet daemon that still runs
+    passes, or a storm that opens breakers, is the regression the
+    tentpole exists to prevent); drift-heal and convergence latencies
+    are gated absolutely (the acceptance bounds) and against the
+    committed BENCH_r12.json. Absent keys FAIL loudly."""
+    with open(record_path) as f:
+        record = json.load(f)
+    problems = []
+
+    quiet = record.get("quiet_total_passes")
+    if quiet is None:
+        problems.append("watch record has no quiet_total_passes")
+    elif quiet != 0:
+        problems.append(
+            f"{quiet} rewrite passes ran across the fleet during the "
+            "quiet window (event-driven steady state must be ZERO)")
+    heal = record.get("drift_heal_p99_ms")
+    if heal is None:
+        problems.append("watch record has no drift_heal_p99_ms")
+    elif heal > 2000.0:
+        problems.append(
+            f"external-drift heal p99 {heal}ms exceeds the 2s acceptance "
+            "bound (was >= 60s pre-watch; the whole point)")
+    opens = record.get("storm_breaker_opens")
+    if opens is None:
+        problems.append("watch record has no storm_breaker_opens")
+    elif opens != 0:
+        problems.append(
+            f"the reconnect storm opened {opens} breaker(s): Retry-After "
+            "pacing must read as a live server")
+    if record.get("storm_undrained", 1) != 0:
+        problems.append(
+            f"{record.get('storm_undrained')} daemon(s) never "
+            "re-established their watch after the storm")
+    frac = record.get("storm_worst_1s_bucket_frac")
+    if frac is None:
+        problems.append("watch record has no storm_worst_1s_bucket_frac")
+    elif frac > 0.25:
+        problems.append(
+            f"worst reconnect-retry second saw {frac:.0%} of the fleet "
+            "(Retry-After pacing failed to spread the herd)")
+    converge = record.get("partition_converge_p99_s")
+    if converge is None:
+        problems.append("watch record has no partition_converge_p99_s")
+
+    try:
+        with open(reference_path) as f:
+            ref = json.load(f)
+    except (OSError, ValueError) as e:
+        problems.append(f"watch reference {reference_path} unreadable: {e}")
+        return problems
+    for key, label in (
+            ("drift_heal_p99_ms", "external-drift heal p99"),
+            ("partition_converge_p99_s",
+             "convergence-after-partition p99")):
+        got, want = record.get(key), ref.get(key)
+        if got is None or want is None:
+            problems.append(f"{key} missing from record or reference")
+        elif want > 0 and got > want * (1.0 + slack):
+            problems.append(
+                f"{label} {got} regressed past "
+                f"{want * (1.0 + slack):.2f} (reference {want} "
+                f"+{int(slack * 100)}%)")
+    return problems
+
+
 def reference_dirty_p50_ms(path):
     """steady_dirty_p50_ms from a committed bench record (either the
     bare record or the driver's {parsed: ...} wrapper)."""
@@ -331,6 +408,14 @@ def main(argv=None):
                     default=os.path.join(repo, "BENCH_r10.json"))
     # Latencies ride protocol constants + a shared CI box's scheduling.
     ap.add_argument("--slice-slack", type=float, default=0.5)
+    ap.add_argument("--watch", metavar="RECORD.json",
+                    help="gate this event-driven watch-soak record "
+                         "(scripts/fleet_soak.py --watch --json)")
+    ap.add_argument("--watch-reference",
+                    default=os.path.join(repo, "BENCH_r12.json"))
+    # Latencies are virtual-clock (seeded simulation), so the slack only
+    # absorbs intentional model changes, not CI noise.
+    ap.add_argument("--watch-slack", type=float, default=0.5)
     ap.add_argument("--plugin", metavar="RECORD.json",
                     help="gate this probe-plugin containment soak record "
                          "(scripts/plugin_soak.py --json)")
@@ -374,6 +459,16 @@ def main(argv=None):
                 print(f"fleet bench gate FAILED: {p}", file=sys.stderr)
             return 1
         print("fleet bench gate OK")
+        return 0
+
+    if args.watch:
+        problems = watch_gate(args.watch, args.watch_reference,
+                              args.watch_slack)
+        if problems:
+            for p in problems:
+                print(f"watch bench gate FAILED: {p}", file=sys.stderr)
+            return 1
+        print("watch bench gate OK")
         return 0
 
     if args.slice:

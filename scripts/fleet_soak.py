@@ -1,6 +1,7 @@
 #!/usr/bin/env python3
 """Cluster-in-a-box fleet soak: ~1000 simulated daemon sink loops vs one
-fake apiserver (ISSUE 8).
+fake apiserver (ISSUE 8), plus the 10k event-driven watch-mode
+simulation (`--watch`, ISSUE 12 — see watch_soak below).
 
 What a 50k-node cluster does to one apiserver cannot be rehearsed with
 one daemon process, so this harness simulates the fleet: every node is a
@@ -332,6 +333,431 @@ def golden_check(seed, steps=12):
     return True, ""
 
 
+# ---- watch-mode simulation (ISSUE 12) ------------------------------------
+#
+# 10k event-driven daemons cannot be rehearsed over real sockets (10k
+# live watch streams = 10k parked threads), so the watch soak runs on a
+# VIRTUAL clock: a seeded discrete-event simulation of the sharded
+# apiserver's watch fan-out and the daemons' event-driven loops, built
+# from the same tpufd.sink twins (ApplySink ladder, Breaker,
+# spread_retry_after_s desync math) the parity tests pin against the
+# C++. Wire-level truth — chunked watch framing, SSA semantics, 410
+# resync — is pinned separately by tests/test_fleet.py against the real
+# fake apiserver and by the C++ unit suites; THIS harness proves the
+# fleet-scale emergent behavior: zero quiet passes, millisecond drift
+# heal, a Retry-After-paced reconnect storm that drains without breaker
+# flap, and bounded convergence after a partition.
+
+
+class SimClock:
+    """Discrete-event loop: schedule(t, fn) then run(until)."""
+
+    def __init__(self):
+        self.heap = []
+        self.seq = 0
+        self.now = 0.0
+
+    def schedule(self, t, fn):
+        self.seq += 1
+        heapq.heappush(self.heap, (t, self.seq, fn))
+
+    def run(self, until):
+        while self.heap and self.heap[0][0] <= until:
+            t, _, fn = heapq.heappop(self.heap)
+            self.now = max(self.now, t)
+            fn(self.now)
+        self.now = until
+
+
+class SimApiServer:
+    """Sharded store + watch fan-out. Each shard owns its objects, its
+    per-second request accounting, and (during the storm) its watch
+    (re-)establishment capacity."""
+
+    def __init__(self, clock, shards, rng):
+        self.clock = clock
+        self.shards = shards
+        self.rng = rng
+        self.objects = {}     # name -> {labels, rv, managers}
+        self.watchers = {}    # name -> SimDaemon
+        self.buckets = collections.Counter()   # int(t) -> requests
+        self.by_verb = collections.Counter()
+        self.watch_capacity = 0  # per shard per second (0 = unlimited)
+        self.watch_buckets = collections.Counter()  # (shard, sec) -> n
+        self.partitioned = set()  # names whose daemon lost connectivity
+
+    def shard_of(self, name):
+        return sinklib.fnv1a64(name) % self.shards
+
+    def _wire_latency(self):
+        return self.rng.uniform(0.0005, 0.003)
+
+    def count(self, t, verb):
+        self.buckets[int(t)] += 1
+        self.by_verb[verb] += 1
+
+    def apply(self, t, name, labels, manager="tfd"):
+        """SSA write from a daemon: tfd-owned keys replaced, foreign
+        managers' keys preserved. Returns the new rv."""
+        self.count(t, "APPLY")
+        obj = self.objects.setdefault(
+            name, {"labels": {}, "rv": 0, "managers": {}})
+        owned = obj["managers"].setdefault(manager, set())
+        for key in owned - set(labels):
+            obj["labels"].pop(key, None)
+        for key, value in labels.items():
+            obj["labels"][key] = value
+            for other, keys in obj["managers"].items():
+                if other != manager:
+                    keys.discard(key)
+        obj["managers"][manager] = set(labels)
+        obj["rv"] += 1
+        self._fanout(t, name, "MODIFIED" if obj["rv"] > 1 else "ADDED")
+        return obj["rv"]
+
+    def edit(self, t, name, key, value):
+        """Foreign drift: another manager moves one of OUR keys (value
+        override) — the heal drill's injection."""
+        obj = self.objects[name]
+        obj["labels"][key] = value
+        for keys in obj["managers"].values():
+            keys.discard(key)
+        obj["managers"].setdefault("chaos", set()).add(key)
+        obj["rv"] += 1
+        self._fanout(t, name, "MODIFIED")
+
+    def delete(self, t, name):
+        obj = self.objects.pop(name, None)
+        if obj is not None:
+            self._fanout(t, name, "DELETED")
+
+    def _fanout(self, t, name, event_type):
+        daemon = self.watchers.get(name)
+        if daemon is None or name in self.partitioned:
+            return
+        obj = self.objects.get(name)
+        labels = dict(obj["labels"]) if obj else {}
+        deliver = t + self._wire_latency()
+        self.clock.schedule(
+            deliver,
+            lambda now, d=daemon, et=event_type, lb=labels:
+                d.on_watch_event(now, et, lb))
+
+    def watch_connect(self, t, name, daemon):
+        """A watch (re-)establishment attempt. Returns (ok,
+        retry_after_s): during the storm each shard only admits
+        watch_capacity establishments per second; the overflow gets a
+        429 + Retry-After: 1 — APF pacing, a LIVE server."""
+        self.count(t, "WATCH")
+        if name in self.partitioned:
+            return False, 0.0  # transport error, not pacing
+        if self.watch_capacity:
+            key = (self.shard_of(name), int(t))
+            self.watch_buckets[key] += 1
+            overflow = self.watch_buckets[key] - self.watch_capacity
+            if overflow > 0:
+                # Backlog-proportional Retry-After (what APF estimates):
+                # the i-th rejected arrival is told to come back when
+                # the queue ahead of it will have drained — later
+                # arrivals wait longer, so the retry wave spreads
+                # instead of re-herding every Retry-After period.
+                return False, max(1.0, overflow / self.watch_capacity)
+        self.watchers[name] = daemon
+        return True, 0.0
+
+    def drop_all_watches(self, t):
+        dropped = list(self.watchers.values())
+        self.watchers.clear()
+        return dropped
+
+
+class SimDaemon:
+    """One event-driven daemon: publishes via the SSA flow, holds a
+    watch, heals drift on watch events, reconnects with Retry-After
+    pacing / jittered backoff, and counts its passes."""
+
+    def __init__(self, server, clock, index, seed):
+        self.server = server
+        self.clock = clock
+        self.name = f"sim-node-{index:05d}"
+        self.rng = random.Random(seed * 7919 + index)
+        self.labels = dict(BASE_LABELS)
+        self.labels["google.com/tfd.node"] = self.name
+        self.breaker = sinklib.Breaker(open_after=3, cooldown_s=30.0)
+        self.connected = False
+        self.reconnect_failures = 0
+        self.passes = 0
+        self.heal_requested_at = None
+        self.heal_latencies_ms = []
+        self.reconnected_at = None
+
+    def _pass_latency(self):
+        return self.rng.uniform(0.0003, 0.0015)
+
+    def join(self, t):
+        self.server.apply(t, self.name, self.labels)
+        self.passes += 1
+        self.connect(t)
+
+    def connect(self, t):
+        ok, retry_after = self.server.watch_connect(t, self.name, self)
+        if ok:
+            self.connected = True
+            self.reconnect_failures = 0
+            self.reconnected_at = t
+            # Re-list drift check on (re-)establish: heal anything that
+            # moved while we were not watching.
+            obj = self.server.objects.get(self.name)
+            self.server.count(t, "GET")
+            if obj is None or any(
+                    obj["labels"].get(k) != v
+                    for k, v in self.labels.items()):
+                self._schedule_heal(t)
+            return
+        self.connected = False
+        if retry_after > 0:
+            # Server-directed pacing (the storm): a pacing server is
+            # alive — never feeds the breaker (the PR 7 rule).
+            self.breaker.defer(
+                sinklib.spread_retry_after_s(retry_after, self.name), t)
+            pause = sinklib.spread_retry_after_s(retry_after, self.name)
+        else:
+            # Transport failure (partition): exponential + jitter.
+            self.reconnect_failures += 1
+            self.breaker.record_transient_failure(t)
+            base = min(30.0, 1.0 * (2 ** min(self.reconnect_failures - 1,
+                                             10)))
+            pause = sinklib.spread_retry_after_s(base, self.name)
+        self.clock.schedule(t + pause, lambda now: self.connect(now))
+
+    def drop(self, t):
+        # Mirrors the C++ watcher's errored-stream path: first reconnect
+        # after backoff_initial (1s), stretched per node by the desync
+        # hash. The first wave still herds (physics: everyone was
+        # dropped at the same instant) — the SERVER's Retry-After pacing
+        # is what spreads the retries.
+        self.connected = False
+        self.clock.schedule(t + sinklib.spread_retry_after_s(1.0, self.name),
+                            lambda now: self.connect(now))
+
+    def on_watch_event(self, t, event_type, labels):
+        if not self.connected:
+            return
+        if event_type == "DELETED" or any(
+                labels.get(k) != v for k, v in self.labels.items()):
+            self._schedule_heal(t)
+
+    def _schedule_heal(self, t):
+        if self.heal_requested_at is None:
+            self.heal_requested_at = t
+            self.clock.schedule(t + self._pass_latency(),
+                                lambda now: self._heal_pass(now))
+
+    def _heal_pass(self, t):
+        self.passes += 1
+        requested = self.heal_requested_at
+        self.heal_requested_at = None
+        if self.name in self.server.partitioned:
+            # The pass's write fails in transit; retried on reconnect.
+            self.breaker.record_transient_failure(t)
+            return
+        self.server.apply(t, self.name, self.labels)
+        self.breaker.record_success()
+        if requested is not None:
+            self.heal_latencies_ms.append((t - requested) * 1000.0)
+
+
+def watch_soak(args):
+    """The 10k-daemon event-driven scale proof. All virtual-time."""
+    rng = random.Random(args.seed)
+    clock = SimClock()
+    server = SimApiServer(clock, shards=args.shards, rng=rng)
+    daemons = [SimDaemon(server, clock, i, args.seed)
+               for i in range(args.nodes)]
+    record = {"mode": "watch", "nodes": args.nodes, "shards": args.shards,
+              "seed": args.seed}
+    problems = []
+
+    # ---- join: staggered across 10 virtual seconds (a rollout, not a
+    # herd — the desync phase hash spreads it in the real fleet).
+    for d in daemons:
+        clock.schedule(sinklib.hash_unit(d.name) * 10.0,
+                       lambda now, d=d: d.join(now))
+    clock.run(15.0)
+    unjoined = sum(1 for d in daemons if not d.connected)
+    if unjoined:
+        problems.append(f"{unjoined} daemons failed to join/watch")
+
+    # ---- quiet window: NO events for 60 virtual seconds. The headline
+    # zero-poll assertion: an event-driven daemon runs ZERO passes
+    # between events (the >= 10 min anti-entropy self-check is outside
+    # this window by construction).
+    passes_before = {d.name: d.passes for d in daemons}
+    clock.run(75.0)
+    quiet_passes = sum(d.passes - passes_before[d.name] for d in daemons)
+    quiet_window_min = 1.0
+    record["quiet_window_s"] = 60
+    record["quiet_total_passes"] = quiet_passes
+    record["quiet_passes_per_minute_per_daemon"] = round(
+        quiet_passes / quiet_window_min / args.nodes, 6)
+    if quiet_passes != 0:
+        problems.append(
+            f"{quiet_passes} passes ran across the fleet during a quiet "
+            f"60s window (event-driven steady state must be zero)")
+
+    # ---- external-drift heal drill: a foreign manager moves one of OUR
+    # keys on 2% of the fleet (seeded times); p99 edit -> store
+    # reconverged must be milliseconds, vs >= the anti-entropy refresh
+    # (>= 60s) for the write-only sink.
+    drilled = rng.sample(daemons, max(10, args.nodes // 50))
+    for d in drilled:
+        at = 80.0 + rng.uniform(0, 10.0)
+        clock.schedule(at, lambda now, d=d: server.edit(
+            now, d.name, "google.com/tpu.topology", "tampered"))
+    clock.run(100.0)
+    heals = [ms for d in drilled for ms in d.heal_latencies_ms]
+    unhealed = [d.name for d in drilled
+                if server.objects[d.name]["labels"].get(
+                    "google.com/tpu.topology") !=
+                d.labels["google.com/tpu.topology"]]
+    record["drift_drills"] = len(drilled)
+    record["drift_heal_p50_ms"] = round(percentile(heals, 50), 3)
+    record["drift_heal_p99_ms"] = round(percentile(heals, 99), 3)
+    if unhealed:
+        problems.append(f"{len(unhealed)} drifted CRs never healed "
+                        f"(e.g. {unhealed[:3]})")
+    if not heals:
+        problems.append("drift drill produced no heal samples")
+    elif percentile(heals, 99) > 2000.0:
+        problems.append(
+            f"drift heal p99 {percentile(heals, 99):.1f}ms exceeds the "
+            f"2s acceptance bound")
+
+    # ---- reconnect storm: EVERY watch dropped at once (apiserver
+    # rollover); re-establishment is capacity-capped per shard with
+    # Retry-After: 1 — the fleet must drain through the pacing without
+    # a single breaker open, and no 1s bucket may re-herd the server.
+    server.watch_capacity = max(
+        5, args.nodes // args.shards // 20)  # ~20s nominal drain/shard
+    server.watch_buckets.clear()
+    storm_at = 110.0
+    clock.schedule(storm_at, lambda now: [
+        d.drop(now) for d in server.drop_all_watches(now)])
+    clock.run(storm_at + 120.0)
+    server.watch_capacity = 0
+    reconnect_attempts = collections.Counter()
+    for (shard, sec), n in server.watch_buckets.items():
+        reconnect_attempts[sec] += n
+    # The first wave (the 1-2s after the drop) sees most of the fleet by
+    # construction — everyone was disconnected at the same instant and
+    # retries backoff_initial later; a watch attempt is one cheap
+    # request. The herd metric is whether the Retry-After-paced RETRY
+    # waves after it re-converge instead of spreading.
+    first_second = sum(n for sec, n in reconnect_attempts.items()
+                       if sec <= int(storm_at) + 2)
+    retry_buckets = {sec: n for sec, n in reconnect_attempts.items()
+                     if sec > int(storm_at) + 2}
+    worst_reconnect = max(retry_buckets.values()) if retry_buckets else 0
+    unreconnected = sum(1 for d in daemons if not d.connected)
+    reconnect_times = [d.reconnected_at - storm_at for d in daemons
+                       if d.reconnected_at and d.reconnected_at >= storm_at]
+    record["storm_watchers_dropped"] = args.nodes
+    record["storm_drop_second_attempts"] = first_second
+    record["storm_worst_1s_bucket"] = worst_reconnect
+    record["storm_worst_1s_bucket_frac"] = round(
+        worst_reconnect / args.nodes, 4)
+    record["storm_breaker_opens"] = sum(d.breaker.opens() for d in daemons)
+    record["storm_drain_p99_s"] = round(percentile(reconnect_times, 99), 2)
+    record["storm_undrained"] = unreconnected
+    if unreconnected:
+        problems.append(f"{unreconnected} daemons never re-established "
+                        f"their watch after the storm")
+    if record["storm_breaker_opens"]:
+        problems.append(
+            f"the reconnect storm opened "
+            f"{record['storm_breaker_opens']} breaker(s): Retry-After "
+            f"pacing must read as a live server")
+    if worst_reconnect / args.nodes > 0.25:
+        problems.append(
+            f"worst reconnect second saw {worst_reconnect} attempts = "
+            f"{worst_reconnect / args.nodes:.0%} of the fleet (pacing "
+            f"failed to spread the herd)")
+
+    # ---- partition + convergence: 10% of the fleet loses connectivity
+    # for 20s while chaos edits their CRs; convergence-after-partition
+    # p99 = heal completion after the partition lifts.
+    part_at = clock.now + 5.0
+    victims = rng.sample(daemons, args.nodes // 10)
+
+    def start_partition(now):
+        for d in victims:
+            server.partitioned.add(d.name)
+        for d in victims:
+            server.edit(now + 0.5, d.name, "google.com/tpu.topology",
+                        "partition-tamper")
+
+    def end_partition(now):
+        for d in victims:
+            server.partitioned.discard(d.name)
+            # The dropped watch surfaced as a transport error when the
+            # stream died; model the reconnect probe cadence finding the
+            # healed network within its (jittered) backoff window.
+            d.connected = False
+            d.clock.schedule(
+                now + sinklib.spread_retry_after_s(1.0, d.name),
+                lambda t, d=d: d.connect(t))
+
+    clock.schedule(part_at, start_partition)
+    clock.schedule(part_at + 20.0, end_partition)
+    clock.run(part_at + 90.0)
+    # Convergence = time from the partition lifting until the victim's
+    # watch re-established AND its re-list drift check re-asserted (the
+    # reconnect path heals synchronously in connect(), so the
+    # re-establish time IS the converged time).
+    converge = []
+    for d in victims:
+        if d.reconnected_at and d.reconnected_at > part_at:
+            converge.append(d.reconnected_at - (part_at + 20.0))
+    part_unhealed = [
+        d.name for d in victims
+        if server.objects[d.name]["labels"].get(
+            "google.com/tpu.topology") !=
+        d.labels["google.com/tpu.topology"]]
+    record["partition_victims"] = len(victims)
+    record["partition_converge_p50_s"] = round(percentile(converge, 50), 3)
+    record["partition_converge_p99_s"] = round(percentile(converge, 99), 3)
+    if part_unhealed:
+        problems.append(
+            f"{len(part_unhealed)} partitioned CRs never reconverged "
+            f"after the partition lifted (e.g. {part_unhealed[:3]})")
+    if not converge:
+        problems.append("partition drill produced no convergence samples")
+    elif percentile(converge, 99) > 30.0:
+        problems.append(
+            f"convergence-after-partition p99 "
+            f"{percentile(converge, 99):.1f}s exceeds the 30s bound")
+
+    record["total_requests"] = sum(server.by_verb.values())
+    record["by_verb"] = dict(server.by_verb)
+
+    print(json.dumps(record))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(record, f, indent=1)
+    if problems:
+        for p in problems:
+            print(f"watch soak FAILED: {p}", file=sys.stderr)
+        return 1
+    print(
+        f"watch soak OK: {args.nodes} daemons x {args.shards} shards, "
+        f"quiet window {record['quiet_total_passes']} passes, drift heal "
+        f"p99 {record['drift_heal_p99_ms']}ms, storm drained p99 "
+        f"{record['storm_drain_p99_s']}s with 0 breaker opens (worst 1s "
+        f"bucket {record['storm_worst_1s_bucket_frac']:.1%}), partition "
+        f"converge p99 {record['partition_converge_p99_s']}s")
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=1000)
@@ -351,7 +777,20 @@ def main(argv=None):
     ap.add_argument("--json", help="write the soak record here")
     ap.add_argument("--quick", action="store_true",
                     help="40 nodes, short phases (test smoke)")
+    ap.add_argument("--watch", action="store_true",
+                    help="run the event-driven watch-mode simulation "
+                         "(virtual clock, 10k daemons) instead of the "
+                         "wire-level diff-sink soak")
+    ap.add_argument("--shards", type=int, default=8,
+                    help="watch mode: fake apiserver shard count")
     args = ap.parse_args(argv)
+
+    if args.watch:
+        if args.nodes == 1000:  # the diff-soak default; watch mode is 10k
+            args.nodes = 10000
+        if args.quick:
+            args.nodes = min(args.nodes, 400)
+        return watch_soak(args)
 
     if args.quick:
         args.nodes = min(args.nodes, 40)

@@ -148,6 +148,14 @@ CONDITIONAL = {
     "tfd_plugin_failures_total",
     "tfd_plugin_violations_total",
     "tfd_plugin_kills_total",
+    # Event-driven core (ISSUE 12): the CR watch is config-gated behind
+    # --use-node-feature-api + --sink-watch (off on this file-sink
+    # boot); wakeups register only once the loop parks AFTER the first
+    # pass — racy at this boot's first-pass scrape.
+    "tfd_sink_watch_state",
+    "tfd_sink_watch_events_total",
+    "tfd_sink_watch_reconnects_total",
+    "tfd_pass_wakeups_total",
 }
 
 

@@ -97,6 +97,7 @@ class Daemon:
         self.port = free_loopback_port()
         self.out_file = Path(out_dir) / f"labels-{tag}"
         argv = [str(BINARY), "--sleep-interval=1s", "--backend=mock",
+                "--event-driven=false",  # cadence-counted sampling
                 f"--mock-topology-file={FIXTURE}",
                 "--machine-type-file=/dev/null", "--no-timestamp",
                 "--journal-capacity=2048",

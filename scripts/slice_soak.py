@@ -102,6 +102,7 @@ class Member:
         self.port = free_loopback_port()
         self.argv = [
             str(BINARY), f"--sleep-interval={INTERVAL_S}s",
+            "--event-driven=false",  # cadence-shaped disagreement windows
             "--backend=pjrt", f"--libtpu-path={FAKE_PJRT}",
             f"--pjrt-init-timeout={PJRT_TIMEOUT_S}s",
             "--pjrt-refresh-interval=1s",

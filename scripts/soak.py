@@ -406,6 +406,10 @@ def run_chaos(args):
         port = free_loopback_port()
         env = {**os.environ, "GCE_METADATA_HOST": "127.0.0.1:1"}
         base_argv = [f"--sleep-interval={interval}s", "--backend=mock",
+                     # The soak derives passes from the per-interval
+                     # cadence; the event core is soaked separately
+                     # (fleet_soak --watch, tests/test_watch.py).
+                     "--event-driven=false",
                      f"--mock-topology-file={fixture}",
                      "--machine-type-file=/dev/null",
                      f"--output-file={label_path}",
@@ -575,6 +579,12 @@ def run_chaos(args):
         daemon = ChaosDaemon(
             args.binary,
             [f"--sleep-interval={interval}s", "--backend=mock",
+             "--event-driven=false",
+             # This drill's seeded fault schedule targets the GET-path
+             # fault points (the legacy write flow); under server-side
+             # apply the write never GETs, so the injected k8s.get 500s
+             # would never fire and the breaker would never open.
+             "--sink-apply=false",
              f"--mock-topology-file={fixture}",
              "--machine-type-file=/dev/null", *sink.daemon_args(),
              f"--introspection-addr=127.0.0.1:{port3}",
@@ -652,6 +662,7 @@ def run_chaos(args):
                 "TFD_FAKE_PJRT_KIND": "TPU v5 lite",
                 "TFD_FAKE_PJRT_BOUNDS": "2,2,1"}
         argv4 = [f"--sleep-interval={interval}s", "--backend=pjrt",
+                 "--event-driven=false",
                  f"--libtpu-path={fake_pjrt}",
                  "--pjrt-refresh-interval=0", "--pjrt-retry-backoff=0",
                  "--pjrt-init-timeout=10s",
@@ -849,6 +860,7 @@ def main(argv=None):
             extra.append(f"--introspection-addr=127.0.0.1:{port}")
             scraper = MetricsScraper(port)
         cmd = [args.binary, f"--sleep-interval={args.interval}s",
+               "--event-driven=false",  # cadence-shaped assertions
                *sink.daemon_args(),
                "--machine-type-file=/dev/null", *extra]
         env = {**os.environ, **sink.daemon_env()}

@@ -490,6 +490,43 @@ std::vector<FlagDef> MakeFlagDefs(Flags* f) {
                   [f](const std::string& v) {
                     return SetBool(&f->sink_patch, v);
                   }});
+  defs.push_back({"sink-apply",
+                  {"TFD_SINK_APPLY"},
+                  "sinkApply",
+                  "write the NodeFeature CR via server-side apply "
+                  "(application/apply-patch+yaml, field manager 'tfd') so "
+                  "foreign field managers' label keys survive our writes; "
+                  "falls back per-process to merge patch, then GET+PUT, "
+                  "when the server rejects the patch type (415/405)",
+                  true,
+                  [f](const std::string& v) {
+                    return SetBool(&f->sink_apply, v);
+                  }});
+  defs.push_back({"sink-watch",
+                  {"TFD_SINK_WATCH"},
+                  "sinkWatch",
+                  "WATCH the daemon's own NodeFeature CR so external "
+                  "edits/deletes heal in milliseconds and apiserver "
+                  "outages surface at watch-drop time; a healthy watch "
+                  "demotes the anti-entropy refresh to a low-frequency "
+                  "self-check (>= 10 min)",
+                  true,
+                  [f](const std::string& v) {
+                    return SetBool(&f->sink_watch, v);
+                  }});
+  defs.push_back({"event-driven",
+                  {"TFD_EVENT_DRIVEN"},
+                  "eventDriven",
+                  "drive the rewrite loop from events (probe-snapshot "
+                  "movement, config-file/plugin-dir inotify, watch-"
+                  "delivered CR drift, deadline timers) instead of a "
+                  "fixed --sleep-interval tick: a quiet daemon runs zero "
+                  "passes between events; false = the legacy interval "
+                  "loop (bisection escape hatch)",
+                  true,
+                  [f](const std::string& v) {
+                    return SetBool(&f->event_driven, v);
+                  }});
   defs.push_back({"cadence-jitter-pct",
                   {"TFD_CADENCE_JITTER_PCT"},
                   "cadenceJitterPct",
@@ -1105,6 +1142,9 @@ std::string ToJson(const Config& config) {
       << ",\"sinkBreakerCooldown\":\"" << f.sink_breaker_cooldown_s << "s\""
       << ",\"sinkRequestDeadline\":\"" << f.sink_request_deadline_s << "s\""
       << ",\"sinkPatch\":" << (f.sink_patch ? "true" : "false")
+      << ",\"sinkApply\":" << (f.sink_apply ? "true" : "false")
+      << ",\"sinkWatch\":" << (f.sink_watch ? "true" : "false")
+      << ",\"eventDriven\":" << (f.event_driven ? "true" : "false")
       << ",\"cadenceJitterPct\":" << f.cadence_jitter_pct
       << ",\"sinkRefresh\":\"" << f.sink_refresh_s << "s\""
       << ",\"sliceCoordination\":"

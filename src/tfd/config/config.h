@@ -210,6 +210,30 @@ struct Flags {
   // forces the reference GET->mutate->PUT flow on every write (the
   // client also falls back by itself when the server answers 415/405).
   bool sink_patch = true;
+  // Server-side apply (k8s/client.h): write the NodeFeature CR as an
+  // application/apply-patch+yaml PATCH under the "tfd" field manager,
+  // so label keys written by OTHER field managers survive our writes
+  // instead of being clobbered. The per-process fallback ladder is
+  // SSA -> merge patch -> GET+PUT: a server rejecting apply (415/405)
+  // demotes to the --sink-patch diff flow for the rest of the process.
+  bool sink_apply = true;
+  // WATCH the daemon's own NodeFeature CR (k8s/watch.h): external edits
+  // and deletes are seen (and healed) in milliseconds, an apiserver
+  // outage surfaces at watch-drop time instead of at the anti-entropy
+  // refresh, and a healthy watch demotes the anti-entropy refresh to a
+  // low-frequency self-check (>= 10 min). Off restores the write-only
+  // sink whose drift/outage detection is bounded by --sink-refresh.
+  bool sink_watch = true;
+  // Event-driven pass loop (sched/wakeup.h): instead of a fixed
+  // --sleep-interval tick, the rewrite loop sleeps on a wakeup
+  // multiplexer — probe-snapshot movement, config-file/plugin-dir
+  // inotify, watch-delivered CR drift, signals, and explicit deadline
+  // timers (anti-entropy refresh, state-file re-save, snapshot tier
+  // boundaries) — so a quiet daemon runs ZERO rewrite passes between
+  // events. Degraded/quarantined/suppressed/retry states fall back to
+  // the interval cadence (their label contracts tick on time). Off =
+  // the legacy fixed-interval loop (bisection escape hatch).
+  bool event_driven = true;
   // Fleet cadence desynchronization (k8s/desync.h): percent amplitude
   // of the deterministic hash-of-nodename per-tick jitter and the
   // anti-entropy refresh-period spread. Any value > 0 ALSO enables the

@@ -364,6 +364,8 @@ Status UpdateNodeFeature(const ClusterConfig& config,
   write.headers["Content-Type"] = "application/json";
   http::RequestOptions patch_write = options;
   patch_write.headers["Content-Type"] = "application/merge-patch+json";
+  http::RequestOptions apply_write = options;
+  apply_write.headers["Content-Type"] = "application/apply-patch+yaml";
 
   // Diff-patch first (zero GETs while the cached state holds), GET →
   // create-if-missing → patch/update-if-changed otherwise (the
@@ -374,8 +376,68 @@ Status UpdateNodeFeature(const ClusterConfig& config,
   std::string last_error;
   for (int attempt = 0; attempt < kMaxAttempts; attempt++) {
     // Recomputed per attempt: a 415 in THIS call flips the flag and the
-    // retry must already take the GET->PUT road.
+    // retry must already take the next rung down the ladder.
     const bool patching = config.use_patch && !state->patch_unsupported;
+    const bool applying = config.use_apply && !state->apply_unsupported;
+
+    // ---- Server-side apply (the top of the ladder): ONE PATCH of the
+    // full desired object under the "tfd" field manager. The apiserver
+    // reconciles field ownership — spec.labels keys another manager
+    // owns survive, keys we previously applied but no longer send are
+    // removed — so the write needs no GET, no cached diff state, and no
+    // resourceVersion fence (force=true resolves ownership conflicts in
+    // our favor for OUR keys; a same-manager conflict cannot happen).
+    // A missing CR is created by the apply itself, which is also what
+    // makes every anti-entropy reconcile and external-delete heal a
+    // single round trip. JSON is valid YAML, so the body is CrBody.
+    if (applying) {
+      std::string apply_url = CrUrl(config, true) +
+                              "?fieldManager=" +
+                              std::string(kApplyFieldManager) +
+                              "&force=true";
+      Result<http::Response> applied =
+          CountedRequest("k8s.patch", "PATCH", apply_url,
+                         CrBody(config, labels), apply_write, outcome);
+      if (!applied.ok()) {
+        return Fail(true, "applying NodeFeature CR: " + applied.error());
+      }
+      outcome->applies++;
+      if (applied->status == 200 || applied->status == 201) {
+        LearnAck(applied->body);
+        TFD_LOG_INFO << "applied NodeFeature CR " << CrName(config.node_name)
+                     << " (server-side apply, field manager "
+                     << kApplyFieldManager << ")";
+        RecordSink("applied NodeFeature CR " + CrName(config.node_name) +
+                       " (server-side apply)",
+                   "apply", /*ok=*/true);
+        return Status::Ok();
+      }
+      if (applied->status == 415 || applied->status == 405) {
+        // Server doesn't speak apply-patch: remember that per-process
+        // and demote to the merge-patch rung (then GET+PUT below it).
+        state->apply_unsupported = true;
+        last_error = "server-side apply unsupported (HTTP " +
+                     std::to_string(applied->status) + ")";
+        RecordSink("apiserver rejects server-side apply; falling back "
+                   "to merge patch",
+                   "apply-unsupported", /*ok=*/false, last_error);
+        continue;
+      }
+      if (applied->status == 409) {
+        // Conflict despite force=true (an admission race, a fake server
+        // modeling an unforced conflict): forget the cached state and
+        // retry — the next apply is self-contained anyway.
+        state->Invalidate();
+        last_error = "apply conflict: " + applied->body.substr(0, 256);
+        RecordSink("NodeFeature CR apply conflict; retrying",
+                   "conflict-retry", /*ok=*/false, last_error);
+        continue;
+      }
+      return Fail(StatusTransient(applied->status),
+                  "applying NodeFeature CR: HTTP " +
+                      std::to_string(applied->status) + ": " +
+                      applied->body.substr(0, 512));
+    }
     // Shared PATCH send + response handling for both the zero-GET and
     // the freshly-fetched diff. Returns true when the write settled
     // (result in *settled); false to retry the attempt loop.

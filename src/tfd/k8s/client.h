@@ -34,7 +34,20 @@ struct ClusterConfig {
   // Diff writes via JSON merge patch (--sink-patch). Off forces the
   // reference GET->mutate->PUT path on every write.
   bool use_patch = true;
+  // Server-side apply (--sink-apply): writes are one PATCH of the full
+  // desired object as application/apply-patch+yaml under the "tfd"
+  // field manager (force=true), so spec.labels keys owned by OTHER
+  // field managers survive our writes. Defaults OFF at this level — the
+  // daemon wires --sink-apply (default on) here; the direct merge-patch
+  // tests keep pinning their rung of the ladder. When the server
+  // rejects the patch type (415/405) the ladder demotes per-process:
+  // SSA -> merge patch -> GET+PUT (SinkState::apply_unsupported).
+  bool use_apply = false;
 };
+
+// The field manager every server-side apply writes under; foreign
+// managers' spec.labels entries are exactly the keys SSA preserves.
+inline constexpr char kApplyFieldManager[] = "tfd";
 
 // Loads in-cluster config (reference k8s-client.go:30-66). Errors when
 // NODE_NAME or the API server location is missing.
@@ -53,6 +66,12 @@ struct SinkState {
   // back to the reference GET->mutate->PUT path for the rest of this
   // process (re-probed on restart — apiservers don't usually regress).
   bool patch_unsupported = false;
+  // The server rejected application/apply-patch+yaml (415/405): demote
+  // to the merge-patch rung for the rest of this process (same
+  // remember-per-process contract as patch_unsupported). NOTE the PUT
+  // rung at the bottom of the ladder replaces spec.labels wholesale —
+  // foreign field managers' keys survive SSA but are clobbered there.
+  bool apply_unsupported = false;
   std::string resource_version;  // last-known metadata.resourceVersion
   lm::Labels acked;              // spec.labels the server last ack'd
 
@@ -73,7 +92,8 @@ struct WriteOutcome {
   int gets = 0;
   int posts = 0;
   int puts = 0;
-  int patches = 0;
+  int patches = 0;   // merge patches AND server-side applies (both PATCH)
+  int applies = 0;   // the server-side-apply subset of `patches`
   size_t patch_bytes = 0;   // serialized merge-patch bodies
   // Largest Retry-After the server attached to a 429/503 — the adaptive
   // backoff's input (0 = server named no pause).

@@ -1,0 +1,458 @@
+#include "tfd/k8s/watch.h"
+
+#include <sys/socket.h>
+
+#include <chrono>
+
+#include "tfd/k8s/desync.h"
+#include "tfd/obs/journal.h"
+#include "tfd/obs/metrics.h"
+#include "tfd/util/http.h"
+#include "tfd/util/jsonlite.h"
+#include "tfd/util/logging.h"
+
+namespace tfd {
+namespace k8s {
+
+namespace {
+
+constexpr char kWatchStateHelp[] =
+    "NodeFeature CR watch state: 0 stopped/disabled, 1 "
+    "connecting/backoff, 2 established.";
+constexpr char kWatchEventsHelp[] =
+    "Watch-stream events received, by type (added/modified/deleted/"
+    "bookmark/error/unknown).";
+constexpr char kWatchReconnectsHelp[] =
+    "Watch stream (re-)establishments after the first.";
+
+std::string CrName(const std::string& node) {
+  return "tfd-features-for-" + node;
+}
+
+std::string NamedCrUrl(const ClusterConfig& config) {
+  return config.apiserver_url + "/apis/nfd.k8s-sigs.io/v1alpha1/namespaces/" +
+         config.namespace_ + "/nodefeatures/" + CrName(config.node_name);
+}
+
+void CountSinkRequest(const std::string& verb, const char* status_class) {
+  obs::Default()
+      .GetCounter("tfd_sink_requests_total",
+                  "Apiserver requests issued by the NodeFeature CR sink, "
+                  "by verb and status class (429 bucketed separately; "
+                  "'error' = transport failure).",
+                  {{"verb", verb}, {"status_class", status_class}})
+      ->Inc();
+}
+
+const char* StatusClassOf(int status) {
+  if (status == 429) return "429";
+  if (status >= 500) return "5xx";
+  if (status >= 400) return "4xx";
+  if (status >= 300) return "3xx";
+  if (status >= 200) return "2xx";
+  return "error";
+}
+
+void SetWatchState(int state) {
+  obs::Default()
+      .GetGauge("tfd_sink_watch_state", kWatchStateHelp)
+      ->Set(state);
+}
+
+void CountWatchEvent(WatchEvent::Type type) {
+  obs::Default()
+      .GetCounter("tfd_sink_watch_events_total", kWatchEventsHelp,
+                  {{"type", WatchEventTypeName(type)}})
+      ->Inc();
+}
+
+// A dropped watch IS the sink outage signal now (the anti-entropy
+// refresh is demoted to a slow self-check while the watch is healthy).
+void CountWatchOutage(const std::string& error) {
+  obs::Default()
+      .GetCounter("tfd_sink_outages_total",
+                  "Sink outages discovered by the anti-entropy "
+                  "refresh write (steady-state liveness probe) or by a "
+                  "dropped NodeFeature CR watch stream.")
+      ->Inc();
+  obs::DefaultJournal().Record(
+      "watch-dropped", "cr",
+      "NodeFeature CR watch dropped: " + error, {{"error", error}});
+}
+
+}  // namespace
+
+const char* WatchEventTypeName(WatchEvent::Type type) {
+  switch (type) {
+    case WatchEvent::Type::kAdded: return "added";
+    case WatchEvent::Type::kModified: return "modified";
+    case WatchEvent::Type::kDeleted: return "deleted";
+    case WatchEvent::Type::kBookmark: return "bookmark";
+    case WatchEvent::Type::kError: return "error";
+    case WatchEvent::Type::kUnknown: return "unknown";
+  }
+  return "unknown";
+}
+
+WatchEvent ParseWatchEventLine(const std::string& line) {
+  WatchEvent event;
+  Result<jsonlite::ValuePtr> parsed = jsonlite::Parse(line);
+  if (!parsed.ok()) return event;
+  const jsonlite::Value& doc = **parsed;
+  jsonlite::ValuePtr type = doc.Get("type");
+  if (!type || type->kind != jsonlite::Value::Kind::kString) return event;
+  const std::string& t = type->string_value;
+  if (t == "ADDED") {
+    event.type = WatchEvent::Type::kAdded;
+  } else if (t == "MODIFIED") {
+    event.type = WatchEvent::Type::kModified;
+  } else if (t == "DELETED") {
+    event.type = WatchEvent::Type::kDeleted;
+  } else if (t == "BOOKMARK") {
+    event.type = WatchEvent::Type::kBookmark;
+  } else if (t == "ERROR") {
+    event.type = WatchEvent::Type::kError;
+  } else {
+    return event;
+  }
+  jsonlite::ValuePtr object = doc.Get("object");
+  if (!object) return event;
+  if (jsonlite::ValuePtr rv = object->GetPath("metadata.resourceVersion");
+      rv && rv->kind == jsonlite::Value::Kind::kString) {
+    event.resource_version = rv->string_value;
+  }
+  if (event.type == WatchEvent::Type::kError) {
+    if (jsonlite::ValuePtr code = object->Get("code");
+        code && code->kind == jsonlite::Value::Kind::kNumber) {
+      event.error_code = static_cast<int>(code->number_value);
+    }
+    return event;
+  }
+  if (jsonlite::ValuePtr labels = object->GetPath("spec.labels");
+      labels && labels->kind == jsonlite::Value::Kind::kObject) {
+    event.has_labels = true;
+    for (const auto& [k, v] : labels->object_items) {
+      if (v && v->kind == jsonlite::Value::Kind::kString) {
+        event.labels[k] = v->string_value;
+      }
+    }
+  }
+  return event;
+}
+
+NodeFeatureWatcher::NodeFeatureWatcher(ClusterConfig config,
+                                       WatcherOptions options,
+                                       PublishedFn published,
+                                       DriftFn on_drift, HealthFn on_health)
+    : config_(std::move(config)),
+      options_(options),
+      published_(std::move(published)),
+      on_drift_(std::move(on_drift)),
+      on_health_(std::move(on_health)) {}
+
+NodeFeatureWatcher::~NodeFeatureWatcher() { Stop(); }
+
+void NodeFeatureWatcher::Start() {
+  if (started_) return;
+  started_ = true;
+  SetWatchState(1);
+  thread_ = std::thread([this] { RunLoop(); });
+}
+
+void NodeFeatureWatcher::Stop() {
+  if (!started_) return;
+  stop_.store(true);
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    cv_.notify_all();
+  }
+  // Unblock a read parked inside the stream; the transport still owns
+  // and closes the fd.
+  int fd = stream_fd_.load();
+  if (fd >= 0) shutdown(fd, SHUT_RDWR);
+  if (thread_.joinable()) thread_.join();
+  started_ = false;
+  SetHealthy(false);
+  SetWatchState(0);
+}
+
+void NodeFeatureWatcher::SetHealthy(bool healthy) {
+  bool was = healthy_.exchange(healthy, std::memory_order_relaxed);
+  if (was != healthy && on_health_) on_health_(healthy);
+}
+
+bool NodeFeatureWatcher::SleepFor(double seconds) {
+  std::unique_lock<std::mutex> lock(mu_);
+  cv_.wait_for(lock,
+               std::chrono::milliseconds(
+                   static_cast<long long>(seconds * 1000)),
+               [this] { return stop_.load(); });
+  return !stop_.load();
+}
+
+void NodeFeatureWatcher::RunLoop() {
+  const std::string node_key = desync::NodeKey();
+  std::string rv;                // bookmarked resourceVersion ("" = re-list)
+  int consecutive_failures = 0;  // errored sessions (backoff input)
+
+  http::RequestOptions base;
+  base.ca_file = config_.ca_file;
+  if (!config_.token.empty()) {
+    base.headers["Authorization"] = "Bearer " + config_.token;
+  }
+  base.headers["Accept"] = "application/json";
+
+  while (!stop_.load()) {
+    // ---- (re-)list: learn the current resourceVersion (and catch any
+    // drift that happened while we were not watching). One GET — the
+    // `410 Gone` resync contract is exactly one of these per resync.
+    if (rv.empty()) {
+      http::RequestOptions list_options = base;
+      list_options.timeout_ms = 5000;
+      list_options.deadline_ms = 10000;
+      Result<http::Response> listed =
+          http::Request("GET", NamedCrUrl(config_), "", list_options);
+      CountSinkRequest("GET", listed.ok() ? StatusClassOf(listed->status)
+                                          : "error");
+      if (!listed.ok()) {
+        SetHealthy(false);
+        SetWatchState(1);
+        CountWatchOutage("list failed: " + listed.error());
+        consecutive_failures++;
+        double pause = std::min(
+            options_.backoff_max_s,
+            options_.backoff_initial_s * (1 << std::min(
+                consecutive_failures - 1, 10)));
+        if (!SleepFor(desync::SpreadRetryAfterS(pause, node_key))) return;
+        continue;
+      }
+      relists_.fetch_add(1);
+      if (listed->status == 200) {
+        Result<jsonlite::ValuePtr> parsed = jsonlite::Parse(listed->body);
+        if (parsed.ok()) {
+          if (jsonlite::ValuePtr v =
+                  (*parsed)->GetPath("metadata.resourceVersion");
+              v && v->kind == jsonlite::Value::Kind::kString) {
+            rv = v->string_value;
+          }
+          // Drift check against the listed state: spec.labels that
+          // differ from what we last published is foreign movement.
+          lm::Labels published;
+          if (published_ && published_(&published) && on_drift_) {
+            lm::Labels current;
+            if (jsonlite::ValuePtr labels = (*parsed)->GetPath("spec.labels");
+                labels &&
+                labels->kind == jsonlite::Value::Kind::kObject) {
+              for (const auto& [k, v] : labels->object_items) {
+                if (v && v->kind == jsonlite::Value::Kind::kString) {
+                  current[k] = v->string_value;
+                }
+              }
+            }
+            // Foreign (non-string / extra-manager) keys are invisible
+            // here; under SSA they are someone else's property anyway.
+            bool ours_intact = true;
+            for (const auto& [k, v] : published) {
+              auto it = current.find(k);
+              if (it == current.end() || it->second != v) {
+                ours_intact = false;
+                break;
+              }
+            }
+            if (!ours_intact) on_drift_("listed");
+          }
+        }
+      } else if (listed->status == 404) {
+        // CR missing. If we have published, that is an external delete.
+        lm::Labels published;
+        if (published_ && published_(&published) && on_drift_) {
+          on_drift_("missing");
+        }
+        // Watch without a resourceVersion below: legal — the server
+        // starts from "now" and delivers the creation when it lands.
+      } else if (listed->status == 429 || listed->status == 503) {
+        double retry_after = listed->RetryAfterSeconds();
+        if (retry_after <= 0) retry_after = options_.backoff_initial_s;
+        if (!SleepFor(desync::SpreadRetryAfterS(retry_after, node_key))) {
+          return;
+        }
+        continue;
+      } else {
+        SetHealthy(false);
+        CountWatchOutage("list HTTP " + std::to_string(listed->status));
+        consecutive_failures++;
+        if (!SleepFor(desync::SpreadRetryAfterS(
+                std::min(options_.backoff_max_s,
+                         options_.backoff_initial_s *
+                             (1 << std::min(consecutive_failures - 1, 10))),
+                node_key))) {
+          return;
+        }
+        continue;
+      }
+    }
+
+    // ---- the watch stream itself.
+    std::string url = NamedCrUrl(config_) +
+                      "?watch=true&allowWatchBookmarks=true&timeoutSeconds=" +
+                      std::to_string(options_.timeout_s);
+    if (!rv.empty()) url += "&resourceVersion=" + rv;
+    http::RequestOptions stream_options = base;
+    stream_options.timeout_ms = options_.read_timeout_ms;
+    // The stream idles for minutes between bookmarks, but CONNECT must
+    // fail fast: a blackholed apiserver would otherwise park this
+    // thread (un-Stop()-ably — no fd published yet) for the full read
+    // timeout, stalling shutdown/reload.
+    stream_options.connect_timeout_ms = 5000;
+
+    sessions_.fetch_add(1);
+    if (sessions_.load() > 1) {
+      obs::Default()
+          .GetCounter("tfd_sink_watch_reconnects_total",
+                      kWatchReconnectsHelp)
+          ->Inc();
+    }
+
+    bool established = false;
+    bool resync_gone = false;
+    double server_retry_after = 0;
+    int stream_status = 0;
+    std::string line_buffer;
+    http::StreamHandler handler;
+    handler.on_connected = [this](int fd) { stream_fd_.store(fd); };
+    handler.on_response = [&](const http::Response& head) {
+      stream_status = head.status;
+      server_retry_after = head.RetryAfterSeconds();
+      if (head.status == 200) {
+        established = true;
+        consecutive_failures = 0;
+        SetHealthy(true);
+        SetWatchState(2);
+        obs::DefaultJournal().Record(
+            "watch-established", "cr",
+            "NodeFeature CR watch established (rv " +
+                (rv.empty() ? std::string("none") : rv) + ")",
+            {{"resource_version", rv}});
+        return true;
+      }
+      return false;  // non-200: abort, classify below
+    };
+    handler.on_data = [&](const char* data, size_t len) {
+      if (stop_.load()) return false;
+      line_buffer.append(data, len);
+      size_t start = 0;
+      size_t eol;
+      while ((eol = line_buffer.find('\n', start)) != std::string::npos) {
+        std::string line = line_buffer.substr(start, eol - start);
+        start = eol + 1;
+        if (line.empty() || line == "\r") continue;
+        WatchEvent event = ParseWatchEventLine(line);
+        CountWatchEvent(event.type);
+        switch (event.type) {
+          case WatchEvent::Type::kBookmark:
+            if (!event.resource_version.empty()) {
+              rv = event.resource_version;
+            }
+            break;
+          case WatchEvent::Type::kError:
+            if (event.error_code == 410) {
+              resync_gone = true;
+              line_buffer.clear();
+              return false;  // abort the stream; loop re-lists once
+            }
+            break;
+          case WatchEvent::Type::kAdded:
+          case WatchEvent::Type::kModified:
+          case WatchEvent::Type::kDeleted: {
+            if (!event.resource_version.empty()) {
+              rv = event.resource_version;
+            }
+            lm::Labels published;
+            if (!published_ || !published_(&published)) break;
+            if (event.type == WatchEvent::Type::kDeleted) {
+              if (on_drift_) on_drift_("deleted");
+              break;
+            }
+            // Self-echoes carry exactly our published set for our
+            // keys; foreign drift moved or removed one of OURS.
+            // (Foreign managers' own keys are their business — SSA
+            // ownership — and do not read as drift.)
+            bool ours_intact = event.has_labels;
+            if (ours_intact) {
+              for (const auto& [k, v] : published) {
+                auto it = event.labels.find(k);
+                if (it == event.labels.end() || it->second != v) {
+                  ours_intact = false;
+                  break;
+                }
+              }
+            }
+            if (!ours_intact && on_drift_) on_drift_("modified");
+            break;
+          }
+          case WatchEvent::Type::kUnknown:
+            break;
+        }
+      }
+      line_buffer.erase(0, start);
+      if (line_buffer.size() > 1024 * 1024) line_buffer.clear();
+      return true;
+    };
+
+    Status streamed =
+        http::RequestStream("GET", url, "", stream_options, handler);
+    stream_fd_.store(-1);
+    CountSinkRequest("WATCH",
+                     streamed.ok() && stream_status > 0
+                         ? StatusClassOf(stream_status)
+                         : "error");
+    if (stop_.load()) return;
+
+    if (resync_gone || stream_status == 410) {
+      // The server compacted past our resourceVersion: re-list exactly
+      // once (the rv.empty() branch above), then re-watch from the
+      // fresh version. Not an outage — the server is alive and talking.
+      obs::DefaultJournal().Record(
+          "watch-resync", "cr",
+          "watch resourceVersion too old (410 Gone); re-listing once",
+          {{"resource_version", rv}});
+      rv.clear();
+      continue;
+    }
+    if (streamed.ok() && established) {
+      // Clean rotation (the server closed at timeoutSeconds): re-watch
+      // immediately from the bookmarked version. Healthy throughout.
+      continue;
+    }
+    if (stream_status == 429 || stream_status == 503 ||
+        server_retry_after > 0) {
+      // Server-directed pacing: a pacing server is ALIVE (the PR 7
+      // rule), so no outage is recorded and the pause is the server's
+      // number, stretched per node so a mass drop cannot re-arrive as
+      // one reconnect herd.
+      SetWatchState(1);
+      double pause = server_retry_after > 0 ? server_retry_after
+                                            : options_.backoff_initial_s;
+      if (!SleepFor(desync::SpreadRetryAfterS(pause, node_key))) return;
+      continue;
+    }
+
+    // Transport failure or unexpected status: the watch DROPPED. This
+    // is the new sink-outage signal — instant, not refresh-bounded.
+    SetHealthy(false);
+    SetWatchState(1);
+    std::string why = !streamed.ok()
+                          ? streamed.message()
+                          : "watch HTTP " + std::to_string(stream_status);
+    CountWatchOutage(why);
+    consecutive_failures++;
+    double pause = std::min(
+        options_.backoff_max_s,
+        options_.backoff_initial_s *
+            (1 << std::min(consecutive_failures - 1, 10)));
+    if (!SleepFor(desync::SpreadRetryAfterS(pause, node_key))) return;
+  }
+}
+
+}  // namespace k8s
+}  // namespace tfd

@@ -1,0 +1,138 @@
+// WATCH on the daemon's own NodeFeature CR.
+//
+// The PR 7 sink is write-only: an external edit or delete of the CR —
+// another controller, an operator's kubectl, a garbage collector — is
+// only discovered at the next anti-entropy refresh (≤ max(60s, 2.5x
+// interval)), and an apiserver outage is only discovered when a write
+// happens to run. The watcher closes both gaps the way the reference
+// NFD stack does (informers): one long-lived
+// `GET ...nodefeatures/<name>?watch=true` stream per daemon, resource-
+// Version-bookmarked, delivering ADDED/MODIFIED/DELETED events in
+// milliseconds. Foreign drift (an event whose spec.labels differ from
+// what this daemon last published) triggers the on_drift callback — the
+// pass loop invalidates its sink state and re-asserts the labels — and
+// a dropped stream surfaces the outage INSTANTLY (tfd_sink_outages_total
+// now fires here, not at refresh cadence).
+//
+// Reconnect discipline rides the PR 7 machinery: Retry-After pacing from
+// a 429/503 is honored (stretched per node by the desync hash so a mass
+// watch drop does not re-arrive as one herd), other failures take
+// exponential backoff with deterministic per-node jitter, and a
+// `410 Gone` (the server compacted past our resourceVersion) re-LISTS
+// exactly once — one GET to re-learn the current state and version —
+// before re-watching.
+//
+// Thread model: one watcher thread per Run() scope; Stop() shuts the
+// socket down to unblock a mid-stream read and joins. Callbacks fire on
+// the watcher thread — they must only do thread-safe work (the daemon
+// passes a WakeupMux::Notify and an atomic health flag).
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <functional>
+#include <mutex>
+#include <string>
+#include <thread>
+
+#include "tfd/k8s/client.h"
+#include "tfd/lm/labeler.h"
+#include "tfd/util/status.h"
+
+namespace tfd {
+namespace k8s {
+
+// One parsed watch event (a newline-delimited JSON document on the
+// watch stream: {"type":"MODIFIED","object":{...}}).
+struct WatchEvent {
+  enum class Type {
+    kAdded,
+    kModified,
+    kDeleted,
+    kBookmark,
+    kError,    // object is a Status; error_code carries .code (410 = resync)
+    kUnknown,  // unparseable line / unrecognized type (ignored, counted)
+  };
+  Type type = Type::kUnknown;
+  std::string resource_version;  // object.metadata.resourceVersion
+  bool has_labels = false;       // object.spec.labels parsed (string values)
+  lm::Labels labels;
+  int error_code = 0;
+};
+
+const char* WatchEventTypeName(WatchEvent::Type type);
+
+// Parses one watch-stream line. Exposed for the unit tests and the
+// Python twin's parity pins (tpufd.sink.parse_watch_event).
+WatchEvent ParseWatchEventLine(const std::string& line);
+
+struct WatcherOptions {
+  // Server-side watch rotation (the timeoutSeconds query param): the
+  // server closes the stream cleanly this often; the client re-watches
+  // from its bookmarked resourceVersion. Rotation is NOT an outage.
+  int timeout_s = 240;
+  // Reconnect backoff after an ERRORED stream (transport failure,
+  // unexpected status): exponential from initial to max, stretched by
+  // the per-node desync jitter; a server-named Retry-After wins.
+  double backoff_initial_s = 1.0;
+  double backoff_max_s = 30.0;
+  // Per-socket-op read timeout for the stream. Must exceed the server's
+  // bookmark/rotation cadence or idle streams read as drops.
+  int read_timeout_ms = 300000;
+};
+
+class NodeFeatureWatcher {
+ public:
+  // `published`: fills *out with the label set this daemon last landed
+  // in the sink and returns true, or returns false when nothing has
+  // been published yet (drift cannot be judged — events are ignored).
+  using PublishedFn = std::function<bool(lm::Labels* out)>;
+  // `on_drift`: foreign movement of the CR ("modified" / "deleted" /
+  // "missing"); fires on the watcher thread.
+  using DriftFn = std::function<void(const std::string& reason)>;
+  // `on_health`: the watch went (un)healthy; fires on the watcher
+  // thread. Healthy = an established stream that has not dropped.
+  using HealthFn = std::function<void(bool healthy)>;
+
+  NodeFeatureWatcher(ClusterConfig config, WatcherOptions options,
+                     PublishedFn published, DriftFn on_drift,
+                     HealthFn on_health = nullptr);
+  ~NodeFeatureWatcher();  // Stop()
+
+  NodeFeatureWatcher(const NodeFeatureWatcher&) = delete;
+  NodeFeatureWatcher& operator=(const NodeFeatureWatcher&) = delete;
+
+  void Start();
+  void Stop();
+
+  bool healthy() const { return healthy_.load(std::memory_order_relaxed); }
+  // Test hooks: stream sessions attempted / re-lists performed.
+  uint64_t sessions() const { return sessions_.load(); }
+  uint64_t relists() const { return relists_.load(); }
+
+ private:
+  void RunLoop();
+  void SetHealthy(bool healthy);
+  // Interruptible sleep; returns false when Stop() fired.
+  bool SleepFor(double seconds);
+
+  ClusterConfig config_;
+  WatcherOptions options_;
+  PublishedFn published_;
+  DriftFn on_drift_;
+  HealthFn on_health_;
+
+  std::thread thread_;
+  std::atomic<bool> stop_{false};
+  std::atomic<bool> healthy_{false};
+  std::atomic<int> stream_fd_{-1};
+  std::atomic<uint64_t> sessions_{0};
+  std::atomic<uint64_t> relists_{0};
+  std::mutex mu_;
+  std::condition_variable cv_;
+  bool started_ = false;
+};
+
+}  // namespace k8s
+}  // namespace tfd

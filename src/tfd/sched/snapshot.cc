@@ -140,14 +140,28 @@ void SnapshotStore::Register(const std::string& source,
   state.device_source = device_source;
 }
 
+void SnapshotStore::SetMovementCallback(std::function<void()> callback) {
+  std::lock_guard<std::mutex> lock(mu_);
+  movement_callback_ = std::move(callback);
+}
+
 void SnapshotStore::PutOk(const std::string& source, Snapshot snapshot) {
   // Memoized off the lock (and off the render path): probe workers pay
   // for the hash so the per-pass planner never does.
   uint64_t content_fingerprint = FullSnapshotFingerprint(snapshot);
+  std::function<void()> notify;
   {
     std::lock_guard<std::mutex> lock(mu_);
     auto it = states_.find(source);
     if (it == states_.end()) return;  // unregistered: dropped
+    // Movement = anything the pass planner's signature would see move:
+    // new content, a recovery (failing -> ok), or the first snapshot.
+    // An identical healthy re-probe is NOT movement — this is what
+    // keeps a quiet event-driven daemon at zero passes while its probe
+    // workers keep their own cadence.
+    bool moved = it->second.content_fingerprint != content_fingerprint ||
+                 !it->second.last_error.empty() ||
+                 !it->second.last_ok.has_value();
     snapshot.version = next_version_++;
     if (snapshot.taken_at == std::chrono::steady_clock::time_point()) {
       snapshot.taken_at = std::chrono::steady_clock::now();
@@ -160,26 +174,36 @@ void SnapshotStore::PutOk(const std::string& source, Snapshot snapshot) {
     it->second.fatal_error = false;
     it->second.consecutive_failures = 0;
     it->second.backoff_s = 0;
+    if (moved) notify = movement_callback_;
   }
   settled_cv_.notify_all();
+  if (notify) notify();  // outside the lock: the callback may Wait()ers
 }
 
 void SnapshotStore::PutError(const std::string& source,
                              const std::string& error, bool fatal) {
+  std::function<void()> notify;
   {
     std::lock_guard<std::mutex> lock(mu_);
     auto it = states_.find(source);
     if (it == states_.end()) return;
+    // A freshly failing source (or a fatal error) moves the planner's
+    // signature; a still-failing source re-failing does not.
+    bool moved = it->second.last_error.empty() || fatal ||
+                 !it->second.settled;
     it->second.settled = true;
     it->second.generation++;
     it->second.last_error = error;
     it->second.fatal_error = fatal;
     it->second.consecutive_failures++;
+    if (moved) notify = movement_callback_;
   }
   settled_cv_.notify_all();
+  if (notify) notify();
 }
 
 void SnapshotStore::InvalidateAll() {
+  std::function<void()> notify;
   {
     std::lock_guard<std::mutex> lock(mu_);
     for (auto& [name, state] : states_) {
@@ -193,10 +217,33 @@ void SnapshotStore::InvalidateAll() {
       state.backoff_s = 0;
       state.last_seen_tier = Tier::kNone;
     }
+    notify = movement_callback_;
   }
   obs::DefaultJournal().Record(
       "snapshots-invalidated", "",
       "every probe-source snapshot invalidated (config regen)");
+  if (notify) notify();
+}
+
+double SnapshotStore::SecondsUntilTierChange() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  double soonest = -1;
+  auto now = std::chrono::steady_clock::now();
+  for (const auto& [name, state] : states_) {
+    (void)name;
+    if (!state.last_ok.has_value()) continue;
+    double age =
+        std::chrono::duration<double>(now - state.last_ok->taken_at)
+            .count();
+    double next = -1;
+    if (age < state.policy.fresh_for_s) {
+      next = state.policy.fresh_for_s - age;
+    } else if (age < state.policy.usable_for_s) {
+      next = state.policy.usable_for_s - age;
+    }
+    if (next >= 0 && (soonest < 0 || next < soonest)) soonest = next;
+  }
+  return soonest;
 }
 
 void SnapshotStore::SetBackoff(const std::string& source, double backoff_s) {

@@ -28,6 +28,7 @@
 #include <chrono>
 #include <condition_variable>
 #include <cstdint>
+#include <functional>
 #include <map>
 #include <mutex>
 #include <optional>
@@ -146,6 +147,22 @@ class SnapshotStore {
   // the previous configuration must not outlive it).
   void InvalidateAll();
 
+  // Event-driven pass loop hook: called (outside the store lock, from
+  // the writing probe worker's thread) whenever a write MOVES what the
+  // pass planner's signature digests — new content fingerprint, a
+  // failing<->ok flip, first settle, InvalidateAll. An identical
+  // healthy re-probe deliberately does not fire it: that is what keeps
+  // a quiet daemon at zero passes while probe workers keep their own
+  // cadence. The callback must be thread-safe (the daemon passes
+  // WakeupMux::Notify).
+  void SetMovementCallback(std::function<void()> callback);
+
+  // Seconds until the earliest fresh->stale-usable or stale->expired
+  // boundary of any source holding a snapshot (-1: none pending). The
+  // event-driven loop folds this into its deadline so an age-driven
+  // tier change still dirties a pass with no probe write to announce it.
+  double SecondsUntilTierChange() const;
+
   void SetBackoff(const std::string& source, double backoff_s);
 
   SourceView View(const std::string& source) const;
@@ -194,6 +211,7 @@ class SnapshotStore {
   std::vector<std::string> order_;
   std::map<std::string, State> states_;
   uint64_t next_version_ = 1;
+  std::function<void()> movement_callback_;
 };
 
 }  // namespace sched

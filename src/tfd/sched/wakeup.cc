@@ -1,0 +1,166 @@
+#include "tfd/sched/wakeup.h"
+
+#include <poll.h>
+#include <string.h>
+#include <sys/eventfd.h>
+#include <sys/inotify.h>
+#include <sys/signalfd.h>
+#include <unistd.h>
+
+#include <algorithm>
+
+namespace tfd {
+namespace sched {
+
+namespace {
+constexpr uint32_t kInotifyMask = IN_MODIFY | IN_CLOSE_WRITE | IN_CREATE |
+                                  IN_DELETE | IN_MOVED_TO | IN_MOVED_FROM |
+                                  IN_MOVE_SELF | IN_DELETE_SELF;
+}  // namespace
+
+WakeupMux::~WakeupMux() {
+  if (event_fd_ >= 0) close(event_fd_);
+  if (signal_fd_ >= 0) close(signal_fd_);
+  if (inotify_fd_ >= 0) close(inotify_fd_);
+}
+
+Status WakeupMux::Init(const sigset_t& sigmask) {
+  event_fd_ = eventfd(0, EFD_NONBLOCK | EFD_CLOEXEC);
+  if (event_fd_ < 0) {
+    return Status::Error(std::string("eventfd: ") + strerror(errno));
+  }
+  signal_fd_ = signalfd(-1, &sigmask, SFD_NONBLOCK | SFD_CLOEXEC);
+  if (signal_fd_ < 0) {
+    return Status::Error(std::string("signalfd: ") + strerror(errno));
+  }
+  inotify_fd_ = inotify_init1(IN_NONBLOCK | IN_CLOEXEC);
+  if (inotify_fd_ < 0) {
+    return Status::Error(std::string("inotify_init1: ") + strerror(errno));
+  }
+  return Status::Ok();
+}
+
+void WakeupMux::WatchPath(const std::string& path) {
+  if (path.empty() || inotify_fd_ < 0) return;
+  for (const auto& [wd, existing] : watch_paths_) {
+    (void)wd;
+    if (existing == path) return;
+  }
+  if (std::find(unarmed_paths_.begin(), unarmed_paths_.end(), path) !=
+      unarmed_paths_.end()) {
+    return;
+  }
+  int wd = inotify_add_watch(inotify_fd_, path.c_str(), kInotifyMask);
+  if (wd >= 0) {
+    watch_paths_[wd] = path;
+  } else {
+    // Not there yet (a config file created later): re-armed per Wait().
+    unarmed_paths_.push_back(path);
+  }
+}
+
+void WakeupMux::ArmPendingPaths() {
+  for (auto it = unarmed_paths_.begin(); it != unarmed_paths_.end();) {
+    int wd = inotify_add_watch(inotify_fd_, it->c_str(), kInotifyMask);
+    if (wd >= 0) {
+      watch_paths_[wd] = *it;
+      it = unarmed_paths_.erase(it);
+    } else {
+      ++it;
+    }
+  }
+}
+
+void WakeupMux::Notify(Reason reason) {
+  pending_reasons_.fetch_or(static_cast<uint32_t>(reason),
+                            std::memory_order_relaxed);
+  if (event_fd_ >= 0) {
+    uint64_t one = 1;
+    // Best-effort: a full counter still wakes the poller.
+    (void)!write(event_fd_, &one, sizeof(one));
+  }
+}
+
+void WakeupMux::DrainEventFd(WakeResult* result) {
+  uint64_t value = 0;
+  while (read(event_fd_, &value, sizeof(value)) > 0) {
+  }
+  result->reasons |= pending_reasons_.exchange(0, std::memory_order_relaxed);
+}
+
+void WakeupMux::DrainSignalFd(WakeResult* result) {
+  signalfd_siginfo info;
+  // One signal per wake: the loop handles it (reload/exit/dump), then
+  // the next Wait() collects any further queued signal immediately
+  // (the fd stays readable, so poll returns at once).
+  ssize_t n = read(signal_fd_, &info, sizeof(info));
+  if (n == static_cast<ssize_t>(sizeof(info))) {
+    result->reasons |= static_cast<uint32_t>(Reason::kSignal);
+    result->signal = static_cast<int>(info.ssi_signo);
+  }
+}
+
+void WakeupMux::DrainInotify(WakeResult* result) {
+  char buf[4096] __attribute__((aligned(__alignof__(inotify_event))));
+  while (true) {
+    ssize_t len = read(inotify_fd_, buf, sizeof(buf));
+    if (len <= 0) break;
+    for (char* p = buf; p < buf + len;) {
+      auto* event = reinterpret_cast<inotify_event*>(p);
+      auto it = watch_paths_.find(event->wd);
+      if (it != watch_paths_.end()) {
+        result->reasons |= static_cast<uint32_t>(Reason::kInotify);
+        if (std::find(result->changed_paths.begin(),
+                      result->changed_paths.end(),
+                      it->second) == result->changed_paths.end()) {
+          result->changed_paths.push_back(it->second);
+        }
+        if (event->mask & (IN_DELETE_SELF | IN_MOVE_SELF | IN_IGNORED)) {
+          // The watched inode is gone; re-arm by path when (if) it
+          // reappears — an atomic rename-over (WriteFileAtomically's
+          // pattern) lands here on every rewrite of the file.
+          unarmed_paths_.push_back(it->second);
+          watch_paths_.erase(it);
+        }
+      }
+      p += sizeof(inotify_event) + event->len;
+    }
+  }
+}
+
+WakeupMux::WakeResult WakeupMux::Wait(double timeout_s) {
+  WakeResult result;
+  ArmPendingPaths();
+  // A Notify() that raced in before this Wait still has its eventfd
+  // byte pending, so poll returns immediately — no lost wakeups.
+  pollfd fds[3];
+  fds[0] = {event_fd_, POLLIN, 0};
+  fds[1] = {signal_fd_, POLLIN, 0};
+  fds[2] = {inotify_fd_, POLLIN, 0};
+  int timeout_ms =
+      timeout_s <= 0 ? 0
+                     : static_cast<int>(std::min(timeout_s * 1000.0,
+                                                 2147483000.0));
+  int ready = poll(fds, 3, timeout_ms);
+  if (ready <= 0) {
+    // Timeout (or EINTR, folded into a deadline pass: spurious at
+    // worst — the planner decides whether any work is owed).
+    result.reasons |= static_cast<uint32_t>(Reason::kDeadline);
+    // Collect any reason that raced in without an eventfd write.
+    result.reasons |=
+        pending_reasons_.exchange(0, std::memory_order_relaxed);
+    return result;
+  }
+  if (fds[0].revents & POLLIN) DrainEventFd(&result);
+  if (fds[1].revents & POLLIN) DrainSignalFd(&result);
+  if (fds[2].revents & POLLIN) DrainInotify(&result);
+  if (result.reasons == 0) {
+    // poll woke for something we could not attribute (e.g. an inotify
+    // event for an already-forgotten wd): treat as a deadline check.
+    result.reasons = static_cast<uint32_t>(Reason::kDeadline);
+  }
+  return result;
+}
+
+}  // namespace sched
+}  // namespace tfd

@@ -1,0 +1,100 @@
+// Wakeup multiplexer for the event-driven pass loop.
+//
+// The legacy loop sleeps a fixed --sleep-interval between passes, so a
+// perfectly quiet daemon still plans (and journals, and pays for) one
+// pass per interval forever. The multiplexer replaces that sleep with a
+// poll(2) over three kernel queues plus an explicit deadline:
+//
+//   eventfd   — cross-thread Notify(): probe-snapshot movement (the
+//               SnapshotStore's movement callback), watch-delivered CR
+//               drift (k8s/watch.h), anything else that should run a
+//               pass NOW. Reasons ride an atomic bitmask.
+//   signalfd  — the daemon's blocked signal set (SIGHUP reload, SIGUSR1
+//               dump, SIGINT/SIGTERM/SIGQUIT exit), replacing
+//               sigtimedwait without changing any semantics.
+//   inotify   — the local byte inputs that feed discovery: the config
+//               file and the plugin directory. A change behaves like
+//               SIGHUP (these are config-load-time inputs).
+//
+// plus a timer: the caller computes "the earliest moment any deadline
+// contract owes work" (anti-entropy refresh, state-file re-save,
+// snapshot tier boundary, interval cadence while degraded/suppressed)
+// and Wait() returns kDeadline when it arrives. A quiet daemon
+// therefore runs ZERO passes between events; every existing timed
+// contract still fires on time as an explicit deadline.
+//
+// Thread model: Wait() is called only by the pass loop; Notify() from
+// any thread.
+#pragma once
+
+#include <signal.h>
+
+#include <atomic>
+#include <cstdint>
+#include <map>
+#include <string>
+#include <vector>
+
+#include "tfd/util/status.h"
+
+namespace tfd {
+namespace sched {
+
+class WakeupMux {
+ public:
+  enum class Reason : uint32_t {
+    kSnapshot = 1,    // probe-snapshot movement (store callback)
+    kWatchDrift = 2,  // watch-delivered foreign CR movement
+    kInotify = 4,     // config file / plugin dir byte change
+    kSignal = 8,      // a blocked signal arrived (see WakeResult.signal)
+    kDeadline = 16,   // the caller's timer expired
+  };
+
+  struct WakeResult {
+    uint32_t reasons = 0;  // Reason bits (a wake can carry several)
+    int signal = 0;        // one collected signal (0 = none)
+    std::vector<std::string> changed_paths;  // inotify hits this wake
+  };
+
+  WakeupMux() = default;
+  ~WakeupMux();
+
+  WakeupMux(const WakeupMux&) = delete;
+  WakeupMux& operator=(const WakeupMux&) = delete;
+
+  // Creates the eventfd/signalfd/inotify trio. `sigmask` must already
+  // be blocked process-wide (main.cc does). Failure means the platform
+  // cannot multiplex — the caller falls back to the legacy loop.
+  Status Init(const sigset_t& sigmask);
+
+  // Watches one path (file or directory) for modify/create/delete/move.
+  // A file that does not exist yet is retried on every Wait(). Safe to
+  // call again with the same path (no-op).
+  void WatchPath(const std::string& path);
+
+  // Thread-safe: wakes a parked Wait() and tags it with `reason`.
+  void Notify(Reason reason);
+
+  // Parks until a notification, a signal, an inotify hit, or
+  // `timeout_s` elapses (<= 0: poll without blocking). Drains all ready
+  // sources so one wake reports every pending reason.
+  WakeResult Wait(double timeout_s);
+
+  bool initialized() const { return event_fd_ >= 0; }
+
+ private:
+  void DrainEventFd(WakeResult* result);
+  void DrainSignalFd(WakeResult* result);
+  void DrainInotify(WakeResult* result);
+  void ArmPendingPaths();
+
+  int event_fd_ = -1;
+  int signal_fd_ = -1;
+  int inotify_fd_ = -1;
+  std::atomic<uint32_t> pending_reasons_{0};
+  std::map<int, std::string> watch_paths_;       // wd -> path
+  std::vector<std::string> unarmed_paths_;       // not yet watchable
+};
+
+}  // namespace sched
+}  // namespace tfd

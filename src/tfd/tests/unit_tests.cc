@@ -30,6 +30,7 @@
 #include "tfd/k8s/breaker.h"
 #include "tfd/k8s/client.h"
 #include "tfd/k8s/desync.h"
+#include "tfd/k8s/watch.h"
 #include "tfd/lm/fragments.h"
 #include "tfd/lm/governor.h"
 #include "tfd/lm/labels.h"
@@ -49,6 +50,7 @@
 #include "tfd/sched/broker.h"
 #include "tfd/sched/snapshot.h"
 #include "tfd/sched/state.h"
+#include "tfd/sched/wakeup.h"
 #include "tfd/slice/coord.h"
 #include "tfd/slice/shape.h"
 #include "tfd/util/time.h"
@@ -5099,6 +5101,469 @@ void TestSliceRejoinDwell() {
   }
 }
 
+// ---- event-driven core (ISSUE 12): SSA ladder, watch, wakeup mux ---------
+
+// Chunk-encodes body parts for a Transfer-Encoding: chunked reply; part
+// boundaries become chunk boundaries, so a multi-part body exercises
+// the client's incremental de-chunker across reads.
+std::string ChunkEncode(const std::vector<std::string>& parts) {
+  std::string out;
+  for (const std::string& part : parts) {
+    char size[16];
+    snprintf(size, sizeof(size), "%zx\r\n", part.size());
+    out += size;
+    out += part;
+    out += "\r\n";
+  }
+  out += "0\r\n\r\n";
+  return out;
+}
+
+void TestRequestStreamChunked() {
+  // Three chunks, with an event line SPLIT across chunk boundaries: the
+  // streaming client must reassemble exactly the bytes a buffered read
+  // would have seen.
+  ScriptedApiServer server({
+      {200,
+       ChunkEncode({"line-one\nli", "ne-two\nline", "-three\n"}),
+       "Transfer-Encoding: chunked\r\n"},
+  });
+  std::string collected;
+  int head_status = 0;
+  http::StreamHandler handler;
+  handler.on_response = [&](const http::Response& head) {
+    head_status = head.status;
+    return true;
+  };
+  handler.on_data = [&](const char* data, size_t len) {
+    collected.append(data, len);
+    return true;
+  };
+  http::RequestOptions options;
+  Status s = http::RequestStream("GET", server.url() + "/stream", "",
+                                 options, handler);
+  CHECK_TRUE(s.ok());
+  CHECK_EQ(head_status, 200);
+  CHECK_EQ(collected, "line-one\nline-two\nline-three\n");
+
+  // Aborting mid-stream from on_data is a clean stop, not an error.
+  ScriptedApiServer abort_server({
+      {200, ChunkEncode({"a\n", "b\n", "c\n"}),
+       "Transfer-Encoding: chunked\r\n"},
+  });
+  int lines_seen = 0;
+  http::StreamHandler aborting;
+  aborting.on_response = [](const http::Response&) { return true; };
+  aborting.on_data = [&](const char* data, size_t len) {
+    (void)data;
+    (void)len;
+    return ++lines_seen < 1;
+  };
+  CHECK_TRUE(http::RequestStream("GET", abort_server.url() + "/s", "",
+                                 options, aborting)
+                 .ok());
+  CHECK_EQ(lines_seen, 1);
+}
+
+void TestWatchEventParse() {
+  // Grid pinned cross-language: tests/test_fleet.py parses the SAME
+  // lines through tpufd.sink.parse_watch_event and must agree.
+  k8s::WatchEvent added = k8s::ParseWatchEventLine(
+      "{\"type\":\"ADDED\",\"object\":{\"metadata\":{\"resourceVersion\":"
+      "\"5\"},\"spec\":{\"labels\":{\"google.com/tpu.count\":\"4\"}}}}");
+  CHECK_TRUE(added.type == k8s::WatchEvent::Type::kAdded);
+  CHECK_EQ(added.resource_version, "5");
+  CHECK_TRUE(added.has_labels);
+  CHECK_EQ(added.labels.at("google.com/tpu.count"), "4");
+
+  k8s::WatchEvent modified = k8s::ParseWatchEventLine(
+      "{\"type\":\"MODIFIED\",\"object\":{\"metadata\":{\"resourceVersion"
+      "\":\"6\"},\"spec\":{\"labels\":{\"a\":\"1\",\"junk\":7}}}}");
+  CHECK_TRUE(modified.type == k8s::WatchEvent::Type::kModified);
+  CHECK_EQ(modified.resource_version, "6");
+  // Non-string values read as absent (the client.cc ExtractSpecLabels
+  // rule).
+  CHECK_EQ(modified.labels.size(), static_cast<size_t>(1));
+
+  k8s::WatchEvent deleted = k8s::ParseWatchEventLine(
+      "{\"type\":\"DELETED\",\"object\":{\"metadata\":{\"resourceVersion\""
+      ":\"7\"},\"spec\":{\"labels\":{}}}}");
+  CHECK_TRUE(deleted.type == k8s::WatchEvent::Type::kDeleted);
+
+  k8s::WatchEvent bookmark = k8s::ParseWatchEventLine(
+      "{\"type\":\"BOOKMARK\",\"object\":{\"metadata\":{\"resourceVersion"
+      "\":\"41\"}}}");
+  CHECK_TRUE(bookmark.type == k8s::WatchEvent::Type::kBookmark);
+  CHECK_EQ(bookmark.resource_version, "41");
+  CHECK_TRUE(!bookmark.has_labels);
+
+  k8s::WatchEvent gone = k8s::ParseWatchEventLine(
+      "{\"type\":\"ERROR\",\"object\":{\"kind\":\"Status\",\"code\":410,"
+      "\"message\":\"too old resource version\"}}");
+  CHECK_TRUE(gone.type == k8s::WatchEvent::Type::kError);
+  CHECK_EQ(gone.error_code, 410);
+
+  // Hostile/unknown input degrades to kUnknown, never throws.
+  CHECK_TRUE(k8s::ParseWatchEventLine("not json").type ==
+             k8s::WatchEvent::Type::kUnknown);
+  CHECK_TRUE(k8s::ParseWatchEventLine("{}").type ==
+             k8s::WatchEvent::Type::kUnknown);
+  CHECK_TRUE(k8s::ParseWatchEventLine(
+                 "{\"type\":\"PATCHED\",\"object\":{}}")
+                 .type == k8s::WatchEvent::Type::kUnknown);
+  CHECK_TRUE(k8s::ParseWatchEventLine("{\"type\":\"ADDED\"}").type ==
+             k8s::WatchEvent::Type::kAdded);
+}
+
+void TestSinkApplyLadder() {
+  // Rung 1 — server-side apply: ONE self-contained PATCH of the full
+  // desired object under ?fieldManager=tfd&force=true. No GET, ever.
+  {
+    ScriptedApiServer server({
+        {200, "{\"metadata\":{\"resourceVersion\":\"3\"}}"},
+        {200, "{\"metadata\":{\"resourceVersion\":\"4\"}}"},
+    });
+    k8s::ClusterConfig cluster = ScriptedCluster(server);
+    cluster.use_apply = true;
+    k8s::SinkState state;
+    k8s::WriteOutcome outcome;
+    lm::Labels labels{{"google.com/tpu.count", "4"}};
+    bool transient = true;
+    CHECK_TRUE(k8s::UpdateNodeFeature(cluster, labels, &transient, &state,
+                                      &outcome)
+                   .ok());
+    CHECK_EQ(outcome.gets, 0);
+    CHECK_EQ(outcome.applies, 1);
+    CHECK_EQ(outcome.patches, 1);
+    CHECK_EQ(outcome.puts, 0);
+    CHECK_TRUE(state.known);
+    CHECK_EQ(state.resource_version, "3");
+    labels["google.com/tpu.count"] = "8";
+    k8s::WriteOutcome second;
+    CHECK_TRUE(k8s::UpdateNodeFeature(cluster, labels, &transient, &state,
+                                      &second)
+                   .ok());
+    CHECK_EQ(second.gets, 0);
+    CHECK_EQ(second.applies, 1);
+    CHECK_EQ(server.exchanges().size(), static_cast<size_t>(2));
+    const ScriptedApiServer::Exchange& first = server.exchanges()[0];
+    CHECK_EQ(first.method, "PATCH");
+    CHECK_TRUE(first.path.find("fieldManager=tfd") != std::string::npos);
+    CHECK_TRUE(first.path.find("force=true") != std::string::npos);
+    // The apply body is the FULL desired object (JSON is valid YAML),
+    // including the NFD node-name attribution label.
+    CHECK_TRUE(first.body.find("\"apiVersion\":\"nfd.k8s-sigs.io/"
+                               "v1alpha1\"") != std::string::npos);
+    CHECK_TRUE(first.body.find("\"google.com/tpu.count\":\"4\"") !=
+               std::string::npos);
+    CHECK_TRUE(first.body.find("nfd.node.kubernetes.io/node-name") !=
+               std::string::npos);
+  }
+
+  // Rung 2 — apply rejected (415): demote to the merge-patch diff flow
+  // in the SAME call, and REMEMBER per-process (the second write goes
+  // straight to merge patch, no apply attempt).
+  {
+    ScriptedApiServer server({
+        {415, "{}"},
+        {200,
+         "{\"metadata\":{\"name\":\"tfd-features-for-unit-node\","
+         "\"resourceVersion\":\"5\",\"labels\":{"
+         "\"nfd.node.kubernetes.io/node-name\":\"unit-node\"}},"
+         "\"spec\":{\"labels\":{\"google.com/tpu.count\":\"2\"}}}"},
+        {200, "{\"metadata\":{\"resourceVersion\":\"6\"}}"},
+        {200, "{\"metadata\":{\"resourceVersion\":\"7\"}}"},
+    });
+    k8s::ClusterConfig cluster = ScriptedCluster(server);
+    cluster.use_apply = true;
+    k8s::SinkState state;
+    k8s::WriteOutcome outcome;
+    lm::Labels labels{{"google.com/tpu.count", "4"}};
+    bool transient = true;
+    CHECK_TRUE(k8s::UpdateNodeFeature(cluster, labels, &transient, &state,
+                                      &outcome)
+                   .ok());
+    CHECK_TRUE(state.apply_unsupported);
+    CHECK_EQ(outcome.applies, 1);
+    CHECK_EQ(outcome.gets, 1);
+    CHECK_EQ(outcome.patches, 2);  // the rejected apply + the merge patch
+    labels["google.com/tpu.count"] = "8";
+    k8s::WriteOutcome second;
+    CHECK_TRUE(k8s::UpdateNodeFeature(cluster, labels, &transient, &state,
+                                      &second)
+                   .ok());
+    CHECK_EQ(second.applies, 0);  // remembered: no more apply attempts
+    CHECK_EQ(second.gets, 0);     // the diff flow's zero-GET dirty write
+    CHECK_EQ(second.patches, 1);
+    CHECK_EQ(server.exchanges().size(), static_cast<size_t>(4));
+    CHECK_TRUE(server.exchanges()[0].path.find("fieldManager") !=
+               std::string::npos);
+    CHECK_EQ(server.exchanges()[1].method, "GET");
+    CHECK_TRUE(server.exchanges()[2].path.find("fieldManager") ==
+               std::string::npos);
+    CHECK_TRUE(server.exchanges()[3].body.find("\"8\"") !=
+               std::string::npos);
+  }
+
+  // Rung 3 — apply AND merge patch rejected: the reference GET+PUT
+  // bottom rung. Foreign METADATA survives the PUT (mutate-fetched),
+  // but foreign spec.labels are clobbered wholesale — the documented
+  // tradeoff of losing SSA field ownership.
+  {
+    const char* foreign_cr =
+        "{\"metadata\":{\"name\":\"tfd-features-for-unit-node\","
+        "\"resourceVersion\":\"8\",\"labels\":{"
+        "\"nfd.node.kubernetes.io/node-name\":\"unit-node\"},"
+        "\"annotations\":{\"foreign/note\":\"keep-me\"}},"
+        "\"spec\":{\"labels\":{\"foreign.io/label\":\"clobbered\"}}}";
+    ScriptedApiServer server({
+        {415, "{}"},           // apply rejected
+        {200, foreign_cr},     // GET (merge-patch attempt's read)
+        {415, "{}"},           // merge patch rejected too
+        {200, foreign_cr},     // GET (PUT attempt's read)
+        {200, "{\"metadata\":{\"resourceVersion\":\"9\"}}"},  // PUT
+    });
+    k8s::ClusterConfig cluster = ScriptedCluster(server);
+    cluster.use_apply = true;
+    k8s::SinkState state;
+    k8s::WriteOutcome outcome;
+    lm::Labels labels{{"google.com/tpu.count", "4"}};
+    bool transient = true;
+    CHECK_TRUE(k8s::UpdateNodeFeature(cluster, labels, &transient, &state,
+                                      &outcome)
+                   .ok());
+    CHECK_TRUE(state.apply_unsupported);
+    CHECK_TRUE(state.patch_unsupported);
+    CHECK_EQ(outcome.puts, 1);
+    CHECK_EQ(server.exchanges().size(), static_cast<size_t>(5));
+    const std::string& put_body = server.exchanges()[4].body;
+    CHECK_EQ(server.exchanges()[4].method, "PUT");
+    // Foreign metadata survives; foreign spec.labels do not.
+    CHECK_TRUE(put_body.find("keep-me") != std::string::npos);
+    CHECK_TRUE(put_body.find("clobbered") == std::string::npos);
+    CHECK_TRUE(put_body.find("\"google.com/tpu.count\":\"4\"") !=
+               std::string::npos);
+  }
+
+  // Transient classification: a 500 on the apply is transient (the
+  // breaker's food), a 403 is not.
+  for (int status : {500, 403}) {
+    ScriptedApiServer server({{status, "{}"}});
+    k8s::ClusterConfig cluster = ScriptedCluster(server);
+    cluster.use_apply = true;
+    k8s::SinkState state;
+    bool transient = (status == 403);  // primed opposite
+    CHECK_TRUE(!k8s::UpdateNodeFeature(cluster,
+                                       {{"google.com/tpu.count", "1"}},
+                                       &transient, &state, nullptr)
+                    .ok());
+    CHECK_EQ(transient, status == 500);
+  }
+}
+
+void TestWatcherResyncAndDrift() {
+  // The watcher's whole contract against a scripted stream:
+  //   list -> watch(events incl. a self-echo, foreign drift, 410) ->
+  //   exactly ONE re-list -> re-watch (clean rotation) -> re-watch.
+  std::string cr_listed =
+      "{\"metadata\":{\"name\":\"tfd-features-for-unit-node\","
+      "\"resourceVersion\":\"5\"},"
+      "\"spec\":{\"labels\":{\"google.com/tpu.count\":\"4\"}}}";
+  ScriptedApiServer server({
+      {200, cr_listed},  // initial list
+      {200,
+       ChunkEncode({
+           // Self-echo: OUR published key intact, a foreign manager's
+           // key present — not drift under SSA ownership.
+           "{\"type\":\"MODIFIED\",\"object\":{\"metadata\":{"
+           "\"resourceVersion\":\"6\"},\"spec\":{\"labels\":{"
+           "\"google.com/tpu.count\":\"4\",\"foreign.io/x\":\"1\"}}}}\n",
+           // Foreign drift: our key MOVED.
+           "{\"type\":\"MODIFIED\",\"object\":{\"metadata\":{"
+           "\"resourceVersion\":\"7\"},\"spec\":{\"labels\":{"
+           "\"google.com/tpu.count\":\"2\"}}}}\n",
+           // Compaction: resync owed.
+           "{\"type\":\"ERROR\",\"object\":{\"kind\":\"Status\","
+           "\"code\":410}}\n",
+       }),
+       "Transfer-Encoding: chunked\r\n"},
+      {200, cr_listed},  // the ONE re-list
+      {200, ChunkEncode({"{\"type\":\"BOOKMARK\",\"object\":{\"metadata\""
+                         ":{\"resourceVersion\":\"9\"}}}\n"}),
+       "Transfer-Encoding: chunked\r\n"},  // clean rotation
+  });
+  k8s::ClusterConfig cluster = ScriptedCluster(server);
+  std::atomic<int> drifts{0};
+  std::atomic<int> healthy_flips{0};
+  k8s::WatcherOptions options;
+  options.timeout_s = 1;
+  options.read_timeout_ms = 10000;
+  k8s::NodeFeatureWatcher watcher(
+      cluster, options,
+      [](lm::Labels* out) {
+        (*out)["google.com/tpu.count"] = "4";
+        return true;
+      },
+      [&](const std::string& reason) {
+        (void)reason;
+        drifts.fetch_add(1);
+      },
+      [&](bool healthy) {
+        if (healthy) healthy_flips.fetch_add(1);
+      });
+  watcher.Start();
+  for (int i = 0; i < 100; i++) {
+    if (watcher.relists() >= 2 && drifts.load() >= 1 &&
+        watcher.sessions() >= 2) {
+      break;
+    }
+    usleep(50 * 1000);
+  }
+  watcher.Stop();
+  CHECK_EQ(drifts.load(), 1);  // the echo did NOT read as drift
+  CHECK_EQ(watcher.relists(), static_cast<uint64_t>(2));  // 410 -> one
+  CHECK_TRUE(watcher.sessions() >= 2);
+  CHECK_TRUE(healthy_flips.load() >= 1);
+  // Wire truth: GET, WATCH, GET, WATCH ... — the 410 cost exactly one
+  // extra GET, and every watch carries watch=true + bookmarks.
+  CHECK_EQ(server.exchanges()[0].method, "GET");
+  CHECK_TRUE(server.exchanges()[1].path.find("watch=true") !=
+             std::string::npos);
+  CHECK_TRUE(server.exchanges()[1].path.find("allowWatchBookmarks=true") !=
+             std::string::npos);
+  CHECK_TRUE(server.exchanges()[1].path.find("resourceVersion=5") !=
+             std::string::npos);
+  CHECK_EQ(server.exchanges()[2].method, "GET");
+  CHECK_TRUE(server.exchanges()[2].path.find("watch=true") ==
+             std::string::npos);
+}
+
+void TestWakeupMux() {
+  sigset_t mask;
+  sigemptyset(&mask);
+  sigaddset(&mask, SIGUSR2);
+  sigprocmask(SIG_BLOCK, &mask, nullptr);
+  sched::WakeupMux mux;
+  CHECK_TRUE(mux.Init(mask).ok());
+  CHECK_TRUE(mux.initialized());
+  using Reason = sched::WakeupMux::Reason;
+
+  // Pure timeout -> deadline reason.
+  sched::WakeupMux::WakeResult wake = mux.Wait(0.02);
+  CHECK_EQ(wake.reasons, static_cast<uint32_t>(Reason::kDeadline));
+
+  // A notify BEFORE the wait is not lost (eventfd holds the byte).
+  mux.Notify(Reason::kSnapshot);
+  wake = mux.Wait(1.0);
+  CHECK_TRUE(wake.reasons & static_cast<uint32_t>(Reason::kSnapshot));
+
+  // Cross-thread notify wakes a parked wait; combined reasons merge.
+  std::thread notifier([&mux] {
+    usleep(20 * 1000);
+    mux.Notify(Reason::kWatchDrift);
+    mux.Notify(Reason::kSnapshot);
+  });
+  wake = mux.Wait(2.0);
+  notifier.join();
+  // (Both may land in one wake or two; drain the second if needed.)
+  uint32_t seen = wake.reasons;
+  if (!(seen & static_cast<uint32_t>(Reason::kSnapshot)) ||
+      !(seen & static_cast<uint32_t>(Reason::kWatchDrift))) {
+    seen |= mux.Wait(0.2).reasons;
+  }
+  CHECK_TRUE(seen & static_cast<uint32_t>(Reason::kWatchDrift));
+  CHECK_TRUE(seen & static_cast<uint32_t>(Reason::kSnapshot));
+
+  // inotify: modify, then ATOMIC-RENAME-OVER (the WriteFileAtomically
+  // pattern every config rewrite uses), then modify the new inode — the
+  // watch must survive the inode swap via the re-arm path.
+  char dir_template[] = "/tmp/tfd-wakeup-XXXXXX";
+  std::string dir = mkdtemp(dir_template);
+  std::string config_path = dir + "/config.yaml";
+  WriteFileAtomically(config_path, "a: 1\n");
+  mux.WatchPath(config_path);
+  {
+    std::ofstream out(config_path, std::ios::app);
+    out << "b: 2\n";
+  }
+  wake = mux.Wait(2.0);
+  CHECK_TRUE(wake.reasons & static_cast<uint32_t>(Reason::kInotify));
+  CHECK_TRUE(!wake.changed_paths.empty());
+  CHECK_EQ(wake.changed_paths[0], config_path);
+  WriteFileAtomically(config_path, "c: 3\n");  // rename-over
+  wake = mux.Wait(2.0);
+  CHECK_TRUE(wake.reasons & static_cast<uint32_t>(Reason::kInotify));
+  mux.Wait(0.05);  // drain + re-arm the fresh inode
+  {
+    std::ofstream out(config_path, std::ios::app);
+    out << "d: 4\n";
+  }
+  wake = mux.Wait(2.0);
+  CHECK_TRUE(wake.reasons & static_cast<uint32_t>(Reason::kInotify));
+
+  // A blocked signal surfaces through the signalfd with its number.
+  raise(SIGUSR2);
+  wake = mux.Wait(2.0);
+  CHECK_TRUE(wake.reasons & static_cast<uint32_t>(Reason::kSignal));
+  CHECK_EQ(wake.signal, SIGUSR2);
+
+  unlink(config_path.c_str());
+  rmdir(dir.c_str());
+  sigprocmask(SIG_UNBLOCK, &mask, nullptr);
+}
+
+void TestSnapshotMovementNotify() {
+  sched::SnapshotStore store;
+  sched::TierPolicy policy;
+  policy.fresh_for_s = 100;
+  policy.usable_for_s = 200;
+  store.Register("mock", policy, /*device_source=*/true);
+  std::atomic<int> notifies{0};
+  store.SetMovementCallback([&notifies] { notifies.fetch_add(1); });
+
+  sched::Snapshot snap;
+  snap.labels = {{"google.com/tpu.count", "4"}};
+  store.PutOk("mock", snap);
+  CHECK_EQ(notifies.load(), 1);  // first snapshot is movement
+
+  // The quiet-daemon contract: an identical healthy re-probe is NOT
+  // movement (generation bumps, callback does not fire).
+  sched::Snapshot same;
+  same.labels = {{"google.com/tpu.count", "4"}};
+  store.PutOk("mock", same);
+  CHECK_EQ(notifies.load(), 1);
+
+  sched::Snapshot changed;
+  changed.labels = {{"google.com/tpu.count", "2"}};
+  store.PutOk("mock", changed);
+  CHECK_EQ(notifies.load(), 2);  // content moved
+
+  store.PutError("mock", "chips busy");
+  CHECK_EQ(notifies.load(), 3);  // ok -> failing flips the signature
+  store.PutError("mock", "chips busy again");
+  CHECK_EQ(notifies.load(), 3);  // still-failing re-fail: no movement
+  sched::Snapshot recovered;
+  recovered.labels = {{"google.com/tpu.count", "2"}};
+  store.PutOk("mock", recovered);
+  CHECK_EQ(notifies.load(), 4);  // failing -> ok flips back
+
+  store.InvalidateAll();
+  CHECK_EQ(notifies.load(), 5);
+
+  // Tier-boundary timer: a fresh snapshot's next change is the fresh
+  // window's edge; an aged one reports the usable edge; expired = none.
+  sched::Snapshot fresh;
+  fresh.labels = {{"google.com/tpu.count", "2"}};
+  store.PutOk("mock", fresh);
+  double next = store.SecondsUntilTierChange();
+  CHECK_TRUE(next > 95 && next <= 100);
+  store.AgeForTest("mock", 150);
+  next = store.SecondsUntilTierChange();
+  CHECK_TRUE(next > 45 && next <= 50);
+  store.AgeForTest("mock", 100);  // now past usable (age 250)
+  CHECK_EQ(store.SecondsUntilTierChange(), -1.0);
+}
+
 }  // namespace
 }  // namespace tfd
 
@@ -5231,6 +5696,12 @@ int main(int argc, char** argv) {
   tfd::TestPluginRoundContainment();
   tfd::TestHealthsmFlapEvidence();
   tfd::TestSliceRejoinDwell();
+  tfd::TestRequestStreamChunked();
+  tfd::TestWatchEventParse();
+  tfd::TestSinkApplyLadder();
+  tfd::TestWatcherResyncAndDrift();
+  tfd::TestWakeupMux();
+  tfd::TestSnapshotMovementNotify();
 
   std::cerr << tfd::g_checks << " checks, " << tfd::g_failures << " failures"
             << std::endl;

@@ -555,5 +555,212 @@ Result<Response> Request(const std::string& method, const std::string& url,
   return ParseResponse(raw);
 }
 
+namespace {
+
+// Incremental de-chunker for streamed bodies: Feed() consumes raw wire
+// bytes and emits decoded payload via the sink; tolerates chunk
+// boundaries (size lines, payload, trailing CRLFs) landing anywhere in
+// a read. Content-length / read-to-close bodies bypass it.
+class ChunkDecoder {
+ public:
+  // Returns false when the sink asked to stop. `done` is set once the
+  // terminal 0-length chunk has been consumed.
+  bool Feed(const char* data, size_t len,
+            const std::function<bool(const char*, size_t)>& sink,
+            bool* done) {
+    size_t i = 0;
+    while (i < len) {
+      switch (state_) {
+        case State::kSize: {
+          char c = data[i++];
+          if (c == '\n') {
+            long chunk = strtol(size_line_.c_str(), nullptr, 16);
+            size_line_.clear();
+            if (chunk <= 0) {
+              state_ = State::kDone;
+              *done = true;
+              return true;
+            }
+            remaining_ = static_cast<size_t>(chunk);
+            state_ = State::kData;
+          } else if (c != '\r') {
+            size_line_ += c;
+            if (size_line_.size() > 32) size_line_.erase(0, 16);
+          }
+          break;
+        }
+        case State::kData: {
+          size_t take = std::min(len - i, remaining_);
+          if (sink && !sink(data + i, take)) return false;
+          i += take;
+          remaining_ -= take;
+          if (remaining_ == 0) {
+            crlf_left_ = 2;
+            state_ = State::kCrlf;
+          }
+          break;
+        }
+        case State::kCrlf: {
+          i++;  // \r then \n; content not validated (hostile peers get
+          crlf_left_--;  // garbage surfaced by the size parse instead)
+          if (crlf_left_ == 0) state_ = State::kSize;
+          break;
+        }
+        case State::kDone:
+          return true;  // trailers ignored
+      }
+    }
+    return true;
+  }
+
+ private:
+  enum class State { kSize, kData, kCrlf, kDone };
+  State state_ = State::kSize;
+  std::string size_line_;
+  size_t remaining_ = 0;
+  int crlf_left_ = 0;
+};
+
+}  // namespace
+
+Status RequestStream(const std::string& method, const std::string& url,
+                     const std::string& body,
+                     const RequestOptions& options,
+                     const StreamHandler& handler) {
+  if (options.server_reached != nullptr) *options.server_reached = false;
+  static std::once_flag sigpipe_once;
+  std::call_once(sigpipe_once, [] { signal(SIGPIPE, SIG_IGN); });
+
+  Result<Url> parsed = ParseUrl(url);
+  if (!parsed.ok()) return Status::Error(parsed.error());
+
+  auto t0 = std::chrono::steady_clock::now();
+  auto over_deadline = [&options, t0] {
+    if (options.deadline_ms <= 0) return false;
+    return std::chrono::steady_clock::now() - t0 >=
+           std::chrono::milliseconds(options.deadline_ms);
+  };
+
+  int connect_timeout_ms = options.connect_timeout_ms > 0
+                               ? options.connect_timeout_ms
+                               : options.timeout_ms;
+  Result<int> fd = Connect(*parsed, connect_timeout_ms);
+  if (!fd.ok()) return Status::Error(fd.error());
+  if (options.server_reached != nullptr) *options.server_reached = true;
+  if (handler.on_connected) handler.on_connected(*fd);
+  if (connect_timeout_ms != options.timeout_ms) {
+    // Restore the stream's long per-op read/write timeouts (Connect
+    // installed the short connect bound on the socket).
+    timeval tv{};
+    tv.tv_sec = options.timeout_ms / 1000;
+    tv.tv_usec = (options.timeout_ms % 1000) * 1000;
+    setsockopt(*fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    setsockopt(*fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  }
+
+  std::unique_ptr<Transport> transport;
+  if (parsed->tls) {
+    Result<std::unique_ptr<Transport>> tls =
+        TlsTransport::Create(*fd, *parsed, options);
+    if (!tls.ok()) return Status::Error(tls.error());
+    transport = std::move(*tls);
+  } else {
+    transport = std::make_unique<PlainTransport>(*fd);
+  }
+
+  std::string host_header = parsed->host.find(':') != std::string::npos
+                                ? "[" + parsed->host + "]"
+                                : parsed->host;
+  if (parsed->port != (parsed->tls ? 443 : 80)) {
+    host_header += ":" + std::to_string(parsed->port);
+  }
+  std::string request = method + " " + parsed->path + " HTTP/1.1\r\n" +
+                        "Host: " + host_header + "\r\n";
+  for (const auto& [k, v] : options.headers) {
+    request += k + ": " + v + "\r\n";
+  }
+  if (!body.empty() || method == "POST" || method == "PUT") {
+    request += "Content-Length: " + std::to_string(body.size()) + "\r\n";
+  }
+  request += "Connection: close\r\n\r\n" + body;
+
+  size_t off = 0;
+  while (off < request.size()) {
+    if (over_deadline()) {
+      return Status::Error("request deadline exceeded (sending)");
+    }
+    Result<int> n = transport->Write(request.data() + off,
+                                     static_cast<int>(request.size() - off));
+    if (!n.ok()) return Status::Error("send failed: " + n.error());
+    off += static_cast<size_t>(*n);
+  }
+
+  // Incremental read: headers first, then the body streamed through the
+  // de-chunker (or raw for content-length / read-to-close responses).
+  std::string raw;
+  Response head;
+  bool have_head = false;
+  bool chunked = false;
+  bool stream_done = false;
+  long long content_length = -1;
+  long long body_seen = 0;
+  ChunkDecoder decoder;
+  char buf[8192];
+  while (!stream_done) {
+    if (over_deadline()) {
+      return Status::Error("request deadline exceeded (receiving)");
+    }
+    Result<int> n = transport->Read(buf, sizeof(buf));
+    if (!n.ok()) return Status::Error("recv failed: " + n.error());
+    if (*n == 0) break;  // peer closed: read-to-close bodies end here
+    const char* data = buf;
+    size_t len = static_cast<size_t>(*n);
+    if (!have_head) {
+      raw.append(data, len);
+      if (raw.size() > 1024 * 1024) {
+        return Status::Error("HTTP response headers too large");
+      }
+      size_t header_end = raw.find("\r\n\r\n");
+      if (header_end == std::string::npos) continue;
+      Result<Response> parsed_head =
+          ParseResponse(raw.substr(0, header_end) + "\r\n\r\n");
+      if (!parsed_head.ok()) return parsed_head.status();
+      head = std::move(*parsed_head);
+      have_head = true;
+      auto te = head.headers.find("transfer-encoding");
+      chunked = te != head.headers.end() &&
+                ToLower(te->second).find("chunked") != std::string::npos;
+      if (auto cl = head.headers.find("content-length");
+          cl != head.headers.end()) {
+        content_length = atoll(cl->second.c_str());
+      }
+      if (handler.on_response && !handler.on_response(head)) {
+        return Status::Ok();  // caller aborted after the head
+      }
+      data = raw.data() + header_end + 4;
+      len = raw.size() - header_end - 4;
+      if (len == 0) {
+        if (content_length == 0) break;
+        continue;
+      }
+    }
+    if (chunked) {
+      if (!decoder.Feed(data, len, handler.on_data, &stream_done)) {
+        return Status::Ok();  // caller aborted mid-stream
+      }
+    } else {
+      body_seen += static_cast<long long>(len);
+      if (handler.on_data && !handler.on_data(data, len)) {
+        return Status::Ok();
+      }
+      if (content_length >= 0 && body_seen >= content_length) break;
+    }
+  }
+  if (!have_head) {
+    return Status::Error("connection closed before response headers");
+  }
+  return Status::Ok();
+}
+
 }  // namespace http
 }  // namespace tfd

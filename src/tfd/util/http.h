@@ -8,6 +8,7 @@
 // OpenSSL, https:// requests fail cleanly and http:// still works.
 #pragma once
 
+#include <functional>
 #include <map>
 #include <string>
 
@@ -48,6 +49,14 @@ struct RequestOptions {
   std::string ca_file;      // PEM bundle for server verification (https)
   bool insecure = false;    // skip server verification (tests only)
   int timeout_ms = 5000;    // per socket operation
+  // Separate bound for connection ESTABLISHMENT in RequestStream (0 =
+  // use timeout_ms). A watch stream legitimately idles for minutes
+  // between reads (timeout_ms must exceed the bookmark cadence), but a
+  // blackholed endpoint must fail the CONNECT in seconds — and before
+  // on_connected has published an fd, the caller's shutdown(2) stop
+  // hook cannot unblock it. Request() ignores this (its timeout_ms is
+  // already short).
+  int connect_timeout_ms = 0;
   // Total wall-clock budget for the WHOLE request (resolve + connect +
   // TLS + send + receive). timeout_ms bounds each socket stall; this
   // bounds their sum, so a peer dribbling one byte per timeout window
@@ -83,6 +92,26 @@ Result<Response> Request(const std::string& method, const std::string& url,
 // chunked transfer-encoding decoding). Exposed for the fuzzers and
 // hostile-input tests — production callers go through Request.
 Result<Response> ParseResponse(const std::string& raw);
+
+// Streaming request for long-lived responses (the Kubernetes WATCH):
+// the header block is parsed into a Response (body empty) and handed to
+// `on_response`; decoded body bytes (chunked framing removed) are then
+// delivered incrementally to `on_data` as they arrive, instead of being
+// buffered until the connection closes. Either callback returning false
+// aborts the stream cleanly (RequestStream returns Ok — the caller
+// asked to stop). `on_connected` (optional) receives the raw socket fd
+// right after the TCP connection lands, so another thread can
+// shutdown(2) it to unblock a pending read — the watcher's prompt-stop
+// hook; the fd must not be closed through it (the transport owns it).
+struct StreamHandler {
+  std::function<void(int fd)> on_connected;
+  std::function<bool(const Response& head)> on_response;
+  std::function<bool(const char* data, size_t len)> on_data;
+};
+
+Status RequestStream(const std::string& method, const std::string& url,
+                     const std::string& body, const RequestOptions& options,
+                     const StreamHandler& handler);
 
 }  // namespace http
 }  // namespace tfd

@@ -131,6 +131,28 @@ class TestHelmChart:
         assert values["introspection"]["enabled"] is True
         assert 1 <= values["introspection"]["port"] <= 65535
 
+    def test_event_driven_knobs_wired(self):
+        """The event-driven-core knobs (ISSUE 12): helm values
+        sinkApply/sinkWatch/eventDriven -> daemonset TFD_* envs, and
+        the 3 static daemonsets carrying them at the daemon defaults
+        (all on — the zero-poll core IS the shipped configuration;
+        eventDriven=false is the bisection escape hatch)."""
+        values = yaml.safe_load((HELM / "values.yaml").read_text())
+        assert values["sinkApply"] is True
+        assert values["sinkWatch"] is True
+        assert values["eventDriven"] is True
+        template = (HELM / "templates" / "daemonset.yml").read_text()
+        for env in ("TFD_SINK_APPLY", "TFD_SINK_WATCH",
+                    "TFD_EVENT_DRIVEN"):
+            assert env in template, env
+        for path in STATIC_YAMLS:
+            ds = yaml.safe_load(path.read_text())
+            env = {e["name"]: e.get("value") for e in
+                   ds["spec"]["template"]["spec"]["containers"][0]["env"]}
+            assert env["TFD_SINK_APPLY"] == "true", path.name
+            assert env["TFD_SINK_WATCH"] == "true", path.name
+            assert env["TFD_EVENT_DRIVEN"] == "true", path.name
+
     def test_slice_coordination_knobs_wired(self):
         """The slice-coherence knobs (ISSUE 10): helm values ->
         daemonset TFD_SLICE_* envs, configmaps RBAC gated on

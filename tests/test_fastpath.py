@@ -258,7 +258,11 @@ class TestSinkOutageDetection:
         anti-entropy refresh doubles as the liveness probe. Kill the
         fake apiserver mid-steady-state and the outage must surface as
         a journaled `sink-outage` + tfd_sink_outages_total within the
-        refresh cadence; healing the server recovers the sink."""
+        refresh cadence; healing the server recovers the sink.
+        (--sink-watch=false: this pins the FALLBACK detector, the only
+        one a watchless config has — with the watch on, the refresh is
+        demoted to a >= 10 min self-check and outages surface instantly
+        at watch-drop time instead; tests/test_watch.py pins that.)"""
         from tpufd.fakes.apiserver import FakeApiServer
 
         with FakeApiServer(token="soak-token") as server:
@@ -272,7 +276,7 @@ class TestSinkOutageDetection:
                     f"--mock-topology-file={FIXTURES / 'v2-8.yaml'}",
                     "--machine-type-file=/dev/null",
                     "--use-node-feature-api", "--output-file=",
-                    "--sink-refresh=3s",
+                    "--sink-refresh=3s", "--sink-watch=false",
                     f"--introspection-addr=127.0.0.1:{port}"]
             env = {"NODE_NAME": "outage-node",
                    "TFD_APISERVER_URL": server.url,

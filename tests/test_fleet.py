@@ -155,8 +155,15 @@ class TestFakeApiServerPatch:
             assert status == 404
 
     def test_capacity_limit_throttles_overflow(self):
+        import time
+
         with FakeApiServer() as server:
             server.set_capacity(3)
+            # Start just after a second boundary so all 8 requests land
+            # in ONE capacity bucket even on a loaded CI box (the
+            # bucket is keyed by int(monotonic()); straddling it makes
+            # 3 extra requests pass and flakes the count).
+            time.sleep(1.0 - (time.monotonic() % 1.0) + 0.02)
             statuses = [api(server, "GET", f"{BASE}/x")[0]
                         for _ in range(8)]
             assert statuses.count(429) >= 4  # over-capacity slice
@@ -310,3 +317,265 @@ class TestBreakerTwin:
         b.record_success()
         assert b.state == b.CLOSED
         assert b.opens() == 1
+
+
+# ---- event-driven core (ISSUE 12): watch + server-side apply -------------
+
+
+class TestWatchEventParity:
+    def test_parse_grid_matches_cpp(self):
+        # The SAME literal lines appear in unit_tests.cc
+        # TestWatchEventParse — both parsers must agree on every field.
+        added = sink.parse_watch_event(
+            '{"type":"ADDED","object":{"metadata":{"resourceVersion":'
+            '"5"},"spec":{"labels":{"google.com/tpu.count":"4"}}}}')
+        assert added["type"] == "added"
+        assert added["resource_version"] == "5"
+        assert added["has_labels"]
+        assert added["labels"] == {"google.com/tpu.count": "4"}
+
+        modified = sink.parse_watch_event(
+            '{"type":"MODIFIED","object":{"metadata":{"resourceVersion'
+            '":"6"},"spec":{"labels":{"a":"1","junk":7}}}}')
+        assert modified["type"] == "modified"
+        # Non-string values read as absent (the C++ ExtractSpecLabels
+        # rule).
+        assert modified["labels"] == {"a": "1"}
+
+        bookmark = sink.parse_watch_event(
+            '{"type":"BOOKMARK","object":{"metadata":{"resourceVersion'
+            '":"41"}}}')
+        assert bookmark["type"] == "bookmark"
+        assert bookmark["resource_version"] == "41"
+        assert not bookmark["has_labels"]
+
+        gone = sink.parse_watch_event(
+            '{"type":"ERROR","object":{"kind":"Status","code":410,'
+            '"message":"too old resource version"}}')
+        assert gone["type"] == "error"
+        assert gone["error_code"] == 410
+
+        assert sink.parse_watch_event("not json")["type"] == "unknown"
+        assert sink.parse_watch_event("{}")["type"] == "unknown"
+        assert sink.parse_watch_event(
+            '{"type":"PATCHED","object":{}}')["type"] == "unknown"
+        assert sink.parse_watch_event(
+            '{"type":"ADDED"}')["type"] == "added"
+
+
+def open_watch(server, path, timeout_s=5.0):
+    """Opens a chunked watch stream; returns (conn, response) — read
+    events with resp.readline()."""
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                      timeout=timeout_s)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    return conn, resp
+
+
+def read_event(resp):
+    line = resp.readline()
+    return json.loads(line) if line else None
+
+
+class TestFakeApiServerWatch:
+    def test_stream_delivers_edits_deletes_and_bookmarks(self):
+        with FakeApiServer() as server:
+            server.set_bookmark_interval(0.2)
+            status, _, created = api(
+                server, "POST", BASE,
+                {"metadata": {"name": "n1"}, "spec": {"labels": {"a": "1"}}},
+                content_type="application/json")
+            assert status == 201
+            conn, resp = open_watch(
+                server,
+                f"{BASE}/n1?watch=true&resourceVersion=1"
+                f"&allowWatchBookmarks=true&timeoutSeconds=5")
+            assert resp.status == 200
+            server.edit("ns", "n1", lambda obj: obj["spec"]["labels"]
+                        .__setitem__("a", "2"))
+            event = read_event(resp)
+            assert event["type"] == "MODIFIED"
+            assert event["object"]["spec"]["labels"]["a"] == "2"
+            assert event["object"]["metadata"]["resourceVersion"] == "2"
+            server.delete("ns", "n1")
+            event = read_event(resp)
+            assert event["type"] == "DELETED"
+            # Bookmarks carry resourceVersion progress while idle.
+            event = read_event(resp)
+            assert event["type"] == "BOOKMARK"
+            conn.close()
+
+    def test_timeout_seconds_rotates_cleanly(self):
+        with FakeApiServer() as server:
+            api(server, "POST", BASE,
+                {"metadata": {"name": "n1"}, "spec": {"labels": {}}},
+                content_type="application/json")
+            conn, resp = open_watch(
+                server, f"{BASE}/n1?watch=true&resourceVersion=1"
+                        f"&timeoutSeconds=1")
+            assert resp.status == 200
+            # No events, no bookmarks requested: the stream closes at
+            # timeoutSeconds with a clean chunked terminator.
+            assert resp.readline() == b""
+            conn.close()
+
+    def test_replay_from_old_rv_and_410_after_compaction(self):
+        with FakeApiServer() as server:
+            api(server, "POST", BASE,
+                {"metadata": {"name": "n1"}, "spec": {"labels": {"a": "1"}}},
+                content_type="application/json")
+            for value in ("2", "3"):
+                server.edit("ns", "n1", lambda obj, v=value:
+                            obj["spec"]["labels"].__setitem__("a", v))
+            # Watching from rv=1 replays the two edits we missed.
+            conn, resp = open_watch(
+                server, f"{BASE}/n1?watch=true&resourceVersion=1"
+                        f"&timeoutSeconds=2")
+            first = read_event(resp)
+            second = read_event(resp)
+            assert [first["object"]["spec"]["labels"]["a"],
+                    second["object"]["spec"]["labels"]["a"]] == ["2", "3"]
+            conn.close()
+            # After compaction the same resume point answers 410 Gone.
+            server.compact("ns", "n1")
+            conn, resp = open_watch(
+                server, f"{BASE}/n1?watch=true&resourceVersion=1"
+                        f"&timeoutSeconds=2")
+            event = read_event(resp)
+            assert event["type"] == "ERROR"
+            assert event["object"]["code"] == 410
+            assert resp.readline() == b""
+            conn.close()
+
+
+class TestFakeApiServerApply:
+    def test_apply_preserves_foreign_manager_keys(self):
+        with FakeApiServer() as server:
+            # Manager "tfd" applies its set; manager "other" owns one key.
+            status, _, _ = api(
+                server, "PATCH", f"{BASE}/n1?fieldManager=tfd&force=true",
+                {"metadata": {"name": "n1"},
+                 "spec": {"labels": {"a": "1", "b": "2"}}},
+                content_type="application/apply-patch+yaml")
+            assert status == 201
+            status, _, _ = api(
+                server, "PATCH",
+                f"{BASE}/n1?fieldManager=other&force=true",
+                {"spec": {"labels": {"x": "9"}}},
+                content_type="application/apply-patch+yaml")
+            assert status == 200
+            # tfd re-applies WITHOUT b: b is pruned (tfd owned it), x
+            # survives (other owns it).
+            status, _, obj = api(
+                server, "PATCH", f"{BASE}/n1?fieldManager=tfd&force=true",
+                {"spec": {"labels": {"a": "10"}}},
+                content_type="application/apply-patch+yaml")
+            assert status == 200
+            assert obj["spec"]["labels"] == {"a": "10", "x": "9"}
+            assert server.field_managers("ns", "n1") == {
+                "tfd": {"a"}, "other": {"x"}}
+
+    def test_unforced_conflict_and_forced_ownership_transfer(self):
+        with FakeApiServer() as server:
+            api(server, "PATCH", f"{BASE}/n1?fieldManager=tfd&force=true",
+                {"metadata": {"name": "n1"},
+                 "spec": {"labels": {"a": "1"}}},
+                content_type="application/apply-patch+yaml")
+            status, _, _ = api(
+                server, "PATCH", f"{BASE}/n1?fieldManager=rival",
+                {"spec": {"labels": {"a": "override"}}},
+                content_type="application/apply-patch+yaml")
+            assert status == 409  # unforced cross-manager conflict
+            status, _, obj = api(
+                server, "PATCH",
+                f"{BASE}/n1?fieldManager=rival&force=true",
+                {"spec": {"labels": {"a": "override"}}},
+                content_type="application/apply-patch+yaml")
+            assert status == 200
+            assert obj["spec"]["labels"]["a"] == "override"
+            assert server.field_managers("ns", "n1")["rival"] == {"a"}
+
+    def test_put_clobbers_foreign_keys_and_ownership(self):
+        with FakeApiServer() as server:
+            api(server, "PATCH", f"{BASE}/n1?fieldManager=other&force=true",
+                {"metadata": {"name": "n1"},
+                 "spec": {"labels": {"x": "9"}}},
+                content_type="application/apply-patch+yaml")
+            status, _, obj = api(
+                server, "PUT", f"{BASE}/n1",
+                {"metadata": {"name": "n1"},
+                 "spec": {"labels": {"a": "1"}}},
+                content_type="application/json", rv="1")
+            assert status == 200
+            assert obj["spec"]["labels"] == {"a": "1"}  # x clobbered
+            assert server.field_managers("ns", "n1") == {}
+
+    def test_apply_unsupported_gate(self):
+        with FakeApiServer() as server:
+            server.set_apply_supported(False)
+            status, _, _ = api(
+                server, "PATCH", f"{BASE}/n1?fieldManager=tfd&force=true",
+                {"metadata": {"name": "n1"}, "spec": {"labels": {}}},
+                content_type="application/apply-patch+yaml")
+            assert status == 415
+
+
+class TestApplySinkFlow:
+    def test_every_write_is_one_self_contained_apply(self):
+        with FakeApiServer() as server:
+            s = sink.ApplySink("node-a", "ns")
+            request = wire_request(server)
+            out = s.write(request, {"google.com/tpu.count": "4"})
+            assert out.ok and out.applies == 1 and out.gets == 0
+            out = s.write(request, {"google.com/tpu.count": "8"})
+            assert out.ok and out.applies == 1 and out.gets == 0
+            # Foreign-manager key injected between writes survives.
+            api(server, "PATCH",
+                f"/apis/nfd.k8s-sigs.io/v1alpha1/namespaces/ns/"
+                f"nodefeatures/tfd-features-for-node-a"
+                f"?fieldManager=other&force=true",
+                {"spec": {"labels": {"foreign.io/x": "1"}}},
+                content_type="application/apply-patch+yaml")
+            out = s.write(request, {"google.com/tpu.count": "16"})
+            assert out.ok
+            stored = server.store[("ns", "tfd-features-for-node-a")]
+            assert stored["spec"]["labels"] == {
+                "google.com/tpu.count": "16", "foreign.io/x": "1"}
+
+    def test_ladder_demotes_to_merge_patch_then_put(self):
+        with FakeApiServer() as server:
+            server.set_apply_supported(False)
+            s = sink.ApplySink("node-a", "ns")
+            request = wire_request(server)
+            out = s.write(request, {"google.com/tpu.count": "4"})
+            # Apply rejected (415) -> remembered -> DiffSink flow (GET,
+            # 404, POST create).
+            assert out.ok and s.apply_unsupported
+            assert out.applies == 1 and out.posts == 1
+            out = s.write(request, {"google.com/tpu.count": "8"})
+            assert out.ok and out.applies == 0  # no more apply attempts
+            # Bottom rung: merge patch also rejected -> GET+PUT, which
+            # clobbers the foreign key (the documented tradeoff).
+            server.store[("ns", "tfd-features-for-node-a")]["spec"][
+                "labels"]["foreign.io/x"] = "1"
+            server.set_patch_supported(False)
+            out = s.write(request, {"google.com/tpu.count": "16"})
+            assert out.ok and out.puts == 1
+            assert server.store[("ns", "tfd-features-for-node-a")]["spec"][
+                "labels"] == {"google.com/tpu.count": "16"}
+
+
+class TestWatchSimSmoke:
+    def test_watch_soak_quick_passes(self, tmp_path):
+        out = tmp_path / "watch.json"
+        rc = fleet_soak.main(["--watch", "--quick", "--nodes", "200",
+                              "--json", str(out)])
+        assert rc == 0
+        record = json.loads(out.read_text())
+        assert record["quiet_total_passes"] == 0
+        assert record["drift_heal_p99_ms"] <= 2000
+        assert record["storm_breaker_opens"] == 0
+        assert record["storm_undrained"] == 0

@@ -101,14 +101,16 @@ def test_repairs_missing_node_name_label(tfd_binary, tmp_path):
 def test_sink_patch_flag_controls_write_verb(tfd_binary, tmp_path):
     """--sink-patch (default true) sends label changes as a merge PATCH;
     --sink-patch=false restores the reference GET+full-PUT flow. Both
-    must converge to the same stored CR content."""
+    must converge to the same stored CR content. (--sink-apply=false
+    here: this test pins the LOWER rungs of the write ladder; the SSA
+    rung on top is pinned by test_fleet.py and the C++ ladder suite.)"""
     with FakeApiServer(token="sekrit") as server:
         env = {
             "NODE_NAME": "tpu-node-1",
             "TFD_APISERVER_URL": server.url,
             "TFD_SERVICEACCOUNT_DIR": str(sa_dir(tmp_path, "sekrit")),
         }
-        args = nf_args() + ["--no-timestamp"]
+        args = nf_args() + ["--no-timestamp", "--sink-apply=false"]
         code, _, err = run_tfd(tfd_binary, args, env=env)
         assert code == 0, err
         key = ("node-feature-discovery", "tfd-features-for-tpu-node-1")

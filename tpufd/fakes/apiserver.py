@@ -2,17 +2,32 @@
 
 Implements just the NFD CR surface the daemon talks to:
   GET    /apis/nfd.k8s-sigs.io/v1alpha1/namespaces/{ns}/nodefeatures/{name}
+  GET    ...?watch=true (chunked watch stream: ADDED/MODIFIED/DELETED/
+         BOOKMARK/ERROR events, resourceVersion semantics, 410 Gone on a
+         compacted-away version, timeoutSeconds rotation)
   POST   /apis/nfd.k8s-sigs.io/v1alpha1/namespaces/{ns}/nodefeatures
   PUT    /apis/nfd.k8s-sigs.io/v1alpha1/namespaces/{ns}/nodefeatures/{name}
-  PATCH  /apis/nfd.k8s-sigs.io/v1alpha1/namespaces/{ns}/nodefeatures/{name}
-with in-memory storage, resourceVersion bumping, JSON-merge-patch
-(RFC 7386) semantics with the resourceVersion-precondition 409, optional
-bearer-token enforcement, 429/Retry-After throttling (a fixed capacity
-per second, or an injected storm), and optional TLS (certfile/keyfile).
+  PATCH  ... (application/merge-patch+json RFC 7386 with the
+         resourceVersion-precondition 409, AND application/apply-patch+yaml
+         server-side apply with per-field-manager ownership of spec.labels)
+  DELETE /apis/nfd.k8s-sigs.io/v1alpha1/namespaces/{ns}/nodefeatures/{name}
+with in-memory storage, resourceVersion bumping, optional bearer-token
+enforcement, 429/Retry-After throttling (a fixed capacity per second, or
+an injected storm), and optional TLS (certfile/keyfile).
+
+Server-side apply model (the subset the daemon's ladder needs): each
+object tracks which field manager owns which spec.labels key. An apply
+from manager M replaces M's previously-owned keys with the applied set
+— keys M no longer sends are removed, keys owned by OTHER managers
+survive untouched. Without force=true, applying a key another manager
+owns at a different value answers 409; with force, ownership transfers.
+A PUT replaces spec.labels wholesale and clears all ownership (the
+documented bottom-rung clobber).
 
 HTTP/1.1 with keep-alive: the cluster-in-a-box fleet soak drives ~1000
 simulated daemons through persistent connections; one thread per
 connection instead of one per request is what makes that feasible.
+Watch streams hold their handler thread for the stream's lifetime.
 """
 
 import copy
@@ -21,6 +36,7 @@ import ssl
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs
 
 PREFIX = "/apis/nfd.k8s-sigs.io/v1alpha1/namespaces/"
 # Core-API ConfigMaps: the slice-coherence layer keeps one per slice
@@ -29,6 +45,11 @@ PREFIX = "/apis/nfd.k8s-sigs.io/v1alpha1/namespaces/"
 # semantics — names never collide with the NodeFeature CRs.
 CORE_PREFIX = "/api/v1/namespaces/"
 MERGE_PATCH = "application/merge-patch+json"
+APPLY_PATCH = "application/apply-patch+yaml"
+
+# Watch-event history retained per object; a watch asking for a version
+# older than the retained window answers ERROR 410 (client must re-list).
+WATCH_HISTORY = 64
 
 
 def merge_patch(target, patch):
@@ -53,6 +74,15 @@ class _Handler(BaseHTTPRequestHandler):
     lock = None
     requests = None  # type: list  # (method, path) per handled request
     timeline = None  # type: list  # (monotonic_t, method, status)
+    # Watch machinery: per-object event history [(rv:int, type, object)],
+    # the compaction floor (oldest replayable rv), per-manager
+    # spec.labels ownership, and the condition watchers park on.
+    events = None     # type: dict  # (ns, name) -> list
+    compacted = None  # type: dict  # (ns, name) -> int
+    managers = None   # type: dict  # (ns, name) -> {manager: set(keys)}
+    watch_cond = None
+    closing = None    # type: list  # [bool] — server shutting down
+    bookmark_interval = 0.5
     # When truthy, every CR request gets this HTTP status before touching
     # the store — apiserver outage injection (5xx reads as transient to
     # the daemon, which stays alive and flips /readyz once rewrites go
@@ -67,9 +97,12 @@ class _Handler(BaseHTTPRequestHandler):
     # 429 + Retry-After until the next second's bucket (0 = unlimited).
     capacity = 0
     cap_bucket = None  # type: list  # [epoch_second, count]
-    # When False, PATCH answers 415 — an apiserver predating merge-patch
-    # support on this resource; the client must fall back to GET+PUT.
+    # When False, merge-PATCH answers 415 — an apiserver predating
+    # merge-patch support on this resource; the client must fall back to
+    # GET+PUT. apply_supported gates server-side apply the same way
+    # (False exercises the SSA -> merge-patch ladder rung).
     patch_supported = True
+    apply_supported = True
 
     def _check_auth(self):
         if self.token is None:
@@ -129,12 +162,17 @@ class _Handler(BaseHTTPRequestHandler):
             return True
         return False
 
+    def _split_path(self):
+        path, _, query = self.path.partition("?")
+        return path, parse_qs(query)
+
     def _parse(self):
+        path, _ = self._split_path()
         for prefix, resource in ((PREFIX, "nodefeatures"),
                                  (CORE_PREFIX, "configmaps")):
-            if not self.path.startswith(prefix):
+            if not path.startswith(prefix):
                 continue
-            rest = self.path[len(prefix):]
+            rest = path[len(prefix):]
             parts = rest.split("/")
             if len(parts) >= 2 and parts[1] == resource:
                 name = parts[2] if len(parts) > 2 else None
@@ -151,12 +189,123 @@ class _Handler(BaseHTTPRequestHandler):
         raw = self.rfile.read(length)
         return json.loads(raw) if raw else {}
 
+    @classmethod
+    def _emit(cls, ns, name, event_type, obj):
+        """Appends one watch event (lock held by the caller) and wakes
+        every parked watcher. History beyond WATCH_HISTORY is compacted
+        away — a watch resuming from before the floor gets 410 Gone.
+        Classmethod: the FakeApiServer facade (edit/delete helpers)
+        emits through the handler CLASS, which owns all shared state."""
+        history = cls.events.setdefault((ns, name), [])
+        rv = int(obj["metadata"]["resourceVersion"])
+        history.append((rv, event_type, copy.deepcopy(obj)))
+        if len(history) > WATCH_HISTORY:
+            dropped = history[:-WATCH_HISTORY]
+            del history[:-WATCH_HISTORY]
+            cls.compacted[(ns, name)] = dropped[-1][0]
+        cls.watch_cond.notify_all()
+
+    # ---- watch stream ----------------------------------------------------
+
+    def _watch(self, ns, name, query):
+        """Serves GET ...?watch=true as a chunked event stream until
+        timeoutSeconds elapses (clean rotation), the client goes away,
+        or the server closes."""
+        try:
+            timeout_s = float(query.get("timeoutSeconds", ["30"])[0])
+        except ValueError:
+            timeout_s = 30.0
+        bookmarks = query.get("allowWatchBookmarks", ["false"])[0] == "true"
+        start_rv = query.get("resourceVersion", [None])[0]
+
+        with self.lock:
+            self.requests.append(("WATCH", self.path))
+            self.timeline.append((time.monotonic(), "WATCH", 200))
+
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def emit(doc):
+            data = json.dumps(doc, separators=(",", ":")).encode() + b"\n"
+            self.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
+            self.wfile.flush()
+
+        def finish():
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+
+        key = (ns, name)
+        with self.lock:
+            floor = self.compacted.get(key, 0)
+            if start_rv is not None:
+                try:
+                    last_sent = int(start_rv)
+                except ValueError:
+                    last_sent = 0
+                if last_sent < floor:
+                    try:
+                        emit({"type": "ERROR",
+                              "object": {"kind": "Status", "code": 410,
+                                         "message":
+                                             "too old resource version"}})
+                        finish()
+                    except OSError:
+                        pass
+                    return
+            else:
+                # No version named: future events only (the "start from
+                # now" informer bootstrap; the client lists first).
+                obj = self.store.get(key)
+                history = self.events.get(key, [])
+                candidates = [0]
+                if obj:
+                    candidates.append(
+                        int(obj["metadata"]["resourceVersion"]))
+                candidates.extend(rv for rv, _, _ in history)
+                last_sent = max(candidates)
+
+        deadline = time.monotonic() + timeout_s
+        next_bookmark = time.monotonic() + self.bookmark_interval
+        try:
+            while not self.closing[0]:
+                now = time.monotonic()
+                if now >= deadline:
+                    break
+                pending = []
+                with self.watch_cond:
+                    history = self.events.get(key, [])
+                    pending = [e for e in history if e[0] > last_sent]
+                    if not pending:
+                        self.watch_cond.wait(
+                            timeout=min(0.1, max(0.0, deadline - now)))
+                        history = self.events.get(key, [])
+                        pending = [e for e in history if e[0] > last_sent]
+                for rv, event_type, obj in pending:
+                    emit({"type": event_type, "object": obj})
+                    last_sent = rv
+                if bookmarks and time.monotonic() >= next_bookmark:
+                    emit({"type": "BOOKMARK",
+                          "object": {"metadata":
+                                     {"resourceVersion": str(last_sent)}}})
+                    next_bookmark = (time.monotonic() +
+                                     self.bookmark_interval)
+            finish()  # clean rotation: the client re-watches
+        except OSError:
+            pass  # client went away mid-stream
+
+    # ---- verbs -----------------------------------------------------------
+
     def do_GET(self):  # noqa: N802
         if self._gate():
             return None
         ns, name = self._parse()
         if ns is None or name is None:
             return self._reply(404, {"message": "not found"})
+        _, query = self._split_path()
+        if query.get("watch", ["false"])[0] == "true":
+            return self._watch(ns, name, query)
         with self.lock:
             obj = self.store.get((ns, name))
         if obj is None:
@@ -176,6 +325,7 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._reply(409, {"message": "already exists"})
             obj.setdefault("metadata", {})["resourceVersion"] = "1"
             self.store[(ns, obj_name)] = obj
+            self._emit(ns, obj_name, "ADDED", obj)
         return self._reply(201, obj)
 
     def do_PUT(self):  # noqa: N802
@@ -195,6 +345,77 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._reply(409, {"message": "conflict"})
             obj["metadata"]["resourceVersion"] = str(int(current_rv) + 1)
             self.store[(ns, name)] = obj
+            # A PUT replaces spec.labels wholesale: every field manager's
+            # ownership is gone — the documented bottom-rung clobber.
+            self.managers.pop((ns, name), None)
+            self._emit(ns, name, "MODIFIED", obj)
+        return self._reply(200, obj)
+
+    def _do_apply(self, ns, name, patch):
+        """Server-side apply (application/apply-patch+yaml; the daemon
+        sends JSON, which is valid YAML). Per-field-manager ownership of
+        spec.labels; metadata.labels merged (the NFD node-name
+        attribution label)."""
+        _, query = self._split_path()
+        manager = query.get("fieldManager", ["unknown"])[0]
+        force = query.get("force", ["false"])[0] == "true"
+        applied = ((patch.get("spec") or {}).get("labels") or {})
+        with self.lock:
+            existing = self.store.get((ns, name))
+            if existing is None:
+                obj = copy.deepcopy(patch)
+                obj.setdefault("metadata", {})["resourceVersion"] = "1"
+                obj.setdefault("spec", {})["labels"] = dict(applied)
+                self.store[(ns, name)] = obj
+                self.managers[(ns, name)] = {manager: set(applied)}
+                self._emit(ns, name, "ADDED", obj)
+                return self._reply(201, obj)
+            owned = self.managers.setdefault((ns, name), {})
+            labels = existing.setdefault("spec", {}).setdefault("labels", {})
+            if not force:
+                for key in applied:
+                    for other, keys in owned.items():
+                        if other != manager and key in keys and \
+                                labels.get(key) != applied[key]:
+                            return self._reply(
+                                409, {"message": f"conflict: field "
+                                      f"{key} owned by {other}"})
+            # No-op applies do not bump resourceVersion (real-apiserver
+            # semantics): same labels for this manager's set, nothing to
+            # prune, metadata already in place, ownership unchanged.
+            meta_wanted = (patch.get("metadata") or {}).get("labels") or {}
+            previous_keys = owned.get(manager, set())
+            foreign_owns_applied = any(
+                other != manager and (keys & set(applied))
+                for other, keys in owned.items())
+            unchanged = (
+                previous_keys == set(applied)
+                and not foreign_owns_applied
+                and all(labels.get(k) == v for k, v in applied.items())
+                and all((existing.get("metadata", {}).get("labels") or {})
+                        .get(k) == v for k, v in meta_wanted.items()))
+            if unchanged:
+                return self._reply(200, copy.deepcopy(existing))
+            previous = owned.get(manager, set())
+            for key in previous - set(applied):
+                labels.pop(key, None)
+            for key, value in applied.items():
+                labels[key] = value
+                for other in owned:
+                    if other != manager:
+                        owned[other].discard(key)
+            owned[manager] = set(applied)
+            # Metadata labels (the node-name attribution) merge in.
+            meta_labels = (patch.get("metadata") or {}).get("labels") or {}
+            if meta_labels:
+                existing.setdefault("metadata", {}).setdefault(
+                    "labels", {}).update(meta_labels)
+            current_rv = existing["metadata"]["resourceVersion"]
+            existing["metadata"]["resourceVersion"] = str(
+                int(current_rv) + 1)
+            self.store[(ns, name)] = existing
+            self._emit(ns, name, "MODIFIED", existing)
+            obj = copy.deepcopy(existing)
         return self._reply(200, obj)
 
     def do_PATCH(self):  # noqa: N802
@@ -205,7 +426,13 @@ class _Handler(BaseHTTPRequestHandler):
         if ns is None or name is None:
             return self._reply(404, {"message": "not found"})
         content_type = (self.headers.get("Content-Type") or "").split(";")[0]
-        if not self.patch_supported or content_type.strip() != MERGE_PATCH:
+        content_type = content_type.strip()
+        if content_type == APPLY_PATCH:
+            if not self.apply_supported:
+                return self._reply(
+                    415, {"message": "server-side apply not supported"})
+            return self._do_apply(ns, name, patch)
+        if not self.patch_supported or content_type != MERGE_PATCH:
             return self._reply(
                 415, {"message": f"unsupported patch type {content_type}"})
         with self.lock:
@@ -228,8 +455,26 @@ class _Handler(BaseHTTPRequestHandler):
             existing["metadata"]["resourceVersion"] = str(
                 int(current_rv) + 1)
             self.store[(ns, name)] = existing
+            self._emit(ns, name, "MODIFIED", existing)
             obj = copy.deepcopy(existing)
         return self._reply(200, obj)
+
+    def do_DELETE(self):  # noqa: N802
+        if self._gate():
+            return None
+        ns, name = self._parse()
+        if ns is None or name is None:
+            return self._reply(404, {"message": "not found"})
+        with self.lock:
+            existing = self.store.pop((ns, name), None)
+            if existing is None:
+                return self._reply(404, {"message": "not found"})
+            self.managers.pop((ns, name), None)
+            current_rv = existing["metadata"]["resourceVersion"]
+            existing["metadata"]["resourceVersion"] = str(
+                int(current_rv) + 1)
+            self._emit(ns, name, "DELETED", existing)
+        return self._reply(200, existing)
 
     def log_message(self, *args):
         pass
@@ -240,16 +485,23 @@ class FakeApiServer:
         # RLock: _reply logs the request under the lock, and the POST/PUT
         # error branches call _reply while already holding it for the
         # store — a plain Lock would deadlock every 409/404 reply.
+        lock = threading.RLock()
         handler = type("Handler", (_Handler,), {
-            "store": {}, "token": token, "lock": threading.RLock(),
+            "store": {}, "token": token, "lock": lock,
             "requests": [], "timeline": [], "failing": 0,
             "failing_retry_after": None, "failing_apf": False,
-            "capacity": 0, "cap_bucket": [0, 0], "patch_supported": True})
+            "capacity": 0, "cap_bucket": [0, 0], "patch_supported": True,
+            "apply_supported": True, "events": {}, "compacted": {},
+            "managers": {}, "watch_cond": threading.Condition(lock),
+            "closing": [False]})
         self.store = handler.store
         self.requests = handler.requests
         self.timeline = handler.timeline
         self._handler = handler
         self._server = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        # Watch handler threads are daemonic and park on the condition;
+        # they must not block interpreter shutdown.
+        self._server.daemon_threads = True
         self.tls = certfile is not None
         if self.tls:
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
@@ -265,6 +517,9 @@ class FakeApiServer:
         return self
 
     def __exit__(self, *exc):
+        self._handler.closing[0] = True
+        with self._handler.watch_cond:
+            self._handler.watch_cond.notify_all()
         self._server.shutdown()
         self._server.server_close()
         self._thread.join(timeout=5)
@@ -289,9 +544,64 @@ class FakeApiServer:
         self._handler.capacity = per_second or 0
 
     def set_patch_supported(self, supported):
-        """False: PATCH answers 415 — exercises the client's GET+PUT
-        fallback against an apiserver without merge-patch support."""
+        """False: merge-PATCH answers 415 — exercises the client's
+        GET+PUT fallback against an apiserver without merge-patch
+        support."""
         self._handler.patch_supported = bool(supported)
+
+    def set_apply_supported(self, supported):
+        """False: application/apply-patch+yaml answers 415 — exercises
+        the client's SSA -> merge-patch fallback rung."""
+        self._handler.apply_supported = bool(supported)
+
+    def set_bookmark_interval(self, seconds):
+        """Watch-stream BOOKMARK cadence (default 0.5s — fast enough for
+        tests to see resourceVersion progress without events)."""
+        self._handler.bookmark_interval = float(seconds)
+
+    def field_managers(self, ns, name):
+        """Ownership snapshot: {manager: set(spec.labels keys)}."""
+        with self._handler.lock:
+            return {m: set(keys) for m, keys in
+                    self._handler.managers.get((ns, name), {}).items()}
+
+    def edit(self, ns, name, mutator):
+        """External-drift injection: mutates the stored object (the
+        `mutator` callable receives the object dict), bumps its
+        resourceVersion, and emits a MODIFIED watch event — exactly what
+        a foreign controller's write looks like to the daemon."""
+        with self._handler.lock:
+            obj = self.store[(ns, name)]
+            mutator(obj)
+            obj["metadata"]["resourceVersion"] = str(
+                int(obj["metadata"]["resourceVersion"]) + 1)
+            self._handler._emit(ns, name, "MODIFIED", obj)
+
+    def delete(self, ns, name):
+        """External-delete injection: removes the object and emits
+        DELETED (the kubectl-delete drill)."""
+        with self._handler.lock:
+            obj = self.store.pop((ns, name), None)
+            if obj is None:
+                return
+            self._handler.managers.pop((ns, name), None)
+            obj["metadata"]["resourceVersion"] = str(
+                int(obj["metadata"]["resourceVersion"]) + 1)
+            self._handler._emit(ns, name, "DELETED", obj)
+
+    def compact(self, ns, name):
+        """Drops the retained watch history and raises the compaction
+        floor to the object's current version: the next watch resuming
+        from an older resourceVersion answers ERROR 410 (the re-list
+        drill)."""
+        with self._handler.lock:
+            obj = self.store.get((ns, name))
+            rv = int(obj["metadata"]["resourceVersion"]) if obj else 0
+            history = self._handler.events.get((ns, name), [])
+            if history:
+                rv = max(rv, history[-1][0])
+            self._handler.events[(ns, name)] = []
+            self._handler.compacted[(ns, name)] = rv
 
     def add_listener(self, port=0):
         """A second loopback listener sharing THIS server's store and
@@ -324,6 +634,7 @@ class _Listener:
             return
         self._server = ThreadingHTTPServer(("127.0.0.1", self.port),
                                            self._handler)
+        self._server.daemon_threads = True
         self.port = self._server.server_address[1]
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True)

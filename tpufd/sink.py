@@ -45,6 +45,8 @@ def _unit(hash64):
 
 NODE_NAME_LABEL = "nfd.node.kubernetes.io/node-name"
 MERGE_PATCH_CONTENT_TYPE = "application/merge-patch+json"
+APPLY_PATCH_CONTENT_TYPE = "application/apply-patch+yaml"
+APPLY_FIELD_MANAGER = "tfd"
 
 
 # ---- desync math (k8s/desync.cc) -----------------------------------------
@@ -126,6 +128,62 @@ def build_merge_patch(acked, desired, node_name, fix_node_name,
     return patch
 
 
+# ---- watch events (k8s/watch.cc ParseWatchEventLine) ---------------------
+
+WATCH_EVENT_TYPES = {
+    "ADDED": "added",
+    "MODIFIED": "modified",
+    "DELETED": "deleted",
+    "BOOKMARK": "bookmark",
+    "ERROR": "error",
+}
+
+
+def parse_watch_event(line):
+    """Twin of k8s::ParseWatchEventLine: one newline-delimited watch
+    JSON document -> {type, resource_version, has_labels, labels,
+    error_code}. Hostile input degrades to type 'unknown' (never
+    raises); non-string spec.labels values read as absent — the same
+    rules the C++ client applies, pinned by the parity grid in
+    tests/test_fleet.py."""
+    out = {"type": "unknown", "resource_version": "", "has_labels": False,
+           "labels": {}, "error_code": 0}
+    try:
+        doc = json.loads(line)
+    except (ValueError, TypeError):
+        return out
+    if not isinstance(doc, dict):
+        return out
+    kind = doc.get("type")
+    if kind not in WATCH_EVENT_TYPES:
+        return out
+    out["type"] = WATCH_EVENT_TYPES[kind]
+    obj = doc.get("object")
+    if not isinstance(obj, dict):
+        return out
+    rv = (obj.get("metadata") or {}).get("resourceVersion")
+    if isinstance(rv, str):
+        out["resource_version"] = rv
+    if out["type"] == "error":
+        code = obj.get("code")
+        if isinstance(code, (int, float)):
+            out["error_code"] = int(code)
+        return out
+    labels = (obj.get("spec") or {}).get("labels")
+    if isinstance(labels, dict):
+        out["has_labels"] = True
+        out["labels"] = {k: v for k, v in labels.items()
+                         if isinstance(v, str)}
+    return out
+
+
+def build_apply_body(namespace, node, labels):
+    """The server-side-apply body (k8s/client.cc CrBody): the FULL
+    desired object — JSON is valid YAML, which is why the wire
+    content-type can be application/apply-patch+yaml."""
+    return _full_body(namespace, node, labels)
+
+
 # ---- circuit breaker twin (k8s/breaker.{h,cc}) ---------------------------
 
 class Breaker:
@@ -200,7 +258,8 @@ class WriteOutcome:
         self.gets = 0
         self.posts = 0
         self.puts = 0
-        self.patches = 0
+        self.patches = 0   # merge patches AND applies (both PATCH verbs)
+        self.applies = 0   # the server-side-apply subset
         self.patch_bytes = 0
         self.retry_after_s = 0.0
         self.ok = False
@@ -392,6 +451,55 @@ class DiffSink:
             out.ok = True
             return out
         return fail(True, "attempts exhausted")
+
+
+class ApplySink(DiffSink):
+    """The server-side-apply sink (k8s/client.cc with use_apply): every
+    write is ONE self-contained PATCH of the full desired object under
+    the 'tfd' field manager — no GET, no cached diff state needed, the
+    CR created if missing, and spec.labels keys owned by OTHER field
+    managers preserved by the server. The per-process fallback ladder
+    mirrors the C++: a 415/405 on the apply demotes to the DiffSink
+    merge-patch flow (then GET+PUT under it) for the rest of the
+    process."""
+
+    def __init__(self, node, namespace="default", use_patch=True):
+        super().__init__(node, namespace, use_patch)
+        self.apply_unsupported = False
+
+    def write(self, request, labels, outcome=None):
+        out = outcome or WriteOutcome()
+        named = _cr_path(self.namespace, _cr_name(self.node))
+        for _ in range(self.MAX_ATTEMPTS):
+            if self.apply_unsupported:
+                return super().write(request, labels, out)
+            body = build_apply_body(self.namespace, self.node, labels)
+            out.patches += 1
+            out.applies += 1
+            out.patch_bytes += len(json.dumps(body, separators=(",", ":")))
+            status, headers, resp = request(
+                "PATCH",
+                named + f"?fieldManager={APPLY_FIELD_MANAGER}&force=true",
+                body, {"Content-Type": APPLY_PATCH_CONTENT_TYPE})
+            self._note_throttle(status, headers, out)
+            if status in (200, 201):
+                self._learn(resp, labels)
+                out.ok = True
+                return out
+            if status in (405, 415):
+                self.apply_unsupported = True  # remembered per process
+                continue
+            if status == 409:
+                self.invalidate()
+                continue
+            out.ok = False
+            out.transient = status == 429 or status >= 500
+            out.error = f"APPLY HTTP {status}"
+            return out
+        out.ok = False
+        out.transient = True
+        out.error = "attempts exhausted"
+        return out
 
 
 class BaselineSink(DiffSink):

@@ -29,6 +29,7 @@
 #include <optional>
 #include <vector>
 
+#include "tfd/agg/runner.h"
 #include "tfd/config/config.h"
 #include "tfd/fault/fault.h"
 #include "tfd/gce/metadata.h"
@@ -955,6 +956,27 @@ Status RenderLabels(
       from.tier = sched::TierName(slice_view.tier);
       from.age_s = slice_view.age_s < 0 ? 0 : slice_view.age_s;
       for (const auto& [k, v] : slice_view.last_ok->labels) {
+        (*merged)[k] = v;
+        (*provenance)[k] = from;
+      }
+    }
+  }
+
+  // Lifecycle fast-path labels (sched/sources.cc "lifecycle" source):
+  // edge-triggered preemption/draining facts. Like the slice keys
+  // these are node-lifecycle facts, not measured-silicon claims, so
+  // they merge on EVERY rung — a preemption notice must publish even
+  // while the chips are busy or the device probe degraded.
+  if (config.flags.lifecycle_watch) {
+    sched::SourceView lifecycle_view = store.View("lifecycle");
+    if (lifecycle_view.registered && lifecycle_view.last_ok.has_value() &&
+        lifecycle_view.tier != sched::Tier::kExpired) {
+      lm::LabelProvenance from;
+      from.labeler = "lifecycle";
+      from.source = "lifecycle";
+      from.tier = sched::TierName(lifecycle_view.tier);
+      from.age_s = lifecycle_view.age_s < 0 ? 0 : lifecycle_view.age_s;
+      for (const auto& [k, v] : lifecycle_view.last_ok->labels) {
         (*merged)[k] = v;
         (*provenance)[k] = from;
       }
@@ -2301,6 +2323,24 @@ int Main(int argc, char** argv) {
                   "Always 1; version and commit ride as labels.",
                   {{"version", info::VersionString()}})
         ->Set(1);
+
+    // Aggregator binary mode (agg/runner.h): shared main, entirely
+    // different runtime — no probes, no per-node labels; a
+    // lease-elected cluster singleton watching every NodeFeature CR
+    // and publishing incremental inventory rollups. It owns its own
+    // introspection server and loop; SIGHUP returns kRestart so a
+    // config reload rides this same start() loop.
+    if (loaded.config.flags.mode == "aggregator") {
+      switch (agg::RunAggregator(loaded.config, sigmask)) {
+        case agg::AggOutcome::kExit:
+          TFD_LOG_INFO << "exiting";
+          return 0;
+        case agg::AggOutcome::kRestart:
+          continue;
+        case agg::AggOutcome::kError:
+          return 1;
+      }
+    }
 
     // Introspection server: daemon mode only (a oneshot pass has no
     // lifecycle to probe, and binding would collide with a daemon already

@@ -211,7 +211,8 @@ def slice_gate(record_path, reference_path, slack):
     expected_steps = {"join", "kill-follower", "member-rejoin",
                       "dwell-depart", "crash-loop-dwell",
                       "kill-leader", "leader-rejoin", "wedge-pjrt",
-                      "unwedge", "partition", "heal",
+                      "unwedge", "preempt-notice", "preempt-clear",
+                      "partition", "heal",
                       "kill9-leader-resume"}
     missing = expected_steps - {s.get("name") for s in steps}
     if missing:
@@ -372,6 +373,73 @@ def watch_gate(record_path, reference_path, slack):
     return problems
 
 
+def aggregate_gate(record_path, reference_path, slack):
+    """Gates an aggregate-soak record (scripts/fleet_soak.py --aggregate
+    --json): the incremental-update contract is ABSOLUTE — zero full
+    recomputes after sync, incremental == from-scratch, a 1000-node
+    burst coalesced to <= 3 writes, steady aggregator QPS <= 1
+    regardless of fleet size, and single-node-change -> published p99
+    within debounce + 1s — plus publish-latency regression vs the
+    committed BENCH_r13.json. Absent keys FAIL loudly."""
+    with open(record_path) as f:
+        record = json.load(f)
+    problems = []
+
+    recomputes = record.get("full_recomputes")
+    if recomputes is None:
+        problems.append("aggregate record has no full_recomputes")
+    elif recomputes != 0:
+        problems.append(
+            f"{recomputes} full rollup recomputes ran after sync (the "
+            "steady path must be O(delta), never O(fleet))")
+    if not record.get("incremental_equals_full"):
+        problems.append("incremental rollups diverged from a "
+                        "from-scratch rebuild (or the check never ran)")
+    # .get with a default, NOT `or`: a legitimate --agg-debounce of 0
+    # must tighten the bound to 1s, not silently widen it to 3s.
+    debounce_ms = record.get("debounce_s", 2.0) * 1000.0
+    p99 = record.get("publish_p99_ms")
+    if p99 is None:
+        problems.append("aggregate record has no publish_p99_ms")
+    elif p99 > debounce_ms + 1000.0:
+        problems.append(
+            f"single-node-change -> rollup-published p99 {p99}ms "
+            f"exceeds the debounce+1s bound "
+            f"({debounce_ms + 1000.0:.0f}ms)")
+    qps = record.get("steady_qps")
+    if qps is None:
+        problems.append("aggregate record has no steady_qps")
+    elif qps > 1.0:
+        problems.append(
+            f"aggregator steady apiserver QPS {qps} exceeds 1.0")
+    writes = record.get("burst_writes")
+    if writes is None:
+        problems.append("aggregate record has no burst_writes")
+    elif writes > 3:
+        problems.append(
+            f"the {record.get('burst_flips')}-node churn burst took "
+            f"{writes} output writes (coalescing bound: 3)")
+    if record.get("sync_nodes") != record.get("nodes"):
+        problems.append(
+            f"initial sync retained {record.get('sync_nodes')} of "
+            f"{record.get('nodes')} nodes")
+
+    try:
+        with open(reference_path) as f:
+            ref = json.load(f).get("publish_p99_ms")
+    except (OSError, ValueError) as e:
+        problems.append(
+            f"aggregate reference {reference_path} unreadable: {e}")
+        ref = None
+    if ref is not None and p99 is not None and ref > 0 and \
+            p99 > ref * (1.0 + slack):
+        problems.append(
+            f"rollup publish p99 {p99}ms regressed past "
+            f"{ref * (1.0 + slack):.0f}ms (reference {ref}ms "
+            f"+{int(slack * 100)}%)")
+    return problems
+
+
 def reference_dirty_p50_ms(path):
     """steady_dirty_p50_ms from a committed bench record (either the
     bare record or the driver's {parsed: ...} wrapper)."""
@@ -416,6 +484,15 @@ def main(argv=None):
     # Latencies are virtual-clock (seeded simulation), so the slack only
     # absorbs intentional model changes, not CI noise.
     ap.add_argument("--watch-slack", type=float, default=0.5)
+    ap.add_argument("--aggregate", metavar="RECORD.json",
+                    help="gate this cluster-inventory aggregate-soak "
+                         "record (scripts/fleet_soak.py --aggregate "
+                         "--json)")
+    ap.add_argument("--aggregate-reference",
+                    default=os.path.join(repo, "BENCH_r13.json"))
+    # Virtual-clock latencies (seeded simulation): slack only absorbs
+    # intentional model changes, like the watch gate.
+    ap.add_argument("--aggregate-slack", type=float, default=0.5)
     ap.add_argument("--plugin", metavar="RECORD.json",
                     help="gate this probe-plugin containment soak record "
                          "(scripts/plugin_soak.py --json)")
@@ -459,6 +536,18 @@ def main(argv=None):
                 print(f"fleet bench gate FAILED: {p}", file=sys.stderr)
             return 1
         print("fleet bench gate OK")
+        return 0
+
+    if args.aggregate:
+        problems = aggregate_gate(args.aggregate,
+                                  args.aggregate_reference,
+                                  args.aggregate_slack)
+        if problems:
+            for p in problems:
+                print(f"aggregate bench gate FAILED: {p}",
+                      file=sys.stderr)
+            return 1
+        print("aggregate bench gate OK")
         return 0
 
     if args.watch:

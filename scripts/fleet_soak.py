@@ -758,6 +758,271 @@ def watch_soak(args):
     return 0
 
 
+# ---- aggregate-mode simulation (ISSUE 13) --------------------------------
+#
+# The cluster-inventory aggregator at 10k nodes: one lease-elected
+# singleton consuming every NodeFeature delta through a collection
+# watch, maintaining rollups INCREMENTALLY (tpufd.agg — the parity-
+# pinned twin of src/tfd/agg), and publishing through the coalescing
+# debounce. Wire-level truth (collection LIST/WATCH framing, 410,
+# labelSelector) is pinned by tests/test_agg.py against the real fake
+# apiserver and by the real-process smoke in tests/test_fleet.py; THIS
+# harness proves the fleet-scale emergent behavior on the virtual
+# clock: single-node-change -> rollup-published p99 within the
+# debounce + 1s bound, steady aggregator apiserver QPS <= 1 regardless
+# of fleet size, ZERO full recomputes after the initial sync, and a
+# 1000-node churn burst coalescing to <= 3 output writes.
+
+
+class AggSimServer:
+    """The apiserver as the aggregator sees it: per-node label objects,
+    a collection-watch fan-out to ONE watcher, and per-second request
+    accounting attributed to the aggregator."""
+
+    def __init__(self, clock, rng):
+        self.clock = clock
+        self.rng = rng
+        self.objects = {}          # node -> labels
+        self.watcher = None        # the SimAggregator
+        self.agg_requests = collections.Counter()  # int(t) -> n
+        self.by_verb = collections.Counter()
+        self.output_writes = []    # (t, labels) — the rollup object
+
+    def _wire_latency(self):
+        return self.rng.uniform(0.0005, 0.003)
+
+    def count_agg(self, t, verb):
+        self.agg_requests[int(t)] += 1
+        self.by_verb[verb] += 1
+
+    def daemon_apply(self, t, node, labels):
+        """A daemon's SSA write (not counted against the aggregator's
+        budget — the per-daemon load is ISSUE 8/12's proven story)."""
+        self.objects[node] = dict(labels)
+        if self.watcher is not None:
+            deliver = t + self._wire_latency()
+            self.clock.schedule(
+                deliver,
+                lambda now, n=node, lb=dict(labels):
+                    self.watcher.on_event(now, n, lb))
+
+    def daemon_delete(self, t, node):
+        self.objects.pop(node, None)
+        if self.watcher is not None:
+            self.clock.schedule(
+                t + self._wire_latency(),
+                lambda now, n=node:
+                    self.watcher.on_event(now, n, None))
+
+
+class SimAggregator:
+    """The aggregator twin: incremental store + coalescing flush +
+    lease renewals, all through tpufd.agg (parity-pinned against the
+    C++ core)."""
+
+    def __init__(self, server, clock, debounce_s, lease_s):
+        from tpufd import agg as agglib
+
+        self.agglib = agglib
+        self.server = server
+        self.clock = clock
+        self.store = agglib.InventoryStore()
+        self.flush = agglib.FlushController(debounce_s)
+        self.lease_s = lease_s
+        self.synced = False
+        self.flush_scheduled = False
+        self.pending_changes = []  # change times awaiting a publish
+        self.publish_latencies_ms = []
+
+    def start(self, t):
+        # Lease bootstrap + the renewal cadence (GET + PATCH per tick,
+        # the real runner's LeaseTick).
+        self.lease_tick(t)
+
+    def lease_tick(self, t):
+        self.server.count_agg(t, "GET")
+        self.server.count_agg(t, "PATCH")
+        self.clock.schedule(t + self.lease_s / 3.0,
+                            lambda now: self.lease_tick(now))
+
+    def sync(self, t):
+        """The initial collection LIST: ONE request regardless of fleet
+        size, every item applied through the same incremental path."""
+        self.server.count_agg(t, "LIST")
+        for node, labels in self.server.objects.items():
+            self.store.apply(node, labels)
+        self.server.watcher = self
+        self.synced = True
+        self._note_dirty(t)
+
+    def on_event(self, t, node, labels):
+        moved = (self.store.remove(node) if labels is None
+                 else self.store.apply(node, labels))
+        if moved:
+            self.pending_changes.append(t)
+            self._note_dirty(t)
+
+    def _note_dirty(self, t):
+        self.flush.note_dirty(t)
+        if not self.flush_scheduled:
+            self.flush_scheduled = True
+            self.clock.schedule(self.flush.due_at(),
+                                lambda now: self._flush(now))
+
+    def _flush(self, t):
+        self.flush_scheduled = False
+        if not self.flush.should_flush(t):
+            return
+        self.server.count_agg(t, "APPLY")
+        self.server.output_writes.append(
+            (t, self.store.build_output_labels()))
+        self.flush.note_flushed()
+        for changed_at in self.pending_changes:
+            self.publish_latencies_ms.append((t - changed_at) * 1000.0)
+        self.pending_changes = []
+
+
+def aggregate_soak(args):
+    """The 10k-node aggregator scale proof. All virtual-time."""
+    from tpufd import agg as agglib
+
+    rng = random.Random(args.seed)
+    clock = SimClock()
+    server = AggSimServer(clock, rng)
+    debounce_s = args.agg_debounce
+    lease_s = 30.0
+    aggregator = SimAggregator(server, clock, debounce_s, lease_s)
+    record = {"mode": "aggregate", "nodes": args.nodes,
+              "seed": args.seed, "debounce_s": debounce_s,
+              "lease_s": lease_s}
+    problems = []
+
+    def labels_for(i, perf_class=None, degraded=None):
+        cls = perf_class or ("degraded" if i % 19 == 0 else
+                             "silver" if i % 3 == 0 else "gold")
+        deg = degraded if degraded is not None else (
+            "true" if i % 37 == 0 else "false")
+        return {
+            "google.com/tpu.count": "4",
+            "google.com/tpu.slice.id": f"slice-{i // 16:04d}",
+            "google.com/tpu.slice.degraded": deg,
+            "google.com/tpu.perf.class": cls,
+            "google.com/tpu.perf.matmul-tflops":
+                "%.3f" % (120.0 + (i * 13) % 80),
+            "google.com/tpu.perf.hbm-gbps":
+                "%.3f" % (500.0 + (i * 7) % 300),
+        }
+
+    # ---- rollout: the fleet lands over 10 virtual seconds; the
+    # aggregator elects, lists ONCE at t=15, then watches.
+    for i in range(args.nodes):
+        at = sinklib.hash_unit(f"agg-node-{i}") * 10.0
+        clock.schedule(at, lambda now, i=i: server.daemon_apply(
+            now, f"node-{i:05d}", labels_for(i)))
+    aggregator.start(0.0)
+    clock.schedule(15.0, lambda now: aggregator.sync(now))
+    clock.run(20.0)
+    record["sync_nodes"] = len(aggregator.store.nodes)
+    if record["sync_nodes"] != args.nodes:
+        problems.append(
+            f"initial sync retained {record['sync_nodes']} of "
+            f"{args.nodes} nodes")
+
+    # ---- single-node-change drills: seeded class flips spread across
+    # a steady hour-shaped window; latency = change -> the first output
+    # write carrying it (the acceptance bound: debounce + 1s).
+    drills = max(50, args.nodes // 50)
+    steady_start, steady_end = 30.0, 30.0 + args.agg_steady_secs
+    for d in range(drills):
+        i = rng.randrange(args.nodes)
+        at = rng.uniform(steady_start, steady_end - debounce_s - 2)
+        clock.schedule(at, lambda now, i=i: server.daemon_apply(
+            now, f"node-{i:05d}",
+            labels_for(i, perf_class="degraded", degraded="true")))
+    clock.run(steady_end)
+    steady_lat = list(aggregator.publish_latencies_ms)
+    record["publish_drills"] = drills
+    record["publish_p50_ms"] = round(percentile(steady_lat, 50), 2)
+    record["publish_p99_ms"] = round(percentile(steady_lat, 99), 2)
+    bound_ms = debounce_s * 1000.0 + 1000.0
+    if not steady_lat:
+        problems.append("no publish-latency samples")
+    elif percentile(steady_lat, 99) > bound_ms:
+        problems.append(
+            f"single-node-change -> rollup-published p99 "
+            f"{percentile(steady_lat, 99):.0f}ms exceeds the "
+            f"debounce+1s bound ({bound_ms:.0f}ms)")
+
+    # ---- steady aggregator QPS: lease renewals + coalesced flushes,
+    # measured across the drill window. The contract: <= 1 QPS
+    # REGARDLESS of fleet size (nothing above scales with nodes).
+    window = [n for sec, n in server.agg_requests.items()
+              if steady_start <= sec < steady_end]
+    steady_qps = sum(window) / max(1.0, steady_end - steady_start)
+    record["steady_qps"] = round(steady_qps, 3)
+    record["steady_worst_second"] = max(window) if window else 0
+    if steady_qps > 1.0:
+        problems.append(
+            f"aggregator steady apiserver QPS {steady_qps:.2f} exceeds "
+            f"1.0 (must be fleet-size-independent)")
+
+    # ---- 1000-node churn burst: every flip lands inside one debounce
+    # window; the output must coalesce to <= 3 writes.
+    burst_at = clock.now + 5.0
+    burst_n = min(1000, args.nodes)
+    victims = rng.sample(range(args.nodes), burst_n)
+    for i in victims:
+        at = burst_at + rng.uniform(0.0, min(0.5, debounce_s / 2))
+        clock.schedule(at, lambda now, i=i: server.daemon_apply(
+            now, f"node-{i:05d}", labels_for(i, perf_class="silver",
+                                             degraded="false")))
+    writes_before = len(server.output_writes)
+    clock.run(burst_at + debounce_s * 3 + 2.0)
+    burst_writes = len(server.output_writes) - writes_before
+    record["burst_flips"] = burst_n
+    record["burst_writes"] = burst_writes
+    if burst_writes > 3:
+        problems.append(
+            f"a {burst_n}-node churn burst produced {burst_writes} "
+            f"output writes (coalescing bound: 3)")
+
+    # ---- the incremental-update contract: zero full recomputes after
+    # sync, and the incremental state equals a from-scratch rebuild.
+    record["full_recomputes"] = aggregator.store.full_recomputes
+    if aggregator.store.full_recomputes != 0:
+        problems.append(
+            f"{aggregator.store.full_recomputes} full recomputes ran "
+            f"(the steady path must be O(delta), never O(fleet))")
+    fresh = agglib.InventoryStore()
+    for node, labels in server.objects.items():
+        fresh.apply(node, labels)
+    record["incremental_equals_full"] = (
+        aggregator.store.build_output_labels() ==
+        fresh.build_output_labels())
+    if not record["incremental_equals_full"]:
+        problems.append("incremental rollups diverged from a "
+                        "from-scratch rebuild")
+    record["events_consumed"] = aggregator.store.events
+    record["output_writes_total"] = len(server.output_writes)
+    record["by_verb"] = dict(server.by_verb)
+
+    print(json.dumps(record))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(record, f, indent=1)
+    if problems:
+        for p in problems:
+            print(f"aggregate soak FAILED: {p}", file=sys.stderr)
+        return 1
+    print(
+        f"aggregate soak OK: {args.nodes} nodes, publish p99 "
+        f"{record['publish_p99_ms']}ms <= {bound_ms:.0f}ms, steady "
+        f"{record['steady_qps']} qps <= 1, {burst_n}-flip burst -> "
+        f"{burst_writes} writes, 0 full recomputes "
+        f"({record['events_consumed']} incremental events)")
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=1000)
@@ -783,7 +1048,22 @@ def main(argv=None):
                          "wire-level diff-sink soak")
     ap.add_argument("--shards", type=int, default=8,
                     help="watch mode: fake apiserver shard count")
+    ap.add_argument("--aggregate", action="store_true",
+                    help="run the cluster-inventory aggregator "
+                         "simulation (virtual clock, 10k daemons + the "
+                         "sim aggregator) instead of the diff-sink soak")
+    ap.add_argument("--agg-debounce", type=float, default=2.0,
+                    help="aggregate mode: publish debounce (s)")
+    ap.add_argument("--agg-steady-secs", type=float, default=60.0,
+                    help="aggregate mode: drill/steady window (s)")
     args = ap.parse_args(argv)
+
+    if args.aggregate:
+        if args.nodes == 1000:  # the diff-soak default; aggregate is 10k
+            args.nodes = 10000
+        if args.quick:
+            args.nodes = min(args.nodes, 400)
+        return aggregate_soak(args)
 
     if args.watch:
         if args.nodes == 1000:  # the diff-soak default; watch mode is 10k

@@ -156,6 +156,17 @@ CONDITIONAL = {
     "tfd_sink_watch_events_total",
     "tfd_sink_watch_reconnects_total",
     "tfd_pass_wakeups_total",
+    # Lifecycle fast path (ISSUE 13 satellite): config-gated behind
+    # --lifecycle-watch (off on this hermetic boot).
+    "tfd_lifecycle_state",
+    # Cluster inventory aggregator (ISSUE 13): these register only in
+    # --mode=aggregator, a different runtime from this daemon boot.
+    "tfd_agg_state",
+    "tfd_agg_nodes",
+    "tfd_agg_events_total",
+    "tfd_agg_flushes_total",
+    "tfd_agg_full_recomputes_total",
+    "tfd_agg_flush_latency_seconds",
 }
 
 

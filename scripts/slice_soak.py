@@ -21,6 +21,12 @@ schedule:
   restart           bring it back                      -> 4/4
   wedge-pjrt        wedge one member's PJRT (hang file)-> 3/4 everywhere
   unwedge           lift the wedge                     -> 4/4
+  preempt-notice    flip one member's GCE              -> the leader folds
+                    instance/preempted to TRUE            the still-alive
+                    (its own fake metadata server)        member into a
+                                                          proactive 3/4
+                                                          degraded verdict
+  preempt-clear     notice cleared                     -> 4/4
   partition         refuse one member's apiserver      -> member drops
                                                           tpu.slice.*
                                                           (self-demotes),
@@ -50,8 +56,17 @@ Invariants asserted at every step:
 `--json FILE` writes the bench record bench_gate.py --slice gates
 against the committed BENCH_r10.json.
 
+`--sink cr` runs the SAME schedule with every member publishing through
+the NodeFeature-CR sink (watch + server-side apply against the fake
+apiserver) instead of the label file — coherence is then sampled from
+the CR store, the bytes a scheduler actually sees. Sole expected delta:
+a partitioned member cannot write its self-demotion (the partition
+severs the sink too), so the store holds its last-agreed labels until
+heal; the demotion is still asserted via the slice-orphaned journal.
+
 Usage:
   python3 scripts/slice_soak.py [--hosts 4] [--seed 10] [--json out.json]
+      [--sink file|cr]
 """
 
 import argparse
@@ -69,6 +84,7 @@ sys.path.insert(0, str(REPO))
 from tpufd import slicecoord  # noqa: E402
 from tpufd.fakes import free_loopback_port  # noqa: E402
 from tpufd.fakes.apiserver import FakeApiServer  # noqa: E402
+from tpufd.fakes.metadata_server import FakeMetadataServer  # noqa: E402
 
 BUILD = REPO / "build"
 BINARY = BUILD / "tpu-feature-discovery"
@@ -92,10 +108,13 @@ def require(cond, message):
 
 
 class Member:
-    def __init__(self, tmp, index, url, hosts):
+    def __init__(self, tmp, index, url, hosts, sink_mode="file",
+                 cr_store=None, metadata_port=None):
         self.index = index
         self.node = f"soak-host-{index}"
         self.url = url
+        self.sink_mode = sink_mode
+        self.cr_store = cr_store  # the shared fake-apiserver store
         self.out_file = tmp / f"tfd-{index}"
         self.state_file = tmp / f"state-{index}"
         self.hang_file = tmp / f"hang-{index}"
@@ -131,10 +150,24 @@ class Member:
             # legitimately quarantines here).
             "--health-flap-threshold=12",
             "--cadence-jitter-pct=0", "--no-timestamp",
+            # Preemption fast path (ISSUE 13 satellite): every member
+            # watches its own fake metadata server's instance/preempted.
+            "--lifecycle-watch",
         ]
+        if sink_mode == "cr":
+            # The NodeFeature-CR sink variant (PR 9's nuance, closed
+            # here): slice labels ride watch+SSA to the apiserver
+            # instead of the label file; coherence is then sampled from
+            # the CR store — the bytes a scheduler actually sees. The
+            # breaker cooldown is shortened so the heal step re-asserts
+            # at the protocol's cadence instead of parking the sink for
+            # the default 30s after the partition's failed writes.
+            self.argv += ["--use-node-feature-api", "--output-file=",
+                          "--sink-breaker-cooldown=2s"]
         self.env = {
             **os.environ,
-            "GCE_METADATA_HOST": "127.0.0.1:1",
+            "GCE_METADATA_HOST": (f"127.0.0.1:{metadata_port}"
+                                  if metadata_port else "127.0.0.1:1"),
             "NODE_NAME": self.node,
             "TFD_APISERVER_URL": url,
             "KUBERNETES_NAMESPACE": NS,
@@ -166,12 +199,22 @@ class Member:
     def alive(self):
         return self.proc is not None and self.proc.poll() is None
 
-    def slice_labels(self):
+    def full_labels(self):
+        if self.sink_mode == "cr":
+            obj = self.cr_store.get((NS, f"tfd-features-for-{self.node}"))
+            if obj is None:
+                return None
+            return dict((obj.get("spec") or {}).get("labels") or {})
         try:
-            labels = dict(line.split("=", 1) for line in
-                          self.out_file.read_text().splitlines() if line)
+            return dict(line.split("=", 1) for line in
+                        self.out_file.read_text().splitlines() if line)
         except (OSError, ValueError):
             return None  # unreadable mid-write; sample again
+
+    def slice_labels(self):
+        labels = self.full_labels()
+        if labels is None:
+            return None
         return slicecoord.slice_labels_of(labels)
 
     def journal_types(self):
@@ -303,18 +346,29 @@ def lease_of(server):
     return json.loads(raw) if raw else None
 
 
-def run_soak(hosts, seed, tmp):
+def run_soak(hosts, seed, tmp, sink_mode="file"):
     soak = Soak(hosts, seed)
     sid = soak.sanitized_id
+    # One fake metadata server per member so the preemption drill can
+    # flip ONE host's instance/preempted without touching the others.
+    from tpufd.fakes.metadata_server import tpu_vm
+    metas = [FakeMetadataServer(tpu_vm(accelerator_type="v5litepod-16",
+                                       worker_id=i, preemptible=True))
+             for i in range(hosts)]
+    for meta in metas:
+        meta.__enter__()
     with FakeApiServer() as server:
         listeners = [server.add_listener() for _ in range(hosts)]
-        members = [Member(tmp, i, listeners[i].url, hosts)
+        members = [Member(tmp, i, listeners[i].url, hosts,
+                          sink_mode=sink_mode, cr_store=server.store,
+                          metadata_port=metas[i].port)
                    for i in range(hosts)]
         for m in members:
             m.env["TFD_SLICE_HOSTS"] = str(hosts)
             m.env["TFD_FAKE_PJRT_HOSTS"] = str(hosts)
         try:
-            print(f"slice soak: {hosts} hosts, seed {seed}")
+            print(f"slice soak: {hosts} hosts, seed {seed}, "
+                  f"sink={sink_mode}")
             for m in members:
                 m.start()
             # Join: everyone healthy, byte-identical. Cold PJRT probes
@@ -430,6 +484,43 @@ def run_soak(hosts, seed, tmp):
                           enforce_window=False)
             soak.watch_steady(members, 2, phase="w7")
 
+            # 3b. Preemption fast path (ISSUE 13 satellite): GCE issues
+            # a preemption notice to one member. Its lifecycle source
+            # (1s tick here) publishes tpu.lifecycle.preempt-imminent,
+            # the report carries preempting=true, and the LEADER folds
+            # the still-alive-but-doomed member into a proactive
+            # degraded verdict — every host relabels 3/4 coherently
+            # BEFORE the VM actually dies.
+            lease = lease_of(server)
+            doomed = next(m for m in members
+                          if m.node != lease["holder"])
+            notice = tpu_vm(accelerator_type="v5litepod-16",
+                            worker_id=doomed.index, preemptible=True,
+                            preempted=True)
+            metas[doomed.index].set_data(notice)
+            soak.converge("preempt-notice", members,
+                          expected_labels(sid, hosts, hosts - 1),
+                          budget_s=AGREEMENT_S + 6 * INTERVAL_S + 3)
+            require("lifecycle-change" in doomed.journal_types(),
+                    "preempted member never journaled lifecycle-change")
+            doomed_labels = doomed.full_labels() or {}
+            require(doomed_labels.get(
+                        "google.com/tpu.lifecycle.preempt-imminent")
+                    == "true",
+                    f"preempted member never published preempt-imminent "
+                    f"(labels {doomed_labels})")
+            soak.watch_steady(members, 2, phase="w7b")
+            # The notice clears (drill ends; in production the VM dies
+            # and the kill/restart steps above cover that path).
+            metas[doomed.index].set_data(
+                tpu_vm(accelerator_type="v5litepod-16",
+                       worker_id=doomed.index, preemptible=True))
+            soak.converge("preempt-clear", members,
+                          expected_labels(sid, hosts, hosts),
+                          budget_s=AGREEMENT_S + 6 * INTERVAL_S + 3,
+                          enforce_window=False)
+            soak.watch_steady(members, 2, phase="w7c")
+
             # 4. Partition one member from the apiserver: it must
             # SELF-DEMOTE (drop tpu.slice.* entirely — never a stale
             # slice view) while the peers degrade the slice.
@@ -437,8 +528,17 @@ def run_soak(hosts, seed, tmp):
             victim = next(m for m in members
                           if m.node != lease["holder"])
             listeners[victim.index].stop()
+            # File sink: the victim's self-demotion (drop tpu.slice.*)
+            # is visible in its label file. CR sink: the victim CANNOT
+            # write its demotion — the partition severs the sink too —
+            # so the store legitimately holds its LAST-AGREED labels
+            # until heal (the documented partition tradeoff); the
+            # demotion itself is still asserted via the slice-orphaned
+            # journal below, read over local introspection.
+            victim_want = ({} if sink_mode == "file"
+                           else expected_labels(sid, hosts, hosts))
             want = {m.index: (expected_labels(sid, hosts, hosts - 1)
-                              if m is not victim else {})
+                              if m is not victim else victim_want)
                     for m in members}
             soak.converge("partition", members, want,
                           budget_s=LEASE_S + AGREEMENT_S +
@@ -488,6 +588,7 @@ def run_soak(hosts, seed, tmp):
                     f"{soak.interleaved} steady-state sample(s) showed "
                     f"two live hosts publishing disagreeing slice labels")
             record = soak.record()
+            record["sink"] = sink_mode
             record["orphan_self_demoted"] = True
             record["leader_failover_epoch_bump"] = True
             record["kill9_lease_resumed"] = True
@@ -498,6 +599,8 @@ def run_soak(hosts, seed, tmp):
                     m.kill(signal.SIGTERM)
             for listener in listeners:
                 listener.stop()
+            for meta in metas:
+                meta.__exit__(None, None, None)
 
 
 def main(argv=None):
@@ -506,6 +609,11 @@ def main(argv=None):
     ap.add_argument("--seed", type=int, default=10)
     ap.add_argument("--json", metavar="FILE",
                     help="write the bench record here")
+    ap.add_argument("--sink", choices=("file", "cr"), default="file",
+                    help="label sink the members publish through: the "
+                         "label file (default) or the NodeFeature-CR "
+                         "watch+SSA path (coherence then sampled from "
+                         "the fake apiserver's CR store)")
     args = ap.parse_args(argv)
 
     if not BINARY.exists() or not FAKE_PJRT.exists():
@@ -516,7 +624,8 @@ def main(argv=None):
     import tempfile
     with tempfile.TemporaryDirectory(prefix="slice-soak-") as tmp:
         try:
-            record = run_soak(args.hosts, args.seed, Path(tmp))
+            record = run_soak(args.hosts, args.seed, Path(tmp),
+                              sink_mode=args.sink)
         except SoakError as e:
             print(f"slice soak FAILED: {e}", file=sys.stderr)
             return 1

@@ -1,0 +1,292 @@
+#include "tfd/agg/agg.h"
+
+#include <cstdlib>
+
+#include "tfd/lm/schema.h"
+#include "tfd/util/strings.h"
+
+namespace tfd {
+namespace agg {
+
+namespace {
+
+// Strict double parse for a label value ("" / garbage -> fallback).
+double ParseLabelDouble(const lm::Labels& labels, const char* key,
+                        double fallback) {
+  auto it = labels.find(key);
+  if (it == labels.end() || it->second.empty()) return fallback;
+  char* end = nullptr;
+  double v = std::strtod(it->second.c_str(), &end);
+  if (end == nullptr || *end != '\0') return fallback;
+  return v;
+}
+
+int ParseLabelInt(const lm::Labels& labels, const char* key, int fallback) {
+  auto it = labels.find(key);
+  int out = 0;
+  if (it == labels.end() || !ParseNonNegInt(it->second, &out)) {
+    return fallback;
+  }
+  return out;
+}
+
+bool LabelTrue(const lm::Labels& labels, const char* key) {
+  auto it = labels.find(key);
+  return it != labels.end() && it->second == "true";
+}
+
+std::string LabelOr(const lm::Labels& labels, const char* key,
+                    const char* fallback) {
+  auto it = labels.find(key);
+  return it == labels.end() ? fallback : it->second;
+}
+
+// Capacity bucket for a contribution's perf class: the three published
+// classes keep their names; anything else (including "") pools as
+// unclassed so the capacity sums always partition total-chips.
+std::string CapacityBucket(const std::string& perf_class) {
+  if (perf_class == "gold" || perf_class == "silver" ||
+      perf_class == "degraded") {
+    return perf_class;
+  }
+  return "unclassed";
+}
+
+}  // namespace
+
+// ---- sketch ---------------------------------------------------------------
+
+int SketchBucketIndex(double value) {
+  if (!(value > kSketchMin)) return 0;  // NaN and <= min both land in 0
+  int idx = 0;
+  double edge = kSketchMin;
+  // Repeated multiplication, not log(): IEEE doubles make this loop
+  // bit-identical in the Python twin, which a libm log() would not be.
+  while (idx < kSketchBuckets - 1 && value > edge) {
+    edge *= kSketchGamma;
+    idx++;
+  }
+  return idx;
+}
+
+double SketchBucketValue(int bucket) {
+  if (bucket <= 0) return kSketchMin;
+  if (bucket >= kSketchBuckets) bucket = kSketchBuckets - 1;
+  double edge = kSketchMin;
+  for (int i = 0; i < bucket; i++) edge *= kSketchGamma;
+  return edge;
+}
+
+void QuantileSketch::Add(double value) {
+  counts_[SketchBucketIndex(value)]++;
+  total_++;
+}
+
+void QuantileSketch::Remove(double value) {
+  int idx = SketchBucketIndex(value);
+  if (counts_[idx] > 0) {
+    counts_[idx]--;
+    total_--;
+  }
+}
+
+void QuantileSketch::Merge(const QuantileSketch& other) {
+  for (int i = 0; i < kSketchBuckets; i++) counts_[i] += other.counts_[i];
+  total_ += other.total_;
+}
+
+double QuantileSketch::Quantile(double q) const {
+  if (total_ <= 0) return -1;
+  if (q < 0) q = 0;
+  if (q > 1) q = 1;
+  // Nearest-rank on the bucketed distribution: the target rank is
+  // floor(q * (n-1)), the answer is the bucket holding that rank.
+  int64_t target = static_cast<int64_t>(q * (total_ - 1));
+  int64_t cumulative = 0;
+  for (int i = 0; i < kSketchBuckets; i++) {
+    cumulative += counts_[i];
+    if (cumulative > target) return SketchBucketValue(i);
+  }
+  return SketchBucketValue(kSketchBuckets - 1);
+}
+
+void QuantileSketch::Clear() {
+  counts_.fill(0);
+  total_ = 0;
+}
+
+// ---- contribution ---------------------------------------------------------
+
+bool NodeContribution::operator==(const NodeContribution& other) const {
+  return slice_id == other.slice_id &&
+         slice_degraded == other.slice_degraded &&
+         multislice_group == other.multislice_group &&
+         perf_class == other.perf_class && chips == other.chips &&
+         matmul_tflops == other.matmul_tflops &&
+         hbm_gbps == other.hbm_gbps && preempting == other.preempting;
+}
+
+NodeContribution ExtractContribution(const lm::Labels& labels) {
+  NodeContribution c;
+  c.slice_id = LabelOr(labels, lm::kSliceId, "");
+  c.slice_degraded = LabelTrue(labels, lm::kSliceDegraded);
+  c.multislice_group = LabelOr(labels, lm::kMultisliceSliceId, "");
+  c.perf_class = LabelOr(labels, lm::kPerfClass, "");
+  c.chips = ParseLabelInt(labels, "google.com/tpu.count", 0);
+  c.matmul_tflops = ParseLabelDouble(labels, lm::kPerfMatmulTflops, -1);
+  c.hbm_gbps = ParseLabelDouble(labels, lm::kPerfHbmGbps, -1);
+  c.preempting = LabelTrue(labels, lm::kLifecyclePreemptImminent) ||
+                 LabelTrue(labels, lm::kLifecycleDraining);
+  return c;
+}
+
+// ---- inventory store ------------------------------------------------------
+
+void InventoryStore::Retire(const NodeContribution& c) {
+  if (!c.slice_id.empty()) {
+    auto it = slices_.find(c.slice_id);
+    if (it != slices_.end()) {
+      it->second.members--;
+      if (c.slice_degraded) it->second.degraded_votes--;
+      if (c.preempting) it->second.preempting--;
+      if (it->second.members <= 0) slices_.erase(it);
+    }
+  }
+  std::string bucket = CapacityBucket(c.perf_class);
+  auto cap = capacity_.find(bucket);
+  if (cap != capacity_.end()) {
+    cap->second -= c.chips;
+    if (cap->second <= 0) capacity_.erase(cap);
+  }
+  if (!c.multislice_group.empty()) {
+    auto ms = multislice_.find(c.multislice_group);
+    if (ms != multislice_.end()) {
+      ms->second--;
+      if (ms->second <= 0) multislice_.erase(ms);
+    }
+  }
+  if (c.preempting) preempting_nodes_--;
+  if (c.matmul_tflops >= 0) matmul_.Remove(c.matmul_tflops);
+  if (c.hbm_gbps >= 0) hbm_.Remove(c.hbm_gbps);
+}
+
+void InventoryStore::Admit(const NodeContribution& c) {
+  if (!c.slice_id.empty()) {
+    SliceAgg& agg = slices_[c.slice_id];
+    agg.members++;
+    if (c.slice_degraded) agg.degraded_votes++;
+    if (c.preempting) agg.preempting++;
+  }
+  capacity_[CapacityBucket(c.perf_class)] += c.chips;
+  if (!c.multislice_group.empty()) multislice_[c.multislice_group]++;
+  if (c.preempting) preempting_nodes_++;
+  if (c.matmul_tflops >= 0) matmul_.Add(c.matmul_tflops);
+  if (c.hbm_gbps >= 0) hbm_.Add(c.hbm_gbps);
+}
+
+bool InventoryStore::Apply(const std::string& node,
+                           const lm::Labels& labels) {
+  events_++;
+  NodeContribution next = ExtractContribution(labels);
+  auto it = nodes_.find(node);
+  if (it != nodes_.end()) {
+    if (it->second == next) return false;  // e.g. a probe-ms-only delta
+    Retire(it->second);
+    it->second = next;
+  } else {
+    nodes_[node] = next;
+  }
+  Admit(next);
+  return true;
+}
+
+std::vector<std::string> InventoryStore::NodeNames() const {
+  std::vector<std::string> out;
+  out.reserve(nodes_.size());
+  for (const auto& [node, c] : nodes_) {
+    (void)c;
+    out.push_back(node);
+  }
+  return out;
+}
+
+bool InventoryStore::Remove(const std::string& node) {
+  events_++;
+  auto it = nodes_.find(node);
+  if (it == nodes_.end()) return false;
+  Retire(it->second);
+  nodes_.erase(it);
+  return true;
+}
+
+lm::Labels InventoryStore::BuildOutputLabels() const {
+  lm::Labels out;
+  int healthy = 0;
+  int degraded = 0;
+  for (const auto& [id, agg] : slices_) {
+    (void)id;
+    if (agg.degraded_votes > 0 || agg.preempting > 0) {
+      degraded++;
+    } else {
+      healthy++;
+    }
+  }
+  out[lm::kInventorySlices] = std::to_string(slices_.size());
+  out[lm::kInventoryHealthySlices] = std::to_string(healthy);
+  out[lm::kInventoryDegradedSlices] = std::to_string(degraded);
+  int64_t total_chips = 0;
+  for (const char* bucket : {"gold", "silver", "degraded", "unclassed"}) {
+    auto it = capacity_.find(bucket);
+    int64_t chips = it == capacity_.end() ? 0 : it->second;
+    total_chips += chips;
+    out[std::string(lm::kCapacityPrefix) + bucket] = std::to_string(chips);
+  }
+  out[std::string(lm::kCapacityPrefix) + "total-chips"] =
+      std::to_string(total_chips);
+  out[lm::kFleetNodes] = std::to_string(nodes_.size());
+  out[lm::kFleetPreempting] = std::to_string(preempting_nodes_);
+  out[lm::kMultisliceGroups] = std::to_string(multislice_.size());
+  if (matmul_.count() > 0) {
+    out[lm::kFleetMatmulP10] = Fixed3(matmul_.Quantile(0.10));
+    out[lm::kFleetMatmulP50] = Fixed3(matmul_.Quantile(0.50));
+  }
+  if (hbm_.count() > 0) {
+    out[lm::kFleetHbmP10] = Fixed3(hbm_.Quantile(0.10));
+    out[lm::kFleetHbmP50] = Fixed3(hbm_.Quantile(0.50));
+  }
+  return out;
+}
+
+void InventoryStore::RecomputeAll() {
+  full_recomputes_++;
+  slices_.clear();
+  capacity_.clear();
+  multislice_.clear();
+  preempting_nodes_ = 0;
+  matmul_.Clear();
+  hbm_.Clear();
+  for (const auto& [node, c] : nodes_) {
+    (void)node;
+    Admit(c);
+  }
+}
+
+void InventoryStore::Clear() {
+  nodes_.clear();
+  slices_.clear();
+  capacity_.clear();
+  multislice_.clear();
+  preempting_nodes_ = 0;
+  matmul_.Clear();
+  hbm_.Clear();
+}
+
+// ---- flush controller -----------------------------------------------------
+
+double FlushController::DueAt() const {
+  if (dirty_since_ < 0) return 1e300;
+  return dirty_since_ + debounce_s_;
+}
+
+}  // namespace agg
+}  // namespace tfd

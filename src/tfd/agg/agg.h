@@ -1,0 +1,187 @@
+// Cluster inventory aggregator: incremental O(delta) rollups over every
+// node's published NodeFeature labels (ROADMAP #3, BASELINE target #5).
+//
+// The reference GFD stops at per-node labels and leans on an external
+// NFD-master for aggregation; a TPU fleet's scheduler needs the
+// CLUSTER-scoped view — how many slices exist, how many are healthy,
+// how much capacity sits in each perf class, where the fleet's perf
+// distribution actually is — and at fleet scale the naive design
+// (re-list + recompute every rollup on every node event) is an O(fleet)
+// hot loop run O(fleet) times per churn window. This module is the
+// incremental-computation core that avoids it, in the style of
+// streaming-dataflow view maintenance: every rollup is a sum of
+// per-node CONTRIBUTIONS, so a watch delta retires the node's old
+// contribution and applies its new one — counters decrement/increment,
+// the quantile sketch removes/adds — and the steady-state cost per
+// event is O(labels changed on one node), never O(nodes). A full
+// recompute exists only as a self-check (RecomputeAll) and a counter
+// (`tfd_agg_full_recomputes_total`) proves the steady path never takes
+// it: the fleet soak asserts it stays 0 after the initial sync.
+//
+// Everything here is pure logic (no I/O, caller-supplied time), twinned
+// constant-for-constant by tpufd/agg.py — the parity grids pin bucket
+// indices, quantiles, and whole rollup label sets on both sides. The
+// transport (lease election, collection watch, SSA publish) lives in
+// agg/runner.cc.
+#pragma once
+
+#include <array>
+#include <cstdint>
+#include <map>
+#include <string>
+#include <vector>
+
+#include "tfd/lm/labeler.h"
+
+namespace tfd {
+namespace agg {
+
+// ---- mergeable quantile sketch -------------------------------------------
+//
+// Fixed-bin log-bucket digest: bucket 0 holds values <= kSketchMin,
+// bucket b>0 holds (kSketchMin*gamma^(b-1), kSketchMin*gamma^b], so the
+// relative error is bounded by gamma-1 (10%) across ~0.5..90k — wide
+// enough for TFLOP/s and GB/s. Counts make it REMOVABLE (retire a
+// node's old value) and mergeable (sum the arrays), which a comparable
+// rank-based digest is not. Bucket boundaries are computed by repeated
+// IEEE-double multiplication, NOT log()/pow(), so the C++ and Python
+// twins bucket every value identically bit-for-bit.
+
+inline constexpr double kSketchMin = 0.5;
+inline constexpr double kSketchGamma = 1.1;
+inline constexpr int kSketchBuckets = 128;
+
+int SketchBucketIndex(double value);
+// The bucket's representative (upper-edge) value; bucket 0 = kSketchMin.
+double SketchBucketValue(int bucket);
+
+class QuantileSketch {
+ public:
+  void Add(double value);
+  // Retires one previously-Added value (clamped at zero defensively —
+  // the store only ever removes what it admitted).
+  void Remove(double value);
+  void Merge(const QuantileSketch& other);
+  int64_t count() const { return total_; }
+  // Representative value at quantile q in [0,1]; -1 when empty.
+  double Quantile(double q) const;
+  void Clear();
+  bool operator==(const QuantileSketch& other) const {
+    return total_ == other.total_ && counts_ == other.counts_;
+  }
+
+ private:
+  std::array<int64_t, kSketchBuckets> counts_{};
+  int64_t total_ = 0;
+};
+
+// ---- per-node contribution -----------------------------------------------
+
+// What one node's label set contributes to the cluster rollups. Pure
+// extraction — two nodes with equal label subsets contribute equally,
+// and an equal old/new contribution is how the store detects that a
+// watch delta (e.g. a probe-ms bump) cannot move any rollup.
+struct NodeContribution {
+  std::string slice_id;          // tpu.slice.id ("" = unsliced node)
+  bool slice_degraded = false;   // tpu.slice.degraded == "true"
+  std::string multislice_group;  // tpu.multislice.slice-id ("" = none)
+  std::string perf_class;        // tpu.perf.class ("" = unclassed)
+  int chips = 0;                 // tpu.count
+  double matmul_tflops = -1;     // tpu.perf.matmul-tflops (-1 = absent)
+  double hbm_gbps = -1;          // tpu.perf.hbm-gbps
+  bool preempting = false;       // tpu.lifecycle.{preempt-imminent,draining}
+
+  bool operator==(const NodeContribution& other) const;
+  bool operator!=(const NodeContribution& other) const {
+    return !(*this == other);
+  }
+};
+
+NodeContribution ExtractContribution(const lm::Labels& labels);
+
+// ---- the incremental inventory store -------------------------------------
+
+class InventoryStore {
+ public:
+  // Applies one node's current label set (watch ADDED/MODIFIED or a
+  // list item). Returns true when the node's contribution CHANGED —
+  // i.e. some rollup moved and a publish is owed. O(changed labels).
+  bool Apply(const std::string& node, const lm::Labels& labels);
+  // Watch DELETED: retires the node's contribution entirely.
+  bool Remove(const std::string& node);
+
+  size_t nodes() const { return nodes_.size(); }
+  // Names of every retained node — the re-list reconcile diffs this
+  // against the listed set so deletes missed while not watching retire.
+  std::vector<std::string> NodeNames() const;
+  uint64_t events() const { return events_; }
+  uint64_t full_recomputes() const { return full_recomputes_; }
+
+  // The cluster-scoped rollup label set (deterministic from the
+  // contributions alone — parity-pinned against the Python twin):
+  //   tpu.slice-inventory.{slices,healthy-slices,degraded-slices}
+  //   tpu.capacity.{gold,silver,degraded,unclassed,total-chips}
+  //   tpu.fleet.{nodes,preempting}
+  //   tpu.multislice.groups
+  //   tpu.fleet.perf.{matmul-p10,matmul-p50,hbm-p10,hbm-p50} (when known)
+  lm::Labels BuildOutputLabels() const;
+
+  // Self-check / debug ONLY: rebuilds every rollup from the retained
+  // contributions and bumps full_recomputes. The steady path never
+  // calls this — `tfd_agg_full_recomputes_total` staying 0 after sync
+  // is the incremental-update acceptance contract.
+  void RecomputeAll();
+
+  void Clear();
+
+ private:
+  struct SliceAgg {
+    int members = 0;
+    int degraded_votes = 0;
+    int preempting = 0;
+  };
+
+  void Retire(const NodeContribution& c);
+  void Admit(const NodeContribution& c);
+
+  std::map<std::string, NodeContribution> nodes_;
+  std::map<std::string, SliceAgg> slices_;
+  std::map<std::string, int64_t> capacity_;   // class -> chips
+  std::map<std::string, int> multislice_;     // group id -> members
+  int preempting_nodes_ = 0;
+  QuantileSketch matmul_;
+  QuantileSketch hbm_;
+  uint64_t events_ = 0;
+  uint64_t full_recomputes_ = 0;
+};
+
+// ---- coalescing publish debounce -----------------------------------------
+
+// Bounded-staleness flush: the FIRST dirtying event opens a window of
+// `debounce_s`; every further event inside it rides the same flush, so
+// a 1000-node churn burst becomes ONE output write and no rollup is
+// ever published more than debounce_s late. (An event landing while a
+// window is open never extends it — this is a staleness bound, not a
+// quiet-period timer, so a steady event drizzle cannot starve the
+// publish forever.)
+class FlushController {
+ public:
+  explicit FlushController(double debounce_s) : debounce_s_(debounce_s) {}
+
+  void NoteDirty(double now) {
+    if (dirty_since_ < 0) dirty_since_ = now;
+  }
+  bool dirty() const { return dirty_since_ >= 0; }
+  double dirty_since() const { return dirty_since_; }
+  // When the pending flush is owed (clean = +infinity).
+  double DueAt() const;
+  bool ShouldFlush(double now) const { return dirty() && now >= DueAt(); }
+  void NoteFlushed() { dirty_since_ = -1; }
+
+ private:
+  double debounce_s_;
+  double dirty_since_ = -1;
+};
+
+}  // namespace agg
+}  // namespace tfd

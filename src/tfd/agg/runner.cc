@@ -1,0 +1,776 @@
+#include "tfd/agg/runner.h"
+
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cctype>
+#include <chrono>
+#include <condition_variable>
+#include <mutex>
+#include <set>
+#include <thread>
+
+#include "tfd/agg/agg.h"
+#include "tfd/info/version.h"
+#include "tfd/k8s/client.h"
+#include "tfd/k8s/desync.h"
+#include "tfd/k8s/watch.h"
+#include "tfd/obs/journal.h"
+#include "tfd/obs/metrics.h"
+#include "tfd/obs/server.h"
+#include "tfd/slice/coord.h"
+#include "tfd/util/http.h"
+#include "tfd/util/jsonlite.h"
+#include "tfd/util/logging.h"
+#include "tfd/util/time.h"
+
+namespace tfd {
+namespace agg {
+
+namespace {
+
+constexpr char kLeaseDocName[] = "tfd-aggregator";
+constexpr char kLeaseKey[] = "lease";
+constexpr char kCrNamePrefix[] = "tfd-features-for-";
+constexpr char kNodeNameLabel[] = "nfd.node.kubernetes.io/node-name";
+constexpr char kFieldManager[] = "tfd-aggregator";
+
+double MonoSeconds() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// Who holds the lease: the pod identity when scheduled as a Deployment,
+// the node as a fallback, the hostname last.
+std::string HolderIdentity() {
+  if (const char* pod = std::getenv("POD_NAME"); pod && *pod) return pod;
+  if (const char* node = std::getenv("NODE_NAME"); node && *node) {
+    return node;
+  }
+  char buf[256] = {0};
+  if (gethostname(buf, sizeof(buf) - 1) == 0 && buf[0]) return buf;
+  return "tfd-aggregator";
+}
+
+// Minimal percent-encoding for a query-parameter value (the
+// labelSelector carries '/' and '.').
+std::string UrlEncode(const std::string& s) {
+  static const char hex[] = "0123456789ABCDEF";
+  std::string out;
+  for (unsigned char c : s) {
+    if (isalnum(c) || c == '-' || c == '_' || c == '.' || c == '~') {
+      out.push_back(static_cast<char>(c));
+    } else {
+      out.push_back('%');
+      out.push_back(hex[c >> 4]);
+      out.push_back(hex[c & 15]);
+    }
+  }
+  return out;
+}
+
+std::string CollectionUrl(const k8s::ClusterConfig& config) {
+  return config.apiserver_url + "/apis/nfd.k8s-sigs.io/v1alpha1/namespaces/" +
+         config.namespace_ + "/nodefeatures";
+}
+
+// The per-node daemons stamp the nfd node-name label on their CRs; the
+// aggregator's OUTPUT object deliberately does not carry it, so this
+// selector excludes our own writes from our own watch.
+std::string NodeSelectorQuery() {
+  return "labelSelector=" + UrlEncode(kNodeNameLabel);
+}
+
+http::RequestOptions BaseOptions(const k8s::ClusterConfig& config) {
+  http::RequestOptions options;
+  options.ca_file = config.ca_file;
+  if (!config.token.empty()) {
+    options.headers["Authorization"] = "Bearer " + config.token;
+  }
+  options.headers["Accept"] = "application/json";
+  return options;
+}
+
+obs::Counter* EventCounter(const char* type) {
+  return obs::Default().GetCounter(
+      "tfd_agg_events_total",
+      "NodeFeature watch events consumed by the aggregator, by type "
+      "(list items count as 'listed').",
+      {{"type", type}});
+}
+
+void SetNodesGauge(size_t nodes) {
+  obs::Default()
+      .GetGauge("tfd_agg_nodes",
+                "Nodes currently retained in the aggregator's inventory "
+                "store.")
+      ->Set(static_cast<double>(nodes));
+}
+
+void SetStateGauge(int state) {
+  obs::Default()
+      .GetGauge("tfd_agg_state",
+                "Aggregator role: 0 follower/standby, 1 leader (watching "
+                "and publishing).")
+      ->Set(state);
+}
+
+// Registered at startup so the acceptance contract (== 0 after sync)
+// is scrapeable even though the steady path never increments it.
+obs::Counter* FullRecomputeCounter() {
+  return obs::Default().GetCounter(
+      "tfd_agg_full_recomputes_total",
+      "Rollup recomputations from scratch. The incremental-update "
+      "contract: 0 after the initial sync — every delta retires and "
+      "re-applies ONE node's contribution instead.");
+}
+
+// ---- shared state between the watch thread and the lease/flush loop ------
+
+struct Shared {
+  std::mutex mu;
+  std::condition_variable cv;
+  InventoryStore store;
+  FlushController flush;
+  bool synced = false;
+
+  explicit Shared(double debounce_s) : flush(debounce_s) {}
+};
+
+// ---- the collection watcher ----------------------------------------------
+
+// One long-lived list-then-watch over the WHOLE NodeFeature collection.
+// Same discipline as k8s::NodeFeatureWatcher (PR 11) at collection
+// scope: resourceVersion bookmarks, clean rotation, Retry-After pacing,
+// exponential backoff with per-process jitter, 410 -> re-list once.
+class CollectionWatcher {
+ public:
+  CollectionWatcher(k8s::ClusterConfig config, Shared* shared)
+      : config_(std::move(config)), shared_(shared) {}
+  ~CollectionWatcher() { Stop(); }
+
+  void Start() {
+    if (started_) return;
+    started_ = true;
+    stop_.store(false);
+    thread_ = std::thread([this] { RunLoop(); });
+  }
+
+  void Stop() {
+    if (!started_) return;
+    stop_.store(true);
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      cv_.notify_all();
+    }
+    int fd = stream_fd_.load();
+    if (fd >= 0) shutdown(fd, SHUT_RDWR);
+    if (thread_.joinable()) thread_.join();
+    started_ = false;
+  }
+
+  uint64_t relists() const { return relists_.load(); }
+
+ private:
+  bool SleepFor(double seconds) {
+    std::unique_lock<std::mutex> lock(mu_);
+    cv_.wait_for(lock,
+                 std::chrono::milliseconds(
+                     static_cast<long long>(seconds * 1000)),
+                 [this] { return stop_.load(); });
+    return !stop_.load();
+  }
+
+  // Applies one object's labels to the store under the shared lock;
+  // notes dirty + wakes the flush loop when a rollup moved.
+  void ApplyObject(const std::string& name, const lm::Labels& labels,
+                   bool deleted) {
+    if (name.rfind(kCrNamePrefix, 0) != 0) return;  // not a daemon CR
+    std::string node = name.substr(sizeof(kCrNamePrefix) - 1);
+    std::lock_guard<std::mutex> lock(shared_->mu);
+    bool moved = deleted ? shared_->store.Remove(node)
+                         : shared_->store.Apply(node, labels);
+    SetNodesGauge(shared_->store.nodes());
+    if (moved) {
+      shared_->flush.NoteDirty(MonoSeconds());
+      shared_->cv.notify_all();
+    }
+  }
+
+  // One collection LIST: applies every item incrementally and retires
+  // nodes that vanished while we were not watching. Returns the list's
+  // resourceVersion.
+  Status ListOnce(std::string* rv) {
+    http::RequestOptions options = BaseOptions(config_);
+    options.timeout_ms = 15000;
+    options.deadline_ms = 30000;
+    std::string url = CollectionUrl(config_) + "?" + NodeSelectorQuery();
+    Result<http::Response> listed = http::Request("GET", url, "", options);
+    if (!listed.ok()) return Status::Error("list failed: " + listed.error());
+    if (listed->status == 429 || listed->status == 503) {
+      double pause = listed->RetryAfterSeconds();
+      return Status::Error("list throttled (HTTP " +
+                           std::to_string(listed->status) + ", retry in " +
+                           std::to_string(pause) + "s)");
+    }
+    if (listed->status != 200) {
+      return Status::Error("list HTTP " + std::to_string(listed->status));
+    }
+    Result<jsonlite::ValuePtr> parsed = jsonlite::Parse(listed->body);
+    if (!parsed.ok()) {
+      return Status::Error("list parse: " + parsed.error());
+    }
+    if (jsonlite::ValuePtr v =
+            (*parsed)->GetPath("metadata.resourceVersion");
+        v && v->kind == jsonlite::Value::Kind::kString) {
+      *rv = v->string_value;
+    }
+    std::set<std::string> listed_nodes;
+    jsonlite::ValuePtr items = (*parsed)->Get("items");
+    if (items && items->kind == jsonlite::Value::Kind::kArray) {
+      for (const jsonlite::ValuePtr& item : items->array_items) {
+        if (!item || item->kind != jsonlite::Value::Kind::kObject) continue;
+        std::string name;
+        if (jsonlite::ValuePtr n = item->GetPath("metadata.name");
+            n && n->kind == jsonlite::Value::Kind::kString) {
+          name = n->string_value;
+        }
+        if (name.rfind(kCrNamePrefix, 0) != 0) continue;
+        lm::Labels labels;
+        if (jsonlite::ValuePtr l = item->GetPath("spec.labels");
+            l && l->kind == jsonlite::Value::Kind::kObject) {
+          for (const auto& [k, v] : l->object_items) {
+            if (v && v->kind == jsonlite::Value::Kind::kString) {
+              labels[k] = v->string_value;
+            }
+          }
+        }
+        listed_nodes.insert(name.substr(sizeof(kCrNamePrefix) - 1));
+        EventCounter("listed")->Inc();
+        ApplyObject(name, labels, /*deleted=*/false);
+      }
+    }
+    // Deletes missed while not watching: every retained node absent
+    // from the list retires through the SAME incremental path.
+    std::vector<std::string> known;
+    {
+      std::lock_guard<std::mutex> lock(shared_->mu);
+      known = shared_->store.NodeNames();
+    }
+    for (const std::string& node : known) {
+      if (listed_nodes.count(node) == 0) {
+        ApplyObject(kCrNamePrefix + node, {}, /*deleted=*/true);
+      }
+    }
+    relists_.fetch_add(1);
+    return Status::Ok();
+  }
+
+  void RunLoop() {
+    const std::string node_key = HolderIdentity();
+    std::string rv;
+    int consecutive_failures = 0;
+
+    while (!stop_.load()) {
+      if (rv.empty()) {
+        Status listed = ListOnce(&rv);
+        if (!listed.ok()) {
+          consecutive_failures++;
+          double pause = std::min(
+              30.0, 1.0 * (1 << std::min(consecutive_failures - 1, 10)));
+          TFD_LOG_WARNING << "aggregator list: " << listed.message()
+                          << "; retrying in ~" << pause << "s";
+          if (!SleepFor(k8s::desync::SpreadRetryAfterS(pause, node_key))) {
+            return;
+          }
+          continue;
+        }
+        consecutive_failures = 0;
+        bool first_sync;
+        size_t nodes;
+        {
+          std::lock_guard<std::mutex> lock(shared_->mu);
+          first_sync = !shared_->synced;
+          shared_->synced = true;
+          nodes = shared_->store.nodes();
+          // The list itself may have moved rollups: publish them.
+          shared_->flush.NoteDirty(MonoSeconds());
+          shared_->cv.notify_all();
+        }
+        obs::DefaultJournal().Record(
+            first_sync ? "agg-synced" : "agg-resync", "agg",
+            (first_sync ? std::string("initial sync: ")
+                        : std::string("re-list after 410: ")) +
+                std::to_string(nodes) + " nodes at rv " + rv,
+            {{"nodes", std::to_string(nodes)}, {"resource_version", rv}});
+      }
+
+      std::string url = CollectionUrl(config_) + "?" + NodeSelectorQuery() +
+                        "&watch=true&allowWatchBookmarks=true"
+                        "&timeoutSeconds=240";
+      if (!rv.empty()) url += "&resourceVersion=" + rv;
+      http::RequestOptions stream_options = BaseOptions(config_);
+      stream_options.timeout_ms = 300000;
+      stream_options.connect_timeout_ms = 5000;
+
+      bool established = false;
+      bool resync_gone = false;
+      double server_retry_after = 0;
+      int stream_status = 0;
+      std::string line_buffer;
+      http::StreamHandler handler;
+      handler.on_connected = [this](int fd) { stream_fd_.store(fd); };
+      handler.on_response = [&](const http::Response& head) {
+        stream_status = head.status;
+        server_retry_after = head.RetryAfterSeconds();
+        if (head.status == 200) {
+          established = true;
+          consecutive_failures = 0;
+          return true;
+        }
+        return false;
+      };
+      handler.on_data = [&](const char* data, size_t len) {
+        if (stop_.load()) return false;
+        line_buffer.append(data, len);
+        size_t start = 0;
+        size_t eol;
+        while ((eol = line_buffer.find('\n', start)) != std::string::npos) {
+          std::string line = line_buffer.substr(start, eol - start);
+          start = eol + 1;
+          if (line.empty() || line == "\r") continue;
+          k8s::WatchEvent event = k8s::ParseWatchEventLine(line);
+          EventCounter(k8s::WatchEventTypeName(event.type))->Inc();
+          switch (event.type) {
+            case k8s::WatchEvent::Type::kBookmark:
+              if (!event.resource_version.empty()) {
+                rv = event.resource_version;
+              }
+              break;
+            case k8s::WatchEvent::Type::kError:
+              if (event.error_code == 410) {
+                resync_gone = true;
+                line_buffer.clear();
+                return false;
+              }
+              break;
+            case k8s::WatchEvent::Type::kAdded:
+            case k8s::WatchEvent::Type::kModified:
+            case k8s::WatchEvent::Type::kDeleted:
+              if (!event.resource_version.empty()) {
+                rv = event.resource_version;
+              }
+              ApplyObject(event.name, event.labels,
+                          event.type == k8s::WatchEvent::Type::kDeleted);
+              break;
+            case k8s::WatchEvent::Type::kUnknown:
+              break;
+          }
+        }
+        line_buffer.erase(0, start);
+        if (line_buffer.size() > 1024 * 1024) line_buffer.clear();
+        return true;
+      };
+
+      Status streamed =
+          http::RequestStream("GET", url, "", stream_options, handler);
+      stream_fd_.store(-1);
+      if (stop_.load()) return;
+
+      if (resync_gone || stream_status == 410) {
+        obs::DefaultJournal().Record(
+            "agg-resync", "agg",
+            "collection watch resourceVersion too old (410 Gone); "
+            "re-listing once",
+            {{"resource_version", rv}});
+        rv.clear();
+        continue;
+      }
+      if (streamed.ok() && established) continue;  // clean rotation
+      if (stream_status == 429 || stream_status == 503 ||
+          server_retry_after > 0) {
+        double pause = server_retry_after > 0 ? server_retry_after : 1.0;
+        if (!SleepFor(k8s::desync::SpreadRetryAfterS(pause, node_key))) {
+          return;
+        }
+        continue;
+      }
+      consecutive_failures++;
+      double pause = std::min(
+          30.0, 1.0 * (1 << std::min(consecutive_failures - 1, 10)));
+      TFD_LOG_WARNING << "aggregator watch dropped ("
+                      << (!streamed.ok()
+                              ? streamed.message()
+                              : "HTTP " + std::to_string(stream_status))
+                      << "); reconnecting in ~" << pause << "s";
+      if (!SleepFor(k8s::desync::SpreadRetryAfterS(pause, node_key))) {
+        return;
+      }
+    }
+  }
+
+  k8s::ClusterConfig config_;
+  Shared* shared_;
+  std::thread thread_;
+  std::atomic<bool> stop_{false};
+  std::atomic<int> stream_fd_{-1};
+  std::atomic<uint64_t> relists_{0};
+  std::mutex mu_;
+  std::condition_variable cv_;
+  bool started_ = false;
+};
+
+// ---- output publish -------------------------------------------------------
+
+// One server-side apply of the full rollup set under the
+// "tfd-aggregator" field manager (creates-if-missing, zero GETs); a
+// server without SSA (415/405) falls back to GET -> PUT/POST, like the
+// sink's ladder, remembered per process.
+Status PublishOutput(const k8s::ClusterConfig& config,
+                     const std::string& output_name,
+                     const lm::Labels& labels, bool* apply_unsupported) {
+  std::string named_url = CollectionUrl(config) + "/" + output_name;
+  std::string body =
+      std::string("{\"apiVersion\":\"nfd.k8s-sigs.io/v1alpha1\","
+                  "\"kind\":\"NodeFeature\",\"metadata\":{\"name\":") +
+      jsonlite::Quote(output_name) + "},\"spec\":{\"labels\":" +
+      jsonlite::SerializeStringMap(labels) + "}}";
+
+  if (!*apply_unsupported) {
+    http::RequestOptions options = BaseOptions(config);
+    options.headers["Content-Type"] = "application/apply-patch+yaml";
+    options.deadline_ms = 15000;
+    Result<http::Response> applied = http::Request(
+        "PATCH",
+        named_url + "?fieldManager=" + std::string(kFieldManager) +
+            "&force=true",
+        body, options);
+    if (!applied.ok()) {
+      return Status::Error("apply failed: " + applied.error());
+    }
+    if (applied->status == 200 || applied->status == 201) {
+      return Status::Ok();
+    }
+    if (applied->status == 415 || applied->status == 405) {
+      *apply_unsupported = true;  // demote for the rest of the process
+    } else {
+      return Status::Error("apply HTTP " +
+                           std::to_string(applied->status));
+    }
+  }
+
+  // Fallback rung: GET -> mutate -> PUT (or POST when absent).
+  http::RequestOptions options = BaseOptions(config);
+  options.deadline_ms = 15000;
+  Result<http::Response> got = http::Request("GET", named_url, "", options);
+  if (!got.ok()) return Status::Error("get failed: " + got.error());
+  if (got->status == 404) {
+    http::RequestOptions post = BaseOptions(config);
+    post.headers["Content-Type"] = "application/json";
+    post.deadline_ms = 15000;
+    Result<http::Response> created =
+        http::Request("POST", CollectionUrl(config), body, post);
+    if (!created.ok()) {
+      return Status::Error("create failed: " + created.error());
+    }
+    if (created->status == 200 || created->status == 201) {
+      return Status::Ok();
+    }
+    return Status::Error("create HTTP " + std::to_string(created->status));
+  }
+  if (got->status != 200) {
+    return Status::Error("get HTTP " + std::to_string(got->status));
+  }
+  Result<jsonlite::ValuePtr> parsed = jsonlite::Parse(got->body);
+  if (!parsed.ok()) return Status::Error("get parse: " + parsed.error());
+  jsonlite::ValuePtr spec = std::make_shared<jsonlite::Value>();
+  spec->kind = jsonlite::Value::Kind::kObject;
+  spec->Set("labels", jsonlite::FromStringMap(labels));
+  (*parsed)->Set("spec", spec);
+  http::RequestOptions put = BaseOptions(config);
+  put.headers["Content-Type"] = "application/json";
+  put.deadline_ms = 15000;
+  Result<http::Response> replaced = http::Request(
+      "PUT", named_url, jsonlite::Serialize(**parsed), put);
+  if (!replaced.ok()) {
+    return Status::Error("put failed: " + replaced.error());
+  }
+  if (replaced->status == 200) return Status::Ok();
+  return Status::Error("put HTTP " + std::to_string(replaced->status));
+}
+
+// ---- lease ----------------------------------------------------------------
+
+struct LeaseState {
+  bool leading = false;
+  uint64_t epoch = 0;
+  bool ever_contacted = false;
+  // Last successful (or server-alive) blackboard contact, monotonic.
+  double last_contact_mono = 0;
+};
+
+// One lease tick against the "tfd-aggregator" ConfigMap: bootstrap,
+// renew, or take over an expired lease — optimistic concurrency via the
+// resourceVersion precondition, exactly like the slice blackboard.
+void LeaseTick(const k8s::ClusterConfig& config, const std::string& self,
+               int lease_duration_s, LeaseState* state) {
+  bool server_alive = false;
+  Result<k8s::CoordDocResult> doc =
+      k8s::GetCoordConfigMap(config, kLeaseDocName, &server_alive, nullptr);
+  bool was_leading = state->leading;
+  if (!doc.ok()) {
+    TFD_LOG_WARNING << "aggregator lease: " << doc.error();
+    // A 429/503-paced server is ALIVE (it answered): the lease doc's
+    // truth is intact, only this poll was deferred — never a partition
+    // signal. A naked failure, though, means we cannot see the
+    // blackboard: a leader keeps leading only while its own lease
+    // could still be valid. Past a full lease duration without
+    // contact, a standby that CAN see the doc has taken over at
+    // expiry — continuing to watch and publish would be exactly the
+    // double publishing the lease exists to prevent, so step down
+    // (the run loop stops the watch and clears the store) until
+    // contact resumes.
+    if (server_alive) {
+      state->last_contact_mono = MonoSeconds();
+    } else if (state->leading &&
+               MonoSeconds() - state->last_contact_mono >
+                   static_cast<double>(lease_duration_s)) {
+      state->leading = false;
+      obs::DefaultJournal().Record(
+          "agg-follower", "agg",
+          "stepped down: lease blackboard unreachable for a full lease",
+          {{"holder", self},
+           {"epoch", std::to_string(state->epoch)}});
+      SetStateGauge(0);
+    }
+    return;
+  }
+  state->ever_contacted = true;
+  state->last_contact_mono = MonoSeconds();
+  double now_wall = WallClockSeconds();
+  slice::Lease lease;
+  bool have_lease = false;
+  if (doc->found) {
+    auto it = doc->data.find(kLeaseKey);
+    if (it != doc->data.end()) {
+      if (Result<slice::Lease> parsed = slice::ParseLease(it->second);
+          parsed.ok()) {
+        lease = *parsed;
+        have_lease = true;
+      }
+    }
+  }
+
+  auto write_lease = [&](uint64_t epoch, bool create) {
+    slice::Lease next;
+    next.holder = self;
+    next.epoch = epoch;
+    next.renewed_at = now_wall;
+    next.duration_s = lease_duration_s;
+    bool conflict = false;
+    Status wrote = k8s::PatchCoordConfigMap(
+        config, kLeaseDocName, {{kLeaseKey, slice::SerializeLease(next)}},
+        create ? "" : doc->resource_version, create, &conflict,
+        &server_alive, nullptr);
+    if (wrote.ok()) {
+      state->leading = true;
+      state->epoch = epoch;
+      return true;
+    }
+    state->leading = false;
+    return false;
+  };
+
+  if (!doc->found) {
+    write_lease(1, /*create=*/true);
+  } else if (have_lease && lease.holder == self &&
+             !slice::LeaseExpired(lease, now_wall)) {
+    write_lease(lease.epoch, /*create=*/false);  // renew, same epoch
+  } else if (!have_lease || slice::LeaseExpired(lease, now_wall)) {
+    write_lease(lease.epoch + 1, /*create=*/false);  // take over
+  } else {
+    state->leading = false;  // someone else holds a live lease
+  }
+
+  if (state->leading != was_leading) {
+    obs::DefaultJournal().Record(
+        state->leading ? "agg-leader" : "agg-follower", "agg",
+        state->leading
+            ? "acquired the aggregator lease (epoch " +
+                  std::to_string(state->epoch) + ")"
+            : "following (lease held by " + lease.holder + ")",
+        {{"holder", state->leading ? self : lease.holder},
+         {"epoch", std::to_string(state->leading ? state->epoch
+                                                 : lease.epoch)}});
+  }
+  SetStateGauge(state->leading ? 1 : 0);
+}
+
+}  // namespace
+
+AggOutcome RunAggregator(const config::Config& config,
+                         const sigset_t& sigmask) {
+  const config::Flags& flags = config.flags;
+  Result<k8s::ClusterConfig> cluster = k8s::LoadInClusterEndpoint();
+  if (!cluster.ok()) {
+    TFD_LOG_ERROR << "aggregator: " << cluster.error();
+    return AggOutcome::kError;
+  }
+  cluster->request_deadline_ms = flags.sink_request_deadline_s * 1000;
+  const std::string self = HolderIdentity();
+
+  std::unique_ptr<obs::IntrospectionServer> server;
+  if (!flags.introspection_addr.empty()) {
+    obs::ServerOptions options;
+    options.addr = flags.introspection_addr;
+    options.journal = &obs::DefaultJournal();
+    // Ready = the lease loop is making contact; 3 leases of slack.
+    options.stale_after_s = std::max(120, 3 * flags.agg_lease_duration_s);
+    Result<std::unique_ptr<obs::IntrospectionServer>> started =
+        obs::IntrospectionServer::Start(options, &obs::Default());
+    if (!started.ok()) {
+      TFD_LOG_ERROR << "aggregator introspection server: "
+                    << started.error();
+      return AggOutcome::kError;
+    }
+    server = std::move(*started);
+    TFD_LOG_INFO << "aggregator introspection on port " << server->port();
+  }
+
+  TFD_LOG_INFO << "tpu-feature-aggregator " << info::VersionString()
+               << " as " << self << " (output "
+               << flags.agg_output_name << ", debounce "
+               << flags.agg_debounce_s << "s, lease "
+               << flags.agg_lease_duration_s << "s)";
+  FullRecomputeCounter();  // register at 0: the acceptance contract
+  SetStateGauge(0);
+
+  Shared shared(static_cast<double>(flags.agg_debounce_s));
+  CollectionWatcher watcher(*cluster, &shared);
+  LeaseState lease_state;
+  bool apply_unsupported = false;
+  const double lease_tick_s =
+      std::max(1.0, flags.agg_lease_duration_s / 3.0);
+  double next_lease_tick = 0;  // immediately
+  double flush_retry_at = 0;
+
+  while (true) {
+    // Collect pending signals without blocking the flush loop.
+    struct timespec zero = {0, 0};
+    int sig;
+    while ((sig = sigtimedwait(&sigmask, nullptr, &zero)) > 0) {
+      if (sig == SIGTERM || sig == SIGINT || sig == SIGQUIT) {
+        TFD_LOG_INFO << "aggregator: signal " << sig << ", shutting down";
+        watcher.Stop();
+        return AggOutcome::kExit;
+      }
+      if (sig == SIGHUP) {
+        TFD_LOG_INFO << "aggregator: SIGHUP, reloading";
+        watcher.Stop();
+        return AggOutcome::kRestart;
+      }
+      // SIGUSR1 etc.: nothing mode-specific to dump.
+    }
+
+    double now = MonoSeconds();
+    if (now >= next_lease_tick) {
+      bool was_leading = lease_state.leading;
+      LeaseTick(*cluster, self, flags.agg_lease_duration_s, &lease_state);
+      next_lease_tick = now + lease_tick_s;
+      if (server && lease_state.ever_contacted) {
+        server->RecordRewrite(true);  // lease contact = liveness
+      }
+      if (lease_state.leading && !was_leading) {
+        watcher.Start();
+      } else if (!lease_state.leading && was_leading) {
+        // Lost the lease: stop watching and forget — the new leader
+        // owns the output; a re-election re-lists from scratch.
+        watcher.Stop();
+        std::lock_guard<std::mutex> lock(shared.mu);
+        shared.store.Clear();
+        shared.synced = false;
+        shared.flush.NoteFlushed();
+      }
+    }
+
+    bool flush_now = false;
+    lm::Labels output;
+    double staleness_s = 0;
+    {
+      std::unique_lock<std::mutex> lock(shared.mu);
+      // A pending retry pushes the dirty flush's due time out to
+      // flush_retry_at — without the max() the loop would wake
+      // immediately (DueAt already past), fail the retry gate, and
+      // busy-spin for the whole retry window during an outage.
+      double due = std::min(std::max(shared.flush.DueAt(), flush_retry_at),
+                            next_lease_tick);
+      double wait_s = std::min(0.2, std::max(0.0, due - MonoSeconds()));
+      shared.cv.wait_for(
+          lock, std::chrono::milliseconds(
+                    static_cast<long long>(wait_s * 1000)));
+      now = MonoSeconds();
+      if (lease_state.leading && shared.synced &&
+          shared.flush.ShouldFlush(now) && now >= flush_retry_at) {
+        flush_now = true;
+        output = shared.store.BuildOutputLabels();
+        staleness_s = now - shared.flush.dirty_since();
+      }
+    }
+
+    if (flush_now) {
+      auto t0 = std::chrono::steady_clock::now();
+      Status published = PublishOutput(*cluster, flags.agg_output_name,
+                                       output, &apply_unsupported);
+      double write_s = obs::SecondsSince(t0);
+      if (published.ok()) {
+        {
+          std::lock_guard<std::mutex> lock(shared.mu);
+          shared.flush.NoteFlushed();
+        }
+        flush_retry_at = 0;
+        obs::Default()
+            .GetCounter("tfd_agg_flushes_total",
+                        "Coalesced rollup publishes (one per debounce "
+                        "window with changes, regardless of how many "
+                        "node deltas rode it).")
+            ->Inc();
+        obs::Default()
+            .GetHistogram(
+                "tfd_agg_flush_latency_seconds",
+                "Dirty-to-published latency of a rollup flush "
+                "(debounce coalescing included).",
+                obs::DurationBuckets())
+            ->Observe(staleness_s + write_s);
+        obs::DefaultJournal().Record(
+            "agg-flush", "agg",
+            "published " + std::to_string(output.size()) +
+                " rollup labels to " + flags.agg_output_name,
+            {{"labels", std::to_string(output.size())},
+             {"staleness_ms",
+              std::to_string(static_cast<long long>(
+                  (staleness_s + write_s) * 1000))}});
+        if (server) {
+          server->RecordRewrite(true);
+          std::string json = "{\"output\":" +
+                             jsonlite::SerializeStringMap(output) + "}";
+          server->SetLabelsJson(json);
+        }
+      } else {
+        // Keep the window dirty; retry on a short cadence so a
+        // transient write failure costs seconds, not a lost publish.
+        flush_retry_at = MonoSeconds() + 1.0;
+        if (server) server->RecordRewrite(false);
+        obs::DefaultJournal().Record(
+            "agg-flush-failed", "agg",
+            "rollup publish failed: " + published.message(),
+            {{"error", published.message()}});
+        TFD_LOG_WARNING << "aggregator publish: " << published.message();
+      }
+    }
+  }
+}
+
+}  // namespace agg
+}  // namespace tfd

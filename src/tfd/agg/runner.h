@@ -1,0 +1,44 @@
+// The tpu-feature-aggregator binary mode (--mode=aggregator): the
+// transport around agg/agg.h's incremental rollup core.
+//
+// One optional cluster singleton (a Deployment, not a DaemonSet),
+// lease-elected through the same optimistic-concurrency ConfigMap
+// discipline as the slice blackboard (doc "tfd-aggregator"; standbys
+// poll at lease/3 and take over at expiry). The leader LISTs every
+// NodeFeature CR once (journal `agg-synced`), then holds ONE
+// collection-scoped watch stream — bookmarks, clean timeoutSeconds
+// rotation, Retry-After-paced reconnects, and a `410 Gone` that
+// re-lists exactly once (journal `agg-resync`) — so steady-state
+// apiserver load is independent of fleet size: zero LISTs, one parked
+// stream, and one lease renewal per lease/3.
+//
+// Every watch delta updates the rollups in O(labels changed on one
+// node) through InventoryStore::Apply; `tfd_agg_full_recomputes_total`
+// exists to prove the steady path never recomputes (the fleet soak
+// gates it == 0 after sync). Publishes ride the FlushController's
+// coalescing debounce (--agg-debounce, default 2s) as ONE server-side
+// apply-patch of the whole rollup label set onto the cluster-scoped
+// output object (--agg-output-name), so a 1000-node churn burst becomes
+// one write.
+#pragma once
+
+#include <signal.h>
+
+#include "tfd/config/config.h"
+
+namespace tfd {
+namespace agg {
+
+enum class AggOutcome {
+  kExit,     // SIGTERM/SIGINT: clean shutdown
+  kRestart,  // SIGHUP: reload config and re-enter
+  kError,    // unrecoverable startup failure
+};
+
+// Runs the aggregator until a signal. `sigmask` is the blocked set the
+// caller (main.cc) collects signals from.
+AggOutcome RunAggregator(const config::Config& config,
+                         const sigset_t& sigmask);
+
+}  // namespace agg
+}  // namespace tfd

@@ -658,6 +658,72 @@ std::vector<FlagDef> MakeFlagDefs(Flags* f) {
                     f->plugin_label_budget = parsed;
                     return Status::Ok();
                   }});
+  defs.push_back({"mode",
+                  {"TFD_MODE"},
+                  "mode",
+                  "binary mode: 'daemon' labels THIS node; 'aggregator' "
+                  "runs the lease-elected cluster-inventory singleton "
+                  "(watches every NodeFeature CR, maintains per-slice/"
+                  "capacity/fleet-perf rollups incrementally, publishes "
+                  "one cluster-scoped output object)",
+                  false,
+                  [f](const std::string& v) {
+                    return SetString(&f->mode, v);
+                  }});
+  defs.push_back({"agg-debounce",
+                  {"TFD_AGG_DEBOUNCE"},
+                  "aggDebounce",
+                  "aggregator publish debounce: the first dirtying watch "
+                  "event opens a window this long and every further "
+                  "event inside it rides the same output write "
+                  "(bounded-staleness coalescing, e.g. 2s)",
+                  false,
+                  [f](const std::string& v) {
+                    return SetDuration(&f->agg_debounce_s, v);
+                  }});
+  defs.push_back({"agg-lease-duration",
+                  {"TFD_AGG_LEASE_DURATION"},
+                  "aggLeaseDuration",
+                  "aggregator leadership lease (ConfigMap "
+                  "'tfd-aggregator'); standbys poll at a third of it "
+                  "and take over at expiry",
+                  false,
+                  [f](const std::string& v) {
+                    return SetDuration(&f->agg_lease_duration_s, v);
+                  }});
+  defs.push_back({"agg-output-name",
+                  {"TFD_AGG_OUTPUT_NAME"},
+                  "aggOutputName",
+                  "name of the cluster-scoped output NodeFeature object "
+                  "the aggregator applies its rollups to",
+                  false,
+                  [f](const std::string& v) {
+                    return SetString(&f->agg_output_name, v);
+                  }});
+  defs.push_back({"perf-fleet-floor-source",
+                  {"TFD_PERF_FLEET_FLOOR_SOURCE"},
+                  "perfFleetFloorSource",
+                  "fleet-relative perf floor input: a JSON file carrying "
+                  "the aggregator-published floors "
+                  "({\"matmul_p10_tflops\":N,\"hbm_p10_gbps\":N}); a "
+                  "node measuring below its fleet's p10 classifies "
+                  "degraded even above 50%-of-rated ('' disables)",
+                  false,
+                  [f](const std::string& v) {
+                    return SetString(&f->perf_fleet_floor_source, v);
+                  }});
+  defs.push_back({"lifecycle-watch",
+                  {"TFD_LIFECYCLE_WATCH"},
+                  "lifecycleWatch",
+                  "preemption-aware lifecycle fast path: watch the GCE "
+                  "preemption metadata endpoint and the node's "
+                  "taints/unschedulable spec, publishing "
+                  "google.com/tpu.lifecycle.{preempt-imminent,draining} "
+                  "within one probe tick (governor-exempt)",
+                  true,
+                  [f](const std::string& v) {
+                    return SetBool(&f->lifecycle_watch, v);
+                  }});
   defs.push_back({"fault-spec",
                   {"TFD_FAULT_SPEC"},
                   "faultSpec",
@@ -1065,6 +1131,22 @@ Result<LoadResult> Load(int argc, char** argv) {
   if (f->plugin_label_budget < 1) {
     return Result<LoadResult>::Error("plugin-label-budget must be >= 1");
   }
+  if (f->mode != "daemon" && f->mode != "aggregator") {
+    return Result<LoadResult>::Error("invalid mode '" + f->mode +
+                                     "' (want daemon|aggregator)");
+  }
+  if (f->agg_debounce_s < 0) {
+    return Result<LoadResult>::Error("agg-debounce must be >= 0s");
+  }
+  if (f->agg_lease_duration_s < 2) {
+    // Same floor as the slice lease: a 1s lease flaps leadership on
+    // any scheduling hiccup.
+    return Result<LoadResult>::Error("agg-lease-duration must be >= 2s");
+  }
+  if (f->mode == "aggregator" && f->agg_output_name.empty()) {
+    return Result<LoadResult>::Error(
+        "aggregator mode needs a non-empty agg-output-name");
+  }
   if (!f->fault_spec.empty()) {
     Status s = fault::Validate(f->fault_spec);
     if (!s.ok()) {
@@ -1157,6 +1239,12 @@ std::string ToJson(const Config& config) {
       << ",\"pluginTimeout\":\"" << f.plugin_timeout_s << "s\""
       << ",\"pluginInterval\":\"" << f.plugin_interval_s << "s\""
       << ",\"pluginLabelBudget\":" << f.plugin_label_budget
+      << ",\"mode\":" << jstr(f.mode)
+      << ",\"aggDebounce\":\"" << f.agg_debounce_s << "s\""
+      << ",\"aggLeaseDuration\":\"" << f.agg_lease_duration_s << "s\""
+      << ",\"aggOutputName\":" << jstr(f.agg_output_name)
+      << ",\"perfFleetFloorSource\":" << jstr(f.perf_fleet_floor_source)
+      << ",\"lifecycleWatch\":" << (f.lifecycle_watch ? "true" : "false")
       << ",\"faultSpec\":" << jstr(f.fault_spec)
       << "},\"sharing\":[";
   for (size_t i = 0; i < config.sharing.time_slicing.size(); i++) {

@@ -47,6 +47,13 @@ struct Sharing {
 };
 
 struct Flags {
+  // Binary mode (shared main): "daemon" is the per-node feature daemon;
+  // "aggregator" is the optional lease-elected cluster singleton
+  // (agg/runner.h) that WATCHes every NodeFeature CR and maintains
+  // cluster-scoped inventory rollups incrementally — per-slice health,
+  // capacity-by-class, fleet perf percentiles — publishing them as SSA
+  // apply-patches on one cluster-scoped output object.
+  std::string mode = "daemon";
   std::string slice_strategy = kSliceStrategyNone;
   bool fail_on_init_error = true;
   bool oneshot = false;
@@ -298,6 +305,37 @@ struct Flags {
   // rejected WHOLE (journal "plugin-violation", flap evidence toward
   // quarantine) — label spam must not publish even its first N keys.
   int plugin_label_budget = 32;
+  // Aggregator publish debounce (agg/agg.h FlushController): the first
+  // dirtying watch event opens a window this long; every further event
+  // inside it rides the SAME output write (a 1000-node churn burst
+  // coalesces to one SSA apply), and no rollup is ever published more
+  // than this late — a bounded-staleness flush, not a quiet-period
+  // timer.
+  int agg_debounce_s = 2;
+  // Aggregator leadership lease (ConfigMap "tfd-aggregator", same
+  // optimistic-concurrency lease discipline as the slice blackboard):
+  // standbys poll at a third of this and take over at expiry, so
+  // running the aggregator as a 2-replica Deployment gives failover
+  // without double publishing.
+  int agg_lease_duration_s = 30;
+  // Name of the cluster-scoped output NodeFeature object the
+  // aggregator applies its rollups to (excluded from its own watch by
+  // the nfd node-name label selector).
+  std::string agg_output_name = "tfd-cluster-inventory";
+  // Fleet-relative perf floor input (perf/, ROADMAP #4a): a JSON file
+  // carrying the aggregator-published fleet floors
+  // ({"matmul_p10_tflops": N, "hbm_p10_gbps": N}); when set, a node
+  // measuring below the fleet's p10 classifies degraded even when it
+  // clears 50%-of-rated — gray degradation relative to ITS fleet.
+  // Empty disables (rated-spec classification only).
+  std::string perf_fleet_floor_source;
+  // Preemption-aware lifecycle fast path (sched/sources.cc
+  // "lifecycle" source): watch the GCE preemption metadata endpoint
+  // (instance/preempted) and the node's taints/unschedulable spec,
+  // publishing google.com/tpu.lifecycle.{preempt-imminent,draining}
+  // the moment either fires (governor-exempt keys; the slice leader
+  // folds a preempting member into a proactive degraded verdict).
+  bool lifecycle_watch = false;
   // Fault injection (fault/fault.h): named-point spec, e.g.
   // "sink.file:errno=ENOSPC:rate=0.3,k8s.put:http=500:count=3".
   // TEST-ONLY — an armed daemon fails on purpose; empty (default)

@@ -95,6 +95,18 @@ Result<bool> MetadataClient::Preemptible() const {
   return ToLower(TrimSpace(*v)) == "true";
 }
 
+Result<bool> MetadataClient::Preempted() const {
+  // instance/preempted flips to TRUE the moment GCE issues the
+  // preemption notice (the ~30s ACPI-G2 warning window) and a 404 on a
+  // non-preemptible shape just means "no": both read as not-preempted.
+  Result<std::string> v = Get("instance/preempted");
+  if (!v.ok()) {
+    if (last_error_kind_ == ErrorKind::kNotFound) return false;
+    return Result<bool>::Error(v.error());
+  }
+  return ToLower(TrimSpace(*v)) == "true";
+}
+
 std::map<std::string, std::string> ParseTpuEnv(const std::string& text) {
   // Format: one "KEY: 'value'" per line (value quoting optional).
   std::map<std::string, std::string> out;

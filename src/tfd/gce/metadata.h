@@ -63,6 +63,10 @@ class MetadataClient {
   Result<std::map<std::string, std::string>> TpuEnv() const;
   Result<std::string> InstanceId() const;
   Result<bool> Preemptible() const;
+  // instance/preempted: TRUE once GCE has issued the preemption notice
+  // (the fast-path input of the lifecycle probe). A 404 — the key is
+  // absent on non-preemptible shapes — reads as false, not an error.
+  Result<bool> Preempted() const;
 
   const std::string& endpoint() const { return endpoint_; }
 

@@ -274,15 +274,20 @@ SinkState& DefaultSinkState() {
 }
 
 Result<ClusterConfig> LoadInClusterConfig() {
-  ClusterConfig config;
-
   const char* node = std::getenv("NODE_NAME");
   if (node == nullptr || *node == '\0') {
     return Result<ClusterConfig>::Error(
         "NODE_NAME environment variable not set (required for the "
         "NodeFeature API sink)");
   }
-  config.node_name = node;
+  Result<ClusterConfig> config = LoadInClusterEndpoint();
+  if (!config.ok()) return config;
+  config->node_name = node;
+  return config;
+}
+
+Result<ClusterConfig> LoadInClusterEndpoint() {
+  ClusterConfig config;
 
   if (const char* url = std::getenv("TFD_APISERVER_URL")) {
     config.apiserver_url = url;
@@ -804,6 +809,58 @@ Status PatchCoordConfigMap(const ClusterConfig& config,
   return Status::Error("patching slice ConfigMap: HTTP " +
                        std::to_string(patched->status) + ": " +
                        patched->body.substr(0, 256));
+}
+
+Status GetNodeDraining(const ClusterConfig& config, bool* draining,
+                       bool* server_alive) {
+  if (draining != nullptr) *draining = false;
+  if (server_alive != nullptr) *server_alive = false;
+  WriteOutcome outcome;
+  http::RequestOptions options = BaseOptions(config);
+  std::string url =
+      config.apiserver_url + "/api/v1/nodes/" + config.node_name;
+  Result<http::Response> got =
+      CountedRequest("k8s.get", "GET", url, "", options, &outcome);
+  if (!got.ok()) {
+    return Status::Error("getting node: " + got.error());
+  }
+  if (server_alive != nullptr) *server_alive = true;
+  if (got->status == 404) return Status::Ok();  // no Node object: not draining
+  if (got->status != 200) {
+    return Status::Error("getting node: HTTP " +
+                         std::to_string(got->status));
+  }
+  Result<jsonlite::ValuePtr> parsed = jsonlite::Parse(got->body);
+  if (!parsed.ok()) {
+    return Status::Error("parsing node: " + parsed.error());
+  }
+  const jsonlite::Value& node = **parsed;
+  bool is_draining = false;
+  if (jsonlite::ValuePtr unsched = node.GetPath("spec.unschedulable");
+      unsched && unsched->kind == jsonlite::Value::Kind::kBool &&
+      unsched->bool_value) {
+    is_draining = true;
+  }
+  if (jsonlite::ValuePtr taints = node.GetPath("spec.taints");
+      taints && taints->kind == jsonlite::Value::Kind::kArray) {
+    for (const jsonlite::ValuePtr& taint : taints->array_items) {
+      if (!taint || taint->kind != jsonlite::Value::Kind::kObject) continue;
+      jsonlite::ValuePtr key = taint->Get("key");
+      if (!key || key->kind != jsonlite::Value::Kind::kString) continue;
+      const std::string& k = key->string_value;
+      // The eviction-impending taints a TPU scheduler cares about: the
+      // kubectl-drain/unschedulable marker and both cluster-autoscaler
+      // scale-down markers.
+      if (k == "node.kubernetes.io/unschedulable" ||
+          k == "ToBeDeletedByClusterAutoscaler" ||
+          k == "DeletionCandidateOfClusterAutoscaler") {
+        is_draining = true;
+        break;
+      }
+    }
+  }
+  if (draining != nullptr) *draining = is_draining;
+  return Status::Ok();
 }
 
 }  // namespace k8s

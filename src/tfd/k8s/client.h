@@ -53,6 +53,22 @@ inline constexpr char kApplyFieldManager[] = "tfd";
 // NODE_NAME or the API server location is missing.
 Result<ClusterConfig> LoadInClusterConfig();
 
+// The endpoint half alone (apiserver url, token, CA, namespace) —
+// NODE_NAME not required. The aggregator (agg/runner.cc) is a cluster
+// singleton, not a node agent; LoadInClusterConfig() is this plus the
+// NODE_NAME gate.
+Result<ClusterConfig> LoadInClusterEndpoint();
+
+// GET /api/v1/nodes/<node> and report whether the node is draining:
+// .spec.unschedulable, or any taint whose key marks an impending
+// eviction (node.kubernetes.io/unschedulable, the cluster-autoscaler's
+// ToBeDeletedByClusterAutoscaler, DeletionCandidateOfClusterAutoscaler).
+// `server_alive` (non-null) reports whether ANY HTTP response arrived.
+// Rides the same counted request machinery (and the k8s.get fault
+// point) as the sink.
+Status GetNodeDraining(const ClusterConfig& config, bool* draining,
+                       bool* server_alive);
+
 // What the sink last acknowledged, carried across passes (the daemon
 // keeps one above the reload loop; tests pass their own). This is what
 // turns the fleet-hostile GET+full-PUT-per-write into a diff sink: with

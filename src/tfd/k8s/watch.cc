@@ -121,6 +121,10 @@ WatchEvent ParseWatchEventLine(const std::string& line) {
       rv && rv->kind == jsonlite::Value::Kind::kString) {
     event.resource_version = rv->string_value;
   }
+  if (jsonlite::ValuePtr name = object->GetPath("metadata.name");
+      name && name->kind == jsonlite::Value::Kind::kString) {
+    event.name = name->string_value;
+  }
   if (event.type == WatchEvent::Type::kError) {
     if (jsonlite::ValuePtr code = object->Get("code");
         code && code->kind == jsonlite::Value::Kind::kNumber) {

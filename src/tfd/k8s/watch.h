@@ -55,6 +55,11 @@ struct WatchEvent {
     kUnknown,  // unparseable line / unrecognized type (ignored, counted)
   };
   Type type = Type::kUnknown;
+  std::string name;              // object.metadata.name ("" when absent) —
+                                 // load-bearing at COLLECTION scope, where
+                                 // one stream carries every object
+                                 // (agg/runner.cc); the per-object watcher
+                                 // ignores it
   std::string resource_version;  // object.metadata.resourceVersion
   bool has_labels = false;       // object.spec.labels parsed (string values)
   lm::Labels labels;

@@ -52,6 +52,13 @@ bool GovernedKey(const std::string& key) {
       key == kSliceDegraded) {
     return false;
   }
+  // Lifecycle fast-path keys (tpu.lifecycle.preempt-imminent/draining)
+  // are exempt like the quarantine annotation: edge-triggered,
+  // conservative-direction facts whose inputs (the GCE preemption
+  // notice, a kubelet taint) are already debounced upstream — a
+  // governor hold-down could delay the ONE label a scheduler needs
+  // within the ~30s preemption warning window.
+  if (HasPrefix(key, kLifecyclePrefix)) return false;
   return true;
 }
 

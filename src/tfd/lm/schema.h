@@ -136,6 +136,48 @@ inline constexpr char kPerfClass[] = "google.com/tpu.perf.class";
 // lowest precedence so first-party labels always win.
 inline constexpr char kPluginNamespacePrefix[] = "google.com/tpu.plugin.";
 
+// Preemption-aware lifecycle (sched/sources.cc "lifecycle" source,
+// --lifecycle-watch): edge-triggered fast-path labels — present ONLY
+// while the condition holds (absence = normal), exempt from the
+// governor's hold-down like the quarantine annotation (the conservative
+// direction must publish within one probe tick, and the inputs — the
+// GCE preemption notice, a kubelet taint — are already debounced
+// upstream). The slice leader folds a preempting member into a
+// proactive tpu.slice.degraded verdict (slice/coord.h
+// MemberReport.preempting).
+inline constexpr char kLifecyclePrefix[] = "google.com/tpu.lifecycle.";
+inline constexpr char kLifecyclePreemptImminent[] =
+    "google.com/tpu.lifecycle.preempt-imminent";
+inline constexpr char kLifecycleDraining[] =
+    "google.com/tpu.lifecycle.draining";
+
+// Cluster inventory rollups (agg/, --mode=aggregator): published on the
+// cluster-scoped output object (NodeFeature CR "tfd-cluster-inventory"),
+// never on a node. Maintained INCREMENTALLY — every watch delta retires
+// the node's old contribution and applies the new one (agg/agg.h).
+inline constexpr char kInventorySlices[] =
+    "google.com/tpu.slice-inventory.slices";
+inline constexpr char kInventoryHealthySlices[] =
+    "google.com/tpu.slice-inventory.healthy-slices";
+inline constexpr char kInventoryDegradedSlices[] =
+    "google.com/tpu.slice-inventory.degraded-slices";
+inline constexpr char kCapacityPrefix[] = "google.com/tpu.capacity.";
+inline constexpr char kFleetNodes[] = "google.com/tpu.fleet.nodes";
+inline constexpr char kFleetPreempting[] =
+    "google.com/tpu.fleet.preempting";
+inline constexpr char kMultisliceGroups[] =
+    "google.com/tpu.multislice.groups";
+// Fleet-relative perf floors (ROADMAP #4a): the fleet's measured
+// distribution, published so on-node daemons can classify "degraded"
+// as "below this fleet's p10" (--perf-fleet-floor-source).
+inline constexpr char kFleetPerfPrefix[] = "google.com/tpu.fleet.perf.";
+inline constexpr char kFleetMatmulP10[] =
+    "google.com/tpu.fleet.perf.matmul-p10";
+inline constexpr char kFleetMatmulP50[] =
+    "google.com/tpu.fleet.perf.matmul-p50";
+inline constexpr char kFleetHbmP10[] = "google.com/tpu.fleet.perf.hbm-p10";
+inline constexpr char kFleetHbmP50[] = "google.com/tpu.fleet.perf.hbm-p50";
+
 // Degradation ladder (sched/): present only when the daemon is serving
 // CACHED device facts because the probe source missed its cadence
 // (chips held by a training job, wedged libtpu). Age is whole seconds

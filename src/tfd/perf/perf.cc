@@ -123,6 +123,44 @@ int ClassifyPct(double matmul_pct, double hbm_pct, int prev_rank) {
   return still_crosses ? rank : prev_rank;
 }
 
+Result<FleetFloor> ParseFleetFloor(const std::string& json_text) {
+  Result<jsonlite::ValuePtr> parsed = jsonlite::Parse(json_text);
+  if (!parsed.ok()) {
+    return Result<FleetFloor>::Error("fleet floor parse: " +
+                                     parsed.error());
+  }
+  if ((*parsed)->kind != jsonlite::Value::Kind::kObject) {
+    return Result<FleetFloor>::Error("fleet floor: not a JSON object");
+  }
+  FleetFloor floor;
+  auto number = [&](const char* key, double* out) {
+    jsonlite::ValuePtr v = (*parsed)->Get(key);
+    if (v && v->kind == jsonlite::Value::Kind::kNumber &&
+        v->number_value >= 0) {
+      *out = v->number_value;
+    }
+  };
+  number("matmul_p10_tflops", &floor.matmul_p10_tflops);
+  number("hbm_p10_gbps", &floor.hbm_p10_gbps);
+  return floor;
+}
+
+int ApplyFleetFloor(int rank, double matmul_tflops, double hbm_gbps,
+                    const FleetFloor& floor) {
+  // An unmeasured value (-1) never triggers a floor, and an unset
+  // floor (-1) never demotes: the floor only ever makes a MEASURED
+  // value stricter, in the conservative direction.
+  if (floor.matmul_p10_tflops >= 0 && matmul_tflops >= 0 &&
+      matmul_tflops < floor.matmul_p10_tflops) {
+    return kRankDegraded;
+  }
+  if (floor.hbm_p10_gbps >= 0 && hbm_gbps >= 0 &&
+      hbm_gbps < floor.hbm_p10_gbps) {
+    return kRankDegraded;
+  }
+  return rank;
+}
+
 std::string Fingerprint(const std::string& family, int chip_count,
                         const std::string& topology,
                         const std::string& libtpu_version) {

@@ -148,6 +148,32 @@ Result<std::map<std::string, double>> ParseExecOutput(
 // The five published labels for one characterization.
 std::map<std::string, std::string> BuildLabels(const Characterization& c);
 
+// Fleet-relative perf floor (--perf-fleet-floor-source, ROADMAP #4a):
+// the aggregator publishes the fleet's measured p10 floors
+// (tpu.fleet.perf.*); a node consuming them classifies `degraded` when
+// it measures BELOW its fleet's p10 even while clearing 50%-of-rated —
+// the gray-degradation case a static rated-spec table cannot catch.
+// Mirrored by tpufd/perfmodel.py (parse_fleet_floor/apply_fleet_floor,
+// parity-pinned).
+struct FleetFloor {
+  double matmul_p10_tflops = -1;  // -1 = no floor published
+  double hbm_p10_gbps = -1;
+  bool valid() const {
+    return matmul_p10_tflops >= 0 || hbm_p10_gbps >= 0;
+  }
+};
+
+// Parses the floor-source document:
+//   {"matmul_p10_tflops": 150.0, "hbm_p10_gbps": 600.0}
+// (either key optional). Errors on garbage; absent keys stay -1.
+Result<FleetFloor> ParseFleetFloor(const std::string& json_text);
+
+// Applies the floor to a raw classification: a measured value below
+// either floor demotes to kRankDegraded; everything else passes
+// through. A -1 (unmeasured) value never triggers a floor.
+int ApplyFleetFloor(int rank, double matmul_tflops, double hbm_gbps,
+                    const FleetFloor& floor);
+
 // Duty-cycle gate (pure, unit-tested): may a measurement start now?
 // After a measurement of `last_seconds` that ended at `last_end`, the
 // next may not start before last_end + last_seconds * (100/pct - 1);

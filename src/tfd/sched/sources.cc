@@ -350,6 +350,45 @@ Status RunPerfProbe(const config::Config& config,
   const int prev_rank =
       current.has_value() ? current->class_rank : -1;
   int raw_rank = perf::ClassifyPct(c.matmul_pct, c.hbm_pct, prev_rank);
+  // Fleet-relative floor (ROADMAP #4a): the aggregator's published p10
+  // makes "degraded" mean "below THIS fleet's floor" even when the
+  // static rated-spec gates pass — gray degradation. Read per
+  // measurement (measurements are rare by the amortization contract);
+  // a missing/garbled floor file disables the floor loudly, never the
+  // measurement.
+  if (!flags.perf_fleet_floor_source.empty()) {
+    Result<std::string> floor_text =
+        ReadFile(flags.perf_fleet_floor_source);
+    Result<perf::FleetFloor> floor =
+        floor_text.ok()
+            ? perf::ParseFleetFloor(*floor_text)
+            : Result<perf::FleetFloor>::Error(floor_text.error());
+    if (floor.ok()) {
+      int floored = perf::ApplyFleetFloor(raw_rank, c.matmul_tflops,
+                                          c.hbm_gbps, *floor);
+      if (floored != raw_rank) {
+        obs::Default()
+            .GetCounter("tfd_perf_fleet_floor_demotions_total",
+                        "Classifications demoted to degraded by the "
+                        "fleet-relative p10 floor "
+                        "(--perf-fleet-floor-source).")
+            ->Inc();
+        obs::DefaultJournal().Record(
+            "perf-fleet-floor", "perf",
+            "measured below the fleet p10 floor: class " +
+                std::string(perf::ClassName(raw_rank)) + " -> degraded",
+            {{"matmul_tflops", Fixed3(c.matmul_tflops)},
+             {"hbm_gbps", Fixed3(c.hbm_gbps)},
+             {"matmul_floor", Fixed3(floor->matmul_p10_tflops)},
+             {"hbm_floor", Fixed3(floor->hbm_p10_gbps)}});
+        raw_rank = floored;
+      }
+    } else {
+      TFD_LOG_WARNING << "perf-fleet-floor-source "
+                      << flags.perf_fleet_floor_source << " unusable ("
+                      << floor.error() << "); fleet floor disabled";
+    }
+  }
   // The health-ladder demotion debounce: one throttled measurement
   // never moves the published class; `unhealthy_after` consecutive
   // demotion verdicts do (and promotions need `recover_after`).
@@ -547,6 +586,18 @@ slice::MemberReport BuildLocalReport(const SnapshotStore& store,
         it != health.last_ok->labels.end() && it->second == "false";
   }
   report.healthy = device_fresh && !quarantined && !health_bad;
+  // Lifecycle fast path: a preemption notice / draining taint the
+  // lifecycle source has published rides into the report so the leader
+  // can degrade the slice BEFORE this host vanishes. Read from the
+  // store (already-debounced upstream), not re-probed here.
+  SourceView lifecycle = store.View("lifecycle");
+  if (lifecycle.registered && lifecycle.last_ok.has_value() &&
+      lifecycle.tier != Tier::kExpired) {
+    const lm::Labels& l = lifecycle.last_ok->labels;
+    report.preempting =
+        l.count(lm::kLifecyclePreemptImminent) > 0 ||
+        l.count(lm::kLifecycleDraining) > 0;
+  }
   if (flags.perf_characterize) {
     if (std::optional<perf::Characterization> c = perf::Default().Get()) {
       report.perf_class = perf::ClassName(c->class_rank);
@@ -838,6 +889,116 @@ std::vector<ProbeSpec> BuildProbeSpecs(
       spec.exclusive = false;  // plugins never get the device lock
       specs.push_back(std::move(spec));
     }
+  }
+
+  if (flags.lifecycle_watch && !flags.oneshot) {
+    // Preemption-aware lifecycle fast path (ROADMAP #3): the GCE
+    // preemption notice gives ~30s of warning — a 60s probe cadence
+    // would miss most of it, so this source ticks fast (10s or the
+    // sleep interval, whichever is shorter) and its labels are
+    // governor-exempt edge triggers: PRESENT only while the condition
+    // holds, absent on a normal node (steady-state label sets stay
+    // byte-identical with the feature on). The node-taint check rides
+    // the k8s client but only once per sleep interval — the fast
+    // cadence belongs to the link-local metadata endpoint, not the
+    // apiserver.
+    const int lifecycle_tick_s = std::min(10, sleep_s);
+    TierPolicy policy;
+    policy.fresh_for_s = 4 * sleep_s + 10;
+    policy.usable_for_s = flags.snapshot_usable_for_s > 0
+                              ? flags.snapshot_usable_for_s
+                              : policy.fresh_for_s + 6 * sleep_s;
+    store->Register("lifecycle", policy, /*device_source=*/false);
+
+    config::Flags flags_copy = flags;
+    // Taint-check cache: (last checked wall time, last verdict) shared
+    // across rounds so the apiserver sees one GET per sleep interval.
+    auto taint_state = std::make_shared<std::pair<double, bool>>(0.0, false);
+    // Preemption verdict memo: a failed metadata read keeps the
+    // PREVIOUS verdict (same contract as the taint check below) — a
+    // transient metadata blip after the notice landed must not clear
+    // preempt-imminent and un-degrade a dying slice mid warning
+    // window. Only an explicit FALSE (which a live endpoint always
+    // serves, preemptible or not) clears it.
+    auto preempt_state = std::make_shared<bool>(false);
+    auto taint_check_failing = std::make_shared<bool>(false);
+    auto last_state = std::make_shared<int>(-1);  // journal on transitions
+    ProbeSpec spec;
+    spec.name = "lifecycle";
+    spec.probe = [flags_copy, taint_state, preempt_state,
+                  taint_check_failing, last_state](Snapshot* out,
+                                                   bool* /*fatal*/) {
+      lm::Labels labels;
+      if (platform::MetadataPlausible(flags_copy.metadata_endpoint)) {
+        gce::MetadataClient client(flags_copy.metadata_endpoint);
+        if (Result<bool> preempted = client.Preempted(); preempted.ok()) {
+          *preempt_state = *preempted;
+        }
+      }
+      bool preempting = *preempt_state;
+      if (preempting) {
+        labels[lm::kLifecyclePreemptImminent] = "true";
+      }
+      double now = WallClockSeconds();
+      if (flags_copy.use_node_feature_api &&
+          now - taint_state->first >= flags_copy.sleep_interval_s) {
+        if (Result<k8s::ClusterConfig> cluster =
+                k8s::LoadInClusterConfig();
+            cluster.ok()) {
+          cluster->request_deadline_ms =
+              flags_copy.sink_request_deadline_s * 1000;
+          bool draining = false;
+          bool alive = false;
+          Status checked = k8s::GetNodeDraining(*cluster, &draining, &alive);
+          // Success or failure, the next check waits a sleep interval
+          // (the one-GET-per-interval apiserver cadence holds even
+          // under a persistent failure).
+          taint_state->first = now;
+          if (checked.ok()) {
+            taint_state->second = draining;
+            *taint_check_failing = false;
+          } else if (!*taint_check_failing) {
+            // A failed check keeps the PREVIOUS verdict: a transient
+            // apiserver blip must neither set nor clear the draining
+            // label. Logged once per failure streak — a standing RBAC
+            // gap (core `nodes get` is a separate grant from the
+            // nodefeatures rules) must not be invisible.
+            *taint_check_failing = true;
+            TFD_LOG_WARNING << "lifecycle taint check: "
+                            << checked.message()
+                            << " (keeping previous draining verdict)";
+          }
+        }
+      }
+      if (taint_state->second) {
+        labels[lm::kLifecycleDraining] = "true";
+      }
+      int state = preempting ? 2 : (taint_state->second ? 1 : 0);
+      obs::Default()
+          .GetGauge("tfd_lifecycle_state",
+                    "Node lifecycle: 0 normal, 1 draining (taint/"
+                    "unschedulable), 2 preemption notice received.")
+          ->Set(state);
+      if (state != *last_state) {
+        if (*last_state >= 0 || state > 0) {
+          obs::DefaultJournal().Record(
+              "lifecycle-change", "lifecycle",
+              state == 2   ? "preemption notice received"
+              : state == 1 ? "node draining"
+                           : "lifecycle normal",
+              {{"state", std::to_string(state)}});
+        }
+        *last_state = state;
+      }
+      out->labels = labels;
+      return Status::Ok();
+    };
+    spec.interval_s = lifecycle_tick_s;
+    spec.backoff_initial_s = lifecycle_tick_s;
+    spec.backoff_max_s = std::max(60, 4 * sleep_s);
+    spec.device_source = false;
+    spec.exclusive = false;  // metadata + apiserver HTTP only
+    specs.push_back(std::move(spec));
   }
 
   if (flags.slice_coordination && !flags.oneshot) {

@@ -195,6 +195,7 @@ std::string SerializeReport(const MemberReport& report) {
   return "{\"host\":" + jsonlite::Quote(report.host) +
          ",\"worker\":" + std::to_string(report.worker_id) +
          ",\"healthy\":" + (report.healthy ? "true" : "false") +
+         ",\"preempting\":" + (report.preempting ? "true" : "false") +
          ",\"shape\":" + jsonlite::Quote(report.shape) +
          ",\"class\":" + jsonlite::Quote(report.perf_class) +
          ",\"at\":" + Fixed3(report.reported_at) + "}";
@@ -216,6 +217,8 @@ Result<MemberReport> ParseReport(const std::string& json) {
   }
   report.worker_id = static_cast<int>(NumberOr(obj, "worker", -1));
   report.healthy = BoolOr(obj, "healthy", false);
+  // Absent on pre-ISSUE-13 reports: reads as not preempting.
+  report.preempting = BoolOr(obj, "preempting", false);
   report.shape = StringOr(obj, "shape");
   report.perf_class = StringOr(obj, "class");
   report.reported_at = NumberOr(obj, "at", 0);
@@ -334,6 +337,12 @@ SliceVerdict MergeVerdict(const SliceIdentity& identity,
     seen.push_back(report.host);
     verdict.members.push_back(report.host);
     bool healthy = report.healthy;
+    // Preemption fast path (ROADMAP #3): a member that has received
+    // the preemption notice (or is draining) is ALIVE but about to
+    // vanish — the leader proactively stops counting it healthy, so
+    // tpu.slice.degraded flips before the host actually dies and
+    // placement stops landing on a dying slice.
+    if (report.preempting) healthy = false;
     if (healthy && policy.rejoin_dwell_s > 0 && departed_at != nullptr) {
       // Rejoin hysteresis: a recently-departed member is present (it
       // appears in members, its report/class count) but not yet

@@ -117,6 +117,11 @@ struct MemberReport {
   std::string host;       // sched::NodeIdentity()
   int worker_id = -1;
   bool healthy = false;   // device snapshot fresh, no quarantine, exec ok
+  // The lifecycle fast path's verdict (preempt-imminent or draining):
+  // an alive-but-dying member. The leader folds it into the verdict as
+  // not-healthy, proactively degrading the slice before the host
+  // disappears.
+  bool preempting = false;
   std::string shape;      // "accel=...;chips=N;topo=..." ("" = no device facts)
   std::string perf_class; // debounced tpu.perf.class ("" = none)
   double reported_at = 0; // reporter's wall clock

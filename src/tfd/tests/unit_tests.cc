@@ -22,6 +22,7 @@
 #include <limits>
 #include <thread>
 
+#include "tfd/agg/agg.h"
 #include "tfd/config/config.h"
 #include "tfd/config/yamllite.h"
 #include "tfd/fault/fault.h"
@@ -5564,6 +5565,312 @@ void TestSnapshotMovementNotify() {
   CHECK_EQ(store.SecondsUntilTierChange(), -1.0);
 }
 
+// ---- cluster inventory aggregator (agg/, ISSUE 13) -----------------------
+
+void TestAggSketchParity() {
+  // The SAME grid is pinned in tests/test_agg.py against tpufd.agg —
+  // bucket boundaries come from repeated IEEE-double multiplication,
+  // so both languages must agree bit-for-bit.
+  struct { double value; int bucket; } grid[] = {
+      {0.0, 0},   {0.25, 0},  {0.5, 0},   {0.51, 1}, {1.0, 8},
+      {10.0, 32}, {100.0, 56}, {197.0, 63}, {459.0, 72}, {819.0, 78},
+      {1e6, 127},
+  };
+  for (const auto& row : grid) {
+    CHECK_EQ(agg::SketchBucketIndex(row.value), row.bucket);
+  }
+  CHECK_EQ(Fixed3(agg::SketchBucketValue(0)), "0.500");
+  CHECK_EQ(Fixed3(agg::SketchBucketValue(1)), "0.550");
+  CHECK_EQ(Fixed3(agg::SketchBucketValue(10)), "1.297");
+  CHECK_EQ(Fixed3(agg::SketchBucketValue(50)), "58.695");
+  CHECK_EQ(Fixed3(agg::SketchBucketValue(127)), "90331.874");
+
+  agg::QuantileSketch sketch;
+  CHECK_EQ(sketch.Quantile(0.5), -1.0);  // empty
+  for (int i = 1; i <= 100; i++) {
+    sketch.Add(static_cast<double>(i * 7 % 97 + 3));
+  }
+  CHECK_EQ(Fixed3(sketch.Quantile(0.10)), "11.613");
+  CHECK_EQ(Fixed3(sketch.Quantile(0.50)), "53.359");
+  CHECK_EQ(Fixed3(sketch.Quantile(0.90)), "94.530");
+
+  // Removable: retiring every value empties it; removing from an empty
+  // bucket is clamped, never negative.
+  agg::QuantileSketch small;
+  small.Add(10.0);
+  small.Add(20.0);
+  small.Remove(10.0);
+  small.Remove(10.0);  // already gone: clamped
+  CHECK_EQ(small.count(), 1);
+  CHECK_EQ(Fixed3(small.Quantile(0.5)), Fixed3(agg::SketchBucketValue(
+                                            agg::SketchBucketIndex(20.0))));
+  // Mergeable: merge == adding both streams.
+  agg::QuantileSketch a, b, both;
+  for (int i = 0; i < 50; i++) {
+    a.Add(i + 1.0);
+    both.Add(i + 1.0);
+  }
+  for (int i = 50; i < 100; i++) {
+    b.Add(i + 1.0);
+    both.Add(i + 1.0);
+  }
+  a.Merge(b);
+  CHECK_TRUE(a == both);
+}
+
+void TestAggIncrementalRollups() {
+  // The SAME 6-node fleet and golden label set are pinned in
+  // tests/test_agg.py.
+  std::map<std::string, lm::Labels> fleet = {
+      {"n0",
+       {{lm::kSliceId, "s-a"}, {lm::kSliceDegraded, "false"},
+        {lm::kPerfClass, "gold"}, {"google.com/tpu.count", "4"},
+        {lm::kPerfMatmulTflops, "180.5"}, {lm::kPerfHbmGbps, "700"}}},
+      {"n1",
+       {{lm::kSliceId, "s-a"}, {lm::kSliceDegraded, "false"},
+        {lm::kPerfClass, "silver"}, {"google.com/tpu.count", "4"},
+        {lm::kPerfMatmulTflops, "150.25"}, {lm::kPerfHbmGbps, "650"}}},
+      {"n2",
+       {{lm::kSliceId, "s-b"}, {lm::kSliceDegraded, "true"},
+        {lm::kPerfClass, "degraded"}, {"google.com/tpu.count", "8"},
+        {lm::kPerfMatmulTflops, "80"}, {lm::kPerfHbmGbps, "300"},
+        {lm::kMultisliceSliceId, "0"}}},
+      {"n3",
+       {{lm::kSliceId, "s-b"}, {lm::kSliceDegraded, "true"},
+        {"google.com/tpu.count", "8"}, {lm::kMultisliceSliceId, "1"}}},
+      {"n4",
+       {{lm::kLifecyclePreemptImminent, "true"},
+        {"google.com/tpu.count", "4"}, {lm::kPerfClass, "gold"},
+        {lm::kPerfMatmulTflops, "190"}, {lm::kPerfHbmGbps, "800"}}},
+      {"n5", {{"google.com/tpu.count", "junk"}, {lm::kPerfClass, "bronze"}}},
+  };
+  agg::InventoryStore store;
+  for (const auto& [node, labels] : fleet) {
+    CHECK_TRUE(store.Apply(node, labels));
+  }
+  lm::Labels golden = {
+      {"google.com/tpu.capacity.degraded", "8"},
+      {"google.com/tpu.capacity.gold", "8"},
+      {"google.com/tpu.capacity.silver", "4"},
+      {"google.com/tpu.capacity.total-chips", "28"},
+      {"google.com/tpu.capacity.unclassed", "8"},
+      {"google.com/tpu.fleet.nodes", "6"},
+      {"google.com/tpu.fleet.perf.hbm-p10", "326.342"},
+      {"google.com/tpu.fleet.perf.hbm-p50", "699.542"},
+      {"google.com/tpu.fleet.perf.matmul-p10", "85.936"},
+      {"google.com/tpu.fleet.perf.matmul-p50", "152.241"},
+      {"google.com/tpu.fleet.preempting", "1"},
+      {"google.com/tpu.multislice.groups", "2"},
+      {"google.com/tpu.slice-inventory.degraded-slices", "1"},
+      {"google.com/tpu.slice-inventory.healthy-slices", "1"},
+      {"google.com/tpu.slice-inventory.slices", "2"},
+  };
+  CHECK_TRUE(store.BuildOutputLabels() == golden);
+
+  // A delta that cannot move any rollup (probe-ms-style noise) returns
+  // false: nothing to publish.
+  lm::Labels noisy = fleet["n0"];
+  noisy["google.com/tpu.health.probe-ms"] = "17";
+  CHECK_TRUE(!store.Apply("n0", noisy));
+  CHECK_TRUE(store.BuildOutputLabels() == golden);
+
+  // A real delta retires the OLD contribution and applies the new one:
+  // n4's preemption notice clears, gold capacity stays, preempting
+  // drops to 0 and the fleet gains a healthy unsliced node.
+  lm::Labels healed = fleet["n4"];
+  healed.erase(lm::kLifecyclePreemptImminent);
+  CHECK_TRUE(store.Apply("n4", healed));
+  lm::Labels after = store.BuildOutputLabels();
+  CHECK_EQ(after["google.com/tpu.fleet.preempting"], "0");
+  CHECK_EQ(after["google.com/tpu.capacity.gold"], "8");
+
+  // Remove retires everything; a second remove of the same node is a
+  // no-op.
+  CHECK_TRUE(store.Remove("n2"));
+  CHECK_TRUE(!store.Remove("n2"));
+  after = store.BuildOutputLabels();
+  CHECK_EQ(after["google.com/tpu.fleet.nodes"], "5");
+  CHECK_EQ(after["google.com/tpu.capacity.degraded"], "0");
+  CHECK_EQ(after["google.com/tpu.multislice.groups"], "1");
+  // s-b still has n3 (degraded vote): still one degraded slice.
+  CHECK_EQ(after["google.com/tpu.slice-inventory.degraded-slices"], "1");
+
+  // The incremental state must equal a from-scratch rebuild — and the
+  // steady path above never took one.
+  CHECK_EQ(store.full_recomputes(), 0u);
+  lm::Labels incremental = store.BuildOutputLabels();
+  store.RecomputeAll();
+  CHECK_TRUE(store.BuildOutputLabels() == incremental);
+  CHECK_EQ(store.full_recomputes(), 1u);
+}
+
+void TestAggFlushController() {
+  agg::FlushController flush(2.0);
+  CHECK_TRUE(!flush.dirty());
+  CHECK_TRUE(!flush.ShouldFlush(100.0));
+  flush.NoteDirty(100.0);
+  CHECK_TRUE(flush.dirty());
+  CHECK_EQ(flush.DueAt(), 102.0);
+  // Later events inside the window do NOT extend it — bounded
+  // staleness, not a quiet-period timer (a steady drizzle cannot
+  // starve the publish).
+  flush.NoteDirty(101.9);
+  CHECK_EQ(flush.DueAt(), 102.0);
+  CHECK_TRUE(!flush.ShouldFlush(101.99));
+  CHECK_TRUE(flush.ShouldFlush(102.0));
+  flush.NoteFlushed();
+  CHECK_TRUE(!flush.dirty());
+  flush.NoteDirty(110.0);
+  CHECK_EQ(flush.DueAt(), 112.0);
+}
+
+void TestPerfFleetFloor() {
+  // Parse grid — pinned in tests/test_agg.py against
+  // tpufd.perfmodel.parse_fleet_floor.
+  Result<perf::FleetFloor> both = perf::ParseFleetFloor(
+      "{\"matmul_p10_tflops\":150.5,\"hbm_p10_gbps\":600}");
+  CHECK_TRUE(both.ok());
+  CHECK_EQ(Fixed3(both->matmul_p10_tflops), "150.500");
+  CHECK_EQ(Fixed3(both->hbm_p10_gbps), "600.000");
+  Result<perf::FleetFloor> one =
+      perf::ParseFleetFloor("{\"matmul_p10_tflops\":100}");
+  CHECK_TRUE(one.ok());
+  CHECK_EQ(one->hbm_p10_gbps, -1.0);
+  CHECK_TRUE(one->valid());
+  Result<perf::FleetFloor> none = perf::ParseFleetFloor("{}");
+  CHECK_TRUE(none.ok());
+  CHECK_TRUE(!none->valid());
+  CHECK_TRUE(!perf::ParseFleetFloor("garbage").ok());
+  CHECK_TRUE(!perf::ParseFleetFloor("[1]").ok());
+
+  // Apply semantics: below either floor -> degraded, even from gold;
+  // unmeasured (-1) values and unset (-1) floors never trigger.
+  perf::FleetFloor floor;
+  floor.matmul_p10_tflops = 150;
+  floor.hbm_p10_gbps = 600;
+  CHECK_EQ(perf::ApplyFleetFloor(perf::kRankGold, 180, 700, floor),
+           perf::kRankGold);
+  CHECK_EQ(perf::ApplyFleetFloor(perf::kRankGold, 140, 700, floor),
+           perf::kRankDegraded);  // gray degradation: gold by rated spec
+  CHECK_EQ(perf::ApplyFleetFloor(perf::kRankSilver, 180, 550, floor),
+           perf::kRankDegraded);
+  CHECK_EQ(perf::ApplyFleetFloor(perf::kRankGold, -1, -1, floor),
+           perf::kRankGold);  // unmeasured never triggers
+  perf::FleetFloor unset;
+  CHECK_EQ(perf::ApplyFleetFloor(perf::kRankSilver, 1, 1, unset),
+           perf::kRankSilver);
+}
+
+void TestSlicePreemptingMember() {
+  // The report round-trips the lifecycle verdict (absent on old
+  // reports reads as false)...
+  slice::MemberReport report;
+  report.host = "host-2";
+  report.worker_id = 2;
+  report.healthy = true;
+  report.preempting = true;
+  report.reported_at = 500;
+  Result<slice::MemberReport> parsed =
+      slice::ParseReport(slice::SerializeReport(report));
+  CHECK_TRUE(parsed.ok());
+  CHECK_TRUE(parsed->preempting);
+  Result<slice::MemberReport> legacy = slice::ParseReport(
+      "{\"host\":\"h\",\"healthy\":true,\"at\":500}");
+  CHECK_TRUE(legacy.ok());
+  CHECK_TRUE(!legacy->preempting);
+
+  // ...and the leader folds it into a PROACTIVE degraded verdict: the
+  // preempting member is present (a member, its class counts) but not
+  // healthy — placement stops landing on a dying slice before the
+  // host actually vanishes. Twin-pinned in test_slice.py.
+  slice::SliceIdentity identity;
+  identity.valid = true;
+  identity.slice_id = "s";
+  identity.num_hosts = 2;
+  slice::CoordPolicy policy;
+  policy.agreement_timeout_s = 60;
+  slice::MemberReport peer;
+  peer.host = "host-1";
+  peer.healthy = true;
+  peer.reported_at = 995;
+  peer.perf_class = "gold";
+  report.perf_class = "silver";
+  report.reported_at = 995;
+  slice::SliceVerdict verdict = slice::MergeVerdict(
+      identity, "host-1", {peer, report}, policy, 1000.0);
+  CHECK_EQ(verdict.healthy_hosts, 1);
+  CHECK_TRUE(verdict.degraded);
+  CHECK_EQ(verdict.members.size(), 2u);
+  CHECK_EQ(verdict.perf_class, std::string("silver"));  // still counted
+}
+
+void TestGetNodeDraining() {
+  // Unschedulable spec.
+  {
+    ScriptedApiServer server({{200,
+                               "{\"spec\":{\"unschedulable\":true}}"}});
+    k8s::ClusterConfig config;
+    config.apiserver_url = server.url();
+    config.node_name = "node-1";
+    bool draining = false;
+    bool alive = false;
+    Status s = k8s::GetNodeDraining(config, &draining, &alive);
+    CHECK_TRUE(s.ok());
+    CHECK_TRUE(alive);
+    CHECK_TRUE(draining);
+  }
+  // Autoscaler taint.
+  {
+    ScriptedApiServer server(
+        {{200,
+          "{\"spec\":{\"taints\":[{\"key\":"
+          "\"ToBeDeletedByClusterAutoscaler\",\"effect\":"
+          "\"NoSchedule\"}]}}"}});
+    k8s::ClusterConfig config;
+    config.apiserver_url = server.url();
+    config.node_name = "node-1";
+    bool draining = false;
+    bool alive = false;
+    CHECK_TRUE(k8s::GetNodeDraining(config, &draining, &alive).ok());
+    CHECK_TRUE(draining);
+  }
+  // Healthy node: unrelated taints do not read as draining; a missing
+  // Node object (404) is "not draining", not an error.
+  {
+    ScriptedApiServer server(
+        {{200,
+          "{\"spec\":{\"taints\":[{\"key\":\"google.com/tpu\","
+          "\"effect\":\"NoSchedule\"}]}}"},
+         {404, "{}"}});
+    k8s::ClusterConfig config;
+    config.apiserver_url = server.url();
+    config.node_name = "node-1";
+    bool draining = true;
+    bool alive = false;
+    CHECK_TRUE(k8s::GetNodeDraining(config, &draining, &alive).ok());
+    CHECK_TRUE(!draining);
+    draining = true;
+    CHECK_TRUE(k8s::GetNodeDraining(config, &draining, &alive).ok());
+    CHECK_TRUE(!draining);
+  }
+}
+
+void TestAggWatchEventName() {
+  // metadata.name now rides every parsed watch event — load-bearing at
+  // collection scope, where one stream carries every object. Pinned in
+  // tests/test_agg.py against tpufd.sink.parse_watch_event.
+  k8s::WatchEvent event = k8s::ParseWatchEventLine(
+      "{\"type\":\"MODIFIED\",\"object\":{\"metadata\":{\"name\":"
+      "\"tfd-features-for-node-7\",\"resourceVersion\":\"12\"},"
+      "\"spec\":{\"labels\":{\"a\":\"1\"}}}}");
+  CHECK_EQ(event.name, "tfd-features-for-node-7");
+  CHECK_EQ(event.resource_version, "12");
+  k8s::WatchEvent nameless = k8s::ParseWatchEventLine(
+      "{\"type\":\"BOOKMARK\",\"object\":{\"metadata\":"
+      "{\"resourceVersion\":\"40\"}}}");
+  CHECK_EQ(nameless.name, "");
+}
+
 }  // namespace
 }  // namespace tfd
 
@@ -5702,6 +6009,13 @@ int main(int argc, char** argv) {
   tfd::TestWatcherResyncAndDrift();
   tfd::TestWakeupMux();
   tfd::TestSnapshotMovementNotify();
+  tfd::TestAggSketchParity();
+  tfd::TestAggIncrementalRollups();
+  tfd::TestAggFlushController();
+  tfd::TestAggWatchEventName();
+  tfd::TestPerfFleetFloor();
+  tfd::TestSlicePreemptingMember();
+  tfd::TestGetNodeDraining();
 
   std::cerr << tfd::g_checks << " checks, " << tfd::g_failures << " failures"
             << std::endl;

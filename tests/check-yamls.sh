@@ -18,6 +18,7 @@ YAML_FILES="
 $DIR/deployments/static/tpu-feature-discovery-daemonset.yaml
 $DIR/deployments/static/tpu-feature-discovery-daemonset-with-slice-single.yaml
 $DIR/deployments/static/tpu-feature-discovery-daemonset-with-slice-mixed.yaml
+$DIR/deployments/static/tpu-feature-aggregator-deployment.yaml
 $DIR/deployments/static/tpu-feature-discovery-job.yaml.template
 $DIR/deployments/static/tpu-slice-burnin-job.yaml.template
 "

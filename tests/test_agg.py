@@ -1,0 +1,643 @@
+"""Cluster inventory aggregator (ISSUE 13): twin parity + real-process
+drills.
+
+The 10k-scale emergent behavior (publish p99, steady QPS, burst
+coalescing) lives in scripts/fleet_soak.py --aggregate (virtual-clock
+twin simulation); THESE tests pin:
+
+  - the C++ <-> tpufd.agg parity grids (sketch buckets/quantiles, the
+    whole rollup label set for a fixed fleet, the flush controller) —
+    the same literals appear in unit_tests.cc TestAggSketchParity /
+    TestAggIncrementalRollups;
+  - the fleet-relative perf floor twins (perfmodel.parse_fleet_floor /
+    apply_fleet_floor vs perf::ParseFleetFloor/ApplyFleetFloor);
+  - the preempting-member verdict fold (slicecoord.merge_verdict vs
+    slice::MergeVerdict);
+  - the fake apiserver's COLLECTION scope: labelSelector-filtered LIST,
+    one merged watch stream ordered by the global resourceVersion,
+    BOOKMARKs carrying it, and ERROR 410 below the collection
+    compaction floor;
+  - the real binary in --mode=aggregator: initial sync, incremental
+    churn, delete retirement, burst coalescing (resourceVersion delta),
+    lease failover between two replicas, and
+    tfd_agg_full_recomputes_total == 0 throughout;
+  - the on-node lifecycle fast path: the GCE preemption notice and a
+    draining taint surface as tpu.lifecycle.* labels within seconds.
+"""
+
+import http.client
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+from conftest import FIXTURES, http_get, wait_for
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from tpufd import agg  # noqa: E402
+from tpufd import metrics  # noqa: E402
+from tpufd import perfmodel  # noqa: E402
+from tpufd import slicecoord  # noqa: E402
+from tpufd import sink  # noqa: E402
+from tpufd.fakes.apiserver import FakeApiServer  # noqa: E402
+from tpufd.fakes.metadata_server import (  # noqa: E402
+    FakeMetadataServer, tpu_vm)
+
+NS = "aggns"
+NODE_NAME_LABEL = "nfd.node.kubernetes.io/node-name"
+OUTPUT = "tfd-cluster-inventory"
+
+
+def free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def stop(proc):
+    proc.terminate()
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait(timeout=5)
+
+
+def metric(port, name, labels=None):
+    status, body = http_get(port, "/metrics")
+    if status != 200:
+        return None
+    try:
+        return metrics.sample_value(body, name, labels)
+    except ValueError:
+        return None
+
+
+# ---- parity grids (identical literals in unit_tests.cc) -------------------
+
+
+class TestSketchParity:
+    def test_bucket_grid_matches_cpp(self):
+        grid = [(0.0, 0), (0.25, 0), (0.5, 0), (0.51, 1), (1.0, 8),
+                (10.0, 32), (100.0, 56), (197.0, 63), (459.0, 72),
+                (819.0, 78), (1e6, 127)]
+        for value, bucket in grid:
+            assert agg.sketch_bucket_index(value) == bucket, value
+        assert agg.fixed3(agg.sketch_bucket_value(0)) == "0.500"
+        assert agg.fixed3(agg.sketch_bucket_value(1)) == "0.550"
+        assert agg.fixed3(agg.sketch_bucket_value(10)) == "1.297"
+        assert agg.fixed3(agg.sketch_bucket_value(50)) == "58.695"
+        assert agg.fixed3(agg.sketch_bucket_value(127)) == "90331.874"
+
+    def test_quantiles_match_cpp(self):
+        s = agg.Sketch()
+        assert s.quantile(0.5) == -1.0
+        for i in range(1, 101):
+            s.add(float(i * 7 % 97 + 3))
+        assert agg.fixed3(s.quantile(0.10)) == "11.613"
+        assert agg.fixed3(s.quantile(0.50)) == "53.359"
+        assert agg.fixed3(s.quantile(0.90)) == "94.530"
+
+    def test_removable_and_mergeable(self):
+        s = agg.Sketch()
+        s.add(10.0)
+        s.add(20.0)
+        s.remove(10.0)
+        s.remove(10.0)  # clamped, never negative
+        assert s.total == 1
+        a, b, both = agg.Sketch(), agg.Sketch(), agg.Sketch()
+        for i in range(50):
+            a.add(i + 1.0)
+            both.add(i + 1.0)
+        for i in range(50, 100):
+            b.add(i + 1.0)
+            both.add(i + 1.0)
+        a.merge(b)
+        assert a.counts == both.counts and a.total == both.total
+
+
+GOLDEN_FLEET = {
+    "n0": {agg.SLICE_ID: "s-a", agg.SLICE_DEGRADED: "false",
+           agg.PERF_CLASS: "gold", agg.TPU_COUNT: "4",
+           agg.PERF_MATMUL: "180.5", agg.PERF_HBM: "700"},
+    "n1": {agg.SLICE_ID: "s-a", agg.SLICE_DEGRADED: "false",
+           agg.PERF_CLASS: "silver", agg.TPU_COUNT: "4",
+           agg.PERF_MATMUL: "150.25", agg.PERF_HBM: "650"},
+    "n2": {agg.SLICE_ID: "s-b", agg.SLICE_DEGRADED: "true",
+           agg.PERF_CLASS: "degraded", agg.TPU_COUNT: "8",
+           agg.PERF_MATMUL: "80", agg.PERF_HBM: "300",
+           agg.MULTISLICE_SLICE_ID: "0"},
+    "n3": {agg.SLICE_ID: "s-b", agg.SLICE_DEGRADED: "true",
+           agg.TPU_COUNT: "8", agg.MULTISLICE_SLICE_ID: "1"},
+    "n4": {agg.LIFECYCLE_PREEMPT: "true", agg.TPU_COUNT: "4",
+           agg.PERF_CLASS: "gold", agg.PERF_MATMUL: "190",
+           agg.PERF_HBM: "800"},
+    "n5": {agg.TPU_COUNT: "junk", agg.PERF_CLASS: "bronze"},
+}
+
+GOLDEN_ROLLUPS = {
+    "google.com/tpu.capacity.degraded": "8",
+    "google.com/tpu.capacity.gold": "8",
+    "google.com/tpu.capacity.silver": "4",
+    "google.com/tpu.capacity.total-chips": "28",
+    "google.com/tpu.capacity.unclassed": "8",
+    "google.com/tpu.fleet.nodes": "6",
+    "google.com/tpu.fleet.perf.hbm-p10": "326.342",
+    "google.com/tpu.fleet.perf.hbm-p50": "699.542",
+    "google.com/tpu.fleet.perf.matmul-p10": "85.936",
+    "google.com/tpu.fleet.perf.matmul-p50": "152.241",
+    "google.com/tpu.fleet.preempting": "1",
+    "google.com/tpu.multislice.groups": "2",
+    "google.com/tpu.slice-inventory.degraded-slices": "1",
+    "google.com/tpu.slice-inventory.healthy-slices": "1",
+    "google.com/tpu.slice-inventory.slices": "2",
+}
+
+
+class TestRollupTwin:
+    def test_golden_fleet_matches_cpp(self):
+        store = agg.InventoryStore()
+        for node, labels in GOLDEN_FLEET.items():
+            assert store.apply(node, labels)
+        assert store.build_output_labels() == GOLDEN_ROLLUPS
+
+    def test_noise_delta_moves_nothing(self):
+        store = agg.InventoryStore()
+        for node, labels in GOLDEN_FLEET.items():
+            store.apply(node, labels)
+        noisy = dict(GOLDEN_FLEET["n0"])
+        noisy["google.com/tpu.health.probe-ms"] = "17"
+        assert not store.apply("n0", noisy)
+        assert store.build_output_labels() == GOLDEN_ROLLUPS
+
+    def test_incremental_equals_recompute_through_churn(self):
+        import random
+
+        rng = random.Random(13)
+        store = agg.InventoryStore()
+        nodes = {}
+        for step in range(300):
+            node = f"n{rng.randrange(40)}"
+            action = rng.random()
+            if action < 0.15 and node in nodes:
+                del nodes[node]
+                store.remove(node)
+            else:
+                labels = {
+                    agg.SLICE_ID: f"s-{rng.randrange(8)}",
+                    agg.SLICE_DEGRADED:
+                        "true" if rng.random() < 0.3 else "false",
+                    agg.PERF_CLASS: rng.choice(
+                        ["gold", "silver", "degraded", ""]),
+                    agg.TPU_COUNT: str(rng.choice([4, 8])),
+                    agg.PERF_MATMUL: agg.fixed3(rng.uniform(50, 200)),
+                    agg.PERF_HBM: agg.fixed3(rng.uniform(200, 900)),
+                }
+                nodes[node] = labels
+                store.apply(node, labels)
+        incremental = store.build_output_labels()
+        fresh = agg.InventoryStore()
+        for node, labels in nodes.items():
+            fresh.apply(node, labels)
+        assert incremental == fresh.build_output_labels()
+        # The churned store never recomputed on its own.
+        assert store.full_recomputes == 0
+        store.recompute_all()
+        assert store.build_output_labels() == incremental
+
+    def test_flush_controller(self):
+        flush = agg.FlushController(2.0)
+        assert not flush.dirty
+        flush.note_dirty(100.0)
+        assert flush.due_at() == 102.0
+        flush.note_dirty(101.9)  # bounded staleness: window not extended
+        assert flush.due_at() == 102.0
+        assert not flush.should_flush(101.99)
+        assert flush.should_flush(102.0)
+        flush.note_flushed()
+        assert not flush.dirty
+
+
+class TestWatchEventNameParity:
+    def test_name_field_matches_cpp(self):
+        event = sink.parse_watch_event(
+            '{"type":"MODIFIED","object":{"metadata":{"name":'
+            '"tfd-features-for-node-7","resourceVersion":"12"},'
+            '"spec":{"labels":{"a":"1"}}}}')
+        assert event["name"] == "tfd-features-for-node-7"
+        assert event["resource_version"] == "12"
+        nameless = sink.parse_watch_event(
+            '{"type":"BOOKMARK","object":{"metadata":'
+            '{"resourceVersion":"40"}}}')
+        assert nameless["name"] == ""
+
+
+class TestFleetFloorTwin:
+    def test_parse_grid_matches_cpp(self):
+        both = perfmodel.parse_fleet_floor(
+            '{"matmul_p10_tflops":150.5,"hbm_p10_gbps":600}')
+        assert both == {"matmul_p10_tflops": 150.5, "hbm_p10_gbps": 600.0}
+        one = perfmodel.parse_fleet_floor('{"matmul_p10_tflops":100}')
+        assert one["hbm_p10_gbps"] is None
+        assert perfmodel.parse_fleet_floor("{}") == {
+            "matmul_p10_tflops": None, "hbm_p10_gbps": None}
+        for garbage in ("garbage", "[1]"):
+            try:
+                perfmodel.parse_fleet_floor(garbage)
+                raise AssertionError("should have raised")
+            except ValueError:
+                pass
+
+    def test_apply_matches_cpp(self):
+        floor = {"matmul_p10_tflops": 150.0, "hbm_p10_gbps": 600.0}
+        apply = perfmodel.apply_fleet_floor
+        assert apply("gold", 180, 700, floor) == "gold"
+        # Gray degradation: gold by rated spec, below the fleet p10.
+        assert apply("gold", 140, 700, floor) == "degraded"
+        assert apply("silver", 180, 550, floor) == "degraded"
+        assert apply("gold", None, None, floor) == "gold"
+        assert apply("silver", 1, 1,
+                     {"matmul_p10_tflops": None,
+                      "hbm_p10_gbps": None}) == "silver"
+
+
+class TestPreemptingVerdictTwin:
+    def test_preempting_member_degrades_slice(self):
+        # Mirrors unit_tests.cc TestSlicePreemptingMember: present,
+        # class counted, never healthy.
+        verdict = slicecoord.merge_verdict(
+            2,
+            [{"host": "host-1", "healthy": True, "at": 995,
+              "class": "gold"},
+             {"host": "host-2", "healthy": True, "at": 995,
+              "class": "silver", "preempting": True}],
+            60, 1000.0)
+        assert verdict["healthy_hosts"] == 1
+        assert verdict["degraded"]
+        assert verdict["members"] == ["host-1", "host-2"]
+        assert verdict["class"] == "silver"
+
+
+# ---- collection scope on the fake apiserver -------------------------------
+
+
+BASE = f"/apis/nfd.k8s-sigs.io/v1alpha1/namespaces/{NS}/nodefeatures"
+
+
+def open_stream(server, path, timeout_s=5.0):
+    conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                      timeout=timeout_s)
+    conn.request("GET", path)
+    return conn, conn.getresponse()
+
+
+def read_event(resp):
+    line = resp.readline()
+    return json.loads(line) if line else None
+
+
+class TestCollectionScope:
+    def test_list_filters_by_selector(self):
+        with FakeApiServer() as server:
+            server.seed(NS, "tfd-features-for-a", {"x": "1"},
+                        {NODE_NAME_LABEL: "a"})
+            server.seed(NS, "tfd-features-for-b", {"x": "2"},
+                        {NODE_NAME_LABEL: "b"})
+            server.seed(NS, OUTPUT, {"rollup": "1"})  # no node-name label
+            conn = http.client.HTTPConnection("127.0.0.1", server.port)
+            conn.request(
+                "GET",
+                BASE + "?labelSelector=nfd.node.kubernetes.io%2Fnode-name")
+            resp = conn.getresponse()
+            doc = json.loads(resp.read())
+            assert resp.status == 200
+            names = {i["metadata"]["name"] for i in doc["items"]}
+            assert names == {"tfd-features-for-a", "tfd-features-for-b"}
+            assert doc["kind"] == "NodeFeatureList"
+            assert int(doc["metadata"]["resourceVersion"]) >= 3
+            conn.close()
+
+    def test_collection_watch_bookmark_and_410(self):
+        with FakeApiServer() as server:
+            server.set_bookmark_interval(0.2)
+            server.seed(NS, "tfd-features-for-a", {"x": "1"},
+                        {NODE_NAME_LABEL: "a"})
+            # LIST first (the aggregator's bootstrap), then watch from
+            # the list's global rv.
+            conn = http.client.HTTPConnection("127.0.0.1", server.port)
+            conn.request("GET", BASE)
+            listed = json.loads(conn.getresponse().read())
+            conn.close()
+            rv = listed["metadata"]["resourceVersion"]
+
+            wconn, resp = open_stream(
+                server,
+                BASE + f"?watch=true&resourceVersion={rv}"
+                       "&allowWatchBookmarks=true&timeoutSeconds=5")
+            assert resp.status == 200
+            server.seed(NS, "tfd-features-for-b", {"x": "2"},
+                        {NODE_NAME_LABEL: "b"})
+            event = read_event(resp)
+            assert event["type"] == "ADDED"
+            assert event["object"]["metadata"]["name"] == \
+                "tfd-features-for-b"
+            server.seed(NS, "tfd-features-for-a", {"x": "9"},
+                        {NODE_NAME_LABEL: "a"})
+            event = read_event(resp)
+            assert event["type"] == "MODIFIED"
+            # A quiet stretch delivers a BOOKMARK carrying the global
+            # rv the client may resume from.
+            deadline = time.monotonic() + 3
+            bookmark = None
+            while time.monotonic() < deadline:
+                event = read_event(resp)
+                if event and event["type"] == "BOOKMARK":
+                    bookmark = event
+                    break
+            assert bookmark is not None
+            assert int(
+                bookmark["object"]["metadata"]["resourceVersion"]) >= 3
+            wconn.close()
+
+            # Compaction: resuming below the collection floor answers
+            # ERROR 410 — the aggregator's exactly-one-re-list drill.
+            server.compact_collection(NS)
+            wconn, resp = open_stream(
+                server, BASE + f"?watch=true&resourceVersion={rv}")
+            event = read_event(resp)
+            assert event["type"] == "ERROR"
+            assert event["object"]["code"] == 410
+            wconn.close()
+
+    def test_selector_filters_watch_events(self):
+        with FakeApiServer() as server:
+            wconn, resp = open_stream(
+                server,
+                BASE + "?watch=true&labelSelector="
+                       "nfd.node.kubernetes.io%2Fnode-name"
+                       "&timeoutSeconds=3")
+            assert resp.status == 200
+            server.seed(NS, OUTPUT, {"rollup": "1"})  # filtered out
+            server.seed(NS, "tfd-features-for-c", {"x": "3"},
+                        {NODE_NAME_LABEL: "c"})
+            event = read_event(resp)
+            assert event["type"] == "ADDED"
+            assert event["object"]["metadata"]["name"] == \
+                "tfd-features-for-c"
+            wconn.close()
+
+
+# ---- real-process aggregator drills ---------------------------------------
+
+
+def agg_argv(binary, port, extra=()):
+    return [str(binary), "--mode=aggregator", "--agg-debounce=1s",
+            "--agg-lease-duration=4s",
+            f"--introspection-addr=127.0.0.1:{port}", *extra]
+
+
+def agg_env(server, who="agg-0"):
+    return {**os.environ, "TFD_APISERVER_URL": server.url,
+            "KUBERNETES_NAMESPACE": NS, "POD_NAME": who,
+            "GCE_METADATA_HOST": "127.0.0.1:1"}
+
+
+def node_labels(i, perf_class="gold", degraded="false", preempting=False):
+    labels = {
+        "google.com/tpu.count": "4",
+        "google.com/tpu.slice.id": f"slice-{i // 4}",
+        "google.com/tpu.slice.degraded": degraded,
+        "google.com/tpu.perf.class": perf_class,
+        "google.com/tpu.perf.matmul-tflops": agg.fixed3(100.0 + i),
+        "google.com/tpu.perf.hbm-gbps": agg.fixed3(500.0 + i),
+    }
+    if preempting:
+        labels["google.com/tpu.lifecycle.preempt-imminent"] = "true"
+    return labels
+
+
+def seed_fleet(server, n):
+    expected = agg.InventoryStore()
+    for i in range(n):
+        labels = node_labels(i, perf_class=["gold", "silver",
+                                            "degraded"][i % 3])
+        server.seed(NS, f"tfd-features-for-node-{i}", labels,
+                    {NODE_NAME_LABEL: f"node-{i}"})
+        expected.apply(f"node-{i}", labels)
+    return expected
+
+
+def output_labels(server):
+    obj = server.store.get((NS, OUTPUT))
+    return (obj or {}).get("spec", {}).get("labels")
+
+
+class TestAggregatorProcess:
+    def test_sync_churn_delete_and_zero_recomputes(self, tfd_binary):
+        with FakeApiServer() as server:
+            expected = seed_fleet(server, 30)
+            port = free_port()
+            proc = subprocess.Popen(
+                agg_argv(tfd_binary, port), env=agg_env(server),
+                stderr=subprocess.DEVNULL)
+            try:
+                # Initial sync: the output object carries EXACTLY what
+                # the Python twin computes from the same label sets.
+                assert wait_for(
+                    lambda: output_labels(server) ==
+                    expected.build_output_labels(), timeout=20)
+
+                # Incremental churn: one node demotes; the rollup
+                # follows within the debounce + slack.
+                churned = node_labels(1, perf_class="degraded",
+                                      degraded="true")
+                server.seed(NS, "tfd-features-for-node-1", churned,
+                            {NODE_NAME_LABEL: "node-1"})
+                expected.apply("node-1", churned)
+                assert wait_for(
+                    lambda: output_labels(server) ==
+                    expected.build_output_labels(), timeout=10)
+
+                # Delete retirement (watch DELETED).
+                server.delete(NS, "tfd-features-for-node-2")
+                expected.remove("node-2")
+                assert wait_for(
+                    lambda: output_labels(server) ==
+                    expected.build_output_labels(), timeout=10)
+
+                # The steady path never recomputed.
+                assert metric(port, "tfd_agg_full_recomputes_total") in \
+                    (None, 0.0)
+                assert metric(port, "tfd_agg_nodes") == 29.0
+                assert metric(port, "tfd_agg_state") == 1.0
+            finally:
+                stop(proc)
+
+    def test_burst_coalesces_to_few_writes(self, tfd_binary):
+        with FakeApiServer() as server:
+            expected = seed_fleet(server, 24)
+            port = free_port()
+            proc = subprocess.Popen(
+                agg_argv(tfd_binary, port,
+                         extra=("--agg-debounce=2s",)),
+                env=agg_env(server), stderr=subprocess.DEVNULL)
+            try:
+                assert wait_for(
+                    lambda: output_labels(server) ==
+                    expected.build_output_labels(), timeout=20)
+                rv_before = int(server.store[
+                    (NS, OUTPUT)]["metadata"]["resourceVersion"])
+                # A whole-fleet churn burst inside one debounce window.
+                for i in range(24):
+                    labels = node_labels(i, perf_class="silver")
+                    server.seed(NS, f"tfd-features-for-node-{i}", labels,
+                                {NODE_NAME_LABEL: f"node-{i}"})
+                    expected.apply(f"node-{i}", labels)
+                assert wait_for(
+                    lambda: output_labels(server) ==
+                    expected.build_output_labels(), timeout=10)
+                time.sleep(2.5)  # a trailing window must stay quiet
+                rv_after = int(server.store[
+                    (NS, OUTPUT)]["metadata"]["resourceVersion"])
+                # 24 node flips -> at most 3 output writes (one per
+                # debounce window the burst straddles, plus slack).
+                assert rv_after - rv_before <= 3, (rv_before, rv_after)
+                assert metric(port, "tfd_agg_full_recomputes_total") in \
+                    (None, 0.0)
+            finally:
+                stop(proc)
+
+    def test_lease_failover_between_replicas(self, tfd_binary):
+        with FakeApiServer() as server:
+            expected = seed_fleet(server, 8)
+            port_a, port_b = free_port(), free_port()
+            a = subprocess.Popen(
+                agg_argv(tfd_binary, port_a), env=agg_env(server, "agg-a"),
+                stderr=subprocess.DEVNULL)
+            proc_b = None
+            try:
+                assert wait_for(
+                    lambda: output_labels(server) ==
+                    expected.build_output_labels(), timeout=20)
+                proc_b = subprocess.Popen(
+                    agg_argv(tfd_binary, port_b),
+                    env=agg_env(server, "agg-b"),
+                    stderr=subprocess.DEVNULL)
+                # The standby follows (never publishes) while the
+                # leader holds the lease.
+                assert wait_for(
+                    lambda: metric(port_b, "tfd_agg_state") == 0.0,
+                    timeout=10)
+                # Kill the leader; the standby must take over within a
+                # few lease durations and keep publishing.
+                a.kill()
+                a.wait(timeout=5)
+                assert wait_for(
+                    lambda: metric(port_b, "tfd_agg_state") == 1.0,
+                    timeout=20)
+                churned = node_labels(3, perf_class="degraded",
+                                      degraded="true")
+                server.seed(NS, "tfd-features-for-node-3", churned,
+                            {NODE_NAME_LABEL: "node-3"})
+                expected.apply("node-3", churned)
+                assert wait_for(
+                    lambda: output_labels(server) ==
+                    expected.build_output_labels(), timeout=15)
+            finally:
+                stop(a)
+                if proc_b is not None:
+                    stop(proc_b)
+
+
+# ---- on-node lifecycle fast path ------------------------------------------
+
+
+class TestLifecycleFastPath:
+    def test_preemption_notice_labels_within_seconds(self, tfd_binary,
+                                                     tmp_path):
+        data = tpu_vm(accelerator_type="v5litepod-4")
+        with FakeMetadataServer(data) as meta:
+            out = tmp_path / "labels"
+            port = free_port()
+            proc = subprocess.Popen(
+                [str(tfd_binary), "--sleep-interval=1s", "--backend=mock",
+                 f"--mock-topology-file={FIXTURES / 'v2-8.yaml'}",
+                 "--machine-type-file=/dev/null", "--lifecycle-watch",
+                 f"--metadata-endpoint=127.0.0.1:{meta.port}",
+                 f"--output-file={out}",
+                 f"--introspection-addr=127.0.0.1:{port}"],
+                env={**os.environ, "TFD_EVENT_DRIVEN": "true"},
+                stderr=subprocess.DEVNULL)
+            try:
+                assert wait_for(out.exists, timeout=20)
+                # Normal node: NO lifecycle labels (edge-triggered,
+                # absence = normal — steady label sets unchanged).
+                assert "tpu.lifecycle." not in out.read_text()
+
+                # The preemption notice lands; the label must follow
+                # fast (lifecycle tick 1s + pass + write + slack).
+                flipped = dict(data)
+                flipped["instance/preempted"] = "TRUE"
+                meta.set_data(flipped)
+                t0 = time.monotonic()
+                assert wait_for(
+                    lambda: "google.com/tpu.lifecycle.preempt-imminent"
+                            "=true" in out.read_text(), timeout=15)
+                latency = time.monotonic() - t0
+                assert latency < 12, latency
+                assert metric(port, "tfd_lifecycle_state") == 2.0
+
+                # Recovery clears it (governor-exempt: no hold-down).
+                meta.set_data(data)
+                assert wait_for(
+                    lambda: "tpu.lifecycle." not in out.read_text(),
+                    timeout=15)
+            finally:
+                stop(proc)
+
+    def test_draining_taint_labels_via_cr_sink(self, tfd_binary,
+                                               tmp_path):
+        with FakeApiServer() as server:
+            sa = tmp_path / "sa"
+            sa.mkdir()
+            (sa / "token").write_text("t")
+            (sa / "namespace").write_text(NS)
+            node = "drain-node"
+            server.set_node(node, unschedulable=False)
+            port = free_port()
+            proc = subprocess.Popen(
+                [str(tfd_binary), "--sleep-interval=1s", "--backend=mock",
+                 f"--mock-topology-file={FIXTURES / 'v2-8.yaml'}",
+                 "--machine-type-file=/dev/null", "--lifecycle-watch",
+                 "--use-node-feature-api", "--output-file=",
+                 f"--introspection-addr=127.0.0.1:{port}"],
+                env={**os.environ, "NODE_NAME": node,
+                     "TFD_APISERVER_URL": server.url,
+                     "TFD_SERVICEACCOUNT_DIR": str(sa),
+                     "GCE_METADATA_HOST": "127.0.0.1:1"},
+                stderr=subprocess.DEVNULL)
+            try:
+                cr = (NS, f"tfd-features-for-{node}")
+
+                def cr_labels():
+                    obj = server.store.get(cr)
+                    return (obj or {}).get("spec", {}).get("labels", {})
+
+                assert wait_for(lambda: cr_labels(), timeout=20)
+                assert "google.com/tpu.lifecycle.draining" not in \
+                    cr_labels()
+                # kubectl cordon: the unschedulable spec flips; the
+                # label follows within the taint-check cadence (one
+                # sleep interval) + a pass.
+                server.set_node(node, unschedulable=True)
+                assert wait_for(
+                    lambda: cr_labels().get(
+                        "google.com/tpu.lifecycle.draining") == "true",
+                    timeout=20)
+            finally:
+                stop(proc)

@@ -225,6 +225,81 @@ class TestHelmChart:
             assert plugin.stat().st_mode & 0o111, f"{name} not executable"
             assert plugin.read_text().startswith("#!/usr/bin/env python3")
 
+    def test_aggregator_knobs_wired(self):
+        """The cluster-inventory aggregator (ISSUE 13): helm
+        aggregator.{enabled,replicas,debounce,leaseDuration,outputName}
+        values -> a Deployment (NOT a DaemonSet) gated on
+        aggregator.enabled wiring TFD_MODE=aggregator + TFD_AGG_* envs,
+        RBAC split into nodefeatures list/watch + writes name-restricted
+        to the output object + a namespaced lease-ConfigMap Role, and
+        the static manifest carrying the same at defaults."""
+        values = yaml.safe_load((HELM / "values.yaml").read_text())
+        agg = values["aggregator"]
+        assert agg["enabled"] is False
+        assert agg["replicas"] == 2
+        assert agg["debounce"] == "2s"
+        assert agg["leaseDuration"] == "30s"
+        assert agg["outputName"] == "tfd-cluster-inventory"
+        template = (HELM / "templates" / "aggregator.yaml").read_text()
+        assert ".Values.aggregator.enabled" in template
+        assert "kind: Deployment" in template
+        assert "kind: DaemonSet" not in template
+        for env in ("TFD_MODE", "TFD_AGG_DEBOUNCE",
+                    "TFD_AGG_LEASE_DURATION", "TFD_AGG_OUTPUT_NAME"):
+            assert env in template, env
+        assert 'value: "aggregator"' in template
+        # POD_NAME fieldRef: the lease holder identity.
+        assert "POD_NAME" in template
+        # RBAC: watch the fleet, write only the output object, lease
+        # ConfigMap namespaced.
+        assert "nodefeatures" in template
+        assert "resourceNames" in template
+        assert ".Values.aggregator.outputName" in template
+        assert "configmaps" in template
+        assert "kind: Role" in template and "kind: ClusterRole" in template
+
+        ds = list(yaml.safe_load_all(
+            (STATIC / "tpu-feature-aggregator-deployment.yaml")
+            .read_text()))
+        kinds = {d["kind"] for d in ds}
+        assert kinds == {"ServiceAccount", "ClusterRole",
+                         "ClusterRoleBinding", "Role", "RoleBinding",
+                         "Deployment"}
+        deploy = next(d for d in ds if d["kind"] == "Deployment")
+        assert deploy["spec"]["replicas"] == 2
+        env = {e["name"]: e.get("value") for e in
+               deploy["spec"]["template"]["spec"]["containers"][0]["env"]}
+        assert env["TFD_MODE"] == "aggregator"
+        assert env["TFD_AGG_DEBOUNCE"] == "2s"
+        assert env["TFD_AGG_LEASE_DURATION"] == "30s"
+        assert env["TFD_AGG_OUTPUT_NAME"] == "tfd-cluster-inventory"
+        role = next(d for d in ds if d["kind"] == "ClusterRole")
+        named = [r for r in role["rules"] if r.get("resourceNames")]
+        assert named and named[0]["resourceNames"] == \
+            ["tfd-cluster-inventory"]
+        assert set(named[0]["verbs"]) == {"patch", "update"}
+
+    def test_lifecycle_watch_knob_wired(self):
+        """The preemption fast path (ISSUE 13 satellite): helm
+        lifecycleWatch -> TFD_LIFECYCLE_WATCH, static daemonsets at the
+        off default."""
+        values = yaml.safe_load((HELM / "values.yaml").read_text())
+        assert values["lifecycleWatch"] is False
+        template = (HELM / "templates" / "daemonset.yml").read_text()
+        assert "TFD_LIFECYCLE_WATCH" in template
+        # The draining check GETs the daemon's own core Node object —
+        # the chart must grant it when the feature is on (nodefeatures
+        # rules alone are not enough; a missing grant fails silently
+        # apart from a once-per-streak warning).
+        rbac = (HELM / "templates" / "rbac.yaml").read_text()
+        assert ".Values.lifecycleWatch" in rbac
+        assert "nodes" in rbac
+        for path in STATIC_YAMLS:
+            ds = yaml.safe_load(path.read_text())
+            env = {e["name"]: e.get("value") for e in
+                   ds["spec"]["template"]["spec"]["containers"][0]["env"]}
+            assert env["TFD_LIFECYCLE_WATCH"] == "false", path.name
+
     def test_helm_daemonset_wires_introspection(self):
         """The chart must wire the introspection addr env, a named
         containerPort, and both kubelet probes, all gated on

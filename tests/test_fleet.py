@@ -579,3 +579,113 @@ class TestWatchSimSmoke:
         assert record["drift_heal_p99_ms"] <= 2000
         assert record["storm_breaker_opens"] == 0
         assert record["storm_undrained"] == 0
+
+
+class TestAggregateSimSmoke:
+    def test_aggregate_soak_quick_passes(self, tmp_path):
+        out = tmp_path / "aggregate.json"
+        rc = fleet_soak.main(["--aggregate", "--quick", "--nodes", "200",
+                              "--json", str(out)])
+        assert rc == 0
+        record = json.loads(out.read_text())
+        assert record["full_recomputes"] == 0
+        assert record["incremental_equals_full"]
+        assert record["steady_qps"] <= 1.0
+        assert record["burst_writes"] <= 3
+        assert record["publish_p99_ms"] <= record["debounce_s"] * 1000 + 1000
+
+
+class TestAggregatorRealProcessSmoke:
+    """200 CRs against the fake apiserver, ONE real aggregator process:
+    the collection list-then-watch sync, incremental churn, and the
+    zero-full-recompute contract — wire-level truth for what the
+    virtual-clock soak proves at 10k."""
+
+    def test_200_nodes_sync_churn_and_zero_recomputes(self, tfd_binary):
+        import os
+        import subprocess
+
+        from conftest import http_get, wait_for
+        from tpufd import agg as agglib
+        from tpufd import metrics as metricslib
+
+        ns = "aggfleet"
+        expected = agglib.InventoryStore()
+        with FakeApiServer() as server:
+            for i in range(200):
+                labels = {
+                    "google.com/tpu.count": "4",
+                    "google.com/tpu.slice.id": f"slice-{i // 16}",
+                    "google.com/tpu.slice.degraded":
+                        "true" if i % 32 == 0 else "false",
+                    "google.com/tpu.perf.class":
+                        ["gold", "silver", "degraded"][i % 3],
+                    "google.com/tpu.perf.matmul-tflops":
+                        "%.3f" % (100.0 + i % 90),
+                    "google.com/tpu.perf.hbm-gbps":
+                        "%.3f" % (400.0 + i % 400),
+                }
+                server.seed(ns, f"tfd-features-for-node-{i}", labels,
+                            {"nfd.node.kubernetes.io/node-name":
+                             f"node-{i}"})
+                expected.apply(f"node-{i}", labels)
+
+            import socket
+
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                port = s.getsockname()[1]
+            proc = subprocess.Popen(
+                [str(tfd_binary), "--mode=aggregator",
+                 "--agg-debounce=1s", "--agg-lease-duration=4s",
+                 f"--introspection-addr=127.0.0.1:{port}"],
+                env={**os.environ, "TFD_APISERVER_URL": server.url,
+                     "KUBERNETES_NAMESPACE": ns, "POD_NAME": "agg-smoke",
+                     "GCE_METADATA_HOST": "127.0.0.1:1"},
+                stderr=subprocess.DEVNULL)
+            try:
+                def output():
+                    obj = server.store.get((ns, "tfd-cluster-inventory"))
+                    return (obj or {}).get("spec", {}).get("labels")
+
+                assert wait_for(
+                    lambda: output() == expected.build_output_labels(),
+                    timeout=30)
+
+                # Incremental churn across 10 nodes (one debounced
+                # write), then the contract counters.
+                for i in range(0, 100, 10):
+                    churned = {
+                        "google.com/tpu.count": "4",
+                        "google.com/tpu.slice.id": f"slice-{i // 16}",
+                        "google.com/tpu.slice.degraded": "true",
+                        "google.com/tpu.perf.class": "degraded",
+                        "google.com/tpu.perf.matmul-tflops": "60.000",
+                        "google.com/tpu.perf.hbm-gbps": "250.000",
+                    }
+                    server.seed(ns, f"tfd-features-for-node-{i}", churned,
+                                {"nfd.node.kubernetes.io/node-name":
+                                 f"node-{i}"})
+                    expected.apply(f"node-{i}", churned)
+                assert wait_for(
+                    lambda: output() == expected.build_output_labels(),
+                    timeout=10)
+
+                status, body = http_get(port, "/metrics")
+                assert status == 200
+                assert metricslib.sample_value(
+                    body, "tfd_agg_nodes") == 200.0
+                recomputes = 0.0
+                try:
+                    recomputes = metricslib.sample_value(
+                        body, "tfd_agg_full_recomputes_total")
+                except ValueError:
+                    pass
+                assert recomputes == 0.0
+            finally:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait(timeout=5)

@@ -1,0 +1,298 @@
+"""Python twin of the cluster-inventory aggregator core (src/tfd/agg/).
+
+Mirrors, constant for constant, the pure logic the 10k-node aggregate
+soak needs to simulate the aggregator without running it — and that the
+parity tests pin against the C++ (change one side, change both):
+
+  - the fixed-bin log-bucket quantile sketch (REMOVABLE + mergeable:
+    counts per bucket, boundaries by repeated IEEE-double
+    multiplication so both languages bucket identically bit-for-bit);
+  - per-node contribution extraction from a published label set;
+  - the incremental inventory store: every delta retires the node's old
+    contribution and applies the new one — O(changed labels) per event,
+    `full_recomputes` counts the from-scratch rebuilds the steady path
+    must never take;
+  - the coalescing bounded-staleness flush controller.
+"""
+
+PREFIX = "google.com/"
+
+SLICE_ID = PREFIX + "tpu.slice.id"
+SLICE_DEGRADED = PREFIX + "tpu.slice.degraded"
+MULTISLICE_SLICE_ID = PREFIX + "tpu.multislice.slice-id"
+PERF_CLASS = PREFIX + "tpu.perf.class"
+PERF_MATMUL = PREFIX + "tpu.perf.matmul-tflops"
+PERF_HBM = PREFIX + "tpu.perf.hbm-gbps"
+TPU_COUNT = PREFIX + "tpu.count"
+LIFECYCLE_PREEMPT = PREFIX + "tpu.lifecycle.preempt-imminent"
+LIFECYCLE_DRAINING = PREFIX + "tpu.lifecycle.draining"
+
+INVENTORY_SLICES = PREFIX + "tpu.slice-inventory.slices"
+INVENTORY_HEALTHY = PREFIX + "tpu.slice-inventory.healthy-slices"
+INVENTORY_DEGRADED = PREFIX + "tpu.slice-inventory.degraded-slices"
+CAPACITY_PREFIX = PREFIX + "tpu.capacity."
+FLEET_NODES = PREFIX + "tpu.fleet.nodes"
+FLEET_PREEMPTING = PREFIX + "tpu.fleet.preempting"
+MULTISLICE_GROUPS = PREFIX + "tpu.multislice.groups"
+FLEET_MATMUL_P10 = PREFIX + "tpu.fleet.perf.matmul-p10"
+FLEET_MATMUL_P50 = PREFIX + "tpu.fleet.perf.matmul-p50"
+FLEET_HBM_P10 = PREFIX + "tpu.fleet.perf.hbm-p10"
+FLEET_HBM_P50 = PREFIX + "tpu.fleet.perf.hbm-p50"
+
+# agg.h kSketch* — the parity grid pins bucket indices on both sides.
+SKETCH_MIN = 0.5
+SKETCH_GAMMA = 1.1
+SKETCH_BUCKETS = 128
+
+
+def sketch_bucket_index(value):
+    """C++ SketchBucketIndex: repeated multiplication, never log()."""
+    try:
+        in_zero = not (value > SKETCH_MIN)  # NaN lands in bucket 0 too
+    except TypeError:
+        return 0
+    if in_zero:
+        return 0
+    idx = 0
+    edge = SKETCH_MIN
+    while idx < SKETCH_BUCKETS - 1 and value > edge:
+        edge *= SKETCH_GAMMA
+        idx += 1
+    return idx
+
+
+def sketch_bucket_value(bucket):
+    if bucket <= 0:
+        return SKETCH_MIN
+    bucket = min(bucket, SKETCH_BUCKETS - 1)
+    edge = SKETCH_MIN
+    for _ in range(bucket):
+        edge *= SKETCH_GAMMA
+    return edge
+
+
+class Sketch:
+    def __init__(self):
+        self.counts = [0] * SKETCH_BUCKETS
+        self.total = 0
+
+    def add(self, value):
+        self.counts[sketch_bucket_index(value)] += 1
+        self.total += 1
+
+    def remove(self, value):
+        idx = sketch_bucket_index(value)
+        if self.counts[idx] > 0:
+            self.counts[idx] -= 1
+            self.total -= 1
+
+    def merge(self, other):
+        for i in range(SKETCH_BUCKETS):
+            self.counts[i] += other.counts[i]
+        self.total += other.total
+
+    def quantile(self, q):
+        if self.total <= 0:
+            return -1.0
+        q = min(max(q, 0.0), 1.0)
+        target = int(q * (self.total - 1))
+        cumulative = 0
+        for i in range(SKETCH_BUCKETS):
+            cumulative += self.counts[i]
+            if cumulative > target:
+                return sketch_bucket_value(i)
+        return sketch_bucket_value(SKETCH_BUCKETS - 1)
+
+
+def _parse_float(labels, key, fallback):
+    raw = labels.get(key, "")
+    try:
+        return float(raw) if raw else fallback
+    except ValueError:
+        return fallback
+
+
+def _parse_int(labels, key, fallback):
+    raw = labels.get(key, "")
+    return int(raw) if raw.isdigit() else fallback
+
+
+def extract_contribution(labels):
+    """C++ ExtractContribution: what one node's label set contributes to
+    the rollups (equal dicts <=> no rollup can move)."""
+    return {
+        "slice_id": labels.get(SLICE_ID, ""),
+        "slice_degraded": labels.get(SLICE_DEGRADED) == "true",
+        "multislice_group": labels.get(MULTISLICE_SLICE_ID, ""),
+        "perf_class": labels.get(PERF_CLASS, ""),
+        "chips": _parse_int(labels, TPU_COUNT, 0),
+        "matmul_tflops": _parse_float(labels, PERF_MATMUL, -1.0),
+        "hbm_gbps": _parse_float(labels, PERF_HBM, -1.0),
+        "preempting": (labels.get(LIFECYCLE_PREEMPT) == "true" or
+                       labels.get(LIFECYCLE_DRAINING) == "true"),
+    }
+
+
+def capacity_bucket(perf_class):
+    if perf_class in ("gold", "silver", "degraded"):
+        return perf_class
+    return "unclassed"
+
+
+def fixed3(v):
+    """util/strings.h Fixed3 ("%.3f") — the shared canonical format."""
+    return "%.3f" % v
+
+
+class InventoryStore:
+    """C++ InventoryStore twin: incremental O(delta) rollups."""
+
+    def __init__(self):
+        self.nodes = {}
+        self.slices = {}       # slice_id -> [members, degraded, preempting]
+        self.capacity = {}     # class -> chips
+        self.multislice = {}   # group -> members
+        self.preempting_nodes = 0
+        self.matmul = Sketch()
+        self.hbm = Sketch()
+        self.events = 0
+        self.full_recomputes = 0
+
+    def _retire(self, c):
+        if c["slice_id"]:
+            agg = self.slices.get(c["slice_id"])
+            if agg is not None:
+                agg[0] -= 1
+                if c["slice_degraded"]:
+                    agg[1] -= 1
+                if c["preempting"]:
+                    agg[2] -= 1
+                if agg[0] <= 0:
+                    del self.slices[c["slice_id"]]
+        bucket = capacity_bucket(c["perf_class"])
+        if bucket in self.capacity:
+            self.capacity[bucket] -= c["chips"]
+            if self.capacity[bucket] <= 0:
+                del self.capacity[bucket]
+        if c["multislice_group"]:
+            group = c["multislice_group"]
+            if group in self.multislice:
+                self.multislice[group] -= 1
+                if self.multislice[group] <= 0:
+                    del self.multislice[group]
+        if c["preempting"]:
+            self.preempting_nodes -= 1
+        if c["matmul_tflops"] >= 0:
+            self.matmul.remove(c["matmul_tflops"])
+        if c["hbm_gbps"] >= 0:
+            self.hbm.remove(c["hbm_gbps"])
+
+    def _admit(self, c):
+        if c["slice_id"]:
+            agg = self.slices.setdefault(c["slice_id"], [0, 0, 0])
+            agg[0] += 1
+            if c["slice_degraded"]:
+                agg[1] += 1
+            if c["preempting"]:
+                agg[2] += 1
+        bucket = capacity_bucket(c["perf_class"])
+        self.capacity[bucket] = self.capacity.get(bucket, 0) + c["chips"]
+        if c["multislice_group"]:
+            group = c["multislice_group"]
+            self.multislice[group] = self.multislice.get(group, 0) + 1
+        if c["preempting"]:
+            self.preempting_nodes += 1
+        if c["matmul_tflops"] >= 0:
+            self.matmul.add(c["matmul_tflops"])
+        if c["hbm_gbps"] >= 0:
+            self.hbm.add(c["hbm_gbps"])
+
+    def apply(self, node, labels):
+        """Returns True when the node's contribution changed (a rollup
+        moved and a publish is owed)."""
+        self.events += 1
+        nxt = extract_contribution(labels)
+        prev = self.nodes.get(node)
+        if prev is not None:
+            if prev == nxt:
+                return False
+            self._retire(prev)
+        self.nodes[node] = nxt
+        self._admit(nxt)
+        return True
+
+    def remove(self, node):
+        self.events += 1
+        prev = self.nodes.pop(node, None)
+        if prev is None:
+            return False
+        self._retire(prev)
+        return True
+
+    def build_output_labels(self):
+        healthy = sum(1 for agg in self.slices.values()
+                      if agg[1] == 0 and agg[2] == 0)
+        degraded = len(self.slices) - healthy
+        out = {
+            INVENTORY_SLICES: str(len(self.slices)),
+            INVENTORY_HEALTHY: str(healthy),
+            INVENTORY_DEGRADED: str(degraded),
+        }
+        total_chips = 0
+        for bucket in ("gold", "silver", "degraded", "unclassed"):
+            chips = self.capacity.get(bucket, 0)
+            total_chips += chips
+            out[CAPACITY_PREFIX + bucket] = str(chips)
+        out[CAPACITY_PREFIX + "total-chips"] = str(total_chips)
+        out[FLEET_NODES] = str(len(self.nodes))
+        out[FLEET_PREEMPTING] = str(self.preempting_nodes)
+        out[MULTISLICE_GROUPS] = str(len(self.multislice))
+        if self.matmul.total > 0:
+            out[FLEET_MATMUL_P10] = fixed3(self.matmul.quantile(0.10))
+            out[FLEET_MATMUL_P50] = fixed3(self.matmul.quantile(0.50))
+        if self.hbm.total > 0:
+            out[FLEET_HBM_P10] = fixed3(self.hbm.quantile(0.10))
+            out[FLEET_HBM_P50] = fixed3(self.hbm.quantile(0.50))
+        return out
+
+    def recompute_all(self):
+        """Self-check ONLY: the steady path never rebuilds (the soak
+        gates full_recomputes == 0 after sync)."""
+        self.full_recomputes += 1
+        self.slices = {}
+        self.capacity = {}
+        self.multislice = {}
+        self.preempting_nodes = 0
+        self.matmul = Sketch()
+        self.hbm = Sketch()
+        for c in self.nodes.values():
+            self._admit(c)
+
+
+class FlushController:
+    """C++ FlushController twin: the FIRST dirtying event opens a window
+    of debounce_s; everything inside it rides the same flush (bounded
+    staleness, not a quiet-period timer)."""
+
+    def __init__(self, debounce_s):
+        self.debounce_s = debounce_s
+        self.dirty_since = None
+
+    def note_dirty(self, now):
+        if self.dirty_since is None:
+            self.dirty_since = now
+
+    @property
+    def dirty(self):
+        return self.dirty_since is not None
+
+    def due_at(self):
+        if self.dirty_since is None:
+            return float("inf")
+        return self.dirty_since + self.debounce_s
+
+    def should_flush(self, now):
+        return self.dirty and now >= self.due_at()
+
+    def note_flushed(self):
+        self.dirty_since = None

@@ -50,6 +50,53 @@ APPLY_PATCH = "application/apply-patch+yaml"
 # Watch-event history retained per object; a watch asking for a version
 # older than the retained window answers ERROR 410 (client must re-list).
 WATCH_HISTORY = 64
+# Collection-scoped history (one merged stream per namespace, ordered by
+# the GLOBAL resourceVersion — the real apiserver's storage revision).
+# Deliberately larger than the per-object window: one busy object must
+# not compact every peer's events out from under a collection watcher.
+COLLECTION_HISTORY = 256
+# Cluster-scoped core resources (GET/PUT /api/v1/nodes/<name>): the
+# lifecycle probe reads spec.unschedulable/taints from here.
+NODES_PREFIX = "/api/v1/nodes"
+
+
+def parse_label_selector(text):
+    """Parses a labelSelector query value into a list of (op, key, value)
+    terms: op is 'exists', 'notexists', 'eq' or 'neq'. The subset the
+    aggregator and tests use — set-based expressions are not served."""
+    terms = []
+    for raw in (text or "").split(","):
+        raw = raw.strip()
+        if not raw:
+            continue
+        if "!=" in raw:
+            key, _, value = raw.partition("!=")
+            terms.append(("neq", key.strip(), value.strip()))
+        elif "==" in raw:
+            key, _, value = raw.partition("==")
+            terms.append(("eq", key.strip(), value.strip()))
+        elif "=" in raw:
+            key, _, value = raw.partition("=")
+            terms.append(("eq", key.strip(), value.strip()))
+        elif raw.startswith("!"):
+            terms.append(("notexists", raw[1:].strip(), None))
+        else:
+            terms.append(("exists", raw, None))
+    return terms
+
+
+def selector_matches(terms, obj):
+    labels = (obj.get("metadata") or {}).get("labels") or {}
+    for op, key, value in terms:
+        if op == "exists" and key not in labels:
+            return False
+        if op == "notexists" and key in labels:
+            return False
+        if op == "eq" and labels.get(key) != value:
+            return False
+        if op == "neq" and labels.get(key) == value:
+            return False
+    return True
 
 
 def merge_patch(target, patch):
@@ -80,6 +127,13 @@ class _Handler(BaseHTTPRequestHandler):
     events = None     # type: dict  # (ns, name) -> list
     compacted = None  # type: dict  # (ns, name) -> int
     managers = None   # type: dict  # (ns, name) -> {manager: set(keys)}
+    # Collection-scoped watch machinery: a GLOBAL resourceVersion (the
+    # storage revision every emitted event is ordered by), one merged
+    # per-namespace history, and its compaction floor.
+    grv = None                  # type: list  # [int]
+    collection_events = None    # type: dict  # ns -> [(grv, type, obj)]
+    collection_compacted = None  # type: dict  # ns -> int
+    nodes = None      # type: dict  # name -> Node object (/api/v1/nodes)
     watch_cond = None
     closing = None    # type: list  # [bool] — server shutting down
     bookmark_interval = 0.5
@@ -203,6 +257,16 @@ class _Handler(BaseHTTPRequestHandler):
             dropped = history[:-WATCH_HISTORY]
             del history[:-WATCH_HISTORY]
             cls.compacted[(ns, name)] = dropped[-1][0]
+        # Collection stream: the same event ordered by the GLOBAL
+        # resourceVersion (per-object rvs are per-object counters and
+        # cannot order a merged stream).
+        cls.grv[0] += 1
+        chistory = cls.collection_events.setdefault(ns, [])
+        chistory.append((cls.grv[0], event_type, copy.deepcopy(obj)))
+        if len(chistory) > COLLECTION_HISTORY:
+            dropped = chistory[:-COLLECTION_HISTORY]
+            del chistory[:-COLLECTION_HISTORY]
+            cls.collection_compacted[ns] = dropped[-1][0]
         cls.watch_cond.notify_all()
 
     # ---- watch stream ----------------------------------------------------
@@ -295,15 +359,132 @@ class _Handler(BaseHTTPRequestHandler):
         except OSError:
             pass  # client went away mid-stream
 
+    # ---- collection scope (LIST + WATCH) ---------------------------------
+
+    def _list(self, ns, query):
+        """GET on the collection: a NodeFeatureList of every object in
+        the namespace passing the labelSelector, stamped with the
+        GLOBAL resourceVersion (what a collection watch resumes from)."""
+        terms = parse_label_selector(
+            query.get("labelSelector", [""])[0])
+        with self.lock:
+            items = [copy.deepcopy(obj) for (ons, _), obj in
+                     sorted(self.store.items()) if ons == ns and
+                     selector_matches(terms, obj)]
+            rv = self.grv[0]
+        return self._reply(200, {
+            "apiVersion": "nfd.k8s-sigs.io/v1alpha1",
+            "kind": "NodeFeatureList",
+            "metadata": {"resourceVersion": str(rv)},
+            "items": items,
+        })
+
+    def _watch_collection(self, ns, query):
+        """GET ...nodefeatures?watch=true — ONE chunked stream carrying
+        every object's events in global-resourceVersion order, filtered
+        by the labelSelector, with BOOKMARKs carrying the global rv and
+        ERROR 410 below the collection compaction floor."""
+        try:
+            timeout_s = float(query.get("timeoutSeconds", ["30"])[0])
+        except ValueError:
+            timeout_s = 30.0
+        bookmarks = query.get("allowWatchBookmarks", ["false"])[0] == "true"
+        start_rv = query.get("resourceVersion", [None])[0]
+        terms = parse_label_selector(
+            query.get("labelSelector", [""])[0])
+
+        with self.lock:
+            self.requests.append(("WATCH", self.path))
+            self.timeline.append((time.monotonic(), "WATCH", 200))
+
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def emit(doc):
+            data = json.dumps(doc, separators=(",", ":")).encode() + b"\n"
+            self.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
+            self.wfile.flush()
+
+        def finish():
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+
+        with self.lock:
+            floor = self.collection_compacted.get(ns, 0)
+            if start_rv is not None:
+                try:
+                    last_sent = int(start_rv)
+                except ValueError:
+                    last_sent = 0
+                if last_sent < floor:
+                    try:
+                        emit({"type": "ERROR",
+                              "object": {"kind": "Status", "code": 410,
+                                         "message":
+                                             "too old resource version"}})
+                        finish()
+                    except OSError:
+                        pass
+                    return
+            else:
+                last_sent = self.grv[0]  # future events only
+
+        deadline = time.monotonic() + timeout_s
+        next_bookmark = time.monotonic() + self.bookmark_interval
+        try:
+            while not self.closing[0]:
+                now = time.monotonic()
+                if now >= deadline:
+                    break
+                pending = []
+                with self.watch_cond:
+                    history = self.collection_events.get(ns, [])
+                    pending = [e for e in history if e[0] > last_sent]
+                    if not pending:
+                        self.watch_cond.wait(
+                            timeout=min(0.1, max(0.0, deadline - now)))
+                        history = self.collection_events.get(ns, [])
+                        pending = [e for e in history if e[0] > last_sent]
+                for grv, event_type, obj in pending:
+                    if selector_matches(terms, obj):
+                        emit({"type": event_type, "object": obj})
+                    last_sent = grv
+                if bookmarks and time.monotonic() >= next_bookmark:
+                    emit({"type": "BOOKMARK",
+                          "object": {"metadata":
+                                     {"resourceVersion": str(last_sent)}}})
+                    next_bookmark = (time.monotonic() +
+                                     self.bookmark_interval)
+            finish()  # clean rotation
+        except OSError:
+            pass
+
     # ---- verbs -----------------------------------------------------------
 
     def do_GET(self):  # noqa: N802
         if self._gate():
             return None
+        path, query = self._split_path()
+        if path.startswith(NODES_PREFIX + "/"):
+            name = path[len(NODES_PREFIX) + 1:]
+            with self.lock:
+                node = self.nodes.get(name)
+            if node is None:
+                return self._reply(404, {"message": "not found"})
+            return self._reply(200, node)
         ns, name = self._parse()
-        if ns is None or name is None:
+        if ns is None:
             return self._reply(404, {"message": "not found"})
-        _, query = self._split_path()
+        if name is None:
+            # Collection scope: nodefeatures only (the coordination
+            # ConfigMaps are always addressed by name).
+            if not path.startswith(PREFIX):
+                return self._reply(404, {"message": "not found"})
+            if query.get("watch", ["false"])[0] == "true":
+                return self._watch_collection(ns, query)
+            return self._list(ns, query)
         if query.get("watch", ["false"])[0] == "true":
             return self._watch(ns, name, query)
         with self.lock:
@@ -492,7 +673,9 @@ class FakeApiServer:
             "failing_retry_after": None, "failing_apf": False,
             "capacity": 0, "cap_bucket": [0, 0], "patch_supported": True,
             "apply_supported": True, "events": {}, "compacted": {},
-            "managers": {}, "watch_cond": threading.Condition(lock),
+            "managers": {}, "grv": [0], "collection_events": {},
+            "collection_compacted": {}, "nodes": {},
+            "watch_cond": threading.Condition(lock),
             "closing": [False]})
         self.store = handler.store
         self.requests = handler.requests
@@ -602,6 +785,50 @@ class FakeApiServer:
                 rv = max(rv, history[-1][0])
             self._handler.events[(ns, name)] = []
             self._handler.compacted[(ns, name)] = rv
+
+    def seed(self, ns, name, labels, meta_labels=None):
+        """Creates or replaces an object server-side (rv bump + watch
+        event), exactly what a daemon's write looks like to a
+        collection watcher — the aggregator soak seeds/churns its fleet
+        through this without 200 real daemon processes."""
+        with self._handler.lock:
+            existing = self.store.get((ns, name))
+            if existing is None:
+                obj = {"apiVersion": "nfd.k8s-sigs.io/v1alpha1",
+                       "kind": "NodeFeature",
+                       "metadata": {"name": name, "namespace": ns,
+                                    "resourceVersion": "1",
+                                    "labels": dict(meta_labels or {})},
+                       "spec": {"labels": dict(labels)}}
+                self.store[(ns, name)] = obj
+                self._handler._emit(ns, name, "ADDED", obj)
+            else:
+                existing["spec"]["labels"] = dict(labels)
+                if meta_labels:
+                    existing.setdefault("metadata", {}).setdefault(
+                        "labels", {}).update(meta_labels)
+                existing["metadata"]["resourceVersion"] = str(
+                    int(existing["metadata"]["resourceVersion"]) + 1)
+                self._handler._emit(ns, name, "MODIFIED", existing)
+
+    def set_node(self, name, unschedulable=False, taints=None):
+        """Creates/updates a /api/v1/nodes/<name> object — the lifecycle
+        probe's draining input (spec.unschedulable + taints)."""
+        with self._handler.lock:
+            self._handler.nodes[name] = {
+                "apiVersion": "v1", "kind": "Node",
+                "metadata": {"name": name},
+                "spec": {"unschedulable": bool(unschedulable),
+                         "taints": list(taints or [])},
+            }
+
+    def compact_collection(self, ns):
+        """Raises the COLLECTION compaction floor to the current global
+        resourceVersion: the next collection watch resuming from an
+        older rv answers ERROR 410 (the aggregator's re-list drill)."""
+        with self._handler.lock:
+            self._handler.collection_events[ns] = []
+            self._handler.collection_compacted[ns] = self._handler.grv[0]
 
     def add_listener(self, port=0):
         """A second loopback listener sharing THIS server's store and

@@ -20,6 +20,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 def tpu_vm(accelerator_type="v5litepod-4", topology=None, worker_id=0,
            chips_per_host_bounds=None, host_bounds=None,
            machine_type="ct5lp-hightpu-4t", preemptible=False,
+           preempted=False,
            spot=False, zone="us-central2-b", megascale_slice_id=None,
            megascale_num_slices=None, instance_id="1234567890",
            extra_attributes=None, include_worker_id=True, hostname=None,
@@ -69,6 +70,10 @@ def tpu_vm(accelerator_type="v5litepod-4", topology=None, worker_id=0,
             "TRUE" if preemptible else "FALSE",
         "instance/scheduling/provisioning-model":
             "SPOT" if spot else "STANDARD",
+        # instance/preempted flips to TRUE when GCE issues the
+        # preemption notice — the lifecycle probe's fast-path input
+        # (flip it live via FakeMetadataServer.set_data).
+        "instance/preempted": "TRUE" if preempted else "FALSE",
         "instance/attributes/accelerator-type": accelerator_type,
         "instance/attributes/tpu-env": "\n".join(tpu_env_lines) + "\n",
         "instance/attributes/agent-worker-number": str(worker_id),

@@ -124,6 +124,39 @@ def classify(matmul_pct, hbm_pct, prev=None):
     return _NAMES[rank] if still_crosses else _NAMES[prev_rank]
 
 
+def parse_fleet_floor(text):
+    """Twin of perf::ParseFleetFloor: the --perf-fleet-floor-source
+    document ({"matmul_p10_tflops": N, "hbm_p10_gbps": N}, either key
+    optional). Returns {matmul_p10_tflops, hbm_p10_gbps} with None for
+    an absent floor; raises ValueError on garbage."""
+    import json
+
+    doc = json.loads(text)
+    if not isinstance(doc, dict):
+        raise ValueError("fleet floor: not a JSON object")
+    floor = {"matmul_p10_tflops": None, "hbm_p10_gbps": None}
+    for key in floor:
+        value = doc.get(key)
+        if isinstance(value, (int, float)) and value >= 0:
+            floor[key] = float(value)
+    return floor
+
+
+def apply_fleet_floor(class_name, matmul_tflops, hbm_gbps, floor):
+    """Twin of perf::ApplyFleetFloor: a MEASURED value below either
+    fleet p10 floor demotes the class to degraded (ROADMAP #4a gray
+    degradation); unmeasured values and unset floors never trigger."""
+    matmul_floor = floor.get("matmul_p10_tflops")
+    hbm_floor = floor.get("hbm_p10_gbps")
+    if (matmul_floor is not None and matmul_tflops is not None
+            and matmul_tflops >= 0 and matmul_tflops < matmul_floor):
+        return CLASS_DEGRADED
+    if (hbm_floor is not None and hbm_gbps is not None
+            and hbm_gbps >= 0 and hbm_gbps < hbm_floor):
+        return CLASS_DEGRADED
+    return class_name
+
+
 def expected_labels(matmul_tflops, hbm_gbps, ici_gbps, family,
                     class_name, specs=None,
                     prefix="google.com/tpu.perf."):

@@ -146,8 +146,8 @@ def parse_watch_event(line):
     raises); non-string spec.labels values read as absent — the same
     rules the C++ client applies, pinned by the parity grid in
     tests/test_fleet.py."""
-    out = {"type": "unknown", "resource_version": "", "has_labels": False,
-           "labels": {}, "error_code": 0}
+    out = {"type": "unknown", "name": "", "resource_version": "",
+           "has_labels": False, "labels": {}, "error_code": 0}
     try:
         doc = json.loads(line)
     except (ValueError, TypeError):
@@ -164,6 +164,12 @@ def parse_watch_event(line):
     rv = (obj.get("metadata") or {}).get("resourceVersion")
     if isinstance(rv, str):
         out["resource_version"] = rv
+    # metadata.name: load-bearing at COLLECTION scope (the aggregator's
+    # one stream carries every object); the per-object watcher ignores
+    # it.
+    name = (obj.get("metadata") or {}).get("name")
+    if isinstance(name, str):
+        out["name"] = name
     if out["type"] == "error":
         code = obj.get("code")
         if isinstance(code, (int, float)):

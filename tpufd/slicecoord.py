@@ -133,12 +133,15 @@ def lease_expired(lease, now):
 
 def merge_verdict(num_hosts, reports, agreement_timeout_s, now,
                   departed_at=None, rejoin_dwell_s=0):
-    """The leader's merge: reports = [{host, healthy, at, class?}].
-    Present = heard from within the agreement window; a stale/missing
-    member degrades the slice. Rejoin hysteresis (C++ MergeVerdict
-    parity): a present healthy host whose ``departed_at[host]`` is
-    younger than ``rejoin_dwell_s`` counts as a member but NOT healthy
-    — a crash-looper cannot flap healthy-hosts once per restart.
+    """The leader's merge: reports = [{host, healthy, at, class?,
+    preempting?}]. Present = heard from within the agreement window; a
+    stale/missing member degrades the slice. A PREEMPTING member (the
+    lifecycle fast path's verdict: alive but about to vanish) counts as
+    a member but never healthy — the slice degrades proactively, before
+    the host dies. Rejoin hysteresis (C++ MergeVerdict parity): a
+    present healthy host whose ``departed_at[host]`` is younger than
+    ``rejoin_dwell_s`` counts as a member but NOT healthy — a
+    crash-looper cannot flap healthy-hosts once per restart.
     Returns {hosts, healthy_hosts, degraded, class, members,
     dwelling}."""
     departed_at = departed_at or {}
@@ -154,6 +157,8 @@ def merge_verdict(num_hosts, reports, agreement_timeout_s, now,
             continue
         members.add(report["host"])
         is_healthy = bool(report.get("healthy"))
+        if report.get("preempting"):
+            is_healthy = False
         if (is_healthy and rejoin_dwell_s > 0
                 and report["host"] in departed_at
                 and now - departed_at[report["host"]] < rejoin_dwell_s):

@@ -16,56 +16,53 @@ the hermetic mock backend, then fails if:
 
 Exit 0 when both gates hold; nonzero with the reason otherwise.
 
-Fleet mode (ISSUE 8): `--fleet RECORD.json` gates a fleet-soak record
-(scripts/fleet_soak.py --json) instead of running the local bench —
-aggregate steady-state QPS reduction vs the GET+PUT baseline (absolute
->= 5x), the worst 1-second burst bucket (<= 10% of the fleet), and the
-steady QPS / churn p99 regressions against the committed BENCH_r08.json.
+Soak-record modes — each gates a committed-soak record file instead of
+running the local bench; shared mechanics (record load, loud failure on
+missing keys, reference-regression compare) live in the helpers at the
+top so the per-mode functions only state their invariants:
 
-Perf mode (ISSUE 9): `--perf` runs bench.perf_record() — the hermetic
-amortized-characterization scenario — and gates (a) the steady-state
-no-op p50 WITH the perf source enabled (<= --noop-budget-us absolute:
-characterization must not tax the fast path), (b) warm-restart perf
-restore <= 15 ms with ZERO measurements journaled after the kill -9,
-(c) exactly one measurement round across the steady soak, and (d) the
-no-op p50 against the committed BENCH_r09.json reference (+ slack).
+  --fleet     (ISSUE 8)  fleet-soak record: steady QPS reduction >= 5x
+              absolute, worst 1s bucket <= 10% of the fleet, golden
+              equality, no breaker opens, QPS/p99 vs BENCH_r08.json.
+  --perf      (ISSUE 9)  runs bench.perf_record() and gates the
+              amortization contract (1 measure round, restore <= 15 ms
+              with zero re-measures) + noop p50 vs BENCH_r09.json.
+  --slice     (ISSUE 10) slice-coherence soak record: zero interleaved
+              disagreements, every chaos step present, invariants set,
+              agreement p50 vs BENCH_r10.json.
+  --plugin    (ISSUE 11) plugin-containment soak record: every
+              misbehavior class quarantined/journaled/recovered, other
+              sources byte-stable, noop p50 vs BENCH_r11.json.
+  --watch     (ISSUE 12) event-driven watch-soak record: zero quiet
+              passes, drift heal p99 <= 2s, storm drained without
+              breaker opens, latencies vs BENCH_r12.json.
+  --aggregate (ISSUE 13) aggregator soak record: zero full recomputes,
+              incremental == from-scratch, burst coalesced, steady QPS
+              <= 1, publish p99 vs BENCH_r13.json.
+  --cluster   (ISSUE 14) end-to-end placement-quality record
+              (scripts/cluster_soak.py): ZERO jobs placed on known-bad
+              hardware after the convergence window, label-to-placement
+              p99 and recovery p99 bounded absolutely and vs
+              BENCH_cluster.json, every injected failure AND heal
+              converged to a placeability flip, byte-identical metrics
+              across two runs of one seed (the determinism pin), and
+              the aggregator genuinely composed in (inventory consumed,
+              zero full recomputes).
 
-Slice mode (ISSUE 10): `--slice RECORD.json` gates a multi-host
-slice-coherence soak record (scripts/slice_soak.py --json) — ZERO
-interleaved-disagreement samples (no pass where two live hosts publish
-different tpu.slice.* claims), every chaos step converged with its
-disagreement window inside 2 probe intervals, the partition/failover/
-kill -9 invariants held, and the agreement-latency p50 within slack of
-the committed BENCH_r10.json.
-
-Plugin mode (ISSUE 11): `--plugin RECORD.json` gates a probe-plugin
-containment soak record (scripts/plugin_soak.py --json) — every
-misbehavior class (hang, crash-loop, garbage, label-spam, namespace
-escape, stdout flood) present, quarantined, journaled, and recovered,
-every other source's labels byte-stable at every sampled pass, the
-ported device-health plugin golden byte-equal to the compiled-in path,
-and the steady no-op p50 with two plugins registered under the
-absolute budget and within slack of the committed BENCH_r11.json.
-
-Watch mode (ISSUE 12): `--watch RECORD.json` gates an event-driven
-watch-soak record (scripts/fleet_soak.py --watch --json) — ZERO rewrite
-passes fleet-wide across the quiet window, external-drift heal p99
-<= 2s (absolute), the mass-watch-drop reconnect storm drained through
-Retry-After pacing with zero breaker opens and no re-herding retry
-wave, and the heal/convergence latencies within slack of the committed
-BENCH_r12.json.
+Every mode fails LOUDLY on records missing expected keys/phases — a
+partially-run or older-format soak record must not sail through its
+gates on defaulted zeros (the --fleet lesson from PR 7).
 
 Usage:
   python3 scripts/bench_gate.py [--reference BENCH_r07.json]
       [--noop-budget-us 1000] [--dirty-slack 0.25]
   python3 scripts/bench_gate.py --fleet fleet.json
-      [--fleet-reference BENCH_r08.json] [--fleet-slack 0.5]
   python3 scripts/bench_gate.py --perf
-      [--perf-reference BENCH_r09.json] [--perf-restore-budget-ms 15]
   python3 scripts/bench_gate.py --slice slice-soak.json
-      [--slice-reference BENCH_r10.json] [--slice-slack 0.5]
   python3 scripts/bench_gate.py --plugin plugin-soak.json
-      [--plugin-reference BENCH_r11.json] [--plugin-slack 1.0]
+  python3 scripts/bench_gate.py --watch watch-soak.json
+  python3 scripts/bench_gate.py --aggregate aggregate-soak.json
+  python3 scripts/bench_gate.py --cluster cluster-soak.json
 """
 
 import argparse
@@ -77,18 +74,77 @@ sys.path.insert(0, os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
 
 
+# ---- shared gate mechanics (one copy; every mode rides these) -------------
+
+
+def load_record(path, what, problems):
+    """Loads a soak record; unreadable = a problem, not a crash."""
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        problems.append(f"{what} record {path} unreadable: {e}")
+        return None
+
+
+def load_reference(path, what, problems):
+    """Loads a committed reference record — either the bare record or
+    the driver's {parsed: ...} wrapper."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        return doc.get("parsed", doc)
+    except (OSError, ValueError) as e:
+        problems.append(f"{what} reference {path} unreadable: {e}")
+        return None
+
+
+def require(record, key, what, problems):
+    """Fetches a record key; absence is a LOUD failure (returns None)."""
+    value = record.get(key)
+    if value is None:
+        problems.append(f"{what} record has no {key}")
+    return value
+
+
+def gate_regressions(record, ref, keys, slack, problems, extra=0.0):
+    """Regression compare vs the committed reference: for each
+    (key, label) pair the record value may not exceed
+    reference * (1 + slack) + extra. Missing on either side fails."""
+    for key, label in keys:
+        got = record.get(key)
+        want = (ref or {}).get(key)
+        if got is None:
+            # Modes that also gate this key absolutely already flagged
+            # the record-side absence via require(); don't say it twice.
+            if not any(p.endswith(f"record has no {key}")
+                       for p in problems):
+                problems.append(f"{key} missing from record")
+        if want is None:
+            problems.append(f"{key} missing from reference")
+        if got is None or want is None:
+            pass
+        elif want > 0 and got > want * (1.0 + slack) + extra:
+            problems.append(
+                f"{label} {got} regressed past "
+                f"{want * (1.0 + slack) + extra:.2f} (reference {want} "
+                f"+{int(slack * 100)}%)")
+
+
+# ---- per-mode gates --------------------------------------------------------
+
+
 def fleet_gate(record_path, reference_path, slack):
     """Gates a fleet-soak record: the two absolute acceptance bounds
     plus regression vs the committed reference. Returns a problem list
     (empty = pass)."""
-    with open(record_path) as f:
-        record = json.load(f)
     problems = []
+    record = load_record(record_path, "fleet", problems)
+    if record is None:
+        return problems
 
-    reduction = record.get("steady_qps_reduction")
-    if reduction is None:
-        problems.append("fleet record has no steady_qps_reduction")
-    elif reduction < 5.0:
+    reduction = require(record, "steady_qps_reduction", "fleet", problems)
+    if reduction is not None and reduction < 5.0:
         problems.append(
             f"steady-state QPS reduction {reduction}x vs the GET+PUT "
             f"baseline is below the 5x floor")
@@ -114,21 +170,13 @@ def fleet_gate(record_path, reference_path, slack):
         problems.append(f"storm opened {storm['breaker_opens']} "
                         "breaker(s) — adaptive backoff regressed")
 
-    try:
-        with open(reference_path) as f:
-            ref = json.load(f)
-    except (OSError, ValueError) as e:
-        problems.append(f"fleet reference {reference_path} unreadable: {e}")
-        return problems
-    for key, label in (("steady_qps_diff", "steady-state sink QPS"),
-                       ("churn_p99_ms", "churn write p99")):
-        got, want = record.get(key), ref.get(key)
-        if got is None or want is None:
-            problems.append(f"{key} missing from record or reference")
-        elif want > 0 and got > want * (1.0 + slack):
-            problems.append(
-                f"{label} {got} regressed past {want * (1.0 + slack):.2f} "
-                f"(reference {want} +{int(slack * 100)}%)")
+    ref = load_reference(reference_path, "fleet", problems)
+    if ref is not None:
+        gate_regressions(
+            record, ref,
+            (("steady_qps_diff", "steady-state sink QPS"),
+             ("churn_p99_ms", "churn write p99")),
+            slack, problems)
     return problems
 
 
@@ -147,10 +195,8 @@ def perf_gate(record, reference_path, noop_budget_us, restore_budget_ms,
             f"no-op pass p50 {noop}us with the perf source enabled "
             f"exceeds the {noop_budget_us}us budget — characterization "
             "is taxing the fast path")
-    rounds = record.get("perf_measure_rounds")
-    if rounds is None:
-        problems.append("perf_measure_rounds missing")
-    elif rounds != 1:
+    rounds = require(record, "perf_measure_rounds", "perf", problems)
+    if rounds is not None and rounds != 1:
         problems.append(
             f"{rounds} measurement rounds across the steady soak "
             "(amortization contract: exactly 1)")
@@ -161,10 +207,9 @@ def perf_gate(record, reference_path, noop_budget_us, restore_budget_ms,
         problems.append(
             f"warm-restart perf restore {restore}ms exceeds the "
             f"{restore_budget_ms}ms budget")
-    restored_rounds = record.get("perf_restored_measure_rounds")
-    if restored_rounds is None:
-        problems.append("perf_restored_measure_rounds missing")
-    elif restored_rounds != 0:
+    restored_rounds = require(record, "perf_restored_measure_rounds",
+                              "perf", problems)
+    if restored_rounds is not None and restored_rounds != 0:
         problems.append(
             f"{restored_rounds} measurement(s) journaled after the "
             "kill -9 restore (must be 0: the restored characterization "
@@ -173,20 +218,12 @@ def perf_gate(record, reference_path, noop_budget_us, restore_budget_ms,
         problems.append(
             "restored pct-of-rated provenance is not 'state-restored' "
             "(cached vs fresh characterization indistinguishable)")
-    try:
-        with open(reference_path) as f:
-            doc = json.load(f)
-        ref = doc.get("parsed", doc).get("perf_noop_p50_us")
-    except (OSError, ValueError) as e:
-        problems.append(f"perf reference {reference_path} unreadable: {e}")
-        ref = None
+    ref = load_reference(reference_path, "perf", problems)
     if ref is not None and noop is not None:
-        ceiling = ref * (1.0 + slack)
-        if noop > ceiling:
-            problems.append(
-                f"perf-enabled no-op p50 {noop}us regressed past "
-                f"{ceiling:.1f}us (reference {ref}us "
-                f"+{int(slack * 100)}%)")
+        gate_regressions(
+            record, ref,
+            (("perf_noop_p50_us", "perf-enabled no-op p50"),),
+            slack, problems)
     return problems
 
 
@@ -195,15 +232,14 @@ def slice_gate(record_path, reference_path, slack):
     agreement-latency regression vs the committed reference. Absent
     keys FAIL loudly — a partially-run soak must not sail through on
     defaults. Returns a problem list (empty = pass)."""
-    with open(record_path) as f:
-        record = json.load(f)
     problems = []
+    record = load_record(record_path, "slice", problems)
+    if record is None:
+        return problems
 
-    interleaved = record.get("interleaved_disagreement_passes")
-    if interleaved is None:
-        problems.append("slice record has no "
-                        "interleaved_disagreement_passes")
-    elif interleaved != 0:
+    interleaved = require(record, "interleaved_disagreement_passes",
+                          "slice", problems)
+    if interleaved is not None and interleaved != 0:
         problems.append(
             f"{interleaved} sample(s) showed two live hosts publishing "
             "disagreeing tpu.slice.* labels (coherence regressed)")
@@ -223,32 +259,20 @@ def slice_gate(record_path, reference_path, slack):
                       "kill9_lease_resumed"):
         if not record.get(invariant):
             problems.append(f"slice record invariant {invariant} not set")
-    worst = record.get("max_disagreement_ms")
-    if worst is None:
-        problems.append("slice record has no max_disagreement_ms")
+    require(record, "max_disagreement_ms", "slice", problems)
     # (Per-step windows are enforced by the soak itself for the
     # failure-relabeling steps; rejoin/boot windows legitimately span a
     # settle window, so no absolute bound on the max here.)
 
-    p50 = record.get("slice_agreement_p50_ms")
-    if p50 is None:
-        problems.append("slice_agreement_p50_ms missing")
-    try:
-        with open(reference_path) as f:
-            ref = json.load(f).get("slice_agreement_p50_ms")
-    except (OSError, ValueError) as e:
-        problems.append(f"slice reference {reference_path} unreadable: {e}")
-        ref = None
-    if ref is not None and p50 is not None:
+    ref = load_reference(reference_path, "slice", problems)
+    if ref is not None:
         # Latencies are dominated by the configured protocol constants
         # (agreement timeout, lease), so regression here means a new
         # layer added passes/round-trips to convergence.
-        ceiling = ref * (1.0 + slack) + 2 * interval_ms
-        if p50 > ceiling:
-            problems.append(
-                f"agreement-latency p50 {p50}ms regressed past "
-                f"{ceiling:.0f}ms (reference {ref}ms +{int(slack * 100)}% "
-                f"+ 2 intervals)")
+        gate_regressions(
+            record, ref,
+            (("slice_agreement_p50_ms", "agreement-latency p50"),),
+            slack, problems, extra=2 * interval_ms)
     return problems
 
 
@@ -259,9 +283,10 @@ def plugin_gate(record_path, reference_path, noop_budget_us, slack):
     a regression), the steady no-op p50 with two plugins registered is
     gated by the absolute budget plus regression vs the committed
     reference. Absent keys FAIL loudly."""
-    with open(record_path) as f:
-        record = json.load(f)
     problems = []
+    record = load_record(record_path, "plugin", problems)
+    if record is None:
+        return problems
 
     modes = record.get("modes") or []
     missing = {"hang", "crash-loop", "garbage", "label-spam", "escape",
@@ -280,27 +305,26 @@ def plugin_gate(record_path, reference_path, noop_budget_us, slack):
         problems.append("plugin record sampled almost nothing — the "
                         "byte-stability claim is vacuous")
 
-    noop = record.get("steady_noop_p50_us")
-    if noop is None:
-        problems.append("steady_noop_p50_us missing")
-    elif noop > noop_budget_us:
+    noop = require(record, "steady_noop_p50_us", "plugin", problems)
+    if noop is not None and noop > noop_budget_us:
         problems.append(
             f"no-op pass p50 {noop}us with plugins registered exceeds "
             f"the {noop_budget_us}us budget — plugins are taxing the "
             "fast path")
-    try:
-        with open(reference_path) as f:
-            ref = json.load(f).get("steady_noop_p50_us")
-    except (OSError, ValueError) as e:
-        problems.append(f"plugin reference {reference_path} unreadable: "
-                        f"{e}")
-        ref = None
-    if ref is not None and noop is not None:
-        ceiling = ref * (1.0 + slack)
+    ref = load_reference(reference_path, "plugin", problems)
+    ref_noop = (require(ref, "steady_noop_p50_us", "plugin reference",
+                        problems)
+                if ref is not None else None)
+    if ref_noop is not None and noop is not None:
+        ceiling = ref_noop * (1.0 + slack)
+        # The absolute budget stays the floor of the gate: a
+        # sub-microsecond reference must not turn scheduler jitter on a
+        # shared CI box into a failure.
         if noop > max(ceiling, noop_budget_us):
             problems.append(
                 f"steady no-op p50 {noop}us regressed past {ceiling:.0f}us "
-                f"(reference {ref}us +{int(slack * 100)}%)")
+                f"(reference {ref_noop}us "
+                f"+{int(slack * 100)}%)")
     return problems
 
 
@@ -312,28 +336,23 @@ def watch_gate(record_path, reference_path, slack):
     tentpole exists to prevent); drift-heal and convergence latencies
     are gated absolutely (the acceptance bounds) and against the
     committed BENCH_r12.json. Absent keys FAIL loudly."""
-    with open(record_path) as f:
-        record = json.load(f)
     problems = []
+    record = load_record(record_path, "watch", problems)
+    if record is None:
+        return problems
 
-    quiet = record.get("quiet_total_passes")
-    if quiet is None:
-        problems.append("watch record has no quiet_total_passes")
-    elif quiet != 0:
+    quiet = require(record, "quiet_total_passes", "watch", problems)
+    if quiet is not None and quiet != 0:
         problems.append(
             f"{quiet} rewrite passes ran across the fleet during the "
             "quiet window (event-driven steady state must be ZERO)")
-    heal = record.get("drift_heal_p99_ms")
-    if heal is None:
-        problems.append("watch record has no drift_heal_p99_ms")
-    elif heal > 2000.0:
+    heal = require(record, "drift_heal_p99_ms", "watch", problems)
+    if heal is not None and heal > 2000.0:
         problems.append(
             f"external-drift heal p99 {heal}ms exceeds the 2s acceptance "
             "bound (was >= 60s pre-watch; the whole point)")
-    opens = record.get("storm_breaker_opens")
-    if opens is None:
-        problems.append("watch record has no storm_breaker_opens")
-    elif opens != 0:
+    opens = require(record, "storm_breaker_opens", "watch", problems)
+    if opens is not None and opens != 0:
         problems.append(
             f"the reconnect storm opened {opens} breaker(s): Retry-After "
             "pacing must read as a live server")
@@ -341,35 +360,21 @@ def watch_gate(record_path, reference_path, slack):
         problems.append(
             f"{record.get('storm_undrained')} daemon(s) never "
             "re-established their watch after the storm")
-    frac = record.get("storm_worst_1s_bucket_frac")
-    if frac is None:
-        problems.append("watch record has no storm_worst_1s_bucket_frac")
-    elif frac > 0.25:
+    frac = require(record, "storm_worst_1s_bucket_frac", "watch", problems)
+    if frac is not None and frac > 0.25:
         problems.append(
             f"worst reconnect-retry second saw {frac:.0%} of the fleet "
             "(Retry-After pacing failed to spread the herd)")
-    converge = record.get("partition_converge_p99_s")
-    if converge is None:
-        problems.append("watch record has no partition_converge_p99_s")
+    require(record, "partition_converge_p99_s", "watch", problems)
 
-    try:
-        with open(reference_path) as f:
-            ref = json.load(f)
-    except (OSError, ValueError) as e:
-        problems.append(f"watch reference {reference_path} unreadable: {e}")
-        return problems
-    for key, label in (
-            ("drift_heal_p99_ms", "external-drift heal p99"),
-            ("partition_converge_p99_s",
-             "convergence-after-partition p99")):
-        got, want = record.get(key), ref.get(key)
-        if got is None or want is None:
-            problems.append(f"{key} missing from record or reference")
-        elif want > 0 and got > want * (1.0 + slack):
-            problems.append(
-                f"{label} {got} regressed past "
-                f"{want * (1.0 + slack):.2f} (reference {want} "
-                f"+{int(slack * 100)}%)")
+    ref = load_reference(reference_path, "watch", problems)
+    if ref is not None:
+        gate_regressions(
+            record, ref,
+            (("drift_heal_p99_ms", "external-drift heal p99"),
+             ("partition_converge_p99_s",
+              "convergence-after-partition p99")),
+            slack, problems)
     return problems
 
 
@@ -381,14 +386,13 @@ def aggregate_gate(record_path, reference_path, slack):
     regardless of fleet size, and single-node-change -> published p99
     within debounce + 1s — plus publish-latency regression vs the
     committed BENCH_r13.json. Absent keys FAIL loudly."""
-    with open(record_path) as f:
-        record = json.load(f)
     problems = []
+    record = load_record(record_path, "aggregate", problems)
+    if record is None:
+        return problems
 
-    recomputes = record.get("full_recomputes")
-    if recomputes is None:
-        problems.append("aggregate record has no full_recomputes")
-    elif recomputes != 0:
+    recomputes = require(record, "full_recomputes", "aggregate", problems)
+    if recomputes is not None and recomputes != 0:
         problems.append(
             f"{recomputes} full rollup recomputes ran after sync (the "
             "steady path must be O(delta), never O(fleet))")
@@ -398,24 +402,18 @@ def aggregate_gate(record_path, reference_path, slack):
     # .get with a default, NOT `or`: a legitimate --agg-debounce of 0
     # must tighten the bound to 1s, not silently widen it to 3s.
     debounce_ms = record.get("debounce_s", 2.0) * 1000.0
-    p99 = record.get("publish_p99_ms")
-    if p99 is None:
-        problems.append("aggregate record has no publish_p99_ms")
-    elif p99 > debounce_ms + 1000.0:
+    p99 = require(record, "publish_p99_ms", "aggregate", problems)
+    if p99 is not None and p99 > debounce_ms + 1000.0:
         problems.append(
             f"single-node-change -> rollup-published p99 {p99}ms "
             f"exceeds the debounce+1s bound "
             f"({debounce_ms + 1000.0:.0f}ms)")
-    qps = record.get("steady_qps")
-    if qps is None:
-        problems.append("aggregate record has no steady_qps")
-    elif qps > 1.0:
+    qps = require(record, "steady_qps", "aggregate", problems)
+    if qps is not None and qps > 1.0:
         problems.append(
             f"aggregator steady apiserver QPS {qps} exceeds 1.0")
-    writes = record.get("burst_writes")
-    if writes is None:
-        problems.append("aggregate record has no burst_writes")
-    elif writes > 3:
+    writes = require(record, "burst_writes", "aggregate", problems)
+    if writes is not None and writes > 3:
         problems.append(
             f"the {record.get('burst_flips')}-node churn burst took "
             f"{writes} output writes (coalescing bound: 3)")
@@ -424,19 +422,104 @@ def aggregate_gate(record_path, reference_path, slack):
             f"initial sync retained {record.get('sync_nodes')} of "
             f"{record.get('nodes')} nodes")
 
-    try:
-        with open(reference_path) as f:
-            ref = json.load(f).get("publish_p99_ms")
-    except (OSError, ValueError) as e:
+    ref = load_reference(reference_path, "aggregate", problems)
+    if ref is not None:
+        gate_regressions(
+            record, ref,
+            (("publish_p99_ms", "rollup publish p99"),),
+            slack, problems)
+    return problems
+
+
+def cluster_gate(record_path, reference_path, slack,
+                 placement_budget_ms=8000.0, recovery_budget_s=10.0):
+    """Gates an end-to-end placement-quality record
+    (scripts/cluster_soak.py --json). The product invariants are
+    ABSOLUTE — a job landing on known-bad hardware after the
+    convergence window, a failure the scheduler never stopped placing
+    onto, or a nondeterministic rerun is a correctness bug, not a
+    regression; the latency headlines are gated absolutely (the
+    acceptance bounds: the partition path's detection + failover +
+    publish budget) and vs the committed BENCH_cluster.json. Absent
+    keys FAIL loudly."""
+    problems = []
+    record = load_record(record_path, "cluster", problems)
+    if record is None:
+        return problems
+
+    bad = require(record, "bad_placements_after_window", "cluster",
+                  problems)
+    if bad is not None and bad != 0:
         problems.append(
-            f"aggregate reference {reference_path} unreadable: {e}")
-        ref = None
-    if ref is not None and p99 is not None and ref > 0 and \
-            p99 > ref * (1.0 + slack):
+            f"{bad} job(s) placed on known-bad hardware AFTER the "
+            f"convergence window (e.g. {record.get('violations', [])[:3]})"
+            " — labels failed to protect placement")
+    p99 = require(record, "label_to_placement_p99_ms", "cluster",
+                  problems)
+    if p99 is not None and p99 > placement_budget_ms:
         problems.append(
-            f"rollup publish p99 {p99}ms regressed past "
-            f"{ref * (1.0 + slack):.0f}ms (reference {ref}ms "
-            f"+{int(slack * 100)}%)")
+            f"label-to-placement p99 {p99}ms exceeds the "
+            f"{placement_budget_ms:.0f}ms acceptance bound (detection + "
+            "agreement + failover + publish budget)")
+    recovery = require(record, "recovery_p99_s", "cluster", problems)
+    if recovery is not None and recovery > recovery_budget_s:
+        problems.append(
+            f"recovery p99 {recovery}s exceeds the "
+            f"{recovery_budget_s:.0f}s bound after heal")
+    if record.get("determinism_ok") is not True:
+        problems.append(
+            "determinism pin absent or failed: two runs of one seed "
+            "must produce byte-identical metrics")
+    tracked = require(record, "failures_tracked", "cluster", problems)
+    converged = require(record, "failures_converged", "cluster", problems)
+    if None not in (tracked, converged) and tracked != converged:
+        problems.append(
+            f"only {converged} of {tracked} injected failures ever "
+            "flipped the scheduler's placeability verdict")
+    heals = require(record, "heals_tracked", "cluster", problems)
+    healed = require(record, "heals_converged", "cluster", problems)
+    if None not in (heals, healed) and heals != healed:
+        problems.append(
+            f"only {healed} of {heals} heals made the victim placeable "
+            "again")
+    leftover = require(record, "final_unplaceable_nodes", "cluster",
+                       problems)
+    if leftover is not None and leftover != 0:
+        problems.append(
+            f"{leftover} node(s) still unplaceable after heal-all")
+    placements = require(record, "placements_total", "cluster", problems)
+    if placements is not None and placements == 0:
+        problems.append("the job stream never placed anything "
+                        "(vacuous run)")
+    storm = require(record, "storm_placements", "cluster", problems)
+    if storm is not None and storm == 0:
+        problems.append("no placement decisions during the failure "
+                        "storm (vacuous run)")
+    good = require(record, "storm_good_placement_frac", "cluster",
+                   problems)
+    if good is not None and good < 0.95:
+        problems.append(
+            f"only {good:.1%} of storm placements landed on good "
+            "hardware (floor: 95%)")
+    inventory = require(record, "inventory_updates_consumed", "cluster",
+                        problems)
+    if inventory is not None and inventory == 0:
+        problems.append("the scheduler never consumed an aggregator "
+                        "inventory rollup (composition broken)")
+    recomputes = require(record, "agg_full_recomputes", "cluster",
+                         problems)
+    if recomputes is not None and recomputes != 0:
+        problems.append(
+            f"{recomputes} aggregator full recomputes during the soak "
+            "(must stay O(delta))")
+
+    ref = load_reference(reference_path, "cluster", problems)
+    if ref is not None:
+        gate_regressions(
+            record, ref,
+            (("label_to_placement_p99_ms", "label-to-placement p99"),
+             ("recovery_p99_s", "recovery p99")),
+            slack, problems)
     return problems
 
 
@@ -447,6 +530,15 @@ def reference_dirty_p50_ms(path):
         doc = json.load(f)
     record = doc.get("parsed", doc)
     return record.get("steady_dirty_p50_ms")
+
+
+def run_mode(label, problems):
+    if problems:
+        for p in problems:
+            print(f"{label} bench gate FAILED: {p}", file=sys.stderr)
+        return 1
+    print(f"{label} bench gate OK")
+    return 0
 
 
 def main(argv=None):
@@ -493,6 +585,18 @@ def main(argv=None):
     # Virtual-clock latencies (seeded simulation): slack only absorbs
     # intentional model changes, like the watch gate.
     ap.add_argument("--aggregate-slack", type=float, default=0.5)
+    ap.add_argument("--cluster", metavar="RECORD.json",
+                    help="gate this end-to-end placement-quality soak "
+                         "record (scripts/cluster_soak.py --json)")
+    ap.add_argument("--cluster-reference",
+                    default=os.path.join(repo, "BENCH_cluster.json"))
+    # Virtual-clock again: the seeded sim reproduces byte-identically,
+    # so slack only absorbs intentional model/protocol changes.
+    ap.add_argument("--cluster-slack", type=float, default=0.5)
+    ap.add_argument("--cluster-placement-budget-ms", type=float,
+                    default=8000.0)
+    ap.add_argument("--cluster-recovery-budget-s", type=float,
+                    default=10.0)
     ap.add_argument("--plugin", metavar="RECORD.json",
                     help="gate this probe-plugin containment soak record "
                          "(scripts/plugin_soak.py --json)")
@@ -529,56 +633,32 @@ def main(argv=None):
         return 0
 
     if args.fleet:
-        problems = fleet_gate(args.fleet, args.fleet_reference,
-                              args.fleet_slack)
-        if problems:
-            for p in problems:
-                print(f"fleet bench gate FAILED: {p}", file=sys.stderr)
-            return 1
-        print("fleet bench gate OK")
-        return 0
+        return run_mode("fleet", fleet_gate(
+            args.fleet, args.fleet_reference, args.fleet_slack))
 
     if args.aggregate:
-        problems = aggregate_gate(args.aggregate,
-                                  args.aggregate_reference,
-                                  args.aggregate_slack)
-        if problems:
-            for p in problems:
-                print(f"aggregate bench gate FAILED: {p}",
-                      file=sys.stderr)
-            return 1
-        print("aggregate bench gate OK")
-        return 0
+        return run_mode("aggregate", aggregate_gate(
+            args.aggregate, args.aggregate_reference,
+            args.aggregate_slack))
+
+    if args.cluster:
+        return run_mode("cluster", cluster_gate(
+            args.cluster, args.cluster_reference, args.cluster_slack,
+            args.cluster_placement_budget_ms,
+            args.cluster_recovery_budget_s))
 
     if args.watch:
-        problems = watch_gate(args.watch, args.watch_reference,
-                              args.watch_slack)
-        if problems:
-            for p in problems:
-                print(f"watch bench gate FAILED: {p}", file=sys.stderr)
-            return 1
-        print("watch bench gate OK")
-        return 0
+        return run_mode("watch", watch_gate(
+            args.watch, args.watch_reference, args.watch_slack))
 
     if args.slice:
-        problems = slice_gate(args.slice, args.slice_reference,
-                              args.slice_slack)
-        if problems:
-            for p in problems:
-                print(f"slice bench gate FAILED: {p}", file=sys.stderr)
-            return 1
-        print("slice bench gate OK")
-        return 0
+        return run_mode("slice", slice_gate(
+            args.slice, args.slice_reference, args.slice_slack))
 
     if args.plugin:
-        problems = plugin_gate(args.plugin, args.plugin_reference,
-                               args.noop_budget_us, args.plugin_slack)
-        if problems:
-            for p in problems:
-                print(f"plugin bench gate FAILED: {p}", file=sys.stderr)
-            return 1
-        print("plugin bench gate OK")
-        return 0
+        return run_mode("plugin", plugin_gate(
+            args.plugin, args.plugin_reference, args.noop_budget_us,
+            args.plugin_slack))
 
     import bench
 

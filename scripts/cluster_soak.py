@@ -1,0 +1,1000 @@
+#!/usr/bin/env python3
+"""Cluster-in-a-box: the end-to-end placement-quality harness
+(ISSUE 14, ROADMAP open item #5 / BASELINE multi-slice target #5).
+
+Every prior soak proves one layer in isolation (fleet sink, slice
+coherence, plugin containment, aggregator rollups); THIS one proves the
+product: that the published google.com/tpu.* labels make placement
+measurably better under failure. It composes the existing simulation
+pieces on ONE seeded virtual clock:
+
+  N slices x M hosts of sim daemons   — per-host ground truth (perf
+      class, wedge, partition, preemption, daemon death) detected at
+      probe cadence and published as NodeFeature labels;
+  per-slice coordination               — a leader merges member reports
+      into an agreed verdict (healthy-hosts / degraded / class = worst
+      member), republished by every live member; leader death fails
+      over at lease expiry; a partitioned member CANNOT write its own
+      demotion (the PR 12 tradeoff), so its object holds stale-good
+      labels until heal;
+  the sharded sim apiserver            — SSA writes, collection watch
+      fan-out, write brownouts (server-alive pacing: publishes defer
+      and retry, reports do NOT age out — the PR 9 orphan rule);
+  the parity-pinned SimAggregator      — tpufd.agg rollups feeding the
+      scheduler's capacity-by-class admission gate;
+  the label-driven toy scheduler       — tpufd.cluster.SimScheduler,
+      which sees ONLY published labels (never sim ground truth) and
+      places a synthetic job stream.
+
+A seeded failure schedule (tpufd.cluster grammar; see
+docs/placement-harness.md) drives chip degradation, host wedges, slice
+partitions, preemption notices, leader kills, and apiserver brownouts
+while the harness measures the headline numbers:
+
+  label-to-placement latency  — ground-truth event -> the scheduler's
+      placeable() verdict for the victim flips (it stops landing jobs
+      there); p99 gated absolutely and vs BENCH_cluster.json;
+  jobs landed on bad hardware — placements onto ground-truth-bad hosts
+      AFTER the per-failure-class convergence window: must be ZERO
+      (inside the window is physics — labels propagate at probe +
+      agreement + publish cadence — and is recorded, not gated);
+  recovery time               — heal event -> placeable() again, plus
+      the first job actually landing back;
+  decisions under fire        — placement decisions served per second
+      during the dense failure storm, and the fraction that landed on
+      good hardware.
+
+Determinism is an acceptance invariant: the whole simulation is run
+TWICE with the same seed and the two records must serialize
+byte-identically (no wall clock, no ambient randomness, sorted
+iteration everywhere); bench_gate.py --cluster gates the committed
+BENCH_cluster.json on all of the above.
+
+Usage:
+  python3 scripts/cluster_soak.py [--slices 12] [--hosts 4] [--seed 14]
+      [--json out] [--quick] [--schedule FILE] [--once]
+"""
+
+import argparse
+import hashlib
+import json
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+from tpufd import cluster as clusterlib  # noqa: E402
+from tpufd import sink as sinklib  # noqa: E402
+from tpufd.fakes.simnet import (  # noqa: E402
+    SimAggregator, SimClock, percentile)
+
+PREFIX = "google.com/"
+
+# Per-failure-class convergence windows (seconds): the label pipeline's
+# worst-case detection + agreement + publish budget for each class.
+# Placements onto the victim INSIDE the window are excused (recorded as
+# bad_placements_within_window); one placement AFTER it is a gate
+# failure. The budget arithmetic lives in docs/placement-harness.md.
+CONVERGENCE_WINDOW_S = {
+    "degrade": 3.0,    # probe tick (<=1s) + publish + wire
+    "preempt": 2.0,    # metadata fast path + publish + wire
+    "wedge": 4.5,      # report ages out (agreement 2s) + verdict + pub
+    "partition": 7.0,  # agreement + possible leader failover (lease 3s)
+}
+# A brownout freezes label flow; failures overlapping one get their
+# window extended past the brownout's end by this much.
+BROWNOUT_GRACE_S = 2.0
+
+PROBE_INTERVAL_S = 1.0
+AGREEMENT_S = 2.0
+LEASE_S = 3.0
+AGG_DEBOUNCE_S = 1.0
+AGG_LEASE_S = 30.0
+JOB_FAIL_DETECT_S = 1.0
+
+
+# ---- the apiserver, as the cluster sees it --------------------------------
+
+
+class ClusterApiServer:
+    """Sharded store + collection-watch fan-out to MANY watchers (the
+    aggregator and the scheduler), plus write brownouts. Also speaks
+    the AggSimServer surface (objects / count_agg / watcher /
+    output_writes) so the stock SimAggregator runs against it."""
+
+    def __init__(self, clock, rng, shards):
+        self.clock = clock
+        self.rng = rng
+        self.shards = shards
+        self.objects = {}          # node -> labels
+        self.watchers = []         # objects with .on_event(t, node, labels)
+        self.by_verb = {}
+        self.shard_buckets = {}    # (shard, sec) -> writes
+        self.brownout_until = 0.0
+        self.brownout_rejected = 0
+        self.agg_requests = {}     # int(t) -> n (SimAggregator surface)
+        self.output_writes = []    # (t, labels) rollup applies
+
+    def _wire_latency(self):
+        return self.rng.uniform(0.0005, 0.003)
+
+    def shard_of(self, name):
+        return sinklib.fnv1a64(name) % self.shards
+
+    def _count(self, t, verb, name=None):
+        self.by_verb[verb] = self.by_verb.get(verb, 0) + 1
+        if name is not None:
+            key = (self.shard_of(name), int(t))
+            self.shard_buckets[key] = self.shard_buckets.get(key, 0) + 1
+
+    def count_agg(self, t, verb):
+        self.agg_requests[int(t)] = self.agg_requests.get(int(t), 0) + 1
+        self._count(t, verb)
+
+    @property
+    def watcher(self):
+        return None
+
+    @watcher.setter
+    def watcher(self, w):
+        # SimAggregator.sync() assigns server.watcher = self; here that
+        # ENROLLS it next to the scheduler instead of replacing it.
+        self.add_watcher(w)
+
+    def add_watcher(self, w):
+        if w not in self.watchers:
+            self.watchers.append(w)
+
+    def brownout(self, t, secs):
+        self.brownout_until = max(self.brownout_until, t + secs)
+
+    def brownout_active(self, t):
+        return t < self.brownout_until
+
+    def daemon_apply(self, t, node, labels):
+        """A daemon's SSA write: store + watch fan-out. Brownout pacing
+        is the CALLER's contract, not this method's — SimHost._publish
+        pre-checks brownout_active and schedules its own retry (keeping
+        the publish_pending slot so later dirtying events ride it), so
+        a write that reaches here always lands. A silent drop here
+        would lose the host's labels with no retry and no watch event —
+        exactly the stale-store lie the harness exists to catch."""
+        self._count(t, "APPLY", node)
+        assert not self.brownout_active(t), \
+            "daemon_apply during a brownout: the caller owns pacing"
+        self.objects[node] = dict(labels)
+        for w in self.watchers:
+            self.clock.schedule(
+                t + self._wire_latency(),
+                lambda now, w=w, n=node, lb=dict(labels):
+                    w.on_event(now, n, lb))
+
+
+class ClusterAggregator(SimAggregator):
+    """The stock SimAggregator plus inventory delivery: every rollup
+    apply is fanned out to the scheduler (one more collection watcher,
+    watching the output object) after wire latency."""
+
+    def __init__(self, server, clock, debounce_s, lease_s, deliver):
+        super().__init__(server, clock, debounce_s, lease_s)
+        self.deliver = deliver
+
+    def _flush(self, t):
+        if self.server.brownout_active(t):
+            # The rollup APPLY is a write like any other: a browned-out
+            # server paces it with Retry-After, so the inventory channel
+            # freezes during a brownout exactly like the per-node
+            # labels do. Keep the flush slot (flush_scheduled stays
+            # True, later dirtying events ride this retry) and retry at
+            # host pacing cadence.
+            self.server.brownout_rejected += 1
+            self.clock.schedule(t + self.server.rng.uniform(0.6, 1.4),
+                                lambda now: self._flush(now))
+            return
+        before = len(self.server.output_writes)
+        super()._flush(t)
+        if len(self.server.output_writes) > before:
+            _, labels = self.server.output_writes[-1]
+            self.clock.schedule(
+                t + self.server._wire_latency(),
+                lambda now, lb=dict(labels): self.deliver(now, lb))
+
+
+# ---- hosts + slices (the simulated daemons) -------------------------------
+
+
+class SimHost:
+    """One host's daemon: ground truth on the left, published labels on
+    the right, a probe/publish pipeline in between. The scheduler NEVER
+    sees the gt_* fields — only what publish() lands in the store."""
+
+    def __init__(self, server, clock, rng, slice_ref, member_idx):
+        self.server = server
+        self.clock = clock
+        self.rng = rng
+        self.slice = slice_ref
+        self.member_idx = member_idx
+        self.name = f"sim-s{slice_ref.idx:02d}-h{member_idx:02d}"
+        self.chips = 8
+        self.base_class = "gold" if rng.random() < 0.7 else "silver"
+        self.gt_degraded = False
+        self.gt_wedged = False
+        self.gt_partitioned = False
+        self.gt_preempting = False
+        self.gt_alive = True
+        self.publish_pending = False
+
+    def reachable(self):
+        """Can this daemon talk to the apiserver / blackboard at all?
+        (A brownout is NOT unreachability: server-alive pacing.)"""
+        return self.gt_alive and not self.gt_wedged and \
+            not self.gt_partitioned
+
+    def gt_bad(self):
+        """Is the HARDWARE unusable for a job right now? (A dead daemon
+        with healthy chips is not bad hardware — leader-kill drills the
+        label layer, not the silicon.)"""
+        return (self.gt_degraded or self.gt_wedged or
+                self.gt_partitioned or self.gt_preempting)
+
+    def effective_class(self):
+        return "degraded" if self.gt_degraded else self.base_class
+
+    def desired_labels(self):
+        v = self.slice.adopted_verdict
+        labels = {
+            PREFIX + "tfd.node": self.name,
+            PREFIX + "tpu.count": str(self.chips),
+            PREFIX + "tpu.accelerator-type": "v5litepod-32",
+            PREFIX + "tpu.perf.class": self.effective_class(),
+            clusterlib.SLICE_ID: self.slice.slice_id,
+            clusterlib.SLICE_DEGRADED:
+                "true" if v["degraded"] else "false",
+            clusterlib.SLICE_CLASS: v["class"],
+            clusterlib.SLICE_HEALTHY_HOSTS: str(v["healthy_hosts"]),
+        }
+        if self.gt_preempting:
+            labels[clusterlib.LIFECYCLE_PREEMPT] = "true"
+        return labels
+
+    def mark_dirty(self, t):
+        """Something this daemon publishes changed: render + write after
+        a short detection/render latency. Coalesces like the real
+        pass loop — one in-flight publish at a time."""
+        if not self.reachable() or self.publish_pending:
+            return
+        self.publish_pending = True
+        self.clock.schedule(t + self.rng.uniform(0.1, 0.5),
+                            lambda now: self._publish(now))
+
+    def _publish(self, now):
+        if not self.reachable():
+            self.publish_pending = False  # re-marked on heal
+            return
+        if self.server.brownout_active(now):
+            # Server-directed pacing: retry, keep the pending slot so
+            # later dirtying events ride this retry.
+            self.server.brownout_rejected += 1
+            self.clock.schedule(now + self.rng.uniform(0.6, 1.4),
+                                lambda t: self._publish(t))
+            return
+        self.publish_pending = False
+        self.server.daemon_apply(now, self.name, self.desired_labels())
+
+    # ---- ground-truth injections (the schedule's ops) ---------------------
+
+    def probe_detect(self, t):
+        """A ground-truth change this daemon can SELF-detect (perf skew,
+        preemption notice): lands at the next probe round, then reports
+        to the slice leader and republishes."""
+        delay = self.rng.uniform(0.2, PROBE_INTERVAL_S)
+        self.clock.schedule(t + delay, self._detected)
+
+    def _detected(self, now):
+        if not self.gt_alive:
+            return
+        self.mark_dirty(now)
+        self.slice.on_report(now, self)
+
+
+class SimSlice:
+    """Per-slice coordination: a lease-elected leader merges member
+    reports into the adopted verdict; every live member republishes the
+    agreed labels. Mirrors the PR 9/12 protocol shape (agreement
+    timeout for stale reports, lease-expiry failover, preempting member
+    -> proactive degraded) at simulation fidelity."""
+
+    def __init__(self, server, clock, rng, idx, host_count):
+        self.server = server
+        self.clock = clock
+        self.rng = rng
+        self.idx = idx
+        self.slice_id = f"slice-{idx:04d}"
+        self.members = [SimHost(server, clock, rng, self, h)
+                        for h in range(host_count)]
+        self.leader_idx = 0
+        self.failover_pending = False
+        self.leader_transitions = 0
+        self.adopted_verdict = self._compute_verdict()
+
+    def leader(self):
+        return self.members[self.leader_idx]
+
+    def _compute_verdict(self):
+        healthy = 0
+        worst_rank = 99
+        worst = "gold"
+        for m in self.members:
+            if not m.reachable():
+                continue
+            rank = clusterlib.CLASS_RANK.get(m.effective_class(), 0)
+            if rank < worst_rank:
+                worst_rank, worst = rank, m.effective_class()
+            if not m.gt_degraded and not m.gt_preempting:
+                healthy += 1
+        return {
+            "healthy_hosts": healthy,
+            # A missing/degraded/preempting member degrades the whole
+            # slice verdict: multi-host workloads need every host, and
+            # a preemption notice is a PROACTIVE demotion (PR 12).
+            "degraded": healthy < len(self.members),
+            "class": worst if worst_rank < 99 else "degraded",
+        }
+
+    def on_report(self, t, _member):
+        """A fresh member report landed on the blackboard: the leader
+        folds it on its next coordination tick."""
+        self.clock.schedule(t + self.rng.uniform(0.1, 0.5),
+                            lambda now: self.recompute(now))
+
+    def on_member_unreachable(self, t):
+        """A member stopped refreshing its report (wedge / partition /
+        death): the leader notices when the report ages past the
+        agreement timeout."""
+        self.clock.schedule(
+            t + AGREEMENT_S + self.rng.uniform(0.1, 0.5),
+            lambda now: self.recompute(now))
+        if not self.leader().reachable():
+            self._schedule_failover(t)
+
+    def _schedule_failover(self, t):
+        if self.failover_pending:
+            return
+        self.failover_pending = True
+        self.clock.schedule(t + LEASE_S, lambda now: self._failover(now))
+
+    def _failover(self, now):
+        self.failover_pending = False
+        if self.leader().reachable():
+            return  # old leader healed inside its lease: no transition
+        for idx, m in enumerate(self.members):
+            if m.reachable():
+                self.leader_idx = idx
+                self.leader_transitions += 1
+                self.recompute(now)
+                return
+        # Nobody reachable (full-slice partition): the next heal's
+        # report path re-triggers election via on_report/recompute.
+        self._schedule_failover(now)
+
+    def recompute(self, now):
+        if not self.leader().reachable():
+            self._schedule_failover(now)
+            return
+        verdict = self._compute_verdict()
+        if verdict == self.adopted_verdict:
+            return
+        self.adopted_verdict = verdict
+        # Every live member republishes the agreed labels (small skew:
+        # the members' own pass loops).
+        for m in self.members:
+            if m.reachable():
+                m.mark_dirty(now + self.rng.uniform(0.0, 0.3))
+
+
+# ---- failure schedules ----------------------------------------------------
+
+
+def default_schedule_text(slices, hosts):
+    """The full seeded chaos timeline: one serialized drill per failure
+    class, then a dense storm, then staggered heal-all. Written in the
+    tpufd.cluster grammar so the soak exercises the same parser the
+    docs teach. Needs >= 8 slices x >= 4 hosts."""
+    if slices < 8 or hosts < 4:
+        raise ValueError("full schedule wants >= 8 slices x >= 4 hosts "
+                         "(use --quick below that)")
+    return f"""\
+# phase A — one drill per failure class, serialized
+20   degrade        s0/h1
+30   heal           s0/h1
+24   preempt        s1/h2
+34   preempt-clear  s1/h2
+28   wedge          s2/h0
+40   unwedge        s2/h0
+36   leader-kill    s3
+48   leader-restart s3
+44   partition      s4 hosts=0-1
+58   heal-partition s4
+52   brownout       apiserver secs=5
+# phase B — the storm: every class at once, then staggered heals
+62   degrade        s5/h3
+62.4 degrade        s6/h0
+62.8 wedge          s7/h1
+63.2 preempt        s0/h3
+63.6 partition      s1 hosts=0-1
+64   leader-kill    s2
+66   brownout       apiserver secs=4
+68   degrade        s3/h2
+78   heal           s5/h3
+79   heal           s6/h0
+80   unwedge        s7/h1
+81   preempt-clear  s0/h3
+82   heal-partition s1
+83   leader-restart s2
+84   heal           s3/h2
+"""
+
+
+def quick_schedule_text(slices, hosts):
+    """Compressed drill set for the CI smoke: every op class once on a
+    4-slice topology, no long storm. Needs >= 4 slices x >= 3 hosts."""
+    if slices < 4 or hosts < 3:
+        raise ValueError("quick schedule wants >= 4 slices x >= 3 hosts")
+    return """\
+10 degrade        s0/h1
+18 heal           s0/h1
+12 wedge          s1/h0
+22 unwedge        s1/h0
+14 preempt        s2/h1
+20 preempt-clear  s2/h1
+16 leader-kill    s3
+26 leader-restart s3
+24 partition      s0 hosts=0-1
+32 heal-partition s0
+28 brownout       apiserver secs=3
+"""
+
+
+# Failures closer together than this are one storm burst; the
+# decisions-under-fire metrics cover the LARGEST such burst, not the
+# whole chaos timeline — averaging the calm, serialized phase-A drills
+# into the storm numbers would dilute a regression that only shows
+# when failure classes overlap.
+STORM_GAP_S = 3.0
+
+
+def storm_window(events):
+    """The dense-failure window the decisions-under-fire metrics cover:
+    the largest burst of failures with consecutive gaps <= STORM_GAP_S
+    (ties -> the later burst), through the last heal at or after the
+    burst starts — the storm isn't over until its victims healed."""
+    fails = sorted(e.at for e in events
+                   if e.op in ("degrade", "wedge", "preempt", "partition",
+                               "leader-kill", "brownout"))
+    heals = [e.at for e in events
+             if e.op in ("heal", "unwedge", "preempt-clear",
+                         "heal-partition", "leader-restart")]
+    if not fails or not heals:
+        return (0.0, 0.0)
+    bursts = [[fails[0]]]
+    for at in fails[1:]:
+        if at - bursts[-1][-1] <= STORM_GAP_S:
+            bursts[-1].append(at)
+        else:
+            bursts.append([at])
+    burst = max(bursts, key=lambda b: (len(b), b[0]))
+    tail = [at for at in heals if at >= burst[0]]
+    return (burst[0], max(tail)) if tail else (0.0, 0.0)
+
+
+# ---- the harness ----------------------------------------------------------
+
+
+class Harness:
+    """Owns the job stream, the ground-truth-vs-placement scoring, and
+    the latency trackers. The ONLY component allowed to look at both
+    sides (ground truth and labels) — the scheduler sees labels only."""
+
+    def __init__(self, clock, rng, sched, hosts_by_name, arrival_dt,
+                 job_classes=("any", "silver", "any", "gold", "silver")):
+        self.clock = clock
+        self.rng = rng
+        self.sched = sched
+        self.hosts = hosts_by_name
+        self.arrival_dt = arrival_dt
+        self.job_classes = job_classes
+        self.queue = []            # FIFO of Job
+        self.jobs = {}             # job_id -> Job
+        self.attempt = {}          # job_id -> placement generation
+        self.next_job = 0
+        self.drain_scheduled = False
+        # scoring
+        self.placement_log = []    # (t, job_id, node, gt_bad, excused)
+        self.excused_until = {}    # node -> t
+        self.down_track = {}       # node -> (t0, op)
+        self.up_track = {}         # node -> (t0, op)
+        self.latency_ms_by_op = {}
+        self.recovery_s_by_op = {}
+        self.land_after_heal = {}  # node -> heal t0 (first-landing watch)
+        self.first_land_s = []
+        self.bad_within = 0
+        self.bad_after = 0
+        self.violations = []
+        self.jobs_submitted = 0
+        self.jobs_completed = 0
+        self.jobs_failed_bad_hw = 0
+        self.jobs_requeued = 0
+        self.inventory_updates = 0
+        self.sched_events = 0
+
+    # ---- label-side hooks (wired as watch delivery) -----------------------
+
+    def on_label_event(self, now, node, labels):
+        self.sched_events += 1
+        self.sched.on_event(node, labels)
+        self._after_view_change(now)
+
+    def on_inventory(self, now, labels):
+        self.inventory_updates += 1
+        self.sched.on_inventory(labels)
+        self._schedule_drain(now)
+
+    def _after_view_change(self, now):
+        # Resolve latency trackers: a tracked-down node the scheduler
+        # now refuses = the label pipeline delivered; a tracked-up node
+        # it accepts again = recovery. One blocked-set scan covers
+        # every tracked node against this view.
+        blocked = clusterlib.slice_blocked_ids(self.sched.view)
+        for node in sorted(self.down_track):
+            if not self.sched.placeable(node, blocked):
+                t0, op = self.down_track.pop(node)
+                self.latency_ms_by_op.setdefault(op, []).append(
+                    (now - t0) * 1000.0)
+        for node in sorted(self.up_track):
+            if self.sched.placeable(node, blocked):
+                t0, op = self.up_track.pop(node)
+                self.recovery_s_by_op.setdefault(op, []).append(now - t0)
+                self.land_after_heal[node] = t0
+        # Label-driven eviction (preempt drain, slice demotion): jobs on
+        # now-unplaceable nodes re-queue.
+        for job_id in self.sched.drain_ineligible():
+            self._requeue(job_id)
+        self._schedule_drain(now)
+
+    # ---- the job stream ---------------------------------------------------
+
+    def start_arrivals(self, t0, t_end):
+        t = t0
+        i = 0
+        while t < t_end:
+            self.clock.schedule(t, lambda now: self._arrive(now))
+            i += 1
+            t = t0 + i * self.arrival_dt
+
+    def _arrive(self, now):
+        job_id = f"job-{self.next_job:05d}"
+        wanted = self.job_classes[self.next_job % len(self.job_classes)]
+        self.next_job += 1
+        job = clusterlib.Job(job_id, wanted, chips=4,
+                             duration_s=self.rng.uniform(2.0, 5.0))
+        self.jobs[job_id] = job
+        self.jobs_submitted += 1
+        self.queue.append(job)
+        self._schedule_drain(now)
+
+    def _requeue(self, job_id):
+        job = self.jobs.get(job_id)
+        if job is None:
+            return
+        self.attempt[job_id] = self.attempt.get(job_id, 0) + 1
+        self.jobs_requeued += 1
+        self.queue.append(job)
+
+    def _schedule_drain(self, now):
+        if self.drain_scheduled or not self.queue:
+            return
+        self.drain_scheduled = True
+        self.clock.schedule(now + 0.05, lambda t: self._drain(t))
+
+    def _drain(self, now):
+        self.drain_scheduled = False
+        while self.queue:
+            job = self.queue[0]
+            decision = self.sched.place(job, now)
+            if not decision.placed:
+                # Head-of-line: retry the whole queue on the next
+                # placement-relevant event or the periodic tick.
+                self.clock.schedule(now + 0.5,
+                                    lambda t: self._schedule_drain(t))
+                return
+            self.queue.pop(0)
+            self._score_placement(now, job, decision.node)
+            gen = self.attempt.get(job.job_id, 0)
+            self.clock.schedule(
+                now + job.duration_s,
+                lambda t, j=job.job_id, g=gen: self._complete(t, j, g))
+
+    def _score_placement(self, now, job, node):
+        host = self.hosts[node]
+        bad = host.gt_bad()
+        excused = now <= self.excused_until.get(node, -1.0)
+        self.placement_log.append((now, job.job_id, node, bad, excused))
+        if bad:
+            if excused:
+                self.bad_within += 1
+            else:
+                self.bad_after += 1
+                self.violations.append(
+                    {"t": round(now, 3), "job": job.job_id, "node": node})
+        heal_t0 = self.land_after_heal.pop(node, None)
+        if heal_t0 is not None:
+            self.first_land_s.append(now - heal_t0)
+
+    def _complete(self, now, job_id, gen):
+        if self.attempt.get(job_id, 0) != gen:
+            return  # superseded: the job was evicted/failed and re-ran
+        if self.sched.node_of(job_id) is None:
+            return
+        self.sched.release(job_id)
+        self.jobs.pop(job_id, None)
+        self.jobs_completed += 1
+        self._schedule_drain(now)
+
+    def fail_jobs_on(self, now, node):
+        """Hardware turned bad under running jobs: they fail after the
+        runtime's own detection delay and re-queue."""
+        doomed = sorted(j for j, (n, _) in self.sched.placements.items()
+                        if n == node)
+        def fail(t, doomed=tuple(doomed)):
+            for job_id in doomed:
+                if self.sched.node_of(job_id) == node:
+                    self.sched.release(job_id)
+                    self.jobs_failed_bad_hw += 1
+                    self._requeue(job_id)
+            self._schedule_drain(t)
+        self.clock.schedule(now + JOB_FAIL_DETECT_S, fail)
+
+    # ---- failure bookkeeping ---------------------------------------------
+
+    def note_down(self, now, node, op, server):
+        window = CONVERGENCE_WINDOW_S[op]
+        until = now + window
+        if server.brownout_active(now):
+            until = max(until,
+                        server.brownout_until + BROWNOUT_GRACE_S)
+        self.excused_until[node] = until
+        self.down_track[node] = (now, op)
+        # A refail before the previous heal's recovery converged cancels
+        # that heal's tracking: the node is down again, so neither its
+        # recovery latency nor its first-landing watch can resolve — a
+        # stale entry would be overwritten by the NEXT heal (losing a
+        # tracked heal) or attribute a later landing to the old t0.
+        self.up_track.pop(node, None)
+        self.land_after_heal.pop(node, None)
+        self.fail_jobs_on(now, node)
+
+    def note_up(self, now, node, op):
+        self.excused_until.pop(node, None)
+        self.down_track.pop(node, None)  # heal raced the label pipeline
+        self.up_track[node] = (now, op)
+
+    def extend_windows_for_brownout(self, now, brownout_until):
+        """A brownout freezes label flow for every convergence still in
+        flight — not just failures injected after it started: extend
+        every open window past the brownout's end."""
+        for node, until in sorted(self.excused_until.items()):
+            if until > now:
+                self.excused_until[node] = max(
+                    until, brownout_until + BROWNOUT_GRACE_S)
+
+
+def apply_event(ev, now, server, slices, harness):
+    """Dispatches one parsed ScheduleEvent into ground truth + the
+    harness's scoring trackers."""
+    if ev.op == "brownout":
+        server.brownout(now, float(ev.args.get("secs", "5")))
+        harness.extend_windows_for_brownout(now, server.brownout_until)
+        return
+    sl = slices[ev.slice_idx]
+    if ev.op in clusterlib.HOST_OPS:
+        host = sl.members[ev.host_idx]
+        if ev.op == "degrade":
+            host.gt_degraded = True
+            harness.note_down(now, host.name, "degrade", server)
+            host.probe_detect(now)
+        elif ev.op == "heal":
+            host.gt_degraded = False
+            harness.note_up(now, host.name, "degrade")
+            host.probe_detect(now)
+        elif ev.op == "preempt":
+            host.gt_preempting = True
+            harness.note_down(now, host.name, "preempt", server)
+            host.probe_detect(now)
+        elif ev.op == "preempt-clear":
+            host.gt_preempting = False
+            harness.note_up(now, host.name, "preempt")
+            host.probe_detect(now)
+        elif ev.op == "wedge":
+            host.gt_wedged = True
+            harness.note_down(now, host.name, "wedge", server)
+            sl.on_member_unreachable(now)
+        elif ev.op == "unwedge":
+            host.gt_wedged = False
+            harness.note_up(now, host.name, "wedge")
+            host.probe_detect(now)
+        return
+    if ev.op == "leader-kill":
+        sl.leader().gt_alive = False
+        sl.on_member_unreachable(now)
+    elif ev.op == "leader-restart":
+        for m in sl.members:
+            if not m.gt_alive:
+                m.gt_alive = True
+                m.probe_detect(now)
+    elif ev.op == "partition":
+        for h in clusterlib.parse_host_range(ev.args, len(sl.members)):
+            member = sl.members[h]
+            member.gt_partitioned = True
+            harness.note_down(now, member.name, "partition", server)
+        sl.on_member_unreachable(now)
+    elif ev.op == "heal-partition":
+        for m in sl.members:
+            if m.gt_partitioned:
+                m.gt_partitioned = False
+                harness.note_up(now, m.name, "partition")
+                m.probe_detect(now)
+
+
+# ---- one full simulation --------------------------------------------------
+
+
+def run_sim(args, schedule_text):
+    rng = random.Random(args.seed)
+    clock = SimClock()
+    server = ClusterApiServer(clock, rng, shards=args.shards)
+    slices = [SimSlice(server, clock, rng, i, args.hosts)
+              for i in range(args.slices)]
+    hosts_by_name = {m.name: m for sl in slices for m in sl.members}
+
+    sched = clusterlib.SimScheduler()
+    harness = Harness(clock, rng, sched, hosts_by_name,
+                      arrival_dt=1.0 / args.job_rate)
+    aggregator = ClusterAggregator(
+        server, clock, AGG_DEBOUNCE_S, AGG_LEASE_S,
+        deliver=harness.on_inventory)
+
+    events = clusterlib.parse_schedule(schedule_text)
+    storm_start, storm_end = storm_window(events)
+    t_end = max(e.at for e in events) + args.drain_secs
+
+    # Rollout: hosts publish their first labels staggered across 5s
+    # (hash-of-name phase, the fleet desync idiom).
+    for name in sorted(hosts_by_name):
+        host = hosts_by_name[name]
+        clock.schedule(sinklib.hash_unit(name) * 5.0,
+                       lambda now, h=host: h.mark_dirty(now))
+    # Aggregator elects + LISTs once at t=8, then watches.
+    aggregator.start(0.0)
+    clock.schedule(8.0, lambda now: aggregator.sync(now))
+
+    # Scheduler bootstrap at t=10: LIST (snapshot the store), then
+    # watch (enrolled as a collection watcher).
+    class SchedWatch:
+        def on_event(self, now, node, labels):
+            harness.on_label_event(now, node, labels)
+
+    def sched_bootstrap(now):
+        for node in sorted(server.objects):
+            sched.on_event(node, server.objects[node])
+        server.add_watcher(SchedWatch())
+
+    clock.schedule(10.0, sched_bootstrap)
+
+    # Jobs from t=12 to the end of the drain window.
+    harness.start_arrivals(12.0, t_end - 5.0)
+
+    for ev in events:
+        clock.schedule(
+            ev.at,
+            lambda now, ev=ev: apply_event(ev, now, server, slices,
+                                           harness))
+    clock.run(t_end)
+
+    # ---- assemble the record ---------------------------------------------
+    down_lat = [ms for op in sorted(harness.latency_ms_by_op)
+                for ms in harness.latency_ms_by_op[op]]
+    recovery = [s for op in sorted(harness.recovery_s_by_op)
+                for s in harness.recovery_s_by_op[op]]
+    storm_placements = [
+        (t, bad) for (t, _, _, bad, _) in harness.placement_log
+        if storm_start <= t <= storm_end]
+    storm_good = sum(1 for _, bad in storm_placements if not bad)
+    storm_secs = max(1e-9, storm_end - storm_start)
+    unplaceable = sorted(n for n in hosts_by_name
+                         if not sched.placeable(n))
+    failures_by_op = {}
+    for ev in events:
+        failures_by_op[ev.op] = failures_by_op.get(ev.op, 0) + 1
+
+    record = {
+        "mode": "cluster",
+        "seed": args.seed,
+        "slices": args.slices,
+        "hosts_per_slice": args.hosts,
+        "nodes": args.slices * args.hosts,
+        "shards": args.shards,
+        "job_rate_per_s": args.job_rate,
+        "schedule_events": {op: failures_by_op[op]
+                            for op in sorted(failures_by_op)},
+        "jobs_submitted": harness.jobs_submitted,
+        "jobs_completed": harness.jobs_completed,
+        "jobs_failed_on_bad_hw": harness.jobs_failed_bad_hw,
+        "jobs_requeued": harness.jobs_requeued,
+        "placements_total": len(harness.placement_log),
+        "decisions_total": sched.decisions,
+        "no_candidate_total": sched.no_candidate_total,
+        "no_capacity_total": sched.no_capacity_total,
+        "scheduler_events": harness.sched_events,
+        "inventory_updates_consumed": harness.inventory_updates,
+        "agg_full_recomputes": aggregator.store.full_recomputes,
+        "brownout_deferred_writes": server.brownout_rejected,
+        "label_to_placement_p50_ms": round(percentile(down_lat, 50), 3),
+        "label_to_placement_p99_ms": round(percentile(down_lat, 99), 3),
+        "label_to_placement_by_op": {
+            op: {"n": len(v),
+                 "p99_ms": round(percentile(v, 99), 3)}
+            for op, v in sorted(harness.latency_ms_by_op.items())},
+        "failures_tracked": (len(down_lat) + len(harness.down_track)),
+        "failures_converged": len(down_lat),
+        "bad_placements_within_window": harness.bad_within,
+        "bad_placements_after_window": harness.bad_after,
+        "violations": harness.violations[:10],
+        "recovery_p50_s": round(percentile(recovery, 50), 3),
+        "recovery_p99_s": round(percentile(recovery, 99), 3),
+        "heals_tracked": len(recovery) + len(harness.up_track),
+        "heals_converged": len(recovery),
+        "recovery_first_land_p99_s": round(
+            percentile(harness.first_land_s, 99), 3),
+        "recovery_first_land_n": len(harness.first_land_s),
+        "storm_window_s": round(storm_secs, 3),
+        "storm_placements": len(storm_placements),
+        "storm_decisions_per_sec": round(
+            len(storm_placements) / storm_secs, 3),
+        "storm_good_placement_frac": round(
+            storm_good / len(storm_placements), 4)
+            if storm_placements else 0.0,
+        "final_unplaceable_nodes": len(unplaceable),
+        "final_queue_len": len(harness.queue),
+        "leader_transitions": sum(sl.leader_transitions for sl in slices),
+        "by_verb": {k: server.by_verb[k]
+                    for k in sorted(server.by_verb)},
+    }
+    return record
+
+
+def check_record(record):
+    """The soak's own acceptance invariants (bench_gate re-checks the
+    committed record; this guards a fresh run)."""
+    problems = []
+    if record["bad_placements_after_window"] != 0:
+        problems.append(
+            f"{record['bad_placements_after_window']} job(s) placed on "
+            f"known-bad hardware AFTER the convergence window "
+            f"(e.g. {record['violations'][:3]}) — the labels failed "
+            "placement")
+    if record["failures_converged"] != record["failures_tracked"]:
+        problems.append(
+            f"only {record['failures_converged']} of "
+            f"{record['failures_tracked']} injected failures ever "
+            "reached the scheduler as a placeability flip")
+    if record["heals_converged"] != record["heals_tracked"]:
+        problems.append(
+            f"only {record['heals_converged']} of "
+            f"{record['heals_tracked']} heals made the victim "
+            "placeable again")
+    if record["final_unplaceable_nodes"] != 0:
+        problems.append(
+            f"{record['final_unplaceable_nodes']} node(s) still "
+            "unplaceable after heal-all + drain")
+    if record["placements_total"] == 0:
+        problems.append("the job stream never placed anything")
+    if record["storm_placements"] == 0:
+        problems.append("no placement decisions during the storm window")
+    if record["agg_full_recomputes"] != 0:
+        problems.append(
+            f"{record['agg_full_recomputes']} aggregator full "
+            "recomputes (must stay O(delta))")
+    if record["inventory_updates_consumed"] == 0:
+        problems.append("the scheduler never consumed an inventory "
+                        "rollup (the aggregator is not composed in)")
+    return problems
+
+
+def canonical_bytes(record):
+    return json.dumps(record, sort_keys=True,
+                      separators=(",", ":")).encode()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--slices", type=int, default=12)
+    ap.add_argument("--hosts", type=int, default=4,
+                    help="hosts per slice")
+    ap.add_argument("--seed", type=int, default=14)
+    ap.add_argument("--shards", type=int, default=8)
+    ap.add_argument("--job-rate", type=float, default=16.0,
+                    help="synthetic job arrivals per virtual second")
+    ap.add_argument("--drain-secs", type=float, default=25.0,
+                    help="virtual seconds to run past the last heal")
+    ap.add_argument("--schedule", metavar="FILE",
+                    help="failure schedule (tpufd.cluster grammar) "
+                         "instead of the built-in one")
+    ap.add_argument("--json", help="write the soak record here")
+    ap.add_argument("--quick", action="store_true",
+                    help="4x3 topology, compressed schedule (CI smoke)")
+    ap.add_argument("--once", action="store_true",
+                    help="skip the determinism double-run")
+    args = ap.parse_args(argv)
+
+    if args.quick:
+        args.slices = min(args.slices, 4)
+        args.hosts = min(args.hosts, 3)
+        args.job_rate = min(args.job_rate, 4.0)
+        args.drain_secs = min(args.drain_secs, 15.0)
+
+    if args.schedule:
+        with open(args.schedule) as f:
+            schedule_text = f.read()
+    elif args.quick:
+        schedule_text = quick_schedule_text(args.slices, args.hosts)
+    else:
+        schedule_text = default_schedule_text(args.slices, args.hosts)
+
+    record = run_sim(args, schedule_text)
+    problems = check_record(record)
+
+    # ---- determinism pin: the SAME seed must reproduce the record
+    # byte-for-byte (virtual clock, seeded rng, sorted iteration — any
+    # wall-clock or hash-order leak shows up here).
+    if args.once:
+        record["determinism_ok"] = None
+    else:
+        second = run_sim(args, schedule_text)
+        record["determinism_ok"] = (
+            canonical_bytes(record) == canonical_bytes(second))
+        if not record["determinism_ok"]:
+            a, b = canonical_bytes(record), canonical_bytes(second)
+            problems.append(
+                "two runs of the same seed diverged "
+                f"(len {len(a)} vs {len(b)}) — the simulation leaked "
+                "nondeterminism")
+    record["record_sha256"] = hashlib.sha256(
+        canonical_bytes({k: v for k, v in record.items()
+                         if k not in ("determinism_ok",
+                                      "record_sha256")})).hexdigest()
+
+    print(json.dumps(record))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(record, f, indent=1, sort_keys=True)
+    if problems:
+        for p in problems:
+            print(f"cluster soak FAILED: {p}", file=sys.stderr)
+        return 1
+    print(
+        f"cluster soak OK: {record['nodes']} hosts in {args.slices} "
+        f"slices, {record['jobs_submitted']} jobs, label->placement p99 "
+        f"{record['label_to_placement_p99_ms']}ms, "
+        f"{record['bad_placements_after_window']} bad placements after "
+        f"window ({record['bad_placements_within_window']} excused "
+        f"inside it), recovery p99 {record['recovery_p99_s']}s, storm "
+        f"{record['storm_decisions_per_sec']}/s placements at "
+        f"{record['storm_good_placement_frac']:.1%} good, "
+        f"determinism {'pinned' if record['determinism_ok'] else 'SKIPPED'}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

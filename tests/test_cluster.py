@@ -1,0 +1,563 @@
+"""Cluster-in-a-box placement harness (ISSUE 14).
+
+Pins, fast enough for the tier-1 path (everything virtual-clock or
+loopback; nothing slow-marked):
+
+  - the failure-schedule grammar (tpufd.cluster.parse_schedule):
+    ordering, comments, per-op target validation, loud rejection;
+  - the label-driven toy scheduler: eligibility from labels only, the
+    slice worst-of-members rule (a partitioned member cannot write its
+    own demotion, so its peers' published verdict must block it), class
+    preference / spread / deterministic tiebreak, the capacity-by-class
+    admission gate fed by the aggregator's inventory object, and the
+    label-driven eviction path;
+  - the GROUND-TRUTH-LEAK guard: flipping sim-internal state WITHOUT a
+    label change must not move placement — the scheduler provably
+    consumes only published labels;
+  - the small-N deterministic cluster smoke (scripts/cluster_soak.py
+    --quick): all soak invariants + byte-identical records across two
+    in-process runs AND across two separate invocations of one seed;
+  - the fake apiserver's collection watch under CONCURRENT writers
+    (SSA applies, merge patches, deletes interleaving across objects/
+    shards): per-object resourceVersion monotonicity, no lost or
+    duplicated events, identical streams to two watchers, and a replay
+    of the stream reconstructing the final store — the wire contract
+    the cluster soak's scheduler/aggregator watchers lean on harder
+    than any prior consumer.
+"""
+
+import http.client
+import json
+import sys
+import threading
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "scripts"))
+
+import cluster_soak  # noqa: E402
+import fleet_soak  # noqa: E402
+
+from tpufd import cluster  # noqa: E402
+from tpufd.fakes import simnet  # noqa: E402
+from tpufd.fakes.apiserver import FakeApiServer  # noqa: E402
+
+P = "google.com/"
+
+
+def labels(**kw):
+    """Shorthand label-set builder: cls/slice_id/slice_degraded/..."""
+    out = {
+        P + "tpu.count": kw.pop("count", "8"),
+        P + "tpu.perf.class": kw.pop("cls", "gold"),
+    }
+    if "slice_id" in kw:
+        out[cluster.SLICE_ID] = kw.pop("slice_id")
+    if kw.pop("slice_degraded", False):
+        out[cluster.SLICE_DEGRADED] = "true"
+    if "slice_cls" in kw:
+        out[cluster.SLICE_CLASS] = kw.pop("slice_cls")
+    if kw.pop("preempt", False):
+        out[cluster.LIFECYCLE_PREEMPT] = "true"
+    if kw.pop("draining", False):
+        out[cluster.LIFECYCLE_DRAINING] = "true"
+    assert not kw, f"unused: {kw}"
+    return out
+
+
+class TestScheduleGrammar:
+    def test_parse_sorts_and_round_trips(self):
+        text = """
+        # a comment
+        30 heal s2/h1
+        10 degrade s2/h1   # trailing comment
+
+        20 partition s4 hosts=1-3
+        25 brownout apiserver secs=7
+        """
+        events = cluster.parse_schedule(text)
+        assert [(e.at, e.op) for e in events] == [
+            (10.0, "degrade"), (20.0, "partition"),
+            (25.0, "brownout"), (30.0, "heal")]
+        assert events[0].target() == "s02/h01"
+        assert events[1].target() == "s04"
+        assert events[1].args == {"hosts": "1-3"}
+        assert events[2].target() == "apiserver"
+
+    def test_same_time_preserves_line_order(self):
+        events = cluster.parse_schedule(
+            "5 degrade s0/h0\n5 wedge s1/h1\n")
+        assert [e.op for e in events] == ["degrade", "wedge"]
+
+    def test_rejections_name_the_line(self):
+        import pytest
+
+        for bad, fragment in (
+                ("x degrade s0/h0", "bad time"),
+                ("5 explode s0/h0", "unknown op"),
+                ("5 degrade s0", "sNN/hMM"),
+                ("5 partition s0/h0", "sNN target"),
+                ("5 brownout s0", "'apiserver'"),
+                ("5 degrade", "want '<at> <op> <target>'"),
+                ("5 partition s0 hosts", "key=value")):
+            with pytest.raises(ValueError) as err:
+                cluster.parse_schedule(bad)
+            assert fragment in str(err.value)
+            assert "line 1" in str(err.value)
+
+    def test_host_range(self):
+        import pytest
+
+        assert cluster.parse_host_range({"hosts": "1-2"}, 4) == [1, 2]
+        assert cluster.parse_host_range({}, 4) == [0, 1]  # lower half
+        with pytest.raises(ValueError):
+            cluster.parse_host_range({"hosts": "2-9"}, 4)
+        with pytest.raises(ValueError):
+            cluster.parse_host_range({"hosts": "nope"}, 4)
+
+    def test_builtin_schedules_parse(self):
+        for text in (cluster_soak.default_schedule_text(12, 4),
+                     cluster_soak.quick_schedule_text(4, 3)):
+            events = cluster.parse_schedule(text)
+            assert events, "builtin schedule parsed empty"
+
+
+class TestScheduler:
+    def test_eligibility_is_labels_only(self):
+        s = cluster.SimScheduler()
+        s.on_event("good", labels(slice_id="sl-a"))
+        s.on_event("degraded", labels(cls="degraded", slice_id="sl-b"))
+        s.on_event("preempting", labels(preempt=True, slice_id="sl-c"))
+        s.on_event("draining", labels(draining=True, slice_id="sl-d"))
+        s.on_event("slice-bad", labels(slice_degraded=True,
+                                       slice_id="sl-e"))
+        s.on_event("slice-cls", labels(slice_cls="degraded",
+                                       slice_id="sl-f"))
+        assert s.placeable("good")
+        for node in ("degraded", "preempting", "draining", "slice-bad",
+                     "slice-cls", "never-seen"):
+            assert not s.placeable(node), node
+
+    def test_slice_worst_of_members_blocks_stale_sibling(self):
+        # The partitioned member's own labels stay stale-good (it cannot
+        # write its demotion); its peer's published degraded verdict
+        # must block the whole slice.
+        s = cluster.SimScheduler()
+        s.on_event("stale", labels(slice_id="sl-1"))
+        s.on_event("peer", labels(slice_id="sl-1", slice_degraded=True))
+        s.on_event("other", labels(slice_id="sl-2"))
+        assert not s.placeable("stale")
+        assert not s.placeable("peer")
+        assert s.placeable("other")
+        job = cluster.Job("j1", "any", 4, 10.0)
+        assert s.place(job, 0.0).node == "other"
+
+    def test_class_preference_spread_and_tiebreak(self):
+        s = cluster.SimScheduler()
+        s.on_event("a-silver", labels(cls="silver"))
+        s.on_event("b-gold", labels(cls="gold"))
+        s.on_event("a-gold", labels(cls="gold"))
+        # Gold preferred over silver; equal free -> lexicographic.
+        d1 = s.place(cluster.Job("j1", "any", 4, 1.0), 0.0)
+        assert d1.node == "a-gold"
+        # Spread: the emptier gold node wins the next one.
+        d2 = s.place(cluster.Job("j2", "any", 4, 1.0), 0.0)
+        assert d2.node == "b-gold"
+        # Gold full (8 chips each, 4 used): still room on both golds;
+        # fill them, then silver catches the overflow for "any" only.
+        s.place(cluster.Job("j3", "any", 4, 1.0), 0.0)
+        s.place(cluster.Job("j4", "any", 4, 1.0), 0.0)
+        d5 = s.place(cluster.Job("j5", "any", 4, 1.0), 0.0)
+        assert d5.node == "a-silver"
+        gold_job = cluster.Job("j6", "gold", 4, 1.0)
+        assert s.place(gold_job, 0.0).reason == "no-candidate"
+
+    def test_class_floor(self):
+        s = cluster.SimScheduler()
+        s.on_event("n-silver", labels(cls="silver"))
+        assert s.place(cluster.Job("j1", "gold", 4, 1.0),
+                       0.0).reason == "no-candidate"
+        assert s.place(cluster.Job("j2", "silver", 4, 1.0),
+                       0.0).node == "n-silver"
+
+    def test_inventory_admission_gate(self):
+        s = cluster.SimScheduler()
+        s.on_event("n1", labels(cls="gold"))
+        # Empty inventory admits (aggregator not synced yet).
+        assert s.place(cluster.Job("j1", "gold", 4, 1.0),
+                       0.0).reason == "placed"
+        # An inventory claiming zero gold chips short-circuits gold
+        # jobs before the scan; "any" jobs still admitted (unclassed
+        # and silver chips count for them).
+        s.on_inventory({cluster.CAPACITY_PREFIX + "gold": "0",
+                        cluster.CAPACITY_PREFIX + "silver": "8",
+                        cluster.CAPACITY_PREFIX + "unclassed": "0"})
+        d = s.place(cluster.Job("j2", "gold", 4, 1.0), 0.0)
+        assert d.reason == "no-capacity"
+        assert s.place(cluster.Job("j3", "any", 4, 1.0),
+                       0.0).reason == "placed"
+
+    def test_eviction_and_release(self):
+        s = cluster.SimScheduler()
+        s.on_event("n1", labels())
+        d = s.place(cluster.Job("j1", "any", 4, 1.0), 0.0)
+        assert d.node == "n1"
+        assert s.node_of("j1") == "n1"
+        # Labels flip bad -> the job drains, chips free.
+        s.on_event("n1", labels(preempt=True))
+        assert s.drain_ineligible() == ["j1"]
+        assert s.node_of("j1") is None
+        assert s.node_used.get("n1", 0) == 0
+        # Released twice is a no-op.
+        assert s.release("j1") is None
+
+    def test_deleted_node_drops_from_view(self):
+        s = cluster.SimScheduler()
+        s.on_event("n1", labels())
+        was, now = s.on_event("n1", None)
+        assert (was, now) == (True, False)
+        assert s.place(cluster.Job("j1", "any", 4, 1.0),
+                       0.0).reason == "no-candidate"
+
+
+class TestGroundTruthLeak:
+    """The labels-only contract, enforced: flipping sim-internal ground
+    truth WITHOUT a label publish must not move placement; the same
+    flip WITH its publish must."""
+
+    def _rig(self):
+        import random
+
+        rng = random.Random(7)
+        clock = simnet.SimClock()
+        server = cluster_soak.ClusterApiServer(clock, rng, shards=4)
+        sl = cluster_soak.SimSlice(server, clock, rng, 0, 3)
+        for m in sl.members:
+            server.daemon_apply(0.0, m.name, m.desired_labels())
+        sched = cluster.SimScheduler()
+        for node in sorted(server.objects):
+            sched.on_event(node, server.objects[node])
+        return clock, server, sl, sched
+
+    def _decisions(self, sched, n=6):
+        probe = cluster.SimScheduler()
+        probe.view = {k: dict(v) for k, v in sched.view.items()}
+        out = []
+        for i in range(n):
+            d = probe.place(cluster.Job(f"p{i}", "any", 4, 1.0), 0.0)
+            out.append((d.node, d.reason))
+        return out
+
+    def test_internal_flip_without_labels_does_not_move_placement(self):
+        clock, server, sl, sched = self._rig()
+        before = self._decisions(sched)
+        victim = sl.members[1]
+        # Ground truth goes bad — but NO detection/publish is wired up,
+        # so no label changes. Placement must not move.
+        victim.gt_degraded = True
+        victim.gt_preempting = True
+        clock.run(30.0)
+        assert sched.placeable(victim.name)
+        assert self._decisions(sched) == before
+
+    def test_same_flip_with_publish_moves_placement(self):
+        clock, server, sl, sched = self._rig()
+        victim = sl.members[1]
+        victim.gt_degraded = True
+        victim.probe_detect(0.0)  # the daemon pipeline this time
+        # Drain the virtual clock, then deliver the store to the
+        # scheduler (the soak wires this through the watch; here we
+        # bootstrap-sync for brevity).
+        clock.run(30.0)
+        for node in sorted(server.objects):
+            sched.on_event(node, server.objects[node])
+        assert not sched.placeable(victim.name)
+        d = self._decisions(sched)
+        assert all(node != victim.name for node, _ in d)
+
+
+class TestClusterSmoke:
+    def test_quick_soak_passes_and_is_deterministic(self, tmp_path):
+        out = tmp_path / "cluster.json"
+        rc = cluster_soak.main(["--quick", "--seed", "14",
+                                "--json", str(out)])
+        assert rc == 0
+        record = json.loads(out.read_text())
+        assert record["bad_placements_after_window"] == 0
+        assert record["determinism_ok"] is True
+        assert record["failures_converged"] == record["failures_tracked"]
+        assert record["heals_converged"] == record["heals_tracked"]
+        assert record["final_unplaceable_nodes"] == 0
+        assert record["inventory_updates_consumed"] > 0
+        assert record["agg_full_recomputes"] == 0
+        assert record["placements_total"] > 0
+
+    def test_two_invocations_byte_identical(self, tmp_path):
+        records = []
+        for name in ("a.json", "b.json"):
+            out = tmp_path / name
+            rc = cluster_soak.main(["--quick", "--seed", "23", "--once",
+                                    "--json", str(out)])
+            assert rc == 0
+            records.append(out.read_bytes())
+        assert records[0] == records[1]
+
+    def test_gate_accepts_committed_record(self):
+        import bench_gate
+
+        repo = Path(__file__).resolve().parent.parent
+        problems = bench_gate.cluster_gate(
+            str(repo / "BENCH_cluster.json"),
+            str(repo / "BENCH_cluster.json"), slack=0.5)
+        assert problems == []
+
+    def test_gate_fails_loudly_on_missing_keys(self, tmp_path):
+        import bench_gate
+
+        stub = tmp_path / "stub.json"
+        stub.write_text("{}")
+        problems = bench_gate.cluster_gate(str(stub), str(stub), 0.5)
+        assert any("bad_placements_after_window" in p for p in problems)
+        assert any("determinism" in p for p in problems)
+
+    def test_soaks_share_one_simnet(self):
+        # The satellite contract: the fleet/aggregate/cluster soaks
+        # import ONE copy of the sim primitives, not private forks.
+        assert fleet_soak.SimClock is simnet.SimClock
+        assert fleet_soak.SimApiServer is simnet.SimApiServer
+        assert fleet_soak.SimDaemon is simnet.SimDaemon
+        assert fleet_soak.AggSimServer is simnet.AggSimServer
+        assert fleet_soak.SimAggregator is simnet.SimAggregator
+        assert cluster_soak.SimClock is simnet.SimClock
+        assert issubclass(cluster_soak.ClusterAggregator,
+                          simnet.SimAggregator)
+
+
+# ---- collection watch under concurrent writers ----------------------------
+
+NS = "clusterns"
+BASE = f"/apis/nfd.k8s-sigs.io/v1alpha1/namespaces/{NS}/nodefeatures"
+
+
+def open_stream(server, path, timeout_s=15.0):
+    conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                      timeout=timeout_s)
+    conn.request("GET", path)
+    return conn, conn.getresponse()
+
+
+class StreamReader(threading.Thread):
+    """Drains one collection watch stream (open from rv=0 BEFORE the
+    writers start) until `expected()` returns a final event count and
+    that many non-bookmark events arrived. expected() returns None
+    while the writers are still running — the reader keeps draining."""
+
+    def __init__(self, server, expected):
+        super().__init__(daemon=True)
+        self.server = server
+        self.expected = expected
+        self.events = []
+        self.bookmarks = []
+
+    def run(self):
+        conn, resp = open_stream(
+            self.server,
+            BASE + "?watch=true&resourceVersion=0"
+                   "&allowWatchBookmarks=true&timeoutSeconds=12")
+        try:
+            while True:
+                target = self.expected()
+                if target is not None and len(self.events) >= target:
+                    return
+                line = resp.readline()
+                if not line:
+                    return
+                event = json.loads(line)
+                if event["type"] == "BOOKMARK":
+                    self.bookmarks.append(int(
+                        event["object"]["metadata"]["resourceVersion"]))
+                    continue
+                self.events.append(event)
+        except (OSError, ValueError):
+            pass
+        finally:
+            conn.close()
+
+
+class Writer(threading.Thread):
+    """One concurrent writer owning a disjoint set of object names:
+    seeds, SSA-applies (fieldManager=self), merge-patches, and finally
+    deletes one dedicated victim. Counts the mutations that SUCCEEDED —
+    exactly the events the stream owes."""
+
+    def __init__(self, server, tag, names, rounds):
+        super().__init__(daemon=True)
+        self.server = server
+        self.tag = tag
+        self.names = names
+        self.rounds = rounds
+        self.mutations = {n: 0 for n in names}
+
+    def _conn(self):
+        return http.client.HTTPConnection("127.0.0.1", self.server.port,
+                                          timeout=10)
+
+    def _patch(self, name, body, content_type, query=""):
+        conn = self._conn()
+        conn.request("PATCH", f"{BASE}/{name}{query}",
+                     json.dumps(body),
+                     {"Content-Type": content_type})
+        resp = conn.getresponse()
+        resp.read()
+        conn.close()
+        return resp.status
+
+    def run(self):
+        serial = 0
+        for name in self.names:
+            self.server.seed(NS, name, {"seeded-by": self.tag})
+            self.mutations[name] += 1
+        for r in range(self.rounds):
+            for name in self.names:
+                serial += 1
+                if r % 2 == 0:
+                    status = self._patch(
+                        name,
+                        {"metadata": {"name": name},
+                         "spec": {"labels":
+                                  {f"{self.tag}-ssa": str(serial)}}},
+                        "application/apply-patch+yaml",
+                        f"?fieldManager={self.tag}&force=true")
+                else:
+                    status = self._patch(
+                        name,
+                        {"spec": {"labels":
+                                  {f"{self.tag}-merge": str(serial)}}},
+                        "application/merge-patch+json")
+                if status in (200, 201):
+                    self.mutations[name] += 1
+        victim = self.names[-1]
+        conn = self._conn()
+        conn.request("DELETE", f"{BASE}/{victim}")
+        resp = conn.getresponse()
+        resp.read()
+        if resp.status == 200:
+            self.mutations[victim] += 1
+        conn.close()
+
+
+class TestCollectionWatchConcurrency:
+    def test_ordering_under_concurrent_writers(self):
+        with FakeApiServer() as server:
+            server.set_bookmark_interval(0.2)
+            writers = [
+                Writer(server, f"w{i}",
+                       [f"tfd-features-for-n{i}{j}" for j in range(3)],
+                       rounds=8)
+                for i in range(4)]
+
+            writers_done = threading.Event()
+
+            def expected():
+                if not writers_done.is_set():
+                    return None
+                return sum(sum(w.mutations.values()) for w in writers)
+
+            readers = [StreamReader(server, expected) for _ in range(2)]
+            for t in readers:
+                t.start()
+            for w in writers:
+                w.start()
+            for w in writers:
+                w.join(timeout=20)
+            writers_done.set()
+            for t in readers:
+                t.join(timeout=20)
+
+            owed = expected()
+            # Events retained: total mutations must fit the collection
+            # history window or the from-0 replay would 410.
+            assert owed < 256, "test outgrew COLLECTION_HISTORY"
+
+            for reader in readers:
+                events = reader.events
+                # No lost, no duplicated events: exactly one event per
+                # successful mutation, per object.
+                assert len(events) == owed
+                per_name = {}
+                for e in events:
+                    name = e["object"]["metadata"]["name"]
+                    rv = int(e["object"]["metadata"]["resourceVersion"])
+                    per_name.setdefault(name, []).append(
+                        (rv, e["type"]))
+                for w in writers:
+                    for name, n in w.mutations.items():
+                        got = per_name.get(name, [])
+                        assert len(got) == n, (name, len(got), n)
+                        # Per-object resourceVersion strictly
+                        # monotonic: no reorder, no dup, no loss.
+                        rvs = [rv for rv, _ in got]
+                        assert rvs == sorted(rvs)
+                        assert len(set(rvs)) == len(rvs)
+                        # The victim's last event is its DELETE.
+                        if name == w.names[-1]:
+                            assert got[-1][1] == "DELETED"
+                # Bookmarks carry a nondecreasing global rv.
+                assert reader.bookmarks == sorted(reader.bookmarks)
+
+            # The two watchers saw the SAME totally-ordered stream.
+            key = lambda e: (e["object"]["metadata"]["name"],  # noqa: E731
+                             e["object"]["metadata"]["resourceVersion"],
+                             e["type"])
+            assert [key(e) for e in readers[0].events] == \
+                [key(e) for e in readers[1].events]
+
+            # Replaying the stream reconstructs the final store.
+            replay = {}
+            for e in readers[0].events:
+                name = e["object"]["metadata"]["name"]
+                if e["type"] == "DELETED":
+                    replay.pop(name, None)
+                else:
+                    replay[name] = e["object"].get(
+                        "spec", {}).get("labels", {})
+            store = {name: obj.get("spec", {}).get("labels", {})
+                     for (ns, name), obj in server.store.items()
+                     if ns == NS}
+            assert replay == store
+
+    def test_concurrent_writer_rvs_interleave_one_global_order(self):
+        # Same-object concurrent SSA from two managers: the per-object
+        # rv sequence the watch emits must be gapless 1..N even when
+        # the applies race (the lock serializes store+emit atomically).
+        with FakeApiServer() as server:
+            name = "tfd-features-for-race"
+            server.seed(NS, name, {"v": "0"})
+
+            def hammer(tag, rounds=12):
+                for i in range(rounds):
+                    conn = http.client.HTTPConnection(
+                        "127.0.0.1", server.port, timeout=10)
+                    conn.request(
+                        "PATCH",
+                        f"{BASE}/{name}?fieldManager={tag}&force=true",
+                        json.dumps({"metadata": {"name": name},
+                                    "spec": {"labels":
+                                             {tag: str(i)}}}),
+                        {"Content-Type": "application/apply-patch+yaml"})
+                    resp = conn.getresponse()
+                    resp.read()
+                    assert resp.status in (200, 201)
+                    conn.close()
+
+            threads = [threading.Thread(target=hammer, args=(t,))
+                       for t in ("mgr-a", "mgr-b")]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=20)
+            history = server._handler.events[(NS, name)]
+            rvs = [rv for rv, _, _ in history]
+            assert rvs == list(range(rvs[0], rvs[0] + len(rvs)))
+            obj = server.store[(NS, name)]
+            assert int(obj["metadata"]["resourceVersion"]) == rvs[-1]

@@ -1,0 +1,408 @@
+"""The cluster-in-a-box placement layer (ISSUE 14): a label-driven toy
+scheduler, the synthetic job/workload model, and the failure-schedule
+grammar the end-to-end placement-quality harness
+(scripts/cluster_soak.py) drives.
+
+The scheduler is deliberately a TOY — a few hundred lines, no
+bin-packing research — but its information diet is the PRODUCT contract
+this repo exists to prove: it sees ONLY labels published through the
+apiserver (per-node NodeFeature labels and the aggregator's
+cluster-inventory object), NEVER the simulation's ground truth. If the
+labels are late, wrong, or missing, the scheduler places jobs on dying
+hardware and the harness counts it. That makes "the published
+google.com/tpu.* labels make placement measurably better under
+failure" a number instead of a slogan.
+
+The labels-only contract is structural, not advisory: SimScheduler
+holds no reference to any simulation object — state enters exclusively
+through on_event()/on_inventory() (the watch-event surface), and the
+ground-truth-leak test in tests/test_cluster.py flips sim-internal
+state WITHOUT a label change and asserts placement does not move.
+
+Everything here is pure and deterministic (sorted iteration, no wall
+clock, no ambient randomness): the same event sequence always yields
+the same placements, which is what lets the soak pin byte-identical
+metrics across two runs of one seed.
+"""
+
+import re
+
+from tpufd import agg as agglib
+
+PREFIX = "google.com/"
+
+# The label diet — every key the scheduler is allowed to read. Shared
+# with the aggregator twin where the aggregator also consumes them.
+SLICE_ID = agglib.SLICE_ID
+SLICE_DEGRADED = agglib.SLICE_DEGRADED
+SLICE_CLASS = PREFIX + "tpu.slice.class"
+SLICE_HEALTHY_HOSTS = PREFIX + "tpu.slice.healthy-hosts"
+PERF_CLASS = agglib.PERF_CLASS
+TPU_COUNT = agglib.TPU_COUNT
+LIFECYCLE_PREEMPT = agglib.LIFECYCLE_PREEMPT
+LIFECYCLE_DRAINING = agglib.LIFECYCLE_DRAINING
+CAPACITY_PREFIX = agglib.CAPACITY_PREFIX
+
+# Perf-class ordering: the scheduler prefers the best class that still
+# clears the job's floor. Absent/unknown ranks 0 (unclassed hardware is
+# only placeable by jobs with no class floor), degraded is NEVER
+# placeable regardless of floor.
+CLASS_RANK = {"gold": 3, "silver": 2, "degraded": 1}
+
+# Job class floors -> minimum acceptable rank.
+JOB_CLASS_RANK = {"gold": 3, "silver": 2, "any": 0}
+
+
+def class_rank(labels):
+    return CLASS_RANK.get(labels.get(PERF_CLASS, ""), 0)
+
+
+def preempting(labels):
+    return (labels.get(LIFECYCLE_PREEMPT) == "true" or
+            labels.get(LIFECYCLE_DRAINING) == "true")
+
+
+def basic_eligible(labels):
+    """Can this node host ANY job, judging purely from its published
+    labels? (Capacity is a separate, per-job check.) The transitions of
+    this predicate are what the harness timestamps: ground-truth event
+    -> basic_eligible flips = label-to-placement latency."""
+    if labels is None:
+        return False
+    if labels.get(PERF_CLASS) == "degraded":
+        return False
+    if labels.get(SLICE_DEGRADED) == "true":
+        return False
+    if labels.get(SLICE_CLASS) == "degraded":
+        return False
+    if preempting(labels):
+        return False
+    return True
+
+
+def node_eligible(labels, min_rank):
+    if not basic_eligible(labels):
+        return False
+    return class_rank(labels) >= min_rank
+
+
+def slice_blocked_ids(view):
+    """Slice ids any member's published labels mark degraded. The
+    worst-of-members rule exists because a PARTITIONED member cannot
+    write its own demotion (the partition severs its sink — the PR 12
+    tradeoff): its node object holds stale-good labels, and the only
+    label evidence that its slice is unsafe is the degraded verdict its
+    still-connected peers publish. A labels-only scheduler therefore
+    keys slice eligibility on the worst published claim across the
+    slice's members, not on each node's own copy."""
+    blocked = set()
+    for labels in view.values():
+        sid = labels.get(SLICE_ID, "")
+        if not sid:
+            continue
+        if (labels.get(SLICE_DEGRADED) == "true" or
+                labels.get(SLICE_CLASS) == "degraded"):
+            blocked.add(sid)
+    return blocked
+
+
+class Job:
+    """One synthetic workload unit: `wanted` names the perf-class floor
+    ("gold" / "silver" / "any"), `chips` how much of a node it occupies,
+    `duration_s` how long it runs once landed."""
+
+    __slots__ = ("job_id", "wanted", "chips", "duration_s")
+
+    def __init__(self, job_id, wanted, chips, duration_s):
+        if wanted not in JOB_CLASS_RANK:
+            raise ValueError(f"unknown job class {wanted!r}")
+        self.job_id = job_id
+        self.wanted = wanted
+        self.chips = chips
+        self.duration_s = duration_s
+
+    @property
+    def min_rank(self):
+        return JOB_CLASS_RANK[self.wanted]
+
+
+class Decision:
+    """One placement decision: node is None when nothing placeable
+    (reason 'no-capacity' = the inventory admission gate said the
+    cluster has no chips of the wanted class; 'no-candidate' = the
+    per-node scan found nothing eligible with room)."""
+
+    __slots__ = ("job_id", "node", "reason", "at")
+
+    def __init__(self, job_id, node, reason, at):
+        self.job_id = job_id
+        self.node = node
+        self.reason = reason
+        self.at = at
+
+    @property
+    def placed(self):
+        return self.node is not None
+
+
+class SimScheduler:
+    """The label-driven toy scheduler.
+
+    Inputs (the ONLY inputs):
+      on_event(node, labels)   — a NodeFeature watch event (labels=None
+                                 for DELETED); returns the
+                                 basic-eligibility transition tuple.
+      on_inventory(labels)     — the aggregator's cluster-inventory
+                                 object (capacity-by-class admission).
+
+    place(job, now) scans the view deterministically: among eligible
+    nodes with room, prefer the highest perf class, then the emptiest
+    node (spread), then lexicographic node name (the determinism
+    tiebreak). Jobs whose node turns ineligible are surfaced by
+    drain_ineligible() for the caller to re-queue — the
+    preemption-aware migration the lifecycle labels exist to drive.
+    """
+
+    def __init__(self):
+        self.view = {}         # node -> published labels
+        self.inventory = {}    # the rollup object's labels (may be {})
+        self.placements = {}   # job_id -> (node, chips)
+        self.node_used = {}    # node -> chips allocated
+        self.decisions = 0
+        self.placed_total = 0
+        self.no_candidate_total = 0
+        self.no_capacity_total = 0
+
+    # ---- label surface ---------------------------------------------------
+
+    def on_event(self, node, labels):
+        """One watch event. Returns (was_eligible, now_eligible) so the
+        harness can timestamp eligibility transitions without reaching
+        into scheduler internals."""
+        was = basic_eligible(self.view.get(node))
+        if labels is None:
+            self.view.pop(node, None)
+        else:
+            self.view[node] = dict(labels)
+        now_el = basic_eligible(self.view.get(node))
+        return was, now_el
+
+    def on_inventory(self, labels):
+        self.inventory = dict(labels or {})
+
+    # ---- bookkeeping -----------------------------------------------------
+
+    def _free_chips(self, node, labels):
+        try:
+            cap = int(labels.get(TPU_COUNT, "0"))
+        except ValueError:
+            cap = 0
+        return cap - self.node_used.get(node, 0)
+
+    def admit(self, job):
+        """Cluster-level admission from the aggregator's capacity-by-
+        class rollup: don't scan 10k nodes for a gold job when the
+        inventory says the cluster owns zero gold chips. An empty
+        inventory (aggregator not synced yet) admits everything — the
+        per-node scan stays the source of truth."""
+        if not self.inventory:
+            return True
+        chips = 0
+        for bucket, rank in (("gold", 3), ("silver", 2), ("unclassed", 0)):
+            if rank >= job.min_rank:
+                raw = self.inventory.get(CAPACITY_PREFIX + bucket, "0")
+                chips += int(raw) if raw.isdigit() else 0
+        return chips >= job.chips
+
+    def placeable(self, node, blocked=None):
+        """basic_eligible plus the slice worst-of-members rule; capacity
+        is a per-job concern, not part of placeability. The harness
+        timestamps transitions of THIS predicate: ground-truth event ->
+        placeable() flips = label-to-placement latency.
+
+        `blocked` takes a precomputed slice_blocked_ids(self.view) so a
+        caller checking many nodes against one view (drain, latency
+        trackers) pays the O(nodes) blocked-set scan once, not per
+        node."""
+        labels = self.view.get(node)
+        if not basic_eligible(labels):
+            return False
+        sid = labels.get(SLICE_ID, "")
+        if not sid:
+            return True
+        if blocked is None:
+            blocked = slice_blocked_ids(self.view)
+        return sid not in blocked
+
+    def place(self, job, now):
+        self.decisions += 1
+        if not self.admit(job):
+            self.no_capacity_total += 1
+            return Decision(job.job_id, None, "no-capacity", now)
+        blocked = slice_blocked_ids(self.view)
+        best = None
+        best_key = None
+        for node in sorted(self.view):
+            labels = self.view[node]
+            if not node_eligible(labels, job.min_rank):
+                continue
+            if labels.get(SLICE_ID, "") in blocked:
+                continue
+            free = self._free_chips(node, labels)
+            if free < job.chips:
+                continue
+            key = (-class_rank(labels), -free, node)
+            if best_key is None or key < best_key:
+                best, best_key = node, key
+        if best is None:
+            self.no_candidate_total += 1
+            return Decision(job.job_id, None, "no-candidate", now)
+        self.placements[job.job_id] = (best, job.chips)
+        self.node_used[best] = self.node_used.get(best, 0) + job.chips
+        self.placed_total += 1
+        return Decision(job.job_id, best, "placed", now)
+
+    def release(self, job_id):
+        """Job finished (or failed on bad hardware): free its chips."""
+        placed = self.placements.pop(job_id, None)
+        if placed is None:
+            return None
+        node, chips = placed
+        used = self.node_used.get(node, 0) - chips
+        if used > 0:
+            self.node_used[node] = used
+        else:
+            self.node_used.pop(node, None)
+        return node
+
+    def node_of(self, job_id):
+        placed = self.placements.get(job_id)
+        return placed[0] if placed else None
+
+    def drain_ineligible(self):
+        """Jobs running on nodes whose published labels now say 'stop':
+        released here and returned (sorted) for the caller to re-queue —
+        the label-driven eviction path (preempt-imminent, slice
+        degraded, perf demotion, node object deleted)."""
+        blocked = slice_blocked_ids(self.view)
+        doomed = sorted(
+            job_id for job_id, (node, _) in self.placements.items()
+            if not self.placeable(node, blocked))
+        for job_id in doomed:
+            self.release(job_id)
+        return doomed
+
+
+# ---- failure-schedule grammar ---------------------------------------------
+#
+# One event per line:   <at_seconds> <op> <target> [key=value ...]
+# Blank lines and #-comments skipped. Targets:
+#   sNN/hMM    one host         (degrade/heal/wedge/unwedge/preempt/
+#                                preempt-clear)
+#   sNN        one slice        (leader-kill/leader-restart/partition/
+#                                heal-partition)
+#   apiserver  the control plane (brownout; secs=N)
+# partition takes hosts=A-B (the member index range that loses
+# connectivity). The full semantics table lives in
+# docs/placement-harness.md.
+
+HOST_OPS = {"degrade", "heal", "wedge", "unwedge", "preempt",
+            "preempt-clear"}
+SLICE_OPS = {"leader-kill", "leader-restart", "partition",
+             "heal-partition"}
+SERVER_OPS = {"brownout"}
+
+_TARGET_HOST = re.compile(r"^s(\d+)/h(\d+)$")
+_TARGET_SLICE = re.compile(r"^s(\d+)$")
+
+
+class ScheduleEvent:
+    __slots__ = ("at", "op", "slice_idx", "host_idx", "args", "line")
+
+    def __init__(self, at, op, slice_idx, host_idx, args, line):
+        self.at = at
+        self.op = op
+        self.slice_idx = slice_idx
+        self.host_idx = host_idx
+        self.args = args
+        self.line = line
+
+    def target(self):
+        if self.op in SERVER_OPS:
+            return "apiserver"
+        if self.host_idx is not None:
+            return f"s{self.slice_idx:02d}/h{self.host_idx:02d}"
+        return f"s{self.slice_idx:02d}"
+
+
+def parse_schedule(text):
+    """Parses the failure-schedule grammar into ScheduleEvents sorted by
+    (time, line order). Raises ValueError naming the offending line —
+    a silent skip would turn a typo'd chaos schedule into a quiet soak
+    that gates nothing."""
+    events = []
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.split("#", 1)[0].strip()
+        if not line:
+            continue
+        parts = line.split()
+        if len(parts) < 3:
+            raise ValueError(
+                f"schedule line {lineno}: want '<at> <op> <target>', "
+                f"got {raw!r}")
+        try:
+            at = float(parts[0])
+        except ValueError:
+            raise ValueError(
+                f"schedule line {lineno}: bad time {parts[0]!r}")
+        op, target = parts[1], parts[2]
+        args = {}
+        for extra in parts[3:]:
+            key, sep, value = extra.partition("=")
+            if not sep:
+                raise ValueError(
+                    f"schedule line {lineno}: want key=value, "
+                    f"got {extra!r}")
+            args[key] = value
+        slice_idx = host_idx = None
+        if op in HOST_OPS:
+            m = _TARGET_HOST.match(target)
+            if not m:
+                raise ValueError(
+                    f"schedule line {lineno}: op {op} wants a "
+                    f"sNN/hMM target, got {target!r}")
+            slice_idx, host_idx = int(m.group(1)), int(m.group(2))
+        elif op in SLICE_OPS:
+            m = _TARGET_SLICE.match(target)
+            if not m:
+                raise ValueError(
+                    f"schedule line {lineno}: op {op} wants a sNN "
+                    f"target, got {target!r}")
+            slice_idx = int(m.group(1))
+        elif op in SERVER_OPS:
+            if target != "apiserver":
+                raise ValueError(
+                    f"schedule line {lineno}: op {op} wants the "
+                    f"'apiserver' target, got {target!r}")
+        else:
+            raise ValueError(f"schedule line {lineno}: unknown op {op!r}")
+        events.append(ScheduleEvent(at, op, slice_idx, host_idx, args,
+                                    lineno))
+    events.sort(key=lambda e: (e.at, e.line))
+    return events
+
+
+def parse_host_range(args, member_count):
+    """partition hosts=A-B -> the sorted member indexes inside the
+    slice that lose connectivity (default: the lower half)."""
+    spec = args.get("hosts")
+    if spec is None:
+        return list(range(member_count // 2))
+    m = re.match(r"^(\d+)-(\d+)$", spec)
+    if not m:
+        raise ValueError(f"bad hosts range {spec!r} (want A-B)")
+    lo, hi = int(m.group(1)), int(m.group(2))
+    if lo > hi or hi >= member_count:
+        raise ValueError(
+            f"hosts range {spec!r} outside 0-{member_count - 1}")
+    return list(range(lo, hi + 1))

@@ -52,6 +52,7 @@
 #include "tfd/obs/journal.h"
 #include "tfd/obs/metrics.h"
 #include "tfd/obs/server.h"
+#include "tfd/obs/trace.h"
 #include "tfd/perf/perf.h"
 #include "tfd/platform/detect.h"
 #include "tfd/plugin/plugin.h"
@@ -606,6 +607,17 @@ Status DispatchSink(const config::Config& config, const lm::Labels& labels,
         config.flags.sink_request_deadline_s * 1000;
     cluster->use_patch = config.flags.sink_patch;
     cluster->use_apply = config.flags.sink_apply;
+    // The causal join key rides outward on every write verb as a CR
+    // annotation: the change id THIS pass captured at BeginRewrite (the
+    // journal keeps it current), so the slice blackboard, the
+    // aggregator, and any cluster-side consumer can join the CR back to
+    // this daemon's /debug/trace and journal. Deliberately NOT the live
+    // LatestActiveChange: a change a probe worker mints while this pass
+    // is writing is not in this write's content, and the annotation
+    // must agree with what MarkPublished acks.
+    if (uint64_t change = obs::DefaultJournal().change(); change != 0) {
+      cluster->change_annotation = std::to_string(change);
+    }
     if (anti_entropy) k8s::DefaultSinkState().Invalidate();
     out = k8s::UpdateNodeFeature(*cluster, labels, &transient, nullptr,
                                  &wire);
@@ -766,6 +778,21 @@ void RecordSuppressedFlips(
          {"source", flip.provenance.source},
          {"tier", flip.provenance.tier}});
   }
+}
+
+// Per-stage split of the slow-pass rewrite span (plan / render /
+// publish): the budget decomposition the causal trace (obs/trace.h)
+// reports per change-id, aggregated here as a histogram so a fleet
+// dashboard can see WHERE pass time goes without reading traces.
+void ObserveStageDuration(const char* stage, double seconds) {
+  obs::Default()
+      .GetHistogram("tfd_pass_stage_duration_seconds",
+                    "Duration of one slow-pass pipeline stage: plan "
+                    "(signature digest + short-circuit decision), render "
+                    "(labelers + merge + govern + serialize), publish "
+                    "(sink dispatch through write-acked).",
+                    obs::DurationBuckets(), {{"stage", stage}})
+      ->Observe(seconds);
 }
 
 // The sink-skip observability pair: counted per sink, journaled once.
@@ -1032,11 +1059,13 @@ Status LabelOnceInner(
       plan.mode == PassMode::kFull ? nullptr : &cache->fragments;
   lm::Labels merged;
   lm::Provenance provenance;
+  auto t_render = std::chrono::steady_clock::now();
   Status rendered = RenderLabels(config, config_generation, timestamp,
                                  machine_type, tpu_vm, store, decision,
                                  plan, refresh_host, fragments, &merged,
                                  &provenance, span_fields);
   if (!rendered.ok()) return rendered;
+  obs::DefaultTrace().Stage("render");
 
   // Anti-flap layer: quarantined sources hold last-good facts, and the
   // governor debounces whatever still wants to flip.
@@ -1044,6 +1073,7 @@ Status LabelOnceInner(
   HoldQuarantinedAndGovern(prev, level_improved, governor, &merged,
                            &provenance, &suppressed);
   *suppressed_flips = suppressed.size();
+  obs::DefaultTrace().Stage("govern");
 
   if (merged.size() <= 1) {
     TFD_LOG_WARNING << "only " << merged.size()
@@ -1054,6 +1084,8 @@ Status LabelOnceInner(
   // feed the byte-compare skip, the file sink, and the published-bytes
   // cache the next fast pass re-emits.
   lm::FormatLabelsInto(merged, &cache->scratch);
+  ObserveStageDuration("render", obs::SecondsSince(t_render));
+  auto t_publish = std::chrono::steady_clock::now();
 
   // Byte-compare sink skip: a slow pass whose output is byte-identical
   // to what the sink holds (a governor hold re-rendering the same set,
@@ -1095,7 +1127,9 @@ Status LabelOnceInner(
                               wrote_ok, anti_entropy_due);
     if (!out.ok()) return out;
   }
+  ObserveStageDuration("publish", obs::SecondsSince(t_publish));
   if (!*wrote_ok) return Status::Ok();  // survived transient sink failure
+  obs::DefaultTrace().Stage("publish");
   governor->CommitPublished();
   RecordSuppressedFlips(suppressed);
 
@@ -1338,7 +1372,11 @@ Status LabelOnce(const config::Config& config, int config_generation,
                  lm::LabelGovernor* governor, LabelState* state,
                  PassCache* cache) {
   auto t0 = std::chrono::steady_clock::now();
-  uint64_t generation = obs::DefaultJournal().BeginRewrite();
+  // The causal change-id this pass carries (obs/trace.h): the latest
+  // label-moving event still in flight. Journal events, json log lines,
+  // and the CR annotation all ride it for the duration of the pass.
+  uint64_t change = obs::DefaultTrace().LatestActiveChange();
+  uint64_t generation = obs::DefaultJournal().BeginRewrite(change);
   ServeDecision decision = Decide(store, config.flags);
   // A pass whose serving rung IMPROVED (metadata -> pjrt convergence,
   // restored -> live) carries monotone-informative changes the
@@ -1373,9 +1411,13 @@ Status LabelOnce(const config::Config& config, int config_generation,
   PassPlan plan = PlanPass(config, store, decision, config_generation,
                            governor, cache, WallClockSeconds());
   if (plan.mode == PassMode::kFast) {
+    // A fast pass means nothing moved: no change in flight, no stage
+    // stamps — tracing stays free in the steady state.
     return FastPass(config, decision, plan, server, breaker, state, cache,
                     t0);
   }
+  ObserveStageDuration("plan", obs::SecondsSince(t0));
+  obs::DefaultTrace().Stage("plan");
   obs::Default()
       .GetCounter("tfd_pass_slow_total",
                   "Passes that rendered in full or incrementally, by the "
@@ -1434,6 +1476,16 @@ Status LabelOnce(const config::Config& config, int config_generation,
     // and bypass the hold-down — re-opening the churn this layer exists
     // to stop.
     if (suppressed_flips == 0) {
+      // Same deferred-commit rule for the causal trace: a pass whose
+      // flips were SUPPRESSED did not land its changes' content (the
+      // byte-compare skip swallowed the write), so the change ids stay
+      // active and the pass that eventually publishes them — after the
+      // hold-down — carries them out (annotation included). Only a
+      // verbatim landing publish-acks, and only THROUGH the change the
+      // pass captured at BeginRewrite — a change a probe worker minted
+      // while this pass was rendering was not in its content and stays
+      // active for the pass its movement wakes.
+      obs::DefaultTrace().MarkPublished(generation, -1, change);
       state->last_published_level = decision.level;
     }
     RecordLabelDiff(merged, provenance, state);
@@ -1522,17 +1574,35 @@ std::string SnapshotsJson(const sched::SnapshotStore& store) {
   return out + "}";
 }
 
-// SIGUSR1 post-mortem dump: journal + snapshots + labels/provenance,
-// written atomically so a `kubectl cp` mid-dump never reads a torn file.
+// SIGUSR1 post-mortem dump: journal + trace ring + snapshots +
+// labels/provenance + the published-labels view (what the sink holds,
+// i.e. the watcher's drift reference), written atomically so a
+// `kubectl cp` mid-dump never reads a torn file — one signal captures
+// the full causal state. With --trace-dump set, the trace ring is also
+// written there as a Chrome trace-event (Perfetto-loadable) document.
 void WriteDebugDump(const config::Config& config,
                     const sched::SnapshotStore& store,
-                    const LabelState& state) {
+                    const LabelState& state,
+                    PublishedLabelsView* published) {
   const std::string& path = config.flags.debug_dump_file;
   obs::Journal& journal = obs::DefaultJournal();
   // The dump records itself first, so the written journal shows when
   // (and that) the operator pulled it.
   journal.Record("dump", "", "SIGUSR1 debug dump requested",
                  {{"path", path}});
+  std::string published_json = "null";
+  lm::Labels sink_view;
+  if (published != nullptr && published->Get(&sink_view)) {
+    published_json = "{";
+    bool first = true;
+    for (const auto& [k, v] : sink_view) {
+      if (!first) published_json += ",";
+      first = false;
+      published_json += jsonlite::Quote(jsonlite::SanitizeUtf8(k)) + ":" +
+                        jsonlite::Quote(jsonlite::SanitizeUtf8(v));
+    }
+    published_json += "}";
+  }
   std::string body =
       "{\"dumped_at\":" +
       std::to_string(static_cast<long long>(WallClockSeconds())) +
@@ -1540,15 +1610,28 @@ void WriteDebugDump(const config::Config& config,
       ",\"labels\":" +
       LabelsDebugJson(journal.generation(), state.labels,
                       state.provenance) +
+      ",\"published_labels\":" + published_json +
       ",\"snapshots\":" + SnapshotsJson(store) +
+      ",\"trace\":" + obs::DefaultTrace().RenderJson() +
       ",\"journal\":" + journal.RenderJson() + "}\n";
   Status s = WriteFileAtomically(path, body);
   if (s.ok()) {
-    TFD_LOG_INFO << "wrote debug dump (journal + snapshots + label "
-                    "provenance) to "
+    TFD_LOG_INFO << "wrote debug dump (journal + trace + snapshots + "
+                    "label provenance + published-labels view) to "
                  << path;
   } else {
     TFD_LOG_WARNING << "debug dump failed: " << s.message();
+  }
+  if (!config.flags.trace_dump_file.empty()) {
+    Status chrome = WriteFileAtomically(
+        config.flags.trace_dump_file,
+        obs::DefaultTrace().RenderChromeTrace() + "\n");
+    if (chrome.ok()) {
+      TFD_LOG_INFO << "wrote Perfetto-loadable trace dump to "
+                   << config.flags.trace_dump_file;
+    } else {
+      TFD_LOG_WARNING << "trace dump failed: " << chrome.message();
+    }
   }
 }
 
@@ -1610,7 +1693,8 @@ bool DeadlineOwesPass(const config::Config& config,
 int EventWait(const config::Config& config, const sched::SnapshotStore& store,
               lm::LabelGovernor* governor, LabelState* state,
               PassCache* cache, sched::WakeupMux* mux,
-              const std::string& desync_node, uint64_t* tick) {
+              const std::string& desync_node, uint64_t* tick,
+              PublishedLabelsView* published) {
   using Reason = sched::WakeupMux::Reason;
   while (true) {
     double now_wall = WallClockSeconds();
@@ -1659,7 +1743,7 @@ int EventWait(const config::Config& config, const sched::SnapshotStore& store,
     }
     if (wake.reasons & static_cast<uint32_t>(Reason::kSignal)) {
       if (wake.signal == SIGUSR1) {
-        WriteDebugDump(config, store, *state);
+        WriteDebugDump(config, store, *state, published);
         continue;  // an operator dump must not trigger a pass
       }
       return wake.signal;
@@ -1874,7 +1958,11 @@ RunOutcome Run(const config::Config& config, int config_generation,
           *cluster, watch_options,
           [published](lm::Labels* out) { return published->Get(out); },
           [mux, event_mode](const std::string& reason) {
-            (void)reason;
+            // Foreign drift is a label-moving origin: mint the change
+            // id HERE so the heal pass (and its re-asserting CR write)
+            // carries it end to end.
+            obs::DefaultTrace().Mint("watch-drift", "cr",
+                                     "foreign CR drift: " + reason);
             double expected = 0;
             g_watch_drift_at.compare_exchange_strong(expected,
                                                      WallClockSeconds());
@@ -1984,7 +2072,7 @@ RunOutcome Run(const config::Config& config, int config_generation,
       // deadline (sched/wakeup.h); signals (and config-input inotify,
       // folded into SIGHUP) surface here.
       sig = EventWait(config, *store, governor, state, cache, mux,
-                      desync_node, tick);
+                      desync_node, tick, published);
     } else {
       // Legacy fixed-interval sleep, interruptibly: SIGHUP → reload
       // config and restart the loop; SIGUSR1 → write the post-mortem
@@ -2019,7 +2107,7 @@ RunOutcome Run(const config::Config& config, int config_generation,
           break;
         }
         if (sig == SIGUSR1) {
-          WriteDebugDump(config, *store, *state);
+          WriteDebugDump(config, *store, *state, published);
           continue;  // an operator dump must not perturb the cadence
         }
         break;
@@ -2262,6 +2350,8 @@ int Main(int argc, char** argv) {
                        : log::Format::kKlog);
     obs::DefaultJournal().SetCapacity(
         static_cast<size_t>(loaded.config.flags.journal_capacity));
+    obs::DefaultTrace().SetCapacity(
+        static_cast<size_t>(loaded.config.flags.trace_capacity));
     // Fault injection arms on first load and re-arms only when the
     // SPEC changes; a reload with the same spec keeps the live rule
     // state (consumed counts, RNG position) — else a count=1
@@ -2353,6 +2443,7 @@ int Main(int argc, char** argv) {
       obs::ServerOptions options;
       options.addr = flags.introspection_addr;
       options.journal = &obs::DefaultJournal();
+      options.trace = &obs::DefaultTrace();
       // Freshness window: 2x the rewrite cadence — plus the health-exec
       // budget when --device-health=full, whose hourly re-measure
       // legitimately blocks a pass for up to health_exec_timeout_s; a
@@ -2376,7 +2467,8 @@ int Main(int argc, char** argv) {
             label_state.provenance));
       }
       TFD_LOG_INFO << "introspection server serving /healthz /readyz "
-                      "/metrics /debug/journal /debug/labels on "
+                      "/metrics /debug/journal /debug/labels /debug/trace "
+                      "on "
                    << flags.introspection_addr << " (port "
                    << server->port() << ")";
     }

@@ -431,6 +431,133 @@ def aggregate_gate(record_path, reference_path, slack):
     return problems
 
 
+# Per-(failure-class, stage) p99 budgets (ms) for the causal stage
+# breakdown (ISSUE 15). Derived from the protocol constants the soak
+# models, with headroom — NOT from the committed record, so a protocol
+# regression (a slower ageing path, an unpaced brownout retry) trips
+# the budget even if the committed reference regresses with it:
+#   detect   — probe tick (<=1s) for self-detectable classes; report
+#              ageing (agreement 2s + leader fold) for wedge/partition
+#   agree    — verdict adoption; partition may pay lease expiry (3s)
+#   hold     — render/coalesce (0.1-0.5s) + member skew (0.3s)
+#   publish  — normally ~0 (the store write is the attempt); a brownout
+#              defers at Retry-After pacing (<=5s storm + pacing)
+#   fanout   — watch wire latency (ms)
+#   schedule — delivery -> placeable flip (the drain tick at worst)
+CLUSTER_STAGE_BUDGETS_MS = {
+    "detect": {"degrade": 1600, "preempt": 1600, "wedge": 3600,
+               "partition": 3600},
+    "agree": {"degrade": 1500, "preempt": 1500, "wedge": 1500,
+              "partition": 4500},
+    "hold": {"*": 1200},
+    "publish": {"*": 6500},
+    "fanout": {"*": 100},
+    "schedule": {"*": 600},
+}
+
+
+def cluster_stage_gate(record, problems):
+    """The stage-breakdown half of cluster_gate: per-class per-stage
+    p99 budgets, sum-consistency with the end-to-end numbers, and the
+    change-id propagation invariants. Absent keys FAIL loudly (the
+    satellite-2 contract: a record missing the breakdown must not sail
+    through on defaults)."""
+    breakdown = require(record, "stage_breakdown", "cluster", problems)
+    by_op = require(record, "label_to_placement_by_op", "cluster",
+                    problems)
+    if breakdown is not None:
+        for op in sorted(breakdown):
+            sb = breakdown[op]
+            stages = sb.get("stages", {})
+            for stage, budgets in sorted(
+                    CLUSTER_STAGE_BUDGETS_MS.items()):
+                budget = budgets.get(op, budgets.get("*"))
+                if budget is None:
+                    continue
+                got = stages.get(stage, {}).get("p99_ms")
+                if got is None:
+                    problems.append(
+                        f"{op}: stage breakdown has no {stage} p99")
+                elif got > budget:
+                    problems.append(
+                        f"{op}/{stage} p99 {got}ms exceeds its "
+                        f"{budget}ms stage budget")
+            # Sum-consistency: the stages PARTITION each chain's e2e
+            # latency, so stage means sum exactly (rounding slack) and
+            # the stage-p99 sum brackets the e2e p99 — it can never be
+            # below it (p99 of a sum <= sum of p99s at these sample
+            # sizes) and a sum far above it means one stage's tail
+            # belongs to a different chain than the headline (worth a
+            # look, not a pass).
+            if abs(sb.get("mean_stage_sum_ms", -1) -
+                   sb.get("mean_e2e_ms", 1)) > 0.02:
+                problems.append(
+                    f"{op}: mean stage sum {sb.get('mean_stage_sum_ms')}"
+                    f"ms != mean e2e {sb.get('mean_e2e_ms')}ms — the "
+                    "stages no longer partition the latency")
+            p99_sum = sb.get("stage_p99_sum_ms")
+            e2e_p99 = sb.get("e2e_p99_ms")
+            if None in (p99_sum, e2e_p99):
+                problems.append(f"{op}: stage breakdown missing "
+                                "stage_p99_sum_ms / e2e_p99_ms")
+            elif p99_sum < e2e_p99 - 0.01 or \
+                    p99_sum > e2e_p99 * 2.0 + 100.0:
+                problems.append(
+                    f"{op}: stage p99 sum {p99_sum}ms is not "
+                    f"sum-consistent with the e2e p99 {e2e_p99}ms "
+                    "(want e2e <= sum <= 2x e2e + 100ms)")
+            # The breakdown's e2e must BE the existing headline metric,
+            # not a parallel measurement that can drift from it.
+            if by_op is not None and op in by_op:
+                headline = by_op[op].get("p99_ms")
+                if headline is not None and e2e_p99 is not None and \
+                        abs(headline - e2e_p99) > 0.01:
+                    problems.append(
+                        f"{op}: breakdown e2e p99 {e2e_p99}ms != "
+                        f"label_to_placement_by_op p99 {headline}ms")
+    overall = require(record, "stage_breakdown_overall", "cluster",
+                      problems)
+    headline = record.get("label_to_placement_p99_ms")
+    if overall is not None and headline is not None:
+        e2e = overall.get("e2e_p99_ms")
+        p99_sum = overall.get("stage_p99_sum_ms")
+        if e2e is None or abs(e2e - headline) > 0.01:
+            problems.append(
+                f"overall breakdown e2e p99 {e2e}ms != headline "
+                f"label_to_placement_p99_ms {headline}ms")
+        if p99_sum is None or p99_sum < headline - 0.01 or \
+                p99_sum > headline * 2.0 + 100.0:
+            problems.append(
+                f"overall stage p99 sum {p99_sum}ms is not "
+                f"sum-consistent with label_to_placement_p99_ms "
+                f"{headline}ms")
+    changes = require(record, "change_ids", "cluster", problems)
+    if changes is not None:
+        if changes.get("active_at_end") != 0:
+            problems.append(
+                f"{changes.get('active_at_end')} change id(s) never "
+                "closed — a causal chain leaked")
+        if changes.get("closed") != record.get("failures_converged"):
+            problems.append(
+                f"closed chains {changes.get('closed')} != converged "
+                f"failures {record.get('failures_converged')} — the "
+                "breakdown does not cover the headline metric")
+        if not changes.get("label_events_joined"):
+            problems.append("no watch delivery carried a change id "
+                            "(annotation propagation broken)")
+        if not changes.get("inventory_joined"):
+            problems.append("no inventory rollup carried a change id "
+                            "(aggregator echo broken)")
+    agg = require(record, "agg_debounce_ms_by_op", "cluster", problems)
+    if agg:
+        for op in sorted(agg):
+            p99 = agg[op].get("p99_ms")
+            if p99 is not None and p99 > 2000.0:
+                problems.append(
+                    f"agg-debounce p99 {p99}ms for {op} exceeds the "
+                    "debounce + 1s bound (2000ms)")
+
+
 def cluster_gate(record_path, reference_path, slack,
                  placement_budget_ms=8000.0, recovery_budget_s=10.0):
     """Gates an end-to-end placement-quality record
@@ -512,6 +639,10 @@ def cluster_gate(record_path, reference_path, slack,
         problems.append(
             f"{recomputes} aggregator full recomputes during the soak "
             "(must stay O(delta))")
+
+    # The causal stage breakdown (ISSUE 15): per-stage budgets,
+    # sum-consistency with the e2e headline, change-id propagation.
+    cluster_stage_gate(record, problems)
 
     ref = load_reference(reference_path, "cluster", problems)
     if ref is not None:

@@ -110,6 +110,12 @@ class ClusterApiServer:
         self.shards = shards
         self.objects = {}          # node -> labels
         self.watchers = []         # objects with .on_event(t, node, labels)
+        # Causal-trace hooks (set by run_sim once the topology exists):
+        # the store stamps the "publish" stage for every open change of
+        # the writing host's slice — the sim analogue of the daemon's
+        # write-acked trace stamp.
+        self.tracker = None
+        self.hosts_by_name = {}
         self.by_verb = {}
         self.shard_buckets = {}    # (shard, sec) -> writes
         self.brownout_until = 0.0
@@ -165,6 +171,11 @@ class ClusterApiServer:
         assert not self.brownout_active(t), \
             "daemon_apply during a brownout: the caller owns pacing"
         self.objects[node] = dict(labels)
+        if self.tracker is not None:
+            host = self.hosts_by_name.get(node)
+            if host is not None:
+                for m in host.slice.members:
+                    self.tracker.stamp_node(m.name, "publish", t)
         for w in self.watchers:
             self.clock.schedule(
                 t + self._wire_latency(),
@@ -177,9 +188,27 @@ class ClusterAggregator(SimAggregator):
     apply is fanned out to the scheduler (one more collection watcher,
     watching the output object) after wire latency."""
 
-    def __init__(self, server, clock, debounce_s, lease_s, deliver):
+    def __init__(self, server, clock, debounce_s, lease_s, deliver,
+                 tracker):
         super().__init__(server, clock, debounce_s, lease_s)
         self.deliver = deliver
+        self.tracker = tracker
+        # Change ids seen in consumed node events, awaiting a rollup
+        # publish: cid -> (first-seen t, op). Resolved (and echoed onto
+        # the delivered inventory, the sim's annotation) at flush time —
+        # the agg-debounce channel of the stage breakdown.
+        self.pending_change_ids = {}
+        self.agg_latency_ms_by_op = {}
+
+    def on_event(self, t, node, labels):
+        if labels and self.tracker is not None:
+            cid = labels.get(clusterlib.CHANGE_KEY, "")
+            if cid.isdigit():
+                record = self.tracker.records.get(int(cid))
+                if record is not None and \
+                        int(cid) not in self.pending_change_ids:
+                    self.pending_change_ids[int(cid)] = (t, record["op"])
+        super().on_event(t, node, labels)
 
     def _flush(self, t):
         if self.server.brownout_active(t):
@@ -197,9 +226,22 @@ class ClusterAggregator(SimAggregator):
         super()._flush(t)
         if len(self.server.output_writes) > before:
             _, labels = self.server.output_writes[-1]
+            delivered = dict(labels)
+            if self.pending_change_ids:
+                # Echo the latest change id this rollup folded in (the
+                # inventory object's annotation in the real runner) and
+                # score the agg-debounce channel: node-event seen ->
+                # rollup delivered.
+                delivered[clusterlib.CHANGE_KEY] = str(
+                    max(self.pending_change_ids))
+                for cid in sorted(self.pending_change_ids):
+                    seen_t, op = self.pending_change_ids[cid]
+                    self.agg_latency_ms_by_op.setdefault(op, []).append(
+                        (t - seen_t) * 1000.0)
+                self.pending_change_ids = {}
             self.clock.schedule(
                 t + self.server._wire_latency(),
-                lambda now, lb=dict(labels): self.deliver(now, lb))
+                lambda now, lb=delivered: self.deliver(now, lb))
 
 
 # ---- hosts + slices (the simulated daemons) -------------------------------
@@ -215,6 +257,7 @@ class SimHost:
         self.clock = clock
         self.rng = rng
         self.slice = slice_ref
+        self.tracker = slice_ref.tracker
         self.member_idx = member_idx
         self.name = f"sim-s{slice_ref.idx:02d}-h{member_idx:02d}"
         self.chips = 8
@@ -257,6 +300,15 @@ class SimHost:
         }
         if self.gt_preempting:
             labels[clusterlib.LIFECYCLE_PREEMPT] = "true"
+        # The change-id annotation analogue: the latest open change any
+        # slice member is carrying rides every member's publish (the
+        # verdict moves every member's labels; the annotation is how
+        # the scheduler-side join proves the propagation).
+        open_ids = [self.tracker.open_change(m.name)
+                    for m in self.slice.members]
+        open_ids = [i for i in open_ids if i is not None]
+        if open_ids:
+            labels[clusterlib.CHANGE_KEY] = str(max(open_ids))
         return labels
 
     def mark_dirty(self, t):
@@ -273,6 +325,12 @@ class SimHost:
         if not self.reachable():
             self.publish_pending = False  # re-marked on heal
             return
+        # First attempt closes the "hold" stage for every open slice
+        # change (render/coalesce is done); a brownout deferral from
+        # here on is "publish" time — first-wins stamps keep the retry
+        # from moving the mark.
+        for m in self.slice.members:
+            self.tracker.stamp_node(m.name, "hold", now)
         if self.server.brownout_active(now):
             # Server-directed pacing: retry, keep the pending slot so
             # later dirtying events ride this retry.
@@ -295,6 +353,7 @@ class SimHost:
     def _detected(self, now):
         if not self.gt_alive:
             return
+        self.tracker.stamp_node(self.name, "detect", now)
         self.mark_dirty(now)
         self.slice.on_report(now, self)
 
@@ -306,11 +365,12 @@ class SimSlice:
     timeout for stale reports, lease-expiry failover, preempting member
     -> proactive degraded) at simulation fidelity."""
 
-    def __init__(self, server, clock, rng, idx, host_count):
+    def __init__(self, server, clock, rng, idx, host_count, tracker):
         self.server = server
         self.clock = clock
         self.rng = rng
         self.idx = idx
+        self.tracker = tracker
         self.slice_id = f"slice-{idx:04d}"
         self.members = [SimHost(server, clock, rng, self, h)
                         for h in range(host_count)]
@@ -353,9 +413,16 @@ class SimSlice:
         """A member stopped refreshing its report (wedge / partition /
         death): the leader notices when the report ages past the
         agreement timeout."""
+        def aged(now):
+            # Report ageing IS the detection for a member that cannot
+            # self-report: the "detect" stage of a wedge/partition
+            # chain ends here (the agreement timeout is its budget).
+            for m in self.members:
+                if not m.reachable():
+                    self.tracker.stamp_node(m.name, "detect", now)
+            self.recompute(now)
         self.clock.schedule(
-            t + AGREEMENT_S + self.rng.uniform(0.1, 0.5),
-            lambda now: self.recompute(now))
+            t + AGREEMENT_S + self.rng.uniform(0.1, 0.5), aged)
         if not self.leader().reachable():
             self._schedule_failover(t)
 
@@ -387,6 +454,12 @@ class SimSlice:
         if verdict == self.adopted_verdict:
             return
         self.adopted_verdict = verdict
+        # The adopted verdict now reflects every open change on this
+        # slice's members: the "agree" stage ends (for a leader-death
+        # window this lands after the lease-expiry failover, which is
+        # exactly the budget the partition class pays).
+        for m in self.members:
+            self.tracker.stamp_node(m.name, "agree", now)
         # Every live member republishes the agreed labels (small skew:
         # the members' own pass loops).
         for m in self.members:
@@ -498,12 +571,14 @@ class Harness:
     sides (ground truth and labels) — the scheduler sees labels only."""
 
     def __init__(self, clock, rng, sched, hosts_by_name, arrival_dt,
+                 tracker,
                  job_classes=("any", "silver", "any", "gold", "silver")):
         self.clock = clock
         self.rng = rng
         self.sched = sched
         self.hosts = hosts_by_name
         self.arrival_dt = arrival_dt
+        self.changes = tracker
         self.job_classes = job_classes
         self.queue = []            # FIFO of Job
         self.jobs = {}             # job_id -> Job
@@ -533,11 +608,25 @@ class Harness:
 
     def on_label_event(self, now, node, labels):
         self.sched_events += 1
+        # The change-id join: a delivery carrying a known change id
+        # proves the annotation propagated daemon -> apiserver ->
+        # scheduler; the "fanout" stage ends for every open change of
+        # the publishing host's slice.
+        cid = (labels or {}).get(clusterlib.CHANGE_KEY, "")
+        if cid.isdigit() and int(cid) < self.changes.next_change:
+            self.changes.label_events_joined += 1
+        host = self.hosts.get(node)
+        if host is not None:
+            for m in host.slice.members:
+                self.changes.stamp_node(m.name, "fanout", now)
         self.sched.on_event(node, labels)
         self._after_view_change(now)
 
     def on_inventory(self, now, labels):
         self.inventory_updates += 1
+        cid = (labels or {}).get(clusterlib.CHANGE_KEY, "")
+        if cid.isdigit() and int(cid) < self.changes.next_change:
+            self.changes.inventory_joined += 1
         self.sched.on_inventory(labels)
         self._schedule_drain(now)
 
@@ -552,6 +641,10 @@ class Harness:
                 t0, op = self.down_track.pop(node)
                 self.latency_ms_by_op.setdefault(op, []).append(
                     (now - t0) * 1000.0)
+                # Close the causal chain at the SAME moment the
+                # end-to-end latency resolves: the stage durations
+                # partition exactly this number.
+                self.changes.close(node, now)
         for node in sorted(self.up_track):
             if self.sched.placeable(node, blocked):
                 t0, op = self.up_track.pop(node)
@@ -666,6 +759,7 @@ class Harness:
                         server.brownout_until + BROWNOUT_GRACE_S)
         self.excused_until[node] = until
         self.down_track[node] = (now, op)
+        self.changes.mint(op, node, now)
         # A refail before the previous heal's recovery converged cancels
         # that heal's tracking: the node is down again, so neither its
         # recovery latency nor its first-landing watch can resolve — a
@@ -677,7 +771,10 @@ class Harness:
 
     def note_up(self, now, node, op):
         self.excused_until.pop(node, None)
-        self.down_track.pop(node, None)  # heal raced the label pipeline
+        if self.down_track.pop(node, None) is not None:
+            # Heal raced the label pipeline: the failure never reached
+            # the scheduler, so its causal chain can never close.
+            self.changes.discard(node)
         self.up_track[node] = (now, op)
 
     def extend_windows_for_brownout(self, now, brownout_until):
@@ -754,16 +851,19 @@ def run_sim(args, schedule_text):
     rng = random.Random(args.seed)
     clock = SimClock()
     server = ClusterApiServer(clock, rng, shards=args.shards)
-    slices = [SimSlice(server, clock, rng, i, args.hosts)
+    tracker = clusterlib.ChangeTracker()
+    slices = [SimSlice(server, clock, rng, i, args.hosts, tracker)
               for i in range(args.slices)]
     hosts_by_name = {m.name: m for sl in slices for m in sl.members}
+    server.tracker = tracker
+    server.hosts_by_name = hosts_by_name
 
     sched = clusterlib.SimScheduler()
     harness = Harness(clock, rng, sched, hosts_by_name,
-                      arrival_dt=1.0 / args.job_rate)
+                      arrival_dt=1.0 / args.job_rate, tracker=tracker)
     aggregator = ClusterAggregator(
         server, clock, AGG_DEBOUNCE_S, AGG_LEASE_S,
-        deliver=harness.on_inventory)
+        deliver=harness.on_inventory, tracker=tracker)
 
     events = clusterlib.parse_schedule(schedule_text)
     storm_start, storm_end = storm_window(events)
@@ -846,6 +946,30 @@ def run_sim(args, schedule_text):
             op: {"n": len(v),
                  "p99_ms": round(percentile(v, 99), 3)}
             for op, v in sorted(harness.latency_ms_by_op.items())},
+        # Causal decomposition (ISSUE 15): per-failure-class stage
+        # breakdown of the SAME chains the end-to-end latency measures,
+        # plus the parallel agg-debounce channel and the change-id
+        # propagation proof. bench_gate --cluster budget-gates each
+        # stage and checks sum-consistency against the e2e numbers.
+        "stage_breakdown": clusterlib.stage_breakdown(
+            tracker.closed, percentile),
+        "stage_breakdown_overall": clusterlib.stage_breakdown(
+            [dict(c, op="all") for c in tracker.closed],
+            percentile).get("all"),
+        "agg_debounce_ms_by_op": {
+            op: {"n": len(v),
+                 "p50_ms": round(percentile(v, 50), 3),
+                 "p99_ms": round(percentile(v, 99), 3)}
+            for op, v in sorted(
+                aggregator.agg_latency_ms_by_op.items())},
+        "change_ids": {
+            "minted": tracker.next_change - 1,
+            "closed": len(tracker.closed),
+            "discarded": tracker.discarded,
+            "active_at_end": tracker.active(),
+            "label_events_joined": tracker.label_events_joined,
+            "inventory_joined": tracker.inventory_joined,
+        },
         "failures_tracked": (len(down_lat) + len(harness.down_track)),
         "failures_converged": len(down_lat),
         "bad_placements_within_window": harness.bad_within,
@@ -909,6 +1033,37 @@ def check_record(record):
     if record["inventory_updates_consumed"] == 0:
         problems.append("the scheduler never consumed an inventory "
                         "rollup (the aggregator is not composed in)")
+    changes = record["change_ids"]
+    if changes["active_at_end"] != 0:
+        problems.append(
+            f"{changes['active_at_end']} change id(s) still open after "
+            "heal-all + drain — a causal chain never closed or was "
+            "leaked")
+    if changes["closed"] != record["failures_converged"]:
+        problems.append(
+            f"closed chains ({changes['closed']}) != converged "
+            f"failures ({record['failures_converged']}) — the stage "
+            "breakdown does not cover the e2e metric")
+    if changes["label_events_joined"] == 0:
+        problems.append("no watch delivery ever carried a change id — "
+                        "the annotation did not propagate to the "
+                        "scheduler")
+    # A short --quick run may legitimately see no rollup-moving event
+    # coincide with an open change; but whenever the agg channel DID
+    # measure a latency, the delivered inventory must have carried the
+    # id (bench_gate additionally requires joins outright on the
+    # committed full-schedule record).
+    if record["agg_debounce_ms_by_op"] and \
+            changes["inventory_joined"] == 0:
+        problems.append("agg-debounce latencies recorded but no "
+                        "inventory rollup carried a change id — the "
+                        "aggregator echo is not composed in")
+    for op, sb in sorted(record["stage_breakdown"].items()):
+        if abs(sb["mean_stage_sum_ms"] - sb["mean_e2e_ms"]) > 0.01:
+            problems.append(
+                f"{op}: stage means sum to {sb['mean_stage_sum_ms']}ms "
+                f"but the e2e mean is {sb['mean_e2e_ms']}ms — the "
+                "stages do not partition the end-to-end latency")
     return problems
 
 

@@ -159,6 +159,13 @@ CONDITIONAL = {
     # Lifecycle fast path (ISSUE 13 satellite): config-gated behind
     # --lifecycle-watch (off on this hermetic boot).
     "tfd_lifecycle_state",
+    # Causal tracing (ISSUE 15): the active gauge registers at the
+    # first mint (the boot's first snapshot movement) and the stage
+    # histogram at the first slow pass — both usually present but racy
+    # against this boot's single-pass scrape; drops need ring overflow.
+    "tfd_trace_active",
+    "tfd_trace_dropped_total",
+    "tfd_pass_stage_duration_seconds",
     # Cluster inventory aggregator (ISSUE 13): these register only in
     # --mode=aggregator, a different runtime from this daemon boot.
     "tfd_agg_state",

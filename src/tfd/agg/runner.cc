@@ -18,6 +18,7 @@
 #include "tfd/k8s/watch.h"
 #include "tfd/obs/journal.h"
 #include "tfd/obs/metrics.h"
+#include "tfd/obs/trace.h"
 #include "tfd/obs/server.h"
 #include "tfd/slice/coord.h"
 #include "tfd/util/http.h"
@@ -135,6 +136,11 @@ struct Shared {
   InventoryStore store;
   FlushController flush;
   bool synced = false;
+  // The latest causal change-id annotation consumed from a node CR
+  // (obs::kChangeAnnotation) — echoed onto the inventory object's own
+  // annotation at the next flush, so the cluster-scoped rollup joins
+  // back to the per-node trace that moved it.
+  std::string last_change;
 
   explicit Shared(double debounce_s) : flush(debounce_s) {}
 };
@@ -186,7 +192,7 @@ class CollectionWatcher {
   // Applies one object's labels to the store under the shared lock;
   // notes dirty + wakes the flush loop when a rollup moved.
   void ApplyObject(const std::string& name, const lm::Labels& labels,
-                   bool deleted) {
+                   bool deleted, const std::string& change = "") {
     if (name.rfind(kCrNamePrefix, 0) != 0) return;  // not a daemon CR
     std::string node = name.substr(sizeof(kCrNamePrefix) - 1);
     std::lock_guard<std::mutex> lock(shared_->mu);
@@ -194,6 +200,7 @@ class CollectionWatcher {
                          : shared_->store.Apply(node, labels);
     SetNodesGauge(shared_->store.nodes());
     if (moved) {
+      if (!change.empty()) shared_->last_change = change;
       shared_->flush.NoteDirty(MonoSeconds());
       shared_->cv.notify_all();
     }
@@ -363,7 +370,8 @@ class CollectionWatcher {
                 rv = event.resource_version;
               }
               ApplyObject(event.name, event.labels,
-                          event.type == k8s::WatchEvent::Type::kDeleted);
+                          event.type == k8s::WatchEvent::Type::kDeleted,
+                          event.change);
               break;
             case k8s::WatchEvent::Type::kUnknown:
               break;
@@ -430,12 +438,20 @@ class CollectionWatcher {
 // sink's ladder, remembered per process.
 Status PublishOutput(const k8s::ClusterConfig& config,
                      const std::string& output_name,
-                     const lm::Labels& labels, bool* apply_unsupported) {
+                     const lm::Labels& labels, bool* apply_unsupported,
+                     const std::string& change = "") {
   std::string named_url = CollectionUrl(config) + "/" + output_name;
+  std::string meta = std::string("\"name\":") + jsonlite::Quote(output_name);
+  if (!change.empty()) {
+    // Echo the latest per-node change id that moved this rollup: the
+    // inventory object stays joinable to the origin daemon's trace.
+    meta += std::string(",\"annotations\":{\"") + obs::kChangeAnnotation +
+            "\":" + jsonlite::Quote(change) + "}";
+  }
   std::string body =
       std::string("{\"apiVersion\":\"nfd.k8s-sigs.io/v1alpha1\","
-                  "\"kind\":\"NodeFeature\",\"metadata\":{\"name\":") +
-      jsonlite::Quote(output_name) + "},\"spec\":{\"labels\":" +
+                  "\"kind\":\"NodeFeature\",\"metadata\":{") +
+      meta + "},\"spec\":{\"labels\":" +
       jsonlite::SerializeStringMap(labels) + "}}";
 
   if (!*apply_unsupported) {
@@ -626,6 +642,11 @@ AggOutcome RunAggregator(const config::Config& config,
     obs::ServerOptions options;
     options.addr = flags.introspection_addr;
     options.journal = &obs::DefaultJournal();
+    // The aggregator mints no changes of its own (its per-event trace
+    // state is the inventory annotation echo), but the server's 404
+    // catalogue advertises /debug/trace — serve the (empty) ring
+    // rather than 404 on a path we claim to serve.
+    options.trace = &obs::DefaultTrace();
     // Ready = the lease loop is making contact; 3 leases of slack.
     options.stale_after_s = std::max(120, 3 * flags.agg_lease_duration_s);
     Result<std::unique_ptr<obs::IntrospectionServer>> started =
@@ -697,6 +718,7 @@ AggOutcome RunAggregator(const config::Config& config,
 
     bool flush_now = false;
     lm::Labels output;
+    std::string flush_change;
     double staleness_s = 0;
     {
       std::unique_lock<std::mutex> lock(shared.mu);
@@ -715,6 +737,7 @@ AggOutcome RunAggregator(const config::Config& config,
           shared.flush.ShouldFlush(now) && now >= flush_retry_at) {
         flush_now = true;
         output = shared.store.BuildOutputLabels();
+        flush_change = shared.last_change;
         staleness_s = now - shared.flush.dirty_since();
       }
     }
@@ -722,12 +745,21 @@ AggOutcome RunAggregator(const config::Config& config,
     if (flush_now) {
       auto t0 = std::chrono::steady_clock::now();
       Status published = PublishOutput(*cluster, flags.agg_output_name,
-                                       output, &apply_unsupported);
+                                       output, &apply_unsupported,
+                                       flush_change);
       double write_s = obs::SecondsSince(t0);
       if (published.ok()) {
         {
           std::lock_guard<std::mutex> lock(shared.mu);
           shared.flush.NoteFlushed();
+          // The echoed change is consumed by this flush: a later
+          // rollup moved only by change-less events must not re-stamp
+          // a stale id (a newer change that arrived mid-publish stays
+          // for the next flush). A FAILED publish keeps it — the retry
+          // still owes the annotation.
+          if (shared.last_change == flush_change) {
+            shared.last_change.clear();
+          }
         }
         flush_retry_at = 0;
         obs::Default()

@@ -424,11 +424,39 @@ std::vector<FlagDef> MakeFlagDefs(Flags* f) {
   defs.push_back({"debug-dump-file",
                   {"TFD_DEBUG_DUMP_FILE"},
                   "debugDumpFile",
-                  "path the SIGUSR1 post-mortem dump (journal + snapshots "
-                  "+ label provenance) is written to",
+                  "path the SIGUSR1 post-mortem dump (journal + trace "
+                  "ring + snapshots + label provenance + published-labels "
+                  "view) is written to",
                   false,
                   [f](const std::string& v) {
                     return SetString(&f->debug_dump_file, v);
+                  }});
+  defs.push_back({"trace-capacity",
+                  {"TFD_TRACE_CAPACITY"},
+                  "traceCapacity",
+                  "causal-trace ring-buffer capacity (drop-oldest; drops "
+                  "counted in tfd_trace_dropped_total)",
+                  false,
+                  [f](const std::string& v) {
+                    int parsed = 0;
+                    if (!ParseNonNegInt(TrimSpace(v), &parsed) ||
+                        parsed < 1) {
+                      return Status::Error("trace-capacity must be a "
+                                           "positive integer");
+                    }
+                    f->trace_capacity = parsed;
+                    return Status::Ok();
+                  }});
+  defs.push_back({"trace-dump",
+                  {"TFD_TRACE_DUMP"},
+                  "traceDump",
+                  "path SIGUSR1 writes the causal-trace ring to as a "
+                  "Chrome trace-event (Perfetto-loadable) document; '' "
+                  "disables (the JSON ring still rides /debug/trace and "
+                  "the post-mortem dump)",
+                  false,
+                  [f](const std::string& v) {
+                    return SetString(&f->trace_dump_file, v);
                   }});
   defs.push_back({"state-file",
                   {"TFD_STATE_FILE"},
@@ -1219,6 +1247,8 @@ std::string ToJson(const Config& config) {
       << ",\"logFormat\":" << jstr(f.log_format)
       << ",\"journalCapacity\":" << f.journal_capacity
       << ",\"debugDumpFile\":" << jstr(f.debug_dump_file)
+      << ",\"traceCapacity\":" << f.trace_capacity
+      << ",\"traceDump\":" << jstr(f.trace_dump_file)
       << ",\"stateFile\":" << jstr(f.state_file)
       << ",\"sinkBreakerFailures\":" << f.sink_breaker_failures
       << ",\"sinkBreakerCooldown\":\"" << f.sink_breaker_cooldown_s << "s\""

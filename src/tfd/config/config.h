@@ -188,9 +188,19 @@ struct Flags {
   // drop-oldest, drops counted in tfd_journal_dropped_total. Bounds the
   // recorder's memory no matter how eventful the node is.
   int journal_capacity = 512;
-  // SIGUSR1 post-mortem dump target: journal + per-source snapshot
-  // state + current labels/provenance, written atomically.
+  // SIGUSR1 post-mortem dump target: journal + trace ring + per-source
+  // snapshot state + current labels/provenance + the published-labels
+  // view, written atomically.
   std::string debug_dump_file = "/tmp/tpu-feature-discovery-debug.json";
+  // Causal-trace ring size (obs/trace.h): fixed capacity, drop-oldest,
+  // drops counted in tfd_trace_dropped_total. Bounds the recorder's
+  // memory no matter how label-eventful the node is.
+  int trace_capacity = 256;
+  // Chrome trace-event (Perfetto-loadable) dump target: SIGUSR1 writes
+  // the trace ring here as a loadable timeline next to the JSON
+  // post-mortem. Empty disables the Perfetto dump (the JSON trace ring
+  // still rides the post-mortem and /debug/trace).
+  std::string trace_dump_file;
   // Crash-safe warm restart (sched/state.h): after every successful
   // rewrite the published labels + provenance + serving decision are
   // persisted here (checksummed, schema- and node-gated); on boot a

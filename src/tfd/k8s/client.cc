@@ -8,6 +8,7 @@
 #include "tfd/fault/fault.h"
 #include "tfd/obs/journal.h"
 #include "tfd/obs/metrics.h"
+#include "tfd/obs/trace.h"
 #include "tfd/util/file.h"
 #include "tfd/util/http.h"
 #include "tfd/util/jsonlite.h"
@@ -182,13 +183,22 @@ Result<http::Response> CountedRequest(const char* method_point,
 // master; the nfd node-name label tells NFD which node this CR describes.
 // (Updates patch or serialize the mutated fetched CR instead.)
 std::string CrBody(const ClusterConfig& config, const lm::Labels& labels) {
+  std::string meta = std::string("\"name\":") +
+                     jsonlite::Quote(CrName(config.node_name)) +
+                     ",\"namespace\":" + jsonlite::Quote(config.namespace_) +
+                     ",\"labels\":{\"" + kNodeNameLabel + "\":" +
+                     jsonlite::Quote(config.node_name) + "}";
+  if (!config.change_annotation.empty()) {
+    // The causal-trace join key rides as an ANNOTATION (obs/trace.h) —
+    // annotations are not label input, so schema and scheduler
+    // eligibility stay untouched.
+    meta += std::string(",\"annotations\":{\"") + obs::kChangeAnnotation +
+            "\":" + jsonlite::Quote(config.change_annotation) + "}";
+  }
   return std::string("{\"apiVersion\":\"") + kNfdGroup + "/" + kNfdVersion +
-         "\",\"kind\":\"NodeFeature\"," + "\"metadata\":{\"name\":" +
-         jsonlite::Quote(CrName(config.node_name)) +
-         ",\"namespace\":" + jsonlite::Quote(config.namespace_) +
-         ",\"labels\":{\"" + kNodeNameLabel + "\":" +
-         jsonlite::Quote(config.node_name) + "}},\"spec\":{\"labels\":" +
-         jsonlite::SerializeStringMap(labels) + "}}";
+         "\",\"kind\":\"NodeFeature\"," + "\"metadata\":{" + meta +
+         "},\"spec\":{\"labels\":" + jsonlite::SerializeStringMap(labels) +
+         "}}";
 }
 
 // metadata.resourceVersion of a parsed CR ("" when absent).
@@ -233,7 +243,8 @@ std::string BuildMergePatch(const lm::Labels& acked,
                             const lm::Labels& desired,
                             const std::string& node_name,
                             bool fix_node_name,
-                            const std::string& resource_version) {
+                            const std::string& resource_version,
+                            const std::string& change_annotation) {
   std::string spec;
   auto add = [&spec](const std::string& key, const std::string* value) {
     if (!spec.empty()) spec += ",";
@@ -261,6 +272,13 @@ std::string BuildMergePatch(const lm::Labels& acked,
     if (!meta.empty()) meta += ",";
     meta += std::string("\"labels\":{\"") + kNodeNameLabel +
             "\":" + jsonlite::Quote(node_name) + "}";
+  }
+  if (!change_annotation.empty()) {
+    // Change-id annotation (obs/trace.h): merge-patch semantics set
+    // just this one annotation key, leaving foreign annotations alone.
+    if (!meta.empty()) meta += ",";
+    meta += std::string("\"annotations\":{\"") + obs::kChangeAnnotation +
+            "\":" + jsonlite::Quote(change_annotation) + "}";
   }
   std::string out = "{";
   if (!meta.empty()) out += "\"metadata\":{" + meta + "},";
@@ -519,7 +537,8 @@ Status UpdateNodeFeature(const ClusterConfig& config,
     if (state->known && patching) {
       std::string patch =
           BuildMergePatch(state->acked, labels, config.node_name,
-                          /*fix_node_name=*/false, state->resource_version);
+                          /*fix_node_name=*/false, state->resource_version,
+                          config.change_annotation);
       if (!patch.empty()) {
         done = TryPatch(patch, /*zero_get=*/true);
         if (done) return settled;
@@ -604,7 +623,7 @@ Status UpdateNodeFeature(const ClusterConfig& config,
       std::string patch =
           BuildMergePatch(current, labels, config.node_name,
                           /*fix_node_name=*/!node_name_ok,
-                          resource_version);
+                          resource_version, config.change_annotation);
       if (!patch.empty()) {
         done = TryPatch(patch, /*zero_get=*/false);
         if (done) return settled;
@@ -640,6 +659,17 @@ Status UpdateNodeFeature(const ClusterConfig& config,
     }
     meta_labels->Set(kNodeNameLabel,
                      jsonlite::MakeString(config.node_name));
+    if (!config.change_annotation.empty()) {
+      jsonlite::ValuePtr annotations = metadata->Get("annotations");
+      if (!annotations ||
+          annotations->kind != jsonlite::Value::Kind::kObject) {
+        annotations = std::make_shared<jsonlite::Value>();
+        annotations->kind = jsonlite::Value::Kind::kObject;
+        metadata->Set("annotations", annotations);
+      }
+      annotations->Set(obs::kChangeAnnotation,
+                       jsonlite::MakeString(config.change_annotation));
+    }
     jsonlite::ValuePtr spec = cr.Get("spec");
     if (!spec || spec->kind != jsonlite::Value::Kind::kObject) {
       spec = std::make_shared<jsonlite::Value>();

@@ -43,6 +43,14 @@ struct ClusterConfig {
   // rejects the patch type (415/405) the ladder demotes per-process:
   // SSA -> merge patch -> GET+PUT (SinkState::apply_unsupported).
   bool use_apply = false;
+  // Causal-trace join key (obs/trace.h): when non-empty, every write
+  // verb stamps metadata.annotations["tfd.google.com/change-id"] with
+  // this value — an ANNOTATION, never a spec.label, so the published
+  // schema and scheduler eligibility are untouched while the slice
+  // blackboard, the aggregator, and /debug/trace stay joinable across
+  // processes. The daemon sets it per write from the latest active
+  // change id ("" = nothing in flight, no annotation written).
+  std::string change_annotation;
 };
 
 // The field manager every server-side apply writes under; foreign
@@ -188,14 +196,18 @@ Status PatchCoordConfigMap(const ClusterConfig& config,
 // Builds the JSON merge patch that turns `acked` into `desired`:
 // changed/added keys verbatim, removed keys null, under spec.labels —
 // plus the nfd node-name metadata label when `fix_node_name` (the GET
-// path saw it missing/wrong) and the resourceVersion precondition when
-// `resource_version` is non-empty. Returns "" when there is nothing to
-// patch. Exposed for the unit tests and the Python twin's parity pins.
+// path saw it missing/wrong), the resourceVersion precondition when
+// `resource_version` is non-empty, and the change-id annotation when
+// `change_annotation` is non-empty (the causal-trace join key; see
+// ClusterConfig::change_annotation). Returns "" when there is nothing
+// to patch. Exposed for the unit tests and the Python twin's parity
+// pins.
 std::string BuildMergePatch(const lm::Labels& acked,
                             const lm::Labels& desired,
                             const std::string& node_name,
                             bool fix_node_name,
-                            const std::string& resource_version);
+                            const std::string& resource_version,
+                            const std::string& change_annotation = "");
 
 }  // namespace k8s
 }  // namespace tfd

@@ -7,6 +7,7 @@
 #include "tfd/k8s/desync.h"
 #include "tfd/obs/journal.h"
 #include "tfd/obs/metrics.h"
+#include "tfd/obs/trace.h"
 #include "tfd/util/http.h"
 #include "tfd/util/jsonlite.h"
 #include "tfd/util/logging.h"
@@ -124,6 +125,14 @@ WatchEvent ParseWatchEventLine(const std::string& line) {
   if (jsonlite::ValuePtr name = object->GetPath("metadata.name");
       name && name->kind == jsonlite::Value::Kind::kString) {
     event.name = name->string_value;
+  }
+  if (jsonlite::ValuePtr annotations =
+          object->GetPath("metadata.annotations");
+      annotations && annotations->kind == jsonlite::Value::Kind::kObject) {
+    if (jsonlite::ValuePtr change = annotations->Get(obs::kChangeAnnotation);
+        change && change->kind == jsonlite::Value::Kind::kString) {
+      event.change = change->string_value;
+    }
   }
   if (event.type == WatchEvent::Type::kError) {
     if (jsonlite::ValuePtr code = object->Get("code");

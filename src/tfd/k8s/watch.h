@@ -61,6 +61,12 @@ struct WatchEvent {
                                  // (agg/runner.cc); the per-object watcher
                                  // ignores it
   std::string resource_version;  // object.metadata.resourceVersion
+  // The causal change-id annotation (obs::kChangeAnnotation, "" when
+  // absent): minted by the writing daemon at the label-moving origin
+  // and echoed onward by cluster-side consumers (the aggregator stamps
+  // the latest one it saw onto its inventory object), so a CR is
+  // joinable to the origin daemon's /debug/trace across processes.
+  std::string change;
   bool has_labels = false;       // object.spec.labels parsed (string values)
   lm::Labels labels;
   int error_code = 0;

@@ -30,6 +30,7 @@ std::string EventJson(const Event& event) {
   std::string out = "{\"seq\":" + std::to_string(event.seq) +
                     ",\"ts\":" + FormatSeconds(event.wall_time_s) +
                     ",\"generation\":" + std::to_string(event.generation) +
+                    ",\"change\":" + std::to_string(event.change) +
                     ",\"type\":" + jsonlite::Quote(event.type) +
                     ",\"source\":" + jsonlite::Quote(event.source) +
                     ",\"message\":" + jsonlite::Quote(event.message) +
@@ -94,6 +95,7 @@ void Journal::Record(
     std::lock_guard<std::mutex> lock(mu_);
     event.seq = next_seq_++;
     event.generation = generation_;
+    event.change = change_;
     if (events_.size() >= capacity_) {
       events_.pop_front();
       dropped_++;
@@ -116,19 +118,26 @@ void Journal::Record(
   if (dropped) dropped_counter->Inc();
 }
 
-uint64_t Journal::BeginRewrite() {
+uint64_t Journal::BeginRewrite(uint64_t change) {
   uint64_t generation;
   {
     std::lock_guard<std::mutex> lock(mu_);
     generation = ++generation_;
+    change_ = change;
   }
   log::SetCurrentGeneration(generation);
+  log::SetCurrentChange(change);
   return generation;
 }
 
 uint64_t Journal::generation() const {
   std::lock_guard<std::mutex> lock(mu_);
   return generation_;
+}
+
+uint64_t Journal::change() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return change_;
 }
 
 std::vector<Event> Journal::Snapshot(size_t n,
@@ -160,15 +169,18 @@ std::string Journal::RenderJson(size_t n, const std::string& type) const {
   uint64_t capacity;
   uint64_t dropped;
   uint64_t generation;
+  uint64_t change;
   {
     std::lock_guard<std::mutex> lock(mu_);
     capacity = capacity_;
     dropped = dropped_;
     generation = generation_;
+    change = change_;
   }
   std::string out = "{\"capacity\":" + std::to_string(capacity) +
                     ",\"dropped_total\":" + std::to_string(dropped) +
                     ",\"generation\":" + std::to_string(generation) +
+                    ",\"change\":" + std::to_string(change) +
                     ",\"events\":[";
   for (size_t i = 0; i < events.size(); i++) {
     if (i) out += ",";

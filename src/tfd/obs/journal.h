@@ -51,6 +51,7 @@ struct Event {
   uint64_t seq = 0;        // journal-global, monotone, never reused
   double wall_time_s = 0;  // unix time, sub-second resolution
   uint64_t generation = 0; // rewrite-generation correlation id
+  uint64_t change = 0;     // causal change-id (obs/trace.h; 0 = none)
   std::string type;        // "probe-ok", "label-diff", "rewrite", ...
   std::string source;      // probe source / sink / "" when not applicable
   std::string message;     // one human-readable line
@@ -84,9 +85,13 @@ class Journal {
 
   // Starts a new rewrite generation (the correlation id) and mirrors it
   // into log::SetCurrentGeneration for --log-format=json. Returns the
-  // new generation.
-  uint64_t BeginRewrite();
+  // new generation. `change` is the causal change-id this pass carries
+  // (obs/trace.h LatestActiveChange; 0 = nothing in flight): every
+  // event recorded until the next pass rides it, so /debug/journal
+  // output joins to /debug/trace without timestamp heuristics.
+  uint64_t BeginRewrite(uint64_t change = 0);
   uint64_t generation() const;
+  uint64_t change() const;
 
   // The newest `n` events (0 = all retained), oldest-first, optionally
   // filtered by exact type. Copied under the lock — renderers never
@@ -109,6 +114,7 @@ class Journal {
   uint64_t next_seq_ = 1;
   uint64_t dropped_ = 0;
   uint64_t generation_ = 0;
+  uint64_t change_ = 0;
 };
 
 // The process-wide journal (the analogue of obs::Default() for metrics):

@@ -142,6 +142,7 @@ Result<std::unique_ptr<IntrospectionServer>> IntrospectionServer::Start(
   auto server = std::unique_ptr<IntrospectionServer>(new IntrospectionServer());
   server->registry_ = registry;
   server->journal_ = options.journal;
+  server->trace_ = options.trace;
   server->stale_after_s_ = options.stale_after_s;
   server->listen_fd_ = fd;
   server->port_ = ntohs(bound.sin_port);
@@ -278,6 +279,25 @@ void IntrospectionServer::HandleRequest(Conn* conn) {
     }
     conn->out = HttpResponse(200, "OK", "application/json",
                              journal_->RenderJson(n, type) + "\n");
+  } else if (path == "/debug/trace" && trace_ != nullptr) {
+    // ?n=<count> (0/absent = all retained) and ?change=<change-id>
+    // filter the causal-trace dump (obs/trace.h).
+    size_t n = 0;
+    uint64_t change = 0;
+    for (const std::string& param : SplitString(query, '&')) {
+      size_t eq = param.find('=');
+      if (eq == std::string::npos) continue;
+      std::string key = param.substr(0, eq);
+      std::string value = param.substr(eq + 1);
+      int parsed = 0;
+      if (key == "n" && ParseNonNegInt(value, &parsed)) {
+        n = static_cast<size_t>(parsed);
+      } else if (key == "change" && ParseNonNegInt(value, &parsed)) {
+        change = static_cast<uint64_t>(parsed);
+      }
+    }
+    conn->out = HttpResponse(200, "OK", "application/json",
+                             trace_->RenderJson(n, change) + "\n");
   } else if (path == "/debug/labels") {
     std::string body;
     {
@@ -295,7 +315,8 @@ void IntrospectionServer::HandleRequest(Conn* conn) {
   } else {
     conn->out = HttpResponse(404, "Not Found", "text/plain",
                              "serves /healthz, /readyz, /metrics, "
-                             "/debug/journal, /debug/labels\n");
+                             "/debug/journal, /debug/labels, "
+                             "/debug/trace\n");
   }
 }
 

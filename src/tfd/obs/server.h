@@ -1,5 +1,5 @@
 // In-daemon introspection HTTP server: /healthz, /readyz, /metrics,
-// /debug/journal, /debug/labels.
+// /debug/journal, /debug/labels, /debug/trace.
 //
 // A minimal single-threaded GET-only HTTP/1.1 server: one background
 // thread runs a poll(2) loop over the listen socket and a small fixed
@@ -28,6 +28,7 @@
 
 #include "tfd/obs/journal.h"
 #include "tfd/obs/metrics.h"
+#include "tfd/obs/trace.h"
 #include "tfd/util/status.h"
 
 namespace tfd {
@@ -48,6 +49,9 @@ struct ServerOptions {
   // Flight recorder behind /debug/journal?n=&type= (null hides the
   // endpoint; the daemon passes obs::DefaultJournal()).
   Journal* journal = nullptr;
+  // Causal-trace recorder behind /debug/trace?n=&change= (null hides
+  // the endpoint; the daemon passes obs::DefaultTrace()).
+  TraceRecorder* trace = nullptr;
 };
 
 class IntrospectionServer {
@@ -90,6 +94,7 @@ class IntrospectionServer {
 
   Registry* registry_ = nullptr;
   Journal* journal_ = nullptr;
+  TraceRecorder* trace_ = nullptr;
   int stale_after_s_ = 120;
   int listen_fd_ = -1;
   int wake_fds_[2] = {-1, -1};  // self-pipe: Stop() wakes the poll loop

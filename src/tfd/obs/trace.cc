@@ -1,0 +1,265 @@
+#include "tfd/obs/trace.h"
+
+#include <chrono>
+#include <cstdio>
+
+#include "tfd/obs/metrics.h"
+#include "tfd/util/jsonlite.h"
+
+namespace tfd {
+namespace obs {
+
+namespace {
+
+double WallNow() {
+  return std::chrono::duration<double>(
+             std::chrono::system_clock::now().time_since_epoch())
+      .count();
+}
+
+// Fixed 6-decimal rendering: the Python twin formats f"{ts:.6f}", so
+// the parity pin can compare rendered documents byte-for-byte.
+std::string FormatTs(double s) {
+  char buf[40];
+  snprintf(buf, sizeof(buf), "%.6f", s);
+  return buf;
+}
+
+// Microseconds for the Chrome trace "ts"/"dur" fields — half-up
+// rounding matched by the twin's int(t * 1e6 + 0.5).
+long long Micros(double s) { return static_cast<long long>(s * 1e6 + 0.5); }
+
+std::string RecordJson(const TraceRecord& record) {
+  std::string out = "{\"change\":" + std::to_string(record.change) +
+                    ",\"generation\":" + std::to_string(record.generation) +
+                    ",\"minted_ts\":" + FormatTs(record.minted_ts) +
+                    ",\"origin\":" + jsonlite::Quote(record.origin) +
+                    ",\"source\":" + jsonlite::Quote(record.source) +
+                    ",\"detail\":" + jsonlite::Quote(record.detail) +
+                    ",\"published\":" +
+                    (record.published ? "true" : "false") + ",\"stages\":{";
+  bool first = true;
+  for (const auto& [stage, ts] : record.stages) {
+    if (!first) out += ",";
+    first = false;
+    out += jsonlite::Quote(stage) + ":" + FormatTs(ts);
+  }
+  return out + "}}";
+}
+
+}  // namespace
+
+TraceRecorder::TraceRecorder(size_t capacity, bool metrics)
+    : capacity_(capacity == 0 ? 1 : capacity), metrics_(metrics) {}
+
+void TraceRecorder::UpdateGauge() const {
+  if (!metrics_) return;
+  size_t active = 0;
+  for (const TraceRecord& record : records_) {
+    if (!record.published) active++;
+  }
+  Default()
+      .GetGauge("tfd_trace_active",
+                "Trace records minted but not yet publish-acked "
+                "(label changes in flight through the pass pipeline).")
+      ->Set(static_cast<double>(active));
+}
+
+void TraceRecorder::SetCapacity(size_t capacity) {
+  uint64_t dropped_now = 0;
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    capacity_ = capacity == 0 ? 1 : capacity;
+    while (records_.size() > capacity_) {
+      records_.pop_front();
+      dropped_++;
+      dropped_now++;
+    }
+    UpdateGauge();
+  }
+  if (metrics_ && dropped_now > 0) {
+    Default()
+        .GetCounter("tfd_trace_dropped_total",
+                    "Trace records evicted by the bounded ring buffer "
+                    "(drop-oldest).")
+        ->Inc(static_cast<double>(dropped_now));
+  }
+}
+
+size_t TraceRecorder::capacity() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return capacity_;
+}
+
+uint64_t TraceRecorder::Mint(const std::string& origin,
+                             const std::string& source,
+                             const std::string& detail, double now_s) {
+  TraceRecord record;
+  record.minted_ts = now_s < 0 ? WallNow() : now_s;
+  // Sanitize at ingestion, like the journal: origins and details can
+  // carry probe error bytes, but /debug/trace and the Perfetto dump
+  // must stay decodable by strict UTF-8 consumers.
+  record.origin = jsonlite::SanitizeUtf8(origin);
+  record.source = jsonlite::SanitizeUtf8(source);
+  record.detail = jsonlite::SanitizeUtf8(detail);
+  bool dropped = false;
+  uint64_t change;
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    change = next_change_++;
+    record.change = change;
+    if (records_.size() >= capacity_) {
+      records_.pop_front();
+      dropped_++;
+      dropped = true;
+    }
+    records_.push_back(std::move(record));
+    UpdateGauge();
+  }
+  if (metrics_ && dropped) {
+    Default()
+        .GetCounter("tfd_trace_dropped_total",
+                    "Trace records evicted by the bounded ring buffer "
+                    "(drop-oldest).")
+        ->Inc();
+  }
+  return change;
+}
+
+void TraceRecorder::Stage(const std::string& stage, double now_s) {
+  std::string name = jsonlite::SanitizeUtf8(stage);
+  double now = now_s < 0 ? WallNow() : now_s;
+  std::lock_guard<std::mutex> lock(mu_);
+  for (TraceRecord& record : records_) {
+    if (record.published) continue;
+    bool seen = false;
+    for (const auto& [existing, ts] : record.stages) {
+      (void)ts;
+      if (existing == name) {
+        seen = true;
+        break;
+      }
+    }
+    if (!seen) record.stages.emplace_back(name, now);
+  }
+}
+
+void TraceRecorder::MarkPublished(uint64_t generation, double now_s,
+                                  uint64_t through_change) {
+  double now = now_s < 0 ? WallNow() : now_s;
+  std::lock_guard<std::mutex> lock(mu_);
+  for (TraceRecord& record : records_) {
+    if (record.published || record.change > through_change) continue;
+    record.published = true;
+    record.generation = generation;
+    record.stages.emplace_back("publish-acked", now);
+  }
+  UpdateGauge();
+}
+
+uint64_t TraceRecorder::LatestActiveChange() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  uint64_t latest = 0;
+  for (const TraceRecord& record : records_) {
+    if (!record.published && record.change > latest) {
+      latest = record.change;
+    }
+  }
+  return latest;
+}
+
+uint64_t TraceRecorder::LatestChange() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return next_change_ - 1;
+}
+
+size_t TraceRecorder::active() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  size_t active = 0;
+  for (const TraceRecord& record : records_) {
+    if (!record.published) active++;
+  }
+  return active;
+}
+
+uint64_t TraceRecorder::dropped_total() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return dropped_;
+}
+
+std::vector<TraceRecord> TraceRecorder::Snapshot(size_t n,
+                                                 uint64_t change) const {
+  std::lock_guard<std::mutex> lock(mu_);
+  std::vector<TraceRecord> out;
+  for (const TraceRecord& record : records_) {
+    if (change != 0 && record.change != change) continue;
+    out.push_back(record);
+  }
+  if (n > 0 && out.size() > n) {
+    out.erase(out.begin(), out.end() - static_cast<ptrdiff_t>(n));
+  }
+  return out;
+}
+
+std::string TraceRecorder::RenderJson(size_t n, uint64_t change) const {
+  std::vector<TraceRecord> records = Snapshot(n, change);
+  uint64_t capacity;
+  uint64_t dropped;
+  uint64_t minted;
+  size_t active = 0;
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    capacity = capacity_;
+    dropped = dropped_;
+    minted = next_change_ - 1;
+    for (const TraceRecord& record : records_) {
+      if (!record.published) active++;
+    }
+  }
+  std::string out = "{\"capacity\":" + std::to_string(capacity) +
+                    ",\"dropped_total\":" + std::to_string(dropped) +
+                    ",\"active\":" + std::to_string(active) +
+                    ",\"minted_total\":" + std::to_string(minted) +
+                    ",\"records\":[";
+  for (size_t i = 0; i < records.size(); i++) {
+    if (i) out += ",";
+    out += RecordJson(records[i]);
+  }
+  return out + "]}";
+}
+
+std::string TraceRecorder::RenderChromeTrace() const {
+  std::vector<TraceRecord> records = Snapshot(0, 0);
+  std::string out = "{\"displayTimeUnit\":\"ms\",\"traceEvents\":[";
+  bool first = true;
+  for (const TraceRecord& record : records) {
+    double prev = record.minted_ts;
+    for (const auto& [stage, ts] : record.stages) {
+      double start = prev;
+      double end = ts > prev ? ts : prev;
+      prev = end;
+      if (!first) out += ",";
+      first = false;
+      out += "{\"name\":" + jsonlite::Quote(stage) +
+             ",\"cat\":" + jsonlite::Quote(record.origin) +
+             ",\"ph\":\"X\",\"ts\":" + std::to_string(Micros(start)) +
+             ",\"dur\":" + std::to_string(Micros(end) - Micros(start)) +
+             ",\"pid\":1,\"tid\":" + std::to_string(record.change) +
+             ",\"args\":{\"change\":" +
+             jsonlite::Quote(std::to_string(record.change)) +
+             ",\"origin\":" + jsonlite::Quote(record.origin) +
+             ",\"source\":" + jsonlite::Quote(record.source) +
+             ",\"generation\":" +
+             jsonlite::Quote(std::to_string(record.generation)) + "}}";
+    }
+  }
+  return out + "]}";
+}
+
+TraceRecorder& DefaultTrace() {
+  static TraceRecorder* trace = new TraceRecorder();
+  return *trace;
+}
+
+}  // namespace obs
+}  // namespace tfd

@@ -1,0 +1,142 @@
+// Causal label-propagation tracing: a bounded, lock-cheap recorder of
+// CHANGE-IDs — one monotone id minted at the origin of every
+// label-moving event (probe-snapshot movement, slice verdict adoption,
+// lifecycle edge, watch-drift heal, config regeneration) — plus the
+// per-stage timestamps the change accumulates as it flows through the
+// pass pipeline (plan → render → govern → publish → publish-acked).
+//
+// The journal (obs/journal.h) answers WHY a node carries its labels;
+// the metrics say HOW MUCH happened. Neither can decompose the
+// headline latency (BENCH_cluster's label-to-placement p99) into
+// per-hop budgets, because the causal chain crosses processes: probe
+// edge → daemon pass → apiserver → aggregator → scheduler. The change
+// id is the join key for that chain: it rides outward as a CR
+// ANNOTATION on SSA writes (annotations, not labels — the schema and
+// scheduler eligibility are untouched), is echoed by the slice
+// blackboard verdict and the aggregator's inventory object, and is
+// carried by journal events (Event::change), --log-format=json lines,
+// and the /debug/trace introspection endpoint alongside the existing
+// rewrite generation.
+//
+// Bounded by construction, like the journal: fixed capacity
+// (--trace-capacity, default 256), drop-oldest with drops counted in
+// tfd_trace_dropped_total, and tfd_trace_active gauging the records
+// minted but not yet publish-acked. Lock-cheap: one mutex, O(1) mints,
+// O(active) stage stamps — and a quiet daemon mints nothing, so
+// tracing is free when nothing moves (the steady-state no-op contract
+// bench_gate enforces).
+//
+// Exported two ways: JSON on /debug/trace?n=&change= (and folded into
+// the SIGUSR1 post-mortem dump), and a Chrome trace-event document
+// (Perfetto-loadable) via RenderChromeTrace — written to --trace-dump
+// on SIGUSR1. tpufd/trace.py is the byte-parity-pinned Python twin.
+#pragma once
+
+#include <cstdint>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace tfd {
+namespace obs {
+
+// The CR annotation key the latest active change id rides outward on
+// (metadata.annotations — NEVER spec.labels; the change id must not
+// become scheduler-visible eligibility input).
+inline constexpr char kChangeAnnotation[] = "tfd.google.com/change-id";
+
+// One traced change. `stages` is an append-ordered (name, wall time)
+// list — first-wins per stage name, so the list is monotone in stamp
+// time. All strings are sanitized at ingestion (hostile probe bytes
+// must not break /debug/trace exposition — fuzz_journal.cc pins it).
+struct TraceRecord {
+  uint64_t change = 0;      // monotone, minted at the origin
+  uint64_t generation = 0;  // rewrite generation that published it
+  double minted_ts = 0;     // unix time, sub-second resolution
+  std::string origin;       // "snapshot", "slice-verdict", "lifecycle",
+                            // "watch-drift", "config", ...
+  std::string source;       // probe source / "" when not applicable
+  std::string detail;       // one human-readable line
+  bool published = false;   // publish-acked by the sink
+  std::vector<std::pair<std::string, double>> stages;
+};
+
+class TraceRecorder {
+ public:
+  static constexpr size_t kDefaultCapacity = 256;
+
+  // `metrics` wires tfd_trace_{active,dropped_total} into
+  // obs::Default(); the fuzz target disables it so hostile inputs
+  // cannot grow the process registry.
+  explicit TraceRecorder(size_t capacity = kDefaultCapacity,
+                         bool metrics = true);
+
+  // Capacity is reconfigurable at a config load (--trace-capacity);
+  // shrinking drops oldest records (counted as drops).
+  void SetCapacity(size_t capacity);
+  size_t capacity() const;
+
+  // Mints a new change id at a label-moving origin. `now_s` < 0 uses
+  // the wall clock (tests inject fixed times for the parity pins).
+  uint64_t Mint(const std::string& origin, const std::string& source,
+                const std::string& detail, double now_s = -1);
+
+  // Stamps `stage` on every ACTIVE (not yet published) record that
+  // does not already carry it — the pass pipeline calls this once per
+  // stage boundary and every in-flight change accumulates the
+  // timestamp (first-wins: a change spanning two passes keeps the
+  // FIRST pass's stamps; the pass that publishes it acks it below).
+  void Stage(const std::string& stage, double now_s = -1);
+
+  // The sink acked a write: every active record with change id <=
+  // `through_change` is stamped with the terminal "publish-acked"
+  // stage, tagged with the publishing rewrite `generation`, and
+  // retired from the active set. The pass passes the change it
+  // captured at BeginRewrite time — a change a probe worker mints
+  // CONCURRENTLY with the pass was not in its render, must not be
+  // acked by it, and stays active for the pass its movement wakes.
+  // The default (max) retires everything active (tests, fuzz).
+  void MarkPublished(uint64_t generation, double now_s = -1,
+                     uint64_t through_change = ~0ull);
+
+  // Highest change id minted but not yet publish-acked (0 = none):
+  // what BeginRewrite() and the CR annotation carry.
+  uint64_t LatestActiveChange() const;
+  // Highest change id ever minted (0 = none yet).
+  uint64_t LatestChange() const;
+
+  size_t active() const;
+  uint64_t dropped_total() const;
+
+  // {"capacity":..,"dropped_total":..,"active":..,"minted_total":..,
+  //  "records":[..]} — what /debug/trace serves and the SIGUSR1 dump
+  // embeds. `n` keeps the newest n records (0 = all retained);
+  // `change` non-zero filters to that exact change id.
+  std::string RenderJson(size_t n = 0, uint64_t change = 0) const;
+
+  // Chrome trace-event JSON (Perfetto/chrome://tracing loadable): one
+  // complete ("ph":"X") event per stage interval, tid = change id, so
+  // each change renders as its own track of plan/render/govern/publish
+  // slices. Written to --trace-dump on SIGUSR1.
+  std::string RenderChromeTrace() const;
+
+ private:
+  std::vector<TraceRecord> Snapshot(size_t n, uint64_t change) const;
+  void UpdateGauge() const;  // call with mu_ held
+
+  mutable std::mutex mu_;
+  size_t capacity_;
+  bool metrics_;
+  std::deque<TraceRecord> records_;
+  uint64_t next_change_ = 1;
+  uint64_t dropped_ = 0;
+};
+
+// The process-wide recorder (the analogue of DefaultJournal()):
+// survives SIGHUP reloads so in-flight changes span the reload itself.
+TraceRecorder& DefaultTrace();
+
+}  // namespace obs
+}  // namespace tfd

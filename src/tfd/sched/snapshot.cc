@@ -2,6 +2,7 @@
 
 #include "tfd/lm/schema.h"
 #include "tfd/obs/journal.h"
+#include "tfd/obs/trace.h"
 #include "tfd/util/strings.h"
 
 namespace tfd {
@@ -150,6 +151,7 @@ void SnapshotStore::PutOk(const std::string& source, Snapshot snapshot) {
   // for the hash so the per-pass planner never does.
   uint64_t content_fingerprint = FullSnapshotFingerprint(snapshot);
   std::function<void()> notify;
+  bool moved = false;
   {
     std::lock_guard<std::mutex> lock(mu_);
     auto it = states_.find(source);
@@ -159,9 +161,9 @@ void SnapshotStore::PutOk(const std::string& source, Snapshot snapshot) {
     // An identical healthy re-probe is NOT movement — this is what
     // keeps a quiet event-driven daemon at zero passes while its probe
     // workers keep their own cadence.
-    bool moved = it->second.content_fingerprint != content_fingerprint ||
-                 !it->second.last_error.empty() ||
-                 !it->second.last_ok.has_value();
+    moved = it->second.content_fingerprint != content_fingerprint ||
+            !it->second.last_error.empty() ||
+            !it->second.last_ok.has_value();
     snapshot.version = next_version_++;
     if (snapshot.taken_at == std::chrono::steady_clock::time_point()) {
       snapshot.taken_at = std::chrono::steady_clock::now();
@@ -176,6 +178,12 @@ void SnapshotStore::PutOk(const std::string& source, Snapshot snapshot) {
     it->second.backoff_s = 0;
     if (moved) notify = movement_callback_;
   }
+  if (moved) {
+    // Probe-snapshot movement is THE primary label-moving origin: mint
+    // the causal change id here (before the wakeup fires) so the pass
+    // this movement triggers already sees it as active.
+    obs::DefaultTrace().Mint("snapshot", source, "probe snapshot moved");
+  }
   settled_cv_.notify_all();
   if (notify) notify();  // outside the lock: the callback may Wait()ers
 }
@@ -183,20 +191,24 @@ void SnapshotStore::PutOk(const std::string& source, Snapshot snapshot) {
 void SnapshotStore::PutError(const std::string& source,
                              const std::string& error, bool fatal) {
   std::function<void()> notify;
+  bool moved = false;
   {
     std::lock_guard<std::mutex> lock(mu_);
     auto it = states_.find(source);
     if (it == states_.end()) return;
     // A freshly failing source (or a fatal error) moves the planner's
     // signature; a still-failing source re-failing does not.
-    bool moved = it->second.last_error.empty() || fatal ||
-                 !it->second.settled;
+    moved = it->second.last_error.empty() || fatal || !it->second.settled;
     it->second.settled = true;
     it->second.generation++;
     it->second.last_error = error;
     it->second.fatal_error = fatal;
     it->second.consecutive_failures++;
     if (moved) notify = movement_callback_;
+  }
+  if (moved) {
+    // A fresh failure moves labels too (tier markers, held facts).
+    obs::DefaultTrace().Mint("snapshot-error", source, error);
   }
   settled_cv_.notify_all();
   if (notify) notify();
@@ -222,6 +234,8 @@ void SnapshotStore::InvalidateAll() {
   obs::DefaultJournal().Record(
       "snapshots-invalidated", "",
       "every probe-source snapshot invalidated (config regen)");
+  obs::DefaultTrace().Mint("config", "",
+                           "snapshots invalidated (config regen)");
   if (notify) notify();
 }
 

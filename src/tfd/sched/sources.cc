@@ -14,6 +14,7 @@
 #include "tfd/lm/schema.h"
 #include "tfd/obs/journal.h"
 #include "tfd/obs/metrics.h"
+#include "tfd/obs/trace.h"
 #include "tfd/perf/perf.h"
 #include "tfd/platform/detect.h"
 #include "tfd/plugin/plugin.h"
@@ -987,6 +988,14 @@ std::vector<ProbeSpec> BuildProbeSpecs(
               : state == 1 ? "node draining"
                            : "lifecycle normal",
               {{"state", std::to_string(state)}});
+          // A lifecycle edge is a label-moving origin (the governor-
+          // exempt fast path): mint the change id so the preempt label
+          // write — and the slice demotion it triggers — is traceable.
+          obs::DefaultTrace().Mint(
+              "lifecycle", "lifecycle",
+              state == 2   ? "preemption notice"
+              : state == 1 ? "node draining"
+                           : "lifecycle cleared");
         }
         *last_state = state;
       }

@@ -7,6 +7,7 @@
 #include "tfd/lm/schema.h"
 #include "tfd/obs/journal.h"
 #include "tfd/obs/metrics.h"
+#include "tfd/obs/trace.h"
 #include "tfd/perf/perf.h"
 #include "tfd/slice/topology.h"
 #include "tfd/util/jsonlite.h"
@@ -260,6 +261,9 @@ std::string SerializeVerdict(const SliceVerdict& verdict) {
   }
   return "{\"seq\":" + std::to_string(verdict.seq) +
          ",\"leader\":" + jsonlite::Quote(verdict.leader) +
+         (verdict.change != 0
+              ? ",\"change\":" + std::to_string(verdict.change)
+              : "") +
          ",\"computed_at\":" + Fixed3(verdict.computed_at) +
          ",\"hosts\":" + std::to_string(verdict.hosts) +
          ",\"healthy_hosts\":" + std::to_string(verdict.healthy_hosts) +
@@ -280,6 +284,7 @@ Result<SliceVerdict> ParseVerdict(const std::string& json) {
   SliceVerdict verdict;
   verdict.seq = static_cast<uint64_t>(NumberOr(obj, "seq", 0));
   verdict.leader = StringOr(obj, "leader");
+  verdict.change = static_cast<uint64_t>(NumberOr(obj, "change", 0));
   verdict.computed_at = NumberOr(obj, "computed_at", 0);
   verdict.hosts = static_cast<int>(NumberOr(obj, "hosts", 0));
   verdict.healthy_hosts =
@@ -740,6 +745,15 @@ Coordinator::TickResult Coordinator::Tick(DocStore* store,
     if (content_changed) {
       next.seq = (have_stored ? stored.seq : s->adopted.seq) + 1;
       next.computed_at = now_s;
+      // The leader mints the causal change id for this verdict content
+      // and the blackboard echoes it to every member — the join key
+      // that lets a follower's republished slice labels (and the
+      // aggregator's rollup) be traced back to THIS agreement.
+      next.change = obs::DefaultTrace().Mint(
+          "slice-verdict", "slice",
+          "verdict moved: " + std::to_string(next.healthy_hosts) + "/" +
+              std::to_string(next.hosts) + " healthy" +
+              (next.degraded ? " (degraded)" : ""));
       updates[kVerdictKey] = SerializeVerdict(next);
     }
     updates[kLeaseKey] = SerializeLease(next_lease);

@@ -148,6 +148,13 @@ struct SliceVerdict {
   uint64_t seq = 0;
   std::string leader;
   double computed_at = 0;
+  // Causal change-id (obs/trace.h) the LEADER minted when this verdict
+  // content was computed, echoed through the blackboard so every
+  // member's publish (and the cluster-side consumers) can join the
+  // verdict back to the leader's /debug/trace. Bookkeeping like
+  // seq/leader — never label content, ignored by content equality,
+  // serialized only when non-zero (older docs parse as 0).
+  uint64_t change = 0;
   int hosts = 0;          // expected members (identity.num_hosts)
   int healthy_hosts = 0;  // present + healthy reports
   bool degraded = true;   // healthy_hosts < hosts

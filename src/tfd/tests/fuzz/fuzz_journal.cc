@@ -1,14 +1,17 @@
 // libFuzzer target for the flight recorder's JSON exposition
-// (/debug/journal): hostile event payloads — huge label values, embedded
-// quotes/newlines, non-UTF8 bytes — must never produce output the JSON
-// grammar (our own jsonlite parser as the oracle) rejects, and the ring
-// buffer must stay bounded under any append pattern. See
-// fuzz_yamllite.cc for the engine/driver arrangement.
+// (/debug/journal) AND the causal-trace recorder's (/debug/trace + the
+// Perfetto dump): hostile event payloads and trace stage names — huge
+// values, embedded quotes/newlines, non-UTF8 bytes — must never
+// produce output the JSON grammar (our own jsonlite parser as the
+// oracle) rejects, and both ring buffers must stay bounded under any
+// append pattern. See fuzz_yamllite.cc for the engine/driver
+// arrangement.
 #include <cstddef>
 #include <cstdint>
 #include <string>
 
 #include "tfd/obs/journal.h"
+#include "tfd/obs/trace.h"
 #include "tfd/util/jsonlite.h"
 
 extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
@@ -39,5 +42,31 @@ extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
   auto filtered = tfd::jsonlite::Parse(journal.RenderJson(2, type));
   if (!filtered.ok()) __builtin_trap();
   (void)tfd::obs::EventJson(journal.Snapshot(1).front());
+
+  // The causal-trace recorder under the same hostile bytes: origins,
+  // sources, details, and — the ISSUE 15 satellite — STAGE NAMES all
+  // carry attacker-influenced content (a probe error string becomes a
+  // mint detail; a plugin could try to smuggle bytes into a stage).
+  // Both renderings must stay valid strict-UTF-8 JSON and the ring
+  // bounded.
+  tfd::obs::TraceRecorder trace(/*capacity=*/4, /*metrics=*/false);
+  trace.Mint(type, source, rest, 1.0);
+  trace.Stage(rest, 2.0);
+  trace.Stage(text, 3.0);
+  trace.MarkPublished(1, 4.0);
+  trace.Mint(rest, type, text, 5.0);
+  trace.Stage(type, 6.0);
+  for (int i = 0; i < 8; i++) trace.Mint(type, source, rest, 7.0 + i);
+  std::string trace_json = trace.RenderJson();
+  auto trace_doc = tfd::jsonlite::Parse(trace_json);
+  if (!trace_doc.ok()) __builtin_trap();
+  if (tfd::jsonlite::SanitizeUtf8(trace_json) != trace_json) {
+    __builtin_trap();
+  }
+  if (trace.active() > trace.capacity()) __builtin_trap();
+  auto chrome = tfd::jsonlite::Parse(trace.RenderChromeTrace());
+  if (!chrome.ok()) __builtin_trap();
+  auto trace_filtered = tfd::jsonlite::Parse(trace.RenderJson(2, 1));
+  if (!trace_filtered.ok()) __builtin_trap();
   return 0;
 }

@@ -1536,6 +1536,271 @@ void TestJournalGenerationCorrelation() {
   CHECK_EQ(log::CurrentGeneration(), g2);
 }
 
+void TestTraceRecorderLifecycle() {
+  // Mint -> stage -> publish-ack: the causal-trace ring's state
+  // machine, bounded like the journal.
+  obs::TraceRecorder trace(3, /*metrics=*/false);
+  CHECK_EQ(trace.capacity(), size_t{3});
+  CHECK_EQ(trace.LatestActiveChange(), uint64_t{0});
+  uint64_t c1 = trace.Mint("snapshot", "tpu", "moved", 10.0);
+  uint64_t c2 = trace.Mint("lifecycle", "lifecycle", "preempt", 11.0);
+  CHECK_EQ(c1, uint64_t{1});
+  CHECK_EQ(c2, uint64_t{2});
+  CHECK_EQ(trace.active(), size_t{2});
+  CHECK_EQ(trace.LatestActiveChange(), c2);
+  CHECK_EQ(trace.LatestChange(), c2);
+
+  // Stage stamps land on every ACTIVE record, first-wins.
+  trace.Stage("plan", 12.0);
+  trace.Stage("plan", 13.0);  // duplicate: must not move the mark
+  // through_change bounds the ack: a change minted concurrently with
+  // the publishing pass (id > what the pass captured at BeginRewrite)
+  // was not in its content and must stay active for the next pass.
+  uint64_t c3 = trace.Mint("snapshot", "tpu", "mid-pass", 13.5);
+  trace.MarkPublished(9, 14.0, c2);
+  CHECK_EQ(trace.active(), size_t{1});
+  CHECK_EQ(trace.LatestActiveChange(), c3);
+  trace.MarkPublished(10, 14.5);  // default: retire everything active
+  CHECK_EQ(trace.active(), size_t{0});
+  CHECK_EQ(trace.LatestActiveChange(), uint64_t{0});
+  // A published record no longer accumulates stages.
+  trace.Stage("render", 15.0);
+  std::string json = trace.RenderJson();
+  CHECK_TRUE(json.find("\"plan\":12.000000") != std::string::npos);
+  CHECK_TRUE(json.find("13.000000") == std::string::npos);
+  CHECK_TRUE(json.find("\"render\"") == std::string::npos);
+  CHECK_TRUE(json.find("\"publish-acked\":14.000000") !=
+             std::string::npos);
+  CHECK_TRUE(json.find("\"generation\":9") != std::string::npos);
+
+  // Ring bound: drop-oldest, counted; change ids stay monotone.
+  trace.Mint("a", "", "", 20.0);
+  trace.Mint("b", "", "", 21.0);
+  CHECK_EQ(trace.dropped_total(), uint64_t{2});
+  // The evicted record no longer renders (filter by its change id).
+  CHECK_TRUE(trace.RenderJson(0, c1).find("\"records\":[]") !=
+             std::string::npos);
+  // Shrinking capacity drops oldest and counts the drops.
+  trace.SetCapacity(1);
+  CHECK_EQ(trace.dropped_total(), uint64_t{4});
+  // The filtered render and the n-limit compose.
+  uint64_t c5 = trace.LatestChange();
+  std::string filtered = trace.RenderJson(1, c5);
+  CHECK_TRUE(filtered.find("\"change\":" + std::to_string(c5)) !=
+             std::string::npos);
+
+  // Hostile bytes sanitize at ingestion (the fuzz target's oracle).
+  obs::TraceRecorder hostile(2, /*metrics=*/false);
+  hostile.Mint("or\x80igin", "s\xffrc", std::string("de\0tail", 7), 1.0);
+  hostile.Stage(std::string("st\xc0\xafage"), 2.0);
+  std::string doc = hostile.RenderJson();
+  CHECK_TRUE(jsonlite::Parse(doc).ok());
+  CHECK_EQ(jsonlite::SanitizeUtf8(doc), doc);
+  CHECK_TRUE(jsonlite::Parse(hostile.RenderChromeTrace()).ok());
+}
+
+// The cross-language parity pin: this literal is ALSO embedded in
+// tests/test_trace.py, where tpufd.trace.TraceRecorder replays the
+// same scripted sequence — both implementations must reproduce it
+// byte-for-byte, so the C++ recorder and the Python twin can never
+// drift apart silently.
+constexpr const char* kTraceGoldenJson =
+    "{\"capacity\":4,\"dropped_total\":0,\"active\":1,\"minted_total\":2,"
+    "\"records\":[{\"change\":1,\"generation\":7,\"minted_ts\":100.000000,"
+    "\"origin\":\"snapshot\",\"source\":\"tpu\",\"detail\":\"probe "
+    "snapshot moved\",\"published\":true,\"stages\":{\"plan\":100.250000,"
+    "\"render\":100.500000,\"govern\":100.625000,\"publish\":101.000000,"
+    "\"publish-acked\":101.125000}},{\"change\":2,\"generation\":0,"
+    "\"minted_ts\":102.500000,\"origin\":\"slice-verdict\","
+    "\"source\":\"slice\",\"detail\":\"verdict moved: 3/4 healthy "
+    "(degraded)\",\"published\":false,\"stages\":{\"plan\":102.750000}}]}";
+
+void TestTraceRecorderGoldenParity() {
+  obs::TraceRecorder trace(4, /*metrics=*/false);
+  CHECK_EQ(trace.Mint("snapshot", "tpu", "probe snapshot moved", 100.0),
+           uint64_t{1});
+  trace.Stage("plan", 100.25);
+  trace.Stage("render", 100.5);
+  trace.Stage("govern", 100.625);
+  trace.Stage("publish", 101.0);
+  trace.MarkPublished(7, 101.125);
+  CHECK_EQ(trace.Mint("slice-verdict", "slice",
+                      "verdict moved: 3/4 healthy (degraded)", 102.5),
+           uint64_t{2});
+  trace.Stage("plan", 102.75);
+  CHECK_EQ(trace.RenderJson(), std::string(kTraceGoldenJson));
+
+  // The Chrome rendering: valid JSON, complete events with integer
+  // microsecond ts/dur, one slice per stage interval, tid = change.
+  std::string chrome = trace.RenderChromeTrace();
+  Result<jsonlite::ValuePtr> doc = jsonlite::Parse(chrome);
+  CHECK_TRUE(doc.ok());
+  if (doc.ok()) {
+    jsonlite::ValuePtr events = (*doc)->Get("traceEvents");
+    CHECK_EQ(events->array_items.size(), size_t{6});
+    const jsonlite::Value& first = *events->array_items[0];
+    CHECK_EQ(first.Get("name")->string_value, "plan");
+    CHECK_EQ(first.Get("ph")->string_value, "X");
+    CHECK_EQ(first.Get("ts")->number_value, 100000000.0);
+    CHECK_EQ(first.Get("dur")->number_value, 250000.0);
+    CHECK_EQ(first.Get("tid")->number_value, 1.0);
+    CHECK_EQ(first.GetPath("args.generation")->string_value, "7");
+    const jsonlite::Value& last = *events->array_items[5];
+    CHECK_EQ(last.Get("name")->string_value, "plan");
+    CHECK_EQ(last.Get("tid")->number_value, 2.0);
+    CHECK_EQ(last.Get("cat")->string_value, "slice-verdict");
+  }
+}
+
+void TestJournalChangeCorrelation() {
+  // Satellite (ISSUE 15): every journal event carries the change id
+  // its pass was carrying, wired through BeginRewrite — so
+  // /debug/journal joins to /debug/trace without timestamp heuristics.
+  obs::Journal journal(8, /*metrics=*/false);
+  journal.Record("pre", "", "before any rewrite");
+  journal.BeginRewrite(41);
+  journal.Record("in1", "", "inside the change-41 pass");
+  journal.BeginRewrite();  // no change in flight -> 0
+  journal.Record("in2", "", "quiet pass");
+  std::vector<obs::Event> events = journal.Snapshot();
+  CHECK_EQ(events[0].change, uint64_t{0});
+  CHECK_EQ(events[1].change, uint64_t{41});
+  CHECK_EQ(events[2].change, uint64_t{0});
+  CHECK_EQ(journal.change(), uint64_t{0});
+  // The id rides the rendered event AND the json log lines.
+  CHECK_TRUE(obs::EventJson(events[1]).find("\"change\":41") !=
+             std::string::npos);
+  journal.BeginRewrite(99);
+  CHECK_EQ(log::CurrentChange(), uint64_t{99});
+  CHECK_TRUE(journal.RenderJson().find("\"change\":99") !=
+             std::string::npos);
+  std::string line = log::FormatLine(log::Severity::kInfo, "x",
+                                     log::Format::kJson,
+                                     1700000000000LL, 3, 99);
+  CHECK_TRUE(line.find("\"change\":99") != std::string::npos);
+  log::SetCurrentChange(0);
+}
+
+void TestDebugTraceEndpoint() {
+  // /debug/trace over the real server socket: n= and change= filters,
+  // and the document parses as strict JSON.
+  obs::Registry reg;
+  obs::TraceRecorder trace(16, /*metrics=*/false);
+  trace.Mint("snapshot", "tpu", "first", 50.0);
+  trace.Stage("plan", 50.5);
+  trace.MarkPublished(3, 51.0);
+  trace.Mint("watch-drift", "cr", "second", 60.0);
+
+  obs::ServerOptions options;
+  options.addr = "127.0.0.1:0";
+  options.trace = &trace;
+  Result<std::unique_ptr<obs::IntrospectionServer>> server =
+      obs::IntrospectionServer::Start(options, &reg);
+  CHECK_TRUE(server.ok());
+  std::string base =
+      "http://127.0.0.1:" + std::to_string((*server)->port());
+  http::RequestOptions ropt;
+  ropt.timeout_ms = 3000;
+
+  Result<http::Response> r =
+      http::Request("GET", base + "/debug/trace", "", ropt);
+  CHECK_TRUE(r.ok());
+  CHECK_EQ(r->status, 200);
+  Result<jsonlite::ValuePtr> doc = jsonlite::Parse(
+      r->body.substr(0, r->body.find_last_not_of('\n') + 1));
+  CHECK_TRUE(doc.ok());
+  if (doc.ok()) {
+    CHECK_EQ((*doc)->Get("records")->array_items.size(), size_t{2});
+    CHECK_EQ((*doc)->Get("active")->number_value, 1.0);
+  }
+  r = http::Request("GET", base + "/debug/trace?change=1&n=5", "", ropt);
+  CHECK_TRUE(r.ok());
+  doc = jsonlite::Parse(r->body.substr(0, r->body.size() - 1));
+  CHECK_TRUE(doc.ok());
+  if (doc.ok()) {
+    jsonlite::ValuePtr records = (*doc)->Get("records");
+    CHECK_EQ(records->array_items.size(), size_t{1});
+    CHECK_EQ(records->array_items[0]->Get("origin")->string_value,
+             "snapshot");
+    CHECK_EQ(records->array_items[0]->Get("generation")->number_value,
+             3.0);
+  }
+  // The 404 catalogue names the new endpoint.
+  r = http::Request("GET", base + "/nope", "", ropt);
+  CHECK_TRUE(r.ok());
+  CHECK_EQ(r->status, 404);
+  CHECK_TRUE(r->body.find("/debug/trace") != std::string::npos);
+  (*server)->Stop();
+}
+
+void TestVerdictChangeEcho() {
+  // The slice blackboard echoes the leader's change id: serialized
+  // only when non-zero (older docs byte-identical), parsed back, and
+  // NEVER part of content equality or the published labels.
+  slice::SliceVerdict verdict;
+  verdict.seq = 4;
+  verdict.leader = "host-a";
+  verdict.computed_at = 12.5;
+  verdict.hosts = 4;
+  verdict.healthy_hosts = 3;
+  verdict.degraded = true;
+  verdict.perf_class = "silver";
+  verdict.members = {"host-a", "host-b", "host-c"};
+  std::string without = slice::SerializeVerdict(verdict);
+  CHECK_TRUE(without.find("change") == std::string::npos);
+  verdict.change = 17;
+  std::string with_change = slice::SerializeVerdict(verdict);
+  CHECK_TRUE(with_change.find("\"change\":17") != std::string::npos);
+  Result<slice::SliceVerdict> parsed = slice::ParseVerdict(with_change);
+  CHECK_TRUE(parsed.ok());
+  if (parsed.ok()) {
+    CHECK_EQ(parsed->change, uint64_t{17});
+    slice::SliceVerdict same = *parsed;
+    same.change = 99;
+    CHECK_TRUE(slice::VerdictContentEquals(*parsed, same));
+  }
+  Result<slice::SliceVerdict> old_doc = slice::ParseVerdict(without);
+  CHECK_TRUE(old_doc.ok());
+  if (old_doc.ok()) CHECK_EQ(old_doc->change, uint64_t{0});
+}
+
+void TestChangeAnnotationBodies() {
+  // The change-id annotation on the wire bodies: merge patch sets just
+  // the one annotation key (foreign annotations survive merge-patch
+  // semantics), and the watch parse extracts it back out.
+  lm::Labels acked = {{"google.com/a", "1"}};
+  lm::Labels desired = {{"google.com/a", "2"}};
+  std::string patch = k8s::BuildMergePatch(acked, desired, "node-1",
+                                           /*fix_node_name=*/false, "12",
+                                           /*change_annotation=*/"37");
+  CHECK_TRUE(patch.find("\"annotations\":{\"tfd.google.com/"
+                        "change-id\":\"37\"}") != std::string::npos);
+  CHECK_TRUE(patch.find("\"resourceVersion\":\"12\"") !=
+             std::string::npos);
+  // Without a change in flight the patch is byte-identical to the
+  // pre-trace wire format (no annotations key at all).
+  std::string plain = k8s::BuildMergePatch(acked, desired, "node-1",
+                                           false, "12");
+  CHECK_TRUE(plain.find("annotations") == std::string::npos);
+
+  k8s::WatchEvent event = k8s::ParseWatchEventLine(
+      "{\"type\":\"MODIFIED\",\"object\":{\"metadata\":{\"name\":"
+      "\"tfd-features-for-n1\",\"resourceVersion\":\"5\","
+      "\"annotations\":{\"tfd.google.com/change-id\":\"37\","
+      "\"other.io/x\":\"y\"}},\"spec\":{\"labels\":{\"a\":\"1\"}}}}");
+  CHECK_EQ(event.change, "37");
+  k8s::WatchEvent none = k8s::ParseWatchEventLine(
+      "{\"type\":\"MODIFIED\",\"object\":{\"metadata\":{\"name\":\"x\","
+      "\"resourceVersion\":\"5\"},\"spec\":{\"labels\":{}}}}");
+  CHECK_EQ(none.change, "");
+  // A non-string annotation value reads as absent, never crashes.
+  k8s::WatchEvent hostile = k8s::ParseWatchEventLine(
+      "{\"type\":\"MODIFIED\",\"object\":{\"metadata\":{\"name\":\"x\","
+      "\"annotations\":{\"tfd.google.com/change-id\":12}},"
+      "\"spec\":{\"labels\":{}}}}");
+  CHECK_EQ(hostile.change, "");
+}
+
 void TestSanitizeUtf8() {
   // Identity on valid UTF-8, including multi-byte and 4-byte planes.
   CHECK_EQ(jsonlite::SanitizeUtf8("plain ascii"), "plain ascii");
@@ -5935,6 +6200,12 @@ int main(int argc, char** argv) {
   tfd::TestBackendCandidatesList();
   tfd::TestJournalCapacityDropOrdering();
   tfd::TestJournalGenerationCorrelation();
+  tfd::TestTraceRecorderLifecycle();
+  tfd::TestTraceRecorderGoldenParity();
+  tfd::TestJournalChangeCorrelation();
+  tfd::TestDebugTraceEndpoint();
+  tfd::TestVerdictChangeEcho();
+  tfd::TestChangeAnnotationBodies();
   tfd::TestSanitizeUtf8();
   tfd::TestJournalJsonHostileBytes();
   tfd::TestLabelDiff();

@@ -16,6 +16,7 @@ namespace {
 
 std::atomic<Format> g_format{Format::kKlog};
 std::atomic<uint64_t> g_generation{0};
+std::atomic<uint64_t> g_change{0};
 
 const char* SeverityName(Severity sev) {
   switch (sev) {
@@ -57,19 +58,28 @@ uint64_t CurrentGeneration() {
   return g_generation.load(std::memory_order_relaxed);
 }
 
+void SetCurrentChange(uint64_t change) {
+  g_change.store(change, std::memory_order_relaxed);
+}
+
+uint64_t CurrentChange() {
+  return g_change.load(std::memory_order_relaxed);
+}
+
 std::string FormatLine(Severity severity, const std::string& body,
                        Format format, int64_t wall_ms,
-                       uint64_t generation) {
+                       uint64_t generation, uint64_t change) {
   if (format == Format::kJson) {
     // One JSON object per line, reusing the journal event schema
-    // (ts / generation / type / message) so `jq` pipelines treat log
-    // lines and /debug/journal events uniformly.
+    // (ts / generation / change / type / message) so `jq` pipelines
+    // treat log lines and /debug/journal events uniformly.
     char ts[32];
     snprintf(ts, sizeof(ts), "%lld.%03lld",
              static_cast<long long>(wall_ms / 1000),
              static_cast<long long>(wall_ms % 1000));
     return std::string("{\"ts\":") + ts +
            ",\"generation\":" + std::to_string(generation) +
+           ",\"change\":" + std::to_string(change) +
            ",\"type\":\"log\",\"severity\":\"" + SeverityName(severity) +
            "\",\"message\":" +
            jsonlite::Quote(jsonlite::SanitizeUtf8(body)) + "}";
@@ -88,7 +98,7 @@ LogLine::~LogLine() {
                         std::chrono::system_clock::now().time_since_epoch())
                         .count();
   std::string line = FormatLine(sev_, stream_.str(), GetFormat(), wall_ms,
-                                CurrentGeneration());
+                                CurrentGeneration(), CurrentChange());
   line.push_back('\n');
   // One write(2) for the whole line: concurrent threads (broker workers,
   // the introspection server) must not interleave mid-line. POSIX makes

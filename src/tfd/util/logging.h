@@ -36,11 +36,18 @@ Format GetFormat();
 void SetCurrentGeneration(uint64_t generation);
 uint64_t CurrentGeneration();
 
+// Change-id correlation (obs/trace.h): the latest label-moving change
+// the current pass is carrying, ridden by JSON log lines next to the
+// generation so free-text logs join to /debug/trace. The journal's
+// BeginRewrite keeps it current too.
+void SetCurrentChange(uint64_t change);
+uint64_t CurrentChange();
+
 // Formats one line (without trailing newline) the way the destructor
 // emits it — exposed for tests.
 std::string FormatLine(Severity severity, const std::string& body,
                        Format format, int64_t wall_ms,
-                       uint64_t generation);
+                       uint64_t generation, uint64_t change = 0);
 
 class LogLine {
  public:

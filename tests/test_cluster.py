@@ -231,7 +231,8 @@ class TestGroundTruthLeak:
         rng = random.Random(7)
         clock = simnet.SimClock()
         server = cluster_soak.ClusterApiServer(clock, rng, shards=4)
-        sl = cluster_soak.SimSlice(server, clock, rng, 0, 3)
+        tracker = cluster.ChangeTracker()
+        sl = cluster_soak.SimSlice(server, clock, rng, 0, 3, tracker)
         for m in sl.members:
             server.daemon_apply(0.0, m.name, m.desired_labels())
         sched = cluster.SimScheduler()

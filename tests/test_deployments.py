@@ -300,6 +300,25 @@ class TestHelmChart:
                    ds["spec"]["template"]["spec"]["containers"][0]["env"]}
             assert env["TFD_LIFECYCLE_WATCH"] == "false", path.name
 
+    def test_trace_knobs_wired(self):
+        """Causal tracing (ISSUE 15): helm traceDump/traceCapacity ->
+        TFD_TRACE_DUMP/TFD_TRACE_CAPACITY (dump gated on a non-empty
+        value), static daemonsets at the defaults (dump off, ring
+        256)."""
+        values = yaml.safe_load((HELM / "values.yaml").read_text())
+        assert values["traceDump"] == ""
+        assert values["traceCapacity"] == "256"
+        template = (HELM / "templates" / "daemonset.yml").read_text()
+        assert "TFD_TRACE_DUMP" in template
+        assert "TFD_TRACE_CAPACITY" in template
+        assert ".Values.traceDump" in template
+        for path in STATIC_YAMLS:
+            ds = yaml.safe_load(path.read_text())
+            env = {e["name"]: e.get("value") for e in
+                   ds["spec"]["template"]["spec"]["containers"][0]["env"]}
+            assert env["TFD_TRACE_DUMP"] == "", path.name
+            assert env["TFD_TRACE_CAPACITY"] == "256", path.name
+
     def test_helm_daemonset_wires_introspection(self):
         """The chart must wire the introspection addr env, a named
         containerPort, and both kubelet probes, all gated on

@@ -291,23 +291,29 @@ def test_invalid_addr_rejected_at_config(tfd_binary):
 
 def test_concurrent_scrapes_survive_sighup_and_rewrites(tfd_binary,
                                                         tmp_path):
-    """Satellite (ISSUE 3): the introspection server under concurrency —
-    /metrics, /debug/journal, and /debug/labels hammered from parallel
-    threads while rewrites land every second and a SIGHUP rebinds the
-    server mid-scrape. Every 200 body must be complete and parseable
-    (no torn responses); connection errors during the rebind window are
-    the only acceptable failures; and the daemon's fd count returns to
-    its pre-storm baseline (no leaked conns)."""
+    """Satellites (ISSUE 3 + ISSUE 15): the introspection server under
+    concurrency — /metrics, /debug/journal, /debug/labels, and
+    /debug/trace hammered from parallel threads while FORCED-SLOW
+    rewrites (TFD_FORCE_SLOW_PASS — every pass renders + publishes, so
+    the trace/journal rings churn under the scrapers) land every second
+    and a SIGHUP rebinds the server mid-scrape. Every 200 body must be
+    complete and parseable (no torn responses); connection errors
+    during the rebind window are the only acceptable failures; a scrape
+    must never block or corrupt a pass (rewrites keep advancing); and
+    the daemon's fd count returns to its pre-storm baseline (no leaked
+    conns)."""
     import json
     import threading
 
     from tpufd import journal as journal_lib
+    from tpufd import trace as trace_lib
 
     port = free_port()
     out_file = tmp_path / "tfd"
     proc = subprocess.Popen(
         daemon_argv(tfd_binary, port, out_file),
-        env={**os.environ, "GCE_METADATA_HOST": "127.0.0.1:1"},
+        env={**os.environ, "GCE_METADATA_HOST": "127.0.0.1:1",
+             "TFD_FORCE_SLOW_PASS": "1"},
         stderr=subprocess.DEVNULL)
 
     def fd_count():
@@ -318,7 +324,7 @@ def test_concurrent_scrapes_survive_sighup_and_rewrites(tfd_binary,
         return min(counts)
 
     failures = []
-    responses = {"metrics": 0, "journal": 0, "labels": 0}
+    responses = {"metrics": 0, "journal": 0, "labels": 0, "trace": 0}
     stop = threading.Event()
 
     def hammer(path, key, check):
@@ -343,10 +349,14 @@ def test_concurrent_scrapes_survive_sighup_and_rewrites(tfd_binary,
          lambda body: journal_lib.parse_journal(body)),
         ("/debug/labels", "labels",
          lambda body: json.loads(body)["labels"]),
+        ("/debug/trace", "trace",
+         lambda body: trace_lib.parse_trace(body)),
     ]
     try:
         assert wait_for(lambda: http_get(port, "/readyz")[0] == 200)
         baseline_fd = fd_count()
+        rewrites_before = metrics.sample_value(
+            http_get(port, "/metrics")[1], "tfd_rewrites_total")
         threads = [threading.Thread(target=hammer, args=args)
                    for args in checks for _ in range(2)]
         for t in threads:
@@ -359,6 +369,13 @@ def test_concurrent_scrapes_survive_sighup_and_rewrites(tfd_binary,
             t.join(timeout=10)
         assert not failures, failures[:5]
         assert all(count > 5 for count in responses.values()), responses
+        # The scrape storm never blocked the pass loop: forced-slow
+        # rewrites kept landing throughout (>= one per second of storm
+        # would be ~4; demand a conservative floor).
+        assert wait_for(lambda: (metrics.sample_value(
+            http_get(port, "/metrics")[1], "tfd_rewrites_total") or 0)
+            >= (rewrites_before or 0) + 2), \
+            "rewrites stalled under the scrape storm"
         # Back to ready on the rebound server, fds back to baseline.
         assert wait_for(lambda: http_get(port, "/readyz")[0] == 200)
         assert wait_for(lambda: fd_count() <= baseline_fd, timeout=15), \

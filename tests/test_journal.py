@@ -164,7 +164,8 @@ class TestSigusr1Dump:
             assert wait_for(lambda: dump_file.exists()), "no dump"
             doc = json.loads(dump_file.read_text())
             assert set(doc) == {"dumped_at", "version", "labels",
-                                "snapshots", "journal"}
+                                "published_labels", "snapshots",
+                                "trace", "journal"}
             journal = journal_lib.parse_journal(doc["journal"])
             # The dump records itself.
             assert journal_lib.events_of_type(journal["events"], "dump")
@@ -275,19 +276,19 @@ class TestTwinHelpers:
 
     def test_parse_rejects_overfull_ring(self):
         doc = {"capacity": 1, "dropped_total": 0, "generation": 1,
-               "events": [
-                   {"seq": 1, "ts": 0, "generation": 1, "type": "a",
-                    "fields": {}},
-                   {"seq": 2, "ts": 0, "generation": 1, "type": "a",
-                    "fields": {}}]}
+               "change": 0, "events": [
+                   {"seq": 1, "ts": 0, "generation": 1, "change": 0,
+                    "type": "a", "fields": {}},
+                   {"seq": 2, "ts": 0, "generation": 1, "change": 0,
+                    "type": "a", "fields": {}}]}
         with pytest.raises(ValueError):
             journal_lib.parse_journal(doc)
 
     def test_dump_text_smoke(self):
         doc = {"capacity": 4, "dropped_total": 0, "generation": 2,
-               "events": [
+               "change": 0, "events": [
                    {"seq": 1, "ts": 1700000000.5, "generation": 1,
-                    "type": "probe-ok", "source": "pjrt",
+                    "change": 3, "type": "probe-ok", "source": "pjrt",
                     "message": "probe pjrt succeeded",
                     "fields": {"duration_s": "0.1"}}]}
         text = journal_lib.dump_text(journal_lib.parse_journal(doc))

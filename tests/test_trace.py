@@ -1,0 +1,250 @@
+"""Causal label-propagation tracing (ISSUE 15): the C++/Python twin
+parity pins for the trace recorder, the change-annotation wire bodies,
+and the real-daemon drill proving ONE change-id joins the journal,
+/debug/trace, the json log stream, and the NodeFeature CR annotation
+end to end — plus the SIGUSR1 post-mortem folding in the trace ring,
+the published-labels view, and the Perfetto-loadable --trace-dump."""
+
+import json
+import os
+import signal
+import subprocess
+
+import pytest
+
+from conftest import FIXTURES, http_get, wait_for
+from tpufd import metrics
+from tpufd import trace as tracelib
+from tpufd.fakes import free_loopback_port as free_port
+from tpufd.sink import CHANGE_ANNOTATION, build_merge_patch
+
+# The SAME literal is embedded in src/tfd/tests/unit_tests.cc
+# (kTraceGoldenJson): the C++ recorder and this twin must both
+# reproduce it byte-for-byte from the scripted sequence below, so the
+# two implementations cannot drift apart silently.
+TRACE_GOLDEN_JSON = (
+    '{"capacity":4,"dropped_total":0,"active":1,"minted_total":2,'
+    '"records":[{"change":1,"generation":7,"minted_ts":100.000000,'
+    '"origin":"snapshot","source":"tpu","detail":"probe '
+    'snapshot moved","published":true,"stages":{"plan":100.250000,'
+    '"render":100.500000,"govern":100.625000,"publish":101.000000,'
+    '"publish-acked":101.125000}},{"change":2,"generation":0,'
+    '"minted_ts":102.500000,"origin":"slice-verdict",'
+    '"source":"slice","detail":"verdict moved: 3/4 healthy '
+    '(degraded)","published":false,"stages":{"plan":102.750000}}]}')
+
+
+def scripted_recorder():
+    t = tracelib.TraceRecorder(4)
+    assert t.mint("snapshot", "tpu", "probe snapshot moved", 100.0) == 1
+    t.stage("plan", 100.25)
+    t.stage("render", 100.5)
+    t.stage("govern", 100.625)
+    t.stage("publish", 101.0)
+    t.mark_published(7, 101.125)
+    assert t.mint("slice-verdict", "slice",
+                  "verdict moved: 3/4 healthy (degraded)", 102.5) == 2
+    t.stage("plan", 102.75)
+    return t
+
+
+class TestTwinParity:
+    def test_render_json_matches_the_cpp_golden(self):
+        assert scripted_recorder().render_json() == TRACE_GOLDEN_JSON
+
+    def test_chrome_trace_shape(self):
+        doc = json.loads(scripted_recorder().render_chrome_trace())
+        events = doc["traceEvents"]
+        # 5 stage slices for change 1 + 1 for change 2, contiguous.
+        assert [e["name"] for e in events] == [
+            "plan", "render", "govern", "publish", "publish-acked",
+            "plan"]
+        assert events[0]["ts"] == 100000000 and events[0]["dur"] == 250000
+        assert events[4]["tid"] == 1 and events[5]["tid"] == 2
+        for prev, nxt in zip(events[:4], events[1:5]):
+            assert prev["ts"] + prev["dur"] == nxt["ts"]
+
+    def test_ring_bounded_and_first_wins(self):
+        t = tracelib.TraceRecorder(2)
+        for i in range(5):
+            t.mint("o", "s", f"d{i}", float(i))
+        assert t.dropped == 3
+        doc = tracelib.parse_trace(t.render_json())
+        assert [r["change"] for r in doc["records"]] == [4, 5]
+        t.stage("plan", 10.0)
+        t.stage("plan", 11.0)  # duplicate must not move the mark
+        assert all(dict(r["stages"])["plan"] == 10.0
+                   for r in t.records)
+
+    def test_parse_trace_rejects_off_schema(self):
+        with pytest.raises(ValueError):
+            tracelib.parse_trace('{"records":[]}')
+        with pytest.raises(ValueError):
+            tracelib.parse_trace(json.dumps(
+                {"capacity": 1, "dropped_total": 0, "active": 0,
+                 "minted_total": 2, "records": [{}, {}]}))
+
+    def test_merge_patch_annotation_matches_cpp_bytes(self):
+        # The C++ BuildMergePatch vector from TestChangeAnnotationBodies:
+        # same key order, so the canonical dumps reproduce its bytes.
+        patch = build_merge_patch({"google.com/a": "1"},
+                                  {"google.com/a": "2"}, "node-1",
+                                  False, "12", change_annotation="37")
+        assert json.dumps(patch, separators=(",", ":")) == (
+            '{"metadata":{"resourceVersion":"12",'
+            '"annotations":{"tfd.google.com/change-id":"37"}},'
+            '"spec":{"labels":{"google.com/a":"2"}}}')
+        # No change in flight -> byte-identical to the pre-trace wire.
+        plain = build_merge_patch({"google.com/a": "1"},
+                                  {"google.com/a": "2"}, "node-1",
+                                  False, "12")
+        assert "annotations" not in json.dumps(plain)
+
+
+def _stop(proc):
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=10)
+
+
+def test_change_id_joins_journal_trace_logs_and_cr(tfd_binary, tmp_path):
+    """The acceptance drill: one induced label flip's change-id appears
+    in (1) the NodeFeature CR annotation on the fake apiserver, (2)
+    /debug/trace, (3) /debug/journal events, and (4) the json log
+    stream — the four surfaces the causal join is promised across."""
+    from tpufd.fakes.apiserver import FakeApiServer
+
+    port = free_port()
+    sa = tmp_path / "sa"
+    sa.mkdir()
+    (sa / "namespace").write_text("node-feature-discovery\n")
+    (sa / "token").write_text("trace-token\n")
+    fixture = tmp_path / "topo.yaml"
+    fixture.write_text((FIXTURES / "v2-8.yaml").read_text())
+    stderr_path = tmp_path / "stderr"
+    with FakeApiServer(token="trace-token") as server, \
+            open(stderr_path, "wb") as stderr_file:
+        proc = subprocess.Popen(
+            [str(tfd_binary), "--sleep-interval=1s", "--backend=mock",
+             f"--mock-topology-file={fixture}",
+             "--machine-type-file=/dev/null", "--use-node-feature-api",
+             "--output-file=", "--log-format=json",
+             # A chip-count flip is non-monotone: the governor would
+             # hold it (and the byte-compare skip would swallow the
+             # write) for the whole default 300s window — shorten the
+             # hold-down so the induced flip publishes within the drill.
+             "--health-flap-window=2s",
+             f"--introspection-addr=127.0.0.1:{port}"],
+            env={**os.environ, "GCE_METADATA_HOST": "127.0.0.1:1",
+                 "NODE_NAME": "trace-node",
+                 "TFD_APISERVER_URL": server.url,
+                 "TFD_SERVICEACCOUNT_DIR": str(sa)},
+            stderr=stderr_file)
+        try:
+            key = ("node-feature-discovery", "tfd-features-for-trace-node")
+
+            def cr_annotation():
+                obj = server.store.get(key)
+                if obj is None:
+                    return None
+                return (obj.get("metadata", {}).get("annotations")
+                        or {}).get(CHANGE_ANNOTATION)
+
+            assert wait_for(lambda: cr_annotation() is not None), \
+                "no change-id annotation ever landed on the CR"
+            # Induce a fresh label flip (topology movement) and wait for
+            # its change to publish through.
+            before = int(cr_annotation())
+            fixture.write_text(fixture.read_text().replace(
+                "count: 4", "count: 2"))
+            assert wait_for(
+                lambda: int(cr_annotation() or 0) > before, timeout=20), \
+                "the induced flip never moved the CR annotation"
+            change = int(cr_annotation())
+
+            # (2) /debug/trace: the change exists, published, with the
+            # pass stages stamped.
+            status, body = http_get(port, f"/debug/trace?change={change}")
+            assert status == 200
+            doc = tracelib.parse_trace(body)
+            records = tracelib.records_for_change(doc, change)
+            assert records and records[0]["published"], records
+            stages = records[0]["stages"]
+            assert "publish-acked" in stages, stages
+            generation = records[0]["generation"]
+            assert generation > 0
+
+            # (3) /debug/journal: events of the publishing pass carry
+            # the change (joined by the change field, not timestamps).
+            status, body = http_get(port, "/debug/journal")
+            assert status == 200
+            journal = json.loads(body)
+            joined = [e for e in journal["events"]
+                      if e.get("change") == change]
+            assert joined, "no journal event carried the change id"
+            assert any(e["type"] == "rewrite" and
+                       e["generation"] == generation for e in joined), \
+                "the rewrite span did not join change -> generation"
+
+            # (4) json logs: at least one line carries the change id.
+            def log_joined():
+                lines = stderr_path.read_text().splitlines()
+                for line in lines:
+                    try:
+                        doc = json.loads(line)
+                    except ValueError:
+                        continue
+                    if doc.get("change") == change:
+                        return True
+                return False
+            assert wait_for(log_joined, timeout=10), \
+                "no json log line carried the change id"
+        finally:
+            _stop(proc)
+
+
+def test_sigusr1_folds_trace_published_labels_and_perfetto(tfd_binary,
+                                                           tmp_path):
+    """Satellite (ISSUE 15): the SIGUSR1 post-mortem now carries the
+    trace ring AND the published-labels view next to journal +
+    snapshots + provenance — one signal captures the full causal state
+    — and --trace-dump writes a Perfetto-loadable Chrome trace-event
+    document alongside."""
+    port = free_port()
+    out_file = tmp_path / "tfd"
+    dump = tmp_path / "debug.json"
+    chrome = tmp_path / "trace.chrome.json"
+    proc = subprocess.Popen(
+        [str(tfd_binary), "--sleep-interval=1s", "--backend=mock",
+         f"--mock-topology-file={FIXTURES / 'v2-8.yaml'}",
+         "--machine-type-file=/dev/null", f"--output-file={out_file}",
+         f"--debug-dump-file={dump}", f"--trace-dump={chrome}",
+         f"--introspection-addr=127.0.0.1:{port}"],
+        env={**os.environ, "GCE_METADATA_HOST": "127.0.0.1:1"},
+        stderr=subprocess.DEVNULL)
+    try:
+        assert wait_for(lambda: http_get(port, "/readyz")[0] == 200)
+        proc.send_signal(signal.SIGUSR1)
+        assert wait_for(lambda: dump.exists() and chrome.exists(),
+                        timeout=15)
+        doc = json.loads(dump.read_text())
+        # The trace ring parses with the twin's schema checker and
+        # carries at least the first-settle change.
+        trace_doc = tracelib.parse_trace(doc["trace"])
+        assert trace_doc["minted_total"] >= 1
+        # The published-labels view agrees with the emitted label file.
+        published = doc["published_labels"]
+        assert published is not None
+        file_labels = dict(
+            line.split("=", 1)
+            for line in out_file.read_text().splitlines() if line)
+        assert published == file_labels
+        # The Perfetto dump is valid Chrome trace-event JSON.
+        chrome_doc = json.loads(chrome.read_text())
+        assert "traceEvents" in chrome_doc
+        assert all(e["ph"] == "X" for e in chrome_doc["traceEvents"])
+        # Metrics: the trace gauge/counter family registered.
+        text = http_get(port, "/metrics")[1]
+        assert metrics.sample_value(text, "tfd_trace_active") is not None
+    finally:
+        _stop(proc)

@@ -43,6 +43,13 @@ LIFECYCLE_PREEMPT = agglib.LIFECYCLE_PREEMPT
 LIFECYCLE_DRAINING = agglib.LIFECYCLE_DRAINING
 CAPACITY_PREFIX = agglib.CAPACITY_PREFIX
 
+# The simulation's stand-in for the CR change-id annotation
+# (tpufd.sink.CHANGE_ANNOTATION / obs/trace.h): the sim apiserver
+# models objects as label dicts, so the causal change-id rides as one
+# more key. The scheduler's eligibility diet (below) never reads it —
+# the same annotations-not-labels contract the real daemon keeps.
+CHANGE_KEY = PREFIX + "tfd.change"
+
 # Perf-class ordering: the scheduler prefers the best class that still
 # clears the job's floor. Absent/unknown ranks 0 (unclassed hardware is
 # only placeable by jobs with no class floor), degraded is NEVER
@@ -291,6 +298,161 @@ class SimScheduler:
         for job_id in doomed:
             self.release(job_id)
         return doomed
+
+
+# ---- causal change tracking (the sim half of obs/trace.h) ------------------
+
+# The placement-critical causal chain, in pipeline order. Each closed
+# change's stage durations PARTITION its end-to-end latency exactly:
+#   detect   — ground-truth event -> the pipeline first KNOWS (probe
+#              round for self-detectable ops; report ageing past the
+#              agreement timeout for wedge/partition)
+#   agree    — detection -> the slice verdict reflecting it is adopted
+#              (includes lease-expiry failover when the leader died)
+#   hold     — adoption -> a member's publish ATTEMPT (render/coalesce
+#              delay — the sim's governor-hold analogue)
+#   publish  — attempt -> the write LANDS in the apiserver store
+#              (includes brownout Retry-After deferrals)
+#   fanout   — store -> the scheduler's watch delivery
+#   schedule — delivery -> the placeable() verdict actually flips
+#              (absorbs any unstamped remainder, so the partition sums
+#              exactly)
+# The aggregator's inventory channel (agg-debounce) is measured
+# separately: it parallels this chain rather than gating the flip.
+CHAIN_STAGES = ("detect", "agree", "hold", "publish", "fanout",
+                "schedule")
+
+
+class ChangeTracker:
+    """Mints one monotone change-id per injected ground-truth failure
+    and accumulates the stage timestamps the simulation stamps as the
+    change propagates daemon -> apiserver -> scheduler. close() turns
+    the stamps into CHAIN_STAGES durations that sum EXACTLY to the
+    end-to-end label-to-placement latency (stamps are clamped monotone;
+    the terminal stage absorbs any unstamped remainder) — the
+    sum-consistency contract bench_gate --cluster enforces.
+
+    Deterministic by construction: ids are minted in event order, all
+    state is plain dicts, and serialization sorts — so the soak's
+    double-run byte-identity pin covers the tracker too."""
+
+    def __init__(self):
+        self.next_change = 1
+        self.open_by_node = {}   # victim node -> open change id
+        self.records = {}        # change id -> {op, node, t0, stamps}
+        self.closed = []         # closed chains, close order
+        self.discarded = 0       # heal raced the pipeline; chain dropped
+        self.label_events_joined = 0    # watch deliveries carrying a
+                                        # known change id (CHANGE_KEY)
+        self.inventory_joined = 0       # inventory rollups carrying one
+
+    def mint(self, op, node, t):
+        change = self.next_change
+        self.next_change += 1
+        # A refail over a still-open change replaces it (the harness's
+        # note_down already re-tracks the victim from the new t0).
+        old = self.open_by_node.get(node)
+        if old is not None:
+            self.records.pop(old, None)
+            self.discarded += 1
+        self.records[change] = {"change": change, "op": op, "node": node,
+                                "t0": t, "stamps": {}}
+        self.open_by_node[node] = change
+        return change
+
+    def open_change(self, node):
+        return self.open_by_node.get(node)
+
+    def stamp(self, change, stage, t):
+        """First-wins stage stamp (a later duplicate — a second member
+        republish, a brownout retry — never moves an earlier mark)."""
+        record = self.records.get(change)
+        if record is None or stage in record["stamps"]:
+            return
+        record["stamps"][stage] = t
+
+    def stamp_node(self, node, stage, t):
+        change = self.open_by_node.get(node)
+        if change is not None:
+            self.stamp(change, stage, t)
+
+    def known(self, change):
+        return change in self.records
+
+    def discard(self, node):
+        """The heal raced the label pipeline (the harness dropped its
+        down-track entry): the chain can never close — drop it."""
+        change = self.open_by_node.pop(node, None)
+        if change is not None:
+            self.records.pop(change, None)
+            self.discarded += 1
+
+    def close(self, node, t_flip):
+        """The scheduler's placeable() verdict flipped for the victim:
+        convert stamps into CHAIN_STAGES durations (ms). Clamps each
+        stamp into [previous stamp, t_flip] so the durations are
+        non-negative and sum exactly to t_flip - t0; a missing stamp
+        contributes 0 and its budget folds into the next stage."""
+        change = self.open_by_node.pop(node, None)
+        record = self.records.pop(change, None) if change else None
+        if record is None:
+            return None
+        prev = record["t0"]
+        durations = {}
+        for stage in CHAIN_STAGES[:-1]:
+            ts = record["stamps"].get(stage)
+            if ts is None:
+                durations[stage] = 0.0
+                continue
+            ts = min(max(ts, prev), t_flip)
+            durations[stage] = (ts - prev) * 1000.0
+            prev = ts
+        durations[CHAIN_STAGES[-1]] = (t_flip - prev) * 1000.0
+        closed = {"change": record["change"], "op": record["op"],
+                  "node": node, "e2e_ms": (t_flip - record["t0"]) * 1000.0,
+                  "stages": durations}
+        self.closed.append(closed)
+        return closed
+
+    def active(self):
+        return len(self.open_by_node)
+
+
+def stage_breakdown(closed, percentile):
+    """Aggregates closed chains into the record's per-failure-class
+    stage table: for each op, per-stage p50/p99 (ms) + the
+    sum-consistency fields bench_gate checks — stage_p99_sum_ms vs
+    e2e_p99_ms per class, and mean_stage_sum_ms == mean_e2e_ms exactly
+    (the partition property). `percentile` is injected (the soak's
+    helper) so this module stays dependency-light."""
+    by_op = {}
+    for chain in closed:
+        by_op.setdefault(chain["op"], []).append(chain)
+    out = {}
+    for op in sorted(by_op):
+        chains = by_op[op]
+        stages = {}
+        p99_sum = 0.0
+        mean_sum = 0.0
+        for stage in CHAIN_STAGES:
+            values = [c["stages"][stage] for c in chains]
+            p50 = percentile(values, 50)
+            p99 = percentile(values, 99)
+            stages[stage] = {"p50_ms": round(p50, 3),
+                             "p99_ms": round(p99, 3)}
+            p99_sum += p99
+            mean_sum += sum(values) / len(values)
+        e2e = [c["e2e_ms"] for c in chains]
+        out[op] = {
+            "n": len(chains),
+            "stages": stages,
+            "stage_p99_sum_ms": round(p99_sum, 3),
+            "e2e_p50_ms": round(percentile(e2e, 50), 3),
+            "e2e_p99_ms": round(percentile(e2e, 99), 3),
+            "mean_stage_sum_ms": round(mean_sum, 3),
+            "mean_e2e_ms": round(sum(e2e) / len(e2e), 3),
+        }
+    return out
 
 
 # ---- failure-schedule grammar ---------------------------------------------

@@ -565,6 +565,8 @@ class _Handler(BaseHTTPRequestHandler):
             # semantics): same labels for this manager's set, nothing to
             # prune, metadata already in place, ownership unchanged.
             meta_wanted = (patch.get("metadata") or {}).get("labels") or {}
+            ann_wanted = (patch.get("metadata") or {}).get(
+                "annotations") or {}
             previous_keys = owned.get(manager, set())
             foreign_owns_applied = any(
                 other != manager and (keys & set(applied))
@@ -574,7 +576,10 @@ class _Handler(BaseHTTPRequestHandler):
                 and not foreign_owns_applied
                 and all(labels.get(k) == v for k, v in applied.items())
                 and all((existing.get("metadata", {}).get("labels") or {})
-                        .get(k) == v for k, v in meta_wanted.items()))
+                        .get(k) == v for k, v in meta_wanted.items())
+                and all((existing.get("metadata", {}).get("annotations")
+                         or {}).get(k) == v
+                        for k, v in ann_wanted.items()))
             if unchanged:
                 return self._reply(200, copy.deepcopy(existing))
             previous = owned.get(manager, set())
@@ -586,11 +591,15 @@ class _Handler(BaseHTTPRequestHandler):
                     if other != manager:
                         owned[other].discard(key)
             owned[manager] = set(applied)
-            # Metadata labels (the node-name attribution) merge in.
+            # Metadata labels (the node-name attribution) and
+            # annotations (the change-id trace join key) merge in.
             meta_labels = (patch.get("metadata") or {}).get("labels") or {}
             if meta_labels:
                 existing.setdefault("metadata", {}).setdefault(
                     "labels", {}).update(meta_labels)
+            if ann_wanted:
+                existing.setdefault("metadata", {}).setdefault(
+                    "annotations", {}).update(ann_wanted)
             current_rv = existing["metadata"]["resourceVersion"]
             existing["metadata"]["resourceVersion"] = str(
                 int(current_rv) + 1)

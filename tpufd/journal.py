@@ -34,7 +34,8 @@ def parse_journal(text):
     """Parses a /debug/journal (or SIGUSR1-dump ``journal``) document;
     raises ValueError when the schema is off."""
     doc = json.loads(text) if isinstance(text, (str, bytes)) else text
-    for key in ("capacity", "dropped_total", "generation", "events"):
+    for key in ("capacity", "dropped_total", "generation", "change",
+                "events"):
         if key not in doc:
             raise ValueError(f"journal document missing {key!r}")
     if len(doc["events"]) > doc["capacity"]:
@@ -42,7 +43,10 @@ def parse_journal(text):
                          f"({len(doc['events'])} > {doc['capacity']}) — "
                          "the ring is not bounded")
     for event in doc["events"]:
-        for key in ("seq", "ts", "generation", "type", "fields"):
+        # `change` (the causal change-id, ISSUE 15) joined the event
+        # schema alongside generation; both are required now.
+        for key in ("seq", "ts", "generation", "change", "type",
+                    "fields"):
             if key not in event:
                 raise ValueError(f"journal event missing {key!r}: {event}")
     return doc

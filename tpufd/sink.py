@@ -47,6 +47,10 @@ NODE_NAME_LABEL = "nfd.node.kubernetes.io/node-name"
 MERGE_PATCH_CONTENT_TYPE = "application/merge-patch+json"
 APPLY_PATCH_CONTENT_TYPE = "application/apply-patch+yaml"
 APPLY_FIELD_MANAGER = "tfd"
+# The causal change-id annotation key (obs/trace.h kChangeAnnotation):
+# an ANNOTATION, never a spec.label, so scheduler eligibility is
+# untouched while the CR stays joinable to the writer's /debug/trace.
+CHANGE_ANNOTATION = "tfd.google.com/change-id"
 
 
 # ---- desync math (k8s/desync.cc) -----------------------------------------
@@ -101,12 +105,15 @@ def spread_retry_after_s(retry_after_s, node):
 # ---- merge patch (k8s/client.cc BuildMergePatch) -------------------------
 
 def build_merge_patch(acked, desired, node_name, fix_node_name,
-                      resource_version):
+                      resource_version, change_annotation=""):
     """The JSON merge patch that turns `acked` into `desired`, as the
     C++ client serializes it (same key order: changed/added keys in
     sorted order, then removals). Returns None when there is nothing to
     patch, else the patch dict (json.dumps(..., separators=(",", ":"))
-    reproduces the C++ byte stream for ASCII labels)."""
+    reproduces the C++ byte stream for ASCII labels). A non-empty
+    `change_annotation` (the causal change-id, obs/trace.h) rides as
+    metadata.annotations[CHANGE_ANNOTATION] — merge-patch semantics set
+    just that key, leaving foreign annotations alone."""
     spec = {}
     for key in sorted(desired):
         if acked.get(key) != desired[key]:
@@ -122,6 +129,8 @@ def build_merge_patch(acked, desired, node_name, fix_node_name,
         meta["resourceVersion"] = resource_version
     if fix_node_name:
         meta["labels"] = {NODE_NAME_LABEL: node_name}
+    if change_annotation:
+        meta["annotations"] = {CHANGE_ANNOTATION: change_annotation}
     if meta:
         patch["metadata"] = meta
     patch["spec"] = {"labels": spec}
@@ -147,7 +156,8 @@ def parse_watch_event(line):
     rules the C++ client applies, pinned by the parity grid in
     tests/test_fleet.py."""
     out = {"type": "unknown", "name": "", "resource_version": "",
-           "has_labels": False, "labels": {}, "error_code": 0}
+           "change": "", "has_labels": False, "labels": {},
+           "error_code": 0}
     try:
         doc = json.loads(line)
     except (ValueError, TypeError):
@@ -170,6 +180,11 @@ def parse_watch_event(line):
     name = (obj.get("metadata") or {}).get("name")
     if isinstance(name, str):
         out["name"] = name
+    annotations = (obj.get("metadata") or {}).get("annotations")
+    if isinstance(annotations, dict):
+        change = annotations.get(CHANGE_ANNOTATION)
+        if isinstance(change, str):
+            out["change"] = change
     if out["type"] == "error":
         code = obj.get("code")
         if isinstance(code, (int, float)):
@@ -183,11 +198,13 @@ def parse_watch_event(line):
     return out
 
 
-def build_apply_body(namespace, node, labels):
+def build_apply_body(namespace, node, labels, change_annotation=""):
     """The server-side-apply body (k8s/client.cc CrBody): the FULL
     desired object — JSON is valid YAML, which is why the wire
-    content-type can be application/apply-patch+yaml."""
-    return _full_body(namespace, node, labels)
+    content-type can be application/apply-patch+yaml. A non-empty
+    `change_annotation` rides as the CHANGE_ANNOTATION metadata
+    annotation (the causal-trace join key)."""
+    return _full_body(namespace, node, labels, change_annotation)
 
 
 # ---- circuit breaker twin (k8s/breaker.{h,cc}) ---------------------------
@@ -283,15 +300,18 @@ def _cr_name(node):
     return f"tfd-features-for-{node}"
 
 
-def _full_body(namespace, node, labels):
+def _full_body(namespace, node, labels, change_annotation=""):
+    metadata = {
+        "name": _cr_name(node),
+        "namespace": namespace,
+        "labels": {NODE_NAME_LABEL: node},
+    }
+    if change_annotation:
+        metadata["annotations"] = {CHANGE_ANNOTATION: change_annotation}
     return {
         "apiVersion": "nfd.k8s-sigs.io/v1alpha1",
         "kind": "NodeFeature",
-        "metadata": {
-            "name": _cr_name(node),
-            "namespace": namespace,
-            "labels": {NODE_NAME_LABEL: node},
-        },
+        "metadata": metadata,
         "spec": {"labels": dict(labels)},
     }
 

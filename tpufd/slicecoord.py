@@ -178,6 +178,17 @@ def merge_verdict(num_hosts, reports, agreement_timeout_s, now,
     }
 
 
+def verdict_change(verdict_doc):
+    """The causal change-id a serialized verdict doc echoes (the C++
+    SerializeVerdict's optional ``change`` field, minted by the leader
+    via obs/trace.h when the verdict content moved; 0 = none recorded —
+    pre-trace docs parse as 0, exactly like the C++ ParseVerdict)."""
+    try:
+        return int(verdict_doc.get("change", 0))
+    except (TypeError, ValueError, AttributeError):
+        return 0
+
+
 def build_slice_labels(slice_id, verdict):
     """The published tpu.slice.* set for one verdict — deterministic
     from the verdict fields alone (leader/seq never move a byte)."""

@@ -52,6 +52,7 @@
 #include "tfd/obs/journal.h"
 #include "tfd/obs/metrics.h"
 #include "tfd/obs/server.h"
+#include "tfd/obs/slo.h"
 #include "tfd/obs/trace.h"
 #include "tfd/perf/perf.h"
 #include "tfd/platform/detect.h"
@@ -618,6 +619,12 @@ Status DispatchSink(const config::Config& config, const lm::Labels& labels,
     if (uint64_t change = obs::DefaultJournal().change(); change != 0) {
       cluster->change_annotation = std::to_string(change);
     }
+    // The node's windowed stage-SLO contribution rides next to the
+    // change id (obs/slo.h). Serialized BEFORE this write's own
+    // publish-ack by construction — the sketches cover changes closed
+    // through the previous pass; this pass's durations ride the next
+    // write. "" (nothing folded yet) writes no annotation.
+    cluster->slo_annotation = obs::DefaultSlo().Serialize();
     if (anti_entropy) k8s::DefaultSinkState().Invalidate();
     out = k8s::UpdateNodeFeature(*cluster, labels, &transient, nullptr,
                                  &wire);
@@ -781,18 +788,27 @@ void RecordSuppressedFlips(
 }
 
 // Per-stage split of the slow-pass rewrite span (plan / render /
-// publish): the budget decomposition the causal trace (obs/trace.h)
-// reports per change-id, aggregated here as a histogram so a fleet
-// dashboard can see WHERE pass time goes without reading traces.
-void ObserveStageDuration(const char* stage, double seconds) {
-  obs::Default()
-      .GetHistogram("tfd_pass_stage_duration_seconds",
-                    "Duration of one slow-pass pipeline stage: plan "
-                    "(signature digest + short-circuit decision), render "
-                    "(labelers + merge + govern + serialize), publish "
-                    "(sink dispatch through write-acked).",
-                    obs::DurationBuckets(), {{"stage", stage}})
-      ->Observe(seconds);
+// publish / publish-acked): the budget decomposition the causal trace
+// (obs/trace.h) reports per change-id, aggregated here as a histogram
+// so a fleet dashboard can see WHERE pass time goes without reading
+// traces. When `change` is non-zero it rides the landed bucket as an
+// OpenMetrics exemplar (`# {change_id="42"}`) — one click from a p99
+// spike to the exact change's trace and journal trail.
+void ObserveStageDuration(const char* stage, double seconds,
+                          uint64_t change = 0) {
+  obs::Histogram* histogram = obs::Default().GetHistogram(
+      "tfd_pass_stage_duration_seconds",
+      "Duration of one slow-pass pipeline stage: plan "
+      "(signature digest + short-circuit decision), render "
+      "(labelers + merge + govern + serialize), publish "
+      "(sink dispatch through write-acked), publish-acked "
+      "(the change's full minted-to-acked span tail).",
+      obs::DurationBuckets(), {{"stage", stage}});
+  if (change != 0) {
+    histogram->Observe(seconds, {{"change_id", std::to_string(change)}});
+  } else {
+    histogram->Observe(seconds);
+  }
 }
 
 // The sink-skip observability pair: counted per sink, journaled once.
@@ -1084,7 +1100,8 @@ Status LabelOnceInner(
   // feed the byte-compare skip, the file sink, and the published-bytes
   // cache the next fast pass re-emits.
   lm::FormatLabelsInto(merged, &cache->scratch);
-  ObserveStageDuration("render", obs::SecondsSince(t_render));
+  ObserveStageDuration("render", obs::SecondsSince(t_render),
+                       obs::DefaultJournal().change());
   auto t_publish = std::chrono::steady_clock::now();
 
   // Byte-compare sink skip: a slow pass whose output is byte-identical
@@ -1127,7 +1144,8 @@ Status LabelOnceInner(
                               wrote_ok, anti_entropy_due);
     if (!out.ok()) return out;
   }
-  ObserveStageDuration("publish", obs::SecondsSince(t_publish));
+  ObserveStageDuration("publish", obs::SecondsSince(t_publish),
+                       obs::DefaultJournal().change());
   if (!*wrote_ok) return Status::Ok();  // survived transient sink failure
   obs::DefaultTrace().Stage("publish");
   governor->CommitPublished();
@@ -1416,7 +1434,7 @@ Status LabelOnce(const config::Config& config, int config_generation,
     return FastPass(config, decision, plan, server, breaker, state, cache,
                     t0);
   }
-  ObserveStageDuration("plan", obs::SecondsSince(t0));
+  ObserveStageDuration("plan", obs::SecondsSince(t0), change);
   obs::DefaultTrace().Stage("plan");
   obs::Default()
       .GetCounter("tfd_pass_slow_total",
@@ -1485,7 +1503,24 @@ Status LabelOnce(const config::Config& config, int config_generation,
       // pass captured at BeginRewrite — a change a probe worker minted
       // while this pass was rendering was not in its content and stays
       // active for the pass its movement wakes.
-      obs::DefaultTrace().MarkPublished(generation, -1, change);
+      std::vector<obs::TraceRecord> retired =
+          obs::DefaultTrace().MarkPublished(generation, -1, change);
+      // Every change this pass closed feeds the SLO engine: its stage
+      // durations fold into the windowed sketches (/debug/slo, the
+      // stage-slo annotation the NEXT write carries out), and its
+      // minted-to-acked tail lands in the publish-acked histogram with
+      // the change id as the exemplar — the join from a fleet p99
+      // spike back to one change's causal trail.
+      for (const obs::TraceRecord& record : retired) {
+        std::map<std::string, double> stage_ms =
+            obs::StageDurationsMs(record);
+        obs::DefaultSlo().Fold(record.change, stage_ms);
+        auto acked = stage_ms.find("publish-acked");
+        if (acked != stage_ms.end()) {
+          ObserveStageDuration("publish-acked", acked->second / 1000.0,
+                               record.change);
+        }
+      }
       state->last_published_level = decision.level;
     }
     RecordLabelDiff(merged, provenance, state);
@@ -1613,6 +1648,7 @@ void WriteDebugDump(const config::Config& config,
       ",\"published_labels\":" + published_json +
       ",\"snapshots\":" + SnapshotsJson(store) +
       ",\"trace\":" + obs::DefaultTrace().RenderJson() +
+      ",\"slo\":" + obs::DefaultSlo().RenderJson() +
       ",\"journal\":" + journal.RenderJson() + "}\n";
   Status s = WriteFileAtomically(path, body);
   if (s.ok()) {
@@ -2352,6 +2388,7 @@ int Main(int argc, char** argv) {
         static_cast<size_t>(loaded.config.flags.journal_capacity));
     obs::DefaultTrace().SetCapacity(
         static_cast<size_t>(loaded.config.flags.trace_capacity));
+    obs::DefaultSlo().SetWindow(loaded.config.flags.slo_window_s);
     // Fault injection arms on first load and re-arms only when the
     // SPEC changes; a reload with the same spec keeps the live rule
     // state (consumed counts, RNG position) — else a count=1
@@ -2444,6 +2481,7 @@ int Main(int argc, char** argv) {
       options.addr = flags.introspection_addr;
       options.journal = &obs::DefaultJournal();
       options.trace = &obs::DefaultTrace();
+      options.slo = &obs::DefaultSlo();
       // Freshness window: 2x the rewrite cadence — plus the health-exec
       // budget when --device-health=full, whose hourly re-measure
       // legitimately blocks a pass for up to health_exec_timeout_s; a
@@ -2468,7 +2506,7 @@ int Main(int argc, char** argv) {
       }
       TFD_LOG_INFO << "introspection server serving /healthz /readyz "
                       "/metrics /debug/journal /debug/labels /debug/trace "
-                      "on "
+                      "/debug/slo on "
                    << flags.introspection_addr << " (port "
                    << server->port() << ")";
     }

@@ -48,6 +48,15 @@ top so the per-mode functions only state their invariants:
               across two runs of one seed (the determinism pin), and
               the aggregator genuinely composed in (inventory consumed,
               zero full recomputes).
+  --slo       (ISSUE 16) the fleet-SLO section of a cluster-soak
+              record: the injected latency regression asserts a
+              multi-window burn in the fast window and clears after the
+              heal, burn verdicts reach published tpu.slo.*.burn
+              labels, the aggregator's merged stage sketches agree with
+              the harness's exact durations within the gamma-1.1 sketch
+              error, and the budget table still derives from
+              CLUSTER_STAGE_BUDGETS_MS (three-way drift check vs
+              tpufd.agg.SLO_STAGE_BUDGETS_MS).
 
 Every mode fails LOUDLY on records missing expected keys/phases — a
 partially-run or older-format soak record must not sail through its
@@ -63,6 +72,7 @@ Usage:
   python3 scripts/bench_gate.py --watch watch-soak.json
   python3 scripts/bench_gate.py --aggregate aggregate-soak.json
   python3 scripts/bench_gate.py --cluster cluster-soak.json
+  python3 scripts/bench_gate.py --slo cluster-soak.json
 """
 
 import argparse
@@ -654,6 +664,152 @@ def cluster_gate(record_path, reference_path, slack,
     return problems
 
 
+def slo_stage_budgets_ms():
+    """Re-derives the fleet SLO stage budgets from the cluster protocol
+    budgets above: plan and publish each get the chain "hold" allowance
+    (the governor's local think-time), render the "fanout" allowance
+    (pure CPU), and publish-acked — which absorbs brownout deferral —
+    hold+fanout. The tpufd.agg.SLO_STAGE_BUDGETS_MS table (and its C++
+    twin agg.cc DefaultSloBudgetsMs) must match this derivation; the
+    --slo gate cross-checks all three so one table cannot drift."""
+    hold = CLUSTER_STAGE_BUDGETS_MS["hold"]["*"]
+    fanout = CLUSTER_STAGE_BUDGETS_MS["fanout"]["*"]
+    return {
+        "plan": float(hold),
+        "render": float(fanout),
+        "publish": float(hold),
+        "publish-acked": float(hold + fanout),
+    }
+
+
+def slo_gate(record_path):
+    """Gates the fleet-SLO section of a cluster-soak record
+    (scripts/cluster_soak.py --json, "slo" key): the injected publish
+    latency regression must assert a burn in the fast window and clear
+    after the heal, burn verdicts must actually reach published labels,
+    and the fleet-side sketch quantiles must agree with the harness's
+    exact per-stage durations within the sketch's relative-error
+    guarantee (gamma 1.1, floored at the smallest representable value).
+    The budget table is re-derived from CLUSTER_STAGE_BUDGETS_MS and
+    cross-checked against both the record and tpufd.agg so the three
+    copies cannot drift apart. Absent keys FAIL loudly."""
+    problems = []
+    record = load_record(record_path, "slo", problems)
+    if record is None:
+        return problems
+    slo = require(record, "slo", "slo", problems)
+    if slo is None:
+        return problems
+
+    from tpufd import agg as agglib
+
+    # Budget-table three-way cross-check: derivation here, the Python
+    # twin table, and what the soak actually ran with.
+    derived = slo_stage_budgets_ms()
+    if dict(agglib.SLO_STAGE_BUDGETS_MS) != derived:
+        problems.append(
+            f"tpufd.agg.SLO_STAGE_BUDGETS_MS {agglib.SLO_STAGE_BUDGETS_MS} "
+            f"!= derivation from CLUSTER_STAGE_BUDGETS_MS {derived} — "
+            "the budget tables drifted")
+    recorded = require(slo, "budgets_ms", "slo", problems)
+    if recorded is not None and dict(recorded) != derived:
+        problems.append(
+            f"record ran with budgets {recorded} != derived {derived}")
+
+    # The regression must exist, have stretched real publishes, and
+    # every SLO stage must have folded samples (vacuous-run guard).
+    regression = require(slo, "regression", "slo", problems)
+    stretched = require(slo, "stretched_publishes", "slo", problems)
+    if stretched is not None and stretched == 0:
+        problems.append("the slowdown stretched no publishes "
+                        "(vacuous regression)")
+    folds = require(slo, "folds", "slo", problems)
+    if folds is not None:
+        for stage in agglib.SLO_STAGES:
+            if not folds.get(stage):
+                problems.append(f"no {stage} durations ever folded "
+                                "into a sketch")
+
+    # Burn timing: at least one assert->clear interval must overlap
+    # [regression start, regression end + fast window] — the burn fired
+    # BECAUSE of the injected latency, inside the fast window — and
+    # nothing may still be burning at soak end (the clear path works).
+    fast_window = require(slo, "fast_window_s", "slo", problems)
+    edges = require(slo, "burn_edges", "slo", problems)
+    if None not in (regression, fast_window, edges):
+        window_end = regression["end"] + fast_window
+        live = {}
+        overlapped = False
+        for edge in edges:
+            if edge["burning"]:
+                live[edge["stage"]] = edge["t"]
+            else:
+                asserted = live.pop(edge["stage"], None)
+                if asserted is not None and asserted <= window_end \
+                        and edge["t"] > regression["start"]:
+                    overlapped = True
+        for asserted in live.values():
+            if asserted <= window_end:
+                overlapped = True
+        if not edges:
+            problems.append("no burn edges at all — the evaluator "
+                            "never ran or never tripped")
+        elif not overlapped:
+            problems.append(
+                f"no burn interval overlaps the regression window "
+                f"[{regression['start']}, {window_end}] — the burn "
+                "did not fire on the injected latency")
+    burning = require(slo, "burning_at_end", "slo", problems)
+    if burning:
+        problems.append(
+            f"stages still burning at soak end: {burning} — the clear "
+            "path (sketch retirement -> republish -> unmerge) is broken")
+    flushes = require(slo, "burn_label_flushes", "slo", problems)
+    if flushes is not None and flushes == 0:
+        problems.append("no aggregator flush ever carried a "
+                        "tpu.slo.*.burn label — burn verdicts never "
+                        "reached published labels")
+
+    # Fleet-vs-harness quantile cross-check: the aggregator's merged
+    # sketches vs the harness's exact durations, captured in the same
+    # instant. Counts must match EXACTLY (merge loses no samples);
+    # quantiles within the gamma-1.1 relative error, floored at the
+    # sketch's smallest representable value (durations clamped to ~0
+    # land in bucket 0, whose representative is SKETCH_MIN).
+    checkpoint = require(slo, "checkpoint", "slo", problems)
+    if checkpoint is not None:
+        fleet = checkpoint.get("fleet") or {}
+        harness = checkpoint.get("harness") or {}
+        if not fleet:
+            problems.append("checkpoint captured no fleet sketches "
+                            "(vacuous cross-check)")
+        if sorted(fleet) != sorted(harness):
+            problems.append(
+                f"checkpoint stage sets differ: fleet {sorted(fleet)} "
+                f"vs harness {sorted(harness)}")
+        for stage in sorted(set(fleet) & set(harness)):
+            if fleet[stage].get("n") != harness[stage].get("n"):
+                problems.append(
+                    f"checkpoint {stage}: fleet n {fleet[stage].get('n')}"
+                    f" != harness n {harness[stage].get('n')} — the "
+                    "merge lost or duplicated samples")
+            for q in ("p50_ms", "p99_ms"):
+                got = fleet[stage].get(q)
+                exact = harness[stage].get(q)
+                if None in (got, exact):
+                    problems.append(
+                        f"checkpoint {stage} missing {q}")
+                    continue
+                ceiling = max(exact * agglib.SKETCH_GAMMA,
+                              agglib.SKETCH_MIN) + 0.002
+                if not (exact - 0.002 <= got <= ceiling):
+                    problems.append(
+                        f"checkpoint {stage} {q}: fleet {got} vs "
+                        f"harness {exact} — outside the gamma-"
+                        f"{agglib.SKETCH_GAMMA} sketch error")
+    return problems
+
+
 def reference_dirty_p50_ms(path):
     """steady_dirty_p50_ms from a committed bench record (either the
     bare record or the driver's {parsed: ...} wrapper)."""
@@ -728,6 +884,12 @@ def main(argv=None):
                     default=8000.0)
     ap.add_argument("--cluster-recovery-budget-s", type=float,
                     default=10.0)
+    ap.add_argument("--slo", metavar="RECORD.json",
+                    help="gate the fleet-SLO section of a cluster-soak "
+                         "record: burn timing vs the injected latency "
+                         "regression, burn labels actually published, "
+                         "fleet-vs-harness sketch quantiles within the "
+                         "gamma-1.1 error, budget tables un-drifted")
     ap.add_argument("--plugin", metavar="RECORD.json",
                     help="gate this probe-plugin containment soak record "
                          "(scripts/plugin_soak.py --json)")
@@ -777,6 +939,9 @@ def main(argv=None):
             args.cluster, args.cluster_reference, args.cluster_slack,
             args.cluster_placement_budget_ms,
             args.cluster_recovery_budget_s))
+
+    if args.slo:
+        return run_mode("slo", slo_gate(args.slo))
 
     if args.watch:
         return run_mode("watch", watch_gate(

@@ -65,6 +65,7 @@ import sys
 sys.path.insert(0, os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
 
+from tpufd import agg as agglib  # noqa: E402
 from tpufd import cluster as clusterlib  # noqa: E402
 from tpufd import sink as sinklib  # noqa: E402
 from tpufd.fakes.simnet import (  # noqa: E402
@@ -94,6 +95,18 @@ AGG_DEBOUNCE_S = 1.0
 AGG_LEASE_S = 30.0
 JOB_FAIL_DETECT_S = 1.0
 
+# Fleet SLO engine (ISSUE 16), time-compressed for the virtual clock:
+# the node's 10-minute sketch window becomes 15 virtual seconds, the
+# burn evaluator's 5m/1h fast/slow windows become 5s/20s. Same
+# arithmetic (tpufd.agg.BurnEvaluator takes the windows as
+# parameters), ~40x compression so a soak covers fold -> burn ->
+# retire -> clear end to end.
+SLO_WINDOW_S = 15.0
+SLO_FAST_WINDOW_S = 5.0
+SLO_SLOW_WINDOW_S = 20.0
+SLO_BURN_TICK_S = 0.5   # the runner's flush-loop evaluation cadence
+SLO_NODE_TICK_S = 1.0   # each daemon's retire-oldest sweep
+
 
 # ---- the apiserver, as the cluster sees it --------------------------------
 
@@ -120,6 +133,9 @@ class ClusterApiServer:
         self.shard_buckets = {}    # (shard, sec) -> writes
         self.brownout_until = 0.0
         self.brownout_rejected = 0
+        self.slowdown_until = 0.0
+        self.slowdown_delay_s = 0.0
+        self.slowdown_stretched = 0
         self.agg_requests = {}     # int(t) -> n (SimAggregator surface)
         self.output_writes = []    # (t, labels) rollup applies
 
@@ -158,6 +174,18 @@ class ClusterApiServer:
 
     def brownout_active(self, t):
         return t < self.brownout_until
+
+    def slowdown(self, t, secs, delay_s):
+        """The SLO engine's latency-regression drill: for `secs`, every
+        publish attempt lands ~delay_s late (a tail-latency regression,
+        NOT an outage — watches, reads, and the aggregator's rollup
+        writes are unaffected, so the burn verdict can still publish
+        WHILE the regression is in flight)."""
+        self.slowdown_until = max(self.slowdown_until, t + secs)
+        self.slowdown_delay_s = delay_s
+
+    def slowdown_active(self, t):
+        return t < self.slowdown_until
 
     def daemon_apply(self, t, node, labels):
         """A daemon's SSA write: store + watch fan-out. Brownout pacing
@@ -199,6 +227,37 @@ class ClusterAggregator(SimAggregator):
         # the agg-debounce channel of the stage breakdown.
         self.pending_change_ids = {}
         self.agg_latency_ms_by_op = {}
+        # Fleet SLO engine (ISSUE 16): multi-window burn evaluation over
+        # the merged per-stage sketches, on the flush-loop cadence the
+        # real runner uses (the sim compresses the windows, not the
+        # arithmetic).
+        self.burn = agglib.BurnEvaluator(
+            agglib.slo_budgets_ms_from_spec(""),
+            fast_window_s=SLO_FAST_WINDOW_S,
+            slow_window_s=SLO_SLOW_WINDOW_S)
+        self.burn_edges = []        # {"t", "stage", "burning"}
+        self.burn_label_flushes = 0
+
+    def _stage_slo(self, labels):
+        # The annotation analogue: serialized stage sketches ride the
+        # object next to the change id (tpufd.cluster.SLO_KEY).
+        return (labels or {}).get(clusterlib.SLO_KEY, "")
+
+    def sync(self, t):
+        super().sync(t)
+        self.clock.schedule(t + SLO_BURN_TICK_S,
+                            lambda now: self._burn_tick(now))
+
+    def _burn_tick(self, now):
+        for stage, burning in self.burn.note(now, self.store.stage):
+            self.burn_edges.append({"t": round(now, 3), "stage": stage,
+                                    "burning": burning})
+            # A verdict edge is a label movement: it rides the very
+            # flush it dirties (the runner evaluates before the flush
+            # decision for the same reason).
+            self._note_dirty(now)
+        self.clock.schedule(now + SLO_BURN_TICK_S,
+                            lambda t: self._burn_tick(t))
 
     def on_event(self, t, node, labels):
         if labels and self.tracker is not None:
@@ -226,6 +285,13 @@ class ClusterAggregator(SimAggregator):
         super()._flush(t)
         if len(self.server.output_writes) > before:
             _, labels = self.server.output_writes[-1]
+            # Burn verdict labels ride the rollup exactly like the real
+            # runner's output: one row per currently-burning stage.
+            burning = self.burn.burning_stages()
+            for stage in burning:
+                labels[agglib.SLO_BURN_PREFIX + stage + ".burn"] = "true"
+            if burning:
+                self.burn_label_flushes += 1
             delivered = dict(labels)
             if self.pending_change_ids:
                 # Echo the latest change id this rollup folded in (the
@@ -268,6 +334,13 @@ class SimHost:
         self.gt_preempting = False
         self.gt_alive = True
         self.publish_pending = False
+        # Windowed stage-SLO sketches (obs/slo.h StageSlo analogue):
+        # closed causal chains fold here; folds older than SLO_WINDOW_S
+        # retire on the node tick and the shrunken serialization rides
+        # the next publish.
+        self.slo_folds = []      # (fold t, slo stage, ms)
+        self.slo_sketches = {}   # slo stage -> agglib.Sketch
+        self.slo_tick_live = False
 
     def reachable(self):
         """Can this daemon talk to the apiserver / blackboard at all?
@@ -309,6 +382,12 @@ class SimHost:
         open_ids = [i for i in open_ids if i is not None]
         if open_ids:
             labels[clusterlib.CHANGE_KEY] = str(max(open_ids))
+        # The stage-slo annotation analogue: the current windowed
+        # sketches, serialized exactly like the real daemon's
+        # tfd.google.com/stage-slo (empty sketches write nothing).
+        if self.slo_sketches:
+            labels[clusterlib.SLO_KEY] = \
+                agglib.serialize_stage_sketches(self.slo_sketches)
         return labels
 
     def mark_dirty(self, t):
@@ -321,7 +400,7 @@ class SimHost:
         self.clock.schedule(t + self.rng.uniform(0.1, 0.5),
                             lambda now: self._publish(now))
 
-    def _publish(self, now):
+    def _publish(self, now, stretched=False):
         if not self.reachable():
             self.publish_pending = False  # re-marked on heal
             return
@@ -338,8 +417,59 @@ class SimHost:
             self.clock.schedule(now + self.rng.uniform(0.6, 1.4),
                                 lambda t: self._publish(t))
             return
+        if not stretched and self.server.slowdown_active(now):
+            # The latency-regression drill: this write lands ~delay_s
+            # late, exactly once (a stretched tail, not a retry storm).
+            # The hold stamp above already closed, so the whole stretch
+            # is "publish" time — the duration the SLO engine must
+            # catch burning.
+            self.server.slowdown_stretched += 1
+            self.clock.schedule(
+                now + self.server.slowdown_delay_s *
+                self.rng.uniform(0.8, 1.2),
+                lambda t: self._publish(t, stretched=True))
+            return
         self.publish_pending = False
         self.server.daemon_apply(now, self.name, self.desired_labels())
+
+    # ---- the windowed stage-SLO fold (obs/slo.h analogue) -----------------
+
+    def fold_slo(self, now, stage_ms):
+        """One closed causal chain's durations, mapped onto the node
+        SLO stages, fold into this daemon's windowed sketches; the
+        updated serialization rides the next publish."""
+        for stage in sorted(stage_ms):
+            self.slo_folds.append((now, stage, stage_ms[stage]))
+            self.slo_sketches.setdefault(
+                stage, agglib.Sketch()).add(stage_ms[stage])
+        self.mark_dirty(now)
+        if not self.slo_tick_live:
+            self.slo_tick_live = True
+            self.clock.schedule(now + SLO_NODE_TICK_S,
+                                lambda t: self._slo_tick(t))
+
+    def _slo_tick(self, now):
+        """Retire-oldest: folds past the window leave the sketches
+        (exact removal — the sketch is removable by design) and the
+        shrunken view republishes, which is what lets the fleet burn
+        verdict CLEAR after a regression heals."""
+        cutoff = now - SLO_WINDOW_S
+        expired = [f for f in self.slo_folds if f[0] <= cutoff]
+        if expired:
+            self.slo_folds = [f for f in self.slo_folds
+                              if f[0] > cutoff]
+            for _, stage, ms in expired:
+                sketch = self.slo_sketches.get(stage)
+                if sketch is not None:
+                    sketch.remove(ms)
+                    if sketch.total <= 0:
+                        del self.slo_sketches[stage]
+            self.mark_dirty(now)
+        if self.slo_folds:
+            self.clock.schedule(now + SLO_NODE_TICK_S,
+                                lambda t: self._slo_tick(t))
+        else:
+            self.slo_tick_live = False
 
     # ---- ground-truth injections (the schedule's ops) ---------------------
 
@@ -507,6 +637,18 @@ def default_schedule_text(slices, hosts):
 82   heal-partition s1
 83   leader-restart s2
 84   heal           s3/h2
+# phase C — the SLO regression drill (ISSUE 16): a stretched-publish
+# window with serialized failures inside it; the burn verdict must
+# assert while the stretch is live and clear once the folds retire
+90   slowdown       apiserver secs=16 delay=3
+92   degrade        s4/h0
+94   degrade        s5/h1
+96   degrade        s6/h2
+98   degrade        s7/h3
+108  heal           s4/h0
+109  heal           s5/h1
+110  heal           s6/h2
+111  heal           s7/h3
 """
 
 
@@ -527,6 +669,11 @@ def quick_schedule_text(slices, hosts):
 24 partition      s0 hosts=0-1
 32 heal-partition s0
 28 brownout       apiserver secs=3
+36 slowdown       apiserver secs=10 delay=3
+37 degrade        s1/h1
+39 degrade        s2/h0
+52 heal           s1/h1
+53 heal           s2/h0
 """
 
 
@@ -603,6 +750,11 @@ class Harness:
         self.jobs_requeued = 0
         self.inventory_updates = 0
         self.sched_events = 0
+        # Fleet SLO engine (ISSUE 16): the harness's own copy of every
+        # fold (ground truth for the fleet-vs-harness cross-check) and
+        # the checkpoint snapshot taken after the regression drill.
+        self.slo_folds = []        # (t, slo stage, ms)
+        self.slo_checkpoint = None
 
     # ---- label-side hooks (wired as watch delivery) -----------------------
 
@@ -644,7 +796,20 @@ class Harness:
                 # Close the causal chain at the SAME moment the
                 # end-to-end latency resolves: the stage durations
                 # partition exactly this number.
-                self.changes.close(node, now)
+                closed = self.changes.close(node, now)
+                # The fold: the victim's daemon sketches its own closed
+                # chain (the sim analogue of MarkPublished feeding
+                # StageSlo) and the harness keeps the exact values the
+                # fleet rollup must reproduce within sketch error.
+                if closed is not None:
+                    stage_ms = clusterlib.slo_stage_durations(
+                        closed["stages"])
+                    host = self.hosts.get(node)
+                    if host is not None:
+                        host.fold_slo(now, stage_ms)
+                    for stage in sorted(stage_ms):
+                        self.slo_folds.append(
+                            (now, stage, stage_ms[stage]))
         for node in sorted(self.up_track):
             if self.sched.placeable(node, blocked):
                 t0, op = self.up_track.pop(node)
@@ -757,6 +922,12 @@ class Harness:
         if server.brownout_active(now):
             until = max(until,
                         server.brownout_until + BROWNOUT_GRACE_S)
+        if server.slowdown_active(now):
+            # A stretched publish adds ~delay_s to the pipeline; 1.5x
+            # covers the stretch jitter.
+            until = max(until, now + window +
+                        server.slowdown_delay_s * 1.5 +
+                        BROWNOUT_GRACE_S)
         self.excused_until[node] = until
         self.down_track[node] = (now, op)
         self.changes.mint(op, node, now)
@@ -786,6 +957,49 @@ class Harness:
                 self.excused_until[node] = max(
                     until, brownout_until + BROWNOUT_GRACE_S)
 
+    def extend_windows_for_slowdown(self, now, delay_s):
+        """A slowdown stretches every in-flight publish by ~delay_s:
+        every open convergence window pays the same stretch."""
+        for node, until in sorted(self.excused_until.items()):
+            if until > now:
+                self.excused_until[node] = \
+                    until + delay_s * 1.5 + BROWNOUT_GRACE_S
+
+    def slo_checkpoint_snap(self, now, aggregator):
+        """One deterministic mid-soak snapshot, taken after the
+        regression drill's chains have closed and published but before
+        their folds retire: the merged fleet sketches (what the
+        aggregator would label) next to the harness's exact values for
+        the same window, quantiled with the sketch's own nearest-rank
+        rule so the only divergence left is bucketing error (gamma
+        1.1) — the cross-check bench_gate --slo enforces."""
+        fleet = {}
+        for stage in sorted(aggregator.store.stage):
+            sketch = aggregator.store.stage[stage]
+            if sketch.total > 0:
+                fleet[stage] = {
+                    "n": sketch.total,
+                    "p50_ms": round(sketch.quantile(0.50), 3),
+                    "p99_ms": round(sketch.quantile(0.99), 3),
+                }
+        cutoff = now - SLO_WINDOW_S
+        by_stage = {}
+        for t, stage, ms in self.slo_folds:
+            if t > cutoff:
+                by_stage.setdefault(stage, []).append(ms)
+        harness = {}
+        for stage in sorted(by_stage):
+            values = sorted(by_stage[stage])
+            def rank(q):
+                return values[int(q * (len(values) - 1))]
+            harness[stage] = {
+                "n": len(values),
+                "p50_ms": round(rank(0.50), 3),
+                "p99_ms": round(rank(0.99), 3),
+            }
+        self.slo_checkpoint = {"t": round(now, 3), "fleet": fleet,
+                               "harness": harness}
+
 
 def apply_event(ev, now, server, slices, harness):
     """Dispatches one parsed ScheduleEvent into ground truth + the
@@ -793,6 +1007,11 @@ def apply_event(ev, now, server, slices, harness):
     if ev.op == "brownout":
         server.brownout(now, float(ev.args.get("secs", "5")))
         harness.extend_windows_for_brownout(now, server.brownout_until)
+        return
+    if ev.op == "slowdown":
+        delay = float(ev.args.get("delay", "3"))
+        server.slowdown(now, float(ev.args.get("secs", "10")), delay)
+        harness.extend_windows_for_slowdown(now, delay)
         return
     sl = slices[ev.slice_idx]
     if ev.op in clusterlib.HOST_OPS:
@@ -869,6 +1088,19 @@ def run_sim(args, schedule_text):
     storm_start, storm_end = storm_window(events)
     t_end = max(e.at for e in events) + args.drain_secs
 
+    # The SLO regression drill: the checkpoint snapshot lands after the
+    # LAST slowdown window ends (its chains closed and published) but
+    # before their folds retire from the node windows.
+    slowdowns = [e for e in events if e.op == "slowdown"]
+    regression = None
+    if slowdowns:
+        last = slowdowns[-1]
+        regression = {
+            "start": last.at,
+            "end": last.at + float(last.args.get("secs", "10")),
+            "delay_s": float(last.args.get("delay", "3")),
+        }
+
     # Rollout: hosts publish their first labels staggered across 5s
     # (hash-of-name phase, the fleet desync idiom).
     for name in sorted(hosts_by_name):
@@ -900,6 +1132,10 @@ def run_sim(args, schedule_text):
             ev.at,
             lambda now, ev=ev: apply_event(ev, now, server, slices,
                                            harness))
+    if regression is not None:
+        clock.schedule(
+            regression["end"] + 5.0,
+            lambda now: harness.slo_checkpoint_snap(now, aggregator))
     clock.run(t_end)
 
     # ---- assemble the record ---------------------------------------------
@@ -994,6 +1230,26 @@ def run_sim(args, schedule_text):
         "leader_transitions": sum(sl.leader_transitions for sl in slices),
         "by_verb": {k: server.by_verb[k]
                     for k in sorted(server.by_verb)},
+        # Fleet SLO engine (ISSUE 16): the burn verdict trail, the
+        # regression drill's shape, and the fleet-vs-harness checkpoint
+        # bench_gate --slo cross-checks within sketch error.
+        "slo": {
+            "window_s": SLO_WINDOW_S,
+            "fast_window_s": SLO_FAST_WINDOW_S,
+            "slow_window_s": SLO_SLOW_WINDOW_S,
+            "budgets_ms": {s: aggregator.burn.budgets[s]
+                           for s in sorted(aggregator.burn.budgets)},
+            "regression": regression,
+            "stretched_publishes": server.slowdown_stretched,
+            "folds": {
+                s: sum(1 for _, stage, _ in harness.slo_folds
+                       if stage == s)
+                for s in agglib.SLO_STAGES},
+            "burn_edges": aggregator.burn_edges,
+            "burning_at_end": aggregator.burn.burning_stages(),
+            "burn_label_flushes": aggregator.burn_label_flushes,
+            "checkpoint": harness.slo_checkpoint,
+        },
     }
     return record
 
@@ -1064,6 +1320,84 @@ def check_record(record):
                 f"{op}: stage means sum to {sb['mean_stage_sum_ms']}ms "
                 f"but the e2e mean is {sb['mean_e2e_ms']}ms — the "
                 "stages do not partition the end-to-end latency")
+    problems.extend(check_slo(record["slo"]))
+    return problems
+
+
+def check_slo(slo):
+    """The SLO engine's own acceptance invariants (bench_gate --slo
+    re-checks the committed record with the budget cross-derivation on
+    top). Only enforced when the schedule ran a regression drill."""
+    problems = []
+    regression = slo.get("regression")
+    if regression is None:
+        return problems
+    if not slo.get("stretched_publishes"):
+        problems.append("a slowdown was scheduled but no publish was "
+                        "ever stretched — the regression drill is "
+                        "vacuous")
+    edges = slo.get("burn_edges", [])
+    window_end = regression["end"] + slo["fast_window_s"]
+    # The verdict must be BURNING at some point inside the regression
+    # window (an assert edge at or before window_end with no clear
+    # before the window starts also covers a pre-regression assert
+    # from an earlier over-budget burst).
+    burning_in_window = False
+    live = {}  # stage -> assert t, for burn intervals still open
+    for edge in edges:
+        if edge["burning"]:
+            live[edge["stage"]] = edge["t"]
+        else:
+            asserted = live.pop(edge["stage"], None)
+            if asserted is not None and asserted <= window_end and \
+                    edge["t"] > regression["start"]:
+                burning_in_window = True
+    if any(t <= window_end for t in live.values()):
+        burning_in_window = True
+    if not burning_in_window:
+        problems.append(
+            "the regression drill never asserted a burn verdict "
+            f"inside its window (through {window_end}s)")
+    if slo.get("burning_at_end"):
+        problems.append(
+            f"stages {slo['burning_at_end']} still burning at soak "
+            "end — the verdict never cleared after the heal")
+    if not slo.get("burn_label_flushes"):
+        problems.append("no published rollup ever carried a "
+                        "tpu.slo.*.burn label — the verdict never "
+                        "reached the label surface")
+    checkpoint = slo.get("checkpoint")
+    if not checkpoint or not checkpoint.get("fleet"):
+        problems.append("the SLO checkpoint is missing or empty — the "
+                        "fleet sketches never merged")
+        return problems
+    fleet, harness = checkpoint["fleet"], checkpoint["harness"]
+    if sorted(fleet) != sorted(harness):
+        problems.append(
+            f"checkpoint stage sets diverge: fleet {sorted(fleet)} vs "
+            f"harness {sorted(harness)}")
+        return problems
+    for stage in sorted(fleet):
+        f, h = fleet[stage], harness[stage]
+        if f["n"] != h["n"]:
+            problems.append(
+                f"checkpoint {stage}: fleet folded {f['n']} samples "
+                f"but the harness saw {h['n']} — the annotation "
+                "channel dropped or duplicated folds")
+            continue
+        for q in ("p50_ms", "p99_ms"):
+            exact = h[q]
+            got = f[q]
+            # The sketch rounds UP to its bucket edge: within one
+            # gamma of the exact value, floored at the sketch's
+            # smallest representable value (values under SKETCH_MIN
+            # all land in bucket 0, whose representative is
+            # SKETCH_MIN); tiny epsilon for fixed3 rounding.
+            ceiling = max(exact * 1.1, agglib.SKETCH_MIN) + 0.002
+            if not (exact - 0.002 <= got <= ceiling):
+                problems.append(
+                    f"checkpoint {stage} {q}: fleet {got} vs harness "
+                    f"{exact} — outside the gamma-1.1 sketch error")
     return problems
 
 

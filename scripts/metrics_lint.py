@@ -174,6 +174,9 @@ CONDITIONAL = {
     "tfd_agg_flushes_total",
     "tfd_agg_full_recomputes_total",
     "tfd_agg_flush_latency_seconds",
+    # Fleet SLO engine (ISSUE 16): the burn-state gauge registers only
+    # in --mode=aggregator once a stage with a budget has been seen.
+    "tfd_slo_burn_state",
 }
 
 

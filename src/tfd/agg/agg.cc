@@ -95,6 +95,21 @@ void QuantileSketch::Merge(const QuantileSketch& other) {
   total_ += other.total_;
 }
 
+void QuantileSketch::Unmerge(const QuantileSketch& other) {
+  for (int i = 0; i < kSketchBuckets; i++) {
+    int64_t take =
+        other.counts_[i] < counts_[i] ? other.counts_[i] : counts_[i];
+    counts_[i] -= take;
+    total_ -= take;
+  }
+}
+
+void QuantileSketch::AddBucketCount(int bucket, int64_t n) {
+  if (bucket < 0 || bucket >= kSketchBuckets || n <= 0) return;
+  counts_[bucket] += n;
+  total_ += n;
+}
+
 double QuantileSketch::Quantile(double q) const {
   if (total_ <= 0) return -1;
   if (q < 0) q = 0;
@@ -110,9 +125,151 @@ double QuantileSketch::Quantile(double q) const {
   return SketchBucketValue(kSketchBuckets - 1);
 }
 
+double QuantileSketch::FractionAbove(double threshold) const {
+  if (total_ <= 0) return 0;
+  int64_t over = 0;
+  for (int i = 0; i < kSketchBuckets; i++) {
+    if (counts_[i] > 0 && SketchBucketValue(i) > threshold) {
+      over += counts_[i];
+    }
+  }
+  return static_cast<double>(over) / static_cast<double>(total_);
+}
+
 void QuantileSketch::Clear() {
   counts_.fill(0);
   total_ = 0;
+}
+
+// ---- stage sketches -------------------------------------------------------
+
+std::map<std::string, double> DefaultSloBudgetsMs() {
+  return {{"plan", 1200},
+          {"render", 100},
+          {"publish", 1200},
+          {"publish-acked", 1300}};
+}
+
+std::map<std::string, double> SloBudgetsMsFromSpec(const std::string& spec) {
+  std::map<std::string, double> budgets = DefaultSloBudgetsMs();
+  for (const std::string& entry : SplitString(spec, ',')) {
+    size_t eq = entry.find('=');
+    if (eq == std::string::npos) continue;
+    std::string stage = entry.substr(0, eq);
+    if (budgets.find(stage) == budgets.end()) continue;
+    int ms = 0;
+    if (!ParseNonNegInt(entry.substr(eq + 1), &ms) || ms <= 0) continue;
+    budgets[stage] = static_cast<double>(ms);
+  }
+  return budgets;
+}
+
+std::string SerializeStageSketches(const StageSketches& stages) {
+  std::string out;
+  for (const char* name : kSloStages) {
+    auto it = stages.find(name);
+    if (it == stages.end() || it->second.count() <= 0) continue;
+    if (!out.empty()) out += ';';
+    out += name;
+    out += '=';
+    bool first = true;
+    const auto& counts = it->second.bucket_counts();
+    for (int i = 0; i < kSketchBuckets; i++) {
+      if (counts[i] <= 0) continue;
+      if (!first) out += ',';
+      first = false;
+      out += std::to_string(i);
+      out += ':';
+      out += std::to_string(counts[i]);
+    }
+  }
+  return out;
+}
+
+StageSketches ParseStageSketches(const std::string& text) {
+  StageSketches out;
+  for (const std::string& entry : SplitString(text, ';')) {
+    size_t eq = entry.find('=');
+    if (eq == std::string::npos) continue;
+    std::string stage = entry.substr(0, eq);
+    bool known = false;
+    for (const char* name : kSloStages) known |= stage == name;
+    if (!known) continue;  // a newer (or hostile) node's vocabulary
+    QuantileSketch sketch;
+    for (const std::string& pair : SplitString(entry.substr(eq + 1), ',')) {
+      size_t colon = pair.find(':');
+      if (colon == std::string::npos) continue;
+      int bucket = 0;
+      int n = 0;
+      if (!ParseNonNegInt(pair.substr(0, colon), &bucket) ||
+          !ParseNonNegInt(pair.substr(colon + 1), &n)) {
+        continue;
+      }
+      sketch.AddBucketCount(bucket, n);
+    }
+    if (sketch.count() > 0) out[stage].Merge(sketch);
+  }
+  return out;
+}
+
+// ---- burn evaluator -------------------------------------------------------
+
+BurnEvaluator::BurnEvaluator(std::map<std::string, double> budgets_ms,
+                             double fast_window_s, double slow_window_s)
+    : budgets_(std::move(budgets_ms)),
+      fast_window_s_(fast_window_s),
+      slow_window_s_(slow_window_s) {}
+
+std::vector<BurnEvaluator::Edge> BurnEvaluator::Note(
+    double now, const StageSketches& sketches) {
+  std::vector<Edge> edges;
+  for (const auto& [stage, budget] : budgets_) {
+    auto sk = sketches.find(stage);
+    bool have = sk != sketches.end() && sk->second.count() > 0;
+    if (!have && stages_.find(stage) == stages_.end()) continue;
+    double fraction = have ? sk->second.FractionAbove(budget) : 0.0;
+    StageState& state = stages_[stage];
+    state.samples.emplace_back(now, fraction);
+    while (!state.samples.empty() &&
+           state.samples.front().first <= now - slow_window_s_) {
+      state.samples.pop_front();
+    }
+    double fast_sum = 0;
+    int64_t fast_n = 0;
+    double slow_sum = 0;
+    int64_t slow_n = 0;
+    for (const auto& [ts, f] : state.samples) {
+      slow_sum += f;
+      slow_n++;
+      if (ts > now - fast_window_s_) {
+        fast_sum += f;
+        fast_n++;
+      }
+    }
+    double fast = fast_n > 0 ? fast_sum / static_cast<double>(fast_n) : 0;
+    double slow = slow_n > 0 ? slow_sum / static_cast<double>(slow_n) : 0;
+    if (!state.burning && fast >= kFastThreshold && slow >= kSlowThreshold) {
+      state.burning = true;
+      edges.push_back({stage, true});
+    } else if (state.burning && fast < kFastThreshold) {
+      state.burning = false;
+      edges.push_back({stage, false});
+    }
+  }
+  return edges;
+}
+
+bool BurnEvaluator::burning(const std::string& stage) const {
+  auto it = stages_.find(stage);
+  return it != stages_.end() && it->second.burning;
+}
+
+std::vector<std::string> BurnEvaluator::BurningStages() const {
+  std::vector<std::string> out;
+  for (const auto& [stage, state] : stages_) {
+    if (state.burning) out.push_back(stage);
+  }
+  return out;
 }
 
 // ---- contribution ---------------------------------------------------------
@@ -123,11 +280,14 @@ bool NodeContribution::operator==(const NodeContribution& other) const {
          multislice_group == other.multislice_group &&
          perf_class == other.perf_class && chips == other.chips &&
          matmul_tflops == other.matmul_tflops &&
-         hbm_gbps == other.hbm_gbps && preempting == other.preempting;
+         hbm_gbps == other.hbm_gbps && preempting == other.preempting &&
+         stage_slo == other.stage_slo;
 }
 
-NodeContribution ExtractContribution(const lm::Labels& labels) {
+NodeContribution ExtractContribution(const lm::Labels& labels,
+                                     const std::string& stage_slo) {
   NodeContribution c;
+  c.stage_slo = stage_slo;
   c.slice_id = LabelOr(labels, lm::kSliceId, "");
   c.slice_degraded = LabelTrue(labels, lm::kSliceDegraded);
   c.multislice_group = LabelOr(labels, lm::kMultisliceSliceId, "");
@@ -168,6 +328,14 @@ void InventoryStore::Retire(const NodeContribution& c) {
   if (c.preempting) preempting_nodes_--;
   if (c.matmul_tflops >= 0) matmul_.Remove(c.matmul_tflops);
   if (c.hbm_gbps >= 0) hbm_.Remove(c.hbm_gbps);
+  if (!c.stage_slo.empty()) {
+    for (const auto& [stage, sketch] : ParseStageSketches(c.stage_slo)) {
+      auto it = stage_.find(stage);
+      if (it == stage_.end()) continue;
+      it->second.Unmerge(sketch);
+      if (it->second.count() <= 0) stage_.erase(it);
+    }
+  }
 }
 
 void InventoryStore::Admit(const NodeContribution& c) {
@@ -182,12 +350,17 @@ void InventoryStore::Admit(const NodeContribution& c) {
   if (c.preempting) preempting_nodes_++;
   if (c.matmul_tflops >= 0) matmul_.Add(c.matmul_tflops);
   if (c.hbm_gbps >= 0) hbm_.Add(c.hbm_gbps);
+  if (!c.stage_slo.empty()) {
+    for (const auto& [stage, sketch] : ParseStageSketches(c.stage_slo)) {
+      stage_[stage].Merge(sketch);
+    }
+  }
 }
 
-bool InventoryStore::Apply(const std::string& node,
-                           const lm::Labels& labels) {
+bool InventoryStore::Apply(const std::string& node, const lm::Labels& labels,
+                           const std::string& stage_slo) {
   events_++;
-  NodeContribution next = ExtractContribution(labels);
+  NodeContribution next = ExtractContribution(labels, stage_slo);
   auto it = nodes_.find(node);
   if (it != nodes_.end()) {
     if (it->second == next) return false;  // e.g. a probe-ms-only delta
@@ -254,6 +427,13 @@ lm::Labels InventoryStore::BuildOutputLabels() const {
     out[lm::kFleetHbmP10] = Fixed3(hbm_.Quantile(0.10));
     out[lm::kFleetHbmP50] = Fixed3(hbm_.Quantile(0.50));
   }
+  for (const char* stage : kSloStages) {
+    auto it = stage_.find(stage);
+    if (it == stage_.end() || it->second.count() <= 0) continue;
+    std::string base = std::string(lm::kObsStagePrefix) + stage;
+    out[base + ".p50-ms"] = Fixed3(it->second.Quantile(0.50));
+    out[base + ".p99-ms"] = Fixed3(it->second.Quantile(0.99));
+  }
   return out;
 }
 
@@ -265,6 +445,7 @@ void InventoryStore::RecomputeAll() {
   preempting_nodes_ = 0;
   matmul_.Clear();
   hbm_.Clear();
+  stage_.clear();
   for (const auto& [node, c] : nodes_) {
     (void)node;
     Admit(c);
@@ -279,6 +460,7 @@ void InventoryStore::Clear() {
   preempting_nodes_ = 0;
   matmul_.Clear();
   hbm_.Clear();
+  stage_.clear();
 }
 
 // ---- flush controller -----------------------------------------------------

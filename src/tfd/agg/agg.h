@@ -27,8 +27,10 @@
 
 #include <array>
 #include <cstdint>
+#include <deque>
 #include <map>
 #include <string>
+#include <utility>
 #include <vector>
 
 #include "tfd/lm/labeler.h"
@@ -62,9 +64,22 @@ class QuantileSketch {
   // the store only ever removes what it admitted).
   void Remove(double value);
   void Merge(const QuantileSketch& other);
+  // Retires a previously-Merged sketch (per-bucket, clamped at zero —
+  // the inverse the removable design buys; a rank digest has none).
+  void Unmerge(const QuantileSketch& other);
+  // Deserialization primitive: lands `n` observations directly in
+  // `bucket` (out-of-range bucket / non-positive n are ignored).
+  void AddBucketCount(int bucket, int64_t n);
   int64_t count() const { return total_; }
+  const std::array<int64_t, kSketchBuckets>& bucket_counts() const {
+    return counts_;
+  }
   // Representative value at quantile q in [0,1]; -1 when empty.
   double Quantile(double q) const;
+  // Fraction of the mass whose bucket representative exceeds
+  // `threshold` — the over-budget fraction the burn evaluator feeds
+  // on. 0 when empty.
+  double FractionAbove(double threshold) const;
   void Clear();
   bool operator==(const QuantileSketch& other) const {
     return total_ == other.total_ && counts_ == other.counts_;
@@ -73,6 +88,97 @@ class QuantileSketch {
  private:
   std::array<int64_t, kSketchBuckets> counts_{};
   int64_t total_ = 0;
+};
+
+// ---- stage-latency SLO sketches ------------------------------------------
+//
+// The fleet SLO engine's vocabulary: each node folds its closed
+// changes' per-stage durations (milliseconds) into one windowed sketch
+// per stage (obs/slo.h), serializes the set into a CR annotation, and
+// the aggregator merges every node's contribution into the fleet view
+// it publishes as tpu.obs.stage.* labels and burns against budgets.
+
+inline constexpr const char* kSloStages[] = {"plan", "render", "publish",
+                                             "publish-acked"};
+inline constexpr int kNumSloStages = 4;
+
+// Node-stage latency budgets (ms). Provenance: derived from the
+// cluster protocol budgets (scripts/bench_gate.py
+// CLUSTER_STAGE_BUDGETS_MS) — the node pipeline runs inside the
+// chain's hold+publish span, so plan and publish each get the chain
+// "hold" allowance (1200ms, the governor's local think-time), render
+// gets the "fanout" allowance (100ms, pure CPU), and publish-acked —
+// which absorbs brownout deferral — gets hold+fanout (1300ms).
+// bench_gate --slo re-derives this table from CLUSTER_STAGE_BUDGETS_MS
+// and cross-checks the record against it; change one, change all.
+std::map<std::string, double> DefaultSloBudgetsMs();
+
+// Budgets with operator overrides applied: `spec` is
+// "stage=ms[,stage=ms...]" (the TFD_SLO_BUDGETS_MS env format the
+// aggregator accepts; the CI slo-smoke tightens budgets through it).
+// Unknown stages and malformed entries are ignored; "" = the defaults.
+std::map<std::string, double> SloBudgetsMsFromSpec(const std::string& spec);
+
+using StageSketches = std::map<std::string, QuantileSketch>;
+
+// Compact annotation encoding of a stage-sketch set: stages in
+// kSloStages order, empty sketches skipped, sparse ascending
+// bucket:count pairs —
+//   plan=0:3,5:2;publish=17:1
+// Annotation-safe (alnum plus '=' ':' ',' ';' '-'), deterministic,
+// byte-identical to the tpufd.agg twin.
+std::string SerializeStageSketches(const StageSketches& stages);
+// Tolerant inverse: unknown stage names and malformed tokens are
+// skipped, never fatal — the annotation arrives from arbitrary nodes.
+StageSketches ParseStageSketches(const std::string& text);
+
+// ---- multi-window burn-rate evaluator ------------------------------------
+//
+// Classic fast+slow burn detection over the merged fleet sketches: at
+// each evaluation tick the per-stage over-budget fraction is recorded,
+// and a stage starts BURNING when the fast-window mean crosses 1/2
+// (the regression is live right now) while the slow-window mean has
+// spent at least the 10% error budget (it is not a single blip); it
+// clears as soon as the fast-window mean drops back under 1/2. Pure
+// logic, caller-supplied time — twinned by tpufd.agg.BurnEvaluator.
+class BurnEvaluator {
+ public:
+  static constexpr double kFastWindowS = 300;    // 5m: is it happening NOW
+  static constexpr double kSlowWindowS = 3600;   // 1h: did it spend budget
+  static constexpr double kFastThreshold = 0.5;
+  static constexpr double kSlowThreshold = 0.1;  // the 10% error budget
+
+  explicit BurnEvaluator(std::map<std::string, double> budgets_ms =
+                             DefaultSloBudgetsMs(),
+                         double fast_window_s = kFastWindowS,
+                         double slow_window_s = kSlowWindowS);
+
+  struct Edge {
+    std::string stage;
+    bool burning = false;  // true = slo-burn asserted, false = cleared
+  };
+
+  // One evaluation tick over the merged fleet sketches. Returns the
+  // burn EDGES this tick produced (empty = no verdict changed). A
+  // stage absent from the sketches contributes an over-fraction of 0
+  // once it has ever been seen; a never-seen stage stays untracked.
+  std::vector<Edge> Note(double now, const StageSketches& sketches);
+
+  bool burning(const std::string& stage) const;
+  std::vector<std::string> BurningStages() const;
+  const std::map<std::string, double>& budgets_ms() const {
+    return budgets_;
+  }
+
+ private:
+  struct StageState {
+    std::deque<std::pair<double, double>> samples;  // (ts, over-fraction)
+    bool burning = false;
+  };
+  std::map<std::string, double> budgets_;
+  double fast_window_s_;
+  double slow_window_s_;
+  std::map<std::string, StageState> stages_;
 };
 
 // ---- per-node contribution -----------------------------------------------
@@ -90,6 +196,11 @@ struct NodeContribution {
   double matmul_tflops = -1;     // tpu.perf.matmul-tflops (-1 = absent)
   double hbm_gbps = -1;          // tpu.perf.hbm-gbps
   bool preempting = false;       // tpu.lifecycle.{preempt-imminent,draining}
+  // The node's serialized stage-SLO sketch set, verbatim from the
+  // tfd.google.com/stage-slo annotation ("" = none published). Kept
+  // raw: string equality is the no-rollup-moved check, and Admit/
+  // Retire parse on demand (bounded: <= 4 stages x 128 buckets).
+  std::string stage_slo;
 
   bool operator==(const NodeContribution& other) const;
   bool operator!=(const NodeContribution& other) const {
@@ -97,16 +208,19 @@ struct NodeContribution {
   }
 };
 
-NodeContribution ExtractContribution(const lm::Labels& labels);
+NodeContribution ExtractContribution(const lm::Labels& labels,
+                                     const std::string& stage_slo = "");
 
 // ---- the incremental inventory store -------------------------------------
 
 class InventoryStore {
  public:
   // Applies one node's current label set (watch ADDED/MODIFIED or a
-  // list item). Returns true when the node's contribution CHANGED —
-  // i.e. some rollup moved and a publish is owed. O(changed labels).
-  bool Apply(const std::string& node, const lm::Labels& labels);
+  // list item) plus its serialized stage-SLO annotation. Returns true
+  // when the node's contribution CHANGED — i.e. some rollup moved and
+  // a publish is owed. O(changed labels).
+  bool Apply(const std::string& node, const lm::Labels& labels,
+             const std::string& stage_slo = "");
   // Watch DELETED: retires the node's contribution entirely.
   bool Remove(const std::string& node);
 
@@ -124,7 +238,12 @@ class InventoryStore {
   //   tpu.fleet.{nodes,preempting}
   //   tpu.multislice.groups
   //   tpu.fleet.perf.{matmul-p10,matmul-p50,hbm-p10,hbm-p50} (when known)
+  //   tpu.obs.stage.<stage>.{p50,p99}-ms (when any node published SLO)
   lm::Labels BuildOutputLabels() const;
+
+  // The merged fleet stage sketches (sum of every node's published
+  // contribution) — what the burn evaluator feeds on.
+  const StageSketches& stage_sketches() const { return stage_; }
 
   // Self-check / debug ONLY: rebuilds every rollup from the retained
   // contributions and bumps full_recomputes. The steady path never
@@ -151,6 +270,7 @@ class InventoryStore {
   int preempting_nodes_ = 0;
   QuantileSketch matmul_;
   QuantileSketch hbm_;
+  StageSketches stage_;
   uint64_t events_ = 0;
   uint64_t full_recomputes_ = 0;
 };

@@ -16,14 +16,17 @@
 #include "tfd/k8s/client.h"
 #include "tfd/k8s/desync.h"
 #include "tfd/k8s/watch.h"
+#include "tfd/lm/schema.h"
 #include "tfd/obs/journal.h"
 #include "tfd/obs/metrics.h"
+#include "tfd/obs/slo.h"
 #include "tfd/obs/trace.h"
 #include "tfd/obs/server.h"
 #include "tfd/slice/coord.h"
 #include "tfd/util/http.h"
 #include "tfd/util/jsonlite.h"
 #include "tfd/util/logging.h"
+#include "tfd/util/strings.h"
 #include "tfd/util/time.h"
 
 namespace tfd {
@@ -128,6 +131,15 @@ obs::Counter* FullRecomputeCounter() {
       "re-applies ONE node's contribution instead.");
 }
 
+obs::Gauge* BurnStateGauge(const std::string& stage) {
+  return obs::Default().GetGauge(
+      "tfd_slo_burn_state",
+      "Fleet SLO burn verdict per pipeline stage: 1 while the stage's "
+      "fast-window over-budget fraction holds the burn (slo-burn "
+      "journaled), 0 otherwise.",
+      {{"stage", stage}});
+}
+
 // ---- shared state between the watch thread and the lease/flush loop ------
 
 struct Shared {
@@ -135,6 +147,9 @@ struct Shared {
   std::condition_variable cv;
   InventoryStore store;
   FlushController flush;
+  // Multi-window burn detection over the merged fleet stage sketches;
+  // evaluated on the flush loop's cadence under this mutex.
+  BurnEvaluator burn;
   bool synced = false;
   // The latest causal change-id annotation consumed from a node CR
   // (obs::kChangeAnnotation) — echoed onto the inventory object's own
@@ -142,7 +157,8 @@ struct Shared {
   // back to the per-node trace that moved it.
   std::string last_change;
 
-  explicit Shared(double debounce_s) : flush(debounce_s) {}
+  Shared(double debounce_s, std::map<std::string, double> budgets_ms)
+      : flush(debounce_s), burn(std::move(budgets_ms)) {}
 };
 
 // ---- the collection watcher ----------------------------------------------
@@ -189,15 +205,17 @@ class CollectionWatcher {
     return !stop_.load();
   }
 
-  // Applies one object's labels to the store under the shared lock;
-  // notes dirty + wakes the flush loop when a rollup moved.
+  // Applies one object's labels (and its stage-SLO annotation) to the
+  // store under the shared lock; notes dirty + wakes the flush loop
+  // when a rollup moved.
   void ApplyObject(const std::string& name, const lm::Labels& labels,
-                   bool deleted, const std::string& change = "") {
+                   bool deleted, const std::string& change = "",
+                   const std::string& stage_slo = "") {
     if (name.rfind(kCrNamePrefix, 0) != 0) return;  // not a daemon CR
     std::string node = name.substr(sizeof(kCrNamePrefix) - 1);
     std::lock_guard<std::mutex> lock(shared_->mu);
     bool moved = deleted ? shared_->store.Remove(node)
-                         : shared_->store.Apply(node, labels);
+                         : shared_->store.Apply(node, labels, stage_slo);
     SetNodesGauge(shared_->store.nodes());
     if (moved) {
       if (!change.empty()) shared_->last_change = change;
@@ -254,9 +272,27 @@ class CollectionWatcher {
             }
           }
         }
+        // The node's stage-SLO contribution rides as an annotation next
+        // to the change id (obs/slo.h) — a re-list must re-learn it, or
+        // the fleet sketches would stay stale until the node's next
+        // publish. The change id itself is NOT consumed here: a list is
+        // not a label movement, and stamping an arbitrary item's id
+        // onto the next flush would mis-join the rollup.
+        std::string stage_slo;
+        if (jsonlite::ValuePtr annotations =
+                item->GetPath("metadata.annotations");
+            annotations &&
+            annotations->kind == jsonlite::Value::Kind::kObject) {
+          if (jsonlite::ValuePtr slo =
+                  annotations->Get(obs::kSloAnnotation);
+              slo && slo->kind == jsonlite::Value::Kind::kString) {
+            stage_slo = slo->string_value;
+          }
+        }
         listed_nodes.insert(name.substr(sizeof(kCrNamePrefix) - 1));
         EventCounter("listed")->Inc();
-        ApplyObject(name, labels, /*deleted=*/false);
+        ApplyObject(name, labels, /*deleted=*/false, /*change=*/"",
+                    stage_slo);
       }
     }
     // Deletes missed while not watching: every retained node absent
@@ -371,7 +407,7 @@ class CollectionWatcher {
               }
               ApplyObject(event.name, event.labels,
                           event.type == k8s::WatchEvent::Type::kDeleted,
-                          event.change);
+                          event.change, event.stage_slo);
               break;
             case k8s::WatchEvent::Type::kUnknown:
               break;
@@ -668,7 +704,19 @@ AggOutcome RunAggregator(const config::Config& config,
   FullRecomputeCounter();  // register at 0: the acceptance contract
   SetStateGauge(0);
 
-  Shared shared(static_cast<double>(flags.agg_debounce_s));
+  // Stage budgets: the derived defaults (agg.h provenance note), with
+  // operator overrides from TFD_SLO_BUDGETS_MS ("stage=ms,..." — the
+  // CI slo-smoke tightens budgets through it to trip a burn quickly).
+  const char* budget_spec = std::getenv("TFD_SLO_BUDGETS_MS");
+  std::map<std::string, double> budgets =
+      SloBudgetsMsFromSpec(budget_spec ? budget_spec : "");
+  for (const auto& [stage, ms] : budgets) {
+    (void)ms;
+    BurnStateGauge(stage)->Set(0);  // register: scrape-deterministic
+  }
+
+  Shared shared(static_cast<double>(flags.agg_debounce_s),
+                std::move(budgets));
   CollectionWatcher watcher(*cluster, &shared);
   LeaseState lease_state;
   bool apply_unsupported = false;
@@ -720,6 +768,7 @@ AggOutcome RunAggregator(const config::Config& config,
     lm::Labels output;
     std::string flush_change;
     double staleness_s = 0;
+    std::vector<BurnEvaluator::Edge> burn_edges;
     {
       std::unique_lock<std::mutex> lock(shared.mu);
       // A pending retry pushes the dirty flush's due time out to
@@ -733,13 +782,42 @@ AggOutcome RunAggregator(const config::Config& config,
           lock, std::chrono::milliseconds(
                     static_cast<long long>(wait_s * 1000)));
       now = MonoSeconds();
+      if (lease_state.leading && shared.synced) {
+        // One burn-evaluation tick over the merged fleet sketches —
+        // BEFORE the flush decision, so a verdict edge both dirties
+        // the window and rides the very flush it triggers.
+        burn_edges = shared.burn.Note(now, shared.store.stage_sketches());
+        if (!burn_edges.empty()) shared.flush.NoteDirty(now);
+      }
       if (lease_state.leading && shared.synced &&
           shared.flush.ShouldFlush(now) && now >= flush_retry_at) {
         flush_now = true;
         output = shared.store.BuildOutputLabels();
+        // Burning stages ride the rollup as labels: the scheduler (and
+        // the soak's assertions) read the fleet burn verdict exactly
+        // where the rollups live, no scrape required.
+        for (const std::string& stage : shared.burn.BurningStages()) {
+          output[std::string(lm::kSloBurnPrefix) + stage + ".burn"] =
+              "true";
+        }
         flush_change = shared.last_change;
         staleness_s = now - shared.flush.dirty_since();
       }
+    }
+
+    for (const BurnEvaluator::Edge& edge : burn_edges) {
+      BurnStateGauge(edge.stage)->Set(edge.burning ? 1 : 0);
+      double budget_ms = 0;
+      auto it = shared.burn.budgets_ms().find(edge.stage);
+      if (it != shared.burn.budgets_ms().end()) budget_ms = it->second;
+      obs::DefaultJournal().Record(
+          edge.burning ? "slo-burn" : "slo-clear", "agg",
+          edge.burning
+              ? "fleet '" + edge.stage + "' stage burning its " +
+                    Fixed3(budget_ms) + "ms budget (fast-window mean >= " +
+                    Fixed3(BurnEvaluator::kFastThreshold) + ")"
+              : "fleet '" + edge.stage + "' stage burn cleared",
+          {{"stage", edge.stage}, {"budget_ms", Fixed3(budget_ms)}});
     }
 
     if (flush_now) {

@@ -447,6 +447,23 @@ std::vector<FlagDef> MakeFlagDefs(Flags* f) {
                     f->trace_capacity = parsed;
                     return Status::Ok();
                   }});
+  defs.push_back({"slo-window",
+                  {"TFD_SLO_WINDOW"},
+                  "sloWindow",
+                  "stage-SLO sketch window in seconds (closed passes "
+                  "older than this retire from /debug/slo and the "
+                  "stage-slo annotation)",
+                  false,
+                  [f](const std::string& v) {
+                    int parsed = 0;
+                    if (!ParseNonNegInt(TrimSpace(v), &parsed) ||
+                        parsed < 1) {
+                      return Status::Error("slo-window must be a "
+                                           "positive integer");
+                    }
+                    f->slo_window_s = parsed;
+                    return Status::Ok();
+                  }});
   defs.push_back({"trace-dump",
                   {"TFD_TRACE_DUMP"},
                   "traceDump",
@@ -1248,6 +1265,7 @@ std::string ToJson(const Config& config) {
       << ",\"journalCapacity\":" << f.journal_capacity
       << ",\"debugDumpFile\":" << jstr(f.debug_dump_file)
       << ",\"traceCapacity\":" << f.trace_capacity
+      << ",\"sloWindow\":\"" << f.slo_window_s << "s\""
       << ",\"traceDump\":" << jstr(f.trace_dump_file)
       << ",\"stateFile\":" << jstr(f.state_file)
       << ",\"sinkBreakerFailures\":" << f.sink_breaker_failures

@@ -196,6 +196,11 @@ struct Flags {
   // drops counted in tfd_trace_dropped_total. Bounds the recorder's
   // memory no matter how label-eventful the node is.
   int trace_capacity = 256;
+  // Stage-SLO sketch window (obs/slo.h): closed passes older than this
+  // retire from the per-stage quantile sketches served on /debug/slo
+  // and stamped into the tfd.google.com/stage-slo annotation, so the
+  // fleet rollup reflects the last N minutes, not daemon lifetime.
+  int slo_window_s = 600;
   // Chrome trace-event (Perfetto-loadable) dump target: SIGUSR1 writes
   // the trace ring here as a loadable timeline next to the JSON
   // post-mortem. Empty disables the Perfetto dump (the JSON trace ring

@@ -8,6 +8,7 @@
 #include "tfd/fault/fault.h"
 #include "tfd/obs/journal.h"
 #include "tfd/obs/metrics.h"
+#include "tfd/obs/slo.h"
 #include "tfd/obs/trace.h"
 #include "tfd/util/file.h"
 #include "tfd/util/http.h"
@@ -188,12 +189,21 @@ std::string CrBody(const ClusterConfig& config, const lm::Labels& labels) {
                      ",\"namespace\":" + jsonlite::Quote(config.namespace_) +
                      ",\"labels\":{\"" + kNodeNameLabel + "\":" +
                      jsonlite::Quote(config.node_name) + "}";
-  if (!config.change_annotation.empty()) {
-    // The causal-trace join key rides as an ANNOTATION (obs/trace.h) —
-    // annotations are not label input, so schema and scheduler
-    // eligibility stay untouched.
-    meta += std::string(",\"annotations\":{\"") + obs::kChangeAnnotation +
-            "\":" + jsonlite::Quote(config.change_annotation) + "}";
+  if (!config.change_annotation.empty() || !config.slo_annotation.empty()) {
+    // The causal-trace join key and the stage-SLO sketches ride as
+    // ANNOTATIONS (obs/trace.h, obs/slo.h) — annotations are not label
+    // input, so schema and scheduler eligibility stay untouched.
+    std::string annotations;
+    if (!config.change_annotation.empty()) {
+      annotations += std::string("\"") + obs::kChangeAnnotation +
+                     "\":" + jsonlite::Quote(config.change_annotation);
+    }
+    if (!config.slo_annotation.empty()) {
+      if (!annotations.empty()) annotations += ",";
+      annotations += std::string("\"") + obs::kSloAnnotation +
+                     "\":" + jsonlite::Quote(config.slo_annotation);
+    }
+    meta += ",\"annotations\":{" + annotations + "}";
   }
   return std::string("{\"apiVersion\":\"") + kNfdGroup + "/" + kNfdVersion +
          "\",\"kind\":\"NodeFeature\"," + "\"metadata\":{" + meta +
@@ -244,7 +254,8 @@ std::string BuildMergePatch(const lm::Labels& acked,
                             const std::string& node_name,
                             bool fix_node_name,
                             const std::string& resource_version,
-                            const std::string& change_annotation) {
+                            const std::string& change_annotation,
+                            const std::string& slo_annotation) {
   std::string spec;
   auto add = [&spec](const std::string& key, const std::string* value) {
     if (!spec.empty()) spec += ",";
@@ -273,12 +284,22 @@ std::string BuildMergePatch(const lm::Labels& acked,
     meta += std::string("\"labels\":{\"") + kNodeNameLabel +
             "\":" + jsonlite::Quote(node_name) + "}";
   }
-  if (!change_annotation.empty()) {
-    // Change-id annotation (obs/trace.h): merge-patch semantics set
-    // just this one annotation key, leaving foreign annotations alone.
+  if (!change_annotation.empty() || !slo_annotation.empty()) {
+    // Change-id + stage-SLO annotations (obs/trace.h, obs/slo.h):
+    // merge-patch semantics set just these annotation keys, leaving
+    // foreign annotations alone.
+    std::string annotations;
+    if (!change_annotation.empty()) {
+      annotations += std::string("\"") + obs::kChangeAnnotation +
+                     "\":" + jsonlite::Quote(change_annotation);
+    }
+    if (!slo_annotation.empty()) {
+      if (!annotations.empty()) annotations += ",";
+      annotations += std::string("\"") + obs::kSloAnnotation +
+                     "\":" + jsonlite::Quote(slo_annotation);
+    }
     if (!meta.empty()) meta += ",";
-    meta += std::string("\"annotations\":{\"") + obs::kChangeAnnotation +
-            "\":" + jsonlite::Quote(change_annotation) + "}";
+    meta += "\"annotations\":{" + annotations + "}";
   }
   std::string out = "{";
   if (!meta.empty()) out += "\"metadata\":{" + meta + "},";
@@ -538,7 +559,7 @@ Status UpdateNodeFeature(const ClusterConfig& config,
       std::string patch =
           BuildMergePatch(state->acked, labels, config.node_name,
                           /*fix_node_name=*/false, state->resource_version,
-                          config.change_annotation);
+                          config.change_annotation, config.slo_annotation);
       if (!patch.empty()) {
         done = TryPatch(patch, /*zero_get=*/true);
         if (done) return settled;
@@ -623,7 +644,8 @@ Status UpdateNodeFeature(const ClusterConfig& config,
       std::string patch =
           BuildMergePatch(current, labels, config.node_name,
                           /*fix_node_name=*/!node_name_ok,
-                          resource_version, config.change_annotation);
+                          resource_version, config.change_annotation,
+                          config.slo_annotation);
       if (!patch.empty()) {
         done = TryPatch(patch, /*zero_get=*/false);
         if (done) return settled;
@@ -659,7 +681,8 @@ Status UpdateNodeFeature(const ClusterConfig& config,
     }
     meta_labels->Set(kNodeNameLabel,
                      jsonlite::MakeString(config.node_name));
-    if (!config.change_annotation.empty()) {
+    if (!config.change_annotation.empty() ||
+        !config.slo_annotation.empty()) {
       jsonlite::ValuePtr annotations = metadata->Get("annotations");
       if (!annotations ||
           annotations->kind != jsonlite::Value::Kind::kObject) {
@@ -667,8 +690,14 @@ Status UpdateNodeFeature(const ClusterConfig& config,
         annotations->kind = jsonlite::Value::Kind::kObject;
         metadata->Set("annotations", annotations);
       }
-      annotations->Set(obs::kChangeAnnotation,
-                       jsonlite::MakeString(config.change_annotation));
+      if (!config.change_annotation.empty()) {
+        annotations->Set(obs::kChangeAnnotation,
+                         jsonlite::MakeString(config.change_annotation));
+      }
+      if (!config.slo_annotation.empty()) {
+        annotations->Set(obs::kSloAnnotation,
+                         jsonlite::MakeString(config.slo_annotation));
+      }
     }
     jsonlite::ValuePtr spec = cr.Get("spec");
     if (!spec || spec->kind != jsonlite::Value::Kind::kObject) {

@@ -51,6 +51,13 @@ struct ClusterConfig {
   // processes. The daemon sets it per write from the latest active
   // change id ("" = nothing in flight, no annotation written).
   std::string change_annotation;
+  // Serialized per-stage latency sketches (obs/slo.h kSloAnnotation):
+  // when non-empty, every write verb stamps
+  // metadata.annotations["tfd.google.com/stage-slo"] with this value —
+  // the node's windowed SLO contribution, published next to the change
+  // id so the aggregator can merge fleet stage latencies without
+  // scraping every node. An ANNOTATION, never a spec.label.
+  std::string slo_annotation;
 };
 
 // The field manager every server-side apply writes under; foreign
@@ -199,15 +206,18 @@ Status PatchCoordConfigMap(const ClusterConfig& config,
 // path saw it missing/wrong), the resourceVersion precondition when
 // `resource_version` is non-empty, and the change-id annotation when
 // `change_annotation` is non-empty (the causal-trace join key; see
-// ClusterConfig::change_annotation). Returns "" when there is nothing
-// to patch. Exposed for the unit tests and the Python twin's parity
-// pins.
+// ClusterConfig::change_annotation), and the stage-SLO annotation when
+// `slo_annotation` is non-empty (the node's serialized latency
+// sketches; see ClusterConfig::slo_annotation). Returns "" when there
+// is nothing to patch. Exposed for the unit tests and the Python
+// twin's parity pins.
 std::string BuildMergePatch(const lm::Labels& acked,
                             const lm::Labels& desired,
                             const std::string& node_name,
                             bool fix_node_name,
                             const std::string& resource_version,
-                            const std::string& change_annotation = "");
+                            const std::string& change_annotation = "",
+                            const std::string& slo_annotation = "");
 
 }  // namespace k8s
 }  // namespace tfd

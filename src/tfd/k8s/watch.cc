@@ -7,6 +7,7 @@
 #include "tfd/k8s/desync.h"
 #include "tfd/obs/journal.h"
 #include "tfd/obs/metrics.h"
+#include "tfd/obs/slo.h"
 #include "tfd/obs/trace.h"
 #include "tfd/util/http.h"
 #include "tfd/util/jsonlite.h"
@@ -132,6 +133,10 @@ WatchEvent ParseWatchEventLine(const std::string& line) {
     if (jsonlite::ValuePtr change = annotations->Get(obs::kChangeAnnotation);
         change && change->kind == jsonlite::Value::Kind::kString) {
       event.change = change->string_value;
+    }
+    if (jsonlite::ValuePtr slo = annotations->Get(obs::kSloAnnotation);
+        slo && slo->kind == jsonlite::Value::Kind::kString) {
+      event.stage_slo = slo->string_value;
     }
   }
   if (event.type == WatchEvent::Type::kError) {

@@ -67,6 +67,11 @@ struct WatchEvent {
   // the latest one it saw onto its inventory object), so a CR is
   // joinable to the origin daemon's /debug/trace across processes.
   std::string change;
+  // The serialized per-stage latency sketches (obs::kSloAnnotation, ""
+  // when absent): published by the daemon next to the change id so the
+  // aggregator can merge node SLO contributions without scraping every
+  // node. Rides metadata.annotations, never spec.labels.
+  std::string stage_slo;
   bool has_labels = false;       // object.spec.labels parsed (string values)
   lm::Labels labels;
   int error_code = 0;

@@ -178,6 +178,15 @@ inline constexpr char kFleetMatmulP50[] =
 inline constexpr char kFleetHbmP10[] = "google.com/tpu.fleet.perf.hbm-p10";
 inline constexpr char kFleetHbmP50[] = "google.com/tpu.fleet.perf.hbm-p50";
 
+// Fleet SLO engine (agg/ + obs/slo.h): merged pass-stage latency
+// percentiles and multi-window burn-rate verdicts, published on the
+// cluster inventory object next to the perf floors. Keys are built
+// from these prefixes plus the stage name (agg::kSloStages):
+//   tpu.obs.stage.<stage>.{p50,p99}-ms   (Fixed3 milliseconds)
+//   tpu.slo.<stage>.burn                 ("true"/"false")
+inline constexpr char kObsStagePrefix[] = "google.com/tpu.obs.stage.";
+inline constexpr char kSloBurnPrefix[] = "google.com/tpu.slo.";
+
 // Degradation ladder (sched/): present only when the daemon is serving
 // CACHED device facts because the probe source missed its cadence
 // (chips held by a training job, wedged libtpu). Age is whole seconds

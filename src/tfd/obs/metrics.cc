@@ -132,6 +132,7 @@ Histogram::Histogram(std::vector<double> upper_bounds) {
   for (size_t i = 0; i < upper_bounds_.size(); i++) {
     counts_.push_back(std::make_unique<std::atomic<unsigned long long>>(0));
   }
+  exemplars_.resize(upper_bounds_.size() + 1);  // trailing slot = +Inf
 }
 
 void Histogram::Observe(double v) {
@@ -148,6 +149,15 @@ void Histogram::Observe(double v) {
                                      std::memory_order_relaxed)) {
   }
   count_.fetch_add(1, std::memory_order_relaxed);
+}
+
+void Histogram::Observe(double v, const Labels& exemplar) {
+  if (std::isnan(v)) return;
+  Observe(v);
+  size_t i = std::lower_bound(upper_bounds_.begin(), upper_bounds_.end(), v) -
+             upper_bounds_.begin();  // == counts_.size() -> the +Inf slot
+  std::lock_guard<std::mutex> lock(exemplar_mu_);
+  exemplars_[i] = Exemplar{SanitizeLabels(exemplar), v, true};
 }
 
 unsigned long long Histogram::CumulativeCount(size_t i) const {
@@ -168,6 +178,10 @@ Histogram::Snapshot Histogram::TakeSnapshot() const {
   }
   snap.total = running + overflow_.load(std::memory_order_relaxed);
   snap.sum = sum_.load(std::memory_order_relaxed);
+  {
+    std::lock_guard<std::mutex> lock(exemplar_mu_);
+    snap.exemplars = exemplars_;
+  }
   return snap;
 }
 
@@ -329,15 +343,26 @@ std::string Registry::Exposition() const {
       } else {
         const Histogram& h = *child->histogram;
         const Histogram::Snapshot snap = h.TakeSnapshot();
+        auto exemplar_suffix = [&snap](size_t i) -> std::string {
+          if (i >= snap.exemplars.size() || !snap.exemplars[i].set) {
+            return "";
+          }
+          const Histogram::Exemplar& e = snap.exemplars[i];
+          std::string labels = RenderLabels(e.labels, nullptr, "");
+          if (labels.empty()) labels = "{}";
+          return " # " + labels + " " + FormatValue(e.value);
+        };
         for (size_t i = 0; i < h.upper_bounds().size(); i++) {
           out += f->name + "_bucket" +
                  RenderLabels(child->labels, "le",
                               FormatValue(h.upper_bounds()[i])) +
-                 " " + std::to_string(snap.cumulative[i]) + "\n";
+                 " " + std::to_string(snap.cumulative[i]) +
+                 exemplar_suffix(i) + "\n";
         }
         out += f->name + "_bucket" +
                RenderLabels(child->labels, "le", "+Inf") + " " +
-               std::to_string(snap.total) + "\n";
+               std::to_string(snap.total) +
+               exemplar_suffix(h.upper_bounds().size()) + "\n";
         out += f->name + "_sum" + RenderLabels(child->labels, nullptr, "") +
                " " + FormatValue(snap.sum) + "\n";
         out += f->name + "_count" + RenderLabels(child->labels, nullptr, "") +
@@ -372,13 +397,95 @@ bool ValidMetricName(const std::string& s) {
   return true;
 }
 
-// Parses `metric_name{labels} value` into its parts. Returns false (with
-// *error set) on any grammar violation.
+// Parses `metric_name{labels} value` — optionally followed by an
+// OpenMetrics exemplar (` # {labels} value`, no timestamp: this build
+// never emits one) — into its parts. Returns false (with *error set)
+// on any grammar violation.
 struct Sample {
   std::string name;
   std::map<std::string, std::string> labels;
   double value = 0;
+  bool has_exemplar = false;
+  std::map<std::string, std::string> exemplar_labels;
+  double exemplar_value = 0;
 };
+
+// Parses a `{k="v",...}` block starting at line[*i] == '{'; leaves *i
+// just past the closing brace.
+bool ParseLabelBlock(const std::string& line, size_t* pos,
+                     std::map<std::string, std::string>* labels,
+                     std::string* error) {
+  size_t i = *pos + 1;  // past '{'
+  while (i < line.size() && line[i] != '}') {
+    size_t key_start = i;
+    while (i < line.size() && line[i] != '=') i++;
+    std::string key = line.substr(key_start, i - key_start);
+    if (!ValidMetricName(key) || key.find(':') != std::string::npos) {
+      *error = "invalid label name '" + key + "' in: " + line;
+      return false;
+    }
+    if (i + 1 >= line.size() || line[i + 1] != '"') {
+      *error = "label value not quoted in: " + line;
+      return false;
+    }
+    i += 2;
+    std::string value;
+    while (i < line.size() && line[i] != '"') {
+      if (line[i] == '\\') {
+        if (i + 1 >= line.size()) {
+          *error = "dangling escape in: " + line;
+          return false;
+        }
+        char esc = line[i + 1];
+        if (esc != '\\' && esc != '"' && esc != 'n') {
+          *error = "invalid escape \\" + std::string(1, esc) +
+                   " in: " + line;
+          return false;
+        }
+        value.push_back(esc == 'n' ? '\n' : esc);
+        i += 2;
+      } else {
+        value.push_back(line[i++]);
+      }
+    }
+    if (i >= line.size()) {
+      *error = "unterminated label value in: " + line;
+      return false;
+    }
+    i++;  // closing quote
+    if (labels->count(key) != 0) {
+      *error = "duplicate label '" + key + "' in: " + line;
+      return false;
+    }
+    (*labels)[key] = value;
+    if (i < line.size() && line[i] == ',') i++;
+  }
+  if (i >= line.size()) {
+    *error = "unterminated label set in: " + line;
+    return false;
+  }
+  *pos = i + 1;  // past '}'
+  return true;
+}
+
+bool ParseValueText(const std::string& value_text, const std::string& line,
+                    double* out, std::string* error) {
+  if (value_text == "+Inf") {
+    *out = std::numeric_limits<double>::infinity();
+  } else if (value_text == "-Inf") {
+    *out = -std::numeric_limits<double>::infinity();
+  } else if (value_text == "NaN") {
+    *out = std::numeric_limits<double>::quiet_NaN();
+  } else {
+    char* end = nullptr;
+    *out = std::strtod(value_text.c_str(), &end);
+    if (end == value_text.c_str() || *end != '\0') {
+      *error = "unparseable value '" + value_text + "' in: " + line;
+      return false;
+    }
+  }
+  return true;
+}
 
 bool ParseSample(const std::string& line, Sample* out, std::string* error) {
   size_t i = 0;
@@ -393,83 +500,64 @@ bool ParseSample(const std::string& line, Sample* out, std::string* error) {
     return false;
   }
   if (i < line.size() && line[i] == '{') {
-    i++;
-    while (i < line.size() && line[i] != '}') {
-      size_t key_start = i;
-      while (i < line.size() && line[i] != '=') i++;
-      std::string key = line.substr(key_start, i - key_start);
-      if (!ValidMetricName(key) || key.find(':') != std::string::npos) {
-        *error = "invalid label name '" + key + "' in: " + line;
-        return false;
-      }
-      if (i + 1 >= line.size() || line[i + 1] != '"') {
-        *error = "label value not quoted in: " + line;
-        return false;
-      }
-      i += 2;
-      std::string value;
-      while (i < line.size() && line[i] != '"') {
-        if (line[i] == '\\') {
-          if (i + 1 >= line.size()) {
-            *error = "dangling escape in: " + line;
-            return false;
-          }
-          char esc = line[i + 1];
-          if (esc != '\\' && esc != '"' && esc != 'n') {
-            *error = "invalid escape \\" + std::string(1, esc) +
-                     " in: " + line;
-            return false;
-          }
-          value.push_back(esc == 'n' ? '\n' : esc);
-          i += 2;
-        } else {
-          value.push_back(line[i++]);
-        }
-      }
-      if (i >= line.size()) {
-        *error = "unterminated label value in: " + line;
-        return false;
-      }
-      i++;  // closing quote
-      if (out->labels.count(key) != 0) {
-        *error = "duplicate label '" + key + "' in: " + line;
-        return false;
-      }
-      out->labels[key] = value;
-      if (i < line.size() && line[i] == ',') i++;
-    }
-    if (i >= line.size()) {
-      *error = "unterminated label set in: " + line;
-      return false;
-    }
-    i++;  // closing brace
+    if (!ParseLabelBlock(line, &i, &out->labels, error)) return false;
   }
   if (i >= line.size() || line[i] != ' ') {
     *error = "missing value separator in: " + line;
     return false;
   }
-  std::string value_text = line.substr(i + 1);
+  std::string rest = line.substr(i + 1);
+  std::string value_text = rest;
+  // OpenMetrics exemplar section: `<value> # {labels} <exemplar-value>`.
+  // The split is safe on the raw value text — a value can never contain
+  // a quoted string, so " # " there is unambiguous.
+  size_t hash = rest.find(" # ");
+  if (hash != std::string::npos) {
+    value_text = rest.substr(0, hash);
+    std::string exemplar = rest.substr(hash + 3);
+    if (exemplar.empty() || exemplar[0] != '{') {
+      *error = "exemplar without label set in: " + line;
+      return false;
+    }
+    size_t j = 0;
+    if (!ParseLabelBlock(exemplar, &j, &out->exemplar_labels, error)) {
+      return false;
+    }
+    if (j >= exemplar.size() || exemplar[j] != ' ') {
+      *error = "exemplar missing value in: " + line;
+      return false;
+    }
+    std::string exemplar_value = exemplar.substr(j + 1);
+    if (exemplar_value.empty() ||
+        exemplar_value.find(' ') != std::string::npos) {
+      // An exemplar timestamp is legal OpenMetrics but this build never
+      // emits one (determinism); strict about OUR output.
+      *error = "malformed exemplar value in: " + line;
+      return false;
+    }
+    if (!ParseValueText(exemplar_value, line, &out->exemplar_value, error)) {
+      return false;
+    }
+    // The OpenMetrics exemplar length budget: label names + values
+    // combined must not exceed 128 characters.
+    size_t runes = 0;
+    for (const auto& [k, v] : out->exemplar_labels) {
+      runes += k.size() + v.size();
+    }
+    if (runes > 128) {
+      *error = "exemplar label set over the 128-character budget in: " +
+               line;
+      return false;
+    }
+    out->has_exemplar = true;
+  }
   if (value_text.empty() || value_text.find(' ') != std::string::npos) {
     // A trailing timestamp is legal Prometheus but this build never emits
     // one; flagging it keeps the validator strict about OUR output.
     *error = "malformed value field in: " + line;
     return false;
   }
-  if (value_text == "+Inf") {
-    out->value = std::numeric_limits<double>::infinity();
-  } else if (value_text == "-Inf") {
-    out->value = -std::numeric_limits<double>::infinity();
-  } else if (value_text == "NaN") {
-    out->value = std::numeric_limits<double>::quiet_NaN();
-  } else {
-    char* end = nullptr;
-    out->value = std::strtod(value_text.c_str(), &end);
-    if (end == value_text.c_str() || *end != '\0') {
-      *error = "unparseable value '" + value_text + "' in: " + line;
-      return false;
-    }
-  }
-  return true;
+  return ParseValueText(value_text, line, &out->value, error);
 }
 
 // The family a sample belongs to: an exactly-named family wins (a
@@ -538,6 +626,16 @@ Status ValidateExposition(const std::string& text) {
     auto type_it = types.find(family);
     if (type_it == types.end()) {
       return Status::Error("sample for undeclared family: " + line);
+    }
+    if (sample.has_exemplar) {
+      // OpenMetrics: exemplars attach to counters and histogram
+      // buckets only — never gauges, _sum/_count, or untyped series.
+      bool bucket_line = type_it->second == "histogram" &&
+                         sample.name == family + "_bucket";
+      if (!bucket_line && type_it->second != "counter") {
+        return Status::Error("exemplar on a non-counter/non-bucket line: " +
+                             line);
+      }
     }
     if (type_it->second == "counter" &&
         !(sample.value >= 0 || std::isnan(sample.value))) {

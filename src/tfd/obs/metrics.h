@@ -67,6 +67,20 @@ class Histogram {
   // cannot be bucketed); +/-inf land in the +Inf bucket like any other
   // out-of-range value.
   void Observe(double v);
+  // Observe plus an OpenMetrics exemplar: `exemplar` (e.g.
+  // {{"change_id","42"}}) is remembered for the bucket `v` lands in
+  // (last write wins) and rendered after that bucket's sample as
+  // `... # {change_id="42"} <v>` — one click from a fleet-p99 spike to
+  // the exact change and its journal/Perfetto trail. The exemplar
+  // store is mutex-guarded (labels are strings); the exemplar-free
+  // Observe above stays lock-free for the hot path.
+  void Observe(double v, const Labels& exemplar);
+
+  struct Exemplar {
+    Labels labels;
+    double value = 0;
+    bool set = false;
+  };
 
   const std::vector<double>& upper_bounds() const { return upper_bounds_; }
   // One coherent read of the whole histogram: cumulative counts per
@@ -79,6 +93,9 @@ class Histogram {
     std::vector<unsigned long long> cumulative;  // per finite bucket
     unsigned long long total = 0;                // +Inf bucket == _count
     double sum = 0;
+    // Per finite bucket plus one trailing entry for +Inf; .set=false
+    // where no exemplar was ever observed.
+    std::vector<Exemplar> exemplars;
   };
   Snapshot TakeSnapshot() const;
   unsigned long long CumulativeCount(size_t i) const;
@@ -93,6 +110,8 @@ class Histogram {
   std::atomic<unsigned long long> overflow_{0};  // > last bound (+Inf)
   std::atomic<double> sum_{0.0};
   std::atomic<unsigned long long> count_{0};
+  mutable std::mutex exemplar_mu_;
+  std::vector<Exemplar> exemplars_;  // finite buckets + [+Inf] last
 };
 
 // Buckets sized for label-pass work: sub-millisecond file rewrites up to
@@ -149,8 +168,12 @@ Registry& Default();
 // Validates Prometheus text exposition: HELP/TYPE lines well-formed, every
 // sample matches the line grammar with a parseable value, samples only for
 // families with a declared TYPE, histogram buckets cumulative-monotone with
-// a +Inf bucket matching _count. Used by the unit tests, fuzz_metrics.cc
-// (as the oracle over Registry output), and the CI metrics-lint step (via
+// a +Inf bucket matching _count. OpenMetrics exemplars
+// (` # {change_id="42"} 0.0043`) are accepted — well-formed label set,
+// parseable value, combined label length within the 128-rune budget —
+// but ONLY on counter and histogram-bucket lines; anywhere else they
+// are rejected. Used by the unit tests, fuzz_metrics.cc (as the oracle
+// over Registry output), and the CI metrics-lint step (via
 // `tfd_unit_tests --validate-exposition <file>`).
 Status ValidateExposition(const std::string& text);
 

@@ -143,6 +143,7 @@ Result<std::unique_ptr<IntrospectionServer>> IntrospectionServer::Start(
   server->registry_ = registry;
   server->journal_ = options.journal;
   server->trace_ = options.trace;
+  server->slo_ = options.slo;
   server->stale_after_s_ = options.stale_after_s;
   server->listen_fd_ = fd;
   server->port_ = ntohs(bound.sin_port);
@@ -298,6 +299,12 @@ void IntrospectionServer::HandleRequest(Conn* conn) {
     }
     conn->out = HttpResponse(200, "OK", "application/json",
                              trace_->RenderJson(n, change) + "\n");
+  } else if (path == "/debug/slo" && slo_ != nullptr) {
+    // Expire-then-render: the windowed view must age out even when no
+    // pass has folded anything since the last read (quiet daemon).
+    slo_->Expire();
+    conn->out = HttpResponse(200, "OK", "application/json",
+                             slo_->RenderJson() + "\n");
   } else if (path == "/debug/labels") {
     std::string body;
     {
@@ -316,7 +323,7 @@ void IntrospectionServer::HandleRequest(Conn* conn) {
     conn->out = HttpResponse(404, "Not Found", "text/plain",
                              "serves /healthz, /readyz, /metrics, "
                              "/debug/journal, /debug/labels, "
-                             "/debug/trace\n");
+                             "/debug/trace, /debug/slo\n");
   }
 }
 

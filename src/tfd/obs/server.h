@@ -1,5 +1,5 @@
 // In-daemon introspection HTTP server: /healthz, /readyz, /metrics,
-// /debug/journal, /debug/labels, /debug/trace.
+// /debug/journal, /debug/labels, /debug/trace, /debug/slo.
 //
 // A minimal single-threaded GET-only HTTP/1.1 server: one background
 // thread runs a poll(2) loop over the listen socket and a small fixed
@@ -28,6 +28,7 @@
 
 #include "tfd/obs/journal.h"
 #include "tfd/obs/metrics.h"
+#include "tfd/obs/slo.h"
 #include "tfd/obs/trace.h"
 #include "tfd/util/status.h"
 
@@ -52,6 +53,10 @@ struct ServerOptions {
   // Causal-trace recorder behind /debug/trace?n=&change= (null hides
   // the endpoint; the daemon passes obs::DefaultTrace()).
   TraceRecorder* trace = nullptr;
+  // Windowed stage-SLO tracker behind /debug/slo (null hides the
+  // endpoint; the daemon passes obs::DefaultSlo()). Each read expires
+  // the window first, so a quiet daemon's view still ages out.
+  StageSlo* slo = nullptr;
 };
 
 class IntrospectionServer {
@@ -95,6 +100,7 @@ class IntrospectionServer {
   Registry* registry_ = nullptr;
   Journal* journal_ = nullptr;
   TraceRecorder* trace_ = nullptr;
+  StageSlo* slo_ = nullptr;
   int stale_after_s_ = 120;
   int listen_fd_ = -1;
   int wake_fds_[2] = {-1, -1};  // self-pipe: Stop() wakes the poll loop

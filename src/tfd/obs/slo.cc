@@ -1,0 +1,161 @@
+#include "tfd/obs/slo.h"
+
+#include <chrono>
+
+#include "tfd/util/jsonlite.h"
+#include "tfd/util/strings.h"
+
+namespace tfd {
+namespace obs {
+
+namespace {
+
+double WallNow() {
+  return std::chrono::duration<double>(
+             std::chrono::system_clock::now().time_since_epoch())
+      .count();
+}
+
+bool KnownSloStage(const std::string& stage) {
+  for (const char* name : agg::kSloStages) {
+    if (stage == name) return true;
+  }
+  return false;
+}
+
+}  // namespace
+
+std::map<std::string, double> StageDurationsMs(const TraceRecord& record) {
+  std::map<std::string, double> out;
+  double prev = record.minted_ts;
+  for (const auto& [stage, ts] : record.stages) {
+    double end = ts > prev ? ts : prev;
+    double ms = (end - prev) * 1000.0;
+    prev = end;
+    if (stage == "govern") {
+      out["render"] += ms;
+    } else if (KnownSloStage(stage)) {
+      out[stage] += ms;
+    }
+  }
+  return out;
+}
+
+StageSlo::StageSlo(int window_s)
+    : window_s_(window_s < 1 ? 1 : window_s) {}
+
+void StageSlo::SetWindow(int window_s) {
+  std::lock_guard<std::mutex> lock(mu_);
+  window_s_ = window_s < 1 ? 1 : window_s;
+}
+
+int StageSlo::window_s() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return window_s_;
+}
+
+void StageSlo::ExpireLocked(double now) {
+  while (!samples_.empty() && samples_.front().ts <= now - window_s_) {
+    for (const auto& [stage, ms] : samples_.front().stages) {
+      auto it = sketches_.find(stage);
+      if (it == sketches_.end()) continue;
+      it->second.Remove(ms);
+      if (it->second.count() <= 0) sketches_.erase(it);
+    }
+    samples_.pop_front();
+    retired_++;
+  }
+}
+
+void StageSlo::Fold(uint64_t change,
+                    const std::map<std::string, double>& stage_ms,
+                    double now_s) {
+  double now = now_s < 0 ? WallNow() : now_s;
+  std::lock_guard<std::mutex> lock(mu_);
+  Sample sample;
+  sample.ts = now;
+  for (const char* name : agg::kSloStages) {
+    auto it = stage_ms.find(name);
+    if (it == stage_ms.end()) continue;
+    sketches_[name].Add(it->second);
+    sample.stages.emplace_back(name, it->second);
+  }
+  if (!sample.stages.empty()) {
+    samples_.push_back(std::move(sample));
+    folded_++;
+    if (change > last_change_) last_change_ = change;
+  }
+  ExpireLocked(now);
+}
+
+void StageSlo::Expire(double now_s) {
+  double now = now_s < 0 ? WallNow() : now_s;
+  std::lock_guard<std::mutex> lock(mu_);
+  ExpireLocked(now);
+}
+
+int64_t StageSlo::folded_total() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return folded_;
+}
+
+int64_t StageSlo::retired_total() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return retired_;
+}
+
+int64_t StageSlo::samples() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return static_cast<int64_t>(samples_.size());
+}
+
+agg::StageSketches StageSlo::Snapshot() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return sketches_;
+}
+
+std::string StageSlo::Serialize() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return agg::SerializeStageSketches(sketches_);
+}
+
+std::string StageSlo::RenderJson() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  std::string out = "{\"window_s\":" + std::to_string(window_s_) +
+                    ",\"samples\":" + std::to_string(samples_.size()) +
+                    ",\"folded_total\":" + std::to_string(folded_) +
+                    ",\"retired_total\":" + std::to_string(retired_) +
+                    ",\"last_change\":" + std::to_string(last_change_) +
+                    ",\"stages\":{";
+  bool first = true;
+  for (const char* name : agg::kSloStages) {
+    auto it = sketches_.find(name);
+    if (it == sketches_.end() || it->second.count() <= 0) continue;
+    if (!first) out += ",";
+    first = false;
+    out += jsonlite::Quote(name);
+    out += ":{\"count\":" + std::to_string(it->second.count()) +
+           ",\"p50_ms\":" + Fixed3(it->second.Quantile(0.50)) +
+           ",\"p99_ms\":" + Fixed3(it->second.Quantile(0.99)) + "}";
+  }
+  out += "},\"serialized\":" +
+         jsonlite::Quote(agg::SerializeStageSketches(sketches_)) + "}";
+  return out;
+}
+
+void StageSlo::Clear() {
+  std::lock_guard<std::mutex> lock(mu_);
+  samples_.clear();
+  sketches_.clear();
+  folded_ = 0;
+  retired_ = 0;
+  last_change_ = 0;
+}
+
+StageSlo& DefaultSlo() {
+  static StageSlo* slo = new StageSlo();
+  return *slo;
+}
+
+}  // namespace obs
+}  // namespace tfd

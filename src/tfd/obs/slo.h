@@ -1,0 +1,119 @@
+// Node-side fleet SLO engine: windowed per-stage latency sketches.
+//
+// PR 14's TraceRecorder decomposes every closed change into per-stage
+// timestamps; this module SPENDS that instrument. Each change the sink
+// publish-acks folds its stage durations (plan / render / publish /
+// publish-acked, milliseconds) into one removable+mergeable quantile
+// sketch per stage (agg/agg.h QuantileSketch — the same digest the
+// aggregator's perf floors use), WINDOWED by retire-oldest: every fold
+// also expires samples older than --slo-window seconds, so the view is
+// "the last N minutes", not "since boot". A node that was slow
+// yesterday and healed stops indicting itself.
+//
+// Exported three ways:
+//   - /debug/slo (obs/server.cc): RenderJson — window, per-stage
+//     count/p50/p99 and the serialized sketch set (byte-parity-pinned
+//     against the tpufd.trace.StageSlo twin);
+//   - the tfd.google.com/stage-slo CR ANNOTATION (kSloAnnotation,
+//     next to the change-id annotation, never spec.labels): Serialize
+//     — the aggregator parses and merges every node's contribution
+//     into the fleet tpu.obs.stage.* percentiles and burns them
+//     against budgets (agg::BurnEvaluator);
+//   - the SIGUSR1 post-mortem dump ("slo" section, next to the trace
+//     ring and published labels).
+//
+// Quiet-daemon contract: a pass that publishes nothing folds nothing —
+// the tracker costs nothing when nothing moves (the BENCH_r07/r11
+// steady no-op gates stay untouched).
+#pragma once
+
+#include <cstdint>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "tfd/agg/agg.h"
+#include "tfd/obs/trace.h"
+
+namespace tfd {
+namespace obs {
+
+// The CR annotation key the serialized stage sketches ride outward on
+// (metadata.annotations — NEVER spec.labels; latency digests must not
+// become scheduler-visible eligibility input).
+inline constexpr char kSloAnnotation[] = "tfd.google.com/stage-slo";
+
+// Per-stage durations (ms) of one closed trace record, sliced by the
+// same interval rule as RenderChromeTrace: each stage's duration runs
+// from the previous stamp (minted_ts first) to its own stamp, clamped
+// at 0 against clock steps. "govern" is folded into "render" — the
+// SLO vocabulary is the four agg::kSloStages; unknown stages are
+// dropped.
+std::map<std::string, double> StageDurationsMs(const TraceRecord& record);
+
+class StageSlo {
+ public:
+  static constexpr int kDefaultWindowS = 600;
+
+  explicit StageSlo(int window_s = kDefaultWindowS);
+
+  // Reconfigurable at a config load (--slo-window); shrinking expires
+  // eagerly on the next Fold/Expire.
+  void SetWindow(int window_s);
+  int window_s() const;
+
+  // Folds one closed change's stage durations (ms) and expires
+  // anything older than the window. `now_s` < 0 uses the wall clock
+  // (tests inject fixed times for the parity pins).
+  void Fold(uint64_t change, const std::map<std::string, double>& stage_ms,
+            double now_s = -1);
+
+  // Retire-oldest pass without a fold (the introspection reads call
+  // this so a quiet daemon's view still ages out).
+  void Expire(double now_s = -1);
+
+  int64_t folded_total() const;
+  int64_t retired_total() const;
+  int64_t samples() const;
+
+  // Copy of the current per-stage sketches (empty stages absent).
+  agg::StageSketches Snapshot() const;
+
+  // The annotation payload (agg::SerializeStageSketches of the
+  // current window; "" when empty).
+  std::string Serialize() const;
+
+  // {"window_s":..,"samples":..,"folded_total":..,"retired_total":..,
+  //  "last_change":..,"stages":{"plan":{"count":..,"p50_ms":..,
+  //  "p99_ms":..},..},"serialized":".."} — what /debug/slo serves and
+  //  the SIGUSR1 dump embeds; byte-parity with the Python twin.
+  std::string RenderJson() const;
+
+  void Clear();
+
+ private:
+  struct Sample {
+    double ts = 0;
+    std::vector<std::pair<std::string, double>> stages;  // (stage, ms)
+  };
+
+  void ExpireLocked(double now);
+
+  mutable std::mutex mu_;
+  int window_s_;
+  std::deque<Sample> samples_;
+  agg::StageSketches sketches_;
+  int64_t folded_ = 0;
+  int64_t retired_ = 0;
+  uint64_t last_change_ = 0;
+};
+
+// The process-wide tracker (the analogue of DefaultTrace()): survives
+// SIGHUP reloads so the window spans the reload itself.
+StageSlo& DefaultSlo();
+
+}  // namespace obs
+}  // namespace tfd

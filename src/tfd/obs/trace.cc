@@ -144,17 +144,20 @@ void TraceRecorder::Stage(const std::string& stage, double now_s) {
   }
 }
 
-void TraceRecorder::MarkPublished(uint64_t generation, double now_s,
-                                  uint64_t through_change) {
+std::vector<TraceRecord> TraceRecorder::MarkPublished(
+    uint64_t generation, double now_s, uint64_t through_change) {
   double now = now_s < 0 ? WallNow() : now_s;
+  std::vector<TraceRecord> retired;
   std::lock_guard<std::mutex> lock(mu_);
   for (TraceRecord& record : records_) {
     if (record.published || record.change > through_change) continue;
     record.published = true;
     record.generation = generation;
     record.stages.emplace_back("publish-acked", now);
+    retired.push_back(record);
   }
   UpdateGauge();
+  return retired;
 }
 
 uint64_t TraceRecorder::LatestActiveChange() const {

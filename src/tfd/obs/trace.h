@@ -98,8 +98,13 @@ class TraceRecorder {
   // CONCURRENTLY with the pass was not in its render, must not be
   // acked by it, and stays active for the pass its movement wakes.
   // The default (max) retires everything active (tests, fuzz).
-  void MarkPublished(uint64_t generation, double now_s = -1,
-                     uint64_t through_change = ~0ull);
+  // Returns copies of the records retired by THIS call (terminal
+  // "publish-acked" stamp included) — the caller folds their stage
+  // durations into the SLO sketches (obs/slo.h) and mints the
+  // publish-acked histogram samples with change-id exemplars.
+  std::vector<TraceRecord> MarkPublished(uint64_t generation,
+                                         double now_s = -1,
+                                         uint64_t through_change = ~0ull);
 
   // Highest change id minted but not yet publish-acked (0 = none):
   // what BeginRewrite() and the CR annotation carry.

@@ -1167,6 +1167,51 @@ void TestValidateExposition() {
                  .ok());
 }
 
+void TestMetricsExemplars() {
+  // OpenMetrics exemplars (ISSUE 16): an Observe with a change-id
+  // label lands on the bucket line as ` # {change_id="42"} v`, last
+  // write per bucket wins, and the validator enforces the placement
+  // and size rules. The Python twin runs the same cases in
+  // tests/test_metrics.py.
+  obs::Registry reg;
+  obs::Histogram* h = reg.GetHistogram("tfd_stage_seconds", "stage",
+                                       {0.1, 1.0}, {{"stage", "plan"}});
+  h->Observe(0.05, {{"change_id", "42"}});
+  h->Observe(0.5);                          // exemplar-free stays bare
+  h->Observe(5.0, {{"change_id", "43"}});   // +Inf bucket exemplar
+  std::string text = reg.Exposition();
+  CHECK_TRUE(text.find("tfd_stage_seconds_bucket{stage=\"plan\","
+                       "le=\"0.1\"} 1 # {change_id=\"42\"} 0.05\n") !=
+             std::string::npos);
+  CHECK_TRUE(text.find("le=\"1\"} 2\n") != std::string::npos);
+  CHECK_TRUE(text.find("le=\"+Inf\"} 3 # {change_id=\"43\"} 5\n") !=
+             std::string::npos);
+  CHECK_TRUE(obs::ValidateExposition(text).ok());
+  // Last write wins within a bucket.
+  h->Observe(0.06, {{"change_id", "44"}});
+  CHECK_TRUE(reg.Exposition().find("# {change_id=\"44\"} 0.06") !=
+             std::string::npos);
+  CHECK_TRUE(obs::ValidateExposition(reg.Exposition()).ok());
+
+  // Placement: exemplars ride counter and histogram-bucket lines ONLY.
+  CHECK_TRUE(obs::ValidateExposition(
+                 "# TYPE c counter\nc 1 # {change_id=\"1\"} 1\n")
+                 .ok());
+  CHECK_TRUE(!obs::ValidateExposition(
+                  "# TYPE g gauge\ng 1 # {change_id=\"1\"} 1\n")
+                  .ok());
+  CHECK_TRUE(!obs::ValidateExposition(
+                  "# TYPE h histogram\n"
+                  "h_bucket{le=\"+Inf\"} 1\nh_sum 1\n"
+                  "h_count 1 # {change_id=\"1\"} 1\n")
+                  .ok());
+  // The 128-rune exemplar label budget (the OpenMetrics limit).
+  std::string big(140, 'x');
+  CHECK_TRUE(!obs::ValidateExposition("# TYPE c counter\nc 1 # {a=\"" +
+                                      big + "\"} 1\n")
+                  .ok());
+}
+
 void TestListenAddrParse() {
   Result<obs::ListenAddr> a = obs::ParseListenAddr(":8081");
   CHECK_TRUE(a.ok());
@@ -1652,6 +1697,92 @@ void TestTraceRecorderGoldenParity() {
   }
 }
 
+// The SLO-engine cross-language parity pin: this literal is ALSO
+// embedded in tests/test_trace.py, where tpufd.trace.StageSlo replays
+// the same scripted fold/expire sequence — byte-for-byte, like the
+// trace golden above.
+constexpr const char* kSloGoldenJson =
+    "{\"window_s\":60,\"samples\":2,\"folded_total\":3,\"retired_total\":1,"
+    "\"last_change\":3,\"stages\":{\"plan\":{\"count\":1,\"p50_ms\":0.500,"
+    "\"p99_ms\":0.500},\"render\":{\"count\":1,\"p50_ms\":40.090,"
+    "\"p99_ms\":40.090},\"publish\":{\"count\":1,\"p50_ms\":2922.162,"
+    "\"p99_ms\":2922.162}},\"serialized\":"
+    "\"plan=0:1;render=46:1;publish=91:1\"}";
+
+void TestStageSloGoldenParity() {
+  obs::StageSlo slo(/*window_s=*/60);
+  slo.Fold(1,
+           {{"plan", 100.25},
+            {"render", 12.5},
+            {"publish", 480.0},
+            {"publish-acked", 500.0}},
+           100.0);
+  slo.Fold(2, {{"plan", 0.0}, {"publish", 2900.0}}, 130.0);
+  // Unknown stages never enter the sketches; a fold with ONLY unknown
+  // stages would not count.
+  slo.Fold(3, {{"render", 40.0}, {"junk", 5.0}}, 150.0);
+  // Retire-oldest: the t=100 sample ages out (publish-acked empties
+  // with it and drops from the document entirely).
+  slo.Expire(170.0);
+  CHECK_EQ(slo.RenderJson(), std::string(kSloGoldenJson));
+  CHECK_EQ(slo.Serialize(), "plan=0:1;render=46:1;publish=91:1");
+  CHECK_EQ(slo.samples(), int64_t{2});
+  CHECK_EQ(slo.retired_total(), int64_t{1});
+
+  // The serialized annotation round-trips through the aggregator's
+  // parser into the same sketches the node holds.
+  agg::StageSketches parsed = agg::ParseStageSketches(slo.Serialize());
+  agg::StageSketches held = slo.Snapshot();
+  CHECK_EQ(parsed.size(), held.size());
+  for (const auto& [stage, sketch] : held) {
+    CHECK_TRUE(parsed[stage] == sketch);
+  }
+
+  // Shrinking the window expires eagerly on the next touch; draining
+  // everything leaves an empty serialization ("" = no annotation).
+  slo.SetWindow(5);
+  slo.Expire(170.0);
+  CHECK_EQ(slo.samples(), int64_t{0});
+  CHECK_EQ(slo.retired_total(), int64_t{3});
+  CHECK_EQ(slo.Serialize(), "");
+  CHECK_EQ(slo.folded_total(), int64_t{3});  // history, not window
+
+  // A fold with no known stage counts nothing.
+  obs::StageSlo quiet(60);
+  quiet.Fold(9, {{"junk", 1.0}}, 10.0);
+  CHECK_EQ(quiet.folded_total(), int64_t{0});
+  CHECK_EQ(quiet.Serialize(), "");
+}
+
+void TestStageDurationsMs() {
+  // The slicing rule shared with RenderChromeTrace: prev-stamp ->
+  // stage-stamp intervals, minted_ts first, clamped at 0 against clock
+  // steps, "govern" folded into "render", unknown stages dropped. The
+  // SAME grids are pinned in tests/test_trace.py against
+  // tpufd.trace.stage_durations_ms.
+  obs::TraceRecord record;
+  record.minted_ts = 100.0;
+  record.stages = {{"plan", 100.25},
+                   {"render", 100.5},
+                   {"govern", 100.625},
+                   {"publish", 101.0},
+                   {"publish-acked", 101.125}};
+  std::map<std::string, double> ms = obs::StageDurationsMs(record);
+  CHECK_EQ(Fixed3(ms["plan"]), "250.000");
+  CHECK_EQ(Fixed3(ms["render"]), "375.000");  // render 250 + govern 125
+  CHECK_EQ(Fixed3(ms["publish"]), "375.000");
+  CHECK_EQ(Fixed3(ms["publish-acked"]), "125.000");
+  CHECK_EQ(ms.size(), size_t{4});
+
+  obs::TraceRecord stepped;
+  stepped.minted_ts = 10.0;
+  stepped.stages = {{"plan", 9.0}, {"publish", 10.5}, {"junk", 11.0}};
+  ms = obs::StageDurationsMs(stepped);
+  CHECK_EQ(Fixed3(ms["plan"]), "0.000");  // clock step clamps, not -1000
+  CHECK_EQ(Fixed3(ms["publish"]), "500.000");
+  CHECK_EQ(ms.size(), size_t{2});
+}
+
 void TestJournalChangeCorrelation() {
   // Satellite (ISSUE 15): every journal event carries the change id
   // its pass was carrying, wired through BeginRewrite — so
@@ -1783,6 +1914,24 @@ void TestChangeAnnotationBodies() {
                                            false, "12");
   CHECK_TRUE(plain.find("annotations") == std::string::npos);
 
+  // The stage-SLO annotation (ISSUE 16) rides NEXT TO the change id —
+  // change id first — and alone when no change is in flight. The exact
+  // bytes are pinned against the Python twin in tests/test_trace.py.
+  std::string with_slo = k8s::BuildMergePatch(
+      acked, desired, "node-1", false, "12",
+      /*change_annotation=*/"37",
+      /*slo_annotation=*/"plan=0:1;publish=91:1");
+  CHECK_TRUE(with_slo.find(
+                 "\"annotations\":{\"tfd.google.com/change-id\":\"37\","
+                 "\"tfd.google.com/stage-slo\":"
+                 "\"plan=0:1;publish=91:1\"}") != std::string::npos);
+  std::string slo_only = k8s::BuildMergePatch(
+      acked, desired, "node-1", false, "12", "", "plan=0:1");
+  CHECK_TRUE(slo_only.find("\"annotations\":{\"tfd.google.com/"
+                           "stage-slo\":\"plan=0:1\"}") !=
+             std::string::npos);
+  CHECK_TRUE(slo_only.find("change-id") == std::string::npos);
+
   k8s::WatchEvent event = k8s::ParseWatchEventLine(
       "{\"type\":\"MODIFIED\",\"object\":{\"metadata\":{\"name\":"
       "\"tfd-features-for-n1\",\"resourceVersion\":\"5\","
@@ -1799,6 +1948,23 @@ void TestChangeAnnotationBodies() {
       "\"annotations\":{\"tfd.google.com/change-id\":12}},"
       "\"spec\":{\"labels\":{}}}}");
   CHECK_EQ(hostile.change, "");
+
+  // The stage-slo annotation extracts alongside the change id (the
+  // aggregator's merge input); absent or non-string reads as "".
+  k8s::WatchEvent slo_event = k8s::ParseWatchEventLine(
+      "{\"type\":\"MODIFIED\",\"object\":{\"metadata\":{\"name\":\"x\","
+      "\"resourceVersion\":\"5\",\"annotations\":{"
+      "\"tfd.google.com/change-id\":\"37\","
+      "\"tfd.google.com/stage-slo\":\"plan=0:1;publish=91:1\"}},"
+      "\"spec\":{\"labels\":{\"a\":\"1\"}}}}");
+  CHECK_EQ(slo_event.change, "37");
+  CHECK_EQ(slo_event.stage_slo, "plan=0:1;publish=91:1");
+  CHECK_EQ(none.stage_slo, "");
+  k8s::WatchEvent bad_slo = k8s::ParseWatchEventLine(
+      "{\"type\":\"MODIFIED\",\"object\":{\"metadata\":{\"name\":\"x\","
+      "\"annotations\":{\"tfd.google.com/stage-slo\":7}},"
+      "\"spec\":{\"labels\":{}}}}");
+  CHECK_EQ(bad_slo.stage_slo, "");
 }
 
 void TestSanitizeUtf8() {
@@ -5881,6 +6047,137 @@ void TestAggSketchParity() {
   }
   a.Merge(b);
   CHECK_TRUE(a == both);
+  // Unmergeable: retiring a merged sketch restores the other stream —
+  // the per-node retire -> republish -> aggregator-unmerge loop the
+  // windowed SLO view rides on. Same pins in tests/test_agg.py.
+  both.Unmerge(b);
+  agg::QuantileSketch a_alone;
+  for (int i = 0; i < 50; i++) a_alone.Add(i + 1.0);
+  CHECK_TRUE(both == a_alone);
+
+  // FractionAbove: the burn evaluator's over-budget mass. Pinned in
+  // tests/test_agg.py with the same values.
+  agg::QuantileSketch over;
+  over.Add(10.0);
+  over.Add(20.0);
+  over.Add(3000.0);
+  over.Add(3000.0);
+  CHECK_EQ(Fixed3(over.FractionAbove(1200.0)), "0.500");
+  CHECK_EQ(Fixed3(over.FractionAbove(5.0)), "1.000");
+  CHECK_EQ(Fixed3(over.FractionAbove(1e9)), "0.000");
+  CHECK_EQ(Fixed3(agg::QuantileSketch().FractionAbove(1.0)), "0.000");
+
+  // AddBucketCount (the deserialization primitive): out-of-range
+  // buckets and non-positive counts are ignored, never fatal.
+  agg::QuantileSketch direct;
+  direct.AddBucketCount(5, 3);
+  direct.AddBucketCount(-1, 2);
+  direct.AddBucketCount(agg::kSketchBuckets, 2);
+  direct.AddBucketCount(4, 0);
+  direct.AddBucketCount(4, -7);
+  CHECK_EQ(direct.count(), 3);
+  CHECK_EQ(direct.bucket_counts()[5], 3);
+}
+
+void TestSloSerializationParity() {
+  // The annotation encoding (SerializeStageSketches): kSloStages
+  // order, empty sketches skipped, sparse ascending bucket:count. The
+  // SAME goldens are pinned in tests/test_agg.py.
+  agg::StageSketches stages;
+  stages["plan"].Add(100.25);
+  stages["plan"].Add(0.0);
+  stages["publish"].Add(2900.0);
+  std::string text = agg::SerializeStageSketches(stages);
+  CHECK_EQ(text, "plan=0:1,56:1;publish=91:1");
+  // Round trip: parse -> serialize reproduces the bytes, and the
+  // sketches match bucket-for-bucket.
+  agg::StageSketches parsed = agg::ParseStageSketches(text);
+  CHECK_EQ(agg::SerializeStageSketches(parsed), text);
+  CHECK_TRUE(parsed["plan"] == stages["plan"]);
+  CHECK_TRUE(parsed["publish"] == stages["publish"]);
+  CHECK_EQ(agg::SerializeStageSketches({}), "");
+
+  // Tolerant parse: the annotation arrives from arbitrary nodes —
+  // unknown stages and malformed tokens skip, never throw. Pins match
+  // tests/test_agg.py.
+  agg::StageSketches junk = agg::ParseStageSketches("junk=1:2;plan=5:3");
+  CHECK_EQ(junk.size(), size_t{1});
+  CHECK_EQ(junk["plan"].bucket_counts()[5], 3);
+  agg::StageSketches partial =
+      agg::ParseStageSketches("plan=abc:1,8:2,:,9");
+  CHECK_EQ(partial["plan"].count(), 2);
+  CHECK_EQ(partial["plan"].bucket_counts()[8], 2);
+  CHECK_TRUE(agg::ParseStageSketches("plan=").empty());
+  CHECK_TRUE(agg::ParseStageSketches("").empty());
+  CHECK_TRUE(agg::ParseStageSketches(";;").empty());
+  // A repeated stage accumulates (merge semantics, not last-wins).
+  agg::StageSketches twice = agg::ParseStageSketches("plan=0:1;plan=1:1");
+  CHECK_EQ(twice["plan"].count(), 2);
+}
+
+void TestSloBudgetsFromSpec() {
+  // The default table is DERIVED from the cluster protocol budgets
+  // (scripts/bench_gate.py CLUSTER_STAGE_BUDGETS_MS: hold=1200,
+  // fanout=100): plan/publish = hold, render = fanout, publish-acked =
+  // hold+fanout. bench_gate --slo cross-checks the same derivation.
+  std::map<std::string, double> defaults = agg::DefaultSloBudgetsMs();
+  CHECK_EQ(Fixed3(defaults["plan"]), "1200.000");
+  CHECK_EQ(Fixed3(defaults["render"]), "100.000");
+  CHECK_EQ(Fixed3(defaults["publish"]), "1200.000");
+  CHECK_EQ(Fixed3(defaults["publish-acked"]), "1300.000");
+  CHECK_EQ(defaults.size(), size_t{4});
+  // Operator overrides (TFD_SLO_BUDGETS_MS): unknown stages and
+  // malformed numbers are ignored; "" = the defaults. Same grid in
+  // tests/test_agg.py.
+  std::map<std::string, double> tuned = agg::SloBudgetsMsFromSpec(
+      "publish=2500,junk=5,render=nope,plan=90");
+  CHECK_EQ(Fixed3(tuned["publish"]), "2500.000");
+  CHECK_EQ(Fixed3(tuned["plan"]), "90.000");
+  CHECK_EQ(Fixed3(tuned["render"]), "100.000");
+  CHECK_EQ(Fixed3(tuned["publish-acked"]), "1300.000");
+  CHECK_EQ(tuned.size(), size_t{4});
+  CHECK_TRUE(agg::SloBudgetsMsFromSpec("") == defaults);
+}
+
+void TestBurnEvaluatorParity() {
+  // The multi-window burn scenario, scripted on an injected clock: a
+  // sketch whose mass sits far over the publish budget asserts on the
+  // first tick (fast mean 1.0, slow mean 1.0); replacing it with a
+  // healthy sketch clears once the fast window drains. The SAME script
+  // runs in tests/test_agg.py — edge times must match exactly.
+  agg::BurnEvaluator burn(agg::SloBudgetsMsFromSpec(""),
+                          /*fast_window_s=*/10.0, /*slow_window_s=*/40.0);
+  agg::StageSketches hot;
+  for (int i = 0; i < 4; i++) hot["publish"].Add(3000.0);
+  std::vector<std::pair<double, bool>> edges;  // (t, burning)
+  for (int t = 0; t < 50; t += 5) {
+    for (const agg::BurnEvaluator::Edge& e :
+         burn.Note(static_cast<double>(t), hot)) {
+      CHECK_EQ(e.stage, "publish");
+      edges.emplace_back(static_cast<double>(t), e.burning);
+    }
+  }
+  CHECK_EQ(edges.size(), size_t{1});
+  CHECK_EQ(edges[0].first, 0.0);
+  CHECK_TRUE(edges[0].second);
+  CHECK_TRUE(burn.burning("publish"));
+  CHECK_EQ(burn.BurningStages().size(), size_t{1});
+
+  agg::StageSketches cool;
+  for (int i = 0; i < 20; i++) cool["publish"].Add(10.0);
+  for (int t = 50; t < 90; t += 5) {
+    for (const agg::BurnEvaluator::Edge& e :
+         burn.Note(static_cast<double>(t), cool)) {
+      CHECK_TRUE(!e.burning);
+      edges.emplace_back(static_cast<double>(t), e.burning);
+    }
+  }
+  CHECK_EQ(edges.size(), size_t{2});
+  CHECK_EQ(edges[1].first, 55.0);  // two clean fast-window ticks
+  CHECK_TRUE(!burn.burning("publish"));
+  CHECK_TRUE(burn.BurningStages().empty());
+  // A never-seen stage stays untracked (no spurious clear edges).
+  CHECK_TRUE(!burn.burning("plan"));
 }
 
 void TestAggIncrementalRollups() {
@@ -6190,6 +6487,7 @@ int main(int argc, char** argv) {
   tfd::TestMetricsEscaping();
   tfd::TestMetricsHistogram();
   tfd::TestValidateExposition();
+  tfd::TestMetricsExemplars();
   tfd::TestListenAddrParse();
   tfd::TestIntrospectionServer();
   tfd::TestReadyzAllExpired();
@@ -6202,6 +6500,8 @@ int main(int argc, char** argv) {
   tfd::TestJournalGenerationCorrelation();
   tfd::TestTraceRecorderLifecycle();
   tfd::TestTraceRecorderGoldenParity();
+  tfd::TestStageSloGoldenParity();
+  tfd::TestStageDurationsMs();
   tfd::TestJournalChangeCorrelation();
   tfd::TestDebugTraceEndpoint();
   tfd::TestVerdictChangeEcho();
@@ -6281,6 +6581,9 @@ int main(int argc, char** argv) {
   tfd::TestWakeupMux();
   tfd::TestSnapshotMovementNotify();
   tfd::TestAggSketchParity();
+  tfd::TestSloSerializationParity();
+  tfd::TestSloBudgetsFromSpec();
+  tfd::TestBurnEvaluatorParity();
   tfd::TestAggIncrementalRollups();
   tfd::TestAggFlushController();
   tfd::TestAggWatchEventName();

@@ -120,6 +120,126 @@ class TestSketchParity:
         a.merge(b)
         assert a.counts == both.counts and a.total == both.total
 
+    def test_unmerge_subtracts_a_contribution(self):
+        # The aggregator retires a node by unmerging its last-seen
+        # sketch from the fleet merge; same grid as unit_tests.cc.
+        a, b, both = agg.Sketch(), agg.Sketch(), agg.Sketch()
+        for i in range(50):
+            a.add(i + 1.0)
+            both.add(i + 1.0)
+        for i in range(50, 100):
+            b.add(i + 1.0)
+            both.add(i + 1.0)
+        both.unmerge(b)
+        assert both.counts == a.counts and both.total == a.total
+
+    def test_fraction_above_matches_cpp(self):
+        s = agg.Sketch()
+        for v in (10.0, 20.0, 3000.0, 3000.0):
+            s.add(v)
+        assert agg.fixed3(s.fraction_above(1200.0)) == "0.500"
+        assert agg.fixed3(s.fraction_above(5.0)) == "1.000"
+        assert agg.fixed3(s.fraction_above(1e9)) == "0.000"
+        assert agg.fixed3(agg.Sketch().fraction_above(1.0)) == "0.000"
+
+    def test_add_bucket_count_rejects_off_grid(self):
+        s = agg.Sketch()
+        s.add_bucket_count(5, 3)
+        s.add_bucket_count(-1, 2)                  # below the grid
+        s.add_bucket_count(agg.SKETCH_BUCKETS, 2)  # above the grid
+        s.add_bucket_count(4, 0)                   # empty
+        s.add_bucket_count(4, -7)                  # negative
+        assert s.total == 3
+        assert s.counts[5] == 3 and s.counts[4] == 0
+
+
+# ---- fleet SLO engine twins (identical literals in unit_tests.cc) ---------
+
+
+class TestSloSerializationParity:
+    def test_golden_wire_encoding_matches_cpp(self):
+        plan, publish = agg.Sketch(), agg.Sketch()
+        plan.add(100.25)
+        plan.add(0.0)
+        publish.add(2900.0)
+        wire = agg.serialize_stage_sketches(
+            {"plan": plan, "publish": publish})
+        assert wire == "plan=0:1,56:1;publish=91:1"
+        parsed = agg.parse_stage_sketches(wire)
+        assert set(parsed) == {"plan", "publish"}
+        assert parsed["plan"].counts == plan.counts
+        assert parsed["publish"].counts == publish.counts
+
+    def test_parser_is_tolerant_never_fatal(self):
+        one = agg.parse_stage_sketches("junk=1:2;plan=5:3")
+        assert set(one) == {"plan"}
+        assert one["plan"].counts[5] == 3 and one["plan"].total == 3
+        ragged = agg.parse_stage_sketches("plan=abc:1,8:2,:,9")
+        assert ragged["plan"].total == 2
+        assert ragged["plan"].counts[8] == 2
+        for empty in ("plan=", "", ";;"):
+            assert agg.parse_stage_sketches(empty) == {}, empty
+
+    def test_repeated_stage_accumulates(self):
+        # Merge semantics on the wire: a repeated stage token folds in
+        # (the aggregator never drops a node's contribution).
+        doubled = agg.parse_stage_sketches("plan=0:1;plan=1:1")
+        assert doubled["plan"].total == 2
+        assert doubled["plan"].counts[0] == 1
+        assert doubled["plan"].counts[1] == 1
+
+
+class TestSloBudgetsParity:
+    def test_defaults_and_override_spec_match_cpp(self):
+        defaults = agg.slo_budgets_ms_from_spec("")
+        assert defaults == {"plan": 1200.0, "render": 100.0,
+                            "publish": 1200.0, "publish-acked": 1300.0}
+        assert defaults == agg.SLO_STAGE_BUDGETS_MS
+        tuned = agg.slo_budgets_ms_from_spec(
+            "publish=2500,junk=5,render=nope,plan=90")
+        assert tuned["publish"] == 2500.0 and tuned["plan"] == 90.0
+        assert tuned["render"] == 100.0
+        assert tuned["publish-acked"] == 1300.0
+
+    def test_budgets_cross_check_bench_gate_derivation(self):
+        # bench_gate --slo re-derives the table from the cluster
+        # protocol budgets; a drift between the two fails here before
+        # it fails in CI.
+        sys.path.insert(
+            0, str(Path(__file__).resolve().parent.parent / "scripts"))
+        import bench_gate
+        assert bench_gate.slo_stage_budgets_ms() == \
+            agg.SLO_STAGE_BUDGETS_MS
+
+
+class TestBurnEvaluatorTwin:
+    def test_assert_and_clear_edges_match_cpp(self):
+        # Same script as unit_tests.cc TestBurnEvaluatorParity: a hot
+        # publish sketch asserts on the first tick (both window means
+        # saturate), stays latched while hot, and clears two ticks
+        # after the sketch cools (the fast window drains first).
+        burn = agg.BurnEvaluator(agg.slo_budgets_ms_from_spec(""),
+                                 fast_window_s=10.0, slow_window_s=40.0)
+        hot = agg.Sketch()
+        for _ in range(4):
+            hot.add(3000.0)
+        edges = []
+        for t in range(0, 50, 5):
+            edges += [(t, s, b)
+                      for s, b in burn.note(float(t), {"publish": hot})]
+        assert edges == [(0, "publish", True)]
+        assert burn.burning("publish")
+        cool = agg.Sketch()
+        for _ in range(20):
+            cool.add(10.0)
+        for t in range(50, 90, 5):
+            edges += [(t, s, b)
+                      for s, b in burn.note(float(t), {"publish": cool})]
+        assert edges == [(0, "publish", True), (55, "publish", False)]
+        assert burn.burning_stages() == []
+        # A stage that never saw a sketch is never tracked at all.
+        assert "plan" not in burn.samples
+
 
 GOLDEN_FLEET = {
     "n0": {agg.SLICE_ID: "s-a", agg.SLICE_DEGRADED: "false",
@@ -552,6 +672,61 @@ class TestAggregatorProcess:
                 stop(a)
                 if proc_b is not None:
                     stop(proc_b)
+
+
+class TestSloBurnEndToEnd:
+    def test_hot_stage_sketch_asserts_burn_on_real_aggregator(
+            self, tfd_binary):
+        """ISSUE 16 end-to-end: a node CR carrying a hot stage-slo
+        annotation + a tightened TFD_SLO_BUDGETS_MS budget must surface
+        as fleet obs labels, a tpu.slo.*.burn label on the rollup, the
+        burn gauge, and an slo-burn journal event — on the REAL
+        aggregator binary."""
+        with FakeApiServer() as server:
+            seed_fleet(server, 6)
+            hot = agg.Sketch()
+            for _ in range(8):
+                hot.add(3000.0)
+            wire = agg.serialize_stage_sketches({"publish": hot})
+
+            def attach(obj):
+                obj["metadata"].setdefault(
+                    "annotations", {})["tfd.google.com/stage-slo"] = wire
+
+            server.edit(NS, "tfd-features-for-node-0", attach)
+            port = free_port()
+            proc = subprocess.Popen(
+                agg_argv(tfd_binary, port),
+                env={**agg_env(server),
+                     "TFD_SLO_BUDGETS_MS": "publish=100"},
+                stderr=subprocess.DEVNULL)
+            try:
+                def burning():
+                    labels = output_labels(server) or {}
+                    return labels.get(
+                        "google.com/tpu.slo.publish.burn") == "true"
+
+                assert wait_for(burning, timeout=20)
+                labels = output_labels(server)
+                # The fleet stage quantiles ride the same rollup, and
+                # the fleet merge IS node-0's sketch here.
+                assert labels["google.com/tpu.obs.stage.publish.p99-ms"] \
+                    == agg.fixed3(hot.quantile(0.99))
+                assert labels["google.com/tpu.obs.stage.publish.p50-ms"] \
+                    == agg.fixed3(hot.quantile(0.50))
+                # Stages nobody sketched publish nothing.
+                assert "google.com/tpu.obs.stage.plan.p99-ms" not in labels
+                assert "google.com/tpu.slo.plan.burn" not in labels
+                assert metric(port, "tfd_slo_burn_state",
+                              labels={"stage": "publish"}) == 1.0
+                status, body = http_get(
+                    port, "/debug/journal?type=slo-burn")
+                assert status == 200
+                events = json.loads(body)["events"]
+                assert any(e["fields"].get("stage") == "publish"
+                           for e in events)
+            finally:
+                stop(proc)
 
 
 # ---- on-node lifecycle fast path ------------------------------------------

@@ -89,6 +89,38 @@ class TestScheduleGrammar:
             "5 degrade s0/h0\n5 wedge s1/h1\n")
         assert [e.op for e in events] == ["degrade", "wedge"]
 
+    def test_slowdown_is_a_server_op(self):
+        # The SLO soak's latency-regression injection rides the same
+        # grammar as brownout (ISSUE 16).
+        assert "slowdown" in cluster.SERVER_OPS
+        event, = cluster.parse_schedule(
+            "36 slowdown apiserver secs=10 delay=3\n")
+        assert (event.at, event.op) == (36.0, "slowdown")
+        assert event.target() == "apiserver"
+        assert event.args == {"secs": "10", "delay": "3"}
+        import pytest
+
+        with pytest.raises(ValueError) as err:
+            cluster.parse_schedule("5 slowdown s0")
+        assert "'apiserver'" in str(err.value)
+
+
+class TestSloStageDurations:
+    def test_partition_of_chain_stages(self):
+        # The chain->node stage correspondence the SLO budgets are
+        # derived from: plan=hold, render=fanout, publish=publish,
+        # publish-acked=publish+fanout.
+        chain = {"detect": 1.0, "agree": 2.0, "hold": 40.0,
+                 "publish": 300.0, "fanout": 8.0, "schedule": 4.0}
+        assert cluster.slo_stage_durations(chain) == {
+            "plan": 40.0, "render": 8.0, "publish": 300.0,
+            "publish-acked": 308.0}
+        # The vocabulary is exactly the sketching twin's stage set.
+        from tpufd import agg
+
+        assert tuple(sorted(cluster.SLO_STAGE_SOURCES)) == \
+            tuple(sorted(agg.SLO_STAGES))
+
     def test_rejections_name_the_line(self):
         import pytest
 

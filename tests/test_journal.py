@@ -165,7 +165,7 @@ class TestSigusr1Dump:
             doc = json.loads(dump_file.read_text())
             assert set(doc) == {"dumped_at", "version", "labels",
                                 "published_labels", "snapshots",
-                                "trace", "journal"}
+                                "trace", "slo", "journal"}
             journal = journal_lib.parse_journal(doc["journal"])
             # The dump records itself.
             assert journal_lib.events_of_type(journal["events"], "dump")

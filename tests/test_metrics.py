@@ -216,5 +216,72 @@ def test_probe_timing_lands_in_default_registry():
         labels={"probe": "unit-probe"}) == 1
 
 
+def test_exemplar_render_golden_and_last_write_wins():
+    """Mirrors unit_tests.cc TestMetricsExemplars: an observation
+    carrying an exemplar rides its bucket line in OpenMetrics form;
+    the next exemplared observation into the same bucket replaces it."""
+    reg = metrics.Registry()
+    h = reg.histogram("tfd_stage_seconds", "stage latency",
+                      labels={"stage": "plan"}, buckets=(0.1, 1.0))
+    h.observe(0.05, exemplar={"change_id": "42"})
+    h.observe(0.5)
+    h.observe(5.0, exemplar={"change_id": "43"})
+    text = reg.render()
+    assert ('tfd_stage_seconds_bucket{stage="plan",le="0.1"} 1 '
+            '# {change_id="42"} 0.05\n') in text
+    assert 'tfd_stage_seconds_bucket{stage="plan",le="1"} 2\n' in text
+    assert ('tfd_stage_seconds_bucket{stage="plan",le="+Inf"} 3 '
+            '# {change_id="43"} 5\n') in text
+    metrics.validate_exposition(text)
+    h.observe(0.06, exemplar={"change_id": "44"})
+    text = reg.render()
+    assert '# {change_id="44"} 0.06' in text
+    assert 'change_id="42"' not in text
+    metrics.validate_exposition(text)
+
+
+def test_parse_samples_ex_round_trips_exemplars():
+    text = ("# TYPE tfd_passes_total counter\n"
+            'tfd_passes_total 7 # {change_id="9"} 0.25\n'
+            "# TYPE tfd_g gauge\n"
+            "tfd_g 1\n")
+    metrics.validate_exposition(text)
+    samples = list(metrics.parse_samples_ex(text))
+    assert samples[0] == ("tfd_passes_total", {}, 7.0,
+                          ({"change_id": "9"}, 0.25))
+    assert samples[1] == ("tfd_g", {}, 1.0, None)
+    # The exemplar-blind view stays exemplar-blind.
+    assert list(metrics.parse_samples(text)) == [
+        ("tfd_passes_total", {}, 7.0), ("tfd_g", {}, 1.0)]
+
+
+def test_exemplar_placement_rules_bite():
+    # Counter lines and histogram bucket lines only.
+    for bad in (
+        '# TYPE g gauge\ng 1 # {change_id="1"} 1\n',
+        ('# TYPE h histogram\nh_bucket{le="+Inf"} 1\nh_sum 1\n'
+         'h_count 1 # {change_id="1"} 1\n'),
+    ):
+        with pytest.raises(ValueError):
+            metrics.validate_exposition(bad)
+    metrics.validate_exposition(
+        '# TYPE c counter\nc 1 # {change_id="1"} 1\n')
+
+
+def test_exemplar_label_budget_bites():
+    fat = "x" * 140
+    with pytest.raises(ValueError):
+        metrics.validate_exposition(
+            f'# TYPE c counter\nc 1 # {{change_id="{fat}"}} 1\n')
+
+
+def test_hash_inside_label_value_is_not_an_exemplar():
+    text = '# TYPE g gauge\ng{path="a # b"} 1\n'
+    metrics.validate_exposition(text)
+    (name, labels, value, exemplar), = metrics.parse_samples_ex(text)
+    assert (name, value, exemplar) == ("g", 1.0, None)
+    assert labels["path"] == "a # b"
+
+
 def self_destruct():
     raise RuntimeError("probe blew up")

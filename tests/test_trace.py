@@ -100,6 +100,112 @@ class TestTwinParity:
                                   False, "12")
         assert "annotations" not in json.dumps(plain)
 
+    def test_merge_patch_slo_annotation_matches_cpp_bytes(self):
+        # ISSUE 16: the stage-SLO annotation rides NEXT TO the change
+        # id (change id first) — same C++ TestChangeAnnotationBodies
+        # vectors.
+        patch = build_merge_patch(
+            {"google.com/a": "1"}, {"google.com/a": "2"}, "node-1",
+            False, "12", change_annotation="37",
+            slo_annotation="plan=0:1;publish=91:1")
+        assert json.dumps(patch, separators=(",", ":")) == (
+            '{"metadata":{"resourceVersion":"12",'
+            '"annotations":{"tfd.google.com/change-id":"37",'
+            '"tfd.google.com/stage-slo":"plan=0:1;publish=91:1"}},'
+            '"spec":{"labels":{"google.com/a":"2"}}}')
+        # The sketches publish even on a quiet-change pass (no change
+        # id in flight): the slo annotation stands alone.
+        solo = build_merge_patch(
+            {"google.com/a": "1"}, {"google.com/a": "2"}, "node-1",
+            False, "12", slo_annotation="plan=0:1")
+        body = json.dumps(solo, separators=(",", ":"))
+        assert '"annotations":{"tfd.google.com/stage-slo":"plan=0:1"}' \
+            in body
+        assert "change-id" not in body
+
+
+# The SLO-engine parity pin (ISSUE 16): the SAME literal is embedded
+# in src/tfd/tests/unit_tests.cc (kSloGoldenJson) — C++ StageSlo and
+# the tpufd.trace.StageSlo twin replay the same scripted fold/expire
+# sequence and must both reproduce it byte-for-byte.
+SLO_GOLDEN_JSON = (
+    '{"window_s":60,"samples":2,"folded_total":3,"retired_total":1,'
+    '"last_change":3,"stages":{"plan":{"count":1,"p50_ms":0.500,'
+    '"p99_ms":0.500},"render":{"count":1,"p50_ms":40.090,'
+    '"p99_ms":40.090},"publish":{"count":1,"p50_ms":2922.162,'
+    '"p99_ms":2922.162}},"serialized":'
+    '"plan=0:1;render=46:1;publish=91:1"}')
+
+
+def scripted_slo():
+    slo = tracelib.StageSlo(window_s=60)
+    slo.fold(1, {"plan": 100.25, "render": 12.5, "publish": 480.0,
+                 "publish-acked": 500.0}, 100.0)
+    slo.fold(2, {"plan": 0.0, "publish": 2900.0}, 130.0)
+    # Unknown stages never enter the sketches.
+    slo.fold(3, {"render": 40.0, "junk": 5.0}, 150.0)
+    # Retire-oldest: the t=100 sample ages out, and publish-acked
+    # (present only there) drops from the document with it.
+    slo.expire(170.0)
+    return slo
+
+
+class TestSloTwinParity:
+    def test_render_json_matches_the_cpp_golden(self):
+        slo = scripted_slo()
+        assert slo.render_json() == SLO_GOLDEN_JSON
+        assert slo.serialize() == "plan=0:1;render=46:1;publish=91:1"
+        assert (len(slo.samples), slo.retired) == (2, 1)
+
+    def test_windowed_retirement_drains_to_empty(self):
+        slo = scripted_slo()
+        slo.window_s = 5
+        slo.expire(170.0)
+        assert not slo.samples and not slo.sketches
+        assert slo.retired == 3
+        assert slo.serialize() == ""
+        assert slo.folded == 3  # history, not window
+
+        # A fold with only unknown stages counts nothing.
+        quiet = tracelib.StageSlo(window_s=60)
+        quiet.fold(9, {"junk": 1.0}, 10.0)
+        assert quiet.folded == 0 and quiet.serialize() == ""
+
+    def test_serialized_round_trips_through_agg_parser(self):
+        from tpufd import agg as agglib
+
+        slo = scripted_slo()
+        parsed = agglib.parse_stage_sketches(slo.serialize())
+        assert sorted(parsed) == sorted(slo.sketches)
+        for stage, sketch in slo.sketches.items():
+            assert parsed[stage].counts == sketch.counts
+
+    def test_stage_durations_ms_matches_cpp_grid(self):
+        # Same vectors as C++ TestStageDurationsMs: interval slicing,
+        # govern folded into render, clock-step clamp, unknown dropped.
+        rec = {"minted_ts": 100.0,
+               "stages": [("plan", 100.25), ("render", 100.5),
+                          ("govern", 100.625), ("publish", 101.0),
+                          ("publish-acked", 101.125)]}
+        assert tracelib.stage_durations_ms(rec) == {
+            "plan": 250.0, "render": 375.0, "publish": 375.0,
+            "publish-acked": 125.0}
+        stepped = {"minted_ts": 10.0,
+                   "stages": [("plan", 9.0), ("publish", 10.5),
+                              ("junk", 11.0)]}
+        assert tracelib.stage_durations_ms(stepped) == {
+            "plan": 0.0, "publish": 500.0}
+
+    def test_parse_slo_rejects_off_schema(self):
+        tracelib.parse_slo(SLO_GOLDEN_JSON)
+        with pytest.raises(ValueError):
+            tracelib.parse_slo('{"stages":{}}')
+        with pytest.raises(ValueError):
+            tracelib.parse_slo(json.dumps(
+                {"window_s": 60, "samples": 0, "folded_total": 0,
+                 "retired_total": 0, "last_change": 0,
+                 "stages": {"plan": {"count": 1}}, "serialized": ""}))
+
 
 def _stop(proc):
     if proc.poll() is None:
@@ -199,6 +305,51 @@ def test_change_id_joins_journal_trace_logs_and_cr(tfd_binary, tmp_path):
                 return False
             assert wait_for(log_joined, timeout=10), \
                 "no json log line carried the change id"
+
+            # (5) /debug/slo (ISSUE 16): the closed change's stage
+            # durations folded into the windowed sketches. The fold
+            # happens on the publish-ack, a beat after the CR write
+            # lands — poll for it.
+            def slo_caught_up():
+                status, body = http_get(port, "/debug/slo")
+                return (status == 200 and
+                        tracelib.parse_slo(body)["last_change"] >= change)
+            assert wait_for(slo_caught_up, timeout=10), \
+                "the published change never folded into /debug/slo"
+            slo_doc = tracelib.parse_slo(http_get(port, "/debug/slo")[1])
+            assert slo_doc["folded_total"] >= 1
+            assert "publish-acked" in slo_doc["stages"], slo_doc
+            assert slo_doc["serialized"]
+
+            # (6) the stage-slo CR annotation: the sketches ride
+            # outward next to the change id, parseable by the
+            # aggregator's twin, never as spec.labels.
+            from tpufd import agg as agglib
+            from tpufd.sink import SLO_ANNOTATION
+
+            obj = server.store.get(key)
+            annotations = obj["metadata"]["annotations"]
+            assert agglib.parse_stage_sketches(
+                annotations.get(SLO_ANNOTATION, ""))
+            assert not any(k.startswith("tfd.google.com/")
+                           for k in obj["spec"]["labels"])
+
+            # (7) /metrics: the publish-acked stage histogram carries
+            # the change id as an OpenMetrics exemplar, and the whole
+            # exposition (exemplars included) passes the Python
+            # validator twin.
+            text = http_get(port, "/metrics")[1]
+            metrics.validate_exposition(text)
+            exemplars = [
+                (labels, ex) for name, labels, _, ex
+                in metrics.parse_samples_ex(text)
+                if name == "tfd_pass_stage_duration_seconds_bucket"
+                and labels.get("stage") == "publish-acked"
+                and ex is not None]
+            assert exemplars, \
+                "no publish-acked bucket line carried an exemplar"
+            assert any(ex[0].get("change_id") == str(change)
+                       for _, ex in exemplars), exemplars
         finally:
             _stop(proc)
 
@@ -228,10 +379,20 @@ def test_sigusr1_folds_trace_published_labels_and_perfetto(tfd_binary,
         assert wait_for(lambda: dump.exists() and chrome.exists(),
                         timeout=15)
         doc = json.loads(dump.read_text())
+        # The dump layout is pinned: a section rename or reorder breaks
+        # operators' jq one-liners, so it fails a test first (ISSUE 16
+        # added "slo" between "trace" and "journal").
+        assert list(doc) == [
+            "dumped_at", "version", "labels", "published_labels",
+            "snapshots", "trace", "slo", "journal"]
         # The trace ring parses with the twin's schema checker and
         # carries at least the first-settle change.
         trace_doc = tracelib.parse_trace(doc["trace"])
         assert trace_doc["minted_total"] >= 1
+        # The slo section parses with the twin's schema checker and
+        # rides the default 600s window (--slo-window untouched here).
+        slo_doc = tracelib.parse_slo(doc["slo"])
+        assert slo_doc["window_s"] == 600
         # The published-labels view agrees with the emitted label file.
         published = doc["published_labels"]
         assert published is not None

@@ -39,6 +39,29 @@ FLEET_MATMUL_P50 = PREFIX + "tpu.fleet.perf.matmul-p50"
 FLEET_HBM_P10 = PREFIX + "tpu.fleet.perf.hbm-p10"
 FLEET_HBM_P50 = PREFIX + "tpu.fleet.perf.hbm-p50"
 
+# Fleet SLO engine (lm/schema.h kObsStagePrefix / kSloBurnPrefix):
+# keys are prefix + stage (+ suffix), stage in SLO_STAGES.
+OBS_STAGE_PREFIX = PREFIX + "tpu.obs.stage."
+SLO_BURN_PREFIX = PREFIX + "tpu.slo."
+
+# agg.h kSloStages — the node-pipeline stage vocabulary the SLO engine
+# sketches ("govern" folds into "render" on the node side).
+SLO_STAGES = ("plan", "render", "publish", "publish-acked")
+
+# agg.cc DefaultSloBudgetsMs — node-stage latency budgets (ms), derived
+# from the cluster protocol budgets (bench_gate CLUSTER_STAGE_BUDGETS_MS):
+# plan and publish each get the chain "hold" allowance (the governor's
+# local think-time), render the "fanout" allowance (pure CPU), and
+# publish-acked — which absorbs brownout deferral — hold+fanout.
+# bench_gate --slo re-derives this table and cross-checks it; change
+# one side, change all.
+SLO_STAGE_BUDGETS_MS = {
+    "plan": 1200.0,
+    "render": 100.0,
+    "publish": 1200.0,
+    "publish-acked": 1300.0,
+}
+
 # agg.h kSketch* — the parity grid pins bucket indices on both sides.
 SKETCH_MIN = 0.5
 SKETCH_GAMMA = 1.1
@@ -91,6 +114,31 @@ class Sketch:
             self.counts[i] += other.counts[i]
         self.total += other.total
 
+    def unmerge(self, other):
+        """C++ Unmerge: retires a previously-merged sketch (per-bucket,
+        clamped at zero)."""
+        for i in range(SKETCH_BUCKETS):
+            take = min(other.counts[i], self.counts[i])
+            self.counts[i] -= take
+            self.total -= take
+
+    def add_bucket_count(self, bucket, n):
+        """C++ AddBucketCount: deserialization primitive (out-of-range
+        bucket / non-positive n ignored)."""
+        if bucket < 0 or bucket >= SKETCH_BUCKETS or n <= 0:
+            return
+        self.counts[bucket] += n
+        self.total += n
+
+    def fraction_above(self, threshold):
+        """C++ FractionAbove: fraction of mass whose bucket
+        representative exceeds `threshold` (0 when empty)."""
+        if self.total <= 0:
+            return 0.0
+        over = sum(n for i, n in enumerate(self.counts)
+                   if n > 0 and sketch_bucket_value(i) > threshold)
+        return over / self.total
+
     def quantile(self, q):
         if self.total <= 0:
             return -1.0
@@ -102,6 +150,113 @@ class Sketch:
             if cumulative > target:
                 return sketch_bucket_value(i)
         return sketch_bucket_value(SKETCH_BUCKETS - 1)
+
+
+def slo_budgets_ms_from_spec(spec):
+    """C++ SloBudgetsMsFromSpec: the defaults with operator overrides
+    applied — ``spec`` is "stage=ms[,stage=ms...]" (the
+    TFD_SLO_BUDGETS_MS env format); unknown stages and malformed
+    entries are ignored."""
+    budgets = dict(SLO_STAGE_BUDGETS_MS)
+    for entry in (spec or "").split(","):
+        stage, eq, ms = entry.partition("=")
+        if not eq or stage not in budgets or not ms.isdigit():
+            continue
+        if int(ms) <= 0:
+            continue
+        budgets[stage] = float(int(ms))
+    return budgets
+
+
+def serialize_stage_sketches(stages):
+    """C++ SerializeStageSketches: compact annotation encoding —
+    stages in SLO_STAGES order, empty skipped, sparse ascending
+    ``bucket:count`` pairs, e.g. ``plan=0:3,5:2;publish=17:1``."""
+    parts = []
+    for name in SLO_STAGES:
+        sketch = stages.get(name)
+        if sketch is None or sketch.total <= 0:
+            continue
+        pairs = ",".join(f"{i}:{n}" for i, n in enumerate(sketch.counts)
+                         if n > 0)
+        parts.append(f"{name}={pairs}")
+    return ";".join(parts)
+
+
+def parse_stage_sketches(text):
+    """C++ ParseStageSketches: tolerant inverse — unknown stages and
+    malformed tokens are skipped, never fatal."""
+    out = {}
+    for entry in (text or "").split(";"):
+        stage, eq, body = entry.partition("=")
+        if not eq or stage not in SLO_STAGES:
+            continue
+        sketch = Sketch()
+        for pair in body.split(","):
+            bucket, colon, count = pair.partition(":")
+            if not colon or not bucket.isdigit() or not count.isdigit():
+                continue
+            sketch.add_bucket_count(int(bucket), int(count))
+        if sketch.total > 0:
+            out.setdefault(stage, Sketch()).merge(sketch)
+    return out
+
+
+class BurnEvaluator:
+    """C++ agg::BurnEvaluator twin: multi-window burn detection over
+    the merged fleet sketches. A stage starts burning when the
+    fast-window mean over-budget fraction crosses 1/2 while the
+    slow-window mean has spent the 10% error budget; it clears when
+    the fast mean drops back under 1/2."""
+
+    FAST_WINDOW_S = 300.0
+    SLOW_WINDOW_S = 3600.0
+    FAST_THRESHOLD = 0.5
+    SLOW_THRESHOLD = 0.1
+
+    def __init__(self, budgets_ms=None, fast_window_s=FAST_WINDOW_S,
+                 slow_window_s=SLOW_WINDOW_S):
+        self.budgets = dict(SLO_STAGE_BUDGETS_MS if budgets_ms is None
+                            else budgets_ms)
+        self.fast_window_s = fast_window_s
+        self.slow_window_s = slow_window_s
+        self.samples = {}  # stage -> [(ts, over-fraction)]
+        self.state = {}    # stage -> burning bool
+
+    def burning(self, stage):
+        return self.state.get(stage, False)
+
+    def burning_stages(self):
+        return sorted(s for s, b in self.state.items() if b)
+
+    def note(self, now, sketches):
+        """One evaluation tick; returns the burn edges as a list of
+        (stage, burning) tuples (C++ Note, budget-sorted order)."""
+        edges = []
+        for stage in sorted(self.budgets):
+            budget = self.budgets[stage]
+            sketch = sketches.get(stage)
+            have = sketch is not None and sketch.total > 0
+            if not have and stage not in self.samples:
+                continue
+            fraction = sketch.fraction_above(budget) if have else 0.0
+            window = self.samples.setdefault(stage, [])
+            window.append((now, fraction))
+            while window and window[0][0] <= now - self.slow_window_s:
+                window.pop(0)
+            fast = [f for ts, f in window if ts > now - self.fast_window_s]
+            fast_mean = sum(fast) / len(fast) if fast else 0.0
+            slow_mean = (sum(f for _, f in window) / len(window)
+                         if window else 0.0)
+            burning = self.state.get(stage, False)
+            if (not burning and fast_mean >= self.FAST_THRESHOLD and
+                    slow_mean >= self.SLOW_THRESHOLD):
+                self.state[stage] = True
+                edges.append((stage, True))
+            elif burning and fast_mean < self.FAST_THRESHOLD:
+                self.state[stage] = False
+                edges.append((stage, False))
+        return edges
 
 
 def _parse_float(labels, key, fallback):
@@ -117,10 +272,13 @@ def _parse_int(labels, key, fallback):
     return int(raw) if raw.isdigit() else fallback
 
 
-def extract_contribution(labels):
+def extract_contribution(labels, stage_slo=""):
     """C++ ExtractContribution: what one node's label set contributes to
-    the rollups (equal dicts <=> no rollup can move)."""
+    the rollups (equal dicts <=> no rollup can move). `stage_slo` is the
+    node's serialized stage-sketch annotation, kept raw — string
+    equality is the no-rollup-moved check."""
     return {
+        "stage_slo": stage_slo,
         "slice_id": labels.get(SLICE_ID, ""),
         "slice_degraded": labels.get(SLICE_DEGRADED) == "true",
         "multislice_group": labels.get(MULTISLICE_SLICE_ID, ""),
@@ -155,6 +313,7 @@ class InventoryStore:
         self.preempting_nodes = 0
         self.matmul = Sketch()
         self.hbm = Sketch()
+        self.stage = {}        # stage -> merged fleet Sketch
         self.events = 0
         self.full_recomputes = 0
 
@@ -186,6 +345,14 @@ class InventoryStore:
             self.matmul.remove(c["matmul_tflops"])
         if c["hbm_gbps"] >= 0:
             self.hbm.remove(c["hbm_gbps"])
+        if c["stage_slo"]:
+            for stage, sketch in parse_stage_sketches(c["stage_slo"]).items():
+                merged = self.stage.get(stage)
+                if merged is None:
+                    continue
+                merged.unmerge(sketch)
+                if merged.total <= 0:
+                    del self.stage[stage]
 
     def _admit(self, c):
         if c["slice_id"]:
@@ -206,12 +373,15 @@ class InventoryStore:
             self.matmul.add(c["matmul_tflops"])
         if c["hbm_gbps"] >= 0:
             self.hbm.add(c["hbm_gbps"])
+        if c["stage_slo"]:
+            for stage, sketch in parse_stage_sketches(c["stage_slo"]).items():
+                self.stage.setdefault(stage, Sketch()).merge(sketch)
 
-    def apply(self, node, labels):
+    def apply(self, node, labels, stage_slo=""):
         """Returns True when the node's contribution changed (a rollup
         moved and a publish is owed)."""
         self.events += 1
-        nxt = extract_contribution(labels)
+        nxt = extract_contribution(labels, stage_slo)
         prev = self.nodes.get(node)
         if prev is not None:
             if prev == nxt:
@@ -253,6 +423,13 @@ class InventoryStore:
         if self.hbm.total > 0:
             out[FLEET_HBM_P10] = fixed3(self.hbm.quantile(0.10))
             out[FLEET_HBM_P50] = fixed3(self.hbm.quantile(0.50))
+        for name in SLO_STAGES:
+            sketch = self.stage.get(name)
+            if sketch is None or sketch.total <= 0:
+                continue
+            base = OBS_STAGE_PREFIX + name
+            out[base + ".p50-ms"] = fixed3(sketch.quantile(0.50))
+            out[base + ".p99-ms"] = fixed3(sketch.quantile(0.99))
         return out
 
     def recompute_all(self):
@@ -265,6 +442,7 @@ class InventoryStore:
         self.preempting_nodes = 0
         self.matmul = Sketch()
         self.hbm = Sketch()
+        self.stage = {}
         for c in self.nodes.values():
             self._admit(c)
 

@@ -50,6 +50,35 @@ CAPACITY_PREFIX = agglib.CAPACITY_PREFIX
 # the same annotations-not-labels contract the real daemon keeps.
 CHANGE_KEY = PREFIX + "tfd.change"
 
+# The stage-SLO annotation analogue (obs/slo.h kSloAnnotation /
+# "tfd.google.com/stage-slo"): each sim daemon's serialized windowed
+# stage sketches ride this key; the aggregator merges them into the
+# fleet view exactly like the real runner. The scheduler never reads
+# it either.
+SLO_KEY = PREFIX + "tfd.stage-slo"
+
+# How a sim daemon folds a closed causal chain's CHAIN_STAGES durations
+# into the node SLO stage vocabulary (tpufd.agg.SLO_STAGES): "hold" is
+# the governor/render think-time before the write attempt (the node's
+# "plan" window), "fanout" the pure-wire span ("render"'s CPU-bound
+# analogue), chain "publish" the attempt-to-landed span, and
+# "publish-acked" the landed write plus its delivery tail. The SLO
+# budget table (tpufd.agg.SLO_STAGE_BUDGETS_MS) is derived from the
+# SAME correspondence — bench_gate --slo cross-checks both.
+SLO_STAGE_SOURCES = {
+    "plan": ("hold",),
+    "render": ("fanout",),
+    "publish": ("publish",),
+    "publish-acked": ("publish", "fanout"),
+}
+
+
+def slo_stage_durations(chain_stages):
+    """Maps one closed chain's per-stage durations (ms, CHAIN_STAGES
+    keys) onto the node SLO stages a sim daemon sketches."""
+    return {stage: sum(chain_stages[s] for s in sources)
+            for stage, sources in sorted(SLO_STAGE_SOURCES.items())}
+
 # Perf-class ordering: the scheduler prefers the best class that still
 # clears the job's floor. Absent/unknown ranks 0 (unclassed hardware is
 # only placeable by jobs with no class floor), degraded is NEVER
@@ -463,7 +492,10 @@ def stage_breakdown(closed, percentile):
 #                                preempt-clear)
 #   sNN        one slice        (leader-kill/leader-restart/partition/
 #                                heal-partition)
-#   apiserver  the control plane (brownout; secs=N)
+#   apiserver  the control plane (brownout secs=N; slowdown secs=N
+#                                 delay=D — every publish attempt in
+#                                 the window lands D s late, the SLO
+#                                 engine's latency-regression drill)
 # partition takes hosts=A-B (the member index range that loses
 # connectivity). The full semantics table lives in
 # docs/placement-harness.md.
@@ -472,7 +504,7 @@ HOST_OPS = {"degrade", "heal", "wedge", "unwedge", "preempt",
             "preempt-clear"}
 SLICE_OPS = {"leader-kill", "leader-restart", "partition",
              "heal-partition"}
-SERVER_OPS = {"brownout"}
+SERVER_OPS = {"brownout", "slowdown"}
 
 _TARGET_HOST = re.compile(r"^s(\d+)/h(\d+)$")
 _TARGET_SLICE = re.compile(r"^s(\d+)$")

@@ -282,9 +282,22 @@ class _Handler(BaseHTTPRequestHandler):
         bookmarks = query.get("allowWatchBookmarks", ["false"])[0] == "true"
         start_rv = query.get("resourceVersion", [None])[0]
 
+        key = (ns, name)
         with self.lock:
             self.requests.append(("WATCH", self.path))
             self.timeline.append((time.monotonic(), "WATCH", 200))
+            # "Future events only" is relative to REQUEST ARRIVAL, not
+            # to whenever this thread gets scheduled after the headers
+            # flush — a write racing the header round-trip must still
+            # be delivered.
+            floor = self.compacted.get(key, 0)
+            obj = self.store.get(key)
+            history = self.events.get(key, [])
+            candidates = [0]
+            if obj:
+                candidates.append(int(obj["metadata"]["resourceVersion"]))
+            candidates.extend(rv for rv, _, _ in history)
+            rv_at_request = max(candidates)
 
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
@@ -300,35 +313,25 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.write(b"0\r\n\r\n")
             self.wfile.flush()
 
-        key = (ns, name)
-        with self.lock:
-            floor = self.compacted.get(key, 0)
-            if start_rv is not None:
+        if start_rv is not None:
+            try:
+                last_sent = int(start_rv)
+            except ValueError:
+                last_sent = 0
+            if last_sent < floor:
                 try:
-                    last_sent = int(start_rv)
-                except ValueError:
-                    last_sent = 0
-                if last_sent < floor:
-                    try:
-                        emit({"type": "ERROR",
-                              "object": {"kind": "Status", "code": 410,
-                                         "message":
-                                             "too old resource version"}})
-                        finish()
-                    except OSError:
-                        pass
-                    return
-            else:
-                # No version named: future events only (the "start from
-                # now" informer bootstrap; the client lists first).
-                obj = self.store.get(key)
-                history = self.events.get(key, [])
-                candidates = [0]
-                if obj:
-                    candidates.append(
-                        int(obj["metadata"]["resourceVersion"]))
-                candidates.extend(rv for rv, _, _ in history)
-                last_sent = max(candidates)
+                    emit({"type": "ERROR",
+                          "object": {"kind": "Status", "code": 410,
+                                     "message":
+                                         "too old resource version"}})
+                    finish()
+                except OSError:
+                    pass
+                return
+        else:
+            # No version named: future events only (the "start from
+            # now" informer bootstrap; the client lists first).
+            last_sent = rv_at_request
 
         deadline = time.monotonic() + timeout_s
         next_bookmark = time.monotonic() + self.bookmark_interval
@@ -396,6 +399,10 @@ class _Handler(BaseHTTPRequestHandler):
         with self.lock:
             self.requests.append(("WATCH", self.path))
             self.timeline.append((time.monotonic(), "WATCH", 200))
+            # Snapshot at REQUEST ARRIVAL (see _watch): a write racing
+            # the header round-trip must still reach this stream.
+            floor = self.collection_compacted.get(ns, 0)
+            grv_at_request = self.grv[0]
 
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
@@ -411,25 +418,23 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.write(b"0\r\n\r\n")
             self.wfile.flush()
 
-        with self.lock:
-            floor = self.collection_compacted.get(ns, 0)
-            if start_rv is not None:
+        if start_rv is not None:
+            try:
+                last_sent = int(start_rv)
+            except ValueError:
+                last_sent = 0
+            if last_sent < floor:
                 try:
-                    last_sent = int(start_rv)
-                except ValueError:
-                    last_sent = 0
-                if last_sent < floor:
-                    try:
-                        emit({"type": "ERROR",
-                              "object": {"kind": "Status", "code": 410,
-                                         "message":
-                                             "too old resource version"}})
-                        finish()
-                    except OSError:
-                        pass
-                    return
-            else:
-                last_sent = self.grv[0]  # future events only
+                    emit({"type": "ERROR",
+                          "object": {"kind": "Status", "code": 410,
+                                     "message":
+                                         "too old resource version"}})
+                    finish()
+                except OSError:
+                    pass
+                return
+        else:
+            last_sent = grv_at_request  # future events only
 
         deadline = time.monotonic() + timeout_s
         next_bookmark = time.monotonic() + self.bookmark_interval

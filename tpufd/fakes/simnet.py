@@ -330,19 +330,26 @@ class SimAggregator:
         self.clock.schedule(t + self.lease_s / 3.0,
                             lambda now: self.lease_tick(now))
 
+    def _stage_slo(self, labels):
+        """The stage-slo annotation analogue: the base aggregator has
+        none; the cluster soak overrides this to lift each node's
+        serialized stage sketches off its object (ISSUE 16)."""
+        return ""
+
     def sync(self, t):
         """The initial collection LIST: ONE request regardless of fleet
         size, every item applied through the same incremental path."""
         self.server.count_agg(t, "LIST")
         for node, labels in self.server.objects.items():
-            self.store.apply(node, labels)
+            self.store.apply(node, labels, self._stage_slo(labels))
         self.server.watcher = self
         self.synced = True
         self._note_dirty(t)
 
     def on_event(self, t, node, labels):
         moved = (self.store.remove(node) if labels is None
-                 else self.store.apply(node, labels))
+                 else self.store.apply(node, labels,
+                                       self._stage_slo(labels)))
         if moved:
             self.pending_changes.append(t)
             self._note_dirty(t)

@@ -96,8 +96,14 @@ class Histogram:
         self.overflow = 0
         self.sum = 0.0
         self.count = 0
+        # Last exemplar per bucket (trailing slot = +Inf): (labels, v)
+        # — mirrors the C++ Histogram's exemplar store.
+        self.exemplars = [None] * (len(bounds) + 1)
 
-    def observe(self, v):
+    def observe(self, v, exemplar=None):
+        """`exemplar` (a labels dict, e.g. {"change_id": "42"}) is
+        remembered for the bucket `v` lands in (last write wins) and
+        rendered as an OpenMetrics exemplar after that bucket line."""
         v = float(v)
         if math.isnan(v):  # would poison _sum forever, cannot be bucketed
             return
@@ -107,8 +113,11 @@ class Histogram:
                 break
         else:
             self.overflow += 1
+            i = len(self.bounds)
         self.sum += v
         self.count += 1
+        if exemplar is not None:
+            self.exemplars[i] = (dict(exemplar), v)
 
 
 class Registry:
@@ -193,17 +202,32 @@ class Registry:
                         # validate_exposition itself rejects.
                         counts = list(child.counts)
                         total = sum(counts) + child.overflow
+
+                        def _exemplar_suffix(i, child=child):
+                            entry = child.exemplars[i]
+                            if entry is None:
+                                return ""
+                            ex_labels, ex_value = entry
+                            rendered = ",".join(
+                                f'{_sanitize_name(k, label=True)}='
+                                f'"{_escape_label_value(v)}"'
+                                for k, v in ex_labels.items())
+                            return (f" # {{{rendered}}} "
+                                    f"{_format_value(ex_value)}")
+
                         cumulative = 0
-                        for bound, n in zip(child.bounds, counts):
+                        for i, (bound, n) in enumerate(
+                                zip(child.bounds, counts)):
                             cumulative += n
                             le = _format_value(bound)
                             sep = "," if labels else ""
                             out.append(
                                 f'{name}_bucket{{{labels}{sep}le="{le}"}} '
-                                f"{cumulative}")
+                                f"{cumulative}{_exemplar_suffix(i)}")
                         sep = "," if labels else ""
                         out.append(f'{name}_bucket{{{labels}{sep}le="+Inf"}} '
-                                   f"{total}")
+                                   f"{total}"
+                                   f"{_exemplar_suffix(len(child.bounds))}")
                         suffix = f"{{{labels}}}" if labels else ""
                         out.append(f"{name}_sum{suffix} "
                                    f"{_format_value(child.sum)}")
@@ -240,6 +264,15 @@ _SAMPLE_RE = re.compile(
     r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"          # metric name
     r"(?:\{(.*)\})?"                        # optional label set
     r" (NaN|[+-]Inf|[0-9eE.+-]+)$")         # value (no timestamp)
+# OpenMetrics exemplar form: `name{labels} value # {ex_labels} ex_value`.
+# Tried only after the plain grammar fails, so a pathological " # "
+# INSIDE a quoted label value still parses as a plain sample (the
+# greedy label group swallows it) rather than a bogus exemplar.
+_EXEMPLAR_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(.*)\})?"
+    r" (NaN|[+-]Inf|[0-9eE.+-]+)"
+    r" # \{(.*)\} (NaN|[+-]Inf|[0-9eE.+-]+)$")
 _LABEL_RE = re.compile(
     r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"(?:,|$)')
 
@@ -254,42 +287,79 @@ def _parse_value(text):
     return float(text)
 
 
-def parse_samples(text):
-    """Yields (name, labels-dict, value) for every sample line. Raises
-    ValueError on lines that match neither the sample nor the comment
-    grammar — the strict subset this repo emits (no timestamps)."""
+def _parse_label_text(label_text, line):
+    """The contiguous `_LABEL_RE` scan shared by the sample label set
+    and the exemplar label set — both obey the same grammar."""
+    labels = {}
+    if not label_text:
+        return labels
+    consumed = 0
+    for lm in _LABEL_RE.finditer(label_text):
+        # Matches must be CONTIGUOUS from the start: an end-only
+        # check would silently drop junk-prefixed or
+        # space-separated labels ('a="1" ,b="2"') instead of
+        # rejecting the line like the C++ checker does.
+        if lm.start() != consumed:
+            raise ValueError(
+                f"unparseable label set in: {line!r}")
+        key, value = lm.group(1), lm.group(2)
+        if key in labels:
+            raise ValueError(f"duplicate label {key!r} in: {line!r}")
+        # Single-pass unescape: sequential str.replace would eat
+        # a literal backslash before 'n' (writer emits a\\nb for
+        # the value a\nb; \\n-first would mis-decode it).
+        labels[key] = re.sub(
+            r"\\(.)",
+            lambda m: "\n" if m.group(1) == "n" else m.group(1),
+            value)
+        consumed = lm.end()
+    if consumed != len(label_text):
+        raise ValueError(f"unparseable label set in: {line!r}")
+    return labels
+
+
+def parse_samples_ex(text):
+    """Yields (name, labels-dict, value, exemplar) for every sample
+    line, where exemplar is None or an (labels-dict, value) pair.
+    Raises ValueError on lines that match neither the sample nor the
+    comment grammar — the strict subset this repo emits (no
+    timestamps, no exemplar timestamps)."""
     for line in text.splitlines():
         if not line or line.startswith("#"):
             continue
+        # Plain grammar first, exemplar grammar as fallback. The plain
+        # regex's greedy label group also matches exemplar lines (the
+        # label text then holds `} value # {...` junk and fails the
+        # contiguity scan), so a failed LABEL parse — not just a failed
+        # line match — retries as an exemplar line. A genuine " # "
+        # inside a quoted label value parses cleanly the first time
+        # and never reaches the fallback, matching the C++ scanner.
         match = _SAMPLE_RE.match(line)
+        if match:
+            name, label_text, value_text = match.groups()
+            try:
+                labels = _parse_label_text(label_text, line)
+            except ValueError:
+                match = None
+            else:
+                yield name, labels, _parse_value(value_text), None
+                continue
+        match = _EXEMPLAR_SAMPLE_RE.match(line)
         if not match:
             raise ValueError(f"unparseable sample line: {line!r}")
-        name, label_text, value_text = match.groups()
-        labels = {}
-        if label_text:
-            consumed = 0
-            for lm in _LABEL_RE.finditer(label_text):
-                # Matches must be CONTIGUOUS from the start: an end-only
-                # check would silently drop junk-prefixed or
-                # space-separated labels ('a="1" ,b="2"') instead of
-                # rejecting the line like the C++ checker does.
-                if lm.start() != consumed:
-                    raise ValueError(
-                        f"unparseable label set in: {line!r}")
-                key, value = lm.group(1), lm.group(2)
-                if key in labels:
-                    raise ValueError(f"duplicate label {key!r} in: {line!r}")
-                # Single-pass unescape: sequential str.replace would eat
-                # a literal backslash before 'n' (writer emits a\\nb for
-                # the value a\nb; \\n-first would mis-decode it).
-                labels[key] = re.sub(
-                    r"\\(.)",
-                    lambda m: "\n" if m.group(1) == "n" else m.group(1),
-                    value)
-                consumed = lm.end()
-            if consumed != len(label_text):
-                raise ValueError(f"unparseable label set in: {line!r}")
-        yield name, labels, _parse_value(value_text)
+        name, label_text, value_text, ex_text, ex_value = match.groups()
+        exemplar = (_parse_label_text(ex_text, line),
+                    _parse_value(ex_value))
+        yield (name, _parse_label_text(label_text, line),
+               _parse_value(value_text), exemplar)
+
+
+def parse_samples(text):
+    """Yields (name, labels-dict, value) for every sample line —
+    exemplar-blind view of :func:`parse_samples_ex` for callers that
+    only read values."""
+    for name, labels, value, _ in parse_samples_ex(text):
+        yield name, labels, value
 
 
 def sample_value(text, name, labels=None):
@@ -333,7 +403,7 @@ def validate_exposition(text):
     last_le = {}
     inf_bucket = {}
     counts = {}
-    for name, labels, value in parse_samples(text):
+    for name, labels, value, exemplar in parse_samples_ex(text):
         # Exact-named family wins (a counter legitimately called
         # h_bucket is its own family); only then does a histogram
         # series suffix attribute to its base. The registries rename
@@ -348,6 +418,20 @@ def validate_exposition(text):
                     break
         if family not in types:
             raise ValueError(f"sample for undeclared family: {name}")
+        if exemplar is not None:
+            # OpenMetrics placement rule: exemplars ride counter and
+            # histogram-bucket lines ONLY (mirrors the C++ checker).
+            bucket_line = (types[family] == "histogram"
+                           and name == family + "_bucket")
+            if not bucket_line and types[family] != "counter":
+                raise ValueError(
+                    f"exemplar on a non-counter/non-bucket line: {name}")
+            ex_labels, _ = exemplar
+            budget = sum(len(k) + len(v) for k, v in ex_labels.items())
+            if budget > 128:
+                raise ValueError(
+                    f"exemplar labels exceed the 128-rune budget "
+                    f"({budget}) on: {name}")
         if types[family] == "counter" and value < 0:
             raise ValueError(f"negative counter: {name} {value}")
         if types[family] == "histogram" and name == family + "_bucket":

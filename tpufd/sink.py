@@ -51,6 +51,10 @@ APPLY_FIELD_MANAGER = "tfd"
 # an ANNOTATION, never a spec.label, so scheduler eligibility is
 # untouched while the CR stays joinable to the writer's /debug/trace.
 CHANGE_ANNOTATION = "tfd.google.com/change-id"
+# The stage-SLO sketch annotation key (obs/slo.h kSloAnnotation): the
+# node's serialized windowed latency sketches, same annotation-not-label
+# rule — latency digests must never become eligibility input.
+SLO_ANNOTATION = "tfd.google.com/stage-slo"
 
 
 # ---- desync math (k8s/desync.cc) -----------------------------------------
@@ -105,15 +109,18 @@ def spread_retry_after_s(retry_after_s, node):
 # ---- merge patch (k8s/client.cc BuildMergePatch) -------------------------
 
 def build_merge_patch(acked, desired, node_name, fix_node_name,
-                      resource_version, change_annotation=""):
+                      resource_version, change_annotation="",
+                      slo_annotation=""):
     """The JSON merge patch that turns `acked` into `desired`, as the
     C++ client serializes it (same key order: changed/added keys in
     sorted order, then removals). Returns None when there is nothing to
     patch, else the patch dict (json.dumps(..., separators=(",", ":"))
     reproduces the C++ byte stream for ASCII labels). A non-empty
-    `change_annotation` (the causal change-id, obs/trace.h) rides as
-    metadata.annotations[CHANGE_ANNOTATION] — merge-patch semantics set
-    just that key, leaving foreign annotations alone."""
+    `change_annotation` (the causal change-id, obs/trace.h) and a
+    non-empty `slo_annotation` (the serialized stage sketches,
+    obs/slo.h) ride as metadata.annotations, change-id first —
+    merge-patch semantics set just those keys, leaving foreign
+    annotations alone."""
     spec = {}
     for key in sorted(desired):
         if acked.get(key) != desired[key]:
@@ -129,8 +136,13 @@ def build_merge_patch(acked, desired, node_name, fix_node_name,
         meta["resourceVersion"] = resource_version
     if fix_node_name:
         meta["labels"] = {NODE_NAME_LABEL: node_name}
+    annotations = {}
     if change_annotation:
-        meta["annotations"] = {CHANGE_ANNOTATION: change_annotation}
+        annotations[CHANGE_ANNOTATION] = change_annotation
+    if slo_annotation:
+        annotations[SLO_ANNOTATION] = slo_annotation
+    if annotations:
+        meta["annotations"] = annotations
     if meta:
         patch["metadata"] = meta
     patch["spec"] = {"labels": spec}
@@ -156,8 +168,8 @@ def parse_watch_event(line):
     rules the C++ client applies, pinned by the parity grid in
     tests/test_fleet.py."""
     out = {"type": "unknown", "name": "", "resource_version": "",
-           "change": "", "has_labels": False, "labels": {},
-           "error_code": 0}
+           "change": "", "stage_slo": "", "has_labels": False,
+           "labels": {}, "error_code": 0}
     try:
         doc = json.loads(line)
     except (ValueError, TypeError):
@@ -185,6 +197,9 @@ def parse_watch_event(line):
         change = annotations.get(CHANGE_ANNOTATION)
         if isinstance(change, str):
             out["change"] = change
+        slo = annotations.get(SLO_ANNOTATION)
+        if isinstance(slo, str):
+            out["stage_slo"] = slo
     if out["type"] == "error":
         code = obj.get("code")
         if isinstance(code, (int, float)):
@@ -198,13 +213,16 @@ def parse_watch_event(line):
     return out
 
 
-def build_apply_body(namespace, node, labels, change_annotation=""):
+def build_apply_body(namespace, node, labels, change_annotation="",
+                     slo_annotation=""):
     """The server-side-apply body (k8s/client.cc CrBody): the FULL
     desired object — JSON is valid YAML, which is why the wire
     content-type can be application/apply-patch+yaml. A non-empty
     `change_annotation` rides as the CHANGE_ANNOTATION metadata
-    annotation (the causal-trace join key)."""
-    return _full_body(namespace, node, labels, change_annotation)
+    annotation (the causal-trace join key), a non-empty
+    `slo_annotation` as SLO_ANNOTATION (the stage sketches)."""
+    return _full_body(namespace, node, labels, change_annotation,
+                      slo_annotation)
 
 
 # ---- circuit breaker twin (k8s/breaker.{h,cc}) ---------------------------
@@ -300,14 +318,20 @@ def _cr_name(node):
     return f"tfd-features-for-{node}"
 
 
-def _full_body(namespace, node, labels, change_annotation=""):
+def _full_body(namespace, node, labels, change_annotation="",
+               slo_annotation=""):
     metadata = {
         "name": _cr_name(node),
         "namespace": namespace,
         "labels": {NODE_NAME_LABEL: node},
     }
+    annotations = {}
     if change_annotation:
-        metadata["annotations"] = {CHANGE_ANNOTATION: change_annotation}
+        annotations[CHANGE_ANNOTATION] = change_annotation
+    if slo_annotation:
+        annotations[SLO_ANNOTATION] = slo_annotation
+    if annotations:
+        metadata["annotations"] = annotations
     return {
         "apiVersion": "nfd.k8s-sigs.io/v1alpha1",
         "kind": "NodeFeature",

@@ -94,7 +94,10 @@ class TraceRecorder:
         """Publish-acks every active record under `generation` —
         bounded by `through_change` (C++ parity: a change minted
         concurrently with the publishing pass was not in its content
-        and stays active; None retires everything)."""
+        and stays active; None retires everything). Returns the records
+        retired by THIS call (terminal stamp included), like the C++
+        MarkPublished — the caller folds them into the SLO sketches."""
+        retired = []
         for record in self.records:
             if record["published"]:
                 continue
@@ -104,6 +107,8 @@ class TraceRecorder:
             record["published"] = True
             record["generation"] = generation
             record["stages"].append((PUBLISH_ACKED, now))
+            retired.append(record)
+        return retired
 
     def latest_active_change(self):
         latest = 0
@@ -164,6 +169,119 @@ class TraceRecorder:
                         _quote(str(r["generation"]))))
         return ("{\"displayTimeUnit\":\"ms\",\"traceEvents\":[%s]}"
                 % ",".join(events))
+
+
+def stage_durations_ms(record):
+    """C++ obs/slo.h StageDurationsMs twin: per-stage durations (ms) of
+    one closed trace record, sliced by the RenderChromeTrace interval
+    rule (previous stamp -> stage stamp, minted_ts first, clamped at 0
+    against clock steps). "govern" folds into "render"; stages outside
+    the SLO vocabulary (tpufd.agg.SLO_STAGES) are dropped."""
+    from tpufd.agg import SLO_STAGES
+
+    out = {}
+    prev = record["minted_ts"]
+    for stage, ts in record["stages"]:
+        end = max(ts, prev)
+        ms = (end - prev) * 1000.0
+        prev = end
+        if stage == "govern":
+            out["render"] = out.get("render", 0.0) + ms
+        elif stage in SLO_STAGES:
+            out[stage] = out.get(stage, 0.0) + ms
+    return out
+
+
+class StageSlo:
+    """C++ obs/slo.h StageSlo twin: windowed per-stage latency sketches
+    — each closed change folds its stage durations (ms) into one
+    removable sketch per stage, retire-oldest past `window_s`, so the
+    view is the last N minutes, not since boot. render_json is
+    byte-parity-pinned against the C++ RenderJson."""
+
+    DEFAULT_WINDOW_S = 600
+
+    def __init__(self, window_s=DEFAULT_WINDOW_S):
+        self.window_s = max(1, window_s)
+        self.samples = []   # (ts, [(stage, ms)])
+        self.sketches = {}  # stage -> tpufd.agg.Sketch
+        self.folded = 0
+        self.retired = 0
+        self.last_change = 0
+
+    def _expire(self, now):
+        while self.samples and self.samples[0][0] <= now - self.window_s:
+            _, stages = self.samples.pop(0)
+            for stage, ms in stages:
+                sketch = self.sketches.get(stage)
+                if sketch is None:
+                    continue
+                sketch.remove(ms)
+                if sketch.total <= 0:
+                    del self.sketches[stage]
+            self.retired += 1
+
+    def fold(self, change, stage_ms, now):
+        from tpufd.agg import SLO_STAGES, Sketch
+
+        stages = []
+        for name in SLO_STAGES:
+            if name not in stage_ms:
+                continue
+            self.sketches.setdefault(name, Sketch()).add(stage_ms[name])
+            stages.append((name, stage_ms[name]))
+        if stages:
+            self.samples.append((now, stages))
+            self.folded += 1
+            self.last_change = max(self.last_change, change)
+        self._expire(now)
+
+    def expire(self, now):
+        self._expire(now)
+
+    def serialize(self):
+        from tpufd.agg import serialize_stage_sketches
+
+        return serialize_stage_sketches(self.sketches)
+
+    def render_json(self):
+        """The /debug/slo document, byte-identical to the C++
+        RenderJson for the same fold/expire sequence."""
+        from tpufd.agg import SLO_STAGES, fixed3
+
+        parts = []
+        for name in SLO_STAGES:
+            sketch = self.sketches.get(name)
+            if sketch is None or sketch.total <= 0:
+                continue
+            parts.append(
+                "%s:{\"count\":%d,\"p50_ms\":%s,\"p99_ms\":%s}" % (
+                    _quote(name), sketch.total,
+                    fixed3(sketch.quantile(0.50)),
+                    fixed3(sketch.quantile(0.99))))
+        return ("{\"window_s\":%d,\"samples\":%d,\"folded_total\":%d,"
+                "\"retired_total\":%d,\"last_change\":%d,\"stages\":{%s},"
+                "\"serialized\":%s}" % (
+                    self.window_s, len(self.samples), self.folded,
+                    self.retired, self.last_change, ",".join(parts),
+                    _quote(self.serialize())))
+
+
+def parse_slo(text):
+    """Parses a /debug/slo (or SIGUSR1-dump ``slo``) document; raises
+    ValueError when the schema is off — the harness-side mirror of
+    :func:`parse_trace`."""
+    doc = json.loads(text) if isinstance(text, (str, bytes)) else text
+    for key in ("window_s", "samples", "folded_total", "retired_total",
+                "last_change", "stages", "serialized"):
+        if key not in doc:
+            raise ValueError(f"slo document missing {key!r}")
+    for stage, entry in doc["stages"].items():
+        for key in ("count", "p50_ms", "p99_ms"):
+            if key not in entry:
+                raise ValueError(
+                    f"slo stage {stage!r} missing {key!r}: {entry}")
+    return doc
 
 
 def parse_trace(text):

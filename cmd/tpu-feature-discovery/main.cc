@@ -30,6 +30,7 @@
 #include <vector>
 
 #include "tfd/agg/runner.h"
+#include "tfd/placement/placement.h"
 #include "tfd/config/config.h"
 #include "tfd/fault/fault.h"
 #include "tfd/gce/metadata.h"
@@ -2465,6 +2466,22 @@ int Main(int argc, char** argv) {
         case agg::AggOutcome::kRestart:
           continue;
         case agg::AggOutcome::kError:
+          return 1;
+      }
+    }
+
+    // Placement query-service mode (placement/placement.h): an
+    // informer-fed candidate index over the NodeFeature collection
+    // answering POST /v1/placements with zero apiserver reads per
+    // query. Same restart-on-SIGHUP discipline as the aggregator.
+    if (loaded.config.flags.mode == "placement") {
+      switch (placement::RunPlacement(loaded.config, sigmask)) {
+        case placement::PlacementOutcome::kExit:
+          TFD_LOG_INFO << "exiting";
+          return 0;
+        case placement::PlacementOutcome::kRestart:
+          continue;
+        case placement::PlacementOutcome::kError:
           return 1;
       }
     }

@@ -48,6 +48,15 @@ top so the per-mode functions only state their invariants:
               across two runs of one seed (the determinism pin), and
               the aggregator genuinely composed in (inventory consumed,
               zero full recomputes).
+  --shard     (ISSUE 17) sharded-tree + placement soak record
+              (cluster_soak.py --placement-qps > 0): N-shard merged
+              inventory byte-identical to the flat oracle (incl. after
+              a shard retire/re-admit drill), inventory staleness p99
+              <= 1s at 100k nodes, measured >= 1000 correct placements
+              per second with ZERO wrong answers after the convergence
+              window and zero sampled exact-parity misses, zero full
+              recomputes on every tier, staleness p99 vs
+              BENCH_shard.json.
   --slo       (ISSUE 16) the fleet-SLO section of a cluster-soak
               record: the injected latency regression asserts a
               multi-window burn in the fast window and clears after the
@@ -73,6 +82,7 @@ Usage:
   python3 scripts/bench_gate.py --aggregate aggregate-soak.json
   python3 scripts/bench_gate.py --cluster cluster-soak.json
   python3 scripts/bench_gate.py --slo cluster-soak.json
+  python3 scripts/bench_gate.py --shard BENCH_shard.json
 """
 
 import argparse
@@ -810,6 +820,103 @@ def slo_gate(record_path):
     return problems
 
 
+def shard_gate(record_path, reference_path, slack,
+               staleness_budget_s, qps_floor):
+    """Gates a sharded-tree + placement soak record
+    (scripts/cluster_soak.py --placement-qps > 0): the ISSUE 17
+    acceptance bounds at 100k-node scale."""
+    problems = []
+    record = load_record(record_path, "shard", problems)
+    if record is None:
+        return problems
+
+    if record.get("mode") != "shard":
+        problems.append(
+            f"record mode {record.get('mode')!r} is not 'shard' — gate "
+            "a record from cluster_soak.py --placement-qps > 0")
+    nodes = require(record, "nodes", "shard", problems)
+    if nodes is not None and nodes < 100000:
+        problems.append(
+            f"record covers {nodes} nodes — the acceptance scale is "
+            "100k (regenerate without --quick)")
+    shards = require(record, "shards", "shard", problems)
+    if shards is not None and shards < 2:
+        problems.append(f"{shards} L1 shard(s) is not a tree")
+
+    # The tree's whole claim: N-shard merge == flat, byte-identical,
+    # including after the retire/re-admit drill, with every tier
+    # staying O(delta).
+    if not record.get("merged_equals_flat"):
+        problems.append("merged root state != flat oracle at "
+                        "quiescence — the tree is not byte-compatible")
+    if not record.get("published_equals_flat"):
+        problems.append("last PUBLISHED inventory != flat oracle — a "
+                        "trailing delta never flushed")
+    if record.get("shard_restart_drill") is None:
+        problems.append("the shard retire/re-admit drill never ran")
+    recomputes = require(record, "full_recomputes", "shard", problems)
+    for tier, count in sorted((recomputes or {}).items()):
+        if count != 0:
+            problems.append(
+                f"{count} full recomputes on tier {tier} — every tier "
+                "must stay O(delta)")
+
+    # Sub-second inventory: churn -> merged publish.
+    staleness = require(record, "inventory_staleness_p99_s", "shard",
+                        problems)
+    if staleness is not None and staleness > staleness_budget_s:
+        problems.append(
+            f"inventory staleness p99 {staleness}s exceeds the "
+            f"{staleness_budget_s}s budget")
+    if record.get("staleness_n", 0) == 0:
+        problems.append("no staleness samples — churn never crossed "
+                        "the tree")
+
+    # Placement correctness: zero wrong answers after the convergence
+    # window, zero sampled exact-parity misses.
+    wrong = require(record, "incorrect_after_window", "shard", problems)
+    if wrong:
+        problems.append(
+            f"{wrong} placement answer(s) wrong after the convergence "
+            f"window (e.g. {record.get('violations', [])[:3]})")
+    misses = require(record, "parity_mismatches", "shard", problems)
+    if misses:
+        problems.append(
+            f"{misses} sampled exact-parity mismatch(es) — the index "
+            "diverged from the ground-truth sweep")
+    if record.get("parity_samples", 0) == 0:
+        problems.append("the exact-parity sampler never fired")
+
+    # The measured serving rate (real wall clock around the query
+    # calls): an absolute floor, NOT reference-regressed — wall numbers
+    # vary with the CI box, and 1000/s has orders of magnitude of
+    # headroom over the measured rate.
+    measured = record.get("measured") or {}
+    rate = measured.get("placements_per_sec_served_correctly")
+    if rate is None:
+        problems.append("shard record has no measured "
+                        "placements_per_sec_served_correctly")
+    elif rate < qps_floor:
+        problems.append(
+            f"measured correct-placement rate {rate}/s is below the "
+            f"{qps_floor}/s acceptance floor")
+
+    if record.get("determinism_ok") is False:
+        problems.append("determinism pin failed — two runs of one seed "
+                        "diverged")
+
+    # Reference regression: only the virtual-clock staleness number
+    # (deterministic given the model; slack absorbs intentional
+    # debounce/topology changes).
+    ref = load_reference(reference_path, "shard", problems)
+    if ref is not None:
+        gate_regressions(
+            record, ref,
+            [("inventory_staleness_p99_s", "inventory staleness p99")],
+            slack, problems)
+    return problems
+
+
 def reference_dirty_p50_ms(path):
     """steady_dirty_p50_ms from a committed bench record (either the
     bare record or the driver's {parsed: ...} wrapper)."""
@@ -884,6 +991,16 @@ def main(argv=None):
                     default=8000.0)
     ap.add_argument("--cluster-recovery-budget-s", type=float,
                     default=10.0)
+    ap.add_argument("--shard", metavar="RECORD.json",
+                    help="gate this sharded-tree + placement soak "
+                         "record (scripts/cluster_soak.py "
+                         "--placement-qps > 0 --json)")
+    ap.add_argument("--shard-reference",
+                    default=os.path.join(repo, "BENCH_shard.json"))
+    ap.add_argument("--shard-slack", type=float, default=0.5)
+    ap.add_argument("--shard-staleness-budget-s", type=float,
+                    default=1.0)
+    ap.add_argument("--shard-qps-floor", type=float, default=1000.0)
     ap.add_argument("--slo", metavar="RECORD.json",
                     help="gate the fleet-SLO section of a cluster-soak "
                          "record: burn timing vs the injected latency "
@@ -942,6 +1059,11 @@ def main(argv=None):
 
     if args.slo:
         return run_mode("slo", slo_gate(args.slo))
+
+    if args.shard:
+        return run_mode("shard", shard_gate(
+            args.shard, args.shard_reference, args.shard_slack,
+            args.shard_staleness_budget_s, args.shard_qps_floor))
 
     if args.watch:
         return run_mode("watch", watch_gate(

@@ -50,9 +50,25 @@ byte-identically (no wall clock, no ambient randomness, sorted
 iteration everywhere); bench_gate.py --cluster gates the committed
 BENCH_cluster.json on all of the above.
 
+A SECOND mode (ISSUE 17) rides the same virtual clock: `--shards N
+--placement-qps Q` runs the sharded aggregation tree + placement query
+service at fleet scale (default 100k nodes) — N L1 InventoryStore twins
+each owning 1/N of the fleet by name hash, publishing partial rollups
+over the pinned wire format; one L2 ShardMergeStore root merging them
+O(delta) into the cluster inventory; and the tpufd.placement index fed
+by the same label stream answering a seeded query mix. It measures
+inventory staleness (churn -> merged root publish), per-tier flush QPS,
+and REAL placements/sec served correctly (wall clock around the query
+calls only — everything else stays virtual), proves the merged root
+byte-identical to a flat single-store oracle through a shard
+retire/re-admit drill, and double-runs the seed for byte determinism.
+bench_gate --shard gates the committed BENCH_shard.json.
+
 Usage:
   python3 scripts/cluster_soak.py [--slices 12] [--hosts 4] [--seed 14]
       [--json out] [--quick] [--schedule FILE] [--once]
+  python3 scripts/cluster_soak.py --shards 8 --placement-qps 2000
+      [--nodes 100000] [--churn-rate 200] [--json out] [--quick]
 """
 
 import argparse
@@ -61,12 +77,14 @@ import json
 import os
 import random
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
 
 from tpufd import agg as agglib  # noqa: E402
 from tpufd import cluster as clusterlib  # noqa: E402
+from tpufd import placement as placementlib  # noqa: E402
 from tpufd import sink as sinklib  # noqa: E402
 from tpufd.fakes.simnet import (  # noqa: E402
     SimAggregator, SimClock, percentile)
@@ -1401,6 +1419,566 @@ def check_slo(slo):
     return problems
 
 
+# ---- the sharded aggregation tree + placement soak (ISSUE 17) -------------
+
+# Tier debounces sized so churn -> merged-root-publish stays sub-second
+# even when a change lands at the very start of BOTH windows:
+# L1 0.4s + wire + root 0.4s + wire < 1s.
+SHARD_L1_DEBOUNCE_S = 0.4
+SHARD_ROOT_DEBOUNCE_S = 0.4
+# A placement answer touching a node (or its slice) whose ground truth
+# changed this recently is excused, not gated: the informer feed is
+# physics (wire + apply), not a correctness bug.
+SHARD_CONVERGE_S = 1.0
+# Every Nth query additionally pays the O(nodes) exact scan: the
+# answer's WINNER must match an independent SimScheduler-style sweep
+# over the ground-truth label surface.
+SHARD_PARITY_EVERY = 2000
+SHARD_SLICE_HOSTS = 8     # nodes per slice id in the synthetic fleet
+SHARD_WIRE = (0.0005, 0.003)
+
+
+def shard_node_labels(rng, i):
+    """One node's published labels: every rollup dimension the tree
+    must carry (classes, chips, slices, degraded claims, preemption,
+    multislice, perf sketches)."""
+    labels = {
+        agglib.TPU_COUNT: str([4, 8, 16][i % 3]),
+        agglib.PERF_CLASS:
+            ["gold", "gold", "silver", "silver", "degraded", ""][i % 6],
+        agglib.SLICE_ID: f"slice-{i // SHARD_SLICE_HOSTS:06d}",
+        agglib.SLICE_DEGRADED: "true" if i % 97 == 0 else "false",
+        agglib.PERF_MATMUL: agglib.fixed3(rng.uniform(60.0, 200.0)),
+        agglib.PERF_HBM: agglib.fixed3(rng.uniform(250.0, 900.0)),
+    }
+    if i % 83 == 0:
+        labels[agglib.LIFECYCLE_PREEMPT] = "true"
+    if i % 12 == 0:
+        labels[agglib.MULTISLICE_SLICE_ID] = str(i % 4)
+    return labels
+
+
+class ShardTreeSim:
+    """The tree on one virtual clock: N L1 InventoryStore twins ->
+    partial wire -> one ShardMergeStore root -> inventory delivery,
+    next to a flat single-store oracle fed the identical stream, and
+    the tpufd.placement index the query stream runs against. The ONLY
+    wall clock in the soak wraps the placement query calls (the
+    measured serving rate); everything else is virtual and seeded."""
+
+    def __init__(self, args, rng, clock):
+        self.args = args
+        self.rng = rng
+        self.clock = clock
+        self.shards = args.shards
+        self.labels = {}            # ground truth == published surface
+        self.stage_slo = {}         # node -> pinned stage-slo payload
+        self.flat = agglib.InventoryStore()
+        self.l1 = [agglib.InventoryStore() for _ in range(self.shards)]
+        self.l1_flush = [agglib.FlushController(SHARD_L1_DEBOUNCE_S)
+                         for _ in range(self.shards)]
+        self.l1_flush_scheduled = [False] * self.shards
+        self.l1_flushes = [0] * self.shards
+        self.l1_pending = [[] for _ in range(self.shards)]  # change ts
+        self.root = agglib.ShardMergeStore()
+        self.root_flush = agglib.FlushController(SHARD_ROOT_DEBOUNCE_S)
+        self.root_flush_scheduled = False
+        self.root_flushes = 0
+        self.root_pending = []      # change ts merged, awaiting publish
+        self.root_published = None  # last published inventory labels
+        self.partial_bytes_max = 0
+        self.index = placementlib.PlacementIndex()
+        self.inventory_delivered = 0
+        self.last_inventory = {}    # what the exact checker admits from
+        # ground-truth slice claims for O(1) answer scoring
+        self.gt_claims = {}
+        self.gt_blocked = set()
+        self.node_changed_at = {}
+        self.slice_changed_at = {}
+        # scoring
+        self.staleness_s = []
+        self.queries = {"placed": 0, "no-candidate": 0, "no-capacity": 0}
+        self.incorrect_after = 0
+        self.incorrect_within = 0
+        self.violations = []
+        self.parity_samples = 0
+        self.parity_mismatches = 0
+        self.query_seq = 0
+        self.query_wall_s = 0.0
+        self.queries_correct = 0
+        self.restart_drill = None
+
+    def _wire(self):
+        return self.rng.uniform(*SHARD_WIRE)
+
+    # ---- ground-truth slice claims (worst-of-members, O(1)) ---------------
+
+    def _claim(self, labels):
+        return (labels.get(agglib.SLICE_DEGRADED) == "true" or
+                labels.get(placementlib.SLICE_CLASS) == "degraded")
+
+    def _track_claims(self, node, old, new, now):
+        for labels, delta in ((old, -1), (new, +1)):
+            if labels is None or not self._claim(labels):
+                continue
+            sid = labels.get(agglib.SLICE_ID, "")
+            if not sid:
+                continue
+            count = self.gt_claims.get(sid, 0) + delta
+            if count <= 0:
+                self.gt_claims.pop(sid, None)
+                self.gt_blocked.discard(sid)
+            else:
+                self.gt_claims[sid] = count
+                self.gt_blocked.add(sid)
+            self.slice_changed_at[sid] = now
+
+    # ---- the label stream -------------------------------------------------
+
+    def bootstrap(self):
+        """Seed the whole fleet at t=0 into every tier (bootstrap
+        staleness is not tracked — the gated metric is steady-state
+        churn -> merged publish)."""
+        for i in range(self.args.nodes):
+            node = f"tpu-node-{i:06d}"
+            labels = shard_node_labels(self.rng, i)
+            slo = ""
+            if i % 1000 == 0:
+                hot = agglib.Sketch()
+                hot.add(12.0 + (i % 7) * 3.0)
+                hot.add(900.0)
+                slo = agglib.serialize_stage_sketches({"publish": hot})
+                self.stage_slo[node] = slo
+            self.labels[node] = labels
+            self._track_claims(node, None, labels, 0.0)
+            self.flat.apply(node, labels, stage_slo=slo)
+            shard = agglib.shard_index_of(node, self.shards)
+            self.l1[shard].apply(node, labels, stage_slo=slo)
+            self.index.apply_node(node, labels)
+            self._note_l1_dirty(shard, 0.0)
+        self.slice_changed_at = {}
+        self.node_changed_at = {}
+
+    def churn(self, now):
+        i = self.rng.randrange(self.args.nodes)
+        node = f"tpu-node-{i:06d}"
+        old = self.labels[node]
+        new = dict(old)
+        roll = self.rng.random()
+        if roll < 0.35:
+            new[agglib.PERF_CLASS] = self.rng.choice(
+                ["gold", "silver", "degraded"])
+        elif roll < 0.55:
+            new[agglib.SLICE_DEGRADED] = \
+                "false" if old.get(agglib.SLICE_DEGRADED) == "true" \
+                else "true"
+        elif roll < 0.70:
+            if agglib.LIFECYCLE_PREEMPT in new:
+                del new[agglib.LIFECYCLE_PREEMPT]
+            else:
+                new[agglib.LIFECYCLE_PREEMPT] = "true"
+        elif roll < 0.90:
+            new[agglib.PERF_MATMUL] = agglib.fixed3(
+                self.rng.uniform(60.0, 200.0))
+        else:
+            new[agglib.TPU_COUNT] = self.rng.choice(["4", "8", "16"])
+        self.labels[node] = new
+        self._track_claims(node, old, new, now)
+        self.node_changed_at[node] = now
+        slo = self.stage_slo.get(node, "")
+        self.flat.apply(node, new, stage_slo=slo)
+        shard = agglib.shard_index_of(node, self.shards)
+        if self.l1[shard].apply(node, new, stage_slo=slo):
+            self.l1_pending[shard].append(now)
+            self._note_l1_dirty(shard, now)
+        # The placement informer sees the node event directly (no
+        # aggregation tier on the query path) after wire latency.
+        self.clock.schedule(
+            now + self._wire(),
+            lambda t, n=node, lb=dict(new): self.index.apply_node(n, lb))
+
+    # ---- tier flushes (bounded-staleness debounce per tier) ---------------
+
+    def _note_l1_dirty(self, shard, now):
+        self.l1_flush[shard].note_dirty(now)
+        if not self.l1_flush_scheduled[shard]:
+            self.l1_flush_scheduled[shard] = True
+            self.clock.schedule(self.l1_flush[shard].due_at(),
+                                lambda t, s=shard: self._l1_flush(t, s))
+
+    def _l1_flush(self, now, shard):
+        self.l1_flush_scheduled[shard] = False
+        if not self.l1_flush[shard].dirty:
+            return
+        self.l1_flush[shard].note_flushed()
+        self.l1_flushes[shard] += 1
+        wire = agglib.serialize_partial_labels(
+            self.l1[shard].partial(), f"{shard}/{self.shards}")
+        self.partial_bytes_max = max(
+            self.partial_bytes_max,
+            sum(len(k) + len(v) for k, v in wire.items()))
+        pending, self.l1_pending[shard] = self.l1_pending[shard], []
+        self.clock.schedule(
+            now + self._wire(),
+            lambda t, s=shard, w=wire, p=tuple(pending):
+                self._root_merge(t, s, w, p))
+
+    def _root_merge(self, now, shard, wire, pending):
+        partial = agglib.parse_partial_labels(wire)
+        changed = self.root.apply_partial(shard, partial)
+        self.root_pending.extend(pending)
+        if changed:
+            self.root_flush.note_dirty(now)
+            if not self.root_flush_scheduled:
+                self.root_flush_scheduled = True
+                self.clock.schedule(self.root_flush.due_at(),
+                                    lambda t: self._root_publish(t))
+
+    def _root_publish(self, now):
+        self.root_flush_scheduled = False
+        if not self.root_flush.dirty:
+            return
+        self.root_flush.note_flushed()
+        self.root_flushes += 1
+        self.root_published = self.root.build_output_labels()
+        for changed_at in self.root_pending:
+            self.staleness_s.append(now - changed_at)
+        self.root_pending = []
+        labels = dict(self.root_published)
+        self.clock.schedule(
+            now + self._wire(),
+            lambda t, lb=labels: self._deliver_inventory(lb))
+
+    def _deliver_inventory(self, labels):
+        self.inventory_delivered += 1
+        self.last_inventory = labels
+        self.index.apply_inventory(labels)
+
+    def shard_restart(self, now):
+        """The retire/re-admit drill: the root drops one shard's
+        partial (its lease lapsed) and the L1 republishes — the merged
+        state must converge back to the oracle (the final byte-identity
+        check proves the unmerge really subtracted)."""
+        victim = self.shards // 2
+        self.root.remove_partial(victim)
+        self.root_flush.note_dirty(now)
+        if not self.root_flush_scheduled:
+            self.root_flush_scheduled = True
+            self.clock.schedule(self.root_flush.due_at(),
+                                lambda t: self._root_publish(t))
+        self.restart_drill = {"shard": victim, "t": round(now, 3)}
+        self.clock.schedule(now + 0.5,
+                            lambda t, s=victim: self._readmit(t, s))
+
+    def _readmit(self, now, shard):
+        self.l1_flush[shard].note_dirty(now)
+        self.l1_flush[shard].dirty_since = now  # force a republish
+        if not self.l1_flush_scheduled[shard]:
+            self.l1_flush_scheduled[shard] = True
+            self.clock.schedule(self.l1_flush[shard].due_at(),
+                                lambda t, s=shard: self._l1_flush(t, s))
+
+    # ---- the query stream -------------------------------------------------
+
+    QUERY_MIX = (("any", 1, False), ("any", 4, False), ("gold", 4, False),
+                 ("silver", 8, False), ("any", 8, True), ("gold", 1, False),
+                 ("any", 16, False), ("silver", 4, True))
+
+    def query(self, now):
+        self.query_seq += 1
+        wanted, chips, want_slice = self.QUERY_MIX[
+            self.query_seq % len(self.QUERY_MIX)]
+        t0 = time.perf_counter()
+        answer = self.index.query(wanted=wanted, chips=chips,
+                                  slice=want_slice, limit=1)
+        self.query_wall_s += time.perf_counter() - t0
+        status = answer["status"]
+        self.queries[status] += 1
+        correct = True
+        if status == "placed":
+            correct = self._score_candidate(
+                now, answer["candidates"][0]["node"], wanted, chips,
+                want_slice)
+        if self.query_seq % SHARD_PARITY_EVERY == 0:
+            self._score_parity(now, answer, wanted, chips, want_slice)
+        if correct:
+            self.queries_correct += 1
+
+    def _recent(self, now, node):
+        if now - self.node_changed_at.get(node, -1e9) <= SHARD_CONVERGE_S:
+            return True
+        sid = self.labels.get(node, {}).get(agglib.SLICE_ID, "")
+        return sid and now - self.slice_changed_at.get(sid, -1e9) \
+            <= SHARD_CONVERGE_S
+
+    def _score_candidate(self, now, node, wanted, chips, want_slice):
+        """O(1) validity of a served candidate against ground truth:
+        eligible, class floor, room, slice shape, slice not blocked."""
+        labels = self.labels.get(node)
+        min_rank = placementlib.job_min_rank(wanted)
+        ok = (labels is not None and
+              placementlib.basic_eligible(labels) and
+              placementlib.class_rank(
+                  labels.get(agglib.PERF_CLASS, "")) >= min_rank)
+        if ok:
+            raw = labels.get(agglib.TPU_COUNT, "0")
+            ok = raw.isdigit() and int(raw) >= chips
+        if ok:
+            sid = labels.get(agglib.SLICE_ID, "")
+            if want_slice and not sid:
+                ok = False
+            elif sid and sid in self.gt_blocked:
+                ok = False
+        if ok:
+            return True
+        if self._recent(now, node):
+            self.incorrect_within += 1
+        else:
+            self.incorrect_after += 1
+            if len(self.violations) < 10:
+                self.violations.append(
+                    {"t": round(now, 3), "node": node, "class": wanted,
+                     "chips": chips})
+        return False
+
+    def _score_parity(self, now, answer, wanted, chips, want_slice):
+        """The sampled exact check: an independent SimScheduler-style
+        sweep over the ground-truth surface (cluster.py arithmetic, not
+        the index's rank structures) must pick the same winner."""
+        self.parity_samples += 1
+        min_rank = placementlib.job_min_rank(wanted)
+        admitted = True
+        if self.last_inventory:
+            total = 0
+            for bucket, rank in (("gold", 3), ("silver", 2),
+                                 ("unclassed", 0)):
+                if rank >= min_rank:
+                    raw = self.last_inventory.get(
+                        agglib.CAPACITY_PREFIX + bucket, "0")
+                    total += int(raw) if raw.isdigit() else 0
+            admitted = total >= chips
+        best, best_key = None, None
+        if admitted:
+            for node in sorted(self.labels):
+                labels = self.labels[node]
+                if not clusterlib.node_eligible(labels, min_rank):
+                    continue
+                sid = labels.get(agglib.SLICE_ID, "")
+                if want_slice and not sid:
+                    continue
+                if sid and sid in self.gt_blocked:
+                    continue
+                raw = labels.get(agglib.TPU_COUNT, "0")
+                free = int(raw) if raw.isdigit() else 0
+                if free < chips:
+                    continue
+                key = (-clusterlib.class_rank(labels), -free, node)
+                if best_key is None or key < best_key:
+                    best, best_key = node, key
+        expect = "placed" if best is not None else (
+            "no-candidate" if admitted else "no-capacity")
+        got = answer["status"]
+        got_node = answer["candidates"][0]["node"] \
+            if answer["candidates"] else None
+        if got == expect and (got_node == best or got != "placed"):
+            return
+        # Mismatch: excused only while the involved nodes' ground truth
+        # is inside the convergence window.
+        involved = [n for n in (got_node, best) if n]
+        if (involved and
+                all(self._recent(now, n) for n in involved)) or \
+                (not involved and got != expect and
+                 now - max(list(self.node_changed_at.values()) or [0.0])
+                 <= SHARD_CONVERGE_S):
+            self.incorrect_within += 1
+            return
+        self.parity_mismatches += 1
+        if len(self.violations) < 10:
+            self.violations.append(
+                {"t": round(now, 3), "parity": True, "got": got,
+                 "got_node": got_node, "expect": expect, "best": best})
+
+
+def run_shard_sim(args):
+    rng = random.Random(args.seed)
+    clock = SimClock()
+    sim = ShardTreeSim(args, rng, clock)
+
+    t0 = time.perf_counter()
+    sim.bootstrap()
+    bootstrap_wall_s = time.perf_counter() - t0
+
+    churn_t0, churn_t1 = 5.0, 5.0 + args.churn_secs
+    step = 1.0 / args.churn_rate
+    n = int(args.churn_secs * args.churn_rate)
+    for k in range(n):
+        clock.schedule(churn_t0 + k * step, sim.churn)
+    # The retire/re-admit drill lands mid-churn.
+    clock.schedule(churn_t0 + args.churn_secs * 0.5, sim.shard_restart)
+    # Queries run through the churn window and a calm tail.
+    q_step = 1.0 / args.placement_qps
+    q_n = int((args.churn_secs + 3.0) * args.placement_qps)
+    for k in range(q_n):
+        clock.schedule(churn_t0 + k * q_step, sim.query)
+    # Drain: let both debounce windows flush everything out.
+    t_end = churn_t1 + 5.0
+    clock.run(t_end)
+
+    merged_equals_flat = (
+        sim.root.build_output_labels() == sim.flat.build_output_labels())
+    published_equals_flat = (
+        sim.root_published == sim.flat.build_output_labels())
+    churn_window = max(1e-9, args.churn_secs)
+    record = {
+        "mode": "shard",
+        "seed": args.seed,
+        "nodes": args.nodes,
+        "shards": args.shards,
+        "placement_qps": args.placement_qps,
+        "churn_rate_per_s": args.churn_rate,
+        "churn_secs": args.churn_secs,
+        "l1_debounce_s": SHARD_L1_DEBOUNCE_S,
+        "root_debounce_s": SHARD_ROOT_DEBOUNCE_S,
+        "converge_window_s": SHARD_CONVERGE_S,
+        "churn_events": n,
+        "l1_flushes": {f"shard-{i}": sim.l1_flushes[i]
+                       for i in range(args.shards)},
+        "l1_flush_qps_peak_shard": round(
+            max(sim.l1_flushes) / churn_window, 3),
+        "root_flushes": sim.root_flushes,
+        "root_flush_qps": round(sim.root_flushes / churn_window, 3),
+        "partial_bytes_max": sim.partial_bytes_max,
+        "inventory_updates_delivered": sim.inventory_delivered,
+        "staleness_n": len(sim.staleness_s),
+        "inventory_staleness_p50_s": round(
+            percentile(sim.staleness_s, 50), 4),
+        "inventory_staleness_p99_s": round(
+            percentile(sim.staleness_s, 99), 4),
+        "merged_equals_flat": merged_equals_flat,
+        "published_equals_flat": published_equals_flat,
+        "shard_restart_drill": sim.restart_drill,
+        "full_recomputes": {
+            "flat": sim.flat.full_recomputes,
+            "l1_max": max(s.full_recomputes for s in sim.l1),
+            "root": sim.root.full_recomputes,
+        },
+        "placement_nodes": len(sim.index.nodes),
+        "placement_eligible": sim.index.eligible(),
+        "queries_total": sim.query_seq,
+        "queries_by_status": {k: sim.queries[k]
+                              for k in sorted(sim.queries)},
+        "incorrect_after_window": sim.incorrect_after,
+        "incorrect_within_window": sim.incorrect_within,
+        "parity_samples": sim.parity_samples,
+        "parity_mismatches": sim.parity_mismatches,
+        "violations": sim.violations,
+    }
+    measured = {
+        "bootstrap_wall_s": round(bootstrap_wall_s, 3),
+        "query_wall_s": round(sim.query_wall_s, 4),
+        "queries_correct": sim.queries_correct,
+        "placements_per_sec_served_correctly": round(
+            sim.queries_correct / max(sim.query_wall_s, 1e-9), 1),
+    }
+    return record, measured
+
+
+def check_shard_record(record):
+    """The shard soak's own acceptance invariants (bench_gate --shard
+    re-checks the committed record with the 100k-scale floors on
+    top)."""
+    problems = []
+    if not record["merged_equals_flat"]:
+        problems.append("merged root state != flat single-aggregator "
+                        "oracle at quiescence — the tree is not "
+                        "byte-compatible")
+    if not record["published_equals_flat"]:
+        problems.append("the LAST PUBLISHED inventory != the flat "
+                        "oracle — a trailing delta never flushed")
+    if record["shard_restart_drill"] is None:
+        problems.append("the shard retire/re-admit drill never ran")
+    if record["staleness_n"] == 0:
+        problems.append("no staleness samples — churn never crossed "
+                        "the tree")
+    if record["inventory_staleness_p99_s"] > 1.0:
+        problems.append(
+            f"inventory staleness p99 "
+            f"{record['inventory_staleness_p99_s']}s exceeds the 1s "
+            "sub-second-inventory bound")
+    for tier, count in sorted(record["full_recomputes"].items()):
+        if count != 0:
+            problems.append(f"{count} full recomputes on tier {tier} "
+                            "(every tier must stay O(delta))")
+    if record["queries_total"] == 0:
+        problems.append("the query stream never ran")
+    if record["queries_by_status"]["placed"] == 0:
+        problems.append("no query was ever answered 'placed'")
+    if record["incorrect_after_window"] != 0:
+        problems.append(
+            f"{record['incorrect_after_window']} placement answer(s) "
+            f"wrong AFTER the convergence window "
+            f"(e.g. {record['violations'][:3]})")
+    if record["parity_samples"] == 0:
+        problems.append("the exact-parity sampler never fired")
+    if record["parity_mismatches"] != 0:
+        problems.append(
+            f"{record['parity_mismatches']} sampled exact-parity "
+            "mismatch(es) — the index diverged from the ground-truth "
+            "sweep")
+    # Bounded-staleness coalescing: a shard flushes at most once per
+    # debounce window no matter the churn rate.
+    bound = 1.0 / SHARD_L1_DEBOUNCE_S * 1.25 + 1.0
+    if record["l1_flush_qps_peak_shard"] > bound:
+        problems.append(
+            f"peak per-shard flush QPS "
+            f"{record['l1_flush_qps_peak_shard']} exceeds the "
+            f"debounce coalescing bound {bound:.2f}")
+    return problems
+
+
+def main_shard(args):
+    record, measured = run_shard_sim(args)
+    problems = check_shard_record(record)
+
+    if args.once:
+        record["determinism_ok"] = None
+    else:
+        second, _ = run_shard_sim(args)
+        record["determinism_ok"] = (
+            canonical_bytes(record) == canonical_bytes(second))
+        if not record["determinism_ok"]:
+            problems.append("two runs of the same seed diverged — the "
+                            "sharded tree leaked nondeterminism")
+    # Wall-clock numbers ride OUTSIDE the determinism comparison and
+    # the sha: they are real measurements, not simulation outputs.
+    record["record_sha256"] = hashlib.sha256(
+        canonical_bytes({k: v for k, v in record.items()
+                         if k not in ("determinism_ok",
+                                      "record_sha256")})).hexdigest()
+    record["measured"] = measured
+
+    print(json.dumps(record))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(record, f, indent=1, sort_keys=True)
+    if problems:
+        for p in problems:
+            print(f"shard soak FAILED: {p}", file=sys.stderr)
+        return 1
+    print(
+        f"shard soak OK: {record['nodes']} nodes over "
+        f"{record['shards']} L1 shards, staleness p99 "
+        f"{record['inventory_staleness_p99_s']}s, merged==flat "
+        f"{record['merged_equals_flat']}, "
+        f"{record['queries_total']} queries "
+        f"({measured['placements_per_sec_served_correctly']}/s served "
+        f"correctly, {record['incorrect_after_window']} wrong after "
+        f"window, {record['parity_mismatches']} parity misses), "
+        f"determinism "
+        f"{'pinned' if record['determinism_ok'] else 'SKIPPED'}")
+    return 0
+
+
 def canonical_bytes(record):
     return json.dumps(record, sort_keys=True,
                       separators=(",", ":")).encode()
@@ -1425,7 +2003,29 @@ def main(argv=None):
                     help="4x3 topology, compressed schedule (CI smoke)")
     ap.add_argument("--once", action="store_true",
                     help="skip the determinism double-run")
+    ap.add_argument("--placement-qps", type=float, default=0.0,
+                    help="> 0 selects the sharded-tree + placement "
+                         "soak (ISSUE 17): placement queries per "
+                         "virtual second against the index twin")
+    ap.add_argument("--nodes", type=int, default=100000,
+                    help="fleet size for the sharded-tree soak")
+    ap.add_argument("--churn-rate", type=float, default=200.0,
+                    help="label mutations per virtual second "
+                         "(sharded-tree soak)")
+    ap.add_argument("--churn-secs", type=float, default=30.0,
+                    help="length of the churn window "
+                         "(sharded-tree soak)")
     args = ap.parse_args(argv)
+
+    if args.placement_qps > 0:
+        # Sharded-tree mode: --shards means L1 aggregator shards, not
+        # apiserver store shards.
+        if args.quick:
+            args.nodes = min(args.nodes, 4000)
+            args.placement_qps = min(args.placement_qps, 400.0)
+            args.churn_secs = min(args.churn_secs, 12.0)
+        args.shards = max(2, args.shards)
+        return main_shard(args)
 
     if args.quick:
         args.slices = min(args.slices, 4)

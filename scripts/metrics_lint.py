@@ -177,6 +177,17 @@ CONDITIONAL = {
     # Fleet SLO engine (ISSUE 16): the burn-state gauge registers only
     # in --mode=aggregator once a stage with a budget has been seen.
     "tfd_slo_burn_state",
+    # Sharded aggregation tree + placement query service (ISSUE 17):
+    # the tier gauge registers in --mode=aggregator, the placement
+    # families in --mode=placement — both different runtimes from this
+    # daemon boot (the query histogram additionally needs a query).
+    "tfd_agg_tier",
+    "tfd_placement_queries_total",
+    "tfd_placement_events_total",
+    "tfd_placement_nodes",
+    "tfd_placement_eligible_nodes",
+    "tfd_placement_blocked_slices",
+    "tfd_placement_query_seconds",
 }
 
 

@@ -1,0 +1,305 @@
+#!/usr/bin/env python3
+"""Placement-service smoke against the REAL binary (ISSUE 17).
+
+Boots `tpu-feature-discovery --mode=placement` against a
+tpufd.fakes.apiserver seeded with a scaled fleet, churns the label
+surface well past the fake's DEFAULT watch-history window, and asserts:
+
+  - /readyz gates on informer sync, then answers track a
+    tpufd.placement twin fed the identical label stream — exact
+    equality on every (class, chips, slice, limit) probe;
+  - queries are served from the in-memory index: ZERO apiserver reads
+    land while the query battery runs;
+  - churn never degenerates into a 410 relist storm: the apiserver's
+    history depth is sized PROPORTIONALLY to the fleet
+    (collection_history = max(256, 2 * nodes) — the same rule of thumb
+    docs/placement-harness.md states for real deployments), so a watch
+    reconnect during the churn burst can always resume above the
+    compaction floor. The smoke counts collection LISTs: one initial
+    sync, none forced by churn;
+  - the admission gate composes in: zeroed capacity labels on the
+    inventory object flip a gold query to no-capacity, deleting the
+    object admits it again.
+
+This is the CI-shaped end of the ISSUE 17 scale story: the 100k-node
+numbers live in scripts/cluster_soak.py --placement-qps (virtual clock,
+twin stores); THIS proves the real binary speaks the same contract on a
+real socket.
+
+Usage:
+  python3 scripts/placement_smoke.py [--binary build/tpu-feature-discovery]
+      [--nodes 600] [--churn 400] [--seed 17]
+"""
+
+import argparse
+import http.client
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+from tpufd import agg as agglib  # noqa: E402
+from tpufd import metrics as metricslib  # noqa: E402
+from tpufd import placement as placementlib  # noqa: E402
+from tpufd.fakes.apiserver import FakeApiServer  # noqa: E402
+
+NS = "placement-smoke"
+NODE_NAME_LABEL = "nfd.node.kubernetes.io/node-name"
+OUTPUT = "tfd-cluster-inventory"
+
+PROBES = [
+    {"class": "any", "chips": 1},
+    {"class": "any", "chips": 8, "limit": 8},
+    {"class": "gold", "chips": 4},
+    {"class": "gold", "chips": 8, "slice": True, "limit": 4},
+    {"class": "silver", "chips": 4, "slice": True},
+    {"class": "silver", "chips": 16},
+    {"class": "any", "chips": 4, "slice": True, "limit": 16},
+]
+
+
+def free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def http_get(port, path, timeout=5):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read().decode()
+    except OSError:
+        return None, ""
+    finally:
+        conn.close()
+
+
+def wait_for(cond, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.1)
+    return cond()
+
+
+def post_placement(port, doc):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+    try:
+        conn.request("POST", "/v1/placements", body=json.dumps(doc),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read().decode())
+    finally:
+        conn.close()
+
+
+def node_labels(rng, i):
+    labels = {
+        agglib.TPU_COUNT: str([4, 8, 16][i % 3]),
+        agglib.PERF_CLASS: ["gold", "silver", "degraded", ""][i % 4],
+        agglib.SLICE_ID: f"sm-{i // 8}",
+        agglib.SLICE_DEGRADED: "true" if i % 41 == 0 else "false",
+    }
+    if i % 29 == 0:
+        labels[agglib.LIFECYCLE_PREEMPT] = "true"
+    return labels
+
+
+def churn_labels(rng, old):
+    new = dict(old)
+    roll = rng.random()
+    if roll < 0.4:
+        new[agglib.PERF_CLASS] = rng.choice(["gold", "silver", "degraded"])
+    elif roll < 0.65:
+        new[agglib.SLICE_DEGRADED] = \
+            "false" if old.get(agglib.SLICE_DEGRADED) == "true" else "true"
+    elif roll < 0.8:
+        if agglib.LIFECYCLE_PREEMPT in new:
+            del new[agglib.LIFECYCLE_PREEMPT]
+        else:
+            new[agglib.LIFECYCLE_PREEMPT] = "true"
+    else:
+        new[agglib.TPU_COUNT] = rng.choice(["4", "8", "16"])
+    return new
+
+
+def collection_lists(server):
+    """LIST requests on the bare collection (the relist signature) —
+    watches are logged with the WATCH method marker and don't count."""
+    return sum(1 for method, path in server.requests
+               if method == "GET" and path.rstrip("/").endswith(
+                   "/nodefeatures"))
+
+
+def probe_battery(port, twin, problems, tag):
+    for probe in PROBES:
+        want = twin.query(wanted=probe["class"],
+                          chips=probe.get("chips", 1),
+                          slice=probe.get("slice", False),
+                          limit=probe.get("limit", 1))
+        status, got = post_placement(port, probe)
+        if status != 200:
+            problems.append(f"{tag}: probe {probe} -> HTTP {status}")
+        elif got != want:
+            problems.append(
+                f"{tag}: probe {probe} diverged from the twin: "
+                f"service {got} vs twin {want}")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--binary", default="build/tpu-feature-discovery")
+    ap.add_argument("--nodes", type=int, default=600)
+    ap.add_argument("--churn", type=int, default=400,
+                    help="label mutations to stream (sized past the "
+                         "fake apiserver's DEFAULT 64-event window)")
+    ap.add_argument("--seed", type=int, default=17)
+    args = ap.parse_args(argv)
+
+    rng = random.Random(args.seed)
+    problems = []
+    # The satellite rule of thumb under test: history depth scales with
+    # the fleet, so churn bursts proportional to fleet size can never
+    # push the compaction floor past a live consumer's resume point.
+    depth = max(256, 2 * args.nodes)
+
+    with FakeApiServer(collection_history=depth) as server:
+        twin = placementlib.PlacementIndex()
+        fleet = {}
+        for i in range(args.nodes):
+            node = f"sp-{i:05d}"
+            labels = node_labels(rng, i)
+            fleet[node] = labels
+            server.seed(NS, f"tfd-features-for-{node}", labels,
+                        {NODE_NAME_LABEL: node})
+            twin.apply_node(node, labels)
+
+        qport, oport = free_port(), free_port()
+        proc = subprocess.Popen(
+            [args.binary, "--mode=placement",
+             f"--placement-listen-addr=127.0.0.1:{qport}",
+             f"--introspection-addr=127.0.0.1:{oport}"],
+            env={**os.environ, "TFD_APISERVER_URL": server.url,
+                 "KUBERNETES_NAMESPACE": NS,
+                 "POD_NAME": "placement-smoke-0",
+                 "GCE_METADATA_HOST": "127.0.0.1:1"},
+            stderr=subprocess.DEVNULL)
+        try:
+            if not wait_for(
+                    lambda: http_get(qport, "/readyz")[0] == 200):
+                print("placement smoke FAILED: /readyz never went 200",
+                      file=sys.stderr)
+                return 1
+            lists_after_sync = collection_lists(server)
+
+            probe_battery(qport, twin, problems, "post-sync")
+            reads_before = len(server.requests)
+            probe_battery(qport, twin, problems, "read-free")
+            if len(server.requests) != reads_before:
+                problems.append(
+                    f"{len(server.requests) - reads_before} apiserver "
+                    "request(s) landed DURING the query battery — "
+                    "queries must be served from the in-memory index")
+
+            # Churn far past the default 64-event history window.
+            nodes = sorted(fleet)
+            for _ in range(args.churn):
+                node = rng.choice(nodes)
+                fleet[node] = churn_labels(rng, fleet[node])
+                server.seed(NS, f"tfd-features-for-{node}", fleet[node],
+                            {NODE_NAME_LABEL: node})
+                twin.apply_node(node, fleet[node])
+
+            # Convergence: the service's event counter catches up, then
+            # the battery must agree again.
+            def caught_up():
+                status, body = http_get(oport, "/metrics")
+                if status != 200:
+                    return False
+                try:
+                    n = metricslib.sample_value(
+                        body, "tfd_placement_nodes", None)
+                except ValueError:
+                    return False
+                if n != float(args.nodes):
+                    return False
+                for probe in PROBES[:2]:
+                    want = twin.query(wanted=probe["class"],
+                                      chips=probe.get("chips", 1),
+                                      slice=probe.get("slice", False),
+                                      limit=probe.get("limit", 1))
+                    _, got = post_placement(qport, probe)
+                    if got != want:
+                        return False
+                return True
+
+            if not wait_for(caught_up):
+                problems.append(
+                    "service never converged with the twin after "
+                    f"{args.churn} churn events")
+            probe_battery(qport, twin, problems, "post-churn")
+
+            relists = collection_lists(server) - lists_after_sync
+            if relists != 0:
+                problems.append(
+                    f"{relists} collection relist(s) during churn — a "
+                    "410 storm the proportional history depth "
+                    f"({depth} events for {args.nodes} nodes) is there "
+                    "to prevent")
+
+            # Admission gate end to end: zeroed capacity refuses gold,
+            # deleting the inventory object admits again.
+            zeroed = {agglib.CAPACITY_PREFIX + "gold": "0",
+                      agglib.CAPACITY_PREFIX + "silver": "0",
+                      agglib.CAPACITY_PREFIX + "unclassed": "0"}
+            server.seed(NS, OUTPUT, zeroed)
+            twin.apply_inventory(zeroed)
+            gold = {"class": "gold", "chips": 4}
+            if not wait_for(lambda: post_placement(qport, gold)[1] ==
+                            twin.query(wanted="gold", chips=4)):
+                problems.append("zeroed inventory never flipped the "
+                                "gold query to no-capacity")
+            server.delete(NS, OUTPUT)
+            twin.apply_inventory({})
+            if not wait_for(lambda: post_placement(qport, gold)[1] ==
+                            twin.query(wanted="gold", chips=4)):
+                problems.append("deleting the inventory object never "
+                                "re-admitted the gold query")
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=5)
+
+    summary = {
+        "nodes": args.nodes,
+        "churn_events": args.churn,
+        "collection_history": depth,
+        "probes": len(PROBES) * 3 + 2,
+        "problems": problems,
+    }
+    print(json.dumps(summary))
+    if problems:
+        for p in problems:
+            print(f"placement smoke FAILED: {p}", file=sys.stderr)
+        return 1
+    print(f"placement smoke OK: {args.nodes} nodes, {args.churn} churn "
+          f"events through a {depth}-deep history with zero relists, "
+          "service == twin on every probe")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2,6 +2,7 @@
 
 #include <cstdlib>
 
+#include "tfd/k8s/desync.h"
 #include "tfd/lm/schema.h"
 #include "tfd/util/strings.h"
 
@@ -304,55 +305,55 @@ NodeContribution ExtractContribution(const lm::Labels& labels,
 
 void InventoryStore::Retire(const NodeContribution& c) {
   if (!c.slice_id.empty()) {
-    auto it = slices_.find(c.slice_id);
-    if (it != slices_.end()) {
+    auto it = roll_.slices.find(c.slice_id);
+    if (it != roll_.slices.end()) {
       it->second.members--;
-      if (c.slice_degraded) it->second.degraded_votes--;
+      if (c.slice_degraded) it->second.degraded--;
       if (c.preempting) it->second.preempting--;
-      if (it->second.members <= 0) slices_.erase(it);
+      if (it->second.members <= 0) roll_.slices.erase(it);
     }
   }
   std::string bucket = CapacityBucket(c.perf_class);
-  auto cap = capacity_.find(bucket);
-  if (cap != capacity_.end()) {
+  auto cap = roll_.capacity.find(bucket);
+  if (cap != roll_.capacity.end()) {
     cap->second -= c.chips;
-    if (cap->second <= 0) capacity_.erase(cap);
+    if (cap->second <= 0) roll_.capacity.erase(cap);
   }
   if (!c.multislice_group.empty()) {
-    auto ms = multislice_.find(c.multislice_group);
-    if (ms != multislice_.end()) {
+    auto ms = roll_.multislice.find(c.multislice_group);
+    if (ms != roll_.multislice.end()) {
       ms->second--;
-      if (ms->second <= 0) multislice_.erase(ms);
+      if (ms->second <= 0) roll_.multislice.erase(ms);
     }
   }
-  if (c.preempting) preempting_nodes_--;
-  if (c.matmul_tflops >= 0) matmul_.Remove(c.matmul_tflops);
-  if (c.hbm_gbps >= 0) hbm_.Remove(c.hbm_gbps);
+  if (c.preempting) roll_.preempting--;
+  if (c.matmul_tflops >= 0) roll_.matmul.Remove(c.matmul_tflops);
+  if (c.hbm_gbps >= 0) roll_.hbm.Remove(c.hbm_gbps);
   if (!c.stage_slo.empty()) {
     for (const auto& [stage, sketch] : ParseStageSketches(c.stage_slo)) {
-      auto it = stage_.find(stage);
-      if (it == stage_.end()) continue;
+      auto it = roll_.stage.find(stage);
+      if (it == roll_.stage.end()) continue;
       it->second.Unmerge(sketch);
-      if (it->second.count() <= 0) stage_.erase(it);
+      if (it->second.count() <= 0) roll_.stage.erase(it);
     }
   }
 }
 
 void InventoryStore::Admit(const NodeContribution& c) {
   if (!c.slice_id.empty()) {
-    SliceAgg& agg = slices_[c.slice_id];
+    SliceCounts& agg = roll_.slices[c.slice_id];
     agg.members++;
-    if (c.slice_degraded) agg.degraded_votes++;
+    if (c.slice_degraded) agg.degraded++;
     if (c.preempting) agg.preempting++;
   }
-  capacity_[CapacityBucket(c.perf_class)] += c.chips;
-  if (!c.multislice_group.empty()) multislice_[c.multislice_group]++;
-  if (c.preempting) preempting_nodes_++;
-  if (c.matmul_tflops >= 0) matmul_.Add(c.matmul_tflops);
-  if (c.hbm_gbps >= 0) hbm_.Add(c.hbm_gbps);
+  roll_.capacity[CapacityBucket(c.perf_class)] += c.chips;
+  if (!c.multislice_group.empty()) roll_.multislice[c.multislice_group]++;
+  if (c.preempting) roll_.preempting++;
+  if (c.matmul_tflops >= 0) roll_.matmul.Add(c.matmul_tflops);
+  if (c.hbm_gbps >= 0) roll_.hbm.Add(c.hbm_gbps);
   if (!c.stage_slo.empty()) {
     for (const auto& [stage, sketch] : ParseStageSketches(c.stage_slo)) {
-      stage_[stage].Merge(sketch);
+      roll_.stage[stage].Merge(sketch);
     }
   }
 }
@@ -370,6 +371,7 @@ bool InventoryStore::Apply(const std::string& node, const lm::Labels& labels,
     nodes_[node] = next;
   }
   Admit(next);
+  roll_.nodes = static_cast<int64_t>(nodes_.size());
   return true;
 }
 
@@ -389,63 +391,14 @@ bool InventoryStore::Remove(const std::string& node) {
   if (it == nodes_.end()) return false;
   Retire(it->second);
   nodes_.erase(it);
+  roll_.nodes = static_cast<int64_t>(nodes_.size());
   return true;
-}
-
-lm::Labels InventoryStore::BuildOutputLabels() const {
-  lm::Labels out;
-  int healthy = 0;
-  int degraded = 0;
-  for (const auto& [id, agg] : slices_) {
-    (void)id;
-    if (agg.degraded_votes > 0 || agg.preempting > 0) {
-      degraded++;
-    } else {
-      healthy++;
-    }
-  }
-  out[lm::kInventorySlices] = std::to_string(slices_.size());
-  out[lm::kInventoryHealthySlices] = std::to_string(healthy);
-  out[lm::kInventoryDegradedSlices] = std::to_string(degraded);
-  int64_t total_chips = 0;
-  for (const char* bucket : {"gold", "silver", "degraded", "unclassed"}) {
-    auto it = capacity_.find(bucket);
-    int64_t chips = it == capacity_.end() ? 0 : it->second;
-    total_chips += chips;
-    out[std::string(lm::kCapacityPrefix) + bucket] = std::to_string(chips);
-  }
-  out[std::string(lm::kCapacityPrefix) + "total-chips"] =
-      std::to_string(total_chips);
-  out[lm::kFleetNodes] = std::to_string(nodes_.size());
-  out[lm::kFleetPreempting] = std::to_string(preempting_nodes_);
-  out[lm::kMultisliceGroups] = std::to_string(multislice_.size());
-  if (matmul_.count() > 0) {
-    out[lm::kFleetMatmulP10] = Fixed3(matmul_.Quantile(0.10));
-    out[lm::kFleetMatmulP50] = Fixed3(matmul_.Quantile(0.50));
-  }
-  if (hbm_.count() > 0) {
-    out[lm::kFleetHbmP10] = Fixed3(hbm_.Quantile(0.10));
-    out[lm::kFleetHbmP50] = Fixed3(hbm_.Quantile(0.50));
-  }
-  for (const char* stage : kSloStages) {
-    auto it = stage_.find(stage);
-    if (it == stage_.end() || it->second.count() <= 0) continue;
-    std::string base = std::string(lm::kObsStagePrefix) + stage;
-    out[base + ".p50-ms"] = Fixed3(it->second.Quantile(0.50));
-    out[base + ".p99-ms"] = Fixed3(it->second.Quantile(0.99));
-  }
-  return out;
 }
 
 void InventoryStore::RecomputeAll() {
   full_recomputes_++;
-  slices_.clear();
-  capacity_.clear();
-  multislice_.clear();
-  preempting_nodes_ = 0;
-  matmul_.Clear();
-  hbm_.Clear();
-  stage_.clear();
+  roll_ = RollupState();
+  roll_.nodes = static_cast<int64_t>(nodes_.size());
   for (const auto& [node, c] : nodes_) {
     (void)node;
     Admit(c);
@@ -454,13 +407,321 @@ void InventoryStore::RecomputeAll() {
 
 void InventoryStore::Clear() {
   nodes_.clear();
-  slices_.clear();
-  capacity_.clear();
-  multislice_.clear();
-  preempting_nodes_ = 0;
-  matmul_.Clear();
-  hbm_.Clear();
-  stage_.clear();
+  roll_ = RollupState();
+}
+
+// ---- sharded aggregation tree ---------------------------------------------
+
+int ShardIndexOf(const std::string& node, int shards) {
+  if (shards <= 1) return 0;
+  // Textbook FNV-1a (desync), NOT util/strings.h Fnv1a64 — the soak's
+  // Python twin shards via tpufd.sink.fnv1a64, which pins this one.
+  return static_cast<int>(k8s::desync::Fnv1a64(node) %
+                          static_cast<uint64_t>(shards));
+}
+
+bool RollupState::operator==(const RollupState& other) const {
+  return nodes == other.nodes && preempting == other.preempting &&
+         slices == other.slices && capacity == other.capacity &&
+         multislice == other.multislice && matmul == other.matmul &&
+         hbm == other.hbm && stage == other.stage;
+}
+
+lm::Labels BuildRollupLabels(const RollupState& state) {
+  lm::Labels out;
+  int64_t healthy = 0;
+  int64_t degraded = 0;
+  for (const auto& [id, agg] : state.slices) {
+    (void)id;
+    if (agg.degraded > 0 || agg.preempting > 0) {
+      degraded++;
+    } else {
+      healthy++;
+    }
+  }
+  out[lm::kInventorySlices] = std::to_string(state.slices.size());
+  out[lm::kInventoryHealthySlices] = std::to_string(healthy);
+  out[lm::kInventoryDegradedSlices] = std::to_string(degraded);
+  int64_t total_chips = 0;
+  for (const char* bucket : {"gold", "silver", "degraded", "unclassed"}) {
+    auto it = state.capacity.find(bucket);
+    int64_t chips = it == state.capacity.end() ? 0 : it->second;
+    total_chips += chips;
+    out[std::string(lm::kCapacityPrefix) + bucket] = std::to_string(chips);
+  }
+  out[std::string(lm::kCapacityPrefix) + "total-chips"] =
+      std::to_string(total_chips);
+  out[lm::kFleetNodes] = std::to_string(state.nodes);
+  out[lm::kFleetPreempting] = std::to_string(state.preempting);
+  out[lm::kMultisliceGroups] = std::to_string(state.multislice.size());
+  if (state.matmul.count() > 0) {
+    out[lm::kFleetMatmulP10] = Fixed3(state.matmul.Quantile(0.10));
+    out[lm::kFleetMatmulP50] = Fixed3(state.matmul.Quantile(0.50));
+  }
+  if (state.hbm.count() > 0) {
+    out[lm::kFleetHbmP10] = Fixed3(state.hbm.Quantile(0.10));
+    out[lm::kFleetHbmP50] = Fixed3(state.hbm.Quantile(0.50));
+  }
+  for (const char* stage : kSloStages) {
+    auto it = state.stage.find(stage);
+    if (it == state.stage.end() || it->second.count() <= 0) continue;
+    std::string base = std::string(lm::kObsStagePrefix) + stage;
+    out[base + ".p50-ms"] = Fixed3(it->second.Quantile(0.50));
+    out[base + ".p99-ms"] = Fixed3(it->second.Quantile(0.99));
+  }
+  return out;
+}
+
+std::string SerializeSketch(const QuantileSketch& sketch) {
+  std::string out;
+  const auto& counts = sketch.bucket_counts();
+  for (int i = 0; i < kSketchBuckets; i++) {
+    if (counts[i] <= 0) continue;
+    if (!out.empty()) out += ',';
+    out += std::to_string(i);
+    out += ':';
+    out += std::to_string(counts[i]);
+  }
+  return out;
+}
+
+QuantileSketch ParseSketch(const std::string& text) {
+  QuantileSketch sketch;
+  for (const std::string& pair : SplitString(text, ',')) {
+    size_t colon = pair.find(':');
+    if (colon == std::string::npos) continue;
+    int bucket = 0;
+    int n = 0;
+    if (!ParseNonNegInt(pair.substr(0, colon), &bucket) ||
+        !ParseNonNegInt(pair.substr(colon + 1), &n)) {
+      continue;
+    }
+    sketch.AddBucketCount(bucket, n);
+  }
+  return sketch;
+}
+
+namespace {
+
+// "key:v1:v2,..." serializers for the counter maps — deterministic
+// (sorted map iteration), annotation-safe, exact-roundtrip (zero
+// entries are carried, matching the erase-at-zero store semantics
+// where a zero-chip class entry can legitimately exist).
+std::string SerializeCounterMap(const std::map<std::string, int64_t>& m) {
+  std::string out;
+  for (const auto& [key, n] : m) {
+    if (!out.empty()) out += ',';
+    out += key;
+    out += ':';
+    out += std::to_string(n);
+  }
+  return out;
+}
+
+void ParseCounterMap(const std::string& text,
+                     std::map<std::string, int64_t>* out) {
+  for (const std::string& entry : SplitString(text, ',')) {
+    size_t colon = entry.find(':');
+    if (colon == std::string::npos || colon == 0) continue;
+    int n = 0;
+    if (!ParseNonNegInt(entry.substr(colon + 1), &n)) continue;
+    (*out)[entry.substr(0, colon)] = n;
+  }
+}
+
+std::string SerializeSliceMap(
+    const std::map<std::string, SliceCounts>& slices) {
+  std::string out;
+  for (const auto& [id, agg] : slices) {
+    if (!out.empty()) out += ',';
+    out += id;
+    out += ':';
+    out += std::to_string(agg.members);
+    out += ':';
+    out += std::to_string(agg.degraded);
+    out += ':';
+    out += std::to_string(agg.preempting);
+  }
+  return out;
+}
+
+void ParseSliceMap(const std::string& text,
+                   std::map<std::string, SliceCounts>* out) {
+  for (const std::string& entry : SplitString(text, ',')) {
+    std::vector<std::string> parts = SplitString(entry, ':');
+    if (parts.size() != 4 || parts[0].empty()) continue;
+    int members = 0;
+    int degraded = 0;
+    int preempting = 0;
+    if (!ParseNonNegInt(parts[1], &members) ||
+        !ParseNonNegInt(parts[2], &degraded) ||
+        !ParseNonNegInt(parts[3], &preempting)) {
+      continue;
+    }
+    (*out)[parts[0]] = SliceCounts{members, degraded, preempting};
+  }
+}
+
+int64_t ParseCount(const lm::Labels& labels, const char* key) {
+  auto it = labels.find(key);
+  int n = 0;
+  if (it == labels.end() || !ParseNonNegInt(it->second, &n)) return 0;
+  return n;
+}
+
+}  // namespace
+
+lm::Labels SerializePartialLabels(const RollupState& state,
+                                  const std::string& shard_spec) {
+  lm::Labels out;
+  out[lm::kAggTier] = lm::kAggTierPartial;
+  out[lm::kAggShard] = shard_spec;
+  out[lm::kAggNodes] = std::to_string(state.nodes);
+  out[lm::kAggPreempting] = std::to_string(state.preempting);
+  if (!state.slices.empty()) {
+    out[lm::kAggSlices] = SerializeSliceMap(state.slices);
+  }
+  if (!state.capacity.empty()) {
+    out[lm::kAggCapacity] = SerializeCounterMap(state.capacity);
+  }
+  if (!state.multislice.empty()) {
+    out[lm::kAggMultislice] = SerializeCounterMap(state.multislice);
+  }
+  if (state.matmul.count() > 0) {
+    out[lm::kAggMatmul] = SerializeSketch(state.matmul);
+  }
+  if (state.hbm.count() > 0) {
+    out[lm::kAggHbm] = SerializeSketch(state.hbm);
+  }
+  std::string slo = SerializeStageSketches(state.stage);
+  if (!slo.empty()) out[lm::kAggStageSlo] = slo;
+  return out;
+}
+
+bool ParsePartialLabels(const lm::Labels& labels, RollupState* out) {
+  auto tier = labels.find(lm::kAggTier);
+  if (tier == labels.end() || tier->second != lm::kAggTierPartial) {
+    return false;
+  }
+  *out = RollupState();
+  out->nodes = ParseCount(labels, lm::kAggNodes);
+  out->preempting = ParseCount(labels, lm::kAggPreempting);
+  auto it = labels.find(lm::kAggSlices);
+  if (it != labels.end()) ParseSliceMap(it->second, &out->slices);
+  it = labels.find(lm::kAggCapacity);
+  if (it != labels.end()) ParseCounterMap(it->second, &out->capacity);
+  it = labels.find(lm::kAggMultislice);
+  if (it != labels.end()) ParseCounterMap(it->second, &out->multislice);
+  it = labels.find(lm::kAggMatmul);
+  if (it != labels.end()) out->matmul = ParseSketch(it->second);
+  it = labels.find(lm::kAggHbm);
+  if (it != labels.end()) out->hbm = ParseSketch(it->second);
+  it = labels.find(lm::kAggStageSlo);
+  if (it != labels.end()) out->stage = ParseStageSketches(it->second);
+  return true;
+}
+
+void ShardMergeStore::Retire(const RollupState& p) {
+  merged_.nodes -= p.nodes;
+  merged_.preempting -= p.preempting;
+  for (const auto& [id, agg] : p.slices) {
+    auto it = merged_.slices.find(id);
+    if (it == merged_.slices.end()) continue;
+    it->second.members -= agg.members;
+    it->second.degraded -= agg.degraded;
+    it->second.preempting -= agg.preempting;
+    if (it->second.members <= 0) merged_.slices.erase(it);
+  }
+  for (const auto& [bucket, chips] : p.capacity) {
+    auto it = merged_.capacity.find(bucket);
+    if (it == merged_.capacity.end()) continue;
+    it->second -= chips;
+    if (it->second <= 0) merged_.capacity.erase(it);
+  }
+  for (const auto& [group, members] : p.multislice) {
+    auto it = merged_.multislice.find(group);
+    if (it == merged_.multislice.end()) continue;
+    it->second -= members;
+    if (it->second <= 0) merged_.multislice.erase(it);
+  }
+  merged_.matmul.Unmerge(p.matmul);
+  merged_.hbm.Unmerge(p.hbm);
+  for (const auto& [stage, sketch] : p.stage) {
+    auto it = merged_.stage.find(stage);
+    if (it == merged_.stage.end()) continue;
+    it->second.Unmerge(sketch);
+    if (it->second.count() <= 0) merged_.stage.erase(it);
+  }
+}
+
+void ShardMergeStore::Admit(const RollupState& p) {
+  merged_.nodes += p.nodes;
+  merged_.preempting += p.preempting;
+  for (const auto& [id, agg] : p.slices) {
+    SliceCounts& m = merged_.slices[id];
+    m.members += agg.members;
+    m.degraded += agg.degraded;
+    m.preempting += agg.preempting;
+  }
+  for (const auto& [bucket, chips] : p.capacity) {
+    merged_.capacity[bucket] += chips;
+  }
+  for (const auto& [group, members] : p.multislice) {
+    merged_.multislice[group] += members;
+  }
+  merged_.matmul.Merge(p.matmul);
+  merged_.hbm.Merge(p.hbm);
+  for (const auto& [stage, sketch] : p.stage) {
+    merged_.stage[stage].Merge(sketch);
+  }
+}
+
+bool ShardMergeStore::ApplyPartial(const std::string& shard,
+                                   const RollupState& partial) {
+  events_++;
+  auto it = partials_.find(shard);
+  if (it != partials_.end()) {
+    if (it->second == partial) return false;  // no rollup moved
+    Retire(it->second);
+    it->second = partial;
+  } else {
+    partials_[shard] = partial;
+  }
+  Admit(partial);
+  return true;
+}
+
+bool ShardMergeStore::RemovePartial(const std::string& shard) {
+  events_++;
+  auto it = partials_.find(shard);
+  if (it == partials_.end()) return false;
+  Retire(it->second);
+  partials_.erase(it);
+  return true;
+}
+
+std::vector<std::string> ShardMergeStore::ShardNames() const {
+  std::vector<std::string> out;
+  out.reserve(partials_.size());
+  for (const auto& [shard, p] : partials_) {
+    (void)p;
+    out.push_back(shard);
+  }
+  return out;
+}
+
+void ShardMergeStore::RecomputeAll() {
+  full_recomputes_++;
+  merged_ = RollupState();
+  for (const auto& [shard, p] : partials_) {
+    (void)shard;
+    Admit(p);
+  }
+}
+
+void ShardMergeStore::Clear() {
+  partials_.clear();
+  merged_ = RollupState();
 }
 
 // ---- flush controller -----------------------------------------------------

@@ -211,6 +211,116 @@ struct NodeContribution {
 NodeContribution ExtractContribution(const lm::Labels& labels,
                                      const std::string& stage_slo = "");
 
+// ---- sharded aggregation tree --------------------------------------------
+//
+// The rollup core was built mergeable/removable precisely so aggregation
+// could become a TREE (ROADMAP #3): L1 shards each run the incremental
+// store over 1/n of the fleet and publish a PARTIAL — the shard's whole
+// aggregate state serialized as counter maps and sparse sketch buckets —
+// and the L2 root merges the n partials O(delta) (retire the shard's old
+// partial, admit its new one) into an output byte-identical to what a
+// flat single aggregator over the same fleet would publish. Bit-identity
+// holds because every rollup is a sum of exact integer counters and
+// integer-count sketch buckets: addition is associative, so
+// (shard sums) summed == flat sum, bucket for bucket.
+
+// Shard assignment: nodes whose textbook-FNV-1a name hash lands in
+// shard i of n (k8s::desync::Fnv1a64 — twin-pinned by tpufd.sink).
+// shards <= 1 maps everything to shard 0 (the flat topology).
+int ShardIndexOf(const std::string& node, int shards);
+
+// One slice's aggregated member counters (the store's former private
+// SliceAgg, public now so partials can carry it across tiers).
+struct SliceCounts {
+  int64_t members = 0;
+  int64_t degraded = 0;    // members voting tpu.slice.degraded=true
+  int64_t preempting = 0;  // members with a lifecycle preempt/drain label
+  bool operator==(const SliceCounts& other) const {
+    return members == other.members && degraded == other.degraded &&
+           preempting == other.preempting;
+  }
+};
+
+// The complete aggregate state one tier holds: what an L1 publishes as
+// its partial, what the L2 accumulates per shard AND as the merged
+// total, and what the flat InventoryStore maintains internally — one
+// struct so BuildRollupLabels is shared and byte-compat is structural,
+// not coincidental.
+struct RollupState {
+  int64_t nodes = 0;
+  int64_t preempting = 0;
+  std::map<std::string, SliceCounts> slices;
+  std::map<std::string, int64_t> capacity;    // class bucket -> chips
+  std::map<std::string, int64_t> multislice;  // group id -> members
+  QuantileSketch matmul;
+  QuantileSketch hbm;
+  StageSketches stage;
+
+  bool operator==(const RollupState& other) const;
+  bool operator!=(const RollupState& other) const {
+    return !(*this == other);
+  }
+};
+
+// The cluster-scoped rollup label set from an aggregate state —
+// deterministic, parity-pinned against the Python twin. Every tier's
+// output flows through this one function (see InventoryStore::
+// BuildOutputLabels / ShardMergeStore::BuildOutputLabels).
+lm::Labels BuildRollupLabels(const RollupState& state);
+
+// Sparse sketch wire form: ascending "bucket:count" pairs joined by
+// ',' ("" = empty). The inverse is tolerant (malformed pairs skipped).
+std::string SerializeSketch(const QuantileSketch& sketch);
+QuantileSketch ParseSketch(const std::string& text);
+
+// The partial CR's label payload: the aggregate state under the
+// lm::kAgg* keys plus the tier marker and the "i/n" shard spec. Empty
+// maps/sketches omit their key. ParsePartialLabels returns false when
+// the tier marker is absent (the labels are not a partial); malformed
+// fields are skipped, never fatal — the payload arrives from the wire.
+lm::Labels SerializePartialLabels(const RollupState& state,
+                                  const std::string& shard_spec);
+bool ParsePartialLabels(const lm::Labels& labels, RollupState* out);
+
+// The L2 root's store: one RollupState per live shard plus the merged
+// total, maintained O(delta per partial) — ApplyPartial retires the
+// shard's previous partial (counter subtraction + Sketch::Unmerge) and
+// admits the new one; root state is O(shards), never O(nodes).
+class ShardMergeStore {
+ public:
+  // Returns true when the shard's partial CHANGED (some rollup moved
+  // and a publish is owed) — equal partials are a no-op, mirroring
+  // InventoryStore::Apply.
+  bool ApplyPartial(const std::string& shard, const RollupState& partial);
+  // Watch DELETED: retires the shard's contribution entirely.
+  bool RemovePartial(const std::string& shard);
+
+  size_t shards() const { return partials_.size(); }
+  std::vector<std::string> ShardNames() const;
+  uint64_t events() const { return events_; }
+  uint64_t full_recomputes() const { return full_recomputes_; }
+
+  const RollupState& merged() const { return merged_; }
+  lm::Labels BuildOutputLabels() const { return BuildRollupLabels(merged_); }
+  const StageSketches& stage_sketches() const { return merged_.stage; }
+
+  // Self-check ONLY (mirrors InventoryStore::RecomputeAll): rebuilds
+  // the merged total from the retained partials and bumps
+  // full_recomputes — `tfd_agg_full_recomputes_total == 0` on every
+  // tier is the acceptance contract.
+  void RecomputeAll();
+  void Clear();
+
+ private:
+  void Retire(const RollupState& p);
+  void Admit(const RollupState& p);
+
+  std::map<std::string, RollupState> partials_;
+  RollupState merged_;
+  uint64_t events_ = 0;
+  uint64_t full_recomputes_ = 0;
+};
+
 // ---- the incremental inventory store -------------------------------------
 
 class InventoryStore {
@@ -239,11 +349,15 @@ class InventoryStore {
   //   tpu.multislice.groups
   //   tpu.fleet.perf.{matmul-p10,matmul-p50,hbm-p10,hbm-p50} (when known)
   //   tpu.obs.stage.<stage>.{p50,p99}-ms (when any node published SLO)
-  lm::Labels BuildOutputLabels() const;
+  lm::Labels BuildOutputLabels() const { return BuildRollupLabels(roll_); }
+
+  // The store's whole aggregate state — what an L1 shard serializes
+  // into its partial CR (SerializePartialLabels).
+  const RollupState& Partial() const { return roll_; }
 
   // The merged fleet stage sketches (sum of every node's published
   // contribution) — what the burn evaluator feeds on.
-  const StageSketches& stage_sketches() const { return stage_; }
+  const StageSketches& stage_sketches() const { return roll_.stage; }
 
   // Self-check / debug ONLY: rebuilds every rollup from the retained
   // contributions and bumps full_recomputes. The steady path never
@@ -254,23 +368,13 @@ class InventoryStore {
   void Clear();
 
  private:
-  struct SliceAgg {
-    int members = 0;
-    int degraded_votes = 0;
-    int preempting = 0;
-  };
-
   void Retire(const NodeContribution& c);
   void Admit(const NodeContribution& c);
 
   std::map<std::string, NodeContribution> nodes_;
-  std::map<std::string, SliceAgg> slices_;
-  std::map<std::string, int64_t> capacity_;   // class -> chips
-  std::map<std::string, int> multislice_;     // group id -> members
-  int preempting_nodes_ = 0;
-  QuantileSketch matmul_;
-  QuantileSketch hbm_;
-  StageSketches stage_;
+  // Everything the contributions roll up to (roll_.nodes is kept equal
+  // to nodes_.size() by Apply/Remove/Clear).
+  RollupState roll_;
   uint64_t events_ = 0;
   uint64_t full_recomputes_ = 0;
 };
@@ -297,6 +401,12 @@ class FlushController {
   double DueAt() const;
   bool ShouldFlush(double now) const { return dirty() && now >= DueAt(); }
   void NoteFlushed() { dirty_since_ = -1; }
+  // Restore a consumed window after a failed publish: the retry owes
+  // the ORIGINAL staleness, so an event that dirtied the controller
+  // mid-publish never shortens it.
+  void ReArm(double since) {
+    if (dirty_since_ < 0 || since < dirty_since_) dirty_since_ = since;
+  }
 
  private:
   double debounce_s_;

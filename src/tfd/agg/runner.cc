@@ -39,6 +39,39 @@ constexpr char kLeaseKey[] = "lease";
 constexpr char kCrNamePrefix[] = "tfd-features-for-";
 constexpr char kNodeNameLabel[] = "nfd.node.kubernetes.io/node-name";
 constexpr char kFieldManager[] = "tfd-aggregator";
+// The sharded aggregation tree's object names: every L1 partial is
+// "tfd-inventory-shard-<i>"; ALL "tfd-inventory-*" names (root and
+// partials) are inventory objects, never node contributions.
+constexpr char kInventoryNamePrefix[] = "tfd-inventory-";
+constexpr char kPartialNamePrefix[] = "tfd-inventory-shard-";
+
+// Which aggregation tier this process runs (tfd_agg_tier gauge values).
+enum class Tier {
+  kFlat = 0,   // the PR-12 topology: one store over the whole fleet
+  kShard = 1,  // L1: 1/n of the fleet -> one partial CR
+  kMerge = 2,  // L2 root: n partial CRs -> the cluster inventory
+};
+
+// How one watched object participates in a tier's ingest. The
+// inventory exclusion comes FIRST: partials deliberately carry the nfd
+// node-name label (so the L2's selector watch sees them), which puts
+// them in EVERY tier's stream — without the explicit name rule a shard
+// would re-ingest inventory as node contributions.
+enum class ObjKind {
+  kNodeCr,   // a daemon's per-node CR
+  kPartial,  // an L1 shard's partial rollup CR
+  kOther,    // the root inventory output, or anything else
+};
+
+ObjKind ClassifyName(const std::string& name,
+                     const std::string& output_name) {
+  if (name.rfind(kPartialNamePrefix, 0) == 0) return ObjKind::kPartial;
+  if (name.rfind(kInventoryNamePrefix, 0) == 0 || name == output_name) {
+    return ObjKind::kOther;
+  }
+  if (name.rfind(kCrNamePrefix, 0) == 0) return ObjKind::kNodeCr;
+  return ObjKind::kOther;
+}
 
 double MonoSeconds() {
   return std::chrono::duration<double>(
@@ -145,7 +178,8 @@ obs::Gauge* BurnStateGauge(const std::string& stage) {
 struct Shared {
   std::mutex mu;
   std::condition_variable cv;
-  InventoryStore store;
+  InventoryStore store;      // kFlat / kShard: per-node contributions
+  ShardMergeStore merge;     // kMerge: per-shard partials
   FlushController flush;
   // Multi-window burn detection over the merged fleet stage sketches;
   // evaluated on the flush loop's cadence under this mutex.
@@ -156,9 +190,21 @@ struct Shared {
   // annotation at the next flush, so the cluster-scoped rollup joins
   // back to the per-node trace that moved it.
   std::string last_change;
+  // Tier topology (fixed at startup, read freely).
+  Tier tier = Tier::kFlat;
+  int shard_index = 0;  // kShard: this process owns shard_index of
+  int shard_count = 0;  //   shard_count (ShardIndexOf assignment)
+  std::string output_name;
 
   Shared(double debounce_s, std::map<std::string, double> budgets_ms)
       : flush(debounce_s), burn(std::move(budgets_ms)) {}
+
+  // The tier's retained-population size for the tfd_agg_nodes gauge —
+  // merged node total at the root, store size below it.
+  size_t Population() const {
+    return tier == Tier::kMerge ? static_cast<size_t>(merge.merged().nodes)
+                                : store.nodes();
+  }
 };
 
 // ---- the collection watcher ----------------------------------------------
@@ -206,17 +252,39 @@ class CollectionWatcher {
   }
 
   // Applies one object's labels (and its stage-SLO annotation) to the
-  // store under the shared lock; notes dirty + wakes the flush loop
-  // when a rollup moved.
+  // tier's store under the shared lock; notes dirty + wakes the flush
+  // loop when a rollup moved. Inventory objects (the root output and
+  // every tfd-inventory-* partial) are NEVER node contributions, at
+  // any tier — the L2 root consumes the partials, everyone else
+  // ignores them.
   void ApplyObject(const std::string& name, const lm::Labels& labels,
                    bool deleted, const std::string& change = "",
                    const std::string& stage_slo = "") {
-    if (name.rfind(kCrNamePrefix, 0) != 0) return;  // not a daemon CR
-    std::string node = name.substr(sizeof(kCrNamePrefix) - 1);
+    ObjKind kind = ClassifyName(name, shared_->output_name);
     std::lock_guard<std::mutex> lock(shared_->mu);
-    bool moved = deleted ? shared_->store.Remove(node)
-                         : shared_->store.Apply(node, labels, stage_slo);
-    SetNodesGauge(shared_->store.nodes());
+    bool moved = false;
+    if (shared_->tier == Tier::kMerge) {
+      if (kind != ObjKind::kPartial) return;  // the root merges partials only
+      if (deleted) {
+        moved = shared_->merge.RemovePartial(name);
+      } else {
+        RollupState partial;
+        // Not (yet) a partial payload — e.g. the CR exists but another
+        // writer owns it. Tolerate, never ingest.
+        if (!ParsePartialLabels(labels, &partial)) return;
+        moved = shared_->merge.ApplyPartial(name, partial);
+      }
+    } else {
+      if (kind != ObjKind::kNodeCr) return;  // satellite rule: excluded
+      std::string node = name.substr(sizeof(kCrNamePrefix) - 1);
+      if (shared_->tier == Tier::kShard &&
+          ShardIndexOf(node, shared_->shard_count) != shared_->shard_index) {
+        return;  // another shard's node
+      }
+      moved = deleted ? shared_->store.Remove(node)
+                      : shared_->store.Apply(node, labels, stage_slo);
+    }
+    SetNodesGauge(shared_->Population());
     if (moved) {
       if (!change.empty()) shared_->last_change = change;
       shared_->flush.NoteDirty(MonoSeconds());
@@ -253,6 +321,7 @@ class CollectionWatcher {
       *rv = v->string_value;
     }
     std::set<std::string> listed_nodes;
+    std::set<std::string> listed_partials;
     jsonlite::ValuePtr items = (*parsed)->Get("items");
     if (items && items->kind == jsonlite::Value::Kind::kArray) {
       for (const jsonlite::ValuePtr& item : items->array_items) {
@@ -262,7 +331,15 @@ class CollectionWatcher {
             n && n->kind == jsonlite::Value::Kind::kString) {
           name = n->string_value;
         }
-        if (name.rfind(kCrNamePrefix, 0) != 0) continue;
+        // Only the tier's own ingest kind counts as a listed item; the
+        // inventory-name exclusion applies to a LIST exactly as it does
+        // to the watch stream.
+        ObjKind kind = ClassifyName(name, shared_->output_name);
+        if (shared_->tier == Tier::kMerge) {
+          if (kind != ObjKind::kPartial) continue;
+        } else if (kind != ObjKind::kNodeCr) {
+          continue;
+        }
         lm::Labels labels;
         if (jsonlite::ValuePtr l = item->GetPath("spec.labels");
             l && l->kind == jsonlite::Value::Kind::kObject) {
@@ -289,22 +366,40 @@ class CollectionWatcher {
             stage_slo = slo->string_value;
           }
         }
-        listed_nodes.insert(name.substr(sizeof(kCrNamePrefix) - 1));
+        if (shared_->tier == Tier::kMerge) {
+          listed_partials.insert(name);
+        } else {
+          listed_nodes.insert(name.substr(sizeof(kCrNamePrefix) - 1));
+        }
         EventCounter("listed")->Inc();
         ApplyObject(name, labels, /*deleted=*/false, /*change=*/"",
                     stage_slo);
       }
     }
-    // Deletes missed while not watching: every retained node absent
-    // from the list retires through the SAME incremental path.
-    std::vector<std::string> known;
-    {
-      std::lock_guard<std::mutex> lock(shared_->mu);
-      known = shared_->store.NodeNames();
-    }
-    for (const std::string& node : known) {
-      if (listed_nodes.count(node) == 0) {
-        ApplyObject(kCrNamePrefix + node, {}, /*deleted=*/true);
+    // Deletes missed while not watching: every retained node (or
+    // partial, at the root) absent from the list retires through the
+    // SAME incremental path.
+    if (shared_->tier == Tier::kMerge) {
+      std::vector<std::string> known;
+      {
+        std::lock_guard<std::mutex> lock(shared_->mu);
+        known = shared_->merge.ShardNames();
+      }
+      for (const std::string& shard : known) {
+        if (listed_partials.count(shard) == 0) {
+          ApplyObject(shard, {}, /*deleted=*/true);
+        }
+      }
+    } else {
+      std::vector<std::string> known;
+      {
+        std::lock_guard<std::mutex> lock(shared_->mu);
+        known = shared_->store.NodeNames();
+      }
+      for (const std::string& node : known) {
+        if (listed_nodes.count(node) == 0) {
+          ApplyObject(kCrNamePrefix + node, {}, /*deleted=*/true);
+        }
       }
     }
     relists_.fetch_add(1);
@@ -475,9 +570,16 @@ class CollectionWatcher {
 Status PublishOutput(const k8s::ClusterConfig& config,
                      const std::string& output_name,
                      const lm::Labels& labels, bool* apply_unsupported,
-                     const std::string& change = "") {
+                     const std::string& change = "",
+                     const lm::Labels& meta_labels = {}) {
   std::string named_url = CollectionUrl(config) + "/" + output_name;
   std::string meta = std::string("\"name\":") + jsonlite::Quote(output_name);
+  if (!meta_labels.empty()) {
+    // An L1 partial stamps the nfd node-name METADATA label so the L2
+    // root's selector watch sees it (the flat/root output deliberately
+    // carries none, staying outside every watch).
+    meta += ",\"labels\":" + jsonlite::SerializeStringMap(meta_labels);
+  }
   if (!change.empty()) {
     // Echo the latest per-node change id that moved this rollup: the
     // inventory object stays joinable to the origin daemon's trace.
@@ -541,6 +643,12 @@ Status PublishOutput(const k8s::ClusterConfig& config,
   spec->kind = jsonlite::Value::Kind::kObject;
   spec->Set("labels", jsonlite::FromStringMap(labels));
   (*parsed)->Set("spec", spec);
+  if (!meta_labels.empty()) {
+    if (jsonlite::ValuePtr metadata = (*parsed)->Get("metadata");
+        metadata && metadata->kind == jsonlite::Value::Kind::kObject) {
+      metadata->Set("labels", jsonlite::FromStringMap(meta_labels));
+    }
+  }
   http::RequestOptions put = BaseOptions(config);
   put.headers["Content-Type"] = "application/json";
   put.deadline_ms = 15000;
@@ -563,14 +671,18 @@ struct LeaseState {
   double last_contact_mono = 0;
 };
 
-// One lease tick against the "tfd-aggregator" ConfigMap: bootstrap,
-// renew, or take over an expired lease — optimistic concurrency via the
-// resourceVersion precondition, exactly like the slice blackboard.
-void LeaseTick(const k8s::ClusterConfig& config, const std::string& self,
+// One lease tick against the tier's lease ConfigMap ("tfd-aggregator"
+// for the flat aggregator and the L2 root, "tfd-aggregator-shard-<i>"
+// per L1 shard — each shard's replica pair elects independently):
+// bootstrap, renew, or take over an expired lease — optimistic
+// concurrency via the resourceVersion precondition, exactly like the
+// slice blackboard.
+void LeaseTick(const k8s::ClusterConfig& config,
+               const std::string& lease_doc, const std::string& self,
                int lease_duration_s, LeaseState* state) {
   bool server_alive = false;
   Result<k8s::CoordDocResult> doc =
-      k8s::GetCoordConfigMap(config, kLeaseDocName, &server_alive, nullptr);
+      k8s::GetCoordConfigMap(config, lease_doc, &server_alive, nullptr);
   bool was_leading = state->leading;
   if (!doc.ok()) {
     TFD_LOG_WARNING << "aggregator lease: " << doc.error();
@@ -623,7 +735,7 @@ void LeaseTick(const k8s::ClusterConfig& config, const std::string& self,
     next.duration_s = lease_duration_s;
     bool conflict = false;
     Status wrote = k8s::PatchCoordConfigMap(
-        config, kLeaseDocName, {{kLeaseKey, slice::SerializeLease(next)}},
+        config, lease_doc, {{kLeaseKey, slice::SerializeLease(next)}},
         create ? "" : doc->resource_version, create, &conflict,
         &server_alive, nullptr);
     if (wrote.ok()) {
@@ -696,13 +808,53 @@ AggOutcome RunAggregator(const config::Config& config,
     TFD_LOG_INFO << "aggregator introspection on port " << server->port();
   }
 
+  // Tier topology: --agg-shard=i/n -> L1 shard (partial publisher),
+  // --agg-merge-shards=n -> L2 root (partial consumer), neither ->
+  // the flat PR-12 singleton. Config validated the shard spec shape.
+  Tier tier = Tier::kFlat;
+  int shard_index = 0;
+  int shard_count = 0;
+  if (!flags.agg_shard.empty()) {
+    size_t slash = flags.agg_shard.find('/');
+    ParseNonNegInt(flags.agg_shard.substr(0, slash), &shard_index);
+    ParseNonNegInt(flags.agg_shard.substr(slash + 1), &shard_count);
+    tier = Tier::kShard;
+  } else if (flags.agg_merge_shards > 0) {
+    tier = Tier::kMerge;
+  }
+  // An L1's output is its partial CR and its lease doc is per-shard —
+  // each shard's replica pair elects its own leader independently.
+  const std::string output_name =
+      tier == Tier::kShard
+          ? kPartialNamePrefix + std::to_string(shard_index)
+          : flags.agg_output_name;
+  const std::string lease_doc =
+      tier == Tier::kShard
+          ? std::string(kLeaseDocName) + "-shard-" +
+                std::to_string(shard_index)
+          : kLeaseDocName;
+  const std::string shard_spec =
+      std::to_string(shard_index) + "/" + std::to_string(shard_count);
+
   TFD_LOG_INFO << "tpu-feature-aggregator " << info::VersionString()
-               << " as " << self << " (output "
-               << flags.agg_output_name << ", debounce "
-               << flags.agg_debounce_s << "s, lease "
-               << flags.agg_lease_duration_s << "s)";
+               << " as " << self << " (output " << output_name
+               << ", debounce " << flags.agg_debounce_s << "s, lease "
+               << flags.agg_lease_duration_s << "s"
+               << (tier == Tier::kShard
+                       ? ", L1 shard " + flags.agg_shard
+                       : tier == Tier::kMerge
+                             ? ", L2 root of " +
+                                   std::to_string(flags.agg_merge_shards) +
+                                   " shards"
+                             : std::string())
+               << ")";
   FullRecomputeCounter();  // register at 0: the acceptance contract
   SetStateGauge(0);
+  obs::Default()
+      .GetGauge("tfd_agg_tier",
+                 "Aggregation tier this process runs: 0 flat singleton, "
+                 "1 L1 shard (partial publisher), 2 L2 merge root.")
+      ->Set(static_cast<double>(static_cast<int>(tier)));
 
   // Stage budgets: the derived defaults (agg.h provenance note), with
   // operator overrides from TFD_SLO_BUDGETS_MS ("stage=ms,..." — the
@@ -717,6 +869,10 @@ AggOutcome RunAggregator(const config::Config& config,
 
   Shared shared(static_cast<double>(flags.agg_debounce_s),
                 std::move(budgets));
+  shared.tier = tier;
+  shared.shard_index = shard_index;
+  shared.shard_count = shard_count;
+  shared.output_name = flags.agg_output_name;
   CollectionWatcher watcher(*cluster, &shared);
   LeaseState lease_state;
   bool apply_unsupported = false;
@@ -746,7 +902,8 @@ AggOutcome RunAggregator(const config::Config& config,
     double now = MonoSeconds();
     if (now >= next_lease_tick) {
       bool was_leading = lease_state.leading;
-      LeaseTick(*cluster, self, flags.agg_lease_duration_s, &lease_state);
+      LeaseTick(*cluster, lease_doc, self, flags.agg_lease_duration_s,
+                &lease_state);
       next_lease_tick = now + lease_tick_s;
       if (server && lease_state.ever_contacted) {
         server->RecordRewrite(true);  // lease contact = liveness
@@ -759,6 +916,7 @@ AggOutcome RunAggregator(const config::Config& config,
         watcher.Stop();
         std::lock_guard<std::mutex> lock(shared.mu);
         shared.store.Clear();
+        shared.merge.Clear();
         shared.synced = false;
         shared.flush.NoteFlushed();
       }
@@ -768,6 +926,7 @@ AggOutcome RunAggregator(const config::Config& config,
     lm::Labels output;
     std::string flush_change;
     double staleness_s = 0;
+    double flush_dirty_since = 0;
     std::vector<BurnEvaluator::Edge> burn_edges;
     {
       std::unique_lock<std::mutex> lock(shared.mu);
@@ -782,17 +941,30 @@ AggOutcome RunAggregator(const config::Config& config,
           lock, std::chrono::milliseconds(
                     static_cast<long long>(wait_s * 1000)));
       now = MonoSeconds();
-      if (lease_state.leading && shared.synced) {
+      if (lease_state.leading && shared.synced && tier != Tier::kShard) {
         // One burn-evaluation tick over the merged fleet sketches —
         // BEFORE the flush decision, so a verdict edge both dirties
-        // the window and rides the very flush it triggers.
-        burn_edges = shared.burn.Note(now, shared.store.stage_sketches());
+        // the window and rides the very flush it triggers. An L1 shard
+        // never burns: its sketches cover 1/n of the fleet — the fleet
+        // verdict belongs to the tier that merges them.
+        burn_edges = shared.burn.Note(
+            now, tier == Tier::kMerge ? shared.merge.stage_sketches()
+                                      : shared.store.stage_sketches());
         if (!burn_edges.empty()) shared.flush.NoteDirty(now);
       }
       if (lease_state.leading && shared.synced &&
           shared.flush.ShouldFlush(now) && now >= flush_retry_at) {
         flush_now = true;
-        output = shared.store.BuildOutputLabels();
+        if (tier == Tier::kShard) {
+          // An L1 publishes its PARTIAL — the whole aggregate as
+          // counter maps + sparse sketches, never scalars.
+          output = SerializePartialLabels(shared.store.Partial(),
+                                          shard_spec);
+        } else if (tier == Tier::kMerge) {
+          output = shared.merge.BuildOutputLabels();
+        } else {
+          output = shared.store.BuildOutputLabels();
+        }
         // Burning stages ride the rollup as labels: the scheduler (and
         // the soak's assertions) read the fleet burn verdict exactly
         // where the rollups live, no scrape required.
@@ -801,7 +973,16 @@ AggOutcome RunAggregator(const config::Config& config,
               "true";
         }
         flush_change = shared.last_change;
-        staleness_s = now - shared.flush.dirty_since();
+        flush_dirty_since = shared.flush.dirty_since();
+        staleness_s = now - flush_dirty_since;
+        // Consume the window at CAPTURE time, while the lock still
+        // covers the output snapshot above. A rollup that moves during
+        // the publish (the root's second partial landing while the
+        // first one's flush is in flight) then re-arms a fresh window
+        // instead of being erased by a post-publish NoteFlushed — that
+        // erasure silently dropped the last delta forever when no
+        // later event came to repair it.
+        shared.flush.NoteFlushed();
       }
     }
 
@@ -822,14 +1003,21 @@ AggOutcome RunAggregator(const config::Config& config,
 
     if (flush_now) {
       auto t0 = std::chrono::steady_clock::now();
-      Status published = PublishOutput(*cluster, flags.agg_output_name,
-                                       output, &apply_unsupported,
-                                       flush_change);
+      // A partial stamps the nfd node-name metadata label so the L2
+      // root's selector watch delivers it; the label's value is the
+      // partial's own name (no node owns this object).
+      lm::Labels meta_labels;
+      if (tier == Tier::kShard) meta_labels[kNodeNameLabel] = output_name;
+      Status published =
+          PublishOutput(*cluster, output_name, output, &apply_unsupported,
+                        flush_change, meta_labels);
       double write_s = obs::SecondsSince(t0);
       if (published.ok()) {
         {
+          // The window was consumed at capture time; a NoteDirty that
+          // landed while the publish was in flight opened a NEW window
+          // that must survive this success path untouched.
           std::lock_guard<std::mutex> lock(shared.mu);
-          shared.flush.NoteFlushed();
           // The echoed change is consumed by this flush: a later
           // rollup moved only by change-less events must not re-stamp
           // a stale id (a newer change that arrived mid-publish stays
@@ -856,7 +1044,7 @@ AggOutcome RunAggregator(const config::Config& config,
         obs::DefaultJournal().Record(
             "agg-flush", "agg",
             "published " + std::to_string(output.size()) +
-                " rollup labels to " + flags.agg_output_name,
+                " rollup labels to " + output_name,
             {{"labels", std::to_string(output.size())},
              {"staleness_ms",
               std::to_string(static_cast<long long>(
@@ -868,8 +1056,14 @@ AggOutcome RunAggregator(const config::Config& config,
           server->SetLabelsJson(json);
         }
       } else {
-        // Keep the window dirty; retry on a short cadence so a
-        // transient write failure costs seconds, not a lost publish.
+        // Re-open the consumed window at its ORIGINAL start so the
+        // retry still owes the full staleness; retry on a short
+        // cadence so a transient write failure costs seconds, not a
+        // lost publish.
+        {
+          std::lock_guard<std::mutex> lock(shared.mu);
+          shared.flush.ReArm(flush_dirty_since);
+        }
         flush_retry_at = MonoSeconds() + 1.0;
         if (server) server->RecordRewrite(false);
         obs::DefaultJournal().Record(

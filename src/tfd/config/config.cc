@@ -710,7 +710,10 @@ std::vector<FlagDef> MakeFlagDefs(Flags* f) {
                   "runs the lease-elected cluster-inventory singleton "
                   "(watches every NodeFeature CR, maintains per-slice/"
                   "capacity/fleet-perf rollups incrementally, publishes "
-                  "one cluster-scoped output object)",
+                  "one cluster-scoped output object); 'placement' runs "
+                  "the placement query service (informer-fed in-memory "
+                  "index over NodeFeature CRs answering POST "
+                  "/v1/placements with zero apiserver reads per query)",
                   false,
                   [f](const std::string& v) {
                     return SetString(&f->mode, v);
@@ -744,6 +747,47 @@ std::vector<FlagDef> MakeFlagDefs(Flags* f) {
                   false,
                   [f](const std::string& v) {
                     return SetString(&f->agg_output_name, v);
+                  }});
+  defs.push_back({"agg-shard",
+                  {"TFD_AGG_SHARD"},
+                  "aggShard",
+                  "sharded aggregation tree, L1 tier: 'i/n' makes this "
+                  "aggregator shard i of n — it watches only nodes whose "
+                  "FNV-1a name hash lands in its shard and publishes the "
+                  "partial rollup CR 'tfd-inventory-shard-i' (serialized "
+                  "sketches + counter maps) instead of the cluster "
+                  "inventory ('' = flat topology)",
+                  false,
+                  [f](const std::string& v) {
+                    return SetString(&f->agg_shard, v);
+                  }});
+  defs.push_back({"agg-merge-shards",
+                  {"TFD_AGG_MERGE_SHARDS"},
+                  "aggMergeShards",
+                  "sharded aggregation tree, L2 root: > 0 makes this "
+                  "aggregator the merge root consuming that many L1 "
+                  "partial CRs and publishing the cluster inventory "
+                  "byte-compatibly with the flat topology (0 = off; "
+                  "mutually exclusive with --agg-shard)",
+                  false,
+                  [f](const std::string& v) {
+                    int parsed = 0;
+                    if (!ParseNonNegInt(TrimSpace(v), &parsed)) {
+                      return Status::Error("agg-merge-shards must be a "
+                                           "non-negative integer");
+                    }
+                    f->agg_merge_shards = parsed;
+                    return Status::Ok();
+                  }});
+  defs.push_back({"placement-listen-addr",
+                  {"TFD_PLACEMENT_LISTEN_ADDR"},
+                  "placementListenAddr",
+                  "placement query service listen address "
+                  "(host:port for POST /v1/placements; --mode=placement "
+                  "only)",
+                  false,
+                  [f](const std::string& v) {
+                    return SetString(&f->placement_listen_addr, v);
                   }});
   defs.push_back({"perf-fleet-floor-source",
                   {"TFD_PERF_FLEET_FLOOR_SOURCE"},
@@ -1176,9 +1220,10 @@ Result<LoadResult> Load(int argc, char** argv) {
   if (f->plugin_label_budget < 1) {
     return Result<LoadResult>::Error("plugin-label-budget must be >= 1");
   }
-  if (f->mode != "daemon" && f->mode != "aggregator") {
-    return Result<LoadResult>::Error("invalid mode '" + f->mode +
-                                     "' (want daemon|aggregator)");
+  if (f->mode != "daemon" && f->mode != "aggregator" &&
+      f->mode != "placement") {
+    return Result<LoadResult>::Error(
+        "invalid mode '" + f->mode + "' (want daemon|aggregator|placement)");
   }
   if (f->agg_debounce_s < 0) {
     return Result<LoadResult>::Error("agg-debounce must be >= 0s");
@@ -1191,6 +1236,30 @@ Result<LoadResult> Load(int argc, char** argv) {
   if (f->mode == "aggregator" && f->agg_output_name.empty()) {
     return Result<LoadResult>::Error(
         "aggregator mode needs a non-empty agg-output-name");
+  }
+  if (!f->agg_shard.empty()) {
+    // "i/n": shard i of n, 0 <= i < n.
+    size_t slash = f->agg_shard.find('/');
+    int index = -1;
+    int count = 0;
+    bool ok = slash != std::string::npos && slash > 0 &&
+              ParseNonNegInt(f->agg_shard.substr(0, slash), &index) &&
+              ParseNonNegInt(f->agg_shard.substr(slash + 1), &count) &&
+              count >= 1 && index < count;
+    if (!ok) {
+      return Result<LoadResult>::Error(
+          "agg-shard must be 'i/n' with 0 <= i < n (got '" + f->agg_shard +
+          "')");
+    }
+    if (f->agg_merge_shards > 0) {
+      return Result<LoadResult>::Error(
+          "agg-shard (L1) and agg-merge-shards (L2 root) are mutually "
+          "exclusive — one process, one tier");
+    }
+  }
+  if (f->mode == "placement" && f->placement_listen_addr.empty()) {
+    return Result<LoadResult>::Error(
+        "placement mode needs a non-empty placement-listen-addr");
   }
   if (!f->fault_spec.empty()) {
     Status s = fault::Validate(f->fault_spec);
@@ -1291,6 +1360,9 @@ std::string ToJson(const Config& config) {
       << ",\"aggDebounce\":\"" << f.agg_debounce_s << "s\""
       << ",\"aggLeaseDuration\":\"" << f.agg_lease_duration_s << "s\""
       << ",\"aggOutputName\":" << jstr(f.agg_output_name)
+      << ",\"aggShard\":" << jstr(f.agg_shard)
+      << ",\"aggMergeShards\":" << f.agg_merge_shards
+      << ",\"placementListenAddr\":" << jstr(f.placement_listen_addr)
       << ",\"perfFleetFloorSource\":" << jstr(f.perf_fleet_floor_source)
       << ",\"lifecycleWatch\":" << (f.lifecycle_watch ? "true" : "false")
       << ",\"faultSpec\":" << jstr(f.fault_spec)

@@ -337,6 +337,22 @@ struct Flags {
   // aggregator applies its rollups to (excluded from its own watch by
   // the nfd node-name label selector).
   std::string agg_output_name = "tfd-cluster-inventory";
+  // Sharded aggregation tree, L1 tier (agg/agg.h ShardMergeStore):
+  // "i/n" makes this aggregator the lease-elected leader of shard i of
+  // n — it watches only nodes whose FNV-1a name hash lands in its
+  // shard and publishes the PARTIAL rollup CR "tfd-inventory-shard-i"
+  // (serialized sketches + counter maps) instead of the cluster
+  // inventory. "" = flat single-aggregator topology.
+  std::string agg_shard;
+  // Sharded aggregation tree, L2 root: > 0 makes this aggregator the
+  // merge root — it consumes the n L1 partial CRs through the same
+  // collection watch, merges them O(delta), and publishes
+  // agg_output_name byte-compatibly with the flat topology. 0 = off.
+  // Mutually exclusive with agg_shard.
+  int agg_merge_shards = 0;
+  // Placement query service (--mode=placement, placement/): the
+  // host:port the HTTP endpoint (POST /v1/placements) listens on.
+  std::string placement_listen_addr = "0.0.0.0:8780";
   // Fleet-relative perf floor input (perf/, ROADMAP #4a): a JSON file
   // carrying the aggregator-published fleet floors
   // ({"matmul_p10_tflops": N, "hbm_p10_gbps": N}); when set, a node

@@ -187,6 +187,30 @@ inline constexpr char kFleetHbmP50[] = "google.com/tpu.fleet.perf.hbm-p50";
 inline constexpr char kObsStagePrefix[] = "google.com/tpu.obs.stage.";
 inline constexpr char kSloBurnPrefix[] = "google.com/tpu.slo.";
 
+// Sharded aggregation tree (agg/, --agg-shard / --agg-merge-shards):
+// each lease-elected L1 shard publishes a PARTIAL rollup CR
+// ("tfd-inventory-shard-<i>") whose spec.labels carry the shard's
+// serialized aggregate — counter maps and sparse sketch buckets, not
+// scalars — under these keys. The L2 root consumes the partials through
+// the same collection watch, merges them O(delta) (retire old partial,
+// admit new), and republishes the byte-compatible cluster inventory.
+// Values are annotation-safe (alnum plus ':' ',' '-' '.' '='); slice
+// and multislice ids must not contain ':' or ','.
+inline constexpr char kAggPrefix[] = "google.com/tfd.agg.";
+inline constexpr char kAggTier[] = "google.com/tfd.agg.tier";
+inline constexpr char kAggShard[] = "google.com/tfd.agg.shard";
+inline constexpr char kAggNodes[] = "google.com/tfd.agg.nodes";
+inline constexpr char kAggPreempting[] = "google.com/tfd.agg.preempting";
+inline constexpr char kAggSlices[] = "google.com/tfd.agg.slices";
+inline constexpr char kAggCapacity[] = "google.com/tfd.agg.capacity";
+inline constexpr char kAggMultislice[] = "google.com/tfd.agg.multislice";
+inline constexpr char kAggMatmul[] = "google.com/tfd.agg.matmul";
+inline constexpr char kAggHbm[] = "google.com/tfd.agg.hbm";
+inline constexpr char kAggStageSlo[] = "google.com/tfd.agg.stage-slo";
+// The kAggTier value an L1 partial carries ("partial"); the merged root
+// output carries no tier key (byte-compat with the flat aggregator).
+inline constexpr char kAggTierPartial[] = "partial";
+
 // Degradation ladder (sched/): present only when the daemon is serving
 // CACHED device facts because the probe source missed its cadence
 // (chips held by a training job, wedged libtpu). Age is whole seconds

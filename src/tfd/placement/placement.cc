@@ -1,0 +1,1065 @@
+#include "tfd/placement/placement.h"
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <poll.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cctype>
+#include <chrono>
+#include <condition_variable>
+#include <mutex>
+#include <thread>
+
+#include "tfd/info/version.h"
+#include "tfd/k8s/client.h"
+#include "tfd/k8s/desync.h"
+#include "tfd/k8s/watch.h"
+#include "tfd/lm/schema.h"
+#include "tfd/obs/journal.h"
+#include "tfd/obs/metrics.h"
+#include "tfd/obs/server.h"
+#include "tfd/obs/slo.h"
+#include "tfd/util/http.h"
+#include "tfd/util/jsonlite.h"
+#include "tfd/util/logging.h"
+#include "tfd/util/strings.h"
+
+namespace tfd {
+namespace placement {
+
+namespace {
+
+// The daemon CR / inventory naming contract (agg/runner.cc): per-node
+// CRs are "tfd-features-for-<node>"; every "tfd-inventory-*" object is
+// an aggregation artifact (the root rollup or an L1 shard partial) and
+// never a node contribution.
+constexpr char kCrNamePrefix[] = "tfd-features-for-";
+// Published chip capacity (the same literal agg.cc's contribution
+// extractor reads).
+constexpr char kTpuCountLabel[] = "google.com/tpu.count";
+
+constexpr int kMaxConns = 16;
+constexpr size_t kMaxRequestBytes = 16384;
+constexpr int kConnDeadlineS = 10;
+constexpr int kPollTickMs = 1000;
+
+std::string Get(const lm::Labels& labels, const char* key) {
+  auto it = labels.find(key);
+  return it == labels.end() ? std::string() : it->second;
+}
+
+int64_t GetInt(const lm::Labels& labels, const char* key) {
+  std::string raw = Get(labels, key);
+  if (raw.empty()) return 0;
+  int value = 0;
+  if (!ParseNonNegInt(raw, &value)) return 0;
+  return value;
+}
+
+std::string HolderIdentity() {
+  const char* pod = std::getenv("POD_NAME");
+  if (pod != nullptr && *pod != '\0') return pod;
+  char host[256] = {0};
+  if (gethostname(host, sizeof(host) - 1) == 0 && host[0] != '\0') {
+    return host;
+  }
+  return "tfd-placement";
+}
+
+std::string HttpResponse(int status, const std::string& reason,
+                         const std::string& content_type,
+                         const std::string& body,
+                         const std::string& extra_header = "") {
+  std::string out =
+      "HTTP/1.1 " + std::to_string(status) + " " + reason + "\r\n";
+  out += "Content-Type: " + content_type + "\r\n";
+  out += "Content-Length: " + std::to_string(body.size()) + "\r\n";
+  if (!extra_header.empty()) out += extra_header + "\r\n";
+  out += "Connection: close\r\n\r\n";
+  out += body;
+  return out;
+}
+
+void SetNonBlockingCloexec(int fd) {
+  fcntl(fd, F_SETFL, fcntl(fd, F_GETFL, 0) | O_NONBLOCK);
+  fcntl(fd, F_SETFD, fcntl(fd, F_GETFD, 0) | FD_CLOEXEC);
+}
+
+obs::Counter* QueryCounter(const std::string& status) {
+  return obs::Default().GetCounter(
+      "tfd_placement_queries_total",
+      "Placement queries served, by outcome (placed / no-candidate / "
+      "no-capacity / bad-request).",
+      {{"status", status}});
+}
+
+obs::Counter* IngestCounter(const char* type) {
+  return obs::Default().GetCounter(
+      "tfd_placement_events_total",
+      "Collection events the placement index consumed, by type (list "
+      "items count as 'listed'; 'inventory' is a rollup-object ingest).",
+      {{"type", type}});
+}
+
+void SetIndexGauges(const PlacementIndex& index) {
+  obs::Default()
+      .GetGauge("tfd_placement_nodes",
+                "Nodes currently retained in the placement index.")
+      ->Set(static_cast<double>(index.nodes()));
+  obs::Default()
+      .GetGauge("tfd_placement_eligible_nodes",
+                "Basic-eligible nodes in the placement index (candidate "
+                "population before per-query class/chips/slice filters).")
+      ->Set(static_cast<double>(index.eligible()));
+  obs::Default()
+      .GetGauge("tfd_placement_blocked_slices",
+                "Slice ids blocked by the worst-of-members rule (at "
+                "least one member publishes a degraded-slice verdict).")
+      ->Set(static_cast<double>(index.blocked_slices()));
+}
+
+}  // namespace
+
+// ---- the eligibility contract (tpufd/cluster.py, bit-for-bit) ------------
+
+int ClassRank(const std::string& perf_class) {
+  if (perf_class == "gold") return 3;
+  if (perf_class == "silver") return 2;
+  if (perf_class == "degraded") return 1;
+  return 0;
+}
+
+int JobMinRank(const std::string& wanted) {
+  if (wanted == "gold") return 3;
+  if (wanted == "silver") return 2;
+  if (wanted == "any") return 0;
+  return -1;
+}
+
+bool Preempting(const lm::Labels& labels) {
+  return Get(labels, lm::kLifecyclePreemptImminent) == "true" ||
+         Get(labels, lm::kLifecycleDraining) == "true";
+}
+
+bool BasicEligible(const lm::Labels& labels) {
+  if (Get(labels, lm::kPerfClass) == "degraded") return false;
+  if (Get(labels, lm::kSliceDegraded) == "true") return false;
+  if (Get(labels, lm::kSliceClass) == "degraded") return false;
+  if (Preempting(labels)) return false;
+  return true;
+}
+
+bool SliceDegradedClaim(const lm::Labels& labels) {
+  return Get(labels, lm::kSliceDegraded) == "true" ||
+         Get(labels, lm::kSliceClass) == "degraded";
+}
+
+// ---- the index -----------------------------------------------------------
+
+void PlacementIndex::Insert(const std::string& node, const Entry& entry) {
+  if (entry.basic) {
+    by_rank_[entry.rank].insert({-entry.chips, node});
+  }
+  if (entry.claim && !entry.slice_id.empty()) {
+    if (++claims_[entry.slice_id] == 1) blocked_.insert(entry.slice_id);
+  }
+}
+
+void PlacementIndex::Erase(const std::string& node, const Entry& entry) {
+  if (entry.basic) {
+    auto it = by_rank_.find(entry.rank);
+    if (it != by_rank_.end()) {
+      it->second.erase({-entry.chips, node});
+      if (it->second.empty()) by_rank_.erase(it);
+    }
+  }
+  if (entry.claim && !entry.slice_id.empty()) {
+    auto it = claims_.find(entry.slice_id);
+    if (it != claims_.end() && --it->second <= 0) {
+      claims_.erase(it);
+      blocked_.erase(entry.slice_id);
+    }
+  }
+}
+
+bool PlacementIndex::ApplyNode(const std::string& node,
+                               const lm::Labels& labels) {
+  Entry entry;
+  entry.perf_class = Get(labels, lm::kPerfClass);
+  entry.rank = ClassRank(entry.perf_class);
+  entry.chips = GetInt(labels, kTpuCountLabel);
+  entry.slice_id = Get(labels, lm::kSliceId);
+  entry.basic = BasicEligible(labels);
+  entry.claim = SliceDegradedClaim(labels);
+
+  auto it = nodes_.find(node);
+  if (it != nodes_.end()) {
+    const Entry& old = it->second;
+    if (old.perf_class == entry.perf_class && old.chips == entry.chips &&
+        old.slice_id == entry.slice_id && old.basic == entry.basic &&
+        old.claim == entry.claim) {
+      return false;  // no index movement
+    }
+    Erase(node, old);
+    it->second = entry;
+  } else {
+    nodes_.emplace(node, entry);
+  }
+  Insert(node, entry);
+  events_++;
+  return true;
+}
+
+bool PlacementIndex::RemoveNode(const std::string& node) {
+  auto it = nodes_.find(node);
+  if (it == nodes_.end()) return false;
+  Erase(node, it->second);
+  nodes_.erase(it);
+  events_++;
+  return true;
+}
+
+void PlacementIndex::ApplyInventory(const lm::Labels& labels) {
+  inventory_capacity_.clear();
+  have_inventory_ = !labels.empty();
+  const std::string prefix = lm::kCapacityPrefix;
+  for (const auto& [key, value] : labels) {
+    if (key.rfind(prefix, 0) != 0) continue;
+    std::string bucket = key.substr(prefix.size());
+    // SimScheduler.admit: `int(raw) if raw.isdigit() else 0`.
+    bool digits = !value.empty() &&
+                  std::all_of(value.begin(), value.end(), [](char c) {
+                    return c >= '0' && c <= '9';
+                  });
+    int parsed = 0;
+    if (digits) ParseNonNegInt(value, &parsed);
+    inventory_capacity_[bucket] = parsed;
+  }
+  events_++;
+}
+
+bool PlacementIndex::Admit(int min_rank, int chips) const {
+  if (!have_inventory_) return true;
+  static constexpr struct {
+    const char* bucket;
+    int rank;
+  } kBuckets[] = {{"gold", 3}, {"silver", 2}, {"unclassed", 0}};
+  int64_t total = 0;
+  for (const auto& b : kBuckets) {
+    if (b.rank < min_rank) continue;
+    auto it = inventory_capacity_.find(b.bucket);
+    if (it != inventory_capacity_.end()) total += it->second;
+  }
+  return total >= chips;
+}
+
+size_t PlacementIndex::eligible() const {
+  size_t count = 0;
+  for (const auto& [rank, set] : by_rank_) {
+    (void)rank;
+    count += set.size();
+  }
+  return count;
+}
+
+std::vector<std::string> PlacementIndex::NodeNames() const {
+  std::vector<std::string> names;
+  names.reserve(nodes_.size());
+  for (const auto& [node, entry] : nodes_) {
+    (void)entry;
+    names.push_back(node);
+  }
+  return names;
+}
+
+PlacementResult PlacementIndex::Query(const PlacementQuery& query) const {
+  PlacementResult out;
+  const int min_rank = JobMinRank(query.wanted);
+  const int limit =
+      std::max(1, std::min(query.limit, kMaxLimit));
+  if (!Admit(min_rank, query.chips)) {
+    out.status = "no-capacity";
+    return out;
+  }
+  for (const auto& [rank, set] : by_rank_) {
+    if (rank < min_rank) break;  // ranks iterate descending
+    for (const auto& [neg_free, node] : set) {
+      int64_t free = -neg_free;
+      if (free < query.chips) break;  // free descends within a rank
+      const Entry& entry = nodes_.at(node);
+      if (entry.slice_id.empty()) {
+        if (query.slice) continue;  // multislice job needs a member
+      } else if (blocked_.count(entry.slice_id) != 0) {
+        continue;  // worst-of-members: a peer's verdict blocks it
+      }
+      out.candidates.push_back(
+          {node, entry.perf_class, free, entry.slice_id});
+      if (static_cast<int>(out.candidates.size()) >= limit) {
+        out.status = "placed";
+        return out;
+      }
+    }
+  }
+  out.status = out.candidates.empty() ? "no-candidate" : "placed";
+  return out;
+}
+
+// ---- wire protocol -------------------------------------------------------
+
+std::string ParsePlacementBody(const std::string& body,
+                               PlacementQuery* query) {
+  *query = PlacementQuery();
+  Result<jsonlite::ValuePtr> parsed = jsonlite::Parse(body);
+  if (!parsed.ok()) return "malformed JSON: " + parsed.error();
+  const jsonlite::ValuePtr& root = *parsed;
+  if (root->kind != jsonlite::Value::Kind::kObject) {
+    return "request body must be a JSON object";
+  }
+  if (jsonlite::ValuePtr v = root->Get("class"); v) {
+    if (v->kind != jsonlite::Value::Kind::kString) {
+      return "'class' must be a string";
+    }
+    query->wanted = v->string_value;
+  }
+  if (JobMinRank(query->wanted) < 0) {
+    return "unknown class '" + query->wanted +
+           "' (want gold, silver or any)";
+  }
+  if (jsonlite::ValuePtr v = root->Get("chips"); v) {
+    if (v->kind != jsonlite::Value::Kind::kNumber ||
+        v->number_value < 0 || v->number_value > 1e9 ||
+        v->number_value != static_cast<int>(v->number_value)) {
+      return "'chips' must be a non-negative integer";
+    }
+    query->chips = static_cast<int>(v->number_value);
+  }
+  if (jsonlite::ValuePtr v = root->Get("slice"); v) {
+    if (v->kind != jsonlite::Value::Kind::kBool) {
+      return "'slice' must be a boolean";
+    }
+    query->slice = v->bool_value;
+  }
+  if (jsonlite::ValuePtr v = root->Get("limit"); v) {
+    if (v->kind != jsonlite::Value::Kind::kNumber ||
+        v->number_value < 1 ||
+        v->number_value > PlacementIndex::kMaxLimit ||
+        v->number_value != static_cast<int>(v->number_value)) {
+      return "'limit' must be an integer in [1, " +
+             std::to_string(PlacementIndex::kMaxLimit) + "]";
+    }
+    query->limit = static_cast<int>(v->number_value);
+  }
+  return "";
+}
+
+std::string RenderPlacementResult(const PlacementResult& result) {
+  std::string out = "{\"status\":" + jsonlite::Quote(result.status) +
+                    ",\"candidates\":[";
+  bool first = true;
+  for (const Candidate& c : result.candidates) {
+    if (!first) out += ",";
+    first = false;
+    out += "{\"node\":" + jsonlite::Quote(c.node) +
+           ",\"class\":" + jsonlite::Quote(c.perf_class) +
+           ",\"free\":" + std::to_string(c.free) +
+           ",\"slice\":" + jsonlite::Quote(c.slice_id) + "}";
+  }
+  out += "]}";
+  return out;
+}
+
+namespace {
+
+// ---- shared state between the ingest thread and the query server --------
+
+struct Shared {
+  std::mutex mu;
+  PlacementIndex index;
+  bool synced = false;
+  std::string inventory_name;  // the root rollup object we admit from
+};
+
+// ---- the query server ----------------------------------------------------
+
+// POST-capable sibling of obs::IntrospectionServer's poll loop: the
+// introspection server is deliberately GET-only (it never reads a
+// body), so the query endpoint gets its own socket + loop. Same
+// traffic model, same budgets, plus Content-Length framing.
+class QueryServer {
+ public:
+  static Result<std::unique_ptr<QueryServer>> Start(
+      const std::string& addr, Shared* shared) {
+    using R = Result<std::unique_ptr<QueryServer>>;
+    Result<obs::ListenAddr> parsed = obs::ParseListenAddr(addr);
+    if (!parsed.ok()) return R::Error(parsed.error());
+
+    int fd = socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0);
+    if (fd < 0) return R::Error(std::string("socket: ") + strerror(errno));
+    int one = 1;
+    setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in sa{};
+    sa.sin_family = AF_INET;
+    sa.sin_port = htons(static_cast<uint16_t>(parsed->port));
+    if (parsed->host.empty()) {
+      sa.sin_addr.s_addr = htonl(INADDR_ANY);
+    } else {
+      inet_pton(AF_INET, parsed->host.c_str(), &sa.sin_addr);
+    }
+    if (bind(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) != 0) {
+      std::string err = strerror(errno);
+      close(fd);
+      return R::Error("bind " + addr + ": " + err);
+    }
+    if (listen(fd, 64) != 0) {
+      std::string err = strerror(errno);
+      close(fd);
+      return R::Error("listen " + addr + ": " + err);
+    }
+    SetNonBlockingCloexec(fd);
+    sockaddr_in bound{};
+    socklen_t bound_len = sizeof(bound);
+    getsockname(fd, reinterpret_cast<sockaddr*>(&bound), &bound_len);
+
+    auto server = std::unique_ptr<QueryServer>(new QueryServer());
+    server->shared_ = shared;
+    server->listen_fd_ = fd;
+    server->port_ = ntohs(bound.sin_port);
+    if (pipe(server->wake_fds_) != 0) {
+      close(fd);
+      return R::Error(std::string("pipe: ") + strerror(errno));
+    }
+    SetNonBlockingCloexec(server->wake_fds_[0]);
+    SetNonBlockingCloexec(server->wake_fds_[1]);
+    QueryServer* raw = server.get();
+    server->thread_ = std::thread([raw] { raw->Loop(); });
+    return server;
+  }
+
+  ~QueryServer() {
+    if (!stopping_.exchange(true)) {
+      ssize_t ignored = write(wake_fds_[1], "x", 1);
+      (void)ignored;
+    }
+    if (thread_.joinable()) thread_.join();
+    for (Conn& conn : conns_) {
+      if (conn.fd >= 0) close(conn.fd);
+    }
+    if (listen_fd_ >= 0) close(listen_fd_);
+    for (int fd : wake_fds_) {
+      if (fd >= 0) close(fd);
+    }
+  }
+
+  int port() const { return port_; }
+
+ private:
+  QueryServer() = default;
+
+  struct Conn {
+    int fd = -1;
+    std::string in;
+    std::string out;
+    size_t out_off = 0;
+    std::chrono::steady_clock::time_point opened;
+    bool responding = false;
+  };
+
+  // A request is complete when the headers have landed AND
+  // Content-Length more bytes followed them (the introspection server
+  // never frames bodies; placement queries are bodies).
+  static bool RequestComplete(const std::string& in, size_t* header_end,
+                              size_t* body_len) {
+    size_t end = in.find("\r\n\r\n");
+    size_t sep = 4;
+    if (end == std::string::npos) {
+      end = in.find("\n\n");
+      sep = 2;
+    }
+    if (end == std::string::npos) return false;
+    *header_end = end + sep;
+    size_t length = 0;
+    std::string lower;
+    lower.reserve(end);
+    for (size_t i = 0; i < end; i++) {
+      lower.push_back(
+          static_cast<char>(tolower(static_cast<unsigned char>(in[i]))));
+    }
+    size_t pos = lower.find("content-length:");
+    if (pos != std::string::npos) {
+      pos += sizeof("content-length:") - 1;
+      while (pos < lower.size() && lower[pos] == ' ') pos++;
+      while (pos < lower.size() && isdigit(static_cast<unsigned char>(
+                                       lower[pos]))) {
+        length = length * 10 +
+                 static_cast<size_t>(lower[pos] - '0');
+        pos++;
+        if (length > kMaxRequestBytes) break;
+      }
+    }
+    *body_len = length;
+    return in.size() >= *header_end + length;
+  }
+
+  void HandleRequest(Conn* conn) {
+    conn->responding = true;
+    size_t header_end = 0;
+    size_t body_len = 0;
+    RequestComplete(conn->in, &header_end, &body_len);
+    size_t line_end = conn->in.find("\r\n");
+    if (line_end == std::string::npos) line_end = conn->in.find('\n');
+    std::string request_line = conn->in.substr(0, line_end);
+    size_t sp1 = request_line.find(' ');
+    size_t sp2 = request_line.rfind(' ');
+    if (sp1 == std::string::npos || sp2 <= sp1) {
+      conn->out = HttpResponse(400, "Bad Request", "text/plain",
+                               "malformed request line\n");
+      return;
+    }
+    std::string method = request_line.substr(0, sp1);
+    std::string path = request_line.substr(sp1 + 1, sp2 - sp1 - 1);
+    size_t qmark = path.find('?');
+    if (qmark != std::string::npos) path = path.substr(0, qmark);
+
+    if (path == "/v1/placements") {
+      if (method != "POST") {
+        conn->out =
+            HttpResponse(405, "Method Not Allowed", "text/plain",
+                         "placements are POST-only\n", "Allow: POST");
+        return;
+      }
+      std::string body = conn->in.substr(header_end, body_len);
+      ServePlacement(conn, body);
+      return;
+    }
+    if (method != "GET") {
+      conn->out = HttpResponse(405, "Method Not Allowed", "text/plain",
+                               "only GET is served here\n", "Allow: GET");
+      return;
+    }
+    if (path == "/healthz") {
+      conn->out = HttpResponse(200, "OK", "text/plain", "ok\n");
+    } else if (path == "/readyz") {
+      bool ready;
+      {
+        std::lock_guard<std::mutex> lock(shared_->mu);
+        ready = shared_->synced;
+      }
+      conn->out = ready ? HttpResponse(200, "OK", "text/plain", "ready\n")
+                        : HttpResponse(503, "Service Unavailable",
+                                       "text/plain",
+                                       "collection not yet listed\n");
+    } else {
+      conn->out = HttpResponse(404, "Not Found", "text/plain",
+                               "serves /healthz, /readyz and "
+                               "POST /v1/placements\n");
+    }
+  }
+
+  void ServePlacement(Conn* conn, const std::string& body) {
+    auto t0 = std::chrono::steady_clock::now();
+    PlacementQuery query;
+    std::string error = ParsePlacementBody(body, &query);
+    if (!error.empty()) {
+      QueryCounter("bad-request")->Inc();
+      conn->out = HttpResponse(400, "Bad Request", "application/json",
+                               "{\"error\":" + jsonlite::Quote(error) +
+                                   "}\n");
+      return;
+    }
+    PlacementResult result;
+    {
+      std::lock_guard<std::mutex> lock(shared_->mu);
+      result = shared_->index.Query(query);
+    }
+    QueryCounter(result.status)->Inc();
+    obs::Default()
+        .GetHistogram("tfd_placement_query_seconds",
+                      "Wall time of one placement query, parse to "
+                      "rendered response (index scan included).",
+                      obs::DurationBuckets())
+        ->Observe(obs::SecondsSince(t0));
+    conn->out = HttpResponse(200, "OK", "application/json",
+                             RenderPlacementResult(result) + "\n");
+  }
+
+  void Loop() {
+    while (!stopping_.load()) {
+      std::vector<pollfd> fds;
+      fds.push_back({wake_fds_[0], POLLIN, 0});
+      const bool accepting = conns_.size() < kMaxConns;
+      if (accepting) fds.push_back({listen_fd_, POLLIN, 0});
+      for (Conn& conn : conns_) {
+        fds.push_back({conn.fd,
+                       static_cast<short>(conn.responding ? POLLOUT
+                                                          : POLLIN),
+                       0});
+      }
+      int rc = poll(fds.data(), fds.size(), kPollTickMs);
+      if (stopping_.load()) return;
+      if (rc < 0) {
+        if (errno == EINTR) continue;
+        TFD_LOG_WARNING << "placement poll failed: " << strerror(errno)
+                        << "; query server exiting";
+        return;
+      }
+      size_t idx = 1;
+      if (accepting) {
+        if (fds[idx].revents & POLLIN) {
+          while (true) {
+            int client = accept(listen_fd_, nullptr, nullptr);
+            if (client < 0) break;
+            SetNonBlockingCloexec(client);
+            Conn conn;
+            conn.fd = client;
+            conn.opened = std::chrono::steady_clock::now();
+            conns_.push_back(std::move(conn));
+            if (conns_.size() >= kMaxConns) break;
+          }
+        }
+        idx++;
+      }
+      auto now = std::chrono::steady_clock::now();
+      size_t polled = fds.size() - idx;
+      for (size_t c = 0; c < polled; c++, idx++) {
+        Conn& conn = conns_[c];
+        bool drop = false;
+        if (fds[idx].revents & (POLLERR | POLLHUP | POLLNVAL)) {
+          drop = true;
+        } else if (!conn.responding && (fds[idx].revents & POLLIN)) {
+          char buf[4096];
+          ssize_t n = read(conn.fd, buf, sizeof(buf));
+          if (n <= 0) {
+            if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+              // spurious wakeup
+            } else {
+              drop = true;
+            }
+          } else {
+            conn.in.append(buf, static_cast<size_t>(n));
+            size_t header_end = 0;
+            size_t body_len = 0;
+            if (conn.in.size() > kMaxRequestBytes) {
+              conn.out = HttpResponse(413, "Payload Too Large",
+                                      "text/plain", "request too large\n");
+              conn.responding = true;
+            } else if (RequestComplete(conn.in, &header_end, &body_len)) {
+              HandleRequest(&conn);
+            }
+          }
+        } else if (conn.responding && (fds[idx].revents & POLLOUT)) {
+          ssize_t n = send(conn.fd, conn.out.data() + conn.out_off,
+                           conn.out.size() - conn.out_off, MSG_NOSIGNAL);
+          if (n < 0) {
+            if (errno != EAGAIN && errno != EWOULDBLOCK) drop = true;
+          } else {
+            conn.out_off += static_cast<size_t>(n);
+            if (conn.out_off >= conn.out.size()) drop = true;  // done
+          }
+        }
+        if (!drop &&
+            now - conn.opened > std::chrono::seconds(kConnDeadlineS)) {
+          drop = true;
+        }
+        conn.fd = drop ? (close(conn.fd), -1) : conn.fd;
+      }
+      conns_.erase(std::remove_if(conns_.begin(), conns_.end(),
+                                  [](const Conn& c) { return c.fd < 0; }),
+                   conns_.end());
+    }
+  }
+
+  Shared* shared_ = nullptr;
+  int listen_fd_ = -1;
+  int port_ = 0;
+  int wake_fds_[2] = {-1, -1};
+  std::thread thread_;
+  std::atomic<bool> stopping_{false};
+  std::vector<Conn> conns_;
+};
+
+// ---- the collection ingest -----------------------------------------------
+
+std::string CollectionUrl(const k8s::ClusterConfig& config) {
+  return config.apiserver_url +
+         "/apis/nfd.k8s-sigs.io/v1alpha1/namespaces/" + config.namespace_ +
+         "/nodefeatures";
+}
+
+http::RequestOptions BaseOptions(const k8s::ClusterConfig& config) {
+  http::RequestOptions options;
+  options.ca_file = config.ca_file;
+  if (!config.token.empty()) {
+    options.headers["Authorization"] = "Bearer " + config.token;
+  }
+  options.headers["Accept"] = "application/json";
+  return options;
+}
+
+// One long-lived list-then-watch over the WHOLE collection — no label
+// selector, because the inventory rollup object (the admission input)
+// deliberately carries no node-name label and a selector watch would
+// never deliver it. Same resume/backoff discipline as the aggregator's
+// CollectionWatcher.
+class Ingest {
+ public:
+  Ingest(k8s::ClusterConfig config, Shared* shared)
+      : config_(std::move(config)), shared_(shared) {}
+  ~Ingest() { Stop(); }
+
+  void Start() {
+    if (started_) return;
+    started_ = true;
+    stop_.store(false);
+    thread_ = std::thread([this] { RunLoop(); });
+  }
+
+  void Stop() {
+    if (!started_) return;
+    stop_.store(true);
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      cv_.notify_all();
+    }
+    int fd = stream_fd_.load();
+    if (fd >= 0) shutdown(fd, SHUT_RDWR);
+    if (thread_.joinable()) thread_.join();
+    started_ = false;
+  }
+
+ private:
+  bool SleepFor(double seconds) {
+    std::unique_lock<std::mutex> lock(mu_);
+    cv_.wait_for(lock,
+                 std::chrono::milliseconds(
+                     static_cast<long long>(seconds * 1000)),
+                 [this] { return stop_.load(); });
+    return !stop_.load();
+  }
+
+  void ApplyObject(const std::string& name, const lm::Labels& labels,
+                   bool deleted) {
+    std::lock_guard<std::mutex> lock(shared_->mu);
+    if (name == shared_->inventory_name) {
+      shared_->index.ApplyInventory(deleted ? lm::Labels{} : labels);
+      IngestCounter("inventory")->Inc();
+    } else if (name.rfind(kCrNamePrefix, 0) == 0) {
+      std::string node = name.substr(sizeof(kCrNamePrefix) - 1);
+      if (deleted) {
+        shared_->index.RemoveNode(node);
+      } else {
+        shared_->index.ApplyNode(node, labels);
+      }
+    } else {
+      return;  // shard partials and strangers: never node contributions
+    }
+    SetIndexGauges(shared_->index);
+  }
+
+  Status ListOnce(std::string* rv) {
+    http::RequestOptions options = BaseOptions(config_);
+    options.timeout_ms = 15000;
+    options.deadline_ms = 30000;
+    Result<http::Response> listed =
+        http::Request("GET", CollectionUrl(config_), "", options);
+    if (!listed.ok()) return Status::Error("list failed: " + listed.error());
+    if (listed->status == 429 || listed->status == 503) {
+      return Status::Error("list throttled (HTTP " +
+                           std::to_string(listed->status) + ")");
+    }
+    if (listed->status != 200) {
+      return Status::Error("list HTTP " + std::to_string(listed->status));
+    }
+    Result<jsonlite::ValuePtr> parsed = jsonlite::Parse(listed->body);
+    if (!parsed.ok()) return Status::Error("list parse: " + parsed.error());
+    if (jsonlite::ValuePtr v = (*parsed)->GetPath("metadata.resourceVersion");
+        v && v->kind == jsonlite::Value::Kind::kString) {
+      *rv = v->string_value;
+    }
+    std::set<std::string> listed_nodes;
+    bool saw_inventory = false;
+    jsonlite::ValuePtr items = (*parsed)->Get("items");
+    if (items && items->kind == jsonlite::Value::Kind::kArray) {
+      for (const jsonlite::ValuePtr& item : items->array_items) {
+        if (!item || item->kind != jsonlite::Value::Kind::kObject) continue;
+        std::string name;
+        if (jsonlite::ValuePtr n = item->GetPath("metadata.name");
+            n && n->kind == jsonlite::Value::Kind::kString) {
+          name = n->string_value;
+        }
+        lm::Labels labels;
+        if (jsonlite::ValuePtr l = item->GetPath("spec.labels");
+            l && l->kind == jsonlite::Value::Kind::kObject) {
+          for (const auto& [k, v] : l->object_items) {
+            if (v && v->kind == jsonlite::Value::Kind::kString) {
+              labels[k] = v->string_value;
+            }
+          }
+        }
+        if (name == shared_->inventory_name) {
+          saw_inventory = true;
+        } else if (name.rfind(kCrNamePrefix, 0) == 0) {
+          listed_nodes.insert(name.substr(sizeof(kCrNamePrefix) - 1));
+        }
+        IngestCounter("listed")->Inc();
+        ApplyObject(name, labels, /*deleted=*/false);
+      }
+    }
+    std::vector<std::string> known;
+    bool had_inventory;
+    {
+      std::lock_guard<std::mutex> lock(shared_->mu);
+      known = shared_->index.NodeNames();
+      had_inventory = shared_->index.have_inventory();
+    }
+    for (const std::string& node : known) {
+      if (listed_nodes.count(node) == 0) {
+        ApplyObject(kCrNamePrefix + node, {}, /*deleted=*/true);
+      }
+    }
+    if (had_inventory && !saw_inventory) {
+      ApplyObject(shared_->inventory_name, {}, /*deleted=*/true);
+    }
+    return Status::Ok();
+  }
+
+  void RunLoop() {
+    const std::string node_key = HolderIdentity();
+    std::string rv;
+    int consecutive_failures = 0;
+
+    while (!stop_.load()) {
+      if (rv.empty()) {
+        Status listed = ListOnce(&rv);
+        if (!listed.ok()) {
+          consecutive_failures++;
+          double pause = std::min(
+              30.0, 1.0 * (1 << std::min(consecutive_failures - 1, 10)));
+          TFD_LOG_WARNING << "placement list: " << listed.message()
+                          << "; retrying in ~" << pause << "s";
+          if (!SleepFor(k8s::desync::SpreadRetryAfterS(pause, node_key))) {
+            return;
+          }
+          continue;
+        }
+        consecutive_failures = 0;
+        size_t nodes;
+        bool first_sync;
+        {
+          std::lock_guard<std::mutex> lock(shared_->mu);
+          first_sync = !shared_->synced;
+          shared_->synced = true;
+          nodes = shared_->index.nodes();
+        }
+        obs::DefaultJournal().Record(
+            first_sync ? "placement-synced" : "placement-resync",
+            "placement",
+            (first_sync ? std::string("initial sync: ")
+                        : std::string("re-list after 410: ")) +
+                std::to_string(nodes) + " nodes at rv " + rv,
+            {{"nodes", std::to_string(nodes)},
+             {"resource_version", rv}});
+      }
+
+      std::string url = CollectionUrl(config_) +
+                        "?watch=true&allowWatchBookmarks=true"
+                        "&timeoutSeconds=240";
+      if (!rv.empty()) url += "&resourceVersion=" + rv;
+      http::RequestOptions stream_options = BaseOptions(config_);
+      stream_options.timeout_ms = 300000;
+      stream_options.connect_timeout_ms = 5000;
+
+      bool established = false;
+      bool resync_gone = false;
+      double server_retry_after = 0;
+      int stream_status = 0;
+      std::string line_buffer;
+      http::StreamHandler handler;
+      handler.on_connected = [this](int fd) { stream_fd_.store(fd); };
+      handler.on_response = [&](const http::Response& head) {
+        stream_status = head.status;
+        server_retry_after = head.RetryAfterSeconds();
+        if (head.status == 200) {
+          established = true;
+          consecutive_failures = 0;
+          return true;
+        }
+        return false;
+      };
+      handler.on_data = [&](const char* data, size_t len) {
+        if (stop_.load()) return false;
+        line_buffer.append(data, len);
+        size_t start = 0;
+        size_t eol;
+        while ((eol = line_buffer.find('\n', start)) != std::string::npos) {
+          std::string line = line_buffer.substr(start, eol - start);
+          start = eol + 1;
+          if (line.empty() || line == "\r") continue;
+          k8s::WatchEvent event = k8s::ParseWatchEventLine(line);
+          switch (event.type) {
+            case k8s::WatchEvent::Type::kBookmark:
+              if (!event.resource_version.empty()) {
+                rv = event.resource_version;
+              }
+              break;
+            case k8s::WatchEvent::Type::kError:
+              if (event.error_code == 410) {
+                resync_gone = true;
+                line_buffer.clear();
+                return false;
+              }
+              break;
+            case k8s::WatchEvent::Type::kAdded:
+            case k8s::WatchEvent::Type::kModified:
+            case k8s::WatchEvent::Type::kDeleted:
+              if (!event.resource_version.empty()) {
+                rv = event.resource_version;
+              }
+              IngestCounter(k8s::WatchEventTypeName(event.type))->Inc();
+              ApplyObject(event.name, event.labels,
+                          event.type == k8s::WatchEvent::Type::kDeleted);
+              break;
+            case k8s::WatchEvent::Type::kUnknown:
+              break;
+          }
+        }
+        line_buffer.erase(0, start);
+        if (line_buffer.size() > 1024 * 1024) line_buffer.clear();
+        return true;
+      };
+
+      Status streamed =
+          http::RequestStream("GET", url, "", stream_options, handler);
+      stream_fd_.store(-1);
+      if (stop_.load()) return;
+
+      if (resync_gone || stream_status == 410) {
+        obs::DefaultJournal().Record(
+            "placement-resync", "placement",
+            "collection watch resourceVersion too old (410 Gone); "
+            "re-listing once",
+            {{"resource_version", rv}});
+        rv.clear();
+        continue;
+      }
+      if (streamed.ok() && established) continue;  // clean rotation
+      if (stream_status == 429 || stream_status == 503 ||
+          server_retry_after > 0) {
+        double pause = server_retry_after > 0 ? server_retry_after : 1.0;
+        if (!SleepFor(k8s::desync::SpreadRetryAfterS(pause, node_key))) {
+          return;
+        }
+        continue;
+      }
+      consecutive_failures++;
+      double pause = std::min(
+          30.0, 1.0 * (1 << std::min(consecutive_failures - 1, 10)));
+      TFD_LOG_WARNING << "placement watch dropped ("
+                      << (!streamed.ok()
+                              ? streamed.message()
+                              : "HTTP " + std::to_string(stream_status))
+                      << "); reconnecting in ~" << pause << "s";
+      if (!SleepFor(k8s::desync::SpreadRetryAfterS(pause, node_key))) {
+        return;
+      }
+    }
+  }
+
+  k8s::ClusterConfig config_;
+  Shared* shared_;
+  std::thread thread_;
+  std::atomic<bool> stop_{false};
+  std::atomic<int> stream_fd_{-1};
+  std::mutex mu_;
+  std::condition_variable cv_;
+  bool started_ = false;
+};
+
+}  // namespace
+
+// ---- the mode ------------------------------------------------------------
+
+PlacementOutcome RunPlacement(const config::Config& config,
+                              const sigset_t& sigmask) {
+  const config::Flags& flags = config.flags;
+  Result<k8s::ClusterConfig> cluster = k8s::LoadInClusterEndpoint();
+  if (!cluster.ok()) {
+    TFD_LOG_ERROR << "placement: " << cluster.error();
+    return PlacementOutcome::kError;
+  }
+  cluster->request_deadline_ms = flags.sink_request_deadline_s * 1000;
+
+  std::unique_ptr<obs::IntrospectionServer> server;
+  if (!flags.introspection_addr.empty()) {
+    obs::ServerOptions options;
+    options.addr = flags.introspection_addr;
+    options.journal = &obs::DefaultJournal();
+    options.stale_after_s = 120;
+    Result<std::unique_ptr<obs::IntrospectionServer>> started =
+        obs::IntrospectionServer::Start(options, &obs::Default());
+    if (!started.ok()) {
+      TFD_LOG_ERROR << "placement introspection server: "
+                    << started.error();
+      return PlacementOutcome::kError;
+    }
+    server = std::move(*started);
+    TFD_LOG_INFO << "placement introspection on port " << server->port();
+  }
+
+  Shared shared;
+  shared.inventory_name = flags.agg_output_name;
+  // Register the families at zero so the acceptance checks scrape
+  // deterministically before the first query.
+  QueryCounter("placed");
+  QueryCounter("no-candidate");
+  QueryCounter("no-capacity");
+  QueryCounter("bad-request");
+  SetIndexGauges(shared.index);
+
+  Result<std::unique_ptr<QueryServer>> query_server =
+      QueryServer::Start(flags.placement_listen_addr, &shared);
+  if (!query_server.ok()) {
+    TFD_LOG_ERROR << "placement query server: " << query_server.error();
+    return PlacementOutcome::kError;
+  }
+  TFD_LOG_INFO << "tpu-feature-placement " << info::VersionString()
+               << " serving POST /v1/placements on port "
+               << (*query_server)->port() << " (inventory "
+               << shared.inventory_name << ")";
+
+  Ingest ingest(*cluster, &shared);
+  ingest.Start();
+
+  while (true) {
+    struct timespec tick = {0, 200 * 1000 * 1000};
+    int sig = sigtimedwait(&sigmask, nullptr, &tick);
+    if (sig == SIGTERM || sig == SIGINT || sig == SIGQUIT) {
+      TFD_LOG_INFO << "placement: signal " << sig << ", shutting down";
+      ingest.Stop();
+      return PlacementOutcome::kExit;
+    }
+    if (sig == SIGHUP) {
+      TFD_LOG_INFO << "placement: SIGHUP, reloading";
+      ingest.Stop();
+      return PlacementOutcome::kRestart;
+    }
+    if (server) {
+      bool synced;
+      {
+        std::lock_guard<std::mutex> lock(shared.mu);
+        synced = shared.synced;
+      }
+      // Readiness = the collection has listed; the ingest thread keeps
+      // the index fresh from then on (watch drops re-list on their own).
+      if (synced) server->RecordRewrite(true);
+    }
+  }
+}
+
+}  // namespace placement
+}  // namespace tfd

@@ -1,0 +1,169 @@
+// Placement query service (--mode=placement): a labels-only candidate
+// index over the NodeFeature collection, answering `POST /v1/placements`
+// with ZERO apiserver reads per query.
+//
+// The eligibility contract is the SimScheduler's (tpufd/cluster.py),
+// replicated bit-for-bit so the soak can score served placements against
+// the toy scheduler's ground truth:
+//   - basic eligibility: labels present, perf class not "degraded", the
+//     node's own slice labels not degraded, not preempting/draining;
+//   - slice worst-of-members: a slice id ANY member marks degraded
+//     blocks every member (a partitioned node cannot write its own
+//     demotion — its peers' verdicts are the only label evidence);
+//   - preference order: highest perf class first, then the most free
+//     chips (spread), then lexicographic node name (determinism);
+//   - cluster admission: the aggregator's capacity-by-class rollup
+//     gates a query before any scan ("no-capacity"); an empty
+//     inventory admits everything.
+//
+// The index is allocation-free: `free` is the node's published
+// TPU_COUNT. Queries are reads; the caller (a scheduler) owns its own
+// allocation bookkeeping, exactly like SimScheduler.node_used.
+//
+// Data path: one collection list+watch (no label selector — the
+// inventory object deliberately carries no node-name label) feeds
+// ApplyNode / ApplyInventory; tfd-inventory-shard-* partials are never
+// node contributions (the same exclusion rule every aggregation tier
+// applies). Every mutation maintains the rank-ordered candidate sets
+// incrementally, so a query is O(answer), not O(nodes).
+#pragma once
+
+#include <signal.h>
+
+#include <cstdint>
+#include <map>
+#include <set>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "tfd/config/config.h"
+#include "tfd/lm/labels.h"
+
+namespace tfd {
+namespace placement {
+
+// Perf-class ordering (tpufd.cluster.CLASS_RANK): absent/unknown ranks
+// 0, degraded is never placeable regardless of floor.
+int ClassRank(const std::string& perf_class);
+
+// Job class floors (tpufd.cluster.JOB_CLASS_RANK): "gold" 3, "silver"
+// 2, "any" 0; unknown floors are a caller error, surfaced as -1.
+int JobMinRank(const std::string& wanted);
+
+// The lifecycle gate: preempt-imminent or draining.
+bool Preempting(const lm::Labels& labels);
+
+// Can this node host ANY job, judging purely from its own published
+// labels? (Capacity and slice peers are separate checks.)
+bool BasicEligible(const lm::Labels& labels);
+
+// Does this node's published view claim its slice degraded? Any member
+// claiming blocks the whole slice (worst-of-members).
+bool SliceDegradedClaim(const lm::Labels& labels);
+
+struct PlacementQuery {
+  std::string wanted = "any";  // perf-class floor: gold | silver | any
+  int chips = 1;               // free chips the job needs on one node
+  bool slice = false;          // require slice membership (multislice)
+  int limit = 1;               // max candidates returned (1..kMaxLimit)
+};
+
+struct Candidate {
+  std::string node;
+  std::string perf_class;  // published class ("" = unclassed)
+  int64_t free = 0;        // free chips (published capacity)
+  std::string slice_id;    // "" when not a slice member
+};
+
+struct PlacementResult {
+  // "placed" (candidates non-empty), "no-candidate", or "no-capacity"
+  // (the inventory admission gate refused before any scan) — the
+  // SimScheduler Decision reasons verbatim.
+  std::string status;
+  std::vector<Candidate> candidates;  // preference order, <= limit
+};
+
+class PlacementIndex {
+ public:
+  // Ingests one node's published labels (ADDED/MODIFIED). Returns true
+  // when the index changed.
+  bool ApplyNode(const std::string& node, const lm::Labels& labels);
+  // Node CR deleted. Returns true when the node was present.
+  bool RemoveNode(const std::string& node);
+  // Ingests the aggregator's inventory rollup (capacity-by-class
+  // admission). Pass {} when the inventory object is deleted.
+  void ApplyInventory(const lm::Labels& labels);
+
+  PlacementResult Query(const PlacementQuery& query) const;
+
+  // Admission alone (the no-capacity gate), exposed for tests.
+  bool Admit(int min_rank, int chips) const;
+
+  size_t nodes() const { return nodes_.size(); }
+  size_t eligible() const;         // basic-eligible population
+  size_t blocked_slices() const { return blocked_.size(); }
+  bool have_inventory() const { return have_inventory_; }
+  uint64_t events() const { return events_; }
+  // Retained node names (list-reconcile: retire what a re-list lost).
+  std::vector<std::string> NodeNames() const;
+
+  static constexpr int kMaxLimit = 64;
+
+ private:
+  struct Entry {
+    std::string perf_class;
+    int rank = 0;
+    int64_t chips = 0;
+    std::string slice_id;
+    bool basic = false;  // basic-eligible (candidate-set member)
+    bool claim = false;  // publishes a degraded-slice verdict
+  };
+
+  void Insert(const std::string& node, const Entry& entry);
+  void Erase(const std::string& node, const Entry& entry);
+
+  std::map<std::string, Entry> nodes_;
+  // rank -> candidates ordered by (-free, name): iterating ranks
+  // descending then set order IS the preference order. Basic-eligible
+  // nodes only; slice blocking is applied at query time (one slice
+  // verdict must not require re-indexing every member).
+  std::map<int, std::set<std::pair<int64_t, std::string>>,
+           std::greater<int>>
+      by_rank_;
+  // slice id -> members currently publishing a degraded-slice claim.
+  std::map<std::string, int64_t> claims_;
+  std::set<std::string> blocked_;  // claims_ keys with count > 0
+  // capacity-by-class buckets from the inventory rollup. An ingested
+  // inventory with ANY labels arms the admission gate (SimScheduler:
+  // `if not self.inventory: return True`), even if it carries no
+  // capacity keys — have_inventory_ tracks that distinction.
+  std::map<std::string, int64_t> inventory_capacity_;
+  bool have_inventory_ = false;
+  uint64_t events_ = 0;
+};
+
+// Parses a /v1/placements request body into a query. Returns a
+// non-empty error string on malformed input (HTTP 400).
+std::string ParsePlacementBody(const std::string& body,
+                               PlacementQuery* query);
+
+// Renders a PlacementResult as the response JSON document.
+std::string RenderPlacementResult(const PlacementResult& result);
+
+enum class PlacementOutcome {
+  kExit,     // SIGTERM/SIGINT: clean shutdown
+  kRestart,  // SIGHUP: reload config and re-enter
+  kError,    // unrecoverable startup failure
+};
+
+// Runs the placement query service until a signal: collection
+// list+watch feeding the index, the query HTTP server on
+// --placement-listen-addr, and the introspection server on
+// --introspection-addr. `sigmask` is the blocked set main.cc collects
+// signals from.
+PlacementOutcome RunPlacement(const config::Config& config,
+                              const sigset_t& sigmask);
+
+}  // namespace placement
+}  // namespace tfd

@@ -42,6 +42,7 @@
 #include "tfd/obs/journal.h"
 #include "tfd/obs/metrics.h"
 #include "tfd/obs/server.h"
+#include "tfd/placement/placement.h"
 #include "tfd/perf/perf.h"
 #include "tfd/pjrt/pjrt_binding.h"
 #include "tfd/platform/detect.h"
@@ -6284,6 +6285,20 @@ void TestAggFlushController() {
   CHECK_TRUE(!flush.dirty());
   flush.NoteDirty(110.0);
   CHECK_EQ(flush.DueAt(), 112.0);
+
+  // ReArm restores a consumed window after a failed publish. Clean ->
+  // the original start; already re-dirtied by a mid-publish event ->
+  // the EARLIER of the two (the retry owes the original staleness).
+  flush.NoteFlushed();
+  flush.ReArm(110.0);
+  CHECK_TRUE(flush.dirty());
+  CHECK_EQ(flush.DueAt(), 112.0);
+  flush.NoteFlushed();
+  flush.NoteDirty(111.5);  // landed while the failed publish was in flight
+  flush.ReArm(110.0);
+  CHECK_EQ(flush.DueAt(), 112.0);
+  flush.ReArm(115.0);  // never later than an open window's start
+  CHECK_EQ(flush.DueAt(), 112.0);
 }
 
 void TestPerfFleetFloor() {
@@ -6431,6 +6446,296 @@ void TestAggWatchEventName() {
       "{\"type\":\"BOOKMARK\",\"object\":{\"metadata\":"
       "{\"resourceVersion\":\"40\"}}}");
   CHECK_EQ(nameless.name, "");
+}
+
+void TestAggShardIndexOf() {
+  // Pinned assignment: fnv1a64("tpu-node-1") == 0xd4ee320a7c9868f9
+  // (tests/test_agg.py pins the same constant through tpufd.sink, so
+  // an L1 shard and the Python twins can never disagree on ownership).
+  CHECK_EQ(agg::ShardIndexOf("tpu-node-1", 0), 0);
+  CHECK_EQ(agg::ShardIndexOf("tpu-node-1", 1), 0);
+  CHECK_EQ(agg::ShardIndexOf("tpu-node-1", 4),
+           static_cast<int>(0xd4ee320a7c9868f9ULL % 4));
+  for (int i = 0; i < 50; i++) {
+    std::string node = "node-" + std::to_string(i);
+    int shard = agg::ShardIndexOf(node, 5);
+    CHECK_TRUE(shard >= 0 && shard < 5);
+    CHECK_EQ(shard, agg::ShardIndexOf(node, 5));
+  }
+}
+
+void TestAggPartialLabelsRoundtrip() {
+  agg::InventoryStore store;
+  agg::StageSketches st;
+  st["plan"].Add(42.0);
+  st["publish"].Add(850.0);
+  CHECK_TRUE(store.Apply("n0",
+                         {{lm::kPerfClass, "gold"},
+                          {"google.com/tpu.count", "4"},
+                          {lm::kSliceId, "s-a"},
+                          {lm::kPerfMatmulTflops, "180.5"},
+                          {lm::kPerfHbmGbps, "700"}},
+                         agg::SerializeStageSketches(st)));
+  // A 0-chip node leaves a ZERO-valued capacity entry (erase-at-zero is
+  // a retire-path rule, not a store invariant); the wire format must
+  // carry it verbatim or the root's no-op equality check would flap.
+  CHECK_TRUE(store.Apply("zero", {{"google.com/tpu.count", "0"}}));
+
+  lm::Labels wire = agg::SerializePartialLabels(store.Partial(), "2/8");
+  CHECK_EQ(wire[lm::kAggTier], std::string(lm::kAggTierPartial));
+  CHECK_EQ(wire[lm::kAggShard], "2/8");
+  CHECK_EQ(wire[lm::kAggNodes], "2");
+  CHECK_EQ(wire[lm::kAggPreempting], "0");
+  agg::RollupState parsed;
+  CHECK_TRUE(agg::ParsePartialLabels(wire, &parsed));
+  CHECK_TRUE(parsed == store.Partial());
+
+  // The published rollup label set is NOT a partial (no tier marker):
+  // the parser refuses rather than ingesting scalars as contributions.
+  agg::RollupState reject;
+  CHECK_TRUE(!agg::ParsePartialLabels(store.BuildOutputLabels(), &reject));
+  CHECK_TRUE(!agg::ParsePartialLabels({}, &reject));
+}
+
+// The shared fleet generator for the tree-merge tests: mixed classes,
+// slices with degraded verdicts, preempting nodes, perf samples and
+// per-node stage sketches — every rollup family exercised.
+lm::Labels ShardTestNodeLabels(int i) {
+  lm::Labels labels;
+  labels["google.com/tpu.count"] = std::to_string(4 + (i % 3) * 2);
+  if (i % 4 == 0) {
+    labels[lm::kPerfClass] = "gold";
+  } else if (i % 4 == 1) {
+    labels[lm::kPerfClass] = "silver";
+  } else if (i % 4 == 2) {
+    labels[lm::kPerfClass] = "degraded";
+  }
+  labels[lm::kSliceId] = "s-" + std::to_string(i % 5);
+  if (i % 7 == 0) labels[lm::kSliceDegraded] = "true";
+  if (i % 11 == 0) labels[lm::kLifecyclePreemptImminent] = "true";
+  if (i % 6 == 0) labels[lm::kMultisliceSliceId] = std::to_string(i % 2);
+  labels[lm::kPerfMatmulTflops] = std::to_string(90 + i * 4) + ".25";
+  labels[lm::kPerfHbmGbps] = std::to_string(300 + i * 17);
+  return labels;
+}
+
+void TestAggShardMergeTree() {
+  // Satellite contract: merging N partial sketches equals the flat
+  // single-aggregator state BIT-identically — integer bucket counts
+  // make merge associative — including unmerge-then-remerge when a
+  // shard's partial is retired and re-admitted.
+  const int kNodes = 48;
+  const int kShards = 3;
+  agg::InventoryStore flat;
+  std::vector<agg::InventoryStore> shards(kShards);
+  for (int i = 0; i < kNodes; i++) {
+    std::string node = "merge-node-" + std::to_string(i);
+    lm::Labels labels = ShardTestNodeLabels(i);
+    agg::StageSketches st;
+    st["plan"].Add(40.0 + i * 3.1);
+    st["publish-acked"].Add(900.0 + i * 11.0);
+    std::string slo = agg::SerializeStageSketches(st);
+    CHECK_TRUE(flat.Apply(node, labels, slo));
+    CHECK_TRUE(shards[agg::ShardIndexOf(node, kShards)].Apply(node, labels,
+                                                              slo));
+  }
+  for (int s = 0; s < kShards; s++) {
+    CHECK_TRUE(shards[s].nodes() > 0);  // the fleet spans every shard
+  }
+
+  // L1 -> L2 over the WIRE: each shard's partial serializes to labels
+  // and parses back at the root, exactly as in production.
+  agg::ShardMergeStore merge;
+  for (int s = 0; s < kShards; s++) {
+    lm::Labels partial_wire = agg::SerializePartialLabels(
+        shards[s].Partial(),
+        std::to_string(s) + "/" + std::to_string(kShards));
+    agg::RollupState parsed;
+    CHECK_TRUE(agg::ParsePartialLabels(partial_wire, &parsed));
+    CHECK_TRUE(parsed == shards[s].Partial());
+    CHECK_TRUE(merge.ApplyPartial(
+        "tfd-inventory-shard-" + std::to_string(s), parsed));
+  }
+
+  // Tree == flat: byte-identical published labels AND bit-identical
+  // sketches underneath (bucket-count equality, not quantile equality).
+  CHECK_TRUE(merge.BuildOutputLabels() == flat.BuildOutputLabels());
+  CHECK_TRUE(merge.merged().matmul == flat.Partial().matmul);
+  CHECK_TRUE(merge.merged().hbm == flat.Partial().hbm);
+  CHECK_TRUE(merge.merged().stage == flat.Partial().stage);
+
+  // Unmerge-then-remerge: a shard leader churns, its partial is retired
+  // (Sketch Unmerge, counter-map subtract) and re-admitted — the root
+  // must land back on the identical state, without a recompute.
+  agg::RollupState shard1 = shards[1].Partial();
+  CHECK_TRUE(merge.RemovePartial("tfd-inventory-shard-1"));
+  CHECK_TRUE(!(merge.BuildOutputLabels() == flat.BuildOutputLabels()));
+  CHECK_TRUE(merge.ApplyPartial("tfd-inventory-shard-1", shard1));
+  CHECK_TRUE(merge.BuildOutputLabels() == flat.BuildOutputLabels());
+  CHECK_TRUE(merge.merged().matmul == flat.Partial().matmul);
+  CHECK_TRUE(merge.merged().stage == flat.Partial().stage);
+
+  // Re-applying an identical partial is a no-op (nothing to publish);
+  // removing an unknown shard likewise.
+  CHECK_TRUE(!merge.ApplyPartial("tfd-inventory-shard-1", shard1));
+  CHECK_TRUE(!merge.RemovePartial("tfd-inventory-shard-9"));
+
+  // The steady path never recomputed, at either tier — and a forced
+  // from-scratch rebuild equals the incremental state.
+  CHECK_EQ(merge.full_recomputes(), 0u);
+  CHECK_EQ(flat.full_recomputes(), 0u);
+  lm::Labels incremental = merge.BuildOutputLabels();
+  merge.RecomputeAll();
+  CHECK_TRUE(merge.BuildOutputLabels() == incremental);
+  CHECK_EQ(merge.full_recomputes(), 1u);
+}
+
+void TestPlacementIndexContract() {
+  // The SimScheduler eligibility contract (tpufd/cluster.py),
+  // replicated by placement::PlacementIndex and pinned here; the
+  // Python twin runs the same scenario in tests/test_placement.py.
+  CHECK_EQ(placement::ClassRank("gold"), 3);
+  CHECK_EQ(placement::ClassRank("silver"), 2);
+  CHECK_EQ(placement::ClassRank("degraded"), 1);
+  CHECK_EQ(placement::ClassRank(""), 0);
+  CHECK_EQ(placement::ClassRank("bronze"), 0);
+  CHECK_EQ(placement::JobMinRank("gold"), 3);
+  CHECK_EQ(placement::JobMinRank("any"), 0);
+  CHECK_EQ(placement::JobMinRank("bronze"), -1);
+
+  placement::PlacementIndex index;
+  index.ApplyNode("a-gold", {{lm::kPerfClass, "gold"},
+                             {"google.com/tpu.count", "4"},
+                             {lm::kSliceId, "s1"}});
+  index.ApplyNode("b-gold-big", {{lm::kPerfClass, "gold"},
+                                 {"google.com/tpu.count", "8"}});
+  index.ApplyNode("c-silver", {{lm::kPerfClass, "silver"},
+                               {"google.com/tpu.count", "8"},
+                               {lm::kSliceId, "s2"}});
+  index.ApplyNode("d-degraded", {{lm::kPerfClass, "degraded"},
+                                 {"google.com/tpu.count", "16"}});
+  index.ApplyNode("e-preempt", {{lm::kPerfClass, "gold"},
+                                {"google.com/tpu.count", "8"},
+                                {lm::kLifecyclePreemptImminent, "true"}});
+  CHECK_EQ(index.nodes(), 5u);
+  CHECK_EQ(index.eligible(), 3u);  // degraded + preempting filtered
+
+  // Preference order: highest class, then most free, then name.
+  placement::PlacementQuery q;
+  q.wanted = "any";
+  q.chips = 4;
+  q.limit = 8;
+  placement::PlacementResult r = index.Query(q);
+  CHECK_EQ(r.status, "placed");
+  CHECK_EQ(r.candidates.size(), 3u);
+  CHECK_EQ(r.candidates[0].node, "b-gold-big");
+  CHECK_EQ(r.candidates[1].node, "a-gold");
+  CHECK_EQ(r.candidates[2].node, "c-silver");
+
+  // The class floor filters below-rank candidates.
+  q.wanted = "gold";
+  r = index.Query(q);
+  CHECK_EQ(r.candidates.size(), 2u);
+
+  // The chips filter.
+  q.wanted = "any";
+  q.chips = 8;
+  r = index.Query(q);
+  CHECK_EQ(r.candidates.size(), 2u);
+  CHECK_EQ(r.candidates[0].node, "b-gold-big");
+
+  // Worst-of-members: ONE member's degraded verdict blocks the whole
+  // slice — including members whose own labels still read healthy.
+  index.ApplyNode("f-verdict", {{lm::kSliceId, "s1"},
+                                {lm::kSliceDegraded, "true"},
+                                {"google.com/tpu.count", "4"}});
+  CHECK_EQ(index.blocked_slices(), 1u);
+  q.chips = 4;
+  r = index.Query(q);
+  for (const placement::Candidate& c : r.candidates) {
+    CHECK_TRUE(c.node != "a-gold" && c.node != "f-verdict");
+  }
+  // The verdict clears: the slice unblocks without a rebuild.
+  index.ApplyNode("f-verdict",
+                  {{lm::kSliceId, "s1"}, {"google.com/tpu.count", "4"}});
+  CHECK_EQ(index.blocked_slices(), 0u);
+  r = index.Query(q);
+  bool has_a = false;
+  for (const placement::Candidate& c : r.candidates) {
+    if (c.node == "a-gold") has_a = true;
+  }
+  CHECK_TRUE(has_a);
+
+  // A slice-requiring (multislice) query only returns slice members.
+  q.slice = true;
+  r = index.Query(q);
+  CHECK_TRUE(!r.candidates.empty());
+  for (const placement::Candidate& c : r.candidates) {
+    CHECK_TRUE(!c.slice_id.empty());
+  }
+  q.slice = false;
+
+  // Cluster admission from the aggregator's capacity-by-class rollup.
+  std::string prefix = lm::kCapacityPrefix;
+  index.ApplyInventory({{prefix + "gold", "8"},
+                        {prefix + "silver", "0"},
+                        {prefix + "unclassed", "4"},
+                        {prefix + "degraded", "16"}});
+  q.wanted = "gold";
+  q.chips = 9;
+  CHECK_EQ(index.Query(q).status, "no-capacity");
+  q.chips = 8;
+  CHECK_EQ(index.Query(q).status, "placed");
+  // Degraded capacity never admits anything (rank 1 < every floor the
+  // bucket table serves), and non-digit capacity reads as 0.
+  index.ApplyInventory({{prefix + "gold", "junk"}});
+  q.chips = 1;
+  CHECK_EQ(index.Query(q).status, "no-capacity");
+  // Inventory deleted: empty admits everything again.
+  index.ApplyInventory({});
+  CHECK_EQ(index.Query(q).status, "placed");
+
+  CHECK_TRUE(index.RemoveNode("b-gold-big"));
+  CHECK_TRUE(!index.RemoveNode("b-gold-big"));
+  q.wanted = "any";
+  q.chips = 100;
+  CHECK_EQ(index.Query(q).status, "no-candidate");
+}
+
+void TestPlacementProtocol() {
+  placement::PlacementQuery q;
+  CHECK_EQ(placement::ParsePlacementBody(
+               "{\"class\":\"gold\",\"chips\":4,\"slice\":true,"
+               "\"limit\":3}",
+               &q),
+           "");
+  CHECK_EQ(q.wanted, "gold");
+  CHECK_EQ(q.chips, 4);
+  CHECK_TRUE(q.slice);
+  CHECK_EQ(q.limit, 3);
+  CHECK_EQ(placement::ParsePlacementBody("{}", &q), "");
+  CHECK_EQ(q.wanted, "any");
+  CHECK_EQ(q.chips, 1);
+  CHECK_TRUE(!q.slice);
+  CHECK_TRUE(!placement::ParsePlacementBody("", &q).empty());
+  CHECK_TRUE(!placement::ParsePlacementBody("[]", &q).empty());
+  CHECK_TRUE(
+      !placement::ParsePlacementBody("{\"class\":\"bronze\"}", &q).empty());
+  CHECK_TRUE(!placement::ParsePlacementBody("{\"chips\":-1}", &q).empty());
+  CHECK_TRUE(!placement::ParsePlacementBody("{\"chips\":1.5}", &q).empty());
+  CHECK_TRUE(!placement::ParsePlacementBody("{\"limit\":0}", &q).empty());
+  CHECK_TRUE(!placement::ParsePlacementBody("{\"slice\":1}", &q).empty());
+
+  placement::PlacementResult result;
+  result.status = "placed";
+  result.candidates.push_back({"n1", "gold", 4, "s1"});
+  CHECK_EQ(placement::RenderPlacementResult(result),
+           "{\"status\":\"placed\",\"candidates\":[{\"node\":\"n1\","
+           "\"class\":\"gold\",\"free\":4,\"slice\":\"s1\"}]}");
+  result.candidates.clear();
+  result.status = "no-candidate";
+  CHECK_EQ(placement::RenderPlacementResult(result),
+           "{\"status\":\"no-candidate\",\"candidates\":[]}");
 }
 
 }  // namespace
@@ -6587,6 +6892,11 @@ int main(int argc, char** argv) {
   tfd::TestAggIncrementalRollups();
   tfd::TestAggFlushController();
   tfd::TestAggWatchEventName();
+  tfd::TestAggShardIndexOf();
+  tfd::TestAggPartialLabelsRoundtrip();
+  tfd::TestAggShardMergeTree();
+  tfd::TestPlacementIndexContract();
+  tfd::TestPlacementProtocol();
   tfd::TestPerfFleetFloor();
   tfd::TestSlicePreemptingMember();
   tfd::TestGetNodeDraining();

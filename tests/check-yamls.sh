@@ -19,6 +19,7 @@ $DIR/deployments/static/tpu-feature-discovery-daemonset.yaml
 $DIR/deployments/static/tpu-feature-discovery-daemonset-with-slice-single.yaml
 $DIR/deployments/static/tpu-feature-discovery-daemonset-with-slice-mixed.yaml
 $DIR/deployments/static/tpu-feature-aggregator-deployment.yaml
+$DIR/deployments/static/tpu-feature-placement-deployment.yaml
 $DIR/deployments/static/tpu-feature-discovery-job.yaml.template
 $DIR/deployments/static/tpu-slice-burnin-job.yaml.template
 "

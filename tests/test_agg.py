@@ -341,6 +341,17 @@ class TestRollupTwin:
         assert flush.should_flush(102.0)
         flush.note_flushed()
         assert not flush.dirty
+        # rearm restores a consumed window after a failed publish —
+        # pinned against the C++ ReArm: clean -> the original start;
+        # re-dirtied mid-publish -> the earlier of the two.
+        flush.rearm(100.0)
+        assert flush.due_at() == 102.0
+        flush.note_flushed()
+        flush.note_dirty(101.5)
+        flush.rearm(100.0)
+        assert flush.due_at() == 102.0
+        flush.rearm(105.0)  # never later than an open window's start
+        assert flush.due_at() == 102.0
 
 
 class TestWatchEventNameParity:
@@ -814,5 +825,309 @@ class TestLifecycleFastPath:
                     lambda: cr_labels().get(
                         "google.com/tpu.lifecycle.draining") == "true",
                     timeout=20)
+            finally:
+                stop(proc)
+
+
+# ---- sharded aggregation tree (ISSUE 17) ----------------------------------
+
+
+class TestShardTreeTwin:
+    def test_shard_index_of_pin(self):
+        # unit_tests.cc TestAggShardIndexOf pins the same literals: the
+        # two sides MUST route every node to the same L1 shard or the
+        # tree double-counts.
+        assert agg.shard_index_of("tpu-node-1", 4) == 1
+        assert agg.shard_index_of("tpu-node-1", 0) == 0
+        assert agg.shard_index_of("tpu-node-1", 1) == 0
+        counts = [0, 0, 0]
+        for i in range(48):
+            counts[agg.shard_index_of(f"merge-node-{i}", 3)] += 1
+        assert counts == [15, 16, 17]
+
+    def test_classify_name_excludes_all_inventory(self):
+        # The satellite-1 exclusion: ALL tfd-inventory-* names (root
+        # AND partials) are inventory objects, never node
+        # contributions — partials carry the node-name label to ride
+        # the selector watch, so the name rule is the only guard.
+        classify = agg.classify_name
+        assert classify("tfd-features-for-node-1",
+                        OUTPUT) == agg.OBJ_NODE_CR
+        assert classify("tfd-inventory-shard-0",
+                        OUTPUT) == agg.OBJ_PARTIAL
+        assert classify("tfd-inventory-shard-7",
+                        OUTPUT) == agg.OBJ_PARTIAL
+        assert classify(OUTPUT, OUTPUT) == agg.OBJ_OTHER
+        assert classify("tfd-inventory-custom", OUTPUT) == agg.OBJ_OTHER
+        # A custom output name is excluded by equality even without
+        # the prefix.
+        assert classify("my-inventory", "my-inventory") == agg.OBJ_OTHER
+        assert classify("unrelated", OUTPUT) == agg.OBJ_OTHER
+
+    def test_partial_labels_roundtrip(self):
+        store = agg.InventoryStore()
+        for node, labels in GOLDEN_FLEET.items():
+            store.apply(node, labels)
+        wire = agg.serialize_partial_labels(store.partial(), "2/8")
+        assert wire[agg.AGG_TIER] == "partial"
+        assert wire[agg.AGG_SHARD] == "2/8"
+        assert wire[agg.AGG_NODES] == "6"
+        assert wire[agg.AGG_PREEMPTING] == "1"
+        parsed = agg.parse_partial_labels(wire)
+        assert parsed == store.partial()
+        # A parsed partial rebuilds the same rollup the flat store
+        # publishes — the byte-compat contract is structural.
+        assert agg.build_rollup_labels(parsed) == GOLDEN_ROLLUPS
+        # Non-partial label sets are rejected, never misread.
+        assert agg.parse_partial_labels(GOLDEN_ROLLUPS) is None
+        assert agg.parse_partial_labels({}) is None
+
+    @staticmethod
+    def _shard_fleet(n):
+        # Mirrors unit_tests.cc ShardTestNodeLabels: every rollup
+        # dimension exercised (classes, slices, degraded claims,
+        # preemption, multislice, perf sketches, junk).
+        fleet = {}
+        for i in range(n):
+            labels = {
+                agg.TPU_COUNT: str([4, 6, 8][i % 3]),
+                agg.PERF_CLASS: ["gold", "silver", "degraded", ""][i % 4],
+                agg.SLICE_ID: f"s-{i % 5}",
+                agg.SLICE_DEGRADED: "true" if i % 7 == 0 else "false",
+                agg.PERF_MATMUL: agg.fixed3(80.0 + 3.0 * i),
+                agg.PERF_HBM: agg.fixed3(300.0 + 11.0 * i),
+            }
+            if i % 11 == 0:
+                labels[agg.LIFECYCLE_PREEMPT] = "true"
+            if i % 6 == 0:
+                labels[agg.MULTISLICE_SLICE_ID] = str(i % 2)
+            fleet[f"merge-node-{i}"] = labels
+        return fleet
+
+    def test_tree_merge_equals_flat(self):
+        # Satellite 3 (twin side): merging N partial states equals the
+        # flat single-store rollup bit-identically — including the
+        # sketch counter arrays, and including unmerge-then-remerge
+        # when a shard's partial is retired and re-admitted.
+        shards = 3
+        fleet = self._shard_fleet(48)
+        flat = agg.InventoryStore()
+        l1 = [agg.InventoryStore() for _ in range(shards)]
+        for node, labels in fleet.items():
+            stage = ""
+            if node.endswith("-0") or node.endswith("-7"):
+                hot = agg.Sketch()
+                hot.add(1500.0)
+                hot.add(40.0)
+                stage = agg.serialize_stage_sketches({"publish": hot})
+            flat.apply(node, labels, stage_slo=stage)
+            l1[agg.shard_index_of(node, shards)].apply(
+                node, labels, stage_slo=stage)
+        merge = agg.ShardMergeStore()
+        for i, shard_store in enumerate(l1):
+            # Through the WIRE: serialize -> parse -> apply, exactly
+            # what the L2 root ingests from the partial CRs.
+            wire = agg.serialize_partial_labels(
+                shard_store.partial(), f"{i}/{shards}")
+            assert merge.apply_partial(i, agg.parse_partial_labels(wire))
+        assert merge.build_output_labels() == flat.build_output_labels()
+        assert merge.merged["matmul"] == flat.matmul
+        assert merge.merged["hbm"] == flat.hbm
+        assert merge.merged["stage"] == flat.stage
+
+        # Retire shard 1 (its lease lapses): the rollup moves...
+        assert merge.remove_partial(1)
+        assert merge.build_output_labels() != flat.build_output_labels()
+        # ... and re-admitting restores bit-identity (unmerge really
+        # subtracted, nothing drifted).
+        wire = agg.serialize_partial_labels(
+            l1[1].partial(), f"1/{shards}")
+        assert merge.apply_partial(1, agg.parse_partial_labels(wire))
+        assert merge.build_output_labels() == flat.build_output_labels()
+        assert merge.merged["matmul"] == flat.matmul
+
+        # Re-applying an identical partial is a no-op (no publish owed).
+        assert not merge.apply_partial(1, agg.parse_partial_labels(wire))
+        assert not merge.remove_partial(9)
+
+        # Every tier held the O(delta) contract, and the self-check
+        # recompute agrees with the incremental state.
+        assert flat.full_recomputes == 0
+        assert all(s.full_recomputes == 0 for s in l1)
+        assert merge.full_recomputes == 0
+        incremental = merge.build_output_labels()
+        merge.recompute_all()
+        assert merge.build_output_labels() == incremental
+
+
+class TestWatchHistoryDepth:
+    def test_collection_floor_tracks_configured_depth(self):
+        # Satellite 2: the 410 compaction floor follows the
+        # constructor-configured history depth. Shallow server: 12
+        # events against an 8-deep window compacts the first four away
+        # — resuming from rv 1 is below the floor.
+        with FakeApiServer(collection_history=8) as server:
+            for i in range(12):
+                server.seed(NS, f"tfd-features-for-h{i}", {"x": str(i)},
+                            {NODE_NAME_LABEL: f"h{i}"})
+            wconn, resp = open_stream(
+                server, BASE + "?watch=true&resourceVersion=1")
+            event = read_event(resp)
+            assert event["type"] == "ERROR"
+            assert event["object"]["code"] == 410
+            wconn.close()
+
+    def test_default_depth_replays_the_same_stream(self):
+        # The identical 12-event stream replays in full from rv 1 at
+        # the default 64-deep window — the floor is the ONLY variable.
+        with FakeApiServer() as server:
+            for i in range(12):
+                server.seed(NS, f"tfd-features-for-h{i}", {"x": str(i)},
+                            {NODE_NAME_LABEL: f"h{i}"})
+            wconn, resp = open_stream(
+                server,
+                BASE + "?watch=true&resourceVersion=1&timeoutSeconds=2")
+            names = []
+            while True:
+                event = read_event(resp)
+                if not event or event["type"] == "BOOKMARK":
+                    break
+                assert event["type"] == "ADDED"
+                names.append(event["object"]["metadata"]["name"])
+            wconn.close()
+            assert names == [f"tfd-features-for-h{i}"
+                             for i in range(1, 12)]
+
+    def test_per_object_floor_tracks_configured_depth(self):
+        # The per-object watch window obeys its own knob the same way.
+        with FakeApiServer(watch_history=4) as server:
+            for i in range(10):
+                server.seed(NS, "tfd-features-for-solo", {"x": str(i)},
+                            {NODE_NAME_LABEL: "solo"})
+            wconn, resp = open_stream(
+                server,
+                BASE + "/tfd-features-for-solo?watch=true"
+                       "&resourceVersion=1")
+            event = read_event(resp)
+            assert event["type"] == "ERROR"
+            assert event["object"]["code"] == 410
+            wconn.close()
+
+
+def partial_labels(server, shard):
+    obj = server.store.get((NS, f"tfd-inventory-shard-{shard}"))
+    return (obj or {}).get("spec", {}).get("labels")
+
+
+class TestShardedAggregatorProcess:
+    def test_two_shards_merge_to_flat_byte_identical(self, tfd_binary):
+        # The tentpole end-to-end: 2 L1 shards + the L2 merge root on
+        # one fake apiserver publish a cluster inventory byte-identical
+        # to what the flat twin computes from the same fleet — through
+        # churn and delete retirement, with zero full recomputes on
+        # EVERY tier.
+        with FakeApiServer() as server:
+            expected = seed_fleet(server, 24)
+            ports = [free_port() for _ in range(3)]
+            procs = []
+            try:
+                for i in range(2):
+                    procs.append(subprocess.Popen(
+                        agg_argv(tfd_binary, ports[i],
+                                 extra=(f"--agg-shard={i}/2",)),
+                        env=agg_env(server, f"l1-{i}"),
+                        stderr=subprocess.DEVNULL))
+                procs.append(subprocess.Popen(
+                    agg_argv(tfd_binary, ports[2],
+                             extra=("--agg-merge-shards=2",)),
+                    env=agg_env(server, "root"),
+                    stderr=subprocess.DEVNULL))
+                assert wait_for(
+                    lambda: output_labels(server) ==
+                    expected.build_output_labels(), timeout=30)
+
+                # The partial CRs exist, carry the tier marker + shard
+                # spec, and ride the selector watch via the node-name
+                # metadata label.
+                for i in range(2):
+                    obj = server.store[(NS, f"tfd-inventory-shard-{i}")]
+                    labels = obj["spec"]["labels"]
+                    assert labels[agg.AGG_TIER] == "partial"
+                    assert labels[agg.AGG_SHARD] == f"{i}/2"
+                    assert obj["metadata"]["labels"][NODE_NAME_LABEL] \
+                        == f"tfd-inventory-shard-{i}"
+                # The two shards partition the fleet exactly.
+                assert (int(partial_labels(server, 0)[agg.AGG_NODES]) +
+                        int(partial_labels(server, 1)[agg.AGG_NODES])) \
+                    == 24
+
+                # Churn crosses the tree: demote one node; the ROOT
+                # output converges to the flat twin's answer.
+                churned = node_labels(1, perf_class="degraded",
+                                      degraded="true")
+                server.seed(NS, "tfd-features-for-node-1", churned,
+                            {NODE_NAME_LABEL: "node-1"})
+                expected.apply("node-1", churned)
+                assert wait_for(
+                    lambda: output_labels(server) ==
+                    expected.build_output_labels(), timeout=15)
+
+                # Delete retirement crosses it too.
+                server.delete(NS, "tfd-features-for-node-2")
+                expected.remove("node-2")
+                assert wait_for(
+                    lambda: output_labels(server) ==
+                    expected.build_output_labels(), timeout=15)
+
+                # Zero recomputes on every tier; the tier gauge tells
+                # the three processes apart.
+                for port, tier in zip(ports, (1.0, 1.0, 2.0)):
+                    assert metric(
+                        port, "tfd_agg_full_recomputes_total") in \
+                        (None, 0.0)
+                    assert metric(port, "tfd_agg_tier") == tier
+            finally:
+                for proc in procs:
+                    stop(proc)
+
+    def test_foreign_partial_in_watch_stream_is_ignored(self, tfd_binary):
+        # Satellite-1 regression: a partial CR carries the node-name
+        # label (so it LANDS in every selector watch stream); the flat
+        # aggregator and an L1 shard must both classify it by name and
+        # never ingest it as a node contribution.
+        with FakeApiServer() as server:
+            expected = seed_fleet(server, 6)
+            foreign = agg.serialize_partial_labels(
+                expected.partial(), "7/8")
+            server.seed(NS, "tfd-inventory-shard-7", foreign,
+                        {NODE_NAME_LABEL: "tfd-inventory-shard-7"})
+
+            port = free_port()
+            proc = subprocess.Popen(
+                agg_argv(tfd_binary, port), env=agg_env(server),
+                stderr=subprocess.DEVNULL)
+            try:
+                # Were the partial counted, fleet.nodes would be 7 and
+                # the rollup could never equal the 6-node twin answer.
+                assert wait_for(
+                    lambda: output_labels(server) ==
+                    expected.build_output_labels(), timeout=20)
+                assert metric(port, "tfd_agg_nodes") == 6.0
+            finally:
+                stop(proc)
+
+            # Same drill for an L1 shard (one shard owns the whole
+            # fleet): its partial must report 6 nodes, not 7.
+            port = free_port()
+            proc = subprocess.Popen(
+                agg_argv(tfd_binary, port,
+                         extra=("--agg-shard=0/1",)),
+                env=agg_env(server, "l1-solo"),
+                stderr=subprocess.DEVNULL)
+            try:
+                assert wait_for(
+                    lambda: (partial_labels(server, 0) or {}).get(
+                        agg.AGG_NODES) == "6", timeout=20)
+                assert metric(port, "tfd_agg_nodes") == 6.0
             finally:
                 stop(proc)

@@ -279,6 +279,75 @@ class TestHelmChart:
             ["tfd-cluster-inventory"]
         assert set(named[0]["verbs"]) == {"patch", "update"}
 
+    def test_sharded_aggregator_knobs_wired(self):
+        """The sharded aggregation tree (ISSUE 17): helm
+        aggregator.shards (default 0 = flat) turns the aggregator
+        Deployment into the L2 merge root (TFD_AGG_MERGE_SHARDS) and
+        ranges out n L1 shard Deployments (TFD_AGG_SHARD=i/n), with the
+        name-restricted write rule extended to the partial rollup
+        CRs."""
+        values = yaml.safe_load((HELM / "values.yaml").read_text())
+        assert values["aggregator"]["shards"] == 0
+        template = (HELM / "templates" / "aggregator.yaml").read_text()
+        # Root gains the merge flag, gated on the shard count.
+        assert "TFD_AGG_MERGE_SHARDS" in template
+        assert "ge (int .Values.aggregator.shards) 2" in template
+        # L1 shards: one Deployment per shard, the i/n spec, a
+        # per-shard component label (distinct selector), and RBAC
+        # covering the partial CR names.
+        assert "TFD_AGG_SHARD" in template
+        assert "until (int .Values.aggregator.shards)" in template
+        assert "tfd-inventory-shard-" in template
+        assert "aggregator-shard-" in template
+
+    def test_placement_knobs_wired(self):
+        """The placement query service (ISSUE 17): helm
+        placement.{enabled,replicas,port} -> a Deployment + Service
+        gated on placement.enabled wiring TFD_MODE=placement +
+        TFD_PLACEMENT_LISTEN_ADDR, probes on the QUERY port (readiness
+        = informer synced), strictly read-only RBAC, and the static
+        manifest carrying the same at defaults."""
+        values = yaml.safe_load((HELM / "values.yaml").read_text())
+        pl = values["placement"]
+        assert pl["enabled"] is False
+        assert pl["replicas"] == 2
+        assert pl["port"] == 8085
+        template = (HELM / "templates" / "placement.yaml").read_text()
+        assert ".Values.placement.enabled" in template
+        assert "kind: Deployment" in template
+        assert "kind: Service" in template
+        assert 'value: "placement"' in template
+        assert "TFD_PLACEMENT_LISTEN_ADDR" in template
+        assert ".Values.placement.replicas" in template
+        # Read-only: the service must never hold write verbs — a
+        # replica going haywire cannot corrupt the label surface.
+        for verb in ("patch", "update", "create", "delete"):
+            assert verb not in template, verb
+        # No lease either (every replica serves the same index).
+        assert "configmaps" not in template
+
+        ds = list(yaml.safe_load_all(
+            (STATIC / "tpu-feature-placement-deployment.yaml")
+            .read_text()))
+        kinds = {d["kind"] for d in ds}
+        assert kinds == {"ServiceAccount", "ClusterRole",
+                         "ClusterRoleBinding", "Deployment", "Service"}
+        deploy = next(d for d in ds if d["kind"] == "Deployment")
+        assert deploy["spec"]["replicas"] == 2
+        container = deploy["spec"]["template"]["spec"]["containers"][0]
+        env = {e["name"]: e.get("value") for e in container["env"]}
+        assert env["TFD_MODE"] == "placement"
+        assert env["TFD_PLACEMENT_LISTEN_ADDR"] == ":8085"
+        # Probes ride the query port: readiness gates on the informer
+        # sync, so a cold replica never joins the Service.
+        assert container["readinessProbe"]["httpGet"]["port"] == \
+            "placements"
+        role = next(d for d in ds if d["kind"] == "ClusterRole")
+        verbs = {v for rule in role["rules"] for v in rule["verbs"]}
+        assert verbs == {"get", "list", "watch"}
+        svc = next(d for d in ds if d["kind"] == "Service")
+        assert svc["spec"]["ports"][0]["port"] == 8085
+
     def test_lifecycle_watch_knob_wired(self):
         """The preemption fast path (ISSUE 13 satellite): helm
         lifecycleWatch -> TFD_LIFECYCLE_WATCH, static daemonsets at the

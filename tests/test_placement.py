@@ -1,0 +1,415 @@
+"""Placement query service (ISSUE 17): twin parity + real-process smoke.
+
+The 100k-scale numbers (placements/sec served correctly, inventory
+staleness) live in scripts/cluster_soak.py --shards/--placement-qps;
+THESE tests pin:
+
+  - the tpufd.placement twin against the SimScheduler eligibility
+    contract (tpufd.cluster) — same winner, same no-candidate /
+    no-capacity verdicts, over randomized fleets and churn;
+  - the incremental index against a from-scratch rebuild (the O(answer)
+    rank walk never drifts from the label surface);
+  - the real binary in --mode=placement: informer sync (/readyz),
+    POST /v1/placements answers identical to the twin fed the same
+    label sets, protocol errors (400/405/404), the inventory admission
+    gate flipping a gold query to no-capacity with zero apiserver reads
+    per query, and node churn moving the answers.
+"""
+
+import http.client
+import json
+import os
+import random
+import subprocess
+import sys
+from pathlib import Path
+
+from conftest import http_get, wait_for
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from tpufd import agg  # noqa: E402
+from tpufd import cluster  # noqa: E402
+from tpufd import metrics  # noqa: E402
+from tpufd import placement  # noqa: E402
+from tpufd.fakes.apiserver import FakeApiServer  # noqa: E402
+
+NS = "placens"
+NODE_NAME_LABEL = "nfd.node.kubernetes.io/node-name"
+OUTPUT = "tfd-cluster-inventory"
+
+
+def free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def stop(proc):
+    proc.terminate()
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait(timeout=5)
+
+
+def metric(port, name, labels=None):
+    status, body = http_get(port, "/metrics")
+    if status != 200:
+        return None
+    try:
+        return metrics.sample_value(body, name, labels)
+    except ValueError:
+        return None
+
+
+def random_labels(rng):
+    labels = {}
+    if rng.random() < 0.9:
+        labels[agg.TPU_COUNT] = rng.choice(["4", "8", "16", "junk"])
+    if rng.random() < 0.85:
+        labels[agg.PERF_CLASS] = rng.choice(
+            ["gold", "silver", "degraded", "bronze", ""])
+    if rng.random() < 0.7:
+        labels[agg.SLICE_ID] = f"s-{rng.randrange(6)}"
+        labels[agg.SLICE_DEGRADED] = \
+            "true" if rng.random() < 0.25 else "false"
+        if rng.random() < 0.2:
+            labels[placement.SLICE_CLASS] = rng.choice(
+                ["degraded", "gold"])
+    if rng.random() < 0.1:
+        labels[agg.LIFECYCLE_PREEMPT] = "true"
+    if rng.random() < 0.1:
+        labels[placement.LIFECYCLE_DRAINING] = "true"
+    return labels
+
+
+class TestContractHelpers:
+    def test_rank_and_eligibility_match_cluster(self):
+        # The twin's helpers and the SimScheduler's must be the SAME
+        # function — a fleet of adversarial label sets agrees point by
+        # point (unit_tests.cc TestPlacementIndexContract pins the C++
+        # side on the same grid).
+        assert placement.class_rank("gold") == 3
+        assert placement.class_rank("silver") == 2
+        assert placement.class_rank("degraded") == 1
+        assert placement.class_rank("bronze") == 0
+        assert placement.class_rank("") == 0
+        assert placement.class_rank(None) == 0
+        assert placement.job_min_rank("gold") == 3
+        assert placement.job_min_rank("silver") == 2
+        assert placement.job_min_rank("any") == 0
+        assert placement.job_min_rank("bronze") == -1
+        rng = random.Random(29)
+        for _ in range(500):
+            labels = random_labels(rng)
+            assert placement.basic_eligible(labels) == \
+                cluster.basic_eligible(labels)
+            assert placement.preempting(labels) == \
+                cluster.preempting(labels)
+
+
+class TestTwinParity:
+    def test_query_matches_simscheduler(self):
+        # The load-bearing parity: over randomized fleets, the index's
+        # top candidate IS the SimScheduler's choice, and the
+        # no-candidate / no-capacity verdicts agree — for every job
+        # class and several chip sizes, with and without an inventory
+        # admission gate.
+        rng = random.Random(31)
+        for trial in range(60):
+            idx = placement.PlacementIndex()
+            sched = cluster.SimScheduler()
+            for i in range(rng.randrange(5, 40)):
+                node = f"pn-{i}"
+                labels = random_labels(rng)
+                idx.apply_node(node, labels)
+                sched.on_event(node, labels)
+            if trial % 3 == 0:
+                inventory = {
+                    agg.CAPACITY_PREFIX + "gold":
+                        str(rng.choice([0, 4, 64])),
+                    agg.CAPACITY_PREFIX + "silver":
+                        str(rng.choice([0, 8])),
+                    agg.CAPACITY_PREFIX + "unclassed": "0",
+                }
+                idx.apply_inventory(inventory)
+                sched.on_inventory(inventory)
+            for wanted in ("any", "silver", "gold"):
+                for chips in (1, 4, 8, 16):
+                    job = cluster.Job("j", wanted, chips, 1.0)
+                    decision = sched.place(job, 0.0)
+                    result = idx.query(wanted=wanted, chips=chips)
+                    if decision.placed:
+                        assert result["status"] == "placed"
+                        assert result["candidates"][0]["node"] == \
+                            decision.node, (trial, wanted, chips)
+                        # Keep the scheduler allocation-free like the
+                        # index: release immediately.
+                        sched.release("j")
+                    else:
+                        assert result["status"] == decision.reason, \
+                            (trial, wanted, chips)
+
+    def test_churned_index_equals_rebuilt(self):
+        # Apply/remove churn, then rebuild from the surviving label
+        # sets: every query answer and every gauge agrees — the
+        # incremental rank lists never drift.
+        rng = random.Random(37)
+        idx = placement.PlacementIndex()
+        fleet = {}
+        for step in range(600):
+            node = f"cn-{rng.randrange(50)}"
+            if rng.random() < 0.2 and node in fleet:
+                del fleet[node]
+                idx.remove_node(node)
+            else:
+                labels = random_labels(rng)
+                fleet[node] = labels
+                idx.apply_node(node, labels)
+        rebuilt = placement.PlacementIndex()
+        for node, labels in fleet.items():
+            rebuilt.apply_node(node, labels)
+        assert len(idx.nodes) == len(fleet)
+        assert idx.eligible() == rebuilt.eligible()
+        assert idx.blocked == rebuilt.blocked
+        for wanted in ("any", "silver", "gold"):
+            for chips in (1, 4, 8):
+                for want_slice in (False, True):
+                    assert idx.query(wanted=wanted, chips=chips,
+                                     slice=want_slice, limit=64) == \
+                        rebuilt.query(wanted=wanted, chips=chips,
+                                      slice=want_slice, limit=64)
+
+    def test_preference_order_and_filters(self):
+        # The pinned 5-node fleet from unit_tests.cc
+        # TestPlacementIndexContract — preference order, class floor,
+        # chips filter, worst-of-members blocking, slice requirement,
+        # and the admission gate.
+        idx = placement.PlacementIndex()
+        idx.apply_node("a-gold", {agg.PERF_CLASS: "gold",
+                                  agg.TPU_COUNT: "4",
+                                  agg.SLICE_ID: "s-1"})
+        idx.apply_node("b-gold-big", {agg.PERF_CLASS: "gold",
+                                      agg.TPU_COUNT: "8",
+                                      agg.SLICE_ID: "s-1"})
+        idx.apply_node("c-silver", {agg.PERF_CLASS: "silver",
+                                    agg.TPU_COUNT: "8"})
+        idx.apply_node("d-degraded", {agg.PERF_CLASS: "degraded",
+                                      agg.TPU_COUNT: "8"})
+        idx.apply_node("e-preempt", {agg.PERF_CLASS: "gold",
+                                     agg.TPU_COUNT: "8",
+                                     agg.LIFECYCLE_PREEMPT: "true"})
+        assert len(idx.nodes) == 5
+        assert idx.eligible() == 3
+        full = idx.query(limit=64)
+        assert [c["node"] for c in full["candidates"]] == \
+            ["b-gold-big", "a-gold", "c-silver"]
+        # Class floor.
+        gold = idx.query(wanted="gold", limit=64)
+        assert [c["node"] for c in gold["candidates"]] == \
+            ["b-gold-big", "a-gold"]
+        # Chips filter (free descends within a rank).
+        assert [c["node"] for c in
+                idx.query(chips=8, limit=64)["candidates"]] == \
+            ["b-gold-big", "c-silver"]
+        # A multislice job needs a slice member.
+        assert [c["node"] for c in
+                idx.query(slice=True, limit=64)["candidates"]] == \
+            ["b-gold-big", "a-gold"]
+        # Worst-of-members: one peer's degraded claim blocks s-1.
+        idx.apply_node("f-verdict", {agg.SLICE_ID: "s-1",
+                                     agg.SLICE_DEGRADED: "true"})
+        assert [c["node"] for c in idx.query(limit=64)["candidates"]] \
+            == ["c-silver"]
+        idx.remove_node("f-verdict")
+        assert [c["node"] for c in idx.query(limit=64)["candidates"]] \
+            == ["b-gold-big", "a-gold", "c-silver"]
+        # Admission: a synced inventory with zero admissible chips
+        # refuses BEFORE any scan; deleting it re-admits.
+        idx.apply_inventory({agg.CAPACITY_PREFIX + "gold": "0",
+                             agg.CAPACITY_PREFIX + "silver": "junk"})
+        assert idx.query(wanted="gold")["status"] == "no-capacity"
+        idx.apply_inventory({})
+        assert idx.query(wanted="gold")["status"] == "placed"
+        # Limit clamps.
+        assert len(idx.query(limit=2)["candidates"]) == 2
+        assert idx.query(chips=99)["status"] == "no-candidate"
+
+
+# ---- the real binary -------------------------------------------------------
+
+
+def placement_argv(binary, query_port, obs_port):
+    return [str(binary), "--mode=placement",
+            f"--placement-listen-addr=127.0.0.1:{query_port}",
+            f"--introspection-addr=127.0.0.1:{obs_port}"]
+
+
+def placement_env(server):
+    return {**os.environ, "TFD_APISERVER_URL": server.url,
+            "KUBERNETES_NAMESPACE": NS, "POD_NAME": "placement-0",
+            "GCE_METADATA_HOST": "127.0.0.1:1"}
+
+
+def post_placement(port, doc, raw=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+    body = raw if raw is not None else json.dumps(doc)
+    conn.request("POST", "/v1/placements", body=body,
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    payload = resp.read().decode()
+    conn.close()
+    return resp.status, json.loads(payload) if payload else None
+
+
+def seed_placement_fleet(server, n):
+    twin = placement.PlacementIndex()
+    for i in range(n):
+        labels = {
+            agg.TPU_COUNT: str([4, 8][i % 2]),
+            agg.PERF_CLASS: ["gold", "silver", "degraded"][i % 3],
+            agg.SLICE_ID: f"ps-{i // 4}",
+            agg.SLICE_DEGRADED: "false",
+        }
+        server.seed(NS, f"tfd-features-for-p{i}", labels,
+                    {NODE_NAME_LABEL: f"p{i}"})
+        twin.apply_node(f"p{i}", labels)
+    return twin
+
+
+class TestPlacementProcess:
+    def test_http_service_answers_like_the_twin(self, tfd_binary):
+        with FakeApiServer() as server:
+            twin = seed_placement_fleet(server, 12)
+            qport, oport = free_port(), free_port()
+            proc = subprocess.Popen(
+                placement_argv(tfd_binary, qport, oport),
+                env=placement_env(server), stderr=subprocess.DEVNULL)
+            try:
+                # Informer sync gates readiness.
+                assert wait_for(
+                    lambda: http_get(qport, "/readyz")[0] == 200,
+                    timeout=20)
+                assert http_get(qport, "/healthz")[0] == 200
+
+                # Every query the twin can pose, the service answers
+                # identically — zero apiserver reads on the query path
+                # (watch rotations don't count; LIST/GET would).
+                def list_reads():
+                    return sum(1 for m, _ in server.requests
+                               if m != "WATCH")
+
+                reads_before = list_reads()
+                for doc in ({"class": "any", "chips": 1, "limit": 5},
+                            {"class": "gold", "chips": 4, "limit": 64},
+                            {"class": "silver", "chips": 8},
+                            {"class": "any", "chips": 8, "slice": True,
+                             "limit": 3},
+                            {"class": "gold", "chips": 99}):
+                    status, body = post_placement(qport, doc)
+                    assert status == 200, (doc, body)
+                    assert body == twin.query(
+                        wanted=doc["class"], chips=doc["chips"],
+                        slice=doc.get("slice", False),
+                        limit=doc.get("limit", 1)), doc
+                assert list_reads() == reads_before
+
+                # Protocol errors.
+                status, body = post_placement(
+                    qport, {"class": "bronze", "chips": 1})
+                assert status == 400 and "error" in body
+                status, _ = post_placement(qport, None, raw="not json")
+                assert status == 400
+                assert http_get(qport, "/v1/placements")[0] == 405
+                assert http_get(qport, "/nope")[0] == 404
+
+                # Node churn moves the answers: demote the nodes the
+                # service preferred and the winner changes.
+                before = post_placement(
+                    qport, {"class": "any", "chips": 1})[1]
+                winner = before["candidates"][0]["node"]
+                demoted = {agg.TPU_COUNT: "4",
+                           agg.PERF_CLASS: "degraded"}
+                server.seed(NS, f"tfd-features-for-{winner}", demoted,
+                            {NODE_NAME_LABEL: winner})
+                twin.apply_node(winner, demoted)
+                assert wait_for(
+                    lambda: post_placement(
+                        qport, {"class": "any", "chips": 1})[1] ==
+                    twin.query(), timeout=10)
+
+                # Delete retirement shrinks the index.
+                server.delete(NS, "tfd-features-for-p3")
+                twin.remove_node("p3")
+                assert wait_for(
+                    lambda: metric(oport, "tfd_placement_nodes") == 11.0,
+                    timeout=10)
+                assert post_placement(
+                    qport, {"class": "any", "chips": 1,
+                            "limit": 64})[1] == twin.query(limit=64)
+                assert metric(oport, "tfd_placement_queries_total",
+                              labels={"status": "placed"}) >= 1.0
+                assert metric(oport, "tfd_placement_queries_total",
+                              labels={"status": "bad-request"}) >= 2.0
+            finally:
+                stop(proc)
+
+    def test_inventory_admission_gate(self, tfd_binary):
+        # The aggregator's rollup object gates admission: a cluster
+        # whose inventory says zero gold chips answers no-capacity to a
+        # gold job WITHOUT scanning — even though gold-labeled nodes
+        # exist (the inventory is authoritative for admission, the scan
+        # for candidates; SimScheduler.admit draws the same line).
+        with FakeApiServer() as server:
+            twin = seed_placement_fleet(server, 6)
+            server.seed(NS, OUTPUT, {
+                agg.CAPACITY_PREFIX + "gold": "0",
+                agg.CAPACITY_PREFIX + "silver": "0",
+                agg.CAPACITY_PREFIX + "unclassed": "0",
+            })
+            twin.apply_inventory({
+                agg.CAPACITY_PREFIX + "gold": "0",
+                agg.CAPACITY_PREFIX + "silver": "0",
+                agg.CAPACITY_PREFIX + "unclassed": "0",
+            })
+            qport, oport = free_port(), free_port()
+            proc = subprocess.Popen(
+                placement_argv(tfd_binary, qport, oport),
+                env=placement_env(server), stderr=subprocess.DEVNULL)
+            try:
+                assert wait_for(
+                    lambda: http_get(qport, "/readyz")[0] == 200,
+                    timeout=20)
+                status, body = post_placement(
+                    qport, {"class": "gold", "chips": 4})
+                assert status == 200
+                assert body == {"status": "no-capacity",
+                                "candidates": []}
+                assert body == twin.query(wanted="gold", chips=4)
+                # The inventory rollup is updated (capacity appears):
+                # the same query starts placing.
+                refreshed = {agg.CAPACITY_PREFIX + "gold": "24"}
+                server.seed(NS, OUTPUT, refreshed)
+                twin.apply_inventory(refreshed)
+                assert wait_for(
+                    lambda: post_placement(
+                        qport, {"class": "gold", "chips": 4})[1] ==
+                    twin.query(wanted="gold", chips=4), timeout=10)
+                assert post_placement(
+                    qport,
+                    {"class": "gold", "chips": 4})[1]["status"] == \
+                    "placed"
+                # Deleting the inventory object re-admits everything.
+                server.delete(NS, OUTPUT)
+                twin.apply_inventory({})
+                assert wait_for(
+                    lambda: metric(
+                        oport, "tfd_placement_events_total",
+                        labels={"type": "inventory"}) >= 2.0,
+                    timeout=10)
+            finally:
+                stop(proc)

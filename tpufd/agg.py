@@ -15,6 +15,8 @@ parity tests pin against the C++ (change one side, change both):
   - the coalescing bounded-staleness flush controller.
 """
 
+from .sink import fnv1a64
+
 PREFIX = "google.com/"
 
 SLICE_ID = PREFIX + "tpu.slice.id"
@@ -62,10 +64,61 @@ SLO_STAGE_BUDGETS_MS = {
     "publish-acked": 1300.0,
 }
 
+# Sharded aggregation tree (lm/schema.h kAgg*): the label keys an L1
+# shard's PARTIAL rollup CR carries — the shard's whole aggregate state
+# as counter maps and sparse sketch buckets, merged O(delta) by the L2
+# root into the byte-compatible cluster inventory.
+AGG_PREFIX = PREFIX + "tfd.agg."
+AGG_TIER = AGG_PREFIX + "tier"
+AGG_SHARD = AGG_PREFIX + "shard"
+AGG_NODES = AGG_PREFIX + "nodes"
+AGG_PREEMPTING = AGG_PREFIX + "preempting"
+AGG_SLICES = AGG_PREFIX + "slices"
+AGG_CAPACITY = AGG_PREFIX + "capacity"
+AGG_MULTISLICE = AGG_PREFIX + "multislice"
+AGG_MATMUL = AGG_PREFIX + "matmul"
+AGG_HBM = AGG_PREFIX + "hbm"
+AGG_STAGE_SLO = AGG_PREFIX + "stage-slo"
+AGG_TIER_PARTIAL = "partial"
+
 # agg.h kSketch* — the parity grid pins bucket indices on both sides.
 SKETCH_MIN = 0.5
 SKETCH_GAMMA = 1.1
 SKETCH_BUCKETS = 128
+
+
+def shard_index_of(node, shards):
+    """C++ ShardIndexOf: node -> L1 shard via the twin-pinned textbook
+    FNV-1a name hash (shards <= 1 maps everything to shard 0)."""
+    if shards <= 1:
+        return 0
+    return fnv1a64(node) % shards
+
+
+# runner.cc ClassifyName: how one watched object participates in a
+# tier's ingest. The inventory exclusion comes FIRST: partials
+# deliberately carry the nfd node-name label (so the L2's selector
+# watch sees them), which puts them in EVERY tier's stream — without
+# the explicit name rule a shard would re-ingest inventory as node
+# contributions.
+CR_NAME_PREFIX = "tfd-features-for-"
+INVENTORY_NAME_PREFIX = "tfd-inventory-"
+PARTIAL_NAME_PREFIX = "tfd-inventory-shard-"
+
+OBJ_NODE_CR = "node-cr"
+OBJ_PARTIAL = "partial"
+OBJ_OTHER = "other"
+
+
+def classify_name(name, output_name):
+    """Twin of runner.cc ClassifyName."""
+    if name.startswith(PARTIAL_NAME_PREFIX):
+        return OBJ_PARTIAL
+    if name.startswith(INVENTORY_NAME_PREFIX) or name == output_name:
+        return OBJ_OTHER
+    if name.startswith(CR_NAME_PREFIX):
+        return OBJ_NODE_CR
+    return OBJ_OTHER
 
 
 def sketch_bucket_index(value):
@@ -150,6 +203,16 @@ class Sketch:
             if cumulative > target:
                 return sketch_bucket_value(i)
         return sketch_bucket_value(SKETCH_BUCKETS - 1)
+
+    def __eq__(self, other):
+        """C++ QuantileSketch::operator== (total + per-bucket counts)."""
+        if not isinstance(other, Sketch):
+            return NotImplemented
+        return self.total == other.total and self.counts == other.counts
+
+    def __ne__(self, other):
+        eq = self.__eq__(other)
+        return eq if eq is NotImplemented else not eq
 
 
 def slo_budgets_ms_from_spec(spec):
@@ -302,6 +365,239 @@ def fixed3(v):
     return "%.3f" % v
 
 
+def rollup_state():
+    """C++ RollupState zero value: the complete aggregate state one tier
+    holds — what an L1 publishes as its partial, what the L2 accumulates
+    per shard and as the merged total. Dict twin; ``slices`` values are
+    ``[members, degraded, preempting]`` lists (the store's format)."""
+    return {
+        "nodes": 0,
+        "preempting": 0,
+        "slices": {},
+        "capacity": {},
+        "multislice": {},
+        "matmul": Sketch(),
+        "hbm": Sketch(),
+        "stage": {},
+    }
+
+
+def build_rollup_labels(state):
+    """C++ BuildRollupLabels: the cluster-scoped rollup label set from
+    an aggregate state — every tier's output flows through this one
+    function so byte-compat across the tree is structural."""
+    healthy = sum(1 for agg in state["slices"].values()
+                  if agg[1] == 0 and agg[2] == 0)
+    degraded = len(state["slices"]) - healthy
+    out = {
+        INVENTORY_SLICES: str(len(state["slices"])),
+        INVENTORY_HEALTHY: str(healthy),
+        INVENTORY_DEGRADED: str(degraded),
+    }
+    total_chips = 0
+    for bucket in ("gold", "silver", "degraded", "unclassed"):
+        chips = state["capacity"].get(bucket, 0)
+        total_chips += chips
+        out[CAPACITY_PREFIX + bucket] = str(chips)
+    out[CAPACITY_PREFIX + "total-chips"] = str(total_chips)
+    out[FLEET_NODES] = str(state["nodes"])
+    out[FLEET_PREEMPTING] = str(state["preempting"])
+    out[MULTISLICE_GROUPS] = str(len(state["multislice"]))
+    if state["matmul"].total > 0:
+        out[FLEET_MATMUL_P10] = fixed3(state["matmul"].quantile(0.10))
+        out[FLEET_MATMUL_P50] = fixed3(state["matmul"].quantile(0.50))
+    if state["hbm"].total > 0:
+        out[FLEET_HBM_P10] = fixed3(state["hbm"].quantile(0.10))
+        out[FLEET_HBM_P50] = fixed3(state["hbm"].quantile(0.50))
+    for name in SLO_STAGES:
+        sketch = state["stage"].get(name)
+        if sketch is None or sketch.total <= 0:
+            continue
+        base = OBS_STAGE_PREFIX + name
+        out[base + ".p50-ms"] = fixed3(sketch.quantile(0.50))
+        out[base + ".p99-ms"] = fixed3(sketch.quantile(0.99))
+    return out
+
+
+def serialize_sketch(sketch):
+    """C++ SerializeSketch: sparse ascending ``bucket:count`` pairs
+    joined by ',' ("" = empty)."""
+    return ",".join(f"{i}:{n}" for i, n in enumerate(sketch.counts)
+                    if n > 0)
+
+
+def parse_sketch(text):
+    """C++ ParseSketch: tolerant inverse (malformed pairs skipped)."""
+    sketch = Sketch()
+    for pair in (text or "").split(","):
+        bucket, colon, count = pair.partition(":")
+        if not colon or not bucket.isdigit() or not count.isdigit():
+            continue
+        sketch.add_bucket_count(int(bucket), int(count))
+    return sketch
+
+
+def serialize_partial_labels(state, shard_spec):
+    """C++ SerializePartialLabels: the partial CR's label payload —
+    the aggregate state under the AGG_* keys plus the tier marker and
+    the "i/n" shard spec; empty maps/sketches omit their key."""
+    out = {
+        AGG_TIER: AGG_TIER_PARTIAL,
+        AGG_SHARD: shard_spec,
+        AGG_NODES: str(state["nodes"]),
+        AGG_PREEMPTING: str(state["preempting"]),
+    }
+    if state["slices"]:
+        out[AGG_SLICES] = ",".join(
+            f"{sid}:{agg[0]}:{agg[1]}:{agg[2]}"
+            for sid, agg in sorted(state["slices"].items()))
+    if state["capacity"]:
+        out[AGG_CAPACITY] = ",".join(
+            f"{k}:{n}" for k, n in sorted(state["capacity"].items()))
+    if state["multislice"]:
+        out[AGG_MULTISLICE] = ",".join(
+            f"{k}:{n}" for k, n in sorted(state["multislice"].items()))
+    if state["matmul"].total > 0:
+        out[AGG_MATMUL] = serialize_sketch(state["matmul"])
+    if state["hbm"].total > 0:
+        out[AGG_HBM] = serialize_sketch(state["hbm"])
+    slo = serialize_stage_sketches(state["stage"])
+    if slo:
+        out[AGG_STAGE_SLO] = slo
+    return out
+
+
+def parse_partial_labels(labels):
+    """C++ ParsePartialLabels: None when the tier marker is absent (the
+    labels are not a partial); malformed fields are skipped, never
+    fatal — the payload arrives from the wire."""
+    if labels.get(AGG_TIER) != AGG_TIER_PARTIAL:
+        return None
+    state = rollup_state()
+    for key, field in ((AGG_NODES, "nodes"), (AGG_PREEMPTING, "preempting")):
+        raw = labels.get(key, "")
+        if raw.isdigit():
+            state[field] = int(raw)
+    for entry in labels.get(AGG_SLICES, "").split(","):
+        parts = entry.split(":")
+        if len(parts) != 4 or not parts[0]:
+            continue
+        if not all(p.isdigit() for p in parts[1:]):
+            continue
+        state["slices"][parts[0]] = [int(p) for p in parts[1:]]
+    for key, field in ((AGG_CAPACITY, "capacity"),
+                       (AGG_MULTISLICE, "multislice")):
+        for entry in labels.get(key, "").split(","):
+            name, colon, count = entry.partition(":")
+            if not colon or not name or not count.isdigit():
+                continue
+            state[field][name] = int(count)
+    if AGG_MATMUL in labels:
+        state["matmul"] = parse_sketch(labels[AGG_MATMUL])
+    if AGG_HBM in labels:
+        state["hbm"] = parse_sketch(labels[AGG_HBM])
+    if AGG_STAGE_SLO in labels:
+        state["stage"] = parse_stage_sketches(labels[AGG_STAGE_SLO])
+    return state
+
+
+class ShardMergeStore:
+    """C++ ShardMergeStore twin: the L2 root's store — one RollupState
+    per live shard plus the merged total, maintained O(delta per
+    partial): apply retires the shard's previous partial (counter
+    subtraction + sketch unmerge) and admits the new one. Root state is
+    O(shards), never O(nodes)."""
+
+    def __init__(self):
+        self.partials = {}
+        self.merged = rollup_state()
+        self.events = 0
+        self.full_recomputes = 0
+
+    def _retire(self, p):
+        m = self.merged
+        m["nodes"] -= p["nodes"]
+        m["preempting"] -= p["preempting"]
+        for sid, agg in p["slices"].items():
+            have = m["slices"].get(sid)
+            if have is None:
+                continue
+            have[0] -= agg[0]
+            have[1] -= agg[1]
+            have[2] -= agg[2]
+            if have[0] <= 0:
+                del m["slices"][sid]
+        for field in ("capacity", "multislice"):
+            for key, n in p[field].items():
+                if key not in m[field]:
+                    continue
+                m[field][key] -= n
+                if m[field][key] <= 0:
+                    del m[field][key]
+        m["matmul"].unmerge(p["matmul"])
+        m["hbm"].unmerge(p["hbm"])
+        for stage, sketch in p["stage"].items():
+            merged = m["stage"].get(stage)
+            if merged is None:
+                continue
+            merged.unmerge(sketch)
+            if merged.total <= 0:
+                del m["stage"][stage]
+
+    def _admit(self, p):
+        m = self.merged
+        m["nodes"] += p["nodes"]
+        m["preempting"] += p["preempting"]
+        for sid, agg in p["slices"].items():
+            have = m["slices"].setdefault(sid, [0, 0, 0])
+            have[0] += agg[0]
+            have[1] += agg[1]
+            have[2] += agg[2]
+        for field in ("capacity", "multislice"):
+            for key, n in p[field].items():
+                m[field][key] = m[field].get(key, 0) + n
+        m["matmul"].merge(p["matmul"])
+        m["hbm"].merge(p["hbm"])
+        for stage, sketch in p["stage"].items():
+            m["stage"].setdefault(stage, Sketch()).merge(sketch)
+
+    def apply_partial(self, shard, partial):
+        """Returns True when the shard's partial changed (a rollup
+        moved and a publish is owed) — equal partials are a no-op."""
+        self.events += 1
+        prev = self.partials.get(shard)
+        if prev is not None:
+            if prev == partial:
+                return False
+            self._retire(prev)
+        self.partials[shard] = partial
+        self._admit(partial)
+        return True
+
+    def remove_partial(self, shard):
+        self.events += 1
+        prev = self.partials.pop(shard, None)
+        if prev is None:
+            return False
+        self._retire(prev)
+        return True
+
+    @property
+    def stage_sketches(self):
+        return self.merged["stage"]
+
+    def build_output_labels(self):
+        return build_rollup_labels(self.merged)
+
+    def recompute_all(self):
+        """Self-check ONLY — full_recomputes == 0 on every tier is the
+        acceptance contract."""
+        self.full_recomputes += 1
+        self.merged = rollup_state()
+        for p in self.partials.values():
+            self._admit(p)
+
+
 class InventoryStore:
     """C++ InventoryStore twin: incremental O(delta) rollups."""
 
@@ -399,38 +695,23 @@ class InventoryStore:
         self._retire(prev)
         return True
 
-    def build_output_labels(self):
-        healthy = sum(1 for agg in self.slices.values()
-                      if agg[1] == 0 and agg[2] == 0)
-        degraded = len(self.slices) - healthy
-        out = {
-            INVENTORY_SLICES: str(len(self.slices)),
-            INVENTORY_HEALTHY: str(healthy),
-            INVENTORY_DEGRADED: str(degraded),
+    def partial(self):
+        """C++ InventoryStore::Partial: the store's whole aggregate
+        state (live references) — what an L1 shard serializes into its
+        partial CR via serialize_partial_labels."""
+        return {
+            "nodes": len(self.nodes),
+            "preempting": self.preempting_nodes,
+            "slices": self.slices,
+            "capacity": self.capacity,
+            "multislice": self.multislice,
+            "matmul": self.matmul,
+            "hbm": self.hbm,
+            "stage": self.stage,
         }
-        total_chips = 0
-        for bucket in ("gold", "silver", "degraded", "unclassed"):
-            chips = self.capacity.get(bucket, 0)
-            total_chips += chips
-            out[CAPACITY_PREFIX + bucket] = str(chips)
-        out[CAPACITY_PREFIX + "total-chips"] = str(total_chips)
-        out[FLEET_NODES] = str(len(self.nodes))
-        out[FLEET_PREEMPTING] = str(self.preempting_nodes)
-        out[MULTISLICE_GROUPS] = str(len(self.multislice))
-        if self.matmul.total > 0:
-            out[FLEET_MATMUL_P10] = fixed3(self.matmul.quantile(0.10))
-            out[FLEET_MATMUL_P50] = fixed3(self.matmul.quantile(0.50))
-        if self.hbm.total > 0:
-            out[FLEET_HBM_P10] = fixed3(self.hbm.quantile(0.10))
-            out[FLEET_HBM_P50] = fixed3(self.hbm.quantile(0.50))
-        for name in SLO_STAGES:
-            sketch = self.stage.get(name)
-            if sketch is None or sketch.total <= 0:
-                continue
-            base = OBS_STAGE_PREFIX + name
-            out[base + ".p50-ms"] = fixed3(sketch.quantile(0.50))
-            out[base + ".p99-ms"] = fixed3(sketch.quantile(0.99))
-        return out
+
+    def build_output_labels(self):
+        return build_rollup_labels(self.partial())
 
     def recompute_all(self):
         """Self-check ONLY: the steady path never rebuilds (the soak
@@ -474,3 +755,10 @@ class FlushController:
 
     def note_flushed(self):
         self.dirty_since = None
+
+    def rearm(self, since):
+        """Restore a consumed window after a failed publish: the retry
+        owes the ORIGINAL staleness, so an event that dirtied the
+        controller mid-publish never shortens it."""
+        if self.dirty_since is None or since < self.dirty_since:
+            self.dirty_since = since

@@ -49,11 +49,15 @@ APPLY_PATCH = "application/apply-patch+yaml"
 
 # Watch-event history retained per object; a watch asking for a version
 # older than the retained window answers ERROR 410 (client must re-list).
+# Default only — FakeApiServer(watch_history=...) overrides per server
+# (a 100k-node sharded soak needs a floor proportional to fleet size or
+# every reconnect would 410 into a full re-list).
 WATCH_HISTORY = 64
 # Collection-scoped history (one merged stream per namespace, ordered by
 # the GLOBAL resourceVersion — the real apiserver's storage revision).
 # Deliberately larger than the per-object window: one busy object must
 # not compact every peer's events out from under a collection watcher.
+# Default only — FakeApiServer(collection_history=...) overrides.
 COLLECTION_HISTORY = 256
 # Cluster-scoped core resources (GET/PUT /api/v1/nodes/<name>): the
 # lifecycle probe reads spec.unschedulable/taints from here.
@@ -134,6 +138,11 @@ class _Handler(BaseHTTPRequestHandler):
     collection_events = None    # type: dict  # ns -> [(grv, type, obj)]
     collection_compacted = None  # type: dict  # ns -> int
     nodes = None      # type: dict  # name -> Node object (/api/v1/nodes)
+    # Retained history depths (the 410 compaction floors). Class attrs
+    # so FakeApiServer(watch_history=..., collection_history=...) can
+    # size the replay window to the fleet under test.
+    watch_history = WATCH_HISTORY
+    collection_history = COLLECTION_HISTORY
     watch_cond = None
     closing = None    # type: list  # [bool] — server shutting down
     bookmark_interval = 0.5
@@ -253,9 +262,9 @@ class _Handler(BaseHTTPRequestHandler):
         history = cls.events.setdefault((ns, name), [])
         rv = int(obj["metadata"]["resourceVersion"])
         history.append((rv, event_type, copy.deepcopy(obj)))
-        if len(history) > WATCH_HISTORY:
-            dropped = history[:-WATCH_HISTORY]
-            del history[:-WATCH_HISTORY]
+        if len(history) > cls.watch_history:
+            dropped = history[:-cls.watch_history]
+            del history[:-cls.watch_history]
             cls.compacted[(ns, name)] = dropped[-1][0]
         # Collection stream: the same event ordered by the GLOBAL
         # resourceVersion (per-object rvs are per-object counters and
@@ -263,9 +272,9 @@ class _Handler(BaseHTTPRequestHandler):
         cls.grv[0] += 1
         chistory = cls.collection_events.setdefault(ns, [])
         chistory.append((cls.grv[0], event_type, copy.deepcopy(obj)))
-        if len(chistory) > COLLECTION_HISTORY:
-            dropped = chistory[:-COLLECTION_HISTORY]
-            del chistory[:-COLLECTION_HISTORY]
+        if len(chistory) > cls.collection_history:
+            dropped = chistory[:-cls.collection_history]
+            del chistory[:-cls.collection_history]
             cls.collection_compacted[ns] = dropped[-1][0]
         cls.watch_cond.notify_all()
 
@@ -676,7 +685,9 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class FakeApiServer:
-    def __init__(self, token=None, certfile=None, keyfile=None, port=0):
+    def __init__(self, token=None, certfile=None, keyfile=None, port=0,
+                 watch_history=WATCH_HISTORY,
+                 collection_history=COLLECTION_HISTORY):
         # RLock: _reply logs the request under the lock, and the POST/PUT
         # error branches call _reply while already holding it for the
         # store — a plain Lock would deadlock every 409/404 reply.
@@ -689,6 +700,8 @@ class FakeApiServer:
             "apply_supported": True, "events": {}, "compacted": {},
             "managers": {}, "grv": [0], "collection_events": {},
             "collection_compacted": {}, "nodes": {},
+            "watch_history": int(watch_history),
+            "collection_history": int(collection_history),
             "watch_cond": threading.Condition(lock),
             "closing": [False]})
         self.store = handler.store

@@ -1,0 +1,212 @@
+"""Placement query index — the parity twin of src/tfd/placement/.
+
+The C++ service answers `POST /v1/placements` from an informer-fed
+in-memory index over NodeFeature CRs; this module is the same index in
+Python, bit-for-bit on the eligibility contract, so the cluster soak can
+drive it at fleet scale (100k nodes) on the virtual clock and score
+served placements against the SimScheduler ground truth
+(tpufd/cluster.py), and so tests can pin the twin against the real
+binary's HTTP responses.
+
+The eligibility contract (tpufd.cluster, replicated by both sides):
+
+  - basic eligibility: perf class not "degraded", own slice labels not
+    degraded, not preempting/draining;
+  - slice worst-of-members: a slice id ANY member marks degraded blocks
+    every member;
+  - preference order: highest perf class, then most free chips
+    (spread), then lexicographic node name;
+  - cluster admission: the aggregator's capacity-by-class rollup gates
+    a query before any scan ("no-capacity"); an empty inventory admits
+    everything.
+
+The index is allocation-free (`free` = published chip capacity): the
+caller owns allocation bookkeeping, exactly like SimScheduler.node_used.
+Candidate sets are maintained incrementally per rank as bisect-sorted
+``(-free, node)`` lists, so a query costs O(answer + filtered), never
+O(nodes).
+"""
+
+import bisect
+
+from . import agg as agglib
+
+PERF_CLASS = agglib.PERF_CLASS
+TPU_COUNT = agglib.TPU_COUNT
+SLICE_ID = agglib.SLICE_ID
+SLICE_DEGRADED = agglib.SLICE_DEGRADED
+SLICE_CLASS = agglib.PREFIX + "tpu.slice.class"
+LIFECYCLE_PREEMPT = agglib.LIFECYCLE_PREEMPT
+LIFECYCLE_DRAINING = agglib.LIFECYCLE_DRAINING
+CAPACITY_PREFIX = agglib.CAPACITY_PREFIX
+
+CLASS_RANK = {"gold": 3, "silver": 2, "degraded": 1}
+JOB_CLASS_RANK = {"gold": 3, "silver": 2, "any": 0}
+
+MAX_LIMIT = 64  # PlacementIndex::kMaxLimit
+
+
+def class_rank(perf_class):
+    return CLASS_RANK.get(perf_class or "", 0)
+
+
+def job_min_rank(wanted):
+    """-1 flags an unknown floor (the C++ side serves HTTP 400)."""
+    return JOB_CLASS_RANK.get(wanted, -1)
+
+
+def preempting(labels):
+    return (labels.get(LIFECYCLE_PREEMPT) == "true" or
+            labels.get(LIFECYCLE_DRAINING) == "true")
+
+
+def basic_eligible(labels):
+    if labels.get(PERF_CLASS) == "degraded":
+        return False
+    if labels.get(SLICE_DEGRADED) == "true":
+        return False
+    if labels.get(SLICE_CLASS) == "degraded":
+        return False
+    if preempting(labels):
+        return False
+    return True
+
+
+def slice_degraded_claim(labels):
+    return (labels.get(SLICE_DEGRADED) == "true" or
+            labels.get(SLICE_CLASS) == "degraded")
+
+
+def _chips(labels):
+    raw = labels.get(TPU_COUNT, "")
+    try:
+        value = int(raw)
+    except ValueError:
+        return 0
+    return max(0, value)
+
+
+class PlacementIndex:
+    """Twin of placement::PlacementIndex."""
+
+    def __init__(self):
+        self.nodes = {}      # node -> entry tuple
+        self.by_rank = {}    # rank -> bisect-sorted [(-free, node), ...]
+        self.claims = {}     # slice id -> degraded-claim member count
+        self.blocked = set() # claims keys with count > 0
+        self.inventory_capacity = {}
+        self.have_inventory = False
+        self.events = 0
+
+    # entry = (perf_class, rank, chips, slice_id, basic, claim)
+
+    def _insert(self, node, entry):
+        perf_class, rank, chips, slice_id, basic, claim = entry
+        del perf_class
+        if basic:
+            bisect.insort(self.by_rank.setdefault(rank, []),
+                          (-chips, node))
+        if claim and slice_id:
+            self.claims[slice_id] = self.claims.get(slice_id, 0) + 1
+            self.blocked.add(slice_id)
+
+    def _erase(self, node, entry):
+        perf_class, rank, chips, slice_id, basic, claim = entry
+        del perf_class
+        if basic:
+            ranked = self.by_rank.get(rank)
+            if ranked is not None:
+                idx = bisect.bisect_left(ranked, (-chips, node))
+                if idx < len(ranked) and ranked[idx] == (-chips, node):
+                    ranked.pop(idx)
+                if not ranked:
+                    del self.by_rank[rank]
+        if claim and slice_id:
+            count = self.claims.get(slice_id, 0) - 1
+            if count <= 0:
+                self.claims.pop(slice_id, None)
+                self.blocked.discard(slice_id)
+            else:
+                self.claims[slice_id] = count
+
+    def apply_node(self, node, labels):
+        perf_class = labels.get(PERF_CLASS, "")
+        entry = (perf_class, class_rank(perf_class), _chips(labels),
+                 labels.get(SLICE_ID, ""), basic_eligible(labels),
+                 slice_degraded_claim(labels))
+        old = self.nodes.get(node)
+        if old == entry:
+            return False
+        if old is not None:
+            self._erase(node, old)
+        self.nodes[node] = entry
+        self._insert(node, entry)
+        self.events += 1
+        return True
+
+    def remove_node(self, node):
+        old = self.nodes.pop(node, None)
+        if old is None:
+            return False
+        self._erase(node, old)
+        self.events += 1
+        return True
+
+    def apply_inventory(self, labels):
+        """Pass {} (or None) when the inventory object is deleted."""
+        labels = labels or {}
+        self.inventory_capacity = {}
+        self.have_inventory = bool(labels)
+        for key, value in labels.items():
+            if not key.startswith(CAPACITY_PREFIX):
+                continue
+            bucket = key[len(CAPACITY_PREFIX):]
+            # SimScheduler.admit: int(raw) if raw.isdigit() else 0.
+            self.inventory_capacity[bucket] = (
+                int(value) if value.isdigit() else 0)
+        self.events += 1
+
+    def admit(self, min_rank, chips):
+        if not self.have_inventory:
+            return True
+        total = 0
+        for bucket, rank in (("gold", 3), ("silver", 2), ("unclassed", 0)):
+            if rank >= min_rank:
+                total += self.inventory_capacity.get(bucket, 0)
+        return total >= chips
+
+    def eligible(self):
+        return sum(len(ranked) for ranked in self.by_rank.values())
+
+    def query(self, wanted="any", chips=1, slice=False, limit=1):
+        """Returns the same document RenderPlacementResult emits:
+        {"status": ..., "candidates": [{"node","class","free","slice"}]}."""
+        min_rank = job_min_rank(wanted)
+        if min_rank < 0:
+            raise ValueError(f"unknown class {wanted!r}")
+        limit = max(1, min(int(limit), MAX_LIMIT))
+        if not self.admit(min_rank, chips):
+            return {"status": "no-capacity", "candidates": []}
+        candidates = []
+        for rank in sorted(self.by_rank, reverse=True):
+            if rank < min_rank:
+                break
+            for neg_free, node in self.by_rank[rank]:
+                free = -neg_free
+                if free < chips:
+                    break  # free descends within a rank
+                entry = self.nodes[node]
+                slice_id = entry[3]
+                if not slice_id:
+                    if slice:
+                        continue  # multislice job needs a member
+                elif slice_id in self.blocked:
+                    continue  # worst-of-members: a peer blocks it
+                candidates.append({"node": node, "class": entry[0],
+                                   "free": free, "slice": slice_id})
+                if len(candidates) >= limit:
+                    return {"status": "placed", "candidates": candidates}
+            if len(candidates) >= limit:
+                break
+        return {"status": "placed" if candidates else "no-candidate",
+                "candidates": candidates}

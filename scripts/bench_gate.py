@@ -66,6 +66,16 @@ top so the per-mode functions only state their invariants:
               error, and the budget table still derives from
               CLUSTER_STAGE_BUDGETS_MS (three-way drift check vs
               tpufd.agg.SLO_STAGE_BUDGETS_MS).
+  --explain   (ISSUE 18) the placement-explainability section of a
+              cluster-soak record: every post-convergence-window
+              rejection of a ground-truth-bad node carries a reason
+              from its injected failure's class (degrade -> perf/
+              class, preempt -> lifecycle, wedge/partition ->
+              slice-member), each placed job's per-reason queue-wait
+              histogram sums EXACTLY (integer µs) to its measured
+              wait, the decision audit ring saw every decision and
+              closed evicted entries, the taxonomy stays closed, and
+              the record is deterministic.
 
 Every mode fails LOUDLY on records missing expected keys/phases — a
 partially-run or older-format soak record must not sail through its
@@ -82,6 +92,7 @@ Usage:
   python3 scripts/bench_gate.py --aggregate aggregate-soak.json
   python3 scripts/bench_gate.py --cluster cluster-soak.json
   python3 scripts/bench_gate.py --slo cluster-soak.json
+  python3 scripts/bench_gate.py --explain cluster-soak.json
   python3 scripts/bench_gate.py --shard BENCH_shard.json
 """
 
@@ -820,6 +831,116 @@ def slo_gate(record_path):
     return problems
 
 
+def explain_gate(record_path):
+    """Gates the placement-explainability section of a cluster-soak
+    record (scripts/cluster_soak.py --json, "explain" key — ISSUE 18):
+
+      - attribution fidelity: every post-convergence-window rejection
+        of a ground-truth-bad node carried a reason from its injected
+        failure's class (degrade -> perf/class, preempt -> lifecycle,
+        wedge/partition -> slice-member), with non-vacuous coverage;
+      - queue-wait accounting: each placed job's per-reason wait
+        histogram sums EXACTLY (integer µs on the virtual clock) to
+        its measured queue wait, and so do the aggregates;
+      - the decision audit ring saw every decision and closed evicted
+        entries;
+      - every rejection reason stays inside the closed taxonomy;
+      - the record is deterministic (byte-identical double run).
+
+    Absent keys FAIL loudly."""
+    problems = []
+    record = load_record(record_path, "explain", problems)
+    if record is None:
+        return problems
+    explain = require(record, "explain", "explain", problems)
+    if explain is None:
+        return problems
+
+    from tpufd import placement as placementlib
+
+    explained = require(explain, "explained_queries", "explain",
+                        problems)
+    if explained is not None and explained == 0:
+        problems.append("no placement decision was ever explained "
+                        "(vacuous run)")
+
+    fidelity = require(explain, "fidelity", "explain", problems)
+    if fidelity is not None:
+        checked = fidelity.get("checked", 0)
+        if checked == 0:
+            problems.append(
+                "the fidelity scorer never checked a post-window "
+                "rejection of a failed node — the soak proved nothing "
+                "about attribution")
+        if fidelity.get("mismatched", 0) != 0:
+            problems.append(
+                f"{fidelity['mismatched']} of {checked} post-window "
+                f"rejection(s) carried a reason outside the injected "
+                f"failure's class (e.g. "
+                f"{fidelity.get('mismatch_examples', [])[:3]}) — "
+                "explanations misattribute")
+        by_op = fidelity.get("by_op", {})
+        for op in sorted(by_op):
+            if by_op[op].get("mismatched", 0) != 0:
+                problems.append(
+                    f"fidelity mismatches under op {op}: "
+                    f"{by_op[op]['mismatched']} of "
+                    f"{by_op[op].get('checked')}")
+
+    attribution = require(explain, "attribution", "explain", problems)
+    if attribution is not None:
+        if attribution.get("jobs", 0) == 0:
+            problems.append("no job's queue wait was ever attributed "
+                            "(vacuous run)")
+        if attribution.get("sum_mismatches", 0) != 0:
+            problems.append(
+                f"{attribution['sum_mismatches']} job(s) whose "
+                "per-reason wait histogram does not sum exactly to "
+                "the measured wait")
+        total = attribution.get("wait_usec_total")
+        by_reason = attribution.get("by_reason_usec")
+        if total is None or by_reason is None:
+            problems.append("attribution record lacks the integer-µs "
+                            "totals (wait_usec_total/by_reason_usec)")
+        elif total != sum(by_reason.values()):
+            problems.append(
+                f"aggregate reason histogram sums to "
+                f"{sum(by_reason.values())}µs but the measured wait is "
+                f"{total}µs — attribution leaked")
+
+    rejections = require(explain, "rejections_total", "explain",
+                         problems)
+    if rejections is not None:
+        unknown = [r for r in sorted(rejections)
+                   if r not in placementlib.REJECTION_REASONS]
+        if unknown:
+            problems.append(
+                f"rejection reasons outside the closed taxonomy: "
+                f"{unknown}")
+        if not rejections:
+            problems.append("no rejection was ever counted "
+                            "(vacuous run)")
+
+    ring = require(explain, "ring", "explain", problems)
+    if ring is not None:
+        if ring.get("appended", 0) == 0:
+            problems.append("the decision audit ring never saw a "
+                            "decision")
+        if ring.get("evictions", 0) == 0:
+            problems.append(
+                "no evicted decision ever closed into the ring — the "
+                "eviction join (decision -> change-id) is untested")
+        if ring.get("capacity", 0) <= 0:
+            problems.append("audit ring capacity must be positive")
+
+    if record.get("determinism_ok") is not True:
+        problems.append(
+            "determinism pin absent or failed: two runs of one seed "
+            "must produce byte-identical metrics (including the "
+            "explain section)")
+    return problems
+
+
 def shard_gate(record_path, reference_path, slack,
                staleness_budget_s, qps_floor):
     """Gates a sharded-tree + placement soak record
@@ -1007,6 +1128,13 @@ def main(argv=None):
                          "regression, burn labels actually published, "
                          "fleet-vs-harness sketch quantiles within the "
                          "gamma-1.1 error, budget tables un-drifted")
+    ap.add_argument("--explain", metavar="RECORD.json",
+                    help="gate the placement-explainability section of "
+                         "a cluster-soak record: attribution fidelity "
+                         "(post-window rejection reasons match the "
+                         "injected failure class), exact queue-wait "
+                         "reason accounting, audit-ring coverage, "
+                         "closed taxonomy")
     ap.add_argument("--plugin", metavar="RECORD.json",
                     help="gate this probe-plugin containment soak record "
                          "(scripts/plugin_soak.py --json)")
@@ -1059,6 +1187,9 @@ def main(argv=None):
 
     if args.slo:
         return run_mode("slo", slo_gate(args.slo))
+
+    if args.explain:
+        return run_mode("explain", explain_gate(args.explain))
 
     if args.shard:
         return run_mode("shard", shard_gate(

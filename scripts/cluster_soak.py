@@ -125,6 +125,31 @@ SLO_SLOW_WINDOW_S = 20.0
 SLO_BURN_TICK_S = 0.5   # the runner's flush-loop evaluation cadence
 SLO_NODE_TICK_S = 1.0   # each daemon's retire-oldest sweep
 
+# Placement explainability (ISSUE 18): which rejection-taxonomy reasons
+# each injected failure class may legitimately produce on its victim
+# AFTER the convergence window. degrade demotes the published class
+# (degraded outright, or below a job's floor); preempt/preempt-clear
+# ride the lifecycle labels; wedge/partition victims cannot publish
+# their own demotion, so the only label evidence is a peer's
+# degraded-slice verdict. A post-window rejection of a ground-truth-bad
+# node carrying a reason OUTSIDE its failure's class is an attribution
+# fidelity miss — bench_gate --explain requires zero.
+EXPLAIN_REASON_CLASSES = {
+    "degrade": {"perf-degraded", "class-floor", "slice-member-degraded"},
+    "preempt": {"lifecycle-preempt", "lifecycle-draining"},
+    "wedge": {"slice-member-degraded"},
+    "partition": {"slice-member-degraded"},
+}
+
+
+def usec(t):
+    """Virtual seconds -> integer microseconds. Queue-wait attribution
+    quantizes TIMESTAMPS (not intervals) so per-interval attributions
+    telescope exactly: sum(q(t[i+1]) - q(t[i])) == q(t[n]) - q(t[0])
+    over integers — the reason histogram sums to the measured wait
+    EXACTLY, not within epsilon."""
+    return int(round(t * 1e6))
+
 
 # ---- the apiserver, as the cluster sees it --------------------------------
 
@@ -773,6 +798,19 @@ class Harness:
         # the checkpoint snapshot taken after the regression drill.
         self.slo_folds = []        # (t, slo stage, ms)
         self.slo_checkpoint = None
+        # Placement explainability (ISSUE 18): queue-wait attribution
+        # (every queued microsecond lands in exactly one reason bucket)
+        # and the reason-class fidelity scorer.
+        self.active_fail_ops = {}  # node -> set of live injected ops
+        self.enqueue_us = {}       # job_id -> µs of last (re)enqueue
+        self.wait_mark_us = {}     # job_id -> µs of last attribution
+        self.span_attr_us = {}     # job_id -> {reason: µs} (open span)
+        self.job_wait_us = {}      # job_id -> measured wait µs (closed)
+        self.job_attr_us = {}      # job_id -> {reason: µs} (closed)
+        self.explain_checked = 0
+        self.explain_mismatched = 0
+        self.explain_by_op = {}    # op -> {"checked","mismatched"}
+        self.explain_mismatches = []  # examples, <= 5
 
     # ---- label-side hooks (wired as watch delivery) -----------------------
 
@@ -835,8 +873,8 @@ class Harness:
                 self.land_after_heal[node] = t0
         # Label-driven eviction (preempt drain, slice demotion): jobs on
         # now-unplaceable nodes re-queue.
-        for job_id in self.sched.drain_ineligible():
-            self._requeue(job_id)
+        for job_id in self.sched.drain_ineligible(now):
+            self._requeue(job_id, now)
         self._schedule_drain(now)
 
     # ---- the job stream ---------------------------------------------------
@@ -858,15 +896,17 @@ class Harness:
         self.jobs[job_id] = job
         self.jobs_submitted += 1
         self.queue.append(job)
+        self.enqueue_us[job_id] = self.wait_mark_us[job_id] = usec(now)
         self._schedule_drain(now)
 
-    def _requeue(self, job_id):
+    def _requeue(self, job_id, now):
         job = self.jobs.get(job_id)
         if job is None:
             return
         self.attempt[job_id] = self.attempt.get(job_id, 0) + 1
         self.jobs_requeued += 1
         self.queue.append(job)
+        self.enqueue_us[job_id] = self.wait_mark_us[job_id] = usec(now)
 
     def _schedule_drain(self, now):
         if self.drain_scheduled or not self.queue:
@@ -878,19 +918,117 @@ class Harness:
         self.drain_scheduled = False
         while self.queue:
             job = self.queue[0]
-            decision = self.sched.place(job, now)
+            decision = self.sched.place(job, now, explain=True)
             if not decision.placed:
-                # Head-of-line: retry the whole queue on the next
-                # placement-relevant event or the periodic tick.
+                # Head-of-line: every queued job's wait since its last
+                # attribution mark is charged to the reason blocking
+                # the head (the counterfactual's reason), and each
+                # post-window rejection of a ground-truth-bad node is
+                # fidelity-scored against its failure class. Then
+                # retry the whole queue on the next placement-relevant
+                # event or the periodic tick.
+                self._attribute_wait(now, decision)
+                self._score_rejections(now, job, decision.explain)
                 self.clock.schedule(now + 0.5,
                                     lambda t: self._schedule_drain(t))
                 return
             self.queue.pop(0)
+            self._close_wait(now, job.job_id)
             self._score_placement(now, job, decision.node)
             gen = self.attempt.get(job.job_id, 0)
             self.clock.schedule(
                 now + job.duration_s,
                 lambda t, j=job.job_id, g=gen: self._complete(t, j, g))
+
+    # ---- queue-wait attribution + fidelity (ISSUE 18) ---------------------
+
+    def _attribute_wait(self, now, decision):
+        reason = decision.explain["blocking"] or decision.reason
+        q_now = usec(now)
+        for queued in self.queue:
+            job_id = queued.job_id
+            du = q_now - self.wait_mark_us.get(job_id, q_now)
+            if du > 0:
+                span = self.span_attr_us.setdefault(job_id, {})
+                span[reason] = span.get(reason, 0) + du
+            self.wait_mark_us[job_id] = q_now
+
+    def _close_wait(self, now, job_id):
+        """The job placed: the residual since the last attribution mark
+        is dispatch latency (queue position + drain cadence, no
+        rejection to blame), and the span's histogram folds into the
+        job's closed totals. Timestamp quantization (usec) makes
+        sum(job_attr_us) == job_wait_us EXACT by telescoping."""
+        q_now = usec(now)
+        mark = self.wait_mark_us.pop(job_id, q_now)
+        span = self.span_attr_us.pop(job_id, {})
+        if q_now - mark > 0:
+            span["dispatch"] = span.get("dispatch", 0) + (q_now - mark)
+        start = self.enqueue_us.pop(job_id, q_now)
+        self.job_wait_us[job_id] = \
+            self.job_wait_us.get(job_id, 0) + (q_now - start)
+        attr = self.job_attr_us.setdefault(job_id, {})
+        for reason in sorted(span):
+            attr[reason] = attr.get(reason, 0) + span[reason]
+
+    def _score_rejections(self, now, job, explanation):
+        """Attribution fidelity: a post-convergence-window rejection of
+        a node whose ground truth an injected failure holds bad must
+        carry a reason from that failure's class
+        (EXPLAIN_REASON_CLASSES). Rejections the failure cannot have
+        caused are out of scope: insufficient-chips is allocation
+        (failures never shrink published capacity), capacity-admission
+        is query-wide, and class-floor only counts when the node's
+        HEALTHY class would have cleared the job's floor (a silver host
+        rejected for a gold job was never this failure's doing)."""
+        for rejection in explanation["rejections"]:
+            node = rejection["node"]
+            ops = self.active_fail_ops.get(node)
+            if not ops:
+                continue
+            if now <= self.excused_until.get(node, -1.0):
+                continue  # still inside the convergence window
+            reason = rejection["reason"]
+            if reason in ("insufficient-chips", "capacity-admission"):
+                continue
+            host = self.hosts.get(node)
+            if reason == "class-floor" and host is not None and \
+                    clusterlib.CLASS_RANK.get(host.base_class, 0) < \
+                    job.min_rank:
+                continue
+            expected = set()
+            for op in ops:
+                expected |= EXPLAIN_REASON_CLASSES.get(op, set())
+            if not expected:
+                continue
+            if reason == "slice-member-degraded" and \
+                    reason not in expected and host is not None:
+                # The pinned precedence puts slice verdicts above
+                # lifecycle: a preempted node whose slice a DIFFERENT
+                # member's failure degraded legitimately explains as
+                # slice-member-degraded. Accept when the slice is
+                # ground-truth degraded (any member bad, or a member's
+                # heal not yet converged so its claim is legitimately
+                # stale).
+                members = host.slice.members
+                if any(m.gt_bad() for m in members) or \
+                        any(m.name in self.up_track for m in members):
+                    continue
+            self.explain_checked += 1
+            ok = reason in expected
+            if not ok:
+                self.explain_mismatched += 1
+                if len(self.explain_mismatches) < 5:
+                    self.explain_mismatches.append({
+                        "t": round(now, 3), "job": job.job_id,
+                        "node": node, "reason": reason,
+                        "ops": sorted(ops)})
+            for op in sorted(ops):
+                bucket = self.explain_by_op.setdefault(
+                    op, {"checked": 0, "mismatched": 0})
+                bucket["checked"] += 1
+                if not ok:
+                    bucket["mismatched"] += 1
 
     def _score_placement(self, now, job, node):
         host = self.hosts[node]
@@ -928,7 +1066,7 @@ class Harness:
                 if self.sched.node_of(job_id) == node:
                     self.sched.release(job_id)
                     self.jobs_failed_bad_hw += 1
-                    self._requeue(job_id)
+                    self._requeue(job_id, t)
             self._schedule_drain(t)
         self.clock.schedule(now + JOB_FAIL_DETECT_S, fail)
 
@@ -948,6 +1086,7 @@ class Harness:
                         BROWNOUT_GRACE_S)
         self.excused_until[node] = until
         self.down_track[node] = (now, op)
+        self.active_fail_ops.setdefault(node, set()).add(op)
         self.changes.mint(op, node, now)
         # A refail before the previous heal's recovery converged cancels
         # that heal's tracking: the node is down again, so neither its
@@ -960,6 +1099,11 @@ class Harness:
 
     def note_up(self, now, node, op):
         self.excused_until.pop(node, None)
+        ops = self.active_fail_ops.get(node)
+        if ops is not None:
+            ops.discard(op)
+            if not ops:
+                self.active_fail_ops.pop(node, None)
         if self.down_track.pop(node, None) is not None:
             # Heal raced the label pipeline: the failure never reached
             # the scheduler, so its causal chain can never close.
@@ -1172,6 +1316,31 @@ def run_sim(args, schedule_text):
     for ev in events:
         failures_by_op[ev.op] = failures_by_op.get(ev.op, 0) + 1
 
+    # Queue-wait attribution rollup: per placed job, the reason
+    # histogram must sum to the measured wait EXACTLY (integer µs,
+    # timestamp-quantized — see usec()).
+    wait_total_us = 0
+    wait_by_reason_us = {}
+    wait_sum_mismatches = 0
+    for job_id in sorted(harness.job_wait_us):
+        attr = harness.job_attr_us.get(job_id, {})
+        if sum(attr.values()) != harness.job_wait_us[job_id]:
+            wait_sum_mismatches += 1
+        wait_total_us += harness.job_wait_us[job_id]
+        for reason in attr:
+            wait_by_reason_us[reason] = \
+                wait_by_reason_us.get(reason, 0) + attr[reason]
+    wait_attribution = {
+        "jobs": len(harness.job_wait_us),
+        # Integer µs: wait_usec_total == sum(by_reason_usec.values())
+        # exactly — bench_gate --explain re-adds the committed values.
+        "wait_usec_total": wait_total_us,
+        "by_reason_usec": {r: wait_by_reason_us[r]
+                           for r in sorted(wait_by_reason_us)},
+        "wait_seconds_total": round(wait_total_us / 1e6, 6),
+        "sum_mismatches": wait_sum_mismatches,
+    }
+
     record = {
         "mode": "cluster",
         "seed": args.seed,
@@ -1268,6 +1437,30 @@ def run_sim(args, schedule_text):
             "burn_label_flushes": aggregator.burn_label_flushes,
             "checkpoint": harness.slo_checkpoint,
         },
+        # Placement explainability (ISSUE 18): the rejection-taxonomy
+        # rollup, the decision audit ring's counters, the exact
+        # queue-wait reason attribution, and the fidelity score
+        # bench_gate --explain gates.
+        "explain": {
+            "explained_queries": sched.explained_total,
+            "rejections_total": {
+                r: sched.rejections_total[r]
+                for r in sorted(sched.rejections_total)},
+            "ring": {
+                "capacity": sched.ring_capacity,
+                "appended": sched.ring_seq,
+                "dropped": sched.ring_dropped,
+                "evictions": sched.evicted_total,
+            },
+            "attribution": wait_attribution,
+            "fidelity": {
+                "checked": harness.explain_checked,
+                "mismatched": harness.explain_mismatched,
+                "by_op": {op: dict(harness.explain_by_op[op])
+                          for op in sorted(harness.explain_by_op)},
+                "mismatch_examples": harness.explain_mismatches,
+            },
+        },
     }
     return record
 
@@ -1339,6 +1532,43 @@ def check_record(record):
                 f"but the e2e mean is {sb['mean_e2e_ms']}ms — the "
                 "stages do not partition the end-to-end latency")
     problems.extend(check_slo(record["slo"]))
+    problems.extend(check_explain(record["explain"]))
+    return problems
+
+
+def check_explain(explain):
+    """The explainability invariants a fresh soak run enforces on
+    itself (bench_gate --explain re-checks the committed record and
+    additionally requires fidelity coverage, which a --quick run may
+    legitimately lack)."""
+    problems = []
+    if explain["explained_queries"] == 0:
+        problems.append("no placement decision was ever explained — "
+                        "the explain contract never ran")
+    attribution = explain["attribution"]
+    if attribution["sum_mismatches"] != 0:
+        problems.append(
+            f"{attribution['sum_mismatches']} job(s) whose queue-wait "
+            "reason histogram does not sum exactly to the measured "
+            "wait — an interval was dropped or double-attributed")
+    if attribution["wait_usec_total"] != \
+            sum(attribution["by_reason_usec"].values()):
+        problems.append(
+            "the aggregate reason histogram does not sum to the "
+            "aggregate measured wait — attribution leaked")
+    fidelity = explain["fidelity"]
+    if fidelity["mismatched"] != 0:
+        problems.append(
+            f"{fidelity['mismatched']} post-window rejection(s) of a "
+            f"ground-truth-bad node carried a reason outside its "
+            f"failure's class (e.g. "
+            f"{fidelity['mismatch_examples'][:3]}) — the explanations "
+            "misattribute")
+    unknown = [r for r in explain["rejections_total"]
+               if r not in clusterlib.REJECTION_REASONS]
+    if unknown:
+        problems.append(f"rejection reasons outside the closed "
+                        f"taxonomy: {unknown}")
     return problems
 
 

@@ -188,6 +188,12 @@ CONDITIONAL = {
     "tfd_placement_eligible_nodes",
     "tfd_placement_blocked_slices",
     "tfd_placement_query_seconds",
+    # Placement decision explainability (ISSUE 18): rejections need an
+    # "explain": true query, decisions/dropped need closed decisions
+    # reaching the audit ring — all --mode=placement only.
+    "tfd_placement_rejections_total",
+    "tfd_placement_decisions_total",
+    "tfd_placement_audit_dropped_total",
 }
 
 

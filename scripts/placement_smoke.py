@@ -26,9 +26,20 @@ numbers live in scripts/cluster_soak.py --placement-qps (virtual clock,
 twin stores); THIS proves the real binary speaks the same contract on a
 real socket.
 
+With --explain (ISSUE 18) it runs the explainability drill instead: a
+small crafted fleet hitting every rejection-taxonomy reason, seeded
+WITH tfd.google.com/change-id annotations, and asserts that explained
+answers match the tpufd.placement twin exactly (reasons, blocking
+member, pinned counterfactual strings, change-id joins), that the
+explained battery still lands ZERO apiserver reads, that a non-explain
+answer's bytes are a byte-prefix of the explained one (the explain
+section strictly appends — pay-for-what-you-use), and that
+GET /v1/decisions replays the audit ring (job/node filters, n bound,
+an `evicted` entry carrying the change-id after a node CR deletion).
+
 Usage:
   python3 scripts/placement_smoke.py [--binary build/tpu-feature-discovery]
-      [--nodes 600] [--churn 400] [--seed 17]
+      [--nodes 600] [--churn 400] [--seed 17] [--explain]
 """
 
 import argparse
@@ -92,15 +103,20 @@ def wait_for(cond, timeout=30.0):
     return cond()
 
 
-def post_placement(port, doc):
+def post_placement_raw(port, doc):
     conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
     try:
         conn.request("POST", "/v1/placements", body=json.dumps(doc),
                      headers={"Content-Type": "application/json"})
         resp = conn.getresponse()
-        return resp.status, json.loads(resp.read().decode())
+        return resp.status, resp.read().decode()
     finally:
         conn.close()
+
+
+def post_placement(port, doc):
+    status, body = post_placement_raw(port, doc)
+    return status, json.loads(body)
 
 
 def node_labels(rng, i):
@@ -156,6 +172,229 @@ def probe_battery(port, twin, problems, tag):
                 f"service {got} vs twin {want}")
 
 
+CHANGE_ANNOTATION = "tfd.google.com/change-id"
+
+# The crafted explain fleet: one node per taxonomy gate, changes
+# stamped as annotations so the service's joins are checkable.
+EXPLAIN_FLEET = {
+    # The winner for gold/8 (placed; evicted later by CR deletion).
+    "xa-gold-big": {agglib.PERF_CLASS: "gold", agglib.TPU_COUNT: "16",
+                    agglib.SLICE_ID: "xs-1",
+                    agglib.SLICE_DEGRADED: "false"},
+    # insufficient-chips for chips=8 (and the best rejected node for
+    # the unplaceable chips=64 counterfactual).
+    "xb-gold-small": {agglib.PERF_CLASS: "gold", agglib.TPU_COUNT: "4"},
+    # perf-degraded via the node's own verdict label.
+    "xc-degraded": {agglib.PERF_CLASS: "degraded",
+                    agglib.TPU_COUNT: "8"},
+    # class-floor for gold queries.
+    "xd-silver": {agglib.PERF_CLASS: "silver", agglib.TPU_COUNT: "8"},
+    # lifecycle gates.
+    "xe-preempt": {agglib.PERF_CLASS: "gold", agglib.TPU_COUNT: "8",
+                   agglib.LIFECYCLE_PREEMPT: "true"},
+    "xf-drain": {agglib.PERF_CLASS: "gold", agglib.TPU_COUNT: "8",
+                 agglib.LIFECYCLE_DRAINING: "true"},
+    # Worst-of-members: xg-m0's own claim blocks itself (member =
+    # self) AND its healthy peer xg-m1 (member = xg-m0, change =
+    # xg-m0's write).
+    "xg-m0": {agglib.PERF_CLASS: "gold", agglib.TPU_COUNT: "8",
+              agglib.SLICE_ID: "xs-2", agglib.SLICE_DEGRADED: "true"},
+    "xg-m1": {agglib.PERF_CLASS: "gold", agglib.TPU_COUNT: "8",
+              agglib.SLICE_ID: "xs-2", agglib.SLICE_DEGRADED: "false"},
+}
+
+EXPLAIN_PROBES = [
+    {"class": "gold", "chips": 8, "job": "ej-placed"},
+    {"class": "gold", "chips": 64, "job": "ej-unplaceable"},
+    {"class": "any", "chips": 4, "slice": True, "job": "ej-slice"},
+    {"class": "silver", "chips": 4, "limit": 8, "job": "ej-floor"},
+]
+
+
+def explain_drill(args):
+    """The ISSUE 18 smoke: explained answers twin-exact with change-id
+    joins, zero reads, byte-prefix pay-for-what-you-use, and the
+    /v1/decisions audit ring incl. the eviction join."""
+    problems = []
+    with FakeApiServer() as server:
+        twin = placementlib.PlacementIndex()
+        for node, labels in EXPLAIN_FLEET.items():
+            change = f"ch-{node}-1"
+            server.seed(NS, f"tfd-features-for-{node}", labels,
+                        {NODE_NAME_LABEL: node},
+                        annotations={CHANGE_ANNOTATION: change})
+            twin.apply_node(node, labels, change=change)
+
+        qport, oport = free_port(), free_port()
+        proc = subprocess.Popen(
+            [args.binary, "--mode=placement",
+             f"--placement-listen-addr=127.0.0.1:{qport}",
+             f"--introspection-addr=127.0.0.1:{oport}",
+             "--placement-audit-capacity=64"],
+            env={**os.environ, "TFD_APISERVER_URL": server.url,
+                 "KUBERNETES_NAMESPACE": NS,
+                 "POD_NAME": "placement-smoke-0",
+                 "GCE_METADATA_HOST": "127.0.0.1:1"},
+            stderr=subprocess.DEVNULL)
+        try:
+            if not wait_for(
+                    lambda: http_get(qport, "/readyz")[0] == 200):
+                print("explain smoke FAILED: /readyz never went 200",
+                      file=sys.stderr)
+                return 1
+
+            # Pay-for-what-you-use, byte for byte: the non-explain
+            # answer must be a strict prefix of the explained one
+            # (modulo the closing brace) — the explain section only
+            # ever APPENDS to the same document.
+            plain_doc = {"class": "gold", "chips": 8}
+            _, plain = post_placement_raw(qport, plain_doc)
+            _, plain_false = post_placement_raw(
+                qport, {**plain_doc, "explain": False})
+            _, explained = post_placement_raw(
+                qport, {**plain_doc, "explain": True})
+            if plain != plain_false:
+                problems.append(
+                    "explain:false changed the answer bytes vs the "
+                    "key being absent")
+            if "explain" in plain:
+                problems.append(
+                    "non-explain answer leaked an explain section")
+            stem = plain.rstrip("\n").rstrip("}")
+            if not explained.startswith(stem + ',"explain":'):
+                problems.append(
+                    "explained answer is not the non-explain bytes "
+                    "plus an appended explain section: "
+                    f"{plain!r} vs {explained!r}")
+
+            # The explained battery: twin-exact, closed taxonomy,
+            # zero apiserver reads.
+            reads_before = len(server.requests)
+            for probe in EXPLAIN_PROBES:
+                want = twin.query(wanted=probe["class"],
+                                  chips=probe.get("chips", 1),
+                                  slice=probe.get("slice", False),
+                                  limit=probe.get("limit", 1),
+                                  explain=True)
+                status, got = post_placement(
+                    qport, {**probe, "explain": True})
+                if status != 200:
+                    problems.append(
+                        f"explain probe {probe} -> HTTP {status}")
+                    continue
+                if got != want:
+                    problems.append(
+                        f"explain probe {probe} diverged from the "
+                        f"twin: service {got} vs twin {want}")
+                    continue
+                bad = set(got["explain"]["reasons"]) - \
+                    set(placementlib.REJECTION_REASONS)
+                if bad:
+                    problems.append(
+                        f"explain probe {probe} used reasons outside "
+                        f"the closed taxonomy: {sorted(bad)}")
+            if len(server.requests) != reads_before:
+                problems.append(
+                    f"{len(server.requests) - reads_before} apiserver "
+                    "request(s) landed DURING the explained battery — "
+                    "explanations must come from the in-memory index")
+
+            # Spot-check the pinned joins the twin equality implies:
+            # the unplaceable counterfactual names the best node and
+            # the change-id of the blocking write.
+            _, unplaceable = post_placement(
+                qport, {"class": "gold", "chips": 64, "explain": True,
+                        "job": "ej-counterfactual"})
+            cf = unplaceable["explain"]["counterfactual"]
+            if not cf.startswith("insufficient-chips: needs 48 more "
+                                 "free chip(s); best node xa-gold-big "
+                                 "has 16 free"):
+                problems.append(f"pinned counterfactual diverged: {cf!r}")
+            if "(change ch-xa-gold-big-1)" not in cf:
+                problems.append(
+                    f"counterfactual lost the change-id join: {cf!r}")
+            by_node = {r["node"]: r
+                       for r in unplaceable["explain"]["rejections"]}
+            peer = by_node.get("xg-m1", {})
+            if peer.get("member") != "xg-m0" or \
+                    peer.get("change") != "ch-xg-m0-1":
+                problems.append(
+                    "slice rejection lost the blocking-member / "
+                    f"change join: {peer}")
+
+            # The audit ring: every POST above closed a decision.
+            status, body = http_get(qport, "/v1/decisions")
+            ring = json.loads(body)
+            if ring["capacity"] != 64:
+                problems.append(
+                    "--placement-audit-capacity=64 did not size the "
+                    f"ring: {ring['capacity']}")
+            if ring["appended"] != len(ring["decisions"]) or \
+                    ring["appended"] < len(EXPLAIN_PROBES) + 4:
+                problems.append(
+                    f"ring did not close every decision: {ring}")
+            _, body = http_get(qport, "/v1/decisions?job=ej-floor")
+            only = json.loads(body)["decisions"]
+            if len(only) != 1 or only[0]["job"] != "ej-floor":
+                problems.append(f"?job= filter broke: {only}")
+            _, body = http_get(qport, "/v1/decisions?n=1")
+            tail = json.loads(body)["decisions"]
+            if len(tail) != 1 or \
+                    tail[0]["seq"] != ring["appended"] - 1:
+                problems.append(f"?n=1 did not render the tail: {tail}")
+
+            # Eviction join: deleting the placed node's CR closes the
+            # placements naming it, carrying the retained change-id.
+            server.delete(NS, "tfd-features-for-xa-gold-big")
+            twin.remove_node("xa-gold-big")
+
+            def evicted():
+                _, body = http_get(
+                    qport, "/v1/decisions?node=xa-gold-big")
+                return any(d["outcome"] == "evicted"
+                           for d in json.loads(body)["decisions"])
+
+            if not wait_for(evicted):
+                problems.append(
+                    "no evicted audit entry after the node CR delete")
+            else:
+                _, body = http_get(
+                    qport, "/v1/decisions?node=xa-gold-big")
+                ev = [d for d in json.loads(body)["decisions"]
+                      if d["outcome"] == "evicted"][-1]
+                if ev["reason"] != "deleted" or \
+                        "ej-placed" not in ev["jobs"] or \
+                        ev["change_ids"] != ["ch-xa-gold-big-1"]:
+                    problems.append(
+                        f"evicted entry lost its joins: {ev}")
+                _, metrics = http_get(oport, "/metrics")
+                if metricslib.sample_value(
+                        metrics, "tfd_placement_decisions_total",
+                        {"outcome": "evicted"}) != 1.0:
+                    problems.append(
+                        "tfd_placement_decisions_total{outcome="
+                        "\"evicted\"} did not count the eviction")
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=5)
+
+    print(json.dumps({"explain_probes": len(EXPLAIN_PROBES) + 4,
+                      "problems": problems}))
+    if problems:
+        for p in problems:
+            print(f"explain smoke FAILED: {p}", file=sys.stderr)
+        return 1
+    print("explain smoke OK: explained answers twin-exact with "
+          "change-id joins, zero reads, non-explain bytes a strict "
+          "prefix, audit ring served with filters and the eviction "
+          "join")
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--binary", default="build/tpu-feature-discovery")
@@ -164,7 +403,12 @@ def main(argv=None):
                     help="label mutations to stream (sized past the "
                          "fake apiserver's DEFAULT 64-event window)")
     ap.add_argument("--seed", type=int, default=17)
+    ap.add_argument("--explain", action="store_true",
+                    help="run the ISSUE 18 explainability drill "
+                         "instead of the churn smoke")
     args = ap.parse_args(argv)
+    if args.explain:
+        return explain_drill(args)
 
     rng = random.Random(args.seed)
     problems = []

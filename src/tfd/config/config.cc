@@ -789,6 +789,25 @@ std::vector<FlagDef> MakeFlagDefs(Flags* f) {
                   [f](const std::string& v) {
                     return SetString(&f->placement_listen_addr, v);
                   }});
+  defs.push_back({"placement-audit-capacity",
+                  {"TFD_PLACEMENT_AUDIT_CAPACITY"},
+                  "placementAuditCapacity",
+                  "placement decision audit ring capacity: closed "
+                  "decisions (placed + rejected + evicted) retained "
+                  "drop-oldest for GET /v1/decisions and the SIGUSR1 "
+                  "dump (--mode=placement only)",
+                  false,
+                  [f](const std::string& v) {
+                    int parsed = 0;
+                    if (!ParseNonNegInt(TrimSpace(v), &parsed) ||
+                        parsed < 1) {
+                      return Status::Error(
+                          "placement-audit-capacity must be a positive "
+                          "integer");
+                    }
+                    f->placement_audit_capacity = parsed;
+                    return Status::Ok();
+                  }});
   defs.push_back({"perf-fleet-floor-source",
                   {"TFD_PERF_FLEET_FLOOR_SOURCE"},
                   "perfFleetFloorSource",
@@ -1363,6 +1382,7 @@ std::string ToJson(const Config& config) {
       << ",\"aggShard\":" << jstr(f.agg_shard)
       << ",\"aggMergeShards\":" << f.agg_merge_shards
       << ",\"placementListenAddr\":" << jstr(f.placement_listen_addr)
+      << ",\"placementAuditCapacity\":" << f.placement_audit_capacity
       << ",\"perfFleetFloorSource\":" << jstr(f.perf_fleet_floor_source)
       << ",\"lifecycleWatch\":" << (f.lifecycle_watch ? "true" : "false")
       << ",\"faultSpec\":" << jstr(f.fault_spec)

@@ -353,6 +353,10 @@ struct Flags {
   // Placement query service (--mode=placement, placement/): the
   // host:port the HTTP endpoint (POST /v1/placements) listens on.
   std::string placement_listen_addr = "0.0.0.0:8780";
+  // Placement decision audit ring (placement/ DecisionRing): how many
+  // closed decisions (placed + rejected + evicted) the drop-oldest
+  // ring retains for GET /v1/decisions and the SIGUSR1 dump.
+  int placement_audit_capacity = 256;
   // Fleet-relative perf floor input (perf/, ROADMAP #4a): a JSON file
   // carrying the aggregator-published fleet floors
   // ({"matmul_p10_tflops": N, "hbm_p10_gbps": N}); when set, a node

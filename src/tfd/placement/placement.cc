@@ -25,6 +25,8 @@
 #include "tfd/obs/metrics.h"
 #include "tfd/obs/server.h"
 #include "tfd/obs/slo.h"
+#include "tfd/obs/trace.h"
+#include "tfd/util/file.h"
 #include "tfd/util/http.h"
 #include "tfd/util/jsonlite.h"
 #include "tfd/util/logging.h"
@@ -107,6 +109,45 @@ obs::Counter* IngestCounter(const char* type) {
       {{"type", type}});
 }
 
+obs::Counter* RejectionCounter(const std::string& reason) {
+  return obs::Default().GetCounter(
+      "tfd_placement_rejections_total",
+      "Nodes rejected by explained placement queries, by the FIRST "
+      "gating reason from the closed taxonomy (class-floor / "
+      "perf-degraded / lifecycle-preempt / lifecycle-draining / "
+      "slice-member-degraded / insufficient-chips / "
+      "capacity-admission). Counted only when the query asked "
+      "\"explain\": true — the fast path never pays the walk.",
+      {{"reason", reason}});
+}
+
+obs::Counter* DecisionCounter(const std::string& outcome) {
+  return obs::Default().GetCounter(
+      "tfd_placement_decisions_total",
+      "Closed decisions appended to the placement audit ring, by "
+      "outcome (placed / rejected / evicted).",
+      {{"outcome", outcome}});
+}
+
+obs::Counter* AuditDroppedCounter() {
+  return obs::Default().GetCounter(
+      "tfd_placement_audit_dropped_total",
+      "Audit-ring entries discarded by the drop-oldest bound "
+      "(--placement-audit-capacity).");
+}
+
+// The closed rejection taxonomy, in pinned precedence order.
+constexpr const char* kRejectionReasons[] = {
+    "perf-degraded",      "slice-member-degraded", "lifecycle-preempt",
+    "lifecycle-draining", "class-floor",           "insufficient-chips",
+    "capacity-admission"};
+
+double WallSeconds() {
+  return std::chrono::duration<double>(
+             std::chrono::system_clock::now().time_since_epoch())
+      .count();
+}
+
 void SetIndexGauges(const PlacementIndex& index) {
   obs::Default()
       .GetGauge("tfd_placement_nodes",
@@ -160,6 +201,18 @@ bool SliceDegradedClaim(const lm::Labels& labels) {
          Get(labels, lm::kSliceClass) == "degraded";
 }
 
+std::string BasicReason(const lm::Labels& labels) {
+  if (Get(labels, lm::kPerfClass) == "degraded") return "perf-degraded";
+  if (SliceDegradedClaim(labels)) return "slice-member-degraded";
+  if (Get(labels, lm::kLifecyclePreemptImminent) == "true") {
+    return "lifecycle-preempt";
+  }
+  if (Get(labels, lm::kLifecycleDraining) == "true") {
+    return "lifecycle-draining";
+  }
+  return "";
+}
+
 // ---- the index -----------------------------------------------------------
 
 void PlacementIndex::Insert(const std::string& node, const Entry& entry) {
@@ -189,7 +242,8 @@ void PlacementIndex::Erase(const std::string& node, const Entry& entry) {
 }
 
 bool PlacementIndex::ApplyNode(const std::string& node,
-                               const lm::Labels& labels) {
+                               const lm::Labels& labels,
+                               const std::string& change) {
   Entry entry;
   entry.perf_class = Get(labels, lm::kPerfClass);
   entry.rank = ClassRank(entry.perf_class);
@@ -197,14 +251,20 @@ bool PlacementIndex::ApplyNode(const std::string& node,
   entry.slice_id = Get(labels, lm::kSliceId);
   entry.basic = BasicEligible(labels);
   entry.claim = SliceDegradedClaim(labels);
+  entry.basic_reason = BasicReason(labels);
+  entry.change = change;
 
   auto it = nodes_.find(node);
   if (it != nodes_.end()) {
     const Entry& old = it->second;
     if (old.perf_class == entry.perf_class && old.chips == entry.chips &&
         old.slice_id == entry.slice_id && old.basic == entry.basic &&
-        old.claim == entry.claim) {
-      return false;  // no index movement
+        old.claim == entry.claim &&
+        old.basic_reason == entry.basic_reason) {
+      // No index movement: keep old.change — the retained change-id is
+      // the write that CREATED the current condition, not the last
+      // no-op rewrite.
+      return false;
     }
     Erase(node, old);
     it->second = entry;
@@ -225,9 +285,11 @@ bool PlacementIndex::RemoveNode(const std::string& node) {
   return true;
 }
 
-void PlacementIndex::ApplyInventory(const lm::Labels& labels) {
+void PlacementIndex::ApplyInventory(const lm::Labels& labels,
+                                    const std::string& change) {
   inventory_capacity_.clear();
   have_inventory_ = !labels.empty();
+  inventory_change_ = change;
   const std::string prefix = lm::kCapacityPrefix;
   for (const auto& [key, value] : labels) {
     if (key.rfind(prefix, 0) != 0) continue;
@@ -310,6 +372,148 @@ PlacementResult PlacementIndex::Query(const PlacementQuery& query) const {
   return out;
 }
 
+std::string PlacementIndex::NodeChange(const std::string& node) const {
+  auto it = nodes_.find(node);
+  return it == nodes_.end() ? std::string() : it->second.change;
+}
+
+std::string PlacementIndex::NodeBasicReason(const std::string& node) const {
+  auto it = nodes_.find(node);
+  return it == nodes_.end() ? std::string() : it->second.basic_reason;
+}
+
+PlacementExplanation PlacementIndex::Explain(
+    const PlacementQuery& query, const PlacementResult& result) const {
+  PlacementExplanation out;
+  const int min_rank = JobMinRank(query.wanted);
+  const bool admitted = Admit(min_rank, query.chips);
+  std::set<std::string> placed;
+  for (const Candidate& c : result.candidates) placed.insert(c.node);
+
+  // Pre-pass: the lexicographically-first claiming member per blocked
+  // slice (the name a "slice-member-degraded" rejection reports).
+  std::map<std::string, std::string> first_claimer;
+  for (const auto& [node, entry] : nodes_) {
+    if (entry.claim && !entry.slice_id.empty() &&
+        first_claimer.count(entry.slice_id) == 0) {
+      first_claimer[entry.slice_id] = node;
+    }
+  }
+
+  std::set<std::string> change_ids;
+  // The counterfactual names the most-preferred rejected node:
+  // preference order (rank desc, free desc, name asc) over rejections.
+  bool have_best = false;
+  const Entry* best_entry = nullptr;
+  std::string best_node;
+  Rejection best_rejection;
+
+  for (const auto& [node, entry] : nodes_) {
+    if (placed.count(node) != 0) continue;
+    if (query.slice && entry.slice_id.empty()) {
+      // Structurally out of scope for a multislice query — a
+      // non-member is not "rejected", it was never a candidate shape.
+      continue;
+    }
+    Rejection rejection;
+    rejection.node = node;
+    rejection.change = entry.change;
+    if (!admitted) {
+      rejection.reason = "capacity-admission";
+      rejection.change = inventory_change_;
+    } else if (!entry.basic_reason.empty()) {
+      rejection.reason = entry.basic_reason;
+      if (rejection.reason == "slice-member-degraded") {
+        rejection.member = node;  // the node's own claim blocks it
+      }
+    } else if (entry.rank < min_rank) {
+      rejection.reason = "class-floor";
+    } else if (!entry.slice_id.empty() &&
+               blocked_.count(entry.slice_id) != 0) {
+      rejection.reason = "slice-member-degraded";
+      auto claimer = first_claimer.find(entry.slice_id);
+      if (claimer != first_claimer.end()) {
+        rejection.member = claimer->second;
+        rejection.change = NodeChange(claimer->second);
+      }
+    } else if (entry.chips < query.chips) {
+      rejection.reason = "insufficient-chips";
+    } else {
+      continue;  // viable, just beyond the answer's limit — not rejected
+    }
+    out.reasons[rejection.reason]++;
+    out.rejected++;
+    if (!rejection.change.empty()) change_ids.insert(rejection.change);
+    if (static_cast<int>(out.rejections.size()) <
+        PlacementExplanation::kMaxRejections) {
+      out.rejections.push_back(rejection);
+    }
+    if (!have_best || entry.rank > best_entry->rank ||
+        (entry.rank == best_entry->rank &&
+         (entry.chips > best_entry->chips ||
+          (entry.chips == best_entry->chips && node < best_node)))) {
+      have_best = true;
+      best_entry = &entry;
+      best_node = node;
+      best_rejection = rejection;
+    }
+  }
+
+  for (const std::string& id : change_ids) {
+    if (static_cast<int>(out.change_ids.size()) >=
+        PlacementExplanation::kMaxChangeIds) {
+      break;
+    }
+    out.change_ids.push_back(id);
+  }
+
+  if (result.status == "placed") return out;
+
+  // Counterfactual: the minimal blocking summary for an unplaceable
+  // query. Strings are pinned against tpufd.placement.explain.
+  if (result.status == "no-capacity") {
+    out.counterfactual = "capacity-admission: inventory admits fewer than " +
+                         std::to_string(query.chips) +
+                         " chip(s) at class floor " + query.wanted;
+    if (!inventory_change_.empty()) {
+      out.counterfactual += " (change " + inventory_change_ + ")";
+    }
+    return out;
+  }
+  if (!have_best) {
+    out.counterfactual = query.slice ? "no slice-member nodes in index"
+                                     : "no candidate nodes in index";
+    return out;
+  }
+  const std::string& reason = best_rejection.reason;
+  if (reason == "insufficient-chips") {
+    out.counterfactual =
+        "insufficient-chips: needs " +
+        std::to_string(query.chips - best_entry->chips) +
+        " more free chip(s); best node " + best_node + " has " +
+        std::to_string(best_entry->chips) + " free";
+  } else if (reason == "class-floor") {
+    out.counterfactual =
+        "class-floor: needs class >= " + query.wanted + "; best node " +
+        best_node + " is " +
+        (best_entry->perf_class.empty() ? "unclassed"
+                                        : best_entry->perf_class);
+  } else if (reason == "slice-member-degraded") {
+    out.counterfactual = "slice-member-degraded: slice " +
+                         best_entry->slice_id + " blocked by member " +
+                         best_rejection.member +
+                         "'s degraded-slice verdict";
+  } else {
+    // perf-degraded / lifecycle-preempt / lifecycle-draining.
+    out.counterfactual = reason + ": best node " + best_node +
+                         " is blocked by its own labels";
+  }
+  if (!best_rejection.change.empty()) {
+    out.counterfactual += " (change " + best_rejection.change + ")";
+  }
+  return out;
+}
+
 // ---- wire protocol -------------------------------------------------------
 
 std::string ParsePlacementBody(const std::string& body,
@@ -355,6 +559,21 @@ std::string ParsePlacementBody(const std::string& body,
     }
     query->limit = static_cast<int>(v->number_value);
   }
+  if (jsonlite::ValuePtr v = root->Get("explain"); v) {
+    if (v->kind != jsonlite::Value::Kind::kBool) {
+      return "'explain' must be a boolean";
+    }
+    query->explain = v->bool_value;
+  }
+  if (jsonlite::ValuePtr v = root->Get("job"); v) {
+    if (v->kind != jsonlite::Value::Kind::kString) {
+      return "'job' must be a string";
+    }
+    if (v->string_value.size() > 256) {
+      return "'job' must be at most 256 bytes";
+    }
+    query->job = v->string_value;
+  }
   return "";
 }
 
@@ -370,6 +589,153 @@ std::string RenderPlacementResult(const PlacementResult& result) {
            ",\"free\":" + std::to_string(c.free) +
            ",\"slice\":" + jsonlite::Quote(c.slice_id) + "}";
   }
+  out += "]";
+  if (result.explained) {
+    // The explain section rides the SAME document; a non-explain
+    // query's answer bytes are untouched (pay-for-what-you-use).
+    const PlacementExplanation& ex = result.explanation;
+    out += ",\"explain\":{\"reasons\":{";
+    first = true;
+    for (const auto& [reason, count] : ex.reasons) {
+      if (!first) out += ",";
+      first = false;
+      out += jsonlite::Quote(reason) + ":" + std::to_string(count);
+    }
+    out += "},\"rejected\":" + std::to_string(ex.rejected) +
+           ",\"rejections\":[";
+    first = true;
+    for (const Rejection& r : ex.rejections) {
+      if (!first) out += ",";
+      first = false;
+      out += "{\"node\":" + jsonlite::Quote(r.node) +
+             ",\"reason\":" + jsonlite::Quote(r.reason);
+      if (!r.member.empty()) {
+        out += ",\"member\":" + jsonlite::Quote(r.member);
+      }
+      if (!r.change.empty()) {
+        out += ",\"change\":" + jsonlite::Quote(r.change);
+      }
+      out += "}";
+    }
+    out += "],\"counterfactual\":" + jsonlite::Quote(ex.counterfactual) +
+           ",\"change_ids\":[";
+    first = true;
+    for (const std::string& id : ex.change_ids) {
+      if (!first) out += ",";
+      first = false;
+      out += jsonlite::Quote(id);
+    }
+    out += "]}";
+  }
+  out += "}";
+  return out;
+}
+
+// ---- decision audit ring --------------------------------------------------
+
+void DecisionRing::Push(DecisionRecord record) {
+  record.seq = next_seq_++;
+  ring_.push_back(std::move(record));
+  while (ring_.size() > capacity_) {
+    ring_.pop_front();
+    dropped_++;
+  }
+}
+
+bool DecisionRing::EvictNode(const std::string& node,
+                             const std::string& reason,
+                             const std::string& change, double t) {
+  // Placed decisions naming this node that postdate its last eviction
+  // are the placements this transition just invalidated.
+  std::vector<std::string> jobs;
+  std::set<std::string> seen;
+  for (auto it = ring_.rbegin(); it != ring_.rend(); ++it) {
+    if (it->node != node) continue;
+    if (it->outcome == "evicted") break;
+    if (it->outcome == "placed" && seen.insert(it->job).second) {
+      jobs.push_back(it->job);
+    }
+  }
+  if (jobs.empty()) return false;
+  std::reverse(jobs.begin(), jobs.end());  // oldest placement first
+  DecisionRecord record;
+  record.t = t;
+  record.outcome = "evicted";
+  record.node = node;
+  record.reason = reason;
+  if (!change.empty()) record.change_ids.push_back(change);
+  record.jobs = std::move(jobs);
+  Push(std::move(record));
+  return true;
+}
+
+std::string DecisionRing::RenderJson(int n, const std::string& job_filter,
+                                     const std::string& node_filter) const {
+  std::vector<const DecisionRecord*> matched;
+  for (const DecisionRecord& record : ring_) {
+    if (!job_filter.empty()) {
+      bool hit = record.job == job_filter;
+      for (const std::string& j : record.jobs) hit = hit || j == job_filter;
+      if (!hit) continue;
+    }
+    if (!node_filter.empty() && record.node != node_filter) continue;
+    matched.push_back(&record);
+  }
+  size_t start = 0;
+  if (n > 0 && matched.size() > static_cast<size_t>(n)) {
+    start = matched.size() - static_cast<size_t>(n);
+  }
+  std::string out = "{\"capacity\":" + std::to_string(capacity_) +
+                    ",\"appended\":" + std::to_string(next_seq_) +
+                    ",\"dropped\":" + std::to_string(dropped_) +
+                    ",\"decisions\":[";
+  bool first = true;
+  for (size_t i = start; i < matched.size(); i++) {
+    const DecisionRecord& record = *matched[i];
+    if (!first) out += ",";
+    first = false;
+    char t_buf[32];
+    snprintf(t_buf, sizeof(t_buf), "%.3f", record.t);
+    out += "{\"seq\":" + std::to_string(record.seq) + ",\"t\":" + t_buf +
+           ",\"outcome\":" + jsonlite::Quote(record.outcome);
+    if (record.outcome == "evicted") {
+      out += ",\"node\":" + jsonlite::Quote(record.node) +
+             ",\"reason\":" + jsonlite::Quote(record.reason) +
+             ",\"jobs\":[";
+      bool jfirst = true;
+      for (const std::string& j : record.jobs) {
+        if (!jfirst) out += ",";
+        jfirst = false;
+        out += jsonlite::Quote(j);
+      }
+      out += "]";
+    } else {
+      out += ",\"job\":" + jsonlite::Quote(record.job) +
+             ",\"query\":{\"class\":" + jsonlite::Quote(record.query.wanted) +
+             ",\"chips\":" + std::to_string(record.query.chips) +
+             ",\"slice\":" + (record.query.slice ? "true" : "false") +
+             ",\"limit\":" + std::to_string(record.query.limit) +
+             ",\"explain\":" + (record.query.explain ? "true" : "false") +
+             "},\"node\":" + jsonlite::Quote(record.node) +
+             ",\"reason\":" + jsonlite::Quote(record.reason) +
+             ",\"reasons\":{";
+      bool rfirst = true;
+      for (const auto& [reason, count] : record.reasons) {
+        if (!rfirst) out += ",";
+        rfirst = false;
+        out += jsonlite::Quote(reason) + ":" + std::to_string(count);
+      }
+      out += "}";
+    }
+    out += ",\"change_ids\":[";
+    bool cfirst = true;
+    for (const std::string& id : record.change_ids) {
+      if (!cfirst) out += ",";
+      cfirst = false;
+      out += jsonlite::Quote(id);
+    }
+    out += "]}";
+  }
   out += "]}";
   return out;
 }
@@ -381,6 +747,7 @@ namespace {
 struct Shared {
   std::mutex mu;
   PlacementIndex index;
+  DecisionRing ring{256};  // sized from --placement-audit-capacity
   bool synced = false;
   std::string inventory_name;  // the root rollup object we admit from
 };
@@ -523,8 +890,12 @@ class QueryServer {
     }
     std::string method = request_line.substr(0, sp1);
     std::string path = request_line.substr(sp1 + 1, sp2 - sp1 - 1);
+    std::string query_string;
     size_t qmark = path.find('?');
-    if (qmark != std::string::npos) path = path.substr(0, qmark);
+    if (qmark != std::string::npos) {
+      query_string = path.substr(qmark + 1);
+      path = path.substr(0, qmark);
+    }
 
     if (path == "/v1/placements") {
       if (method != "POST") {
@@ -542,7 +913,9 @@ class QueryServer {
                                "only GET is served here\n", "Allow: GET");
       return;
     }
-    if (path == "/healthz") {
+    if (path == "/v1/decisions") {
+      ServeDecisions(conn, query_string);
+    } else if (path == "/healthz") {
       conn->out = HttpResponse(200, "OK", "text/plain", "ok\n");
     } else if (path == "/readyz") {
       bool ready;
@@ -556,9 +929,42 @@ class QueryServer {
                                        "collection not yet listed\n");
     } else {
       conn->out = HttpResponse(404, "Not Found", "text/plain",
-                               "serves /healthz, /readyz and "
-                               "POST /v1/placements\n");
+                               "serves /healthz, /readyz, /v1/decisions "
+                               "and POST /v1/placements\n");
     }
+  }
+
+  // GET /v1/decisions?n=&job=&node= — the audit ring, oldest-first.
+  // Filters are exact matches; n bounds the rendered tail.
+  void ServeDecisions(Conn* conn, const std::string& query_string) {
+    int n = 0;
+    std::string job_filter;
+    std::string node_filter;
+    size_t pos = 0;
+    while (pos < query_string.size()) {
+      size_t amp = query_string.find('&', pos);
+      if (amp == std::string::npos) amp = query_string.size();
+      std::string param = query_string.substr(pos, amp - pos);
+      pos = amp + 1;
+      size_t eq = param.find('=');
+      if (eq == std::string::npos) continue;
+      std::string key = param.substr(0, eq);
+      std::string value = param.substr(eq + 1);
+      if (key == "n") {
+        int parsed = 0;
+        if (!value.empty() && ParseNonNegInt(value, &parsed)) n = parsed;
+      } else if (key == "job") {
+        job_filter = value;
+      } else if (key == "node") {
+        node_filter = value;
+      }
+    }
+    std::string body;
+    {
+      std::lock_guard<std::mutex> lock(shared_->mu);
+      body = shared_->ring.RenderJson(n, job_filter, node_filter);
+    }
+    conn->out = HttpResponse(200, "OK", "application/json", body + "\n");
   }
 
   void ServePlacement(Conn* conn, const std::string& body) {
@@ -576,8 +982,40 @@ class QueryServer {
     {
       std::lock_guard<std::mutex> lock(shared_->mu);
       result = shared_->index.Query(query);
+      if (query.explain) {
+        // Same lock, same index state: the explanation can never
+        // disagree with the answer it explains, even under churn.
+        result.explained = true;
+        result.explanation = shared_->index.Explain(query, result);
+      }
+      DecisionRecord record;
+      record.t = WallSeconds();
+      record.outcome = result.status == "placed" ? "placed" : "rejected";
+      record.job = query.job;
+      record.query = query;
+      if (!result.candidates.empty()) {
+        record.node = result.candidates.front().node;
+      }
+      record.reason = result.status;
+      if (result.explained) {
+        record.reasons = result.explanation.reasons;
+        record.change_ids = result.explanation.change_ids;
+      }
+      uint64_t dropped_before = shared_->ring.dropped();
+      shared_->ring.Push(std::move(record));
+      uint64_t newly_dropped = shared_->ring.dropped() - dropped_before;
+      if (newly_dropped > 0) {
+        AuditDroppedCounter()->Inc(static_cast<double>(newly_dropped));
+      }
     }
     QueryCounter(result.status)->Inc();
+    DecisionCounter(result.status == "placed" ? "placed" : "rejected")
+        ->Inc();
+    if (result.explained) {
+      for (const auto& [reason, count] : result.explanation.reasons) {
+        RejectionCounter(reason)->Inc(static_cast<double>(count));
+      }
+    }
     obs::Default()
         .GetHistogram("tfd_placement_query_seconds",
                       "Wall time of one placement query, parse to "
@@ -743,22 +1181,41 @@ class Ingest {
   }
 
   void ApplyObject(const std::string& name, const lm::Labels& labels,
-                   bool deleted) {
-    std::lock_guard<std::mutex> lock(shared_->mu);
-    if (name == shared_->inventory_name) {
-      shared_->index.ApplyInventory(deleted ? lm::Labels{} : labels);
-      IngestCounter("inventory")->Inc();
-    } else if (name.rfind(kCrNamePrefix, 0) == 0) {
-      std::string node = name.substr(sizeof(kCrNamePrefix) - 1);
-      if (deleted) {
-        shared_->index.RemoveNode(node);
+                   bool deleted, const std::string& change = "") {
+    uint64_t evicted = 0;
+    {
+      std::lock_guard<std::mutex> lock(shared_->mu);
+      if (name == shared_->inventory_name) {
+        shared_->index.ApplyInventory(deleted ? lm::Labels{} : labels,
+                                      change);
+        IngestCounter("inventory")->Inc();
+      } else if (name.rfind(kCrNamePrefix, 0) == 0) {
+        std::string node = name.substr(sizeof(kCrNamePrefix) - 1);
+        if (deleted) {
+          std::string last_change = shared_->index.NodeChange(node);
+          if (shared_->index.RemoveNode(node) &&
+              shared_->ring.EvictNode(node, "deleted", last_change,
+                                      WallSeconds())) {
+            evicted++;
+          }
+        } else {
+          bool moved = shared_->index.ApplyNode(node, labels, change);
+          std::string reason = shared_->index.NodeBasicReason(node);
+          // A moving write that leaves the node basic-ineligible closes
+          // (as "evicted") any ring placements still naming it.
+          if (moved && !reason.empty() &&
+              shared_->ring.EvictNode(node, reason,
+                                      shared_->index.NodeChange(node),
+                                      WallSeconds())) {
+            evicted++;
+          }
+        }
       } else {
-        shared_->index.ApplyNode(node, labels);
+        return;  // shard partials and strangers: never node contributions
       }
-    } else {
-      return;  // shard partials and strangers: never node contributions
+      SetIndexGauges(shared_->index);
     }
-    SetIndexGauges(shared_->index);
+    for (uint64_t i = 0; i < evicted; i++) DecisionCounter("evicted")->Inc();
   }
 
   Status ListOnce(std::string* rv) {
@@ -801,13 +1258,24 @@ class Ingest {
             }
           }
         }
+        // The change-id annotation (obs::kChangeAnnotation) — the same
+        // field the watch path surfaces as WatchEvent::change; listing
+        // must not lose the causal join.
+        std::string change;
+        if (jsonlite::ValuePtr a = item->GetPath("metadata.annotations");
+            a && a->kind == jsonlite::Value::Kind::kObject) {
+          if (jsonlite::ValuePtr c = a->Get(obs::kChangeAnnotation);
+              c && c->kind == jsonlite::Value::Kind::kString) {
+            change = c->string_value;
+          }
+        }
         if (name == shared_->inventory_name) {
           saw_inventory = true;
         } else if (name.rfind(kCrNamePrefix, 0) == 0) {
           listed_nodes.insert(name.substr(sizeof(kCrNamePrefix) - 1));
         }
         IngestCounter("listed")->Inc();
-        ApplyObject(name, labels, /*deleted=*/false);
+        ApplyObject(name, labels, /*deleted=*/false, change);
       }
     }
     std::vector<std::string> known;
@@ -922,7 +1390,8 @@ class Ingest {
               }
               IngestCounter(k8s::WatchEventTypeName(event.type))->Inc();
               ApplyObject(event.name, event.labels,
-                          event.type == k8s::WatchEvent::Type::kDeleted);
+                          event.type == k8s::WatchEvent::Type::kDeleted,
+                          event.change);
               break;
             case k8s::WatchEvent::Type::kUnknown:
               break;
@@ -1013,12 +1482,19 @@ PlacementOutcome RunPlacement(const config::Config& config,
 
   Shared shared;
   shared.inventory_name = flags.agg_output_name;
+  shared.ring = DecisionRing(
+      static_cast<size_t>(std::max(1, flags.placement_audit_capacity)));
   // Register the families at zero so the acceptance checks scrape
   // deterministically before the first query.
   QueryCounter("placed");
   QueryCounter("no-candidate");
   QueryCounter("no-capacity");
   QueryCounter("bad-request");
+  DecisionCounter("placed");
+  DecisionCounter("rejected");
+  DecisionCounter("evicted");
+  AuditDroppedCounter();
+  for (const char* reason : kRejectionReasons) RejectionCounter(reason);
   SetIndexGauges(shared.index);
 
   Result<std::unique_ptr<QueryServer>> query_server =
@@ -1047,6 +1523,39 @@ PlacementOutcome RunPlacement(const config::Config& config,
       TFD_LOG_INFO << "placement: SIGHUP, reloading";
       ingest.Stop();
       return PlacementOutcome::kRestart;
+    }
+    if (sig == SIGUSR1 && !flags.debug_dump_file.empty()) {
+      // The placement-mode post-mortem: the decision audit ring plus
+      // the index view it was computed from, next to the journal — the
+      // same one-signal causal capture the daemon's dump gives.
+      std::string decisions;
+      std::string index_json;
+      {
+        std::lock_guard<std::mutex> lock(shared.mu);
+        decisions = shared.ring.RenderJson(0, "", "");
+        index_json =
+            "{\"nodes\":" + std::to_string(shared.index.nodes()) +
+            ",\"eligible\":" + std::to_string(shared.index.eligible()) +
+            ",\"blocked_slices\":" +
+            std::to_string(shared.index.blocked_slices()) +
+            ",\"have_inventory\":" +
+            (shared.index.have_inventory() ? "true" : "false") +
+            ",\"synced\":" + (shared.synced ? "true" : "false") + "}";
+      }
+      std::string body =
+          "{\"mode\":\"placement\",\"version\":" +
+          jsonlite::Quote(info::VersionString()) +
+          ",\"index\":" + index_json + ",\"decisions\":" + decisions +
+          ",\"journal\":" + obs::DefaultJournal().RenderJson() + "}\n";
+      Status wrote = WriteFileAtomically(flags.debug_dump_file, body);
+      if (wrote.ok()) {
+        TFD_LOG_INFO << "wrote placement debug dump (decision ring + "
+                        "index view + journal) to "
+                     << flags.debug_dump_file;
+      } else {
+        TFD_LOG_WARNING << "placement debug dump failed: "
+                        << wrote.message();
+      }
     }
     if (server) {
       bool synced;

@@ -31,6 +31,7 @@
 #include <signal.h>
 
 #include <cstdint>
+#include <deque>
 #include <map>
 #include <set>
 #include <string>
@@ -62,11 +63,20 @@ bool BasicEligible(const lm::Labels& labels);
 // claiming blocks the whole slice (worst-of-members).
 bool SliceDegradedClaim(const lm::Labels& labels);
 
+// The FIRST reason this node's own labels make it basic-ineligible, ""
+// when basic-eligible. The closed rejection taxonomy
+// (tpufd.placement.basic_reason, bit-for-bit): "perf-degraded",
+// "slice-member-degraded" (the node's own claim), "lifecycle-preempt",
+// "lifecycle-draining". Precedence mirrors BasicEligible's check order.
+std::string BasicReason(const lm::Labels& labels);
+
 struct PlacementQuery {
   std::string wanted = "any";  // perf-class floor: gold | silver | any
   int chips = 1;               // free chips the job needs on one node
   bool slice = false;          // require slice membership (multislice)
   int limit = 1;               // max candidates returned (1..kMaxLimit)
+  bool explain = false;        // attach the rejection taxonomy walk
+  std::string job;             // caller's job id (audit-ring join key)
 };
 
 struct Candidate {
@@ -76,26 +86,77 @@ struct Candidate {
   std::string slice_id;    // "" when not a slice member
 };
 
+// One rejected node in an explained answer: the FIRST gating reason
+// from the closed taxonomy. `member` names the blocking slice member
+// (only for "slice-member-degraded"); `change` is the change-id of the
+// label write that created the blocking condition ("" when the CR
+// carried none).
+struct Rejection {
+  std::string node;
+  std::string reason;
+  std::string member;
+  std::string change;
+};
+
+// The explained view of one answer: per-reason counts over EVERY
+// rejected node, a name-ordered (bounded) rejection sample, and — when
+// the job is unplaceable — the minimal counterfactual blocking summary
+// plus the joined change-ids. Computed from the in-memory index only.
+struct PlacementExplanation {
+  std::map<std::string, int64_t> reasons;  // reason -> rejected nodes
+  int64_t rejected = 0;                    // total rejected nodes
+  std::vector<Rejection> rejections;       // name order, <= kMaxRejections
+  std::string counterfactual;              // "" when placed
+  std::vector<std::string> change_ids;     // sorted, deduped, bounded
+
+  static constexpr int kMaxRejections = 32;
+  static constexpr int kMaxChangeIds = 16;
+};
+
 struct PlacementResult {
   // "placed" (candidates non-empty), "no-candidate", or "no-capacity"
   // (the inventory admission gate refused before any scan) — the
   // SimScheduler Decision reasons verbatim.
   std::string status;
   std::vector<Candidate> candidates;  // preference order, <= limit
+  bool explained = false;             // query asked "explain": true
+  PlacementExplanation explanation;   // valid only when explained
 };
 
 class PlacementIndex {
  public:
-  // Ingests one node's published labels (ADDED/MODIFIED). Returns true
-  // when the index changed.
-  bool ApplyNode(const std::string& node, const lm::Labels& labels);
+  // Ingests one node's published labels (ADDED/MODIFIED). `change` is
+  // the CR's change-id annotation (obs::kChangeAnnotation) and is
+  // retained only when the write actually moved the index — a no-op
+  // rewrite keeps the change-id that created the current condition.
+  // Returns true when the index changed.
+  bool ApplyNode(const std::string& node, const lm::Labels& labels,
+                 const std::string& change = "");
   // Node CR deleted. Returns true when the node was present.
   bool RemoveNode(const std::string& node);
   // Ingests the aggregator's inventory rollup (capacity-by-class
   // admission). Pass {} when the inventory object is deleted.
-  void ApplyInventory(const lm::Labels& labels);
+  void ApplyInventory(const lm::Labels& labels,
+                      const std::string& change = "");
 
   PlacementResult Query(const PlacementQuery& query) const;
+
+  // The rejection-taxonomy walk for one already-computed answer: the
+  // FIRST gating reason per rejected node, in the pinned precedence
+  // (capacity-admission query-wide, then the node's own basic_reason,
+  // then class-floor, then a peer's slice claim, then
+  // insufficient-chips). Must run under the same lock/state as the
+  // Query that produced `result`. O(nodes) — explain is
+  // pay-for-what-you-use; the non-explain path never calls this.
+  PlacementExplanation Explain(const PlacementQuery& query,
+                               const PlacementResult& result) const;
+
+  // The change-id of the last label write that moved this node's index
+  // entry ("" when unknown). Exposed for the eviction join.
+  std::string NodeChange(const std::string& node) const;
+  // The node's stored basic-ineligibility reason ("" if eligible or
+  // unknown node).
+  std::string NodeBasicReason(const std::string& node) const;
 
   // Admission alone (the no-capacity gate), exposed for tests.
   bool Admit(int min_rank, int chips) const;
@@ -116,8 +177,10 @@ class PlacementIndex {
     int rank = 0;
     int64_t chips = 0;
     std::string slice_id;
-    bool basic = false;  // basic-eligible (candidate-set member)
-    bool claim = false;  // publishes a degraded-slice verdict
+    bool basic = false;        // basic-eligible (candidate-set member)
+    bool claim = false;        // publishes a degraded-slice verdict
+    std::string basic_reason;  // taxonomy reason ("" when basic)
+    std::string change;        // change-id of the last moving write
   };
 
   void Insert(const std::string& node, const Entry& entry);
@@ -140,7 +203,63 @@ class PlacementIndex {
   // capacity keys — have_inventory_ tracks that distinction.
   std::map<std::string, int64_t> inventory_capacity_;
   bool have_inventory_ = false;
+  std::string inventory_change_;  // change-id of the admitting rollup
   uint64_t events_ = 0;
+};
+
+// ---- decision audit ring --------------------------------------------------
+
+// One closed decision. outcome "placed"/"rejected" entries carry the
+// query, the answer node, the per-reason rejection counts (only when
+// the query was explained — counting rejections for a non-explain
+// query would cost the O(nodes) walk the fast path refuses to pay),
+// and the joined change-ids. outcome "evicted" entries record a node
+// leaving eligibility (or the collection) while the ring still holds
+// placed decisions naming it: `jobs` lists the affected placements.
+struct DecisionRecord {
+  uint64_t seq = 0;
+  double t = 0;  // wall-clock seconds
+  std::string outcome;  // placed | rejected | evicted
+  std::string job;      // query's job id ("" when the caller sent none)
+  PlacementQuery query;
+  std::string node;    // answer node (placed) / evicted node
+  std::string reason;  // rejected: status; evicted: taxonomy or "deleted"
+  std::map<std::string, int64_t> reasons;  // explained rejection counts
+  std::vector<std::string> change_ids;
+  std::vector<std::string> jobs;  // evicted: affected job ids
+};
+
+// Bounded drop-oldest ring of closed placement decisions, served as
+// GET /v1/decisions and folded into the SIGUSR1 debug dump. The caller
+// provides locking (the query server pushes under Shared::mu).
+class DecisionRing {
+ public:
+  explicit DecisionRing(size_t capacity) : capacity_(capacity) {}
+
+  void Push(DecisionRecord record);
+
+  // Appends one "evicted" record if the ring holds placed decisions
+  // naming `node` that postdate its last eviction. Returns true when a
+  // record was appended.
+  bool EvictNode(const std::string& node, const std::string& reason,
+                 const std::string& change, double t);
+
+  // Renders {"capacity":..,"appended":..,"dropped":..,"decisions":[..]}
+  // oldest-first, filtered (empty filter = match all), last `n` after
+  // filtering (n <= 0 = everything retained).
+  std::string RenderJson(int n, const std::string& job_filter,
+                         const std::string& node_filter) const;
+
+  size_t capacity() const { return capacity_; }
+  size_t size() const { return ring_.size(); }
+  uint64_t appended() const { return next_seq_; }
+  uint64_t dropped() const { return dropped_; }
+
+ private:
+  size_t capacity_;
+  std::deque<DecisionRecord> ring_;
+  uint64_t next_seq_ = 0;
+  uint64_t dropped_ = 0;
 };
 
 // Parses a /v1/placements request body into a query. Returns a

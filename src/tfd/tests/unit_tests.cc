@@ -6736,6 +6736,293 @@ void TestPlacementProtocol() {
   result.status = "no-candidate";
   CHECK_EQ(placement::RenderPlacementResult(result),
            "{\"status\":\"no-candidate\",\"candidates\":[]}");
+
+  // ISSUE 18: the explain request surface. Defaults off, strict types,
+  // and the job id (the audit-ring join key) rides along.
+  CHECK_EQ(placement::ParsePlacementBody(
+               "{\"explain\":true,\"job\":\"train-77\"}", &q),
+           "");
+  CHECK_TRUE(q.explain);
+  CHECK_EQ(q.job, "train-77");
+  CHECK_EQ(placement::ParsePlacementBody("{}", &q), "");
+  CHECK_TRUE(!q.explain);
+  CHECK_EQ(q.job, "");
+  CHECK_TRUE(!placement::ParsePlacementBody("{\"explain\":1}", &q).empty());
+  CHECK_TRUE(!placement::ParsePlacementBody("{\"job\":7}", &q).empty());
+
+  // The explain section APPENDS to the same document — a non-explain
+  // answer's bytes stay untouched (pay-for-what-you-use, asserted
+  // byte-for-byte by scripts/placement_smoke.py --explain too).
+  result.status = "no-candidate";
+  result.explained = true;
+  result.explanation.reasons["insufficient-chips"] = 1;
+  result.explanation.reasons["slice-member-degraded"] = 1;
+  result.explanation.rejected = 2;
+  result.explanation.rejections.push_back(
+      {"n2", "insufficient-chips", "", "ch-2"});
+  result.explanation.rejections.push_back(
+      {"n3", "slice-member-degraded", "n9", "ch-9"});
+  result.explanation.counterfactual = "why not";
+  result.explanation.change_ids = {"ch-2", "ch-9"};
+  CHECK_EQ(placement::RenderPlacementResult(result),
+           "{\"status\":\"no-candidate\",\"candidates\":[],"
+           "\"explain\":{\"reasons\":{\"insufficient-chips\":1,"
+           "\"slice-member-degraded\":1},\"rejected\":2,\"rejections\":["
+           "{\"node\":\"n2\",\"reason\":\"insufficient-chips\","
+           "\"change\":\"ch-2\"},"
+           "{\"node\":\"n3\",\"reason\":\"slice-member-degraded\","
+           "\"member\":\"n9\",\"change\":\"ch-9\"}],"
+           "\"counterfactual\":\"why not\","
+           "\"change_ids\":[\"ch-2\",\"ch-9\"]}}");
+}
+
+void TestPlacementExplain() {
+  // The rejection-taxonomy walk (ISSUE 18), pinned against
+  // tpufd.placement.explain / tpufd.cluster.explain_decision — the
+  // Python grids run the same scenario in tests/test_placement.py.
+  const std::string count = "google.com/tpu.count";
+  placement::PlacementIndex index;
+  index.ApplyNode("xa-gold-big",
+                  {{lm::kPerfClass, "gold"}, {count, "16"},
+                   {lm::kSliceId, "xs-1"}},
+                  "ch-a");
+  index.ApplyNode("xb-gold-small",
+                  {{lm::kPerfClass, "gold"}, {count, "4"}}, "ch-b");
+  index.ApplyNode("xc-degraded",
+                  {{lm::kPerfClass, "degraded"}, {count, "8"}}, "ch-c");
+  index.ApplyNode("xd-silver",
+                  {{lm::kPerfClass, "silver"}, {count, "8"}}, "ch-d");
+  index.ApplyNode("xe-preempt",
+                  {{lm::kPerfClass, "gold"}, {count, "8"},
+                   {lm::kLifecyclePreemptImminent, "true"}},
+                  "ch-e");
+  index.ApplyNode("xf-drain",
+                  {{lm::kPerfClass, "gold"}, {count, "8"},
+                   {lm::kLifecycleDraining, "true"}},
+                  "ch-f");
+  // xg-m0's own claim blocks itself (member = self) AND its healthy
+  // peer xg-m1 (member = xg-m0, change = xg-m0's write).
+  index.ApplyNode("xg-m0",
+                  {{lm::kPerfClass, "gold"}, {count, "8"},
+                   {lm::kSliceId, "xs-2"}, {lm::kSliceDegraded, "true"}},
+                  "ch-g0");
+  index.ApplyNode("xg-m1",
+                  {{lm::kPerfClass, "gold"}, {count, "8"},
+                   {lm::kSliceId, "xs-2"}},
+                  "ch-g1");
+
+  placement::PlacementQuery q;
+  q.wanted = "gold";
+  q.chips = 8;
+  q.explain = true;
+  placement::PlacementResult r = index.Query(q);
+  CHECK_EQ(r.status, "placed");
+  CHECK_EQ(r.candidates[0].node, "xa-gold-big");
+  placement::PlacementExplanation ex = index.Explain(q, r);
+  CHECK_EQ(ex.rejected, 7);
+  CHECK_EQ(ex.reasons["perf-degraded"], 1);
+  CHECK_EQ(ex.reasons["class-floor"], 1);
+  CHECK_EQ(ex.reasons["lifecycle-preempt"], 1);
+  CHECK_EQ(ex.reasons["lifecycle-draining"], 1);
+  CHECK_EQ(ex.reasons["slice-member-degraded"], 2);
+  CHECK_EQ(ex.reasons["insufficient-chips"], 1);
+  CHECK_EQ(ex.counterfactual, "");  // placed: nothing to counterfact
+  // Rejections are name-ordered; the slice entries name the blocking
+  // member (self for the claimer, the first claimer for the peer) and
+  // join the change-id of the write that created the condition.
+  std::map<std::string, placement::Rejection> by_node;
+  for (const placement::Rejection& rej : ex.rejections) {
+    by_node[rej.node] = rej;
+  }
+  CHECK_EQ(by_node["xg-m0"].reason, "slice-member-degraded");
+  CHECK_EQ(by_node["xg-m0"].member, "xg-m0");
+  CHECK_EQ(by_node["xg-m0"].change, "ch-g0");
+  CHECK_EQ(by_node["xg-m1"].reason, "slice-member-degraded");
+  CHECK_EQ(by_node["xg-m1"].member, "xg-m0");
+  CHECK_EQ(by_node["xg-m1"].change, "ch-g0");  // the BLOCKING write
+  CHECK_EQ(by_node["xb-gold-small"].reason, "insufficient-chips");
+  CHECK_EQ(by_node["xd-silver"].reason, "class-floor");
+  // change_ids: sorted, deduped (xg-m1 contributed ch-g0, not ch-g1).
+  const std::vector<std::string> want_ids = {"ch-b", "ch-c", "ch-d",
+                                             "ch-e", "ch-f", "ch-g0"};
+  CHECK_TRUE(ex.change_ids == want_ids);
+
+  // Precedence: a node's OWN basic reason beats a peer's slice claim,
+  // and class-floor beats the peer claim too.
+  index.ApplyNode("xh-preempt-in-xs2",
+                  {{lm::kPerfClass, "gold"}, {count, "8"},
+                   {lm::kSliceId, "xs-2"},
+                   {lm::kLifecyclePreemptImminent, "true"}},
+                  "ch-h");
+  index.ApplyNode("xi-silver-in-xs2",
+                  {{lm::kPerfClass, "silver"}, {count, "8"},
+                   {lm::kSliceId, "xs-2"}},
+                  "ch-i");
+  r = index.Query(q);
+  ex = index.Explain(q, r);
+  for (const placement::Rejection& rej : ex.rejections) by_node[rej.node] = rej;
+  CHECK_EQ(by_node["xh-preempt-in-xs2"].reason, "lifecycle-preempt");
+  CHECK_EQ(by_node["xi-silver-in-xs2"].reason, "class-floor");
+  index.RemoveNode("xh-preempt-in-xs2");
+  index.RemoveNode("xi-silver-in-xs2");
+
+  // A viable node beyond the answer is SKIPPED, not rejected: the
+  // taxonomy explains infeasibility, not ranking.
+  q.wanted = "any";
+  q.chips = 4;
+  q.limit = 1;
+  r = index.Query(q);
+  ex = index.Explain(q, r);
+  bool saw_viable_loser = false;
+  for (const placement::Rejection& rej : ex.rejections) {
+    if (rej.node == "xb-gold-small") saw_viable_loser = true;
+  }
+  CHECK_TRUE(!saw_viable_loser);
+
+  // Unplaceable counterfactual: the pinned string names the best
+  // rejected node and what would have to change, with the change join.
+  q.wanted = "gold";
+  q.chips = 64;
+  r = index.Query(q);
+  CHECK_EQ(r.status, "no-candidate");
+  ex = index.Explain(q, r);
+  CHECK_EQ(ex.counterfactual,
+           "insufficient-chips: needs 48 more free chip(s); best node "
+           "xa-gold-big has 16 free (change ch-a)");
+
+  // Slice-blocked counterfactual.
+  placement::PlacementIndex slice_only;
+  slice_only.ApplyNode("ya-m0",
+                       {{lm::kPerfClass, "gold"}, {count, "8"},
+                        {lm::kSliceId, "ys-1"},
+                        {lm::kSliceDegraded, "true"}},
+                       "ch-y0");
+  q.chips = 8;
+  r = slice_only.Query(q);
+  ex = slice_only.Explain(q, r);
+  CHECK_EQ(ex.counterfactual,
+           "slice-member-degraded: slice ys-1 blocked by member "
+           "ya-m0's degraded-slice verdict (change ch-y0)");
+
+  // Class-floor counterfactual ("unclassed" when no class published).
+  placement::PlacementIndex floor_only;
+  floor_only.ApplyNode("za", {{count, "8"}});
+  r = floor_only.Query(q);
+  ex = floor_only.Explain(q, r);
+  CHECK_EQ(ex.counterfactual,
+           "class-floor: needs class >= gold; best node za is unclassed");
+
+  // no-capacity counterfactual is query-wide and joins the INVENTORY
+  // change; every node rejects as capacity-admission.
+  const std::string prefix = lm::kCapacityPrefix;
+  index.ApplyInventory({{prefix + "gold", "0"}}, "ch-inv");
+  q.chips = 1;
+  r = index.Query(q);
+  CHECK_EQ(r.status, "no-capacity");
+  ex = index.Explain(q, r);
+  CHECK_EQ(ex.counterfactual,
+           "capacity-admission: inventory admits fewer than 1 chip(s) "
+           "at class floor gold (change ch-inv)");
+  CHECK_EQ(ex.reasons["capacity-admission"], ex.rejected);
+  CHECK_TRUE(ex.change_ids == std::vector<std::string>{"ch-inv"});
+  index.ApplyInventory({});
+
+  // Empty-index counterfactuals, slice-shaped and not.
+  placement::PlacementIndex empty;
+  r = empty.Query(q);
+  ex = empty.Explain(q, r);
+  CHECK_EQ(ex.counterfactual, "no candidate nodes in index");
+  q.slice = true;
+  r = empty.Query(q);
+  ex = empty.Explain(q, r);
+  CHECK_EQ(ex.counterfactual, "no slice-member nodes in index");
+  q.slice = false;
+
+  // Non-members are structurally out of scope for a multislice query
+  // (not rejections), and the inline sample is bounded while the
+  // counts cover EVERY rejected node.
+  placement::PlacementIndex big;
+  for (int i = 0; i < 40; i++) {
+    char name[16];
+    snprintf(name, sizeof(name), "bn-%02d", i);
+    big.ApplyNode(name, {{lm::kPerfClass, "degraded"}, {count, "8"}});
+  }
+  big.ApplyNode("bs-member", {{lm::kPerfClass, "gold"}, {count, "4"},
+                              {lm::kSliceId, "bs-1"}});
+  q.wanted = "gold";
+  q.chips = 8;
+  q.slice = true;
+  r = big.Query(q);
+  ex = big.Explain(q, r);
+  CHECK_EQ(ex.rejected, 1);  // the 40 non-members never enter the walk
+  CHECK_EQ(ex.reasons["insufficient-chips"], 1);
+  q.slice = false;
+  r = big.Query(q);
+  ex = big.Explain(q, r);
+  CHECK_EQ(ex.rejected, 41);
+  CHECK_EQ(static_cast<int>(ex.rejections.size()),
+           placement::PlacementExplanation::kMaxRejections);
+  CHECK_EQ(ex.reasons["perf-degraded"], 40);
+}
+
+void TestDecisionRing() {
+  // Bounded drop-oldest audit ring (ISSUE 18): capacity, filters, the
+  // n bound, and the eviction join.
+  placement::DecisionRing ring(3);
+  for (int i = 0; i < 5; i++) {
+    placement::DecisionRecord record;
+    record.t = 1.0 + i;
+    record.outcome = i % 2 == 0 ? "placed" : "rejected";
+    record.job = "j-" + std::to_string(i);
+    record.node = i % 2 == 0 ? "n-keep" : "";
+    record.reason = i % 2 == 0 ? "placed" : "no-candidate";
+    ring.Push(std::move(record));
+  }
+  CHECK_EQ(ring.size(), 3u);
+  CHECK_EQ(ring.appended(), 5u);
+  CHECK_EQ(ring.dropped(), 2u);
+  std::string doc = ring.RenderJson(0, "", "");
+  CHECK_TRUE(doc.find("\"capacity\":3") != std::string::npos);
+  CHECK_TRUE(doc.find("\"appended\":5") != std::string::npos);
+  CHECK_TRUE(doc.find("\"dropped\":2") != std::string::npos);
+  CHECK_TRUE(doc.find("\"job\":\"j-0\"") == std::string::npos);  // dropped
+  CHECK_TRUE(doc.find("\"job\":\"j-2\"") != std::string::npos);
+  CHECK_TRUE(doc.find("\"seq\":4") != std::string::npos);
+  // Filters are exact; n bounds the filtered tail.
+  doc = ring.RenderJson(0, "j-3", "");
+  CHECK_TRUE(doc.find("\"job\":\"j-3\"") != std::string::npos);
+  CHECK_TRUE(doc.find("\"job\":\"j-2\"") == std::string::npos);
+  doc = ring.RenderJson(1, "", "");
+  CHECK_TRUE(doc.find("\"seq\":4") != std::string::npos);
+  CHECK_TRUE(doc.find("\"seq\":3") == std::string::npos);
+  doc = ring.RenderJson(0, "", "n-keep");
+  CHECK_TRUE(doc.find("\"seq\":2") != std::string::npos);
+  CHECK_TRUE(doc.find("\"seq\":3") == std::string::npos);
+
+  // Eviction joins the placements the transition invalidated: the
+  // placed decisions naming the node since its last eviction, oldest
+  // first, carrying the change-id of the evicting write.
+  placement::DecisionRing ring2(16);
+  for (int i = 0; i < 2; i++) {
+    placement::DecisionRecord record;
+    record.outcome = "placed";
+    record.job = "ej-" + std::to_string(i);
+    record.node = "ev-node";
+    ring2.Push(std::move(record));
+  }
+  CHECK_TRUE(!ring2.EvictNode("other-node", "deleted", "", 9.0));
+  CHECK_TRUE(ring2.EvictNode("ev-node", "perf-degraded", "ch-evict", 9.0));
+  doc = ring2.RenderJson(0, "", "ev-node");
+  CHECK_TRUE(doc.find("\"outcome\":\"evicted\"") != std::string::npos);
+  CHECK_TRUE(doc.find("\"reason\":\"perf-degraded\"") != std::string::npos);
+  CHECK_TRUE(doc.find("\"jobs\":[\"ej-0\",\"ej-1\"]") != std::string::npos);
+  CHECK_TRUE(doc.find("\"change_ids\":[\"ch-evict\"]") != std::string::npos);
+  // The eviction closed those placements: a second transition has
+  // nothing left to close.
+  CHECK_TRUE(!ring2.EvictNode("ev-node", "deleted", "", 10.0));
+  // A job filter matches evicted records through their jobs list.
+  doc = ring2.RenderJson(0, "ej-1", "");
+  CHECK_TRUE(doc.find("\"outcome\":\"evicted\"") != std::string::npos);
 }
 
 }  // namespace
@@ -6897,6 +7184,8 @@ int main(int argc, char** argv) {
   tfd::TestAggShardMergeTree();
   tfd::TestPlacementIndexContract();
   tfd::TestPlacementProtocol();
+  tfd::TestPlacementExplain();
+  tfd::TestDecisionRing();
   tfd::TestPerfFleetFloor();
   tfd::TestSlicePreemptingMember();
   tfd::TestGetNodeDraining();

@@ -1299,7 +1299,12 @@ class TestRealLibtpu:
         degrade to the null backend with exit 0."""
         code, out, err = run_tfd(
             tfd_binary,
-            pjrt_args(["--fail-on-init-error=false"],
+            pjrt_args(["--fail-on-init-error=false",
+                       # dlopen + version negotiation happen in the
+                       # first second; the rest of the default 30s
+                       # watchdog budget is just waiting out a client
+                       # create that can't succeed without a TPU.
+                       "--pjrt-init-timeout=8s"],
                       libtpu=_real_libtpu_path()),
             timeout=180)
         assert code == 0, err

@@ -251,6 +251,73 @@ class TestScheduler:
         assert s.place(cluster.Job("j1", "any", 4, 1.0),
                        0.0).reason == "no-candidate"
 
+    def test_delete_then_drain_frees_chips(self):
+        # DELETE with no re-add: the next drain evicts the claim, the
+        # eviction record carries the deleted object's change-id, and
+        # the chips come back.
+        s = cluster.SimScheduler()
+        l = labels()
+        l[cluster.CHANGE_KEY] = "ch-del-1"
+        s.on_event("n1", l)
+        assert s.place(cluster.Job("j1", "any", 4, 1.0), 0.0).node == "n1"
+        s.on_event("n1", None)
+        assert s.drain_ineligible(1.0) == ["j1"]
+        assert s.node_used.get("n1", 0) == 0
+        rec = s.ring[-1]
+        assert (rec["outcome"], rec["reason"]) == ("evicted", "deleted")
+        assert rec["jobs"] == ["j1"]
+        assert rec["change_ids"] == ["ch-del-1"]
+
+    def test_delete_readd_before_drain_still_evicts(self):
+        # The ISSUE 18 bugfix-sweep leak: node DELETED mid-claim, then
+        # re-created before a drain pass runs. The claim died with the
+        # old node object — the re-created node must not inherit its
+        # used-chip accounting, so the drain still evicts the job and
+        # a full-node job then fits on the fresh node.
+        s = cluster.SimScheduler()
+        l = labels()
+        l[cluster.CHANGE_KEY] = "ch-del-2"
+        s.on_event("n1", l)
+        assert s.place(cluster.Job("j1", "any", 4, 1.0), 0.0).node == "n1"
+        s.on_event("n1", None)
+        s.on_event("n1", labels())  # re-created, healthy, 8 chips
+        assert s.drain_ineligible(1.0) == ["j1"]
+        assert s.node_used.get("n1", 0) == 0
+        assert s.node_of("j1") is None
+        rec = s.ring[-1]
+        assert (rec["outcome"], rec["reason"]) == ("evicted", "deleted")
+        assert rec["jobs"] == ["j1"]
+        assert rec["change_ids"] == ["ch-del-2"]
+        assert s.place(cluster.Job("j2", "any", 8, 1.0), 2.0).node == "n1"
+
+    def test_delete_readd_new_claim_survives_drain(self):
+        # Only claims severed by the DELETE are evicted; a job placed
+        # on the re-created object afterwards is judged against the
+        # node's current (healthy) labels and keeps running.
+        s = cluster.SimScheduler()
+        s.on_event("n1", labels())
+        s.place(cluster.Job("j1", "any", 4, 1.0), 0.0)
+        s.on_event("n1", None)
+        s.on_event("n1", labels())
+        assert s.place(cluster.Job("j2", "any", 4, 1.0), 1.0).node == "n1"
+        assert s.drain_ineligible(2.0) == ["j1"]
+        assert s.node_of("j2") == "n1"
+        assert s.node_used["n1"] == 4
+
+    def test_release_after_delete_clears_severed_claim(self):
+        # Job completes between the DELETE and the drain: release
+        # retires the severed-claim record too, so the drain has
+        # nothing to evict.
+        s = cluster.SimScheduler()
+        s.on_event("n1", labels())
+        s.place(cluster.Job("j1", "any", 4, 1.0), 0.0)
+        s.on_event("n1", None)
+        assert s.release("j1") == "n1"
+        s.on_event("n1", labels())
+        assert s.drain_ineligible(1.0) == []
+        assert s.evicted_total == 0
+        assert not s.deleted_claims
+
 
 class TestGroundTruthLeak:
     """The labels-only contract, enforced: flipping sim-internal ground

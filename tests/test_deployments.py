@@ -312,12 +312,17 @@ class TestHelmChart:
         assert pl["enabled"] is False
         assert pl["replicas"] == 2
         assert pl["port"] == 8085
+        # Decision audit ring capacity (ISSUE 18): helm knob -> env,
+        # static manifest pinned at the 256 default.
+        assert pl["auditCapacity"] == 256
         template = (HELM / "templates" / "placement.yaml").read_text()
         assert ".Values.placement.enabled" in template
         assert "kind: Deployment" in template
         assert "kind: Service" in template
         assert 'value: "placement"' in template
         assert "TFD_PLACEMENT_LISTEN_ADDR" in template
+        assert "TFD_PLACEMENT_AUDIT_CAPACITY" in template
+        assert ".Values.placement.auditCapacity" in template
         assert ".Values.placement.replicas" in template
         # Read-only: the service must never hold write verbs — a
         # replica going haywire cannot corrupt the label surface.
@@ -338,6 +343,7 @@ class TestHelmChart:
         env = {e["name"]: e.get("value") for e in container["env"]}
         assert env["TFD_MODE"] == "placement"
         assert env["TFD_PLACEMENT_LISTEN_ADDR"] == ":8085"
+        assert env["TFD_PLACEMENT_AUDIT_CAPACITY"] == "256"
         # Probes ride the query port: readiness gates on the informer
         # sync, so a cold replica never joins the Service.
         assert container["readinessProbe"]["httpGet"]["port"] == \

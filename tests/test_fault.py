@@ -212,9 +212,13 @@ class TestSinkBreaker:
                 return 0
             from tpufd import metrics
             try:
-                return metrics.sample_value(body, "tfd_rewrites_total")
+                value = metrics.sample_value(body, "tfd_rewrites_total")
             except ValueError:
                 return 0
+            # The family can be scraped before its first sample lands;
+            # keep the wait_for predicates polling instead of raising
+            # on None >= N.
+            return 0 if value is None else value
 
         with FakeApiServer(token="breaker-token") as server:
             proc = launch(

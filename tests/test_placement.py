@@ -179,10 +179,16 @@ class TestTwinParity:
         for wanted in ("any", "silver", "gold"):
             for chips in (1, 4, 8):
                 for want_slice in (False, True):
-                    assert idx.query(wanted=wanted, chips=chips,
-                                     slice=want_slice, limit=64) == \
-                        rebuilt.query(wanted=wanted, chips=chips,
-                                      slice=want_slice, limit=64)
+                    # Explained and plain answers both survive churn:
+                    # the walk reads the same incremental structures
+                    # the fast path does (ISSUE 18).
+                    for explain in (False, True):
+                        assert idx.query(wanted=wanted, chips=chips,
+                                         slice=want_slice, limit=64,
+                                         explain=explain) == \
+                            rebuilt.query(wanted=wanted, chips=chips,
+                                          slice=want_slice, limit=64,
+                                          explain=explain)
 
     def test_preference_order_and_filters(self):
         # The pinned 5-node fleet from unit_tests.cc
@@ -238,6 +244,191 @@ class TestTwinParity:
         # Limit clamps.
         assert len(idx.query(limit=2)["candidates"]) == 2
         assert idx.query(chips=99)["status"] == "no-candidate"
+
+
+class TestExplainParity:
+    """ISSUE 18: the rejection-taxonomy walk, twin-pinned across all
+    three implementations (C++ runs the same crafted fleet in
+    unit_tests.cc TestPlacementExplain)."""
+
+    def test_explain_grid_matches_simscheduler(self):
+        # Over randomized fleets (kept under the twins' 32-rejection
+        # inline cap so the lists compare exactly), the SimScheduler's
+        # explanation IS the index twin's, modulo the two documented
+        # sim deltas: the extra "blocking" attribution hook, and
+        # allocation-aware free chips (no allocations are held here).
+        rng = random.Random(41)
+        for trial in range(40):
+            idx = placement.PlacementIndex()
+            sched = cluster.SimScheduler()
+            for i in range(rng.randrange(4, 28)):
+                node = f"en-{i}"
+                labels = random_labels(rng)
+                if rng.random() < 0.6:
+                    labels[cluster.CHANGE_KEY] = f"ch-{trial}-{i}"
+                idx.apply_node(node, labels,
+                               change=labels.get(cluster.CHANGE_KEY, ""))
+                sched.on_event(node, labels)
+            if trial % 3 == 0:
+                inventory = {
+                    agg.CAPACITY_PREFIX + "gold":
+                        str(rng.choice([0, 4, 64])),
+                    agg.CAPACITY_PREFIX + "silver":
+                        str(rng.choice([0, 8])),
+                    cluster.CHANGE_KEY: f"ch-inv-{trial}",
+                }
+                idx.apply_inventory(
+                    inventory, change=inventory[cluster.CHANGE_KEY])
+                sched.on_inventory(inventory)
+            for wanted in ("any", "silver", "gold"):
+                for chips in (1, 8, 64):
+                    job = cluster.Job("ej", wanted, chips, 1.0)
+                    decision = sched.place(job, 0.0, explain=True)
+                    want = idx.query(wanted=wanted, chips=chips,
+                                     explain=True)["explain"]
+                    got = {k: v for k, v in decision.explain.items()
+                           if k != "blocking"}
+                    assert got == want, (trial, wanted, chips)
+                    if decision.placed:
+                        sched.release("ej")
+
+    def test_pinned_taxonomy_and_counterfactuals(self):
+        # The crafted fleet from unit_tests.cc TestPlacementExplain:
+        # every taxonomy reason, the blocking-member naming, change-id
+        # joins, and the pinned counterfactual strings.
+        idx = placement.PlacementIndex()
+        fleet = [
+            ("xa-gold-big", {agg.PERF_CLASS: "gold", agg.TPU_COUNT: "16",
+                             agg.SLICE_ID: "xs-1"}, "ch-a"),
+            ("xb-gold-small", {agg.PERF_CLASS: "gold",
+                               agg.TPU_COUNT: "4"}, "ch-b"),
+            ("xc-degraded", {agg.PERF_CLASS: "degraded",
+                             agg.TPU_COUNT: "8"}, "ch-c"),
+            ("xd-silver", {agg.PERF_CLASS: "silver",
+                           agg.TPU_COUNT: "8"}, "ch-d"),
+            ("xe-preempt", {agg.PERF_CLASS: "gold", agg.TPU_COUNT: "8",
+                            agg.LIFECYCLE_PREEMPT: "true"}, "ch-e"),
+            ("xf-drain", {agg.PERF_CLASS: "gold", agg.TPU_COUNT: "8",
+                          placement.LIFECYCLE_DRAINING: "true"}, "ch-f"),
+            ("xg-m0", {agg.PERF_CLASS: "gold", agg.TPU_COUNT: "8",
+                       agg.SLICE_ID: "xs-2",
+                       agg.SLICE_DEGRADED: "true"}, "ch-g0"),
+            ("xg-m1", {agg.PERF_CLASS: "gold", agg.TPU_COUNT: "8",
+                       agg.SLICE_ID: "xs-2"}, "ch-g1"),
+        ]
+        for node, labels, change in fleet:
+            idx.apply_node(node, labels, change=change)
+
+        result = idx.query(wanted="gold", chips=8, explain=True)
+        assert result["status"] == "placed"
+        assert result["candidates"][0]["node"] == "xa-gold-big"
+        ex = result["explain"]
+        assert ex["reasons"] == {"perf-degraded": 1, "class-floor": 1,
+                                 "lifecycle-preempt": 1,
+                                 "lifecycle-draining": 1,
+                                 "slice-member-degraded": 2,
+                                 "insufficient-chips": 1}
+        assert ex["rejected"] == 7
+        assert ex["counterfactual"] == ""
+        by_node = {r["node"]: r for r in ex["rejections"]}
+        # The claimer blocks itself (member = self); its healthy peer
+        # is blocked BY the claimer — the member an operator must fix —
+        # and joins the BLOCKING write's change-id, not its own.
+        assert by_node["xg-m0"]["member"] == "xg-m0"
+        assert by_node["xg-m0"]["change"] == "ch-g0"
+        assert by_node["xg-m1"]["member"] == "xg-m0"
+        assert by_node["xg-m1"]["change"] == "ch-g0"
+        assert ex["change_ids"] == ["ch-b", "ch-c", "ch-d", "ch-e",
+                                    "ch-f", "ch-g0"]
+
+        # Precedence: a node's OWN basic reason and the class floor
+        # both beat a peer's slice claim.
+        idx.apply_node("xh", {agg.PERF_CLASS: "gold", agg.TPU_COUNT: "8",
+                              agg.SLICE_ID: "xs-2",
+                              agg.LIFECYCLE_PREEMPT: "true"}, "ch-h")
+        idx.apply_node("xi", {agg.PERF_CLASS: "silver",
+                              agg.TPU_COUNT: "8",
+                              agg.SLICE_ID: "xs-2"}, "ch-i")
+        ex = idx.query(wanted="gold", chips=8, explain=True)["explain"]
+        by_node = {r["node"]: r for r in ex["rejections"]}
+        assert by_node["xh"]["reason"] == "lifecycle-preempt"
+        assert by_node["xi"]["reason"] == "class-floor"
+        idx.remove_node("xh")
+        idx.remove_node("xi")
+
+        # A viable node beyond the limit is skipped, not rejected.
+        ex = idx.query(wanted="any", chips=4, limit=1,
+                       explain=True)["explain"]
+        assert "xb-gold-small" not in {r["node"] for r in ex["rejections"]}
+
+        # Pinned counterfactual strings, change joins included.
+        ex = idx.query(wanted="gold", chips=64, explain=True)["explain"]
+        assert ex["counterfactual"] == \
+            ("insufficient-chips: needs 48 more free chip(s); "
+             "best node xa-gold-big has 16 free (change ch-a)")
+        only_slice = placement.PlacementIndex()
+        only_slice.apply_node("ya-m0", {agg.PERF_CLASS: "gold",
+                                        agg.TPU_COUNT: "8",
+                                        agg.SLICE_ID: "ys-1",
+                                        agg.SLICE_DEGRADED: "true"},
+                              change="ch-y0")
+        ex = only_slice.query(wanted="gold", chips=8,
+                              explain=True)["explain"]
+        assert ex["counterfactual"] == \
+            ("slice-member-degraded: slice ys-1 blocked by member "
+             "ya-m0's degraded-slice verdict (change ch-y0)")
+        floor_only = placement.PlacementIndex()
+        floor_only.apply_node("za", {agg.TPU_COUNT: "8"})
+        ex = floor_only.query(wanted="gold", chips=8,
+                              explain=True)["explain"]
+        assert ex["counterfactual"] == \
+            "class-floor: needs class >= gold; best node za is unclassed"
+        idx.apply_inventory({agg.CAPACITY_PREFIX + "gold": "0"},
+                            change="ch-inv")
+        result = idx.query(wanted="gold", chips=1, explain=True)
+        assert result["status"] == "no-capacity"
+        ex = result["explain"]
+        assert ex["counterfactual"] == \
+            ("capacity-admission: inventory admits fewer than 1 "
+             "chip(s) at class floor gold (change ch-inv)")
+        assert ex["reasons"] == {"capacity-admission": ex["rejected"]}
+        assert ex["change_ids"] == ["ch-inv"]
+        idx.apply_inventory({})
+        empty = placement.PlacementIndex()
+        assert empty.query(explain=True)["explain"]["counterfactual"] \
+            == "no candidate nodes in index"
+        assert empty.query(slice=True,
+                           explain=True)["explain"]["counterfactual"] \
+            == "no slice-member nodes in index"
+
+        # Taxonomy is closed: every reason any walk emits is in the
+        # pinned enum.
+        for r in (idx.query(wanted="gold", chips=8,
+                            explain=True)["explain"]["reasons"]):
+            assert r in placement.REJECTION_REASONS
+
+    def test_rejection_caps_and_slice_scope(self):
+        # Counts cover EVERY rejected node; the inline sample and the
+        # change-id join are bounded; non-members never enter a
+        # multislice walk.
+        idx = placement.PlacementIndex()
+        for i in range(40):
+            idx.apply_node(f"bn-{i:02d}", {agg.PERF_CLASS: "degraded",
+                                           agg.TPU_COUNT: "8"},
+                           change=f"ch-{i:02d}")
+        idx.apply_node("bs-member", {agg.PERF_CLASS: "gold",
+                                     agg.TPU_COUNT: "4",
+                                     agg.SLICE_ID: "bs-1"})
+        ex = idx.query(wanted="gold", chips=8, slice=True,
+                       explain=True)["explain"]
+        assert ex["rejected"] == 1
+        assert ex["reasons"] == {"insufficient-chips": 1}
+        ex = idx.query(wanted="gold", chips=8, explain=True)["explain"]
+        assert ex["rejected"] == 41
+        assert len(ex["rejections"]) == placement.MAX_EXPLAIN_REJECTIONS
+        assert ex["reasons"]["perf-degraded"] == 40
+        assert len(ex["change_ids"]) == placement.MAX_EXPLAIN_CHANGE_IDS
+        assert ex["change_ids"] == sorted(ex["change_ids"])
 
 
 # ---- the real binary -------------------------------------------------------
@@ -411,5 +602,95 @@ class TestPlacementProcess:
                         oport, "tfd_placement_events_total",
                         labels={"type": "inventory"}) >= 2.0,
                     timeout=10)
+            finally:
+                stop(proc)
+
+    def test_explain_and_decisions_endpoint(self, tfd_binary):
+        # ISSUE 18 on the live socket (scripts/placement_smoke.py
+        # --explain is the deep drill; this pins the tier-1 shape):
+        # explained answers equal the twin's walk including change-id
+        # joins, rejection metrics move only for explained queries, and
+        # /v1/decisions serves the audit ring with the eviction join.
+        with FakeApiServer() as server:
+            twin = placement.PlacementIndex()
+            for i in range(6):
+                labels = {
+                    agg.TPU_COUNT: str([16, 4][i % 2]),
+                    agg.PERF_CLASS: ["gold", "silver", "degraded"][i % 3],
+                }
+                change = f"ch-p{i}"
+                server.seed(NS, f"tfd-features-for-p{i}", labels,
+                            {NODE_NAME_LABEL: f"p{i}"},
+                            annotations={
+                                "tfd.google.com/change-id": change})
+                twin.apply_node(f"p{i}", labels, change=change)
+            qport, oport = free_port(), free_port()
+            proc = subprocess.Popen(
+                placement_argv(tfd_binary, qport, oport) +
+                ["--placement-audit-capacity=8"],
+                env=placement_env(server), stderr=subprocess.DEVNULL)
+            try:
+                assert wait_for(
+                    lambda: http_get(qport, "/readyz")[0] == 200,
+                    timeout=20)
+                # A non-explain query never pays the walk: the
+                # rejection counter stays unregistered/zero.
+                status, body = post_placement(
+                    qport, {"class": "gold", "chips": 8})
+                assert status == 200 and "explain" not in body
+                for doc in ({"class": "gold", "chips": 8,
+                             "explain": True, "job": "tj-1"},
+                            {"class": "gold", "chips": 99,
+                             "explain": True, "job": "tj-2"}):
+                    status, body = post_placement(qport, doc)
+                    assert status == 200
+                    want = twin.query(wanted=doc["class"],
+                                      chips=doc["chips"], explain=True)
+                    assert body == want, doc
+                    assert set(body["explain"]["reasons"]) <= \
+                        set(placement.REJECTION_REASONS)
+                assert metric(oport, "tfd_placement_rejections_total",
+                              labels={"reason": "perf-degraded"}) >= 1.0
+                assert metric(oport, "tfd_placement_decisions_total",
+                              labels={"outcome": "rejected"}) >= 1.0
+
+                # The audit ring: capacity from the flag, every query
+                # closed, filters exact.
+                _, body = http_get(qport, "/v1/decisions")
+                ring = json.loads(body)
+                assert ring["capacity"] == 8
+                assert ring["appended"] == 3
+                _, body = http_get(qport, "/v1/decisions?job=tj-2")
+                only = json.loads(body)["decisions"]
+                assert [d["job"] for d in only] == ["tj-2"]
+                assert only[0]["reasons"] == \
+                    twin.query(wanted="gold", chips=99,
+                               explain=True)["explain"]["reasons"]
+
+                # Deleting the placed node's CR closes its placements
+                # as an evicted entry joining the retained change-id.
+                winner = twin.query(wanted="gold", chips=8)[
+                    "candidates"][0]["node"]
+                server.delete(NS, f"tfd-features-for-{winner}")
+                twin.remove_node(winner)
+
+                def evicted():
+                    _, body = http_get(
+                        qport, f"/v1/decisions?node={winner}")
+                    return any(d["outcome"] == "evicted"
+                               for d in json.loads(body)["decisions"])
+
+                assert wait_for(evicted, timeout=10)
+                _, body = http_get(qport, f"/v1/decisions?node={winner}")
+                ev = [d for d in json.loads(body)["decisions"]
+                      if d["outcome"] == "evicted"][-1]
+                assert ev["reason"] == "deleted"
+                assert "tj-1" in ev["jobs"]
+                assert ev["change_ids"] == [f"ch-{winner}"]
+                assert metric(oport, "tfd_placement_decisions_total",
+                              labels={"outcome": "evicted"}) == 1.0
+                # The 404 catalog names the new endpoint.
+                status, text = http_get(qport, "/nope")
+                assert status == 404 and "/v1/decisions" in text
             finally:
                 stop(proc)

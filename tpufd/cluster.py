@@ -28,6 +28,7 @@ metrics across two runs of one seed.
 import re
 
 from tpufd import agg as agglib
+from tpufd import placement as placementlib
 
 PREFIX = "google.com/"
 
@@ -88,6 +89,12 @@ CLASS_RANK = {"gold": 3, "silver": 2, "degraded": 1}
 # Job class floors -> minimum acceptable rank.
 JOB_CLASS_RANK = {"gold": 3, "silver": 2, "any": 0}
 
+# The closed rejection taxonomy (ISSUE 18) — shared with the serving
+# twins so the SimScheduler's explanations are pinned to the exact
+# strings the C++ service and tpufd.placement emit.
+REJECTION_REASONS = placementlib.REJECTION_REASONS
+MAX_EXPLAIN_CHANGE_IDS = placementlib.MAX_EXPLAIN_CHANGE_IDS
+
 
 def class_rank(labels):
     return CLASS_RANK.get(labels.get(PERF_CLASS, ""), 0)
@@ -114,6 +121,16 @@ def basic_eligible(labels):
     if preempting(labels):
         return False
     return True
+
+
+def basic_reason(labels):
+    """The FIRST taxonomy reason this node's own labels make it
+    basic-ineligible, "" when basic-eligible (None-tolerant wrapper over
+    tpufd.placement.basic_reason — the sim view stores None for deleted
+    nodes)."""
+    if labels is None:
+        return ""
+    return placementlib.basic_reason(labels)
 
 
 def node_eligible(labels, min_rank):
@@ -166,15 +183,18 @@ class Decision:
     """One placement decision: node is None when nothing placeable
     (reason 'no-capacity' = the inventory admission gate said the
     cluster has no chips of the wanted class; 'no-candidate' = the
-    per-node scan found nothing eligible with room)."""
+    per-node scan found nothing eligible with room). `explain` carries
+    the rejection-taxonomy walk (SimScheduler.explain_decision) when the
+    caller asked for it, None otherwise."""
 
-    __slots__ = ("job_id", "node", "reason", "at")
+    __slots__ = ("job_id", "node", "reason", "at", "explain")
 
     def __init__(self, job_id, node, reason, at):
         self.job_id = job_id
         self.node = node
         self.reason = reason
         self.at = at
+        self.explain = None
 
     @property
     def placed(self):
@@ -199,6 +219,10 @@ class SimScheduler:
     preemption-aware migration the lifecycle labels exist to drive.
     """
 
+    # The serving ring's default capacity (placement::DecisionRing /
+    # --placement-audit-capacity).
+    RING_CAPACITY = 256
+
     def __init__(self):
         self.view = {}         # node -> published labels
         self.inventory = {}    # the rollup object's labels (may be {})
@@ -208,6 +232,21 @@ class SimScheduler:
         self.placed_total = 0
         self.no_candidate_total = 0
         self.no_capacity_total = 0
+        # Placement explainability (ISSUE 18): the decision audit ring
+        # (bounded, drop-oldest — the sim analogue of the service's
+        # /v1/decisions ring) and the per-reason rejection rollup the
+        # soak folds into tfd_placement_rejections_total's twin.
+        self.ring = []
+        self.ring_capacity = self.RING_CAPACITY
+        self.ring_seq = 0
+        self.ring_dropped = 0
+        self.explained_total = 0
+        self.rejections_total = {}  # reason -> rejected-node count
+        self.evicted_total = 0
+        # Claims severed by a node DELETE, captured at the event so the
+        # eviction survives the node re-appearing before the next drain
+        # pass (job_id -> change-id of the deleted node object).
+        self.deleted_claims = {}
 
     # ---- label surface ---------------------------------------------------
 
@@ -217,7 +256,16 @@ class SimScheduler:
         into scheduler internals."""
         was = basic_eligible(self.view.get(node))
         if labels is None:
-            self.view.pop(node, None)
+            old = self.view.pop(node, None)
+            if old is not None:
+                # A claim dies with its node object. Record every
+                # placement the delete severed — a re-created node of
+                # the same name is NEW hardware and must not inherit
+                # the old object's used-chip accounting.
+                change = old.get(CHANGE_KEY, "")
+                for job_id, (placed_node, _) in self.placements.items():
+                    if placed_node == node:
+                        self.deleted_claims[job_id] = change
         else:
             self.view[node] = dict(labels)
         now_el = basic_eligible(self.view.get(node))
@@ -270,11 +318,12 @@ class SimScheduler:
             blocked = slice_blocked_ids(self.view)
         return sid not in blocked
 
-    def place(self, job, now):
+    def place(self, job, now, explain=False):
         self.decisions += 1
         if not self.admit(job):
             self.no_capacity_total += 1
-            return Decision(job.job_id, None, "no-capacity", now)
+            decision = Decision(job.job_id, None, "no-capacity", now)
+            return self._close_decision(decision, job, explain)
         blocked = slice_blocked_ids(self.view)
         best = None
         best_key = None
@@ -292,17 +341,172 @@ class SimScheduler:
                 best, best_key = node, key
         if best is None:
             self.no_candidate_total += 1
-            return Decision(job.job_id, None, "no-candidate", now)
+            decision = Decision(job.job_id, None, "no-candidate", now)
+            return self._close_decision(decision, job, explain)
         self.placements[job.job_id] = (best, job.chips)
         self.node_used[best] = self.node_used.get(best, 0) + job.chips
         self.placed_total += 1
-        return Decision(job.job_id, best, "placed", now)
+        decision = Decision(job.job_id, best, "placed", now)
+        return self._close_decision(decision, job, explain)
+
+    # ---- placement explainability (ISSUE 18) ------------------------------
+
+    def _ring_push(self, record):
+        record["seq"] = self.ring_seq
+        self.ring_seq += 1
+        self.ring.append(record)
+        if len(self.ring) > self.ring_capacity:
+            self.ring.pop(0)
+            self.ring_dropped += 1
+
+    def _close_decision(self, decision, job, explain):
+        record = {
+            "t": decision.at,
+            "outcome": "placed" if decision.placed else "rejected",
+            "job": decision.job_id,
+            "query": {"class": job.wanted, "chips": job.chips},
+            "node": decision.node or "",
+            "reason": "" if decision.placed else decision.reason,
+        }
+        if explain:
+            self.explained_total += 1
+            decision.explain = self.explain_decision(job, decision)
+            for reason in sorted(decision.explain["reasons"]):
+                self.rejections_total[reason] = \
+                    self.rejections_total.get(reason, 0) + \
+                    decision.explain["reasons"][reason]
+            record["reasons"] = dict(decision.explain["reasons"])
+            record["change_ids"] = list(decision.explain["change_ids"])
+        self._ring_push(record)
+        return decision
+
+    def _first_claimers(self):
+        """slice id -> its lexicographically-first member whose
+        published labels claim the slice degraded (the blocking member
+        an explanation names — same pick as the serving twins)."""
+        first = {}
+        for node in sorted(self.view):
+            labels = self.view[node]
+            sid = labels.get(SLICE_ID, "")
+            if not sid or sid in first:
+                continue
+            if (labels.get(SLICE_DEGRADED) == "true" or
+                    labels.get(SLICE_CLASS) == "degraded"):
+                first[sid] = node
+        return first
+
+    def explain_decision(self, job, decision):
+        """The rejection-taxonomy walk for one already-made decision,
+        in the serving twins' pinned FIRST-reason precedence
+        (tpufd.placement.PlacementIndex.explain): capacity-admission
+        (query-wide), the node's own basic_reason, class-floor, a
+        peer's degraded-slice claim (naming the blocking member),
+        insufficient-chips. Two sim-side deltas from the allocation-free
+        index: free chips are allocation-aware (capacity minus
+        node_used — the sim owns its bookkeeping), and the rejection
+        list is NOT capped at the serving twins' inline sample bound
+        (the harness scores attribution fidelity over the full walk).
+        `blocking` is the counterfactual's reason name ("" when placed)
+        — the queue-wait attribution hook."""
+        admitted = decision.reason != "no-capacity"
+        blocked = slice_blocked_ids(self.view)
+        first_claimer = self._first_claimers()
+        reasons = {}
+        rejections = []
+        change_ids = set()
+        best = None  # (rank, free, node, rejection)
+        for node in sorted(self.view):
+            if node == decision.node:
+                continue
+            labels = self.view[node]
+            free = self._free_chips(node, labels)
+            rejection = {"node": node, "reason": ""}
+            change = labels.get(CHANGE_KEY, "")
+            member = ""
+            if not admitted:
+                rejection["reason"] = "capacity-admission"
+                change = self.inventory.get(CHANGE_KEY, "")
+            else:
+                reason = basic_reason(labels)
+                if reason:
+                    rejection["reason"] = reason
+                    if reason == "slice-member-degraded":
+                        member = node  # its own claim blocks it
+                elif class_rank(labels) < job.min_rank:
+                    rejection["reason"] = "class-floor"
+                else:
+                    sid = labels.get(SLICE_ID, "")
+                    if sid and sid in blocked:
+                        rejection["reason"] = "slice-member-degraded"
+                        member = first_claimer.get(sid, "")
+                        change = self.view.get(member, {}).get(
+                            CHANGE_KEY, "") if member else ""
+                    elif free < job.chips:
+                        rejection["reason"] = "insufficient-chips"
+                    else:
+                        continue  # viable, just not preferred
+            if member:
+                rejection["member"] = member
+            if change:
+                rejection["change"] = change
+                change_ids.add(change)
+            reason = rejection["reason"]
+            reasons[reason] = reasons.get(reason, 0) + 1
+            rejections.append(rejection)
+            rank = class_rank(labels)
+            if (best is None or (rank, free) > (best[0], best[1]) or
+                    ((rank, free) == (best[0], best[1]) and
+                     node < best[2])):
+                best = (rank, free, node, rejection)
+        out = {"reasons": reasons, "rejected": len(rejections),
+               "rejections": rejections, "counterfactual": "",
+               "change_ids": sorted(change_ids)[:MAX_EXPLAIN_CHANGE_IDS],
+               "blocking": ""}
+        if decision.placed:
+            return out
+        if decision.reason == "no-capacity":
+            text = (f"capacity-admission: inventory admits fewer than "
+                    f"{job.chips} chip(s) at class floor {job.wanted}")
+            change = self.inventory.get(CHANGE_KEY, "")
+            if change:
+                text += f" (change {change})"
+            out["counterfactual"] = text
+            out["blocking"] = "capacity-admission"
+            return out
+        if best is None:
+            out["counterfactual"] = "no candidate nodes in index"
+            out["blocking"] = "no-nodes"
+            return out
+        _, free, node, rejection = best
+        reason = rejection["reason"]
+        out["blocking"] = reason
+        if reason == "insufficient-chips":
+            text = (f"insufficient-chips: needs {job.chips - free} more "
+                    f"free chip(s); best node {node} has {free} free")
+        elif reason == "class-floor":
+            cls = self.view[node].get(PERF_CLASS, "") or "unclassed"
+            text = (f"class-floor: needs class >= {job.wanted}; "
+                    f"best node {node} is {cls}")
+        elif reason == "slice-member-degraded":
+            sid = self.view[node].get(SLICE_ID, "")
+            text = (f"slice-member-degraded: slice {sid} blocked by "
+                    f"member {rejection['member']}'s degraded-slice "
+                    f"verdict")
+        else:
+            # perf-degraded / lifecycle-preempt / lifecycle-draining.
+            text = (f"{reason}: best node {node} is blocked by its "
+                    f"own labels")
+        if rejection.get("change"):
+            text += f" (change {rejection['change']})"
+        out["counterfactual"] = text
+        return out
 
     def release(self, job_id):
         """Job finished (or failed on bad hardware): free its chips."""
         placed = self.placements.pop(job_id, None)
         if placed is None:
             return None
+        self.deleted_claims.pop(job_id, None)
         node, chips = placed
         used = self.node_used.get(node, 0) - chips
         if used > 0:
@@ -315,17 +519,67 @@ class SimScheduler:
         placed = self.placements.get(job_id)
         return placed[0] if placed else None
 
-    def drain_ineligible(self):
+    def drain_ineligible(self, now=0.0):
         """Jobs running on nodes whose published labels now say 'stop':
         released here and returned (sorted) for the caller to re-queue —
         the label-driven eviction path (preempt-imminent, slice
-        degraded, perf demotion, node object deleted)."""
+        degraded, perf demotion, node object deleted). Each evicted
+        node closes an "evicted" audit-ring record carrying the
+        taxonomy reason that doomed it and the change-id of the label
+        write that created the condition (the serving ring's
+        DecisionRing::EvictNode analogue)."""
         blocked = slice_blocked_ids(self.view)
+        severed = {job_id: change
+                   for job_id, change in self.deleted_claims.items()
+                   if job_id in self.placements}
         doomed = sorted(
-            job_id for job_id, (node, _) in self.placements.items()
-            if not self.placeable(node, blocked))
+            set(severed) | {
+                job_id for job_id, (node, _) in self.placements.items()
+                if not self.placeable(node, blocked)})
+        by_node = {}
         for job_id in doomed:
+            by_node.setdefault(self.placements[job_id][0],
+                               []).append(job_id)
             self.release(job_id)
+        first_claimer = None
+        for node in sorted(by_node):
+            labels = self.view.get(node)
+            # Claims a node DELETE severed are evicted as "deleted"
+            # even when the node re-appeared before this drain ran: the
+            # claim died with the old node object (change-ids captured
+            # at the delete), and only the re-created object's own
+            # claims — if any — are judged against its current labels.
+            dead = [j for j in by_node[node] if j in severed]
+            if dead:
+                self.evicted_total += 1
+                self._ring_push({
+                    "t": now, "outcome": "evicted", "node": node,
+                    "reason": "deleted", "jobs": dead,
+                    "change_ids": sorted(
+                        {severed[j] for j in dead if severed[j]})})
+            live = [j for j in by_node[node] if j not in severed]
+            if not live:
+                continue
+            if labels is None:
+                reason, change = "deleted", ""
+            else:
+                reason = basic_reason(labels)
+                change = labels.get(CHANGE_KEY, "")
+                if not reason:
+                    # Basic-eligible but unplaceable: a peer's
+                    # degraded-slice claim evicted it.
+                    reason = "slice-member-degraded"
+                    if first_claimer is None:
+                        first_claimer = self._first_claimers()
+                    member = first_claimer.get(
+                        labels.get(SLICE_ID, ""), "")
+                    change = self.view.get(member, {}).get(
+                        CHANGE_KEY, "") if member else ""
+            self.evicted_total += 1
+            self._ring_push({
+                "t": now, "outcome": "evicted", "node": node,
+                "reason": reason, "jobs": live,
+                "change_ids": [change] if change else []})
         return doomed
 
 

@@ -813,11 +813,13 @@ class FakeApiServer:
             self._handler.events[(ns, name)] = []
             self._handler.compacted[(ns, name)] = rv
 
-    def seed(self, ns, name, labels, meta_labels=None):
+    def seed(self, ns, name, labels, meta_labels=None, annotations=None):
         """Creates or replaces an object server-side (rv bump + watch
         event), exactly what a daemon's write looks like to a
         collection watcher — the aggregator soak seeds/churns its fleet
-        through this without 200 real daemon processes."""
+        through this without 200 real daemon processes. `annotations`
+        rides metadata.annotations (the change-id / SLO channel a real
+        daemon stamps next to its labels)."""
         with self._handler.lock:
             existing = self.store.get((ns, name))
             if existing is None:
@@ -825,7 +827,8 @@ class FakeApiServer:
                        "kind": "NodeFeature",
                        "metadata": {"name": name, "namespace": ns,
                                     "resourceVersion": "1",
-                                    "labels": dict(meta_labels or {})},
+                                    "labels": dict(meta_labels or {}),
+                                    "annotations": dict(annotations or {})},
                        "spec": {"labels": dict(labels)}}
                 self.store[(ns, name)] = obj
                 self._handler._emit(ns, name, "ADDED", obj)
@@ -834,6 +837,9 @@ class FakeApiServer:
                 if meta_labels:
                     existing.setdefault("metadata", {}).setdefault(
                         "labels", {}).update(meta_labels)
+                if annotations:
+                    existing.setdefault("metadata", {}).setdefault(
+                        "annotations", {}).update(annotations)
                 existing["metadata"]["resourceVersion"] = str(
                     int(existing["metadata"]["resourceVersion"]) + 1)
                 self._handler._emit(ns, name, "MODIFIED", existing)

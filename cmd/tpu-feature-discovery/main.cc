@@ -2499,6 +2499,13 @@ int Main(int argc, char** argv) {
       options.journal = &obs::DefaultJournal();
       options.trace = &obs::DefaultTrace();
       options.slo = &obs::DefaultSlo();
+      if (flags.slice_coordination) {
+        // Peer report relay (--slice-relay): peers fetch this host's
+        // live member report here during a partial partition.
+        options.slice_report = [] {
+          return slice::Default().LocalReportJson();
+        };
+      }
       // Freshness window: 2x the rewrite cadence — plus the health-exec
       // budget when --device-health=full, whose hourly re-measure
       // legitimately blocks a pass for up to health_exec_timeout_s; a

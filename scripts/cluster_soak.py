@@ -97,18 +97,36 @@ PREFIX = "google.com/"
 # bad_placements_within_window); one placement AFTER it is a gate
 # failure. The budget arithmetic lives in docs/placement-harness.md.
 CONVERGENCE_WINDOW_S = {
-    "degrade": 3.0,    # probe tick (<=1s) + publish + wire
-    "preempt": 2.0,    # metadata fast path + publish + wire
-    "wedge": 4.5,      # report ages out (agreement 2s) + verdict + pub
-    "partition": 7.0,  # agreement + possible leader failover (lease 3s)
+    "degrade": 1.5,    # event-driven probe + publish + wire
+    "preempt": 1.5,    # metadata fast path + publish + wire
+    "wedge": 3.0,      # peer probe confirms stale at agreement/2 + pub
+    "partition": 4.0,  # confirm + pre-declared succession (no full
+                       # lease-expiry wait: ISSUE 19)
 }
-# A brownout freezes label flow; failures overlapping one get their
-# window extended past the brownout's end by this much.
+# A brownout no longer freezes label flow outright — the leader hedges
+# and the store sheds (admits a fraction of) paced writes — but tails
+# stretch; failures overlapping one get their window extended past the
+# brownout's end by this much.
 BROWNOUT_GRACE_S = 2.0
 
 PROBE_INTERVAL_S = 1.0
 AGREEMENT_S = 2.0
 LEASE_S = 3.0
+# Peer report relay (ISSUE 19): a member whose blackboard report went
+# stale past agreement/2 is probed directly by its peers; a failed
+# probe CONFIRMS the staleness and the merge excludes the member now
+# instead of waiting out the full ageing window.
+RELAY_CONFIRM_S = AGREEMENT_S / 2.0
+# Pre-declared lease succession (ISSUE 19): the verdict names the
+# successor line; the first live successor promotes at the first
+# missed renewal tick (renew cadence lease/3 = 1s, missed at 1.5x)
+# instead of full lease expiry at 3s.
+SUCCESSION_S = LEASE_S / 3.0 * 1.5
+# Brownout shedding: a browned-out apiserver paces writers but still
+# ADMITS this fraction of attempts (Retry-After is per-request, not a
+# blackout) — the reason a verdict can reach the scheduler through a
+# racing member's publish while the others back off.
+BROWNOUT_ACCEPT_P = 0.55
 AGG_DEBOUNCE_S = 1.0
 AGG_LEASE_S = 30.0
 JOB_FAIL_DETECT_S = 1.0
@@ -233,20 +251,25 @@ class ClusterApiServer:
     def daemon_apply(self, t, node, labels):
         """A daemon's SSA write: store + watch fan-out. Brownout pacing
         is the CALLER's contract, not this method's — SimHost._publish
-        pre-checks brownout_active and schedules its own retry (keeping
-        the publish_pending slot so later dirtying events ride it), so
-        a write that reaches here always lands. A silent drop here
-        would lose the host's labels with no retry and no watch event —
-        exactly the stale-store lie the harness exists to catch."""
+        rolls the shedding lottery (BROWNOUT_ACCEPT_P) and schedules its
+        own retry on a reject (keeping the publish_pending slot so later
+        dirtying events ride it), so a write that reaches here always
+        lands. A silent drop here would lose the host's labels with no
+        retry and no watch event — exactly the stale-store lie the
+        harness exists to catch."""
         self._count(t, "APPLY", node)
-        assert not self.brownout_active(t), \
-            "daemon_apply during a brownout: the caller owns pacing"
         self.objects[node] = dict(labels)
-        if self.tracker is not None:
-            host = self.hosts_by_name.get(node)
-            if host is not None:
-                for m in host.slice.members:
-                    self.tracker.stamp_node(m.name, "publish", t)
+        host = self.hosts_by_name.get(node)
+        if host is not None:
+            # The harness's exact-value mirror of the SLO annotation
+            # that just landed: the fold multiset resident in this
+            # host's sketches at apply time (the fleet-vs-harness
+            # checkpoint compares against the merged fleet view, which
+            # lags this by one wire hop).
+            host.published_slo_folds = list(host.slo_folds)
+        if self.tracker is not None and host is not None:
+            for m in host.slice.members:
+                self.tracker.stamp_node(m.name, "publish", t)
         for w in self.watchers:
             self.clock.schedule(
                 t + self._wire_latency(),
@@ -313,15 +336,17 @@ class ClusterAggregator(SimAggregator):
         super().on_event(t, node, labels)
 
     def _flush(self, t):
-        if self.server.brownout_active(t):
+        if self.server.brownout_active(t) and \
+                self.server.rng.random() >= BROWNOUT_ACCEPT_P:
             # The rollup APPLY is a write like any other: a browned-out
-            # server paces it with Retry-After, so the inventory channel
-            # freezes during a brownout exactly like the per-node
-            # labels do. Keep the flush slot (flush_scheduled stays
-            # True, later dirtying events ride this retry) and retry at
-            # host pacing cadence.
+            # server sheds it with Retry-After (admitting only the
+            # BROWNOUT_ACCEPT_P fraction), so the inventory channel
+            # slows during a brownout exactly like the per-node labels
+            # do. Keep the flush slot (flush_scheduled stays True,
+            # later dirtying events ride this retry) and retry at the
+            # server's pacing cadence.
             self.server.brownout_rejected += 1
-            self.clock.schedule(t + self.server.rng.uniform(0.6, 1.4),
+            self.clock.schedule(t + self.server.rng.uniform(0.2, 0.35),
                                 lambda now: self._flush(now))
             return
         before = len(self.server.output_writes)
@@ -374,6 +399,7 @@ class SimHost:
         self.gt_degraded = False
         self.gt_wedged = False
         self.gt_partitioned = False
+        self.gt_asym = False     # severed from the apiserver ONLY
         self.gt_preempting = False
         self.gt_alive = True
         self.publish_pending = False
@@ -384,17 +410,36 @@ class SimHost:
         self.slo_folds = []      # (fold t, slo stage, ms)
         self.slo_sketches = {}   # slo stage -> agglib.Sketch
         self.slo_tick_live = False
+        # The harness mirrors every fold for the fleet-vs-harness
+        # checkpoint cross-check (run_sim wires this): stretched-ack
+        # folds originate HERE, not in a closed chain, so the mirror
+        # must hang off the fold itself.
+        self.on_fold = None      # callable(now, stage_ms) or None
+        # Snapshot of slo_folds as of the last store-applied publish
+        # (ClusterApiServer.daemon_apply captures it): the exact-value
+        # twin of the serialized annotation the fleet merge consumed.
+        self.published_slo_folds = []
 
-    def reachable(self):
-        """Can this daemon talk to the apiserver / blackboard at all?
+    def api_reachable(self):
+        """Can this daemon talk to the apiserver / blackboard?
         (A brownout is NOT unreachability: server-alive pacing.)"""
+        return self.gt_alive and not self.gt_wedged and \
+            not self.gt_partitioned and not self.gt_asym
+
+    def peer_reachable(self):
+        """Can this daemon's PEERS reach its introspection endpoint?
+        An asymmetric partition (gt_asym) severs only the apiserver
+        path — peers still fetch its live report and relay it (ISSUE
+        19), so the slice verdict keeps counting it healthy."""
         return self.gt_alive and not self.gt_wedged and \
             not self.gt_partitioned
 
     def gt_bad(self):
         """Is the HARDWARE unusable for a job right now? (A dead daemon
         with healthy chips is not bad hardware — leader-kill drills the
-        label layer, not the silicon.)"""
+        label layer, not the silicon. Likewise an asym-partitioned
+        member: its chips are fine and its labels keep flowing via the
+        leader's hedged publish.)"""
         return (self.gt_degraded or self.gt_wedged or
                 self.gt_partitioned or self.gt_preempting)
 
@@ -436,16 +481,26 @@ class SimHost:
     def mark_dirty(self, t):
         """Something this daemon publishes changed: render + write after
         a short detection/render latency. Coalesces like the real
-        pass loop — one in-flight publish at a time."""
-        if not self.reachable() or self.publish_pending:
+        pass loop — one in-flight publish at a time. An asym-severed
+        member cannot write itself, but its peers still see it: the
+        slice leader proxies the publish (ISSUE 19 write hedging)."""
+        if self.publish_pending:
+            return
+        if not self.api_reachable():
+            if self.peer_reachable():
+                self.slice.hedge_publish(t, self)
             return
         self.publish_pending = True
-        self.clock.schedule(t + self.rng.uniform(0.1, 0.5),
+        self.clock.schedule(t + self.rng.uniform(0.05, 0.2),
                             lambda now: self._publish(now))
 
-    def _publish(self, now, stretched=False):
-        if not self.reachable():
-            self.publish_pending = False  # re-marked on heal
+    def _publish(self, now):
+        if not self.publish_pending:
+            return  # a hedge landed this and handed the slot back
+        if not self.api_reachable():
+            self.publish_pending = False  # re-marked on heal (or hedged)
+            if self.peer_reachable():
+                self.slice.hedge_publish(now, self)
             return
         # First attempt closes the "hold" stage for every open slice
         # change (render/coalesce is done); a brownout deferral from
@@ -453,25 +508,31 @@ class SimHost:
         # from moving the mark.
         for m in self.slice.members:
             self.tracker.stamp_node(m.name, "hold", now)
-        if self.server.brownout_active(now):
-            # Server-directed pacing: retry, keep the pending slot so
-            # later dirtying events ride this retry.
+        if self.server.brownout_active(now) and \
+                self.rng.random() >= BROWNOUT_ACCEPT_P:
+            # Server-directed shedding: this attempt drew Retry-After.
+            # Retry at the server's pacing cadence, keep the pending
+            # slot so later dirtying events ride this retry. The slice
+            # verdict still converges through whichever racing member
+            # draws an admit first (placeability is worst-of-members).
             self.server.brownout_rejected += 1
-            self.clock.schedule(now + self.rng.uniform(0.6, 1.4),
+            self.clock.schedule(now + self.rng.uniform(0.2, 0.35),
                                 lambda t: self._publish(t))
             return
-        if not stretched and self.server.slowdown_active(now):
-            # The latency-regression drill: this write lands ~delay_s
-            # late, exactly once (a stretched tail, not a retry storm).
-            # The hold stamp above already closed, so the whole stretch
-            # is "publish" time — the duration the SLO engine must
-            # catch burning.
+        if self.server.slowdown_active(now):
+            # The latency-regression drill: the write itself lands, but
+            # its ACK comes back ~delay_s late — a tail-latency
+            # regression on the write path, not an outage. The daemon's
+            # SLO sketches fold the OBSERVED attempt->ack duration when
+            # the ack arrives; the label flow itself is not delayed
+            # (watch fan-out fires on the store apply, not the ack).
+            stretch = self.server.slowdown_delay_s * \
+                self.rng.uniform(0.8, 1.2)
             self.server.slowdown_stretched += 1
             self.clock.schedule(
-                now + self.server.slowdown_delay_s *
-                self.rng.uniform(0.8, 1.2),
-                lambda t: self._publish(t, stretched=True))
-            return
+                now + stretch,
+                lambda t, ms=stretch * 1000.0: self.fold_slo(
+                    t, {"publish": ms, "publish-acked": ms}))
         self.publish_pending = False
         self.server.daemon_apply(now, self.name, self.desired_labels())
 
@@ -485,6 +546,8 @@ class SimHost:
             self.slo_folds.append((now, stage, stage_ms[stage]))
             self.slo_sketches.setdefault(
                 stage, agglib.Sketch()).add(stage_ms[stage])
+        if self.on_fold is not None:
+            self.on_fold(now, stage_ms)
         self.mark_dirty(now)
         if not self.slo_tick_live:
             self.slo_tick_live = True
@@ -518,9 +581,11 @@ class SimHost:
 
     def probe_detect(self, t):
         """A ground-truth change this daemon can SELF-detect (perf skew,
-        preemption notice): lands at the next probe round, then reports
-        to the slice leader and republishes."""
-        delay = self.rng.uniform(0.2, PROBE_INTERVAL_S)
+        preemption notice): rides the device-event/lifecycle fast path
+        (a watch on the metadata server + the PJRT health callback),
+        so it lands well inside the probe round, then reports to the
+        slice leader and republishes."""
+        delay = self.rng.uniform(0.1, 0.55 * PROBE_INTERVAL_S)
         self.clock.schedule(t + delay, self._detected)
 
     def _detected(self, now):
@@ -535,8 +600,11 @@ class SimSlice:
     """Per-slice coordination: a lease-elected leader merges member
     reports into the adopted verdict; every live member republishes the
     agreed labels. Mirrors the PR 9/12 protocol shape (agreement
-    timeout for stale reports, lease-expiry failover, preempting member
-    -> proactive degraded) at simulation fidelity."""
+    timeout for stale reports, lease failover, preempting member ->
+    proactive degraded) plus the ISSUE 19 partition-tolerance upgrades
+    (peer report relay with confirmed-stale exclusion, pre-declared
+    succession at the first missed renewal, hedged publishes) at
+    simulation fidelity."""
 
     def __init__(self, server, clock, rng, idx, host_count, tracker):
         self.server = server
@@ -550,6 +618,9 @@ class SimSlice:
         self.leader_idx = 0
         self.failover_pending = False
         self.leader_transitions = 0
+        self.relayed_reports = 0
+        self.successions = 0
+        self.hedged_publishes = 0
         self.adopted_verdict = self._compute_verdict()
 
     def leader(self):
@@ -560,8 +631,14 @@ class SimSlice:
         worst_rank = 99
         worst = "gold"
         for m in self.members:
-            if not m.reachable():
+            # Peer-reachable is what the MERGED view sees: a member
+            # severed only from the apiserver still counts, because a
+            # peer relays its live report onto the blackboard
+            # (--slice-relay). Only a member no peer can reach ages out.
+            if not m.peer_reachable():
                 continue
+            if not m.api_reachable():
+                self.relayed_reports += 1
             rank = clusterlib.CLASS_RANK.get(m.effective_class(), 0)
             if rank < worst_rank:
                 worst_rank, worst = rank, m.effective_class()
@@ -579,48 +656,99 @@ class SimSlice:
     def on_report(self, t, _member):
         """A fresh member report landed on the blackboard: the leader
         folds it on its next coordination tick."""
-        self.clock.schedule(t + self.rng.uniform(0.1, 0.5),
+        self.clock.schedule(t + self.rng.uniform(0.1, 0.3),
                             lambda now: self.recompute(now))
 
     def on_member_unreachable(self, t):
         """A member stopped refreshing its report (wedge / partition /
-        death): the leader notices when the report ages past the
-        agreement timeout."""
-        def aged(now):
-            # Report ageing IS the detection for a member that cannot
-            # self-report: the "detect" stage of a wedge/partition
-            # chain ends here (the agreement timeout is its budget).
+        death): its report goes stale at agreement/2, a peer's direct
+        probe FAILS, and the confirmed-stale exclusion drops it from
+        the merge now (ISSUE 19) — no waiting out the full ageing
+        window. Fresh-reported members are never probed."""
+        def confirmed(now):
+            # The failed relay probe IS the detection for a member that
+            # cannot self-report: the "detect" stage of a
+            # wedge/partition chain ends here (stale-after + one probe
+            # is its budget).
             for m in self.members:
-                if not m.reachable():
+                if not m.peer_reachable():
                     self.tracker.stamp_node(m.name, "detect", now)
             self.recompute(now)
         self.clock.schedule(
-            t + AGREEMENT_S + self.rng.uniform(0.1, 0.5), aged)
-        if not self.leader().reachable():
+            t + RELAY_CONFIRM_S + self.rng.uniform(0.05, 0.18),
+            confirmed)
+        if not self.leader().api_reachable():
             self._schedule_failover(t)
 
     def _schedule_failover(self, t):
+        """Pre-declared succession (ISSUE 19): the adopted verdict
+        already names the successor line, so the first-listed live
+        follower promotes at the first MISSED RENEWAL TICK
+        (SUCCESSION_S), epoch-fenced like any acquisition — full lease
+        expiry stays the backstop only when no successor survives."""
         if self.failover_pending:
             return
         self.failover_pending = True
-        self.clock.schedule(t + LEASE_S, lambda now: self._failover(now))
+        self.clock.schedule(
+            t + SUCCESSION_S + self.rng.uniform(0.02, 0.12),
+            lambda now: self._failover(now))
 
     def _failover(self, now):
         self.failover_pending = False
-        if self.leader().reachable():
+        if self.leader().api_reachable():
             return  # old leader healed inside its lease: no transition
         for idx, m in enumerate(self.members):
-            if m.reachable():
+            if m.api_reachable():
                 self.leader_idx = idx
                 self.leader_transitions += 1
+                self.successions += 1
                 self.recompute(now)
                 return
-        # Nobody reachable (full-slice partition): the next heal's
+        # Nobody api-reachable (full-slice partition): the next heal's
         # report path re-triggers election via on_report/recompute.
         self._schedule_failover(now)
 
+    def hedge_publish(self, t, member):
+        """Write hedging (ISSUE 19): the leader proxies a severed
+        member's publish under the hedge field manager. Coalesces
+        newest-wins on the member's own pending slot — the same slot
+        its own pass loop uses, so on heal the member reclaims
+        ownership with no duplicate stream."""
+        leader = self.leader()
+        if leader is member or not leader.api_reachable():
+            return
+        if member.publish_pending:
+            return
+        member.publish_pending = True
+        self.clock.schedule(t + self.rng.uniform(0.1, 0.3),
+                            lambda now: self._hedge_land(now, member))
+
+    def _hedge_land(self, now, member):
+        if not member.publish_pending:
+            return
+        if member.api_reachable():
+            # Healed while the hedge was in flight: hand the slot back
+            # to the member's own pass loop (SSA ownership reclaim).
+            member.publish_pending = False
+            member.mark_dirty(now)
+            return
+        leader = self.leader()
+        if leader is member or not leader.api_reachable():
+            member.publish_pending = False
+            return
+        if self.server.brownout_active(now) and \
+                self.rng.random() >= BROWNOUT_ACCEPT_P:
+            self.server.brownout_rejected += 1
+            self.clock.schedule(now + self.rng.uniform(0.2, 0.35),
+                                lambda t: self._hedge_land(t, member))
+            return
+        member.publish_pending = False
+        self.hedged_publishes += 1
+        self.server.daemon_apply(now, member.name,
+                                 member.desired_labels())
+
     def recompute(self, now):
-        if not self.leader().reachable():
+        if not self.leader().api_reachable():
             self._schedule_failover(now)
             return
         verdict = self._compute_verdict()
@@ -629,14 +757,15 @@ class SimSlice:
         self.adopted_verdict = verdict
         # The adopted verdict now reflects every open change on this
         # slice's members: the "agree" stage ends (for a leader-death
-        # window this lands after the lease-expiry failover, which is
-        # exactly the budget the partition class pays).
+        # window this lands after the missed-renewal succession, which
+        # is exactly the budget the partition class pays).
         for m in self.members:
             self.tracker.stamp_node(m.name, "agree", now)
         # Every live member republishes the agreed labels (small skew:
-        # the members' own pass loops).
+        # the members' own pass loops); an asym-severed member's copy
+        # routes through the leader's hedge inside mark_dirty.
         for m in self.members:
-            if m.reachable():
+            if m.peer_reachable():
                 m.mark_dirty(now + self.rng.uniform(0.0, 0.3))
 
 
@@ -655,6 +784,14 @@ def default_schedule_text(slices, hosts):
 # phase A — one drill per failure class, serialized
 20   degrade        s0/h1
 30   heal           s0/h1
+# the ISSUE 19 asym drill: s6/h1 loses the apiserver but not its
+# peers; the degrade on s6/h3 inside the window forces a verdict
+# change the leader must HEDGE onto s6/h1's labels. The assertion is
+# the non-event: no flap, no spurious demotion of s6/h1.
+21   asym-partition s6/h1
+23   degrade        s6/h3
+27   heal           s6/h3
+31   asym-heal      s6/h1
 24   preempt        s1/h2
 34   preempt-clear  s1/h2
 28   wedge          s2/h0
@@ -703,6 +840,8 @@ def quick_schedule_text(slices, hosts):
     return """\
 10 degrade        s0/h1
 18 heal           s0/h1
+11 asym-partition s0/h2
+22 asym-heal      s0/h2
 12 wedge          s1/h0
 22 unwedge        s1/h0
 14 preempt        s2/h1
@@ -862,10 +1001,11 @@ class Harness:
                         closed["stages"])
                     host = self.hosts.get(node)
                     if host is not None:
+                        # The host's on_fold hook mirrors the fold into
+                        # self.slo_folds — one shared path with the
+                        # stretched-ack folds, so the fleet-vs-harness
+                        # checkpoint counts stay exactly equal.
                         host.fold_slo(now, stage_ms)
-                    for stage in sorted(stage_ms):
-                        self.slo_folds.append(
-                            (now, stage, stage_ms[stage]))
         for node in sorted(self.up_track):
             if self.sched.placeable(node, blocked):
                 t0, op = self.up_track.pop(node)
@@ -1078,12 +1218,8 @@ class Harness:
         if server.brownout_active(now):
             until = max(until,
                         server.brownout_until + BROWNOUT_GRACE_S)
-        if server.slowdown_active(now):
-            # A stretched publish adds ~delay_s to the pipeline; 1.5x
-            # covers the stretch jitter.
-            until = max(until, now + window +
-                        server.slowdown_delay_s * 1.5 +
-                        BROWNOUT_GRACE_S)
+        # A slowdown stretches publish ACKS, not the writes themselves
+        # (the label flow rides the store apply): no window extension.
         self.excused_until[node] = until
         self.down_track[node] = (now, op)
         self.active_fail_ops.setdefault(node, set()).add(op)
@@ -1119,22 +1255,18 @@ class Harness:
                 self.excused_until[node] = max(
                     until, brownout_until + BROWNOUT_GRACE_S)
 
-    def extend_windows_for_slowdown(self, now, delay_s):
-        """A slowdown stretches every in-flight publish by ~delay_s:
-        every open convergence window pays the same stretch."""
-        for node, until in sorted(self.excused_until.items()):
-            if until > now:
-                self.excused_until[node] = \
-                    until + delay_s * 1.5 + BROWNOUT_GRACE_S
-
     def slo_checkpoint_snap(self, now, aggregator):
         """One deterministic mid-soak snapshot, taken after the
         regression drill's chains have closed and published but before
         their folds retire: the merged fleet sketches (what the
         aggregator would label) next to the harness's exact values for
-        the same window, quantiled with the sketch's own nearest-rank
+        the same folds, quantiled with the sketch's own nearest-rank
         rule so the only divergence left is bucketing error (gamma
-        1.1) — the cross-check bench_gate --slo enforces."""
+        1.1) — the cross-check bench_gate --slo enforces. The exact
+        side mirrors each host's LAST-PUBLISHED residency (what the
+        merged annotation actually contained), not a recomputed time
+        window — retire-vs-checkpoint boundary races would otherwise
+        shift a fold across the window edge on one side only."""
         fleet = {}
         for stage in sorted(aggregator.store.stage):
             sketch = aggregator.store.stage[stage]
@@ -1144,10 +1276,9 @@ class Harness:
                     "p50_ms": round(sketch.quantile(0.50), 3),
                     "p99_ms": round(sketch.quantile(0.99), 3),
                 }
-        cutoff = now - SLO_WINDOW_S
         by_stage = {}
-        for t, stage, ms in self.slo_folds:
-            if t > cutoff:
+        for name in sorted(self.hosts):
+            for _t, stage, ms in self.hosts[name].published_slo_folds:
                 by_stage.setdefault(stage, []).append(ms)
         harness = {}
         for stage in sorted(by_stage):
@@ -1173,7 +1304,6 @@ def apply_event(ev, now, server, slices, harness):
     if ev.op == "slowdown":
         delay = float(ev.args.get("delay", "3"))
         server.slowdown(now, float(ev.args.get("secs", "10")), delay)
-        harness.extend_windows_for_slowdown(now, delay)
         return
     sl = slices[ev.slice_idx]
     if ev.op in clusterlib.HOST_OPS:
@@ -1201,6 +1331,16 @@ def apply_event(ev, now, server, slices, harness):
         elif ev.op == "unwedge":
             host.gt_wedged = False
             harness.note_up(now, host.name, "wedge")
+            host.probe_detect(now)
+        elif ev.op == "asym-partition":
+            # Severed from the apiserver, still reachable by peers: the
+            # assertion is the NON-event — no note_down, no verdict
+            # degrade, no eviction. Peer relay keeps the member in the
+            # merge and the leader hedges its publishes; a placement
+            # onto it stays CORRECT (the hardware is fine).
+            host.gt_asym = True
+        elif ev.op == "asym-heal":
+            host.gt_asym = False
             host.probe_detect(now)
         return
     if ev.op == "leader-kill":
@@ -1242,6 +1382,9 @@ def run_sim(args, schedule_text):
     sched = clusterlib.SimScheduler()
     harness = Harness(clock, rng, sched, hosts_by_name,
                       arrival_dt=1.0 / args.job_rate, tracker=tracker)
+    for host in hosts_by_name.values():
+        host.on_fold = lambda now, stage_ms: harness.slo_folds.extend(
+            (now, stage, stage_ms[stage]) for stage in sorted(stage_ms))
     aggregator = ClusterAggregator(
         server, clock, AGG_DEBOUNCE_S, AGG_LEASE_S,
         deliver=harness.on_inventory, tracker=tracker)
@@ -1415,6 +1558,14 @@ def run_sim(args, schedule_text):
         "final_unplaceable_nodes": len(unplaceable),
         "final_queue_len": len(harness.queue),
         "leader_transitions": sum(sl.leader_transitions for sl in slices),
+        # Partition-tolerant fast convergence (ISSUE 19): each protocol
+        # upgrade must actually FIRE during the soak — bench_gate
+        # --cluster requires all three non-zero on the committed record.
+        "slice_relayed_reports": sum(sl.relayed_reports
+                                     for sl in slices),
+        "slice_successions": sum(sl.successions for sl in slices),
+        "slice_hedged_publishes": sum(sl.hedged_publishes
+                                      for sl in slices),
         "by_verb": {k: server.by_verb[k]
                     for k in sorted(server.by_verb)},
         # Fleet SLO engine (ISSUE 16): the burn verdict trail, the
@@ -1500,6 +1651,18 @@ def check_record(record):
     if record["inventory_updates_consumed"] == 0:
         problems.append("the scheduler never consumed an inventory "
                         "rollup (the aggregator is not composed in)")
+    asym_scheduled = record["schedule_events"].get("asym-partition", 0)
+    if asym_scheduled:
+        for key in ("slice_relayed_reports", "slice_hedged_publishes"):
+            if not record.get(key):
+                problems.append(
+                    f"an asym-partition was scheduled but {key} is "
+                    "zero — the ISSUE 19 relay/hedge path never fired")
+    if record["schedule_events"].get("partition", 0) and \
+            not record.get("slice_successions"):
+        problems.append(
+            "a leader-covering partition was scheduled but no "
+            "pre-declared succession ever promoted a follower")
     changes = record["change_ids"]
     if changes["active_at_end"] != 0:
         problems.append(

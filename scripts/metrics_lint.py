@@ -140,6 +140,13 @@ CONDITIONAL = {
     # Rejoin hysteresis (ISSUE 11 satellite): fires only when a
     # departed member rejoins a coordinated slice.
     "tfd_slice_rejoin_dwells_total",
+    # Partition-tolerant fast convergence (ISSUE 19): fire only on live
+    # coordination events — a stale peer answering a direct probe
+    # (relay), a missed-renewal promotion (succession), and a leader
+    # proxy-publishing for a relay-only member (hedge, CR sink only).
+    "tfd_slice_relayed_reports_total",
+    "tfd_slice_successions_total",
+    "tfd_slice_hedged_publishes_total",
     # Probe-plugin SDK (ISSUE 11): config-gated behind --plugin-dir
     # (empty on this hermetic boot); failures/violations/kills
     # additionally need a misbehaving plugin.

@@ -27,11 +27,50 @@ schedule:
                                                           proactive 3/4
                                                           degraded verdict
   preempt-clear     notice cleared                     -> 4/4
-  partition         refuse one member's apiserver      -> member drops
-                                                          tpu.slice.*
-                                                          (self-demotes),
-                                                          peers 3/4
-  heal              restore the listener               -> rejoin, 4/4
+  partition         refuse one member's apiserver AND  -> peers probe its
+                    freeze the process (SIGSTOP): a       introspection,
+                    FULL partition, nothing of the        get no answer,
+                    member is reachable                   confirm it stale
+                                                          and degrade 3/4
+                                                          AHEAD of the
+                                                          ageing window
+  heal              SIGCONT + restore the listener     -> rejoin, 4/4
+  asym-partition    refuse one member's apiserver but  -> peers RELAY its
+                    leave the process running: the        live report onto
+                    asymmetric partition (member          the blackboard
+                    reaches peers, not the apiserver)     (slice-relay):
+                                                          the slice NEVER
+                                                          degrades; the
+                                                          member itself
+                                                          self-demotes
+                                                          (slice-orphaned)
+  asym-degrade      preempt-notice a THIRD member      -> verdict moves to
+                    while the victim is still severed     3/4 everywhere;
+                                                          cr sink: the
+                                                          leader HEDGES
+                                                          the verdict onto
+                                                          the severed
+                                                          member's CR
+                                                          (slice-hedge)
+  asym-recover      notice cleared                     -> back to 4/4
+  asym-heal         restore the victim's listener      -> instant rejoin
+                                                          (relay kept it
+                                                          continuously
+                                                          present: no
+                                                          rejoin dwell);
+                                                          cr sink: its own
+                                                          apply reclaims
+                                                          the hedged keys
+  brownout-         throttle the apiserver below the   -> the FIRST listed
+  succession        fleet's offered load (429s), then     successor takes
+                    SIGKILL the lease holder              the lease at the
+                                                          first missed
+                                                          renewal tick
+                                                          (slice-
+                                                          succession),
+                                                          ahead of lease
+                                                          expiry
+  brownout-clear    lift the throttle + restart       -> 4/4
   kill9-leader      kill -9 the leader + instant       -> lease resumed
                     restart (same state file)             from the state
                                                           file: NO epoch
@@ -49,8 +88,15 @@ Invariants asserted at every step:
   - ZERO "interleaved disagreement" samples: outside a step's
     convergence window, no sample may show two live hosts publishing
     different slice labels;
-  - the partitioned member drops its slice labels entirely (never a
-    stale slice view) and journals slice-orphaned;
+  - the asymmetrically partitioned member drops its slice labels
+    entirely (never a stale slice view) and journals slice-orphaned,
+    while the slice itself NEVER degrades (peer report relay keeps it
+    counted) — and with the cr sink the leader hedges verdict changes
+    onto its CR so the scheduler's view never goes stale either;
+  - the fully partitioned member is confirmed-stale by a failed peer
+    probe and excluded ahead of the agreement-timeout ageing window;
+  - the lease moves by pre-declared succession (slice-succession, at
+    the first missed renewal tick) when the holder dies mid-brownout;
   - the kill -9'd leader resumes its lease epoch from the state file.
 
 `--json FILE` writes the bench record bench_gate.py --slice gates
@@ -60,9 +106,11 @@ against the committed BENCH_r10.json.
 the NodeFeature-CR sink (watch + server-side apply against the fake
 apiserver) instead of the label file — coherence is then sampled from
 the CR store, the bytes a scheduler actually sees. Sole expected delta:
-a partitioned member cannot write its self-demotion (the partition
-severs the sink too), so the store holds its last-agreed labels until
-heal; the demotion is still asserted via the slice-orphaned journal.
+a severed member cannot write its self-demotion (the partition severs
+the sink too) — the demotion is asserted via the slice-orphaned
+journal, and under the ASYMMETRIC partition the leader's hedged
+publishes (--sink-hedge, field manager tfd-hedge) keep its CR on the
+agreed verdict instead of letting it go stale.
 
 Usage:
   python3 scripts/slice_soak.py [--hosts 4] [--seed 10] [--json out.json]
@@ -193,7 +241,15 @@ class Member:
         if self.proc is None:
             return
         self.proc.send_signal(sig)
-        self.proc.wait(timeout=10)
+        try:
+            self.proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            # A SIGSTOPped member (the full-partition drill) ignores
+            # SIGTERM until resumed; don't let a failed drill leave a
+            # frozen orphan holding the log pipe open.
+            self.proc.send_signal(signal.SIGCONT)
+            self.proc.kill()
+            self.proc.wait(timeout=10)
         self.proc = None
 
     def alive(self):
@@ -228,6 +284,25 @@ class Member:
         except Exception:
             return []
 
+    def metric(self, name):
+        """Reads one counter off this member's /metrics exposition
+        (0.0 when absent or unreachable)."""
+        import urllib.request
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{self.port}/metrics",
+                    timeout=2) as r:
+                text = r.read().decode()
+        except Exception:
+            return 0.0
+        for line in text.splitlines():
+            if line.startswith(name) and not line.startswith("#"):
+                try:
+                    return float(line.rsplit(None, 1)[-1])
+                except ValueError:
+                    return 0.0
+        return 0.0
+
 
 def expected_labels(sanitized_id, hosts, healthy):
     verdict = {"hosts": hosts, "healthy_hosts": healthy,
@@ -244,6 +319,16 @@ class Soak:
         self.steps = []
         self.interleaved = 0
         self.samples = 0
+        # High-water marks for the partition-tolerance counters,
+        # captured at the drill that asserted them: a member restarted
+        # by a LATER drill (brownout kill, kill -9) boots with zeroed
+        # in-process counters, so the end-of-run sum alone can
+        # under-count a path that demonstrably fired.
+        self.counter_floors = {}
+
+    def note_counter(self, name, value):
+        if value > self.counter_floors.get(name, 0):
+            self.counter_floors[name] = value
 
     def sample_all(self, members):
         """One coherence sample across the live members; returns
@@ -272,6 +357,34 @@ class Soak:
                 if phase:
                     print(f"    DISAGREE[{phase}]: {sets}")
             time.sleep(0.1)
+
+    def settle(self, name, members, want, quiet_s, budget_s):
+        """A FATAL quiet gate between drills: every live member must
+        hold `want` continuously for quiet_s before the next drill
+        starts. converge() can be satisfied by a frozen member's stale
+        pre-freeze bytes (its file cannot change) or by a fleet that
+        touches the target mid-churn — either way the next drill would
+        begin over residual turbulence (rejoins still settling, lease
+        churn) and its asserts would blame the wrong protocol."""
+        deadline = time.monotonic() + budget_s
+        quiet_since = None
+        while True:
+            sample = self.sample_all(members)
+            self.samples += 1
+            ok = all(sample.get(m.index) == want
+                     for m in members if m.alive())
+            now = time.monotonic()
+            if ok:
+                if quiet_since is None:
+                    quiet_since = now
+                if now - quiet_since >= quiet_s:
+                    return
+            else:
+                quiet_since = None
+            require(now < deadline,
+                    f"settle {name}: fleet never quiet for {quiet_s}s "
+                    f"within {budget_s}s (sample {sample})")
+            time.sleep(0.05)
 
     def converge(self, name, members, want, budget_s, extra_check=None,
                  enforce_window=True):
@@ -434,12 +547,15 @@ def run_soak(hosts, seed, tmp, sink_mode="file"):
                           expected_labels(sid, hosts, hosts),
                           budget_s=2 * AGREEMENT_S + 25,
                           enforce_window=False)
-            lease = lease_of(server)
-            dwell_leader = next(m for m in members
-                                if m.node == lease["holder"])
-            require("slice-rejoin-dwell" in dwell_leader.journal_types(),
-                    "leader never journaled slice-rejoin-dwell for the "
-                    "crash-looping member")
+            # Whichever member held the lease when the crash-looper
+            # rejoined journaled the dwell — leadership may have moved
+            # since (succession promotes at a missed renewal, and a
+            # leader tick stalled on a probe of the mid-restart member
+            # can miss one), so scan every live member's journal.
+            require(any("slice-rejoin-dwell" in m.journal_types()
+                        for m in members if m.alive()),
+                    "no member ever journaled slice-rejoin-dwell for "
+                    "the crash-looping member")
             soak.watch_steady(members, 2, phase="w3b")
 
             # 2. Kill the leader: lease failover (epoch bump) + the
@@ -520,37 +636,219 @@ def run_soak(hosts, seed, tmp, sink_mode="file"):
                           budget_s=AGREEMENT_S + 6 * INTERVAL_S + 3,
                           enforce_window=False)
             soak.watch_steady(members, 2, phase="w7c")
+            soak.settle("pre-partition", members,
+                        expected_labels(sid, hosts, hosts),
+                        quiet_s=2, budget_s=LEASE_S + 10)
 
-            # 4. Partition one member from the apiserver: it must
-            # SELF-DEMOTE (drop tpu.slice.* entirely — never a stale
-            # slice view) while the peers degrade the slice.
+            # 4. FULL partition: nothing of the victim is reachable —
+            # the apiserver listener refuses AND the process is frozen
+            # (SIGSTOP), so the peers' relay probes of its
+            # introspection port time out. Confirm-or-relay
+            # (--slice-relay) turns that failed probe into a
+            # confirmed-stale exclusion AHEAD of the agreement-timeout
+            # ageing window: the budget here is tightened below the
+            # pre-relay LEASE_S+AGREEMENT_S bound — reduced in source,
+            # not waived. The frozen victim's sink holds its pre-freeze
+            # bytes (it cannot demote while stopped); the asymmetric
+            # drill below owns the self-demotion assertion.
             lease = lease_of(server)
             victim = next(m for m in members
                           if m.node != lease["holder"])
             listeners[victim.index].stop()
-            # File sink: the victim's self-demotion (drop tpu.slice.*)
-            # is visible in its label file. CR sink: the victim CANNOT
-            # write its demotion — the partition severs the sink too —
-            # so the store legitimately holds its LAST-AGREED labels
-            # until heal (the documented partition tradeoff); the
-            # demotion itself is still asserted via the slice-orphaned
-            # journal below, read over local introspection.
-            victim_want = ({} if sink_mode == "file"
-                           else expected_labels(sid, hosts, hosts))
+            victim.proc.send_signal(signal.SIGSTOP)
+            frozen_labels = expected_labels(sid, hosts, hosts)
             want = {m.index: (expected_labels(sid, hosts, hosts - 1)
-                              if m is not victim else victim_want)
+                              if m is not victim else frozen_labels)
                     for m in members}
             soak.converge("partition", members, want,
-                          budget_s=LEASE_S + AGREEMENT_S +
-                          4 * INTERVAL_S + 3)
-            require("slice-orphaned" in victim.journal_types(),
-                    "partitioned member never journaled slice-orphaned")
-            soak.watch_steady([m for m in members if m is not victim], 2, phase="w8")
+                          budget_s=AGREEMENT_S + 4 * INTERVAL_S + 2)
+            soak.watch_steady([m for m in members if m is not victim],
+                              2, phase="w8")
+            victim.proc.send_signal(signal.SIGCONT)
             listeners[victim.index].start()
             soak.converge("heal", members,
                           expected_labels(sid, hosts, hosts),
                           budget_s=LEASE_S + 15, enforce_window=False)
             soak.watch_steady(members, 2, phase="w9")
+            # heal's converge is satisfiable by the victim's pre-freeze
+            # bytes alone (a frozen file still reads 4/4); the asym
+            # drill's "never degrades" assert needs the victim actually
+            # caught up and the lease churn drained first.
+            soak.settle("post-heal", members,
+                        expected_labels(sid, hosts, hosts),
+                        quiet_s=2, budget_s=LEASE_S + 15)
+
+            # 4b. ASYMMETRIC partition (the ISSUE 19 tentpole): the
+            # victim reaches its peers but not the apiserver. Its
+            # blackboard report goes stale, a peer probes its
+            # introspection addr, gets the live report, and RELAYS it
+            # onto the blackboard — the slice must NOT degrade: the
+            # relabeling non-event is the acceptance. The victim
+            # itself, cut off from the blackboard, still self-demotes
+            # (agreed-or-absent is about ITS view, which it cannot
+            # refresh).
+            lease = lease_of(server)
+            victim = next(m for m in members
+                          if m.node != lease["holder"])
+            survivors = [m for m in members if m is not victim]
+            listeners[victim.index].stop()
+            relayer = None
+            relay_deadline = time.monotonic() + AGREEMENT_S + 6
+            while time.monotonic() < relay_deadline and relayer is None:
+                for index, labels in soak.sample_all(survivors).items():
+                    if labels:
+                        require(
+                            labels[slicecoord.SLICE_HEALTHY_HOSTS]
+                            == str(hosts),
+                            f"slice degraded under an ASYMMETRIC "
+                            f"partition (host {index} published "
+                            f"{labels}); the relay should have kept "
+                            f"the severed member counted")
+                soak.samples += 1
+                relayer = next((m for m in survivors
+                                if "slice-relay" in m.journal_types()),
+                               None)
+                time.sleep(0.1)
+            require(relayer is not None,
+                    "no peer ever journaled slice-relay for the "
+                    "asymmetrically partitioned member")
+            relayed_now = relayer.metric("tfd_slice_relayed_reports_total")
+            require(relayed_now > 0,
+                    "slice-relay journaled but the relayed-reports "
+                    "counter never moved")
+            soak.note_counter("slice_relayed_reports", relayed_now)
+            # The victim's self-demotion: visible in its label file
+            # (file sink), or via journal only (cr sink — it cannot
+            # write, and the leader's hedge keeps its CR on the agreed
+            # verdict rather than letting it go stale).
+            victim_want = ({} if sink_mode == "file"
+                           else expected_labels(sid, hosts, hosts))
+            want = {m.index: (expected_labels(sid, hosts, hosts)
+                              if m is not victim else victim_want)
+                    for m in members}
+            soak.converge("asym-partition", members, want,
+                          budget_s=LEASE_S + 4 * INTERVAL_S + 3)
+            orphan_deadline = time.monotonic() + LEASE_S + 5
+            while (time.monotonic() < orphan_deadline
+                   and "slice-orphaned" not in victim.journal_types()):
+                time.sleep(0.1)
+            require("slice-orphaned" in victim.journal_types(),
+                    "asymmetrically partitioned member never journaled "
+                    "slice-orphaned")
+
+            # 4c. The verdict MOVES while the victim is severed: a
+            # third member gets a preemption notice. Every reachable
+            # member relabels 3/4 — and with the CR sink the leader
+            # HEDGES the new verdict onto the severed member's CR
+            # under the tfd-hedge field manager, so the scheduler's
+            # view of the victim never goes stale.
+            lease = lease_of(server)
+            doomed2 = next(m for m in members
+                           if m.node != lease["holder"]
+                           and m is not victim)
+            metas[doomed2.index].set_data(
+                tpu_vm(accelerator_type="v5litepod-16",
+                       worker_id=doomed2.index, preemptible=True,
+                       preempted=True))
+            degraded = expected_labels(sid, hosts, hosts - 1)
+            victim_want = {} if sink_mode == "file" else degraded
+            want = {m.index: (degraded if m is not victim
+                              else victim_want)
+                    for m in members}
+            soak.converge("asym-degrade", members, want,
+                          budget_s=AGREEMENT_S + 6 * INTERVAL_S + 3)
+            if sink_mode == "cr":
+                hedger = next((m for m in survivors
+                               if "slice-hedge" in m.journal_types()),
+                              None)
+                require(hedger is not None,
+                        "cr sink: no member journaled slice-hedge for "
+                        "the severed member's publish")
+                hedged_now = hedger.metric(
+                    "tfd_slice_hedged_publishes_total")
+                require(hedged_now > 0,
+                        "slice-hedge journaled but the hedged-publishes "
+                        "counter never moved")
+                soak.note_counter("slice_hedged_publishes", hedged_now)
+            metas[doomed2.index].set_data(
+                tpu_vm(accelerator_type="v5litepod-16",
+                       worker_id=doomed2.index, preemptible=True))
+            healthy = expected_labels(sid, hosts, hosts)
+            victim_want = {} if sink_mode == "file" else healthy
+            want = {m.index: (healthy if m is not victim
+                              else victim_want)
+                    for m in members}
+            soak.converge("asym-recover", members, want,
+                          budget_s=AGREEMENT_S + 6 * INTERVAL_S + 3,
+                          enforce_window=False)
+
+            # 4d. Heal the asymmetric partition: the relay kept the
+            # victim CONTINUOUSLY present in the leader's merge, so
+            # unlike a full partition there is no rejoin dwell — the
+            # victim re-owns its publish as soon as its blackboard
+            # contact returns.
+            listeners[victim.index].start()
+            soak.converge("asym-heal", members,
+                          expected_labels(sid, hosts, hosts),
+                          budget_s=LEASE_S + 10, enforce_window=False)
+            if sink_mode == "cr":
+                mgrs = server.field_managers(
+                    NS, f"tfd-features-for-{victim.node}")
+                require(not mgrs.get("tfd-hedge"),
+                        f"healed member never reclaimed its hedged "
+                        f"slice labels (tfd-hedge still owns "
+                        f"{sorted(mgrs.get('tfd-hedge', ()))})")
+            soak.watch_steady(members, 2, phase="w9b")
+            soak.settle("pre-brownout", members,
+                        expected_labels(sid, hosts, hosts),
+                        quiet_s=2, budget_s=LEASE_S + 10)
+
+            # 4e. Leader loss MID-BROWNOUT: cap the apiserver below the
+            # fleet's offered load (4 hosts x ~2 requests/s against a
+            # 7/s bucket guarantees 429s every second while all four
+            # live), then SIGKILL the holder. The verdict already
+            # names the line of succession, so the first listed live
+            # successor takes the lease at its first MISSED-RENEWAL
+            # tick — ahead of full lease expiry — and the survivors
+            # converge while still throttled (paced retries stagger
+            # publishes, so the disagreement window is measured, not
+            # enforced).
+            server.set_capacity(7)
+            time.sleep(2)  # let the throttle actually bite
+            lease = lease_of(server)
+            leader = next(m for m in members if m.node == lease["holder"])
+            survivors = [m for m in members if m is not leader]
+            succ_before = {
+                m.index: m.metric("tfd_slice_successions_total")
+                for m in survivors}
+            epoch_before = lease_of(server)["epoch"]
+            leader.kill(signal.SIGKILL)
+            soak.converge(
+                "brownout-succession", members,
+                expected_labels(sid, hosts, hosts - 1),
+                budget_s=LEASE_S + AGREEMENT_S + 4 * INTERVAL_S + 5,
+                extra_check=lambda: (lease_of(server) or {}).get(
+                    "epoch", 0) > epoch_before,
+                enforce_window=False)
+            new_holder = next(m for m in members
+                              if m.node == lease_of(server)["holder"])
+            require("slice-succession" in new_holder.journal_types(),
+                    "new holder never journaled slice-succession (the "
+                    "lease moved by expiry, not succession)")
+            succ_now = new_holder.metric("tfd_slice_successions_total")
+            require(succ_now > succ_before[new_holder.index],
+                    "slice-succession journaled but the successions "
+                    "counter never moved for the new holder")
+            soak.note_counter("slice_successions", succ_now)
+            server.set_capacity(0)
+            leader.start()
+            soak.converge("brownout-clear", members,
+                          expected_labels(sid, hosts, hosts),
+                          budget_s=20, enforce_window=False)
+            soak.watch_steady(members, 2, phase="w9c")
+            soak.settle("pre-kill9", members,
+                        expected_labels(sid, hosts, hosts),
+                        quiet_s=2, budget_s=LEASE_S + 10)
 
             # 5. kill -9 the leader and restart it IMMEDIATELY with the
             # same state file: the lease must be resumed (no epoch
@@ -592,6 +890,20 @@ def run_soak(hosts, seed, tmp, sink_mode="file"):
             record["orphan_self_demoted"] = True
             record["leader_failover_epoch_bump"] = True
             record["kill9_lease_resumed"] = True
+            record["asym_peers_never_degraded"] = True
+            record["succession_under_brownout"] = True
+            record["slice_relayed_reports"] = max(
+                sum(m.metric("tfd_slice_relayed_reports_total")
+                    for m in members),
+                soak.counter_floors.get("slice_relayed_reports", 0))
+            record["slice_successions"] = max(
+                sum(m.metric("tfd_slice_successions_total")
+                    for m in members),
+                soak.counter_floors.get("slice_successions", 0))
+            record["slice_hedged_publishes"] = max(
+                sum(m.metric("tfd_slice_hedged_publishes_total")
+                    for m in members),
+                soak.counter_floors.get("slice_hedged_publishes", 0))
             return record
         finally:
             for m in members:
@@ -614,6 +926,10 @@ def main(argv=None):
                          "label file (default) or the NodeFeature-CR "
                          "watch+SSA path (coherence then sampled from "
                          "the fake apiserver's CR store)")
+    ap.add_argument("--workdir", metavar="DIR",
+                    help="run in DIR and keep it (per-member daemon "
+                         "logs survive a failed drill for post-mortem); "
+                         "default is a throwaway temp dir")
     args = ap.parse_args(argv)
 
     if not BINARY.exists() or not FAKE_PJRT.exists():
@@ -621,8 +937,15 @@ def main(argv=None):
               "pytest conftest or cmake+ninja)", file=sys.stderr)
         return 2
 
+    import contextlib
     import tempfile
-    with tempfile.TemporaryDirectory(prefix="slice-soak-") as tmp:
+    if args.workdir:
+        workdir = Path(args.workdir)
+        workdir.mkdir(parents=True, exist_ok=True)
+        ctx = contextlib.nullcontext(str(workdir))
+    else:
+        ctx = tempfile.TemporaryDirectory(prefix="slice-soak-")
+    with ctx as tmp:
         try:
             record = run_soak(args.hosts, args.seed, Path(tmp),
                               sink_mode=args.sink)

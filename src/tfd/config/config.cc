@@ -652,6 +652,45 @@ std::vector<FlagDef> MakeFlagDefs(Flags* f) {
                   [f](const std::string& v) {
                     return SetDuration(&f->slice_rejoin_dwell_s, v);
                   }});
+  defs.push_back({"slice-relay",
+                  {"TFD_SLICE_RELAY"},
+                  "sliceRelay",
+                  "peer report relay: gossip a peer's fresh member-"
+                  "report onto the slice blackboard when its own copy "
+                  "goes stale but the peer still answers on its "
+                  "introspection addr — the leader's merged view "
+                  "survives a partial partition without waiting out "
+                  "the agreement-timeout ageing window",
+                  true,
+                  [f](const std::string& v) {
+                    return SetBool(&f->slice_relay, v);
+                  }});
+  defs.push_back({"slice-succession",
+                  {"TFD_SLICE_SUCCESSION"},
+                  "sliceSuccession",
+                  "pre-declared lease succession: the slice verdict "
+                  "names an ordered successor list and the first-listed "
+                  "live follower promotes at the first missed renewal "
+                  "tick (epoch-fenced, rv-preconditioned like the "
+                  "expiry acquisition) instead of waiting out full "
+                  "lease expiry",
+                  true,
+                  [f](const std::string& v) {
+                    return SetBool(&f->slice_succession, v);
+                  }});
+  defs.push_back({"sink-hedge",
+                  {"TFD_SINK_HEDGE"},
+                  "sinkHedge",
+                  "write hedging under brownout: the slice leader "
+                  "proxies the agreed tpu.slice.* labels onto a severed "
+                  "(relay-only) member's NodeFeature CR via server-side "
+                  "apply under the 'tfd-hedge' field manager, coalesced "
+                  "newest-wins; the member's own next apply reclaims "
+                  "ownership on heal",
+                  true,
+                  [f](const std::string& v) {
+                    return SetBool(&f->sink_hedge, v);
+                  }});
   defs.push_back({"plugin-dir",
                   {"TFD_PLUGIN_DIR"},
                   "pluginDir",
@@ -1371,6 +1410,9 @@ std::string ToJson(const Config& config) {
       << ",\"sliceAgreementTimeout\":\"" << f.slice_agreement_timeout_s
       << "s\""
       << ",\"sliceRejoinDwell\":\"" << f.slice_rejoin_dwell_s << "s\""
+      << ",\"sliceRelay\":" << (f.slice_relay ? "true" : "false")
+      << ",\"sliceSuccession\":" << (f.slice_succession ? "true" : "false")
+      << ",\"sinkHedge\":" << (f.sink_hedge ? "true" : "false")
       << ",\"pluginDir\":" << jstr(f.plugin_dir)
       << ",\"pluginTimeout\":\"" << f.plugin_timeout_s << "s\""
       << ",\"pluginInterval\":\"" << f.plugin_interval_s << "s\""

@@ -299,6 +299,24 @@ struct Flags {
   // restart — it must stay continuously present for the dwell to be
   // counted. 0 = auto: 2x the agreement timeout.
   int slice_rejoin_dwell_s = 0;
+  // Partition-tolerant fast convergence (ISSUE 19). All three default
+  // on; `=false` is the bisection escape hatch.
+  //
+  // Peer report relay: when a peer's blackboard report goes stale but
+  // its introspection endpoint still answers, gossip its fresh report
+  // onto the blackboard (marked relayed_by, origin stamp kept) so a
+  // partial partition never waits out the agreement-timeout ageing.
+  bool slice_relay = true;
+  // Pre-declared lease succession: the verdict carries the healthy
+  // members as an ordered successor list; the first-listed live
+  // successor promotes at the first missed renewal tick (epoch-fenced,
+  // rv-preconditioned) instead of waiting out full lease expiry.
+  bool slice_succession = true;
+  // Write hedging: the slice leader proxies the agreed tpu.slice.*
+  // labels onto a severed (relay-only) member's NodeFeature CR via SSA
+  // under the "tfd-hedge" field manager; the member's own next apply
+  // reclaims ownership on heal. Requires the CR sink.
+  bool sink_hedge = true;
   // Probe-plugin SDK (plugin/plugin.h): directory scanned at config
   // load for tfd.probe/v1 plugin executables; each accepted plugin
   // becomes a ProbeBroker source "plugin.<name>" with the full

@@ -870,6 +870,42 @@ Status PatchCoordConfigMap(const ClusterConfig& config,
                        patched->body.substr(0, 256));
 }
 
+Status HedgeNodeFeatureLabels(const ClusterConfig& config,
+                              const std::string& target_node,
+                              const lm::Labels& labels,
+                              bool* server_alive, WriteOutcome* outcome) {
+  WriteOutcome local_outcome;
+  if (outcome == nullptr) outcome = &local_outcome;
+  if (server_alive != nullptr) *server_alive = false;
+  // The target's CR, the target's nfd node-name label — only the field
+  // manager distinguishes this write from the member's own. The apply
+  // body carries JUST the hedged labels, so kHedgeFieldManager owns
+  // exactly those keys and nothing the member published itself.
+  ClusterConfig target = config;
+  target.node_name = target_node;
+  http::RequestOptions options = BaseOptions(target);
+  options.headers["Content-Type"] = "application/apply-patch+yaml";
+  std::string url = CrUrl(target, true) +
+                    "?fieldManager=" + kHedgeFieldManager + "&force=true";
+  Result<http::Response> applied = CountedRequest(
+      "k8s.patch", "PATCH", url, CrBody(target, labels), options, outcome);
+  outcome->applies++;
+  if (!applied.ok()) {
+    return Status::Error("hedging NodeFeature CR for " + target_node +
+                         ": " + applied.error());
+  }
+  if (server_alive != nullptr) *server_alive = true;
+  if (applied->status == 200 || applied->status == 201) {
+    TFD_LOG_INFO << "hedged NodeFeature CR " << CrName(target_node)
+                 << " (" << labels.size() << " labels, field manager "
+                 << kHedgeFieldManager << ")";
+    return Status::Ok();
+  }
+  return Status::Error("hedging NodeFeature CR for " + target_node +
+                       ": HTTP " + std::to_string(applied->status) + ": " +
+                       applied->body.substr(0, 256));
+}
+
 Status GetNodeDraining(const ClusterConfig& config, bool* draining,
                        bool* server_alive) {
   if (draining != nullptr) *draining = false;

@@ -200,6 +200,27 @@ Status PatchCoordConfigMap(const ClusterConfig& config,
                            bool* server_alive,
                            WriteOutcome* outcome = nullptr);
 
+// The field manager hedged (leader-proxied) slice publishes apply
+// under. Distinct from kApplyFieldManager on purpose: the severed
+// member's own next force=true apply under "tfd" reclaims ownership of
+// every spec.labels key on heal, with no tombstone left behind.
+inline constexpr char kHedgeFieldManager[] = "tfd-hedge";
+
+// Hedged publish (--sink-hedge): server-side-applies `labels` onto
+// ANOTHER node's NodeFeature CR ("tfd-features-for-<target_node>")
+// under kHedgeFieldManager. The slice leader calls this to proxy the
+// agreed tpu.slice.* labels for a member severed from the apiserver —
+// the only writer that still can. Always SSA (apply-patch+yaml,
+// force=true): a cross-node write must never clobber the target's own
+// field-manager state, so there is no merge-patch/PUT ladder here — an
+// apiserver that rejects apply (415/405) simply fails the hedge.
+// `server_alive` (non-null) reports whether ANY HTTP response arrived.
+Status HedgeNodeFeatureLabels(const ClusterConfig& config,
+                              const std::string& target_node,
+                              const lm::Labels& labels,
+                              bool* server_alive,
+                              WriteOutcome* outcome = nullptr);
+
 // Builds the JSON merge patch that turns `acked` into `desired`:
 // changed/added keys verbatim, removed keys null, under spec.labels —
 // plus the nfd node-name metadata label when `fix_node_name` (the GET

@@ -144,6 +144,7 @@ Result<std::unique_ptr<IntrospectionServer>> IntrospectionServer::Start(
   server->journal_ = options.journal;
   server->trace_ = options.trace;
   server->slo_ = options.slo;
+  server->slice_report_ = options.slice_report;
   server->stale_after_s_ = options.stale_after_s;
   server->listen_fd_ = fd;
   server->port_ = ntohs(bound.sin_port);
@@ -319,11 +320,25 @@ void IntrospectionServer::HandleRequest(Conn* conn) {
     } else {
       conn->out = HttpResponse(200, "OK", "application/json", body + "\n");
     }
+  } else if (path == "/debug/slice-report" && slice_report_ != nullptr) {
+    // The peer-relay fetch surface (--slice-relay): this host's LIVE
+    // member report, refreshed every slice tick even when the
+    // blackboard is unreachable — that is exactly when a peer needs it.
+    std::string body = slice_report_();
+    if (body.empty()) {
+      conn->out = HttpResponse(503, "Service Unavailable",
+                               "application/json",
+                               "{\"error\":\"no slice report built "
+                               "yet\"}\n");
+    } else {
+      conn->out = HttpResponse(200, "OK", "application/json", body + "\n");
+    }
   } else {
     conn->out = HttpResponse(404, "Not Found", "text/plain",
                              "serves /healthz, /readyz, /metrics, "
                              "/debug/journal, /debug/labels, "
-                             "/debug/trace, /debug/slo\n");
+                             "/debug/trace, /debug/slo, "
+                             "/debug/slice-report\n");
   }
 }
 

@@ -23,6 +23,7 @@
 // wedged or erroring daemon drops out of service without dying.
 #pragma once
 
+#include <functional>
 #include <memory>
 #include <string>
 
@@ -57,6 +58,12 @@ struct ServerOptions {
   // endpoint; the daemon passes obs::DefaultSlo()). Each read expires
   // the window first, so a quiet daemon's view still ages out.
   StageSlo* slo = nullptr;
+  // Live member-report provider behind /debug/slice-report (null hides
+  // the endpoint): peers fetch this during a partial partition to relay
+  // this host's report onto the slice blackboard (--slice-relay). The
+  // daemon wires slice::Default().LocalReportJson; an empty return is
+  // served as 503 (no report built yet).
+  std::function<std::string()> slice_report;
 };
 
 class IntrospectionServer {
@@ -101,6 +108,7 @@ class IntrospectionServer {
   Journal* journal_ = nullptr;
   TraceRecorder* trace_ = nullptr;
   StageSlo* slo_ = nullptr;
+  std::function<std::string()> slice_report_;
   int stale_after_s_ = 120;
   int listen_fd_ = -1;
   int wake_fds_[2] = {-1, -1};  // self-pipe: Stop() wakes the poll loop

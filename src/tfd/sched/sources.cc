@@ -23,6 +23,7 @@
 #include "tfd/slice/coord.h"
 #include "tfd/slice/topology.h"
 #include "tfd/util/file.h"
+#include "tfd/util/http.h"
 #include "tfd/util/logging.h"
 #include "tfd/util/strings.h"
 #include "tfd/util/subprocess.h"
@@ -542,6 +543,29 @@ class K8sCoordStore : public slice::DocStore {
   int deadline_ms_ = 0;
 };
 
+// Peer-relay transport (--slice-relay): GET the peer's live member
+// report from its introspection server. Deliberately tight timeouts —
+// the fetch runs inside the slice tick, and a peer that is ALSO
+// unreachable must cost ~a second, not a sink deadline. A failure here
+// is never blackboard contact and never feeds any breaker: "peer
+// unreachable too" is an expected answer during a real partition.
+class HttpPeerChannel : public slice::PeerChannel {
+ public:
+  Result<std::string> FetchReport(const std::string& addr) override {
+    http::RequestOptions options;
+    options.timeout_ms = 1000;
+    options.deadline_ms = 1500;
+    Result<http::Response> got = http::Request(
+        "GET", "http://" + addr + "/debug/slice-report", "", options);
+    if (!got.ok()) return Result<std::string>::Error(got.error());
+    if (got->status != 200) {
+      return Result<std::string>::Error(
+          "peer report fetch: HTTP " + std::to_string(got->status));
+    }
+    return got->body;
+  }
+};
+
 // This host's view for the member report: shape + freshness from the
 // serving-preference device snapshot, healthsm quarantine, the health
 // exec's verdict, and the debounced perf class. All already-debounced
@@ -603,6 +627,21 @@ slice::MemberReport BuildLocalReport(const SnapshotStore& store,
     if (std::optional<perf::Characterization> c = perf::Default().Get()) {
       report.perf_class = perf::ClassName(c->class_rank);
     }
+  }
+  // Peer-relay addr (--slice-relay): where peers fetch this host's live
+  // report (/debug/slice-report) when its blackboard copy goes stale.
+  // The wildcard/empty bind host is substituted with the node identity
+  // — the name a peer can actually route to.
+  if (flags.slice_relay && !flags.introspection_addr.empty()) {
+    std::string addr = flags.introspection_addr;
+    size_t colon = addr.rfind(':');
+    std::string host =
+        colon == std::string::npos ? addr : addr.substr(0, colon);
+    if (host.empty() || host == "0.0.0.0") {
+      addr = report.host +
+             (colon == std::string::npos ? "" : addr.substr(colon));
+    }
+    report.addr = addr;
   }
   return report;
 }
@@ -1042,6 +1081,15 @@ std::vector<ProbeSpec> BuildProbeSpecs(
         flags.slice_rejoin_dwell_s > 0
             ? flags.slice_rejoin_dwell_s
             : 2 * coord_policy.agreement_timeout_s;
+    // Partition-tolerant fast convergence (ISSUE 19): relay and
+    // succession straight from the flags; the hedge additionally needs
+    // the CR sink (there is no cross-node label FILE to proxy to). The
+    // succession threshold keys off the real renewal cadence — the
+    // slice tick — not the lease duration.
+    coord_policy.relay = flags.slice_relay;
+    coord_policy.succession = flags.slice_succession;
+    coord_policy.hedge = flags.sink_hedge && flags.use_node_feature_api;
+    coord_policy.renew_cadence_s = slice_tick_s;
     slice::Default().Configure(identity, NodeIdentity(), coord_policy);
     // Configure() may substitute the state file's restored identity
     // when live derivation had NO name evidence (metadata server down
@@ -1067,11 +1115,12 @@ std::vector<ProbeSpec> BuildProbeSpecs(
       store->Register("slice", policy, /*device_source=*/false);
 
       auto coord_store = std::make_shared<K8sCoordStore>(flags);
+      auto peer_channel = std::make_shared<HttpPeerChannel>();
       config::Flags flags_copy = flags;
       std::shared_ptr<SnapshotStore> store_ref = store;
       ProbeSpec spec;
       spec.name = "slice";
-      spec.probe = [coord_store, store_ref, flags_copy,
+      spec.probe = [coord_store, peer_channel, store_ref, flags_copy,
                     identity](Snapshot* out, bool* /*fatal*/) {
         // Until the first device probe round settles, this host's view
         // is UNKNOWN, not unhealthy — a freshly (re)started member
@@ -1099,9 +1148,35 @@ std::vector<ProbeSpec> BuildProbeSpecs(
         // publish an EMPTY slice snapshot (self-demotion to
         // single-host labels), not let a stale one keep serving from
         // the store until expiry.
-        slice::Coordinator::TickResult result =
-            slice::Default().Tick(coord_store.get(), local, now);
+        slice::Coordinator::TickResult result = slice::Default().Tick(
+            coord_store.get(), local, now,
+            flags_copy.slice_relay ? peer_channel.get() : nullptr);
         out->labels = result.labels;
+        // Hedged publishes (--sink-hedge): the coordinator hands the
+        // leader one entry per (severed member, verdict change); the
+        // SSA write rides the hedge field manager so the member's own
+        // apply reclaims its CR on heal. A failed hedge is logged and
+        // dropped — the NEXT verdict change re-hedges (newest-wins
+        // coalescing; a queue of stale verdicts would be worse than
+        // none), and the member's own sink remains the source of truth.
+        for (const slice::Coordinator::HedgedPublish& hedge :
+             result.hedges) {
+          Result<k8s::ClusterConfig> cluster = k8s::LoadInClusterConfig();
+          if (!cluster.ok()) {
+            TFD_LOG_WARNING << "slice hedge for " << hedge.host
+                            << " skipped: " << cluster.error();
+            break;
+          }
+          cluster->request_deadline_ms =
+              flags_copy.sink_request_deadline_s * 1000;
+          bool alive = false;
+          Status hedged = k8s::HedgeNodeFeatureLabels(
+              *cluster, hedge.host, hedge.labels, &alive);
+          if (!hedged.ok()) {
+            TFD_LOG_WARNING << "slice hedge for " << hedge.host << ": "
+                            << hedged.message();
+          }
+        }
         return Status::Ok();
       };
       spec.interval_s = slice_tick_s;

@@ -193,12 +193,19 @@ std::map<std::string, std::string> SliceEnvFromProcess() {
 // ---- blackboard documents ------------------------------------------------
 
 std::string SerializeReport(const MemberReport& report) {
+  // addr/relayed_by are emitted only when set: a pre-relay report's
+  // bytes (and the twin's) are unchanged.
   return "{\"host\":" + jsonlite::Quote(report.host) +
          ",\"worker\":" + std::to_string(report.worker_id) +
          ",\"healthy\":" + (report.healthy ? "true" : "false") +
          ",\"preempting\":" + (report.preempting ? "true" : "false") +
          ",\"shape\":" + jsonlite::Quote(report.shape) +
          ",\"class\":" + jsonlite::Quote(report.perf_class) +
+         (report.addr.empty() ? ""
+                              : ",\"addr\":" + jsonlite::Quote(report.addr)) +
+         (report.relayed_by.empty()
+              ? ""
+              : ",\"relayed_by\":" + jsonlite::Quote(report.relayed_by)) +
          ",\"at\":" + Fixed3(report.reported_at) + "}";
 }
 
@@ -222,6 +229,8 @@ Result<MemberReport> ParseReport(const std::string& json) {
   report.preempting = BoolOr(obj, "preempting", false);
   report.shape = StringOr(obj, "shape");
   report.perf_class = StringOr(obj, "class");
+  report.addr = StringOr(obj, "addr");
+  report.relayed_by = StringOr(obj, "relayed_by");
   report.reported_at = NumberOr(obj, "at", 0);
   return report;
 }
@@ -259,6 +268,11 @@ std::string SerializeVerdict(const SliceVerdict& verdict) {
     if (!members.empty()) members += ",";
     members += jsonlite::Quote(m);
   }
+  std::string successors;
+  for (const std::string& m : verdict.successors) {
+    if (!successors.empty()) successors += ",";
+    successors += jsonlite::Quote(m);
+  }
   return "{\"seq\":" + std::to_string(verdict.seq) +
          ",\"leader\":" + jsonlite::Quote(verdict.leader) +
          (verdict.change != 0
@@ -269,7 +283,12 @@ std::string SerializeVerdict(const SliceVerdict& verdict) {
          ",\"healthy_hosts\":" + std::to_string(verdict.healthy_hosts) +
          ",\"degraded\":" + (verdict.degraded ? "true" : "false") +
          ",\"class\":" + jsonlite::Quote(verdict.perf_class) +
-         ",\"members\":[" + members + "]}";
+         ",\"members\":[" + members + "]" +
+         // Emitted only when non-empty: pre-succession verdict bytes
+         // (and the twin's) are unchanged.
+         (successors.empty() ? ""
+                             : ",\"successors\":[" + successors + "]") +
+         "}";
 }
 
 Result<SliceVerdict> ParseVerdict(const std::string& json) {
@@ -299,6 +318,14 @@ Result<SliceVerdict> ParseVerdict(const std::string& json) {
       }
     }
   }
+  if (jsonlite::ValuePtr successors = obj.Get("successors");
+      successors && successors->kind == jsonlite::Value::Kind::kArray) {
+    for (const jsonlite::ValuePtr& m : successors->array_items) {
+      if (m && m->kind == jsonlite::Value::Kind::kString) {
+        verdict.successors.push_back(m->string_value);
+      }
+    }
+  }
   if (verdict.hosts <= 0) {
     return Result<SliceVerdict>::Error("verdict: missing hosts");
   }
@@ -306,6 +333,7 @@ Result<SliceVerdict> ParseVerdict(const std::string& json) {
   // membership check binary-searches this, and an unsorted list from a
   // hand-edited/corrupt ConfigMap must not turn that into UB.
   std::sort(verdict.members.begin(), verdict.members.end());
+  std::sort(verdict.successors.begin(), verdict.successors.end());
   return verdict;
 }
 
@@ -362,11 +390,19 @@ SliceVerdict MergeVerdict(const SliceIdentity& identity,
         if (dwelling != nullptr) dwelling->push_back(report.host);
       }
     }
-    if (healthy) verdict.healthy_hosts++;
+    if (healthy) {
+      verdict.healthy_hosts++;
+      // Pre-declared succession: every healthy present member except
+      // the leader is an eligible successor; the sorted order is the
+      // promotion order (deterministic from the facts alone, so every
+      // member computes the same line of succession).
+      if (report.host != leader) verdict.successors.push_back(report.host);
+    }
     int rank = RankOfClassName(report.perf_class);
     if (rank > worst_rank) worst_rank = rank;
   }
   std::sort(verdict.members.begin(), verdict.members.end());
+  std::sort(verdict.successors.begin(), verdict.successors.end());
   verdict.degraded = verdict.healthy_hosts < verdict.hosts;
   // tpu.slice.class = the WORST present member class (a slice is as
   // fast as its slowest host; closes the PR 8 "plug the perf class
@@ -440,6 +476,12 @@ void Coordinator::Configure(const SliceIdentity& identity,
     state_.last_contact_ok = 0;
     state_.departed_at.clear();
     state_.last_dwelling.clear();
+    state_.relaying.clear();
+    state_.hedged_seq.clear();
+    {
+      std::lock_guard<std::mutex> report_lock(report_mu_);
+      state_.local_report_json.clear();
+    }
   }
   state_.identity = effective;
   state_.self = self;
@@ -600,10 +642,19 @@ Coordinator::TickResult Coordinator::HandleContactFailure(State* s,
 
 Coordinator::TickResult Coordinator::Tick(DocStore* store,
                                           const MemberReport& local,
-                                          double now_s) {
+                                          double now_s,
+                                          PeerChannel* peers) {
   std::lock_guard<std::mutex> lock(mu_);
   State* s = &state_;
   if (!s->identity.valid) return {CoordMode::kSingleHost, lm::Labels{}};
+  // Stash BEFORE any blackboard contact: a member severed from the
+  // apiserver must keep serving fresh reports to relaying peers — that
+  // is the whole point of the relay. Under report_mu_ so a peer's probe
+  // is answered mid-tick instead of waiting out this tick's I/O.
+  {
+    std::lock_guard<std::mutex> report_lock(report_mu_);
+    s->local_report_json = SerializeReport(local);
+  }
   if (s->last_contact_ok == 0) s->last_contact_ok = now_s;
   const std::string name = CoordDocName(s->identity.slice_id);
   const std::string report_key = std::string(kReportKeyPrefix) + s->self;
@@ -667,14 +718,201 @@ Coordinator::TickResult Coordinator::Tick(DocStore* store,
   for (const auto& [key, value] : doc.data) {
     if (key.rfind(kReportKeyPrefix, 0) != 0) continue;
     Result<MemberReport> parsed = ParseReport(value);
+    // A relayed copy of OUR OWN report is a peer vouching for us, not
+    // us: it is dropped here (local below is the only self report) and
+    // never counts as blackboard contact or local liveness.
     if (parsed.ok() && parsed->host != s->self) reports.push_back(*parsed);
   }
   reports.push_back(local);
 
+  // Peer report relay (--slice-relay): a peer whose blackboard report
+  // is going stale may be severed from the apiserver while WE can
+  // still reach it directly. Fetch its live report over its
+  // introspection addr and gossip it onto the blackboard with our
+  // relayed_by mark — the origin stamp is kept verbatim, so a relay
+  // can never manufacture freshness the origin did not claim, and the
+  // leader's merged view survives the partial partition without
+  // waiting out the ageing window. The probe cuts BOTH ways: a stale
+  // peer we tried and FAILED to reach is confirmed-stale and excluded
+  // from this tick's merge ahead of the ageing window, instead of
+  // lingering until agreement_timeout ages it out. A probe that
+  // ANSWERS with a valid report proves the member alive AT PROBE TIME
+  // even when the copy is no fresher (a report renewed the same tick
+  // as its blackboard write carries the identical stamp, and a
+  // scheduling-stalled peer can fall a full window behind on board
+  // renewals while still answering) — so this tick's merge counts it
+  // as of the probe, while the BOARD stamp only ever moves when the
+  // origin actually claimed something newer. The stale threshold sits
+  // above one report-renewal period: a healthy member's copy must be
+  // allowed to age a full cadence (plus write latency) between
+  // renewals without drawing probes every tick. Failed probes are
+  // cached per board stamp (see probe_failed_at): a frozen peer whose
+  // TCP backlog accepts the connect but never answers costs one probe
+  // timeout per 2x agreement window, not one per tick.
+  if (s->policy.relay && peers != nullptr) {
+    const int cadence =
+        s->policy.renew_cadence_s > 0
+            ? s->policy.renew_cadence_s
+            : std::max(1, s->policy.lease_duration_s / 3);
+    const double stale_after =
+        std::max(s->policy.agreement_timeout_s / 2.0, cadence * 1.5);
+    std::vector<std::string> relaying_now;
+    std::vector<std::string> confirmed_stale;
+    for (MemberReport& report : reports) {
+      if (report.host == s->self || report.addr.empty()) continue;
+      if (report.reported_at > 0 &&
+          now_s - report.reported_at <= stale_after) {
+        continue;  // still fresh on the blackboard: nothing to relay
+      }
+      if (auto it = s->probe_failed_at.find(report.host);
+          it != s->probe_failed_at.end() &&
+          it->second.first == report.reported_at &&
+          now_s - it->second.second <=
+              2.0 * s->policy.agreement_timeout_s) {
+        // The board stamp hasn't moved since the last FAILED probe and
+        // the re-probe cooldown hasn't elapsed: re-confirm stale
+        // without paying another probe timeout.
+        confirmed_stale.push_back(report.host);
+        continue;
+      }
+      Result<std::string> fetched = peers->FetchReport(report.addr);
+      if (!fetched.ok()) {  // stale on the board AND unreachable direct
+        s->probe_failed_at[report.host] = {report.reported_at, now_s};
+        confirmed_stale.push_back(report.host);
+        continue;
+      }
+      Result<MemberReport> fresh = ParseReport(*fetched);
+      if (!fresh.ok() || fresh->host != report.host) {
+        // Reachable but answering garbage (or somebody else's report)
+        // is not a liveness proof: same fast exclusion as no answer.
+        s->probe_failed_at[report.host] = {report.reported_at, now_s};
+        confirmed_stale.push_back(report.host);
+        continue;
+      }
+      s->probe_failed_at.erase(report.host);
+      if (fresh->reported_at <= report.reported_at) {
+        // Alive and answering, just nothing newer to gossip (the live
+        // copy renews at tick cadence and can tie the blackboard
+        // stamp — or fall behind entirely when the peer's tick loop
+        // is stalled). The answer itself is the liveness proof: count
+        // the member in THIS tick's merge as of the probe, but write
+        // nothing — the board keeps only what the origin claimed.
+        report.reported_at = now_s;
+        continue;
+      }
+      MemberReport relayed = *fresh;
+      relayed.relayed_by = s->self;
+      updates[std::string(kReportKeyPrefix) + relayed.host] =
+          SerializeReport(relayed);
+      report = relayed;  // this tick's merge sees the fresh view too
+      relaying_now.push_back(relayed.host);
+      obs::Default()
+          .GetCounter("tfd_slice_relayed_reports_total",
+                      "Peer member-reports this host gossiped onto the "
+                      "slice blackboard on behalf of a peer whose own "
+                      "report was going stale (--slice-relay).")
+          ->Inc();
+      if (std::find(s->relaying.begin(), s->relaying.end(),
+                    relayed.host) == s->relaying.end()) {
+        obs::DefaultJournal().Record(
+            "slice-relay", "slice",
+            "relaying " + relayed.host +
+                "'s report onto the blackboard (its own copy went "
+                "stale; peer still reachable at " + relayed.addr + ")",
+            {{"slice", s->identity.slice_id},
+             {"host", relayed.host},
+             {"addr", relayed.addr},
+             {"origin_at", Fixed3(relayed.reported_at)}});
+      }
+    }
+    s->relaying = std::move(relaying_now);
+    if (!confirmed_stale.empty()) {
+      reports.erase(
+          std::remove_if(reports.begin(), reports.end(),
+                         [&](const MemberReport& r) {
+                           return std::find(confirmed_stale.begin(),
+                                            confirmed_stale.end(),
+                                            r.host) != confirmed_stale.end();
+                         }),
+          reports.end());
+    }
+  }
+
   const bool expired = LeaseExpired(lease, now_s);
   const bool holder = !expired && lease.holder == s->self;
 
-  if (holder || expired) {
+  // Pre-declared lease succession (--slice-succession): the holder
+  // renews every slice tick, so a renewal older than ~1.5 ticks means
+  // the leader is gone (or severed) — and the verdict already names
+  // the line of succession. The FIRST-listed successor that still has
+  // a fresh report promotes NOW, epoch-fenced and rv-preconditioned
+  // exactly like the expiry acquisition below, instead of waiting out
+  // the rest of the lease. Everyone else keeps waiting (expiry is the
+  // backstop if the first successor died with the leader).
+  bool succession = false;
+  if (s->policy.succession && !expired && !holder && have_stored &&
+      !stored.successors.empty()) {
+    const int cadence =
+        s->policy.renew_cadence_s > 0
+            ? s->policy.renew_cadence_s
+            : std::max(1, s->policy.lease_duration_s / 3);
+    const double missed_after = cadence + std::max(1, cadence / 2);
+    if (now_s - lease.renewed_at > missed_after) {
+      std::string first;
+      for (const std::string& cand : stored.successors) {
+        if (cand == lease.holder) continue;  // stale list: skip holder
+        for (const MemberReport& r : reports) {
+          if (r.host == cand && r.reported_at > 0 &&
+              now_s - r.reported_at <= s->policy.agreement_timeout_s) {
+            first = cand;
+            break;
+          }
+        }
+        if (!first.empty()) break;
+      }
+      succession = (first == s->self);
+    }
+  }
+
+  // Rejoin hysteresis bookkeeping — on EVERY member's tick, not just
+  // the holder's: refresh the departure time of every expected-or-
+  // tracked member that is absent/stale THIS round, so "now -
+  // departed_at" measures continuous presence since a member's
+  // return; a host that has served its dwell sheds the entry. A
+  // follower must keep this clock warm because succession
+  // (--slice-succession) can hand it the lease at any missed renewal
+  // — a successor promoting with an empty dwell map would instantly
+  // re-count a crash-looper the old leader was mid-dwell on.
+  if (s->policy.rejoin_dwell_s > 0) {
+    std::vector<std::string> present;
+    for (const MemberReport& report : reports) {
+      if (report.reported_at > 0 &&
+          now_s - report.reported_at <= s->policy.agreement_timeout_s) {
+        present.push_back(report.host);
+      }
+    }
+    auto is_present = [&present](const std::string& host) {
+      return std::find(present.begin(), present.end(), host) !=
+             present.end();
+    };
+    if (s->have_verdict) {
+      for (const std::string& host : s->adopted.members) {
+        if (!is_present(host)) s->departed_at[host] = now_s;
+      }
+    }
+    for (auto it = s->departed_at.begin(); it != s->departed_at.end();) {
+      if (!is_present(it->first)) {
+        it->second = now_s;  // still absent: the dwell clock holds
+        ++it;
+      } else if (now_s - it->second >= s->policy.rejoin_dwell_s) {
+        it = s->departed_at.erase(it);  // dwell served: count it again
+      } else {
+        ++it;
+      }
+    }
+  }
+
+  if (holder || expired || succession) {
     // Renew (holder) or run for the expired lease. Both are
     // preconditioned on the fetched resourceVersion: two acquirers
     // cannot both win, and a slow OLD leader races the live doc rather
@@ -682,39 +920,6 @@ Coordinator::TickResult Coordinator::Tick(DocStore* store,
     // outbid (the epoch fence).
     Lease next_lease{s->self, holder ? lease.epoch : lease.epoch + 1,
                      now_s, s->policy.lease_duration_s};
-    // Rejoin hysteresis bookkeeping (leader-side): refresh the
-    // departure time of every expected-or-tracked member that is
-    // absent/stale THIS round, so "now - departed_at" measures
-    // continuous presence since a member's return; a host that has
-    // served its dwell sheds the entry.
-    if (s->policy.rejoin_dwell_s > 0) {
-      std::vector<std::string> present;
-      for (const MemberReport& report : reports) {
-        if (report.reported_at > 0 &&
-            now_s - report.reported_at <= s->policy.agreement_timeout_s) {
-          present.push_back(report.host);
-        }
-      }
-      auto is_present = [&present](const std::string& host) {
-        return std::find(present.begin(), present.end(), host) !=
-               present.end();
-      };
-      if (s->have_verdict) {
-        for (const std::string& host : s->adopted.members) {
-          if (!is_present(host)) s->departed_at[host] = now_s;
-        }
-      }
-      for (auto it = s->departed_at.begin(); it != s->departed_at.end();) {
-        if (!is_present(it->first)) {
-          it->second = now_s;  // still absent: the dwell clock holds
-          ++it;
-        } else if (now_s - it->second >= s->policy.rejoin_dwell_s) {
-          it = s->departed_at.erase(it);  // dwell served: count it again
-        } else {
-          ++it;
-        }
-      }
-    }
     std::vector<std::string> dwelling;
     SliceVerdict next =
         MergeVerdict(s->identity, s->self, reports, s->policy, now_s,
@@ -762,11 +967,35 @@ Coordinator::TickResult Coordinator::Tick(DocStore* store,
     Status wrote = store->Patch(name, updates, doc.resource_version,
                                 false, &conflict, &alive2);
     if (wrote.ok()) {
+      if (succession) {
+        obs::Default()
+            .GetCounter(
+                "tfd_slice_successions_total",
+                "Lease takeovers by a pre-declared successor at the "
+                "first missed renewal tick, ahead of full lease "
+                "expiry (--slice-succession).")
+            ->Inc();
+        obs::DefaultJournal().Record(
+            "slice-succession", "slice",
+            "succeeded " + lease.holder + " at missed renewal (lease " +
+                "last renewed " +
+                Fixed3(now_s - lease.renewed_at) +
+                "s ago, duration " + std::to_string(lease.duration_s) +
+                "s); epoch " + std::to_string(next_lease.epoch),
+            {{"slice", s->identity.slice_id},
+             {"from", lease.holder},
+             {"epoch", std::to_string(next_lease.epoch)},
+             {"renewal_age_s", Fixed3(now_s - lease.renewed_at)}});
+      }
       s->epoch = next_lease.epoch;
       ObserveLeader(s, next_lease.holder, next_lease.epoch, now_s);
       AdoptVerdict(s, content_changed ? next : stored, now_s);
       SetMode(s, CoordMode::kLeader,
-              holder ? "" : "acquired the expired lease", now_s);
+              holder ? ""
+                     : (succession
+                            ? "succeeded to the lease at missed renewal"
+                            : "acquired the expired lease"),
+              now_s);
     } else if (conflict) {
       // Another member moved the doc between our GET and PATCH — a
       // rival acquirer, or just a report landing. Our report must
@@ -850,9 +1079,69 @@ Coordinator::TickResult Coordinator::Tick(DocStore* store,
     }
   }
 
-  return {s->mode, s->have_verdict
-                       ? BuildSliceLabels(s->identity, s->adopted)
-                       : lm::Labels{}};
+  TickResult result{s->mode, s->have_verdict
+                                 ? BuildSliceLabels(s->identity, s->adopted)
+                                 : lm::Labels{}};
+
+  // Write hedging under brownout (--sink-hedge): a member whose report
+  // reaches the blackboard only by relay cannot publish its OWN
+  // tpu.slice.* either — the same partition severs its sink. The
+  // leader already holds the agreed verdict, so it proxies the publish
+  // onto the severed member's CR (the caller writes under the
+  // dedicated hedge field manager; the member's next apply reclaims
+  // ownership on heal). One hedge per (host, verdict seq): deferred
+  // hedges coalesce newest-wins, never queue.
+  if (s->policy.hedge && s->mode == CoordMode::kLeader &&
+      s->have_verdict) {
+    std::vector<std::string> severed;
+    for (const MemberReport& report : reports) {
+      if (report.host == s->self || report.relayed_by.empty()) continue;
+      if (report.reported_at <= 0 ||
+          now_s - report.reported_at > s->policy.agreement_timeout_s) {
+        continue;  // relay went stale too: nothing current to vouch for
+      }
+      severed.push_back(report.host);
+      auto it = s->hedged_seq.find(report.host);
+      if (it != s->hedged_seq.end() && it->second == s->adopted.seq) {
+        continue;  // this verdict already hedged to this host
+      }
+      s->hedged_seq[report.host] = s->adopted.seq;
+      result.hedges.push_back(
+          {report.host, BuildSliceLabels(s->identity, s->adopted)});
+      obs::Default()
+          .GetCounter("tfd_slice_hedged_publishes_total",
+                      "Agreed slice-label publishes the leader proxied "
+                      "onto a severed member's CR (--sink-hedge; one "
+                      "per host per verdict change).")
+          ->Inc();
+      obs::DefaultJournal().Record(
+          "slice-hedge", "slice",
+          "hedging " + report.host + "'s slice-label publish (its "
+              "report arrives only by relay; proxying verdict seq " +
+              std::to_string(s->adopted.seq) + ")",
+          {{"slice", s->identity.slice_id},
+           {"host", report.host},
+           {"seq", std::to_string(s->adopted.seq)},
+           {"relayed_by", report.relayed_by}});
+    }
+    // A healed member writes its own (un-relayed) report again: shed
+    // its entry so a FUTURE severance hedges afresh.
+    for (auto it = s->hedged_seq.begin(); it != s->hedged_seq.end();) {
+      if (std::find(severed.begin(), severed.end(), it->first) ==
+          severed.end()) {
+        it = s->hedged_seq.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+
+  return result;
+}
+
+std::string Coordinator::LocalReportJson() const {
+  std::lock_guard<std::mutex> lock(report_mu_);
+  return state_.local_report_json;
 }
 
 std::string Coordinator::SerializeJson(double now_s) const {

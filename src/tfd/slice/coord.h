@@ -125,6 +125,18 @@ struct MemberReport {
   std::string shape;      // "accel=...;chips=N;topo=..." ("" = no device facts)
   std::string perf_class; // debounced tpu.perf.class ("" = none)
   double reported_at = 0; // reporter's wall clock
+  // Peer-relay transport (--slice-relay): the reporter's introspection
+  // address, so a peer that can still reach it can fetch a fresh report
+  // over /debug/slice-report when the blackboard copy goes stale.
+  // Serialized only when non-empty — pre-relay docs parse unchanged.
+  std::string addr;
+  // Set on a RELAYED copy: the member that gossiped this report onto
+  // the blackboard on the origin's behalf. The origin stamp
+  // (reported_at) is the ORIGIN's clock — a relay never re-stamps, so
+  // it can never extend the origin's own freshness, and the origin
+  // never treats a relayed copy of its own report as blackboard
+  // contact. Serialized only when non-empty.
+  std::string relayed_by;
 };
 std::string SerializeReport(const MemberReport& report);
 Result<MemberReport> ParseReport(const std::string& json);
@@ -160,6 +172,15 @@ struct SliceVerdict {
   bool degraded = true;   // healthy_hosts < hosts
   std::string perf_class; // WORST present member class ("" = none known)
   std::vector<std::string> members;  // present member hosts, sorted
+  // Pre-declared lease succession (--slice-succession): the healthy
+  // present members EXCLUDING the leader, sorted — the first-listed
+  // live entry promotes at the first missed renewal tick instead of
+  // waiting out full lease expiry. Bookkeeping like seq/leader: never
+  // label content, ignored by content equality (a failover with
+  // unchanged facts must not move a byte), serialized only when
+  // non-empty (older docs parse as none). Staleness is safe: consumers
+  // filter out the current holder and anyone without a fresh report.
+  std::vector<std::string> successors;
 };
 std::string SerializeVerdict(const SliceVerdict& verdict);
 Result<SliceVerdict> ParseVerdict(const std::string& json);
@@ -174,6 +195,25 @@ struct CoordPolicy {
   // long before it is re-counted healthy, so a crash-looping host
   // cannot flap healthy-hosts once per restart. 0 disables.
   int rejoin_dwell_s = 0;
+  // Partition-tolerant fast convergence (ISSUE 19), all default-on
+  // with `=false` bisection escape hatches:
+  //   relay       — gossip a stale-on-the-blackboard peer's fresh
+  //                 report (fetched over its introspection addr) so a
+  //                 partial partition never waits out the ageing window
+  //   succession  — promote the first-listed verdict successor at the
+  //                 first missed renewal tick instead of lease expiry
+  bool relay = true;
+  bool succession = true;
+  //   hedge       — the leader proxies a severed (relay-only) member's
+  //                 agreed tpu.slice.* publish onto that member's CR
+  //                 (--sink-hedge; the write itself happens in the sink
+  //                 layer under the "tfd-hedge" SSA field manager)
+  bool hedge = true;
+  // The holder's renewal cadence (the slice tick; sources.cc wires
+  // min(sleep, lease/3)). A follower calls a renewal "missed" — and
+  // succession eligible — after renew_cadence_s + max(1, cadence/2)
+  // without a renewal. 0 falls back to max(1, lease_duration_s/3).
+  int renew_cadence_s = 0;
 };
 
 // Pure verdict merge: a report is PRESENT when it is younger than the
@@ -221,6 +261,17 @@ struct CoordDoc {
 // arrived — a 429-paced apiserver is alive (the orphan decision must
 // not treat server-directed pacing as a partition), a transport error
 // is not.
+// Member-to-member report fetch for the peer relay (--slice-relay): the
+// daemon's implementation GETs http://<addr>/debug/slice-report (the
+// introspection server); unit tests hand the coordinator a map. A fetch
+// failure means "peer unreachable too" and changes NOTHING — it is
+// never blackboard contact, never a health signal.
+class PeerChannel {
+ public:
+  virtual ~PeerChannel() = default;
+  virtual Result<std::string> FetchReport(const std::string& addr) = 0;
+};
+
 class DocStore {
  public:
   virtual ~DocStore() = default;
@@ -261,17 +312,39 @@ class Coordinator {
   void Configure(const SliceIdentity& identity, const std::string& self,
                  const CoordPolicy& policy);
 
+  // One hedged publish the LEADER owes on a severed member's behalf
+  // (--sink-hedge): the member's report reaches the blackboard only by
+  // relay (it cannot reach the apiserver itself), so the leader proxies
+  // the agreed tpu.slice.* labels onto the member's own NodeFeature CR.
+  // The caller performs the write under the dedicated hedge SSA field
+  // manager so the member's own next apply reclaims ownership on heal.
+  // Emitted once per (host, verdict seq) — deferred hedges coalesce
+  // newest-wins instead of queueing.
+  struct HedgedPublish {
+    std::string host;   // the severed member (its CR is the target)
+    lm::Labels labels;  // the agreed slice labels to proxy
+  };
   struct TickResult {
     CoordMode mode = CoordMode::kSingleHost;
     lm::Labels labels;  // empty = publish no slice labels
+    std::vector<HedgedPublish> hedges;  // leader-only, usually empty
   };
-  // One coordination tick: fetch the blackboard, write our report,
-  // renew/acquire the lease, compute (leader) or adopt (all) the
-  // verdict, and return the labels to publish. NEVER fails on transport
-  // errors — a partitioned member must keep returning Ok so its (empty,
-  // self-demoted) snapshot replaces the stale one in the store; within
-  // the grace window it returns the last adopted labels unchanged.
-  TickResult Tick(DocStore* store, const MemberReport& local, double now_s);
+  // One coordination tick: fetch the blackboard, relay reachable peers'
+  // reports onto it (`peers`, optional), write our report, renew/
+  // acquire/succeed-to the lease, compute (leader) or adopt (all) the
+  // verdict, and return the labels to publish plus any hedged publishes
+  // owed. NEVER fails on transport errors — a partitioned member must
+  // keep returning Ok so its (empty, self-demoted) snapshot replaces
+  // the stale one in the store; within the grace window it returns the
+  // last adopted labels unchanged. Peer-fetch failures are ignored:
+  // they are not blackboard contact either way.
+  TickResult Tick(DocStore* store, const MemberReport& local, double now_s,
+                  PeerChannel* peers = nullptr);
+
+  // The latest serialized local report Tick saw (thread-safe snapshot):
+  // what /debug/slice-report serves to relaying peers. Empty until the
+  // first tick.
+  std::string LocalReportJson() const;
 
   CoordMode mode() const;
   SliceIdentity identity() const;
@@ -309,6 +382,27 @@ class Coordinator {
     // crash-looper it was mid-dwell on.
     std::map<std::string, double> departed_at;
     std::vector<std::string> last_dwelling;  // rejoin-dwell journal dedup
+    // Relay bookkeeping: hosts whose reports this member relayed last
+    // tick (journal dedup — one slice-relay per severance episode).
+    std::vector<std::string> relaying;
+    // Failed-probe cache: host -> {board stamp when the direct probe
+    // failed, probe wall time}. While the stamp hasn't moved, the host
+    // is re-confirmed stale WITHOUT a new probe for 2x the agreement
+    // window — a frozen peer's connect-then-hang costs one probe
+    // timeout per window, not one per tick (a tick stalled past the
+    // agreement window would spuriously age out live peers).
+    std::map<std::string, std::pair<double, double>> probe_failed_at;
+    // Hedge bookkeeping (leader-side): host -> last verdict seq hedged
+    // to its CR, so deferred hedges coalesce newest-wins (one hedge
+    // per host per verdict change, never a queue).
+    std::map<std::string, uint64_t> hedged_seq;
+    // The serialized local report of the most recent tick, served to
+    // relaying peers via /debug/slice-report. Guarded by report_mu_,
+    // NOT mu_: Tick() holds mu_ across blackboard I/O and peer probes
+    // (seconds under a partition), and a peer's relay probe of THIS
+    // host must never wait out our tick — a probe that times out reads
+    // as "confirmed stale" and would evict a live member.
+    std::string local_report_json;
   };
 
   TickResult HandleContactFailure(State* s, bool server_alive,
@@ -320,6 +414,11 @@ class Coordinator {
                      double now_s);
 
   mutable std::mutex mu_;
+  // Narrow lock for the probe-serving surface only (local_report_json).
+  // Lock order: mu_ before report_mu_; LocalReportJson() takes ONLY
+  // report_mu_ so the introspection thread stays wait-free with respect
+  // to an in-flight tick.
+  mutable std::mutex report_mu_;
   State state_;
 };
 

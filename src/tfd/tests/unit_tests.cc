@@ -14,12 +14,14 @@
 
 #include <atomic>
 #include <cassert>
+#include <condition_variable>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <fstream>
 #include <iostream>
 #include <limits>
+#include <mutex>
 #include <thread>
 
 #include "tfd/agg/agg.h"
@@ -4536,6 +4538,7 @@ class MemoryDocStore : public slice::DocStore {
  public:
   bool fail_transport = false;
   bool alive_on_fail = false;  // true = "server answered 429/5xx"
+  bool fail_patch = false;     // writes throttled, reads fine (alive)
 
   Status Get(const std::string& name, slice::CoordDoc* doc,
              bool* alive) override {
@@ -4563,6 +4566,12 @@ class MemoryDocStore : public slice::DocStore {
     if (fail_transport) {
       *alive = alive_on_fail;
       return Status::Error("injected transport failure");
+    }
+    if (fail_patch) {
+      // Writes bounce (429/brownout) while reads keep working — the
+      // one-way degradation the asymmetric-partition tests exercise.
+      *alive = true;
+      return Status::Error("injected write throttle");
     }
     *alive = true;
     auto it = docs.find(name);
@@ -4818,6 +4827,523 @@ void TestSliceCoordSerializeRestore() {
   CHECK_EQ(parsed->slice_json.empty(), false);
   slice::Coordinator e;
   CHECK_TRUE(e.RestoreJson(parsed->slice_json, 1001).ok());
+}
+
+// Scripted peer-relay transport: addr -> canned /debug/slice-report
+// body, plus a kill switch for "nothing of the member is reachable".
+class FakePeerChannel : public slice::PeerChannel {
+ public:
+  std::map<std::string, std::string> responses;
+  bool fail = false;
+  int fetches = 0;
+
+  Result<std::string> FetchReport(const std::string& addr) override {
+    fetches++;
+    if (fail) return Result<std::string>::Error("connection refused");
+    auto it = responses.find(addr);
+    if (it == responses.end()) {
+      return Result<std::string>::Error("connection refused");
+    }
+    return it->second;
+  }
+};
+
+slice::MemberReport AddrReportFor(const std::string& host, bool healthy,
+                                  double at, const std::string& addr) {
+  slice::MemberReport r = LocalReportFor(host, healthy, at);
+  r.addr = addr;
+  return r;
+}
+
+void TestSliceRelayConfirmOrRelay() {
+  slice::CoordPolicy policy;
+  policy.lease_duration_s = 10;
+  policy.agreement_timeout_s = 5;
+  policy.renew_cadence_s = 1;  // stale_after = max(5/2, 1*1.5) = 2.5s
+  slice::SliceIdentity id_b = TwoHostIdentity();
+  id_b.worker_id = 1;
+  FakePeerChannel peers;
+  MemoryDocStore store;
+  slice::Coordinator a;
+  slice::Coordinator b;
+  a.Configure(TwoHostIdentity(), "host-a", policy);
+  b.Configure(id_b, "host-b", policy);
+  a.Tick(&store, LocalReportFor("host-a", true, 100), 100, &peers);
+  b.Tick(&store, AddrReportFor("host-b", true, 100.5, "127.0.0.1:9901"),
+         100.5);
+  slice::Coordinator::TickResult ra =
+      a.Tick(&store, LocalReportFor("host-a", true, 101), 101, &peers);
+  CHECK_EQ(ra.labels[lm::kSliceHealthyHosts], std::string("2"));
+  CHECK_EQ(peers.fetches, 0);  // fresh-reported members are never probed
+
+  // host-b goes silent on the blackboard but stays reachable direct:
+  // past stale_after the leader probes its addr, gets the live report,
+  // and relays it (origin stamp verbatim, relayed_by marked) — the
+  // slice holds 2/2 without waiting out the agreement ageing.
+  peers.responses["127.0.0.1:9901"] = slice::SerializeReport(
+      AddrReportFor("host-b", true, 103.6, "127.0.0.1:9901"));
+  ra = a.Tick(&store, LocalReportFor("host-a", true, 104), 104, &peers);
+  CHECK_TRUE(peers.fetches > 0);
+  CHECK_EQ(ra.labels[lm::kSliceHealthyHosts], std::string("2"));
+  {
+    Result<slice::MemberReport> relayed = slice::ParseReport(
+        store.docs[slice::CoordDocName("unit-slice")]
+            .data[std::string(slice::kReportKeyPrefix) + "host-b"]);
+    CHECK_TRUE(relayed.ok());
+    CHECK_EQ(relayed->relayed_by, std::string("host-a"));
+    CHECK_TRUE(relayed->reported_at == 103.6);  // never re-stamped
+  }
+
+  // A reachable peer with NOTHING fresher is NOT evicted: the live
+  // copy renews at tick cadence and can tie the blackboard stamp, so
+  // ordinary ageing stays the arbiter for reachable-but-silent.
+  // (Regression pin: an equal-stamp answer once confirmed-stale'd
+  // perfectly live members.)
+  ra = a.Tick(&store, LocalReportFor("host-a", true, 106.5), 106.5,
+              &peers);
+  CHECK_EQ(ra.labels[lm::kSliceHealthyHosts], std::string("2"));
+
+  // Confirm-or-relay: stale on the board AND unreachable direct is
+  // confirmed-stale — excluded from this merge at ~3.9s of the 5s
+  // ageing window, ahead of the timeout.
+  peers.fail = true;
+  ra = a.Tick(&store, LocalReportFor("host-a", true, 107.5), 107.5,
+              &peers);
+  CHECK_EQ(ra.labels[lm::kSliceHealthyHosts], std::string("1"));
+  CHECK_EQ(ra.labels[lm::kSliceDegraded], std::string("true"));
+
+  // Failed-probe cache: while host-b's board stamp hasn't moved, the
+  // next tick re-confirms stale WITHOUT paying another probe (a frozen
+  // peer's hung connect must not stall every tick).
+  {
+    const int before = peers.fetches;
+    ra = a.Tick(&store, LocalReportFor("host-a", true, 108), 108, &peers);
+    CHECK_EQ(peers.fetches, before);
+    CHECK_EQ(ra.labels[lm::kSliceHealthyHosts], std::string("1"));
+  }
+
+  // host-b renews directly (stamp moves -> cache invalidated), then
+  // goes silent again and answers GARBAGE: reachable-but-gibberish is
+  // no liveness proof — same fast exclusion as no answer.
+  peers.fail = false;
+  b.Tick(&store, AddrReportFor("host-b", true, 108.5, "127.0.0.1:9901"),
+         108.5);
+  ra = a.Tick(&store, LocalReportFor("host-a", true, 109), 109, &peers);
+  CHECK_EQ(ra.labels[lm::kSliceHealthyHosts], std::string("2"));
+  peers.responses["127.0.0.1:9901"] = "not a report";
+  {
+    const int before = peers.fetches;
+    ra = a.Tick(&store, LocalReportFor("host-a", true, 111.5), 111.5,
+                &peers);
+    CHECK_TRUE(peers.fetches > before);
+    CHECK_EQ(ra.labels[lm::kSliceHealthyHosts], std::string("1"));
+  }
+
+  // The liveness lift (1-CPU collapse regression pin): host-b renews
+  // at 115, then only ANSWERS probes with that same stamp. At t=120.5
+  // the board copy is 5.5s old — past the agreement window — but the
+  // equal-stamp answer proves it alive at probe time, so the merge
+  // counts it. Nothing is written: the board keeps the origin's claim.
+  b.Tick(&store, AddrReportFor("host-b", true, 115, "127.0.0.1:9901"),
+         115);
+  peers.responses["127.0.0.1:9901"] = slice::SerializeReport(
+      AddrReportFor("host-b", true, 115, "127.0.0.1:9901"));
+  ra = a.Tick(&store, LocalReportFor("host-a", true, 120.5), 120.5,
+              &peers);
+  CHECK_EQ(ra.labels[lm::kSliceHealthyHosts], std::string("2"));
+  {
+    Result<slice::MemberReport> board = slice::ParseReport(
+        store.docs[slice::CoordDocName("unit-slice")]
+            .data[std::string(slice::kReportKeyPrefix) + "host-b"]);
+    CHECK_TRUE(board.ok());
+    CHECK_TRUE(board->reported_at == 115);  // lift never re-stamps
+  }
+
+  // Cooldown expiry: a failure cached at t=123 suppresses re-probes
+  // (t=124), but past 2x the agreement window the host gets another
+  // chance — and a live answer resurrects it however old the board
+  // copy is.
+  peers.fail = true;
+  ra = a.Tick(&store, LocalReportFor("host-a", true, 123), 123, &peers);
+  CHECK_EQ(ra.labels[lm::kSliceHealthyHosts], std::string("1"));
+  {
+    const int before = peers.fetches;
+    ra = a.Tick(&store, LocalReportFor("host-a", true, 124), 124, &peers);
+    CHECK_EQ(peers.fetches, before);
+  }
+  peers.fail = false;
+  peers.responses["127.0.0.1:9901"] = slice::SerializeReport(
+      AddrReportFor("host-b", true, 133.5, "127.0.0.1:9901"));
+  ra = a.Tick(&store, LocalReportFor("host-a", true, 134), 134, &peers);
+  CHECK_EQ(ra.labels[lm::kSliceHealthyHosts], std::string("2"));
+
+  // With relay off the same silence just ages out normally — and no
+  // probe is ever attempted.
+  {
+    slice::CoordPolicy off = policy;
+    off.relay = false;
+    MemoryDocStore store2;
+    FakePeerChannel peers2;
+    slice::Coordinator a2;
+    slice::Coordinator b2;
+    a2.Configure(TwoHostIdentity(), "host-a", off);
+    b2.Configure(id_b, "host-b", off);
+    a2.Tick(&store2, LocalReportFor("host-a", true, 100), 100, &peers2);
+    b2.Tick(&store2,
+            AddrReportFor("host-b", true, 100.5, "127.0.0.1:9901"),
+            100.5);
+    slice::Coordinator::TickResult r2 =
+        a2.Tick(&store2, LocalReportFor("host-a", true, 104), 104,
+                &peers2);
+    CHECK_EQ(peers2.fetches, 0);
+    CHECK_EQ(r2.labels[lm::kSliceHealthyHosts], std::string("2"));
+  }
+}
+
+// A peer-relay transport that parks in FetchReport until released —
+// the shape of a probe against a frozen (SIGSTOPped) member whose TCP
+// backlog accepts the connect but never answers.
+class BlockingPeerChannel : public slice::PeerChannel {
+ public:
+  Result<std::string> FetchReport(const std::string&) override {
+    blocked.store(true);
+    std::unique_lock<std::mutex> lock(mu);
+    cv.wait(lock, [this] { return released; });
+    return Result<std::string>::Error("connection timed out");
+  }
+  void Release() {
+    {
+      std::lock_guard<std::mutex> lock(mu);
+      released = true;
+    }
+    cv.notify_all();
+  }
+  std::atomic<bool> blocked{false};
+  std::mutex mu;
+  std::condition_variable cv;
+  bool released = false;
+};
+
+// The probe-serving surface must be wait-free with respect to the tick:
+// Tick() holds the coordinator lock across blackboard I/O and peer
+// probes (seconds under a partition), and a peer's FetchReport of THIS
+// host lands on LocalReportJson() from the introspection thread. If
+// that read waited out the tick, the prober would time out and
+// confirm-stale a perfectly live member — the exact cascade a partial
+// partition triggers when every member is probing the same frozen host.
+void TestSliceReportSurfaceWaitFreeUnderTick() {
+  slice::CoordPolicy policy;
+  policy.lease_duration_s = 10;
+  policy.agreement_timeout_s = 5;
+  policy.renew_cadence_s = 1;
+  slice::SliceIdentity id_b = TwoHostIdentity();
+  id_b.worker_id = 1;
+  MemoryDocStore store;
+  BlockingPeerChannel peers;
+  slice::Coordinator a;
+  slice::Coordinator b;
+  a.Configure(TwoHostIdentity(), "host-a", policy);
+  b.Configure(id_b, "host-b", policy);
+  a.Tick(&store, LocalReportFor("host-a", true, 100), 100);
+  b.Tick(&store, AddrReportFor("host-b", true, 100, "127.0.0.1:9901"),
+         100);
+
+  // host-b's board report is now stale at t=104: host-a's tick probes
+  // it and parks inside the channel, holding the tick lock.
+  std::thread ticker([&] {
+    a.Tick(&store, LocalReportFor("host-a", true, 104), 104, &peers);
+  });
+  while (!peers.blocked.load()) {
+    std::this_thread::yield();
+  }
+  // The report surface answers NOW, mid-tick, with the stash from the
+  // blocked tick itself (stashed before any I/O).
+  std::string served = a.LocalReportJson();
+  CHECK_TRUE(!served.empty());
+  Result<slice::MemberReport> parsed = slice::ParseReport(served);
+  CHECK_TRUE(parsed.ok());
+  CHECK_EQ(parsed->host, std::string("host-a"));
+  CHECK_TRUE(parsed->reported_at == 104.0);
+  peers.Release();
+  ticker.join();
+}
+
+void TestSliceSuccession() {
+  slice::CoordPolicy policy;
+  policy.lease_duration_s = 10;   // cadence = 10/3 = 3; missed_after 4
+  policy.agreement_timeout_s = 5;
+  slice::SliceIdentity id_b = TwoHostIdentity();
+  id_b.worker_id = 1;
+  MemoryDocStore store;
+  slice::Coordinator a;
+  slice::Coordinator b;
+  a.Configure(TwoHostIdentity(), "host-a", policy);
+  b.Configure(id_b, "host-b", policy);
+  a.Tick(&store, LocalReportFor("host-a", true, 100), 100);
+  b.Tick(&store, LocalReportFor("host-b", true, 100.5), 100.5);
+  a.Tick(&store, LocalReportFor("host-a", true, 101), 101);
+  {
+    // The verdict pre-declares the line of succession: every healthy
+    // member except the leader, sorted.
+    Result<slice::SliceVerdict> v = slice::ParseVerdict(
+        store.docs[slice::CoordDocName("unit-slice")]
+            .data[slice::kVerdictKey]);
+    CHECK_TRUE(v.ok());
+    CHECK_EQ(static_cast<int>(v->successors.size()), 1);
+    CHECK_EQ(v->successors[0], std::string("host-b"));
+  }
+
+  // host-a dies after renewing at t=101 (lease runs to 111). Within
+  // the missed-renewal threshold the follower keeps following...
+  slice::Coordinator::TickResult rb =
+      b.Tick(&store, LocalReportFor("host-b", true, 104), 104);
+  CHECK_TRUE(rb.mode == slice::CoordMode::kFollower);
+  // ...but at renewal age 5.5 (> cadence + cadence/2 = 4, lease NOT
+  // yet expired) the first-listed successor promotes, epoch-fenced.
+  rb = b.Tick(&store, LocalReportFor("host-b", true, 106.5), 106.5);
+  CHECK_TRUE(rb.mode == slice::CoordMode::kLeader);
+  CHECK_EQ(rb.labels[lm::kSliceHealthyHosts], std::string("1"));
+  {
+    Result<slice::Lease> lease =
+        slice::ParseLease(store.docs[slice::CoordDocName("unit-slice")]
+                              .data[slice::kLeaseKey]);
+    CHECK_TRUE(lease.ok());
+    CHECK_EQ(lease->holder, std::string("host-b"));
+    CHECK_EQ(static_cast<int>(lease->epoch), 2);
+  }
+
+  // Succession off: the same timeline waits for full lease expiry.
+  {
+    slice::CoordPolicy off = policy;
+    off.succession = false;
+    MemoryDocStore store2;
+    slice::Coordinator a2;
+    slice::Coordinator b2;
+    a2.Configure(TwoHostIdentity(), "host-a", off);
+    b2.Configure(id_b, "host-b", off);
+    a2.Tick(&store2, LocalReportFor("host-a", true, 100), 100);
+    b2.Tick(&store2, LocalReportFor("host-b", true, 100.5), 100.5);
+    a2.Tick(&store2, LocalReportFor("host-a", true, 101), 101);
+    slice::Coordinator::TickResult r2 =
+        b2.Tick(&store2, LocalReportFor("host-b", true, 106.5), 106.5);
+    CHECK_TRUE(r2.mode == slice::CoordMode::kFollower);  // lease valid
+    r2 = b2.Tick(&store2, LocalReportFor("host-b", true, 111.5), 111.5);
+    CHECK_TRUE(r2.mode == slice::CoordMode::kLeader);  // expiry backstop
+  }
+
+  // A dead first successor is skipped: promotion needs a FRESH report,
+  // so the first LIVE name in the sorted line takes the lease.
+  {
+    slice::SliceIdentity id3 = TwoHostIdentity();
+    id3.num_hosts = 3;
+    slice::SliceIdentity id3b = id3;
+    id3b.worker_id = 1;
+    slice::SliceIdentity id3c = id3;
+    id3c.worker_id = 2;
+    MemoryDocStore store3;
+    slice::Coordinator a3;
+    slice::Coordinator b3;
+    slice::Coordinator c3;
+    a3.Configure(id3, "host-a", policy);
+    b3.Configure(id3b, "host-b", policy);
+    c3.Configure(id3c, "host-c", policy);
+    a3.Tick(&store3, LocalReportFor("host-a", true, 100), 100);
+    b3.Tick(&store3, LocalReportFor("host-b", true, 100.2), 100.2);
+    c3.Tick(&store3, LocalReportFor("host-c", true, 100.4), 100.4);
+    a3.Tick(&store3, LocalReportFor("host-a", true, 101), 101);
+    // host-a AND host-b die. At t=106 host-b's report is 5.8s stale:
+    // ineligible — host-c, second in line, succeeds.
+    slice::Coordinator::TickResult r3 =
+        c3.Tick(&store3, LocalReportFor("host-c", true, 106), 106);
+    CHECK_TRUE(r3.mode == slice::CoordMode::kLeader);
+    Result<slice::Lease> lease =
+        slice::ParseLease(store3.docs[slice::CoordDocName("unit-slice")]
+                              .data[slice::kLeaseKey]);
+    CHECK_TRUE(lease.ok());
+    CHECK_EQ(lease->holder, std::string("host-c"));
+    CHECK_EQ(static_cast<int>(lease->epoch), 2);
+  }
+}
+
+void TestSliceAsymmetricPartition() {
+  slice::CoordPolicy policy;
+  policy.lease_duration_s = 10;
+  policy.agreement_timeout_s = 5;
+  policy.renew_cadence_s = 1;
+  slice::SliceIdentity id_b = TwoHostIdentity();
+  id_b.worker_id = 1;
+
+  // Blackboard-but-not-peers: every direct probe would fail, but the
+  // reports are FRESH — fresh members are never probed, so a flaky
+  // fetch path cannot evict (or demote) a live member.
+  {
+    MemoryDocStore store;
+    FakePeerChannel broken;
+    broken.fail = true;
+    slice::Coordinator a;
+    slice::Coordinator b;
+    a.Configure(TwoHostIdentity(), "host-a", policy);
+    b.Configure(id_b, "host-b", policy);
+    a.Tick(&store, LocalReportFor("host-a", true, 100), 100, &broken);
+    b.Tick(&store, AddrReportFor("host-b", true, 100.5, "127.0.0.1:1"),
+           100.5);
+    slice::Coordinator::TickResult ra =
+        a.Tick(&store, LocalReportFor("host-a", true, 101), 101, &broken);
+    CHECK_EQ(broken.fetches, 0);
+    CHECK_EQ(ra.labels[lm::kSliceHealthyHosts], std::string("2"));
+    slice::Coordinator::TickResult rb =
+        b.Tick(&store, AddrReportFor("host-b", true, 101.5, "127.0.0.1:1"),
+               101.5);
+    CHECK_TRUE(rb.mode == slice::CoordMode::kFollower);  // no demotion
+    CHECK_TRUE(rb.labels == ra.labels);
+  }
+
+  // Peers-but-not-blackboard: the fleet keeps the severed member
+  // counted THROUGH the relay, while the member itself — whose only
+  // liveness is that relayed copy — still self-demotes at the lease:
+  // a relayed report is a peer vouching for it, never its own
+  // blackboard contact.
+  {
+    MemoryDocStore store;
+    MemoryDocStore dead;
+    dead.fail_transport = true;
+    FakePeerChannel peers;
+    slice::Coordinator a;
+    slice::Coordinator b;
+    a.Configure(TwoHostIdentity(), "host-a", policy);
+    b.Configure(id_b, "host-b", policy);
+    a.Tick(&store, LocalReportFor("host-a", true, 100), 100, &peers);
+    b.Tick(&store, AddrReportFor("host-b", true, 100.5, "127.0.0.1:2"),
+           100.5);
+    a.Tick(&store, LocalReportFor("host-a", true, 101), 101, &peers);
+    // host-b loses the apiserver; its peers still reach it.
+    peers.responses["127.0.0.1:2"] = slice::SerializeReport(
+        AddrReportFor("host-b", true, 103.6, "127.0.0.1:2"));
+    slice::Coordinator::TickResult ra =
+        a.Tick(&store, LocalReportFor("host-a", true, 104), 104, &peers);
+    CHECK_EQ(ra.labels[lm::kSliceHealthyHosts], std::string("2"));
+    peers.responses["127.0.0.1:2"] = slice::SerializeReport(
+        AddrReportFor("host-b", true, 111.6, "127.0.0.1:2"));
+    ra = a.Tick(&store, LocalReportFor("host-a", true, 112), 112, &peers);
+    CHECK_EQ(ra.labels[lm::kSliceHealthyHosts], std::string("2"));
+    // host-b itself, cut off past the lease duration, self-demotes —
+    // even though a FRESH relayed copy of its own report sits on the
+    // blackboard the whole time.
+    slice::Coordinator::TickResult rb = b.Tick(
+        &dead, AddrReportFor("host-b", true, 112.5, "127.0.0.1:2"),
+        112.5);
+    CHECK_TRUE(rb.mode == slice::CoordMode::kOrphaned);
+    CHECK_TRUE(rb.labels.empty());
+  }
+
+  // Reads fine, writes throttled (brownout): blackboard CONTACT is
+  // what anchors self-trust, so the member never self-demotes — it
+  // keeps publishing the adopted verdict while its own report goes
+  // stale on the board (where the peers' relay keeps the fleet from
+  // degrading it in the meantime).
+  {
+    MemoryDocStore store;
+    slice::Coordinator a;
+    slice::Coordinator b;
+    a.Configure(TwoHostIdentity(), "host-a", policy);
+    b.Configure(id_b, "host-b", policy);
+    a.Tick(&store, LocalReportFor("host-a", true, 100), 100);
+    b.Tick(&store, LocalReportFor("host-b", true, 100.5), 100.5);
+    a.Tick(&store, LocalReportFor("host-a", true, 101), 101);
+    slice::Coordinator::TickResult rb =
+        b.Tick(&store, LocalReportFor("host-b", true, 101.5), 101.5);
+    lm::Labels adopted = rb.labels;
+    CHECK_TRUE(!adopted.empty());
+    store.fail_patch = true;
+    for (double t = 102.5; t < 118; t += 1.0) {
+      rb = b.Tick(&store, LocalReportFor("host-b", true, t), t);
+      CHECK_TRUE(rb.mode != slice::CoordMode::kOrphaned);
+      CHECK_TRUE(rb.labels == adopted);
+    }
+  }
+}
+
+void TestSliceHedgedPublish() {
+  slice::CoordPolicy policy;
+  policy.lease_duration_s = 10;
+  policy.agreement_timeout_s = 5;
+  policy.renew_cadence_s = 1;
+  slice::SliceIdentity id_b = TwoHostIdentity();
+  id_b.worker_id = 1;
+  MemoryDocStore store;
+  FakePeerChannel peers;
+  slice::Coordinator a;
+  slice::Coordinator b;
+  a.Configure(TwoHostIdentity(), "host-a", policy);
+  b.Configure(id_b, "host-b", policy);
+  a.Tick(&store, LocalReportFor("host-a", true, 100), 100, &peers);
+  b.Tick(&store, AddrReportFor("host-b", true, 100.5, "127.0.0.1:3"),
+         100.5);
+  slice::Coordinator::TickResult ra =
+      a.Tick(&store, LocalReportFor("host-a", true, 101), 101, &peers);
+  CHECK_TRUE(ra.hedges.empty());  // nobody severed
+
+  // host-b's report now arrives only by relay: the leader proxies its
+  // agreed publish — the hedged labels ARE the leader's own bytes.
+  peers.responses["127.0.0.1:3"] = slice::SerializeReport(
+      AddrReportFor("host-b", true, 103.6, "127.0.0.1:3"));
+  ra = a.Tick(&store, LocalReportFor("host-a", true, 104), 104, &peers);
+  CHECK_EQ(static_cast<int>(ra.hedges.size()), 1);
+  CHECK_EQ(ra.hedges[0].host, std::string("host-b"));
+  CHECK_TRUE(ra.hedges[0].labels == ra.labels);
+
+  // Same verdict, still severed: coalesced — one hedge per (host,
+  // verdict seq), deferred hedges never queue.
+  peers.responses["127.0.0.1:3"] = slice::SerializeReport(
+      AddrReportFor("host-b", true, 105.6, "127.0.0.1:3"));
+  ra = a.Tick(&store, LocalReportFor("host-a", true, 106.2), 106.2,
+              &peers);
+  CHECK_TRUE(ra.hedges.empty());
+
+  // The verdict MOVES while host-b is severed (it reports unhealthy
+  // through the relay): the new content is hedged exactly once.
+  peers.responses["127.0.0.1:3"] = slice::SerializeReport(
+      AddrReportFor("host-b", false, 107.6, "127.0.0.1:3"));
+  ra = a.Tick(&store, LocalReportFor("host-a", true, 108.2), 108.2,
+              &peers);
+  CHECK_EQ(static_cast<int>(ra.hedges.size()), 1);
+  CHECK_EQ(ra.hedges[0].labels.at(lm::kSliceHealthyHosts),
+           std::string("1"));
+
+  // Heal: host-b writes its own report again — the hedge entry sheds,
+  // so a FUTURE severance hedges afresh.
+  b.Tick(&store, AddrReportFor("host-b", true, 109, "127.0.0.1:3"), 109);
+  ra = a.Tick(&store, LocalReportFor("host-a", true, 109.5), 109.5,
+              &peers);
+  CHECK_TRUE(ra.hedges.empty());  // own report: not severed
+  peers.responses["127.0.0.1:3"] = slice::SerializeReport(
+      AddrReportFor("host-b", true, 111.6, "127.0.0.1:3"));
+  ra = a.Tick(&store, LocalReportFor("host-a", true, 112.1), 112.1,
+              &peers);
+  CHECK_EQ(static_cast<int>(ra.hedges.size()), 1);  // re-severed
+
+  // --sink-hedge=false: relay still keeps the member counted, but the
+  // leader never writes on its behalf.
+  {
+    slice::CoordPolicy off = policy;
+    off.hedge = false;
+    MemoryDocStore store2;
+    FakePeerChannel peers2;
+    slice::Coordinator a2;
+    slice::Coordinator b2;
+    a2.Configure(TwoHostIdentity(), "host-a", off);
+    b2.Configure(id_b, "host-b", off);
+    a2.Tick(&store2, LocalReportFor("host-a", true, 100), 100, &peers2);
+    b2.Tick(&store2, AddrReportFor("host-b", true, 100.5, "127.0.0.1:4"),
+            100.5);
+    peers2.responses["127.0.0.1:4"] = slice::SerializeReport(
+        AddrReportFor("host-b", true, 103.6, "127.0.0.1:4"));
+    slice::Coordinator::TickResult r2 =
+        a2.Tick(&store2, LocalReportFor("host-a", true, 104), 104,
+                &peers2);
+    CHECK_EQ(r2.labels[lm::kSliceHealthyHosts], std::string("2"));
+    CHECK_TRUE(r2.hedges.empty());
+  }
 }
 
 void TestGovernorSliceKeys() {
@@ -7158,6 +7684,11 @@ int main(int argc, char** argv) {
   tfd::TestSliceLeaseStateMachine();
   tfd::TestSliceOrphanAndRejoin();
   tfd::TestSliceCoordSerializeRestore();
+  tfd::TestSliceRelayConfirmOrRelay();
+  tfd::TestSliceReportSurfaceWaitFreeUnderTick();
+  tfd::TestSliceSuccession();
+  tfd::TestSliceAsymmetricPartition();
+  tfd::TestSliceHedgedPublish();
   tfd::TestGovernorSliceKeys();
   tfd::TestPluginHandshakeGrid();
   tfd::TestPluginRoundValidationGrid();

@@ -56,6 +56,15 @@ if "--xla_force_host_platform_device_count" not in os.environ.get(
         " --xla_force_host_platform_device_count=8").strip()
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-wall-clock drills (multi-minute waits, redundant "
+        "with a soak or a cheaper sibling) excluded from the tier-1 "
+        "budget's `-m 'not slow'` run; CI's dedicated soak steps and a "
+        "`-m slow` run still cover them")
+
+
 @pytest.fixture(scope="session")
 def cpu_jax():
     import jax

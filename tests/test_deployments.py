@@ -194,6 +194,27 @@ class TestHelmChart:
                    ds["spec"]["template"]["spec"]["containers"][0]["env"]}
             assert env["TFD_SLICE_REJOIN_DWELL"] == "0", path.name
 
+    def test_partition_tolerance_knobs_wired(self):
+        """The partition-tolerance knobs (ISSUE 19): helm values ->
+        TFD_SLICE_RELAY / TFD_SLICE_SUCCESSION / TFD_SINK_HEDGE, all
+        defaulting ON (the static daemonsets carry "true" so the
+        "=false" escape hatch is one edit away)."""
+        values = yaml.safe_load((HELM / "values.yaml").read_text())
+        assert values["sliceRelay"] is True
+        assert values["sliceSuccession"] is True
+        assert values["sinkHedge"] is True
+        template = (HELM / "templates" / "daemonset.yml").read_text()
+        for env in ("TFD_SLICE_RELAY", "TFD_SLICE_SUCCESSION",
+                    "TFD_SINK_HEDGE"):
+            assert env in template, env
+        for path in STATIC_YAMLS:
+            ds = yaml.safe_load(path.read_text())
+            env = {e["name"]: e.get("value") for e in
+                   ds["spec"]["template"]["spec"]["containers"][0]["env"]}
+            assert env["TFD_SLICE_RELAY"] == "true", path.name
+            assert env["TFD_SLICE_SUCCESSION"] == "true", path.name
+            assert env["TFD_SINK_HEDGE"] == "true", path.name
+
     def test_plugin_knobs_wired(self):
         """The probe-plugin SDK knobs (ISSUE 11): helm values ->
         TFD_PLUGIN_* envs (dir gated on pluginEnabled), the 3 static

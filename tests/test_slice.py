@@ -419,6 +419,100 @@ class TestTwinParity:
             5, 100, departed_at=departed, rejoin_dwell_s=0)
         assert v["healthy_hosts"] == 2
 
+    def test_report_and_verdict_wire_bytes(self):
+        """ISSUE 19 wire-format parity (C++ SerializeReport /
+        SerializeVerdict): addr / relayed_by / successors are emitted
+        only when set, so pre-relay / pre-succession documents are
+        byte-identical to the older protocol's."""
+        base = {"host": "host-a", "worker": 0, "healthy": True,
+                "preempting": False, "shape": "2x2x1", "class": "gold",
+                "at": 100.5}
+        assert slicecoord.serialize_report(base) == (
+            '{"host":"host-a","worker":0,"healthy":true,'
+            '"preempting":false,"shape":"2x2x1","class":"gold",'
+            '"at":100.500}')
+        relayed = dict(base, addr="127.0.0.1:9101", relayed_by="host-b")
+        assert slicecoord.serialize_report(relayed) == (
+            '{"host":"host-a","worker":0,"healthy":true,'
+            '"preempting":false,"shape":"2x2x1","class":"gold",'
+            '"addr":"127.0.0.1:9101","relayed_by":"host-b",'
+            '"at":100.500}')
+        # Round-trip: relaying re-serializes the parsed report with
+        # only relayed_by added — the origin stamp must survive
+        # verbatim (a relay never extends freshness).
+        assert '"at":100.500' in slicecoord.serialize_report(relayed)
+
+        v = {"seq": 7, "leader": "host-a", "computed_at": 100.5,
+             "hosts": 4, "healthy_hosts": 4, "degraded": False,
+             "class": "", "members": ["host-a", "host-b"]}
+        plain = slicecoord.serialize_verdict(v)
+        assert plain == (
+            '{"seq":7,"leader":"host-a","computed_at":100.500,'
+            '"hosts":4,"healthy_hosts":4,"degraded":false,"class":"",'
+            '"members":["host-a","host-b"]}')
+        v["successors"] = ["host-b", "host-c"]
+        assert slicecoord.serialize_verdict(v) == plain[:-1] + \
+            ',"successors":["host-b","host-c"]}'
+
+    def test_succession_grid(self):
+        """The missed-renewal predicate and promotion order, same
+        literals as the C++ TestSliceSuccession: lease 10 -> cadence 3
+        -> missed_after 4; the follower holds at renewal age 3, may
+        promote at 5.5, and an EXPIRED lease (age > 10) takes the
+        ordinary acquisition path instead."""
+        assert slicecoord.renew_cadence(10) == 3
+        assert slicecoord.renew_cadence(10, renew_cadence_s=1) == 1
+        assert slicecoord.renew_cadence(2) == 1  # floor
+
+        lease = {"holder": "host-a", "epoch": 1, "renewed_at": 101.5,
+                 "duration_s": 10}
+        assert not slicecoord.succession_due(lease, 104.5)   # age 3
+        assert slicecoord.succession_due(lease, 107.0)       # age 5.5
+        assert not slicecoord.succession_due(lease, 112.0)   # expired
+        assert not slicecoord.succession_due(
+            {"holder": "", "epoch": 0, "renewed_at": 0,
+             "duration_s": 10}, 107.0)  # no holder = nothing to succeed
+        # Explicit cadence 1 (the soak's): missed_after 2.
+        assert not slicecoord.succession_due(lease, 103.4,
+                                             renew_cadence_s=1)
+        assert slicecoord.succession_due(lease, 104.0,
+                                         renew_cadence_s=1)
+
+        # Promotion order: first-listed live successor, skipping the
+        # absent holder and stale candidates; "" = expiry backstop.
+        reports = [{"host": "host-b", "at": 98.0},
+                   {"host": "host-c", "at": 105.0}]
+        assert slicecoord.first_successor(
+            ["host-b", "host-c"], "host-a", reports, 5, 106.0) == "host-c"
+        assert slicecoord.first_successor(
+            ["host-a", "host-c"], "host-a", reports, 5, 106.0) == "host-c"
+        assert slicecoord.first_successor(
+            ["host-b"], "host-a", reports, 5, 106.0) == ""
+
+    def test_merge_verdict_successor_line(self):
+        """MergeVerdict parity: successors = every healthy present
+        member except the leader, SORTED — deterministic from the facts
+        alone. Dwelling / preempting / unhealthy members never make
+        the line."""
+        def report(host, healthy, at, **kw):
+            return dict({"host": host, "healthy": healthy, "at": at}, **kw)
+
+        v = slicecoord.merge_verdict(
+            4, [report("d", True, 100), report("b", True, 100),
+                report("a", True, 100), report("c", True, 100)],
+            5, 100, leader="a")
+        assert v["successors"] == ["b", "c", "d"]
+        v = slicecoord.merge_verdict(
+            4, [report("a", True, 100), report("b", False, 100),
+                report("c", True, 100, preempting=True),
+                report("d", True, 100)],
+            5, 100, leader="a")
+        assert v["successors"] == ["d"]
+        v = slicecoord.merge_verdict(
+            4, [report("a", True, 100), report("b", True, 100)],
+            5, 100, departed_at={"b": 95}, rejoin_dwell_s=20, leader="a")
+        assert v["successors"] == [] and v["dwelling"] == ["b"]
+
     def test_identity_grid(self):
         # The literals pinned on the C++ side (TestSliceIdentityDerivation).
         assert slicecoord.sanitize_slice_id("My/Pod:0") == \

@@ -743,19 +743,22 @@ def stage_breakdown(closed, percentile):
 # One event per line:   <at_seconds> <op> <target> [key=value ...]
 # Blank lines and #-comments skipped. Targets:
 #   sNN/hMM    one host         (degrade/heal/wedge/unwedge/preempt/
-#                                preempt-clear)
+#                                preempt-clear/asym-partition/asym-heal)
 #   sNN        one slice        (leader-kill/leader-restart/partition/
 #                                heal-partition)
 #   apiserver  the control plane (brownout secs=N; slowdown secs=N
-#                                 delay=D — every publish attempt in
-#                                 the window lands D s late, the SLO
+#                                 delay=D — every publish ACK in the
+#                                 window returns D s late, the SLO
 #                                 engine's latency-regression drill)
 # partition takes hosts=A-B (the member index range that loses
-# connectivity). The full semantics table lives in
+# connectivity). asym-partition severs ONE host from the apiserver
+# while its peers can still reach it (the ISSUE 19 relay/hedge drill:
+# the slice must NOT degrade and the member's labels keep flowing via
+# the leader's hedged publish). The full semantics table lives in
 # docs/placement-harness.md.
 
 HOST_OPS = {"degrade", "heal", "wedge", "unwedge", "preempt",
-            "preempt-clear"}
+            "preempt-clear", "asym-partition", "asym-heal"}
 SLICE_OPS = {"leader-kill", "leader-restart", "partition",
              "heal-partition"}
 SERVER_OPS = {"brownout", "slowdown"}

@@ -5,8 +5,13 @@ grid (change one side, change both):
   - derive_slice_identity: the deterministic slice-id derivation
   - sanitize_slice_id:     the k8s-name-safe id (incl. the FNV suffix)
   - lease_expired:         the lease freshness rule
-  - merge_verdict:         the leader's report merge
+  - merge_verdict:         the leader's report merge (+ successor line)
   - build_slice_labels:    the published tpu.slice.* label set
+  - serialize_report / serialize_verdict: the blackboard document BYTES
+    (incl. the ISSUE 19 addr/relayed_by/successors fields, emitted only
+    when set so pre-relay documents are unchanged)
+  - succession_due / first_successor: the pre-declared lease-succession
+    eligibility rule (missed-renewal detection + promotion order)
 
 The soak (scripts/slice_soak.py) uses these to independently recompute
 what the daemons SHOULD agree on, and the journal/label helpers to
@@ -131,8 +136,117 @@ def lease_expired(lease, now):
     return now - lease.get("renewed_at", 0) > lease["duration_s"]
 
 
+def renew_cadence(lease_duration_s, renew_cadence_s=0):
+    """C++ Tick parity: the holder renews every slice tick; 0 falls
+    back to lease_duration/3 (integer division, floor 1)."""
+    if renew_cadence_s > 0:
+        return renew_cadence_s
+    return max(1, lease_duration_s // 3)
+
+
+def succession_due(lease, now, renew_cadence_s=0):
+    """The ISSUE 19 missed-renewal predicate (--slice-succession): the
+    lease is NOT yet expired, but the holder has missed ~1.5 renewal
+    ticks — the pre-declared first successor may promote now instead of
+    waiting out the rest of the lease. Expired leases take the ordinary
+    acquisition path, never this one."""
+    if lease_expired(lease, now):
+        return False
+    cadence = renew_cadence(lease["duration_s"], renew_cadence_s)
+    missed_after = cadence + max(1, cadence // 2)
+    return now - lease.get("renewed_at", 0) > missed_after
+
+
+def first_successor(successors, holder, reports, agreement_timeout_s,
+                    now):
+    """The promotion order: the FIRST-listed successor (the stored
+    verdict's sorted list) that is not the absent holder and still has
+    a fresh report. Returns "" when nobody qualifies (expiry is the
+    backstop)."""
+    fresh = {r["host"] for r in reports
+             if r.get("at", 0) > 0 and now - r["at"] <= agreement_timeout_s}
+    for cand in successors:
+        if cand == holder:
+            continue
+        if cand in fresh:
+            return cand
+    return ""
+
+
+def json_quote(s):
+    """jsonlite::Quote parity: the exact escape set the C++ writer
+    uses (no \\uXXXX for printable non-ASCII)."""
+    out = ['"']
+    for ch in s.encode("utf-8"):
+        c = chr(ch)
+        if c == '"':
+            out.append('\\"')
+        elif c == "\\":
+            out.append("\\\\")
+        elif c == "\b":
+            out.append("\\b")
+        elif c == "\f":
+            out.append("\\f")
+        elif c == "\n":
+            out.append("\\n")
+        elif c == "\r":
+            out.append("\\r")
+        elif c == "\t":
+            out.append("\\t")
+        elif ch < 0x20:
+            out.append(f"\\u{ch:04x}")
+        else:
+            out.append(c)
+    return "".join(out) + '"'
+
+
+def serialize_report(report):
+    """C++ SerializeReport byte mirror. report: {host, worker, healthy,
+    preempting, shape, class, addr?, relayed_by?, at}. addr/relayed_by
+    are emitted only when set, so a pre-relay report's bytes are
+    unchanged."""
+    addr = report.get("addr") or ""
+    relayed_by = report.get("relayed_by") or ""
+    return ("{\"host\":" + json_quote(report["host"]) +
+            ",\"worker\":" + str(report.get("worker", -1)) +
+            ",\"healthy\":" + ("true" if report.get("healthy") else
+                               "false") +
+            ",\"preempting\":" + ("true" if report.get("preempting") else
+                                  "false") +
+            ",\"shape\":" + json_quote(report.get("shape", "")) +
+            ",\"class\":" + json_quote(report.get("class", "")) +
+            ("" if not addr else ",\"addr\":" + json_quote(addr)) +
+            ("" if not relayed_by
+             else ",\"relayed_by\":" + json_quote(relayed_by)) +
+            ",\"at\":" + f"{report.get('at', 0):.3f}" + "}")
+
+
+def serialize_verdict(verdict):
+    """C++ SerializeVerdict byte mirror. verdict: {seq, leader, change?,
+    computed_at, hosts, healthy_hosts, degraded, class, members,
+    successors?}. change and successors are emitted only when set, so
+    pre-trace / pre-succession documents are unchanged."""
+    members = ",".join(json_quote(m) for m in verdict.get("members", []))
+    successors = ",".join(
+        json_quote(m) for m in verdict.get("successors", []))
+    change = int(verdict.get("change", 0) or 0)
+    return ("{\"seq\":" + str(verdict.get("seq", 0)) +
+            ",\"leader\":" + json_quote(verdict.get("leader", "")) +
+            ("" if change == 0 else ",\"change\":" + str(change)) +
+            ",\"computed_at\":" + f"{verdict.get('computed_at', 0):.3f}" +
+            ",\"hosts\":" + str(verdict["hosts"]) +
+            ",\"healthy_hosts\":" + str(verdict.get("healthy_hosts", 0)) +
+            ",\"degraded\":" + ("true" if verdict.get("degraded") else
+                                "false") +
+            ",\"class\":" + json_quote(verdict.get("class", "")) +
+            ",\"members\":[" + members + "]" +
+            ("" if not successors
+             else ",\"successors\":[" + successors + "]") +
+            "}")
+
+
 def merge_verdict(num_hosts, reports, agreement_timeout_s, now,
-                  departed_at=None, rejoin_dwell_s=0):
+                  departed_at=None, rejoin_dwell_s=0, leader=""):
     """The leader's merge: reports = [{host, healthy, at, class?,
     preempting?}]. Present = heard from within the agreement window; a
     stale/missing member degrades the slice. A PREEMPTING member (the
@@ -142,13 +256,17 @@ def merge_verdict(num_hosts, reports, agreement_timeout_s, now,
     present healthy host whose ``departed_at[host]`` is younger than
     ``rejoin_dwell_s`` counts as a member but NOT healthy — a
     crash-looper cannot flap healthy-hosts once per restart.
-    Returns {hosts, healthy_hosts, degraded, class, members,
-    dwelling}."""
+    Returns {hosts, healthy_hosts, degraded, class, members, dwelling,
+    successors}; successors (ISSUE 19 pre-declared succession) is every
+    healthy present member except ``leader``, sorted — deterministic
+    from the facts alone, so every member computes the same line of
+    succession."""
     departed_at = departed_at or {}
     members = set()
     healthy = 0
     worst = -1
     dwelling = []
+    successors = []
     for report in reports:
         at = report.get("at", 0)
         if at <= 0 or now - at > agreement_timeout_s:
@@ -166,6 +284,8 @@ def merge_verdict(num_hosts, reports, agreement_timeout_s, now,
             dwelling.append(report["host"])
         if is_healthy:
             healthy += 1
+            if report["host"] != leader:
+                successors.append(report["host"])
         rank = CLASS_RANKS.get(report.get("class") or "", -1)
         worst = max(worst, rank)
     return {
@@ -175,6 +295,7 @@ def merge_verdict(num_hosts, reports, agreement_timeout_s, now,
         "class": RANK_NAMES.get(worst, ""),
         "members": sorted(members),
         "dwelling": sorted(dwelling),
+        "successors": sorted(successors),
     }
 
 

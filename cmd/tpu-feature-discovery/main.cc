@@ -30,6 +30,7 @@
 #include <vector>
 
 #include "tfd/agg/runner.h"
+#include "tfd/remedy/remedy.h"
 #include "tfd/placement/placement.h"
 #include "tfd/config/config.h"
 #include "tfd/fault/fault.h"
@@ -2482,6 +2483,22 @@ int Main(int argc, char** argv) {
         case placement::PlacementOutcome::kRestart:
           continue;
         case placement::PlacementOutcome::kError:
+          return 1;
+      }
+    }
+
+    // Closed-loop remediation mode (remedy/remedy.h): a lease-elected
+    // cordon/drain/rebuild controller consuming the same NodeFeature
+    // streams, dry-run by default (--remedy-dry-run=false to enforce).
+    // Same restart-on-SIGHUP discipline as the aggregator.
+    if (loaded.config.flags.mode == "remedy") {
+      switch (remedy::RunRemedy(loaded.config, sigmask)) {
+        case remedy::RemedyOutcome::kExit:
+          TFD_LOG_INFO << "exiting";
+          return 0;
+        case remedy::RemedyOutcome::kRestart:
+          continue;
+        case remedy::RemedyOutcome::kError:
           return 1;
       }
     }

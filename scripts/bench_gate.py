@@ -57,6 +57,16 @@ top so the per-mode functions only state their invariants:
               window and zero sampled exact-parity misses, zero full
               recomputes on every tier, staleness p99 vs
               BENCH_shard.json.
+  --remedy    (ISSUE 20) closed-loop remediation soak record
+              (cluster_soak.py --remedy): the dry-run pass byte-zero on
+              the node objects AND job-stream-identical to control,
+              zero false-positive cordons, zero non-excused stage-
+              budget violations, every interlock (node-rate-limit,
+              slo-burn, disruption-budget, domain-cap) and the
+              rollback/backoff drills actually fired, enforce strictly
+              reduces bad placements within a bounded p99 cost, budget/
+              config/vocabulary drift vs the live code, per-class
+              remediation p99 vs BENCH_remedy.json.
   --slo       (ISSUE 16) the fleet-SLO section of a cluster-soak
               record: the injected latency regression asserts a
               multi-window burn in the fast window and clears after the
@@ -94,6 +104,7 @@ Usage:
   python3 scripts/bench_gate.py --slo cluster-soak.json
   python3 scripts/bench_gate.py --explain cluster-soak.json
   python3 scripts/bench_gate.py --shard BENCH_shard.json
+  python3 scripts/bench_gate.py --remedy BENCH_remedy.json
 """
 
 import argparse
@@ -1107,6 +1118,94 @@ def shard_gate(record_path, reference_path, slack,
     return problems
 
 
+def remedy_gate(record_path, reference_path, slack):
+    """Gates a closed-loop remediation soak record
+    (scripts/cluster_soak.py --remedy): the ISSUE 20 acceptance
+    invariants on the committed record, the protocol/budget drift
+    checks against the live code, and the per-evidence-class latency
+    regression vs BENCH_remedy.json."""
+    problems = []
+    record = load_record(record_path, "remedy", problems)
+    if record is None:
+        return problems
+
+    if record.get("mode") != "remedy":
+        problems.append(
+            f"record mode {record.get('mode')!r} is not 'remedy' — "
+            "gate a record from cluster_soak.py --remedy")
+        return problems
+
+    # The soak's own acceptance invariants, re-checked on the COMMITTED
+    # record (one implementation — the soak and the gate cannot drift).
+    import cluster_soak
+
+    problems.extend(cluster_soak.check_remedy_record(record))
+
+    # The committed record must carry a PINNED determinism proof
+    # (--once writes null; that's fine for a smoke run, not for the
+    # committed reference).
+    if record.get("determinism_ok") is not True:
+        problems.append("committed record has no pinned determinism "
+                        "proof (regenerate without --once)")
+
+    # Drift checks: the budgets/config the record was scored against
+    # must match the live protocol constants, and the action/interlock
+    # vocabularies must match the engine's closed sets — adding an
+    # action or loosening a budget without regenerating the record
+    # fails here.
+    from tpufd import remedy as remedylib
+
+    if record.get("stage_budgets_ms") != \
+            cluster_soak.REMEDY_STAGE_BUDGETS_MS:
+        problems.append(
+            f"record stage budgets {record.get('stage_budgets_ms')} != "
+            f"live REMEDY_STAGE_BUDGETS_MS "
+            f"{cluster_soak.REMEDY_STAGE_BUDGETS_MS} — regenerate "
+            "BENCH_remedy.json")
+    if record.get("engine_config") != cluster_soak.REMEDY_ENGINE_CFG:
+        problems.append(
+            "record engine_config drifted from the live "
+            "REMEDY_ENGINE_CFG — regenerate BENCH_remedy.json")
+    score = require(record, "scorecard", "remedy", problems)
+    if score is not None:
+        if sorted(score.get("actions", {})) != \
+                sorted(remedylib.ACTION_KINDS):
+            problems.append(
+                f"scorecard action kinds {sorted(score.get('actions', {}))} "
+                f"!= the engine's closed vocabulary "
+                f"{sorted(remedylib.ACTION_KINDS)}")
+        if sorted(score.get("blocked", {})) != \
+                sorted(remedylib.INTERLOCKS):
+            problems.append(
+                f"scorecard interlocks {sorted(score.get('blocked', {}))} "
+                f"!= the engine's closed vocabulary "
+                f"{sorted(remedylib.INTERLOCKS)}")
+
+    # Reference regression: the per-evidence-class end-to-end
+    # remediation p99s (fault -> acked) on the enforce pass.
+    ref = load_reference(reference_path, "remedy", problems)
+    if ref is not None:
+        got_bd = (record.get("enforce", {}).get("remedy", {})
+                  .get("stage_breakdown", {}))
+        want_bd = (ref.get("enforce", {}).get("remedy", {})
+                   .get("stage_breakdown", {}))
+        for cls in ("crash-loop", "gray", "preempt"):
+            got = got_bd.get(cls, {}).get("e2e_p99_ms")
+            want = want_bd.get(cls, {}).get("e2e_p99_ms")
+            if got is None:
+                problems.append(f"record has no {cls} e2e_p99_ms")
+            if want is None:
+                problems.append(f"reference has no {cls} e2e_p99_ms")
+            if got is None or want is None:
+                continue
+            if want > 0 and got > want * (1.0 + slack):
+                problems.append(
+                    f"{cls} remediation e2e p99 {got}ms regressed past "
+                    f"{want * (1.0 + slack):.2f} (reference {want} "
+                    f"+{int(slack * 100)}%)")
+    return problems
+
+
 def reference_dirty_p50_ms(path):
     """steady_dirty_p50_ms from a committed bench record (either the
     bare record or the driver's {parsed: ...} wrapper)."""
@@ -1191,6 +1290,16 @@ def main(argv=None):
     ap.add_argument("--shard-staleness-budget-s", type=float,
                     default=1.0)
     ap.add_argument("--shard-qps-floor", type=float, default=1000.0)
+    ap.add_argument("--remedy", metavar="RECORD.json",
+                    help="gate this closed-loop remediation soak "
+                         "record (scripts/cluster_soak.py --remedy "
+                         "--json): dry-run byte-zero, zero "
+                         "false-positive cordons, every interlock + "
+                         "rollback drill fired, stage budgets held, "
+                         "per-class latency vs BENCH_remedy.json")
+    ap.add_argument("--remedy-reference",
+                    default=os.path.join(repo, "BENCH_remedy.json"))
+    ap.add_argument("--remedy-slack", type=float, default=0.5)
     ap.add_argument("--slo", metavar="RECORD.json",
                     help="gate the fleet-SLO section of a cluster-soak "
                          "record: burn timing vs the injected latency "
@@ -1253,6 +1362,10 @@ def main(argv=None):
             args.cluster, args.cluster_reference, args.cluster_slack,
             args.cluster_placement_budget_ms,
             args.cluster_recovery_budget_s))
+
+    if args.remedy:
+        return run_mode("remedy", remedy_gate(
+            args.remedy, args.remedy_reference, args.remedy_slack))
 
     if args.slo:
         return run_mode("slo", slo_gate(args.slo))

@@ -85,6 +85,7 @@ sys.path.insert(0, os.path.dirname(
 from tpufd import agg as agglib  # noqa: E402
 from tpufd import cluster as clusterlib  # noqa: E402
 from tpufd import placement as placementlib  # noqa: E402
+from tpufd import remedy as remedylib  # noqa: E402
 from tpufd import sink as sinklib  # noqa: E402
 from tpufd.fakes.simnet import (  # noqa: E402
     SimAggregator, SimClock, percentile)
@@ -2372,6 +2373,812 @@ def main_shard(args):
     return 0
 
 
+# ---- the closed-loop remediation soak (ISSUE 20) --------------------------
+
+# The remediation pipeline's protocol constants, time-compressed onto
+# the virtual clock like the SLO windows above. The stage budgets are
+# DERIVED from them (each stage's worst case + ~2x slack), so loosening
+# a constant without re-deriving the budget fails the gate loudly.
+REMEDY_OBSERVE_S = (0.05, 0.2)   # ground truth -> daemon publish lands
+REMEDY_WATCH_S = (0.02, 0.1)     # store apply -> controller observation
+REMEDY_DECIDE_TICK_S = 1.0       # the controller's decision cadence
+REMEDY_PATCH_RTT_S = (0.02, 0.08)  # node patch issue -> apiserver ack
+REMEDY_STAGE_BUDGETS_MS = {
+    # evidence crosses its ground-truth threshold -> the engine SEES it:
+    # one publish (<= 200ms) + one watch delivery (<= 100ms), 2x slack.
+    "detect": 600.0,
+    # seen -> the decision tick emits the action: one tick, ~1.6x slack.
+    "decide": 1600.0,
+    # emitted -> the write is issued: same tick pass.
+    "act": 100.0,
+    # issued -> the apiserver acks: one patch RTT (<= 80ms), ~2x slack.
+    "acked": 300.0,
+}
+REMEDY_ENGINE_CFG = dict(
+    window_s=10.0, flap_threshold=3, heal_dwell_s=4.0, cooldown_s=1.0,
+    backoff_base_s=0.5, backoff_max_s=4.0, max_concurrent_cordons=3,
+    domain_cap=1, rebuild_cooldown_s=20.0)
+REMEDY_JOB_CHIPS = 8
+REMEDY_JOB_FAIL_DETECT_S = 0.5
+REMEDY_DRAIN_TICK_S = 0.25
+
+
+def remedy_schedule_text():
+    """The remediation drill timeline (tpufd.cluster grammar plus the
+    domain declarations). Op mapping in THIS soak: `degrade` flips the
+    headline class (an eligibility down-flip — crash-loop fuel);
+    `degrade ... gray=1` degrades one CHIP while the headline stays
+    good (the gray-failure drill); `brownout` sheds node patches
+    (write-failure/backoff drill); `slowdown` models the burn verdict
+    the ISSUE 16 engine derives from a stretched-write window, arming
+    the slo-burn interlock; `domain-fail`/`domain-heal` flip every
+    member of a declared failure domain at once (the correlated-failure
+    drill the domain-cap interlock meters)."""
+    return """\
+domain rack-a hosts=s0/h0,s0/h1,s0/h2,s0/h3
+domain rack-b hosts=s1/h0,s1/h1,s1/h2,s1/h3
+domain rack-c hosts=s2/h0,s2/h1,s2/h2,s2/h3
+# phase A — crash-loop flapper: 3 down-flips inside the 10s window
+10   degrade s3/h0
+11   heal    s3/h0
+12   degrade s3/h0
+13   heal    s3/h0
+14   degrade s3/h0
+22   heal    s3/h0
+# phase B — gray chip degradation, then the rollback drill: the chip
+# heals, the evidence stays retracted through the dwell, uncordon
+16   degrade s3/h1 gray=1
+30   heal    s3/h1
+# phase C — preempt-imminent lifecycle -> drain-recommend (label only)
+20   preempt s3/h2
+34   preempt-clear s3/h2
+# phase D — a gray failure lands INSIDE a brownout: the cordon write is
+# shed, backoff arms (node-rate-limit), the retry lands after the window
+38   brownout apiserver secs=3
+38.5 degrade s3/h3 gray=1
+50   heal    s3/h3
+# phase E — the slo-burn damper: a gray failure mid-burn defers its
+# cordon until the burn verdict clears
+44   slowdown apiserver secs=6
+45   degrade s2/h0 gray=1
+56   heal    s2/h0
+# phase F — the correlated domain storm: three racks flap together;
+# disruption-budget + domain-cap meter the cordons, the queue backs up
+# onto the one clean rack and the rebuild recommendation fires
+60   domain-fail rack-a
+61   domain-heal rack-a
+62   domain-fail rack-a
+63   domain-heal rack-a
+64   domain-fail rack-a
+60.5 domain-fail rack-b
+61.5 domain-heal rack-b
+62.5 domain-fail rack-b
+63.5 domain-heal rack-b
+64.5 domain-fail rack-b
+61   domain-fail rack-c
+62   domain-heal rack-c
+63   domain-fail rack-c
+64   domain-heal rack-c
+65   domain-fail rack-c
+78   domain-heal rack-a
+79   domain-heal rack-b
+80   domain-heal rack-c
+"""
+
+
+class RemedyStore:
+    """The apiserver's two surfaces as the remediation controller sees
+    them: the label CRs (read path) and the node objects (the cordon
+    write path). Node patches are the ONLY mutation the controller
+    performs; the dry-run proof hashes `nodes` before/after."""
+
+    def __init__(self, names):
+        self.labels = {}        # node -> published label dict
+        self.nodes = {name: {"metadata": {"name": name,
+                                          "resourceVersion": "1"},
+                             "spec": {"unschedulable": False}}
+                      for name in names}
+        self.node_patches = 0
+        self.write_rejects = 0
+        self.brownout_until = -1.0
+
+    def brownout(self, now, secs):
+        self.brownout_until = max(self.brownout_until, now + secs)
+
+    def patch_node(self, now, name, unschedulable):
+        """Merge-patch spec.unschedulable. A browned-out server sheds
+        node patches outright (server-directed pacing, Retry-After):
+        the caller's backoff + re-emit is the drill."""
+        if now < self.brownout_until:
+            self.write_rejects += 1
+            return False
+        node = self.nodes[name]
+        node["spec"]["unschedulable"] = bool(unschedulable)
+        node["metadata"]["resourceVersion"] = str(
+            int(node["metadata"]["resourceVersion"]) + 1)
+        self.node_patches += 1
+        return True
+
+    def unschedulable(self, name):
+        return self.nodes[name]["spec"]["unschedulable"]
+
+    def nodes_sha(self):
+        return hashlib.sha256(canonical_bytes(self.nodes)).hexdigest()
+
+
+class RemedyHost:
+    """Ground truth for one node in the remediation soak. Publishes the
+    label surface the engine consumes; the scheduler reads the same
+    published labels (never the gt_* fields)."""
+
+    def __init__(self, clock, rng, store, name, domain):
+        self.clock = clock
+        self.rng = rng
+        self.store = store
+        self.name = name
+        self.domain = domain
+        self.chips = 8
+        self.gt_headline = False   # headline class degraded (flap fuel)
+        self.gt_gray = False       # one chip degraded, headline good
+        self.gt_preempt = False
+        self.on_publish = None     # callable(now, name, labels) or None
+
+    def bad(self):
+        return self.gt_headline or self.gt_gray or self.gt_preempt
+
+    def labels(self):
+        out = {
+            PREFIX + "tfd.node": self.name,
+            remedylib.TPU_COUNT: str(self.chips),
+            remedylib.PERF_CLASS:
+                "degraded" if self.gt_headline else "gold",
+        }
+        if self.domain:
+            out[remedylib.DOMAIN_LABEL] = self.domain
+        if self.gt_gray:
+            out[remedylib.CHIP_CLASS_PREFIX + "0"
+                + remedylib.CHIP_CLASS_SUFFIX] = "degraded"
+        if self.gt_preempt:
+            out[remedylib.LIFECYCLE_PREEMPT] = "true"
+        return out
+
+    def publish(self, now):
+        delay = self.rng.uniform(*REMEDY_OBSERVE_S)
+        self.clock.schedule(now + delay, lambda t: self._land(t))
+
+    def _land(self, now):
+        labels = self.labels()
+        self.store.labels[self.name] = labels
+        if self.on_publish is not None:
+            self.on_publish(now, self.name, labels)
+
+
+class SimRemedy:
+    """The `--mode=remedy` runner twin on the virtual clock: consumes
+    observations into the REAL tpufd.remedy.RemedyEngine, executes (or,
+    under dry-run, journals) its actions against the RemedyStore, and
+    tracks every executed action's detect->decide->act->acked chain
+    with the REAL RemedyTracker. `dry_run` is a runner property — the
+    engine state machine is identical in both, which is what makes the
+    dry-run journal a faithful preview."""
+
+    def __init__(self, clock, rng, store, dry_run):
+        self.clock = clock
+        self.rng = rng
+        self.store = store
+        self.dry_run = dry_run
+        self.engine = remedylib.RemedyEngine(
+            remedylib.RemedyConfig(**REMEDY_ENGINE_CFG))
+        self.tracker = remedylib.RemedyTracker()
+        self.chains = []           # closed chains (+ excused flag)
+        self.intents = []          # dry-run journal (kind, node, t)
+        self.detect_seen = {}      # node -> t the detect edge fired
+        self.fault_since = {}      # node -> {class: gt threshold t}
+        self.gt_flips = {}         # node -> injected down-flip times
+        self.excused = set()       # nodes whose next chain is excused
+        self.false_positives = 0
+        self.reemits = 0
+        self.queued_chips = lambda: 0
+
+    # ---- ground-truth bookkeeping (fed by apply_remedy_event) -------------
+
+    def gt_down_flip(self, node, now):
+        window = self.engine.config.window_s
+        flips = self.gt_flips.setdefault(node, [])
+        flips.append(now)
+        self.gt_flips[node] = [t for t in flips if t > now - window]
+        if len(self.gt_flips[node]) >= self.engine.config.flap_threshold:
+            self.fault_since.setdefault(node, {}).setdefault(
+                "crash-loop",
+                self.gt_flips[node][self.engine.config.flap_threshold - 1])
+
+    def gt_set(self, node, cls, active, now):
+        per = self.fault_since.setdefault(node, {})
+        if active:
+            per.setdefault(cls, now)
+        else:
+            per.pop(cls, None)
+            if cls == "crash-loop":
+                self.gt_flips.pop(node, None)
+
+    # ---- the observation feed ---------------------------------------------
+
+    def on_publish(self, now, node, labels):
+        """Store apply -> this controller's watch delivery. The delay
+        draws from the CONTROLLER's rng stream, so attaching a
+        controller does not perturb the job/publish streams — the
+        control and dry-run passes stay identical on the job side."""
+        watch = self.rng.uniform(*REMEDY_WATCH_S)
+        self.clock.schedule(
+            now + watch,
+            lambda t, ls=dict(labels): self.on_observation(t, node, ls))
+
+    def on_observation(self, now, node, labels):
+        if self.engine.observe_node(node, labels, now):
+            self.detect_seen.setdefault(node, now)
+
+    def observe_inventory(self, labels, now):
+        self.engine.observe_inventory(labels, now)
+
+    # ---- the decision loop ------------------------------------------------
+
+    def start(self, t0):
+        self.clock.schedule(t0, lambda now: self._tick(now))
+
+    def _tick(self, now):
+        self.engine.observe_demand(self.queued_chips(), now)
+        actions, blocked = self.engine.tick(now)
+        for node, _ in blocked:
+            # An interlock deferred this node: its eventual chain
+            # measures policy dwell, not pipeline latency — excused
+            # from the stage budgets (still counted + gated on edges).
+            self.excused.add(node)
+        for action in actions:
+            self._execute(action, now)
+        self.clock.schedule(now + REMEDY_DECIDE_TICK_S,
+                            lambda t: self._tick(t))
+
+    def _chain_t0(self, action):
+        per = self.fault_since.get(action.node, {})
+        if action.kind == "cordon" and action.evidence in per:
+            return per[action.evidence]
+        if action.kind == "drain-recommend" and "preempt" in per:
+            return per["preempt"]
+        return action.detected_at
+
+    def _execute(self, action, now):
+        node = action.node
+        if action.kind == "cordon":
+            recent = self.fault_since.get(node, {})
+            if not recent and not self.gt_flips.get(node):
+                self.false_positives += 1
+        excused = node in self.excused
+        n = self.engine.nodes.get(node)
+        if n is not None and n.fail_count > 0:
+            excused = True
+            self.reemits += 1
+        change = self.tracker.mint(
+            self._chain_op(action), node, self._chain_t0(action))
+        self.tracker.stamp(change, "detect",
+                           self.detect_seen.get(node, now))
+        self.tracker.stamp(change, "decide", now)
+        self.tracker.stamp(change, "act", now)
+        if self.dry_run:
+            self.intents.append(
+                {"kind": action.kind, "node": node,
+                 "evidence": action.evidence, "t": round(now, 3)})
+            self.engine.note_action_result(node, action.kind, True, now)
+            self._close(change, now, excused, node)
+            return
+        if action.kind in ("cordon", "uncordon"):
+            rtt = self.rng.uniform(*REMEDY_PATCH_RTT_S)
+            want = action.kind == "cordon"
+            self.clock.schedule(
+                now + rtt,
+                lambda t, c=change, nd=node, w=want, k=action.kind,
+                e=excused: self._ack_patch(t, c, nd, w, k, e))
+        else:
+            # drain/rebuild recommendations are journal + label writes,
+            # never a node mutation; they ack at CR-write latency.
+            rtt = self.rng.uniform(*REMEDY_PATCH_RTT_S)
+            self.clock.schedule(
+                now + rtt,
+                lambda t, c=change, nd=node, k=action.kind,
+                e=excused: self._ack_plain(t, c, nd, k, e))
+
+    def _ack_patch(self, now, change, node, want, kind, excused):
+        if self.store.patch_node(now, node, want):
+            self.engine.note_action_result(node, kind, True, now)
+            self._close(change, now, excused, node)
+        else:
+            self.engine.note_action_result(node, kind, False, now)
+            self.tracker.discard(change)
+
+    def _ack_plain(self, now, change, node, kind, excused):
+        self.engine.note_action_result(node, kind, True, now)
+        self._close(change, now, excused, node)
+
+    def _close(self, change, now, excused, node):
+        record = self.tracker.close(change, now)
+        if record is not None:
+            record["excused"] = excused
+            self.chains.append(record)
+        self.detect_seen.pop(node, None)
+        self.excused.discard(node)
+
+    @staticmethod
+    def _chain_op(action):
+        # The per-class scorecard key: the evidence class for cordons
+        # ("crash-loop"/"gray"), "preempt" for drains, the action kind
+        # for rollbacks and rebuilds.
+        if action.kind == "cordon":
+            return action.evidence
+        if action.kind == "drain-recommend":
+            return "preempt"
+        return action.kind
+
+
+def apply_remedy_event(ev, now, store, hosts, domains, remedy):
+    """Dispatch one ScheduleEvent into the remedy soak's ground truth
+    (op mapping documented on remedy_schedule_text)."""
+    def flip_headline(host, bad):
+        was_bad = not remedylib.eligible(host.labels())
+        host.gt_headline = bad
+        now_bad = not remedylib.eligible(host.labels())
+        if remedy is not None and now_bad and not was_bad:
+            remedy.gt_down_flip(host.name, now)
+        host.publish(now)
+
+    if ev.op == "brownout":
+        store.brownout(now, float(ev.args.get("secs", "3")))
+        return
+    if ev.op == "slowdown":
+        # The burn verdict the stretched-write window produces (ISSUE
+        # 16), fed to the controller as the inventory CR it watches.
+        secs = float(ev.args.get("secs", "6"))
+        if remedy is not None:
+            remedy.observe_inventory(
+                {agglib.SLO_BURN_PREFIX + "publish.burn": "true"}, now)
+            remedy.clock.schedule(
+                now + secs,
+                lambda t: remedy.observe_inventory({}, t))
+        return
+    if ev.op in clusterlib.DOMAIN_OPS:
+        for si, hi in domains[ev.args["domain"]]:
+            host = hosts[f"sim-s{si:02d}-h{hi:02d}"]
+            flip_headline(host, ev.op == "domain-fail")
+        return
+    host = hosts[f"sim-s{ev.slice_idx:02d}-h{ev.host_idx:02d}"]
+    if ev.op == "degrade":
+        if ev.args.get("gray"):
+            host.gt_gray = True
+            if remedy is not None:
+                remedy.gt_set(host.name, "gray", True, now)
+            host.publish(now)
+        else:
+            flip_headline(host, True)
+    elif ev.op == "heal":
+        if host.gt_gray and remedy is not None:
+            remedy.gt_set(host.name, "gray", False, now)
+        host.gt_gray = False
+        flip_headline(host, False)
+    elif ev.op == "preempt":
+        host.gt_preempt = True
+        if remedy is not None:
+            remedy.gt_set(host.name, "preempt", True, now)
+        host.publish(now)
+    elif ev.op == "preempt-clear":
+        host.gt_preempt = False
+        if remedy is not None:
+            remedy.gt_set(host.name, "preempt", False, now)
+        host.publish(now)
+    else:
+        raise ValueError(f"op {ev.op} has no remedy-soak mapping")
+
+
+def run_remedy_pass(args, schedule_text, mode):
+    """One full remediation soak pass on a fresh virtual clock. mode:
+    'control' (no controller), 'dry-run' (controller journals, never
+    writes), 'enforce' (controller cordons for real)."""
+    # Three independent rng streams so the CONTROLLER's draws never
+    # perturb the publish/job streams: control vs dry-run must stay
+    # byte-identical on the job side (the dry-run faithfulness proof),
+    # and control vs enforce must differ only through the cordons.
+    rng_pub = random.Random(args.seed * 9176 + 11)
+    rng_jobs = random.Random(args.seed * 31337 + 7)
+    rng_remedy = random.Random(args.seed * 77003 + 3)
+    rng = rng_jobs
+    clock = SimClock()
+    names = [f"sim-s{si:02d}-h{hi:02d}"
+             for si in range(args.slices) for hi in range(args.hosts)]
+    events, domains = clusterlib.parse_schedule_with_domains(
+        schedule_text)
+    store = RemedyStore(names)
+    domain_of = {f"sim-s{si:02d}-h{hi:02d}": name
+                 for name, members in domains.items()
+                 for si, hi in members}
+    hosts = {name: RemedyHost(clock, rng_pub, store, name,
+                              domain_of.get(name, ""))
+             for name in names}
+
+    remedy = None
+    if mode != "control":
+        remedy = SimRemedy(clock, rng_remedy, store,
+                           dry_run=(mode == "dry-run"))
+        for host in hosts.values():
+            host.on_publish = remedy.on_publish
+        remedy.start(5.0)
+
+    # ---- the job stream: labels-only scheduler + gt scoring ---------------
+    queue = []                 # FIFO of (job_id, enqueue_t)
+    running = {}               # job_id -> (node, gen)
+    used_chips = {name: 0 for name in names}
+    stats = {"submitted": 0, "completed": 0, "failed_bad_hw": 0,
+             "requeued": 0, "placements": 0, "bad_placements": 0}
+    submit_t = {}
+    completion_s = []
+    wait_ms = []
+    gen = {}
+    drain_live = [False]
+
+    def queued_chips():
+        return REMEDY_JOB_CHIPS * len(queue)
+
+    if remedy is not None:
+        remedy.queued_chips = queued_chips
+
+    def complete(now, job_id, g):
+        if gen.get(job_id, 0) != g or job_id not in running:
+            return
+        node, _ = running.pop(job_id)
+        used_chips[node] -= REMEDY_JOB_CHIPS
+        stats["completed"] += 1
+        completion_s.append(now - submit_t[job_id])
+        schedule_drain(now)
+
+    def fail_jobs_on(now, node):
+        doomed = sorted(j for j, (n, _) in running.items() if n == node)
+
+        def fail(t, doomed=tuple(doomed)):
+            for job_id in doomed:
+                if job_id in running and running[job_id][0] == node:
+                    running.pop(job_id)
+                    used_chips[node] -= REMEDY_JOB_CHIPS
+                    gen[job_id] = gen.get(job_id, 0) + 1
+                    stats["failed_bad_hw"] += 1
+                    stats["requeued"] += 1
+                    queue.append((job_id, t))
+            schedule_drain(t)
+
+        if doomed:
+            clock.schedule(now + REMEDY_JOB_FAIL_DETECT_S, fail)
+
+    def placeable(now, name):
+        labels = store.labels.get(name)
+        if labels is None or not remedylib.eligible(labels):
+            return False
+        if store.unschedulable(name):
+            return False
+        return used_chips[name] + REMEDY_JOB_CHIPS <= hosts[name].chips
+
+    def drain(now):
+        drain_live[0] = False
+        while queue:
+            job_id, enq_t = queue[0]
+            node = next((n for n in names if placeable(now, n)), None)
+            if node is None:
+                clock.schedule(now + REMEDY_DRAIN_TICK_S,
+                               lambda t: schedule_drain(t))
+                return
+            queue.pop(0)
+            used_chips[node] += REMEDY_JOB_CHIPS
+            g = gen.get(job_id, 0)
+            running[job_id] = (node, g)
+            stats["placements"] += 1
+            wait_ms.append((now - enq_t) * 1000.0)
+            if hosts[node].bad():
+                stats["bad_placements"] += 1
+                fail_jobs_on(now, node)
+            else:
+                duration = rng.uniform(4.0, 7.0)
+                clock.schedule(
+                    now + duration,
+                    lambda t, j=job_id, g=g: complete(t, j, g))
+
+    def schedule_drain(now):
+        if drain_live[0] or not queue:
+            return
+        drain_live[0] = True
+        clock.schedule(now + 0.05, drain)
+
+    def arrive(now, job_id):
+        stats["submitted"] += 1
+        submit_t[job_id] = now
+        queue.append((job_id, now))
+        schedule_drain(now)
+
+    # Bootstrap: every host publishes its baseline, staggered.
+    for name in sorted(names):
+        clock.schedule(sinklib.hash_unit(name) * 2.0,
+                       lambda now, h=hosts[name]: h.publish(now))
+    # Jobs every 0.5s from t=5 through t=95.
+    for i in range(180):
+        clock.schedule(5.0 + i * 0.5,
+                       lambda now, j=f"job-{i:05d}": arrive(now, j))
+    for ev in events:
+        clock.schedule(
+            ev.at,
+            lambda now, ev=ev: apply_remedy_event(
+                ev, now, store, hosts, domains, remedy))
+    t_end = max(e.at for e in events) + 40.0
+    clock.run(t_end)
+
+    record = {
+        "mode": mode,
+        "jobs_submitted": stats["submitted"],
+        "jobs_completed": stats["completed"],
+        "jobs_failed_on_bad_hw": stats["failed_bad_hw"],
+        "jobs_requeued": stats["requeued"],
+        "placements_total": stats["placements"],
+        "bad_placements": stats["bad_placements"],
+        "completion_p50_s": round(percentile(completion_s, 50), 3),
+        "completion_p99_s": round(percentile(completion_s, 99), 3),
+        "queue_wait_p99_ms": round(percentile(wait_ms, 99), 3),
+        "final_queue_len": len(queue),
+        "final_running": len(running),
+        "node_patches": store.node_patches,
+        "write_rejects": store.write_rejects,
+        "nodes_sha256": store.nodes_sha(),
+        "final_unschedulable": sorted(
+            n for n in names if store.unschedulable(n)),
+    }
+    if remedy is not None:
+        # Stage budgets gate the fault->acked pipeline for the three
+        # evidence classes. Uncordons measure the heal DWELL by design
+        # and rebuilds have no per-node fault edge, so neither is
+        # budget-gated; interlock-deferred chains are excused (the
+        # deferral is policy, not pipeline latency) but still counted.
+        gated = [c for c in remedy.chains
+                 if not c["excused"]
+                 and c["op"] in ("crash-loop", "gray", "preempt")]
+        violations = []
+        for chain in gated:
+            for stage, budget in sorted(REMEDY_STAGE_BUDGETS_MS.items()):
+                if chain["stages"][stage] > budget:
+                    violations.append(
+                        {"change": chain["change"], "op": chain["op"],
+                         "node": chain["node"], "stage": stage,
+                         "ms": chain["stages"][stage], "budget_ms": budget})
+        breakdown_in = [dict(c, op=c["op"]) for c in remedy.chains]
+        record["remedy"] = {
+            "counters": remedy.engine.counters,
+            "cordoned_at_end": remedy.engine.cordoned_nodes(),
+            "chains_closed": len(remedy.chains),
+            "chains_budget_gated": len(gated),
+            "chains_excused": len(remedy.chains) - len(gated),
+            "reemits": remedy.reemits,
+            "false_positives": remedy.false_positives,
+            "open_chains": len(remedy.tracker.open),
+            "intents": len(remedy.intents),
+            "budget_violations": violations[:10],
+            "budget_violations_total": len(violations),
+            "stage_breakdown": clusterlib.stage_breakdown(
+                breakdown_in, percentile,
+                stages=remedylib.REMEDY_STAGES),
+            "render_sha256": hashlib.sha256(
+                remedy.engine.render_json().encode()).hexdigest(),
+        }
+    return record
+
+
+def run_remedy_sim(args, schedule_text):
+    control = run_remedy_pass(args, schedule_text, "control")
+    dry = run_remedy_pass(args, schedule_text, "dry-run")
+    enforce = run_remedy_pass(args, schedule_text, "enforce")
+    events, domains = clusterlib.parse_schedule_with_domains(
+        schedule_text)
+    by_op = {}
+    for ev in events:
+        by_op[ev.op] = by_op.get(ev.op, 0) + 1
+    enforce_remedy = enforce["remedy"]
+    record = {
+        "mode": "remedy",
+        "seed": args.seed,
+        "slices": args.slices,
+        "hosts_per_slice": args.hosts,
+        "nodes": args.slices * args.hosts,
+        "engine_config": dict(REMEDY_ENGINE_CFG),
+        "stage_budgets_ms": dict(REMEDY_STAGE_BUDGETS_MS),
+        "domains": {name: [f"s{si}/h{hi}" for si, hi in members]
+                    for name, members in sorted(domains.items())},
+        "schedule_events": {op: by_op[op] for op in sorted(by_op)},
+        "control": control,
+        "dry_run": dry,
+        "enforce": enforce,
+        "scorecard": {
+            "bad_placements": {
+                "control": control["bad_placements"],
+                "dry_run": dry["bad_placements"],
+                "enforce": enforce["bad_placements"]},
+            "completion_p99_s": {
+                "control": control["completion_p99_s"],
+                "dry_run": dry["completion_p99_s"],
+                "enforce": enforce["completion_p99_s"]},
+            "actions": enforce_remedy["counters"]["actions"],
+            "blocked": enforce_remedy["counters"]["blocked"],
+            "rollback_drills": enforce_remedy["counters"]["rollbacks"],
+            "write_failures":
+                enforce_remedy["counters"]["write_failures"],
+            "false_positives": enforce_remedy["false_positives"],
+            "budget_violations":
+                enforce_remedy["budget_violations_total"],
+            "remediated_classes": sorted(
+                enforce_remedy["stage_breakdown"]),
+            "dry_run_zero_writes": (
+                dry["node_patches"] == 0
+                and dry["nodes_sha256"] == control["nodes_sha256"]),
+            "dry_run_intents": dry["remedy"]["intents"],
+        },
+    }
+    return record
+
+
+def check_remedy_record(record):
+    """The remediation soak's acceptance invariants (bench_gate --remedy
+    re-checks the committed record with the reference regression on
+    top)."""
+    problems = []
+    score = record["scorecard"]
+    control, dry, enforce = (record["control"], record["dry_run"],
+                             record["enforce"])
+    if not score["dry_run_zero_writes"]:
+        problems.append(
+            "dry-run mutated the node objects (patches "
+            f"{dry['node_patches']}, sha match "
+            f"{dry['nodes_sha256'] == control['nodes_sha256']}) — "
+            "--remedy-dry-run is not byte-zero")
+    if score["dry_run_intents"] == 0:
+        problems.append("dry-run journaled no intents — the preview "
+                        "is vacuous")
+    if control["node_patches"] != 0:
+        problems.append("the control pass patched a node — the "
+                        "baseline is contaminated")
+    if score["budget_violations"] != 0:
+        problems.append(
+            f"{score['budget_violations']} non-excused stage-budget "
+            f"violation(s), e.g. "
+            f"{enforce['remedy']['budget_violations'][:3]}")
+    if score["false_positives"] != 0:
+        problems.append(
+            f"{score['false_positives']} cordon(s) of a node with no "
+            "injected fault — the evidence pipeline misfired")
+    if score["rollback_drills"] == 0:
+        problems.append("no uncordon rollback ever ran — the heal "
+                        "dwell drill is vacuous")
+    for interlock in remedylib.INTERLOCKS:
+        if score["blocked"].get(interlock, 0) == 0:
+            problems.append(
+                f"interlock {interlock} never fired — its drill is "
+                "vacuous")
+    for cls in ("crash-loop", "gray", "preempt"):
+        n = enforce["remedy"]["stage_breakdown"].get(
+            cls, {}).get("n", 0)
+        if n == 0:
+            problems.append(
+                f"no closed remediation chain for evidence class "
+                f"{cls} — the per-class latency scorecard has a hole")
+    if score["actions"].get("rebuild-recommend", 0) == 0:
+        problems.append("the capacity-gap rebuild recommendation never "
+                        "fired during the domain storm")
+    if score["write_failures"] == 0 or enforce["write_rejects"] == 0:
+        problems.append("the brownout never rejected a cordon write — "
+                        "the backoff/retry drill is vacuous")
+    if enforce["remedy"]["reemits"] == 0:
+        problems.append("a rejected write was never re-emitted — the "
+                        "backoff retry never landed")
+    if enforce["bad_placements"] >= control["bad_placements"]:
+        problems.append(
+            f"enforce placed {enforce['bad_placements']} jobs on bad "
+            f"hardware vs control's {control['bad_placements']} — "
+            "remediation did not help placement")
+    # The faithfulness proof: with the controller on its own rng
+    # stream, a dry-run pass must be INDISTINGUISHABLE from control on
+    # the job side — same placements, same failures, same latencies.
+    for key in ("bad_placements", "jobs_failed_on_bad_hw",
+                "completion_p99_s", "queue_wait_p99_ms",
+                "placements_total"):
+        if dry[key] != control[key]:
+            problems.append(
+                f"dry-run {key} {dry[key]} != control {control[key]} "
+                "— the dry-run controller perturbed the workload")
+    # Cordons trade tail latency for correctness: removing flapping
+    # capacity mid-storm may stretch the queue, but the cost is
+    # budgeted — enforce p99 stays within 25% of control while the
+    # doomed placements drop.
+    ceiling = round(control["completion_p99_s"] * 1.25, 3)
+    if enforce["completion_p99_s"] > ceiling:
+        problems.append(
+            f"enforce completion p99 {enforce['completion_p99_s']}s "
+            f"exceeds the 1.25x-control budget {ceiling}s — the "
+            "cordons cost more than the doom loops saved")
+    for name, pass_record in (("dry_run", dry), ("enforce", enforce)):
+        remedy = pass_record["remedy"]
+        if remedy["cordoned_at_end"]:
+            problems.append(
+                f"{name}: nodes {remedy['cordoned_at_end']} still "
+                "cordoned after heal-all + drain — a rollback leaked")
+        if remedy["open_chains"] != 0:
+            problems.append(
+                f"{name}: {remedy['open_chains']} remediation chain(s) "
+                "never closed or were leaked")
+        for op, sb in sorted(remedy["stage_breakdown"].items()):
+            if abs(sb["mean_stage_sum_ms"] - sb["mean_e2e_ms"]) > 0.01:
+                problems.append(
+                    f"{name}: {op} stage means sum to "
+                    f"{sb['mean_stage_sum_ms']}ms but the e2e mean is "
+                    f"{sb['mean_e2e_ms']}ms — the stages do not "
+                    "partition the remediation latency")
+    if enforce["final_unschedulable"]:
+        problems.append(
+            f"nodes {enforce['final_unschedulable']} still "
+            "unschedulable at soak end")
+    for name, pass_record in (("control", control), ("dry_run", dry),
+                              ("enforce", enforce)):
+        if pass_record["final_queue_len"] != 0:
+            problems.append(f"{name}: {pass_record['final_queue_len']} "
+                            "job(s) still queued at soak end")
+        if pass_record["jobs_completed"] != pass_record["jobs_submitted"]:
+            problems.append(
+                f"{name}: only {pass_record['jobs_completed']} of "
+                f"{pass_record['jobs_submitted']} jobs ever completed")
+    return problems
+
+
+def main_remedy(args):
+    schedule_text = remedy_schedule_text()
+    if args.schedule:
+        with open(args.schedule) as f:
+            schedule_text = f.read()
+    record = run_remedy_sim(args, schedule_text)
+    problems = check_remedy_record(record)
+
+    if args.once:
+        record["determinism_ok"] = None
+    else:
+        second = run_remedy_sim(args, schedule_text)
+        record["determinism_ok"] = (
+            canonical_bytes(record) == canonical_bytes(second))
+        if not record["determinism_ok"]:
+            problems.append("two runs of the same seed diverged — the "
+                            "remediation soak leaked nondeterminism")
+    record["record_sha256"] = hashlib.sha256(
+        canonical_bytes({k: v for k, v in record.items()
+                         if k not in ("determinism_ok",
+                                      "record_sha256")})).hexdigest()
+
+    print(json.dumps(record))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(record, f, indent=1, sort_keys=True)
+    if problems:
+        for p in problems:
+            print(f"remedy soak FAILED: {p}", file=sys.stderr)
+        return 1
+    score = record["scorecard"]
+    print(
+        f"remedy soak OK: {record['nodes']} nodes / "
+        f"{len(record['domains'])} domains, bad placements "
+        f"control {score['bad_placements']['control']} -> enforce "
+        f"{score['bad_placements']['enforce']}, completion p99 "
+        f"{score['completion_p99_s']['control']}s -> "
+        f"{score['completion_p99_s']['enforce']}s, "
+        f"{score['rollback_drills']} rollback(s), "
+        f"{score['budget_violations']} budget violations, dry-run "
+        f"zero-writes {score['dry_run_zero_writes']}, determinism "
+        f"{'pinned' if record['determinism_ok'] else 'SKIPPED'}")
+    return 0
+
+
 def canonical_bytes(record):
     return json.dumps(record, sort_keys=True,
                       separators=(",", ":")).encode()
@@ -2396,6 +3203,10 @@ def main(argv=None):
                     help="4x3 topology, compressed schedule (CI smoke)")
     ap.add_argument("--once", action="store_true",
                     help="skip the determinism double-run")
+    ap.add_argument("--remedy", action="store_true",
+                    help="run the closed-loop remediation soak (ISSUE "
+                         "20): control vs dry-run vs enforce passes "
+                         "over the correlated-failure-domain schedule")
     ap.add_argument("--placement-qps", type=float, default=0.0,
                     help="> 0 selects the sharded-tree + placement "
                          "soak (ISSUE 17): placement queries per "
@@ -2409,6 +3220,13 @@ def main(argv=None):
                     help="length of the churn window "
                          "(sharded-tree soak)")
     args = ap.parse_args(argv)
+
+    if args.remedy:
+        # Remediation mode: the 4x4 topology the built-in drill
+        # schedule's domains are written against.
+        args.slices = 4
+        args.hosts = 4
+        return main_remedy(args)
 
     if args.placement_qps > 0:
         # Sharded-tree mode: --shards means L1 aggregator shards, not

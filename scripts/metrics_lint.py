@@ -201,6 +201,16 @@ CONDITIONAL = {
     "tfd_placement_rejections_total",
     "tfd_placement_decisions_total",
     "tfd_placement_audit_dropped_total",
+    # Closed-loop remediation (ISSUE 20): all --mode=remedy only — a
+    # different runtime from this daemon boot. Actions/blocked/
+    # rollbacks/write-failures additionally need live evidence edges.
+    "tfd_remedy_state",
+    "tfd_remedy_events_total",
+    "tfd_remedy_cordons_active",
+    "tfd_remedy_actions_total",
+    "tfd_remedy_blocked_total",
+    "tfd_remedy_rollbacks_total",
+    "tfd_remedy_write_failures_total",
 }
 
 

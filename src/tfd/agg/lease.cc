@@ -1,0 +1,167 @@
+#include "tfd/agg/lease.h"
+
+#include <unistd.h>
+
+#include <cctype>
+#include <chrono>
+#include <cstdlib>
+
+#include "tfd/obs/journal.h"
+#include "tfd/slice/coord.h"
+#include "tfd/util/logging.h"
+#include "tfd/util/time.h"
+
+namespace tfd {
+namespace agg {
+
+namespace {
+constexpr char kLeaseKey[] = "lease";
+}  // namespace
+
+double MonoSeconds() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+std::string HolderIdentity() {
+  if (const char* pod = std::getenv("POD_NAME"); pod && *pod) return pod;
+  if (const char* node = std::getenv("NODE_NAME"); node && *node) {
+    return node;
+  }
+  char buf[256] = {0};
+  if (gethostname(buf, sizeof(buf) - 1) == 0 && buf[0]) return buf;
+  return "tfd-aggregator";
+}
+
+std::string UrlEncode(const std::string& s) {
+  static const char hex[] = "0123456789ABCDEF";
+  std::string out;
+  for (unsigned char c : s) {
+    if (isalnum(c) || c == '-' || c == '_' || c == '.' || c == '~') {
+      out.push_back(static_cast<char>(c));
+    } else {
+      out.push_back('%');
+      out.push_back(hex[c >> 4]);
+      out.push_back(hex[c & 15]);
+    }
+  }
+  return out;
+}
+
+std::string CollectionUrl(const k8s::ClusterConfig& config) {
+  return config.apiserver_url + "/apis/nfd.k8s-sigs.io/v1alpha1/namespaces/" +
+         config.namespace_ + "/nodefeatures";
+}
+
+std::string NodeSelectorQuery() {
+  return "labelSelector=" + UrlEncode(kNodeNameLabel);
+}
+
+http::RequestOptions BaseOptions(const k8s::ClusterConfig& config) {
+  http::RequestOptions options;
+  options.ca_file = config.ca_file;
+  if (!config.token.empty()) {
+    options.headers["Authorization"] = "Bearer " + config.token;
+  }
+  options.headers["Accept"] = "application/json";
+  return options;
+}
+
+void LeaseTick(const k8s::ClusterConfig& config,
+               const std::string& lease_doc, const std::string& self,
+               int lease_duration_s, const std::string& journal_role,
+               LeaseState* state) {
+  bool server_alive = false;
+  Result<k8s::CoordDocResult> doc =
+      k8s::GetCoordConfigMap(config, lease_doc, &server_alive, nullptr);
+  bool was_leading = state->leading;
+  if (!doc.ok()) {
+    TFD_LOG_WARNING << journal_role << " lease: " << doc.error();
+    // A 429/503-paced server is ALIVE (it answered): the lease doc's
+    // truth is intact, only this poll was deferred — never a partition
+    // signal. A naked failure, though, means we cannot see the
+    // blackboard: a leader keeps leading only while its own lease
+    // could still be valid. Past a full lease duration without
+    // contact, a standby that CAN see the doc has taken over at
+    // expiry — continuing to act would be exactly the double
+    // leadership the lease exists to prevent, so step down (the run
+    // loop unwinds the leader-only machinery) until contact resumes.
+    if (server_alive) {
+      state->last_contact_mono = MonoSeconds();
+    } else if (state->leading &&
+               MonoSeconds() - state->last_contact_mono >
+                   static_cast<double>(lease_duration_s)) {
+      state->leading = false;
+      obs::DefaultJournal().Record(
+          journal_role + "-follower", journal_role,
+          "stepped down: lease blackboard unreachable for a full lease",
+          {{"holder", self},
+           {"epoch", std::to_string(state->epoch)}});
+    }
+    return;
+  }
+  state->ever_contacted = true;
+  state->last_contact_mono = MonoSeconds();
+  double now_wall = WallClockSeconds();
+  slice::Lease lease;
+  bool have_lease = false;
+  if (doc->found) {
+    auto it = doc->data.find(kLeaseKey);
+    if (it != doc->data.end()) {
+      if (Result<slice::Lease> parsed = slice::ParseLease(it->second);
+          parsed.ok()) {
+        lease = *parsed;
+        have_lease = true;
+      }
+    }
+  }
+
+  auto write_lease = [&](uint64_t epoch, bool create) {
+    slice::Lease next;
+    next.holder = self;
+    next.epoch = epoch;
+    next.renewed_at = now_wall;
+    next.duration_s = lease_duration_s;
+    bool conflict = false;
+    Status wrote = k8s::PatchCoordConfigMap(
+        config, lease_doc, {{kLeaseKey, slice::SerializeLease(next)}},
+        create ? "" : doc->resource_version, create, &conflict,
+        &server_alive, nullptr);
+    if (wrote.ok()) {
+      state->leading = true;
+      state->epoch = epoch;
+      return true;
+    }
+    state->leading = false;
+    return false;
+  };
+
+  if (!doc->found) {
+    write_lease(1, /*create=*/true);
+  } else if (have_lease && lease.holder == self &&
+             !slice::LeaseExpired(lease, now_wall)) {
+    write_lease(lease.epoch, /*create=*/false);  // renew, same epoch
+  } else if (!have_lease || slice::LeaseExpired(lease, now_wall)) {
+    write_lease(lease.epoch + 1, /*create=*/false);  // take over
+  } else {
+    state->leading = false;  // someone else holds a live lease
+  }
+
+  if (state->leading != was_leading) {
+    obs::DefaultJournal().Record(
+        state->leading ? journal_role + "-leader"
+                       : journal_role + "-follower",
+        journal_role,
+        state->leading
+            ? "acquired the " + journal_role + " lease (epoch " +
+                  std::to_string(state->epoch) + ")"
+            : "following (lease held by " + lease.holder + ")",
+        {{"holder", state->leading ? self : lease.holder},
+         {"epoch", std::to_string(state->leading ? state->epoch
+                                                 : lease.epoch)}});
+  }
+}
+
+}  // namespace agg
+}  // namespace tfd

@@ -1,0 +1,72 @@
+#ifndef TFD_AGG_LEASE_H_
+#define TFD_AGG_LEASE_H_
+
+// The lease discipline shared by every cluster-singleton controller:
+// the aggregator (flat, L1 shard, L2 root — agg/runner.cc) and the
+// remediation controller (remedy/remedy.cc). One ConfigMap per lease
+// doc on the slice-coordination blackboard (k8s/client.h), optimistic
+// concurrency via the resourceVersion precondition, epoch fencing on
+// takeover — extracted from agg/runner.cc so a second controller could
+// not fork the election rules.
+
+#include <cstdint>
+#include <string>
+
+#include "tfd/k8s/client.h"
+#include "tfd/util/http.h"
+
+namespace tfd {
+namespace agg {
+
+// The per-node daemons stamp this metadata label on their CRs; a
+// controller's OUTPUT objects deliberately omit it (except L1 partials,
+// which carry it so the L2 root's selector watch sees them).
+inline constexpr char kNodeNameLabel[] = "nfd.node.kubernetes.io/node-name";
+
+// Monotonic seconds (steady_clock): lease contact ages and flush
+// debounce run on this, never the wall clock.
+double MonoSeconds();
+
+// Who holds the lease: the pod identity when scheduled as a Deployment,
+// the node as a fallback, the hostname last.
+std::string HolderIdentity();
+
+// Minimal percent-encoding for a query-parameter value (the
+// labelSelector carries '/' and '.').
+std::string UrlEncode(const std::string& s);
+
+// The NodeFeature collection URL every singleton watches.
+std::string CollectionUrl(const k8s::ClusterConfig& config);
+
+// Selector that keeps a controller's own unlabeled output objects out
+// of its own watch (the aggregator's ingest filter; the remediation
+// controller deliberately watches WITHOUT it — the inventory CR it
+// consumes is exactly such an unlabeled output).
+std::string NodeSelectorQuery();
+
+// Base request options: CA, bearer token, JSON accept.
+http::RequestOptions BaseOptions(const k8s::ClusterConfig& config);
+
+struct LeaseState {
+  bool leading = false;
+  uint64_t epoch = 0;
+  bool ever_contacted = false;
+  // Last successful (or server-alive) blackboard contact, monotonic.
+  double last_contact_mono = 0;
+};
+
+// One lease tick against `lease_doc`: bootstrap, renew, or take over an
+// expired lease. `journal_role` names the controller in the journal
+// ("agg" -> agg-leader/agg-follower, "remedy" ->
+// remedy-leader/remedy-follower) and in log lines. Role-transition
+// gauges are the CALLER's job (each controller owns its own
+// tfd_<role>_state family) — this function only moves `state`.
+void LeaseTick(const k8s::ClusterConfig& config,
+               const std::string& lease_doc, const std::string& self,
+               int lease_duration_s, const std::string& journal_role,
+               LeaseState* state);
+
+}  // namespace agg
+}  // namespace tfd
+
+#endif  // TFD_AGG_LEASE_H_

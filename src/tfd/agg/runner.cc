@@ -12,6 +12,7 @@
 #include <thread>
 
 #include "tfd/agg/agg.h"
+#include "tfd/agg/lease.h"
 #include "tfd/info/version.h"
 #include "tfd/k8s/client.h"
 #include "tfd/k8s/desync.h"
@@ -35,9 +36,7 @@ namespace agg {
 namespace {
 
 constexpr char kLeaseDocName[] = "tfd-aggregator";
-constexpr char kLeaseKey[] = "lease";
 constexpr char kCrNamePrefix[] = "tfd-features-for-";
-constexpr char kNodeNameLabel[] = "nfd.node.kubernetes.io/node-name";
 constexpr char kFieldManager[] = "tfd-aggregator";
 // The sharded aggregation tree's object names: every L1 partial is
 // "tfd-inventory-shard-<i>"; ALL "tfd-inventory-*" names (root and
@@ -73,62 +72,10 @@ ObjKind ClassifyName(const std::string& name,
   return ObjKind::kOther;
 }
 
-double MonoSeconds() {
-  return std::chrono::duration<double>(
-             std::chrono::steady_clock::now().time_since_epoch())
-      .count();
-}
-
-// Who holds the lease: the pod identity when scheduled as a Deployment,
-// the node as a fallback, the hostname last.
-std::string HolderIdentity() {
-  if (const char* pod = std::getenv("POD_NAME"); pod && *pod) return pod;
-  if (const char* node = std::getenv("NODE_NAME"); node && *node) {
-    return node;
-  }
-  char buf[256] = {0};
-  if (gethostname(buf, sizeof(buf) - 1) == 0 && buf[0]) return buf;
-  return "tfd-aggregator";
-}
-
-// Minimal percent-encoding for a query-parameter value (the
-// labelSelector carries '/' and '.').
-std::string UrlEncode(const std::string& s) {
-  static const char hex[] = "0123456789ABCDEF";
-  std::string out;
-  for (unsigned char c : s) {
-    if (isalnum(c) || c == '-' || c == '_' || c == '.' || c == '~') {
-      out.push_back(static_cast<char>(c));
-    } else {
-      out.push_back('%');
-      out.push_back(hex[c >> 4]);
-      out.push_back(hex[c & 15]);
-    }
-  }
-  return out;
-}
-
-std::string CollectionUrl(const k8s::ClusterConfig& config) {
-  return config.apiserver_url + "/apis/nfd.k8s-sigs.io/v1alpha1/namespaces/" +
-         config.namespace_ + "/nodefeatures";
-}
-
-// The per-node daemons stamp the nfd node-name label on their CRs; the
-// aggregator's OUTPUT object deliberately does not carry it, so this
-// selector excludes our own writes from our own watch.
-std::string NodeSelectorQuery() {
-  return "labelSelector=" + UrlEncode(kNodeNameLabel);
-}
-
-http::RequestOptions BaseOptions(const k8s::ClusterConfig& config) {
-  http::RequestOptions options;
-  options.ca_file = config.ca_file;
-  if (!config.token.empty()) {
-    options.headers["Authorization"] = "Bearer " + config.token;
-  }
-  options.headers["Accept"] = "application/json";
-  return options;
-}
+// MonoSeconds / HolderIdentity / UrlEncode / CollectionUrl /
+// NodeSelectorQuery / BaseOptions / LeaseState / LeaseTick live in
+// agg/lease.h now — the lease discipline is shared with the
+// remediation controller (remedy/remedy.cc) and must not fork.
 
 obs::Counter* EventCounter(const char* type) {
   return obs::Default().GetCounter(
@@ -661,117 +608,6 @@ Status PublishOutput(const k8s::ClusterConfig& config,
   return Status::Error("put HTTP " + std::to_string(replaced->status));
 }
 
-// ---- lease ----------------------------------------------------------------
-
-struct LeaseState {
-  bool leading = false;
-  uint64_t epoch = 0;
-  bool ever_contacted = false;
-  // Last successful (or server-alive) blackboard contact, monotonic.
-  double last_contact_mono = 0;
-};
-
-// One lease tick against the tier's lease ConfigMap ("tfd-aggregator"
-// for the flat aggregator and the L2 root, "tfd-aggregator-shard-<i>"
-// per L1 shard — each shard's replica pair elects independently):
-// bootstrap, renew, or take over an expired lease — optimistic
-// concurrency via the resourceVersion precondition, exactly like the
-// slice blackboard.
-void LeaseTick(const k8s::ClusterConfig& config,
-               const std::string& lease_doc, const std::string& self,
-               int lease_duration_s, LeaseState* state) {
-  bool server_alive = false;
-  Result<k8s::CoordDocResult> doc =
-      k8s::GetCoordConfigMap(config, lease_doc, &server_alive, nullptr);
-  bool was_leading = state->leading;
-  if (!doc.ok()) {
-    TFD_LOG_WARNING << "aggregator lease: " << doc.error();
-    // A 429/503-paced server is ALIVE (it answered): the lease doc's
-    // truth is intact, only this poll was deferred — never a partition
-    // signal. A naked failure, though, means we cannot see the
-    // blackboard: a leader keeps leading only while its own lease
-    // could still be valid. Past a full lease duration without
-    // contact, a standby that CAN see the doc has taken over at
-    // expiry — continuing to watch and publish would be exactly the
-    // double publishing the lease exists to prevent, so step down
-    // (the run loop stops the watch and clears the store) until
-    // contact resumes.
-    if (server_alive) {
-      state->last_contact_mono = MonoSeconds();
-    } else if (state->leading &&
-               MonoSeconds() - state->last_contact_mono >
-                   static_cast<double>(lease_duration_s)) {
-      state->leading = false;
-      obs::DefaultJournal().Record(
-          "agg-follower", "agg",
-          "stepped down: lease blackboard unreachable for a full lease",
-          {{"holder", self},
-           {"epoch", std::to_string(state->epoch)}});
-      SetStateGauge(0);
-    }
-    return;
-  }
-  state->ever_contacted = true;
-  state->last_contact_mono = MonoSeconds();
-  double now_wall = WallClockSeconds();
-  slice::Lease lease;
-  bool have_lease = false;
-  if (doc->found) {
-    auto it = doc->data.find(kLeaseKey);
-    if (it != doc->data.end()) {
-      if (Result<slice::Lease> parsed = slice::ParseLease(it->second);
-          parsed.ok()) {
-        lease = *parsed;
-        have_lease = true;
-      }
-    }
-  }
-
-  auto write_lease = [&](uint64_t epoch, bool create) {
-    slice::Lease next;
-    next.holder = self;
-    next.epoch = epoch;
-    next.renewed_at = now_wall;
-    next.duration_s = lease_duration_s;
-    bool conflict = false;
-    Status wrote = k8s::PatchCoordConfigMap(
-        config, lease_doc, {{kLeaseKey, slice::SerializeLease(next)}},
-        create ? "" : doc->resource_version, create, &conflict,
-        &server_alive, nullptr);
-    if (wrote.ok()) {
-      state->leading = true;
-      state->epoch = epoch;
-      return true;
-    }
-    state->leading = false;
-    return false;
-  };
-
-  if (!doc->found) {
-    write_lease(1, /*create=*/true);
-  } else if (have_lease && lease.holder == self &&
-             !slice::LeaseExpired(lease, now_wall)) {
-    write_lease(lease.epoch, /*create=*/false);  // renew, same epoch
-  } else if (!have_lease || slice::LeaseExpired(lease, now_wall)) {
-    write_lease(lease.epoch + 1, /*create=*/false);  // take over
-  } else {
-    state->leading = false;  // someone else holds a live lease
-  }
-
-  if (state->leading != was_leading) {
-    obs::DefaultJournal().Record(
-        state->leading ? "agg-leader" : "agg-follower", "agg",
-        state->leading
-            ? "acquired the aggregator lease (epoch " +
-                  std::to_string(state->epoch) + ")"
-            : "following (lease held by " + lease.holder + ")",
-        {{"holder", state->leading ? self : lease.holder},
-         {"epoch", std::to_string(state->leading ? state->epoch
-                                                 : lease.epoch)}});
-  }
-  SetStateGauge(state->leading ? 1 : 0);
-}
-
 }  // namespace
 
 AggOutcome RunAggregator(const config::Config& config,
@@ -903,7 +739,8 @@ AggOutcome RunAggregator(const config::Config& config,
     if (now >= next_lease_tick) {
       bool was_leading = lease_state.leading;
       LeaseTick(*cluster, lease_doc, self, flags.agg_lease_duration_s,
-                &lease_state);
+                "agg", &lease_state);
+      SetStateGauge(lease_state.leading ? 1 : 0);
       next_lease_tick = now + lease_tick_s;
       if (server && lease_state.ever_contacted) {
         server->RecordRewrite(true);  // lease contact = liveness

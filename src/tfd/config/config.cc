@@ -752,7 +752,11 @@ std::vector<FlagDef> MakeFlagDefs(Flags* f) {
                   "one cluster-scoped output object); 'placement' runs "
                   "the placement query service (informer-fed in-memory "
                   "index over NodeFeature CRs answering POST "
-                  "/v1/placements with zero apiserver reads per query)",
+                  "/v1/placements with zero apiserver reads per query); "
+                  "'remedy' runs the lease-elected closed-loop "
+                  "remediation controller (cordon/drain/rebuild verdicts "
+                  "from sliding-window evidence, safety-interlocked, "
+                  "dry-run by default)",
                   false,
                   [f](const std::string& v) {
                     return SetString(&f->mode, v);
@@ -846,6 +850,98 @@ std::vector<FlagDef> MakeFlagDefs(Flags* f) {
                     }
                     f->placement_audit_capacity = parsed;
                     return Status::Ok();
+                  }});
+  defs.push_back({"remedy-dry-run",
+                  {"TFD_REMEDY_DRY_RUN"},
+                  "remedyDryRun",
+                  "remediation dry run (DEFAULT ON): the engine journals "
+                  "every intended action (remedy-cordon/remedy-rollback/"
+                  "remedy-drain/remedy-rebuild with dry_run=true) without "
+                  "mutating anything; --remedy-dry-run=false enforces "
+                  "(--mode=remedy only)",
+                  true,
+                  [f](const std::string& v) {
+                    return SetBool(&f->remedy_dry_run, v);
+                  }});
+  defs.push_back({"remedy-max-concurrent-cordons",
+                  {"TFD_REMEDY_MAX_CONCURRENT_CORDONS"},
+                  "remedyMaxConcurrentCordons",
+                  "fleet-wide disruption budget: max nodes concurrently "
+                  "cordoned, in-flight intents included (further cordons "
+                  "journal remedy-budget-blocked)",
+                  false,
+                  [f](const std::string& v) {
+                    int parsed = 0;
+                    if (!ParseNonNegInt(TrimSpace(v), &parsed) ||
+                        parsed < 1) {
+                      return Status::Error(
+                          "remedy-max-concurrent-cordons must be a "
+                          "positive integer");
+                    }
+                    f->remedy_max_concurrent_cordons = parsed;
+                    return Status::Ok();
+                  }});
+  defs.push_back({"remedy-domain-cap",
+                  {"TFD_REMEDY_DOMAIN_CAP"},
+                  "remedyDomainCap",
+                  "per-failure-domain concurrent-cordon cap (the "
+                  "google.com/tpu.topology.domain label names the "
+                  "rack/power group)",
+                  false,
+                  [f](const std::string& v) {
+                    int parsed = 0;
+                    if (!ParseNonNegInt(TrimSpace(v), &parsed) ||
+                        parsed < 1) {
+                      return Status::Error(
+                          "remedy-domain-cap must be a positive integer");
+                    }
+                    f->remedy_domain_cap = parsed;
+                    return Status::Ok();
+                  }});
+  defs.push_back({"remedy-window",
+                  {"TFD_REMEDY_WINDOW"},
+                  "remedyWindow",
+                  "sliding evidence window for crash-loop flap counting "
+                  "(e.g. 60s)",
+                  false,
+                  [f](const std::string& v) {
+                    return SetDuration(&f->remedy_window_s, v);
+                  }});
+  defs.push_back({"remedy-flap-threshold",
+                  {"TFD_REMEDY_FLAP_THRESHOLD"},
+                  "remedyFlapThreshold",
+                  "eligibility down-flips inside --remedy-window that "
+                  "count as crash-loop evidence",
+                  false,
+                  [f](const std::string& v) {
+                    int parsed = 0;
+                    if (!ParseNonNegInt(TrimSpace(v), &parsed) ||
+                        parsed < 1) {
+                      return Status::Error(
+                          "remedy-flap-threshold must be a positive "
+                          "integer");
+                    }
+                    f->remedy_flap_threshold = parsed;
+                    return Status::Ok();
+                  }});
+  defs.push_back({"remedy-heal-dwell",
+                  {"TFD_REMEDY_HEAL_DWELL"},
+                  "remedyHealDwell",
+                  "how long cordon evidence must stay retracted before "
+                  "the automatic rollback (un-cordon) fires (e.g. 10s)",
+                  false,
+                  [f](const std::string& v) {
+                    return SetDuration(&f->remedy_heal_dwell_s, v);
+                  }});
+  defs.push_back({"remedy-node-cooldown",
+                  {"TFD_REMEDY_NODE_COOLDOWN"},
+                  "remedyNodeCooldown",
+                  "per-node action cooldown; failed writes add "
+                  "exponential backoff with deterministic jitter on top "
+                  "(e.g. 5s)",
+                  false,
+                  [f](const std::string& v) {
+                    return SetDuration(&f->remedy_node_cooldown_s, v);
                   }});
   defs.push_back({"perf-fleet-floor-source",
                   {"TFD_PERF_FLEET_FLOOR_SOURCE"},
@@ -1279,9 +1375,19 @@ Result<LoadResult> Load(int argc, char** argv) {
     return Result<LoadResult>::Error("plugin-label-budget must be >= 1");
   }
   if (f->mode != "daemon" && f->mode != "aggregator" &&
-      f->mode != "placement") {
+      f->mode != "placement" && f->mode != "remedy") {
     return Result<LoadResult>::Error(
-        "invalid mode '" + f->mode + "' (want daemon|aggregator|placement)");
+        "invalid mode '" + f->mode +
+        "' (want daemon|aggregator|placement|remedy)");
+  }
+  if (f->remedy_window_s < 1) {
+    return Result<LoadResult>::Error("remedy-window must be >= 1s");
+  }
+  if (f->remedy_heal_dwell_s < 0) {
+    return Result<LoadResult>::Error("remedy-heal-dwell must be >= 0s");
+  }
+  if (f->remedy_node_cooldown_s < 0) {
+    return Result<LoadResult>::Error("remedy-node-cooldown must be >= 0s");
   }
   if (f->agg_debounce_s < 0) {
     return Result<LoadResult>::Error("agg-debounce must be >= 0s");
@@ -1425,6 +1531,14 @@ std::string ToJson(const Config& config) {
       << ",\"aggMergeShards\":" << f.agg_merge_shards
       << ",\"placementListenAddr\":" << jstr(f.placement_listen_addr)
       << ",\"placementAuditCapacity\":" << f.placement_audit_capacity
+      << ",\"remedyDryRun\":" << (f.remedy_dry_run ? "true" : "false")
+      << ",\"remedyMaxConcurrentCordons\":"
+      << f.remedy_max_concurrent_cordons
+      << ",\"remedyDomainCap\":" << f.remedy_domain_cap
+      << ",\"remedyWindow\":\"" << f.remedy_window_s << "s\""
+      << ",\"remedyFlapThreshold\":" << f.remedy_flap_threshold
+      << ",\"remedyHealDwell\":\"" << f.remedy_heal_dwell_s << "s\""
+      << ",\"remedyNodeCooldown\":\"" << f.remedy_node_cooldown_s << "s\""
       << ",\"perfFleetFloorSource\":" << jstr(f.perf_fleet_floor_source)
       << ",\"lifecycleWatch\":" << (f.lifecycle_watch ? "true" : "false")
       << ",\"faultSpec\":" << jstr(f.fault_spec)

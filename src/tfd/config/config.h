@@ -375,6 +375,28 @@ struct Flags {
   // closed decisions (placed + rejected + evicted) the drop-oldest
   // ring retains for GET /v1/decisions and the SIGUSR1 dump.
   int placement_audit_capacity = 256;
+  // Closed-loop remediation controller (--mode=remedy, remedy/):
+  // default-ON dry run — the engine's state machine runs identically,
+  // but every intended action (cordon / uncordon / drain-recommend /
+  // rebuild-recommend) is journaled instead of executed. Promotion to
+  // enforce is an explicit --remedy-dry-run=false.
+  bool remedy_dry_run = true;
+  // Fleet-wide disruption budget: max nodes concurrently cordoned
+  // (in-flight cordon intents count against it).
+  int remedy_max_concurrent_cordons = 3;
+  // Per-failure-domain concurrent-cordon cap (the
+  // google.com/tpu.topology.domain label names the rack/power group).
+  int remedy_domain_cap = 1;
+  // Sliding evidence window for crash-loop flap counting.
+  int remedy_window_s = 60;
+  // Eligibility down-flips inside the window that count as crash-loop.
+  int remedy_flap_threshold = 3;
+  // How long cordon evidence must stay retracted before the automatic
+  // rollback (un-cordon) fires.
+  int remedy_heal_dwell_s = 10;
+  // Per-node action cooldown; failed writes add exponential backoff
+  // with deterministic jitter on top (remedy/remedy.h).
+  int remedy_node_cooldown_s = 5;
   // Fleet-relative perf floor input (perf/, ROADMAP #4a): a JSON file
   // carrying the aggregator-published fleet floors
   // ({"matmul_p10_tflops": N, "hbm_p10_gbps": N}); when set, a node

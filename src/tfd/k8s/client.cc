@@ -958,5 +958,28 @@ Status GetNodeDraining(const ClusterConfig& config, bool* draining,
   return Status::Ok();
 }
 
+Status PatchNodeUnschedulable(const ClusterConfig& config,
+                              const std::string& node, bool unschedulable,
+                              bool* server_alive, WriteOutcome* outcome) {
+  WriteOutcome local_outcome;
+  if (outcome == nullptr) outcome = &local_outcome;
+  if (server_alive != nullptr) *server_alive = false;
+  http::RequestOptions options = BaseOptions(config);
+  options.headers["Content-Type"] = "application/merge-patch+json";
+  std::string url = config.apiserver_url + "/api/v1/nodes/" + node;
+  std::string body = std::string("{\"spec\":{\"unschedulable\":") +
+                     (unschedulable ? "true" : "false") + "}}";
+  Result<http::Response> patched =
+      CountedRequest("k8s.patch", "PATCH", url, body, options, outcome);
+  if (!patched.ok()) {
+    return Status::Error("patching node " + node + ": " + patched.error());
+  }
+  if (server_alive != nullptr) *server_alive = true;
+  if (patched->status == 200 || patched->status == 201) return Status::Ok();
+  return Status::Error("patching node " + node + ": HTTP " +
+                       std::to_string(patched->status) + ": " +
+                       patched->body.substr(0, 256));
+}
+
 }  // namespace k8s
 }  // namespace tfd

@@ -134,6 +134,18 @@ struct WriteOutcome {
   bool apf_rejected = false;
 };
 
+// JSON-merge-patches `{"spec":{"unschedulable":<unschedulable>}}` onto
+// /api/v1/nodes/<node> — the remediation controller's cordon/uncordon
+// verb (remedy/remedy.cc). Deliberately merge-patch, not SSA: the spec
+// field is a plain bool with exactly one writer class (cordoners), and
+// kubectl's own cordon uses the same shape. `server_alive` (non-null)
+// reports whether ANY HTTP response arrived. Rides the counted request
+// machinery (and the k8s.patch fault point) like every other write.
+Status PatchNodeUnschedulable(const ClusterConfig& config,
+                              const std::string& node, bool unschedulable,
+                              bool* server_alive,
+                              WriteOutcome* outcome = nullptr);
+
 // Creates or updates the NodeFeature CR "tfd-features-for-<node>" carrying
 // `labels` (reference labels.go:141-184; CR name pattern labels.go:38).
 //

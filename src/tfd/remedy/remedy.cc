@@ -1,0 +1,1082 @@
+#include "tfd/remedy/remedy.h"
+
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <cmath>
+#include <condition_variable>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <set>
+#include <sstream>
+#include <thread>
+
+#include "tfd/agg/lease.h"
+#include "tfd/info/version.h"
+#include "tfd/k8s/client.h"
+#include "tfd/k8s/desync.h"
+#include "tfd/k8s/watch.h"
+#include "tfd/lm/schema.h"
+#include "tfd/obs/journal.h"
+#include "tfd/obs/metrics.h"
+#include "tfd/obs/server.h"
+#include "tfd/obs/trace.h"
+#include "tfd/util/http.h"
+#include "tfd/util/jsonlite.h"
+#include "tfd/util/logging.h"
+#include "tfd/util/strings.h"
+#include "tfd/util/time.h"
+
+namespace tfd {
+namespace remedy {
+
+namespace {
+
+constexpr char kLeaseDocName[] = "tfd-remedy";
+constexpr char kCrNamePrefix[] = "tfd-features-for-";
+constexpr char kFieldManager[] = "tfd-remedy";
+
+bool StartsWith(const std::string& s, const char* prefix) {
+  return s.rfind(prefix, 0) == 0;
+}
+
+bool EndsWith(const std::string& s, const char* suffix) {
+  size_t n = std::strlen(suffix);
+  return s.size() >= n && s.compare(s.size() - n, n, suffix) == 0;
+}
+
+// "%g" of the value rounded to 3 decimals — the reason strings' number
+// format (mirrors the Python twin's `round(x, 3)` + `%g`).
+std::string Num(double v) {
+  char buf[64];
+  std::snprintf(buf, sizeof(buf), "%g", std::round(v * 1000.0) / 1000.0);
+  return buf;
+}
+
+std::string GetLabel(const lm::Labels& labels, const char* key) {
+  auto it = labels.find(key);
+  return it == labels.end() ? std::string() : it->second;
+}
+
+}  // namespace
+
+// ---- the pure engine ------------------------------------------------------
+
+bool Eligible(const lm::Labels* labels) {
+  if (labels == nullptr) return false;
+  if (GetLabel(*labels, lm::kPerfClass) == "degraded") return false;
+  if (GetLabel(*labels, lm::kSliceDegraded) == "true") return false;
+  if (GetLabel(*labels, lm::kSliceClass) == "degraded") return false;
+  if (GetLabel(*labels, lm::kLifecyclePreemptImminent) == "true") {
+    return false;
+  }
+  if (GetLabel(*labels, lm::kLifecycleDraining) == "true") return false;
+  return true;
+}
+
+bool GrayDegraded(const lm::Labels& labels) {
+  if (GetLabel(labels, lm::kPerfClass) == "degraded") return false;
+  for (const auto& [key, value] : labels) {
+    if (StartsWith(key, kChipClassPrefix) &&
+        EndsWith(key, kChipClassSuffix) && value == "degraded") {
+      return true;
+    }
+  }
+  return false;
+}
+
+double BackoffJitterUnit(const std::string& node, int fail_count) {
+  return static_cast<double>(
+             k8s::desync::Fnv1a64(node + ":" + std::to_string(fail_count)) %
+             1000) /
+         1000.0;
+}
+
+RemedyEngine::RemedyEngine(RemedyConfig config) : config_(config) {
+  for (const char* kind : kActionKinds) action_counts_[kind] = 0;
+  for (const char* interlock : kInterlocks) blocked_counts_[interlock] = 0;
+}
+
+bool RemedyEngine::ObserveNode(const std::string& node,
+                               const lm::Labels* labels, double now) {
+  if (labels == nullptr) {
+    nodes_.erase(node);
+    return false;
+  }
+  Node& n = nodes_[node];
+  n.labels = *labels;
+  if (auto it = labels->find(kDomainLabel); it != labels->end()) {
+    n.domain = it->second;
+  }
+  bool el = Eligible(labels);
+  if (n.eligible.has_value() && *n.eligible && !el) n.flips.push_back(now);
+  n.eligible = el;
+  return RefreshEvidence(&n, now);
+}
+
+void RemedyEngine::ObserveInventory(const lm::Labels& labels, double now) {
+  (void)now;
+  slo_burning_ = false;
+  for (const auto& [key, value] : labels) {
+    if (StartsWith(key, lm::kSloBurnPrefix) && EndsWith(key, ".burn") &&
+        value == "true") {
+      slo_burning_ = true;
+      break;
+    }
+  }
+}
+
+void RemedyEngine::ObserveDemand(int64_t chips, double now) {
+  (void)now;
+  queued_demand_chips_ = chips;
+}
+
+bool RemedyEngine::RefreshEvidence(Node* n, double now) {
+  const double floor = now - config_.window_s;
+  std::vector<double> kept;
+  kept.reserve(n->flips.size());
+  for (double t : n->flips) {
+    if (t > floor) kept.push_back(t);
+  }
+  n->flips = std::move(kept);
+  std::map<std::string, double> active;
+  if (static_cast<int>(n->flips.size()) >= config_.flap_threshold) {
+    active["crash-loop"] = n->flips[config_.flap_threshold - 1];
+  }
+  if (GrayDegraded(n->labels)) active["gray"] = now;
+  if (GetLabel(n->labels, lm::kLifecyclePreemptImminent) == "true") {
+    active["preempt"] = now;
+  }
+  bool detected = false;
+  for (const auto& [cls, since] : active) {
+    if (n->evidence.find(cls) == n->evidence.end()) {
+      // Evidence stamps first-wins: crash-loop carries the flip that
+      // crossed the threshold, the point-in-time classes carry now.
+      n->evidence[cls] = since;
+      detected = true;
+    }
+  }
+  for (auto it = n->evidence.begin(); it != n->evidence.end();) {
+    if (active.find(it->first) == active.end()) {
+      it = n->evidence.erase(it);
+    } else {
+      ++it;
+    }
+  }
+  bool cordon_active = false;
+  for (const char* cls : kCordonEvidence) {
+    if (n->evidence.count(cls)) cordon_active = true;
+  }
+  if (cordon_active) {
+    n->clear_since.reset();
+  } else if (!n->clear_since.has_value()) {
+    n->clear_since = now;
+  }
+  if (n->evidence.count("preempt") == 0) n->drain_recommended = false;
+  return detected;
+}
+
+const char* RemedyEngine::CordonEvidenceClass(const Node& n) const {
+  for (const char* cls : kCordonEvidence) {
+    if (n.evidence.count(cls)) return cls;
+  }
+  return nullptr;
+}
+
+bool RemedyEngine::RateLimited(const Node& n, double now) const {
+  if (n.backoff_until.has_value() && now < *n.backoff_until) return true;
+  if (n.last_action_at.has_value() &&
+      now - *n.last_action_at < config_.cooldown_s) {
+    return true;
+  }
+  return false;
+}
+
+int64_t RemedyEngine::PredictedCapacityChips(double now) const {
+  (void)now;
+  int64_t total = 0;
+  for (const auto& [name, n] : nodes_) {
+    (void)name;
+    if (!n.eligible.has_value() || !*n.eligible || n.cordoned ||
+        n.pending == "cordon") {
+      continue;
+    }
+    if (CordonEvidenceClass(n) != nullptr) continue;
+    std::string count = GetLabel(n.labels, "google.com/tpu.count");
+    if (count.empty()) continue;
+    char* end = nullptr;
+    long long parsed = std::strtoll(count.c_str(), &end, 10);
+    if (end != nullptr && *end == '\0' && end != count.c_str()) {
+      total += parsed;
+    }
+  }
+  return total;
+}
+
+std::pair<std::vector<Action>, std::vector<BlockedEdge>> RemedyEngine::Tick(
+    double now) {
+  const RemedyConfig& cfg = config_;
+  std::vector<Action> actions;
+  std::set<BlockedEdge> blocked_now;
+  // Re-age crash-loop windows even without fresh observations.
+  for (auto& [name, n] : nodes_) {
+    (void)name;
+    RefreshEvidence(&n, now);
+  }
+  int active_cordons = 0;
+  std::map<std::string, int> domain_cordons;
+  for (const auto& [name, n] : nodes_) {
+    (void)name;
+    if (n.cordoned || n.pending == "cordon") {
+      active_cordons++;
+      if (!n.domain.empty()) domain_cordons[n.domain]++;
+    }
+  }
+  for (auto& [node, n] : nodes_) {
+    if (!n.pending.empty()) continue;
+    const char* ev = CordonEvidenceClass(n);
+    if (n.cordoned) {
+      if (ev == nullptr && n.clear_since.has_value() &&
+          now - *n.clear_since >= cfg.heal_dwell_s &&
+          !RateLimited(n, now)) {
+        n.pending = "uncordon";
+        actions.push_back({"uncordon", node, n.cordon_class, *n.clear_since,
+                           "evidence retracted for " +
+                               Num(now - *n.clear_since) + "s"});
+      }
+    } else if (ev != nullptr) {
+      if (RateLimited(n, now)) {
+        blocked_now.insert({node, "node-rate-limit"});
+      } else if (slo_burning_) {
+        blocked_now.insert({node, "slo-burn"});
+      } else if (active_cordons >= cfg.max_concurrent_cordons) {
+        blocked_now.insert({node, "disruption-budget"});
+      } else if (!n.domain.empty() &&
+                 domain_cordons[n.domain] >= cfg.domain_cap) {
+        blocked_now.insert({node, "domain-cap"});
+      } else {
+        n.pending = "cordon";
+        n.cordon_class = ev;
+        active_cordons++;
+        if (!n.domain.empty()) domain_cordons[n.domain]++;
+        actions.push_back({"cordon", node, ev, n.evidence[ev],
+                           std::string("evidence ") + ev +
+                               " active since " + Num(n.evidence[ev])});
+      }
+    }
+    if (n.evidence.count("preempt") && !n.drain_recommended &&
+        !RateLimited(n, now)) {
+      n.drain_recommended = true;
+      actions.push_back({"drain-recommend", node, "preempt",
+                         n.evidence["preempt"],
+                         "preempt-imminent lifecycle"});
+      action_counts_["drain-recommend"]++;
+    }
+  }
+  if (queued_demand_chips_ > 0) {
+    int64_t capacity = PredictedCapacityChips(now);
+    if (capacity < queued_demand_chips_ &&
+        (!last_rebuild_at_.has_value() ||
+         now - *last_rebuild_at_ >= cfg.rebuild_cooldown_s)) {
+      last_rebuild_at_ = now;
+      actions.push_back({"rebuild-recommend", "", "capacity", now,
+                         "predicted capacity " + std::to_string(capacity) +
+                             " chips < queued demand " +
+                             std::to_string(queued_demand_chips_)});
+      action_counts_["rebuild-recommend"]++;
+    }
+  }
+  std::vector<BlockedEdge> newly_blocked;
+  for (const BlockedEdge& edge : blocked_now) {
+    if (blocked_live_.count(edge) == 0) newly_blocked.push_back(edge);
+  }
+  for (const BlockedEdge& edge : newly_blocked) {
+    blocked_counts_[edge.second]++;
+  }
+  blocked_live_ = std::move(blocked_now);
+  return {std::move(actions), std::move(newly_blocked)};
+}
+
+void RemedyEngine::NoteActionResult(const std::string& node,
+                                    const std::string& kind, bool ok,
+                                    double now) {
+  auto it = nodes_.find(node);
+  if (it == nodes_.end()) return;
+  Node& n = it->second;
+  n.pending.clear();
+  n.last_action_at = now;
+  if (ok) {
+    n.fail_count = 0;
+    n.backoff_until.reset();
+    if (kind == "cordon") {
+      n.cordoned = true;
+      n.cordon_at = now;
+      action_counts_["cordon"]++;
+    } else if (kind == "uncordon") {
+      n.cordoned = false;
+      n.cordon_at.reset();
+      action_counts_["uncordon"]++;
+      rollbacks_++;
+    }
+  } else {
+    n.fail_count++;
+    write_failures_++;
+    double backoff =
+        std::min(config_.backoff_base_s *
+                     std::pow(2.0, static_cast<double>(n.fail_count - 1)),
+                 config_.backoff_max_s);
+    double jitter = BackoffJitterUnit(node, n.fail_count);
+    n.backoff_until = now + backoff * (1.0 + 0.5 * jitter);
+  }
+}
+
+int RemedyEngine::AbandonPending() {
+  int dropped = 0;
+  for (auto& [name, n] : nodes_) {
+    (void)name;
+    if (!n.pending.empty()) {
+      n.pending.clear();
+      dropped++;
+    }
+  }
+  return dropped;
+}
+
+std::vector<std::string> RemedyEngine::CordonedNodes() const {
+  std::vector<std::string> out;
+  for (const auto& [name, n] : nodes_) {
+    if (n.cordoned) out.push_back(name);
+  }
+  return out;
+}
+
+std::vector<std::string> RemedyEngine::NodeNames() const {
+  std::vector<std::string> out;
+  out.reserve(nodes_.size());
+  for (const auto& [name, n] : nodes_) {
+    (void)n;
+    out.push_back(name);
+  }
+  return out;
+}
+
+int64_t RemedyEngine::ActionCount(const std::string& kind) const {
+  auto it = action_counts_.find(kind);
+  return it == action_counts_.end() ? 0 : it->second;
+}
+
+int64_t RemedyEngine::BlockedCount(const std::string& interlock) const {
+  auto it = blocked_counts_.find(interlock);
+  return it == blocked_counts_.end() ? 0 : it->second;
+}
+
+std::string RemedyEngine::RenderJson() const {
+  std::ostringstream out;
+  out << "{\"actions\":{";
+  bool first = true;
+  for (const auto& [kind, count] : action_counts_) {
+    if (!first) out << ",";
+    first = false;
+    out << "\"" << kind << "\":" << count;
+  }
+  out << "},\"blocked\":{";
+  first = true;
+  for (const auto& [interlock, count] : blocked_counts_) {
+    if (!first) out << ",";
+    first = false;
+    out << "\"" << interlock << "\":" << count;
+  }
+  out << "},\"cordoned\":[";
+  first = true;
+  for (const std::string& node : CordonedNodes()) {
+    if (!first) out << ",";
+    first = false;
+    out << "\"" << node << "\"";
+  }
+  out << "],\"nodes\":{";
+  first = true;
+  for (const auto& [name, n] : nodes_) {
+    if (!first) out << ",";
+    first = false;
+    out << "\"" << name << "\":{\"cordoned\":"
+        << (n.cordoned ? "true" : "false") << ",\"domain\":\"" << n.domain
+        << "\",\"evidence\":[";
+    bool first_ev = true;
+    for (const auto& [cls, since] : n.evidence) {
+      (void)since;
+      if (!first_ev) out << ",";
+      first_ev = false;
+      out << "\"" << cls << "\"";
+    }
+    out << "],\"flips\":" << n.flips.size() << "}";
+  }
+  out << "},\"rollbacks\":" << rollbacks_
+      << ",\"write_failures\":" << write_failures_ << "}";
+  return out.str();
+}
+
+// ---- the runner -----------------------------------------------------------
+
+namespace {
+
+obs::Counter* EventCounter(const char* type) {
+  return obs::Default().GetCounter(
+      "tfd_remedy_events_total",
+      "NodeFeature watch events consumed by the remediation controller, "
+      "by type (list items count as 'listed').",
+      {{"type", type}});
+}
+
+void SetStateGauge(int state) {
+  obs::Default()
+      .GetGauge("tfd_remedy_state",
+                "Remediation controller role: 0 follower/standby, 1 "
+                "leader (watching and acting).")
+      ->Set(state);
+}
+
+void SetCordonsActiveGauge(size_t cordons) {
+  obs::Default()
+      .GetGauge("tfd_remedy_cordons_active",
+                "Nodes the controller currently holds cordoned (dry-run "
+                "counts intended cordons; the disruption budget caps "
+                "this).")
+      ->Set(static_cast<double>(cordons));
+}
+
+obs::Counter* ActionCounter(const std::string& kind) {
+  return obs::Default().GetCounter(
+      "tfd_remedy_actions_total",
+      "Remediation actions executed (or journaled under dry-run), by "
+      "action kind from the closed vocabulary.",
+      {{"action", kind}});
+}
+
+obs::Counter* BlockedCounter(const std::string& interlock) {
+  return obs::Default().GetCounter(
+      "tfd_remedy_blocked_total",
+      "Remediation intents newly blocked by a safety interlock, by "
+      "interlock (transition edges, not steady blockage).",
+      {{"interlock", interlock}});
+}
+
+obs::Counter* RollbacksCounter() {
+  return obs::Default().GetCounter(
+      "tfd_remedy_rollbacks_total",
+      "Automatic rollbacks (un-cordons) after the triggering evidence "
+      "was retracted for the full heal dwell.");
+}
+
+obs::Counter* WriteFailuresCounter() {
+  return obs::Default().GetCounter(
+      "tfd_remedy_write_failures_total",
+      "Failed remediation writes; each arms per-node exponential "
+      "backoff with deterministic jitter before the retry.");
+}
+
+// Shared state between the watch thread and the lease/decision loop.
+struct Shared {
+  std::mutex mu;
+  std::condition_variable cv;
+  RemedyEngine engine;
+  bool synced = false;
+  // node -> monotonic time the latest evidence class transitioned to
+  // active (the detect edge); consumed by the first action on the node
+  // for the detect->decide stage decomposition.
+  std::map<std::string, double> detect_at;
+  std::string output_name;  // the inventory CR to consume
+
+  explicit Shared(RemedyConfig cfg) : engine(std::move(cfg)) {}
+};
+
+// One long-lived list-then-watch over the WHOLE NodeFeature collection
+// — deliberately WITHOUT the aggregator's node-name labelSelector: the
+// inventory CR this controller consumes is exactly the unlabeled
+// output object that selector exists to exclude. Same stream
+// discipline as agg/runner.cc's CollectionWatcher (bookmarks, clean
+// rotation, Retry-After pacing, exponential backoff, 410 -> re-list).
+class RemedyWatcher {
+ public:
+  RemedyWatcher(k8s::ClusterConfig config, Shared* shared)
+      : config_(std::move(config)), shared_(shared) {}
+  ~RemedyWatcher() { Stop(); }
+
+  void Start() {
+    if (started_) return;
+    started_ = true;
+    stop_.store(false);
+    thread_ = std::thread([this] { RunLoop(); });
+  }
+
+  void Stop() {
+    if (!started_) return;
+    stop_.store(true);
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      cv_.notify_all();
+    }
+    int fd = stream_fd_.load();
+    if (fd >= 0) shutdown(fd, SHUT_RDWR);
+    if (thread_.joinable()) thread_.join();
+    started_ = false;
+  }
+
+ private:
+  bool SleepFor(double seconds) {
+    std::unique_lock<std::mutex> lock(mu_);
+    cv_.wait_for(lock,
+                 std::chrono::milliseconds(
+                     static_cast<long long>(seconds * 1000)),
+                 [this] { return stop_.load(); });
+    return !stop_.load();
+  }
+
+  // Routes one object into the engine under the shared lock: node CRs
+  // feed ObserveNode (detect edges noted for the stage decomposition),
+  // the inventory CR feeds ObserveInventory (+ the optional queued-
+  // demand bridge label); everything else — partial rollups, foreign
+  // CRs — is ignored.
+  void ApplyObject(const std::string& name, const lm::Labels& labels,
+                   bool deleted) {
+    double now = agg::MonoSeconds();
+    std::lock_guard<std::mutex> lock(shared_->mu);
+    if (StartsWith(name, kCrNamePrefix)) {
+      std::string node = name.substr(sizeof(kCrNamePrefix) - 1);
+      bool detect = shared_->engine.ObserveNode(
+          node, deleted ? nullptr : &labels, now);
+      if (detect) shared_->detect_at[node] = now;
+      shared_->cv.notify_all();
+    } else if (name == shared_->output_name) {
+      shared_->engine.ObserveInventory(deleted ? lm::Labels{} : labels,
+                                       now);
+      if (!deleted) {
+        if (auto it = labels.find(kQueueDemandLabel); it != labels.end()) {
+          char* end = nullptr;
+          long long chips = std::strtoll(it->second.c_str(), &end, 10);
+          if (end != nullptr && *end == '\0' &&
+              end != it->second.c_str()) {
+            shared_->engine.ObserveDemand(chips, now);
+          }
+        }
+      }
+      shared_->cv.notify_all();
+    }
+  }
+
+  Status ListOnce(std::string* rv) {
+    http::RequestOptions options = agg::BaseOptions(config_);
+    options.timeout_ms = 15000;
+    options.deadline_ms = 30000;
+    Result<http::Response> listed =
+        http::Request("GET", agg::CollectionUrl(config_), "", options);
+    if (!listed.ok()) return Status::Error("list failed: " + listed.error());
+    if (listed->status == 429 || listed->status == 503) {
+      return Status::Error("list throttled (HTTP " +
+                           std::to_string(listed->status) + ")");
+    }
+    if (listed->status != 200) {
+      return Status::Error("list HTTP " + std::to_string(listed->status));
+    }
+    Result<jsonlite::ValuePtr> parsed = jsonlite::Parse(listed->body);
+    if (!parsed.ok()) {
+      return Status::Error("list parse: " + parsed.error());
+    }
+    if (jsonlite::ValuePtr v =
+            (*parsed)->GetPath("metadata.resourceVersion");
+        v && v->kind == jsonlite::Value::Kind::kString) {
+      *rv = v->string_value;
+    }
+    std::set<std::string> listed_nodes;
+    jsonlite::ValuePtr items = (*parsed)->Get("items");
+    if (items && items->kind == jsonlite::Value::Kind::kArray) {
+      for (const jsonlite::ValuePtr& item : items->array_items) {
+        if (!item || item->kind != jsonlite::Value::Kind::kObject) continue;
+        std::string name;
+        if (jsonlite::ValuePtr n = item->GetPath("metadata.name");
+            n && n->kind == jsonlite::Value::Kind::kString) {
+          name = n->string_value;
+        }
+        lm::Labels labels;
+        if (jsonlite::ValuePtr l = item->GetPath("spec.labels");
+            l && l->kind == jsonlite::Value::Kind::kObject) {
+          for (const auto& [k, v] : l->object_items) {
+            if (v && v->kind == jsonlite::Value::Kind::kString) {
+              labels[k] = v->string_value;
+            }
+          }
+        }
+        if (StartsWith(name, kCrNamePrefix)) {
+          listed_nodes.insert(name.substr(sizeof(kCrNamePrefix) - 1));
+        }
+        EventCounter("listed")->Inc();
+        ApplyObject(name, labels, /*deleted=*/false);
+      }
+    }
+    // Deletes missed while not watching retire through the same path.
+    std::vector<std::string> known;
+    {
+      std::lock_guard<std::mutex> lock(shared_->mu);
+      known = shared_->engine.NodeNames();
+    }
+    for (const std::string& node : known) {
+      if (listed_nodes.count(node) == 0) {
+        ApplyObject(kCrNamePrefix + node, {}, /*deleted=*/true);
+      }
+    }
+    return Status::Ok();
+  }
+
+  void RunLoop() {
+    const std::string node_key = agg::HolderIdentity();
+    std::string rv;
+    int consecutive_failures = 0;
+
+    while (!stop_.load()) {
+      if (rv.empty()) {
+        Status listed = ListOnce(&rv);
+        if (!listed.ok()) {
+          consecutive_failures++;
+          double pause = std::min(
+              30.0, 1.0 * (1 << std::min(consecutive_failures - 1, 10)));
+          TFD_LOG_WARNING << "remedy list: " << listed.message()
+                          << "; retrying in ~" << pause << "s";
+          if (!SleepFor(k8s::desync::SpreadRetryAfterS(pause, node_key))) {
+            return;
+          }
+          continue;
+        }
+        consecutive_failures = 0;
+        bool first_sync;
+        size_t nodes;
+        {
+          std::lock_guard<std::mutex> lock(shared_->mu);
+          first_sync = !shared_->synced;
+          shared_->synced = true;
+          nodes = shared_->engine.nodes();
+          shared_->cv.notify_all();
+        }
+        obs::DefaultJournal().Record(
+            first_sync ? "remedy-synced" : "remedy-resync", "remedy",
+            (first_sync ? std::string("initial sync: ")
+                        : std::string("re-list after 410: ")) +
+                std::to_string(nodes) + " nodes at rv " + rv,
+            {{"nodes", std::to_string(nodes)},
+             {"resource_version", rv}});
+      }
+
+      std::string url = agg::CollectionUrl(config_) +
+                        "?watch=true&allowWatchBookmarks=true"
+                        "&timeoutSeconds=240";
+      if (!rv.empty()) url += "&resourceVersion=" + rv;
+      http::RequestOptions stream_options = agg::BaseOptions(config_);
+      stream_options.timeout_ms = 300000;
+      stream_options.connect_timeout_ms = 5000;
+
+      bool established = false;
+      bool resync_gone = false;
+      double server_retry_after = 0;
+      int stream_status = 0;
+      std::string line_buffer;
+      http::StreamHandler handler;
+      handler.on_connected = [this](int fd) { stream_fd_.store(fd); };
+      handler.on_response = [&](const http::Response& head) {
+        stream_status = head.status;
+        server_retry_after = head.RetryAfterSeconds();
+        if (head.status == 200) {
+          established = true;
+          consecutive_failures = 0;
+          return true;
+        }
+        return false;
+      };
+      handler.on_data = [&](const char* data, size_t len) {
+        if (stop_.load()) return false;
+        line_buffer.append(data, len);
+        size_t start = 0;
+        size_t eol;
+        while ((eol = line_buffer.find('\n', start)) != std::string::npos) {
+          std::string line = line_buffer.substr(start, eol - start);
+          start = eol + 1;
+          if (line.empty() || line == "\r") continue;
+          k8s::WatchEvent event = k8s::ParseWatchEventLine(line);
+          EventCounter(k8s::WatchEventTypeName(event.type))->Inc();
+          switch (event.type) {
+            case k8s::WatchEvent::Type::kBookmark:
+              if (!event.resource_version.empty()) {
+                rv = event.resource_version;
+              }
+              break;
+            case k8s::WatchEvent::Type::kError:
+              if (event.error_code == 410) {
+                resync_gone = true;
+                line_buffer.clear();
+                return false;
+              }
+              break;
+            case k8s::WatchEvent::Type::kAdded:
+            case k8s::WatchEvent::Type::kModified:
+            case k8s::WatchEvent::Type::kDeleted:
+              if (!event.resource_version.empty()) {
+                rv = event.resource_version;
+              }
+              ApplyObject(event.name, event.labels,
+                          event.type == k8s::WatchEvent::Type::kDeleted);
+              break;
+            case k8s::WatchEvent::Type::kUnknown:
+              break;
+          }
+        }
+        line_buffer.erase(0, start);
+        if (line_buffer.size() > 1024 * 1024) line_buffer.clear();
+        return true;
+      };
+
+      Status streamed =
+          http::RequestStream("GET", url, "", stream_options, handler);
+      stream_fd_.store(-1);
+      if (stop_.load()) return;
+
+      if (resync_gone || stream_status == 410) {
+        obs::DefaultJournal().Record(
+            "remedy-resync", "remedy",
+            "collection watch resourceVersion too old (410 Gone); "
+            "re-listing once",
+            {{"resource_version", rv}});
+        rv.clear();
+        continue;
+      }
+      if (streamed.ok() && established) continue;  // clean rotation
+      if (stream_status == 429 || stream_status == 503 ||
+          server_retry_after > 0) {
+        double pause = server_retry_after > 0 ? server_retry_after : 1.0;
+        if (!SleepFor(k8s::desync::SpreadRetryAfterS(pause, node_key))) {
+          return;
+        }
+        continue;
+      }
+      consecutive_failures++;
+      double pause = std::min(
+          30.0, 1.0 * (1 << std::min(consecutive_failures - 1, 10)));
+      TFD_LOG_WARNING << "remedy watch dropped ("
+                      << (!streamed.ok()
+                              ? streamed.message()
+                              : "HTTP " + std::to_string(stream_status))
+                      << "); reconnecting in ~" << pause << "s";
+      if (!SleepFor(k8s::desync::SpreadRetryAfterS(pause, node_key))) {
+        return;
+      }
+    }
+  }
+
+  k8s::ClusterConfig config_;
+  Shared* shared_;
+  std::thread thread_;
+  std::atomic<bool> stop_{false};
+  std::atomic<int> stream_fd_{-1};
+  std::mutex mu_;
+  std::condition_variable cv_;
+  bool started_ = false;
+};
+
+// The drain recommendation: ONE server-side apply of the drain label
+// onto the node's own NodeFeature CR under the "tfd-remedy" field
+// manager — this controller owns exactly that key and nothing the
+// daemon published itself. No merge-patch/PUT ladder: an apiserver
+// without SSA simply fails the recommendation (it is advisory).
+Status ApplyDrainLabel(const k8s::ClusterConfig& config,
+                       const std::string& node) {
+  std::string name = std::string(kCrNamePrefix) + node;
+  std::string url = agg::CollectionUrl(config) + "/" + name +
+                    "?fieldManager=" + kFieldManager + "&force=true";
+  std::string body =
+      std::string("{\"apiVersion\":\"nfd.k8s-sigs.io/v1alpha1\","
+                  "\"kind\":\"NodeFeature\",\"metadata\":{\"name\":") +
+      jsonlite::Quote(name) + "},\"spec\":{\"labels\":{" +
+      jsonlite::Quote(kDrainLabel) + ":\"true\"}}}";
+  http::RequestOptions options = agg::BaseOptions(config);
+  options.headers["Content-Type"] = "application/apply-patch+yaml";
+  options.deadline_ms = 15000;
+  Result<http::Response> applied =
+      http::Request("PATCH", url, body, options);
+  if (!applied.ok()) {
+    return Status::Error("drain label apply: " + applied.error());
+  }
+  if (applied->status == 200 || applied->status == 201) return Status::Ok();
+  return Status::Error("drain label apply HTTP " +
+                       std::to_string(applied->status));
+}
+
+}  // namespace
+
+RemedyOutcome RunRemedy(const config::Config& config,
+                        const sigset_t& sigmask) {
+  const config::Flags& flags = config.flags;
+  Result<k8s::ClusterConfig> cluster = k8s::LoadInClusterEndpoint();
+  if (!cluster.ok()) {
+    TFD_LOG_ERROR << "remedy: " << cluster.error();
+    return RemedyOutcome::kError;
+  }
+  cluster->request_deadline_ms = flags.sink_request_deadline_s * 1000;
+  const std::string self = agg::HolderIdentity();
+
+  RemedyConfig engine_cfg;
+  engine_cfg.window_s = flags.remedy_window_s;
+  engine_cfg.flap_threshold = flags.remedy_flap_threshold;
+  engine_cfg.heal_dwell_s = flags.remedy_heal_dwell_s;
+  engine_cfg.cooldown_s = flags.remedy_node_cooldown_s;
+  engine_cfg.max_concurrent_cordons = flags.remedy_max_concurrent_cordons;
+  engine_cfg.domain_cap = flags.remedy_domain_cap;
+
+  std::unique_ptr<obs::IntrospectionServer> server;
+  if (!flags.introspection_addr.empty()) {
+    obs::ServerOptions options;
+    options.addr = flags.introspection_addr;
+    options.journal = &obs::DefaultJournal();
+    options.trace = &obs::DefaultTrace();
+    options.stale_after_s = std::max(120, 3 * flags.agg_lease_duration_s);
+    Result<std::unique_ptr<obs::IntrospectionServer>> started =
+        obs::IntrospectionServer::Start(options, &obs::Default());
+    if (!started.ok()) {
+      TFD_LOG_ERROR << "remedy introspection server: " << started.error();
+      return RemedyOutcome::kError;
+    }
+    server = std::move(*started);
+    TFD_LOG_INFO << "remedy introspection on port " << server->port();
+  }
+
+  TFD_LOG_INFO << "tpu-feature-remedy " << info::VersionString() << " as "
+               << self << " ("
+               << (flags.remedy_dry_run ? "DRY-RUN" : "ENFORCE")
+               << ", budget " << flags.remedy_max_concurrent_cordons
+               << " cordons, domain cap " << flags.remedy_domain_cap
+               << ", window " << flags.remedy_window_s << "s, lease "
+               << flags.agg_lease_duration_s << "s)";
+
+  // Register the whole metric surface at 0: scrape-deterministic.
+  SetStateGauge(0);
+  SetCordonsActiveGauge(0);
+  for (const char* kind : kActionKinds) ActionCounter(kind);
+  for (const char* interlock : kInterlocks) BlockedCounter(interlock);
+  RollbacksCounter();
+  WriteFailuresCounter();
+
+  Shared shared(engine_cfg);
+  shared.output_name = flags.agg_output_name;
+  RemedyWatcher watcher(*cluster, &shared);
+  agg::LeaseState lease_state;
+  const double lease_tick_s =
+      std::max(1.0, flags.agg_lease_duration_s / 3.0);
+  double next_lease_tick = 0;    // immediately
+  double next_decision_tick = 0;
+  bool watcher_running = false;
+
+  // Refreshes the lease when due; returns false when leadership (or
+  // the epoch) moved away from `fence_epoch` — the epoch fence every
+  // in-flight action batch checks BEFORE each write.
+  auto fence_holds = [&](uint64_t fence_epoch) {
+    double now = agg::MonoSeconds();
+    if (now >= next_lease_tick) {
+      agg::LeaseTick(*cluster, kLeaseDocName, self,
+                     flags.agg_lease_duration_s, "remedy", &lease_state);
+      SetStateGauge(lease_state.leading ? 1 : 0);
+      next_lease_tick = now + lease_tick_s;
+      if (server && lease_state.ever_contacted) server->RecordRewrite(true);
+    }
+    return lease_state.leading && lease_state.epoch == fence_epoch;
+  };
+
+  auto abandon = [&](const char* why) {
+    int dropped;
+    {
+      std::lock_guard<std::mutex> lock(shared.mu);
+      dropped = shared.engine.AbandonPending();
+    }
+    if (dropped > 0) {
+      obs::DefaultJournal().Record(
+          "remedy-abandoned", "remedy",
+          std::string(why) + ": dropped " + std::to_string(dropped) +
+              " in-flight intents (the next leader re-derives them)",
+          {{"dropped", std::to_string(dropped)},
+           {"epoch", std::to_string(lease_state.epoch)}});
+    }
+  };
+
+  while (true) {
+    struct timespec zero = {0, 0};
+    int sig;
+    while ((sig = sigtimedwait(&sigmask, nullptr, &zero)) > 0) {
+      if (sig == SIGTERM || sig == SIGINT || sig == SIGQUIT) {
+        TFD_LOG_INFO << "remedy: signal " << sig << ", shutting down";
+        watcher.Stop();
+        return RemedyOutcome::kExit;
+      }
+      if (sig == SIGHUP) {
+        TFD_LOG_INFO << "remedy: SIGHUP, reloading";
+        watcher.Stop();
+        return RemedyOutcome::kRestart;
+      }
+    }
+
+    double now = agg::MonoSeconds();
+    if (now >= next_lease_tick) {
+      agg::LeaseTick(*cluster, kLeaseDocName, self,
+                     flags.agg_lease_duration_s, "remedy", &lease_state);
+      SetStateGauge(lease_state.leading ? 1 : 0);
+      next_lease_tick = now + lease_tick_s;
+      if (server && lease_state.ever_contacted) server->RecordRewrite(true);
+    }
+    // Level-triggered (not edge-triggered) watcher reconciliation: the
+    // epoch fence may observe the lease loss mid-batch, so the
+    // transition is not guaranteed to surface HERE first.
+    if (lease_state.leading && !watcher_running) {
+      watcher.Start();
+      watcher_running = true;
+    } else if (!lease_state.leading && watcher_running) {
+      // Lost the lease: stop watching, drop every in-flight intent
+      // (epoch fence), and forget sync — a re-election re-lists.
+      watcher.Stop();
+      watcher_running = false;
+      abandon("lease lost");
+      std::lock_guard<std::mutex> lock(shared.mu);
+      shared.synced = false;
+    }
+
+    {
+      std::unique_lock<std::mutex> lock(shared.mu);
+      double due = std::min(next_decision_tick, next_lease_tick);
+      double wait_s = std::min(0.2, std::max(0.0, due - agg::MonoSeconds()));
+      shared.cv.wait_for(
+          lock, std::chrono::milliseconds(
+                    static_cast<long long>(wait_s * 1000)));
+    }
+
+    now = agg::MonoSeconds();
+    if (now < next_decision_tick) continue;
+    next_decision_tick = now + 1.0;
+
+    std::vector<Action> actions;
+    std::vector<BlockedEdge> blocked;
+    std::map<std::string, double> detect_at;
+    bool ready = false;
+    {
+      std::lock_guard<std::mutex> lock(shared.mu);
+      ready = lease_state.leading && shared.synced;
+      if (ready) {
+        auto result = shared.engine.Tick(now);
+        actions = std::move(result.first);
+        blocked = std::move(result.second);
+        detect_at = shared.detect_at;
+      }
+    }
+    if (!ready) continue;
+
+    for (const BlockedEdge& edge : blocked) {
+      BlockedCounter(edge.second)->Inc();
+      obs::DefaultJournal().Record(
+          "remedy-budget-blocked", "remedy",
+          "cordon of " + edge.first + " blocked by the " + edge.second +
+              " interlock",
+          {{"node", edge.first}, {"interlock", edge.second}});
+    }
+
+    const uint64_t fence_epoch = lease_state.epoch;
+    const double decide_mono = now;
+    for (const Action& action : actions) {
+      if (!fence_holds(fence_epoch)) {
+        abandon("epoch fence tripped mid-batch");
+        break;
+      }
+      uint64_t change = obs::DefaultTrace().Mint(
+          "remedy", action.kind,
+          action.node.empty() ? action.reason
+                              : action.node + ": " + action.reason);
+      double t_act = agg::MonoSeconds();
+      obs::DefaultTrace().Stage("act");
+      bool ok = true;
+      std::string error;
+      if (!flags.remedy_dry_run) {
+        if (action.kind == "cordon" || action.kind == "uncordon") {
+          Status s = k8s::PatchNodeUnschedulable(
+              *cluster, action.node, action.kind == "cordon", nullptr,
+              nullptr);
+          ok = s.ok();
+          if (!ok) error = s.message();
+        } else if (action.kind == "drain-recommend") {
+          Status s = ApplyDrainLabel(*cluster, action.node);
+          ok = s.ok();
+          if (!ok) error = s.message();
+        }
+        // rebuild-recommend mutates nothing: journal only.
+      }
+      double t_acked = agg::MonoSeconds();
+      {
+        std::lock_guard<std::mutex> lock(shared.mu);
+        shared.engine.NoteActionResult(action.node, action.kind, ok,
+                                       t_acked);
+        shared.detect_at.erase(action.node);
+      }
+      // The remedy stage-budget decomposition (detect -> decide -> act
+      // -> acked) rides the journal: detect is the watch thread's
+      // evidence edge, decide the tick that emitted the action.
+      double t_detect = decide_mono;
+      if (auto it = detect_at.find(action.node); it != detect_at.end()) {
+        t_detect = std::min(it->second, decide_mono);
+      }
+      std::vector<std::pair<std::string, std::string>> attrs = {
+          {"change", std::to_string(change)},
+          {"node", action.node},
+          {"action", action.kind},
+          {"evidence", action.evidence},
+          {"dry_run", flags.remedy_dry_run ? "true" : "false"},
+          {"decide_ms", Fixed3((decide_mono - t_detect) * 1000)},
+          {"act_ms", Fixed3((t_act - decide_mono) * 1000)},
+          {"acked_ms", Fixed3((t_acked - t_act) * 1000)}};
+      if (ok) {
+        const char* kind = action.kind == "cordon" ? "remedy-cordon"
+                           : action.kind == "uncordon" ? "remedy-rollback"
+                           : action.kind == "drain-recommend"
+                               ? "remedy-drain"
+                               : "remedy-rebuild";
+        obs::DefaultJournal().Record(
+            kind, "remedy",
+            (flags.remedy_dry_run ? std::string("[dry-run] ")
+                                  : std::string()) +
+                action.kind +
+                (action.node.empty() ? "" : " " + action.node) + ": " +
+                action.reason,
+            attrs);
+        ActionCounter(action.kind)->Inc();
+        if (action.kind == "uncordon") RollbacksCounter()->Inc();
+        obs::DefaultTrace().MarkPublished(0, -1, change);
+      } else {
+        attrs.emplace_back("error", error);
+        obs::DefaultJournal().Record(
+            "remedy-write-failed", "remedy",
+            action.kind + " of " + action.node + " failed: " + error +
+                " (exponential backoff armed; the next tick re-emits "
+                "once it expires)",
+            attrs);
+        WriteFailuresCounter()->Inc();
+        TFD_LOG_WARNING << "remedy write: " << error;
+      }
+    }
+
+    size_t cordons;
+    std::string state_json;
+    {
+      std::lock_guard<std::mutex> lock(shared.mu);
+      cordons = shared.engine.CordonedNodes().size();
+      state_json = shared.engine.RenderJson();
+    }
+    SetCordonsActiveGauge(cordons);
+    if (server) server->SetLabelsJson("{\"remedy\":" + state_json + "}");
+  }
+}
+
+}  // namespace remedy
+}  // namespace tfd

@@ -1,0 +1,227 @@
+#ifndef TFD_REMEDY_REMEDY_H_
+#define TFD_REMEDY_REMEDY_H_
+
+// Closed-loop remediation (--mode=remedy): a lease-elected cluster
+// singleton that consumes the same label streams the aggregator and
+// placement view consume (NodeFeature CRs + the inventory CR), derives
+// remediation verdicts from sliding-window evidence, and executes a
+// CLOSED action vocabulary:
+//
+//   cordon            node `spec.unschedulable` merge patch — crash-loop
+//                     flap history (>= flap_threshold eligibility
+//                     down-flips inside window_s) or gray degradation
+//                     (a tpu.perf.chip<N>.class=degraded label while
+//                     the node still *looks* placeable)
+//   uncordon          automatic rollback once the triggering evidence
+//                     is retracted and stays retracted for heal_dwell_s
+//   drain-recommend   preempt-imminent lifecycle — label + journal
+//                     only, never an eviction
+//   rebuild-recommend predicted eligible capacity dropped below queued
+//                     demand — journal only
+//
+// Safety interlocks (evaluated in this order, first hit wins):
+//   node-rate-limit    per-node cooldown + exponential backoff with
+//                      deterministic fnv1a64 jitter after failed writes
+//   slo-burn           a burning tpu.slo.*.burn stage on the inventory
+//                      CR defers NEW cordons (the fleet is already
+//                      hurting; don't remove capacity mid-burn)
+//   disruption-budget  fleet-wide max concurrent cordons
+//   domain-cap         per-failure-domain concurrent-cordon cap
+//                      (tpu.topology.domain names the rack/power group)
+//
+// The RemedyEngine is the PURE half: side-effect-free and clock-free —
+// the runner feeds observations and a `now`, and executes the returned
+// actions (or journals them untouched under --remedy-dry-run, the
+// default). Dry-run vs enforce is therefore a *runner* property; the
+// engine's state machine is identical in both, which is what makes the
+// dry-run journal a faithful preview.
+//
+// tpufd/remedy.py is the parity-pinned Python twin: the scripted
+// scenario in src/tfd/tests/unit_tests.cc TestRemedyParityGolden and
+// tests/test_remedy.py compares RenderJson() against ONE shared
+// literal. Every semantic change lands in both or the pin fails.
+
+#include <signal.h>
+
+#include <cstdint>
+#include <map>
+#include <optional>
+#include <set>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "tfd/config/config.h"
+#include "tfd/lm/labeler.h"
+
+namespace tfd {
+namespace remedy {
+
+// Failure-domain membership (rack/power group). Published by the
+// operator/provisioner, consumed by the domain-cap interlock.
+inline constexpr char kDomainLabel[] = "google.com/tpu.topology.domain";
+// The drain recommendation is a label, not an eviction: schedulers and
+// operators act on it; the controller never deletes a pod.
+inline constexpr char kDrainLabel[] =
+    "google.com/tpu.remedy.drain-recommended";
+// Per-chip gray degradation: google.com/tpu.perf.chip<N>.class.
+inline constexpr char kChipClassPrefix[] = "google.com/tpu.perf.chip";
+inline constexpr char kChipClassSuffix[] = ".class";
+// Optional queued-demand bridge label on the inventory CR (chips the
+// decision audit stream reports queued); absent keeps the
+// rebuild-recommend path idle — the harness twin feeds ObserveDemand
+// directly.
+inline constexpr char kQueueDemandLabel[] =
+    "google.com/tpu.queue.demand-chips";
+
+// Closed vocabularies — gates and metrics iterate these, so a new
+// action/interlock must be added HERE (and to the Python twin) or it
+// fails loudly.
+inline constexpr const char* kActionKinds[] = {
+    "cordon", "uncordon", "drain-recommend", "rebuild-recommend"};
+inline constexpr const char* kInterlocks[] = {
+    "node-rate-limit", "slo-burn", "disruption-budget", "domain-cap"};
+// Evidence classes that justify a cordon, in deterministic priority
+// order (crash-loop wins when both are active).
+inline constexpr const char* kCordonEvidence[] = {"crash-loop", "gray"};
+
+// The scheduler's-eye view of a node (tpufd/cluster.py basic_eligible):
+// crash-loop flips are DOWN-flips of this predicate. nullptr = deleted.
+bool Eligible(const lm::Labels* labels);
+
+// A chip-level degraded verdict on a node whose headline class is NOT
+// degraded: the node still looks placeable, so nothing else in the
+// stack will fence it — exactly the case remediation exists for.
+bool GrayDegraded(const lm::Labels& labels);
+
+// Deterministic jitter in [0, 1): both twins hash the same key
+// ("<node>:<fail_count>" through k8s::desync::Fnv1a64), so a seeded
+// soak reproduces byte-identically across languages.
+double BackoffJitterUnit(const std::string& node, int fail_count);
+
+// Knobs, each wired through flags/env/helm/static (--remedy-*;
+// TFD_REMEDY_*; remedy.* helm values).
+struct RemedyConfig {
+  double window_s = 60.0;
+  int flap_threshold = 3;
+  double heal_dwell_s = 10.0;
+  double cooldown_s = 5.0;
+  double backoff_base_s = 1.0;
+  double backoff_max_s = 30.0;
+  int max_concurrent_cordons = 3;
+  int domain_cap = 1;
+  double rebuild_cooldown_s = 30.0;
+};
+
+struct Action {
+  std::string kind;
+  std::string node;      // "" for rebuild-recommend (fleet-scoped)
+  std::string evidence;  // crash-loop | gray | preempt | capacity
+  double detected_at = 0;
+  std::string reason;
+};
+
+// (node, interlock) pairs that TRANSITIONED into blocked this tick.
+using BlockedEdge = std::pair<std::string, std::string>;
+
+class RemedyEngine {
+ public:
+  explicit RemedyEngine(RemedyConfig config = {});
+
+  // One NodeFeature CR state (nullptr = deleted). Returns true when
+  // any evidence class TRANSITIONED to active (the detect edge).
+  bool ObserveNode(const std::string& node, const lm::Labels* labels,
+                   double now);
+  // The aggregator's inventory CR: a burning tpu.slo.<stage>.burn
+  // stage arms the slo-burn interlock.
+  void ObserveInventory(const lm::Labels& labels, double now);
+  // Queued demand (chips) from the decision audit stream — the
+  // rebuild trigger's right-hand side.
+  void ObserveDemand(int64_t chips, double now);
+
+  // One decision pass: (actions, newly-blocked edges). Deterministic:
+  // nodes visited in sorted order, interlocks evaluated in the
+  // documented order; steady blockage is not re-counted.
+  std::pair<std::vector<Action>, std::vector<BlockedEdge>> Tick(double now);
+
+  // The runner executed (or dry-ran) an action. Failed writes arm
+  // exponential backoff with deterministic jitter; the action stays
+  // un-applied and a later tick re-emits it once the backoff expires.
+  void NoteActionResult(const std::string& node, const std::string& kind,
+                        bool ok, double now);
+
+  // Epoch-fenced step-down mid-batch: the lease is gone, so every
+  // in-flight intent is dropped without state change — the next leader
+  // re-derives it from the same evidence. Returns intents dropped.
+  int AbandonPending();
+
+  std::vector<std::string> CordonedNodes() const;
+  // Chips on nodes the fleet can actually count on: eligible, not
+  // cordoned (or being cordoned), no active cordon evidence.
+  int64_t PredictedCapacityChips(double now) const;
+  std::vector<std::string> NodeNames() const;
+  size_t nodes() const { return nodes_.size(); }
+  bool slo_burning() const { return slo_burning_; }
+  int64_t ActionCount(const std::string& kind) const;
+  int64_t BlockedCount(const std::string& interlock) const;
+  int64_t rollbacks() const { return rollbacks_; }
+  int64_t write_failures() const { return write_failures_; }
+  const RemedyConfig& config() const { return config_; }
+
+  // Deterministic compact JSON of the engine state — the parity golden
+  // surface (byte-identical to tpufd/remedy.py render_json()).
+  std::string RenderJson() const;
+
+ private:
+  struct Node {
+    lm::Labels labels;
+    std::optional<bool> eligible;  // unknown until first observation
+    std::vector<double> flips;     // eligibility down-flip times
+    std::map<std::string, double> evidence;  // class -> active_since
+    std::optional<double> clear_since;
+    bool cordoned = false;
+    std::string cordon_class;
+    std::optional<double> cordon_at;
+    std::string pending;  // action kind in flight ("" = none)
+    std::optional<double> last_action_at;
+    int fail_count = 0;
+    std::optional<double> backoff_until;
+    bool drain_recommended = false;
+    std::string domain;
+  };
+
+  bool RefreshEvidence(Node* n, double now);
+  const char* CordonEvidenceClass(const Node& n) const;
+  bool RateLimited(const Node& n, double now) const;
+
+  RemedyConfig config_;
+  std::map<std::string, Node> nodes_;
+  bool slo_burning_ = false;
+  int64_t queued_demand_chips_ = 0;
+  std::optional<double> last_rebuild_at_;
+  std::map<std::string, int64_t> action_counts_;
+  std::map<std::string, int64_t> blocked_counts_;
+  int64_t rollbacks_ = 0;
+  int64_t write_failures_ = 0;
+  std::set<BlockedEdge> blocked_live_;
+};
+
+enum class RemedyOutcome {
+  kExit,     // SIGTERM/SIGINT: clean shutdown
+  kRestart,  // SIGHUP: reload config and re-enter
+  kError,    // unrecoverable startup failure
+};
+
+// Runs the remediation controller until a signal. Lease doc
+// "tfd-remedy" (agg/lease.h discipline, --agg-lease-duration), its own
+// unfiltered collection watch (the inventory CR it consumes is exactly
+// the unlabeled output the aggregator's selector excludes), a ~1s
+// decision tick while leading+synced, epoch-fenced action execution,
+// and --remedy-dry-run (default ON) journaling instead of mutating.
+RemedyOutcome RunRemedy(const config::Config& config,
+                        const sigset_t& sigmask);
+
+}  // namespace remedy
+}  // namespace tfd
+
+#endif  // TFD_REMEDY_REMEDY_H_

@@ -45,6 +45,7 @@
 #include "tfd/obs/metrics.h"
 #include "tfd/obs/server.h"
 #include "tfd/placement/placement.h"
+#include "tfd/remedy/remedy.h"
 #include "tfd/perf/perf.h"
 #include "tfd/pjrt/pjrt_binding.h"
 #include "tfd/platform/detect.h"
@@ -6958,6 +6959,277 @@ void TestGetNodeDraining() {
   }
 }
 
+void TestRemedyEligibilityPrimitives() {
+  // The scheduler's-eye eligibility predicate and the gray-degradation
+  // detector (remedy/remedy.h; Python twin tpufd/remedy.py pins the
+  // same grid in tests/test_remedy.py).
+  lm::Labels ok = {{"google.com/tpu.count", "4"}};
+  CHECK_TRUE(remedy::Eligible(&ok));
+  CHECK_TRUE(!remedy::Eligible(nullptr));  // deleted CR
+  lm::Labels bad = ok;
+  bad["google.com/tpu.perf.class"] = "degraded";
+  CHECK_TRUE(!remedy::Eligible(&bad));
+  lm::Labels sliced = ok;
+  sliced["google.com/tpu.slice.degraded"] = "true";
+  CHECK_TRUE(!remedy::Eligible(&sliced));
+  lm::Labels preempt = ok;
+  preempt["google.com/tpu.lifecycle.preempt-imminent"] = "true";
+  CHECK_TRUE(!remedy::Eligible(&preempt));
+
+  // Gray: a chip-level degraded verdict while the headline class is
+  // NOT degraded. A degraded headline means the node is already
+  // fenced by the rest of the stack — not gray.
+  lm::Labels gray = ok;
+  gray["google.com/tpu.perf.chip0.class"] = "degraded";
+  CHECK_TRUE(remedy::GrayDegraded(gray));
+  CHECK_TRUE(!remedy::GrayDegraded(ok));
+  lm::Labels loud = gray;
+  loud["google.com/tpu.perf.class"] = "degraded";
+  CHECK_TRUE(!remedy::GrayDegraded(loud));
+  // Non-class chip keys (e.g. tpu.perf.chip0.gflops) are not verdicts.
+  lm::Labels metric = ok;
+  metric["google.com/tpu.perf.chip0.gflops"] = "degraded";
+  CHECK_TRUE(!remedy::GrayDegraded(metric));
+
+  // Deterministic jitter: same key -> same unit value, in [0, 1).
+  double j = remedy::BackoffJitterUnit("n2", 1);
+  CHECK_TRUE(j >= 0.0 && j < 1.0);
+  CHECK_EQ(j, remedy::BackoffJitterUnit("n2", 1));
+  CHECK_TRUE(j != remedy::BackoffJitterUnit("n2", 2));
+}
+
+void TestRemedyBackoffAndHeal() {
+  remedy::RemedyConfig cfg;
+  cfg.window_s = 60.0;
+  cfg.flap_threshold = 2;
+  cfg.heal_dwell_s = 10.0;
+  cfg.cooldown_s = 1.0;
+  cfg.backoff_base_s = 4.0;
+  cfg.backoff_max_s = 30.0;
+  remedy::RemedyEngine e(cfg);
+  lm::Labels ok = {{"google.com/tpu.count", "4"}};
+  lm::Labels bad = ok;
+  bad["google.com/tpu.perf.class"] = "degraded";
+
+  e.ObserveNode("n1", &ok, 0.0);
+  e.ObserveNode("n1", &bad, 1.0);
+  e.ObserveNode("n1", &ok, 2.0);
+  e.ObserveNode("n1", &bad, 3.0);  // second down-flip -> crash-loop
+
+  auto [actions, blocked] = e.Tick(4.0);
+  CHECK_EQ(actions.size(), 1u);
+  CHECK_EQ(actions[0].kind, "cordon");
+  CHECK_EQ(actions[0].evidence, "crash-loop");
+  // The write fails: exponential backoff (base 4s) arms, the intent is
+  // dropped, and the next tick inside the backoff is rate-limited.
+  e.NoteActionResult("n1", "cordon", false, 4.1);
+  CHECK_EQ(e.write_failures(), 1);
+  auto [actions2, blocked2] = e.Tick(5.0);
+  CHECK_TRUE(actions2.empty());
+  CHECK_EQ(blocked2.size(), 1u);
+  CHECK_EQ(blocked2[0].second, "node-rate-limit");
+  // After the backoff window (4s * <1.5 jitter factor <= 6s) the
+  // still-active evidence re-emits the same cordon; this one lands.
+  auto [actions3, blocked3] = e.Tick(11.0);
+  CHECK_EQ(actions3.size(), 1u);
+  CHECK_EQ(actions3[0].kind, "cordon");
+  e.NoteActionResult("n1", "cordon", true, 11.1);
+  CHECK_EQ(e.CordonedNodes().size(), 1u);
+  CHECK_EQ(e.ActionCount("cordon"), 1);  // failures don't count
+
+  // Heal: evidence retracted (flips age out of the window) and stays
+  // retracted for heal_dwell_s -> automatic rollback.
+  e.ObserveNode("n1", &ok, 70.0);
+  auto [actions4, blocked4] = e.Tick(70.5);
+  CHECK_TRUE(actions4.empty());  // dwell not yet served
+  auto [actions5, blocked5] = e.Tick(81.0);
+  CHECK_EQ(actions5.size(), 1u);
+  CHECK_EQ(actions5[0].kind, "uncordon");
+  e.NoteActionResult("n1", "uncordon", true, 81.1);
+  CHECK_EQ(e.rollbacks(), 1);
+  CHECK_TRUE(e.CordonedNodes().empty());
+}
+
+void TestRemedyParityGolden() {
+  // The scripted scenario from tests/test_remedy.py, replayed through
+  // the C++ engine; the final RenderJson() must equal the SAME literal
+  // the Python twin pins. Every semantic change lands in both engines
+  // or this golden fails on one side.
+  remedy::RemedyConfig cfg;
+  cfg.window_s = 60.0;
+  cfg.flap_threshold = 3;
+  cfg.heal_dwell_s = 10.0;
+  cfg.cooldown_s = 5.0;
+  cfg.backoff_base_s = 1.0;
+  cfg.backoff_max_s = 30.0;
+  cfg.max_concurrent_cordons = 3;
+  cfg.domain_cap = 1;
+  cfg.rebuild_cooldown_s = 30.0;
+  remedy::RemedyEngine e(cfg);
+
+  const lm::Labels kOk = {{"google.com/tpu.count", "4"}};
+  lm::Labels kBad = kOk;
+  kBad["google.com/tpu.perf.class"] = "degraded";
+  lm::Labels kGray = kOk;
+  kGray["google.com/tpu.perf.chip0.class"] = "degraded";
+  lm::Labels kPre = kOk;
+  kPre["google.com/tpu.lifecycle.preempt-imminent"] = "true";
+  auto dom = [](lm::Labels labels, const char* d) {
+    labels["google.com/tpu.topology.domain"] = d;
+    return labels;
+  };
+
+  // t=0 baseline: n1/n2/n5 plain, n3/n4 in rack-a, n6 in rack-b.
+  for (const char* n : {"n1", "n2", "n5"}) e.ObserveNode(n, &kOk, 0.0);
+  for (const char* n : {"n3", "n4"}) {
+    lm::Labels l = dom(kOk, "rack-a");
+    e.ObserveNode(n, &l, 0.0);
+  }
+  {
+    lm::Labels l = dom(kOk, "rack-b");
+    e.ObserveNode("n6", &l, 0.0);
+  }
+  // Crash-loop flapping on n1/n3/n4/n6 (down-flips at t=1, 3, 5).
+  int i = 0;
+  for (double t : {1.0, 2.0, 3.0, 4.0, 5.0}) {
+    const lm::Labels& flat = (i % 2 == 0) ? kBad : kOk;
+    e.ObserveNode("n1", &flat, t);
+    lm::Labels a = dom(flat, "rack-a");
+    lm::Labels b = dom(flat, "rack-b");
+    e.ObserveNode("n3", &a, t);
+    e.ObserveNode("n4", &a, t);
+    e.ObserveNode("n6", &b, t);
+    i++;
+  }
+  e.ObserveNode("n2", &kGray, 5.5);
+  e.ObserveNode("n5", &kPre, 5.5);
+
+  // Tick 1: cordons n1/n2/n3, budget blocks n4+n6, drain n5.
+  auto [a1, b1] = e.Tick(6.0);
+  CHECK_EQ(a1.size(), 4u);
+  CHECK_EQ(a1[0].kind + ":" + a1[0].node, "cordon:n1");
+  CHECK_EQ(a1[1].kind + ":" + a1[1].node, "cordon:n2");
+  CHECK_EQ(a1[2].kind + ":" + a1[2].node, "cordon:n3");
+  CHECK_EQ(a1[3].kind + ":" + a1[3].node, "drain-recommend:n5");
+  e.NoteActionResult("n1", "cordon", true, 6.1);
+  e.NoteActionResult("n2", "cordon", false, 6.1);  // write failure
+  e.NoteActionResult("n3", "cordon", true, 6.1);
+  e.NoteActionResult("n5", "drain-recommend", true, 6.1);
+
+  // Tick 2: n2 rate-limited, n4 domain-capped behind n3, n6 cordons.
+  auto [a2, b2] = e.Tick(7.0);
+  CHECK_EQ(a2.size(), 1u);
+  CHECK_EQ(a2[0].kind + ":" + a2[0].node, "cordon:n6");
+  CHECK_EQ(b2.size(), 2u);
+  CHECK_EQ(b2[0].first + "/" + b2[0].second, "n2/node-rate-limit");
+  CHECK_EQ(b2[1].first + "/" + b2[1].second, "n4/domain-cap");
+  e.NoteActionResult("n6", "cordon", true, 7.1);
+
+  // Tick 3: a burning SLO stage defers n4's cordon.
+  {
+    lm::Labels burn = {{"google.com/tpu.slo.publish.burn", "true"}};
+    e.ObserveInventory(burn, 7.5);
+  }
+  auto [a3, b3] = e.Tick(8.0);
+  CHECK_TRUE(a3.empty());
+  CHECK_EQ(b3.size(), 1u);
+  CHECK_EQ(b3[0].first + "/" + b3[0].second, "n4/slo-burn");
+
+  // Tick 4: burn clears, budget re-blocks n4; queued demand triggers
+  // a rebuild recommendation (predicted capacity 0 < 20 chips).
+  e.ObserveInventory({}, 9.0);
+  e.ObserveDemand(20, 9.0);
+  auto [a4, b4] = e.Tick(9.5);
+  CHECK_EQ(a4.size(), 1u);
+  CHECK_EQ(a4[0].kind, "rebuild-recommend");
+  CHECK_EQ(b4.size(), 1u);
+  CHECK_EQ(b4[0].first + "/" + b4[0].second, "n4/disruption-budget");
+  e.NoteActionResult("", "rebuild-recommend", true, 9.6);
+
+  // t=70: n1 heals for good; n3/n6 stay gray-degraded.
+  e.ObserveNode("n1", &kOk, 70.0);
+  e.ObserveNode("n2", &kOk, 70.0);
+  {
+    lm::Labels a = dom(kGray, "rack-a");
+    lm::Labels b = dom(kGray, "rack-b");
+    e.ObserveNode("n3", &a, 70.0);
+    e.ObserveNode("n6", &b, 70.0);
+  }
+  auto [a5, b5] = e.Tick(70.5);
+  CHECK_EQ(a5.size(), 1u);
+  CHECK_EQ(a5[0].kind, "rebuild-recommend");
+  e.NoteActionResult("", "rebuild-recommend", true, 70.6);
+
+  // Tick 6: n1's evidence stayed retracted for the heal dwell.
+  auto [a6, b6] = e.Tick(81.0);
+  CHECK_EQ(a6.size(), 1u);
+  CHECK_EQ(a6[0].kind + ":" + a6[0].node, "uncordon:n1");
+  e.NoteActionResult("n1", "uncordon", true, 81.1);
+
+  // Gray returns on n2; the cordon intent is abandoned mid-batch
+  // (epoch fence) without state change.
+  e.ObserveNode("n2", &kGray, 82.0);
+  auto [a7, b7] = e.Tick(82.5);
+  CHECK_EQ(a7.size(), 1u);
+  CHECK_EQ(a7[0].kind + ":" + a7[0].node, "cordon:n2");
+  CHECK_EQ(e.AbandonPending(), 1);
+
+  CHECK_EQ(
+      e.RenderJson(),
+      "{\"actions\":{\"cordon\":3,\"drain-recommend\":1,"
+      "\"rebuild-recommend\":2,\"uncordon\":1},\"blocked\":{"
+      "\"disruption-budget\":3,\"domain-cap\":1,\"node-rate-limit\":1,"
+      "\"slo-burn\":1},\"cordoned\":[\"n3\",\"n6\"],\"nodes\":{\"n1\":{"
+      "\"cordoned\":false,\"domain\":\"\",\"evidence\":[],\"flips\":0},"
+      "\"n2\":{\"cordoned\":false,\"domain\":\"\",\"evidence\":["
+      "\"gray\"],\"flips\":0},\"n3\":{\"cordoned\":true,\"domain\":"
+      "\"rack-a\",\"evidence\":[\"gray\"],\"flips\":0},\"n4\":{"
+      "\"cordoned\":false,\"domain\":\"rack-a\",\"evidence\":[],"
+      "\"flips\":0},\"n5\":{\"cordoned\":false,\"domain\":\"\","
+      "\"evidence\":[\"preempt\"],\"flips\":0},\"n6\":{\"cordoned\":"
+      "true,\"domain\":\"rack-b\",\"evidence\":[\"gray\"],\"flips\":0}}"
+      ",\"rollbacks\":1,\"write_failures\":1}");
+}
+
+void TestPatchNodeUnschedulable() {
+  // Cordon: ONE merge patch of spec.unschedulable to the core nodes
+  // endpoint, nothing else on the wire.
+  {
+    ScriptedApiServer server({{200, "{}"}});
+    k8s::ClusterConfig config;
+    config.apiserver_url = server.url();
+    bool alive = false;
+    k8s::WriteOutcome outcome;
+    Status s = k8s::PatchNodeUnschedulable(config, "node-1", true, &alive,
+                                           &outcome);
+    CHECK_TRUE(s.ok());
+    CHECK_TRUE(alive);
+    CHECK_EQ(outcome.patches, 1);
+    CHECK_EQ(server.exchanges().size(), 1u);
+    CHECK_EQ(server.exchanges()[0].method, "PATCH");
+    CHECK_EQ(server.exchanges()[0].path, "/api/v1/nodes/node-1");
+    CHECK_EQ(server.exchanges()[0].body,
+             "{\"spec\":{\"unschedulable\":true}}");
+  }
+  // Uncordon flips the literal; a 5xx is an error with an ALIVE server
+  // (pacing/overload must not read as a partition).
+  {
+    ScriptedApiServer server({{200, "{}"}, {503, "{}"}});
+    k8s::ClusterConfig config;
+    config.apiserver_url = server.url();
+    bool alive = false;
+    CHECK_TRUE(
+        k8s::PatchNodeUnschedulable(config, "node-1", false, &alive, nullptr)
+            .ok());
+    CHECK_EQ(server.exchanges()[0].body,
+             "{\"spec\":{\"unschedulable\":false}}");
+    Status s =
+        k8s::PatchNodeUnschedulable(config, "node-1", true, &alive, nullptr);
+    CHECK_TRUE(!s.ok());
+    CHECK_TRUE(alive);
+  }
+}
+
 void TestAggWatchEventName() {
   // metadata.name now rides every parsed watch event — load-bearing at
   // collection scope, where one stream carries every object. Pinned in
@@ -7720,6 +7992,10 @@ int main(int argc, char** argv) {
   tfd::TestPerfFleetFloor();
   tfd::TestSlicePreemptingMember();
   tfd::TestGetNodeDraining();
+  tfd::TestRemedyEligibilityPrimitives();
+  tfd::TestRemedyBackoffAndHeal();
+  tfd::TestRemedyParityGolden();
+  tfd::TestPatchNodeUnschedulable();
 
   std::cerr << tfd::g_checks << " checks, " << tfd::g_failures << " failures"
             << std::endl;

@@ -375,6 +375,65 @@ class TestHelmChart:
         svc = next(d for d in ds if d["kind"] == "Service")
         assert svc["spec"]["ports"][0]["port"] == 8085
 
+    def test_remedy_knobs_wired(self):
+        """The closed-loop remediation controller (ISSUE 20): helm
+        remedy.{enabled,dryRun,maxConcurrentCordons,domainCap} -> a
+        lease-elected Deployment gated on remedy.enabled wiring
+        TFD_MODE=remedy with dry-run SHIPPING ON, node patch RBAC
+        scoped to exactly cordon + drain-label, and the static manifest
+        carrying the same at defaults."""
+        values = yaml.safe_load((HELM / "values.yaml").read_text())
+        rm = values["remedy"]
+        assert rm["enabled"] is False
+        # The safety default: observe-only until explicitly flipped.
+        assert rm["dryRun"] is True
+        assert rm["maxConcurrentCordons"] == 3
+        assert rm["domainCap"] == 1
+        assert rm["replicas"] == 2
+        template = (HELM / "templates" / "remedy.yaml").read_text()
+        assert ".Values.remedy.enabled" in template
+        assert "kind: Deployment" in template
+        assert 'value: "remedy"' in template
+        assert "TFD_REMEDY_DRY_RUN" in template
+        assert ".Values.remedy.dryRun" in template
+        assert "TFD_REMEDY_MAX_CONCURRENT_CORDONS" in template
+        assert ".Values.remedy.maxConcurrentCordons" in template
+        assert "TFD_REMEDY_DOMAIN_CAP" in template
+        assert ".Values.remedy.domainCap" in template
+        assert ".Values.remedy.replicas" in template
+        # Lease-elected singleton: the namespaced configmap lease Role
+        # the aggregator idiom uses.
+        assert "configmaps" in template
+
+        ds = list(yaml.safe_load_all(
+            (STATIC / "tpu-feature-remedy-deployment.yaml")
+            .read_text()))
+        kinds = {d["kind"] for d in ds}
+        assert kinds == {"ServiceAccount", "ClusterRole",
+                         "ClusterRoleBinding", "Role", "RoleBinding",
+                         "Deployment"}
+        deploy = next(d for d in ds if d["kind"] == "Deployment")
+        assert deploy["spec"]["replicas"] == 2
+        container = deploy["spec"]["template"]["spec"]["containers"][0]
+        env = {e["name"]: e.get("value") for e in container["env"]}
+        assert env["TFD_MODE"] == "remedy"
+        assert env["TFD_REMEDY_DRY_RUN"] == "true"
+        assert env["TFD_REMEDY_MAX_CONCURRENT_CORDONS"] == "3"
+        assert env["TFD_REMEDY_DOMAIN_CAP"] == "1"
+        # The write surface, pinned verb by verb: nodes get exactly
+        # get+patch (cordon is a spec.unschedulable patch — no delete,
+        # no eviction surface at all), nodefeatures add the drain-label
+        # SSA apply verbs to the collection watch.
+        role = next(d for d in ds if d["kind"] == "ClusterRole")
+        by_resource = {}
+        for rule in role["rules"]:
+            for res in rule["resources"]:
+                by_resource.setdefault(res, set()).update(rule["verbs"])
+        assert by_resource["nodes"] == {"get", "patch"}
+        assert by_resource["nodefeatures"] == \
+            {"get", "list", "watch", "create", "patch"}
+        assert "pods" not in by_resource
+
     def test_lifecycle_watch_knob_wired(self):
         """The preemption fast path (ISSUE 13 satellite): helm
         lifecycleWatch -> TFD_LIFECYCLE_WATCH, static daemonsets at the

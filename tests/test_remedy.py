@@ -1,0 +1,537 @@
+"""Closed-loop remediation (ISSUE 20): twin parity + real-process
+drills.
+
+The fleet-scale behavior (remediation latency p99 per evidence class,
+budget-violation counting, queue-wait improvement vs a no-remedy
+control) lives in scripts/cluster_soak.py --remedy; THESE tests pin:
+
+  - the tpufd.remedy engine battery: the eligibility predicate, gray
+    detection, crash-loop flap windows, the four interlocks in their
+    documented order, failed-write backoff with deterministic jitter,
+    heal-dwell rollback, and abandon-on-lease-loss;
+  - the C++ <-> tpufd.remedy parity golden: ONE scripted scenario, ONE
+    render_json() literal — the same literal appears in unit_tests.cc
+    TestRemedyParityGolden;
+  - the fake apiserver's core /api/v1/nodes/<name> PATCH contract
+    (merge patch, resourceVersion precondition, rv bump, watch
+    fan-out) — the cordon verb's test double;
+  - the real binary in --mode=remedy: dry-run (default) journaling
+    every intent while mutating NOTHING, enforce-mode cordon of a
+    gray-degraded node, and the automatic rollback once the evidence
+    stays retracted for the heal dwell.
+"""
+
+import http.client
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+from conftest import http_get, wait_for
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from tpufd import journal as tpufd_journal  # noqa: E402
+from tpufd import metrics  # noqa: E402
+from tpufd import remedy  # noqa: E402
+from tpufd.fakes.apiserver import FakeApiServer  # noqa: E402
+
+NS = "remns"
+OUTPUT = "tfd-cluster-inventory"
+
+OK = {"google.com/tpu.count": "4"}
+BAD = {"google.com/tpu.count": "4",
+       "google.com/tpu.perf.class": "degraded"}
+GRAY = {"google.com/tpu.count": "4",
+        "google.com/tpu.perf.chip0.class": "degraded"}
+PRE = {"google.com/tpu.count": "4",
+       "google.com/tpu.lifecycle.preempt-imminent": "true"}
+
+
+def dom(labels, d):
+    out = dict(labels)
+    out[remedy.DOMAIN_LABEL] = d
+    return out
+
+
+def free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def stop(proc):
+    proc.terminate()
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait(timeout=5)
+
+
+def metric(port, name, labels=None):
+    status, body = http_get(port, "/metrics")
+    if status != 200:
+        return None
+    try:
+        return metrics.sample_value(body, name, labels=labels)
+    except ValueError:
+        return None
+
+
+def journal_events(port):
+    status, body = http_get(port, "/debug/journal")
+    if status != 200:
+        return []
+    try:
+        return tpufd_journal.parse_journal(json.loads(body))["events"]
+    except (ValueError, KeyError):
+        return []
+
+
+def get_node(server, name):
+    conn = http.client.HTTPConnection("127.0.0.1", server.port)
+    try:
+        conn.request("GET", f"/api/v1/nodes/{name}")
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+# ---- engine battery -------------------------------------------------------
+
+
+class TestEligibilityPrimitives:
+    def test_eligible_grid(self):
+        # unit_tests.cc TestRemedyEligibilityPrimitives pins the same
+        # grid.
+        assert remedy.eligible(OK)
+        assert not remedy.eligible(None)  # deleted CR
+        assert not remedy.eligible(BAD)
+        assert not remedy.eligible(
+            {**OK, "google.com/tpu.slice.degraded": "true"})
+        assert not remedy.eligible(
+            {**OK, "google.com/tpu.slice.class": "degraded"})
+        assert not remedy.eligible(PRE)
+        assert not remedy.eligible(
+            {**OK, "google.com/tpu.lifecycle.draining": "true"})
+
+    def test_gray_degraded(self):
+        assert remedy.gray_degraded(GRAY)
+        assert not remedy.gray_degraded(OK)
+        # A degraded HEADLINE class means the stack already fenced the
+        # node — that is loud, not gray.
+        assert not remedy.gray_degraded(
+            {**GRAY, "google.com/tpu.perf.class": "degraded"})
+        # Non-class chip keys are metrics, not verdicts.
+        assert not remedy.gray_degraded(
+            {**OK, "google.com/tpu.perf.chip0.gflops": "degraded"})
+
+    def test_backoff_jitter_deterministic(self):
+        j = remedy.backoff_jitter_unit("n2", 1)
+        assert 0.0 <= j < 1.0
+        assert j == remedy.backoff_jitter_unit("n2", 1)
+        assert j != remedy.backoff_jitter_unit("n2", 2)
+
+
+class TestEngineBattery:
+    def engine(self, **overrides):
+        kw = dict(window_s=60.0, flap_threshold=2, heal_dwell_s=10.0,
+                  cooldown_s=1.0, backoff_base_s=4.0, backoff_max_s=30.0)
+        kw.update(overrides)
+        return remedy.RemedyEngine(remedy.RemedyConfig(**kw))
+
+    def flap_to_crash_loop(self, e, node="n1", start=0.0):
+        e.observe_node(node, OK, start)
+        e.observe_node(node, BAD, start + 1.0)
+        e.observe_node(node, OK, start + 2.0)
+        e.observe_node(node, BAD, start + 3.0)  # second down-flip
+
+    def test_backoff_and_heal(self):
+        # Mirrors unit_tests.cc TestRemedyBackoffAndHeal.
+        e = self.engine()
+        self.flap_to_crash_loop(e)
+        actions, _ = e.tick(4.0)
+        assert [(a.kind, a.evidence) for a in actions] == \
+            [("cordon", "crash-loop")]
+        # Failed write: backoff arms; the next tick is rate-limited.
+        e.note_action_result("n1", "cordon", False, 4.1)
+        assert e.counters["write_failures"] == 1
+        actions, blocked = e.tick(5.0)
+        assert actions == []
+        assert blocked == [("n1", "node-rate-limit")]
+        # Past the backoff (4s * <=1.5 jitter factor) the still-active
+        # evidence re-emits the cordon; failures never counted.
+        actions, _ = e.tick(11.0)
+        assert [a.kind for a in actions] == ["cordon"]
+        e.note_action_result("n1", "cordon", True, 11.1)
+        assert e.cordoned_nodes() == ["n1"]
+        assert e.counters["actions"]["cordon"] == 1
+        # Heal: flips age out, dwell served -> automatic rollback.
+        e.observe_node("n1", OK, 70.0)
+        actions, _ = e.tick(70.5)
+        assert actions == []  # dwell not yet served
+        actions, _ = e.tick(81.0)
+        assert [a.kind for a in actions] == ["uncordon"]
+        e.note_action_result("n1", "uncordon", True, 81.1)
+        assert e.counters["rollbacks"] == 1
+        assert e.cordoned_nodes() == []
+
+    def test_backoff_doubles_and_caps(self):
+        e = self.engine()
+        self.flap_to_crash_loop(e)
+        assert remedy.cfg_backoff(e.config, 1) == 4.0
+        assert remedy.cfg_backoff(e.config, 2) == 8.0
+        assert min(remedy.cfg_backoff(e.config, 4),
+                   e.config.backoff_max_s) == 30.0
+
+    def test_dwell_resets_on_evidence_return(self):
+        e = self.engine(heal_dwell_s=10.0)
+        self.flap_to_crash_loop(e)
+        actions, _ = e.tick(4.0)
+        e.note_action_result("n1", "cordon", True, 4.1)
+        # Evidence clears at t=70, but RETURNS at t=75 (gray this
+        # time): the dwell clock must restart, not carry over.
+        e.observe_node("n1", OK, 70.0)
+        e.tick(70.5)
+        e.observe_node("n1", GRAY, 75.0)
+        actions, _ = e.tick(81.0)
+        assert actions == []  # would have fired at 80.5 without reset
+        e.observe_node("n1", OK, 85.0)
+        actions, _ = e.tick(95.5)
+        assert [a.kind for a in actions] == ["uncordon"]
+
+    def test_slo_burn_defers_and_releases(self):
+        e = self.engine()
+        self.flap_to_crash_loop(e)
+        e.observe_inventory(
+            {"google.com/tpu.slo.publish.burn": "true"}, 3.5)
+        actions, blocked = e.tick(4.0)
+        assert actions == []
+        assert blocked == [("n1", "slo-burn")]
+        # Steady blockage is not re-counted.
+        actions, blocked = e.tick(5.0)
+        assert blocked == []
+        assert e.counters["blocked"]["slo-burn"] == 1
+        e.observe_inventory({}, 6.0)
+        actions, _ = e.tick(7.0)
+        assert [a.kind for a in actions] == ["cordon"]
+
+    def test_preempt_drain_recommend_once(self):
+        # Preempt transitions are eligibility down-flips too; a high
+        # flap threshold keeps this test on the drain path alone.
+        e = self.engine(flap_threshold=5)
+        e.observe_node("n1", OK, 0.0)
+        e.observe_node("n1", PRE, 1.0)
+        actions, _ = e.tick(2.0)
+        assert [(a.kind, a.evidence) for a in actions] == \
+            [("drain-recommend", "preempt")]
+        e.note_action_result("n1", "drain-recommend", True, 2.1)
+        actions, _ = e.tick(5.0)
+        assert actions == []  # sticky until the evidence retracts
+        e.observe_node("n1", OK, 6.0)
+        e.observe_node("n1", PRE, 8.0)
+        actions, _ = e.tick(9.0)
+        assert [a.kind for a in actions] == ["drain-recommend"]
+
+    def test_rebuild_recommend_capacity_gap(self):
+        e = self.engine(rebuild_cooldown_s=30.0)
+        e.observe_node("n1", OK, 0.0)
+        e.observe_node("n2", OK, 0.0)
+        e.observe_demand(20, 0.0)
+        actions, _ = e.tick(1.0)  # capacity 8 < 20
+        assert [a.kind for a in actions] == ["rebuild-recommend"]
+        assert "capacity 8 chips < queued demand 20" in actions[0].reason
+        actions, _ = e.tick(2.0)
+        assert actions == []  # rebuild cooldown
+        e.observe_demand(6, 3.0)
+        actions, _ = e.tick(40.0)  # capacity 8 >= 6: satisfied
+        assert actions == []
+
+    def test_abandon_pending_drops_without_state_change(self):
+        e = self.engine()
+        self.flap_to_crash_loop(e)
+        actions, _ = e.tick(4.0)
+        assert [a.kind for a in actions] == ["cordon"]
+        assert e.abandon_pending() == 1
+        assert e.cordoned_nodes() == []
+        # The next tick re-derives the same intent from the evidence.
+        actions, _ = e.tick(5.0)
+        assert [a.kind for a in actions] == ["cordon"]
+
+
+class TestRemedyTracker:
+    def test_stage_decomposition_monotone(self):
+        t = remedy.RemedyTracker()
+        change = t.mint("cordon", "n1", 10.0)
+        t.stamp(change, "detect", 10.0)
+        t.stamp(change, "decide", 10.2)
+        t.stamp(change, "act", 10.25)
+        rec = t.close(change, 10.5)  # acked absorbs the remainder
+        assert rec["op"] == "cordon"
+        assert rec["node"] == "n1"
+        assert rec["e2e_ms"] == 500.0
+        assert list(rec["stages"]) == list(remedy.REMEDY_STAGES)
+        assert rec["stages"] == {"detect": 0.0, "decide": 200.0,
+                                 "act": 50.0, "acked": 250.0}
+        assert sum(rec["stages"].values()) == rec["e2e_ms"]
+
+    def test_discard(self):
+        t = remedy.RemedyTracker()
+        change = t.mint("cordon", "n1", 1.0)
+        t.discard(change)
+        assert t.close(change, 2.0) is None
+
+
+# ---- parity golden --------------------------------------------------------
+
+
+class TestParityGolden:
+    def test_scenario_matches_cpp_golden(self):
+        # The EXACT scenario unit_tests.cc TestRemedyParityGolden
+        # replays through the C++ engine; both pin the same literal.
+        cfg = remedy.RemedyConfig(
+            window_s=60.0, flap_threshold=3, heal_dwell_s=10.0,
+            cooldown_s=5.0, backoff_base_s=1.0, backoff_max_s=30.0,
+            max_concurrent_cordons=3, domain_cap=1,
+            rebuild_cooldown_s=30.0)
+        e = remedy.RemedyEngine(cfg)
+
+        # t=0 baseline: n1/n2/n5 plain, n3/n4 in rack-a, n6 in rack-b.
+        for n in ("n1", "n2", "n5"):
+            e.observe_node(n, OK, 0.0)
+        for n in ("n3", "n4"):
+            e.observe_node(n, dom(OK, "rack-a"), 0.0)
+        e.observe_node("n6", dom(OK, "rack-b"), 0.0)
+        # Crash-loop flapping on n1/n3/n4/n6 (down-flips at t=1, 3, 5).
+        for i, t in enumerate((1.0, 2.0, 3.0, 4.0, 5.0)):
+            flat = BAD if i % 2 == 0 else OK
+            e.observe_node("n1", flat, t)
+            e.observe_node("n3", dom(flat, "rack-a"), t)
+            e.observe_node("n4", dom(flat, "rack-a"), t)
+            e.observe_node("n6", dom(flat, "rack-b"), t)
+        e.observe_node("n2", GRAY, 5.5)
+        e.observe_node("n5", PRE, 5.5)
+
+        # Tick 1: cordons n1/n2/n3, budget blocks n4+n6, drain n5.
+        a, _ = e.tick(6.0)
+        assert [x.kind + ":" + x.node for x in a] == [
+            "cordon:n1", "cordon:n2", "cordon:n3",
+            "drain-recommend:n5"]
+        e.note_action_result("n1", "cordon", True, 6.1)
+        e.note_action_result("n2", "cordon", False, 6.1)  # write fails
+        e.note_action_result("n3", "cordon", True, 6.1)
+        e.note_action_result("n5", "drain-recommend", True, 6.1)
+
+        # Tick 2: n2 rate-limited, n4 domain-capped, n6 cordons.
+        a, b = e.tick(7.0)
+        assert [x.kind + ":" + x.node for x in a] == ["cordon:n6"]
+        assert b == [("n2", "node-rate-limit"), ("n4", "domain-cap")]
+        e.note_action_result("n6", "cordon", True, 7.1)
+
+        # Tick 3: a burning SLO stage defers n4's cordon.
+        e.observe_inventory(
+            {"google.com/tpu.slo.publish.burn": "true"}, 7.5)
+        a, b = e.tick(8.0)
+        assert a == []
+        assert b == [("n4", "slo-burn")]
+
+        # Tick 4: burn clears, budget re-blocks n4; queued demand
+        # triggers a rebuild recommendation (capacity 0 < 20 chips).
+        e.observe_inventory({}, 9.0)
+        e.observe_demand(20, 9.0)
+        a, b = e.tick(9.5)
+        assert [x.kind for x in a] == ["rebuild-recommend"]
+        assert b == [("n4", "disruption-budget")]
+        e.note_action_result("", "rebuild-recommend", True, 9.6)
+
+        # t=70: n1 heals for good; n3/n6 stay gray-degraded.
+        e.observe_node("n1", OK, 70.0)
+        e.observe_node("n2", OK, 70.0)
+        e.observe_node("n3", dom(GRAY, "rack-a"), 70.0)
+        e.observe_node("n6", dom(GRAY, "rack-b"), 70.0)
+        a, _ = e.tick(70.5)
+        assert [x.kind for x in a] == ["rebuild-recommend"]
+        e.note_action_result("", "rebuild-recommend", True, 70.6)
+
+        # Tick 6: n1's evidence stayed retracted for the heal dwell.
+        a, _ = e.tick(81.0)
+        assert [x.kind + ":" + x.node for x in a] == ["uncordon:n1"]
+        e.note_action_result("n1", "uncordon", True, 81.1)
+
+        # Gray returns on n2; the intent is abandoned mid-batch.
+        e.observe_node("n2", GRAY, 82.0)
+        a, _ = e.tick(82.5)
+        assert [x.kind + ":" + x.node for x in a] == ["cordon:n2"]
+        assert e.abandon_pending() == 1
+        assert e.cordoned_nodes() == ["n3", "n6"]
+
+        assert e.render_json() == (
+            '{"actions":{"cordon":3,"drain-recommend":1,'
+            '"rebuild-recommend":2,"uncordon":1},"blocked":{'
+            '"disruption-budget":3,"domain-cap":1,"node-rate-limit":1,'
+            '"slo-burn":1},"cordoned":["n3","n6"],"nodes":{"n1":{'
+            '"cordoned":false,"domain":"","evidence":[],"flips":0},'
+            '"n2":{"cordoned":false,"domain":"","evidence":["gray"],'
+            '"flips":0},"n3":{"cordoned":true,"domain":"rack-a",'
+            '"evidence":["gray"],"flips":0},"n4":{"cordoned":false,'
+            '"domain":"rack-a","evidence":[],"flips":0},"n5":{'
+            '"cordoned":false,"domain":"","evidence":["preempt"],'
+            '"flips":0},"n6":{"cordoned":true,"domain":"rack-b",'
+            '"evidence":["gray"],"flips":0}},"rollbacks":1,'
+            '"write_failures":1}')
+
+
+# ---- fake apiserver: core node PATCH --------------------------------------
+
+
+def patch_node(server, name, body, content_type="application/"
+                                                "merge-patch+json"):
+    conn = http.client.HTTPConnection("127.0.0.1", server.port)
+    try:
+        conn.request("PATCH", f"/api/v1/nodes/{name}",
+                     body=json.dumps(body).encode(),
+                     headers={"Content-Type": content_type})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+class TestNodeCordon:
+    def test_merge_patch_flips_unschedulable_and_bumps_rv(self):
+        with FakeApiServer() as server:
+            server.set_node("node-1", unschedulable=False)
+            status, obj = get_node(server, "node-1")
+            assert status == 200
+            assert obj["metadata"]["resourceVersion"] == "1"
+            status, obj = patch_node(
+                server, "node-1", {"spec": {"unschedulable": True}})
+            assert status == 200
+            assert obj["spec"]["unschedulable"] is True
+            assert obj["metadata"]["resourceVersion"] == "2"
+            # The fan-out history carries the MODIFIED event.
+            events = server._handler.node_events["node-1"]
+            assert [(rv, t) for rv, t, _ in events] == [(2, "MODIFIED")]
+            # Uncordon flips it back.
+            status, obj = patch_node(
+                server, "node-1", {"spec": {"unschedulable": False}})
+            assert status == 200
+            assert obj["spec"]["unschedulable"] is False
+            assert obj["metadata"]["resourceVersion"] == "3"
+
+    def test_rv_precondition_checked_then_stripped(self):
+        with FakeApiServer() as server:
+            server.set_node("node-1")
+            status, _ = patch_node(
+                server, "node-1",
+                {"metadata": {"resourceVersion": "999"},
+                 "spec": {"unschedulable": True}})
+            assert status == 409
+            status, obj = patch_node(
+                server, "node-1",
+                {"metadata": {"resourceVersion": "1"},
+                 "spec": {"unschedulable": True}})
+            assert status == 200
+            # Checked as a precondition, then STRIPPED: the stale
+            # version string must not persist as content.
+            assert obj["metadata"]["resourceVersion"] == "2"
+
+    def test_unknown_node_404_and_wrong_content_type_415(self):
+        with FakeApiServer() as server:
+            status, _ = patch_node(
+                server, "ghost", {"spec": {"unschedulable": True}})
+            assert status == 404
+            server.set_node("node-1")
+            status, _ = patch_node(
+                server, "node-1", {"spec": {"unschedulable": True}},
+                content_type="application/json-patch+json")
+            assert status == 415
+
+
+# ---- real-process remedy drills -------------------------------------------
+
+
+def remedy_argv(binary, port, extra=()):
+    return [str(binary), "--mode=remedy", "--agg-lease-duration=3s",
+            "--remedy-window=10s", "--remedy-heal-dwell=2s",
+            "--remedy-node-cooldown=1s",
+            f"--introspection-addr=127.0.0.1:{port}", *extra]
+
+
+def remedy_env(server, who="remedy-0"):
+    return {**os.environ, "TFD_APISERVER_URL": server.url,
+            "KUBERNETES_NAMESPACE": NS, "POD_NAME": who,
+            "GCE_METADATA_HOST": "127.0.0.1:1"}
+
+
+class TestRemedyProcess:
+    def test_dry_run_default_journals_but_never_mutates(self, tfd_binary):
+        with FakeApiServer() as server:
+            server.set_node("node-1", unschedulable=False)
+            server.seed(NS, "tfd-features-for-node-1", GRAY)
+            port = free_port()
+            proc = subprocess.Popen(
+                remedy_argv(tfd_binary, port), env=remedy_env(server),
+                stderr=subprocess.DEVNULL)
+            try:
+                assert wait_for(
+                    lambda: metric(port, "tfd_remedy_state") == 1.0,
+                    timeout=20)
+                assert wait_for(
+                    lambda: metric(port, "tfd_remedy_actions_total",
+                                   {"action": "cordon"}) == 1.0,
+                    timeout=20)
+                # The intent is journaled with the dry-run stamp and
+                # the stage decomposition...
+                events = journal_events(port)
+                cordons = [ev for ev in events
+                           if ev["type"] == "remedy-cordon"]
+                assert cordons, [ev["type"] for ev in events]
+                assert cordons[0]["fields"]["dry_run"] == "true"
+                assert "act_ms" in cordons[0]["fields"]
+                # ...but the node object was NEVER touched: same rv,
+                # still schedulable, zero PATCHes on the wire.
+                status, obj = get_node(server, "node-1")
+                assert status == 200
+                assert obj["metadata"]["resourceVersion"] == "1"
+                assert obj["spec"]["unschedulable"] is False
+                assert metric(port, "tfd_remedy_cordons_active") == 1.0
+            finally:
+                stop(proc)
+
+    def test_enforce_cordons_then_rolls_back_on_heal(self, tfd_binary):
+        with FakeApiServer() as server:
+            server.set_node("node-1", unschedulable=False)
+            server.seed(NS, "tfd-features-for-node-1", GRAY)
+            port = free_port()
+            proc = subprocess.Popen(
+                remedy_argv(tfd_binary, port,
+                            extra=("--remedy-dry-run=false",)),
+                env=remedy_env(server), stderr=subprocess.DEVNULL)
+            try:
+                # Enforce: the gray node is actually cordoned.
+                assert wait_for(
+                    lambda: get_node(server, "node-1")[1]["spec"][
+                        "unschedulable"] is True, timeout=20)
+                # Evidence retracts and stays retracted for the heal
+                # dwell (2s): the controller rolls its own action back.
+                server.seed(NS, "tfd-features-for-node-1", OK)
+                assert wait_for(
+                    lambda: get_node(server, "node-1")[1]["spec"][
+                        "unschedulable"] is False, timeout=20)
+                assert wait_for(
+                    lambda: metric(
+                        port, "tfd_remedy_rollbacks_total") == 1.0,
+                    timeout=10)
+                events = journal_events(port)
+                kinds = [ev["type"] for ev in events]
+                assert "remedy-cordon" in kinds
+                assert "remedy-rollback" in kinds
+            finally:
+                stop(proc)

@@ -105,6 +105,7 @@ def test_graft_dryrun_hermetic_subprocess():
     assert "DRYRUN_OK" in proc.stdout
 
 
+@pytest.mark.slow
 def test_health_probes_cpu(cpu_jax):
     """The probes must run (tiny sizes) on whatever backend is present."""
     from tpufd import health
@@ -124,6 +125,7 @@ def test_health_probes_cpu(cpu_jax):
     assert "google.com/tpu.health.devices-consistent" not in labels
 
 
+@pytest.mark.slow
 def test_chip_count_cross_check(cpu_jax, monkeypatch):
     """TFD_CHIP_COUNT (exported by the daemon around the health exec)
     drives the enumeration cross-check: match -> consistent only;
@@ -165,6 +167,7 @@ def test_dma_copy_probe_cpu(cpu_jax):
     assert float(out[0, 0]) == 2.5 and float(out[-1, -1]) == 2.5
 
 
+@pytest.mark.slow
 def test_health_labels_extended_cpu(cpu_jax):
     """--extended adds the dma-copy-gbps label through the same fmt/
     rated-context plumbing as the other throughput labels."""
@@ -175,6 +178,7 @@ def test_health_labels_extended_cpu(cpu_jax):
     assert float(labels["google.com/tpu.health.dma-copy-gbps"]) > 0
 
 
+@pytest.mark.slow
 def test_extended_probe_failure_degrades_gracefully(cpu_jax, monkeypatch):
     """A pallas/Mosaic failure of the opt-in DMA probe is an environment
     limitation, not sick silicon: the chip the core probes measured
@@ -265,6 +269,7 @@ def test_ici_axis_sweep_cpu(cpu_jax):
     assert bool(jnp.any(shift(x, jnp.int32(1)) != x))
 
 
+@pytest.mark.slow
 def test_ici_sweep_labels_cpu(cpu_jax, monkeypatch):
     """When the devices expose a coordinate grid, health_labels adds one
     ici-<axis>-gbps label per axis; CPU devices don't, so the physical
@@ -334,6 +339,7 @@ def test_allreduce_probe_multidevice(cpu_jax):
     assert gbps > 0
 
 
+@pytest.mark.slow
 def test_bench_json_contract():
     """bench.py must print exactly one JSON line with the driver's schema;
     TFD_BENCH_RUNS trims it for test speed and JAX_PLATFORMS=cpu skips the
@@ -472,6 +478,7 @@ def test_cli_burnin(cpu_jax, capsys):
     assert "ring attention" not in out
 
 
+@pytest.mark.slow
 def test_cli_health(cpu_jax, capsys):
     """python -m tpufd health prints feature-file-format label lines."""
     from tpufd.__main__ import main
@@ -591,6 +598,7 @@ def test_sched_probe_scheduler_retries_with_backoff():
         labels={"probe": "hbm-gbps"}) == 3
 
 
+@pytest.mark.slow
 def test_sched_health_labels_retry_transient_probe(cpu_jax, monkeypatch):
     """health_labels routes its core probes through the scheduler: one
     transient raise must not flip ok=false (TPUFD_PROBE_RETRIES covers

@@ -701,13 +701,17 @@ class ChangeTracker:
         return len(self.open_by_node)
 
 
-def stage_breakdown(closed, percentile):
+def stage_breakdown(closed, percentile, stages=None):
     """Aggregates closed chains into the record's per-failure-class
     stage table: for each op, per-stage p50/p99 (ms) + the
     sum-consistency fields bench_gate checks — stage_p99_sum_ms vs
     e2e_p99_ms per class, and mean_stage_sum_ms == mean_e2e_ms exactly
     (the partition property). `percentile` is injected (the soak's
-    helper) so this module stays dependency-light."""
+    helper) so this module stays dependency-light. `stages` defaults to
+    the placement CHAIN_STAGES; the remediation scorecard passes
+    remedy.REMEDY_STAGES (detect -> decide -> act -> acked) and reuses
+    the identical aggregation + sum-consistency contract."""
+    stage_names = CHAIN_STAGES if stages is None else tuple(stages)
     by_op = {}
     for chain in closed:
         by_op.setdefault(chain["op"], []).append(chain)
@@ -717,7 +721,7 @@ def stage_breakdown(closed, percentile):
         stages = {}
         p99_sum = 0.0
         mean_sum = 0.0
-        for stage in CHAIN_STAGES:
+        for stage in stage_names:
             values = [c["stages"][stage] for c in chains]
             p50 = percentile(values, 50)
             p99 = percentile(values, 99)
@@ -756,15 +760,26 @@ def stage_breakdown(closed, percentile):
 # the slice must NOT degrade and the member's labels keep flowing via
 # the leader's hedged publish). The full semantics table lives in
 # docs/placement-harness.md.
+#
+# Failure DOMAINS (ISSUE 20, the remediation controller's domain-cap
+# interlock) are declared inline and then targeted as a unit:
+#   domain rack-a hosts=s0/h0,s1/h2,s2/h1     # declaration, no time
+#   30 domain-fail rack-a                     # every member partitions
+#   60 domain-heal rack-a
+# A domain must be declared BEFORE the first event that targets it, a
+# member must be sNN/hMM, and an undeclared/typo'd name fails the parse
+# loudly — a quiet skip would soak nothing and gate everything.
 
 HOST_OPS = {"degrade", "heal", "wedge", "unwedge", "preempt",
             "preempt-clear", "asym-partition", "asym-heal"}
 SLICE_OPS = {"leader-kill", "leader-restart", "partition",
              "heal-partition"}
 SERVER_OPS = {"brownout", "slowdown"}
+DOMAIN_OPS = {"domain-fail", "domain-heal"}
 
 _TARGET_HOST = re.compile(r"^s(\d+)/h(\d+)$")
 _TARGET_SLICE = re.compile(r"^s(\d+)$")
+_DOMAIN_NAME = re.compile(r"^[A-Za-z][A-Za-z0-9_-]*$")
 
 
 class ScheduleEvent:
@@ -781,22 +796,54 @@ class ScheduleEvent:
     def target(self):
         if self.op in SERVER_OPS:
             return "apiserver"
+        if self.op in DOMAIN_OPS:
+            return self.args["domain"]
         if self.host_idx is not None:
             return f"s{self.slice_idx:02d}/h{self.host_idx:02d}"
         return f"s{self.slice_idx:02d}"
 
 
-def parse_schedule(text):
-    """Parses the failure-schedule grammar into ScheduleEvents sorted by
-    (time, line order). Raises ValueError naming the offending line —
-    a silent skip would turn a typo'd chaos schedule into a quiet soak
-    that gates nothing."""
+def parse_schedule_with_domains(text):
+    """Parses the failure-schedule grammar into (events, domains):
+    ScheduleEvents sorted by (time, line order), plus the declared
+    failure domains as {name: [(slice_idx, host_idx), ...]}. Raises
+    ValueError naming the offending line — a silent skip would turn a
+    typo'd chaos schedule into a quiet soak that gates nothing."""
     events = []
+    domains = {}
     for lineno, raw in enumerate(text.splitlines(), 1):
         line = raw.split("#", 1)[0].strip()
         if not line:
             continue
         parts = line.split()
+        if parts[0] == "domain":
+            # Declaration line: domain <name> hosts=s0/h0,s1/h2,...
+            if len(parts) != 3 or not parts[2].startswith("hosts="):
+                raise ValueError(
+                    f"schedule line {lineno}: want 'domain <name> "
+                    f"hosts=sA/hB,...', got {raw!r}")
+            name = parts[1]
+            if not _DOMAIN_NAME.match(name):
+                raise ValueError(
+                    f"schedule line {lineno}: bad domain name {name!r}")
+            if name in domains:
+                raise ValueError(
+                    f"schedule line {lineno}: duplicate domain {name!r}")
+            members = []
+            spec = parts[2][len("hosts="):]
+            for item in spec.split(",") if spec else []:
+                m = _TARGET_HOST.match(item)
+                if not m:
+                    raise ValueError(
+                        f"schedule line {lineno}: domain member "
+                        f"{item!r} is not sNN/hMM")
+                members.append((int(m.group(1)), int(m.group(2))))
+            if not members:
+                raise ValueError(
+                    f"schedule line {lineno}: domain {name!r} has no "
+                    f"members")
+            domains[name] = members
+            continue
         if len(parts) < 3:
             raise ValueError(
                 f"schedule line {lineno}: want '<at> <op> <target>', "
@@ -835,11 +882,25 @@ def parse_schedule(text):
                 raise ValueError(
                     f"schedule line {lineno}: op {op} wants the "
                     f"'apiserver' target, got {target!r}")
+        elif op in DOMAIN_OPS:
+            if target not in domains:
+                raise ValueError(
+                    f"schedule line {lineno}: op {op} targets "
+                    f"undeclared domain {target!r} (declare it first "
+                    f"with 'domain {target} hosts=...')")
+            args["domain"] = target
         else:
             raise ValueError(f"schedule line {lineno}: unknown op {op!r}")
         events.append(ScheduleEvent(at, op, slice_idx, host_idx, args,
                                     lineno))
     events.sort(key=lambda e: (e.at, e.line))
+    return events, domains
+
+
+def parse_schedule(text):
+    """Back-compat wrapper: events only, domain declarations allowed
+    but discarded."""
+    events, _ = parse_schedule_with_domains(text)
     return events
 
 

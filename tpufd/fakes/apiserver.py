@@ -626,6 +626,43 @@ class _Handler(BaseHTTPRequestHandler):
         patch = self._body()  # consume before ANY reply (keep-alive framing)
         if self._gate():
             return None
+        path, _ = self._split_path()
+        if path.startswith(NODES_PREFIX + "/"):
+            # Core /api/v1/nodes/<name> merge patch — the remediation
+            # controller's cordon/uncordon verb (spec.unschedulable).
+            # Same optimistic-concurrency contract as the CR store: a
+            # metadata.resourceVersion in the patch is a PRECONDITION,
+            # checked then stripped, and every successful patch bumps
+            # the rv and fans out to parked watchers.
+            content_type = (self.headers.get("Content-Type")
+                            or "").split(";")[0].strip()
+            if not self.patch_supported or content_type != MERGE_PATCH:
+                return self._reply(
+                    415, {"message": f"unsupported patch type "
+                                     f"{content_type}"})
+            node_name = path[len(NODES_PREFIX) + 1:]
+            with self.lock:
+                node = self.nodes.get(node_name)
+                if node is None:
+                    return self._reply(404, {"message": "not found"})
+                current_rv = node["metadata"]["resourceVersion"]
+                patch = copy.deepcopy(patch)
+                sent_rv = (patch.get("metadata") or {}).pop(
+                    "resourceVersion", None)
+                if sent_rv is not None and sent_rv != current_rv:
+                    return self._reply(409, {"message": "conflict"})
+                if patch.get("metadata") == {}:
+                    del patch["metadata"]
+                merge_patch(node, patch)
+                node["metadata"]["resourceVersion"] = str(
+                    int(current_rv) + 1)
+                self.nodes[node_name] = node
+                history = self.node_events.setdefault(node_name, [])
+                history.append((int(node["metadata"]["resourceVersion"]),
+                                "MODIFIED", copy.deepcopy(node)))
+                self.watch_cond.notify_all()
+                obj = copy.deepcopy(node)
+            return self._reply(200, obj)
         ns, name = self._parse()
         if ns is None or name is None:
             return self._reply(404, {"message": "not found"})
@@ -699,7 +736,7 @@ class FakeApiServer:
             "capacity": 0, "cap_bucket": [0, 0], "patch_supported": True,
             "apply_supported": True, "events": {}, "compacted": {},
             "managers": {}, "grv": [0], "collection_events": {},
-            "collection_compacted": {}, "nodes": {},
+            "collection_compacted": {}, "nodes": {}, "node_events": {},
             "watch_history": int(watch_history),
             "collection_history": int(collection_history),
             "watch_cond": threading.Condition(lock),
@@ -848,9 +885,12 @@ class FakeApiServer:
         """Creates/updates a /api/v1/nodes/<name> object — the lifecycle
         probe's draining input (spec.unschedulable + taints)."""
         with self._handler.lock:
+            existing = self._handler.nodes.get(name)
+            rv = "1" if existing is None else str(
+                int(existing["metadata"]["resourceVersion"]) + 1)
             self._handler.nodes[name] = {
                 "apiVersion": "v1", "kind": "Node",
-                "metadata": {"name": name},
+                "metadata": {"name": name, "resourceVersion": rv},
                 "spec": {"unschedulable": bool(unschedulable),
                          "taints": list(taints or [])},
             }
